@@ -1,0 +1,32 @@
+"""karpenter_core_tpu — a TPU-native cluster-autoscaling framework.
+
+A from-scratch rebuild of the capability set of `karpenter-core` (Kubernetes
+node autoscaler, reference mounted at /root/reference): watch unschedulable
+pods, evaluate the full Kubernetes scheduling constraint model, bin-pack pods
+onto candidate nodes chosen from priced instance-type offerings, launch and
+lifecycle those nodes, and continuously deprovision (consolidation, emptiness,
+expiration, drift) via scheduling simulation.
+
+Unlike the reference — whose solver is a serial first-fit-decreasing loop in Go
+(reference scheduler.go:96-133) — the compute-heavy kernels here encode
+pending pods x instance types x topology domains as dense feasibility tensors
+and solve provisioning and consolidation replans as vmapped/pjit-sharded JAX
+kernels on TPU, behind a pluggable `Solver` interface with an in-process
+greedy fallback.
+
+Layer map (mirrors SURVEY.md section 1):
+  kube/           k8s-lite object model + in-memory apiserver (envtest analog)
+  api/            L0: Provisioner/Machine types, labels, settings
+  scheduling/     L1: constraint algebra (requirements, taints, ports, volumes)
+  cloudprovider/  L0: SPI, InstanceType/Offering, fake provider
+  state/          L2: cluster state cache + informers
+  controllers/    L4: provisioning, deprovisioning, machine, node, termination,
+                  inflightchecks, counter, metrics
+  solver/         snapshot->tensor encoding + Solver interface + gRPC service
+  ops/            JAX/Pallas kernels (feasibility, packing, topology, replan)
+  parallel/       device mesh, shardings, pjit wrappers
+  events/metrics/ observability
+  utils/          resource-list algebra and helpers
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,108 @@
+"""Well-known / restricted label domains, capacity types, annotations.
+
+Mirrors reference pkg/apis/v1alpha5/labels.go:26-135.
+"""
+from __future__ import annotations
+
+from karpenter_core_tpu.kube.objects import (
+    LABEL_ARCH_STABLE,
+    LABEL_FAILURE_DOMAIN_BETA_REGION,
+    LABEL_FAILURE_DOMAIN_BETA_ZONE,
+    LABEL_HOSTNAME,
+    LABEL_INSTANCE_TYPE_BETA,
+    LABEL_INSTANCE_TYPE_STABLE,
+    LABEL_OS_STABLE,
+    LABEL_TOPOLOGY_REGION,
+    LABEL_TOPOLOGY_ZONE,
+)
+
+GROUP = "karpenter.sh"
+TESTING_GROUP = "testing.karpenter.sh"
+COMPATIBILITY_GROUP = "compatibility.karpenter.sh"
+
+ARCHITECTURE_AMD64 = "amd64"
+ARCHITECTURE_ARM64 = "arm64"
+CAPACITY_TYPE_SPOT = "spot"
+CAPACITY_TYPE_ON_DEMAND = "on-demand"
+
+PROVISIONER_NAME_LABEL_KEY = f"{GROUP}/provisioner-name"
+MACHINE_NAME_LABEL_KEY = f"{GROUP}/machine-name"
+LABEL_NODE_INITIALIZED = f"{GROUP}/initialized"
+LABEL_CAPACITY_TYPE = f"{GROUP}/capacity-type"
+
+DO_NOT_EVICT_POD_ANNOTATION_KEY = f"{GROUP}/do-not-evict"
+DO_NOT_CONSOLIDATE_NODE_ANNOTATION_KEY = f"{GROUP}/do-not-consolidate"
+EMPTINESS_TIMESTAMP_ANNOTATION_KEY = f"{GROUP}/emptiness-timestamp"
+VOLUNTARY_DISRUPTION_ANNOTATION_KEY = f"{GROUP}/voluntary-disruption"
+VOLUNTARY_DISRUPTION_DRIFTED_VALUE = "drifted"
+PROVIDER_COMPATIBILITY_ANNOTATION_KEY = f"{COMPATIBILITY_GROUP}/provider"
+
+TERMINATION_FINALIZER = f"{GROUP}/termination"
+
+# Label domains prohibited by the kubelet or reserved by the framework
+# (labels.go:62-67).
+RESTRICTED_LABEL_DOMAINS = frozenset({"kubernetes.io", "k8s.io", GROUP})
+
+# Sub-domains of the restricted domains that are allowed (labels.go:69-76).
+LABEL_DOMAIN_EXCEPTIONS = frozenset({"kops.k8s.io", "node.kubernetes.io", TESTING_GROUP})
+
+# Labels in restricted domains the framework understands and can narrow
+# (labels.go:78-89). A mutable set: the fake cloudprovider registers extra
+# well-known labels like the reference's fake does (fake/instancetype.go:40-46).
+WELL_KNOWN_LABELS = {
+    PROVISIONER_NAME_LABEL_KEY,
+    LABEL_TOPOLOGY_ZONE,
+    LABEL_TOPOLOGY_REGION,
+    LABEL_INSTANCE_TYPE_STABLE,
+    LABEL_ARCH_STABLE,
+    LABEL_OS_STABLE,
+    LABEL_CAPACITY_TYPE,
+}
+
+
+def register_well_known_labels(*keys: str) -> None:
+    WELL_KNOWN_LABELS.update(keys)
+
+# Labels that must not be injected on nodes (labels.go:91-96).
+RESTRICTED_LABELS = frozenset({EMPTINESS_TIMESTAMP_ANNOTATION_KEY, LABEL_HOSTNAME})
+
+# Aliased label keys normalized into the well-known vocabulary
+# (labels.go:98-107).
+NORMALIZED_LABELS = {
+    LABEL_FAILURE_DOMAIN_BETA_ZONE: LABEL_TOPOLOGY_ZONE,
+    "beta.kubernetes.io/arch": LABEL_ARCH_STABLE,
+    "beta.kubernetes.io/os": LABEL_OS_STABLE,
+    LABEL_INSTANCE_TYPE_BETA: LABEL_INSTANCE_TYPE_STABLE,
+    LABEL_FAILURE_DOMAIN_BETA_REGION: LABEL_TOPOLOGY_REGION,
+}
+
+
+def is_restricted_node_label(key: str) -> bool:
+    """True if the label should not be injected on nodes (labels.go:120-134).
+
+    Well-known labels ARE restricted here: cloud providers inject them, the
+    framework must not synthesize values for them."""
+    if key in WELL_KNOWN_LABELS:
+        return True
+    domain = _label_domain(key)
+    if domain in LABEL_DOMAIN_EXCEPTIONS:
+        return False
+    if any(domain.endswith(d) for d in RESTRICTED_LABEL_DOMAINS):
+        return True
+    return key in RESTRICTED_LABELS
+
+
+def is_restricted_label(key: str) -> str | None:
+    """Returns an error message if the label may not be used (labels.go:107-115)."""
+    if key in WELL_KNOWN_LABELS:
+        return None
+    if is_restricted_node_label(key):
+        return (
+            f"label {key} is restricted; specify a well known label or a custom "
+            f"label that does not use a restricted domain"
+        )
+    return None
+
+
+def _label_domain(key: str) -> str:
+    return key.split("/", 1)[0] if "/" in key else ""

@@ -1,0 +1,58 @@
+"""Dynamic global settings (reference pkg/apis/settings/settings.go:32-68).
+
+The reference resolves these from the `karpenter-global-settings` ConfigMap and
+injects them into context.Context; here they form a process-wide Settings
+object threaded explicitly (or via `current()` for defaults).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict
+
+
+@dataclass
+class Settings:
+    batch_max_duration: float = 10.0  # seconds (settings.go:33)
+    batch_idle_duration: float = 1.0  # seconds (settings.go:34)
+    ttl_after_not_registered: float = 15 * 60.0  # seconds (settings.go:35-37)
+    drift_enabled: bool = False  # feature gate (settings.go:44)
+
+    @classmethod
+    def from_config_map(cls, data: Dict[str, str]) -> "Settings":
+        """Parse the settings ConfigMap data (settings.go:53-68)."""
+        s = cls()
+        if "batchMaxDuration" in data:
+            s.batch_max_duration = _parse_duration(data["batchMaxDuration"])
+        if "batchIdleDuration" in data:
+            s.batch_idle_duration = _parse_duration(data["batchIdleDuration"])
+        if "ttlAfterNotRegistered" in data:
+            s.ttl_after_not_registered = _parse_duration(data["ttlAfterNotRegistered"])
+        if "featureGates.driftEnabled" in data:
+            s.drift_enabled = data["featureGates.driftEnabled"].lower() == "true"
+        return s
+
+
+def _parse_duration(value: str) -> float:
+    """Parse a Go-style duration string ("10s", "1m30s", "500ms"); rejects
+    malformed input like Go's time.ParseDuration."""
+    import re
+
+    value = value.strip()
+    unit_re = r"[0-9]+(?:\.[0-9]*)?(?:h|m(?!s)|s|ms|us|ns)"
+    if not re.fullmatch(f"(?:{unit_re})+", value):
+        raise ValueError(f"cannot parse duration {value!r}")
+    matches = re.findall(r"([0-9]+(?:\.[0-9]*)?)(h|m(?!s)|s|ms|us|ns)", value)
+    unit_seconds = {"h": 3600.0, "m": 60.0, "s": 1.0, "ms": 1e-3, "us": 1e-6, "ns": 1e-9}
+    return sum(float(n) * unit_seconds[u] for n, u in matches)
+
+
+_current = Settings()
+
+
+def current() -> Settings:
+    return _current
+
+
+def set_current(settings: Settings) -> None:
+    global _current
+    _current = settings

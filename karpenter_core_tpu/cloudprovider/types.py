@@ -1,0 +1,118 @@
+"""CloudProvider SPI + core value types.
+
+Mirrors reference pkg/cloudprovider/types.go:50-175: the vendor interface
+(Create/Delete/Get/GetInstanceTypes/IsMachineDrifted), InstanceType with
+Requirements/Offerings/Capacity/Overhead, Offering with per-(zone,
+capacity-type) price and availability, and MachineNotFoundError.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from karpenter_core_tpu.api.labels import LABEL_CAPACITY_TYPE
+from karpenter_core_tpu.api.machine import Machine
+from karpenter_core_tpu.api.provisioner import Provisioner
+from karpenter_core_tpu.kube.objects import LABEL_TOPOLOGY_ZONE, ResourceList
+from karpenter_core_tpu.scheduling.requirements import Requirements
+from karpenter_core_tpu.utils import resources as resources_util
+
+
+@dataclass(frozen=True)
+class Offering:
+    """types.go:106-113."""
+
+    capacity_type: str
+    zone: str
+    price: float
+    available: bool = True
+
+
+class Offerings(list):
+    """types.go:119-145."""
+
+    def get(self, capacity_type: str, zone: str) -> Optional[Offering]:
+        for o in self:
+            if o.capacity_type == capacity_type and o.zone == zone:
+                return o
+        return None
+
+    def available(self) -> "Offerings":
+        return Offerings(o for o in self if o.available)
+
+    def requirements(self, reqs: Requirements) -> "Offerings":
+        """Filter by zone/capacity-type requirements (types.go:133-138)."""
+        return Offerings(
+            o
+            for o in self
+            if (LABEL_TOPOLOGY_ZONE not in reqs or reqs.get_requirement(LABEL_TOPOLOGY_ZONE).has(o.zone))
+            and (
+                LABEL_CAPACITY_TYPE not in reqs
+                or reqs.get_requirement(LABEL_CAPACITY_TYPE).has(o.capacity_type)
+            )
+        )
+
+    def cheapest(self) -> Offering:
+        return min(self, key=lambda o: o.price)
+
+
+@dataclass
+class InstanceTypeOverhead:
+    """types.go:91-103."""
+
+    kube_reserved: ResourceList = field(default_factory=dict)
+    system_reserved: ResourceList = field(default_factory=dict)
+    eviction_threshold: ResourceList = field(default_factory=dict)
+
+    def total(self) -> ResourceList:
+        return resources_util.merge(
+            self.kube_reserved, self.system_reserved, self.eviction_threshold
+        )
+
+
+@dataclass
+class InstanceType:
+    """types.go:72-89. Requirements must be defined for every well-known
+    label (zone/capacity-type requirements derive from offerings)."""
+
+    name: str
+    requirements: Requirements = field(default_factory=Requirements)
+    offerings: Offerings = field(default_factory=Offerings)
+    capacity: ResourceList = field(default_factory=dict)
+    overhead: InstanceTypeOverhead = field(default_factory=InstanceTypeOverhead)
+
+    def allocatable(self) -> ResourceList:
+        return resources_util.subtract(self.capacity, self.overhead.total())
+
+
+class MachineNotFoundError(Exception):
+    """types.go:148-175."""
+
+
+def is_machine_not_found(err: Exception) -> bool:
+    return isinstance(err, MachineNotFoundError)
+
+
+class CloudProvider:
+    """The vendor SPI (types.go:50-68)."""
+
+    def create(self, machine: Machine) -> Machine:
+        """Launch capacity for the machine; returns a machine with a resolved
+        ProviderID and status capacity/allocatable."""
+        raise NotImplementedError
+
+    def delete(self, machine: Machine) -> None:
+        raise NotImplementedError
+
+    def get(self, machine_name: str, provisioner_name: str = "") -> Machine:
+        raise NotImplementedError
+
+    def get_instance_types(self, provisioner: Optional[Provisioner]) -> List[InstanceType]:
+        """All instance types, including those with no available offerings."""
+        raise NotImplementedError
+
+    def is_machine_drifted(self, machine: Machine) -> bool:
+        raise NotImplementedError
+
+    def name(self) -> str:
+        raise NotImplementedError

@@ -1,0 +1,143 @@
+"""Requirements — a map key -> Requirement closed under intersection.
+
+Mirrors reference pkg/scheduling/requirements.go:32-223: `add` intersects with
+any existing requirement for the same key; `compatible` enforces that custom
+(non-well-known) labels must be defined on the node side while well-known
+labels intersect-if-present; `intersects` is the symmetric overlap check with
+the NotIn/DoesNotExist escape hatch.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional
+
+from karpenter_core_tpu.kube.objects import Pod
+from karpenter_core_tpu.scheduling.requirement import (
+    OP_DOES_NOT_EXIST,
+    OP_EXISTS,
+    OP_IN,
+    OP_NOT_IN,
+    Requirement,
+)
+
+
+class Requirements(Dict[str, Requirement]):
+    """dict[key, Requirement] with intersection-on-add (requirements.go:32)."""
+
+    def __init__(self, requirements: Iterable[Requirement] = ()):
+        super().__init__()
+        self.add(*requirements)
+
+    # -- constructors ------------------------------------------------------
+
+    @classmethod
+    def from_node_selector_requirements(cls, *reqs) -> "Requirements":
+        """From kube NodeSelectorRequirement objects (requirements.go:43-49)."""
+        return cls(Requirement(r.key, r.operator, r.values) for r in reqs)
+
+    @classmethod
+    def from_labels(cls, labels: Dict[str, str]) -> "Requirements":
+        """Each label k=v becomes In(v) (requirements.go:52-58)."""
+        return cls(Requirement(k, OP_IN, [v]) for k, v in labels.items())
+
+    @classmethod
+    def from_pod(cls, pod: Pod) -> "Requirements":
+        """nodeSelector + heaviest preferred term + FIRST required term
+        (requirements.go:61-78; the relaxation loop drops the rest)."""
+        requirements = cls.from_labels(pod.spec.node_selector)
+        affinity = pod.spec.affinity
+        if affinity is None or affinity.node_affinity is None:
+            return requirements
+        node_affinity = affinity.node_affinity
+        if node_affinity.preferred:
+            heaviest = max(node_affinity.preferred, key=lambda t: t.weight)
+            requirements.add(
+                *cls.from_node_selector_requirements(
+                    *heaviest.preference.match_expressions
+                ).values()
+            )
+        if node_affinity.required:
+            requirements.add(
+                *cls.from_node_selector_requirements(
+                    *node_affinity.required[0].match_expressions
+                ).values()
+            )
+        return requirements
+
+    # -- algebra -----------------------------------------------------------
+
+    def add(self, *requirements: Requirement) -> None:
+        """Intersecting add (requirements.go:87-94)."""
+        for requirement in requirements:
+            existing = super().get(requirement.key)
+            if existing is not None:
+                requirement = requirement.intersection(existing)
+            self[requirement.key] = requirement
+
+    def copy(self) -> "Requirements":
+        return Requirements(
+            Requirement._make(r.key, r.complement, r.values, r.greater_than, r.less_than)
+            for r in self.values()
+        )
+
+    def keys_set(self) -> frozenset:
+        return frozenset(self.keys())
+
+    def get_requirement(self, key: str) -> Requirement:
+        """Missing keys read as Exists — allow anything (requirements.go:114-120)."""
+        existing = super().get(key)
+        if existing is None:
+            return Requirement(key, OP_EXISTS)
+        return existing
+
+    def compatible(self, requirements: "Requirements") -> Optional[str]:
+        """None if `requirements` can be met, else an error string
+        (requirements.go:123-133). Custom labels must be defined on the
+        receiver (node side) unless the incoming operator is NotIn or
+        DoesNotExist; well-known labels intersect-if-present."""
+        from karpenter_core_tpu.api.labels import WELL_KNOWN_LABELS
+
+        errs: List[str] = []
+        for key in requirements.keys_set() - WELL_KNOWN_LABELS:
+            op = requirements.get_requirement(key).operator()
+            if key in self or op in (OP_NOT_IN, OP_DOES_NOT_EXIST):
+                continue
+            errs.append(f'label "{key}" does not have known values')
+        err = self.intersects(requirements)
+        if err:
+            errs.append(err)
+        return "; ".join(errs) if errs else None
+
+    def intersects(self, requirements: "Requirements") -> Optional[str]:
+        """None if overlapping values exist for every shared key
+        (requirements.go:189-206)."""
+        errs: List[str] = []
+        for key in self.keys_set() & requirements.keys_set():
+            existing = self.get_requirement(key)
+            incoming = requirements.get_requirement(key)
+            if existing.intersection(incoming).len() == 0:
+                # NotIn/DoesNotExist on BOTH sides is vacuously fine
+                if incoming.operator() in (OP_NOT_IN, OP_DOES_NOT_EXIST) and existing.operator() in (
+                    OP_NOT_IN,
+                    OP_DOES_NOT_EXIST,
+                ):
+                    continue
+                errs.append(f"key {key}, {incoming!r} not in {existing!r}")
+        return "; ".join(errs) if errs else None
+
+    def labels(self) -> Dict[str, str]:
+        """Representative node labels (requirements.go:208-218)."""
+        from karpenter_core_tpu.api.labels import is_restricted_node_label
+
+        out: Dict[str, str] = {}
+        for key, requirement in self.items():
+            if not is_restricted_node_label(key):
+                value = requirement.any()
+                if value:
+                    out[key] = value
+        return out
+
+    def __repr__(self) -> str:
+        from karpenter_core_tpu.api.labels import RESTRICTED_LABELS
+
+        shown = [r for k, r in sorted(self.items()) if k not in RESTRICTED_LABELS]
+        return ", ".join(repr(r) for r in shown)

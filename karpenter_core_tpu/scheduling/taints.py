@@ -1,0 +1,25 @@
+"""Taint toleration checks (reference pkg/scheduling/taints.go:26-57)."""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from karpenter_core_tpu.kube.objects import Pod, Taint
+
+
+def tolerates(taints: List[Taint], pod: Pod) -> Optional[str]:
+    """None if the pod tolerates ALL taints, else an error string
+    (taints.go:29-41)."""
+    errs = []
+    for taint in taints:
+        if not any(t.tolerates_taint(taint) for t in pod.spec.tolerations):
+            errs.append(f"did not tolerate {taint.key}={taint.value}:{taint.effect}")
+    return "; ".join(errs) if errs else None
+
+
+def merge(taints: List[Taint], with_taints: List[Taint]) -> List[Taint]:
+    """Union keyed on (key, effect) identity, left-biased (taints.go:44-56)."""
+    result = list(taints)
+    for taint in with_taints:
+        if not any(taint.match_taint(t) for t in result):
+            result.append(taint)
+    return result

@@ -1,0 +1,103 @@
+"""Per-node mounted-volume counting per CSI driver vs CSINode limits
+(reference pkg/scheduling/volumeusage.go:33-236).
+
+The reference resolves a pod's PVC -> PV/StorageClass -> CSI driver via the
+kube client; here the lookup goes through the in-memory kube store.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Optional, Set
+
+from karpenter_core_tpu.kube.objects import NamespacedName, Pod, object_key
+
+Volumes = Dict[str, Set[str]]  # csi driver name -> set of pvc ids
+
+
+def _union(a: Volumes, b: Volumes) -> Volumes:
+    out = {k: set(v) for k, v in a.items()}
+    for k, v in b.items():
+        out.setdefault(k, set()).update(v)
+    return out
+
+
+@dataclass
+class VolumeCount(dict):
+    """driver -> count; exceeds() compares against CSINode limits
+    (volumeusage.go:102-131)."""
+
+    def exceeds(self, limits: Dict[str, int]) -> bool:
+        for driver, count in self.items():
+            limit = limits.get(driver)
+            if limit is not None and count > limit:
+                return True
+        return False
+
+
+class VolumeUsage:
+    """volumeusage.go:33-100."""
+
+    def __init__(self, kube_client=None):
+        self.kube_client = kube_client
+        self.volumes: Volumes = {}
+        self.pod_volumes: Dict[NamespacedName, Volumes] = {}
+
+    def add(self, pod: Pod) -> None:
+        pod_vols = self._resolve(pod)
+        self.pod_volumes[object_key(pod)] = pod_vols
+        self.volumes = _union(self.volumes, pod_vols)
+
+    def validate(self, pod: Pod) -> VolumeCount:
+        """Projected per-driver counts if the pod were added."""
+        pod_vols = self._resolve(pod)
+        merged = _union(self.volumes, pod_vols)
+        result = VolumeCount()
+        for driver, ids in merged.items():
+            result[driver] = len(ids)
+        return result
+
+    def delete_pod(self, key: NamespacedName) -> None:
+        self.pod_volumes.pop(key, None)
+        self.volumes = {}
+        for vols in self.pod_volumes.values():
+            self.volumes = _union(self.volumes, vols)
+
+    def deep_copy(self) -> "VolumeUsage":
+        out = VolumeUsage(self.kube_client)
+        out.volumes = {k: set(v) for k, v in self.volumes.items()}
+        out.pod_volumes = {
+            pk: {k: set(v) for k, v in vols.items()} for pk, vols in self.pod_volumes.items()
+        }
+        return out
+
+    def _resolve(self, pod: Pod) -> Volumes:
+        """PVC -> (bound PV).csi.driver or StorageClass.provisioner
+        (volumeusage.go:133-200)."""
+        result: Volumes = {}
+        if self.kube_client is None:
+            return result
+        for volume in pod.spec.volumes:
+            if volume.persistent_volume_claim is None:
+                continue
+            claim_name = volume.persistent_volume_claim.claim_name
+            pvc = self.kube_client.get(
+                "PersistentVolumeClaim", pod.metadata.namespace, claim_name
+            )
+            if pvc is None:
+                continue
+            pvc_id = f"{pod.metadata.namespace}/{claim_name}"
+            driver = self._driver_for(pvc)
+            if driver:
+                result.setdefault(driver, set()).add(pvc_id)
+        return result
+
+    def _driver_for(self, pvc) -> Optional[str]:
+        if pvc.spec.volume_name:
+            pv = self.kube_client.get("PersistentVolume", "", pvc.spec.volume_name)
+            if pv is not None and pv.spec.csi is not None:
+                return pv.spec.csi.driver
+        if pvc.spec.storage_class_name:
+            sc = self.kube_client.get("StorageClass", "", pvc.spec.storage_class_name)
+            if sc is not None:
+                return sc.provisioner
+        return None

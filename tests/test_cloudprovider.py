@@ -1,0 +1,97 @@
+"""L0 API + fake cloudprovider tests."""
+import pytest
+
+from karpenter_core_tpu.api.labels import LABEL_CAPACITY_TYPE
+from karpenter_core_tpu.api.machine import Machine, MachineSpec
+from karpenter_core_tpu.api.provisioner import Limits, Provisioner, ProvisionerSpec, order_by_weight
+from karpenter_core_tpu.api.settings import Settings, _parse_duration
+from karpenter_core_tpu.cloudprovider import fake
+from karpenter_core_tpu.cloudprovider.types import MachineNotFoundError, Offerings
+from karpenter_core_tpu.kube.objects import LABEL_TOPOLOGY_ZONE, NodeSelectorRequirement
+from karpenter_core_tpu.scheduling.requirement import OP_IN, Requirement
+from karpenter_core_tpu.scheduling.requirements import Requirements
+
+
+def test_instance_type_ladder():
+    its = fake.instance_types(5)
+    assert [it.capacity["cpu"] for it in its] == [1, 2, 3, 4, 5]
+    assert its[2].capacity["pods"] == 30
+    # allocatable subtracts kube-reserved overhead
+    assert its[0].allocatable()["cpu"] == pytest.approx(0.9)
+
+
+def test_instance_types_assorted_size():
+    its = fake.instance_types_assorted()
+    assert len(its) == 7 * 8 * 3 * 2 * 2 * 2
+    assert len({it.name for it in its}) == len(its)
+
+
+def test_offerings_filter():
+    it = fake.new_instance_type("t")
+    reqs = Requirements([Requirement(LABEL_TOPOLOGY_ZONE, OP_IN, ["test-zone-3"])])
+    filtered = it.offerings.requirements(reqs)
+    assert all(o.zone == "test-zone-3" for o in filtered)
+    assert all(o.capacity_type == "on-demand" for o in filtered)
+    ct_reqs = Requirements([Requirement(LABEL_CAPACITY_TYPE, OP_IN, ["spot"])])
+    assert len(it.offerings.requirements(ct_reqs)) == 2
+
+
+def test_fake_create_picks_cheapest_compatible():
+    cp = fake.FakeCloudProvider(fake.instance_types(10))
+    machine = Machine(
+        spec=MachineSpec(
+            requirements=[
+                NodeSelectorRequirement("node.kubernetes.io/instance-type", OP_IN, ["fake-it-3", "fake-it-7"])
+            ]
+        )
+    )
+    machine.metadata.name = "m1"
+    created = cp.create(machine)
+    # cheapest of the two allowed types is fake-it-3 (4 cpu)
+    assert created.metadata.labels["node.kubernetes.io/instance-type"] == "fake-it-3"
+    assert created.status.provider_id.startswith("fake:///")
+    assert created.status.capacity["cpu"] == 4.0
+    got = cp.get("m1")
+    assert got is not created  # get() returns a deep copy
+    assert got.status.provider_id == created.status.provider_id
+    cp.delete(machine)
+    with pytest.raises(MachineNotFoundError):
+        cp.get("m1")
+
+
+def test_fake_create_call_cap():
+    cp = fake.FakeCloudProvider(fake.instance_types(3))
+    cp.allowed_create_calls = 0
+    m = Machine()
+    m.metadata.name = "m"
+    with pytest.raises(RuntimeError):
+        cp.create(m)
+
+
+def test_limits_exceeded_by():
+    limits = Limits(resources={"cpu": 10.0})
+    assert limits.exceeded_by({"cpu": 5.0}) is None
+    assert limits.exceeded_by({"cpu": 11.0}) is not None
+
+
+def test_order_by_weight():
+    a = Provisioner(spec=ProvisionerSpec(weight=5))
+    a.metadata.name = "a"
+    b = Provisioner(spec=ProvisionerSpec())
+    b.metadata.name = "b"
+    c = Provisioner(spec=ProvisionerSpec(weight=50))
+    c.metadata.name = "c"
+    assert [p.name for p in order_by_weight([a, b, c])] == ["c", "a", "b"]
+
+
+def test_settings_parse():
+    s = Settings.from_config_map(
+        {"batchMaxDuration": "20s", "batchIdleDuration": "500ms", "featureGates.driftEnabled": "true"}
+    )
+    assert s.batch_max_duration == 20.0
+    assert s.batch_idle_duration == 0.5
+    assert s.drift_enabled
+    assert _parse_duration("1m30s") == 90.0
+    for bad in ["1O s", "x5s", "", "5", "s"]:
+        with pytest.raises(ValueError):
+            _parse_duration(bad)

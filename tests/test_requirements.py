@@ -1,0 +1,206 @@
+"""Requirement/Requirements algebra tests.
+
+Property tables mirror the reference's pkg/scheduling/requirement_test.go and
+requirements_test.go coverage: operator recovery, intersection truth table over
+all operator pairs, bounds behavior, compatibility direction rules.
+"""
+import pytest
+
+from karpenter_core_tpu.api import labels
+from karpenter_core_tpu.kube.objects import (
+    Affinity,
+    NodeAffinity,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    Pod,
+    PodSpec,
+    PreferredSchedulingTerm,
+)
+from karpenter_core_tpu.scheduling.requirement import (
+    MAX_LEN,
+    OP_DOES_NOT_EXIST,
+    OP_EXISTS,
+    OP_GT,
+    OP_IN,
+    OP_LT,
+    OP_NOT_IN,
+    Requirement,
+)
+from karpenter_core_tpu.scheduling.requirements import Requirements
+
+
+# -- Requirement ------------------------------------------------------------
+
+
+def test_operator_recovery():
+    assert Requirement("k", OP_IN, ["a"]).operator() == OP_IN
+    assert Requirement("k", OP_IN, []).operator() == OP_DOES_NOT_EXIST
+    assert Requirement("k", OP_NOT_IN, ["a"]).operator() == OP_NOT_IN
+    assert Requirement("k", OP_NOT_IN, []).operator() == OP_EXISTS
+    assert Requirement("k", OP_EXISTS).operator() == OP_EXISTS
+    assert Requirement("k", OP_DOES_NOT_EXIST).operator() == OP_DOES_NOT_EXIST
+    # Gt/Lt read as Exists-with-bounds (requirement.go:186-197)
+    assert Requirement("k", OP_GT, ["5"]).operator() == OP_EXISTS
+    assert Requirement("k", OP_LT, ["5"]).operator() == OP_EXISTS
+
+
+def test_len_semantics():
+    assert Requirement("k", OP_IN, ["a", "b"]).len() == 2
+    assert Requirement("k", OP_DOES_NOT_EXIST).len() == 0
+    assert Requirement("k", OP_EXISTS).len() == MAX_LEN
+    assert Requirement("k", OP_NOT_IN, ["a"]).len() == MAX_LEN - 1
+
+
+def test_has():
+    r = Requirement("k", OP_IN, ["a", "b"])
+    assert r.has("a") and not r.has("c")
+    r = Requirement("k", OP_NOT_IN, ["a"])
+    assert not r.has("a") and r.has("c")
+    r = Requirement("k", OP_GT, ["5"])
+    assert r.has("6") and not r.has("5") and not r.has("abc")
+    r = Requirement("k", OP_LT, ["5"])
+    assert r.has("4") and not r.has("5")
+
+
+@pytest.mark.parametrize(
+    "a_op,a_vals,b_op,b_vals,expect_op,expect_vals",
+    [
+        (OP_IN, ["a", "b"], OP_IN, ["b", "c"], OP_IN, {"b"}),
+        (OP_IN, ["a"], OP_IN, ["b"], OP_DOES_NOT_EXIST, set()),
+        (OP_IN, ["a", "b"], OP_NOT_IN, ["b"], OP_IN, {"a"}),
+        (OP_NOT_IN, ["a"], OP_NOT_IN, ["b"], OP_NOT_IN, {"a", "b"}),
+        (OP_IN, ["a"], OP_EXISTS, [], OP_IN, {"a"}),
+        (OP_EXISTS, [], OP_EXISTS, [], OP_EXISTS, set()),
+        (OP_DOES_NOT_EXIST, [], OP_IN, ["a"], OP_DOES_NOT_EXIST, set()),
+        (OP_DOES_NOT_EXIST, [], OP_EXISTS, [], OP_DOES_NOT_EXIST, set()),
+    ],
+)
+def test_intersection_table(a_op, a_vals, b_op, b_vals, expect_op, expect_vals):
+    a = Requirement("k", a_op, a_vals)
+    b = Requirement("k", b_op, b_vals)
+    for lhs, rhs in ((a, b), (b, a)):  # intersection is commutative
+        out = lhs.intersection(rhs)
+        assert out.operator() == expect_op
+        assert out.values == expect_vals
+
+
+def test_intersection_bounds():
+    gt = Requirement("k", OP_GT, ["3"])
+    lt = Requirement("k", OP_LT, ["10"])
+    out = gt.intersection(lt)
+    assert out.operator() == OP_EXISTS
+    assert out.has("5") and not out.has("3") and not out.has("10")
+    # collapsed interval -> DoesNotExist (requirement.go:124-126)
+    collapsed = Requirement("k", OP_GT, ["8"]).intersection(Requirement("k", OP_LT, ["5"]))
+    assert collapsed.operator() == OP_DOES_NOT_EXIST
+    # bounds filter concrete values and are then dropped (requirement.go:139-147)
+    vals = Requirement("k", OP_IN, ["1", "5", "20"]).intersection(gt)
+    assert vals.values == {"5", "20"}
+    assert vals.greater_than is None
+
+
+def test_key_normalization():
+    r = Requirement("failure-domain.beta.kubernetes.io/zone", OP_IN, ["us-east-1a"])
+    assert r.key == "topology.kubernetes.io/zone"
+
+
+# -- Requirements -----------------------------------------------------------
+
+
+def test_add_intersects_same_key():
+    rs = Requirements([Requirement("k", OP_IN, ["a", "b"])])
+    rs.add(Requirement("k", OP_IN, ["b", "c"]))
+    assert rs["k"].values == {"b"}
+
+
+def test_get_missing_is_exists():
+    rs = Requirements()
+    assert rs.get_requirement("k").operator() == OP_EXISTS
+
+
+def test_intersects_symmetric_overlap():
+    a = Requirements([Requirement("zone", OP_IN, ["z1", "z2"])])
+    b = Requirements([Requirement("zone", OP_IN, ["z2"])])
+    assert a.intersects(b) is None
+    c = Requirements([Requirement("zone", OP_IN, ["z3"])])
+    assert a.intersects(c) is not None
+    # NotIn/DoesNotExist both sides escape (requirements.go:195-201)
+    d = Requirements([Requirement("x", OP_DOES_NOT_EXIST)])
+    e = Requirements([Requirement("x", OP_NOT_IN, ["v"])])
+    err = d.intersects(e)
+    assert err is None
+
+
+def test_compatible_custom_label_direction():
+    """Custom labels must be DEFINED on the node side (requirements.go:123-133)."""
+    node_side = Requirements()
+    pod_side = Requirements([Requirement("custom/label", OP_IN, ["v"])])
+    assert node_side.compatible(pod_side) is not None  # undefined custom -> deny
+    node_side = Requirements([Requirement("custom/label", OP_IN, ["v", "w"])])
+    assert node_side.compatible(pod_side) is None
+    # well-known labels are allowed when undefined on node side
+    pod_zone = Requirements([Requirement("topology.kubernetes.io/zone", OP_IN, ["z1"])])
+    assert Requirements().compatible(pod_zone) is None
+    # NotIn custom label against undefined node side is allowed
+    not_in = Requirements([Requirement("custom/label2", OP_NOT_IN, ["v"])])
+    assert Requirements().compatible(not_in) is None
+
+
+def test_from_pod_heaviest_preferred_and_first_required():
+    pod = Pod(
+        spec=PodSpec(
+            node_selector={"a": "1"},
+            affinity=Affinity(
+                node_affinity=NodeAffinity(
+                    required=[
+                        NodeSelectorTerm(
+                            [NodeSelectorRequirement("zone", OP_IN, ["z1", "z2"])]
+                        ),
+                        NodeSelectorTerm([NodeSelectorRequirement("zone", OP_IN, ["z9"])]),
+                    ],
+                    preferred=[
+                        PreferredSchedulingTerm(
+                            weight=1,
+                            preference=NodeSelectorTerm(
+                                [NodeSelectorRequirement("light", OP_IN, ["x"])]
+                            ),
+                        ),
+                        PreferredSchedulingTerm(
+                            weight=10,
+                            preference=NodeSelectorTerm(
+                                [NodeSelectorRequirement("heavy", OP_IN, ["y"])]
+                            ),
+                        ),
+                    ],
+                )
+            ),
+        )
+    )
+    rs = Requirements.from_pod(pod)
+    assert rs["a"].values == {"1"}
+    assert rs["zone"].values == {"z1", "z2"}  # first required term only
+    assert "heavy" in rs and "light" not in rs  # heaviest preferred only
+
+
+def test_labels_skips_restricted():
+    rs = Requirements(
+        [
+            Requirement("kubernetes.io/hostname", OP_IN, ["h1"]),
+            Requirement("topology.kubernetes.io/zone", OP_IN, ["z1"]),
+            Requirement("custom", OP_IN, ["v"]),
+        ]
+    )
+    out = rs.labels()
+    assert "kubernetes.io/hostname" not in out
+    # well-known labels are injected by cloud providers, never synthesized
+    # (labels.go:120-134)
+    assert "topology.kubernetes.io/zone" not in out
+    assert out["custom"] == "v"
+
+
+def test_any_respects_large_bounds():
+    r = Requirement("k", OP_GT, ["3000000000"])
+    assert int(r.any()) > 3000000000
+    # adjacent bounds collapse to the only remaining value
+    rr = Requirement("k", OP_GT, ["5"]).intersection(Requirement("k", OP_LT, ["7"]))
+    assert rr.any() == "6"

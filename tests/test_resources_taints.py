@@ -1,0 +1,128 @@
+"""utils.resources algebra + taints/hostports/volumes tests
+(coverage model: reference pkg/utils/resources + scheduling suite)."""
+from karpenter_core_tpu.kube.objects import (
+    Container,
+    ContainerPort,
+    Pod,
+    PodSpec,
+    ResourceRequirements,
+    Taint,
+    Toleration,
+)
+from karpenter_core_tpu.scheduling import taints as taints_mod
+from karpenter_core_tpu.scheduling.hostportusage import HostPortUsage
+from karpenter_core_tpu.utils import resources
+
+
+def mkpod(requests=None, limits=None, init_requests=None, tolerations=(), ports=(), name="p"):
+    containers = [
+        Container(
+            resources=ResourceRequirements(requests=dict(requests or {}), limits=dict(limits or {})),
+            ports=list(ports),
+        )
+    ]
+    init = (
+        [Container(resources=ResourceRequirements(requests=dict(init_requests)))]
+        if init_requests
+        else []
+    )
+    pod = Pod(spec=PodSpec(containers=containers, init_containers=init, tolerations=list(tolerations)))
+    pod.metadata.name = name
+    return pod
+
+
+def test_parse_quantity():
+    assert resources.parse_quantity("100m") == 0.1
+    assert resources.parse_quantity("1Gi") == 2**30
+    assert resources.parse_quantity("2") == 2.0
+    assert resources.parse_quantity("1.5k") == 1500.0
+    assert resources.parse_quantity(3) == 3.0
+
+
+def test_merge_subtract_fits():
+    a = {"cpu": 1.0, "memory": 100.0}
+    b = {"cpu": 2.0, "pods": 1.0}
+    assert resources.merge(a, b) == {"cpu": 3.0, "memory": 100.0, "pods": 1.0}
+    assert resources.subtract(b, a) == {"cpu": 1.0, "pods": 1.0}
+    assert resources.fits({"cpu": 1.0}, {"cpu": 1.0, "memory": 5.0})
+    assert not resources.fits({"cpu": 2.0}, {"cpu": 1.0})
+    assert not resources.fits({}, {"cpu": -1.0})  # negative total never fits
+    # requesting a resource the total lacks
+    assert not resources.fits({"gpu": 1.0}, {"cpu": 1.0})
+
+
+def test_ceiling_init_containers():
+    pod = mkpod(requests={"cpu": 1.0}, init_requests={"cpu": 4.0})
+    assert resources.ceiling_requests(pod) == {"cpu": 4.0}
+    pod = mkpod(requests={"cpu": 5.0}, init_requests={"cpu": 4.0})
+    assert resources.ceiling_requests(pod) == {"cpu": 5.0}
+
+
+def test_limits_merged_into_requests():
+    pod = mkpod(requests={}, limits={"cpu": 2.0})
+    assert resources.ceiling_requests(pod) == {"cpu": 2.0}
+
+
+def test_requests_for_pods_adds_pod_count():
+    p1, p2 = mkpod(requests={"cpu": 1.0}), mkpod(requests={"cpu": 2.0})
+    out = resources.requests_for_pods(p1, p2)
+    assert out["cpu"] == 3.0 and out["pods"] == 2.0
+
+
+# -- taints -----------------------------------------------------------------
+
+
+def test_tolerates():
+    taint = Taint(key="team", value="a", effect="NoSchedule")
+    assert taints_mod.tolerates([taint], mkpod()) is not None
+    ok = mkpod(tolerations=[Toleration(key="team", operator="Equal", value="a")])
+    assert taints_mod.tolerates([taint], ok) is None
+    exists = mkpod(tolerations=[Toleration(key="team", operator="Exists")])
+    assert taints_mod.tolerates([taint], exists) is None
+    wildcard = mkpod(tolerations=[Toleration(operator="Exists")])
+    assert taints_mod.tolerates([taint], wildcard) is None
+    wrong_effect = mkpod(tolerations=[Toleration(key="team", operator="Exists", effect="NoExecute")])
+    assert taints_mod.tolerates([taint], wrong_effect) is not None
+    # k8s: Exists with a non-empty value never tolerates
+    exists_with_value = mkpod(tolerations=[Toleration(key="team", operator="Exists", value="a")])
+    assert taints_mod.tolerates([taint], exists_with_value) is not None
+    # unknown operator matches nothing
+    typod = mkpod(tolerations=[Toleration(key="team", operator="exists")])
+    assert taints_mod.tolerates([taint], typod) is not None
+
+
+def test_taint_merge_left_biased():
+    a = [Taint("k", "v1", "NoSchedule")]
+    b = [Taint("k", "v2", "NoSchedule"), Taint("k2", "x", "NoExecute")]
+    merged = taints_mod.merge(a, b)
+    assert merged[0].value == "v1"  # same (key,effect) keeps left
+    assert len(merged) == 2
+
+
+# -- host ports -------------------------------------------------------------
+
+
+def test_hostport_conflicts():
+    usage = HostPortUsage()
+    p1 = mkpod(ports=[ContainerPort(host_port=80)], name="p1")
+    assert usage.validate(p1) is None
+    usage.add(p1)
+    p2 = mkpod(ports=[ContainerPort(host_port=80)], name="p2")
+    assert usage.validate(p2) is not None
+    # different port fine
+    p3 = mkpod(ports=[ContainerPort(host_port=81)], name="p3")
+    assert usage.validate(p3) is None
+    # same port different explicit IPs fine
+    usage2 = HostPortUsage()
+    q1 = mkpod(ports=[ContainerPort(host_port=80, host_ip="10.0.0.1")], name="q1")
+    usage2.add(q1)
+    q2 = mkpod(ports=[ContainerPort(host_port=80, host_ip="10.0.0.2")], name="q2")
+    assert usage2.validate(q2) is None
+    # unspecified IP conflicts with specified
+    q3 = mkpod(ports=[ContainerPort(host_port=80)], name="q3")
+    assert usage2.validate(q3) is not None
+    # same pod revalidation doesn't self-conflict
+    assert usage2.validate(q1) is None
+    # protocol isolation
+    q4 = mkpod(ports=[ContainerPort(host_port=80, protocol="UDP")], name="q4")
+    assert usage2.validate(q4) is None
