@@ -1,0 +1,79 @@
+"""Benchmark: pods scheduled/sec for the device solve.
+
+Reference baseline: the Go scheduler enforces a floor of 100 pods/sec for
+batches > 100 pods (reference scheduling_benchmark_test.go:50,180-184) and
+publishes no absolute numbers; vs_baseline is therefore measured against that
+floor. The timed region is the jitted device program — feasibility +
+packing — which is the analog of Scheduler.Solve() (snapshot encoding is the
+control plane's job and is reported separately on stderr).
+
+Prints exactly one JSON line:
+  {"metric": ..., "value": N, "unit": "pods/sec", "vs_baseline": N/100}
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+N_PODS = int(os.environ.get("BENCH_PODS", "2000"))
+N_TYPES = int(os.environ.get("BENCH_TYPES", "100"))
+N_RUNS = int(os.environ.get("BENCH_RUNS", "5"))
+
+
+def main():
+    import numpy as np
+
+    import jax
+
+    from __graft_entry__ import _scenario
+    from karpenter_core_tpu.solver.encode import encode_snapshot
+    from karpenter_core_tpu.solver.tpu_solver import build_device_solve, device_args
+
+    t0 = time.perf_counter()
+    pods, provisioners, instance_types = _scenario(N_PODS, N_TYPES)
+    snap = encode_snapshot(pods, provisioners, instance_types)
+    encode_s = time.perf_counter() - t0
+
+    _, run = build_device_solve(snap, max_nodes=1024)
+    args = device_args(snap, provisioners)
+    fn = jax.jit(run)
+
+    t0 = time.perf_counter()
+    out = fn(*args)
+    jax.block_until_ready(out)
+    compile_s = time.perf_counter() - t0
+
+    times = []
+    for _ in range(N_RUNS):
+        t0 = time.perf_counter()
+        out = fn(*args)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+
+    assigned = np.asarray(out[0])
+    scheduled = int((assigned >= 0).sum())
+    solve_s = float(np.median(times))
+    pods_per_sec = scheduled / solve_s
+
+    print(
+        f"[bench] device={jax.devices()[0].device_kind} pods={N_PODS} types={N_TYPES} "
+        f"scheduled={scheduled} encode={encode_s:.2f}s compile={compile_s:.1f}s "
+        f"solve_med={solve_s * 1e3:.1f}ms p_best={min(times) * 1e3:.1f}ms",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": f"pods_scheduled_per_sec_device_solve_{N_PODS}pods_{N_TYPES}types",
+                "value": round(pods_per_sec, 1),
+                "unit": "pods/sec",
+                "vs_baseline": round(pods_per_sec / 100.0, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

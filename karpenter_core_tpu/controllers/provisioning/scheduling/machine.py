@@ -1,0 +1,319 @@
+"""In-flight scheduling machines: the constraint accumulators of the solve.
+
+Mirrors reference pkg/controllers/provisioning/scheduling/{machine,
+existingnode,machinetemplate}.go. A SchedulingMachine accumulates pods and
+monotonically narrows its InstanceTypeOptions through the
+compatible ∧ fits ∧ hasOffering filter (machine.go:137-159) — exactly the
+feasibility expression the TPU kernel (ops/feasibility.py) evaluates densely.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional
+
+from karpenter_core_tpu.api import labels as api_labels
+from karpenter_core_tpu.api.machine import (
+    Machine,
+    MachineResourceRequirements,
+    MachineSpec,
+)
+from karpenter_core_tpu.api.provisioner import Provisioner
+from karpenter_core_tpu.cloudprovider.types import InstanceType
+from karpenter_core_tpu.kube.objects import (
+    LABEL_HOSTNAME,
+    LABEL_INSTANCE_TYPE_STABLE,
+    LABEL_TOPOLOGY_ZONE,
+    Node,
+    ObjectMeta,
+    Pod,
+    ResourceList,
+    Taint,
+)
+from karpenter_core_tpu.scheduling import taints as taints_mod
+from karpenter_core_tpu.scheduling.hostportusage import HostPortUsage
+from karpenter_core_tpu.scheduling.requirement import OP_IN, Requirement
+from karpenter_core_tpu.scheduling.requirements import Requirements
+from karpenter_core_tpu.utils import resources as resources_util
+
+_node_id = itertools.count(1)
+
+
+class MachineTemplate:
+    """Per-Provisioner launch template (machinetemplate.go:32-62)."""
+
+    def __init__(self, provisioner: Provisioner):
+        labels = dict(provisioner.spec.labels)
+        labels[api_labels.PROVISIONER_NAME_LABEL_KEY] = provisioner.name
+        requirements = Requirements()
+        requirements.add(
+            *Requirements.from_node_selector_requirements(*provisioner.spec.requirements).values()
+        )
+        requirements.add(*Requirements.from_labels(labels).values())
+        self.provisioner_name = provisioner.name
+        self.provider = provisioner.spec.provider
+        self.provider_ref = provisioner.spec.provider_ref
+        self.kubelet = provisioner.spec.kubelet_configuration
+        self.annotations = dict(provisioner.spec.annotations)
+        self.labels = labels
+        self.taints: List[Taint] = list(provisioner.spec.taints)
+        self.startup_taints: List[Taint] = list(provisioner.spec.startup_taints)
+        self.requirements = requirements
+        self.requests: ResourceList = {}
+        self.instance_type_options: List[InstanceType] = []
+
+    def to_node(self) -> Node:
+        """machinetemplate.go:64-77."""
+        node = Node(
+            metadata=ObjectMeta(
+                labels={**self.labels, **self.requirements.labels()},
+                annotations=dict(self.annotations),
+                finalizers=[api_labels.TERMINATION_FINALIZER],
+            )
+        )
+        node.spec.taints = list(self.taints) + list(self.startup_taints)
+        return node
+
+    def to_machine(self) -> Machine:
+        """machinetemplate.go:79-100 — narrows instance-type requirement to
+        the final option set; inline provider config rides the compatibility
+        annotation (provisioner.go:104-112)."""
+        self.requirements.add(
+            Requirement(
+                LABEL_INSTANCE_TYPE_STABLE,
+                OP_IN,
+                [it.name for it in self.instance_type_options],
+            )
+        )
+        annotations = dict(self.annotations)
+        if self.provider is not None:
+            import json
+
+            annotations[api_labels.PROVIDER_COMPATIBILITY_ANNOTATION_KEY] = json.dumps(
+                self.provider, sort_keys=True
+            )
+        machine = Machine(
+            metadata=ObjectMeta(
+                name=f"{self.provisioner_name}-{next(_node_id):05d}",
+                annotations=annotations,
+                labels=dict(self.labels),
+            ),
+            spec=MachineSpec(
+                taints=list(self.taints),
+                startup_taints=list(self.startup_taints),
+                requirements=[
+                    r.to_node_selector_requirement() for r in self.requirements.values()
+                ],
+                resources=MachineResourceRequirements(requests=dict(self.requests)),
+                kubelet=self.kubelet,
+                machine_template_ref=self.provider_ref,
+            ),
+        )
+        machine.metadata.namespace = ""
+        return machine
+
+
+class SchedulingMachine:
+    """A node being provisioned by this solve (machine.go:31-115)."""
+
+    def __init__(
+        self,
+        template: MachineTemplate,
+        topology,
+        daemon_resources: ResourceList,
+        instance_types: List[InstanceType],
+    ):
+        hostname = f"hostname-placeholder-{next(_node_id):04d}"
+        topology.register(LABEL_HOSTNAME, hostname)
+        self.template = template
+        self.provisioner_name = template.provisioner_name
+        self.labels = template.labels
+        self.annotations = template.annotations
+        self.taints = template.taints
+        self.startup_taints = template.startup_taints
+        self.kubelet = template.kubelet
+        self.provider = template.provider
+        self.provider_ref = template.provider_ref
+        self.requirements = Requirements(template.requirements.values())
+        self.requirements.add(Requirement(LABEL_HOSTNAME, OP_IN, [hostname]))
+        self.instance_type_options = list(instance_types)
+        self.requests: ResourceList = dict(daemon_resources)
+        self.pods: List[Pod] = []
+        self.topology = topology
+        self.hostport_usage = HostPortUsage()
+
+    def add(self, pod: Pod) -> Optional[str]:
+        """Try to commit the pod; returns an error string or None
+        (machine.go:62-107)."""
+        err = taints_mod.tolerates(self.taints, pod)
+        if err:
+            return err
+        err = self.hostport_usage.validate(pod)
+        if err:
+            return err
+
+        machine_requirements = Requirements(self.requirements.values())
+        pod_requirements = Requirements.from_pod(pod)
+        err = machine_requirements.compatible(pod_requirements)
+        if err:
+            return f"incompatible requirements, {err}"
+        machine_requirements.add(*pod_requirements.values())
+
+        topology_requirements, err = self.topology.add_requirements(
+            pod_requirements, machine_requirements, pod
+        )
+        if err:
+            return err
+        err = machine_requirements.compatible(topology_requirements)
+        if err:
+            return err
+        machine_requirements.add(*topology_requirements.values())
+
+        requests = resources_util.merge(self.requests, resources_util.requests_for_pods(pod))
+        instance_types = filter_instance_types_by_requirements(
+            self.instance_type_options, machine_requirements, requests
+        )
+        if not instance_types:
+            return (
+                f"no instance type satisfied resources "
+                f"{resources_util.to_string(resources_util.requests_for_pods(pod))} "
+                f"and requirements {machine_requirements!r}"
+            )
+
+        self.pods.append(pod)
+        self.instance_type_options = instance_types
+        self.requests = requests
+        self.requirements = machine_requirements
+        self.topology.record(pod, machine_requirements)
+        self.hostport_usage.add(pod)
+        return None
+
+    def finalize_scheduling(self) -> None:
+        """Drop the placeholder hostname requirement (machine.go:109-115)."""
+        self.requirements.pop(LABEL_HOSTNAME, None)
+
+    def to_machine_template(self) -> MachineTemplate:
+        """Fold accumulated state back into a launchable template."""
+        out = MachineTemplate.__new__(MachineTemplate)
+        out.provisioner_name = self.provisioner_name
+        out.provider = self.provider
+        out.provider_ref = self.provider_ref
+        out.kubelet = self.kubelet
+        out.annotations = dict(self.annotations)
+        out.labels = dict(self.labels)
+        out.taints = list(self.taints)
+        out.startup_taints = list(self.startup_taints)
+        out.requirements = self.requirements
+        out.requests = dict(self.requests)
+        out.instance_type_options = list(self.instance_type_options)
+        return out
+
+    def __repr__(self) -> str:
+        names = ", ".join(it.name for it in self.instance_type_options[:5])
+        extra = len(self.instance_type_options) - 5
+        if extra > 0:
+            names += f" and {extra} other(s)"
+        return (
+            f"machine with {len(self.pods)} pods requesting "
+            f"{resources_util.to_string(self.requests)} from types {names}"
+        )
+
+
+class ExistingNode:
+    """A real or in-flight node considered by the solve
+    (existingnode.go:28-115)."""
+
+    def __init__(self, state_node, topology, daemon_resources: ResourceList):
+        remaining_daemon = resources_util.subtract(
+            daemon_resources, state_node.total_daemonset_requests()
+        )
+        remaining_daemon = {k: max(v, 0.0) for k, v in remaining_daemon.items()}
+        self.state_node = state_node
+        self.pods: List[Pod] = []
+        self.topology = topology
+        self.requests: ResourceList = remaining_daemon
+        self.requirements = Requirements.from_labels(state_node.labels())
+        self.requirements.add(Requirement(LABEL_HOSTNAME, OP_IN, [state_node.hostname()]))
+        topology.register(LABEL_HOSTNAME, state_node.hostname())
+
+    def name(self) -> str:
+        return self.state_node.name()
+
+    def add(self, pod: Pod) -> Optional[str]:
+        """existingnode.go:62-115."""
+        err = taints_mod.tolerates(self.state_node.taints(), pod)
+        if err:
+            return err
+        err = self.state_node.hostport_usage.validate(pod)
+        if err:
+            return err
+        mounted = self.state_node.volume_usage.validate(pod)
+        if mounted.exceeds(self.state_node.volume_limits):
+            return "would exceed node volume limits"
+
+        requests = resources_util.merge(self.requests, resources_util.requests_for_pods(pod))
+        if not resources_util.fits(requests, self.state_node.available()):
+            return "exceeds node resources"
+
+        node_requirements = Requirements(self.requirements.values())
+        pod_requirements = Requirements.from_pod(pod)
+        err = node_requirements.compatible(pod_requirements)
+        if err:
+            return err
+        node_requirements.add(*pod_requirements.values())
+
+        topology_requirements, err = self.topology.add_requirements(
+            pod_requirements, node_requirements, pod
+        )
+        if err:
+            return err
+        err = node_requirements.compatible(topology_requirements)
+        if err:
+            return err
+        node_requirements.add(*topology_requirements.values())
+
+        self.pods.append(pod)
+        self.requests = requests
+        self.requirements = node_requirements
+        self.topology.record(pod, node_requirements)
+        self.state_node.hostport_usage.add(pod)
+        self.state_node.volume_usage.add(pod)
+        return None
+
+
+def filter_instance_types_by_requirements(
+    instance_types: List[InstanceType],
+    requirements: Requirements,
+    requests: ResourceList,
+) -> List[InstanceType]:
+    """compatible ∧ fits ∧ hasOffering (machine.go:137-159) — the expression
+    the TPU feasibility kernel lowers to tensor masks."""
+    return [
+        it
+        for it in instance_types
+        if _compatible(it, requirements)
+        and _fits(it, requests)
+        and _has_offering(it, requirements)
+    ]
+
+
+def _compatible(instance_type: InstanceType, requirements: Requirements) -> bool:
+    return instance_type.requirements.intersects(requirements) is None
+
+
+def _fits(instance_type: InstanceType, requests: ResourceList) -> bool:
+    return resources_util.fits(requests, instance_type.allocatable())
+
+
+def _has_offering(instance_type: InstanceType, requirements: Requirements) -> bool:
+    for offering in instance_type.offerings.available():
+        if (
+            LABEL_TOPOLOGY_ZONE not in requirements
+            or requirements.get_requirement(LABEL_TOPOLOGY_ZONE).has(offering.zone)
+        ) and (
+            api_labels.LABEL_CAPACITY_TYPE not in requirements
+            or requirements.get_requirement(api_labels.LABEL_CAPACITY_TYPE).has(
+                offering.capacity_type
+            )
+        ):
+            return True
+    return False

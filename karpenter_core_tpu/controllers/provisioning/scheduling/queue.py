@@ -1,0 +1,48 @@
+"""FFD pod queue with progress detection (reference queue.go:26-110)."""
+from __future__ import annotations
+
+from collections import deque
+from typing import Dict, List, Optional, Tuple
+
+from karpenter_core_tpu.kube.objects import Pod
+from karpenter_core_tpu.utils import resources
+
+
+def ffd_sort_key(pod: Pod) -> Tuple:
+    """CPU desc, then memory desc, then creation time, then UID
+    (queue.go:74-110)."""
+    requests = resources.requests_for_pods(pod)
+    return (
+        -requests.get("cpu", 0.0),
+        -requests.get("memory", 0.0),
+        pod.metadata.creation_timestamp,
+        pod.metadata.uid,
+    )
+
+
+class Queue:
+    def __init__(self, pods: List[Pod]):
+        self.pods: deque = deque(sorted(pods, key=ffd_sort_key))
+        self.last_len: Dict[str, int] = {}
+
+    def pop(self) -> Optional[Pod]:
+        """None when empty OR when the head pod returns with an unchanged
+        queue length — no progress is being made (queue.go:39-50)."""
+        if not self.pods:
+            return None
+        pod = self.pods[0]
+        if self.last_len.get(pod.metadata.uid) == len(self.pods):
+            return None
+        return self.pods.popleft()
+
+    def push(self, pod: Pod, relaxed: bool) -> None:
+        """Re-queue a failed pod; relaxation resets staleness tracking
+        (queue.go:53-60)."""
+        self.pods.append(pod)
+        if relaxed:
+            self.last_len = {}
+        else:
+            self.last_len[pod.metadata.uid] = len(self.pods)
+
+    def list(self) -> List[Pod]:
+        return list(self.pods)

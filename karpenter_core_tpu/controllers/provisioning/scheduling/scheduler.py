@@ -1,0 +1,298 @@
+"""Scheduler — greedy first-fit solve with progress queue and relaxation.
+
+Mirrors reference pkg/controllers/provisioning/scheduling/scheduler.go:42-312.
+This is the HOST path: the in-process fallback solver and the differential
+oracle for the TPU tensor solver (solver/ + ops/). The TPU path replaces
+Solve()'s per-pod loop with dense pod×type feasibility + packing kernels; this
+implementation defines the semantics those kernels must reproduce.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from karpenter_core_tpu.api import labels as api_labels
+from karpenter_core_tpu.api.provisioner import Provisioner
+from karpenter_core_tpu.cloudprovider.types import InstanceType
+from karpenter_core_tpu.controllers.provisioning.scheduling.machine import (
+    ExistingNode,
+    MachineTemplate,
+    SchedulingMachine,
+    filter_instance_types_by_requirements,
+)
+from karpenter_core_tpu.controllers.provisioning.scheduling.preferences import Preferences
+from karpenter_core_tpu.controllers.provisioning.scheduling.queue import Queue
+from karpenter_core_tpu.controllers.provisioning.scheduling.topology import Topology
+from karpenter_core_tpu.kube.objects import Pod, ResourceList
+from karpenter_core_tpu.scheduling import taints as taints_mod
+from karpenter_core_tpu.scheduling.requirements import Requirements
+from karpenter_core_tpu.utils import resources as resources_util
+
+
+@dataclass
+class SchedulerOptions:
+    simulation_mode: bool = False
+
+
+@dataclass
+class SchedulingResult:
+    new_machines: List[SchedulingMachine] = field(default_factory=list)
+    existing_nodes: List[ExistingNode] = field(default_factory=list)
+    errors: Dict[str, str] = field(default_factory=dict)  # pod uid -> last error
+    failed_pods: List[Pod] = field(default_factory=list)
+
+    def pod_count_new(self) -> int:
+        return sum(len(m.pods) for m in self.new_machines)
+
+    def pod_count_existing(self) -> int:
+        return sum(len(n.pods) for n in self.existing_nodes)
+
+
+class Scheduler:
+    """scheduler.go:79-133."""
+
+    def __init__(
+        self,
+        kube_client,
+        machine_templates: List[MachineTemplate],
+        provisioners: List[Provisioner],
+        cluster,
+        state_nodes: List,
+        topology: Topology,
+        instance_types: Dict[str, List[InstanceType]],
+        daemonset_pods: List[Pod],
+        recorder=None,
+        opts: Optional[SchedulerOptions] = None,
+    ):
+        # provisioner PreferNoSchedule taints enable the extra relaxation
+        # (scheduler.go:48-56)
+        tolerate_prefer_no_schedule = any(
+            taint.effect == "PreferNoSchedule"
+            for prov in provisioners
+            for taint in prov.spec.taints
+        )
+        self.kube_client = kube_client
+        self.machine_templates = machine_templates
+        self.topology = topology
+        self.cluster = cluster
+        self.instance_types = instance_types
+        self.daemon_overhead = _get_daemon_overhead(machine_templates, daemonset_pods)
+        self.recorder = recorder
+        self.opts = opts or SchedulerOptions()
+        self.preferences = Preferences(tolerate_prefer_no_schedule)
+        self.remaining_resources: Dict[str, ResourceList] = {
+            p.name: dict(p.spec.limits.resources)
+            for p in provisioners
+            if p.spec.limits is not None
+        }
+        self.new_machines: List[SchedulingMachine] = []
+        self.existing_nodes: List[ExistingNode] = []
+        self._calculate_existing_machines(state_nodes, daemonset_pods)
+
+    def solve(self, pods: List[Pod]) -> SchedulingResult:
+        """The hot loop (scheduler.go:96-133): pop pod → try existing nodes →
+        try open machines (ascending pod count) → open machine from the first
+        compatible weighted template; on failure relax and re-push."""
+        errors: Dict[str, str] = {}
+        q = Queue(pods)
+        while True:
+            pod = q.pop()
+            if pod is None:
+                break
+            err = self._add(pod)
+            if err is None:
+                errors.pop(pod.metadata.uid, None)
+                continue
+            errors[pod.metadata.uid] = err
+            relaxed = self.preferences.relax(pod)
+            q.push(pod, relaxed)
+            if relaxed:
+                self.topology.update(pod)
+
+        for machine in self.new_machines:
+            machine.finalize_scheduling()
+
+        failed = q.list()
+        result = SchedulingResult(
+            new_machines=self.new_machines,
+            existing_nodes=self.existing_nodes,
+            errors={p.metadata.uid: errors.get(p.metadata.uid, "") for p in failed},
+            failed_pods=failed,
+        )
+        if not self.opts.simulation_mode:
+            self._record_results(result)
+        return result
+
+    # -- internals ---------------------------------------------------------
+
+    def _add(self, pod: Pod) -> Optional[str]:
+        """scheduler.go:177-222."""
+        for node in self.existing_nodes:
+            if node.add(pod) is None:
+                return None
+
+        # pick the open machine with fewest pods first (scheduler.go:186-193)
+        self.new_machines.sort(key=lambda m: len(m.pods))
+        for machine in self.new_machines:
+            if machine.add(pod) is None:
+                return None
+
+        errs: List[str] = []
+        for template in self.machine_templates:
+            instance_types = self.instance_types.get(template.provisioner_name, [])
+            remaining = self.remaining_resources.get(template.provisioner_name)
+            if remaining is not None:
+                instance_types = filter_by_remaining_resources(instance_types, remaining)
+                if not instance_types:
+                    errs.append(
+                        f"all available instance types exceed limits for provisioner "
+                        f'"{template.provisioner_name}"'
+                    )
+                    continue
+            machine = SchedulingMachine(
+                template,
+                self.topology,
+                self.daemon_overhead.get(id(template), {}),
+                instance_types,
+            )
+            err = machine.add(pod)
+            if err is not None:
+                errs.append(f'incompatible with provisioner "{template.provisioner_name}", {err}')
+                continue
+            self.new_machines.append(machine)
+            if remaining is not None:
+                # pessimistic max-capacity subtraction (scheduler.go:276-293)
+                self.remaining_resources[template.provisioner_name] = subtract_max(
+                    remaining, machine.instance_type_options
+                )
+            return None
+        return "; ".join(errs) if errs else "no machine templates configured"
+
+    def _calculate_existing_machines(self, state_nodes: List, daemonset_pods: List[Pod]) -> None:
+        """scheduler.go:224-251."""
+        for state_node in state_nodes:
+            if not state_node.owned():
+                continue
+            daemons = [
+                p
+                for p in daemonset_pods
+                if taints_mod.tolerates(state_node.taints(), p) is None
+                and Requirements.from_labels(state_node.labels()).compatible(
+                    Requirements.from_pod(p)
+                )
+                is None
+            ]
+            self.existing_nodes.append(
+                ExistingNode(
+                    state_node,
+                    self.topology,
+                    resources_util.requests_for_pods(*daemons) if daemons else {"pods": 0.0},
+                )
+            )
+            provisioner_name = state_node.labels().get(api_labels.PROVISIONER_NAME_LABEL_KEY, "")
+            if provisioner_name in self.remaining_resources:
+                self.remaining_resources[provisioner_name] = resources_util.subtract(
+                    self.remaining_resources[provisioner_name], state_node.capacity()
+                )
+
+    def _record_results(self, result: SchedulingResult) -> None:
+        """scheduler.go:135-175 — nomination + failure events."""
+        if self.recorder is None:
+            return
+        for pod in result.failed_pods:
+            self.recorder.pod_failed_to_schedule(pod, result.errors.get(pod.metadata.uid, ""))
+        for node in self.existing_nodes:
+            if node.pods and self.cluster is not None:
+                self.cluster.nominate_node_for_pod(node.name())
+            for pod in node.pods:
+                self.recorder.nominate_pod(pod, node.name())
+
+
+def build_scheduler(
+    kube_client,
+    cluster,
+    provisioners: List[Provisioner],
+    instance_types: Dict[str, List[InstanceType]],
+    pods: List[Pod],
+    state_nodes: Optional[List] = None,
+    daemonset_pods: Optional[List[Pod]] = None,
+    opts: Optional[SchedulerOptions] = None,
+    recorder=None,
+) -> "Scheduler":
+    """Wire a Scheduler the way the Provisioner does (provisioner.go:198-264):
+    templates ordered by weight, topology-domain universe from provisioner ∩
+    instance-type requirements, topology seeded with the batch pods."""
+    from karpenter_core_tpu.api.provisioner import order_by_weight
+
+    provisioners = [
+        p for p in order_by_weight(provisioners) if p.metadata.deletion_timestamp is None
+    ]
+    templates = [MachineTemplate(p) for p in provisioners]
+    domains: Dict[str, set] = {}
+    for provisioner in provisioners:
+        prov_reqs = Requirements.from_node_selector_requirements(
+            *provisioner.spec.requirements
+        )
+        for instance_type in instance_types.get(provisioner.name, []):
+            # intersect so instance-type zones don't expand past the
+            # provisioner's own universe (provisioner.go:227-237)
+            requirements = Requirements(prov_reqs.values())
+            requirements.add(*instance_type.requirements.values())
+            for key, requirement in requirements.items():
+                domains.setdefault(key, set()).update(requirement.values_list())
+        for key, requirement in prov_reqs.items():
+            if requirement.operator() == "In":
+                domains.setdefault(key, set()).update(requirement.values_list())
+
+    topology = Topology(kube_client, cluster, domains, pods)
+    return Scheduler(
+        kube_client,
+        templates,
+        provisioners,
+        cluster,
+        state_nodes or [],
+        topology,
+        instance_types,
+        daemonset_pods or [],
+        recorder=recorder,
+        opts=opts,
+    )
+
+
+def _get_daemon_overhead(
+    templates: List[MachineTemplate], daemonset_pods: List[Pod]
+) -> Dict[int, ResourceList]:
+    """Per-template daemon resource overhead (scheduler.go:253-270)."""
+    overhead: Dict[int, ResourceList] = {}
+    for template in templates:
+        daemons = [
+            p
+            for p in daemonset_pods
+            if taints_mod.tolerates(template.taints, p) is None
+            and template.requirements.compatible(Requirements.from_pod(p)) is None
+        ]
+        overhead[id(template)] = (
+            resources_util.requests_for_pods(*daemons) if daemons else {"pods": 0.0}
+        )
+    return overhead
+
+
+def subtract_max(remaining: ResourceList, instance_types: List[InstanceType]) -> ResourceList:
+    """Pessimistically subtract the max capacity over the machine's remaining
+    instance-type options (scheduler.go:276-293)."""
+    if not instance_types:
+        return remaining
+    max_caps = resources_util.max_resources(*[it.capacity for it in instance_types])
+    return {k: v - max_caps.get(k, 0.0) for k, v in remaining.items()}
+
+
+def filter_by_remaining_resources(
+    instance_types: List[InstanceType], remaining: ResourceList
+) -> List[InstanceType]:
+    """Exclude types whose capacity would breach provisioner limits
+    (scheduler.go:296-312)."""
+    out = []
+    for it in instance_types:
+        if all(it.capacity.get(name, 0.0) <= quantity for name, quantity in remaining.items()):
+            out.append(it)
+    return out

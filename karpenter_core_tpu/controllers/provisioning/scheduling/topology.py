@@ -1,0 +1,463 @@
+"""Topology tracking: spread / pod-affinity / pod-anti-affinity groups.
+
+Mirrors reference pkg/controllers/provisioning/scheduling/{topology,
+topologygroup,topologynodefilter}.go: TopologyGroups are hashed for sharing
+across pods; per-domain counts are seeded by listing cluster pods
+(countDomains); AddRequirements tightens node requirements to viable domains
+(kube-scheduler skew rule for spreads, existing-domain mask for affinity,
+zero-count mask for anti-affinity); Record commits a placement.
+
+The TPU path (ops/topology kernels) encodes these same domain-count tensors
+on device; this module is the semantic oracle and the host fallback.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+from karpenter_core_tpu.kube.objects import (
+    LABEL_HOSTNAME,
+    LabelSelector,
+    Pod,
+    PodAffinityTerm,
+)
+from karpenter_core_tpu.scheduling.requirement import (
+    OP_DOES_NOT_EXIST,
+    OP_EXISTS,
+    OP_IN,
+    Requirement,
+)
+from karpenter_core_tpu.scheduling.requirements import Requirements
+from karpenter_core_tpu.utils import podutils
+
+MAX_SKEW_UNBOUNDED = 2**31 - 1
+
+TOPOLOGY_TYPE_SPREAD = "topology spread"
+TOPOLOGY_TYPE_POD_AFFINITY = "pod affinity"
+TOPOLOGY_TYPE_POD_ANTI_AFFINITY = "pod anti-affinity"
+
+
+def _selector_canonical(selector: Optional[LabelSelector]) -> Tuple:
+    if selector is None:
+        return ("nil",)
+    return (
+        tuple(sorted(selector.match_labels.items())),
+        tuple(
+            sorted(
+                (e.key, e.operator, tuple(sorted(e.values))) for e in selector.match_expressions
+            )
+        ),
+    )
+
+
+def _selector_matches(selector: Optional[LabelSelector], labels: Dict[str, str]) -> bool:
+    """metav1.LabelSelectorAsSelector semantics: nil matches nothing, empty
+    matches everything."""
+    if selector is None:
+        return False
+    return selector.matches(labels)
+
+
+class TopologyNodeFilter:
+    """OR-of-terms node filter for spread constraints
+    (topologynodefilter.go:15-56)."""
+
+    def __init__(self, terms: List[Requirements]):
+        self.terms = terms
+
+    @classmethod
+    def for_pod(cls, pod: Pod) -> "TopologyNodeFilter":
+        node_selector_reqs = Requirements.from_labels(pod.spec.node_selector)
+        affinity = pod.spec.affinity
+        if affinity is None or affinity.node_affinity is None or not affinity.node_affinity.required:
+            return cls([node_selector_reqs])
+        terms = []
+        for term in affinity.node_affinity.required:
+            reqs = Requirements(node_selector_reqs.values())
+            reqs.add(*Requirements.from_node_selector_requirements(*term.match_expressions).values())
+            terms.append(reqs)
+        return cls(terms)
+
+    @classmethod
+    def empty(cls) -> "TopologyNodeFilter":
+        return cls([])
+
+    def matches_requirements(self, requirements: Requirements) -> bool:
+        if not self.terms:
+            return True
+        return any(requirements.compatible(term) is None for term in self.terms)
+
+    def matches_labels(self, labels: Dict[str, str]) -> bool:
+        return self.matches_requirements(Requirements.from_labels(labels))
+
+    def canonical(self) -> Tuple:
+        out = []
+        for term in self.terms:
+            out.append(
+                tuple(
+                    sorted(
+                        (r.key, r.complement, tuple(sorted(r.values)), r.greater_than, r.less_than)
+                        for r in term.values()
+                    )
+                )
+            )
+        return tuple(sorted(out))
+
+
+class TopologyGroup:
+    """topologygroup.go:51-85."""
+
+    def __init__(
+        self,
+        topology_type: str,
+        key: str,
+        pod: Optional[Pod],
+        namespaces: Set[str],
+        selector: Optional[LabelSelector],
+        max_skew: int,
+        domains: Optional[Set[str]],
+    ):
+        self.type = topology_type
+        self.key = key
+        self.namespaces = set(namespaces)
+        self.selector = selector
+        self.max_skew = max_skew
+        self.domains: Dict[str, int] = {d: 0 for d in (domains or set())}
+        self.owners: Set[str] = set()  # pod UIDs that carry this rule
+        if topology_type == TOPOLOGY_TYPE_SPREAD and pod is not None:
+            self.node_filter = TopologyNodeFilter.for_pod(pod)
+        else:
+            self.node_filter = TopologyNodeFilter.empty()
+
+    # -- next-domain selection (topologygroup.go:82-98,155-243) -----------
+
+    def get(self, pod: Pod, pod_domains: Requirement, node_domains: Requirement) -> Requirement:
+        if self.type == TOPOLOGY_TYPE_SPREAD:
+            return self._next_domain_spread(pod, pod_domains, node_domains)
+        if self.type == TOPOLOGY_TYPE_POD_AFFINITY:
+            return self._next_domain_affinity(pod, pod_domains, node_domains)
+        return self._next_domain_anti_affinity(pod_domains)
+
+    def record(self, *domains: str) -> None:
+        for domain in domains:
+            self.domains[domain] = self.domains.get(domain, 0) + 1
+
+    def counts(self, pod: Pod, requirements: Requirements) -> bool:
+        """Whether the pod's placement under `requirements` counts for this
+        group (topologygroup.go:101-103)."""
+        return self._selects(pod) and self.node_filter.matches_requirements(requirements)
+
+    def register(self, *domains: str) -> None:
+        for domain in domains:
+            self.domains.setdefault(domain, 0)
+
+    def add_owner(self, uid: str) -> None:
+        self.owners.add(uid)
+
+    def remove_owner(self, uid: str) -> None:
+        self.owners.discard(uid)
+
+    def is_owned_by(self, uid: str) -> bool:
+        return uid in self.owners
+
+    def hash_key(self) -> Tuple:
+        """Identity for sharing across pods (topologygroup.go:137-153)."""
+        return (
+            self.key,
+            self.type,
+            tuple(sorted(self.namespaces)),
+            _selector_canonical(self.selector),
+            self.max_skew,
+            self.node_filter.canonical(),
+        )
+
+    def _next_domain_spread(
+        self, pod: Pod, pod_domains: Requirement, node_domains: Requirement
+    ) -> Requirement:
+        """kube-scheduler skew rule: count+self - min <= maxSkew, pick the
+        min-count domain (topologygroup.go:155-182)."""
+        min_count = self._domain_min_count(pod_domains)
+        self_selecting = self._selects(pod)
+        best_domain = None
+        best_count = MAX_SKEW_UNBOUNDED
+        for domain in sorted(self.domains):
+            if node_domains.has(domain):
+                count = self.domains[domain]
+                if self_selecting:
+                    count += 1
+                if count - min_count <= self.max_skew and count < best_count:
+                    best_domain = domain
+                    best_count = count
+        if best_domain is None:
+            return Requirement(pod_domains.key, OP_DOES_NOT_EXIST)
+        return Requirement(pod_domains.key, OP_IN, [best_domain])
+
+    def _domain_min_count(self, domains: Requirement) -> int:
+        """Global min over domains the pod can select; hostname always 0
+        (topologygroup.go:185-199)."""
+        if self.key == LABEL_HOSTNAME:
+            return 0
+        counts = [c for d, c in self.domains.items() if domains.has(d)]
+        return min(counts) if counts else MAX_SKEW_UNBOUNDED
+
+    def _next_domain_affinity(
+        self, pod: Pod, pod_domains: Requirement, node_domains: Requirement
+    ) -> Requirement:
+        """topologygroup.go:202-233: domains with matching pods; a
+        self-selecting pod may seed the first viable domain."""
+        options = Requirement(pod_domains.key, OP_DOES_NOT_EXIST)
+        for domain in sorted(self.domains):
+            if pod_domains.has(domain) and self.domains[domain] > 0:
+                options.insert(domain)
+        if options.len() == 0 and self._selects(pod):
+            intersected = pod_domains.intersection(node_domains)
+            for domain in sorted(self.domains):
+                if intersected.has(domain):
+                    options.insert(domain)
+                    break
+            for domain in sorted(self.domains):
+                if pod_domains.has(domain):
+                    options.insert(domain)
+                    break
+        return options
+
+    def _next_domain_anti_affinity(self, domains: Requirement) -> Requirement:
+        """Only zero-count domains remain viable (topologygroup.go:235-243)."""
+        options = Requirement(domains.key, OP_DOES_NOT_EXIST)
+        for domain in sorted(self.domains):
+            if domains.has(domain) and self.domains[domain] == 0:
+                options.insert(domain)
+        return options
+
+    def _selects(self, pod: Pod) -> bool:
+        return pod.metadata.namespace in self.namespaces and _selector_matches(
+            self.selector, pod.metadata.labels
+        )
+
+
+class Topology:
+    """topology.go:37-80."""
+
+    def __init__(
+        self,
+        kube_client,
+        cluster,
+        domains: Dict[str, Set[str]],
+        pods: List[Pod],
+    ):
+        self.kube_client = kube_client
+        self.cluster = cluster
+        self.domains = domains
+        self.topologies: Dict[Tuple, TopologyGroup] = {}
+        self.inverse_topologies: Dict[Tuple, TopologyGroup] = {}
+        # pods in the current batch are excluded from domain counting: their
+        # placement is decided by this solve (topology.go:56-58)
+        self.excluded_pods: Set[str] = {p.metadata.uid for p in pods}
+        self._update_inverse_affinities()
+        for pod in pods:
+            self.update(pod)
+
+    # -- batch maintenance ------------------------------------------------
+
+    def update(self, pod: Pod) -> None:
+        """(Re-)derive the pod's topology groups after relaxation
+        (topology.go:86-117)."""
+        for tg in self.topologies.values():
+            tg.remove_owner(pod.metadata.uid)
+
+        if podutils.has_pod_anti_affinity(pod):
+            self._update_inverse_anti_affinity(pod, None)
+
+        for tg in self._new_for_topologies(pod) + self._new_for_affinities(pod):
+            key = tg.hash_key()
+            existing = self.topologies.get(key)
+            if existing is None:
+                self._count_domains(tg)
+                self.topologies[key] = tg
+            else:
+                tg = existing
+            tg.add_owner(pod.metadata.uid)
+
+    def record(self, pod: Pod, requirements: Requirements) -> None:
+        """Commit a placement into domain counts (topology.go:120-143)."""
+        for tg in self.topologies.values():
+            if tg.counts(pod, requirements):
+                domains = requirements.get_requirement(tg.key)
+                if tg.type == TOPOLOGY_TYPE_POD_ANTI_AFFINITY:
+                    tg.record(*domains.values_list())
+                elif domains.len() == 1:
+                    tg.record(domains.values_list()[0])
+        for tg in self.inverse_topologies.values():
+            if tg.is_owned_by(pod.metadata.uid):
+                tg.record(*requirements.get_requirement(tg.key).values_list())
+
+    def add_requirements(
+        self, pod_requirements: Requirements, node_requirements: Requirements, pod: Pod
+    ) -> Tuple[Optional[Requirements], Optional[str]]:
+        """Tighten node requirements to viable domains (topology.go:149-167).
+        Returns (requirements, error)."""
+        requirements = Requirements(node_requirements.values())
+        for tg in self._get_matching_topologies(pod, node_requirements):
+            pod_domains = pod_requirements.get_requirement(tg.key)
+            node_domains = node_requirements.get_requirement(tg.key)
+            domains = tg.get(pod, pod_domains, node_domains)
+            if domains.len() == 0:
+                return None, (
+                    f"unsatisfiable topology constraint for {tg.type}, key={tg.key} "
+                    f"(counts = {tg.domains}, podDomains = {pod_domains!r}, "
+                    f"nodeDomains = {node_domains!r})"
+                )
+            requirements.add(domains)
+        return requirements, None
+
+    def register(self, topology_key: str, domain: str) -> None:
+        """Register a new domain (e.g. a hostname) (topology.go:170-180)."""
+        for tg in self.topologies.values():
+            if tg.key == topology_key:
+                tg.register(domain)
+        for tg in self.inverse_topologies.values():
+            if tg.key == topology_key:
+                tg.register(domain)
+
+    # -- construction helpers ---------------------------------------------
+
+    def _update_inverse_affinities(self) -> None:
+        """Seed inverse anti-affinity from pods already in the cluster
+        (topology.go:183-196)."""
+        if self.cluster is None:
+            return
+
+        def visit(pod: Pod, node) -> bool:
+            if pod.metadata.uid not in self.excluded_pods:
+                self._update_inverse_anti_affinity(pod, node.metadata.labels)
+            return True
+
+        self.cluster.for_pods_with_anti_affinity(visit)
+
+    def _update_inverse_anti_affinity(self, pod: Pod, node_labels: Optional[Dict[str, str]]) -> None:
+        """topology.go:200-227: an inverse group tracks where a pod with
+        anti-affinity LANDED so future matching pods avoid those domains."""
+        for term in pod.spec.affinity.pod_anti_affinity.required:
+            namespaces = self._build_namespace_list(
+                pod.metadata.namespace, term.namespaces, term.namespace_selector
+            )
+            tg = TopologyGroup(
+                TOPOLOGY_TYPE_POD_ANTI_AFFINITY,
+                term.topology_key,
+                pod,
+                namespaces,
+                term.label_selector,
+                MAX_SKEW_UNBOUNDED,
+                self.domains.get(term.topology_key, set()),
+            )
+            key = tg.hash_key()
+            existing = self.inverse_topologies.get(key)
+            if existing is None:
+                self.inverse_topologies[key] = tg
+            else:
+                tg = existing
+            if node_labels and tg.key in node_labels:
+                tg.record(node_labels[tg.key])
+            tg.add_owner(pod.metadata.uid)
+
+    def _count_domains(self, tg: TopologyGroup) -> None:
+        """Seed domain counts from scheduled cluster pods (topology.go:231-276)."""
+        if self.kube_client is None:
+            return
+        pods: List[Pod] = []
+        for ns in tg.namespaces:
+            pods.extend(self.kube_client.list("Pod", namespace=ns, selector=tg.selector))
+        for pod in pods:
+            if not podutils.is_scheduled(pod) or podutils.is_terminal(pod) or podutils.is_terminating(pod):
+                continue
+            if pod.metadata.uid in self.excluded_pods:
+                continue
+            node = self.kube_client.get("Node", "", pod.spec.node_name)
+            if node is None:
+                continue
+            domain = node.metadata.labels.get(tg.key)
+            if domain is None and tg.key == LABEL_HOSTNAME:
+                domain = node.metadata.name
+            if domain is None:
+                continue  # node without the domain label doesn't count
+            if not tg.node_filter.matches_labels(node.metadata.labels):
+                continue
+            tg.record(domain)
+
+    def _new_for_topologies(self, pod: Pod) -> List[TopologyGroup]:
+        return [
+            TopologyGroup(
+                TOPOLOGY_TYPE_SPREAD,
+                cs.topology_key,
+                pod,
+                {pod.metadata.namespace},
+                cs.label_selector,
+                cs.max_skew,
+                self.domains.get(cs.topology_key, set()),
+            )
+            for cs in pod.spec.topology_spread_constraints
+        ]
+
+    def _new_for_affinities(self, pod: Pod) -> List[TopologyGroup]:
+        """Both hard and soft affinity terms become groups (topology.go:283-322)."""
+        groups: List[TopologyGroup] = []
+        affinity = pod.spec.affinity
+        if affinity is None:
+            return groups
+        terms: List[Tuple[str, PodAffinityTerm]] = []
+        if affinity.pod_affinity is not None:
+            terms += [(TOPOLOGY_TYPE_POD_AFFINITY, t) for t in affinity.pod_affinity.required]
+            terms += [
+                (TOPOLOGY_TYPE_POD_AFFINITY, t.pod_affinity_term)
+                for t in affinity.pod_affinity.preferred
+            ]
+        if affinity.pod_anti_affinity is not None:
+            terms += [
+                (TOPOLOGY_TYPE_POD_ANTI_AFFINITY, t) for t in affinity.pod_anti_affinity.required
+            ]
+            terms += [
+                (TOPOLOGY_TYPE_POD_ANTI_AFFINITY, t.pod_affinity_term)
+                for t in affinity.pod_anti_affinity.preferred
+            ]
+        for topology_type, term in terms:
+            namespaces = self._build_namespace_list(
+                pod.metadata.namespace, term.namespaces, term.namespace_selector
+            )
+            groups.append(
+                TopologyGroup(
+                    topology_type,
+                    term.topology_key,
+                    pod,
+                    namespaces,
+                    term.label_selector,
+                    MAX_SKEW_UNBOUNDED,
+                    self.domains.get(term.topology_key, set()),
+                )
+            )
+        return groups
+
+    def _build_namespace_list(
+        self, namespace: str, namespaces: List[str], selector: Optional[LabelSelector]
+    ) -> Set[str]:
+        """topology.go:327-347."""
+        if not namespaces and selector is None:
+            return {namespace}
+        if selector is None:
+            return set(namespaces)
+        selected = set(namespaces)
+        if self.kube_client is not None:
+            for ns in self.kube_client.list("Namespace", selector=selector):
+                selected.add(ns.metadata.name)
+        return selected
+
+    def _get_matching_topologies(
+        self, pod: Pod, requirements: Requirements
+    ) -> List[TopologyGroup]:
+        """Groups that control p's scheduling, plus inverse groups p counts
+        against (topology.go:351-364)."""
+        matching = [
+            tg for tg in self.topologies.values() if tg.is_owned_by(pod.metadata.uid)
+        ]
+        matching += [
+            tg for tg in self.inverse_topologies.values() if tg.counts(pod, requirements)
+        ]
+        return matching

@@ -1,0 +1,190 @@
+"""In-memory kube apiserver analog.
+
+The reference tests against a real apiserver via envtest
+(pkg/test/environment.go:69-118); this framework has no cluster dependency, so
+the object store + list/watch semantics live in-process. Controllers consume
+the same get/list/create/update/delete/watch surface that client-go provides.
+
+Thread-safe; watches deliver (event_type, object) tuples to subscriber queues.
+"""
+from __future__ import annotations
+
+import copy
+import queue
+import threading
+import time
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+from karpenter_core_tpu.kube.objects import LabelSelector, NamespacedName
+
+WatchEvent = Tuple[str, object]  # ("ADDED"|"MODIFIED"|"DELETED", obj)
+
+
+class ConflictError(Exception):
+    """Resource-version conflict on update."""
+
+
+class NotFoundError(Exception):
+    pass
+
+
+class AlreadyExistsError(Exception):
+    pass
+
+
+def _kind_of(obj) -> str:
+    return type(obj).__name__
+
+
+class InMemoryKubeClient:
+    """Object store keyed (kind, namespace, name) with watch fan-out."""
+
+    def __init__(self):
+        self._mu = threading.RLock()
+        self._objects: Dict[str, Dict[NamespacedName, object]] = {}
+        self._watchers: Dict[str, List[queue.Queue]] = {}
+        self._rv = 0
+
+    # -- CRUD -------------------------------------------------------------
+
+    def create(self, obj) -> object:
+        kind = _kind_of(obj)
+        with self._mu:
+            key = NamespacedName(obj.metadata.namespace, obj.metadata.name)
+            store = self._objects.setdefault(kind, {})
+            if key in store:
+                raise AlreadyExistsError(f"{kind} {key} already exists")
+            self._rv += 1
+            obj.metadata.resource_version = self._rv
+            stored = copy.deepcopy(obj)
+            store[key] = stored
+            self._notify(kind, "ADDED", stored)
+            return copy.deepcopy(stored)
+
+    def get(self, kind: str, namespace: str, name: str) -> Optional[object]:
+        with self._mu:
+            obj = self._objects.get(kind, {}).get(NamespacedName(namespace, name))
+            return copy.deepcopy(obj) if obj is not None else None
+
+    def update(self, obj) -> object:
+        kind = _kind_of(obj)
+        with self._mu:
+            key = NamespacedName(obj.metadata.namespace, obj.metadata.name)
+            store = self._objects.setdefault(kind, {})
+            if key not in store:
+                raise NotFoundError(f"{kind} {key} not found")
+            self._rv += 1
+            obj.metadata.resource_version = self._rv
+            stored = copy.deepcopy(obj)
+            store[key] = stored
+            self._notify(kind, "MODIFIED", stored)
+            return copy.deepcopy(stored)
+
+    def apply(self, obj) -> object:
+        """Create-or-update."""
+        kind = _kind_of(obj)
+        with self._mu:
+            key = NamespacedName(obj.metadata.namespace, obj.metadata.name)
+            if key in self._objects.get(kind, {}):
+                return self.update(obj)
+            return self.create(obj)
+
+    def delete(self, obj_or_kind, namespace: str = None, name: str = None) -> None:
+        """delete(obj) or delete(kind, namespace, name).
+
+        Honors finalizers: sets deletion_timestamp and emits MODIFIED until the
+        finalizer list is empty, then removes — mirrors apiserver behavior the
+        termination/machine controllers depend on.
+        """
+        if isinstance(obj_or_kind, str):
+            kind = obj_or_kind
+        else:
+            kind = _kind_of(obj_or_kind)
+            namespace = obj_or_kind.metadata.namespace
+            name = obj_or_kind.metadata.name
+        with self._mu:
+            key = NamespacedName(namespace, name)
+            store = self._objects.get(kind, {})
+            existing = store.get(key)
+            if existing is None:
+                raise NotFoundError(f"{kind} {key} not found")
+            if existing.metadata.finalizers:
+                if existing.metadata.deletion_timestamp is None:
+                    existing.metadata.deletion_timestamp = time.time()
+                    self._rv += 1
+                    existing.metadata.resource_version = self._rv
+                    self._notify(kind, "MODIFIED", existing)
+                return
+            del store[key]
+            self._notify(kind, "DELETED", existing)
+
+    def finalize(self, obj) -> None:
+        """Persist a finalizer removal; completes deletion if terminating."""
+        kind = _kind_of(obj)
+        with self._mu:
+            key = NamespacedName(obj.metadata.namespace, obj.metadata.name)
+            store = self._objects.get(kind, {})
+            existing = store.get(key)
+            if existing is None:
+                raise NotFoundError(f"{kind} {key} not found")
+            existing.metadata.finalizers = list(obj.metadata.finalizers)
+            if existing.metadata.deletion_timestamp is not None and not existing.metadata.finalizers:
+                del store[key]
+                self._notify(kind, "DELETED", existing)
+            else:
+                self._rv += 1
+                existing.metadata.resource_version = self._rv
+                obj.metadata.resource_version = self._rv
+                self._notify(kind, "MODIFIED", existing)
+
+    # -- queries ----------------------------------------------------------
+
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = None,
+        selector: Optional[LabelSelector] = None,
+        field_filter: Optional[Callable[[object], bool]] = None,
+    ) -> List[object]:
+        with self._mu:
+            out = []
+            for key, obj in self._objects.get(kind, {}).items():
+                if namespace is not None and key.namespace != namespace:
+                    continue
+                if selector is not None and not selector.matches(obj.metadata.labels):
+                    continue
+                if field_filter is not None and not field_filter(obj):
+                    continue
+                out.append(copy.deepcopy(obj))
+            return out
+
+    def namespaces(self) -> List[str]:
+        with self._mu:
+            names = {o.metadata.name for o in self._objects.get("Namespace", {}).values()}
+            for kind_store in self._objects.values():
+                for key in kind_store:
+                    if key.namespace:
+                        names.add(key.namespace)
+            return sorted(names)
+
+    # -- watches ----------------------------------------------------------
+
+    def watch(self, kind: str, backlog: bool = True) -> queue.Queue:
+        """Subscribe to a kind; returns a queue of WatchEvents. With backlog,
+        current objects are replayed as ADDED."""
+        q: queue.Queue = queue.Queue()
+        with self._mu:
+            if backlog:
+                for obj in self._objects.get(kind, {}).values():
+                    q.put(("ADDED", copy.deepcopy(obj)))
+            self._watchers.setdefault(kind, []).append(q)
+        return q
+
+    def unwatch(self, kind: str, q: queue.Queue) -> None:
+        with self._mu:
+            if q in self._watchers.get(kind, []):
+                self._watchers[kind].remove(q)
+
+    def _notify(self, kind: str, event: str, obj) -> None:
+        for q in self._watchers.get(kind, []):
+            q.put((event, copy.deepcopy(obj)))

@@ -145,6 +145,7 @@ class PodAffinityTerm:
     topology_key: str
     label_selector: Optional[LabelSelector] = None
     namespaces: List[str] = field(default_factory=list)
+    namespace_selector: Optional[LabelSelector] = None
 
 
 @dataclass
@@ -444,6 +445,23 @@ class PodDisruptionBudget:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     spec: PodDisruptionBudgetSpec = field(default_factory=PodDisruptionBudgetSpec)
     status: PodDisruptionBudgetStatus = field(default_factory=PodDisruptionBudgetStatus)
+
+
+@dataclass
+class Namespace:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+
+    def __post_init__(self):
+        self.metadata.namespace = ""
+
+
+@dataclass
+class DaemonSet:
+    """Minimal DaemonSet: carries the pod template the scheduler uses to
+    compute per-template daemon overhead."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    pod_template_spec: Optional["PodSpec"] = None
 
 
 # Well-known label/condition constants (k8s.io/api/core/v1 well_known_labels.go)

@@ -1,0 +1,122 @@
+"""Device primitives for the requirement algebra.
+
+Lowers the set-algebra of pkg/scheduling/{requirement,requirements}.go onto
+dense masks (see solver/encode.py for the encoding):
+
+  nonempty(A ∩ B) per key  =  (outA & outB) | any_v(allowA & allowB)
+  Intersects(a, b) fails on a shared-defined key with empty intersection
+    unless BOTH operators are NotIn/DoesNotExist (requirements.go:189-206)
+  Compatible(node, pod) additionally denies custom (non-well-known) keys the
+    node side doesn't define, unless pod op is NotIn/DoesNotExist
+    (requirements.go:123-133)
+
+All per-key reductions are static Python loops over dictionary segments at
+trace time, so XLA sees fixed-shape slices and fuses the whole thing.
+"""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Segments = List[Tuple[int, int]]  # per key: (lo, hi) into the flat value axis
+
+
+def segment_any(mask: jnp.ndarray, segments: Segments) -> jnp.ndarray:
+    """[..., V] bool -> [..., K] bool: any within each key's segment."""
+    cols = [
+        mask[..., lo:hi].any(axis=-1)
+        if hi > lo
+        else jnp.zeros(mask.shape[:-1], dtype=bool)
+        for lo, hi in segments
+    ]
+    return jnp.stack(cols, axis=-1)
+
+
+def escape_flags(
+    allow: jnp.ndarray, out: jnp.ndarray, defined: jnp.ndarray, segments: Segments
+) -> jnp.ndarray:
+    """Recover operator ∈ {NotIn, DoesNotExist} for (possibly merged)
+    requirement rows (requirement.go:186-197):
+      NotIn          = complement & excluded-values nonempty
+      DoesNotExist   = ~complement & allowed empty
+    """
+    has_allow = segment_any(allow, segments)
+    has_excl = segment_any(~allow, segments)
+    return defined & ((out & has_excl) | (~out & ~has_allow))
+
+
+def pairwise_nonempty_key(
+    allow_a: jnp.ndarray,  # [A, V]
+    out_a: jnp.ndarray,  # [A, K]
+    allow_b: jnp.ndarray,  # [B, V]
+    out_b: jnp.ndarray,  # [B, K]
+    k: int,
+    lo: int,
+    hi: int,
+) -> jnp.ndarray:
+    """[A, B] nonempty(A_i ∩ B_j) for key k via one MXU matmul."""
+    both_out = out_a[:, k : k + 1] & out_b[:, k].T  # [A, B]
+    if hi == lo:
+        return both_out
+    inter = (
+        jnp.matmul(
+            allow_a[:, lo:hi].astype(jnp.bfloat16),
+            allow_b[:, lo:hi].astype(jnp.bfloat16).T,
+            preferred_element_type=jnp.float32,
+        )
+        > 0.5
+    )
+    return both_out | inter
+
+
+def pairwise_intersects(a, b, segments: Segments) -> jnp.ndarray:
+    """[A, B] Requirements.Intersects between rows of two ReqSet pytrees
+    (dicts with allow/out/defined/escape)."""
+    ok = None
+    for k, (lo, hi) in enumerate(segments):
+        shared = a["defined"][:, k : k + 1] & b["defined"][None, :, k]
+        nonempty = pairwise_nonempty_key(a["allow"], a["out"], b["allow"], b["out"], k, lo, hi)
+        escapes = a["escape"][:, k : k + 1] & b["escape"][None, :, k]
+        key_ok = (~shared) | nonempty | escapes
+        ok = key_ok if ok is None else (ok & key_ok)
+    if ok is None:
+        ok = jnp.ones((a["allow"].shape[0], b["allow"].shape[0]), dtype=bool)
+    return ok
+
+
+def pairwise_compatible(node, pod, segments: Segments, well_known: jnp.ndarray) -> jnp.ndarray:
+    """[Nnode, Npod] Requirements.Compatible(node_side, pod_side):
+    Intersects plus the custom-label-must-be-defined rule."""
+    ok = pairwise_intersects(node, pod, segments)
+    # custom keys: pod defines, node doesn't, op not NotIn/DNE -> incompatible
+    custom = ~well_known  # [K]
+    deny = (
+        custom[None, :]
+        & pod["defined"]
+        & ~pod["escape"]
+    )  # [Npod, K]
+    # [Nnode, Npod]: any denied key the node does not define
+    denied = jnp.any(deny[None, :, :] & ~node["defined"][:, None, :], axis=-1)
+    return ok & ~denied
+
+
+def rows_nonempty(allow_a, out_a, allow_b, out_b, segments: Segments) -> jnp.ndarray:
+    """Row-aligned nonempty: a and b both [..., V]/[..., K] broadcastable;
+    returns [..., K]."""
+    cols = []
+    for k, (lo, hi) in enumerate(segments):
+        both_out = out_a[..., k] & out_b[..., k]
+        if hi > lo:
+            inter = (allow_a[..., lo:hi] & allow_b[..., lo:hi]).any(axis=-1)
+            cols.append(both_out | inter)
+        else:
+            cols.append(both_out)
+    return jnp.stack(cols, axis=-1)
+
+
+def fits(requests: jnp.ndarray, alloc: jnp.ndarray) -> jnp.ndarray:
+    """resources.Fits on device: requests [..., R] vs alloc [..., R] ->
+    [...] bool. Any negative allocatable entry never fits."""
+    return jnp.all((requests <= alloc) & (alloc >= 0.0), axis=-1)

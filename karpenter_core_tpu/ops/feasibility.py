@@ -1,0 +1,108 @@
+"""Static pod×instance-type feasibility kernel.
+
+Computes, for each machine template j, F_static[j, P, T] =
+    Compatible(template_j, pod)                   (machine.go:77)
+  ∧ Intersects(template_j ∩ pod, instance_type)   (machine.go:137-145)
+  ∧ hasOffering(type, zones/cts of template∩pod)  (machine.go:152-159)
+  ∧ pod tolerates template taints                 (machine.go:63-65)
+  ∧ template offers the type
+plus openable[j, P] = F_static ∧ fits(daemon_j + pod) — "a fresh machine from
+template j could host this pod alone".
+
+Resource fits against ACCUMULATED machine usage is intentionally excluded from
+F_static: the packing kernel (ops/pack.py) applies it per step.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from karpenter_core_tpu.ops import compat
+
+
+def reqset_row(rs, i):
+    return {k: v[i] for k, v in rs.items()}
+
+
+def merge_reqsets(a, b):
+    """Intersection of two requirement rows/batches (broadcastable):
+    Requirements.Add semantics on masks (requirement.go:117-150)."""
+    return {
+        "allow": a["allow"] & b["allow"],
+        "out": a["out"] & b["out"],
+        "defined": a["defined"] | b["defined"],
+    }
+
+
+def feasibility_static(
+    pod_reqs: dict,  # allow [P,V], out/defined/escape [P,K]
+    tmpl_reqs: dict,  # [J, ...]
+    type_reqs: dict,  # [T, ...]
+    pod_tol: jnp.ndarray,  # [P, J]
+    tmpl_type_mask: jnp.ndarray,  # [J, T]
+    type_offering_ok: jnp.ndarray,  # [T, Z, C]
+    zone_seg: Tuple[int, int],
+    ct_seg: Tuple[int, int],
+    segments,
+    well_known: jnp.ndarray,
+) -> jnp.ndarray:
+    """Returns F_static [J, P, T] bool."""
+    J = tmpl_reqs["allow"].shape[0]
+    outs = []
+    for j in range(J):  # J is small (provisioner count); static unroll
+        tmpl = {k: v[j : j + 1] for k, v in tmpl_reqs.items()}
+        # Compatible(template, pod): [1, P] -> [P]
+        comp_tp = compat.pairwise_compatible(tmpl, pod_reqs, segments, well_known)[0]
+
+        # merged machine requirements M = template ∩ pod
+        merged = merge_reqsets(
+            {k: tmpl_reqs[k][j][None, :] for k in ("allow", "out", "defined")},
+            {k: pod_reqs[k] for k in ("allow", "out", "defined")},
+        )  # [P, ...]
+        merged["escape"] = compat.escape_flags(
+            merged["allow"], merged["out"], merged["defined"], segments
+        )
+
+        # Intersects(M, type): [P, T]
+        inter_ok = compat.pairwise_intersects(merged, type_reqs, segments)
+
+        # hasOffering: any available offering in M's zone/ct masks [P, T]
+        zlo, zhi = zone_seg
+        clo, chi = ct_seg
+        zone_allow = merged["allow"][:, zlo:zhi]  # [P, Z]
+        ct_allow = merged["allow"][:, clo:chi]  # [P, C]
+        offer_ok = (
+            jnp.einsum(
+                "tzc,pz,pc->pt",
+                type_offering_ok.astype(jnp.float32),
+                zone_allow.astype(jnp.float32),
+                ct_allow.astype(jnp.float32),
+            )
+            > 0.5
+        )
+
+        f = (
+            comp_tp[:, None]
+            & pod_tol[:, j][:, None]
+            & tmpl_type_mask[j][None, :]
+            & inter_ok
+            & offer_ok
+        )
+        outs.append(f)
+    return jnp.stack(outs, axis=0)
+
+
+def openable_mask(
+    f_static: jnp.ndarray,  # [J, P, T]
+    pod_requests: jnp.ndarray,  # [P, R]
+    tmpl_daemon: jnp.ndarray,  # [J, R]
+    type_alloc: jnp.ndarray,  # [T, R]
+) -> jnp.ndarray:
+    """[J, P]: a fresh machine from template j can host the pod alone."""
+    # [J, P, T]: daemon_j + pod_p fits type_t
+    req = tmpl_daemon[:, None, :] + pod_requests[None, :, :]  # [J, P, R]
+    fit = compat.fits(req[:, :, None, :], type_alloc[None, None, :, :])  # [J, P, T]
+    return (f_static & fit).any(axis=-1)

@@ -1,0 +1,297 @@
+"""Greedy packing kernel: lax.scan over FFD-ordered pods.
+
+Replaces the serial Solve loop (reference scheduler.go:96-133,177-222) with a
+device-resident scan over a fixed budget of node slots:
+
+  slot state: accumulated requests, merged requirement masks, remaining
+  instance-type mask, per-resource optimistic max-allocatable, pod count.
+
+Per pod step:
+  1. SCREEN all slots cheaply: taints ∧ requirement-compat ∧ optimistic fit
+     (used + pod <= per-slot max over remaining types).
+  2. Rank candidates by the reference's order: existing nodes (index order)
+     first, then open machines ascending pod count (scheduler.go:179-193).
+  3. VERIFY the best candidate exactly: remaining types that are compatible
+     with the MERGED slot∪pod requirements, fit the accumulated usage, and
+     retain an available offering (machine.go:137-159). On failure, mask the
+     candidate and retry (bounded while_loop).
+  4. Otherwise OPEN a new slot from the first template whose fresh machine
+     can host the pod (weight order, scheduler.go:195-221), honoring
+     provisioner limits via pessimistic max-capacity subtraction
+     (scheduler.go:276-293).
+
+Slots [0, E) are pre-seeded with existing nodes (fixed capacity, no type
+narrowing); machine slots open from E upward.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from karpenter_core_tpu.ops import compat
+from karpenter_core_tpu.ops.feasibility import merge_reqsets
+
+BIG = jnp.float32(1e30)
+
+
+class PackState(NamedTuple):
+    used: jnp.ndarray  # [N, R]
+    open: jnp.ndarray  # [N] bool
+    is_existing: jnp.ndarray  # [N] bool
+    tmpl: jnp.ndarray  # [N] int32 template id (machine slots)
+    tol_idx: jnp.ndarray  # [N] int32 row into pod_tol_all
+    pods: jnp.ndarray  # [N] int32
+    allow: jnp.ndarray  # [N, V] bool (merged requirement masks)
+    out: jnp.ndarray  # [N, K] bool
+    defined: jnp.ndarray  # [N, K] bool
+    tmask: jnp.ndarray  # [N, T] bool (remaining instance types; machine slots)
+    cap: jnp.ndarray  # [N, R] optimistic capacity: existing=available,
+    #                   machine=max over remaining types' allocatable
+    nopen: jnp.ndarray  # scalar int32 — next free slot
+    remaining: jnp.ndarray  # [J, R] provisioner remaining limit (+inf if none)
+
+
+def _segment_max_alloc(tmask: jnp.ndarray, type_alloc: jnp.ndarray) -> jnp.ndarray:
+    """[..., T] bool, [T, R] -> [..., R] max allocatable over allowed types."""
+    masked = jnp.where(tmask[..., None], type_alloc, -BIG)
+    return masked.max(axis=-2)
+
+
+def make_pack_kernel(segments, zone_seg, ct_seg, max_verify_tries: int = 16):
+    """Build the jittable packing fn for a fixed label geometry."""
+
+    zlo, zhi = zone_seg
+    clo, chi = ct_seg
+
+    def slot_compat_screen(state: PackState, prow):
+        """[N] bool: pod-vs-slot requirement compatibility + custom rule
+        (the node side is the slot's merged requirements)."""
+        ok = jnp.ones(state.allow.shape[0], dtype=bool)
+        slot_escape = compat.escape_flags(state.allow, state.out, state.defined, segments)
+        for k, (lo, hi) in enumerate(segments):
+            shared = state.defined[:, k] & prow["defined"][k]
+            both_out = state.out[:, k] & prow["out"][k]
+            if hi > lo:
+                inter = (state.allow[:, lo:hi] & prow["allow"][lo:hi]).any(axis=-1)
+                nonempty = both_out | inter
+            else:
+                nonempty = both_out
+            escapes = slot_escape[:, k] & prow["escape"][k]
+            ok &= (~shared) | nonempty | escapes
+        # custom keys the pod defines (op not NotIn/DNE) must be defined on slot
+        deny = prow["custom_deny"]  # [K]
+        ok &= ~jnp.any(deny[None, :] & ~state.defined, axis=-1)
+        return ok
+
+    def verify_slot(state: PackState, prow, n, type_reqs, type_alloc, type_offering_ok, f_static_p):
+        """Exact acceptance check on slot n; returns (ok, new_tmask[T])."""
+        m_allow = state.allow[n] & prow["allow"]  # [V]
+        m_out = state.out[n] & prow["out"]
+        m_defined = state.defined[n] | prow["defined"]
+        m_escape = compat.escape_flags(m_allow[None], m_out[None], m_defined[None], segments)[0]
+
+        # per-type compat with merged requirements
+        ok_t = jnp.ones(type_alloc.shape[0], dtype=bool)
+        for k, (lo, hi) in enumerate(segments):
+            shared = m_defined[k] & type_reqs["defined"][:, k]
+            both_out = m_out[k] & type_reqs["out"][:, k]
+            if hi > lo:
+                inter = (m_allow[lo:hi][None, :] & type_reqs["allow"][:, lo:hi]).any(axis=-1)
+                nonempty = both_out | inter
+            else:
+                nonempty = both_out
+            escapes = m_escape[k] & type_reqs["escape"][:, k]
+            ok_t &= (~shared) | nonempty | escapes
+
+        new_used = state.used[n] + prow["requests"]  # [R]
+        fit_t = compat.fits(new_used[None, :], type_alloc)  # [T]
+        offer_t = (
+            jnp.einsum(
+                "tzc,z,c->t",
+                type_offering_ok.astype(jnp.float32),
+                m_allow[zlo:zhi].astype(jnp.float32),
+                m_allow[clo:chi].astype(jnp.float32),
+            )
+            > 0.5
+        )
+        new_tmask = (
+            state.tmask[n]
+            & ok_t
+            & fit_t
+            & offer_t
+            & f_static_p[state.tmpl[n]]
+        )
+        is_existing = state.is_existing[n]
+        fit_existing = compat.fits(new_used, state.cap[n])
+        ok = jnp.where(is_existing, fit_existing, new_tmask.any())
+        return ok, new_tmask
+
+    def commit(state: PackState, prow, n, new_tmask, type_alloc):
+        m_allow = state.allow[n] & prow["allow"]
+        m_out = state.out[n] & prow["out"]
+        m_defined = state.defined[n] | prow["defined"]
+        new_used = state.used[n] + prow["requests"]
+        is_existing = state.is_existing[n]
+        new_cap = jnp.where(
+            is_existing, state.cap[n], _segment_max_alloc(new_tmask, type_alloc)
+        )
+        return state._replace(
+            used=state.used.at[n].set(new_used),
+            pods=state.pods.at[n].add(1),
+            allow=state.allow.at[n].set(m_allow),
+            out=state.out.at[n].set(m_out),
+            defined=state.defined.at[n].set(m_defined),
+            tmask=jnp.where(
+                is_existing, state.tmask, state.tmask.at[n].set(new_tmask)
+            ),
+            cap=state.cap.at[n].set(new_cap),
+        )
+
+    def pack(
+        state: PackState,
+        pod_arrays: dict,  # allow [P,V], out/defined/escape/custom_deny [P,K],
+        #                    requests [P,R], tol [P, J+E], valid [P]
+        f_static: jnp.ndarray,  # [J, P, T]
+        openable: jnp.ndarray,  # [J, P]
+        tmpl_reqs: dict,  # [J, ...]
+        tmpl_daemon: jnp.ndarray,  # [J, R]
+        tmpl_type_mask: jnp.ndarray,  # [J, T]
+        type_reqs: dict,
+        type_alloc: jnp.ndarray,
+        type_capacity: jnp.ndarray,
+        type_offering_ok: jnp.ndarray,
+    ):
+        N = state.used.shape[0]
+        J = tmpl_daemon.shape[0]
+        P = pod_arrays["requests"].shape[0]
+
+        def step(state: PackState, i):
+            prow = {
+                "allow": pod_arrays["allow"][i],
+                "out": pod_arrays["out"][i],
+                "defined": pod_arrays["defined"][i],
+                "escape": pod_arrays["escape"][i],
+                "custom_deny": pod_arrays["custom_deny"][i],
+                "requests": pod_arrays["requests"][i],
+            }
+            valid = pod_arrays["valid"][i]
+
+            # -- screen --------------------------------------------------
+            tol = pod_arrays["tol"][i][state.tol_idx]  # [N]
+            fit_screen = compat.fits(state.used + prow["requests"][None, :], state.cap)
+            req_screen = slot_compat_screen(state, prow)
+            screen = state.open & tol & fit_screen & req_screen
+
+            # rank: existing first by index, then machines by (pods, index)
+            idx = jnp.arange(N, dtype=jnp.float32)
+            score = jnp.where(
+                state.is_existing,
+                idx,
+                jnp.float32(N) + state.pods.astype(jnp.float32) * N + idx,
+            )
+            score = jnp.where(screen, score, BIG)
+
+            # -- verify loop ---------------------------------------------
+            def cond(carry):
+                found, tries, cand, score, _ = carry
+                return (~found) & (tries < max_verify_tries) & (score.min() < BIG)
+
+            f_static_p = f_static[:, i, :]  # [J, T]
+
+            def body(carry):
+                found, tries, cand, score, tmask_out = carry
+                n = jnp.argmin(score)
+                ok, new_tmask = verify_slot(
+                    state, prow, n, type_reqs, type_alloc, type_offering_ok, f_static_p
+                )
+                score = score.at[n].set(BIG)
+                return (
+                    ok,
+                    tries + 1,
+                    jnp.where(ok, n, cand),
+                    score,
+                    jnp.where(ok, new_tmask, tmask_out),
+                )
+
+            found, _, cand, _, cand_tmask = jax.lax.while_loop(
+                cond,
+                body,
+                (
+                    jnp.bool_(False),
+                    jnp.int32(0),
+                    jnp.int32(-1),
+                    score,
+                    jnp.zeros_like(state.tmask[0]),
+                ),
+            )
+
+            # -- open new slot --------------------------------------------
+            # first template (weight order) that can host the pod within limits
+            cap_ok = jnp.all(
+                type_capacity[None, :, :] <= state.remaining[:, None, :], axis=-1
+            )  # [J, T]
+            open_types = (
+                f_static[:, i, :]
+                & cap_ok
+                & compat.fits(
+                    (tmpl_daemon[:, None, :] + prow["requests"][None, None, :]),
+                    type_alloc[None, :, :],
+                )
+            )  # [J, T]
+            can_open_j = open_types.any(axis=-1) & openable[:, i]  # [J]
+            j_choice = jnp.argmax(can_open_j)
+            can_open = can_open_j.any() & (state.nopen < N)
+
+            do_open = valid & (~found) & can_open
+            do_assign = valid & (found | can_open)
+            slot = jnp.where(found, cand, state.nopen)
+
+            # build the opened slot's state row
+            new_tmask = jnp.where(found, cand_tmask, open_types[j_choice])
+            opened_allow = tmpl_reqs["allow"][j_choice] & prow["allow"]
+            opened_out = tmpl_reqs["out"][j_choice] & prow["out"]
+            opened_defined = tmpl_reqs["defined"][j_choice] | prow["defined"]
+            opened_used = tmpl_daemon[j_choice] + prow["requests"]
+            opened_cap = _segment_max_alloc(new_tmask, type_alloc)
+
+            def apply_found(state):
+                return commit(state, prow, cand, cand_tmask, type_alloc)
+
+            def apply_open(state):
+                n = state.nopen
+                # pessimistic limit subtraction: max capacity over the opened
+                # slot's surviving types (scheduler.go:276-293)
+                max_cap = jnp.where(new_tmask[:, None], type_capacity, -BIG).max(axis=0)
+                max_cap = jnp.maximum(max_cap, 0.0)
+                return state._replace(
+                    used=state.used.at[n].set(opened_used),
+                    open=state.open.at[n].set(True),
+                    is_existing=state.is_existing.at[n].set(False),
+                    tmpl=state.tmpl.at[n].set(j_choice.astype(jnp.int32)),
+                    tol_idx=state.tol_idx.at[n].set(j_choice.astype(jnp.int32)),
+                    pods=state.pods.at[n].set(1),
+                    allow=state.allow.at[n].set(opened_allow),
+                    out=state.out.at[n].set(opened_out),
+                    defined=state.defined.at[n].set(opened_defined),
+                    tmask=state.tmask.at[n].set(new_tmask),
+                    cap=state.cap.at[n].set(opened_cap),
+                    nopen=state.nopen + 1,
+                    remaining=state.remaining.at[j_choice].add(-max_cap),
+                )
+
+            state = jax.lax.cond(
+                valid & found,
+                apply_found,
+                lambda s: jax.lax.cond(do_open, apply_open, lambda x: x, s),
+                state,
+            )
+            assigned = jnp.where(do_assign, slot, jnp.int32(-1))
+            return state, assigned
+
+        state, assigned = jax.lax.scan(step, state, jnp.arange(P, dtype=jnp.int32))
+        return state, assigned
+
+    return pack

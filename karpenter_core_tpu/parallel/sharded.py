@@ -1,0 +1,195 @@
+"""Multi-chip sharded solve over a jax.sharding.Mesh.
+
+Scaling design (the "DP/TP" of this framework — SURVEY.md section 2.7):
+  - 'dp'  : the POD axis is sharded across devices — each device packs its
+            local pods into its own node-slot budget (independent greedy
+            sub-solves; machines are disjoint by construction, so the merge
+            is a concat). This is how 50k-pod batches ride ICI.
+  - 'tp'  : the INSTANCE-TYPE axis of the feasibility matmuls is sharded;
+            each device computes F over its type columns, then an
+            all_gather over 'tp' reassembles the [P_local, T] row a pod
+            needs for packing. The gather rides ICI (XLA collective), not
+            host memory.
+
+Provisioner limits are coordinated pessimistically: the remaining-resource
+budget is pre-split evenly across 'dp' shards (a conservative under-
+approximation of the reference's global subtract_max accounting,
+scheduler.go:276-293).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+def make_sharded_solve(snap, provisioners, mesh, max_nodes_per_shard: int = 256):
+    """Build (fn, args) where fn is a jit-compiled shard_map program over
+    `mesh` (axes 'dp' and 'tp') and args are the host arrays.
+
+    Pod-axis arrays must divide by mesh.shape['dp']; type-axis arrays by
+    mesh.shape['tp'] (the caller pads — see pad_snapshot_for_mesh).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from karpenter_core_tpu.ops.feasibility import feasibility_static, openable_mask
+    from karpenter_core_tpu.ops.pack import PackState, make_pack_kernel
+    from karpenter_core_tpu.solver.tpu_solver import device_args, solve_geometry
+
+    geom = solve_geometry(snap, max_nodes_per_shard)
+    _, J, T, E, R, K, V, _, segments_t, zone_seg, ct_seg = geom
+    assert E == 0, "sharded solve packs new machines only (existing nodes are host-side)"
+    segments = list(segments_t)
+    ndp = mesh.shape["dp"]
+    ntp = mesh.shape["tp"]
+    N = max_nodes_per_shard
+    pack = make_pack_kernel(segments, zone_seg, ct_seg)
+
+    def body(pod_arrays, tmpl, tmpl_daemon, tmpl_type_mask_l, types_l,
+             type_offering_ok_l, types_full, type_alloc, type_capacity,
+             type_offering_ok, pod_tol_all, well_known, remaining0):
+        # ---- type-sharded feasibility + all_gather over 'tp' -------------
+        f_local = feasibility_static(
+            {k: pod_arrays[k] for k in ("allow", "out", "defined", "escape")},
+            tmpl,
+            types_l,
+            pod_arrays["tol_tmpl"],
+            tmpl_type_mask_l,
+            type_offering_ok_l,
+            zone_seg,
+            ct_seg,
+            segments,
+            well_known,
+        )  # [J, P_local, T_local]
+        f_static = jax.lax.all_gather(f_local, "tp", axis=3, tiled=False)
+        # [J, P_local, ntp, T_local] -> [J, P_local, T]
+        f_static = jnp.moveaxis(f_static, 3, 2).reshape(
+            f_local.shape[0], f_local.shape[1], -1
+        )
+
+        openable = openable_mask(
+            f_static, pod_arrays["requests"], tmpl_daemon, type_alloc
+        )
+        state = PackState(
+            used=jnp.zeros((N, R), jnp.float32),
+            open=jnp.zeros(N, bool),
+            is_existing=jnp.zeros(N, bool),
+            tmpl=jnp.zeros(N, jnp.int32),
+            tol_idx=jnp.zeros(N, jnp.int32),
+            pods=jnp.zeros(N, jnp.int32),
+            allow=jnp.ones((N, V), bool),
+            out=jnp.ones((N, K), bool),
+            defined=jnp.zeros((N, K), bool),
+            tmask=jnp.zeros((N, T), bool),
+            cap=jnp.zeros((N, R), jnp.float32),
+            nopen=jnp.int32(0),
+            # pessimistic even split of provisioner limits across dp shards
+            remaining=remaining0 / ndp,
+        )
+        pod_arrays = dict(pod_arrays)
+        pod_arrays["tol"] = pod_tol_all
+        tmpl_type_mask = jax.lax.all_gather(tmpl_type_mask_l, "tp", axis=2, tiled=False)
+        tmpl_type_mask = jnp.moveaxis(tmpl_type_mask, 2, 1).reshape(J, -1)
+        state, assigned = pack(
+            state,
+            pod_arrays,
+            f_static,
+            openable,
+            {k: tmpl[k] for k in ("allow", "out", "defined")},
+            tmpl_daemon,
+            tmpl_type_mask,
+            types_full,
+            type_alloc,
+            type_capacity,
+            type_offering_ok,
+        )
+        # global stats via psum over dp: pods scheduled (an ICI collective)
+        scheduled = jax.lax.psum((assigned >= 0).sum(), "dp")
+        # rank-0 per-shard values need a singleton axis to concatenate over dp
+        state = state._replace(nopen=state.nopen[None])
+        return assigned, state, scheduled
+
+    pod_spec = {
+        "allow": P("dp", None),
+        "out": P("dp", None),
+        "defined": P("dp", None),
+        "escape": P("dp", None),
+        "custom_deny": P("dp", None),
+        "requests": P("dp", None),
+        "tol_tmpl": P("dp", None),
+        "valid": P("dp"),
+    }
+    reqset_rep = {k: P(None, None) for k in ("allow", "out", "defined", "escape")}
+    reqset_tp = {k: P("tp", None) for k in ("allow", "out", "defined", "escape")}
+    in_specs = (
+        pod_spec,  # pod_arrays
+        reqset_rep,  # tmpl
+        P(None, None),  # tmpl_daemon
+        P(None, "tp"),  # tmpl_type_mask_l
+        reqset_tp,  # types_l
+        P("tp", None, None),  # type_offering_ok_l
+        reqset_rep,  # types_full (replicated for packing)
+        P(None, None),  # type_alloc
+        P(None, None),  # type_capacity
+        P(None, None, None),  # type_offering_ok
+        P("dp", None),  # pod_tol_all
+        P(None),  # well_known
+        P(None, None),  # remaining0
+    )
+    out_specs = (
+        P("dp"),  # assigned
+        PackState(
+            used=P("dp", None),
+            open=P("dp"),
+            is_existing=P("dp"),
+            tmpl=P("dp"),
+            tol_idx=P("dp"),
+            pods=P("dp"),
+            allow=P("dp", None),
+            out=P("dp", None),
+            defined=P("dp", None),
+            tmask=P("dp", None),
+            cap=P("dp", None),
+            nopen=P("dp"),
+            remaining=P("dp", None),
+        ),
+        P(),  # scheduled count (replicated)
+    )
+
+    sharded = jax.shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                            check_vma=False)
+    fn = jax.jit(sharded)
+
+    base_args = device_args(snap, provisioners)
+    (pod_arrays, tmpl, tmpl_daemon, tmpl_type_mask, types, type_alloc,
+     type_capacity, type_offering_ok, pod_tol_all, _exist, _eu, _ec,
+     well_known, remaining0) = base_args
+    args = (
+        pod_arrays,
+        tmpl,
+        tmpl_daemon,
+        tmpl_type_mask,
+        types,
+        type_offering_ok,
+        types,
+        type_alloc,
+        type_capacity,
+        type_offering_ok,
+        pod_tol_all,
+        well_known,
+        remaining0,
+    )
+    return fn, args
+
+
+def pad_pods(pods: List, multiple: int) -> List:
+    """Pad the pod list to a multiple with filler pods marked invalid at
+    encode time (they request an impossible amount, so they never schedule).
+    Sharding requires equal-size shards; the valid mask excludes fillers."""
+    from karpenter_core_tpu.testing import make_pod
+
+    short = (-len(pods)) % multiple
+    return pods + [make_pod(requests={"cpu": "1e18"}) for _ in range(short)]

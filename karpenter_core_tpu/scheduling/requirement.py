@@ -127,6 +127,19 @@ class Requirement:
     def insert(self, *items: str) -> None:
         self.values.update(items)
 
+    def to_node_selector_requirement(self):
+        """Recover the v1.NodeSelectorRequirement form (requirement.go:70-113)."""
+        from karpenter_core_tpu.kube.objects import NodeSelectorRequirement
+
+        if self.greater_than is not None:
+            return NodeSelectorRequirement(self.key, OP_GT, [str(self.greater_than)])
+        if self.less_than is not None:
+            return NodeSelectorRequirement(self.key, OP_LT, [str(self.less_than)])
+        op = self.operator()
+        if op in (OP_IN, OP_NOT_IN):
+            return NodeSelectorRequirement(self.key, op, self.values_list())
+        return NodeSelectorRequirement(self.key, op, [])
+
     def operator(self) -> str:
         """Recovered NodeSelector operator (requirement.go:186-197)."""
         if self.complement:

@@ -21,7 +21,6 @@ def _union(a: Volumes, b: Volumes) -> Volumes:
     return out
 
 
-@dataclass
 class VolumeCount(dict):
     """driver -> count; exceeds() compares against CSINode limits
     (volumeusage.go:102-131)."""

@@ -1,0 +1,390 @@
+"""Snapshot → dense tensor encoding for the TPU solver.
+
+The reference evaluates the constraint algebra object-by-object inside the
+serial Solve loop (scheduler.go:96-133, machine.go:137-159). Here the whole
+snapshot is lowered ONCE into dense arrays over a closed label dictionary, so
+pod×instance-type feasibility and packing run as tensor kernels on the MXU.
+
+Key encoding idea: every Requirement becomes
+  - allow[V]   : for each dictionary value of its key, requirement.has(value)
+                 (evaluates In/NotIn/Exists/DoesNotExist/Gt/Lt uniformly,
+                 including integer bounds — the host oracle IS the encoder)
+  - out[K]     : complement flag — values OUTSIDE the dictionary allowed
+  - defined[K] : key constrained at all
+  - escape[K]  : operator ∈ {NotIn, DoesNotExist} (the Intersects/Compatible
+                 escape hatch, requirements.go:195-201)
+Because concrete In-sets are dictionary-closed by construction, set
+intersection nonemptiness is exactly
+  (outA & outB) | any_v(allowA[v] & allowB[v])                (within one key)
+which vectorizes to segment matmuls.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+
+from karpenter_core_tpu.api import labels as api_labels
+from karpenter_core_tpu.api.provisioner import Provisioner
+from karpenter_core_tpu.cloudprovider.types import InstanceType
+from karpenter_core_tpu.controllers.provisioning.scheduling.machine import MachineTemplate
+from karpenter_core_tpu.kube.objects import (
+    LABEL_HOSTNAME,
+    LABEL_TOPOLOGY_ZONE,
+    Pod,
+    ResourceList,
+    Taint,
+)
+from karpenter_core_tpu.scheduling import taints as taints_mod
+from karpenter_core_tpu.scheduling.requirement import (
+    OP_DOES_NOT_EXIST,
+    OP_NOT_IN,
+    Requirement,
+)
+from karpenter_core_tpu.scheduling.requirements import Requirements
+from karpenter_core_tpu.utils import resources as resources_util
+
+# resource axis order: fixed core resources then discovered extended ones
+CORE_RESOURCES = ["cpu", "memory", "pods", "ephemeral-storage"]
+
+
+class LabelDictionary:
+    """Closed (key, value) universe: every value any requirement or node label
+    mentions. Flat value axis V with per-key contiguous segments."""
+
+    def __init__(self):
+        self.keys: List[str] = []
+        self.key_index: Dict[str, int] = {}
+        self._values: List[Dict[str, int]] = []  # per key: value -> local idx
+
+    def add_key(self, key: str) -> int:
+        if key not in self.key_index:
+            self.key_index[key] = len(self.keys)
+            self.keys.append(key)
+            self._values.append({})
+        return self.key_index[key]
+
+    def add_value(self, key: str, value: str) -> None:
+        k = self.add_key(key)
+        vals = self._values[k]
+        if value not in vals:
+            vals[value] = len(vals)
+
+    def freeze(self) -> None:
+        """Assign flat offsets."""
+        self.offsets = np.zeros(len(self.keys) + 1, dtype=np.int32)
+        for k in range(len(self.keys)):
+            self.offsets[k + 1] = self.offsets[k] + len(self._values[k])
+        self.V = int(self.offsets[-1])
+        self.K = len(self.keys)
+        self.key_of_value = np.zeros(self.V, dtype=np.int32)
+        for k in range(self.K):
+            self.key_of_value[self.offsets[k] : self.offsets[k + 1]] = k
+
+    def flat_index(self, key: str, value: str) -> Optional[int]:
+        k = self.key_index.get(key)
+        if k is None:
+            return None
+        local = self._values[k].get(value)
+        if local is None:
+            return None
+        return int(self.offsets[k]) + local
+
+    def values_of(self, key: str) -> List[str]:
+        k = self.key_index.get(key)
+        if k is None:
+            return []
+        return [v for v, _ in sorted(self._values[k].items(), key=lambda kv: kv[1])]
+
+    def segment(self, key: str) -> Tuple[int, int]:
+        k = self.key_index[key]
+        return int(self.offsets[k]), int(self.offsets[k + 1])
+
+
+@dataclass
+class ReqSetArrays:
+    """Dense form of a batch of Requirements (one row each)."""
+
+    allow: np.ndarray  # [N, V] bool
+    out: np.ndarray  # [N, K] bool — complement: outside-dictionary allowed
+    defined: np.ndarray  # [N, K] bool
+    escape: np.ndarray  # [N, K] bool — operator in {NotIn, DoesNotExist}
+
+
+def _collect_requirement_values(reqs: Requirements, dictionary: LabelDictionary) -> None:
+    for key, r in reqs.items():
+        dictionary.add_key(key)
+        for v in r.values:
+            dictionary.add_value(key, v)
+
+
+def encode_reqsets(
+    req_list: Sequence[Requirements], dictionary: LabelDictionary
+) -> ReqSetArrays:
+    n = len(req_list)
+    allow = np.zeros((n, dictionary.V), dtype=bool)
+    out = np.zeros((n, dictionary.K), dtype=bool)
+    defined = np.zeros((n, dictionary.K), dtype=bool)
+    escape = np.zeros((n, dictionary.K), dtype=bool)
+    # undefined keys read as Exists: allow everything incl. outside
+    allow[:] = True
+    out[:] = True
+    for i, reqs in enumerate(req_list):
+        for key, r in reqs.items():
+            k = dictionary.key_index.get(key)
+            if k is None:
+                continue
+            lo, hi = dictionary.segment(key)
+            vals = dictionary.values_of(key)
+            allow[i, lo:hi] = [r.has(v) for v in vals]
+            out[i, k] = r.complement
+            defined[i, k] = True
+            escape[i, k] = r.operator() in (OP_NOT_IN, OP_DOES_NOT_EXIST)
+    return ReqSetArrays(allow=allow, out=out, defined=defined, escape=escape)
+
+
+@dataclass
+class EncodedSnapshot:
+    """Everything the device kernels need, as numpy arrays (moved to device by
+    the solver). Axes: P pods, T instance types, J templates, K keys, V flat
+    values, R resources, Q distinct taints, Z zones, C capacity types."""
+
+    dictionary: LabelDictionary
+    resource_names: List[str]
+
+    # pods
+    pod_reqs: ReqSetArrays  # [P, ...]
+    pod_requests: np.ndarray  # [P, R] float32 (incl. pods=1)
+    pod_tol: np.ndarray  # [P, J] bool — tolerates template j's taints
+
+    # templates (one per provisioner, weight-ordered)
+    tmpl_reqs: ReqSetArrays  # [J, ...]
+    tmpl_daemon: np.ndarray  # [J, R] float32 daemon overhead
+    tmpl_type_mask: np.ndarray  # [J, T] bool — types offered by provisioner j
+
+    # instance types (deduped global list)
+    type_reqs: ReqSetArrays  # [T, ...]
+    type_alloc: np.ndarray  # [T, R] float32 allocatable
+    type_capacity: np.ndarray  # [T, R] float32
+    type_offering_ok: np.ndarray  # [T, Z, C] bool (available)
+    type_offering_price: np.ndarray  # [T, Z, C] float32 (inf when unavailable)
+    type_min_price: np.ndarray  # [T] float32 cheapest available offering
+
+    # label geometry
+    well_known: np.ndarray  # [K] bool
+    zone_seg: Tuple[int, int]
+    ct_seg: Tuple[int, int]
+
+    # existing nodes (pre-seeded slots [0, E))
+    exist_reqs: ReqSetArrays = None  # [E, ...] label requirements
+    exist_used: np.ndarray = None  # [E, R] remaining daemon overhead
+    exist_cap: np.ndarray = None  # [E, R] available()
+    pod_tol_exist: np.ndarray = None  # [P, E]
+
+    # host-side back-references for decode
+    instance_types: List[InstanceType] = field(default_factory=list)
+    templates: List[MachineTemplate] = field(default_factory=list)
+    pods: List[Pod] = field(default_factory=list)
+    state_nodes: List = field(default_factory=list)
+    pod_order: np.ndarray = None  # FFD order applied to pod axis
+
+
+def encode_snapshot(
+    pods: List[Pod],
+    provisioners: List[Provisioner],
+    instance_types: Dict[str, List[InstanceType]],
+    daemonset_pods: Optional[List[Pod]] = None,
+    state_nodes: Optional[List] = None,
+) -> EncodedSnapshot:
+    """Lower a provisioning snapshot to tensors.
+
+    Pods are sorted FFD (cpu desc, mem desc — queue.go:74-110) so the packing
+    scan consumes them in reference order.
+    """
+    from karpenter_core_tpu.api.provisioner import order_by_weight
+    from karpenter_core_tpu.controllers.provisioning.scheduling.queue import ffd_sort_key
+
+    daemonset_pods = daemonset_pods or []
+    # only nodes launched by us participate (scheduler.go:226-229)
+    state_nodes = [n for n in (state_nodes or []) if n.owned()]
+    provisioners = [
+        p for p in order_by_weight(provisioners) if p.metadata.deletion_timestamp is None
+    ]
+    templates = [MachineTemplate(p) for p in provisioners]
+
+    # global dedup of instance types by object identity
+    all_types: List[InstanceType] = []
+    type_ids: Dict[int, int] = {}
+    tmpl_type_mask_rows = []
+    for p in provisioners:
+        row: Set[int] = set()
+        for it in instance_types.get(p.name, []):
+            tid = type_ids.get(id(it))
+            if tid is None:
+                tid = len(all_types)
+                type_ids[id(it)] = tid
+                all_types.append(it)
+            row.add(tid)
+        tmpl_type_mask_rows.append(row)
+
+    order = np.array(
+        sorted(range(len(pods)), key=lambda i: ffd_sort_key(pods[i])), dtype=np.int32
+    )
+    pods_sorted = [pods[i] for i in order]
+
+    pod_reqs_list = [Requirements.from_pod(p) for p in pods_sorted]
+    tmpl_reqs_list = [t.requirements for t in templates]
+    type_reqs_list = [it.requirements for it in all_types]
+    exist_reqs_list = []
+    for node in state_nodes:
+        reqs = Requirements.from_labels(node.labels())
+        reqs.add(Requirement(LABEL_HOSTNAME, "In", [node.hostname()]))
+        exist_reqs_list.append(reqs)
+
+    # -- dictionary closure ------------------------------------------------
+    dictionary = LabelDictionary()
+    for reqs in pod_reqs_list + tmpl_reqs_list + type_reqs_list + exist_reqs_list:
+        _collect_requirement_values(reqs, dictionary)
+    # zone/capacity-type always present for offering logic
+    dictionary.add_key(LABEL_TOPOLOGY_ZONE)
+    dictionary.add_key(api_labels.LABEL_CAPACITY_TYPE)
+    for it in all_types:
+        for o in it.offerings:
+            dictionary.add_value(LABEL_TOPOLOGY_ZONE, o.zone)
+            dictionary.add_value(api_labels.LABEL_CAPACITY_TYPE, o.capacity_type)
+    dictionary.freeze()
+
+    # -- resources ---------------------------------------------------------
+    extended = sorted(
+        set().union(
+            *[set(resources_util.requests_for_pods(p)) for p in pods_sorted] or [set()],
+            *[set(it.allocatable()) for it in all_types] or [set()],
+        )
+        - set(CORE_RESOURCES)
+    )
+    resource_names = CORE_RESOURCES + extended
+    R = len(resource_names)
+    r_index = {r: i for i, r in enumerate(resource_names)}
+
+    def encode_resources(rl: ResourceList) -> np.ndarray:
+        out = np.zeros(R, dtype=np.float32)
+        for name, q in rl.items():
+            if name in r_index:
+                out[r_index[name]] = q
+        return out
+
+    P, J, T, K, V = len(pods_sorted), len(templates), len(all_types), dictionary.K, dictionary.V
+
+    pod_requests = np.stack(
+        [encode_resources(resources_util.requests_for_pods(p)) for p in pods_sorted]
+    ) if P else np.zeros((0, R), np.float32)
+
+    # daemon overhead per template (scheduler.go:253-270)
+    tmpl_daemon = np.zeros((J, R), dtype=np.float32)
+    for j, template in enumerate(templates):
+        daemons = [
+            p
+            for p in daemonset_pods
+            if taints_mod.tolerates(template.taints, p) is None
+            and template.requirements.compatible(Requirements.from_pod(p)) is None
+        ]
+        tmpl_daemon[j] = encode_resources(
+            resources_util.requests_for_pods(*daemons) if daemons else {"pods": 0.0}
+        )
+
+    tmpl_type_mask = np.zeros((J, T), dtype=bool)
+    for j, row in enumerate(tmpl_type_mask_rows):
+        for tid in row:
+            tmpl_type_mask[j, tid] = True
+
+    type_alloc = np.stack([encode_resources(it.allocatable()) for it in all_types]) if T else np.zeros((0, R), np.float32)
+    type_capacity = np.stack([encode_resources(it.capacity) for it in all_types]) if T else np.zeros((0, R), np.float32)
+
+    # -- offerings ---------------------------------------------------------
+    zlo, zhi = dictionary.segment(LABEL_TOPOLOGY_ZONE)
+    clo, chi = dictionary.segment(api_labels.LABEL_CAPACITY_TYPE)
+    Z, C = zhi - zlo, chi - clo
+    zones = dictionary.values_of(LABEL_TOPOLOGY_ZONE)
+    cts = dictionary.values_of(api_labels.LABEL_CAPACITY_TYPE)
+    z_index = {z: i for i, z in enumerate(zones)}
+    c_index = {c: i for i, c in enumerate(cts)}
+    type_offering_ok = np.zeros((T, Z, C), dtype=bool)
+    type_offering_price = np.full((T, Z, C), np.inf, dtype=np.float32)
+    for t, it in enumerate(all_types):
+        for o in it.offerings:
+            if not o.available:
+                continue
+            zi, ci = z_index.get(o.zone), c_index.get(o.capacity_type)
+            if zi is None or ci is None:
+                continue
+            type_offering_ok[t, zi, ci] = True
+            type_offering_price[t, zi, ci] = min(type_offering_price[t, zi, ci], o.price)
+    type_min_price = np.where(
+        type_offering_ok.any(axis=(1, 2)),
+        np.min(type_offering_price, axis=(1, 2)),
+        np.inf,
+    ).astype(np.float32)
+
+    # -- taints ------------------------------------------------------------
+    pod_tol = np.zeros((P, J), dtype=bool)
+    for j, template in enumerate(templates):
+        for i, p in enumerate(pods_sorted):
+            pod_tol[i, j] = taints_mod.tolerates(template.taints, p) is None
+
+    well_known = np.array(
+        [k in api_labels.WELL_KNOWN_LABELS or k == LABEL_HOSTNAME for k in dictionary.keys],
+        dtype=bool,
+    )
+
+    # -- existing nodes ----------------------------------------------------
+    E = len(state_nodes)
+    exist_used = np.zeros((E, R), dtype=np.float32)
+    exist_cap = np.zeros((E, R), dtype=np.float32)
+    pod_tol_exist = np.zeros((P, E), dtype=bool)
+    for e, node in enumerate(state_nodes):
+        node_taints = node.taints()
+        # daemons that would schedule to this node (scheduler.go:231-240)
+        daemons = [
+            p
+            for p in daemonset_pods
+            if taints_mod.tolerates(node_taints, p) is None
+            and Requirements.from_labels(node.labels()).compatible(Requirements.from_pod(p))
+            is None
+        ]
+        daemon_req = resources_util.requests_for_pods(*daemons) if daemons else {"pods": 0.0}
+        remaining = resources_util.subtract(daemon_req, node.total_daemonset_requests())
+        remaining = {k: max(v, 0.0) for k, v in remaining.items()}
+        exist_used[e] = encode_resources(remaining)
+        exist_cap[e] = encode_resources(node.available())
+        for i, p in enumerate(pods_sorted):
+            pod_tol_exist[i, e] = taints_mod.tolerates(node_taints, p) is None
+
+    return EncodedSnapshot(
+        dictionary=dictionary,
+        resource_names=resource_names,
+        pod_reqs=encode_reqsets(pod_reqs_list, dictionary),
+        pod_requests=pod_requests,
+        pod_tol=pod_tol,
+        tmpl_reqs=encode_reqsets(tmpl_reqs_list, dictionary),
+        tmpl_daemon=tmpl_daemon,
+        tmpl_type_mask=tmpl_type_mask,
+        type_reqs=encode_reqsets(type_reqs_list, dictionary),
+        type_alloc=type_alloc,
+        type_capacity=type_capacity,
+        type_offering_ok=type_offering_ok,
+        type_offering_price=type_offering_price,
+        type_min_price=type_min_price,
+        well_known=well_known,
+        zone_seg=(zlo, zhi),
+        ct_seg=(clo, chi),
+        exist_reqs=encode_reqsets(exist_reqs_list, dictionary),
+        exist_used=exist_used,
+        exist_cap=exist_cap,
+        pod_tol_exist=pod_tol_exist,
+        instance_types=all_types,
+        templates=templates,
+        pods=pods_sorted,
+        state_nodes=state_nodes,
+        pod_order=order,
+    )

@@ -1,0 +1,364 @@
+"""TPUSolver — the tensor execution backend for Scheduler.Solve.
+
+Pipeline per solve:
+  1. encode_snapshot: objects -> dense arrays (host, numpy)
+  2. feasibility_static + pack kernels under jit (device)
+  3. decode: slot assignments -> SolvedMachine / existing-node placements
+  4. host-side relaxation rounds for failed pods (preferences.go order), each
+     followed by a fresh device solve — replaces the reference's per-pod
+     relax-and-requeue (scheduler.go:114-123) with <= max_relax_rounds full
+     re-solves, which is cheap because a solve is one fused device program.
+
+The Solver interface (solve(pods, ...) -> SolveResult) is what the
+provisioning controller calls; GreedySolver (host path) and TPUSolver are
+interchangeable, and the gRPC service (solver/service.py) exposes the same
+boundary out-of-process.
+"""
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from karpenter_core_tpu.api.provisioner import Provisioner
+from karpenter_core_tpu.cloudprovider.types import InstanceType
+from karpenter_core_tpu.controllers.provisioning.scheduling.machine import MachineTemplate
+from karpenter_core_tpu.controllers.provisioning.scheduling.preferences import Preferences
+from karpenter_core_tpu.kube.objects import Pod, ResourceList
+from karpenter_core_tpu.scheduling.requirements import Requirements
+from karpenter_core_tpu.solver.encode import EncodedSnapshot, ReqSetArrays, encode_snapshot
+from karpenter_core_tpu.utils import resources as resources_util
+
+
+@dataclass
+class SolvedMachine:
+    """A new node computed by the solver (analog of scheduling.Machine after
+    FinalizeScheduling)."""
+
+    provisioner_name: str
+    template: MachineTemplate
+    pods: List[Pod]
+    instance_type_options: List[InstanceType]
+    requests: ResourceList
+    requirements: Requirements
+
+
+@dataclass
+class SolveResult:
+    new_machines: List[SolvedMachine] = field(default_factory=list)
+    existing_assignments: List[Tuple[object, List[Pod]]] = field(default_factory=list)
+    failed_pods: List[Pod] = field(default_factory=list)
+    rounds: int = 1
+
+    def pod_count_new(self) -> int:
+        return sum(len(m.pods) for m in self.new_machines)
+
+    def pod_count_existing(self) -> int:
+        return sum(len(p) for _, p in self.existing_assignments)
+
+
+def _reqset_to_dict(rs: ReqSetArrays) -> Dict[str, np.ndarray]:
+    return {"allow": rs.allow, "out": rs.out, "defined": rs.defined, "escape": rs.escape}
+
+
+def solve_geometry(snap: EncodedSnapshot, max_nodes: int):
+    dictionary = snap.dictionary
+    segments = [dictionary.segment(k) for k in dictionary.keys]
+    P = len(snap.pods)
+    J = len(snap.templates)
+    T = len(snap.instance_types)
+    E = len(snap.state_nodes)
+    R = len(snap.resource_names)
+    K, V = dictionary.K, dictionary.V
+    N = E + min(max_nodes, max(P, 1))
+    return (P, J, T, E, R, K, V, N, tuple(segments), snap.zone_seg, snap.ct_seg)
+
+
+def build_device_solve(snap: EncodedSnapshot, max_nodes: int = 1024):
+    """Returns (geometry_key, run_fn). run_fn is a pure jittable function of
+    the device arrays produced by device_args(snap) — the whole Solve() as
+    ONE device program: feasibility + openable + packing scan."""
+    import jax.numpy as jnp
+
+    from karpenter_core_tpu.ops.feasibility import feasibility_static, openable_mask
+    from karpenter_core_tpu.ops.pack import PackState, make_pack_kernel
+
+    geom = solve_geometry(snap, max_nodes)
+    P, J, T, E, R, K, V, N, segments_t, zone_seg, ct_seg = geom
+    segments = list(segments_t)
+    pack = make_pack_kernel(segments, zone_seg, ct_seg)
+
+    def run(pod_arrays, tmpl, tmpl_daemon, tmpl_type_mask, types, type_alloc,
+            type_capacity, type_offering_ok, pod_tol_all, exist, exist_used,
+            exist_cap, well_known, remaining0):
+        f_static = feasibility_static(
+            {k: pod_arrays[k] for k in ("allow", "out", "defined", "escape")},
+            tmpl,
+            types,
+            pod_arrays["tol_tmpl"],
+            tmpl_type_mask,
+            type_offering_ok,
+            zone_seg,
+            ct_seg,
+            segments,
+            well_known,
+        )
+        openable = openable_mask(f_static, pod_arrays["requests"], tmpl_daemon, type_alloc)
+        # initial state: existing slots [0, E), machine slots open later
+        state = PackState(
+            used=jnp.zeros((N, R), jnp.float32).at[:E].set(exist_used),
+            open=jnp.arange(N) < E,
+            is_existing=jnp.arange(N) < E,
+            tmpl=jnp.zeros(N, jnp.int32),
+            tol_idx=jnp.concatenate(
+                [J + jnp.arange(E, dtype=jnp.int32), jnp.zeros(N - E, jnp.int32)]
+            ),
+            pods=jnp.zeros(N, jnp.int32),
+            allow=jnp.ones((N, V), bool).at[:E].set(exist["allow"]),
+            out=jnp.ones((N, K), bool).at[:E].set(exist["out"]),
+            defined=jnp.zeros((N, K), bool).at[:E].set(exist["defined"]),
+            tmask=jnp.zeros((N, T), bool),
+            cap=jnp.zeros((N, R), jnp.float32).at[:E].set(exist_cap),
+            nopen=jnp.int32(E),
+            remaining=remaining0,
+        )
+        pod_arrays = dict(pod_arrays)
+        pod_arrays["tol"] = pod_tol_all
+        state, assigned = pack(
+            state,
+            pod_arrays,
+            f_static,
+            openable,
+            {k: tmpl[k] for k in ("allow", "out", "defined")},
+            tmpl_daemon,
+            tmpl_type_mask,
+            types,
+            type_alloc,
+            type_capacity,
+            type_offering_ok,
+        )
+        return assigned, state
+
+    return geom, run
+
+
+def device_args(snap: EncodedSnapshot, provisioners: Optional[List[Provisioner]] = None):
+    """Host arrays (numpy) in run_fn's argument order."""
+    provisioners = provisioners or []
+    P = len(snap.pods)
+    J = len(snap.templates)
+    custom_deny = ~snap.well_known[None, :] & snap.pod_reqs.defined & ~snap.pod_reqs.escape
+    pod_arrays = {
+        "allow": snap.pod_reqs.allow,
+        "out": snap.pod_reqs.out,
+        "defined": snap.pod_reqs.defined,
+        "escape": snap.pod_reqs.escape,
+        "custom_deny": custom_deny,
+        "requests": snap.pod_requests,
+        "tol_tmpl": snap.pod_tol,
+        "valid": np.ones(P, dtype=bool),
+    }
+    pod_tol_all = np.concatenate([snap.pod_tol, snap.pod_tol_exist], axis=1)
+
+    # provisioner limits -> remaining resources [J, R] (scheduler.go:70-75)
+    remaining0 = np.full((J, len(snap.resource_names)), np.float32(1e30))
+    for j, template in enumerate(snap.templates):
+        prov = next((p for p in provisioners if p.name == template.provisioner_name), None)
+        if prov is not None and prov.spec.limits is not None:
+            for r_i, rname in enumerate(snap.resource_names):
+                if rname in prov.spec.limits.resources:
+                    remaining0[j, r_i] = prov.spec.limits.resources[rname]
+    # subtract existing owned capacity (scheduler.go:243-249)
+    from karpenter_core_tpu.api.labels import PROVISIONER_NAME_LABEL_KEY
+
+    for node in snap.state_nodes:
+        pname = node.labels().get(PROVISIONER_NAME_LABEL_KEY, "")
+        for j, template in enumerate(snap.templates):
+            if template.provisioner_name == pname:
+                cap = node.capacity()
+                for r_i, rname in enumerate(snap.resource_names):
+                    if remaining0[j, r_i] < 1e29:
+                        remaining0[j, r_i] -= cap.get(rname, 0.0)
+
+    return (
+        pod_arrays,
+        _reqset_to_dict(snap.tmpl_reqs),
+        snap.tmpl_daemon,
+        snap.tmpl_type_mask,
+        _reqset_to_dict(snap.type_reqs),
+        snap.type_alloc,
+        snap.type_capacity,
+        snap.type_offering_ok,
+        pod_tol_all,
+        _reqset_to_dict(snap.exist_reqs),
+        snap.exist_used,
+        snap.exist_cap,
+        snap.well_known,
+        remaining0,
+    )
+
+
+class TPUSolver:
+    """Stateless dense solver; jit-compiled per label geometry.
+
+    max_nodes bounds the slot budget for NEW machines (existing nodes get
+    their own slots on top). pad_pods rounds the pod axis up to a bucket so
+    repeated solves reuse the compiled program.
+    """
+
+    def __init__(self, max_nodes: int = 1024, max_relax_rounds: int = 3, donate: bool = True):
+        self.max_nodes = max_nodes
+        self.max_relax_rounds = max_relax_rounds
+        self._compiled = {}
+
+    # -- public API --------------------------------------------------------
+
+    def solve(
+        self,
+        pods: List[Pod],
+        provisioners: List[Provisioner],
+        instance_types: Dict[str, List[InstanceType]],
+        daemonset_pods: Optional[List[Pod]] = None,
+        state_nodes: Optional[List] = None,
+    ) -> SolveResult:
+        if not pods:
+            return SolveResult()
+        if not provisioners or not any(instance_types.values()):
+            return SolveResult(failed_pods=list(pods))
+        pods = [copy.deepcopy(p) for p in pods]  # relaxation mutates specs
+        preferences = Preferences(
+            any(
+                t.effect == "PreferNoSchedule"
+                for p in provisioners
+                for t in p.spec.taints
+            )
+        )
+        result = self._solve_once(pods, provisioners, instance_types, daemonset_pods, state_nodes)
+        rounds = 1
+        while result.failed_pods and rounds < self.max_relax_rounds:
+            relaxed_any = False
+            for pod in result.failed_pods:
+                relaxed_any |= preferences.relax(pod)
+            if not relaxed_any:
+                break
+            result = self._solve_once(
+                pods, provisioners, instance_types, daemonset_pods, state_nodes
+            )
+            rounds += 1
+        result.rounds = rounds
+        return result
+
+    # -- internals ---------------------------------------------------------
+
+    def _solve_once(self, pods, provisioners, instance_types, daemonset_pods, state_nodes):
+        snap = encode_snapshot(
+            pods, provisioners, instance_types, daemonset_pods, state_nodes
+        )
+        assigned, state = self._run_kernels(snap, provisioners)
+        return self._decode(snap, assigned, state)
+
+    def _run_kernels(self, snap: EncodedSnapshot, provisioners: List[Provisioner]):
+        import jax
+
+        geom, run = build_device_solve(snap, self.max_nodes)
+        fn = self._compiled.get(geom)
+        if fn is None:
+            fn = jax.jit(run)
+            self._compiled[geom] = fn
+        args = device_args(snap, provisioners)
+        assigned, state = fn(*args)
+        return np.asarray(assigned), jax.tree_util.tree_map(np.asarray, state)
+
+    def _decode(self, snap: EncodedSnapshot, assigned: np.ndarray, state) -> SolveResult:
+        E = len(snap.state_nodes)
+        slot_pods: Dict[int, List[Pod]] = {}
+        failed: List[Pod] = []
+        for i, pod in enumerate(snap.pods):
+            slot = int(assigned[i])
+            if slot < 0:
+                failed.append(pod)
+            else:
+                slot_pods.setdefault(slot, []).append(pod)
+
+        machines: List[SolvedMachine] = []
+        existing: List[Tuple[object, List[Pod]]] = []
+        for slot, pods in sorted(slot_pods.items()):
+            if slot < E:
+                existing.append((snap.state_nodes[slot], pods))
+                continue
+            tmpl_id = int(state.tmpl[slot])
+            template = snap.templates[tmpl_id]
+            tmask = np.asarray(state.tmask[slot])
+            options = [snap.instance_types[t] for t in np.nonzero(tmask)[0]]
+            requirements = Requirements(template.requirements.values())
+            for pod in pods:
+                requirements.add(*Requirements.from_pod(pod).values())
+            requests = dict(
+                zip(snap.resource_names, np.asarray(state.used[slot]).tolist())
+            )
+            requests = {k: v for k, v in requests.items() if v}
+            machines.append(
+                SolvedMachine(
+                    provisioner_name=template.provisioner_name,
+                    template=template,
+                    pods=pods,
+                    instance_type_options=options,
+                    requests=requests,
+                    requirements=requirements,
+                )
+            )
+        return SolveResult(
+            new_machines=machines, existing_assignments=existing, failed_pods=failed
+        )
+
+
+class GreedySolver:
+    """Host fallback implementing the same Solver interface via the Python
+    Scheduler (the reference-semantics path)."""
+
+    def solve(
+        self,
+        pods: List[Pod],
+        provisioners: List[Provisioner],
+        instance_types: Dict[str, List[InstanceType]],
+        daemonset_pods: Optional[List[Pod]] = None,
+        state_nodes: Optional[List] = None,
+        kube_client=None,
+        cluster=None,
+    ) -> SolveResult:
+        from karpenter_core_tpu.controllers.provisioning.scheduling.scheduler import (
+            SchedulerOptions,
+            build_scheduler,
+        )
+
+        pods = [copy.deepcopy(p) for p in pods]
+        scheduler = build_scheduler(
+            kube_client,
+            cluster,
+            provisioners,
+            instance_types,
+            pods,
+            state_nodes=state_nodes,
+            daemonset_pods=daemonset_pods,
+            opts=SchedulerOptions(simulation_mode=True),
+        )
+        res = scheduler.solve(pods)
+        machines = [
+            SolvedMachine(
+                provisioner_name=m.provisioner_name,
+                template=m.template,
+                pods=m.pods,
+                instance_type_options=m.instance_type_options,
+                requests=m.requests,
+                requirements=m.requirements,
+            )
+            for m in res.new_machines
+            if m.pods
+        ]
+        existing = [(n.state_node, n.pods) for n in res.existing_nodes if n.pods]
+        return SolveResult(
+            new_machines=machines, existing_assignments=existing, failed_pods=res.failed_pods
+        )

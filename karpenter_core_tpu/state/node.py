@@ -1,0 +1,226 @@
+"""StateNode — merged view of a v1.Node and its Machine (pre-registration).
+
+Mirrors reference pkg/controllers/state/node.go:60-334: labels/taints/capacity
+resolve from the Machine until the node is initialized; ephemeral taints
+(not-ready/unreachable + startup taints) are masked while uninitialized;
+per-pod requests/limits with the daemonset split; nomination window.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+from karpenter_core_tpu.api import labels as api_labels
+from karpenter_core_tpu.api.machine import CONDITION_MACHINE_INITIALIZED, Machine
+from karpenter_core_tpu.api.settings import Settings
+from karpenter_core_tpu.kube.objects import (
+    LABEL_HOSTNAME,
+    NamespacedName,
+    Node,
+    Pod,
+    ResourceList,
+    TAINT_NODE_NOT_READY,
+    TAINT_NODE_UNREACHABLE,
+    Taint,
+    object_key,
+)
+from karpenter_core_tpu.scheduling.hostportusage import HostPortUsage
+from karpenter_core_tpu.scheduling.volumeusage import VolumeCount, VolumeUsage
+from karpenter_core_tpu.utils import podutils, resources
+
+
+class StateNode:
+    """state/node.go:60-106."""
+
+    def __init__(self, node: Optional[Node] = None, machine: Optional[Machine] = None):
+        self.node = node
+        self.machine = machine
+        self.inflight_allocatable: ResourceList = {}
+        self.inflight_capacity: ResourceList = {}
+        self.startup_taints: List[Taint] = []
+        self.daemonset_requests: Dict[NamespacedName, ResourceList] = {}
+        self.daemonset_limits: Dict[NamespacedName, ResourceList] = {}
+        self.pod_requests: Dict[NamespacedName, ResourceList] = {}
+        self.pod_limits: Dict[NamespacedName, ResourceList] = {}
+        self.hostport_usage = HostPortUsage()
+        self.volume_usage = VolumeUsage()
+        self.volume_limits = VolumeCount()
+        self.marked_for_deletion = False
+        self.nominated_until = 0.0
+
+    # -- identity ---------------------------------------------------------
+
+    def name(self) -> str:
+        if not self.initialized() and self.machine is not None:
+            return self.machine.name
+        return self.node.name if self.node else ""
+
+    def hostname(self) -> str:
+        return self.labels().get(LABEL_HOSTNAME) or self.name()
+
+    def provider_id(self) -> str:
+        if self.node is not None and self.node.spec.provider_id:
+            return self.node.spec.provider_id
+        if self.machine is not None:
+            return self.machine.status.provider_id
+        return ""
+
+    def labels(self) -> Dict[str, str]:
+        if not self.initialized() and self.machine is not None:
+            return self.machine.metadata.labels
+        return self.node.metadata.labels if self.node else {}
+
+    def annotations(self) -> Dict[str, str]:
+        if not self.initialized() and self.machine is not None:
+            return self.machine.metadata.annotations
+        return self.node.metadata.annotations if self.node else {}
+
+    # -- lifecycle --------------------------------------------------------
+
+    def initialized(self) -> bool:
+        """node.go:181-192."""
+        if self.machine is not None:
+            return self.node is not None and self.machine.condition_true(
+                CONDITION_MACHINE_INITIALIZED
+            )
+        if self.node is not None:
+            return self.node.metadata.labels.get(api_labels.LABEL_NODE_INITIALIZED) == "true"
+        return False
+
+    def owned(self) -> bool:
+        return self.labels().get(api_labels.PROVISIONER_NAME_LABEL_KEY, "") != ""
+
+    def is_marked_for_deletion(self) -> bool:
+        return (
+            self.marked_for_deletion
+            or (self.machine is not None and self.machine.metadata.deletion_timestamp is not None)
+            or (
+                self.node is not None
+                and self.machine is None
+                and self.node.metadata.deletion_timestamp is not None
+            )
+        )
+
+    def nominate(self, settings: Optional[Settings] = None) -> None:
+        self.nominated_until = time.time() + nomination_window(settings)
+
+    def nominated(self) -> bool:
+        return self.nominated_until > time.time()
+
+    # -- scheduling views -------------------------------------------------
+
+    def taints(self) -> List[Taint]:
+        """Ephemeral/startup-taint masking (node.go:148-176)."""
+        ephemeral = [
+            Taint(key=TAINT_NODE_NOT_READY, effect="NoSchedule"),
+            Taint(key=TAINT_NODE_UNREACHABLE, effect="NoSchedule"),
+        ]
+        if not self.initialized() and self.owned():
+            if self.machine is not None:
+                ephemeral.extend(self.machine.spec.startup_taints)
+            else:
+                ephemeral.extend(self.startup_taints)
+        if not self.initialized() and self.machine is not None:
+            taints = self.machine.spec.taints
+        else:
+            taints = self.node.spec.taints if self.node else []
+        return [
+            t
+            for t in taints
+            if not any(
+                e.key == t.key and e.value == t.value and e.effect == t.effect for e in ephemeral
+            )
+        ]
+
+    def capacity(self) -> ResourceList:
+        """node.go:194-221 — machine/inflight values backfill zero node values."""
+        return self._capacity_like(
+            node_view=lambda n: n.status.capacity,
+            machine_view=lambda m: m.status.capacity,
+            inflight=self.inflight_capacity,
+        )
+
+    def allocatable(self) -> ResourceList:
+        return self._capacity_like(
+            node_view=lambda n: n.status.allocatable,
+            machine_view=lambda m: m.status.allocatable,
+            inflight=self.inflight_allocatable,
+        )
+
+    def _capacity_like(self, node_view, machine_view, inflight) -> ResourceList:
+        if not self.initialized() and self.machine is not None:
+            if self.node is not None:
+                ret = dict(node_view(self.node))
+                for name, q in machine_view(self.machine).items():
+                    if not ret.get(name):
+                        ret[name] = q
+                return ret
+            return dict(machine_view(self.machine))
+        if not self.initialized() and self.owned() and self.node is not None:
+            ret = dict(node_view(self.node))
+            for name, q in inflight.items():
+                if not ret.get(name):
+                    ret[name] = q
+            return ret
+        return dict(node_view(self.node)) if self.node else {}
+
+    def available(self) -> ResourceList:
+        return resources.subtract(self.allocatable(), self.total_pod_requests())
+
+    def total_pod_requests(self) -> ResourceList:
+        return resources.merge(*self.pod_requests.values()) if self.pod_requests else {}
+
+    def total_pod_limits(self) -> ResourceList:
+        return resources.merge(*self.pod_limits.values()) if self.pod_limits else {}
+
+    def total_daemonset_requests(self) -> ResourceList:
+        return resources.merge(*self.daemonset_requests.values()) if self.daemonset_requests else {}
+
+    def total_daemonset_limits(self) -> ResourceList:
+        return resources.merge(*self.daemonset_limits.values()) if self.daemonset_limits else {}
+
+    # -- pod bookkeeping (node.go:293-321) --------------------------------
+
+    def update_for_pod(self, pod: Pod) -> None:
+        key = object_key(pod)
+        self.pod_requests[key] = resources.requests_for_pods(pod)
+        self.pod_limits[key] = resources.limits_for_pods(pod)
+        if podutils.is_owned_by_daemonset(pod):
+            self.daemonset_requests[key] = resources.requests_for_pods(pod)
+            self.daemonset_limits[key] = resources.limits_for_pods(pod)
+        self.hostport_usage.add(pod)
+        self.volume_usage.add(pod)
+
+    def cleanup_for_pod(self, key: NamespacedName) -> None:
+        self.hostport_usage.delete_pod(key)
+        self.volume_usage.delete_pod(key)
+        self.pod_requests.pop(key, None)
+        self.pod_limits.pop(key, None)
+        self.daemonset_requests.pop(key, None)
+        self.daemonset_limits.pop(key, None)
+
+    def deep_copy(self) -> "StateNode":
+        import copy as copy_mod
+
+        out = StateNode(copy_mod.deepcopy(self.node), copy_mod.deepcopy(self.machine))
+        out.inflight_allocatable = dict(self.inflight_allocatable)
+        out.inflight_capacity = dict(self.inflight_capacity)
+        out.startup_taints = list(self.startup_taints)
+        out.daemonset_requests = {k: dict(v) for k, v in self.daemonset_requests.items()}
+        out.daemonset_limits = {k: dict(v) for k, v in self.daemonset_limits.items()}
+        out.pod_requests = {k: dict(v) for k, v in self.pod_requests.items()}
+        out.pod_limits = {k: dict(v) for k, v in self.pod_limits.items()}
+        out.hostport_usage = self.hostport_usage.deep_copy()
+        out.volume_usage = self.volume_usage.deep_copy()
+        out.volume_limits = VolumeCount(self.volume_limits)
+        out.marked_for_deletion = self.marked_for_deletion
+        out.nominated_until = self.nominated_until
+        return out
+
+
+def nomination_window(settings: Optional[Settings] = None) -> float:
+    """max(10s, 2 x batchMaxDuration) — node.go:328-334."""
+    from karpenter_core_tpu.api.settings import current
+
+    s = settings or current()
+    return max(10.0, 2.0 * s.batch_max_duration)

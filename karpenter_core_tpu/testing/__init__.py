@@ -1,0 +1,183 @@
+"""Test object builders — the analog of reference pkg/test (pods.go etc.)."""
+from __future__ import annotations
+
+import itertools
+from typing import Dict, List, Optional
+
+from karpenter_core_tpu.api.provisioner import (
+    Consolidation,
+    Limits,
+    Provisioner,
+    ProvisionerSpec,
+)
+from karpenter_core_tpu.kube.objects import (
+    Affinity,
+    Condition,
+    Container,
+    ContainerPort,
+    LabelSelector,
+    Node,
+    NodeAffinity,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    ObjectMeta,
+    OwnerReference,
+    Pod,
+    PodAffinity,
+    PodAffinityTerm,
+    PodAntiAffinity,
+    PodSpec,
+    ResourceRequirements,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+    WeightedPodAffinityTerm,
+)
+from karpenter_core_tpu.utils.resources import parse_resource_list
+
+_counter = itertools.count(1)
+
+
+def unique_name(prefix: str = "obj") -> str:
+    return f"{prefix}-{next(_counter)}"
+
+
+def make_pod(
+    name: Optional[str] = None,
+    namespace: str = "default",
+    labels: Optional[Dict[str, str]] = None,
+    annotations: Optional[Dict[str, str]] = None,
+    requests: Optional[Dict[str, object]] = None,
+    limits: Optional[Dict[str, object]] = None,
+    node_selector: Optional[Dict[str, str]] = None,
+    node_name: str = "",
+    tolerations: Optional[List[Toleration]] = None,
+    topology_spread: Optional[List[TopologySpreadConstraint]] = None,
+    pod_affinity_required: Optional[List[PodAffinityTerm]] = None,
+    pod_affinity_preferred: Optional[List[WeightedPodAffinityTerm]] = None,
+    pod_anti_affinity_required: Optional[List[PodAffinityTerm]] = None,
+    pod_anti_affinity_preferred: Optional[List[WeightedPodAffinityTerm]] = None,
+    node_affinity_required: Optional[List[NodeSelectorTerm]] = None,
+    node_affinity_preferred=None,
+    host_ports: Optional[List[int]] = None,
+    owner_kind: str = "",
+    phase: str = "Pending",
+    unschedulable: bool = True,
+) -> Pod:
+    """A pending, unschedulable pod by default (marked with the PodScheduled
+    Unschedulable condition like GetPendingPods expects)."""
+    containers = [
+        Container(
+            resources=ResourceRequirements(
+                requests=parse_resource_list(requests or {}),
+                limits=parse_resource_list(limits or {}),
+            ),
+            ports=[ContainerPort(host_port=p) for p in (host_ports or [])],
+        )
+    ]
+    affinity = None
+    if any(
+        [
+            pod_affinity_required,
+            pod_affinity_preferred,
+            pod_anti_affinity_required,
+            pod_anti_affinity_preferred,
+            node_affinity_required,
+            node_affinity_preferred,
+        ]
+    ):
+        affinity = Affinity(
+            node_affinity=NodeAffinity(
+                required=list(node_affinity_required or []),
+                preferred=list(node_affinity_preferred or []),
+            )
+            if (node_affinity_required or node_affinity_preferred)
+            else None,
+            pod_affinity=PodAffinity(
+                required=list(pod_affinity_required or []),
+                preferred=list(pod_affinity_preferred or []),
+            )
+            if (pod_affinity_required or pod_affinity_preferred)
+            else None,
+            pod_anti_affinity=PodAntiAffinity(
+                required=list(pod_anti_affinity_required or []),
+                preferred=list(pod_anti_affinity_preferred or []),
+            )
+            if (pod_anti_affinity_required or pod_anti_affinity_preferred)
+            else None,
+        )
+    pod = Pod(
+        metadata=ObjectMeta(
+            name=name or unique_name("pod"),
+            namespace=namespace,
+            labels=dict(labels or {}),
+            annotations=dict(annotations or {}),
+        ),
+        spec=PodSpec(
+            node_name=node_name,
+            node_selector=dict(node_selector or {}),
+            affinity=affinity,
+            tolerations=list(tolerations or []),
+            containers=containers,
+            topology_spread_constraints=list(topology_spread or []),
+        ),
+    )
+    pod.status.phase = phase
+    if unschedulable and not node_name:
+        pod.status.conditions.append(
+            Condition(type="PodScheduled", status="False", reason="Unschedulable")
+        )
+    if owner_kind:
+        pod.metadata.owner_references.append(OwnerReference(kind=owner_kind, name="owner"))
+    return pod
+
+
+def make_provisioner(
+    name: Optional[str] = None,
+    requirements: Optional[List[NodeSelectorRequirement]] = None,
+    labels: Optional[Dict[str, str]] = None,
+    taints: Optional[List[Taint]] = None,
+    startup_taints: Optional[List[Taint]] = None,
+    limits: Optional[Dict[str, object]] = None,
+    weight: Optional[int] = None,
+    ttl_seconds_after_empty: Optional[int] = None,
+    ttl_seconds_until_expired: Optional[int] = None,
+    consolidation_enabled: Optional[bool] = None,
+) -> Provisioner:
+    spec = ProvisionerSpec(
+        requirements=list(requirements or []),
+        labels=dict(labels or {}),
+        taints=list(taints or []),
+        startup_taints=list(startup_taints or []),
+        weight=weight,
+        ttl_seconds_after_empty=ttl_seconds_after_empty,
+        ttl_seconds_until_expired=ttl_seconds_until_expired,
+    )
+    if limits is not None:
+        spec.limits = Limits(resources=parse_resource_list(limits))
+    if consolidation_enabled is not None:
+        spec.consolidation = Consolidation(enabled=consolidation_enabled)
+    p = Provisioner(metadata=ObjectMeta(name=name or unique_name("provisioner")), spec=spec)
+    p.metadata.namespace = ""
+    return p
+
+
+def make_node(
+    name: Optional[str] = None,
+    labels: Optional[Dict[str, str]] = None,
+    capacity: Optional[Dict[str, object]] = None,
+    allocatable: Optional[Dict[str, object]] = None,
+    taints: Optional[List[Taint]] = None,
+    provider_id: str = "",
+    ready: bool = True,
+) -> Node:
+    node = Node(metadata=ObjectMeta(name=name or unique_name("node"), labels=dict(labels or {})))
+    node.metadata.namespace = ""
+    node.spec.taints = list(taints or [])
+    node.spec.provider_id = provider_id or f"fake:///{node.metadata.name}"
+    node.status.capacity = parse_resource_list(capacity or {})
+    node.status.allocatable = parse_resource_list(allocatable or capacity or {})
+    node.status.conditions.append(
+        Condition(type="Ready", status="True" if ready else "False")
+    )
+    return node

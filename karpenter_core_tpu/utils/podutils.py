@@ -1,0 +1,78 @@
+"""Pod predicate helpers (reference pkg/utils/pod/scheduling.go)."""
+from __future__ import annotations
+
+from karpenter_core_tpu.kube.objects import Pod
+
+
+def is_scheduled(pod: Pod) -> bool:
+    return pod.spec.node_name != ""
+
+
+def is_terminal(pod: Pod) -> bool:
+    return pod.status.phase in ("Succeeded", "Failed")
+
+
+def is_terminating(pod: Pod) -> bool:
+    return pod.metadata.deletion_timestamp is not None
+
+
+def is_owned_by_daemonset(pod: Pod) -> bool:
+    return any(o.kind == "DaemonSet" for o in pod.metadata.owner_references)
+
+
+def is_owned_by_node(pod: Pod) -> bool:
+    return any(o.kind == "Node" for o in pod.metadata.owner_references)
+
+
+def failed_to_schedule(pod: Pod) -> bool:
+    """PodScheduled condition False with reason Unschedulable."""
+    for cond in pod.status.conditions:
+        if cond.type == "PodScheduled" and cond.status == "False" and cond.reason == "Unschedulable":
+            return True
+    return False
+
+
+def is_provisionable(pod: Pod) -> bool:
+    """The pod needs a new node (pod/scheduling.go IsProvisionable)."""
+    return (
+        not is_scheduled(pod)
+        and not is_terminal(pod)
+        and not is_terminating(pod)
+        and failed_to_schedule(pod)
+        and not is_owned_by_daemonset(pod)
+        and not is_owned_by_node(pod)
+    )
+
+
+def has_pod_anti_affinity(pod: Pod) -> bool:
+    """True if the pod has any required pod anti-affinity term."""
+    return (
+        pod.spec.affinity is not None
+        and pod.spec.affinity.pod_anti_affinity is not None
+        and len(pod.spec.affinity.pod_anti_affinity.required) > 0
+    )
+
+
+def has_required_pod_affinity(pod: Pod) -> bool:
+    return (
+        pod.spec.affinity is not None
+        and pod.spec.affinity.pod_affinity is not None
+        and len(pod.spec.affinity.pod_affinity.required) > 0
+    )
+
+
+def tolerates_unschedulable_taint(pod: Pod) -> bool:
+    from karpenter_core_tpu.kube.objects import TAINT_NODE_UNSCHEDULABLE, Taint
+
+    taint = Taint(key=TAINT_NODE_UNSCHEDULABLE, effect="NoSchedule")
+    return any(t.tolerates_taint(taint) for t in pod.spec.tolerations)
+
+
+def is_evictable(pod: Pod) -> bool:
+    return not is_terminal(pod)
+
+
+def has_do_not_evict(pod: Pod) -> bool:
+    from karpenter_core_tpu.api.labels import DO_NOT_EVICT_POD_ANNOTATION_KEY
+
+    return pod.metadata.annotations.get(DO_NOT_EVICT_POD_ANNOTATION_KEY) == "true"

@@ -1,0 +1,295 @@
+"""Host-path scheduler tests.
+
+Coverage model: reference scheduling suite_test.go / topology_test.go /
+instance_selection_test.go scenarios, condensed: resource bin-packing,
+instance-type narrowing, taints, nodeSelector/affinity, topology spread,
+pod affinity/anti-affinity, relaxation, provisioner limits and weights,
+existing-node reuse.
+"""
+import pytest
+
+from karpenter_core_tpu.api.labels import LABEL_CAPACITY_TYPE, PROVISIONER_NAME_LABEL_KEY
+from karpenter_core_tpu.cloudprovider import fake
+from karpenter_core_tpu.controllers.provisioning.scheduling.scheduler import (
+    SchedulerOptions,
+    build_scheduler,
+)
+from karpenter_core_tpu.kube.client import InMemoryKubeClient
+from karpenter_core_tpu.kube.objects import (
+    LABEL_HOSTNAME,
+    LABEL_TOPOLOGY_ZONE,
+    LabelSelector,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    PodAffinityTerm,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+)
+from karpenter_core_tpu.state.node import StateNode
+from karpenter_core_tpu.testing import make_node, make_pod, make_provisioner
+
+
+def solve(pods, provisioners=None, instance_types=None, state_nodes=None, kube=None):
+    provisioners = provisioners or [make_provisioner()]
+    its = instance_types if instance_types is not None else fake.instance_types(10)
+    it_map = {p.name: its for p in provisioners}
+    scheduler = build_scheduler(
+        kube or InMemoryKubeClient(),
+        None,
+        provisioners,
+        it_map,
+        pods,
+        state_nodes=state_nodes,
+        opts=SchedulerOptions(simulation_mode=True),
+    )
+    return scheduler.solve(pods)
+
+
+def test_single_pod_single_node():
+    result = solve([make_pod(requests={"cpu": "1"})])
+    assert len(result.new_machines) == 1
+    assert result.pod_count_new() == 1
+    assert not result.failed_pods
+
+
+def test_bin_packs_multiple_pods_one_node():
+    # 10 pods x 1 cpu fit a single 16-cpu machine (fake-it-15) given pods cap
+    pods = [make_pod(requests={"cpu": "1"}) for _ in range(10)]
+    result = solve(pods, instance_types=fake.instance_types(20))
+    assert not result.failed_pods
+    assert len(result.new_machines) == 1
+    machine = result.new_machines[0]
+    assert len(machine.pods) == 10
+    # every remaining instance-type option must fit 10 cpu + overhead
+    for it in machine.instance_type_options:
+        assert it.allocatable()["cpu"] >= 10
+
+
+def test_huge_pod_fails():
+    result = solve([make_pod(requests={"cpu": "1000"})])
+    assert len(result.failed_pods) == 1
+    assert not result.new_machines
+
+
+def test_instance_type_narrowing_by_node_selector():
+    pods = [make_pod(node_selector={"node.kubernetes.io/instance-type": "fake-it-3"})]
+    result = solve(pods)
+    assert not result.failed_pods
+    assert [it.name for it in result.new_machines[0].instance_type_options] == ["fake-it-3"]
+
+
+def test_taints_block_untolerating_pods():
+    prov = make_provisioner(taints=[Taint("team", "infra", "NoSchedule")])
+    result = solve([make_pod()], provisioners=[prov])
+    assert len(result.failed_pods) == 1
+    ok = solve(
+        [make_pod(tolerations=[Toleration(key="team", operator="Exists")])],
+        provisioners=[make_provisioner(taints=[Taint("team", "infra", "NoSchedule")])],
+    )
+    assert not ok.failed_pods
+
+
+def test_provisioner_requirements_constrain_pods():
+    prov = make_provisioner(
+        requirements=[NodeSelectorRequirement(LABEL_TOPOLOGY_ZONE, "In", ["test-zone-1"])]
+    )
+    ok = solve([make_pod(node_selector={LABEL_TOPOLOGY_ZONE: "test-zone-1"})], provisioners=[prov])
+    assert not ok.failed_pods
+    bad = solve([make_pod(node_selector={LABEL_TOPOLOGY_ZONE: "test-zone-3"})], provisioners=[prov])
+    assert len(bad.failed_pods) == 1
+
+
+def test_weighted_provisioner_order():
+    heavy = make_provisioner(name="heavy", weight=50, labels={"tier": "heavy"})
+    light = make_provisioner(name="light", labels={"tier": "light"})
+    result = solve([make_pod(requests={"cpu": "1"})], provisioners=[light, heavy])
+    assert result.new_machines[0].provisioner_name == "heavy"
+
+
+def test_provisioner_limits_respected():
+    # limit of 4 cpu; each 1-cpu pod forces max-capacity pessimism: with only
+    # the 4-cpu type available, one node consumes the whole limit
+    prov = make_provisioner(limits={"cpu": "4"})
+    its = [fake.new_instance_type("only-4cpu", resources={"cpu": 4.0, "pods": 100.0})]
+    result = solve([make_pod(requests={"cpu": "1"}) for _ in range(8)], provisioners=[prov], instance_types=its)
+    assert len(result.new_machines) == 1
+    assert result.failed_pods  # remaining pods can't launch within limits
+
+
+def test_zonal_topology_spread():
+    spread = TopologySpreadConstraint(
+        max_skew=1,
+        topology_key=LABEL_TOPOLOGY_ZONE,
+        when_unsatisfiable="DoNotSchedule",
+        label_selector=LabelSelector(match_labels={"app": "web"}),
+    )
+    pods = [
+        make_pod(labels={"app": "web"}, requests={"cpu": "1"}, topology_spread=[spread])
+        for _ in range(6)
+    ]
+    result = solve(pods, instance_types=fake.instance_types(5))
+    assert not result.failed_pods
+    # count pods per zone across machines
+    zone_counts = {}
+    for m in result.new_machines:
+        zone_req = m.requirements.get_requirement(LABEL_TOPOLOGY_ZONE)
+        assert zone_req.len() == 1
+        zone = zone_req.values_list()[0]
+        zone_counts[zone] = zone_counts.get(zone, 0) + len(m.pods)
+    assert len(zone_counts) == 3
+    assert max(zone_counts.values()) - min(zone_counts.values()) <= 1
+
+
+def test_hostname_topology_spread_forces_nodes():
+    spread = TopologySpreadConstraint(
+        max_skew=1,
+        topology_key=LABEL_HOSTNAME,
+        when_unsatisfiable="DoNotSchedule",
+        label_selector=LabelSelector(match_labels={"app": "web"}),
+    )
+    pods = [
+        make_pod(labels={"app": "web"}, requests={"cpu": "1"}, topology_spread=[spread])
+        for _ in range(4)
+    ]
+    result = solve(pods, instance_types=fake.instance_types(5))
+    assert not result.failed_pods
+    # hostname spread with maxSkew 1: pods on distinct nodes until forced
+    assert len(result.new_machines) >= 2
+
+
+def test_pod_anti_affinity_zone_late_committal():
+    """Zone anti-affinity schedules ONE pod per batch: the pod's machine could
+    land in any zone, so all possible domains are blocked out
+    (reference topology.go Record 'block out all possible domains';
+    topology_test.go:1919-1963 'takes multiple batches')."""
+    term = PodAffinityTerm(
+        topology_key=LABEL_TOPOLOGY_ZONE,
+        label_selector=LabelSelector(match_labels={"app": "db"}),
+    )
+    pods = [
+        make_pod(labels={"app": "db"}, requests={"cpu": "1"}, pod_anti_affinity_required=[term])
+        for _ in range(3)
+    ]
+    result = solve(pods, instance_types=fake.instance_types(5))
+    assert result.pod_count_new() == 1
+    assert len(result.failed_pods) == 2
+
+
+def test_pod_anti_affinity_hostname_separates_in_one_batch():
+    """Hostname anti-affinity separates within a batch: each new machine
+    registers a fresh hostname domain (topology_test.go:1550-1570)."""
+    term = PodAffinityTerm(
+        topology_key=LABEL_HOSTNAME,
+        label_selector=LabelSelector(match_labels={"app": "db"}),
+    )
+    pods = [
+        make_pod(labels={"app": "db"}, requests={"cpu": "1"}, pod_anti_affinity_required=[term])
+        for _ in range(3)
+    ]
+    result = solve(pods, instance_types=fake.instance_types(5))
+    assert not result.failed_pods
+    assert len(result.new_machines) == 3
+    assert all(len(m.pods) == 1 for m in result.new_machines)
+
+
+def test_pod_affinity_colocates():
+    term = PodAffinityTerm(
+        topology_key=LABEL_TOPOLOGY_ZONE,
+        label_selector=LabelSelector(match_labels={"app": "web"}),
+    )
+    pods = [make_pod(labels={"app": "web"}, requests={"cpu": "1"}) for _ in range(2)] + [
+        make_pod(labels={"app": "web"}, requests={"cpu": "1"}, pod_affinity_required=[term])
+        for _ in range(2)
+    ]
+    result = solve(pods, instance_types=fake.instance_types(20))
+    assert not result.failed_pods
+    zones = set()
+    for m in result.new_machines:
+        if m.pods:
+            zones.update(m.requirements.get_requirement(LABEL_TOPOLOGY_ZONE).values_list())
+    assert len(zones) == 1  # all landed in one zone
+
+
+def test_relaxation_drops_impossible_preferred_affinity():
+    # preferred node affinity to a nonexistent zone must be relaxed away
+    from karpenter_core_tpu.kube.objects import PreferredSchedulingTerm
+
+    pref = PreferredSchedulingTerm(
+        weight=1,
+        preference=NodeSelectorTerm(
+            [NodeSelectorRequirement(LABEL_TOPOLOGY_ZONE, "In", ["mars-zone"])]
+        ),
+    )
+    result = solve([make_pod(requests={"cpu": "1"}, node_affinity_preferred=[pref])])
+    assert not result.failed_pods
+
+
+def test_relaxation_required_or_terms():
+    # two ORed required terms; first impossible, second valid - reference drops
+    # the head term during relaxation (preferences.go:73-86)
+    terms = [
+        NodeSelectorTerm([NodeSelectorRequirement(LABEL_TOPOLOGY_ZONE, "In", ["mars-zone"])]),
+        NodeSelectorTerm([NodeSelectorRequirement(LABEL_TOPOLOGY_ZONE, "In", ["test-zone-1"])]),
+    ]
+    result = solve([make_pod(requests={"cpu": "1"}, node_affinity_required=terms)])
+    assert not result.failed_pods
+    machine = result.new_machines[0]
+    assert machine.requirements.get_requirement(LABEL_TOPOLOGY_ZONE).values_list() == [
+        "test-zone-1"
+    ]
+
+
+def test_existing_node_reused():
+    node = make_node(
+        labels={
+            PROVISIONER_NAME_LABEL_KEY: "default",
+            "karpenter.sh/initialized": "true",
+            LABEL_HOSTNAME: "existing-1",
+        },
+        capacity={"cpu": "16", "memory": "32Gi", "pods": "100"},
+    )
+    node.metadata.labels["karpenter.sh/initialized"] = "true"
+    state_node = StateNode(node=node)
+    # mark initialized via label
+    node.metadata.labels["karpenter.sh/initialized"] = "true"
+    result = solve(
+        [make_pod(requests={"cpu": "1"})],
+        state_nodes=[state_node],
+    )
+    assert not result.new_machines
+    assert result.pod_count_existing() == 1
+
+
+def test_existing_node_overflow_opens_new():
+    node = make_node(
+        labels={
+            PROVISIONER_NAME_LABEL_KEY: "default",
+            "karpenter.sh/initialized": "true",
+        },
+        capacity={"cpu": "2", "pods": "10"},
+    )
+    state_node = StateNode(node=node)
+    pods = [make_pod(requests={"cpu": "1"}) for _ in range(4)]
+    result = solve(pods, state_nodes=[state_node])
+    assert not result.failed_pods
+    assert result.pod_count_existing() == 2
+    assert result.pod_count_new() == 2
+
+
+def test_capacity_type_requirement_filters_offerings():
+    pods = [make_pod(node_selector={LABEL_CAPACITY_TYPE: "spot"})]
+    result = solve(pods)
+    assert not result.failed_pods
+    m = result.new_machines[0]
+    assert m.requirements.get_requirement(LABEL_CAPACITY_TYPE).has("spot")
+    # every surviving instance-type option has an available spot offering
+    for it in m.instance_type_options:
+        assert any(o.capacity_type == "spot" for o in it.offerings.available())
+
+
+def test_progress_queue_terminates_on_unsatisfiable():
+    # one satisfiable + one never-satisfiable: loop must terminate
+    result = solve([make_pod(requests={"cpu": "1"}), make_pod(requests={"cpu": "999"})])
+    assert len(result.failed_pods) == 1
+    assert result.pod_count_new() == 1

@@ -1,0 +1,220 @@
+"""Differential tests: TPU tensor solver vs host (reference-semantics) solver.
+
+Equivalence criterion (SURVEY.md section 7 hard part e): the greedy reference
+is order-dependent, so equivalence is "all constraints satisfied AND node
+count/price no worse", not bit-identical placements. Every TPU result is
+validated against the full host constraint algebra.
+"""
+import pytest
+
+from karpenter_core_tpu.api.labels import LABEL_CAPACITY_TYPE, PROVISIONER_NAME_LABEL_KEY
+from karpenter_core_tpu.cloudprovider import fake
+from karpenter_core_tpu.kube.objects import (
+    LABEL_TOPOLOGY_ZONE,
+    NodeSelectorRequirement,
+    Taint,
+    Toleration,
+)
+from karpenter_core_tpu.scheduling import taints as taints_mod
+from karpenter_core_tpu.scheduling.requirements import Requirements
+from karpenter_core_tpu.solver.tpu_solver import GreedySolver, TPUSolver
+from karpenter_core_tpu.state.node import StateNode
+from karpenter_core_tpu.testing import make_node, make_pod, make_provisioner
+from karpenter_core_tpu.utils import resources as resources_util
+
+
+def validate_machines(result):
+    """Every machine must satisfy the host constraint algebra exactly."""
+    for m in result.new_machines:
+        assert m.pods, "machine with no pods"
+        assert m.instance_type_options, "machine with no instance types"
+        total = resources_util.merge(
+            *[resources_util.requests_for_pods(p) for p in m.pods]
+        )
+        # at least one surviving type fits the total of pod requests
+        assert any(
+            resources_util.fits(total, it.allocatable()) for it in m.instance_type_options
+        ), f"no type fits {total}"
+        for pod in m.pods:
+            # taints tolerated
+            assert taints_mod.tolerates(m.template.taints, pod) is None
+            # requirements compatible with the final machine requirements
+            pod_reqs = Requirements.from_pod(pod)
+            assert m.requirements.compatible(pod_reqs) is None
+        # every surviving type is compatible + has an offering
+        for it in m.instance_type_options:
+            assert it.requirements.intersects(m.requirements) is None
+
+
+def run_both(pods, provisioners, its_map, state_nodes=None):
+    host = GreedySolver().solve(pods, provisioners, its_map, state_nodes=state_nodes)
+    tpu = TPUSolver().solve(pods, provisioners, its_map, state_nodes=state_nodes)
+    validate_machines(tpu)
+    return host, tpu
+
+
+def test_config1_resources_only():
+    """Config 1 analog: cpu+mem pods, 10 types, single provisioner."""
+    pods = [make_pod(requests={"cpu": "1", "memory": "1Gi"}) for _ in range(40)] + [
+        make_pod(requests={"cpu": "2", "memory": "4Gi"}) for _ in range(20)
+    ]
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(10)}
+    host, tpu = run_both(pods, provisioners, its)
+    assert not tpu.failed_pods
+    assert tpu.pod_count_new() == 60
+    # no worse than the host FFD in node count
+    assert len(tpu.new_machines) <= len(host.new_machines)
+
+
+def test_config2_selectors_and_taints():
+    """Config 2 analog: nodeSelector + taints/tolerations mix."""
+    taint = Taint("dedicated", "batch", "NoSchedule")
+    provisioners = [
+        make_provisioner(name="tainted", weight=10, taints=[taint]),
+        make_provisioner(name="default"),
+    ]
+    its = {
+        "tainted": fake.instance_types(8),
+        "default": fake.instance_types(8),
+    }
+    pods = (
+        [make_pod(requests={"cpu": "1"}) for _ in range(10)]
+        + [
+            make_pod(
+                requests={"cpu": "1"},
+                tolerations=[Toleration(key="dedicated", operator="Exists")],
+            )
+            for _ in range(10)
+        ]
+        + [
+            make_pod(
+                requests={"cpu": "1"},
+                node_selector={LABEL_TOPOLOGY_ZONE: "test-zone-2"},
+            )
+            for _ in range(5)
+        ]
+        + [
+            make_pod(requests={"cpu": "1"}, node_selector={LABEL_CAPACITY_TYPE: "spot"})
+            for _ in range(5)
+        ]
+    )
+    host, tpu = run_both(pods, provisioners, its)
+    assert not tpu.failed_pods
+    # untolerating pods never land on the tainted provisioner
+    for m in tpu.new_machines:
+        if m.provisioner_name == "tainted":
+            for pod in m.pods:
+                assert taints_mod.tolerates([taint], pod) is None
+    # zone-selected pods end up on machines allowing only that zone
+    for m in tpu.new_machines:
+        zone_req = m.requirements.get_requirement(LABEL_TOPOLOGY_ZONE)
+        for pod in m.pods:
+            if pod.spec.node_selector.get(LABEL_TOPOLOGY_ZONE):
+                assert zone_req.values_list() == ["test-zone-2"]
+
+
+def test_instance_type_narrowing_matches_host():
+    pods = [make_pod(node_selector={"node.kubernetes.io/instance-type": "fake-it-3"})]
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(10)}
+    host, tpu = run_both(pods, provisioners, its)
+    assert [it.name for it in tpu.new_machines[0].instance_type_options] == ["fake-it-3"]
+
+
+def test_unschedulable_pod_fails_both():
+    pods = [make_pod(requests={"cpu": "10000"})]
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(5)}
+    host, tpu = run_both(pods, provisioners, its)
+    assert len(host.failed_pods) == 1
+    assert len(tpu.failed_pods) == 1
+
+
+def test_existing_nodes_used_first():
+    node = make_node(
+        labels={
+            PROVISIONER_NAME_LABEL_KEY: "default",
+            "karpenter.sh/initialized": "true",
+        },
+        capacity={"cpu": "8", "memory": "16Gi", "pods": "50"},
+    )
+    state = StateNode(node=node)
+    pods = [make_pod(requests={"cpu": "1"}) for _ in range(4)]
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(10)}
+    host, tpu = run_both(pods, provisioners, its, state_nodes=[state.deep_copy()])
+    assert not tpu.failed_pods
+    assert tpu.pod_count_existing() == 4
+    assert not tpu.new_machines
+
+
+def test_weighted_provisioner_preference():
+    provisioners = [
+        make_provisioner(name="light"),
+        make_provisioner(name="heavy", weight=50),
+    ]
+    its = {"light": fake.instance_types(5), "heavy": fake.instance_types(5)}
+    pods = [make_pod(requests={"cpu": "1"})]
+    host, tpu = run_both(pods, provisioners, its)
+    assert tpu.new_machines[0].provisioner_name == "heavy"
+    assert host.new_machines[0].provisioner_name == "heavy"
+
+
+def test_relaxation_preferred_node_affinity():
+    from karpenter_core_tpu.kube.objects import (
+        NodeSelectorTerm,
+        PreferredSchedulingTerm,
+    )
+
+    pref = PreferredSchedulingTerm(
+        weight=1,
+        preference=NodeSelectorTerm(
+            [NodeSelectorRequirement(LABEL_TOPOLOGY_ZONE, "In", ["mars-zone"])]
+        ),
+    )
+    pods = [make_pod(requests={"cpu": "1"}, node_affinity_preferred=[pref])]
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(5)}
+    host, tpu = run_both(pods, provisioners, its)
+    assert not tpu.failed_pods
+    assert tpu.rounds >= 2  # needed a relaxation round
+
+
+def test_provisioner_limits():
+    prov = make_provisioner(name="default", limits={"cpu": "4"})
+    its = {"default": [fake.new_instance_type("only-4cpu", resources={"cpu": 4.0, "pods": 100.0})]}
+    pods = [make_pod(requests={"cpu": "1"}) for _ in range(8)]
+    host, tpu = run_both(pods, [prov], its)
+    assert len(tpu.new_machines) == 1
+    assert tpu.failed_pods
+
+
+def test_larger_random_mix_no_worse_than_host():
+    import random
+
+    rng = random.Random(42)
+    zones = ["test-zone-1", "test-zone-2", "test-zone-3"]
+    pods = []
+    for i in range(300):
+        kind = rng.random()
+        if kind < 0.3:
+            pods.append(make_pod(requests={"cpu": str(rng.choice([1, 2]))}))
+        elif kind < 0.6:
+            pods.append(
+                make_pod(
+                    requests={"cpu": "1", "memory": f"{rng.choice([1, 2, 4])}Gi"},
+                    node_selector={LABEL_TOPOLOGY_ZONE: rng.choice(zones)},
+                )
+            )
+        elif kind < 0.8:
+            pods.append(
+                make_pod(requests={"cpu": "1"}, node_selector={LABEL_CAPACITY_TYPE: "spot"})
+            )
+        else:
+            pods.append(make_pod(requests={"memory": "2Gi"}))
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(20)}
+    host, tpu = run_both(pods, provisioners, its)
+    assert not tpu.failed_pods
+    assert len(tpu.new_machines) <= len(host.new_machines) + 2
