@@ -20,6 +20,64 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 N_PODS = int(os.environ.get("BENCH_PODS", "2000"))
 N_TYPES = int(os.environ.get("BENCH_TYPES", "100"))
 N_RUNS = int(os.environ.get("BENCH_RUNS", "5"))
+MIX = os.environ.get("BENCH_MIX", "reference")  # reference | plain
+
+
+def _reference_mix(n_pods: int, n_types: int):
+    """The reference benchmark's diverse pod mix
+    (scheduling_benchmark_test.go:187-199): 1/7 zonal topology spread,
+    1/7 hostname spread, 2/7 pod affinity, 3/7 generic."""
+    from karpenter_core_tpu.cloudprovider import fake
+    from karpenter_core_tpu.kube.objects import (
+        LABEL_HOSTNAME,
+        LABEL_TOPOLOGY_ZONE,
+        LabelSelector,
+        PodAffinityTerm,
+        TopologySpreadConstraint,
+    )
+    from karpenter_core_tpu.testing import make_pod, make_provisioner
+
+    zonal = TopologySpreadConstraint(
+        max_skew=1,
+        topology_key=LABEL_TOPOLOGY_ZONE,
+        when_unsatisfiable="DoNotSchedule",
+        label_selector=LabelSelector(match_labels={"app": "spread"}),
+    )
+    hostname = TopologySpreadConstraint(
+        max_skew=1,
+        topology_key=LABEL_HOSTNAME,
+        when_unsatisfiable="DoNotSchedule",
+        label_selector=LabelSelector(match_labels={"app": "hspread"}),
+    )
+    affinity = PodAffinityTerm(
+        topology_key=LABEL_TOPOLOGY_ZONE,
+        label_selector=LabelSelector(match_labels={"app": "aff"}),
+    )
+    pods = []
+    for i in range(n_pods):
+        kind = i % 7
+        if kind == 0:
+            pods.append(
+                make_pod(labels={"app": "spread"}, requests={"cpu": "1"}, topology_spread=[zonal])
+            )
+        elif kind == 1:
+            pods.append(
+                make_pod(
+                    labels={"app": "hspread"}, requests={"cpu": "1"}, topology_spread=[hostname]
+                )
+            )
+        elif kind in (2, 3):
+            pods.append(
+                make_pod(
+                    labels={"app": "aff"},
+                    requests={"cpu": "1"},
+                    pod_affinity_required=[affinity],
+                )
+            )
+        else:
+            pods.append(make_pod(requests={"cpu": "1", "memory": "1Gi"}))
+    provisioners = [make_provisioner(name="default")]
+    return pods, provisioners, {"default": fake.instance_types(n_types)}
 
 
 def main():
@@ -32,7 +90,10 @@ def main():
     from karpenter_core_tpu.solver.tpu_solver import build_device_solve, device_args
 
     t0 = time.perf_counter()
-    pods, provisioners, instance_types = _scenario(N_PODS, N_TYPES)
+    if MIX == "reference":
+        pods, provisioners, instance_types = _reference_mix(N_PODS, N_TYPES)
+    else:
+        pods, provisioners, instance_types = _scenario(N_PODS, N_TYPES)
     snap = encode_snapshot(pods, provisioners, instance_types)
     encode_s = time.perf_counter() - t0
 

@@ -1,0 +1,28 @@
+"""Provisioner resource counter.
+
+Mirrors reference pkg/controllers/counter/controller.go:62-93: aggregate per-
+provisioner status.resources from cluster state, skipping nodes marked for
+deletion.
+"""
+from __future__ import annotations
+
+from karpenter_core_tpu.api import labels as api_labels
+from karpenter_core_tpu.api.provisioner import Provisioner
+from karpenter_core_tpu.utils import resources as resources_util
+
+
+class CounterController:
+    def __init__(self, kube_client, cluster):
+        self.kube_client = kube_client
+        self.cluster = cluster
+
+    def reconcile(self, provisioner: Provisioner) -> None:
+        resources = {}
+        for node in self.cluster.nodes():
+            if node.is_marked_for_deletion():
+                continue
+            if node.labels().get(api_labels.PROVISIONER_NAME_LABEL_KEY) != provisioner.name:
+                continue
+            resources = resources_util.merge(resources, node.capacity())
+        provisioner.status.resources = resources
+        self.kube_client.apply(provisioner)

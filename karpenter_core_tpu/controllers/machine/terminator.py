@@ -1,0 +1,181 @@
+"""Terminator: cordon -> drain -> terminate, plus the async eviction queue.
+
+Mirrors reference pkg/controllers/machine/terminator/{terminator,eviction}.go:
+Cordon taints the node unschedulable; Drain evicts evictable pods (do-not-evict
+blocks with an error; critical pods drain last); TerminateNode deletes the
+cloud instance and removes the finalizer. The EvictionQueue is a rate-limited
+worker with set-dedupe calling the eviction API; PDB 429s requeue with
+backoff.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import List, Optional, Set
+
+from karpenter_core_tpu.api import labels as api_labels
+from karpenter_core_tpu.kube.objects import (
+    NamespacedName,
+    Node,
+    Pod,
+    TAINT_NODE_UNSCHEDULABLE,
+    Taint,
+    object_key,
+)
+from karpenter_core_tpu.utils import podutils
+
+
+class NodeDrainError(Exception):
+    """Drain not finished yet; requeue (terminator.go NodeDrainError)."""
+
+
+class PDBBlockedError(Exception):
+    """Eviction blocked by a PodDisruptionBudget (HTTP 429 analog)."""
+
+
+class EvictionQueue:
+    """eviction.go:58-131: rate-limited workqueue with set dedupe."""
+
+    def __init__(self, kube_client, recorder=None, pdb_checker=None):
+        self.kube_client = kube_client
+        self.recorder = recorder
+        self.pdb_checker = pdb_checker  # fn(pod) -> bool allowed
+        self._set: Set[NamespacedName] = set()
+        self._queue: "queue.Queue" = queue.Queue()
+        self._mu = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def add(self, *pods: Pod) -> None:
+        with self._mu:
+            for pod in pods:
+                key = object_key(pod)
+                if key not in self._set:
+                    self._set.add(key)
+                    self._queue.put((key, 0))
+
+    def start(self) -> None:
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                key, attempts = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            if self.evict(key):
+                with self._mu:
+                    self._set.discard(key)
+            else:
+                # PDB 429 -> exponential backoff requeue (eviction.go:110-124)
+                delay = min(0.1 * (2**attempts), 10.0)
+                threading.Timer(
+                    delay, lambda: self._queue.put((key, attempts + 1))
+                ).start()
+
+    def evict(self, key: NamespacedName) -> bool:
+        """One eviction API call (eviction.go:87-108). True on success or
+        gone; False when PDB-blocked."""
+        pod = self.kube_client.get("Pod", key.namespace, key.name)
+        if pod is None:
+            return True
+        if self.pdb_checker is not None and not self.pdb_checker(pod):
+            return False
+        try:
+            self.kube_client.delete("Pod", key.namespace, key.name)
+        except Exception:
+            return True
+        if self.recorder:
+            self.recorder.evict_pod(pod)
+        return True
+
+    def drain(self) -> None:
+        """Synchronously process everything queued (for tests/sync paths)."""
+        while True:
+            with self._mu:
+                pending = list(self._set)
+            if not pending:
+                return
+            progressed = False
+            for key in pending:
+                if self.evict(key):
+                    with self._mu:
+                        self._set.discard(key)
+                    progressed = True
+            if not progressed:
+                return
+
+
+class Terminator:
+    """terminator.go:40-155."""
+
+    def __init__(self, kube_client, cloud_provider, eviction_queue: EvictionQueue, clock=time.time):
+        self.kube_client = kube_client
+        self.cloud_provider = cloud_provider
+        self.eviction_queue = eviction_queue
+        self.clock = clock
+
+    def cordon(self, node: Node) -> None:
+        """terminator.go:53-68: mark unschedulable."""
+        if node.spec.unschedulable:
+            return
+        node.spec.unschedulable = True
+        if not any(t.key == TAINT_NODE_UNSCHEDULABLE for t in node.spec.taints):
+            node.spec.taints.append(
+                Taint(key=TAINT_NODE_UNSCHEDULABLE, effect="NoSchedule")
+            )
+        self.kube_client.update(node)
+
+    def drain(self, node: Node) -> None:
+        """terminator.go:70-100: evict evictable pods; do-not-evict blocks;
+        critical pods drain after the rest. Raises NodeDrainError until
+        empty."""
+        pods = self.kube_client.list(
+            "Pod", field_filter=lambda p: p.spec.node_name == node.metadata.name
+        )
+        evictable: List[Pod] = []
+        critical: List[Pod] = []
+        for pod in pods:
+            if podutils.is_owned_by_daemonset(pod) or podutils.is_owned_by_node(pod):
+                continue
+            if podutils.is_terminal(pod):
+                continue
+            if podutils.has_do_not_evict(pod) and pod.metadata.deletion_timestamp is None:
+                raise NodeDrainError(
+                    f"pod {pod.metadata.namespace}/{pod.metadata.name} has do-not-evict annotation"
+                )
+            if pod.spec.priority_class_name in ("system-cluster-critical", "system-node-critical"):
+                critical.append(pod)
+            else:
+                evictable.append(pod)
+        # drain critical pods last (terminator.go:131-155)
+        batch = evictable if evictable else critical
+        if batch:
+            self.eviction_queue.add(*batch)
+            raise NodeDrainError(f"{len(evictable) + len(critical)} pods are waiting to be evicted")
+
+    def terminate_node(self, node: Node) -> None:
+        """terminator.go:102-129: delete the instance, then drop the
+        finalizer so the apiserver completes deletion."""
+        state_machine = self.kube_client.get("Machine", "", node.metadata.name)
+        from karpenter_core_tpu.api.machine import Machine as MachineCR
+        from karpenter_core_tpu.cloudprovider.types import MachineNotFoundError
+
+        machine = state_machine
+        if machine is None:
+            machine = MachineCR()
+            machine.metadata.name = node.metadata.name
+            machine.status.provider_id = node.spec.provider_id
+        try:
+            self.cloud_provider.delete(machine)
+        except MachineNotFoundError:
+            pass
+        if api_labels.TERMINATION_FINALIZER in node.metadata.finalizers:
+            node.metadata.finalizers.remove(api_labels.TERMINATION_FINALIZER)
+            self.kube_client.finalize(node)

@@ -1,0 +1,131 @@
+"""Metrics scrapers: node/pod/provisioner gauges.
+
+Mirrors reference pkg/controllers/metrics/{state/scraper/node.go, pod,
+provisioner}: a 5s singleton scrape publishing node allocatable / pod
+requests+limits / daemon overhead gauges labeled by well-known labels, the
+per-pod phase gauge, and per-provisioner limit/usage gauges.
+"""
+from __future__ import annotations
+
+import time
+
+from karpenter_core_tpu.api import labels as api_labels
+from karpenter_core_tpu.kube.objects import (
+    LABEL_ARCH_STABLE,
+    LABEL_INSTANCE_TYPE_STABLE,
+    LABEL_OS_STABLE,
+    LABEL_TOPOLOGY_REGION,
+    LABEL_TOPOLOGY_ZONE,
+)
+from karpenter_core_tpu.metrics.registry import NAMESPACE, REGISTRY
+
+SCRAPE_PERIOD = 5.0
+
+_WELL_KNOWN_GAUGE_LABELS = {
+    "zone": LABEL_TOPOLOGY_ZONE,
+    "region": LABEL_TOPOLOGY_REGION,
+    "instance_type": LABEL_INSTANCE_TYPE_STABLE,
+    "arch": LABEL_ARCH_STABLE,
+    "os": LABEL_OS_STABLE,
+    "capacity_type": api_labels.LABEL_CAPACITY_TYPE,
+    "provisioner": api_labels.PROVISIONER_NAME_LABEL_KEY,
+}
+
+
+def _node_labels(state_node, resource_name: str):
+    labels = {"node_name": state_node.name(), "resource_type": resource_name}
+    node_labels = state_node.labels()
+    for gauge_label, node_label in _WELL_KNOWN_GAUGE_LABELS.items():
+        labels[gauge_label] = node_labels.get(node_label, "")
+    return labels
+
+
+class NodeMetricsController:
+    """metrics/state/scraper/node.go:28-115."""
+
+    def __init__(self, cluster):
+        self.cluster = cluster
+        self.allocatable = REGISTRY.gauge(f"{NAMESPACE}_nodes_allocatable")
+        self.pod_requests = REGISTRY.gauge(f"{NAMESPACE}_nodes_total_pod_requests")
+        self.pod_limits = REGISTRY.gauge(f"{NAMESPACE}_nodes_total_pod_limits")
+        self.daemon_requests = REGISTRY.gauge(f"{NAMESPACE}_nodes_total_daemon_requests")
+        self.daemon_limits = REGISTRY.gauge(f"{NAMESPACE}_nodes_total_daemon_limits")
+        self.overhead = REGISTRY.gauge(f"{NAMESPACE}_nodes_system_overhead")
+
+    def reconcile(self) -> float:
+        for gauge in (
+            self.allocatable,
+            self.pod_requests,
+            self.pod_limits,
+            self.daemon_requests,
+            self.daemon_limits,
+            self.overhead,
+        ):
+            gauge.clear()
+        for state_node in self.cluster.nodes():
+            for name, q in state_node.allocatable().items():
+                self.allocatable.set(q, _node_labels(state_node, name))
+            for name, q in state_node.total_pod_requests().items():
+                self.pod_requests.set(q, _node_labels(state_node, name))
+            for name, q in state_node.total_pod_limits().items():
+                self.pod_limits.set(q, _node_labels(state_node, name))
+            for name, q in state_node.total_daemonset_requests().items():
+                self.daemon_requests.set(q, _node_labels(state_node, name))
+            for name, q in state_node.total_daemonset_limits().items():
+                self.daemon_limits.set(q, _node_labels(state_node, name))
+            capacity = state_node.capacity()
+            allocatable = state_node.allocatable()
+            for name, q in capacity.items():
+                self.overhead.set(q - allocatable.get(name, 0.0), _node_labels(state_node, name))
+        return SCRAPE_PERIOD
+
+
+class PodMetricsController:
+    """metrics/pod/controller.go:55-75."""
+
+    def __init__(self, kube_client, clock=time.time):
+        self.kube_client = kube_client
+        self.clock = clock
+        self.state = REGISTRY.gauge(f"{NAMESPACE}_pods_state")
+        self.startup = REGISTRY.histogram(f"{NAMESPACE}_pods_startup_time_seconds")
+        self._started = set()
+
+    def reconcile(self, pod) -> None:
+        self.state.set(
+            1.0,
+            {
+                "name": pod.metadata.name,
+                "namespace": pod.metadata.namespace,
+                "phase": pod.status.phase,
+                "node": pod.spec.node_name,
+            },
+        )
+        if pod.status.phase == "Running" and pod.metadata.uid not in self._started:
+            self._started.add(pod.metadata.uid)
+            self.startup.observe(self.clock() - pod.metadata.creation_timestamp)
+
+
+class ProvisionerMetricsController:
+    """metrics/provisioner/controller.go."""
+
+    def __init__(self, kube_client):
+        self.kube_client = kube_client
+        self.limit = REGISTRY.gauge(f"{NAMESPACE}_provisioner_limit")
+        self.usage = REGISTRY.gauge(f"{NAMESPACE}_provisioner_usage")
+        self.usage_pct = REGISTRY.gauge(f"{NAMESPACE}_provisioner_usage_pct")
+
+    def reconcile(self, provisioner) -> None:
+        base = {"provisioner": provisioner.name}
+        if provisioner.spec.limits is not None:
+            for name, q in provisioner.spec.limits.resources.items():
+                self.limit.set(q, {**base, "resource_type": name})
+        for name, q in provisioner.status.resources.items():
+            self.usage.set(q, {**base, "resource_type": name})
+            if (
+                provisioner.spec.limits is not None
+                and provisioner.spec.limits.resources.get(name)
+            ):
+                self.usage_pct.set(
+                    q / provisioner.spec.limits.resources[name] * 100.0,
+                    {**base, "resource_type": name},
+                )

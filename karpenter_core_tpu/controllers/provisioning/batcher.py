@@ -1,0 +1,38 @@
+"""Batching window over pod triggers (reference batcher.go:40-74):
+wait for the first trigger, then extend while triggers keep arriving within
+the idle window, capped at the max window."""
+from __future__ import annotations
+
+import threading
+import time
+
+from karpenter_core_tpu.api.settings import Settings, current
+
+
+class Batcher:
+    def __init__(self, settings: Settings = None, clock=time.monotonic):
+        self.settings = settings
+        self.clock = clock
+        self._trigger = threading.Event()
+
+    def trigger(self) -> None:
+        self._trigger.set()
+
+    def wait(self, timeout: float = None, poll: float = 0.01) -> bool:
+        """Returns True when a batch window closed with work to do
+        (batcher.go:50-74)."""
+        settings = self.settings or current()
+        if not self._trigger.wait(timeout=timeout):
+            return False
+        start = self.clock()
+        last = self.clock()
+        self._trigger.clear()
+        while True:
+            now = self.clock()
+            if now - start >= settings.batch_max_duration:
+                return True
+            if now - last >= settings.batch_idle_duration:
+                return True
+            if self._trigger.wait(timeout=poll):
+                self._trigger.clear()
+                last = self.clock()

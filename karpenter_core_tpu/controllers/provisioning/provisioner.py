@@ -1,0 +1,237 @@
+"""Provisioner — the singleton provisioning loop.
+
+Mirrors reference pkg/controllers/provisioning/provisioner.go: batch pending
+pods -> snapshot cluster -> solve -> launch machines in parallel -> create
+Node objects eagerly -> nominate. The solve is pluggable: the TPU tensor
+solver (solver.TPUSolver) by default with the host GreedySolver as fallback —
+the Solver boundary the reference lacks (its Solve is in-process,
+provisioner.go:301).
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import copy
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from karpenter_core_tpu.api import labels as api_labels
+from karpenter_core_tpu.api.provisioner import Provisioner as ProvisionerCRD
+from karpenter_core_tpu.controllers.provisioning.batcher import Batcher
+from karpenter_core_tpu.controllers.provisioning.volumetopology import VolumeTopology
+from karpenter_core_tpu.kube.objects import Node, NodeStatus, Pod
+from karpenter_core_tpu.metrics.registry import NODES_CREATED
+from karpenter_core_tpu.solver.tpu_solver import GreedySolver, SolvedMachine, SolveResult
+from karpenter_core_tpu.utils import podutils
+
+
+@dataclass
+class LaunchOptions:
+    record_pod_nomination: bool = False
+    reason: str = "provisioning"
+
+
+class ProvisioningController:
+    """provisioner.go:62-126."""
+
+    def __init__(
+        self,
+        kube_client,
+        cloud_provider,
+        cluster,
+        recorder=None,
+        solver=None,
+        fallback_solver=None,
+    ):
+        self.kube_client = kube_client
+        self.cloud_provider = cloud_provider
+        self.cluster = cluster
+        self.recorder = recorder
+        self.solver = solver or GreedySolver()
+        self.fallback_solver = fallback_solver or GreedySolver()
+        self.batcher = Batcher()
+        self.volume_topology = VolumeTopology(kube_client)
+        self._mu = threading.Lock()
+
+    # -- reconcile loop ----------------------------------------------------
+
+    def reconcile(self, wait_timeout: float = 0.0) -> int:
+        """One pass: returns the number of machines launched
+        (provisioner.go:105-126)."""
+        if wait_timeout is not None:
+            if not self.batcher.wait(timeout=wait_timeout):
+                return 0
+        result = self.schedule()
+        if result is None:
+            return 0
+        names = self.launch_machines(
+            result.new_machines, LaunchOptions(record_pod_nomination=True)
+        )
+        created = sum(1 for n in names if n)
+        if created:
+            NODES_CREATED.inc({"reason": "provisioning"}, created)
+        # nominate existing-node placements (scheduler.go:143-153)
+        for state_node, pods in result.existing_assignments:
+            self.cluster.nominate_node_for_pod(state_node.name())
+            if self.recorder:
+                for pod in pods:
+                    self.recorder.nominate_pod(pod, state_node.name())
+        for pod in result.failed_pods:
+            if self.recorder:
+                self.recorder.pod_failed_to_schedule(pod, "unschedulable")
+        return created
+
+    def trigger(self) -> None:
+        self.batcher.trigger()
+
+    # -- scheduling --------------------------------------------------------
+
+    def get_pending_pods(self) -> List[Pod]:
+        """Provisionable pods (provisioner.go:152-174)."""
+        pods = self.kube_client.list("Pod", field_filter=lambda p: p.spec.node_name == "")
+        return [p for p in pods if podutils.is_provisionable(p)]
+
+    def get_daemonset_pods(self) -> List[Pod]:
+        """Synthetic pods from DaemonSet templates (provisioner.go:365-382)."""
+        out = []
+        for ds in self.kube_client.list("DaemonSet"):
+            if ds.pod_template_spec is not None:
+                pod = Pod(spec=copy.deepcopy(ds.pod_template_spec))
+                pod.metadata.name = f"{ds.metadata.name}-daemon"
+                pod.metadata.namespace = ds.metadata.namespace
+                out.append(pod)
+        return out
+
+    def schedule(self) -> Optional[SolveResult]:
+        """provisioner.go:266-302."""
+        # nodes in deletion are excluded from the snapshot; pods bound to
+        # deleting nodes re-enter the batch (provisioner.go:278-295)
+        state_nodes = []
+        deleting_nodes = []
+        for node in self.cluster.nodes():
+            (deleting_nodes if node.is_marked_for_deletion() else state_nodes).append(node)
+        pending = self.get_pending_pods()
+        for node in deleting_nodes:
+            if node.node is not None:
+                for pod in self.kube_client.list(
+                    "Pod", field_filter=lambda p, n=node: p.spec.node_name == n.name()
+                ):
+                    if not podutils.is_terminal(pod) and not podutils.is_owned_by_daemonset(pod):
+                        reschedule = copy.deepcopy(pod)
+                        reschedule.spec.node_name = ""
+                        pending.append(reschedule)
+        if not pending:
+            return None
+        provisioners = [
+            p
+            for p in self.kube_client.list("Provisioner")
+            if p.metadata.deletion_timestamp is None
+        ]
+        if not provisioners:
+            return None
+        instance_types = {
+            p.name: self.cloud_provider.get_instance_types(p) for p in provisioners
+        }
+        pending = [self.volume_topology.inject(copy.deepcopy(p)) for p in pending]
+        daemonset_pods = self.get_daemonset_pods()
+        try:
+            return self.solver.solve(
+                pending,
+                provisioners,
+                instance_types,
+                daemonset_pods=daemonset_pods,
+                state_nodes=state_nodes,
+                kube_client=self.kube_client,
+                cluster=self.cluster,
+            )
+        except Exception:
+            if self.fallback_solver is self.solver:
+                raise
+            # solver outage -> host greedy fallback (SURVEY.md section 7.8)
+            return self.fallback_solver.solve(
+                pending,
+                provisioners,
+                instance_types,
+                daemonset_pods=daemonset_pods,
+                state_nodes=state_nodes,
+                kube_client=self.kube_client,
+                cluster=self.cluster,
+            )
+
+    # -- launching ---------------------------------------------------------
+
+    def launch_machines(
+        self, machines: List[SolvedMachine], opts: Optional[LaunchOptions] = None
+    ) -> List[str]:
+        """Parallel launch (provisioner.go:130-148); failures leave ""."""
+        opts = opts or LaunchOptions()
+        if not machines:
+            return []
+        with concurrent.futures.ThreadPoolExecutor(max_workers=max(len(machines), 1)) as pool:
+            futures = [pool.submit(self._launch_one, m, opts) for m in machines]
+            names = []
+            for f in futures:
+                try:
+                    names.append(f.result())
+                except Exception:
+                    names.append("")
+        return names
+
+    def _launch_one(self, machine: SolvedMachine, opts: LaunchOptions) -> str:
+        """provisioner.go:304-361."""
+        latest = self.kube_client.get("Provisioner", "", machine.provisioner_name)
+        if latest is None:
+            raise RuntimeError(f"provisioner {machine.provisioner_name} not found")
+        if latest.spec.limits is not None:
+            err = latest.spec.limits.exceeded_by(latest.status.resources)
+            if err:
+                raise RuntimeError(err)
+
+        from karpenter_core_tpu.scheduling.requirements import Requirements
+
+        template = copy.copy(machine.template)  # templates are shared across machines
+        template.instance_type_options = list(machine.instance_type_options)
+        template.requirements = Requirements(machine.requirements.values())
+        template.requests = dict(machine.requests)
+        machine_cr = template.to_machine()
+        created = self.cloud_provider.create(machine_cr)
+
+        # persist the launch-intent Machine record for the lifecycle
+        # controllers (machine.Controller); named after the created node so
+        # node<->machine lookups are 1:1
+        machine_cr.metadata.name = created.metadata.name
+        machine_cr.status.provider_id = created.status.provider_id
+        machine_cr.status.capacity = dict(created.status.capacity)
+        machine_cr.status.allocatable = dict(created.status.allocatable)
+        machine_cr.metadata.labels.update(created.metadata.labels)
+        self.kube_client.apply(machine_cr)
+
+        # eagerly create the Node (provisioner.go:337-349)
+        node = template.to_node()
+        node.metadata.name = created.metadata.name
+        node.metadata.labels.update(created.metadata.labels)
+        node.spec.provider_id = created.status.provider_id
+        node.status = NodeStatus()
+        try:
+            self.kube_client.create(node)
+        except Exception:
+            pass  # already self-registered (idempotent, provisioner.go:344-349)
+        self.cluster.update_node(node)
+        self.cluster.nominate_node_for_pod(node.metadata.name)
+        if opts.record_pod_nomination and self.recorder:
+            for pod in machine.pods:
+                self.recorder.nominate_pod(pod, node.metadata.name)
+        return node.metadata.name
+
+
+class PodController:
+    """Pod watcher triggering the batcher for provisionable pods
+    (provisioning/controller.go:56-75)."""
+
+    def __init__(self, provisioner: ProvisioningController):
+        self.provisioner = provisioner
+
+    def reconcile(self, pod: Pod) -> None:
+        if not podutils.is_provisionable(pod):
+            return
+        self.provisioner.trigger()

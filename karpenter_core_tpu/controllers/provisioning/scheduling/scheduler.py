@@ -228,22 +228,7 @@ def build_scheduler(
         p for p in order_by_weight(provisioners) if p.metadata.deletion_timestamp is None
     ]
     templates = [MachineTemplate(p) for p in provisioners]
-    domains: Dict[str, set] = {}
-    for provisioner in provisioners:
-        prov_reqs = Requirements.from_node_selector_requirements(
-            *provisioner.spec.requirements
-        )
-        for instance_type in instance_types.get(provisioner.name, []):
-            # intersect so instance-type zones don't expand past the
-            # provisioner's own universe (provisioner.go:227-237)
-            requirements = Requirements(prov_reqs.values())
-            requirements.add(*instance_type.requirements.values())
-            for key, requirement in requirements.items():
-                domains.setdefault(key, set()).update(requirement.values_list())
-        for key, requirement in prov_reqs.items():
-            if requirement.operator() == "In":
-                domains.setdefault(key, set()).update(requirement.values_list())
-
+    domains = build_domains(provisioners, instance_types)
     topology = Topology(kube_client, cluster, domains, pods)
     return Scheduler(
         kube_client,
@@ -257,6 +242,27 @@ def build_scheduler(
         recorder=recorder,
         opts=opts,
     )
+
+
+def build_domains(
+    provisioners: List[Provisioner], instance_types: Dict[str, List[InstanceType]]
+) -> Dict[str, set]:
+    """Topology-domain universe: provisioner ∩ instance-type requirement
+    values per key (provisioner.go:227-243)."""
+    domains: Dict[str, set] = {}
+    for provisioner in provisioners:
+        prov_reqs = Requirements.from_node_selector_requirements(*provisioner.spec.requirements)
+        for instance_type in instance_types.get(provisioner.name, []):
+            # intersect so instance-type zones don't expand past the
+            # provisioner's own universe (provisioner.go:227-237)
+            requirements = Requirements(prov_reqs.values())
+            requirements.add(*instance_type.requirements.values())
+            for key, requirement in requirements.items():
+                domains.setdefault(key, set()).update(requirement.values_list())
+        for key, requirement in prov_reqs.items():
+            if requirement.operator() == "In":
+                domains.setdefault(key, set()).update(requirement.values_list())
+    return domains
 
 
 def _get_daemon_overhead(

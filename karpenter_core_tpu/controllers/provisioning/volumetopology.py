@@ -1,0 +1,78 @@
+"""Inject PVC zone requirements into pod node-affinity.
+
+Mirrors reference pkg/controllers/provisioning/volumetopology.go:36-120: for
+each PVC-backed volume, derive the viable zones from the bound PV's node
+affinity or the StorageClass allowed-topologies, and AND them into EVERY
+required node-selector term so preference relaxation can't drop them.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from karpenter_core_tpu.kube.objects import (
+    Affinity,
+    NodeAffinity,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    Pod,
+)
+
+
+class VolumeTopology:
+    def __init__(self, kube_client):
+        self.kube_client = kube_client
+
+    def inject(self, pod: Pod) -> Pod:
+        requirements = self._get_requirements(pod)
+        if not requirements:
+            return pod
+        if pod.spec.affinity is None:
+            pod.spec.affinity = Affinity()
+        if pod.spec.affinity.node_affinity is None:
+            pod.spec.affinity.node_affinity = NodeAffinity()
+        if not pod.spec.affinity.node_affinity.required:
+            pod.spec.affinity.node_affinity.required = [NodeSelectorTerm()]
+        # zonal requirements are AND-ed into every OR term (volumetopology.go:53-60)
+        for term in pod.spec.affinity.node_affinity.required:
+            term.match_expressions.extend(requirements)
+        return pod
+
+    def _get_requirements(self, pod: Pod) -> List[NodeSelectorRequirement]:
+        requirements: List[NodeSelectorRequirement] = []
+        for volume in pod.spec.volumes:
+            if volume.persistent_volume_claim is None:
+                continue
+            pvc = self.kube_client.get(
+                "PersistentVolumeClaim",
+                pod.metadata.namespace,
+                volume.persistent_volume_claim.claim_name,
+            )
+            if pvc is None:
+                continue
+            reqs = self._from_bound_pv(pvc) or self._from_storage_class(pvc)
+            if reqs:
+                requirements.extend(reqs)
+        return requirements
+
+    def _from_bound_pv(self, pvc) -> Optional[List[NodeSelectorRequirement]]:
+        if not pvc.spec.volume_name:
+            return None
+        pv = self.kube_client.get("PersistentVolume", "", pvc.spec.volume_name)
+        if pv is None or not pv.spec.node_affinity_required:
+            return None
+        out = []
+        for term in pv.spec.node_affinity_required:
+            out.extend(term.match_expressions)
+        return out or None
+
+    def _from_storage_class(self, pvc) -> Optional[List[NodeSelectorRequirement]]:
+        if not pvc.spec.storage_class_name:
+            return None
+        sc = self.kube_client.get("StorageClass", "", pvc.spec.storage_class_name)
+        if sc is None or not sc.allowed_topologies:
+            return None
+        out = []
+        for term in sc.allowed_topologies:
+            for expr in term.match_label_expressions:
+                out.append(NodeSelectorRequirement(expr.key, "In", list(expr.values)))
+        return out or None

@@ -1,0 +1,45 @@
+"""Node termination finalizer: cordon -> drain -> terminate.
+
+Mirrors reference pkg/controllers/termination/controller.go:50-98: when a
+Node with the termination finalizer is deleted, cordon it, drain (requeueing
+while NodeDrainError persists), then delete the instance and remove the
+finalizer.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from karpenter_core_tpu.api import labels as api_labels
+from karpenter_core_tpu.controllers.machine.terminator import NodeDrainError, Terminator
+from karpenter_core_tpu.kube.objects import Node
+from karpenter_core_tpu.metrics.registry import NODES_TERMINATED
+
+
+class TerminationController:
+    def __init__(self, kube_client, terminator: Terminator, cluster=None, recorder=None):
+        self.kube_client = kube_client
+        self.terminator = terminator
+        self.cluster = cluster
+        self.recorder = recorder
+
+    def reconcile(self, node: Node) -> Optional[float]:
+        if node.metadata.deletion_timestamp is None:
+            return None
+        if api_labels.TERMINATION_FINALIZER not in node.metadata.finalizers:
+            return None
+        return self.finalize(node)
+
+    def finalize(self, node: Node) -> Optional[float]:
+        """controller.go:64-86."""
+        self.terminator.cordon(node)
+        try:
+            self.terminator.drain(node)
+        except NodeDrainError as e:
+            if self.recorder:
+                self.recorder.node_failed_to_drain(node, str(e))
+            return 1.0  # requeue while draining
+        self.terminator.terminate_node(node)
+        NODES_TERMINATED.inc({"reason": "terminated"})
+        if self.cluster is not None:
+            self.cluster.delete_node(node.metadata.name)
+        return None

@@ -1,0 +1,168 @@
+"""Dedup + rate-limited event publisher and typed event constructors.
+
+Mirrors reference pkg/events: Recorder.Publish with a dedupe cache and a
+per-event rate limiter (recorder.go), plus the typed constructors in
+events.go (NominatePod, PodFailedToSchedule, EvictPod, ...).
+
+Events are the user-facing explanation channel; here they land in an
+in-memory ring (inspectable in tests / exported by the operator runtime)
+instead of the kube events API.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass
+from typing import Deque, Dict, List
+
+
+@dataclass(frozen=True)
+class Event:
+    involved_kind: str
+    involved_name: str
+    type: str  # Normal | Warning
+    reason: str
+    message: str
+    dedupe_values: tuple = ()
+    timestamp: float = 0.0
+
+    def dedupe_key(self) -> tuple:
+        return (
+            self.involved_kind,
+            self.involved_name,
+            self.type,
+            self.reason,
+            self.dedupe_values or (self.message,),
+        )
+
+
+class Recorder:
+    """recorder.go: 2-minute dedupe window per full event key + a
+    cluster-wide token-bucket per event TYPE (kind, reason) — the flow
+    control that bounds e.g. total FailedScheduling volume."""
+
+    DEDUPE_TTL = 120.0  # defaultDedupeTimeout (recorder.go)
+    RATE_LIMIT_QPS = 1.0
+    RATE_LIMIT_BURST = 10
+
+    def __init__(self, clock=time.time, capacity: int = 4096):
+        self.clock = clock
+        self._mu = threading.Lock()
+        self._seen: Dict[tuple, float] = {}
+        self._tokens: Dict[tuple, List[float]] = {}  # (kind, reason) -> [tokens, last]
+        self._last_purge = 0.0
+        self.events: Deque[Event] = deque(maxlen=capacity)
+
+    def publish(self, event: Event) -> bool:
+        now = self.clock()
+        key = event.dedupe_key()
+        with self._mu:
+            # periodic purge so the dedupe cache stays bounded (the reference
+            # uses an expiring cache with a 10s purge interval)
+            if now - self._last_purge > self.DEDUPE_TTL:
+                self._seen = {
+                    k: t for k, t in self._seen.items() if now - t < self.DEDUPE_TTL
+                }
+                self._last_purge = now
+            last = self._seen.get(key)
+            if last is not None and now - last < self.DEDUPE_TTL:
+                return False
+            self._seen[key] = now
+            type_key = (event.involved_kind, event.reason)
+            tokens, last_t = self._tokens.get(type_key, [float(self.RATE_LIMIT_BURST), now])
+            tokens = min(
+                float(self.RATE_LIMIT_BURST), tokens + (now - last_t) * self.RATE_LIMIT_QPS
+            )
+            if tokens < 1.0:
+                self._tokens[type_key] = [tokens, now]
+                return False
+            self._tokens[type_key] = [tokens - 1.0, now]
+            self.events.append(
+                Event(
+                    involved_kind=event.involved_kind,
+                    involved_name=event.involved_name,
+                    type=event.type,
+                    reason=event.reason,
+                    message=event.message,
+                    dedupe_values=event.dedupe_values,
+                    timestamp=now,
+                )
+            )
+            return True
+
+    def for_object(self, kind: str, name: str) -> List[Event]:
+        with self._mu:
+            return [e for e in self.events if e.involved_kind == kind and e.involved_name == name]
+
+    # -- typed constructors (events.go) ------------------------------------
+
+    def nominate_pod(self, pod, node_name: str) -> None:
+        self.publish(
+            Event(
+                "Pod",
+                f"{pod.metadata.namespace}/{pod.metadata.name}",
+                "Normal",
+                "Nominated",
+                f"Pod should schedule on {node_name}",
+            )
+        )
+
+    def pod_failed_to_schedule(self, pod, err: str) -> None:
+        self.publish(
+            Event(
+                "Pod",
+                f"{pod.metadata.namespace}/{pod.metadata.name}",
+                "Warning",
+                "FailedScheduling",
+                f"Failed to schedule pod, {err}",
+            )
+        )
+
+    def evict_pod(self, pod) -> None:
+        self.publish(
+            Event(
+                "Pod",
+                f"{pod.metadata.namespace}/{pod.metadata.name}",
+                "Normal",
+                "Evicted",
+                "Evicted pod",
+            )
+        )
+
+    def node_failed_to_drain(self, node, err: str) -> None:
+        self.publish(
+            Event(
+                "Node", node.metadata.name, "Warning", "FailedDraining", f"Failed to drain node, {err}"
+            )
+        )
+
+    def node_inflight_check(self, node, message: str) -> None:
+        self.publish(
+            Event("Node", node.metadata.name, "Warning", "FailedInflightCheck", message)
+        )
+
+    def deprovisioning_blocked(self, kind: str, name: str, reason: str) -> None:
+        self.publish(Event(kind, name, "Normal", "Unconsolidatable", reason))
+
+    def deprovisioning_launching(self, machine_name: str, reason: str) -> None:
+        self.publish(
+            Event(
+                "Machine",
+                machine_name,
+                "Normal",
+                "DeprovisioningLaunching",
+                f"Launching for {reason}",
+            )
+        )
+
+    def deprovisioning_terminating(self, node_name: str, reason: str) -> None:
+        self.publish(
+            Event(
+                "Node",
+                node_name,
+                "Normal",
+                "DeprovisioningTerminating",
+                f"Terminating for {reason}",
+            )
+        )

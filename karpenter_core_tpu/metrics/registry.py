@@ -1,0 +1,149 @@
+"""Minimal in-process metrics registry (counters, gauges, histograms).
+
+The reference exposes prometheus metrics (pkg/metrics/metrics.go:13-38 and
+per-controller instruments). This registry mirrors that surface — namespaced
+metric names, label sets, duration buckets — with an in-memory store and a
+text exposition dump, so the operator runtime can serve/inspect the same
+signals without a prometheus client dependency.
+"""
+from __future__ import annotations
+
+import bisect
+import threading
+from collections import defaultdict
+from typing import Dict, Iterable, List, Optional, Tuple
+
+NAMESPACE = "karpenter"
+
+# metrics.go DurationBuckets
+DURATION_BUCKETS = [
+    0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60, 120, 300,
+]
+
+
+LabelValues = Tuple[Tuple[str, str], ...]
+
+
+def _labels(labels: Optional[Dict[str, str]]) -> LabelValues:
+    return tuple(sorted((labels or {}).items()))
+
+
+class Counter:
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._mu = threading.Lock()
+        self.values: Dict[LabelValues, float] = defaultdict(float)
+
+    def inc(self, labels: Optional[Dict[str, str]] = None, value: float = 1.0) -> None:
+        with self._mu:
+            self.values[_labels(labels)] += value
+
+    def get(self, labels: Optional[Dict[str, str]] = None) -> float:
+        return self.values.get(_labels(labels), 0.0)
+
+
+class Gauge:
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._mu = threading.Lock()
+        self.values: Dict[LabelValues, float] = {}
+
+    def set(self, value: float, labels: Optional[Dict[str, str]] = None) -> None:
+        with self._mu:
+            self.values[_labels(labels)] = value
+
+    def get(self, labels: Optional[Dict[str, str]] = None) -> Optional[float]:
+        return self.values.get(_labels(labels))
+
+    def delete(self, labels: Optional[Dict[str, str]] = None) -> None:
+        with self._mu:
+            self.values.pop(_labels(labels), None)
+
+    def clear(self) -> None:
+        with self._mu:
+            self.values.clear()
+
+
+class Histogram:
+    def __init__(self, name: str, help: str = "", buckets: Iterable[float] = DURATION_BUCKETS):
+        self.name = name
+        self.help = help
+        self.buckets = sorted(buckets)
+        self._mu = threading.Lock()
+        self.bucket_counts: Dict[LabelValues, List[int]] = {}
+        self.sums: Dict[LabelValues, float] = defaultdict(float)
+        self.counts: Dict[LabelValues, int] = defaultdict(int)
+
+    def observe(self, value: float, labels: Optional[Dict[str, str]] = None) -> None:
+        lv = _labels(labels)
+        with self._mu:
+            counts = self.bucket_counts.setdefault(lv, [0] * len(self.buckets))
+            i = bisect.bisect_left(self.buckets, value)
+            for b in range(i, len(self.buckets)):
+                counts[b] += 1
+            self.sums[lv] += value
+            self.counts[lv] += 1
+
+    def percentile(self, q: float, labels: Optional[Dict[str, str]] = None) -> Optional[float]:
+        lv = _labels(labels)
+        counts = self.bucket_counts.get(lv)
+        if not counts or self.counts[lv] == 0:
+            return None
+        target = q * self.counts[lv]
+        for bucket, c in zip(self.buckets, counts):
+            if c >= target:
+                return bucket
+        return self.buckets[-1]
+
+
+class Registry:
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.metrics: Dict[str, object] = {}
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(name, lambda: Counter(name, help))
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(name, lambda: Gauge(name, help))
+
+    def histogram(self, name: str, help: str = "", buckets=DURATION_BUCKETS) -> Histogram:
+        return self._get_or_create(name, lambda: Histogram(name, help, buckets))
+
+    def _get_or_create(self, name: str, factory):
+        with self._mu:
+            if name not in self.metrics:
+                self.metrics[name] = factory()
+            return self.metrics[name]
+
+    def expose(self) -> str:
+        """Prometheus-style text exposition."""
+        lines = []
+        with self._mu:
+            metrics = dict(self.metrics)
+        for name, metric in sorted(metrics.items()):
+            if isinstance(metric, (Counter, Gauge)):
+                for lv, value in sorted(metric.values.items()):
+                    label_str = ",".join(f'{k}="{v}"' for k, v in lv)
+                    lines.append(f"{name}{{{label_str}}} {value:g}")
+            elif isinstance(metric, Histogram):
+                for lv, count in sorted(metric.counts.items()):
+                    label_str = ",".join(f'{k}="{v}"' for k, v in lv)
+                    lines.append(f"{name}_count{{{label_str}}} {count}")
+                    lines.append(f"{name}_sum{{{label_str}}} {metric.sums[lv]:g}")
+        return "\n".join(lines)
+
+
+REGISTRY = Registry()
+
+# shared instruments (pkg/metrics/metrics.go:13-38)
+NODES_CREATED = REGISTRY.counter(
+    f"{NAMESPACE}_nodes_created", "Nodes created in total by the framework, by reason"
+)
+NODES_TERMINATED = REGISTRY.counter(
+    f"{NAMESPACE}_nodes_terminated", "Nodes terminated in total by the framework, by reason"
+)
+MACHINES_CREATED = REGISTRY.counter(f"{NAMESPACE}_machines_created")
+MACHINES_TERMINATED = REGISTRY.counter(f"{NAMESPACE}_machines_terminated")

@@ -1,0 +1,233 @@
+"""Operator runtime: wires informers + controllers over the in-memory kube
+store and runs them.
+
+Mirrors reference pkg/operator + pkg/controllers/controllers.go:46-73 (the
+one place all 13 controllers are wired) and operator/controller/singleton.go
+(self-clocked loops). The reference's manager/watch machinery maps to watch
+pump threads; leader election is a no-op single-process lease; the TPU solver
+replaces Scheduler.Solve behind the Solver interface.
+
+Two run modes:
+  step()  — synchronous single pass over every controller (deterministic for
+            tests and simulations; the envtest-style harness)
+  start() — background threads: watch pumps + singleton loops
+"""
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from karpenter_core_tpu.api.settings import Settings, set_current
+from karpenter_core_tpu.controllers.counter.controller import CounterController
+from karpenter_core_tpu.controllers.inflightchecks.controller import InflightChecksController
+from karpenter_core_tpu.controllers.machine.controller import MachineController
+from karpenter_core_tpu.controllers.machine.terminator import EvictionQueue, Terminator
+from karpenter_core_tpu.controllers.metrics.controllers import (
+    NodeMetricsController,
+    PodMetricsController,
+    ProvisionerMetricsController,
+)
+from karpenter_core_tpu.controllers.node.controller import NodeController
+from karpenter_core_tpu.controllers.provisioning.provisioner import (
+    PodController,
+    ProvisioningController,
+)
+from karpenter_core_tpu.controllers.termination.controller import TerminationController
+from karpenter_core_tpu.events import Recorder
+from karpenter_core_tpu.kube.client import InMemoryKubeClient
+from karpenter_core_tpu.state.cluster import Cluster
+from karpenter_core_tpu.state.informer import (
+    MachineInformer,
+    NodeInformer,
+    PodInformer,
+    ProvisionerInformer,
+)
+
+
+@dataclass
+class Operator:
+    """The assembled control plane (controllers.go:46-73)."""
+
+    kube_client: InMemoryKubeClient
+    cloud_provider: object
+    cluster: Cluster
+    recorder: Recorder
+    provisioning: ProvisioningController
+    pod_controller: PodController
+    machine_controller: MachineController
+    node_controller: NodeController
+    termination_controller: TerminationController
+    inflight_checks: InflightChecksController
+    counter: CounterController
+    deprovisioning: object
+    node_metrics: NodeMetricsController
+    pod_metrics: PodMetricsController
+    provisioner_metrics: ProvisionerMetricsController
+    eviction_queue: EvictionQueue
+    terminator: Terminator
+    clock: object = time.time
+    _threads: List[threading.Thread] = field(default_factory=list)
+    _stop: threading.Event = field(default_factory=threading.Event)
+
+    # -- synchronous harness (envtest analog) ------------------------------
+
+    def sync_state(self) -> None:
+        """Pump current store contents through the informers."""
+        node_inf = NodeInformer(self.cluster)
+        pod_inf = PodInformer(self.cluster)
+        machine_inf = MachineInformer(self.cluster)
+        for node in self.kube_client.list("Node"):
+            node_inf.handle("MODIFIED", node)
+        for machine in self.kube_client.list("Machine"):
+            machine_inf.handle("MODIFIED", machine)
+        for pod in self.kube_client.list("Pod"):
+            pod_inf.handle("MODIFIED", pod)
+
+    def step(self, provision: bool = True, deprovision: bool = False) -> dict:
+        """One synchronous pass over the controller chain. Returns a summary
+        of actions taken."""
+        self.sync_state()
+        summary = {"launched": 0, "deprovisioned": False}
+        for machine in self.kube_client.list("Machine"):
+            self.machine_controller.reconcile(machine)
+        for node in self.kube_client.list("Node"):
+            self.node_controller.reconcile(node)
+            self.termination_controller.reconcile(node)
+        self.sync_state()
+        if provision:
+            summary["launched"] = self.provisioning.reconcile(wait_timeout=None)
+            self.sync_state()
+        for machine in self.kube_client.list("Machine"):
+            self.machine_controller.reconcile(machine)
+        for provisioner in self.kube_client.list("Provisioner"):
+            self.counter.reconcile(provisioner)
+            self.provisioner_metrics.reconcile(provisioner)
+        if deprovision and self.deprovisioning is not None:
+            summary["deprovisioned"] = self.deprovisioning.reconcile()
+        self.node_metrics.reconcile()
+        self.eviction_queue.drain()
+        return summary
+
+    # -- background runtime -------------------------------------------------
+
+    def start(self) -> None:
+        """Watch pumps + singleton loops (operator.go:154-169)."""
+        self.eviction_queue.start()
+        watches = [
+            ("Node", NodeInformer(self.cluster).handle),
+            ("Pod", PodInformer(self.cluster).handle),
+            ("Machine", MachineInformer(self.cluster).handle),
+            ("Provisioner", ProvisionerInformer(self.cluster).handle),
+        ]
+        for kind, handler in watches:
+            q = self.kube_client.watch(kind)
+
+            def pump(q=q, handler=handler, kind=kind):
+                while not self._stop.is_set():
+                    try:
+                        event, obj = q.get(timeout=0.1)
+                    except Exception:
+                        continue
+                    handler(event, obj)
+                    if kind == "Pod":
+                        self.pod_controller.reconcile(obj)
+                        self.pod_metrics.reconcile(obj)
+
+            t = threading.Thread(target=pump, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+        def provision_loop():
+            while not self._stop.is_set():
+                try:
+                    self.provisioning.reconcile(wait_timeout=0.2)
+                except Exception:
+                    pass
+
+        def deprovision_loop():
+            while not self._stop.is_set():
+                try:
+                    if self.deprovisioning is not None:
+                        self.deprovisioning.reconcile()
+                except Exception:
+                    pass
+                self._stop.wait(1.0)
+
+        def housekeeping_loop():
+            while not self._stop.is_set():
+                try:
+                    for machine in self.kube_client.list("Machine"):
+                        self.machine_controller.reconcile(machine)
+                    for node in self.kube_client.list("Node"):
+                        self.node_controller.reconcile(node)
+                        self.termination_controller.reconcile(node)
+                    for provisioner in self.kube_client.list("Provisioner"):
+                        self.counter.reconcile(provisioner)
+                    self.node_metrics.reconcile()
+                except Exception:
+                    pass
+                self._stop.wait(1.0)
+
+        for target in (provision_loop, deprovision_loop, housekeeping_loop):
+            t = threading.Thread(target=target, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        self.eviction_queue.stop()
+
+
+def new_operator(
+    cloud_provider,
+    kube_client: Optional[InMemoryKubeClient] = None,
+    settings: Optional[Settings] = None,
+    solver=None,
+    clock=time.time,
+) -> Operator:
+    """Assemble the full control plane (controllers.go:46-73)."""
+    if settings is not None:
+        set_current(settings)
+    kube_client = kube_client or InMemoryKubeClient()
+    recorder = Recorder(clock=clock)
+    cluster = Cluster(kube_client, cloud_provider, clock=clock)
+    eviction_queue = EvictionQueue(kube_client, recorder)
+    terminator = Terminator(kube_client, cloud_provider, eviction_queue, clock=clock)
+    provisioning = ProvisioningController(
+        kube_client, cloud_provider, cluster, recorder=recorder, solver=solver
+    )
+    from karpenter_core_tpu.controllers.deprovisioning.controller import (
+        DeprovisioningController,
+    )
+
+    deprovisioning = DeprovisioningController(
+        kube_client, cluster, provisioning, cloud_provider, recorder, clock=clock
+    )
+    return Operator(
+        kube_client=kube_client,
+        cloud_provider=cloud_provider,
+        cluster=cluster,
+        recorder=recorder,
+        provisioning=provisioning,
+        pod_controller=PodController(provisioning),
+        machine_controller=MachineController(
+            kube_client, cloud_provider, cluster, terminator, recorder, clock=clock
+        ),
+        node_controller=NodeController(kube_client, cloud_provider, cluster, clock=clock),
+        termination_controller=TerminationController(
+            kube_client, terminator, cluster, recorder
+        ),
+        inflight_checks=InflightChecksController(
+            kube_client, cloud_provider, cluster, recorder, clock=clock
+        ),
+        counter=CounterController(kube_client, cluster),
+        deprovisioning=deprovisioning,
+        node_metrics=NodeMetricsController(cluster),
+        pod_metrics=PodMetricsController(kube_client, clock=clock),
+        provisioner_metrics=ProvisionerMetricsController(kube_client),
+        eviction_queue=eviction_queue,
+        terminator=terminator,
+        clock=clock,
+    )

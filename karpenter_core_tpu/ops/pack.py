@@ -4,35 +4,39 @@ Replaces the serial Solve loop (reference scheduler.go:96-133,177-222) with a
 device-resident scan over a fixed budget of node slots:
 
   slot state: accumulated requests, merged requirement masks, remaining
-  instance-type mask, per-resource optimistic max-allocatable, pod count.
+  instance-type mask, per-resource optimistic max-allocatable, pod count;
+  global state: per-topology-group domain counts (ops/topology.py).
 
 Per pod step:
   1. SCREEN all slots cheaply: taints ∧ requirement-compat ∧ optimistic fit
-     (used + pod <= per-slot max over remaining types).
+     (used + pod <= per-slot max over remaining types) ∧ topology viability.
   2. Rank candidates by the reference's order: existing nodes (index order)
      first, then open machines ascending pod count (scheduler.go:179-193).
-  3. VERIFY the best candidate exactly: remaining types that are compatible
-     with the MERGED slot∪pod requirements, fit the accumulated usage, and
-     retain an available offering (machine.go:137-159). On failure, mask the
-     candidate and retry (bounded while_loop).
+  3. VERIFY the best candidate exactly: merge slot ∪ pod requirements,
+     narrow by the topology domain choice (skew-rule argmin domain etc.),
+     recompute the surviving instance types (compatible ∧ fits ∧ offering,
+     machine.go:137-159). On failure, mask the candidate and retry (bounded
+     while_loop).
   4. Otherwise OPEN a new slot from the first template whose fresh machine
-     can host the pod (weight order, scheduler.go:195-221), honoring
-     provisioner limits via pessimistic max-capacity subtraction
-     (scheduler.go:276-293).
+     (fresh hostname domain) can host the pod (weight order,
+     scheduler.go:195-221), honoring provisioner limits via pessimistic
+     max-capacity subtraction (scheduler.go:276-293).
+  5. COMMIT: update slot state and record the placement into topology domain
+     counts (topology.go:120-143).
 
 Slots [0, E) are pre-seeded with existing nodes (fixed capacity, no type
-narrowing); machine slots open from E upward.
+narrowing); machine slots open from E upward. Machine slot n's hostname
+domain is the pre-registered dictionary value slot-hostname-n.
 """
 from __future__ import annotations
 
-from functools import partial
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 
 from karpenter_core_tpu.ops import compat
-from karpenter_core_tpu.ops.feasibility import merge_reqsets
+from karpenter_core_tpu.ops import topology as topo
 
 BIG = jnp.float32(1e30)
 
@@ -52,6 +56,9 @@ class PackState(NamedTuple):
     #                   machine=max over remaining types' allocatable
     nopen: jnp.ndarray  # scalar int32 — next free slot
     remaining: jnp.ndarray  # [J, R] provisioner remaining limit (+inf if none)
+    tcounts: jnp.ndarray  # [G, V] topology domain counts (value-key groups)
+    thost: jnp.ndarray  # [G, N] hostname-group counts per slot
+    tdoms: jnp.ndarray  # [G, V] registered domains per group
 
 
 def _segment_max_alloc(tmask: jnp.ndarray, type_alloc: jnp.ndarray) -> jnp.ndarray:
@@ -60,11 +67,19 @@ def _segment_max_alloc(tmask: jnp.ndarray, type_alloc: jnp.ndarray) -> jnp.ndarr
     return masked.max(axis=-2)
 
 
-def make_pack_kernel(segments, zone_seg, ct_seg, max_verify_tries: int = 16):
-    """Build the jittable packing fn for a fixed label geometry."""
+def make_pack_kernel(
+    segments,
+    zone_seg,
+    ct_seg,
+    max_verify_tries: int = 16,
+    topo_meta: Optional[topo.TopoMeta] = None,
+):
+    """Build the jittable packing fn for a fixed label geometry (+ topology
+    group structure when the batch has topology constraints)."""
 
     zlo, zhi = zone_seg
     clo, chi = ct_seg
+    has_topo = topo_meta is not None and len(topo_meta.groups) > 0
 
     def slot_compat_screen(state: PackState, prow):
         """[N] bool: pod-vs-slot requirement compatibility + custom rule
@@ -86,14 +101,11 @@ def make_pack_kernel(segments, zone_seg, ct_seg, max_verify_tries: int = 16):
         ok &= ~jnp.any(deny[None, :] & ~state.defined, axis=-1)
         return ok
 
-    def verify_slot(state: PackState, prow, n, type_reqs, type_alloc, type_offering_ok, f_static_p):
-        """Exact acceptance check on slot n; returns (ok, new_tmask[T])."""
-        m_allow = state.allow[n] & prow["allow"]  # [V]
-        m_out = state.out[n] & prow["out"]
-        m_defined = state.defined[n] | prow["defined"]
+    def merged_types_ok(m_allow, m_out, m_defined, new_used, base_tmask,
+                        type_reqs, type_alloc, type_offering_ok):
+        """[T]: surviving instance types for a merged requirement row
+        (compatible ∧ fits ∧ hasOffering — machine.go:137-159)."""
         m_escape = compat.escape_flags(m_allow[None], m_out[None], m_defined[None], segments)[0]
-
-        # per-type compat with merged requirements
         ok_t = jnp.ones(type_alloc.shape[0], dtype=bool)
         for k, (lo, hi) in enumerate(segments):
             shared = m_defined[k] & type_reqs["defined"][:, k]
@@ -105,9 +117,7 @@ def make_pack_kernel(segments, zone_seg, ct_seg, max_verify_tries: int = 16):
                 nonempty = both_out
             escapes = m_escape[k] & type_reqs["escape"][:, k]
             ok_t &= (~shared) | nonempty | escapes
-
-        new_used = state.used[n] + prow["requests"]  # [R]
-        fit_t = compat.fits(new_used[None, :], type_alloc)  # [T]
+        fit_t = compat.fits(new_used[None, :], type_alloc)
         offer_t = (
             jnp.einsum(
                 "tzc,z,c->t",
@@ -117,43 +127,57 @@ def make_pack_kernel(segments, zone_seg, ct_seg, max_verify_tries: int = 16):
             )
             > 0.5
         )
-        new_tmask = (
-            state.tmask[n]
-            & ok_t
-            & fit_t
-            & offer_t
-            & f_static_p[state.tmpl[n]]
+        return base_tmask & ok_t & fit_t & offer_t
+
+    def verify_slot(state: PackState, prow, n, type_reqs, type_alloc,
+                    type_offering_ok, f_static_p):
+        """Exact acceptance check on slot n.
+        Returns (ok, new_tmask[T], narrow[V])."""
+        slot_allow = state.allow[n]
+        K = state.out.shape[1]
+        if has_topo:
+            t_viable, narrow, applied_keys = topo.topo_narrow_single(
+                topo_meta, state.tcounts, state.thost, state.tdoms,
+                prow["topo_own"], prow["topo_sel"], prow["allow"], slot_allow, n, K,
+            )
+        else:
+            t_viable = jnp.bool_(True)
+            narrow = jnp.ones_like(slot_allow)
+            applied_keys = jnp.zeros(K, dtype=bool)
+
+        m_allow = slot_allow & prow["allow"] & narrow
+        # topology-narrowed keys become DEFINED concrete In-sets
+        # (AddRequirements, topology.go:149-167)
+        m_out = state.out[n] & prow["out"] & ~applied_keys
+        m_defined = state.defined[n] | prow["defined"] | applied_keys
+        new_used = state.used[n] + prow["requests"]
+
+        new_tmask = merged_types_ok(
+            m_allow, m_out, m_defined, new_used,
+            state.tmask[n] & f_static_p[state.tmpl[n]],
+            type_reqs, type_alloc, type_offering_ok,
         )
         is_existing = state.is_existing[n]
         fit_existing = compat.fits(new_used, state.cap[n])
-        ok = jnp.where(is_existing, fit_existing, new_tmask.any())
-        return ok, new_tmask
+        ok = t_viable & jnp.where(is_existing, fit_existing, new_tmask.any())
+        return ok, new_tmask, narrow, applied_keys
 
-    def commit(state: PackState, prow, n, new_tmask, type_alloc):
-        m_allow = state.allow[n] & prow["allow"]
-        m_out = state.out[n] & prow["out"]
-        m_defined = state.defined[n] | prow["defined"]
-        new_used = state.used[n] + prow["requests"]
-        is_existing = state.is_existing[n]
-        new_cap = jnp.where(
-            is_existing, state.cap[n], _segment_max_alloc(new_tmask, type_alloc)
+    def record_topo(state: PackState, prow, m_allow, m_out, m_defined,
+                    well_known, terms, slot_n):
+        if not has_topo:
+            return state
+        nf_ok = topo.topo_node_filter_ok(
+            topo_meta, terms, segments, well_known, m_allow, m_out, m_defined
         )
-        return state._replace(
-            used=state.used.at[n].set(new_used),
-            pods=state.pods.at[n].add(1),
-            allow=state.allow.at[n].set(m_allow),
-            out=state.out.at[n].set(m_out),
-            defined=state.defined.at[n].set(m_defined),
-            tmask=jnp.where(
-                is_existing, state.tmask, state.tmask.at[n].set(new_tmask)
-            ),
-            cap=state.cap.at[n].set(new_cap),
+        tcounts, thost, tdoms = topo.topo_record(
+            topo_meta, state.tcounts, state.thost, state.tdoms,
+            prow["topo_own"], prow["topo_sel"], nf_ok, m_allow, m_out, slot_n,
         )
+        return state._replace(tcounts=tcounts, thost=thost, tdoms=tdoms)
 
     def pack(
         state: PackState,
-        pod_arrays: dict,  # allow [P,V], out/defined/escape/custom_deny [P,K],
-        #                    requests [P,R], tol [P, J+E], valid [P]
+        pod_arrays: dict,
         f_static: jnp.ndarray,  # [J, P, T]
         openable: jnp.ndarray,  # [J, P]
         tmpl_reqs: dict,  # [J, ...]
@@ -163,10 +187,13 @@ def make_pack_kernel(segments, zone_seg, ct_seg, max_verify_tries: int = 16):
         type_alloc: jnp.ndarray,
         type_capacity: jnp.ndarray,
         type_offering_ok: jnp.ndarray,
+        well_known: jnp.ndarray = None,
+        topo_terms: dict = None,
     ):
         N = state.used.shape[0]
         J = tmpl_daemon.shape[0]
         P = pod_arrays["requests"].shape[0]
+        V = state.allow.shape[1]
 
         def step(state: PackState, i):
             prow = {
@@ -177,6 +204,9 @@ def make_pack_kernel(segments, zone_seg, ct_seg, max_verify_tries: int = 16):
                 "custom_deny": pod_arrays["custom_deny"][i],
                 "requests": pod_arrays["requests"][i],
             }
+            if has_topo:
+                prow["topo_own"] = pod_arrays["topo_own"][i]
+                prow["topo_sel"] = pod_arrays["topo_sel"][i]
             valid = pod_arrays["valid"][i]
 
             # -- screen --------------------------------------------------
@@ -184,6 +214,11 @@ def make_pack_kernel(segments, zone_seg, ct_seg, max_verify_tries: int = 16):
             fit_screen = compat.fits(state.used + prow["requests"][None, :], state.cap)
             req_screen = slot_compat_screen(state, prow)
             screen = state.open & tol & fit_screen & req_screen
+            if has_topo:
+                screen &= topo.topo_screen(
+                    topo_meta, state.tcounts, state.thost, state.tdoms,
+                    prow["topo_own"], prow["topo_sel"], prow["allow"], state.allow,
+                )
 
             # rank: existing first by index, then machines by (pods, index)
             idx = jnp.arange(N, dtype=jnp.float32)
@@ -195,16 +230,16 @@ def make_pack_kernel(segments, zone_seg, ct_seg, max_verify_tries: int = 16):
             score = jnp.where(screen, score, BIG)
 
             # -- verify loop ---------------------------------------------
-            def cond(carry):
-                found, tries, cand, score, _ = carry
-                return (~found) & (tries < max_verify_tries) & (score.min() < BIG)
-
             f_static_p = f_static[:, i, :]  # [J, T]
 
+            def cond2(carry):
+                found, tries, cand, score, _, _, _ = carry
+                return (~found) & (tries < max_verify_tries) & (score.min() < BIG)
+
             def body(carry):
-                found, tries, cand, score, tmask_out = carry
+                found, tries, cand, score, tmask_out, narrow_out, keys_out = carry
                 n = jnp.argmin(score)
-                ok, new_tmask = verify_slot(
+                ok, new_tmask, narrow, applied_keys = verify_slot(
                     state, prow, n, type_reqs, type_alloc, type_offering_ok, f_static_p
                 )
                 score = score.at[n].set(BIG)
@@ -214,10 +249,13 @@ def make_pack_kernel(segments, zone_seg, ct_seg, max_verify_tries: int = 16):
                     jnp.where(ok, n, cand),
                     score,
                     jnp.where(ok, new_tmask, tmask_out),
+                    jnp.where(ok, narrow, narrow_out),
+                    jnp.where(ok, applied_keys, keys_out),
                 )
 
-            found, _, cand, _, cand_tmask = jax.lax.while_loop(
-                cond,
+            K = state.out.shape[1]
+            found, _, cand, _, cand_tmask, cand_narrow, cand_keys = jax.lax.while_loop(
+                cond2,
                 body,
                 (
                     jnp.bool_(False),
@@ -225,23 +263,50 @@ def make_pack_kernel(segments, zone_seg, ct_seg, max_verify_tries: int = 16):
                     jnp.int32(-1),
                     score,
                     jnp.zeros_like(state.tmask[0]),
+                    jnp.ones(V, dtype=bool),
+                    jnp.zeros(K, dtype=bool),
                 ),
             )
 
             # -- open new slot --------------------------------------------
-            # first template (weight order) that can host the pod within limits
+            # fresh slot hostname is its slot identity (thost row = 0)
             cap_ok = jnp.all(
                 type_capacity[None, :, :] <= state.remaining[:, None, :], axis=-1
             )  # [J, T]
-            open_types = (
-                f_static[:, i, :]
-                & cap_ok
-                & compat.fits(
-                    (tmpl_daemon[:, None, :] + prow["requests"][None, None, :]),
-                    type_alloc[None, :, :],
+            open_viable = []
+            open_narrows = []
+            open_outs = []
+            open_defs = []
+            open_types_rows = []
+            for j in range(J):  # static unroll — J is the provisioner count
+                fresh_allow = tmpl_reqs["allow"][j]
+                if has_topo:
+                    tv, tnarrow, tkeys = topo.topo_narrow_single(
+                        topo_meta, state.tcounts, state.thost, state.tdoms,
+                        prow["topo_own"], prow["topo_sel"], prow["allow"], fresh_allow,
+                        state.nopen, K,
+                    )
+                else:
+                    tv = jnp.bool_(True)
+                    tnarrow = jnp.ones(V, dtype=bool)
+                    tkeys = jnp.zeros(K, dtype=bool)
+                m_allow_j = fresh_allow & prow["allow"] & tnarrow
+                m_out_j = tmpl_reqs["out"][j] & prow["out"] & ~tkeys
+                m_def_j = tmpl_reqs["defined"][j] | prow["defined"] | tkeys
+                types_j = merged_types_ok(
+                    m_allow_j, m_out_j, m_def_j,
+                    tmpl_daemon[j] + prow["requests"],
+                    tmpl_type_mask[j] & cap_ok[j] & f_static_p[j],
+                    type_reqs, type_alloc, type_offering_ok,
                 )
-            )  # [J, T]
-            can_open_j = open_types.any(axis=-1) & openable[:, i]  # [J]
+                open_viable.append(tv & types_j.any())
+                open_narrows.append(m_allow_j)
+                open_outs.append(m_out_j)
+                open_defs.append(m_def_j)
+                open_types_rows.append(types_j)
+            can_open_j = jnp.stack(open_viable) & openable[:, i]  # [J]
+            open_allow_rows = jnp.stack(open_narrows)  # [J, V]
+            open_types = jnp.stack(open_types_rows)  # [J, T]
             j_choice = jnp.argmax(can_open_j)
             can_open = can_open_j.any() & (state.nopen < N)
 
@@ -249,24 +314,45 @@ def make_pack_kernel(segments, zone_seg, ct_seg, max_verify_tries: int = 16):
             do_assign = valid & (found | can_open)
             slot = jnp.where(found, cand, state.nopen)
 
-            # build the opened slot's state row
             new_tmask = jnp.where(found, cand_tmask, open_types[j_choice])
-            opened_allow = tmpl_reqs["allow"][j_choice] & prow["allow"]
-            opened_out = tmpl_reqs["out"][j_choice] & prow["out"]
-            opened_defined = tmpl_reqs["defined"][j_choice] | prow["defined"]
+            opened_allow = open_allow_rows[j_choice]
+            opened_out = jnp.stack(open_outs)[j_choice]
+            opened_defined = jnp.stack(open_defs)[j_choice]
             opened_used = tmpl_daemon[j_choice] + prow["requests"]
             opened_cap = _segment_max_alloc(new_tmask, type_alloc)
 
             def apply_found(state):
-                return commit(state, prow, cand, cand_tmask, type_alloc)
+                n = cand
+                m_allow = state.allow[n] & prow["allow"] & cand_narrow
+                m_out = state.out[n] & prow["out"] & ~cand_keys
+                m_defined = state.defined[n] | prow["defined"] | cand_keys
+                new_used = state.used[n] + prow["requests"]
+                is_existing = state.is_existing[n]
+                new_cap = jnp.where(
+                    is_existing, state.cap[n], _segment_max_alloc(cand_tmask, type_alloc)
+                )
+                state = state._replace(
+                    used=state.used.at[n].set(new_used),
+                    pods=state.pods.at[n].add(1),
+                    allow=state.allow.at[n].set(m_allow),
+                    out=state.out.at[n].set(m_out),
+                    defined=state.defined.at[n].set(m_defined),
+                    tmask=jnp.where(
+                        is_existing, state.tmask, state.tmask.at[n].set(cand_tmask)
+                    ),
+                    cap=state.cap.at[n].set(new_cap),
+                )
+                return record_topo(
+                    state, prow, m_allow, m_out, m_defined, well_known, topo_terms, n
+                )
 
             def apply_open(state):
                 n = state.nopen
-                # pessimistic limit subtraction: max capacity over the opened
-                # slot's surviving types (scheduler.go:276-293)
+                # pessimistic limit subtraction over surviving types
+                # (scheduler.go:276-293)
                 max_cap = jnp.where(new_tmask[:, None], type_capacity, -BIG).max(axis=0)
                 max_cap = jnp.maximum(max_cap, 0.0)
-                return state._replace(
+                state = state._replace(
                     used=state.used.at[n].set(opened_used),
                     open=state.open.at[n].set(True),
                     is_existing=state.is_existing.at[n].set(False),
@@ -280,6 +366,10 @@ def make_pack_kernel(segments, zone_seg, ct_seg, max_verify_tries: int = 16):
                     cap=state.cap.at[n].set(opened_cap),
                     nopen=state.nopen + 1,
                     remaining=state.remaining.at[j_choice].add(-max_cap),
+                )
+                return record_topo(
+                    state, prow, opened_allow, opened_out, opened_defined,
+                    well_known, topo_terms, n,
                 )
 
             state = jax.lax.cond(

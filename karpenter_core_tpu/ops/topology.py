@@ -1,0 +1,355 @@
+"""Topology as device-side domain-count tensors.
+
+Lowers the host Topology (controllers/provisioning/scheduling/topology.py,
+mirroring reference topology.go/topologygroup.go) onto arrays the packing
+kernel updates in-place:
+
+  counts[G, V]      per-group occupancy per domain (flat value axis) —
+                    zone/region/custom-key groups
+  hcounts[G, N]     hostname-key groups count per SLOT: a machine slot is
+                    identical to its (placeholder) hostname domain
+                    (machine.go:44-48 registers one fresh hostname per
+                    machine), so slot identity replaces dictionary values and
+                    the value axis stays small at 50k pods
+  domain_mask[G, V] which flat values are registered domains of the group
+  owner[G, P]       pod carries the constraint (direct groups)
+  sel[G, P]         group's selector matches the pod
+
+Per-(pod, slot) viability and the committed narrowing follow
+topologygroup.go:155-243; Record follows topology.go:120-143 including the
+anti-affinity "block out all possible domains" rule and the
+Requirement.Values() complement quirk.
+
+Known approximation: hostname domains of nodes NOT in the candidate set
+(unowned nodes) are invisible to hostname-affinity seeding — such domains are
+never placeable anyway, and hostname spread's min-count is pinned to 0 by the
+reference (topologygroup.go:186-188), so placement decisions match.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+TOPO_SPREAD = 0
+TOPO_AFFINITY = 1
+TOPO_ANTI = 2
+
+
+@dataclass
+class TopoGroupMeta:
+    """Static (trace-time) description of one group."""
+
+    gtype: int
+    seg: Tuple[int, int]
+    key_k: int  # key index (for the complement flag of merged requirements)
+    max_skew: int
+    is_hostname: bool
+    is_inverse: bool
+    filter_term_rows: List[int]  # rows into the filter-term ReqSet arrays
+
+
+@dataclass
+class TopoArrays:
+    """Dynamic per-solve arrays."""
+
+    counts0: np.ndarray  # [G, V] float32 (value-key groups)
+    hcounts0: np.ndarray  # [G, N] float32 (hostname groups, per slot)
+    domain_mask0: np.ndarray  # [G, V] bool
+    owner: np.ndarray  # [G, P] bool
+    sel: np.ndarray  # [G, P] bool
+    # node-filter terms as a flat ReqSet batch
+    term_allow: np.ndarray  # [GT, V]
+    term_out: np.ndarray  # [GT, K]
+    term_defined: np.ndarray  # [GT, K]
+    term_escape: np.ndarray  # [GT, K]
+
+
+@dataclass
+class TopoMeta:
+    groups: List[TopoGroupMeta] = field(default_factory=list)
+
+
+def encode_topology(
+    host_topology,
+    pods_sorted,
+    dictionary,
+    n_slots: int,
+    exist_hostnames: List[str],
+) -> Tuple[Optional[TopoMeta], Optional[TopoArrays]]:
+    """Lower a host Topology (already seeded with cluster counts) to arrays.
+    exist_hostnames[e] maps existing slot e -> its hostname domain.
+    Returns (None, None) when the batch has no topology constraints."""
+    from karpenter_core_tpu.kube.objects import LABEL_HOSTNAME
+    from karpenter_core_tpu.solver.encode import encode_reqsets
+
+    groups = list(host_topology.topologies.values()) + list(
+        host_topology.inverse_topologies.values()
+    )
+    if not groups:
+        return None, None
+
+    P = len(pods_sorted)
+    V = dictionary.V
+    G = len(groups)
+    uid_to_idx = {p.metadata.uid: i for i, p in enumerate(pods_sorted)}
+    n_direct = len(host_topology.topologies)
+
+    metas: List[TopoGroupMeta] = []
+    counts0 = np.zeros((G, V), dtype=np.float32)
+    hcounts0 = np.zeros((G, n_slots), dtype=np.float32)
+    domain_mask0 = np.zeros((G, V), dtype=bool)
+    owner = np.zeros((G, P), dtype=bool)
+    sel = np.zeros((G, P), dtype=bool)
+    term_reqs = []
+
+    type_map = {
+        "topology spread": TOPO_SPREAD,
+        "pod affinity": TOPO_AFFINITY,
+        "pod anti-affinity": TOPO_ANTI,
+    }
+    for g, tg in enumerate(groups):
+        is_hostname = tg.key == LABEL_HOSTNAME
+        seg = dictionary.segment(tg.key) if tg.key in dictionary.key_index else (0, 0)
+        rows = []
+        for term in tg.node_filter.terms:
+            rows.append(len(term_reqs))
+            term_reqs.append(term)
+        metas.append(
+            TopoGroupMeta(
+                gtype=type_map[tg.type],
+                seg=seg,
+                key_k=dictionary.key_index.get(tg.key, 0),
+                max_skew=int(tg.max_skew),
+                is_hostname=is_hostname,
+                is_inverse=(g >= n_direct),
+                filter_term_rows=rows,
+            )
+        )
+        if is_hostname:
+            for e, hostname in enumerate(exist_hostnames):
+                hcounts0[g, e] = tg.domains.get(hostname, 0)
+        else:
+            for domain, count in tg.domains.items():
+                fi = dictionary.flat_index(tg.key, domain)
+                if fi is None:
+                    continue
+                domain_mask0[g, fi] = True
+                counts0[g, fi] = count
+        for uid in tg.owners:
+            if uid in uid_to_idx:
+                owner[g, uid_to_idx[uid]] = True
+        for i, pod in enumerate(pods_sorted):
+            sel[g, i] = tg._selects(pod)
+
+    encoded_terms = encode_reqsets(term_reqs, dictionary)
+    meta = TopoMeta(groups=metas)
+    arrays = TopoArrays(
+        counts0=counts0,
+        hcounts0=hcounts0,
+        domain_mask0=domain_mask0,
+        owner=owner,
+        sel=sel,
+        term_allow=encoded_terms.allow,
+        term_out=encoded_terms.out,
+        term_defined=encoded_terms.defined,
+        term_escape=encoded_terms.escape,
+    )
+    return meta, arrays
+
+
+# ---------------------------------------------------------------------------
+# device-side group evaluation (used inside the packing scan)
+
+
+def _first_true_onehot(mask):
+    """[..., S] bool -> onehot of the first True (all-False rows -> zeros)."""
+    import jax.numpy as jnp
+
+    idx = jnp.argmax(mask, axis=-1)
+    oh = jnp.arange(mask.shape[-1]) == idx[..., None]
+    return oh & mask.any(axis=-1, keepdims=True)
+
+
+def topo_screen(meta: TopoMeta, tcounts, thost, tdoms, own, selp, pod_allow, slot_allow):
+    """Batched viability over all slots: [N] bool.
+
+    own/selp: [G] bool for THIS pod; pod_allow [V]; slot_allow [N, V].
+    Follows topologygroup.go Get(): spread skew rule, affinity positive/seed
+    domains, anti-affinity zero-count domains. Hostname groups evaluate on
+    slot identity (thost [G, N])."""
+    import jax.numpy as jnp
+
+    N = slot_allow.shape[0]
+    viable = jnp.ones(N, dtype=bool)
+    for g, gm in enumerate(meta.groups):
+        applies = selp[g] if gm.is_inverse else own[g]
+        if gm.is_hostname:
+            hc = thost[g]  # [N]
+            if gm.gtype == TOPO_SPREAD:
+                c = hc + selp[g].astype(jnp.float32)
+                g_viable = c - 0.0 <= gm.max_skew  # hostname min pinned to 0
+            elif gm.gtype == TOPO_AFFINITY:
+                has_pos = (hc > 0.5).any()
+                g_viable = jnp.where(has_pos, hc > 0.5, jnp.broadcast_to(selp[g], hc.shape))
+            else:
+                g_viable = hc < 0.5
+        else:
+            lo, hi = gm.seg
+            doms = tdoms[g, lo:hi]
+            cnt = tcounts[g, lo:hi]
+            pod_dom = pod_allow[lo:hi]
+            sallow = slot_allow[:, lo:hi]
+            if gm.gtype == TOPO_SPREAD:
+                c = cnt + selp[g].astype(jnp.float32)
+                minc = jnp.min(jnp.where(pod_dom & doms, cnt, jnp.inf))
+                skew_ok = doms & (c - minc <= gm.max_skew)
+                g_viable = (skew_ok[None, :] & sallow).any(axis=-1)
+            elif gm.gtype == TOPO_AFFINITY:
+                pos = pod_dom & doms & (cnt > 0.5)
+                has_pos = pos.any()
+                seed1 = _first_true_onehot(pod_dom[None, :] & doms[None, :] & sallow)
+                seed2 = _first_true_onehot((pod_dom & doms)[None, :])
+                seeded = seed1 | seed2
+                opts = jnp.where(has_pos, pos[None, :], jnp.where(selp[g], seeded, False))
+                g_viable = (opts & sallow).any(axis=-1)
+            else:  # TOPO_ANTI
+                opts = pod_dom & doms & (cnt < 0.5)
+                g_viable = (opts[None, :] & sallow).any(axis=-1)
+        viable &= ~applies | g_viable
+    return viable
+
+
+def topo_narrow_single(meta: TopoMeta, tcounts, thost, tdoms, own, selp,
+                       pod_allow, slot_allow_row, slot_n, n_keys: int):
+    """(viable, narrow[V], applied_keys[K]) for ONE candidate slot — the
+    exact committed domain choice (spread picks the argmin-count domain among
+    the slot's viable domains; topologygroup.go:155-182). The returned
+    applied_keys mark keys that become DEFINED concrete In-sets on the merged
+    requirements (AddRequirements adds them, topology.go:149-167). Hostname
+    groups evaluate on the slot's identity and narrow nothing."""
+    import jax.numpy as jnp
+
+    V = slot_allow_row.shape[0]
+    viable = jnp.bool_(True)
+    narrow = jnp.ones(V, dtype=bool)
+    applied_keys = jnp.zeros(n_keys, dtype=bool)
+    for g, gm in enumerate(meta.groups):
+        applies = selp[g] if gm.is_inverse else own[g]
+        if gm.is_hostname:
+            hc = thost[g, slot_n]
+            if gm.gtype == TOPO_SPREAD:
+                g_viable = hc + selp[g].astype(jnp.float32) <= gm.max_skew
+            elif gm.gtype == TOPO_AFFINITY:
+                has_pos = (thost[g] > 0.5).any()
+                g_viable = jnp.where(has_pos, hc > 0.5, selp[g])
+            else:
+                g_viable = hc < 0.5
+            viable &= ~applies | g_viable
+            continue
+        lo, hi = gm.seg
+        doms = tdoms[g, lo:hi]
+        cnt = tcounts[g, lo:hi]
+        pod_dom = pod_allow[lo:hi]
+        sallow = slot_allow_row[lo:hi]
+        if gm.gtype == TOPO_SPREAD:
+            c = cnt + selp[g].astype(jnp.float32)
+            minc = jnp.min(jnp.where(pod_dom & doms, cnt, jnp.inf))
+            cand = doms & (c - minc <= gm.max_skew) & sallow
+            c_masked = jnp.where(cand, c, jnp.inf)
+            d_star = jnp.argmin(c_masked)
+            g_narrow = (jnp.arange(hi - lo) == d_star) & cand.any()
+            g_viable = cand.any()
+        elif gm.gtype == TOPO_AFFINITY:
+            pos = pod_dom & doms & (cnt > 0.5)
+            has_pos = pos.any()
+            seed1 = _first_true_onehot((pod_dom & doms & sallow)[None, :])[0]
+            seed2 = _first_true_onehot((pod_dom & doms)[None, :])[0]
+            seeded = seed1 | seed2
+            g_narrow = jnp.where(has_pos, pos, jnp.where(selp[g], seeded, False))
+            g_viable = (g_narrow & sallow).any()
+        else:
+            g_narrow = pod_dom & doms & (cnt < 0.5)
+            g_viable = (g_narrow & sallow).any()
+        viable &= ~applies | g_viable
+        seg_new = jnp.where(applies, narrow[lo:hi] & g_narrow, narrow[lo:hi])
+        narrow = narrow.at[lo:hi].set(seg_new)
+        applied_keys = applied_keys.at[gm.key_k].max(applies)
+    return viable, narrow, applied_keys
+
+
+def topo_record(
+    meta: TopoMeta,
+    tcounts,
+    thost,
+    tdoms,
+    own,
+    selp,
+    nf_ok,
+    m_allow,
+    m_out,
+    slot_n,
+):
+    """Commit a placement into counts (topology.go:120-143).
+
+    nf_ok[G]: node-filter match of the group vs the merged slot requirements.
+    m_allow/m_out: the committed slot's merged requirement masks.
+    Returns (new_counts, new_hcounts, new_domain_mask)."""
+    import jax.numpy as jnp
+
+    for g, gm in enumerate(meta.groups):
+        if gm.is_hostname:
+            # the slot IS the (singleton) hostname domain
+            rec = own[g] if gm.is_inverse else (selp[g] & nf_ok[g])
+            thost = thost.at[g, slot_n].add(rec.astype(jnp.float32))
+            continue
+        lo, hi = gm.seg
+        allow_seg = m_allow[lo:hi]
+        out_k = m_out[gm.key_k]
+        # Requirement.Values(): allowed values for In-sets, EXCLUDED values
+        # for complement sets (requirement.go:178-180) — mirrored exactly.
+        vals = jnp.where(out_k, ~allow_seg, allow_seg)
+        if gm.is_inverse:
+            rec = own[g]
+            delta = vals
+        else:
+            rec = selp[g] & nf_ok[g]
+            if gm.gtype == TOPO_ANTI:
+                delta = vals
+            else:
+                singleton = (~out_k) & (allow_seg.sum() == 1)
+                delta = allow_seg & singleton
+        inc = (rec & delta).astype(jnp.float32)
+        tcounts = tcounts.at[g, lo:hi].add(inc)
+        tdoms = tdoms.at[g, lo:hi].set(tdoms[g, lo:hi] | (rec & delta))
+    return tcounts, thost, tdoms
+
+
+def topo_node_filter_ok(meta: TopoMeta, terms, segments, well_known, m_allow, m_out, m_defined):
+    """[G] bool: TopologyNodeFilter.MatchesRequirements(merged slot reqs)
+    (topologynodefilter.go:46-56): empty filter matches; else any term where
+    Compatible(merged, term) passes."""
+    import jax.numpy as jnp
+
+    from karpenter_core_tpu.ops import compat
+
+    if terms is None or terms["allow"].shape[0] == 0:
+        return jnp.ones(len(meta.groups), dtype=bool)
+
+    m_escape = compat.escape_flags(m_allow[None], m_out[None], m_defined[None], segments)[0]
+    node = {
+        "allow": m_allow[None, :],
+        "out": m_out[None, :],
+        "defined": m_defined[None, :],
+        "escape": m_escape[None, :],
+    }
+    # direction: Compatible(node=merged slot reqs, incoming=term)
+    ok_rows = compat.pairwise_compatible(node, terms, segments, well_known)[0]  # [GT]
+    out = []
+    for gm in meta.groups:
+        if not gm.filter_term_rows:
+            out.append(jnp.bool_(True))
+        else:
+            out.append(jnp.any(jnp.stack([ok_rows[r] for r in gm.filter_term_rows])))
+    return jnp.stack(out)

@@ -40,8 +40,12 @@ def make_sharded_solve(snap, provisioners, mesh, max_nodes_per_shard: int = 256)
     from karpenter_core_tpu.solver.tpu_solver import device_args, solve_geometry
 
     geom = solve_geometry(snap, max_nodes_per_shard)
-    _, J, T, E, R, K, V, _, segments_t, zone_seg, ct_seg = geom
+    _, J, T, E, R, K, V, _, segments_t, zone_seg, ct_seg, _topo_sig = geom
     assert E == 0, "sharded solve packs new machines only (existing nodes are host-side)"
+    assert snap.topo_meta is None, (
+        "sharded solve requires a topology-free batch: domain counts are "
+        "global state; cross-shard topology lands with the repair phase"
+    )
     segments = list(segments_t)
     ndp = mesh.shape["dp"]
     ntp = mesh.shape["tp"]
@@ -88,6 +92,9 @@ def make_sharded_solve(snap, provisioners, mesh, max_nodes_per_shard: int = 256)
             nopen=jnp.int32(0),
             # pessimistic even split of provisioner limits across dp shards
             remaining=remaining0 / ndp,
+            tcounts=jnp.zeros((0, V), jnp.float32),
+            thost=jnp.zeros((0, N), jnp.float32),
+            tdoms=jnp.zeros((0, V), bool),
         )
         pod_arrays = dict(pod_arrays)
         pod_arrays["tol"] = pod_tol_all
@@ -155,6 +162,9 @@ def make_sharded_solve(snap, provisioners, mesh, max_nodes_per_shard: int = 256)
             cap=P("dp", None),
             nopen=P("dp"),
             remaining=P("dp", None),
+            tcounts=P("dp", None),
+            thost=P("dp", None),
+            tdoms=P("dp", None),
         ),
         P(),  # scheduled count (replicated)
     )
@@ -166,7 +176,7 @@ def make_sharded_solve(snap, provisioners, mesh, max_nodes_per_shard: int = 256)
     base_args = device_args(snap, provisioners)
     (pod_arrays, tmpl, tmpl_daemon, tmpl_type_mask, types, type_alloc,
      type_capacity, type_offering_ok, pod_tol_all, _exist, _eu, _ec,
-     well_known, remaining0) = base_args
+     well_known, remaining0, _tc, _th, _td, _tt) = base_args
     args = (
         pod_arrays,
         tmpl,

@@ -182,6 +182,11 @@ class EncodedSnapshot:
     exist_cap: np.ndarray = None  # [E, R] available()
     pod_tol_exist: np.ndarray = None  # [P, E]
 
+    # topology (None when the batch has no topology constraints)
+    topo_meta: object = None  # ops.topology.TopoMeta
+    topo_arrays: object = None  # ops.topology.TopoArrays
+    n_slots: int = 0  # E + machine slot budget (hostname identity width)
+
     # host-side back-references for decode
     instance_types: List[InstanceType] = field(default_factory=list)
     templates: List[MachineTemplate] = field(default_factory=list)
@@ -196,6 +201,9 @@ def encode_snapshot(
     instance_types: Dict[str, List[InstanceType]],
     daemonset_pods: Optional[List[Pod]] = None,
     state_nodes: Optional[List] = None,
+    kube_client=None,
+    cluster=None,
+    max_nodes: int = 1024,
 ) -> EncodedSnapshot:
     """Lower a provisioning snapshot to tensors.
 
@@ -242,10 +250,31 @@ def encode_snapshot(
         reqs.add(Requirement(LABEL_HOSTNAME, "In", [node.hostname()]))
         exist_reqs_list.append(reqs)
 
+    # -- host topology (seeds domain counts incl. cluster pods) -----------
+    from karpenter_core_tpu.controllers.provisioning.scheduling.scheduler import build_domains
+    from karpenter_core_tpu.controllers.provisioning.scheduling.topology import (
+        Topology as HostTopology,
+    )
+
+    domains = build_domains(provisioners, instance_types)
+    host_topology = HostTopology(kube_client, cluster, domains, pods_sorted)
+    topo_groups = list(host_topology.topologies.values()) + list(
+        host_topology.inverse_topologies.values()
+    )
+
     # -- dictionary closure ------------------------------------------------
     dictionary = LabelDictionary()
     for reqs in pod_reqs_list + tmpl_reqs_list + type_reqs_list + exist_reqs_list:
         _collect_requirement_values(reqs, dictionary)
+    for tg in topo_groups:
+        if tg.key == LABEL_HOSTNAME:
+            dictionary.add_key(tg.key)  # hostname domains live on slot identity
+        else:
+            dictionary.add_key(tg.key)
+            for d in tg.domains:
+                dictionary.add_value(tg.key, d)
+        for term in tg.node_filter.terms:
+            _collect_requirement_values(term, dictionary)
     # zone/capacity-type always present for offering logic
     dictionary.add_key(LABEL_TOPOLOGY_ZONE)
     dictionary.add_key(api_labels.LABEL_CAPACITY_TYPE)
@@ -360,6 +389,18 @@ def encode_snapshot(
         for i, p in enumerate(pods_sorted):
             pod_tol_exist[i, e] = taints_mod.tolerates(node_taints, p) is None
 
+    # -- topology arrays ---------------------------------------------------
+    from karpenter_core_tpu.ops.topology import encode_topology
+
+    n_slots = E + min(max_nodes, max(P, 1))
+    topo_meta, topo_arrays = encode_topology(
+        host_topology,
+        pods_sorted,
+        dictionary,
+        n_slots,
+        [n.hostname() for n in state_nodes],
+    )
+
     return EncodedSnapshot(
         dictionary=dictionary,
         resource_names=resource_names,
@@ -382,6 +423,9 @@ def encode_snapshot(
         exist_used=exist_used,
         exist_cap=exist_cap,
         pod_tol_exist=pod_tol_exist,
+        topo_meta=topo_meta,
+        topo_arrays=topo_arrays,
+        n_slots=n_slots,
         instance_types=all_types,
         templates=templates,
         pods=pods_sorted,

@@ -73,8 +73,17 @@ def solve_geometry(snap: EncodedSnapshot, max_nodes: int):
     E = len(snap.state_nodes)
     R = len(snap.resource_names)
     K, V = dictionary.K, dictionary.V
-    N = E + min(max_nodes, max(P, 1))
-    return (P, J, T, E, R, K, V, N, tuple(segments), snap.zone_seg, snap.ct_seg)
+    # the slot budget is fixed at encode time (snapshot topo arrays are sized
+    # to it); max_nodes only applies when the snapshot didn't record one
+    N = snap.n_slots or (E + min(max_nodes, max(P, 1)))
+    topo_sig = ()
+    if snap.topo_meta is not None:
+        topo_sig = tuple(
+            (g.gtype, g.seg, g.key_k, g.max_skew, g.is_hostname, g.is_inverse,
+             tuple(g.filter_term_rows))
+            for g in snap.topo_meta.groups
+        )
+    return (P, J, T, E, R, K, V, N, tuple(segments), snap.zone_seg, snap.ct_seg, topo_sig)
 
 
 def build_device_solve(snap: EncodedSnapshot, max_nodes: int = 1024):
@@ -87,13 +96,14 @@ def build_device_solve(snap: EncodedSnapshot, max_nodes: int = 1024):
     from karpenter_core_tpu.ops.pack import PackState, make_pack_kernel
 
     geom = solve_geometry(snap, max_nodes)
-    P, J, T, E, R, K, V, N, segments_t, zone_seg, ct_seg = geom
+    P, J, T, E, R, K, V, N, segments_t, zone_seg, ct_seg, _topo_sig = geom
     segments = list(segments_t)
-    pack = make_pack_kernel(segments, zone_seg, ct_seg)
+    pack = make_pack_kernel(segments, zone_seg, ct_seg, topo_meta=snap.topo_meta)
 
     def run(pod_arrays, tmpl, tmpl_daemon, tmpl_type_mask, types, type_alloc,
             type_capacity, type_offering_ok, pod_tol_all, exist, exist_used,
-            exist_cap, well_known, remaining0):
+            exist_cap, well_known, remaining0, topo_counts0, topo_hcounts0,
+            topo_doms0, topo_terms):
         f_static = feasibility_static(
             {k: pod_arrays[k] for k in ("allow", "out", "defined", "escape")},
             tmpl,
@@ -124,6 +134,9 @@ def build_device_solve(snap: EncodedSnapshot, max_nodes: int = 1024):
             cap=jnp.zeros((N, R), jnp.float32).at[:E].set(exist_cap),
             nopen=jnp.int32(E),
             remaining=remaining0,
+            tcounts=topo_counts0,
+            thost=topo_hcounts0,
+            tdoms=topo_doms0,
         )
         pod_arrays = dict(pod_arrays)
         pod_arrays["tol"] = pod_tol_all
@@ -139,6 +152,8 @@ def build_device_solve(snap: EncodedSnapshot, max_nodes: int = 1024):
             type_alloc,
             type_capacity,
             type_offering_ok,
+            well_known=well_known,
+            topo_terms=topo_terms,
         )
         return assigned, state
 
@@ -161,6 +176,9 @@ def device_args(snap: EncodedSnapshot, provisioners: Optional[List[Provisioner]]
         "tol_tmpl": snap.pod_tol,
         "valid": np.ones(P, dtype=bool),
     }
+    if snap.topo_meta is not None:
+        pod_arrays["topo_own"] = snap.topo_arrays.owner.T.copy()  # [P, G]
+        pod_arrays["topo_sel"] = snap.topo_arrays.sel.T.copy()
     pod_tol_all = np.concatenate([snap.pod_tol, snap.pod_tol_exist], axis=1)
 
     # provisioner limits -> remaining resources [J, R] (scheduler.go:70-75)
@@ -183,6 +201,29 @@ def device_args(snap: EncodedSnapshot, provisioners: Optional[List[Provisioner]]
                     if remaining0[j, r_i] < 1e29:
                         remaining0[j, r_i] -= cap.get(rname, 0.0)
 
+    V = snap.dictionary.V
+    if snap.topo_meta is not None:
+        ta = snap.topo_arrays
+        topo_counts0 = ta.counts0
+        topo_hcounts0 = ta.hcounts0
+        topo_doms0 = ta.domain_mask0
+        topo_terms = {
+            "allow": ta.term_allow,
+            "out": ta.term_out,
+            "defined": ta.term_defined,
+            "escape": ta.term_escape,
+        }
+    else:
+        topo_counts0 = np.zeros((0, V), np.float32)
+        topo_hcounts0 = np.zeros((0, snap.n_slots or 1), np.float32)
+        topo_doms0 = np.zeros((0, V), bool)
+        topo_terms = {
+            "allow": np.zeros((0, V), bool),
+            "out": np.zeros((0, snap.dictionary.K), bool),
+            "defined": np.zeros((0, snap.dictionary.K), bool),
+            "escape": np.zeros((0, snap.dictionary.K), bool),
+        }
+
     return (
         pod_arrays,
         _reqset_to_dict(snap.tmpl_reqs),
@@ -198,6 +239,10 @@ def device_args(snap: EncodedSnapshot, provisioners: Optional[List[Provisioner]]
         snap.exist_cap,
         snap.well_known,
         remaining0,
+        topo_counts0,
+        topo_hcounts0,
+        topo_doms0,
+        topo_terms,
     )
 
 
@@ -223,6 +268,8 @@ class TPUSolver:
         instance_types: Dict[str, List[InstanceType]],
         daemonset_pods: Optional[List[Pod]] = None,
         state_nodes: Optional[List] = None,
+        kube_client=None,
+        cluster=None,
     ) -> SolveResult:
         if not pods:
             return SolveResult()
@@ -236,7 +283,10 @@ class TPUSolver:
                 for t in p.spec.taints
             )
         )
-        result = self._solve_once(pods, provisioners, instance_types, daemonset_pods, state_nodes)
+        result = self._solve_once(
+            pods, provisioners, instance_types, daemonset_pods, state_nodes,
+            kube_client, cluster,
+        )
         rounds = 1
         while result.failed_pods and rounds < self.max_relax_rounds:
             relaxed_any = False
@@ -245,7 +295,8 @@ class TPUSolver:
             if not relaxed_any:
                 break
             result = self._solve_once(
-                pods, provisioners, instance_types, daemonset_pods, state_nodes
+                pods, provisioners, instance_types, daemonset_pods, state_nodes,
+                kube_client, cluster,
             )
             rounds += 1
         result.rounds = rounds
@@ -253,9 +304,11 @@ class TPUSolver:
 
     # -- internals ---------------------------------------------------------
 
-    def _solve_once(self, pods, provisioners, instance_types, daemonset_pods, state_nodes):
+    def _solve_once(self, pods, provisioners, instance_types, daemonset_pods,
+                    state_nodes, kube_client=None, cluster=None):
         snap = encode_snapshot(
-            pods, provisioners, instance_types, daemonset_pods, state_nodes
+            pods, provisioners, instance_types, daemonset_pods, state_nodes,
+            kube_client=kube_client, cluster=cluster, max_nodes=self.max_nodes,
         )
         assigned, state = self._run_kernels(snap, provisioners)
         return self._decode(snap, assigned, state)
@@ -293,9 +346,7 @@ class TPUSolver:
             template = snap.templates[tmpl_id]
             tmask = np.asarray(state.tmask[slot])
             options = [snap.instance_types[t] for t in np.nonzero(tmask)[0]]
-            requirements = Requirements(template.requirements.values())
-            for pod in pods:
-                requirements.add(*Requirements.from_pod(pod).values())
+            requirements = self._slot_requirements(snap, state, slot)
             requests = dict(
                 zip(snap.resource_names, np.asarray(state.used[slot]).tolist())
             )
@@ -313,6 +364,32 @@ class TPUSolver:
         return SolveResult(
             new_machines=machines, existing_assignments=existing, failed_pods=failed
         )
+
+    @staticmethod
+    def _slot_requirements(snap: EncodedSnapshot, state, slot) -> Requirements:
+        """Reconstruct the machine's merged requirements from the slot masks —
+        includes topology domain narrowing the kernel committed. (Integer
+        Gt/Lt bounds on complement sets are already baked into the allow
+        masks for dictionary values; the bound itself is not recoverable.)"""
+        from karpenter_core_tpu.scheduling.requirement import Requirement
+
+        dictionary = snap.dictionary
+        allow = np.asarray(state.allow[slot])
+        out = np.asarray(state.out[slot])
+        defined = np.asarray(state.defined[slot])
+        requirements = Requirements()
+        for k, key in enumerate(dictionary.keys):
+            if not defined[k]:
+                continue
+            lo, hi = dictionary.segment(key)
+            vals = dictionary.values_of(key)
+            if out[k]:
+                excluded = [v for v, a in zip(vals, allow[lo:hi]) if not a]
+                requirements.add(Requirement(key, "NotIn", excluded))
+            else:
+                allowed = [v for v, a in zip(vals, allow[lo:hi]) if a]
+                requirements.add(Requirement(key, "In", allowed))
+        return requirements
 
 
 class GreedySolver:

@@ -1,0 +1,247 @@
+"""Cluster — the in-memory mirror of nodes/machines/pod-bindings.
+
+Mirrors reference pkg/controllers/state/cluster.go:44-532: a lock-guarded map
+providerID -> StateNode kept fresh by the informer controllers, pod->node
+bindings, an anti-affinity pod index, node nomination, mark-for-deletion, and
+the consolidation dirty-bit with a 5-minute forced re-check.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from karpenter_core_tpu.api.machine import Machine
+from karpenter_core_tpu.api.provisioner import Provisioner
+from karpenter_core_tpu.kube.objects import NamespacedName, Node, Pod, object_key
+from karpenter_core_tpu.state.node import StateNode
+from karpenter_core_tpu.utils import podutils
+
+
+class Cluster:
+    """cluster.go:44-60."""
+
+    CONSOLIDATED_TTL = 5 * 60.0  # forced re-check interval (cluster.go:277-286)
+
+    def __init__(self, kube_client, cloud_provider=None, clock=time.time):
+        self.kube_client = kube_client
+        self.cloud_provider = cloud_provider
+        self.clock = clock
+        self._mu = threading.RLock()
+        self.nodes_by_provider_id: Dict[str, StateNode] = {}
+        self.node_name_to_provider_id: Dict[str, str] = {}
+        self.machine_name_to_provider_id: Dict[str, str] = {}
+        self.bindings: Dict[NamespacedName, str] = {}  # pod -> node name
+        self.anti_affinity_pods: Dict[NamespacedName, Pod] = {}
+        self._consolidated: bool = False
+        self._consolidated_at: float = 0.0
+
+    # -- queries (cluster.go:116-202) --------------------------------------
+
+    def nodes(self) -> List[StateNode]:
+        """Deep-copied snapshot (cluster.go:149-156)."""
+        with self._mu:
+            return [n.deep_copy() for n in self.nodes_by_provider_id.values()]
+
+    def for_each_node(self, fn: Callable[[StateNode], bool]) -> None:
+        with self._mu:
+            nodes = list(self.nodes_by_provider_id.values())
+        for node in nodes:
+            if not fn(node):
+                return
+
+    def for_pods_with_anti_affinity(self, fn: Callable[[Pod, Node], bool]) -> None:
+        """Visit scheduled pods carrying required anti-affinity
+        (cluster.go:116-132)."""
+        with self._mu:
+            pods = list(self.anti_affinity_pods.values())
+        for pod in pods:
+            node = self.kube_client.get("Node", "", pod.spec.node_name)
+            if node is None:
+                continue
+            if not fn(pod, node):
+                return
+
+    def node_for(self, name: str) -> Optional[StateNode]:
+        with self._mu:
+            pid = self.node_name_to_provider_id.get(name) or self.machine_name_to_provider_id.get(
+                name
+            )
+            if pid is None:
+                return None
+            return self.nodes_by_provider_id.get(pid)
+
+    # -- nomination (cluster.go:160-178) -----------------------------------
+
+    def nominate_node_for_pod(self, node_name: str) -> None:
+        with self._mu:
+            node = self.node_for(node_name)
+            if node is not None:
+                node.nominate()
+
+    def unmark_for_deletion(self, *node_names: str) -> None:
+        with self._mu:
+            for name in node_names:
+                node = self.node_for(name)
+                if node is not None:
+                    node.marked_for_deletion = False
+
+    def mark_for_deletion(self, *node_names: str) -> None:
+        """cluster.go:181-202."""
+        with self._mu:
+            for name in node_names:
+                node = self.node_for(name)
+                if node is not None:
+                    node.marked_for_deletion = True
+
+    # -- consolidation dirty bit (cluster.go:269-286) ----------------------
+
+    def set_consolidated(self, consolidated: bool) -> None:
+        with self._mu:
+            self._consolidated = consolidated
+            if consolidated:
+                self._consolidated_at = self.clock()
+
+    def consolidated(self) -> bool:
+        """True while nothing changed since the last full consolidation scan,
+        force-expiring every 5 minutes."""
+        with self._mu:
+            if self.clock() - self._consolidated_at > self.CONSOLIDATED_TTL:
+                self._consolidated = False
+            return self._consolidated
+
+    # -- ingestion (cluster.go:204-267,341-505) ----------------------------
+
+    def update_node(self, node: Node) -> None:
+        with self._mu:
+            provider_id = node.spec.provider_id or f"node:///{node.metadata.name}"
+            existing = self.nodes_by_provider_id.get(provider_id)
+            if existing is None:
+                existing = StateNode(node=node, clock=self.clock)
+                self.nodes_by_provider_id[provider_id] = existing
+            else:
+                existing.node = node
+            self.node_name_to_provider_id[node.metadata.name] = provider_id
+            self._populate_inflight(existing)
+            self._populate_volume_limits(existing)
+            self.set_consolidated(False)
+
+    def delete_node(self, name: str) -> None:
+        with self._mu:
+            pid = self.node_name_to_provider_id.pop(name, None)
+            if pid is not None:
+                state_node = self.nodes_by_provider_id.get(pid)
+                if state_node is not None:
+                    if state_node.machine is not None:
+                        state_node.node = None  # machine record remains
+                    else:
+                        del self.nodes_by_provider_id[pid]
+            self.set_consolidated(False)
+
+    def update_machine(self, machine: Machine) -> None:
+        with self._mu:
+            provider_id = machine.status.provider_id or f"machine:///{machine.name}"
+            existing = self.nodes_by_provider_id.get(provider_id)
+            if existing is None:
+                existing = StateNode(machine=machine, clock=self.clock)
+                self.nodes_by_provider_id[provider_id] = existing
+            else:
+                existing.machine = machine
+            self.machine_name_to_provider_id[machine.name] = provider_id
+            self.set_consolidated(False)
+
+    def delete_machine(self, name: str) -> None:
+        with self._mu:
+            pid = self.machine_name_to_provider_id.pop(name, None)
+            if pid is not None:
+                state_node = self.nodes_by_provider_id.get(pid)
+                if state_node is not None:
+                    if state_node.node is not None:
+                        state_node.machine = None
+                    else:
+                        del self.nodes_by_provider_id[pid]
+            self.set_consolidated(False)
+
+    def update_pod(self, pod: Pod) -> None:
+        """cluster.go:446-505: maintain bindings, per-node usage, and the
+        anti-affinity index."""
+        with self._mu:
+            key = object_key(pod)
+            if podutils.is_terminal(pod):
+                self._unbind(key)
+                self.anti_affinity_pods.pop(key, None)
+                self.set_consolidated(False)
+                return
+            old_node_name = self.bindings.get(key)
+            if pod.spec.node_name:
+                if old_node_name and old_node_name != pod.spec.node_name:
+                    self._unbind(key)
+                self.bindings[key] = pod.spec.node_name
+                node = self.node_for(pod.spec.node_name)
+                if node is not None:
+                    node.update_for_pod(pod)
+                if podutils.has_pod_anti_affinity(pod):
+                    self.anti_affinity_pods[key] = pod
+            self.set_consolidated(False)
+
+    def delete_pod(self, key: NamespacedName) -> None:
+        with self._mu:
+            self._unbind(key)
+            self.anti_affinity_pods.pop(key, None)
+            self.set_consolidated(False)
+
+    def update_provisioner(self, provisioner: Provisioner) -> None:
+        # cache-invalidate only (informer/provisioner.go:52)
+        self.set_consolidated(False)
+
+    def synced(self) -> bool:
+        """All kube nodes/machines are reflected (cluster.go:77-111)."""
+        with self._mu:
+            for node in self.kube_client.list("Node"):
+                if node.metadata.name not in self.node_name_to_provider_id:
+                    return False
+            for machine in self.kube_client.list("Machine"):
+                if machine.status.provider_id and machine.metadata.name not in (
+                    self.machine_name_to_provider_id
+                ):
+                    return False
+            return True
+
+    # -- internals ---------------------------------------------------------
+
+    def _unbind(self, key: NamespacedName) -> None:
+        node_name = self.bindings.pop(key, None)
+        if node_name:
+            node = self.node_for(node_name)
+            if node is not None:
+                node.cleanup_for_pod(key)
+
+    def _populate_inflight(self, state_node: StateNode) -> None:
+        """Inflight capacity from the instance type until kubelet reports
+        (cluster.go:388-428)."""
+        if self.cloud_provider is None or state_node.node is None:
+            return
+        from karpenter_core_tpu.kube.objects import LABEL_INSTANCE_TYPE_STABLE
+
+        it_name = state_node.labels().get(LABEL_INSTANCE_TYPE_STABLE)
+        if not it_name:
+            return
+        try:
+            for it in self.cloud_provider.get_instance_types(None):
+                if it.name == it_name:
+                    state_node.inflight_capacity = dict(it.capacity)
+                    state_node.inflight_allocatable = dict(it.allocatable())
+                    break
+        except Exception:
+            pass
+
+    def _populate_volume_limits(self, state_node: StateNode) -> None:
+        """CSINode driver limits (cluster.go:430-444)."""
+        if state_node.node is None:
+            return
+        csinode = self.kube_client.get("CSINode", "", state_node.node.metadata.name)
+        if csinode is None:
+            return
+        for driver in csinode.drivers:
+            if driver.allocatable_count is not None:
+                state_node.volume_limits[driver.name] = driver.allocatable_count

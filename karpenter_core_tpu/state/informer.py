@@ -1,0 +1,50 @@
+"""Informer controllers: pump kube watch events into the Cluster state.
+
+Mirrors reference pkg/controllers/state/informer/{node,pod,machine,
+provisioner}.go:51-53 — thin reconcilers translating apiserver watch events
+into Cluster.Update*/Delete* calls.
+"""
+from __future__ import annotations
+
+from karpenter_core_tpu.kube.objects import NamespacedName
+
+
+class NodeInformer:
+    def __init__(self, cluster):
+        self.cluster = cluster
+
+    def handle(self, event: str, node) -> None:
+        if event == "DELETED":
+            self.cluster.delete_node(node.metadata.name)
+        else:
+            self.cluster.update_node(node)
+
+
+class PodInformer:
+    def __init__(self, cluster):
+        self.cluster = cluster
+
+    def handle(self, event: str, pod) -> None:
+        if event == "DELETED":
+            self.cluster.delete_pod(NamespacedName(pod.metadata.namespace, pod.metadata.name))
+        else:
+            self.cluster.update_pod(pod)
+
+
+class MachineInformer:
+    def __init__(self, cluster):
+        self.cluster = cluster
+
+    def handle(self, event: str, machine) -> None:
+        if event == "DELETED":
+            self.cluster.delete_machine(machine.metadata.name)
+        else:
+            self.cluster.update_machine(machine)
+
+
+class ProvisionerInformer:
+    def __init__(self, cluster):
+        self.cluster = cluster
+
+    def handle(self, event: str, provisioner) -> None:
+        self.cluster.update_provisioner(provisioner)

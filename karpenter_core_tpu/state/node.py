@@ -32,9 +32,11 @@ from karpenter_core_tpu.utils import podutils, resources
 class StateNode:
     """state/node.go:60-106."""
 
-    def __init__(self, node: Optional[Node] = None, machine: Optional[Machine] = None):
+    def __init__(self, node: Optional[Node] = None, machine: Optional[Machine] = None,
+                 clock=time.time):
         self.node = node
         self.machine = machine
+        self.clock = clock
         self.inflight_allocatable: ResourceList = {}
         self.inflight_capacity: ResourceList = {}
         self.startup_taints: List[Taint] = []
@@ -102,10 +104,10 @@ class StateNode:
         )
 
     def nominate(self, settings: Optional[Settings] = None) -> None:
-        self.nominated_until = time.time() + nomination_window(settings)
+        self.nominated_until = self.clock() + nomination_window(settings)
 
     def nominated(self) -> bool:
-        return self.nominated_until > time.time()
+        return self.nominated_until > self.clock()
 
     # -- scheduling views -------------------------------------------------
 
@@ -202,7 +204,8 @@ class StateNode:
     def deep_copy(self) -> "StateNode":
         import copy as copy_mod
 
-        out = StateNode(copy_mod.deepcopy(self.node), copy_mod.deepcopy(self.machine))
+        out = StateNode(copy_mod.deepcopy(self.node), copy_mod.deepcopy(self.machine),
+                        clock=self.clock)
         out.inflight_allocatable = dict(self.inflight_allocatable)
         out.inflight_capacity = dict(self.inflight_capacity)
         out.startup_taints = list(self.startup_taints)

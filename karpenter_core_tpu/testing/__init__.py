@@ -38,6 +38,20 @@ from karpenter_core_tpu.utils.resources import parse_resource_list
 _counter = itertools.count(1)
 
 
+class FakeClock:
+    """Steppable clock (the analog of clock/testing.FakeClock the reference
+    threads through every TTL-sensitive controller)."""
+
+    def __init__(self, t: float = 1_700_000_000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, seconds: float) -> None:
+        self.t += seconds
+
+
 def unique_name(prefix: str = "obj") -> str:
     return f"{prefix}-{next(_counter)}"
 

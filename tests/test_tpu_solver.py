@@ -218,3 +218,158 @@ def test_larger_random_mix_no_worse_than_host():
     host, tpu = run_both(pods, provisioners, its)
     assert not tpu.failed_pods
     assert len(tpu.new_machines) <= len(host.new_machines) + 2
+
+
+# -- topology on device ------------------------------------------------------
+
+
+def test_zonal_spread_on_device():
+    from karpenter_core_tpu.kube.objects import LabelSelector, TopologySpreadConstraint
+
+    spread = TopologySpreadConstraint(
+        max_skew=1,
+        topology_key=LABEL_TOPOLOGY_ZONE,
+        when_unsatisfiable="DoNotSchedule",
+        label_selector=LabelSelector(match_labels={"app": "web"}),
+    )
+    pods = [
+        make_pod(labels={"app": "web"}, requests={"cpu": "1"}, topology_spread=[spread])
+        for _ in range(9)
+    ]
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(5)}
+    host, tpu = run_both(pods, provisioners, its)
+    assert not tpu.failed_pods
+    zone_counts = {}
+    for m in tpu.new_machines:
+        zone_req = m.requirements.get_requirement(LABEL_TOPOLOGY_ZONE)
+        assert zone_req.len() == 1, f"spread machine must pin one zone, got {zone_req!r}"
+        z = zone_req.values_list()[0]
+        zone_counts[z] = zone_counts.get(z, 0) + len(m.pods)
+    assert len(zone_counts) == 3
+    assert max(zone_counts.values()) - min(zone_counts.values()) <= 1
+
+
+def test_hostname_spread_on_device():
+    from karpenter_core_tpu.kube.objects import (
+        LABEL_HOSTNAME,
+        LabelSelector,
+        TopologySpreadConstraint,
+    )
+
+    spread = TopologySpreadConstraint(
+        max_skew=1,
+        topology_key=LABEL_HOSTNAME,
+        when_unsatisfiable="DoNotSchedule",
+        label_selector=LabelSelector(match_labels={"app": "web"}),
+    )
+    pods = [
+        make_pod(labels={"app": "web"}, requests={"cpu": "1"}, topology_spread=[spread])
+        for _ in range(4)
+    ]
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(5)}
+    host, tpu = run_both(pods, provisioners, its)
+    assert not tpu.failed_pods
+    # maxSkew=1 on hostname: min is pinned to 0, so every machine holds <=1
+    assert all(len(m.pods) <= 1 for m in tpu.new_machines)
+    assert len(tpu.new_machines) == 4
+
+
+def test_zone_anti_affinity_late_committal_on_device():
+    from karpenter_core_tpu.kube.objects import LabelSelector, PodAffinityTerm
+
+    term = PodAffinityTerm(
+        topology_key=LABEL_TOPOLOGY_ZONE,
+        label_selector=LabelSelector(match_labels={"app": "db"}),
+    )
+    pods = [
+        make_pod(labels={"app": "db"}, requests={"cpu": "1"}, pod_anti_affinity_required=[term])
+        for _ in range(3)
+    ]
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(5)}
+    host, tpu = run_both(pods, provisioners, its)
+    # reference semantics: one per batch (block out all possible domains)
+    assert tpu.pod_count_new() == host.pod_count_new() == 1
+    assert len(tpu.failed_pods) == 2
+
+
+def test_hostname_anti_affinity_separates_on_device():
+    from karpenter_core_tpu.kube.objects import (
+        LABEL_HOSTNAME,
+        LabelSelector,
+        PodAffinityTerm,
+    )
+
+    term = PodAffinityTerm(
+        topology_key=LABEL_HOSTNAME,
+        label_selector=LabelSelector(match_labels={"app": "db"}),
+    )
+    pods = [
+        make_pod(labels={"app": "db"}, requests={"cpu": "1"}, pod_anti_affinity_required=[term])
+        for _ in range(3)
+    ]
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(5)}
+    host, tpu = run_both(pods, provisioners, its)
+    assert not tpu.failed_pods
+    assert len(tpu.new_machines) == 3
+    assert all(len(m.pods) == 1 for m in tpu.new_machines)
+
+
+def test_pod_affinity_colocates_on_device():
+    from karpenter_core_tpu.kube.objects import LabelSelector, PodAffinityTerm
+
+    term = PodAffinityTerm(
+        topology_key=LABEL_TOPOLOGY_ZONE,
+        label_selector=LabelSelector(match_labels={"app": "web"}),
+    )
+    pods = [make_pod(labels={"app": "web"}, requests={"cpu": "1"}) for _ in range(2)] + [
+        make_pod(labels={"app": "web"}, requests={"cpu": "1"}, pod_affinity_required=[term])
+        for _ in range(2)
+    ]
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(20)}
+    host, tpu = run_both(pods, provisioners, its)
+    assert not tpu.failed_pods
+    zones = set()
+    for m in tpu.new_machines:
+        zones.update(m.requirements.get_requirement(LABEL_TOPOLOGY_ZONE).values_list())
+    assert len(zones) <= 1 or all(
+        m.requirements.get_requirement(LABEL_TOPOLOGY_ZONE).len() > 1
+        for m in tpu.new_machines
+    )
+
+
+def test_config3_mix_spread_and_anti_affinity():
+    """Config 3 analog (scaled down): spread + anti-affinity + generic mix."""
+    from karpenter_core_tpu.kube.objects import LabelSelector, TopologySpreadConstraint
+
+    spread = TopologySpreadConstraint(
+        max_skew=1,
+        topology_key=LABEL_TOPOLOGY_ZONE,
+        when_unsatisfiable="DoNotSchedule",
+        label_selector=LabelSelector(match_labels={"app": "spreader"}),
+    )
+    pods = (
+        [
+            make_pod(labels={"app": "spreader"}, requests={"cpu": "1"}, topology_spread=[spread])
+            for _ in range(30)
+        ]
+        + [make_pod(requests={"cpu": "1"}) for _ in range(50)]
+        + [make_pod(requests={"memory": "2Gi"}) for _ in range(20)]
+    )
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(20)}
+    host, tpu = run_both(pods, provisioners, its)
+    assert not tpu.failed_pods
+    assert tpu.pod_count_new() == 100
+    # skew of the spread group over zones
+    zone_counts = {}
+    for m in tpu.new_machines:
+        spreaders = [p for p in m.pods if p.metadata.labels.get("app") == "spreader"]
+        if spreaders:
+            z = m.requirements.get_requirement(LABEL_TOPOLOGY_ZONE).values_list()[0]
+            zone_counts[z] = zone_counts.get(z, 0) + len(spreaders)
+    assert max(zone_counts.values()) - min(zone_counts.values()) <= 1
