@@ -1,0 +1,165 @@
+"""Native (C++) greedy packer — ctypes bindings with build-on-first-use.
+
+NativeSolver implements the Solver interface for the NO-TOPOLOGY fallback
+path: the encoder computes the pod x type static feasibility mask (all
+requirement/taint/offering semantics), fast_pack.cpp runs the greedy FFD
+packing at C++ speed. Used by the solver service when no TPU is attached and
+as the in-process emergency fallback.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "fast_pack.cpp")
+_LIB = os.path.join(_HERE, "libfastpack.so")
+
+_lib = None
+_load_mu = threading.Lock()
+
+
+def _load():
+    global _lib
+    with _load_mu:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB) or os.path.getmtime(_LIB) < os.path.getmtime(_SRC):
+            # compile to a temp path + atomic rename so a concurrent process
+            # never dlopens a half-written .so
+            tmp = f"{_LIB}.{os.getpid()}.tmp"
+            subprocess.run(
+                ["g++", "-O3", "-shared", "-fPIC", _SRC, "-o", tmp], check=True
+            )
+            os.replace(tmp, _LIB)
+        lib = ctypes.CDLL(_LIB)
+        lib.fast_pack.restype = ctypes.c_int
+        lib.fast_pack.argtypes = [
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int32,
+            np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.float32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        ]
+        _lib = lib
+        return lib
+
+
+def fast_pack(pod_requests, f_static, type_alloc, daemon, max_nodes: int):
+    """Run the native packer. Returns (assigned[P], slot_tmask[N,T],
+    slot_used[N,R], slot_pods[N], nopen)."""
+    lib = _load()
+    P, R = pod_requests.shape
+    T = type_alloc.shape[0]
+    N = max_nodes
+    pod_requests = np.ascontiguousarray(pod_requests, dtype=np.float32)
+    f_static = np.ascontiguousarray(f_static, dtype=np.uint8)
+    type_alloc = np.ascontiguousarray(type_alloc, dtype=np.float32)
+    daemon = np.ascontiguousarray(daemon, dtype=np.float32)
+    assigned = np.full(P, -1, dtype=np.int32)
+    slot_tmask = np.zeros((N, T), dtype=np.uint8)
+    slot_used = np.zeros((N, R), dtype=np.float32)
+    slot_pods = np.zeros(N, dtype=np.int32)
+    nopen = np.zeros(1, dtype=np.int32)
+    lib.fast_pack(
+        P, T, R, N, pod_requests, f_static, type_alloc, daemon,
+        assigned, slot_tmask, slot_used, slot_pods, nopen,
+    )
+    return assigned, slot_tmask, slot_used, slot_pods, int(nopen[0])
+
+
+class NativeSolver:
+    """Solver interface over the C++ packer (single-template, no-topology
+    path; richer batches raise so the caller falls back to GreedySolver)."""
+
+    def __init__(self, max_nodes: int = 1024):
+        self.max_nodes = max_nodes
+
+    def solve(
+        self,
+        pods,
+        provisioners,
+        instance_types,
+        daemonset_pods=None,
+        state_nodes=None,
+        kube_client=None,
+        cluster=None,
+    ):
+        from karpenter_core_tpu.ops.feasibility import feasibility_static
+        from karpenter_core_tpu.solver.encode import encode_snapshot
+        from karpenter_core_tpu.solver.tpu_solver import (
+            SolveResult,
+            _reqset_to_dict,
+            decode_solve,
+        )
+
+        if not pods:
+            return SolveResult()
+        if not provisioners or not any(instance_types.values()):
+            return SolveResult(failed_pods=list(pods))
+        snap = encode_snapshot(
+            pods, provisioners, instance_types, daemonset_pods, state_nodes,
+            kube_client=kube_client, cluster=cluster, max_nodes=self.max_nodes,
+        )
+        if snap.topo_meta is not None:
+            raise NotImplementedError("native packer handles topology-free batches")
+        if len(snap.templates) != 1 or snap.state_nodes:
+            raise NotImplementedError("native packer handles single-template fresh packs")
+        if any(p.spec.limits is not None for p in provisioners):
+            # the device kernel enforces limits via state.remaining
+            # (scheduler.go:276-293); the native path has no equivalent yet
+            raise NotImplementedError("native packer does not enforce provisioner limits")
+
+        segments = [snap.dictionary.segment(k) for k in snap.dictionary.keys]
+        f = feasibility_static(
+            _reqset_to_dict(snap.pod_reqs),
+            _reqset_to_dict(snap.tmpl_reqs),
+            _reqset_to_dict(snap.type_reqs),
+            snap.pod_tol,
+            snap.tmpl_type_mask,
+            snap.type_offering_ok,
+            snap.zone_seg,
+            snap.ct_seg,
+            segments,
+            snap.well_known,
+        )
+        f_static = np.asarray(f[0])  # [P, T]
+        assigned, slot_tmask, slot_used, slot_pods, nopen = fast_pack(
+            snap.pod_requests, f_static, snap.type_alloc, snap.tmpl_daemon[0],
+            min(self.max_nodes, max(len(pods), 1)),
+        )
+
+        class _State:
+            pass
+
+        state = _State()
+        state.tmpl = np.zeros(slot_tmask.shape[0], dtype=np.int32)
+        state.tmask = slot_tmask.astype(bool)
+        state.used = slot_used
+        # merged requirement masks: template ∩ assigned pods (host recompute)
+        N, V = slot_tmask.shape[0], snap.dictionary.V
+        allow = np.ones((N, V), dtype=bool)
+        out = np.ones((N, snap.dictionary.K), dtype=bool)
+        defined = np.zeros((N, snap.dictionary.K), dtype=bool)
+        allow[:] = snap.tmpl_reqs.allow[0]
+        out[:] = snap.tmpl_reqs.out[0]
+        defined[:] = snap.tmpl_reqs.defined[0]
+        for i, slot in enumerate(assigned):
+            if slot >= 0:
+                allow[slot] &= snap.pod_reqs.allow[i]
+                out[slot] &= snap.pod_reqs.out[i]
+                defined[slot] |= snap.pod_reqs.defined[i]
+        state.allow = allow
+        state.out = out
+        state.defined = defined
+        return decode_solve(snap, assigned, state)

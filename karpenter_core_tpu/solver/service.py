@@ -1,0 +1,356 @@
+"""gRPC Solver service: stateless dense-solve execution behind the process
+boundary (SURVEY.md section 7.2 — absent in the reference, whose Solve is
+in-process at provisioner.go:301).
+
+Server: receives the encoded snapshot tensors + static geometry, runs the
+feasibility+packing device program, returns assignment + slot-state tensors.
+Client (RemoteSolver): implements the same Solver interface as
+TPUSolver/GreedySolver — encodes host-side, ships tensors, decodes locally —
+so the control plane can point at an out-of-process TPU solver with one
+constructor swap. The service keeps no snapshot state: restarts are trivial.
+
+The gRPC method is registered by hand (grpc.unary_unary_rpc_method_handler);
+messages come from service.proto via protoc.
+"""
+from __future__ import annotations
+
+import copy
+import json
+import threading
+from concurrent import futures
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from karpenter_core_tpu.solver import service_pb2 as pb
+from karpenter_core_tpu.solver.encode import encode_snapshot
+from karpenter_core_tpu.solver.tpu_solver import (
+    SolveResult,
+    decode_solve,
+    device_args,
+)
+
+SERVICE = "karpenter.solver.v1.Solver"
+
+
+# ---------------------------------------------------------------------------
+# tensor (de)serialization
+
+
+def tensor_to_pb(name: str, array: np.ndarray) -> pb.Tensor:
+    array = np.ascontiguousarray(array)
+    return pb.Tensor(
+        name=name, dtype=str(array.dtype), shape=list(array.shape), data=array.tobytes()
+    )
+
+
+def tensor_from_pb(t: pb.Tensor) -> np.ndarray:
+    return np.frombuffer(t.data, dtype=np.dtype(t.dtype)).reshape(tuple(t.shape))
+
+
+def _flatten_args(args) -> List[Tuple[str, np.ndarray]]:
+    """device_args tuple -> named tensors (dicts flattened with / paths)."""
+    out = []
+
+    def walk(prefix, value):
+        if isinstance(value, dict):
+            for k in sorted(value):
+                walk(f"{prefix}/{k}", value[k])
+        else:
+            out.append((prefix, np.asarray(value)))
+
+    names = [
+        "pod_arrays", "tmpl", "tmpl_daemon", "tmpl_type_mask", "types",
+        "type_alloc", "type_capacity", "type_offering_ok", "pod_tol_all",
+        "exist", "exist_used", "exist_cap", "well_known", "remaining0",
+        "topo_counts0", "topo_hcounts0", "topo_doms0", "topo_terms",
+    ]
+    for name, value in zip(names, args):
+        walk(name, value)
+    return out
+
+
+def _unflatten_args(tensors: Dict[str, np.ndarray]):
+    def gather(prefix):
+        sub = {}
+        plain = None
+        for name, arr in tensors.items():
+            if name == prefix:
+                plain = arr
+            elif name.startswith(prefix + "/"):
+                sub[name[len(prefix) + 1 :]] = arr
+        return sub if sub else plain
+
+    names = [
+        "pod_arrays", "tmpl", "tmpl_daemon", "tmpl_type_mask", "types",
+        "type_alloc", "type_capacity", "type_offering_ok", "pod_tol_all",
+        "exist", "exist_used", "exist_cap", "well_known", "remaining0",
+        "topo_counts0", "topo_hcounts0", "topo_doms0", "topo_terms",
+    ]
+    return tuple(gather(n) for n in names)
+
+
+def geometry_json(snap) -> str:
+    topo = None
+    if snap.topo_meta is not None:
+        topo = [
+            {
+                "gtype": g.gtype,
+                "seg": list(g.seg),
+                "key_k": g.key_k,
+                "max_skew": g.max_skew,
+                "is_hostname": g.is_hostname,
+                "is_inverse": g.is_inverse,
+                "filter_term_rows": list(g.filter_term_rows),
+            }
+            for g in snap.topo_meta.groups
+        ]
+    return json.dumps(
+        {
+            "segments": [list(snap.dictionary.segment(k)) for k in snap.dictionary.keys],
+            "zone_seg": list(snap.zone_seg),
+            "ct_seg": list(snap.ct_seg),
+            "n_slots": snap.n_slots,
+            "topo_groups": topo,
+        }
+    )
+
+
+# ---------------------------------------------------------------------------
+# server
+
+
+class SolverService:
+    """Stateless executor keyed by geometry (jit cache shared across calls)."""
+
+    def __init__(self):
+        self._compiled = {}
+        self._mu = threading.Lock()
+        self.solves = 0
+
+    def solve(self, request: pb.SolveRequest, context=None) -> pb.SolveResponse:
+        import jax
+
+        from karpenter_core_tpu.ops.topology import TopoGroupMeta, TopoMeta
+
+        try:
+            geometry = json.loads(request.geometry)
+            tensors = {t.name: tensor_from_pb(t) for t in request.tensors}
+            args = _unflatten_args(tensors)
+            segments = [tuple(s) for s in geometry["segments"]]
+            zone_seg = tuple(geometry["zone_seg"])
+            ct_seg = tuple(geometry["ct_seg"])
+            topo_meta = None
+            if geometry.get("topo_groups"):
+                topo_meta = TopoMeta(
+                    groups=[
+                        TopoGroupMeta(
+                            gtype=g["gtype"],
+                            seg=tuple(g["seg"]),
+                            key_k=g["key_k"],
+                            max_skew=g["max_skew"],
+                            is_hostname=g["is_hostname"],
+                            is_inverse=g["is_inverse"],
+                            filter_term_rows=list(g["filter_term_rows"]),
+                        )
+                        for g in geometry["topo_groups"]
+                    ]
+                )
+            key = (request.geometry,)
+            with self._mu:
+                fn = self._compiled.get(key)
+            if fn is None:
+                fn = jax.jit(
+                    _build_run(segments, zone_seg, ct_seg, topo_meta, geometry["n_slots"])
+                )
+                with self._mu:
+                    self._compiled[key] = fn
+            assigned, state = fn(*args)
+            out = [tensor_to_pb("assigned", np.asarray(assigned))]
+            for field, value in state._asdict().items():
+                out.append(tensor_to_pb(f"state/{field}", np.asarray(value)))
+            with self._mu:
+                self.solves += 1
+            return pb.SolveResponse(tensors=out)
+        except Exception as e:  # surface errors to the client
+            return pb.SolveResponse(error=f"{type(e).__name__}: {e}")
+
+    def health(self, request: pb.HealthRequest, context=None) -> pb.HealthResponse:
+        import jax
+
+        return pb.HealthResponse(
+            status="ok", device=jax.devices()[0].device_kind, solves=self.solves
+        )
+
+
+def _build_run(segments, zone_seg, ct_seg, topo_meta, n_slots):
+    import jax.numpy as jnp
+
+    from karpenter_core_tpu.ops.feasibility import feasibility_static, openable_mask
+    from karpenter_core_tpu.ops.pack import PackState, make_pack_kernel
+
+    pack = make_pack_kernel(list(segments), zone_seg, ct_seg, topo_meta=topo_meta)
+
+    def run(pod_arrays, tmpl, tmpl_daemon, tmpl_type_mask, types, type_alloc,
+            type_capacity, type_offering_ok, pod_tol_all, exist, exist_used,
+            exist_cap, well_known, remaining0, topo_counts0, topo_hcounts0,
+            topo_doms0, topo_terms):
+        E = exist_used.shape[0]
+        N = n_slots
+        R = type_alloc.shape[1]
+        T = type_alloc.shape[0]
+        J = tmpl_daemon.shape[0]
+        V = pod_arrays["allow"].shape[1]
+        K = pod_arrays["out"].shape[1]
+        f_static = feasibility_static(
+            {k: pod_arrays[k] for k in ("allow", "out", "defined", "escape")},
+            tmpl, types, pod_arrays["tol_tmpl"], tmpl_type_mask,
+            type_offering_ok, zone_seg, ct_seg, list(segments), well_known,
+        )
+        openable = openable_mask(f_static, pod_arrays["requests"], tmpl_daemon, type_alloc)
+        state = PackState(
+            used=jnp.zeros((N, R), jnp.float32).at[:E].set(exist_used),
+            open=jnp.arange(N) < E,
+            is_existing=jnp.arange(N) < E,
+            tmpl=jnp.zeros(N, jnp.int32),
+            tol_idx=jnp.concatenate(
+                [J + jnp.arange(E, dtype=jnp.int32), jnp.zeros(N - E, jnp.int32)]
+            ),
+            pods=jnp.zeros(N, jnp.int32),
+            allow=jnp.ones((N, V), bool).at[:E].set(exist["allow"]),
+            out=jnp.ones((N, K), bool).at[:E].set(exist["out"]),
+            defined=jnp.zeros((N, K), bool).at[:E].set(exist["defined"]),
+            tmask=jnp.zeros((N, T), bool),
+            cap=jnp.zeros((N, R), jnp.float32).at[:E].set(exist_cap),
+            nopen=jnp.int32(E),
+            remaining=remaining0,
+            tcounts=topo_counts0,
+            thost=topo_hcounts0,
+            tdoms=topo_doms0,
+        )
+        pod_arrays2 = dict(pod_arrays)
+        pod_arrays2["tol"] = pod_tol_all
+        state, assigned = pack(
+            state, pod_arrays2, f_static, openable,
+            {k: tmpl[k] for k in ("allow", "out", "defined")},
+            tmpl_daemon, tmpl_type_mask, types, type_alloc, type_capacity,
+            type_offering_ok, well_known=well_known, topo_terms=topo_terms,
+        )
+        return assigned, state
+
+    return run
+
+
+def serve(address: str = "127.0.0.1:0", max_workers: int = 4):
+    """Start the gRPC server; returns (server, bound_port, service)."""
+    import grpc
+
+    service = SolverService()
+    handlers = {
+        "Solve": grpc.unary_unary_rpc_method_handler(
+            service.solve,
+            request_deserializer=pb.SolveRequest.FromString,
+            response_serializer=pb.SolveResponse.SerializeToString,
+        ),
+        "Health": grpc.unary_unary_rpc_method_handler(
+            service.health,
+            request_deserializer=pb.HealthRequest.FromString,
+            response_serializer=pb.HealthResponse.SerializeToString,
+        ),
+    }
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(SERVICE, handlers),)
+    )
+    port = server.add_insecure_port(address)
+    server.start()
+    return server, port, service
+
+
+# ---------------------------------------------------------------------------
+# client
+
+
+class RemoteSolver:
+    """Solver-interface client: encode locally, solve remotely, decode
+    locally. Falls back to raising on transport errors (the provisioning
+    controller's fallback_solver takes over)."""
+
+    def __init__(self, target: str, max_nodes: int = 1024, max_relax_rounds: int = 3,
+                 timeout: float = 120.0):
+        import grpc
+
+        self.channel = grpc.insecure_channel(target)
+        self.timeout = timeout
+        self.max_nodes = max_nodes
+        self.max_relax_rounds = max_relax_rounds
+        self._solve = self.channel.unary_unary(
+            f"/{SERVICE}/Solve",
+            request_serializer=pb.SolveRequest.SerializeToString,
+            response_deserializer=pb.SolveResponse.FromString,
+        )
+        self._health = self.channel.unary_unary(
+            f"/{SERVICE}/Health",
+            request_serializer=pb.HealthRequest.SerializeToString,
+            response_deserializer=pb.HealthResponse.FromString,
+        )
+
+    def health(self) -> pb.HealthResponse:
+        return self._health(pb.HealthRequest(), timeout=5.0)
+
+    def solve(
+        self,
+        pods,
+        provisioners,
+        instance_types,
+        daemonset_pods=None,
+        state_nodes=None,
+        kube_client=None,
+        cluster=None,
+    ) -> SolveResult:
+        from karpenter_core_tpu.solver.tpu_solver import solve_with_relaxation
+
+        return solve_with_relaxation(
+            lambda p: self._solve_once(
+                p, provisioners, instance_types, daemonset_pods, state_nodes,
+                kube_client, cluster,
+            ),
+            pods,
+            provisioners,
+            instance_types,
+            self.max_relax_rounds,
+        )
+
+    def _solve_once(self, pods, provisioners, instance_types, daemonset_pods,
+                    state_nodes, kube_client, cluster) -> SolveResult:
+        snap = encode_snapshot(
+            pods, provisioners, instance_types, daemonset_pods, state_nodes,
+            kube_client=kube_client, cluster=cluster, max_nodes=self.max_nodes,
+        )
+        args = device_args(snap, provisioners)
+        request = pb.SolveRequest(
+            geometry=geometry_json(snap),
+            tensors=[tensor_to_pb(n, a) for n, a in _flatten_args(args)],
+        )
+        response = self._solve(request, timeout=self.timeout)
+        if response.error:
+            raise RuntimeError(f"solver service error: {response.error}")
+        tensors = {t.name: tensor_from_pb(t) for t in response.tensors}
+        assigned = tensors["assigned"]
+        state = _StateView(
+            {k[len("state/"):]: v for k, v in tensors.items() if k.startswith("state/")}
+        )
+        return decode_solve(snap, assigned, state)
+
+
+class _StateView:
+    """Attribute access over the returned state tensors."""
+
+    def __init__(self, tensors: Dict[str, np.ndarray]):
+        self._tensors = tensors
+
+    def __getattr__(self, name):
+        try:
+            return self._tensors[name]
+        except KeyError:
+            raise AttributeError(name)

@@ -64,6 +64,34 @@ def _reqset_to_dict(rs: ReqSetArrays) -> Dict[str, np.ndarray]:
     return {"allow": rs.allow, "out": rs.out, "defined": rs.defined, "escape": rs.escape}
 
 
+def solve_with_relaxation(solve_once, pods, provisioners, instance_types,
+                          max_relax_rounds: int) -> "SolveResult":
+    """Shared driver: guard degenerate inputs, deepcopy pods (relaxation
+    mutates specs), run solve_once, relax EVERY failed pod between rounds
+    (preferences.go order) — used by TPUSolver, RemoteSolver, and any other
+    Solver implementation."""
+    if not pods:
+        return SolveResult()
+    if not provisioners or not any(instance_types.values()):
+        return SolveResult(failed_pods=list(pods))
+    pods = [copy.deepcopy(p) for p in pods]
+    preferences = Preferences(
+        any(t.effect == "PreferNoSchedule" for p in provisioners for t in p.spec.taints)
+    )
+    result = solve_once(pods)
+    rounds = 1
+    while result.failed_pods and rounds < max_relax_rounds:
+        relaxed_any = False
+        for pod in result.failed_pods:
+            relaxed_any |= preferences.relax(pod)
+        if not relaxed_any:
+            break
+        result = solve_once(pods)
+        rounds += 1
+    result.rounds = rounds
+    return result
+
+
 def solve_geometry(snap: EncodedSnapshot, max_nodes: int):
     dictionary = snap.dictionary
     segments = [dictionary.segment(k) for k in dictionary.keys]
@@ -271,36 +299,16 @@ class TPUSolver:
         kube_client=None,
         cluster=None,
     ) -> SolveResult:
-        if not pods:
-            return SolveResult()
-        if not provisioners or not any(instance_types.values()):
-            return SolveResult(failed_pods=list(pods))
-        pods = [copy.deepcopy(p) for p in pods]  # relaxation mutates specs
-        preferences = Preferences(
-            any(
-                t.effect == "PreferNoSchedule"
-                for p in provisioners
-                for t in p.spec.taints
-            )
-        )
-        result = self._solve_once(
-            pods, provisioners, instance_types, daemonset_pods, state_nodes,
-            kube_client, cluster,
-        )
-        rounds = 1
-        while result.failed_pods and rounds < self.max_relax_rounds:
-            relaxed_any = False
-            for pod in result.failed_pods:
-                relaxed_any |= preferences.relax(pod)
-            if not relaxed_any:
-                break
-            result = self._solve_once(
-                pods, provisioners, instance_types, daemonset_pods, state_nodes,
+        return solve_with_relaxation(
+            lambda p: self._solve_once(
+                p, provisioners, instance_types, daemonset_pods, state_nodes,
                 kube_client, cluster,
-            )
-            rounds += 1
-        result.rounds = rounds
-        return result
+            ),
+            pods,
+            provisioners,
+            instance_types,
+            self.max_relax_rounds,
+        )
 
     # -- internals ---------------------------------------------------------
 
@@ -311,7 +319,7 @@ class TPUSolver:
             kube_client=kube_client, cluster=cluster, max_nodes=self.max_nodes,
         )
         assigned, state = self._run_kernels(snap, provisioners)
-        return self._decode(snap, assigned, state)
+        return decode_solve(snap, assigned, state)
 
     def _run_kernels(self, snap: EncodedSnapshot, provisioners: List[Provisioner]):
         import jax
@@ -325,71 +333,71 @@ class TPUSolver:
         assigned, state = fn(*args)
         return np.asarray(assigned), jax.tree_util.tree_map(np.asarray, state)
 
-    def _decode(self, snap: EncodedSnapshot, assigned: np.ndarray, state) -> SolveResult:
-        E = len(snap.state_nodes)
-        slot_pods: Dict[int, List[Pod]] = {}
-        failed: List[Pod] = []
-        for i, pod in enumerate(snap.pods):
-            slot = int(assigned[i])
-            if slot < 0:
-                failed.append(pod)
-            else:
-                slot_pods.setdefault(slot, []).append(pod)
+def decode_solve(snap: EncodedSnapshot, assigned: np.ndarray, state) -> SolveResult:
+    """Slot assignments + final slot state -> SolveResult (shared by the
+    in-process TPUSolver and the gRPC RemoteSolver client)."""
+    E = len(snap.state_nodes)
+    slot_pods: Dict[int, List[Pod]] = {}
+    failed: List[Pod] = []
+    for i, pod in enumerate(snap.pods):
+        slot = int(assigned[i])
+        if slot < 0:
+            failed.append(pod)
+        else:
+            slot_pods.setdefault(slot, []).append(pod)
 
-        machines: List[SolvedMachine] = []
-        existing: List[Tuple[object, List[Pod]]] = []
-        for slot, pods in sorted(slot_pods.items()):
-            if slot < E:
-                existing.append((snap.state_nodes[slot], pods))
-                continue
-            tmpl_id = int(state.tmpl[slot])
-            template = snap.templates[tmpl_id]
-            tmask = np.asarray(state.tmask[slot])
-            options = [snap.instance_types[t] for t in np.nonzero(tmask)[0]]
-            requirements = self._slot_requirements(snap, state, slot)
-            requests = dict(
-                zip(snap.resource_names, np.asarray(state.used[slot]).tolist())
+    machines: List[SolvedMachine] = []
+    existing: List[Tuple[object, List[Pod]]] = []
+    for slot, pods in sorted(slot_pods.items()):
+        if slot < E:
+            existing.append((snap.state_nodes[slot], pods))
+            continue
+        tmpl_id = int(state.tmpl[slot])
+        template = snap.templates[tmpl_id]
+        tmask = np.asarray(state.tmask[slot])
+        options = [snap.instance_types[t] for t in np.nonzero(tmask)[0]]
+        requirements = slot_requirements(snap, state, slot)
+        requests = dict(zip(snap.resource_names, np.asarray(state.used[slot]).tolist()))
+        requests = {k: v for k, v in requests.items() if v}
+        machines.append(
+            SolvedMachine(
+                provisioner_name=template.provisioner_name,
+                template=template,
+                pods=pods,
+                instance_type_options=options,
+                requests=requests,
+                requirements=requirements,
             )
-            requests = {k: v for k, v in requests.items() if v}
-            machines.append(
-                SolvedMachine(
-                    provisioner_name=template.provisioner_name,
-                    template=template,
-                    pods=pods,
-                    instance_type_options=options,
-                    requests=requests,
-                    requirements=requirements,
-                )
-            )
-        return SolveResult(
-            new_machines=machines, existing_assignments=existing, failed_pods=failed
         )
+    return SolveResult(
+        new_machines=machines, existing_assignments=existing, failed_pods=failed
+    )
 
-    @staticmethod
-    def _slot_requirements(snap: EncodedSnapshot, state, slot) -> Requirements:
-        """Reconstruct the machine's merged requirements from the slot masks —
-        includes topology domain narrowing the kernel committed. (Integer
-        Gt/Lt bounds on complement sets are already baked into the allow
-        masks for dictionary values; the bound itself is not recoverable.)"""
-        from karpenter_core_tpu.scheduling.requirement import Requirement
 
-        dictionary = snap.dictionary
-        allow = np.asarray(state.allow[slot])
-        out = np.asarray(state.out[slot])
-        defined = np.asarray(state.defined[slot])
-        requirements = Requirements()
-        for k, key in enumerate(dictionary.keys):
-            if not defined[k]:
-                continue
-            lo, hi = dictionary.segment(key)
-            vals = dictionary.values_of(key)
-            if out[k]:
-                excluded = [v for v, a in zip(vals, allow[lo:hi]) if not a]
-                requirements.add(Requirement(key, "NotIn", excluded))
-            else:
-                allowed = [v for v, a in zip(vals, allow[lo:hi]) if a]
-                requirements.add(Requirement(key, "In", allowed))
-        return requirements
+def slot_requirements(snap: EncodedSnapshot, state, slot) -> Requirements:
+    """Reconstruct the machine's merged requirements from the slot masks —
+    includes topology domain narrowing the kernel committed. (Integer
+    Gt/Lt bounds on complement sets are already baked into the allow
+    masks for dictionary values; the bound itself is not recoverable.)"""
+    from karpenter_core_tpu.scheduling.requirement import Requirement
+
+    dictionary = snap.dictionary
+    allow = np.asarray(state.allow[slot])
+    out = np.asarray(state.out[slot])
+    defined = np.asarray(state.defined[slot])
+    requirements = Requirements()
+    for k, key in enumerate(dictionary.keys):
+        if not defined[k]:
+            continue
+        lo, hi = dictionary.segment(key)
+        vals = dictionary.values_of(key)
+        if out[k]:
+            excluded = [v for v, a in zip(vals, allow[lo:hi]) if not a]
+            requirements.add(Requirement(key, "NotIn", excluded))
+        else:
+            allowed = [v for v, a in zip(vals, allow[lo:hi]) if a]
+            requirements.add(Requirement(key, "In", allowed))
+    return requirements
 
 
 class GreedySolver:

@@ -1,0 +1,73 @@
+"""gRPC Solver service tests: solve over the wire, decode locally."""
+import pytest
+
+from karpenter_core_tpu.cloudprovider import fake
+from karpenter_core_tpu.solver.service import RemoteSolver, serve
+from karpenter_core_tpu.solver.tpu_solver import GreedySolver
+from karpenter_core_tpu.testing import make_pod, make_provisioner
+
+
+@pytest.fixture(scope="module")
+def server():
+    server, port, service = serve()
+    yield port, service
+    server.stop(0)
+
+
+def test_health(server):
+    port, service = server
+    client = RemoteSolver(f"127.0.0.1:{port}")
+    health = client.health()
+    assert health.status == "ok"
+    assert health.device
+
+
+def test_remote_solve_matches_local(server):
+    port, _ = server
+    client = RemoteSolver(f"127.0.0.1:{port}")
+    pods = [make_pod(requests={"cpu": "1"}) for _ in range(10)]
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(10)}
+    remote = client.solve(pods, provisioners, its)
+    local = GreedySolver().solve(pods, provisioners, its)
+    assert not remote.failed_pods
+    assert remote.pod_count_new() == 10
+    assert len(remote.new_machines) <= len(local.new_machines)
+    assert remote.new_machines[0].instance_type_options
+
+
+def test_remote_solve_with_topology(server):
+    from karpenter_core_tpu.kube.objects import (
+        LABEL_TOPOLOGY_ZONE,
+        LabelSelector,
+        TopologySpreadConstraint,
+    )
+
+    port, _ = server
+    client = RemoteSolver(f"127.0.0.1:{port}")
+    spread = TopologySpreadConstraint(
+        max_skew=1,
+        topology_key=LABEL_TOPOLOGY_ZONE,
+        when_unsatisfiable="DoNotSchedule",
+        label_selector=LabelSelector(match_labels={"app": "web"}),
+    )
+    pods = [
+        make_pod(labels={"app": "web"}, requests={"cpu": "1"}, topology_spread=[spread])
+        for _ in range(6)
+    ]
+    remote = client.solve(pods, [make_provisioner(name="default")], {"default": fake.instance_types(5)})
+    assert not remote.failed_pods
+    zones = set()
+    for m in remote.new_machines:
+        zone_req = m.requirements.get_requirement(LABEL_TOPOLOGY_ZONE)
+        assert zone_req.len() == 1
+        zones.update(zone_req.values_list())
+    assert len(zones) == 3
+
+
+def test_remote_error_surfaces(server):
+    port, _ = server
+    client = RemoteSolver(f"127.0.0.1:{port}")
+    # no pods -> local short-circuit, no crash
+    result = client.solve([], [make_provisioner(name="d")], {"d": fake.instance_types(2)})
+    assert result.pod_count_new() == 0
