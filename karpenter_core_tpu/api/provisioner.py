@@ -33,6 +33,9 @@ class KubeletConfiguration:
     kube_reserved: ResourceList = field(default_factory=dict)
     eviction_hard: Dict[str, str] = field(default_factory=dict)
     eviction_soft: Dict[str, str] = field(default_factory=dict)
+    eviction_soft_grace_period: Dict[str, str] = field(default_factory=dict)
+    image_gc_high_threshold_percent: Optional[int] = None
+    image_gc_low_threshold_percent: Optional[int] = None
 
 
 @dataclass

@@ -456,6 +456,12 @@ class Namespace:
 
 
 @dataclass
+class ConfigMap:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    data: Dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
 class DaemonSet:
     """Minimal DaemonSet: carries the pod template the scheduler uses to
     compute per-template daemon overhead."""

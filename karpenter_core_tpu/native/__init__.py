@@ -33,9 +33,16 @@ def _load():
             # compile to a temp path + atomic rename so a concurrent process
             # never dlopens a half-written .so
             tmp = f"{_LIB}.{os.getpid()}.tmp"
-            subprocess.run(
-                ["g++", "-O3", "-shared", "-fPIC", _SRC, "-o", tmp], check=True
-            )
+            try:
+                subprocess.run(
+                    ["g++", "-O3", "-shared", "-fPIC", _SRC, "-o", tmp], check=True
+                )
+            except (OSError, subprocess.CalledProcessError) as e:
+                raise RuntimeError(
+                    "native packer unavailable: building libfastpack.so failed "
+                    f"({e}); ship a prebuilt .so next to fast_pack.cpp or use "
+                    "the TPU/Greedy solver"
+                ) from e
             os.replace(tmp, _LIB)
         lib = ctypes.CDLL(_LIB)
         lib.fast_pack.restype = ctypes.c_int
@@ -95,18 +102,28 @@ class NativeSolver:
         kube_client=None,
         cluster=None,
     ):
+        from karpenter_core_tpu.solver.tpu_solver import solve_with_relaxation
+
+        return solve_with_relaxation(
+            lambda p: self._solve_once(
+                p, provisioners, instance_types, daemonset_pods, state_nodes,
+                kube_client, cluster,
+            ),
+            pods,
+            provisioners,
+            instance_types,
+            max_relax_rounds=3,
+        )
+
+    def _solve_once(self, pods, provisioners, instance_types, daemonset_pods,
+                    state_nodes, kube_client=None, cluster=None):
         from karpenter_core_tpu.ops.feasibility import feasibility_static
         from karpenter_core_tpu.solver.encode import encode_snapshot
         from karpenter_core_tpu.solver.tpu_solver import (
-            SolveResult,
             _reqset_to_dict,
             decode_solve,
         )
 
-        if not pods:
-            return SolveResult()
-        if not provisioners or not any(instance_types.values()):
-            return SolveResult(failed_pods=list(pods))
         snap = encode_snapshot(
             pods, provisioners, instance_types, daemonset_pods, state_nodes,
             kube_client=kube_client, cluster=cluster, max_nodes=self.max_nodes,

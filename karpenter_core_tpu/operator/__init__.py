@@ -186,11 +186,20 @@ def new_operator(
     settings: Optional[Settings] = None,
     solver=None,
     clock=time.time,
+    with_webhooks: bool = False,
 ) -> Operator:
-    """Assemble the full control plane (controllers.go:46-73)."""
+    """Assemble the full control plane (controllers.go:46-73).
+
+    with_webhooks installs admission defaulting/validation on the client
+    (operator.WithWebhooks, operator.go:149-152); off by default because
+    test suites create intentionally-partial objects."""
     if settings is not None:
         set_current(settings)
     kube_client = kube_client or InMemoryKubeClient()
+    if with_webhooks:
+        from karpenter_core_tpu.webhooks import install as install_webhooks
+
+        install_webhooks(kube_client)
     recorder = Recorder(clock=clock)
     cluster = Cluster(kube_client, cloud_provider, clock=clock)
     eviction_queue = EvictionQueue(kube_client, recorder)

@@ -28,6 +28,7 @@ from karpenter_core_tpu.solver.tpu_solver import (
     SolveResult,
     decode_solve,
     device_args,
+    make_device_run,
 )
 
 SERVICE = "karpenter.solver.v1.Solver"
@@ -48,6 +49,15 @@ def tensor_from_pb(t: pb.Tensor) -> np.ndarray:
     return np.frombuffer(t.data, dtype=np.dtype(t.dtype)).reshape(tuple(t.shape))
 
 
+# device_args() tuple element names, in positional order — the wire schema.
+_ARG_NAMES = (
+    "pod_arrays", "tmpl", "tmpl_daemon", "tmpl_type_mask", "types",
+    "type_alloc", "type_capacity", "type_offering_ok", "pod_tol_all",
+    "exist", "exist_used", "exist_cap", "well_known", "remaining0",
+    "topo_counts0", "topo_hcounts0", "topo_doms0", "topo_terms",
+)
+
+
 def _flatten_args(args) -> List[Tuple[str, np.ndarray]]:
     """device_args tuple -> named tensors (dicts flattened with / paths)."""
     out = []
@@ -59,13 +69,7 @@ def _flatten_args(args) -> List[Tuple[str, np.ndarray]]:
         else:
             out.append((prefix, np.asarray(value)))
 
-    names = [
-        "pod_arrays", "tmpl", "tmpl_daemon", "tmpl_type_mask", "types",
-        "type_alloc", "type_capacity", "type_offering_ok", "pod_tol_all",
-        "exist", "exist_used", "exist_cap", "well_known", "remaining0",
-        "topo_counts0", "topo_hcounts0", "topo_doms0", "topo_terms",
-    ]
-    for name, value in zip(names, args):
+    for name, value in zip(_ARG_NAMES, args):
         walk(name, value)
     return out
 
@@ -81,13 +85,7 @@ def _unflatten_args(tensors: Dict[str, np.ndarray]):
                 sub[name[len(prefix) + 1 :]] = arr
         return sub if sub else plain
 
-    names = [
-        "pod_arrays", "tmpl", "tmpl_daemon", "tmpl_type_mask", "types",
-        "type_alloc", "type_capacity", "type_offering_ok", "pod_tol_all",
-        "exist", "exist_used", "exist_cap", "well_known", "remaining0",
-        "topo_counts0", "topo_hcounts0", "topo_doms0", "topo_terms",
-    ]
-    return tuple(gather(n) for n in names)
+    return tuple(gather(n) for n in _ARG_NAMES)
 
 
 def geometry_json(snap) -> str:
@@ -121,10 +119,18 @@ def geometry_json(snap) -> str:
 
 
 class SolverService:
-    """Stateless executor keyed by geometry (jit cache shared across calls)."""
+    """Stateless executor keyed by geometry (jit cache shared across calls).
+
+    The cache is LRU-bounded: geometry embeds the label dictionary, so in a
+    live cluster label churn mints new keys — an unbounded map would pin every
+    old compiled executable until OOM."""
+
+    MAX_COMPILED = 32
 
     def __init__(self):
-        self._compiled = {}
+        from collections import OrderedDict
+
+        self._compiled = OrderedDict()
         self._mu = threading.Lock()
         self.solves = 0
 
@@ -159,12 +165,18 @@ class SolverService:
             key = (request.geometry,)
             with self._mu:
                 fn = self._compiled.get(key)
+                if fn is not None:
+                    self._compiled.move_to_end(key)
             if fn is None:
                 fn = jax.jit(
-                    _build_run(segments, zone_seg, ct_seg, topo_meta, geometry["n_slots"])
+                    make_device_run(
+                        segments, zone_seg, ct_seg, topo_meta, geometry["n_slots"]
+                    )
                 )
                 with self._mu:
                     self._compiled[key] = fn
+                    while len(self._compiled) > self.MAX_COMPILED:
+                        self._compiled.popitem(last=False)
             assigned, state = fn(*args)
             out = [tensor_to_pb("assigned", np.asarray(assigned))]
             for field, value in state._asdict().items():
@@ -181,64 +193,6 @@ class SolverService:
         return pb.HealthResponse(
             status="ok", device=jax.devices()[0].device_kind, solves=self.solves
         )
-
-
-def _build_run(segments, zone_seg, ct_seg, topo_meta, n_slots):
-    import jax.numpy as jnp
-
-    from karpenter_core_tpu.ops.feasibility import feasibility_static, openable_mask
-    from karpenter_core_tpu.ops.pack import PackState, make_pack_kernel
-
-    pack = make_pack_kernel(list(segments), zone_seg, ct_seg, topo_meta=topo_meta)
-
-    def run(pod_arrays, tmpl, tmpl_daemon, tmpl_type_mask, types, type_alloc,
-            type_capacity, type_offering_ok, pod_tol_all, exist, exist_used,
-            exist_cap, well_known, remaining0, topo_counts0, topo_hcounts0,
-            topo_doms0, topo_terms):
-        E = exist_used.shape[0]
-        N = n_slots
-        R = type_alloc.shape[1]
-        T = type_alloc.shape[0]
-        J = tmpl_daemon.shape[0]
-        V = pod_arrays["allow"].shape[1]
-        K = pod_arrays["out"].shape[1]
-        f_static = feasibility_static(
-            {k: pod_arrays[k] for k in ("allow", "out", "defined", "escape")},
-            tmpl, types, pod_arrays["tol_tmpl"], tmpl_type_mask,
-            type_offering_ok, zone_seg, ct_seg, list(segments), well_known,
-        )
-        openable = openable_mask(f_static, pod_arrays["requests"], tmpl_daemon, type_alloc)
-        state = PackState(
-            used=jnp.zeros((N, R), jnp.float32).at[:E].set(exist_used),
-            open=jnp.arange(N) < E,
-            is_existing=jnp.arange(N) < E,
-            tmpl=jnp.zeros(N, jnp.int32),
-            tol_idx=jnp.concatenate(
-                [J + jnp.arange(E, dtype=jnp.int32), jnp.zeros(N - E, jnp.int32)]
-            ),
-            pods=jnp.zeros(N, jnp.int32),
-            allow=jnp.ones((N, V), bool).at[:E].set(exist["allow"]),
-            out=jnp.ones((N, K), bool).at[:E].set(exist["out"]),
-            defined=jnp.zeros((N, K), bool).at[:E].set(exist["defined"]),
-            tmask=jnp.zeros((N, T), bool),
-            cap=jnp.zeros((N, R), jnp.float32).at[:E].set(exist_cap),
-            nopen=jnp.int32(E),
-            remaining=remaining0,
-            tcounts=topo_counts0,
-            thost=topo_hcounts0,
-            tdoms=topo_doms0,
-        )
-        pod_arrays2 = dict(pod_arrays)
-        pod_arrays2["tol"] = pod_tol_all
-        state, assigned = pack(
-            state, pod_arrays2, f_static, openable,
-            {k: tmpl[k] for k in ("allow", "out", "defined")},
-            tmpl_daemon, tmpl_type_mask, types, type_alloc, type_capacity,
-            type_offering_ok, well_known=well_known, topo_terms=topo_terms,
-        )
-        return assigned, state
-
-    return run
 
 
 def serve(address: str = "127.0.0.1:0", max_workers: int = 4):

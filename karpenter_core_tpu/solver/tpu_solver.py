@@ -114,24 +114,30 @@ def solve_geometry(snap: EncodedSnapshot, max_nodes: int):
     return (P, J, T, E, R, K, V, N, tuple(segments), snap.zone_seg, snap.ct_seg, topo_sig)
 
 
-def build_device_solve(snap: EncodedSnapshot, max_nodes: int = 1024):
-    """Returns (geometry_key, run_fn). run_fn is a pure jittable function of
-    the device arrays produced by device_args(snap) — the whole Solve() as
-    ONE device program: feasibility + openable + packing scan."""
+def make_device_run(segments, zone_seg, ct_seg, topo_meta, n_slots):
+    """Build the jittable device program — the whole Solve() as ONE program:
+    feasibility + openable + packing scan. Pure function of the device arrays
+    produced by device_args(); all dims except n_slots derive from shapes.
+    Shared by build_device_solve (in-process) and the gRPC SolverService."""
     import jax.numpy as jnp
 
     from karpenter_core_tpu.ops.feasibility import feasibility_static, openable_mask
     from karpenter_core_tpu.ops.pack import PackState, make_pack_kernel
 
-    geom = solve_geometry(snap, max_nodes)
-    P, J, T, E, R, K, V, N, segments_t, zone_seg, ct_seg, _topo_sig = geom
-    segments = list(segments_t)
-    pack = make_pack_kernel(segments, zone_seg, ct_seg, topo_meta=snap.topo_meta)
+    segments = list(segments)
+    pack = make_pack_kernel(segments, zone_seg, ct_seg, topo_meta=topo_meta)
 
     def run(pod_arrays, tmpl, tmpl_daemon, tmpl_type_mask, types, type_alloc,
             type_capacity, type_offering_ok, pod_tol_all, exist, exist_used,
             exist_cap, well_known, remaining0, topo_counts0, topo_hcounts0,
             topo_doms0, topo_terms):
+        E = exist_used.shape[0]
+        N = n_slots
+        R = type_alloc.shape[1]
+        T = type_alloc.shape[0]
+        J = tmpl_daemon.shape[0]
+        V = pod_arrays["allow"].shape[1]
+        K = pod_arrays["out"].shape[1]
         f_static = feasibility_static(
             {k: pod_arrays[k] for k in ("allow", "out", "defined", "escape")},
             tmpl,
@@ -185,6 +191,14 @@ def build_device_solve(snap: EncodedSnapshot, max_nodes: int = 1024):
         )
         return assigned, state
 
+    return run
+
+
+def build_device_solve(snap: EncodedSnapshot, max_nodes: int = 1024):
+    """Returns (geometry_key, run_fn) for a snapshot's geometry."""
+    geom = solve_geometry(snap, max_nodes)
+    _P, _J, _T, _E, _R, _K, _V, N, segments_t, zone_seg, ct_seg, _topo_sig = geom
+    run = make_device_run(segments_t, zone_seg, ct_seg, snap.topo_meta, N)
     return geom, run
 
 

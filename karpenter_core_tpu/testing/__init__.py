@@ -171,6 +171,8 @@ def make_provisioner(
         spec.limits = Limits(resources=parse_resource_list(limits))
     if consolidation_enabled is not None:
         spec.consolidation = Consolidation(enabled=consolidation_enabled)
+    if spec.provider is None and spec.provider_ref is None:
+        spec.provider = {"fake": True}  # reference test.Provisioner defaults one
     p = Provisioner(metadata=ObjectMeta(name=name or unique_name("provisioner")), spec=spec)
     p.metadata.namespace = ""
     return p

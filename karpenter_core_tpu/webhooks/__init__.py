@@ -1,0 +1,83 @@
+"""Admission webhooks: CRD defaulting, CRD validation, settings validation.
+
+Mirrors reference pkg/webhooks/webhooks.go:17-63 (knative defaulting +
+validation admission webhooks over the karpenter API types, plus the
+`karpenter-global-settings` ConfigMap validator). In this framework admission
+runs in-process: `install(client)` wraps the in-memory kube client's
+create/update so every write is defaulted then validated — the same guarantee
+an admission webhook provides at the apiserver boundary.
+"""
+from __future__ import annotations
+
+from typing import Callable, List
+
+from karpenter_core_tpu.api.settings import Settings
+from karpenter_core_tpu.api.validation import (
+    ValidationError,
+    set_machine_defaults,
+    set_provisioner_defaults,
+    validate_machine,
+    validate_provisioner,
+)
+
+SETTINGS_CONFIG_MAP_NAME = "karpenter-global-settings"
+
+
+def validate_settings_config_map(config_map) -> List[str]:
+    """The ConfigMap validation webhook (webhooks.go:44-52): settings must
+    parse; unknown keys are tolerated like upstream."""
+    try:
+        Settings.from_config_map(getattr(config_map, "data", {}) or {})
+    except (ValueError, KeyError) as e:
+        return [f"invalid settings: {e}"]
+    return []
+
+
+class AdmissionWebhooks:
+    """Defaulting + validating admission for Provisioner/Machine/ConfigMap."""
+
+    def __init__(self):
+        self.defaulters: dict = {
+            "Provisioner": set_provisioner_defaults,
+            "Machine": set_machine_defaults,
+        }
+        self.validators: dict = {
+            "Provisioner": validate_provisioner,
+            "Machine": validate_machine,
+        }
+
+    def admit(self, obj) -> object:
+        """Default then validate; raises ValidationError on rejection."""
+        kind = type(obj).__name__
+        if kind == "ConfigMap" and obj.metadata.name == SETTINGS_CONFIG_MAP_NAME:
+            errors = validate_settings_config_map(obj)
+            if errors:
+                raise ValidationError(errors)
+            return obj
+        defaulter = self.defaulters.get(kind)
+        if defaulter is not None:
+            defaulter(obj)
+        validator = self.validators.get(kind)
+        if validator is not None:
+            errors = validator(obj)
+            if errors:
+                raise ValidationError(errors)
+        return obj
+
+
+def install(kube_client, webhooks: AdmissionWebhooks | None = None) -> AdmissionWebhooks:
+    """Wrap client.create/update with admission (the webhook registration
+    analog of operator.WithWebhooks, operator.go:149-152)."""
+    webhooks = webhooks or AdmissionWebhooks()
+    create, update = kube_client.create, kube_client.update
+
+    def admitted(write: Callable):
+        def inner(obj):
+            webhooks.admit(obj)
+            return write(obj)
+
+        return inner
+
+    kube_client.create = admitted(create)
+    kube_client.update = admitted(update)
+    return webhooks

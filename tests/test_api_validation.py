@@ -1,0 +1,321 @@
+"""API validation/defaulting + webhooks tests.
+
+Mirrors reference pkg/apis/v1alpha5/suite_test.go (validation specs for
+TTLs, consolidation exclusivity, provider-xor-providerRef, labels, taints,
+requirements, kubelet configuration) and pkg/webhooks behavior.
+"""
+import pytest
+
+from karpenter_core_tpu.api import labels as api_labels
+from karpenter_core_tpu.api.provisioner import (
+    Consolidation,
+    KubeletConfiguration,
+    ProviderRef,
+)
+from karpenter_core_tpu.api.validation import (
+    ValidationError,
+    is_qualified_name,
+    is_valid_label_value,
+    validate_or_raise,
+    validate_provisioner,
+    validate_requirement,
+)
+from karpenter_core_tpu.kube.objects import (
+    LABEL_TOPOLOGY_ZONE,
+    ConfigMap,
+    NodeSelectorRequirement,
+    ObjectMeta,
+    Taint,
+)
+from karpenter_core_tpu.testing import make_provisioner
+from karpenter_core_tpu.webhooks import AdmissionWebhooks, install
+from karpenter_core_tpu.kube.client import InMemoryKubeClient
+
+
+def valid_provisioner(**kwargs):
+    return make_provisioner(**kwargs)
+
+
+def errs(p):
+    return validate_provisioner(p)
+
+
+# -- basic shape ------------------------------------------------------------
+
+
+def test_valid_provisioner_passes():
+    assert errs(valid_provisioner()) == []
+
+
+def test_name_required_and_dns1123():
+    p = valid_provisioner()
+    p.metadata.name = ""
+    assert any("name is required" in e for e in errs(p))
+    p.metadata.name = "Not_A_DNS_Label"
+    assert any("DNS-1123" in e for e in errs(p))
+    p.metadata.name = "x" * 64
+    assert any("DNS-1123" in e for e in errs(p))
+
+
+def test_ttls_cannot_be_negative():
+    p = valid_provisioner()
+    p.spec.ttl_seconds_until_expired = -1
+    assert any("ttlSecondsUntilExpired" in e for e in errs(p))
+    p = valid_provisioner()
+    p.spec.ttl_seconds_after_empty = -1
+    assert any("ttlSecondsAfterEmpty" in e for e in errs(p))
+
+
+def test_consolidation_and_empty_ttl_mutually_exclusive():
+    p = valid_provisioner(ttl_seconds_after_empty=30)
+    p.spec.consolidation = Consolidation(enabled=True)
+    assert any("ttlSecondsAfterEmpty, consolidation.enabled" in e for e in errs(p))
+    # disabled consolidation is fine
+    p.spec.consolidation = Consolidation(enabled=False)
+    assert errs(p) == []
+
+
+def test_provider_xor_provider_ref():
+    p = valid_provisioner()
+    p.spec.provider = {"x": 1}
+    p.spec.provider_ref = ProviderRef(kind="NodeTemplate", name="t")
+    assert any("got both" in e for e in errs(p))
+    p.spec.provider = None
+    p.spec.provider_ref = None
+    assert any("got neither" in e for e in errs(p))
+    p.spec.provider_ref = ProviderRef(kind="NodeTemplate", name="t")
+    assert errs(p) == []
+
+
+# -- labels -----------------------------------------------------------------
+
+
+def test_restricted_labels_rejected():
+    p = valid_provisioner(labels={api_labels.PROVISIONER_NAME_LABEL_KEY: "x"})
+    assert any("restricted" in e for e in errs(p))
+    p = valid_provisioner(labels={"kubernetes.io/custom": "x"})
+    assert any("restricted" in e for e in errs(p))
+
+
+def test_label_domain_exceptions_allowed():
+    assert errs(valid_provisioner(labels={"kops.k8s.io/instancegroup": "x"})) == []
+    assert errs(valid_provisioner(labels={"node.kubernetes.io/custom": "x"})) == []
+    assert errs(valid_provisioner(labels={"subdomain.kops.k8s.io/instancegroup": "x"})) != []
+
+
+def test_well_known_labels_allowed():
+    assert errs(valid_provisioner(labels={LABEL_TOPOLOGY_ZONE: "zone-1"})) == []
+
+
+def test_invalid_label_syntax():
+    p = valid_provisioner(labels={"has a space": "x"})
+    assert errs(p) != []
+    p = valid_provisioner(labels={"ok": "bad value!"})
+    assert errs(p) != []
+    p = valid_provisioner(labels={"ok": "x" * 64})
+    assert errs(p) != []
+
+
+# -- taints -----------------------------------------------------------------
+
+
+def test_taint_validation():
+    p = valid_provisioner(taints=[Taint(key="", value="", effect="NoSchedule")])
+    assert any("taint key is required" in e for e in errs(p))
+    p = valid_provisioner(taints=[Taint(key="k", value="v", effect="Bogus")])
+    assert any("invalid effect" in e for e in errs(p))
+    p = valid_provisioner(taints=[Taint(key="k", value="bad value!", effect="NoSchedule")])
+    assert errs(p) != []
+
+
+def test_duplicate_taint_key_effect_rejected_across_startup():
+    t = Taint(key="dedicated", value="a", effect="NoSchedule")
+    p = valid_provisioner(taints=[t], startup_taints=[Taint(key="dedicated", value="b", effect="NoSchedule")])
+    assert any("duplicate taint" in e for e in errs(p))
+    # same key, different effect is fine
+    p = valid_provisioner(
+        taints=[t], startup_taints=[Taint(key="dedicated", value="b", effect="NoExecute")]
+    )
+    assert errs(p) == []
+
+
+# -- requirements -----------------------------------------------------------
+
+
+def test_requirement_operator_support():
+    for op in ("In", "NotIn", "Exists", "DoesNotExist"):
+        req = NodeSelectorRequirement(key="custom", operator=op, values=["a"] if op in ("In", "NotIn") else [])
+        assert validate_requirement(req) == []
+    bad = NodeSelectorRequirement(key="custom", operator="Unknown", values=[])
+    assert any("unsupported operator" in e for e in validate_requirement(bad))
+
+
+def test_requirement_in_needs_values():
+    req = NodeSelectorRequirement(key="custom", operator="In", values=[])
+    assert any("must have a value" in e for e in validate_requirement(req))
+
+
+def test_requirement_gt_lt_single_positive_integer():
+    for op in ("Gt", "Lt"):
+        assert validate_requirement(NodeSelectorRequirement(key="c", operator=op, values=["5"])) == []
+        for values in ([], ["1", "2"], ["-3"], ["x"]):
+            req = NodeSelectorRequirement(key="c", operator=op, values=values)
+            assert any("single positive integer" in e for e in validate_requirement(req))
+
+
+def test_requirement_restricted_key():
+    req = NodeSelectorRequirement(key="karpenter.sh/custom", operator="Exists", values=[])
+    assert any("restricted" in e for e in validate_requirement(req))
+    p = valid_provisioner(
+        requirements=[
+            NodeSelectorRequirement(
+                key=api_labels.PROVISIONER_NAME_LABEL_KEY, operator="In", values=["x"]
+            )
+        ]
+    )
+    assert any("restricted" in e for e in errs(p))
+
+
+def test_requirement_normalized_key_accepted():
+    # beta zone label normalizes to the stable well-known key
+    req = NodeSelectorRequirement(
+        key="failure-domain.beta.kubernetes.io/zone", operator="In", values=["z1"]
+    )
+    assert validate_requirement(req) == []
+
+
+# -- kubelet configuration --------------------------------------------------
+
+
+def test_kubelet_eviction_signal_keys():
+    kc = KubeletConfiguration(eviction_hard={"memory.available": "5%"})
+    p = valid_provisioner()
+    p.spec.kubelet_configuration = kc
+    assert errs(p) == []
+    kc.eviction_hard = {"bogus.signal": "5%"}
+    assert any("invalid key name bogus.signal" in e for e in errs(p))
+
+
+def test_kubelet_eviction_threshold_values():
+    p = valid_provisioner()
+    for bad in ("-5%", "110%", "x%"):
+        p.spec.kubelet_configuration = KubeletConfiguration(
+            eviction_hard={"memory.available": bad}
+        )
+        assert errs(p) != [], bad
+    p.spec.kubelet_configuration = KubeletConfiguration(
+        eviction_hard={"memory.available": "1Gi"}
+    )
+    assert errs(p) == []
+
+
+def test_kubelet_eviction_soft_pairs():
+    p = valid_provisioner()
+    p.spec.kubelet_configuration = KubeletConfiguration(
+        eviction_soft={"memory.available": "5%"}
+    )
+    assert any("matching evictionSoftGracePeriod" in e for e in errs(p))
+    p.spec.kubelet_configuration = KubeletConfiguration(
+        eviction_soft_grace_period={"memory.available": "1m"}
+    )
+    assert any("matching evictionSoft threshold" in e for e in errs(p))
+    p.spec.kubelet_configuration = KubeletConfiguration(
+        eviction_soft={"memory.available": "5%"},
+        eviction_soft_grace_period={"memory.available": "1m"},
+    )
+    assert errs(p) == []
+
+
+def test_kubelet_reserved_resources():
+    p = valid_provisioner()
+    p.spec.kubelet_configuration = KubeletConfiguration(kube_reserved={"cpu": "1"})
+    assert errs(p) == []
+    p.spec.kubelet_configuration = KubeletConfiguration(kube_reserved={"gpus": "1"})
+    assert any("invalid key name gpus" in e for e in errs(p))
+    p.spec.kubelet_configuration = KubeletConfiguration(system_reserved={"cpu": "-1"})
+    assert any("negative" in e for e in errs(p))
+
+
+def test_kubelet_image_gc_thresholds():
+    p = valid_provisioner()
+    p.spec.kubelet_configuration = KubeletConfiguration(
+        image_gc_high_threshold_percent=50, image_gc_low_threshold_percent=60
+    )
+    assert any("imageGCHighThresholdPercent" in e for e in errs(p))
+    p.spec.kubelet_configuration = KubeletConfiguration(
+        image_gc_high_threshold_percent=60, image_gc_low_threshold_percent=50
+    )
+    assert errs(p) == []
+
+
+def test_kubelet_negative_counts():
+    p = valid_provisioner()
+    p.spec.kubelet_configuration = KubeletConfiguration(max_pods=-1)
+    assert any("maxPods" in e for e in errs(p))
+    p.spec.kubelet_configuration = KubeletConfiguration(pods_per_core=-1)
+    assert any("podsPerCore" in e for e in errs(p))
+
+
+# -- name syntax helpers ----------------------------------------------------
+
+
+def test_qualified_name_rules():
+    assert is_qualified_name("simple") == []
+    assert is_qualified_name("domain.io/name") == []
+    assert is_qualified_name("") != []
+    assert is_qualified_name("a/b/c") != []
+    assert is_qualified_name("UPPER.domain/x") != []
+    assert is_qualified_name("domain.io/" + "x" * 64) != []
+
+
+def test_label_value_rules():
+    assert is_valid_label_value("") == []
+    assert is_valid_label_value("ok-value_1.x") == []
+    assert is_valid_label_value("-leading") != []
+    assert is_valid_label_value("x" * 64) != []
+
+
+# -- webhooks ---------------------------------------------------------------
+
+
+def test_webhook_install_rejects_invalid_writes():
+    client = InMemoryKubeClient()
+    install(client)
+    good = valid_provisioner()
+    client.create(good)
+    bad = valid_provisioner()
+    bad.spec.ttl_seconds_after_empty = -5
+    with pytest.raises(ValidationError):
+        client.create(bad)
+    # updates are validated too
+    good.spec.ttl_seconds_until_expired = -1
+    with pytest.raises(ValidationError):
+        client.update(good)
+
+
+def test_webhook_validates_settings_config_map():
+    client = InMemoryKubeClient()
+    install(client)
+    cm = ConfigMap(
+        metadata=ObjectMeta(name="karpenter-global-settings", namespace="karpenter"),
+        data={"batchMaxDuration": "10s"},
+    )
+    client.create(cm)
+    bad = ConfigMap(
+        metadata=ObjectMeta(name="karpenter-global-settings", namespace="karpenter"),
+        data={"batchMaxDuration": "not-a-duration"},
+    )
+    bad.metadata.name = "karpenter-global-settings"
+    with pytest.raises(ValidationError):
+        client.update(bad)
+    # other config maps are not validated
+    other = ConfigMap(metadata=ObjectMeta(name="other", namespace="karpenter"), data={"x": "y"})
+    client.create(other)
+
+
+def test_validate_or_raise_dispatch():
+    validate_or_raise(valid_provisioner())
+    bad = valid_provisioner()
+    bad.spec.provider = None
+    with pytest.raises(ValidationError):
+        validate_or_raise(bad)

@@ -65,6 +65,8 @@ def test_native_pack_throughput():
     f_static = np.ones((P, T), dtype=np.uint8)
     type_alloc = np.linspace(4, 64, T)[:, None].repeat(R, 1).astype(np.float32)
     daemon = np.zeros(R, dtype=np.float32)
+    # warm: the first call may compile libfastpack.so; keep it out of the timing
+    fast_pack(pod_requests[:1], f_static[:1], type_alloc, daemon, 4)
     t0 = time.perf_counter()
     assigned, *_ = fast_pack(pod_requests, f_static, type_alloc, daemon, 1024)
     dt = time.perf_counter() - t0
