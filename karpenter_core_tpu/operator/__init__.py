@@ -74,15 +74,39 @@ class Operator:
     # -- synchronous harness (envtest analog) ------------------------------
 
     def sync_state(self) -> None:
-        """Pump current store contents through the informers."""
+        """Pump current store contents through the informers, including
+        deletions (a level-triggered relist: objects the cluster tracks that
+        are gone from the store get a synthetic DELETED event — the sync
+        analog of the watch pumps)."""
+        from karpenter_core_tpu.kube.objects import object_key
+
         node_inf = NodeInformer(self.cluster)
         pod_inf = PodInformer(self.cluster)
         machine_inf = MachineInformer(self.cluster)
-        for node in self.kube_client.list("Node"):
+        nodes = self.kube_client.list("Node")
+        machines = self.kube_client.list("Machine")
+        pods = self.kube_client.list("Pod")
+        live_nodes = {n.metadata.name for n in nodes}
+        live_machines = {m.metadata.name for m in machines}
+        for state_node in self.cluster.nodes():
+            # node and machine records expire independently: a Machine can be
+            # deleted while its Node lives on (and vice versa)
+            if (
+                state_node.machine is not None
+                and state_node.machine.metadata.name not in live_machines
+            ):
+                self.cluster.delete_machine(state_node.machine.metadata.name)
+            if state_node.node is not None and state_node.node.metadata.name not in live_nodes:
+                self.cluster.delete_node(state_node.node.metadata.name)
+        live_pods = {object_key(p) for p in pods}
+        for key in list(self.cluster.bindings):
+            if key not in live_pods:
+                self.cluster.delete_pod(key)
+        for node in nodes:
             node_inf.handle("MODIFIED", node)
-        for machine in self.kube_client.list("Machine"):
+        for machine in machines:
             machine_inf.handle("MODIFIED", machine)
-        for pod in self.kube_client.list("Pod"):
+        for pod in pods:
             pod_inf.handle("MODIFIED", pod)
 
     def step(self, provision: bool = True, deprovision: bool = False) -> dict:

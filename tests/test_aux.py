@@ -1,0 +1,267 @@
+"""Auxiliary subsystem tests: events recorder, batcher, inflight checks,
+settings, cluster-state bookkeeping.
+
+Mirrors reference pkg/events (dedupe + rate limit), provisioning/batcher.go
+windows, pkg/controllers/inflightchecks specs, pkg/apis/settings parsing, and
+pkg/controllers/state cluster invariants.
+"""
+import pytest
+
+from karpenter_core_tpu.api import labels as api_labels
+from karpenter_core_tpu.api.labels import (
+    LABEL_NODE_INITIALIZED,
+    PROVISIONER_NAME_LABEL_KEY,
+)
+from karpenter_core_tpu.api.settings import Settings, _parse_duration
+from karpenter_core_tpu.cloudprovider import fake
+from karpenter_core_tpu.controllers.provisioning.batcher import Batcher
+from karpenter_core_tpu.events import Event, Recorder
+from karpenter_core_tpu.kube.objects import Condition
+from karpenter_core_tpu.operator import new_operator
+from karpenter_core_tpu.testing import FakeClock, make_node, make_pod, make_provisioner
+
+
+# -- events recorder --------------------------------------------------------
+
+
+def ev(name="n1", reason="Tested", message="hello", values=()):
+    return Event("Node", name, "Normal", reason, message, dedupe_values=values)
+
+
+def test_recorder_dedupes_within_ttl():
+    clock = FakeClock()
+    r = Recorder(clock=clock)
+    assert r.publish(ev())
+    assert not r.publish(ev())  # identical within TTL -> suppressed
+    clock.advance(Recorder.DEDUPE_TTL + 1)
+    assert r.publish(ev())  # TTL expired -> allowed again
+
+
+def test_recorder_dedupe_uses_values_over_message():
+    clock = FakeClock()
+    r = Recorder(clock=clock)
+    assert r.publish(ev(message="a", values=("k",)))
+    # different message, same dedupe values -> still deduped
+    assert not r.publish(ev(message="b", values=("k",)))
+    # different values -> published
+    assert r.publish(ev(message="a", values=("other",)))
+
+
+def test_recorder_rate_limits_per_event_type():
+    clock = FakeClock()
+    r = Recorder(clock=clock)
+    sent = sum(
+        1 for i in range(50) if r.publish(ev(name=f"node-{i}", reason="Flood"))
+    )
+    assert sent == Recorder.RATE_LIMIT_BURST
+    # tokens refill over time
+    clock.advance(5)
+    assert r.publish(ev(name="late", reason="Flood"))
+
+
+def test_recorder_for_object_filters():
+    r = Recorder(clock=FakeClock())
+    r.publish(ev(name="a"))
+    r.publish(ev(name="b"))
+    assert [e.involved_name for e in r.for_object("Node", "a")] == ["a"]
+
+
+# -- batcher ----------------------------------------------------------------
+
+
+def test_batcher_returns_false_without_trigger():
+    b = Batcher(settings=Settings(batch_idle_duration=0.01, batch_max_duration=0.05))
+    assert not b.wait(timeout=0.05)
+
+
+def test_batcher_closes_after_idle_window():
+    import time
+
+    b = Batcher(settings=Settings(batch_idle_duration=0.02, batch_max_duration=5.0))
+    b.trigger()
+    t0 = time.monotonic()
+    assert b.wait(timeout=0.1)
+    assert time.monotonic() - t0 < 1.0
+
+
+def test_batcher_caps_at_max_window():
+    import threading
+    import time
+
+    b = Batcher(settings=Settings(batch_idle_duration=10.0, batch_max_duration=0.05))
+    b.trigger()
+    stop = threading.Event()
+
+    def keep_triggering():
+        while not stop.is_set():
+            b.trigger()
+            time.sleep(0.005)
+
+    t = threading.Thread(target=keep_triggering, daemon=True)
+    t.start()
+    t0 = time.monotonic()
+    assert b.wait(timeout=0.1)
+    elapsed = time.monotonic() - t0
+    stop.set()
+    t.join()
+    assert elapsed < 2.0  # max window closed the batch despite constant triggers
+
+
+# -- inflight checks --------------------------------------------------------
+
+
+@pytest.fixture
+def env():
+    clock = FakeClock()
+    cp = fake.FakeCloudProvider(fake.instance_types(10))
+    op = new_operator(cp, settings=Settings(), clock=clock)
+    return op, cp, clock
+
+
+def test_inflight_failed_init_after_one_hour(env):
+    op, cp, clock = env
+    node = make_node(
+        name="stuck",
+        labels={PROVISIONER_NAME_LABEL_KEY: "default"},
+        capacity={"cpu": "4"},
+        ready=False,
+    )
+    node.metadata.creation_timestamp = clock() - 2 * 3600
+    op.kube_client.create(node)
+    op.sync_state()
+    op.inflight_checks.reconcile(node)
+    events = op.recorder.for_object("Node", "stuck")
+    assert any("not initialized in over 1 hour" in e.message for e in events)
+
+
+def test_inflight_no_report_before_timeout(env):
+    op, cp, clock = env
+    node = make_node(name="young", labels={PROVISIONER_NAME_LABEL_KEY: "default"},
+                     capacity={"cpu": "4"}, ready=False)
+    node.metadata.creation_timestamp = clock() - 60
+    op.kube_client.create(node)
+    op.sync_state()
+    op.inflight_checks.reconcile(node)
+    assert not op.recorder.for_object("Node", "young")
+
+
+def test_inflight_node_shape_undersized(env):
+    op, cp, clock = env
+    op.kube_client.create(make_provisioner(name="default"))
+    op.kube_client.create(make_pod(requests={"cpu": "1"}))
+    op.step()
+    node = op.kube_client.list("Node")[0]
+    machine = op.kube_client.get("Machine", "", node.metadata.name)
+    # kubelet registers with far less capacity than the machine promised
+    node.status.capacity = {k: v * 0.5 for k, v in machine.status.capacity.items()}
+    node.status.allocatable = dict(node.status.capacity)
+    node.status.conditions.append(Condition(type="Ready", status="True"))
+    op.kube_client.apply(node)
+    op.sync_state()
+    op.inflight_checks.reconcile(op.kube_client.get("Node", "", node.metadata.name))
+    events = op.recorder.for_object("Node", node.metadata.name)
+    assert any("of expected" in e.message for e in events)
+
+
+def test_inflight_stuck_termination_reports_blockers(env):
+    op, cp, clock = env
+    node = make_node(name="blocked", labels={PROVISIONER_NAME_LABEL_KEY: "default"},
+                     capacity={"cpu": "4"})
+    node.metadata.finalizers.append(api_labels.TERMINATION_FINALIZER)
+    op.kube_client.create(node)
+    pod = make_pod(node_name="blocked", unschedulable=False,
+                   annotations={api_labels.DO_NOT_EVICT_POD_ANNOTATION_KEY: "true"})
+    pod.status.phase = "Running"
+    op.kube_client.create(pod)
+    op.kube_client.delete("Node", "", "blocked")  # finalizer holds it
+    node = op.kube_client.get("Node", "", "blocked")
+    op.sync_state()
+    op.inflight_checks.reconcile(node)
+    events = op.recorder.for_object("Node", "blocked")
+    assert any("do-not-evict" in e.message for e in events)
+
+
+# -- settings ---------------------------------------------------------------
+
+
+def test_settings_parse_durations():
+    s = Settings.from_config_map({
+        "batchMaxDuration": "30s",
+        "batchIdleDuration": "500ms",
+        "ttlAfterNotRegistered": "1m30s",
+        "featureGates.driftEnabled": "true",
+    })
+    assert s.batch_max_duration == 30.0
+    assert s.batch_idle_duration == 0.5
+    assert s.ttl_after_not_registered == 90.0
+    assert s.drift_enabled
+
+
+def test_settings_rejects_bad_duration():
+    with pytest.raises(ValueError):
+        _parse_duration("10 parsecs")
+    with pytest.raises(ValueError):
+        _parse_duration("")
+
+
+# -- cluster state ----------------------------------------------------------
+
+
+def test_cluster_tracks_pod_bindings(env):
+    op, cp, clock = env
+    node = make_node(name="host", labels={PROVISIONER_NAME_LABEL_KEY: "default",
+                                          LABEL_NODE_INITIALIZED: "true"},
+                     capacity={"cpu": "8", "pods": "10"})
+    op.kube_client.create(node)
+    pod = make_pod(requests={"cpu": "2"}, node_name="host", unschedulable=False)
+    pod.status.phase = "Running"
+    op.kube_client.create(pod)
+    op.sync_state()
+    state_node = op.cluster.node_for("host")
+    assert state_node.total_pod_requests().get("cpu") == 2.0
+    assert state_node.available().get("cpu") == 6.0
+    # pod deletion releases the resources
+    op.kube_client.delete("Pod", pod.metadata.namespace, pod.metadata.name)
+    op.sync_state()
+    assert op.cluster.node_for("host").total_pod_requests().get("cpu", 0.0) == 0.0
+
+
+def test_cluster_consolidated_dirty_bit(env):
+    op, cp, clock = env
+    op.cluster.set_consolidated(True)
+    assert op.cluster.consolidated()
+    # any node change invalidates the bit
+    node = make_node(name="new", labels={PROVISIONER_NAME_LABEL_KEY: "default"},
+                     capacity={"cpu": "4"})
+    op.kube_client.create(node)
+    op.sync_state()
+    assert not op.cluster.consolidated()
+    # the bit force-expires after 5 minutes regardless
+    op.cluster.set_consolidated(True)
+    clock.advance(5 * 60 + 1)
+    assert not op.cluster.consolidated()
+
+
+def test_cluster_mark_for_deletion(env):
+    op, cp, clock = env
+    node = make_node(name="doomed", labels={PROVISIONER_NAME_LABEL_KEY: "default"},
+                     capacity={"cpu": "4"})
+    op.kube_client.create(node)
+    op.sync_state()
+    op.cluster.mark_for_deletion("doomed")
+    assert op.cluster.node_for("doomed").is_marked_for_deletion()
+    op.cluster.unmark_for_deletion("doomed")
+    assert not op.cluster.node_for("doomed").is_marked_for_deletion()
+
+
+def test_cluster_nomination_window(env):
+    op, cp, clock = env
+    node = make_node(name="nominee", labels={PROVISIONER_NAME_LABEL_KEY: "default"},
+                     capacity={"cpu": "4"})
+    op.kube_client.create(node)
+    op.sync_state()
+    op.cluster.nominate_node_for_pod("nominee")
+    assert op.cluster.node_for("nominee").nominated()
+    # window is 2x batch max duration, >= 10s (node.go:328-334)
+    clock.advance(21)
+    assert not op.cluster.node_for("nominee").nominated()

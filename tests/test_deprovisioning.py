@@ -1,0 +1,307 @@
+"""Deprovisioning suite — expiration, drift, emptiness, consolidation rules.
+
+Mirrors reference pkg/controllers/deprovisioning/suite_test.go (32 specs
+condensed): candidate gating (initialized/nominated/labels), expiration
+ordering, the drift feature gate, empty-node consolidation, consolidation
+disable switches, PDB and do-not-evict blocks, spot-to-spot replacement
+prohibition, and launch-failure cordon rollback.
+"""
+import pytest
+
+from karpenter_core_tpu.api import labels as api_labels
+from karpenter_core_tpu.api.labels import (
+    LABEL_CAPACITY_TYPE,
+    LABEL_NODE_INITIALIZED,
+    PROVISIONER_NAME_LABEL_KEY,
+)
+from karpenter_core_tpu.api.settings import Settings, set_current
+from karpenter_core_tpu.cloudprovider import fake
+from karpenter_core_tpu.kube.objects import (
+    LABEL_INSTANCE_TYPE_STABLE,
+    LABEL_TOPOLOGY_ZONE,
+    LabelSelector,
+    PodDisruptionBudget,
+    PodDisruptionBudgetSpec,
+    PodDisruptionBudgetStatus,
+)
+from karpenter_core_tpu.operator import new_operator
+from karpenter_core_tpu.testing import FakeClock, make_node, make_pod, make_provisioner
+
+
+@pytest.fixture
+def env():
+    clock = FakeClock()
+    cp = fake.FakeCloudProvider(fake.instance_types(10))
+    op = new_operator(cp, settings=Settings(), clock=clock)
+    for d in op.deprovisioning.deprovisioners:
+        d.validation_ttl = 0.0
+    return op, cp, clock
+
+
+def add_node(op, clock, name, it_name="fake-it-9", cpu="10", ct="on-demand",
+             pods=1, pod_labels=None, pod_annotations=None, initialized=True,
+             annotations=None, created_at=None):
+    """An initialized karpenter node with `pods` bound running pods."""
+    node = make_node(
+        name=name,
+        labels={
+            PROVISIONER_NAME_LABEL_KEY: "default",
+            LABEL_NODE_INITIALIZED: "true" if initialized else "false",
+            LABEL_INSTANCE_TYPE_STABLE: it_name,
+            LABEL_CAPACITY_TYPE: ct,
+            LABEL_TOPOLOGY_ZONE: "test-zone-1",
+        },
+        capacity={"cpu": cpu, "memory": "20Gi", "pods": "100"},
+    )
+    if not initialized:
+        del node.metadata.labels[LABEL_NODE_INITIALIZED]
+    node.metadata.annotations.update(annotations or {})
+    node.metadata.creation_timestamp = created_at if created_at is not None else clock()
+    op.kube_client.create(node)
+    for i in range(pods):
+        pod = make_pod(
+            requests={"cpu": "1"},
+            node_name=name,
+            unschedulable=False,
+            labels=pod_labels,
+            annotations=pod_annotations,
+        )
+        pod.status.phase = "Running"
+        op.kube_client.create(pod)
+    return node
+
+
+def provisioner(op, **kwargs):
+    p = make_provisioner(name="default", **kwargs)
+    op.kube_client.create(p)
+    return p
+
+
+# -- candidate gating -------------------------------------------------------
+
+
+def test_uninitialized_nodes_are_not_candidates(env):
+    op, cp, clock = env
+    provisioner(op, consolidation_enabled=True)
+    add_node(op, clock, "raw", initialized=False, pods=0)
+    op.sync_state()
+    assert not op.deprovisioning.reconcile()
+    assert op.kube_client.get("Node", "", "raw") is not None
+
+
+def test_nodes_without_provisioner_label_are_not_candidates(env):
+    op, cp, clock = env
+    provisioner(op, consolidation_enabled=True)
+    node = make_node(name="foreign", capacity={"cpu": "4", "pods": "10"})
+    op.kube_client.create(node)
+    op.sync_state()
+    assert not op.deprovisioning.reconcile()
+    assert op.kube_client.get("Node", "", "foreign") is not None
+
+
+def test_do_not_consolidate_annotation_blocks(env):
+    op, cp, clock = env
+    provisioner(op, consolidation_enabled=True)
+    add_node(op, clock, "anno", pods=0,
+             annotations={api_labels.DO_NOT_CONSOLIDATE_NODE_ANNOTATION_KEY: "true"})
+    op.sync_state()
+    assert not op.deprovisioning.reconcile()
+    assert op.kube_client.get("Node", "", "anno") is not None
+
+
+def test_consolidation_disabled_no_action(env):
+    op, cp, clock = env
+    provisioner(op)  # consolidation not enabled, no TTLs
+    add_node(op, clock, "idle", pods=0)
+    op.sync_state()
+    assert not op.deprovisioning.reconcile()
+    assert op.kube_client.get("Node", "", "idle") is not None
+
+
+# -- emptiness / empty-node consolidation -----------------------------------
+
+
+def test_empty_node_consolidation_deletes_empty(env):
+    op, cp, clock = env
+    provisioner(op, consolidation_enabled=True)
+    add_node(op, clock, "empty-1", pods=0)
+    add_node(op, clock, "empty-2", pods=0)
+    op.sync_state()
+    assert op.deprovisioning.reconcile()
+    op.step()  # finalizer pass
+    assert op.kube_client.get("Node", "", "empty-1") is None
+    assert op.kube_client.get("Node", "", "empty-2") is None
+
+
+def test_daemonset_pods_do_not_prevent_emptiness(env):
+    op, cp, clock = env
+    provisioner(op, consolidation_enabled=True)
+    node = add_node(op, clock, "daemons-only", pods=0)
+    daemon = make_pod(requests={"cpu": "0.1"}, node_name=node.metadata.name,
+                      unschedulable=False, owner_kind="DaemonSet")
+    daemon.status.phase = "Running"
+    op.kube_client.create(daemon)
+    op.sync_state()
+    assert op.deprovisioning.reconcile()
+
+
+# -- expiration -------------------------------------------------------------
+
+
+def test_expiration_ignores_unexpired(env):
+    op, cp, clock = env
+    provisioner(op, ttl_seconds_until_expired=3600)
+    add_node(op, clock, "young", pods=1, pod_labels={"app": "x"})
+    op.sync_state()
+    assert not op.deprovisioning.reconcile()
+
+
+def test_expiration_replaces_oldest_first(env):
+    op, cp, clock = env
+    provisioner(op, ttl_seconds_until_expired=3600)
+    add_node(op, clock, "older", pods=1, created_at=clock() - 8000)
+    add_node(op, clock, "newer", pods=1, created_at=clock() - 7000)
+    op.sync_state()
+    assert op.deprovisioning.reconcile()
+    op.step()
+    # oldest node goes first; the newer one still exists (its capacity absorbs
+    # the evicted pod, so expiration deletes without a replacement launch)
+    assert op.kube_client.get("Node", "", "older") is None
+    assert op.kube_client.get("Node", "", "newer") is not None
+
+
+# -- drift ------------------------------------------------------------------
+
+
+def test_drift_requires_feature_gate(env):
+    op, cp, clock = env
+    set_current(Settings(drift_enabled=False))
+    provisioner(op)
+    add_node(op, clock, "drifted", pods=0,
+             annotations={api_labels.VOLUNTARY_DISRUPTION_ANNOTATION_KEY: "drifted"})
+    op.sync_state()
+    assert not op.deprovisioning.reconcile()
+    assert op.kube_client.get("Node", "", "drifted") is not None
+
+
+def test_drift_deletes_annotated_node_when_enabled(env):
+    op, cp, clock = env
+    set_current(Settings(drift_enabled=True))
+    try:
+        provisioner(op)
+        add_node(op, clock, "drifted", pods=0,
+                 annotations={api_labels.VOLUNTARY_DISRUPTION_ANNOTATION_KEY: "drifted"})
+        op.sync_state()
+        assert op.deprovisioning.reconcile()
+        op.step()
+        assert op.kube_client.get("Node", "", "drifted") is None
+    finally:
+        set_current(Settings())
+
+
+def test_node_controller_annotates_drifted(env):
+    op, cp, clock = env
+    set_current(Settings(drift_enabled=True))
+    try:
+        provisioner(op)
+        op.kube_client.create(make_pod(requests={"cpu": "1"}))
+        op.step()
+        cp.drifted = True
+        op.step()
+        node = op.kube_client.list("Node")[0]
+        assert node.metadata.annotations.get(
+            api_labels.VOLUNTARY_DISRUPTION_ANNOTATION_KEY
+        ) == "drifted"
+    finally:
+        set_current(Settings())
+
+
+# -- consolidation blocks ---------------------------------------------------
+
+
+def test_pdb_blocks_consolidation(env):
+    op, cp, clock = env
+    provisioner(op, consolidation_enabled=True)
+    add_node(op, clock, "guarded", pods=1, pod_labels={"app": "guarded"})
+    pdb = PodDisruptionBudget(
+        spec=PodDisruptionBudgetSpec(selector=LabelSelector(match_labels={"app": "guarded"})),
+        status=PodDisruptionBudgetStatus(disruptions_allowed=0),
+    )
+    pdb.metadata.name = "guard"
+    pdb.metadata.namespace = "default"
+    op.kube_client.create(pdb)
+    op.sync_state()
+    assert not op.deprovisioning.reconcile()
+    assert op.kube_client.get("Node", "", "guarded") is not None
+
+
+def test_do_not_evict_pod_blocks_consolidation(env):
+    op, cp, clock = env
+    provisioner(op, consolidation_enabled=True)
+    add_node(op, clock, "pinned", pods=1,
+             pod_annotations={api_labels.DO_NOT_EVICT_POD_ANNOTATION_KEY: "true"})
+    op.sync_state()
+    assert not op.deprovisioning.reconcile()
+    assert op.kube_client.get("Node", "", "pinned") is not None
+
+
+def test_spot_to_spot_replacement_forbidden(env):
+    op, cp, clock = env
+    provisioner(op, consolidation_enabled=True)
+    # one spot node with a single small pod: replacing with a cheaper SPOT
+    # node is forbidden (consolidation.go:237-244); deletion is impossible
+    # because the pod needs somewhere to go -> no action
+    add_node(op, clock, "spot-big", it_name="fake-it-9", cpu="10", ct="spot", pods=1)
+    op.sync_state()
+    assert not op.deprovisioning.reconcile()
+    assert op.kube_client.get("Node", "", "spot-big") is not None
+
+
+def test_multi_node_consolidation_merges_into_one(env):
+    op, cp, clock = env
+    provisioner(op, consolidation_enabled=True)
+    # two lightly-used nodes collapse into ONE cheaper replacement
+    add_node(op, clock, "big-1", it_name="fake-it-9", cpu="10", pods=1)
+    add_node(op, clock, "big-2", it_name="fake-it-4", cpu="5", pods=1)
+    op.sync_state()
+    assert op.deprovisioning.reconcile()
+    op.step()
+    remaining = op.kube_client.list("Node")
+    assert {n.metadata.name for n in remaining}.isdisjoint({"big-1", "big-2"})
+    assert len(remaining) == 1
+    # the merged machine is strictly cheaper than either original
+    it_name = remaining[0].metadata.labels[LABEL_INSTANCE_TYPE_STABLE]
+    assert it_name not in ("fake-it-9", "fake-it-4")
+
+
+def test_single_node_consolidation_deletes_when_pods_fit_elsewhere(env):
+    op, cp, clock = env
+    provisioner(op, consolidation_enabled=True)
+    # the only CANDIDATE is "redundant"; the keeper belongs to a second,
+    # non-consolidating provisioner, so it is schedulable capacity but never
+    # a candidate — its headroom absorbs the pod and "redundant" is deleted
+    op.kube_client.create(make_provisioner(name="static"))
+    keeper = make_node(
+        name="keeper",
+        labels={PROVISIONER_NAME_LABEL_KEY: "static", LABEL_NODE_INITIALIZED: "true"},
+        capacity={"cpu": "10", "memory": "20Gi", "pods": "100"},
+    )
+    op.kube_client.create(keeper)
+    add_node(op, clock, "redundant", it_name="fake-it-4", cpu="5", pods=1)
+    op.sync_state()
+    assert op.deprovisioning.reconcile()
+    op.step()
+    remaining = {n.metadata.name for n in op.kube_client.list("Node")}
+    assert remaining == {"keeper"}
+
+
+def test_replacement_launch_failure_rolls_back_cordon(env):
+    op, cp, clock = env
+    provisioner(op, ttl_seconds_until_expired=3600)
+    add_node(op, clock, "expired", pods=1, created_at=clock() - 8000)
+    op.sync_state()
+    cp.next_create_err = RuntimeError("no capacity")
+    changed = op.deprovisioning.reconcile()
+    node = op.kube_client.get("Node", "", "expired")
+    assert node is not None
+    assert not node.spec.unschedulable, "cordon must be rolled back on launch failure"

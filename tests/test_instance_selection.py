@@ -1,0 +1,272 @@
+"""Instance-selection suite — cheapest-compatible launch decisions.
+
+Mirrors reference pkg/controllers/provisioning/scheduling/
+instance_selection_test.go (25 specs): for every constraint combination the
+launched node must be one of the cheapest instance types compatible with the
+merged pod + provisioner constraints. Runs the full provision->launch path
+against the fake cloud provider (which, like the reference fake, synthesizes
+the cheapest offering).
+"""
+import math
+
+import pytest
+
+from karpenter_core_tpu.api.labels import (
+    CAPACITY_TYPE_ON_DEMAND,
+    CAPACITY_TYPE_SPOT,
+    LABEL_CAPACITY_TYPE,
+)
+from karpenter_core_tpu.cloudprovider import fake
+from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider
+from karpenter_core_tpu.cloudprovider.types import Offering
+from karpenter_core_tpu.kube.objects import (
+    LABEL_ARCH_STABLE,
+    LABEL_INSTANCE_TYPE_STABLE,
+    LABEL_OS_STABLE,
+    LABEL_TOPOLOGY_ZONE,
+    NodeSelectorRequirement,
+)
+from karpenter_core_tpu.operator import new_operator
+from karpenter_core_tpu.scheduling.requirements import Requirements
+from karpenter_core_tpu.testing import make_pod, make_provisioner
+
+
+@pytest.fixture(scope="module")
+def assorted():
+    return fake.instance_types_assorted()
+
+
+def launch(pod, provisioner=None, universe=None):
+    """Provision + launch one pod; returns (instance_type, zone, ct, price)."""
+    cp = FakeCloudProvider(universe)
+    op = new_operator(cp)
+    op.kube_client.create(provisioner or make_provisioner(name="default"))
+    op.kube_client.create(pod)
+    op.step()
+    if not cp.created_machines:
+        return None
+    created = next(iter(cp.created_machines.values()))
+    labels = created.metadata.labels
+    it = {t.name: t for t in (universe or fake.instance_types(5))}[
+        labels[LABEL_INSTANCE_TYPE_STABLE]
+    ]
+    zone = labels[LABEL_TOPOLOGY_ZONE]
+    ct = labels[LABEL_CAPACITY_TYPE]
+    offering = it.offerings.get(ct, zone)
+    return it, zone, ct, offering.price
+
+
+def min_price(universe, reqs=None, min_resources=None):
+    """Cheapest offering over types compatible with reqs that fit
+    min_resources."""
+    reqs = reqs or Requirements()
+    best = math.inf
+    for it in universe:
+        if reqs.compatible(it.requirements) is not None:
+            continue
+        if min_resources and not all(
+            it.allocatable().get(k, 0.0) >= v for k, v in min_resources.items()
+        ):
+            continue
+        for o in it.offerings.requirements(reqs).available():
+            best = min(best, o.price)
+    return best
+
+
+def reqs_of(**selectors):
+    return Requirements.from_labels(selectors)
+
+
+def check_cheapest(assorted, pod=None, provisioner=None, expect_reqs=None,
+                   min_resources=None):
+    out = launch(pod or make_pod(), provisioner, assorted)
+    assert out is not None, "pod failed to schedule"
+    it, zone, ct, price = out
+    expected = min_price(assorted, expect_reqs, min_resources)
+    assert price == pytest.approx(expected), (it.name, zone, ct, price, expected)
+    return it, zone, ct
+
+
+def test_cheapest_unconstrained(assorted):
+    check_cheapest(assorted)
+
+
+def test_cheapest_pod_arch(assorted):
+    for arch in ("amd64", "arm64"):
+        it, _, _ = check_cheapest(
+            assorted,
+            pod=make_pod(node_selector={LABEL_ARCH_STABLE: arch}),
+            expect_reqs=reqs_of(**{LABEL_ARCH_STABLE: arch}),
+        )
+        assert it.requirements.get_requirement(LABEL_ARCH_STABLE).has(arch)
+
+
+def test_cheapest_prov_arch(assorted):
+    prov = make_provisioner(
+        name="default",
+        requirements=[NodeSelectorRequirement(LABEL_ARCH_STABLE, "In", ["arm64"])],
+    )
+    it, _, _ = check_cheapest(
+        assorted, provisioner=prov, expect_reqs=reqs_of(**{LABEL_ARCH_STABLE: "arm64"})
+    )
+    assert it.requirements.get_requirement(LABEL_ARCH_STABLE).has("arm64")
+
+
+def test_cheapest_pod_os(assorted):
+    it, _, _ = check_cheapest(
+        assorted,
+        pod=make_pod(node_selector={LABEL_OS_STABLE: "windows"}),
+        expect_reqs=reqs_of(**{LABEL_OS_STABLE: "windows"}),
+    )
+    assert it.requirements.get_requirement(LABEL_OS_STABLE).has("windows")
+
+
+def test_cheapest_prov_os(assorted):
+    prov = make_provisioner(
+        name="default",
+        requirements=[NodeSelectorRequirement(LABEL_OS_STABLE, "In", ["windows"])],
+    )
+    it, _, _ = check_cheapest(
+        assorted, provisioner=prov, expect_reqs=reqs_of(**{LABEL_OS_STABLE: "windows"})
+    )
+    assert it.requirements.get_requirement(LABEL_OS_STABLE).has("windows")
+
+
+def test_cheapest_pod_zone(assorted):
+    _, zone, _ = check_cheapest(
+        assorted,
+        pod=make_pod(node_selector={LABEL_TOPOLOGY_ZONE: "test-zone-2"}),
+        expect_reqs=reqs_of(**{LABEL_TOPOLOGY_ZONE: "test-zone-2"}),
+    )
+    assert zone == "test-zone-2"
+
+
+def test_cheapest_prov_zone(assorted):
+    prov = make_provisioner(
+        name="default",
+        requirements=[NodeSelectorRequirement(LABEL_TOPOLOGY_ZONE, "In", ["test-zone-2"])],
+    )
+    _, zone, _ = check_cheapest(
+        assorted, provisioner=prov, expect_reqs=reqs_of(**{LABEL_TOPOLOGY_ZONE: "test-zone-2"})
+    )
+    assert zone == "test-zone-2"
+
+
+def test_cheapest_pod_capacity_type(assorted):
+    _, _, ct = check_cheapest(
+        assorted,
+        pod=make_pod(node_selector={LABEL_CAPACITY_TYPE: CAPACITY_TYPE_SPOT}),
+        expect_reqs=reqs_of(**{LABEL_CAPACITY_TYPE: CAPACITY_TYPE_SPOT}),
+    )
+    assert ct == CAPACITY_TYPE_SPOT
+
+
+def test_cheapest_prov_capacity_type(assorted):
+    prov = make_provisioner(
+        name="default",
+        requirements=[NodeSelectorRequirement(LABEL_CAPACITY_TYPE, "In", [CAPACITY_TYPE_SPOT])],
+    )
+    _, _, ct = check_cheapest(
+        assorted, provisioner=prov,
+        expect_reqs=reqs_of(**{LABEL_CAPACITY_TYPE: CAPACITY_TYPE_SPOT}),
+    )
+    assert ct == CAPACITY_TYPE_SPOT
+
+
+def test_cheapest_combined_prov_ct_pod_zone(assorted):
+    prov = make_provisioner(
+        name="default",
+        requirements=[NodeSelectorRequirement(LABEL_CAPACITY_TYPE, "In", [CAPACITY_TYPE_SPOT])],
+    )
+    _, zone, ct = check_cheapest(
+        assorted,
+        pod=make_pod(node_selector={LABEL_TOPOLOGY_ZONE: "test-zone-2"}),
+        provisioner=prov,
+        expect_reqs=reqs_of(**{
+            LABEL_CAPACITY_TYPE: CAPACITY_TYPE_SPOT,
+            LABEL_TOPOLOGY_ZONE: "test-zone-2",
+        }),
+    )
+    assert (zone, ct) == ("test-zone-2", CAPACITY_TYPE_SPOT)
+
+
+def test_cheapest_full_combo(assorted):
+    prov = make_provisioner(
+        name="default",
+        requirements=[
+            NodeSelectorRequirement(LABEL_CAPACITY_TYPE, "In", [CAPACITY_TYPE_ON_DEMAND]),
+            NodeSelectorRequirement(LABEL_TOPOLOGY_ZONE, "In", ["test-zone-1"]),
+            NodeSelectorRequirement(LABEL_ARCH_STABLE, "In", ["arm64"]),
+            NodeSelectorRequirement(LABEL_OS_STABLE, "In", ["windows"]),
+        ],
+    )
+    it, zone, ct = check_cheapest(
+        assorted, provisioner=prov,
+        expect_reqs=reqs_of(**{
+            LABEL_CAPACITY_TYPE: CAPACITY_TYPE_ON_DEMAND,
+            LABEL_TOPOLOGY_ZONE: "test-zone-1",
+            LABEL_ARCH_STABLE: "arm64",
+            LABEL_OS_STABLE: "windows",
+        }),
+    )
+    assert (zone, ct) == ("test-zone-1", CAPACITY_TYPE_ON_DEMAND)
+    assert it.requirements.get_requirement(LABEL_ARCH_STABLE).has("arm64")
+
+
+def test_no_match_unknown_arch(assorted):
+    assert launch(make_pod(node_selector={LABEL_ARCH_STABLE: "arm"}), None, assorted) is None
+
+
+def test_no_match_arch_zone_conflict(assorted):
+    prov = make_provisioner(
+        name="default",
+        requirements=[NodeSelectorRequirement(LABEL_ARCH_STABLE, "In", ["arm"])],
+    )
+    assert launch(
+        make_pod(node_selector={LABEL_TOPOLOGY_ZONE: "test-zone-2"}), prov, assorted
+    ) is None
+
+
+def test_schedules_instance_with_enough_resources(assorted):
+    it, _, _ = check_cheapest(
+        assorted,
+        pod=make_pod(requests={"cpu": "14", "memory": "14Gi"}),
+        min_resources={"cpu": 14.0, "memory": 14.0 * 2**30},
+    )
+    assert it.allocatable()["cpu"] >= 14
+
+
+def test_cheaper_on_demand_wins_over_spot_ordering():
+    """instance_selection_test.go:553: when the provisioner forbids spot, the
+    launch must find the cheapest ON-DEMAND offering even if spot prices
+    would order the types differently."""
+    universe = [
+        fake.new_instance_type(
+            "spot-cheap",
+            resources={"cpu": 4.0, "pods": 10.0},
+            offerings=[
+                Offering(CAPACITY_TYPE_SPOT, "test-zone-1", 0.5),
+                Offering(CAPACITY_TYPE_ON_DEMAND, "test-zone-1", 3.0),
+            ],
+        ),
+        fake.new_instance_type(
+            "od-cheap",
+            resources={"cpu": 4.0, "pods": 10.0},
+            offerings=[
+                Offering(CAPACITY_TYPE_SPOT, "test-zone-1", 1.0),
+                Offering(CAPACITY_TYPE_ON_DEMAND, "test-zone-1", 2.0),
+            ],
+        ),
+    ]
+    prov = make_provisioner(
+        name="default",
+        requirements=[
+            NodeSelectorRequirement(LABEL_CAPACITY_TYPE, "In", [CAPACITY_TYPE_ON_DEMAND])
+        ],
+    )
+    out = launch(make_pod(requests={"cpu": "1"}), prov, universe)
+    assert out is not None
+    it, _, ct, price = out
+    assert ct == CAPACITY_TYPE_ON_DEMAND
+    assert it.name == "od-cheap"
+    assert price == 2.0

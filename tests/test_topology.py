@@ -1,0 +1,455 @@
+"""Topology suite — spread / pod-affinity / pod-anti-affinity semantics.
+
+Mirrors reference pkg/controllers/provisioning/scheduling/topology_test.go
+(73 specs condensed to the behavior-distinct ones): zonal/hostname/
+capacity-type spread with kube-scheduler skew rules, provisioner-constrained
+domains, existing-pod domain counting, ScheduleAnyway relaxation, node-filter
+limiting, self-affinity, namespace filtering, inverse anti-affinity, and
+provisioner taint generation.
+"""
+import pytest
+
+from karpenter_core_tpu.api.labels import (
+    CAPACITY_TYPE_ON_DEMAND,
+    CAPACITY_TYPE_SPOT,
+    LABEL_CAPACITY_TYPE,
+    PROVISIONER_NAME_LABEL_KEY,
+)
+from karpenter_core_tpu.cloudprovider import fake
+from karpenter_core_tpu.controllers.provisioning.scheduling.scheduler import (
+    SchedulerOptions,
+    build_scheduler,
+)
+from karpenter_core_tpu.kube.client import InMemoryKubeClient
+from karpenter_core_tpu.kube.objects import (
+    LABEL_HOSTNAME,
+    LABEL_TOPOLOGY_ZONE,
+    LabelSelector,
+    LabelSelectorRequirement,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    PodAffinityTerm,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+)
+from karpenter_core_tpu.testing import make_node, make_pod, make_provisioner
+
+WEB = {"app": "web"}
+
+
+def spread(key=LABEL_TOPOLOGY_ZONE, max_skew=1, selector=WEB, unsat="DoNotSchedule"):
+    return TopologySpreadConstraint(
+        max_skew=max_skew,
+        topology_key=key,
+        when_unsatisfiable=unsat,
+        label_selector=LabelSelector(match_labels=selector) if selector is not None else None,
+    )
+
+
+def solve(pods, provisioners=None, instance_types=None, state_nodes=None, kube=None,
+          cluster=None):
+    provisioners = provisioners or [make_provisioner(name="default")]
+    its = instance_types if instance_types is not None else fake.instance_types(10)
+    it_map = {p.name: its for p in provisioners}
+    scheduler = build_scheduler(
+        kube or InMemoryKubeClient(),
+        cluster,
+        provisioners,
+        it_map,
+        pods,
+        state_nodes=state_nodes,
+        opts=SchedulerOptions(simulation_mode=True),
+    )
+    return scheduler.solve(pods)
+
+
+def skew(result, key):
+    """Pods per committed domain over new machines (ExpectSkew analog)."""
+    counts = {}
+    for m in result.new_machines:
+        if not m.pods:
+            continue
+        req = m.requirements.get_requirement(key)
+        assert req.len() == 1, f"domain not committed for {key}: {req!r}"
+        domain = req.values_list()[0]
+        counts[domain] = counts.get(domain, 0) + len(m.pods)
+    return counts
+
+
+# -- spread basics ----------------------------------------------------------
+
+
+def test_unknown_topology_key_fails_pod_but_not_others():
+    """topology_test.go:39-56."""
+    pods = [
+        make_pod(labels=WEB, topology_spread=[spread(key="unknown")]),
+        make_pod(),
+    ]
+    result = solve(pods)
+    assert len(result.failed_pods) == 1
+    assert result.pod_count_new() == 1
+
+
+def test_zonal_spread_match_expressions():
+    """topology_test.go:87-110."""
+    sel = LabelSelector(
+        match_expressions=[LabelSelectorRequirement(key="app", operator="In", values=["web"])]
+    )
+    constraint = TopologySpreadConstraint(
+        max_skew=1, topology_key=LABEL_TOPOLOGY_ZONE,
+        when_unsatisfiable="DoNotSchedule", label_selector=sel,
+    )
+    pods = [make_pod(labels=WEB, requests={"cpu": "1"}, topology_spread=[constraint])
+            for _ in range(6)]
+    result = solve(pods)
+    assert not result.failed_pods
+    assert sorted(skew(result, LABEL_TOPOLOGY_ZONE).values()) == [2, 2, 2]
+
+
+def test_spread_respects_provisioner_zone_subset():
+    """topology_test.go:129-147: provisioner limited to 2 zones -> spread
+    balances across exactly those."""
+    prov = make_provisioner(
+        name="default",
+        requirements=[NodeSelectorRequirement(
+            LABEL_TOPOLOGY_ZONE, "In", ["test-zone-1", "test-zone-2"]
+        )],
+    )
+    pods = [make_pod(labels=WEB, requests={"cpu": "1"}, topology_spread=[spread()])
+            for _ in range(4)]
+    result = solve(pods, provisioners=[prov])
+    assert not result.failed_pods
+    counts = skew(result, LABEL_TOPOLOGY_ZONE)
+    assert sorted(counts.values()) == [2, 2]
+    assert set(counts) == {"test-zone-1", "test-zone-2"}
+
+
+def test_spread_counts_existing_cluster_pods():
+    """topology_test.go:148-186: domain counts seed from pods already bound
+    to nodes (countDomains, topology.go:231-276)."""
+    kube = InMemoryKubeClient()
+    node = make_node(labels={LABEL_TOPOLOGY_ZONE: "test-zone-1"})
+    kube.create(node)
+    bound = make_pod(labels=WEB, node_name=node.metadata.name, unschedulable=False,
+                     phase="Running")
+    kube.create(bound)
+    pods = [make_pod(labels=WEB, requests={"cpu": "1"}, topology_spread=[spread()])
+            for _ in range(2)]
+    result = solve(pods, kube=kube)
+    assert not result.failed_pods
+    counts = skew(result, LABEL_TOPOLOGY_ZONE)
+    # zone-1 already has 1: the two new pods land in zone-2 and zone-3
+    assert counts == {"test-zone-2": 1, "test-zone-3": 1}
+
+
+def test_spread_prefers_minimum_domains_when_skewed():
+    """topology_test.go:229-267: with zone-1 over-count, new pods go to the
+    minimum domains first."""
+    kube = InMemoryKubeClient()
+    node = make_node(labels={LABEL_TOPOLOGY_ZONE: "test-zone-1"})
+    kube.create(node)
+    for _ in range(3):
+        kube.create(make_pod(labels=WEB, node_name=node.metadata.name,
+                             unschedulable=False, phase="Running"))
+    result = solve([make_pod(labels=WEB, requests={"cpu": "1"}, topology_spread=[spread()])],
+                   kube=kube)
+    assert not result.failed_pods
+    assert set(skew(result, LABEL_TOPOLOGY_ZONE)) <= {"test-zone-2", "test-zone-3"}
+
+
+def test_spread_do_not_schedule_blocks_over_skew():
+    """topology_test.go:268-300: zone-1 seeded with 1 pod; provisioner then
+    restricted to zones 2/3 -> only 4 more pods fit under maxSkew 1."""
+    kube = InMemoryKubeClient()
+    node = make_node(labels={LABEL_TOPOLOGY_ZONE: "test-zone-1"})
+    kube.create(node)
+    kube.create(make_pod(labels=WEB, node_name=node.metadata.name,
+                         unschedulable=False, phase="Running"))
+    prov = make_provisioner(
+        name="default",
+        requirements=[NodeSelectorRequirement(
+            LABEL_TOPOLOGY_ZONE, "In", ["test-zone-2", "test-zone-3"]
+        )],
+    )
+    pods = [make_pod(labels=WEB, requests={"cpu": "1"}, topology_spread=[spread()])
+            for _ in range(10)]
+    result = solve(pods, provisioners=[prov], kube=kube)
+    # max skew 1 over counts {z1:1}: z2/z3 can take 2 each, the rest fail
+    counts = skew(result, LABEL_TOPOLOGY_ZONE)
+    assert counts == {"test-zone-2": 2, "test-zone-3": 2}
+    assert len(result.failed_pods) == 6
+
+
+def test_capacity_type_spread_balances():
+    """topology_test.go:520-535."""
+    pods = [make_pod(labels=WEB, requests={"cpu": "1"},
+                     topology_spread=[spread(key=LABEL_CAPACITY_TYPE)])
+            for _ in range(4)]
+    result = solve(pods)
+    assert not result.failed_pods
+    counts = skew(result, LABEL_CAPACITY_TYPE)
+    assert sorted(counts.values()) == [2, 2]
+    assert set(counts) == {CAPACITY_TYPE_SPOT, CAPACITY_TYPE_ON_DEMAND}
+
+
+def test_schedule_anyway_spread_violated_when_unsatisfiable():
+    """topology_test.go:589-619: ScheduleAnyway spreads are dropped by
+    relaxation when the only capacity is one domain."""
+    prov = make_provisioner(
+        name="default",
+        requirements=[NodeSelectorRequirement(LABEL_CAPACITY_TYPE, "In",
+                                              [CAPACITY_TYPE_SPOT])],
+    )
+    kube = InMemoryKubeClient()
+    node = make_node(labels={LABEL_CAPACITY_TYPE: CAPACITY_TYPE_SPOT})
+    kube.create(node)
+    for _ in range(2):
+        kube.create(make_pod(labels=WEB, node_name=node.metadata.name,
+                             unschedulable=False, phase="Running"))
+    pods = [make_pod(labels=WEB, requests={"cpu": "1"},
+                     topology_spread=[spread(key=LABEL_CAPACITY_TYPE, unsat="ScheduleAnyway")])
+            for _ in range(3)]
+    result = solve(pods, provisioners=[prov], kube=kube)
+    assert not result.failed_pods  # violation allowed after relaxation
+    assert result.pod_count_new() == 3
+
+
+def test_hostname_spread_max_skew_two_packs_pairs():
+    """topology_test.go:422-437: maxSkew 2 on hostname lets pods double up."""
+    pods = [make_pod(labels=WEB, requests={"cpu": "1"},
+                     topology_spread=[spread(key=LABEL_HOSTNAME, max_skew=2)])
+            for _ in range(4)]
+    result = solve(pods, instance_types=fake.instance_types(5))
+    assert not result.failed_pods
+    per_machine = sorted(len(m.pods) for m in result.new_machines if m.pods)
+    assert max(per_machine) <= 2
+    assert len(per_machine) >= 2
+
+
+def test_combined_zone_and_hostname_spread():
+    """topology_test.go:814-853."""
+    pods = [
+        make_pod(labels=WEB, requests={"cpu": "1"},
+                 topology_spread=[spread(), spread(key=LABEL_HOSTNAME)])
+        for _ in range(6)
+    ]
+    result = solve(pods, instance_types=fake.instance_types(5))
+    assert not result.failed_pods
+    assert sorted(skew(result, LABEL_TOPOLOGY_ZONE).values()) == [2, 2, 2]
+    # hostname spread with skew 1: one pod per machine
+    assert all(len(m.pods) <= 1 for m in result.new_machines)
+
+
+def test_spread_limited_by_node_selector():
+    """topology_test.go:1067-1092: a nodeSelector restricts the domains the
+    spread can use; all pods land in the selected zone."""
+    pods = [
+        make_pod(labels=WEB, requests={"cpu": "1"},
+                 node_selector={LABEL_TOPOLOGY_ZONE: "test-zone-1"},
+                 topology_spread=[spread()])
+        for _ in range(4)
+    ]
+    result = solve(pods)
+    assert not result.failed_pods
+    assert set(skew(result, LABEL_TOPOLOGY_ZONE)) == {"test-zone-1"}
+
+
+def test_interdependent_selectors_pack_freely():
+    """topology_test.go:378-405: pods whose spread selector matches nothing
+    don't count toward skew, so they may pack onto one node."""
+    pods = [make_pod(requests={"cpu": "1"},
+                     topology_spread=[spread(key=LABEL_HOSTNAME)])
+            for _ in range(5)]
+    result = solve(pods, instance_types=fake.instance_types(20))
+    assert not result.failed_pods
+    assert len([m for m in result.new_machines if m.pods]) == 1
+
+
+def test_nil_selector_spread_schedules():
+    """topology_test.go:366-377: a nil labelSelector selects nothing; the pod
+    still schedules."""
+    result = solve([make_pod(topology_spread=[spread(selector=None)])])
+    assert not result.failed_pods
+
+
+def test_spread_across_multiple_provisioners():
+    """topology_test.go:2214-2248: the domain universe unions across
+    provisioners."""
+    p1 = make_provisioner(
+        name="p1",
+        requirements=[NodeSelectorRequirement(LABEL_TOPOLOGY_ZONE, "In", ["test-zone-1"])],
+    )
+    p2 = make_provisioner(
+        name="p2",
+        requirements=[NodeSelectorRequirement(LABEL_TOPOLOGY_ZONE, "In",
+                                              ["test-zone-2", "test-zone-3"])],
+    )
+    pods = [make_pod(labels=WEB, requests={"cpu": "1"}, topology_spread=[spread()])
+            for _ in range(3)]
+    result = solve(pods, provisioners=[p1, p2])
+    assert not result.failed_pods
+    assert sorted(skew(result, LABEL_TOPOLOGY_ZONE).values()) == [1, 1, 1]
+
+
+# -- pod affinity -----------------------------------------------------------
+
+
+def test_empty_affinity_schedules():
+    """topology_test.go:1232-1241."""
+    pod = make_pod(pod_affinity_required=[], pod_anti_affinity_required=[])
+    result = solve([pod])
+    assert not result.failed_pods
+
+
+def test_self_affinity_hostname_colocates():
+    """topology_test.go:1319-1342: pods selecting themselves land together."""
+    term = PodAffinityTerm(topology_key=LABEL_HOSTNAME,
+                           label_selector=LabelSelector(match_labels=WEB))
+    pods = [make_pod(labels=WEB, requests={"cpu": "1"}, pod_affinity_required=[term])
+            for _ in range(3)]
+    result = solve(pods, instance_types=fake.instance_types(20))
+    assert not result.failed_pods
+    assert len([m for m in result.new_machines if m.pods]) == 1
+
+
+def test_affinity_zone_with_seeded_target():
+    """topology_test.go:1981-2013: affinity pods follow an existing target's
+    zone."""
+    kube = InMemoryKubeClient()
+    node = make_node(labels={LABEL_TOPOLOGY_ZONE: "test-zone-2"})
+    kube.create(node)
+    kube.create(make_pod(labels={"app": "target"}, node_name=node.metadata.name,
+                         unschedulable=False, phase="Running"))
+    term = PodAffinityTerm(topology_key=LABEL_TOPOLOGY_ZONE,
+                           label_selector=LabelSelector(match_labels={"app": "target"}))
+    pods = [make_pod(requests={"cpu": "1"}, pod_affinity_required=[term]) for _ in range(3)]
+    result = solve(pods, kube=kube)
+    assert not result.failed_pods
+    for m in result.new_machines:
+        if m.pods:
+            assert m.requirements.get_requirement(LABEL_TOPOLOGY_ZONE).values_list() == [
+                "test-zone-2"
+            ]
+
+
+def test_affinity_to_nonexistent_pod_fails():
+    """topology_test.go:1964-1980."""
+    term = PodAffinityTerm(topology_key=LABEL_TOPOLOGY_ZONE,
+                           label_selector=LabelSelector(match_labels={"app": "ghost"}))
+    result = solve([make_pod(requests={"cpu": "1"}, pod_affinity_required=[term])])
+    assert len(result.failed_pods) == 1
+
+
+def test_affinity_filtered_by_namespace():
+    """topology_test.go:2094-2131: affinity only sees pods in the term's
+    namespaces (default: the pod's own)."""
+    kube = InMemoryKubeClient()
+    node = make_node(labels={LABEL_TOPOLOGY_ZONE: "test-zone-2"})
+    kube.create(node)
+    kube.create(make_pod(labels={"app": "target"}, namespace="other",
+                         node_name=node.metadata.name, unschedulable=False,
+                         phase="Running"))
+    term = PodAffinityTerm(topology_key=LABEL_TOPOLOGY_ZONE,
+                           label_selector=LabelSelector(match_labels={"app": "target"}))
+    # pod in "default" can't see the target in "other"
+    result = solve([make_pod(requests={"cpu": "1"}, pod_affinity_required=[term])], kube=kube)
+    assert len(result.failed_pods) == 1
+    # naming the namespace in the term fixes it
+    term2 = PodAffinityTerm(topology_key=LABEL_TOPOLOGY_ZONE,
+                            label_selector=LabelSelector(match_labels={"app": "target"}),
+                            namespaces=["other"])
+    result2 = solve([make_pod(requests={"cpu": "1"}, pod_affinity_required=[term2])], kube=kube)
+    assert not result2.failed_pods
+
+
+def test_preferred_affinity_violation_allowed():
+    """topology_test.go:1484-1516: preferred pod affinity with no viable
+    domain is relaxed away."""
+    from karpenter_core_tpu.kube.objects import WeightedPodAffinityTerm
+
+    pref = WeightedPodAffinityTerm(
+        weight=50,
+        pod_affinity_term=PodAffinityTerm(
+            topology_key=LABEL_TOPOLOGY_ZONE,
+            label_selector=LabelSelector(match_labels={"app": "ghost"}),
+        ),
+    )
+    result = solve([make_pod(requests={"cpu": "1"}, pod_affinity_preferred=[pref])])
+    assert not result.failed_pods
+
+
+def test_preferred_anti_affinity_violation_allowed():
+    """topology_test.go:1517-1549."""
+    from karpenter_core_tpu.kube.objects import WeightedPodAffinityTerm
+
+    pref = WeightedPodAffinityTerm(
+        weight=50,
+        pod_affinity_term=PodAffinityTerm(
+            topology_key=LABEL_TOPOLOGY_ZONE,
+            label_selector=LabelSelector(match_labels=WEB),
+        ),
+    )
+    pods = [make_pod(labels=WEB, requests={"cpu": "1"}, pod_anti_affinity_preferred=[pref])
+            for _ in range(5)]
+    result = solve(pods)
+    assert not result.failed_pods  # only 3 zones; violations permitted
+
+
+# -- inverse anti-affinity --------------------------------------------------
+
+
+class _FakeCluster:
+    """Minimal cluster exposing anti-affinity pod->node pairs."""
+
+    def __init__(self, pairs):
+        self.pairs = pairs
+
+    def for_pods_with_anti_affinity(self, visit):
+        for pod, node in self.pairs:
+            if not visit(pod, node):
+                return
+
+
+def test_inverse_anti_affinity_blocks_domain():
+    """topology_test.go:1716-1783: an EXISTING pod with anti-affinity against
+    app=web blocks new web pods from its zone."""
+    term = PodAffinityTerm(topology_key=LABEL_TOPOLOGY_ZONE,
+                           label_selector=LabelSelector(match_labels=WEB))
+    existing = make_pod(labels={"app": "db"}, node_name="existing-node",
+                        unschedulable=False, phase="Running",
+                        pod_anti_affinity_required=[term])
+    node = make_node(name="existing-node", labels={LABEL_TOPOLOGY_ZONE: "test-zone-3"})
+    cluster = _FakeCluster([(existing, node)])
+    pods = [make_pod(labels=WEB, requests={"cpu": "1"}) for _ in range(3)]
+    result = solve(pods, cluster=cluster)
+    assert not result.failed_pods
+    for m in result.new_machines:
+        if m.pods:
+            assert not m.requirements.get_requirement(LABEL_TOPOLOGY_ZONE).has("test-zone-3")
+
+
+# -- provisioner taints -----------------------------------------------------
+
+
+def test_provisioner_taints_applied_to_machine():
+    """topology_test.go:2250-2259."""
+    prov = make_provisioner(name="default",
+                            taints=[Taint("example.com/special", "true", "NoSchedule")])
+    result = solve(
+        [make_pod(requests={"cpu": "1"},
+                  tolerations=[Toleration(key="example.com/special", operator="Exists")])],
+        provisioners=[prov],
+    )
+    assert not result.failed_pods
+    machine = result.new_machines[0]
+    assert any(t.key == "example.com/special" for t in machine.template.taints)
+
+
+def test_startup_taints_do_not_block_scheduling():
+    """topology_test.go:2287-2294: startup taints exist on the node but are
+    not considered for pod scheduling."""
+    prov = make_provisioner(name="default",
+                            startup_taints=[Taint("example.com/init", "true", "NoSchedule")])
+    result = solve([make_pod(requests={"cpu": "1"})], provisioners=[prov])
+    assert not result.failed_pods
