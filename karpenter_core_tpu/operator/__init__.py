@@ -219,24 +219,32 @@ def new_operator(
     test suites create intentionally-partial objects."""
     if settings is not None:
         set_current(settings)
+    from karpenter_core_tpu.cloudprovider.metrics import decorate
+
+    # per-controller SPI duration attribution (cloudprovider/metrics decorator)
+    cp_provisioning = decorate(cloud_provider, "provisioning")
+    cp_machine = decorate(cloud_provider, "machine")
+    cp_node = decorate(cloud_provider, "node")
+    cp_deprovisioning = decorate(cloud_provider, "deprovisioning")
+    cp_inflight = decorate(cloud_provider, "inflightchecks")
     kube_client = kube_client or InMemoryKubeClient()
     if with_webhooks:
         from karpenter_core_tpu.webhooks import install as install_webhooks
 
         install_webhooks(kube_client)
     recorder = Recorder(clock=clock)
-    cluster = Cluster(kube_client, cloud_provider, clock=clock)
+    cluster = Cluster(kube_client, cp_node, clock=clock)
     eviction_queue = EvictionQueue(kube_client, recorder)
-    terminator = Terminator(kube_client, cloud_provider, eviction_queue, clock=clock)
+    terminator = Terminator(kube_client, cp_machine, eviction_queue, clock=clock)
     provisioning = ProvisioningController(
-        kube_client, cloud_provider, cluster, recorder=recorder, solver=solver
+        kube_client, cp_provisioning, cluster, recorder=recorder, solver=solver
     )
     from karpenter_core_tpu.controllers.deprovisioning.controller import (
         DeprovisioningController,
     )
 
     deprovisioning = DeprovisioningController(
-        kube_client, cluster, provisioning, cloud_provider, recorder, clock=clock
+        kube_client, cluster, provisioning, cp_deprovisioning, recorder, clock=clock
     )
     return Operator(
         kube_client=kube_client,
@@ -246,14 +254,14 @@ def new_operator(
         provisioning=provisioning,
         pod_controller=PodController(provisioning),
         machine_controller=MachineController(
-            kube_client, cloud_provider, cluster, terminator, recorder, clock=clock
+            kube_client, cp_machine, cluster, terminator, recorder, clock=clock
         ),
-        node_controller=NodeController(kube_client, cloud_provider, cluster, clock=clock),
+        node_controller=NodeController(kube_client, cp_node, cluster, clock=clock),
         termination_controller=TerminationController(
             kube_client, terminator, cluster, recorder
         ),
         inflight_checks=InflightChecksController(
-            kube_client, cloud_provider, cluster, recorder, clock=clock
+            kube_client, cp_inflight, cluster, recorder, clock=clock
         ),
         counter=CounterController(kube_client, cluster),
         deprovisioning=deprovisioning,

@@ -1,0 +1,148 @@
+"""Controller-process entrypoint: `python -m karpenter_core_tpu.operator`.
+
+The reference binary is assembled by a vendor embedding NewOperator
+(operator.go:68); this standalone entrypoint assembles the same control plane
+from environment configuration (the chart's env vars) and serves the health +
+metrics endpoints the deployment probes:
+
+  KARPENTER_LOG_LEVEL            python logging level name (default INFO)
+  KARPENTER_BATCH_IDLE_SECONDS   provisioning batcher idle window (default 1)
+  KARPENTER_BATCH_MAX_SECONDS    provisioning batcher max window (default 10)
+  KARPENTER_SOLVER_ENDPOINT      host:port of the gRPC TPU solver; unset ->
+                                 in-process TPUSolver (single-process mode)
+  KARPENTER_METRICS_PORT         /metrics /healthz /readyz port (default 8000)
+
+The karpenter-global-settings ConfigMap, when present in the kube store,
+overrides the env defaults (the reference's dynamic-settings path,
+settings.go:53-68; env vars are the bootstrap fallback).
+
+A vendor embeds this the same way the reference is embedded: construct a
+CloudProvider + kube client (any object with the InMemoryKubeClient surface)
+and call run(). Standalone invocation wires the fake provider + in-memory
+client — a self-contained control plane useful for smoke tests and chart
+validation.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from karpenter_core_tpu.api.settings import Settings
+from karpenter_core_tpu.metrics.registry import REGISTRY
+from karpenter_core_tpu.operator import new_operator
+
+
+def solver_from_env():
+    """KARPENTER_SOLVER_ENDPOINT -> RemoteSolver, else None (in-process)."""
+    endpoint = os.environ.get("KARPENTER_SOLVER_ENDPOINT", "")
+    if not endpoint:
+        return None
+    from karpenter_core_tpu.solver.service import RemoteSolver
+
+    return RemoteSolver(endpoint)
+
+
+def settings_from_env() -> Settings:
+    return Settings(
+        batch_idle_duration=float(os.environ.get("KARPENTER_BATCH_IDLE_SECONDS", "1")),
+        batch_max_duration=float(os.environ.get("KARPENTER_BATCH_MAX_SECONDS", "10")),
+    )
+
+
+def resolve_settings(kube_client) -> Settings:
+    """ConfigMap karpenter-global-settings wins over env defaults
+    (injection/injection.go:116-127 bootstraps settings from the ConfigMap)."""
+    if kube_client is not None:
+        for cm in kube_client.list("ConfigMap"):
+            if cm.metadata.name == "karpenter-global-settings":
+                return Settings.from_config_map(cm.data)
+    return settings_from_env()
+
+
+def configure_logging() -> None:
+    import logging
+
+    level = os.environ.get("KARPENTER_LOG_LEVEL", "INFO").upper()
+    logging.basicConfig(
+        level=getattr(logging, level, logging.INFO),
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+
+
+class _HealthHandler(BaseHTTPRequestHandler):
+    operator = None  # set by serve_health
+
+    def do_GET(self):
+        if self.path == "/metrics":
+            body = REGISTRY.expose().encode() + b"\n"
+            ctype = "text/plain; version=0.0.4"
+        elif self.path in ("/healthz", "/readyz"):
+            body = json.dumps({"status": "ok"}).encode()
+            ctype = "application/json"
+        else:
+            self.send_response(404)
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, *args):  # quiet probe spam
+        pass
+
+
+def serve_health(operator, port: int) -> ThreadingHTTPServer:
+    _HealthHandler.operator = operator
+    server = ThreadingHTTPServer(("0.0.0.0", port), _HealthHandler)
+    threading.Thread(target=server.serve_forever, daemon=True).start()
+    return server
+
+
+def run(cloud_provider, kube_client=None, stop_event=None):
+    """Assemble and run the control plane until stop_event (or a signal).
+
+    Settings resolve from the client's karpenter-global-settings ConfigMap
+    when the embedding vendor passes an API-backed client; the standalone
+    in-memory client has no ConfigMap, so env vars apply."""
+    configure_logging()
+    if kube_client is None:
+        from karpenter_core_tpu.kube.client import InMemoryKubeClient
+
+        kube_client = InMemoryKubeClient()
+    operator = new_operator(
+        cloud_provider,
+        kube_client=kube_client,
+        settings=resolve_settings(kube_client),
+        solver=solver_from_env(),
+        with_webhooks=True,
+    )
+    port = int(os.environ.get("KARPENTER_METRICS_PORT", "8000"))
+    health = serve_health(operator, port)
+    operator.start()
+    print(f"controller running; health/metrics on :{port}", flush=True)
+
+    stop = stop_event or threading.Event()
+    try:
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            signal.signal(sig, lambda *_: stop.set())
+    except ValueError:
+        pass  # not the main thread (embedded/test use)
+    stop.wait()
+    operator.stop()
+    health.shutdown()
+    return operator
+
+
+def main():
+    from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider
+
+    run(FakeCloudProvider())
+
+
+if __name__ == "__main__":
+    main()

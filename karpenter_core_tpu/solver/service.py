@@ -249,8 +249,10 @@ class RemoteSolver:
             response_deserializer=pb.HealthResponse.FromString,
         )
 
-    def health(self) -> pb.HealthResponse:
-        return self._health(pb.HealthRequest(), timeout=5.0)
+    def health(self, timeout: float = 30.0) -> pb.HealthResponse:
+        # generous default: the server's first jax.devices() call initializes
+        # the TPU backend, which can take tens of seconds cold
+        return self._health(pb.HealthRequest(), timeout=timeout)
 
     def solve(
         self,
@@ -308,3 +310,27 @@ class _StateView:
             return self._tensors[name]
         except KeyError:
             raise AttributeError(name)
+
+
+def main(argv: Optional[List[str]] = None) -> None:
+    """Container entrypoint: `python -m karpenter_core_tpu.solver.service`."""
+    import argparse
+    import signal
+
+    parser = argparse.ArgumentParser(description="karpenter-core-tpu solver service")
+    parser.add_argument("--port", type=int, default=8980)
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--max-workers", type=int, default=4)
+    args = parser.parse_args(argv)
+
+    server, port, _service = serve(f"{args.host}:{args.port}", max_workers=args.max_workers)
+    print(f"solver service listening on {args.host}:{port}", flush=True)
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+    server.stop(grace=5)
+
+
+if __name__ == "__main__":
+    main()
