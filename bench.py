@@ -113,7 +113,11 @@ def main():
         jax.block_until_ready(out)
         times.append(time.perf_counter() - t0)
 
-    assigned = np.asarray(out[0])
+    from karpenter_core_tpu.solver.tpu_solver import expand_log
+
+    log, ptr, state = out
+    log = {k: np.asarray(v) for k, v in log.items()}
+    assigned = expand_log(snap, log, int(ptr))
     scheduled = int((assigned >= 0).sum())
     solve_s = float(np.median(times))
     pods_per_sec = scheduled / solve_s

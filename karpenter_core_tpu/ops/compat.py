@@ -23,6 +23,72 @@ import jax.numpy as jnp
 Segments = List[Tuple[int, int]]  # per key: (lo, hi) into the flat value axis
 
 
+def use_mxu() -> bool:
+    """True when the default backend has a systolic array (TPU / axon tunnel):
+    per-key reductions are then cheaper as one matmul than as K sliced
+    reductions. On CPU the sliced loop form wins (bf16 matmul is emulated)."""
+    return jax.default_backend() not in ("cpu",)
+
+
+def seg_matrix(segments: Segments, V: int):
+    """Static [V, K] one-hot membership matrix: column k marks the values of
+    key k. Turns every per-key any-reduction into ONE bf16 matmul on the MXU
+    (f32 accumulate keeps the >0 test exact), replacing K sliced reductions —
+    the op-count killer inside the packing scan."""
+    import numpy as np
+
+    K = len(segments)
+    m = np.zeros((V, K), dtype=np.float32)
+    for k, (lo, hi) in enumerate(segments):
+        m[lo:hi, k] = 1.0
+    return m
+
+
+def segment_any_m(mask: jnp.ndarray, seg_mat) -> jnp.ndarray:
+    """[..., V] bool -> [..., K] bool via one matmul (MXU path)."""
+    counts = jax.lax.dot_general(
+        mask.astype(jnp.bfloat16),
+        jnp.asarray(seg_mat, dtype=jnp.bfloat16),
+        (((mask.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+    return counts > 0.5
+
+
+def escape_flags_m(allow, out, defined, seg_mat) -> jnp.ndarray:
+    """escape_flags with matmul-fused segment reductions (2 matmuls total)."""
+    has_allow = segment_any_m(allow, seg_mat)
+    has_excl = segment_any_m(~allow, seg_mat)
+    return defined & ((out & has_excl) | (~out & ~has_allow))
+
+
+def rows_compat_m(node, pod_row, seg_mat, custom_deny=None):
+    """Batched Requirements.Compatible(node_rows, one pod row) -> [N] bool.
+
+    node: dict with allow [N,V] / out,defined [N,K] (escape derived here);
+    pod_row: dict with allow [V] / out,defined,escape [K] (+ custom_deny [K]).
+    Fuses the per-key loop of pairwise_compatible into 3 matmuls."""
+    node_escape = escape_flags_m(node["allow"], node["out"], node["defined"], seg_mat)
+    shared = node["defined"] & pod_row["defined"][None, :]
+    both_out = node["out"] & pod_row["out"][None, :]
+    inter = segment_any_m(node["allow"] & pod_row["allow"][None, :], seg_mat)
+    escapes = node_escape & pod_row["escape"][None, :]
+    ok = ((~shared) | both_out | inter | escapes).all(axis=-1)
+    if custom_deny is not None:
+        ok &= ~jnp.any(custom_deny[None, :] & ~node["defined"], axis=-1)
+    return ok
+
+
+def row_vs_rows_compat_m(m_allow, m_out, m_defined, m_escape, rows, seg_mat):
+    """Intersects(one merged row, batch rows) -> [T] bool, matmul-fused.
+    rows: dict with allow [T,V] / out,defined,escape [T,K]."""
+    shared = m_defined[None, :] & rows["defined"]
+    both_out = m_out[None, :] & rows["out"]
+    inter = segment_any_m(rows["allow"] & m_allow[None, :], seg_mat)
+    escapes = m_escape[None, :] & rows["escape"]
+    return ((~shared) | both_out | inter | escapes).all(axis=-1)
+
+
 def segment_any(mask: jnp.ndarray, segments: Segments) -> jnp.ndarray:
     """[..., V] bool -> [..., K] bool: any within each key's segment."""
     cols = [

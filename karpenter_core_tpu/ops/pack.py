@@ -80,10 +80,29 @@ def make_pack_kernel(
     zlo, zhi = zone_seg
     clo, chi = ct_seg
     has_topo = topo_meta is not None and len(topo_meta.groups) > 0
+    seg_mat = None  # [V, K] built lazily at trace time (V known from arrays)
+
+    def _seg_mat(V):
+        nonlocal seg_mat
+        if seg_mat is None:
+            seg_mat = compat.seg_matrix(segments, V)
+        return seg_mat
 
     def slot_compat_screen(state: PackState, prow):
         """[N] bool: pod-vs-slot requirement compatibility + custom rule
-        (the node side is the slot's merged requirements)."""
+        (the node side is the slot's merged requirements).
+
+        On MXU backends the per-key any-reductions fuse into 3 matmuls
+        (op-count is what bounds the scan step); on CPU the sliced loop
+        form is faster, so pick per backend at trace time."""
+        if compat.use_mxu():
+            sm = _seg_mat(state.allow.shape[1])
+            return compat.rows_compat_m(
+                {"allow": state.allow, "out": state.out, "defined": state.defined},
+                prow,
+                sm,
+                custom_deny=prow["custom_deny"],
+            )
         ok = jnp.ones(state.allow.shape[0], dtype=bool)
         slot_escape = compat.escape_flags(state.allow, state.out, state.defined, segments)
         for k, (lo, hi) in enumerate(segments):
@@ -101,23 +120,36 @@ def make_pack_kernel(
         ok &= ~jnp.any(deny[None, :] & ~state.defined, axis=-1)
         return ok
 
-    def merged_types_ok(m_allow, m_out, m_defined, new_used, base_tmask,
-                        type_reqs, type_alloc, type_offering_ok):
-        """[T]: surviving instance types for a merged requirement row
-        (compatible ∧ fits ∧ hasOffering — machine.go:137-159)."""
-        m_escape = compat.escape_flags(m_allow[None], m_out[None], m_defined[None], segments)[0]
-        ok_t = jnp.ones(type_alloc.shape[0], dtype=bool)
-        for k, (lo, hi) in enumerate(segments):
-            shared = m_defined[k] & type_reqs["defined"][:, k]
-            both_out = m_out[k] & type_reqs["out"][:, k]
-            if hi > lo:
-                inter = (m_allow[lo:hi][None, :] & type_reqs["allow"][:, lo:hi]).any(axis=-1)
-                nonempty = both_out | inter
-            else:
-                nonempty = both_out
-            escapes = m_escape[k] & type_reqs["escape"][:, k]
-            ok_t &= (~shared) | nonempty | escapes
-        fit_t = compat.fits(new_used[None, :], type_alloc)
+    def merged_types_compat(m_allow, m_out, m_defined, base_tmask, type_reqs,
+                            type_offering_ok):
+        """[T]: requirement/offering-surviving types for a merged row
+        (compatible ∧ hasOffering — machine.go:137-159; resource fit is
+        handled separately through per-type replica capacities)."""
+        if compat.use_mxu():
+            sm = _seg_mat(m_allow.shape[0])
+            m_escape = compat.escape_flags_m(
+                m_allow[None], m_out[None], m_defined[None], sm
+            )[0]
+            ok_t = compat.row_vs_rows_compat_m(
+                m_allow, m_out, m_defined, m_escape, type_reqs, sm
+            )
+        else:
+            m_escape = compat.escape_flags(
+                m_allow[None], m_out[None], m_defined[None], segments
+            )[0]
+            ok_t = jnp.ones(base_tmask.shape[0], dtype=bool)
+            for k, (lo, hi) in enumerate(segments):
+                shared = m_defined[k] & type_reqs["defined"][:, k]
+                both_out = m_out[k] & type_reqs["out"][:, k]
+                if hi > lo:
+                    inter = (m_allow[lo:hi][None, :] & type_reqs["allow"][:, lo:hi]).any(
+                        axis=-1
+                    )
+                    nonempty = both_out | inter
+                else:
+                    nonempty = both_out
+                escapes = m_escape[k] & type_reqs["escape"][:, k]
+                ok_t &= (~shared) | nonempty | escapes
         offer_t = (
             jnp.einsum(
                 "tzc,z,c->t",
@@ -127,16 +159,37 @@ def make_pack_kernel(
             )
             > 0.5
         )
-        return base_tmask & ok_t & fit_t & offer_t
+        return base_tmask & ok_t & offer_t
+
+    BIGK = jnp.int32(2**30)
+
+    def replica_cap(alloc, used, req):
+        """alloc [T, R] vs used [1, R] + k*req [R]: max k per row with
+        exact-fit semantics (float floor corrected ±1 so k*req <= room holds
+        under f32 algebra). Rows with any negative allocatable (invalid
+        marker) or an already-overflowing req==0 resource get 0."""
+        room = alloc - used  # [T, R]
+        safe = req > 0  # [R]
+        denom = jnp.where(safe, req, 1.0)
+        kf = jnp.clip(jnp.floor(room / denom), 0.0, jnp.float32(BIGK))
+        kf = jnp.where((kf + 1.0) * denom <= room, kf + 1.0, kf)
+        kf = jnp.where(kf * denom > room, kf - 1.0, kf)
+        k = jnp.clip(kf, 0.0, jnp.float32(BIGK)).astype(jnp.int32)
+        k = jnp.where(safe, k, BIGK)
+        kmin = k.min(axis=-1)
+        valid = jnp.all((alloc >= 0.0) & ((room >= 0.0) | safe), axis=-1)
+        return jnp.where(valid, kmin, 0)
 
     def verify_slot(state: PackState, prow, n, type_reqs, type_alloc,
                     type_offering_ok, f_static_p):
         """Exact acceptance check on slot n.
-        Returns (ok, new_tmask[T], narrow[V])."""
+        Returns (ok, compat_tmask[T], kcap_t[T], kmax, narrow[V], applied[K]).
+        kmax = max identical replicas slot n can take (capacity ∧ owned
+        hostname-spread skew headroom)."""
         slot_allow = state.allow[n]
         K = state.out.shape[1]
         if has_topo:
-            t_viable, narrow, applied_keys = topo.topo_narrow_single(
+            t_viable, narrow, applied_keys, k_topo = topo.topo_narrow_single(
                 topo_meta, state.tcounts, state.thost, state.tdoms,
                 prow["topo_own"], prow["topo_sel"], prow["allow"], slot_allow, n, K,
             )
@@ -144,26 +197,32 @@ def make_pack_kernel(
             t_viable = jnp.bool_(True)
             narrow = jnp.ones_like(slot_allow)
             applied_keys = jnp.zeros(K, dtype=bool)
+            k_topo = BIGK
 
         m_allow = slot_allow & prow["allow"] & narrow
         # topology-narrowed keys become DEFINED concrete In-sets
         # (AddRequirements, topology.go:149-167)
         m_out = state.out[n] & prow["out"] & ~applied_keys
         m_defined = state.defined[n] | prow["defined"] | applied_keys
-        new_used = state.used[n] + prow["requests"]
 
-        new_tmask = merged_types_ok(
-            m_allow, m_out, m_defined, new_used,
+        compat_tmask = merged_types_compat(
+            m_allow, m_out, m_defined,
             state.tmask[n] & f_static_p[state.tmpl[n]],
-            type_reqs, type_alloc, type_offering_ok,
+            type_reqs, type_offering_ok,
         )
+        kcap_t = replica_cap(type_alloc, state.used[n][None, :], prow["requests"])
         is_existing = state.is_existing[n]
-        fit_existing = compat.fits(new_used, state.cap[n])
-        ok = t_viable & jnp.where(is_existing, fit_existing, new_tmask.any())
-        return ok, new_tmask, narrow, applied_keys
+        kmax_exist = replica_cap(
+            state.cap[n][None, :], state.used[n][None, :], prow["requests"]
+        )[0]
+        kmax_mach = jnp.max(jnp.where(compat_tmask, kcap_t, 0), initial=0)
+        kmax = jnp.where(is_existing, kmax_exist, kmax_mach)
+        kmax = jnp.minimum(kmax, k_topo)
+        ok = t_viable & (kmax >= 1)
+        return ok, compat_tmask, kcap_t, kmax, narrow, applied_keys
 
     def record_topo(state: PackState, prow, m_allow, m_out, m_defined,
-                    well_known, terms, slot_n):
+                    well_known, terms, row_mask, k_row):
         if not has_topo:
             return state
         nf_ok = topo.topo_node_filter_ok(
@@ -171,15 +230,16 @@ def make_pack_kernel(
         )
         tcounts, thost, tdoms = topo.topo_record(
             topo_meta, state.tcounts, state.thost, state.tdoms,
-            prow["topo_own"], prow["topo_sel"], nf_ok, m_allow, m_out, slot_n,
+            prow["topo_own"], prow["topo_sel"], nf_ok, m_allow, m_out,
+            row_mask, k_row,
         )
         return state._replace(tcounts=tcounts, thost=thost, tdoms=tdoms)
 
     def pack(
         state: PackState,
-        pod_arrays: dict,
-        f_static: jnp.ndarray,  # [J, P, T]
-        openable: jnp.ndarray,  # [J, P]
+        item_arrays: dict,
+        f_static: jnp.ndarray,  # [J, I, T]
+        openable: jnp.ndarray,  # [J, I]
         tmpl_reqs: dict,  # [J, ...]
         tmpl_daemon: jnp.ndarray,  # [J, R]
         tmpl_type_mask: jnp.ndarray,  # [J, T]
@@ -189,28 +249,61 @@ def make_pack_kernel(
         type_offering_ok: jnp.ndarray,
         well_known: jnp.ndarray = None,
         topo_terms: dict = None,
+        log_len: int = None,
     ):
         N = state.used.shape[0]
         J = tmpl_daemon.shape[0]
-        P = pod_arrays["requests"].shape[0]
+        I = item_arrays["requests"].shape[0]
         V = state.allow.shape[1]
+        K = state.out.shape[1]
+        # commit-log budget: every logged entry commits >= 1 replica, so the
+        # total pod count (+ slack) is a true bound. Callers that know it pass
+        # log_len; commits are additionally gated on log space so an
+        # undersized log fails pods cleanly instead of placing them unlogged.
+        L = log_len if log_len is not None else (I + 2 * N + 64)
 
-        def step(state: PackState, i):
+        log0 = {
+            "item": jnp.full(L, -1, jnp.int32),
+            "slot": jnp.zeros(L, jnp.int32),
+            "ns": jnp.zeros(L, jnp.int32),
+            "k": jnp.zeros(L, jnp.int32),
+            "k_last": jnp.zeros(L, jnp.int32),
+        }
+
+        def log_write(log, ptr, do, item_i, slot_lo, ns, k, k_last):
+            p = jnp.minimum(ptr, L - 1)
+            w = do & (ptr < L)
+
+            def wr(a, v):
+                return a.at[p].set(jnp.where(w, v, a[p]))
+
+            log = {
+                "item": wr(log["item"], item_i),
+                "slot": wr(log["slot"], slot_lo),
+                "ns": wr(log["ns"], ns),
+                "k": wr(log["k"], k),
+                "k_last": wr(log["k_last"], k_last),
+            }
+            return log, ptr + jnp.where(w, 1, 0)
+
+        def step(carry, i):
+            state, log, ptr = carry
             prow = {
-                "allow": pod_arrays["allow"][i],
-                "out": pod_arrays["out"][i],
-                "defined": pod_arrays["defined"][i],
-                "escape": pod_arrays["escape"][i],
-                "custom_deny": pod_arrays["custom_deny"][i],
-                "requests": pod_arrays["requests"][i],
+                "allow": item_arrays["allow"][i],
+                "out": item_arrays["out"][i],
+                "defined": item_arrays["defined"][i],
+                "escape": item_arrays["escape"][i],
+                "custom_deny": item_arrays["custom_deny"][i],
+                "requests": item_arrays["requests"][i],
             }
             if has_topo:
-                prow["topo_own"] = pod_arrays["topo_own"][i]
-                prow["topo_sel"] = pod_arrays["topo_sel"][i]
-            valid = pod_arrays["valid"][i]
+                prow["topo_own"] = item_arrays["topo_own"][i]
+                prow["topo_sel"] = item_arrays["topo_sel"][i]
+            valid = item_arrays["valid"][i]
+            count = item_arrays["count"][i]
 
-            # -- screen --------------------------------------------------
-            tol = pod_arrays["tol"][i][state.tol_idx]  # [N]
+            # -- screen (once per item) -----------------------------------
+            tol = item_arrays["tol"][i][state.tol_idx]  # [N]
             fit_screen = compat.fits(state.used + prow["requests"][None, :], state.cap)
             req_screen = slot_compat_screen(state, prow)
             screen = state.open & tol & fit_screen & req_screen
@@ -222,166 +315,219 @@ def make_pack_kernel(
 
             # rank: existing first by index, then machines by (pods, index)
             idx = jnp.arange(N, dtype=jnp.float32)
-            score = jnp.where(
+            score0 = jnp.where(
                 state.is_existing,
                 idx,
                 jnp.float32(N) + state.pods.astype(jnp.float32) * N + idx,
             )
-            score = jnp.where(screen, score, BIG)
+            score0 = jnp.where(screen, score0, BIG)
 
-            # -- verify loop ---------------------------------------------
             f_static_p = f_static[:, i, :]  # [J, T]
 
-            def cond2(carry):
-                found, tries, cand, score, _, _, _ = carry
-                return (~found) & (tries < max_verify_tries) & (score.min() < BIG)
-
-            def body(carry):
-                found, tries, cand, score, tmask_out, narrow_out, keys_out = carry
+            # -- candidate branch: verify best slot, commit k replicas ----
+            def do_candidate(carry):
+                state, log, ptr, remaining, score, _ = carry
                 n = jnp.argmin(score)
-                ok, new_tmask, narrow, applied_keys = verify_slot(
+                ok, compat_tmask, kcap_t, kmax, narrow, applied_keys = verify_slot(
                     state, prow, n, type_reqs, type_alloc, type_offering_ok, f_static_p
                 )
-                score = score.at[n].set(BIG)
-                return (
-                    ok,
-                    tries + 1,
-                    jnp.where(ok, n, cand),
-                    score,
-                    jnp.where(ok, new_tmask, tmask_out),
-                    jnp.where(ok, narrow, narrow_out),
-                    jnp.where(ok, applied_keys, keys_out),
-                )
+                k = jnp.minimum(remaining, kmax)
+                do = ok & (k >= 1) & (ptr < L)
 
-            K = state.out.shape[1]
-            found, _, cand, _, cand_tmask, cand_narrow, cand_keys = jax.lax.while_loop(
-                cond2,
-                body,
-                (
-                    jnp.bool_(False),
-                    jnp.int32(0),
-                    jnp.int32(-1),
-                    score,
-                    jnp.zeros_like(state.tmask[0]),
-                    jnp.ones(V, dtype=bool),
-                    jnp.zeros(K, dtype=bool),
-                ),
-            )
-
-            # -- open new slot --------------------------------------------
-            # fresh slot hostname is its slot identity (thost row = 0)
-            cap_ok = jnp.all(
-                type_capacity[None, :, :] <= state.remaining[:, None, :], axis=-1
-            )  # [J, T]
-            open_viable = []
-            open_narrows = []
-            open_outs = []
-            open_defs = []
-            open_types_rows = []
-            for j in range(J):  # static unroll — J is the provisioner count
-                fresh_allow = tmpl_reqs["allow"][j]
-                if has_topo:
-                    tv, tnarrow, tkeys = topo.topo_narrow_single(
-                        topo_meta, state.tcounts, state.thost, state.tdoms,
-                        prow["topo_own"], prow["topo_sel"], prow["allow"], fresh_allow,
-                        state.nopen, K,
-                    )
-                else:
-                    tv = jnp.bool_(True)
-                    tnarrow = jnp.ones(V, dtype=bool)
-                    tkeys = jnp.zeros(K, dtype=bool)
-                m_allow_j = fresh_allow & prow["allow"] & tnarrow
-                m_out_j = tmpl_reqs["out"][j] & prow["out"] & ~tkeys
-                m_def_j = tmpl_reqs["defined"][j] | prow["defined"] | tkeys
-                types_j = merged_types_ok(
-                    m_allow_j, m_out_j, m_def_j,
-                    tmpl_daemon[j] + prow["requests"],
-                    tmpl_type_mask[j] & cap_ok[j] & f_static_p[j],
-                    type_reqs, type_alloc, type_offering_ok,
-                )
-                open_viable.append(tv & types_j.any())
-                open_narrows.append(m_allow_j)
-                open_outs.append(m_out_j)
-                open_defs.append(m_def_j)
-                open_types_rows.append(types_j)
-            can_open_j = jnp.stack(open_viable) & openable[:, i]  # [J]
-            open_allow_rows = jnp.stack(open_narrows)  # [J, V]
-            open_types = jnp.stack(open_types_rows)  # [J, T]
-            j_choice = jnp.argmax(can_open_j)
-            can_open = can_open_j.any() & (state.nopen < N)
-
-            do_open = valid & (~found) & can_open
-            do_assign = valid & (found | can_open)
-            slot = jnp.where(found, cand, state.nopen)
-
-            new_tmask = jnp.where(found, cand_tmask, open_types[j_choice])
-            opened_allow = open_allow_rows[j_choice]
-            opened_out = jnp.stack(open_outs)[j_choice]
-            opened_defined = jnp.stack(open_defs)[j_choice]
-            opened_used = tmpl_daemon[j_choice] + prow["requests"]
-            opened_cap = _segment_max_alloc(new_tmask, type_alloc)
-
-            def apply_found(state):
-                n = cand
-                m_allow = state.allow[n] & prow["allow"] & cand_narrow
-                m_out = state.out[n] & prow["out"] & ~cand_keys
-                m_defined = state.defined[n] | prow["defined"] | cand_keys
-                new_used = state.used[n] + prow["requests"]
+                m_allow = state.allow[n] & prow["allow"] & narrow
+                m_out = state.out[n] & prow["out"] & ~applied_keys
+                m_defined = state.defined[n] | prow["defined"] | applied_keys
                 is_existing = state.is_existing[n]
+                new_used = state.used[n] + k.astype(jnp.float32) * prow["requests"]
+                tmask_k = compat_tmask & (kcap_t >= k)
+                new_tmask = jnp.where(is_existing, state.tmask[n], tmask_k)
                 new_cap = jnp.where(
-                    is_existing, state.cap[n], _segment_max_alloc(cand_tmask, type_alloc)
+                    is_existing, state.cap[n], _segment_max_alloc(tmask_k, type_alloc)
                 )
-                state = state._replace(
-                    used=state.used.at[n].set(new_used),
-                    pods=state.pods.at[n].add(1),
-                    allow=state.allow.at[n].set(m_allow),
-                    out=state.out.at[n].set(m_out),
-                    defined=state.defined.at[n].set(m_defined),
-                    tmask=jnp.where(
-                        is_existing, state.tmask, state.tmask.at[n].set(cand_tmask)
-                    ),
-                    cap=state.cap.at[n].set(new_cap),
-                )
-                return record_topo(
-                    state, prow, m_allow, m_out, m_defined, well_known, topo_terms, n
-                )
+                onehot = jnp.arange(N) == n
 
-            def apply_open(state):
-                n = state.nopen
-                # pessimistic limit subtraction over surviving types
-                # (scheduler.go:276-293)
-                max_cap = jnp.where(new_tmask[:, None], type_capacity, -BIG).max(axis=0)
+                def apply(state):
+                    st = state._replace(
+                        used=state.used.at[n].set(new_used),
+                        pods=state.pods.at[n].add(k),
+                        allow=state.allow.at[n].set(m_allow),
+                        out=state.out.at[n].set(m_out),
+                        defined=state.defined.at[n].set(m_defined),
+                        tmask=state.tmask.at[n].set(new_tmask),
+                        cap=state.cap.at[n].set(new_cap),
+                    )
+                    return record_topo(
+                        st, prow, m_allow, m_out, m_defined, well_known, topo_terms,
+                        onehot, jnp.where(onehot, k, 0),
+                    )
+
+                state = jax.lax.cond(do, apply, lambda s: s, state)
+                log, ptr = log_write(log, ptr, do, i, n, 1, k, k)
+                remaining = remaining - jnp.where(do, k, 0)
+                # committed-to-capacity or failed either way: move to next slot
+                score = score.at[n].set(BIG)
+                return state, log, ptr, remaining, score, jnp.bool_(False)
+
+            # -- open branch: bulk-open s fresh slots, m replicas each ----
+            def do_open(carry):
+                state, log, ptr, remaining, score, _ = carry
+                cap_ok = jnp.all(
+                    type_capacity[None, :, :] <= state.remaining[:, None, :], axis=-1
+                )  # [J, T]
+                viab, allows, outs, defs, compats, kcaps, ktopos = (
+                    [], [], [], [], [], [], []
+                )
+                for j in range(J):  # static unroll — J is the provisioner count
+                    fresh_allow = tmpl_reqs["allow"][j]
+                    if has_topo:
+                        tv, tnarrow, tkeys, k_topo_j = topo.topo_narrow_single(
+                            topo_meta, state.tcounts, state.thost, state.tdoms,
+                            prow["topo_own"], prow["topo_sel"], prow["allow"],
+                            fresh_allow, state.nopen, K,
+                        )
+                    else:
+                        tv = jnp.bool_(True)
+                        tnarrow = jnp.ones(V, dtype=bool)
+                        tkeys = jnp.zeros(K, dtype=bool)
+                        k_topo_j = BIGK
+                    m_allow_j = fresh_allow & prow["allow"] & tnarrow
+                    m_out_j = tmpl_reqs["out"][j] & prow["out"] & ~tkeys
+                    m_def_j = tmpl_reqs["defined"][j] | prow["defined"] | tkeys
+                    compat_j = merged_types_compat(
+                        m_allow_j, m_out_j, m_def_j,
+                        tmpl_type_mask[j] & cap_ok[j] & f_static_p[j],
+                        type_reqs, type_offering_ok,
+                    )
+                    kcap_j = replica_cap(
+                        type_alloc, tmpl_daemon[j][None, :], prow["requests"]
+                    )
+                    viab.append(tv & (compat_j & (kcap_j >= 1)).any())
+                    allows.append(m_allow_j)
+                    outs.append(m_out_j)
+                    defs.append(m_def_j)
+                    compats.append(compat_j)
+                    kcaps.append(kcap_j)
+                    ktopos.append(k_topo_j)
+                can_open_j = jnp.stack(viab) & openable[:, i]  # [J]
+                jc = jnp.argmax(can_open_j)
+                m_allow_o = jnp.stack(allows)[jc]
+                m_out_o = jnp.stack(outs)[jc]
+                m_def_o = jnp.stack(defs)[jc]
+                compat_o = jnp.stack(compats)[jc]  # [T]
+                kcap_o = jnp.stack(kcaps)[jc]  # [T]
+                k_topo_o = jnp.stack(ktopos)[jc]
+
+                # per-slot replica cap: capacity ∧ skew headroom
+                m_eff = jnp.minimum(
+                    jnp.max(jnp.where(compat_o, kcap_o, 0), initial=0), k_topo_o
+                )
+                m_eff = jnp.maximum(m_eff, 0)
+
+                # provisioner-limit slot budget via pessimistic max-capacity
+                # subtraction over the k>=1 type set (scheduler.go:276-293)
+                tmask_1 = compat_o & (kcap_o >= 1)
+                max_cap = jnp.where(tmask_1[:, None], type_capacity, -BIG).max(axis=0)
                 max_cap = jnp.maximum(max_cap, 0.0)
-                state = state._replace(
-                    used=state.used.at[n].set(opened_used),
-                    open=state.open.at[n].set(True),
-                    is_existing=state.is_existing.at[n].set(False),
-                    tmpl=state.tmpl.at[n].set(j_choice.astype(jnp.int32)),
-                    tol_idx=state.tol_idx.at[n].set(j_choice.astype(jnp.int32)),
-                    pods=state.pods.at[n].set(1),
-                    allow=state.allow.at[n].set(opened_allow),
-                    out=state.out.at[n].set(opened_out),
-                    defined=state.defined.at[n].set(opened_defined),
-                    tmask=state.tmask.at[n].set(new_tmask),
-                    cap=state.cap.at[n].set(opened_cap),
-                    nopen=state.nopen + 1,
-                    remaining=state.remaining.at[j_choice].add(-max_cap),
+                lim = state.remaining[jc]  # [R]
+                s_lim_r = jnp.where(
+                    max_cap > 0, jnp.floor(lim / jnp.where(max_cap > 0, max_cap, 1.0)),
+                    jnp.float32(BIGK),
                 )
-                return record_topo(
-                    state, prow, opened_allow, opened_out, opened_defined,
-                    well_known, topo_terms, n,
+                s_limit = jnp.clip(s_lim_r.min(), 0.0, jnp.float32(BIGK)).astype(jnp.int32)
+
+                s_need = (remaining + jnp.maximum(m_eff, 1) - 1) // jnp.maximum(m_eff, 1)
+                s = jnp.minimum(jnp.minimum(s_need, N - state.nopen), s_limit)
+                if has_topo:
+                    # a hostname-affinity owner's replicas must co-locate on
+                    # the seeded host: never bulk-open more than one fresh
+                    # slot for it (leftovers fail, as in the reference where
+                    # later replicas cannot join a full seeded node)
+                    own_hostaff = jnp.bool_(False)
+                    for g, gm in enumerate(topo_meta.groups):
+                        if (
+                            gm.is_hostname
+                            and gm.gtype == topo.TOPO_AFFINITY
+                            and not gm.is_inverse
+                        ):
+                            own_hostaff |= prow["topo_own"][g]
+                    s = jnp.where(own_hostaff, jnp.minimum(s, 1), s)
+                can = can_open_j.any() & (m_eff >= 1) & (s >= 1) & (ptr < L)
+                s = jnp.where(can, s, 0)
+
+                placed = jnp.minimum(remaining, s * m_eff)
+                k_last = placed - (s - 1) * m_eff
+                arange = jnp.arange(N)
+                rows = (arange >= state.nopen) & (arange < state.nopen + s)
+                last = arange == (state.nopen + s - 1)
+                k_row = jnp.where(rows, jnp.where(last, k_last, m_eff), 0)
+
+                tmask_full = compat_o & (kcap_o >= m_eff)
+                tmask_last = compat_o & (kcap_o >= k_last)
+                cap_full = _segment_max_alloc(tmask_full, type_alloc)
+                cap_last = _segment_max_alloc(tmask_last, type_alloc)
+                used_rows = (
+                    tmpl_daemon[jc][None, :]
+                    + k_row[:, None].astype(jnp.float32) * prow["requests"][None, :]
                 )
 
-            state = jax.lax.cond(
-                valid & found,
-                apply_found,
-                lambda s: jax.lax.cond(do_open, apply_open, lambda x: x, s),
-                state,
-            )
-            assigned = jnp.where(do_assign, slot, jnp.int32(-1))
-            return state, assigned
+                def apply(state):
+                    rm = rows[:, None]
+                    lastm = (rows & last)[:, None]
+                    st = state._replace(
+                        used=jnp.where(rm, used_rows, state.used),
+                        open=state.open | rows,
+                        is_existing=state.is_existing & ~rows,
+                        tmpl=jnp.where(rows, jc.astype(jnp.int32), state.tmpl),
+                        tol_idx=jnp.where(rows, jc.astype(jnp.int32), state.tol_idx),
+                        pods=jnp.where(rows, k_row, state.pods),
+                        allow=jnp.where(rm, m_allow_o[None, :], state.allow),
+                        out=jnp.where(rm, m_out_o[None, :], state.out),
+                        defined=jnp.where(rm, m_def_o[None, :], state.defined),
+                        tmask=jnp.where(
+                            lastm, tmask_last[None, :],
+                            jnp.where(rm, tmask_full[None, :], state.tmask),
+                        ),
+                        cap=jnp.where(
+                            lastm, cap_last[None, :],
+                            jnp.where(rm, cap_full[None, :], state.cap),
+                        ),
+                        nopen=state.nopen + s,
+                        remaining=state.remaining
+                        - (jnp.arange(J) == jc)[:, None]
+                        * s.astype(jnp.float32)
+                        * max_cap[None, :],
+                    )
+                    return record_topo(
+                        st, prow, m_allow_o, m_out_o, m_def_o, well_known, topo_terms,
+                        rows, k_row,
+                    )
 
-        state, assigned = jax.lax.scan(step, state, jnp.arange(P, dtype=jnp.int32))
-        return state, assigned
+                state = jax.lax.cond(can, apply, lambda st: st, state)
+                log, ptr = log_write(log, ptr, can, i, state.nopen - s, s, m_eff, k_last)
+                remaining = remaining - jnp.where(can, placed, 0)
+                return state, log, ptr, remaining, score, ~can
+
+            def cond_fn(carry):
+                _, _, _, remaining, _, exhausted = carry[0], carry[1], carry[2], carry[3], carry[4], carry[5]
+                tries = carry[6]
+                return (remaining > 0) & (~exhausted) & (tries < count + max_verify_tries)
+
+            def body_fn(carry):
+                inner = carry[:6]
+                tries = carry[6]
+                score = carry[4]
+                has_cand = score.min() < BIG
+                inner = jax.lax.cond(has_cand, do_candidate, do_open, inner)
+                return inner + (tries + 1,)
+
+            remaining0 = jnp.where(valid, count, 0)
+            carry0 = (state, log, ptr, remaining0, score0, jnp.bool_(False), jnp.int32(0))
+            state, log, ptr, _, _, _, _ = jax.lax.while_loop(cond_fn, body_fn, carry0)
+            return (state, log, ptr), None
+
+        (state, log, ptr), _ = jax.lax.scan(
+            step, (state, log0, jnp.int32(0)), jnp.arange(I, dtype=jnp.int32)
+        )
+        return state, log, ptr
 
     return pack

@@ -223,29 +223,44 @@ def topo_screen(meta: TopoMeta, tcounts, thost, tdoms, own, selp, pod_allow, slo
 
 def topo_narrow_single(meta: TopoMeta, tcounts, thost, tdoms, own, selp,
                        pod_allow, slot_allow_row, slot_n, n_keys: int):
-    """(viable, narrow[V], applied_keys[K]) for ONE candidate slot — the
-    exact committed domain choice (spread picks the argmin-count domain among
-    the slot's viable domains; topologygroup.go:155-182). The returned
+    """(viable, narrow[V], applied_keys[K], k_cap) for ONE candidate slot —
+    the exact committed domain choice (spread picks the argmin-count domain
+    among the slot's viable domains; topologygroup.go:155-182). The returned
     applied_keys mark keys that become DEFINED concrete In-sets on the merged
     requirements (AddRequirements adds them, topology.go:149-167). Hostname
-    groups evaluate on the slot's identity and narrow nothing."""
+    groups evaluate on the slot's identity and narrow nothing.
+
+    k_cap (int32) bounds how many IDENTICAL replicas of this pod the slot can
+    take while every one of them individually satisfies the reference's
+    viability rule — the skew headroom of owned hostname-spread groups
+    (min-count pinned to 0, topologygroup.go:186-188). Owned value-key spread
+    and anti-affinity classes are expanded to count=1 items at encode, so
+    they never consume k_cap > 1."""
     import jax.numpy as jnp
 
     V = slot_allow_row.shape[0]
     viable = jnp.bool_(True)
     narrow = jnp.ones(V, dtype=bool)
     applied_keys = jnp.zeros(n_keys, dtype=bool)
+    k_cap = jnp.int32(2**30)
     for g, gm in enumerate(meta.groups):
         applies = selp[g] if gm.is_inverse else own[g]
         if gm.is_hostname:
             hc = thost[g, slot_n]
             if gm.gtype == TOPO_SPREAD:
                 g_viable = hc + selp[g].astype(jnp.float32) <= gm.max_skew
+                headroom = jnp.maximum(
+                    jnp.float32(gm.max_skew) - hc, 0.0
+                ).astype(jnp.int32)
+                k_cap = jnp.where(
+                    applies & selp[g], jnp.minimum(k_cap, headroom), k_cap
+                )
             elif gm.gtype == TOPO_AFFINITY:
                 has_pos = (thost[g] > 0.5).any()
                 g_viable = jnp.where(has_pos, hc > 0.5, selp[g])
             else:
                 g_viable = hc < 0.5
+                k_cap = jnp.where(applies, jnp.minimum(k_cap, 1), k_cap)
             viable &= ~applies | g_viable
             continue
         lo, hi = gm.seg
@@ -272,11 +287,15 @@ def topo_narrow_single(meta: TopoMeta, tcounts, thost, tdoms, own, selp,
         else:
             g_narrow = pod_dom & doms & (cnt < 0.5)
             g_viable = (g_narrow & sallow).any()
+            k_cap = jnp.where(applies, jnp.minimum(k_cap, 1), k_cap)
+        if gm.gtype == TOPO_SPREAD:
+            # owned value-key spread items are expanded at encode; cap anyway
+            k_cap = jnp.where(applies & selp[g], jnp.minimum(k_cap, 1), k_cap)
         viable &= ~applies | g_viable
         seg_new = jnp.where(applies, narrow[lo:hi] & g_narrow, narrow[lo:hi])
         narrow = narrow.at[lo:hi].set(seg_new)
         applied_keys = applied_keys.at[gm.key_k].max(applies)
-    return viable, narrow, applied_keys
+    return viable, narrow, applied_keys, k_cap
 
 
 def topo_record(
@@ -289,20 +308,25 @@ def topo_record(
     nf_ok,
     m_allow,
     m_out,
-    slot_n,
+    row_mask,
+    k_row,
 ):
-    """Commit a placement into counts (topology.go:120-143).
+    """Commit a (possibly bulk) placement into counts (topology.go:120-143).
 
     nf_ok[G]: node-filter match of the group vs the merged slot requirements.
-    m_allow/m_out: the committed slot's merged requirement masks.
+    m_allow/m_out: the committed merged requirement masks (identical for every
+    committed slot — bulk commits write one merged row to a range of slots).
+    row_mask[N]: slots written; k_row[N]: replicas placed per slot.
     Returns (new_counts, new_hcounts, new_domain_mask)."""
     import jax.numpy as jnp
 
+    k_row_f = jnp.where(row_mask, k_row, 0).astype(jnp.float32)
+    placed_total = k_row_f.sum()
     for g, gm in enumerate(meta.groups):
         if gm.is_hostname:
-            # the slot IS the (singleton) hostname domain
+            # each slot IS its (singleton) hostname domain
             rec = own[g] if gm.is_inverse else (selp[g] & nf_ok[g])
-            thost = thost.at[g, slot_n].add(rec.astype(jnp.float32))
+            thost = thost.at[g].add(jnp.where(rec, k_row_f, 0.0))
             continue
         lo, hi = gm.seg
         allow_seg = m_allow[lo:hi]
@@ -320,7 +344,7 @@ def topo_record(
             else:
                 singleton = (~out_k) & (allow_seg.sum() == 1)
                 delta = allow_seg & singleton
-        inc = (rec & delta).astype(jnp.float32)
+        inc = (rec & delta).astype(jnp.float32) * placed_total
         tcounts = tcounts.at[g, lo:hi].add(inc)
         tdoms = tdoms.at[g, lo:hi].set(tdoms[g, lo:hi] | (rec & delta))
     return tcounts, thost, tdoms

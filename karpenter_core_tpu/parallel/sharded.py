@@ -40,7 +40,8 @@ def make_sharded_solve(snap, provisioners, mesh, max_nodes_per_shard: int = 256)
     from karpenter_core_tpu.solver.tpu_solver import device_args, solve_geometry
 
     geom = solve_geometry(snap, max_nodes_per_shard)
-    _, J, T, E, R, K, V, _, segments_t, zone_seg, ct_seg, _topo_sig = geom
+    (_, J, T, E, R, K, V, _, segments_t, zone_seg, ct_seg, _topo_sig,
+     log_len) = geom
     assert E == 0, "sharded solve packs new machines only (existing nodes are host-side)"
     assert snap.topo_meta is None, (
         "sharded solve requires a topology-free batch: domain counts are "
@@ -100,7 +101,7 @@ def make_sharded_solve(snap, provisioners, mesh, max_nodes_per_shard: int = 256)
         pod_arrays["tol"] = pod_tol_all
         tmpl_type_mask = jax.lax.all_gather(tmpl_type_mask_l, "tp", axis=2, tiled=False)
         tmpl_type_mask = jnp.moveaxis(tmpl_type_mask, 2, 1).reshape(J, -1)
-        state, assigned = pack(
+        state, log, ptr = pack(
             state,
             pod_arrays,
             f_static,
@@ -112,12 +113,13 @@ def make_sharded_solve(snap, provisioners, mesh, max_nodes_per_shard: int = 256)
             type_alloc,
             type_capacity,
             type_offering_ok,
+            log_len=log_len,
         )
         # global stats via psum over dp: pods scheduled (an ICI collective)
-        scheduled = jax.lax.psum((assigned >= 0).sum(), "dp")
+        scheduled = jax.lax.psum(state.pods.sum(), "dp")
         # rank-0 per-shard values need a singleton axis to concatenate over dp
         state = state._replace(nopen=state.nopen[None])
-        return assigned, state, scheduled
+        return log, ptr[None], state, scheduled
 
     pod_spec = {
         "allow": P("dp", None),
@@ -128,6 +130,7 @@ def make_sharded_solve(snap, provisioners, mesh, max_nodes_per_shard: int = 256)
         "requests": P("dp", None),
         "tol_tmpl": P("dp", None),
         "valid": P("dp"),
+        "count": P("dp"),
     }
     reqset_rep = {k: P(None, None) for k in ("allow", "out", "defined", "escape")}
     reqset_tp = {k: P("tp", None) for k in ("allow", "out", "defined", "escape")}
@@ -147,7 +150,8 @@ def make_sharded_solve(snap, provisioners, mesh, max_nodes_per_shard: int = 256)
         P(None, None),  # remaining0
     )
     out_specs = (
-        P("dp"),  # assigned
+        {k: P("dp") for k in ("item", "slot", "ns", "k", "k_last")},  # commit log
+        P("dp"),  # log ptr (singleton axis per shard)
         PackState(
             used=P("dp", None),
             open=P("dp"),
@@ -177,6 +181,19 @@ def make_sharded_solve(snap, provisioners, mesh, max_nodes_per_shard: int = 256)
     (pod_arrays, tmpl, tmpl_daemon, tmpl_type_mask, types, type_alloc,
      type_capacity, type_offering_ok, pod_tol_all, _exist, _eu, _ec,
      well_known, remaining0, _tc, _th, _td, _tt) = base_args
+    # pad the ITEM axis to a multiple of dp (classes collapse identical pods,
+    # so the item count is not under the caller's control); padded rows are
+    # invalid with count 0 and never place anything
+    I = pod_arrays["requests"].shape[0]
+    pad = (-I) % ndp
+    if pad:
+        def padded(a):
+            widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
+            return np.pad(a, widths)
+
+        pod_arrays = {k: padded(v) for k, v in pod_arrays.items()}
+        pod_arrays["valid"][I:] = False
+        pod_tol_all = padded(pod_tol_all)
     args = (
         pod_arrays,
         tmpl,

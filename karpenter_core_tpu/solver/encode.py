@@ -187,6 +187,18 @@ class EncodedSnapshot:
     topo_arrays: object = None  # ops.topology.TopoArrays
     n_slots: int = 0  # E + machine slot budget (hostname identity width)
 
+    # pod equivalence classes ("items") — the packing scan's work axis.
+    # Pods with identical constraint rows collapse into one item with a
+    # count; the kernel commits whole replica groups per step instead of one
+    # pod (real batches are deployment-dominated, so this shrinks the
+    # sequential axis 10-100x). Owned value-key-spread / anti-affinity
+    # classes are expanded back to count=1 items to keep the reference's
+    # per-pod domain-choice semantics exact.
+    item_of_pod: np.ndarray = None  # [P] int32 item index per (sorted) pod
+    item_counts: np.ndarray = None  # [I] int32
+    item_rep: np.ndarray = None  # [I] int32 representative pod row
+    item_members: List[List[int]] = None  # host: pod rows per item, in order
+
     # host-side back-references for decode
     instance_types: List[InstanceType] = field(default_factory=list)
     templates: List[MachineTemplate] = field(default_factory=list)
@@ -401,10 +413,16 @@ def encode_snapshot(
         [n.hostname() for n in state_nodes],
     )
 
+    # -- pod equivalence classes (items) -----------------------------------
+    pod_reqs_arr = encode_reqsets(pod_reqs_list, dictionary)
+    item_of_pod, item_counts, item_rep, item_members = _build_items(
+        pod_reqs_arr, pod_requests, pod_tol, pod_tol_exist, topo_meta, topo_arrays
+    )
+
     return EncodedSnapshot(
         dictionary=dictionary,
         resource_names=resource_names,
-        pod_reqs=encode_reqsets(pod_reqs_list, dictionary),
+        pod_reqs=pod_reqs_arr,
         pod_requests=pod_requests,
         pod_tol=pod_tol,
         tmpl_reqs=encode_reqsets(tmpl_reqs_list, dictionary),
@@ -426,9 +444,86 @@ def encode_snapshot(
         topo_meta=topo_meta,
         topo_arrays=topo_arrays,
         n_slots=n_slots,
+        item_of_pod=item_of_pod,
+        item_counts=item_counts,
+        item_rep=item_rep,
+        item_members=item_members,
         instance_types=all_types,
         templates=templates,
         pods=pods_sorted,
         state_nodes=state_nodes,
         pod_order=order,
+    )
+
+
+def _build_items(pod_reqs, pod_requests, pod_tol, pod_tol_exist, topo_meta, topo_arrays):
+    """Group FFD-sorted pods into equivalence classes ("items") by their full
+    constraint encoding. Classes owning a value-key topology-spread or an
+    anti-affinity group are expanded back to count=1 items: their per-pod
+    domain choice mutates group counts between placements (the reference
+    re-evaluates per pod, scheduler.go:96-133). Hostname-spread / affinity
+    owners stay bulk — the kernel's skew-headroom cap and per-commit narrow
+    reproduce the per-pod outcome for identical replicas.
+
+    Returns (item_of_pod [P], item_counts [I], item_rep [I], members)."""
+    from karpenter_core_tpu.ops.topology import TOPO_ANTI, TOPO_SPREAD
+
+    P = pod_requests.shape[0]
+    if P == 0:
+        return (
+            np.zeros(0, np.int32),
+            np.zeros(0, np.int32),
+            np.zeros(0, np.int32),
+            [],
+        )
+    parts = [
+        np.ascontiguousarray(pod_reqs.allow).view(np.uint8).reshape(P, -1),
+        np.ascontiguousarray(pod_reqs.out).view(np.uint8).reshape(P, -1),
+        np.ascontiguousarray(pod_reqs.defined).view(np.uint8).reshape(P, -1),
+        np.ascontiguousarray(pod_reqs.escape).view(np.uint8).reshape(P, -1),
+        np.ascontiguousarray(pod_requests).view(np.uint8).reshape(P, -1),
+        np.ascontiguousarray(pod_tol).view(np.uint8).reshape(P, -1),
+        np.ascontiguousarray(pod_tol_exist).view(np.uint8).reshape(P, -1),
+    ]
+    expand_pod = np.zeros(P, dtype=bool)
+    if topo_meta is not None:
+        owner = topo_arrays.owner  # [G, P]
+        sel = topo_arrays.sel
+        parts.append(np.ascontiguousarray(owner.T).view(np.uint8).reshape(P, -1))
+        parts.append(np.ascontiguousarray(sel.T).view(np.uint8).reshape(P, -1))
+        for g, gm in enumerate(topo_meta.groups):
+            if gm.gtype == TOPO_ANTI or (
+                gm.gtype == TOPO_SPREAD and not gm.is_hostname
+            ):
+                applies = sel[g] if gm.is_inverse else owner[g]
+                expand_pod |= applies
+    sig = np.concatenate(parts, axis=1)
+    keys = {}
+    item_of_pod = np.zeros(P, dtype=np.int32)
+    counts: List[int] = []
+    reps: List[int] = []
+    members: List[List[int]] = []
+    for i in range(P):
+        if expand_pod[i]:
+            item = len(counts)
+            counts.append(1)
+            reps.append(i)
+            members.append([i])
+        else:
+            key = sig[i].tobytes()
+            item = keys.get(key)
+            if item is None:
+                item = len(counts)
+                keys[key] = item
+                counts.append(0)
+                reps.append(i)
+                members.append([])
+            counts[item] += 1
+            members[item].append(i)
+        item_of_pod[i] = item
+    return (
+        item_of_pod,
+        np.asarray(counts, dtype=np.int32),
+        np.asarray(reps, dtype=np.int32),
+        members,
     )

@@ -29,6 +29,7 @@ from karpenter_core_tpu.solver.tpu_solver import (
     decode_solve,
     device_args,
     make_device_run,
+    solve_geometry,
 )
 
 SERVICE = "karpenter.solver.v1.Solver"
@@ -109,6 +110,7 @@ def geometry_json(snap) -> str:
             "zone_seg": list(snap.zone_seg),
             "ct_seg": list(snap.ct_seg),
             "n_slots": snap.n_slots,
+            "log_len": solve_geometry(snap, 0)[-1],
             "topo_groups": topo,
         }
     )
@@ -170,15 +172,18 @@ class SolverService:
             if fn is None:
                 fn = jax.jit(
                     make_device_run(
-                        segments, zone_seg, ct_seg, topo_meta, geometry["n_slots"]
+                        segments, zone_seg, ct_seg, topo_meta, geometry["n_slots"],
+                        log_len=geometry.get("log_len"),
                     )
                 )
                 with self._mu:
                     self._compiled[key] = fn
                     while len(self._compiled) > self.MAX_COMPILED:
                         self._compiled.popitem(last=False)
-            assigned, state = fn(*args)
-            out = [tensor_to_pb("assigned", np.asarray(assigned))]
+            log, ptr, state = fn(*args)
+            out = [tensor_to_pb("ptr", np.asarray(ptr))]
+            for name, value in log.items():
+                out.append(tensor_to_pb(f"log/{name}", np.asarray(value)))
             for field, value in state._asdict().items():
                 out.append(tensor_to_pb(f"state/{field}", np.asarray(value)))
             with self._mu:
@@ -292,11 +297,12 @@ class RemoteSolver:
         if response.error:
             raise RuntimeError(f"solver service error: {response.error}")
         tensors = {t.name: tensor_from_pb(t) for t in response.tensors}
-        assigned = tensors["assigned"]
+        ptr = int(np.asarray(tensors["ptr"]).reshape(-1)[0])
+        log = {k[len("log/"):]: v for k, v in tensors.items() if k.startswith("log/")}
         state = _StateView(
             {k[len("state/"):]: v for k, v in tensors.items() if k.startswith("state/")}
         )
-        return decode_solve(snap, assigned, state)
+        return decode_solve(snap, (log, ptr), state)
 
 
 class _StateView:

@@ -95,7 +95,7 @@ def solve_with_relaxation(solve_once, pods, provisioners, instance_types,
 def solve_geometry(snap: EncodedSnapshot, max_nodes: int):
     dictionary = snap.dictionary
     segments = [dictionary.segment(k) for k in dictionary.keys]
-    P = len(snap.pods)
+    P = len(snap.item_counts) if snap.item_counts is not None else len(snap.pods)
     J = len(snap.templates)
     T = len(snap.instance_types)
     E = len(snap.state_nodes)
@@ -111,10 +111,19 @@ def solve_geometry(snap: EncodedSnapshot, max_nodes: int):
              tuple(g.filter_term_rows))
             for g in snap.topo_meta.groups
         )
-    return (P, J, T, E, R, K, V, N, tuple(segments), snap.zone_seg, snap.ct_seg, topo_sig)
+    # commit-log capacity: total pods rounded to a power-of-two bucket so
+    # repeat solves at nearby batch sizes reuse the compiled program
+    log_len = 128
+    while log_len < len(snap.pods) + 64:
+        log_len *= 2
+    return (
+        P, J, T, E, R, K, V, N, tuple(segments), snap.zone_seg, snap.ct_seg,
+        topo_sig, log_len,
+    )
 
 
-def make_device_run(segments, zone_seg, ct_seg, topo_meta, n_slots):
+def make_device_run(segments, zone_seg, ct_seg, topo_meta, n_slots,
+                    log_len: Optional[int] = None):
     """Build the jittable device program — the whole Solve() as ONE program:
     feasibility + openable + packing scan. Pure function of the device arrays
     produced by device_args(); all dims except n_slots derive from shapes.
@@ -174,7 +183,7 @@ def make_device_run(segments, zone_seg, ct_seg, topo_meta, n_slots):
         )
         pod_arrays = dict(pod_arrays)
         pod_arrays["tol"] = pod_tol_all
-        state, assigned = pack(
+        state, log, ptr = pack(
             state,
             pod_arrays,
             f_static,
@@ -188,8 +197,9 @@ def make_device_run(segments, zone_seg, ct_seg, topo_meta, n_slots):
             type_offering_ok,
             well_known=well_known,
             topo_terms=topo_terms,
+            log_len=log_len,
         )
-        return assigned, state
+        return log, ptr, state
 
     return run
 
@@ -197,31 +207,47 @@ def make_device_run(segments, zone_seg, ct_seg, topo_meta, n_slots):
 def build_device_solve(snap: EncodedSnapshot, max_nodes: int = 1024):
     """Returns (geometry_key, run_fn) for a snapshot's geometry."""
     geom = solve_geometry(snap, max_nodes)
-    _P, _J, _T, _E, _R, _K, _V, N, segments_t, zone_seg, ct_seg, _topo_sig = geom
-    run = make_device_run(segments_t, zone_seg, ct_seg, snap.topo_meta, N)
+    (_P, _J, _T, _E, _R, _K, _V, N, segments_t, zone_seg, ct_seg, _topo_sig,
+     log_len) = geom
+    run = make_device_run(
+        segments_t, zone_seg, ct_seg, snap.topo_meta, N, log_len=log_len
+    )
     return geom, run
 
 
 def device_args(snap: EncodedSnapshot, provisioners: Optional[List[Provisioner]] = None):
-    """Host arrays (numpy) in run_fn's argument order."""
+    """Host arrays (numpy) in run_fn's argument order. The work axis is the
+    ITEM (pod equivalence class) axis: rows are gathered through
+    snap.item_rep and each carries its replica count."""
     provisioners = provisioners or []
-    P = len(snap.pods)
     J = len(snap.templates)
+    rep = (
+        snap.item_rep
+        if snap.item_rep is not None
+        else np.arange(len(snap.pods), dtype=np.int32)
+    )
+    counts = (
+        snap.item_counts
+        if snap.item_counts is not None
+        else np.ones(len(snap.pods), dtype=np.int32)
+    )
+    I = len(rep)
     custom_deny = ~snap.well_known[None, :] & snap.pod_reqs.defined & ~snap.pod_reqs.escape
     pod_arrays = {
-        "allow": snap.pod_reqs.allow,
-        "out": snap.pod_reqs.out,
-        "defined": snap.pod_reqs.defined,
-        "escape": snap.pod_reqs.escape,
-        "custom_deny": custom_deny,
-        "requests": snap.pod_requests,
-        "tol_tmpl": snap.pod_tol,
-        "valid": np.ones(P, dtype=bool),
+        "allow": snap.pod_reqs.allow[rep],
+        "out": snap.pod_reqs.out[rep],
+        "defined": snap.pod_reqs.defined[rep],
+        "escape": snap.pod_reqs.escape[rep],
+        "custom_deny": custom_deny[rep],
+        "requests": snap.pod_requests[rep],
+        "tol_tmpl": snap.pod_tol[rep],
+        "valid": np.ones(I, dtype=bool),
+        "count": counts.astype(np.int32),
     }
     if snap.topo_meta is not None:
-        pod_arrays["topo_own"] = snap.topo_arrays.owner.T.copy()  # [P, G]
-        pod_arrays["topo_sel"] = snap.topo_arrays.sel.T.copy()
-    pod_tol_all = np.concatenate([snap.pod_tol, snap.pod_tol_exist], axis=1)
+        pod_arrays["topo_own"] = snap.topo_arrays.owner.T[rep].copy()  # [I, G]
+        pod_arrays["topo_sel"] = snap.topo_arrays.sel.T[rep].copy()
+    pod_tol_all = np.concatenate([snap.pod_tol, snap.pod_tol_exist], axis=1)[rep]
 
     # provisioner limits -> remaining resources [J, R] (scheduler.go:70-75)
     remaining0 = np.full((J, len(snap.resource_names)), np.float32(1e30))
@@ -332,8 +358,8 @@ class TPUSolver:
             pods, provisioners, instance_types, daemonset_pods, state_nodes,
             kube_client=kube_client, cluster=cluster, max_nodes=self.max_nodes,
         )
-        assigned, state = self._run_kernels(snap, provisioners)
-        return decode_solve(snap, assigned, state)
+        log, ptr, state = self._run_kernels(snap, provisioners)
+        return decode_solve(snap, (log, ptr), state)
 
     def _run_kernels(self, snap: EncodedSnapshot, provisioners: List[Provisioner]):
         import jax
@@ -344,12 +370,53 @@ class TPUSolver:
             fn = jax.jit(run)
             self._compiled[geom] = fn
         args = device_args(snap, provisioners)
-        assigned, state = fn(*args)
-        return np.asarray(assigned), jax.tree_util.tree_map(np.asarray, state)
+        log, ptr, state = fn(*args)
+        return (
+            {k: np.asarray(v) for k, v in log.items()},
+            int(ptr),
+            jax.tree_util.tree_map(np.asarray, state),
+        )
 
-def decode_solve(snap: EncodedSnapshot, assigned: np.ndarray, state) -> SolveResult:
-    """Slot assignments + final slot state -> SolveResult (shared by the
-    in-process TPUSolver and the gRPC RemoteSolver client)."""
+def expand_log(snap: EncodedSnapshot, log, ptr: int) -> np.ndarray:
+    """Replay the kernel's commit log into a per-pod slot assignment [P]
+    (-1 = unscheduled). Entry e places ns slots starting at slot, k replicas
+    per slot (k_last on the final slot), consuming item e.item's member pods
+    in order."""
+    P = len(snap.pods)
+    assigned = np.full(P, -1, dtype=np.int64)
+    members = snap.item_members or [[i] for i in range(P)]
+    cursor = [0] * len(members)
+    items = np.asarray(log["item"])
+    slots = np.asarray(log["slot"])
+    nss = np.asarray(log["ns"])
+    ks = np.asarray(log["k"])
+    k_lasts = np.asarray(log["k_last"])
+    for e in range(int(ptr)):
+        item = int(items[e])
+        if item < 0:
+            continue
+        mem = members[item]
+        ns, k, k_last = int(nss[e]), int(ks[e]), int(k_lasts[e])
+        for s in range(ns):
+            take = k_last if s == ns - 1 else k
+            lo = cursor[item]
+            hi = min(lo + take, len(mem))
+            for m in mem[lo:hi]:
+                assigned[m] = slots[e] + s
+            cursor[item] = hi
+    return assigned
+
+
+def decode_solve(snap: EncodedSnapshot, placements, state) -> SolveResult:
+    """Placements + final slot state -> SolveResult (shared by the in-process
+    TPUSolver, the gRPC RemoteSolver client, and the native packer).
+    `placements` is either a (commit log, ptr) pair from the device kernel or
+    a per-pod assigned array [P] (native path)."""
+    if isinstance(placements, tuple):
+        log, ptr = placements
+        assigned = expand_log(snap, log, ptr)
+    else:
+        assigned = placements
     E = len(snap.state_nodes)
     slot_pods: Dict[int, List[Pod]] = {}
     failed: List[Pod] = []
