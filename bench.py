@@ -21,6 +21,8 @@ N_PODS = int(os.environ.get("BENCH_PODS", "2000"))
 N_TYPES = int(os.environ.get("BENCH_TYPES", "100"))
 N_RUNS = int(os.environ.get("BENCH_RUNS", "5"))
 MIX = os.environ.get("BENCH_MIX", "reference")  # reference | plain
+# node-slot budget: hostname-spread pods (1/7 of the mix) need a slot each
+MAX_NODES = int(os.environ.get("BENCH_NODES", str(max(1024, N_PODS // 4))))
 
 
 def _reference_mix(n_pods: int, n_types: int):
@@ -94,10 +96,10 @@ def main():
         pods, provisioners, instance_types = _reference_mix(N_PODS, N_TYPES)
     else:
         pods, provisioners, instance_types = _scenario(N_PODS, N_TYPES)
-    snap = encode_snapshot(pods, provisioners, instance_types)
+    snap = encode_snapshot(pods, provisioners, instance_types, max_nodes=MAX_NODES)
     encode_s = time.perf_counter() - t0
 
-    _, run = build_device_solve(snap, max_nodes=1024)
+    _, run = build_device_solve(snap, max_nodes=MAX_NODES)
     args = device_args(snap, provisioners)
     fn = jax.jit(run)
 

@@ -71,7 +71,6 @@ def make_pack_kernel(
     segments,
     zone_seg,
     ct_seg,
-    max_verify_tries: int = 16,
     topo_meta: Optional[topo.TopoMeta] = None,
 ):
     """Build the jittable packing fn for a fixed label geometry (+ topology
@@ -80,6 +79,18 @@ def make_pack_kernel(
     zlo, zhi = zone_seg
     clo, chi = ct_seg
     has_topo = topo_meta is not None and len(topo_meta.groups) > 0
+    # value-key spread groups: bulk items owning one are packed by a
+    # per-iteration water-fill domain allocation (greedy argmin-count per pod
+    # equalizes domain counts, so the bulk final state matches per-pod greedy)
+    vk_spread_gs = (
+        [
+            (g, gm)
+            for g, gm in enumerate(topo_meta.groups)
+            if gm.gtype == topo.TOPO_SPREAD and not gm.is_hostname
+        ]
+        if has_topo
+        else []
+    )
     seg_mat = None  # [V, K] built lazily at trace time (V known from arrays)
 
     def _seg_mat(V):
@@ -181,7 +192,7 @@ def make_pack_kernel(
         return jnp.where(valid, kmin, 0)
 
     def verify_slot(state: PackState, prow, n, type_reqs, type_alloc,
-                    type_offering_ok, f_static_p):
+                    type_offering_ok, f_static_p, spread_force=None):
         """Exact acceptance check on slot n.
         Returns (ok, compat_tmask[T], kcap_t[T], kmax, narrow[V], applied[K]).
         kmax = max identical replicas slot n can take (capacity ∧ owned
@@ -192,6 +203,7 @@ def make_pack_kernel(
             t_viable, narrow, applied_keys, k_topo = topo.topo_narrow_single(
                 topo_meta, state.tcounts, state.thost, state.tdoms,
                 prow["topo_own"], prow["topo_sel"], prow["allow"], slot_allow, n, K,
+                spread_force=spread_force,
             )
         else:
             t_viable = jnp.bool_(True)
@@ -256,11 +268,13 @@ def make_pack_kernel(
         I = item_arrays["requests"].shape[0]
         V = state.allow.shape[1]
         K = state.out.shape[1]
-        # commit-log budget: every logged entry commits >= 1 replica, so the
-        # total pod count (+ slack) is a true bound. Callers that know it pass
-        # log_len; commits are additionally gated on log space so an
-        # undersized log fails pods cleanly instead of placing them unlogged.
-        L = log_len if log_len is not None else (I + 2 * N + 64)
+        # commit-log budget: every logged entry commits >= 1 replica, so
+        # total pod count (+ slack) is the true bound — callers that know it
+        # pass log_len (solve_geometry computes it). The fallback is a
+        # heuristic only; commits are gated on log space either way, so an
+        # undersized log fails the overflow pods cleanly instead of placing
+        # them unlogged.
+        L = log_len if log_len is not None else (4 * (I + N) + 64)
 
         log0 = {
             "item": jnp.full(L, -1, jnp.int32),
@@ -324,14 +338,117 @@ def make_pack_kernel(
 
             f_static_p = f_static[:, i, :]  # [J, T]
 
-            # -- candidate branch: verify best slot, commit k replicas ----
-            def do_candidate(carry):
-                state, log, ptr, remaining, score, _ = carry
-                n = jnp.argmin(score)
-                ok, compat_tmask, kcap_t, kmax, narrow, applied_keys = verify_slot(
-                    state, prow, n, type_reqs, type_alloc, type_offering_ok, f_static_p
+            def spread_plan(state, remaining, dead, score):
+                """Per-iteration water-fill targeting for owned value-key
+                spread groups: pick the argmin-count LIVE domain d* and cap
+                the commit at the final fill level minus d*'s count (the bulk
+                equivalent of greedy's per-pod argmin choice,
+                topologygroup.go:155-182).
+
+                A domain is live when it is still placeable: a current
+                candidate slot allows it or a fresh machine could open in it
+                (probed from the static feasibility and the types'/templates'
+                own value masks). Infeasible and retired domains are FROZEN:
+                their counts stop growing, so — exactly like the reference's
+                skew rule, where the global min pins every other domain to
+                min+maxSkew — commits into live domains are additionally
+                bounded by min(frozen counts) + max_skew
+                (topologygroup.go:155-182). With no frozen domain the final
+                water-fill level equalizes counts and the bound is slack.
+
+                Returns (force[V] domain mask, cap, blocked, gate[N] slots
+                allowing d*, dmark[V] domains to retire if placement in d*
+                proves impossible)."""
+                force = jnp.ones(V, dtype=bool)
+                cap = BIGK
+                blocked = jnp.bool_(False)
+                gate = jnp.ones(N, dtype=bool)
+                dmark = jnp.zeros(V, dtype=bool)
+                cands = score < BIG
+                for g, gm in vk_spread_gs:
+                    applies = prow["topo_own"][g]
+                    lo, hi = gm.seg
+                    pod_dom = prow["allow"][lo:hi] & state.tdoms[g, lo:hi]
+                    # feasibility probe per domain
+                    dom_cand = (cands[:, None] & state.allow[:, lo:hi]).any(axis=0)
+                    dom_open = jnp.zeros(hi - lo, dtype=bool)
+                    for j in range(J):
+                        f_j = f_static_p[j] & tmpl_type_mask[j]  # [T]
+                        type_dom = type_reqs["allow"][:, lo:hi]  # [T, seg]
+                        if (lo, hi) == (zlo, zhi):
+                            # zone spread: a zone is only openable if some
+                            # type has an AVAILABLE offering there for the
+                            # merged capacity types (types list unavailable
+                            # zones in their requirements too)
+                            ct_allow = (
+                                tmpl_reqs["allow"][j, clo:chi]
+                                & prow["allow"][clo:chi]
+                            )
+                            type_zone_ok = (
+                                jnp.einsum(
+                                    "tzc,c->tz",
+                                    type_offering_ok.astype(jnp.float32),
+                                    ct_allow.astype(jnp.float32),
+                                )
+                                > 0.5
+                            )
+                            type_dom = type_dom & type_zone_ok
+                        dom_open |= (
+                            openable[j, i]
+                            & tmpl_reqs["allow"][j, lo:hi]
+                            & (f_j[:, None] & type_dom).any(axis=0)
+                        )
+                    live = pod_dom & ~dead[lo:hi] & (dom_cand | dom_open)
+                    frozen = pod_dom & ~live
+                    cnt = state.tcounts[g, lo:hi]
+                    minc_frozen = jnp.min(
+                        jnp.where(frozen, cnt, jnp.inf), initial=jnp.inf
+                    )
+                    n_live = live.sum()
+                    level = (
+                        jnp.where(live, cnt, 0.0).sum()
+                        + remaining.astype(jnp.float32)
+                    ) / jnp.maximum(n_live, 1).astype(jnp.float32)
+                    cntm = jnp.where(live, cnt, jnp.inf)
+                    d_star = jnp.argmin(cntm)
+                    has_live = live.any()
+                    level_cap = jnp.maximum(jnp.floor(level - cntm[d_star]), 1.0)
+                    skew_cap = minc_frozen + jnp.float32(gm.max_skew) - cntm[d_star]
+                    cap_f = jnp.minimum(level_cap, skew_cap)
+                    skew_blocked = has_live & (cap_f < 1.0)
+                    cap_g = jnp.where(
+                        skew_blocked | ~has_live,
+                        0,
+                        jnp.clip(cap_f, 1.0, jnp.float32(BIGK)).astype(jnp.int32),
+                    )
+                    oh = (jnp.arange(hi - lo) == d_star) & has_live
+                    force = force.at[lo:hi].set(
+                        jnp.where(applies, oh, force[lo:hi])
+                    )
+                    dmark = dmark.at[lo:hi].set(
+                        jnp.where(applies, oh, dmark[lo:hi])
+                    )
+                    cap = jnp.where(applies, jnp.minimum(cap, cap_g), cap)
+                    blocked |= applies & (~has_live | skew_blocked)
+                    gate &= jnp.where(applies, state.allow[:, lo + d_star], True)
+                return force, cap, blocked, gate, dmark
+
+            owns_vk_spread = jnp.bool_(False)
+            for g, _gm in vk_spread_gs:
+                owns_vk_spread |= (
+                    prow["topo_own"][g] if has_topo else jnp.bool_(False)
                 )
-                k = jnp.minimum(remaining, kmax)
+
+            # -- candidate branch: verify best slot, commit k replicas ----
+            def do_candidate(args):
+                carry, force, cap, gate, _dmark = args
+                state, log, ptr, remaining, score, _, dead = carry
+                n = jnp.argmin(jnp.where(gate, score, BIG))
+                ok, compat_tmask, kcap_t, kmax, narrow, applied_keys = verify_slot(
+                    state, prow, n, type_reqs, type_alloc, type_offering_ok,
+                    f_static_p, spread_force=force if has_topo else None,
+                )
+                k = jnp.minimum(jnp.minimum(remaining, kmax), cap)
                 do = ok & (k >= 1) & (ptr < L)
 
                 m_allow = state.allow[n] & prow["allow"] & narrow
@@ -364,13 +481,17 @@ def make_pack_kernel(
                 state = jax.lax.cond(do, apply, lambda s: s, state)
                 log, ptr = log_write(log, ptr, do, i, n, 1, k, k)
                 remaining = remaining - jnp.where(do, k, 0)
-                # committed-to-capacity or failed either way: move to next slot
-                score = score.at[n].set(BIG)
-                return state, log, ptr, remaining, score, jnp.bool_(False)
+                # retire the slot on failure or when filled to capacity; a
+                # commit limited only by the water-fill cap leaves the slot
+                # available for a later fill round in the same domain
+                retire = (~do) | (k >= kmax)
+                score = score.at[n].set(jnp.where(retire, BIG, score[n]))
+                return state, log, ptr, remaining, score, jnp.bool_(False), dead
 
             # -- open branch: bulk-open s fresh slots, m replicas each ----
-            def do_open(carry):
-                state, log, ptr, remaining, score, _ = carry
+            def do_open(args):
+                carry, force, cap, _gate, dmark = args
+                state, log, ptr, remaining, score, _, dead = carry
                 cap_ok = jnp.all(
                     type_capacity[None, :, :] <= state.remaining[:, None, :], axis=-1
                 )  # [J, T]
@@ -383,7 +504,7 @@ def make_pack_kernel(
                         tv, tnarrow, tkeys, k_topo_j = topo.topo_narrow_single(
                             topo_meta, state.tcounts, state.thost, state.tdoms,
                             prow["topo_own"], prow["topo_sel"], prow["allow"],
-                            fresh_allow, state.nopen, K,
+                            fresh_allow, state.nopen, K, spread_force=force,
                         )
                     else:
                         tv = jnp.bool_(True)
@@ -435,7 +556,10 @@ def make_pack_kernel(
                 )
                 s_limit = jnp.clip(s_lim_r.min(), 0.0, jnp.float32(BIGK)).astype(jnp.int32)
 
-                s_need = (remaining + jnp.maximum(m_eff, 1) - 1) // jnp.maximum(m_eff, 1)
+                # the water-fill cap bounds how much of the item goes to the
+                # current forced domain this iteration
+                target = jnp.minimum(remaining, cap)
+                s_need = (target + jnp.maximum(m_eff, 1) - 1) // jnp.maximum(m_eff, 1)
                 s = jnp.minimum(jnp.minimum(s_need, N - state.nopen), s_limit)
                 if has_topo:
                     # a hostname-affinity owner's replicas must co-locate on
@@ -454,7 +578,7 @@ def make_pack_kernel(
                 can = can_open_j.any() & (m_eff >= 1) & (s >= 1) & (ptr < L)
                 s = jnp.where(can, s, 0)
 
-                placed = jnp.minimum(remaining, s * m_eff)
+                placed = jnp.minimum(target, s * m_eff)
                 k_last = placed - (s - 1) * m_eff
                 arange = jnp.arange(N)
                 rows = (arange >= state.nopen) & (arange < state.nopen + s)
@@ -505,24 +629,61 @@ def make_pack_kernel(
                 state = jax.lax.cond(can, apply, lambda st: st, state)
                 log, ptr = log_write(log, ptr, can, i, state.nopen - s, s, m_eff, k_last)
                 remaining = remaining - jnp.where(can, placed, 0)
-                return state, log, ptr, remaining, score, ~can
+                # freshly opened slots become candidates for this item's later
+                # fill rounds (e.g. the final water-fill remainder returns to
+                # a partially-filled machine instead of opening another)
+                score = jnp.where(
+                    rows & can,
+                    jnp.float32(N) + k_row.astype(jnp.float32) * N + arange,
+                    score,
+                )
+                # a spread owner that cannot place in the forced domain
+                # retires it and retries the next argmin domain; only a
+                # non-spread item (or one out of domains) is truly stuck
+                failed = ~can
+                dead = dead | (dmark & failed & owns_vk_spread)
+                exhausted = failed & ~owns_vk_spread
+                return state, log, ptr, remaining, score, exhausted, dead
 
             def cond_fn(carry):
-                _, _, _, remaining, _, exhausted = carry[0], carry[1], carry[2], carry[3], carry[4], carry[5]
-                tries = carry[6]
-                return (remaining > 0) & (~exhausted) & (tries < count + max_verify_tries)
+                remaining, exhausted, tries = carry[3], carry[5], carry[7]
+                # backstop only: commits consume `count`, failed verifies
+                # retire slots (<= N), open failures retire domains (<= V)
+                return (remaining > 0) & (~exhausted) & (tries < count + N + V + 64)
 
             def body_fn(carry):
-                inner = carry[:6]
-                tries = carry[6]
-                score = carry[4]
-                has_cand = score.min() < BIG
-                inner = jax.lax.cond(has_cand, do_candidate, do_open, inner)
-                return inner + (tries + 1,)
+                inner = carry[:7]
+                tries = carry[7]
+                state_c, remaining_c, score_c, dead_c = (
+                    carry[0], carry[3], carry[4], carry[6],
+                )
+                if vk_spread_gs:
+                    force, cap, blocked, gate, dmark = spread_plan(
+                        state_c, remaining_c, dead_c, score_c
+                    )
+                else:
+                    force = jnp.ones(V, dtype=bool)
+                    cap = BIGK
+                    blocked = jnp.bool_(False)
+                    gate = jnp.ones(N, dtype=bool)
+                    dmark = jnp.zeros(V, dtype=bool)
+                has_cand = jnp.where(gate, score_c, BIG).min() < BIG
+                args = (inner, force, cap, gate, dmark)
+                inner = jax.lax.cond(has_cand, do_candidate, do_open, args)
+                state_n, log_n, ptr_n, remaining_n, score_n, exhausted_n, dead_n = inner
+                return (
+                    state_n, log_n, ptr_n, remaining_n, score_n,
+                    exhausted_n | blocked, dead_n, tries + 1,
+                )
 
             remaining0 = jnp.where(valid, count, 0)
-            carry0 = (state, log, ptr, remaining0, score0, jnp.bool_(False), jnp.int32(0))
-            state, log, ptr, _, _, _, _ = jax.lax.while_loop(cond_fn, body_fn, carry0)
+            carry0 = (
+                state, log, ptr, remaining0, score0, jnp.bool_(False),
+                jnp.zeros(V, dtype=bool), jnp.int32(0),
+            )
+            state, log, ptr, _, _, _, _, _ = jax.lax.while_loop(
+                cond_fn, body_fn, carry0
+            )
             return (state, log, ptr), None
 
         (state, log, ptr), _ = jax.lax.scan(

@@ -202,10 +202,12 @@ def topo_screen(meta: TopoMeta, tcounts, thost, tdoms, own, selp, pod_allow, slo
             pod_dom = pod_allow[lo:hi]
             sallow = slot_allow[:, lo:hi]
             if gm.gtype == TOPO_SPREAD:
-                c = cnt + selp[g].astype(jnp.float32)
-                minc = jnp.min(jnp.where(pod_dom & doms, cnt, jnp.inf))
-                skew_ok = doms & (c - minc <= gm.max_skew)
-                g_viable = (skew_ok[None, :] & sallow).any(axis=-1)
+                # membership-only: the packing loop's water-fill allocation
+                # decides which domain each commit targets (per-pod skew is
+                # enforced there); screening on the instantaneous skew rule
+                # would wrongly exclude slots whose domain the allocation
+                # will reach at a later fill level
+                g_viable = ((pod_dom & doms)[None, :] & sallow).any(axis=-1)
             elif gm.gtype == TOPO_AFFINITY:
                 pos = pod_dom & doms & (cnt > 0.5)
                 has_pos = pos.any()
@@ -222,20 +224,25 @@ def topo_screen(meta: TopoMeta, tcounts, thost, tdoms, own, selp, pod_allow, slo
 
 
 def topo_narrow_single(meta: TopoMeta, tcounts, thost, tdoms, own, selp,
-                       pod_allow, slot_allow_row, slot_n, n_keys: int):
-    """(viable, narrow[V], applied_keys[K], k_cap) for ONE candidate slot —
-    the exact committed domain choice (spread picks the argmin-count domain
-    among the slot's viable domains; topologygroup.go:155-182). The returned
-    applied_keys mark keys that become DEFINED concrete In-sets on the merged
-    requirements (AddRequirements adds them, topology.go:149-167). Hostname
-    groups evaluate on the slot's identity and narrow nothing.
+                       pod_allow, slot_allow_row, slot_n, n_keys: int,
+                       spread_force=None):
+    """(viable, narrow[V], applied_keys[K], k_cap) for ONE candidate slot.
+    The returned applied_keys mark keys that become DEFINED concrete In-sets
+    on the merged requirements (AddRequirements adds them,
+    topology.go:149-167). Hostname groups evaluate on the slot's identity and
+    narrow nothing.
+
+    Value-key spread narrowing is driven by spread_force [V] — the packing
+    loop's water-fill domain choice for this iteration (the bulk analog of
+    the per-pod argmin-count rule, topologygroup.go:155-182); the slot is
+    viable iff it allows the forced domain. When spread_force is None the
+    per-pod rule applies (argmin-count domain under the skew bound).
 
     k_cap (int32) bounds how many IDENTICAL replicas of this pod the slot can
-    take while every one of them individually satisfies the reference's
-    viability rule — the skew headroom of owned hostname-spread groups
-    (min-count pinned to 0, topologygroup.go:186-188). Owned value-key spread
-    and anti-affinity classes are expanded to count=1 items at encode, so
-    they never consume k_cap > 1."""
+    take while the final state still satisfies the constraint — the skew
+    headroom of owned hostname-spread groups (min-count pinned to 0,
+    topologygroup.go:186-188). Anti-affinity classes are expanded to count=1
+    items at encode, so they never consume k_cap > 1."""
     import jax.numpy as jnp
 
     V = slot_allow_row.shape[0]
@@ -269,13 +276,17 @@ def topo_narrow_single(meta: TopoMeta, tcounts, thost, tdoms, own, selp,
         pod_dom = pod_allow[lo:hi]
         sallow = slot_allow_row[lo:hi]
         if gm.gtype == TOPO_SPREAD:
-            c = cnt + selp[g].astype(jnp.float32)
-            minc = jnp.min(jnp.where(pod_dom & doms, cnt, jnp.inf))
-            cand = doms & (c - minc <= gm.max_skew) & sallow
-            c_masked = jnp.where(cand, c, jnp.inf)
-            d_star = jnp.argmin(c_masked)
-            g_narrow = (jnp.arange(hi - lo) == d_star) & cand.any()
-            g_viable = cand.any()
+            if spread_force is not None:
+                g_narrow = spread_force[lo:hi] & doms
+                g_viable = (g_narrow & sallow).any()
+            else:
+                c = cnt + selp[g].astype(jnp.float32)
+                minc = jnp.min(jnp.where(pod_dom & doms, cnt, jnp.inf))
+                cand = doms & (c - minc <= gm.max_skew) & sallow
+                c_masked = jnp.where(cand, c, jnp.inf)
+                d_star = jnp.argmin(c_masked)
+                g_narrow = (jnp.arange(hi - lo) == d_star) & cand.any()
+                g_viable = cand.any()
         elif gm.gtype == TOPO_AFFINITY:
             pos = pod_dom & doms & (cnt > 0.5)
             has_pos = pos.any()
@@ -288,8 +299,8 @@ def topo_narrow_single(meta: TopoMeta, tcounts, thost, tdoms, own, selp,
             g_narrow = pod_dom & doms & (cnt < 0.5)
             g_viable = (g_narrow & sallow).any()
             k_cap = jnp.where(applies, jnp.minimum(k_cap, 1), k_cap)
-        if gm.gtype == TOPO_SPREAD:
-            # owned value-key spread items are expanded at encode; cap anyway
+        if gm.gtype == TOPO_SPREAD and spread_force is None:
+            # per-pod rule: one replica per domain choice
             k_cap = jnp.where(applies & selp[g], jnp.minimum(k_cap, 1), k_cap)
         viable &= ~applies | g_viable
         seg_new = jnp.where(applies, narrow[lo:hi] & g_narrow, narrow[lo:hi])

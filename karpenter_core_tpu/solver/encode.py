@@ -416,7 +416,10 @@ def encode_snapshot(
     # -- pod equivalence classes (items) -----------------------------------
     pod_reqs_arr = encode_reqsets(pod_reqs_list, dictionary)
     item_of_pod, item_counts, item_rep, item_members = _build_items(
-        pod_reqs_arr, pod_requests, pod_tol, pod_tol_exist, topo_meta, topo_arrays
+        pod_reqs_arr, pod_requests, pod_tol, pod_tol_exist, topo_meta, topo_arrays,
+        # resource components only (drop creation-time/uid tie-breakers so
+        # same-sized classes form one ordering group)
+        ffd_keys=[ffd_sort_key(p)[:2] for p in pods_sorted],
     )
 
     return EncodedSnapshot(
@@ -456,17 +459,21 @@ def encode_snapshot(
     )
 
 
-def _build_items(pod_reqs, pod_requests, pod_tol, pod_tol_exist, topo_meta, topo_arrays):
+def _build_items(pod_reqs, pod_requests, pod_tol, pod_tol_exist, topo_meta,
+                 topo_arrays, ffd_keys=None):
     """Group FFD-sorted pods into equivalence classes ("items") by their full
-    constraint encoding. Classes owning a value-key topology-spread or an
-    anti-affinity group are expanded back to count=1 items: their per-pod
-    domain choice mutates group counts between placements (the reference
-    re-evaluates per pod, scheduler.go:96-133). Hostname-spread / affinity
-    owners stay bulk — the kernel's skew-headroom cap and per-commit narrow
-    reproduce the per-pod outcome for identical replicas.
+    constraint encoding. Classes owning (or selected into) an anti-affinity
+    group are expanded back to count=1 items: each placement's "block out all
+    possible domains" record (topology.go:120-143) changes the next
+    placement's viability, so the reference's per-pod re-evaluation
+    (scheduler.go:96-133) must be preserved. Spread and affinity owners stay
+    bulk: hostname groups are governed by the kernel's skew-headroom cap, and
+    value-key spread owners by its per-iteration water-fill domain
+    allocation, both of which reproduce the per-pod greedy's final counts
+    for identical replicas.
 
     Returns (item_of_pod [P], item_counts [I], item_rep [I], members)."""
-    from karpenter_core_tpu.ops.topology import TOPO_ANTI, TOPO_SPREAD
+    from karpenter_core_tpu.ops.topology import TOPO_ANTI
 
     P = pod_requests.shape[0]
     if P == 0:
@@ -492,9 +499,7 @@ def _build_items(pod_reqs, pod_requests, pod_tol, pod_tol_exist, topo_meta, topo
         parts.append(np.ascontiguousarray(owner.T).view(np.uint8).reshape(P, -1))
         parts.append(np.ascontiguousarray(sel.T).view(np.uint8).reshape(P, -1))
         for g, gm in enumerate(topo_meta.groups):
-            if gm.gtype == TOPO_ANTI or (
-                gm.gtype == TOPO_SPREAD and not gm.is_hostname
-            ):
+            if gm.gtype == TOPO_ANTI:
                 applies = sel[g] if gm.is_inverse else owner[g]
                 expand_pod |= applies
     sig = np.concatenate(parts, axis=1)
@@ -521,6 +526,38 @@ def _build_items(pod_reqs, pod_requests, pod_tol, pod_tol_exist, topo_meta, topo
             counts[item] += 1
             members[item].append(i)
         item_of_pod[i] = item
+
+    # Within an FFD tie group, hostname-spread owners go first: each of
+    # their replicas opens (or claims) a one-pod node, and the reference's
+    # interleaved per-pod loop lets same-sized pods that follow co-locate
+    # onto those nodes (machines rank by ascending pod count,
+    # scheduler.go:186-193). Processing them after a bulk class would
+    # open the spread nodes too late to be reused.
+    if topo_meta is not None and ffd_keys is not None:
+        from karpenter_core_tpu.ops.topology import TOPO_SPREAD
+
+        hs_groups = [
+            g
+            for g, gm in enumerate(topo_meta.groups)
+            if gm.gtype == TOPO_SPREAD and gm.is_hostname and not gm.is_inverse
+        ]
+        if hs_groups:
+            owner = topo_arrays.owner
+            owns_hs = [
+                any(owner[g, reps[it]] for g in hs_groups)
+                for it in range(len(counts))
+            ]
+            order = sorted(
+                range(len(counts)),
+                key=lambda it: (ffd_keys[reps[it]], 0 if owns_hs[it] else 1, it),
+            )
+            inv = np.zeros(len(counts), dtype=np.int32)
+            for new, old in enumerate(order):
+                inv[old] = new
+            item_of_pod = inv[item_of_pod]
+            counts = [counts[old] for old in order]
+            reps = [reps[old] for old in order]
+            members = [members[old] for old in order]
     return (
         item_of_pod,
         np.asarray(counts, dtype=np.int32),

@@ -373,3 +373,88 @@ def test_config3_mix_spread_and_anti_affinity():
             z = m.requirements.get_requirement(LABEL_TOPOLOGY_ZONE).values_list()[0]
             zone_counts[z] = zone_counts.get(z, 0) + len(spreaders)
     assert max(zone_counts.values()) - min(zone_counts.values()) <= 1
+
+
+def test_spread_skew_enforced_when_zone_unschedulable():
+    """A registered-but-unschedulable domain pins the spread min: other
+    domains may only fill to min+maxSkew and the rest of the pods fail
+    (topologygroup.go:155-182 — the bulk water-fill must not pile replicas
+    into the feasible zones)."""
+    from karpenter_core_tpu.cloudprovider.types import Offering
+    from karpenter_core_tpu.kube.objects import (
+        LabelSelector,
+        NodeSelectorRequirement,
+        TopologySpreadConstraint,
+    )
+
+    # zone-3 is registered via the provisioner requirement but no type has
+    # an offering there -> nothing can ever be launched in it
+    it = fake.new_instance_type(
+        "only-type",
+        resources={"cpu": 16.0, "pods": 100.0},
+        offerings=[
+            Offering("on-demand", "test-zone-1", 1.0),
+            Offering("on-demand", "test-zone-2", 1.0),
+        ],
+    )
+    spread = TopologySpreadConstraint(
+        max_skew=1,
+        topology_key=LABEL_TOPOLOGY_ZONE,
+        when_unsatisfiable="DoNotSchedule",
+        label_selector=LabelSelector(match_labels={"app": "web"}),
+    )
+    pods = [
+        make_pod(labels={"app": "web"}, requests={"cpu": "1"}, topology_spread=[spread])
+        for _ in range(8)
+    ]
+    provisioners = [
+        make_provisioner(
+            name="default",
+            requirements=[
+                NodeSelectorRequirement(
+                    LABEL_TOPOLOGY_ZONE,
+                    "In",
+                    ["test-zone-1", "test-zone-2", "test-zone-3"],
+                )
+            ],
+        )
+    ]
+    host, tpu = run_both(pods, provisioners, {"default": [it]})
+    # reference outcome: zone-3 stays at 0 so zones 1/2 take one pod each
+    assert len(tpu.failed_pods) == len(host.failed_pods)
+    assert tpu.pod_count_new() == host.pod_count_new()
+    zone_counts = {}
+    for m in tpu.new_machines:
+        z = m.requirements.get_requirement(LABEL_TOPOLOGY_ZONE)
+        assert z.len() == 1
+        zone_counts[z.values_list()[0]] = zone_counts.get(z.values_list()[0], 0) + len(m.pods)
+    assert all(v <= 1 for v in zone_counts.values()), zone_counts
+
+
+def test_spread_cap_limited_commit_keeps_slot_available():
+    """A commit limited by the water-fill cap (not slot capacity) must leave
+    the slot usable for a later fill round in the same domain — no extra
+    machines versus the host greedy."""
+    from karpenter_core_tpu.kube.objects import LabelSelector, TopologySpreadConstraint
+
+    spread = TopologySpreadConstraint(
+        max_skew=1,
+        topology_key=LABEL_TOPOLOGY_ZONE,
+        when_unsatisfiable="DoNotSchedule",
+        label_selector=LabelSelector(match_labels={"app": "web"}),
+    )
+    # 25 replicas over 3 zones with one 16-cpu type: water-fill rounds must
+    # return to partially-filled machines instead of opening new ones
+    pods = [
+        make_pod(labels={"app": "web"}, requests={"cpu": "1"}, topology_spread=[spread])
+        for _ in range(25)
+    ]
+    provisioners = [make_provisioner(name="default")]
+    its = {
+        "default": [
+            fake.new_instance_type("big", resources={"cpu": 16.0, "pods": 50.0})
+        ]
+    }
+    host, tpu = run_both(pods, provisioners, its)
+    assert not tpu.failed_pods
+    assert len(tpu.new_machines) <= len(host.new_machines)
