@@ -104,10 +104,17 @@ def make_pack_kernel(
         (the node side is the slot's merged requirements).
 
         On MXU backends the per-key any-reductions fuse into 3 matmuls
-        (op-count is what bounds the scan step); on CPU the sliced loop
-        form is faster, so pick per backend at trace time."""
+        (op-count is what bounds the scan step) — or into ONE Pallas pass
+        over the allow tile when enabled; on CPU the sliced loop form is
+        faster, so pick per backend at trace time."""
         if compat.use_mxu():
             sm = _seg_mat(state.allow.shape[1])
+            from karpenter_core_tpu.ops import pallas_kernels
+
+            if pallas_kernels.pallas_enabled():
+                return pallas_kernels.slot_screen_pallas(
+                    state.allow, state.out, state.defined, prow, sm
+                )
             return compat.rows_compat_m(
                 {"allow": state.allow, "out": state.out, "defined": state.defined},
                 prow,
@@ -338,7 +345,41 @@ def make_pack_kernel(
 
             f_static_p = f_static[:, i, :]  # [J, T]
 
-            def spread_plan(state, remaining, dead, score):
+            # per-domain open-feasibility probes are loop-invariant for the
+            # item: compute once per step, consult every iteration
+            dom_open_by_g = {}
+            for g, gm in vk_spread_gs:
+                lo, hi = gm.seg
+                dom_open = jnp.zeros(hi - lo, dtype=bool)
+                for j in range(J):
+                    f_j = f_static_p[j] & tmpl_type_mask[j]  # [T]
+                    type_dom = type_reqs["allow"][:, lo:hi]  # [T, seg]
+                    if (lo, hi) == (zlo, zhi):
+                        # zone spread: a zone is only openable if some type
+                        # has an AVAILABLE offering there for the merged
+                        # capacity types (types list unavailable zones in
+                        # their requirements too)
+                        ct_allow = (
+                            tmpl_reqs["allow"][j, clo:chi]
+                            & prow["allow"][clo:chi]
+                        )
+                        type_zone_ok = (
+                            jnp.einsum(
+                                "tzc,c->tz",
+                                type_offering_ok.astype(jnp.float32),
+                                ct_allow.astype(jnp.float32),
+                            )
+                            > 0.5
+                        )
+                        type_dom = type_dom & type_zone_ok
+                    dom_open |= (
+                        openable[j, i]
+                        & tmpl_reqs["allow"][j, lo:hi]
+                        & (f_j[:, None] & type_dom).any(axis=0)
+                    )
+                dom_open_by_g[g] = dom_open
+
+            def spread_plan(state, remaining, dead, score, ptr):
                 """Per-iteration water-fill targeting for owned value-key
                 spread groups: pick the argmin-count LIVE domain d* and cap
                 the commit at the final fill level minus d*'s count (the bulk
@@ -347,14 +388,19 @@ def make_pack_kernel(
 
                 A domain is live when it is still placeable: a current
                 candidate slot allows it or a fresh machine could open in it
-                (probed from the static feasibility and the types'/templates'
-                own value masks). Infeasible and retired domains are FROZEN:
-                their counts stop growing, so — exactly like the reference's
-                skew rule, where the global min pins every other domain to
-                min+maxSkew — commits into live domains are additionally
-                bounded by min(frozen counts) + max_skew
-                (topologygroup.go:155-182). With no frozen domain the final
-                water-fill level equalizes counts and the bound is slack.
+                (the per-item probe above). Infeasible and retired domains
+                are FROZEN: their counts stop growing, so — exactly like the
+                reference's skew rule, where the global min pins every other
+                domain to min+maxSkew — commits into live domains are
+                additionally bounded by min(frozen counts) + max_skew.
+
+                The probe cannot see resource-coupled budgets (provisioner
+                limits, the slot budget, log space): a sibling domain can
+                turn out infeasible only after this one consumed the budget.
+                When any such budget is scarce the plan DEGRADES to the
+                per-pod skew bound against the min over ALL pod domains —
+                small, reference-faithful commits that can never overfill a
+                domain whose siblings later fail.
 
                 Returns (force[V] domain mask, cap, blocked, gate[N] slots
                 allowing d*, dmark[V] domains to retire if placement in d*
@@ -365,46 +411,27 @@ def make_pack_kernel(
                 gate = jnp.ones(N, dtype=bool)
                 dmark = jnp.zeros(V, dtype=bool)
                 cands = score < BIG
+                limits_finite = (state.remaining < jnp.float32(1e29)).any()
                 for g, gm in vk_spread_gs:
                     applies = prow["topo_own"][g]
                     lo, hi = gm.seg
                     pod_dom = prow["allow"][lo:hi] & state.tdoms[g, lo:hi]
-                    # feasibility probe per domain
                     dom_cand = (cands[:, None] & state.allow[:, lo:hi]).any(axis=0)
-                    dom_open = jnp.zeros(hi - lo, dtype=bool)
-                    for j in range(J):
-                        f_j = f_static_p[j] & tmpl_type_mask[j]  # [T]
-                        type_dom = type_reqs["allow"][:, lo:hi]  # [T, seg]
-                        if (lo, hi) == (zlo, zhi):
-                            # zone spread: a zone is only openable if some
-                            # type has an AVAILABLE offering there for the
-                            # merged capacity types (types list unavailable
-                            # zones in their requirements too)
-                            ct_allow = (
-                                tmpl_reqs["allow"][j, clo:chi]
-                                & prow["allow"][clo:chi]
-                            )
-                            type_zone_ok = (
-                                jnp.einsum(
-                                    "tzc,c->tz",
-                                    type_offering_ok.astype(jnp.float32),
-                                    ct_allow.astype(jnp.float32),
-                                )
-                                > 0.5
-                            )
-                            type_dom = type_dom & type_zone_ok
-                        dom_open |= (
-                            openable[j, i]
-                            & tmpl_reqs["allow"][j, lo:hi]
-                            & (f_j[:, None] & type_dom).any(axis=0)
-                        )
-                    live = pod_dom & ~dead[lo:hi] & (dom_cand | dom_open)
+                    live = pod_dom & ~dead[lo:hi] & (dom_cand | dom_open_by_g[g])
                     frozen = pod_dom & ~live
                     cnt = state.tcounts[g, lo:hi]
                     minc_frozen = jnp.min(
                         jnp.where(frozen, cnt, jnp.inf), initial=jnp.inf
                     )
+                    minc_all = jnp.min(
+                        jnp.where(pod_dom, cnt, jnp.inf), initial=jnp.inf
+                    )
                     n_live = live.sum()
+                    degraded = (
+                        limits_finite
+                        | ((N - state.nopen) < n_live)
+                        | ((L - ptr) < n_live + 1)
+                    )
                     level = (
                         jnp.where(live, cnt, 0.0).sum()
                         + remaining.astype(jnp.float32)
@@ -413,7 +440,11 @@ def make_pack_kernel(
                     d_star = jnp.argmin(cntm)
                     has_live = live.any()
                     level_cap = jnp.maximum(jnp.floor(level - cntm[d_star]), 1.0)
-                    skew_cap = minc_frozen + jnp.float32(gm.max_skew) - cntm[d_star]
+                    skew_cap = jnp.where(
+                        degraded,
+                        minc_all + jnp.float32(gm.max_skew) - cntm[d_star],
+                        minc_frozen + jnp.float32(gm.max_skew) - cntm[d_star],
+                    )
                     cap_f = jnp.minimum(level_cap, skew_cap)
                     skew_blocked = has_live & (cap_f < 1.0)
                     cap_g = jnp.where(
@@ -434,10 +465,10 @@ def make_pack_kernel(
                 return force, cap, blocked, gate, dmark
 
             owns_vk_spread = jnp.bool_(False)
+            n_owned_vk = jnp.int32(0)
             for g, _gm in vk_spread_gs:
-                owns_vk_spread |= (
-                    prow["topo_own"][g] if has_topo else jnp.bool_(False)
-                )
+                owns_vk_spread |= prow["topo_own"][g]
+                n_owned_vk += prow["topo_own"][g].astype(jnp.int32)
 
             # -- candidate branch: verify best slot, commit k replicas ----
             def do_candidate(args):
@@ -641,8 +672,13 @@ def make_pack_kernel(
                 # retires it and retries the next argmin domain; only a
                 # non-spread item (or one out of domains) is truly stuck
                 failed = ~can
-                dead = dead | (dmark & failed & owns_vk_spread)
-                exhausted = failed & ~owns_vk_spread
+                # retire the forced domain only when a SINGLE owned spread
+                # group chose it — with several owned groups only the joint
+                # combination proved infeasible, and retiring each member
+                # would wrongly freeze individually-placeable domains (the
+                # reference simply fails such a pod, machine.go:94-107)
+                dead = dead | (dmark & failed & (n_owned_vk == 1))
+                exhausted = failed & (n_owned_vk != 1)
                 return state, log, ptr, remaining, score, exhausted, dead
 
             def cond_fn(carry):
@@ -659,7 +695,7 @@ def make_pack_kernel(
                 )
                 if vk_spread_gs:
                     force, cap, blocked, gate, dmark = spread_plan(
-                        state_c, remaining_c, dead_c, score_c
+                        state_c, remaining_c, dead_c, score_c, carry[2]
                     )
                 else:
                     force = jnp.ones(V, dtype=bool)

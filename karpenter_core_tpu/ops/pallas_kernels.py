@@ -1,0 +1,128 @@
+"""Pallas TPU kernels for the hot solver ops.
+
+The packing loop's dominant recurring op at 50k-pod scale is the slot
+screen: Requirements.Compatible between every slot's merged requirement
+row and one pod row (requirements.go:123-133 lowered to masks — see
+ops/compat.rows_compat_m). The jnp form issues three separate [N, V] x
+[V, K] matmuls (escape flags need allowed/excluded counts, compat needs
+the intersection count) plus ~10 elementwise ops, each re-reading the
+[N, V] allow matrix from HBM. The Pallas kernel tiles the slot axis and
+makes ONE pass: the allow tile is read into VMEM once, all three MXU
+contractions and the per-key boolean algebra run fused, and only the
+final per-key verdict leaves the core.
+
+Enable with KCT_PALLAS=1 / disable with 0 (default: auto — on for TPU
+backends, off on CPU where the unit tests run the same kernel in
+interpret mode).
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+
+def pallas_enabled() -> bool:
+    flag = os.environ.get("KCT_PALLAS", "auto")
+    if flag in ("1", "true", "on"):
+        return True
+    if flag in ("0", "false", "off"):
+        return False
+    return jax.default_backend() not in ("cpu",)
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+def _screen_kernel(pod_allow_ref, seg_ref, allow_ref, s_out_ref, s_def_ref,
+                   p_out_ref, p_def_ref, p_esc_ref, deny_ref, verdict_ref):
+    """One slot tile: fused escape-flag recovery + Compatible verdict.
+
+    Inputs are 0/1 float32 masks: allow [TN, V]; s_out/s_def [TN, K];
+    pod rows [1, V]/[1, K]; seg [V, K] key-membership. Output: per-key OK
+    [TN, K] (the caller ANDs over the real keys).
+    """
+    allow = allow_ref[:]
+    seg = seg_ref[:]
+    pod_allow = pod_allow_ref[:]
+
+    # one pass over the allow tile: three MXU contractions
+    dot = lambda a, b: jax.lax.dot_general(
+        a, b, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+    has_allow = dot(allow, seg)  # [TN, K] #allowed values per key
+    has_excl = dot(1.0 - allow, seg)  # [TN, K] #excluded values per key
+    inter = dot(allow * pod_allow, seg)  # [TN, K] #shared values per key
+
+    s_out = s_out_ref[:]
+    s_def = s_def_ref[:]
+    p_out = p_out_ref[:]
+    p_def = p_def_ref[:]
+    p_esc = p_esc_ref[:]
+    deny = deny_ref[:]
+
+    # escape = defined & ((out & has_excl) | (~out & ~has_allow))
+    slot_escape = s_def * jnp.maximum(
+        s_out * (has_excl > 0.5), (1.0 - s_out) * (has_allow < 0.5)
+    )
+    shared = s_def * p_def
+    both_out = s_out * p_out
+    nonempty = jnp.maximum(both_out, (inter > 0.5).astype(jnp.float32))
+    escapes = slot_escape * p_esc
+    # ~shared | nonempty | escapes, then the custom-deny rule
+    key_ok = jnp.maximum(jnp.maximum(1.0 - shared, nonempty), escapes)
+    key_ok = jnp.minimum(key_ok, 1.0 - deny * (1.0 - s_def))
+    verdict_ref[:] = key_ok
+
+
+def slot_screen_pallas(slot_allow, slot_out, slot_defined, pod_row, seg_mat,
+                       interpret: bool = False):
+    """[N] Requirements.Compatible(slot rows, one pod row) as one fused
+    Pallas pass. Semantics identical to compat.rows_compat_m (the jnp
+    reference implementation the unit tests compare against)."""
+    from jax.experimental import pallas as pl
+
+    N, V = slot_allow.shape
+    K = slot_out.shape[1]
+    TN = 256
+    Np = _round_up(max(N, TN), TN)
+    Kp = _round_up(max(K, 128), 128)
+    Vp = _round_up(max(V, 128), 128)
+
+    def pad2(a, r, c):
+        a = a.astype(jnp.float32)
+        return jnp.pad(a, ((0, r - a.shape[0]), (0, c - a.shape[1])))
+
+    args = (
+        pad2(pod_row["allow"][None, :], 1, Vp),
+        pad2(jnp.asarray(seg_mat), Vp, Kp),
+        pad2(slot_allow, Np, Vp),
+        pad2(slot_out, Np, Kp),
+        pad2(slot_defined, Np, Kp),
+        pad2(pod_row["out"][None, :], 1, Kp),
+        pad2(pod_row["defined"][None, :], 1, Kp),
+        pad2(pod_row["escape"][None, :], 1, Kp),
+        pad2(pod_row["custom_deny"][None, :], 1, Kp),
+    )
+    key_ok = pl.pallas_call(
+        _screen_kernel,
+        grid=(Np // TN,),
+        in_specs=[
+            pl.BlockSpec((1, Vp), lambda n: (0, 0)),
+            pl.BlockSpec((Vp, Kp), lambda n: (0, 0)),
+            pl.BlockSpec((TN, Vp), lambda n: (n, 0)),
+            pl.BlockSpec((TN, Kp), lambda n: (n, 0)),
+            pl.BlockSpec((TN, Kp), lambda n: (n, 0)),
+            pl.BlockSpec((1, Kp), lambda n: (0, 0)),
+            pl.BlockSpec((1, Kp), lambda n: (0, 0)),
+            pl.BlockSpec((1, Kp), lambda n: (0, 0)),
+            pl.BlockSpec((1, Kp), lambda n: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((TN, Kp), lambda n: (n, 0)),
+        out_shape=jax.ShapeDtypeStruct((Np, Kp), jnp.float32),
+        interpret=interpret,
+    )(*args)
+    # padded keys: verdict 1.0 (shared=0 -> ~shared). AND over real keys.
+    return jnp.all(key_ok[:N, :K] > 0.5, axis=-1)

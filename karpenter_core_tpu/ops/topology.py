@@ -235,8 +235,8 @@ def topo_narrow_single(meta: TopoMeta, tcounts, thost, tdoms, own, selp,
     Value-key spread narrowing is driven by spread_force [V] — the packing
     loop's water-fill domain choice for this iteration (the bulk analog of
     the per-pod argmin-count rule, topologygroup.go:155-182); the slot is
-    viable iff it allows the forced domain. When spread_force is None the
-    per-pod rule applies (argmin-count domain under the skew bound).
+    viable iff it allows the forced domain. A None spread_force admits every
+    registered domain (the caller enforces the skew/allocation bound).
 
     k_cap (int32) bounds how many IDENTICAL replicas of this pod the slot can
     take while the final state still satisfies the constraint — the skew
@@ -276,17 +276,11 @@ def topo_narrow_single(meta: TopoMeta, tcounts, thost, tdoms, own, selp,
         pod_dom = pod_allow[lo:hi]
         sallow = slot_allow_row[lo:hi]
         if gm.gtype == TOPO_SPREAD:
-            if spread_force is not None:
-                g_narrow = spread_force[lo:hi] & doms
-                g_viable = (g_narrow & sallow).any()
-            else:
-                c = cnt + selp[g].astype(jnp.float32)
-                minc = jnp.min(jnp.where(pod_dom & doms, cnt, jnp.inf))
-                cand = doms & (c - minc <= gm.max_skew) & sallow
-                c_masked = jnp.where(cand, c, jnp.inf)
-                d_star = jnp.argmin(c_masked)
-                g_narrow = (jnp.arange(hi - lo) == d_star) & cand.any()
-                g_viable = cand.any()
+            # domain choice is the packing loop's water-fill plan; absent a
+            # plan every registered domain is admissible
+            sf = spread_force[lo:hi] if spread_force is not None else doms
+            g_narrow = sf & doms
+            g_viable = (g_narrow & sallow).any()
         elif gm.gtype == TOPO_AFFINITY:
             pos = pod_dom & doms & (cnt > 0.5)
             has_pos = pos.any()
@@ -299,9 +293,6 @@ def topo_narrow_single(meta: TopoMeta, tcounts, thost, tdoms, own, selp,
             g_narrow = pod_dom & doms & (cnt < 0.5)
             g_viable = (g_narrow & sallow).any()
             k_cap = jnp.where(applies, jnp.minimum(k_cap, 1), k_cap)
-        if gm.gtype == TOPO_SPREAD and spread_force is None:
-            # per-pod rule: one replica per domain choice
-            k_cap = jnp.where(applies & selp[g], jnp.minimum(k_cap, 1), k_cap)
         viable &= ~applies | g_viable
         seg_new = jnp.where(applies, narrow[lo:hi] & g_narrow, narrow[lo:hi])
         narrow = narrow.at[lo:hi].set(seg_new)

@@ -1,13 +1,17 @@
 """Multi-chip sharded solve over a jax.sharding.Mesh.
 
 Scaling design (the "DP/TP" of this framework — SURVEY.md section 2.7):
-  - 'dp'  : the POD axis is sharded across devices — each device packs its
-            local pods into its own node-slot budget (independent greedy
-            sub-solves; machines are disjoint by construction, so the merge
-            is a concat). This is how 50k-pod batches ride ICI.
+  - 'dp'  : the REPLICA COUNT axis is sharded across devices — every
+            device sees the same item (pod-equivalence-class) rows but
+            packs its 1/ndp share of each class's replicas into its own
+            node-slot budget (independent greedy sub-solves; machines are
+            disjoint by construction, so the merge is a concat). Splitting
+            counts instead of item rows keeps per-device work balanced even
+            when one deployment dominates the batch. This is how 50k-pod
+            batches ride ICI.
   - 'tp'  : the INSTANCE-TYPE axis of the feasibility matmuls is sharded;
             each device computes F over its type columns, then an
-            all_gather over 'tp' reassembles the [P_local, T] row a pod
+            all_gather over 'tp' reassembles the [I, T] row an item
             needs for packing. The gather rides ICI (XLA collective), not
             host memory.
 
@@ -53,9 +57,10 @@ def make_sharded_solve(snap, provisioners, mesh, max_nodes_per_shard: int = 256)
     N = max_nodes_per_shard
     pack = make_pack_kernel(segments, zone_seg, ct_seg)
 
-    def body(pod_arrays, tmpl, tmpl_daemon, tmpl_type_mask_l, types_l,
-             type_offering_ok_l, types_full, type_alloc, type_capacity,
-             type_offering_ok, pod_tol_all, well_known, remaining0):
+    def body(pod_arrays, count_split, tmpl, tmpl_daemon, tmpl_type_mask_l,
+             types_l, type_offering_ok_l, types_full, type_alloc,
+             type_capacity, type_offering_ok, pod_tol_all, well_known,
+             remaining0):
         # ---- type-sharded feasibility + all_gather over 'tp' -------------
         f_local = feasibility_static(
             {k: pod_arrays[k] for k in ("allow", "out", "defined", "escape")},
@@ -99,6 +104,8 @@ def make_sharded_solve(snap, provisioners, mesh, max_nodes_per_shard: int = 256)
         )
         pod_arrays = dict(pod_arrays)
         pod_arrays["tol"] = pod_tol_all
+        # this shard's share of each class's replicas
+        pod_arrays["count"] = count_split[0]
         tmpl_type_mask = jax.lax.all_gather(tmpl_type_mask_l, "tp", axis=2, tiled=False)
         tmpl_type_mask = jnp.moveaxis(tmpl_type_mask, 2, 1).reshape(J, -1)
         state, log, ptr = pack(
@@ -121,21 +128,22 @@ def make_sharded_solve(snap, provisioners, mesh, max_nodes_per_shard: int = 256)
         state = state._replace(nopen=state.nopen[None])
         return log, ptr[None], state, scheduled
 
+    # item rows replicate; only the per-shard replica counts shard over dp
     pod_spec = {
-        "allow": P("dp", None),
-        "out": P("dp", None),
-        "defined": P("dp", None),
-        "escape": P("dp", None),
-        "custom_deny": P("dp", None),
-        "requests": P("dp", None),
-        "tol_tmpl": P("dp", None),
-        "valid": P("dp"),
-        "count": P("dp"),
+        "allow": P(None, None),
+        "out": P(None, None),
+        "defined": P(None, None),
+        "escape": P(None, None),
+        "custom_deny": P(None, None),
+        "requests": P(None, None),
+        "tol_tmpl": P(None, None),
+        "valid": P(None),
     }
     reqset_rep = {k: P(None, None) for k in ("allow", "out", "defined", "escape")}
     reqset_tp = {k: P("tp", None) for k in ("allow", "out", "defined", "escape")}
     in_specs = (
         pod_spec,  # pod_arrays
+        P("dp", None),  # count_split [ndp, I]
         reqset_rep,  # tmpl
         P(None, None),  # tmpl_daemon
         P(None, "tp"),  # tmpl_type_mask_l
@@ -145,7 +153,7 @@ def make_sharded_solve(snap, provisioners, mesh, max_nodes_per_shard: int = 256)
         P(None, None),  # type_alloc
         P(None, None),  # type_capacity
         P(None, None, None),  # type_offering_ok
-        P("dp", None),  # pod_tol_all
+        P(None, None),  # pod_tol_all
         P(None),  # well_known
         P(None, None),  # remaining0
     )
@@ -181,21 +189,16 @@ def make_sharded_solve(snap, provisioners, mesh, max_nodes_per_shard: int = 256)
     (pod_arrays, tmpl, tmpl_daemon, tmpl_type_mask, types, type_alloc,
      type_capacity, type_offering_ok, pod_tol_all, _exist, _eu, _ec,
      well_known, remaining0, _tc, _th, _td, _tt) = base_args
-    # pad the ITEM axis to a multiple of dp (classes collapse identical pods,
-    # so the item count is not under the caller's control); padded rows are
-    # invalid with count 0 and never place anything
-    I = pod_arrays["requests"].shape[0]
-    pad = (-I) % ndp
-    if pad:
-        def padded(a):
-            widths = [(0, pad)] + [(0, 0)] * (a.ndim - 1)
-            return np.pad(a, widths)
-
-        pod_arrays = {k: padded(v) for k, v in pod_arrays.items()}
-        pod_arrays["valid"][I:] = False
-        pod_tol_all = padded(pod_tol_all)
+    # split each class's replica count evenly across the dp shards (the
+    # item rows themselves replicate); remainders go to the low shards
+    counts = pod_arrays.pop("count").astype(np.int64)
+    I = counts.shape[0]
+    count_split = np.tile(counts // ndp, (ndp, 1)).astype(np.int32)
+    for d in range(ndp):
+        count_split[d] += (counts % ndp > d)
     args = (
         pod_arrays,
+        count_split,
         tmpl,
         tmpl_daemon,
         tmpl_type_mask,

@@ -458,3 +458,37 @@ def test_spread_cap_limited_commit_keeps_slot_available():
     host, tpu = run_both(pods, provisioners, its)
     assert not tpu.failed_pods
     assert len(tpu.new_machines) <= len(host.new_machines)
+
+
+def test_spread_degrades_under_provisioner_limits():
+    """When a resource-coupled budget (provisioner limit) could starve a
+    sibling domain, the water-fill degrades to per-pod skew bounds: no
+    domain may be overfilled before the sibling's infeasibility surfaces
+    (scheduler.go:276-293 + topologygroup.go:155-182)."""
+    from karpenter_core_tpu.kube.objects import LabelSelector, TopologySpreadConstraint
+
+    spread = TopologySpreadConstraint(
+        max_skew=1,
+        topology_key=LABEL_TOPOLOGY_ZONE,
+        when_unsatisfiable="DoNotSchedule",
+        label_selector=LabelSelector(match_labels={"app": "web"}),
+    )
+    pods = [
+        make_pod(labels={"app": "web"}, requests={"cpu": "1"}, topology_spread=[spread])
+        for _ in range(9)
+    ]
+    provisioners = [make_provisioner(name="default", limits={"cpu": "16"})]
+    its = {
+        "default": [
+            fake.new_instance_type("big", resources={"cpu": 16.0, "pods": 50.0})
+        ]
+    }
+    host, tpu = run_both(pods, provisioners, its)
+    assert len(tpu.failed_pods) == len(host.failed_pods)
+    assert tpu.pod_count_new() == host.pod_count_new()
+    zone_counts = {f"test-zone-{i}": 0 for i in (1, 2, 3)}
+    for m in tpu.new_machines:
+        z = m.requirements.get_requirement(LABEL_TOPOLOGY_ZONE)
+        assert z.len() == 1
+        zone_counts[z.values_list()[0]] += len(m.pods)
+    assert max(zone_counts.values()) - min(zone_counts.values()) <= 1, zone_counts
