@@ -21,6 +21,8 @@ N_PODS = int(os.environ.get("BENCH_PODS", "2000"))
 N_TYPES = int(os.environ.get("BENCH_TYPES", "100"))
 N_RUNS = int(os.environ.get("BENCH_RUNS", "5"))
 MIX = os.environ.get("BENCH_MIX", "reference")  # reference | plain
+CONFIG = os.environ.get("BENCH_CONFIG", "solve")  # solve | consolidation
+N_EXISTING = int(os.environ.get("BENCH_EXISTING", "1000"))
 # node-slot budget: hostname-spread pods (1/7 of the mix) need a slot each
 MAX_NODES = int(os.environ.get("BENCH_NODES", str(max(1024, N_PODS // 4))))
 
@@ -80,6 +82,105 @@ def _reference_mix(n_pods: int, n_types: int):
             pods.append(make_pod(requests={"cpu": "1", "memory": "1Gi"}))
     provisioners = [make_provisioner(name="default")]
     return pods, provisioners, {"default": fake.instance_types(n_types)}
+
+
+def consolidation_bench():
+    """Config 4 analog: N_EXISTING under-utilized nodes, N_PODS running
+    pods, full multi-node replan (the parallel prefix ladder over
+    simulate_scheduling, replacing multinodeconsolidation.go:87-113's
+    sequential binary search). Timed region: the whole ComputeCommand
+    ladder, steady-state (compiled programs cached)."""
+    import time as _time
+
+    from karpenter_core_tpu.api.labels import (
+        LABEL_CAPACITY_TYPE,
+        LABEL_NODE_INITIALIZED,
+        PROVISIONER_NAME_LABEL_KEY,
+    )
+    from karpenter_core_tpu.api.settings import Settings
+    from karpenter_core_tpu.cloudprovider import fake
+    from karpenter_core_tpu.controllers.deprovisioning.core import candidate_nodes
+    from karpenter_core_tpu.kube.objects import LABEL_INSTANCE_TYPE_STABLE, LABEL_TOPOLOGY_ZONE
+    from karpenter_core_tpu.operator import new_operator
+    from karpenter_core_tpu.solver.tpu_solver import TPUSolver
+    from karpenter_core_tpu.testing import FakeClock, make_node, make_pod, make_provisioner
+
+    clock = FakeClock()
+    universe = fake.instance_types(N_TYPES)
+    cp = fake.FakeCloudProvider(universe)
+    solver = TPUSolver(max_nodes=max(1024, N_PODS // 4))
+    op = new_operator(cp, settings=Settings(), solver=solver, clock=clock)
+    op.kube_client.create(make_provisioner(name="default", consolidation_enabled=True))
+
+    pods_per_node = max(1, N_PODS // N_EXISTING)
+    t0 = time.perf_counter()
+    for n in range(N_EXISTING):
+        it = universe[n % len(universe)]
+        name = f"node-{n}"
+        node = make_node(
+            name=name,
+            labels={
+                PROVISIONER_NAME_LABEL_KEY: "default",
+                LABEL_NODE_INITIALIZED: "true",
+                LABEL_INSTANCE_TYPE_STABLE: it.name,
+                LABEL_CAPACITY_TYPE: "on-demand",
+                LABEL_TOPOLOGY_ZONE: f"test-zone-{1 + n % 3}",
+            },
+            capacity={k: str(v) for k, v in it.capacity.items()},
+        )
+        op.kube_client.create(node)
+        for _ in range(pods_per_node):
+            pod = make_pod(requests={"cpu": "0.1"}, node_name=name, unschedulable=False)
+            pod.status.phase = "Running"
+            op.kube_client.create(pod)
+    op.sync_state()
+    setup_s = time.perf_counter() - t0
+
+    multi = next(
+        d for d in op.deprovisioning.deprovisioners
+        if type(d).__name__ == "MultiNodeConsolidation"
+    )
+    multi.validation_ttl = 0.0
+
+    def replan():
+        candidates = multi.sort_and_filter_candidates(
+            candidate_nodes(
+                op.cluster, op.kube_client, cp, multi.should_deprovision, clock
+            )
+        )
+        return candidates, multi.first_n_consolidation_ladder(candidates)
+
+    t0 = time.perf_counter()
+    candidates, cmd = replan()
+    warm_s = time.perf_counter() - t0
+    times = []
+    for _ in range(max(1, N_RUNS - 1)):
+        t0 = time.perf_counter()
+        candidates, cmd = replan()
+        times.append(time.perf_counter() - t0)
+    replan_s = float(np.median(times)) if times else warm_s
+
+    total_pods = N_EXISTING * pods_per_node
+    pods_per_sec = total_pods / replan_s
+    print(
+        f"[bench] consolidation nodes={N_EXISTING} pods={total_pods} "
+        f"types={N_TYPES} candidates={len(candidates)} action={cmd.action} "
+        f"removed={len(cmd.nodes_to_remove)} setup={setup_s:.1f}s "
+        f"warm={warm_s:.1f}s replan_med={replan_s * 1e3:.1f}ms",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "metric": (
+                    f"consolidation_replan_pods_per_sec_{N_EXISTING}nodes_{total_pods}pods"
+                ),
+                "value": round(pods_per_sec, 1),
+                "unit": "pods/sec",
+                "vs_baseline": round(pods_per_sec / 100.0, 2),
+            }
+        )
+    )
 
 
 def main():

@@ -223,7 +223,6 @@ def encode_snapshot(
     scan consumes them in reference order.
     """
     from karpenter_core_tpu.api.provisioner import order_by_weight
-    from karpenter_core_tpu.controllers.provisioning.scheduling.queue import ffd_sort_key
 
     daemonset_pods = daemonset_pods or []
     # only nodes launched by us participate (scheduler.go:226-229)
@@ -248,8 +247,28 @@ def encode_snapshot(
             row.add(tid)
         tmpl_type_mask_rows.append(row)
 
+    # memoized per-pod requests: requests_for_pods walks containers and is
+    # called for the FFD key, the resource-name union, and the request rows
+    req_cache = {}
+
+    def pod_requests_of(p):
+        rl = req_cache.get(id(p))
+        if rl is None:
+            rl = resources_util.requests_for_pods(p)
+            req_cache[id(p)] = rl
+        return rl
+
+    def ffd_key(p):
+        rl = pod_requests_of(p)
+        return (
+            -rl.get("cpu", 0.0),
+            -rl.get("memory", 0.0),
+            p.metadata.creation_timestamp or 0.0,
+            p.metadata.uid,
+        )
+
     order = np.array(
-        sorted(range(len(pods)), key=lambda i: ffd_sort_key(pods[i])), dtype=np.int32
+        sorted(range(len(pods)), key=lambda i: ffd_key(pods[i])), dtype=np.int32
     )
     pods_sorted = [pods[i] for i in order]
 
@@ -299,7 +318,7 @@ def encode_snapshot(
     # -- resources ---------------------------------------------------------
     extended = sorted(
         set().union(
-            *[set(resources_util.requests_for_pods(p)) for p in pods_sorted] or [set()],
+            *[set(pod_requests_of(p)) for p in pods_sorted] or [set()],
             *[set(it.allocatable()) for it in all_types] or [set()],
         )
         - set(CORE_RESOURCES)
@@ -318,7 +337,7 @@ def encode_snapshot(
     P, J, T, K, V = len(pods_sorted), len(templates), len(all_types), dictionary.K, dictionary.V
 
     pod_requests = np.stack(
-        [encode_resources(resources_util.requests_for_pods(p)) for p in pods_sorted]
+        [encode_resources(pod_requests_of(p)) for p in pods_sorted]
     ) if P else np.zeros((0, R), np.float32)
 
     # daemon overhead per template (scheduler.go:253-270)
@@ -379,10 +398,14 @@ def encode_snapshot(
     )
 
     # -- existing nodes ----------------------------------------------------
+    # pod x node toleration is evaluated once per (pod, taint-signature):
+    # cluster nodes overwhelmingly share a handful of taint sets, so this
+    # turns the P x E double loop into P x #signatures
     E = len(state_nodes)
     exist_used = np.zeros((E, R), dtype=np.float32)
     exist_cap = np.zeros((E, R), dtype=np.float32)
     pod_tol_exist = np.zeros((P, E), dtype=bool)
+    taint_sig_cols: Dict[Tuple, np.ndarray] = {}
     for e, node in enumerate(state_nodes):
         node_taints = node.taints()
         # daemons that would schedule to this node (scheduler.go:231-240)
@@ -398,8 +421,18 @@ def encode_snapshot(
         remaining = {k: max(v, 0.0) for k, v in remaining.items()}
         exist_used[e] = encode_resources(remaining)
         exist_cap[e] = encode_resources(node.available())
-        for i, p in enumerate(pods_sorted):
-            pod_tol_exist[i, e] = taints_mod.tolerates(node_taints, p) is None
+        sig = tuple(
+            sorted((t.key, t.value, t.effect) for t in node_taints)
+        )
+        col = taint_sig_cols.get(sig)
+        if col is None:
+            col = np.fromiter(
+                (taints_mod.tolerates(node_taints, p) is None for p in pods_sorted),
+                dtype=bool,
+                count=P,
+            )
+            taint_sig_cols[sig] = col
+        pod_tol_exist[:, e] = col
 
     # -- topology arrays ---------------------------------------------------
     from karpenter_core_tpu.ops.topology import encode_topology
@@ -419,7 +452,7 @@ def encode_snapshot(
         pod_reqs_arr, pod_requests, pod_tol, pod_tol_exist, topo_meta, topo_arrays,
         # resource components only (drop creation-time/uid tie-breakers so
         # same-sized classes form one ordering group)
-        ffd_keys=[ffd_sort_key(p)[:2] for p in pods_sorted],
+        ffd_keys=[ffd_key(p)[:2] for p in pods_sorted],
     )
 
     return EncodedSnapshot(
