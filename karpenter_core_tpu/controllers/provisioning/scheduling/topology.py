@@ -243,7 +243,13 @@ class Topology:
         cluster,
         domains: Dict[str, Set[str]],
         pods: List[Pod],
+        update_pods: Optional[List[Pod]] = None,
     ):
+        """update_pods: subset of `pods` to register groups/ownership for —
+        the tensor encoder passes one representative per pod-spec equivalence
+        class (group membership is a pure function of spec+labels+namespace),
+        while the host scheduler registers every pod. `pods` always defines
+        the excluded set (topology.go:56-58)."""
         self.kube_client = kube_client
         self.cluster = cluster
         self.domains = domains
@@ -253,7 +259,7 @@ class Topology:
         # placement is decided by this solve (topology.go:56-58)
         self.excluded_pods: Set[str] = {p.metadata.uid for p in pods}
         self._update_inverse_affinities()
-        for pod in pods:
+        for pod in pods if update_pods is None else update_pods:
             self.update(pod)
 
     # -- batch maintenance ------------------------------------------------

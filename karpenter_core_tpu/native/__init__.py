@@ -102,7 +102,10 @@ class NativeSolver:
         kube_client=None,
         cluster=None,
     ):
-        from karpenter_core_tpu.solver.tpu_solver import solve_with_relaxation
+        from karpenter_core_tpu.solver.tpu_solver import (
+            DEFAULT_MAX_RELAX_ROUNDS,
+            solve_with_relaxation,
+        )
 
         return solve_with_relaxation(
             lambda p: self._solve_once(
@@ -112,7 +115,7 @@ class NativeSolver:
             pods,
             provisioners,
             instance_types,
-            max_relax_rounds=3,
+            max_relax_rounds=DEFAULT_MAX_RELAX_ROUNDS,
         )
 
     def _solve_once(self, pods, provisioners, instance_types, daemonset_pods,

@@ -412,6 +412,23 @@ def make_pack_kernel(
                 dmark = jnp.zeros(V, dtype=bool)
                 cands = score < BIG
                 limits_finite = (state.remaining < jnp.float32(1e29)).any()
+                # open-feasibility is only statically provable when the vk
+                # spread is the item's SOLE structural constraint: an item
+                # that also owns hostname-affinity/anti groups (s capped to 1,
+                # opens gated on co-location) or owns >1 vk-spread group
+                # (joint domain feasibility) can fail inside do_open AFTER
+                # the bulk commit, leaving a domain irreversibly above
+                # min(frozen)+max_skew. Those items degrade to the per-pod
+                # skew bound (minc_all), like the reference's per-pod loop.
+                vk_ids = {g for g, _ in vk_spread_gs}
+                n_owned_vk_p = jnp.int32(0)
+                for g, _gm in vk_spread_gs:
+                    n_owned_vk_p += prow["topo_own"][g].astype(jnp.int32)
+                owns_nonspread = jnp.bool_(False)
+                for g in range(len(topo_meta.groups) if has_topo else 0):
+                    if g not in vk_ids:
+                        owns_nonspread |= prow["topo_own"][g]
+                not_provable = (n_owned_vk_p > 1) | owns_nonspread
                 for g, gm in vk_spread_gs:
                     applies = prow["topo_own"][g]
                     lo, hi = gm.seg
@@ -431,6 +448,7 @@ def make_pack_kernel(
                         limits_finite
                         | ((N - state.nopen) < n_live)
                         | ((L - ptr) < n_live + 1)
+                        | not_provable
                     )
                     level = (
                         jnp.where(live, cnt, 0.0).sum()

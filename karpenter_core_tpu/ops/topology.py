@@ -77,10 +77,17 @@ def encode_topology(
     dictionary,
     n_slots: int,
     exist_hostnames: List[str],
+    uidx=None,
+    uniq_pods=None,
 ) -> Tuple[Optional[TopoMeta], Optional[TopoArrays]]:
     """Lower a host Topology (already seeded with cluster counts) to arrays.
     exist_hostnames[e] maps existing slot e -> its hostname domain.
-    Returns (None, None) when the batch has no topology constraints."""
+    Returns (None, None) when the batch has no topology constraints.
+
+    When (uidx, uniq_pods) is given — uidx[i] = pod i's spec-equivalence
+    class, uniq_pods[u] = that class's representative (a member of the
+    batch) — ownership and selection are evaluated once per class and
+    gathered, turning the G x P Python loops into G x U."""
     from karpenter_core_tpu.kube.objects import LABEL_HOSTNAME
     from karpenter_core_tpu.solver.encode import encode_reqsets
 
@@ -93,15 +100,18 @@ def encode_topology(
     P = len(pods_sorted)
     V = dictionary.V
     G = len(groups)
-    uid_to_idx = {p.metadata.uid: i for i, p in enumerate(pods_sorted)}
+    per_class = uidx is not None and uniq_pods is not None
+    if not per_class:
+        uid_to_idx = {p.metadata.uid: i for i, p in enumerate(pods_sorted)}
     n_direct = len(host_topology.topologies)
 
     metas: List[TopoGroupMeta] = []
     counts0 = np.zeros((G, V), dtype=np.float32)
     hcounts0 = np.zeros((G, n_slots), dtype=np.float32)
     domain_mask0 = np.zeros((G, V), dtype=bool)
-    owner = np.zeros((G, P), dtype=bool)
-    sel = np.zeros((G, P), dtype=bool)
+    U = len(uniq_pods) if per_class else P
+    owner_u = np.zeros((G, U), dtype=bool)
+    sel_u = np.zeros((G, U), dtype=bool)
     term_reqs = []
 
     type_map = {
@@ -137,12 +147,22 @@ def encode_topology(
                     continue
                 domain_mask0[g, fi] = True
                 counts0[g, fi] = count
-        for uid in tg.owners:
-            if uid in uid_to_idx:
-                owner[g, uid_to_idx[uid]] = True
-        for i, pod in enumerate(pods_sorted):
-            sel[g, i] = tg._selects(pod)
+        if per_class:
+            for u, rep in enumerate(uniq_pods):
+                owner_u[g, u] = tg.is_owned_by(rep.metadata.uid)
+                sel_u[g, u] = tg._selects(rep)
+        else:
+            for uid in tg.owners:
+                if uid in uid_to_idx:
+                    owner_u[g, uid_to_idx[uid]] = True
+            for i, pod in enumerate(pods_sorted):
+                sel_u[g, i] = tg._selects(pod)
 
+    if per_class:
+        owner = owner_u[:, uidx]
+        sel = sel_u[:, uidx]
+    else:
+        owner, sel = owner_u, sel_u
     encoded_terms = encode_reqsets(term_reqs, dictionary)
     meta = TopoMeta(groups=metas)
     arrays = TopoArrays(

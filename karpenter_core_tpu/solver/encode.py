@@ -49,6 +49,35 @@ from karpenter_core_tpu.utils import resources as resources_util
 CORE_RESOURCES = ["cpu", "memory", "pods", "ephemeral-storage"]
 
 
+def _pod_spec_signature(p: Pod) -> Tuple:
+    """Content key for pod spec-equivalence: covers exactly what the encoder
+    derives per pod — namespace+labels (topology selection/ownership),
+    node_selector + affinity (Requirements.from_pod, topology groups),
+    tolerations, spread constraints, and container resources (requests
+    ceiling). Pods with equal signatures are interchangeable to the solver.
+    Affinity/spread objects are keyed by repr (dataclass reprs are
+    content-recursive); the common no-affinity case stays cheap."""
+    s = p.spec
+    return (
+        p.metadata.namespace,
+        tuple(p.metadata.labels.items()),
+        tuple(s.node_selector.items()),
+        repr(s.affinity) if s.affinity is not None else None,
+        repr(s.tolerations) if s.tolerations else None,
+        repr(s.topology_spread_constraints) if s.topology_spread_constraints else None,
+        tuple(
+            (tuple(c.resources.requests.items()), tuple(c.resources.limits.items()))
+            for c in s.containers
+        ),
+        tuple(
+            (tuple(c.resources.requests.items()), tuple(c.resources.limits.items()))
+            for c in s.init_containers
+        )
+        if s.init_containers
+        else None,
+    )
+
+
 class LabelDictionary:
     """Closed (key, value) universe: every value any requirement or node label
     mentions. Flat value axis V with per-key contiguous segments."""
@@ -136,8 +165,24 @@ def encode_reqsets(
             if k is None:
                 continue
             lo, hi = dictionary.segment(key)
-            vals = dictionary.values_of(key)
-            allow[i, lo:hi] = [r.has(v) for v in vals]
+            # concrete In/NotIn sets touch only their own values — O(|values|)
+            # instead of O(segment width), which matters for wide segments
+            # (instance-type names, hostnames)
+            if r.greater_than is None and r.less_than is None:
+                local = dictionary._values[k]
+                if not r.complement:
+                    allow[i, lo:hi] = False
+                    for v in r.values:
+                        li = local.get(v)
+                        if li is not None:
+                            allow[i, lo + li] = True
+                else:
+                    for v in r.values:
+                        li = local.get(v)
+                        if li is not None:
+                            allow[i, lo + li] = False
+            else:
+                allow[i, lo:hi] = [r.has(v) for v in dictionary.values_of(key)]
             out[i, k] = r.complement
             defined[i, k] = True
             escape[i, k] = r.operator() in (OP_NOT_IN, OP_DOES_NOT_EXIST)
@@ -247,32 +292,50 @@ def encode_snapshot(
             row.add(tid)
         tmpl_type_mask_rows.append(row)
 
-    # memoized per-pod requests: requests_for_pods walks containers and is
-    # called for the FFD key, the resource-name union, and the request rows
-    req_cache = {}
+    # -- pod spec-equivalence classes (the 50k-scale lever) ----------------
+    # Real batches are deployment-dominated: thousands of pods share a
+    # handful of specs. Everything the encoder derives from a pod —
+    # Requirements.from_pod, requests, toleration columns, topology
+    # ownership/selection — is a pure function of (namespace, labels, spec),
+    # so it is computed once per distinct signature and GATHERED to the pod
+    # axis with numpy indexing. This replaces the reference's per-pod
+    # constraint evaluation (scheduler.go:96-133) with per-CLASS evaluation.
+    P0 = len(pods)
+    sig_of: Dict[Tuple, int] = {}
+    uidx0 = np.empty(P0, dtype=np.int32)
+    uniq_pods: List[Pod] = []
+    for i, p in enumerate(pods):
+        sig = _pod_spec_signature(p)
+        u = sig_of.get(sig)
+        if u is None:
+            u = len(uniq_pods)
+            sig_of[sig] = u
+            uniq_pods.append(p)
+        uidx0[i] = u
+    U = len(uniq_pods)
 
-    def pod_requests_of(p):
-        rl = req_cache.get(id(p))
-        if rl is None:
-            rl = resources_util.requests_for_pods(p)
-            req_cache[id(p)] = rl
-        return rl
+    req_u = [resources_util.requests_for_pods(p) for p in uniq_pods]
 
-    def ffd_key(p):
-        rl = pod_requests_of(p)
-        return (
-            -rl.get("cpu", 0.0),
-            -rl.get("memory", 0.0),
-            p.metadata.creation_timestamp or 0.0,
-            p.metadata.uid,
-        )
-
-    order = np.array(
-        sorted(range(len(pods)), key=lambda i: ffd_key(pods[i])), dtype=np.int32
+    # FFD sort (cpu desc, mem desc, creation, uid — queue.go:74-110) done as
+    # one vectorized lexsort over gathered per-class request columns
+    cpu_u = np.array([rl.get("cpu", 0.0) for rl in req_u], dtype=np.float64)
+    mem_u = np.array([rl.get("memory", 0.0) for rl in req_u], dtype=np.float64)
+    ts = np.array(
+        [p.metadata.creation_timestamp or 0.0 for p in pods], dtype=np.float64
+    )
+    uids = np.array([p.metadata.uid for p in pods])
+    order = (
+        np.lexsort((uids, ts, -mem_u[uidx0], -cpu_u[uidx0])).astype(np.int32)
+        if P0
+        else np.zeros(0, np.int32)
     )
     pods_sorted = [pods[i] for i in order]
+    uidx = uidx0[order]
 
-    pod_reqs_list = [Requirements.from_pod(p) for p in pods_sorted]
+    def ffd_key_of_class(u):
+        return (-cpu_u[u], -mem_u[u])
+
+    pod_reqs_u = [Requirements.from_pod(p) for p in uniq_pods]
     tmpl_reqs_list = [t.requirements for t in templates]
     type_reqs_list = [it.requirements for it in all_types]
     exist_reqs_list = []
@@ -288,14 +351,16 @@ def encode_snapshot(
     )
 
     domains = build_domains(provisioners, instance_types)
-    host_topology = HostTopology(kube_client, cluster, domains, pods_sorted)
+    host_topology = HostTopology(
+        kube_client, cluster, domains, pods_sorted, update_pods=uniq_pods
+    )
     topo_groups = list(host_topology.topologies.values()) + list(
         host_topology.inverse_topologies.values()
     )
 
     # -- dictionary closure ------------------------------------------------
     dictionary = LabelDictionary()
-    for reqs in pod_reqs_list + tmpl_reqs_list + type_reqs_list + exist_reqs_list:
+    for reqs in pod_reqs_u + tmpl_reqs_list + type_reqs_list + exist_reqs_list:
         _collect_requirement_values(reqs, dictionary)
     for tg in topo_groups:
         if tg.key == LABEL_HOSTNAME:
@@ -318,7 +383,7 @@ def encode_snapshot(
     # -- resources ---------------------------------------------------------
     extended = sorted(
         set().union(
-            *[set(pod_requests_of(p)) for p in pods_sorted] or [set()],
+            *[set(rl) for rl in req_u] or [set()],
             *[set(it.allocatable()) for it in all_types] or [set()],
         )
         - set(CORE_RESOURCES)
@@ -336,9 +401,12 @@ def encode_snapshot(
 
     P, J, T, K, V = len(pods_sorted), len(templates), len(all_types), dictionary.K, dictionary.V
 
-    pod_requests = np.stack(
-        [encode_resources(pod_requests_of(p)) for p in pods_sorted]
-    ) if P else np.zeros((0, R), np.float32)
+    pod_requests_u = (
+        np.stack([encode_resources(rl) for rl in req_u])
+        if U
+        else np.zeros((0, R), np.float32)
+    )
+    pod_requests = pod_requests_u[uidx] if P else np.zeros((0, R), np.float32)
 
     # daemon overhead per template (scheduler.go:253-270)
     tmpl_daemon = np.zeros((J, R), dtype=np.float32)
@@ -387,10 +455,11 @@ def encode_snapshot(
     ).astype(np.float32)
 
     # -- taints ------------------------------------------------------------
-    pod_tol = np.zeros((P, J), dtype=bool)
+    pod_tol_u = np.zeros((U, J), dtype=bool)
     for j, template in enumerate(templates):
-        for i, p in enumerate(pods_sorted):
-            pod_tol[i, j] = taints_mod.tolerates(template.taints, p) is None
+        for u, p in enumerate(uniq_pods):
+            pod_tol_u[u, j] = taints_mod.tolerates(template.taints, p) is None
+    pod_tol = pod_tol_u[uidx] if P else np.zeros((0, J), dtype=bool)
 
     well_known = np.array(
         [k in api_labels.WELL_KNOWN_LABELS or k == LABEL_HOSTNAME for k in dictionary.keys],
@@ -398,9 +467,9 @@ def encode_snapshot(
     )
 
     # -- existing nodes ----------------------------------------------------
-    # pod x node toleration is evaluated once per (pod, taint-signature):
-    # cluster nodes overwhelmingly share a handful of taint sets, so this
-    # turns the P x E double loop into P x #signatures
+    # pod x node toleration is evaluated once per (spec class,
+    # taint-signature): cluster nodes overwhelmingly share a handful of
+    # taint sets, so the P x E double loop becomes #classes x #signatures
     E = len(state_nodes)
     exist_used = np.zeros((E, R), dtype=np.float32)
     exist_cap = np.zeros((E, R), dtype=np.float32)
@@ -426,11 +495,12 @@ def encode_snapshot(
         )
         col = taint_sig_cols.get(sig)
         if col is None:
-            col = np.fromiter(
-                (taints_mod.tolerates(node_taints, p) is None for p in pods_sorted),
+            col_u = np.fromiter(
+                (taints_mod.tolerates(node_taints, p) is None for p in uniq_pods),
                 dtype=bool,
-                count=P,
+                count=U,
             )
+            col = col_u[uidx] if P else np.zeros(0, dtype=bool)
             taint_sig_cols[sig] = col
         pod_tol_exist[:, e] = col
 
@@ -444,15 +514,25 @@ def encode_snapshot(
         dictionary,
         n_slots,
         [n.hostname() for n in state_nodes],
+        uidx=uidx,
+        uniq_pods=uniq_pods,
+    )
+
+    # -- pod requirement rows: encode per class, gather --------------------
+    pod_reqs_u_arr = encode_reqsets(pod_reqs_u, dictionary)
+    pod_reqs_arr = ReqSetArrays(
+        allow=pod_reqs_u_arr.allow[uidx],
+        out=pod_reqs_u_arr.out[uidx],
+        defined=pod_reqs_u_arr.defined[uidx],
+        escape=pod_reqs_u_arr.escape[uidx],
     )
 
     # -- pod equivalence classes (items) -----------------------------------
-    pod_reqs_arr = encode_reqsets(pod_reqs_list, dictionary)
     item_of_pod, item_counts, item_rep, item_members = _build_items(
-        pod_reqs_arr, pod_requests, pod_tol, pod_tol_exist, topo_meta, topo_arrays,
+        uidx, topo_meta, topo_arrays,
         # resource components only (drop creation-time/uid tie-breakers so
         # same-sized classes form one ordering group)
-        ffd_keys=[ffd_key(p)[:2] for p in pods_sorted],
+        ffd_key_of_class=ffd_key_of_class,
     )
 
     return EncodedSnapshot(
@@ -492,11 +572,10 @@ def encode_snapshot(
     )
 
 
-def _build_items(pod_reqs, pod_requests, pod_tol, pod_tol_exist, topo_meta,
-                 topo_arrays, ffd_keys=None):
-    """Group FFD-sorted pods into equivalence classes ("items") by their full
-    constraint encoding. Classes owning (or selected into) an anti-affinity
-    group are expanded back to count=1 items: each placement's "block out all
+def _build_items(uidx, topo_meta, topo_arrays, ffd_key_of_class=None):
+    """Group FFD-sorted pods into items by spec-equivalence class (uidx[i] =
+    pod i's class). Classes owning (or selected into) an anti-affinity group
+    are expanded back to count=1 items: each placement's "block out all
     possible domains" record (topology.go:120-143) changes the next
     placement's viability, so the reference's per-pod re-evaluation
     (scheduler.go:96-133) must be preserved. Spread and affinity owners stay
@@ -508,7 +587,7 @@ def _build_items(pod_reqs, pod_requests, pod_tol, pod_tol_exist, topo_meta,
     Returns (item_of_pod [P], item_counts [I], item_rep [I], members)."""
     from karpenter_core_tpu.ops.topology import TOPO_ANTI
 
-    P = pod_requests.shape[0]
+    P = len(uidx)
     if P == 0:
         return (
             np.zeros(0, np.int32),
@@ -516,27 +595,15 @@ def _build_items(pod_reqs, pod_requests, pod_tol, pod_tol_exist, topo_meta,
             np.zeros(0, np.int32),
             [],
         )
-    parts = [
-        np.ascontiguousarray(pod_reqs.allow).view(np.uint8).reshape(P, -1),
-        np.ascontiguousarray(pod_reqs.out).view(np.uint8).reshape(P, -1),
-        np.ascontiguousarray(pod_reqs.defined).view(np.uint8).reshape(P, -1),
-        np.ascontiguousarray(pod_reqs.escape).view(np.uint8).reshape(P, -1),
-        np.ascontiguousarray(pod_requests).view(np.uint8).reshape(P, -1),
-        np.ascontiguousarray(pod_tol).view(np.uint8).reshape(P, -1),
-        np.ascontiguousarray(pod_tol_exist).view(np.uint8).reshape(P, -1),
-    ]
     expand_pod = np.zeros(P, dtype=bool)
     if topo_meta is not None:
         owner = topo_arrays.owner  # [G, P]
         sel = topo_arrays.sel
-        parts.append(np.ascontiguousarray(owner.T).view(np.uint8).reshape(P, -1))
-        parts.append(np.ascontiguousarray(sel.T).view(np.uint8).reshape(P, -1))
         for g, gm in enumerate(topo_meta.groups):
             if gm.gtype == TOPO_ANTI:
                 applies = sel[g] if gm.is_inverse else owner[g]
                 expand_pod |= applies
-    sig = np.concatenate(parts, axis=1)
-    keys = {}
+    class_item: Dict[int, int] = {}
     item_of_pod = np.zeros(P, dtype=np.int32)
     counts: List[int] = []
     reps: List[int] = []
@@ -548,11 +615,11 @@ def _build_items(pod_reqs, pod_requests, pod_tol, pod_tol_exist, topo_meta,
             reps.append(i)
             members.append([i])
         else:
-            key = sig[i].tobytes()
-            item = keys.get(key)
+            u = int(uidx[i])
+            item = class_item.get(u)
             if item is None:
                 item = len(counts)
-                keys[key] = item
+                class_item[u] = item
                 counts.append(0)
                 reps.append(i)
                 members.append([])
@@ -566,7 +633,7 @@ def _build_items(pod_reqs, pod_requests, pod_tol, pod_tol_exist, topo_meta,
     # onto those nodes (machines rank by ascending pod count,
     # scheduler.go:186-193). Processing them after a bulk class would
     # open the spread nodes too late to be reused.
-    if topo_meta is not None and ffd_keys is not None:
+    if topo_meta is not None and ffd_key_of_class is not None:
         from karpenter_core_tpu.ops.topology import TOPO_SPREAD
 
         hs_groups = [
@@ -582,7 +649,11 @@ def _build_items(pod_reqs, pod_requests, pod_tol, pod_tol_exist, topo_meta,
             ]
             order = sorted(
                 range(len(counts)),
-                key=lambda it: (ffd_keys[reps[it]], 0 if owns_hs[it] else 1, it),
+                key=lambda it: (
+                    ffd_key_of_class(uidx[reps[it]]),
+                    0 if owns_hs[it] else 1,
+                    it,
+                ),
             )
             inv = np.zeros(len(counts), dtype=np.int32)
             for new, old in enumerate(order):

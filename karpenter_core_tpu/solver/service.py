@@ -235,13 +235,18 @@ class RemoteSolver:
     locally. Falls back to raising on transport errors (the provisioning
     controller's fallback_solver takes over)."""
 
-    def __init__(self, target: str, max_nodes: int = 1024, max_relax_rounds: int = 3,
+    def __init__(self, target: str, max_nodes: int = 1024,
+                 max_relax_rounds: int = None,
                  timeout: float = 120.0):
         import grpc
 
         self.channel = grpc.insecure_channel(target)
         self.timeout = timeout
         self.max_nodes = max_nodes
+        if max_relax_rounds is None:
+            from karpenter_core_tpu.solver.tpu_solver import DEFAULT_MAX_RELAX_ROUNDS
+
+            max_relax_rounds = DEFAULT_MAX_RELAX_ROUNDS
         self.max_relax_rounds = max_relax_rounds
         self._solve = self.channel.unary_unary(
             f"/{SERVICE}/Solve",

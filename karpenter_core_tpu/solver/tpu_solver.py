@@ -64,12 +64,41 @@ def _reqset_to_dict(rs: ReqSetArrays) -> Dict[str, np.ndarray]:
     return {"allow": rs.allow, "out": rs.out, "defined": rs.defined, "escape": rs.escape}
 
 
+# run()'s positional argument order — device_args() produces this tuple and
+# donate/shard specs index into it by name through this list, so a signature
+# change breaks loudly (asserted in make_device_run) instead of donating the
+# wrong buffer.
+RUN_ARG_NAMES = (
+    "pod_arrays", "tmpl", "tmpl_daemon", "tmpl_type_mask", "types",
+    "type_alloc", "type_capacity", "type_offering_ok", "pod_tol_all",
+    "exist", "exist_used", "exist_cap", "well_known", "remaining0",
+    "topo_counts0", "topo_hcounts0", "topo_doms0", "topo_terms",
+)
+# arrays that flow through the scan carry unchanged in shape/dtype
+# (remaining0 -> state.remaining, topo_* -> state.tcounts/thost/tdoms):
+# donating lets XLA alias them instead of allocating fresh HBM
+DONATE_ARGNUMS = tuple(
+    RUN_ARG_NAMES.index(n)
+    for n in ("remaining0", "topo_counts0", "topo_hcounts0", "topo_doms0")
+)
+
+# safety cap on relaxation re-solve rounds; sized above the ~6 preference
+# tiers (preferences.go:36-56) so the fixpoint, not the cap, terminates —
+# shared by TPUSolver, RemoteSolver, and NativeSolver
+DEFAULT_MAX_RELAX_ROUNDS = 16
+
+
 def solve_with_relaxation(solve_once, pods, provisioners, instance_types,
                           max_relax_rounds: int) -> "SolveResult":
     """Shared driver: guard degenerate inputs, deepcopy pods (relaxation
     mutates specs), run solve_once, relax EVERY failed pod between rounds
     (preferences.go order) — used by TPUSolver, RemoteSolver, and any other
-    Solver implementation."""
+    Solver implementation.
+
+    Termination matches the reference (scheduler.go:114-123): rounds continue
+    until no failed pod can relax further (Preferences.relax fixpoint);
+    max_relax_rounds is only a safety cap and is sized (16) above the ~6
+    relaxation tiers so real workloads always reach exhaustion."""
     if not pods:
         return SolveResult()
     if not provisioners or not any(instance_types.values()):
@@ -139,7 +168,7 @@ def make_device_run(segments, zone_seg, ct_seg, topo_meta, n_slots,
     def run(pod_arrays, tmpl, tmpl_daemon, tmpl_type_mask, types, type_alloc,
             type_capacity, type_offering_ok, pod_tol_all, exist, exist_used,
             exist_cap, well_known, remaining0, topo_counts0, topo_hcounts0,
-            topo_doms0, topo_terms):
+            topo_doms0, topo_terms):  # order must match RUN_ARG_NAMES
         E = exist_used.shape[0]
         N = n_slots
         R = type_alloc.shape[1]
@@ -201,6 +230,9 @@ def make_device_run(segments, zone_seg, ct_seg, topo_meta, n_slots,
         )
         return log, ptr, state
 
+    import inspect
+
+    assert tuple(inspect.signature(run).parameters) == RUN_ARG_NAMES
     return run
 
 
@@ -322,9 +354,12 @@ class TPUSolver:
     repeated solves reuse the compiled program.
     """
 
-    def __init__(self, max_nodes: int = 1024, max_relax_rounds: int = 3, donate: bool = True):
+    def __init__(self, max_nodes: int = 1024,
+                 max_relax_rounds: int = DEFAULT_MAX_RELAX_ROUNDS,
+                 donate: bool = True):
         self.max_nodes = max_nodes
         self.max_relax_rounds = max_relax_rounds
+        self.donate = donate
         self._compiled = {}
 
     # -- public API --------------------------------------------------------
@@ -367,7 +402,9 @@ class TPUSolver:
         geom, run = build_device_solve(snap, self.max_nodes)
         fn = self._compiled.get(geom)
         if fn is None:
-            fn = jax.jit(run)
+            # inputs are fresh numpy per solve, so donation invalidates
+            # nothing on the host
+            fn = jax.jit(run, donate_argnums=DONATE_ARGNUMS if self.donate else ())
             self._compiled[geom] = fn
         args = device_args(snap, provisioners)
         log, ptr, state = fn(*args)
