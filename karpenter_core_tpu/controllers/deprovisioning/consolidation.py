@@ -174,9 +174,21 @@ class Consolidation:
         return self.validate_command(cmd, remaining)
 
     def _wait(self, seconds: float) -> None:
+        """Clock-driven TTL wait (validation.go:60-67). Under the real clock
+        this sleeps; under a steppable test clock (anything exposing
+        `.sleep`, e.g. testing.FakeClock) it blocks until the clock is
+        ADVANCED past the deadline by another thread — the same contract as
+        the reference's clock.Sleep on a FakeClock, so the 15s revalidation
+        window is actually exercised in tests instead of no-opped. A bare
+        callable clock with neither wall-time nor step semantics waits
+        nothing."""
         if seconds <= 0:
             return
-        time.sleep(seconds) if self.clock is time.time else None
+        sleep = getattr(self.clock, "sleep", None)
+        if sleep is not None:
+            sleep(seconds)
+        elif self.clock is time.time:
+            time.sleep(seconds)
 
     def _blocked(self, candidates: List[CandidateNode], reason: str) -> None:
         if self.recorder and len(candidates) == 1:
@@ -233,8 +245,13 @@ class MultiNodeConsolidation(Consolidation):
     def first_n_consolidation_ladder(self, candidates: List[CandidateNode]) -> Command:
         """Evaluate a geometric ladder of prefix sizes; keep the largest
         feasible. Replaces the reference's sequential binary search
-        (multinodeconsolidation.go:87-113) with independently dispatchable
-        solves (each one device program on the TPU path)."""
+        (multinodeconsolidation.go:87-113).
+
+        On a solver with batched-replan support (TPUSolver), the whole
+        ladder is screened in ONE vmapped device dispatch over a shared
+        union encode (solver/replan.py) and only the winning prefix is
+        confirmed through the exact solve path; otherwise each rung is a
+        full solve (host fallback)."""
         if len(candidates) < 2:
             return Command(action=ACTION_DO_NOTHING)
         n = len(candidates)
@@ -244,21 +261,85 @@ class MultiNodeConsolidation(Consolidation):
                 for i in range(self.LADDER_POINTS)
             }
         ) if n > 2 else [2]
+
+        if getattr(self.provisioning.solver, "supports_batched_replan", False):
+            return self._ladder_batched(candidates, sizes)
         best = Command(action=ACTION_DO_NOTHING)
         for size in sizes:
-            prefix = candidates[:size]
-            cmd = self.compute_consolidation(prefix)
-            if cmd.action == ACTION_REPLACE:
-                cmd.replacement_machines[0].instance_type_options = self._filter_out_same_type(
-                    cmd.replacement_machines[0], prefix
-                )
-                if not cmd.replacement_machines[0].instance_type_options:
-                    cmd = Command(action=ACTION_DO_NOTHING)
+            cmd = self._evaluate_prefix(candidates, size)
             if cmd.action in (ACTION_REPLACE, ACTION_DELETE):
                 best = cmd
             else:
                 break  # larger prefixes are monotonically harder
         return best
+
+    def _evaluate_prefix(self, candidates: List[CandidateNode], size: int) -> Command:
+        """Exact evaluation of one prefix: full solve + price/same-type
+        rules."""
+        prefix = candidates[:size]
+        cmd = self.compute_consolidation(prefix)
+        if cmd.action == ACTION_REPLACE:
+            cmd.replacement_machines[0].instance_type_options = self._filter_out_same_type(
+                cmd.replacement_machines[0], prefix
+            )
+            if not cmd.replacement_machines[0].instance_type_options:
+                cmd = Command(action=ACTION_DO_NOTHING)
+        return cmd
+
+    def _ladder_batched(self, candidates: List[CandidateNode],
+                        sizes: List[int]) -> Command:
+        """One vmapped screen over all rungs, then exact confirmation of the
+        largest screen-feasible prefix, stepping down on disagreement (the
+        screen checks schedulability and machine count; price and same-type
+        rules only apply at confirmation)."""
+        from karpenter_core_tpu.solver.replan import batched_ladder_screen
+
+        try:
+            screens = batched_ladder_screen(
+                self.kube_client, self.cluster, self.provisioning, candidates,
+                sizes, max_nodes=getattr(
+                    self.provisioning.solver, "max_nodes", 1024
+                ),
+            )
+        except CandidateNodeDeletingError:
+            return Command(action=ACTION_DO_NOTHING)
+        feasible = []
+        blocked = []
+        for screen in screens:
+            if screen.all_scheduled and screen.conclusive and screen.n_new_machines <= 1:
+                feasible.append(screen.size)
+            else:
+                blocked = [s.size for s in screens[len(feasible):]]
+                break  # larger prefixes are monotonically harder
+        for size in reversed(feasible):
+            cmd = self._evaluate_prefix(candidates, size)
+            if cmd.action in (ACTION_REPLACE, ACTION_DELETE):
+                return cmd
+        # The screen is the round-0 kernel only — no preference relaxation
+        # (scheduler.go:114-123 relaxes until exhaustion). A negative screen
+        # is therefore inconclusive when any involved pod still carries a
+        # relaxable soft constraint; confirm those rungs through the exact
+        # (relaxing) path before concluding nothing consolidates.
+        if blocked and self._any_relaxable(candidates[: blocked[-1]]):
+            best = Command(action=ACTION_DO_NOTHING)
+            for size in blocked:
+                cmd = self._evaluate_prefix(candidates, size)
+                if cmd.action in (ACTION_REPLACE, ACTION_DELETE):
+                    best = cmd
+                else:
+                    break
+            return best
+        return Command(action=ACTION_DO_NOTHING)
+
+    def _any_relaxable(self, candidates: List[CandidateNode]) -> bool:
+        from karpenter_core_tpu.controllers.provisioning.scheduling.preferences import (
+            Preferences,
+        )
+
+        prefs = Preferences()
+        pods = [p for c in candidates for p in c.pods]
+        pods += list(self.provisioning.get_pending_pods())
+        return any(prefs.is_relaxable(p) for p in pods)
 
     def _filter_out_same_type(self, replacement, consolidated: List[CandidateNode]):
         """multinodeconsolidation.go:133-166: prevent replacing with the same

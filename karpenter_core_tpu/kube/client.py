@@ -80,6 +80,30 @@ class InMemoryKubeClient:
             self._notify(kind, "MODIFIED", stored)
             return copy.deepcopy(stored)
 
+    def compare_and_update(self, obj, expected_rv: int) -> object:
+        """Optimistic-concurrency update: raises ConflictError unless the
+        stored resource_version still equals expected_rv — the apiserver's
+        409 contract. Lease-based leader election depends on this to
+        arbitrate between processes."""
+        kind = _kind_of(obj)
+        with self._mu:
+            key = NamespacedName(obj.metadata.namespace, obj.metadata.name)
+            store = self._objects.setdefault(kind, {})
+            cur = store.get(key)
+            if cur is None:
+                raise NotFoundError(f"{kind} {key} not found")
+            if cur.metadata.resource_version != expected_rv:
+                raise ConflictError(
+                    f"{kind} {key} resource_version "
+                    f"{cur.metadata.resource_version} != expected {expected_rv}"
+                )
+            self._rv += 1
+            obj.metadata.resource_version = self._rv
+            stored = copy.deepcopy(obj)
+            store[key] = stored
+            self._notify(kind, "MODIFIED", stored)
+            return copy.deepcopy(stored)
+
     def apply(self, obj) -> object:
         """Create-or-update."""
         kind = _kind_of(obj)

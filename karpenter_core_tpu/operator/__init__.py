@@ -145,6 +145,12 @@ class Operator:
             ("Machine", MachineInformer(self.cluster).handle),
             ("Provisioner", ProvisionerInformer(self.cluster).handle),
         ]
+        import logging
+        import queue as queue_mod
+
+        from karpenter_core_tpu.operator.controller import RECONCILE_ERRORS
+
+        log = logging.getLogger("karpenter.operator")
         for kind, handler in watches:
             q = self.kube_client.watch(kind)
 
@@ -152,52 +158,53 @@ class Operator:
                 while not self._stop.is_set():
                     try:
                         event, obj = q.get(timeout=0.1)
-                    except Exception:
+                    except queue_mod.Empty:
                         continue
-                    handler(event, obj)
-                    if kind == "Pod":
-                        self.pod_controller.reconcile(obj)
-                        self.pod_metrics.reconcile(obj)
+                    try:
+                        handler(event, obj)
+                        if kind == "Pod":
+                            self.pod_controller.reconcile(obj)
+                            self.pod_metrics.reconcile(obj)
+                    except Exception:
+                        RECONCILE_ERRORS.inc(labels={"controller": f"watch-{kind}"})
+                        log.exception("watch pump failed (kind=%s)", kind)
 
             t = threading.Thread(target=pump, daemon=True)
             t.start()
             self._threads.append(t)
 
-        def provision_loop():
-            while not self._stop.is_set():
-                try:
-                    self.provisioning.reconcile(wait_timeout=0.2)
-                except Exception:
-                    pass
+        from karpenter_core_tpu.operator.controller import Singleton
 
-        def deprovision_loop():
-            while not self._stop.is_set():
-                try:
-                    if self.deprovisioning is not None:
-                        self.deprovisioning.reconcile()
-                except Exception:
-                    pass
-                self._stop.wait(1.0)
+        def provision_once():
+            self.provisioning.reconcile(wait_timeout=0.2)
+            return 0.0  # the batcher is the rate limiter
 
-        def housekeeping_loop():
-            while not self._stop.is_set():
-                try:
-                    for machine in self.kube_client.list("Machine"):
-                        self.machine_controller.reconcile(machine)
-                    for node in self.kube_client.list("Node"):
-                        self.node_controller.reconcile(node)
-                        self.termination_controller.reconcile(node)
-                    for provisioner in self.kube_client.list("Provisioner"):
-                        self.counter.reconcile(provisioner)
-                    self.node_metrics.reconcile()
-                except Exception:
-                    pass
-                self._stop.wait(1.0)
+        def deprovision_once():
+            if self.deprovisioning is not None:
+                self.deprovisioning.reconcile()
+            return None
 
-        for target in (provision_loop, deprovision_loop, housekeeping_loop):
-            t = threading.Thread(target=target, daemon=True)
-            t.start()
-            self._threads.append(t)
+        def housekeeping_once():
+            for machine in self.kube_client.list("Machine"):
+                self.machine_controller.reconcile(machine)
+            for node in self.kube_client.list("Node"):
+                self.node_controller.reconcile(node)
+                self.termination_controller.reconcile(node)
+            for provisioner in self.kube_client.list("Provisioner"):
+                self.counter.reconcile(provisioner)
+            self.node_metrics.reconcile()
+            return None
+
+        # rate-limited singleton loops with duration/error instrumentation
+        # (singleton.go:58-129) — a crashing reconcile is logged, counted,
+        # and backed off, never silently swallowed
+        self.singletons = [
+            Singleton("provisioning", provision_once, interval=0.0),
+            Singleton("deprovisioning", deprovision_once, interval=1.0),
+            Singleton("housekeeping", housekeeping_once, interval=1.0),
+        ]
+        for singleton in self.singletons:
+            self._threads.append(singleton.start(self._stop))
 
     def stop(self) -> None:
         self._stop.set()

@@ -52,13 +52,18 @@ def settings_from_env() -> Settings:
     )
 
 
-def resolve_settings(kube_client) -> Settings:
-    """ConfigMap karpenter-global-settings wins over env defaults
+def resolve_settings(kube_client, options=None) -> Settings:
+    """ConfigMap karpenter-global-settings wins over flags/env defaults
     (injection/injection.go:116-127 bootstraps settings from the ConfigMap)."""
     if kube_client is not None:
         for cm in kube_client.list("ConfigMap"):
             if cm.metadata.name == "karpenter-global-settings":
                 return Settings.from_config_map(cm.data)
+    if options is not None:
+        return Settings(
+            batch_idle_duration=options.batch_idle_seconds,
+            batch_max_duration=options.batch_max_seconds,
+        )
     return settings_from_env()
 
 
@@ -72,8 +77,41 @@ def configure_logging() -> None:
     )
 
 
+def _debug_threads() -> str:
+    """All thread stacks — the goroutine-dump analog of the reference's
+    pprof handlers (operator/profiling.go:25), for diagnosing stuck loops."""
+    import sys
+    import traceback
+
+    frames = sys._current_frames()
+    names = {t.ident: t.name for t in threading.enumerate()}
+    out = []
+    for ident, frame in frames.items():
+        out.append(f"--- thread {names.get(ident, '?')} ({ident}) ---")
+        out.extend(line.rstrip() for line in traceback.format_stack(frame))
+    return "\n".join(out) + "\n"
+
+
+def _debug_backend() -> str:
+    """Device + compile-cache facts for the solver process."""
+    try:
+        import jax
+
+        dev = jax.devices()[0]
+        info = {
+            "platform": dev.platform,
+            "device_kind": dev.device_kind,
+            "device_count": len(jax.devices()),
+            "live_arrays": len(jax.live_arrays()),
+        }
+    except Exception as exc:  # backend may be unavailable; report, don't die
+        info = {"error": f"{type(exc).__name__}: {exc}"}
+    return json.dumps(info) + "\n"
+
+
 class _HealthHandler(BaseHTTPRequestHandler):
     operator = None  # set by serve_health
+    profiling_enabled = False  # set from KARPENTER_ENABLE_PROFILING
 
     def do_GET(self):
         if self.path == "/metrics":
@@ -81,6 +119,12 @@ class _HealthHandler(BaseHTTPRequestHandler):
             ctype = "text/plain; version=0.0.4"
         elif self.path in ("/healthz", "/readyz"):
             body = json.dumps({"status": "ok"}).encode()
+            ctype = "application/json"
+        elif self.path == "/debug/threads" and self.profiling_enabled:
+            body = _debug_threads().encode()
+            ctype = "text/plain"
+        elif self.path == "/debug/backend" and self.profiling_enabled:
+            body = _debug_backend().encode()
             ctype = "application/json"
         else:
             self.send_response(404)
@@ -96,52 +140,82 @@ class _HealthHandler(BaseHTTPRequestHandler):
         pass
 
 
-def serve_health(operator, port: int) -> ThreadingHTTPServer:
+def serve_health(operator, port: int, profiling: bool = False) -> ThreadingHTTPServer:
     _HealthHandler.operator = operator
+    # opt-in debug handlers, like the reference's --enable-profiling pprof
+    # registration (operator.go:124-126)
+    _HealthHandler.profiling_enabled = profiling
     server = ThreadingHTTPServer(("0.0.0.0", port), _HealthHandler)
     threading.Thread(target=server.serve_forever, daemon=True).start()
     return server
 
 
-def run(cloud_provider, kube_client=None, stop_event=None):
+def run(cloud_provider, kube_client=None, stop_event=None, options=None):
     """Assemble and run the control plane until stop_event (or a signal).
 
     Settings resolve from the client's karpenter-global-settings ConfigMap
     when the embedding vendor passes an API-backed client; the standalone
-    in-memory client has no ConfigMap, so env vars apply."""
+    in-memory client has no ConfigMap, so flags/env apply. With leader
+    election enabled (the default, operator.go:108-110) the controllers only
+    start once the lease is held, and losing it stops the process."""
+    from karpenter_core_tpu.operator.options import parse_options
+
+    # embedded path: resolve env vars through the same flag layer as the CLI
+    # (flags > env > defaults), so KARPENTER_* documented above keep working
+    opts = options or parse_options([])
     configure_logging()
+    opts.apply_memory_limit()
     if kube_client is None:
         from karpenter_core_tpu.kube.client import InMemoryKubeClient
 
         kube_client = InMemoryKubeClient()
+    if opts.solver_endpoint:
+        from karpenter_core_tpu.solver.service import RemoteSolver
+
+        solver = RemoteSolver(opts.solver_endpoint)
+    else:
+        solver = solver_from_env()
     operator = new_operator(
         cloud_provider,
         kube_client=kube_client,
-        settings=resolve_settings(kube_client),
-        solver=solver_from_env(),
-        with_webhooks=True,
+        settings=resolve_settings(kube_client, opts),
+        solver=solver,
+        with_webhooks=not opts.disable_webhook,
     )
-    port = int(os.environ.get("KARPENTER_METRICS_PORT", "8000"))
-    health = serve_health(operator, port)
-    operator.start()
-    print(f"controller running; health/metrics on :{port}", flush=True)
-
+    health = serve_health(operator, opts.metrics_port, profiling=opts.enable_profiling)
     stop = stop_event or threading.Event()
     try:
         for sig in (signal.SIGTERM, signal.SIGINT):
             signal.signal(sig, lambda *_: stop.set())
     except ValueError:
         pass  # not the main thread (embedded/test use)
+
+    elector = None
+    if opts.enable_leader_election:
+        from karpenter_core_tpu.operator.leaderelection import LeaderElector
+
+        elector = LeaderElector(kube_client)
+        if not elector.acquire_blocking(stop):
+            health.shutdown()
+            return operator  # stopped before leadership
+        elector.start_renewing(stop)
+    operator.start()
+    print(
+        f"controller running; health/metrics on :{opts.metrics_port}", flush=True
+    )
     stop.wait()
     operator.stop()
+    if elector is not None:
+        elector.release()
     health.shutdown()
     return operator
 
 
 def main():
     from karpenter_core_tpu.cloudprovider.fake import FakeCloudProvider
+    from karpenter_core_tpu.operator.options import parse_options
 
-    run(FakeCloudProvider())
+    run(FakeCloudProvider(), options=parse_options())
 
 
 if __name__ == "__main__":

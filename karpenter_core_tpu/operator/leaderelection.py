@@ -1,0 +1,102 @@
+"""Lease-based leader election.
+
+Mirrors reference operator.go:108-110 (controller-runtime's
+LeaderElectionResourceLock "leases", id "karpenter-leader-election"): the
+control plane only runs while holding the lease; a standby acquires it when
+the holder's renew deadline lapses. The lease record is a ConfigMap-shaped
+object in the kube store, so two processes sharing an API-backed client
+arbitrate correctly; the in-memory single-process client acquires trivially.
+"""
+from __future__ import annotations
+
+import threading
+import time
+import uuid
+from typing import Optional
+
+LEASE_NAME = "karpenter-leader-election"
+LEASE_NAMESPACE = "kube-system"
+LEASE_DURATION = 15.0  # controller-runtime defaults
+RENEW_PERIOD = 2.0
+RETRY_PERIOD = 2.0
+
+
+class LeaderElector:
+    def __init__(self, kube_client, identity: Optional[str] = None,
+                 clock=time.time, lease_duration: float = LEASE_DURATION):
+        self.kube_client = kube_client
+        self.identity = identity or f"karpenter-{uuid.uuid4().hex[:8]}"
+        self.clock = clock
+        self.lease_duration = lease_duration
+        self._renew_thread: Optional[threading.Thread] = None
+
+    def _lease(self):
+        return self.kube_client.get("ConfigMap", LEASE_NAMESPACE, LEASE_NAME)
+
+    def try_acquire(self) -> bool:
+        """Acquire (or re-acquire) the lease if free or expired.
+
+        Both transitions are compare-and-swap shaped so two standbys racing
+        for an expired lease cannot both win: creation loses to
+        AlreadyExists (another process created first) and takeover goes
+        through compare_and_update against the observed resource_version
+        (the apiserver's 409 contract); a conflict means someone else
+        renewed or took the lease first, so this attempt simply fails and
+        the caller retries."""
+        now = self.clock()
+        lease = self._lease()
+        if lease is None:
+            from karpenter_core_tpu.kube.objects import ConfigMap, ObjectMeta
+
+            lease = ConfigMap(
+                metadata=ObjectMeta(name=LEASE_NAME, namespace=LEASE_NAMESPACE),
+                data={"holder": self.identity, "renew_time": str(now)},
+            )
+            try:
+                self.kube_client.create(lease)
+            except Exception:  # AlreadyExists: lost the create race
+                return False
+            return True
+        holder = lease.data.get("holder", "")
+        renew_time = float(lease.data.get("renew_time", "0"))
+        if holder == self.identity or now - renew_time > self.lease_duration:
+            observed_rv = lease.metadata.resource_version
+            lease.data["holder"] = self.identity
+            lease.data["renew_time"] = str(now)
+            cas = getattr(self.kube_client, "compare_and_update", None)
+            try:
+                if cas is not None:
+                    cas(lease, observed_rv)
+                else:
+                    self.kube_client.update(lease)
+            except Exception:  # conflict: another process moved first
+                return False
+            return True
+        return False
+
+    def acquire_blocking(self, stop: threading.Event) -> bool:
+        """Block until the lease is held or stop is set. Returns held."""
+        while not stop.is_set():
+            if self.try_acquire():
+                return True
+            stop.wait(RETRY_PERIOD)
+        return False
+
+    def start_renewing(self, stop: threading.Event) -> None:
+        def renew():
+            while not stop.is_set():
+                stop.wait(RENEW_PERIOD)
+                if not self.try_acquire():  # lost the lease: stop the plane
+                    stop.set()
+                    return
+
+        self._renew_thread = threading.Thread(
+            target=renew, name="leader-election-renew", daemon=True
+        )
+        self._renew_thread.start()
+
+    def release(self) -> None:
+        lease = self._lease()
+        if lease is not None and lease.data.get("holder") == self.identity:
+            lease.data["renew_time"] = "0"
+            self.kube_client.update(lease)

@@ -3,7 +3,7 @@
 Scaling design (the "DP/TP" of this framework — SURVEY.md section 2.7):
   - 'dp'  : the REPLICA COUNT axis is sharded across devices — every
             device sees the same item (pod-equivalence-class) rows but
-            packs its 1/ndp share of each class's replicas into its own
+            packs its share of each class's replicas into its own
             node-slot budget (independent greedy sub-solves; machines are
             disjoint by construction, so the merge is a concat). Splitting
             counts instead of item rows keeps per-device work balanced even
@@ -15,28 +15,112 @@ Scaling design (the "DP/TP" of this framework — SURVEY.md section 2.7):
             needs for packing. The gather rides ICI (XLA collective), not
             host memory.
 
+Topology (round 2): domain counts are global mutable state, so
+topology-entangled work cannot split freely. Items are partitioned into
+COMPONENTS by union-find over the topology groups they own or select into
+(two groups sharing a pod must co-locate); each component is routed whole
+to one 'dp' shard (LPT on replica counts), so every group's counts evolve
+on exactly one device and the per-shard solve follows the reference
+semantics (topologygroup.go:155-243) with no cross-shard races.
+Topology-free items still split evenly. Every shard carries the full
+[G, V] count state; only its own groups' rows ever change.
+
+Existing nodes (round 2): each existing node is OWNED by one shard
+(round-robin); all shards carry the slots [0, E) at the same indices but
+non-owned slots stay closed, so capacity can never be double-booked. A
+topology component whose pods could have landed on another shard's
+existing node opens a new machine instead — a valid (possibly costlier)
+packing, never a constraint violation.
+
 Provisioner limits are coordinated pessimistically: the remaining-resource
-budget is pre-split evenly across 'dp' shards (a conservative under-
-approximation of the reference's global subtract_max accounting,
-scheduler.go:276-293).
+budget is pre-split across 'dp' shards proportional to each shard's replica
+load (a conservative under-approximation of the reference's global
+subtract_max accounting, scheduler.go:276-293).
 """
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 
-def make_sharded_solve(snap, provisioners, mesh, max_nodes_per_shard: int = 256):
-    """Build (fn, args) where fn is a jit-compiled shard_map program over
-    `mesh` (axes 'dp' and 'tp') and args are the host arrays.
+def plan_shards(snap, ndp: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Partition the batch across dp shards.
 
-    Pod-axis arrays must divide by mesh.shape['dp']; type-axis arrays by
-    mesh.shape['tp'] (the caller pads — see pad_snapshot_for_mesh).
+    Returns (count_split [ndp, I], exist_owner [ndp, E] bool).
+
+    Topology-entangled items (owning or selected into any group) are routed
+    whole: union-find joins groups sharing an item, components go to shards
+    by longest-processing-time on replica count, and every item of a
+    component lands on its shard. Free items split evenly with remainders
+    to the low shards.
+    """
+    counts = (
+        snap.item_counts
+        if snap.item_counts is not None
+        else np.ones(len(snap.pods), dtype=np.int32)
+    ).astype(np.int64)
+    I = len(counts)
+    E = len(snap.state_nodes)
+    exist_owner = np.zeros((ndp, E), dtype=bool)
+    for e in range(E):
+        exist_owner[e % ndp, e] = True
+
+    count_split = np.tile(counts // ndp, (ndp, 1)).astype(np.int32)
+    for d in range(ndp):
+        count_split[d] += (counts % ndp > d)
+
+    if snap.topo_meta is not None and len(snap.topo_meta.groups) > 0:
+        rep = snap.item_rep
+        touch = (snap.topo_arrays.owner | snap.topo_arrays.sel)[:, rep]  # [G, I]
+        G = touch.shape[0]
+        parent = list(range(G))
+
+        def find(g):
+            while parent[g] != g:
+                parent[g] = parent[parent[g]]
+                g = parent[g]
+            return g
+
+        for i in range(I):
+            gs = np.nonzero(touch[:, i])[0]
+            for g in gs[1:]:
+                ra, rb = find(int(gs[0])), find(int(g))
+                if ra != rb:
+                    parent[rb] = ra
+        comp_of_item = np.full(I, -1, dtype=np.int64)
+        for i in range(I):
+            gs = np.nonzero(touch[:, i])[0]
+            if len(gs):
+                comp_of_item[i] = find(int(gs[0]))
+        comps = [c for c in np.unique(comp_of_item) if c >= 0]
+        loads = {c: int(counts[comp_of_item == c].sum()) for c in comps}
+        shard_load = np.zeros(ndp, dtype=np.int64)
+        comp_shard: Dict[int, int] = {}
+        for c in sorted(comps, key=lambda c: -loads[c]):
+            d = int(np.argmin(shard_load))
+            comp_shard[c] = d
+            shard_load[d] += loads[c]
+        for i in range(I):
+            c = comp_of_item[i]
+            if c >= 0:
+                count_split[:, i] = 0
+                count_split[comp_shard[int(c)], i] = counts[i]
+    return count_split, exist_owner
+
+
+def make_sharded_solve(snap, provisioners, mesh, max_nodes_per_shard: int = 256,
+                       program_cache=None):
+    """Build (fn, args, plan) where fn is a jit-compiled shard_map program
+    over `mesh` (axes 'dp' and 'tp'), args are the host arrays, and plan is
+    (count_split, exist_owner) for decoding.
+
+    Type-axis arrays must divide by mesh.shape['tp'] (the caller pads —
+    see pad_types). Supports topology constraints and existing nodes via
+    component routing / slot ownership (module docstring).
     """
     import jax
     import jax.numpy as jnp
-    from jax.sharding import NamedSharding
     from jax.sharding import PartitionSpec as P
 
     from karpenter_core_tpu.ops.feasibility import feasibility_static, openable_mask
@@ -46,156 +130,195 @@ def make_sharded_solve(snap, provisioners, mesh, max_nodes_per_shard: int = 256)
     geom = solve_geometry(snap, max_nodes_per_shard)
     (_, J, T, E, R, K, V, _, segments_t, zone_seg, ct_seg, _topo_sig,
      log_len) = geom
-    assert E == 0, "sharded solve packs new machines only (existing nodes are host-side)"
-    assert snap.topo_meta is None, (
-        "sharded solve requires a topology-free batch: domain counts are "
-        "global state; cross-shard topology lands with the repair phase"
-    )
     segments = list(segments_t)
     ndp = mesh.shape["dp"]
     ntp = mesh.shape["tp"]
-    N = max_nodes_per_shard
-    pack = make_pack_kernel(segments, zone_seg, ct_seg)
+    N = E + max_nodes_per_shard
+    has_topo = snap.topo_meta is not None and len(snap.topo_meta.groups) > 0
+    G = len(snap.topo_meta.groups) if has_topo else 0
+    count_split, exist_owner = plan_shards(snap, ndp)
 
-    def body(pod_arrays, count_split, tmpl, tmpl_daemon, tmpl_type_mask_l,
-             types_l, type_offering_ok_l, types_full, type_alloc,
-             type_capacity, type_offering_ok, pod_tol_all, well_known,
-             remaining0):
-        # ---- type-sharded feasibility + all_gather over 'tp' -------------
-        f_local = feasibility_static(
-            {k: pod_arrays[k] for k in ("allow", "out", "defined", "escape")},
-            tmpl,
-            types_l,
-            pod_arrays["tol_tmpl"],
-            tmpl_type_mask_l,
-            type_offering_ok_l,
-            zone_seg,
-            ct_seg,
-            segments,
-            well_known,
-        )  # [J, P_local, T_local]
-        f_static = jax.lax.all_gather(f_local, "tp", axis=3, tiled=False)
-        # [J, P_local, ntp, T_local] -> [J, P_local, T]
-        f_static = jnp.moveaxis(f_static, 3, 2).reshape(
-            f_local.shape[0], f_local.shape[1], -1
+    # the shard_map program is pure in everything but the label geometry
+    # (+ topo signature, baked into geom) and the mesh shape: cache it so
+    # steady-state solves and relaxation rounds reuse one compiled program
+    cache_key = (geom, ndp, ntp)
+    fn = None if program_cache is None else program_cache.get(cache_key)
+    if fn is None:
+        pack = make_pack_kernel(segments, zone_seg, ct_seg, topo_meta=snap.topo_meta)
+
+        def body(pod_arrays, count_split, tmpl, tmpl_daemon, tmpl_type_mask_l,
+                 types_l, type_offering_ok_l, types_full, type_alloc,
+                 type_capacity, type_offering_ok, pod_tol_all, exist, exist_used,
+                 exist_cap, exist_owner, well_known, remaining_split,
+                 topo_counts0, topo_hcounts0, topo_doms0, topo_terms):
+            # ---- type-sharded feasibility + all_gather over 'tp' -------------
+            f_local = feasibility_static(
+                {k: pod_arrays[k] for k in ("allow", "out", "defined", "escape")},
+                tmpl,
+                types_l,
+                pod_arrays["tol_tmpl"],
+                tmpl_type_mask_l,
+                type_offering_ok_l,
+                zone_seg,
+                ct_seg,
+                segments,
+                well_known,
+            )  # [J, I, T_local]
+            f_static = jax.lax.all_gather(f_local, "tp", axis=3, tiled=False)
+            f_static = jnp.moveaxis(f_static, 3, 2).reshape(
+                f_local.shape[0], f_local.shape[1], -1
+            )
+
+            openable = openable_mask(
+                f_static, pod_arrays["requests"], tmpl_daemon, type_alloc
+            )
+            mine = exist_owner[0]  # [E] this shard's existing slots
+            slot_exist = jnp.arange(N) < E
+            open0 = jnp.where(slot_exist, jnp.pad(mine, (0, N - E)), False)
+            state = PackState(
+                used=jnp.zeros((N, R), jnp.float32).at[:E].set(exist_used),
+                open=open0,
+                is_existing=open0,
+                tmpl=jnp.zeros(N, jnp.int32),
+                tol_idx=jnp.concatenate(
+                    [J + jnp.arange(E, dtype=jnp.int32), jnp.zeros(N - E, jnp.int32)]
+                ),
+                pods=jnp.zeros(N, jnp.int32),
+                allow=jnp.ones((N, V), bool).at[:E].set(exist["allow"]),
+                out=jnp.ones((N, K), bool).at[:E].set(exist["out"]),
+                defined=jnp.zeros((N, K), bool).at[:E].set(exist["defined"]),
+                tmask=jnp.zeros((N, T), bool),
+                cap=jnp.zeros((N, R), jnp.float32).at[:E].set(exist_cap),
+                nopen=jnp.int32(E),
+                remaining=remaining_split[0],
+                tcounts=topo_counts0,
+                thost=topo_hcounts0,
+                tdoms=topo_doms0,
+            )
+            pod_arrays = dict(pod_arrays)
+            pod_arrays["tol"] = pod_tol_all
+            # this shard's share of each class's replicas
+            pod_arrays["count"] = count_split[0]
+            tmpl_type_mask = jax.lax.all_gather(tmpl_type_mask_l, "tp", axis=2, tiled=False)
+            tmpl_type_mask = jnp.moveaxis(tmpl_type_mask, 2, 1).reshape(J, -1)
+            state, log, ptr = pack(
+                state,
+                pod_arrays,
+                f_static,
+                openable,
+                {k: tmpl[k] for k in ("allow", "out", "defined")},
+                tmpl_daemon,
+                tmpl_type_mask,
+                types_full,
+                type_alloc,
+                type_capacity,
+                type_offering_ok,
+                well_known=well_known,
+                topo_terms=topo_terms,
+                log_len=log_len,
+            )
+            # global stats via psum over dp: pods scheduled (an ICI collective)
+            scheduled = jax.lax.psum(state.pods.sum(), "dp")
+            # rank-0 per-shard values need a singleton axis to concatenate over dp
+            state = state._replace(nopen=state.nopen[None])
+            return log, ptr[None], state, scheduled
+
+        # item rows replicate; only the per-shard replica counts shard over dp
+        pod_spec = {
+            "allow": P(None, None),
+            "out": P(None, None),
+            "defined": P(None, None),
+            "escape": P(None, None),
+            "custom_deny": P(None, None),
+            "requests": P(None, None),
+            "tol_tmpl": P(None, None),
+            "valid": P(None),
+        }
+        if has_topo:
+            pod_spec["topo_own"] = P(None, None)
+            pod_spec["topo_sel"] = P(None, None)
+        reqset_rep = {k: P(None, None) for k in ("allow", "out", "defined", "escape")}
+        reqset_tp = {k: P("tp", None) for k in ("allow", "out", "defined", "escape")}
+        in_specs = (
+            pod_spec,  # pod_arrays
+            P("dp", None),  # count_split [ndp, I]
+            reqset_rep,  # tmpl
+            P(None, None),  # tmpl_daemon
+            P(None, "tp"),  # tmpl_type_mask_l
+            reqset_tp,  # types_l
+            P("tp", None, None),  # type_offering_ok_l
+            reqset_rep,  # types_full (replicated for packing)
+            P(None, None),  # type_alloc
+            P(None, None),  # type_capacity
+            P(None, None, None),  # type_offering_ok
+            P(None, None),  # pod_tol_all
+            reqset_rep,  # exist
+            P(None, None),  # exist_used
+            P(None, None),  # exist_cap
+            P("dp", None),  # exist_owner [ndp, E]
+            P(None),  # well_known
+            P("dp", None, None),  # remaining_split [ndp, J, R]
+            P(None, None),  # topo_counts0 [G, V]
+            P(None, None),  # topo_hcounts0 [G, N]
+            P(None, None),  # topo_doms0 [G, V]
+            {k: P(None, None) for k in ("allow", "out", "defined", "escape")},  # topo_terms
+        )
+        out_specs = (
+            {k: P("dp") for k in ("item", "slot", "ns", "k", "k_last")},  # commit log
+            P("dp"),  # log ptr (singleton axis per shard)
+            PackState(
+                used=P("dp", None),
+                open=P("dp"),
+                is_existing=P("dp"),
+                tmpl=P("dp"),
+                tol_idx=P("dp"),
+                pods=P("dp"),
+                allow=P("dp", None),
+                out=P("dp", None),
+                defined=P("dp", None),
+                tmask=P("dp", None),
+                cap=P("dp", None),
+                nopen=P("dp"),
+                remaining=P("dp", None),
+                tcounts=P("dp", None),
+                thost=P("dp", None),
+                tdoms=P("dp", None),
+            ),
+            P(),  # scheduled count (replicated)
         )
 
-        openable = openable_mask(
-            f_static, pod_arrays["requests"], tmpl_daemon, type_alloc
-        )
-        state = PackState(
-            used=jnp.zeros((N, R), jnp.float32),
-            open=jnp.zeros(N, bool),
-            is_existing=jnp.zeros(N, bool),
-            tmpl=jnp.zeros(N, jnp.int32),
-            tol_idx=jnp.zeros(N, jnp.int32),
-            pods=jnp.zeros(N, jnp.int32),
-            allow=jnp.ones((N, V), bool),
-            out=jnp.ones((N, K), bool),
-            defined=jnp.zeros((N, K), bool),
-            tmask=jnp.zeros((N, T), bool),
-            cap=jnp.zeros((N, R), jnp.float32),
-            nopen=jnp.int32(0),
-            # pessimistic even split of provisioner limits across dp shards
-            remaining=remaining0 / ndp,
-            tcounts=jnp.zeros((0, V), jnp.float32),
-            thost=jnp.zeros((0, N), jnp.float32),
-            tdoms=jnp.zeros((0, V), bool),
-        )
-        pod_arrays = dict(pod_arrays)
-        pod_arrays["tol"] = pod_tol_all
-        # this shard's share of each class's replicas
-        pod_arrays["count"] = count_split[0]
-        tmpl_type_mask = jax.lax.all_gather(tmpl_type_mask_l, "tp", axis=2, tiled=False)
-        tmpl_type_mask = jnp.moveaxis(tmpl_type_mask, 2, 1).reshape(J, -1)
-        state, log, ptr = pack(
-            state,
-            pod_arrays,
-            f_static,
-            openable,
-            {k: tmpl[k] for k in ("allow", "out", "defined")},
-            tmpl_daemon,
-            tmpl_type_mask,
-            types_full,
-            type_alloc,
-            type_capacity,
-            type_offering_ok,
-            log_len=log_len,
-        )
-        # global stats via psum over dp: pods scheduled (an ICI collective)
-        scheduled = jax.lax.psum(state.pods.sum(), "dp")
-        # rank-0 per-shard values need a singleton axis to concatenate over dp
-        state = state._replace(nopen=state.nopen[None])
-        return log, ptr[None], state, scheduled
-
-    # item rows replicate; only the per-shard replica counts shard over dp
-    pod_spec = {
-        "allow": P(None, None),
-        "out": P(None, None),
-        "defined": P(None, None),
-        "escape": P(None, None),
-        "custom_deny": P(None, None),
-        "requests": P(None, None),
-        "tol_tmpl": P(None, None),
-        "valid": P(None),
-    }
-    reqset_rep = {k: P(None, None) for k in ("allow", "out", "defined", "escape")}
-    reqset_tp = {k: P("tp", None) for k in ("allow", "out", "defined", "escape")}
-    in_specs = (
-        pod_spec,  # pod_arrays
-        P("dp", None),  # count_split [ndp, I]
-        reqset_rep,  # tmpl
-        P(None, None),  # tmpl_daemon
-        P(None, "tp"),  # tmpl_type_mask_l
-        reqset_tp,  # types_l
-        P("tp", None, None),  # type_offering_ok_l
-        reqset_rep,  # types_full (replicated for packing)
-        P(None, None),  # type_alloc
-        P(None, None),  # type_capacity
-        P(None, None, None),  # type_offering_ok
-        P(None, None),  # pod_tol_all
-        P(None),  # well_known
-        P(None, None),  # remaining0
-    )
-    out_specs = (
-        {k: P("dp") for k in ("item", "slot", "ns", "k", "k_last")},  # commit log
-        P("dp"),  # log ptr (singleton axis per shard)
-        PackState(
-            used=P("dp", None),
-            open=P("dp"),
-            is_existing=P("dp"),
-            tmpl=P("dp"),
-            tol_idx=P("dp"),
-            pods=P("dp"),
-            allow=P("dp", None),
-            out=P("dp", None),
-            defined=P("dp", None),
-            tmask=P("dp", None),
-            cap=P("dp", None),
-            nopen=P("dp"),
-            remaining=P("dp", None),
-            tcounts=P("dp", None),
-            thost=P("dp", None),
-            tdoms=P("dp", None),
-        ),
-        P(),  # scheduled count (replicated)
-    )
-
-    sharded = jax.shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                            check_vma=False)
-    fn = jax.jit(sharded)
+        sharded = jax.shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                                check_vma=False)
+        fn = jax.jit(sharded)
+        if program_cache is not None:
+            program_cache[cache_key] = fn
 
     base_args = device_args(snap, provisioners)
     (pod_arrays, tmpl, tmpl_daemon, tmpl_type_mask, types, type_alloc,
-     type_capacity, type_offering_ok, pod_tol_all, _exist, _eu, _ec,
-     well_known, remaining0, _tc, _th, _td, _tt) = base_args
-    # split each class's replica count evenly across the dp shards (the
-    # item rows themselves replicate); remainders go to the low shards
-    counts = pod_arrays.pop("count").astype(np.int64)
-    I = counts.shape[0]
-    count_split = np.tile(counts // ndp, (ndp, 1)).astype(np.int32)
-    for d in range(ndp):
-        count_split[d] += (counts % ndp > d)
+     type_capacity, type_offering_ok, pod_tol_all, exist, exist_used,
+     exist_cap, well_known, remaining0, topo_counts0, topo_hcounts0,
+     topo_doms0, topo_terms) = base_args
+    pod_arrays = dict(pod_arrays)
+    pod_arrays.pop("count")
+
+    # limits split proportional to each shard's replica load (pessimistic:
+    # the shares always sum to <= the global budget)
+    total = max(int(count_split.sum()), 1)
+    share = count_split.sum(axis=1).astype(np.float64) / total  # [ndp]
+    finite = remaining0 < np.float32(1e29)
+    remaining_split = np.where(
+        finite[None], remaining0[None] * share[:, None, None], remaining0[None]
+    ).astype(np.float32)
+
+    # per-shard hostname-count state: existing columns seed identically on
+    # every shard (only the owner shard's groups ever read/update them);
+    # machine columns start at zero. [G, N] with N = E + max_nodes_per_shard
+    if has_topo:
+        th0 = np.zeros((G, N), dtype=np.float32)
+        th0[:, :E] = topo_hcounts0[:, :E]
+    else:
+        th0 = np.zeros((0, N), dtype=np.float32)
+
     args = (
         pod_arrays,
         count_split,
@@ -209,16 +332,137 @@ def make_sharded_solve(snap, provisioners, mesh, max_nodes_per_shard: int = 256)
         type_capacity,
         type_offering_ok,
         pod_tol_all,
+        exist,
+        exist_used,
+        exist_cap,
+        exist_owner,
         well_known,
-        remaining0,
+        remaining_split,
+        topo_counts0,
+        th0,
+        topo_doms0,
+        topo_terms,
     )
-    return fn, args
+    return fn, args, (count_split, exist_owner)
+
+
+def decode_sharded(snap, log, ptr, state, count_split):
+    """Merge per-shard commit logs into one SolveResult.
+
+    log: dict of [ndp, L] arrays; ptr: [ndp]; state: PackState stacked on a
+    leading dp axis. Shard d consumes members[off_d : off_d + split_d] of
+    each item, where off_d is the cumulative split below d — the same
+    partition plan_shards produced. Each shard's log replays through the
+    single-device expand_log/decode_solve (bounded to the shard's member
+    slice); merging is a concat because machines are shard-local and every
+    existing slot is owned by exactly one shard."""
+    from types import SimpleNamespace
+
+    from karpenter_core_tpu.solver.tpu_solver import (
+        SolveResult,
+        decode_solve,
+        expand_log,
+    )
+
+    ndp = count_split.shape[0]
+    # shard_map concatenates per-shard outputs along the leading axis:
+    # reshape [ndp*L] logs and [ndp*N, ...] state fields back to per-shard
+    log = {k: np.asarray(v).reshape(ndp, -1) for k, v in log.items()}
+    ptr = np.asarray(ptr).reshape(-1)
+    P = len(snap.pods)
+    offs = np.cumsum(count_split, axis=0) - count_split  # [ndp, I]
+
+    N = np.asarray(state.tmpl).shape[0] // ndp
+    fields = {
+        name: np.asarray(getattr(state, name)).reshape((ndp, N) + np.asarray(
+            getattr(state, name)
+        ).shape[1:])
+        for name in ("tmpl", "tmask", "used", "allow", "out", "defined")
+    }
+
+    machines: List = []
+    existing: List[Tuple[object, List]] = []
+    scheduled = np.zeros(P, dtype=bool)
+    for d in range(ndp):
+        assigned_d = expand_log(
+            snap,
+            {k: v[d] for k, v in log.items()},
+            int(ptr[d]),
+            member_lo=offs[d],
+            member_hi=offs[d] + count_split[d],
+        )
+        shard_state = SimpleNamespace(**{k: v[d] for k, v in fields.items()})
+        res_d = decode_solve(snap, assigned_d, shard_state)
+        machines.extend(res_d.new_machines)
+        existing.extend(res_d.existing_assignments)
+        scheduled |= assigned_d >= 0
+
+    failed = [pod for i, pod in enumerate(snap.pods) if not scheduled[i]]
+    return SolveResult(
+        new_machines=machines, existing_assignments=existing, failed_pods=failed
+    )
+
+
+class ShardedSolver:
+    """Solver-interface front end for the multi-chip path: encode once,
+    run the shard_map program over `mesh`, merge shard logs. Drop-in for
+    TPUSolver where a Mesh is available; relaxation shares
+    solve_with_relaxation."""
+
+    def __init__(self, mesh, max_nodes_per_shard: int = 256,
+                 max_relax_rounds: Optional[int] = None):
+        from karpenter_core_tpu.solver.tpu_solver import DEFAULT_MAX_RELAX_ROUNDS
+
+        self.mesh = mesh
+        self.max_nodes_per_shard = max_nodes_per_shard
+        self.max_relax_rounds = (
+            DEFAULT_MAX_RELAX_ROUNDS if max_relax_rounds is None else max_relax_rounds
+        )
+        self._compiled = {}
+
+    def solve(self, pods, provisioners, instance_types, daemonset_pods=None,
+              state_nodes=None, kube_client=None, cluster=None):
+        from karpenter_core_tpu.solver.tpu_solver import solve_with_relaxation
+
+        return solve_with_relaxation(
+            lambda p: self._solve_once(
+                p, provisioners, instance_types, daemonset_pods, state_nodes,
+                kube_client, cluster,
+            ),
+            pods,
+            provisioners,
+            instance_types,
+            self.max_relax_rounds,
+        )
+
+    def _solve_once(self, pods, provisioners, instance_types, daemonset_pods,
+                    state_nodes, kube_client, cluster):
+        import jax
+
+        from karpenter_core_tpu.solver.encode import encode_snapshot
+
+        snap = encode_snapshot(
+            pods, provisioners, instance_types, daemonset_pods, state_nodes,
+            kube_client=kube_client, cluster=cluster,
+            max_nodes=self.max_nodes_per_shard,
+        )
+        fn, args, (count_split, _exist_owner) = make_sharded_solve(
+            snap, provisioners, self.mesh,
+            max_nodes_per_shard=self.max_nodes_per_shard,
+            program_cache=self._compiled,
+        )
+        with self.mesh:
+            log, ptr, state, _scheduled = fn(*args)
+            jax.block_until_ready(log)
+        state = jax.tree_util.tree_map(np.asarray, state)
+        return decode_sharded(snap, log, ptr, state, count_split)
 
 
 def pad_pods(pods: List, multiple: int) -> List:
     """Pad the pod list to a multiple with filler pods marked invalid at
     encode time (they request an impossible amount, so they never schedule).
-    Sharding requires equal-size shards; the valid mask excludes fillers."""
+    Replica-count splitting makes dp padding unnecessary; kept for callers
+    that want uniform batch sizes across solves."""
     from karpenter_core_tpu.testing import make_pod
 
     short = (-len(pods)) % multiple

@@ -152,11 +152,15 @@ def solve_geometry(snap: EncodedSnapshot, max_nodes: int):
 
 
 def make_device_run(segments, zone_seg, ct_seg, topo_meta, n_slots,
-                    log_len: Optional[int] = None):
+                    log_len: Optional[int] = None, rung_mode: bool = False):
     """Build the jittable device program — the whole Solve() as ONE program:
     feasibility + openable + packing scan. Pure function of the device arrays
     produced by device_args(); all dims except n_slots derive from shapes.
-    Shared by build_device_solve (in-process) and the gRPC SolverService."""
+    Shared by build_device_solve (in-process) and the gRPC SolverService.
+
+    rung_mode=True prepends two args (count_row [I], exist_open [E]) that
+    override the per-item replica counts and the open-existing-slot mask —
+    the vmap axis of the batched consolidation ladder (solver/replan.py)."""
     import jax.numpy as jnp
 
     from karpenter_core_tpu.ops.feasibility import feasibility_static, openable_mask
@@ -165,10 +169,11 @@ def make_device_run(segments, zone_seg, ct_seg, topo_meta, n_slots,
     segments = list(segments)
     pack = make_pack_kernel(segments, zone_seg, ct_seg, topo_meta=topo_meta)
 
-    def run(pod_arrays, tmpl, tmpl_daemon, tmpl_type_mask, types, type_alloc,
-            type_capacity, type_offering_ok, pod_tol_all, exist, exist_used,
-            exist_cap, well_known, remaining0, topo_counts0, topo_hcounts0,
-            topo_doms0, topo_terms):  # order must match RUN_ARG_NAMES
+    def run_impl(count_row, exist_open, pod_arrays, tmpl, tmpl_daemon,
+                 tmpl_type_mask, types, type_alloc, type_capacity,
+                 type_offering_ok, pod_tol_all, exist, exist_used, exist_cap,
+                 well_known, remaining0, topo_counts0, topo_hcounts0,
+                 topo_doms0, topo_terms):
         E = exist_used.shape[0]
         N = n_slots
         R = type_alloc.shape[1]
@@ -176,6 +181,13 @@ def make_device_run(segments, zone_seg, ct_seg, topo_meta, n_slots,
         J = tmpl_daemon.shape[0]
         V = pod_arrays["allow"].shape[1]
         K = pod_arrays["out"].shape[1]
+        if count_row is not None:
+            pod_arrays = dict(pod_arrays)
+            pod_arrays["count"] = count_row
+        if exist_open is None:
+            open0 = jnp.arange(N) < E
+        else:
+            open0 = (jnp.arange(N) < E) & jnp.pad(exist_open, (0, N - E))
         f_static = feasibility_static(
             {k: pod_arrays[k] for k in ("allow", "out", "defined", "escape")},
             tmpl,
@@ -192,8 +204,8 @@ def make_device_run(segments, zone_seg, ct_seg, topo_meta, n_slots,
         # initial state: existing slots [0, E), machine slots open later
         state = PackState(
             used=jnp.zeros((N, R), jnp.float32).at[:E].set(exist_used),
-            open=jnp.arange(N) < E,
-            is_existing=jnp.arange(N) < E,
+            open=open0,
+            is_existing=open0,
             tmpl=jnp.zeros(N, jnp.int32),
             tol_idx=jnp.concatenate(
                 [J + jnp.arange(E, dtype=jnp.int32), jnp.zeros(N - E, jnp.int32)]
@@ -229,6 +241,20 @@ def make_device_run(segments, zone_seg, ct_seg, topo_meta, n_slots,
             log_len=log_len,
         )
         return log, ptr, state
+
+    if rung_mode:
+        return run_impl
+
+    def run(pod_arrays, tmpl, tmpl_daemon, tmpl_type_mask, types, type_alloc,
+            type_capacity, type_offering_ok, pod_tol_all, exist, exist_used,
+            exist_cap, well_known, remaining0, topo_counts0, topo_hcounts0,
+            topo_doms0, topo_terms):  # order must match RUN_ARG_NAMES
+        return run_impl(
+            None, None, pod_arrays, tmpl, tmpl_daemon, tmpl_type_mask, types,
+            type_alloc, type_capacity, type_offering_ok, pod_tol_all, exist,
+            exist_used, exist_cap, well_known, remaining0, topo_counts0,
+            topo_hcounts0, topo_doms0, topo_terms,
+        )
 
     import inspect
 
@@ -354,6 +380,10 @@ class TPUSolver:
     repeated solves reuse the compiled program.
     """
 
+    # consolidation's prefix ladder screens all rungs in one vmapped
+    # dispatch against this solver (solver/replan.py)
+    supports_batched_replan = True
+
     def __init__(self, max_nodes: int = 1024,
                  max_relax_rounds: int = DEFAULT_MAX_RELAX_ROUNDS,
                  donate: bool = True):
@@ -407,22 +437,48 @@ class TPUSolver:
             fn = jax.jit(run, donate_argnums=DONATE_ARGNUMS if self.donate else ())
             self._compiled[geom] = fn
         args = device_args(snap, provisioners)
-        log, ptr, state = fn(*args)
+        # opt-in device profiling around the Solve dispatch — the analog of
+        # the reference's pprof-profiled benchmark capture
+        # (scheduling_benchmark_test.go:84-95); view with tensorboard or
+        # xprof. One trace per solve while the env var is set.
+        import os
+
+        trace_dir = os.environ.get("KARPENTER_JAX_TRACE_DIR", "")
+        if trace_dir:
+            with jax.profiler.trace(trace_dir):
+                log, ptr, state = fn(*args)
+                jax.block_until_ready(state)
+        else:
+            log, ptr, state = fn(*args)
         return (
             {k: np.asarray(v) for k, v in log.items()},
             int(ptr),
             jax.tree_util.tree_map(np.asarray, state),
         )
 
-def expand_log(snap: EncodedSnapshot, log, ptr: int) -> np.ndarray:
+def expand_log(snap: EncodedSnapshot, log, ptr: int,
+               member_lo=None, member_hi=None) -> np.ndarray:
     """Replay the kernel's commit log into a per-pod slot assignment [P]
     (-1 = unscheduled). Entry e places ns slots starting at slot, k replicas
     per slot (k_last on the final slot), consuming item e.item's member pods
-    in order."""
+    in order.
+
+    member_lo/member_hi (per-item arrays) bound which members this log may
+    consume — the dp-sharded path replays each shard's log against its own
+    slice of every equivalence class (parallel/sharded.py plan_shards)."""
     P = len(snap.pods)
     assigned = np.full(P, -1, dtype=np.int64)
     members = snap.item_members or [[i] for i in range(P)]
-    cursor = [0] * len(members)
+    cursor = (
+        [int(x) for x in member_lo]
+        if member_lo is not None
+        else [0] * len(members)
+    )
+    cap = (
+        [int(x) for x in member_hi]
+        if member_hi is not None
+        else [len(m) for m in members]
+    )
     items = np.asarray(log["item"])
     slots = np.asarray(log["slot"])
     nss = np.asarray(log["ns"])
@@ -437,7 +493,7 @@ def expand_log(snap: EncodedSnapshot, log, ptr: int) -> np.ndarray:
         for s in range(ns):
             take = k_last if s == ns - 1 else k
             lo = cursor[item]
-            hi = min(lo + take, len(mem))
+            hi = min(lo + take, cap[item], len(mem))
             for m in mem[lo:hi]:
                 assigned[m] = slots[e] + s
             cursor[item] = hi

@@ -40,16 +40,43 @@ _counter = itertools.count(1)
 
 class FakeClock:
     """Steppable clock (the analog of clock/testing.FakeClock the reference
-    threads through every TTL-sensitive controller)."""
+    threads through every TTL-sensitive controller).
 
-    def __init__(self, t: float = 1_700_000_000.0):
-        self.t = t
+    `sleep` gives TTL waits real semantics under test: it blocks while
+    another thread drives the clock forward with `advance` (so the 15s
+    revalidation window is genuinely exercised, validation.go:60-67), but
+    if the clock sits still for `grace` real seconds — no stepper thread —
+    it jumps itself to the deadline instead of deadlocking the test."""
+
+    def __init__(self, t: float = 1_700_000_000.0, grace: float = 0.05):
+        import threading
+
+        self._t = t
+        self._grace = grace
+        self._cond = threading.Condition()
+
+    @property
+    def t(self) -> float:
+        return self._t
 
     def __call__(self) -> float:
-        return self.t
+        with self._cond:
+            return self._t
 
     def advance(self, seconds: float) -> None:
-        self.t += seconds
+        with self._cond:
+            self._t += seconds
+            self._cond.notify_all()
+
+    def sleep(self, seconds: float) -> None:
+        with self._cond:
+            deadline = self._t + seconds
+            while self._t < deadline:
+                last = self._t
+                self._cond.wait(timeout=self._grace)
+                if self._t == last:  # nobody is stepping: jump
+                    self._t = deadline
+                    self._cond.notify_all()
 
 
 def unique_name(prefix: str = "obj") -> str:

@@ -305,3 +305,109 @@ def test_replacement_launch_failure_rolls_back_cordon(env):
     node = op.kube_client.get("Node", "", "expired")
     assert node is not None
     assert not node.spec.unschedulable, "cordon must be rolled back on launch failure"
+
+
+# -- TTL revalidation with a stepping clock ---------------------------------
+# (consolidation.go:66, validation.go:63-103 — the 15s window is real here,
+# driven by FakeClock.advance from the test thread, not zeroed out)
+
+
+def _stepping(clock, stop, step=1.0, period=0.005):
+    """Advance the fake clock in the background until stop is set."""
+    import threading
+    import time as _time
+
+    def tick():
+        while not stop.is_set():
+            clock.advance(step)
+            _time.sleep(period)
+
+    t = threading.Thread(target=tick, daemon=True)
+    t.start()
+    return t
+
+
+def test_empty_node_ttl_revalidates_with_stepping_clock():
+    import threading
+
+    clock = FakeClock(grace=5.0)  # stepper-driven: no auto-jump under CI load
+    cp = fake.FakeCloudProvider(fake.instance_types(10))
+    op = new_operator(cp, settings=Settings(), clock=clock)
+    provisioner(op, consolidation_enabled=True)
+    add_node(op, clock, "empty-1", pods=0)
+    op.sync_state()
+    empty = next(
+        d for d in op.deprovisioning.deprovisioners
+        if type(d).__name__ == "EmptyNodeConsolidation"
+    )
+    assert empty.validation_ttl == 15.0  # the real TTL, not a test zero
+    start = clock()
+    stop = threading.Event()
+    stepper = _stepping(clock, stop)
+    try:
+        cmd = empty.compute_command(
+            empty.sort_and_filter_candidates(
+                __import__(
+                    "karpenter_core_tpu.controllers.deprovisioning.core",
+                    fromlist=["candidate_nodes"],
+                ).candidate_nodes(
+                    op.cluster, op.kube_client, cp, empty.should_deprovision, clock
+                )
+            )
+        )
+    finally:
+        stop.set()
+        stepper.join(timeout=2)
+    assert clock() - start >= 15.0, "compute_command must wait out the TTL"
+    assert cmd.action == "delete"
+    assert [n.metadata.name for n in cmd.nodes_to_remove] == ["empty-1"]
+
+
+def test_multi_node_ttl_blocks_on_nomination():
+    """A node nominated for a pending pod during the validation TTL blocks
+    the command (validation.go:70-85)."""
+    import threading
+
+    from karpenter_core_tpu.controllers.deprovisioning.core import candidate_nodes
+
+    clock = FakeClock(grace=5.0)  # stepper-driven: no auto-jump under CI load
+    cp = fake.FakeCloudProvider(fake.instance_types(10))
+    op = new_operator(cp, settings=Settings(), clock=clock)
+    provisioner(op, consolidation_enabled=True)
+    add_node(op, clock, "under-1", it_name="fake-it-9", cpu="10", pods=1)
+    add_node(op, clock, "under-2", it_name="fake-it-9", cpu="10", pods=1)
+    op.sync_state()
+    multi = next(
+        d for d in op.deprovisioning.deprovisioners
+        if type(d).__name__ == "MultiNodeConsolidation"
+    )
+    assert multi.validation_ttl == 15.0
+    candidates = multi.sort_and_filter_candidates(
+        candidate_nodes(op.cluster, op.kube_client, cp, multi.should_deprovision, clock)
+    )
+    assert len(candidates) == 2
+
+    nominate_after = clock() + 5.0
+    nominated = threading.Event()
+    stop = threading.Event()
+
+    def tick():
+        import time as _time
+
+        while not stop.is_set():
+            clock.advance(1.0)
+            if clock() >= nominate_after and not nominated.is_set():
+                # a pending pod gets nominated onto a candidate mid-TTL
+                op.cluster.nominate_node_for_pod(candidates[0].name)
+                nominated.set()
+            _time.sleep(0.005)
+
+    stepper = threading.Thread(target=tick, daemon=True)
+    stepper.start()
+    try:
+        cmd = multi.compute_command(candidates)
+    finally:
+        stop.set()
+        stepper.join(timeout=2)
+    assert nominated.is_set()
+    assert cmd.action == "retry", f"nominated candidate must block, got {cmd.action}"

@@ -1,0 +1,234 @@
+"""Runtime layer: singleton loops, options, leader election, threaded start.
+
+Covers reference operator/controller/singleton.go:58-129 (rate-limited
+instrumented reconciles), options/options.go:30-76 (flag layer), and
+operator.go:108-110 (leader election)."""
+import threading
+import time
+
+import pytest
+
+from karpenter_core_tpu.metrics.registry import REGISTRY
+from karpenter_core_tpu.operator.controller import (
+    RECONCILE_DURATION,
+    RECONCILE_ERRORS,
+    Singleton,
+)
+from karpenter_core_tpu.operator.leaderelection import LeaderElector
+from karpenter_core_tpu.operator.options import Options, parse_options
+
+
+class TestSingleton:
+    def test_success_returns_interval(self):
+        s = Singleton("t-ok", lambda: None, interval=2.5)
+        assert s.reconcile_once() == 2.5
+
+    def test_requeue_after_overrides_interval(self):
+        s = Singleton("t-requeue", lambda: 0.25, interval=2.5)
+        assert s.reconcile_once() == 0.25
+
+    def test_error_backs_off_and_counts(self):
+        calls = []
+
+        def boom():
+            calls.append(1)
+            raise RuntimeError("kaboom")
+
+        s = Singleton("t-err", boom, interval=1.0)
+        before = RECONCILE_ERRORS.get(labels={"controller": "t-err"})
+        w1 = s.reconcile_once()
+        w2 = s.reconcile_once()
+        assert RECONCILE_ERRORS.get(labels={"controller": "t-err"}) == before + 2
+        assert 0 < w1 < w2 <= 10.0  # exponential, capped
+
+    def test_error_then_success_resets_backoff(self):
+        state = {"fail": True}
+
+        def flaky():
+            if state["fail"]:
+                raise RuntimeError("once")
+
+        s = Singleton("t-flaky", flaky, interval=1.0)
+        s.reconcile_once()
+        state["fail"] = False
+        assert s.reconcile_once() == 1.0
+        assert s._failures == 0
+
+    def test_duration_observed(self):
+        s = Singleton("t-dur", lambda: None)
+        s.reconcile_once()
+        assert RECONCILE_DURATION.counts[(("controller", "t-dur"),)] == 1
+
+    def test_loop_survives_errors(self):
+        calls = []
+
+        def boom():
+            calls.append(1)
+            raise RuntimeError("kaboom")
+
+        stop = threading.Event()
+        s = Singleton("t-loop", boom, interval=0.0)
+        # shrink backoff so the test is fast
+        import karpenter_core_tpu.operator.controller as ctrl
+
+        s.reconcile_once()  # prime failure count
+        s.start(stop)
+        time.sleep(0.15)
+        stop.set()
+        assert len(calls) >= 2  # kept reconciling after raising
+
+
+class TestOptions:
+    def test_defaults(self):
+        opts = parse_options([])
+        assert opts.metrics_port == 8000
+        assert opts.enable_leader_election is True
+        assert opts.disable_webhook is False
+
+    def test_flags_override(self):
+        opts = parse_options(
+            ["--metrics-port", "9999", "--no-leader-elect",
+             "--enable-profiling", "--batch-idle-seconds", "0.5"]
+        )
+        assert opts.metrics_port == 9999
+        assert opts.enable_leader_election is False
+        assert opts.enable_profiling is True
+        assert opts.batch_idle_seconds == 0.5
+
+    def test_env_fallback(self, monkeypatch):
+        monkeypatch.setenv("KARPENTER_METRICS_PORT", "7070")
+        monkeypatch.setenv("KARPENTER_LEADER_ELECT", "false")
+        opts = parse_options([])
+        assert opts.metrics_port == 7070
+        assert opts.enable_leader_election is False
+
+    def test_batch_env_reaches_settings_via_options(self, monkeypatch):
+        """run()'s embedded path resolves settings through parse_options([]),
+        so the documented KARPENTER_BATCH_* env vars must land in Settings."""
+        from karpenter_core_tpu.operator.__main__ import resolve_settings
+
+        monkeypatch.setenv("KARPENTER_BATCH_IDLE_SECONDS", "5")
+        monkeypatch.setenv("KARPENTER_BATCH_MAX_SECONDS", "30")
+        settings = resolve_settings(None, parse_options([]))
+        assert settings.batch_idle_duration == 5.0
+        assert settings.batch_max_duration == 30.0
+
+
+class TestLeaderElection:
+    def make_client(self):
+        from karpenter_core_tpu.kube.client import InMemoryKubeClient
+
+        return InMemoryKubeClient()
+
+    def test_first_acquires(self):
+        client = self.make_client()
+        assert LeaderElector(client, identity="a").try_acquire()
+
+    def test_second_blocked_until_expiry(self):
+        client = self.make_client()
+        now = [1000.0]
+        clock = lambda: now[0]
+        a = LeaderElector(client, identity="a", clock=clock)
+        b = LeaderElector(client, identity="b", clock=clock)
+        assert a.try_acquire()
+        assert not b.try_acquire()
+        now[0] += 20.0  # past the 15s lease duration without renewal
+        assert b.try_acquire()
+        assert not a.try_acquire()  # lost it
+
+    def test_holder_renews(self):
+        client = self.make_client()
+        now = [1000.0]
+        clock = lambda: now[0]
+        a = LeaderElector(client, identity="a", clock=clock)
+        assert a.try_acquire()
+        now[0] += 10.0
+        assert a.try_acquire()  # renewal
+        b = LeaderElector(client, identity="b", clock=clock)
+        now[0] += 10.0  # only 10s since renewal
+        assert not b.try_acquire()
+
+    def test_release_frees_lease(self):
+        client = self.make_client()
+        a = LeaderElector(client, identity="a")
+        b = LeaderElector(client, identity="b")
+        assert a.try_acquire()
+        a.release()
+        assert b.try_acquire()
+
+    def test_expired_lease_single_winner_under_race(self):
+        """N standbys racing for an expired lease: the compare-and-swap
+        takeover admits exactly one (no split-brain)."""
+        client = self.make_client()
+        now = [1000.0]
+        clock = lambda: now[0]
+        holder = LeaderElector(client, identity="old", clock=clock)
+        assert holder.try_acquire()
+        now[0] += 20.0  # past lease_duration without renewal
+        n = 8
+        electors = [
+            LeaderElector(client, identity=f"e{i}", clock=clock) for i in range(n)
+        ]
+        results = [False] * n
+        barrier = threading.Barrier(n)
+
+        def go(i):
+            barrier.wait()
+            results[i] = electors[i].try_acquire()
+
+        threads = [threading.Thread(target=go, args=(i,)) for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=5)
+        assert sum(results) == 1
+
+    def test_create_race_single_winner(self):
+        """No lease at all: racing creators collide on AlreadyExists and
+        exactly one wins."""
+        client = self.make_client()
+        n = 8
+        electors = [LeaderElector(client, identity=f"c{i}") for i in range(n)]
+        results = [False] * n
+        barrier = threading.Barrier(n)
+
+        def go(i):
+            barrier.wait()
+            results[i] = electors[i].try_acquire()
+
+        threads = [threading.Thread(target=go, args=(i,)) for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=5)
+        assert sum(results) == 1
+
+
+class TestThreadedStart:
+    def test_start_provisions_and_survives(self):
+        """The threaded runtime (watch pumps + singletons) launches a machine
+        for a pending pod and keeps running after a controller error."""
+        from karpenter_core_tpu.cloudprovider import fake
+        from karpenter_core_tpu.operator import new_operator
+        from karpenter_core_tpu.api.settings import Settings
+        from karpenter_core_tpu.testing import make_pod, make_provisioner
+
+        cp = fake.FakeCloudProvider(fake.instance_types(5))
+        op = new_operator(
+            cp,
+            settings=Settings(batch_idle_duration=0.05, batch_max_duration=0.1),
+        )
+        op.kube_client.create(make_provisioner(name="default"))
+        op.start()
+        try:
+            op.kube_client.create(make_pod(requests={"cpu": "1"}))
+            deadline = time.time() + 10.0
+            while time.time() < deadline:
+                if op.kube_client.list("Machine"):
+                    break
+                time.sleep(0.05)
+            assert op.kube_client.list("Machine"), "no machine launched"
+            for singleton in op.singletons:
+                assert singleton._thread.is_alive()
+        finally:
+            op.stop()

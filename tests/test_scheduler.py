@@ -293,3 +293,49 @@ def test_progress_queue_terminates_on_unsatisfiable():
     result = solve([make_pod(requests={"cpu": "1"}), make_pod(requests={"cpu": "999"})])
     assert len(result.failed_pods) == 1
     assert result.pod_count_new() == 1
+
+
+def test_is_relaxable_predicate():
+    """Preferences.is_relaxable must agree with what relax() can drop
+    (non-mutating mirror of preferences.go:36-56); the batched replan
+    screen relies on it to decide whether an unrelaxed negative is
+    conclusive."""
+    import copy
+
+    from karpenter_core_tpu.controllers.provisioning.scheduling.preferences import (
+        Preferences,
+    )
+    from karpenter_core_tpu.kube.objects import (
+        LabelSelector,
+        PodAffinityTerm,
+        TopologySpreadConstraint,
+        WeightedPodAffinityTerm,
+    )
+
+    prefs = Preferences()
+    term = PodAffinityTerm(
+        topology_key="topology.kubernetes.io/zone",
+        label_selector=LabelSelector(match_labels={"app": "x"}),
+    )
+    cases = [
+        make_pod(requests={"cpu": "1"}),
+        make_pod(
+            requests={"cpu": "1"},
+            pod_affinity_preferred=[WeightedPodAffinityTerm(weight=1, pod_affinity_term=term)],
+        ),
+        make_pod(
+            requests={"cpu": "1"},
+            topology_spread=[
+                TopologySpreadConstraint(
+                    max_skew=1,
+                    topology_key="kubernetes.io/hostname",
+                    when_unsatisfiable="ScheduleAnyway",
+                    label_selector=LabelSelector(match_labels={"app": "x"}),
+                )
+            ],
+        ),
+        make_pod(requests={"cpu": "1"}, pod_affinity_required=[term]),
+    ]
+    for pod in cases:
+        probe = copy.deepcopy(pod)
+        assert prefs.is_relaxable(pod) == prefs.relax(probe), pod

@@ -1,0 +1,269 @@
+"""Multi-device sharded solve vs single-device solve (differential).
+
+Runs on the 8 virtual CPU devices from conftest. The equivalence bar
+(SURVEY.md section 7): all constraints satisfied, every pod the single-device
+solve schedules also schedules sharded, and topology outcomes (skew,
+co-location, anti-affinity separation) match the reference semantics —
+placements need not be bit-identical because dp sub-solves pack
+independently.
+"""
+import numpy as np
+import pytest
+
+import jax
+from jax.sharding import Mesh
+
+from karpenter_core_tpu.api.labels import PROVISIONER_NAME_LABEL_KEY
+from karpenter_core_tpu.cloudprovider import fake
+from karpenter_core_tpu.kube.objects import (
+    LABEL_HOSTNAME,
+    LABEL_TOPOLOGY_ZONE,
+    LabelSelector,
+    PodAffinityTerm,
+    TopologySpreadConstraint,
+)
+from karpenter_core_tpu.parallel.sharded import ShardedSolver, plan_shards
+from karpenter_core_tpu.solver.encode import encode_snapshot
+from karpenter_core_tpu.solver.tpu_solver import TPUSolver
+from karpenter_core_tpu.state.node import StateNode
+from karpenter_core_tpu.testing import make_node, make_pod, make_provisioner
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    devices = np.array(jax.devices()[:8]).reshape(4, 2)
+    return Mesh(devices, ("dp", "tp"))
+
+
+def run_both(mesh, pods, provisioners, its, state_nodes=None):
+    import copy
+
+    sharded = ShardedSolver(mesh, max_nodes_per_shard=16).solve(
+        pods,
+        provisioners,
+        its,
+        state_nodes=[n.deep_copy() for n in state_nodes] if state_nodes else None,
+    )
+    single = TPUSolver(max_nodes=64).solve(
+        pods,
+        provisioners,
+        its,
+        state_nodes=[n.deep_copy() for n in state_nodes] if state_nodes else None,
+    )
+    return sharded, single
+
+
+def zonal_spread(app="spread", max_skew=1):
+    return TopologySpreadConstraint(
+        max_skew=max_skew,
+        topology_key=LABEL_TOPOLOGY_ZONE,
+        when_unsatisfiable="DoNotSchedule",
+        label_selector=LabelSelector(match_labels={"app": app}),
+    )
+
+
+def test_plain_pods_all_schedule(mesh):
+    pods = [make_pod(requests={"cpu": "1"}) for _ in range(40)]
+    provs = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(8)}
+    sh, dv = run_both(mesh, pods, provs, its)
+    assert sh.pod_count_new() == dv.pod_count_new() == 40
+    assert not sh.failed_pods and not dv.failed_pods
+
+
+def test_spread_skew_matches_single_device(mesh):
+    pods = [
+        make_pod(labels={"app": "spread"}, requests={"cpu": "1"},
+                 topology_spread=[zonal_spread()])
+        for _ in range(9)
+    ] + [make_pod(requests={"cpu": "1"}) for _ in range(12)]
+    provs = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(8)}
+    sh, dv = run_both(mesh, pods, provs, its)
+    assert not sh.failed_pods and not dv.failed_pods
+
+    def zone_counts(res):
+        counts = {}
+        for m in res.new_machines:
+            n = sum(1 for p in m.pods if p.metadata.labels.get("app") == "spread")
+            if n:
+                zone = m.requirements.get_requirement(LABEL_TOPOLOGY_ZONE).values_list()[0]
+                counts[zone] = counts.get(zone, 0) + n
+        return counts
+
+    shc, dvc = zone_counts(sh), zone_counts(dv)
+    # 9 pods over 3 zones under max_skew=1 -> exactly 3 per zone, both paths
+    assert sorted(shc.values()) == sorted(dvc.values()) == [3, 3, 3]
+
+
+def test_pod_affinity_colocates_one_zone(mesh):
+    aff = PodAffinityTerm(
+        topology_key=LABEL_TOPOLOGY_ZONE,
+        label_selector=LabelSelector(match_labels={"app": "aff"}),
+    )
+    pods = [
+        make_pod(labels={"app": "aff"}, requests={"cpu": "1"},
+                 pod_affinity_required=[aff])
+        for _ in range(8)
+    ]
+    provs = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(8)}
+    sh, dv = run_both(mesh, pods, provs, its)
+    assert not sh.failed_pods and not dv.failed_pods
+
+    def zones(res):
+        zs = set()
+        for m in res.new_machines:
+            zs.update(m.requirements.get_requirement(LABEL_TOPOLOGY_ZONE).values_list())
+        return zs
+
+    assert len(zones(sh)) == 1  # affinity keeps the group in one zone
+    assert len(zones(dv)) == 1
+
+
+def test_anti_affinity_flexible_machines_block_domains(mesh):
+    """Reference semantics (topology.go:120-143): an anti-affinity pod on a
+    NEW machine records ALL the machine's viable domains, so 3 identical
+    anti pods with 3-zone-flexible machines schedule exactly ONE pod — the
+    first blocks every zone. Sharded must reproduce this, not 'improve' it."""
+    anti = PodAffinityTerm(
+        topology_key=LABEL_TOPOLOGY_ZONE,
+        label_selector=LabelSelector(match_labels={"app": "anti"}),
+    )
+    pods = [
+        make_pod(labels={"app": "anti"}, requests={"cpu": "1"},
+                 pod_anti_affinity_required=[anti])
+        for _ in range(3)
+    ]
+    provs = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(8)}
+    sh, dv = run_both(mesh, pods, provs, its)
+    assert sh.pod_count_new() == dv.pod_count_new() == 1
+    assert len(sh.failed_pods) == len(dv.failed_pods) == 2
+
+
+def test_anti_affinity_zone_pinned_separates(mesh):
+    """Zone-pinned anti pods (each machine narrowed to one zone) all
+    schedule, in distinct zones, on both paths."""
+    anti = PodAffinityTerm(
+        topology_key=LABEL_TOPOLOGY_ZONE,
+        label_selector=LabelSelector(match_labels={"app": "anti"}),
+    )
+    pods = [
+        make_pod(labels={"app": "anti"}, requests={"cpu": "1"},
+                 pod_anti_affinity_required=[anti],
+                 node_selector={LABEL_TOPOLOGY_ZONE: f"test-zone-{z}"})
+        for z in (1, 2, 3)
+    ]
+    provs = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(8)}
+    sh, dv = run_both(mesh, pods, provs, its)
+    assert not sh.failed_pods and not dv.failed_pods
+
+    def pod_zones(res):
+        zs = []
+        for m in res.new_machines:
+            for _ in m.pods:
+                zs.extend(
+                    m.requirements.get_requirement(LABEL_TOPOLOGY_ZONE).values_list()
+                )
+        return zs
+
+    assert len(set(pod_zones(sh))) == 3
+    assert len(set(pod_zones(dv))) == 3
+
+
+def test_existing_nodes_fill_before_new(mesh):
+    nodes = [
+        StateNode(
+            node=make_node(
+                labels={
+                    PROVISIONER_NAME_LABEL_KEY: "default",
+                    "karpenter.sh/initialized": "true",
+                },
+                capacity={"cpu": "8", "memory": "16Gi", "pods": "50"},
+            )
+        ).deep_copy()
+        for _ in range(4)
+    ]
+    pods = [make_pod(requests={"cpu": "1"}) for _ in range(24)]
+    provs = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(8)}
+    sh, dv = run_both(mesh, pods, provs, its, state_nodes=nodes)
+    assert sh.pod_count_existing() == dv.pod_count_existing() == 24
+    assert not sh.new_machines and not dv.new_machines
+
+
+def test_reference_mix_with_existing(mesh):
+    aff = PodAffinityTerm(
+        topology_key=LABEL_TOPOLOGY_ZONE,
+        label_selector=LabelSelector(match_labels={"app": "aff"}),
+    )
+    pods = []
+    for i in range(28):
+        kind = i % 7
+        if kind == 0:
+            pods.append(
+                make_pod(labels={"app": "spread"}, requests={"cpu": "1"},
+                         topology_spread=[zonal_spread()])
+            )
+        elif kind in (2, 3):
+            pods.append(
+                make_pod(labels={"app": "aff"}, requests={"cpu": "1"},
+                         pod_affinity_required=[aff])
+            )
+        else:
+            pods.append(make_pod(requests={"cpu": "1", "memory": "1Gi"}))
+    nodes = [
+        StateNode(
+            node=make_node(
+                labels={
+                    PROVISIONER_NAME_LABEL_KEY: "default",
+                    "karpenter.sh/initialized": "true",
+                },
+                capacity={"cpu": "4", "memory": "8Gi", "pods": "20"},
+            )
+        ).deep_copy()
+        for _ in range(2)
+    ]
+    provs = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(8)}
+    sh, dv = run_both(mesh, pods, provs, its, state_nodes=nodes)
+    assert not sh.failed_pods and not dv.failed_pods
+    assert (sh.pod_count_new() + sh.pod_count_existing()) == 28
+    assert (dv.pod_count_new() + dv.pod_count_existing()) == 28
+
+
+def test_provisioner_limits_respected_globally(mesh):
+    # limit allows ~8 cpu total; sharded shares must never over-launch
+    provs = [make_provisioner(name="default", limits={"cpu": "8"})]
+    pods = [make_pod(requests={"cpu": "1"}) for _ in range(32)]
+    its = {"default": fake.instance_types(8)}
+    sh, dv = run_both(mesh, pods, provs, its)
+    for res in (sh, dv):
+        launched = sum(
+            min(it.capacity.get("cpu", 0.0) for it in m.instance_type_options)
+            for m in res.new_machines
+        )
+        assert launched <= 8.0 + 1e-6, f"limit exceeded: {launched}"
+
+
+def test_plan_shards_components_colocate():
+    zonal = zonal_spread()
+    pods = [
+        make_pod(labels={"app": "spread"}, requests={"cpu": "1"},
+                 topology_spread=[zonal])
+        for _ in range(6)
+    ] + [make_pod(requests={"cpu": "1"}) for _ in range(10)]
+    provs = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(4)}
+    snap = encode_snapshot(pods, provs, its, max_nodes=16)
+    count_split, exist_owner = plan_shards(snap, 4)
+    counts = snap.item_counts
+    # totals preserved
+    assert (count_split.sum(axis=0) == counts).all()
+    # topology-owning items live on exactly one shard
+    touch = (snap.topo_arrays.owner | snap.topo_arrays.sel)[:, snap.item_rep]
+    for i in range(len(counts)):
+        if touch[:, i].any():
+            assert (count_split[:, i] > 0).sum() == 1
