@@ -76,14 +76,34 @@ class InflightChecksController:
     def _termination(self, node: Node) -> List[str]:
         if node.metadata.deletion_timestamp is None:
             return []
+        from karpenter_core_tpu.controllers.deprovisioning.core import PDBLimits
+
+        # only pods that need rescheduling can block a drain
+        # (utils/node/node.go:30-48 GetNodePods)
+        pods = [
+            p
+            for p in self.kube_client.list(
+                "Pod", field_filter=lambda p: p.spec.node_name == node.metadata.name
+            )
+            if not (
+                podutils.is_owned_by_node(p)
+                or podutils.is_owned_by_daemonset(p)
+                or podutils.is_terminal(p)
+                or podutils.is_terminating(p)
+            )
+        ]
+        messages = []
+        # PDB blockers first — the common stuck-drain cause
+        # (inflightchecks/termination.go:40-50)
+        pdb, ok = PDBLimits(self.kube_client).can_evict_pods(pods)
+        if not ok:
+            messages.append(f"Can't drain node, PDB {pdb} is blocking evictions")
         blockers = []
-        for pod in self.kube_client.list(
-            "Pod", field_filter=lambda p: p.spec.node_name == node.metadata.name
-        ):
+        for pod in pods:
             if podutils.has_do_not_evict(pod):
                 blockers.append(
                     f"pod {pod.metadata.namespace}/{pod.metadata.name} has do-not-evict"
                 )
         if blockers:
-            return [f"Can't drain node, {'; '.join(blockers)}"]
-        return []
+            messages.append(f"Can't drain node, {'; '.join(blockers)}")
+        return messages

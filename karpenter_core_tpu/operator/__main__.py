@@ -68,8 +68,20 @@ def resolve_settings(kube_client, options=None) -> Settings:
 
 
 def configure_logging() -> None:
+    """KARPENTER_LOGGING_CONFIG (a logging dictConfig JSON, injected from the
+    config-logging ConfigMap — the analog of the reference's zap ConfigMap,
+    operator.go:95-100) wins; otherwise basicConfig at KARPENTER_LOG_LEVEL."""
+    import json
     import logging
+    import logging.config
 
+    raw = os.environ.get("KARPENTER_LOGGING_CONFIG", "")
+    if raw:
+        try:
+            logging.config.dictConfig(json.loads(raw))
+            return
+        except (ValueError, TypeError, AttributeError, ImportError) as exc:
+            print(f"invalid KARPENTER_LOGGING_CONFIG, using basicConfig: {exc}")
     level = os.environ.get("KARPENTER_LOG_LEVEL", "INFO").upper()
     logging.basicConfig(
         level=getattr(logging, level, logging.INFO),

@@ -181,6 +181,46 @@ def test_inflight_stuck_termination_reports_blockers(env):
     assert any("do-not-evict" in e.message for e in events)
 
 
+def test_inflight_stuck_termination_reports_pdb_blocker(env):
+    op, cp, clock = env
+    from karpenter_core_tpu.kube.objects import (
+        LabelSelector,
+        PodDisruptionBudget,
+        PodDisruptionBudgetSpec,
+        PodDisruptionBudgetStatus,
+    )
+
+    node = make_node(name="pdb-blocked", labels={PROVISIONER_NAME_LABEL_KEY: "default"},
+                     capacity={"cpu": "4"})
+    node.metadata.finalizers.append(api_labels.TERMINATION_FINALIZER)
+    op.kube_client.create(node)
+    pod = make_pod(node_name="pdb-blocked", unschedulable=False,
+                   labels={"app": "guarded"})
+    pod.status.phase = "Running"
+    op.kube_client.create(pod)
+    pdb = PodDisruptionBudget(
+        spec=PodDisruptionBudgetSpec(selector=LabelSelector(match_labels={"app": "guarded"})),
+        status=PodDisruptionBudgetStatus(disruptions_allowed=0),
+    )
+    pdb.metadata.name = "guard"
+    pdb.metadata.namespace = "default"
+    op.kube_client.create(pdb)
+    op.kube_client.delete("Node", "", "pdb-blocked")  # finalizer holds it
+    node = op.kube_client.get("Node", "", "pdb-blocked")
+    op.sync_state()
+    op.inflight_checks.reconcile(node)
+    events = op.recorder.for_object("Node", "pdb-blocked")
+    assert any("PDB default/guard is blocking evictions" in e.message for e in events)
+    # a node not under deletion reports nothing
+    op.recorder.events.clear()
+    healthy = make_node(name="fine", labels={PROVISIONER_NAME_LABEL_KEY: "default"},
+                        capacity={"cpu": "4"})
+    op.kube_client.create(healthy)
+    op.sync_state()
+    op.inflight_checks.reconcile(healthy)
+    assert not op.recorder.for_object("Node", "fine")
+
+
 # -- settings ---------------------------------------------------------------
 
 
