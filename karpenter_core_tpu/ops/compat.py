@@ -23,11 +23,21 @@ import jax.numpy as jnp
 Segments = List[Tuple[int, int]]  # per key: (lo, hi) into the flat value axis
 
 
-def use_mxu() -> bool:
-    """True when the default backend has a systolic array (TPU / axon tunnel):
-    per-key reductions are then cheaper as one matmul than as K sliced
-    reductions. On CPU the sliced loop form wins (bf16 matmul is emulated)."""
-    return jax.default_backend() not in ("cpu",)
+def resolve_backend(device=None) -> str:
+    """Pick the kernel lowering for the device the program will RUN on:
+    'sliced' (per-key loop, CPU), 'mxu' (matmul-fused), or 'pallas' (fused
+    single-pass screen). Kernel builders take this as an explicit option so
+    tracing for a non-default device can't bake the wrong branch
+    (jax.default_backend() is only the fallback when no device is given)."""
+    import os
+
+    platform = device.platform if device is not None else jax.default_backend()
+    if platform == "cpu":
+        return "sliced"
+    # KCT_PALLAS=0 keeps the MXU matmul form instead of the fused Pallas screen
+    if os.environ.get("KCT_PALLAS", "auto") in ("0", "false", "off"):
+        return "mxu"
+    return "pallas"
 
 
 def seg_matrix(segments: Segments, V: int):

@@ -72,9 +72,18 @@ def make_pack_kernel(
     zone_seg,
     ct_seg,
     topo_meta: Optional[topo.TopoMeta] = None,
+    backend: Optional[str] = None,
 ):
     """Build the jittable packing fn for a fixed label geometry (+ topology
-    group structure when the batch has topology constraints)."""
+    group structure when the batch has topology constraints).
+
+    backend ∈ {'sliced', 'mxu', 'pallas'} picks the lowering for the device
+    the program will run on (compat.resolve_backend); None resolves from the
+    default backend. Explicit so a CPU trace targeting TPU (or a test forcing
+    the MXU form on CPU) gets the right branch."""
+    backend = backend or compat.resolve_backend()
+    assert backend in ("sliced", "mxu", "pallas"), backend
+    mxu = backend in ("mxu", "pallas")
 
     zlo, zhi = zone_seg
     clo, chi = ct_seg
@@ -107,11 +116,11 @@ def make_pack_kernel(
         (op-count is what bounds the scan step) — or into ONE Pallas pass
         over the allow tile when enabled; on CPU the sliced loop form is
         faster, so pick per backend at trace time."""
-        if compat.use_mxu():
+        if mxu:
             sm = _seg_mat(state.allow.shape[1])
-            from karpenter_core_tpu.ops import pallas_kernels
+            if backend == "pallas":
+                from karpenter_core_tpu.ops import pallas_kernels
 
-            if pallas_kernels.pallas_enabled():
                 return pallas_kernels.slot_screen_pallas(
                     state.allow, state.out, state.defined, prow, sm
                 )
@@ -143,7 +152,7 @@ def make_pack_kernel(
         """[T]: requirement/offering-surviving types for a merged row
         (compatible ∧ hasOffering — machine.go:137-159; resource fit is
         handled separately through per-type replica capacities)."""
-        if compat.use_mxu():
+        if mxu:
             sm = _seg_mat(m_allow.shape[0])
             m_escape = compat.escape_flags_m(
                 m_allow[None], m_out[None], m_defined[None], sm

@@ -11,25 +11,14 @@ makes ONE pass: the allow tile is read into VMEM once, all three MXU
 contractions and the per-key boolean algebra run fused, and only the
 final per-key verdict leaves the core.
 
-Enable with KCT_PALLAS=1 / disable with 0 (default: auto — on for TPU
-backends, off on CPU where the unit tests run the same kernel in
-interpret mode).
+Selection lives in compat.resolve_backend: 'pallas' on accelerator
+backends (KCT_PALLAS=0 falls back to the jnp matmul form), never on CPU,
+where the unit tests run this same kernel in interpret mode instead.
 """
 from __future__ import annotations
 
-import os
-
 import jax
 import jax.numpy as jnp
-
-
-def pallas_enabled() -> bool:
-    flag = os.environ.get("KCT_PALLAS", "auto")
-    if flag in ("1", "true", "on"):
-        return True
-    if flag in ("0", "false", "off"):
-        return False
-    return jax.default_backend() not in ("cpu",)
 
 
 def _round_up(x: int, m: int) -> int:

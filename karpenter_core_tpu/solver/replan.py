@@ -161,12 +161,13 @@ def batched_ladder_screen(
             provisioning.solver._replan_compiled = cache
         except AttributeError:
             pass
-    key = (geom, Rn)
+    backend = getattr(provisioning.solver, "backend", None)
+    key = (geom, Rn, backend)
     fn = cache.get(key)
     if fn is None:
         rung_run = make_device_run(
             segments_t, zone_seg, ct_seg, snap.topo_meta, N, log_len=log_len,
-            rung_mode=True,
+            rung_mode=True, backend=backend,
         )
         fn = jax.jit(jax.vmap(rung_run, in_axes=(0, 0) + (None,) * 18))
         cache[key] = fn

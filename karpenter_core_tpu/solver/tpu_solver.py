@@ -152,7 +152,8 @@ def solve_geometry(snap: EncodedSnapshot, max_nodes: int):
 
 
 def make_device_run(segments, zone_seg, ct_seg, topo_meta, n_slots,
-                    log_len: Optional[int] = None, rung_mode: bool = False):
+                    log_len: Optional[int] = None, rung_mode: bool = False,
+                    backend: Optional[str] = None):
     """Build the jittable device program — the whole Solve() as ONE program:
     feasibility + openable + packing scan. Pure function of the device arrays
     produced by device_args(); all dims except n_slots derive from shapes.
@@ -167,7 +168,9 @@ def make_device_run(segments, zone_seg, ct_seg, topo_meta, n_slots,
     from karpenter_core_tpu.ops.pack import PackState, make_pack_kernel
 
     segments = list(segments)
-    pack = make_pack_kernel(segments, zone_seg, ct_seg, topo_meta=topo_meta)
+    pack = make_pack_kernel(
+        segments, zone_seg, ct_seg, topo_meta=topo_meta, backend=backend
+    )
 
     def run_impl(count_row, exist_open, pod_arrays, tmpl, tmpl_daemon,
                  tmpl_type_mask, types, type_alloc, type_capacity,
@@ -262,13 +265,17 @@ def make_device_run(segments, zone_seg, ct_seg, topo_meta, n_slots,
     return run
 
 
-def build_device_solve(snap: EncodedSnapshot, max_nodes: int = 1024):
-    """Returns (geometry_key, run_fn) for a snapshot's geometry."""
+def build_device_solve(snap: EncodedSnapshot, max_nodes: int = 1024,
+                       backend: Optional[str] = None):
+    """Returns (geometry_key, run_fn) for a snapshot's geometry. backend
+    picks the kernel lowering (compat.resolve_backend default); tests force
+    'mxu' on CPU to exercise the exact TPU code path."""
     geom = solve_geometry(snap, max_nodes)
     (_P, _J, _T, _E, _R, _K, _V, N, segments_t, zone_seg, ct_seg, _topo_sig,
      log_len) = geom
     run = make_device_run(
-        segments_t, zone_seg, ct_seg, snap.topo_meta, N, log_len=log_len
+        segments_t, zone_seg, ct_seg, snap.topo_meta, N, log_len=log_len,
+        backend=backend,
     )
     return geom, run
 
@@ -386,10 +393,11 @@ class TPUSolver:
 
     def __init__(self, max_nodes: int = 1024,
                  max_relax_rounds: int = DEFAULT_MAX_RELAX_ROUNDS,
-                 donate: bool = True):
+                 donate: bool = True, backend: Optional[str] = None):
         self.max_nodes = max_nodes
         self.max_relax_rounds = max_relax_rounds
         self.donate = donate
+        self.backend = backend  # kernel lowering override (compat.resolve_backend)
         self._compiled = {}
 
     # -- public API --------------------------------------------------------
@@ -429,13 +437,13 @@ class TPUSolver:
     def _run_kernels(self, snap: EncodedSnapshot, provisioners: List[Provisioner]):
         import jax
 
-        geom, run = build_device_solve(snap, self.max_nodes)
-        fn = self._compiled.get(geom)
+        geom, run = build_device_solve(snap, self.max_nodes, backend=self.backend)
+        fn = self._compiled.get((geom, self.backend))
         if fn is None:
             # inputs are fresh numpy per solve, so donation invalidates
             # nothing on the host
             fn = jax.jit(run, donate_argnums=DONATE_ARGNUMS if self.donate else ())
-            self._compiled[geom] = fn
+            self._compiled[(geom, self.backend)] = fn
         args = device_args(snap, provisioners)
         # opt-in device profiling around the Solve dispatch — the analog of
         # the reference's pprof-profiled benchmark capture

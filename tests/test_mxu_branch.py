@@ -1,0 +1,219 @@
+"""Differential tests for the MXU (matmul-fused) kernel lowerings.
+
+conftest.py pins tests to CPU, where compat.resolve_backend() picks the
+sliced-loop forms — so the code that actually runs on TPU (rows_compat_m,
+row_vs_rows_compat_m, escape_flags_m, and the backend='mxu' pack kernel)
+would otherwise never be exercised. These tests force the MXU branch on CPU
+and require bit-equality with the sliced reference forms over random
+geometries, plus full-solve equality between backend='mxu' and
+backend='sliced' device programs.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from karpenter_core_tpu.ops import compat
+
+
+def random_segments(rng, n_keys, max_width=12):
+    widths = rng.integers(0, max_width, size=n_keys)  # incl. empty segments
+    bounds = np.cumsum(np.concatenate([[0], widths]))
+    return [(int(a), int(b)) for a, b in zip(bounds[:-1], bounds[1:])]
+
+
+def random_reqset(rng, n, segments):
+    V = segments[-1][1] if segments else 0
+    K = len(segments)
+    return {
+        "allow": jnp.asarray(rng.random((n, V)) < 0.6),
+        "out": jnp.asarray(rng.random((n, K)) < 0.3),
+        "defined": jnp.asarray(rng.random((n, K)) < 0.6),
+        "escape": jnp.asarray(rng.random((n, K)) < 0.25),
+    }
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_escape_flags_m_matches_sliced(seed):
+    rng = np.random.default_rng(seed)
+    segments = random_segments(rng, int(rng.integers(1, 14)))
+    rows = random_reqset(rng, 29, segments)
+    sm = compat.seg_matrix(segments, segments[-1][1])
+    want = compat.escape_flags(rows["allow"], rows["out"], rows["defined"], segments)
+    got = compat.escape_flags_m(rows["allow"], rows["out"], rows["defined"], sm)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def sliced_rows_compat(node, pod, segments):
+    """The pack.py slot_compat_screen else-branch, extracted verbatim as the
+    reference semantics (Requirements.Compatible, requirements.go:123-133)."""
+    ok = jnp.ones(node["allow"].shape[0], dtype=bool)
+    slot_escape = compat.escape_flags(
+        node["allow"], node["out"], node["defined"], segments
+    )
+    for k, (lo, hi) in enumerate(segments):
+        shared = node["defined"][:, k] & pod["defined"][k]
+        both_out = node["out"][:, k] & pod["out"][k]
+        if hi > lo:
+            inter = (node["allow"][:, lo:hi] & pod["allow"][lo:hi]).any(axis=-1)
+            nonempty = both_out | inter
+        else:
+            nonempty = both_out
+        escapes = slot_escape[:, k] & pod["escape"][k]
+        ok &= (~shared) | nonempty | escapes
+    deny = pod["custom_deny"]
+    ok &= ~jnp.any(deny[None, :] & ~node["defined"], axis=-1)
+    return ok
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_rows_compat_m_matches_sliced(seed):
+    rng = np.random.default_rng(100 + seed)
+    segments = random_segments(rng, int(rng.integers(1, 14)))
+    node = random_reqset(rng, 41, segments)
+    pod_rows = random_reqset(rng, 1, segments)
+    pod = {k: v[0] for k, v in pod_rows.items()}
+    pod["custom_deny"] = jnp.asarray(rng.random(len(segments)) < 0.2)
+    sm = compat.seg_matrix(segments, segments[-1][1])
+    want = sliced_rows_compat(node, pod, segments)
+    got = compat.rows_compat_m(
+        {"allow": node["allow"], "out": node["out"], "defined": node["defined"]},
+        pod,
+        sm,
+        custom_deny=pod["custom_deny"],
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def sliced_row_vs_rows(m_allow, m_out, m_defined, m_escape, rows, segments):
+    """pack.py merged_types_compat else-branch (Requirements.Intersects
+    against a batch, requirements.go:189-206)."""
+    T = rows["allow"].shape[0]
+    ok_t = jnp.ones(T, dtype=bool)
+    for k, (lo, hi) in enumerate(segments):
+        shared = m_defined[k] & rows["defined"][:, k]
+        both_out = m_out[k] & rows["out"][:, k]
+        if hi > lo:
+            inter = (m_allow[lo:hi][None, :] & rows["allow"][:, lo:hi]).any(axis=-1)
+            nonempty = both_out | inter
+        else:
+            nonempty = both_out
+        escapes = m_escape[k] & rows["escape"][:, k]
+        ok_t &= (~shared) | nonempty | escapes
+    return ok_t
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4])
+def test_row_vs_rows_compat_m_matches_sliced(seed):
+    rng = np.random.default_rng(200 + seed)
+    segments = random_segments(rng, int(rng.integers(1, 14)))
+    rows = random_reqset(rng, 53, segments)
+    m_rows = random_reqset(rng, 1, segments)
+    m_allow, m_out, m_defined = (
+        m_rows["allow"][0], m_rows["out"][0], m_rows["defined"][0],
+    )
+    sm = compat.seg_matrix(segments, segments[-1][1])
+    m_escape = compat.escape_flags(
+        m_allow[None], m_out[None], m_defined[None], segments
+    )[0]
+    m_escape_m = compat.escape_flags_m(m_allow[None], m_out[None], m_defined[None], sm)[0]
+    np.testing.assert_array_equal(np.asarray(m_escape_m), np.asarray(m_escape))
+    want = sliced_row_vs_rows(m_allow, m_out, m_defined, m_escape, rows, segments)
+    got = compat.row_vs_rows_compat_m(m_allow, m_out, m_defined, m_escape_m, rows, sm)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+# -- full-solve equality: the exact program lowered for TPU, run on CPU ------
+
+
+def _mix(n_pods):
+    from karpenter_core_tpu.kube.objects import (
+        LABEL_HOSTNAME,
+        LABEL_TOPOLOGY_ZONE,
+        LabelSelector,
+        PodAffinityTerm,
+        TopologySpreadConstraint,
+    )
+    from karpenter_core_tpu.testing import make_pod
+
+    zonal = TopologySpreadConstraint(
+        max_skew=1, topology_key=LABEL_TOPOLOGY_ZONE,
+        when_unsatisfiable="DoNotSchedule",
+        label_selector=LabelSelector(match_labels={"app": "spread"}),
+    )
+    hostname = TopologySpreadConstraint(
+        max_skew=1, topology_key=LABEL_HOSTNAME,
+        when_unsatisfiable="DoNotSchedule",
+        label_selector=LabelSelector(match_labels={"app": "hspread"}),
+    )
+    affinity = PodAffinityTerm(
+        topology_key=LABEL_TOPOLOGY_ZONE,
+        label_selector=LabelSelector(match_labels={"app": "aff"}),
+    )
+    pods = []
+    for i in range(n_pods):
+        kind = i % 7
+        if kind == 0:
+            pods.append(make_pod(labels={"app": "spread"}, requests={"cpu": "1"},
+                                 topology_spread=[zonal]))
+        elif kind == 1:
+            pods.append(make_pod(labels={"app": "hspread"}, requests={"cpu": "1"},
+                                 topology_spread=[hostname]))
+        elif kind in (2, 3):
+            pods.append(make_pod(labels={"app": "aff"}, requests={"cpu": "1"},
+                                 pod_affinity_required=[affinity]))
+        else:
+            pods.append(make_pod(requests={"cpu": "1", "memory": "1Gi"}))
+    return pods
+
+
+@pytest.mark.parametrize("n_pods", [25, 70])
+def test_full_solve_mxu_equals_sliced(n_pods):
+    """backend='mxu' (the TPU lowering) and backend='sliced' must produce the
+    SAME commit log on identical snapshots — the device program is otherwise
+    untested on CPU."""
+    import jax
+
+    from karpenter_core_tpu.cloudprovider import fake
+    from karpenter_core_tpu.solver.encode import encode_snapshot
+    from karpenter_core_tpu.solver.tpu_solver import build_device_solve, device_args
+    from karpenter_core_tpu.testing import make_provisioner
+
+    pods = _mix(n_pods)
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(20)}
+    snap = encode_snapshot(pods, provisioners, its, max_nodes=128)
+    args = device_args(snap, provisioners)
+    outs = {}
+    for backend in ("sliced", "mxu"):
+        _, run = build_device_solve(snap, max_nodes=128, backend=backend)
+        log, ptr, state = jax.jit(run)(*args)
+        outs[backend] = (
+            {k: np.asarray(v) for k, v in log.items()}, int(ptr),
+            np.asarray(state.pods), np.asarray(state.tmask),
+        )
+    log_s, ptr_s, pods_s, tmask_s = outs["sliced"]
+    log_m, ptr_m, pods_m, tmask_m = outs["mxu"]
+    assert ptr_s == ptr_m
+    for k in log_s:
+        np.testing.assert_array_equal(log_s[k][:ptr_s], log_m[k][:ptr_m], err_msg=k)
+    np.testing.assert_array_equal(pods_s, pods_m)
+    np.testing.assert_array_equal(tmask_s, tmask_m)
+
+
+def test_resolve_backend_contract():
+    """CPU default resolves 'sliced'; a non-CPU device object resolves the
+    MXU/Pallas form regardless of the default backend; KCT_PALLAS=0 downgrades
+    pallas to mxu."""
+    import os
+
+    class Dev:
+        platform = "tpu"
+
+    assert compat.resolve_backend() == "sliced"  # conftest pins CPU
+    assert compat.resolve_backend(Dev()) == "pallas"
+    os.environ["KCT_PALLAS"] = "0"
+    try:
+        assert compat.resolve_backend(Dev()) == "mxu"
+    finally:
+        del os.environ["KCT_PALLAS"]

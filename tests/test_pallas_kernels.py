@@ -1,8 +1,9 @@
 """Pallas screen kernel vs the jnp reference implementation.
 
 Runs in interpret mode on CPU (tests/conftest.py pins JAX_PLATFORMS=cpu);
-on a real TPU the same kernel compiles via Mosaic and is enabled in the
-packing loop with KCT_PALLAS=1.
+on a real TPU the same kernel compiles via Mosaic and is selected by
+compat.resolve_backend ('pallas' on accelerators unless KCT_PALLAS=0;
+tests force it via the kernel builders' backend option).
 """
 import numpy as np
 import pytest
