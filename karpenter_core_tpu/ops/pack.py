@@ -278,6 +278,7 @@ def make_pack_kernel(
         well_known: jnp.ndarray = None,
         topo_terms: dict = None,
         log_len: int = None,
+        n_exist: int = 0,
     ):
         N = state.used.shape[0]
         J = tmpl_daemon.shape[0]
@@ -291,6 +292,15 @@ def make_pack_kernel(
         # undersized log fails the overflow pods cleanly instead of placing
         # them unlogged.
         L = log_len if log_len is not None else (4 * (I + N) + 64)
+        # bulk existing-fill log: one [E] take-vector per bulk commit, the
+        # main log carries an ns=-1 marker entry (k = bulk row index) so
+        # decode replays commits in order. Budget: one bulk per item for the
+        # topology-free case plus water-fill domain rounds (<= V-ish), hard-
+        # capped so the [LB, E] matrix stays small at 50k-item scale — on
+        # overflow the use_bulk gate falls back to the per-slot path, which
+        # is slower but identical in result.
+        EB = n_exist
+        LB = min(2 * I + V + 64, 4096) if EB > 0 else 1
 
         log0 = {
             "item": jnp.full(L, -1, jnp.int32),
@@ -298,6 +308,8 @@ def make_pack_kernel(
             "ns": jnp.zeros(L, jnp.int32),
             "k": jnp.zeros(L, jnp.int32),
             "k_last": jnp.zeros(L, jnp.int32),
+            "bulk_take": jnp.zeros((LB, EB), jnp.int32),
+            "bulk_n": jnp.int32(0),
         }
 
         def log_write(log, ptr, do, item_i, slot_lo, ns, k, k_last):
@@ -308,6 +320,7 @@ def make_pack_kernel(
                 return a.at[p].set(jnp.where(w, v, a[p]))
 
             log = {
+                **log,
                 "item": wr(log["item"], item_i),
                 "slot": wr(log["slot"], slot_lo),
                 "ns": wr(log["ns"], ns),
@@ -317,6 +330,13 @@ def make_pack_kernel(
             return log, ptr + jnp.where(w, 1, 0)
 
         def step(carry, i):
+            # padded / empty items skip the whole step body (screens, probes,
+            # spread plans) through ONE cond — the item-axis bucket padding
+            # costs microseconds per padded row instead of a full screen
+            valid_i = item_arrays["valid"][i] & (item_arrays["count"][i] > 0)
+            return jax.lax.cond(valid_i, _step_body, lambda c, _i: c, carry, i), None
+
+        def _step_body(carry, i):
             state, log, ptr = carry
             prow = {
                 "allow": item_arrays["allow"][i],
@@ -354,10 +374,27 @@ def make_pack_kernel(
 
             f_static_p = f_static[:, i, :]  # [J, T]
 
+            owns_vk_spread0 = jnp.bool_(False)
+            for g, _gm in vk_spread_gs:
+                owns_vk_spread0 |= prow["topo_own"][g]
+
             # per-domain open-feasibility probes are loop-invariant for the
-            # item: compute once per step, consult every iteration
-            dom_open_by_g = {}
-            for g, gm in vk_spread_gs:
+            # item: compute once per step, consult every iteration — gated
+            # behind ownership so the (dominant) non-spread items skip the
+            # J x T x seg probe work entirely
+            def _compute_dom_open(_):
+                out = []
+                for g, gm in vk_spread_gs:
+                    out.append(_dom_open_one(g, gm))
+                return tuple(out)
+
+            def _zeros_dom_open(_):
+                return tuple(
+                    jnp.zeros(gm.seg[1] - gm.seg[0], dtype=bool)
+                    for _g, gm in vk_spread_gs
+                )
+
+            def _dom_open_one(g, gm):
                 lo, hi = gm.seg
                 dom_open = jnp.zeros(hi - lo, dtype=bool)
                 for j in range(J):
@@ -386,7 +423,17 @@ def make_pack_kernel(
                         & tmpl_reqs["allow"][j, lo:hi]
                         & (f_j[:, None] & type_dom).any(axis=0)
                     )
-                dom_open_by_g[g] = dom_open
+                return dom_open
+
+            if vk_spread_gs:
+                dom_open_t = jax.lax.cond(
+                    owns_vk_spread0, _compute_dom_open, _zeros_dom_open, None
+                )
+                dom_open_by_g = {
+                    g: dom_open_t[x] for x, (g, _gm) in enumerate(vk_spread_gs)
+                }
+            else:
+                dom_open_by_g = {}
 
             def spread_plan(state, remaining, dead, score, ptr):
                 """Per-iteration water-fill targeting for owned value-key
@@ -497,6 +544,14 @@ def make_pack_kernel(
                 owns_vk_spread |= prow["topo_own"][g]
                 n_owned_vk += prow["topo_own"][g].astype(jnp.int32)
 
+            # bulk existing-fill eligibility is loop-invariant per item
+            if EB > 0 and has_topo:
+                item_bulk_ok = topo.topo_bulk_item_ok(
+                    topo_meta, prow["topo_own"], prow["topo_sel"]
+                )
+            else:
+                item_bulk_ok = jnp.bool_(EB > 0)
+
             # -- candidate branch: verify best slot, commit k replicas ----
             def do_candidate(args):
                 carry, force, cap, gate, _dmark = args
@@ -544,6 +599,93 @@ def make_pack_kernel(
                 # available for a later fill round in the same domain
                 retire = (~do) | (k >= kmax)
                 score = score.at[n].set(jnp.where(retire, BIG, score[n]))
+                return state, log, ptr, remaining, score, jnp.bool_(False), dead
+
+            # -- bulk existing fill: ALL gated existing candidates in one
+            # iteration (the reference tries existing nodes in index order
+            # per pod, scheduler.go:179-185 — identical replicas filling in
+            # index order under per-slot caps reproduce it exactly). Without
+            # this, a 1000-node cluster costs one while-iteration per slot
+            # per item.
+            def do_bulk(args):
+                carry, force, cap, gate, _dmark = args
+                state, log, ptr, remaining, score, _, dead = carry
+                cands = (score < BIG) & gate & state.is_existing
+                if has_topo:
+                    viable = topo.topo_screen(
+                        topo_meta, state.tcounts, state.thost, state.tdoms,
+                        prow["topo_own"], prow["topo_sel"], prow["allow"],
+                        state.allow,
+                    )
+                    narrow, applied_keys, k_topo_e = topo.topo_bulk_narrow(
+                        topo_meta, state.tcounts, state.thost, state.tdoms,
+                        prow["topo_own"], prow["topo_sel"], prow["allow"], K,
+                        spread_force=force,
+                    )
+                    # owned narrowed domains must remain reachable per slot
+                    for g, gm in enumerate(topo_meta.groups):
+                        if gm.is_hostname or gm.is_inverse:
+                            continue
+                        if gm.gtype in (topo.TOPO_SPREAD, topo.TOPO_AFFINITY):
+                            lo, hi = gm.seg
+                            ok_g = (state.allow[:, lo:hi] & narrow[lo:hi]).any(-1)
+                            viable &= ~prow["topo_own"][g] | ok_g
+                else:
+                    viable = jnp.ones(N, dtype=bool)
+                    narrow = jnp.ones(V, dtype=bool)
+                    applied_keys = jnp.zeros(K, dtype=bool)
+                    k_topo_e = jnp.full(N, BIGK, dtype=jnp.int32)
+
+                k_e = replica_cap(state.cap, state.used, prow["requests"])  # [N]
+                k_eff = jnp.where(
+                    cands & viable, jnp.minimum(k_e, k_topo_e), 0
+                )
+                budget = jnp.minimum(remaining, cap)
+                csum = jnp.cumsum(k_eff)
+                take = jnp.clip(budget - (csum - k_eff), 0, k_eff)
+                placed = take.sum()
+                bn = log["bulk_n"]
+                do = (placed >= 1) & (ptr < L) & (bn < LB)
+
+                m_allow_rows = state.allow & (prow["allow"] & narrow)[None, :]
+                m_out_rows = state.out & prow["out"][None, :] & ~applied_keys[None, :]
+                m_def_rows = state.defined | prow["defined"][None, :] | applied_keys[None, :]
+                touched = take > 0
+
+                def apply(state):
+                    tm = touched[:, None]
+                    st = state._replace(
+                        used=state.used
+                        + take[:, None].astype(jnp.float32) * prow["requests"][None, :],
+                        pods=state.pods + take,
+                        allow=jnp.where(tm, m_allow_rows, state.allow),
+                        out=jnp.where(tm, m_out_rows, state.out),
+                        defined=jnp.where(tm, m_def_rows, state.defined),
+                    )
+                    if has_topo:
+                        tcounts, thost, tdoms = topo.topo_record_bulk(
+                            topo_meta, st.tcounts, st.thost, st.tdoms,
+                            prow["topo_own"], prow["topo_sel"],
+                            m_allow_rows, m_out_rows, take,
+                        )
+                        st = st._replace(tcounts=tcounts, thost=thost, tdoms=tdoms)
+                    return st
+
+                state = jax.lax.cond(do, apply, lambda s: s, state)
+                bslot = jnp.minimum(bn, LB - 1)
+                log = {
+                    **log,
+                    "bulk_take": log["bulk_take"].at[bslot].set(
+                        jnp.where(do, take[:EB], log["bulk_take"][bslot])
+                    ),
+                    "bulk_n": bn + jnp.where(do, 1, 0),
+                }
+                log, ptr = log_write(log, ptr, do, i, 0, -1, bn, placed)
+                remaining = remaining - jnp.where(do, placed, 0)
+                # retire filled/unusable slots; on a no-op pass retire every
+                # candidate so the loop is guaranteed to progress
+                retire = cands & jnp.where(do, (k_eff == 0) | (take >= k_eff), True)
+                score = jnp.where(retire, BIG, score)
                 return state, log, ptr, remaining, score, jnp.bool_(False), dead
 
             # -- open branch: bulk-open s fresh slots, m replicas each ----
@@ -721,8 +863,23 @@ def make_pack_kernel(
                     carry[0], carry[3], carry[4], carry[6],
                 )
                 if vk_spread_gs:
-                    force, cap, blocked, gate, dmark = spread_plan(
-                        state_c, remaining_c, dead_c, score_c, carry[2]
+                    # non-owners skip the whole water-fill plan (cond, not
+                    # where): the plan's [N]/[seg] reductions are per-
+                    # iteration costs the dominant topology-free items
+                    # shouldn't pay
+                    force, cap, blocked, gate, dmark = jax.lax.cond(
+                        owns_vk_spread0,
+                        lambda _: spread_plan(
+                            state_c, remaining_c, dead_c, score_c, carry[2]
+                        ),
+                        lambda _: (
+                            jnp.ones(V, dtype=bool),
+                            jnp.int32(BIGK),
+                            jnp.bool_(False),
+                            jnp.ones(N, dtype=bool),
+                            jnp.zeros(V, dtype=bool),
+                        ),
+                        None,
                     )
                 else:
                     force = jnp.ones(V, dtype=bool)
@@ -732,7 +889,32 @@ def make_pack_kernel(
                     dmark = jnp.zeros(V, dtype=bool)
                 has_cand = jnp.where(gate, score_c, BIG).min() < BIG
                 args = (inner, force, cap, gate, dmark)
-                inner = jax.lax.cond(has_cand, do_candidate, do_open, args)
+                if EB > 0:
+                    exist_cand = (
+                        (score_c < BIG) & gate & state_c.is_existing
+                    ).any()
+                    need_seed = (
+                        topo.topo_bulk_need_seed(
+                            topo_meta, state_c.tcounts, state_c.tdoms,
+                            prow["topo_own"], prow["allow"],
+                        )
+                        if has_topo
+                        else jnp.bool_(False)
+                    )
+                    use_bulk = (
+                        item_bulk_ok
+                        & exist_cand
+                        & ~need_seed
+                        & (carry[1]["bulk_n"] < LB)
+                    )
+                    inner = jax.lax.cond(
+                        use_bulk,
+                        do_bulk,
+                        lambda a: jax.lax.cond(has_cand, do_candidate, do_open, a),
+                        args,
+                    )
+                else:
+                    inner = jax.lax.cond(has_cand, do_candidate, do_open, args)
                 state_n, log_n, ptr_n, remaining_n, score_n, exhausted_n, dead_n = inner
                 return (
                     state_n, log_n, ptr_n, remaining_n, score_n,
@@ -747,7 +929,7 @@ def make_pack_kernel(
             state, log, ptr, _, _, _, _, _ = jax.lax.while_loop(
                 cond_fn, body_fn, carry0
             )
-            return (state, log, ptr), None
+            return (state, log, ptr)
 
         (state, log, ptr), _ = jax.lax.scan(
             step, (state, log0, jnp.int32(0)), jnp.arange(I, dtype=jnp.int32)

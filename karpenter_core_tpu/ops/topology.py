@@ -320,6 +320,130 @@ def topo_narrow_single(meta: TopoMeta, tcounts, thost, tdoms, own, selp,
     return viable, narrow, applied_keys, k_cap
 
 
+def topo_bulk_item_ok(meta: TopoMeta, own, selp):
+    """Scalar bool: may this item take the bulk existing-fill fast path?
+
+    The bulk path fills MANY existing slots in one iteration with per-slot
+    singleton-domain counting, so it excludes items whose placement records
+    non-singleton deltas or requires per-slot sequencing:
+      - anti-affinity (owner or selected, direct or inverse-owner): each
+        placement records vals over all possible domains (topo_record) and
+        changes the next slot's viability;
+      - hostname pod-affinity owners: replicas must co-locate on one host;
+      - groups with node-filter terms: nf_ok is per merged slot row, which
+        the bulk path does not evaluate.
+    """
+    import jax.numpy as jnp
+
+    ok = jnp.bool_(True)
+    for g, gm in enumerate(meta.groups):
+        has_terms = len(gm.filter_term_rows) > 0
+        if gm.is_inverse:
+            ok &= ~own[g]
+            if has_terms:
+                ok &= ~selp[g]
+            continue
+        if gm.gtype == TOPO_ANTI:
+            ok &= ~(own[g] | selp[g])
+        elif gm.gtype == TOPO_AFFINITY and gm.is_hostname:
+            ok &= ~own[g]
+        if has_terms:
+            ok &= ~(own[g] | selp[g])
+    return ok
+
+
+def topo_bulk_need_seed(meta: TopoMeta, tcounts, tdoms, own, pod_allow):
+    """Scalar bool: an owned value-key affinity group has NO positive domain
+    yet — the first replica must seed one via the single-slot path before
+    the bulk path can fill against positive domains."""
+    import jax.numpy as jnp
+
+    need = jnp.bool_(False)
+    for g, gm in enumerate(meta.groups):
+        if gm.is_inverse or gm.is_hostname or gm.gtype != TOPO_AFFINITY:
+            continue
+        lo, hi = gm.seg
+        has_pos = (
+            pod_allow[lo:hi] & tdoms[g, lo:hi] & (tcounts[g, lo:hi] > 0.5)
+        ).any()
+        need |= own[g] & ~has_pos
+    return need
+
+
+def topo_bulk_narrow(meta: TopoMeta, tcounts, thost, tdoms, own, selp,
+                     pod_allow, n_keys: int, spread_force=None):
+    """(narrow[V], applied_keys[K], k_cap[N]) for the bulk existing fill.
+
+    Unlike topo_narrow_single the narrowing row is SLOT-INDEPENDENT (domain
+    choice depends only on counts/registered domains/the water-fill force),
+    so one row merges into every filled slot; per-slot admission is the
+    caller's viability screen ∧ (slot allows the narrowed domains). k_cap[N]
+    is the per-slot replica headroom of owned hostname-spread groups."""
+    import jax.numpy as jnp
+
+    V = pod_allow.shape[0]
+    N = thost.shape[1] if thost.ndim == 2 else 0
+    narrow = jnp.ones(V, dtype=bool)
+    applied = jnp.zeros(n_keys, dtype=bool)
+    k_cap = jnp.full(N, jnp.int32(2**30), dtype=jnp.int32)
+    for g, gm in enumerate(meta.groups):
+        if gm.is_inverse:
+            continue
+        if gm.is_hostname:
+            if gm.gtype == TOPO_SPREAD:
+                headroom = jnp.maximum(
+                    jnp.float32(gm.max_skew) - thost[g], 0.0
+                ).astype(jnp.int32)
+                k_cap = jnp.where(
+                    own[g] & selp[g], jnp.minimum(k_cap, headroom), k_cap
+                )
+            continue
+        lo, hi = gm.seg
+        doms = tdoms[g, lo:hi]
+        if gm.gtype == TOPO_SPREAD:
+            sf = spread_force[lo:hi] if spread_force is not None else doms
+            g_narrow = sf & doms
+        elif gm.gtype == TOPO_AFFINITY:
+            g_narrow = pod_allow[lo:hi] & doms & (tcounts[g, lo:hi] > 0.5)
+        else:
+            continue
+        seg_new = jnp.where(own[g], narrow[lo:hi] & g_narrow, narrow[lo:hi])
+        narrow = narrow.at[lo:hi].set(seg_new)
+        applied = applied.at[gm.key_k].max(own[g])
+    return narrow, applied, k_cap
+
+
+def topo_record_bulk(meta: TopoMeta, tcounts, thost, tdoms, own, selp,
+                     m_allow_rows, m_out_rows, k_row):
+    """Per-slot merged-row variant of topo_record for the bulk existing fill.
+
+    Only reachable for items topo_bulk_item_ok admits (no anti, no inverse
+    ownership, no filtered groups), so value-key counting is the singleton
+    rule evaluated per slot and nf_ok is vacuously true."""
+    import jax.numpy as jnp
+
+    k_row_f = k_row.astype(jnp.float32)
+    touched = k_row > 0
+    for g, gm in enumerate(meta.groups):
+        if gm.is_hostname:
+            rec = own[g] if gm.is_inverse else selp[g]
+            thost = thost.at[g].add(jnp.where(rec, k_row_f, 0.0))
+            continue
+        if gm.is_inverse:
+            continue  # inverse groups record on OWNER placements only
+        lo, hi = gm.seg
+        allow_seg = m_allow_rows[:, lo:hi]
+        out_k = m_out_rows[:, gm.key_k]
+        rec = selp[g]
+        singleton = (~out_k) & (allow_seg.sum(axis=-1) == 1)
+        delta = allow_seg & singleton[:, None]  # [N, seg]
+        inc = (delta.astype(jnp.float32) * k_row_f[:, None]).sum(axis=0)
+        tcounts = tcounts.at[g, lo:hi].add(jnp.where(rec, inc, 0.0))
+        newdoms = (delta & touched[:, None]).any(axis=0) & rec
+        tdoms = tdoms.at[g, lo:hi].set(tdoms[g, lo:hi] | newdoms)
+    return tcounts, thost, tdoms
+
+
 def topo_record(
     meta: TopoMeta,
     tcounts,

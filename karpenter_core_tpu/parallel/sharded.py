@@ -61,8 +61,11 @@ def plan_shards(snap, ndp: int) -> Tuple[np.ndarray, np.ndarray]:
         else np.ones(len(snap.pods), dtype=np.int32)
     ).astype(np.int64)
     I = len(counts)
+    # the exist axis is bucket-padded at encode; sentinel rows [E_real, E_pad)
+    # stay unowned, i.e. closed on every shard
+    E_pad = snap.exist_used.shape[0] if snap.exist_used is not None else 0
     E = len(snap.state_nodes)
-    exist_owner = np.zeros((ndp, E), dtype=bool)
+    exist_owner = np.zeros((ndp, E_pad), dtype=bool)
     for e in range(E):
         exist_owner[e % ndp, e] = True
 
@@ -128,12 +131,13 @@ def make_sharded_solve(snap, provisioners, mesh, max_nodes_per_shard: int = 256,
     from karpenter_core_tpu.solver.tpu_solver import device_args, solve_geometry
 
     geom = solve_geometry(snap, max_nodes_per_shard)
-    (_, J, T, E, R, K, V, _, segments_t, zone_seg, ct_seg, _topo_sig,
+    (_, J, T, E, R, K, V, N, segments_t, zone_seg, ct_seg, _topo_sig,
      log_len) = geom
     segments = list(segments_t)
     ndp = mesh.shape["dp"]
     ntp = mesh.shape["tp"]
-    N = E + max_nodes_per_shard
+    # N = snap.n_slots (E includes the encode-time bucket padding) — the
+    # topo hcounts arrays are sized to it, so the slot axis must match
     has_topo = snap.topo_meta is not None and len(snap.topo_meta.groups) > 0
     G = len(snap.topo_meta.groups) if has_topo else 0
     count_split, exist_owner = plan_shards(snap, ndp)
@@ -216,11 +220,13 @@ def make_sharded_solve(snap, provisioners, mesh, max_nodes_per_shard: int = 256,
                 well_known=well_known,
                 topo_terms=topo_terms,
                 log_len=log_len,
+                n_exist=E,
             )
             # global stats via psum over dp: pods scheduled (an ICI collective)
             scheduled = jax.lax.psum(state.pods.sum(), "dp")
             # rank-0 per-shard values need a singleton axis to concatenate over dp
             state = state._replace(nopen=state.nopen[None])
+            log = {**log, "bulk_n": log["bulk_n"][None]}
             return log, ptr[None], state, scheduled
 
         # item rows replicate; only the per-shard replica counts shard over dp
@@ -264,7 +270,10 @@ def make_sharded_solve(snap, provisioners, mesh, max_nodes_per_shard: int = 256,
             {k: P(None, None) for k in ("allow", "out", "defined", "escape")},  # topo_terms
         )
         out_specs = (
-            {k: P("dp") for k in ("item", "slot", "ns", "k", "k_last")},  # commit log
+            {
+                **{k: P("dp") for k in ("item", "slot", "ns", "k", "k_last", "bulk_n")},
+                "bulk_take": P("dp", None),
+            },  # commit log
             P("dp"),  # log ptr (singleton axis per shard)
             PackState(
                 used=P("dp", None),
@@ -300,6 +309,11 @@ def make_sharded_solve(snap, provisioners, mesh, max_nodes_per_shard: int = 256,
      topo_doms0, topo_terms) = base_args
     pod_arrays = dict(pod_arrays)
     pod_arrays.pop("count")
+    # device count axis padded like device_args pads the item rows; the
+    # returned plan keeps the real-I count_split for decoding
+    I_pad = pod_arrays["valid"].shape[0]
+    count_split_dev = np.zeros((ndp, I_pad), dtype=count_split.dtype)
+    count_split_dev[:, : count_split.shape[1]] = count_split
 
     # limits split proportional to each shard's replica load (pessimistic:
     # the shares always sum to <= the global budget)
@@ -321,7 +335,7 @@ def make_sharded_solve(snap, provisioners, mesh, max_nodes_per_shard: int = 256,
 
     args = (
         pod_arrays,
-        count_split,
+        count_split_dev,
         tmpl,
         tmpl_daemon,
         tmpl_type_mask,
@@ -367,7 +381,13 @@ def decode_sharded(snap, log, ptr, state, count_split):
     ndp = count_split.shape[0]
     # shard_map concatenates per-shard outputs along the leading axis:
     # reshape [ndp*L] logs and [ndp*N, ...] state fields back to per-shard
-    log = {k: np.asarray(v).reshape(ndp, -1) for k, v in log.items()}
+    # (trailing dims preserved — bulk_take is [ndp*LB, E])
+    log = {
+        k: (lambda a: a.reshape((ndp, a.shape[0] // ndp) + a.shape[1:]))(
+            np.asarray(v)
+        )
+        for k, v in log.items()
+    }
     ptr = np.asarray(ptr).reshape(-1)
     P = len(snap.pods)
     offs = np.cumsum(count_split, axis=0) - count_split  # [ndp, I]

@@ -49,6 +49,18 @@ from karpenter_core_tpu.utils import resources as resources_util
 CORE_RESOURCES = ["cpu", "memory", "pods", "ephemeral-storage"]
 
 
+def bucket_pow2(n: int, lo: int) -> int:
+    """Round n up to a power-of-two bucket (min lo); 0 stays 0. Batch-size
+    axes are padded to buckets so solves at never-seen sizes reuse the
+    compiled program — p99 must be a solve, not a compile."""
+    if n <= 0:
+        return 0
+    b = lo
+    while b < n:
+        b *= 2
+    return b
+
+
 def _pod_spec_signature(p: Pod) -> Tuple:
     """Content key for pod spec-equivalence: covers exactly what the encoder
     derives per pod — namespace+labels (topology selection/ownership),
@@ -261,11 +273,17 @@ def encode_snapshot(
     kube_client=None,
     cluster=None,
     max_nodes: int = 1024,
+    reuse_dictionary: Optional[LabelDictionary] = None,
 ) -> EncodedSnapshot:
     """Lower a provisioning snapshot to tensors.
 
     Pods are sorted FFD (cpu desc, mem desc — queue.go:74-110) so the packing
     scan consumes them in reference order.
+
+    reuse_dictionary: a dictionary from an earlier encode of the SAME
+    snapshot whose value universe is a superset of this batch's (relaxation
+    only removes requirements) — reusing it keeps V/K/segments identical so
+    relaxation re-solves hit the compiled program instead of recompiling.
     """
     from karpenter_core_tpu.api.provisioner import order_by_weight
 
@@ -359,26 +377,38 @@ def encode_snapshot(
     )
 
     # -- dictionary closure ------------------------------------------------
-    dictionary = LabelDictionary()
-    for reqs in pod_reqs_u + tmpl_reqs_list + type_reqs_list + exist_reqs_list:
-        _collect_requirement_values(reqs, dictionary)
-    for tg in topo_groups:
-        if tg.key == LABEL_HOSTNAME:
-            dictionary.add_key(tg.key)  # hostname domains live on slot identity
-        else:
-            dictionary.add_key(tg.key)
-            for d in tg.domains:
-                dictionary.add_value(tg.key, d)
-        for term in tg.node_filter.terms:
-            _collect_requirement_values(term, dictionary)
-    # zone/capacity-type always present for offering logic
-    dictionary.add_key(LABEL_TOPOLOGY_ZONE)
-    dictionary.add_key(api_labels.LABEL_CAPACITY_TYPE)
-    for it in all_types:
-        for o in it.offerings:
-            dictionary.add_value(LABEL_TOPOLOGY_ZONE, o.zone)
-            dictionary.add_value(api_labels.LABEL_CAPACITY_TYPE, o.capacity_type)
-    dictionary.freeze()
+    # the EXISTING-NODE axis is padded to a power-of-two bucket (closed
+    # sentinel slots, see below) so batches with varying node counts share a
+    # compiled program; hostname values pad in step so the segment width
+    # tracks the bucket, not the live count
+    E_real = len(state_nodes)
+    E_pad = bucket_pow2(E_real, 8)
+    if reuse_dictionary is not None:
+        dictionary = reuse_dictionary
+    else:
+        dictionary = LabelDictionary()
+        for reqs in pod_reqs_u + tmpl_reqs_list + type_reqs_list + exist_reqs_list:
+            _collect_requirement_values(reqs, dictionary)
+        for tg in topo_groups:
+            if tg.key == LABEL_HOSTNAME:
+                dictionary.add_key(tg.key)  # hostname domains live on slot identity
+            else:
+                dictionary.add_key(tg.key)
+                for d in tg.domains:
+                    dictionary.add_value(tg.key, d)
+            for term in tg.node_filter.terms:
+                _collect_requirement_values(term, dictionary)
+        # zone/capacity-type always present for offering logic
+        dictionary.add_key(LABEL_TOPOLOGY_ZONE)
+        dictionary.add_key(api_labels.LABEL_CAPACITY_TYPE)
+        for it in all_types:
+            for o in it.offerings:
+                dictionary.add_value(LABEL_TOPOLOGY_ZONE, o.zone)
+                dictionary.add_value(api_labels.LABEL_CAPACITY_TYPE, o.capacity_type)
+        if E_real:
+            for i in range(E_real, E_pad):
+                dictionary.add_value(LABEL_HOSTNAME, f"__exist-pad-{i}")
+        dictionary.freeze()
 
     # -- resources ---------------------------------------------------------
     extended = sorted(
@@ -469,11 +499,19 @@ def encode_snapshot(
     # -- existing nodes ----------------------------------------------------
     # pod x node toleration is evaluated once per (spec class,
     # taint-signature): cluster nodes overwhelmingly share a handful of
-    # taint sets, so the P x E double loop becomes #classes x #signatures
-    E = len(state_nodes)
-    exist_used = np.zeros((E, R), dtype=np.float32)
-    exist_cap = np.zeros((E, R), dtype=np.float32)
-    pod_tol_exist = np.zeros((P, E), dtype=bool)
+    # taint sets, so the P x E double loop becomes #classes x #signatures.
+    # Rows [E_real, E_pad) are closed sentinels: cap=-1 never fits
+    # (compat.fits rejects negative allocatable) and tolerations are False,
+    # so the kernel can never place onto them — they exist only to keep the
+    # array geometry on a bucket boundary.
+    E = E_real
+    exist_used = np.zeros((E_pad, R), dtype=np.float32)
+    exist_cap = np.full((E_pad, R), -1.0, dtype=np.float32)
+    exist_cap[:E] = 0.0
+    pod_tol_exist = np.zeros((P, E_pad), dtype=bool)
+    exist_reqs_list = exist_reqs_list + [
+        Requirements() for _ in range(E_pad - E_real)
+    ]
     taint_sig_cols: Dict[Tuple, np.ndarray] = {}
     for e, node in enumerate(state_nodes):
         node_taints = node.taints()
@@ -507,7 +545,8 @@ def encode_snapshot(
     # -- topology arrays ---------------------------------------------------
     from karpenter_core_tpu.ops.topology import encode_topology
 
-    n_slots = E + min(max_nodes, max(P, 1))
+    # machine-slot budget on a bucket too (same compiled-program argument)
+    n_slots = E_pad + min(max_nodes, bucket_pow2(max(P, 1), 64))
     topo_meta, topo_arrays = encode_topology(
         host_topology,
         pods_sorted,

@@ -121,14 +121,15 @@ def batched_ladder_screen(
         cluster=cluster,
         max_nodes=max_nodes,
     )
-    E = len(snap.state_nodes)
+    E = snap.exist_used.shape[0]  # bucket-padded existing axis
     name_to_slot = {n.name(): e for e, n in enumerate(snap.state_nodes)}
     cand_slot = np.full(len(candidates), -1, dtype=np.int64)
     for ci, c in enumerate(candidates):
         cand_slot[ci] = name_to_slot.get(c.name, -1)
-    uninitialized = np.array(
-        [not n.initialized() for n in snap.state_nodes], dtype=bool
-    )
+    uninitialized = np.zeros(E, dtype=bool)  # padded sentinel rows: False
+    uninitialized[: len(snap.state_nodes)] = [
+        not n.initialized() for n in snap.state_nodes
+    ]
 
     # per-row candidate tag on the FFD-sorted pod axis
     cand_of_row = np.array(
@@ -138,7 +139,10 @@ def batched_ladder_screen(
     I = len(snap.item_counts) if snap.item_counts is not None else len(snap.pods)
 
     Rn = len(sizes)
-    count_rows = np.zeros((Rn, I), dtype=np.int32)
+    from karpenter_core_tpu.solver.encode import bucket_pow2
+
+    # count axis padded like device_args pads the item axis
+    count_rows = np.zeros((Rn, bucket_pow2(max(I, 1), 32)), dtype=np.int32)
     exist_open = np.ones((Rn, E), dtype=bool)
     for r, size in enumerate(sizes):
         for it in range(I):

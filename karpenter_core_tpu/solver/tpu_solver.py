@@ -90,10 +90,15 @@ DEFAULT_MAX_RELAX_ROUNDS = 16
 
 def solve_with_relaxation(solve_once, pods, provisioners, instance_types,
                           max_relax_rounds: int) -> "SolveResult":
-    """Shared driver: guard degenerate inputs, deepcopy pods (relaxation
-    mutates specs), run solve_once, relax EVERY failed pod between rounds
-    (preferences.go order) — used by TPUSolver, RemoteSolver, and any other
-    Solver implementation.
+    """Shared driver: guard degenerate inputs, run solve_once, relax EVERY
+    failed pod between rounds (preferences.go order) — used by TPUSolver,
+    RemoteSolver, and any other Solver implementation.
+
+    Relaxation mutates pod specs, so a failed pod is deep-copied ON FIRST
+    RELAX (lazily, identity-tracked across rounds) instead of deep-copying
+    the whole batch up front — at 50k pods the wholesale copy costs seconds
+    per solve while the common case never relaxes at all. Caller-passed
+    objects are never mutated.
 
     Termination matches the reference (scheduler.go:114-123): rounds continue
     until no failed pod can relax further (Preferences.relax fixpoint);
@@ -103,7 +108,9 @@ def solve_with_relaxation(solve_once, pods, provisioners, instance_types,
         return SolveResult()
     if not provisioners or not any(instance_types.values()):
         return SolveResult(failed_pods=list(pods))
-    pods = [copy.deepcopy(p) for p in pods]
+    pods = list(pods)
+    index_of = {id(p): i for i, p in enumerate(pods)}
+    is_copy = [False] * len(pods)
     preferences = Preferences(
         any(t.effect == "PreferNoSchedule" for p in provisioners for t in p.spec.taints)
     )
@@ -112,7 +119,16 @@ def solve_with_relaxation(solve_once, pods, provisioners, instance_types,
     while result.failed_pods and rounds < max_relax_rounds:
         relaxed_any = False
         for pod in result.failed_pods:
-            relaxed_any |= preferences.relax(pod)
+            i = index_of.get(id(pod))
+            if i is None:
+                continue  # defensive: not a pod of this batch
+            if not is_copy[i]:
+                pods[i] = copy.deepcopy(pod)
+                index_of[id(pods[i])] = i
+                is_copy[i] = True
+            # always relax the COPY at that index — a stale id lookup (the
+            # same caller object listed twice) must never reach the original
+            relaxed_any |= preferences.relax(pods[i])
         if not relaxed_any:
             break
         result = solve_once(pods)
@@ -122,12 +138,18 @@ def solve_with_relaxation(solve_once, pods, provisioners, instance_types,
 
 
 def solve_geometry(snap: EncodedSnapshot, max_nodes: int):
+    from karpenter_core_tpu.solver.encode import bucket_pow2
+
     dictionary = snap.dictionary
     segments = [dictionary.segment(k) for k in dictionary.keys]
-    P = len(snap.item_counts) if snap.item_counts is not None else len(snap.pods)
+    # item axis padded to a bucket (device_args pads with valid=False rows)
+    # and existing axis pre-padded at encode: the geometry key — and with it
+    # the compiled program — is stable across nearby batch sizes
+    I_real = len(snap.item_counts) if snap.item_counts is not None else len(snap.pods)
+    P = bucket_pow2(max(I_real, 1), 32)
     J = len(snap.templates)
     T = len(snap.instance_types)
-    E = len(snap.state_nodes)
+    E = snap.exist_used.shape[0] if snap.exist_used is not None else 0
     R = len(snap.resource_names)
     K, V = dictionary.K, dictionary.V
     # the slot budget is fixed at encode time (snapshot topo arrays are sized
@@ -242,6 +264,10 @@ def make_device_run(segments, zone_seg, ct_seg, topo_meta, n_slots,
             well_known=well_known,
             topo_terms=topo_terms,
             log_len=log_len,
+            # rung mode never decodes the log (the ladder screen reads only
+            # state.pods), so the bulk fast path is disabled to avoid
+            # allocating Rn vmapped bulk logs
+            n_exist=0 if rung_mode else E,
         )
         return log, ptr, state
 
@@ -313,6 +339,23 @@ def device_args(snap: EncodedSnapshot, provisioners: Optional[List[Provisioner]]
         pod_arrays["topo_own"] = snap.topo_arrays.owner.T[rep].copy()  # [I, G]
         pod_arrays["topo_sel"] = snap.topo_arrays.sel.T[rep].copy()
     pod_tol_all = np.concatenate([snap.pod_tol, snap.pod_tol_exist], axis=1)[rep]
+
+    # pad the item axis to the bucketed geometry (valid=False, count=0 rows
+    # never commit — the scan pays one cheap step each); must mirror
+    # solve_geometry's bucket
+    from karpenter_core_tpu.solver.encode import bucket_pow2
+
+    I_pad = bucket_pow2(max(I, 1), 32)
+    if I_pad > I:
+        pad = I_pad - I
+
+        def pad_rows(a):
+            return np.concatenate(
+                [a, np.zeros((pad,) + a.shape[1:], dtype=a.dtype)], axis=0
+            )
+
+        pod_arrays = {k: pad_rows(v) for k, v in pod_arrays.items()}
+        pod_tol_all = pad_rows(pod_tol_all)
 
     # provisioner limits -> remaining resources [J, R] (scheduler.go:70-75)
     remaining0 = np.full((J, len(snap.resource_names)), np.float32(1e30))
@@ -412,10 +455,14 @@ class TPUSolver:
         kube_client=None,
         cluster=None,
     ) -> SolveResult:
+        # relaxation rounds reuse round 1's dictionary: dropping a preferred
+        # term would shrink the value universe, change V/K, and force a
+        # recompile mid-solve — a superset dictionary is always valid
+        relax_ctx = {"dictionary": None}
         return solve_with_relaxation(
             lambda p: self._solve_once(
                 p, provisioners, instance_types, daemonset_pods, state_nodes,
-                kube_client, cluster,
+                kube_client, cluster, relax_ctx,
             ),
             pods,
             provisioners,
@@ -426,11 +473,14 @@ class TPUSolver:
     # -- internals ---------------------------------------------------------
 
     def _solve_once(self, pods, provisioners, instance_types, daemonset_pods,
-                    state_nodes, kube_client=None, cluster=None):
+                    state_nodes, kube_client=None, cluster=None, relax_ctx=None):
         snap = encode_snapshot(
             pods, provisioners, instance_types, daemonset_pods, state_nodes,
             kube_client=kube_client, cluster=cluster, max_nodes=self.max_nodes,
+            reuse_dictionary=relax_ctx.get("dictionary") if relax_ctx else None,
         )
+        if relax_ctx is not None:
+            relax_ctx["dictionary"] = snap.dictionary
         log, ptr, state = self._run_kernels(snap, provisioners)
         return decode_solve(snap, (log, ptr), state)
 
@@ -451,6 +501,10 @@ class TPUSolver:
         # xprof. One trace per solve while the env var is set.
         import os
 
+        # one batched transfer for the whole arg tree: the TPU link (axon
+        # tunnel especially) charges per-transfer latency, so ~40 implicit
+        # per-leaf uploads cost seconds where one device_put costs ~0.1s
+        args = jax.device_put(args)
         trace_dir = os.environ.get("KARPENTER_JAX_TRACE_DIR", "")
         if trace_dir:
             with jax.profiler.trace(trace_dir):
@@ -458,11 +512,28 @@ class TPUSolver:
                 jax.block_until_ready(state)
         else:
             log, ptr, state = fn(*args)
-        return (
-            {k: np.asarray(v) for k, v in log.items()},
-            int(ptr),
-            jax.tree_util.tree_map(np.asarray, state),
+        # fetch ONLY what decode reads: log entries [:ptr], bulk rows
+        # [:bulk_n], and state slot rows [:nopen] (the slot budget is mostly
+        # unused headroom — at 50k pods this cuts the fetch ~10x)
+        ptr_i, nopen, bulk_n = jax.device_get((ptr, state.nopen, log["bulk_n"]))
+        ptr_i, nopen, bulk_n = int(ptr_i), int(nopen), int(bulk_n)
+        sliced = (
+            {k: log[k][:ptr_i] for k in ("item", "slot", "ns", "k", "k_last")},
+            log["bulk_take"][:bulk_n],
+            {
+                f: getattr(state, f)[:nopen]
+                for f in ("tmpl", "tmask", "used", "allow", "out", "defined", "pods")
+            },
         )
+        # ONE batched device_get — per-transfer link latency dominates the
+        # fetch when every leaf round-trips separately
+        log_h, bulk_take, state_d = jax.device_get(sliced)
+        log_h["bulk_take"] = bulk_take
+        log_h["bulk_n"] = bulk_n
+        from types import SimpleNamespace
+
+        state_h = SimpleNamespace(**state_d)
+        return log_h, ptr_i, state_h
 
 def expand_log(snap: EncodedSnapshot, log, ptr: int,
                member_lo=None, member_hi=None) -> np.ndarray:
@@ -492,12 +563,25 @@ def expand_log(snap: EncodedSnapshot, log, ptr: int,
     nss = np.asarray(log["ns"])
     ks = np.asarray(log["k"])
     k_lasts = np.asarray(log["k_last"])
+    bulk_take = np.asarray(log.get("bulk_take", np.zeros((0, 0), np.int32)))
     for e in range(int(ptr)):
         item = int(items[e])
         if item < 0:
             continue
         mem = members[item]
         ns, k, k_last = int(nss[e]), int(ks[e]), int(k_lasts[e])
+        if ns == -1:
+            # bulk existing-fill marker: k is the bulk_take row; fill slots
+            # in index order (the commit's own order)
+            row = bulk_take[k]
+            for slot_e in np.nonzero(row)[0]:
+                take = int(row[slot_e])
+                lo = cursor[item]
+                hi = min(lo + take, cap[item], len(mem))
+                for m in mem[lo:hi]:
+                    assigned[m] = slot_e
+                cursor[item] = hi
+            continue
         for s in range(ns):
             take = k_last if s == ns - 1 else k
             lo = cursor[item]

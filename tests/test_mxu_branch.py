@@ -195,8 +195,9 @@ def test_full_solve_mxu_equals_sliced(n_pods):
     log_s, ptr_s, pods_s, tmask_s = outs["sliced"]
     log_m, ptr_m, pods_m, tmask_m = outs["mxu"]
     assert ptr_s == ptr_m
-    for k in log_s:
+    for k in ("item", "slot", "ns", "k", "k_last"):
         np.testing.assert_array_equal(log_s[k][:ptr_s], log_m[k][:ptr_m], err_msg=k)
+    np.testing.assert_array_equal(log_s["bulk_take"], log_m["bulk_take"])
     np.testing.assert_array_equal(pods_s, pods_m)
     np.testing.assert_array_equal(tmask_s, tmask_m)
 

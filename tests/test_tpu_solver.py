@@ -492,3 +492,183 @@ def test_spread_degrades_under_provisioner_limits():
         assert z.len() == 1
         zone_counts[z.values_list()[0]] += len(m.pods)
     assert max(zone_counts.values()) - min(zone_counts.values()) <= 1, zone_counts
+
+
+# -- bulk existing-fill fast path -------------------------------------------
+
+
+def _exist_nodes(n, cpu="4", zone_of=None, labels_extra=None):
+    nodes = []
+    for i in range(n):
+        labels = {
+            PROVISIONER_NAME_LABEL_KEY: "default",
+            "karpenter.sh/initialized": "true",
+        }
+        if zone_of is not None:
+            from karpenter_core_tpu.kube.objects import LABEL_TOPOLOGY_ZONE
+
+            labels[LABEL_TOPOLOGY_ZONE] = zone_of(i)
+        if labels_extra:
+            labels.update(labels_extra)
+        node = make_node(name=f"exist-{i}", labels=labels,
+                         capacity={"cpu": cpu, "memory": "16Gi", "pods": "50"})
+        nodes.append(StateNode(node=node))
+    return nodes
+
+
+def test_bulk_existing_fill_matches_host_many_nodes():
+    """An item spanning MANY existing nodes must land exactly like the
+    reference's index-order fill (exercises the do_bulk branch, which fills
+    every gated existing slot in one while-iteration)."""
+    pods = [make_pod(labels={"app": "web"}, requests={"cpu": "1"}) for _ in range(40)]
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(8)}
+    host, tpu = run_both(pods, provisioners, its, state_nodes=_exist_nodes(12))
+    assert not tpu.failed_pods and not host.failed_pods
+    # 12 nodes x 4 cpu = 48 >= 40: everything fits on existing, zero machines
+    assert not tpu.new_machines and not host.new_machines
+    assert tpu.pod_count_existing() == 40
+    # index-order fill: same per-node pod counts as the host oracle
+    host_counts = sorted(len(p) for _, p in host.existing_assignments)
+    tpu_counts = sorted(len(p) for _, p in tpu.existing_assignments)
+    assert host_counts == tpu_counts
+
+
+def test_bulk_existing_fill_overflow_opens_machines():
+    pods = [make_pod(requests={"cpu": "1"}) for _ in range(30)]
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(8)}
+    host, tpu = run_both(pods, provisioners, its, state_nodes=_exist_nodes(4))
+    assert not tpu.failed_pods
+    assert tpu.pod_count_existing() == 16  # 4 nodes x 4 cpu
+    assert tpu.pod_count_new() == 14
+    assert len(tpu.new_machines) <= len(host.new_machines)
+
+
+def test_bulk_existing_fill_hostname_spread_headroom():
+    """Hostname-spread owners fill one replica per existing host (skew=1)
+    via the bulk path's per-slot headroom cap, then spill to fresh hosts."""
+    from karpenter_core_tpu.kube.objects import (
+        LABEL_HOSTNAME,
+        LabelSelector,
+        TopologySpreadConstraint,
+    )
+
+    spread = TopologySpreadConstraint(
+        max_skew=1, topology_key=LABEL_HOSTNAME,
+        when_unsatisfiable="DoNotSchedule",
+        label_selector=LabelSelector(match_labels={"app": "hs"}),
+    )
+    pods = [
+        make_pod(labels={"app": "hs"}, requests={"cpu": "1"}, topology_spread=[spread])
+        for _ in range(10)
+    ]
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(8)}
+    host, tpu = run_both(pods, provisioners, its, state_nodes=_exist_nodes(6))
+    assert not tpu.failed_pods
+    for _, placed in tpu.existing_assignments:
+        assert len(placed) == 1  # skew 1 over hostname: one per host
+    assert tpu.pod_count_existing() == 6
+    assert tpu.pod_count_new() == 4
+    for m in tpu.new_machines:
+        assert len(m.pods) == 1
+
+
+def test_bulk_existing_fill_zonal_spread_balance():
+    """Zonal-spread owners bulk-fill existing nodes per water-fill domain
+    round; final zone balance must satisfy max_skew like the host oracle."""
+    from karpenter_core_tpu.kube.objects import (
+        LABEL_TOPOLOGY_ZONE,
+        LabelSelector,
+        TopologySpreadConstraint,
+    )
+
+    spread = TopologySpreadConstraint(
+        max_skew=1, topology_key=LABEL_TOPOLOGY_ZONE,
+        when_unsatisfiable="DoNotSchedule",
+        label_selector=LabelSelector(match_labels={"app": "zs"}),
+    )
+    pods = [
+        make_pod(labels={"app": "zs"}, requests={"cpu": "1"}, topology_spread=[spread])
+        for _ in range(18)
+    ]
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(8)}
+    nodes = _exist_nodes(6, zone_of=lambda i: f"test-zone-{1 + i % 3}")
+    host, tpu = run_both(pods, provisioners, its, state_nodes=nodes)
+    assert not tpu.failed_pods
+    zone_counts = {}
+    for sn, placed in tpu.existing_assignments:
+        z = sn.labels()["topology.kubernetes.io/zone"]
+        zone_counts[z] = zone_counts.get(z, 0) + len(placed)
+    for m in tpu.new_machines:
+        zr = m.requirements.get_requirement("topology.kubernetes.io/zone")
+        z = zr.values_list()[0]
+        zone_counts[z] = zone_counts.get(z, 0) + len(m.pods)
+    assert sum(zone_counts.values()) == 18
+    assert max(zone_counts.values()) - min(zone_counts.values()) <= 1
+
+
+def test_bulk_existing_fill_pod_affinity_seeded_domain():
+    """Pod-affinity owners: first replica seeds a zone (single-slot path),
+    the rest bulk-fill only existing nodes in positive domains."""
+    from karpenter_core_tpu.kube.objects import (
+        LABEL_TOPOLOGY_ZONE,
+        LabelSelector,
+        PodAffinityTerm,
+    )
+
+    aff = PodAffinityTerm(
+        topology_key=LABEL_TOPOLOGY_ZONE,
+        label_selector=LabelSelector(match_labels={"app": "aff"}),
+    )
+    pods = [
+        make_pod(labels={"app": "aff"}, requests={"cpu": "1"},
+                 pod_affinity_required=[aff])
+        for _ in range(10)
+    ]
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(8)}
+    nodes = _exist_nodes(6, zone_of=lambda i: f"test-zone-{1 + i % 3}")
+    host, tpu = run_both(pods, provisioners, its, state_nodes=nodes)
+    assert not tpu.failed_pods
+    zones = set()
+    for sn, placed in tpu.existing_assignments:
+        zones.add(sn.labels()["topology.kubernetes.io/zone"])
+    for m in tpu.new_machines:
+        zones.update(m.requirements.get_requirement(
+            "topology.kubernetes.io/zone").values_list())
+    assert len(zones) == 1, f"affinity pods must co-locate in one zone, got {zones}"
+
+
+def test_bulk_existing_fill_mixed_with_plain_items():
+    """Plain + spread + selector items over a heterogeneous node fleet: the
+    TPU result must use no more machines than the host oracle."""
+    from karpenter_core_tpu.kube.objects import (
+        LABEL_TOPOLOGY_ZONE,
+        LabelSelector,
+        TopologySpreadConstraint,
+    )
+
+    spread = TopologySpreadConstraint(
+        max_skew=1, topology_key=LABEL_TOPOLOGY_ZONE,
+        when_unsatisfiable="DoNotSchedule",
+        label_selector=LabelSelector(match_labels={"app": "zs"}),
+    )
+    pods = (
+        [make_pod(labels={"app": "zs"}, requests={"cpu": "1"}, topology_spread=[spread])
+         for _ in range(6)]
+        + [make_pod(labels={"app": f"p{i % 5}"}, requests={"cpu": "1"}) for i in range(20)]
+        + [make_pod(requests={"cpu": "1"},
+                    node_selector={LABEL_CAPACITY_TYPE: "on-demand"}) for _ in range(4)]
+    )
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(10)}
+    nodes = _exist_nodes(
+        5, zone_of=lambda i: f"test-zone-{1 + i % 3}",
+        labels_extra={LABEL_CAPACITY_TYPE: "on-demand"},
+    )
+    host, tpu = run_both(pods, provisioners, its, state_nodes=nodes)
+    assert len(tpu.failed_pods) == len(host.failed_pods) == 0
+    assert len(tpu.new_machines) <= len(host.new_machines)
