@@ -1,20 +1,31 @@
-"""Benchmark: pods scheduled/sec for the device solve.
+"""Benchmark: the NORTH-STAR config — 50k pods x 500 instance types, >=1000
+distinct pod specs, 1000 existing nodes — end-to-end Solve() p99 over
+varied batch sizes on real TPU hardware.
 
 Reference baseline: the Go scheduler enforces a floor of 100 pods/sec for
 batches > 100 pods (reference scheduling_benchmark_test.go:50,180-184) and
-publishes no absolute numbers; vs_baseline is therefore measured against that
-floor. The timed region is the jitted device program — feasibility +
-packing — which is the analog of Scheduler.Solve() (snapshot encoding is the
-control plane's job and is reported separately on stderr).
+publishes no absolute numbers; vs_baseline is measured against that floor.
+The chartered target (BASELINE.json north_star): < 1s p99 Solve() at
+50k x 500 on a v5e-4 (this bench runs on ONE v5e chip).
+
+The timed region is the FULL Solve() — encode + device program + decode —
+because that is what the reference's Solve() does; the device-only time is
+reported in "extra". p99 is taken across >= BENCH_RUNS solves whose pod /
+existing-node counts vary inside one bucket geometry (so steady-state
+production solves hit the compiled cache; the compile is reported
+separately). The workload is the reference benchmark's diverse mix
+(scheduling_benchmark_test.go:187-199) with BENCH_DISTINCT distinct generic
+specs — round 2's mix collapsed to 4 equivalence classes, which measured
+the bulk-replica fast path instead of the per-item scan.
 
 Prints exactly one JSON line:
-  {"metric": ..., "value": N, "unit": "pods/sec", "vs_baseline": N/100}
+  {"metric": ..., "value": N, "unit": "pods/sec", "vs_baseline": N/100,
+   "extra": {...p50/p99, device ms, consolidation replan number...}}
 
 Hardened (round 2): the bench NEVER exits without printing that JSON line.
-Backend init is probed in a subprocess with retries (round 1 died at
-"Unable to initialize backend 'axon': UNAVAILABLE" and recorded nothing);
-if the accelerator stays unavailable the bench falls back to CPU and says so
-in the metric name, because a CPU number beats no number.
+Backend init is probed in a subprocess with retries; on exhaustion it falls
+back to CPU and says so in the metric name. Each failed probe attempt is
+printed to stderr (preserved in the driver's recorded tail).
 """
 import json
 import os
@@ -26,14 +37,19 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np
 
-N_PODS = int(os.environ.get("BENCH_PODS", "2000"))
-N_TYPES = int(os.environ.get("BENCH_TYPES", "100"))
-N_RUNS = int(os.environ.get("BENCH_RUNS", "5"))
+N_PODS = int(os.environ.get("BENCH_PODS", "50000"))
+N_TYPES = int(os.environ.get("BENCH_TYPES", "500"))
+N_RUNS = int(os.environ.get("BENCH_RUNS", "20"))
+N_DISTINCT = int(os.environ.get("BENCH_DISTINCT", "1000"))
 MIX = os.environ.get("BENCH_MIX", "reference")  # reference | plain
 CONFIG = os.environ.get("BENCH_CONFIG", "solve")  # solve | consolidation
 N_EXISTING = int(os.environ.get("BENCH_EXISTING", "1000"))
+# consolidation sub-bench scale (ref multinodeconsolidation.go:87-113)
+CONS_NODES = int(os.environ.get("BENCH_CONS_NODES", "1000"))
+CONS_PODS = int(os.environ.get("BENCH_CONS_PODS", "10000"))
+CONS_TYPES = int(os.environ.get("BENCH_CONS_TYPES", "100"))
 # node-slot budget: hostname-spread pods (1/7 of the mix) need a slot each
-MAX_NODES = int(os.environ.get("BENCH_NODES", str(max(1024, N_PODS // 4))))
+MAX_NODES = int(os.environ.get("BENCH_NODES", str(max(1024, N_PODS // 4 + 2048))))
 PROBE_RETRIES = int(os.environ.get("BENCH_PROBE_RETRIES", "2"))
 PROBE_TIMEOUT = int(os.environ.get("BENCH_PROBE_TIMEOUT", "240"))
 
@@ -86,10 +102,47 @@ def ensure_backend():
           file=sys.stderr)
 
 
-def _reference_mix(n_pods: int, n_types: int):
+def _existing_nodes(n: int, universe):
+    """n initialized provisioned nodes over the type universe, 3 zones."""
+    from karpenter_core_tpu.api.labels import (
+        LABEL_CAPACITY_TYPE,
+        LABEL_NODE_INITIALIZED,
+        PROVISIONER_NAME_LABEL_KEY,
+    )
+    from karpenter_core_tpu.kube.objects import (
+        LABEL_INSTANCE_TYPE_STABLE,
+        LABEL_TOPOLOGY_ZONE,
+    )
+    from karpenter_core_tpu.state.node import StateNode
+    from karpenter_core_tpu.testing import make_node
+
+    nodes = []
+    for i in range(n):
+        it = universe[i % len(universe)]
+        node = make_node(
+            name=f"node-{i}",
+            labels={
+                PROVISIONER_NAME_LABEL_KEY: "default",
+                LABEL_NODE_INITIALIZED: "true",
+                LABEL_INSTANCE_TYPE_STABLE: it.name,
+                LABEL_CAPACITY_TYPE: "on-demand",
+                LABEL_TOPOLOGY_ZONE: f"test-zone-{1 + i % 3}",
+            },
+            capacity={k: str(v) for k, v in it.capacity.items()},
+        )
+        nodes.append(StateNode(node=node))
+    return nodes
+
+
+def _reference_mix(n_pods: int, n_types: int, distinct: int = 1, seed: int = 0,
+                   universe=None):
     """The reference benchmark's diverse pod mix
     (scheduling_benchmark_test.go:187-199): 1/7 zonal topology spread,
-    1/7 hostname spread, 2/7 pod affinity, 3/7 generic."""
+    1/7 hostname spread, 2/7 pod affinity, 3/7 generic — the generic share
+    split over `distinct` spec-equivalence classes so the per-item scan
+    (not just the bulk-replica fast path) is what gets measured. `seed`
+    varies the class labels so repeat runs are distinct workloads;
+    `universe` reuses an instance-type list instead of building one."""
     from karpenter_core_tpu.cloudprovider import fake
     from karpenter_core_tpu.kube.objects import (
         LABEL_HOSTNAME,
@@ -138,19 +191,25 @@ def _reference_mix(n_pods: int, n_types: int):
                 )
             )
         else:
-            pods.append(make_pod(requests={"cpu": "1", "memory": "1Gi"}))
+            pods.append(
+                make_pod(
+                    labels={"app": f"gen-{seed}-{i % max(distinct, 1)}"},
+                    requests={"cpu": "1", "memory": "1Gi"},
+                )
+            )
     provisioners = [make_provisioner(name="default")]
-    return pods, provisioners, {"default": fake.instance_types(n_types)}
+    return pods, provisioners, {
+        "default": universe if universe is not None else fake.instance_types(n_types)
+    }
 
 
-def consolidation_bench():
-    """Config 4 analog: N_EXISTING under-utilized nodes, N_PODS running
+def consolidation_bench(emit: bool = True):
+    """Config 4 analog: CONS_NODES under-utilized nodes, CONS_PODS running
     pods, full multi-node replan (the parallel prefix ladder over
     simulate_scheduling, replacing multinodeconsolidation.go:87-113's
     sequential binary search). Timed region: the whole ComputeCommand
-    ladder, steady-state (compiled programs cached)."""
-    import time as _time
-
+    ladder, steady-state (compiled programs cached). Returns a result dict;
+    emit=True also prints the standalone JSON line."""
     from karpenter_core_tpu.api.labels import (
         LABEL_CAPACITY_TYPE,
         LABEL_NODE_INITIALIZED,
@@ -165,15 +224,15 @@ def consolidation_bench():
     from karpenter_core_tpu.testing import FakeClock, make_node, make_pod, make_provisioner
 
     clock = FakeClock()
-    universe = fake.instance_types(N_TYPES)
+    universe = fake.instance_types(CONS_TYPES)
     cp = fake.FakeCloudProvider(universe)
-    solver = TPUSolver(max_nodes=max(1024, N_PODS // 4))
+    solver = TPUSolver(max_nodes=max(1024, CONS_PODS // 4))
     op = new_operator(cp, settings=Settings(), solver=solver, clock=clock)
     op.kube_client.create(make_provisioner(name="default", consolidation_enabled=True))
 
-    pods_per_node = max(1, N_PODS // N_EXISTING)
+    pods_per_node = max(1, CONS_PODS // CONS_NODES)
     t0 = time.perf_counter()
-    for n in range(N_EXISTING):
+    for n in range(CONS_NODES):
         it = universe[n % len(universe)]
         name = f"node-{n}"
         node = make_node(
@@ -213,81 +272,138 @@ def consolidation_bench():
     candidates, cmd = replan()
     warm_s = time.perf_counter() - t0
     times = []
-    for _ in range(max(1, N_RUNS - 1)):
+    for _ in range(4):
         t0 = time.perf_counter()
         candidates, cmd = replan()
         times.append(time.perf_counter() - t0)
     replan_s = float(np.median(times)) if times else warm_s
 
-    total_pods = N_EXISTING * pods_per_node
+    total_pods = CONS_NODES * pods_per_node
     pods_per_sec = total_pods / replan_s
     print(
-        f"[bench] consolidation nodes={N_EXISTING} pods={total_pods} "
-        f"types={N_TYPES} candidates={len(candidates)} action={cmd.action} "
+        f"[bench] consolidation nodes={CONS_NODES} pods={total_pods} "
+        f"types={CONS_TYPES} candidates={len(candidates)} action={cmd.action} "
         f"removed={len(cmd.nodes_to_remove)} setup={setup_s:.1f}s "
         f"warm={warm_s:.1f}s replan_med={replan_s * 1e3:.1f}ms",
         file=sys.stderr,
     )
-    suffix = "_cpu_fallback" if BACKEND_NOTE.startswith("cpu-fallback") else ""
-    print(
-        json.dumps(
-            {
-                "metric": (
-                    f"consolidation_replan_pods_per_sec_{N_EXISTING}nodes_"
-                    f"{total_pods}pods{suffix}"
-                ),
-                "value": round(pods_per_sec, 1),
-                "unit": "pods/sec",
-                "vs_baseline": round(pods_per_sec / 100.0, 2),
-            }
+    result = {
+        "nodes": CONS_NODES,
+        "pods": total_pods,
+        "types": CONS_TYPES,
+        "action": str(cmd.action),
+        "removed": len(cmd.nodes_to_remove),
+        "replan_med_ms": round(replan_s * 1e3, 1),
+        "warm_s": round(warm_s, 1),
+        "pods_per_sec": round(pods_per_sec, 1),
+    }
+    if emit:
+        suffix = "_cpu_fallback" if BACKEND_NOTE.startswith("cpu-fallback") else ""
+        print(
+            json.dumps(
+                {
+                    "metric": (
+                        f"consolidation_replan_pods_per_sec_{CONS_NODES}nodes_"
+                        f"{total_pods}pods{suffix}"
+                    ),
+                    "value": round(pods_per_sec, 1),
+                    "unit": "pods/sec",
+                    "vs_baseline": round(pods_per_sec / 100.0, 2),
+                }
+            )
         )
-    )
+    return result
 
 
 def main():
     import jax
 
-    from __graft_entry__ import _scenario
+    from karpenter_core_tpu.cloudprovider import fake
     from karpenter_core_tpu.solver.encode import encode_snapshot
-    from karpenter_core_tpu.solver.tpu_solver import build_device_solve, device_args
+    from karpenter_core_tpu.solver.tpu_solver import (
+        TPUSolver,
+        build_device_solve,
+        device_args,
+    )
 
+    universe = fake.instance_types(N_TYPES)
+    solver = TPUSolver(max_nodes=MAX_NODES)
+
+    def workload(n_pods, n_existing, seed):
+        pods, provisioners, its = _reference_mix(
+            n_pods, N_TYPES, N_DISTINCT, seed=seed, universe=universe
+        )
+        return pods, provisioners, its, _existing_nodes(n_existing, universe)
+
+    # -- warm the compiled program for the bucket geometry ----------------
     t0 = time.perf_counter()
-    if MIX == "reference":
-        pods, provisioners, instance_types = _reference_mix(N_PODS, N_TYPES)
-    else:
-        pods, provisioners, instance_types = _scenario(N_PODS, N_TYPES)
-    snap = encode_snapshot(pods, provisioners, instance_types, max_nodes=MAX_NODES)
-    encode_s = time.perf_counter() - t0
+    pods, provisioners, its, nodes = workload(N_PODS, N_EXISTING, 0)
+    gen_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    res = solver.solve(pods, provisioners, its, state_nodes=nodes)
+    cold_s = time.perf_counter() - t0
+    scheduled = res.pod_count_new() + res.pod_count_existing()
+    print(
+        f"[bench] device={jax.devices()[0].device_kind} cold={cold_s:.1f}s "
+        f"gen={gen_s:.1f}s scheduled={scheduled}/{N_PODS} "
+        f"existing_used={res.pod_count_existing()} failed={len(res.failed_pods)}",
+        file=sys.stderr,
+    )
 
+    # device-only time at the headline config (r01/r02-comparable region)
+    snap = encode_snapshot(pods, provisioners, its, None, nodes, max_nodes=MAX_NODES)
+    args = jax.device_put(device_args(snap, provisioners))
     _, run = build_device_solve(snap, max_nodes=MAX_NODES)
-    args = device_args(snap, provisioners)
     fn = jax.jit(run)
-
-    t0 = time.perf_counter()
     out = fn(*args)
     jax.block_until_ready(out)
-    compile_s = time.perf_counter() - t0
-
-    times = []
-    for _ in range(N_RUNS):
+    dts = []
+    for _ in range(3):
         t0 = time.perf_counter()
         out = fn(*args)
         jax.block_until_ready(out)
-        times.append(time.perf_counter() - t0)
+        dts.append(time.perf_counter() - t0)
+    device_ms = float(np.median(dts)) * 1e3
+    del out, args
 
-    from karpenter_core_tpu.solver.tpu_solver import expand_log
+    # -- p99 across varied batch sizes (same bucket => compiled-cache hits,
+    # the production steady state; each solve is a FRESH workload) --------
+    rng = np.random.default_rng(7)
+    times = []
+    sched_counts = []
+    for r in range(N_RUNS):
+        n_pods = int(N_PODS * (0.8 + 0.25 * rng.random()))  # 40k..52.5k
+        n_exist = int(N_EXISTING * (0.88 + 0.12 * rng.random()))  # same E bucket
+        pods, provisioners, its, nodes = workload(n_pods, n_exist, r)
+        t0 = time.perf_counter()
+        res = solver.solve(pods, provisioners, its, state_nodes=nodes)
+        dt = time.perf_counter() - t0
+        times.append(dt)
+        sched_counts.append(res.pod_count_new() + res.pod_count_existing())
+        print(
+            f"[bench] run {r + 1}/{N_RUNS}: pods={n_pods} nodes={n_exist} "
+            f"solve={dt * 1e3:.0f}ms scheduled={sched_counts[-1]}",
+            file=sys.stderr,
+        )
+    ts = np.sort(np.array(times))
+    p50 = float(np.percentile(ts, 50))
+    p99 = float(np.percentile(ts, 99))
+    compiled = len(solver._compiled)
+    pods_per_sec = N_PODS / p99  # pods/sec at the p99 latency, headline size
 
-    log, ptr, state = out
-    log = {k: np.asarray(v) for k, v in log.items()}
-    assigned = expand_log(snap, log, int(ptr))
-    scheduled = int((assigned >= 0).sum())
-    solve_s = float(np.median(times))
-    pods_per_sec = scheduled / solve_s
+    cons = None
+    if os.environ.get("BENCH_SKIP_CONSOLIDATION", "") != "1":
+        try:
+            cons = consolidation_bench(emit=False)
+        except BaseException as exc:  # noqa: BLE001 — still record the solve
+            import traceback
+
+            traceback.print_exc()
+            cons = {"error": f"{type(exc).__name__}: {exc}"[:200]}
 
     print(
-        f"[bench] device={jax.devices()[0].device_kind} pods={N_PODS} types={N_TYPES} "
-        f"scheduled={scheduled} encode={encode_s:.2f}s compile={compile_s:.1f}s "
-        f"solve_med={solve_s * 1e3:.1f}ms p_best={min(times) * 1e3:.1f}ms",
+        f"[bench] e2e p50={p50 * 1e3:.0f}ms p99={p99 * 1e3:.0f}ms "
+        f"device_med={device_ms:.0f}ms compiled_programs={compiled}",
         file=sys.stderr,
     )
     suffix = "_cpu_fallback" if BACKEND_NOTE.startswith("cpu-fallback") else ""
@@ -295,11 +411,25 @@ def main():
         json.dumps(
             {
                 "metric": (
-                    f"pods_scheduled_per_sec_device_solve_{N_PODS}pods_{N_TYPES}types{suffix}"
+                    f"pods_per_sec_e2e_p99_{N_PODS}pods_{N_TYPES}types_"
+                    f"{N_DISTINCT}distinct_{N_EXISTING}nodes{suffix}"
                 ),
                 "value": round(pods_per_sec, 1),
                 "unit": "pods/sec",
                 "vs_baseline": round(pods_per_sec / 100.0, 2),
+                "extra": {
+                    "e2e_p50_ms": round(p50 * 1e3, 1),
+                    "e2e_p99_ms": round(p99 * 1e3, 1),
+                    "device_solve_med_ms": round(device_ms, 1),
+                    "north_star_target_ms": 1000.0,
+                    "device_under_target": bool(device_ms < 1000.0),
+                    "runs": N_RUNS,
+                    "scheduled_min": int(min(sched_counts)),
+                    "compile_cold_s": round(cold_s, 1),
+                    "compiled_programs_after_varied_batches": compiled,
+                    "chips": 1,
+                    "consolidation": cons,
+                },
             }
         )
     )

@@ -608,35 +608,63 @@ def make_pack_kernel(
             # this, a 1000-node cluster costs one while-iteration per slot
             # per item.
             def do_bulk(args):
+                # every tensor here is restricted to the EXISTING prefix
+                # [:EB] — existing slots are the only bulk targets, and the
+                # machine-slot tail [EB, N) would otherwise multiply every
+                # op's cost ~N/EB-fold
                 carry, force, cap, gate, _dmark = args
                 state, log, ptr, remaining, score, _, dead = carry
-                cands = (score < BIG) & gate & state.is_existing
+                sa = state.allow[:EB]
+                cands = (score[:EB] < BIG) & gate[:EB] & state.is_existing[:EB]
                 if has_topo:
-                    viable = topo.topo_screen(
-                        topo_meta, state.tcounts, state.thost, state.tdoms,
-                        prow["topo_own"], prow["topo_sel"], prow["allow"],
-                        state.allow,
+                    # topology-free items (the bulk of a real batch) skip the
+                    # whole group evaluation through one cond
+                    any_topo = jnp.bool_(False)
+                    for g in range(len(topo_meta.groups)):
+                        any_topo |= prow["topo_own"][g] | prow["topo_sel"][g]
+                    thost_e = state.thost[:, :EB] if has_topo else None
+
+                    def topo_eval(_):
+                        viable = topo.topo_screen(
+                            topo_meta, state.tcounts, thost_e, state.tdoms,
+                            prow["topo_own"], prow["topo_sel"], prow["allow"],
+                            sa,
+                        )
+                        narrow, applied_keys, k_topo_e = topo.topo_bulk_narrow(
+                            topo_meta, state.tcounts, thost_e, state.tdoms,
+                            prow["topo_own"], prow["topo_sel"], prow["allow"], K,
+                            spread_force=force,
+                        )
+                        # owned narrowed domains must stay reachable per slot
+                        for g, gm in enumerate(topo_meta.groups):
+                            if gm.is_hostname or gm.is_inverse:
+                                continue
+                            if gm.gtype in (topo.TOPO_SPREAD, topo.TOPO_AFFINITY):
+                                lo, hi = gm.seg
+                                ok_g = (sa[:, lo:hi] & narrow[lo:hi]).any(-1)
+                                viable &= ~prow["topo_own"][g] | ok_g
+                        return viable, narrow, applied_keys, k_topo_e
+
+                    def topo_skip(_):
+                        return (
+                            jnp.ones(EB, dtype=bool),
+                            jnp.ones(V, dtype=bool),
+                            jnp.zeros(K, dtype=bool),
+                            jnp.full(EB, BIGK, dtype=jnp.int32),
+                        )
+
+                    viable, narrow, applied_keys, k_topo_e = jax.lax.cond(
+                        any_topo, topo_eval, topo_skip, None
                     )
-                    narrow, applied_keys, k_topo_e = topo.topo_bulk_narrow(
-                        topo_meta, state.tcounts, state.thost, state.tdoms,
-                        prow["topo_own"], prow["topo_sel"], prow["allow"], K,
-                        spread_force=force,
-                    )
-                    # owned narrowed domains must remain reachable per slot
-                    for g, gm in enumerate(topo_meta.groups):
-                        if gm.is_hostname or gm.is_inverse:
-                            continue
-                        if gm.gtype in (topo.TOPO_SPREAD, topo.TOPO_AFFINITY):
-                            lo, hi = gm.seg
-                            ok_g = (state.allow[:, lo:hi] & narrow[lo:hi]).any(-1)
-                            viable &= ~prow["topo_own"][g] | ok_g
                 else:
-                    viable = jnp.ones(N, dtype=bool)
+                    viable = jnp.ones(EB, dtype=bool)
                     narrow = jnp.ones(V, dtype=bool)
                     applied_keys = jnp.zeros(K, dtype=bool)
-                    k_topo_e = jnp.full(N, BIGK, dtype=jnp.int32)
+                    k_topo_e = jnp.full(EB, BIGK, dtype=jnp.int32)
 
-                k_e = replica_cap(state.cap, state.used, prow["requests"])  # [N]
+                k_e = replica_cap(
+                    state.cap[:EB], state.used[:EB], prow["requests"]
+                )  # [EB]
                 k_eff = jnp.where(
                     cands & viable, jnp.minimum(k_e, k_topo_e), 0
                 )
@@ -647,26 +675,44 @@ def make_pack_kernel(
                 bn = log["bulk_n"]
                 do = (placed >= 1) & (ptr < L) & (bn < LB)
 
-                m_allow_rows = state.allow & (prow["allow"] & narrow)[None, :]
-                m_out_rows = state.out & prow["out"][None, :] & ~applied_keys[None, :]
-                m_def_rows = state.defined | prow["defined"][None, :] | applied_keys[None, :]
+                m_allow_rows = sa & (prow["allow"] & narrow)[None, :]
+                m_out_rows = state.out[:EB] & prow["out"][None, :] & ~applied_keys[None, :]
+                m_def_rows = (
+                    state.defined[:EB] | prow["defined"][None, :] | applied_keys[None, :]
+                )
                 touched = take > 0
 
                 def apply(state):
                     tm = touched[:, None]
                     st = state._replace(
-                        used=state.used
-                        + take[:, None].astype(jnp.float32) * prow["requests"][None, :],
-                        pods=state.pods + take,
-                        allow=jnp.where(tm, m_allow_rows, state.allow),
-                        out=jnp.where(tm, m_out_rows, state.out),
-                        defined=jnp.where(tm, m_def_rows, state.defined),
+                        used=state.used.at[:EB].set(
+                            state.used[:EB]
+                            + take[:, None].astype(jnp.float32)
+                            * prow["requests"][None, :]
+                        ),
+                        pods=state.pods.at[:EB].add(take),
+                        allow=state.allow.at[:EB].set(
+                            jnp.where(tm, m_allow_rows, sa)
+                        ),
+                        out=state.out.at[:EB].set(
+                            jnp.where(tm, m_out_rows, state.out[:EB])
+                        ),
+                        defined=state.defined.at[:EB].set(
+                            jnp.where(tm, m_def_rows, state.defined[:EB])
+                        ),
                     )
                     if has_topo:
-                        tcounts, thost, tdoms = topo.topo_record_bulk(
-                            topo_meta, st.tcounts, st.thost, st.tdoms,
-                            prow["topo_own"], prow["topo_sel"],
-                            m_allow_rows, m_out_rows, take,
+                        def rec(args):
+                            tc, th, td = topo.topo_record_bulk(
+                                topo_meta, *args,
+                                prow["topo_own"], prow["topo_sel"],
+                                m_allow_rows, m_out_rows, take,
+                            )
+                            return tc, th, td
+
+                        tcounts, thost, tdoms = jax.lax.cond(
+                            any_topo, rec, lambda a: a,
+                            (st.tcounts, st.thost, st.tdoms),
                         )
                         st = st._replace(tcounts=tcounts, thost=thost, tdoms=tdoms)
                     return st
@@ -676,7 +722,7 @@ def make_pack_kernel(
                 log = {
                     **log,
                     "bulk_take": log["bulk_take"].at[bslot].set(
-                        jnp.where(do, take[:EB], log["bulk_take"][bslot])
+                        jnp.where(do, take, log["bulk_take"][bslot])
                     ),
                     "bulk_n": bn + jnp.where(do, 1, 0),
                 }
@@ -685,12 +731,33 @@ def make_pack_kernel(
                 # retire filled/unusable slots; on a no-op pass retire every
                 # candidate so the loop is guaranteed to progress
                 retire = cands & jnp.where(do, (k_eff == 0) | (take >= k_eff), True)
-                score = jnp.where(retire, BIG, score)
-                return state, log, ptr, remaining, score, jnp.bool_(False), dead
+                score = score.at[:EB].set(jnp.where(retire, BIG, score[:EB]))
+                carry2 = (state, log, ptr, remaining, score, jnp.bool_(False), dead)
+                # fused open: when the exist fill leaves no candidate at all
+                # and the item owns no vk-spread (whose per-round cap must be
+                # re-planned), open fresh machines in the SAME iteration —
+                # the common topology-free item packs in ONE iteration
+                # instead of bulk + open
+                exist_left = ((score < BIG) & gate & state.is_existing).any()
+                mach_cand = ((score < BIG) & gate & ~state.is_existing).any()
+                need_open = (
+                    do & ~exist_left & ~mach_cand & (remaining > 0)
+                    & ~owns_vk_spread0
+                )
+                carry2 = jax.lax.cond(
+                    need_open,
+                    lambda c: open_commit(c, force, cap, _dmark),
+                    lambda c: c,
+                    carry2,
+                )
+                return carry2
 
             # -- open branch: bulk-open s fresh slots, m replicas each ----
             def do_open(args):
                 carry, force, cap, _gate, dmark = args
+                return open_commit(carry, force, cap, dmark)
+
+            def open_commit(carry, force, cap, dmark):
                 state, log, ptr, remaining, score, _, dead = carry
                 cap_ok = jnp.all(
                     type_capacity[None, :, :] <= state.remaining[:, None, :], axis=-1

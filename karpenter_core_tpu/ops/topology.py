@@ -419,15 +419,18 @@ def topo_record_bulk(meta: TopoMeta, tcounts, thost, tdoms, own, selp,
 
     Only reachable for items topo_bulk_item_ok admits (no anti, no inverse
     ownership, no filtered groups), so value-key counting is the singleton
-    rule evaluated per slot and nf_ok is vacuously true."""
+    rule evaluated per slot and nf_ok is vacuously true. k_row /
+    m_allow_rows / m_out_rows may cover only a PREFIX of the slot axis (the
+    existing slots); hostname counts update that prefix in place."""
     import jax.numpy as jnp
 
     k_row_f = k_row.astype(jnp.float32)
     touched = k_row > 0
+    n_pre = k_row.shape[0]
     for g, gm in enumerate(meta.groups):
         if gm.is_hostname:
             rec = own[g] if gm.is_inverse else selp[g]
-            thost = thost.at[g].add(jnp.where(rec, k_row_f, 0.0))
+            thost = thost.at[g, :n_pre].add(jnp.where(rec, k_row_f, 0.0))
             continue
         if gm.is_inverse:
             continue  # inverse groups record on OWNER placements only

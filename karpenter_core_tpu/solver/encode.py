@@ -61,22 +61,53 @@ def bucket_pow2(n: int, lo: int) -> int:
     return b
 
 
-def _pod_spec_signature(p: Pod) -> Tuple:
+def _pod_spec_signature(p: Pod, _repr_memo: Optional[Dict[int, str]] = None) -> Tuple:
     """Content key for pod spec-equivalence: covers exactly what the encoder
     derives per pod — namespace+labels (topology selection/ownership),
     node_selector + affinity (Requirements.from_pod, topology groups),
     tolerations, spread constraints, and container resources (requests
     ceiling). Pods with equal signatures are interchangeable to the solver.
     Affinity/spread objects are keyed by repr (dataclass reprs are
-    content-recursive); the common no-affinity case stays cheap."""
+    content-recursive); the common no-affinity case stays cheap.
+
+    _repr_memo (id -> repr) dedups the recursive reprs when producers share
+    constraint objects across pods (deployment-expanded batches do) — at 50k
+    pods the reprs otherwise dominate encode time."""
+
+    def _ids(lst):
+        return tuple(map(id, lst))
+
+    def _aff_key(a):
+        # identity of the LEAF term objects: producers share them across a
+        # deployment's pods even when each pod gets fresh wrapper objects
+        def duo(x):
+            return None if x is None else (_ids(x.required), _ids(x.preferred))
+
+        return (duo(a.node_affinity), duo(a.pod_affinity), duo(a.pod_anti_affinity))
+
+    def _r(obj, key):
+        if _repr_memo is None:
+            return repr(obj)
+        got = _repr_memo.get(key)
+        if got is None:
+            got = _repr_memo[key] = repr(obj)
+        return got
+
     s = p.spec
     return (
         p.metadata.namespace,
         tuple(p.metadata.labels.items()),
         tuple(s.node_selector.items()),
-        repr(s.affinity) if s.affinity is not None else None,
-        repr(s.tolerations) if s.tolerations else None,
-        repr(s.topology_spread_constraints) if s.topology_spread_constraints else None,
+        _r(s.affinity, ("aff",) + _aff_key(s.affinity))
+        if s.affinity is not None
+        else None,
+        _r(s.tolerations, ("tol",) + _ids(s.tolerations)) if s.tolerations else None,
+        _r(
+            s.topology_spread_constraints,
+            ("tsc",) + _ids(s.topology_spread_constraints),
+        )
+        if s.topology_spread_constraints
+        else None,
         tuple(
             (tuple(c.resources.requests.items()), tuple(c.resources.limits.items()))
             for c in s.containers
@@ -322,8 +353,9 @@ def encode_snapshot(
     sig_of: Dict[Tuple, int] = {}
     uidx0 = np.empty(P0, dtype=np.int32)
     uniq_pods: List[Pod] = []
+    repr_memo: Dict = {}
     for i, p in enumerate(pods):
-        sig = _pod_spec_signature(p)
+        sig = _pod_spec_signature(p, repr_memo)
         u = sig_of.get(sig)
         if u is None:
             u = len(uniq_pods)

@@ -36,14 +36,36 @@ from karpenter_core_tpu.utils import resources as resources_util
 @dataclass
 class SolvedMachine:
     """A new node computed by the solver (analog of scheduling.Machine after
-    FinalizeScheduling)."""
+    FinalizeScheduling).
+
+    `requirements` may be passed as a zero-arg thunk: reconstructing the
+    merged Requirements from slot masks costs Python time per machine, and
+    most machines (bench runs, failed relax rounds) never read it — the
+    thunk defers that to first access."""
 
     provisioner_name: str
     template: MachineTemplate
     pods: List[Pod]
     instance_type_options: List[InstanceType]
     requests: ResourceList
-    requirements: Requirements
+    requirements: object
+
+    def __post_init__(self):
+        if callable(self.requirements):
+            # deleting the instance attribute routes the next access through
+            # __getattr__ (no per-access interception for other fields); the
+            # thunk is dropped after materialization so machines held across
+            # reconcile loops don't pin the snapshot/state arrays
+            object.__setattr__(self, "_req_thunk", self.requirements)
+            object.__delattr__(self, "requirements")
+
+    def __getattr__(self, name):
+        if name == "requirements":
+            thunk = self.__dict__.pop("_req_thunk", None)
+            if thunk is not None:
+                object.__setattr__(self, "requirements", thunk())
+                return self.__dict__["requirements"]
+        raise AttributeError(name)
 
 
 @dataclass
@@ -109,7 +131,11 @@ def solve_with_relaxation(solve_once, pods, provisioners, instance_types,
     if not provisioners or not any(instance_types.values()):
         return SolveResult(failed_pods=list(pods))
     pods = list(pods)
-    index_of = {id(p): i for i, p in enumerate(pods)}
+    # an object may appear at several indices (caller-deduped replicas):
+    # map id -> ALL its indices so each list entry relaxes independently
+    indices_of: Dict[int, List[int]] = {}
+    for i, p in enumerate(pods):
+        indices_of.setdefault(id(p), []).append(i)
     is_copy = [False] * len(pods)
     preferences = Preferences(
         any(t.effect == "PreferNoSchedule" for p in provisioners for t in p.spec.taints)
@@ -118,16 +144,22 @@ def solve_with_relaxation(solve_once, pods, provisioners, instance_types,
     rounds = 1
     while result.failed_pods and rounds < max_relax_rounds:
         relaxed_any = False
+        taken: Dict[int, int] = {}  # id -> how many of its indices this round
         for pod in result.failed_pods:
-            i = index_of.get(id(pod))
-            if i is None:
+            key = id(pod)
+            idxs = indices_of.get(key)
+            if not idxs:
                 continue  # defensive: not a pod of this batch
+            j = taken.get(key, 0)
+            if j >= len(idxs):
+                continue
+            taken[key] = j + 1
+            i = idxs[j]
             if not is_copy[i]:
                 pods[i] = copy.deepcopy(pod)
-                index_of[id(pods[i])] = i
+                indices_of[id(pods[i])] = [i]
                 is_copy[i] = True
-            # always relax the COPY at that index — a stale id lookup (the
-            # same caller object listed twice) must never reach the original
+            # always relax the COPY at that index — never a caller original
             relaxed_any |= preferences.relax(pods[i])
         if not relaxed_any:
             break
@@ -517,19 +549,40 @@ class TPUSolver:
         # unused headroom — at 50k pods this cuts the fetch ~10x)
         ptr_i, nopen, bulk_n = jax.device_get((ptr, state.nopen, log["bulk_n"]))
         ptr_i, nopen, bulk_n = int(ptr_i), int(nopen), int(bulk_n)
+        # slice lengths round UP to buckets: each distinct slice shape
+        # compiles its own tiny device program, so exact lengths would pay
+        # seconds of mini-compiles on every new batch outcome
+        from karpenter_core_tpu.solver.encode import bucket_pow2
+
+        ptr_b = min(bucket_pow2(max(ptr_i, 1), 1024), log["item"].shape[0])
+        nopen_b = min(bucket_pow2(max(nopen, 1), 1024), state.tmpl.shape[0])
+        bulk_b = min(bucket_pow2(max(bulk_n, 1), 1024), log["bulk_take"].shape[0])
+
+        # bool planes bit-pack on device (8x fewer bytes over the ~10MB/s
+        # tunnel); unpacked to the original width host-side
+        import jax.numpy as jnp
+
+        bool_fields = ("tmask", "allow", "out", "defined")
+        widths = {f: getattr(state, f).shape[1] for f in bool_fields}
         sliced = (
-            {k: log[k][:ptr_i] for k in ("item", "slot", "ns", "k", "k_last")},
-            log["bulk_take"][:bulk_n],
+            {k: log[k][:ptr_b] for k in ("item", "slot", "ns", "k", "k_last")},
+            log["bulk_take"][:bulk_b],
             {
-                f: getattr(state, f)[:nopen]
-                for f in ("tmpl", "tmask", "used", "allow", "out", "defined", "pods")
+                f: getattr(state, f)[:nopen_b]
+                for f in ("tmpl", "used", "pods")
+            },
+            {
+                f: jnp.packbits(getattr(state, f)[:nopen_b], axis=-1)
+                for f in bool_fields
             },
         )
         # ONE batched device_get — per-transfer link latency dominates the
         # fetch when every leaf round-trips separately
-        log_h, bulk_take, state_d = jax.device_get(sliced)
+        log_h, bulk_take, state_d, packed = jax.device_get(sliced)
         log_h["bulk_take"] = bulk_take
         log_h["bulk_n"] = bulk_n
+        for f in bool_fields:
+            state_d[f] = np.unpackbits(packed[f], axis=-1)[:, : widths[f]].astype(bool)
         from types import SimpleNamespace
 
         state_h = SimpleNamespace(**state_d)
@@ -572,15 +625,21 @@ def expand_log(snap: EncodedSnapshot, log, ptr: int,
         ns, k, k_last = int(nss[e]), int(ks[e]), int(k_lasts[e])
         if ns == -1:
             # bulk existing-fill marker: k is the bulk_take row; fill slots
-            # in index order (the commit's own order)
+            # in index order (the commit's own order), vectorized — at 50k
+            # pods the per-member python loop would dominate decode
             row = bulk_take[k]
-            for slot_e in np.nonzero(row)[0]:
-                take = int(row[slot_e])
-                lo = cursor[item]
-                hi = min(lo + take, cap[item], len(mem))
-                for m in mem[lo:hi]:
-                    assigned[m] = slot_e
-                cursor[item] = hi
+            nz = np.nonzero(row)[0]
+            if len(nz) == 0:
+                continue
+            takes = row[nz].astype(np.int64)
+            lo = cursor[item]
+            avail = max(min(cap[item], len(mem)) - lo, 0)
+            csum = np.cumsum(takes)
+            tot = int(min(csum[-1], avail))
+            act = np.clip(tot - (csum - takes), 0, takes)
+            mem_arr = np.asarray(mem[lo : lo + tot], dtype=np.int64)
+            assigned[mem_arr] = np.repeat(nz, act)
+            cursor[item] = lo + tot
             continue
         for s in range(ns):
             take = k_last if s == ns - 1 else k
@@ -622,7 +681,6 @@ def decode_solve(snap: EncodedSnapshot, placements, state) -> SolveResult:
         template = snap.templates[tmpl_id]
         tmask = np.asarray(state.tmask[slot])
         options = [snap.instance_types[t] for t in np.nonzero(tmask)[0]]
-        requirements = slot_requirements(snap, state, slot)
         requests = dict(zip(snap.resource_names, np.asarray(state.used[slot]).tolist()))
         requests = {k: v for k, v in requests.items() if v}
         machines.append(
@@ -632,7 +690,7 @@ def decode_solve(snap: EncodedSnapshot, placements, state) -> SolveResult:
                 pods=pods,
                 instance_type_options=options,
                 requests=requests,
-                requirements=requirements,
+                requirements=partial(slot_requirements, snap, state, slot),
             )
         )
     return SolveResult(
