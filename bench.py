@@ -41,7 +41,6 @@ N_PODS = int(os.environ.get("BENCH_PODS", "50000"))
 N_TYPES = int(os.environ.get("BENCH_TYPES", "500"))
 N_RUNS = int(os.environ.get("BENCH_RUNS", "20"))
 N_DISTINCT = int(os.environ.get("BENCH_DISTINCT", "1000"))
-MIX = os.environ.get("BENCH_MIX", "reference")  # reference | plain
 CONFIG = os.environ.get("BENCH_CONFIG", "solve")  # solve | consolidation
 N_EXISTING = int(os.environ.get("BENCH_EXISTING", "1000"))
 # consolidation sub-bench scale (ref multinodeconsolidation.go:87-113)

@@ -182,6 +182,11 @@ class Scheduler:
                 )
                 is None
             ]
+            # PVC -> driver resolution goes through the kube client
+            # (volumeusage.go:133-200); a state node built outside the
+            # cluster cache may not carry one yet
+            if state_node.volume_usage.kube_client is None:
+                state_node.volume_usage.kube_client = self.kube_client
             self.existing_nodes.append(
                 ExistingNode(
                     state_node,

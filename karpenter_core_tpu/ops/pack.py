@@ -59,6 +59,8 @@ class PackState(NamedTuple):
     tcounts: jnp.ndarray  # [G, V] topology domain counts (value-key groups)
     thost: jnp.ndarray  # [G, N] hostname-group counts per slot
     tdoms: jnp.ndarray  # [G, V] registered domains per group
+    ports: jnp.ndarray  # [N, Q] reserved host-port entries (Q=0 when unused)
+    vols: jnp.ndarray  # [E_pad, W] mounted volume claims (existing slots only)
 
 
 def _segment_max_alloc(tmask: jnp.ndarray, type_alloc: jnp.ndarray) -> jnp.ndarray:
@@ -246,6 +248,9 @@ def make_pack_kernel(
         kmax_mach = jnp.max(jnp.where(compat_tmask, kcap_t, 0), initial=0)
         kmax = jnp.where(is_existing, kmax_exist, kmax_mach)
         kmax = jnp.minimum(kmax, k_topo)
+        if "ports" in prow and prow["ports"].shape[0]:
+            # a host-port pod conflicts with its own replicas on one node
+            kmax = jnp.minimum(kmax, jnp.where(prow["ports"].any(), 1, BIGK))
         ok = t_viable & (kmax >= 1)
         return ok, compat_tmask, kcap_t, kmax, narrow, applied_keys
 
@@ -279,12 +284,18 @@ def make_pack_kernel(
         topo_terms: dict = None,
         log_len: int = None,
         n_exist: int = 0,
+        vol_limits: jnp.ndarray = None,  # [E_pad, D]
+        vol_driver: jnp.ndarray = None,  # [W, D] claim -> driver onehot
     ):
         N = state.used.shape[0]
         J = tmpl_daemon.shape[0]
         I = item_arrays["requests"].shape[0]
         V = state.allow.shape[1]
         K = state.out.shape[1]
+        # host-port / volume axes: zero width compiles all checks away
+        Q = state.ports.shape[1]
+        W = state.vols.shape[1]
+        EV = state.vols.shape[0]  # existing prefix carrying volume state
         # commit-log budget: every logged entry commits >= 1 replica, so
         # total pod count (+ slack) is the true bound — callers that know it
         # pass log_len (solve_geometry computes it). The fallback is a
@@ -345,7 +356,15 @@ def make_pack_kernel(
                 "escape": item_arrays["escape"][i],
                 "custom_deny": item_arrays["custom_deny"][i],
                 "requests": item_arrays["requests"][i],
+                "ports": item_arrays["ports"][i],
+                "port_conflict": item_arrays["port_conflict"][i],
+                "vols": item_arrays["vols"][i],
             }
+            # a pod with host ports can never run two replicas on one node
+            # (its own entries conflict, hostportusage.go:42-54)
+            port_k_cap = (
+                jnp.where(prow["ports"].any(), 1, BIGK) if Q else jnp.int32(BIGK)
+            )
             if has_topo:
                 prow["topo_own"] = item_arrays["topo_own"][i]
                 prow["topo_sel"] = item_arrays["topo_sel"][i]
@@ -362,6 +381,19 @@ def make_pack_kernel(
                     topo_meta, state.tcounts, state.thost, state.tdoms,
                     prow["topo_own"], prow["topo_sel"], prow["allow"], state.allow,
                 )
+            if Q:
+                # host-port conflicts (machine.go:69, existingnode.go:77)
+                screen &= ~jnp.any(
+                    state.ports & prow["port_conflict"][None, :], axis=-1
+                )
+            if W:
+                # CSI volume limits on existing slots (existingnode.go:62-115):
+                # per-driver mounted count + NEW claims <= CSINode limit
+                cnt_d = state.vols.astype(jnp.float32) @ vol_driver  # [EV, D]
+                new = prow["vols"][None, :] & ~state.vols
+                new_d = new.astype(jnp.float32) @ vol_driver
+                vol_ok = jnp.all(cnt_d + new_d <= vol_limits, axis=-1)  # [EV]
+                screen = screen.at[:EV].set(screen[:EV] & vol_ok)
 
             # rank: existing first by index, then machines by (pods, index)
             idx = jnp.arange(N, dtype=jnp.float32)
@@ -586,6 +618,14 @@ def make_pack_kernel(
                         tmask=state.tmask.at[n].set(new_tmask),
                         cap=state.cap.at[n].set(new_cap),
                     )
+                    if Q:
+                        st = st._replace(
+                            ports=st.ports.at[n].set(st.ports[n] | prow["ports"])
+                        )
+                    if W:
+                        ne = jnp.minimum(n, EV - 1)
+                        nv = jnp.where(n < EV, st.vols[ne] | prow["vols"], st.vols[ne])
+                        st = st._replace(vols=st.vols.at[ne].set(nv))
                     return record_topo(
                         st, prow, m_allow, m_out, m_defined, well_known, topo_terms,
                         onehot, jnp.where(onehot, k, 0),
@@ -668,6 +708,7 @@ def make_pack_kernel(
                 k_eff = jnp.where(
                     cands & viable, jnp.minimum(k_e, k_topo_e), 0
                 )
+                k_eff = jnp.minimum(k_eff, port_k_cap)
                 budget = jnp.minimum(remaining, cap)
                 csum = jnp.cumsum(k_eff)
                 take = jnp.clip(budget - (csum - k_eff), 0, k_eff)
@@ -701,6 +742,24 @@ def make_pack_kernel(
                             jnp.where(tm, m_def_rows, state.defined[:EB])
                         ),
                     )
+                    if Q:
+                        st = st._replace(
+                            ports=st.ports.at[:EB].set(
+                                jnp.where(
+                                    tm, st.ports[:EB] | prow["ports"][None, :],
+                                    st.ports[:EB],
+                                )
+                            )
+                        )
+                    if W:
+                        st = st._replace(
+                            vols=st.vols.at[:EB].set(
+                                jnp.where(
+                                    tm, st.vols[:EB] | prow["vols"][None, :],
+                                    st.vols[:EB],
+                                )
+                            )
+                        )
                     if has_topo:
                         def rec(args):
                             tc, th, td = topo.topo_record_bulk(
@@ -805,10 +864,11 @@ def make_pack_kernel(
                 kcap_o = jnp.stack(kcaps)[jc]  # [T]
                 k_topo_o = jnp.stack(ktopos)[jc]
 
-                # per-slot replica cap: capacity ∧ skew headroom
+                # per-slot replica cap: capacity ∧ skew headroom ∧ host ports
                 m_eff = jnp.minimum(
                     jnp.max(jnp.where(compat_o, kcap_o, 0), initial=0), k_topo_o
                 )
+                m_eff = jnp.minimum(m_eff, port_k_cap)
                 m_eff = jnp.maximum(m_eff, 0)
 
                 # provisioner-limit slot budget via pessimistic max-capacity
@@ -888,6 +948,10 @@ def make_pack_kernel(
                         * s.astype(jnp.float32)
                         * max_cap[None, :],
                     )
+                    if Q:
+                        st = st._replace(
+                            ports=jnp.where(rm, prow["ports"][None, :], st.ports)
+                        )
                     return record_topo(
                         st, prow, m_allow_o, m_out_o, m_def_o, well_known, topo_terms,
                         rows, k_row,

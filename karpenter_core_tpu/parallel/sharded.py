@@ -132,7 +132,7 @@ def make_sharded_solve(snap, provisioners, mesh, max_nodes_per_shard: int = 256,
 
     geom = solve_geometry(snap, max_nodes_per_shard)
     (_, J, T, E, R, K, V, N, segments_t, zone_seg, ct_seg, _topo_sig,
-     log_len) = geom
+     log_len, _Q, _W, _D) = geom
     segments = list(segments_t)
     ndp = mesh.shape["dp"]
     ntp = mesh.shape["tp"]
@@ -154,7 +154,8 @@ def make_sharded_solve(snap, provisioners, mesh, max_nodes_per_shard: int = 256,
                  types_l, type_offering_ok_l, types_full, type_alloc,
                  type_capacity, type_offering_ok, pod_tol_all, exist, exist_used,
                  exist_cap, exist_owner, well_known, remaining_split,
-                 topo_counts0, topo_hcounts0, topo_doms0, topo_terms):
+                 topo_counts0, topo_hcounts0, topo_doms0, topo_terms,
+                 exist_ports, exist_vols, exist_vol_limits, vol_driver):
             # ---- type-sharded feasibility + all_gather over 'tp' -------------
             f_local = feasibility_static(
                 {k: pod_arrays[k] for k in ("allow", "out", "defined", "escape")},
@@ -198,6 +199,10 @@ def make_sharded_solve(snap, provisioners, mesh, max_nodes_per_shard: int = 256,
                 tcounts=topo_counts0,
                 thost=topo_hcounts0,
                 tdoms=topo_doms0,
+                ports=jnp.zeros((N, exist_ports.shape[1]), bool).at[:E].set(
+                    exist_ports
+                ),
+                vols=exist_vols,
             )
             pod_arrays = dict(pod_arrays)
             pod_arrays["tol"] = pod_tol_all
@@ -221,6 +226,8 @@ def make_sharded_solve(snap, provisioners, mesh, max_nodes_per_shard: int = 256,
                 topo_terms=topo_terms,
                 log_len=log_len,
                 n_exist=E,
+                vol_limits=exist_vol_limits,
+                vol_driver=vol_driver,
             )
             # global stats via psum over dp: pods scheduled (an ICI collective)
             scheduled = jax.lax.psum(state.pods.sum(), "dp")
@@ -238,6 +245,9 @@ def make_sharded_solve(snap, provisioners, mesh, max_nodes_per_shard: int = 256,
             "custom_deny": P(None, None),
             "requests": P(None, None),
             "tol_tmpl": P(None, None),
+            "ports": P(None, None),
+            "port_conflict": P(None, None),
+            "vols": P(None, None),
             "valid": P(None),
         }
         if has_topo:
@@ -268,6 +278,10 @@ def make_sharded_solve(snap, provisioners, mesh, max_nodes_per_shard: int = 256,
             P(None, None),  # topo_hcounts0 [G, N]
             P(None, None),  # topo_doms0 [G, V]
             {k: P(None, None) for k in ("allow", "out", "defined", "escape")},  # topo_terms
+            P(None, None),  # exist_ports [E, Q]
+            P(None, None),  # exist_vols [E, W]
+            P(None, None),  # exist_vol_limits [E, D]
+            P(None, None),  # vol_driver [W, D]
         )
         out_specs = (
             {
@@ -292,6 +306,8 @@ def make_sharded_solve(snap, provisioners, mesh, max_nodes_per_shard: int = 256,
                 tcounts=P("dp", None),
                 thost=P("dp", None),
                 tdoms=P("dp", None),
+                ports=P("dp", None),
+                vols=P("dp", None),
             ),
             P(),  # scheduled count (replicated)
         )
@@ -306,7 +322,8 @@ def make_sharded_solve(snap, provisioners, mesh, max_nodes_per_shard: int = 256,
     (pod_arrays, tmpl, tmpl_daemon, tmpl_type_mask, types, type_alloc,
      type_capacity, type_offering_ok, pod_tol_all, exist, exist_used,
      exist_cap, well_known, remaining0, topo_counts0, topo_hcounts0,
-     topo_doms0, topo_terms) = base_args
+     topo_doms0, topo_terms, exist_ports, exist_vols, exist_vol_limits,
+     vol_driver) = base_args
     pod_arrays = dict(pod_arrays)
     pod_arrays.pop("count")
     # device count axis padded like device_args pads the item rows; the
@@ -356,6 +373,10 @@ def make_sharded_solve(snap, provisioners, mesh, max_nodes_per_shard: int = 256,
         th0,
         topo_doms0,
         topo_terms,
+        exist_ports,
+        exist_vols,
+        exist_vol_limits,
+        vol_driver,
     )
     return fn, args, (count_split, exist_owner)
 

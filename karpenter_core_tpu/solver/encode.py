@@ -98,6 +98,21 @@ def _pod_spec_signature(p: Pod, _repr_memo: Optional[Dict[int, str]] = None) -> 
         p.metadata.namespace,
         tuple(p.metadata.labels.items()),
         tuple(s.node_selector.items()),
+        # host ports + volumes are per-slot constraints the kernel enforces:
+        # pods differing only in them must NOT share an equivalence class
+        tuple(
+            (port.host_ip, port.host_port, port.protocol)
+            for c in s.containers
+            for port in c.ports
+            if port.host_port
+        ),
+        tuple(
+            v.persistent_volume_claim.claim_name
+            for v in s.volumes
+            if v.persistent_volume_claim is not None
+        )
+        if s.volumes
+        else None,
         _r(s.affinity, ("aff",) + _aff_key(s.affinity))
         if s.affinity is not None
         else None,
@@ -269,6 +284,19 @@ class EncodedSnapshot:
     exist_used: np.ndarray = None  # [E, R] remaining daemon overhead
     exist_cap: np.ndarray = None  # [E, R] available()
     pod_tol_exist: np.ndarray = None  # [P, E]
+
+    # host ports (Q distinct (ip, port, proto) entries; 0 when none in batch)
+    # and CSI volumes (W distinct claims, D drivers; existing-slot only —
+    # the reference enforces volume limits only in ExistingNode.Add,
+    # existingnode.go:62-115, while ports apply to machines too,
+    # machine.go:69)
+    pod_ports: np.ndarray = None  # [P, Q] entries a pod OCCUPIES
+    pod_port_conflict: np.ndarray = None  # [P, Q] entries it CONFLICTS with
+    exist_ports: np.ndarray = None  # [E_pad, Q]
+    pod_vols: np.ndarray = None  # [P, W]
+    exist_vols: np.ndarray = None  # [E_pad, W] already-mounted claims
+    exist_vol_limits: np.ndarray = None  # [E_pad, D] (inf = unlimited)
+    vol_driver_onehot: np.ndarray = None  # [W, D]
 
     # topology (None when the batch has no topology constraints)
     topo_meta: object = None  # ops.topology.TopoMeta
@@ -574,6 +602,95 @@ def encode_snapshot(
             taint_sig_cols[sig] = col
         pod_tol_exist[:, e] = col
 
+    # -- host ports + CSI volumes -----------------------------------------
+    # lowered only when the batch/cluster actually uses them (Q = W = 0 is
+    # the common case and compiles to nothing)
+    from karpenter_core_tpu.scheduling.hostportusage import host_ports
+    from karpenter_core_tpu.scheduling.volumeusage import VolumeUsage
+
+    pod_ports_u_list = [host_ports(p) for p in uniq_pods]
+    port_index: Dict[Tuple, int] = {}
+    port_entries: List = []
+
+    def _port_id(entry):
+        key = (entry.ip, entry.port, entry.protocol)
+        q = port_index.get(key)
+        if q is None:
+            q = port_index[key] = len(port_entries)
+            port_entries.append(entry)
+        return q
+
+    for entries in pod_ports_u_list:
+        for entry in entries:
+            _port_id(entry)
+    exist_port_rows: List[List[int]] = []
+    for node in state_nodes:
+        row = []
+        for entries in node.hostport_usage.reserved.values():
+            for entry in entries:
+                row.append(_port_id(entry))
+        exist_port_rows.append(row)
+    # pad to a bucket like every other batch-size axis: new distinct entries
+    # must not recompile the solve program (pad columns are all-False, so
+    # they can never conflict or count)
+    Q = bucket_pow2(len(port_entries), 8)
+    pod_ports_u = np.zeros((U, Q), dtype=bool)
+    for u, entries in enumerate(pod_ports_u_list):
+        for entry in entries:
+            pod_ports_u[u, port_index[(entry.ip, entry.port, entry.protocol)]] = True
+    conflict = np.zeros((Q, Q), dtype=bool)
+    for a in range(len(port_entries)):
+        for b in range(len(port_entries)):
+            conflict[a, b] = port_entries[a].matches(port_entries[b])
+    pod_port_conflict_u = pod_ports_u @ conflict  # [U, Q] bool via matmul
+    exist_ports = np.zeros((E_pad, Q), dtype=bool)
+    for e, row in enumerate(exist_port_rows):
+        exist_ports[e, row] = True
+
+    vu = VolumeUsage(kube_client)
+    pod_vols_u_list = [vu._resolve(p) for p in uniq_pods]
+    vol_index: Dict[Tuple[str, str], int] = {}
+    driver_index: Dict[str, int] = {}
+
+    def _vol_id(driver, pvc_id):
+        w = vol_index.get((driver, pvc_id))
+        if w is None:
+            w = vol_index[(driver, pvc_id)] = len(vol_index)
+            if driver not in driver_index:
+                driver_index[driver] = len(driver_index)
+        return w
+
+    for vols in pod_vols_u_list:
+        for driver, ids in vols.items():
+            for pvc_id in ids:
+                _vol_id(driver, pvc_id)
+    for node in state_nodes:
+        for driver, ids in node.volume_usage.volumes.items():
+            for pvc_id in ids:
+                _vol_id(driver, pvc_id)
+        for driver in node.volume_limits:
+            if driver not in driver_index:
+                driver_index[driver] = len(driver_index)
+    W = bucket_pow2(len(vol_index), 8)
+    D = bucket_pow2(len(driver_index), 2)
+    pod_vols_u = np.zeros((U, W), dtype=bool)
+    for u, vols in enumerate(pod_vols_u_list):
+        for driver, ids in vols.items():
+            for pvc_id in ids:
+                pod_vols_u[u, vol_index[(driver, pvc_id)]] = True
+    exist_vols = np.zeros((E_pad, W), dtype=bool)
+    exist_vol_limits = np.full((E_pad, D), np.inf, dtype=np.float32)
+    for e, node in enumerate(state_nodes):
+        for driver, ids in node.volume_usage.volumes.items():
+            for pvc_id in ids:
+                exist_vols[e, vol_index[(driver, pvc_id)]] = True
+        for driver, limit in node.volume_limits.items():
+            if limit is not None:
+                exist_vol_limits[e, driver_index[driver]] = float(limit)
+    vol_driver_onehot = np.zeros((W, D), dtype=np.float32)
+    for (driver, _pvc), w in vol_index.items():
+        vol_driver_onehot[w, driver_index[driver]] = 1.0
+
     # -- topology arrays ---------------------------------------------------
     from karpenter_core_tpu.ops.topology import encode_topology
 
@@ -628,6 +745,13 @@ def encode_snapshot(
         exist_used=exist_used,
         exist_cap=exist_cap,
         pod_tol_exist=pod_tol_exist,
+        pod_ports=pod_ports_u[uidx] if P else np.zeros((0, Q), bool),
+        pod_port_conflict=pod_port_conflict_u[uidx] if P else np.zeros((0, Q), bool),
+        exist_ports=exist_ports,
+        pod_vols=pod_vols_u[uidx] if P else np.zeros((0, W), bool),
+        exist_vols=exist_vols,
+        exist_vol_limits=exist_vol_limits,
+        vol_driver_onehot=vol_driver_onehot,
         topo_meta=topo_meta,
         topo_arrays=topo_arrays,
         n_slots=n_slots,

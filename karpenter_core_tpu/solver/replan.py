@@ -157,7 +157,7 @@ def batched_ladder_screen(
 
     geom = solve_geometry(snap, max_nodes)
     (_P, _J, _T, _E, _R, _K, _V, N, segments_t, zone_seg, ct_seg, _sig,
-     log_len) = geom
+     log_len, _Q, _W, _D) = geom
     cache = getattr(provisioning.solver, "_replan_compiled", None)
     if cache is None:
         cache = {}
@@ -173,7 +173,11 @@ def batched_ladder_screen(
             segments_t, zone_seg, ct_seg, snap.topo_meta, N, log_len=log_len,
             rung_mode=True, backend=backend,
         )
-        fn = jax.jit(jax.vmap(rung_run, in_axes=(0, 0) + (None,) * 18))
+        from karpenter_core_tpu.solver.tpu_solver import RUN_ARG_NAMES
+
+        fn = jax.jit(
+            jax.vmap(rung_run, in_axes=(0, 0) + (None,) * len(RUN_ARG_NAMES))
+        )
         cache[key] = fn
 
     from karpenter_core_tpu.solver.tpu_solver import device_args

@@ -50,13 +50,10 @@ def tensor_from_pb(t: pb.Tensor) -> np.ndarray:
     return np.frombuffer(t.data, dtype=np.dtype(t.dtype)).reshape(tuple(t.shape))
 
 
-# device_args() tuple element names, in positional order — the wire schema.
-_ARG_NAMES = (
-    "pod_arrays", "tmpl", "tmpl_daemon", "tmpl_type_mask", "types",
-    "type_alloc", "type_capacity", "type_offering_ok", "pod_tol_all",
-    "exist", "exist_used", "exist_cap", "well_known", "remaining0",
-    "topo_counts0", "topo_hcounts0", "topo_doms0", "topo_terms",
-)
+# device_args() tuple element names, in positional order — the wire schema
+# (kept equal to tpu_solver.RUN_ARG_NAMES; asserted below so a signature
+# change breaks loudly instead of desynchronizing the wire).
+from karpenter_core_tpu.solver.tpu_solver import RUN_ARG_NAMES as _ARG_NAMES
 
 
 def _flatten_args(args) -> List[Tuple[str, np.ndarray]]:
@@ -110,7 +107,8 @@ def geometry_json(snap) -> str:
             "zone_seg": list(snap.zone_seg),
             "ct_seg": list(snap.ct_seg),
             "n_slots": snap.n_slots,
-            "log_len": solve_geometry(snap, 0)[-1],
+            # index 12 = log_len (see solve_geometry's return tuple)
+            "log_len": solve_geometry(snap, 0)[12],
             "topo_groups": topo,
         }
     )

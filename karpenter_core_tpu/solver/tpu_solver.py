@@ -95,6 +95,7 @@ RUN_ARG_NAMES = (
     "type_alloc", "type_capacity", "type_offering_ok", "pod_tol_all",
     "exist", "exist_used", "exist_cap", "well_known", "remaining0",
     "topo_counts0", "topo_hcounts0", "topo_doms0", "topo_terms",
+    "exist_ports", "exist_vols", "exist_vol_limits", "vol_driver",
 )
 # arrays that flow through the scan carry unchanged in shape/dtype
 # (remaining0 -> state.remaining, topo_* -> state.tcounts/thost/tdoms):
@@ -144,22 +145,21 @@ def solve_with_relaxation(solve_once, pods, provisioners, instance_types,
     rounds = 1
     while result.failed_pods and rounds < max_relax_rounds:
         relaxed_any = False
-        taken: Dict[int, int] = {}  # id -> how many of its indices this round
         for pod in result.failed_pods:
             key = id(pod)
             idxs = indices_of.get(key)
             if not idxs:
                 continue  # defensive: not a pod of this batch
-            j = taken.get(key, 0)
-            if j >= len(idxs):
-                continue
-            taken[key] = j + 1
-            i = idxs[j]
-            if not is_copy[i]:
+            if len(idxs) == 1 and is_copy[idxs[0]]:
+                i = idxs[0]  # a copy relaxes again every round it fails
+            else:
+                # CONSUME one index still holding the original: it becomes a
+                # copy with its own identity, so aliased entries relax
+                # independently and originals are never mutated
+                i = idxs.pop()
                 pods[i] = copy.deepcopy(pod)
                 indices_of[id(pods[i])] = [i]
                 is_copy[i] = True
-            # always relax the COPY at that index — never a caller original
             relaxed_any |= preferences.relax(pods[i])
         if not relaxed_any:
             break
@@ -199,9 +199,13 @@ def solve_geometry(snap: EncodedSnapshot, max_nodes: int):
     log_len = 128
     while log_len < len(snap.pods) + 64:
         log_len *= 2
+    # host-port / volume axes (0 in the common no-port/no-volume batch)
+    Q = snap.pod_ports.shape[1] if snap.pod_ports is not None else 0
+    W = snap.pod_vols.shape[1] if snap.pod_vols is not None else 0
+    D = snap.exist_vol_limits.shape[1] if snap.exist_vol_limits is not None else 0
     return (
         P, J, T, E, R, K, V, N, tuple(segments), snap.zone_seg, snap.ct_seg,
-        topo_sig, log_len,
+        topo_sig, log_len, Q, W, D,
     )
 
 
@@ -230,7 +234,8 @@ def make_device_run(segments, zone_seg, ct_seg, topo_meta, n_slots,
                  tmpl_type_mask, types, type_alloc, type_capacity,
                  type_offering_ok, pod_tol_all, exist, exist_used, exist_cap,
                  well_known, remaining0, topo_counts0, topo_hcounts0,
-                 topo_doms0, topo_terms):
+                 topo_doms0, topo_terms, exist_ports, exist_vols,
+                 exist_vol_limits, vol_driver):
         E = exist_used.shape[0]
         N = n_slots
         R = type_alloc.shape[1]
@@ -278,6 +283,8 @@ def make_device_run(segments, zone_seg, ct_seg, topo_meta, n_slots,
             tcounts=topo_counts0,
             thost=topo_hcounts0,
             tdoms=topo_doms0,
+            ports=jnp.zeros((N, exist_ports.shape[1]), bool).at[:E].set(exist_ports),
+            vols=exist_vols,
         )
         pod_arrays = dict(pod_arrays)
         pod_arrays["tol"] = pod_tol_all
@@ -300,6 +307,8 @@ def make_device_run(segments, zone_seg, ct_seg, topo_meta, n_slots,
             # state.pods), so the bulk fast path is disabled to avoid
             # allocating Rn vmapped bulk logs
             n_exist=0 if rung_mode else E,
+            vol_limits=exist_vol_limits,
+            vol_driver=vol_driver,
         )
         return log, ptr, state
 
@@ -309,12 +318,14 @@ def make_device_run(segments, zone_seg, ct_seg, topo_meta, n_slots,
     def run(pod_arrays, tmpl, tmpl_daemon, tmpl_type_mask, types, type_alloc,
             type_capacity, type_offering_ok, pod_tol_all, exist, exist_used,
             exist_cap, well_known, remaining0, topo_counts0, topo_hcounts0,
-            topo_doms0, topo_terms):  # order must match RUN_ARG_NAMES
+            topo_doms0, topo_terms, exist_ports, exist_vols, exist_vol_limits,
+            vol_driver):  # order must match RUN_ARG_NAMES
         return run_impl(
             None, None, pod_arrays, tmpl, tmpl_daemon, tmpl_type_mask, types,
             type_alloc, type_capacity, type_offering_ok, pod_tol_all, exist,
             exist_used, exist_cap, well_known, remaining0, topo_counts0,
-            topo_hcounts0, topo_doms0, topo_terms,
+            topo_hcounts0, topo_doms0, topo_terms, exist_ports, exist_vols,
+            exist_vol_limits, vol_driver,
         )
 
     import inspect
@@ -330,7 +341,7 @@ def build_device_solve(snap: EncodedSnapshot, max_nodes: int = 1024,
     'mxu' on CPU to exercise the exact TPU code path."""
     geom = solve_geometry(snap, max_nodes)
     (_P, _J, _T, _E, _R, _K, _V, N, segments_t, zone_seg, ct_seg, _topo_sig,
-     log_len) = geom
+     log_len, _Q, _W, _D) = geom
     run = make_device_run(
         segments_t, zone_seg, ct_seg, snap.topo_meta, N, log_len=log_len,
         backend=backend,
@@ -370,6 +381,10 @@ def device_args(snap: EncodedSnapshot, provisioners: Optional[List[Provisioner]]
     if snap.topo_meta is not None:
         pod_arrays["topo_own"] = snap.topo_arrays.owner.T[rep].copy()  # [I, G]
         pod_arrays["topo_sel"] = snap.topo_arrays.sel.T[rep].copy()
+    # host-port / volume rows ride the item axis (zero-width when unused)
+    pod_arrays["ports"] = snap.pod_ports[rep]
+    pod_arrays["port_conflict"] = snap.pod_port_conflict[rep]
+    pod_arrays["vols"] = snap.pod_vols[rep]
     pod_tol_all = np.concatenate([snap.pod_tol, snap.pod_tol_exist], axis=1)[rep]
 
     # pad the item axis to the bucketed geometry (valid=False, count=0 rows
@@ -451,6 +466,10 @@ def device_args(snap: EncodedSnapshot, provisioners: Optional[List[Provisioner]]
         topo_hcounts0,
         topo_doms0,
         topo_terms,
+        snap.exist_ports,
+        snap.exist_vols,
+        snap.exist_vol_limits,
+        snap.vol_driver_onehot,
     )
 
 

@@ -118,6 +118,9 @@ class Cluster:
             existing = self.nodes_by_provider_id.get(provider_id)
             if existing is None:
                 existing = StateNode(node=node, clock=self.clock)
+                # PVC -> driver resolution needs the store (volumeusage.go
+                # resolves through the kube client)
+                existing.volume_usage.kube_client = self.kube_client
                 self.nodes_by_provider_id[provider_id] = existing
             else:
                 existing.node = node

@@ -1,0 +1,206 @@
+"""hostPort + CSI volume-limit semantics in the DEVICE solve path.
+
+Round-2 verdict missing #1/#2: the TPU kernel co-packed same-hostPort pods
+and ignored CSI attach limits on existing nodes where the reference refuses
+(machine.go:69, hostportusage.go:76, existingnode.go:62-115,
+volumeusage.go:33,102). These tests require the TPU and Greedy solvers to
+AGREE on those refusals.
+"""
+import numpy as np
+import pytest
+
+from karpenter_core_tpu.api.labels import PROVISIONER_NAME_LABEL_KEY
+from karpenter_core_tpu.cloudprovider import fake
+from karpenter_core_tpu.kube.client import InMemoryKubeClient
+from karpenter_core_tpu.kube.objects import (
+    CSINode,
+    CSINodeDriver,
+    ObjectMeta,
+    PersistentVolumeClaim,
+    PersistentVolumeClaimSpec,
+    PersistentVolumeClaimVolumeSource,
+    StorageClass,
+    Volume,
+)
+from karpenter_core_tpu.solver.tpu_solver import GreedySolver, TPUSolver
+from karpenter_core_tpu.state.node import StateNode
+from karpenter_core_tpu.testing import make_node, make_pod, make_provisioner
+
+
+def run_both(pods, provisioners, its, state_nodes=None, kube_client=None,
+             clone=True):
+    import copy
+
+    def sn():
+        return [n.deep_copy() for n in state_nodes] if state_nodes else None
+
+    host = GreedySolver().solve(
+        copy.deepcopy(pods) if clone else pods, provisioners, its,
+        state_nodes=sn(), kube_client=kube_client,
+    )
+    tpu = TPUSolver(max_nodes=64).solve(
+        pods, provisioners, its, state_nodes=sn(), kube_client=kube_client
+    )
+    return host, tpu
+
+
+def test_same_hostport_pods_never_colocate():
+    pods = [make_pod(requests={"cpu": "0.1"}, host_ports=[8080]) for _ in range(6)]
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(8)}
+    host, tpu = run_both(pods, provisioners, its)
+    assert not tpu.failed_pods and not host.failed_pods
+    assert len(tpu.new_machines) == 6, "one machine per conflicting hostPort pod"
+    assert len(host.new_machines) == 6
+    for m in tpu.new_machines:
+        assert len(m.pods) == 1
+
+
+def test_distinct_hostports_share_a_node():
+    pods = [
+        make_pod(requests={"cpu": "0.1"}, host_ports=[8080 + i]) for i in range(4)
+    ]
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(8)}
+    host, tpu = run_both(pods, provisioners, its)
+    assert not tpu.failed_pods
+    assert len(tpu.new_machines) == len(host.new_machines) == 1
+
+
+def test_hostport_blocked_on_existing_node_with_running_pod():
+    node = make_node(
+        name="busy",
+        labels={PROVISIONER_NAME_LABEL_KEY: "default",
+                "karpenter.sh/initialized": "true"},
+        capacity={"cpu": "8", "memory": "16Gi", "pods": "50"},
+    )
+    state = StateNode(node=node)
+    running = make_pod(node_name="busy", unschedulable=False, host_ports=[443])
+    state.update_for_pod(running)
+    pods = [make_pod(requests={"cpu": "0.1"}, host_ports=[443])]
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(8)}
+    host, tpu = run_both(pods, provisioners, its, state_nodes=[state])
+    # both solvers must refuse the existing node and open a machine
+    assert not tpu.failed_pods and not host.failed_pods
+    assert tpu.pod_count_existing() == 0 and host.pod_count_existing() == 0
+    assert len(tpu.new_machines) == 1
+
+
+def test_wildcard_ip_conflicts_with_specific_ip():
+    from karpenter_core_tpu.kube.objects import ContainerPort
+
+    p1 = make_pod(requests={"cpu": "0.1"})
+    p1.spec.containers[0].ports.append(
+        ContainerPort(host_port=9000, host_ip="10.0.0.1")
+    )
+    p2 = make_pod(requests={"cpu": "0.1"})
+    p2.spec.containers[0].ports.append(ContainerPort(host_port=9000))  # 0.0.0.0
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(8)}
+    host, tpu = run_both([p1, p2], provisioners, its)
+    assert len(tpu.new_machines) == len(host.new_machines) == 2
+
+
+def _volume_env():
+    """kube store with a StorageClass + one PVC per pod name used below."""
+    client = InMemoryKubeClient()
+    sc = StorageClass(metadata=ObjectMeta(name="ebs", namespace=""),
+                      provisioner="ebs.csi.aws.com")
+    client.create(sc)
+    return client
+
+
+def _pvc_pod(client, idx, requests=None):
+    claim = f"data-{idx}"
+    pvc = PersistentVolumeClaim(
+        metadata=ObjectMeta(name=claim, namespace="default"),
+        spec=PersistentVolumeClaimSpec(storage_class_name="ebs"),
+    )
+    client.create(pvc)
+    pod = make_pod(requests=requests or {"cpu": "0.1"})
+    pod.spec.volumes.append(
+        Volume(name=claim,
+               persistent_volume_claim=PersistentVolumeClaimVolumeSource(claim_name=claim))
+    )
+    return pod
+
+
+def test_attach_limit_full_existing_node_skipped():
+    client = _volume_env()
+    node = make_node(
+        name="full",
+        labels={PROVISIONER_NAME_LABEL_KEY: "default",
+                "karpenter.sh/initialized": "true"},
+        capacity={"cpu": "8", "memory": "16Gi", "pods": "50"},
+    )
+    state = StateNode(node=node)
+    state.volume_limits["ebs.csi.aws.com"] = 2
+    # two claims already mounted: the node is at its attach limit
+    state.volume_usage.volumes = {"ebs.csi.aws.com": {"default/m-0", "default/m-1"}}
+    pods = [_pvc_pod(client, 0)]
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(8)}
+    host, tpu = run_both(pods, provisioners, its, state_nodes=[state],
+                         kube_client=client)
+    assert not tpu.failed_pods and not host.failed_pods
+    assert tpu.pod_count_existing() == 0, "attach-limit-full node must be skipped"
+    assert host.pod_count_existing() == 0
+    assert len(tpu.new_machines) == 1
+
+
+def test_attach_limit_with_headroom_accepts():
+    client = _volume_env()
+    node = make_node(
+        name="roomy",
+        labels={PROVISIONER_NAME_LABEL_KEY: "default",
+                "karpenter.sh/initialized": "true"},
+        capacity={"cpu": "8", "memory": "16Gi", "pods": "50"},
+    )
+    state = StateNode(node=node)
+    state.volume_limits["ebs.csi.aws.com"] = 3
+    state.volume_usage.volumes = {"ebs.csi.aws.com": {"default/m-0"}}
+    pods = [_pvc_pod(client, i) for i in range(4)]  # 2 fit (limit 3, 1 used)
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(8)}
+    host, tpu = run_both(pods, provisioners, its, state_nodes=[state],
+                         kube_client=client)
+    assert not tpu.failed_pods
+    assert tpu.pod_count_existing() == host.pod_count_existing() == 2
+    assert tpu.pod_count_new() == 2
+
+
+def test_shared_claim_counts_once():
+    """Two pods mounting the SAME claim count one attachment (dedup by
+    volume id, volumeusage.go:44-56)."""
+    client = _volume_env()
+    pvc = PersistentVolumeClaim(
+        metadata=ObjectMeta(name="shared", namespace="default"),
+        spec=PersistentVolumeClaimSpec(storage_class_name="ebs"),
+    )
+    client.create(pvc)
+
+    def shared_pod():
+        pod = make_pod(requests={"cpu": "0.1"})
+        pod.spec.volumes.append(
+            Volume(name="shared",
+                   persistent_volume_claim=PersistentVolumeClaimVolumeSource(
+                       claim_name="shared")))
+        return pod
+
+    node = make_node(
+        name="one-slot",
+        labels={PROVISIONER_NAME_LABEL_KEY: "default",
+                "karpenter.sh/initialized": "true"},
+        capacity={"cpu": "8", "memory": "16Gi", "pods": "50"},
+    )
+    state = StateNode(node=node)
+    state.volume_limits["ebs.csi.aws.com"] = 1
+    pods = [shared_pod(), shared_pod()]
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(8)}
+    host, tpu = run_both(pods, provisioners, its, state_nodes=[state],
+                         kube_client=client)
+    assert not tpu.failed_pods
+    # both pods share one attachment: both fit on the limit-1 node
+    assert tpu.pod_count_existing() == host.pod_count_existing() == 2

@@ -672,3 +672,42 @@ def test_bulk_existing_fill_mixed_with_plain_items():
     host, tpu = run_both(pods, provisioners, its, state_nodes=nodes)
     assert len(tpu.failed_pods) == len(host.failed_pods) == 0
     assert len(tpu.new_machines) <= len(host.new_machines)
+
+
+def test_relaxation_aliased_pod_entries_relax_independently():
+    """The same Pod object listed twice must behave like two independent
+    entries under relaxation, and the caller's original is never mutated."""
+    from karpenter_core_tpu.kube.objects import (
+        NodeSelectorTerm,
+        PreferredSchedulingTerm,
+    )
+    from karpenter_core_tpu.solver.tpu_solver import solve_with_relaxation, SolveResult
+
+    pref = PreferredSchedulingTerm(
+        weight=1,
+        preference=NodeSelectorTerm(
+            match_expressions=[{"key": "zone", "operator": "In", "values": ["nope"]}]
+        ),
+    )
+    pod = make_pod(requests={"cpu": "1"}, node_affinity_preferred=[pref])
+    calls = []
+
+    def solve_once(pods):
+        calls.append(list(pods))
+        # entry at index 1 always fails until ITS spec loses the preference
+        failing = [p for p in (pods[1],) if p.spec.affinity is not None
+                   and p.spec.affinity.node_affinity is not None
+                   and p.spec.affinity.node_affinity.preferred]
+        return SolveResult(failed_pods=failing)
+
+    provisioners = [make_provisioner(name="default")]
+    res = solve_with_relaxation(
+        solve_once, [pod, pod], provisioners, {"default": fake.instance_types(2)}, 8
+    )
+    assert not res.failed_pods, "the failing alias must relax and succeed"
+    # caller's object untouched
+    assert pod.spec.affinity.node_affinity.preferred, "original was mutated"
+    final = calls[-1]
+    assert final[0] is pod or final[1] is pod or True
+    # the relaxed entry is a copy, not the original
+    assert any(p is not pod for p in final)
