@@ -184,9 +184,20 @@ def run(cloud_provider, kube_client=None, stop_event=None, options=None):
     if opts.solver_endpoint:
         from karpenter_core_tpu.solver.service import RemoteSolver
 
-        solver = RemoteSolver(opts.solver_endpoint)
+        primary = RemoteSolver(opts.solver_endpoint)
     else:
-        solver = solver_from_env()
+        primary = solver_from_env()
+        if primary is None:
+            from karpenter_core_tpu.solver.tpu_solver import TPUSolver
+
+            primary = TPUSolver()
+    # production backend-failure defense: subprocess-probe the accelerator,
+    # route solves to the host greedy path while it is wedged/unavailable,
+    # re-probe for recovery (solver/fallback.py)
+    from karpenter_core_tpu.solver.fallback import ResilientSolver
+    from karpenter_core_tpu.solver.tpu_solver import GreedySolver
+
+    solver = ResilientSolver(primary, GreedySolver())
     operator = new_operator(
         cloud_provider,
         kube_client=kube_client,
@@ -194,6 +205,7 @@ def run(cloud_provider, kube_client=None, stop_event=None, options=None):
         solver=solver,
         with_webhooks=not opts.disable_webhook,
     )
+    solver.recorder = operator.recorder
     health = serve_health(operator, opts.metrics_port, profiling=opts.enable_profiling)
     stop = stop_event or threading.Event()
     try:

@@ -1,0 +1,145 @@
+"""ResilientSolver — production backend-failure fallback.
+
+The accelerator link (the axon tunnel especially) is observed to HANG or
+fail to initialize, not just error: rounds 1 and 2 both lost their first
+bench attempt to `Unable to initialize backend: UNAVAILABLE`. The bench
+defends itself with a subprocess probe (bench.py); this module moves that
+defense into the PRODUCTION solve path, per the round-2 verdict:
+
+  - backend health is probed in a SUBPROCESS with a timeout (a wedged
+    backend cannot poison the control-plane process) and cached with a TTL;
+  - while unhealthy — or after a primary solve raises — Solve() routes to
+    the fallback solver (GreedySolver by default), publishes a deduped
+    event, and bumps a metric;
+  - the probe retries after `reprobe_interval`, so a recovered TPU is
+    picked back up without a restart.
+
+Wired by operator.__main__ around TPUSolver/RemoteSolver; the control plane
+keeps provisioning through a dead accelerator (reference analog: the whole
+design is level-triggered reconciliation — the solver must degrade, never
+stall, operator.go:154-169).
+"""
+from __future__ import annotations
+
+import subprocess
+import sys
+import time
+from typing import Optional
+
+from karpenter_core_tpu.events import Event
+from karpenter_core_tpu.metrics.registry import Counter
+
+SOLVER_FALLBACK_TOTAL = Counter(
+    "karpenter_solver_fallback_total",
+    "Solves routed to the fallback solver because the accelerator backend "
+    "was unavailable or the primary solver raised",
+)
+
+
+def probe_backend(timeout: float = 60.0) -> Optional[str]:
+    """Probe accelerator init in a subprocess. Returns None when healthy,
+    else a one-line reason. A hung init (the observed failure mode) is
+    converted into a timeout instead of wedging the caller."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d = jax.devices(); print(d[0].platform)"],
+            capture_output=True, text=True, timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return f"backend probe timed out after {timeout:.0f}s"
+    except OSError as e:
+        return f"backend probe failed to launch: {e}"
+    if proc.returncode != 0:
+        err = (proc.stderr or "").strip().splitlines()
+        return err[-1] if err else "backend probe exited nonzero"
+    return None
+
+
+class ResilientSolver:
+    """Solver decorator: primary with health-gated fallback.
+
+    prober is injectable for tests (defaults to probe_backend)."""
+
+    def __init__(self, primary, fallback, recorder=None, clock=time.time,
+                 probe_timeout: float = 60.0, reprobe_interval: float = 300.0,
+                 prober=None):
+        self.primary = primary
+        self.fallback = fallback
+        self.recorder = recorder
+        self.clock = clock
+        self.probe_timeout = probe_timeout
+        self.reprobe_interval = reprobe_interval
+        self.prober = prober or (lambda: probe_backend(probe_timeout))
+        self._healthy: Optional[bool] = None
+        self._last_probe = 0.0
+        self._reason = ""
+
+    # -- health ------------------------------------------------------------
+
+    def healthy(self) -> bool:
+        now = self.clock()
+        if self._healthy is None or (
+            not self._healthy and now - self._last_probe >= self.reprobe_interval
+        ):
+            self._last_probe = now
+            reason = self.prober()
+            was = self._healthy
+            self._healthy = reason is None
+            self._reason = reason or ""
+            if was is not False and not self._healthy:
+                self._event("SolverDegraded",
+                            f"accelerator backend unavailable ({self._reason}); "
+                            "falling back to the host solver")
+            elif was is False and self._healthy:
+                self._event("SolverRecovered", "accelerator backend recovered")
+        return bool(self._healthy)
+
+    def _mark_dead(self, reason: str) -> None:
+        self._healthy = False
+        self._last_probe = self.clock()
+        self._reason = reason
+        self._event("SolverDegraded",
+                    f"primary solver failed ({reason}); "
+                    "falling back to the host solver")
+
+    def _event(self, reason: str, message: str) -> None:
+        if self.recorder is not None:
+            self.recorder.publish(
+                Event("Solver", "solver", "Warning" if "Degraded" in reason
+                      else "Normal", reason, message,
+                      dedupe_values=(reason,))
+            )
+
+    # -- Solver interface --------------------------------------------------
+
+    @property
+    def supports_batched_replan(self) -> bool:
+        return self.healthy() and getattr(
+            self.primary, "supports_batched_replan", False
+        )
+
+    @property
+    def backend(self):
+        return getattr(self.primary, "backend", None)
+
+    def solve(self, pods, provisioners, instance_types, daemonset_pods=None,
+              state_nodes=None, kube_client=None, cluster=None):
+        if not self.healthy():
+            SOLVER_FALLBACK_TOTAL.inc({"reason": "backend_unavailable"})
+            return self.fallback.solve(
+                pods, provisioners, instance_types, daemonset_pods,
+                state_nodes, kube_client=kube_client, cluster=cluster,
+            )
+        try:
+            return self.primary.solve(
+                pods, provisioners, instance_types, daemonset_pods,
+                state_nodes, kube_client=kube_client, cluster=cluster,
+            )
+        except Exception as e:  # noqa: BLE001 — degrade, never stall
+            self._mark_dead(f"{type(e).__name__}: {e}")
+            SOLVER_FALLBACK_TOTAL.inc({"reason": "primary_error"})
+            return self.fallback.solve(
+                pods, provisioners, instance_types, daemonset_pods,
+                state_nodes, kube_client=kube_client, cluster=cluster,
+            )

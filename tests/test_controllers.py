@@ -231,3 +231,79 @@ def test_metrics_exposed(env):
     op.step()
     text = REGISTRY.expose()
     assert "karpenter_nodes_created" in text
+
+
+# -- solver backend-failure fallback ----------------------------------------
+
+
+def test_control_plane_provisions_with_dead_backend():
+    """Round-2 verdict #5: with the accelerator backend artificially dead,
+    the control plane must still provision via the host fallback, publish a
+    SolverDegraded event, count the fallback, and recover after a healthy
+    re-probe."""
+    from karpenter_core_tpu.solver.fallback import (
+        SOLVER_FALLBACK_TOTAL,
+        ResilientSolver,
+    )
+    from karpenter_core_tpu.solver.tpu_solver import GreedySolver
+
+    class DeadSolver:
+        supports_batched_replan = True
+
+        def solve(self, *a, **k):
+            raise AssertionError("dead backend must never be invoked")
+
+    clock = FakeClock()
+    health = {"reason": "backend probe timed out after 60s"}
+    resilient = ResilientSolver(
+        DeadSolver(), GreedySolver(), clock=clock,
+        reprobe_interval=300.0, prober=lambda: health["reason"],
+    )
+    cp = fake.FakeCloudProvider(fake.instance_types(10))
+    op = new_operator(cp, settings=Settings(), solver=resilient, clock=clock)
+    resilient.recorder = op.recorder
+    op.kube_client.create(make_provisioner(name="default"))
+    before = SOLVER_FALLBACK_TOTAL.get({"reason": "backend_unavailable"})
+    op.kube_client.create(make_pod(requests={"cpu": "1"}))
+    op.step()
+    # provisioned through the fallback
+    assert op.kube_client.list("Machine"), "fallback must still provision"
+    assert SOLVER_FALLBACK_TOTAL.get({"reason": "backend_unavailable"}) > before
+    events = op.recorder.for_object("Solver", "solver")
+    assert any(e.reason == "SolverDegraded" for e in events)
+    # batched replan is disabled while degraded
+    assert resilient.supports_batched_replan is False
+    # recovery: probe turns healthy after the reprobe interval
+    health["reason"] = None
+    clock.advance(301)
+    assert resilient.healthy()
+    assert any(e.reason == "SolverRecovered"
+               for e in op.recorder.for_object("Solver", "solver"))
+    assert resilient.supports_batched_replan is True
+
+
+def test_resilient_solver_degrades_on_primary_exception():
+    from karpenter_core_tpu.solver.fallback import ResilientSolver
+    from karpenter_core_tpu.solver.tpu_solver import GreedySolver
+
+    class FlakySolver:
+        calls = 0
+
+        def solve(self, *a, **k):
+            FlakySolver.calls += 1
+            raise RuntimeError("UNAVAILABLE: tunnel wedged")
+
+    clock = FakeClock()
+    resilient = ResilientSolver(
+        FlakySolver(), GreedySolver(), clock=clock, prober=lambda: None,
+    )
+    pods = [make_pod(requests={"cpu": "1"})]
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(5)}
+    res = resilient.solve(pods, provisioners, its)
+    assert res.pod_count_new() == 1, "exception must fall through to greedy"
+    assert FlakySolver.calls == 1
+    # marked dead: the primary is not retried before the reprobe interval
+    res2 = resilient.solve(pods, provisioners, its)
+    assert res2.pod_count_new() == 1
+    assert FlakySolver.calls == 1
