@@ -197,7 +197,7 @@ def run(cloud_provider, kube_client=None, stop_event=None, options=None):
     from karpenter_core_tpu.solver.fallback import ResilientSolver
     from karpenter_core_tpu.solver.tpu_solver import GreedySolver
 
-    solver = ResilientSolver(primary, GreedySolver())
+    solver = ResilientSolver(primary, GreedySolver(), solve_timeout=900.0)
     operator = new_operator(
         cloud_provider,
         kube_client=kube_client,
@@ -206,6 +206,9 @@ def run(cloud_provider, kube_client=None, stop_event=None, options=None):
         with_webhooks=not opts.disable_webhook,
     )
     solver.recorder = operator.recorder
+    # the wrapper IS the fallback layer: point the provisioner's own
+    # fallback at it so the two mechanisms don't stack
+    operator.provisioning.fallback_solver = solver
     health = serve_health(operator, opts.metrics_port, profiling=opts.enable_profiling)
     stop = stop_event or threading.Event()
     try:

@@ -6,13 +6,18 @@ bench attempt to `Unable to initialize backend: UNAVAILABLE`. The bench
 defends itself with a subprocess probe (bench.py); this module moves that
 defense into the PRODUCTION solve path, per the round-2 verdict:
 
-  - backend health is probed in a SUBPROCESS with a timeout (a wedged
-    backend cannot poison the control-plane process) and cached with a TTL;
-  - while unhealthy — or after a primary solve raises — Solve() routes to
-    the fallback solver (GreedySolver by default), publishes a deduped
-    event, and bumps a metric;
-  - the probe retries after `reprobe_interval`, so a recovered TPU is
-    picked back up without a restart.
+  - backend health is probed OUT-OF-PROCESS with a timeout (a wedged
+    backend cannot poison the control-plane process): the local jax
+    backend for in-process solvers, the Health RPC for RemoteSolver;
+  - health is re-checked on a TTL in BOTH directions — an unhealthy
+    backend re-probes for recovery, and a healthy verdict expires so a
+    mid-life wedge is detected between solves;
+  - optionally, each primary solve runs under a thread watchdog
+    (solve_timeout): a solve that hangs in-process is abandoned (the
+    thread leaks by design — better one leaked thread than a stalled
+    control plane) and the solver degrades;
+  - while unhealthy, Solve() routes to the fallback solver (GreedySolver),
+    publishes a deduped event, and bumps karpenter_solver_fallback_total.
 
 Wired by operator.__main__ around TPUSolver/RemoteSolver; the control plane
 keeps provisioning through a dead accelerator (reference analog: the whole
@@ -23,23 +28,24 @@ from __future__ import annotations
 
 import subprocess
 import sys
+import threading
 import time
 from typing import Optional
 
 from karpenter_core_tpu.events import Event
-from karpenter_core_tpu.metrics.registry import Counter
+from karpenter_core_tpu.metrics.registry import NAMESPACE, REGISTRY
 
-SOLVER_FALLBACK_TOTAL = Counter(
-    "karpenter_solver_fallback_total",
+SOLVER_FALLBACK_TOTAL = REGISTRY.counter(
+    f"{NAMESPACE}_solver_fallback_total",
     "Solves routed to the fallback solver because the accelerator backend "
-    "was unavailable or the primary solver raised",
+    "was unavailable or the primary solver failed",
 )
 
 
 def probe_backend(timeout: float = 60.0) -> Optional[str]:
-    """Probe accelerator init in a subprocess. Returns None when healthy,
-    else a one-line reason. A hung init (the observed failure mode) is
-    converted into a timeout instead of wedging the caller."""
+    """Probe local accelerator init in a subprocess. Returns None when
+    healthy, else a one-line reason. A hung init (the observed failure
+    mode) is converted into a timeout instead of wedging the caller."""
     try:
         proc = subprocess.run(
             [sys.executable, "-c",
@@ -56,21 +62,39 @@ def probe_backend(timeout: float = 60.0) -> Optional[str]:
     return None
 
 
+def probe_for(primary, timeout: float = 60.0) -> Optional[str]:
+    """Pick the probe matching the primary: RemoteSolver exposes a Health
+    RPC (the control-plane pod often has no local accelerator at all —
+    that is WHY the solver is remote); in-process solvers probe the local
+    backend."""
+    health = getattr(primary, "health", None)
+    if callable(health):
+        try:
+            health(timeout=timeout)
+            return None
+        except Exception as e:  # noqa: BLE001 — any RPC failure = unhealthy
+            return f"solver service health check failed: {type(e).__name__}: {e}"
+    return probe_backend(timeout)
+
+
 class ResilientSolver:
     """Solver decorator: primary with health-gated fallback.
 
-    prober is injectable for tests (defaults to probe_backend)."""
+    prober is injectable for tests (defaults to probe_for(primary))."""
 
     def __init__(self, primary, fallback, recorder=None, clock=time.time,
                  probe_timeout: float = 60.0, reprobe_interval: float = 300.0,
-                 prober=None):
+                 healthy_recheck_interval: float = 600.0,
+                 solve_timeout: Optional[float] = None, prober=None):
         self.primary = primary
         self.fallback = fallback
         self.recorder = recorder
         self.clock = clock
         self.probe_timeout = probe_timeout
         self.reprobe_interval = reprobe_interval
-        self.prober = prober or (lambda: probe_backend(probe_timeout))
+        self.healthy_recheck_interval = healthy_recheck_interval
+        self.solve_timeout = solve_timeout
+        self.prober = prober or (lambda: probe_for(primary, probe_timeout))
         self._healthy: Optional[bool] = None
         self._last_probe = 0.0
         self._reason = ""
@@ -79,35 +103,40 @@ class ResilientSolver:
 
     def healthy(self) -> bool:
         now = self.clock()
-        if self._healthy is None or (
-            not self._healthy and now - self._last_probe >= self.reprobe_interval
-        ):
+        stale = (
+            self._healthy is None
+            or (not self._healthy
+                and now - self._last_probe >= self.reprobe_interval)
+            or (self._healthy
+                and now - self._last_probe >= self.healthy_recheck_interval)
+        )
+        if stale:
             self._last_probe = now
             reason = self.prober()
             was = self._healthy
             self._healthy = reason is None
             self._reason = reason or ""
             if was is not False and not self._healthy:
-                self._event("SolverDegraded",
+                self._event("SolverDegraded", "Warning",
                             f"accelerator backend unavailable ({self._reason}); "
                             "falling back to the host solver")
             elif was is False and self._healthy:
-                self._event("SolverRecovered", "accelerator backend recovered")
+                self._event("SolverRecovered", "Normal",
+                            "accelerator backend recovered")
         return bool(self._healthy)
 
     def _mark_dead(self, reason: str) -> None:
         self._healthy = False
         self._last_probe = self.clock()
         self._reason = reason
-        self._event("SolverDegraded",
+        self._event("SolverDegraded", "Warning",
                     f"primary solver failed ({reason}); "
                     "falling back to the host solver")
 
-    def _event(self, reason: str, message: str) -> None:
+    def _event(self, reason: str, etype: str, message: str) -> None:
         if self.recorder is not None:
             self.recorder.publish(
-                Event("Solver", "solver", "Warning" if "Degraded" in reason
-                      else "Normal", reason, message,
+                Event("Solver", "solver", etype, reason, message,
                       dedupe_values=(reason,))
             )
 
@@ -115,13 +144,46 @@ class ResilientSolver:
 
     @property
     def supports_batched_replan(self) -> bool:
-        return self.healthy() and getattr(
+        # cached health only — this property is read every deprovisioning
+        # pass and must never block on a probe; until the first solve has
+        # established health, the sequential replan path is used
+        return self._healthy is True and getattr(
             self.primary, "supports_batched_replan", False
         )
 
     @property
     def backend(self):
         return getattr(self.primary, "backend", None)
+
+    @property
+    def max_nodes(self):
+        # consolidation sizes its ladder screen off the solver's budget
+        return getattr(self.primary, "max_nodes", 1024)
+
+    def _primary_solve(self, *args, **kwargs):
+        if self.solve_timeout is None:
+            return self.primary.solve(*args, **kwargs)
+        box = {}
+        done = threading.Event()
+
+        def run():
+            try:
+                box["result"] = self.primary.solve(*args, **kwargs)
+            except BaseException as e:  # noqa: BLE001 — surfaced below
+                box["error"] = e
+            finally:
+                done.set()
+
+        t = threading.Thread(target=run, daemon=True, name="primary-solve")
+        t.start()
+        if not done.wait(self.solve_timeout):
+            # the thread leaks with the wedged call — by design
+            raise TimeoutError(
+                f"primary solve exceeded {self.solve_timeout:.0f}s watchdog"
+            )
+        if "error" in box:
+            raise box["error"]
+        return box["result"]
 
     def solve(self, pods, provisioners, instance_types, daemonset_pods=None,
               state_nodes=None, kube_client=None, cluster=None):
@@ -132,7 +194,7 @@ class ResilientSolver:
                 state_nodes, kube_client=kube_client, cluster=cluster,
             )
         try:
-            return self.primary.solve(
+            return self._primary_solve(
                 pods, provisioners, instance_types, daemonset_pods,
                 state_nodes, kube_client=kube_client, cluster=cluster,
             )

@@ -307,3 +307,70 @@ def test_resilient_solver_degrades_on_primary_exception():
     res2 = resilient.solve(pods, provisioners, its)
     assert res2.pod_count_new() == 1
     assert FlakySolver.calls == 1
+
+
+def test_resilient_solver_watchdog_abandons_hung_solve():
+    """A solve that HANGS in-process (the observed axon wedge) is abandoned
+    by the thread watchdog and routed to the fallback."""
+    import threading as _threading
+
+    from karpenter_core_tpu.solver.fallback import ResilientSolver
+    from karpenter_core_tpu.solver.tpu_solver import GreedySolver
+
+    release = _threading.Event()
+
+    class HungSolver:
+        def solve(self, *a, **k):
+            release.wait(30)  # simulates a wedged device call
+            raise RuntimeError("never reached in test")
+
+    resilient = ResilientSolver(
+        HungSolver(), GreedySolver(), prober=lambda: None, solve_timeout=0.2,
+    )
+    pods = [make_pod(requests={"cpu": "1"})]
+    res = resilient.solve(pods, [make_provisioner(name="default")],
+                          {"default": fake.instance_types(5)})
+    release.set()
+    assert res.pod_count_new() == 1, "watchdog must fall back"
+    assert resilient._healthy is False
+
+
+def test_resilient_solver_probes_remote_health_rpc():
+    from karpenter_core_tpu.solver.fallback import probe_for
+
+    class FakeRemote:
+        def __init__(self, ok):
+            self.ok = ok
+
+        def health(self, timeout=30.0):
+            if not self.ok:
+                raise RuntimeError("UNAVAILABLE")
+
+    assert probe_for(FakeRemote(True)) is None
+    assert "health check failed" in probe_for(FakeRemote(False))
+
+
+def test_resilient_solver_healthy_verdict_expires():
+    """A mid-life wedge is caught: the healthy verdict re-probes after
+    healthy_recheck_interval."""
+    from karpenter_core_tpu.solver.fallback import ResilientSolver
+    from karpenter_core_tpu.solver.tpu_solver import GreedySolver
+
+    clock = FakeClock()
+    health = {"reason": None}
+    probes = []
+
+    def prober():
+        probes.append(clock())
+        return health["reason"]
+
+    resilient = ResilientSolver(
+        GreedySolver(), GreedySolver(), clock=clock, prober=prober,
+        healthy_recheck_interval=600.0,
+    )
+    assert resilient.healthy() and len(probes) == 1
+    assert resilient.healthy() and len(probes) == 1  # cached
+    clock.advance(601)
+    health["reason"] = "tunnel wedged"
+    assert not resilient.healthy()
+    assert len(probes) == 2
