@@ -68,6 +68,9 @@ class Operator:
     eviction_queue: EvictionQueue
     terminator: Terminator
     clock: object = time.time
+    # deflake hook: zero-arg callable injecting randomized delays into the
+    # watch pumps (reference pkg/test/randomdelay.go:44-70); None in prod
+    jitter: object = None
     _threads: List[threading.Thread] = field(default_factory=list)
     _stop: threading.Event = field(default_factory=threading.Event)
 
@@ -161,6 +164,12 @@ class Operator:
                     except queue_mod.Empty:
                         continue
                     try:
+                        # deflake hook: the test harness injects randomized
+                        # delays here to shake out pump/singleton races
+                        # (reference randomdelay.go:44-70, make deflake)
+                        jitter = self.jitter
+                        if jitter is not None:
+                            jitter()
                         handler(event, obj)
                         if kind == "Pod":
                             self.pod_controller.reconcile(obj)
@@ -209,6 +218,12 @@ class Operator:
     def stop(self) -> None:
         self._stop.set()
         self.eviction_queue.stop()
+        # join the pumps/singletons so no stale thread mutates state (or
+        # trips error counters) after stop() returns — bounded wait, the
+        # threads are daemons either way
+        for t in self._threads:
+            t.join(timeout=2.0)
+        self._threads.clear()
 
 
 def new_operator(

