@@ -37,18 +37,33 @@ def _kind_of(obj) -> str:
 
 
 class InMemoryKubeClient:
-    """Object store keyed (kind, namespace, name) with watch fan-out."""
+    """Object store keyed (kind, namespace, name) with watch fan-out.
 
-    def __init__(self):
+    `scheme` (api/scheme.default_scheme) maps kind names to types —
+    new_object() constructs through it, and strict=True rejects writes of
+    unregistered kinds (the runtime.Scheme contract, operator/scheme)."""
+
+    def __init__(self, scheme=None, strict: bool = False):
         self._mu = threading.RLock()
         self._objects: Dict[str, Dict[NamespacedName, object]] = {}
         self._watchers: Dict[str, List[queue.Queue]] = {}
         self._rv = 0
+        if scheme is None:
+            from karpenter_core_tpu.api.scheme import default_scheme
+
+            scheme = default_scheme()
+        self.scheme = scheme
+        self.strict = strict
+
+    def new_object(self, kind: str):
+        return self.scheme.new_object(kind)
 
     # -- CRUD -------------------------------------------------------------
 
     def create(self, obj) -> object:
         kind = _kind_of(obj)
+        if self.strict and not self.scheme.recognizes(kind):
+            raise TypeError(f"kind {kind} is not registered in the scheme")
         with self._mu:
             key = NamespacedName(obj.metadata.namespace, obj.metadata.name)
             store = self._objects.setdefault(kind, {})

@@ -198,10 +198,15 @@ def run(cloud_provider, kube_client=None, stop_event=None, options=None):
     from karpenter_core_tpu.solver.tpu_solver import GreedySolver
 
     solver = ResilientSolver(primary, GreedySolver(), solve_timeout=900.0)
+    settings = resolve_settings(kube_client, opts)
+    # context-carried config bootstrap (injection.go:116-127)
+    from karpenter_core_tpu.operator.injection import inject_defaults
+
+    inject_defaults(options=opts, settings=settings)
     operator = new_operator(
         cloud_provider,
         kube_client=kube_client,
-        settings=resolve_settings(kube_client, opts),
+        settings=settings,
         solver=solver,
         with_webhooks=not opts.disable_webhook,
     )

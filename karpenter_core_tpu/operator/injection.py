@@ -1,0 +1,89 @@
+"""Context-carried configuration (reference pkg/operator/injection:36-127).
+
+The reference threads Options, Settings, and the controller name through
+context.Context so any depth of the call stack can read them without
+plumbing. contextvars are the Python analog: Singleton.reconcile_once sets
+the controller name around each reconcile, the operator entrypoint sets
+options/settings at startup, and log lines / metrics helpers read them
+without signature changes.
+"""
+from __future__ import annotations
+
+import contextlib
+import contextvars
+from typing import Iterator, Optional
+
+_options: contextvars.ContextVar = contextvars.ContextVar("karpenter_options",
+                                                          default=None)
+_settings: contextvars.ContextVar = contextvars.ContextVar("karpenter_settings",
+                                                           default=None)
+_controller: contextvars.ContextVar = contextvars.ContextVar(
+    "karpenter_controller", default=""
+)
+# process-level bootstrap values: new THREADS do not inherit ContextVar
+# values set elsewhere (each thread starts a fresh context), so the
+# operator-startup defaults live in module globals and the getters fall
+# back to them — context overrides still win within a scope
+_default_options = None
+_default_settings = None
+
+
+@contextlib.contextmanager
+def with_options(options) -> Iterator[None]:
+    token = _options.set(options)
+    try:
+        yield
+    finally:
+        _options.reset(token)
+
+
+def get_options():
+    o = _options.get()
+    return o if o is not None else _default_options
+
+
+@contextlib.contextmanager
+def with_settings(settings) -> Iterator[None]:
+    token = _settings.set(settings)
+    try:
+        yield
+    finally:
+        _settings.reset(token)
+
+
+def get_settings():
+    """Context settings first, then the injected process defaults, then
+    the process-global current settings (settings.go:53-68 falls back the
+    same way)."""
+    s = _settings.get()
+    if s is not None:
+        return s
+    if _default_settings is not None:
+        return _default_settings
+    from karpenter_core_tpu.api.settings import current
+
+    return current()
+
+
+@contextlib.contextmanager
+def with_controller_name(name: str) -> Iterator[None]:
+    token = _controller.set(name)
+    try:
+        yield
+    finally:
+        _controller.reset(token)
+
+
+def controller_name() -> str:
+    return _controller.get()
+
+
+def inject_defaults(options=None, settings=None) -> None:
+    """Process-level bootstrap (injection.go:116-127): set the base values
+    once at operator startup — visible from EVERY thread (module globals,
+    since threads do not inherit another thread's ContextVars)."""
+    global _default_options, _default_settings
+    if options is not None:
+        _default_options = options
+    if settings is not None:
+        _default_settings = settings

@@ -88,7 +88,7 @@ def _run_iteration(seed: int) -> None:
         deadline = time.time() + 10.0
         while time.time() < deadline:
             machines = op.kube_client.list("Machine")
-            capacity = sum(m.status.capacity.get("cpu") or 8.0 for m in machines)
+            capacity = sum(m.status.capacity.get("cpu") or 0.0 for m in machines)
             if machines and capacity >= demand:
                 break
             time.sleep(0.05)
