@@ -47,8 +47,10 @@ N_EXISTING = int(os.environ.get("BENCH_EXISTING", "1000"))
 CONS_NODES = int(os.environ.get("BENCH_CONS_NODES", "1000"))
 CONS_PODS = int(os.environ.get("BENCH_CONS_PODS", "10000"))
 CONS_TYPES = int(os.environ.get("BENCH_CONS_TYPES", "100"))
-# node-slot budget: hostname-spread pods (1/7 of the mix) need a slot each
-MAX_NODES = int(os.environ.get("BENCH_NODES", str(max(1024, N_PODS // 4 + 2048))))
+# node-slot budget: hostname-spread pods (1/7 of the mix) need a slot each,
+# plus headroom for the machine opens of the other kinds — oversizing the
+# budget taxes every [N]-wide op in the scan
+MAX_NODES = int(os.environ.get("BENCH_NODES", str(max(1024, N_PODS // 5 + 1536))))
 PROBE_RETRIES = int(os.environ.get("BENCH_PROBE_RETRIES", "2"))
 PROBE_TIMEOUT = int(os.environ.get("BENCH_PROBE_TIMEOUT", "240"))
 

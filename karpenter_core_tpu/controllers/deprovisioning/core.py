@@ -228,6 +228,14 @@ def candidate_nodes(
 
     candidates: List[CandidateNode] = []
 
+    # ONE pass over the pod store instead of a per-candidate filtered list:
+    # the naive form is O(nodes x pods) with a lambda per pair — at 1k
+    # nodes / 10k pods that is 10M calls per deprovisioning scan
+    pods_by_node: Dict[str, List[Pod]] = {}
+    for p in kube_client.list("Pod"):
+        if p.spec.node_name and not podutils.is_terminal(p):
+            pods_by_node.setdefault(p.spec.node_name, []).append(p)
+
     def visit(state_node) -> bool:
         labels = state_node.labels()
         prov_name = labels.get(api_labels.PROVISIONER_NAME_LABEL_KEY)
@@ -250,13 +258,7 @@ def candidate_nodes(
             return True
         if state_node.node is None:
             return True
-        pods = [
-            p
-            for p in kube_client.list(
-                "Pod", field_filter=lambda p: p.spec.node_name == state_node.name()
-            )
-            if not podutils.is_terminal(p)
-        ]
+        pods = pods_by_node.get(state_node.name(), [])
         if not should_deprovision(state_node, provisioner, pods):
             return True
         candidate = CandidateNode(

@@ -67,26 +67,43 @@ def resolve_settings(kube_client, options=None) -> Settings:
     return settings_from_env()
 
 
+class _ControllerContextFilter:
+    """Stamps every record with the context-injected controller name
+    (operator/injection.py) so log lines are controller-attributable the
+    way the reference's logger.WithValues(controller) lines are."""
+
+    def filter(self, record):
+        from karpenter_core_tpu.operator.injection import controller_name
+
+        record.controller = controller_name() or "-"
+        return True
+
+
 def configure_logging() -> None:
     """KARPENTER_LOGGING_CONFIG (a logging dictConfig JSON, injected from the
     config-logging ConfigMap — the analog of the reference's zap ConfigMap,
-    operator.go:95-100) wins; otherwise basicConfig at KARPENTER_LOG_LEVEL."""
+    operator.go:95-100) wins; otherwise basicConfig at KARPENTER_LOG_LEVEL.
+    Either way, records carry the injected controller name."""
     import json
     import logging
     import logging.config
 
     raw = os.environ.get("KARPENTER_LOGGING_CONFIG", "")
+    configured = False
     if raw:
         try:
             logging.config.dictConfig(json.loads(raw))
-            return
+            configured = True
         except (ValueError, TypeError, AttributeError, ImportError) as exc:
             print(f"invalid KARPENTER_LOGGING_CONFIG, using basicConfig: {exc}")
-    level = os.environ.get("KARPENTER_LOG_LEVEL", "INFO").upper()
-    logging.basicConfig(
-        level=getattr(logging, level, logging.INFO),
-        format="%(asctime)s %(levelname)s %(name)s %(message)s",
-    )
+    if not configured:
+        level = os.environ.get("KARPENTER_LOG_LEVEL", "INFO").upper()
+        logging.basicConfig(
+            level=getattr(logging, level, logging.INFO),
+            format="%(asctime)s %(levelname)s %(name)s [%(controller)s] %(message)s",
+        )
+    for handler in logging.getLogger().handlers:
+        handler.addFilter(_ControllerContextFilter())
 
 
 def _debug_threads() -> str:
@@ -137,6 +154,23 @@ class _HealthHandler(BaseHTTPRequestHandler):
             ctype = "text/plain"
         elif self.path == "/debug/backend" and self.profiling_enabled:
             body = _debug_backend().encode()
+            ctype = "application/json"
+        elif self.path == "/debug/config" and self.profiling_enabled:
+            # context-injected config (operator/injection.py)
+            from dataclasses import asdict, is_dataclass
+
+            from karpenter_core_tpu.operator import injection
+
+            opts = injection.get_options()
+            settings = injection.get_settings()
+            body = json.dumps(
+                {
+                    "options": asdict(opts) if is_dataclass(opts) else repr(opts),
+                    "settings": asdict(settings)
+                    if is_dataclass(settings)
+                    else repr(settings),
+                }
+            ).encode()
             ctype = "application/json"
         else:
             self.send_response(404)

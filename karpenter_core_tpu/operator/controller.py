@@ -11,11 +11,13 @@ replacement.
 from __future__ import annotations
 
 import logging
+import queue
 import threading
 import time
 from typing import Callable, Optional
 
 from karpenter_core_tpu.metrics.registry import REGISTRY
+from karpenter_core_tpu.operator.injection import with_controller_name
 
 LOG = logging.getLogger("karpenter.controller")
 
@@ -56,8 +58,6 @@ class Singleton:
 
     def reconcile_once(self) -> Optional[float]:
         """One instrumented reconcile; returns the wait before the next."""
-        from karpenter_core_tpu.operator.injection import with_controller_name
-
         start = time.perf_counter()
         try:
             with with_controller_name(self.name):
@@ -95,24 +95,62 @@ class Singleton:
         return self._thread
 
 
+class _DaemonPool:
+    """Minimal worker pool with DAEMON threads (unlike ThreadPoolExecutor,
+    whose non-daemon workers are joined at interpreter exit — one reconcile
+    wedged on a blackholed cloud API would then block process shutdown
+    until SIGKILL). A wedged task here leaks its worker; the process still
+    exits."""
+
+    def __init__(self, name: str, max_workers: int):
+        self._q: "queue.Queue" = queue.Queue()
+        self._threads = [
+            threading.Thread(
+                target=self._worker, daemon=True, name=f"{name}-{i}"
+            )
+            for i in range(max_workers)
+        ]
+        for t in self._threads:
+            t.start()
+
+    def _worker(self):
+        while True:
+            fn, args, box, done = self._q.get()
+            try:
+                box["result"] = fn(*args)
+            except BaseException as e:  # noqa: BLE001 — surfaced via result()
+                box["error"] = e
+            finally:
+                done.set()
+
+    def submit(self, fn, *args):
+        box: dict = {}
+        done = threading.Event()
+        self._q.put((fn, args, box, done))
+
+        def result(timeout=None):
+            if not done.wait(timeout):
+                raise TimeoutError("reconcile still running")
+            if "error" in box:
+                raise box["error"]
+            return box.get("result")
+
+        return result
+
+
 # persistent per-controller worker pools: the housekeeping singleton runs
 # every second — building/tearing a 50-thread pool per tick would be pure
-# churn. Pools live for the process (idle workers are cheap; the executor's
-# atexit hook reaps them at interpreter exit).
+# churn. Pools live for the process (idle daemon workers are cheap).
 _pools: dict = {}
 _pools_mu = threading.Lock()
 
 
-def _pool(name: str, max_workers: int):
-    import concurrent.futures
-
+def _pool(name: str, max_workers: int) -> _DaemonPool:
     key = (name, max_workers)
     with _pools_mu:
         pool = _pools.get(key)
         if pool is None:
-            pool = _pools[key] = concurrent.futures.ThreadPoolExecutor(
-                max_workers=max_workers, thread_name_prefix=name
-            )
+            pool = _pools[key] = _DaemonPool(name, max_workers)
         return pool
 
 
@@ -122,8 +160,6 @@ def reconcile_concurrently(name: str, items, reconcile_fn, max_workers: int = 10
     reconciles, machine/controller.go:166, and 10 for provisioning,
     provisioning/controller.go:72). Errors are counted/logged per
     controller and never abort the batch; returns the error count."""
-    from karpenter_core_tpu.operator.injection import with_controller_name
-
     items = list(items)
     if not items:
         return 0
@@ -133,10 +169,10 @@ def reconcile_concurrently(name: str, items, reconcile_fn, max_workers: int = 10
             return reconcile_fn(obj)
 
     errors = 0
-    futures = [_pool(name, max_workers).submit(one, obj) for obj in items]
-    for fut in futures:
+    results = [_pool(name, max_workers).submit(one, obj) for obj in items]
+    for result in results:
         try:
-            fut.result()
+            result()
         except Exception:
             RECONCILE_ERRORS.inc(labels={"controller": name})
             LOG.exception("reconcile failed (controller=%s)", name)

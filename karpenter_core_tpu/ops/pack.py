@@ -286,6 +286,7 @@ def make_pack_kernel(
         n_exist: int = 0,
         vol_limits: jnp.ndarray = None,  # [E_pad, D]
         vol_driver: jnp.ndarray = None,  # [W, D] claim -> driver onehot
+        log_commits: bool = True,
     ):
         N = state.used.shape[0]
         J = tmpl_daemon.shape[0]
@@ -310,8 +311,11 @@ def make_pack_kernel(
         # capped so the [LB, E] matrix stays small at 50k-item scale — on
         # overflow the use_bulk gate falls back to the per-slot path, which
         # is slower but identical in result.
+        # log_commits=False (the consolidation rung screen, which reads only
+        # the final state) skips every log write AND the log-space gating,
+        # so the bulk fast path runs with a 1-row take matrix.
         EB = n_exist
-        LB = min(2 * I + V + 64, 4096) if EB > 0 else 1
+        LB = (min(2 * I + V + 64, 4096) if log_commits else 1) if EB > 0 else 1
 
         log0 = {
             "item": jnp.full(L, -1, jnp.int32),
@@ -323,7 +327,13 @@ def make_pack_kernel(
             "bulk_n": jnp.int32(0),
         }
 
+        def log_ok(ptr):
+            """Commit gate: log space when logging, always-true otherwise."""
+            return (ptr < L) if log_commits else jnp.bool_(True)
+
         def log_write(log, ptr, do, item_i, slot_lo, ns, k, k_last):
+            if not log_commits:
+                return log, ptr
             p = jnp.minimum(ptr, L - 1)
             w = do & (ptr < L)
 
@@ -594,7 +604,7 @@ def make_pack_kernel(
                     f_static_p, spread_force=force if has_topo else None,
                 )
                 k = jnp.minimum(jnp.minimum(remaining, kmax), cap)
-                do = ok & (k >= 1) & (ptr < L)
+                do = ok & (k >= 1) & log_ok(ptr)
 
                 m_allow = state.allow[n] & prow["allow"] & narrow
                 m_out = state.out[n] & prow["out"] & ~applied_keys
@@ -714,7 +724,9 @@ def make_pack_kernel(
                 take = jnp.clip(budget - (csum - k_eff), 0, k_eff)
                 placed = take.sum()
                 bn = log["bulk_n"]
-                do = (placed >= 1) & (ptr < L) & (bn < LB)
+                do = (placed >= 1) & log_ok(ptr) & (
+                    (bn < LB) if log_commits else jnp.bool_(True)
+                )
 
                 m_allow_rows = sa & (prow["allow"] & narrow)[None, :]
                 m_out_rows = state.out[:EB] & prow["out"][None, :] & ~applied_keys[None, :]
@@ -777,14 +789,15 @@ def make_pack_kernel(
                     return st
 
                 state = jax.lax.cond(do, apply, lambda s: s, state)
-                bslot = jnp.minimum(bn, LB - 1)
-                log = {
-                    **log,
-                    "bulk_take": log["bulk_take"].at[bslot].set(
-                        jnp.where(do, take, log["bulk_take"][bslot])
-                    ),
-                    "bulk_n": bn + jnp.where(do, 1, 0),
-                }
+                if log_commits:
+                    bslot = jnp.minimum(bn, LB - 1)
+                    log = {
+                        **log,
+                        "bulk_take": log["bulk_take"].at[bslot].set(
+                            jnp.where(do, take, log["bulk_take"][bslot])
+                        ),
+                        "bulk_n": bn + jnp.where(do, 1, 0),
+                    }
                 log, ptr = log_write(log, ptr, do, i, 0, -1, bn, placed)
                 remaining = remaining - jnp.where(do, placed, 0)
                 # retire filled/unusable slots; on a no-op pass retire every
@@ -902,7 +915,7 @@ def make_pack_kernel(
                         ):
                             own_hostaff |= prow["topo_own"][g]
                     s = jnp.where(own_hostaff, jnp.minimum(s, 1), s)
-                can = can_open_j.any() & (m_eff >= 1) & (s >= 1) & (ptr < L)
+                can = can_open_j.any() & (m_eff >= 1) & (s >= 1) & log_ok(ptr)
                 s = jnp.where(can, s, 0)
 
                 placed = jnp.minimum(target, s * m_eff)
@@ -1036,7 +1049,8 @@ def make_pack_kernel(
                         item_bulk_ok
                         & exist_cand
                         & ~need_seed
-                        & (carry[1]["bulk_n"] < LB)
+                        & ((carry[1]["bulk_n"] < LB) if log_commits
+                           else jnp.bool_(True))
                     )
                     inner = jax.lax.cond(
                         use_bulk,

@@ -303,12 +303,14 @@ def make_device_run(segments, zone_seg, ct_seg, topo_meta, n_slots,
             well_known=well_known,
             topo_terms=topo_terms,
             log_len=log_len,
-            # rung mode never decodes the log (the ladder screen reads only
-            # state.pods), so the bulk fast path is disabled to avoid
-            # allocating Rn vmapped bulk logs
-            n_exist=0 if rung_mode else E,
+            n_exist=E,
             vol_limits=exist_vol_limits,
             vol_driver=vol_driver,
+            # rung mode never decodes the log (the ladder screen reads only
+            # state.pods): skip every log write and its space gating, which
+            # keeps the vmapped bulk-take matrices at one row AND lets the
+            # bulk existing-fill fast path run per rung
+            log_commits=not rung_mode,
         )
         return log, ptr, state
 
