@@ -351,6 +351,12 @@ def main():
         file=sys.stderr,
     )
 
+    # second warm at a smaller size: the post-solve fetch slices bucket by
+    # outcome (ptr/nopen), and the first solve at a new bucket combo pays
+    # small one-time compiles — warm them out of the timed region
+    pods2, provisioners2, its2, nodes2 = workload(int(N_PODS * 0.8), N_EXISTING, 1)
+    solver.solve(pods2, provisioners2, its2, state_nodes=nodes2)
+
     # device-only time at the headline config (r01/r02-comparable region)
     snap = encode_snapshot(pods, provisioners, its, None, nodes, max_nodes=MAX_NODES)
     args = jax.device_put(device_args(snap, provisioners))
