@@ -377,6 +377,7 @@ def main():
     # the production steady state; each solve is a FRESH workload) --------
     rng = np.random.default_rng(7)
     times = []
+    device_times = []
     sched_counts = []
     for r in range(N_RUNS):
         n_pods = int(N_PODS * (0.8 + 0.25 * rng.random()))  # 40k..52.5k
@@ -386,15 +387,19 @@ def main():
         res = solver.solve(pods, provisioners, its, state_nodes=nodes)
         dt = time.perf_counter() - t0
         times.append(dt)
+        device_times.append(getattr(solver, "last_device_ms", 0.0))
         sched_counts.append(res.pod_count_new() + res.pod_count_existing())
         print(
             f"[bench] run {r + 1}/{N_RUNS}: pods={n_pods} nodes={n_exist} "
-            f"solve={dt * 1e3:.0f}ms scheduled={sched_counts[-1]}",
+            f"solve={dt * 1e3:.0f}ms device={device_times[-1]:.0f}ms "
+            f"scheduled={sched_counts[-1]}",
             file=sys.stderr,
         )
     ts = np.sort(np.array(times))
     p50 = float(np.percentile(ts, 50))
     p99 = float(np.percentile(ts, 99))
+    dev_p50 = float(np.percentile(device_times, 50))
+    dev_p99 = float(np.percentile(device_times, 99))
     compiled = len(solver._compiled)
     pods_per_sec = N_PODS / p99  # pods/sec at the p99 latency, headline size
 
@@ -428,8 +433,10 @@ def main():
                     "e2e_p50_ms": round(p50 * 1e3, 1),
                     "e2e_p99_ms": round(p99 * 1e3, 1),
                     "device_solve_med_ms": round(device_ms, 1),
+                    "device_p50_ms_varied": round(dev_p50, 1),
+                    "device_p99_ms_varied": round(dev_p99, 1),
                     "north_star_target_ms": 1000.0,
-                    "device_under_target": bool(device_ms < 1000.0),
+                    "device_under_target": bool(dev_p99 < 1000.0),
                     "runs": N_RUNS,
                     "scheduled_min": int(min(sched_counts)),
                     "compile_cold_s": round(cold_s, 1),

@@ -558,6 +558,9 @@ class TPUSolver:
         # tunnel especially) charges per-transfer latency, so ~40 implicit
         # per-leaf uploads cost seconds where one device_put costs ~0.1s
         args = jax.device_put(args)
+        import time as _time
+
+        t_dispatch = _time.perf_counter()
         trace_dir = os.environ.get("KARPENTER_JAX_TRACE_DIR", "")
         if trace_dir:
             with jax.profiler.trace(trace_dir):
@@ -569,6 +572,9 @@ class TPUSolver:
         # [:bulk_n], and state slot rows [:nopen] (the slot budget is mostly
         # unused headroom — at 50k pods this cuts the fetch ~10x)
         ptr_i, nopen, bulk_n = jax.device_get((ptr, state.nopen, log["bulk_n"]))
+        # dispatch -> first scalar readback ≈ device execution time for this
+        # solve (observability: bench reports p99 of this across batches)
+        self.last_device_ms = (_time.perf_counter() - t_dispatch) * 1e3
         ptr_i, nopen, bulk_n = int(ptr_i), int(nopen), int(bulk_n)
         # slice lengths round UP to buckets: each distinct slice shape
         # compiles its own tiny device program, so exact lengths would pay
