@@ -38,33 +38,36 @@ class SolvedMachine:
     """A new node computed by the solver (analog of scheduling.Machine after
     FinalizeScheduling).
 
-    `requirements` may be passed as a zero-arg thunk: reconstructing the
-    merged Requirements from slot masks costs Python time per machine, and
-    most machines (bench runs, failed relax rounds) never read it — the
-    thunk defers that to first access."""
+    `requirements` and `instance_type_options` may be passed as zero-arg
+    thunks: reconstructing them from slot masks costs Python time per
+    machine, and most machines (bench runs, failed relax rounds) never read
+    them — the thunk defers that to first access and is dropped after
+    materialization so held machines don't pin snapshot/state arrays."""
 
     provisioner_name: str
     template: MachineTemplate
     pods: List[Pod]
-    instance_type_options: List[InstanceType]
+    instance_type_options: object
     requests: ResourceList
     requirements: object
 
+    _LAZY = ("requirements", "instance_type_options")
+
     def __post_init__(self):
-        if callable(self.requirements):
-            # deleting the instance attribute routes the next access through
-            # __getattr__ (no per-access interception for other fields); the
-            # thunk is dropped after materialization so machines held across
-            # reconcile loops don't pin the snapshot/state arrays
-            object.__setattr__(self, "_req_thunk", self.requirements)
-            object.__delattr__(self, "requirements")
+        for field_name in self._LAZY:
+            value = getattr(self, field_name)
+            if callable(value):
+                # deleting the instance attribute routes the next access
+                # through __getattr__ (no per-access interception otherwise)
+                object.__setattr__(self, f"_{field_name}_thunk", value)
+                object.__delattr__(self, field_name)
 
     def __getattr__(self, name):
-        if name == "requirements":
-            thunk = self.__dict__.pop("_req_thunk", None)
+        if name in type(self)._LAZY:
+            thunk = self.__dict__.pop(f"_{name}_thunk", None)
             if thunk is not None:
-                object.__setattr__(self, "requirements", thunk())
-                return self.__dict__["requirements"]
+                object.__setattr__(self, name, thunk())
+                return self.__dict__[name]
         raise AttributeError(name)
 
 
@@ -539,15 +542,53 @@ class TPUSolver:
 
     def _run_kernels(self, snap: EncodedSnapshot, provisioners: List[Provisioner]):
         import jax
+        import jax.numpy as jnp
 
         geom, run = build_device_solve(snap, self.max_nodes, backend=self.backend)
-        fn = self._compiled.get((geom, self.backend))
-        if fn is None:
-            # inputs are fresh numpy per solve, so donation invalidates
-            # nothing on the host
-            fn = jax.jit(run, donate_argnums=DONATE_ARGNUMS if self.donate else ())
-            self._compiled[(geom, self.backend)] = fn
         args = device_args(snap, provisioners)
+        # upload shrinkage: large bool planes bit-pack on the host and
+        # unpack INSIDE the jitted program — ~8x fewer bytes over a link
+        # that runs tens of MB/s. The packing spec joins the compile key;
+        # donation is skipped on this path (leaf positions are flattened).
+        leaves, treedef = jax.tree_util.tree_flatten(args)
+        spec = tuple(
+            a.shape[-1]
+            if (a.dtype == np.bool_ and a.ndim >= 1 and a.size > 4096)
+            else None
+            for a in leaves
+        )
+        packed = [
+            np.packbits(a, axis=-1) if w is not None else a
+            for a, w in zip(leaves, spec)
+        ]
+        key = (geom, self.backend, spec, treedef)
+        fn = self._compiled.get(key)
+        if fn is None:
+            def run_packed(*pleaves):
+                unpacked = [
+                    jnp.unpackbits(l, axis=-1, count=w).astype(bool)
+                    if w is not None
+                    else l
+                    for l, w in zip(pleaves, spec)
+                ]
+                return run(*jax.tree_util.tree_unflatten(treedef, unpacked))
+
+            # donation survives flattening: map the donated named args to
+            # their flat leaf positions (they're float32, never packed, so
+            # shapes still alias into the scan carry)
+            donate_idx: List[int] = []
+            off = 0
+            for name, arg in zip(RUN_ARG_NAMES, args):
+                n_leaves = len(jax.tree_util.tree_leaves(arg))
+                if name in ("remaining0", "topo_counts0", "topo_hcounts0",
+                            "topo_doms0"):
+                    donate_idx.extend(range(off, off + n_leaves))
+                off += n_leaves
+            fn = jax.jit(
+                run_packed,
+                donate_argnums=tuple(donate_idx) if self.donate else (),
+            )
+            self._compiled[key] = fn
         # opt-in device profiling around the Solve dispatch — the analog of
         # the reference's pprof-profiled benchmark capture
         # (scheduling_benchmark_test.go:84-95); view with tensorboard or
@@ -557,7 +598,7 @@ class TPUSolver:
         # one batched transfer for the whole arg tree: the TPU link (axon
         # tunnel especially) charges per-transfer latency, so ~40 implicit
         # per-leaf uploads cost seconds where one device_put costs ~0.1s
-        args = jax.device_put(args)
+        args = jax.device_put(packed)
         import time as _time
 
         t_dispatch = _time.perf_counter()
@@ -587,8 +628,6 @@ class TPUSolver:
 
         # bool planes bit-pack on device (8x fewer bytes over the ~10MB/s
         # tunnel); unpacked to the original width host-side
-        import jax.numpy as jnp
-
         bool_fields = ("tmask", "allow", "out", "defined")
         widths = {f: getattr(state, f).shape[1] for f in bool_fields}
         sliced = (
@@ -706,16 +745,19 @@ def decode_solve(snap: EncodedSnapshot, placements, state) -> SolveResult:
             continue
         tmpl_id = int(state.tmpl[slot])
         template = snap.templates[tmpl_id]
-        tmask = np.asarray(state.tmask[slot])
-        options = [snap.instance_types[t] for t in np.nonzero(tmask)[0]]
         requests = dict(zip(snap.resource_names, np.asarray(state.used[slot]).tolist()))
         requests = {k: v for k, v in requests.items() if v}
+
+        def options_thunk(slot=slot):
+            tmask = np.asarray(state.tmask[slot])
+            return [snap.instance_types[t] for t in np.nonzero(tmask)[0]]
+
         machines.append(
             SolvedMachine(
                 provisioner_name=template.provisioner_name,
                 template=template,
                 pods=pods,
-                instance_type_options=options,
+                instance_type_options=options_thunk,
                 requests=requests,
                 requirements=partial(slot_requirements, snap, state, slot),
             )
