@@ -61,6 +61,24 @@ def bucket_pow2(n: int, lo: int) -> int:
     return b
 
 
+def _ids(lst):
+    return tuple(map(id, lst))
+
+
+def _aff_duo(x):
+    # identity of the LEAF term objects: producers share them across a
+    # deployment's pods even when each pod gets fresh wrapper objects
+    return None if x is None else (_ids(x.required), _ids(x.preferred))
+
+
+def _aff_key(a):
+    return (
+        _aff_duo(a.node_affinity),
+        _aff_duo(a.pod_affinity),
+        _aff_duo(a.pod_anti_affinity),
+    )
+
+
 def _pod_spec_signature(p: Pod, _repr_memo: Optional[Dict[int, str]] = None) -> Tuple:
     """Content key for pod spec-equivalence: covers exactly what the encoder
     derives per pod — namespace+labels (topology selection/ownership),
@@ -72,18 +90,8 @@ def _pod_spec_signature(p: Pod, _repr_memo: Optional[Dict[int, str]] = None) -> 
 
     _repr_memo (id -> repr) dedups the recursive reprs when producers share
     constraint objects across pods (deployment-expanded batches do) — at 50k
-    pods the reprs otherwise dominate encode time."""
-
-    def _ids(lst):
-        return tuple(map(id, lst))
-
-    def _aff_key(a):
-        # identity of the LEAF term objects: producers share them across a
-        # deployment's pods even when each pod gets fresh wrapper objects
-        def duo(x):
-            return None if x is None else (_ids(x.required), _ids(x.preferred))
-
-        return (duo(a.node_affinity), duo(a.pod_affinity), duo(a.pod_anti_affinity))
+    pods the reprs otherwise dominate encode time. Helpers live at module
+    scope: defining them per call costs ~1.5us x 50k pods."""
 
     def _r(obj, key):
         if _repr_memo is None:
@@ -256,10 +264,15 @@ class EncodedSnapshot:
     dictionary: LabelDictionary
     resource_names: List[str]
 
-    # pods
-    pod_reqs: ReqSetArrays  # [P, ...]
-    pod_requests: np.ndarray  # [P, R] float32 (incl. pods=1)
-    pod_tol: np.ndarray  # [P, J] bool — tolerates template j's taints
+    # pods — stored at CLASS level ([U] spec-equivalence classes) with the
+    # per-pod gather map `uidx` [P]; the [P, ...] views below are lazy
+    # cached properties. The device path reads only item-representative
+    # rows, so materializing 50k-row arrays to feed a ~1k-row gather cost
+    # ~0.3s of encode time per solve (measured at the north-star config).
+    pod_reqs_u: ReqSetArrays  # [U, ...]
+    pod_requests_u: np.ndarray  # [U, R] float32 (incl. pods=1)
+    pod_tol_u: np.ndarray  # [U, J] bool — tolerates template j's taints
+    uidx: np.ndarray  # [P] int32 class of sorted pod i
 
     # templates (one per provisioner, weight-ordered)
     tmpl_reqs: ReqSetArrays  # [J, ...]
@@ -283,17 +296,20 @@ class EncodedSnapshot:
     exist_reqs: ReqSetArrays = None  # [E, ...] label requirements
     exist_used: np.ndarray = None  # [E, R] remaining daemon overhead
     exist_cap: np.ndarray = None  # [E, R] available()
-    pod_tol_exist: np.ndarray = None  # [P, E]
+    # pod x existing toleration, factored (class, taint-signature): column
+    # S is the all-False sentinel for bucket-pad slots
+    tol_exist_us: np.ndarray = None  # [U, S+1] bool
+    sig_of_node: np.ndarray = None  # [E_pad] int64 -> signature (S = pad)
 
     # host ports (Q distinct (ip, port, proto) entries; 0 when none in batch)
     # and CSI volumes (W distinct claims, D drivers; existing-slot only —
     # the reference enforces volume limits only in ExistingNode.Add,
     # existingnode.go:62-115, while ports apply to machines too,
     # machine.go:69)
-    pod_ports: np.ndarray = None  # [P, Q] entries a pod OCCUPIES
-    pod_port_conflict: np.ndarray = None  # [P, Q] entries it CONFLICTS with
+    pod_ports_u: np.ndarray = None  # [U, Q] entries a pod OCCUPIES
+    pod_port_conflict_u: np.ndarray = None  # [U, Q] entries it CONFLICTS with
     exist_ports: np.ndarray = None  # [E_pad, Q]
-    pod_vols: np.ndarray = None  # [P, W]
+    pod_vols_u: np.ndarray = None  # [U, W]
     exist_vols: np.ndarray = None  # [E_pad, W] already-mounted claims
     exist_vol_limits: np.ndarray = None  # [E_pad, D] (inf = unlimited)
     vol_driver_onehot: np.ndarray = None  # [W, D]
@@ -321,6 +337,66 @@ class EncodedSnapshot:
     pods: List[Pod] = field(default_factory=list)
     state_nodes: List = field(default_factory=list)
     pod_order: np.ndarray = None  # FFD order applied to pod axis
+
+    # -- lazy [P, ...] views (native packer / host consumers only) ---------
+
+    def _gather(self, name: str, arr_u: np.ndarray) -> np.ndarray:
+        cache = self.__dict__.setdefault("_pod_view_cache", {})
+        got = cache.get(name)
+        if got is None:
+            got = cache[name] = (
+                arr_u[self.uidx]
+                if len(self.pods)
+                else np.zeros((0,) + arr_u.shape[1:], dtype=arr_u.dtype)
+            )
+        return got
+
+    @property
+    def pod_reqs(self) -> ReqSetArrays:
+        cache = self.__dict__.setdefault("_pod_view_cache", {})
+        got = cache.get("pod_reqs")
+        if got is None:
+            u = self.pod_reqs_u
+            idx = self.uidx
+            got = cache["pod_reqs"] = ReqSetArrays(
+                allow=u.allow[idx],
+                out=u.out[idx],
+                defined=u.defined[idx],
+                escape=u.escape[idx],
+            )
+        return got
+
+    @property
+    def pod_requests(self) -> np.ndarray:
+        return self._gather("pod_requests", self.pod_requests_u)
+
+    @property
+    def pod_tol(self) -> np.ndarray:
+        return self._gather("pod_tol", self.pod_tol_u)
+
+    @property
+    def pod_tol_exist(self) -> np.ndarray:
+        cache = self.__dict__.setdefault("_pod_view_cache", {})
+        got = cache.get("pod_tol_exist")
+        if got is None:
+            got = cache["pod_tol_exist"] = (
+                self.tol_exist_us[self.uidx[:, None], self.sig_of_node[None, :]]
+                if len(self.pods)
+                else np.zeros((0, len(self.sig_of_node)), dtype=bool)
+            )
+        return got
+
+    @property
+    def pod_ports(self) -> np.ndarray:
+        return self._gather("pod_ports", self.pod_ports_u)
+
+    @property
+    def pod_port_conflict(self) -> np.ndarray:
+        return self._gather("pod_port_conflict", self.pod_port_conflict_u)
+
+    @property
+    def pod_vols(self) -> np.ndarray:
+        return self._gather("pod_vols", self.pod_vols_u)
 
 
 def encode_snapshot(
@@ -496,7 +572,6 @@ def encode_snapshot(
         if U
         else np.zeros((0, R), np.float32)
     )
-    pod_requests = pod_requests_u[uidx] if P else np.zeros((0, R), np.float32)
 
     # daemon overhead per template (scheduler.go:253-270)
     tmpl_daemon = np.zeros((J, R), dtype=np.float32)
@@ -549,7 +624,6 @@ def encode_snapshot(
     for j, template in enumerate(templates):
         for u, p in enumerate(uniq_pods):
             pod_tol_u[u, j] = taints_mod.tolerates(template.taints, p) is None
-    pod_tol = pod_tol_u[uidx] if P else np.zeros((0, J), dtype=bool)
 
     well_known = np.array(
         [k in api_labels.WELL_KNOWN_LABELS or k == LABEL_HOSTNAME for k in dictionary.keys],
@@ -568,11 +642,16 @@ def encode_snapshot(
     exist_used = np.zeros((E_pad, R), dtype=np.float32)
     exist_cap = np.full((E_pad, R), -1.0, dtype=np.float32)
     exist_cap[:E] = 0.0
-    pod_tol_exist = np.zeros((P, E_pad), dtype=bool)
     exist_reqs_list = exist_reqs_list + [
         Requirements() for _ in range(E_pad - E_real)
     ]
-    taint_sig_cols: Dict[Tuple, np.ndarray] = {}
+    # tolerations evaluate once per (spec class, taint signature), then ONE
+    # two-axis numpy gather builds [P, E_pad] — per-column writes cost ~0.6s
+    # of host time at 50k x 1k (measured), the gather ~0.1s. Signature index
+    # S is the sentinel all-False row for the pad slots.
+    taint_sig_ids: Dict[Tuple, int] = {}
+    tol_rows_u: List[np.ndarray] = []
+    sig_of_node = np.empty(E_pad, dtype=np.int64)
     for e, node in enumerate(state_nodes):
         node_taints = node.taints()
         # daemons that would schedule to this node (scheduler.go:231-240)
@@ -591,16 +670,22 @@ def encode_snapshot(
         sig = tuple(
             sorted((t.key, t.value, t.effect) for t in node_taints)
         )
-        col = taint_sig_cols.get(sig)
-        if col is None:
-            col_u = np.fromiter(
-                (taints_mod.tolerates(node_taints, p) is None for p in uniq_pods),
-                dtype=bool,
-                count=U,
+        s = taint_sig_ids.get(sig)
+        if s is None:
+            s = taint_sig_ids[sig] = len(tol_rows_u)
+            tol_rows_u.append(
+                np.fromiter(
+                    (taints_mod.tolerates(node_taints, p) is None for p in uniq_pods),
+                    dtype=bool,
+                    count=U,
+                )
             )
-            col = col_u[uidx] if P else np.zeros(0, dtype=bool)
-            taint_sig_cols[sig] = col
-        pod_tol_exist[:, e] = col
+        sig_of_node[e] = s
+    S = len(tol_rows_u)
+    sig_of_node[E_real:] = S
+    tol_exist_us = np.zeros((U, S + 1), dtype=bool)  # [:, S] all-False (pad)
+    if S:
+        tol_exist_us[:, :S] = np.stack(tol_rows_u, axis=1)
 
     # -- host ports + CSI volumes -----------------------------------------
     # lowered only when the batch/cluster actually uses them (Q = W = 0 is
@@ -706,14 +791,8 @@ def encode_snapshot(
         uniq_pods=uniq_pods,
     )
 
-    # -- pod requirement rows: encode per class, gather --------------------
+    # -- pod requirement rows: encoded per class; [P] views are lazy -------
     pod_reqs_u_arr = encode_reqsets(pod_reqs_u, dictionary)
-    pod_reqs_arr = ReqSetArrays(
-        allow=pod_reqs_u_arr.allow[uidx],
-        out=pod_reqs_u_arr.out[uidx],
-        defined=pod_reqs_u_arr.defined[uidx],
-        escape=pod_reqs_u_arr.escape[uidx],
-    )
 
     # -- pod equivalence classes (items) -----------------------------------
     item_of_pod, item_counts, item_rep, item_members = _build_items(
@@ -726,9 +805,10 @@ def encode_snapshot(
     return EncodedSnapshot(
         dictionary=dictionary,
         resource_names=resource_names,
-        pod_reqs=pod_reqs_arr,
-        pod_requests=pod_requests,
-        pod_tol=pod_tol,
+        pod_reqs_u=pod_reqs_u_arr,
+        pod_requests_u=pod_requests_u,
+        pod_tol_u=pod_tol_u,
+        uidx=uidx,
         tmpl_reqs=encode_reqsets(tmpl_reqs_list, dictionary),
         tmpl_daemon=tmpl_daemon,
         tmpl_type_mask=tmpl_type_mask,
@@ -744,11 +824,12 @@ def encode_snapshot(
         exist_reqs=encode_reqsets(exist_reqs_list, dictionary),
         exist_used=exist_used,
         exist_cap=exist_cap,
-        pod_tol_exist=pod_tol_exist,
-        pod_ports=pod_ports_u[uidx] if P else np.zeros((0, Q), bool),
-        pod_port_conflict=pod_port_conflict_u[uidx] if P else np.zeros((0, Q), bool),
+        tol_exist_us=tol_exist_us,
+        sig_of_node=sig_of_node,
+        pod_ports_u=pod_ports_u,
+        pod_port_conflict_u=pod_port_conflict_u,
         exist_ports=exist_ports,
-        pod_vols=pod_vols_u[uidx] if P else np.zeros((0, W), bool),
+        pod_vols_u=pod_vols_u,
         exist_vols=exist_vols,
         exist_vol_limits=exist_vol_limits,
         vol_driver_onehot=vol_driver_onehot,

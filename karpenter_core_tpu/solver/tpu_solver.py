@@ -54,6 +54,9 @@ class SolvedMachine:
     _LAZY = ("requirements", "instance_type_options")
 
     def __post_init__(self):
+        import threading
+
+        object.__setattr__(self, "_lazy_lock", threading.Lock())
         for field_name in self._LAZY:
             value = getattr(self, field_name)
             if callable(value):
@@ -63,11 +66,16 @@ class SolvedMachine:
                 object.__delattr__(self, field_name)
 
     def __getattr__(self, name):
+        # locked: concurrent readers (launch fan-out threads, scrapers) must
+        # not race the thunk pop — the loser would see AttributeError
         if name in type(self)._LAZY:
-            thunk = self.__dict__.pop(f"_{name}_thunk", None)
-            if thunk is not None:
-                object.__setattr__(self, name, thunk())
-                return self.__dict__[name]
+            with self.__dict__["_lazy_lock"]:
+                if name in self.__dict__:
+                    return self.__dict__[name]
+                thunk = self.__dict__.pop(f"_{name}_thunk", None)
+                if thunk is not None:
+                    object.__setattr__(self, name, thunk())
+                    return self.__dict__[name]
         raise AttributeError(name)
 
 
@@ -203,8 +211,8 @@ def solve_geometry(snap: EncodedSnapshot, max_nodes: int):
     while log_len < len(snap.pods) + 64:
         log_len *= 2
     # host-port / volume axes (0 in the common no-port/no-volume batch)
-    Q = snap.pod_ports.shape[1] if snap.pod_ports is not None else 0
-    W = snap.pod_vols.shape[1] if snap.pod_vols is not None else 0
+    Q = snap.pod_ports_u.shape[1] if snap.pod_ports_u is not None else 0
+    W = snap.pod_vols_u.shape[1] if snap.pod_vols_u is not None else 0
     D = snap.exist_vol_limits.shape[1] if snap.exist_vol_limits is not None else 0
     return (
         P, J, T, E, R, K, V, N, tuple(segments), snap.zone_seg, snap.ct_seg,
@@ -371,15 +379,20 @@ def device_args(snap: EncodedSnapshot, provisioners: Optional[List[Provisioner]]
         else np.ones(len(snap.pods), dtype=np.int32)
     )
     I = len(rep)
-    custom_deny = ~snap.well_known[None, :] & snap.pod_reqs.defined & ~snap.pod_reqs.escape
+    # gather item rows straight from the CLASS-level arrays ([U, ...]) —
+    # going through the lazy [P, ...] views would materialize 50k rows to
+    # read ~1k (the r03 encode-time fix)
+    cls = snap.uidx[rep] if len(snap.pods) else rep
+    u = snap.pod_reqs_u
+    custom_deny_u = ~snap.well_known[None, :] & u.defined & ~u.escape
     pod_arrays = {
-        "allow": snap.pod_reqs.allow[rep],
-        "out": snap.pod_reqs.out[rep],
-        "defined": snap.pod_reqs.defined[rep],
-        "escape": snap.pod_reqs.escape[rep],
-        "custom_deny": custom_deny[rep],
-        "requests": snap.pod_requests[rep],
-        "tol_tmpl": snap.pod_tol[rep],
+        "allow": u.allow[cls],
+        "out": u.out[cls],
+        "defined": u.defined[cls],
+        "escape": u.escape[cls],
+        "custom_deny": custom_deny_u[cls],
+        "requests": snap.pod_requests_u[cls],
+        "tol_tmpl": snap.pod_tol_u[cls],
         "valid": np.ones(I, dtype=bool),
         "count": counts.astype(np.int32),
     }
@@ -387,10 +400,13 @@ def device_args(snap: EncodedSnapshot, provisioners: Optional[List[Provisioner]]
         pod_arrays["topo_own"] = snap.topo_arrays.owner.T[rep].copy()  # [I, G]
         pod_arrays["topo_sel"] = snap.topo_arrays.sel.T[rep].copy()
     # host-port / volume rows ride the item axis (zero-width when unused)
-    pod_arrays["ports"] = snap.pod_ports[rep]
-    pod_arrays["port_conflict"] = snap.pod_port_conflict[rep]
-    pod_arrays["vols"] = snap.pod_vols[rep]
-    pod_tol_all = np.concatenate([snap.pod_tol, snap.pod_tol_exist], axis=1)[rep]
+    pod_arrays["ports"] = snap.pod_ports_u[cls]
+    pod_arrays["port_conflict"] = snap.pod_port_conflict_u[cls]
+    pod_arrays["vols"] = snap.pod_vols_u[cls]
+    pod_tol_all = np.concatenate(
+        [snap.pod_tol_u[cls], snap.tol_exist_us[cls[:, None], snap.sig_of_node[None, :]]],
+        axis=1,
+    )
 
     # pad the item axis to the bucketed geometry (valid=False, count=0 rows
     # never commit — the scan pays one cheap step each); must mirror
@@ -492,11 +508,15 @@ class TPUSolver:
 
     def __init__(self, max_nodes: int = 1024,
                  max_relax_rounds: int = DEFAULT_MAX_RELAX_ROUNDS,
-                 donate: bool = True, backend: Optional[str] = None):
+                 donate: bool = True, backend: Optional[str] = None,
+                 profile_phases: bool = False):
         self.max_nodes = max_nodes
         self.max_relax_rounds = max_relax_rounds
         self.donate = donate
         self.backend = backend  # kernel lowering override (compat.resolve_backend)
+        # opt-in: barrier after upload so last_phase_ms attributes transfer
+        # time separately (costs cold solves the serialized upload)
+        self.profile_phases = profile_phases
         self._compiled = {}
 
     # -- public API --------------------------------------------------------
@@ -541,11 +561,23 @@ class TPUSolver:
         return decode_solve(snap, (log, ptr), state)
 
     def _run_kernels(self, snap: EncodedSnapshot, provisioners: List[Provisioner]):
+        import time as _time
+
         import jax
         import jax.numpy as jnp
 
+        phases = self.last_phase_ms = {}
+        t_phase = _time.perf_counter()
+
+        def _mark(name):
+            nonlocal t_phase
+            now = _time.perf_counter()
+            phases[name] = round((now - t_phase) * 1e3, 1)
+            t_phase = now
+
         geom, run = build_device_solve(snap, self.max_nodes, backend=self.backend)
         args = device_args(snap, provisioners)
+        _mark("args")
         # upload shrinkage: large bool planes bit-pack on the host and
         # unpack INSIDE the jitted program — ~8x fewer bytes over a link
         # that runs tens of MB/s. The packing spec joins the compile key;
@@ -561,6 +593,7 @@ class TPUSolver:
             np.packbits(a, axis=-1) if w is not None else a
             for a, w in zip(leaves, spec)
         ]
+        _mark("pack")
         key = (geom, self.backend, spec, treedef)
         fn = self._compiled.get(key)
         if fn is None:
@@ -599,7 +632,12 @@ class TPUSolver:
         # tunnel especially) charges per-transfer latency, so ~40 implicit
         # per-leaf uploads cost seconds where one device_put costs ~0.1s
         args = jax.device_put(packed)
-        import time as _time
+        if self.profile_phases:
+            # barrier ONLY under opt-in phase profiling: it serializes the
+            # upload with jit trace/compile, costing cold solves the full
+            # transfer time for timing attribution
+            jax.block_until_ready(args)
+        _mark("upload")
 
         t_dispatch = _time.perf_counter()
         trace_dir = os.environ.get("KARPENTER_JAX_TRACE_DIR", "")
@@ -616,6 +654,7 @@ class TPUSolver:
         # dispatch -> first scalar readback ≈ device execution time for this
         # solve (observability: bench reports p99 of this across batches)
         self.last_device_ms = (_time.perf_counter() - t_dispatch) * 1e3
+        _mark("device")
         ptr_i, nopen, bulk_n = int(ptr_i), int(nopen), int(bulk_n)
         # slice lengths round UP to buckets: each distinct slice shape
         # compiles its own tiny device program, so exact lengths would pay
@@ -626,33 +665,99 @@ class TPUSolver:
         nopen_b = min(bucket_pow2(max(nopen, 1), 1024), state.tmpl.shape[0])
         bulk_b = min(bucket_pow2(max(bulk_n, 1), 1024), log["bulk_take"].shape[0])
 
-        # bool planes bit-pack on device (8x fewer bytes over the ~10MB/s
-        # tunnel); unpacked to the original width host-side
-        bool_fields = ("tmask", "allow", "out", "defined")
-        widths = {f: getattr(state, f).shape[1] for f in bool_fields}
+        # eager fetch = only what decode reads on the Solve critical path:
+        # the commit log + per-slot tmpl/used/pods. The launch-path planes
+        # (tmask/allow/out/defined — read by SolvedMachine.requirements()/
+        # instance_type_options(), i.e. after Solve returns) stay on device
+        # behind a one-shot lazy batched fetch: at 50k pods they are ~7MB on
+        # a tunnel that moves ~10MB/s, roughly half the warm fetch time.
+        # bulk_take rides as int16 when every pod capacity fits (counts are
+        # bounded by a slot's 'pods' allocatable), halving the largest leaf.
+        pods_idx = snap.resource_names.index("pods")
+        pods_cap_max = max(
+            float(snap.type_alloc[:, pods_idx].max()) if len(snap.type_alloc) else 0.0,
+            float(snap.exist_cap[:, pods_idx].max())
+            if snap.exist_cap is not None and snap.exist_cap.size
+            else 0.0,
+        )
+        bulk_dtype = jnp.int16 if pods_cap_max < 32767 else jnp.int32
         sliced = (
             {k: log[k][:ptr_b] for k in ("item", "slot", "ns", "k", "k_last")},
-            log["bulk_take"][:bulk_b],
+            log["bulk_take"][:bulk_b].astype(bulk_dtype),
             {
                 f: getattr(state, f)[:nopen_b]
                 for f in ("tmpl", "used", "pods")
             },
-            {
-                f: jnp.packbits(getattr(state, f)[:nopen_b], axis=-1)
-                for f in bool_fields
-            },
         )
+        # the lazy planes pack+slice ON DEVICE now (async dispatch) so only
+        # ~3MB of packed bits stay pinned, not the full state pytree
+        lazy_widths = {f: getattr(state, f).shape[1] for f in _SlotState._LAZY}
+        lazy_packed = {
+            f: jnp.packbits(getattr(state, f)[:nopen_b], axis=-1)
+            for f in _SlotState._LAZY
+        }
         # ONE batched device_get — per-transfer link latency dominates the
         # fetch when every leaf round-trips separately
-        log_h, bulk_take, state_d, packed = jax.device_get(sliced)
+        log_h, bulk_take, state_d = jax.device_get(sliced)
         log_h["bulk_take"] = bulk_take
         log_h["bulk_n"] = bulk_n
-        for f in bool_fields:
-            state_d[f] = np.unpackbits(packed[f], axis=-1)[:, : widths[f]].astype(bool)
-        from types import SimpleNamespace
-
-        state_h = SimpleNamespace(**state_d)
+        state_h = _SlotState(state_d, lazy_packed, lazy_widths)
+        _mark("fetch")
         return log_h, ptr_i, state_h
+
+class _SlotState:
+    """Host view of the final per-slot state. tmpl/used/pods are fetched
+    eagerly (decode reads them for every machine); the launch-path planes
+    (tmask, allow, out, defined) — read only by SolvedMachine.requirements()
+    / instance_type_options(), i.e. after Solve() returns — defer to ONE
+    batched device_get on first access. What stays pinned on device is only
+    the bit-packed [:nopen_b] slices (~a few MB), not the full state pytree;
+    the pack+slice ops are dispatched (async) before construction.
+
+    Thread-safe: machine launches fan out over a thread pool
+    (provisioner.py) and every machine's thunk shares this object."""
+
+    _LAZY = ("tmask", "allow", "out", "defined")
+
+    def __init__(self, eager: dict, packed_dev: dict, widths: dict):
+        import threading
+
+        self.__dict__.update(eager)
+        self.__dict__["_packed_dev"] = packed_dev
+        self.__dict__["_widths"] = widths
+        self.__dict__["_lock"] = threading.Lock()
+
+    def __getattr__(self, name):  # only called when not in __dict__
+        if name in type(self)._LAZY:
+            self._fetch_lazy()
+            return self.__dict__[name]
+        raise AttributeError(name)
+
+    def _fetch_lazy(self):
+        import jax
+
+        with self.__dict__["_lock"]:
+            if self._LAZY[0] in self.__dict__:  # another thread won the race
+                return
+            dev = self.__dict__.get("_packed_dev")
+            if dev is None:
+                raise RuntimeError(
+                    "slot planes were released before first access"
+                )
+            packed = jax.device_get(dev)  # may raise transiently: retryable
+            widths = self.__dict__["_widths"]
+            for f in self._LAZY:
+                self.__dict__[f] = (
+                    np.unpackbits(packed[f], axis=-1)[:, : widths[f]].astype(bool)
+                )
+            del self.__dict__["_packed_dev"]  # drop refs only on success
+
+    def release(self):
+        """Drop the device references without fetching (discarded result);
+        decode calls this when no machine will ever read the planes."""
+        with self.__dict__["_lock"]:
+            self.__dict__.pop("_packed_dev", None)
+
 
 def expand_log(snap: EncodedSnapshot, log, ptr: int,
                member_lo=None, member_hi=None) -> np.ndarray:
@@ -762,6 +867,8 @@ def decode_solve(snap: EncodedSnapshot, placements, state) -> SolveResult:
                 requirements=partial(slot_requirements, snap, state, slot),
             )
         )
+    if not machines and hasattr(state, "release"):
+        state.release()  # no thunk will ever read the lazy planes
     return SolveResult(
         new_machines=machines, existing_assignments=existing, failed_pods=failed
     )

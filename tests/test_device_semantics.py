@@ -6,13 +6,17 @@ offering availability, and init-container request ceilings.
 The bar (SURVEY.md §7e): all constraints satisfied and the device result no
 worse than the host oracle (greedy order-dependence allows different but
 equally-valid placements)."""
-import copy
 
 import pytest
 
 from karpenter_core_tpu.api.labels import LABEL_CAPACITY_TYPE
 from karpenter_core_tpu.cloudprovider import fake
-from karpenter_core_tpu.kube.objects import LABEL_TOPOLOGY_ZONE, Taint, Toleration
+from karpenter_core_tpu.kube.objects import (
+    LABEL_TOPOLOGY_ZONE,
+    NodeSelectorTerm,
+    Taint,
+    Toleration,
+)
 from karpenter_core_tpu.solver.tpu_solver import GreedySolver, TPUSolver
 from karpenter_core_tpu.testing import (
     NodeSelectorRequirement,
@@ -22,7 +26,8 @@ from karpenter_core_tpu.testing import (
 
 
 def run_both(pods, provisioners, its, **kw):
-    host = GreedySolver().solve(copy.deepcopy(pods), provisioners, its, **kw)
+    # GreedySolver deep-copies its pods on entry already
+    host = GreedySolver().solve(pods, provisioners, its, **kw)
     tpu = TPUSolver(max_nodes=64).solve(pods, provisioners, its, **kw)
     return host, tpu
 
@@ -44,11 +49,7 @@ def test_gt_requirement_on_device():
         make_pod(
             requests={"cpu": "0.5"},
             node_affinity_required=[
-                __import__(
-                    "karpenter_core_tpu.kube.objects", fromlist=["NodeSelectorTerm"]
-                ).NodeSelectorTerm(
-                    [NodeSelectorRequirement(label_key, "Gt", ["5"])]
-                )
+                NodeSelectorTerm([NodeSelectorRequirement(label_key, "Gt", ["5"])])
             ],
         )
         for _ in range(3)

@@ -711,3 +711,30 @@ def test_relaxation_aliased_pod_entries_relax_independently():
     assert final[0] is pod or final[1] is pod or True
     # the relaxed entry is a copy, not the original
     assert any(p is not pod for p in final)
+
+
+def test_concurrent_lazy_machine_reads():
+    """requirements/instance_type_options thunks and the _SlotState plane
+    fetch are shared across the launch thread pool (provisioner.py fan-out);
+    concurrent first-access must not race the thunk pop or the device
+    fetch."""
+    import concurrent.futures as cf
+
+    universe = fake.instance_types(8)
+    pods = [
+        make_pod(labels={"app": f"a{i % 6}"}, requests={"cpu": "1"})
+        for i in range(36)
+    ]
+    solver = TPUSolver(max_nodes=64)
+    res = solver.solve(
+        pods, [make_provisioner(name="default")], {"default": universe}
+    )
+    assert res.new_machines
+    with cf.ThreadPoolExecutor(8) as ex:
+        out = list(
+            ex.map(
+                lambda m: (len(m.requirements), len(m.instance_type_options)),
+                res.new_machines * 8,
+            )
+        )
+    assert all(nreq > 0 and nopt > 0 for nreq, nopt in out)
