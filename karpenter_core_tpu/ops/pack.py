@@ -350,25 +350,25 @@ def make_pack_kernel(
             }
             return log, ptr + jnp.where(w, 1, 0)
 
-        def step(carry, i):
-            # padded / empty items skip the whole step body (screens, probes,
-            # spread plans) through ONE cond — the item-axis bucket padding
-            # costs microseconds per padded row instead of a full screen
-            valid_i = item_arrays["valid"][i] & (item_arrays["count"][i] > 0)
-            return jax.lax.cond(valid_i, _step_body, lambda c, _i: c, carry, i), None
+        def step(carry, x):
+            # per-item rows arrive as scan xs (NOT manual indexing by the
+            # counter): xs slicing lets the TPU pipeliner double-buffer the
+            # row loads, where body-internal dynamic-slices serialized a
+            # ~170us alternate-memory copy per row per step (~340ms/solve at
+            # 1k items, measured). Padded / empty items skip the whole step
+            # body (screens, probes, spread plans) through ONE cond.
+            valid_i = x["valid"] & (x["count"] > 0)
+            return jax.lax.cond(valid_i, _step_body, lambda c, _x: c, carry, x), None
 
-        def _step_body(carry, i):
+        def _step_body(carry, x):
             state, log, ptr = carry
+            i = x["i"]
             prow = {
-                "allow": item_arrays["allow"][i],
-                "out": item_arrays["out"][i],
-                "defined": item_arrays["defined"][i],
-                "escape": item_arrays["escape"][i],
-                "custom_deny": item_arrays["custom_deny"][i],
-                "requests": item_arrays["requests"][i],
-                "ports": item_arrays["ports"][i],
-                "port_conflict": item_arrays["port_conflict"][i],
-                "vols": item_arrays["vols"][i],
+                k: x[k]
+                for k in (
+                    "allow", "out", "defined", "escape", "custom_deny",
+                    "requests", "ports", "port_conflict", "vols",
+                )
             }
             # a pod with host ports can never run two replicas on one node
             # (its own entries conflict, hostportusage.go:42-54)
@@ -376,13 +376,13 @@ def make_pack_kernel(
                 jnp.where(prow["ports"].any(), 1, BIGK) if Q else jnp.int32(BIGK)
             )
             if has_topo:
-                prow["topo_own"] = item_arrays["topo_own"][i]
-                prow["topo_sel"] = item_arrays["topo_sel"][i]
-            valid = item_arrays["valid"][i]
-            count = item_arrays["count"][i]
+                prow["topo_own"] = x["topo_own"]
+                prow["topo_sel"] = x["topo_sel"]
+            valid = x["valid"]
+            count = x["count"]
 
             # -- screen (once per item) -----------------------------------
-            tol = item_arrays["tol"][i][state.tol_idx]  # [N]
+            tol = x["tol"][state.tol_idx]  # [N]
             fit_screen = compat.fits(state.used + prow["requests"][None, :], state.cap)
             req_screen = slot_compat_screen(state, prow)
             screen = state.open & tol & fit_screen & req_screen
@@ -414,7 +414,8 @@ def make_pack_kernel(
             )
             score0 = jnp.where(screen, score0, BIG)
 
-            f_static_p = f_static[:, i, :]  # [J, T]
+            f_static_p = x["f_static"]  # [J, T]
+            openable_p = x["openable"]  # [J]
 
             owns_vk_spread0 = jnp.bool_(False)
             for g, _gm in vk_spread_gs:
@@ -461,7 +462,7 @@ def make_pack_kernel(
                         )
                         type_dom = type_dom & type_zone_ok
                     dom_open |= (
-                        openable[j, i]
+                        openable_p[j]
                         & tmpl_reqs["allow"][j, lo:hi]
                         & (f_j[:, None] & type_dom).any(axis=0)
                     )
@@ -618,30 +619,40 @@ def make_pack_kernel(
                 )
                 onehot = jnp.arange(N) == n
 
-                def apply(state):
-                    st = state._replace(
-                        used=state.used.at[n].set(new_used),
-                        pods=state.pods.at[n].add(k),
-                        allow=state.allow.at[n].set(m_allow),
-                        out=state.out.at[n].set(m_out),
-                        defined=state.defined.at[n].set(m_defined),
-                        tmask=state.tmask.at[n].set(new_tmask),
-                        cap=state.cap.at[n].set(new_cap),
-                    )
-                    if Q:
-                        st = st._replace(
-                            ports=st.ports.at[n].set(st.ports[n] | prow["ports"])
-                        )
-                    if W:
-                        ne = jnp.minimum(n, EV - 1)
-                        nv = jnp.where(n < EV, st.vols[ne] | prow["vols"], st.vols[ne])
-                        st = st._replace(vols=st.vols.at[ne].set(nv))
-                    return record_topo(
-                        st, prow, m_allow, m_out, m_defined, well_known, topo_terms,
-                        onehot, jnp.where(onehot, k, 0),
-                    )
+                # commit UNCONDITIONALLY with predicated row values: a
+                # lax.cond(do, apply, id) here made XLA copy the whole
+                # [N, V]/[N, T] planes on every taken branch to unify branch
+                # buffers (~80ms/solve at 50k); a no-op row write aliases
+                def row(new, old):
+                    return jnp.where(do, new, old)
 
-                state = jax.lax.cond(do, apply, lambda s: s, state)
+                state = state._replace(
+                    used=state.used.at[n].set(row(new_used, state.used[n])),
+                    pods=state.pods.at[n].add(jnp.where(do, k, 0)),
+                    allow=state.allow.at[n].set(row(m_allow, state.allow[n])),
+                    out=state.out.at[n].set(row(m_out, state.out[n])),
+                    defined=state.defined.at[n].set(row(m_defined, state.defined[n])),
+                    tmask=state.tmask.at[n].set(row(new_tmask, state.tmask[n])),
+                    cap=state.cap.at[n].set(row(new_cap, state.cap[n])),
+                )
+                if Q:
+                    state = state._replace(
+                        ports=state.ports.at[n].set(
+                            row(state.ports[n] | prow["ports"], state.ports[n])
+                        )
+                    )
+                if W:
+                    ne = jnp.minimum(n, EV - 1)
+                    nv = jnp.where(
+                        do & (n < EV), state.vols[ne] | prow["vols"], state.vols[ne]
+                    )
+                    state = state._replace(vols=state.vols.at[ne].set(nv))
+                # record_topo is a strict no-op when the masked k_row is all
+                # zero (topo_record gates domain registration on placement)
+                state = record_topo(
+                    state, prow, m_allow, m_out, m_defined, well_known, topo_terms,
+                    onehot & do, jnp.where(onehot & do, k, 0),
+                )
                 log, ptr = log_write(log, ptr, do, i, n, 1, k, k)
                 remaining = remaining - jnp.where(do, k, 0)
                 # retire the slot on failure or when filled to capacity; a
@@ -733,62 +744,62 @@ def make_pack_kernel(
                 m_def_rows = (
                     state.defined[:EB] | prow["defined"][None, :] | applied_keys[None, :]
                 )
+                # unconditional commit with do-predicated takes (see
+                # do_candidate: a state-carrying lax.cond copies the planes)
+                take = jnp.where(do, take, 0)
                 touched = take > 0
-
-                def apply(state):
-                    tm = touched[:, None]
-                    st = state._replace(
-                        used=state.used.at[:EB].set(
-                            state.used[:EB]
-                            + take[:, None].astype(jnp.float32)
-                            * prow["requests"][None, :]
-                        ),
-                        pods=state.pods.at[:EB].add(take),
-                        allow=state.allow.at[:EB].set(
-                            jnp.where(tm, m_allow_rows, sa)
-                        ),
-                        out=state.out.at[:EB].set(
-                            jnp.where(tm, m_out_rows, state.out[:EB])
-                        ),
-                        defined=state.defined.at[:EB].set(
-                            jnp.where(tm, m_def_rows, state.defined[:EB])
-                        ),
+                tm = touched[:, None]
+                state = state._replace(
+                    used=state.used.at[:EB].set(
+                        state.used[:EB]
+                        + take[:, None].astype(jnp.float32)
+                        * prow["requests"][None, :]
+                    ),
+                    pods=state.pods.at[:EB].add(take),
+                    allow=state.allow.at[:EB].set(
+                        jnp.where(tm, m_allow_rows, sa)
+                    ),
+                    out=state.out.at[:EB].set(
+                        jnp.where(tm, m_out_rows, state.out[:EB])
+                    ),
+                    defined=state.defined.at[:EB].set(
+                        jnp.where(tm, m_def_rows, state.defined[:EB])
+                    ),
+                )
+                if Q:
+                    state = state._replace(
+                        ports=state.ports.at[:EB].set(
+                            jnp.where(
+                                tm, state.ports[:EB] | prow["ports"][None, :],
+                                state.ports[:EB],
+                            )
+                        )
                     )
-                    if Q:
-                        st = st._replace(
-                            ports=st.ports.at[:EB].set(
-                                jnp.where(
-                                    tm, st.ports[:EB] | prow["ports"][None, :],
-                                    st.ports[:EB],
-                                )
+                if W:
+                    state = state._replace(
+                        vols=state.vols.at[:EB].set(
+                            jnp.where(
+                                tm, state.vols[:EB] | prow["vols"][None, :],
+                                state.vols[:EB],
                             )
                         )
-                    if W:
-                        st = st._replace(
-                            vols=st.vols.at[:EB].set(
-                                jnp.where(
-                                    tm, st.vols[:EB] | prow["vols"][None, :],
-                                    st.vols[:EB],
-                                )
-                            )
+                    )
+                if has_topo:
+                    # topo_record_bulk is a strict no-op at take==0; the cond
+                    # carries only the small count tensors
+                    def rec(args):
+                        tc, th, td = topo.topo_record_bulk(
+                            topo_meta, *args,
+                            prow["topo_own"], prow["topo_sel"],
+                            m_allow_rows, m_out_rows, take,
                         )
-                    if has_topo:
-                        def rec(args):
-                            tc, th, td = topo.topo_record_bulk(
-                                topo_meta, *args,
-                                prow["topo_own"], prow["topo_sel"],
-                                m_allow_rows, m_out_rows, take,
-                            )
-                            return tc, th, td
+                        return tc, th, td
 
-                        tcounts, thost, tdoms = jax.lax.cond(
-                            any_topo, rec, lambda a: a,
-                            (st.tcounts, st.thost, st.tdoms),
-                        )
-                        st = st._replace(tcounts=tcounts, thost=thost, tdoms=tdoms)
-                    return st
-
-                state = jax.lax.cond(do, apply, lambda s: s, state)
+                    tcounts, thost, tdoms = jax.lax.cond(
+                        any_topo, rec, lambda a: a,
+                        (state.tcounts, state.thost, state.tdoms),
+                    )
+                    state = state._replace(tcounts=tcounts, thost=thost, tdoms=tdoms)
                 if log_commits:
                     bslot = jnp.minimum(bn, LB - 1)
                     log = {
@@ -868,7 +879,7 @@ def make_pack_kernel(
                     compats.append(compat_j)
                     kcaps.append(kcap_j)
                     ktopos.append(k_topo_j)
-                can_open_j = jnp.stack(viab) & openable[:, i]  # [J]
+                can_open_j = jnp.stack(viab) & openable_p  # [J]
                 jc = jnp.argmax(can_open_j)
                 m_allow_o = jnp.stack(allows)[jc]
                 m_out_o = jnp.stack(outs)[jc]
@@ -934,43 +945,44 @@ def make_pack_kernel(
                     + k_row[:, None].astype(jnp.float32) * prow["requests"][None, :]
                 )
 
-                def apply(state):
-                    rm = rows[:, None]
-                    lastm = (rows & last)[:, None]
-                    st = state._replace(
-                        used=jnp.where(rm, used_rows, state.used),
-                        open=state.open | rows,
-                        is_existing=state.is_existing & ~rows,
-                        tmpl=jnp.where(rows, jc.astype(jnp.int32), state.tmpl),
-                        tol_idx=jnp.where(rows, jc.astype(jnp.int32), state.tol_idx),
-                        pods=jnp.where(rows, k_row, state.pods),
-                        allow=jnp.where(rm, m_allow_o[None, :], state.allow),
-                        out=jnp.where(rm, m_out_o[None, :], state.out),
-                        defined=jnp.where(rm, m_def_o[None, :], state.defined),
-                        tmask=jnp.where(
-                            lastm, tmask_last[None, :],
-                            jnp.where(rm, tmask_full[None, :], state.tmask),
-                        ),
-                        cap=jnp.where(
-                            lastm, cap_last[None, :],
-                            jnp.where(rm, cap_full[None, :], state.cap),
-                        ),
-                        nopen=state.nopen + s,
-                        remaining=state.remaining
-                        - (jnp.arange(J) == jc)[:, None]
-                        * s.astype(jnp.float32)
-                        * max_cap[None, :],
+                # unconditional commit: `can=False` already forces s=0, so
+                # `rows` is empty and every write below is the identity —
+                # the former lax.cond(can, apply, id) cost a full-plane copy
+                # per taken branch for buffer unification (see do_candidate)
+                rm = rows[:, None]
+                lastm = (rows & last)[:, None]
+                state = state._replace(
+                    used=jnp.where(rm, used_rows, state.used),
+                    open=state.open | rows,
+                    is_existing=state.is_existing & ~rows,
+                    tmpl=jnp.where(rows, jc.astype(jnp.int32), state.tmpl),
+                    tol_idx=jnp.where(rows, jc.astype(jnp.int32), state.tol_idx),
+                    pods=jnp.where(rows, k_row, state.pods),
+                    allow=jnp.where(rm, m_allow_o[None, :], state.allow),
+                    out=jnp.where(rm, m_out_o[None, :], state.out),
+                    defined=jnp.where(rm, m_def_o[None, :], state.defined),
+                    tmask=jnp.where(
+                        lastm, tmask_last[None, :],
+                        jnp.where(rm, tmask_full[None, :], state.tmask),
+                    ),
+                    cap=jnp.where(
+                        lastm, cap_last[None, :],
+                        jnp.where(rm, cap_full[None, :], state.cap),
+                    ),
+                    nopen=state.nopen + s,
+                    remaining=state.remaining
+                    - (jnp.arange(J) == jc)[:, None]
+                    * s.astype(jnp.float32)
+                    * max_cap[None, :],
+                )
+                if Q:
+                    state = state._replace(
+                        ports=jnp.where(rm, prow["ports"][None, :], state.ports)
                     )
-                    if Q:
-                        st = st._replace(
-                            ports=jnp.where(rm, prow["ports"][None, :], st.ports)
-                        )
-                    return record_topo(
-                        st, prow, m_allow_o, m_out_o, m_def_o, well_known, topo_terms,
-                        rows, k_row,
-                    )
-
-                state = jax.lax.cond(can, apply, lambda st: st, state)
+                state = record_topo(
+                    state, prow, m_allow_o, m_out_o, m_def_o, well_known, topo_terms,
+                    rows, k_row,
+                )
                 log, ptr = log_write(log, ptr, can, i, state.nopen - s, s, m_eff, k_last)
                 remaining = remaining - jnp.where(can, placed, 0)
                 # freshly opened slots become candidates for this item's later
@@ -1076,8 +1088,14 @@ def make_pack_kernel(
             )
             return (state, log, ptr)
 
+        xs = dict(
+            item_arrays,
+            i=jnp.arange(I, dtype=jnp.int32),
+            f_static=jnp.moveaxis(f_static, 1, 0),  # [I, J, T]
+            openable=openable.T,  # [I, J]
+        )
         (state, log, ptr), _ = jax.lax.scan(
-            step, (state, log0, jnp.int32(0)), jnp.arange(I, dtype=jnp.int32)
+            step, (state, log0, jnp.int32(0)), xs
         )
         return state, log, ptr
 

@@ -11,9 +11,10 @@ makes ONE pass: the allow tile is read into VMEM once, all three MXU
 contractions and the per-key boolean algebra run fused, and only the
 final per-key verdict leaves the core.
 
-Selection lives in compat.resolve_backend: 'pallas' on accelerator
-backends (KCT_PALLAS=0 falls back to the jnp matmul form), never on CPU,
-where the unit tests run this same kernel in interpret mode instead.
+Selection lives in compat.resolve_backend: 'mxu' (the jnp matmul form) by
+default on accelerator backends — measured faster than this kernel at the
+north-star geometry — with KCT_PALLAS=1 opting in; never on CPU, where the
+unit tests run this same kernel in interpret mode instead.
 """
 from __future__ import annotations
 
@@ -29,28 +30,32 @@ def _screen_kernel(pod_allow_ref, seg_ref, allow_ref, s_out_ref, s_def_ref,
                    p_out_ref, p_def_ref, p_esc_ref, deny_ref, verdict_ref):
     """One slot tile: fused escape-flag recovery + Compatible verdict.
 
-    Inputs are 0/1 float32 masks: allow [TN, V]; s_out/s_def [TN, K];
-    pod rows [1, V]/[1, K]; seg [V, K] key-membership. Output: per-key OK
-    [TN, K] (the caller ANDs over the real keys).
+    Inputs are 0/1 BF16 masks (exact for indicators; f32 staging doubled
+    the HBM bytes and measurably lost to the plain matmul path at 50k
+    scale): allow [TN, V]; s_out/s_def [TN, K]; pod rows [1, V]/[1, K];
+    seg [V, K] key-membership. MXU contractions accumulate in f32, so the
+    >0 tests stay exact. Output: per-key OK [TN, K] f32 (the caller ANDs
+    over the real keys).
     """
     allow = allow_ref[:]
     seg = seg_ref[:]
     pod_allow = pod_allow_ref[:]
 
-    # one pass over the allow tile: three MXU contractions
+    one = jnp.bfloat16(1.0)
+    # one pass over the allow tile: three MXU contractions (f32 accumulate)
     dot = lambda a, b: jax.lax.dot_general(
         a, b, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
     )
     has_allow = dot(allow, seg)  # [TN, K] #allowed values per key
-    has_excl = dot(1.0 - allow, seg)  # [TN, K] #excluded values per key
+    has_excl = dot(one - allow, seg)  # [TN, K] #excluded values per key
     inter = dot(allow * pod_allow, seg)  # [TN, K] #shared values per key
 
-    s_out = s_out_ref[:]
-    s_def = s_def_ref[:]
-    p_out = p_out_ref[:]
-    p_def = p_def_ref[:]
-    p_esc = p_esc_ref[:]
-    deny = deny_ref[:]
+    s_out = s_out_ref[:].astype(jnp.float32)
+    s_def = s_def_ref[:].astype(jnp.float32)
+    p_out = p_out_ref[:].astype(jnp.float32)
+    p_def = p_def_ref[:].astype(jnp.float32)
+    p_esc = p_esc_ref[:].astype(jnp.float32)
+    deny = deny_ref[:].astype(jnp.float32)
 
     # escape = defined & ((out & has_excl) | (~out & ~has_allow))
     slot_escape = s_def * jnp.maximum(
@@ -81,7 +86,7 @@ def slot_screen_pallas(slot_allow, slot_out, slot_defined, pod_row, seg_mat,
     Vp = _round_up(max(V, 128), 128)
 
     def pad2(a, r, c):
-        a = a.astype(jnp.float32)
+        a = a.astype(jnp.bfloat16)
         return jnp.pad(a, ((0, r - a.shape[0]), (0, c - a.shape[1])))
 
     args = (

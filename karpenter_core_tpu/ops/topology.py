@@ -471,6 +471,11 @@ def topo_record(
 
     k_row_f = jnp.where(row_mask, k_row, 0).astype(jnp.float32)
     placed_total = k_row_f.sum()
+    # a zero-placement call must be a strict NO-OP (commit sites run
+    # unconditionally with predicated no-op values instead of lax.cond —
+    # branch-carried state forced XLA to copy the big planes every commit);
+    # domain registration therefore gates on an actual placement
+    active = placed_total > 0
     for g, gm in enumerate(meta.groups):
         if gm.is_hostname:
             # each slot IS its (singleton) hostname domain
@@ -495,7 +500,7 @@ def topo_record(
                 delta = allow_seg & singleton
         inc = (rec & delta).astype(jnp.float32) * placed_total
         tcounts = tcounts.at[g, lo:hi].add(inc)
-        tdoms = tdoms.at[g, lo:hi].set(tdoms[g, lo:hi] | (rec & delta))
+        tdoms = tdoms.at[g, lo:hi].set(tdoms[g, lo:hi] | (rec & delta & active))
     return tcounts, thost, tdoms
 
 

@@ -72,9 +72,13 @@ class SolvedMachine:
             with self.__dict__["_lazy_lock"]:
                 if name in self.__dict__:
                     return self.__dict__[name]
-                thunk = self.__dict__.pop(f"_{name}_thunk", None)
+                thunk = self.__dict__.get(f"_{name}_thunk")
                 if thunk is not None:
+                    # materialize BEFORE dropping the thunk: a transient
+                    # device fetch error must stay retryable, not decay
+                    # into a permanent AttributeError
                     object.__setattr__(self, name, thunk())
+                    del self.__dict__[f"_{name}_thunk"]
                     return self.__dict__[name]
         raise AttributeError(name)
 

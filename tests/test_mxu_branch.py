@@ -204,17 +204,18 @@ def test_full_solve_mxu_equals_sliced(n_pods):
 
 def test_resolve_backend_contract():
     """CPU default resolves 'sliced'; a non-CPU device object resolves the
-    MXU/Pallas form regardless of the default backend; KCT_PALLAS=0 downgrades
-    pallas to mxu."""
+    MXU/Pallas form regardless of the default backend; KCT_PALLAS=1 opts
+    in to the fused Pallas screen (default is the plain matmul form —
+    measured faster at the north-star geometry)."""
     import os
 
     class Dev:
         platform = "tpu"
 
     assert compat.resolve_backend() == "sliced"  # conftest pins CPU
-    assert compat.resolve_backend(Dev()) == "pallas"
-    os.environ["KCT_PALLAS"] = "0"
+    assert compat.resolve_backend(Dev()) == "mxu"
+    os.environ["KCT_PALLAS"] = "1"
     try:
-        assert compat.resolve_backend(Dev()) == "mxu"
+        assert compat.resolve_backend(Dev()) == "pallas"
     finally:
         del os.environ["KCT_PALLAS"]

@@ -2,7 +2,7 @@
 
 Runs in interpret mode on CPU (tests/conftest.py pins JAX_PLATFORMS=cpu);
 on a real TPU the same kernel compiles via Mosaic and is selected by
-compat.resolve_backend ('pallas' on accelerators unless KCT_PALLAS=0;
+compat.resolve_backend ('mxu' on accelerators unless KCT_PALLAS=1;
 tests force it via the kernel builders' backend option).
 """
 import numpy as np
