@@ -317,6 +317,7 @@ def consolidation_bench(emit: bool = True):
                     "value": round(pods_per_sec, 1),
                     "unit": "pods/sec",
                     "vs_baseline": round(pods_per_sec / 100.0, 2),
+                    "extra": {"backend_probe": PROBE_LOG},
                 }
             )
         )
@@ -484,6 +485,7 @@ if __name__ == "__main__":
                     "unit": "pods/sec",
                     "vs_baseline": 0.0,
                     "error": f"{type(exc).__name__}: {exc}"[:400],
+                    "extra": {"backend_probe": PROBE_LOG},
                 }
             )
         )
