@@ -90,57 +90,98 @@ def _pod_spec_signature(p: Pod, _repr_memo: Optional[Dict[int, str]] = None) -> 
 
     _repr_memo (id -> repr) dedups the recursive reprs when producers share
     constraint objects across pods (deployment-expanded batches do) — at 50k
-    pods the reprs otherwise dominate encode time. Helpers live at module
-    scope: defining them per call costs ~1.5us x 50k pods."""
-
-    def _r(obj, key):
-        if _repr_memo is None:
-            return repr(obj)
-        got = _repr_memo.get(key)
-        if got is None:
-            got = _repr_memo[key] = repr(obj)
-        return got
-
+    pods the reprs otherwise dominate encode time. The body is deliberately
+    flat (no closures, inlined memo gets, single-container fast path): this
+    runs once per pod and is the encoder's hottest Python loop."""
+    if _repr_memo is None:
+        _repr_memo = {}
+    mget = _repr_memo.get
     s = p.spec
-    return (
-        p.metadata.namespace,
-        tuple(p.metadata.labels.items()),
-        tuple(s.node_selector.items()),
-        # host ports + volumes are per-slot constraints the kernel enforces:
-        # pods differing only in them must NOT share an equivalence class
+    md = p.metadata
+
+    aff = s.affinity
+    if aff is None:
+        aff_r = None
+    else:
+        k = ("aff",) + _aff_key(aff)
+        aff_r = mget(k)
+        if aff_r is None:
+            aff_r = _repr_memo[k] = repr(aff)
+    tol = s.tolerations
+    if tol:
+        k = ("tol",) + _ids(tol)
+        tol_r = mget(k)
+        if tol_r is None:
+            tol_r = _repr_memo[k] = repr(tol)
+    else:
+        tol_r = None
+    tsc = s.topology_spread_constraints
+    if tsc:
+        k = ("tsc",) + _ids(tsc)
+        tsc_r = mget(k)
+        if tsc_r is None:
+            tsc_r = _repr_memo[k] = repr(tsc)
+    else:
+        tsc_r = None
+
+    # host ports + volumes are per-slot constraints the kernel enforces:
+    # pods differing only in them must NOT share an equivalence class
+    cts = s.containers
+    if len(cts) == 1:
+        c = cts[0]
+        res = (
+            (tuple(c.resources.requests.items()), tuple(c.resources.limits.items())),
+        )
+        ports = (
+            tuple(
+                (pt.host_ip, pt.host_port, pt.protocol)
+                for pt in c.ports
+                if pt.host_port
+            )
+            if c.ports
+            else ()
+        )
+    else:
+        res = tuple(
+            (tuple(c.resources.requests.items()), tuple(c.resources.limits.items()))
+            for c in cts
+        )
+        ports = tuple(
+            (pt.host_ip, pt.host_port, pt.protocol)
+            for c in cts
+            for pt in c.ports
+            if pt.host_port
+        )
+    ic = s.init_containers
+    ic_r = (
         tuple(
-            (port.host_ip, port.host_port, port.protocol)
-            for c in s.containers
-            for port in c.ports
-            if port.host_port
-        ),
+            (tuple(c.resources.requests.items()), tuple(c.resources.limits.items()))
+            for c in ic
+        )
+        if ic
+        else None
+    )
+    vols = s.volumes
+    vol_r = (
         tuple(
             v.persistent_volume_claim.claim_name
-            for v in s.volumes
+            for v in vols
             if v.persistent_volume_claim is not None
         )
-        if s.volumes
-        else None,
-        _r(s.affinity, ("aff",) + _aff_key(s.affinity))
-        if s.affinity is not None
-        else None,
-        _r(s.tolerations, ("tol",) + _ids(s.tolerations)) if s.tolerations else None,
-        _r(
-            s.topology_spread_constraints,
-            ("tsc",) + _ids(s.topology_spread_constraints),
-        )
-        if s.topology_spread_constraints
-        else None,
-        tuple(
-            (tuple(c.resources.requests.items()), tuple(c.resources.limits.items()))
-            for c in s.containers
-        ),
-        tuple(
-            (tuple(c.resources.requests.items()), tuple(c.resources.limits.items()))
-            for c in s.init_containers
-        )
-        if s.init_containers
-        else None,
+        if vols
+        else None
+    )
+    return (
+        md.namespace,
+        tuple(md.labels.items()),
+        tuple(s.node_selector.items()),
+        ports,
+        vol_r,
+        aff_r,
+        tol_r,
+        tsc_r,
+        res,
+        ic_r,
     )
 
 

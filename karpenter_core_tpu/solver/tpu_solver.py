@@ -114,11 +114,10 @@ RUN_ARG_NAMES = (
 )
 # arrays that flow through the scan carry unchanged in shape/dtype
 # (remaining0 -> state.remaining, topo_* -> state.tcounts/thost/tdoms):
-# donating lets XLA alias them instead of allocating fresh HBM
-DONATE_ARGNUMS = tuple(
-    RUN_ARG_NAMES.index(n)
-    for n in ("remaining0", "topo_counts0", "topo_hcounts0", "topo_doms0")
-)
+# donating lets XLA alias them instead of allocating fresh HBM. The name
+# tuple is THE source of truth — _run_kernels' bundling also keys off it
+DONATE_ARG_NAMES = ("remaining0", "topo_counts0", "topo_hcounts0", "topo_doms0")
+DONATE_ARGNUMS = tuple(RUN_ARG_NAMES.index(n) for n in DONATE_ARG_NAMES)
 
 # safety cap on relaxation re-solve rounds; sized above the ~6 preference
 # tiers (preferences.go:36-56) so the fixpoint, not the cap, terminates —
@@ -582,48 +581,95 @@ class TPUSolver:
         geom, run = build_device_solve(snap, self.max_nodes, backend=self.backend)
         args = device_args(snap, provisioners)
         _mark("args")
-        # upload shrinkage: large bool planes bit-pack on the host and
-        # unpack INSIDE the jitted program — ~8x fewer bytes over a link
-        # that runs tens of MB/s. The packing spec joins the compile key;
-        # donation is skipped on this path (leaf positions are flattened).
+        # upload shrinkage, two layers:
+        # 1. large bool planes bit-pack on the host and unpack INSIDE the
+        #    jitted program — ~8x fewer bytes over a link that runs tens
+        #    of MB/s;
+        # 2. all non-donated leaves CONCATENATE into one uint8 bundle —
+        #    one transfer instead of ~40, on a link that charges
+        #    per-transfer latency. Leaves are sliced + bitcast back inside
+        #    the program (static offsets). Donated leaves (float32 planes
+        #    aliasing into the scan carry) stay separate buffers so
+        #    donation still works.
         leaves, treedef = jax.tree_util.tree_flatten(args)
+        donate_set = set()
+        off = 0
+        for name, arg in zip(RUN_ARG_NAMES, args):
+            n_leaves = len(jax.tree_util.tree_leaves(arg))
+            if name in DONATE_ARG_NAMES:
+                donate_set.update(range(off, off + n_leaves))
+            off += n_leaves
+        # donated leaves must stay unpacked AND unbundled: they alias into
+        # the scan carry verbatim (topo_doms0 is a large bool plane that
+        # would otherwise trip the packing threshold and reach the kernel
+        # as uint8 with the wrong shape)
         spec = tuple(
             a.shape[-1]
-            if (a.dtype == np.bool_ and a.ndim >= 1 and a.size > 4096)
+            if (
+                i not in donate_set
+                and a.dtype == np.bool_
+                and a.ndim >= 1
+                and a.size > 4096
+            )
             else None
-            for a in leaves
+            for i, a in enumerate(leaves)
         )
         packed = [
             np.packbits(a, axis=-1) if w is not None else a
             for a, w in zip(leaves, spec)
         ]
+        # bundle layout: (byte offset, nbytes, dtype str, stored shape) per
+        # non-donated leaf; None marks a donated (separate) leaf
+        layout = []
+        chunks: List[np.ndarray] = []
+        off_b = 0
+        for i, a in enumerate(packed):
+            if i in donate_set:
+                layout.append(None)
+                continue
+            b = np.ascontiguousarray(a).reshape(-1).view(np.uint8)
+            pad = (-len(b)) % 4  # keep every segment 4-byte aligned
+            layout.append((off_b, len(b), str(a.dtype), a.shape))
+            chunks.append(b)
+            if pad:
+                chunks.append(np.zeros(pad, np.uint8))
+            off_b += len(b) + pad
+        bundle = np.concatenate(chunks) if chunks else np.zeros(0, np.uint8)
+        donated_leaves = [packed[i] for i in sorted(donate_set)]
         _mark("pack")
-        key = (geom, self.backend, spec, treedef)
+        key = (geom, self.backend, spec, treedef, tuple(layout))
         fn = self._compiled.get(key)
         if fn is None:
-            def run_packed(*pleaves):
-                unpacked = [
-                    jnp.unpackbits(l, axis=-1, count=w).astype(bool)
-                    if w is not None
-                    else l
-                    for l, w in zip(pleaves, spec)
-                ]
-                return run(*jax.tree_util.tree_unflatten(treedef, unpacked))
+            def run_bundled(bundle, *donated):
+                it = iter(donated)
+                rebuilt = []
+                for w, lay in zip(spec, layout):
+                    if lay is None:
+                        rebuilt.append(next(it))
+                        continue
+                    o, nbytes, dt_s, shape = lay
+                    dt = np.dtype(dt_s)
+                    sl = jax.lax.slice(bundle, (o,), (o + nbytes,))
+                    if dt == np.bool_:
+                        arr = sl.astype(bool).reshape(shape)
+                    elif dt.itemsize == 1:
+                        arr = sl.astype(dt).reshape(shape)
+                    else:
+                        arr = jax.lax.bitcast_convert_type(
+                            sl.reshape((-1, dt.itemsize)), jnp.dtype(dt)
+                        ).reshape(shape)
+                    if w is not None:
+                        arr = jnp.unpackbits(arr, axis=-1, count=w).astype(bool)
+                    rebuilt.append(arr)
+                return run(*jax.tree_util.tree_unflatten(treedef, rebuilt))
 
-            # donation survives flattening: map the donated named args to
-            # their flat leaf positions (they're float32, never packed, so
-            # shapes still alias into the scan carry)
-            donate_idx: List[int] = []
-            off = 0
-            for name, arg in zip(RUN_ARG_NAMES, args):
-                n_leaves = len(jax.tree_util.tree_leaves(arg))
-                if name in ("remaining0", "topo_counts0", "topo_hcounts0",
-                            "topo_doms0"):
-                    donate_idx.extend(range(off, off + n_leaves))
-                off += n_leaves
             fn = jax.jit(
-                run_packed,
-                donate_argnums=tuple(donate_idx) if self.donate else (),
+                run_bundled,
+                donate_argnums=(
+                    tuple(range(1, 1 + len(donated_leaves)))
+                    if self.donate
+                    else ()
+                ),
             )
             self._compiled[key] = fn
         # opt-in device profiling around the Solve dispatch — the analog of
@@ -632,10 +678,8 @@ class TPUSolver:
         # xprof. One trace per solve while the env var is set.
         import os
 
-        # one batched transfer for the whole arg tree: the TPU link (axon
-        # tunnel especially) charges per-transfer latency, so ~40 implicit
-        # per-leaf uploads cost seconds where one device_put costs ~0.1s
-        args = jax.device_put(packed)
+        # one transfer for the bundle + one per donated plane
+        args = jax.device_put((bundle, *donated_leaves))
         if self.profile_phases:
             # barrier ONLY under opt-in phase profiling: it serializes the
             # upload with jit trace/compile, costing cold solves the full

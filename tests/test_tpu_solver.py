@@ -738,3 +738,69 @@ def test_concurrent_lazy_machine_reads():
             )
         )
     assert all(nreq > 0 and nopt > 0 for nreq, nopt in out)
+
+
+def test_donated_topo_plane_above_packing_threshold():
+    """topo_doms0 is a donated bool plane [G, V]; when G*V crosses the
+    upload bit-packing threshold it must ride UNPACKED (donated carry
+    planes alias verbatim into the scan). Regression: the bundled-upload
+    path once packed it, handing the kernel uint8 of the wrong shape."""
+    from karpenter_core_tpu.kube.objects import (
+        LABEL_HOSTNAME,
+        LABEL_TOPOLOGY_ZONE,
+        LabelSelector,
+        PodAffinityTerm,
+        TopologySpreadConstraint,
+    )
+
+    zonal = TopologySpreadConstraint(
+        max_skew=1,
+        topology_key=LABEL_TOPOLOGY_ZONE,
+        when_unsatisfiable="DoNotSchedule",
+        label_selector=LabelSelector(match_labels={"app": "s"}),
+    )
+    hostname = TopologySpreadConstraint(
+        max_skew=1,
+        topology_key=LABEL_HOSTNAME,
+        when_unsatisfiable="DoNotSchedule",
+        label_selector=LabelSelector(match_labels={"app": "h"}),
+    )
+    affinity = PodAffinityTerm(
+        topology_key=LABEL_TOPOLOGY_ZONE,
+        label_selector=LabelSelector(match_labels={"app": "a"}),
+    )
+    pods = [
+        make_pod(labels={"app": "s"}, requests={"cpu": "0.1"}, topology_spread=[zonal]),
+        make_pod(labels={"app": "h"}, requests={"cpu": "0.1"}, topology_spread=[hostname]),
+        make_pod(labels={"app": "a"}, requests={"cpu": "0.1"}, pod_affinity_required=[affinity]),
+    ]
+    # inflate V past the threshold via distinct NotIn selector values (the
+    # dictionary closes over every mentioned value)
+    from karpenter_core_tpu.kube.objects import NodeSelectorTerm
+    from karpenter_core_tpu.testing import NodeSelectorRequirement
+
+    pods.append(
+        make_pod(
+            requests={"cpu": "0.1"},
+            node_affinity_required=[
+                NodeSelectorTerm(
+                    [
+                        NodeSelectorRequirement(
+                            "bucket", "NotIn", [f"b{i}" for i in range(1500)]
+                        )
+                    ]
+                )
+            ],
+        )
+    )
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(3)}
+    solver = TPUSolver(max_nodes=16)
+    res = solver.solve(pods, provisioners, its)
+    # sanity: the workload really crossed the threshold
+    from karpenter_core_tpu.solver.encode import encode_snapshot
+
+    snap = encode_snapshot(pods, provisioners, its, max_nodes=16)
+    G = len(snap.topo_meta.groups)
+    assert G * snap.dictionary.V > 4096, "test must cross the packing threshold"
+    assert res.pod_count_new() == 4 and not res.failed_pods
