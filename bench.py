@@ -41,7 +41,11 @@ N_PODS = int(os.environ.get("BENCH_PODS", "50000"))
 N_TYPES = int(os.environ.get("BENCH_TYPES", "500"))
 N_RUNS = int(os.environ.get("BENCH_RUNS", "20"))
 N_DISTINCT = int(os.environ.get("BENCH_DISTINCT", "1000"))
-CONFIG = os.environ.get("BENCH_CONFIG", "solve")  # solve | consolidation
+CONFIG = os.environ.get("BENCH_CONFIG", "solve")  # solve | consolidation | sweep
+# sweep mode: distinct-spec counts to measure the per-item scan cost curve
+SWEEP_DISTINCT = [
+    int(x) for x in os.environ.get("BENCH_SWEEP", "10,100,1000,5000").split(",")
+]
 N_EXISTING = int(os.environ.get("BENCH_EXISTING", "1000"))
 # consolidation sub-bench scale (ref multinodeconsolidation.go:87-113)
 CONS_NODES = int(os.environ.get("BENCH_CONS_NODES", "1000"))
@@ -324,6 +328,74 @@ def consolidation_bench(emit: bool = True):
     return result
 
 
+def sweep():
+    """Per-item scan cost curve (round-2 verdict: 'measure 2-3 points on
+    the item axis to establish the actual scaling'): device-solve median
+    at N_PODS x N_TYPES for each distinct-spec count in BENCH_SWEEP, one
+    JSON line with the full curve. Items scale with distinct specs, so
+    this isolates the scan's sequential-axis cost from the bulk-replica
+    fast path."""
+    import jax
+
+    from karpenter_core_tpu.cloudprovider import fake
+    from karpenter_core_tpu.solver.encode import encode_snapshot
+    from karpenter_core_tpu.solver.tpu_solver import build_device_solve, device_args
+
+    universe = fake.instance_types(N_TYPES)
+    points = []
+    for distinct in SWEEP_DISTINCT:
+        pods, provisioners, its = _reference_mix(
+            N_PODS, N_TYPES, distinct, seed=0, universe=universe
+        )
+        nodes = _existing_nodes(N_EXISTING, universe)
+        snap = encode_snapshot(
+            pods, provisioners, its, None, nodes, max_nodes=MAX_NODES
+        )
+        args = jax.device_put(device_args(snap, provisioners))
+        _, run = build_device_solve(snap, max_nodes=MAX_NODES)
+        fn = jax.jit(run)
+        out = fn(*args)
+        jax.block_until_ready(out)
+        dts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = fn(*args)
+            jax.block_until_ready(out)
+            dts.append(time.perf_counter() - t0)
+        items = len(snap.item_counts)
+        ms = float(np.median(dts)) * 1e3
+        points.append({"distinct": distinct, "items": items,
+                       "device_ms": round(ms, 1)})
+        print(f"[bench] sweep distinct={distinct} items={items} "
+              f"device={ms:.0f}ms", file=sys.stderr)
+        del out, args
+    # marginal per-item cost from the curve's endpoints
+    d_items = points[-1]["items"] - points[0]["items"]
+    per_item_us = (
+        (points[-1]["device_ms"] - points[0]["device_ms"]) / d_items * 1e3
+        if d_items
+        else 0.0
+    )
+    suffix = "_cpu_fallback" if BACKEND_NOTE.startswith("cpu-fallback") else ""
+    print(
+        json.dumps(
+            {
+                "metric": f"item_axis_sweep_device_ms_{N_PODS}pods_{N_TYPES}types{suffix}",
+                "value": points[-1]["device_ms"],
+                "unit": "ms",
+                "vs_baseline": round(
+                    (N_PODS / (points[-1]["device_ms"] / 1e3)) / 100.0, 2
+                ),
+                "extra": {
+                    "points": points,
+                    "marginal_us_per_item": round(per_item_us, 1),
+                    "backend_probe": PROBE_LOG,
+                },
+            }
+        )
+    )
+
+
 def main():
     import jax
 
@@ -471,6 +543,8 @@ if __name__ == "__main__":
         ensure_backend()
         if CONFIG == "consolidation":
             consolidation_bench()
+        elif CONFIG == "sweep":
+            sweep()
         else:
             main()
     except BaseException as exc:  # never exit without the JSON line

@@ -114,10 +114,10 @@ RUN_ARG_NAMES = (
 )
 # arrays that flow through the scan carry unchanged in shape/dtype
 # (remaining0 -> state.remaining, topo_* -> state.tcounts/thost/tdoms):
-# donating lets XLA alias them instead of allocating fresh HBM. The name
-# tuple is THE source of truth — _run_kernels' bundling also keys off it
+# donating lets XLA alias them instead of allocating fresh HBM.
+# _run_kernels derives the per-leaf donation positions from this tuple.
 DONATE_ARG_NAMES = ("remaining0", "topo_counts0", "topo_hcounts0", "topo_doms0")
-DONATE_ARGNUMS = tuple(RUN_ARG_NAMES.index(n) for n in DONATE_ARG_NAMES)
+assert all(n in RUN_ARG_NAMES for n in DONATE_ARG_NAMES)
 
 # safety cap on relaxation re-solve rounds; sized above the ~6 preference
 # tiers (preferences.go:36-56) so the fixpoint, not the cap, terminates —
