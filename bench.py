@@ -112,6 +112,26 @@ def ensure_backend():
     PROBE_LOG.append(f"fallback: cpu ({last_err})"[:200])
     print(f"[bench] accelerator unavailable; running on CPU: {last_err}",
           file=sys.stderr)
+    if not force_cpu:
+        # shrink the involuntary-CPU workload so a wedged accelerator still
+        # yields a recorded (clearly suffixed) number in minutes, not hours:
+        # the 50k x 500 config is sized for the TPU, and the 2026-07-30
+        # tunnel wedge showed the full config grinding past the driver's
+        # patience on CPU
+        global N_PODS, N_TYPES, N_RUNS, N_EXISTING, MAX_NODES
+        global CONS_NODES, CONS_PODS
+        N_PODS = min(N_PODS, 5000)
+        N_TYPES = min(N_TYPES, 100)
+        N_RUNS = min(N_RUNS, 6)
+        N_EXISTING = min(N_EXISTING, 200)
+        MAX_NODES = max(1024, N_PODS // 5 + 512)
+        CONS_NODES = min(CONS_NODES, 100)
+        CONS_PODS = min(CONS_PODS, 1000)
+        print(
+            f"[bench] cpu-fallback workload shrunk to {N_PODS}x{N_TYPES}, "
+            f"{N_RUNS} runs",
+            file=sys.stderr,
+        )
 
 
 def _existing_nodes(n: int, universe):
