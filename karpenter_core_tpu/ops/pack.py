@@ -209,25 +209,48 @@ def make_pack_kernel(
         valid = jnp.all((alloc >= 0.0) & ((room >= 0.0) | safe), axis=-1)
         return jnp.where(valid, kmin, 0)
 
+    def _topo_skip(V, K):
+        """The exact tuple topo_narrow_single returns when no group
+        owns/selects the item: (viable, narrow[V], applied_keys[K], k_cap).
+        Single definition — lax.cond branch shapes must stay in lockstep
+        with the real call at every gated site."""
+        return (
+            jnp.bool_(True),
+            jnp.ones(V, dtype=bool),
+            jnp.zeros(K, dtype=bool),
+            jnp.int32(BIGK),
+        )
+
     def verify_slot(state: PackState, prow, n, type_reqs, type_alloc,
-                    type_offering_ok, f_static_p, spread_force=None):
+                    type_offering_ok, f_static_p, spread_force=None,
+                    any_topo=None):
         """Exact acceptance check on slot n.
         Returns (ok, compat_tmask[T], kcap_t[T], kmax, narrow[V], applied[K]).
         kmax = max identical replicas slot n can take (capacity ∧ owned
-        hostname-spread skew headroom)."""
+        hostname-spread skew headroom).
+
+        any_topo: item-invariant "owns or is selected by any topology group"
+        flag (required when the kernel has topology groups); the whole
+        per-group narrowing skips through one cond — the dominant
+        topology-free items otherwise pay it on every verify iteration."""
         slot_allow = state.allow[n]
         K = state.out.shape[1]
         if has_topo:
-            t_viable, narrow, applied_keys, k_topo = topo.topo_narrow_single(
-                topo_meta, state.tcounts, state.thost, state.tdoms,
-                prow["topo_own"], prow["topo_sel"], prow["allow"], slot_allow, n, K,
-                spread_force=spread_force,
+            def _narrow(_):
+                return topo.topo_narrow_single(
+                    topo_meta, state.tcounts, state.thost, state.tdoms,
+                    prow["topo_own"], prow["topo_sel"], prow["allow"],
+                    slot_allow, n, K, spread_force=spread_force,
+                )
+
+            t_viable, narrow, applied_keys, k_topo = jax.lax.cond(
+                any_topo, _narrow, lambda _: _topo_skip(slot_allow.shape[0], K),
+                None,
             )
         else:
-            t_viable = jnp.bool_(True)
-            narrow = jnp.ones_like(slot_allow)
-            applied_keys = jnp.zeros(K, dtype=bool)
-            k_topo = BIGK
+            t_viable, narrow, applied_keys, k_topo = _topo_skip(
+                slot_allow.shape[0], K
+            )
 
         m_allow = slot_allow & prow["allow"] & narrow
         # topology-narrowed keys become DEFINED concrete In-sets
@@ -378,6 +401,13 @@ def make_pack_kernel(
             if has_topo:
                 prow["topo_own"] = x["topo_own"]
                 prow["topo_sel"] = x["topo_sel"]
+            # item-invariant: does ANY topology group own/select this item?
+            # Gates the per-group narrowing in verify/open/bulk — the
+            # dominant topology-free items skip that work entirely
+            any_topo_i = jnp.bool_(False)
+            if has_topo:
+                for g in range(len(topo_meta.groups)):
+                    any_topo_i |= prow["topo_own"][g] | prow["topo_sel"][g]
             valid = x["valid"]
             count = x["count"]
 
@@ -603,6 +633,7 @@ def make_pack_kernel(
                 ok, compat_tmask, kcap_t, kmax, narrow, applied_keys = verify_slot(
                     state, prow, n, type_reqs, type_alloc, type_offering_ok,
                     f_static_p, spread_force=force if has_topo else None,
+                    any_topo=any_topo_i if has_topo else None,
                 )
                 k = jnp.minimum(jnp.minimum(remaining, kmax), cap)
                 do = ok & (k >= 1) & log_ok(ptr)
@@ -680,9 +711,7 @@ def make_pack_kernel(
                 if has_topo:
                     # topology-free items (the bulk of a real batch) skip the
                     # whole group evaluation through one cond
-                    any_topo = jnp.bool_(False)
-                    for g in range(len(topo_meta.groups)):
-                        any_topo |= prow["topo_own"][g] | prow["topo_sel"][g]
+                    any_topo = any_topo_i
                     thost_e = state.thost[:, :EB] if has_topo else None
 
                     def topo_eval(_):
@@ -851,16 +880,24 @@ def make_pack_kernel(
                 for j in range(J):  # static unroll — J is the provisioner count
                     fresh_allow = tmpl_reqs["allow"][j]
                     if has_topo:
-                        tv, tnarrow, tkeys, k_topo_j = topo.topo_narrow_single(
-                            topo_meta, state.tcounts, state.thost, state.tdoms,
-                            prow["topo_own"], prow["topo_sel"], prow["allow"],
-                            fresh_allow, state.nopen, K, spread_force=force,
+                        # gated on the item-invariant any_topo flag: the
+                        # dominant topology-free items skip the per-group
+                        # narrowing on every (fused) open
+                        def _narrow_j(_, fresh_allow=fresh_allow):
+                            return topo.topo_narrow_single(
+                                topo_meta, state.tcounts, state.thost,
+                                state.tdoms, prow["topo_own"],
+                                prow["topo_sel"], prow["allow"],
+                                fresh_allow, state.nopen, K,
+                                spread_force=force,
+                            )
+
+                        tv, tnarrow, tkeys, k_topo_j = jax.lax.cond(
+                            any_topo_i, _narrow_j,
+                            lambda _: _topo_skip(V, K), None,
                         )
                     else:
-                        tv = jnp.bool_(True)
-                        tnarrow = jnp.ones(V, dtype=bool)
-                        tkeys = jnp.zeros(K, dtype=bool)
-                        k_topo_j = BIGK
+                        tv, tnarrow, tkeys, k_topo_j = _topo_skip(V, K)
                     m_allow_j = fresh_allow & prow["allow"] & tnarrow
                     m_out_j = tmpl_reqs["out"][j] & prow["out"] & ~tkeys
                     m_def_j = tmpl_reqs["defined"][j] | prow["defined"] | tkeys
