@@ -881,18 +881,31 @@ def decode_solve(snap: EncodedSnapshot, placements, state) -> SolveResult:
     else:
         assigned = placements
     E = len(snap.state_nodes)
-    slot_pods: Dict[int, List[Pod]] = {}
-    failed: List[Pod] = []
-    for i, pod in enumerate(snap.pods):
-        slot = int(assigned[i])
-        if slot < 0:
-            failed.append(pod)
-        else:
-            slot_pods.setdefault(slot, []).append(pod)
+    # group pods by slot with one stable argsort instead of 50k dict
+    # setdefault/appends; stable keeps FFD order within each slot
+    assigned = np.asarray(assigned)
+    all_pods = snap.pods
+    ok_idx = np.nonzero(assigned >= 0)[0]
+    failed: List[Pod] = (
+        [all_pods[i] for i in np.nonzero(assigned < 0)[0]]
+        if len(ok_idx) < len(all_pods)
+        else []
+    )
+    order = np.argsort(assigned[ok_idx], kind="stable")
+    sorted_idx = ok_idx[order]
+    sorted_slots = assigned[sorted_idx]
+    cuts = np.nonzero(np.diff(sorted_slots))[0] + 1
+    starts = np.concatenate([[0], cuts]).astype(np.int64)
+    ends = np.concatenate([cuts, [len(sorted_idx)]]).astype(np.int64)
+    slot_groups = [
+        (int(sorted_slots[s]), [all_pods[i] for i in sorted_idx[s:e]])
+        for s, e in zip(starts, ends)
+        if e > s
+    ]
 
     machines: List[SolvedMachine] = []
     existing: List[Tuple[object, List[Pod]]] = []
-    for slot, pods in sorted(slot_pods.items()):
+    for slot, pods in slot_groups:
         if slot < E:
             existing.append((snap.state_nodes[slot], pods))
             continue
