@@ -433,7 +433,10 @@ def decode_sharded(snap, log, ptr, state, count_split):
             member_hi=offs[d] + count_split[d],
         )
         shard_state = SimpleNamespace(**{k: v[d] for k, v in fields.items()})
-        res_d = decode_solve(snap, assigned_d, shard_state)
+        # failures are recomputed below from the cross-shard bitmask: a
+        # shard's assigned is -1 for every OTHER shard's pods, so per-shard
+        # failed lists would be O(ndp * P) garbage
+        res_d = decode_solve(snap, assigned_d, shard_state, want_failed=False)
         machines.extend(res_d.new_machines)
         existing.extend(res_d.existing_assignments)
         scheduled |= assigned_d >= 0

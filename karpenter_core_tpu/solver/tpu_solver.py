@@ -870,7 +870,8 @@ def expand_log(snap: EncodedSnapshot, log, ptr: int,
     return assigned
 
 
-def decode_solve(snap: EncodedSnapshot, placements, state) -> SolveResult:
+def decode_solve(snap: EncodedSnapshot, placements, state,
+                 want_failed: bool = True) -> SolveResult:
     """Placements + final slot state -> SolveResult (shared by the in-process
     TPUSolver, the gRPC RemoteSolver client, and the native packer).
     `placements` is either a (commit log, ptr) pair from the device kernel or
@@ -888,7 +889,7 @@ def decode_solve(snap: EncodedSnapshot, placements, state) -> SolveResult:
     ok_idx = np.nonzero(assigned >= 0)[0]
     failed: List[Pod] = (
         [all_pods[i] for i in np.nonzero(assigned < 0)[0]]
-        if len(ok_idx) < len(all_pods)
+        if want_failed and len(ok_idx) < len(all_pods)
         else []
     )
     order = np.argsort(assigned[ok_idx], kind="stable")
