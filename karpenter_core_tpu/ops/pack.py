@@ -75,6 +75,7 @@ def make_pack_kernel(
     ct_seg,
     topo_meta: Optional[topo.TopoMeta] = None,
     backend: Optional[str] = None,
+    screen_v: Optional[int] = None,
 ):
     """Build the jittable packing fn for a fixed label geometry (+ topology
     group structure when the batch has topology constraints).
@@ -82,7 +83,14 @@ def make_pack_kernel(
     backend ∈ {'sliced', 'mxu', 'pallas'} picks the lowering for the device
     the program will run on (compat.resolve_backend); None resolves from the
     default backend. Explicit so a CPU trace targeting TPU (or a test forcing
-    the MXU form on CPU) gets the right branch."""
+    the MXU form on CPU) gets the right branch.
+
+    screen_v: the MXU screens' value-axis width. When the encoder proves no
+    pod or instance type constrains hostname, the (last, ~half-of-V on a
+    real cluster) hostname segment drops out of the screen matmuls — every
+    hostname key term resolves through ~shared regardless of content, so
+    the sliced screens are exact. None or >= V means full width; the
+    'sliced' CPU lowering always runs full width (same semantics)."""
     backend = backend or compat.resolve_backend()
     assert backend in ("sliced", "mxu", "pallas"), backend
     mxu = backend in ("mxu", "pallas")
@@ -104,11 +112,15 @@ def make_pack_kernel(
     )
     seg_mat = None  # [V, K] built lazily at trace time (V known from arrays)
 
+    def _sv(V):
+        """Screen width for a full value axis of V."""
+        return V if screen_v is None else min(screen_v, V)
+
     def _seg_mat(V):
         nonlocal seg_mat
         if seg_mat is None:
             seg_mat = compat.seg_matrix(segments, V)
-        return seg_mat
+        return seg_mat[: _sv(V)]
 
     def slot_compat_screen(state: PackState, prow):
         """[N] bool: pod-vs-slot requirement compatibility + custom rule
@@ -119,16 +131,20 @@ def make_pack_kernel(
         over the allow tile when enabled; on CPU the sliced loop form is
         faster, so pick per backend at trace time."""
         if mxu:
-            sm = _seg_mat(state.allow.shape[1])
+            V_full = state.allow.shape[1]
+            svv = _sv(V_full)
+            sm = _seg_mat(V_full)
+            allow_s = state.allow[:, :svv]
+            prow_s = dict(prow, allow=prow["allow"][:svv])
             if backend == "pallas":
                 from karpenter_core_tpu.ops import pallas_kernels
 
                 return pallas_kernels.slot_screen_pallas(
-                    state.allow, state.out, state.defined, prow, sm
+                    allow_s, state.out, state.defined, prow_s, sm
                 )
             return compat.rows_compat_m(
-                {"allow": state.allow, "out": state.out, "defined": state.defined},
-                prow,
+                {"allow": allow_s, "out": state.out, "defined": state.defined},
+                prow_s,
                 sm,
                 custom_deny=prow["custom_deny"],
             )
@@ -155,12 +171,16 @@ def make_pack_kernel(
         (compatible ∧ hasOffering — machine.go:137-159; resource fit is
         handled separately through per-type replica capacities)."""
         if mxu:
-            sm = _seg_mat(m_allow.shape[0])
+            V_full = m_allow.shape[0]
+            svv = _sv(V_full)
+            sm = _seg_mat(V_full)
+            m_allow_s = m_allow[:svv]
             m_escape = compat.escape_flags_m(
-                m_allow[None], m_out[None], m_defined[None], sm
+                m_allow_s[None], m_out[None], m_defined[None], sm
             )[0]
             ok_t = compat.row_vs_rows_compat_m(
-                m_allow, m_out, m_defined, m_escape, type_reqs, sm
+                m_allow_s, m_out, m_defined, m_escape,
+                dict(type_reqs, allow=type_reqs["allow"][:, :svv]), sm,
             )
         else:
             m_escape = compat.escape_flags(

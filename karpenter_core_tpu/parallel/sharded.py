@@ -132,7 +132,7 @@ def make_sharded_solve(snap, provisioners, mesh, max_nodes_per_shard: int = 256,
 
     geom = solve_geometry(snap, max_nodes_per_shard)
     (_, J, T, E, R, K, V, N, segments_t, zone_seg, ct_seg, _topo_sig,
-     log_len, _Q, _W, _D) = geom
+     log_len, _Q, _W, _D, screen_v) = geom
     segments = list(segments_t)
     ndp = mesh.shape["dp"]
     ntp = mesh.shape["tp"]
@@ -148,7 +148,9 @@ def make_sharded_solve(snap, provisioners, mesh, max_nodes_per_shard: int = 256,
     cache_key = (geom, ndp, ntp)
     fn = None if program_cache is None else program_cache.get(cache_key)
     if fn is None:
-        pack = make_pack_kernel(segments, zone_seg, ct_seg, topo_meta=snap.topo_meta)
+        pack = make_pack_kernel(segments, zone_seg, ct_seg,
+                                topo_meta=snap.topo_meta,
+                                screen_v=screen_v)
 
         def body(pod_arrays, count_split, tmpl, tmpl_daemon, tmpl_type_mask_l,
                  types_l, type_offering_ok_l, types_full, type_alloc,

@@ -207,6 +207,19 @@ class LabelDictionary:
         if value not in vals:
             vals[value] = len(vals)
 
+    def move_key_last(self, key: str) -> None:
+        """Reorder so `key`'s segment sits at the END of the flat value
+        axis (call before freeze). The packing screens slice the hostname
+        segment — roughly half of V on a real cluster (one value per
+        existing node + pad) — off their matmuls when no pod constrains
+        hostname; that only works on a contiguous tail."""
+        k = self.key_index.get(key)
+        if k is None or k == len(self.keys) - 1:
+            return
+        self.keys.append(self.keys.pop(k))
+        self._values.append(self._values.pop(k))
+        self.key_index = {name: i for i, name in enumerate(self.keys)}
+
     def freeze(self) -> None:
         """Assign flat offsets."""
         self.offsets = np.zeros(len(self.keys) + 1, dtype=np.int32)
@@ -359,6 +372,9 @@ class EncodedSnapshot:
     topo_meta: object = None  # ops.topology.TopoMeta
     topo_arrays: object = None  # ops.topology.TopoArrays
     n_slots: int = 0  # E + machine slot budget (hostname identity width)
+    # screens run on allow[:, :screen_v]: V minus the (last) hostname
+    # segment when nothing on the pod/type side constrains hostname
+    screen_v: int = 0
 
     # pod equivalence classes ("items") — the packing scan's work axis.
     # Pods with identical constraint rows collapse into one item with a
@@ -585,6 +601,9 @@ def encode_snapshot(
         if E_real:
             for i in range(E_real, E_pad):
                 dictionary.add_value(LABEL_HOSTNAME, f"__exist-pad-{i}")
+        # hostname's (large) segment goes LAST so the screens can slice it
+        # off when no pod constrains hostname
+        dictionary.move_key_last(LABEL_HOSTNAME)
         dictionary.freeze()
 
     # -- resources ---------------------------------------------------------
@@ -835,6 +854,31 @@ def encode_snapshot(
     # -- pod requirement rows: encoded per class; [P] views are lazy -------
     pod_reqs_u_arr = encode_reqsets(pod_reqs_u, dictionary)
 
+    # screens may run on a prefix of the value axis: when no pod (and no
+    # instance type) constrains hostname, every hostname term in
+    # Compatible/Intersects resolves through ~shared regardless of the
+    # segment's content, and the segment — one value per existing node +
+    # pad, roughly half of V on a real cluster — sits LAST by construction
+    if reuse_dictionary is not None:
+        # sticky across relaxation rounds: dropping a pod's hostname term
+        # mid-solve must not change the screen width (and recompile)
+        screen_v = getattr(dictionary, "screen_v", dictionary.V)
+    else:
+        screen_v = dictionary.V
+        if LABEL_HOSTNAME in dictionary.key_index:
+            hlo, hhi = dictionary.segment(LABEL_HOSTNAME)
+            hostname_last = hhi == dictionary.V
+            k_h = dictionary.key_index[LABEL_HOSTNAME]
+            pods_constrain = (
+                bool(pod_reqs_u_arr.defined[:, k_h].any()) if U else False
+            )
+            types_constrain = any(
+                LABEL_HOSTNAME in it.requirements for it in all_types
+            )
+            if hostname_last and not pods_constrain and not types_constrain:
+                screen_v = hlo
+        dictionary.screen_v = screen_v
+
     # -- pod equivalence classes (items) -----------------------------------
     item_of_pod, item_counts, item_rep, item_members = _build_items(
         uidx, topo_meta, topo_arrays,
@@ -877,6 +921,7 @@ def encode_snapshot(
         topo_meta=topo_meta,
         topo_arrays=topo_arrays,
         n_slots=n_slots,
+        screen_v=screen_v,
         item_of_pod=item_of_pod,
         item_counts=item_counts,
         item_rep=item_rep,

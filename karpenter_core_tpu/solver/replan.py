@@ -157,7 +157,7 @@ def batched_ladder_screen(
 
     geom = solve_geometry(snap, max_nodes)
     (_P, _J, _T, _E, _R, _K, _V, N, segments_t, zone_seg, ct_seg, _sig,
-     log_len, _Q, _W, _D) = geom
+     log_len, _Q, _W, _D, screen_v) = geom
     cache = getattr(provisioning.solver, "_replan_compiled", None)
     if cache is None:
         cache = {}
@@ -171,7 +171,7 @@ def batched_ladder_screen(
     if fn is None:
         rung_run = make_device_run(
             segments_t, zone_seg, ct_seg, snap.topo_meta, N, log_len=log_len,
-            rung_mode=True, backend=backend,
+            rung_mode=True, backend=backend, screen_v=screen_v,
         )
         from karpenter_core_tpu.solver.tpu_solver import RUN_ARG_NAMES
 

@@ -107,6 +107,7 @@ def geometry_json(snap) -> str:
             "zone_seg": list(snap.zone_seg),
             "ct_seg": list(snap.ct_seg),
             "n_slots": snap.n_slots,
+            "screen_v": snap.screen_v or snap.dictionary.V,
             # index 12 = log_len (see solve_geometry's return tuple)
             "log_len": solve_geometry(snap, 0)[12],
             "topo_groups": topo,
@@ -172,6 +173,7 @@ class SolverService:
                     make_device_run(
                         segments, zone_seg, ct_seg, topo_meta, geometry["n_slots"],
                         log_len=geometry.get("log_len"),
+                        screen_v=geometry.get("screen_v"),
                     )
                 )
                 with self._mu:

@@ -219,13 +219,14 @@ def solve_geometry(snap: EncodedSnapshot, max_nodes: int):
     D = snap.exist_vol_limits.shape[1] if snap.exist_vol_limits is not None else 0
     return (
         P, J, T, E, R, K, V, N, tuple(segments), snap.zone_seg, snap.ct_seg,
-        topo_sig, log_len, Q, W, D,
+        topo_sig, log_len, Q, W, D, snap.screen_v or V,
     )
 
 
 def make_device_run(segments, zone_seg, ct_seg, topo_meta, n_slots,
                     log_len: Optional[int] = None, rung_mode: bool = False,
-                    backend: Optional[str] = None):
+                    backend: Optional[str] = None,
+                    screen_v: Optional[int] = None):
     """Build the jittable device program — the whole Solve() as ONE program:
     feasibility + openable + packing scan. Pure function of the device arrays
     produced by device_args(); all dims except n_slots derive from shapes.
@@ -241,7 +242,8 @@ def make_device_run(segments, zone_seg, ct_seg, topo_meta, n_slots,
 
     segments = list(segments)
     pack = make_pack_kernel(
-        segments, zone_seg, ct_seg, topo_meta=topo_meta, backend=backend
+        segments, zone_seg, ct_seg, topo_meta=topo_meta, backend=backend,
+        screen_v=screen_v,
     )
 
     def run_impl(count_row, exist_open, pod_arrays, tmpl, tmpl_daemon,
@@ -357,10 +359,10 @@ def build_device_solve(snap: EncodedSnapshot, max_nodes: int = 1024,
     'mxu' on CPU to exercise the exact TPU code path."""
     geom = solve_geometry(snap, max_nodes)
     (_P, _J, _T, _E, _R, _K, _V, N, segments_t, zone_seg, ct_seg, _topo_sig,
-     log_len, _Q, _W, _D) = geom
+     log_len, _Q, _W, _D, screen_v) = geom
     run = make_device_run(
         segments_t, zone_seg, ct_seg, snap.topo_meta, N, log_len=log_len,
-        backend=backend,
+        backend=backend, screen_v=screen_v,
     )
     return geom, run
 

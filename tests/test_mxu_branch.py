@@ -219,3 +219,86 @@ def test_resolve_backend_contract():
         assert compat.resolve_backend(Dev()) == "pallas"
     finally:
         del os.environ["KCT_PALLAS"]
+
+
+def _existing(n, universe):
+    from karpenter_core_tpu.api.labels import (
+        LABEL_CAPACITY_TYPE,
+        LABEL_NODE_INITIALIZED,
+        PROVISIONER_NAME_LABEL_KEY,
+    )
+    from karpenter_core_tpu.kube.objects import (
+        LABEL_INSTANCE_TYPE_STABLE,
+        LABEL_TOPOLOGY_ZONE,
+    )
+    from karpenter_core_tpu.state.node import StateNode
+    from karpenter_core_tpu.testing import make_node
+
+    return [
+        StateNode(
+            node=make_node(
+                name=f"mxu-n{e}",
+                labels={
+                    PROVISIONER_NAME_LABEL_KEY: "default",
+                    LABEL_NODE_INITIALIZED: "true",
+                    LABEL_INSTANCE_TYPE_STABLE: universe[e % len(universe)].name,
+                    LABEL_CAPACITY_TYPE: "on-demand",
+                    LABEL_TOPOLOGY_ZONE: f"test-zone-{1 + e % 3}",
+                },
+                capacity={
+                    k: str(v) for k, v in universe[e % len(universe)].capacity.items()
+                },
+            )
+        )
+        for e in range(n)
+    ]
+
+
+@pytest.mark.parametrize("pin_hostname", [False, True])
+def test_hostname_screen_elision_mxu_equals_sliced(pin_hostname):
+    """With existing nodes the hostname segment sits last and the MXU
+    screens elide it (screen_v < V) unless some pod constrains hostname;
+    either way the mxu and sliced lowerings must agree commit-for-commit
+    (the sliced form always runs full width)."""
+    import jax
+
+    from karpenter_core_tpu.cloudprovider import fake
+    from karpenter_core_tpu.kube.objects import LABEL_HOSTNAME
+    from karpenter_core_tpu.solver.encode import encode_snapshot
+    from karpenter_core_tpu.solver.tpu_solver import build_device_solve, device_args
+    from karpenter_core_tpu.testing import make_provisioner
+
+    from karpenter_core_tpu.testing import make_pod
+
+    universe = fake.instance_types(8)
+    pods = _mix(21)
+    if pin_hostname:
+        pods.append(
+            make_pod(
+                requests={"cpu": "0.5"},
+                node_selector={LABEL_HOSTNAME: "mxu-n1"},
+            )
+        )
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": universe}
+    nodes = _existing(5, universe)
+    snap = encode_snapshot(pods, provisioners, its, None, nodes, max_nodes=64)
+    assert (snap.screen_v < snap.dictionary.V) == (not pin_hostname), (
+        "elision must engage exactly when no pod constrains hostname"
+    )
+    args = device_args(snap, provisioners)
+    outs = {}
+    for backend in ("sliced", "mxu"):
+        _, run = build_device_solve(snap, max_nodes=64, backend=backend)
+        log, ptr, state = jax.jit(run)(*args)
+        outs[backend] = (
+            {k: np.asarray(v) for k, v in log.items()}, int(ptr),
+            np.asarray(state.pods),
+        )
+    log_s, ptr_s, pods_s = outs["sliced"]
+    log_m, ptr_m, pods_m = outs["mxu"]
+    assert ptr_s == ptr_m
+    for k in ("item", "slot", "ns", "k", "k_last"):
+        np.testing.assert_array_equal(log_s[k][:ptr_s], log_m[k][:ptr_m], err_msg=k)
+    np.testing.assert_array_equal(log_s["bulk_take"], log_m["bulk_take"])
+    np.testing.assert_array_equal(pods_s, pods_m)
