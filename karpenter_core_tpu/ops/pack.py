@@ -122,39 +122,41 @@ def make_pack_kernel(
             seg_mat = compat.seg_matrix(segments, V)
         return seg_mat[: _sv(V)]
 
-    def slot_compat_screen(state: PackState, prow):
-        """[N] bool: pod-vs-slot requirement compatibility + custom rule
-        (the node side is the slot's merged requirements).
+    def slot_compat_screen(allow, out, defined, prow):
+        """[n] bool: pod-vs-slot requirement compatibility + custom rule
+        (the node side is the slot's merged requirements). Takes the slot
+        planes directly — callers pass a PREFIX of the slot axis (the
+        nopen-tiered screen) or the full planes.
 
         On MXU backends the per-key any-reductions fuse into 3 matmuls
         (op-count is what bounds the scan step) — or into ONE Pallas pass
         over the allow tile when enabled; on CPU the sliced loop form is
         faster, so pick per backend at trace time."""
         if mxu:
-            V_full = state.allow.shape[1]
+            V_full = allow.shape[1]
             svv = _sv(V_full)
             sm = _seg_mat(V_full)
-            allow_s = state.allow[:, :svv]
+            allow_s = allow[:, :svv]
             prow_s = dict(prow, allow=prow["allow"][:svv])
             if backend == "pallas":
                 from karpenter_core_tpu.ops import pallas_kernels
 
                 return pallas_kernels.slot_screen_pallas(
-                    allow_s, state.out, state.defined, prow_s, sm
+                    allow_s, out, defined, prow_s, sm
                 )
             return compat.rows_compat_m(
-                {"allow": allow_s, "out": state.out, "defined": state.defined},
+                {"allow": allow_s, "out": out, "defined": defined},
                 prow_s,
                 sm,
                 custom_deny=prow["custom_deny"],
             )
-        ok = jnp.ones(state.allow.shape[0], dtype=bool)
-        slot_escape = compat.escape_flags(state.allow, state.out, state.defined, segments)
+        ok = jnp.ones(allow.shape[0], dtype=bool)
+        slot_escape = compat.escape_flags(allow, out, defined, segments)
         for k, (lo, hi) in enumerate(segments):
-            shared = state.defined[:, k] & prow["defined"][k]
-            both_out = state.out[:, k] & prow["out"][k]
+            shared = defined[:, k] & prow["defined"][k]
+            both_out = out[:, k] & prow["out"][k]
             if hi > lo:
-                inter = (state.allow[:, lo:hi] & prow["allow"][lo:hi]).any(axis=-1)
+                inter = (allow[:, lo:hi] & prow["allow"][lo:hi]).any(axis=-1)
                 nonempty = both_out | inter
             else:
                 nonempty = both_out
@@ -162,7 +164,7 @@ def make_pack_kernel(
             ok &= (~shared) | nonempty | escapes
         # custom keys the pod defines (op not NotIn/DNE) must be defined on slot
         deny = prow["custom_deny"]  # [K]
-        ok &= ~jnp.any(deny[None, :] & ~state.defined, axis=-1)
+        ok &= ~jnp.any(deny[None, :] & ~defined, axis=-1)
         return ok
 
     def merged_types_compat(m_allow, m_out, m_defined, base_tmask, type_reqs,
@@ -431,38 +433,74 @@ def make_pack_kernel(
             valid = x["valid"]
             count = x["count"]
 
-            # -- screen (once per item) -----------------------------------
-            tol = x["tol"][state.tol_idx]  # [N]
-            fit_screen = compat.fits(state.used + prow["requests"][None, :], state.cap)
-            req_screen = slot_compat_screen(state, prow)
-            screen = state.open & tol & fit_screen & req_screen
-            if has_topo:
-                screen &= topo.topo_screen(
-                    topo_meta, state.tcounts, state.thost, state.tdoms,
-                    prow["topo_own"], prow["topo_sel"], prow["allow"], state.allow,
+            # -- screen (once per item), TIERED by nopen ------------------
+            # slots at or beyond nopen can never be open, so the [N]-wide
+            # screen work (matmuls, fits, topology, ranking) runs on the
+            # smallest power-of-two-ish prefix covering the open slots;
+            # uncovered tail slots pad to screen=False / score=BIG, which
+            # is exactly what the full computation yields for closed slots
+            def _screen_upto(limit):
+                tol_l = x["tol"][state.tol_idx[:limit]]
+                fit_l = compat.fits(
+                    state.used[:limit] + prow["requests"][None, :],
+                    state.cap[:limit],
                 )
-            if Q:
-                # host-port conflicts (machine.go:69, existingnode.go:77)
-                screen &= ~jnp.any(
-                    state.ports & prow["port_conflict"][None, :], axis=-1
+                req_l = slot_compat_screen(
+                    state.allow[:limit], state.out[:limit],
+                    state.defined[:limit], prow,
                 )
-            if W:
-                # CSI volume limits on existing slots (existingnode.go:62-115):
-                # per-driver mounted count + NEW claims <= CSINode limit
-                cnt_d = state.vols.astype(jnp.float32) @ vol_driver  # [EV, D]
-                new = prow["vols"][None, :] & ~state.vols
-                new_d = new.astype(jnp.float32) @ vol_driver
-                vol_ok = jnp.all(cnt_d + new_d <= vol_limits, axis=-1)  # [EV]
-                screen = screen.at[:EV].set(screen[:EV] & vol_ok)
+                sc = state.open[:limit] & tol_l & fit_l & req_l
+                if has_topo:
+                    sc &= topo.topo_screen(
+                        topo_meta, state.tcounts, state.thost[:, :limit],
+                        state.tdoms, prow["topo_own"], prow["topo_sel"],
+                        prow["allow"], state.allow[:limit],
+                    )
+                if Q:
+                    # host-port conflicts (machine.go:69, existingnode.go:77)
+                    sc &= ~jnp.any(
+                        state.ports[:limit] & prow["port_conflict"][None, :],
+                        axis=-1,
+                    )
+                if W:
+                    # CSI volume limits on existing slots
+                    # (existingnode.go:62-115): per-driver mounted count +
+                    # NEW claims <= CSINode limit; tiers never cut below EV
+                    cnt_d = state.vols.astype(jnp.float32) @ vol_driver
+                    new = prow["vols"][None, :] & ~state.vols
+                    new_d = new.astype(jnp.float32) @ vol_driver
+                    vol_ok = jnp.all(cnt_d + new_d <= vol_limits, axis=-1)
+                    sc = sc.at[:EV].set(sc[:EV] & vol_ok)
+                # rank: existing first by index, then machines by
+                # (pods, index)
+                idx_l = jnp.arange(limit, dtype=jnp.float32)
+                s0 = jnp.where(
+                    state.is_existing[:limit],
+                    idx_l,
+                    jnp.float32(N)
+                    + state.pods[:limit].astype(jnp.float32) * N
+                    + idx_l,
+                )
+                s0 = jnp.where(sc, s0, BIG)
+                pad = N - limit
+                if pad:
+                    s0 = jnp.pad(s0, (0, pad), constant_values=BIG)
+                return s0
 
-            # rank: existing first by index, then machines by (pods, index)
-            idx = jnp.arange(N, dtype=jnp.float32)
-            score0 = jnp.where(
-                state.is_existing,
-                idx,
-                jnp.float32(N) + state.pods.astype(jnp.float32) * N + idx,
+            tiers = sorted(
+                {max(EV, (N + 3) // 4), max(EV, (N + 1) // 2),
+                 max(EV, (3 * N + 3) // 4), N}
             )
-            score0 = jnp.where(screen, score0, BIG)
+            if N > 2048 and len(tiers) > 1:
+                cuts = jnp.array(tiers[:-1], jnp.int32)
+                tier_idx = (state.nopen > cuts).sum()
+                score0 = jax.lax.switch(
+                    tier_idx,
+                    [lambda _, t=t: _screen_upto(t) for t in tiers],
+                    None,
+                )
+            else:
+                score0 = _screen_upto(N)
 
             f_static_p = x["f_static"]  # [J, T]
             openable_p = x["openable"]  # [J]

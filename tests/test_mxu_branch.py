@@ -302,3 +302,63 @@ def test_hostname_screen_elision_mxu_equals_sliced(pin_hostname):
         np.testing.assert_array_equal(log_s[k][:ptr_s], log_m[k][:ptr_m], err_msg=k)
     np.testing.assert_array_equal(log_s["bulk_take"], log_m["bulk_take"])
     np.testing.assert_array_equal(pods_s, pods_m)
+
+
+def test_tiered_screen_crosses_tier_boundary():
+    """The nopen-tiered screen (active only at n_slots > 2048) must match
+    the sliced lowering commit-for-commit on a workload whose open-slot
+    count CROSSES a tier boundary mid-scan: 600 hostname-spread pods open
+    600 slots (past the first ~N/4 tier cut), then later items screen at
+    the next tier. CPU tests otherwise never reach the switch path."""
+    import jax
+
+    from karpenter_core_tpu.cloudprovider import fake
+    from karpenter_core_tpu.kube.objects import (
+        LABEL_HOSTNAME,
+        LabelSelector,
+        TopologySpreadConstraint,
+    )
+    from karpenter_core_tpu.solver.encode import encode_snapshot
+    from karpenter_core_tpu.solver.tpu_solver import build_device_solve, device_args
+    from karpenter_core_tpu.testing import make_pod, make_provisioner
+
+    hostname = TopologySpreadConstraint(
+        max_skew=1,
+        topology_key=LABEL_HOSTNAME,
+        when_unsatisfiable="DoNotSchedule",
+        label_selector=LabelSelector(match_labels={"app": "h"}),
+    )
+    universe = fake.instance_types(6)
+    pods = [
+        make_pod(labels={"app": "h"}, requests={"cpu": "0.5"},
+                 topology_spread=[hostname])
+        for _ in range(600)
+    ]
+    for i in range(500):
+        pods.append(
+            make_pod(labels={"app": f"g{i % 5}"}, requests={"cpu": "1"})
+        )
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": universe}
+    nodes = _existing(8, universe)
+    snap = encode_snapshot(pods, provisioners, its, None, nodes, max_nodes=2560)
+    assert snap.n_slots > 2048, "workload must engage the tiered switch"
+    args = device_args(snap, provisioners)
+    outs = {}
+    for backend in ("sliced", "mxu"):
+        _, run = build_device_solve(snap, max_nodes=2560, backend=backend)
+        log, ptr, state = jax.jit(run)(*args)
+        outs[backend] = (
+            {k: np.asarray(v) for k, v in log.items()}, int(ptr),
+            np.asarray(state.pods), int(np.asarray(state.nopen)),
+        )
+    log_s, ptr_s, pods_s, nopen_s = outs["sliced"]
+    log_m, ptr_m, pods_m, nopen_m = outs["mxu"]
+    # the scan must actually have crossed the first tier cut (~N/4)
+    assert nopen_s > (snap.n_slots + 3) // 4, nopen_s
+    assert ptr_s == ptr_m and nopen_s == nopen_m
+    for k in ("item", "slot", "ns", "k", "k_last"):
+        np.testing.assert_array_equal(log_s[k][:ptr_s], log_m[k][:ptr_m], err_msg=k)
+    np.testing.assert_array_equal(log_s["bulk_take"], log_m["bulk_take"])
+    np.testing.assert_array_equal(pods_s, pods_m)
+    assert int(pods_s.sum()) == len(pods), "every pod placed"
