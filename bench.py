@@ -26,6 +26,16 @@ Hardened (round 2): the bench NEVER exits without printing that JSON line.
 Backend init is probed in a subprocess with retries; on exhaustion it falls
 back to CPU and says so in the metric name. Each failed probe attempt is
 printed to stderr (preserved in the driver's recorded tail).
+
+Hardened again (round 4, VERDICT #1): the top-level process is now an
+ORCHESTRATOR that never imports jax itself. It probes the backend with a
+progressive schedule (60s -> 240s -> 600s), runs the actual bench in a
+WORKER subprocess under a watchdog timeout (so a mid-run tunnel wedge
+cannot hang the driver with no JSON emitted), retries the worker once
+after a re-probe if it wedges, and — if it had to settle for a CPU
+fallback — makes one FINAL long TPU probe before emitting, re-running the
+TPU workload if the tunnel came back. A transient wedge at any single
+point in time can no longer cost the round its TPU number.
 """
 import json
 import os
@@ -57,6 +67,19 @@ CONS_TYPES = int(os.environ.get("BENCH_CONS_TYPES", "100"))
 MAX_NODES = int(os.environ.get("BENCH_NODES", str(max(1024, N_PODS // 5 + 1536))))
 PROBE_RETRIES = int(os.environ.get("BENCH_PROBE_RETRIES", "2"))
 PROBE_TIMEOUT = int(os.environ.get("BENCH_PROBE_TIMEOUT", "240"))
+# orchestrator knobs (round 4): progressive probe schedule, worker watchdog,
+# and the last-chance probe made after a CPU fallback before emitting
+PROBE_SCHEDULE = [
+    int(x) for x in os.environ.get("BENCH_PROBE_SCHEDULE", "60,240,600").split(",")
+]
+WORKER_TIMEOUT = int(os.environ.get("BENCH_WORKER_TIMEOUT", "2700"))
+CPU_WORKER_TIMEOUT = int(os.environ.get("BENCH_CPU_WORKER_TIMEOUT", "1500"))
+FINAL_PROBE_TIMEOUT = int(os.environ.get("BENCH_FINAL_PROBE_TIMEOUT", "300"))
+# hard wall-clock budget for the WHOLE orchestration: later stages get
+# min(stage_timeout, remaining) and the rescue stages are skipped once the
+# budget is spent, so the JSON line is guaranteed to appear before a
+# driver-side patience limit of this size kills the process silently
+TOTAL_BUDGET = int(os.environ.get("BENCH_TOTAL_BUDGET", "5400"))
 
 BACKEND_NOTE = ""
 # each probe attempt's outcome, recorded into the final JSON's "extra" so a
@@ -79,27 +102,24 @@ def ensure_backend():
     global BACKEND_NOTE
     force_cpu = os.environ.get("BENCH_CPU", "") == "1"
     last_err = "forced by BENCH_CPU=1"
+    if not force_cpu and os.environ.get("BENCH_SKIP_PROBE", "") == "1":
+        # orchestrator already proved the backend is alive; just use it
+        import jax
+
+        BACKEND_NOTE = f"{jax.devices()[0].platform} {jax.devices()[0].device_kind}"
+        print(f"[bench] backend (pre-probed by orchestrator): {BACKEND_NOTE}",
+              file=sys.stderr)
+        return
     if not force_cpu:
         for attempt in range(PROBE_RETRIES):
-            proc = None
-            try:
-                proc = subprocess.run(
-                    [sys.executable, "-c",
-                     "import jax; d=jax.devices(); print(d[0].platform, d[0].device_kind)"],
-                    capture_output=True, text=True, timeout=PROBE_TIMEOUT,
-                    env=dict(os.environ),
-                )
-            except subprocess.TimeoutExpired:
-                last_err = f"probe timeout after {PROBE_TIMEOUT}s"
-            if proc is not None and proc.returncode == 0:
-                BACKEND_NOTE = proc.stdout.strip()
+            ok, note = _probe_once(PROBE_TIMEOUT)
+            if ok:
+                BACKEND_NOTE = note
                 PROBE_LOG.append(f"attempt {attempt + 1}: ok ({BACKEND_NOTE})"[:200])
                 print(f"[bench] backend ok: {BACKEND_NOTE} (attempt {attempt + 1})",
                       file=sys.stderr)
                 return
-            if proc is not None:
-                err = (proc.stderr or "").strip()
-                last_err = err.splitlines()[-1] if err else "rc!=0"
+            last_err = note
             PROBE_LOG.append(f"attempt {attempt + 1}: FAILED ({last_err})"[:200])
             print(f"[bench] backend probe attempt {attempt + 1} failed: {last_err}",
                   file=sys.stderr)
@@ -112,7 +132,10 @@ def ensure_backend():
     PROBE_LOG.append(f"fallback: cpu ({last_err})"[:200])
     print(f"[bench] accelerator unavailable; running on CPU: {last_err}",
           file=sys.stderr)
-    if not force_cpu:
+    # shrink on involuntary fallback — including when the ORCHESTRATOR made
+    # the fallback decision and signals it via BENCH_CPU_SHRINK (plain
+    # BENCH_CPU=1 alone means a deliberate full-config CPU run)
+    if not force_cpu or os.environ.get("BENCH_CPU_SHRINK", "") == "1":
         # shrink the involuntary-CPU workload so a wedged accelerator still
         # yields a recorded (clearly suffixed) number in minutes, not hours:
         # the 50k x 500 config is sized for the TPU, and the 2026-07-30
@@ -558,7 +581,218 @@ def main():
     )
 
 
+def _run_subprocess(cmd, env, timeout_s: int, capture_stderr=False) -> tuple:
+    """Popen in its own process group with a HARD watchdog: on timeout the
+    whole group is SIGKILLed and pipes are drained on bounded threads, so
+    a child stuck in an uninterruptible tunnel syscall (or a grandchild
+    holding a pipe) cannot wedge this process. Returns
+    (rc_or_None, stdout_text, stderr_text, timed_out). With
+    capture_stderr=False, stderr is inherited (streams live into the
+    driver's recorded tail)."""
+    import signal
+    import threading
+
+    proc = subprocess.Popen(
+        cmd, stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE if capture_stderr else None,
+        text=True, env=env, start_new_session=True,
+    )
+    out_chunks, err_chunks = [], []
+
+    def _drain(stream, chunks):
+        try:
+            chunks.append(stream.read())
+        except Exception:
+            pass
+
+    drainers = [threading.Thread(target=_drain, args=(proc.stdout, out_chunks),
+                                 daemon=True)]
+    if capture_stderr:
+        drainers.append(threading.Thread(
+            target=_drain, args=(proc.stderr, err_chunks), daemon=True))
+    deadline = time.monotonic() + timeout_s
+    for d in drainers:
+        d.start()
+    for d in drainers:
+        d.join(max(0.0, deadline - time.monotonic()))
+    if any(d.is_alive() for d in drainers):
+        timed_out = True
+    else:
+        # pipes hit EOF; reap the child (poll() right after EOF can race)
+        try:
+            proc.wait(timeout=30)
+            timed_out = False
+        except subprocess.TimeoutExpired:
+            timed_out = True
+    if timed_out:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        for d in drainers:
+            d.join(10)  # bounded: give the pipes a moment to close
+    rc = proc.poll()
+    return rc, "".join(out_chunks), "".join(err_chunks), timed_out
+
+
+def _probe_once(timeout_s: int) -> tuple:
+    """One subprocess backend probe. Returns (ok, note); on failure the
+    note carries the backend's own last stderr line (e.g. 'Unable to
+    initialize backend axon') so BENCH_r{N}.json distinguishes a tunnel
+    wedge from an import error."""
+    rc, out, err, timed_out = _run_subprocess(
+        [sys.executable, "-c",
+         "import jax; d=jax.devices(); print(d[0].platform, d[0].device_kind)"],
+        dict(os.environ), timeout_s, capture_stderr=True,
+    )
+    if timed_out:
+        return False, f"probe timeout after {timeout_s}s"
+    if rc == 0:
+        return True, out.strip()
+    lines = [ln for ln in err.strip().splitlines() if ln.strip()]
+    return False, (lines[-1] if lines else f"probe rc={rc}")
+
+
+def _parse_json_line(text: str):
+    result = None
+    for line in text.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                result = json.loads(line)
+            except ValueError:
+                continue
+    return result
+
+
+def _run_worker(extra_env: dict, timeout_s: int) -> tuple:
+    """Run this script as a worker subprocess under a watchdog. stderr is
+    inherited (streams live into the driver's recorded tail); stdout is
+    captured and the last JSON-parseable line is the result. Returns
+    (result_dict_or_None, note)."""
+    env = dict(os.environ)
+    env["BENCH_WORKER"] = "1"
+    env.update(extra_env)
+    rc, out, _, timed_out = _run_subprocess(
+        [sys.executable, os.path.abspath(__file__)], env, timeout_s)
+    # parse even a timed-out worker's captured stdout: a worker that printed
+    # its JSON but hung at interpreter shutdown still produced a result
+    result = _parse_json_line(out)
+    if result is not None:
+        return result, ("ok (worker hung at exit, result salvaged)"
+                        if timed_out else "ok")
+    if timed_out:
+        return None, f"worker wedged: no result within {timeout_s}s (killed)"
+    return None, f"worker rc={rc}, no JSON line on stdout"
+
+
+def _failure_record(note: str, extra: dict) -> dict:
+    return {
+        "metric": f"bench_failed_{CONFIG}_{N_PODS}pods_{N_TYPES}types",
+        "value": 0.0,
+        "unit": "pods/sec",
+        "vs_baseline": 0.0,
+        "error": note[:400],
+        "extra": extra,
+    }
+
+
+def orchestrate():
+    """Top-level driver-facing entry: never imports jax in this process, so
+    no wedge can stop the final JSON line from being printed."""
+    probe_log = []
+    deadline = time.monotonic() + TOTAL_BUDGET
+
+    def _left() -> int:
+        return max(0, int(deadline - time.monotonic()))
+
+    def _budget(stage_timeout: int) -> int:
+        return min(stage_timeout, _left())
+
+    def _log(msg):
+        probe_log.append(msg[:200])
+        print(f"[bench] {probe_log[-1]}", file=sys.stderr)
+
+    if os.environ.get("BENCH_CPU", "") == "1":
+        # deliberate CPU run: skip all TPU probing, honor the full config
+        result, note = _run_worker({}, _budget(TOTAL_BUDGET))
+        if result is None:
+            result = _failure_record(note, {})
+        result.setdefault("extra", {})["orchestrator_probe"] = ["forced cpu"]
+        print(json.dumps(result))
+        return
+
+    tpu_ok = False
+    for i, t in enumerate(PROBE_SCHEDULE):
+        ok, note = _probe_once(_budget(t))
+        _log(f"probe {i + 1} ({t}s): {'ok ' if ok else 'FAILED '}({note})")
+        if ok:
+            tpu_ok = True
+            break
+        if i < len(PROBE_SCHEDULE) - 1 and _left() > 60:
+            time.sleep(min(30, 5 * (i + 1)))
+
+    result = None
+    got_tpu = False
+    if tpu_ok:
+        result, note = _run_worker({"BENCH_SKIP_PROBE": "1"},
+                                   _budget(WORKER_TIMEOUT))
+        if result is None and _left() > 300:
+            # the tunnel can wedge mid-run: re-probe, then one retry with a
+            # reduced run count so the retry fits the remaining patience
+            _log(f"worker attempt 1: {note}")
+            ok, pnote = _probe_once(_budget(240))
+            _log(f"re-probe (240s): {'ok ' if ok else 'FAILED '}({pnote})")
+            if ok:
+                result, note = _run_worker(
+                    {"BENCH_SKIP_PROBE": "1",
+                     "BENCH_RUNS": str(max(6, N_RUNS // 2))},
+                    _budget(WORKER_TIMEOUT),
+                )
+                if result is None:
+                    _log(f"worker attempt 2: {note}")
+        got_tpu = result is not None
+
+    if result is None:
+        # CPU fallback: always produces a (shrunk, clearly suffixed) number.
+        # Reserve ~60s of budget headroom so the record is always emitted.
+        print("[bench] falling back to CPU worker", file=sys.stderr)
+        result, note = _run_worker(
+            {"BENCH_CPU": "1", "BENCH_CPU_SHRINK": "1"},
+            _budget(CPU_WORKER_TIMEOUT),
+        )
+    if not got_tpu and result is not None and _left() > FINAL_PROBE_TIMEOUT + 120:
+        # last chance before settling for the CPU number: the wedge may have
+        # been transient (applies whether the probes failed up front or the
+        # worker wedged mid-run)
+        ok, pnote = _probe_once(FINAL_PROBE_TIMEOUT)
+        _log(f"final probe ({FINAL_PROBE_TIMEOUT}s): "
+             f"{'ok ' if ok else 'FAILED '}({pnote})")
+        if ok:
+            tpu_result, tnote = _run_worker(
+                {"BENCH_SKIP_PROBE": "1"}, _budget(WORKER_TIMEOUT))
+            if tpu_result is not None:
+                _log("rescued: TPU came back on final probe")
+                result = tpu_result
+            else:
+                _log(f"final TPU attempt: {tnote}")
+    if result is None:
+        result = _failure_record(note, {})
+
+    result.setdefault("extra", {})["orchestrator_probe"] = probe_log
+    print(json.dumps(result))
+
+
 if __name__ == "__main__":
+    if os.environ.get("BENCH_WORKER", "") != "1":
+        try:
+            orchestrate()
+        except BaseException as exc:  # never exit without the JSON line
+            import traceback
+
+            traceback.print_exc()
+            print(json.dumps(_failure_record(f"{type(exc).__name__}: {exc}", {})))
+        sys.exit(0)
     try:
         ensure_backend()
         if CONFIG == "consolidation":
@@ -573,14 +807,10 @@ if __name__ == "__main__":
         traceback.print_exc()
         print(
             json.dumps(
-                {
-                    "metric": f"bench_failed_{CONFIG}_{N_PODS}pods_{N_TYPES}types",
-                    "value": 0.0,
-                    "unit": "pods/sec",
-                    "vs_baseline": 0.0,
-                    "error": f"{type(exc).__name__}: {exc}"[:400],
-                    "extra": {"backend_probe": PROBE_LOG},
-                }
+                _failure_record(
+                    f"{type(exc).__name__}: {exc}",
+                    {"backend_probe": PROBE_LOG},
+                )
             )
         )
         sys.exit(0)
