@@ -141,6 +141,31 @@ def instance_types(total: int) -> List[InstanceType]:
     ]
 
 
+def default_universe() -> List[InstanceType]:
+    """The reference fake's default GetInstanceTypes universe
+    (fake/cloudprovider.go:135-177): default / small / two gpu vendors /
+    arm (16cpu 128Gi, extra OSes) / single-pod."""
+    return [
+        new_instance_type("default-instance-type"),
+        new_instance_type(
+            "small-instance-type", resources={"cpu": 2.0, "memory": 2.0 * GI}
+        ),
+        new_instance_type(
+            "gpu-vendor-instance-type", resources={RESOURCE_GPU_VENDOR_A: 2.0}
+        ),
+        new_instance_type(
+            "gpu-vendor-b-instance-type", resources={RESOURCE_GPU_VENDOR_B: 2.0}
+        ),
+        new_instance_type(
+            "arm-instance-type",
+            architecture="arm64",
+            operating_systems=["ios", "linux", "windows", "darwin"],
+            resources={"cpu": 16.0, "memory": 128.0 * GI},
+        ),
+        new_instance_type("single-pod-instance-type", resources={"pods": 1.0}),
+    ]
+
+
 def instance_types_assorted() -> List[InstanceType]:
     """Cross product of cpu x mem x zone x capacity-type x os x arch
     (fake/instancetype.go:109-148) — 1,344 unique single-offering types."""

@@ -87,9 +87,46 @@ class ProvisioningController:
     # -- scheduling --------------------------------------------------------
 
     def get_pending_pods(self) -> List[Pod]:
-        """Provisionable pods (provisioner.go:152-174)."""
+        """Provisionable pods (provisioner.go:152-174); pods failing Validate
+        — opted out of Karpenter nodes, invalid affinity requirements, or
+        invalid volume references — are ignored (provisioner.go:166-169)."""
         pods = self.kube_client.list("Pod", field_filter=lambda p: p.spec.node_name == "")
-        return [p for p in pods if podutils.is_provisionable(p)]
+        return [
+            p
+            for p in pods
+            if podutils.is_provisionable(p) and self._validate_pod(p) is None
+        ]
+
+    def _validate_pod(self, pod: Pod) -> Optional[str]:
+        """Provisioner.Validate (provisioner.go:376-434): provisioner-name
+        opt-out, affinity-term requirement validity, volume references."""
+        from karpenter_core_tpu.api.validation import validate_requirement
+        from karpenter_core_tpu.scheduling.requirements import Requirements
+
+        # validateProvisionerNameCanExist (provisioner.go:386-394): a pod
+        # that requires the provisioner-name label to NOT exist (e.g. the
+        # controller's own replicas) never enters the batch
+        for req in Requirements.from_pod(pod).values():
+            if (
+                req.key == api_labels.PROVISIONER_NAME_LABEL_KEY
+                and req.operator() == "DoesNotExist"
+            ):
+                return (
+                    f"configured to not run on a Karpenter provisioned node "
+                    f"via {req.key} DoesNotExist requirement"
+                )
+        # validateAffinity (provisioner.go:408-434): every node-affinity term
+        # must carry well-formed requirements
+        affinity = pod.spec.affinity
+        if affinity is not None and affinity.node_affinity is not None:
+            terms = list(affinity.node_affinity.required)
+            terms.extend(p.preference for p in affinity.node_affinity.preferred)
+            for term in terms:
+                for expr in term.match_expressions:
+                    errs = validate_requirement(expr)
+                    if errs:
+                        return "; ".join(errs)
+        return self.volume_topology.validate(pod)
 
     def get_daemonset_pods(self) -> List[Pod]:
         """Synthetic pods from DaemonSet templates (provisioner.go:365-382)."""

@@ -37,6 +37,37 @@ class VolumeTopology:
             term.match_expressions.extend(requirements)
         return pod
 
+    def validate(self, pod: Pod) -> Optional[str]:
+        """validatePersistentVolumeClaims (volumetopology.go:146-199): returns
+        an error string when a volume references a missing PVC / PV /
+        StorageClass (including ephemeral claim templates) — such pods are
+        ignored by GetPendingPods rather than failing the whole batch."""
+        for volume in pod.spec.volumes:
+            storage_class_name = None
+            volume_name = ""
+            if volume.persistent_volume_claim is not None:
+                pvc = self.kube_client.get(
+                    "PersistentVolumeClaim",
+                    pod.metadata.namespace,
+                    volume.persistent_volume_claim.claim_name,
+                )
+                if pvc is None:
+                    return (
+                        f"persistent volume claim "
+                        f"{volume.persistent_volume_claim.claim_name!r} not found"
+                    )
+                storage_class_name = pvc.spec.storage_class_name
+                volume_name = pvc.spec.volume_name
+            elif volume.ephemeral is not None:
+                storage_class_name = volume.ephemeral.storage_class_name
+            if storage_class_name:
+                if self.kube_client.get("StorageClass", "", storage_class_name) is None:
+                    return f"storage class {storage_class_name!r} not found"
+            if volume_name:
+                if self.kube_client.get("PersistentVolume", "", volume_name) is None:
+                    return f"persistent volume {volume_name!r} not found"
+        return None
+
     def _get_requirements(self, pod: Pod) -> List[NodeSelectorRequirement]:
         requirements: List[NodeSelectorRequirement] = []
         for volume in pod.spec.volumes:

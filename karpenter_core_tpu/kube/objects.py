@@ -254,9 +254,18 @@ class PersistentVolumeClaimVolumeSource:
 
 
 @dataclass
+class EphemeralVolumeSource:
+    """Generic ephemeral volume: carries the claim-template storage class
+    (v1.EphemeralVolumeSource, validated in volumetopology.go:162-170)."""
+
+    storage_class_name: Optional[str] = None
+
+
+@dataclass
 class Volume:
     name: str = ""
     persistent_volume_claim: Optional[PersistentVolumeClaimVolumeSource] = None
+    ephemeral: Optional[EphemeralVolumeSource] = None
 
 
 @dataclass

@@ -104,18 +104,40 @@ def make_pod(
     owner_kind: str = "",
     phase: str = "Pending",
     unschedulable: bool = True,
+    init_requests: Optional[Dict[str, object]] = None,
+    init_limits: Optional[Dict[str, object]] = None,
 ) -> Pod:
     """A pending, unschedulable pod by default (marked with the PodScheduled
-    Unschedulable condition like GetPendingPods expects)."""
+    Unschedulable condition like GetPendingPods expects).
+
+    Requests default from limits per-resource, matching the apiserver's
+    admission defaulting the reference's envtest pods get for free (its
+    suites routinely set only Limits)."""
+
+    def _requests(reqs, lims):
+        out = dict(parse_resource_list(lims or {}))
+        out.update(parse_resource_list(reqs or {}))
+        return out
+
     containers = [
         Container(
             resources=ResourceRequirements(
-                requests=parse_resource_list(requests or {}),
+                requests=_requests(requests, limits),
                 limits=parse_resource_list(limits or {}),
             ),
             ports=[ContainerPort(host_port=p) for p in (host_ports or [])],
         )
     ]
+    init_containers = []
+    if init_requests or init_limits:
+        init_containers = [
+            Container(
+                resources=ResourceRequirements(
+                    requests=_requests(init_requests, init_limits),
+                    limits=parse_resource_list(init_limits or {}),
+                )
+            )
+        ]
     affinity = None
     if any(
         [
@@ -160,6 +182,7 @@ def make_pod(
             affinity=affinity,
             tolerations=list(tolerations or []),
             containers=containers,
+            init_containers=init_containers,
             topology_spread_constraints=list(topology_spread or []),
         ),
     )
@@ -203,6 +226,116 @@ def make_provisioner(
     p = Provisioner(metadata=ObjectMeta(name=name or unique_name("provisioner")), spec=spec)
     p.metadata.namespace = ""
     return p
+
+
+def make_daemonset(
+    name: Optional[str] = None,
+    namespace: str = "default",
+    requests: Optional[Dict[str, object]] = None,
+    node_selector: Optional[Dict[str, str]] = None,
+) -> "DaemonSet":
+    """test.DaemonSet analog: carries the pod template the scheduler uses for
+    per-template daemon overhead (reference pkg/test/daemonsets.go)."""
+    from karpenter_core_tpu.kube.objects import DaemonSet
+
+    return DaemonSet(
+        metadata=ObjectMeta(name=name or unique_name("ds"), namespace=namespace),
+        pod_template_spec=PodSpec(
+            node_selector=dict(node_selector or {}),
+            containers=[
+                Container(
+                    resources=ResourceRequirements(
+                        requests=parse_resource_list(requests or {})
+                    )
+                )
+            ],
+        ),
+    )
+
+
+def make_storage_class(name: str, provisioner: str = "", zones: Optional[List[str]] = None):
+    """test.StorageClass analog (pkg/test/storage.go)."""
+    from karpenter_core_tpu.kube.objects import (
+        LABEL_TOPOLOGY_ZONE,
+        StorageClass,
+        TopologySelectorLabelRequirement,
+        TopologySelectorTerm,
+    )
+
+    sc = StorageClass(metadata=ObjectMeta(name=name), provisioner=provisioner)
+    if zones:
+        sc.allowed_topologies = [
+            TopologySelectorTerm(
+                match_label_expressions=[
+                    TopologySelectorLabelRequirement(
+                        key=LABEL_TOPOLOGY_ZONE, values=list(zones)
+                    )
+                ]
+            )
+        ]
+    return sc
+
+
+def make_pvc(name: str, namespace: str = "default", storage_class: Optional[str] = None,
+             volume_name: str = ""):
+    """test.PersistentVolumeClaim analog."""
+    from karpenter_core_tpu.kube.objects import (
+        PersistentVolumeClaim,
+        PersistentVolumeClaimSpec,
+    )
+
+    return PersistentVolumeClaim(
+        metadata=ObjectMeta(name=name, namespace=namespace),
+        spec=PersistentVolumeClaimSpec(
+            storage_class_name=storage_class, volume_name=volume_name
+        ),
+    )
+
+
+def make_pv(name: str, driver: str = "", zones: Optional[List[str]] = None,
+            storage_class: str = ""):
+    """test.PersistentVolume analog; driver='' models non-CSI (e.g. NFS)."""
+    from karpenter_core_tpu.kube.objects import (
+        CSIPersistentVolumeSource,
+        LABEL_TOPOLOGY_ZONE,
+        PersistentVolume,
+        PersistentVolumeSpec,
+    )
+
+    spec = PersistentVolumeSpec(storage_class_name=storage_class)
+    if driver:
+        spec.csi = CSIPersistentVolumeSource(driver=driver)
+    if zones:
+        spec.node_affinity_required = [
+            NodeSelectorTerm(
+                match_expressions=[
+                    NodeSelectorRequirement(LABEL_TOPOLOGY_ZONE, "In", list(zones))
+                ]
+            )
+        ]
+    return PersistentVolume(metadata=ObjectMeta(name=name), spec=spec)
+
+
+def make_csinode(node_name: str, driver: str, allocatable: Optional[int] = None):
+    """storagev1.CSINode analog carrying per-driver attach limits."""
+    from karpenter_core_tpu.kube.objects import CSINode, CSINodeDriver
+
+    return CSINode(
+        metadata=ObjectMeta(name=node_name),
+        drivers=[CSINodeDriver(name=driver, allocatable_count=allocatable)],
+    )
+
+
+def pvc_volume(claim_name: str):
+    from karpenter_core_tpu.kube.objects import (
+        PersistentVolumeClaimVolumeSource,
+        Volume,
+    )
+
+    return Volume(
+        name=claim_name,
+        persistent_volume_claim=PersistentVolumeClaimVolumeSource(claim_name=claim_name),
+    )
 
 
 def make_node(

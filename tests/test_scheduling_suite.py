@@ -1,0 +1,657 @@
+"""Port of reference scheduling suite_test.go — Custom Constraints +
+Preferential Fallback describes (suite_test.go:111-716), spec-for-spec over
+the expectations harness (testing/expectations.py). Spec names and cited
+line numbers refer to
+/root/reference/pkg/controllers/provisioning/scheduling/suite_test.go.
+"""
+import pytest
+
+from karpenter_core_tpu.api import labels as api_labels
+from karpenter_core_tpu.cloudprovider import fake
+from karpenter_core_tpu.kube.objects import (
+    LABEL_ARCH_STABLE,
+    LABEL_HOSTNAME,
+    LABEL_INSTANCE_TYPE_STABLE,
+    LABEL_OS_STABLE,
+    LABEL_TOPOLOGY_ZONE,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    PreferredSchedulingTerm,
+)
+from karpenter_core_tpu.testing import make_pod, make_provisioner
+from karpenter_core_tpu.testing.expectations import Env
+
+ZONE = LABEL_TOPOLOGY_ZONE
+ITYPE = LABEL_INSTANCE_TYPE_STABLE
+CT = api_labels.LABEL_CAPACITY_TYPE
+INTEGER = fake.INTEGER_INSTANCE_LABEL_KEY
+
+
+@pytest.fixture()
+def env():
+    return Env()
+
+
+def req(key, op, *values):
+    return NodeSelectorRequirement(key=key, operator=op, values=list(values))
+
+
+def terms(*exprs):
+    """test.PodOptions.NodeRequirements: ONE required term, ANDed exprs."""
+    return [NodeSelectorTerm(match_expressions=list(exprs))]
+
+
+def prefs(*exprs, weight=1):
+    """test.PodOptions.NodePreferences: ONE weight-1 preferred term."""
+    return [
+        PreferredSchedulingTerm(
+            weight=weight, preference=NodeSelectorTerm(match_expressions=list(exprs))
+        )
+    ]
+
+
+# -- Custom Constraints / Provisioner with Labels (suite_test.go:112-160) ---
+
+
+def test_schedules_unconstrained_pods_onto_provisioner_labels(env):
+    """suite_test.go:113-120."""
+    env.expect_applied(make_provisioner(name="default", labels={"test-key": "test-value"}))
+    pod = make_pod()
+    env.expect_provisioned(pod)
+    node = env.expect_scheduled(pod)
+    assert node.metadata.labels.get("test-key") == "test-value"
+
+
+def test_conflicting_node_selector_not_scheduled(env):
+    """suite_test.go:121-129."""
+    env.expect_applied(make_provisioner(name="default", labels={"test-key": "test-value"}))
+    pod = make_pod(node_selector={"test-key": "different-value"})
+    env.expect_provisioned(pod)
+    env.expect_not_scheduled(pod)
+
+
+def test_undefined_key_node_selector_not_scheduled(env):
+    """suite_test.go:130-137."""
+    env.expect_applied(make_provisioner(name="default"))
+    pod = make_pod(node_selector={"test-key": "test-value"})
+    env.expect_provisioned(pod)
+    env.expect_not_scheduled(pod)
+
+
+def test_matching_requirements_scheduled(env):
+    """suite_test.go:138-149."""
+    env.expect_applied(make_provisioner(name="default", labels={"test-key": "test-value"}))
+    pod = make_pod(
+        node_affinity_required=terms(req("test-key", "In", "test-value", "another-value"))
+    )
+    env.expect_provisioned(pod)
+    node = env.expect_scheduled(pod)
+    assert node.metadata.labels.get("test-key") == "test-value"
+
+
+def test_conflicting_requirements_not_scheduled(env):
+    """suite_test.go:150-161."""
+    env.expect_applied(make_provisioner(name="default", labels={"test-key": "test-value"}))
+    pod = make_pod(node_affinity_required=terms(req("test-key", "In", "another-value")))
+    env.expect_provisioned(pod)
+    env.expect_not_scheduled(pod)
+
+
+# -- Custom Constraints / Well Known Labels (suite_test.go:162-366) ---------
+
+
+def test_uses_provisioner_constraints(env):
+    """suite_test.go:163-171."""
+    env.expect_applied(
+        make_provisioner(name="default", requirements=[req(ZONE, "In", "test-zone-2")])
+    )
+    pod = make_pod()
+    env.expect_provisioned(pod)
+    node = env.expect_scheduled(pod)
+    assert node.metadata.labels.get(ZONE) == "test-zone-2"
+
+
+def test_uses_node_selectors(env):
+    """suite_test.go:172-182."""
+    env.expect_applied(
+        make_provisioner(
+            name="default", requirements=[req(ZONE, "In", "test-zone-1", "test-zone-2")]
+        )
+    )
+    pod = make_pod(node_selector={ZONE: "test-zone-2"})
+    env.expect_provisioned(pod)
+    node = env.expect_scheduled(pod)
+    assert node.metadata.labels.get(ZONE) == "test-zone-2"
+
+
+def test_hostname_selector_not_scheduled(env):
+    """suite_test.go:183-190."""
+    env.expect_applied(make_provisioner(name="default"))
+    pod = make_pod(node_selector={LABEL_HOSTNAME: "red-node"})
+    env.expect_provisioned(pod)
+    env.expect_not_scheduled(pod)
+
+
+def test_unknown_zone_selector_not_scheduled(env):
+    """suite_test.go:191-200."""
+    env.expect_applied(
+        make_provisioner(name="default", requirements=[req(ZONE, "In", "test-zone-1")])
+    )
+    pod = make_pod(node_selector={ZONE: "unknown"})
+    env.expect_provisioned(pod)
+    env.expect_not_scheduled(pod)
+
+
+def test_selector_outside_provisioner_constraints_not_scheduled(env):
+    """suite_test.go:201-210."""
+    env.expect_applied(
+        make_provisioner(name="default", requirements=[req(ZONE, "In", "test-zone-1")])
+    )
+    pod = make_pod(node_selector={ZONE: "test-zone-2"})
+    env.expect_provisioned(pod)
+    env.expect_not_scheduled(pod)
+
+
+def test_compatible_requirements_in_operator(env):
+    """suite_test.go:211-221."""
+    env.expect_applied(make_provisioner(name="default"))
+    pod = make_pod(node_affinity_required=terms(req(ZONE, "In", "test-zone-3")))
+    env.expect_provisioned(pod)
+    node = env.expect_scheduled(pod)
+    assert node.metadata.labels.get(ZONE) == "test-zone-3"
+
+
+def test_compatible_requirements_gt_operator(env):
+    """suite_test.go:222-231."""
+    env.expect_applied(
+        make_provisioner(name="default", requirements=[req(INTEGER, "Gt", "8")])
+    )
+    pod = make_pod()
+    env.expect_provisioned(pod)
+    node = env.expect_scheduled(pod)
+    assert node.metadata.labels.get(INTEGER) == "16"
+
+
+def test_compatible_requirements_lt_operator(env):
+    """suite_test.go:232-241."""
+    env.expect_applied(
+        make_provisioner(name="default", requirements=[req(INTEGER, "Lt", "8")])
+    )
+    pod = make_pod()
+    env.expect_provisioned(pod)
+    node = env.expect_scheduled(pod)
+    assert node.metadata.labels.get(INTEGER) == "2"
+
+
+def test_incompatible_requirements_in_unknown_value(env):
+    """suite_test.go:242-251."""
+    env.expect_applied(make_provisioner(name="default"))
+    pod = make_pod(node_affinity_required=terms(req(ZONE, "In", "unknown")))
+    env.expect_provisioned(pod)
+    env.expect_not_scheduled(pod)
+
+
+def test_compatible_requirements_notin_operator(env):
+    """suite_test.go:252-262."""
+    env.expect_applied(make_provisioner(name="default"))
+    pod = make_pod(
+        node_affinity_required=terms(
+            req(ZONE, "NotIn", "test-zone-1", "test-zone-2", "unknown")
+        )
+    )
+    env.expect_provisioned(pod)
+    node = env.expect_scheduled(pod)
+    assert node.metadata.labels.get(ZONE) == "test-zone-3"
+
+
+def test_incompatible_requirements_notin_all_zones(env):
+    """suite_test.go:263-273."""
+    env.expect_applied(make_provisioner(name="default"))
+    pod = make_pod(
+        node_affinity_required=terms(
+            req(ZONE, "NotIn", "test-zone-1", "test-zone-2", "test-zone-3", "unknown")
+        )
+    )
+    env.expect_provisioned(pod)
+    env.expect_not_scheduled(pod)
+
+
+def test_compatible_preferences_and_requirements_in(env):
+    """suite_test.go:274-287."""
+    env.expect_applied(make_provisioner(name="default"))
+    pod = make_pod(
+        node_affinity_required=terms(
+            req(ZONE, "In", "test-zone-1", "test-zone-2", "test-zone-3", "unknown")
+        ),
+        node_affinity_preferred=prefs(req(ZONE, "In", "test-zone-2", "unknown")),
+    )
+    env.expect_provisioned(pod)
+    node = env.expect_scheduled(pod)
+    assert node.metadata.labels.get(ZONE) == "test-zone-2"
+
+
+def test_incompatible_preferences_relaxed_in(env):
+    """suite_test.go:288-300 — conflicting preference is relaxed away."""
+    env.expect_applied(make_provisioner(name="default"))
+    pod = make_pod(
+        node_affinity_required=terms(
+            req(ZONE, "In", "test-zone-1", "test-zone-2", "test-zone-3", "unknown")
+        ),
+        node_affinity_preferred=prefs(req(ZONE, "In", "unknown")),
+    )
+    env.expect_provisioned(pod)
+    env.expect_scheduled(pod)
+
+
+def test_compatible_preferences_and_requirements_notin(env):
+    """suite_test.go:301-314."""
+    env.expect_applied(make_provisioner(name="default"))
+    pod = make_pod(
+        node_affinity_required=terms(
+            req(ZONE, "In", "test-zone-1", "test-zone-2", "test-zone-3", "unknown")
+        ),
+        node_affinity_preferred=prefs(req(ZONE, "NotIn", "test-zone-1", "test-zone-3")),
+    )
+    env.expect_provisioned(pod)
+    node = env.expect_scheduled(pod)
+    assert node.metadata.labels.get(ZONE) == "test-zone-2"
+
+
+def test_incompatible_preferences_relaxed_notin(env):
+    """suite_test.go:315-327."""
+    env.expect_applied(make_provisioner(name="default"))
+    pod = make_pod(
+        node_affinity_required=terms(
+            req(ZONE, "In", "test-zone-1", "test-zone-2", "test-zone-3", "unknown")
+        ),
+        node_affinity_preferred=prefs(
+            req(ZONE, "NotIn", "test-zone-1", "test-zone-2", "test-zone-3")
+        ),
+    )
+    env.expect_provisioned(pod)
+    env.expect_scheduled(pod)
+
+
+def test_compatible_selectors_preferences_requirements(env):
+    """suite_test.go:328-342."""
+    env.expect_applied(make_provisioner(name="default"))
+    pod = make_pod(
+        node_selector={ZONE: "test-zone-3"},
+        node_affinity_required=terms(
+            req(ZONE, "In", "test-zone-1", "test-zone-2", "test-zone-3")
+        ),
+        node_affinity_preferred=prefs(
+            req(ZONE, "In", "test-zone-1", "test-zone-2", "test-zone-3")
+        ),
+    )
+    env.expect_provisioned(pod)
+    node = env.expect_scheduled(pod)
+    assert node.metadata.labels.get(ZONE) == "test-zone-3"
+
+
+def test_multidimensional_selectors_preferences_requirements(env):
+    """suite_test.go:343-365."""
+    env.expect_applied(make_provisioner(name="default"))
+    pod = make_pod(
+        node_selector={ZONE: "test-zone-3", ITYPE: "arm-instance-type"},
+        node_affinity_required=terms(
+            req(ZONE, "In", "test-zone-1", "test-zone-3"),
+            req(ITYPE, "In", "default-instance-type", "arm-instance-type"),
+        ),
+        node_affinity_preferred=prefs(
+            req(ZONE, "NotIn", "unknown"),
+            req(ITYPE, "NotIn", "unknown"),
+        ),
+    )
+    env.expect_provisioned(pod)
+    node = env.expect_scheduled(pod)
+    assert node.metadata.labels.get(ZONE) == "test-zone-3"
+    assert node.metadata.labels.get(ITYPE) == "arm-instance-type"
+
+
+# -- Custom Constraints / Constraints Validation (suite_test.go:367-423) ----
+
+
+def test_restricted_labels_not_scheduled(env):
+    """suite_test.go:368-378."""
+    env.expect_applied(make_provisioner(name="default"))
+    for label in api_labels.RESTRICTED_LABELS:
+        pod = make_pod(node_affinity_required=terms(req(label, "In", "test")))
+        env.expect_provisioned(pod)
+        env.expect_not_scheduled(pod)
+
+
+def test_restricted_domains_not_scheduled(env):
+    """suite_test.go:379-389."""
+    env.expect_applied(make_provisioner(name="default"))
+    for domain in api_labels.RESTRICTED_LABEL_DOMAINS:
+        pod = make_pod(
+            node_affinity_required=terms(req(domain + "/test", "In", "test"))
+        )
+        env.expect_provisioned(pod)
+        env.expect_not_scheduled(pod)
+
+
+def test_domain_exception_labels_scheduled(env):
+    """suite_test.go:390-403."""
+    requirements = [
+        req(domain + "/test", "In", "test-value")
+        for domain in api_labels.LABEL_DOMAIN_EXCEPTIONS
+    ]
+    env.expect_applied(make_provisioner(name="default", requirements=requirements))
+    for domain in api_labels.LABEL_DOMAIN_EXCEPTIONS:
+        pod = make_pod()
+        env.expect_provisioned(pod)
+        node = env.expect_scheduled(pod)
+        assert node.metadata.labels.get(domain + "/test") == "test-value"
+
+
+def test_well_known_label_selectors_scheduled(env):
+    """suite_test.go:404-422."""
+    schedulable = [
+        make_pod(node_selector={ZONE: "test-zone-1"}),
+        make_pod(node_selector={ITYPE: "default-instance-type"}),
+        make_pod(node_selector={LABEL_ARCH_STABLE: "arm64"}),
+        make_pod(node_selector={LABEL_OS_STABLE: "linux"}),
+        make_pod(node_selector={CT: "spot"}),
+    ]
+    env.expect_applied(make_provisioner(name="default"))
+    env.expect_provisioned(*schedulable)
+    for pod in schedulable:
+        env.expect_scheduled(pod)
+
+
+# -- Custom Constraints / Scheduling Logic (suite_test.go:424-594) ----------
+
+
+def test_in_undefined_key_not_scheduled(env):
+    """suite_test.go:425-433."""
+    env.expect_applied(make_provisioner(name="default"))
+    pod = make_pod(node_affinity_required=terms(req("test-key", "In", "test-value")))
+    env.expect_provisioned(pod)
+    env.expect_not_scheduled(pod)
+
+
+def test_notin_undefined_key_scheduled(env):
+    """suite_test.go:434-443."""
+    env.expect_applied(make_provisioner(name="default"))
+    pod = make_pod(node_affinity_required=terms(req("test-key", "NotIn", "test-value")))
+    env.expect_provisioned(pod)
+    node = env.expect_scheduled(pod)
+    assert node.metadata.labels.get("test-key") != "test-value"
+
+
+def test_exists_undefined_key_not_scheduled(env):
+    """suite_test.go:444-452."""
+    env.expect_applied(make_provisioner(name="default"))
+    pod = make_pod(node_affinity_required=terms(req("test-key", "Exists")))
+    env.expect_provisioned(pod)
+    env.expect_not_scheduled(pod)
+
+
+def test_doesnotexist_undefined_key_scheduled(env):
+    """suite_test.go:453-462."""
+    env.expect_applied(make_provisioner(name="default"))
+    pod = make_pod(node_affinity_required=terms(req("test-key", "DoesNotExist")))
+    env.expect_provisioned(pod)
+    node = env.expect_scheduled(pod)
+    assert "test-key" not in node.metadata.labels
+
+
+def test_unconstrained_pod_gets_provisioner_requirement_label(env):
+    """suite_test.go:463-471."""
+    env.expect_applied(
+        make_provisioner(name="default", requirements=[req("test-key", "In", "test-value")])
+    )
+    pod = make_pod()
+    env.expect_provisioned(pod)
+    node = env.expect_scheduled(pod)
+    assert node.metadata.labels.get("test-key") == "test-value"
+
+
+def test_in_matching_value_scheduled(env):
+    """suite_test.go:472-483."""
+    env.expect_applied(
+        make_provisioner(name="default", requirements=[req("test-key", "In", "test-value")])
+    )
+    pod = make_pod(node_affinity_required=terms(req("test-key", "In", "test-value")))
+    env.expect_provisioned(pod)
+    node = env.expect_scheduled(pod)
+    assert node.metadata.labels.get("test-key") == "test-value"
+
+
+def test_notin_matching_value_not_scheduled(env):
+    """suite_test.go:484-494."""
+    env.expect_applied(
+        make_provisioner(name="default", requirements=[req("test-key", "In", "test-value")])
+    )
+    pod = make_pod(node_affinity_required=terms(req("test-key", "NotIn", "test-value")))
+    env.expect_provisioned(pod)
+    env.expect_not_scheduled(pod)
+
+
+def test_exists_defined_key_scheduled(env):
+    """suite_test.go:495-506."""
+    env.expect_applied(
+        make_provisioner(name="default", requirements=[req("test-key", "In", "test-value")])
+    )
+    pod = make_pod(node_affinity_required=terms(req("test-key", "Exists")))
+    env.expect_provisioned(pod)
+    env.expect_scheduled(pod)
+
+
+def test_doesnotexist_defined_key_not_scheduled(env):
+    """suite_test.go:507-518."""
+    env.expect_applied(
+        make_provisioner(name="default", requirements=[req("test-key", "In", "test-value")])
+    )
+    pod = make_pod(node_affinity_required=terms(req("test-key", "DoesNotExist")))
+    env.expect_provisioned(pod)
+    env.expect_not_scheduled(pod)
+
+
+def test_in_different_value_not_scheduled(env):
+    """suite_test.go:519-529."""
+    env.expect_applied(
+        make_provisioner(name="default", requirements=[req("test-key", "In", "test-value")])
+    )
+    pod = make_pod(node_affinity_required=terms(req("test-key", "In", "another-value")))
+    env.expect_provisioned(pod)
+    env.expect_not_scheduled(pod)
+
+
+def test_notin_different_value_scheduled(env):
+    """suite_test.go:530-541."""
+    env.expect_applied(
+        make_provisioner(name="default", requirements=[req("test-key", "In", "test-value")])
+    )
+    pod = make_pod(node_affinity_required=terms(req("test-key", "NotIn", "another-value")))
+    env.expect_provisioned(pod)
+    node = env.expect_scheduled(pod)
+    assert node.metadata.labels.get("test-key") == "test-value"
+
+
+def test_compatible_pods_share_node(env):
+    """suite_test.go:542-561."""
+    env.expect_applied(
+        make_provisioner(
+            name="default",
+            requirements=[req("test-key", "In", "test-value", "another-value")],
+        )
+    )
+    pods = [
+        make_pod(node_affinity_required=terms(req("test-key", "In", "test-value"))),
+        make_pod(node_affinity_required=terms(req("test-key", "NotIn", "another-value"))),
+    ]
+    env.expect_provisioned(*pods)
+    node1 = env.expect_scheduled(pods[0])
+    node2 = env.expect_scheduled(pods[1])
+    assert node1.metadata.labels.get("test-key") == "test-value"
+    assert node2.metadata.labels.get("test-key") == "test-value"
+    assert node1.metadata.name == node2.metadata.name
+
+
+def test_incompatible_pods_different_nodes(env):
+    """suite_test.go:562-581."""
+    env.expect_applied(
+        make_provisioner(
+            name="default",
+            requirements=[req("test-key", "In", "test-value", "another-value")],
+        )
+    )
+    pods = [
+        make_pod(node_affinity_required=terms(req("test-key", "In", "test-value"))),
+        make_pod(node_affinity_required=terms(req("test-key", "In", "another-value"))),
+    ]
+    env.expect_provisioned(*pods)
+    node1 = env.expect_scheduled(pods[0])
+    node2 = env.expect_scheduled(pods[1])
+    assert node1.metadata.labels.get("test-key") == "test-value"
+    assert node2.metadata.labels.get("test-key") == "another-value"
+    assert node1.metadata.name != node2.metadata.name
+
+
+def test_exists_does_not_overwrite_existing_value(env):
+    """suite_test.go:582-592."""
+    env.expect_applied(make_provisioner(name="default"))
+    pod = make_pod(
+        node_affinity_required=terms(
+            req(ZONE, "In", "non-existent-zone"), req(ZONE, "Exists")
+        )
+    )
+    env.expect_provisioned(pod)
+    env.expect_not_scheduled(pod)
+
+
+# -- Preferential Fallback / Required (suite_test.go:596-636) ---------------
+
+
+def test_does_not_relax_final_required_term(env):
+    """suite_test.go:598-613."""
+    env.expect_applied(
+        make_provisioner(
+            name="default",
+            requirements=[
+                req(ZONE, "In", "test-zone-1"),
+                req(ITYPE, "In", "default-instance-type"),
+            ],
+        )
+    )
+    pod = make_pod(node_affinity_required=terms(req(ZONE, "In", "invalid")))
+    env.expect_provisioned(pod)
+    env.expect_not_scheduled(pod)
+
+
+def test_relaxes_multiple_required_terms(env):
+    """suite_test.go:614-636 — OR terms tried in order; first viable wins."""
+    env.expect_applied(make_provisioner(name="default"))
+    pod = make_pod(
+        node_affinity_required=[
+            NodeSelectorTerm(match_expressions=[req(ZONE, "In", "invalid")]),
+            NodeSelectorTerm(match_expressions=[req(ZONE, "In", "invalid")]),
+            NodeSelectorTerm(match_expressions=[req(ZONE, "In", "test-zone-1")]),
+            NodeSelectorTerm(match_expressions=[req(ZONE, "In", "test-zone-2")]),
+        ]
+    )
+    env.expect_provisioned(pod)
+    node = env.expect_scheduled(pod)
+    assert node.metadata.labels.get(ZONE) == "test-zone-1"
+
+
+# -- Preferential Fallback / Preferred (suite_test.go:637-716) --------------
+
+
+def test_relaxes_all_preferred_terms(env):
+    """suite_test.go:638-656."""
+    env.expect_applied(make_provisioner(name="default"))
+    pod = make_pod(
+        node_affinity_preferred=[
+            PreferredSchedulingTerm(
+                weight=1,
+                preference=NodeSelectorTerm(match_expressions=[req(ZONE, "In", "invalid")]),
+            ),
+            PreferredSchedulingTerm(
+                weight=1,
+                preference=NodeSelectorTerm(match_expressions=[req(ITYPE, "In", "invalid")]),
+            ),
+        ]
+    )
+    env.expect_provisioned(pod)
+    env.expect_scheduled(pod)
+
+
+def test_relaxes_to_lighter_weights(env):
+    """suite_test.go:657-683 — heaviest preferences dropped first."""
+    env.expect_applied(
+        make_provisioner(
+            name="default",
+            requirements=[req(ZONE, "In", "test-zone-1", "test-zone-2")],
+        )
+    )
+    pod = make_pod(
+        node_affinity_preferred=[
+            PreferredSchedulingTerm(
+                weight=100,
+                preference=NodeSelectorTerm(
+                    match_expressions=[req(ITYPE, "In", "test-zone-3")]
+                ),
+            ),
+            PreferredSchedulingTerm(
+                weight=50,
+                preference=NodeSelectorTerm(
+                    match_expressions=[req(ZONE, "In", "test-zone-2")]
+                ),
+            ),
+            PreferredSchedulingTerm(
+                weight=1,
+                preference=NodeSelectorTerm(
+                    match_expressions=[req(ZONE, "In", "test-zone-1")]
+                ),
+            ),
+        ]
+    )
+    env.expect_provisioned(pod)
+    node = env.expect_scheduled(pod)
+    assert node.metadata.labels.get(ZONE) == "test-zone-2"
+
+
+def test_preference_conflicting_with_requirement_scheduled(env):
+    """suite_test.go:684-704."""
+    env.expect_applied(make_provisioner(name="default"))
+    pod = make_pod(
+        node_affinity_required=terms(req(ZONE, "In", "test-zone-3")),
+        node_affinity_preferred=prefs(req(ZONE, "NotIn", "test-zone-3")),
+    )
+    env.expect_provisioned(pod)
+    node = env.expect_scheduled(pod)
+    assert node.metadata.labels.get(ZONE) == "test-zone-3"
+
+
+def test_conflicting_preference_requirements_scheduled(env):
+    """suite_test.go:705-715."""
+    env.expect_applied(make_provisioner(name="default"))
+    pod = make_pod(
+        node_affinity_preferred=prefs(
+            req(ZONE, "In", "invalid"), req(ZONE, "NotIn", "invalid")
+        )
+    )
+    env.expect_provisioned(pod)
+    env.expect_scheduled(pod)
+
+
+def test_pod_opted_out_of_karpenter_is_ignored(env):
+    """provisioner.go:386-394 — a pod requiring provisioner-name DoesNotExist
+    (e.g. the controller's own replicas) never enters the batch."""
+    env.expect_applied(make_provisioner(name="default"))
+    opted_out = make_pod(
+        node_affinity_required=terms(
+            req(api_labels.PROVISIONER_NAME_LABEL_KEY, "DoesNotExist")
+        )
+    )
+    normal = make_pod()
+    env.expect_provisioned(opted_out, normal)
+    env.expect_scheduled(normal)
+    env.expect_not_scheduled(opted_out)
+    assert opted_out.metadata.name not in {
+        p.metadata.name for p in env.provisioning.get_pending_pods()
+    }

@@ -1,0 +1,402 @@
+"""Port of reference scheduling suite_test.go — Instance Type Compatibility
++ Binpacking describes (suite_test.go:717-1253), spec-for-spec over the
+expectations harness. Cited line numbers refer to
+/root/reference/pkg/controllers/provisioning/scheduling/suite_test.go.
+"""
+import pytest
+
+from karpenter_core_tpu.api import labels as api_labels
+from karpenter_core_tpu.cloudprovider import fake
+from karpenter_core_tpu.cloudprovider.types import Offering
+from karpenter_core_tpu.kube.objects import (
+    LABEL_ARCH_STABLE,
+    LABEL_INSTANCE_TYPE_STABLE,
+    LABEL_OS_STABLE,
+    LABEL_TOPOLOGY_ZONE,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+)
+from karpenter_core_tpu.testing import make_pod, make_provisioner
+from karpenter_core_tpu.testing.expectations import Env
+
+GI = 2**30
+ZONE = LABEL_TOPOLOGY_ZONE
+ITYPE = LABEL_INSTANCE_TYPE_STABLE
+ARCH = LABEL_ARCH_STABLE
+
+
+@pytest.fixture()
+def env():
+    return Env()
+
+
+def req(key, op, *values):
+    return NodeSelectorRequirement(key=key, operator=op, values=list(values))
+
+
+def terms(*exprs):
+    return [NodeSelectorTerm(match_expressions=list(exprs))]
+
+
+def distinct_nodes(env, pods):
+    names = set()
+    for pod in pods:
+        names.add(env.expect_scheduled(pod).metadata.name)
+    return names
+
+
+# -- Instance Type Compatibility (suite_test.go:717-976) --------------------
+
+
+def test_more_resources_than_any_type_not_scheduled(env):
+    """suite_test.go:718-728."""
+    env.expect_applied(make_provisioner(name="default"))
+    pod = make_pod(requests={"cpu": "512"})
+    env.expect_provisioned(pod)
+    env.expect_not_scheduled(pod)
+
+
+def test_different_archs_on_different_instances(env):
+    """suite_test.go:729-751."""
+    env.expect_applied(
+        make_provisioner(name="default", requirements=[req(ARCH, "In", "arm64", "amd64")])
+    )
+    pods = [
+        make_pod(node_selector={ARCH: "amd64"}),
+        make_pod(node_selector={ARCH: "arm64"}),
+    ]
+    env.expect_provisioned(*pods)
+    assert len(distinct_nodes(env, pods)) == 2
+
+
+def test_excludes_types_unsupported_by_pod_constraints_instance_type(env):
+    """suite_test.go:752-770 — arm type conflicts with amd64-only provisioner."""
+    env.expect_applied(
+        make_provisioner(name="default", requirements=[req(ARCH, "In", "amd64")])
+    )
+    pod = make_pod(node_affinity_required=terms(req(ITYPE, "In", "arm-instance-type")))
+    env.expect_provisioned(pod)
+    env.expect_not_scheduled(pod)
+
+
+def test_excludes_types_unsupported_by_pod_constraints_os(env):
+    """suite_test.go:771-790 — the only ios-OS type is arm, disallowed."""
+    env.expect_applied(
+        make_provisioner(name="default", requirements=[req(ARCH, "In", "amd64")])
+    )
+    pod = make_pod(node_affinity_required=terms(req(LABEL_OS_STABLE, "In", "ios")))
+    env.expect_provisioned(pod)
+    env.expect_not_scheduled(pod)
+
+
+def test_excludes_types_unsupported_by_provider_arch_constraint(env):
+    """suite_test.go:791-803 — only the arm type has 14 cpu."""
+    env.expect_applied(
+        make_provisioner(name="default", requirements=[req(ARCH, "In", "amd64")])
+    )
+    pod = make_pod(limits={"cpu": "14"})
+    env.expect_provisioned(pod)
+    env.expect_not_scheduled(pod)
+
+
+def test_different_operating_systems_on_different_instances(env):
+    """suite_test.go:804-826."""
+    env.expect_applied(
+        make_provisioner(name="default", requirements=[req(ARCH, "In", "arm64", "amd64")])
+    )
+    pods = [
+        make_pod(node_selector={LABEL_OS_STABLE: "linux"}),
+        make_pod(node_selector={LABEL_OS_STABLE: "windows"}),
+    ]
+    env.expect_provisioned(*pods)
+    assert len(distinct_nodes(env, pods)) == 2
+
+
+def test_different_instance_type_selectors_on_different_instances(env):
+    """suite_test.go:827-849."""
+    env.expect_applied(
+        make_provisioner(name="default", requirements=[req(ARCH, "In", "arm64", "amd64")])
+    )
+    pods = [
+        make_pod(node_selector={ITYPE: "small-instance-type"}),
+        make_pod(node_selector={ITYPE: "default-instance-type"}),
+    ]
+    env.expect_provisioned(*pods)
+    assert len(distinct_nodes(env, pods)) == 2
+
+
+def test_different_zone_selectors_on_different_instances(env):
+    """suite_test.go:850-872."""
+    env.expect_applied(
+        make_provisioner(name="default", requirements=[req(ARCH, "In", "arm64", "amd64")])
+    )
+    pods = [
+        make_pod(node_selector={ZONE: "test-zone-1"}),
+        make_pod(node_selector={ZONE: "test-zone-2"}),
+    ]
+    env.expect_provisioned(*pods)
+    assert len(distinct_nodes(env, pods)) == 2
+
+
+def test_disjoint_extended_resources_on_different_instances():
+    """suite_test.go:873-901 — no type has both GPUs."""
+    universe = fake.instance_types(5)
+    universe[0].capacity["karpenter.sh/super-great-gpu"] = 25.0
+    universe[1].capacity["karpenter.sh/even-better-gpu"] = 25.0
+    env = Env(universe=universe)
+    env.expect_applied(make_provisioner(name="default"))
+    pods = [
+        make_pod(limits={"karpenter.sh/super-great-gpu": "1"}),
+        make_pod(limits={"karpenter.sh/even-better-gpu": "1"}),
+    ]
+    env.expect_provisioned(*pods)
+    assert len(distinct_nodes(env, pods)) == 2
+
+
+def test_conjoint_extended_resources_not_schedulable():
+    """suite_test.go:902-919 — one pod needing both GPUs fails."""
+    universe = fake.instance_types(5)
+    universe[0].capacity["karpenter.sh/super-great-gpu"] = 25.0
+    universe[1].capacity["karpenter.sh/even-better-gpu"] = 25.0
+    env = Env(universe=universe)
+    env.expect_applied(make_provisioner(name="default"))
+    pod = make_pod(
+        limits={"karpenter.sh/super-great-gpu": "1", "karpenter.sh/even-better-gpu": "1"}
+    )
+    env.expect_provisioned(pod)
+    env.expect_not_scheduled(pod)
+
+
+# -- Provider Specific Labels (suite_test.go:920-975) -----------------------
+
+
+def test_filters_types_matching_provider_labels():
+    """suite_test.go:921-933 — size label selects ladder ends."""
+    env = Env(universe=fake.instance_types(5))
+    env.expect_applied(make_provisioner(name="default"))
+    pods = [
+        make_pod(node_selector={fake.LABEL_INSTANCE_SIZE: "large"}),
+        make_pod(node_selector={fake.LABEL_INSTANCE_SIZE: "small"}),
+    ]
+    env.expect_provisioned(*pods)
+    assert env.expect_scheduled(pods[0]).metadata.labels[ITYPE] == "fake-it-4"
+    assert env.expect_scheduled(pods[1]).metadata.labels[ITYPE] == "fake-it-0"
+
+
+def test_incompatible_provider_labels_not_scheduled():
+    """suite_test.go:934-950."""
+    universe = fake.instance_types(5)
+    env = Env(universe=universe)
+    env.expect_applied(make_provisioner(name="default"))
+    pods = [
+        make_pod(
+            node_selector={fake.LABEL_INSTANCE_SIZE: "large", ITYPE: universe[0].name}
+        ),
+        make_pod(
+            node_selector={fake.LABEL_INSTANCE_SIZE: "small", ITYPE: universe[4].name}
+        ),
+    ]
+    env.expect_provisioned(*pods)
+    env.expect_not_scheduled(pods[0])
+    env.expect_not_scheduled(pods[1])
+
+
+def test_optional_label_exists():
+    """suite_test.go:951-962 — Exists on a label only some types carry."""
+    universe = fake.instance_types(5)
+    env = Env(universe=universe)
+    env.expect_applied(make_provisioner(name="default"))
+    pod = make_pod(
+        node_affinity_required=terms(req(fake.EXOTIC_INSTANCE_LABEL_KEY, "Exists"))
+    )
+    env.expect_provisioned(pod)
+    node = env.expect_scheduled(pod)
+    assert fake.EXOTIC_INSTANCE_LABEL_KEY in node.metadata.labels
+    assert node.metadata.labels[ITYPE] == universe[4].name
+
+
+def test_optional_label_does_not_exist():
+    """suite_test.go:963-974."""
+    env = Env(universe=fake.instance_types(5))
+    env.expect_applied(make_provisioner(name="default"))
+    pod = make_pod(
+        node_affinity_required=terms(req(fake.EXOTIC_INSTANCE_LABEL_KEY, "DoesNotExist"))
+    )
+    env.expect_provisioned(pod)
+    node = env.expect_scheduled(pod)
+    assert fake.EXOTIC_INSTANCE_LABEL_KEY not in node.metadata.labels
+
+
+# -- Binpacking (suite_test.go:977-1253) ------------------------------------
+
+
+def test_small_pod_on_smallest_instance(env):
+    """suite_test.go:978-989."""
+    env.expect_applied(make_provisioner(name="default"))
+    pod = make_pod(requests={"memory": "100M"})
+    env.expect_provisioned(pod)
+    assert env.expect_scheduled(pod).metadata.labels[ITYPE] == "small-instance-type"
+
+
+def test_small_pod_on_smallest_possible_instance(env):
+    """suite_test.go:990-1001."""
+    env.expect_applied(make_provisioner(name="default"))
+    pod = make_pod(requests={"memory": "2000M"})
+    env.expect_provisioned(pod)
+    assert env.expect_scheduled(pod).metadata.labels[ITYPE] == "small-instance-type"
+
+
+def test_multiple_small_pods_share_smallest_instance(env):
+    """suite_test.go:1002-1020."""
+    env.expect_applied(make_provisioner(name="default"))
+    pods = [make_pod(requests={"memory": "10M"}) for _ in range(5)]
+    env.expect_provisioned(*pods)
+    names = set()
+    for pod in pods:
+        node = env.expect_scheduled(pod)
+        names.add(node.metadata.name)
+        assert node.metadata.labels[ITYPE] == "small-instance-type"
+    assert len(names) == 1
+
+
+def test_new_nodes_when_at_capacity(env):
+    """suite_test.go:1021-1040 — 40 x 1.8G pods -> 20 default nodes."""
+    env.expect_applied(make_provisioner(name="default"))
+    pods = [
+        make_pod(node_selector={ARCH: "amd64"}, requests={"memory": "1.8G"})
+        for _ in range(40)
+    ]
+    env.expect_provisioned(*pods)
+    names = set()
+    for pod in pods:
+        node = env.expect_scheduled(pod)
+        names.add(node.metadata.name)
+        assert node.metadata.labels[ITYPE] == "default-instance-type"
+    assert len(names) == 20
+
+
+def test_packs_small_and_large_pods_together(env):
+    """suite_test.go:1041-1072."""
+    env.expect_applied(make_provisioner(name="default"))
+    large = [
+        make_pod(node_selector={ARCH: "amd64"}, requests={"memory": "1.8G"})
+        for _ in range(40)
+    ]
+    small = [
+        make_pod(node_selector={ARCH: "amd64"}, requests={"memory": "400M"})
+        for _ in range(20)
+    ]
+    pods = large + small
+    env.expect_provisioned(*pods)
+    names = set()
+    for pod in pods:
+        node = env.expect_scheduled(pod)
+        names.add(node.metadata.name)
+        assert node.metadata.labels[ITYPE] == "default-instance-type"
+    assert len(names) == 20
+
+
+def test_packs_nodes_tightly():
+    """suite_test.go:1073-1098 — big pod then small pod get different sizes."""
+    env = Env(universe=fake.instance_types(5))
+    env.expect_applied(make_provisioner(name="default"))
+    pods = [
+        make_pod(requests={"cpu": "4.5"}),
+        make_pod(requests={"cpu": "1"}),
+    ]
+    env.expect_provisioned(*pods)
+    node1 = env.expect_scheduled(pods[0])
+    node2 = env.expect_scheduled(pods[1])
+    assert node1.metadata.labels[ITYPE] != node2.metadata.labels[ITYPE]
+
+
+def test_zero_quantity_resource_requests(env):
+    """suite_test.go:1099-1110."""
+    env.expect_applied(make_provisioner(name="default"))
+    pod = make_pod(
+        requests={"foo.com/weird-resources": "0"},
+        limits={"foo.com/weird-resources": "0"},
+    )
+    env.expect_provisioned(pod)
+    env.expect_scheduled(pod)
+
+
+def test_pod_exceeding_every_capacity_not_scheduled(env):
+    """suite_test.go:1111-1121."""
+    env.expect_applied(make_provisioner(name="default"))
+    pod = make_pod(requests={"memory": "2Ti"})
+    env.expect_provisioned(pod)
+    env.expect_not_scheduled(pod)
+
+
+def test_new_nodes_on_pod_count_limit(env):
+    """suite_test.go:1122-1143 — 25 tiny pods, 5-pod cap -> 5 small nodes."""
+    env.expect_applied(make_provisioner(name="default"))
+    pods = [
+        make_pod(
+            node_selector={ARCH: "amd64"}, requests={"memory": "1M", "cpu": "1m"}
+        )
+        for _ in range(25)
+    ]
+    env.expect_provisioned(*pods)
+    names = set()
+    for pod in pods:
+        node = env.expect_scheduled(pod)
+        names.add(node.metadata.name)
+        assert node.metadata.labels[ITYPE] == "small-instance-type"
+    assert len(names) == 5
+
+
+def test_init_container_requests_counted(env):
+    """suite_test.go:1144-1164 — init ceiling forces the bigger type."""
+    env.expect_applied(make_provisioner(name="default"))
+    pod = make_pod(
+        requests={"memory": "1Gi", "cpu": "1"},
+        init_requests={"memory": "1Gi", "cpu": "2"},
+    )
+    env.expect_provisioned(pod)
+    assert env.expect_scheduled(pod).metadata.labels[ITYPE] == "default-instance-type"
+
+
+def test_init_container_requests_exceeding_capacity_not_scheduled(env):
+    """suite_test.go:1165-1184."""
+    env.expect_applied(make_provisioner(name="default"))
+    pod = make_pod(
+        requests={"memory": "1Gi", "cpu": "1"},
+        init_requests={"memory": "1Ti", "cpu": "2"},
+    )
+    env.expect_provisioned(pod)
+    env.expect_not_scheduled(pod)
+
+
+def test_selects_valid_types_regardless_of_price():
+    """suite_test.go:1185-1252 — cheapest valid type wins; all valid options
+    are passed to the cloud provider."""
+    universe = [
+        fake.new_instance_type(
+            "medium",
+            resources={"cpu": 2.0, "memory": 2.0 * GI},
+            offerings=[Offering("on-demand", "test-zone-1a", 3.0)],
+        ),
+        fake.new_instance_type(
+            "small",
+            resources={"cpu": 1.0, "memory": 1.0 * GI},
+            offerings=[Offering("on-demand", "test-zone-1a", 2.0)],
+        ),
+        fake.new_instance_type(
+            "large",
+            resources={"cpu": 4.0, "memory": 4.0 * GI},
+            offerings=[Offering("on-demand", "test-zone-1a", 1.0)],
+        ),
+    ]
+    env = Env(universe=universe)
+    env.expect_applied(make_provisioner(name="default"))
+    pod = make_pod(limits={"cpu": "1m", "memory": "1Mi"})
+    env.expect_provisioned(pod)
+    node = env.expect_scheduled(pod)
+    assert node.metadata.labels[ITYPE] == "large"
+    create_reqs = {
+        r.key: set(r.values)
+        for r in env.cloud_provider.create_calls[0].spec.requirements
+    }
+    assert create_reqs[ITYPE] == {"small", "medium", "large"}

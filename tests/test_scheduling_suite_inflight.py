@@ -1,0 +1,402 @@
+"""Port of reference scheduling suite_test.go — In-Flight Nodes describe
+(suite_test.go:1254-1828): in-flight reuse, zone/hostname balance against
+in-flight nodes, taint assumptions, daemonset accounting, bin-pack-first.
+Cited line numbers refer to
+/root/reference/pkg/controllers/provisioning/scheduling/suite_test.go.
+
+nodeStateController/podStateController reconciles map to op.sync_state()
+(the level-triggered informer relist) and cluster.update_pod.
+"""
+import pytest
+
+from karpenter_core_tpu.api import labels as api_labels
+from karpenter_core_tpu.cloudprovider import fake
+from karpenter_core_tpu.kube.objects import (
+    LABEL_ARCH_STABLE,
+    LABEL_HOSTNAME,
+    LABEL_TOPOLOGY_ZONE,
+    LabelSelector,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    Taint,
+    TopologySpreadConstraint,
+)
+from karpenter_core_tpu.testing import make_daemonset, make_pod, make_provisioner
+from karpenter_core_tpu.testing.expectations import Env
+
+ZONE = LABEL_TOPOLOGY_ZONE
+
+
+@pytest.fixture()
+def env():
+    return Env()
+
+
+def req(key, op, *values):
+    return NodeSelectorRequirement(key=key, operator=op, values=list(values))
+
+
+def terms(*exprs):
+    return [NodeSelectorTerm(match_expressions=list(exprs))]
+
+
+def spread(key=ZONE, selector=None):
+    return TopologySpreadConstraint(
+        max_skew=1,
+        topology_key=key,
+        when_unsatisfiable="DoNotSchedule",
+        label_selector=LabelSelector(match_labels=selector or {"foo": "bar"}),
+    )
+
+
+def test_reuses_inflight_node_with_capacity(env):
+    """suite_test.go:1255-1271."""
+    env.expect_applied(make_provisioner(name="default"))
+    initial = make_pod(limits={"cpu": "10m"})
+    env.expect_provisioned(initial)
+    node1 = env.expect_scheduled(initial)
+    env.op.sync_state()
+
+    second = make_pod(limits={"cpu": "10m"})
+    env.expect_provisioned(second)
+    node2 = env.expect_scheduled(second)
+    assert node1.metadata.name == node2.metadata.name
+
+
+def test_reuses_inflight_node_node_selectors(env):
+    """suite_test.go:1272-1320 — zone intersection reuses; disjoint opens."""
+    env.expect_applied(make_provisioner(name="default"))
+    initial = make_pod(
+        limits={"cpu": "10m"},
+        node_affinity_required=terms(req(ZONE, "In", "test-zone-2")),
+    )
+    env.expect_provisioned(initial)
+    node1 = env.expect_scheduled(initial)
+    env.op.sync_state()
+
+    second = make_pod(
+        limits={"cpu": "10m"},
+        node_affinity_required=terms(req(ZONE, "In", "test-zone-1", "test-zone-2")),
+    )
+    env.expect_provisioned(second)
+    node2 = env.expect_scheduled(second)
+    assert node1.metadata.name == node2.metadata.name
+    env.op.sync_state()
+
+    third = make_pod(
+        limits={"cpu": "10m"},
+        node_affinity_required=terms(req(ZONE, "In", "test-zone-1", "test-zone-3")),
+    )
+    env.expect_provisioned(third)
+    node3 = env.expect_scheduled(third)
+    assert node1.metadata.name != node3.metadata.name
+
+
+def test_second_node_when_pod_does_not_fit(env):
+    """suite_test.go:1321-1339."""
+    env.expect_applied(make_provisioner(name="default"))
+    initial = make_pod(limits={"cpu": "1001m"})
+    env.expect_provisioned(initial)
+    node1 = env.expect_scheduled(initial)
+    env.op.sync_state()
+
+    second = make_pod(limits={"cpu": "1"})
+    env.expect_provisioned(second)
+    node2 = env.expect_scheduled(second)
+    assert node1.metadata.name != node2.metadata.name
+
+
+def test_second_node_when_pod_incompatible_selector(env):
+    """suite_test.go:1340-1356."""
+    env.expect_applied(make_provisioner(name="default"))
+    initial = make_pod(limits={"cpu": "10m"})
+    env.expect_provisioned(initial)
+    node1 = env.expect_scheduled(initial)
+    env.op.sync_state()
+
+    second = make_pod(node_selector={LABEL_ARCH_STABLE: "arm64"})
+    env.expect_provisioned(second)
+    node2 = env.expect_scheduled(second)
+    assert node1.metadata.name != node2.metadata.name
+
+
+def test_second_node_when_inflight_terminating(env):
+    """suite_test.go:1357-1379."""
+    env.expect_applied(make_provisioner(name="default"))
+    initial = make_pod(limits={"cpu": "10m"})
+    env.expect_provisioned(initial)
+    node1 = env.expect_scheduled(initial)
+    env.op.sync_state()
+
+    env.expect_deleted(node1)
+    env.op.sync_state()
+
+    second = make_pod(limits={"cpu": "10m"})
+    env.expect_provisioned(second)
+    node2 = env.expect_scheduled(second)
+    assert node1.metadata.name != node2.metadata.name
+
+
+# -- Topology with in-flight nodes (suite_test.go:1380-1452) ----------------
+
+
+def test_balances_zones_with_inflight_nodes(env):
+    """suite_test.go:1381-1418."""
+    labels = {"foo": "bar"}
+    topo = spread()
+    env.expect_applied(make_provisioner(name="default"))
+    pods = [make_pod(labels=labels, topology_spread=[topo]) for _ in range(4)]
+    env.expect_provisioned(*pods)
+    assert sorted(env.expect_skew("default", topo).values()) == [1, 1, 2]
+
+    env.op.sync_state()
+    first_round_nodes = len(env.kube.list("Node"))
+    more = [make_pod(labels=labels, topology_spread=[topo]) for _ in range(5)]
+    env.expect_provisioned(*more)
+    assert sorted(env.expect_skew("default", topo).values()) == [3, 3, 3]
+    # in-flight nodes absorb the second round
+    assert len(env.kube.list("Node")) == first_round_nodes
+
+
+def test_balances_hostnames_with_inflight_nodes(env):
+    """suite_test.go:1419-1452 — hostname spread prefers fresh nodes."""
+    labels = {"foo": "bar"}
+    topo = spread(key=LABEL_HOSTNAME)
+    env.expect_applied(make_provisioner(name="default"))
+    pods = [make_pod(labels=labels, topology_spread=[topo]) for _ in range(4)]
+    env.expect_provisioned(*pods)
+    assert sorted(env.expect_skew("default", topo).values()) == [1, 1, 1, 1]
+
+    env.op.sync_state()
+    more = [make_pod(labels=labels, topology_spread=[topo]) for _ in range(5)]
+    env.expect_provisioned(*more)
+    assert sorted(env.expect_skew("default", topo).values()) == [1] * 9
+
+
+# -- Taints with in-flight nodes (suite_test.go:1453-1588) ------------------
+
+
+def test_assumes_pod_schedules_to_untainted_node(env):
+    """suite_test.go:1454-1475."""
+    env.expect_applied(make_provisioner(name="default"))
+    initial = make_pod(limits={"cpu": "8"})
+    env.expect_provisioned(initial)
+    node1 = env.expect_scheduled(initial)
+
+    env.expect_deleted(initial)
+    node1.spec.taints = []
+    env.expect_applied(node1)
+    env.op.sync_state()
+
+    second = make_pod()
+    env.expect_provisioned(second)
+    node2 = env.expect_scheduled(second)
+    assert node1.metadata.name == node2.metadata.name
+
+
+def test_does_not_assume_pod_schedules_to_tainted_node(env):
+    """suite_test.go:1476-1502."""
+    env.expect_applied(make_provisioner(name="default"))
+    initial = make_pod(limits={"cpu": "8"})
+    env.expect_provisioned(initial)
+    node1 = env.expect_scheduled(initial)
+
+    env.expect_deleted(initial)
+    env.drop_machine(node1)  # raw-node path: the spec taints the Node directly
+    node1.spec.taints = list(node1.spec.taints) + [
+        Taint(key="foo.com/taint", value="tainted", effect="NoSchedule")
+    ]
+    env.expect_applied(node1)
+    env.op.sync_state()
+
+    second = make_pod()
+    env.expect_provisioned(second)
+    node2 = env.expect_scheduled(second)
+    assert node1.metadata.name != node2.metadata.name
+
+
+def test_assumes_pod_schedules_through_custom_startup_taint(env):
+    """suite_test.go:1503-1535 — startup taints don't block assumption."""
+    env.expect_applied(
+        make_provisioner(
+            name="default",
+            startup_taints=[Taint(key="foo.com/taint", value="tainted", effect="NoSchedule")],
+        )
+    )
+    initial = make_pod(limits={"cpu": "8"})
+    env.expect_provisioned(initial)
+    node1 = env.expect_scheduled(initial)
+
+    env.expect_deleted(initial)
+    assert any(t.key == "foo.com/taint" for t in node1.spec.taints)
+    env.expect_applied(node1)
+    env.op.sync_state()
+
+    second = make_pod()
+    env.expect_provisioned(second)
+    node2 = env.expect_scheduled(second)
+    assert node1.metadata.name == node2.metadata.name
+
+
+def test_does_not_assume_startup_taint_after_initialization(env):
+    """suite_test.go:1536-1561."""
+    startup = Taint(key="ignore-me", value="nothing-to-see-here", effect="NoSchedule")
+    env.expect_applied(make_provisioner(name="default", startup_taints=[startup]))
+    initial = make_pod()
+    env.expect_provisioned(initial)
+    node1 = env.expect_scheduled(initial)
+
+    env.expect_deleted(initial)
+    env.drop_machine(node1)  # raw-node path: initialized label set by hand
+    node1.metadata.labels[api_labels.LABEL_NODE_INITIALIZED] = "true"
+    node1.spec.taints = [startup]
+    node1.status.capacity = {"pods": 10.0}
+    env.expect_applied(node1)
+    env.op.sync_state()
+
+    second = make_pod()
+    env.expect_provisioned(second)
+    node2 = env.expect_scheduled(second)
+    assert node1.metadata.name != node2.metadata.name
+
+
+def test_tainted_notready_node_is_inflight_even_if_initialized(env):
+    """suite_test.go:1562-1588 — ephemeral not-ready taints are masked."""
+    env.expect_applied(make_provisioner(name="default"))
+    pod = make_pod(requests={"cpu": "10m"})
+    env.expect_provisioned(pod)
+    node1 = env.expect_scheduled(pod)
+    env.op.sync_state()
+
+    node1.metadata.labels[api_labels.LABEL_NODE_INITIALIZED] = "true"
+    node1.spec.taints = [
+        Taint(key="node.kubernetes.io/not-ready", effect="NoSchedule"),
+        Taint(key="node.kubernetes.io/unreachable", effect="NoSchedule"),
+    ]
+    env.expect_applied(node1)
+    env.op.sync_state()
+
+    pod2 = make_pod(requests={"cpu": "10m"})
+    env.expect_provisioned(pod2)
+    node2 = env.expect_scheduled(pod2)
+    assert node1.metadata.name == node2.metadata.name
+
+
+# -- Daemonsets with in-flight nodes (suite_test.go:1589-1757) --------------
+
+
+def test_daemonset_usage_tracked_separately(env):
+    """suite_test.go:1590-1663."""
+    ds = make_daemonset(requests={"cpu": "1", "memory": "1Gi"})
+    env.expect_applied(make_provisioner(name="default"), ds)
+
+    initial = make_pod(limits={"cpu": "8"})
+    env.expect_provisioned(initial)
+    node1 = env.expect_scheduled(initial)
+
+    ds_pod = make_pod(requests={"cpu": "1", "memory": "2Gi"}, owner_kind="DaemonSet")
+    env.expect_deleted(initial)
+    env.op.sync_state()
+    env.expect_applied(ds_pod)
+    for state_node in env.cluster.nodes():
+        assert state_node.total_daemonset_requests().get("cpu", 0.0) == pytest.approx(0)
+        # full 16 cpu - 100m overhead (the 8-cpu pod forced the arm type)
+        assert state_node.available().get("cpu", 0.0) == pytest.approx(15.9)
+
+    env.expect_manual_binding(ds_pod, node1)
+    env.op.sync_state()
+    for state_node in env.cluster.nodes():
+        assert state_node.total_daemonset_requests().get("cpu", 0.0) == pytest.approx(1)
+        assert state_node.available().get("cpu", 0.0) == pytest.approx(14.9)
+
+    second = make_pod(limits={"cpu": "14.9"})
+    env.expect_provisioned(second)
+    node2 = env.expect_scheduled(second)
+    assert node1.metadata.name == node2.metadata.name
+
+
+def test_unexpected_daemonset_pod_binding(env):
+    """suite_test.go:1664-1756 — unexpected node label attracting a DS pod
+    must not corrupt the remaining-daemonset accounting."""
+    ds1 = make_daemonset(
+        requests={"cpu": "1", "memory": "1Gi"}, node_selector={"my-node-label": "value"}
+    )
+    ds2 = make_daemonset(requests={"cpu": "1m"})
+    env.expect_applied(make_provisioner(name="default"), ds1, ds2)
+
+    initial = make_pod(limits={"cpu": "8"})
+    env.expect_provisioned(initial)
+    node1 = env.expect_scheduled(initial)
+    node1.metadata.labels["my-node-label"] = "value"
+    env.expect_applied(node1)
+
+    ds_pod = make_pod(
+        node_selector={"my-node-label": "value"},
+        requests={"cpu": "1", "memory": "2Gi"},
+        owner_kind="DaemonSet",
+    )
+    env.expect_deleted(initial)
+    env.op.sync_state()
+    env.expect_applied(ds_pod)
+    for state_node in env.cluster.nodes():
+        assert state_node.total_daemonset_requests().get("cpu", 0.0) == pytest.approx(0)
+        assert state_node.available().get("cpu", 0.0) == pytest.approx(15.9)
+
+    env.expect_manual_binding(ds_pod, node1)
+    env.op.sync_state()
+    for state_node in env.cluster.nodes():
+        assert state_node.total_daemonset_requests().get("cpu", 0.0) == pytest.approx(1)
+        assert state_node.available().get("cpu", 0.0) == pytest.approx(14.9)
+
+    second = make_pod(limits={"cpu": "15.5"})
+    env.expect_provisioned(second)
+    node2 = env.expect_scheduled(second)
+    assert node1.metadata.name != node2.metadata.name
+
+
+# -- bin-pack-first over batches (suite_test.go:1758-1828) ------------------
+
+
+def test_packs_inflight_nodes_before_launching_new():
+    """suite_test.go:1758-1798 — random batches leave <=1 node with spare."""
+    import random
+
+    universe = [
+        fake.new_instance_type("medium", resources={"cpu": 4.25, "pods": 4.0})
+    ]
+    env = Env(universe=universe)
+    env.expect_applied(make_provisioner(name="default"))
+    rng = random.Random(42)
+    for _ in range(10):
+        batch = [make_pod(limits={"cpu": "1"}) for _ in range(rng.randint(0, 9))]
+        if not batch:
+            continue
+        env.expect_provisioned(*batch)
+        for pod in batch:
+            env.expect_scheduled(pod)
+        env.op.sync_state()
+
+    nodes_with_cpu_free = 0
+    for state_node in env.cluster.nodes():
+        if state_node.available().get("cpu", 0.0) >= 1:
+            nodes_with_cpu_free += 1
+    assert nodes_with_cpu_free <= 1
+
+
+def test_inflight_reuse_via_provider_ref(env):
+    """suite_test.go:1799-1828 (#2011) — in-flight capacity known through a
+    ProviderRef-only provisioner."""
+    prov = make_provisioner(name="default")
+    prov.spec.provider = None
+    from karpenter_core_tpu.api.provisioner import ProviderRef
+
+    prov.spec.provider_ref = ProviderRef(name="ref")
+    env.expect_applied(prov)
+    pod = make_pod(limits={"cpu": "10m"})
+    env.expect_provisioned_no_binding(pod)
+    assert len(env.kube.list("Node")) == 1
+    env.op.sync_state()
+
+    env.expect_applied(pod)  # still pending/unschedulable
+    env.expect_provisioned_no_binding(pod)
+    assert len(env.kube.list("Node")) == 1

@@ -1,0 +1,208 @@
+"""Port of reference scheduling suite_test.go — No Pre-Binding + VolumeUsage
+describes (suite_test.go:1829-2214). Cited line numbers refer to
+/root/reference/pkg/controllers/provisioning/scheduling/suite_test.go.
+"""
+import pytest
+
+from karpenter_core_tpu.cloudprovider import fake
+from karpenter_core_tpu.kube.objects import (
+    EphemeralVolumeSource,
+    LABEL_TOPOLOGY_ZONE,
+    LabelSelector,
+    PodAffinityTerm,
+    Volume,
+)
+from karpenter_core_tpu.testing import (
+    make_csinode,
+    make_pod,
+    make_provisioner,
+    make_pv,
+    make_pvc,
+    make_storage_class,
+    pvc_volume,
+)
+from karpenter_core_tpu.testing.expectations import Env
+
+CSI = "fake.csi.provider"
+
+
+@pytest.fixture()
+def env():
+    return Env()
+
+
+def big_type_env():
+    """One 1024-cpu/1024-pod type (suite_test.go:1935-1947)."""
+    return Env(
+        universe=[
+            fake.new_instance_type(
+                "instance-type", resources={"cpu": 1024.0, "pods": 1024.0}
+            )
+        ]
+    )
+
+
+# -- No Pre-Binding (suite_test.go:1829-1932) -------------------------------
+
+
+def test_does_not_bind_pods_to_new_nodes(env):
+    """suite_test.go:1830-1859."""
+    assert len(env.kube.list("Node")) == 0
+    env.expect_applied(make_provisioner(name="default"))
+    initial = make_pod(limits={"cpu": "10m"})
+    env.expect_provisioned_no_binding(initial)
+    env.expect_not_scheduled(initial)
+    assert len(env.kube.list("Node")) == 1
+
+    env.op.sync_state()
+    second = make_pod(limits={"cpu": "10m"})
+    env.expect_provisioned_no_binding(second)
+    env.expect_not_scheduled(second)
+    assert len(env.kube.list("Node")) == 1
+
+
+def test_handles_kubelet_zeroed_extended_resources(env):
+    """suite_test.go:1860-1901 (#1459) — kubelet zeroing extended resources
+    at startup must not hide in-flight capacity."""
+    env.expect_applied(make_provisioner(name="default"))
+    initial = make_pod(limits={"cpu": "10m", fake.RESOURCE_GPU_VENDOR_A: "1"})
+    env.expect_provisioned_no_binding(initial)
+    env.expect_not_scheduled(initial)
+    nodes = env.kube.list("Node")
+    assert len(nodes) == 1
+    node1 = nodes[0]
+
+    node1.status.capacity = {fake.RESOURCE_GPU_VENDOR_A: 0.0}
+    node1.status.allocatable = {fake.RESOURCE_GPU_VENDOR_B: 0.0}
+    env.expect_applied(node1)
+    env.op.sync_state()
+
+    second = make_pod(limits={"cpu": "10m", fake.RESOURCE_GPU_VENDOR_A: "1"})
+    env.expect_provisioned_no_binding(second)
+    env.expect_not_scheduled(second)
+    assert len(env.kube.list("Node")) == 1
+
+
+def test_self_pod_affinity_without_binding(env):
+    """suite_test.go:1902-1931 (#1975) — the second solve must prefer the
+    in-flight node's domain for self-affinity."""
+    labels = {"security": "s2"}
+    term = PodAffinityTerm(
+        topology_key=LABEL_TOPOLOGY_ZONE,
+        label_selector=LabelSelector(match_labels=labels),
+    )
+    pods = [
+        make_pod(labels=labels, pod_affinity_required=[term]) for _ in range(2)
+    ]
+    env.expect_applied(make_provisioner(name="default"))
+    env.expect_provisioned_no_binding(pods[0])
+    env.op.sync_state()
+    env.expect_provisioned_no_binding(pods[1])
+    assert len(env.kube.list("Node")) == 1
+
+
+# -- VolumeUsage (suite_test.go:1933-2214) ----------------------------------
+
+
+def _csi_inflight_node(env):
+    """Shared setup: one launched node with a 10-volume CSI driver limit."""
+    env.expect_applied(make_provisioner(name="default"))
+    initial = make_pod()
+    env.expect_provisioned(initial)
+    node = env.expect_scheduled(initial)
+    env.expect_applied(make_csinode(node.metadata.name, CSI, allocatable=10))
+    env.op.sync_state()
+    return node
+
+
+def test_multiple_nodes_due_to_volume_limits():
+    """suite_test.go:1934-1997 — 6 pods x 2 distinct PVCs > 10-volume limit."""
+    env = big_type_env()
+    _csi_inflight_node(env)
+    env.expect_applied(make_storage_class("my-storage-class", CSI, zones=["test-zone-1"]))
+
+    pods = []
+    for i in range(6):
+        env.expect_applied(
+            make_pvc(f"my-claim-a-{i}", storage_class="my-storage-class"),
+            make_pvc(f"my-claim-b-{i}", storage_class="my-storage-class"),
+        )
+        pod = make_pod()
+        pod.spec.volumes = [pvc_volume(f"my-claim-a-{i}"), pvc_volume(f"my-claim-b-{i}")]
+        pods.append(pod)
+    env.expect_provisioned(*pods)
+    # in-flight node holds 5 pods (10 volumes); the 6th needs a new node
+    assert len(env.kube.list("Node")) == 2
+
+
+def test_single_node_when_all_pods_share_pvc():
+    """suite_test.go:1998-2064 — 100 pods, one PVC -> one node."""
+    env = big_type_env()
+    _csi_inflight_node(env)
+    env.expect_applied(make_storage_class("my-storage-class", CSI, zones=["test-zone-1"]))
+    env.expect_applied(make_pv("my-volume", zones=["test-zone-1"]))
+    env.expect_applied(
+        make_pvc("my-claim", storage_class="my-storage-class", volume_name="my-volume")
+    )
+
+    pods = []
+    for _ in range(100):
+        pod = make_pod()
+        pod.spec.volumes = [pvc_volume("my-claim"), pvc_volume("my-claim")]
+        pods.append(pod)
+    env.expect_provisioned(*pods)
+    assert len(env.kube.list("Node")) == 1
+
+
+def test_non_dynamic_pvcs_do_not_fail():
+    """suite_test.go:2065-2133 — PVC with empty storage class, bound PV."""
+    env = big_type_env()
+    _csi_inflight_node(env)
+    env.expect_applied(make_storage_class("my-storage-class", CSI, zones=["test-zone-1"]))
+    env.expect_applied(make_pv("my-volume", driver=CSI, zones=["test-zone-1"]))
+    env.expect_applied(make_pvc("my-claim", storage_class="", volume_name="my-volume"))
+
+    pods = []
+    for _ in range(5):
+        pod = make_pod()
+        pod.spec.volumes = [pvc_volume("my-claim"), pvc_volume("my-claim")]
+        pods.append(pod)
+    env.expect_provisioned(*pods)
+    assert len(env.kube.list("Node")) == 1
+
+
+def test_nfs_volumes_do_not_fail():
+    """suite_test.go:2134-2183 — non-CSI (NFS) PV doesn't count to limits."""
+    env = big_type_env()
+    env.expect_applied(make_provisioner(name="default"))
+    initial = make_pod()
+    env.expect_provisioned(initial)
+    env.expect_scheduled(initial)
+    env.op.sync_state()
+
+    env.expect_applied(make_pv("my-volume", driver="", storage_class="nfs",
+                               zones=["test-zone-1"]))
+    env.expect_applied(make_pvc("my-claim", storage_class="", volume_name="my-volume"))
+
+    pods = []
+    for _ in range(5):
+        pod = make_pod()
+        pod.spec.volumes = [pvc_volume("my-claim"), pvc_volume("my-claim")]
+        pods.append(pod)
+    env.expect_provisioned(*pods)
+    assert len(env.kube.list("Node")) == 1
+
+
+def test_ephemeral_volume_with_missing_storage_class_not_provisioned(env):
+    """suite_test.go:2184-2214 — no node for an ephemeral volume whose
+    storage class doesn't exist."""
+    env.expect_applied(make_provisioner(name="default"))
+    pod = make_pod()
+    pod.spec.volumes.append(
+        Volume(
+            name="tmp-ephemeral",
+            ephemeral=EphemeralVolumeSource(storage_class_name="non-existent"),
+        )
+    )
+    env.expect_provisioned(pod)
+    assert len(env.kube.list("Node")) == 0

@@ -1,0 +1,1031 @@
+"""Port of reference topology_test.go over the expectations harness —
+the specs NOT already condensed into tests/test_topology.py (which keeps the
+solver-level variants). Spec-for-spec with binding via ExpectProvisioned, so
+committed domain counts carry across batches exactly as in the reference.
+Cited line numbers refer to
+/root/reference/pkg/controllers/provisioning/scheduling/topology_test.go.
+"""
+import pytest
+
+from karpenter_core_tpu.api import labels as api_labels
+from karpenter_core_tpu.kube.objects import (
+    LABEL_ARCH_STABLE,
+    LABEL_HOSTNAME,
+    LABEL_TOPOLOGY_ZONE,
+    LabelSelector,
+    LabelSelectorRequirement,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    TopologySpreadConstraint,
+)
+from karpenter_core_tpu.testing import make_node, make_pod, make_provisioner
+from karpenter_core_tpu.testing.expectations import Env
+
+ZONE = LABEL_TOPOLOGY_ZONE
+CT = api_labels.LABEL_CAPACITY_TYPE
+ARCH = LABEL_ARCH_STABLE
+LABELS = {"test": "test"}
+
+
+@pytest.fixture()
+def env():
+    return Env()
+
+
+def req(key, op, *values):
+    return NodeSelectorRequirement(key=key, operator=op, values=list(values))
+
+
+def terms(*exprs):
+    return [NodeSelectorTerm(match_expressions=list(exprs))]
+
+
+def tsc(key=ZONE, max_skew=1, selector=LABELS, unsat="DoNotSchedule",
+        expressions=None):
+    sel = None
+    if expressions is not None:
+        sel = LabelSelector(match_expressions=list(expressions))
+    elif selector is not None:
+        sel = LabelSelector(match_labels=dict(selector))
+    return TopologySpreadConstraint(
+        max_skew=max_skew, topology_key=key, when_unsatisfiable=unsat,
+        label_selector=sel,
+    )
+
+
+def spread_pods(n, topo, labels=LABELS, **kw):
+    return [make_pod(labels=dict(labels), topology_spread=[topo], **kw) for _ in range(n)]
+
+
+def skew_of(env, topo):
+    return sorted(env.expect_skew("default", topo).values())
+
+
+# -- Topology / top-level (topology_test.go:57-69) --------------------------
+
+
+def test_invalid_label_selector_not_spread(env):
+    """topology_test.go:57-69 — a selector that can't match the owning pods
+    doesn't spread them: both land on one node (the reference asserts the
+    same colocation through ExpectSkew's ConsistOf(2))."""
+    topo = tsc(selector={"app.kubernetes.io/name": "{{ zqfmgb }}"})
+    env.expect_applied(make_provisioner(name="default"))
+    pods = spread_pods(2, topo, labels=LABELS)
+    env.expect_provisioned(*pods)
+    for pod in pods:
+        env.expect_scheduled(pod)
+    assert len(env.kube.list("Node")) == 1
+
+
+# -- Zonal (topology_test.go:70-404) ----------------------------------------
+
+
+def test_balance_across_zones_match_labels(env):
+    """topology_test.go:71-86."""
+    topo = tsc()
+    env.expect_applied(make_provisioner(name="default"))
+    env.expect_provisioned(*spread_pods(4, topo))
+    assert skew_of(env, topo) == [1, 1, 2]
+
+
+def test_respects_provisioner_zonal_constraints_full(env):
+    """topology_test.go:111-128."""
+    topo = tsc()
+    env.expect_applied(
+        make_provisioner(
+            name="default",
+            requirements=[req(ZONE, "In", "test-zone-1", "test-zone-2", "test-zone-3")],
+        )
+    )
+    env.expect_provisioned(*spread_pods(4, topo))
+    assert skew_of(env, topo) == [1, 1, 2]
+
+
+def test_non_minimum_domain_when_only_available(env):
+    """topology_test.go:187-228 — forced zones; maxSkew 5 absorbs six in z3."""
+    topo = tsc(max_skew=5)
+    rr = {"cpu": "1.1"}
+    env.expect_applied(
+        make_provisioner(name="default", requirements=[req(ZONE, "In", "test-zone-1")])
+    )
+    env.expect_provisioned(*spread_pods(1, topo, requests=rr))
+    assert skew_of(env, topo) == [1]
+
+    env.expect_applied(
+        make_provisioner(name="default", requirements=[req(ZONE, "In", "test-zone-2")])
+    )
+    env.expect_provisioned(*spread_pods(1, topo, requests=rr))
+    assert skew_of(env, topo) == [1, 1]
+
+    env.expect_applied(
+        make_provisioner(name="default", requirements=[req(ZONE, "In", "test-zone-3")])
+    )
+    env.expect_provisioned(*spread_pods(10, topo, requests=rr))
+    assert skew_of(env, topo) == [1, 1, 6]
+
+
+def test_discover_domains_from_unconstrained_first_pod(env):
+    """topology_test.go:301-332 — zone-1 seeded by a non-spread pod."""
+    topo = tsc()
+    rr = {"cpu": "1.1"}
+    env.expect_applied(
+        make_provisioner(name="default", requirements=[req(ZONE, "In", "test-zone-1")])
+    )
+    seed = make_pod(labels=dict(LABELS), requests=rr)
+    env.expect_provisioned(seed)
+
+    env.expect_applied(
+        make_provisioner(
+            name="default", requirements=[req(ZONE, "In", "test-zone-2", "test-zone-3")]
+        )
+    )
+    env.expect_provisioned(*spread_pods(10, topo, requests=rr))
+    assert skew_of(env, topo) == [1, 2, 2]
+
+
+def test_only_counts_matching_bound_pods(env):
+    """topology_test.go:333-365 — pending/terminating/failed/succeeded/
+    wrong-namespace/no-domain pods are ignored in domain counts."""
+    import time as _time
+
+    first = make_node(name="first", labels={ZONE: "test-zone-1"},
+                      capacity={"cpu": "100", "pods": "100"})
+    second = make_node(name="second", labels={ZONE: "test-zone-2"},
+                       capacity={"cpu": "100", "pods": "100"})
+    third = make_node(name="third", capacity={"cpu": "100", "pods": "100"})
+    topo = tsc()
+    env.expect_applied(make_provisioner(name="default"), first, second, third)
+    env.op.sync_state()
+
+    ignored_and_counted = [
+        make_pod(node_name="first", unschedulable=False),  # missing labels
+        make_pod(labels=dict(LABELS)),  # pending
+        make_pod(labels=dict(LABELS), node_name="third", unschedulable=False),  # no domain
+        make_pod(labels=dict(LABELS), namespace="wrong-ns", node_name="first",
+                 unschedulable=False),  # wrong namespace
+        make_pod(labels=dict(LABELS), node_name="first", unschedulable=False,
+                 phase="Failed"),
+        make_pod(labels=dict(LABELS), node_name="first", unschedulable=False,
+                 phase="Succeeded"),
+        make_pod(labels=dict(LABELS), node_name="first", unschedulable=False),
+        make_pod(labels=dict(LABELS), node_name="first", unschedulable=False),
+        make_pod(labels=dict(LABELS), node_name="second", unschedulable=False),
+    ]
+    terminating = make_pod(labels=dict(LABELS))
+    terminating.metadata.deletion_timestamp = _time.time() + 10
+    env.expect_applied(terminating, *ignored_and_counted)
+    env.op.sync_state()
+    env.expect_provisioned(*spread_pods(2, topo))
+    assert skew_of(env, topo) == [1, 2, 2]
+
+
+def test_hostname_balance_across_nodes(env):
+    """topology_test.go:406-421."""
+    topo = tsc(key=LABEL_HOSTNAME)
+    env.expect_applied(make_provisioner(name="default"))
+    env.expect_provisioned(*spread_pods(4, topo))
+    assert skew_of(env, topo) == [1, 1, 1, 1]
+
+
+def test_multiple_deployments_hostname_spread(env):
+    """topology_test.go:438-473 (#1425) — two apps, two nodes minimum."""
+    env.expect_applied(make_provisioner(name="default"))
+
+    def spread_pod(app):
+        return make_pod(
+            labels={"app": app},
+            topology_spread=[tsc(key=LABEL_HOSTNAME, selector={"app": app})],
+        )
+
+    pods = [spread_pod("app1"), spread_pod("app1"), spread_pod("app2"), spread_pod("app2")]
+    env.expect_provisioned(*pods)
+    for pod in pods:
+        env.expect_scheduled(pod)
+    assert len(env.kube.list("Node")) == 2
+
+
+def test_multiple_deployments_hostname_spread_varying_arch(env):
+    """topology_test.go:474-518 (#1425) — arch split forces four nodes."""
+    env.expect_applied(make_provisioner(name="default"))
+
+    def spread_pod(app, arch):
+        return make_pod(
+            labels={"app": app},
+            node_affinity_required=terms(req(ARCH, "In", arch)),
+            topology_spread=[tsc(key=LABEL_HOSTNAME, selector={"app": app})],
+        )
+
+    pods = [
+        spread_pod("app1", "amd64"),
+        spread_pod("app1", "amd64"),
+        spread_pod("app2", "arm64"),
+        spread_pod("app2", "arm64"),
+    ]
+    env.expect_provisioned(*pods)
+    for pod in pods:
+        env.expect_scheduled(pod)
+    assert len(env.kube.list("Node")) == 4
+
+
+# -- CapacityType (topology_test.go:519-812) --------------------------------
+
+
+def test_respects_provisioner_capacity_type_constraints(env):
+    """topology_test.go:536-553."""
+    topo = tsc(key=CT)
+    env.expect_applied(
+        make_provisioner(name="default", requirements=[req(CT, "In", "spot", "on-demand")])
+    )
+    env.expect_provisioned(*spread_pods(4, topo))
+    assert skew_of(env, topo) == [2, 2]
+
+
+def test_capacity_type_do_not_schedule_respects_skew(env):
+    """topology_test.go:554-588."""
+    topo = tsc(key=CT)
+    rr = {"cpu": "1.1"}
+    env.expect_applied(
+        make_provisioner(name="default", requirements=[req(CT, "In", "spot")])
+    )
+    env.expect_provisioned(*spread_pods(1, topo, requests=rr))
+    assert skew_of(env, topo) == [1]
+
+    env.expect_applied(
+        make_provisioner(name="default", requirements=[req(CT, "In", "on-demand")])
+    )
+    env.expect_provisioned(*spread_pods(5, topo, requests=rr))
+    assert skew_of(env, topo) == [1, 2]
+
+
+def test_capacity_type_only_counts_matching_bound_pods(env):
+    """topology_test.go:620-652."""
+    import time as _time
+
+    first = make_node(name="first", labels={CT: "spot"},
+                      capacity={"cpu": "100", "pods": "100"})
+    second = make_node(name="second", labels={CT: "on-demand"},
+                       capacity={"cpu": "100", "pods": "100"})
+    third = make_node(name="third", capacity={"cpu": "100", "pods": "100"})
+    topo = tsc(key=CT)
+    env.expect_applied(make_provisioner(name="default"), first, second, third)
+    env.op.sync_state()
+
+    pods = [
+        make_pod(node_name="first", unschedulable=False),
+        make_pod(labels=dict(LABELS)),
+        make_pod(labels=dict(LABELS), node_name="third", unschedulable=False),
+        make_pod(labels=dict(LABELS), namespace="wrong-ns", node_name="first",
+                 unschedulable=False),
+        make_pod(labels=dict(LABELS), node_name="first", unschedulable=False,
+                 phase="Failed"),
+        make_pod(labels=dict(LABELS), node_name="first", unschedulable=False,
+                 phase="Succeeded"),
+        make_pod(labels=dict(LABELS), node_name="first", unschedulable=False),
+        make_pod(labels=dict(LABELS), node_name="first", unschedulable=False),
+        make_pod(labels=dict(LABELS), node_name="second", unschedulable=False),
+    ]
+    terminating = make_pod(labels=dict(LABELS))
+    terminating.metadata.deletion_timestamp = _time.time() + 10
+    env.expect_applied(terminating, *pods)
+    env.op.sync_state()
+    env.expect_provisioned(*spread_pods(2, topo))
+    assert skew_of(env, topo) == [2, 3]
+
+
+def test_capacity_type_no_selector_matches_nothing(env):
+    """topology_test.go:653-664 — nil selector counts no pods; vanilla pod
+    schedules and lands in one capacity-type domain."""
+    topo = tsc(key=CT, selector=None)
+    env.expect_applied(make_provisioner(name="default"))
+    pod = make_pod()
+    env.expect_provisioned(pod)
+    env.expect_scheduled(pod)
+    assert skew_of(env, topo) in ([], [1])
+
+
+def test_interdependent_selectors_pack_one_node(env):
+    """topology_test.go:665-687 — owners don't match their own selector, so
+    skew never grows and all five pods share one hostname."""
+    topo = tsc(key=LABEL_HOSTNAME)
+    env.expect_applied(make_provisioner(name="default"))
+    pods = [make_pod(topology_spread=[topo]) for _ in range(5)]
+    env.expect_provisioned(*pods)
+    names = {env.expect_scheduled(p).metadata.name for p in pods}
+    assert len(names) == 1
+
+
+def test_balance_capacity_types_node_required_affinity_constrained(env):
+    """topology_test.go:688-724."""
+    env.expect_applied(make_provisioner(name="default"))
+    seed = make_pod(
+        labels=dict(LABELS),
+        node_affinity_required=terms(
+            req(ZONE, "In", "test-zone-1"), req(CT, "In", "on-demand")
+        ),
+    )
+    env.expect_provisioned(seed)
+    env.expect_scheduled(seed)
+
+    topo = tsc(key=CT)
+    env.expect_provisioned(
+        *[
+            make_pod(
+                labels=dict(LABELS),
+                topology_spread=[topo],
+                node_affinity_required=terms(
+                    req(ZONE, "In", "test-zone-2"), req(CT, "In", "spot")
+                ),
+            )
+            for _ in range(5)
+        ]
+    )
+    assert skew_of(env, topo) == [1, 5]
+
+
+def test_balance_capacity_types_no_constraints(env):
+    """topology_test.go:725-767."""
+    env.expect_applied(make_provisioner(name="default"))
+    seed = make_pod(
+        labels=dict(LABELS),
+        node_selector={"node.kubernetes.io/instance-type": "single-pod-instance-type"},
+        node_affinity_required=terms(req(CT, "In", "on-demand")),
+    )
+    env.expect_provisioned(seed)
+    env.expect_scheduled(seed)
+
+    topo = tsc(key=CT)
+    env.expect_applied(
+        make_provisioner(name="default", requirements=[req(CT, "In", "spot")])
+    )
+    env.expect_provisioned(
+        *spread_pods(5, topo, requests={"cpu": "2"})
+    )
+    assert skew_of(env, topo) == [1, 2]
+
+
+def test_balance_arch_no_constraints(env):
+    """topology_test.go:768-812."""
+    env.expect_applied(make_provisioner(name="default"))
+    seed = make_pod(
+        labels=dict(LABELS),
+        node_selector={"node.kubernetes.io/instance-type": "single-pod-instance-type"},
+        node_affinity_required=terms(req(ARCH, "In", "amd64")),
+    )
+    env.expect_provisioned(seed)
+    env.expect_scheduled(seed)
+
+    topo = tsc(key=ARCH)
+    env.expect_applied(
+        make_provisioner(name="default", requirements=[req(ARCH, "In", "arm64")])
+    )
+    env.expect_provisioned(*spread_pods(5, topo, requests={"cpu": "2"}))
+    assert skew_of(env, topo) == [1, 2]
+
+
+# -- Combined contexts (topology_test.go:813-1230) --------------------------
+
+
+def max_skew_of(env, topo):
+    counts = list(env.expect_skew("default", topo).values())
+    return (max(counts) - min(counts)) if counts else 0
+
+
+def test_balance_across_provisioner_requirements(env):
+    """topology_test.go:854-909 — spread over a custom key forces a 4:1
+    spot:on-demand split across two provisioners."""
+    spot_prov = make_provisioner(
+        name="spot",
+        requirements=[
+            req(CT, "In", "spot"),
+            req("capacity.spread.4-1", "In", "2", "3", "4", "5"),
+        ],
+    )
+    od_prov = make_provisioner(
+        name="on-demand",
+        requirements=[
+            req(CT, "In", "on-demand"),
+            req("capacity.spread.4-1", "In", "1"),
+        ],
+    )
+    topo = tsc(key="capacity.spread.4-1")
+    env.expect_applied(spot_prov, od_prov)
+    pods = spread_pods(20, topo)
+    env.expect_provisioned(*pods)
+    for pod in pods:
+        env.expect_scheduled(pod)
+    assert skew_of(env, topo) == [4, 4, 4, 4, 4]
+    assert skew_of(env, tsc(key=CT)) == [4, 16]
+
+
+def test_zonal_spread_with_disabled_second_provisioner(env):
+    """topology_test.go:910-945 — a zero-limit provisioner contributes no
+    schedulable domain."""
+    topo_zone = tsc()
+    topo_host = tsc(key=LABEL_HOSTNAME, unsat="ScheduleAnyway")
+    prov_a = make_provisioner(
+        name="default",
+        requirements=[req(ZONE, "In", "test-zone-1", "test-zone-2")],
+    )
+    prov_b = make_provisioner(
+        name="b",
+        requirements=[req(ZONE, "In", "test-zone-3")],
+        limits={"cpu": "0"},
+    )
+    env.expect_applied(prov_a, prov_b)
+    env.expect_provisioned(
+        *[
+            make_pod(labels=dict(LABELS), topology_spread=[topo_zone, topo_host])
+            for _ in range(10)
+        ]
+    )
+    assert skew_of(env, topo_zone) == [1, 1]
+    assert skew_of(env, topo_host) == [1, 1]
+
+
+def test_capacity_type_and_hostname_combined(env):
+    """topology_test.go:946-987."""
+    topo_ct = tsc(key=CT)
+    topo_host = tsc(key=LABEL_HOSTNAME, max_skew=3)
+    env.expect_applied(make_provisioner(name="default"))
+
+    def batch(n):
+        pods = [
+            make_pod(labels=dict(LABELS), topology_spread=[topo_ct, topo_host])
+            for _ in range(n)
+        ]
+        env.expect_provisioned(*pods)
+
+    batch(2)
+    assert skew_of(env, topo_ct) == [1, 1]
+    assert max(env.expect_skew("default", topo_host).values()) <= 3
+    batch(3)
+    assert skew_of(env, topo_ct) == [2, 3]
+    assert max(env.expect_skew("default", topo_host).values()) <= 3
+    batch(5)
+    assert skew_of(env, topo_ct) == [5, 5]
+    assert max(env.expect_skew("default", topo_host).values()) <= 3
+    batch(11)
+    assert skew_of(env, topo_ct) == [10, 11]
+    assert max(env.expect_skew("default", topo_host).values()) <= 3
+
+
+def test_zonal_and_capacity_type_combined(env):
+    """topology_test.go:989-1027 — both skews bounded batch over batch."""
+    topo_ct = tsc(key=CT)
+    topo_zone = tsc()
+    env.expect_applied(make_provisioner(name="default"))
+
+    def batch(n):
+        env.expect_provisioned(
+            *[
+                make_pod(labels=dict(LABELS), topology_spread=[topo_ct, topo_zone])
+                for _ in range(n)
+            ]
+        )
+
+    batch(2)
+    assert max(env.expect_skew("default", topo_ct).values()) <= 1
+    assert max(env.expect_skew("default", topo_zone).values()) <= 1
+    batch(3)
+    assert max(env.expect_skew("default", topo_ct).values()) <= 3
+    assert max(env.expect_skew("default", topo_zone).values()) <= 2
+    batch(5)
+    assert max(env.expect_skew("default", topo_ct).values()) <= 5
+    assert max(env.expect_skew("default", topo_zone).values()) <= 4
+    batch(11)
+    assert max(env.expect_skew("default", topo_ct).values()) <= 11
+    assert max(env.expect_skew("default", topo_zone).values()) <= 7
+
+
+def test_hostname_zonal_capacity_type_combined():
+    """topology_test.go:1029-1065 — all three constraints hold across
+    fourteen growing batches over the assorted universe."""
+    from karpenter_core_tpu.cloudprovider import fake as fake_mod
+
+    env = Env(universe=fake_mod.instance_types_assorted())
+    topo_ct = tsc(key=CT)
+    topo_zone = tsc(max_skew=2)
+    topo_host = tsc(key=LABEL_HOSTNAME, max_skew=3)
+    env.expect_applied(make_provisioner(name="default"))
+    for i in range(1, 15):
+        pods = [
+            make_pod(
+                labels=dict(LABELS), topology_spread=[topo_ct, topo_zone, topo_host]
+            )
+            for _ in range(i)
+        ]
+        env.expect_provisioned(*pods)
+        assert max_skew_of(env, topo_ct) <= 1
+        assert max_skew_of(env, topo_zone) <= 2
+        assert max_skew_of(env, topo_host) <= 3
+        for pod in pods:
+            env.expect_scheduled(pod)
+
+
+def test_spread_limited_by_node_requirements(env):
+    """topology_test.go:1093-1114."""
+    topo = tsc()
+    env.expect_applied(make_provisioner(name="default"))
+    env.expect_provisioned(
+        *[
+            make_pod(
+                labels=dict(LABELS),
+                topology_spread=[topo],
+                node_affinity_required=terms(
+                    req(ZONE, "In", "test-zone-1", "test-zone-2")
+                ),
+            )
+            for _ in range(10)
+        ]
+    )
+    assert skew_of(env, topo) == [5, 5]
+
+
+def test_spread_limited_by_node_affinity_then_reopened(env):
+    """topology_test.go:1115-1161 — empty zone-3 is chosen when it improves
+    max-skew; final batch levels all three."""
+    topo = tsc()
+    env.expect_applied(make_provisioner(name="default"))
+    env.expect_provisioned(
+        *[
+            make_pod(
+                labels=dict(LABELS),
+                topology_spread=[topo],
+                node_affinity_required=terms(
+                    req(ZONE, "In", "test-zone-1", "test-zone-2")
+                ),
+            )
+            for _ in range(6)
+        ]
+    )
+    assert skew_of(env, topo) == [3, 3]
+
+    env.expect_applied(
+        make_provisioner(
+            name="default",
+            requirements=[req(ZONE, "In", "test-zone-1", "test-zone-2", "test-zone-3")],
+        )
+    )
+    env.expect_provisioned(
+        make_pod(
+            labels=dict(LABELS),
+            topology_spread=[topo],
+            node_affinity_required=terms(req(ZONE, "In", "test-zone-2", "test-zone-3")),
+        )
+    )
+    assert skew_of(env, topo) == [1, 3, 3]
+
+    env.expect_provisioned(*spread_pods(5, topo))
+    assert skew_of(env, topo) == [4, 4, 4]
+
+
+def test_capacity_type_spread_limited_by_node_selector(env):
+    """topology_test.go:1163-1186 (ScheduleAnyway variant)."""
+    topo = tsc(key=CT, unsat="ScheduleAnyway")
+    env.expect_applied(make_provisioner(name="default"))
+    pods = [
+        make_pod(labels=dict(LABELS), topology_spread=[topo],
+                 node_selector={CT: "spot"})
+        for _ in range(5)
+    ] + [
+        make_pod(labels=dict(LABELS), topology_spread=[topo],
+                 node_selector={CT: "on-demand"})
+        for _ in range(5)
+    ]
+    env.expect_provisioned(*pods)
+    assert skew_of(env, topo) == [5, 5]
+
+
+def test_capacity_type_spread_limited_by_node_affinity_then_reopened(env):
+    """topology_test.go:1187-1230."""
+    topo = tsc(key=CT)
+    env.expect_applied(make_provisioner(name="default"))
+    env.expect_provisioned(
+        *[
+            make_pod(labels=dict(LABELS), topology_spread=[topo],
+                     node_affinity_required=terms(req(CT, "In", "spot")))
+            for _ in range(3)
+        ]
+    )
+    assert skew_of(env, topo) == [3]
+
+    env.expect_provisioned(
+        make_pod(labels=dict(LABELS), topology_spread=[topo],
+                 node_affinity_required=terms(req(CT, "In", "on-demand", "spot")))
+    )
+    assert skew_of(env, topo) == [1, 3]
+
+    env.expect_provisioned(*spread_pods(5, topo))
+    assert skew_of(env, topo) == [4, 5]
+
+
+# -- Pod Affinity / Anti-Affinity (topology_test.go:1231-2248) ---------------
+
+AFF = {"security": "s2"}
+
+
+def aff_term(key=LABEL_HOSTNAME, selector=AFF, namespaces=None, ns_selector=None):
+    from karpenter_core_tpu.kube.objects import PodAffinityTerm
+
+    return PodAffinityTerm(
+        topology_key=key,
+        label_selector=LabelSelector(match_labels=dict(selector)),
+        namespaces=list(namespaces or []),
+        namespace_selector=ns_selector,
+    )
+
+
+def weighted(term, weight=50):
+    from karpenter_core_tpu.kube.objects import WeightedPodAffinityTerm
+
+    return WeightedPodAffinityTerm(weight=weight, pod_affinity_term=term)
+
+
+def test_pod_affinity_hostname(env):
+    """topology_test.go:1242-1275."""
+    topo = tsc(key=LABEL_HOSTNAME)
+    target = make_pod(labels=dict(AFF))
+    follower = make_pod(pod_affinity_required=[aff_term()])
+    pods = spread_pods(10, topo) + [target, follower]
+    env.expect_applied(make_provisioner(name="default"))
+    env.expect_provisioned(*pods)
+    n1 = env.expect_scheduled(target)
+    n2 = env.expect_scheduled(follower)
+    assert n1.metadata.name == n2.metadata.name
+
+
+def test_pod_affinity_arch(env):
+    """topology_test.go:1276-1318 — same arch, different hosts via TSC."""
+    topo = tsc(key=LABEL_HOSTNAME, selector=AFF)
+    target = make_pod(
+        labels=dict(AFF), topology_spread=[topo], requests={"cpu": "2"},
+        node_selector={ARCH: "arm64"},
+    )
+    follower = make_pod(
+        labels=dict(AFF), topology_spread=[topo], requests={"cpu": "1"},
+        pod_affinity_required=[aff_term(key=ARCH)],
+    )
+    env.expect_applied(make_provisioner(name="default"))
+    env.expect_provisioned(target, follower)
+    n1 = env.expect_scheduled(target)
+    n2 = env.expect_scheduled(follower)
+    assert n1.metadata.labels[ARCH] == n2.metadata.labels[ARCH]
+    assert n1.metadata.name != n2.metadata.name
+
+
+def test_self_affinity_first_empty_domain_only(env):
+    """topology_test.go:1343-1384 — 5-pod node cap: 5 schedule on one node,
+    5 fail; later batches can't open a second hostname domain."""
+    def batch():
+        return [
+            make_pod(labels=dict(AFF), pod_affinity_required=[aff_term()])
+            for _ in range(10)
+        ]
+
+    env.expect_applied(make_provisioner(name="default"))
+    pods = batch()
+    env.expect_provisioned(*pods)
+    names = set()
+    scheduled = unscheduled = 0
+    for pod in pods:
+        live = env.expect_exists(pod)
+        if live.spec.node_name:
+            names.add(live.spec.node_name)
+            scheduled += 1
+        else:
+            unscheduled += 1
+    assert len(names) == 1 and scheduled == 5 and unscheduled == 5
+
+    pods = batch()
+    env.expect_provisioned(*pods)
+    for pod in pods:
+        env.expect_not_scheduled(pod)
+
+
+def test_self_affinity_first_domain_constrained_zones(env):
+    """topology_test.go:1385-1428 — hostname affinity ties followers to the
+    seeded host even under disjoint zone requirements."""
+    env.expect_applied(make_provisioner(name="default"))
+    seed = make_pod(
+        labels=dict(AFF),
+        node_selector={ZONE: "test-zone-1"},
+        pod_affinity_required=[aff_term()],
+    )
+    env.expect_provisioned(seed)
+
+    pods = [
+        make_pod(
+            labels=dict(AFF),
+            node_affinity_required=terms(req(ZONE, "In", "test-zone-2", "test-zone-3")),
+            pod_affinity_required=[aff_term()],
+        )
+        for _ in range(10)
+    ]
+    env.expect_provisioned(*pods)
+    for pod in pods:
+        env.expect_not_scheduled(pod)
+
+
+def test_self_affinity_zone(env):
+    """topology_test.go:1429-1452."""
+    env.expect_applied(make_provisioner(name="default"))
+    pods = [
+        make_pod(labels=dict(AFF), pod_affinity_required=[aff_term(key=ZONE)])
+        for _ in range(3)
+    ]
+    env.expect_provisioned(*pods)
+    names = {env.expect_scheduled(p).metadata.name for p in pods}
+    assert len(names) == 1
+
+
+def test_self_affinity_zone_with_constraint(env):
+    """topology_test.go:1453-1483."""
+    env.expect_applied(make_provisioner(name="default"))
+    pods = [
+        make_pod(
+            labels=dict(AFF),
+            pod_affinity_required=[aff_term(key=ZONE)],
+            node_affinity_required=terms(req(ZONE, "In", "test-zone-3")),
+        )
+        for _ in range(3)
+    ]
+    env.expect_provisioned(*pods)
+    names = set()
+    for pod in pods:
+        node = env.expect_scheduled(pod)
+        names.add(node.metadata.name)
+        assert node.metadata.labels[ZONE] == "test-zone-3"
+    assert len(names) == 1
+
+
+def test_simple_anti_affinity_hostname_separates(env):
+    """topology_test.go:1550-1571 — bidirectional, order-independent."""
+    env.expect_applied(make_provisioner(name="default"))
+    for _ in range(10):
+        target = make_pod(labels=dict(AFF))
+        avoider = make_pod(pod_anti_affinity_required=[aff_term()])
+        env.expect_provisioned(avoider, target)
+        n1 = env.expect_scheduled(target)
+        n2 = env.expect_scheduled(avoider)
+        assert n1.metadata.name != n2.metadata.name
+
+
+def test_anti_affinity_zone_not_violated(env):
+    """topology_test.go:1572-1610 — all zones hold a repelling pod."""
+    env.expect_applied(make_provisioner(name="default"))
+    zone_pods = [
+        make_pod(labels=dict(AFF), requests={"cpu": "2"},
+                 node_selector={ZONE: f"test-zone-{i}"})
+        for i in (1, 2, 3)
+    ]
+    avoider = make_pod(pod_anti_affinity_required=[aff_term(key=ZONE)])
+    env.expect_provisioned(*zone_pods, avoider)
+    for pod in zone_pods:
+        env.expect_scheduled(pod)
+    env.expect_not_scheduled(avoider)
+
+
+def test_anti_affinity_zone_other_schedules_first(env):
+    """topology_test.go:1611-1632."""
+    env.expect_applied(make_provisioner(name="default"))
+    target = make_pod(labels=dict(AFF), requests={"cpu": "2"})
+    avoider = make_pod(pod_anti_affinity_required=[aff_term(key=ZONE)])
+    env.expect_provisioned(target, avoider)
+    env.expect_scheduled(target)
+    env.expect_not_scheduled(avoider)
+
+
+def test_anti_affinity_arch(env):
+    """topology_test.go:1633-1675 — lands on a different arch."""
+    topo = tsc(key=LABEL_HOSTNAME, selector=AFF)
+    target = make_pod(
+        labels=dict(AFF), topology_spread=[topo], requests={"cpu": "2"},
+        node_selector={ARCH: "arm64"},
+    )
+    avoider = make_pod(
+        labels=dict(AFF), topology_spread=[topo], requests={"cpu": "1"},
+        pod_anti_affinity_required=[aff_term(key=ARCH)],
+    )
+    env.expect_applied(make_provisioner(name="default"))
+    env.expect_provisioned(target, avoider)
+    n1 = env.expect_scheduled(target)
+    n2 = env.expect_scheduled(avoider)
+    assert n1.metadata.labels[ARCH] != n2.metadata.labels[ARCH]
+
+
+def test_preferred_anti_affinity_inverse_violated(env):
+    """topology_test.go:1676-1715 — preferences relax, pod schedules."""
+    anti = [weighted(aff_term(key=ZONE), weight=10)]
+    env.expect_applied(make_provisioner(name="default"))
+    zone_pods = [
+        make_pod(requests={"cpu": "2"}, node_selector={ZONE: f"test-zone-{i}"},
+                 pod_anti_affinity_preferred=list(anti))
+        for i in (1, 2, 3)
+    ]
+    target = make_pod(labels=dict(AFF))
+    env.expect_provisioned(*zone_pods, target)
+    for pod in zone_pods:
+        env.expect_scheduled(pod)
+    env.expect_scheduled(target)
+
+
+def test_anti_affinity_zone_schroedinger(env):
+    """topology_test.go:1752-1783 — an uncommitted repeller blocks every
+    zone until its node exists; then the target schedules elsewhere."""
+    env.expect_applied(make_provisioner(name="default"))
+    anywhere = make_pod(requests={"cpu": "2"},
+                        pod_anti_affinity_required=[aff_term(key=ZONE)])
+    target = make_pod(labels=dict(AFF))
+    env.expect_provisioned(anywhere, target)
+    node1 = env.expect_scheduled(anywhere)
+    env.expect_not_scheduled(target)
+
+    env.op.sync_state()
+    env.expect_provisioned(target)
+    node2 = env.expect_scheduled(target)
+    assert node1.metadata.labels[ZONE] != node2.metadata.labels[ZONE]
+
+
+def test_preferred_anti_affinity_inverse_existing_nodes(env):
+    """topology_test.go:1834-1883."""
+    anti = [weighted(aff_term(key=ZONE), weight=10)]
+    env.expect_applied(make_provisioner(name="default"))
+    zone_pods = [
+        make_pod(requests={"cpu": "2"}, node_selector={ZONE: f"test-zone-{i}"},
+                 pod_anti_affinity_preferred=list(anti))
+        for i in (1, 2, 3)
+    ]
+    env.expect_provisioned(*zone_pods)
+    for pod in zone_pods:
+        env.expect_scheduled(pod)
+    env.op.sync_state()
+
+    target = make_pod(labels=dict(AFF))
+    env.expect_provisioned(target)
+    env.expect_scheduled(target)
+
+
+def test_affinity_preference_with_conflicting_required_constraint(env):
+    """topology_test.go:1884-1918 — preference loses to DoNotSchedule TSC."""
+    constraint = tsc(key=LABEL_HOSTNAME)
+    target = make_pod(labels=dict(AFF))
+    pods = [
+        make_pod(
+            labels=dict(LABELS),
+            topology_spread=[constraint],
+            pod_affinity_preferred=[weighted(aff_term())],
+        )
+        for _ in range(3)
+    ]
+    env.expect_applied(make_provisioner(name="default"))
+    env.expect_provisioned(*(pods + [target]))
+    for pod in pods + [target]:
+        env.expect_scheduled(pod)
+    assert skew_of(env, constraint) == [1, 1, 1]
+
+
+def test_anti_affinity_zone_topology_batches(env):
+    """topology_test.go:1919-1963 — zonal anti-affinity works itself out
+    over successive batches (late committal)."""
+    def batch():
+        return [
+            make_pod(labels=dict(AFF),
+                     pod_anti_affinity_required=[aff_term(key=ZONE)])
+            for _ in range(3)
+        ]
+
+    def delete_unscheduled():
+        for pod in env.kube.list("Pod"):
+            if not pod.spec.node_name:
+                env.kube.delete("Pod", pod.metadata.namespace, pod.metadata.name)
+        env.op.sync_state()
+
+    top = tsc(selector=AFF)
+    env.expect_applied(make_provisioner(name="default"))
+    for expected in ([1], [1, 1], [1, 1, 1], [1, 1, 1]):
+        env.expect_provisioned(*batch())
+        env.op.sync_state()
+        assert skew_of(env, top) == expected
+        delete_unscheduled()
+
+
+def test_affinity_zone_topology_constrained_target(env):
+    """topology_test.go:2014-2042 — all 11 land in the target's zone."""
+    target = make_pod(
+        labels=dict(AFF),
+        node_affinity_required=terms(req(ZONE, "In", "test-zone-1")),
+    )
+    followers = [
+        make_pod(pod_affinity_required=[aff_term(key=ZONE)]) for _ in range(10)
+    ]
+    env.expect_applied(make_provisioner(name="default"))
+    env.expect_provisioned(*(followers + [target]))
+    top = tsc(selector=None)
+    counts = env.expect_skew("default", top)
+    assert sorted(counts.values()) == [11]
+
+
+def test_multiple_dependent_affinities(env):
+    """topology_test.go:2043-2077 — db -> web -> cache -> ui chain (reduced
+    to 5 rounds; the reference's 50 exercise the same order-independence)."""
+    db = {"type": "db", "spread": "spread"}
+    web = {"type": "web", "spread": "spread"}
+    cache = {"type": "cache", "spread": "spread"}
+    ui = {"type": "ui", "spread": "spread"}
+    for _ in range(5):
+        e = Env()
+        e.expect_applied(make_provisioner(name="default"))
+        pods = [
+            make_pod(labels=dict(db)),
+            make_pod(labels=dict(web), pod_affinity_required=[aff_term(selector=db)]),
+            make_pod(labels=dict(cache), pod_affinity_required=[aff_term(selector=web)]),
+            make_pod(labels=dict(ui), pod_affinity_required=[aff_term(selector=cache)]),
+        ]
+        e.expect_provisioned(*pods)
+        for pod in pods:
+            e.expect_scheduled(pod)
+
+
+def test_unsatisfiable_dependency_fails(env):
+    """topology_test.go:2078-2093 — no infinite loop, pod just fails."""
+    db = {"type": "db", "spread": "spread"}
+    web = {"type": "web", "spread": "spread"}
+    env.expect_applied(make_provisioner(name="default"))
+    pod = make_pod(labels=dict(db), pod_affinity_required=[aff_term(selector=web)])
+    env.expect_provisioned(pod)
+    env.expect_not_scheduled(pod)
+
+
+def test_affinity_namespace_list_matches(env):
+    """topology_test.go:2132-2170."""
+    topo = tsc(key=LABEL_HOSTNAME)
+    target = make_pod(labels=dict(AFF), namespace="other-ns-list")
+    follower = make_pod(
+        pod_affinity_required=[aff_term(namespaces=["other-ns-list"])]
+    )
+    pods = spread_pods(10, topo) + [target, follower]
+    env.expect_applied(make_provisioner(name="default"))
+    env.expect_provisioned(*pods)
+    n1 = env.expect_scheduled(target)
+    n2 = env.expect_scheduled(follower)
+    assert n1.metadata.name == n2.metadata.name
+
+
+def test_affinity_empty_namespace_selector(env):
+    """topology_test.go:2171-2213 — empty selector matches all namespaces."""
+    from karpenter_core_tpu.kube.objects import Namespace, ObjectMeta
+
+    env.kube.create(
+        Namespace(metadata=ObjectMeta(name="empty-ns-selector", labels={"foo": "bar"}))
+    )
+    topo = tsc(key=LABEL_HOSTNAME)
+    target = make_pod(labels=dict(AFF), namespace="empty-ns-selector")
+    follower = make_pod(
+        pod_affinity_required=[
+            aff_term(ns_selector=LabelSelector(match_labels={}))
+        ]
+    )
+    pods = spread_pods(10, topo) + [target, follower]
+    env.expect_applied(make_provisioner(name="default"))
+    env.expect_provisioned(*pods)
+    n1 = env.expect_scheduled(target)
+    n2 = env.expect_scheduled(follower)
+    assert n1.metadata.name == n2.metadata.name
+
+
+# -- Taints (topology_test.go:2249-2305) ------------------------------------
+
+
+def test_tolerated_taints_schedule(env):
+    """topology_test.go:2260-2286."""
+    from karpenter_core_tpu.kube.objects import Taint, Toleration
+
+    env.expect_applied(
+        make_provisioner(
+            name="default",
+            taints=[Taint(key="test-key", value="test-value", effect="NoSchedule")],
+        )
+    )
+    tolerant = make_pod(
+        tolerations=[Toleration(key="test-key", operator="Equal",
+                                value="test-value", effect="NoSchedule")]
+    )
+    intolerant = make_pod()
+    env.expect_provisioned(tolerant, intolerant)
+    env.expect_scheduled(tolerant)
+    env.expect_not_scheduled(intolerant)
+
+
+def test_no_taints_generated_for_op_exists(env):
+    """topology_test.go:2295-2305 — Exists requirement adds no taint."""
+    env.expect_applied(
+        make_provisioner(name="default", requirements=[req("test-key", "Exists")])
+    )
+    pod = make_pod(
+        tolerations=[{"key": "test-key", "operator": "Exists"}]
+        and [__import__("karpenter_core_tpu.kube.objects", fromlist=["Toleration"]).Toleration(
+            key="test-key", operator="Exists")]
+    )
+    env.expect_provisioned(pod)
+    node = env.expect_scheduled(pod)
+    assert not node.spec.taints
