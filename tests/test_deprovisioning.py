@@ -40,8 +40,10 @@ def env():
 
 def add_node(op, clock, name, it_name="fake-it-9", cpu="10", ct="on-demand",
              pods=1, pod_labels=None, pod_annotations=None, initialized=True,
-             annotations=None, created_at=None):
-    """An initialized karpenter node with `pods` bound running pods."""
+             annotations=None, created_at=None, zone="test-zone-1",
+             pod_requests=None, pod_owner_kind="", pod_spread=None):
+    """An initialized karpenter node with `pods` bound running pods (shared
+    with test_deprovisioning_suite.py)."""
     node = make_node(
         name=name,
         labels={
@@ -49,7 +51,7 @@ def add_node(op, clock, name, it_name="fake-it-9", cpu="10", ct="on-demand",
             LABEL_NODE_INITIALIZED: "true" if initialized else "false",
             LABEL_INSTANCE_TYPE_STABLE: it_name,
             LABEL_CAPACITY_TYPE: ct,
-            LABEL_TOPOLOGY_ZONE: "test-zone-1",
+            LABEL_TOPOLOGY_ZONE: zone,
         },
         capacity={"cpu": cpu, "memory": "20Gi", "pods": "100"},
     )
@@ -60,11 +62,13 @@ def add_node(op, clock, name, it_name="fake-it-9", cpu="10", ct="on-demand",
     op.kube_client.create(node)
     for i in range(pods):
         pod = make_pod(
-            requests={"cpu": "1"},
+            requests=pod_requests or {"cpu": "1"},
             node_name=name,
             unschedulable=False,
             labels=pod_labels,
             annotations=pod_annotations,
+            owner_kind=pod_owner_kind,
+            topology_spread=pod_spread or [],
         )
         pod.status.phase = "Running"
         op.kube_client.create(pod)

@@ -1,0 +1,285 @@
+"""Port of the reference deprovisioning suite specs not condensed into
+tests/test_deprovisioning.py: pod eviction cost model, PDB namespace
+matching, ownerless-pod eviction, node lifetime consideration, topology
+preservation on replace/delete, pending-pod accounting, parallelization
+protections, and the same-type multi-node merge guard. Cited line numbers
+refer to /root/reference/pkg/controllers/deprovisioning/suite_test.go.
+"""
+import functools
+
+import pytest
+
+from karpenter_core_tpu.api.labels import (
+    LABEL_NODE_INITIALIZED,
+    PROVISIONER_NAME_LABEL_KEY,
+)
+from karpenter_core_tpu.controllers.deprovisioning import core
+from karpenter_core_tpu.kube.objects import (
+    LABEL_TOPOLOGY_ZONE,
+    LabelSelector,
+    PodAffinityTerm,
+    PodDisruptionBudget,
+    PodDisruptionBudgetSpec,
+    TopologySpreadConstraint,
+)
+from karpenter_core_tpu.testing import make_node, make_pod, make_provisioner
+
+# shared env/builders with the condensed suite (same fixture semantics)
+from test_deprovisioning import add_node as _add_node
+from test_deprovisioning import env, provisioner  # noqa: F401
+
+add_node = functools.partial(_add_node, pod_owner_kind="ReplicaSet")
+
+
+# -- Pod Eviction Cost (suite_test.go:148-202) ------------------------------
+
+
+def test_standard_eviction_cost():
+    """suite_test.go:150-153."""
+    assert core.pod_eviction_cost(make_pod()) == 1.0
+
+
+def test_deletion_cost_annotation_orders_cost():
+    """suite_test.go:154-188 — positive raises, negative lowers, monotone."""
+    key = core.POD_DELETION_COST_ANNOTATION
+    assert core.pod_eviction_cost(make_pod(annotations={key: "100"})) > 1.0
+    assert core.pod_eviction_cost(make_pod(annotations={key: "-100"})) < 1.0
+    c1 = core.pod_eviction_cost(make_pod(annotations={key: "101"}))
+    c2 = core.pod_eviction_cost(make_pod(annotations={key: "100"}))
+    c3 = core.pod_eviction_cost(make_pod(annotations={key: "99"}))
+    assert c1 > c2 > c3
+
+
+def test_priority_orders_cost():
+    """suite_test.go:189-201."""
+    high = make_pod()
+    high.spec.priority = 1
+    low = make_pod()
+    low.spec.priority = -1
+    assert core.pod_eviction_cost(high) > 1.0
+    assert core.pod_eviction_cost(low) < 1.0
+
+
+# -- Replace / Delete details ----------------------------------------------
+
+
+def test_pdb_namespace_must_match(env):
+    """suite_test.go:335-405 — a PDB in a different namespace does not block
+    consolidation of matching-label pods elsewhere."""
+    op, cp, clock = env
+    provisioner(op, consolidation_enabled=True)
+    labels = {"app": "pdb-ns"}
+    pdb = PodDisruptionBudget(
+        spec=PodDisruptionBudgetSpec(
+            selector=LabelSelector(match_labels=dict(labels)), max_unavailable=0
+        )
+    )
+    pdb.metadata.name = "pdb"
+    pdb.metadata.namespace = "other-namespace"
+    op.kube_client.create(pdb)
+
+    op.kube_client.create(make_provisioner(name="static"))
+    keeper = make_node(
+        name="keeper",
+        labels={PROVISIONER_NAME_LABEL_KEY: "static", LABEL_NODE_INITIALIZED: "true"},
+        capacity={"cpu": "10", "memory": "20Gi", "pods": "100"},
+    )
+    op.kube_client.create(keeper)
+    add_node(op, clock, "redundant", it_name="fake-it-4", cpu="5", pods=1,
+             pod_labels=labels)
+    op.sync_state()
+    assert op.deprovisioning.reconcile(), "wrong-namespace PDB must not block"
+    op.step()
+    assert op.kube_client.get("Node", "", "redundant") is None
+
+
+def test_deleting_node_is_not_a_candidate(env):
+    """suite_test.go:679-755 — a node already in deletion is skipped rather
+    than re-planned while its teardown finishes."""
+    op, cp, clock = env
+    provisioner(op, consolidation_enabled=True, ttl_seconds_until_expired=3600)
+    node = add_node(op, clock, "going", pods=0, created_at=clock() - 8000)
+    node.metadata.deletion_timestamp = clock()
+    op.kube_client.update(node)
+    op.sync_state()
+    assert not op.deprovisioning.reconcile()
+
+
+def test_deletes_node_with_ownerless_pods(env):
+    """suite_test.go:1001-1078 — pods without a controller ownerRef are
+    evicted, not treated as blockers."""
+    op, cp, clock = env
+    provisioner(op, consolidation_enabled=True)
+    op.kube_client.create(make_provisioner(name="static"))
+    keeper = make_node(
+        name="keeper",
+        labels={PROVISIONER_NAME_LABEL_KEY: "static", LABEL_NODE_INITIALIZED: "true"},
+        capacity={"cpu": "10", "memory": "20Gi", "pods": "100"},
+    )
+    op.kube_client.create(keeper)
+    add_node(op, clock, "redundant", it_name="fake-it-4", cpu="5", pods=1,
+             pod_owner_kind="")
+    op.sync_state()
+    assert op.deprovisioning.reconcile()
+    op.step()
+    assert op.kube_client.get("Node", "", "redundant") is None
+
+
+def test_lifetime_remaining_scales_disruption_cost(env):
+    """suite_test.go:1080-1160 — nearly-expired nodes sort first (cheaper to
+    disrupt) when computing candidates."""
+    op, cp, clock = env
+    prov = provisioner(op, consolidation_enabled=True,
+                       ttl_seconds_until_expired=10000)
+    add_node(op, clock, "old", it_name="fake-it-4", cpu="5", pods=1,
+             created_at=clock() - 9000)
+    add_node(op, clock, "young", it_name="fake-it-4", cpu="5", pods=1,
+             created_at=clock() - 100)
+    op.sync_state()
+    candidates = core.candidate_nodes(
+        op.cluster, op.kube_client, cp,
+        lambda state_node, prov, pods: True, clock,
+    )
+    by_name = {c.node.metadata.name: c for c in candidates}
+    assert by_name["old"].disruption_cost < by_name["young"].disruption_cost
+
+
+def test_replace_maintains_zonal_topology_spread(env):
+    """suite_test.go:1162-1269 — replacing a node under a zonal spread keeps
+    the replacement in the same zone."""
+    op, cp, clock = env
+    provisioner(op, consolidation_enabled=True)
+    labels = {"app": "test-zonal-spread"}
+    tsc = TopologySpreadConstraint(
+        max_skew=1,
+        topology_key=LABEL_TOPOLOGY_ZONE,
+        when_unsatisfiable="DoNotSchedule",
+        label_selector=LabelSelector(match_labels=dict(labels)),
+    )
+    # zone-2 node is expensive (fake-it-9); zones 1/3 cheap (fake-it-0)
+    add_node(op, clock, "z1", it_name="fake-it-0", cpu="1", zone="test-zone-1",
+             pods=1, pod_labels=dict(labels), pod_spread=[tsc])
+    add_node(op, clock, "z2", it_name="fake-it-9", cpu="10", zone="test-zone-2",
+             pods=1, pod_labels=dict(labels), pod_spread=[tsc])
+    add_node(op, clock, "z3", it_name="fake-it-0", cpu="1", zone="test-zone-3",
+             pods=1, pod_labels=dict(labels), pod_spread=[tsc])
+    op.sync_state()
+    assert op.deprovisioning.reconcile()
+    op.step()
+    nodes = op.kube_client.list("Node")
+    zones = sorted(n.metadata.labels[LABEL_TOPOLOGY_ZONE] for n in nodes)
+    assert zones == ["test-zone-1", "test-zone-2", "test-zone-3"], (
+        "replacement must stay in test-zone-2 to preserve the spread"
+    )
+    assert op.kube_client.get("Node", "", "z2") is None
+
+
+def test_wont_delete_node_violating_anti_affinity(env):
+    """suite_test.go:1270-1364 — deletion that would force co-location of
+    anti-affine pods is rejected. Cheapest-type nodes, so a cheaper
+    replacement isn't available either: no action at all."""
+    op, cp, clock = env
+    provisioner(op, consolidation_enabled=True)
+    labels = {"app": "anti"}
+    anti = PodAffinityTerm(
+        topology_key="kubernetes.io/hostname",
+        label_selector=LabelSelector(match_labels=dict(labels)),
+    )
+    for name in ("a1", "a2"):
+        add_node(op, clock, name, it_name="fake-it-0", cpu="1", pods=0)
+        pod = make_pod(requests={"cpu": "0.5"}, node_name=name, labels=dict(labels),
+                       unschedulable=False, owner_kind="ReplicaSet",
+                       pod_anti_affinity_required=[anti])
+        pod.status.phase = "Running"
+        op.kube_client.create(pod)
+    op.sync_state()
+    # neither node can be deleted: its pod can't join the other's host
+    assert not op.deprovisioning.reconcile()
+    assert op.kube_client.get("Node", "", "a1") is not None
+    assert op.kube_client.get("Node", "", "a2") is not None
+
+
+def test_considers_pending_pods_when_consolidating(env):
+    """suite_test.go:1476-1526 — a huge pending pod needs the big node's
+    capacity class, so the node can't be replaced by something cheaper:
+    no create calls, node survives."""
+    op, cp, clock = env
+    provisioner(op, consolidation_enabled=True)
+    # one expensive node with a tiny bound pod — WITHOUT the pending pod
+    # this would be replaced by the cheapest type
+    add_node(op, clock, "big", it_name="fake-it-9", cpu="10", pods=1,
+             pod_requests={"cpu": "1"})
+    # the pending pod forces the simulation to re-buy the same big type
+    op.kube_client.create(make_pod(requests={"cpu": "8"}))
+    op.sync_state()
+    changed = op.deprovisioning.reconcile()
+    assert op.kube_client.get("Node", "", "big") is not None
+    assert not changed
+    assert not cp.create_calls
+
+
+def test_nominated_node_not_consolidated(env):
+    """suite_test.go:1802-1885 — a node nominated for rescheduled pods is
+    protected from consolidation."""
+    op, cp, clock = env
+    provisioner(op, consolidation_enabled=True)
+    op.kube_client.create(make_provisioner(name="static"))
+    keeper = make_node(
+        name="keeper",
+        labels={PROVISIONER_NAME_LABEL_KEY: "static", LABEL_NODE_INITIALIZED: "true"},
+        capacity={"cpu": "10", "memory": "20Gi", "pods": "100"},
+    )
+    op.kube_client.create(keeper)
+    add_node(op, clock, "redundant", it_name="fake-it-4", cpu="5", pods=1)
+    op.sync_state()
+    op.cluster.nominate_node_for_pod("redundant")
+    assert not op.deprovisioning.reconcile(), "nominated nodes must be skipped"
+    assert op.kube_client.get("Node", "", "redundant") is not None
+
+
+def test_provisioning_proceeds_while_node_marked_for_deletion(env):
+    """suite_test.go:1731-1801 — pods arriving mid-consolidation get a NEW
+    node; capacity marked for deletion is not reused."""
+    op, cp, clock = env
+    provisioner(op, consolidation_enabled=True)
+    add_node(op, clock, "leaving", it_name="fake-it-9", cpu="10", pods=0)
+    op.sync_state()
+    op.cluster.mark_for_deletion("leaving")
+    op.kube_client.create(make_pod(requests={"cpu": "1"}))
+    op.sync_state()
+    launched = op.provisioning.reconcile(wait_timeout=None)
+    assert launched == 1, "must launch fresh capacity, not reuse the leaving node"
+
+
+def test_wont_merge_nodes_into_same_type(env):
+    """suite_test.go:1976-2052 — multi-node consolidation filters out plans
+    whose single replacement is one of the types being removed
+    (multinodeconsolidation.go:133-166)."""
+    op, cp, clock = env
+    provisioner(op, consolidation_enabled=True)
+    # two copies of a type where the merged load would need that SAME type:
+    # filterOutSameType rejects the merge, and the less-disruptive plain
+    # deletion (dup-1's pod fits dup-2) wins with zero create calls
+    add_node(op, clock, "dup-1", it_name="fake-it-9", cpu="10", pods=1,
+             pod_requests={"cpu": "3"})
+    add_node(op, clock, "dup-2", it_name="fake-it-9", cpu="10", pods=2,
+             pod_requests={"cpu": "3"})
+    op.sync_state()
+    assert op.deprovisioning.reconcile()
+    op.step()
+    assert not cp.create_calls, "merge into the same type must be filtered"
+    assert op.kube_client.get("Node", "", "dup-1") is None
+    assert op.kube_client.get("Node", "", "dup-2") is not None
+
+
+def test_wont_replace_when_no_cheaper_type_exists(env):
+    """suite_test.go:575-678 — replacement must be strictly cheaper; a node
+    already on the cheapest type with a pod that can't move stays put."""
+    op, cp, clock = env
+    provisioner(op, consolidation_enabled=True)
+    add_node(op, clock, "floor", it_name="fake-it-0", cpu="1", pods=1,
+             pod_requests={"cpu": "0.5"})
+    op.sync_state()
+    assert not op.deprovisioning.reconcile()
+    assert op.kube_client.get("Node", "", "floor") is not None
+    assert not cp.create_calls

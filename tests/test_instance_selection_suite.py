@@ -1,0 +1,176 @@
+"""Port of reference instance_selection_test.go over the expectations
+harness — the angle the solver-level tests (test_instance_selection.py)
+don't pin: every instance-type option handed to the cloud provider at
+Create time must itself satisfy the pod + provisioner constraints
+(instance_selection_test.go:79-105 ExpectInstancesWithLabel over
+CreateCalls), on a shuffled assorted universe.
+"""
+import random
+
+import pytest
+
+from karpenter_core_tpu.api import labels as api_labels
+from karpenter_core_tpu.cloudprovider import fake
+from karpenter_core_tpu.kube.objects import (
+    LABEL_ARCH_STABLE,
+    LABEL_OS_STABLE,
+    LABEL_TOPOLOGY_ZONE,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+)
+from karpenter_core_tpu.testing import make_pod, make_provisioner
+from karpenter_core_tpu.testing.expectations import Env
+
+ZONE = LABEL_TOPOLOGY_ZONE
+CT = api_labels.LABEL_CAPACITY_TYPE
+ARCH = LABEL_ARCH_STABLE
+
+
+@pytest.fixture()
+def env():
+    universe = fake.instance_types_assorted()
+    random.Random(11).shuffle(universe)  # randomness per the reference BeforeEach
+    return Env(universe=universe)
+
+
+def req(key, op, *values):
+    return NodeSelectorRequirement(key=key, operator=op, values=list(values))
+
+
+def terms(*exprs):
+    return [NodeSelectorTerm(match_expressions=list(exprs))]
+
+
+def min_price(universe):
+    return min(
+        o.price for it in universe for o in it.offerings.available()
+    )
+
+
+def node_price(env, node):
+    """nodePrice(node) analog: the launched node's offering price."""
+    by_name = {it.name: it for it in env.universe}
+    it = by_name[node.metadata.labels["node.kubernetes.io/instance-type"]]
+    zone = node.metadata.labels[ZONE]
+    ct = node.metadata.labels[CT]
+    return next(
+        o.price for o in it.offerings.available()
+        if o.zone == zone and o.capacity_type == ct
+    )
+
+
+def create_call_options(env):
+    """supportedInstanceTypes(CreateCalls[0]) analog: instance types named
+    in the machine spec's instance-type requirement."""
+    call = env.cloud_provider.create_calls[0]
+    by_name = {it.name: it for it in env.universe}
+    for r in call.spec.requirements:
+        if r.key == "node.kubernetes.io/instance-type":
+            return [by_name[v] for v in r.values]
+    return []
+
+
+def expect_instances_with_req(options, key, *values):
+    """ExpectInstancesWithLabel: every offered option commits to one of the
+    given values for the key (instance_selection_test.go:31-44 analog)."""
+    assert options, "no instance type options in the create call"
+    for it in options:
+        r = it.requirements.get_requirement(key)
+        assert r is not None and set(r.values_list()) & set(values), (
+            f"{it.name} does not satisfy {key} in {values}"
+        )
+
+
+def test_cheapest_and_all_options_valid_pod_arch(env):
+    """instance_selection_test.go:79-105 (amd64 + arm64 variants)."""
+    for arch in ("amd64", "arm64"):
+        e = Env(universe=env.universe)
+        e.expect_applied(make_provisioner(name="default"))
+        pod = make_pod(node_affinity_required=terms(req(ARCH, "In", arch)))
+        e.expect_provisioned(pod)
+        node = e.expect_scheduled(pod)
+        assert node_price(e, node) == min_price(e.universe)
+        expect_instances_with_req(create_call_options(e), ARCH, arch)
+
+
+def test_cheapest_and_all_options_valid_pod_os(env):
+    """instance_selection_test.go:151-204 (windows + linux variants)."""
+    for os_ in ("windows", "linux"):
+        e = Env(universe=env.universe)
+        e.expect_applied(make_provisioner(name="default"))
+        pod = make_pod(node_affinity_required=terms(req(LABEL_OS_STABLE, "In", os_)))
+        e.expect_provisioned(pod)
+        node = e.expect_scheduled(pod)
+        assert node_price(e, node) == min_price(e.universe)
+        expect_instances_with_req(create_call_options(e), LABEL_OS_STABLE, os_)
+
+
+def test_cheapest_and_all_options_valid_prov_constraints(env):
+    """instance_selection_test.go:106-150, 205-260 — provisioner-side
+    arch/os/zone/ct constraints propagate to every offered option."""
+    cases = [
+        (ARCH, "amd64"),
+        (ARCH, "arm64"),
+        (LABEL_OS_STABLE, "windows"),
+        (ZONE, "test-zone-2"),
+        (CT, "spot"),
+    ]
+    for key, value in cases:
+        e = Env(universe=env.universe)
+        e.expect_applied(
+            make_provisioner(name="default", requirements=[req(key, "In", value)])
+        )
+        pod = make_pod()
+        e.expect_provisioned(pod)
+        node = e.expect_scheduled(pod)
+        assert node_price(e, node) == min_price(e.universe)
+        expect_instances_with_req(create_call_options(e), key, value)
+
+
+def test_cheapest_full_combo_create_call(env):
+    """instance_selection_test.go:386-417 — pod ct/zone/arch/os combo; every
+    option satisfies all four."""
+    env.expect_applied(make_provisioner(name="default"))
+    pod = make_pod(
+        node_affinity_required=terms(
+            req(CT, "In", "spot"),
+            req(ZONE, "In", "test-zone-2"),
+            req(ARCH, "In", "amd64"),
+            req(LABEL_OS_STABLE, "In", "linux"),
+        )
+    )
+    env.expect_provisioned(pod)
+    node = env.expect_scheduled(pod)
+    assert node_price(env, node) == min_price(env.universe)
+    options = create_call_options(env)
+    expect_instances_with_req(options, CT, "spot")
+    expect_instances_with_req(options, ZONE, "test-zone-2")
+    expect_instances_with_req(options, ARCH, "amd64")
+    expect_instances_with_req(options, LABEL_OS_STABLE, "linux")
+
+
+def test_no_match_no_create_call(env):
+    """instance_selection_test.go:418-498 — impossible selectors launch
+    nothing at all."""
+    env.expect_applied(
+        make_provisioner(name="default", requirements=[req(ARCH, "In", "arm64")])
+    )
+    pod = make_pod(
+        node_affinity_required=terms(req(ZONE, "In", "test-zone-2")),
+        node_selector={ARCH: "arm"},
+    )
+    env.expect_provisioned(pod)
+    env.expect_not_scheduled(pod)
+    assert not env.cloud_provider.create_calls
+
+
+def test_enough_resources_choice(env):
+    """instance_selection_test.go:499-552 — resource requests narrow the
+    option list to types that actually fit."""
+    env.expect_applied(make_provisioner(name="default"))
+    pod = make_pod(requests={"cpu": "32", "memory": "16Gi"})
+    env.expect_provisioned(pod)
+    env.expect_scheduled(pod)
+    for it in create_call_options(env):
+        alloc = it.allocatable()
+        assert alloc.get("cpu", 0.0) >= 32 and alloc.get("memory", 0.0) >= 16 * 2**30
