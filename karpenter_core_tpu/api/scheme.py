@@ -64,6 +64,7 @@ def default_scheme() -> Scheme:
     s.register(k8s.Node, namespaced=False)
     s.register(k8s.Namespace, namespaced=False)
     s.register(k8s.ConfigMap)
+    s.register(k8s.Secret)
     s.register(k8s.PersistentVolumeClaim)
     s.register(k8s.PersistentVolume, namespaced=False)
     s.register(k8s.StorageClass, namespaced=False)

@@ -9,7 +9,7 @@ backoff.
 """
 from __future__ import annotations
 
-import queue
+import heapq
 import threading
 import time
 from typing import List, Optional, Set
@@ -35,25 +35,44 @@ class PDBBlockedError(Exception):
 
 
 class EvictionQueue:
-    """eviction.go:58-131: rate-limited workqueue with set dedupe."""
+    """eviction.go:58-131: rate-limited workqueue with set dedupe.
+
+    Requeue-with-backoff is a DELAY HEAP drained by the single worker
+    thread (the reference's rate-limited workqueue shape): a PDB-blocked
+    pod is pushed back with a ready-at time instead of spawning a
+    threading.Timer per retry — under a large blocked drain the old
+    timer-per-pod scheme churned one thread per (pod x retry)."""
 
     def __init__(self, kube_client, recorder=None, pdb_checker=None):
         self.kube_client = kube_client
         self.recorder = recorder
         self.pdb_checker = pdb_checker  # fn(pod) -> bool allowed
         self._set: Set[NamespacedName] = set()
-        self._queue: "queue.Queue" = queue.Queue()
-        self._mu = threading.Lock()
+        self._heap: list = []  # (ready_at, seq, key, attempts)
+        self._seq = 0
+        self._cond = threading.Condition()
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
 
     def add(self, *pods: Pod) -> None:
-        with self._mu:
+        with self._cond:
             for pod in pods:
                 key = object_key(pod)
                 if key not in self._set:
                     self._set.add(key)
-                    self._queue.put((key, 0))
+                    heapq.heappush(self._heap, (0.0, self._seq, key, 0))
+                    self._seq += 1
+            self._cond.notify()
+
+    def _requeue(self, key: NamespacedName, attempts: int) -> None:
+        """PDB 429 -> exponential backoff requeue (eviction.go:110-124)."""
+        delay = min(0.1 * (2**attempts), 10.0)
+        with self._cond:
+            heapq.heappush(
+                self._heap, (time.monotonic() + delay, self._seq, key, attempts + 1)
+            )
+            self._seq += 1
+            self._cond.notify()
 
     def start(self) -> None:
         if self._thread is None:
@@ -62,22 +81,40 @@ class EvictionQueue:
 
     def stop(self) -> None:
         self._stop.set()
+        with self._cond:
+            self._cond.notify_all()
+
+    def _pop_ready(self, timeout: float = 0.1):
+        """Earliest ready item, waiting up to `timeout` for one to arrive
+        or ripen. Returns (key, attempts) or None."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while not self._stop.is_set():
+                now = time.monotonic()
+                if self._heap:
+                    ready_at = self._heap[0][0]
+                    if ready_at <= now:
+                        _, _, key, attempts = heapq.heappop(self._heap)
+                        return key, attempts
+                    wait = min(ready_at, deadline) - now
+                else:
+                    wait = deadline - now
+                if wait <= 0:
+                    return None
+                self._cond.wait(wait)
+            return None
 
     def _run(self) -> None:
         while not self._stop.is_set():
-            try:
-                key, attempts = self._queue.get(timeout=0.1)
-            except queue.Empty:
+            item = self._pop_ready()
+            if item is None:
                 continue
+            key, attempts = item
             if self.evict(key):
-                with self._mu:
+                with self._cond:
                     self._set.discard(key)
             else:
-                # PDB 429 -> exponential backoff requeue (eviction.go:110-124)
-                delay = min(0.1 * (2**attempts), 10.0)
-                threading.Timer(
-                    delay, lambda: self._queue.put((key, attempts + 1))
-                ).start()
+                self._requeue(key, attempts)
 
     def evict(self, key: NamespacedName) -> bool:
         """One eviction API call (eviction.go:87-108). True on success or
@@ -98,14 +135,14 @@ class EvictionQueue:
     def drain(self) -> None:
         """Synchronously process everything queued (for tests/sync paths)."""
         while True:
-            with self._mu:
+            with self._cond:
                 pending = list(self._set)
             if not pending:
                 return
             progressed = False
             for key in pending:
                 if self.evict(key):
-                    with self._mu:
+                    with self._cond:
                         self._set.discard(key)
                     progressed = True
             if not progressed:

@@ -1,0 +1,348 @@
+"""Real-cluster kube client: the InMemoryKubeClient surface over a live
+kube-apiserver's REST API.
+
+The control plane consumes only the narrow client surface in
+kube/client.py (get/list/create/update/compare_and_update/apply/delete/
+finalize/watch + conflict semantics). This adapter implements that surface
+against an actual apiserver — the deployment story the Helm charts
+describe (reference equivalents: client-go via controller-runtime,
+pkg/operator/operator.go:106-123, pkg/test/environment.go:69-118).
+
+No external kubernetes package is required: objects convert through
+kube/serialization.py and HTTP rides urllib. The transport is injectable,
+so tests drive the full adapter against a mocked apiserver; in-cluster
+config (service-account token + CA) is detected automatically.
+"""
+from __future__ import annotations
+
+import json
+import queue
+import ssl
+import threading
+import urllib.error
+import urllib.parse
+import urllib.request
+from typing import Dict, List, Optional, Tuple
+
+from karpenter_core_tpu.kube.client import (
+    AlreadyExistsError,
+    ConflictError,
+    NotFoundError,
+    _kind_of,
+)
+from karpenter_core_tpu.kube.serialization import from_k8s_dict, to_k8s_dict
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+# kind -> (api prefix, plural, namespaced)
+RESOURCES: Dict[str, Tuple[str, str, bool]] = {
+    "Pod": ("/api/v1", "pods", True),
+    "Node": ("/api/v1", "nodes", False),
+    "Namespace": ("/api/v1", "namespaces", False),
+    "ConfigMap": ("/api/v1", "configmaps", True),
+    "Secret": ("/api/v1", "secrets", True),
+    "PersistentVolumeClaim": ("/api/v1", "persistentvolumeclaims", True),
+    "PersistentVolume": ("/api/v1", "persistentvolumes", False),
+    "StorageClass": ("/apis/storage.k8s.io/v1", "storageclasses", False),
+    "CSINode": ("/apis/storage.k8s.io/v1", "csinodes", False),
+    "PodDisruptionBudget": ("/apis/policy/v1", "poddisruptionbudgets", True),
+    "DaemonSet": ("/apis/apps/v1", "daemonsets", True),
+    "Provisioner": ("/apis/karpenter.sh/v1alpha5", "provisioners", False),
+    "Machine": ("/apis/karpenter.sh/v1alpha5", "machines", False),
+}
+
+API_VERSIONS = {
+    "Provisioner": "karpenter.sh/v1alpha5",
+    "Machine": "karpenter.sh/v1alpha5",
+    "StorageClass": "storage.k8s.io/v1",
+    "CSINode": "storage.k8s.io/v1",
+    "PodDisruptionBudget": "policy/v1",
+    "DaemonSet": "apps/v1",
+}
+
+
+class UrllibTransport:
+    """Default transport: urllib with bearer-token + CA from the in-cluster
+    service account (or explicit kwargs)."""
+
+    def __init__(self, base_url: str, token: Optional[str] = None,
+                 ca_cert: Optional[str] = None, insecure: bool = False):
+        self.base_url = base_url.rstrip("/")
+        if token is None:
+            try:
+                token = open(f"{SA_DIR}/token").read().strip()
+            except OSError:
+                token = ""
+        self.token = token
+        if ca_cert is None:
+            import os
+
+            default_ca = f"{SA_DIR}/ca.crt"
+            ca_cert = default_ca if os.path.exists(default_ca) else None
+        if insecure:
+            self.ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+            self.ctx.check_hostname = False
+            self.ctx.verify_mode = ssl.CERT_NONE
+        elif ca_cert:
+            self.ctx = ssl.create_default_context(cafile=ca_cert)
+        else:
+            self.ctx = ssl.create_default_context()
+
+    def __call__(self, method: str, path: str, body: Optional[dict] = None,
+                 params: Optional[dict] = None, stream: bool = False,
+                 timeout: float = 30.0):
+        url = self.base_url + path
+        if params:
+            url += "?" + urllib.parse.urlencode(params)
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Content-Type", "application/json")
+        req.add_header("Accept", "application/json")
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        try:
+            resp = urllib.request.urlopen(
+                req, context=self.ctx if url.startswith("https") else None,
+                timeout=None if stream else timeout,
+            )
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode(errors="replace")
+        if stream:
+            return resp.status, resp  # caller iterates the body
+        return resp.status, resp.read().decode()
+
+
+class ApiServerKubeClient:
+    """InMemoryKubeClient-compatible adapter over a live apiserver."""
+
+    def __init__(self, transport, scheme=None, default_namespace: str = "default"):
+        from karpenter_core_tpu.api.scheme import default_scheme
+
+        self.transport = transport
+        self.scheme = scheme or default_scheme()
+        self.default_namespace = default_namespace
+        self._watch_threads: List[threading.Thread] = []
+        self._stop = threading.Event()
+
+    @classmethod
+    def in_cluster(cls, **kwargs):
+        import os
+
+        host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default.svc")
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        return cls(UrllibTransport(f"https://{host}:{port}"), **kwargs)
+
+    # -- path/encoding helpers ---------------------------------------------
+
+    def _path(self, kind: str, namespace: str = "", name: str = "") -> str:
+        prefix, plural, namespaced = RESOURCES[kind]
+        path = prefix
+        if namespaced:
+            path += f"/namespaces/{namespace or self.default_namespace}"
+        path += f"/{plural}"
+        if name:
+            path += f"/{name}"
+        return path
+
+    def _cls(self, kind: str):
+        return self.scheme.type_for(kind)
+
+    def _decode(self, kind: str, raw: dict):
+        obj = from_k8s_dict(self._cls(kind), raw)
+        rv = (raw.get("metadata") or {}).get("resourceVersion")
+        if rv is not None:
+            try:
+                obj.metadata.resource_version = int(rv)
+            except (TypeError, ValueError):
+                obj.metadata.resource_version = 0
+        return obj
+
+    def _encode(self, obj) -> dict:
+        kind = _kind_of(obj)
+        raw = to_k8s_dict(obj)
+        raw["kind"] = kind
+        raw["apiVersion"] = API_VERSIONS.get(kind, "v1")
+        meta = raw.setdefault("metadata", {})
+        rv = meta.pop("resourceVersion", None)
+        if rv:
+            meta["resourceVersion"] = str(rv)
+        prefix, _, namespaced = RESOURCES[kind]
+        if not namespaced:
+            meta.pop("namespace", None)
+        elif not meta.get("namespace"):
+            meta["namespace"] = self.default_namespace
+        return raw
+
+    # -- the client surface (kube/client.py parity) -------------------------
+
+    def new_object(self, kind: str):
+        return self._cls(kind)()
+
+    def create(self, obj):
+        kind = _kind_of(obj)
+        ns = getattr(obj.metadata, "namespace", "")
+        status, body = self.transport("POST", self._path(kind, ns), self._encode(obj))
+        if status == 409:
+            raise AlreadyExistsError(f"{kind} {obj.metadata.name} already exists")
+        self._raise_for(status, body, kind, obj.metadata.name)
+        return self._decode(kind, json.loads(body))
+
+    def get(self, kind: str, namespace: str, name: str):
+        status, body = self.transport("GET", self._path(kind, namespace, name))
+        if status == 404:
+            return None
+        self._raise_for(status, body, kind, name)
+        return self._decode(kind, json.loads(body))
+
+    def update(self, obj):
+        kind = _kind_of(obj)
+        ns = getattr(obj.metadata, "namespace", "")
+        status, body = self.transport(
+            "PUT", self._path(kind, ns, obj.metadata.name), self._encode(obj)
+        )
+        if status == 409:
+            raise ConflictError(f"{kind} {obj.metadata.name} resource version conflict")
+        if status == 404:
+            raise NotFoundError(f"{kind} {obj.metadata.name} not found")
+        self._raise_for(status, body, kind, obj.metadata.name)
+        return self._decode(kind, json.loads(body))
+
+    def compare_and_update(self, obj, expected_rv: int):
+        obj.metadata.resource_version = expected_rv
+        return self.update(obj)
+
+    def apply(self, obj):
+        try:
+            return self.create(obj)
+        except AlreadyExistsError:
+            kind = _kind_of(obj)
+            current = self.get(kind, getattr(obj.metadata, "namespace", ""), obj.metadata.name)
+            if current is not None:
+                obj.metadata.resource_version = current.metadata.resource_version
+            return self.update(obj)
+
+    def delete(self, obj_or_kind, namespace: str = None, name: str = None):
+        if isinstance(obj_or_kind, str):
+            kind = obj_or_kind
+        else:
+            kind = _kind_of(obj_or_kind)
+            namespace = getattr(obj_or_kind.metadata, "namespace", "")
+            name = obj_or_kind.metadata.name
+        status, body = self.transport("DELETE", self._path(kind, namespace or "", name))
+        if status == 404:
+            raise NotFoundError(f"{kind} {name} not found")
+        self._raise_for(status, body, kind, name)
+
+    def finalize(self, obj):
+        """Persist finalizer removal so the apiserver completes deletion."""
+        self.update(obj)
+
+    def list(self, kind: str, namespace: str = None, selector=None,
+             field_filter=None) -> List[object]:
+        prefix, plural, namespaced = RESOURCES[kind]
+        if namespaced and namespace:
+            path = f"{prefix}/namespaces/{namespace}/{plural}"
+        else:
+            path = f"{prefix}/{plural}"
+        status, body = self.transport("GET", path)
+        self._raise_for(status, body, kind, "")
+        items = [self._decode(kind, raw) for raw in json.loads(body).get("items", [])]
+        if selector is not None:
+            items = [o for o in items if selector.matches(o.metadata.labels)]
+        if field_filter is not None:
+            items = [o for o in items if field_filter(o)]
+        return items
+
+    def namespaces(self) -> List[str]:
+        return [n.metadata.name for n in self.list("Namespace")]
+
+    # -- watches ------------------------------------------------------------
+
+    def watch(self, kind: str, backlog: bool = True) -> "queue.Queue":
+        """Streamed apiserver watch pumped into a queue of (event, obj),
+        matching the in-memory client's contract.
+
+        Reconnects resume from the last seen resourceVersion; when that is
+        rejected (410 Gone / stream error) the pump RELISTS, replaying
+        current objects as ADDED and emitting synthetic DELETED events for
+        objects that vanished while the stream was down — the informer
+        list-then-watch contract, so consumers never hold ghosts."""
+        q: "queue.Queue" = queue.Queue()
+        known: dict = {}  # (namespace, name) -> True, for deletion diffing
+        last_rv = {"v": None}
+
+        def relist():
+            current = {}
+            for obj in self.list(kind):
+                key = (getattr(obj.metadata, "namespace", ""), obj.metadata.name)
+                current[key] = True
+                q.put(("ADDED", obj))
+                rv = obj.metadata.resource_version
+                if rv:
+                    last_rv["v"] = max(int(last_rv["v"] or 0), int(rv))
+            for key in list(known):
+                if key not in current:
+                    gone = self.new_object(kind)
+                    gone.metadata.namespace, gone.metadata.name = key
+                    q.put(("DELETED", gone))
+            known.clear()
+            known.update(current)
+
+        if backlog:
+            relist()
+
+        def pump():
+            fresh = backlog  # initial list already ran when backlog=True
+            while not self._stop.is_set():
+                try:
+                    if not fresh:
+                        relist()
+                    fresh = False
+                    params = {"watch": "true"}
+                    if last_rv["v"] is not None:
+                        params["resourceVersion"] = str(last_rv["v"])
+                    status, resp = self.transport(
+                        "GET", self._path(kind), params=params, stream=True
+                    )
+                    if status != 200:
+                        last_rv["v"] = None  # rv too old; force a relist
+                        self._stop.wait(2.0)
+                        continue
+                    for line in resp:
+                        if self._stop.is_set():
+                            return
+                        if not line.strip():
+                            continue
+                        event = json.loads(line)
+                        etype = event.get("type", "MODIFIED")
+                        obj = self._decode(kind, event.get("object", {}))
+                        key = (getattr(obj.metadata, "namespace", ""),
+                               obj.metadata.name)
+                        if etype == "DELETED":
+                            known.pop(key, None)
+                        else:
+                            known[key] = True
+                        rv = obj.metadata.resource_version
+                        if rv:
+                            last_rv["v"] = max(int(last_rv["v"] or 0), int(rv))
+                        q.put((etype, obj))
+                except Exception:
+                    self._stop.wait(2.0)  # stream dropped; relist on retry
+
+        t = threading.Thread(target=pump, daemon=True)
+        t.start()
+        self._watch_threads.append(t)
+        return q
+
+    def unwatch(self, kind: str, q) -> None:  # queues die with their pumps
+        pass
+
+    def close(self) -> None:
+        self._stop.set()
+
+    # -- error mapping -------------------------------------------------------
+
+    @staticmethod
+    def _raise_for(status: int, body, kind: str, name: str) -> None:
+        if status >= 400:
+            raise RuntimeError(f"apiserver {status} for {kind} {name}: {str(body)[:200]}")

@@ -471,6 +471,16 @@ class ConfigMap:
 
 
 @dataclass
+class Secret:
+    """Opaque secret (base64-encoded values in `data`) — carries the webhook
+    serving cert (chart secret-webhook-cert.yaml)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    data: Dict[str, str] = field(default_factory=dict)
+    type: str = "Opaque"
+
+
+@dataclass
 class DaemonSet:
     """Minimal DaemonSet: carries the pod template the scheduler uses to
     compute per-template daemon overhead."""

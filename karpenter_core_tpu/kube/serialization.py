@@ -1,0 +1,121 @@
+"""k8s JSON <-> object-model conversion.
+
+The object model (kube/objects.py, api/*.py) uses snake_case dataclasses
+with float quantities; the wire format (AdmissionReview payloads, a real
+apiserver) uses camelCase JSON with string quantities. `from_k8s_dict` /
+`to_k8s_dict` convert generically from the dataclass type hints, so every
+registered kind round-trips without per-type marshalling code — the analog
+of the reference's generated deepcopy/JSON tags (zz_generated.deepcopy.go).
+"""
+from __future__ import annotations
+
+import dataclasses
+import typing
+
+from karpenter_core_tpu.utils.resources import parse_quantity
+
+
+def camel(name: str) -> str:
+    parts = name.split("_")
+    return parts[0] + "".join(p.title() for p in parts[1:])
+
+
+def snake(name: str) -> str:
+    out = []
+    for ch in name:
+        if ch.isupper():
+            out.append("_")
+            out.append(ch.lower())
+        else:
+            out.append(ch)
+    return "".join(out)
+
+
+# metadata fields whose wire names aren't a plain camelCase of the attribute
+_SPECIAL_WIRE = {
+    "creation_timestamp": "creationTimestamp",
+    "deletion_timestamp": "deletionTimestamp",
+    "resource_version": "resourceVersion",
+}
+
+
+def _strip_optional(tp):
+    origin = typing.get_origin(tp)
+    if origin is typing.Union:
+        args = [a for a in typing.get_args(tp) if a is not type(None)]
+        if len(args) == 1:
+            return args[0]
+    return tp
+
+
+def _is_quantity_map(tp) -> bool:
+    """Dict[str, float] fields are ResourceLists: values may arrive as k8s
+    quantity strings ("100m", "1Gi")."""
+    return (
+        typing.get_origin(tp) is dict
+        and typing.get_args(tp) == (str, float)
+    )
+
+
+def from_k8s_dict(cls, data):
+    """Build `cls` from a camelCase k8s JSON dict. Unknown keys are ignored
+    (server-side pruning analog); missing keys take dataclass defaults."""
+    if data is None:
+        return None
+    tp = _strip_optional(cls)
+    origin = typing.get_origin(tp)
+    if origin is list:
+        (item_tp,) = typing.get_args(tp)
+        return [from_k8s_dict(item_tp, item) for item in data]
+    if origin is dict:
+        key_tp, val_tp = typing.get_args(tp)
+        if val_tp is float:
+            return {k: _to_float(v) for k, v in data.items()}
+        return {k: from_k8s_dict(val_tp, v) for k, v in data.items()}
+    if dataclasses.is_dataclass(tp):
+        hints = typing.get_type_hints(tp)
+        kwargs = {}
+        for f in dataclasses.fields(tp):
+            wire = _SPECIAL_WIRE.get(f.name, camel(f.name))
+            if wire in data:
+                raw = data[wire]
+            elif f.name in data:
+                raw = data[f.name]
+            else:
+                continue
+            kwargs[f.name] = from_k8s_dict(hints[f.name], raw)
+        return tp(**kwargs)
+    if tp is float:
+        return _to_float(data)
+    if tp in (int, str, bool):
+        return data
+    return data  # Any / plain dict (e.g. provider config)
+
+
+def _to_float(v) -> float:
+    if isinstance(v, str):
+        return parse_quantity(v)
+    return float(v)
+
+
+def to_k8s_dict(obj):
+    """Serialize an object-model instance to a camelCase k8s JSON dict.
+    Empty lists/dicts/None are dropped (k8s omitempty semantics)."""
+    if obj is None:
+        return None
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        out = {}
+        for f in dataclasses.fields(obj):
+            value = getattr(obj, f.name)
+            encoded = to_k8s_dict(value)
+            if encoded in (None, [], {}, ""):
+                continue
+            out[_SPECIAL_WIRE.get(f.name, camel(f.name))] = encoded
+        return out
+    if isinstance(obj, list):
+        return [to_k8s_dict(v) for v in obj]
+    if isinstance(obj, dict):
+        return {k: to_k8s_dict(v) for k, v in obj.items()}
+    if isinstance(obj, tuple):
+        return list(obj)
+    return obj

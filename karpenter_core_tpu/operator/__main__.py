@@ -265,6 +265,22 @@ def run(cloud_provider, kube_client=None, stop_event=None, options=None):
             health.shutdown()
             return operator  # stopped before leadership
         elector.start_renewing(stop)
+    # HTTPS admission endpoint over the same in-process admission brain
+    # (webhooks.go:17-63). Started AFTER leader election so only the leader
+    # rotates the shared cert Secret; any startup failure degrades to
+    # in-process admission instead of killing the controller.
+    webhook_server = None
+    if not opts.disable_webhook and opts.webhook_port:
+        from karpenter_core_tpu.webhooks.server import WebhookServer
+
+        webhook_server = WebhookServer(
+            operator.kube_client, host="0.0.0.0", port=opts.webhook_port
+        )
+        try:
+            webhook_server.start()
+        except Exception as exc:  # port conflict, apiserver 4xx, cert race
+            print(f"webhook server disabled: {exc}", flush=True)
+            webhook_server = None
     operator.start()
     print(
         f"controller running; health/metrics on :{opts.metrics_port}", flush=True
@@ -273,6 +289,8 @@ def run(cloud_provider, kube_client=None, stop_event=None, options=None):
     operator.stop()
     if elector is not None:
         elector.release()
+    if webhook_server is not None:
+        webhook_server.stop()
     health.shutdown()
     return operator
 

@@ -374,3 +374,36 @@ def test_resilient_solver_healthy_verdict_expires():
     health["reason"] = "tunnel wedged"
     assert not resilient.healthy()
     assert len(probes) == 2
+
+
+def test_eviction_queue_backoff_without_timer_threads():
+    """eviction.go:58-131 — PDB-blocked pods retry on a delay heap drained
+    by the ONE worker thread; no timer thread per blocked pod, and each pod
+    is eventually evicted once the PDB unblocks."""
+    import threading
+    import time as _time
+
+    from karpenter_core_tpu.controllers.machine.terminator import EvictionQueue
+    from karpenter_core_tpu.kube.client import InMemoryKubeClient
+
+    client = InMemoryKubeClient()
+    blocked = {"on": True}
+    q = EvictionQueue(client, pdb_checker=lambda pod: not blocked["on"])
+    pods = [make_pod(unschedulable=False) for _ in range(50)]
+    for p in pods:
+        client.create(p)
+
+    baseline_threads = threading.active_count()
+    q.start()
+    q.add(*pods)
+    _time.sleep(0.5)  # several blocked retry rounds
+    # one worker thread, zero timer threads despite 50 blocked pods retrying
+    assert threading.active_count() <= baseline_threads + 1
+    assert len(client.list("Pod")) == 50  # still blocked
+
+    blocked["on"] = False
+    deadline = _time.monotonic() + 10
+    while client.list("Pod") and _time.monotonic() < deadline:
+        _time.sleep(0.05)
+    q.stop()
+    assert not client.list("Pod"), "all pods evicted after PDB unblocked"

@@ -1,0 +1,191 @@
+"""HTTPS admission serving + cert rotation (webhooks/server.py), the
+out-of-process transport for the in-process admission brain
+(reference pkg/webhooks/webhooks.go:17-63).
+"""
+import base64
+import datetime
+import json
+import ssl
+import urllib.request
+
+import pytest
+
+from karpenter_core_tpu.kube.client import InMemoryKubeClient
+from karpenter_core_tpu.kube.serialization import from_k8s_dict, to_k8s_dict
+from karpenter_core_tpu.webhooks.server import (
+    CERT_SECRET_NAME,
+    CertManager,
+    WebhookServer,
+    cert_expiry,
+    generate_self_signed_cert,
+)
+
+
+def _post(port, path, review):
+    ctx = ssl.create_default_context()
+    ctx.check_hostname = False
+    ctx.verify_mode = ssl.CERT_NONE  # self-signed serving cert
+    req = urllib.request.Request(
+        f"https://127.0.0.1:{port}{path}",
+        data=json.dumps(review).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, context=ctx, timeout=10) as resp:
+        return json.loads(resp.read())
+
+
+def _review(kind, obj, uid="test-uid"):
+    return {
+        "apiVersion": "admission.k8s.io/v1",
+        "kind": "AdmissionReview",
+        "request": {"uid": uid, "kind": {"kind": kind}, "object": obj},
+    }
+
+
+@pytest.fixture()
+def server():
+    client = InMemoryKubeClient()
+    srv = WebhookServer(client, namespace="karpenter")
+    port = srv.start()
+    yield client, srv, port
+    srv.stop()
+
+
+def test_cert_manager_populates_and_reuses_secret():
+    client = InMemoryKubeClient()
+    cm = CertManager(client, namespace="karpenter")
+    cert1, key1 = cm.reconcile()
+    secret = client.get("Secret", "karpenter", CERT_SECRET_NAME)
+    assert secret is not None and secret.data["tls.crt"]
+    # fresh cert is reused, not regenerated
+    cert2, _ = cm.reconcile()
+    assert cert2 == cert1
+
+
+def test_cert_manager_rotates_near_expiry():
+    client = InMemoryKubeClient()
+    cm = CertManager(client, namespace="karpenter")
+    # seed a nearly-expired cert (3 days left < 7-day rotation window)
+    old_cert, old_key = generate_self_signed_cert(valid_days=3)
+    from karpenter_core_tpu.kube.objects import ObjectMeta, Secret
+
+    client.create(
+        Secret(
+            metadata=ObjectMeta(name=CERT_SECRET_NAME, namespace="karpenter"),
+            data={
+                "tls.crt": base64.b64encode(old_cert).decode(),
+                "tls.key": base64.b64encode(old_key).decode(),
+            },
+        )
+    )
+    new_cert, _ = cm.reconcile()
+    assert new_cert != old_cert
+    assert cert_expiry(new_cert) > cert_expiry(old_cert)
+    stored = client.get("Secret", "karpenter", CERT_SECRET_NAME)
+    assert base64.b64decode(stored.data["tls.crt"]) == new_cert
+
+
+def test_validate_rejects_invalid_provisioner(server):
+    _, _, port = server
+    bad = {
+        "kind": "Provisioner",
+        "metadata": {"name": "bad"},
+        "spec": {
+            "requirements": [
+                {"key": "kubernetes.io/hostname", "operator": "In",
+                 "values": ["h"]}
+            ]
+        },
+    }
+    out = _post(port, "/validate", _review("Provisioner", bad))
+    assert out["response"]["allowed"] is False
+    assert "hostname" in out["response"]["status"]["message"]
+
+
+def test_validate_allows_valid_provisioner(server):
+    _, _, port = server
+    good = {
+        "kind": "Provisioner",
+        "metadata": {"name": "ok"},
+        "spec": {"provider": {"fake": True}},
+    }
+    out = _post(port, "/validate", _review("Provisioner", good))
+    assert out["response"]["allowed"] is True
+    assert out["response"]["uid"] == "test-uid"
+
+
+def test_default_endpoint_returns_patch(server):
+    _, _, port = server
+    # defaulting adds e.g. the capacity-type requirement default
+    obj = {
+        "kind": "Provisioner",
+        "metadata": {"name": "needs-defaults"},
+        "spec": {"provider": {"fake": True}},
+    }
+    out = _post(port, "/default", _review("Provisioner", obj))
+    resp = out["response"]
+    assert resp["allowed"] is True
+    if "patch" in resp:  # defaulting produced changes
+        patch = json.loads(base64.b64decode(resp["patch"]))
+        assert patch and patch[0]["path"].startswith("/spec")
+
+
+def test_serialization_round_trip():
+    """from_k8s_dict/to_k8s_dict round-trip the Provisioner CRD with
+    camelCase keys and string quantities."""
+    from karpenter_core_tpu.api.provisioner import Provisioner
+
+    wire = {
+        "metadata": {"name": "p"},
+        "spec": {
+            "labels": {"team": "a"},
+            "taints": [{"key": "k", "value": "v", "effect": "NoSchedule"}],
+            "startupTaints": [{"key": "s", "effect": "NoSchedule"}],
+            "requirements": [
+                {"key": "topology.kubernetes.io/zone", "operator": "In",
+                 "values": ["test-zone-1"]}
+            ],
+            "ttlSecondsAfterEmpty": 30,
+            "limits": {"resources": {"cpu": "100", "memory": "100Gi"}},
+            "weight": 10,
+            "consolidation": {"enabled": True},
+            "provider": {"fake": True},
+        },
+    }
+    p = from_k8s_dict(Provisioner, wire)
+    assert p.spec.startup_taints[0].key == "s"
+    assert p.spec.ttl_seconds_after_empty == 30
+    assert p.spec.limits.resources["cpu"] == 100.0
+    assert p.spec.limits.resources["memory"] == 100 * 2**30
+    assert p.spec.consolidation.enabled is True
+    back = to_k8s_dict(p)
+    assert back["spec"]["startupTaints"][0]["key"] == "s"
+    assert back["spec"]["ttlSecondsAfterEmpty"] == 30
+    assert back["spec"]["weight"] == 10
+
+
+def test_default_patch_is_per_key_and_preserves_unknown_fields(server):
+    """The mutating patch touches only keys defaulting changed — canonical
+    vs canonical comparison, so wire canonicalization (camelCase, quantity
+    strings) and unknown spec fields never produce or lose data."""
+    _, _, port = server
+    obj = {
+        "kind": "Provisioner",
+        "metadata": {"name": "p"},
+        "spec": {
+            "provider": {"fake": True},
+            "limits": {"resources": {"cpu": "100"}},  # string quantity
+            "somethingUnknown": {"keep": "me"},  # not in the model
+        },
+    }
+    out = _post(port, "/default", _review("Provisioner", obj))
+    resp = out["response"]
+    assert resp["allowed"] is True
+    if "patch" in resp:
+        patch = json.loads(base64.b64decode(resp["patch"]))
+        for op in patch:
+            # per-key ops only; never a whole-spec replace that would drop
+            # the unknown field, and never a rewrite of untouched keys
+            assert op["path"].startswith("/spec/")
+            assert op["path"] != "/spec/somethingUnknown"
+            assert op["path"] != "/spec/limits"
