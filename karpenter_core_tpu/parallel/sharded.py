@@ -74,8 +74,25 @@ def plan_shards(snap, ndp: int) -> Tuple[np.ndarray, np.ndarray]:
         count_split[d] += (counts % ndp > d)
 
     if snap.topo_meta is not None and len(snap.topo_meta.groups) > 0:
+        from karpenter_core_tpu.ops import topology as topo_mod
+
         rep = snap.item_rep
         touch = (snap.topo_arrays.owner | snap.topo_arrays.sel)[:, rep]  # [G, I]
+        # hostname SPREAD groups split freely: their counts live in the
+        # per-SLOT thost lane and slots are disjoint across dp shards (fresh
+        # slots open on one shard; existing slots are owned), so every
+        # domain's count evolves on exactly one device and the global
+        # min-count/skew rule reduces to the local one (fresh empty slots
+        # pin min=0 on every shard, as globally). Routing them whole was
+        # round 3's dominant packing-quality loss: the one shard holding
+        # the hostname component monopolized the colocation headroom that
+        # other shards' hostPort/generic pods needed. Affinity and
+        # anti-affinity stay routed (their assume/seed semantics are not
+        # slot-local).
+        touch = touch.copy()
+        for g, gm in enumerate(snap.topo_meta.groups):
+            if gm.is_hostname and gm.gtype == topo_mod.TOPO_SPREAD and not gm.is_inverse:
+                touch[g, :] = False
         G = touch.shape[0]
         parent = list(range(G))
 
@@ -109,6 +126,29 @@ def plan_shards(snap, ndp: int) -> Tuple[np.ndarray, np.ndarray]:
             if c >= 0:
                 count_split[:, i] = 0
                 count_split[comp_shard[int(c)], i] = counts[i]
+        # rebalance FREE items against the component loads (water-fill):
+        # an even free split on top of LPT-routed components leaves the
+        # component shards overloaded; instead free replicas fill toward
+        # the common target load
+        free_items = np.nonzero(comp_of_item < 0)[0]
+        if len(free_items):
+            # largest items first; shard_load ACCUMULATES as items are
+            # assigned, so count-1 classes spread instead of all landing on
+            # the same largest-remainder shard
+            for i in sorted(free_items, key=lambda i: -int(counts[i])):
+                c = int(counts[i])
+                level = (int(shard_load.sum()) + c) / ndp
+                deficit = np.maximum(0.0, level - shard_load.astype(np.float64))
+                if deficit.sum() <= 0:
+                    deficit = np.ones(ndp)
+                frac = deficit / deficit.sum()
+                split = np.floor(frac * c).astype(np.int64)
+                rem = c - int(split.sum())
+                for _ in range(rem):  # leftovers one-by-one to least loaded
+                    d = int(np.argmin(shard_load + split))
+                    split[d] += 1
+                count_split[:, i] = split
+                shard_load += split
     return count_split, exist_owner
 
 

@@ -1,0 +1,445 @@
+"""Wide differential fuzz: >=52 seeds across 4 dictionary geometries and
+every constraint family (VERDICT r3 item 5, discharging SURVEY §7e).
+
+Each geometry fixes its label vocabulary with anchor pods so its seeds
+share compiled device programs; the equivalence bar is the §7e contract —
+all constraints hold on the device result and it is no worse than the
+host GreedySolver oracle (same slack rationale as
+test_differential_fuzz.py). Three seeds per geometry additionally re-solve
+through the backend='mxu' lowering (the TPU branch, CPU-executable), and
+the pallas slot screen is fuzzed kernel-level against its jnp reference.
+"""
+import os
+
+import numpy as np
+import pytest
+
+from karpenter_core_tpu.api.labels import (
+    LABEL_CAPACITY_TYPE,
+    LABEL_NODE_INITIALIZED,
+    PROVISIONER_NAME_LABEL_KEY,
+)
+from karpenter_core_tpu.cloudprovider import fake
+from karpenter_core_tpu.kube.client import InMemoryKubeClient
+from karpenter_core_tpu.kube.objects import (
+    CSINode,
+    CSINodeDriver,
+    LABEL_ARCH_STABLE,
+    LABEL_HOSTNAME,
+    LABEL_INSTANCE_TYPE_STABLE,
+    LABEL_TOPOLOGY_ZONE,
+    LabelSelector,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    ObjectMeta,
+    PersistentVolumeClaim,
+    PersistentVolumeClaimSpec,
+    PersistentVolumeClaimVolumeSource,
+    PreferredSchedulingTerm,
+    StorageClass,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+    Volume,
+)
+from karpenter_core_tpu.solver.tpu_solver import GreedySolver, TPUSolver
+from karpenter_core_tpu.state.node import StateNode
+from karpenter_core_tpu.testing import make_node, make_pod, make_provisioner
+
+from tests.test_differential_fuzz import ZONES, _check_invariants, _workload
+
+N_SEEDS = int(os.environ.get("KCT_FUZZ_SEEDS", "13"))
+MXU_SEEDS = 3  # per geometry, re-solved through the TPU mxu lowering
+
+
+def _zonal(selector):
+    return TopologySpreadConstraint(
+        max_skew=1,
+        topology_key=LABEL_TOPOLOGY_ZONE,
+        when_unsatisfiable="DoNotSchedule",
+        label_selector=LabelSelector(match_labels=selector),
+    )
+
+
+def _existing(universe, n, prefix):
+    nodes = []
+    for e in range(n):
+        it = universe[e % len(universe)]
+        nodes.append(
+            StateNode(
+                node=make_node(
+                    name=f"{prefix}-{e}",
+                    labels={
+                        PROVISIONER_NAME_LABEL_KEY: "default",
+                        LABEL_NODE_INITIALIZED: "true",
+                        LABEL_INSTANCE_TYPE_STABLE: it.name,
+                        LABEL_CAPACITY_TYPE: "on-demand",
+                        LABEL_TOPOLOGY_ZONE: ZONES[e % 3],
+                    },
+                    capacity={k: str(v) for k, v in it.capacity.items()},
+                )
+            )
+        )
+    return nodes
+
+
+def _solve_both(pods, provisioners, its, nodes, kube=None, max_nodes=96,
+                backend=None):
+    import copy
+
+    def sn():
+        return [n.deep_copy() for n in nodes] if nodes else None
+
+    host = GreedySolver().solve(
+        copy.deepcopy(pods), provisioners, its, state_nodes=sn(), kube_client=kube
+    )
+    tpu = TPUSolver(max_nodes=max_nodes, backend=backend).solve(
+        pods, provisioners, its, state_nodes=sn(), kube_client=kube
+    )
+    return host, tpu
+
+
+def _equivalence(host, tpu, pods, slack=1):
+    _check_invariants(tpu, pods)
+    assert len(tpu.failed_pods) <= len(host.failed_pods), (
+        f"device failed {len(tpu.failed_pods)} vs host {len(host.failed_pods)}"
+    )
+    assert len(tpu.new_machines) <= len(host.new_machines) + slack
+
+
+# -- G1: the baseline mix (ports, taints, spread, selectors, existing) -------
+
+
+@pytest.mark.parametrize("seed", list(range(100, 100 + N_SEEDS)))
+def test_fuzz_g1_baseline(seed):
+    rng = np.random.default_rng(seed)
+    universe = fake.instance_types(8)
+    pods, provisioners, its, nodes = _workload(rng, universe)
+    host, tpu = _solve_both(pods, provisioners, its, nodes)
+    _equivalence(host, tpu, pods)
+
+
+@pytest.mark.parametrize("seed", list(range(100, 100 + MXU_SEEDS)))
+def test_fuzz_g1_mxu_lowering(seed):
+    rng = np.random.default_rng(seed)
+    universe = fake.instance_types(8)
+    pods, provisioners, its, nodes = _workload(rng, universe)
+    host, tpu = _solve_both(pods, provisioners, its, nodes, backend="mxu")
+    _equivalence(host, tpu, pods)
+
+
+# -- G2: volumes + provisioner limits geometry ------------------------------
+
+G2_APPS = ["va", "vb"]
+
+
+def _g2_workload(rng):
+    """CSI volume limits on existing nodes + provisioner cpu limits, over a
+    12-type universe (distinct dictionary from G1)."""
+    universe = fake.instance_types(12)
+    kube = InMemoryKubeClient()
+    kube.create(StorageClass(metadata=ObjectMeta(name="fuzz-sc", namespace=""),
+                             provisioner="fuzz.csi"))
+    pods = []
+    claim_i = [0]
+
+    def pvc_pod(cpu):
+        name = f"claim-{rng.bit_generator.seed_seq.entropy}-{claim_i[0]}"
+        claim_i[0] += 1
+        kube.create(
+            PersistentVolumeClaim(
+                metadata=ObjectMeta(name=name, namespace="default"),
+                spec=PersistentVolumeClaimSpec(storage_class_name="fuzz-sc"),
+            )
+        )
+        pod = make_pod(requests={"cpu": cpu})
+        pod.spec.volumes.append(
+            Volume(name=name,
+                   persistent_volume_claim=PersistentVolumeClaimVolumeSource(claim_name=name))
+        )
+        return pod
+
+    # anchors for the fixed dictionary
+    for z in ZONES:
+        pods.append(make_pod(requests={"cpu": "0.1"},
+                             node_selector={LABEL_TOPOLOGY_ZONE: z}))
+    for app in G2_APPS:
+        pods.append(make_pod(labels={"app": app}, requests={"cpu": "0.1"}))
+    pods.append(pvc_pod("0.1"))
+
+    while len(pods) < 64:
+        kind = int(rng.integers(0, 4))
+        cpu = str(float(rng.choice([0.25, 0.5, 1.0])))
+        if kind == 0:
+            pods.append(pvc_pod(cpu))
+        elif kind == 1:
+            pods.append(make_pod(requests={"cpu": cpu},
+                                 node_selector={LABEL_TOPOLOGY_ZONE: str(rng.choice(ZONES))}))
+        else:
+            pods.append(make_pod(labels={"app": str(rng.choice(G2_APPS))},
+                                 requests={"cpu": cpu}))
+    order = rng.permutation(len(pods))
+    pods = [pods[i] for i in order]
+
+    nodes = _existing(universe, 4, "g2")
+    for node in nodes:
+        kube.create(CSINode(metadata=ObjectMeta(name=node.name()),
+                            drivers=[CSINodeDriver(name="fuzz.csi",
+                                                   allocatable_count=3)]))
+    provisioners = [make_provisioner(name="default", limits={"cpu": "200"})]
+    return pods, provisioners, {"default": universe}, nodes, kube
+
+
+def _check_volume_limits(res, kube, limit=3):
+    """No EXISTING node carries more than `limit` distinct fuzz-sc claims:
+    CSINode attach limits bind only on real nodes (existingnode.go:62-115);
+    new machines have no CSINode yet, matching the reference."""
+    def n_claims(pods):
+        claims = set()
+        for p in pods:
+            for v in p.spec.volumes:
+                if v.persistent_volume_claim is not None:
+                    claims.add(v.persistent_volume_claim.claim_name)
+        return len(claims)
+
+    for _node, ps in res.existing_assignments:
+        assert n_claims(ps) <= limit, "existing node exceeds CSI attach limit"
+
+
+@pytest.mark.parametrize("seed", list(range(200, 200 + N_SEEDS)))
+def test_fuzz_g2_volumes_limits(seed):
+    rng = np.random.default_rng(seed)
+    pods, provisioners, its, nodes, kube = _g2_workload(rng)
+    host, tpu = _solve_both(pods, provisioners, its, nodes, kube=kube)
+    _equivalence(host, tpu, pods)
+    _check_volume_limits(tpu, kube)
+
+
+@pytest.mark.parametrize("seed", list(range(200, 200 + MXU_SEEDS)))
+def test_fuzz_g2_mxu_lowering(seed):
+    rng = np.random.default_rng(seed)
+    pods, provisioners, its, nodes, kube = _g2_workload(rng)
+    host, tpu = _solve_both(pods, provisioners, its, nodes, kube=kube,
+                            backend="mxu")
+    _equivalence(host, tpu, pods)
+    _check_volume_limits(tpu, kube)
+
+
+# -- G3: relaxation geometry (preferences that must be dropped) --------------
+
+G3_APPS = ["ra", "rb", "rc", "rd", "re", "rf"]
+
+
+def _g3_workload(rng):
+    """Preferred node affinity to nonexistent zones, ScheduleAnyway
+    spreads, hostname spread — the relaxation families
+    (preferences.go:36-56)."""
+    universe = fake.instance_types(6)
+    anyway = TopologySpreadConstraint(
+        max_skew=1,
+        topology_key=LABEL_TOPOLOGY_ZONE,
+        when_unsatisfiable="ScheduleAnyway",
+        label_selector=LabelSelector(match_labels={"app": "ra"}),
+    )
+    hostname = TopologySpreadConstraint(
+        max_skew=1,
+        topology_key=LABEL_HOSTNAME,
+        when_unsatisfiable="DoNotSchedule",
+        label_selector=LabelSelector(match_labels={"app": "rb"}),
+    )
+
+    def pref_invalid():
+        return [
+            PreferredSchedulingTerm(
+                weight=50,
+                preference=NodeSelectorTerm(
+                    match_expressions=[
+                        NodeSelectorRequirement(LABEL_TOPOLOGY_ZONE, "In", ["nowhere"])
+                    ]
+                ),
+            )
+        ]
+
+    pods = []
+    for z in ZONES:
+        pods.append(make_pod(requests={"cpu": "0.1"},
+                             node_selector={LABEL_TOPOLOGY_ZONE: z}))
+    for app in G3_APPS:
+        pods.append(make_pod(labels={"app": app}, requests={"cpu": "0.1"}))
+    pods.append(make_pod(labels={"app": "ra"}, requests={"cpu": "0.1"},
+                         topology_spread=[anyway]))
+    pods.append(make_pod(labels={"app": "rb"}, requests={"cpu": "0.1"},
+                         topology_spread=[hostname]))
+
+    while len(pods) < 60:
+        kind = int(rng.integers(0, 4))
+        cpu = str(float(rng.choice([0.25, 0.5, 1.0])))
+        if kind == 0:
+            pods.append(make_pod(labels={"app": "ra"}, requests={"cpu": cpu},
+                                 topology_spread=[anyway]))
+        elif kind == 1:
+            pods.append(make_pod(labels={"app": "rb"}, requests={"cpu": cpu},
+                                 topology_spread=[hostname]))
+        elif kind == 2:
+            pods.append(make_pod(requests={"cpu": cpu},
+                                 node_affinity_preferred=pref_invalid()))
+        else:
+            pods.append(make_pod(labels={"app": str(rng.choice(G3_APPS))},
+                                 requests={"cpu": cpu}))
+    order = rng.permutation(len(pods))
+    pods = [pods[i] for i in order]
+    nodes = _existing(universe, 3, "g3")
+    return pods, [make_provisioner(name="default")], {"default": universe}, nodes
+
+
+@pytest.mark.parametrize("seed", list(range(300, 300 + N_SEEDS)))
+def test_fuzz_g3_relaxation(seed):
+    rng = np.random.default_rng(seed)
+    pods, provisioners, its, nodes = _g3_workload(rng)
+    host, tpu = _solve_both(pods, provisioners, its, nodes)
+    _equivalence(host, tpu, pods)
+    # the relaxable preferences must never FAIL a pod on either path
+    assert not tpu.failed_pods and not host.failed_pods
+
+
+@pytest.mark.parametrize("seed", list(range(300, 300 + MXU_SEEDS)))
+def test_fuzz_g3_mxu_lowering(seed):
+    rng = np.random.default_rng(seed)
+    pods, provisioners, its, nodes = _g3_workload(rng)
+    host, tpu = _solve_both(pods, provisioners, its, nodes, backend="mxu")
+    _equivalence(host, tpu, pods)
+
+
+# -- G4: multi-attribute universe geometry (arch/os/ct/integer) --------------
+
+
+def _g4_universe():
+    """Assorted-style slice: one offering per (zone, ct), two archs —
+    a dictionary with many more instance-type values than G1-G3."""
+    out = []
+    for cpu in (2, 4, 8):
+        for zone in ZONES:
+            for ct in ("spot", "on-demand"):
+                for arch in ("amd64", "arm64"):
+                    resources = {"cpu": float(cpu), "memory": float(cpu * 2 * 2**30)}
+                    out.append(
+                        fake.new_instance_type(
+                            f"g4-{cpu}-{arch}-{zone}-{ct}",
+                            resources=resources,
+                            architecture=arch,
+                            offerings=[
+                                fake.Offering(ct, zone,
+                                              fake.price_from_resources(resources))
+                            ],
+                        )
+                    )
+    return out
+
+
+def _g4_workload(rng, universe):
+    pods = []
+    for z in ZONES:
+        pods.append(make_pod(requests={"cpu": "0.1"},
+                             node_selector={LABEL_TOPOLOGY_ZONE: z}))
+    for arch in ("amd64", "arm64"):
+        pods.append(make_pod(requests={"cpu": "0.1"},
+                             node_selector={LABEL_ARCH_STABLE: arch}))
+    for ct in ("spot", "on-demand"):
+        pods.append(make_pod(requests={"cpu": "0.1"},
+                             node_selector={LABEL_CAPACITY_TYPE: ct}))
+
+    while len(pods) < 56:
+        kind = int(rng.integers(0, 5))
+        cpu = str(float(rng.choice([0.25, 0.5, 1.0, 2.0])))
+        if kind == 0:
+            pods.append(make_pod(requests={"cpu": cpu},
+                                 node_selector={LABEL_ARCH_STABLE: str(rng.choice(["amd64", "arm64"]))}))
+        elif kind == 1:
+            pods.append(make_pod(requests={"cpu": cpu},
+                                 node_selector={LABEL_CAPACITY_TYPE: str(rng.choice(["spot", "on-demand"]))}))
+        elif kind == 2:
+            pods.append(
+                make_pod(
+                    requests={"cpu": cpu},
+                    node_affinity_required=[
+                        NodeSelectorTerm(
+                            match_expressions=[
+                                NodeSelectorRequirement(
+                                    fake.INTEGER_INSTANCE_LABEL_KEY,
+                                    str(rng.choice(["Gt", "Lt"])),
+                                    ["4"],
+                                )
+                            ]
+                        )
+                    ],
+                )
+            )
+        elif kind == 3:
+            pods.append(make_pod(requests={"cpu": cpu},
+                                 node_selector={LABEL_TOPOLOGY_ZONE: str(rng.choice(ZONES))}))
+        else:
+            pods.append(make_pod(requests={"cpu": cpu}))
+    order = rng.permutation(len(pods))
+    pods = [pods[i] for i in order]
+    return pods, [make_provisioner(name="default")], {"default": universe}, []
+
+
+@pytest.mark.parametrize("seed", list(range(400, 400 + N_SEEDS)))
+def test_fuzz_g4_multi_attribute(seed):
+    rng = np.random.default_rng(seed)
+    universe = _g4_universe()
+    pods, provisioners, its, nodes = _g4_workload(rng, universe)
+    host, tpu = _solve_both(pods, provisioners, its, nodes, max_nodes=80)
+    _equivalence(host, tpu, pods)
+
+
+@pytest.mark.parametrize("seed", list(range(400, 400 + MXU_SEEDS)))
+def test_fuzz_g4_mxu_lowering(seed):
+    rng = np.random.default_rng(seed)
+    universe = _g4_universe()
+    pods, provisioners, its, nodes = _g4_workload(rng, universe)
+    host, tpu = _solve_both(pods, provisioners, its, nodes, max_nodes=80,
+                            backend="mxu")
+    _equivalence(host, tpu, pods)
+
+
+# -- pallas lowering: kernel-level fuzz vs the jnp reference -----------------
+
+
+@pytest.mark.parametrize("seed", list(range(500, 510)))
+def test_fuzz_pallas_slot_screen(seed):
+    """slot_screen_pallas (interpret mode on CPU) matches rows_compat_m on
+    random masks across 10 seeds — the pallas leg of the lowering fuzz."""
+    import jax.numpy as jnp
+
+    from karpenter_core_tpu.ops import compat
+    from karpenter_core_tpu.ops.pallas_kernels import slot_screen_pallas
+
+    rng = np.random.default_rng(seed)
+    N, V = 48, 96
+    segments = []
+    start = 0
+    while start < V:
+        width = int(rng.integers(2, 9))
+        end = min(start + width, V)
+        segments.append((start, end))
+        start = end
+    K = len(segments)
+    seg_mat = compat.seg_matrix(segments, V)
+    slot_allow = jnp.asarray(rng.random((N, V)) < 0.7)
+    slot_out = jnp.asarray(rng.random((N, K)) < 0.3)
+    slot_defined = jnp.asarray(rng.random((N, K)) < 0.5)
+    pod = {
+        "allow": jnp.asarray(rng.random(V) < 0.7),
+        "out": jnp.asarray(rng.random(K) < 0.3),
+        "defined": jnp.asarray(rng.random(K) < 0.5),
+        "escape": jnp.asarray(rng.random(K) < 0.5),
+        "custom_deny": jnp.asarray(rng.random(K) < 0.2),
+    }
+    got = slot_screen_pallas(slot_allow, slot_out, slot_defined, pod, seg_mat,
+                             interpret=True)
+    want = compat.rows_compat_m(
+        {"allow": slot_allow, "out": slot_out, "defined": slot_defined},
+        pod, seg_mat, custom_deny=pod["custom_deny"],
+    )
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))

@@ -302,16 +302,20 @@ def test_hostname_spread_component_at_scale(mesh):
         assert n_hs <= 1, "hostname spread violated on a shard"
         hs_machines += n_hs
     assert hs_machines == 40
-    # the component is on ONE shard: that shard owns all hs machines; free
-    # pods still land across multiple shards (count_split spread)
+    # hostname SPREAD splits across shards (its counts are slot-local, so
+    # the shards can share the class without a global-count race) — the
+    # per-machine skew assertion above is the correctness bar; the split is
+    # what buys back cross-shard colocation headroom
     snap = encode_snapshot(pods, provisioners, its, max_nodes=64)
     count_split, _ = plan_shards(snap, mesh.shape["dp"])
     hs_items = [
         it for it in range(len(snap.item_counts))
         if snap.pods[snap.item_members[it][0]].metadata.labels.get("app") == "hs"
     ]
-    owners = {int(np.nonzero(count_split[:, it])[0][0]) for it in hs_items}
-    assert len(owners) == 1, "hostname component must live on one shard"
+    for it in hs_items:
+        assert (count_split[:, it] > 0).sum() >= 2, (
+            "hostname-spread replicas must split across shards"
+        )
     free_shards = (count_split.sum(axis=1) > 0).sum()
     assert free_shards >= 2, "free items must use multiple shards"
 
@@ -343,3 +347,40 @@ def test_relaxation_through_sharded_solver(mesh):
     assert not res.failed_pods, "relaxation must drop the impossible preference"
     assert res.rounds >= 2, "must have taken at least one relaxation round"
     assert res.pod_count_new() == 8
+
+
+def test_pessimistic_limit_presplit_cost_bounded(mesh):
+    """The dp pre-split of provisioner limits (sharded.py: remaining_split,
+    a conservative under-approximation of the reference's global
+    subtract_max accounting, scheduler.go:276-293) may strand at most the
+    rounding slack: with a budget that exactly fits the batch globally,
+    the sharded solve schedules all but <= ndp boundary pods, and never
+    OVERSHOOTS the limit."""
+    import copy
+
+    ndp = mesh.shape["dp"]
+    universe = fake.instance_types(4)
+    # 32 identical 1-cpu pods; limit covers exactly the node capacity needed
+    pods = [make_pod(requests={"cpu": "1"}) for _ in range(32)]
+    provisioners = [make_provisioner(name="default", limits={"cpu": "48"})]
+    its = {"default": universe}
+
+    single = TPUSolver(max_nodes=64).solve(
+        copy.deepcopy(pods), provisioners, its
+    )
+    sharded = ShardedSolver(mesh, max_nodes_per_shard=16).solve(
+        pods, provisioners, its
+    )
+    # quality bound: the proportional split rounds each shard's budget
+    # DOWN, so at most one node's worth of pods per shard can strand
+    assert len(sharded.failed_pods) <= len(single.failed_pods) + ndp, (
+        f"pre-split stranded {len(sharded.failed_pods)} pods "
+        f"(single-device strands {len(single.failed_pods)})"
+    )
+    # safety bound: the split shares sum to <= the global budget, so the
+    # combined machine capacity can never exceed the limit
+    total_cpu = sum(
+        max(it.capacity.get("cpu", 0.0) for it in m.instance_type_options)
+        for m in sharded.new_machines
+    )
+    assert total_cpu <= 48.0 + 1e-6, f"limit overshot: {total_cpu}"
