@@ -534,6 +534,49 @@ def main():
     compiled = len(solver._compiled)
     pods_per_sec = N_PODS / p99  # pods/sec at the p99 latency, headline size
 
+    # -- PIPELINED steady state: the production loop overlaps the NEXT
+    # batch's encode with the current solve's device window (the host is
+    # idle in device_get), so steady-state Solve latency drops by ~the
+    # encode slice. Measured separately so the headline e2e stays the
+    # unpipelined single-call number.
+    import concurrent.futures
+    import gc as _gc
+
+    # same sample count as the headline e2e loop so the two p99s compare;
+    # the worker thread GENERATES + encodes the next batch (production
+    # shape: one live batch at a time), and the timed loop keeps the varied
+    # loop's per-solve gc.collect so GC artifacts stay isolated identically
+    pipe_runs = N_RUNS
+    pipe_times = []
+    if pipe_runs >= 2:
+        pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+
+        def gen_and_encode(r):
+            n_pods = int(N_PODS * (0.8 + 0.25 * rng.random()))
+            n_exist = int(N_EXISTING * (0.88 + 0.12 * rng.random()))
+            batch = workload(n_pods, n_exist, 1000 + r)
+            p, pr, it, nd = batch
+            return batch, solver.encode(p, pr, it, state_nodes=nd)
+
+        nxt = pool.submit(gen_and_encode, 0)
+        for r in range(pipe_runs):
+            (p, pr, it, nd), snap = nxt.result()
+            if r + 1 < pipe_runs:
+                nxt = pool.submit(gen_and_encode, r + 1)
+            _gc.collect()
+            t0 = time.perf_counter()
+            solver.solve(p, pr, it, state_nodes=nd, encoded=snap)
+            pipe_times.append(time.perf_counter() - t0)
+            print(
+                f"[bench] pipelined {r + 1}/{pipe_runs}: pods={len(p)} "
+                f"solve={pipe_times[-1] * 1e3:.0f}ms",
+                file=sys.stderr,
+            )
+            del p, pr, it, nd, snap
+        pool.shutdown(wait=False)
+    pipe_p50 = float(np.percentile(pipe_times, 50)) if pipe_times else 0.0
+    pipe_p99 = float(np.percentile(pipe_times, 99)) if pipe_times else 0.0
+
     cons = None
     if os.environ.get("BENCH_SKIP_CONSOLIDATION", "") != "1":
         try:
@@ -566,6 +609,9 @@ def main():
                     "device_solve_med_ms": round(device_ms, 1),
                     "device_p50_ms_varied": round(dev_p50, 1),
                     "device_p99_ms_varied": round(dev_p99, 1),
+                    "pipelined_p50_ms": round(pipe_p50 * 1e3, 1),
+                    "pipelined_p99_ms": round(pipe_p99 * 1e3, 1),
+                    "pipelined_runs": len(pipe_times),
                     "north_star_target_ms": 1000.0,
                     "device_under_target": bool(dev_p99 < 1000.0),
                     "runs": N_RUNS,

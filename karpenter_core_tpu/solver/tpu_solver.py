@@ -526,6 +526,27 @@ class TPUSolver:
 
     # -- public API --------------------------------------------------------
 
+    def encode(
+        self,
+        pods: List[Pod],
+        provisioners: List[Provisioner],
+        instance_types: Dict[str, List[InstanceType]],
+        daemonset_pods: Optional[List[Pod]] = None,
+        state_nodes: Optional[List] = None,
+        kube_client=None,
+        cluster=None,
+    ):
+        """Pre-encode a batch into a snapshot off the Solve critical path.
+        The production loop overlaps this with the PREVIOUS solve's device
+        window + fetch (both host-idle waits): pass the result to
+        solve(..., encoded=snap) and the ~encode-sized slice of e2e latency
+        disappears from the next Solve (round-3 PERF.md: encode was the
+        largest host cost at the north-star config)."""
+        return encode_snapshot(
+            pods, provisioners, instance_types, daemonset_pods, state_nodes,
+            kube_client=kube_client, cluster=cluster, max_nodes=self.max_nodes,
+        )
+
     def solve(
         self,
         pods: List[Pod],
@@ -535,11 +556,21 @@ class TPUSolver:
         state_nodes: Optional[List] = None,
         kube_client=None,
         cluster=None,
+        encoded=None,
     ) -> SolveResult:
+        if encoded is not None:
+            # the snapshot must be OF this batch: round 1 solves the
+            # snapshot's arrays while relax rounds re-encode from the call
+            # arguments, and relaxation matches failed pods by identity —
+            # a mismatched snapshot would silently mix cluster states and
+            # no-op every relaxation
+            assert len(encoded.pods) == len(pods) and (
+                {id(p) for p in encoded.pods} == {id(p) for p in pods}
+            ), "encoded snapshot was built from a different pod batch"
         # relaxation rounds reuse round 1's dictionary: dropping a preferred
         # term would shrink the value universe, change V/K, and force a
         # recompile mid-solve — a superset dictionary is always valid
-        relax_ctx = {"dictionary": None}
+        relax_ctx = {"dictionary": None, "encoded": encoded}
         return solve_with_relaxation(
             lambda p: self._solve_once(
                 p, provisioners, instance_types, daemonset_pods, state_nodes,
@@ -555,11 +586,13 @@ class TPUSolver:
 
     def _solve_once(self, pods, provisioners, instance_types, daemonset_pods,
                     state_nodes, kube_client=None, cluster=None, relax_ctx=None):
-        snap = encode_snapshot(
-            pods, provisioners, instance_types, daemonset_pods, state_nodes,
-            kube_client=kube_client, cluster=cluster, max_nodes=self.max_nodes,
-            reuse_dictionary=relax_ctx.get("dictionary") if relax_ctx else None,
-        )
+        snap = relax_ctx.pop("encoded", None) if relax_ctx else None
+        if snap is None:
+            snap = encode_snapshot(
+                pods, provisioners, instance_types, daemonset_pods, state_nodes,
+                kube_client=kube_client, cluster=cluster, max_nodes=self.max_nodes,
+                reuse_dictionary=relax_ctx.get("dictionary") if relax_ctx else None,
+            )
         if relax_ctx is not None:
             relax_ctx["dictionary"] = snap.dictionary
         log, ptr, state = self._run_kernels(snap, provisioners)

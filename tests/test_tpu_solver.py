@@ -804,3 +804,36 @@ def test_donated_topo_plane_above_packing_threshold():
     G = len(snap.topo_meta.groups)
     assert G * snap.dictionary.V > 4096, "test must cross the packing threshold"
     assert res.pod_count_new() == 4 and not res.failed_pods
+
+
+def test_pre_encoded_solve_matches_inline_encode():
+    """solve(..., encoded=solver.encode(...)) — the pipelined production
+    path — produces the same placements as the inline-encode path."""
+    from collections import Counter
+
+    universe = fake.instance_types(6)
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": universe}
+    pods = [make_pod(requests={"cpu": "0.5"}) for _ in range(24)]
+    solver = TPUSolver(max_nodes=64)
+
+    inline = solver.solve(pods, provisioners, its)
+    snap = solver.encode(pods, provisioners, its)
+    piped = solver.solve(pods, provisioners, its, encoded=snap)
+    assert piped.pod_count_new() == inline.pod_count_new()
+    assert not piped.failed_pods
+
+    def shape(res):
+        # machine-level placement shape: (pod count, narrowed type options)
+        return Counter(
+            (len(m.pods), tuple(sorted(it.name for it in m.instance_type_options)))
+            for m in res.new_machines
+        )
+
+    assert shape(piped) == shape(inline)
+    # a snapshot from a DIFFERENT batch is rejected loudly
+    import pytest as _pytest
+
+    other = [make_pod(requests={"cpu": "0.5"}) for _ in range(24)]
+    with _pytest.raises(AssertionError):
+        solver.solve(other, provisioners, its, encoded=snap)
