@@ -1,0 +1,22 @@
+# Developer entry points — the analog of the reference's Makefile targets
+# (test/deflake/verify, reference Makefile:9-33). Tests force the CPU
+# backend with 8 virtual devices via tests/conftest.py.
+
+.PHONY: test deflake perf bench verify
+
+test:  ## full suite (CPU, 8 virtual devices)
+	python -m pytest tests -q
+
+deflake:  ## until-it-fails loop over the concurrency-sensitive suites
+	./hack/deflake.sh
+
+perf:  ## enforced >=100 pods/sec floor (reference test_performance tag)
+	KCT_PERF=1 python -m pytest tests/test_perf_floor.py -q
+
+bench:  ## north-star benchmark on the attached backend (one JSON line)
+	python bench.py
+
+verify:  ## driver hooks: single-chip compile check + 8-way mesh dryrun
+	python -c "import jax, __graft_entry__ as g; fn, a = g.entry(); \
+	jax.block_until_ready(jax.jit(fn)(*a)); print('entry ok')"
+	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"

@@ -1,0 +1,24 @@
+#!/usr/bin/env bash
+# Until-it-fails deflake loop over the concurrency-sensitive suites — the
+# analog of the reference's `make deflake` (Makefile:14-20: ginkgo --race
+# --randomize-all --until-it-fails). Each iteration re-runs the threaded
+# runtime suites with a fresh jitter seed; the loop stops at the FIRST
+# failure (preserving the output) or after MAX_ITERS (default: forever).
+set -u
+cd "$(dirname "$0")/.."
+i=0
+while :; do
+  i=$((i + 1))
+  seed=$RANDOM
+  echo "=== deflake iteration $i (seed $seed) ==="
+  if ! KCT_DEFLAKE_ITERS="${KCT_DEFLAKE_ITERS:-20}" KCT_DEFLAKE_SEED="$seed" \
+      python -m pytest tests/test_deflake.py tests/test_operator_runtime.py \
+      tests/test_controllers.py -q; then
+    echo "=== FAILED on iteration $i (seed $seed) ==="
+    exit 1
+  fi
+  if [ -n "${MAX_ITERS:-}" ] && [ "$i" -ge "$MAX_ITERS" ]; then
+    echo "=== $i iterations green ==="
+    exit 0
+  fi
+done

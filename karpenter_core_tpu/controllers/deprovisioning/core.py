@@ -230,9 +230,13 @@ def candidate_nodes(
 
     # ONE pass over the pod store instead of a per-candidate filtered list:
     # the naive form is O(nodes x pods) with a lambda per pair — at 1k
-    # nodes / 10k pods that is 10M calls per deprovisioning scan
+    # nodes / 10k pods that is 10M calls per deprovisioning scan.
+    # Shared references (copy_objects=False): this path only READS pods —
+    # simulate paths shallow-clone (clone_for_simulation) before clearing
+    # node_name and the solvers deep-copy a pod before relaxing it — and at
+    # 10k pods the per-scan clone dominated the whole replan's host time
     pods_by_node: Dict[str, List[Pod]] = {}
-    for p in kube_client.list("Pod"):
+    for p in kube_client.list("Pod", copy_objects=False):
         if p.spec.node_name and not podutils.is_terminal(p):
             pods_by_node.setdefault(p.spec.node_name, []).append(p)
 
@@ -312,15 +316,13 @@ def simulate_scheduling(
         pods.extend(
             p
             for p in kube_client.list(
-                "Pod", field_filter=lambda p, n=node: p.spec.node_name == n.name()
+                "Pod",
+                field_filter=lambda p, n=node: p.spec.node_name == n.name(),
+                copy_objects=False,  # cloned for mutation two lines down
             )
             if not podutils.is_terminal(p) and not podutils.is_owned_by_daemonset(p)
         )
-    import copy
-
-    pods = [copy.deepcopy(p) for p in pods]
-    for p in pods:
-        p.spec.node_name = ""
+    pods = [podutils.clone_for_simulation(p) for p in pods]
 
     provisioners = [
         p for p in kube_client.list("Provisioner") if p.metadata.deletion_timestamp is None

@@ -238,7 +238,9 @@ class ApiServerKubeClient:
         self.update(obj)
 
     def list(self, kind: str, namespace: str = None, selector=None,
-             field_filter=None) -> List[object]:
+             field_filter=None, copy_objects: bool = True) -> List[object]:
+        # copy_objects is part of the client surface; decoded REST objects
+        # are always fresh, so it has no effect here
         prefix, plural, namespaced = RESOURCES[kind]
         if namespaced and namespace:
             path = f"{prefix}/namespaces/{namespace}/{plural}"

@@ -184,7 +184,14 @@ class InMemoryKubeClient:
         namespace: Optional[str] = None,
         selector: Optional[LabelSelector] = None,
         field_filter: Optional[Callable[[object], bool]] = None,
+        copy_objects: bool = True,
     ) -> List[object]:
+        """copy_objects=False returns SHARED references (the informer-cache
+        read idiom client-go consumers use): only for read-only paths —
+        callers that mutate must deep-copy first, exactly as they must with
+        objects handed out by a controller-runtime cache. The deprovisioning
+        replan reads thousands of pods per cycle; cloning them dominated
+        the whole ladder's host time."""
         with self._mu:
             out = []
             for key, obj in self._objects.get(kind, {}).items():
@@ -194,7 +201,7 @@ class InMemoryKubeClient:
                     continue
                 if field_filter is not None and not field_filter(obj):
                     continue
-                out.append(copy.deepcopy(obj))
+                out.append(copy.deepcopy(obj) if copy_objects else obj)
             return out
 
     def namespaces(self) -> List[str]:

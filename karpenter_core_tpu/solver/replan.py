@@ -19,7 +19,6 @@ of up to 8 sequential solves.
 """
 from __future__ import annotations
 
-import copy
 from dataclasses import dataclass
 from typing import Dict, List, Tuple
 
@@ -76,7 +75,9 @@ def batched_ladder_screen(
         cand_of.append(-1)
     for node in deleting_nodes:
         for p in kube_client.list(
-            "Pod", field_filter=lambda p, n=node: p.spec.node_name == n.name()
+            "Pod",
+            field_filter=lambda p, n=node: p.spec.node_name == n.name(),
+            copy_objects=False,  # clone_for_simulation shallow-clones below
         ):
             if not podutils.is_terminal(p) and not podutils.is_owned_by_daemonset(p):
                 pods.append(p)
@@ -86,9 +87,7 @@ def batched_ladder_screen(
             if not podutils.is_owned_by_daemonset(p):
                 pods.append(p)
                 cand_of.append(ci)
-    pods = [copy.deepcopy(p) for p in pods]
-    for p in pods:
-        p.spec.node_name = ""
+    pods = [podutils.clone_for_simulation(p) for p in pods]
     cand_of_pod: Dict[str, int] = {
         p.metadata.uid: ci for p, ci in zip(pods, cand_of)
     }

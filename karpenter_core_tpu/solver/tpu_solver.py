@@ -564,9 +564,12 @@ class TPUSolver:
             # arguments, and relaxation matches failed pods by identity —
             # a mismatched snapshot would silently mix cluster states and
             # no-op every relaxation
-            assert len(encoded.pods) == len(pods) and (
-                {id(p) for p in encoded.pods} == {id(p) for p in pods}
-            ), "encoded snapshot was built from a different pod batch"
+            if len(encoded.pods) != len(pods) or (
+                {id(p) for p in encoded.pods} != {id(p) for p in pods}
+            ):
+                raise ValueError(
+                    "encoded snapshot was built from a different pod batch"
+                )
         # relaxation rounds reuse round 1's dictionary: dropping a preferred
         # term would shrink the value universe, change V/K, and force a
         # recompile mid-solve — a superset dictionary is always valid

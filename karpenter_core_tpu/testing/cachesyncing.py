@@ -1,0 +1,90 @@
+"""CacheSyncingClient — writes block until the watch fan-out observes them.
+
+The analog of reference pkg/test/cachesyncingclient.go:45: envtest suites
+wrap the client so a test that writes an object and immediately asserts on
+informer-driven state can't flake on watch latency. Here the wrapper holds
+its own watch queues and, after every write, drains them until the event
+for that object (same kind, key, and resource version) has been delivered —
+proving the client's notification fan-out handed the event to every
+subscriber queue registered before the write."""
+from __future__ import annotations
+
+import queue
+import time
+from typing import Dict
+
+from karpenter_core_tpu.kube.objects import object_key
+
+
+class CacheSyncingClient:
+    """Wraps a kube client; create/update/delete block until self-observed."""
+
+    def __init__(self, inner, timeout: float = 5.0):
+        self._inner = inner
+        self._timeout = timeout
+        self._queues: Dict[str, "queue.Queue"] = {}
+
+    def __getattr__(self, name):  # read paths pass straight through
+        return getattr(self._inner, name)
+
+    def _queue_for(self, kind: str) -> "queue.Queue":
+        q = self._queues.get(kind)
+        if q is None:
+            q = self._inner.watch(kind, backlog=False)
+            self._queues[kind] = q
+        return q
+
+    def _await_event(self, kind: str, key, min_rv: int, deleted: bool = False):
+        q = self._queue_for(kind)
+        deadline = time.monotonic() + self._timeout
+        while True:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"watch never observed {'deletion of ' if deleted else ''}"
+                    f"{kind} {key} (rv>={min_rv}) within {self._timeout}s"
+                )
+            try:
+                event, obj = q.get(timeout=remaining)
+            except queue.Empty:
+                continue
+            if object_key(obj) != key:
+                continue
+            if deleted and event == "DELETED":
+                return
+            if not deleted and obj.metadata.resource_version >= min_rv:
+                return
+
+    def create(self, obj):
+        kind = type(obj).__name__
+        self._queue_for(kind)  # subscribe BEFORE the write
+        created = self._inner.create(obj)
+        self._await_event(kind, object_key(created), created.metadata.resource_version)
+        return created
+
+    def update(self, obj):
+        kind = type(obj).__name__
+        self._queue_for(kind)
+        updated = self._inner.update(obj)
+        self._await_event(kind, object_key(updated), updated.metadata.resource_version)
+        return updated
+
+    def apply(self, obj):
+        kind = type(obj).__name__
+        self._queue_for(kind)
+        applied = self._inner.apply(obj)
+        self._await_event(kind, object_key(applied), applied.metadata.resource_version)
+        return applied
+
+    def delete(self, obj_or_kind, namespace: str = None, name: str = None):
+        if isinstance(obj_or_kind, str):
+            kind, ns, nm = obj_or_kind, namespace or "", name
+        else:
+            kind = type(obj_or_kind).__name__
+            ns = getattr(obj_or_kind.metadata, "namespace", "")
+            nm = obj_or_kind.metadata.name
+        self._queue_for(kind)
+        from karpenter_core_tpu.kube.objects import NamespacedName
+
+        self._inner.delete(obj_or_kind, namespace, name)
+        self._await_event(kind, NamespacedName(ns, nm), 0, deleted=True)

@@ -105,6 +105,30 @@ def _run_iteration(seed: int) -> None:
         op.stop()
 
 
+# hack/deflake.sh re-seeds every until-it-fails iteration so repeated runs
+# explore fresh interleavings instead of replaying 0..ITERS forever
+SEED_BASE = int(os.environ.get("KCT_DEFLAKE_SEED", "0")) * 10_000
+
+
 @pytest.mark.parametrize("seed", range(ITERS))
 def test_threaded_runtime_deflake(seed):
-    _run_iteration(seed)
+    _run_iteration(SEED_BASE + seed)
+
+
+def test_cache_syncing_client_blocks_until_observed():
+    """CacheSyncingClient (cachesyncingclient.go:45 analog): writes return
+    only after the client's own watch queue delivered the event, so a
+    write-then-assert test can't race the watch fan-out."""
+    from karpenter_core_tpu.kube.client import InMemoryKubeClient
+    from karpenter_core_tpu.testing.cachesyncing import CacheSyncingClient
+
+    client = CacheSyncingClient(InMemoryKubeClient())
+    pod = make_pod(requests={"cpu": "1"})
+    created = client.create(pod)
+    rv_created = created.metadata.resource_version
+    assert rv_created >= 1
+    created.metadata.labels["x"] = "y"
+    updated = client.update(created)
+    assert updated.metadata.resource_version > rv_created
+    client.delete("Pod", created.metadata.namespace, created.metadata.name)
+    assert client.get("Pod", created.metadata.namespace, created.metadata.name) is None

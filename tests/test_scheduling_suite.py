@@ -655,3 +655,59 @@ def test_pod_opted_out_of_karpenter_is_ignored(env):
     assert opted_out.metadata.name not in {
         p.metadata.name for p in env.provisioning.get_pending_pods()
     }
+
+
+def test_relaxation_only_touches_failed_pods(env):
+    """Divergence guard for the TPU path's per-ROUND relaxation
+    (solver/tpu_solver.py) vs the reference's per-POD relax
+    (scheduler.go:114-123): a pod whose preference IS satisfiable keeps it
+    honored even while other pods in the same batch must relax theirs."""
+    env.expect_applied(make_provisioner(name="default"))
+    keeps = make_pod(
+        node_affinity_preferred=prefs(req(ZONE, "In", "test-zone-2"))
+    )
+    relaxes = make_pod(
+        node_affinity_preferred=prefs(req(ZONE, "In", "nowhere"))
+    )
+    env.expect_provisioned(keeps, relaxes)
+    node_keeps = env.expect_scheduled(keeps)
+    env.expect_scheduled(relaxes)
+    assert node_keeps.metadata.labels.get(ZONE) == "test-zone-2", (
+        "satisfiable preference must survive another pod's relaxation round"
+    )
+
+
+def test_required_or_terms_relax_in_order_per_pod(env):
+    """Two pods with DIFFERENT viable OR-terms each land on their own
+    first-viable term — relaxation state is per pod, not shared."""
+    env.expect_applied(make_provisioner(name="default"))
+    pod_a = make_pod(
+        node_affinity_required=[
+            NodeSelectorTerm(match_expressions=[req(ZONE, "In", "nowhere")]),
+            NodeSelectorTerm(match_expressions=[req(ZONE, "In", "test-zone-1")]),
+        ]
+    )
+    pod_b = make_pod(
+        node_affinity_required=[
+            NodeSelectorTerm(match_expressions=[req(ZONE, "In", "nowhere")]),
+            NodeSelectorTerm(match_expressions=[req(ZONE, "In", "test-zone-3")]),
+        ]
+    )
+    env.expect_provisioned(pod_a, pod_b)
+    assert env.expect_scheduled(pod_a).metadata.labels[ZONE] == "test-zone-1"
+    assert env.expect_scheduled(pod_b).metadata.labels[ZONE] == "test-zone-3"
+
+
+def test_relaxation_only_touches_failed_pods_device_path():
+    """The same guard through the DEVICE solver's bounded masked re-solve
+    rounds: satisfiable preferences survive other pods' relaxations."""
+    from karpenter_core_tpu.solver.tpu_solver import TPUSolver
+
+    env = Env(solver=TPUSolver(max_nodes=32))
+    env.expect_applied(make_provisioner(name="default"))
+    keeps = make_pod(node_affinity_preferred=prefs(req(ZONE, "In", "test-zone-2")))
+    relaxes = make_pod(node_affinity_preferred=prefs(req(ZONE, "In", "nowhere")))
+    env.expect_provisioned(keeps, relaxes)
+    node_keeps = env.expect_scheduled(keeps)
+    env.expect_scheduled(relaxes)
+    assert node_keeps.metadata.labels.get(ZONE) == "test-zone-2"

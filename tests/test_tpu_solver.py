@@ -831,9 +831,10 @@ def test_pre_encoded_solve_matches_inline_encode():
         )
 
     assert shape(piped) == shape(inline)
-    # a snapshot from a DIFFERENT batch is rejected loudly
+    # a snapshot from a DIFFERENT batch is rejected loudly (ValueError,
+    # not assert: it must survive python -O)
     import pytest as _pytest
 
     other = [make_pod(requests={"cpu": "0.5"}) for _ in range(24)]
-    with _pytest.raises(AssertionError):
+    with _pytest.raises(ValueError):
         solver.solve(other, provisioners, its, encoded=snap)
