@@ -97,7 +97,13 @@ class MachineController:
         node.metadata.labels.update(machine.metadata.labels)
         node.metadata.labels[api_labels.MACHINE_NAME_LABEL_KEY] = machine.name
         node.spec.taints = taints_mod.merge(node.spec.taints, machine.spec.taints)
-        node.spec.taints = taints_mod.merge(node.spec.taints, machine.spec.startup_taints)
+        if not machine.condition_true(CONDITION_MACHINE_REGISTERED):
+            # startupTaints sync exactly ONCE, at first registration: once
+            # the node agent removes them they must NOT reappear on later
+            # reconciles (registration.go:38-98; suite_test.go:363-409)
+            node.spec.taints = taints_mod.merge(
+                node.spec.taints, machine.spec.startup_taints
+            )
         if api_labels.TERMINATION_FINALIZER not in node.metadata.finalizers:
             node.metadata.finalizers.append(api_labels.TERMINATION_FINALIZER)
         self.kube_client.apply(node)
@@ -144,6 +150,8 @@ class MachineController:
         if machine.condition_true(CONDITION_MACHINE_REGISTERED):
             return None
         ttl = current_settings().ttl_after_not_registered
+        if ttl is None:
+            return None  # reaper disabled (settings.go TTLAfterNotRegistered)
         age = self.clock() - machine.metadata.creation_timestamp
         if age < ttl:
             return ttl - age

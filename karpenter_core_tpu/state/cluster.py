@@ -143,7 +143,12 @@ class Cluster:
 
     def update_machine(self, machine: Machine) -> None:
         with self._mu:
-            provider_id = machine.status.provider_id or f"machine:///{machine.name}"
+            if not machine.status.provider_id:
+                # can't reconcile machines without provider ids yet
+                # (cluster.go:204-210); synced() skips them for the same
+                # reason, so they don't block startup either
+                return
+            provider_id = machine.status.provider_id
             existing = self.nodes_by_provider_id.get(provider_id)
             if existing is None:
                 existing = StateNode(machine=machine, clock=self.clock)

@@ -228,6 +228,49 @@ def make_provisioner(
     return p
 
 
+def make_machine(
+    name: Optional[str] = None,
+    provider_id: str = "",
+    labels: Optional[Dict[str, str]] = None,
+    requirements: Optional[List[NodeSelectorRequirement]] = None,
+    capacity: Optional[Dict[str, object]] = None,
+    allocatable: Optional[Dict[str, object]] = None,
+    launched: bool = False,
+    registered: bool = False,
+    initialized: bool = False,
+):
+    """test.Machine analog (reference pkg/test/machines.go): a launch-intent
+    record with optional lifecycle conditions pre-set."""
+    from karpenter_core_tpu.api.machine import (
+        CONDITION_MACHINE_INITIALIZED,
+        CONDITION_MACHINE_LAUNCHED,
+        CONDITION_MACHINE_REGISTERED,
+        Machine,
+        MachineSpec,
+        MachineStatus,
+    )
+
+    machine = Machine(
+        metadata=ObjectMeta(name=name or unique_name("machine"),
+                            labels=dict(labels or {})),
+        spec=MachineSpec(requirements=list(requirements or [])),
+        status=MachineStatus(
+            provider_id=provider_id,
+            capacity=parse_resource_list(capacity or {}),
+            allocatable=parse_resource_list(
+                (capacity if allocatable is None else allocatable) or {}
+            ),
+        ),
+    )
+    if launched:
+        machine.set_condition(CONDITION_MACHINE_LAUNCHED, "True")
+    if registered:
+        machine.set_condition(CONDITION_MACHINE_REGISTERED, "True")
+    if initialized:
+        machine.set_condition(CONDITION_MACHINE_INITIALIZED, "True")
+    return machine
+
+
 def make_daemonset(
     name: Optional[str] = None,
     namespace: str = "default",

@@ -1,0 +1,493 @@
+"""Port of reference pkg/controllers/state/suite_test.go (39 specs across
+Inflight Nodes / Node Resource Level / Pod Anti-Affinity / Provisioner Spec
+Updates / Cluster State Sync), spec-for-spec against state.Cluster via the
+operator's informer pump (op.sync_state = the level-triggered relist the
+node/pod/machine informer reconciles perform). Cited line numbers refer to
+/root/reference/pkg/controllers/state/suite_test.go.
+"""
+import pytest
+
+from karpenter_core_tpu.api.labels import (
+    LABEL_NODE_INITIALIZED,
+    PROVISIONER_NAME_LABEL_KEY,
+)
+from karpenter_core_tpu.api.settings import Settings
+from karpenter_core_tpu.cloudprovider import fake
+from karpenter_core_tpu.kube.objects import (
+    LABEL_INSTANCE_TYPE_STABLE,
+    LABEL_TOPOLOGY_ZONE,
+    LabelSelector,
+    NodeSelectorRequirement,
+    PodAffinityTerm,
+    WeightedPodAffinityTerm,
+)
+from karpenter_core_tpu.operator import new_operator
+from karpenter_core_tpu.testing import (
+    FakeClock,
+    make_machine,
+    make_node,
+    make_pod,
+    make_provisioner,
+)
+
+GI = 2**30
+
+
+@pytest.fixture
+def env():
+    clock = FakeClock()
+    cp = fake.FakeCloudProvider(fake.instance_types(5))
+    op = new_operator(cp, settings=Settings(), clock=clock)
+    op.kube_client.create(make_provisioner(name="default"))
+    return op, cp, clock
+
+
+def state_nodes(op):
+    return op.cluster.nodes()
+
+
+# -- Inflight Nodes (suite_test.go:93-482) ----------------------------------
+
+
+def test_capacity_from_instance_type(env):
+    """suite_test.go:94-108 — an uninitialized node's capacity/allocatable
+    come from the instance type (kubelet hasn't reported yet)."""
+    op, cp, clock = env
+    it = cp.instance_types[0]
+    node = make_node(labels={PROVISIONER_NAME_LABEL_KEY: "default",
+                             LABEL_INSTANCE_TYPE_STABLE: it.name})
+    op.kube_client.create(node)
+    op.sync_state()
+    assert len(state_nodes(op)) == 1
+    sn = op.cluster.node_for(node.metadata.name)
+    for k, v in it.capacity.items():
+        assert sn.capacity().get(k) == pytest.approx(v)
+    for k, v in it.allocatable().items():
+        assert sn.allocatable().get(k) == pytest.approx(v)
+
+
+def test_capacity_combines_instance_type_and_node(env):
+    """suite_test.go:109-137 — real kubelet-reported values win per
+    resource; the instance type fills the gaps."""
+    op, cp, clock = env
+    it = cp.instance_types[0]
+    node = make_node(
+        labels={PROVISIONER_NAME_LABEL_KEY: "default",
+                LABEL_INSTANCE_TYPE_STABLE: it.name},
+        capacity={"ephemeral-storage": "100Gi"},
+        allocatable={"memory": "100Mi"},
+    )
+    op.kube_client.create(node)
+    op.sync_state()
+    sn = op.cluster.node_for(node.metadata.name)
+    assert sn.allocatable().get("memory") == pytest.approx(100 * 2**20)
+    assert sn.allocatable().get("cpu") == pytest.approx(it.allocatable()["cpu"])
+    assert sn.capacity().get("ephemeral-storage") == pytest.approx(100 * GI)
+    assert sn.capacity().get("memory") == pytest.approx(it.capacity["memory"])
+
+
+def test_machine_without_provider_id_ignored(env):
+    """suite_test.go:138-176."""
+    op, cp, clock = env
+    machine = make_machine(provider_id="")
+    op.kube_client.create(machine)
+    op.sync_state()
+    assert op.cluster.node_for(machine.metadata.name) is None
+
+
+def test_machine_with_no_node_is_inflight(env):
+    """suite_test.go:177-240 — a machine with a provider id but no node yet
+    is schedulable in-flight capacity."""
+    op, cp, clock = env
+    it = cp.instance_types[0]
+    machine = make_machine(
+        provider_id="fake://m1",
+        requirements=[
+            NodeSelectorRequirement(LABEL_INSTANCE_TYPE_STABLE, "In", [it.name]),
+            NodeSelectorRequirement(LABEL_TOPOLOGY_ZONE, "In", ["test-zone-1"]),
+        ],
+        capacity={k: str(v) for k, v in it.capacity.items()},
+    )
+    op.kube_client.create(machine)
+    op.sync_state()
+    sn = op.cluster.node_for(machine.metadata.name)
+    assert sn is not None and sn.node is None and sn.machine is not None
+
+
+def test_inflight_capacity_is_machine_capacity(env):
+    """suite_test.go:241-288."""
+    op, cp, clock = env
+    machine = make_machine(
+        provider_id="fake://m2",
+        capacity={"cpu": "2", "memory": "32Gi", "ephemeral-storage": "20Gi"},
+        allocatable={"cpu": "1", "memory": "30Gi", "ephemeral-storage": "18Gi"},
+    )
+    op.kube_client.create(machine)
+    op.sync_state()
+    sn = op.cluster.node_for(machine.metadata.name)
+    assert sn.capacity().get("cpu") == pytest.approx(2.0)
+    assert sn.capacity().get("memory") == pytest.approx(32 * GI)
+    assert sn.allocatable().get("cpu") == pytest.approx(1.0)
+    assert sn.allocatable().get("ephemeral-storage") == pytest.approx(18 * GI)
+
+
+def test_machine_capacity_until_node_initialized(env):
+    """suite_test.go:289-438 — while the node is uninitialized the machine
+    fills resources the kubelet hasn't reported (zeros/absent on the node);
+    kubelet-reported values win as soon as they exist."""
+    op, cp, clock = env
+    machine = make_machine(
+        provider_id="fake://m3",
+        capacity={"cpu": "4", "memory": "4Gi"},
+        allocatable={"cpu": "4", "memory": "4Gi"},
+        launched=True,
+    )
+    op.kube_client.create(machine)
+    # kubelet hasn't reported anything yet: empty node capacity
+    node = make_node(name="m3-node", provider_id="fake://m3",
+                     labels={PROVISIONER_NAME_LABEL_KEY: "default"},
+                     capacity={})
+    op.kube_client.create(node)
+    op.sync_state()
+    sn = op.cluster.node_for("m3-node")
+    assert sn.machine is not None and sn.node is not None
+    assert sn.capacity().get("cpu") == pytest.approx(4.0), (
+        "machine fills unreported resources pre-init"
+    )
+
+    # kubelet reports; reported values override the machine's
+    node.status.capacity = {"cpu": 3.5, "memory": 3500 * 2**20}
+    op.kube_client.update(node)
+    op.sync_state()
+    sn = op.cluster.node_for("m3-node")
+    assert sn.capacity().get("cpu") == pytest.approx(3.5), "reported value wins"
+
+
+def test_nomination_survives_machine_becoming_node(env):
+    """suite_test.go:439-459."""
+    op, cp, clock = env
+    machine = make_machine(provider_id="fake://m4", capacity={"cpu": "4"})
+    op.kube_client.create(machine)
+    op.sync_state()
+    op.cluster.nominate_node_for_pod(machine.metadata.name)
+    assert op.cluster.node_for(machine.metadata.name).nominated()
+
+    node = make_node(name="m4-node", provider_id="fake://m4",
+                     labels={PROVISIONER_NAME_LABEL_KEY: "default"})
+    op.kube_client.create(node)
+    op.sync_state()
+    assert op.cluster.node_for("m4-node").nominated(), (
+        "nomination must carry over when the inflight machine becomes a node"
+    )
+
+
+def test_marked_for_deletion_survives_machine_becoming_node(env):
+    """suite_test.go:460-482."""
+    op, cp, clock = env
+    machine = make_machine(provider_id="fake://m5", capacity={"cpu": "4"})
+    op.kube_client.create(machine)
+    op.sync_state()
+    op.cluster.mark_for_deletion(machine.metadata.name)
+
+    node = make_node(name="m5-node", provider_id="fake://m5",
+                     labels={PROVISIONER_NAME_LABEL_KEY: "default"})
+    op.kube_client.create(node)
+    op.sync_state()
+    assert op.cluster.node_for("m5-node").is_marked_for_deletion()
+
+
+# -- Node Resource Level (suite_test.go:483-1041) ---------------------------
+
+
+def _ready_node(op, name="rn", cpu="4"):
+    node = make_node(name=name,
+                     labels={PROVISIONER_NAME_LABEL_KEY: "default",
+                             LABEL_NODE_INITIALIZED: "true"},
+                     capacity={"cpu": cpu, "memory": "8Gi", "pods": "110"})
+    op.kube_client.create(node)
+    return node
+
+
+def test_unbound_pods_not_counted(env):
+    """suite_test.go:484-514."""
+    op, cp, clock = env
+    _ready_node(op)
+    op.kube_client.create(make_pod(requests={"cpu": "2"}))
+    op.sync_state()
+    assert op.cluster.node_for("rn").total_pod_requests().get("cpu", 0.0) == 0.0
+
+
+def test_bound_pods_counted(env):
+    """suite_test.go:515-584 (new + existing pods)."""
+    op, cp, clock = env
+    _ready_node(op)
+    pod = make_pod(requests={"cpu": "1.5"}, node_name="rn", unschedulable=False)
+    pod.status.phase = "Running"
+    op.kube_client.create(pod)
+    op.sync_state()
+    assert op.cluster.node_for("rn").total_pod_requests().get("cpu") == pytest.approx(1.5)
+
+
+def test_deleted_pod_requests_subtracted(env):
+    """suite_test.go:585-628."""
+    op, cp, clock = env
+    _ready_node(op)
+    pod = make_pod(requests={"cpu": "2"}, node_name="rn", unschedulable=False)
+    pod.status.phase = "Running"
+    op.kube_client.create(pod)
+    op.sync_state()
+    op.kube_client.delete("Pod", pod.metadata.namespace, pod.metadata.name)
+    op.sync_state()
+    assert op.cluster.node_for("rn").total_pod_requests().get("cpu", 0.0) == 0.0
+
+
+def test_terminal_pod_not_counted(env):
+    """suite_test.go:629-666 — Succeeded/Failed pods hold no resources."""
+    op, cp, clock = env
+    _ready_node(op)
+    for phase in ("Succeeded", "Failed"):
+        pod = make_pod(requests={"cpu": "1"}, node_name="rn", unschedulable=False)
+        pod.status.phase = phase
+        op.kube_client.create(pod)
+    op.sync_state()
+    assert op.cluster.node_for("rn").total_pod_requests().get("cpu", 0.0) == 0.0
+
+
+def test_deleted_node_untracked(env):
+    """suite_test.go:667-704."""
+    op, cp, clock = env
+    node = _ready_node(op)
+    op.sync_state()
+    assert op.cluster.node_for("rn") is not None
+    op.kube_client.delete("Node", "", node.metadata.name)
+    op.sync_state()
+    assert op.cluster.node_for("rn") is None
+
+
+def test_pod_rebind_tracked_across_missed_events(env):
+    """suite_test.go:705-776 — a pod that moves nodes (or whose events were
+    missed) counts on exactly its current node after a relist."""
+    op, cp, clock = env
+    _ready_node(op, name="rn1")
+    _ready_node(op, name="rn2")
+    pod = make_pod(requests={"cpu": "1"}, node_name="rn1", unschedulable=False)
+    pod.status.phase = "Running"
+    op.kube_client.create(pod)
+    op.sync_state()
+    assert op.cluster.node_for("rn1").total_pod_requests().get("cpu") == pytest.approx(1.0)
+    # pod "moves" (delete + recreate bound elsewhere), relist catches up
+    op.kube_client.delete("Pod", pod.metadata.namespace, pod.metadata.name)
+    moved = make_pod(requests={"cpu": "1"}, node_name="rn2", unschedulable=False)
+    moved.status.phase = "Running"
+    op.kube_client.create(moved)
+    op.sync_state()
+    assert op.cluster.node_for("rn1").total_pod_requests().get("cpu", 0.0) == 0.0
+    assert op.cluster.node_for("rn2").total_pod_requests().get("cpu") == pytest.approx(1.0)
+
+
+def test_resource_usage_across_add_delete_churn(env):
+    """suite_test.go:777-841."""
+    op, cp, clock = env
+    _ready_node(op, cpu="32")
+    pods = []
+    for i in range(10):
+        pod = make_pod(requests={"cpu": "1"}, node_name="rn", unschedulable=False)
+        pod.status.phase = "Running"
+        op.kube_client.create(pod)
+        pods.append(pod)
+    op.sync_state()
+    assert op.cluster.node_for("rn").total_pod_requests().get("cpu") == pytest.approx(10.0)
+    for pod in pods[:5]:
+        op.kube_client.delete("Pod", pod.metadata.namespace, pod.metadata.name)
+    op.sync_state()
+    assert op.cluster.node_for("rn").total_pod_requests().get("cpu") == pytest.approx(5.0)
+
+
+def test_daemonset_requests_tracked_separately(env):
+    """suite_test.go:842-916."""
+    op, cp, clock = env
+    _ready_node(op)
+    ds_pod = make_pod(requests={"cpu": "1"}, node_name="rn", unschedulable=False,
+                      owner_kind="DaemonSet")
+    ds_pod.status.phase = "Running"
+    plain = make_pod(requests={"cpu": "2"}, node_name="rn", unschedulable=False)
+    plain.status.phase = "Running"
+    op.kube_client.create(ds_pod)
+    op.kube_client.create(plain)
+    op.sync_state()
+    sn = op.cluster.node_for("rn")
+    assert sn.total_daemonset_requests().get("cpu") == pytest.approx(1.0)
+    assert sn.total_pod_requests().get("cpu") == pytest.approx(3.0)
+
+
+def test_node_deletion_timestamp_marks_for_deletion(env):
+    """suite_test.go:917-998 (node + machine variants)."""
+    op, cp, clock = env
+    node = _ready_node(op)
+    node.metadata.deletion_timestamp = clock()
+    op.kube_client.update(node)
+    op.sync_state()
+    assert op.cluster.node_for("rn").is_marked_for_deletion()
+
+    machine = make_machine(provider_id="fake://doomed", capacity={"cpu": "4"})
+    op.kube_client.create(machine)
+    op.sync_state()
+    machine.metadata.deletion_timestamp = clock()
+    op.kube_client.update(machine)
+    op.sync_state()
+    assert op.cluster.node_for(machine.metadata.name).is_marked_for_deletion()
+
+
+def test_nomination_expires(env):
+    """suite_test.go:999-1023."""
+    op, cp, clock = env
+    _ready_node(op)
+    op.sync_state()
+    op.cluster.nominate_node_for_pod("rn")
+    assert op.cluster.node_for("rn").nominated()
+    clock.advance(30)
+    assert not op.cluster.node_for("rn").nominated()
+
+
+def test_node_registering_provider_id_later(env):
+    """suite_test.go:1024-1041 — a node that starts without a provider id
+    stays tracked when it registers one."""
+    op, cp, clock = env
+    node = make_node(name="late", labels={PROVISIONER_NAME_LABEL_KEY: "default"},
+                     capacity={"cpu": "4"}, provider_id="placeholder")
+    node.spec.provider_id = ""
+    op.kube_client.create(node)
+    op.sync_state()
+    assert op.cluster.node_for("late") is not None
+    node.spec.provider_id = "real://late"
+    op.kube_client.update(node)
+    op.sync_state()
+    sn = op.cluster.node_for("late")
+    assert sn is not None and sn.provider_id() == "real://late"
+
+
+# -- Pod Anti-Affinity (suite_test.go:1042-1217) ----------------------------
+
+ANTI = PodAffinityTerm(
+    topology_key=LABEL_TOPOLOGY_ZONE,
+    label_selector=LabelSelector(match_labels={"app": "anti"}),
+)
+
+
+def _anti_pod(node_name, required=True):
+    kwargs = {"pod_anti_affinity_required": [ANTI]} if required else {
+        "pod_anti_affinity_preferred": [WeightedPodAffinityTerm(weight=1, pod_affinity_term=ANTI)]
+    }
+    pod = make_pod(requests={"cpu": "0.5"}, node_name=node_name,
+                   unschedulable=False, **kwargs)
+    pod.status.phase = "Running"
+    return pod
+
+
+def _visited(op):
+    seen = []
+    op.cluster.for_pods_with_anti_affinity(lambda p, n: (seen.append(p), True)[1])
+    return seen
+
+
+def test_required_anti_affinity_tracked(env):
+    """suite_test.go:1043-1081."""
+    op, cp, clock = env
+    _ready_node(op)
+    op.kube_client.create(_anti_pod("rn"))
+    op.sync_state()
+    assert len(_visited(op)) == 1
+
+
+def test_preferred_anti_affinity_not_tracked(env):
+    """suite_test.go:1082-1123."""
+    op, cp, clock = env
+    _ready_node(op)
+    op.kube_client.create(_anti_pod("rn", required=False))
+    op.sync_state()
+    assert not _visited(op)
+
+
+def test_anti_affinity_untracked_on_delete(env):
+    """suite_test.go:1124-1172."""
+    op, cp, clock = env
+    _ready_node(op)
+    pod = _anti_pod("rn")
+    op.kube_client.create(pod)
+    op.sync_state()
+    assert len(_visited(op)) == 1
+    op.kube_client.delete("Pod", pod.metadata.namespace, pod.metadata.name)
+    op.sync_state()
+    assert not _visited(op)
+
+
+def test_anti_affinity_events_out_of_order(env):
+    """suite_test.go:1173-1217 — pod events arriving before the node's are
+    reconciled once both exist."""
+    op, cp, clock = env
+    pod = _anti_pod("later-node")
+    op.kube_client.create(pod)
+    op.sync_state()  # node doesn't exist yet; visitor skips it
+    assert not _visited(op)
+    _ready_node(op, name="later-node")
+    op.sync_state()
+    assert len(_visited(op)) == 1
+
+
+# -- Provisioner Spec Updates (suite_test.go:1218-1228) ---------------------
+
+
+def test_provisioner_update_invalidates_consolidated(env):
+    """suite_test.go:1219-1228 — a provisioner watch event re-arms the
+    consolidation dirty bit (the ProvisionerInformer is the watch pump's
+    handler; driven directly here like the reference's reconcile call)."""
+    from karpenter_core_tpu.state.informer import ProvisionerInformer
+
+    op, cp, clock = env
+    op.sync_state()
+    op.cluster.set_consolidated(True)
+    prov = op.kube_client.get("Provisioner", "", "default")
+    prov.spec.weight = 50
+    op.kube_client.update(prov)
+    ProvisionerInformer(op.cluster).handle("MODIFIED", prov)
+    assert not op.cluster.consolidated()
+
+
+# -- Cluster State Sync (suite_test.go:1229-1382) ---------------------------
+
+
+def test_synced_when_all_nodes_tracked(env):
+    """suite_test.go:1230-1265 (nodes, no-provider-id, late registration)."""
+    op, cp, clock = env
+    for i in range(3):
+        _ready_node(op, name=f"sync-{i}")
+    assert not op.cluster.synced()  # informers haven't caught up
+    op.sync_state()
+    assert op.cluster.synced()
+
+
+def test_synced_with_machines_and_nodes(env):
+    """suite_test.go:1266-1330."""
+    op, cp, clock = env
+    _ready_node(op, name="paired")
+    machine = make_machine(provider_id="fake://paired", capacity={"cpu": "4"})
+    op.kube_client.create(machine)
+    lone = make_machine(provider_id="fake://lone", capacity={"cpu": "4"})
+    op.kube_client.create(lone)
+    op.sync_state()
+    assert op.cluster.synced()
+
+
+def test_not_synced_when_machine_untracked(env):
+    """suite_test.go:1331-1382 — an untracked machine (or node) means not
+    synced; machines without provider ids don't block."""
+    op, cp, clock = env
+    op.sync_state()
+    pending = make_machine(provider_id="")  # unresolved provider id
+    op.kube_client.create(pending)
+    assert op.cluster.synced(), "no-provider-id machines must not block sync"
+    resolved = make_machine(provider_id="fake://r1", capacity={"cpu": "4"})
+    op.kube_client.create(resolved)
+    assert not op.cluster.synced(), "untracked machine blocks sync"
+    op.sync_state()
+    assert op.cluster.synced()
