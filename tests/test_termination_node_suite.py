@@ -1,0 +1,281 @@
+"""Port of reference pkg/controllers/termination/suite_test.go and
+pkg/controllers/node/suite_test.go — the drain-policy and node-hygiene
+specs the condensed controller tests don't pin individually. Cited line
+numbers refer to the corresponding reference suite files.
+"""
+import pytest
+
+from karpenter_core_tpu.api import labels as api_labels
+from karpenter_core_tpu.api.settings import Settings
+from karpenter_core_tpu.cloudprovider import fake
+from karpenter_core_tpu.controllers.machine.terminator import NodeDrainError
+from karpenter_core_tpu.kube.objects import (
+    Condition,
+    OwnerReference,
+    PodDisruptionBudget,
+    PodDisruptionBudgetSpec,
+    LabelSelector,
+    TAINT_NODE_UNSCHEDULABLE,
+    Toleration,
+)
+from karpenter_core_tpu.operator import new_operator
+from karpenter_core_tpu.testing import (
+    FakeClock,
+    make_node,
+    make_pod,
+    make_provisioner,
+)
+
+
+@pytest.fixture
+def env():
+    clock = FakeClock()
+    cp = fake.FakeCloudProvider(fake.instance_types(5))
+    op = new_operator(cp, settings=Settings(), clock=clock)
+    op.kube_client.create(make_provisioner(name="default"))
+    return op, cp, clock
+
+
+def karpenter_node(op, name="tn"):
+    node = make_node(
+        name=name,
+        labels={
+            api_labels.PROVISIONER_NAME_LABEL_KEY: "default",
+            api_labels.LABEL_NODE_INITIALIZED: "true",
+        },
+        capacity={"cpu": "8", "memory": "16Gi", "pods": "50"},
+    )
+    node.metadata.finalizers.append(api_labels.TERMINATION_FINALIZER)
+    op.kube_client.create(node)
+    return node
+
+
+def running_pod(op, node_name, **kwargs):
+    pod = make_pod(requests={"cpu": "0.1"}, node_name=node_name,
+                   unschedulable=False, **kwargs)
+    pod.status.phase = "Running"
+    op.kube_client.create(pod)
+    return pod
+
+
+def start_deletion(op, node):
+    node.metadata.deletion_timestamp = op.clock()
+    op.kube_client.update(node)
+    return op.termination_controller.reconcile(node)
+
+
+# -- termination/suite_test.go ----------------------------------------------
+
+
+def test_deletes_empty_node(env):
+    """termination suite_test.go:90-96."""
+    op, cp, clock = env
+    node = karpenter_node(op)
+    start_deletion(op, node)
+    assert op.kube_client.get("Node", "", "tn") is None
+
+
+def test_terminal_pods_do_not_block_deletion(env):
+    """termination suite_test.go:379-395."""
+    op, cp, clock = env
+    node = karpenter_node(op)
+    for phase in ("Succeeded", "Failed"):
+        pod = running_pod(op, "tn", owner_kind="ReplicaSet")
+        pod.status.phase = phase
+        op.kube_client.update(pod)
+    start_deletion(op, node)
+    assert op.kube_client.get("Node", "", "tn") is None
+
+
+def test_ownerless_pods_are_evicted(env):
+    """termination suite_test.go:306-334."""
+    op, cp, clock = env
+    node = karpenter_node(op)
+    running_pod(op, "tn")  # no ownerRef
+    start_deletion(op, node)
+    op.eviction_queue.drain()
+    start_deletion(op, node)
+    assert op.kube_client.get("Node", "", "tn") is None
+
+
+def test_do_not_evict_blocks_even_with_unschedulable_toleration(env):
+    """termination suite_test.go:212-255."""
+    op, cp, clock = env
+    node = karpenter_node(op)
+    running_pod(
+        op, "tn",
+        annotations={api_labels.DO_NOT_EVICT_POD_ANNOTATION_KEY: "true"},
+        tolerations=[Toleration(key=TAINT_NODE_UNSCHEDULABLE, operator="Exists")],
+        owner_kind="ReplicaSet",
+    )
+    start_deletion(op, node)
+    assert op.kube_client.get("Node", "", "tn") is not None
+
+
+def test_static_pods_not_evicted(env):
+    """termination suite_test.go:504-547 — node-owned (static) pods are
+    skipped by the drain, and don't block deletion."""
+    op, cp, clock = env
+    node = karpenter_node(op)
+    static = running_pod(op, "tn")
+    static.metadata.owner_references = [OwnerReference(kind="Node", name="tn")]
+    op.kube_client.update(static)
+    start_deletion(op, node)
+    assert op.kube_client.get("Node", "", "tn") is None
+    # the static pod was never even ENQUEUED for eviction
+    assert not op.eviction_queue._set
+    assert op.kube_client.get("Pod", static.metadata.namespace,
+                              static.metadata.name) is not None
+
+
+def test_pdb_blocked_eviction_keeps_node(env):
+    """termination suite_test.go:431-471 — a zero-budget PDB stalls the
+    drain; the node survives until the PDB frees up."""
+    op, cp, clock = env
+    pdb = PodDisruptionBudget(
+        spec=PodDisruptionBudgetSpec(
+            selector=LabelSelector(match_labels={"app": "pdb"}), max_unavailable=0
+        )
+    )
+    pdb.metadata.name = "pdb"
+    pdb.metadata.namespace = "default"
+    pdb.status.disruptions_allowed = 0
+    op.kube_client.create(pdb)
+    # the real PDB-matching logic is the checker (pdblimits.go:34-76)
+    from karpenter_core_tpu.controllers.deprovisioning.core import PDBLimits
+
+    op.eviction_queue.pdb_checker = (
+        lambda pod: PDBLimits(op.kube_client).can_evict_pods([pod])[1]
+    )
+    node = karpenter_node(op)
+    running_pod(op, "tn", labels={"app": "pdb"}, owner_kind="ReplicaSet")
+    start_deletion(op, node)
+    op.eviction_queue.drain()  # blocked: evict() returns False
+    start_deletion(op, node)
+    assert op.kube_client.get("Node", "", "tn") is not None
+
+
+def test_non_critical_pods_evicted_first(env):
+    """termination suite_test.go:472-503 — critical pods drain only after
+    the regular pods are gone."""
+    op, cp, clock = env
+    node = karpenter_node(op)
+    regular = running_pod(op, "tn", owner_kind="ReplicaSet")
+    critical = running_pod(op, "tn", owner_kind="ReplicaSet")
+    critical.spec.priority_class_name = "system-cluster-critical"
+    op.kube_client.update(critical)
+
+    with pytest.raises(NodeDrainError):
+        op.terminator.drain(op.kube_client.get("Node", "", "tn"))
+    op.eviction_queue.drain()
+    # the regular pod went first; the critical one is still running
+    assert op.kube_client.get("Pod", regular.metadata.namespace,
+                              regular.metadata.name) is None
+    assert op.kube_client.get("Pod", critical.metadata.namespace,
+                              critical.metadata.name) is not None
+    with pytest.raises(NodeDrainError):
+        op.terminator.drain(op.kube_client.get("Node", "", "tn"))
+    op.eviction_queue.drain()
+    assert op.kube_client.get("Pod", critical.metadata.namespace,
+                              critical.metadata.name) is None
+
+
+def test_node_not_deleted_until_pods_gone(env):
+    """termination suite_test.go:548-624."""
+    op, cp, clock = env
+    node = karpenter_node(op)
+    running_pod(op, "tn", owner_kind="ReplicaSet")
+    start_deletion(op, node)
+    assert op.kube_client.get("Node", "", "tn") is not None, (
+        "node must survive while pods await eviction"
+    )
+    op.eviction_queue.drain()
+    start_deletion(op, node)
+    assert op.kube_client.get("Node", "", "tn") is None
+
+
+# -- node/suite_test.go ------------------------------------------------------
+
+
+def node_reconcile(op, node):
+    return op.node_controller.reconcile(
+        op.kube_client.get("Node", "", node.metadata.name) or node
+    )
+
+
+def test_initializes_ready_machineless_node(env):
+    """node suite_test.go:139-168."""
+    op, cp, clock = env
+    node = make_node(name="init-me",
+                     labels={api_labels.PROVISIONER_NAME_LABEL_KEY: "default"},
+                     capacity={"cpu": "4"})
+    op.kube_client.create(node)
+    op.sync_state()
+    node_reconcile(op, node)
+    live = op.kube_client.get("Node", "", "init-me")
+    assert live.metadata.labels.get(api_labels.LABEL_NODE_INITIALIZED) == "true"
+
+
+def test_does_not_initialize_not_ready_node(env):
+    """node suite_test.go:154-168."""
+    op, cp, clock = env
+    node = make_node(name="not-ready",
+                     labels={api_labels.PROVISIONER_NAME_LABEL_KEY: "default"},
+                     capacity={"cpu": "4"}, ready=False)
+    op.kube_client.create(node)
+    op.sync_state()
+    node_reconcile(op, node)
+    live = op.kube_client.get("Node", "", "not-ready")
+    assert api_labels.LABEL_NODE_INITIALIZED not in live.metadata.labels
+
+
+def test_emptiness_annotation_added_and_removed(env):
+    """node suite_test.go:349-387 — the emptiness timestamp appears on empty
+    nodes and clears once a pod lands."""
+    op, cp, clock = env
+    op.kube_client.delete("Provisioner", "", "default")
+    op.kube_client.create(make_provisioner(name="default", ttl_seconds_after_empty=30))
+    node = make_node(name="maybe-empty",
+                     labels={api_labels.PROVISIONER_NAME_LABEL_KEY: "default",
+                             api_labels.LABEL_NODE_INITIALIZED: "true"},
+                     capacity={"cpu": "4", "pods": "10"})
+    op.kube_client.create(node)
+    op.sync_state()
+    node_reconcile(op, node)
+    live = op.kube_client.get("Node", "", "maybe-empty")
+    key = api_labels.EMPTINESS_TIMESTAMP_ANNOTATION_KEY
+    assert key in live.metadata.annotations
+
+    running_pod(op, "maybe-empty", owner_kind="ReplicaSet")
+    node_reconcile(op, live)
+    live = op.kube_client.get("Node", "", "maybe-empty")
+    assert key not in live.metadata.annotations
+
+
+def test_termination_finalizer_added_once(env):
+    """node suite_test.go:388-421."""
+    op, cp, clock = env
+    node = make_node(name="fin",
+                     labels={api_labels.PROVISIONER_NAME_LABEL_KEY: "default"},
+                     capacity={"cpu": "4"})
+    op.kube_client.create(node)
+    op.sync_state()
+    node_reconcile(op, node)
+    live = op.kube_client.get("Node", "", "fin")
+    assert live.metadata.finalizers.count(api_labels.TERMINATION_FINALIZER) == 1
+    node_reconcile(op, live)
+    live = op.kube_client.get("Node", "", "fin")
+    assert live.metadata.finalizers.count(api_labels.TERMINATION_FINALIZER) == 1
+
+
+def test_unowned_node_untouched(env):
+    """node suite_test.go:455-466 — nodes without the provisioner label are
+    not karpenter's to manage."""
+    op, cp, clock = env
+    node = make_node(name="foreign", capacity={"cpu": "4"})
+    op.kube_client.create(node)
+    op.sync_state()
+    node_reconcile(op, node)
+    live = op.kube_client.get("Node", "", "foreign")
+    assert api_labels.TERMINATION_FINALIZER not in live.metadata.finalizers
+    assert api_labels.LABEL_NODE_INITIALIZED not in live.metadata.labels
