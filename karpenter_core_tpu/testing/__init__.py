@@ -200,6 +200,7 @@ def make_provisioner(
     name: Optional[str] = None,
     requirements: Optional[List[NodeSelectorRequirement]] = None,
     labels: Optional[Dict[str, str]] = None,
+    annotations: Optional[Dict[str, str]] = None,
     taints: Optional[List[Taint]] = None,
     startup_taints: Optional[List[Taint]] = None,
     limits: Optional[Dict[str, object]] = None,
@@ -211,6 +212,7 @@ def make_provisioner(
     spec = ProvisionerSpec(
         requirements=list(requirements or []),
         labels=dict(labels or {}),
+        annotations=dict(annotations or {}),
         taints=list(taints or []),
         startup_taints=list(startup_taints or []),
         weight=weight,
@@ -276,23 +278,25 @@ def make_daemonset(
     namespace: str = "default",
     requests: Optional[Dict[str, object]] = None,
     node_selector: Optional[Dict[str, str]] = None,
+    tolerations: Optional[List[Toleration]] = None,
+    node_affinity_required: Optional[List[NodeSelectorTerm]] = None,
 ) -> "DaemonSet":
     """test.DaemonSet analog: carries the pod template the scheduler uses for
     per-template daemon overhead (reference pkg/test/daemonsets.go)."""
     from karpenter_core_tpu.kube.objects import DaemonSet
 
+    # the template IS a pod spec: compose through make_pod (the reference's
+    # test.DaemonSet(PodOptions) shape) so the two builders cannot drift
+    template = make_pod(
+        requests=requests,
+        node_selector=node_selector,
+        tolerations=tolerations,
+        node_affinity_required=node_affinity_required,
+        unschedulable=False,
+    ).spec
     return DaemonSet(
         metadata=ObjectMeta(name=name or unique_name("ds"), namespace=namespace),
-        pod_template_spec=PodSpec(
-            node_selector=dict(node_selector or {}),
-            containers=[
-                Container(
-                    resources=ResourceRequirements(
-                        requests=parse_resource_list(requests or {})
-                    )
-                )
-            ],
-        ),
+        pod_template_spec=template,
     )
 
 

@@ -1,0 +1,248 @@
+"""Port of reference pkg/controllers/provisioning/suite_test.go — the
+daemonset-overhead filtering, node annotation/label propagation, machine
+request content, and storage-zone specs the condensed tests don't pin.
+Cited line numbers refer to
+/root/reference/pkg/controllers/provisioning/suite_test.go.
+"""
+import pytest
+
+from karpenter_core_tpu.api import labels as api_labels
+from karpenter_core_tpu.cloudprovider import fake
+from karpenter_core_tpu.kube.objects import (
+    LABEL_INSTANCE_TYPE_STABLE,
+    LABEL_TOPOLOGY_ZONE,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    Taint,
+    Toleration,
+)
+from karpenter_core_tpu.testing import (
+    make_daemonset,
+    make_pod,
+    make_provisioner,
+    make_pv,
+    make_pvc,
+    make_storage_class,
+    pvc_volume,
+)
+from karpenter_core_tpu.testing.expectations import Env
+
+LADDER = fake.instance_types(10)  # fake-it-i: (i+1) cpu
+
+
+@pytest.fixture()
+def env():
+    return Env(universe=LADDER)
+
+
+def req(key, op, *values):
+    return NodeSelectorRequirement(key=key, operator=op, values=list(values))
+
+
+def chosen_cpu(env, pod):
+    node = env.expect_scheduled(pod)
+    name = node.metadata.labels[LABEL_INSTANCE_TYPE_STABLE]
+    return next(it.capacity["cpu"] for it in env.universe if it.name == name)
+
+
+def test_ignores_deleting_provisioners(env):
+    """suite_test.go:111-121."""
+    prov = make_provisioner(name="default")
+    env.expect_applied(prov)
+    prov.metadata.deletion_timestamp = env.clock()
+    env.kube.update(prov)
+    pod = make_pod(requests={"cpu": "1"})
+    env.expect_provisioned(pod)
+    env.expect_not_scheduled(pod)
+    assert not env.cloud_provider.create_calls
+
+
+def test_daemonset_overhead_counted(env):
+    """suite_test.go:370-387 — a matching daemonset's requests inflate the
+    chosen node size."""
+    env.expect_applied(make_provisioner(name="default"),
+                       make_daemonset(requests={"cpu": "1"}))
+    pod = make_pod(requests={"cpu": "1"})
+    env.expect_provisioned(pod)
+    # pod(1) + daemon(1) + 0.1 overhead -> exactly the 3-cpu rung
+    assert chosen_cpu(env, pod) == 3
+
+
+def test_daemonset_without_matching_toleration_ignored(env):
+    """suite_test.go:493-512 — daemonsets that can't tolerate the
+    provisioner's taints add no overhead."""
+    env.expect_applied(
+        make_provisioner(name="default",
+                         taints=[Taint(key="foo", value="bar", effect="NoSchedule")]),
+        make_daemonset(requests={"cpu": "1"}),
+    )
+    pod = make_pod(requests={"cpu": "1"},
+                   tolerations=[Toleration(operator="Exists")])
+    env.expect_provisioned(pod)
+    assert chosen_cpu(env, pod) == 2, "no daemon overhead counted"
+
+
+def test_daemonset_with_incompatible_selector_ignored(env):
+    """suite_test.go:513-530."""
+    env.expect_applied(
+        make_provisioner(name="default"),
+        make_daemonset(requests={"cpu": "1"},
+                       node_selector={"node": "invalid"}),
+    )
+    pod = make_pod(requests={"cpu": "1"})
+    env.expect_provisioned(pod)
+    assert chosen_cpu(env, pod) == 2
+
+
+def test_daemonset_with_notin_unspecified_key_counted(env):
+    """suite_test.go:531-551 — NotIn over an unspecified key matches, so the
+    daemonset counts."""
+    env.expect_applied(
+        make_provisioner(name="default"),
+        make_daemonset(
+            requests={"cpu": "1"},
+            node_affinity_required=[
+                NodeSelectorTerm(match_expressions=[req("foo", "NotIn", "bar")])
+            ],
+        ),
+    )
+    pod = make_pod(
+        requests={"cpu": "1"},
+        node_affinity_required=[
+            NodeSelectorTerm(
+                match_expressions=[req(LABEL_TOPOLOGY_ZONE, "In", "test-zone-2")]
+            )
+        ],
+    )
+    env.expect_provisioned(pod)
+    assert chosen_cpu(env, pod) == 3
+
+
+def test_daemonset_with_matching_toleration_counted(env):
+    """suite_test.go:493-512 inverse — a daemonset that DOES tolerate the
+    provisioner's taints adds its overhead."""
+    env.expect_applied(
+        make_provisioner(name="default",
+                         taints=[Taint(key="foo", value="bar", effect="NoSchedule")]),
+        make_daemonset(requests={"cpu": "1"},
+                       tolerations=[Toleration(operator="Exists")]),
+    )
+    pod = make_pod(requests={"cpu": "1"},
+                   tolerations=[Toleration(operator="Exists")])
+    env.expect_provisioned(pod)
+    assert chosen_cpu(env, pod) == 3
+
+
+def test_provisioner_annotations_propagate_to_nodes(env):
+    """suite_test.go:552-563."""
+    env.expect_applied(
+        make_provisioner(
+            name="default",
+            annotations={api_labels.DO_NOT_CONSOLIDATE_NODE_ANNOTATION_KEY: "true"},
+        )
+    )
+    pod = make_pod(requests={"cpu": "1"})
+    env.expect_provisioned(pod)
+    node = env.expect_scheduled(pod)
+    assert node.metadata.annotations.get(
+        api_labels.DO_NOT_CONSOLIDATE_NODE_ANNOTATION_KEY
+    ) == "true"
+
+
+def test_provisioner_requirement_labels_propagate(env):
+    """suite_test.go:564-605 — In/Gt/Lt requirements become node labels;
+    NotIn/Exists/DoesNotExist do not pin values."""
+    env.expect_applied(
+        make_provisioner(
+            name="default",
+            labels={"test-key-1": "test-value-1"},
+            requirements=[
+                req("test-key-2", "In", "test-value-2"),
+                req("test-key-3", "NotIn", "test-value-3"),
+                req("test-key-4", "Lt", "4"),
+                req("test-key-5", "Gt", "5"),
+                req("test-key-6", "Exists"),
+                req("test-key-7", "DoesNotExist"),
+            ],
+        )
+    )
+    pod = make_pod(requests={"cpu": "1"})
+    env.expect_provisioned(pod)
+    node = env.expect_scheduled(pod)
+    labels = node.metadata.labels
+    assert labels.get("test-key-1") == "test-value-1"
+    assert labels.get("test-key-2") == "test-value-2"
+    assert labels.get("test-key-3") != "test-value-3"
+    assert int(labels["test-key-4"]) < 4
+    assert int(labels["test-key-5"]) > 5
+    assert "test-key-6" in labels
+    assert "test-key-7" not in labels
+
+
+def test_machine_request_carries_requirements_and_provider(env):
+    """suite_test.go:648-712 + 819-859 — the Create call's machine spec
+    carries the merged requirements and the compatibility provider
+    annotation."""
+    env.expect_applied(
+        make_provisioner(
+            name="default",
+            requirements=[req(LABEL_TOPOLOGY_ZONE, "In", "test-zone-2")],
+        )
+    )
+    pod = make_pod(requests={"cpu": "1"})
+    env.expect_provisioned(pod)
+    env.expect_scheduled(pod)
+    call = env.cloud_provider.create_calls[0]
+    reqs = {r.key: r for r in call.spec.requirements}
+    assert reqs[LABEL_TOPOLOGY_ZONE].values == ["test-zone-2"]
+    assert api_labels.PROVISIONER_NAME_LABEL_KEY in reqs
+    assert api_labels.PROVIDER_COMPATIBILITY_ANNOTATION_KEY in call.metadata.annotations
+
+
+def test_machine_request_includes_daemon_overhead_requests(env):
+    """suite_test.go:860-918 — machine resource requests include matching
+    daemonset requests."""
+    env.expect_applied(make_provisioner(name="default"),
+                       make_daemonset(requests={"cpu": "1", "memory": "1Gi"}))
+    pod = make_pod(requests={"cpu": "1", "memory": "1Gi"})
+    env.expect_provisioned(pod)
+    env.expect_scheduled(pod)
+    call = env.cloud_provider.create_calls[0]
+    assert call.spec.resources.requests.get("cpu", 0.0) >= 2.0
+    assert call.spec.resources.requests.get("memory", 0.0) >= 2 * 2**30
+
+
+def test_schedules_to_storage_class_zones(env):
+    """suite_test.go:974-998 — an unbound PVC pins the pod to the storage
+    class's allowed zones; incompatible pod zones fail."""
+    env.expect_applied(
+        make_provisioner(name="default"),
+        make_storage_class("zonal-sc", "fake.csi", zones=["test-zone-3"]),
+        make_pvc("zonal-claim", storage_class="zonal-sc"),
+    )
+    pod = make_pod(requests={"cpu": "1"})
+    pod.spec.volumes.append(pvc_volume("zonal-claim"))
+    env.expect_provisioned(pod)
+    node = env.expect_scheduled(pod)
+    assert node.metadata.labels[LABEL_TOPOLOGY_ZONE] == "test-zone-3"
+
+    incompatible = make_pod(
+        requests={"cpu": "1"}, node_selector={LABEL_TOPOLOGY_ZONE: "test-zone-1"}
+    )
+    incompatible.spec.volumes.append(pvc_volume("zonal-claim"))
+    env.expect_provisioned(incompatible)
+    env.expect_not_scheduled(incompatible)
+
+
+def test_schedules_to_bound_volume_zones(env):
+    """suite_test.go:999-1010."""
+    env.expect_applied(
+        make_provisioner(name="default"),
+        make_pv("bound-pv", zones=["test-zone-2"]),
+        make_pvc("bound-claim", volume_name="bound-pv"),
+    )
+    pod = make_pod(requests={"cpu": "1"})
+    pod.spec.volumes.append(pvc_volume("bound-claim"))
+    env.expect_provisioned(pod)
+    node = env.expect_scheduled(pod)
+    assert node.metadata.labels[LABEL_TOPOLOGY_ZONE] == "test-zone-2"
