@@ -305,3 +305,68 @@ def test_cluster_nomination_window(env):
     # window is 2x batch max duration, >= 10s (node.go:328-334)
     clock.advance(21)
     assert not op.cluster.node_for("nominee").nominated()
+
+
+def test_inflight_startup_taint_never_removed(env):
+    """inflightchecks failedinit.go:30-82 — a stuck startup taint is named
+    in the failed-init report."""
+    from karpenter_core_tpu.kube.objects import Taint
+    from karpenter_core_tpu.testing import make_machine
+
+    op, cp, clock = env
+    machine = make_machine(provider_id="fake://stuck-taint", capacity={"cpu": "4"})
+    machine.spec.startup_taints = [Taint(key="never.leaves/taint", effect="NoSchedule")]
+    op.kube_client.create(machine)
+    node = make_node(
+        name="stuck-taint",
+        labels={PROVISIONER_NAME_LABEL_KEY: "default"},
+        capacity={"cpu": "4"},
+        provider_id="fake://stuck-taint",
+        taints=[Taint(key="never.leaves/taint", effect="NoSchedule")],
+    )
+    node.metadata.creation_timestamp = clock() - 2 * 3600
+    op.kube_client.create(node)
+    op.sync_state()
+    op.inflight_checks.reconcile(node)
+    events = op.recorder.for_object("Node", "stuck-taint")
+    assert any("startup taints remain" in e.message for e in events)
+
+
+def test_inflight_stuck_termination_names_pdb(env):
+    """inflightchecks termination.go:26-55 — a node stuck deleting reports
+    the PDB blocking its pods."""
+    from karpenter_core_tpu.kube.objects import (
+        LabelSelector,
+        PodDisruptionBudget,
+        PodDisruptionBudgetSpec,
+    )
+
+    op, cp, clock = env
+    pdb = PodDisruptionBudget(
+        spec=PodDisruptionBudgetSpec(
+            selector=LabelSelector(match_labels={"app": "guarded"}),
+            max_unavailable=0,
+        )
+    )
+    pdb.metadata.name = "guard"
+    pdb.metadata.namespace = "default"
+    op.kube_client.create(pdb)
+    node = make_node(
+        name="stuck-del",
+        labels={PROVISIONER_NAME_LABEL_KEY: "default",
+                LABEL_NODE_INITIALIZED: "true"},
+        capacity={"cpu": "4", "pods": "10"},
+    )
+    node.metadata.deletion_timestamp = clock() - 600
+    op.kube_client.create(node)
+    pod = make_pod(requests={"cpu": "0.1"}, node_name="stuck-del",
+                   unschedulable=False, labels={"app": "guarded"},
+                   owner_kind="ReplicaSet")
+    pod.status.phase = "Running"
+    op.kube_client.create(pod)
+    op.sync_state()
+    op.inflight_checks.reconcile(node)
+    events = op.recorder.for_object("Node", "stuck-del")
+    assert any("guard" in e.message for e in events), (
+        f"expected the blocking PDB to be named: {[e.message for e in events]}"
+    )

@@ -204,3 +204,59 @@ def test_any_respects_large_bounds():
     # adjacent bounds collapse to the only remaining value
     rr = Requirement("k", OP_GT, ["5"]).intersection(Requirement("k", OP_LT, ["7"]))
     assert rr.any() == "6"
+
+
+# -- exhaustive pairwise intersection property (requirement_test.go:82-293) --
+
+
+def _req_universe():
+    """Every operator shape the reference's 210-row intersection table
+    exercises, over a small shared value vocabulary."""
+    from karpenter_core_tpu.scheduling.requirement import (
+        OP_DOES_NOT_EXIST,
+        OP_EXISTS,
+        OP_GT,
+        OP_IN,
+        OP_LT,
+        OP_NOT_IN,
+        Requirement,
+    )
+
+    K = "key"
+    return [
+        Requirement(K, OP_IN, ["A"]),
+        Requirement(K, OP_IN, ["B"]),
+        Requirement(K, OP_IN, ["A", "B"]),
+        Requirement(K, OP_IN, ["1"]),
+        Requirement(K, OP_IN, ["1", "9"]),
+        Requirement(K, OP_NOT_IN, ["A"]),
+        Requirement(K, OP_NOT_IN, ["A", "B"]),
+        Requirement(K, OP_NOT_IN, ["1"]),
+        Requirement(K, OP_EXISTS),
+        Requirement(K, OP_DOES_NOT_EXIST),
+        Requirement(K, OP_GT, ["3"]),
+        Requirement(K, OP_LT, ["7"]),
+        Requirement(K, OP_GT, ["8"]),
+        Requirement(K, OP_LT, ["2"]),
+    ]
+
+
+def test_pairwise_intersection_matches_membership_oracle():
+    """For every requirement pair and every probe value:
+    (r1 ∩ r2).has(v) == r1.has(v) AND r2.has(v) — the semantic content of
+    the reference's full pairwise table, checked as a property instead of
+    210 hand-written rows."""
+    probes = ["A", "B", "C", "0", "1", "2", "3", "4", "5", "6", "7", "8", "9"]
+    universe = _req_universe()
+    checked = 0
+    for r1 in universe:
+        for r2 in universe:
+            merged = r1.intersection(r2)
+            for v in probes:
+                want = r1.has(v) and r2.has(v)
+                got = merged.has(v)
+                assert got == want, (
+                    f"({r1!r} ∩ {r2!r}).has({v!r}) = {got}, want {want}"
+                )
+                checked += 1
+    assert checked == len(universe) ** 2 * len(probes)
