@@ -7,28 +7,43 @@ object threaded explicitly (or via `current()` for defaults).
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict
+from typing import Dict, Optional
 
 
 @dataclass
 class Settings:
     batch_max_duration: float = 10.0  # seconds (settings.go:33)
     batch_idle_duration: float = 1.0  # seconds (settings.go:34)
-    ttl_after_not_registered: float = 15 * 60.0  # seconds (settings.go:35-37)
+    # None disables the unregistered-machine reaper (settings.go:35-37,86-91:
+    # an empty ConfigMap value nils the pointer)
+    ttl_after_not_registered: Optional[float] = 15 * 60.0
     drift_enabled: bool = False  # feature gate (settings.go:44)
 
     @classmethod
     def from_config_map(cls, data: Dict[str, str]) -> "Settings":
-        """Parse the settings ConfigMap data (settings.go:53-68)."""
+        """Parse the settings ConfigMap data (settings.go:53-68). Raises
+        ValueError on malformed durations/booleans and on values that fail
+        Validate() (settings.go:69-85) — batch windows are required-positive,
+        the registration TTL may be empty (disabled) but not negative."""
         s = cls()
         if "batchMaxDuration" in data:
             s.batch_max_duration = _parse_duration(data["batchMaxDuration"])
         if "batchIdleDuration" in data:
             s.batch_idle_duration = _parse_duration(data["batchIdleDuration"])
         if "ttlAfterNotRegistered" in data:
-            s.ttl_after_not_registered = _parse_duration(data["ttlAfterNotRegistered"])
+            raw = data["ttlAfterNotRegistered"]
+            s.ttl_after_not_registered = None if raw == "" else _parse_duration(raw)
         if "featureGates.driftEnabled" in data:
-            s.drift_enabled = data["featureGates.driftEnabled"].lower() == "true"
+            raw = data["featureGates.driftEnabled"].lower()
+            if raw not in ("true", "false"):
+                raise ValueError(f"featureGates.driftEnabled: not a boolean: {raw!r}")
+            s.drift_enabled = raw == "true"
+        if s.batch_max_duration <= 0:
+            raise ValueError("batchMaxDuration cannot be negative")
+        if s.batch_idle_duration <= 0:
+            raise ValueError("batchIdleDuration cannot be negative")
+        if s.ttl_after_not_registered is not None and s.ttl_after_not_registered <= 0:
+            raise ValueError("ttlAfterNotRegistered cannot be negative")
         return s
 
 

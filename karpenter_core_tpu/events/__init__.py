@@ -10,11 +10,12 @@ instead of the kube events API.
 """
 from __future__ import annotations
 
+import dataclasses
 import threading
 import time
 from collections import deque
 from dataclasses import dataclass
-from typing import Deque, Dict, List
+from typing import Deque, Dict, List, Optional
 
 
 @dataclass(frozen=True)
@@ -26,6 +27,13 @@ class Event:
     message: str
     dedupe_values: tuple = ()
     timestamp: float = 0.0
+    # per-event override of the recorder's 2-minute window (events.go
+    # Event.DedupeTimeout); None uses Recorder.DEDUPE_TTL
+    dedupe_timeout: Optional[float] = None
+    # (qps, burst) token bucket, shared per (kind, reason) across events —
+    # the analog of events.go Event.RateLimiter: only events that carry a
+    # limiter are rate-limited (recorder.go:75)
+    rate_limit: Optional[tuple] = None
 
     def dedupe_key(self) -> tuple:
         return (
@@ -38,13 +46,14 @@ class Event:
 
 
 class Recorder:
-    """recorder.go: 2-minute dedupe window per full event key + a
-    cluster-wide token-bucket per event TYPE (kind, reason) — the flow
-    control that bounds e.g. total FailedScheduling volume."""
+    """recorder.go: 2-minute dedupe window per full event key, plus opt-in
+    token-bucket rate limiting for events that carry one (recorder.go:75 —
+    in the reference only pod nomination does, events.go:24-35)."""
 
     DEDUPE_TTL = 120.0  # defaultDedupeTimeout (recorder.go)
-    RATE_LIMIT_QPS = 1.0
-    RATE_LIMIT_BURST = 10
+    # PodNominationRateLimiter (events.go:25) — shared across all nomination
+    # events so the limit is cluster-wide, like the reference's pointer
+    POD_NOMINATION_RATE_LIMIT = (5.0, 10)
 
     def __init__(self, clock=time.time, capacity: int = 4096):
         self.clock = clock
@@ -57,38 +66,28 @@ class Recorder:
     def publish(self, event: Event) -> bool:
         now = self.clock()
         key = event.dedupe_key()
+        ttl = self.DEDUPE_TTL if event.dedupe_timeout is None else event.dedupe_timeout
         with self._mu:
-            # periodic purge so the dedupe cache stays bounded (the reference
-            # uses an expiring cache with a 10s purge interval)
+            # periodic purge so the dedupe cache stays bounded; entries carry
+            # their own expiry so a long per-event dedupe_timeout survives
+            # the sweep (the reference's expiring cache is per-entry too)
             if now - self._last_purge > self.DEDUPE_TTL:
-                self._seen = {
-                    k: t for k, t in self._seen.items() if now - t < self.DEDUPE_TTL
-                }
+                self._seen = {k: exp for k, exp in self._seen.items() if now < exp}
                 self._last_purge = now
-            last = self._seen.get(key)
-            if last is not None and now - last < self.DEDUPE_TTL:
+            expiry = self._seen.get(key)
+            if expiry is not None and now < expiry:
                 return False
-            self._seen[key] = now
-            type_key = (event.involved_kind, event.reason)
-            tokens, last_t = self._tokens.get(type_key, [float(self.RATE_LIMIT_BURST), now])
-            tokens = min(
-                float(self.RATE_LIMIT_BURST), tokens + (now - last_t) * self.RATE_LIMIT_QPS
-            )
-            if tokens < 1.0:
-                self._tokens[type_key] = [tokens, now]
-                return False
-            self._tokens[type_key] = [tokens - 1.0, now]
-            self.events.append(
-                Event(
-                    involved_kind=event.involved_kind,
-                    involved_name=event.involved_name,
-                    type=event.type,
-                    reason=event.reason,
-                    message=event.message,
-                    dedupe_values=event.dedupe_values,
-                    timestamp=now,
-                )
-            )
+            self._seen[key] = now + ttl
+            if event.rate_limit is not None:
+                qps, burst = event.rate_limit
+                type_key = (event.involved_kind, event.reason)
+                tokens, last_t = self._tokens.get(type_key, [float(burst), now])
+                tokens = min(float(burst), tokens + (now - last_t) * qps)
+                if tokens < 1.0:
+                    self._tokens[type_key] = [tokens, now]
+                    return False
+                self._tokens[type_key] = [tokens - 1.0, now]
+            self.events.append(dataclasses.replace(event, timestamp=now))
             return True
 
     def for_object(self, kind: str, name: str) -> List[Event]:
@@ -105,6 +104,7 @@ class Recorder:
                 "Normal",
                 "Nominated",
                 f"Pod should schedule on {node_name}",
+                rate_limit=self.POD_NOMINATION_RATE_LIMIT,
             )
         )
 

@@ -48,15 +48,22 @@ def test_recorder_dedupe_uses_values_over_message():
 
 
 def test_recorder_rate_limits_per_event_type():
+    """Opt-in limiter (recorder.go:75): events carrying a rate_limit share a
+    (kind, reason) token bucket; events without one are never limited."""
+    import dataclasses
+
     clock = FakeClock()
     r = Recorder(clock=clock)
+    limited = lambda e: dataclasses.replace(e, rate_limit=(1.0, 10))  # noqa: E731
     sent = sum(
-        1 for i in range(50) if r.publish(ev(name=f"node-{i}", reason="Flood"))
+        1
+        for i in range(50)
+        if r.publish(limited(ev(name=f"node-{i}", reason="Flood")))
     )
-    assert sent == Recorder.RATE_LIMIT_BURST
+    assert sent == 10
     # tokens refill over time
     clock.advance(5)
-    assert r.publish(ev(name="late", reason="Flood"))
+    assert r.publish(limited(ev(name="late", reason="Flood")))
 
 
 def test_recorder_for_object_filters():

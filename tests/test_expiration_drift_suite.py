@@ -1,0 +1,275 @@
+"""Spec-for-spec port of the reference expiration and drift suites.
+
+Cited line numbers refer to
+/root/reference/pkg/controllers/deprovisioning/expiration_test.go and
+/root/reference/pkg/controllers/deprovisioning/drift_test.go. Shares the
+env fixture and node builders with tests/test_deprovisioning.py; nodes
+carrying pods own them via ReplicaSet so eviction simulation treats them
+as reschedulable (the suites' ExpectApplied(rs) + ownered pods).
+"""
+import functools
+
+import pytest
+
+from karpenter_core_tpu.api import labels as api_labels
+from karpenter_core_tpu.api.labels import (
+    LABEL_CAPACITY_TYPE,
+    LABEL_NODE_INITIALIZED,
+    PROVISIONER_NAME_LABEL_KEY,
+)
+from karpenter_core_tpu.api.settings import Settings, set_current
+from karpenter_core_tpu.cloudprovider import fake
+from karpenter_core_tpu.cloudprovider.types import Offering
+from karpenter_core_tpu.kube.objects import (
+    LABEL_INSTANCE_TYPE_STABLE,
+    LABEL_TOPOLOGY_ZONE,
+)
+from karpenter_core_tpu.testing import make_node, make_pod, make_provisioner
+
+# shared env/builders with the condensed suite (same fixture semantics)
+from test_deprovisioning import add_node as _add_node
+from test_deprovisioning import env, provisioner  # noqa: F401
+
+add_node = functools.partial(_add_node, pod_owner_kind="ReplicaSet")
+
+DRIFTED = {
+    api_labels.VOLUNTARY_DISRUPTION_ANNOTATION_KEY: "drifted"
+}
+
+
+@pytest.fixture
+def drift_on():
+    set_current(Settings(drift_enabled=True))
+    yield
+    set_current(Settings())
+
+
+def _custom_replacement_universe(cp):
+    """The current/replacement pair the replace-with-multiple-nodes specs
+    build (expiration_test.go:198-225, drift_test.go:222-249): the node's
+    own type has no available offering, the only buyable type holds one
+    2-cpu pod."""
+    current = fake.new_instance_type(
+        "current-on-demand",
+        offerings=[Offering("on-demand", "test-zone-1a", 0.5, available=False)],
+    )
+    replacement = fake.new_instance_type(
+        "replacement-on-demand",
+        resources={"cpu": 3.0},
+        offerings=[Offering("on-demand", "test-zone-1a", 0.3)],
+    )
+    cp.instance_types = [current, replacement]
+    return current, replacement
+
+
+# -- Expiration (expiration_test.go) ----------------------------------------
+
+
+def test_ignores_nodes_without_expiry_ttl(env):
+    """expiration_test.go:37-65 — no TTLSecondsUntilExpired on the
+    provisioner: no create calls, node survives any amount of clock."""
+    op, cp, clock = env
+    provisioner(op)
+    add_node(op, clock, "ageless", pods=0)
+    op.sync_state()
+    clock.advance(600)
+    assert not op.deprovisioning.reconcile()
+    assert not cp.create_calls
+    assert op.kube_client.get("Node", "", "ageless") is not None
+
+
+def test_can_delete_expired_nodes(env):
+    """expiration_test.go:66-98 — TTL 60, clock steps 10 minutes: the empty
+    node is deleted without a replacement launch."""
+    op, cp, clock = env
+    provisioner(op, ttl_seconds_until_expired=60)
+    add_node(op, clock, "expired", pods=0)
+    op.sync_state()
+    clock.advance(600)
+    assert op.deprovisioning.reconcile()
+    op.step()
+    assert not cp.create_calls
+    assert op.kube_client.get("Node", "", "expired") is None
+
+
+def test_expires_one_node_at_a_time_most_expired_first(env):
+    """expiration_test.go:99-142 — two provisioners (TTL 100 vs 500), both
+    past expiry: one reconcile loop removes only the most-expired node."""
+    op, cp, clock = env
+    provisioner(op, ttl_seconds_until_expired=100)
+    op.kube_client.create(
+        make_provisioner(name="slow-expiry", ttl_seconds_until_expired=500)
+    )
+    add_node(op, clock, "to-expire", pods=0)
+    later = make_node(
+        name="not-to-expire",
+        labels={
+            PROVISIONER_NAME_LABEL_KEY: "slow-expiry",
+            LABEL_NODE_INITIALIZED: "true",
+            LABEL_INSTANCE_TYPE_STABLE: "fake-it-9",
+            LABEL_CAPACITY_TYPE: "on-demand",
+            LABEL_TOPOLOGY_ZONE: "test-zone-1",
+        },
+        capacity={"cpu": "10", "memory": "20Gi", "pods": "100"},
+    )
+    later.metadata.creation_timestamp = clock()
+    op.kube_client.create(later)
+    op.sync_state()
+    clock.advance(600)
+    assert op.deprovisioning.reconcile()
+    op.step()
+    assert not cp.create_calls
+    assert op.kube_client.get("Node", "", "to-expire") is None
+    assert op.kube_client.get("Node", "", "not-to-expire") is not None
+
+
+def test_can_replace_node_for_expiration(env):
+    """expiration_test.go:143-196 — an expired node with a live replicaset
+    pod is replaced: one launch, then the old node goes away."""
+    op, cp, clock = env
+    provisioner(op, ttl_seconds_until_expired=30)
+    add_node(op, clock, "replaced", pods=1)
+    op.sync_state()
+    clock.advance(600)
+    assert op.deprovisioning.reconcile()
+    op.step()
+    assert len(cp.create_calls) == 1
+    assert op.kube_client.get("Node", "", "replaced") is None
+
+
+def test_uncordons_when_expiration_replacement_partially_fails(env):
+    """expiration_test.go:197-287 — three replacement launches needed, the
+    cloud provider allows two: the command aborts and the cordon is rolled
+    back (node schedulable again)."""
+    op, cp, clock = env
+    current, _ = _custom_replacement_universe(cp)
+    cp.allowed_create_calls = 2
+    provisioner(op, ttl_seconds_until_expired=30)
+    add_node(op, clock, "kept", it_name=current.name, cpu="7",
+             zone="test-zone-1a", pods=3, pod_requests={"cpu": "2"})
+    op.sync_state()
+    clock.advance(600)
+    op.deprovisioning.reconcile()
+    # 3 attempted launches, the third rejected (fake counts then throws)
+    assert len(cp.create_calls) == 3
+    node = op.kube_client.get("Node", "", "kept")
+    assert node is not None
+    assert not node.spec.unschedulable
+
+
+def test_can_replace_expired_node_with_multiple_nodes(env):
+    """expiration_test.go:288-378 — the only buyable type holds one pod
+    each: expiration fans the three pods out over three launches."""
+    op, cp, clock = env
+    current, _ = _custom_replacement_universe(cp)
+    provisioner(op, ttl_seconds_until_expired=200)
+    add_node(op, clock, "fan-out", it_name=current.name, cpu="8",
+             zone="test-zone-1a", pods=3, pod_requests={"cpu": "2"})
+    op.sync_state()
+    clock.advance(600)
+    assert op.deprovisioning.reconcile()
+    op.step()
+    assert len(cp.create_calls) == 3
+    assert op.kube_client.get("Node", "", "fan-out") is None
+
+
+# -- Drift (drift_test.go) ---------------------------------------------------
+
+
+def test_ignores_drifted_nodes_when_gate_disabled(env):
+    """drift_test.go:38-70 — annotated drifted but DriftEnabled=false."""
+    op, cp, clock = env
+    set_current(Settings(drift_enabled=False))
+    provisioner(op)
+    add_node(op, clock, "gated", pods=0, annotations=dict(DRIFTED))
+    op.sync_state()
+    clock.advance(600)
+    assert not op.deprovisioning.reconcile()
+    assert not cp.create_calls
+    assert op.kube_client.get("Node", "", "gated") is not None
+
+
+def test_ignores_drift_annotation_with_wrong_value(env, drift_on):
+    """drift_test.go:71-102 — the disruption annotation with any value other
+    than "drifted" does not trigger drift."""
+    op, cp, clock = env
+    provisioner(op)
+    add_node(op, clock, "mislabeled", pods=0,
+             annotations={api_labels.VOLUNTARY_DISRUPTION_ANNOTATION_KEY: "wrong-value"})
+    op.sync_state()
+    clock.advance(600)
+    assert not op.deprovisioning.reconcile()
+    assert not cp.create_calls
+    assert op.kube_client.get("Node", "", "mislabeled") is not None
+
+
+def test_ignores_nodes_without_drift_annotation(env, drift_on):
+    """drift_test.go:103-131."""
+    op, cp, clock = env
+    provisioner(op)
+    add_node(op, clock, "undrifted", pods=0)
+    op.sync_state()
+    clock.advance(600)
+    assert not op.deprovisioning.reconcile()
+    assert not cp.create_calls
+    assert op.kube_client.get("Node", "", "undrifted") is not None
+
+
+def test_can_delete_drifted_nodes(env, drift_on):
+    """drift_test.go:132-165."""
+    op, cp, clock = env
+    provisioner(op)
+    add_node(op, clock, "drifted", pods=0, annotations=dict(DRIFTED))
+    op.sync_state()
+    clock.advance(600)
+    assert op.deprovisioning.reconcile()
+    op.step()
+    assert not cp.create_calls
+    assert op.kube_client.get("Node", "", "drifted") is None
+
+
+def test_can_replace_drifted_nodes(env, drift_on):
+    """drift_test.go:166-220 — drifted node with a replicaset pod: one
+    replacement launch, old node removed."""
+    op, cp, clock = env
+    provisioner(op)
+    add_node(op, clock, "drift-replace", pods=1, annotations=dict(DRIFTED))
+    op.sync_state()
+    clock.advance(600)
+    assert op.deprovisioning.reconcile()
+    op.step()
+    assert len(cp.create_calls) == 1
+    assert op.kube_client.get("Node", "", "drift-replace") is None
+
+
+def test_can_replace_drifted_node_with_multiple_nodes(env, drift_on):
+    """drift_test.go:221-312 — one-pod-per-replacement universe: three
+    launches replace the drifted node."""
+    op, cp, clock = env
+    current, _ = _custom_replacement_universe(cp)
+    provisioner(op)
+    add_node(op, clock, "drift-fan-out", it_name=current.name, cpu="8",
+             zone="test-zone-1a", pods=3, pod_requests={"cpu": "2"},
+             annotations=dict(DRIFTED))
+    op.sync_state()
+    clock.advance(600)
+    assert op.deprovisioning.reconcile()
+    op.step()
+    assert len(cp.create_calls) == 3
+    assert op.kube_client.get("Node", "", "drift-fan-out") is None
+
+
+def test_deletes_one_drifted_node_at_a_time(env, drift_on):
+    """drift_test.go:313-360 — two drifted empty nodes, one reconcile loop:
+    exactly one is deleted (one command per loop)."""
+    op, cp, clock = env
+    provisioner(op)
+    add_node(op, clock, "drift-1", pods=0, annotations=dict(DRIFTED))
+    add_node(op, clock, "drift-2", pods=0, annotations=dict(DRIFTED))
+    op.sync_state()
+    clock.advance(600)
+    assert op.deprovisioning.reconcile()
+    op.step()
+    assert not cp.create_calls
+    remaining = {n.metadata.name for n in op.kube_client.list("Node")}
+    assert len(remaining & {"drift-1", "drift-2"}) == 1

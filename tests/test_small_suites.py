@@ -1,0 +1,216 @@
+"""Spec-for-spec port of the reference's small suites: events and settings.
+
+Cited line numbers refer to /root/reference/pkg/events/suite_test.go and
+/root/reference/pkg/apis/settings/suite_test.go. The injection,
+operator/controller, and utils suites are covered line-cited in
+tests/test_operator_runtime.py.
+"""
+import pytest
+
+from karpenter_core_tpu.api.settings import Settings
+from karpenter_core_tpu.events import Event, Recorder
+from karpenter_core_tpu.testing import FakeClock, make_node, make_pod
+
+
+@pytest.fixture
+def rec():
+    clock = FakeClock()
+    return Recorder(clock=clock), clock
+
+
+# -- Event Creation (events/suite_test.go:79-96) -----------------------------
+
+
+def test_creates_nominate_pod_event(rec):
+    """suite_test.go:80-83."""
+    r, _ = rec
+    r.nominate_pod(make_pod(name="p"), "node-1")
+    assert [e.reason for e in r.events] == ["Nominated"]
+
+
+def test_creates_evict_pod_event(rec):
+    """suite_test.go:84-87."""
+    r, _ = rec
+    r.evict_pod(make_pod(name="p"))
+    assert [e.reason for e in r.events] == ["Evicted"]
+
+
+def test_creates_pod_failed_to_schedule_event(rec):
+    """suite_test.go:88-91."""
+    r, _ = rec
+    r.pod_failed_to_schedule(make_pod(name="p"), "err")
+    assert [e.reason for e in r.events] == ["FailedScheduling"]
+
+
+def test_creates_node_failed_to_drain_event(rec):
+    """suite_test.go:92-95."""
+    r, _ = rec
+    r.node_failed_to_drain(make_node(name="n"), "err")
+    assert [e.reason for e in r.events] == ["FailedDraining"]
+
+
+# -- Dedupe (events/suite_test.go:98-130) ------------------------------------
+
+
+def test_dedupes_rapid_identical_events(rec):
+    """suite_test.go:99-105 — 100 identical evictions -> one event."""
+    r, _ = rec
+    pod = make_pod(name="same")
+    for _ in range(100):
+        r.evict_pod(pod)
+    assert sum(1 for e in r.events if e.reason == "Evicted") == 1
+
+
+def test_dedupe_timeout_can_be_overridden(rec):
+    """suite_test.go:106-121 — a 2s DedupeTimeout expires long before the
+    default 2-minute window."""
+    r, clock = rec
+    evt = Event("Pod", "default/same", "Normal", "Evicted", "Evicted pod",
+                dedupe_timeout=2.0)
+    for _ in range(10):
+        r.publish(evt)
+    assert sum(1 for e in r.events if e.reason == "Evicted") == 1
+    clock.advance(3.0)
+    r.publish(evt)
+    assert sum(1 for e in r.events if e.reason == "Evicted") == 2
+
+
+def test_long_dedupe_timeout_survives_cache_purge(rec):
+    """A dedupe_timeout longer than the default window must not be cut short
+    by the recorder's periodic cache sweep (the reference's expiring cache
+    keeps per-entry TTLs, recorder.go:59,85)."""
+    r, clock = rec
+    evt = Event("Pod", "default/same", "Normal", "Evicted", "Evicted pod",
+                dedupe_timeout=600.0)
+    assert r.publish(evt)
+    clock.advance(130.0)  # past the default window -> triggers the purge
+    r.evict_pod(make_pod(name="other"))
+    assert not r.publish(evt), "still inside its 600s dedupe window"
+    clock.advance(500.0)
+    assert r.publish(evt)
+
+
+def test_allows_events_with_different_entities(rec):
+    """suite_test.go:122-129 — eviction is NOT rate-limited (only nomination
+    carries a limiter, events.go:24-46): 100 distinct pods -> 100 events."""
+    r, _ = rec
+    for i in range(100):
+        r.evict_pod(make_pod(name=f"p-{i}"))
+    assert sum(1 for e in r.events if e.reason == "Evicted") == 100
+
+
+# -- Rate Limiting (events/suite_test.go:130-145) ----------------------------
+
+
+def test_nomination_capped_at_burst(rec):
+    """suite_test.go:131-136 — 100 rapid nominations of distinct pods pass
+    dedupe but the shared token bucket caps them at burst=10."""
+    r, _ = rec
+    for i in range(100):
+        r.nominate_pod(make_pod(name=f"p-{i}"), "node-1")
+    assert sum(1 for e in r.events if e.reason == "Nominated") == 10
+
+
+def test_nomination_smoothed_rate_allows_steady_flow(rec):
+    """suite_test.go:137-144 — 5 nominations/second for 3 seconds stays
+    within qps=5: all 15 land."""
+    r, clock = rec
+    n = 0
+    for _ in range(3):
+        for _ in range(5):
+            r.nominate_pod(make_pod(name=f"p-{n}"), "node-1")
+            n += 1
+        clock.advance(1.0)
+    assert sum(1 for e in r.events if e.reason == "Nominated") == 15
+
+
+# -- Settings (apis/settings/suite_test.go:38-139) ---------------------------
+
+
+def test_settings_defaults_from_empty_config_map():
+    """suite_test.go:39-50."""
+    s = Settings.from_config_map({})
+    assert s.batch_max_duration == 10.0
+    assert s.batch_idle_duration == 1.0
+    assert s.drift_enabled is False
+    assert s.ttl_after_not_registered == 15 * 60.0
+
+
+def test_settings_custom_values():
+    """suite_test.go:51-67."""
+    s = Settings.from_config_map(
+        {
+            "batchMaxDuration": "30s",
+            "batchIdleDuration": "5s",
+            "featureGates.driftEnabled": "true",
+            "ttlAfterNotRegistered": "30m",
+        }
+    )
+    assert s.batch_max_duration == 30.0
+    assert s.batch_idle_duration == 5.0
+    assert s.drift_enabled is True
+    assert s.ttl_after_not_registered == 30 * 60.0
+
+
+def test_settings_empty_ttl_disables_registration_reaper():
+    """suite_test.go:68-84 — an empty ttlAfterNotRegistered nils the TTL
+    (settings.go:86-91) rather than failing validation."""
+    s = Settings.from_config_map(
+        {
+            "batchMaxDuration": "30s",
+            "batchIdleDuration": "5s",
+            "featureGates.driftEnabled": "true",
+            "ttlAfterNotRegistered": "",
+        }
+    )
+    assert s.ttl_after_not_registered is None
+    assert s.batch_max_duration == 30.0
+
+
+@pytest.mark.parametrize(
+    "data",
+    [
+        {"batchMaxDuration": "-10s"},  # suite_test.go:85-93
+        {"batchMaxDuration": ""},  # suite_test.go:94-102
+        {"batchIdleDuration": "-1s"},  # suite_test.go:103-111
+        {"batchIdleDuration": ""},  # suite_test.go:112-120
+        {"featureGates.driftEnabled": "foobar"},  # suite_test.go:121-129
+        {"ttlAfterNotRegistered": "-10s"},  # suite_test.go:130-138
+    ],
+    ids=[
+        "negative-batch-max",
+        "empty-batch-max",
+        "negative-batch-idle",
+        "empty-batch-idle",
+        "non-boolean-drift-gate",
+        "negative-ttl-after-not-registered",
+    ],
+)
+def test_settings_validation_failures(data):
+    """suite_test.go:85-139 — malformed/negative values are rejected."""
+    with pytest.raises(ValueError):
+        Settings.from_config_map(data)
+
+
+def test_disabled_ttl_skips_machine_liveness_reaper():
+    """liveness.go:33-60 with settings.go's nil TTL: an unregistered machine
+    is never reaped when the TTL is disabled."""
+    from karpenter_core_tpu.api.settings import set_current
+    from karpenter_core_tpu.cloudprovider import fake
+    from karpenter_core_tpu.operator import new_operator
+    from karpenter_core_tpu.testing import make_machine
+
+    try:
+        clock = FakeClock()
+        op = new_operator(fake.FakeCloudProvider(fake.instance_types(3)),
+                          settings=Settings(ttl_after_not_registered=None),
+                          clock=clock)
+        machine = make_machine(name="orphan", launched=True, registered=False)
+        machine.metadata.creation_timestamp = clock()
+        op.kube_client.create(machine)
+        # never registers; a day passes; the machine must survive
+        clock.advance(24 * 3600)
+        assert op.machine_controller.liveness(machine) is None
+        assert op.kube_client.get("Machine", "", "orphan") is not None
+    finally:
+        set_current(Settings())
