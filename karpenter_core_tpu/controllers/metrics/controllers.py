@@ -81,7 +81,9 @@ class NodeMetricsController:
 
 
 class PodMetricsController:
-    """metrics/pod/controller.go:55-75."""
+    """metrics/pod/controller.go:118-163: cleanup-then-record — every event
+    first drops the pod's previous gauge (so phase transitions don't leave
+    stale series) and re-records unless the pod is gone."""
 
     def __init__(self, kube_client, clock=time.time):
         self.kube_client = kube_client
@@ -89,43 +91,71 @@ class PodMetricsController:
         self.state = REGISTRY.gauge(f"{NAMESPACE}_pods_state")
         self.startup = REGISTRY.histogram(f"{NAMESPACE}_pods_startup_time_seconds")
         self._started = set()
+        self._labels = {}  # (namespace, name) -> last recorded label set
 
-    def reconcile(self, pod) -> None:
-        self.state.set(
-            1.0,
-            {
-                "name": pod.metadata.name,
-                "namespace": pod.metadata.namespace,
-                "phase": pod.status.phase,
-                "node": pod.spec.node_name,
-            },
-        )
+    def reconcile(self, pod, deleted: bool = False) -> None:
+        key = (pod.metadata.namespace, pod.metadata.name)
+        prev = self._labels.pop(key, None)
+        if prev is not None:
+            self.state.delete(prev)
+        if deleted:
+            self._started.discard(pod.metadata.uid)
+            return
+        labels = {
+            "name": pod.metadata.name,
+            "namespace": pod.metadata.namespace,
+            "phase": pod.status.phase,
+            "node": pod.spec.node_name,
+        }
+        self.state.set(1.0, labels)
+        self._labels[key] = labels
         if pod.status.phase == "Running" and pod.metadata.uid not in self._started:
             self._started.add(pod.metadata.uid)
             self.startup.observe(self.clock() - pod.metadata.creation_timestamp)
 
 
 class ProvisionerMetricsController:
-    """metrics/provisioner/controller.go."""
+    """metrics/provisioner/controller.go:107-135: cleanup-then-record — the
+    previous gauge set is dropped on every event so resource-type changes and
+    provisioner deletion don't leave stale series."""
 
     def __init__(self, kube_client):
         self.kube_client = kube_client
         self.limit = REGISTRY.gauge(f"{NAMESPACE}_provisioner_limit")
         self.usage = REGISTRY.gauge(f"{NAMESPACE}_provisioner_usage")
         self.usage_pct = REGISTRY.gauge(f"{NAMESPACE}_provisioner_usage_pct")
+        self._labels = {}  # provisioner name -> [(gauge, labels), ...]
 
-    def reconcile(self, provisioner) -> None:
+    def reconcile(self, provisioner, deleted: bool = False) -> None:
+        for gauge, labels in self._labels.pop(provisioner.name, []):
+            gauge.delete(labels)
+        if deleted:
+            return
+        recorded = []
         base = {"provisioner": provisioner.name}
         if provisioner.spec.limits is not None:
             for name, q in provisioner.spec.limits.resources.items():
-                self.limit.set(q, {**base, "resource_type": name})
+                labels = {**base, "resource_type": name}
+                self.limit.set(q, labels)
+                recorded.append((self.limit, labels))
         for name, q in provisioner.status.resources.items():
-            self.usage.set(q, {**base, "resource_type": name})
+            labels = {**base, "resource_type": name}
+            self.usage.set(q, labels)
+            recorded.append((self.usage, labels))
             if (
                 provisioner.spec.limits is not None
                 and provisioner.spec.limits.resources.get(name)
             ):
                 self.usage_pct.set(
-                    q / provisioner.spec.limits.resources[name] * 100.0,
-                    {**base, "resource_type": name},
+                    q / provisioner.spec.limits.resources[name] * 100.0, labels
                 )
+                recorded.append((self.usage_pct, labels))
+        self._labels[provisioner.name] = recorded
+
+    def prune(self, live_names) -> None:
+        """Drop series for provisioners no longer in the cluster — the
+        level-triggered analog of reconciling a NotFound key
+        (controller.go:117-123)."""
+        for name in set(self._labels) - set(live_names):
+            for gauge, labels in self._labels.pop(name, []):
+                gauge.delete(labels)

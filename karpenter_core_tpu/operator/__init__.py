@@ -128,9 +128,11 @@ class Operator:
             self.sync_state()
         for machine in self.kube_client.list("Machine"):
             self.machine_controller.reconcile(machine)
-        for provisioner in self.kube_client.list("Provisioner"):
+        provisioners = self.kube_client.list("Provisioner")
+        for provisioner in provisioners:
             self.counter.reconcile(provisioner)
             self.provisioner_metrics.reconcile(provisioner)
+        self.provisioner_metrics.prune({p.name for p in provisioners})
         if deprovision and self.deprovisioning is not None:
             summary["deprovisioned"] = self.deprovisioning.reconcile()
         self.node_metrics.reconcile()
@@ -172,8 +174,13 @@ class Operator:
                             jitter()
                         handler(event, obj)
                         if kind == "Pod":
-                            self.pod_controller.reconcile(obj)
-                            self.pod_metrics.reconcile(obj)
+                            if event != "DELETED":
+                                self.pod_controller.reconcile(obj)
+                            self.pod_metrics.reconcile(obj, deleted=event == "DELETED")
+                        elif kind == "Provisioner":
+                            self.provisioner_metrics.reconcile(
+                                obj, deleted=event == "DELETED"
+                            )
                     except Exception:
                         RECONCILE_ERRORS.inc(labels={"controller": f"watch-{kind}"})
                         log.exception("watch pump failed (kind=%s)", kind)

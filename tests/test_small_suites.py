@@ -214,3 +214,148 @@ def test_disabled_ttl_skips_machine_liveness_reaper():
         assert op.kube_client.get("Machine", "", "orphan") is not None
     finally:
         set_current(Settings())
+
+
+# -- Metrics controllers (controllers/metrics/{provisioner,state,pod}) -------
+# suite_test.go line citations refer to the respective reference suite.
+
+
+def _find_metric(gauge, want):
+    """FindMetricWithLabelValues: any series whose labels superset `want`."""
+    want = set(want.items())
+    for key, value in gauge.values.items():
+        if want <= set(key):
+            return value
+    return None
+
+
+@pytest.fixture
+def op_env():
+    from karpenter_core_tpu.cloudprovider import fake
+    from karpenter_core_tpu.operator import new_operator
+
+    clock = FakeClock()
+    op = new_operator(fake.FakeCloudProvider(fake.instance_types(5)),
+                      settings=Settings(), clock=clock)
+    return op, clock
+
+
+def test_provisioner_limit_metrics(op_env):
+    """provisioner/suite_test.go:58-78."""
+    from karpenter_core_tpu.testing import make_provisioner
+
+    op, _ = op_env
+    p = make_provisioner(name="limits-prov",
+                         limits={"cpu": "10", "memory": "10Mi"})
+    op.kube_client.create(p)
+    op.step(provision=False)
+    g = op.provisioner_metrics.limit
+    assert _find_metric(g, {"provisioner": "limits-prov", "resource_type": "cpu"}) == 10.0
+    mem = _find_metric(g, {"provisioner": "limits-prov", "resource_type": "memory"})
+    assert mem == 10 * 2**20
+
+
+def test_provisioner_usage_metrics(op_env):
+    """provisioner/suite_test.go:79-102."""
+    from karpenter_core_tpu.testing import make_provisioner
+
+    op, _ = op_env
+    p = make_provisioner(name="usage-prov")
+    p.status.resources = {"cpu": 10.0, "memory": 10.0 * 2**20}
+    op.kube_client.create(p)
+    op.provisioner_metrics.reconcile(p)
+    g = op.provisioner_metrics.usage
+    assert _find_metric(g, {"provisioner": "usage-prov", "resource_type": "cpu"}) == 10.0
+
+
+def test_provisioner_usage_pct_metrics(op_env):
+    """provisioner/suite_test.go:103-132 — usage 10% of limits."""
+    from karpenter_core_tpu.testing import make_provisioner
+
+    op, _ = op_env
+    p = make_provisioner(name="pct-prov", limits={"cpu": "100", "memory": "100Mi"})
+    p.status.resources = {"cpu": 10.0, "memory": 10.0 * 2**20}
+    op.kube_client.create(p)
+    op.provisioner_metrics.reconcile(p)
+    g = op.provisioner_metrics.usage_pct
+    for rt in ("cpu", "memory"):
+        assert _find_metric(g, {"provisioner": "pct-prov", "resource_type": rt}) == 10.0
+
+
+def test_provisioner_metrics_deleted_on_provisioner_delete(op_env):
+    """provisioner/suite_test.go:133-168 — all three series vanish."""
+    from karpenter_core_tpu.testing import make_provisioner
+
+    op, _ = op_env
+    p = make_provisioner(name="gone-prov", limits={"cpu": "100"})
+    p.status.resources = {"cpu": 10.0}
+    op.kube_client.create(p)
+    op.provisioner_metrics.reconcile(p)
+    op.kube_client.delete("Provisioner", "", "gone-prov")
+    op.step(provision=False)  # level-triggered prune
+    for g in (op.provisioner_metrics.limit, op.provisioner_metrics.usage,
+              op.provisioner_metrics.usage_pct):
+        assert _find_metric(g, {"provisioner": "gone-prov"}) is None
+
+
+def test_node_allocatable_metric(op_env):
+    """state/suite_test.go:86-106."""
+    from karpenter_core_tpu.testing import make_node
+
+    op, _ = op_env
+    node = make_node(name="metric-node",
+                     capacity={"cpu": "5", "memory": "32Gi", "pods": "100"})
+    op.kube_client.create(node)
+    op.sync_state()
+    op.node_metrics.reconcile()
+    g = op.node_metrics.allocatable
+    assert _find_metric(g, {"node_name": "metric-node", "resource_type": "pods"}) == 100.0
+    assert _find_metric(g, {"node_name": "metric-node", "resource_type": "cpu"}) == 5.0
+
+
+def test_node_metric_removed_when_node_deleted(op_env):
+    """state/suite_test.go:107-132."""
+    from karpenter_core_tpu.testing import make_node
+
+    op, _ = op_env
+    node = make_node(name="vanishing-node", capacity={"cpu": "5", "pods": "10"})
+    op.kube_client.create(node)
+    op.sync_state()
+    op.node_metrics.reconcile()
+    assert _find_metric(op.node_metrics.allocatable, {"node_name": "vanishing-node"}) is not None
+    op.kube_client.delete("Node", "", "vanishing-node")
+    op.sync_state()
+    op.node_metrics.reconcile()
+    assert _find_metric(op.node_metrics.allocatable, {"node_name": "vanishing-node"}) is None
+
+
+def test_pod_state_metric(op_env):
+    """pod/suite_test.go:54-64."""
+    op, _ = op_env
+    pod = make_pod(name="metric-pod")
+    op.pod_metrics.reconcile(pod)
+    assert _find_metric(op.pod_metrics.state,
+                        {"name": "metric-pod", "namespace": "default"}) == 1.0
+
+
+def test_pod_state_metric_tracks_phase(op_env):
+    """pod/suite_test.go:65-86 — the old phase's series must not linger."""
+    op, _ = op_env
+    pod = make_pod(name="phase-pod")
+    pod.status.phase = "Pending"
+    op.pod_metrics.reconcile(pod)
+    pod.status.phase = "Running"
+    op.pod_metrics.reconcile(pod)
+    assert _find_metric(op.pod_metrics.state,
+                        {"name": "phase-pod", "phase": "Running"}) == 1.0
+    assert _find_metric(op.pod_metrics.state,
+                        {"name": "phase-pod", "phase": "Pending"}) is None
+
+
+def test_pod_state_metric_deleted_on_pod_delete(op_env):
+    """pod/suite_test.go:87-100."""
+    op, _ = op_env
+    pod = make_pod(name="deleted-pod")
+    op.pod_metrics.reconcile(pod)
+    op.pod_metrics.reconcile(pod, deleted=True)
+    assert _find_metric(op.pod_metrics.state, {"name": "deleted-pod"}) is None
