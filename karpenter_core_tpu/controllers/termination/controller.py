@@ -25,12 +25,12 @@ class TerminationController:
     def reconcile(self, node: Node) -> Optional[float]:
         if node.metadata.deletion_timestamp is None:
             return None
-        if api_labels.TERMINATION_FINALIZER not in node.metadata.finalizers:
-            return None
         return self.finalize(node)
 
     def finalize(self, node: Node) -> Optional[float]:
-        """controller.go:64-86."""
+        """controller.go:64-86 — a no-op without the finalizer (:65-67)."""
+        if api_labels.TERMINATION_FINALIZER not in node.metadata.finalizers:
+            return None
         self.terminator.cordon(node)
         try:
             self.terminator.drain(node)

@@ -202,7 +202,17 @@ class Operator:
 
         def housekeeping_once():
             from karpenter_core_tpu.operator.controller import (
+                Typed,
                 reconcile_concurrently,
+            )
+
+            # key-based typed reconcilers (typed.go:50-81): each worker
+            # re-fetches its object so list-to-reconcile races see fresh
+            # state, and deleting objects route to finalize()
+            typed_machine = Typed(self.kube_client, "Machine", self.machine_controller)
+            typed_node = Typed(self.kube_client, "Node", self.node_controller)
+            typed_termination = Typed(
+                self.kube_client, "Node", self.termination_controller
             )
 
             # MaxConcurrentReconciles analog: machine reconciles fan out 50
@@ -210,12 +220,13 @@ class Operator:
             # provisioning/controller.go:72); cloud/API-bound work overlaps
             reconcile_concurrently(
                 "machine", self.kube_client.list("Machine"),
-                self.machine_controller.reconcile, max_workers=50,
+                lambda m: typed_machine.reconcile_key(m.metadata.name),
+                max_workers=50,
             )
 
             def node_reconcile(node):
-                self.node_controller.reconcile(node)
-                self.termination_controller.reconcile(node)
+                typed_node.reconcile_key(node.metadata.name)
+                typed_termination.reconcile_key(node.metadata.name)
 
             reconcile_concurrently(
                 "node", self.kube_client.list("Node"), node_reconcile,

@@ -95,6 +95,32 @@ class Singleton:
         return self._thread
 
 
+class Typed:
+    """Key-based decorator around an object controller (typed.go:50-81).
+
+    Reconciling by key instead of by object means the inner controller
+    always receives a FRESH fetch — never a stale watch/list copy — a
+    NotFound key is silently ignored (typed.go:73-75), and an object
+    mid-deletion is routed to the inner controller's finalize() when it
+    implements one (FinalizingTypedController, typed.go:39-43,76-78)."""
+
+    def __init__(self, kube_client, kind: str, inner):
+        self.kube_client = kube_client
+        self.kind = kind
+        self.inner = inner
+        self.name = f"{kind.lower()}.{type(inner).__name__}"
+
+    def reconcile_key(self, name: str, namespace: str = ""):
+        obj = self.kube_client.get(self.kind, namespace, name)
+        if obj is None:
+            return None
+        if obj.metadata.deletion_timestamp is not None and hasattr(
+            self.inner, "finalize"
+        ):
+            return self.inner.finalize(obj)
+        return self.inner.reconcile(obj)
+
+
 class _DaemonPool:
     """Minimal worker pool with DAEMON threads (unlike ThreadPoolExecutor,
     whose non-daemon workers are joined at interpreter exit — one reconcile

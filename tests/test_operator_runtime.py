@@ -353,3 +353,73 @@ def test_housekeeping_runs_machine_reconciles_in_parallel():
         op.stop()
     assert threads_seen, "housekeeping never reconciled a machine"
     assert all(t.startswith("machine") for t in threads_seen), threads_seen
+
+
+# -- Typed controller decorator (operator/controller/typed.go:50-81) ---------
+
+
+class TestTyped:
+    """Port of operator/controller/suite_test.go:75-110."""
+
+    def _client_with_node(self, deleting=False, finalizers=()):
+        from karpenter_core_tpu.cloudprovider import fake
+        from karpenter_core_tpu.operator import new_operator
+        from karpenter_core_tpu.testing import FakeClock, make_node
+
+        op = new_operator(fake.FakeCloudProvider(fake.instance_types(2)),
+                          clock=FakeClock())
+        node = make_node(name="typed-node",
+                         labels={"karpenter.sh/provisioner-name": "default"})
+        node.metadata.finalizers.extend(finalizers)
+        if deleting:
+            node.metadata.deletion_timestamp = 1.0
+        op.kube_client.create(node)
+        return op.kube_client, node
+
+    def test_passes_expected_node_into_reconcile(self):
+        """suite_test.go:75-94 — the inner controller receives the freshly
+        fetched object for the key."""
+        from karpenter_core_tpu.operator.controller import Typed
+
+        kube_client, node = self._client_with_node()
+        seen = []
+
+        class Fake:
+            def reconcile(self, obj):
+                seen.append(obj)
+
+        Typed(kube_client, "Node", Fake()).reconcile_key("typed-node")
+        assert len(seen) == 1
+        assert seen[0].metadata.name == "typed-node"
+        assert seen[0].metadata.labels["karpenter.sh/provisioner-name"] == "default"
+
+    def test_calls_finalize_when_finalizing(self):
+        """suite_test.go:95-110 — an object mid-deletion routes to
+        finalize() when the inner controller implements one."""
+        from karpenter_core_tpu.operator.controller import Typed
+
+        kube_client, node = self._client_with_node(
+            deleting=True, finalizers=["testing/finalizer"])
+        calls = []
+
+        class Fake:
+            def reconcile(self, obj):
+                calls.append("reconcile")
+
+            def finalize(self, obj):
+                calls.append("finalize")
+
+        Typed(kube_client, "Node", Fake()).reconcile_key("typed-node")
+        assert calls == ["finalize"]
+
+    def test_not_found_key_is_ignored(self):
+        """typed.go:73-75 — IgnoreNotFound: a vanished key is a no-op."""
+        from karpenter_core_tpu.operator.controller import Typed
+
+        kube_client, _ = self._client_with_node()
+
+        class Explode:
+            def reconcile(self, obj):
+                raise AssertionError("must not be called")
+
+        assert Typed(kube_client, "Node", Explode()).reconcile_key("gone") is None
