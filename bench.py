@@ -542,27 +542,39 @@ def main():
     import concurrent.futures
     import gc as _gc
 
-    # same sample count as the headline e2e loop so the two p99s compare;
-    # the worker thread GENERATES + encodes the next batch (production
-    # shape: one live batch at a time), and the timed loop keeps the varied
-    # loop's per-solve gc.collect so GC artifacts stay isolated identically
+    # same sample count as the headline e2e loop so the two p99s compare.
+    # Only ENCODE runs on the worker thread: in production the pods already
+    # exist (watch cache) — generating 50k Python pod objects is a bench
+    # artifact, and doing it on the worker during the timed solve starved
+    # the main thread's host-side fetch/decode of the GIL (first measured
+    # TPU run: pipelined p50 1.97s vs plain 1.44s). Generation now happens
+    # on the MAIN thread between timed windows; encode (numpy-heavy,
+    # GIL-releasing) is what overlaps the device window, which is the
+    # production overlap being measured.
     pipe_runs = N_RUNS
     pipe_times = []
     if pipe_runs >= 2:
         pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
 
-        def gen_and_encode(r):
+        def gen(r):
             n_pods = int(N_PODS * (0.8 + 0.25 * rng.random()))
             n_exist = int(N_EXISTING * (0.88 + 0.12 * rng.random()))
-            batch = workload(n_pods, n_exist, 1000 + r)
-            p, pr, it, nd = batch
-            return batch, solver.encode(p, pr, it, state_nodes=nd)
+            return workload(n_pods, n_exist, 1000 + r)
 
-        nxt = pool.submit(gen_and_encode, 0)
+        def encode(batch):
+            p, pr, it, nd = batch
+            return solver.encode(p, pr, it, state_nodes=nd)
+
+        cur = gen(0)
+        nxt_batch = None
+        nxt = pool.submit(encode, cur)
         for r in range(pipe_runs):
-            (p, pr, it, nd), snap = nxt.result()
             if r + 1 < pipe_runs:
-                nxt = pool.submit(gen_and_encode, r + 1)
+                nxt_batch = gen(r + 1)  # main thread, untimed
+            snap = nxt.result()
+            p, pr, it, nd = cur
+            if r + 1 < pipe_runs:
+                nxt = pool.submit(encode, nxt_batch)
             _gc.collect()
             t0 = time.perf_counter()
             solver.solve(p, pr, it, state_nodes=nd, encoded=snap)
@@ -572,6 +584,7 @@ def main():
                 f"solve={pipe_times[-1] * 1e3:.0f}ms",
                 file=sys.stderr,
             )
+            cur, nxt_batch = nxt_batch, None
             del p, pr, it, nd, snap
         pool.shutdown(wait=False)
     pipe_p50 = float(np.percentile(pipe_times, 50)) if pipe_times else 0.0

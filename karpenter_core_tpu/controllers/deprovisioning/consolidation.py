@@ -239,6 +239,14 @@ class MultiNodeConsolidation(Consolidation):
         if cmd.action == ACTION_DO_NOTHING:
             return cmd
         if not self.validate_after_ttl(cmd):
+            # If the rejected command came straight from the vmapped screen
+            # (the delete shortcut), force the NEXT ladder through exact
+            # per-rung confirmation: a screen/exact disagreement would
+            # otherwise reproduce the identical screen answer every loop —
+            # a retry livelock that also shadows smaller, genuinely
+            # feasible rungs.
+            if cmd.from_screen:
+                self._confirm_deletes_once = True
             return Command(action=ACTION_RETRY)
         return cmd
 
@@ -249,8 +257,12 @@ class MultiNodeConsolidation(Consolidation):
 
         On a solver with batched-replan support (TPUSolver), the whole
         ladder is screened in ONE vmapped device dispatch over a shared
-        union encode (solver/replan.py) and only the winning prefix is
-        confirmed through the exact solve path; otherwise each rung is a
+        union encode (solver/replan.py). A conclusive 0-new-machine winner
+        becomes the DELETE command directly (validate_after_ttl re-simulates
+        through the exact path before execution; a validation rejection
+        flips the next ladder back to exact per-rung confirmation); REPLACE
+        winners are always confirmed through the exact solve path, stepping
+        down on disagreement. Without batched-replan support each rung is a
         full solve (host fallback)."""
         if len(candidates) < 2:
             return Command(action=ACTION_DO_NOTHING)
@@ -288,12 +300,14 @@ class MultiNodeConsolidation(Consolidation):
 
     def _ladder_batched(self, candidates: List[CandidateNode],
                         sizes: List[int]) -> Command:
-        """One vmapped screen over all rungs, then exact confirmation of the
-        largest screen-feasible prefix, stepping down on disagreement (the
-        screen checks schedulability and machine count; price and same-type
-        rules only apply at confirmation)."""
+        """One vmapped screen over all rungs; conclusive 0-new-machine
+        winners short-circuit to DELETE, REPLACE winners get exact
+        confirmation (price and same-type rules live there), stepping down
+        on disagreement. See first_n_consolidation_ladder for the
+        validation backstop on the delete shortcut."""
         from karpenter_core_tpu.solver.replan import batched_ladder_screen
 
+        confirm_deletes = getattr(self, "_confirm_deletes_once", False)
         try:
             screens = batched_ladder_screen(
                 self.kube_client, self.cluster, self.provisioning, candidates,
@@ -302,16 +316,38 @@ class MultiNodeConsolidation(Consolidation):
                 ),
             )
         except CandidateNodeDeletingError:
+            # transient (a candidate is mid-delete): keep the one-shot flag
+            # so the NEXT successful ladder still runs exact confirmation
             return Command(action=ACTION_DO_NOTHING)
+        self._confirm_deletes_once = False
         feasible = []
         blocked = []
+        by_size = {}
         for screen in screens:
             if screen.all_scheduled and screen.conclusive and screen.n_new_machines <= 1:
                 feasible.append(screen.size)
+                by_size[screen.size] = screen
             else:
                 blocked = [s.size for s in screens[len(feasible):]]
                 break  # larger prefixes are monotonically harder
         for size in reversed(feasible):
+            # A conclusive 0-new-machine rung IS the delete decision: the
+            # screen ran the same round-0 kernel the exact path would (the
+            # delete branch of consolidation.go:180-264 checks only "all
+            # scheduled, zero replacements" — price/spot/same-type rules
+            # exist only for REPLACE), relaxation could only make pods MORE
+            # schedulable, and validate_after_ttl re-simulates through the
+            # exact path before any node is touched. Skipping the
+            # confirming solve here halves the replan's critical path.
+            # confirm_deletes (set after a validation rejection of a
+            # screen-sourced delete) routes this rung through the exact
+            # path instead, restoring the step-down on disagreement.
+            if by_size[size].n_new_machines == 0 and not confirm_deletes:
+                return Command(
+                    nodes_to_remove=[c.node for c in candidates[:size]],
+                    action=ACTION_DELETE,
+                    from_screen=True,
+                )
             cmd = self._evaluate_prefix(candidates, size)
             if cmd.action in (ACTION_REPLACE, ACTION_DELETE):
                 return cmd

@@ -60,6 +60,10 @@ class Command:
     nodes_to_remove: List[Node] = field(default_factory=list)
     action: str = ACTION_DO_NOTHING
     replacement_machines: List[SolvedMachine] = field(default_factory=list)
+    # provenance: True when a DELETE was issued straight from the vmapped
+    # ladder screen (no exact confirming solve); a validation rejection of
+    # such a command flips the next ladder to exact per-rung confirmation
+    from_screen: bool = False
 
     def __str__(self) -> str:
         names = [n.metadata.name for n in self.nodes_to_remove]

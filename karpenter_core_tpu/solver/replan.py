@@ -77,7 +77,7 @@ def batched_ladder_screen(
         for p in kube_client.list(
             "Pod",
             field_filter=lambda p, n=node: p.spec.node_name == n.name(),
-            copy_objects=False,  # clone_for_simulation shallow-clones below
+            copy_objects=False,  # read-only below; see clone note
         ):
             if not podutils.is_terminal(p) and not podutils.is_owned_by_daemonset(p):
                 pods.append(p)
@@ -87,7 +87,15 @@ def batched_ladder_screen(
             if not podutils.is_owned_by_daemonset(p):
                 pods.append(p)
                 cand_of.append(ci)
-    pods = [podutils.clone_for_simulation(p) for p in pods]
+    # NO clone_for_simulation here, unlike simulate_scheduling. INVARIANT:
+    # this path must stay strictly read-only over live Pod objects —
+    # encode_snapshot consumes specs/labels without normalizing them, the
+    # device path never reads spec.node_name, and the round-0 kernel does
+    # no preference relaxation (the only mutating step in the exact path).
+    # Any future consumer of snap.pods that mutates or reads node_name must
+    # reinstate the shallow clone. Measured (2026-07-30, config-4 profile):
+    # even the SHALLOW clone of 10k shared Pods cost 97-309ms per replan —
+    # comparable to the ~170ms device dispatch it feeds.
     cand_of_pod: Dict[str, int] = {
         p.metadata.uid: ci for p, ci in zip(pods, cand_of)
     }

@@ -415,3 +415,151 @@ def test_multi_node_ttl_blocks_on_nomination():
         stepper.join(timeout=2)
     assert nominated.is_set()
     assert cmd.action == "retry", f"nominated candidate must block, got {cmd.action}"
+
+
+# -- batched-ladder / host-ladder equivalence --------------------------------
+# The TPU replan screens every prefix rung in one vmapped dispatch and, for
+# a conclusive 0-new-machine winner, issues the DELETE directly from the
+# screen (solver/replan.py; consolidation.py _ladder_batched). These pin
+# that shortcut to the host ladder's exact-solve answer on the same state.
+
+
+class _NoBatchedReplan:
+    """Delegating wrapper that hides supports_batched_replan, forcing the
+    host per-rung ladder on the same underlying solver."""
+
+    supports_batched_replan = False
+
+    def __init__(self, inner):
+        self._inner = inner
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def _multi_and_candidates(op, cp, clock):
+    from karpenter_core_tpu.controllers.deprovisioning.core import candidate_nodes
+
+    multi = next(
+        d for d in op.deprovisioning.deprovisioners
+        if type(d).__name__ == "MultiNodeConsolidation"
+    )
+    multi.validation_ttl = 0.0
+    candidates = multi.sort_and_filter_candidates(
+        candidate_nodes(op.cluster, op.kube_client, cp, multi.should_deprovision, clock)
+    )
+    return multi, candidates
+
+
+def test_batched_ladder_delete_matches_host_ladder():
+    from karpenter_core_tpu.solver.tpu_solver import TPUSolver
+
+    clock = FakeClock()
+    cp = fake.FakeCloudProvider(fake.instance_types(10))
+    op = new_operator(
+        cp, settings=Settings(), solver=TPUSolver(max_nodes=64), clock=clock
+    )
+    provisioner(op, consolidation_enabled=True)
+    # a non-candidate keeper (different, non-consolidating provisioner)
+    # absorbs every displaced pod, so the winning rung removes ALL 8
+    # candidates with ZERO new machines -> the screen's direct-delete fires
+    op.kube_client.create(make_provisioner(name="static"))
+    keeper = make_node(
+        name="keeper",
+        labels={PROVISIONER_NAME_LABEL_KEY: "static", LABEL_NODE_INITIALIZED: "true"},
+        capacity={"cpu": "10", "memory": "20Gi", "pods": "100"},
+    )
+    op.kube_client.create(keeper)
+    for i in range(8):
+        add_node(op, clock, f"lite-{i}", it_name="fake-it-9", cpu="10", pods=1,
+                 pod_requests={"cpu": "0.1"})
+    op.sync_state()
+    multi, candidates = _multi_and_candidates(op, cp, clock)
+    assert len(candidates) == 8
+    assert multi.provisioning.solver.supports_batched_replan
+
+    cmd_batched = multi.first_n_consolidation_ladder(candidates)
+    host_solver = _NoBatchedReplan(multi.provisioning.solver)
+    orig = multi.provisioning.solver
+    try:
+        multi.provisioning.solver = host_solver
+        cmd_host = multi.first_n_consolidation_ladder(candidates)
+    finally:
+        multi.provisioning.solver = orig
+
+    assert cmd_batched.action == "delete"
+    assert cmd_host.action == "delete"
+    assert {n.metadata.name for n in cmd_batched.nodes_to_remove} == {
+        n.metadata.name for n in cmd_host.nodes_to_remove
+    }
+    assert not cmd_batched.replacement_machines
+
+
+def test_batched_ladder_replace_still_confirms_exactly():
+    """A REPLACE outcome (1 new cheaper machine) must still route through
+    the exact confirming solve — price and same-type rules live there."""
+    from karpenter_core_tpu.solver.tpu_solver import TPUSolver
+
+    clock = FakeClock()
+    cp = fake.FakeCloudProvider(fake.instance_types(10))
+    op = new_operator(
+        cp, settings=Settings(), solver=TPUSolver(max_nodes=64), clock=clock
+    )
+    provisioner(op, consolidation_enabled=True)
+    # two half-used nodes whose pods need a (cheaper, smaller) single node
+    add_node(op, clock, "big-1", it_name="fake-it-9", cpu="10", pods=1)
+    add_node(op, clock, "big-2", it_name="fake-it-4", cpu="5", pods=1)
+    op.sync_state()
+    multi, candidates = _multi_and_candidates(op, cp, clock)
+    assert len(candidates) == 2
+
+    cmd = multi.first_n_consolidation_ladder(candidates)
+    assert cmd.action == "replace"
+    assert len(cmd.replacement_machines) == 1
+    # the replacement passed the price filter: strictly cheaper than the sum
+    names = {it.name for it in cmd.replacement_machines[0].instance_type_options}
+    assert "fake-it-9" not in names
+
+
+def test_screen_delete_validation_rejection_forces_exact_ladder():
+    """A validation rejection of a screen-sourced delete must flip the next
+    ladder to exact per-rung confirmation (no screen/exact-disagreement
+    retry livelock)."""
+    from karpenter_core_tpu.solver.tpu_solver import TPUSolver
+
+    clock = FakeClock()
+    cp = fake.FakeCloudProvider(fake.instance_types(10))
+    op = new_operator(
+        cp, settings=Settings(), solver=TPUSolver(max_nodes=64), clock=clock
+    )
+    provisioner(op, consolidation_enabled=True)
+    op.kube_client.create(make_provisioner(name="static"))
+    keeper = make_node(
+        name="keeper",
+        labels={PROVISIONER_NAME_LABEL_KEY: "static", LABEL_NODE_INITIALIZED: "true"},
+        capacity={"cpu": "10", "memory": "20Gi", "pods": "100"},
+    )
+    op.kube_client.create(keeper)
+    for i in range(4):
+        add_node(op, clock, f"lite-{i}", it_name="fake-it-9", cpu="10", pods=1,
+                 pod_requests={"cpu": "0.1"})
+    op.sync_state()
+    multi, candidates = _multi_and_candidates(op, cp, clock)
+
+    cmd = multi.first_n_consolidation_ladder(candidates)
+    assert cmd.action == "delete" and getattr(cmd, "from_screen", False)
+
+    # validation rejects the screen-sourced delete -> RETRY + exact-mode flag
+    multi.validate_after_ttl = lambda _cmd: False
+    retry = multi.compute_command(candidates)
+    assert retry.action == "retry"
+    assert multi._confirm_deletes_once
+
+    # next ladder runs the exact confirming path: same delete, no screen tag
+    cmd2 = multi.first_n_consolidation_ladder(candidates)
+    assert cmd2.action == "delete"
+    assert not getattr(cmd2, "from_screen", False)
+    assert not multi._confirm_deletes_once  # one-shot, hot path restored
+    assert {n.metadata.name for n in cmd2.nodes_to_remove} == {
+        n.metadata.name for n in cmd.nodes_to_remove
+    }
