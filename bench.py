@@ -480,6 +480,13 @@ def main():
     pods2, provisioners2, its2, nodes2 = workload(int(N_PODS * 0.8), N_EXISTING, 1)
     solver.solve(pods2, provisioners2, its2, state_nodes=nodes2)
 
+    # the production processes' long-lived-server GC tuning (the operator
+    # applies the same call at startup — utils/gctuning.py), here applied
+    # after warmup so the frozen baseline covers the compiled programs
+    from karpenter_core_tpu.utils.gctuning import apply_server_gc_tuning
+
+    apply_server_gc_tuning()
+
     # device-only time at the headline config (r01/r02-comparable region)
     snap = encode_snapshot(pods, provisioners, its, None, nodes, max_nodes=MAX_NODES)
     args = jax.device_put(device_args(snap, provisioners))

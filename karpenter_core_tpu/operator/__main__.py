@@ -248,6 +248,13 @@ def run(cloud_provider, kube_client=None, stop_event=None, options=None):
     # the wrapper IS the fallback layer: point the provisioner's own
     # fallback at it so the two mechanisms don't stack
     operator.provisioning.fallback_solver = solver
+    # long-lived-server GC posture (utils/gctuning.py): freeze the wired-up
+    # baseline out of collector scans so gen-2 pauses don't land mid-Solve
+    # (the CPython analog of the reference's --memory-limit GOGC tuning,
+    # operator.go:84-88). The bench applies the same call after its warmup.
+    from karpenter_core_tpu.utils.gctuning import apply_server_gc_tuning
+
+    apply_server_gc_tuning()
     health = serve_health(operator, opts.metrics_port, profiling=opts.enable_profiling)
     stop = stop_event or threading.Event()
     try:

@@ -335,6 +335,12 @@ def main(argv: Optional[List[str]] = None) -> None:
     args = parser.parse_args(argv)
 
     server, port, _service = serve(f"{args.host}:{args.port}", max_workers=args.max_workers)
+    # decode runs in THIS process in a split deployment: apply the shared
+    # long-lived-server GC posture (utils/gctuning.py) so gen-2 pauses
+    # don't land mid-Solve
+    from karpenter_core_tpu.utils.gctuning import apply_server_gc_tuning
+
+    apply_server_gc_tuning()
     print(f"solver service listening on {args.host}:{port}", flush=True)
     stop = threading.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):

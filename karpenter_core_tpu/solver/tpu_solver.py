@@ -523,6 +523,9 @@ class TPUSolver:
         # time separately (costs cold solves the serialized upload)
         self.profile_phases = profile_phases
         self._compiled = {}
+        # per-geometry (ptr_b, bulk_b, nopen_b) from the previous solve:
+        # the speculative single-round-trip fetch slices with these
+        self._fetch_buckets = {}
 
     # -- public API --------------------------------------------------------
 
@@ -733,32 +736,20 @@ class TPUSolver:
                 jax.block_until_ready(state)
         else:
             log, ptr, state = fn(*args)
+
         # fetch ONLY what decode reads: log entries [:ptr], bulk rows
         # [:bulk_n], and state slot rows [:nopen] (the slot budget is mostly
-        # unused headroom — at 50k pods this cuts the fetch ~10x)
-        ptr_i, nopen, bulk_n = jax.device_get((ptr, state.nopen, log["bulk_n"]))
-        # dispatch -> first scalar readback ≈ device execution time for this
-        # solve (observability: bench reports p99 of this across batches)
-        self.last_device_ms = (_time.perf_counter() - t_dispatch) * 1e3
-        _mark("device")
-        ptr_i, nopen, bulk_n = int(ptr_i), int(nopen), int(bulk_n)
-        # slice lengths round UP to buckets: each distinct slice shape
-        # compiles its own tiny device program, so exact lengths would pay
-        # seconds of mini-compiles on every new batch outcome
-        from karpenter_core_tpu.solver.encode import bucket_pow2
-
-        ptr_b = min(bucket_pow2(max(ptr_i, 1), 1024), log["item"].shape[0])
-        nopen_b = min(bucket_pow2(max(nopen, 1), 1024), state.tmpl.shape[0])
-        bulk_b = min(bucket_pow2(max(bulk_n, 1), 1024), log["bulk_take"].shape[0])
-
-        # eager fetch = only what decode reads on the Solve critical path:
-        # the commit log + per-slot tmpl/used/pods. The launch-path planes
-        # (tmask/allow/out/defined — read by SolvedMachine.requirements()/
-        # instance_type_options(), i.e. after Solve returns) stay on device
-        # behind a one-shot lazy batched fetch: at 50k pods they are ~7MB on
-        # a tunnel that moves ~10MB/s, roughly half the warm fetch time.
-        # bulk_take rides as int16 when every pod capacity fits (counts are
-        # bounded by a slot's 'pods' allocatable), halving the largest leaf.
+        # unused headroom — at 50k pods this cuts the fetch ~10x). Slice
+        # lengths round UP to buckets: each distinct slice shape compiles
+        # its own tiny device program, so exact lengths would pay seconds of
+        # mini-compiles on every new batch outcome.
+        #
+        # The tunnel charges per-ROUND-TRIP latency (~75-150ms at 50k pods
+        # for <1MB of payload), so the steady-state path fetches the result
+        # scalars AND the data slices in ONE device_get, slicing
+        # SPECULATIVELY with the previous solve's bucket sizes; only when a
+        # solve's actual sizes exceed the speculation (rare — buckets are
+        # pow2 round-ups) does it pay the old second round trip.
         pods_idx = snap.resource_names.index("pods")
         pods_cap_max = max(
             float(snap.type_alloc[:, pods_idx].max()) if len(snap.type_alloc) else 0.0,
@@ -767,24 +758,67 @@ class TPUSolver:
             else 0.0,
         )
         bulk_dtype = jnp.int16 if pods_cap_max < 32767 else jnp.int32
-        sliced = (
-            {k: log[k][:ptr_b] for k in ("item", "slot", "ns", "k", "k_last")},
-            log["bulk_take"][:bulk_b].astype(bulk_dtype),
-            {
-                f: getattr(state, f)[:nopen_b]
-                for f in ("tmpl", "used", "pods")
-            },
-        )
-        # the lazy planes pack+slice ON DEVICE now (async dispatch) so only
-        # ~3MB of packed bits stay pinned, not the full state pytree
+
+        def _sliced(ptr_b, bulk_b, nopen_b):
+            # bulk_take rides as int16 when every pod capacity fits (counts
+            # are bounded by a slot's 'pods' allocatable), halving the
+            # largest leaf. Lazy planes (tmask/allow/out/defined — read by
+            # SolvedMachine.requirements()/instance_type_options() AFTER
+            # Solve returns) pack+slice ON DEVICE (async dispatch) so only
+            # ~3MB of packed bits stay pinned, and defer to a one-shot
+            # batched fetch on first access.
+            eager = (
+                {k: log[k][:ptr_b] for k in ("item", "slot", "ns", "k", "k_last")},
+                log["bulk_take"][:bulk_b].astype(bulk_dtype),
+                {f: getattr(state, f)[:nopen_b] for f in ("tmpl", "used", "pods")},
+            )
+            lazy = {
+                f: jnp.packbits(getattr(state, f)[:nopen_b], axis=-1)
+                for f in _SlotState._LAZY
+            }
+            return eager, lazy
+
+        from karpenter_core_tpu.solver.encode import bucket_pow2
+
+        def _buckets(ptr_i, nopen, bulk_n):
+            return (
+                min(bucket_pow2(max(ptr_i, 1), 1024), log["item"].shape[0]),
+                min(bucket_pow2(max(bulk_n, 1), 1024), log["bulk_take"].shape[0]),
+                min(bucket_pow2(max(nopen, 1), 1024), state.tmpl.shape[0]),
+            )
+
         lazy_widths = {f: getattr(state, f).shape[1] for f in _SlotState._LAZY}
-        lazy_packed = {
-            f: jnp.packbits(getattr(state, f)[:nopen_b], axis=-1)
-            for f in _SlotState._LAZY
-        }
-        # ONE batched device_get — per-transfer link latency dominates the
-        # fetch when every leaf round-trips separately
-        log_h, bulk_take, state_d = jax.device_get(sliced)
+        spec_bk = self._fetch_buckets.get(key)
+        fused = spec_bk is not None
+        if fused:
+            sliced, lazy_packed = _sliced(*spec_bk)
+            (ptr_i, nopen, bulk_n), (log_h, bulk_take, state_d) = jax.device_get(
+                ((ptr, state.nopen, log["bulk_n"]), sliced)
+            )
+        else:
+            ptr_i, nopen, bulk_n = jax.device_get((ptr, state.nopen, log["bulk_n"]))
+        # dispatch -> first readback ≈ device execution time for this solve
+        # (observability; on the fused path this includes the eager-slice
+        # transfer, which the single-RT design makes inseparable)
+        self.last_device_ms = (_time.perf_counter() - t_dispatch) * 1e3
+        _mark("device")
+        ptr_i, nopen, bulk_n = int(ptr_i), int(nopen), int(bulk_n)
+        need_bk = _buckets(ptr_i, nopen, bulk_n)
+        # keep the speculation MONOTONE (max with the previous buckets):
+        # storing the exact need would ping-pong on workloads oscillating
+        # across a pow2 boundary — every step-up solve would pay the wasted
+        # fused transfer plus the old second round trip. Over-fetch is
+        # bounded by one bucket step per axis.
+        self._fetch_buckets[key] = (
+            tuple(max(n, s) for n, s in zip(need_bk, spec_bk))
+            if spec_bk is not None
+            else need_bk
+        )
+        if not fused or any(n > s for n, s in zip(need_bk, spec_bk)):
+            # speculation miss (or first solve at this geometry): fetch the
+            # correctly-sized slices in a second round trip
+            sliced, lazy_packed = _sliced(*need_bk)
+            log_h, bulk_take, state_d = jax.device_get(sliced)
         log_h["bulk_take"] = bulk_take
         log_h["bulk_n"] = bulk_n
         state_h = _SlotState(state_d, lazy_packed, lazy_widths)

@@ -1,0 +1,32 @@
+"""Long-lived-server CPython GC tuning, shared by the operator process and
+the benchmark.
+
+The solver's decode allocates tens of thousands of short-lived objects per
+Solve (pod lists, SolvedMachines); with default thresholds a gen-2
+collection eventually lands INSIDE a solve and pauses decode for
+100-300 ms — the dominant p50->p99 source once encode is pipelined off the
+critical path. The standard server remedy (applied by e.g. Instagram's and
+many asyncio deployments) is to freeze the warmed baseline out of collector
+scans and widen gen-2's threshold; garbage from each reconcile loop is
+still collected promptly by gen-0/1.
+
+The reference sets a GOGC-equivalent soft memory limit at operator start
+(operator.go:84-88 via --memory-limit); this is the CPython analog.
+"""
+import gc
+
+_applied = False
+
+
+def apply_server_gc_tuning(gen2_threshold: int = 100) -> None:
+    """Freeze the current (warmed) object graph into the permanent
+    generation and widen gen-2's collection threshold. Call AFTER process
+    warmup — imports done, compiled-program caches populated — so the
+    frozen set covers the long-lived baseline. Idempotent."""
+    global _applied
+    gc.collect()
+    gc.freeze()
+    if not _applied:
+        a0, a1, _ = gc.get_threshold()
+        gc.set_threshold(a0, a1, gen2_threshold)
+        _applied = True

@@ -377,3 +377,23 @@ def test_inflight_stuck_termination_names_pdb(env):
     assert any("guard" in e.message for e in events), (
         f"expected the blocking PDB to be named: {[e.message for e in events]}"
     )
+
+
+def test_server_gc_tuning_idempotent():
+    """utils/gctuning.py: gen-2 threshold widened once; freeze applied;
+    repeat calls don't re-shrink or error (operator + solver service + bench
+    all call it)."""
+    import gc
+
+    from karpenter_core_tpu.utils.gctuning import apply_server_gc_tuning
+
+    before = gc.get_threshold()
+    try:
+        apply_server_gc_tuning(gen2_threshold=123)
+        a0, a1, g2 = gc.get_threshold()
+        assert (a0, a1) == before[:2]
+        apply_server_gc_tuning(gen2_threshold=456)  # idempotent: no re-apply
+        assert gc.get_threshold()[2] == g2
+    finally:
+        gc.set_threshold(*before)
+        gc.unfreeze()
