@@ -277,9 +277,12 @@ def make_daemonset(
     name: Optional[str] = None,
     namespace: str = "default",
     requests: Optional[Dict[str, object]] = None,
+    limits: Optional[Dict[str, object]] = None,
     node_selector: Optional[Dict[str, str]] = None,
     tolerations: Optional[List[Toleration]] = None,
     node_affinity_required: Optional[List[NodeSelectorTerm]] = None,
+    init_requests: Optional[Dict[str, object]] = None,
+    init_limits: Optional[Dict[str, object]] = None,
 ) -> "DaemonSet":
     """test.DaemonSet analog: carries the pod template the scheduler uses for
     per-template daemon overhead (reference pkg/test/daemonsets.go)."""
@@ -289,9 +292,12 @@ def make_daemonset(
     # test.DaemonSet(PodOptions) shape) so the two builders cannot drift
     template = make_pod(
         requests=requests,
+        limits=limits,
         node_selector=node_selector,
         tolerations=tolerations,
         node_affinity_required=node_affinity_required,
+        init_requests=init_requests,
+        init_limits=init_limits,
         unschedulable=False,
     ).spec
     return DaemonSet(

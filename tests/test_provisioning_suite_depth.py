@@ -1,0 +1,529 @@
+"""Port of reference pkg/controllers/provisioning/suite_test.go — the spec
+families the condensed suite doesn't pin: supported node selectors,
+accelerators, pods-capacity packing, deleting-node exclusion, the Resource
+Limits context, daemonset overhead edge cases (startup taints, limit
+defaulting, init containers), invalid-PVC tolerance, volume-zone
+compatibility, preferential fallback order, and multi-provisioner
+selection. Cited line numbers refer to
+/root/reference/pkg/controllers/provisioning/suite_test.go.
+"""
+import pytest
+
+from karpenter_core_tpu.api import labels as api_labels
+from karpenter_core_tpu.cloudprovider import fake
+from karpenter_core_tpu.kube.objects import (
+    LABEL_ARCH_STABLE,
+    LABEL_INSTANCE_TYPE_STABLE,
+    LABEL_OS_STABLE,
+    LABEL_TOPOLOGY_ZONE,
+    NodeSelectorRequirement,
+    NodeSelectorTerm,
+    PreferredSchedulingTerm,
+    Taint,
+)
+from karpenter_core_tpu.testing import (
+    make_daemonset,
+    make_node,
+    make_pod,
+    make_provisioner,
+    make_pv,
+    make_pvc,
+    make_storage_class,
+    pvc_volume,
+)
+from karpenter_core_tpu.testing.expectations import Env
+
+
+@pytest.fixture()
+def env():
+    return Env()  # fake.default_universe(), like the reference suite
+
+
+def req(key, op, *values):
+    return NodeSelectorRequirement(key=key, operator=op, values=list(values))
+
+
+def chosen_capacity(env, pod):
+    node = env.expect_scheduled(pod)
+    name = node.metadata.labels[LABEL_INSTANCE_TYPE_STABLE]
+    return next(it.capacity for it in env.universe if it.name == name)
+
+
+# -- node selector support (suite_test.go:122-161) --------------------------
+
+
+def test_supported_node_selectors_schedulable(env):
+    """suite_test.go:122-155 — selectors over well-known labels the
+    provisioner/universe can satisfy all schedule."""
+    prov = make_provisioner(name="default")
+    env.expect_applied(prov)
+    schedulable = [
+        make_pod(node_selector={api_labels.PROVISIONER_NAME_LABEL_KEY: prov.metadata.name}),
+        make_pod(node_selector={LABEL_TOPOLOGY_ZONE: "test-zone-1"}),
+        make_pod(node_selector={LABEL_INSTANCE_TYPE_STABLE: "default-instance-type"}),
+        make_pod(node_selector={LABEL_ARCH_STABLE: "arm64"}),
+        make_pod(node_selector={LABEL_OS_STABLE: "linux"}),
+    ]
+    env.expect_provisioned(*schedulable)
+    for pod in schedulable:
+        env.expect_scheduled(pod)
+
+
+def test_unsupported_node_selectors_not_scheduled(env):
+    """suite_test.go:136-148,156-159 — unknown values for well-known labels
+    (or undefined custom labels) never schedule."""
+    env.expect_applied(make_provisioner(name="default"))
+    unschedulable = [
+        make_pod(node_selector={api_labels.PROVISIONER_NAME_LABEL_KEY: "unknown"}),
+        make_pod(node_selector={LABEL_TOPOLOGY_ZONE: "unknown"}),
+        make_pod(node_selector={LABEL_INSTANCE_TYPE_STABLE: "unknown"}),
+        make_pod(node_selector={LABEL_ARCH_STABLE: "unknown"}),
+        make_pod(node_selector={LABEL_OS_STABLE: "unknown"}),
+        make_pod(node_selector={api_labels.LABEL_CAPACITY_TYPE: "unknown"}),
+        make_pod(node_selector={"foo": "bar"}),
+    ]
+    env.expect_provisioned(*unschedulable)
+    for pod in unschedulable:
+        env.expect_not_scheduled(pod)
+
+
+def test_provisions_nodes_for_accelerators(env):
+    """suite_test.go:162-176 — extended-resource requests pick the gpu
+    instance types."""
+    env.expect_applied(make_provisioner(name="default"))
+    pod_a = make_pod(limits={fake.RESOURCE_GPU_VENDOR_A: "1"})
+    pod_b = make_pod(limits={fake.RESOURCE_GPU_VENDOR_B: "1"})
+    env.expect_provisioned(pod_a, pod_b)
+    env.expect_scheduled(pod_a)
+    env.expect_scheduled(pod_b)
+
+
+def test_pods_capacity_forces_one_node_per_pod(env):
+    """suite_test.go:177-200 — the scheduler relies on the instance type's
+    "pods" capacity (maxPods is the vendor's input to it): three pods on
+    single-pod-instance-type need three nodes."""
+    env.expect_applied(
+        make_provisioner(
+            name="default",
+            requirements=[req(LABEL_INSTANCE_TYPE_STABLE, "In", "single-pod-instance-type")],
+        )
+    )
+    pods = [make_pod(), make_pod(), make_pod()]
+    env.expect_provisioned(*pods)
+    nodes = set()
+    for pod in pods:
+        nodes.add(env.expect_scheduled(pod).metadata.name)
+    assert len(nodes) == 3
+
+
+def test_deleting_node_excluded_from_scheduling(env):
+    """suite_test.go:201-240 — a node whose deletion is in flight (finalizer
+    holds it) is not a scheduling target; new pods get a new node."""
+    prov = make_provisioner(name="default")
+    its = env.cloud_provider.get_instance_types(prov)
+    node = make_node(
+        labels={
+            api_labels.PROVISIONER_NAME_LABEL_KEY: prov.metadata.name,
+            LABEL_INSTANCE_TYPE_STABLE: its[0].name,
+        },
+        capacity=dict(its[0].capacity),
+    )
+    node.metadata.finalizers.append(api_labels.TERMINATION_FINALIZER)
+    env.expect_applied(node, prov)
+    for _ in range(3):
+        pod = make_pod()
+        env.expect_applied(pod)
+        env.expect_manual_binding(pod, node)
+    env.kube.delete(node)  # finalizer keeps it terminating
+    live = env.kube.get("Node", "", node.metadata.name)
+    assert live is not None and live.metadata.deletion_timestamp is not None
+    bindings = env.expect_provisioned_no_binding(make_pod(), make_pod())
+    for n in bindings.values():
+        assert n is not None and n.metadata.name != node.metadata.name
+
+
+# -- Resource Limits (suite_test.go:241-369) --------------------------------
+
+
+def test_limits_already_exceeded_blocks_launch(env):
+    """suite_test.go:241-253 — status.resources over the limit blocks the
+    machine launch."""
+    prov = make_provisioner(name="default", limits={"cpu": "20"})
+    prov.status.resources = {"cpu": 100.0}
+    env.expect_applied(prov)
+    pod = make_pod()
+    env.expect_provisioned(pod)
+    env.expect_not_scheduled(pod)
+
+
+def test_limits_met_schedules(env):
+    """suite_test.go:254-268."""
+    env.expect_applied(make_provisioner(name="default", limits={"cpu": "2"}))
+    pod = make_pod(requests={"cpu": "1.75"})
+    env.expect_provisioned(pod)
+    env.expect_scheduled(pod)
+
+
+def test_limits_partially_schedule(env):
+    """suite_test.go:269-314 — cpu limit 3 and hostname anti-affinity force
+    exactly one of two 1.5-cpu pods to schedule."""
+    env.expect_applied(make_provisioner(name="default", limits={"cpu": "3"}))
+    from karpenter_core_tpu.kube.objects import (
+        LABEL_HOSTNAME,
+        LabelSelector,
+        PodAffinityTerm,
+    )
+
+    def pod():
+        return make_pod(
+            labels={"app": "foo"},
+            requests={"cpu": "1.5"},
+            pod_anti_affinity_required=[
+                PodAffinityTerm(
+                    topology_key=LABEL_HOSTNAME,
+                    label_selector=LabelSelector(match_labels={"app": "foo"}),
+                )
+            ],
+        )
+
+    pods = [pod(), pod()]
+    env.expect_provisioned(*pods)
+    scheduled = sum(
+        1 for p in pods
+        if env.kube.get("Pod", p.metadata.namespace, p.metadata.name).spec.node_name
+    )
+    assert scheduled == 1
+
+
+def test_limits_exceeded_by_one_pod_blocks(env):
+    """suite_test.go:315-327 — a 2.1-cpu pod can't launch under a 2-cpu
+    limit (every viable node's capacity exceeds the remainder)."""
+    env.expect_applied(make_provisioner(name="default", limits={"cpu": "2"}))
+    pod = make_pod(requests={"cpu": "2.1"})
+    env.expect_provisioned(pod)
+    env.expect_not_scheduled(pod)
+
+
+def test_limits_exceeded_gpu_pods_capacity(env):
+    """suite_test.go:328-341 — pods-capacity limit of 1: the only gpu
+    instance type carries a 5-pod capacity, which would exceed it."""
+    env.expect_applied(make_provisioner(name="default", limits={"pods": "1"}))
+    pod = make_pod(limits={fake.RESOURCE_GPU_VENDOR_A: "1"})
+    env.expect_provisioned(pod)
+    env.expect_not_scheduled(pod)
+
+
+def test_limits_account_across_scheduling_rounds(env):
+    """suite_test.go:342-369 — round 2 sees round 1's launched capacity
+    (recomputed from cluster state, scheduler.go:244-249) and refuses."""
+    env.expect_applied(make_provisioner(name="default", limits={"cpu": "2"}))
+    first = make_pod(requests={"cpu": "1.75"})
+    env.expect_provisioned(first)
+    env.expect_scheduled(first)
+    second = make_pod(requests={"cpu": "1.75"})
+    env.expect_provisioned(second)
+    env.expect_not_scheduled(second)
+
+
+# -- daemonset overhead edge cases (suite_test.go:388-492) ------------------
+
+
+def test_overhead_counted_despite_startup_taints(env):
+    """suite_test.go:388-409 — startup taints do NOT gate daemonset
+    overhead: the daemon carries no toleration yet still counts."""
+    env.expect_applied(
+        make_provisioner(
+            name="default",
+            startup_taints=[Taint(key="foo.com/taint", effect="NoSchedule")],
+        ),
+        make_daemonset(requests={"cpu": "1", "memory": "1Gi"}),
+    )
+    pod = make_pod(requests={"cpu": "1", "memory": "1Gi"})
+    env.expect_provisioned(pod)
+    cap = chosen_capacity(env, pod)
+    assert cap["cpu"] == 4.0
+    assert cap["memory"] == 4.0 * 2**30
+
+
+def test_overhead_too_large_not_scheduled(env):
+    """suite_test.go:410-419."""
+    env.expect_applied(
+        make_provisioner(name="default"),
+        make_daemonset(requests={"cpu": "10000", "memory": "10000Gi"}),
+    )
+    pod = make_pod()
+    env.expect_provisioned(pod)
+    env.expect_not_scheduled(pod)
+
+
+def test_overhead_requests_default_from_limits(env):
+    """suite_test.go:420-432 — a daemon resource with no request defaults
+    from its limit (memory 10000Gi here), so the overhead is too large."""
+    env.expect_applied(
+        make_provisioner(name="default"),
+        make_daemonset(requests={"cpu": "1"},
+                       limits={"cpu": "10000", "memory": "10000Gi"}),
+    )
+    pod = make_pod()
+    env.expect_provisioned(pod)
+    env.expect_not_scheduled(pod)
+
+
+def test_overhead_max_of_containers_and_init_containers(env):
+    """suite_test.go:433-453 — daemon overhead is the per-resource max of
+    the container requests and init-container requests (with limit
+    defaulting): max(cpu 2, cpu 1)=2, max(mem 1Gi, mem 2Gi)=2Gi fits the
+    4-cpu/4Gi default instance type."""
+    env.expect_applied(
+        make_provisioner(name="default"),
+        make_daemonset(
+            requests={"cpu": "2"},
+            limits={"cpu": "2", "memory": "1Gi"},
+            init_requests={"cpu": "1"},
+            init_limits={"cpu": "10000", "memory": "2Gi"},
+        ),
+    )
+    pod = make_pod()
+    env.expect_provisioned(pod)
+    cap = chosen_capacity(env, pod)
+    assert cap["cpu"] == 4.0
+    assert cap["memory"] == 4.0 * 2**30
+
+
+def test_overhead_combined_max_too_large(env):
+    """suite_test.go:454-471 — container memory defaults from its 1Gi limit
+    but the init memory defaults from a 10000Gi limit; the combined max
+    fits nothing."""
+    env.expect_applied(
+        make_provisioner(name="default"),
+        make_daemonset(
+            requests={"cpu": "1"},
+            limits={"cpu": "10000", "memory": "1Gi"},
+            init_requests={"cpu": "1"},
+            init_limits={"cpu": "10000", "memory": "10000Gi"},
+        ),
+    )
+    pod = make_pod()
+    env.expect_provisioned(pod)
+    env.expect_not_scheduled(pod)
+
+
+def test_overhead_init_container_too_large(env):
+    """suite_test.go:472-484."""
+    env.expect_applied(
+        make_provisioner(name="default"),
+        make_daemonset(init_requests={"cpu": "10000", "memory": "10000Gi"}),
+    )
+    pod = make_pod()
+    env.expect_provisioned(pod)
+    env.expect_not_scheduled(pod)
+
+
+def test_daemonset_without_resources_schedulable(env):
+    """suite_test.go:485-492."""
+    env.expect_applied(make_provisioner(name="default"), make_daemonset())
+    pod = make_pod()
+    env.expect_provisioned(pod)
+    env.expect_scheduled(pod)
+
+
+# -- invalid PVCs and volume zones (suite_test.go:919-973, 1010-1058) -------
+
+
+def test_invalid_pvc_not_scheduled(env):
+    """suite_test.go:919-926 — a pod referencing a non-existent claim can't
+    schedule."""
+    env.expect_applied(make_provisioner(name="default"))
+    pod = make_pod()
+    pod.spec.volumes.append(pvc_volume("invalid"))
+    env.expect_provisioned(pod)
+    env.expect_not_scheduled(pod)
+
+
+def test_empty_storage_class_schedules(env):
+    """suite_test.go:927-936 — storageClassName: "" (pre-provisioned PV
+    binding) adds no zone requirement and schedules."""
+    env.expect_applied(make_provisioner(name="default"),
+                       make_pvc("empty-sc-claim", storage_class=""))
+    pod = make_pod()
+    pod.spec.volumes.append(pvc_volume("empty-sc-claim"))
+    env.expect_provisioned(pod)
+    env.expect_scheduled(pod)
+
+
+@pytest.mark.parametrize(
+    "claim, claim_kwargs",
+    [
+        ("missing", None),  # the claim object itself doesn't exist
+        ("bad-sc-claim", {"storage_class": "invalid-storage-class"}),
+        ("bad-vol-claim", {"volume_name": "invalid-volume-name"}),
+    ],
+    ids=["pvc", "storage-class", "volume-name"],
+)
+def test_valid_pods_schedule_next_to_invalid_pvc_pod(env, claim, claim_kwargs):
+    """suite_test.go:937-973 — one pod's broken volume chain (missing claim
+    / storage class / volume) doesn't poison the batch."""
+    env.expect_applied(make_provisioner(name="default"))
+    if claim_kwargs is not None:
+        env.expect_applied(make_pvc(claim, **claim_kwargs))
+    invalid_pod = make_pod()
+    invalid_pod.spec.volumes.append(pvc_volume(claim))
+    env.expect_provisioned(invalid_pod)
+    pod = make_pod()
+    env.expect_provisioned(pod)
+    env.expect_not_scheduled(invalid_pod)
+    env.expect_scheduled(pod)
+
+
+def test_bound_volume_zone_incompatible_not_scheduled(env):
+    """suite_test.go:1010-1022 — pod zone requirement conflicts with the
+    bound PV's zone."""
+    env.expect_applied(
+        make_provisioner(name="default"),
+        make_storage_class("sc", "fake.csi"),
+        make_pv("zone3-pv", zones=["test-zone-3"], storage_class="sc"),
+        make_pvc("zone3-claim", storage_class="sc", volume_name="zone3-pv"),
+    )
+    pod = make_pod(
+        node_affinity_required=[
+            NodeSelectorTerm(
+                match_expressions=[req(LABEL_TOPOLOGY_ZONE, "In", "test-zone-1")]
+            )
+        ]
+    )
+    pod.spec.volumes.append(pvc_volume("zone3-claim"))
+    env.expect_provisioned(pod)
+    env.expect_not_scheduled(pod)
+
+
+def test_volume_zone_requirement_not_relaxed_away(env):
+    """suite_test.go:1023-1058 — the injected volume zone requirement is
+    ANDed into EVERY OR'd node-selector term, so relaxing the unsatisfiable
+    first term cannot drop it."""
+    env.expect_applied(
+        make_provisioner(name="default"),
+        make_storage_class("sc", "fake.csi"),
+        make_pv("zone3-pv", zones=["test-zone-3"], storage_class="sc"),
+        make_pvc("zone3-claim", storage_class="sc", volume_name="zone3-pv"),
+    )
+    pod = make_pod(
+        node_affinity_required=[
+            NodeSelectorTerm(
+                match_expressions=[req("example.com/label", "In", "unsupported")]
+            ),
+            NodeSelectorTerm(
+                match_expressions=[
+                    req(api_labels.LABEL_CAPACITY_TYPE, "In",
+                        api_labels.CAPACITY_TYPE_ON_DEMAND)
+                ]
+            ),
+        ]
+    )
+    pod.spec.volumes.append(pvc_volume("zone3-claim"))
+    env.expect_provisioned(pod)
+    node = env.expect_scheduled(pod)
+    assert node.metadata.labels[LABEL_TOPOLOGY_ZONE] == "test-zone-3"
+
+
+# -- preferential fallback order (suite_test.go:1140-1163) ------------------
+
+
+def test_prefer_no_schedule_tolerated_after_affinity_relaxation(env):
+    """suite_test.go:1140-1163 — both invalid preferred terms are relaxed,
+    then the PreferNoSchedule taint is tolerated; the node carries it."""
+    env.expect_applied(
+        make_provisioner(
+            name="default",
+            taints=[Taint(key="foo", value="bar", effect="PreferNoSchedule")],
+        )
+    )
+    pod = make_pod(
+        node_affinity_preferred=[
+            PreferredSchedulingTerm(
+                weight=1,
+                preference=NodeSelectorTerm(
+                    match_expressions=[req(LABEL_TOPOLOGY_ZONE, "In", "invalid")]
+                ),
+            ),
+            PreferredSchedulingTerm(
+                weight=1,
+                preference=NodeSelectorTerm(
+                    match_expressions=[req(LABEL_INSTANCE_TYPE_STABLE, "In", "invalid")]
+                ),
+            ),
+        ]
+    )
+    env.expect_provisioned(pod)
+    node = env.expect_scheduled(pod)
+    assert any(
+        t.key == "foo" and t.value == "bar" and t.effect == "PreferNoSchedule"
+        for t in node.spec.taints
+    )
+
+
+# -- multiple provisioners (suite_test.go:1164-1213) ------------------------
+
+
+def test_schedules_to_explicitly_selected_provisioner(env):
+    """suite_test.go:1164-1171."""
+    target = make_provisioner(name="target")
+    env.expect_applied(target, make_provisioner(name="other"))
+    pod = make_pod(
+        node_selector={api_labels.PROVISIONER_NAME_LABEL_KEY: "target"}
+    )
+    env.expect_provisioned(pod)
+    node = env.expect_scheduled(pod)
+    assert node.metadata.labels[api_labels.PROVISIONER_NAME_LABEL_KEY] == "target"
+
+
+def test_schedules_to_provisioner_by_labels(env):
+    """suite_test.go:1172-1179."""
+    target = make_provisioner(name="labeled", labels={"foo": "bar"})
+    env.expect_applied(target, make_provisioner(name="other"))
+    pod = make_pod(node_selector={"foo": "bar"})
+    env.expect_provisioned(pod)
+    node = env.expect_scheduled(pod)
+    assert node.metadata.labels[api_labels.PROVISIONER_NAME_LABEL_KEY] == "labeled"
+
+
+def test_prefer_no_schedule_provisioner_deprioritized(env):
+    """suite_test.go:1180-1188 — an untainted provisioner wins over one with
+    a PreferNoSchedule taint."""
+    tainted = make_provisioner(
+        name="tainted",
+        taints=[Taint(key="foo", value="bar", effect="PreferNoSchedule")],
+    )
+    env.expect_applied(tainted, make_provisioner(name="clean"))
+    pod = make_pod()
+    env.expect_provisioned(pod)
+    node = env.expect_scheduled(pod)
+    assert node.metadata.labels[api_labels.PROVISIONER_NAME_LABEL_KEY] != "tainted"
+
+
+def test_highest_weight_provisioner_always_wins(env):
+    """suite_test.go:1189-1204."""
+    env.expect_applied(
+        make_provisioner(name="unweighted"),
+        make_provisioner(name="w20", weight=20),
+        make_provisioner(name="w100", weight=100),
+    )
+    pods = [make_pod(), make_pod(), make_pod()]
+    env.expect_provisioned(*pods)
+    for pod in pods:
+        node = env.expect_scheduled(pod)
+        assert node.metadata.labels[api_labels.PROVISIONER_NAME_LABEL_KEY] == "w100"
+
+
+def test_explicit_selection_beats_weight(env):
+    """suite_test.go:1205-1213."""
+    env.expect_applied(
+        make_provisioner(name="targeted"),
+        make_provisioner(name="w20", weight=20),
+        make_provisioner(name="w100", weight=100),
+    )
+    pod = make_pod(
+        node_selector={api_labels.PROVISIONER_NAME_LABEL_KEY: "targeted"}
+    )
+    env.expect_provisioned(pod)
+    node = env.expect_scheduled(pod)
+    assert node.metadata.labels[api_labels.PROVISIONER_NAME_LABEL_KEY] == "targeted"
