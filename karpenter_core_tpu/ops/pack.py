@@ -98,6 +98,19 @@ def make_pack_kernel(
     zlo, zhi = zone_seg
     clo, chi = ct_seg
     has_topo = topo_meta is not None and len(topo_meta.groups) > 0
+    # machine-region bulk fill: when the batch carries hostname anti-affinity
+    # groups (slot-local — the domain IS the node), their classes stay bulk
+    # (solver/encode._build_items) and the bulk-fill region widens from the
+    # existing prefix to the FULL slot axis, with exact per-slot type
+    # narrowing for machine rows. Without this, each of a service's
+    # one-replica-per-node pods pays one while-iteration (a ~310-replica
+    # hostname-anti service = ~310 candidate commits); with it the whole
+    # class commits in one iteration. Geometries without hostname anti
+    # compile the exact same program as before.
+    mach_bulk = has_topo and any(
+        gm.gtype == topo.TOPO_ANTI and gm.is_hostname
+        for gm in topo_meta.groups
+    )
     # value-key spread groups: bulk items owning one are packed by a
     # per-iteration water-fill domain allocation (greedy argmin-count per pod
     # equalizes domain counts, so the bulk final state matches per-pod greedy)
@@ -231,6 +244,83 @@ def make_pack_kernel(
         valid = jnp.all((alloc >= 0.0) & ((room >= 0.0) | safe), axis=-1)
         return jnp.where(valid, kmin, 0)
 
+    def replica_cap_rows(alloc, used_rows, req):
+        """replica_cap vectorized over slot rows: alloc [T, R] vs per-slot
+        used [BR, R] + k*req [R] -> [BR, T] max identical replicas, with the
+        same exact-fit float corrections and validity rules. Looped over the
+        (small, static) resource axis so the peak temp stays [BR, T]."""
+        BRr = used_rows.shape[0]
+        T = alloc.shape[0]
+        bigf = jnp.float32(BIGK)
+        kmin = jnp.full((BRr, T), bigf)
+        valid = jnp.ones((BRr, T), dtype=bool)
+        for r in range(alloc.shape[1]):
+            alloc_r = alloc[None, :, r]  # [1, T]
+            room = alloc_r - used_rows[:, r : r + 1]  # [BR, T]
+            reqr = req[r]
+            safe = reqr > 0
+            denom = jnp.where(safe, reqr, 1.0)
+            kf = jnp.clip(jnp.floor(room / denom), 0.0, bigf)
+            kf = jnp.where((kf + 1.0) * denom <= room, kf + 1.0, kf)
+            kf = jnp.where(kf * denom > room, kf - 1.0, kf)
+            kr = jnp.where(safe, jnp.clip(kf, 0.0, bigf), bigf)
+            kmin = jnp.minimum(kmin, kr)
+            valid &= (alloc_r >= 0.0) & ((room >= 0.0) | safe)
+        return jnp.where(valid, kmin, 0.0).astype(jnp.int32)
+
+    def mach_rows_types_compat(m_allow_rows, m_out_rows, m_def_rows,
+                               base_tmask_rows, type_reqs, type_offering_ok):
+        """merged_types_compat vectorized over slot rows: [BR, T] bool of
+        requirement/offering-surviving types per merged row (compatible ∧
+        hasOffering, machine.go:137-159). The hostname tail beyond the
+        screen width is exact to skip: instance types never define those
+        keys, so every such key term resolves through ~shared."""
+        V_full = m_allow_rows.shape[1]
+        svv = _sv(V_full)
+        a = m_allow_rows[:, :svv]
+        t_allow = type_reqs["allow"][:, :svv]
+        if mxu:
+            esc = compat.escape_flags_m(
+                a, m_out_rows, m_def_rows, _seg_mat(V_full)
+            )
+        else:
+            esc = compat.escape_flags(
+                m_allow_rows, m_out_rows, m_def_rows, segments
+            )
+        ok = jnp.ones(
+            (m_allow_rows.shape[0], t_allow.shape[0]), dtype=bool
+        )
+        for k, (lo, hi) in enumerate(segments):
+            if lo >= svv:
+                continue
+            hi_s = min(hi, svv)
+            shared = m_def_rows[:, k : k + 1] & type_reqs["defined"][None, :, k]
+            both_out = m_out_rows[:, k : k + 1] & type_reqs["out"][None, :, k]
+            if hi_s > lo:
+                inter = (
+                    jnp.matmul(
+                        a[:, lo:hi_s].astype(jnp.bfloat16),
+                        t_allow[:, lo:hi_s].astype(jnp.bfloat16).T,
+                        preferred_element_type=jnp.float32,
+                    )
+                    > 0.5
+                )
+                nonempty = both_out | inter
+            else:
+                nonempty = both_out
+            escapes = esc[:, k : k + 1] & type_reqs["escape"][None, :, k]
+            ok &= (~shared) | nonempty | escapes
+        offer = (
+            jnp.einsum(
+                "tzc,nz,nc->nt",
+                type_offering_ok.astype(jnp.float32),
+                m_allow_rows[:, zlo:zhi].astype(jnp.float32),
+                m_allow_rows[:, clo:chi].astype(jnp.float32),
+            )
+            > 0.5
+        )
+        return base_tmask_rows & ok & offer
+
     def _topo_skip(V, K):
         """The exact tuple topo_narrow_single returns when no group
         owns/selects the item: (viable, narrow[V], applied_keys[K], k_cap).
@@ -360,7 +450,28 @@ def make_pack_kernel(
         # the final state) skips every log write AND the log-space gating,
         # so the bulk fast path runs with a 1-row take matrix.
         EB = n_exist
-        LB = (min(2 * I + V + 64, 4096) if log_commits else 1) if EB > 0 else 1
+        # bulk-fill region: the existing prefix, widened to the full slot
+        # axis when the geometry admits machine-region bulk items
+        BR = N if mach_bulk else EB
+        if BR == 0:
+            LB = 1
+        elif not log_commits:
+            LB = 1
+        elif mach_bulk:
+            # take rows are per bulk COMMIT: <=~2 per plain bulk item
+            # (fill + post-open leftovers) plus one per water-fill domain
+            # round of vk-spread items (<= their total seg width); the old
+            # V-based slack would blow the [LB, N] matrix up at wide-
+            # dictionary geometries. Overflow falls back to the per-slot
+            # path (identical result).
+            spread_w = sum(
+                gm.seg[1] - gm.seg[0]
+                for gm in topo_meta.groups
+                if gm.gtype == topo.TOPO_SPREAD and not gm.is_hostname
+            )
+            LB = min(3 * I + spread_w + 64, 2048)
+        else:
+            LB = min(2 * I + V + 64, 4096)
 
         log0 = {
             "item": jnp.full(L, -1, jnp.int32),
@@ -368,7 +479,7 @@ def make_pack_kernel(
             "ns": jnp.zeros(L, jnp.int32),
             "k": jnp.zeros(L, jnp.int32),
             "k_last": jnp.zeros(L, jnp.int32),
-            "bulk_take": jnp.zeros((LB, EB), jnp.int32),
+            "bulk_take": jnp.zeros((LB, BR), jnp.int32),
             "bulk_n": jnp.int32(0),
         }
 
@@ -682,6 +793,15 @@ def make_pack_kernel(
                 )
             else:
                 item_bulk_ok = jnp.bool_(EB > 0)
+            # machine-region bulk eligibility: every group involving the
+            # item must be slot-local (hostname anti/inverse) or
+            # recording-only — see topo_mach_bulk_item_ok
+            if mach_bulk:
+                mach_ok_i = topo.topo_mach_bulk_item_ok(
+                    topo_meta, prow["topo_own"], prow["topo_sel"]
+                )
+            else:
+                mach_ok_i = jnp.bool_(False)
 
             # -- candidate branch: verify best slot, commit k replicas ----
             def do_candidate(args):
@@ -751,26 +871,33 @@ def make_pack_kernel(
                 score = score.at[n].set(jnp.where(retire, BIG, score[n]))
                 return state, log, ptr, remaining, score, jnp.bool_(False), dead
 
-            # -- bulk existing fill: ALL gated existing candidates in one
-            # iteration (the reference tries existing nodes in index order
-            # per pod, scheduler.go:179-185 — identical replicas filling in
-            # index order under per-slot caps reproduce it exactly). Without
-            # this, a 1000-node cluster costs one while-iteration per slot
-            # per item.
+            # -- bulk fill: ALL gated candidates in one iteration (the
+            # reference tries existing nodes in index order per pod,
+            # scheduler.go:179-185 — identical replicas filling in index
+            # order under per-slot caps reproduce it exactly). Without this,
+            # a 1000-node cluster costs one while-iteration per slot per
+            # item. With mach_bulk the region widens to the full slot axis
+            # and takes follow the score order (existing first by index,
+            # then machines ascending pod count — the do_candidate order),
+            # with exact per-slot type narrowing for machine rows.
             def do_bulk(args):
-                # every tensor here is restricted to the EXISTING prefix
-                # [:EB] — existing slots are the only bulk targets, and the
-                # machine-slot tail [EB, N) would otherwise multiply every
-                # op's cost ~N/EB-fold
+                # every tensor here is restricted to the bulk region [:BR] —
+                # the existing prefix unless the geometry admits machine-
+                # region bulk items; a machine-slot tail would otherwise
+                # multiply every op's cost ~N/EB-fold for nothing
                 carry, force, cap, gate, _dmark = args
                 state, log, ptr, remaining, score, _, dead = carry
-                sa = state.allow[:EB]
-                cands = (score[:EB] < BIG) & gate[:EB] & state.is_existing[:EB]
+                sa = state.allow[:BR]
+                cands = (score[:BR] < BIG) & gate[:BR] & (
+                    state.is_existing[:BR]
+                    if not mach_bulk
+                    else (state.is_existing[:BR] | mach_ok_i)
+                )
                 if has_topo:
                     # topology-free items (the bulk of a real batch) skip the
                     # whole group evaluation through one cond
                     any_topo = any_topo_i
-                    thost_e = state.thost[:, :EB] if has_topo else None
+                    thost_e = state.thost[:, :BR] if has_topo else None
 
                     def topo_eval(_):
                         viable = topo.topo_screen(
@@ -795,79 +922,158 @@ def make_pack_kernel(
 
                     def topo_skip(_):
                         return (
-                            jnp.ones(EB, dtype=bool),
+                            jnp.ones(BR, dtype=bool),
                             jnp.ones(V, dtype=bool),
                             jnp.zeros(K, dtype=bool),
-                            jnp.full(EB, BIGK, dtype=jnp.int32),
+                            jnp.full(BR, BIGK, dtype=jnp.int32),
                         )
 
                     viable, narrow, applied_keys, k_topo_e = jax.lax.cond(
                         any_topo, topo_eval, topo_skip, None
                     )
                 else:
-                    viable = jnp.ones(EB, dtype=bool)
+                    viable = jnp.ones(BR, dtype=bool)
                     narrow = jnp.ones(V, dtype=bool)
                     applied_keys = jnp.zeros(K, dtype=bool)
-                    k_topo_e = jnp.full(EB, BIGK, dtype=jnp.int32)
+                    k_topo_e = jnp.full(BR, BIGK, dtype=jnp.int32)
 
+                m_allow_rows = sa & (prow["allow"] & narrow)[None, :]
+                m_out_rows = state.out[:BR] & prow["out"][None, :] & ~applied_keys[None, :]
+                m_def_rows = (
+                    state.defined[:BR] | prow["defined"][None, :] | applied_keys[None, :]
+                )
+
+                # existing-prefix capacity only when mach_bulk (the machine
+                # tail gets exact per-type caps below; computing k_e over it
+                # would be dead work every iteration)
+                KEW = EB if mach_bulk else BR
                 k_e = replica_cap(
-                    state.cap[:EB], state.used[:EB], prow["requests"]
-                )  # [EB]
+                    state.cap[:KEW], state.used[:KEW], prow["requests"]
+                )  # [KEW]
+                if mach_bulk:
+                    # exact surviving-type computation for MACHINE rows only
+                    # — the bulk analog of verify_slot (merged_types_compat +
+                    # per-type replica caps, machine.go:137-159), vectorized
+                    # over the static machine slice [EB, BR): the existing
+                    # prefix keeps its fixed-capacity k_e and would discard
+                    # these rows anyway. Gated behind eligibility so pure
+                    # existing-prefix fills skip the [MBW, T] work entirely.
+                    MBW = BR - EB
+
+                    def _mach_rows(_):
+                        tmask_c = mach_rows_types_compat(
+                            m_allow_rows[EB:], m_out_rows[EB:],
+                            m_def_rows[EB:],
+                            state.tmask[EB:BR]
+                            & f_static_p[state.tmpl[EB:BR]],
+                            type_reqs, type_offering_ok,
+                        )
+                        kcap_r = replica_cap_rows(
+                            type_alloc, state.used[EB:BR], prow["requests"]
+                        )
+                        return tmask_c, kcap_r
+
+                    def _mach_skip(_):
+                        T = type_alloc.shape[0]
+                        return (
+                            jnp.zeros((MBW, T), dtype=bool),
+                            jnp.zeros((MBW, T), dtype=jnp.int32),
+                        )
+
+                    tmask_rows, kcap_rows = jax.lax.cond(
+                        mach_ok_i, _mach_rows, _mach_skip, None
+                    )
+                    k_mach = jnp.max(
+                        jnp.where(tmask_rows, kcap_rows, 0), axis=-1
+                    )  # [MBW]
+                    k_slot = jnp.concatenate([k_e, k_mach])
+                else:
+                    k_slot = k_e
                 k_eff = jnp.where(
-                    cands & viable, jnp.minimum(k_e, k_topo_e), 0
+                    cands & viable, jnp.minimum(k_slot, k_topo_e), 0
                 )
                 k_eff = jnp.minimum(k_eff, port_k_cap)
                 budget = jnp.minimum(remaining, cap)
-                csum = jnp.cumsum(k_eff)
-                take = jnp.clip(budget - (csum - k_eff), 0, k_eff)
+                if mach_bulk:
+                    # take in score order (existing slots rank below machine
+                    # slots by construction) so a budget smaller than the
+                    # candidate capacity lands on the same slots the
+                    # sequential do_candidate loop would have filled
+                    order = jnp.argsort(jnp.where(k_eff > 0, score[:BR], BIG))
+                    k_ord = k_eff[order]
+                    csum_o = jnp.cumsum(k_ord)
+                    take_o = jnp.clip(budget - (csum_o - k_ord), 0, k_ord)
+                    take = jnp.zeros_like(k_eff).at[order].set(take_o)
+                else:
+                    csum = jnp.cumsum(k_eff)
+                    take = jnp.clip(budget - (csum - k_eff), 0, k_eff)
                 placed = take.sum()
                 bn = log["bulk_n"]
                 do = (placed >= 1) & log_ok(ptr) & (
                     (bn < LB) if log_commits else jnp.bool_(True)
                 )
 
-                m_allow_rows = sa & (prow["allow"] & narrow)[None, :]
-                m_out_rows = state.out[:EB] & prow["out"][None, :] & ~applied_keys[None, :]
-                m_def_rows = (
-                    state.defined[:EB] | prow["defined"][None, :] | applied_keys[None, :]
-                )
                 # unconditional commit with do-predicated takes (see
                 # do_candidate: a state-carrying lax.cond copies the planes)
                 take = jnp.where(do, take, 0)
                 touched = take > 0
                 tm = touched[:, None]
                 state = state._replace(
-                    used=state.used.at[:EB].set(
-                        state.used[:EB]
+                    used=state.used.at[:BR].set(
+                        state.used[:BR]
                         + take[:, None].astype(jnp.float32)
                         * prow["requests"][None, :]
                     ),
-                    pods=state.pods.at[:EB].add(take),
-                    allow=state.allow.at[:EB].set(
+                    pods=state.pods.at[:BR].add(take),
+                    allow=state.allow.at[:BR].set(
                         jnp.where(tm, m_allow_rows, sa)
                     ),
-                    out=state.out.at[:EB].set(
-                        jnp.where(tm, m_out_rows, state.out[:EB])
+                    out=state.out.at[:BR].set(
+                        jnp.where(tm, m_out_rows, state.out[:BR])
                     ),
-                    defined=state.defined.at[:EB].set(
-                        jnp.where(tm, m_def_rows, state.defined[:EB])
+                    defined=state.defined.at[:BR].set(
+                        jnp.where(tm, m_def_rows, state.defined[:BR])
                     ),
                 )
+                if mach_bulk:
+                    # touched machine rows narrow their surviving types to
+                    # those that fit the committed replicas (tmask_k =
+                    # compat ∧ kcap >= k, as in do_candidate) and refresh
+                    # the optimistic capacity; the existing prefix never
+                    # narrows types, so the writes cover [EB, BR) only
+                    tmm = touched[EB:][:, None]
+                    new_tmask_rows = tmask_rows & (
+                        kcap_rows >= take[EB:, None]
+                    )
+                    state = state._replace(
+                        tmask=state.tmask.at[EB:BR].set(
+                            jnp.where(tmm, new_tmask_rows, state.tmask[EB:BR])
+                        ),
+                        cap=state.cap.at[EB:BR].set(
+                            jnp.where(
+                                tmm,
+                                _segment_max_alloc(new_tmask_rows, type_alloc),
+                                state.cap[EB:BR],
+                            )
+                        ),
+                    )
                 if Q:
                     state = state._replace(
-                        ports=state.ports.at[:EB].set(
+                        ports=state.ports.at[:BR].set(
                             jnp.where(
-                                tm, state.ports[:EB] | prow["ports"][None, :],
-                                state.ports[:EB],
+                                tm, state.ports[:BR] | prow["ports"][None, :],
+                                state.ports[:BR],
                             )
                         )
                     )
                 if W:
+                    EVB = min(EV, BR)
                     state = state._replace(
-                        vols=state.vols.at[:EB].set(
+                        vols=state.vols.at[:EVB].set(
                             jnp.where(
-                                tm, state.vols[:EB] | prow["vols"][None, :],
-                                state.vols[:EB],
+                                tm[:EVB],
+                                state.vols[:EVB] | prow["vols"][None, :],
+                                state.vols[:EVB],
                             )
                         )
                     )
@@ -901,7 +1107,7 @@ def make_pack_kernel(
                 # retire filled/unusable slots; on a no-op pass retire every
                 # candidate so the loop is guaranteed to progress
                 retire = cands & jnp.where(do, (k_eff == 0) | (take >= k_eff), True)
-                score = score.at[:EB].set(jnp.where(retire, BIG, score[:EB]))
+                score = score.at[:BR].set(jnp.where(retire, BIG, score[:BR]))
                 carry2 = (state, log, ptr, remaining, score, jnp.bool_(False), dead)
                 # fused open: when the exist fill leaves no candidate at all
                 # and the item owns no vk-spread (whose per-round cap must be
@@ -1140,7 +1346,7 @@ def make_pack_kernel(
                     dmark = jnp.zeros(V, dtype=bool)
                 has_cand = jnp.where(gate, score_c, BIG).min() < BIG
                 args = (inner, force, cap, gate, dmark)
-                if EB > 0:
+                if BR > 0:
                     exist_cand = (
                         (score_c < BIG) & gate & state_c.is_existing
                     ).any()
@@ -1152,9 +1358,13 @@ def make_pack_kernel(
                         if has_topo
                         else jnp.bool_(False)
                     )
+                    bulk_ready = item_bulk_ok & exist_cand
+                    if mach_bulk:
+                        # machine-region-eligible items bulk whenever ANY
+                        # candidate exists (the region covers the full axis)
+                        bulk_ready |= mach_ok_i & has_cand
                     use_bulk = (
-                        item_bulk_ok
-                        & exist_cand
+                        bulk_ready
                         & ~need_seed
                         & ((carry[1]["bulk_n"] < LB) if log_commits
                            else jnp.bool_(True))

@@ -287,7 +287,14 @@ def topo_narrow_single(meta: TopoMeta, tcounts, thost, tdoms, own, selp,
                 g_viable = jnp.where(has_pos, hc > 0.5, selp[g])
             else:
                 g_viable = hc < 0.5
-                k_cap = jnp.where(applies, jnp.minimum(k_cap, 1), k_cap)
+                # only the DIRECT group 1-caps replicas: an owner's replicas
+                # repel each other (owners kept bulk match their own
+                # selector; non-matching owners are expanded at encode).
+                # Followers merely SELECTED by the inverse group do not
+                # record into the inverse plane (only owners do) and may
+                # stack on a non-owner slot, as in the reference.
+                if not gm.is_inverse:
+                    k_cap = jnp.where(applies, jnp.minimum(k_cap, 1), k_cap)
             viable &= ~applies | g_viable
             continue
         lo, hi = gm.seg
@@ -352,6 +359,47 @@ def topo_bulk_item_ok(meta: TopoMeta, own, selp):
     return ok
 
 
+def topo_mach_bulk_item_ok(meta: TopoMeta, own, selp):
+    """Scalar bool: may this item take the FULL-AXIS (machine-region) bulk
+    fill? Superset of topo_bulk_item_ok's admission that additionally allows
+    hostname anti-affinity involvement — a hostname group's domain IS the
+    slot, so each placement only changes its own slot's viability and the
+    per-slot take computed from pre-iteration counts stays exact:
+
+      - hostname direct anti (own and/or selected): screened per slot on
+        thost==0, capped at 1 replica/slot by topo_bulk_narrow; recording is
+        thost[g, slot] += take (slot-local). Owner classes that do NOT match
+        their own selector are expanded to count=1 items at encode (their
+        replicas may legally co-locate), so own => selp here and the 1-cap
+        is exact.
+      - hostname inverse anti: the selected side screens on the inverse
+        plane (slot-local); the owner side records into it (slot-local).
+        own of an inverse group implies own of the paired direct group, so
+        self-matching owners are already 1-capped by the direct group.
+
+    Everything with cross-slot effects keeps the exclusions of
+    topo_bulk_item_ok: value-key anti (a placement in domain d kills every
+    slot of d), hostname-affinity owners (replicas must co-locate on one
+    seeded host), and node-filter terms (nf_ok is per merged slot row)."""
+    import jax.numpy as jnp
+
+    ok = jnp.bool_(True)
+    for g, gm in enumerate(meta.groups):
+        has_terms = len(gm.filter_term_rows) > 0
+        if has_terms:
+            ok &= ~(own[g] | selp[g])
+            continue
+        if gm.is_inverse:
+            if not gm.is_hostname:
+                ok &= ~own[g]
+            continue
+        if gm.gtype == TOPO_ANTI and not gm.is_hostname:
+            ok &= ~(own[g] | selp[g])
+        elif gm.gtype == TOPO_AFFINITY and gm.is_hostname:
+            ok &= ~own[g]
+    return ok
+
+
 def topo_bulk_need_seed(meta: TopoMeta, tcounts, tdoms, own, pod_allow):
     """Scalar bool: an owned value-key affinity group has NO positive domain
     yet — the first replica must seed one via the single-slot path before
@@ -397,6 +445,13 @@ def topo_bulk_narrow(meta: TopoMeta, tcounts, thost, tdoms, own, selp,
                 k_cap = jnp.where(
                     own[g] & selp[g], jnp.minimum(k_cap, headroom), k_cap
                 )
+            elif gm.gtype == TOPO_ANTI:
+                # one replica per zero-count slot (the machine-region bulk
+                # admits hostname anti; count>0 slots are screened out by
+                # topo_screen, the 1-cap stops two replicas sharing a slot)
+                k_cap = jnp.where(
+                    own[g], jnp.minimum(k_cap, 1), k_cap
+                )
             continue
         lo, hi = gm.seg
         doms = tdoms[g, lo:hi]
@@ -415,13 +470,16 @@ def topo_bulk_narrow(meta: TopoMeta, tcounts, thost, tdoms, own, selp,
 
 def topo_record_bulk(meta: TopoMeta, tcounts, thost, tdoms, own, selp,
                      m_allow_rows, m_out_rows, k_row):
-    """Per-slot merged-row variant of topo_record for the bulk existing fill.
+    """Per-slot merged-row variant of topo_record for the bulk fills.
 
-    Only reachable for items topo_bulk_item_ok admits (no anti, no inverse
-    ownership, no filtered groups), so value-key counting is the singleton
-    rule evaluated per slot and nf_ok is vacuously true. k_row /
-    m_allow_rows / m_out_rows may cover only a PREFIX of the slot axis (the
-    existing slots); hostname counts update that prefix in place."""
+    Reachable for items topo_bulk_item_ok admits (existing-prefix fill) and
+    items topo_mach_bulk_item_ok admits (machine-region fill — additionally
+    hostname anti own/selp and hostname-inverse own, all of which record
+    slot-locally through the thost lane below). Neither admits value-key
+    anti involvement or filter terms, so value-key counting is the
+    singleton rule evaluated per slot and nf_ok is vacuously true. k_row /
+    m_allow_rows / m_out_rows may cover only a PREFIX of the slot axis;
+    hostname counts update that prefix in place."""
     import jax.numpy as jnp
 
     k_row_f = k_row.astype(jnp.float32)

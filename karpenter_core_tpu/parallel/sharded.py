@@ -444,7 +444,8 @@ def decode_sharded(snap, log, ptr, state, count_split):
     ndp = count_split.shape[0]
     # shard_map concatenates per-shard outputs along the leading axis:
     # reshape [ndp*L] logs and [ndp*N, ...] state fields back to per-shard
-    # (trailing dims preserved — bulk_take is [ndp*LB, E])
+    # (trailing dims preserved — bulk_take is [ndp*LB, BR]: the
+    # existing prefix, or the full slot axis under mach_bulk geometries)
     log = {
         k: (lambda a: a.reshape((ndp, a.shape[0] // ndp) + a.shape[1:]))(
             np.asarray(v)

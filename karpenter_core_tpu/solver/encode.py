@@ -380,9 +380,9 @@ class EncodedSnapshot:
     # Pods with identical constraint rows collapse into one item with a
     # count; the kernel commits whole replica groups per step instead of one
     # pod (real batches are deployment-dominated, so this shrinks the
-    # sequential axis 10-100x). Owned value-key-spread / anti-affinity
-    # classes are expanded back to count=1 items to keep the reference's
-    # per-pod domain-choice semantics exact.
+    # sequential axis 10-100x). Classes involved in value-key anti-affinity
+    # are expanded back to count=1 items to keep the reference's per-pod
+    # domain-choice semantics exact (_build_items; hostname anti stays bulk).
     item_of_pod: np.ndarray = None  # [P] int32 item index per (sorted) pod
     item_counts: np.ndarray = None  # [I] int32
     item_rep: np.ndarray = None  # [I] int32 representative pod row
@@ -936,15 +936,19 @@ def encode_snapshot(
 
 def _build_items(uidx, topo_meta, topo_arrays, ffd_key_of_class=None):
     """Group FFD-sorted pods into items by spec-equivalence class (uidx[i] =
-    pod i's class). Classes owning (or selected into) an anti-affinity group
-    are expanded back to count=1 items: each placement's "block out all
-    possible domains" record (topology.go:120-143) changes the next
-    placement's viability, so the reference's per-pod re-evaluation
-    (scheduler.go:96-133) must be preserved. Spread and affinity owners stay
-    bulk: hostname groups are governed by the kernel's skew-headroom cap, and
-    value-key spread owners by its per-iteration water-fill domain
-    allocation, both of which reproduce the per-pod greedy's final counts
-    for identical replicas.
+    pod i's class). Classes involved in a VALUE-KEY anti-affinity group are
+    expanded back to count=1 items: each placement's "block out all possible
+    domains" record (topology.go:120-143) changes the next placement's
+    viability, so the reference's per-pod re-evaluation (scheduler.go:96-133)
+    must be preserved. Hostname anti-affinity (the one-replica-per-node
+    service pattern) is slot-local — thost[g, n] tracks it per slot exactly —
+    so those classes stay bulk (kernel caps takes at 1/slot; the
+    machine-region bulk fill commits whole replica groups per iteration),
+    except owners that don't match their own selector (see inline comment).
+    Spread and affinity owners stay bulk: hostname groups are governed by the
+    kernel's skew-headroom cap, and value-key spread owners by its
+    per-iteration water-fill domain allocation, both of which reproduce the
+    per-pod greedy's final counts for identical replicas.
 
     Returns (item_of_pod [P], item_counts [I], item_rep [I], members)."""
     from karpenter_core_tpu.ops.topology import TOPO_ANTI
@@ -962,9 +966,24 @@ def _build_items(uidx, topo_meta, topo_arrays, ffd_key_of_class=None):
         owner = topo_arrays.owner  # [G, P]
         sel = topo_arrays.sel
         for g, gm in enumerate(topo_meta.groups):
-            if gm.gtype == TOPO_ANTI:
-                applies = sel[g] if gm.is_inverse else owner[g]
+            if gm.gtype != TOPO_ANTI:
+                continue
+            applies = sel[g] if gm.is_inverse else owner[g]
+            if not gm.is_hostname or len(gm.filter_term_rows) > 0:
+                # value-key anti: a placement in domain d registers every
+                # possible domain and kills all of d's slots — per-pod
+                # re-evaluation required. Filter terms: nf_ok is per merged
+                # slot row, outside the bulk paths.
                 expand_pod |= applies
+            elif not gm.is_inverse:
+                # hostname anti is SLOT-LOCAL (the domain is the node):
+                # thost[g, n] tracks it per slot exactly, the kernel caps
+                # bulk takes at 1/slot and the machine-region bulk fill
+                # commits a whole replica group in one iteration — the class
+                # stays bulk. Exception: an owner that does NOT match its
+                # own selector (replicas may legally co-locate, the 1-cap
+                # would diverge) keeps the reference's per-pod items.
+                expand_pod |= owner[g] & ~sel[g]
     class_item: Dict[int, int] = {}
     item_of_pod = np.zeros(P, dtype=np.int32)
     counts: List[int] = []
@@ -989,19 +1008,22 @@ def _build_items(uidx, topo_meta, topo_arrays, ffd_key_of_class=None):
             members[item].append(i)
         item_of_pod[i] = item
 
-    # Within an FFD tie group, hostname-spread owners go first: each of
-    # their replicas opens (or claims) a one-pod node, and the reference's
-    # interleaved per-pod loop lets same-sized pods that follow co-locate
-    # onto those nodes (machines rank by ascending pod count,
-    # scheduler.go:186-193). Processing them after a bulk class would
-    # open the spread nodes too late to be reused.
+    # Within an FFD tie group, hostname-spread and hostname-anti owners go
+    # first: each of their replicas opens (or claims) a near-empty node, and
+    # the reference's interleaved per-pod loop lets same-sized pods that
+    # follow co-locate onto those nodes (machines rank by ascending pod
+    # count, scheduler.go:186-193). Processing them after a bulk class would
+    # open the one-replica-per-node seeds too late to be reused — measured
+    # ~20% extra nodes on the config-3 mix when anti classes went last.
     if topo_meta is not None and ffd_key_of_class is not None:
         from karpenter_core_tpu.ops.topology import TOPO_SPREAD
 
         hs_groups = [
             g
             for g, gm in enumerate(topo_meta.groups)
-            if gm.gtype == TOPO_SPREAD and gm.is_hostname and not gm.is_inverse
+            if (gm.gtype == TOPO_SPREAD or gm.gtype == TOPO_ANTI)
+            and gm.is_hostname
+            and not gm.is_inverse
         ]
         if hs_groups:
             owner = topo_arrays.owner

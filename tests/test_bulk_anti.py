@@ -1,0 +1,351 @@
+"""Bulk hostname anti-affinity semantics.
+
+Hostname anti-affinity classes (the one-replica-per-node service pattern,
+topologygroup.go:235-243 over the hostname key) stay BULK items in the
+encoder (solver/encode._build_items) and commit through the machine-region
+bulk fill (ops/pack.py do_bulk with mach_bulk) instead of one
+while-iteration per replica. These tests pin the semantics of that fast
+path against the host oracle: pairwise-distinct nodes per selector group,
+inverse blocking, existing-node fill order, the non-self-matching-owner
+expansion exception, and interaction with ports and zonal spread.
+
+Reference anchors: topologygroup.go:235-243 (anti = zero-count domains
+only), topology.go:200-227 (inverse index), scheduler.go:179-193 (existing
+nodes first, machines ascending pod count).
+"""
+import numpy as np
+import pytest
+
+from karpenter_core_tpu.cloudprovider import fake
+from karpenter_core_tpu.kube.objects import (
+    LABEL_HOSTNAME,
+    LABEL_TOPOLOGY_ZONE,
+    LabelSelector,
+    PodAffinityTerm,
+    TopologySpreadConstraint,
+)
+from karpenter_core_tpu.solver.tpu_solver import GreedySolver, TPUSolver
+from karpenter_core_tpu.state.node import StateNode
+from karpenter_core_tpu.testing import make_node, make_pod, make_provisioner
+
+from tests.test_tpu_solver import validate_machines
+
+
+def _anti_pod(group: str, extra_labels=None, **kw):
+    labels = {"app": group}
+    labels.update(extra_labels or {})
+    return make_pod(
+        labels=labels,
+        requests=kw.pop("requests", {"cpu": "1"}),
+        pod_anti_affinity_required=[
+            PodAffinityTerm(
+                topology_key=LABEL_HOSTNAME,
+                label_selector=LabelSelector(match_labels={"app": group}),
+            )
+        ],
+        **kw,
+    )
+
+
+def _slot_groups(res):
+    """[(slot, {group: count})] over new machines + existing assignments."""
+    out = []
+    for m in res.new_machines:
+        out.append([p for p in m.pods])
+    for _n, pods in res.existing_assignments:
+        out.append(list(pods))
+    groups = []
+    for pods in out:
+        seen = {}
+        for p in pods:
+            app = (p.metadata.labels or {}).get("app", "")
+            if app:
+                seen[app] = seen.get(app, 0) + 1
+        groups.append(seen)
+    return groups
+
+
+def _assert_one_per_node(res, prefix="anti-"):
+    for seen in _slot_groups(res):
+        for app, cnt in seen.items():
+            if app.startswith(prefix):
+                assert cnt <= 1, f"{app} has {cnt} replicas on one node"
+
+
+def test_bulk_anti_class_stays_one_item():
+    """Self-matching hostname-anti classes collapse to one bulk item
+    (encode._build_items keeps them; value-key anti would expand)."""
+    from karpenter_core_tpu.solver import encode as enc
+
+    pods = [_anti_pod("svc") for _ in range(12)]
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(5)}
+    snap = enc.encode_snapshot(pods, provisioners, its, [])
+    assert len(snap.item_counts) == 1
+    assert int(snap.item_counts[0]) == 12
+
+
+def test_zone_anti_class_still_expands():
+    """Value-key (zone) anti keeps the reference's per-pod items — each
+    placement registers every possible domain (topology.go:120-143)."""
+    from karpenter_core_tpu.solver import encode as enc
+
+    pods = [
+        make_pod(
+            labels={"app": "z"},
+            requests={"cpu": "1"},
+            pod_anti_affinity_required=[
+                PodAffinityTerm(
+                    topology_key=LABEL_TOPOLOGY_ZONE,
+                    label_selector=LabelSelector(match_labels={"app": "z"}),
+                )
+            ],
+        )
+        for _ in range(3)
+    ]
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(5)}
+    snap = enc.encode_snapshot(pods, provisioners, its, [])
+    assert len(snap.item_counts) == 3
+
+
+def test_bulk_anti_distinct_nodes():
+    """A 10-replica self-anti service lands on 10 pairwise-distinct nodes
+    on the device path, matching the host count."""
+    pods = [_anti_pod("svc") for _ in range(10)]
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(8)}
+    host = GreedySolver().solve(pods, provisioners, its)
+    tpu = TPUSolver(max_nodes=32).solve(pods, provisioners, its)
+    assert not tpu.failed_pods
+    _assert_one_per_node(tpu, prefix="svc")
+    validate_machines(tpu)
+    assert len(tpu.new_machines) == len(host.new_machines) == 10
+
+
+def test_bulk_anti_groups_share_nodes():
+    """Different services' replicas CAN co-locate (only same-selector pods
+    repel): 3 services x 6 replicas need only 6 nodes, on both paths."""
+    pods = []
+    for g in range(3):
+        pods += [_anti_pod(f"anti-{g}", requests={"cpu": "0.5"}) for _ in range(6)]
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(8)}
+    host = GreedySolver().solve(pods, provisioners, its)
+    tpu = TPUSolver(max_nodes=32).solve(pods, provisioners, its)
+    assert not tpu.failed_pods and not host.failed_pods
+    _assert_one_per_node(tpu)
+    validate_machines(tpu)
+    # the device bulk fill reuses the first service's opened nodes for the
+    # later services (machine-region bulk; scheduler.go:186-193 ordering)
+    assert len(tpu.new_machines) <= len(host.new_machines)
+
+
+def test_bulk_anti_fills_existing_first():
+    """Empty existing nodes take one replica each before machines open
+    (scheduler.go:179-185)."""
+    pods = [_anti_pod("svc") for _ in range(6)]
+    provisioners = [make_provisioner(name="default")]
+    universe = fake.instance_types(6)
+    its = {"default": universe}
+    from karpenter_core_tpu.api.labels import (
+        LABEL_CAPACITY_TYPE,
+        LABEL_NODE_INITIALIZED,
+        PROVISIONER_NAME_LABEL_KEY,
+    )
+    from karpenter_core_tpu.kube.objects import LABEL_INSTANCE_TYPE_STABLE
+
+    nodes = []
+    for i in range(4):
+        it = universe[0]
+        node = make_node(
+            name=f"exist-{i}",
+            labels={
+                PROVISIONER_NAME_LABEL_KEY: "default",
+                LABEL_NODE_INITIALIZED: "true",
+                LABEL_INSTANCE_TYPE_STABLE: it.name,
+                LABEL_CAPACITY_TYPE: "on-demand",
+                LABEL_TOPOLOGY_ZONE: "test-zone-1",
+            },
+            capacity={k: str(v) for k, v in it.capacity.items()},
+        )
+        nodes.append(StateNode(node=node))
+    tpu = TPUSolver(max_nodes=16).solve(
+        pods, provisioners, its, state_nodes=[n.deep_copy() for n in nodes]
+    )
+    assert not tpu.failed_pods
+    _assert_one_per_node(tpu, prefix="svc")
+    # 4 existing nodes each take one replica; 2 fresh machines take the rest
+    assert len(tpu.existing_assignments) == 4
+    for _n, ps in tpu.existing_assignments:
+        assert len(ps) == 1
+    assert len(tpu.new_machines) == 2
+
+
+def test_non_self_matching_owner_expands_and_colocates():
+    """An anti OWNER whose selector does NOT match its own labels may
+    co-locate its replicas (the reference only repels selector-matching
+    pods); the encoder keeps per-pod items for it and the device path
+    matches the host."""
+    from karpenter_core_tpu.solver import encode as enc
+
+    # owner pods labeled app=web repel app=db pods, not each other
+    pods = [
+        make_pod(
+            labels={"app": "web"},
+            requests={"cpu": "0.5"},
+            pod_anti_affinity_required=[
+                PodAffinityTerm(
+                    topology_key=LABEL_HOSTNAME,
+                    label_selector=LabelSelector(match_labels={"app": "db"}),
+                )
+            ],
+        )
+        for _ in range(4)
+    ]
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(5)}
+    snap = enc.encode_snapshot(pods, provisioners, its, [])
+    assert len(snap.item_counts) == 4  # expanded: co-location is legal
+    host = GreedySolver().solve(pods, provisioners, its)
+    tpu = TPUSolver(max_nodes=16).solve(pods, provisioners, its)
+    assert not tpu.failed_pods and not host.failed_pods
+    assert len(tpu.new_machines) <= len(host.new_machines)
+
+
+def _owner_follower_census(res, group="svc"):
+    """[(n_owners, n_followers)] per slot for one selector group."""
+    slots = [list(m.pods) for m in res.new_machines]
+    slots += [list(ps) for _n, ps in res.existing_assignments]
+    out = []
+    for ps in slots:
+        owners = followers = 0
+        for p in ps:
+            if (p.metadata.labels or {}).get("app") != group:
+                continue
+            if p.spec.affinity and p.spec.affinity.pod_anti_affinity:
+                owners += 1
+            else:
+                followers += 1
+        out.append((owners, followers))
+    return out
+
+
+def test_inverse_blocks_matching_pods_from_owner_nodes():
+    """Pods matching an anti owner's selector cannot join the owner's node
+    (inverse index, topology.go:200-227), on the device bulk path: the
+    owner pods are small enough that a matching pod could otherwise fit.
+    Follower-ONLY nodes may still stack many followers (they repel nothing
+    and record only into the direct plane)."""
+    pods = [_anti_pod("svc", requests={"cpu": "1"}) for _ in range(3)]
+    # matching pods (selected by svc's selector) — no anti of their own
+    pods += [
+        make_pod(labels={"app": "svc"}, requests={"cpu": "0.5"})
+        for _ in range(3)
+    ]
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(6)}
+    tpu = TPUSolver(max_nodes=16).solve(pods, provisioners, its)
+    assert not tpu.failed_pods
+    for owners, followers in _owner_follower_census(tpu):
+        if owners:
+            # an owner's node repels every other selector-matching pod
+            assert owners == 1 and followers == 0
+
+
+def test_followers_stack_on_non_owner_nodes():
+    """Selected-only followers do NOT repel each other: the reference
+    stacks them on one non-owner node (only owner nodes are barred,
+    topology.go:200-227) — the bulk follower item must match the host
+    oracle's machine count instead of opening one node per follower."""
+    pods = [_anti_pod("svc", requests={"cpu": "1"}) for _ in range(3)]
+    pods += [
+        make_pod(labels={"app": "svc"}, requests={"cpu": "0.25"})
+        for _ in range(6)
+    ]
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(6)}
+    host = GreedySolver().solve(pods, provisioners, its)
+    tpu = TPUSolver(max_nodes=16).solve(pods, provisioners, its)
+    assert not tpu.failed_pods and not host.failed_pods
+    for owners, followers in _owner_follower_census(tpu):
+        if owners:
+            assert owners == 1 and followers == 0
+    # 3 owner nodes + followers stacked densely: host opens 4 machines
+    assert len(tpu.new_machines) <= len(host.new_machines)
+
+
+def test_config3_shape_mixed_batch():
+    """The BASELINE config-3 shape in miniature: hostname-anti services +
+    a zonal DoNotSchedule spread cohort + generic filler, device vs host."""
+    zonal = TopologySpreadConstraint(
+        max_skew=1,
+        topology_key=LABEL_TOPOLOGY_ZONE,
+        when_unsatisfiable="DoNotSchedule",
+        label_selector=LabelSelector(match_labels={"app": "spread"}),
+    )
+    pods = []
+    for i in range(120):
+        k = i % 4
+        if k == 0:
+            pods.append(_anti_pod(f"anti-{i % 16 // 4}"))
+        elif k == 1:
+            pods.append(
+                make_pod(
+                    labels={"app": "spread"},
+                    requests={"cpu": "1"},
+                    topology_spread=[zonal],
+                )
+            )
+        else:
+            pods.append(make_pod(requests={"cpu": "1", "memory": "1Gi"}))
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(10)}
+    host = GreedySolver().solve(pods, provisioners, its)
+    tpu = TPUSolver(max_nodes=64).solve(pods, provisioners, its)
+    assert not tpu.failed_pods and not host.failed_pods
+    _assert_one_per_node(tpu)
+    validate_machines(tpu)
+    # zonal skew holds
+    zone_counts = {}
+    for m in tpu.new_machines:
+        zone_req = m.requirements.get_requirement(LABEL_TOPOLOGY_ZONE)
+        zones = zone_req.values_list() if zone_req is not None else []
+        n_spread = sum(
+            1
+            for p in m.pods
+            if (p.metadata.labels or {}).get("app") == "spread"
+        )
+        if n_spread and len(zones) == 1:
+            zone_counts[zones[0]] = zone_counts.get(zones[0], 0) + n_spread
+    if len(zone_counts) > 1:
+        assert max(zone_counts.values()) - min(zone_counts.values()) <= 1
+    # packing quality parity: within one node either way
+    assert len(tpu.new_machines) <= len(host.new_machines) + 1
+
+
+def test_bulk_anti_with_host_ports():
+    """A port-carrying anti service: both the port-conflict 1-cap and the
+    anti 1-cap apply; replicas land on distinct nodes with no port clash."""
+    pods = [
+        _anti_pod("svc", requests={"cpu": "0.5"}, host_ports=[8080])
+        for _ in range(5)
+    ]
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(5)}
+    tpu = TPUSolver(max_nodes=16).solve(pods, provisioners, its)
+    assert not tpu.failed_pods
+    _assert_one_per_node(tpu, prefix="svc")
+    assert len(tpu.new_machines) == 5
+
+
+def test_bulk_anti_budget_larger_than_slots():
+    """More replicas than the slot budget: the overflow fails cleanly, the
+    placed replicas still sit on distinct nodes."""
+    pods = [_anti_pod("svc") for _ in range(12)]
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(4)}
+    tpu = TPUSolver(max_nodes=8).solve(pods, provisioners, its)
+    assert len(tpu.failed_pods) == 4
+    _assert_one_per_node(tpu, prefix="svc")
+    assert len(tpu.new_machines) == 8

@@ -35,6 +35,7 @@ from karpenter_core_tpu.kube.objects import (
     PersistentVolumeClaim,
     PersistentVolumeClaimSpec,
     PersistentVolumeClaimVolumeSource,
+    PodAffinityTerm,
     PreferredSchedulingTerm,
     StorageClass,
     Taint,
@@ -443,3 +444,93 @@ def test_fuzz_pallas_slot_screen(seed):
         pod, seg_mat, custom_deny=pod["custom_deny"],
     )
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+# -- G5: hostname anti-affinity geometry (the bulk-anti fast path) -----------
+
+G5_GROUPS = ["s0", "s1", "s2", "s3"]
+
+
+def _g5_workload(rng):
+    """Hostname anti-affinity services (self-matching owners — the bulk
+    item fast path, topologygroup.go:235-243), selected-only followers
+    (inverse index, topology.go:200-227), zonal spread, and generic filler
+    over existing nodes. Anchors pin every app value so the seeds share one
+    compiled program."""
+    universe = fake.instance_types(8)
+
+    def anti(group, cpu):
+        return make_pod(
+            labels={"app": group},
+            requests={"cpu": cpu},
+            pod_anti_affinity_required=[
+                PodAffinityTerm(
+                    topology_key=LABEL_HOSTNAME,
+                    label_selector=LabelSelector(match_labels={"app": group}),
+                )
+            ],
+        )
+
+    pods = []
+    for g in G5_GROUPS:
+        pods.append(anti(g, "0.1"))
+    pods.append(make_pod(labels={"app": "spread"}, requests={"cpu": "0.1"},
+                         topology_spread=[_zonal({"app": "spread"})]))
+    while len(pods) < 64:
+        kind = int(rng.integers(0, 5))
+        cpu = str(float(rng.choice([0.25, 0.5, 1.0])))
+        g = str(rng.choice(G5_GROUPS))
+        if kind == 0:
+            pods.append(anti(g, cpu))
+        elif kind == 1:
+            # follower: matches the service selector, owns no anti itself —
+            # repelled from owner nodes through the inverse group only
+            pods.append(make_pod(labels={"app": g}, requests={"cpu": cpu}))
+        elif kind == 2:
+            pods.append(make_pod(labels={"app": "spread"}, requests={"cpu": cpu},
+                                 topology_spread=[_zonal({"app": "spread"})]))
+        else:
+            pods.append(make_pod(requests={"cpu": cpu}))
+    order = rng.permutation(len(pods))
+    pods = [pods[i] for i in order]
+    nodes = _existing(universe, 4, "g5")
+    return pods, [make_provisioner(name="default")], {"default": universe}, nodes
+
+
+def _check_hostname_anti(tpu):
+    """No slot holds two pods matching one anti selector (owner or
+    follower — both count toward the selector's per-node census)."""
+    slots = [list(m.pods) for m in tpu.new_machines]
+    slots += [list(ps) for _n, ps in tpu.existing_assignments]
+    for ps in slots:
+        seen = {}
+        owners = {}
+        for p in ps:
+            app = (p.metadata.labels or {}).get("app")
+            if app in G5_GROUPS:
+                seen[app] = seen.get(app, 0) + 1
+                if p.spec.affinity and p.spec.affinity.pod_anti_affinity:
+                    owners[app] = owners.get(app, 0) + 1
+        for app in owners:
+            # an owner forbids ANY other selector-matching pod on its node
+            assert seen[app] == 1, (
+                f"anti owner shares a node with {seen[app] - 1} matching pods"
+            )
+
+
+@pytest.mark.parametrize("seed", list(range(600, 600 + N_SEEDS)))
+def test_fuzz_g5_hostname_anti(seed):
+    rng = np.random.default_rng(seed)
+    pods, provisioners, its, nodes = _g5_workload(rng)
+    host, tpu = _solve_both(pods, provisioners, its, nodes)
+    _equivalence(host, tpu, pods)
+    _check_hostname_anti(tpu)
+
+
+@pytest.mark.parametrize("seed", list(range(600, 600 + MXU_SEEDS)))
+def test_fuzz_g5_mxu_lowering(seed):
+    rng = np.random.default_rng(seed)
+    pods, provisioners, its, nodes = _g5_workload(rng)
+    host, tpu = _solve_both(pods, provisioners, its, nodes, backend="mxu")
+    _equivalence(host, tpu, pods)
+    _check_hostname_anti(tpu)
