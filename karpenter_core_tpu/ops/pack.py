@@ -107,6 +107,11 @@ def make_pack_kernel(
     # hostname-anti service = ~310 candidate commits); with it the whole
     # class commits in one iteration. Geometries without hostname anti
     # compile the exact same program as before.
+    # trigger ONLY on hostname anti: widening to every topology geometry was
+    # measured 3.2x SLOWER at the 50k headline (918ms -> 2970ms warm p50) —
+    # ~1000 generic classes each paid the [MBW, T] exact machine narrowing
+    # per bulk iteration, swamping the saved per-slot commits. Anti-bearing
+    # batches have few classes and k=1-per-slot items, where the trade wins.
     mach_bulk = has_topo and any(
         gm.gtype == topo.TOPO_ANTI and gm.is_hostname
         for gm in topo_meta.groups
