@@ -40,6 +40,13 @@ SOLVER_FALLBACK_TOTAL = REGISTRY.counter(
     "Solves routed to the fallback solver because the accelerator backend "
     "was unavailable or the primary solver failed",
 )
+# routine routing is NOT a failure: it rides its own counter so alerts on
+# karpenter_solver_fallback_total keep meaning "something is wrong"
+SOLVER_SMALL_BATCH_TOTAL = REGISTRY.counter(
+    f"{NAMESPACE}_solver_small_batch_routed_total",
+    "Solves routed to the host FFD because the batch was below the "
+    "small-batch work product (the device path's fixed cost would dominate)",
+)
 
 
 def probe_backend(timeout: float = 60.0) -> Optional[str]:
@@ -78,14 +85,25 @@ def probe_for(primary, timeout: float = 60.0) -> Optional[str]:
 
 
 class ResilientSolver:
-    """Solver decorator: primary with health-gated fallback.
+    """Solver decorator: primary with health-gated fallback, plus
+    small-batch routing — tiny solves go straight to the fallback FFD.
+
+    The device path pays a fixed ~90-100 ms of encode + round trip +
+    decode regardless of batch size (BASELINE config 1: 100 pods solve in
+    ~10 ms on the host greedy but ~100 ms through the accelerator), while
+    the host greedy's cost grows with pods x instance types (measured
+    ~0.04 + 0.0035*types ms per pod). Batches whose pods x types work
+    product is under small_batch_work_max therefore route to the fallback
+    — the same serial-FFD regime where the reference wins tiny cells
+    (scheduling_benchmark_test.go:56-76's smallest rungs).
 
     prober is injectable for tests (defaults to probe_for(primary))."""
 
     def __init__(self, primary, fallback, recorder=None, clock=time.time,
                  probe_timeout: float = 60.0, reprobe_interval: float = 300.0,
                  healthy_recheck_interval: float = 600.0,
-                 solve_timeout: Optional[float] = None, prober=None):
+                 solve_timeout: Optional[float] = None, prober=None,
+                 small_batch_work_max: int = 20_000):
         self.primary = primary
         self.fallback = fallback
         self.recorder = recorder
@@ -95,9 +113,11 @@ class ResilientSolver:
         self.healthy_recheck_interval = healthy_recheck_interval
         self.solve_timeout = solve_timeout
         self.prober = prober or (lambda: probe_for(primary, probe_timeout))
+        self.small_batch_work_max = small_batch_work_max
         self._healthy: Optional[bool] = None
         self._last_probe = 0.0
         self._reason = ""
+        self._bg_probe_started = False
 
     # -- health ------------------------------------------------------------
 
@@ -185,13 +205,43 @@ class ResilientSolver:
             raise box["error"]
         return box["result"]
 
+    def _small_batch(self, pods, instance_types) -> bool:
+        if self.small_batch_work_max <= 0:
+            return False
+        n_types = sum(len(v) for v in instance_types.values())
+        return len(pods) * max(n_types, 1) <= self.small_batch_work_max
+
+    def _fallback_solve(self, pods, provisioners, instance_types,
+                        daemonset_pods, state_nodes, kube_client, cluster):
+        return self.fallback.solve(
+            pods, provisioners, instance_types, daemonset_pods,
+            state_nodes, kube_client=kube_client, cluster=cluster,
+        )
+
     def solve(self, pods, provisioners, instance_types, daemonset_pods=None,
               state_nodes=None, kube_client=None, cluster=None):
+        # tiny batches: the serial FFD beats the device path's fixed
+        # encode/transfer cost — route without blocking on primary health.
+        # A cluster whose solves are ALL small would otherwise never
+        # establish health (supports_batched_replan stays un-gated and a
+        # dead backend goes unreported), so the first routed solve kicks
+        # off ONE background probe; later probes follow the normal TTLs.
+        if self._small_batch(pods, instance_types):
+            SOLVER_SMALL_BATCH_TOTAL.inc()
+            if self._healthy is None and not self._bg_probe_started:
+                self._bg_probe_started = True
+                threading.Thread(
+                    target=self.healthy, daemon=True, name="solver-probe"
+                ).start()
+            return self._fallback_solve(
+                pods, provisioners, instance_types, daemonset_pods,
+                state_nodes, kube_client, cluster,
+            )
         if not self.healthy():
             SOLVER_FALLBACK_TOTAL.inc({"reason": "backend_unavailable"})
-            return self.fallback.solve(
+            return self._fallback_solve(
                 pods, provisioners, instance_types, daemonset_pods,
-                state_nodes, kube_client=kube_client, cluster=cluster,
+                state_nodes, kube_client, cluster,
             )
         try:
             return self._primary_solve(
@@ -201,7 +251,7 @@ class ResilientSolver:
         except Exception as e:  # noqa: BLE001 — degrade, never stall
             self._mark_dead(f"{type(e).__name__}: {e}")
             SOLVER_FALLBACK_TOTAL.inc({"reason": "primary_error"})
-            return self.fallback.solve(
+            return self._fallback_solve(
                 pods, provisioners, instance_types, daemonset_pods,
-                state_nodes, kube_client=kube_client, cluster=cluster,
+                state_nodes, kube_client, cluster,
             )
