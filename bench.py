@@ -859,11 +859,8 @@ def main():
                 # record WHICH path served the rung: under BENCH_GRID_SCALE
                 # shrinks, rungs above config 1 can fall below the routing
                 # work product too — the artifact must say what it measured
-                n_types_total = sum(len(v) for v in its.values())
-                routed = (
-                    g_pods * max(n_types_total, 1)
-                    <= stage_solver.small_batch_work_max
-                )
+                # (the solver's own predicate, so the label cannot drift)
+                routed = stage_solver._small_batch(pods, its)
                 grid[kind] = {
                     "pods": g_pods,
                     "e2e_p50_ms": round(

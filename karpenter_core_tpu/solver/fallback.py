@@ -117,33 +117,62 @@ class ResilientSolver:
         self._healthy: Optional[bool] = None
         self._last_probe = 0.0
         self._reason = ""
-        self._bg_probe_started = False
+        # serializes the probe + verdict write (concurrent controller
+        # threads share one probe instead of racing subprocess probes)
+        self._verdict_lock = threading.Lock()
+        # held while a background probe is scheduled/running
+        self._probe_gate = threading.Lock()
 
     # -- health ------------------------------------------------------------
 
-    def healthy(self) -> bool:
+    def _stale(self) -> bool:
         now = self.clock()
-        stale = (
+        return (
             self._healthy is None
             or (not self._healthy
                 and now - self._last_probe >= self.reprobe_interval)
             or (self._healthy
                 and now - self._last_probe >= self.healthy_recheck_interval)
         )
-        if stale:
-            self._last_probe = now
-            reason = self.prober()
-            was = self._healthy
-            self._healthy = reason is None
-            self._reason = reason or ""
-            if was is not False and not self._healthy:
-                self._event("SolverDegraded", "Warning",
-                            f"accelerator backend unavailable ({self._reason}); "
-                            "falling back to the host solver")
-            elif was is False and self._healthy:
-                self._event("SolverRecovered", "Normal",
-                            "accelerator backend recovered")
-        return bool(self._healthy)
+
+    def healthy(self) -> bool:
+        with self._verdict_lock:
+            # re-check under the lock: a concurrent caller may have just
+            # refreshed the verdict while this thread waited
+            if self._stale():
+                self._last_probe = self.clock()
+                reason = self.prober()
+                was = self._healthy
+                self._healthy = reason is None
+                self._reason = reason or ""
+                if was is not False and not self._healthy:
+                    self._event(
+                        "SolverDegraded", "Warning",
+                        f"accelerator backend unavailable ({self._reason}); "
+                        "falling back to the host solver")
+                elif was is False and self._healthy:
+                    self._event("SolverRecovered", "Normal",
+                                "accelerator backend recovered")
+            return bool(self._healthy)
+
+    def _maybe_bg_probe(self) -> None:
+        """Refresh a stale health verdict WITHOUT blocking the caller —
+        the small-batch path never waits on a probe, but a cluster whose
+        solves are all small must still establish health (batched-replan
+        gating), detect a mid-life wedge on the normal healthy-recheck
+        TTL, and re-probe a dead backend for recovery."""
+        if not self._stale():
+            return
+        if not self._probe_gate.acquire(blocking=False):
+            return  # a probe is already scheduled or running
+
+        def run():
+            try:
+                self.healthy()
+            finally:
+                self._probe_gate.release()
+
+        threading.Thread(target=run, daemon=True, name="solver-probe").start()
 
     def _mark_dead(self, reason: str) -> None:
         self._healthy = False
@@ -221,18 +250,14 @@ class ResilientSolver:
     def solve(self, pods, provisioners, instance_types, daemonset_pods=None,
               state_nodes=None, kube_client=None, cluster=None):
         # tiny batches: the serial FFD beats the device path's fixed
-        # encode/transfer cost — route without blocking on primary health.
-        # A cluster whose solves are ALL small would otherwise never
-        # establish health (supports_batched_replan stays un-gated and a
-        # dead backend goes unreported), so the first routed solve kicks
-        # off ONE background probe; later probes follow the normal TTLs.
+        # encode/transfer cost — route without blocking on primary health,
+        # while _maybe_bg_probe keeps the verdict fresh on the normal TTLs
+        # (establish at startup, expire a healthy verdict, re-probe a dead
+        # backend) so batched-replan gating and degradation/recovery
+        # events work even when every solve is small.
         if self._small_batch(pods, instance_types):
             SOLVER_SMALL_BATCH_TOTAL.inc()
-            if self._healthy is None and not self._bg_probe_started:
-                self._bg_probe_started = True
-                threading.Thread(
-                    target=self.healthy, daemon=True, name="solver-probe"
-                ).start()
+            self._maybe_bg_probe()
             return self._fallback_solve(
                 pods, provisioners, instance_types, daemonset_pods,
                 state_nodes, kube_client, cluster,

@@ -388,11 +388,47 @@ def test_resilient_solver_routes_small_batches_to_ffd():
     # so batched-replan gating and degradation events work on clusters
     # whose provisioning solves are all small
     assert probed.wait(5.0), "background probe must run"
-    for _ in range(50):
+    import time as _t
+
+    for _ in range(100):
         if resilient._healthy is not None:
             break
-        import time as _t; _t.sleep(0.05)
+        _t.sleep(0.05)
     assert resilient._healthy is True
+    # the verdict still EXPIRES on the healthy-recheck TTL when every
+    # solve is small: a mid-life wedge is detected by a background
+    # re-probe instead of staying healthy forever
+    health = {"reason": None}
+    clock = FakeClock()
+    rechecks = []
+
+    def prober2():
+        rechecks.append(clock())
+        return health["reason"]
+
+    small = [make_pod(requests={"cpu": "1"}) for _ in range(5)]
+    resilient4 = ResilientSolver(
+        CountingSolver(), GreedySolver(), clock=clock, prober=prober2,
+        healthy_recheck_interval=600.0,
+    )
+    resilient4.solve(small, provisioners, its)
+    for _ in range(100):
+        if resilient4._healthy is not None:
+            break
+        _t.sleep(0.05)
+    assert resilient4._healthy is True and len(rechecks) == 1
+    resilient4.solve(small, provisioners, its)  # fresh verdict: no probe
+    _t.sleep(0.1)
+    assert len(rechecks) == 1
+    clock.advance(601)
+    health["reason"] = "tunnel wedged"
+    resilient4.solve(small, provisioners, its)  # stale: background re-probe
+    for _ in range(100):
+        if resilient4._healthy is False:
+            break
+        _t.sleep(0.05)
+    assert resilient4._healthy is False, "mid-life wedge must be detected"
+    assert len(rechecks) == 2
     # above the work product: goes to the primary
     resilient2 = ResilientSolver(
         CountingSolver(), GreedySolver(), prober=lambda: None,
