@@ -172,12 +172,31 @@ class ResilientSolver:
             finally:
                 self._probe_gate.release()
 
-        threading.Thread(target=run, daemon=True, name="solver-probe").start()
+        # a failed start (thread exhaustion) must not leak the gate — that
+        # would disable every future background probe for the process
+        # lifetime. The probe is best-effort: the solve it decorates must
+        # still return, and the next stale small-batch solve retries.
+        try:
+            threading.Thread(
+                target=run, daemon=True, name="solver-probe"
+            ).start()
+        except Exception:  # noqa: BLE001 — best-effort probe
+            self._probe_gate.release()
+        except BaseException:
+            self._probe_gate.release()
+            raise
 
     def _mark_dead(self, reason: str) -> None:
-        self._healthy = False
-        self._last_probe = self.clock()
-        self._reason = reason
+        # under the verdict lock: a background probe completing after a
+        # primary-solve failure must not overwrite the dead verdict with
+        # its (pre-failure-sampled) healthy one; taking the lock orders
+        # this write after any in-flight probe, and stamping _last_probe
+        # makes the dead verdict fresh so the next healthy() respects the
+        # reprobe TTL instead of instantly re-probing
+        with self._verdict_lock:
+            self._healthy = False
+            self._last_probe = self.clock()
+            self._reason = reason
         self._event("SolverDegraded", "Warning",
                     f"primary solver failed ({reason}); "
                     "falling back to the host solver")

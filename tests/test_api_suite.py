@@ -1,0 +1,550 @@
+"""Spec-for-spec port of the v1alpha5 API suite.
+
+Every `It(...)` of reference pkg/apis/v1alpha5/suite_test.go (58 validation
+specs + 3 Limits specs), one test per spec, cited by line. The condensed
+coverage in tests/test_api_validation.py predates this port and remains as
+the webhook/dispatch layer's tests.
+"""
+import pytest
+
+from karpenter_core_tpu.api import labels as api_labels
+from karpenter_core_tpu.api.provisioner import (
+    Consolidation,
+    KubeletConfiguration,
+    Limits,
+    ProviderRef,
+)
+from karpenter_core_tpu.api.validation import validate_provisioner
+from karpenter_core_tpu.kube.objects import (
+    LABEL_TOPOLOGY_ZONE,
+    NodeSelectorRequirement,
+    Taint,
+)
+from karpenter_core_tpu.testing import make_provisioner
+
+
+@pytest.fixture
+def provisioner():
+    # suite_test.go:47-57 — a named provisioner with a ProviderRef
+    p = make_provisioner()
+    p.spec.provider = None
+    p.spec.provider_ref = ProviderRef(kind="NodeTemplate", name="default")
+    return p
+
+
+def ok(p):
+    assert validate_provisioner(p) == [], validate_provisioner(p)
+
+
+def bad(p):
+    assert validate_provisioner(p) != [], "expected validation failure"
+
+
+# -- TTLs + consolidation (suite_test.go:59-94) ------------------------------
+
+
+def test_fails_on_negative_expiry_ttl(provisioner):
+    """suite_test.go:59"""
+    provisioner.spec.ttl_seconds_until_expired = -1
+    bad(provisioner)
+
+
+def test_succeeds_on_missing_expiry_ttl(provisioner):
+    """suite_test.go:63"""
+    provisioner.spec.ttl_seconds_until_expired = None
+    ok(provisioner)
+
+
+def test_fails_on_negative_empty_ttl(provisioner):
+    """suite_test.go:68"""
+    provisioner.spec.ttl_seconds_after_empty = -1
+    bad(provisioner)
+
+
+def test_succeeds_on_missing_empty_ttl(provisioner):
+    """suite_test.go:72"""
+    provisioner.spec.ttl_seconds_after_empty = None
+    ok(provisioner)
+
+
+def test_succeeds_on_valid_empty_ttl(provisioner):
+    """suite_test.go:76"""
+    provisioner.spec.ttl_seconds_after_empty = 30
+    ok(provisioner)
+
+
+def test_fails_if_consolidation_and_empty_ttl_both_enabled(provisioner):
+    """suite_test.go:80"""
+    provisioner.spec.ttl_seconds_after_empty = 30
+    provisioner.spec.consolidation = Consolidation(enabled=True)
+    bad(provisioner)
+
+
+def test_succeeds_if_consolidation_off_and_empty_ttl_set(provisioner):
+    """suite_test.go:85"""
+    provisioner.spec.ttl_seconds_after_empty = 30
+    provisioner.spec.consolidation = Consolidation(enabled=False)
+    ok(provisioner)
+
+
+def test_succeeds_if_consolidation_on_and_empty_ttl_unset(provisioner):
+    """suite_test.go:90"""
+    provisioner.spec.ttl_seconds_after_empty = None
+    provisioner.spec.consolidation = Consolidation(enabled=True)
+    ok(provisioner)
+
+
+# -- Limits context (suite_test.go:96-105) -----------------------------------
+
+
+def test_allows_undefined_limits(provisioner):
+    """suite_test.go:97"""
+    provisioner.spec.limits = Limits()
+    ok(provisioner)
+
+
+def test_allows_empty_limits(provisioner):
+    """suite_test.go:101"""
+    provisioner.spec.limits = Limits(resources={})
+    ok(provisioner)
+
+
+# -- Provider context (suite_test.go:106-116) --------------------------------
+
+
+def test_rejects_provider_and_provider_ref_together(provisioner):
+    """suite_test.go:107"""
+    provisioner.spec.provider = {}
+    provisioner.spec.provider_ref = ProviderRef(name="providerRef")
+    bad(provisioner)
+
+
+def test_requires_provider_or_provider_ref(provisioner):
+    """suite_test.go:112"""
+    provisioner.spec.provider = None
+    provisioner.spec.provider_ref = None
+    bad(provisioner)
+
+
+# -- Labels context (suite_test.go:117-155) ----------------------------------
+
+
+def test_allows_unrecognized_labels(provisioner):
+    """suite_test.go:118"""
+    provisioner.spec.labels = {"foo": "silly-name"}
+    ok(provisioner)
+
+
+def test_fails_for_provisioner_name_label(provisioner):
+    """suite_test.go:122"""
+    provisioner.spec.labels = {
+        api_labels.PROVISIONER_NAME_LABEL_KEY: "silly-name"
+    }
+    bad(provisioner)
+
+
+def test_fails_for_invalid_label_keys(provisioner):
+    """suite_test.go:126"""
+    provisioner.spec.labels = {"spaces are not allowed": "silly-name"}
+    bad(provisioner)
+
+
+def test_fails_for_invalid_label_values(provisioner):
+    """suite_test.go:130"""
+    provisioner.spec.labels = {"silly-name": "/ is not allowed"}
+    bad(provisioner)
+
+
+def test_fails_for_restricted_label_domains(provisioner):
+    """suite_test.go:134"""
+    for domain in api_labels.RESTRICTED_LABEL_DOMAINS:
+        provisioner.spec.labels = {f"{domain}/unknown": "silly-name"}
+        bad(provisioner)
+
+
+def test_allows_labels_kops_requires(provisioner):
+    """suite_test.go:140"""
+    provisioner.spec.labels = {
+        "kops.k8s.io/instancegroup": "karpenter-nodes",
+        "kops.k8s.io/gpu": "1",
+    }
+    ok(provisioner)
+
+
+def test_allows_labels_in_restricted_domain_exceptions(provisioner):
+    """suite_test.go:147"""
+    for domain in api_labels.LABEL_DOMAIN_EXCEPTIONS:
+        provisioner.spec.labels = {domain: "test-value"}
+        ok(provisioner)
+
+
+# -- Taints context (suite_test.go:156-202) ----------------------------------
+
+
+def test_succeeds_for_valid_taints(provisioner):
+    """suite_test.go:157"""
+    provisioner.spec.taints = [
+        Taint(key="a", value="b", effect="NoSchedule"),
+        Taint(key="c", value="d", effect="NoExecute"),
+        Taint(key="e", value="f", effect="PreferNoSchedule"),
+        Taint(key="key-only", effect="NoExecute"),
+    ]
+    ok(provisioner)
+
+
+def test_fails_for_invalid_taint_keys(provisioner):
+    """suite_test.go:166"""
+    provisioner.spec.taints = [Taint(key="???")]
+    bad(provisioner)
+
+
+def test_fails_for_missing_taint_key(provisioner):
+    """suite_test.go:170"""
+    provisioner.spec.taints = [Taint(key="", effect="NoSchedule")]
+    bad(provisioner)
+
+
+def test_fails_for_invalid_taint_value(provisioner):
+    """suite_test.go:174"""
+    provisioner.spec.taints = [
+        Taint(key="invalid-value", effect="NoSchedule", value="???")
+    ]
+    bad(provisioner)
+
+
+def test_fails_for_invalid_taint_effect(provisioner):
+    """suite_test.go:178"""
+    provisioner.spec.taints = [Taint(key="invalid-effect", effect="???")]
+    bad(provisioner)
+
+
+def test_same_key_different_effects_allowed(provisioner):
+    """suite_test.go:182"""
+    provisioner.spec.taints = [
+        Taint(key="a", effect="NoSchedule"),
+        Taint(key="a", effect="NoExecute"),
+    ]
+    ok(provisioner)
+
+
+def test_duplicate_taint_key_effect_pairs_rejected(provisioner):
+    """suite_test.go:189 — within taints AND across taints/startupTaints"""
+    provisioner.spec.taints = [
+        Taint(key="a", effect="NoSchedule"),
+        Taint(key="a", effect="NoSchedule"),
+    ]
+    bad(provisioner)
+    provisioner.spec.taints = [Taint(key="a", effect="NoSchedule")]
+    provisioner.spec.startup_taints = [Taint(key="a", effect="NoSchedule")]
+    bad(provisioner)
+
+
+# -- Requirements context (suite_test.go:204-278) ----------------------------
+
+
+def test_requirements_fail_for_provisioner_name_label(provisioner):
+    """suite_test.go:205"""
+    provisioner.spec.requirements = [
+        NodeSelectorRequirement(
+            key=api_labels.PROVISIONER_NAME_LABEL_KEY,
+            operator="In",
+            values=["silly-name"],
+        )
+    ]
+    bad(provisioner)
+
+
+def test_requirements_allow_supported_ops(provisioner):
+    """suite_test.go:211"""
+    provisioner.spec.requirements = [
+        NodeSelectorRequirement(LABEL_TOPOLOGY_ZONE, "In", ["test"]),
+        NodeSelectorRequirement(LABEL_TOPOLOGY_ZONE, "Gt", ["1"]),
+        NodeSelectorRequirement(LABEL_TOPOLOGY_ZONE, "Lt", ["1"]),
+        NodeSelectorRequirement(LABEL_TOPOLOGY_ZONE, "NotIn", []),
+        NodeSelectorRequirement(LABEL_TOPOLOGY_ZONE, "Exists", []),
+    ]
+    ok(provisioner)
+
+
+def test_requirements_fail_for_unsupported_ops(provisioner):
+    """suite_test.go:221"""
+    provisioner.spec.requirements = [
+        NodeSelectorRequirement(LABEL_TOPOLOGY_ZONE, "unknown", ["test"])
+    ]
+    bad(provisioner)
+
+
+def test_requirements_fail_for_restricted_domains(provisioner):
+    """suite_test.go:229"""
+    for domain in api_labels.RESTRICTED_LABEL_DOMAINS:
+        provisioner.spec.requirements = [
+            NodeSelectorRequirement(f"{domain}/test", "In", ["test"])
+        ]
+        bad(provisioner)
+
+
+def test_requirements_allow_restricted_domain_exceptions(provisioner):
+    """suite_test.go:237"""
+    for domain in api_labels.LABEL_DOMAIN_EXCEPTIONS:
+        provisioner.spec.requirements = [
+            NodeSelectorRequirement(f"{domain}/test", "In", ["test"])
+        ]
+        ok(provisioner)
+
+
+def test_requirements_allow_well_known_label_exceptions(provisioner):
+    """suite_test.go:245"""
+    for label in set(api_labels.WELL_KNOWN_LABELS) - {
+        api_labels.PROVISIONER_NAME_LABEL_KEY
+    }:
+        provisioner.spec.requirements = [
+            NodeSelectorRequirement(label, "In", ["test"])
+        ]
+        ok(provisioner)
+
+
+def test_requirements_allow_nonempty_set_after_overlap_removal(provisioner):
+    """suite_test.go:253"""
+    provisioner.spec.requirements = [
+        NodeSelectorRequirement(LABEL_TOPOLOGY_ZONE, "In", ["test", "foo"]),
+        NodeSelectorRequirement(LABEL_TOPOLOGY_ZONE, "NotIn", ["test", "bar"]),
+    ]
+    ok(provisioner)
+
+
+def test_requirements_allow_empty(provisioner):
+    """suite_test.go:260"""
+    provisioner.spec.requirements = []
+    ok(provisioner)
+
+
+@pytest.mark.parametrize(
+    "op,values",
+    [
+        ("Gt", []),
+        ("Gt", ["1", "2"]),
+        ("Gt", ["a"]),
+        ("Gt", ["-1"]),
+        ("Lt", []),
+        ("Lt", ["1", "2"]),
+        ("Lt", ["a"]),
+        ("Lt", ["-1"]),
+    ],
+)
+def test_requirements_fail_invalid_gt_lt_values(provisioner, op, values):
+    """suite_test.go:264"""
+    provisioner.spec.requirements = [
+        NodeSelectorRequirement(LABEL_TOPOLOGY_ZONE, op, values)
+    ]
+    bad(provisioner)
+
+
+# -- KubeletConfiguration context (suite_test.go:280-491) --------------------
+
+
+def test_kube_reserved_invalid_keys(provisioner):
+    """suite_test.go:281 — pods is not reservable"""
+    provisioner.spec.kubelet_configuration = KubeletConfiguration(
+        kube_reserved={"pods": 2.0}
+    )
+    bad(provisioner)
+
+
+def test_system_reserved_invalid_keys(provisioner):
+    """suite_test.go:289"""
+    provisioner.spec.kubelet_configuration = KubeletConfiguration(
+        system_reserved={"pods": 2.0}
+    )
+    bad(provisioner)
+
+
+_VALID_SIGNALS = {
+    "memory.available": "5%",
+    "nodefs.available": "10%",
+    "nodefs.inodesFree": "15%",
+    "imagefs.available": "5%",
+    "imagefs.inodesFree": "5%",
+    "pid.available": "5%",
+}
+_VALID_GRACE = {
+    "memory.available": "1m",
+    "nodefs.available": "90s",
+    "nodefs.inodesFree": "5m",
+    "imagefs.available": "1h",
+    "imagefs.inodesFree": "24h",
+    "pid.available": "1m",
+}
+
+
+def test_eviction_hard_valid_keys(provisioner):
+    """suite_test.go:299"""
+    provisioner.spec.kubelet_configuration = KubeletConfiguration(
+        eviction_hard=dict(_VALID_SIGNALS)
+    )
+    ok(provisioner)
+
+
+def test_eviction_hard_invalid_keys(provisioner):
+    """suite_test.go:312"""
+    provisioner.spec.kubelet_configuration = KubeletConfiguration(
+        eviction_hard={"memory": "5%"}
+    )
+    bad(provisioner)
+
+
+def test_eviction_hard_invalid_formatted_percentage(provisioner):
+    """suite_test.go:320"""
+    provisioner.spec.kubelet_configuration = KubeletConfiguration(
+        eviction_hard={"memory.available": "5%3"}
+    )
+    bad(provisioner)
+
+
+def test_eviction_hard_percentage_too_large(provisioner):
+    """suite_test.go:328"""
+    provisioner.spec.kubelet_configuration = KubeletConfiguration(
+        eviction_hard={"memory.available": "110%"}
+    )
+    bad(provisioner)
+
+
+def test_eviction_hard_invalid_quantity(provisioner):
+    """suite_test.go:336 — GB is not a valid k8s quantity suffix"""
+    provisioner.spec.kubelet_configuration = KubeletConfiguration(
+        eviction_hard={"memory.available": "110GB"}
+    )
+    bad(provisioner)
+
+
+def test_eviction_soft_valid_keys(provisioner):
+    """suite_test.go:347"""
+    provisioner.spec.kubelet_configuration = KubeletConfiguration(
+        eviction_soft=dict(_VALID_SIGNALS),
+        eviction_soft_grace_period=dict(_VALID_GRACE),
+    )
+    ok(provisioner)
+
+
+def test_eviction_soft_invalid_keys(provisioner):
+    """suite_test.go:368"""
+    provisioner.spec.kubelet_configuration = KubeletConfiguration(
+        eviction_soft={"memory": "5%"},
+        eviction_soft_grace_period={"memory": "1m"},
+    )
+    bad(provisioner)
+
+
+def test_eviction_soft_invalid_formatted_percentage(provisioner):
+    """suite_test.go:379"""
+    provisioner.spec.kubelet_configuration = KubeletConfiguration(
+        eviction_soft={"memory.available": "5%3"},
+        eviction_soft_grace_period={"memory.available": "1m"},
+    )
+    bad(provisioner)
+
+
+def test_eviction_soft_percentage_too_large(provisioner):
+    """suite_test.go:390"""
+    provisioner.spec.kubelet_configuration = KubeletConfiguration(
+        eviction_soft={"memory.available": "110%"},
+        eviction_soft_grace_period={"memory.available": "1m"},
+    )
+    bad(provisioner)
+
+
+def test_eviction_soft_invalid_quantity(provisioner):
+    """suite_test.go:401"""
+    provisioner.spec.kubelet_configuration = KubeletConfiguration(
+        eviction_soft={"memory.available": "110GB"},
+        eviction_soft_grace_period={"memory.available": "1m"},
+    )
+    bad(provisioner)
+
+
+def test_eviction_soft_requires_matching_grace_period(provisioner):
+    """suite_test.go:412"""
+    provisioner.spec.kubelet_configuration = KubeletConfiguration(
+        eviction_soft={"memory.available": "200Mi"}
+    )
+    bad(provisioner)
+
+
+def test_image_gc_high_threshold_percent(provisioner):
+    """suite_test.go:423"""
+    provisioner.spec.kubelet_configuration = KubeletConfiguration(
+        image_gc_high_threshold_percent=10
+    )
+    ok(provisioner)
+
+
+def test_image_gc_high_less_than_low_rejected(provisioner):
+    """suite_test.go:429"""
+    provisioner.spec.kubelet_configuration = KubeletConfiguration(
+        image_gc_high_threshold_percent=50,
+        image_gc_low_threshold_percent=60,
+    )
+    bad(provisioner)
+
+
+def test_image_gc_low_threshold_percent(provisioner):
+    """suite_test.go:438"""
+    provisioner.spec.kubelet_configuration = KubeletConfiguration(
+        image_gc_low_threshold_percent=10
+    )
+    ok(provisioner)
+
+
+def test_image_gc_low_greater_than_high_rejected(provisioner):
+    """suite_test.go:444"""
+    provisioner.spec.kubelet_configuration = KubeletConfiguration(
+        image_gc_high_threshold_percent=50,
+        image_gc_low_threshold_percent=60,
+    )
+    bad(provisioner)
+
+
+def test_eviction_soft_grace_period_valid_keys(provisioner):
+    """suite_test.go:454"""
+    provisioner.spec.kubelet_configuration = KubeletConfiguration(
+        eviction_soft=dict(_VALID_SIGNALS),
+        eviction_soft_grace_period=dict(_VALID_GRACE),
+    )
+    ok(provisioner)
+
+
+def test_eviction_soft_grace_period_invalid_keys(provisioner):
+    """suite_test.go:475"""
+    provisioner.spec.kubelet_configuration = KubeletConfiguration(
+        eviction_soft_grace_period={"memory": "1m"}
+    )
+    bad(provisioner)
+
+
+def test_eviction_soft_grace_period_requires_matching_threshold(provisioner):
+    """suite_test.go:483"""
+    provisioner.spec.kubelet_configuration = KubeletConfiguration(
+        eviction_soft_grace_period={"memory.available": "1m"}
+    )
+    bad(provisioner)
+
+
+# -- Limits.ExceededBy (suite_test.go:495-523) -------------------------------
+
+
+def test_limits_usage_lower_than_limit():
+    """suite_test.go:511"""
+    limits = Limits(resources={"cpu": 16.0})
+    assert limits.exceeded_by({"cpu": 15.0}) is None
+
+
+def test_limits_usage_equal_to_limit():
+    """suite_test.go:515"""
+    limits = Limits(resources={"cpu": 16.0})
+    assert limits.exceeded_by({"cpu": 16.0}) is None
+
+
+def test_limits_usage_higher_than_limit():
+    """suite_test.go:519 — the error names the resource and both numbers"""
+    limits = Limits(resources={"cpu": 16.0})
+    err = limits.exceeded_by({"cpu": 17.0})
+    assert err == "cpu resource usage of 17 exceeds limit of 16"
