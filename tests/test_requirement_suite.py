@@ -1,0 +1,251 @@
+"""Spec-for-spec port of the Requirement algebra suite.
+
+Reference pkg/scheduling/requirement_test.go: the full 14x14 pairwise
+Intersection table (requirement_test.go:82-292), the Has value table
+(:295-370), Operator recovery (:373-388), complement-set Len (:391-406),
+Any (:409-424), String (:427-444), and NodeSelectorRequirement conversion
+(:447-462) — every expectation transcribed, not recomputed, so the table
+is an independent oracle for the host algebra (which the device encoder
+mirrors; ops/compat.py carries the tensor twin).
+
+The 14 fixtures mirror requirement_test.go:29-42. `CB` builds the
+compound complement results the reference spells as raw struct literals
+(complement sets carrying Gt/Lt bounds, requirement_test.go:167,223,
+228-231,242,246,287-288).
+"""
+import pytest
+
+from karpenter_core_tpu.scheduling.requirement import MAX_LEN, Requirement
+
+
+def R(op, *values):
+    return Requirement("key", op, list(values))
+
+
+def CB(values=(), gt=None, lt=None):
+    """Complement set with optional integer bounds (the reference's
+    &Requirement{complement: true, ...} literals)."""
+    return Requirement._make("key", True, set(values), gt, lt)
+
+
+exists = R("Exists")
+dne = R("DoesNotExist")
+inA = R("In", "A")
+inB = R("In", "B")
+inAB = R("In", "A", "B")
+notInA = R("NotIn", "A")
+in1 = R("In", "1")
+in9 = R("In", "9")
+in19 = R("In", "1", "9")
+notIn12 = R("NotIn", "1", "2")
+gt1 = R("Gt", "1")
+gt9 = R("Gt", "9")
+lt1 = R("Lt", "1")
+lt9 = R("Lt", "9")
+
+FIXTURES = [
+    ("exists", exists), ("dne", dne), ("inA", inA), ("inB", inB),
+    ("inAB", inAB), ("notInA", notInA), ("in1", in1), ("in9", in9),
+    ("in19", in19), ("notIn12", notIn12), ("gt1", gt1), ("gt9", gt9),
+    ("lt1", lt1), ("lt9", lt9),
+]
+
+# the complete Intersection table, rows/cols in FIXTURES order, each cell
+# transcribed from requirement_test.go:83-291
+INTERSECTION_TABLE = {
+    "exists": [exists, dne, inA, inB, inAB, notInA, in1, in9, in19,
+               notIn12, gt1, gt9, lt1, lt9],
+    "dne": [dne] * 14,
+    "inA": [inA, dne, inA, dne, inA, dne, dne, dne, dne, inA,
+            dne, dne, dne, dne],
+    "inB": [inB, dne, dne, inB, inB, inB, dne, dne, dne, inB,
+            dne, dne, dne, dne],
+    "inAB": [inAB, dne, inA, inB, inAB, inB, dne, dne, dne, inAB,
+             dne, dne, dne, dne],
+    "notInA": [notInA, dne, dne, inB, inB, notInA, in1, in9, in19,
+               CB({"A", "1", "2"}), gt1, gt9, lt1, lt9],
+    "in1": [in1, dne, dne, dne, dne, in1, in1, dne, in1, dne,
+            dne, dne, dne, in1],
+    "in9": [in9, dne, dne, dne, dne, in9, dne, in9, in9, in9,
+            in9, dne, dne, dne],
+    "in19": [in19, dne, dne, dne, dne, in19, in1, in9, in19, in9,
+             in9, dne, dne, in1],
+    "notIn12": [notIn12, dne, inA, inB, inAB, CB({"A", "1", "2"}),
+                dne, in9, in9, notIn12, CB({"2"}, gt=1), CB(gt=9),
+                CB(lt=1), CB({"1", "2"}, lt=9)],
+    "gt1": [gt1, dne, dne, dne, dne, gt1, dne, in9, in9,
+            CB({"2"}, gt=1), gt1, gt9, dne, CB(gt=1, lt=9)],
+    "gt9": [gt9, dne, dne, dne, dne, gt9, dne, dne, dne, gt9,
+            gt9, gt9, dne, dne],
+    "lt1": [lt1, dne, dne, dne, dne, lt1, dne, dne, dne, lt1,
+            dne, dne, lt1, lt1],
+    "lt9": [lt9, dne, dne, dne, dne, lt9, in1, dne, in1,
+            CB({"1", "2"}, lt=9), CB(gt=1, lt=9), dne, lt1, lt9],
+}
+
+
+def test_normalize_labels_across_construction_paths():
+    """requirement_test.go:45-79 — the 5 beta-label aliases normalize to
+    the stable keys through every Requirements construction path: label
+    map, NodeSelectorRequirement list, and the pod path (nodeSelector +
+    required + preferred node affinity)."""
+    from karpenter_core_tpu.kube.objects import (
+        LABEL_ARCH_STABLE,
+        LABEL_INSTANCE_TYPE_STABLE,
+        LABEL_OS_STABLE,
+        LABEL_TOPOLOGY_REGION,
+        LABEL_TOPOLOGY_ZONE,
+        NodeSelectorRequirement,
+        NodeSelectorTerm,
+        PreferredSchedulingTerm,
+    )
+    from karpenter_core_tpu.scheduling.requirements import Requirements
+    from karpenter_core_tpu.testing import make_pod
+
+    node_selector = {
+        "failure-domain.beta.kubernetes.io/zone": "test",
+        "failure-domain.beta.kubernetes.io/region": "test",
+        "beta.kubernetes.io/arch": "test",
+        "beta.kubernetes.io/os": "test",
+        "beta.kubernetes.io/instance-type": "test",
+    }
+    reqs = [
+        NodeSelectorRequirement(key=k, operator="In", values=[v])
+        for k, v in node_selector.items()
+    ]
+    want = {
+        LABEL_ARCH_STABLE,
+        LABEL_OS_STABLE,
+        LABEL_INSTANCE_TYPE_STABLE,
+        LABEL_TOPOLOGY_REGION,
+        LABEL_TOPOLOGY_ZONE,
+    }
+    pod = make_pod(
+        node_selector=dict(node_selector),
+        node_affinity_required=[NodeSelectorTerm(match_expressions=reqs)],
+        node_affinity_preferred=[
+            PreferredSchedulingTerm(
+                weight=1, preference=NodeSelectorTerm(match_expressions=reqs)
+            )
+        ],
+    )
+    for r in [
+        Requirements.from_labels(dict(node_selector)),
+        Requirements.from_node_selector_requirements(*reqs),
+        Requirements.from_pod(pod),
+    ]:
+        assert r.keys_set() == want, sorted(r.keys_set())
+
+
+@pytest.mark.parametrize("row", [name for name, _ in FIXTURES])
+def test_intersection_table(row):
+    """requirement_test.go:82-292 — the full pairwise table."""
+    left = dict(FIXTURES)[row]
+    for (col, right), want in zip(FIXTURES, INTERSECTION_TABLE[row]):
+        got = left.intersection(right)
+        assert got == want, f"{row} ∩ {col}: got {got!r}, want {want!r}"
+
+
+# Has table (requirement_test.go:295-370): per probed value, the expected
+# result per fixture in FIXTURES order
+HAS_TABLE = {
+    "A": [True, False, True, False, True, False, False, False, False,
+          True, False, False, False, False],
+    "B": [True, False, False, True, True, True, False, False, False,
+          True, False, False, False, False],
+    "1": [True, False, False, False, False, True, True, False, True,
+          False, False, False, False, True],
+    "2": [True, False, False, False, False, True, False, False, False,
+          False, True, False, False, True],
+    "9": [True, False, False, False, False, True, False, True, True,
+          True, True, False, False, False],
+}
+
+
+@pytest.mark.parametrize("value", sorted(HAS_TABLE))
+def test_has_table(value):
+    """requirement_test.go:295-370"""
+    for (name, req), want in zip(FIXTURES, HAS_TABLE[value]):
+        assert req.has(value) is want, f"{name}.has({value!r})"
+
+
+def test_operator_recovery():
+    """requirement_test.go:373-388 — Gt/Lt recover as Exists."""
+    want = ["Exists", "DoesNotExist", "In", "In", "In", "NotIn", "In",
+            "In", "In", "NotIn", "Exists", "Exists", "Exists", "Exists"]
+    for (name, req), op in zip(FIXTURES, want):
+        assert req.operator() == op, name
+
+
+def test_len_complement_counting():
+    """requirement_test.go:391-406 — complement sets count down from the
+    max-int universe."""
+    want = [MAX_LEN, 0, 1, 1, 2, MAX_LEN - 1, 1, 1, 2, MAX_LEN - 2,
+            MAX_LEN, MAX_LEN, MAX_LEN, MAX_LEN]
+    for (name, req), n in zip(FIXTURES, want):
+        assert req.len() == n, name
+
+
+def test_any():
+    """requirement_test.go:409-424"""
+    assert exists.any() != ""
+    assert dne.any() == ""
+    assert inA.any() == "A"
+    assert inB.any() == "B"
+    assert inAB.any() in ("A", "B")
+    assert notInA.any() not in ("", "A")
+    assert in1.any() == "1"
+    assert in9.any() == "9"
+    assert in19.any() in ("1", "9")
+    assert notIn12.any() not in ("", "1", "2")
+    assert int(gt1.any()) >= 1
+    assert 9 <= int(gt9.any()) < MAX_LEN
+    assert lt1.any() == "0"
+    assert 0 <= int(lt9.any()) < 9
+
+
+def test_string():
+    """requirement_test.go:427-444 — same cases, the repo's repr format
+    (python list syntax instead of Go's space-joined values)."""
+    assert repr(exists) == "key Exists"
+    assert repr(dne) == "key DoesNotExist"
+    assert repr(inA) == "key In ['A']"
+    assert repr(inB) == "key In ['B']"
+    assert repr(inAB) == "key In ['A', 'B']"
+    assert repr(notInA) == "key NotIn ['A']"
+    assert repr(in1) == "key In ['1']"
+    assert repr(in9) == "key In ['9']"
+    assert repr(in19) == "key In ['1', '9']"
+    assert repr(notIn12) == "key NotIn ['1', '2']"
+    assert repr(gt1) == "key Exists >1"
+    assert repr(gt9) == "key Exists >9"
+    assert repr(lt1) == "key Exists <1"
+    assert repr(lt9) == "key Exists <9"
+    assert repr(gt1.intersection(lt9)) == "key Exists >1 <9"
+    # an empty integer interval collapses to DoesNotExist
+    assert repr(gt9.intersection(lt1)) == "key DoesNotExist"
+
+
+def test_node_selector_requirement_conversion():
+    """requirement_test.go:447-462"""
+    cases = [
+        (exists, "Exists", []),
+        (dne, "DoesNotExist", []),
+        (inA, "In", ["A"]),
+        (inB, "In", ["B"]),
+        (inAB, "In", ["A", "B"]),
+        (notInA, "NotIn", ["A"]),
+        (in1, "In", ["1"]),
+        (in9, "In", ["9"]),
+        (in19, "In", ["1", "9"]),
+        (notIn12, "NotIn", ["1", "2"]),
+        (gt1, "Gt", ["1"]),
+        (gt9, "Gt", ["9"]),
+        (lt1, "Lt", ["1"]),
+        (lt9, "Lt", ["9"]),
+    ]
+    for req, op, values in cases:
+        nsr = req.to_node_selector_requirement()
+        assert nsr.key == "key"
+        assert nsr.operator == op
+        assert sorted(nsr.values or []) == values
