@@ -52,6 +52,9 @@ class ProvisioningController:
         self.batcher = Batcher()
         self.volume_topology = VolumeTopology(kube_client)
         self._mu = threading.Lock()
+        # (provisioners, instance_types) the LAST solve saw — the failure-
+        # explanation probe reads them so it never races provisioner churn
+        self._last_solve_inputs: Tuple[list, dict] = ([], {})
 
     # -- reconcile loop ----------------------------------------------------
 
@@ -76,10 +79,85 @@ class ProvisioningController:
             if self.recorder:
                 for pod in pods:
                     self.recorder.nominate_pod(pod, state_node.name())
-        for pod in result.failed_pods:
-            if self.recorder:
-                self.recorder.pod_failed_to_schedule(pod, "unschedulable")
+        if result.failed_pods and self.recorder:
+            # the host scheduler records exact per-pod causes in
+            # result.errors; the device solver reports WHICH pods failed
+            # but not why, so the remaining gaps are re-checked against
+            # the host constraint algebra — incompatible requirements
+            # (with typo hints), intolerable taints, or no fitting
+            # instance type — like the reference's per-pod solve errors
+            # (scheduler.go:96-133 via events.PodFailedToSchedule).
+            # Explanation must never cost the reconcile its result:
+            # machines are already launched at this point.
+            reasons = dict(getattr(result, "errors", None) or {})
+            missing = [
+                p for p in result.failed_pods
+                if not reasons.get(p.metadata.uid)
+            ]
+            if missing:
+                try:
+                    reasons.update(self._explain_failures(missing))
+                except Exception:  # noqa: BLE001 — events are best-effort
+                    pass
+            for pod in result.failed_pods:
+                self.recorder.pod_failed_to_schedule(
+                    pod, reasons.get(pod.metadata.uid) or "unschedulable"
+                )
         return created
+
+    def _explain_failures(self, failed: List[Pod]) -> Dict[str, str]:
+        """Template-level failure causes for failed pods, keyed by pod uid.
+        Probes each weighted template with the host checks the scheduler's
+        Machine.Add performs (taints -> requirements -> instance-type fit,
+        machine.go:62-107), against the SAME provisioners/instance-types
+        snapshot the solve used (stashed by schedule() — re-listing here
+        would race provisioner churn). A pod placeable on SOME template
+        failed for a batch-level reason (topology, limits, slot budget)
+        and keeps the generic message."""
+        from karpenter_core_tpu.controllers.provisioning.scheduling.machine import (
+            MachineTemplate,
+            filter_instance_types_by_requirements,
+        )
+        from karpenter_core_tpu.scheduling import taints as taints_mod
+        from karpenter_core_tpu.scheduling.requirements import Requirements
+        from karpenter_core_tpu.utils import resources as resources_util
+
+        reasons: Dict[str, str] = {}
+        provisioners, instance_types = self._last_solve_inputs
+        if not provisioners:
+            return reasons
+        templates = [
+            (MachineTemplate(p), instance_types.get(p.name, []))
+            for p in provisioners  # already weight-ordered by schedule()
+        ]
+        for pod in failed:
+            pod_reqs = Requirements.from_pod(pod)
+            requests = resources_util.requests_for_pods(pod)
+            err_msg = None
+            for template, types in templates:
+                err = taints_mod.tolerates(template.taints, pod)
+                if err is None:
+                    merged = Requirements(template.requirements.values())
+                    err = merged.compatible(pod_reqs)
+                    if err:
+                        err = f"incompatible requirements, {err}"
+                    else:
+                        merged.add(*pod_reqs.values())
+                        if not filter_instance_types_by_requirements(
+                            types, merged, requests
+                        ):
+                            err = (
+                                f"no instance type satisfied resources "
+                                f"{resources_util.to_string(requests)} "
+                                f"and requirements {merged!r}"
+                            )
+                if err is None:
+                    err_msg = None
+                    break  # placeable here: the failure was batch-level
+                err_msg = err
+            if err_msg:
+                reasons[pod.metadata.uid] = err_msg
+        return reasons
 
     def trigger(self) -> None:
         self.batcher.trigger()
@@ -159,16 +237,23 @@ class ProvisioningController:
                         pending.append(reschedule)
         if not pending:
             return None
-        provisioners = [
-            p
-            for p in self.kube_client.list("Provisioner")
-            if p.metadata.deletion_timestamp is None
-        ]
+        from karpenter_core_tpu.api.provisioner import order_by_weight
+
+        provisioners = order_by_weight(
+            [
+                p
+                for p in self.kube_client.list("Provisioner")
+                if p.metadata.deletion_timestamp is None
+            ]
+        )
         if not provisioners:
             return None
         instance_types = {
             p.name: self.cloud_provider.get_instance_types(p) for p in provisioners
         }
+        # the exact inputs this solve saw, for the failure-explanation
+        # probe (re-listing would race provisioner churn)
+        self._last_solve_inputs = (provisioners, instance_types)
         pending = [self.volume_topology.inject(copy.deepcopy(p)) for p in pending]
         daemonset_pods = self.get_daemonset_pods()
         try:

@@ -89,6 +89,10 @@ class SolveResult:
     existing_assignments: List[Tuple[object, List[Pod]]] = field(default_factory=list)
     failed_pods: List[Pod] = field(default_factory=list)
     rounds: int = 1
+    # pod uid -> failure cause, when the solver knows it (the host scheduler
+    # records exact per-pod errors; the device path leaves this empty and
+    # the provisioner's explain probe fills the gap)
+    errors: Dict[str, str] = field(default_factory=dict)
 
     def pod_count_new(self) -> int:
         return sum(len(m.pods) for m in self.new_machines)
@@ -1079,5 +1083,9 @@ class GreedySolver:
         ]
         existing = [(n.state_node, n.pods) for n in res.existing_nodes if n.pods]
         return SolveResult(
-            new_machines=machines, existing_assignments=existing, failed_pods=res.failed_pods
+            new_machines=machines, existing_assignments=existing,
+            failed_pods=res.failed_pods,
+            # the scheduler's exact per-pod causes (topology, hostports,
+            # limits included) ride along for the FailedScheduling events
+            errors=dict(res.errors),
         )

@@ -519,3 +519,26 @@ def test_eviction_queue_backoff_without_timer_threads():
         _time.sleep(0.05)
     q.stop()
     assert not client.list("Pod"), "all pods evicted after PDB unblocked"
+
+
+def test_failed_scheduling_events_explain_cause(env):
+    """The device solver reports which pods failed, not why; the
+    provisioner re-checks failures against the host algebra so the
+    FailedScheduling event explains the cause with the reference's
+    message shapes (machine.go:62-107 errors incl. the typo hint,
+    requirements.go:172-186)."""
+    op, cp, clock = env
+    op.kube_client.create(make_provisioner(name="default"))
+    op.kube_client.create(
+        make_pod(requests={"cpu": "1"}, node_selector={"zone": "test-zone-1"})
+    )
+    op.kube_client.create(make_pod(requests={"cpu": "10000"}))
+    op.step()
+    msgs = [e.message for e in list(op.recorder.events)
+            if e.reason == "FailedScheduling"]
+    assert any(
+        'label "zone" does not have known values '
+        '(typo of "topology.kubernetes.io/zone"?)' in m
+        for m in msgs
+    ), msgs
+    assert any("no instance type satisfied resources" in m for m in msgs), msgs

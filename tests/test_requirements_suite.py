@@ -1,0 +1,190 @@
+"""Spec-for-spec port of the Requirements (set-level) suite.
+
+Reference pkg/scheduling/requirements_test.go: aliased-label
+normalization (:27-31), the full 15x15 Compatible matrix over the
+zone-key fixtures (:50-290, every cell transcribed), the typo-hint error
+messages (:293-355), and NodeSelectorRequirements conversion (:358-407).
+The per-Requirement algebra tables live in tests/test_requirement_suite.py.
+"""
+import pytest
+
+from karpenter_core_tpu.kube.objects import (
+    LABEL_TOPOLOGY_ZONE,
+    NodeSelectorRequirement,
+)
+from karpenter_core_tpu.scheduling.requirement import Requirement
+from karpenter_core_tpu.scheduling.requirements import Requirements
+
+
+def RS(op=None, *values):
+    r = Requirements()
+    if op is not None:
+        r.add(Requirement(LABEL_TOPOLOGY_ZONE, op, list(values)))
+    return r
+
+
+def test_normalize_aliased_labels():
+    """requirements_test.go:27-31 — the beta zone alias lands under the
+    stable key."""
+    r = Requirements(
+        [Requirement("failure-domain.beta.kubernetes.io/zone", "In", ["test"])]
+    )
+    assert "failure-domain.beta.kubernetes.io/zone" not in r
+    assert r.get_requirement(LABEL_TOPOLOGY_ZONE).has("test")
+
+
+# fixtures in requirements_test.go:34-48 order
+FIXTURES = [
+    ("unconstrained", RS()),
+    ("exists", RS("Exists")),
+    ("doesNotExist", RS("DoesNotExist")),
+    ("inA", RS("In", "A")),
+    ("inB", RS("In", "B")),
+    ("inAB", RS("In", "A", "B")),
+    ("notInA", RS("NotIn", "A")),
+    ("in1", RS("In", "1")),
+    ("in9", RS("In", "9")),
+    ("in19", RS("In", "1", "9")),
+    ("notIn12", RS("NotIn", "1", "2")),
+    ("gt1", RS("Gt", "1")),
+    ("gt9", RS("Gt", "9")),
+    ("lt1", RS("Lt", "1")),
+    ("lt9", RS("Lt", "9")),
+]
+
+# Compatible matrix, rows/cols in FIXTURES order, transcribed from
+# requirements_test.go:51-289 (T = Succeed)
+T, F = True, False
+COMPATIBLE_TABLE = {
+    "unconstrained": [T, T, T, T, T, T, T, T, T, T, T, T, T, T, T],
+    "exists":        [T, T, F, T, T, T, T, T, T, T, T, T, T, T, T],
+    "doesNotExist":  [T, F, T, F, F, F, T, F, F, F, T, F, F, F, F],
+    "inA":           [T, T, F, T, F, T, F, F, F, F, T, F, F, F, F],
+    "inB":           [T, T, F, F, T, T, T, F, F, F, T, F, F, F, F],
+    "inAB":          [T, T, F, T, T, T, T, F, F, F, T, F, F, F, F],
+    "notInA":        [T, T, T, F, T, T, T, T, T, T, T, T, T, T, T],
+    "in1":           [T, T, F, F, F, F, T, T, F, T, F, F, F, F, T],
+    "in9":           [T, T, F, F, F, F, T, F, T, T, T, T, F, F, F],
+    "in19":          [T, T, F, F, F, F, T, T, T, T, T, T, F, F, T],
+    "notIn12":       [T, T, T, T, T, T, T, F, T, T, T, T, T, T, T],
+    "gt1":           [T, T, F, F, F, F, T, F, T, T, T, T, T, F, T],
+    "gt9":           [T, T, F, F, F, F, T, F, F, F, T, T, T, F, F],
+    "lt1":           [T, T, F, F, F, F, T, F, F, F, T, F, F, T, T],
+    "lt9":           [T, T, F, F, F, F, T, T, F, T, T, T, F, T, T],
+}
+
+
+@pytest.mark.parametrize("row", [name for name, _ in FIXTURES])
+def test_compatible_matrix(row):
+    """requirements_test.go:50-290 — the full pairwise Compatible table;
+    receiver is the node side."""
+    left = dict(FIXTURES)[row]
+    for (col, right), want in zip(FIXTURES, COMPATIBLE_TABLE[row]):
+        err = left.compatible(right)
+        ok = err is None
+        assert ok is want, f"{row}.compatible({col}): {err!r}"
+
+
+@pytest.mark.parametrize(
+    "bad,want",
+    [
+        ("zone", "topology.kubernetes.io/zone"),
+        ("region", "topology.kubernetes.io/region"),
+        ("provisioner-name", "karpenter.sh/provisioner-name"),
+        ("instance-type", "node.kubernetes.io/instance-type"),
+        ("arch", "kubernetes.io/arch"),
+        ("capacity-type", "karpenter.sh/capacity-type"),
+    ],
+)
+def test_detects_well_known_label_truncations(bad, want):
+    """requirements_test.go:293-327"""
+    unconstrained = Requirements()
+    prov = Requirements([Requirement(bad, "Exists")])
+    assert unconstrained.compatible(prov) == (
+        f'label "{bad}" does not have known values (typo of "{want}"?)'
+    )
+
+
+@pytest.mark.parametrize(
+    "bad,want",
+    [
+        ("topology.kubernetesio/zone", "topology.kubernetes.io/zone"),
+        ("topology.kubernetes.io/regio", "topology.kubernetes.io/region"),
+        ("karpenterprovisioner-name", "karpenter.sh/provisioner-name"),
+    ],
+)
+def test_detects_well_known_label_typos(bad, want):
+    """requirements_test.go:328-350"""
+    unconstrained = Requirements()
+    prov = Requirements([Requirement(bad, "Exists")])
+    assert unconstrained.compatible(prov) == (
+        f'label "{bad}" does not have known values (typo of "{want}"?)'
+    )
+
+
+def test_unknown_label_error_message():
+    """requirements_test.go:351-355 — no hint for a label nothing
+    resembles."""
+    unconstrained = Requirements()
+    prov = Requirements([Requirement("deployment", "Exists")])
+    assert unconstrained.compatible(prov) == (
+        'label "deployment" does not have known values'
+    )
+
+
+def test_node_selector_requirements_conversion():
+    """requirements_test.go:358-407 — every operator round-trips through
+    the set-level conversion."""
+    reqs = Requirements(
+        [
+            Requirement("exists", "Exists"),
+            Requirement("doesNotExist", "DoesNotExist"),
+            Requirement("inA", "In", ["A"]),
+            Requirement("inB", "In", ["B"]),
+            Requirement("inAB", "In", ["A", "B"]),
+            Requirement("notInA", "NotIn", ["A"]),
+            Requirement("in1", "In", ["1"]),
+            Requirement("in9", "In", ["9"]),
+            Requirement("in19", "In", ["1", "9"]),
+            Requirement("notIn12", "NotIn", ["1", "2"]),
+            Requirement("greaterThan1", "Gt", ["1"]),
+            Requirement("greaterThan9", "Gt", ["9"]),
+            Requirement("lessThan1", "Lt", ["1"]),
+            Requirement("lessThan9", "Lt", ["9"]),
+        ]
+    )
+    out = {r.key: r for r in (req.to_node_selector_requirement() for req in reqs.values())}
+    assert len(out) == 14
+    want = {
+        "exists": ("Exists", []),
+        "doesNotExist": ("DoesNotExist", []),
+        "inA": ("In", ["A"]),
+        "inB": ("In", ["B"]),
+        "inAB": ("In", ["A", "B"]),
+        "notInA": ("NotIn", ["A"]),
+        "in1": ("In", ["1"]),
+        "in9": ("In", ["9"]),
+        "in19": ("In", ["1", "9"]),
+        "notIn12": ("NotIn", ["1", "2"]),
+        "greaterThan1": ("Gt", ["1"]),
+        "greaterThan9": ("Gt", ["9"]),
+        "lessThan1": ("Lt", ["1"]),
+        "lessThan9": ("Lt", ["9"]),
+    }
+    for key, (op, values) in want.items():
+        nsr = out[key]
+        assert nsr.operator == op, key
+        assert sorted(nsr.values or []) == values, key
+
+
+def test_compatible_direction_custom_labels():
+    """requirements.go:123-133 — a custom label must be DEFINED on the
+    node side: node-with-label accepts the pod, bare node rejects it
+    (unless the pod side is NotIn/DoesNotExist)."""
+    node = Requirements([Requirement("team", "In", ["red"])])
+    pod = Requirements([Requirement("team", "In", ["red"])])
+    assert node.compatible(pod) is None
+    bare = Requirements()
+    assert bare.compatible(pod) is not None
+    negated = Requirements([Requirement("team", "NotIn", ["blue"])])
+    assert bare.compatible(negated) is None
