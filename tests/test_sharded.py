@@ -401,10 +401,23 @@ def test_quality_scaling_curve_across_mesh_sizes():
             pods.append(make_pod(labels={"app": "spread"}, requests={"cpu": "1"},
                                  topology_spread=[zonal_spread()]))
         elif k == 1:
-            pods.append(make_pod(requests={"cpu": "1"}, host_ports=[7000 + i % 3]))
+            # three distinct ports so port packing (3 pods per node max
+            # among these) is a real constraint, not a 1-per-node floor
+            pods.append(
+                make_pod(requests={"cpu": "1"},
+                         host_ports=[7000 + (i // 6) % 3])
+            )
+        elif k == 2:
+            # per-group zonal spreads: five distinct topology components
+            # that plan_shards must route whole, exercising component
+            # routing (not just free-item splitting) at every dp
+            g = f"g-{i % 30 // 6}"
+            pods.append(
+                make_pod(labels={"app": g}, requests={"cpu": "1"},
+                         topology_spread=[zonal_spread(app=g)])
+            )
         else:
-            pods.append(make_pod(labels={"app": f"g-{i % 5}"},
-                                 requests={"cpu": "1", "memory": "1Gi"}))
+            pods.append(make_pod(requests={"cpu": "1", "memory": "1Gi"}))
     provs = [make_provisioner(name="default")]
     its = {"default": fake.instance_types(8)}
 
