@@ -23,7 +23,11 @@ to one 'dp' shard (LPT on replica counts), so every group's counts evolve
 on exactly one device and the per-shard solve follows the reference
 semantics (topologygroup.go:155-243) with no cross-shard races.
 Topology-free items still split evenly. Every shard carries the full
-[G, V] count state; only its own groups' rows ever change.
+[G, V] count state; only its own groups' rows ever change. SLOT-LOCAL
+hostname groups are the exception and split freely: hostname spread
+(round 4 of the previous session) and hostname anti-affinity (round 4 —
+separation across disjoint shard slots can only over-satisfy the
+constraint; see plan_shards).
 
 Existing nodes (round 2): each existing node is OWNED by one shard
 (round-robin); all shards carry the slots [0, E) at the same indices but
@@ -86,12 +90,34 @@ def plan_shards(snap, ndp: int) -> Tuple[np.ndarray, np.ndarray]:
         # pin min=0 on every shard, as globally). Routing them whole was
         # round 3's dominant packing-quality loss: the one shard holding
         # the hostname component monopolized the colocation headroom that
-        # other shards' hostPort/generic pods needed. Affinity and
-        # anti-affinity stay routed (their assume/seed semantics are not
-        # slot-local).
+        # other shards' hostPort/generic pods needed.
+        #
+        # hostname ANTI groups (direct and inverse, no filter terms) split
+        # freely too: the constraint is pairwise SEPARATION on the slot
+        # axis, so placing its pods on different shards' disjoint slots can
+        # only over-satisfy it — owners repel selector-matching pods, which
+        # therefore could never have co-located with them anyway, and the
+        # within-shard thost lane enforces the rule among same-shard
+        # replicas exactly. Existing slots are owned by one shard, so the
+        # identically-seeded existing columns never race. Value-key
+        # affinity/anti stay routed (their assume/seed semantics span
+        # shards through the shared domain counts).
         touch = touch.copy()
         for g, gm in enumerate(snap.topo_meta.groups):
-            if gm.is_hostname and gm.gtype == topo_mod.TOPO_SPREAD and not gm.is_inverse:
+            if not gm.is_hostname:
+                continue
+            if gm.gtype == topo_mod.TOPO_SPREAD and not gm.is_inverse:
+                # spread groups always carry the pod's node-filter term
+                # row; the filter constrains WHICH nodes count, not the
+                # cross-shard accounting, so it doesn't gate the split
+                touch[g, :] = False
+            elif (
+                gm.gtype == topo_mod.TOPO_ANTI
+                and len(gm.filter_term_rows) == 0
+            ):
+                # anti groups have no node filter in the reference;
+                # guard anyway — a filtered variant would make per-slot
+                # admission row-dependent
                 touch[g, :] = False
         G = touch.shape[0]
         parent = list(range(G))

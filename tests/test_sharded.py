@@ -440,3 +440,55 @@ def test_quality_scaling_curve_across_mesh_sizes():
         assert nodes <= int(base * (1.0 + 0.10 * (ndp.bit_length() - 1))) + 1, (
             f"dp={ndp}: {nodes} nodes vs single-device {base} ({curve})"
         )
+
+
+def test_hostname_anti_splits_freely_across_shards(mesh):
+    """Hostname anti-affinity components split across dp shards (their
+    constraint is pairwise separation on the slot axis, which disjoint
+    shard slots can only over-satisfy); the result still holds one
+    replica per node per selector group and matches single-device
+    packing quality."""
+    def anti(g):
+        return make_pod(
+            labels={"app": g},
+            requests={"cpu": "1"},
+            pod_anti_affinity_required=[
+                PodAffinityTerm(
+                    topology_key=LABEL_HOSTNAME,
+                    label_selector=LabelSelector(match_labels={"app": g}),
+                )
+            ],
+        )
+
+    pods = [anti(f"svc-{i % 2}") for i in range(48)]
+    pods += [make_pod(requests={"cpu": "0.5"}) for _ in range(32)]
+    provs = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(8)}
+
+    snap = encode_snapshot(pods, provs, its, max_nodes=64)
+    count_split, _ = plan_shards(snap, 4)
+    # the two anti classes are bulk items whose replicas spread over >1
+    # shard (free split), not routed whole
+    anti_items = [
+        i for i in range(len(snap.item_counts))
+        if (snap.pods[snap.item_members[i][0]].metadata.labels or {})
+        .get("app", "").startswith("svc-")
+        and int(snap.item_counts[i]) == 24
+    ]
+    assert len(anti_items) == 2, "anti classes must stay bulk (one per svc)"
+    for i in anti_items:
+        assert int((count_split[:, i] > 0).sum()) > 1, (
+            f"anti item {i} routed whole: {count_split[:, i]}"
+        )
+
+    sh, dv = run_both(mesh, pods, provs, its)
+    assert not sh.failed_pods and not dv.failed_pods
+    for m in sh.new_machines:
+        per = {}
+        for p in m.pods:
+            app = (p.metadata.labels or {}).get("app", "")
+            if app.startswith("svc-"):
+                per[app] = per.get(app, 0) + 1
+        assert all(v == 1 for v in per.values()), per
+    # quality parity with the single-device solve
+    assert len(sh.new_machines) <= len(dv.new_machines) + 2
