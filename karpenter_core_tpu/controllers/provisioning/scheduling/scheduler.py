@@ -233,6 +233,14 @@ def build_scheduler(
         p for p in order_by_weight(provisioners) if p.metadata.deletion_timestamp is None
     ]
     templates = [MachineTemplate(p) for p in provisioners]
+    # CSI attach limits: snapshots that bypassed the cluster informer
+    # (direct API use, tests) resolve them from the CSINode objects here —
+    # only for owned nodes, the ones the Scheduler will actually pack
+    from karpenter_core_tpu.state.node import resolve_volume_limits
+
+    resolve_volume_limits(
+        [n for n in (state_nodes or []) if n.owned()], kube_client
+    )
     domains = build_domains(provisioners, instance_types)
     topology = Topology(kube_client, cluster, domains, pods)
     return Scheduler(

@@ -482,6 +482,11 @@ def encode_snapshot(
     daemonset_pods = daemonset_pods or []
     # only nodes launched by us participate (scheduler.go:226-229)
     state_nodes = [n for n in (state_nodes or []) if n.owned()]
+    # CSI attach limits ride the state nodes; snapshots that bypassed the
+    # cluster informer (gRPC boundary, direct API use) resolve them here
+    from karpenter_core_tpu.state.node import resolve_volume_limits
+
+    resolve_volume_limits(state_nodes, kube_client)
     provisioners = [
         p for p in order_by_weight(provisioners) if p.metadata.deletion_timestamp is None
     ]

@@ -244,12 +244,8 @@ class Cluster:
             pass
 
     def _populate_volume_limits(self, state_node: StateNode) -> None:
-        """CSINode driver limits (cluster.go:430-444)."""
-        if state_node.node is None:
-            return
-        csinode = self.kube_client.get("CSINode", "", state_node.node.metadata.name)
-        if csinode is None:
-            return
-        for driver in csinode.drivers:
-            if driver.allocatable_count is not None:
-                state_node.volume_limits[driver.name] = driver.allocatable_count
+        """CSINode driver limits (cluster.go:430-444) — the shared rule,
+        re-applied on every node update so limits stay informer-fresh."""
+        from karpenter_core_tpu.state.node import populate_volume_limits_from
+
+        populate_volume_limits_from(self.kube_client, state_node)

@@ -227,3 +227,41 @@ def nomination_window(settings: Optional[Settings] = None) -> float:
 
     s = settings or current()
     return max(10.0, 2.0 * s.batch_max_duration)
+
+
+def populate_volume_limits_from(kube_client, state_node: "StateNode") -> None:
+    """THE CSINode -> volume_limits rule (reference cluster.go:430-444):
+    copy each driver's allocatable count onto the state node. Shared by
+    the cluster informer (which re-applies it on every node update) and
+    resolve_volume_limits below, so the resolution rule cannot drift."""
+    if state_node.node is None:
+        return
+    csinode = kube_client.get("CSINode", "", state_node.node.metadata.name)
+    if csinode is None:
+        return
+    for driver in csinode.drivers:
+        if driver.allocatable_count is not None:
+            state_node.volume_limits[driver.name] = driver.allocatable_count
+
+
+def resolve_volume_limits(state_nodes, kube_client) -> None:
+    """Fill EMPTY StateNode.volume_limits from the kube CSINode objects.
+    Solvers consuming state_nodes that did not come from a synced Cluster
+    (direct API use, the gRPC service boundary, tests) would otherwise
+    treat every existing node as unlimited and overfill CSI attach
+    capacity.
+
+    Already-populated nodes are left untouched: cluster-synced snapshots
+    carry informer-fresh limits (the informer re-applies the rule on
+    every node update), and refreshing them here would issue one client
+    get per existing node per solve — a REST storm through the apiserver
+    transport. The contract this relies on: StateNode lists handed to a
+    solve are per-solve SNAPSHOTS (every caller builds or deep-copies
+    them per request); a bypass-path caller must not reuse StateNode
+    objects across solves while CSINode limits change underneath."""
+    if kube_client is None:
+        return
+    for sn in state_nodes or []:
+        if sn.node is None or sn.volume_limits:
+            continue
+        populate_volume_limits_from(kube_client, sn)

@@ -204,3 +204,76 @@ def test_shared_claim_counts_once():
     assert not tpu.failed_pods
     # both pods share one attachment: both fit on the limit-1 node
     assert tpu.pod_count_existing() == host.pod_count_existing() == 2
+
+
+def test_volume_limits_resolve_from_csinode_without_cluster():
+    """CSI attach limits must bind even when state_nodes bypass the
+    cluster informer (the gRPC boundary / direct API shape): both solver
+    paths resolve them from the CSINode objects (state/node.py
+    resolve_volume_limits; reference cluster.go:430-444 +
+    existingnode.go:62-115). Regression: found by the deep fuzz sweep —
+    an existing node took 4 distinct claims against a limit of 3."""
+    from karpenter_core_tpu.api.labels import (
+        LABEL_CAPACITY_TYPE,
+        LABEL_NODE_INITIALIZED,
+        PROVISIONER_NAME_LABEL_KEY,
+    )
+    from karpenter_core_tpu.kube.client import InMemoryKubeClient
+    from karpenter_core_tpu.kube.objects import (
+        CSINode,
+        CSINodeDriver,
+        LABEL_INSTANCE_TYPE_STABLE,
+        LABEL_TOPOLOGY_ZONE,
+        ObjectMeta,
+        PersistentVolumeClaim,
+        PersistentVolumeClaimSpec,
+        PersistentVolumeClaimVolumeSource,
+        StorageClass,
+        Volume,
+    )
+    from karpenter_core_tpu.solver.tpu_solver import GreedySolver, TPUSolver
+    from karpenter_core_tpu.state.node import StateNode
+    from karpenter_core_tpu.testing import make_node
+
+    kube = InMemoryKubeClient()
+    kube.create(StorageClass(metadata=ObjectMeta(name="sc", namespace=""),
+                             provisioner="x.csi"))
+    pods = []
+    for i in range(5):
+        name = f"c{i}"
+        kube.create(PersistentVolumeClaim(
+            metadata=ObjectMeta(name=name, namespace="default"),
+            spec=PersistentVolumeClaimSpec(storage_class_name="sc")))
+        p = make_pod(requests={"cpu": "1"})
+        p.spec.volumes.append(Volume(
+            name=name,
+            persistent_volume_claim=PersistentVolumeClaimVolumeSource(
+                claim_name=name)))
+        pods.append(p)
+    universe = fake.instance_types(12)
+    it = universe[8]  # 9-cpu type: capacity would admit all 5
+    node = make_node(
+        name="e0",
+        labels={
+            PROVISIONER_NAME_LABEL_KEY: "default",
+            LABEL_NODE_INITIALIZED: "true",
+            LABEL_INSTANCE_TYPE_STABLE: it.name,
+            LABEL_CAPACITY_TYPE: "on-demand",
+            LABEL_TOPOLOGY_ZONE: "test-zone-1",
+        },
+        capacity={k: str(v) for k, v in it.capacity.items()},
+    )
+    nodes = [StateNode(node=node)]
+    kube.create(CSINode(metadata=ObjectMeta(name="e0"),
+                        drivers=[CSINodeDriver(name="x.csi",
+                                               allocatable_count=3)]))
+    provs = [make_provisioner(name="default")]
+    for solver in (TPUSolver(max_nodes=8), GreedySolver()):
+        res = solver.solve(
+            pods, provs, {"default": universe},
+            state_nodes=[n.deep_copy() for n in nodes], kube_client=kube,
+        )
+        assert not res.failed_pods
+        for _n, ps in res.existing_assignments:
+            assert len(ps) == 3, "CSI limit must cap the existing node at 3"
+        assert sum(len(m.pods) for m in res.new_machines) == 2
