@@ -211,6 +211,11 @@ def run(cloud_provider, kube_client=None, stop_event=None, options=None):
     opts = options or parse_options([])
     configure_logging()
     opts.apply_memory_limit()
+    # restart-survivable compiled programs: a rebooted control plane must
+    # not blank provisioning for the cold-compile window (utils/compilecache)
+    from karpenter_core_tpu.utils.compilecache import enable_persistent_cache
+
+    enable_persistent_cache()
     if kube_client is None:
         from karpenter_core_tpu.kube.client import InMemoryKubeClient
 
@@ -222,9 +227,18 @@ def run(cloud_provider, kube_client=None, stop_event=None, options=None):
     else:
         primary = solver_from_env()
         if primary is None:
-            from karpenter_core_tpu.solver.tpu_solver import TPUSolver
+            # mesh autodetection: >1 visible device -> the multi-chip
+            # ShardedSolver, else single-chip TPUSolver (solver/factory.py —
+            # the production analog of Solve being THE entry,
+            # provisioner.go:297-301, with the v5e-4 fan-out built in)
+            from karpenter_core_tpu.solver.factory import build_solver, describe
 
-            primary = TPUSolver()
+            primary = build_solver()
+            import logging
+
+            logging.getLogger(__name__).info(
+                "in-process solver: %s", describe(primary)
+            )
     # production backend-failure defense: subprocess-probe the accelerator,
     # route solves to the host greedy path while it is wedged/unavailable,
     # re-probe for recovery (solver/fallback.py)

@@ -63,25 +63,36 @@ def plan_shards(snap, ndp: int) -> Tuple[np.ndarray, np.ndarray]:
         snap.item_counts
         if snap.item_counts is not None
         else np.ones(len(snap.pods), dtype=np.int32)
-    ).astype(np.int64)
-    I = len(counts)
+    )
     # the exist axis is bucket-padded at encode; sentinel rows [E_real, E_pad)
     # stay unowned, i.e. closed on every shard
     E_pad = snap.exist_used.shape[0] if snap.exist_used is not None else 0
     E = len(snap.state_nodes)
+    touch = None
+    if snap.topo_meta is not None and len(snap.topo_meta.groups) > 0:
+        rep = snap.item_rep
+        touch = (snap.topo_arrays.owner | snap.topo_arrays.sel)[:, rep]  # [G, I]
+    return plan_shards_arrays(counts, E, E_pad, ndp, touch, snap.topo_meta)
+
+
+def plan_shards_arrays(counts, E_real: int, E_pad: int, ndp: int,
+                       touch=None, topo_meta=None) -> Tuple[np.ndarray, np.ndarray]:
+    """Array-level core of plan_shards: counts [I] replica counts per item,
+    touch [G, I] bool (item owns/selects into group g) or None. Shared by
+    the snapshot path (plan_shards) and the gRPC service, which rebuilds
+    `touch` from the wire tensors (pod_arrays/topo_own|topo_sel)."""
+    counts = np.asarray(counts).astype(np.int64)
+    I = len(counts)
     exist_owner = np.zeros((ndp, E_pad), dtype=bool)
-    for e in range(E):
+    for e in range(E_real):
         exist_owner[e % ndp, e] = True
 
     count_split = np.tile(counts // ndp, (ndp, 1)).astype(np.int32)
     for d in range(ndp):
         count_split[d] += (counts % ndp > d)
 
-    if snap.topo_meta is not None and len(snap.topo_meta.groups) > 0:
+    if touch is not None and topo_meta is not None and len(topo_meta.groups) > 0:
         from karpenter_core_tpu.ops import topology as topo_mod
-
-        rep = snap.item_rep
-        touch = (snap.topo_arrays.owner | snap.topo_arrays.sel)[:, rep]  # [G, I]
         # hostname SPREAD groups split freely: their counts live in the
         # per-SLOT thost lane and slots are disjoint across dp shards (fresh
         # slots open on one shard; existing slots are owned), so every
@@ -103,7 +114,7 @@ def plan_shards(snap, ndp: int) -> Tuple[np.ndarray, np.ndarray]:
         # affinity/anti stay routed (their assume/seed semantics span
         # shards through the shared domain counts).
         touch = touch.copy()
-        for g, gm in enumerate(snap.topo_meta.groups):
+        for g, gm in enumerate(topo_meta.groups):
             if not gm.is_hostname:
                 continue
             if gm.gtype == topo_mod.TOPO_SPREAD and not gm.is_inverse:
@@ -178,215 +189,208 @@ def plan_shards(snap, ndp: int) -> Tuple[np.ndarray, np.ndarray]:
     return count_split, exist_owner
 
 
-def make_sharded_solve(snap, provisioners, mesh, max_nodes_per_shard: int = 256,
-                       program_cache=None):
-    """Build (fn, args, plan) where fn is a jit-compiled shard_map program
-    over `mesh` (axes 'dp' and 'tp'), args are the host arrays, and plan is
-    (count_split, exist_owner) for decoding.
-
-    Type-axis arrays must divide by mesh.shape['tp'] (the caller pads —
-    see pad_types). Supports topology constraints and existing nodes via
-    component routing / slot ownership (module docstring).
-    """
+def make_sharded_run(segments, zone_seg, ct_seg, topo_meta, n_slots, mesh,
+                     log_len: Optional[int] = None,
+                     screen_v: Optional[int] = None):
+    """Build the jit-compiled shard_map program over `mesh` (axes 'dp' and
+    'tp') from GEOMETRY alone — the sharded analog of
+    tpu_solver.make_device_run, shared by make_sharded_solve (snapshot path)
+    and the gRPC SolverService (which reconstructs geometry from the wire).
+    All other dims derive from argument shapes at trace time."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
     from karpenter_core_tpu.ops.feasibility import feasibility_static, openable_mask
     from karpenter_core_tpu.ops.pack import PackState, make_pack_kernel
-    from karpenter_core_tpu.solver.tpu_solver import device_args, solve_geometry
 
-    geom = solve_geometry(snap, max_nodes_per_shard)
-    (_, J, T, E, R, K, V, N, segments_t, zone_seg, ct_seg, _topo_sig,
-     log_len, _Q, _W, _D, screen_v) = geom
-    segments = list(segments_t)
-    ndp = mesh.shape["dp"]
-    ntp = mesh.shape["tp"]
-    # N = snap.n_slots (E includes the encode-time bucket padding) — the
-    # topo hcounts arrays are sized to it, so the slot axis must match
-    has_topo = snap.topo_meta is not None and len(snap.topo_meta.groups) > 0
-    G = len(snap.topo_meta.groups) if has_topo else 0
-    count_split, exist_owner = plan_shards(snap, ndp)
+    segments = list(segments)
+    N = n_slots
+    has_topo = topo_meta is not None and len(topo_meta.groups) > 0
+    pack = make_pack_kernel(segments, zone_seg, ct_seg,
+                            topo_meta=topo_meta,
+                            screen_v=screen_v)
 
-    # the shard_map program is pure in everything but the label geometry
-    # (+ topo signature, baked into geom) and the mesh shape: cache it so
-    # steady-state solves and relaxation rounds reuse one compiled program
-    cache_key = (geom, ndp, ntp)
-    fn = None if program_cache is None else program_cache.get(cache_key)
-    if fn is None:
-        pack = make_pack_kernel(segments, zone_seg, ct_seg,
-                                topo_meta=snap.topo_meta,
-                                screen_v=screen_v)
-
-        def body(pod_arrays, count_split, tmpl, tmpl_daemon, tmpl_type_mask_l,
-                 types_l, type_offering_ok_l, types_full, type_alloc,
-                 type_capacity, type_offering_ok, pod_tol_all, exist, exist_used,
-                 exist_cap, exist_owner, well_known, remaining_split,
-                 topo_counts0, topo_hcounts0, topo_doms0, topo_terms,
-                 exist_ports, exist_vols, exist_vol_limits, vol_driver):
-            # ---- type-sharded feasibility + all_gather over 'tp' -------------
-            f_local = feasibility_static(
-                {k: pod_arrays[k] for k in ("allow", "out", "defined", "escape")},
-                tmpl,
-                types_l,
-                pod_arrays["tol_tmpl"],
-                tmpl_type_mask_l,
-                type_offering_ok_l,
-                zone_seg,
-                ct_seg,
-                segments,
-                well_known,
-            )  # [J, I, T_local]
-            f_static = jax.lax.all_gather(f_local, "tp", axis=3, tiled=False)
-            f_static = jnp.moveaxis(f_static, 3, 2).reshape(
-                f_local.shape[0], f_local.shape[1], -1
-            )
-
-            openable = openable_mask(
-                f_static, pod_arrays["requests"], tmpl_daemon, type_alloc
-            )
-            mine = exist_owner[0]  # [E] this shard's existing slots
-            slot_exist = jnp.arange(N) < E
-            open0 = jnp.where(slot_exist, jnp.pad(mine, (0, N - E)), False)
-            state = PackState(
-                used=jnp.zeros((N, R), jnp.float32).at[:E].set(exist_used),
-                open=open0,
-                is_existing=open0,
-                tmpl=jnp.zeros(N, jnp.int32),
-                tol_idx=jnp.concatenate(
-                    [J + jnp.arange(E, dtype=jnp.int32), jnp.zeros(N - E, jnp.int32)]
-                ),
-                pods=jnp.zeros(N, jnp.int32),
-                allow=jnp.ones((N, V), bool).at[:E].set(exist["allow"]),
-                out=jnp.ones((N, K), bool).at[:E].set(exist["out"]),
-                defined=jnp.zeros((N, K), bool).at[:E].set(exist["defined"]),
-                tmask=jnp.zeros((N, T), bool),
-                cap=jnp.zeros((N, R), jnp.float32).at[:E].set(exist_cap),
-                nopen=jnp.int32(E),
-                remaining=remaining_split[0],
-                tcounts=topo_counts0,
-                thost=topo_hcounts0,
-                tdoms=topo_doms0,
-                ports=jnp.zeros((N, exist_ports.shape[1]), bool).at[:E].set(
-                    exist_ports
-                ),
-                vols=exist_vols,
-            )
-            pod_arrays = dict(pod_arrays)
-            pod_arrays["tol"] = pod_tol_all
-            # this shard's share of each class's replicas
-            pod_arrays["count"] = count_split[0]
-            tmpl_type_mask = jax.lax.all_gather(tmpl_type_mask_l, "tp", axis=2, tiled=False)
-            tmpl_type_mask = jnp.moveaxis(tmpl_type_mask, 2, 1).reshape(J, -1)
-            state, log, ptr = pack(
-                state,
-                pod_arrays,
-                f_static,
-                openable,
-                {k: tmpl[k] for k in ("allow", "out", "defined")},
-                tmpl_daemon,
-                tmpl_type_mask,
-                types_full,
-                type_alloc,
-                type_capacity,
-                type_offering_ok,
-                well_known=well_known,
-                topo_terms=topo_terms,
-                log_len=log_len,
-                n_exist=E,
-                vol_limits=exist_vol_limits,
-                vol_driver=vol_driver,
-            )
-            # global stats via psum over dp: pods scheduled (an ICI collective)
-            scheduled = jax.lax.psum(state.pods.sum(), "dp")
-            # rank-0 per-shard values need a singleton axis to concatenate over dp
-            state = state._replace(nopen=state.nopen[None])
-            log = {**log, "bulk_n": log["bulk_n"][None]}
-            return log, ptr[None], state, scheduled
-
-        # item rows replicate; only the per-shard replica counts shard over dp
-        pod_spec = {
-            "allow": P(None, None),
-            "out": P(None, None),
-            "defined": P(None, None),
-            "escape": P(None, None),
-            "custom_deny": P(None, None),
-            "requests": P(None, None),
-            "tol_tmpl": P(None, None),
-            "ports": P(None, None),
-            "port_conflict": P(None, None),
-            "vols": P(None, None),
-            "valid": P(None),
-        }
-        if has_topo:
-            pod_spec["topo_own"] = P(None, None)
-            pod_spec["topo_sel"] = P(None, None)
-        reqset_rep = {k: P(None, None) for k in ("allow", "out", "defined", "escape")}
-        reqset_tp = {k: P("tp", None) for k in ("allow", "out", "defined", "escape")}
-        in_specs = (
-            pod_spec,  # pod_arrays
-            P("dp", None),  # count_split [ndp, I]
-            reqset_rep,  # tmpl
-            P(None, None),  # tmpl_daemon
-            P(None, "tp"),  # tmpl_type_mask_l
-            reqset_tp,  # types_l
-            P("tp", None, None),  # type_offering_ok_l
-            reqset_rep,  # types_full (replicated for packing)
-            P(None, None),  # type_alloc
-            P(None, None),  # type_capacity
-            P(None, None, None),  # type_offering_ok
-            P(None, None),  # pod_tol_all
-            reqset_rep,  # exist
-            P(None, None),  # exist_used
-            P(None, None),  # exist_cap
-            P("dp", None),  # exist_owner [ndp, E]
-            P(None),  # well_known
-            P("dp", None, None),  # remaining_split [ndp, J, R]
-            P(None, None),  # topo_counts0 [G, V]
-            P(None, None),  # topo_hcounts0 [G, N]
-            P(None, None),  # topo_doms0 [G, V]
-            {k: P(None, None) for k in ("allow", "out", "defined", "escape")},  # topo_terms
-            P(None, None),  # exist_ports [E, Q]
-            P(None, None),  # exist_vols [E, W]
-            P(None, None),  # exist_vol_limits [E, D]
-            P(None, None),  # vol_driver [W, D]
+    def body(pod_arrays, count_split, tmpl, tmpl_daemon, tmpl_type_mask_l,
+             types_l, type_offering_ok_l, types_full, type_alloc,
+             type_capacity, type_offering_ok, pod_tol_all, exist, exist_used,
+             exist_cap, exist_owner, well_known, remaining_split,
+             topo_counts0, topo_hcounts0, topo_doms0, topo_terms,
+             exist_ports, exist_vols, exist_vol_limits, vol_driver):
+        E = exist_used.shape[0]
+        R = exist_used.shape[1]
+        J = tmpl_daemon.shape[0]
+        T = type_alloc.shape[0]
+        V = pod_arrays["allow"].shape[1]
+        K = pod_arrays["out"].shape[1]
+        # ---- type-sharded feasibility + all_gather over 'tp' -------------
+        f_local = feasibility_static(
+            {k: pod_arrays[k] for k in ("allow", "out", "defined", "escape")},
+            tmpl,
+            types_l,
+            pod_arrays["tol_tmpl"],
+            tmpl_type_mask_l,
+            type_offering_ok_l,
+            zone_seg,
+            ct_seg,
+            segments,
+            well_known,
+        )  # [J, I, T_local]
+        f_static = jax.lax.all_gather(f_local, "tp", axis=3, tiled=False)
+        f_static = jnp.moveaxis(f_static, 3, 2).reshape(
+            f_local.shape[0], f_local.shape[1], -1
         )
-        out_specs = (
-            {
-                **{k: P("dp") for k in ("item", "slot", "ns", "k", "k_last", "bulk_n")},
-                "bulk_take": P("dp", None),
-            },  # commit log
-            P("dp"),  # log ptr (singleton axis per shard)
-            PackState(
-                used=P("dp", None),
-                open=P("dp"),
-                is_existing=P("dp"),
-                tmpl=P("dp"),
-                tol_idx=P("dp"),
-                pods=P("dp"),
-                allow=P("dp", None),
-                out=P("dp", None),
-                defined=P("dp", None),
-                tmask=P("dp", None),
-                cap=P("dp", None),
-                nopen=P("dp"),
-                remaining=P("dp", None),
-                tcounts=P("dp", None),
-                thost=P("dp", None),
-                tdoms=P("dp", None),
-                ports=P("dp", None),
-                vols=P("dp", None),
+
+        openable = openable_mask(
+            f_static, pod_arrays["requests"], tmpl_daemon, type_alloc
+        )
+        mine = exist_owner[0]  # [E] this shard's existing slots
+        slot_exist = jnp.arange(N) < E
+        open0 = jnp.where(slot_exist, jnp.pad(mine, (0, N - E)), False)
+        state = PackState(
+            used=jnp.zeros((N, R), jnp.float32).at[:E].set(exist_used),
+            open=open0,
+            is_existing=open0,
+            tmpl=jnp.zeros(N, jnp.int32),
+            tol_idx=jnp.concatenate(
+                [J + jnp.arange(E, dtype=jnp.int32), jnp.zeros(N - E, jnp.int32)]
             ),
-            P(),  # scheduled count (replicated)
+            pods=jnp.zeros(N, jnp.int32),
+            allow=jnp.ones((N, V), bool).at[:E].set(exist["allow"]),
+            out=jnp.ones((N, K), bool).at[:E].set(exist["out"]),
+            defined=jnp.zeros((N, K), bool).at[:E].set(exist["defined"]),
+            tmask=jnp.zeros((N, T), bool),
+            cap=jnp.zeros((N, R), jnp.float32).at[:E].set(exist_cap),
+            nopen=jnp.int32(E),
+            remaining=remaining_split[0],
+            tcounts=topo_counts0,
+            thost=topo_hcounts0,
+            tdoms=topo_doms0,
+            ports=jnp.zeros((N, exist_ports.shape[1]), bool).at[:E].set(
+                exist_ports
+            ),
+            vols=exist_vols,
         )
+        pod_arrays = dict(pod_arrays)
+        pod_arrays["tol"] = pod_tol_all
+        # this shard's share of each class's replicas
+        pod_arrays["count"] = count_split[0]
+        tmpl_type_mask = jax.lax.all_gather(tmpl_type_mask_l, "tp", axis=2, tiled=False)
+        tmpl_type_mask = jnp.moveaxis(tmpl_type_mask, 2, 1).reshape(J, -1)
+        state, log, ptr = pack(
+            state,
+            pod_arrays,
+            f_static,
+            openable,
+            {k: tmpl[k] for k in ("allow", "out", "defined")},
+            tmpl_daemon,
+            tmpl_type_mask,
+            types_full,
+            type_alloc,
+            type_capacity,
+            type_offering_ok,
+            well_known=well_known,
+            topo_terms=topo_terms,
+            log_len=log_len,
+            n_exist=E,
+            vol_limits=exist_vol_limits,
+            vol_driver=vol_driver,
+        )
+        # global stats via psum over dp: pods scheduled (an ICI collective)
+        scheduled = jax.lax.psum(state.pods.sum(), "dp")
+        # rank-0 per-shard values need a singleton axis to concatenate over dp
+        state = state._replace(nopen=state.nopen[None])
+        log = {**log, "bulk_n": log["bulk_n"][None]}
+        return log, ptr[None], state, scheduled
 
-        sharded = jax.shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                                check_vma=False)
-        fn = jax.jit(sharded)
-        if program_cache is not None:
-            program_cache[cache_key] = fn
+    # item rows replicate; only the per-shard replica counts shard over dp
+    pod_spec = {
+        "allow": P(None, None),
+        "out": P(None, None),
+        "defined": P(None, None),
+        "escape": P(None, None),
+        "custom_deny": P(None, None),
+        "requests": P(None, None),
+        "tol_tmpl": P(None, None),
+        "ports": P(None, None),
+        "port_conflict": P(None, None),
+        "vols": P(None, None),
+        "valid": P(None),
+    }
+    if has_topo:
+        pod_spec["topo_own"] = P(None, None)
+        pod_spec["topo_sel"] = P(None, None)
+    reqset_rep = {k: P(None, None) for k in ("allow", "out", "defined", "escape")}
+    reqset_tp = {k: P("tp", None) for k in ("allow", "out", "defined", "escape")}
+    in_specs = (
+        pod_spec,  # pod_arrays
+        P("dp", None),  # count_split [ndp, I]
+        reqset_rep,  # tmpl
+        P(None, None),  # tmpl_daemon
+        P(None, "tp"),  # tmpl_type_mask_l
+        reqset_tp,  # types_l
+        P("tp", None, None),  # type_offering_ok_l
+        reqset_rep,  # types_full (replicated for packing)
+        P(None, None),  # type_alloc
+        P(None, None),  # type_capacity
+        P(None, None, None),  # type_offering_ok
+        P(None, None),  # pod_tol_all
+        reqset_rep,  # exist
+        P(None, None),  # exist_used
+        P(None, None),  # exist_cap
+        P("dp", None),  # exist_owner [ndp, E]
+        P(None),  # well_known
+        P("dp", None, None),  # remaining_split [ndp, J, R]
+        P(None, None),  # topo_counts0 [G, V]
+        P(None, None),  # topo_hcounts0 [G, N]
+        P(None, None),  # topo_doms0 [G, V]
+        {k: P(None, None) for k in ("allow", "out", "defined", "escape")},  # topo_terms
+        P(None, None),  # exist_ports [E, Q]
+        P(None, None),  # exist_vols [E, W]
+        P(None, None),  # exist_vol_limits [E, D]
+        P(None, None),  # vol_driver [W, D]
+    )
+    out_specs = (
+        {
+            **{k: P("dp") for k in ("item", "slot", "ns", "k", "k_last", "bulk_n")},
+            "bulk_take": P("dp", None),
+        },  # commit log
+        P("dp"),  # log ptr (singleton axis per shard)
+        PackState(
+            used=P("dp", None),
+            open=P("dp"),
+            is_existing=P("dp"),
+            tmpl=P("dp"),
+            tol_idx=P("dp"),
+            pods=P("dp"),
+            allow=P("dp", None),
+            out=P("dp", None),
+            defined=P("dp", None),
+            tmask=P("dp", None),
+            cap=P("dp", None),
+            nopen=P("dp"),
+            remaining=P("dp", None),
+            tcounts=P("dp", None),
+            thost=P("dp", None),
+            tdoms=P("dp", None),
+            ports=P("dp", None),
+            vols=P("dp", None),
+        ),
+        P(),  # scheduled count (replicated)
+    )
 
-    base_args = device_args(snap, provisioners)
+    sharded = jax.shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                            check_vma=False)
+    fn = jax.jit(sharded)
+    return fn
+
+
+def shard_args(base_args, count_split: np.ndarray, exist_owner: np.ndarray):
+    """Assemble the shard_map argument tuple from a device_args() tuple plus
+    the plan_shards partition. The count axis is padded to the item bucket
+    (device_args pads the item rows); the caller keeps the real-I count_split
+    for decoding."""
+    ndp = count_split.shape[0]
     (pod_arrays, tmpl, tmpl_daemon, tmpl_type_mask, types, type_alloc,
      type_capacity, type_offering_ok, pod_tol_all, exist, exist_used,
      exist_cap, well_known, remaining0, topo_counts0, topo_hcounts0,
@@ -394,8 +398,7 @@ def make_sharded_solve(snap, provisioners, mesh, max_nodes_per_shard: int = 256,
      vol_driver) = base_args
     pod_arrays = dict(pod_arrays)
     pod_arrays.pop("count")
-    # device count axis padded like device_args pads the item rows; the
-    # returned plan keeps the real-I count_split for decoding
+    E = exist_used.shape[0]
     I_pad = pod_arrays["valid"].shape[0]
     count_split_dev = np.zeros((ndp, I_pad), dtype=count_split.dtype)
     count_split_dev[:, : count_split.shape[1]] = count_split
@@ -412,13 +415,10 @@ def make_sharded_solve(snap, provisioners, mesh, max_nodes_per_shard: int = 256,
     # per-shard hostname-count state: existing columns seed identically on
     # every shard (only the owner shard's groups ever read/update them);
     # machine columns start at zero. [G, N] with N = E + max_nodes_per_shard
-    if has_topo:
-        th0 = np.zeros((G, N), dtype=np.float32)
-        th0[:, :E] = topo_hcounts0[:, :E]
-    else:
-        th0 = np.zeros((0, N), dtype=np.float32)
+    th0 = np.zeros_like(topo_hcounts0)
+    th0[:, :E] = topo_hcounts0[:, :E]
 
-    args = (
+    return (
         pod_arrays,
         count_split_dev,
         tmpl,
@@ -446,6 +446,41 @@ def make_sharded_solve(snap, provisioners, mesh, max_nodes_per_shard: int = 256,
         exist_vol_limits,
         vol_driver,
     )
+
+
+def make_sharded_solve(snap, provisioners, mesh, max_nodes_per_shard: int = 256,
+                       program_cache=None):
+    """Build (fn, args, plan) where fn is a jit-compiled shard_map program
+    over `mesh` (axes 'dp' and 'tp'), args are the host arrays, and plan is
+    (count_split, exist_owner) for decoding.
+
+    Type-axis arrays must divide by mesh.shape['tp'] (ShardedSolver routes
+    non-dividing geometries through a dp-only mesh). Supports topology
+    constraints and existing nodes via component routing / slot ownership
+    (module docstring)."""
+    from karpenter_core_tpu.solver.tpu_solver import device_args, solve_geometry
+
+    geom = solve_geometry(snap, max_nodes_per_shard)
+    (_, _J, _T, _E, _R, _K, _V, N, segments_t, zone_seg, ct_seg, _topo_sig,
+     log_len, _Q, _W, _D, screen_v) = geom
+    ndp = mesh.shape["dp"]
+    ntp = mesh.shape["tp"]
+    count_split, exist_owner = plan_shards(snap, ndp)
+
+    # the shard_map program is pure in everything but the label geometry
+    # (+ topo signature, baked into geom) and the mesh shape: cache it so
+    # steady-state solves and relaxation rounds reuse one compiled program
+    cache_key = (geom, ndp, ntp)
+    fn = None if program_cache is None else program_cache.get(cache_key)
+    if fn is None:
+        fn = make_sharded_run(
+            segments_t, zone_seg, ct_seg, snap.topo_meta, N, mesh,
+            log_len=log_len, screen_v=screen_v,
+        )
+        if program_cache is not None:
+            program_cache[cache_key] = fn
+
+    args = shard_args(device_args(snap, provisioners), count_split, exist_owner)
     return fn, args, (count_split, exist_owner)
 
 
@@ -519,8 +554,10 @@ def decode_sharded(snap, log, ptr, state, count_split):
 class ShardedSolver:
     """Solver-interface front end for the multi-chip path: encode once,
     run the shard_map program over `mesh`, merge shard logs. Drop-in for
-    TPUSolver where a Mesh is available; relaxation shares
-    solve_with_relaxation."""
+    TPUSolver where a Mesh is available (solver/factory.py builds one when
+    the process sees >1 device); relaxation shares solve_with_relaxation and
+    the pipelined encode()/solve(encoded=) surface matches TPUSolver so the
+    provisioning loop overlaps encode with the previous solve either way."""
 
     def __init__(self, mesh, max_nodes_per_shard: int = 256,
                  max_relax_rounds: Optional[int] = None):
@@ -533,14 +570,42 @@ class ShardedSolver:
         )
         self._compiled = {}
 
+    @property
+    def max_nodes(self) -> int:
+        # the GLOBAL new-machine budget (consolidation sizes its ladder
+        # screen off this); each shard owns max_nodes_per_shard of it
+        return self.mesh.shape["dp"] * self.max_nodes_per_shard
+
+    def encode(self, pods, provisioners, instance_types, daemonset_pods=None,
+               state_nodes=None, kube_client=None, cluster=None):
+        """Pre-encode a batch off the Solve critical path (same contract as
+        TPUSolver.encode); the snapshot is sized to the PER-SHARD slot
+        budget, which is what every per-device plane keys off."""
+        from karpenter_core_tpu.solver.encode import encode_snapshot
+
+        return encode_snapshot(
+            pods, provisioners, instance_types, daemonset_pods, state_nodes,
+            kube_client=kube_client, cluster=cluster,
+            max_nodes=self.max_nodes_per_shard,
+        )
+
     def solve(self, pods, provisioners, instance_types, daemonset_pods=None,
-              state_nodes=None, kube_client=None, cluster=None):
+              state_nodes=None, kube_client=None, cluster=None, encoded=None):
         from karpenter_core_tpu.solver.tpu_solver import solve_with_relaxation
 
+        if encoded is not None:
+            # must be OF this batch (see TPUSolver.solve for why identity)
+            if len(encoded.pods) != len(pods) or (
+                {id(p) for p in encoded.pods} != {id(p) for p in pods}
+            ):
+                raise ValueError(
+                    "encoded snapshot was built from a different pod batch"
+                )
+        relax_ctx = {"encoded": encoded}
         return solve_with_relaxation(
             lambda p: self._solve_once(
                 p, provisioners, instance_types, daemonset_pods, state_nodes,
-                kube_client, cluster,
+                kube_client, cluster, relax_ctx,
             ),
             pods,
             provisioners,
@@ -548,27 +613,64 @@ class ShardedSolver:
             self.max_relax_rounds,
         )
 
+    # a shard that exhausts its per-shard slot budget doubles it and
+    # re-solves (the grown program is compiled once and cached); cap the
+    # growth so a pathological batch can't compile unbounded geometries
+    MAX_NODES_PER_SHARD_CAP = 4096
+
     def _solve_once(self, pods, provisioners, instance_types, daemonset_pods,
-                    state_nodes, kube_client, cluster):
+                    state_nodes, kube_client, cluster, relax_ctx=None):
         import jax
 
         from karpenter_core_tpu.solver.encode import encode_snapshot
 
-        snap = encode_snapshot(
-            pods, provisioners, instance_types, daemonset_pods, state_nodes,
-            kube_client=kube_client, cluster=cluster,
-            max_nodes=self.max_nodes_per_shard,
-        )
-        fn, args, (count_split, _exist_owner) = make_sharded_solve(
-            snap, provisioners, self.mesh,
-            max_nodes_per_shard=self.max_nodes_per_shard,
-            program_cache=self._compiled,
-        )
-        with self.mesh:
-            log, ptr, state, _scheduled = fn(*args)
-            jax.block_until_ready(log)
-        state = jax.tree_util.tree_map(np.asarray, state)
-        return decode_sharded(snap, log, ptr, state, count_split)
+        snap = relax_ctx.pop("encoded", None) if relax_ctx else None
+        while True:
+            if snap is None:
+                snap = encode_snapshot(
+                    pods, provisioners, instance_types, daemonset_pods,
+                    state_nodes, kube_client=kube_client, cluster=cluster,
+                    max_nodes=self.max_nodes_per_shard,
+                )
+            mesh = self.mesh
+            if len(snap.instance_types) % mesh.shape["tp"] != 0:
+                # the tp all_gather needs the type axis to divide; rare odd
+                # geometries route through a dp-only view of the same devices
+                mesh = _dp_only_mesh(mesh)
+            fn, args, (count_split, _exist_owner) = make_sharded_solve(
+                snap, provisioners, mesh,
+                max_nodes_per_shard=self.max_nodes_per_shard,
+                program_cache=self._compiled,
+            )
+            with mesh:
+                log, ptr, state, _scheduled = fn(*args)
+                jax.block_until_ready(log)
+            state = jax.tree_util.tree_map(np.asarray, state)
+            result = decode_sharded(snap, log, ptr, state, count_split)
+            if not result.failed_pods:
+                return result
+            # slot-budget exhaustion is NOT a constraint failure: the dp
+            # split can concentrate more machines on one shard than the
+            # per-shard budget admits even when the global budget fits
+            # (scheduler.go has one global node list; shards have disjoint
+            # budgets). Grow and retry; the budget sticks for future solves.
+            exhausted = bool(
+                np.any(np.asarray(state.nopen).reshape(-1) >= snap.n_slots)
+            )
+            if not exhausted or (
+                self.max_nodes_per_shard * 2 > self.MAX_NODES_PER_SHARD_CAP
+            ):
+                return result
+            self.max_nodes_per_shard *= 2
+            snap = None  # re-encode at the grown slot budget
+
+
+def _dp_only_mesh(mesh):
+    """Reshape a dp×tp mesh's devices into dp×1 (all devices on 'dp')."""
+    from jax.sharding import Mesh
+
+    devices = np.asarray(mesh.devices).reshape(-1, 1)
+    return Mesh(devices, ("dp", "tp"))
 
 
 def pad_pods(pods: List, multiple: int) -> List:

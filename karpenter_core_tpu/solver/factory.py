@@ -1,0 +1,88 @@
+"""Solver factory — the ONE place the production stack decides which solve
+path serves Solve().
+
+The reference has a single in-process entry (`Solve` at
+provisioner.go:297-301); this framework has three interchangeable backends
+(host FFD, single-chip TPUSolver, multi-chip ShardedSolver) plus an
+out-of-process gRPC boundary. Every production entrypoint — the operator
+(`operator/__main__.py`), the solver service container
+(`solver/service.py`), and the bench — builds its primary through
+build_solver() so a v5e-4 pod automatically serves the sharded path instead
+of solving on one chip.
+
+Selection (KARPENTER_SOLVER_MODE, default "auto"):
+  auto     >1 visible device -> ShardedSolver over a dp×tp Mesh;
+           otherwise TPUSolver.
+  single   TPUSolver regardless of device count.
+  sharded  ShardedSolver; raises if only one device is visible.
+
+Mesh shape: tp = KARPENTER_MESH_TP when set; else 2 when the device count
+is a multiple of 2 and >= 4 (the dryrun-validated split — feasibility's
+type-axis matmuls gather over 'tp' on ICI), else 1. dp takes the rest.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def detect_mesh(devices=None, tp: Optional[int] = None):
+    """Build the dp×tp Mesh over the visible devices; None when the process
+    sees a single device (single-chip path)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    n = len(devices)
+    if n < 2:
+        return None
+    if tp is None:
+        tp_env = os.environ.get("KARPENTER_MESH_TP", "")
+        tp = int(tp_env) if tp_env else (2 if n % 2 == 0 and n >= 4 else 1)
+    if tp < 1 or n % tp != 0:
+        raise ValueError(f"tp={tp} does not divide device count {n}")
+    return Mesh(np.array(devices).reshape(n // tp, tp), ("dp", "tp"))
+
+
+def describe(solver) -> str:
+    """One-line boot log / bench-artifact description of the chosen path."""
+    name = type(solver).__name__
+    mesh = getattr(solver, "mesh", None)
+    if mesh is not None:
+        return f"{name}(dp={mesh.shape['dp']}, tp={mesh.shape['tp']})"
+    return name
+
+
+def build_solver(max_nodes: int = 1024, mode: Optional[str] = None,
+                 backend: Optional[str] = None,
+                 max_nodes_per_shard: Optional[int] = None):
+    """Construct the primary in-process solver for this process's devices.
+
+    max_nodes is the GLOBAL new-machine slot budget; the sharded path
+    divides it across dp shards unless max_nodes_per_shard pins it."""
+    mode = (mode or os.environ.get("KARPENTER_SOLVER_MODE", "auto")).lower()
+    if mode not in ("auto", "single", "sharded"):
+        raise ValueError(f"unknown KARPENTER_SOLVER_MODE {mode!r}")
+    mesh = None
+    if mode != "single":
+        try:
+            mesh = detect_mesh()
+        except Exception:
+            if mode == "sharded":
+                raise
+            mesh = None  # auto: a wedged backend degrades to the single path
+    if mesh is None:
+        if mode == "sharded":
+            raise RuntimeError(
+                "KARPENTER_SOLVER_MODE=sharded but only one device is visible"
+            )
+        from karpenter_core_tpu.solver.tpu_solver import TPUSolver
+
+        return TPUSolver(max_nodes=max_nodes, backend=backend)
+    from karpenter_core_tpu.parallel.sharded import ShardedSolver
+
+    ndp = mesh.shape["dp"]
+    per_shard = max_nodes_per_shard or max(max_nodes // ndp, 64)
+    return ShardedSolver(mesh, max_nodes_per_shard=per_shard)

@@ -111,6 +111,9 @@ def geometry_json(snap) -> str:
             # index 12 = log_len (see solve_geometry's return tuple)
             "log_len": solve_geometry(snap, 0)[12],
             "topo_groups": topo,
+            # real (pre-padding) existing-node count: the sharded service
+            # path assigns slot ownership over the real rows only
+            "n_exist_real": len(snap.state_nodes),
         }
     )
 
@@ -122,15 +125,27 @@ def geometry_json(snap) -> str:
 class SolverService:
     """Stateless executor keyed by geometry (jit cache shared across calls).
 
+    `mesh` (a dp×tp jax.sharding.Mesh, or True to autodetect via
+    solver/factory.detect_mesh) routes every Solve through the multi-chip
+    shard_map program — the v5e-4 deployment shape. The wire format is
+    unchanged except the response carries per-shard-stacked tensors plus a
+    `count_split` plan tensor, which the client detects and decodes with
+    parallel/sharded.decode_sharded.
+
     The cache is LRU-bounded: geometry embeds the label dictionary, so in a
     live cluster label churn mints new keys — an unbounded map would pin every
     old compiled executable until OOM."""
 
     MAX_COMPILED = 32
 
-    def __init__(self):
+    def __init__(self, mesh=None):
         from collections import OrderedDict
 
+        if mesh is True:
+            from karpenter_core_tpu.solver.factory import detect_mesh
+
+            mesh = detect_mesh()
+        self.mesh = mesh
         self._compiled = OrderedDict()
         self._mu = threading.Lock()
         self.solves = 0
@@ -163,25 +178,35 @@ class SolverService:
                         for g in geometry["topo_groups"]
                     ]
                 )
-            key = (request.geometry,)
-            with self._mu:
-                fn = self._compiled.get(key)
-                if fn is not None:
-                    self._compiled.move_to_end(key)
-            if fn is None:
-                fn = jax.jit(
-                    make_device_run(
-                        segments, zone_seg, ct_seg, topo_meta, geometry["n_slots"],
-                        log_len=geometry.get("log_len"),
-                        screen_v=geometry.get("screen_v"),
-                    )
+            if self.mesh is not None:
+                log, ptr, state, count_split = self._solve_sharded(
+                    request.geometry, geometry, args, topo_meta,
+                    segments, zone_seg, ct_seg,
                 )
+                out = [
+                    tensor_to_pb("ptr", np.asarray(ptr)),
+                    tensor_to_pb("count_split", np.asarray(count_split)),
+                ]
+            else:
+                key = (request.geometry,)
                 with self._mu:
-                    self._compiled[key] = fn
-                    while len(self._compiled) > self.MAX_COMPILED:
-                        self._compiled.popitem(last=False)
-            log, ptr, state = fn(*args)
-            out = [tensor_to_pb("ptr", np.asarray(ptr))]
+                    fn = self._compiled.get(key)
+                    if fn is not None:
+                        self._compiled.move_to_end(key)
+                if fn is None:
+                    fn = jax.jit(
+                        make_device_run(
+                            segments, zone_seg, ct_seg, topo_meta, geometry["n_slots"],
+                            log_len=geometry.get("log_len"),
+                            screen_v=geometry.get("screen_v"),
+                        )
+                    )
+                    with self._mu:
+                        self._compiled[key] = fn
+                        while len(self._compiled) > self.MAX_COMPILED:
+                            self._compiled.popitem(last=False)
+                log, ptr, state = fn(*args)
+                out = [tensor_to_pb("ptr", np.asarray(ptr))]
             for name, value in log.items():
                 out.append(tensor_to_pb(f"log/{name}", np.asarray(value)))
             for field, value in state._asdict().items():
@@ -192,19 +217,81 @@ class SolverService:
         except Exception as e:  # surface errors to the client
             return pb.SolveResponse(error=f"{type(e).__name__}: {e}")
 
+    def _solve_sharded(self, geometry_key: str, geometry: dict, args,
+                       topo_meta, segments, zone_seg, ct_seg):
+        """Run the request through the multi-chip shard_map program.
+
+        The shard plan (plan_shards_arrays) is recomputed server-side from
+        the wire tensors — the item-axis topology incidence rides in
+        pod_arrays/topo_own|topo_sel, so no extra request fields are needed —
+        and returned to the client as `count_split` for log decoding."""
+        import jax
+
+        from karpenter_core_tpu.parallel.sharded import (
+            _dp_only_mesh,
+            make_sharded_run,
+            plan_shards_arrays,
+            shard_args,
+        )
+
+        pod_arrays = args[0]
+        exist_used = args[10]
+        type_alloc = args[5]
+        counts = np.asarray(pod_arrays["count"])
+        touch = None
+        if topo_meta is not None and "topo_own" in pod_arrays:
+            touch = (
+                np.asarray(pod_arrays["topo_own"])
+                | np.asarray(pod_arrays["topo_sel"])
+            ).T  # [G, I]
+        E_pad = exist_used.shape[0]
+        E_real = int(geometry.get("n_exist_real", E_pad))
+        mesh = self.mesh
+        if type_alloc.shape[0] % mesh.shape["tp"] != 0:
+            mesh = _dp_only_mesh(mesh)  # odd type axis: all devices on dp
+        ndp, ntp = mesh.shape["dp"], mesh.shape["tp"]
+        count_split, exist_owner = plan_shards_arrays(
+            counts, E_real, E_pad, ndp, touch, topo_meta
+        )
+        key = (geometry_key, ndp, ntp)
+        with self._mu:
+            fn = self._compiled.get(key)
+            if fn is not None:
+                self._compiled.move_to_end(key)
+        if fn is None:
+            fn = make_sharded_run(
+                segments, zone_seg, ct_seg, topo_meta, geometry["n_slots"],
+                mesh, log_len=geometry.get("log_len"),
+                screen_v=geometry.get("screen_v"),
+            )
+            with self._mu:
+                self._compiled[key] = fn
+                while len(self._compiled) > self.MAX_COMPILED:
+                    self._compiled.popitem(last=False)
+        sh_args = shard_args(args, count_split, exist_owner)
+        with mesh:
+            log, ptr, state, _scheduled = fn(*sh_args)
+            jax.block_until_ready(log)
+        return log, ptr, state, count_split
+
     def health(self, request: pb.HealthRequest, context=None) -> pb.HealthResponse:
         import jax
 
-        return pb.HealthResponse(
-            status="ok", device=jax.devices()[0].device_kind, solves=self.solves
-        )
+        device = jax.devices()[0].device_kind
+        if self.mesh is not None:
+            device += (
+                f" x{self.mesh.size}"
+                f"(dp={self.mesh.shape['dp']},tp={self.mesh.shape['tp']})"
+            )
+        return pb.HealthResponse(status="ok", device=device, solves=self.solves)
 
 
-def serve(address: str = "127.0.0.1:0", max_workers: int = 4):
-    """Start the gRPC server; returns (server, bound_port, service)."""
+def serve(address: str = "127.0.0.1:0", max_workers: int = 4, mesh=None):
+    """Start the gRPC server; returns (server, bound_port, service).
+    mesh=True autodetects a multi-chip mesh (factory.detect_mesh)."""
     import grpc
 
-    service = SolverService()
+    service = SolverService(mesh=mesh)
     handlers = {
         "Solve": grpc.unary_unary_rpc_method_handler(
             service.solve,
@@ -302,11 +389,34 @@ class RemoteSolver:
         if response.error:
             raise RuntimeError(f"solver service error: {response.error}")
         tensors = {t.name: tensor_from_pb(t) for t in response.tensors}
-        ptr = int(np.asarray(tensors["ptr"]).reshape(-1)[0])
         log = {k[len("log/"):]: v for k, v in tensors.items() if k.startswith("log/")}
         state = _StateView(
             {k[len("state/"):]: v for k, v in tensors.items() if k.startswith("state/")}
         )
+        if "count_split" in tensors:
+            # the service ran the multi-chip program: per-shard-stacked logs
+            # + the shard plan come back; merge with the sharded decoder
+            from karpenter_core_tpu.parallel.sharded import decode_sharded
+
+            result = decode_sharded(
+                snap, log, tensors["ptr"], state, tensors["count_split"]
+            )
+            if result.failed_pods:
+                # per-shard slot exhaustion (see ShardedSolver._solve_once):
+                # double the budget — which sizes snap.n_slots per shard on
+                # the sharded service — and re-request once per doubling
+                from karpenter_core_tpu.parallel.sharded import ShardedSolver
+
+                cap = ShardedSolver.MAX_NODES_PER_SHARD_CAP
+                nopen = np.asarray(tensors["state/nopen"]).reshape(-1)
+                if np.any(nopen >= snap.n_slots) and self.max_nodes * 2 <= cap:
+                    self.max_nodes *= 2
+                    return self._solve_once(
+                        pods, provisioners, instance_types, daemonset_pods,
+                        state_nodes, kube_client, cluster,
+                    )
+            return result
+        ptr = int(np.asarray(tensors["ptr"]).reshape(-1)[0])
         return decode_solve(snap, (log, ptr), state)
 
 
@@ -334,7 +444,36 @@ def main(argv: Optional[List[str]] = None) -> None:
     parser.add_argument("--max-workers", type=int, default=4)
     args = parser.parse_args(argv)
 
-    server, port, _service = serve(f"{args.host}:{args.port}", max_workers=args.max_workers)
+    # restart-survivable compiled programs (utils/compilecache): a solver
+    # container restart reloads executables from disk instead of paying the
+    # cold compile while the control plane waits
+    from karpenter_core_tpu.utils.compilecache import enable_persistent_cache
+
+    enable_persistent_cache()
+    # multi-chip containers (v5e-4) serve every Solve through the sharded
+    # program; KARPENTER_SOLVER_MODE=single pins the one-chip path
+    import os
+
+    from karpenter_core_tpu.solver.factory import detect_mesh
+
+    mode = os.environ.get("KARPENTER_SOLVER_MODE", "auto").lower()
+    mesh = None
+    if mode != "single":
+        mesh = detect_mesh()
+        if mesh is None and mode == "sharded":
+            # same contract as factory.build_solver: an explicit sharded
+            # pin fails fast instead of silently serving one chip
+            raise RuntimeError(
+                "KARPENTER_SOLVER_MODE=sharded but only one device is visible"
+            )
+    server, port, _service = serve(
+        f"{args.host}:{args.port}", max_workers=args.max_workers, mesh=mesh
+    )
+    if mesh is not None:
+        print(
+            f"solver service mesh: dp={mesh.shape['dp']} tp={mesh.shape['tp']}",
+            flush=True,
+        )
     # decode runs in THIS process in a split deployment: apply the shared
     # long-lived-server GC posture (utils/gctuning.py) so gen-2 pauses
     # don't land mid-Solve
