@@ -71,6 +71,8 @@ def default_scheme() -> Scheme:
     s.register(k8s.CSINode, namespaced=False)
     s.register(k8s.PodDisruptionBudget)
     s.register(k8s.DaemonSet)
+    s.register(k8s.Event)
+    s.register(k8s.Lease)
     return s
 
 

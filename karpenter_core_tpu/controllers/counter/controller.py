@@ -25,4 +25,11 @@ class CounterController:
                 continue
             resources = resources_util.merge(resources, node.capacity())
         provisioner.status.resources = resources
-        self.kube_client.apply(provisioner)
+        # status subresource write (counter/controller.go:67 Status().Patch):
+        # a plain PUT would be silently dropped by the apiserver
+        from karpenter_core_tpu.kube.client import NotFoundError
+
+        try:
+            self.kube_client.update_status(provisioner)
+        except NotFoundError:
+            pass  # provisioner deleted mid-reconcile

@@ -54,7 +54,21 @@ class MachineController:
             if isinstance(r, (int, float)):
                 requeue = min(requeue, r) if requeue is not None else r
         self._sync_ready(machine)
-        self.kube_client.apply(machine)
+        # metadata/spec ride the plain PUT; conditions/providerID/capacity
+        # live under the status SUBRESOURCE, which a plain PUT silently
+        # drops — they must go through Status().Update (machine
+        # controller.go status writes; CRD `subresources: {status: {}}`).
+        # Rebase on apply's returned rv (the REST adapter does not mutate
+        # the passed object) so the status PUT doesn't 409 every reconcile;
+        # a machine deleted mid-reconcile is a clean no-op, not an error.
+        from karpenter_core_tpu.kube.client import NotFoundError
+
+        try:
+            applied = self.kube_client.apply(machine)
+            machine.metadata.resource_version = applied.metadata.resource_version
+            self.kube_client.update_status(machine)
+        except NotFoundError:
+            return None  # deleted by a concurrent worker
         self.cluster.update_machine(machine)
         return requeue
 

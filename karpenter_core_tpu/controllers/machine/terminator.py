@@ -118,14 +118,30 @@ class EvictionQueue:
 
     def evict(self, key: NamespacedName) -> bool:
         """One eviction API call (eviction.go:87-108). True on success or
-        gone; False when PDB-blocked."""
+        gone; False when PDB-blocked.
+
+        Goes through the pods/eviction SUBRESOURCE when the client has one
+        (both InMemoryKubeClient and ApiServerKubeClient do): the apiserver
+        enforces PodDisruptionBudgets and answers 429
+        (EvictionBlockedError -> requeue with backoff), so budget
+        arbitration is server-side instead of a host check racing other PDB
+        consumers (eviction.go:111-124). pdb_checker remains an optional
+        EXTRA host-side gate for embedders with custom policies."""
+        from karpenter_core_tpu.kube.client import EvictionBlockedError
+
         pod = self.kube_client.get("Pod", key.namespace, key.name)
         if pod is None:
             return True
         if self.pdb_checker is not None and not self.pdb_checker(pod):
             return False
+        evict = getattr(self.kube_client, "evict", None)
         try:
-            self.kube_client.delete("Pod", key.namespace, key.name)
+            if evict is not None:
+                evict(key.namespace, key.name)
+            else:
+                self.kube_client.delete("Pod", key.namespace, key.name)
+        except EvictionBlockedError:
+            return False  # server-enforced PDB 429
         except Exception:
             return True
         if self.recorder:

@@ -326,7 +326,11 @@ class ProvisioningController:
         machine_cr.status.capacity = dict(created.status.capacity)
         machine_cr.status.allocatable = dict(created.status.allocatable)
         machine_cr.metadata.labels.update(created.metadata.labels)
-        self.kube_client.apply(machine_cr)
+        # providerID/capacity/allocatable live under the status subresource;
+        # rebase on apply's returned rv so the status PUT never 409s
+        applied = self.kube_client.apply(machine_cr)
+        machine_cr.metadata.resource_version = applied.metadata.resource_version
+        self.kube_client.update_status(machine_cr)
 
         # eagerly create the Node (provisioner.go:337-349)
         node = template.to_node()

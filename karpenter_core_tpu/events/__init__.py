@@ -4,9 +4,11 @@ Mirrors reference pkg/events: Recorder.Publish with a dedupe cache and a
 per-event rate limiter (recorder.go), plus the typed constructors in
 events.go (NominatePod, PodFailedToSchedule, EvictPod, ...).
 
-Events are the user-facing explanation channel; here they land in an
-in-memory ring (inspectable in tests / exported by the operator runtime)
-instead of the kube events API.
+Events are the user-facing explanation channel. They land in an in-memory
+ring (inspectable in tests / exported by the operator runtime) AND — when
+the recorder carries a kube client — as core/v1 Event objects in the
+cluster, so `kubectl describe pod` shows scheduling decisions the way the
+reference's client-go record.EventRecorder does (recorder.go:50-56).
 """
 from __future__ import annotations
 
@@ -55,12 +57,20 @@ class Recorder:
     # events so the limit is cluster-wide, like the reference's pointer
     POD_NOMINATION_RATE_LIMIT = (5.0, 10)
 
-    def __init__(self, clock=time.time, capacity: int = 4096):
+    def __init__(self, clock=time.time, capacity: int = 4096, kube_client=None):
         self.clock = clock
+        self.kube_client = kube_client  # optional cluster sink
         self._mu = threading.Lock()
         self._seen: Dict[tuple, float] = {}
         self._tokens: Dict[tuple, List[float]] = {}  # (kind, reason) -> [tokens, last]
         self._last_purge = 0.0
+        self._posted = 0
+        # cluster posts ride a bounded queue drained by one daemon worker
+        # (client-go's recorder is buffered the same way): a slow or down
+        # apiserver must never block the reconcile path that publishes
+        self._post_q = None
+        self._post_idle = threading.Event()
+        self._post_idle.set()
         self.events: Deque[Event] = deque(maxlen=capacity)
 
     def publish(self, event: Event) -> bool:
@@ -88,7 +98,97 @@ class Recorder:
                     return False
                 self._tokens[type_key] = [tokens - 1.0, now]
             self.events.append(dataclasses.replace(event, timestamp=now))
+            self._posted += 1
+            seq = self._posted
+        self._post_to_cluster(event, now, seq)
+        return True
+
+    def _post_to_cluster(self, event: Event, now: float, seq: int) -> None:
+        """Enqueue the core/v1 Event object for the poster worker
+        (recorder.go:50-56 — client-go's recorder posts through the events
+        API, buffered). Dedupe/rate-limit already passed, so each surviving
+        publish is one Event with count=1; name uniqueness follows the
+        client-go `<name>.<hex>` convention. Posting is best-effort: a full
+        queue drops the cluster copy (the in-memory ring keeps it) and an
+        apiserver error never breaks the control loop the event narrates."""
+        if self.kube_client is None:
+            return
+        try:
+            from karpenter_core_tpu.kube.objects import Event as KubeEvent
+
+            ns, _, name = event.involved_name.rpartition("/")
+            obj = KubeEvent()
+            obj.metadata.namespace = ns or "default"
+            obj.metadata.name = f"{name}.{format(int(now * 1e6) + seq, 'x')}"
+            obj.involved_object.kind = event.involved_kind
+            obj.involved_object.namespace = ns
+            obj.involved_object.name = name
+            obj.reason = event.reason
+            obj.message = event.message
+            obj.type = event.type
+            obj.first_timestamp = obj.last_timestamp = now
+            self._poster().put_nowait(obj)
+            self._post_idle.clear()
+        except Exception:  # noqa: BLE001 — cluster sink is best-effort
+            pass
+
+    def _poster(self):
+        import queue as _queue
+
+        with self._mu:
+            if self._post_q is None:
+                self._post_q = _queue.Queue(maxsize=1024)
+                threading.Thread(
+                    target=self._post_loop, daemon=True, name="event-poster"
+                ).start()
+        return self._post_q
+
+    def _post_loop(self) -> None:
+        import queue as _queue
+
+        posted = 0
+        while True:
+            try:
+                obj = self._post_q.get(timeout=0.2)
+            except _queue.Empty:
+                self._post_idle.set()
+                continue
+            try:
+                self.kube_client.create(obj)
+            except Exception:  # noqa: BLE001 — best-effort
+                pass
+            posted += 1
+            if posted % 256 == 0:
+                self._prune_cluster_events()
+            if self._post_q.empty():
+                self._post_idle.set()
+
+    def _prune_cluster_events(self) -> None:
+        """The in-memory client has no apiserver event-TTL GC: bound the
+        stored Events to the ring capacity so a long-lived single-process
+        control plane doesn't grow without limit. A real apiserver TTLs
+        events itself, so this only runs for the in-memory client."""
+        from karpenter_core_tpu.kube.client import InMemoryKubeClient
+
+        if not isinstance(self.kube_client, InMemoryKubeClient):
+            return
+        try:
+            events = self.kube_client.list("Event")
+            cap = self.events.maxlen or 4096
+            if len(events) > cap:
+                events.sort(key=lambda e: e.metadata.creation_timestamp or 0.0)
+                for e in events[: len(events) - cap]:
+                    self.kube_client.delete(
+                        "Event", e.metadata.namespace, e.metadata.name
+                    )
+        except Exception:  # noqa: BLE001 — pruning is best-effort
+            pass
+
+    def flush(self, timeout: float = 5.0) -> bool:
+        """Wait for queued cluster posts to drain (tests / shutdown)."""
+        if self._post_q is None:
             return True
+        return self._post_idle.wait(timeout)
 
     def for_object(self, kind: str, name: str) -> List[Event]:
         with self._mu:

@@ -49,6 +49,8 @@ RESOURCES: Dict[str, Tuple[str, str, bool]] = {
     "DaemonSet": ("/apis/apps/v1", "daemonsets", True),
     "Provisioner": ("/apis/karpenter.sh/v1alpha5", "provisioners", False),
     "Machine": ("/apis/karpenter.sh/v1alpha5", "machines", False),
+    "Event": ("/api/v1", "events", True),
+    "Lease": ("/apis/coordination.k8s.io/v1", "leases", True),
 }
 
 API_VERSIONS = {
@@ -58,6 +60,7 @@ API_VERSIONS = {
     "CSINode": "storage.k8s.io/v1",
     "PodDisruptionBudget": "policy/v1",
     "DaemonSet": "apps/v1",
+    "Lease": "coordination.k8s.io/v1",
 }
 
 
@@ -210,6 +213,57 @@ class ApiServerKubeClient:
     def compare_and_update(self, obj, expected_rv: int):
         obj.metadata.resource_version = expected_rv
         return self.update(obj)
+
+    def update_status(self, obj):
+        """PUT to the status SUBRESOURCE — the CRDs declare
+        `subresources: {status: {}}`, so a plain PUT silently drops status
+        changes; every controller status write (machine conditions,
+        counter's status.resources) must land here (reference:
+        Status().Patch, counter/controller.go:67).
+
+        Like the reference's status Patch, a concurrent spec/metadata bump
+        must not fail the status write: on 409 the current resourceVersion
+        is re-read once and the write retried (a /status PUT only persists
+        status, so rebasing is always safe)."""
+        kind = _kind_of(obj)
+        ns = getattr(obj.metadata, "namespace", "")
+        path = self._path(kind, ns, obj.metadata.name) + "/status"
+        status, body = self.transport("PUT", path, self._encode(obj))
+        if status == 409:
+            current = self.get(kind, ns, obj.metadata.name)
+            if current is None:
+                raise NotFoundError(f"{kind} {obj.metadata.name} not found")
+            obj.metadata.resource_version = current.metadata.resource_version
+            status, body = self.transport("PUT", path, self._encode(obj))
+            if status == 409:
+                raise ConflictError(
+                    f"{kind} {obj.metadata.name} resource version conflict"
+                )
+        if status == 404:
+            raise NotFoundError(f"{kind} {obj.metadata.name} not found")
+        self._raise_for(status, body, kind, obj.metadata.name)
+        return self._decode(kind, json.loads(body))
+
+    def evict(self, namespace: str, name: str) -> None:
+        """POST the pods/eviction subresource; a 429 (PDB exhausted) raises
+        EvictionBlockedError so the eviction queue requeues with backoff —
+        server-enforced budgets instead of a host-side TOCTOU check
+        (reference eviction.go:111-124)."""
+        from karpenter_core_tpu.kube.client import EvictionBlockedError
+
+        body = {
+            "apiVersion": "policy/v1",
+            "kind": "Eviction",
+            "metadata": {"name": name, "namespace": namespace},
+        }
+        status, resp = self.transport(
+            "POST", self._path("Pod", namespace, name) + "/eviction", body
+        )
+        if status == 404:
+            return  # already gone: success
+        if status == 429:
+            raise EvictionBlockedError(str(resp)[:200])
+        self._raise_for(status, resp, "Pod", name)
 
     def apply(self, obj):
         try:

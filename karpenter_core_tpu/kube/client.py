@@ -32,6 +32,19 @@ class AlreadyExistsError(Exception):
     pass
 
 
+class EvictionBlockedError(Exception):
+    """pods/eviction returned 429: a PodDisruptionBudget blocks the eviction
+    (server-side enforcement, reference eviction.go:111-124). Callers requeue
+    with backoff."""
+
+
+# kinds served with a status SUBRESOURCE: a plain PUT to the main resource
+# silently drops status changes (the apiserver contract the shipped CRDs
+# declare via `subresources: {status: {}}`); status persists only through
+# update_status(). Core Pod/Node behave the same on a real apiserver.
+STATUS_SUBRESOURCE_KINDS = frozenset({"Machine", "Provisioner", "Node", "Pod"})
+
+
 def _kind_of(obj) -> str:
     return type(obj).__name__
 
@@ -91,6 +104,34 @@ class InMemoryKubeClient:
             self._rv += 1
             obj.metadata.resource_version = self._rv
             stored = copy.deepcopy(obj)
+            if kind in STATUS_SUBRESOURCE_KINDS and hasattr(stored, "status"):
+                # subresource contract: plain PUT silently drops status
+                # changes (controllers must Status().Update —
+                # counter/controller.go:67); create() keeps seeded status so
+                # test fixtures that are "born with" capacity keep working
+                stored.status = copy.deepcopy(store[key].status)
+            store[key] = stored
+            self._notify(kind, "MODIFIED", stored)
+            return copy.deepcopy(stored)
+
+    def update_status(self, obj) -> object:
+        """PUT to the status subresource: persists ONLY obj.status (spec and
+        metadata of the stored object are untouched, mirroring the apiserver,
+        which ignores everything but status on /status writes)."""
+        kind = _kind_of(obj)
+        with self._mu:
+            key = NamespacedName(obj.metadata.namespace, obj.metadata.name)
+            store = self._objects.setdefault(kind, {})
+            if key not in store:
+                raise NotFoundError(f"{kind} {key} not found")
+            # fresh deepcopy into the store (same as update/create): watchers
+            # holding a previously-notified reference must not observe this
+            # write mutating it underneath them
+            stored = copy.deepcopy(store[key])
+            self._rv += 1
+            stored.metadata.resource_version = self._rv
+            obj.metadata.resource_version = self._rv
+            stored.status = copy.deepcopy(obj.status)
             store[key] = stored
             self._notify(kind, "MODIFIED", stored)
             return copy.deepcopy(stored)
@@ -156,6 +197,42 @@ class InMemoryKubeClient:
                 return
             del store[key]
             self._notify(kind, "DELETED", existing)
+
+    def evict(self, namespace: str, name: str) -> None:
+        """POST pods/eviction analog with SERVER-side PDB enforcement
+        (eviction.go:111-124): raises EvictionBlockedError (the 429) when a
+        matching PodDisruptionBudget has no disruptions left, else deletes
+        the pod and decrements the budget — so concurrent PDB consumers
+        can't over-evict through a check-then-delete race. A missing pod is
+        success (it is already gone)."""
+        with self._mu:
+            pod = self._objects.get("Pod", {}).get(NamespacedName(namespace, name))
+            if pod is None:
+                return
+            matching = [
+                pdb
+                for pdb in self._objects.get("PodDisruptionBudget", {}).values()
+                if pdb.spec.selector is not None
+                and pdb.metadata.namespace == namespace
+                and pdb.spec.selector.matches(pod.metadata.labels)
+            ]
+            if len(matching) > 1:
+                # the real eviction API refuses when >1 PDB covers a pod
+                # (it cannot atomically update multiple budgets)
+                raise EvictionBlockedError(
+                    f"This pod has more than one PodDisruptionBudget: "
+                    f"{', '.join(p.metadata.name for p in matching)}"
+                )
+            if matching:
+                pdb = matching[0]
+                if pdb.status.disruptions_allowed <= 0:
+                    raise EvictionBlockedError(
+                        f"Cannot evict pod as it would violate the pod's "
+                        f"disruption budget "
+                        f"{pdb.metadata.namespace}/{pdb.metadata.name}"
+                    )
+                pdb.status.disruptions_allowed -= 1
+            self.delete("Pod", namespace, name)
 
     def finalize(self, obj) -> None:
         """Persist a finalizer removal; completes deletion if terminating."""

@@ -489,6 +489,51 @@ class DaemonSet:
     pod_template_spec: Optional["PodSpec"] = None
 
 
+@dataclass
+class ObjectReference:
+    """core/v1 ObjectReference (the involvedObject of an Event)."""
+
+    kind: str = ""
+    namespace: str = ""
+    name: str = ""
+    uid: str = ""
+
+
+@dataclass
+class Event:
+    """core/v1 Event — the cluster-visible record the Recorder posts so
+    `kubectl describe` shows scheduling decisions (reference: client-go
+    record.EventRecorder via pkg/events/recorder.go:50-56)."""
+
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    involved_object: ObjectReference = field(default_factory=ObjectReference)
+    reason: str = ""
+    message: str = ""
+    type: str = "Normal"  # Normal | Warning
+    count: int = 1
+    first_timestamp: Optional[float] = None
+    last_timestamp: Optional[float] = None
+    reporting_component: str = "karpenter"
+
+
+@dataclass
+class LeaseSpec:
+    """coordination.k8s.io/v1 LeaseSpec (leader-election record,
+    reference operator.go:108-110 LeaderElectionResourceLock "leases")."""
+
+    holder_identity: str = ""
+    lease_duration_seconds: int = 15
+    acquire_time: Optional[float] = None
+    renew_time: Optional[float] = None
+    lease_transitions: int = 0
+
+
+@dataclass
+class Lease:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    spec: LeaseSpec = field(default_factory=LeaseSpec)
+
+
 # Well-known label/condition constants (k8s.io/api/core/v1 well_known_labels.go)
 LABEL_HOSTNAME = "kubernetes.io/hostname"
 LABEL_TOPOLOGY_ZONE = "topology.kubernetes.io/zone"

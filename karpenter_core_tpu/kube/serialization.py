@@ -39,6 +39,33 @@ _SPECIAL_WIRE = {
 }
 
 
+def _is_time_field(name: str) -> bool:
+    """Float fields holding epoch seconds that ride the wire as RFC3339
+    (k8s Time/MicroTime): creationTimestamp, deletionTimestamp, the Event
+    first/lastTimestamp, the Lease acquire/renewTime. A real apiserver
+    always stamps these — the adapter must parse them, not feed them to
+    the quantity parser."""
+    return name.endswith("_timestamp") or name.endswith("_time")
+
+
+def _parse_time(v) -> float:
+    if isinstance(v, (int, float)):
+        return float(v)
+    from datetime import datetime
+
+    return datetime.fromisoformat(str(v).replace("Z", "+00:00")).timestamp()
+
+
+def _format_time(v: float) -> str:
+    from datetime import datetime, timezone
+
+    return (
+        datetime.fromtimestamp(float(v), timezone.utc)
+        .isoformat(timespec="microseconds")
+        .replace("+00:00", "Z")
+    )
+
+
 def _strip_optional(tp):
     origin = typing.get_origin(tp)
     if origin is typing.Union:
@@ -83,7 +110,10 @@ def from_k8s_dict(cls, data):
                 raw = data[f.name]
             else:
                 continue
-            kwargs[f.name] = from_k8s_dict(hints[f.name], raw)
+            if _is_time_field(f.name) and raw is not None:
+                kwargs[f.name] = _parse_time(raw)
+            else:
+                kwargs[f.name] = from_k8s_dict(hints[f.name], raw)
         return tp(**kwargs)
     if tp is float:
         return _to_float(data)
@@ -107,7 +137,13 @@ def to_k8s_dict(obj):
         out = {}
         for f in dataclasses.fields(obj):
             value = getattr(obj, f.name)
-            encoded = to_k8s_dict(value)
+            if _is_time_field(f.name) and isinstance(value, (int, float)):
+                # zero means unset in the object model: OMIT it rather than
+                # emit a bare float a real apiserver would reject for a
+                # Time/MicroTime field
+                encoded = _format_time(value) if value else None
+            else:
+                encoded = to_k8s_dict(value)
             if encoded in (None, [], {}, ""):
                 continue
             out[_SPECIAL_WIRE.get(f.name, camel(f.name))] = encoded

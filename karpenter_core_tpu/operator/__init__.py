@@ -287,7 +287,9 @@ def new_operator(
         from karpenter_core_tpu.webhooks import install as install_webhooks
 
         install_webhooks(kube_client)
-    recorder = Recorder(clock=clock)
+    # events post to the cluster through the client (kubectl-describe
+    # visibility) on top of the in-memory ring (recorder.go:50-56)
+    recorder = Recorder(clock=clock, kube_client=kube_client)
     cluster = Cluster(kube_client, cp_node, clock=clock)
     eviction_queue = EvictionQueue(kube_client, recorder)
     terminator = Terminator(kube_client, cp_machine, eviction_queue, clock=clock)

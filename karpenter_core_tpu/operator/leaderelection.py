@@ -2,10 +2,11 @@
 
 Mirrors reference operator.go:108-110 (controller-runtime's
 LeaderElectionResourceLock "leases", id "karpenter-leader-election"): the
-control plane only runs while holding the lease; a standby acquires it when
-the holder's renew deadline lapses. The lease record is a ConfigMap-shaped
-object in the kube store, so two processes sharing an API-backed client
-arbitrate correctly; the in-memory single-process client acquires trivially.
+control plane only runs while holding a coordination.k8s.io/v1 Lease; a
+standby acquires it when the holder's renew deadline lapses. Both
+transitions are compare-and-swap shaped against the apiserver's 409
+contract, so two processes sharing an API-backed client arbitrate
+correctly; the in-memory single-process client acquires trivially.
 """
 from __future__ import annotations
 
@@ -31,7 +32,7 @@ class LeaderElector:
         self._renew_thread: Optional[threading.Thread] = None
 
     def _lease(self):
-        return self.kube_client.get("ConfigMap", LEASE_NAMESPACE, LEASE_NAME)
+        return self.kube_client.get("Lease", LEASE_NAMESPACE, LEASE_NAME)
 
     def try_acquire(self) -> bool:
         """Acquire (or re-acquire) the lease if free or expired.
@@ -43,26 +44,34 @@ class LeaderElector:
         (the apiserver's 409 contract); a conflict means someone else
         renewed or took the lease first, so this attempt simply fails and
         the caller retries."""
+        from karpenter_core_tpu.kube.objects import Lease, LeaseSpec, ObjectMeta
+
         now = self.clock()
         lease = self._lease()
         if lease is None:
-            from karpenter_core_tpu.kube.objects import ConfigMap, ObjectMeta
-
-            lease = ConfigMap(
+            lease = Lease(
                 metadata=ObjectMeta(name=LEASE_NAME, namespace=LEASE_NAMESPACE),
-                data={"holder": self.identity, "renew_time": str(now)},
+                spec=LeaseSpec(
+                    holder_identity=self.identity,
+                    lease_duration_seconds=int(self.lease_duration),
+                    acquire_time=now,
+                    renew_time=now,
+                ),
             )
             try:
                 self.kube_client.create(lease)
             except Exception:  # AlreadyExists: lost the create race
                 return False
             return True
-        holder = lease.data.get("holder", "")
-        renew_time = float(lease.data.get("renew_time", "0"))
+        holder = lease.spec.holder_identity
+        renew_time = lease.spec.renew_time or 0.0
         if holder == self.identity or now - renew_time > self.lease_duration:
             observed_rv = lease.metadata.resource_version
-            lease.data["holder"] = self.identity
-            lease.data["renew_time"] = str(now)
+            if holder != self.identity:  # takeover, not renewal
+                lease.spec.acquire_time = now
+                lease.spec.lease_transitions += 1
+            lease.spec.holder_identity = self.identity
+            lease.spec.renew_time = now
             cas = getattr(self.kube_client, "compare_and_update", None)
             try:
                 if cas is not None:
@@ -96,7 +105,11 @@ class LeaderElector:
         self._renew_thread.start()
 
     def release(self) -> None:
+        """Clear the renew time so a standby can take over immediately
+        (graceful handoff on shutdown). None (not 0.0) so the field is
+        simply omitted on the wire — a real apiserver rejects non-RFC3339
+        MicroTime values."""
         lease = self._lease()
-        if lease is not None and lease.data.get("holder") == self.identity:
-            lease.data["renew_time"] = "0"
+        if lease is not None and lease.spec.holder_identity == self.identity:
+            lease.spec.renew_time = None
             self.kube_client.update(lease)

@@ -76,6 +76,13 @@ class CacheSyncingClient:
         self._await_event(kind, object_key(applied), applied.metadata.resource_version)
         return applied
 
+    def update_status(self, obj):
+        kind = type(obj).__name__
+        self._queue_for(kind)
+        updated = self._inner.update_status(obj)
+        self._await_event(kind, object_key(updated), updated.metadata.resource_version)
+        return updated
+
     def delete(self, obj_or_kind, namespace: str = None, name: str = None):
         if isinstance(obj_or_kind, str):
             kind, ns, nm = obj_or_kind, namespace or "", name

@@ -71,8 +71,12 @@ class Env:
     # -- object lifecycle (expectations.go:58-213) -------------------------
 
     def expect_applied(self, *objects):
-        """Create-or-update each object, preserving its status across the
-        write (ExpectApplied, expectations.go:110-143)."""
+        """Create-or-update each object INCLUDING its status
+        (ExpectApplied, expectations.go:110-143: the Go helper follows the
+        spec write with a Status().Update so suites that seed status through
+        it keep working). A plain update() alone would silently drop status
+        changes on subresource kinds (kube/client.py
+        STATUS_SUBRESOURCE_KINDS)."""
         for obj in objects:
             kind = type(obj).__name__
             current = self.kube.get(
@@ -83,6 +87,8 @@ class Env:
             else:
                 obj.metadata.resource_version = current.metadata.resource_version
                 self.kube.update(obj)
+                if hasattr(obj, "status"):
+                    self.kube.update_status(obj)
         return objects[0] if len(objects) == 1 else objects
 
     def expect_exists(self, obj_or_kind, name: str = None, namespace: str = ""):

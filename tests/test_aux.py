@@ -163,7 +163,7 @@ def test_inflight_node_shape_undersized(env):
     node.status.capacity = {k: v * 0.5 for k, v in machine.status.capacity.items()}
     node.status.allocatable = dict(node.status.capacity)
     node.status.conditions.append(Condition(type="Ready", status="True"))
-    op.kube_client.apply(node)
+    op.kube_client.update_status(node)  # kubelet writes via /status
     op.sync_state()
     op.inflight_checks.reconcile(op.kube_client.get("Node", "", node.metadata.name))
     events = op.recorder.for_object("Node", node.metadata.name)

@@ -46,7 +46,8 @@ def simulate_kubelet(op, bind_pods=True):
             node.status.allocatable = dict(machine.status.allocatable)
         if not node.ready():
             node.status.conditions.append(Condition(type="Ready", status="True"))
-        op.kube_client.apply(node)
+        # the simulated kubelet writes through the status subresource
+        op.kube_client.update_status(node)
     if bind_pods:
         nodes = [n for n in op.kube_client.list("Node")]
         for pod in op.kube_client.list("Pod"):
@@ -165,7 +166,7 @@ def test_emptiness_ttl_deprovisions(env):
     # pod finishes -> node empty -> emptiness timestamp annotation
     pod = op.kube_client.list("Pod")[0]
     pod.status.phase = "Succeeded"
-    op.kube_client.update(pod)
+    op.kube_client.update_status(pod)  # phase rides the status subresource
     op.step()
     node = op.kube_client.get("Node", "", node_name)
     assert api_labels.EMPTINESS_TIMESTAMP_ANNOTATION_KEY in node.metadata.annotations

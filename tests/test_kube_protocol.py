@@ -1,0 +1,227 @@
+"""Real-cluster protocol contracts (round-5 verdict item 4), enforced
+identically by the in-memory client and the apiserver adapter:
+
+  - status SUBRESOURCE: a plain PUT silently drops status changes (the
+    shipped CRDs declare `subresources: {status: {}}`); status persists
+    only through update_status (reference counter/controller.go:67).
+  - pods/eviction SUBRESOURCE: server-enforced PDBs answer 429
+    (EvictionBlockedError), no host-side TOCTOU (eviction.go:111-124).
+  - coordination.k8s.io/v1 Lease leader election with CAS takeover
+    (operator.go:108-110).
+  - Events post to the cluster through the client (recorder.go:50-56).
+"""
+import pytest
+
+from karpenter_core_tpu.events import Event, Recorder
+from karpenter_core_tpu.kube.client import (
+    EvictionBlockedError,
+    InMemoryKubeClient,
+    NotFoundError,
+)
+from karpenter_core_tpu.kube.objects import (
+    LabelSelector,
+    PodDisruptionBudget,
+    PodDisruptionBudgetSpec,
+    PodDisruptionBudgetStatus,
+)
+from karpenter_core_tpu.testing import FakeClock, make_machine, make_node, make_pod
+
+
+# ---------------------------------------------------------------------------
+# status subresource
+
+
+def test_plain_put_drops_status_changes():
+    c = InMemoryKubeClient()
+    machine = c.create(make_machine())
+    machine.status.provider_id = "fake://m1"
+    machine.metadata.labels["x"] = "1"
+    c.update(machine)
+    stored = c.get("Machine", "", machine.metadata.name)
+    assert stored.metadata.labels["x"] == "1"  # metadata persisted
+    assert stored.status.provider_id == ""  # status silently dropped
+
+
+def test_update_status_persists_only_status():
+    c = InMemoryKubeClient()
+    machine = c.create(make_machine())
+    machine.status.provider_id = "fake://m1"
+    machine.metadata.labels["x"] = "1"  # must NOT ride a /status write
+    c.update_status(machine)
+    stored = c.get("Machine", "", machine.metadata.name)
+    assert stored.status.provider_id == "fake://m1"
+    assert "x" not in stored.metadata.labels
+
+
+def test_update_status_missing_object_raises():
+    c = InMemoryKubeClient()
+    with pytest.raises(NotFoundError):
+        c.update_status(make_machine())
+
+
+def test_node_and_pod_status_are_subresources_too():
+    c = InMemoryKubeClient()
+    node = c.create(make_node(name="n1"))
+    node.status.capacity = {"cpu": 8.0}
+    c.update(node)
+    assert not c.get("Node", "", "n1").status.capacity.get("cpu")
+    c.update_status(node)
+    assert c.get("Node", "", "n1").status.capacity["cpu"] == 8.0
+
+
+def test_configmap_update_unaffected():
+    """Kinds without a status subresource keep plain-PUT semantics."""
+    from karpenter_core_tpu.kube.objects import ConfigMap, ObjectMeta
+
+    c = InMemoryKubeClient()
+    cm = c.create(ConfigMap(metadata=ObjectMeta(name="cm"), data={"a": "1"}))
+    cm.data["a"] = "2"
+    c.update(cm)
+    assert c.get("ConfigMap", "default", "cm").data["a"] == "2"
+
+
+# ---------------------------------------------------------------------------
+# pods/eviction subresource
+
+
+def _blocked_pdb(app: str) -> PodDisruptionBudget:
+    return PodDisruptionBudget(
+        spec=PodDisruptionBudgetSpec(
+            selector=LabelSelector(match_labels={"app": app})
+        ),
+        status=PodDisruptionBudgetStatus(disruptions_allowed=0),
+    )
+
+
+def test_evict_respects_pdb_429():
+    c = InMemoryKubeClient()
+    pdb = _blocked_pdb("web")
+    pdb.metadata.name = "web-pdb"
+    c.create(pdb)
+    pod = c.create(make_pod(name="w1", labels={"app": "web"}))
+    with pytest.raises(EvictionBlockedError):
+        c.evict(pod.metadata.namespace, "w1")
+    assert c.get("Pod", pod.metadata.namespace, "w1") is not None  # not deleted
+
+
+def test_evict_decrements_budget_server_side():
+    """Two concurrent consumers cannot over-evict through a
+    check-then-delete race: the budget decrements atomically with the
+    delete."""
+    c = InMemoryKubeClient()
+    pdb = _blocked_pdb("db")
+    pdb.metadata.name = "db-pdb"
+    pdb.status.disruptions_allowed = 1
+    c.create(pdb)
+    c.create(make_pod(name="d1", labels={"app": "db"}))
+    c.create(make_pod(name="d2", labels={"app": "db"}))
+    c.evict("default", "d1")  # consumes the one disruption
+    with pytest.raises(EvictionBlockedError):
+        c.evict("default", "d2")
+    assert c.get("Pod", "default", "d1") is None
+    assert c.get("Pod", "default", "d2") is not None
+
+
+def test_evict_gone_pod_is_success():
+    InMemoryKubeClient().evict("default", "nope")  # no raise
+
+
+def test_eviction_queue_requeues_on_429():
+    """The terminator's queue routes through the subresource and backs off
+    on 429 instead of deleting around the budget."""
+    from karpenter_core_tpu.controllers.machine.terminator import EvictionQueue
+    from karpenter_core_tpu.kube.objects import object_key
+
+    c = InMemoryKubeClient()
+    pdb = _blocked_pdb("q")
+    pdb.metadata.name = "q-pdb"
+    c.create(pdb)
+    pod = c.create(make_pod(name="q1", labels={"app": "q"}))
+    q = EvictionQueue(c)
+    assert q.evict(object_key(pod)) is False  # blocked -> requeue
+    assert c.get("Pod", "default", "q1") is not None
+    pdb.status.disruptions_allowed = 1
+    c.update(pdb)
+    assert q.evict(object_key(pod)) is True
+    assert c.get("Pod", "default", "q1") is None
+
+
+# ---------------------------------------------------------------------------
+# Lease leader election
+
+
+def test_leader_election_uses_lease_kind():
+    from karpenter_core_tpu.operator.leaderelection import (
+        LEASE_NAME,
+        LEASE_NAMESPACE,
+        LeaderElector,
+    )
+
+    c = InMemoryKubeClient(strict=True)  # Lease must be a registered kind
+    clock = FakeClock()
+    a = LeaderElector(c, identity="a", clock=clock)
+    assert a.try_acquire()
+    lease = c.get("Lease", LEASE_NAMESPACE, LEASE_NAME)
+    assert type(lease).__name__ == "Lease"
+    assert lease.spec.holder_identity == "a"
+    assert lease.spec.renew_time == clock()
+
+    # CAS takeover: a standby wins only after the renew deadline lapses,
+    # and the transition is recorded
+    b = LeaderElector(c, identity="b", clock=clock)
+    assert not b.try_acquire()
+    clock.advance(30.0)
+    assert b.try_acquire()
+    lease = c.get("Lease", LEASE_NAMESPACE, LEASE_NAME)
+    assert lease.spec.holder_identity == "b"
+    assert lease.spec.lease_transitions == 1
+
+
+def test_lease_release_frees_immediately():
+    from karpenter_core_tpu.operator.leaderelection import LeaderElector
+
+    c = InMemoryKubeClient()
+    clock = FakeClock()
+    a = LeaderElector(c, identity="a", clock=clock)
+    b = LeaderElector(c, identity="b", clock=clock)
+    assert a.try_acquire()
+    a.release()
+    assert b.try_acquire()  # no wait for the duration to lapse
+
+
+# ---------------------------------------------------------------------------
+# Events through the client
+
+
+def test_recorder_posts_events_to_cluster():
+    c = InMemoryKubeClient(strict=True)
+    rec = Recorder(kube_client=c)
+    pod = make_pod(name="ev-pod")
+    rec.pod_failed_to_schedule(pod, "insufficient cpu")
+    assert rec.flush()  # cluster posts are async (buffered like client-go)
+    events = c.list("Event")
+    assert len(events) == 1
+    ev = events[0]
+    assert ev.involved_object.kind == "Pod"
+    assert ev.involved_object.name == "ev-pod"
+    assert ev.reason == "FailedScheduling"
+    assert "insufficient cpu" in ev.message
+    assert ev.type == "Warning"
+    assert ev.metadata.namespace == pod.metadata.namespace
+
+    # deduped publishes do NOT multiply cluster objects
+    rec.pod_failed_to_schedule(pod, "insufficient cpu")
+    assert rec.flush()
+    assert len(c.list("Event")) == 1
+
+
+def test_recorder_sink_failure_never_breaks_publish():
+    class ExplodingClient:
+        def create(self, obj):
+            raise RuntimeError("apiserver down")
+
+    rec = Recorder(kube_client=ExplodingClient())
+    assert rec.publish(
+        Event("Node", "n1", "Normal", "Reason", "msg")
+    )  # ring still records
+    assert len(rec.events) == 1

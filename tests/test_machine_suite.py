@@ -135,7 +135,7 @@ def test_not_initialized_while_node_not_ready(env):
     assert not live(op, machine).condition_true(CONDITION_MACHINE_INITIALIZED)
 
     node.status.conditions = [Condition(type="Ready", status="True")]
-    op.kube_client.update(node)
+    op.kube_client.update_status(node)  # kubelet writes via /status
     reconcile(op, machine)
     assert live(op, machine).condition_true(CONDITION_MACHINE_INITIALIZED)
 
@@ -157,7 +157,7 @@ def test_not_initialized_until_extended_resources_registered(env):
     assert not live(op, machine).condition_true(CONDITION_MACHINE_INITIALIZED)
 
     node.status.allocatable["fake.com/vendor-a"] = 2.0
-    op.kube_client.update(node)
+    op.kube_client.update_status(node)  # kubelet registers the resource
     reconcile(op, machine)
     assert live(op, machine).condition_true(CONDITION_MACHINE_INITIALIZED)
 

@@ -155,9 +155,10 @@ def test_machine_capacity_until_node_initialized(env):
         "machine fills unreported resources pre-init"
     )
 
-    # kubelet reports; reported values override the machine's
+    # kubelet reports (via the status subresource); reported values
+    # override the machine's
     node.status.capacity = {"cpu": 3.5, "memory": 3500 * 2**20}
-    op.kube_client.update(node)
+    op.kube_client.update_status(node)
     op.sync_state()
     sn = op.cluster.node_for("m3-node")
     assert sn.capacity().get("cpu") == pytest.approx(3.5), "reported value wins"

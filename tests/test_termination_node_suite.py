@@ -82,7 +82,7 @@ def test_terminal_pods_do_not_block_deletion(env):
     for phase in ("Succeeded", "Failed"):
         pod = running_pod(op, "tn", owner_kind="ReplicaSet")
         pod.status.phase = phase
-        op.kube_client.update(pod)
+        op.kube_client.update_status(pod)  # phase rides the status subresource
     start_deletion(op, node)
     assert op.kube_client.get("Node", "", "tn") is None
 
