@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# Presubmit lane — the reference gates every PR on `make presubmit`
+# (.github/workflows/presubmit.yaml:11-12 runs it across a k8s version
+# matrix); this chains the same gates for this repo: full test suite,
+# enforced perf floor, a short deflake pass over the concurrency-sensitive
+# suites, and the driver verify hooks (single-chip compile + 8-way mesh
+# dryrun at reduced scale).
+#
+# Usage: ./hack/presubmit.sh [quick]
+#   quick  skips the deflake loop (for fast local iteration)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== presubmit: make test"
+make test
+
+echo "== presubmit: make perf (>=100 pods/sec floor)"
+make perf
+
+if [[ "${1:-}" != "quick" ]]; then
+  echo "== presubmit: short deflake (3 iterations)"
+  MAX_ITERS=3 ./hack/deflake.sh
+fi
+
+echo "== presubmit: verify (entry compile + mesh dryrun, reduced scale)"
+KCT_DRYRUN_PODS=600 KCT_DRYRUN_GENERIC_PODS=8000 \
+KCT_DRYRUN_GENERIC_DISTINCT=200 KCT_DRYRUN_GENERIC_TYPES=50 \
+KCT_DRYRUN_GENERIC_EXISTING=100 make verify
+
+echo "== presubmit: OK"

@@ -1,0 +1,48 @@
+"""Persistent XLA compilation cache — kills the cold-start stall.
+
+The solve is ONE fused device program per label geometry, and geometry
+bucketing keeps the program count tiny (one program serves every varied
+50k-pod batch). That makes a disk cache maximally effective: a solver
+restart reloads the compiled executable instead of re-paying the ~2-minute
+cold compile (BENCH_r04 measured 125 s), so a restart can't blank
+provisioning — the reference's in-process Go solver has zero warmup
+(scheduler.go:96) and parity demands the same here.
+
+Wired at boot by the operator (operator/__main__.py), the solver service
+container (solver/service.py main), and the bench. Must run BEFORE the
+first jit compilation in the process.
+"""
+from __future__ import annotations
+
+import os
+import tempfile
+from typing import Optional
+
+
+def enable_persistent_cache(cache_dir: Optional[str] = None) -> Optional[str]:
+    """Point JAX's persistent compilation cache at a disk directory.
+
+    KARPENTER_COMPILE_CACHE_DIR overrides the default
+    (<tmp>/karpenter-tpu-xla-cache); set it to "0" / "off" to disable.
+    Returns the directory in use, or None when disabled/unavailable."""
+    env = os.environ.get("KARPENTER_COMPILE_CACHE_DIR", "")
+    if env.lower() in ("0", "off", "disabled"):
+        return None
+    cache_dir = cache_dir or env or os.path.join(
+        tempfile.gettempdir(), "karpenter-tpu-xla-cache"
+    )
+    try:
+        import jax
+
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # the solve programs are few and large: cache everything, not just
+        # compiles above the (1s) default threshold
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+        try:
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        except Exception:  # noqa: BLE001 — older jax: keep the default
+            pass
+        return cache_dir
+    except Exception:  # noqa: BLE001 — cache is an optimization, never fatal
+        return None
