@@ -674,6 +674,7 @@ def main():
     times = []
     device_times = []
     sched_counts = []
+    run_phases = []  # per-run phase breakdown: attributes the p50->p99 tail
     for r in range(N_RUNS):
         n_pods = int(N_PODS * (0.8 + 0.25 * rng.random()))  # 40k..52.5k
         n_exist = int(N_EXISTING * (0.88 + 0.12 * rng.random()))  # same E bucket
@@ -691,16 +692,30 @@ def main():
         dt = time.perf_counter() - t0
         times.append(dt)
         device_times.append(getattr(solver, "last_device_ms", 0.0))
+        phases = dict(getattr(solver, "last_phase_ms", {}) or {})
+        # everything solve() spent outside the instrumented kernel phases:
+        # encode + decode + relaxation bookkeeping (host python/numpy)
+        phases["other_host"] = round(dt * 1e3 - sum(phases.values()), 1)
+        run_phases.append(phases)
         sched_counts.append(res.pod_count_new() + res.pod_count_existing())
         print(
             f"[bench] run {r + 1}/{N_RUNS}: pods={n_pods} nodes={n_exist} "
             f"solve={dt * 1e3:.0f}ms device={device_times[-1]:.0f}ms "
-            f"scheduled={sched_counts[-1]}",
+            f"scheduled={sched_counts[-1]} phases={phases}",
             file=sys.stderr,
         )
     ts = np.sort(np.array(times))
     p50 = float(np.percentile(ts, 50))
     p99 = float(np.percentile(ts, 99))
+    # same-run histogram + the slowest run's phase attribution: the tail
+    # must be explainable from the artifact itself (PERF.md section)
+    worst = int(np.argmax(times))
+    median_run = int(np.argsort(times)[len(times) // 2])
+    tail_attrib = {
+        "e2e_sorted_ms": [round(t * 1e3, 1) for t in ts.tolist()],
+        "p99_run_phases": run_phases[worst],
+        "p50_run_phases": run_phases[median_run],
+    }
     dev_p50 = float(np.percentile(device_times, 50))
     dev_p99 = float(np.percentile(device_times, 99))
     compiled = len(solver._compiled)
@@ -723,42 +738,20 @@ def main():
     # on the MAIN thread between timed windows; encode (numpy-heavy,
     # GIL-releasing) is what overlaps the device window, which is the
     # production overlap being measured.
-    pipe_runs = N_RUNS
-    pipe_times = []
-    if pipe_runs >= 2:
-        pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+    def pipe_gen(r):
+        n_pods = int(N_PODS * (0.8 + 0.25 * rng.random()))
+        n_exist = int(N_EXISTING * (0.88 + 0.12 * rng.random()))
+        return workload(n_pods, n_exist, 1000 + r)
 
-        def gen(r):
-            n_pods = int(N_PODS * (0.8 + 0.25 * rng.random()))
-            n_exist = int(N_EXISTING * (0.88 + 0.12 * rng.random()))
-            return workload(n_pods, n_exist, 1000 + r)
-
-        def encode(batch):
-            p, pr, it, nd = batch
-            return solver.encode(p, pr, it, state_nodes=nd)
-
-        cur = gen(0)
-        nxt_batch = None
-        nxt = pool.submit(encode, cur)
-        for r in range(pipe_runs):
-            if r + 1 < pipe_runs:
-                nxt_batch = gen(r + 1)  # main thread, untimed
-            snap = nxt.result()
-            p, pr, it, nd = cur
-            if r + 1 < pipe_runs:
-                nxt = pool.submit(encode, nxt_batch)
-            _gc.collect()
-            t0 = time.perf_counter()
-            solver.solve(p, pr, it, state_nodes=nd, encoded=snap)
-            pipe_times.append(time.perf_counter() - t0)
-            print(
-                f"[bench] pipelined {r + 1}/{pipe_runs}: pods={len(p)} "
-                f"solve={pipe_times[-1] * 1e3:.0f}ms",
-                file=sys.stderr,
-            )
-            cur, nxt_batch = nxt_batch, None
-            del p, pr, it, nd, snap
-        pool.shutdown(wait=False)
+    pipe_times = _pipelined_loop(
+        N_RUNS,
+        pipe_gen,
+        lambda b: solver.encode(b[0], b[1], b[2], state_nodes=b[3]),
+        lambda b, snap: solver.solve(
+            b[0], b[1], b[2], state_nodes=b[3], encoded=snap
+        ),
+        "pipelined",
+    )
     pipe_p50 = float(np.percentile(pipe_times, 50)) if pipe_times else 0.0
     pipe_p99 = float(np.percentile(pipe_times, 99)) if pipe_times else 0.0
 
@@ -807,38 +800,15 @@ def main():
                 )
             # the same encode-overlap treatment as the headline: the NEXT
             # batch's encode rides the current solve's device window
-            c5_pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
-            c5_pipe = []
-            cur = c5_gen(500)
-            nxt_batch = None
-            nxt = c5_pool.submit(
+            c5_pipe = _pipelined_loop(
+                c5_runs,
+                lambda r: c5_gen(500 + r),
                 lambda b: solver.encode(b[0], c5_provs, b[1], state_nodes=b[2]),
-                cur,
+                lambda b, snap: solver.solve(
+                    b[0], c5_provs, b[1], state_nodes=b[2], encoded=snap
+                ),
+                "config5 pipelined",
             )
-            for r in range(c5_runs):
-                if r + 1 < c5_runs:
-                    nxt_batch = c5_gen(501 + r)
-                snap = nxt.result()
-                pods, its, nodes = cur
-                if r + 1 < c5_runs:
-                    nxt = c5_pool.submit(
-                        lambda b: solver.encode(
-                            b[0], c5_provs, b[1], state_nodes=b[2]
-                        ),
-                        nxt_batch,
-                    )
-                _gc.collect()
-                t0 = time.perf_counter()
-                solver.solve(pods, c5_provs, its, state_nodes=nodes,
-                             encoded=snap)
-                c5_pipe.append(time.perf_counter() - t0)
-                print(
-                    f"[bench] config5 pipelined {r + 1}/{c5_runs}: "
-                    f"pods={len(pods)} solve={c5_pipe[-1] * 1e3:.0f}ms",
-                    file=sys.stderr,
-                )
-                cur, nxt_batch = nxt_batch, None
-            c5_pool.shutdown(wait=False)
             c5 = {
                 "provisioners": len(c5_provs),
                 "e2e_p50_ms": round(float(np.percentile(c5_times, 50)) * 1e3, 1),
@@ -966,6 +936,11 @@ def main():
             env = dict(os.environ)
             env["BENCH_WARM_RESTART"] = "1"
             env["BENCH_COMPILE_CACHE_DIR"] = cache_dir
+            # the child must PROBE for itself (a wedged-mid-run tunnel would
+            # otherwise hang its direct jax init until the watchdog), and
+            # must not inherit the shrink the parent's own fallback applied
+            env.pop("BENCH_SKIP_PROBE", None)
+            env.pop("BENCH_CPU_SHRINK", None)
             rc, out, _, timed_out = _run_subprocess(
                 [sys.executable, os.path.abspath(__file__)], env,
                 int(min(_worker_time_left() - 60, 900)),
@@ -973,6 +948,16 @@ def main():
             warm_restart = _parse_json_line(out) or {
                 "error": f"rc={rc} timed_out={timed_out}"
             }
+            parent_platform = jax.devices()[0].platform
+            if (
+                "error" not in warm_restart
+                and (warm_restart.get("platform") != parent_platform
+                     or warm_restart.get("pods") != N_PODS)
+            ):
+                # a CPU-fallback / shrunk child measured something else:
+                # keep the data but label it invalid for the restart claim
+                warm_restart = {"error": "backend or workload mismatch",
+                                **warm_restart}
             print(f"[bench] warm restart: {warm_restart}", file=sys.stderr)
 
     print(
@@ -1003,6 +988,7 @@ def main():
                     "north_star_target_ms": 1000.0,
                     "device_under_target": bool(dev_p99 < 1000.0),
                     "runs": N_RUNS,
+                    "tail": tail_attrib,
                     "scheduled_min": int(min(sched_counts)),
                     "compile_cold_s": round(cold_s, 1),
                     "warm_restart": warm_restart,
@@ -1017,6 +1003,44 @@ def main():
             }
         )
     )
+
+
+def _pipelined_loop(n_runs, gen, encode, solve_encoded, label):
+    """The production encode-overlap protocol, shared by the headline and
+    config-5 measurements: batch N+1's encode rides a worker thread while
+    solve N runs (the host is idle in the device window). gen(r) -> batch
+    on the MAIN thread (untimed; generating 50k pod objects on the worker
+    starved the timed solve's GIL — see the headline loop's history);
+    encode(batch) -> snapshot on the worker; solve_encoded(batch, snap) is
+    the timed region. Returns per-run seconds."""
+    import concurrent.futures
+    import gc as _gc
+
+    times = []
+    if n_runs < 2:
+        return times
+    pool = concurrent.futures.ThreadPoolExecutor(max_workers=1)
+    cur = gen(0)
+    nxt_batch = None
+    nxt = pool.submit(encode, cur)
+    for r in range(n_runs):
+        if r + 1 < n_runs:
+            nxt_batch = gen(r + 1)
+        snap = nxt.result()
+        if r + 1 < n_runs:
+            nxt = pool.submit(encode, nxt_batch)
+        _gc.collect()
+        t0 = time.perf_counter()
+        solve_encoded(cur, snap)
+        times.append(time.perf_counter() - t0)
+        print(
+            f"[bench] {label} {r + 1}/{n_runs}: "
+            f"solve={times[-1] * 1e3:.0f}ms",
+            file=sys.stderr,
+        )
+        cur, nxt_batch = nxt_batch, None
+    pool.shutdown(wait=False)
+    return times
 
 
 def warm_restart_entry():
@@ -1042,6 +1066,8 @@ def warm_restart_entry():
     t0 = time.perf_counter()
     res = solver.solve(pods, provisioners, its, state_nodes=nodes)
     first_solve_s = time.perf_counter() - t0
+    import jax
+
     print(
         json.dumps(
             {
@@ -1049,6 +1075,10 @@ def warm_restart_entry():
                 "total_restart_s": round(time.perf_counter() - t_boot, 1),
                 "workload_gen_s": round(gen_s, 1),
                 "scheduled": res.pod_count_new() + res.pod_count_existing(),
+                # the parent validates these: a CPU-fallback or shrunk child
+                # must not masquerade as the TPU restart stall
+                "platform": jax.devices()[0].platform,
+                "pods": N_PODS,
             }
         )
     )

@@ -569,6 +569,9 @@ class ShardedSolver:
             DEFAULT_MAX_RELAX_ROUNDS if max_relax_rounds is None else max_relax_rounds
         )
         self._compiled = {}
+        from karpenter_core_tpu.solver.encode import EncodeReuse
+
+        self._encode_reuse = EncodeReuse()
 
     @property
     def max_nodes(self) -> int:
@@ -587,6 +590,7 @@ class ShardedSolver:
             pods, provisioners, instance_types, daemonset_pods, state_nodes,
             kube_client=kube_client, cluster=cluster,
             max_nodes=self.max_nodes_per_shard,
+            reuse=self._encode_reuse,
         )
 
     def solve(self, pods, provisioners, instance_types, daemonset_pods=None,
@@ -631,6 +635,7 @@ class ShardedSolver:
                     pods, provisioners, instance_types, daemonset_pods,
                     state_nodes, kube_client=kube_client, cluster=cluster,
                     max_nodes=self.max_nodes_per_shard,
+                    reuse=self._encode_reuse,
                 )
             mesh = self.mesh
             if len(snap.instance_types) % mesh.shape["tp"] != 0:

@@ -456,6 +456,59 @@ class EncodedSnapshot:
         return self._gather("pod_vols", self.pod_vols_u)
 
 
+class EncodeReuse:
+    """Cross-solve carrier for encode work whose inputs are stable between
+    batches (round-5 verdict #2: "cluster state and dictionaries change
+    little between batches — reuse").
+
+    The INSTANCE-TYPE planes are the reusable unit: a cluster's type
+    universe is the same objects solve after solve (the cloud provider
+    caches them), and their encoded planes depend only on (type objects,
+    dictionary content, resource names, offering state) — all captured in
+    the cache key, so a label-universe, extended-resource, or
+    offering-availability change simply misses and re-encodes. The carrier
+    holds a strong reference to the keyed type objects (an id()-only key
+    could collide after the originals are freed) and is thread-safe: the
+    pipelined production loop encodes batch N+1 on a worker thread while a
+    relaxation round re-encodes on the main thread. Hold one per solver
+    (TPUSolver/ShardedSolver/RemoteSolver own one) and pass it to
+    encode_snapshot(reuse=...)."""
+
+    def __init__(self):
+        import threading
+
+        self._lock = threading.Lock()
+        self._key = None
+        self._planes = None
+        self._keyed_types = None  # strong refs: keeps the id() key valid
+
+    def get(self, key):
+        with self._lock:
+            return self._planes if self._key == key else None
+
+    def put(self, key, planes, all_types) -> None:
+        with self._lock:
+            self._key = key
+            self._planes = planes
+            self._keyed_types = list(all_types)
+
+    @staticmethod
+    def dict_signature(dictionary: "LabelDictionary") -> Tuple:
+        return tuple(
+            (k, tuple(dictionary.values_of(k))) for k in dictionary.keys
+        )
+
+    @staticmethod
+    def offering_signature(all_types) -> Tuple:
+        # Offering.available/price are mutable in place (the provider flips
+        # availability between solves); they must key the cache
+        return tuple(
+            tuple((o.zone, o.capacity_type, o.available, o.price)
+                  for o in it.offerings)
+            for it in all_types
+        )
+
+
 def encode_snapshot(
     pods: List[Pod],
     provisioners: List[Provisioner],
@@ -466,6 +519,7 @@ def encode_snapshot(
     cluster=None,
     max_nodes: int = 1024,
     reuse_dictionary: Optional[LabelDictionary] = None,
+    reuse: Optional[EncodeReuse] = None,
 ) -> EncodedSnapshot:
     """Lower a provisioning snapshot to tensors.
 
@@ -476,6 +530,10 @@ def encode_snapshot(
     snapshot whose value universe is a superset of this batch's (relaxation
     only removes requirements) — reusing it keeps V/K/segments identical so
     relaxation re-solves hit the compiled program instead of recompiling.
+
+    reuse: an EncodeReuse carried across solves; stable instance-type
+    planes are reused instead of re-encoded when types, dictionary content,
+    and resource names all match the previous batch.
     """
     from karpenter_core_tpu.api.provisioner import order_by_weight
 
@@ -656,33 +714,57 @@ def encode_snapshot(
         for tid in row:
             tmpl_type_mask[j, tid] = True
 
-    type_alloc = np.stack([encode_resources(it.allocatable()) for it in all_types]) if T else np.zeros((0, R), np.float32)
-    type_capacity = np.stack([encode_resources(it.capacity) for it in all_types]) if T else np.zeros((0, R), np.float32)
-
-    # -- offerings ---------------------------------------------------------
     zlo, zhi = dictionary.segment(LABEL_TOPOLOGY_ZONE)
     clo, chi = dictionary.segment(api_labels.LABEL_CAPACITY_TYPE)
-    Z, C = zhi - zlo, chi - clo
-    zones = dictionary.values_of(LABEL_TOPOLOGY_ZONE)
-    cts = dictionary.values_of(api_labels.LABEL_CAPACITY_TYPE)
-    z_index = {z: i for i, z in enumerate(zones)}
-    c_index = {c: i for i, c in enumerate(cts)}
-    type_offering_ok = np.zeros((T, Z, C), dtype=bool)
-    type_offering_price = np.full((T, Z, C), np.inf, dtype=np.float32)
-    for t, it in enumerate(all_types):
-        for o in it.offerings:
-            if not o.available:
-                continue
-            zi, ci = z_index.get(o.zone), c_index.get(o.capacity_type)
-            if zi is None or ci is None:
-                continue
-            type_offering_ok[t, zi, ci] = True
-            type_offering_price[t, zi, ci] = min(type_offering_price[t, zi, ci], o.price)
-    type_min_price = np.where(
-        type_offering_ok.any(axis=(1, 2)),
-        np.min(type_offering_price, axis=(1, 2)),
-        np.inf,
-    ).astype(np.float32)
+
+    # -- instance-type planes (reusable across solves) ---------------------
+    # pure function of (type objects, dictionary content, resource names):
+    # the type universe is stable between production batches, so these
+    # planes are the first thing incremental encode skips
+    type_key = (
+        _ids(all_types),
+        EncodeReuse.dict_signature(dictionary),
+        tuple(resource_names),
+        EncodeReuse.offering_signature(all_types),
+    )
+    cached = reuse.get(type_key) if reuse is not None else None
+    if cached is not None:
+        (type_reqs_arr, type_alloc, type_capacity, type_offering_ok,
+         type_offering_price, type_min_price) = cached
+    else:
+        type_alloc = np.stack([encode_resources(it.allocatable()) for it in all_types]) if T else np.zeros((0, R), np.float32)
+        type_capacity = np.stack([encode_resources(it.capacity) for it in all_types]) if T else np.zeros((0, R), np.float32)
+
+        # -- offerings -----------------------------------------------------
+        Z, C = zhi - zlo, chi - clo
+        zones = dictionary.values_of(LABEL_TOPOLOGY_ZONE)
+        cts = dictionary.values_of(api_labels.LABEL_CAPACITY_TYPE)
+        z_index = {z: i for i, z in enumerate(zones)}
+        c_index = {c: i for i, c in enumerate(cts)}
+        type_offering_ok = np.zeros((T, Z, C), dtype=bool)
+        type_offering_price = np.full((T, Z, C), np.inf, dtype=np.float32)
+        for t, it in enumerate(all_types):
+            for o in it.offerings:
+                if not o.available:
+                    continue
+                zi, ci = z_index.get(o.zone), c_index.get(o.capacity_type)
+                if zi is None or ci is None:
+                    continue
+                type_offering_ok[t, zi, ci] = True
+                type_offering_price[t, zi, ci] = min(type_offering_price[t, zi, ci], o.price)
+        type_min_price = np.where(
+            type_offering_ok.any(axis=(1, 2)),
+            np.min(type_offering_price, axis=(1, 2)),
+            np.inf,
+        ).astype(np.float32)
+        type_reqs_arr = encode_reqsets(type_reqs_list, dictionary)
+        if reuse is not None:
+            reuse.put(
+                type_key,
+                (type_reqs_arr, type_alloc, type_capacity, type_offering_ok,
+                 type_offering_price, type_min_price),
+                all_types,
+            )
 
     # -- taints ------------------------------------------------------------
     pod_tol_u = np.zeros((U, J), dtype=bool)
@@ -902,7 +984,7 @@ def encode_snapshot(
         tmpl_reqs=encode_reqsets(tmpl_reqs_list, dictionary),
         tmpl_daemon=tmpl_daemon,
         tmpl_type_mask=tmpl_type_mask,
-        type_reqs=encode_reqsets(type_reqs_list, dictionary),
+        type_reqs=type_reqs_arr,
         type_alloc=type_alloc,
         type_capacity=type_capacity,
         type_offering_ok=type_offering_ok,

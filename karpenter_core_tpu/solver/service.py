@@ -335,6 +335,9 @@ class RemoteSolver:
 
             max_relax_rounds = DEFAULT_MAX_RELAX_ROUNDS
         self.max_relax_rounds = max_relax_rounds
+        from karpenter_core_tpu.solver.encode import EncodeReuse
+
+        self._encode_reuse = EncodeReuse()
         self._solve = self.channel.unary_unary(
             f"/{SERVICE}/Solve",
             request_serializer=pb.SolveRequest.SerializeToString,
@@ -379,6 +382,7 @@ class RemoteSolver:
         snap = encode_snapshot(
             pods, provisioners, instance_types, daemonset_pods, state_nodes,
             kube_client=kube_client, cluster=cluster, max_nodes=self.max_nodes,
+            reuse=self._encode_reuse,
         )
         args = device_args(snap, provisioners)
         request = pb.SolveRequest(

@@ -126,6 +126,21 @@ def test_evict_gone_pod_is_success():
     InMemoryKubeClient().evict("default", "nope")  # no raise
 
 
+def test_evict_refuses_multiple_covering_pdbs():
+    """The real eviction API refuses when >1 PDB covers a pod (it cannot
+    atomically update multiple budgets) — so must the in-memory server."""
+    c = InMemoryKubeClient()
+    for name in ("pdb-a", "pdb-b"):
+        pdb = _blocked_pdb("multi")
+        pdb.metadata.name = name
+        pdb.status.disruptions_allowed = 5
+        c.create(pdb)
+    c.create(make_pod(name="m1", labels={"app": "multi"}))
+    with pytest.raises(EvictionBlockedError, match="more than one"):
+        c.evict("default", "m1")
+    assert c.get("Pod", "default", "m1") is not None
+
+
 def test_eviction_queue_requeues_on_429():
     """The terminator's queue routes through the subresource and backs off
     on 429 instead of deleting around the budget."""

@@ -434,11 +434,17 @@ def test_quality_scaling_curve_across_mesh_sizes():
         )
         assert not res.failed_pods, f"dp={ndp} dropped pods"
         curve[ndp] = len(res.new_machines)
-    # quality parity bound: each doubling of dp may cost at most ~10%
-    # extra nodes over single-device (shard-local leftover slack)
+    # quality parity bound (tightened round 5 from ~10% per doubling): the
+    # dp split's only systematic costs are ONE partially-filled leftover
+    # node per shard (disjoint budgets) plus ~2% split pessimism (limit
+    # pre-shares, component routing). Measured: dp=2 and dp=4 both +3
+    # nodes here (the per-shard remainder, not a percentage), and the 50k
+    # dryrun mixes measure +0.2% (generic) / -0.4% (anti-heavy).
     for ndp, nodes in curve.items():
-        assert nodes <= int(base * (1.0 + 0.10 * (ndp.bit_length() - 1))) + 1, (
-            f"dp={ndp}: {nodes} nodes vs single-device {base} ({curve})"
+        bound = base + ndp + max(1, int(base * 0.02))
+        assert nodes <= bound, (
+            f"dp={ndp}: {nodes} nodes vs single-device {base}, "
+            f"bound {bound} ({curve})"
         )
 
 

@@ -223,6 +223,32 @@ def test_service_sharded_parity_with_tpu_solver(sharded_server):
         assert m.requirements.get_requirement(LABEL_TOPOLOGY_ZONE) is not None
 
 
+def test_service_sharded_slot_growth_retry(sharded_server):
+    """When a shard exhausts the per-shard slot budget, the CLIENT detects
+    it from the returned nopen and re-requests with a doubled budget (the
+    remote analog of ShardedSolver's self-healing sizing)."""
+    port, _ = sharded_server
+    client = RemoteSolver(f"127.0.0.1:{port}", max_nodes=2)
+    # 40 one-cpu pods on 8-cpu nodes need ~5+ machines; with dp=4 x 2
+    # slots the first attempt exhausts at 8 machines worst-case split —
+    # force it harder with anti-affinity one-per-node services
+    anti = PodAffinityTerm(
+        topology_key=LABEL_HOSTNAME,
+        label_selector=LabelSelector(match_labels={"app": "grow"}),
+    )
+    pods = [
+        make_pod(labels={"app": "grow"}, requests={"cpu": "1"},
+                 pod_anti_affinity_required=[anti])
+        for _ in range(24)
+    ]
+    res = client.solve(
+        pods, [make_provisioner(name="default")], {"default": fake.instance_types(8)}
+    )
+    assert not res.failed_pods
+    assert len(res.new_machines) == 24  # one per node (anti)
+    assert client.max_nodes > 2  # the budget grew
+
+
 def test_service_sharded_hostname_anti(sharded_server):
     """Hostname anti-affinity (the free-splitting bulk lane) survives the
     service round trip: one replica per node."""
