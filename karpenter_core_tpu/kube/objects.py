@@ -97,12 +97,25 @@ class PreferredSchedulingTerm:
     preference: NodeSelectorTerm
 
 
+_AFFINITY_WIRE = {
+    "required": "requiredDuringSchedulingIgnoredDuringExecution",
+    "preferred": "preferredDuringSchedulingIgnoredDuringExecution",
+}
+
+
 @dataclass
 class NodeAffinity:
     # required terms are ORed (any one term may match); expressions within a
     # term are ANDed — mirrors v1.NodeSelector semantics.
     required: List[NodeSelectorTerm] = field(default_factory=list)
     preferred: List[PreferredSchedulingTerm] = field(default_factory=list)
+
+    # the k8s wire names (kube/serialization.py consults these; a real
+    # apiserver payload would otherwise never populate `required`) — and
+    # NodeAffinity's required list additionally wraps in a NodeSelector
+    # object on the wire
+    _WIRE_OVERRIDES = _AFFINITY_WIRE
+    _WIRE_WRAP = {"required": "nodeSelectorTerms"}
 
 
 @dataclass
@@ -159,11 +172,15 @@ class PodAffinity:
     required: List[PodAffinityTerm] = field(default_factory=list)
     preferred: List[WeightedPodAffinityTerm] = field(default_factory=list)
 
+    _WIRE_OVERRIDES = _AFFINITY_WIRE
+
 
 @dataclass
 class PodAntiAffinity:
     required: List[PodAffinityTerm] = field(default_factory=list)
     preferred: List[WeightedPodAffinityTerm] = field(default_factory=list)
+
+    _WIRE_OVERRIDES = _AFFINITY_WIRE
 
 
 @dataclass

@@ -31,12 +31,24 @@ def snake(name: str) -> str:
     return "".join(out)
 
 
-# metadata fields whose wire names aren't a plain camelCase of the attribute
+# fields whose wire names aren't a plain camelCase of the attribute.
+# provider_id rides as "providerID" (capital ID — k8s convention, and the
+# shipped Machine CRD schema's own spelling): a real apiserver would
+# silently drop "providerId" writes and the adapter would decode '' back,
+# breaking node<->machine matching (caught by tests/test_wire_fixtures.py).
 _SPECIAL_WIRE = {
     "creation_timestamp": "creationTimestamp",
     "deletion_timestamp": "deletionTimestamp",
     "resource_version": "resourceVersion",
+    "provider_id": "providerID",
 }
+
+
+def _wire_name(cls, fname: str) -> str:
+    overrides = getattr(cls, "_WIRE_OVERRIDES", None)
+    if overrides and fname in overrides:
+        return overrides[fname]
+    return _SPECIAL_WIRE.get(fname, camel(fname))
 
 
 def _is_time_field(name: str) -> bool:
@@ -101,15 +113,20 @@ def from_k8s_dict(cls, data):
         return {k: from_k8s_dict(val_tp, v) for k, v in data.items()}
     if dataclasses.is_dataclass(tp):
         hints = typing.get_type_hints(tp)
+        wrap = getattr(tp, "_WIRE_WRAP", None)
         kwargs = {}
         for f in dataclasses.fields(tp):
-            wire = _SPECIAL_WIRE.get(f.name, camel(f.name))
+            wire = _wire_name(tp, f.name)
             if wire in data:
                 raw = data[wire]
             elif f.name in data:
                 raw = data[f.name]
             else:
                 continue
+            if wrap and f.name in wrap and isinstance(raw, dict):
+                # wire wraps the list in an object (e.g. NodeAffinity's
+                # required is a NodeSelector{nodeSelectorTerms: [...]})
+                raw = raw.get(wrap[f.name], [])
             if _is_time_field(f.name) and raw is not None:
                 kwargs[f.name] = _parse_time(raw)
             else:
@@ -135,6 +152,7 @@ def to_k8s_dict(obj):
         return None
     if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
         out = {}
+        wrap = getattr(type(obj), "_WIRE_WRAP", None)
         for f in dataclasses.fields(obj):
             value = getattr(obj, f.name)
             if _is_time_field(f.name) and isinstance(value, (int, float)):
@@ -146,7 +164,9 @@ def to_k8s_dict(obj):
                 encoded = to_k8s_dict(value)
             if encoded in (None, [], {}, ""):
                 continue
-            out[_SPECIAL_WIRE.get(f.name, camel(f.name))] = encoded
+            if wrap and f.name in wrap:
+                encoded = {wrap[f.name]: encoded}
+            out[_wire_name(type(obj), f.name)] = encoded
         return out
     if isinstance(obj, list):
         return [to_k8s_dict(v) for v in obj]

@@ -508,6 +508,23 @@ class EncodeReuse:
             for it in all_types
         )
 
+    @staticmethod
+    def resource_signature(all_types) -> Tuple:
+        # capacity/overhead are plain mutable attributes on the same cached
+        # type objects — a provider refreshing them in place must miss the
+        # cache, or the solver packs against stale per-type resources.
+        # Requirements objects are keyed by identity (reassignment misses;
+        # the reference's providers build requirements at type construction
+        # and never mutate them in place).
+        return tuple(
+            (
+                tuple(sorted(it.capacity.items())),
+                tuple(sorted(it.allocatable().items())),
+                id(it.requirements),
+            )
+            for it in all_types
+        )
+
 
 def encode_snapshot(
     pods: List[Pod],
@@ -726,6 +743,7 @@ def encode_snapshot(
         EncodeReuse.dict_signature(dictionary),
         tuple(resource_names),
         EncodeReuse.offering_signature(all_types),
+        EncodeReuse.resource_signature(all_types),
     )
     cached = reuse.get(type_key) if reuse is not None else None
     if cached is not None:

@@ -470,6 +470,31 @@ def main(argv: Optional[List[str]] = None) -> None:
             raise RuntimeError(
                 "KARPENTER_SOLVER_MODE=sharded but only one device is visible"
             )
+    # boot warmup BEFORE binding the port (i.e. before readiness): load the
+    # jax runtime and compile/load a small solve so the first production
+    # Solve doesn't eat the backend-init stall; with the persistent cache
+    # populated, real-geometry programs load from disk on first request
+    if os.environ.get("KARPENTER_SOLVER_WARMUP", "1") != "0":
+        import time as _time
+
+        t0 = _time.perf_counter()
+        try:  # warmup is best-effort: a flake must not crash-loop the pod
+            from karpenter_core_tpu.cloudprovider import fake as _fake
+            from karpenter_core_tpu.solver.factory import build_solver
+            from karpenter_core_tpu.testing import make_pod, make_provisioner
+
+            warm = build_solver(max_nodes=64)
+            warm.solve(
+                [make_pod(requests={"cpu": "1"}) for _ in range(32)],
+                [make_provisioner(name="default")],
+                {"default": _fake.instance_types(4)},
+            )
+            print(
+                f"solver warmup done in {_time.perf_counter() - t0:.1f}s",
+                flush=True,
+            )
+        except Exception as exc:  # noqa: BLE001 — serve anyway
+            print(f"solver warmup failed (serving anyway): {exc}", flush=True)
     server, port, _service = serve(
         f"{args.host}:{args.port}", max_workers=args.max_workers, mesh=mesh
     )

@@ -21,6 +21,12 @@ _applied = False
 _pause_lock = threading.Lock()
 _pause_depth = 0
 _pause_reenable = False
+_pause_since = 0.0
+# under SUSTAINED overlapping solves (the gRPC service's worker pool) the
+# depth may never return to zero, which would leave cyclic GC off for the
+# process lifetime; past this span a window EXIT runs an explicit collect
+# (gc.collect works while disabled) so cyclic garbage stays bounded
+MAX_DEFERRED_SPAN_S = 30.0
 
 
 @contextlib.contextmanager
@@ -30,24 +36,39 @@ def gc_paused():
     batch costs 100-300 ms when it lands mid-solve — measured as the
     dominant p50->p99 e2e tail source (BENCH r5 tail attribution: p99 run
     +295 ms of host time at flat device time). Refcounting still frees
-    acyclic garbage immediately; cyclic garbage waits the ~1 s until the
-    window closes. Nested/concurrent use is safe via a process-wide depth
-    counter: GC re-enables only when the LAST window closes (the gRPC
-    service runs 4 solve workers concurrently — an inner exit must not
-    re-enable GC under another thread's window)."""
-    global _pause_depth, _pause_reenable
+    acyclic garbage immediately; cyclic garbage waits until a window closes.
+    Nested/concurrent use is safe via a process-wide depth counter: GC
+    re-enables only when the LAST window closes (the gRPC service runs 4
+    solve workers concurrently — an inner exit must not re-enable GC under
+    another thread's window), and sustained overlap is bounded by an
+    explicit collect on window exits past MAX_DEFERRED_SPAN_S."""
+    import time
+
+    global _pause_depth, _pause_reenable, _pause_since
     with _pause_lock:
         if _pause_depth == 0:
             _pause_reenable = gc.isenabled()
+            _pause_since = time.monotonic()
             gc.disable()
         _pause_depth += 1
     try:
         yield
     finally:
+        collect_now = False
         with _pause_lock:
             _pause_depth -= 1
-            if _pause_depth == 0 and _pause_reenable:
-                gc.enable()
+            if _pause_depth == 0:
+                if _pause_reenable:
+                    gc.enable()
+            elif time.monotonic() - _pause_since > MAX_DEFERRED_SPAN_S:
+                # overlapping windows have kept GC off too long: pay one
+                # collection on THIS exiting solve's thread (off the other
+                # threads' critical windows is impossible process-wide, but
+                # unbounded deferral risks OOM — bound it)
+                _pause_since = time.monotonic()
+                collect_now = True
+        if collect_now:
+            gc.collect()
 
 
 def apply_server_gc_tuning(gen2_threshold: int = 100) -> None:
