@@ -472,6 +472,8 @@ def make_sharded_solve(snap, provisioners, mesh, max_nodes_per_shard: int = 256,
     # steady-state solves and relaxation rounds reuse one compiled program
     cache_key = (geom, ndp, ntp)
     fn = None if program_cache is None else program_cache.get(cache_key)
+    if fn is not None and hasattr(program_cache, "move_to_end"):
+        program_cache.move_to_end(cache_key)  # LRU recency (ShardedSolver)
     if fn is None:
         fn = make_sharded_run(
             segments_t, zone_seg, ct_seg, snap.topo_meta, N, mesh,
@@ -568,7 +570,12 @@ class ShardedSolver:
         self.max_relax_rounds = (
             DEFAULT_MAX_RELAX_ROUNDS if max_relax_rounds is None else max_relax_rounds
         )
-        self._compiled = {}
+        # LRU-bounded (same rationale as TPUSolver/SolverService: label
+        # churn mints geometries; don't pin old executables forever)
+        from collections import OrderedDict
+
+        self.MAX_COMPILED = 32
+        self._compiled = OrderedDict()
         from karpenter_core_tpu.solver.encode import EncodeReuse
 
         self._encode_reuse = EncodeReuse()
@@ -647,6 +654,8 @@ class ShardedSolver:
                 max_nodes_per_shard=self.max_nodes_per_shard,
                 program_cache=self._compiled,
             )
+            while len(self._compiled) > self.MAX_COMPILED:
+                self._compiled.popitem(last=False)
             with mesh:
                 log, ptr, state, _scheduled = fn(*args)
                 jax.block_until_ready(log)

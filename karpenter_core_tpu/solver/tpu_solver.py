@@ -536,9 +536,15 @@ class TPUSolver:
         # opt-in: barrier after upload so last_phase_ms attributes transfer
         # time separately (costs cold solves the serialized upload)
         self.profile_phases = profile_phases
-        self._compiled = {}
-        # per-geometry (ptr_b, bulk_b, nopen_b) from the previous solve:
-        # the speculative single-round-trip fetch slices with these
+        # LRU-bounded like the gRPC service's cache: geometry embeds the
+        # label dictionary, so live-cluster label churn mints new keys — an
+        # unbounded map would pin every old compiled executable (HBM + host)
+        from collections import OrderedDict
+
+        self.MAX_COMPILED = 32
+        self._compiled = OrderedDict()
+        # per-geometry (ptr_b, bulk_b, nopen_b, nnz_b) from the previous
+        # solve: the speculative single-round-trip fetch slices with these
         self._fetch_buckets = {}
         # incremental encode: stable instance-type planes carry across
         # solves (encode.EncodeReuse)
@@ -701,6 +707,8 @@ class TPUSolver:
         _mark("pack")
         key = (geom, self.backend, spec, treedef, tuple(layout))
         fn = self._compiled.get(key)
+        if fn is not None:
+            self._compiled.move_to_end(key)
         if fn is None:
             def run_bundled(bundle, *donated):
                 it = iter(donated)
@@ -734,6 +742,9 @@ class TPUSolver:
                 ),
             )
             self._compiled[key] = fn
+            while len(self._compiled) > self.MAX_COMPILED:
+                old_key, _ = self._compiled.popitem(last=False)
+                self._fetch_buckets.pop(old_key, None)
         # opt-in device profiling around the Solve dispatch — the analog of
         # the reference's pprof-profiled benchmark capture
         # (scheduling_benchmark_test.go:84-95); view with tensorboard or
