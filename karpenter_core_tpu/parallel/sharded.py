@@ -561,6 +561,14 @@ class ShardedSolver:
     the pipelined encode()/solve(encoded=) surface matches TPUSolver so the
     provisioning loop overlaps encode with the previous solve either way."""
 
+    # the consolidation ladder's vmapped screen (solver/replan.py) is
+    # independent of the provisioning solve path: it builds its own device
+    # program and runs on ONE device (a 1k-node ladder fits a single chip),
+    # so a multi-chip deployment keeps the batched-replan fast path —
+    # provisioning fans out over the mesh, the screen rides chip 0
+    supports_batched_replan = True
+    backend = None  # default kernel lowering for the screen program
+
     def __init__(self, mesh, max_nodes_per_shard: int = 256,
                  max_relax_rounds: Optional[int] = None):
         from karpenter_core_tpu.solver.tpu_solver import DEFAULT_MAX_RELAX_ROUNDS

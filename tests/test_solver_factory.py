@@ -173,6 +173,79 @@ def test_resilient_over_sharded_assembly():
     assert solver._healthy is True
 
 
+def test_sharded_batched_consolidation_ladder():
+    """A multi-chip deployment keeps the vmapped consolidation ladder: the
+    screen program is solver-independent and runs on one device, so
+    ShardedSolver advertises supports_batched_replan and the ladder result
+    matches the host (sequential) ladder on the same cluster."""
+    from karpenter_core_tpu.api.labels import (
+        LABEL_NODE_INITIALIZED,
+    )
+    from karpenter_core_tpu.api.settings import Settings
+    from karpenter_core_tpu.controllers.deprovisioning.core import candidate_nodes
+    from karpenter_core_tpu.operator import new_operator
+    from karpenter_core_tpu.testing import FakeClock, make_node
+
+    clock = FakeClock()
+    cp = fake.FakeCloudProvider(fake.instance_types(10))
+    solver = ShardedSolver(detect_mesh(), max_nodes_per_shard=16)
+    assert solver.supports_batched_replan
+    op = new_operator(cp, settings=Settings(), solver=solver, clock=clock)
+    op.kube_client.create(
+        make_provisioner(name="default", consolidation_enabled=True)
+    )
+    op.kube_client.create(make_provisioner(name="static"))
+    keeper = make_node(
+        name="keeper",
+        labels={PROVISIONER_NAME_LABEL_KEY: "static",
+                LABEL_NODE_INITIALIZED: "true"},
+        capacity={"cpu": "10", "memory": "20Gi", "pods": "100"},
+    )
+    op.kube_client.create(keeper)
+    from karpenter_core_tpu.api.labels import (
+        LABEL_CAPACITY_TYPE,
+    )
+    from karpenter_core_tpu.kube.objects import (
+        LABEL_INSTANCE_TYPE_STABLE,
+        LABEL_TOPOLOGY_ZONE,
+    )
+
+    for i in range(6):
+        node = make_node(
+            name=f"lite-{i}",
+            labels={
+                PROVISIONER_NAME_LABEL_KEY: "default",
+                LABEL_NODE_INITIALIZED: "true",
+                LABEL_INSTANCE_TYPE_STABLE: "fake-it-9",
+                LABEL_CAPACITY_TYPE: "on-demand",
+                LABEL_TOPOLOGY_ZONE: "test-zone-1",
+            },
+            capacity={"cpu": "10", "memory": "20Gi", "pods": "100"},
+        )
+        node.metadata.creation_timestamp = clock()
+        op.kube_client.create(node)
+        pod = make_pod(requests={"cpu": "0.1"}, node_name=f"lite-{i}",
+                       unschedulable=False)
+        pod.status.phase = "Running"
+        op.kube_client.create(pod)
+    op.sync_state()
+    multi = next(
+        d for d in op.deprovisioning.deprovisioners
+        if type(d).__name__ == "MultiNodeConsolidation"
+    )
+    multi.validation_ttl = 0.0
+    candidates = multi.sort_and_filter_candidates(
+        candidate_nodes(op.cluster, op.kube_client, cp,
+                        multi.should_deprovision, clock)
+    )
+    assert len(candidates) == 6
+    cmd = multi.first_n_consolidation_ladder(candidates)
+    assert cmd.action == "delete"
+    # every displaced pod fits the keeper: the ladder removes all of them
+    assert len(cmd.nodes_to_remove) == 6
+    assert not cmd.replacement_machines
+
+
 # ---------------------------------------------------------------------------
 # gRPC service over the mesh
 
