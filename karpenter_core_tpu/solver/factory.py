@@ -19,11 +19,44 @@ Selection (KARPENTER_SOLVER_MODE, default "auto"):
 Mesh shape: tp = KARPENTER_MESH_TP when set; else 2 when the device count
 is a multiple of 2 and >= 4 (the dryrun-validated split — feasibility's
 type-axis matmuls gather over 'tp' on ICI), else 1. dp takes the rest.
+
+Multi-host: set KARPENTER_DIST_COORDINATOR (host:port of process 0) plus
+KARPENTER_DIST_NUM_PROCESSES / KARPENTER_DIST_PROCESS_ID and the factory
+calls jax.distributed.initialize before device detection — jax.devices()
+then spans every host's chips and the Mesh covers the full slice, with
+XLA routing the dp/tp collectives over ICI within a host and DCN across
+hosts (the reference's NCCL/MPI multi-node analog). On TPU pods the
+three variables can be omitted entirely (jax autodetects from the TPU
+environment when KARPENTER_DIST_COORDINATOR=auto).
 """
 from __future__ import annotations
 
 import os
 from typing import Optional
+
+_dist_initialized = False
+
+
+def ensure_distributed() -> bool:
+    """Initialize jax.distributed from KARPENTER_DIST_* when configured.
+    Idempotent; returns True when multi-host mode is active. Must run
+    before the first jax.devices() call in the process."""
+    global _dist_initialized
+    coordinator = os.environ.get("KARPENTER_DIST_COORDINATOR", "")
+    if not coordinator or _dist_initialized:
+        return _dist_initialized
+    import jax
+
+    if coordinator == "auto":
+        jax.distributed.initialize()  # TPU-pod autodetection
+    else:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=int(os.environ["KARPENTER_DIST_NUM_PROCESSES"]),
+            process_id=int(os.environ["KARPENTER_DIST_PROCESS_ID"]),
+        )
+    _dist_initialized = True
+    return True
 
 
 def detect_mesh(devices=None, tp: Optional[int] = None):
@@ -34,6 +67,7 @@ def detect_mesh(devices=None, tp: Optional[int] = None):
     from jax.sharding import Mesh
 
     if devices is None:
+        ensure_distributed()  # multi-host: devices() spans the whole slice
         devices = jax.devices()
     n = len(devices)
     if n < 2:

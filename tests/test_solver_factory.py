@@ -110,6 +110,17 @@ def test_build_solver_mode_invalid():
         build_solver(mode="bogus")
 
 
+def test_ensure_distributed_noop_without_coordinator(monkeypatch):
+    """Without KARPENTER_DIST_COORDINATOR the factory stays single-host
+    (and never calls jax.distributed.initialize, which would hang waiting
+    for peers)."""
+    from karpenter_core_tpu.solver import factory
+
+    monkeypatch.delenv("KARPENTER_DIST_COORDINATOR", raising=False)
+    assert factory.ensure_distributed() is False
+    assert factory.detect_mesh() is not None  # detection still works
+
+
 def test_operator_run_boots_sharded_solver():
     """The operator entrypoint's in-process primary comes from the factory:
     on a multi-device box the production stack serves the sharded path
