@@ -228,6 +228,15 @@ class ResilientSolver:
         # consolidation sizes its ladder screen off the solver's budget
         return getattr(self.primary, "max_nodes", 1024)
 
+    def encode(self, *args, **kwargs):
+        """Pipelined-surface passthrough: embedders overlap the next
+        batch's encode with the current solve (solve(encoded=snap)); the
+        primary owns the snapshot format. Only valid while the primary is
+        serving — a fallback-routed solve ignores the snapshot (the host
+        FFD re-reads objects), which stays correct because encode() output
+        is advisory for the device path only."""
+        return self.primary.encode(*args, **kwargs)
+
     def _primary_solve(self, *args, **kwargs):
         if self.solve_timeout is None:
             return self.primary.solve(*args, **kwargs)
@@ -267,7 +276,7 @@ class ResilientSolver:
         )
 
     def solve(self, pods, provisioners, instance_types, daemonset_pods=None,
-              state_nodes=None, kube_client=None, cluster=None):
+              state_nodes=None, kube_client=None, cluster=None, encoded=None):
         # tiny batches: the serial FFD beats the device path's fixed
         # encode/transfer cost — route without blocking on primary health,
         # while _maybe_bg_probe keeps the verdict fresh on the normal TTLs
@@ -288,9 +297,11 @@ class ResilientSolver:
                 state_nodes, kube_client, cluster,
             )
         try:
+            kwargs = {"encoded": encoded} if encoded is not None else {}
             return self._primary_solve(
                 pods, provisioners, instance_types, daemonset_pods,
                 state_nodes, kube_client=kube_client, cluster=cluster,
+                **kwargs,
             )
         except Exception as e:  # noqa: BLE001 — degrade, never stall
             self._mark_dead(f"{type(e).__name__}: {e}")

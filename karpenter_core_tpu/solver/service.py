@@ -354,6 +354,16 @@ class RemoteSolver:
         # the TPU backend, which can take tens of seconds cold
         return self._health(pb.HealthRequest(), timeout=timeout)
 
+    def encode(self, pods, provisioners, instance_types, daemonset_pods=None,
+               state_nodes=None, kube_client=None, cluster=None):
+        """Pre-encode off the Solve critical path (pipelined surface,
+        same contract as TPUSolver.encode)."""
+        return encode_snapshot(
+            pods, provisioners, instance_types, daemonset_pods, state_nodes,
+            kube_client=kube_client, cluster=cluster, max_nodes=self.max_nodes,
+            reuse=self._encode_reuse,
+        )
+
     def solve(
         self,
         pods,
@@ -363,13 +373,22 @@ class RemoteSolver:
         state_nodes=None,
         kube_client=None,
         cluster=None,
+        encoded=None,
     ) -> SolveResult:
         from karpenter_core_tpu.solver.tpu_solver import solve_with_relaxation
 
+        if encoded is not None and (
+            len(encoded.pods) != len(pods)
+            or {id(p) for p in encoded.pods} != {id(p) for p in pods}
+        ):
+            raise ValueError(
+                "encoded snapshot was built from a different pod batch"
+            )
+        relax_ctx = {"encoded": encoded}
         return solve_with_relaxation(
             lambda p: self._solve_once(
                 p, provisioners, instance_types, daemonset_pods, state_nodes,
-                kube_client, cluster,
+                kube_client, cluster, relax_ctx,
             ),
             pods,
             provisioners,
@@ -378,12 +397,15 @@ class RemoteSolver:
         )
 
     def _solve_once(self, pods, provisioners, instance_types, daemonset_pods,
-                    state_nodes, kube_client, cluster) -> SolveResult:
-        snap = encode_snapshot(
-            pods, provisioners, instance_types, daemonset_pods, state_nodes,
-            kube_client=kube_client, cluster=cluster, max_nodes=self.max_nodes,
-            reuse=self._encode_reuse,
-        )
+                    state_nodes, kube_client, cluster,
+                    relax_ctx=None) -> SolveResult:
+        snap = relax_ctx.pop("encoded", None) if relax_ctx else None
+        if snap is None:
+            snap = encode_snapshot(
+                pods, provisioners, instance_types, daemonset_pods, state_nodes,
+                kube_client=kube_client, cluster=cluster,
+                max_nodes=self.max_nodes, reuse=self._encode_reuse,
+            )
         args = device_args(snap, provisioners)
         request = pb.SolveRequest(
             geometry=geometry_json(snap),

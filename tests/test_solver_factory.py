@@ -167,6 +167,26 @@ def test_sharded_encoded_mismatch_raises():
         solver.solve(other, provisioners, its, encoded=snap)
 
 
+def test_resilient_pipelined_surface_passthrough():
+    """The production wrapper exposes the encode()/solve(encoded=) overlap
+    protocol of its primary, so a driving loop can pipeline through the
+    full ResilientSolver assembly."""
+    from karpenter_core_tpu.solver.fallback import ResilientSolver
+    from karpenter_core_tpu.solver.tpu_solver import GreedySolver, TPUSolver
+
+    solver = ResilientSolver(
+        TPUSolver(max_nodes=32), GreedySolver(),
+        prober=lambda: None, small_batch_work_max=1,
+    )
+    pods = [make_pod(requests={"cpu": "1"}) for _ in range(16)]
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(4)}
+    snap = solver.encode(pods, provisioners, its)
+    res = solver.solve(pods, provisioners, its, encoded=snap)
+    assert not res.failed_pods
+    assert solver._healthy is True  # served by the primary, not fallback
+
+
 def test_resilient_over_sharded_assembly():
     """ResilientSolver(primary=ShardedSolver) — the exact production wiring —
     routes a non-small batch through the sharded primary."""
