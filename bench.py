@@ -986,6 +986,12 @@ def main():
                     "pipelined_p99_ms": round(pipe_p99 * 1e3, 1),
                     "pipelined_runs": len(pipe_times),
                     "north_star_target_ms": 1000.0,
+                    # the charter is about Solve(), not the kernel slice
+                    # (r4 verdict weak #1): judge against the e2e numbers
+                    "single_call_under_target": bool(p99 * 1e3 < 1000.0),
+                    "pipelined_under_target": bool(
+                        pipe_times and pipe_p99 * 1e3 < 1000.0
+                    ),
                     "device_under_target": bool(dev_p99 < 1000.0),
                     "runs": N_RUNS,
                     "tail": tail_attrib,
