@@ -75,8 +75,18 @@ def plan_shards(snap, ndp: int) -> Tuple[np.ndarray, np.ndarray]:
     return plan_shards_arrays(counts, E, E_pad, ndp, touch, snap.topo_meta)
 
 
+# below this many replicas per dp shard the split costs more packing
+# quality than it buys in parallelism (per-shard leftovers + components
+# that can't share nodes across shards dominate): route the WHOLE batch to
+# shard 0 with single-device semantics. Production small batches route to
+# the host FFD before reaching here (ResilientSolver); this guards direct
+# ShardedSolver use.
+MIN_SPLIT_REPLICAS_PER_SHARD = 32
+
+
 def plan_shards_arrays(counts, E_real: int, E_pad: int, ndp: int,
-                       touch=None, topo_meta=None) -> Tuple[np.ndarray, np.ndarray]:
+                       touch=None, topo_meta=None,
+                       ) -> Tuple[np.ndarray, np.ndarray]:
     """Array-level core of plan_shards: counts [I] replica counts per item,
     touch [G, I] bool (item owns/selects into group g) or None. Shared by
     the snapshot path (plan_shards) and the gRPC service, which rebuilds
@@ -84,12 +94,36 @@ def plan_shards_arrays(counts, E_real: int, E_pad: int, ndp: int,
     counts = np.asarray(counts).astype(np.int64)
     I = len(counts)
     exist_owner = np.zeros((ndp, E_pad), dtype=bool)
+
+    total = int(counts.sum())
+    # single-shard threshold: the per-dp work floor, with an absolute cap
+    # so a huge mesh (dp=64) never serializes thousands of replicas onto
+    # one chip. A single-shard batch that exhausts shard 0's slot budget
+    # retries with a TRANSIENT doubling (ShardedSolver._solve_once keeps
+    # growth non-sticky when the plan didn't split), so no permanent
+    # geometry cliff hides here.
+    threshold = min(ndp * MIN_SPLIT_REPLICAS_PER_SHARD, 256)
+    if total < threshold:
+        # too small to split: shard 0 owns every replica AND every existing
+        # node, making the result exactly the single-device packing
+        count_split = np.zeros((ndp, I), dtype=np.int32)
+        count_split[0] = counts
+        exist_owner[0, :E_real] = True
+        return count_split, exist_owner
+
     for e in range(E_real):
         exist_owner[e % ndp, e] = True
 
+    # even base split; remainders ROUND-ROBIN by item index. Sending every
+    # remainder to the low shards (pre-round-5) piled ALL the replicas of a
+    # batch of one-replica items onto shard 0 — a 100-pod no-topology batch
+    # ran entirely serial (the water-fill rebalance below only runs when
+    # topology groups exist).
     count_split = np.tile(counts // ndp, (ndp, 1)).astype(np.int32)
-    for d in range(ndp):
-        count_split[d] += (counts % ndp > d)
+    rem = (counts % ndp).astype(np.int64)
+    d_idx = np.arange(ndp, dtype=np.int64)[:, None]
+    i_idx = np.arange(I, dtype=np.int64)[None, :]
+    count_split += (((d_idx - i_idx) % ndp) < rem[None, :]).astype(np.int32)
 
     if touch is not None and topo_meta is not None and len(topo_meta.groups) > 0:
         from karpenter_core_tpu.ops import topology as topo_mod
@@ -644,12 +678,13 @@ class ShardedSolver:
         from karpenter_core_tpu.solver.encode import encode_snapshot
 
         snap = relax_ctx.pop("encoded", None) if relax_ctx else None
+        per_shard = self.max_nodes_per_shard
         while True:
             if snap is None:
                 snap = encode_snapshot(
                     pods, provisioners, instance_types, daemonset_pods,
                     state_nodes, kube_client=kube_client, cluster=cluster,
-                    max_nodes=self.max_nodes_per_shard,
+                    max_nodes=per_shard,
                     reuse=self._encode_reuse,
                 )
             mesh = self.mesh
@@ -659,7 +694,7 @@ class ShardedSolver:
                 mesh = _dp_only_mesh(mesh)
             fn, args, (count_split, _exist_owner) = make_sharded_solve(
                 snap, provisioners, mesh,
-                max_nodes_per_shard=self.max_nodes_per_shard,
+                max_nodes_per_shard=per_shard,
                 program_cache=self._compiled,
             )
             while len(self._compiled) > self.MAX_COMPILED:
@@ -675,15 +710,20 @@ class ShardedSolver:
             # split can concentrate more machines on one shard than the
             # per-shard budget admits even when the global budget fits
             # (scheduler.go has one global node list; shards have disjoint
-            # budgets). Grow and retry; the budget sticks for future solves.
+            # budgets). Grow and retry. The growth PERSISTS only when the
+            # plan actually split: a small-batch single-shard solve that
+            # overflowed must not permanently double every future solve's
+            # slot geometry (the compiled program for the transient size
+            # stays cached, so repeats pay one extra dispatch, not a
+            # recompile).
             exhausted = bool(
                 np.any(np.asarray(state.nopen).reshape(-1) >= snap.n_slots)
             )
-            if not exhausted or (
-                self.max_nodes_per_shard * 2 > self.MAX_NODES_PER_SHARD_CAP
-            ):
+            if not exhausted or per_shard * 2 > self.MAX_NODES_PER_SHARD_CAP:
                 return result
-            self.max_nodes_per_shard *= 2
+            per_shard *= 2
+            if int((count_split.sum(axis=1) > 0).sum()) > 1:
+                self.max_nodes_per_shard = per_shard
             snap = None  # re-encode at the grown slot budget
 
 

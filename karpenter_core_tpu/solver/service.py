@@ -430,17 +430,26 @@ class RemoteSolver:
             if result.failed_pods:
                 # per-shard slot exhaustion (see ShardedSolver._solve_once):
                 # double the budget — which sizes snap.n_slots per shard on
-                # the sharded service — and re-request once per doubling
+                # the sharded service — and re-request once per doubling.
+                # Growth persists only when the plan split; a single-shard
+                # small batch must not permanently double the geometry.
                 from karpenter_core_tpu.parallel.sharded import ShardedSolver
 
                 cap = ShardedSolver.MAX_NODES_PER_SHARD_CAP
                 nopen = np.asarray(tensors["state/nopen"]).reshape(-1)
                 if np.any(nopen >= snap.n_slots) and self.max_nodes * 2 <= cap:
-                    self.max_nodes *= 2
-                    return self._solve_once(
-                        pods, provisioners, instance_types, daemonset_pods,
-                        state_nodes, kube_client, cluster,
-                    )
+                    cs = np.asarray(tensors["count_split"])
+                    sticky = int((cs.sum(axis=1) > 0).sum()) > 1
+                    old = self.max_nodes
+                    self.max_nodes = old * 2
+                    try:
+                        return self._solve_once(
+                            pods, provisioners, instance_types,
+                            daemonset_pods, state_nodes, kube_client, cluster,
+                        )
+                    finally:
+                        if not sticky:
+                            self.max_nodes = old
             return result
         ptr = int(np.asarray(tensors["ptr"]).reshape(-1)[0])
         return decode_solve(snap, (log, ptr), state)

@@ -223,3 +223,41 @@ def test_fuzz_host_vs_device(seed):
     # onto existing nodes; curated tests (test_device_semantics,
     # test_tpu_solver) hold the strict <= bar on non-adversarial mixes.
     assert len(tpu.new_machines) <= len(host.new_machines) + 1
+
+
+@pytest.mark.parametrize("seed", [11, 23, 47])
+def test_fuzz_single_vs_sharded(seed):
+    """Round 5: the SAME random workloads through the production multi-chip
+    path (ShardedSolver over the 8-device mesh) vs the single-device
+    solver. Bar: no pod the single-device solve schedules may fail sharded,
+    all invariants hold on the merged result, and packing stays within the
+    per-shard-leftover bound (one partially-filled node per dp shard)."""
+    import jax
+    from jax.sharding import Mesh
+
+    from karpenter_core_tpu.parallel.sharded import ShardedSolver
+
+    rng = np.random.default_rng(seed)
+    universe = fake.instance_types(8)
+    pods, provisioners, its, nodes = _workload(rng, universe)
+    single = TPUSolver(max_nodes=96).solve(
+        pods, provisioners, its,
+        state_nodes=[n.deep_copy() for n in nodes],
+    )
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(4, 2), ("dp", "tp"))
+    sharded = ShardedSolver(mesh, max_nodes_per_shard=32).solve(
+        pods, provisioners, its,
+        state_nodes=[n.deep_copy() for n in nodes],
+    )
+    _check_invariants(sharded, pods)
+    assert len(sharded.failed_pods) <= len(single.failed_pods), (
+        f"sharded failed {len(sharded.failed_pods)} vs single "
+        f"{len(single.failed_pods)}"
+    )
+    # these 72-pod batches ride the small-batch single-shard routing
+    # (plan_shards_arrays MIN_SPLIT_REPLICAS_PER_SHARD), so the packing is
+    # the single-device algorithm modulo the per-shard slot budget
+    assert len(sharded.new_machines) <= len(single.new_machines) + 1, (
+        f"sharded opened {len(sharded.new_machines)} nodes vs "
+        f"single-device {len(single.new_machines)}"
+    )

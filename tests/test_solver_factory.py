@@ -330,12 +330,13 @@ def test_service_sharded_parity_with_tpu_solver(sharded_server):
 def test_service_sharded_slot_growth_retry(sharded_server):
     """When a shard exhausts the per-shard slot budget, the CLIENT detects
     it from the returned nopen and re-requests with a doubled budget (the
-    remote analog of ShardedSolver's self-healing sizing)."""
+    remote analog of ShardedSolver's self-healing sizing). This 24-replica
+    batch rides the single-shard small-batch routing, so the growth is
+    TRANSIENT: the solve succeeds at the doubled size but the configured
+    budget is restored (a permanently doubled geometry would tax every
+    future solve)."""
     port, _ = sharded_server
     client = RemoteSolver(f"127.0.0.1:{port}", max_nodes=2)
-    # 40 one-cpu pods on 8-cpu nodes need ~5+ machines; with dp=4 x 2
-    # slots the first attempt exhausts at 8 machines worst-case split —
-    # force it harder with anti-affinity one-per-node services
     anti = PodAffinityTerm(
         topology_key=LABEL_HOSTNAME,
         label_selector=LabelSelector(match_labels={"app": "grow"}),
@@ -350,7 +351,7 @@ def test_service_sharded_slot_growth_retry(sharded_server):
     )
     assert not res.failed_pods
     assert len(res.new_machines) == 24  # one per node (anti)
-    assert client.max_nodes > 2  # the budget grew
+    assert client.max_nodes == 2  # single-shard growth did not stick
 
 
 def test_service_sharded_hostname_anti(sharded_server):
