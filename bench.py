@@ -1216,6 +1216,16 @@ def orchestrate():
     no wedge can stop the final JSON line from being printed."""
     probe_log = []
     deadline = time.monotonic() + TOTAL_BUDGET
+    # one compile-cache dir for ALL worker attempts this orchestration: a
+    # retry/rescue worker after a mid-run wedge reloads the first attempt's
+    # compiled programs from disk instead of re-paying the ~2-minute cold
+    # compile out of its (already shrunk) budget
+    if not os.environ.get("BENCH_COMPILE_CACHE_DIR"):
+        import tempfile
+
+        os.environ["BENCH_COMPILE_CACHE_DIR"] = tempfile.mkdtemp(
+            prefix="kct-xla-cache-"
+        )
 
     def _left() -> int:
         return max(0, int(deadline - time.monotonic()))
