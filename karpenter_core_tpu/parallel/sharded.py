@@ -735,12 +735,3 @@ def _dp_only_mesh(mesh):
     return Mesh(devices, ("dp", "tp"))
 
 
-def pad_pods(pods: List, multiple: int) -> List:
-    """Pad the pod list to a multiple with filler pods marked invalid at
-    encode time (they request an impossible amount, so they never schedule).
-    Replica-count splitting makes dp padding unnecessary; kept for callers
-    that want uniform batch sizes across solves."""
-    from karpenter_core_tpu.testing import make_pod
-
-    short = (-len(pods)) % multiple
-    return pods + [make_pod(requests={"cpu": "1e18"}) for _ in range(short)]

@@ -517,8 +517,9 @@ class TPUSolver:
     """Stateless dense solver; jit-compiled per label geometry.
 
     max_nodes bounds the slot budget for NEW machines (existing nodes get
-    their own slots on top). pad_pods rounds the pod axis up to a bucket so
-    repeated solves reuse the compiled program.
+    their own slots on top). Geometry bucketing (solve_geometry/device_args)
+    pads every batch axis to power-of-two buckets internally, so repeated
+    solves at varying sizes reuse the compiled program.
     """
 
     # consolidation's prefix ladder screens all rungs in one vmapped
