@@ -291,6 +291,11 @@ class ApiServerKubeClient:
         """Persist finalizer removal so the apiserver completes deletion."""
         self.update(obj)
 
+    # page size for chunked LISTs — a 50k-pod cluster's apiserver will not
+    # return one 50k-item response; every page after the first rides the
+    # server's `continue` token (client-go's default chunk size is 500)
+    LIST_LIMIT = 500
+
     def list(self, kind: str, namespace: str = None, selector=None,
              field_filter=None, copy_objects: bool = True) -> List[object]:
         # copy_objects is part of the client surface; decoded REST objects
@@ -300,9 +305,17 @@ class ApiServerKubeClient:
             path = f"{prefix}/namespaces/{namespace}/{plural}"
         else:
             path = f"{prefix}/{plural}"
-        status, body = self.transport("GET", path)
-        self._raise_for(status, body, kind, "")
-        items = [self._decode(kind, raw) for raw in json.loads(body).get("items", [])]
+        items: List[object] = []
+        params = {"limit": str(self.LIST_LIMIT)}
+        while True:
+            status, body = self.transport("GET", path, params=params)
+            self._raise_for(status, body, kind, "")
+            page = json.loads(body)
+            items.extend(self._decode(kind, raw) for raw in page.get("items", []))
+            token = (page.get("metadata") or {}).get("continue")
+            if not token:
+                break
+            params = {"limit": str(self.LIST_LIMIT), "continue": token}
         if selector is not None:
             items = [o for o in items if selector.matches(o.metadata.labels)]
         if field_filter is not None:
