@@ -309,6 +309,17 @@ class ApiServerKubeClient:
         params = {"limit": str(self.LIST_LIMIT)}
         while True:
             status, body = self.transport("GET", path, params=params)
+            if status == 410 and "continue" in params:
+                # the snapshot behind the continue token expired (etcd
+                # compaction mid-pagination on a large cluster): fall back
+                # to ONE unpaginated full list, like client-go's ListPager
+                status, body = self.transport("GET", path)
+                self._raise_for(status, body, kind, "")
+                items = [
+                    self._decode(kind, raw)
+                    for raw in json.loads(body).get("items", [])
+                ]
+                break
             self._raise_for(status, body, kind, "")
             page = json.loads(body)
             items.extend(self._decode(kind, raw) for raw in page.get("items", []))

@@ -400,3 +400,25 @@ def test_adapter_list_follows_continue_tokens(client):
     pods = c.list("Pod")
     assert len(pods) == 12
     assert {p.metadata.name for p in pods} == {f"page-{i:02d}" for i in range(12)}
+
+
+def test_adapter_list_410_mid_pagination_falls_back_to_full_list(client):
+    """An expired continue token (etcd compaction mid-pagination) answers
+    410 Gone; the adapter retries as ONE unpaginated full list instead of
+    erroring out with a partial result (client-go ListPager behavior)."""
+    server, c = client
+    for i in range(8):
+        c.create(make_pod(name=f"gone-{i}"))
+    c.LIST_LIMIT = 3
+    real_call = server.__call__
+
+    def expiring(method, path, body=None, params=None, stream=False,
+                 timeout=30.0):
+        if method == "GET" and params and params.get("continue"):
+            return 410, json.dumps({"reason": "Expired"})
+        return real_call(method, path, body=body, params=params,
+                         stream=stream, timeout=timeout)
+
+    c.transport = expiring
+    pods = c.list("Pod")
+    assert len(pods) == 8  # full fallback, not the 3-item first page
