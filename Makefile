@@ -17,6 +17,10 @@ bench:  ## north-star benchmark on the attached backend (one JSON line)
 	python bench.py
 
 verify:  ## driver hooks: single-chip compile check + 8-way mesh dryrun
-	python -c "import jax, __graft_entry__ as g; fn, a = g.entry(); \
+	# force the CPU backend in-process: this image's sitecustomize pins the
+	# axon TPU tunnel (env vars can't override it), and a wedged tunnel
+	# would hang the compile check forever — verify must be hermetic
+	python -c "import jax; jax.config.update('jax_platforms', 'cpu'); \
+	import __graft_entry__ as g; fn, a = g.entry(); \
 	jax.block_until_ready(jax.jit(fn)(*a)); print('entry ok')"
 	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
