@@ -2,7 +2,7 @@
 # (test/deflake/verify, reference Makefile:9-33). Tests force the CPU
 # backend with 8 virtual devices via tests/conftest.py.
 
-.PHONY: test deflake perf bench verify
+.PHONY: test deflake perf bench verify trace-demo
 
 test:  ## full suite (CPU, 8 virtual devices)
 	python -m pytest tests -q
@@ -16,6 +16,9 @@ perf:  ## enforced >=100 pods/sec floor (reference test_performance tag)
 bench:  ## north-star benchmark on the attached backend (one JSON line)
 	python bench.py
 
+trace-demo:  ## small traced solve -> /tmp/karpenter_trace.json (validated)
+	python hack/trace_demo.py
+
 verify:  ## driver hooks: single-chip compile check + 8-way mesh dryrun
 	# force the CPU backend in-process: this image's sitecustomize pins the
 	# axon TPU tunnel (env vars can't override it), and a wedged tunnel
@@ -24,3 +27,5 @@ verify:  ## driver hooks: single-chip compile check + 8-way mesh dryrun
 	import __graft_entry__ as g; fn, a = g.entry(); \
 	jax.block_until_ready(jax.jit(fn)(*a)); print('entry ok')"
 	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+	# non-fatal smoke: a traced solve must export valid Perfetto JSON
+	-$(MAKE) trace-demo

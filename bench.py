@@ -589,6 +589,7 @@ def main():
     import jax
 
     from karpenter_core_tpu.cloudprovider import fake
+    from karpenter_core_tpu.obs import TRACER
     from karpenter_core_tpu.solver.encode import encode_snapshot
     from karpenter_core_tpu.solver.factory import build_solver, describe
     from karpenter_core_tpu.solver.tpu_solver import (
@@ -596,6 +597,11 @@ def main():
         build_device_solve,
         device_args,
     )
+
+    # solve-path tracing ON: the phase breakdown below reads from the SAME
+    # tracer spans production exports (ISSUE 1 — bench and production
+    # report identical numbers instead of bench-private timers)
+    TRACER.enable()
 
     # persistent compile cache: cold compiles below write to disk; the
     # warm-restart stage at the end re-solves from a FRESH process against
@@ -687,12 +693,23 @@ def main():
         import gc
 
         gc.collect()
+        seq = TRACER.mark()
         t0 = time.perf_counter()
         res = solver.solve(pods, provisioners, its, state_nodes=nodes)
         dt = time.perf_counter() - t0
         times.append(dt)
         device_times.append(getattr(solver, "last_device_ms", 0.0))
-        phases = dict(getattr(solver, "last_phase_ms", {}) or {})
+        # phase breakdown from the TRACER's solver.phase.* spans — the same
+        # spans production exports to /debug/trace. Keys match the
+        # historical artifact (args/pack/upload/device/fetch/other_host);
+        # the tracer's extra encode/bind spans fold into other_host, and
+        # last_only reproduces the old timers' last-relax-round-wins
+        # semantics, so BENCH_r* comparisons stay apples-to-apples.
+        tr_phases = TRACER.phase_ms_since(seq, last_only=True)
+        phases = {
+            k: tr_phases.get(k, 0.0)
+            for k in ("args", "pack", "upload", "device", "fetch")
+        }
         # everything solve() spent outside the instrumented kernel phases:
         # encode + decode + relaxation bookkeeping (host python/numpy)
         phases["other_host"] = round(dt * 1e3 - sum(phases.values()), 1)

@@ -1,0 +1,81 @@
+"""Trace-demo smoke: run a small solve with tracing on, export the Chrome
+trace-event JSON, and validate it (`make trace-demo`; wired into `make
+verify` as a non-fatal step).
+
+Checks the ISSUE-1 contract end to end in-process:
+  * the trace round-trips through json.loads,
+  * it contains >0 solver-phase events (solver.phase.*),
+  * every event is a complete ('X') event carrying a dur,
+  * the reconcile that triggered the solve is present.
+
+Hermetic: forces the CPU backend in-process (the image's sitecustomize pins
+the axon TPU tunnel; env vars can't override it — same treatment as `make
+verify`'s compile check).
+"""
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+OUT = os.environ.get("KCT_TRACE_DEMO_OUT", "/tmp/karpenter_trace.json")
+# 48 keeps the verify smoke fast on CPU; KCT_TRACE_DEMO_PODS=5000 captures
+# the acceptance-scale trace (docs/observability.md walkthrough)
+N_PODS = int(os.environ.get("KCT_TRACE_DEMO_PODS", "48"))
+
+
+def main() -> int:
+    from karpenter_core_tpu.cloudprovider import fake
+    from karpenter_core_tpu.obs import TRACER
+    from karpenter_core_tpu.operator import new_operator
+    from karpenter_core_tpu.solver.tpu_solver import TPUSolver
+    from karpenter_core_tpu.testing import make_pod, make_provisioner
+
+    TRACER.enable()
+    cp = fake.FakeCloudProvider(fake.instance_types(8))
+    op = new_operator(cp, solver=TPUSolver(max_nodes=max(64, N_PODS // 4)))
+    op.kube_client.create(make_provisioner(name="default"))
+    for i in range(N_PODS):
+        op.kube_client.create(
+            make_pod(labels={"app": f"demo-{i % 6}"}, requests={"cpu": "1"})
+        )
+    op.sync_state()
+    op.provisioning.trigger()
+    created = op.provisioning.reconcile(wait_timeout=None)
+
+    TRACER.export_chrome_trace(OUT)
+    with open(OUT) as f:
+        trace = json.load(f)  # round-trip validation
+
+    events = trace["traceEvents"]
+    phase_events = [e for e in events if e["name"].startswith("solver.phase.")]
+    problems = []
+    if created <= 0:
+        problems.append(f"demo solve launched no machines (created={created})")
+    if not phase_events:
+        problems.append("no solver.phase.* events in the trace")
+    bad = [e for e in events if e.get("ph") != "X" or "dur" not in e]
+    if bad:
+        problems.append(f"{len(bad)} events are not complete ('X') events with dur")
+    if not any(e["name"] == "provisioner.reconcile" for e in events):
+        problems.append("missing provisioner.reconcile span")
+
+    print(TRACER.summary(), file=sys.stderr)
+    if problems:
+        for p in problems:
+            print(f"trace-demo FAIL: {p}", file=sys.stderr)
+        return 1
+    phases = sorted({e["name"].split(".")[-1] for e in phase_events})
+    print(
+        f"trace-demo ok: {OUT} ({len(events)} events, machines={created}, "
+        f"phases={','.join(phases)})"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -300,6 +300,20 @@ def simulate_scheduling(
     """Re-enter the solver in simulation mode over (pending + evicted) pods
     with the candidates removed from the snapshot. Returns (new machines,
     all_pods_scheduled)."""
+    from karpenter_core_tpu.obs import TRACER
+
+    with TRACER.span("deprovisioning.simulate", candidates=len(candidates)):
+        return _simulate_scheduling_traced(
+            kube_client, cluster, provisioning, candidates
+        )
+
+
+def _simulate_scheduling_traced(
+    kube_client,
+    cluster,
+    provisioning,
+    candidates: List[CandidateNode],
+) -> Tuple[List[SolvedMachine], bool]:
     candidate_names = {c.name for c in candidates}
     state_nodes = []
     deleting_nodes = []

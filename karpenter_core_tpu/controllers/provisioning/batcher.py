@@ -7,6 +7,7 @@ import threading
 import time
 
 from karpenter_core_tpu.api.settings import Settings, current
+from karpenter_core_tpu.obs import TRACER
 
 
 class Batcher:
@@ -14,8 +15,13 @@ class Batcher:
         self.settings = settings
         self.clock = clock
         self._trigger = threading.Event()
+        self._mu = threading.Lock()
+        self._triggers = 0  # total triggers ever (locked: concurrent pods)
+        self._consumed = 0  # triggers attributed to already-closed windows
 
     def trigger(self) -> None:
+        with self._mu:
+            self._triggers += 1
         self._trigger.set()
 
     def wait(self, timeout: float = None, poll: float = 0.01) -> bool:
@@ -24,14 +30,30 @@ class Batcher:
         settings = self.settings or current()
         if not self._trigger.wait(timeout=timeout):
             return False
+        # the span covers the WINDOW (first trigger -> close), not the idle
+        # wait above it: the window is the batching latency a pod pays
+        # before its solve starts
+        start_ns = time.perf_counter_ns()
         start = self.clock()
         last = self.clock()
         self._trigger.clear()
         while True:
             now = self.clock()
-            if now - start >= settings.batch_max_duration:
-                return True
-            if now - last >= settings.batch_idle_duration:
+            closed = (
+                "max" if now - start >= settings.batch_max_duration
+                else "idle" if now - last >= settings.batch_idle_duration
+                else None
+            )
+            if closed:
+                # everything not yet attributed to a prior window — including
+                # triggers that accumulated while wait() was blocked
+                with self._mu:
+                    folded = self._triggers - self._consumed
+                    self._consumed = self._triggers
+                TRACER.add_span(
+                    "batcher.window", start_ns, time.perf_counter_ns(),
+                    closed_by=closed, triggers=folded,
+                )
                 return True
             if self._trigger.wait(timeout=poll):
                 self._trigger.clear()

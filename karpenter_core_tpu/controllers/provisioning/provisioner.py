@@ -21,6 +21,7 @@ from karpenter_core_tpu.controllers.provisioning.batcher import Batcher
 from karpenter_core_tpu.controllers.provisioning.volumetopology import VolumeTopology
 from karpenter_core_tpu.kube.objects import Node, NodeStatus, Pod
 from karpenter_core_tpu.metrics.registry import NODES_CREATED
+from karpenter_core_tpu.obs import TRACER
 from karpenter_core_tpu.solver.tpu_solver import GreedySolver, SolvedMachine, SolveResult
 from karpenter_core_tpu.utils import podutils
 
@@ -64,12 +65,26 @@ class ProvisioningController:
         if wait_timeout is not None:
             if not self.batcher.wait(timeout=wait_timeout):
                 return 0
+        # the reconcile ROOT span: schedule (solver.solve nests under it)
+        # and launch both land in the same trace, so one Perfetto timeline
+        # shows batch -> solve phases -> machine launches end to end
+        with TRACER.span("provisioner.reconcile") as sp:
+            created = self._reconcile_traced(sp)
+        return created
+
+    def _reconcile_traced(self, sp) -> int:
         result = self.schedule()
         if result is None:
             return 0
-        names = self.launch_machines(
-            result.new_machines, LaunchOptions(record_pod_nomination=True)
+        sp.set(
+            machines=len(result.new_machines),
+            existing=len(result.existing_assignments),
+            failed=len(result.failed_pods),
         )
+        with TRACER.span("provisioner.launch", machines=len(result.new_machines)):
+            names = self.launch_machines(
+                result.new_machines, LaunchOptions(record_pod_nomination=True)
+            )
         created = sum(1 for n in names if n)
         if created:
             NODES_CREATED.inc({"reason": "provisioning"}, created)

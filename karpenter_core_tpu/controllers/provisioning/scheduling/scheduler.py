@@ -24,6 +24,7 @@ from karpenter_core_tpu.controllers.provisioning.scheduling.preferences import P
 from karpenter_core_tpu.controllers.provisioning.scheduling.queue import Queue
 from karpenter_core_tpu.controllers.provisioning.scheduling.topology import Topology
 from karpenter_core_tpu.kube.objects import Pod, ResourceList
+from karpenter_core_tpu.obs import TRACER
 from karpenter_core_tpu.scheduling import taints as taints_mod
 from karpenter_core_tpu.scheduling.requirements import Requirements
 from karpenter_core_tpu.utils import resources as resources_util
@@ -93,6 +94,15 @@ class Scheduler:
         """The hot loop (scheduler.go:96-133): pop pod → try existing nodes →
         try open machines (ascending pod count) → open machine from the first
         compatible weighted template; on failure relax and re-push."""
+        with TRACER.span("scheduler.solve", pods=len(pods)) as sp:
+            result = self._solve_traced(pods)
+            sp.set(
+                machines=len(result.new_machines),
+                failed=len(result.failed_pods),
+            )
+            return result
+
+    def _solve_traced(self, pods: List[Pod]) -> SchedulingResult:
         errors: Dict[str, str] = {}
         q = Queue(pods)
         while True:

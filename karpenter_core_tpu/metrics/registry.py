@@ -4,7 +4,10 @@ The reference exposes prometheus metrics (pkg/metrics/metrics.go:13-38 and
 per-controller instruments). This registry mirrors that surface — namespaced
 metric names, label sets, duration buckets — with an in-memory store and a
 text exposition dump, so the operator runtime can serve/inspect the same
-signals without a prometheus client dependency.
+signals without a prometheus client dependency. expose() emits the real
+Prometheus text format (HELP/TYPE lines, cumulative histogram buckets with
+the +Inf series, escaped label values) so promtool and a real scraper can
+parse the endpoint.
 """
 from __future__ import annotations
 
@@ -28,6 +31,37 @@ def _labels(labels: Optional[Dict[str, str]]) -> LabelValues:
     return tuple(sorted((labels or {}).items()))
 
 
+def _escape_label(value: str) -> str:
+    """Label-value escaping per the exposition format: backslash, quote,
+    newline."""
+    return (
+        str(value)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
+
+
+def _escape_help(text: str) -> str:
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _fmt_labels(lv: LabelValues, extra: str = "") -> str:
+    parts = [f'{k}="{_escape_label(v)}"' for k, v in lv]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+def _fmt_value(value: float) -> str:
+    """Full-precision sample rendering (the python client's convention):
+    %g's 6 significant digits would corrupt large counters/sums under
+    rate()/increase() on a real scraper."""
+    if value == int(value) and abs(value) < 1e17:
+        return str(int(value))
+    return repr(float(value))
+
+
 class Counter:
     def __init__(self, name: str, help: str = ""):
         self.name = name
@@ -40,7 +74,8 @@ class Counter:
             self.values[_labels(labels)] += value
 
     def get(self, labels: Optional[Dict[str, str]] = None) -> float:
-        return self.values.get(_labels(labels), 0.0)
+        with self._mu:
+            return self.values.get(_labels(labels), 0.0)
 
 
 class Gauge:
@@ -55,7 +90,8 @@ class Gauge:
             self.values[_labels(labels)] = value
 
     def get(self, labels: Optional[Dict[str, str]] = None) -> Optional[float]:
-        return self.values.get(_labels(labels))
+        with self._mu:
+            return self.values.get(_labels(labels))
 
     def delete(self, labels: Optional[Dict[str, str]] = None) -> None:
         with self._mu:
@@ -72,6 +108,7 @@ class Histogram:
         self.help = help
         self.buckets = sorted(buckets)
         self._mu = threading.Lock()
+        # bucket_counts are CUMULATIVE (le semantics), matching exposition
         self.bucket_counts: Dict[LabelValues, List[int]] = {}
         self.sums: Dict[LabelValues, float] = defaultdict(float)
         self.counts: Dict[LabelValues, int] = defaultdict(int)
@@ -87,15 +124,18 @@ class Histogram:
             self.counts[lv] += 1
 
     def percentile(self, q: float, labels: Optional[Dict[str, str]] = None) -> Optional[float]:
+        """Upper bucket bound at quantile q; values above the largest
+        finite bucket saturate to it (histogram_quantile's convention)."""
         lv = _labels(labels)
-        counts = self.bucket_counts.get(lv)
-        if not counts or self.counts[lv] == 0:
-            return None
-        target = q * self.counts[lv]
-        for bucket, c in zip(self.buckets, counts):
-            if c >= target:
-                return bucket
-        return self.buckets[-1]
+        with self._mu:
+            counts = self.bucket_counts.get(lv)
+            if not counts or self.counts[lv] == 0:
+                return None
+            target = q * self.counts[lv]
+            for bucket, c in zip(self.buckets, counts):
+                if c >= target:
+                    return bucket
+            return self.buckets[-1]
 
 
 class Registry:
@@ -104,35 +144,64 @@ class Registry:
         self.metrics: Dict[str, object] = {}
 
     def counter(self, name: str, help: str = "") -> Counter:
-        return self._get_or_create(name, lambda: Counter(name, help))
+        return self._get_or_create(name, Counter, lambda: Counter(name, help))
 
     def gauge(self, name: str, help: str = "") -> Gauge:
-        return self._get_or_create(name, lambda: Gauge(name, help))
+        return self._get_or_create(name, Gauge, lambda: Gauge(name, help))
 
     def histogram(self, name: str, help: str = "", buckets=DURATION_BUCKETS) -> Histogram:
-        return self._get_or_create(name, lambda: Histogram(name, help, buckets))
+        return self._get_or_create(
+            name, Histogram, lambda: Histogram(name, help, buckets)
+        )
 
-    def _get_or_create(self, name: str, factory):
+    def _get_or_create(self, name: str, cls, factory):
         with self._mu:
-            if name not in self.metrics:
-                self.metrics[name] = factory()
-            return self.metrics[name]
+            existing = self.metrics.get(name)
+            if existing is None:
+                existing = self.metrics[name] = factory()
+            elif not isinstance(existing, cls):
+                raise TypeError(
+                    f"metric {name!r} is already registered as "
+                    f"{type(existing).__name__}, not {cls.__name__}"
+                )
+            return existing
 
     def expose(self) -> str:
-        """Prometheus-style text exposition."""
-        lines = []
+        """Prometheus text exposition (format version 0.0.4)."""
+        lines: List[str] = []
         with self._mu:
             metrics = dict(self.metrics)
         for name, metric in sorted(metrics.items()):
+            if metric.help:
+                lines.append(f"# HELP {name} {_escape_help(metric.help)}")
             if isinstance(metric, (Counter, Gauge)):
-                for lv, value in sorted(metric.values.items()):
-                    label_str = ",".join(f'{k}="{v}"' for k, v in lv)
-                    lines.append(f"{name}{{{label_str}}} {value:g}")
+                kind = "counter" if isinstance(metric, Counter) else "gauge"
+                lines.append(f"# TYPE {name} {kind}")
+                with metric._mu:
+                    values = dict(metric.values)
+                for lv, value in sorted(values.items()):
+                    lines.append(f"{name}{_fmt_labels(lv)} {_fmt_value(value)}")
             elif isinstance(metric, Histogram):
-                for lv, count in sorted(metric.counts.items()):
-                    label_str = ",".join(f'{k}="{v}"' for k, v in lv)
-                    lines.append(f"{name}_count{{{label_str}}} {count}")
-                    lines.append(f"{name}_sum{{{label_str}}} {metric.sums[lv]:g}")
+                lines.append(f"# TYPE {name} histogram")
+                with metric._mu:
+                    series = {
+                        lv: (
+                            list(metric.bucket_counts.get(lv, [])),
+                            metric.sums[lv],
+                            count,
+                        )
+                        for lv, count in metric.counts.items()
+                    }
+                for lv, (buckets, total_sum, count) in sorted(series.items()):
+                    for bound, c in zip(metric.buckets, buckets):
+                        le = _fmt_labels(lv, f'le="{bound:g}"')
+                        lines.append(f"{name}_bucket{le} {c}")
+                    inf = _fmt_labels(lv, 'le="+Inf"')
+                    lines.append(f"{name}_bucket{inf} {count}")
+                    lines.append(
+                        f"{name}_sum{_fmt_labels(lv)} {_fmt_value(total_sum)}"
+                    )
+                    lines.append(f"{name}_count{_fmt_labels(lv)} {count}")
         return "\n".join(lines)
 
 

@@ -1,0 +1,23 @@
+"""Observability: end-to-end solve-path tracing.
+
+The production hot path (provisioner reconcile -> batcher window ->
+Scheduler.Solve() -> TPUSolver phases -> gRPC solver service -> bind) is
+instrumented with the process-wide TRACER from obs.tracer. Import the
+singleton from here:
+
+    from karpenter_core_tpu.obs import TRACER, device_profiler
+"""
+from karpenter_core_tpu.obs.tracer import (
+    TRACER,
+    TRACE_HEADER,
+    Span,
+    Tracer,
+    device_profiler,
+    enable_tracing_from_env,
+    profile_dir,
+)
+
+__all__ = [
+    "TRACER", "TRACE_HEADER", "Span", "Tracer", "device_profiler",
+    "enable_tracing_from_env", "profile_dir",
+]

@@ -1,0 +1,408 @@
+"""Solve-path tracing: nested spans, a bounded ring buffer, Chrome
+trace-event export (loadable in Perfetto / chrome://tracing), and a metrics
+bridge into the in-process registry.
+
+Design constraints (the reasons this is not an OpenTelemetry dependency):
+
+  * the disabled path must be near-zero — Tracer.span()/add_span() on a
+    disabled tracer is ONE attribute check returning a shared no-op object,
+    no allocation — so the instrumentation lives permanently on the
+    production hot path (provisioner reconcile -> batcher window ->
+    Scheduler.Solve -> TPUSolver phases -> gRPC service -> bind);
+  * spans must be recordable retroactively (add_span with explicit
+    timestamps) because the solver's phase boundaries are sequential marks
+    inside one function, not lexically nested blocks;
+  * everything is process-local and thread-safe: solver phases run on the
+    reconcile thread, machine launches fan out over a pool, and the gRPC
+    server handles calls on its own executor.
+
+The analog in the JAX ecosystem is jax.profiler's Perfetto workflow for
+DEVICE time; this tracer covers the host-side pipeline around it and the
+two compose (device_profiler below wraps device solves in jax.profiler when
+KARPENTER_TPU_PROFILE points at a directory).
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+from contextlib import nullcontext
+from typing import Dict, List, Optional
+
+from karpenter_core_tpu.metrics.registry import NAMESPACE, REGISTRY
+
+# -- instruments fed by the span bridge (names chartered in ISSUE 1) --------
+
+SOLVER_PHASE_DURATION = REGISTRY.histogram(
+    f"{NAMESPACE}_solver_phase_duration_seconds",
+    "Duration of each TPU solver phase (encode/args/pack/upload/device/"
+    "fetch/bind), fed by solver.phase.* spans",
+)
+SOLVER_SOLVE_DURATION = REGISTRY.histogram(
+    f"{NAMESPACE}_solver_solve_duration_seconds",
+    "End-to-end Solve() duration including relaxation rounds",
+)
+SOLVER_BATCH_SIZE = REGISTRY.gauge(
+    f"{NAMESPACE}_solver_batch_size",
+    "Pod count of the most recent Solve() batch",
+)
+
+_PHASE_PREFIX = "solver.phase."
+
+# gRPC metadata key carrying the trace id across the solver-service
+# boundary (client stub attaches, server handler adopts)
+TRACE_HEADER = "x-karpenter-trace-id"
+
+
+def _bridge(span: "Span") -> None:
+    """Span completion -> metrics registry. Called with the tracer enabled
+    only; controller reconcile histograms are observed at their own sites
+    (operator/controller.py) so they are never double-counted here."""
+    name = span.name
+    if name.startswith(_PHASE_PREFIX):
+        SOLVER_PHASE_DURATION.observe(
+            span.duration_s, {"phase": name[len(_PHASE_PREFIX):]}
+        )
+    elif name == "solver.solve":
+        # deprovisioning simulations re-enter the same solver: keep their
+        # solves out of the provisioning-latency series (context label /
+        # batch-size gauge) or consolidation-heavy clusters would report
+        # simulation numbers as provisioning SLO data
+        ctx = str(span.attrs.get("context", "provisioning"))
+        SOLVER_SOLVE_DURATION.observe(span.duration_s, {"context": ctx})
+        pods = span.attrs.get("pods")
+        if pods is not None and ctx == "provisioning":
+            SOLVER_BATCH_SIZE.set(float(pods))
+
+
+# ---------------------------------------------------------------------------
+
+
+class Span:
+    """One finished (or live) span. Timestamps are perf_counter_ns."""
+
+    __slots__ = (
+        "name", "trace_id", "span_id", "parent_id", "start_ns", "end_ns",
+        "attrs", "tid", "_tracer",
+    )
+
+    def __init__(self, tracer: "Tracer", name: str, trace_id: str,
+                 span_id: int, parent_id: Optional[int],
+                 attrs: Dict[str, object]):
+        self._tracer = tracer
+        self.name = name
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.attrs = attrs
+        self.tid = threading.get_ident()
+        self.start_ns = 0
+        self.end_ns = 0
+
+    @property
+    def duration_s(self) -> float:
+        return (self.end_ns - self.start_ns) / 1e9
+
+    @property
+    def duration_ms(self) -> float:
+        return (self.end_ns - self.start_ns) / 1e6
+
+    def set(self, **attrs) -> "Span":
+        """Attach attributes to a live span (e.g. rounds known at exit)."""
+        self.attrs.update(attrs)
+        return self
+
+    def __enter__(self) -> "Span":
+        self._tracer._push(self)
+        self.start_ns = time.perf_counter_ns()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        self.end_ns = time.perf_counter_ns()
+        if exc_type is not None:
+            self.attrs["error"] = exc_type.__name__
+        self._tracer._pop(self)
+        return False
+
+
+class _NoopSpan:
+    """Shared do-nothing span for the disabled path: span() returns THIS
+    object without allocating, so a disabled tracer costs one flag check."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return False
+
+    def set(self, **attrs):
+        return self
+
+
+NOOP_SPAN = _NoopSpan()
+
+
+class Tracer:
+    """Thread-safe tracer with a bounded ring-buffer span store.
+
+    Spans nest via a thread-local stack: a span opened while another is
+    live on the same thread becomes its child and inherits its trace id.
+    Roots mint a fresh trace id unless one is passed explicitly (the gRPC
+    server passes the client's propagated id). Finished spans land in a
+    deque(maxlen=capacity); `dropped` counts ring-buffer evictions so
+    truncation is always visible in exports.
+    """
+
+    def __init__(self, capacity: int = 65536):
+        self.enabled = False
+        self.capacity = capacity
+        self._mu = threading.Lock()
+        self._spans: deque = deque(maxlen=capacity)
+        self._finished = 0  # total spans ever recorded (monotonic)
+        self._ids = itertools.count(1)
+        self._trace_ids = itertools.count(1)
+        self._tls = threading.local()
+        self._t0_ns = time.perf_counter_ns()
+        self._pid = os.getpid()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def enable(self) -> "Tracer":
+        self.enabled = True
+        return self
+
+    def disable(self) -> "Tracer":
+        self.enabled = False
+        return self
+
+    def clear(self) -> None:
+        with self._mu:
+            self._spans.clear()
+            self._finished = 0
+
+    # -- recording ---------------------------------------------------------
+
+    def span(self, name: str, trace_id: Optional[str] = None, **attrs):
+        """Context manager for a live span. Disabled -> shared no-op."""
+        if not self.enabled:
+            return NOOP_SPAN
+        return self._make(name, trace_id, attrs)
+
+    def add_span(self, name: str, start_ns: int, end_ns: int,
+                 trace_id: Optional[str] = None, **attrs) -> None:
+        """Record an already-finished region (phase marks inside one
+        function body); parented to the calling thread's current span."""
+        if not self.enabled:
+            return
+        span = self._make(name, trace_id, attrs)
+        span.start_ns = start_ns
+        span.end_ns = end_ns
+        self._record(span)
+
+    def _make(self, name, trace_id, attrs) -> Span:
+        parent = self._current()
+        if trace_id is None:
+            trace_id = (
+                parent.trace_id if parent is not None
+                else f"t{next(self._trace_ids):08x}"
+            )
+        return Span(
+            self, name, trace_id, next(self._ids),
+            parent.span_id if parent is not None else None, attrs,
+        )
+
+    # -- nesting (thread-local stack) --------------------------------------
+
+    def _stack(self) -> list:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    def _current(self) -> Optional[Span]:
+        stack = getattr(self._tls, "stack", None)
+        return stack[-1] if stack else None
+
+    def current_trace_id(self) -> Optional[str]:
+        """Trace id of the calling thread's active span (propagation)."""
+        cur = self._current()
+        return cur.trace_id if cur is not None else None
+
+    def current_span_name(self) -> Optional[str]:
+        """Name of the calling thread's active span (e.g. to tell a
+        provisioning solve from a deprovisioning-simulation solve)."""
+        cur = self._current()
+        return cur.name if cur is not None else None
+
+    def _push(self, span: Span) -> None:
+        self._stack().append(span)
+
+    def _pop(self, span: Span) -> None:
+        stack = self._stack()
+        if stack and stack[-1] is span:
+            stack.pop()
+        elif span in stack:  # mispaired exit: drop it and everything above
+            del stack[stack.index(span):]
+        self._record(span)
+
+    def _record(self, span: Span) -> None:
+        with self._mu:
+            self._spans.append(span)
+            self._finished += 1
+        try:
+            _bridge(span)
+        except Exception:  # noqa: BLE001 — metrics must never break a solve
+            pass
+
+    # -- reading -----------------------------------------------------------
+
+    @property
+    def dropped(self) -> int:
+        """Spans evicted from the ring buffer (truncation accounting)."""
+        with self._mu:
+            return self._finished - len(self._spans)
+
+    def mark(self) -> int:
+        """Sequence checkpoint; pass to spans_since()/phase_ms_since()."""
+        with self._mu:
+            return self._finished
+
+    def spans(self) -> List[Span]:
+        with self._mu:
+            return list(self._spans)
+
+    def spans_since(self, seq: int) -> List[Span]:
+        """Spans recorded after mark() returned `seq` (ring-aware: spans
+        evicted since the mark are simply gone from the result)."""
+        with self._mu:
+            newer = self._finished - seq
+            if newer <= 0:
+                return []
+            return list(self._spans)[-min(newer, len(self._spans)):]
+
+    def phase_ms_since(self, seq: int, prefix: str = _PHASE_PREFIX,
+                       last_only: bool = False) -> Dict[str, float]:
+        """Per-phase milliseconds for solver.phase.* spans recorded after
+        `seq` — the bench's phase-breakdown source. Default sums every
+        occurrence (all relaxation rounds); last_only=True keeps only the
+        final occurrence per phase, matching the historical
+        last-round-overwrite timers so old bench artifacts stay comparable."""
+        out: Dict[str, float] = {}
+        for span in self.spans_since(seq):
+            if span.name.startswith(prefix):
+                key = span.name[len(prefix):]
+                prev = 0.0 if last_only else out.get(key, 0.0)
+                out[key] = round(prev + span.duration_ms, 1)
+        return out
+
+    # -- export ------------------------------------------------------------
+
+    def chrome_trace(self) -> Dict[str, object]:
+        """Chrome trace-event JSON (dict): complete ('X') events with
+        microsecond ts/dur, loadable in Perfetto and chrome://tracing."""
+        events = []
+        for span in self.spans():
+            args = {"trace_id": span.trace_id, "span_id": span.span_id}
+            if span.parent_id is not None:
+                args["parent_id"] = span.parent_id
+            for k, v in span.attrs.items():
+                args[k] = v if isinstance(v, (int, float, bool)) else str(v)
+            events.append(
+                {
+                    "name": span.name,
+                    "cat": "karpenter",
+                    "ph": "X",
+                    "ts": (span.start_ns - self._t0_ns) / 1e3,
+                    "dur": max(span.end_ns - span.start_ns, 0) / 1e3,
+                    "pid": self._pid,
+                    "tid": span.tid % 2**31,  # chrome wants a small int
+                    "args": args,
+                }
+            )
+        return {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "otherData": {"dropped_spans": self.dropped},
+        }
+
+    def export_chrome_trace(self, path: str) -> str:
+        with open(path, "w") as f:
+            json.dump(self.chrome_trace(), f)
+        return path
+
+    def summary(self) -> str:
+        """Compact per-span-name text summary (count / total / mean / max)."""
+        agg: Dict[str, List[float]] = {}
+        for span in self.spans():
+            agg.setdefault(span.name, []).append(span.duration_ms)
+        lines = [
+            f"{'span':<40} {'count':>6} {'total_ms':>10} {'mean_ms':>9} {'max_ms':>9}"
+        ]
+        for name in sorted(agg):
+            ds = agg[name]
+            lines.append(
+                f"{name:<40} {len(ds):>6} {sum(ds):>10.1f} "
+                f"{sum(ds) / len(ds):>9.1f} {max(ds):>9.1f}"
+            )
+        if self.dropped:
+            lines.append(f"(dropped {self.dropped} spans: ring buffer full)")
+        return "\n".join(lines)
+
+
+# the process-wide tracer
+TRACER = Tracer()
+
+_TRUTHY = ("1", "true", "on", "yes")
+_FALSY = ("0", "false", "off", "no")
+
+
+def enable_tracing_from_env(default_on: bool = False) -> bool:
+    """Arm/disarm TRACER from KARPENTER_TPU_TRACE — the ONE parser of that
+    variable, shared by the import-time hook (default off) and the
+    operator / solver-service entrypoints (default on), so truthy
+    spellings like 'true'/'on' behave identically everywhere. Returns the
+    resulting enabled state."""
+    raw = os.environ.get("KARPENTER_TPU_TRACE", "").strip().lower()
+    if raw in _FALSY:
+        TRACER.disable()
+    elif default_on or raw in _TRUTHY:
+        TRACER.enable()
+    return TRACER.enabled
+
+
+# KARPENTER_TPU_TRACE set truthy arms tracing at import, so any entrypoint
+# (bench, tests, one-off scripts) opts in uniformly
+enable_tracing_from_env(default_on=False)
+
+
+def profile_dir() -> str:
+    """The device-profiling output directory, "" when profiling is off.
+    The ONE place the KARPENTER_TPU_PROFILE / KARPENTER_JAX_TRACE_DIR
+    (pre-ISSUE-1 spelling) env vars are interpreted — callers that need to
+    know whether profiling is active (e.g. to barrier the dispatch) must
+    use this instead of re-reading the env."""
+    return (
+        os.environ.get("KARPENTER_TPU_PROFILE", "")
+        or os.environ.get("KARPENTER_JAX_TRACE_DIR", "")
+    )
+
+
+def device_profiler():
+    """Context manager wrapping a device solve in jax.profiler when
+    profile_dir() names a directory; no-op otherwise or when the profiler
+    is unavailable. The captured trace is the device-side complement of
+    this module's host spans (view with tensorboard/xprof)."""
+    trace_dir = profile_dir()
+    if trace_dir:
+        try:
+            import jax
+
+            return jax.profiler.trace(trace_dir)
+        except Exception:  # noqa: BLE001 — profiling is opt-in, never fatal
+            pass
+    return nullcontext()

@@ -146,6 +146,18 @@ class _HealthHandler(BaseHTTPRequestHandler):
         if self.path == "/metrics":
             body = REGISTRY.expose().encode() + b"\n"
             ctype = "text/plain; version=0.0.4"
+        elif self.path == "/debug/trace" and self.profiling_enabled:
+            # Chrome trace-event JSON of the solve-path ring buffer: save
+            # and load in Perfetto (ui.perfetto.dev) or chrome://tracing
+            from karpenter_core_tpu.obs import TRACER
+
+            body = json.dumps(TRACER.chrome_trace()).encode()
+            ctype = "application/json"
+        elif self.path == "/debug/trace/summary" and self.profiling_enabled:
+            from karpenter_core_tpu.obs import TRACER
+
+            body = TRACER.summary().encode() + b"\n"
+            ctype = "text/plain"
         elif self.path in ("/healthz", "/readyz"):
             body = json.dumps({"status": "ok"}).encode()
             ctype = "application/json"
@@ -211,6 +223,14 @@ def run(cloud_provider, kube_client=None, stop_event=None, options=None):
     opts = options or parse_options([])
     configure_logging()
     opts.apply_memory_limit()
+    # solve-path tracing is ON in the production control plane (the whole
+    # point of ISSUE 1: perf work starts from data, not guesses); its
+    # enabled-path cost is a handful of span objects per reconcile.
+    # KARPENTER_TPU_TRACE=0/false/off opts out for perf-pathological
+    # deployments.
+    from karpenter_core_tpu.obs import enable_tracing_from_env
+
+    enable_tracing_from_env(default_on=True)
     # restart-survivable compiled programs: a rebooted control plane must
     # not blank provisioning for the cold-compile window (utils/compilecache)
     from karpenter_core_tpu.utils.compilecache import enable_persistent_cache

@@ -17,6 +17,7 @@ import time
 from typing import Callable, Optional
 
 from karpenter_core_tpu.metrics.registry import REGISTRY
+from karpenter_core_tpu.obs import TRACER
 from karpenter_core_tpu.operator.injection import with_controller_name
 
 LOG = logging.getLogger("karpenter.controller")
@@ -60,7 +61,13 @@ class Singleton:
         """One instrumented reconcile; returns the wait before the next."""
         start = time.perf_counter()
         try:
-            with with_controller_name(self.name):
+            # spans nest: a provisioning reconcile's solve phases land under
+            # this root in the exported trace. RECONCILE_DURATION is observed
+            # in the finally below (always on), so the tracer's metrics
+            # bridge deliberately skips controller.reconcile spans.
+            with with_controller_name(self.name), TRACER.span(
+                "controller.reconcile", controller=self.name
+            ):
                 requeue_after = self.reconcile()
         except Exception:
             RECONCILE_ERRORS.inc(labels={"controller": self.name})

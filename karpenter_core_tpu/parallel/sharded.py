@@ -413,8 +413,20 @@ def make_sharded_run(segments, zone_seg, ct_seg, topo_meta, n_slots, mesh,
         P(),  # scheduled count (replicated)
     )
 
-    sharded = jax.shard_map(body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                            check_vma=False)
+    # version compat: jax >= 0.6 exposes jax.shard_map (check_vma);
+    # 0.4.x only has jax.experimental.shard_map (check_rep)
+    if hasattr(jax, "shard_map"):
+        sharded = jax.shard_map(
+            body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    else:
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        sharded = _shard_map(
+            body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=False,
+        )
     fn = jax.jit(sharded)
     return fn
 

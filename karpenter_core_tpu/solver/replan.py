@@ -47,6 +47,25 @@ def batched_ladder_screen(
 
     Raises CandidateNodeDeletingError under the same conditions as
     simulate_scheduling (a candidate is already mid-delete)."""
+    from karpenter_core_tpu.obs import TRACER
+
+    with TRACER.span(
+        "deprovisioning.ladder_screen",
+        candidates=len(candidates), rungs=len(sizes),
+    ):
+        return _ladder_screen_traced(
+            kube_client, cluster, provisioning, candidates, sizes, max_nodes
+        )
+
+
+def _ladder_screen_traced(
+    kube_client,
+    cluster,
+    provisioning,
+    candidates,
+    sizes: List[int],
+    max_nodes: int,
+) -> List[RungScreen]:
     import jax
 
     from karpenter_core_tpu.controllers.deprovisioning.core import (
@@ -175,6 +194,9 @@ def batched_ladder_screen(
     backend = getattr(provisioning.solver, "backend", None)
     key = (geom, Rn, backend)
     fn = cache.get(key)
+    from karpenter_core_tpu.utils.compilecache import record_lookup
+
+    record_lookup("replan", fn is not None)
     if fn is None:
         rung_run = make_device_run(
             segments_t, zone_seg, ct_seg, snap.topo_meta, N, log_len=log_len,

@@ -22,6 +22,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from karpenter_core_tpu.obs import TRACE_HEADER, TRACER
 from karpenter_core_tpu.solver import service_pb2 as pb
 from karpenter_core_tpu.solver.encode import encode_snapshot
 from karpenter_core_tpu.solver.tpu_solver import (
@@ -151,9 +152,28 @@ class SolverService:
         self.solves = 0
 
     def solve(self, request: pb.SolveRequest, context=None) -> pb.SolveResponse:
+        # adopt the client's propagated trace id (metadata interceptor
+        # analog): the server-side span joins the control plane's trace so
+        # one Perfetto timeline covers both processes
+        trace_id = None
+        if context is not None:
+            try:
+                for k, v in context.invocation_metadata() or ():
+                    if k == TRACE_HEADER:
+                        trace_id = v
+            except Exception:  # noqa: BLE001 — tracing must never fail a solve
+                pass
+        with TRACER.span(
+            "solver.service.solve", trace_id=trace_id,
+            tensors=len(request.tensors),
+        ):
+            return self._solve_traced(request)
+
+    def _solve_traced(self, request: pb.SolveRequest) -> pb.SolveResponse:
         import jax
 
         from karpenter_core_tpu.ops.topology import TopoGroupMeta, TopoMeta
+        from karpenter_core_tpu.utils.compilecache import record_lookup
 
         try:
             geometry = json.loads(request.geometry)
@@ -193,6 +213,7 @@ class SolverService:
                     fn = self._compiled.get(key)
                     if fn is not None:
                         self._compiled.move_to_end(key)
+                record_lookup("service", fn is not None)
                 if fn is None:
                     fn = jax.jit(
                         make_device_run(
@@ -253,11 +274,14 @@ class SolverService:
         count_split, exist_owner = plan_shards_arrays(
             counts, E_real, E_pad, ndp, touch, topo_meta
         )
+        from karpenter_core_tpu.utils.compilecache import record_lookup
+
         key = (geometry_key, ndp, ntp)
         with self._mu:
             fn = self._compiled.get(key)
             if fn is not None:
                 self._compiled.move_to_end(key)
+        record_lookup("service_sharded", fn is not None)
         if fn is None:
             fn = make_sharded_run(
                 segments, zone_seg, ct_seg, topo_meta, geometry["n_slots"],
@@ -268,8 +292,10 @@ class SolverService:
                 self._compiled[key] = fn
                 while len(self._compiled) > self.MAX_COMPILED:
                     self._compiled.popitem(last=False)
+        from karpenter_core_tpu.obs import device_profiler
+
         sh_args = shard_args(args, count_split, exist_owner)
-        with mesh:
+        with mesh, device_profiler():
             log, ptr, state, _scheduled = fn(*sh_args)
             jax.block_until_ready(log)
         return log, ptr, state, count_split
@@ -401,17 +427,26 @@ class RemoteSolver:
                     relax_ctx=None) -> SolveResult:
         snap = relax_ctx.pop("encoded", None) if relax_ctx else None
         if snap is None:
-            snap = encode_snapshot(
-                pods, provisioners, instance_types, daemonset_pods, state_nodes,
-                kube_client=kube_client, cluster=cluster,
-                max_nodes=self.max_nodes, reuse=self._encode_reuse,
+            with TRACER.span("solver.phase.encode", pods=len(pods)):
+                snap = encode_snapshot(
+                    pods, provisioners, instance_types, daemonset_pods, state_nodes,
+                    kube_client=kube_client, cluster=cluster,
+                    max_nodes=self.max_nodes, reuse=self._encode_reuse,
+                )
+        with TRACER.span("solver.phase.args"):
+            args = device_args(snap, provisioners)
+            request = pb.SolveRequest(
+                geometry=geometry_json(snap),
+                tensors=[tensor_to_pb(n, a) for n, a in _flatten_args(args)],
             )
-        args = device_args(snap, provisioners)
-        request = pb.SolveRequest(
-            geometry=geometry_json(snap),
-            tensors=[tensor_to_pb(n, a) for n, a in _flatten_args(args)],
-        )
-        response = self._solve(request, timeout=self.timeout)
+        # the RPC carries the current trace id over metadata so the server
+        # handler's span lands in the same trace (stub-interceptor analog)
+        with TRACER.span("solver.service.request") as sp:
+            trace_id = getattr(sp, "trace_id", None) or TRACER.current_trace_id()
+            metadata = ((TRACE_HEADER, trace_id),) if trace_id else None
+            response = self._solve(
+                request, timeout=self.timeout, metadata=metadata
+            )
         if response.error:
             raise RuntimeError(f"solver service error: {response.error}")
         tensors = {t.name: tensor_from_pb(t) for t in response.tensors}
@@ -424,9 +459,10 @@ class RemoteSolver:
             # + the shard plan come back; merge with the sharded decoder
             from karpenter_core_tpu.parallel.sharded import decode_sharded
 
-            result = decode_sharded(
-                snap, log, tensors["ptr"], state, tensors["count_split"]
-            )
+            with TRACER.span("solver.phase.bind"):
+                result = decode_sharded(
+                    snap, log, tensors["ptr"], state, tensors["count_split"]
+                )
             if result.failed_pods:
                 # per-shard slot exhaustion (see ShardedSolver._solve_once):
                 # double the budget — which sizes snap.n_slots per shard on
@@ -452,7 +488,8 @@ class RemoteSolver:
                             self.max_nodes = old
             return result
         ptr = int(np.asarray(tensors["ptr"]).reshape(-1)[0])
-        return decode_solve(snap, (log, ptr), state)
+        with TRACER.span("solver.phase.bind"):
+            return decode_solve(snap, (log, ptr), state)
 
 
 class _StateView:
@@ -485,9 +522,16 @@ def main(argv: Optional[List[str]] = None) -> None:
     from karpenter_core_tpu.utils.compilecache import enable_persistent_cache
 
     enable_persistent_cache()
+    import os
+
+    # server-side solve tracing, on by default like the operator's
+    # (KARPENTER_TPU_TRACE=0/false/off opts out); spans adopt the client's
+    # propagated trace id so both processes share one timeline
+    from karpenter_core_tpu.obs import enable_tracing_from_env
+
+    enable_tracing_from_env(default_on=True)
     # multi-chip containers (v5e-4) serve every Solve through the sharded
     # program; KARPENTER_SOLVER_MODE=single pins the one-chip path
-    import os
 
     from karpenter_core_tpu.solver.factory import detect_mesh
 

@@ -28,6 +28,7 @@ from karpenter_core_tpu.cloudprovider.types import InstanceType
 from karpenter_core_tpu.controllers.provisioning.scheduling.machine import MachineTemplate
 from karpenter_core_tpu.controllers.provisioning.scheduling.preferences import Preferences
 from karpenter_core_tpu.kube.objects import Pod, ResourceList
+from karpenter_core_tpu.obs import TRACER, device_profiler, profile_dir
 from karpenter_core_tpu.scheduling.requirements import Requirements
 from karpenter_core_tpu.solver.encode import EncodedSnapshot, ReqSetArrays, encode_snapshot
 from karpenter_core_tpu.utils import resources as resources_util
@@ -151,10 +152,21 @@ def solve_with_relaxation(solve_once, pods, provisioners, instance_types,
         return SolveResult(failed_pods=list(pods))
     from karpenter_core_tpu.utils.gctuning import gc_paused
 
-    with gc_paused():
-        return _solve_with_relaxation_inner(
+    # the solve-path ROOT span: every phase span below (encode/args/pack/
+    # upload/device/fetch/bind) nests under it, and its completion feeds
+    # the solve-duration histogram + batch-size gauge (obs/tracer bridge).
+    # context tells a provisioning solve from a deprovisioning-simulation
+    # re-entry (parented under a deprovisioning.* span) so simulation
+    # batches never pollute the provisioning-latency metric series.
+    parent = TRACER.current_span_name() or ""
+    context = "simulation" if parent.startswith("deprovisioning.") else "provisioning"
+    with TRACER.span("solver.solve", pods=len(pods), context=context) as sp, \
+            gc_paused():
+        result = _solve_with_relaxation_inner(
             solve_once, pods, provisioners, max_relax_rounds
         )
+        sp.set(rounds=result.rounds, failed=len(result.failed_pods))
+        return result
 
 
 def _solve_with_relaxation_inner(solve_once, pods, provisioners,
@@ -621,16 +633,19 @@ class TPUSolver:
                     state_nodes, kube_client=None, cluster=None, relax_ctx=None):
         snap = relax_ctx.pop("encoded", None) if relax_ctx else None
         if snap is None:
-            snap = encode_snapshot(
-                pods, provisioners, instance_types, daemonset_pods, state_nodes,
-                kube_client=kube_client, cluster=cluster, max_nodes=self.max_nodes,
-                reuse_dictionary=relax_ctx.get("dictionary") if relax_ctx else None,
-                reuse=self._encode_reuse,
-            )
+            with TRACER.span("solver.phase.encode", pods=len(pods)):
+                snap = encode_snapshot(
+                    pods, provisioners, instance_types, daemonset_pods, state_nodes,
+                    kube_client=kube_client, cluster=cluster, max_nodes=self.max_nodes,
+                    reuse_dictionary=relax_ctx.get("dictionary") if relax_ctx else None,
+                    reuse=self._encode_reuse,
+                )
         if relax_ctx is not None:
             relax_ctx["dictionary"] = snap.dictionary
         log, ptr, state = self._run_kernels(snap, provisioners)
-        return decode_solve(snap, (log, ptr), state)
+        # "bind": decode slot assignments back into machines / placements
+        with TRACER.span("solver.phase.bind"):
+            return decode_solve(snap, (log, ptr), state)
 
     def _run_kernels(self, snap: EncodedSnapshot, provisioners: List[Provisioner]):
         import time as _time
@@ -639,12 +654,15 @@ class TPUSolver:
         import jax.numpy as jnp
 
         phases = self.last_phase_ms = {}
-        t_phase = _time.perf_counter()
+        t_phase = _time.perf_counter_ns()
 
-        def _mark(name):
+        def _mark(name, **attrs):
+            # retroactive span per phase boundary: the kernel pipeline is
+            # sequential marks, not nested blocks (obs.Tracer.add_span)
             nonlocal t_phase
-            now = _time.perf_counter()
-            phases[name] = round((now - t_phase) * 1e3, 1)
+            now = _time.perf_counter_ns()
+            phases[name] = round((now - t_phase) / 1e6, 1)
+            TRACER.add_span(f"solver.phase.{name}", t_phase, now, **attrs)
             t_phase = now
 
         geom, run = build_device_solve(snap, self.max_nodes, backend=self.backend)
@@ -706,8 +724,15 @@ class TPUSolver:
         bundle = np.concatenate(chunks) if chunks else np.zeros(0, np.uint8)
         donated_leaves = [packed[i] for i in sorted(donate_set)]
         _mark("pack")
+        from karpenter_core_tpu.utils.compilecache import (
+            record_compile_seconds,
+            record_lookup,
+        )
+
         key = (geom, self.backend, spec, treedef, tuple(layout))
         fn = self._compiled.get(key)
+        cache_hit = fn is not None
+        record_lookup("tpu_solver", cache_hit)
         if fn is not None:
             self._compiled.move_to_end(key)
         if fn is None:
@@ -746,12 +771,6 @@ class TPUSolver:
             while len(self._compiled) > self.MAX_COMPILED:
                 old_key, _ = self._compiled.popitem(last=False)
                 self._fetch_buckets.pop(old_key, None)
-        # opt-in device profiling around the Solve dispatch — the analog of
-        # the reference's pprof-profiled benchmark capture
-        # (scheduling_benchmark_test.go:84-95); view with tensorboard or
-        # xprof. One trace per solve while the env var is set.
-        import os
-
         # one transfer for the bundle + one per donated plane
         args = jax.device_put((bundle, *donated_leaves))
         if self.profile_phases:
@@ -762,13 +781,16 @@ class TPUSolver:
         _mark("upload")
 
         t_dispatch = _time.perf_counter()
-        trace_dir = os.environ.get("KARPENTER_JAX_TRACE_DIR", "")
-        if trace_dir:
-            with jax.profiler.trace(trace_dir):
-                log, ptr, state = fn(*args)
-                jax.block_until_ready(state)
-        else:
+        # opt-in device profiling around the Solve dispatch (obs.device_
+        # profiler, KARPENTER_TPU_PROFILE) — the analog of the reference's
+        # pprof-profiled benchmark capture (scheduling_benchmark_test.go:
+        # 84-95); view with tensorboard or xprof. One trace per solve
+        # while the env var is set. The barrier keeps the execution inside
+        # the captured window.
+        with device_profiler():
             log, ptr, state = fn(*args)
+            if profile_dir():
+                jax.block_until_ready(state)
 
         # fetch ONLY what decode reads: log entries [:ptr], bulk rows
         # [:bulk_n], and state slot rows [:nopen] (the slot budget is mostly
@@ -873,7 +895,12 @@ class TPUSolver:
         # (observability; on the fused path this includes the eager-slice
         # transfer, which the single-RT design makes inseparable)
         self.last_device_ms = (_time.perf_counter() - t_dispatch) * 1e3
-        _mark("device")
+        _mark("device", compile_cache="hit" if cache_hit else "miss")
+        if not cache_hit:
+            # a miss's first dispatch pays jit trace + XLA compile (or the
+            # persistent disk-cache load): attribute it to the compile
+            # histogram so restart stalls are visible in /metrics
+            record_compile_seconds(phases["device"] / 1e3)
         ptr_i, nopen, bulk_n, nnz = int(ptr_i), int(nopen), int(bulk_n), int(nnz)
         need_bk = _buckets(ptr_i, nopen, bulk_n, nnz)
         # keep the speculation MONOTONE (max with the previous buckets):

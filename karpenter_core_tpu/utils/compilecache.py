@@ -18,6 +18,37 @@ import os
 import tempfile
 from typing import Optional
 
+from karpenter_core_tpu.metrics.registry import NAMESPACE, REGISTRY
+
+# compiled-program cache observability: every in-process executable-cache
+# lookup (TPUSolver._compiled, SolverService._compiled) records a hit or a
+# miss, and a miss's first dispatch — which pays jit trace + XLA compile
+# (or a persistent-cache disk load) — records its seconds. These are the
+# counters ISSUE 1 charters; the solve-path tracer attaches the same
+# hit/miss as a span attribute.
+CACHE_HITS = REGISTRY.counter(
+    f"{NAMESPACE}_compile_cache_hits",
+    "Compiled-executable cache hits, by cache site",
+)
+CACHE_MISSES = REGISTRY.counter(
+    f"{NAMESPACE}_compile_cache_misses",
+    "Compiled-executable cache misses (jit trace + compile paid), by cache site",
+)
+COMPILE_SECONDS = REGISTRY.histogram(
+    f"{NAMESPACE}_compile_cache_compile_seconds",
+    "Seconds spent in a cache-missing solve's first dispatch (includes jit "
+    "trace + XLA compile, or the persistent disk-cache load)",
+)
+
+
+def record_lookup(site: str, hit: bool) -> None:
+    """One executable-cache lookup outcome (site: 'tpu_solver'/'service')."""
+    (CACHE_HITS if hit else CACHE_MISSES).inc({"site": site})
+
+
+def record_compile_seconds(seconds: float) -> None:
+    COMPILE_SECONDS.observe(seconds)
+
 
 def enable_persistent_cache(cache_dir: Optional[str] = None) -> Optional[str]:
     """Point JAX's persistent compilation cache at a disk directory.

@@ -1,0 +1,144 @@
+"""Metrics-registry exposition suite (ISSUE 1 satellites): golden-output
+test of the Prometheus text format (HELP/TYPE, cumulative histogram buckets
+with +Inf, label escaping), locked reads, type-mismatch rejection, and
+Histogram.percentile edge cases."""
+import threading
+
+import pytest
+
+from karpenter_core_tpu.metrics.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+)
+
+
+def test_expose_golden():
+    r = Registry()
+    c = r.counter("t_requests", "Total requests")
+    c.inc({"code": "200"})
+    c.inc({"code": '5"00\n'}, 2)  # quote + newline need escaping
+    g = r.gauge("t_temp", "Temp\nnow")  # HELP newline needs escaping
+    g.set(3.5, {"room": "a"})
+    h = r.histogram("t_lat", "Latency", buckets=[0.1, 1])
+    h.observe(0.05)
+    h.observe(0.5, {"p": "x"})
+    h.observe(5, {"p": "x"})  # above the largest bucket: only +Inf counts it
+
+    assert r.expose() == "\n".join([
+        '# HELP t_lat Latency',
+        '# TYPE t_lat histogram',
+        't_lat_bucket{le="0.1"} 1',
+        't_lat_bucket{le="1"} 1',
+        't_lat_bucket{le="+Inf"} 1',
+        't_lat_sum 0.05',
+        't_lat_count 1',
+        't_lat_bucket{p="x",le="0.1"} 0',
+        't_lat_bucket{p="x",le="1"} 1',
+        't_lat_bucket{p="x",le="+Inf"} 2',
+        't_lat_sum{p="x"} 5.5',
+        't_lat_count{p="x"} 2',
+        '# HELP t_requests Total requests',
+        '# TYPE t_requests counter',
+        't_requests{code="200"} 1',
+        't_requests{code="5\\"00\\n"} 2',
+        '# HELP t_temp Temp\\nnow',
+        '# TYPE t_temp gauge',
+        't_temp{room="a"} 3.5',
+    ])
+
+
+def test_expose_backslash_escaping():
+    r = Registry()
+    r.gauge("t_path").set(1.0, {"dir": "C:\\tmp"})
+    assert 't_path{dir="C:\\\\tmp"} 1' in r.expose()
+
+
+def test_expose_empty_metric_emits_type_only():
+    r = Registry()
+    r.counter("t_nothing", "never incremented")
+    text = r.expose()
+    assert "# HELP t_nothing never incremented" in text
+    assert "# TYPE t_nothing counter" in text
+    assert "t_nothing{" not in text  # no samples
+
+
+def test_histogram_buckets_are_cumulative_and_parseable():
+    """Every exposed line is `name{labels} value` with balanced quotes —
+    the shape promtool parses; bucket counts never decrease as le grows."""
+    r = Registry()
+    h = r.histogram("t_d", "", buckets=[1, 2, 4])
+    for v in (0.5, 1.5, 3, 100):
+        h.observe(v, {"op": "solve"})
+    lines = [ln for ln in r.expose().splitlines() if not ln.startswith("#")]
+    assert lines  # samples exist
+    counts = []
+    for ln in lines:
+        name_part, value = ln.rsplit(" ", 1)
+        float(value)  # parseable
+        assert name_part.count('"') % 2 == 0
+        if "_bucket" in name_part:
+            counts.append(float(value))
+    assert counts == sorted(counts)  # cumulative
+    assert counts[-1] == 4  # +Inf sees every observation
+
+
+# -- type mismatch -----------------------------------------------------------
+
+
+def test_get_or_create_raises_on_type_mismatch():
+    r = Registry()
+    r.counter("t_x")
+    with pytest.raises(TypeError, match="already registered as Counter"):
+        r.gauge("t_x")
+    with pytest.raises(TypeError):
+        r.histogram("t_x")
+    # same-type re-request still returns the one instance
+    assert r.counter("t_x") is r.counter("t_x")
+
+
+# -- locked reads ------------------------------------------------------------
+
+
+def test_counter_concurrent_inc_and_get():
+    c = Counter("t_c")
+    N, PER = 8, 2000
+
+    def work():
+        for _ in range(PER):
+            c.inc({"k": "v"})
+            c.get({"k": "v"})  # locked read races the writers
+
+    threads = [threading.Thread(target=work) for _ in range(N)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert c.get({"k": "v"}) == N * PER
+
+
+def test_gauge_get_returns_none_when_unset():
+    g = Gauge("t_g")
+    assert g.get() is None
+    g.set(2.0)
+    assert g.get() == 2.0
+
+
+# -- percentile edge cases ---------------------------------------------------
+
+
+def test_percentile_above_largest_bucket_saturates():
+    h = Histogram("t_h", buckets=[0.1, 1])
+    h.observe(50)  # beyond every finite bucket
+    h.observe(99)
+    assert h.percentile(0.5) == 1  # saturates to the largest finite bound
+    assert h.percentile(1.0) == 1
+
+
+def test_percentile_empty_labels_and_no_observations():
+    h = Histogram("t_h", buckets=[0.1, 1])
+    assert h.percentile(0.99) is None  # nothing observed
+    h.observe(0.05, {"a": "b"})
+    assert h.percentile(0.5) is None  # empty-label series still unobserved
+    assert h.percentile(0.5, {"a": "b"}) == 0.1

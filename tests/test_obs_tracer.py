@@ -1,0 +1,297 @@
+"""Tracer unit suite (ISSUE 1 satellite): nesting, thread safety, ring
+truncation accounting, Chrome trace-event shape, the disabled fast path,
+and trace-id propagation over the gRPC solver-service boundary."""
+import json
+import threading
+import time
+
+import pytest
+
+from karpenter_core_tpu.obs import TRACER
+from karpenter_core_tpu.obs.tracer import NOOP_SPAN, TRACE_HEADER, Tracer
+
+
+@pytest.fixture
+def tracer():
+    t = Tracer(capacity=1024)
+    t.enable()
+    return t
+
+
+# -- nesting ----------------------------------------------------------------
+
+
+def test_nested_spans_parent_and_trace_id(tracer):
+    with tracer.span("outer", kind="root") as outer:
+        with tracer.span("inner") as inner:
+            assert inner.parent_id == outer.span_id
+            assert inner.trace_id == outer.trace_id
+        with tracer.span("inner2") as inner2:
+            assert inner2.parent_id == outer.span_id
+    spans = tracer.spans()
+    assert [s.name for s in spans] == ["inner", "inner2", "outer"]
+    # a NEW root mints a NEW trace id
+    with tracer.span("outer2") as outer2:
+        assert outer2.trace_id != outer.trace_id
+        assert outer2.parent_id is None
+
+
+def test_explicit_trace_id_adopted(tracer):
+    with tracer.span("server", trace_id="t-propagated") as sp:
+        assert sp.trace_id == "t-propagated"
+        with tracer.span("child") as child:
+            assert child.trace_id == "t-propagated"
+
+
+def test_add_span_parents_to_current(tracer):
+    t0 = time.perf_counter_ns()
+    with tracer.span("solve") as root:
+        tracer.add_span("solver.phase.args", t0, t0 + 1_000_000, n=3)
+    phase = next(s for s in tracer.spans() if s.name == "solver.phase.args")
+    assert phase.parent_id == root.span_id
+    assert phase.duration_ms == pytest.approx(1.0)
+    assert phase.attrs["n"] == 3
+
+
+def test_exception_exits_span_and_flags_error(tracer):
+    with pytest.raises(ValueError):
+        with tracer.span("boom"):
+            raise ValueError("x")
+    (span,) = tracer.spans()
+    assert span.attrs["error"] == "ValueError"
+    assert tracer._current() is None  # stack unwound
+
+
+# -- thread safety ----------------------------------------------------------
+
+
+def test_concurrent_writers():
+    tracer = Tracer(capacity=8 * 200 * 2)
+    tracer.enable()
+    N_THREADS, N_SPANS = 8, 200
+    errors = []
+
+    def work(i):
+        try:
+            for j in range(N_SPANS):
+                with tracer.span(f"outer-{i}") as outer:
+                    with tracer.span(f"inner-{i}") as inner:
+                        assert inner.parent_id == outer.span_id
+                        assert inner.trace_id == outer.trace_id
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=work, args=(i,)) for i in range(N_THREADS)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    spans = tracer.spans()
+    assert len(spans) == N_THREADS * N_SPANS * 2
+    # per-thread nesting stayed isolated: every inner's parent is an outer
+    # span from the SAME thread
+    by_id = {s.span_id: s for s in spans}
+    for s in spans:
+        if s.name.startswith("inner"):
+            assert by_id[s.parent_id].tid == s.tid
+
+
+# -- ring buffer ------------------------------------------------------------
+
+
+def test_ring_truncation_accounting():
+    t = Tracer(capacity=8)
+    t.enable()
+    for i in range(20):
+        with t.span(f"s{i}"):
+            pass
+    assert len(t.spans()) == 8
+    assert t.dropped == 12
+    # the ring keeps the NEWEST spans
+    assert [s.name for s in t.spans()] == [f"s{i}" for i in range(12, 20)]
+    assert t.chrome_trace()["otherData"]["dropped_spans"] == 12
+    t.clear()
+    assert t.dropped == 0 and not t.spans()
+
+
+def test_spans_since_mark(tracer):
+    with tracer.span("before"):
+        pass
+    seq = tracer.mark()
+    with tracer.span("solver.phase.device"):
+        time.sleep(0.002)
+    with tracer.span("solver.phase.device"):
+        pass
+    names = [s.name for s in tracer.spans_since(seq)]
+    assert names == ["solver.phase.device", "solver.phase.device"]
+    phases = tracer.phase_ms_since(seq)
+    assert set(phases) == {"device"}
+    assert phases["device"] >= 2.0  # summed across both spans
+    # last_only reproduces the historical last-round-overwrite timers
+    last = tracer.phase_ms_since(seq, last_only=True)
+    assert last["device"] < phases["device"]
+
+
+# -- chrome trace export -----------------------------------------------------
+
+
+def test_chrome_trace_shape(tracer, tmp_path):
+    with tracer.span("provisioner.reconcile"):
+        with tracer.span("solver.phase.encode", pods=5):
+            pass
+    path = tracer.export_chrome_trace(str(tmp_path / "trace.json"))
+    with open(path) as f:
+        trace = json.load(f)  # round-trips
+    events = trace["traceEvents"]
+    assert len(events) == 2
+    for e in events:
+        # complete events: every one carries ph='X' AND a dur (the
+        # B-without-E failure mode cannot exist by construction)
+        assert e["ph"] == "X"
+        assert e["dur"] >= 0
+        assert isinstance(e["ts"], float)
+        assert isinstance(e["tid"], int) and isinstance(e["pid"], int)
+        assert "trace_id" in e["args"]
+    encode = next(e for e in events if e["name"] == "solver.phase.encode")
+    assert encode["args"]["pods"] == 5
+    assert encode["args"]["parent_id"]
+
+
+# -- disabled fast path ------------------------------------------------------
+
+
+def test_disabled_path_no_allocation():
+    t = Tracer()
+    assert not t.enabled
+    # span() on a disabled tracer returns the SHARED no-op object — no
+    # per-call allocation, one flag check
+    assert t.span("a") is NOOP_SPAN
+    assert t.span("b", pods=50000) is NOOP_SPAN
+    with t.span("c") as sp:
+        sp.set(x=1)  # attribute setter is also a no-op
+    t.add_span("d", 0, 10)
+    assert t.mark() == 0
+    assert not t.spans()
+
+
+def test_metrics_bridge_feeds_registry(tracer):
+    from karpenter_core_tpu.obs.tracer import (
+        SOLVER_BATCH_SIZE,
+        SOLVER_PHASE_DURATION,
+        SOLVER_SOLVE_DURATION,
+    )
+
+    before = SOLVER_PHASE_DURATION.counts.get((("phase", "upload"),), 0)
+    with tracer.span("solver.phase.upload"):
+        pass
+    with tracer.span("solver.solve", pods=123):
+        pass
+    assert SOLVER_PHASE_DURATION.counts[(("phase", "upload"),)] == before + 1
+    assert SOLVER_BATCH_SIZE.get() == 123.0
+    # simulation-context solves land in their own series and never touch
+    # the provisioning batch-size gauge
+    sim_before = SOLVER_SOLVE_DURATION.counts.get(
+        (("context", "simulation"),), 0
+    )
+    with tracer.span("solver.solve", pods=9999, context="simulation"):
+        pass
+    assert SOLVER_SOLVE_DURATION.counts[(("context", "simulation"),)] == (
+        sim_before + 1
+    )
+    assert SOLVER_BATCH_SIZE.get() == 123.0  # unchanged
+
+
+def test_enable_tracing_from_env(monkeypatch):
+    from karpenter_core_tpu.obs import tracer as tracer_mod
+
+    was_enabled = tracer_mod.TRACER.enabled
+    try:
+        for raw, default_on, expect in [
+            ("1", False, True), ("true", False, True), ("on", False, True),
+            ("", False, False), ("0", True, False), ("false", True, False),
+            ("", True, True),
+        ]:
+            tracer_mod.TRACER.disable()
+            monkeypatch.setenv("KARPENTER_TPU_TRACE", raw)
+            assert tracer_mod.enable_tracing_from_env(default_on) is expect, (
+                raw, default_on,
+            )
+    finally:
+        tracer_mod.TRACER.enabled = was_enabled
+
+
+# -- solve-path integration --------------------------------------------------
+
+
+def test_solve_emits_all_phases():
+    """A real TPUSolver.solve() records the six solver phases (+args) under
+    one solver.solve root, all sharing a trace id."""
+    from karpenter_core_tpu.cloudprovider import fake
+    from karpenter_core_tpu.solver.tpu_solver import TPUSolver
+    from karpenter_core_tpu.testing import make_pod, make_provisioner
+
+    TRACER.enable()
+    TRACER.clear()
+    try:
+        solver = TPUSolver(max_nodes=32)
+        pods = [make_pod(requests={"cpu": "1"}) for _ in range(16)]
+        res = solver.solve(
+            pods, [make_provisioner(name="default")],
+            {"default": fake.instance_types(4)},
+        )
+        assert res.pod_count_new() + res.pod_count_existing() == 16
+        spans = TRACER.spans()
+        root = next(s for s in spans if s.name == "solver.solve")
+        phases = {
+            s.name[len("solver.phase."):]
+            for s in spans
+            if s.name.startswith("solver.phase.")
+        }
+        assert {"encode", "args", "pack", "upload", "device", "fetch",
+                "bind"} <= phases
+        assert all(s.trace_id == root.trace_id for s in spans)
+        assert root.attrs["context"] == "provisioning"
+        device = next(s for s in spans if s.name == "solver.phase.device")
+        assert device.attrs["compile_cache"] in ("hit", "miss")
+        # a solve re-entered under a deprovisioning span self-labels as a
+        # simulation (kept out of the provisioning metric series)
+        TRACER.clear()
+        with TRACER.span("deprovisioning.simulate", candidates=0):
+            solver.solve(
+                pods, [make_provisioner(name="default")],
+                {"default": fake.instance_types(4)},
+            )
+        sim_root = next(
+            s for s in TRACER.spans() if s.name == "solver.solve"
+        )
+        assert sim_root.attrs["context"] == "simulation"
+    finally:
+        TRACER.disable()
+        TRACER.clear()
+
+
+def test_service_adopts_propagated_trace_id():
+    """The gRPC server handler joins the client's trace via metadata."""
+    from karpenter_core_tpu.solver import service_pb2 as pb
+    from karpenter_core_tpu.solver.service import SolverService
+
+    class _Ctx:
+        def invocation_metadata(self):
+            return ((TRACE_HEADER, "t-from-client"),)
+
+    TRACER.enable()
+    TRACER.clear()
+    try:
+        service = SolverService()
+        # malformed geometry: the handler reports the error on the wire and
+        # still records its span with the adopted trace id
+        resp = service.solve(
+            pb.SolveRequest(geometry="", tensors=[]), context=_Ctx()
+        )
+        assert resp.error
+        (span,) = [s for s in TRACER.spans() if s.name == "solver.service.solve"]
+        assert span.trace_id == "t-from-client"
+    finally:
+        TRACER.disable()
+        TRACER.clear()
