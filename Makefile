@@ -2,10 +2,10 @@
 # (test/deflake/verify, reference Makefile:9-33). Tests force the CPU
 # backend with 8 virtual devices via tests/conftest.py.
 
-.PHONY: test deflake perf bench verify trace-demo
+.PHONY: test deflake perf bench verify trace-demo chaos chaos-smoke
 
-test:  ## full suite (CPU, 8 virtual devices)
-	python -m pytest tests -q
+test:  ## tier-1 suite (CPU, 8 virtual devices); slow chaos soaks: make chaos
+	python -m pytest tests -q -m "not slow"
 
 deflake:  ## until-it-fails loop over the concurrency-sensitive suites
 	./hack/deflake.sh
@@ -19,6 +19,15 @@ bench:  ## north-star benchmark on the attached backend (one JSON line)
 trace-demo:  ## small traced solve -> /tmp/karpenter_trace.json (validated)
 	python hack/trace_demo.py
 
+chaos:  ## fault-injection suite (incl. slow schedule cases), fixed seed
+	KARPENTER_CHAOS_SEED=42 python -m pytest \
+		tests/test_chaos_registry.py tests/test_chaos_operator.py \
+		tests/test_chaos_solver.py tests/test_kube_retry.py \
+		tests/test_resilient_recovery.py -q
+
+chaos-smoke:  ## env-spec chaos run -> loop recovers + counters exposed
+	python hack/chaos_smoke.py
+
 verify:  ## driver hooks: single-chip compile check + 8-way mesh dryrun
 	# force the CPU backend in-process: this image's sitecustomize pins the
 	# axon TPU tunnel (env vars can't override it), and a wedged tunnel
@@ -29,3 +38,6 @@ verify:  ## driver hooks: single-chip compile check + 8-way mesh dryrun
 	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 	# non-fatal smoke: a traced solve must export valid Perfetto JSON
 	-$(MAKE) trace-demo
+	# non-fatal smoke: an env-spec chaos run must recover and expose the
+	# karpenter_chaos_injected_total / retry / ICE counters
+	-$(MAKE) chaos-smoke

@@ -1,0 +1,90 @@
+"""Chaos smoke: arm a fail-3-then-recover create fault via the
+KARPENTER_CHAOS env grammar against a full in-process control plane, and
+validate the ISSUE-2 contract end to end (`make chaos-smoke`; wired into
+`make verify` as a non-fatal step, same pattern as trace-demo):
+
+  * the env spec parses and arms (seeded, deterministic),
+  * the injected faults fire and karpenter_chaos_injected_total appears in
+    the /metrics exposition alongside the retry/ICE counters,
+  * the loop recovers: a final re-solve needs no new machines and strands
+    no pods — degrade, never stall.
+
+Hermetic: forces the CPU backend in-process (the image's sitecustomize pins
+the axon TPU tunnel; env vars can't override it — same treatment as `make
+verify`'s compile check).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+N_PODS = int(os.environ.get("KCT_CHAOS_SMOKE_PODS", "12"))
+SPEC = os.environ.get(
+    "KCT_CHAOS_SMOKE_SPEC", "cloudprovider.create=error:conn,times:3"
+)
+SEED = os.environ.get("KARPENTER_CHAOS_SEED", "42")
+
+
+def main() -> int:
+    from karpenter_core_tpu import chaos
+    from karpenter_core_tpu.api.settings import Settings
+    from karpenter_core_tpu.cloudprovider import fake
+    from karpenter_core_tpu.metrics.registry import REGISTRY
+    from karpenter_core_tpu.operator import new_operator
+    from karpenter_core_tpu.testing import make_pod, make_provisioner
+
+    armed = chaos.arm_from_env(
+        {"KARPENTER_CHAOS": SPEC, "KARPENTER_CHAOS_SEED": SEED}
+    )
+    fault = armed[chaos.CLOUDPROVIDER_CREATE]
+
+    cp = fake.FakeCloudProvider(fake.instance_types(8))
+    op = new_operator(cp, settings=Settings())
+    op.kube_client.create(make_provisioner(name="default"))
+    for i in range(N_PODS):
+        op.kube_client.create(make_pod(name=f"smoke-{i}", requests={"cpu": "1"}))
+    for _ in range(8):
+        op.step()
+
+    problems = []
+    if fault.injected != 3:
+        problems.append(f"expected 3 injected faults, saw {fault.injected}")
+    if not op.kube_client.list("Machine"):
+        problems.append("no machines launched after the fault recovered")
+    op.sync_state()
+    result = op.provisioning.schedule()
+    if result is not None and (result.new_machines or result.failed_pods):
+        problems.append(
+            f"loop did not converge: new={len(result.new_machines)} "
+            f"failed={len(result.failed_pods)}"
+        )
+
+    # the counters the /debug|/metrics exposition must carry
+    text = REGISTRY.expose()
+    for needle in (
+        "karpenter_chaos_injected_total",
+        "karpenter_launch_failures_total",
+        "karpenter_launch_resolve_retriggers_total",
+    ):
+        if needle not in text:
+            problems.append(f"{needle} missing from the metrics exposition")
+
+    chaos.reset()
+    if problems:
+        for p in problems:
+            print(f"chaos-smoke FAIL: {p}", file=sys.stderr)
+        return 1
+    print(
+        f"chaos-smoke ok: spec={SPEC!r} injected={fault.injected} "
+        f"machines={len(op.kube_client.list('Machine'))} pods={N_PODS} "
+        "(all scheduled, counters exposed)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,11 +18,14 @@ from karpenter_core_tpu.api.machine import Machine, MachineStatus
 from karpenter_core_tpu.api.provisioner import Provisioner
 from karpenter_core_tpu.cloudprovider.types import (
     CloudProvider,
+    IncompatibleRequirementsError,
     InstanceType,
     InstanceTypeOverhead,
+    InsufficientCapacityError,
     MachineNotFoundError,
     Offering,
     Offerings,
+    offering_pool_matches,
 )
 from karpenter_core_tpu.kube.objects import (
     LABEL_ARCH_STABLE,
@@ -204,6 +207,12 @@ class FakeCloudProvider(CloudProvider):
         self.created_machines: Dict[str, Machine] = {}
         self.drifted: bool = False
         self.next_create_err: Optional[Exception] = None
+        # offering keys (instance_type, zone, capacity_type) that raise
+        # InsufficientCapacityError on create — the reference fake's
+        # InsufficientCapacityPools: the launch path's ICE cache + re-solve
+        # are exercised against vendor-shaped capacity outages. Empty
+        # components wildcard (e.g. ("", "test-zone-1", "") exhausts a zone).
+        self.insufficient_capacity: set = set()
 
     def reset(self) -> None:
         with self._mu:
@@ -211,6 +220,7 @@ class FakeCloudProvider(CloudProvider):
             self.created_machines = {}
             self.allowed_create_calls = 2**31
             self.next_create_err = None
+            self.insufficient_capacity = set()
 
     def create(self, machine: Machine) -> Machine:
         with self._mu:
@@ -219,7 +229,9 @@ class FakeCloudProvider(CloudProvider):
                 raise err
             self.create_calls.append(machine)
             if len(self.create_calls) > self.allowed_create_calls:
-                raise RuntimeError("erroring as number of AllowedCreateCalls has been exceeded")
+                raise InsufficientCapacityError(
+                    "erroring as number of AllowedCreateCalls has been exceeded"
+                )
 
             reqs = Requirements.from_node_selector_requirements(*machine.spec.requirements)
             candidates = [
@@ -230,7 +242,9 @@ class FakeCloudProvider(CloudProvider):
                 and resources_util.fits(machine.spec.resources.requests, it.allocatable())
             ]
             if not candidates:
-                raise RuntimeError("no compatible instance types for machine")
+                raise IncompatibleRequirementsError(
+                    "no compatible instance types for machine"
+                )
             candidates.sort(
                 key=lambda it: it.offerings.available().requirements(reqs).cheapest().price
             )
@@ -241,6 +255,13 @@ class FakeCloudProvider(CloudProvider):
                 for key, requirement in instance_type.requirements.items()
                 if requirement.operator() == OP_IN
             }
+            # pick the first compatible offering with CAPACITY; a pool in
+            # insufficient_capacity is skipped like a real cloud falling
+            # through to its next pool, and only when every compatible
+            # offering is exhausted does create() raise the vendor-shaped
+            # ICE (keyed to the first compatible offering, so the launch
+            # path's ICE cache masks something concrete)
+            exhausted = []
             for o in instance_type.offerings.available():
                 offer_reqs = Requirements(
                     [
@@ -249,9 +270,35 @@ class FakeCloudProvider(CloudProvider):
                     ]
                 )
                 if reqs.compatible(offer_reqs) is None:
+                    if self._exhausted(instance_type.name, o):
+                        exhausted.append(o)
+                        continue
                     labels[LABEL_TOPOLOGY_ZONE] = o.zone
                     labels[api_labels.LABEL_CAPACITY_TYPE] = o.capacity_type
                     break
+            else:
+                if exhausted:
+                    if len(exhausted) == 1:
+                        # one precise pool failed: report the full offering
+                        # key so only IT gets masked
+                        o = exhausted[0]
+                        raise InsufficientCapacityError(
+                            f"insufficient capacity for {instance_type.name} "
+                            f"in {o.zone}/{o.capacity_type}",
+                            instance_type=instance_type.name,
+                            zone=o.zone,
+                            capacity_type=o.capacity_type,
+                        )
+                    # every compatible pool of this type is exhausted:
+                    # report TYPE-level exhaustion (empty zone/ct wildcard)
+                    # so the ICE cache masks the whole type and the
+                    # re-solve moves to the next instance type instead of
+                    # replaying one offering at a time
+                    raise InsufficientCapacityError(
+                        f"insufficient capacity for {instance_type.name} "
+                        f"(all compatible offerings exhausted)",
+                        instance_type=instance_type.name,
+                    )
 
             name = f"fake-machine-{next(_name_counter)}"
             created = Machine(
@@ -266,6 +313,16 @@ class FakeCloudProvider(CloudProvider):
             created.metadata.namespace = ""
             self.created_machines[machine.name] = created
             return created
+
+    def _exhausted(self, instance_type: str, offering: Offering) -> bool:
+        """InsufficientCapacityPools membership; empty pool components
+        wildcard (("", "test-zone-1", "") exhausts a whole zone)."""
+        return any(
+            offering_pool_matches(
+                pool, instance_type, offering.zone, offering.capacity_type
+            )
+            for pool in self.insufficient_capacity
+        )
 
     def get(self, machine_name: str, provisioner_name: str = "") -> Machine:
         with self._mu:

@@ -1,0 +1,146 @@
+"""TTL'd insufficient-capacity (ICE) cache.
+
+When a launch fails with InsufficientCapacityError, the exhausted offering
+(instance-type × zone × capacity-type) is recorded here and MASKED from the
+instance-type universe the next Solve() sees — so the re-solve of the
+residual pods places them on different offerings instead of spinning on the
+one the cloud just rejected (reference: the AWS provider's unavailable-
+offerings cache; fake/cloudprovider.go's InsufficientCapacityPools drives
+the same behavior in tests).
+
+Entries expire on a TTL because zonal exhaustion is transient: capacity
+returns, and a permanently-masked offering would strand the cheapest
+placement forever. Partial keys degrade gracefully — an error that only
+names an instance type masks every offering of that type; an error with no
+key at all (e.g. a chaos-injected generic ICE) masks nothing but still
+counts, so launch retry semantics are exercised without corrupting the
+universe.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from karpenter_core_tpu.cloudprovider.types import (
+    InstanceType,
+    InsufficientCapacityError,
+    Offering,
+    Offerings,
+    offering_pool_matches,
+)
+from karpenter_core_tpu.metrics.registry import NAMESPACE, REGISTRY
+
+ICE_CACHE_ENTRIES = REGISTRY.gauge(
+    f"{NAMESPACE}_ice_cache_entries",
+    "Offerings currently masked by the insufficient-capacity cache",
+)
+ICE_CACHE_RECORDED_TOTAL = REGISTRY.counter(
+    f"{NAMESPACE}_ice_cache_recorded_total",
+    "InsufficientCapacityErrors recorded into the ICE cache",
+)
+ICE_CACHE_MASKED_TOTAL = REGISTRY.counter(
+    f"{NAMESPACE}_ice_cache_masked_offerings_total",
+    "Offerings masked out of a Solve's instance-type universe by the ICE cache",
+)
+
+Key = Tuple[str, str, str]  # (instance_type, zone, capacity_type)
+
+# the reference AWS provider caches ICE for 3 minutes
+DEFAULT_TTL = 180.0
+
+
+class ICECache:
+    """Thread-safe (launches fan out over a pool) offering blocklist."""
+
+    def __init__(self, ttl: float = DEFAULT_TTL, clock=time.time):
+        self.ttl = ttl
+        self.clock = clock
+        self._mu = threading.Lock()
+        self._entries: Dict[Key, float] = {}  # key -> expiry
+
+    def record(self, err: InsufficientCapacityError) -> bool:
+        """Record the exhausted offering; returns False when the error
+        carries no offering key at all (nothing maskable)."""
+        key = err.offering_key()
+        if not any(key):
+            return False
+        with self._mu:
+            self._entries[key] = self.clock() + self.ttl
+            ICE_CACHE_ENTRIES.set(float(len(self._entries)))
+        ICE_CACHE_RECORDED_TOTAL.inc()
+        return True
+
+    def _expire_locked(self, now: float) -> None:
+        dead = [k for k, exp in self._entries.items() if exp <= now]
+        for k in dead:
+            del self._entries[k]
+        if dead:
+            ICE_CACHE_ENTRIES.set(float(len(self._entries)))
+
+    def next_expiry_in(self) -> Optional[float]:
+        """Seconds until the EARLIEST entry expires (None when empty) — the
+        launch path schedules its re-solve retrigger here, since masked
+        capacity cannot return any sooner than its cache entry lapses."""
+        now = self.clock()
+        with self._mu:
+            self._expire_locked(now)
+            if not self._entries:
+                return None
+            return max(0.0, min(self._entries.values()) - now)
+
+    def __len__(self) -> int:
+        with self._mu:
+            self._expire_locked(self.clock())
+            return len(self._entries)
+
+    def keys(self) -> List[Key]:
+        with self._mu:
+            self._expire_locked(self.clock())
+            return list(self._entries)
+
+    # -- universe masking ---------------------------------------------------
+
+    def mask(self, instance_types: List[InstanceType]) -> List[InstanceType]:
+        """Return the universe with cached-exhausted offerings flagged
+        unavailable (shallow rebuild: only instance types that actually
+        lose an offering are copied — the common no-entries case returns
+        the input list untouched). One lock acquisition + expiry sweep for
+        the whole universe: this runs on the solve hot path, per offering
+        of potentially hundreds of types."""
+        entries = self.keys()  # one locked snapshot (expires stale entries)
+        if not entries:
+            return instance_types
+        out: List[InstanceType] = []
+        masked = 0
+        for it in instance_types:
+            hit = [
+                o for o in it.offerings
+                if o.available
+                and any(
+                    offering_pool_matches(key, it.name, o.zone, o.capacity_type)
+                    for key in entries
+                )
+            ]
+            if not hit:
+                out.append(it)
+                continue
+            masked += len(hit)
+            new_offerings = Offerings(
+                Offering(o.capacity_type, o.zone, o.price, available=False)
+                if o in hit
+                else o
+                for o in it.offerings
+            )
+            out.append(
+                InstanceType(
+                    name=it.name,
+                    requirements=it.requirements,
+                    offerings=new_offerings,
+                    capacity=it.capacity,
+                    overhead=it.overhead,
+                )
+            )
+        if masked:
+            ICE_CACHE_MASKED_TOTAL.inc(value=float(masked))
+        return out

@@ -89,8 +89,57 @@ class MachineNotFoundError(Exception):
     """types.go:148-175."""
 
 
+class CloudProviderError(RuntimeError):
+    """Base for typed create-path failures. Subclasses RuntimeError so
+    pre-existing callers catching the old bare RuntimeErrors keep working."""
+
+
+class InsufficientCapacityError(CloudProviderError):
+    """The vendor could not launch the requested offering — the ICE
+    (insufficient-capacity) shape every real cloud returns under zonal
+    exhaustion. Carries the exhausted offering key so the launch path can
+    feed the ICE cache and mask it from the next Solve (the reference's
+    insufficient-capacity-error cache, cloudprovider/fake +
+    aws ICE-cache analog)."""
+
+    def __init__(self, message: str = "insufficient capacity",
+                 instance_type: str = "", zone: str = "",
+                 capacity_type: str = ""):
+        super().__init__(message)
+        self.instance_type = instance_type
+        self.zone = zone
+        self.capacity_type = capacity_type
+
+    def offering_key(self) -> Tuple[str, str, str]:
+        return (self.instance_type, self.zone, self.capacity_type)
+
+
+class IncompatibleRequirementsError(CloudProviderError):
+    """No instance type satisfies the machine's requirements — a REQUEST
+    defect, not a capacity outage: retrying the same launch cannot succeed,
+    so callers must not treat it as transient (no ICE-cache entry, no
+    launch retry)."""
+
+
 def is_machine_not_found(err: Exception) -> bool:
     return isinstance(err, MachineNotFoundError)
+
+
+def is_insufficient_capacity(err: Exception) -> bool:
+    return isinstance(err, InsufficientCapacityError)
+
+
+def offering_pool_matches(pool: Tuple[str, str, str], instance_type: str,
+                          zone: str, capacity_type: str) -> bool:
+    """THE wildcard match over an (instance_type, zone, capacity_type) pool
+    key: an empty component matches anything. Shared by the ICE cache and
+    the fake provider's InsufficientCapacityPools so the two can't drift."""
+    pool_it, pool_zone, pool_ct = pool
+    return (
+        (not pool_it or pool_it == instance_type)
+        and (not pool_zone or pool_zone == zone)
+        and (not pool_ct or pool_ct == capacity_type)
+    )
 
 
 class CloudProvider:

@@ -15,15 +15,33 @@ import threading
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
+from karpenter_core_tpu import chaos
 from karpenter_core_tpu.api import labels as api_labels
 from karpenter_core_tpu.api.provisioner import Provisioner as ProvisionerCRD
+from karpenter_core_tpu.cloudprovider.icecache import ICECache
+from karpenter_core_tpu.cloudprovider.types import (
+    IncompatibleRequirementsError,
+    InsufficientCapacityError,
+)
 from karpenter_core_tpu.controllers.provisioning.batcher import Batcher
 from karpenter_core_tpu.controllers.provisioning.volumetopology import VolumeTopology
 from karpenter_core_tpu.kube.objects import Node, NodeStatus, Pod
-from karpenter_core_tpu.metrics.registry import NODES_CREATED
+from karpenter_core_tpu.metrics.registry import NAMESPACE, NODES_CREATED, REGISTRY
 from karpenter_core_tpu.obs import TRACER
 from karpenter_core_tpu.solver.tpu_solver import GreedySolver, SolvedMachine, SolveResult
 from karpenter_core_tpu.utils import podutils
+
+LAUNCH_FAILURES = REGISTRY.counter(
+    f"{NAMESPACE}_launch_failures_total",
+    "Machine launches that failed, by failure class (insufficient_capacity /"
+    " transient / error)",
+)
+LAUNCH_RESOLVE_RETRIGGERS = REGISTRY.counter(
+    f"{NAMESPACE}_launch_resolve_retriggers_total",
+    "Batcher re-triggers after retryable launch failures: the residual pods"
+    " re-solve against an ICE-masked universe instead of spinning on the"
+    " offering the cloud just rejected",
+)
 
 
 @dataclass
@@ -52,6 +70,16 @@ class ProvisioningController:
         self.fallback_solver = fallback_solver or GreedySolver()
         self.batcher = Batcher()
         self.volume_topology = VolumeTopology(kube_client)
+        # exhausted offerings observed at launch; masked from the universe
+        # the next Solve sees so residual pods re-place elsewhere
+        self.ice_cache = ICECache()
+        # launch-retry pacing: consecutive retryable-failure count and the
+        # monotonic deadline of the next scheduled re-trigger (None = none
+        # pending) — the workqueue-backoff analog, so a persistently
+        # failing launch re-solves on a growing jittered interval instead
+        # of burning a full solve every batch window
+        self._launch_retry_failures = 0
+        self._launch_retry_at: Optional[float] = None
         self._mu = threading.Lock()
         # (provisioners, instance_types) the LAST solve saw — the failure-
         # explanation probe reads them so it never races provisioner churn
@@ -63,6 +91,7 @@ class ProvisioningController:
         """One pass: returns the number of machines launched
         (provisioner.go:105-126)."""
         if wait_timeout is not None:
+            self._maybe_fire_launch_retry()
             if not self.batcher.wait(timeout=wait_timeout):
                 return 0
         # the reconcile ROOT span: schedule (solver.solve nests under it)
@@ -82,10 +111,33 @@ class ProvisioningController:
             failed=len(result.failed_pods),
         )
         with TRACER.span("provisioner.launch", machines=len(result.new_machines)):
-            names = self.launch_machines(
+            names, errors = self._launch_machines_with_errors(
                 result.new_machines, LaunchOptions(record_pod_nomination=True)
             )
         created = sum(1 for n in names if n)
+        if any(self._launch_retryable(e) for e in errors):
+            # level-triggered launch retry: the failed machines' pods are
+            # still pending, the exhausted offerings are now ICE-masked —
+            # schedule a re-trigger so a later reconcile re-SOLVES the
+            # residual pods against the masked universe instead of waiting
+            # for an unrelated pod event. Paced by jittered exponential
+            # backoff on consecutive failures (workqueue-requeue analog): a
+            # PERSISTENTLY failing launch must not burn a full solve every
+            # batch window.
+            LAUNCH_RESOLVE_RETRIGGERS.inc()
+            self._launch_retry_failures += 1
+            self._schedule_launch_retry(self._launch_retry_failures)
+        else:
+            self._launch_retry_failures = 0
+            if result.failed_pods:
+                # pods left unplaced while offerings are ICE-masked: arm
+                # ONE re-trigger at the earliest cache-entry expiry (masked
+                # capacity cannot return any sooner) so the batch re-solves
+                # then instead of either polling a full solve per window or
+                # waiting for an unrelated pod event
+                wait = self.ice_cache.next_expiry_in()
+                if wait is not None:
+                    self._schedule_launch_retry_in(wait + 0.05)
         if created:
             NODES_CREATED.inc({"reason": "provisioning"}, created)
         # nominate existing-node placements (scheduler.go:143-153)
@@ -263,8 +315,12 @@ class ProvisioningController:
         )
         if not provisioners:
             return None
+        # offerings the cloud recently ICE'd are masked so this solve
+        # places pods where capacity actually exists (TTL'd: exhaustion is
+        # transient, the offering returns when the cache entry expires)
         instance_types = {
-            p.name: self.cloud_provider.get_instance_types(p) for p in provisioners
+            p.name: self.ice_cache.mask(self.cloud_provider.get_instance_types(p))
+            for p in provisioners
         }
         # the exact inputs this solve saw, for the failure-explanation
         # probe (re-listing would race provisioner churn)
@@ -301,18 +357,76 @@ class ProvisioningController:
         self, machines: List[SolvedMachine], opts: Optional[LaunchOptions] = None
     ) -> List[str]:
         """Parallel launch (provisioner.go:130-148); failures leave ""."""
+        names, _ = self._launch_machines_with_errors(machines, opts)
+        return names
+
+    @staticmethod
+    def _launch_retryable(err: Exception) -> bool:
+        """Failures a re-solve can beat: capacity outages (the offering is
+        now ICE-masked) and transient transport faults. Request defects
+        (IncompatibleRequirementsError), policy stops (limits exceeded,
+        provisioner deleted), and configuration errors (bare OSErrors like
+        PermissionError/FileNotFoundError from a vendor SDK) would re-fail
+        identically — no retrigger."""
+        if isinstance(err, IncompatibleRequirementsError):
+            return False
+        return isinstance(
+            err, (InsufficientCapacityError, ConnectionError, TimeoutError)
+        )
+
+    def _schedule_launch_retry(self, failures: int) -> None:
+        """Arm the next launch re-trigger deadline: jittered exponential
+        from the batch idle window on consecutive failures, capped at 30s."""
+        from karpenter_core_tpu.api.settings import current
+        from karpenter_core_tpu.utils.backoff import full_jitter
+
+        settings = self.batcher.settings or current()
+        base = max(settings.batch_idle_duration, 0.05)
+        self._schedule_launch_retry_in(
+            max(full_jitter(max(failures - 1, 0), base, cap=30.0), base)
+        )
+
+    def _schedule_launch_retry_in(self, delay: float) -> None:
+        import time as time_mod
+
+        self._launch_retry_at = time_mod.monotonic() + delay
+
+    def _maybe_fire_launch_retry(self) -> None:
+        """Fire a due launch re-trigger (called from the reconcile loop
+        before the batch wait; step()-mode passes solve unconditionally so
+        it never needs the trigger)."""
+        import time as time_mod
+
+        due_at = self._launch_retry_at
+        if due_at is not None and time_mod.monotonic() >= due_at:
+            self._launch_retry_at = None
+            self.batcher.trigger()
+
+    def _launch_machines_with_errors(
+        self, machines: List[SolvedMachine], opts: Optional[LaunchOptions] = None
+    ) -> Tuple[List[str], List[Exception]]:
+        """launch_machines + the per-machine exceptions (reconcile uses the
+        classification to decide whether a re-solve can make progress)."""
         opts = opts or LaunchOptions()
         if not machines:
-            return []
+            return [], []
         with concurrent.futures.ThreadPoolExecutor(max_workers=max(len(machines), 1)) as pool:
             futures = [pool.submit(self._launch_one, m, opts) for m in machines]
-            names = []
+            names: List[str] = []
+            errors: List[Exception] = []
             for f in futures:
                 try:
                     names.append(f.result())
-                except Exception:
+                except Exception as e:  # noqa: BLE001 — classified below
                     names.append("")
-        return names
+                    errors.append(e)
+                    if isinstance(e, InsufficientCapacityError):
+                        LAUNCH_FAILURES.inc({"reason": "insufficient_capacity"})
+                    elif self._launch_retryable(e):
+                        LAUNCH_FAILURES.inc({"reason": "transient"})
+                    else:
+                        LAUNCH_FAILURES.inc({"reason": "error"})
+        return names, errors
 
     def _launch_one(self, machine: SolvedMachine, opts: LaunchOptions) -> str:
         """provisioner.go:304-361."""
@@ -331,7 +445,15 @@ class ProvisioningController:
         template.requirements = Requirements(machine.requirements.values())
         template.requests = dict(machine.requests)
         machine_cr = template.to_machine()
-        created = self.cloud_provider.create(machine_cr)
+        try:
+            # chaos hook: the SPI edge every vendor launch crosses
+            chaos.maybe_fail(chaos.CLOUDPROVIDER_CREATE)
+            created = self.cloud_provider.create(machine_cr)
+        except InsufficientCapacityError as e:
+            # remember the exhausted offering so the retrigger's re-solve
+            # masks it instead of re-placing pods on the same dead pool
+            self.ice_cache.record(e)
+            raise
 
         # persist the launch-intent Machine record for the lifecycle
         # controllers (machine.Controller); named after the created node so

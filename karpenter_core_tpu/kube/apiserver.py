@@ -17,13 +17,16 @@ from __future__ import annotations
 
 import json
 import queue
+import random
 import ssl
 import threading
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
 from typing import Dict, List, Optional, Tuple
 
+from karpenter_core_tpu import chaos
 from karpenter_core_tpu.kube.client import (
     AlreadyExistsError,
     ConflictError,
@@ -31,6 +34,13 @@ from karpenter_core_tpu.kube.client import (
     _kind_of,
 )
 from karpenter_core_tpu.kube.serialization import from_k8s_dict, to_k8s_dict
+from karpenter_core_tpu.metrics.registry import NAMESPACE, REGISTRY
+
+KUBE_TRANSPORT_RETRIES = REGISTRY.counter(
+    f"{NAMESPACE}_kube_transport_retries_total",
+    "Apiserver requests retried after a transient transport failure "
+    "(5xx/429/timeout/connection-reset), by HTTP method",
+)
 
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
@@ -109,23 +119,118 @@ class UrllibTransport:
                 timeout=None if stream else timeout,
             )
         except urllib.error.HTTPError as e:
-            return e.code, e.read().decode(errors="replace")
+            # headers ride along so the retry layer can honor Retry-After
+            return e.code, e.read().decode(errors="replace"), dict(e.headers)
         if stream:
             return resp.status, resp  # caller iterates the body
         return resp.status, resp.read().decode()
 
 
-class ApiServerKubeClient:
-    """InMemoryKubeClient-compatible adapter over a live apiserver."""
+# HTTP statuses the retry layer treats as transient. 409 is deliberately
+# absent: a conflict is a SEMANTIC outcome (optimistic concurrency) the
+# callers' rebase logic owns — blind retry of the same stale PUT can never
+# succeed and would just burn the conflict window.
+TRANSIENT_HTTP = frozenset({429, 500, 502, 503, 504})
+# non-idempotent verbs retry a NARROWER set: 429/503 are pre-processing
+# rejections the apiserver itself sends (the request was not applied), but
+# 500/502/504 can come from a gateway AFTER the apiserver committed the
+# write — replaying an applied POST/DELETE turns success into a spurious
+# AlreadyExists/NotFound (client-go draws the same idempotency line)
+TRANSIENT_HTTP_NON_IDEMPOTENT = frozenset({429, 503})
 
-    def __init__(self, transport, scheme=None, default_namespace: str = "default"):
+
+class ApiServerKubeClient:
+    """InMemoryKubeClient-compatible adapter over a live apiserver.
+
+    Every non-streaming request rides a bounded retry loop: transient
+    transport failures (connection reset, timeout, 5xx, 429) back off
+    exponentially with full jitter and honor Retry-After — the client-go
+    rest.Client retry posture — so a blipping apiserver degrades a
+    reconcile's latency instead of failing it."""
+
+    def __init__(self, transport, scheme=None, default_namespace: str = "default",
+                 retry_attempts: int = 4, retry_base: float = 0.1,
+                 retry_max: float = 2.0, rng: Optional[random.Random] = None):
         from karpenter_core_tpu.api.scheme import default_scheme
 
         self.transport = transport
         self.scheme = scheme or default_scheme()
         self.default_namespace = default_namespace
+        self.retry_attempts = retry_attempts
+        self.retry_base = retry_base
+        self.retry_max = retry_max
+        self._rng = rng or random.Random()
         self._watch_threads: List[threading.Thread] = []
+        self._watch_cancels: Dict[int, threading.Event] = {}
+        self._watch_mu = threading.Lock()
         self._stop = threading.Event()
+
+    # -- transport with transient-failure retries ---------------------------
+
+    def _backoff(self, attempt: int, retry_after: Optional[str]) -> float:
+        """Exponential with full jitter (utils/backoff — N controllers
+        retrying the same blip must not re-land in lockstep); a parseable
+        Retry-After wins, capped at retry_max."""
+        if retry_after:
+            try:
+                return min(float(retry_after), self.retry_max)
+            except ValueError:
+                pass  # HTTP-date form: fall through to the backoff
+        from karpenter_core_tpu.utils.backoff import full_jitter
+
+        return full_jitter(attempt, self.retry_base, self.retry_max, self._rng)
+
+    def _request(self, method: str, path: str, body: Optional[dict] = None,
+                 params: Optional[dict] = None, timeout: float = 30.0,
+                 transient: Optional[frozenset] = None):
+        """One logical request; returns (status, body). Retries transient
+        failures; conflicts (409) and other 4xx return to the caller
+        untouched. `transient` narrows the retriable statuses for calls
+        whose semantics claim one of them (eviction's PDB 429); by default
+        it is the full set for GET and the not-applied-only subset for
+        write verbs (see TRANSIENT_HTTP_NON_IDEMPOTENT)."""
+        if transient is None:
+            transient = (
+                TRANSIENT_HTTP if method == "GET"
+                else TRANSIENT_HTTP_NON_IDEMPOTENT
+            )
+        attempt = 0
+        while True:
+            retry_after = None
+            try:
+                # chaos hook: the edge every apiserver round trip crosses;
+                # injected faults exercise THIS retry loop
+                chaos.maybe_fail(chaos.KUBE_TRANSPORT)
+                result = self.transport(
+                    method, path, body, params=params, timeout=timeout
+                )
+            except (ConnectionError, TimeoutError, OSError) as e:
+                # urllib.error.URLError (and socket.timeout) subclass
+                # OSError: connection refused/reset, DNS blips, timeouts.
+                # AMBIGUOUS failures (the request may have been applied
+                # before the connection died) are only retried for GET —
+                # replaying a POST/DELETE whose first copy landed turns a
+                # server-side success into a spurious AlreadyExists/
+                # NotFound (client-go retries connection errors for
+                # idempotent verbs only). A rejected-with-status request
+                # (the branch below) was NOT applied, so those retry for
+                # every verb.
+                if method != "GET" or attempt >= self.retry_attempts:
+                    raise
+                status = None
+            else:
+                status, resp_body = result[0], result[1]
+                headers = result[2] if len(result) > 2 else {}
+                if status not in transient or attempt >= self.retry_attempts:
+                    return status, resp_body
+                retry_after = {
+                    k.lower(): v for k, v in (headers or {}).items()
+                }.get("retry-after")
+            KUBE_TRANSPORT_RETRIES.inc({"method": method})
+            delay = self._backoff(attempt, retry_after)
+            attempt += 1
+            if delay > 0:
+                time.sleep(delay)
 
     @classmethod
     def in_cluster(cls, **kwargs):
@@ -184,14 +289,14 @@ class ApiServerKubeClient:
     def create(self, obj):
         kind = _kind_of(obj)
         ns = getattr(obj.metadata, "namespace", "")
-        status, body = self.transport("POST", self._path(kind, ns), self._encode(obj))
+        status, body = self._request("POST", self._path(kind, ns), self._encode(obj))
         if status == 409:
             raise AlreadyExistsError(f"{kind} {obj.metadata.name} already exists")
         self._raise_for(status, body, kind, obj.metadata.name)
         return self._decode(kind, json.loads(body))
 
     def get(self, kind: str, namespace: str, name: str):
-        status, body = self.transport("GET", self._path(kind, namespace, name))
+        status, body = self._request("GET", self._path(kind, namespace, name))
         if status == 404:
             return None
         self._raise_for(status, body, kind, name)
@@ -200,7 +305,7 @@ class ApiServerKubeClient:
     def update(self, obj):
         kind = _kind_of(obj)
         ns = getattr(obj.metadata, "namespace", "")
-        status, body = self.transport(
+        status, body = self._request(
             "PUT", self._path(kind, ns, obj.metadata.name), self._encode(obj)
         )
         if status == 409:
@@ -228,13 +333,13 @@ class ApiServerKubeClient:
         kind = _kind_of(obj)
         ns = getattr(obj.metadata, "namespace", "")
         path = self._path(kind, ns, obj.metadata.name) + "/status"
-        status, body = self.transport("PUT", path, self._encode(obj))
+        status, body = self._request("PUT", path, self._encode(obj))
         if status == 409:
             current = self.get(kind, ns, obj.metadata.name)
             if current is None:
                 raise NotFoundError(f"{kind} {obj.metadata.name} not found")
             obj.metadata.resource_version = current.metadata.resource_version
-            status, body = self.transport("PUT", path, self._encode(obj))
+            status, body = self._request("PUT", path, self._encode(obj))
             if status == 409:
                 raise ConflictError(
                     f"{kind} {obj.metadata.name} resource version conflict"
@@ -256,8 +361,12 @@ class ApiServerKubeClient:
             "kind": "Eviction",
             "metadata": {"name": name, "namespace": namespace},
         }
-        status, resp = self.transport(
-            "POST", self._path("Pod", namespace, name) + "/eviction", body
+        # a 429 here is SEMANTIC (the PDB has no disruptions left), not a
+        # rate limit: retrying at the transport layer would burn seconds
+        # per blocked eviction — the eviction queue owns the requeue
+        status, resp = self._request(
+            "POST", self._path("Pod", namespace, name) + "/eviction", body,
+            transient=TRANSIENT_HTTP_NON_IDEMPOTENT - {429},
         )
         if status == 404:
             return  # already gone: success
@@ -282,7 +391,7 @@ class ApiServerKubeClient:
             kind = _kind_of(obj_or_kind)
             namespace = getattr(obj_or_kind.metadata, "namespace", "")
             name = obj_or_kind.metadata.name
-        status, body = self.transport("DELETE", self._path(kind, namespace or "", name))
+        status, body = self._request("DELETE", self._path(kind, namespace or "", name))
         if status == 404:
             raise NotFoundError(f"{kind} {name} not found")
         self._raise_for(status, body, kind, name)
@@ -308,12 +417,12 @@ class ApiServerKubeClient:
         items: List[object] = []
         params = {"limit": str(self.LIST_LIMIT)}
         while True:
-            status, body = self.transport("GET", path, params=params)
+            status, body = self._request("GET", path, params=params)
             if status == 410 and "continue" in params:
                 # the snapshot behind the continue token expired (etcd
                 # compaction mid-pagination on a large cluster): fall back
                 # to ONE unpaginated full list, like client-go's ListPager
-                status, body = self.transport("GET", path)
+                status, body = self._request("GET", path)
                 self._raise_for(status, body, kind, "")
                 items = [
                     self._decode(kind, raw)
@@ -350,6 +459,15 @@ class ApiServerKubeClient:
         q: "queue.Queue" = queue.Queue()
         known: dict = {}  # (namespace, name) -> True, for deletion diffing
         last_rv = {"v": None}
+        # per-watch cancellation: unwatch() sets this so a relisting
+        # consumer (the operator's stale-stream recovery) can retire the
+        # old pump instead of leaking a thread + stream + orphan queue
+        cancel = threading.Event()
+        with self._watch_mu:
+            self._watch_cancels[id(q)] = cancel
+
+        def stopped() -> bool:
+            return self._stop.is_set() or cancel.is_set()
 
         def relist():
             current = {}
@@ -373,7 +491,7 @@ class ApiServerKubeClient:
 
         def pump():
             fresh = backlog  # initial list already ran when backlog=True
-            while not self._stop.is_set():
+            while not stopped():
                 try:
                     if not fresh:
                         relist()
@@ -381,15 +499,16 @@ class ApiServerKubeClient:
                     params = {"watch": "true"}
                     if last_rv["v"] is not None:
                         params["resourceVersion"] = str(last_rv["v"])
-                    status, resp = self.transport(
+                    result = self.transport(
                         "GET", self._path(kind), params=params, stream=True
                     )
+                    status, resp = result[0], result[1]  # HTTPError adds headers
                     if status != 200:
                         last_rv["v"] = None  # rv too old; force a relist
-                        self._stop.wait(2.0)
+                        cancel.wait(2.0)  # (global stop re-checked above)
                         continue
                     for line in resp:
-                        if self._stop.is_set():
+                        if stopped():
                             return
                         if not line.strip():
                             continue
@@ -407,15 +526,22 @@ class ApiServerKubeClient:
                             last_rv["v"] = max(int(last_rv["v"] or 0), int(rv))
                         q.put((etype, obj))
                 except Exception:
-                    self._stop.wait(2.0)  # stream dropped; relist on retry
+                    cancel.wait(2.0)  # stream dropped; relist on retry
 
         t = threading.Thread(target=pump, daemon=True)
         t.start()
         self._watch_threads.append(t)
         return q
 
-    def unwatch(self, kind: str, q) -> None:  # queues die with their pumps
-        pass
+    def unwatch(self, kind: str, q) -> None:
+        """Retire the queue's pump: its thread exits at the next event,
+        stream error, or reconnect attempt — a relisting consumer swapping
+        queues must not accumulate live pumps (best-effort: a pump blocked
+        mid-stream lingers until the stream next yields or drops)."""
+        with self._watch_mu:
+            cancel = self._watch_cancels.pop(id(q), None)
+        if cancel is not None:
+            cancel.set()
 
     def close(self) -> None:
         self._stop.set()

@@ -15,6 +15,7 @@ import threading
 import time
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
+from karpenter_core_tpu import chaos
 from karpenter_core_tpu.kube.objects import LabelSelector, NamespacedName
 
 WatchEvent = Tuple[str, object]  # ("ADDED"|"MODIFIED"|"DELETED", obj)
@@ -74,6 +75,7 @@ class InMemoryKubeClient:
     # -- CRUD -------------------------------------------------------------
 
     def create(self, obj) -> object:
+        chaos.maybe_fail(chaos.KUBE_TRANSPORT)
         kind = _kind_of(obj)
         if self.strict and not self.scheme.recognizes(kind):
             raise TypeError(f"kind {kind} is not registered in the scheme")
@@ -90,11 +92,13 @@ class InMemoryKubeClient:
             return copy.deepcopy(stored)
 
     def get(self, kind: str, namespace: str, name: str) -> Optional[object]:
+        chaos.maybe_fail(chaos.KUBE_TRANSPORT)
         with self._mu:
             obj = self._objects.get(kind, {}).get(NamespacedName(namespace, name))
             return copy.deepcopy(obj) if obj is not None else None
 
     def update(self, obj) -> object:
+        chaos.maybe_fail(chaos.KUBE_TRANSPORT)
         kind = _kind_of(obj)
         with self._mu:
             key = NamespacedName(obj.metadata.namespace, obj.metadata.name)
@@ -118,6 +122,7 @@ class InMemoryKubeClient:
         """PUT to the status subresource: persists ONLY obj.status (spec and
         metadata of the stored object are untouched, mirroring the apiserver,
         which ignores everything but status on /status writes)."""
+        chaos.maybe_fail(chaos.KUBE_TRANSPORT)
         kind = _kind_of(obj)
         with self._mu:
             key = NamespacedName(obj.metadata.namespace, obj.metadata.name)
@@ -176,6 +181,7 @@ class InMemoryKubeClient:
         finalizer list is empty, then removes — mirrors apiserver behavior the
         termination/machine controllers depend on.
         """
+        chaos.maybe_fail(chaos.KUBE_TRANSPORT)
         if isinstance(obj_or_kind, str):
             kind = obj_or_kind
         else:
@@ -269,6 +275,7 @@ class InMemoryKubeClient:
         objects handed out by a controller-runtime cache. The deprovisioning
         replan reads thousands of pods per cycle; cloning them dominated
         the whole ladder's host time."""
+        chaos.maybe_fail(chaos.KUBE_TRANSPORT)
         with self._mu:
             out = []
             for key, obj in self._objects.get(kind, {}).items():

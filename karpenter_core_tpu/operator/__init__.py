@@ -71,6 +71,15 @@ class Operator:
     # deflake hook: zero-arg callable injecting randomized delays into the
     # watch pumps (reference pkg/test/randomdelay.go:44-70); None in prod
     jitter: object = None
+    # watch staleness bound: a pump that has seen NO event for this many
+    # seconds relists (informer resync analog) so a silently-dead stream
+    # converges; 0 disables the periodic resync (fault-driven relists
+    # still fire). The default is deliberately long — a relist is a full
+    # LIST + redelivery per kind (50k objects at the design target), and
+    # the apiserver client's pump already reconnects/relists internally on
+    # stream drops, so this is a last-resort liveness net, not the primary
+    # recovery path (real informers resync on hours-scale defaults).
+    watch_relist_interval: float = 600.0
     _threads: List[threading.Thread] = field(default_factory=list)
     _stop: threading.Event = field(default_factory=threading.Event)
 
@@ -153,37 +162,117 @@ class Operator:
         import logging
         import queue as queue_mod
 
+        from karpenter_core_tpu import chaos
+        from karpenter_core_tpu.metrics.registry import NAMESPACE, REGISTRY
         from karpenter_core_tpu.operator.controller import RECONCILE_ERRORS
 
+        relists = REGISTRY.counter(
+            f"{NAMESPACE}_watch_relists_total",
+            "Watch relists after a dropped/stale stream or failed event "
+            "delivery, by kind (the informer list-then-watch recovery)",
+        )
         log = logging.getLogger("karpenter.operator")
         for kind, handler in watches:
             q = self.kube_client.watch(kind)
 
-            def pump(q=q, handler=handler, kind=kind):
+            def deliver(event, obj, handler=handler, kind=kind):
+                """One event through the informer + per-kind side effects —
+                shared by the live stream and the relist replay so recovery
+                re-drives the SAME reactions (pod batching, metric prune)."""
+                chaos.maybe_fail(chaos.STATE_WATCH)
+                handler(event, obj)
+                if kind == "Pod":
+                    if event != "DELETED":
+                        self.pod_controller.reconcile(obj)
+                    self.pod_metrics.reconcile(obj, deleted=event == "DELETED")
+                elif kind == "Provisioner":
+                    self.provisioner_metrics.reconcile(
+                        obj, deleted=event == "DELETED"
+                    )
+
+            def relist(known, deliver=deliver, kind=kind):
+                """Backlog relist after a gap (failed delivery, staleness
+                timeout): replay the store as MODIFIED and synthesize
+                DELETED for objects that vanished while deliveries were
+                failing, so the cluster state holds no ghosts. The existing
+                queue is KEPT — both client implementations keep their
+                subscriptions valid across gaps (the in-memory queue cannot
+                break; the apiserver pump reconnects-and-relists
+                internally), so resubscribing here would only leak pumps
+                and double-list. Replays may duplicate live events —
+                level-triggered consumers tolerate that."""
+                relists.inc({"kind": kind})
+                current = {}
+                for obj in self.kube_client.list(kind):
+                    key = (getattr(obj.metadata, "namespace", ""),
+                           obj.metadata.name)
+                    current[key] = True
+                    deliver("MODIFIED", obj)
+                for key in list(known):
+                    if key not in current:
+                        gone = self.kube_client.new_object(kind)
+                        gone.metadata.namespace, gone.metadata.name = key
+                        deliver("DELETED", gone)
+                known.clear()
+                known.update(current)
+
+            def pump(q=q, deliver=deliver, relist=relist, kind=kind):
+                known: dict = {}
+                last_event = time.monotonic()
                 while not self._stop.is_set():
                     try:
-                        event, obj = q.get(timeout=0.1)
-                    except queue_mod.Empty:
-                        continue
-                    try:
+                        try:
+                            event, obj = q.get(timeout=0.1)
+                        except queue_mod.Empty:
+                            # staleness: a stream that has gone silent past
+                            # the resync bound relists — a dead pump and a
+                            # quiet cluster look identical from here, and a
+                            # relist is cheap + idempotent for level-
+                            # triggered consumers
+                            if (
+                                self.watch_relist_interval
+                                and time.monotonic() - last_event
+                                >= self.watch_relist_interval
+                            ):
+                                relist(known)
+                                last_event = time.monotonic()
+                            continue
+                        last_event = time.monotonic()
                         # deflake hook: the test harness injects randomized
                         # delays here to shake out pump/singleton races
                         # (reference randomdelay.go:44-70, make deflake)
                         jitter = self.jitter
                         if jitter is not None:
                             jitter()
-                        handler(event, obj)
-                        if kind == "Pod":
-                            if event != "DELETED":
-                                self.pod_controller.reconcile(obj)
-                            self.pod_metrics.reconcile(obj, deleted=event == "DELETED")
-                        elif kind == "Provisioner":
-                            self.provisioner_metrics.reconcile(
-                                obj, deleted=event == "DELETED"
-                            )
+                        deliver(event, obj)
+                        # track known keys only AFTER a successful delivery:
+                        # a failed DELETED delivery must keep its key so the
+                        # recovery relist still diffs it into a synthetic
+                        # DELETED instead of leaving a ghost
+                        key = (getattr(obj.metadata, "namespace", ""),
+                               obj.metadata.name)
+                        if event == "DELETED":
+                            known.pop(key, None)
+                        else:
+                            known[key] = True
                     except Exception:
                         RECONCILE_ERRORS.inc(labels={"controller": f"watch-{kind}"})
                         log.exception("watch pump failed (kind=%s)", kind)
+                        # the failed event is lost from the stream's point
+                        # of view: recover by relisting so the store state
+                        # (including whatever that event carried) lands —
+                        # retried until it sticks (degrade, never stall; a
+                        # watch_relist_interval of 0 must still converge)
+                        while not self._stop.is_set():
+                            try:
+                                relist(known)
+                                last_event = time.monotonic()
+                                break
+                            except Exception:
+                                log.exception(
+                                    "watch relist failed (kind=%s)", kind
+                                )
+                                self._stop.wait(0.2)
 
             t = threading.Thread(target=pump, daemon=True)
             t.start()

@@ -11,6 +11,9 @@ metrics endpoints the deployment probes:
   KARPENTER_SOLVER_ENDPOINT      host:port of the gRPC TPU solver; unset ->
                                  in-process TPUSolver (single-process mode)
   KARPENTER_METRICS_PORT         /metrics /healthz /readyz port (default 8000)
+  KARPENTER_CHAOS                fault-injection spec (docs/robustness.md);
+                                 armed at import, unset in production
+  KARPENTER_CHAOS_SEED           default per-point RNG seed for the spec
 
 The karpenter-global-settings ConfigMap, when present in the kube store,
 overrides the env defaults (the reference's dynamic-settings path,

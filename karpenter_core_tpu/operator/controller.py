@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import logging
 import queue
+import random
 import threading
 import time
 from typing import Callable, Optional
@@ -49,12 +50,16 @@ class Singleton:
         reconcile: Callable[[], Optional[float]],
         interval: float = 1.0,
         clock=time.time,
+        rng: Optional[random.Random] = None,
     ):
         self.name = name
         self.reconcile = reconcile
         self.interval = interval
         self.clock = clock
         self._failures = 0
+        self._rng = rng or random.Random()
+        # decorrelated-jitter state: last backoff actually slept
+        self._last_backoff = ERROR_BACKOFF_BASE
         self._thread: Optional[threading.Thread] = None
 
     def reconcile_once(self) -> Optional[float]:
@@ -72,10 +77,19 @@ class Singleton:
         except Exception:
             RECONCILE_ERRORS.inc(labels={"controller": self.name})
             self._failures += 1
-            backoff = min(
-                ERROR_BACKOFF_BASE * (2 ** min(self._failures, 24)),
-                ERROR_BACKOFF_MAX,
+            # decorrelated jitter (utils/backoff; the run-loop jitter
+            # hook's shape, operator/__init__.py): sleep ~ U(base,
+            # 3 * last_sleep), capped. N controllers failing on the same
+            # dead apiserver spread out instead of thundering-herding it in
+            # lockstep every 10s — and the expected sleep still grows
+            # geometrically like the old pure-exponential ladder.
+            from karpenter_core_tpu.utils.backoff import decorrelated_jitter
+
+            backoff = decorrelated_jitter(
+                self._last_backoff, ERROR_BACKOFF_BASE, ERROR_BACKOFF_MAX,
+                self._rng,
             )
+            self._last_backoff = max(backoff, ERROR_BACKOFF_BASE)
             LOG.exception(
                 "reconcile failed (controller=%s, failures=%d, backoff=%.3fs)",
                 self.name, self._failures, backoff,
@@ -86,6 +100,7 @@ class Singleton:
                 time.perf_counter() - start, labels={"controller": self.name}
             )
         self._failures = 0
+        self._last_backoff = ERROR_BACKOFF_BASE
         return self.interval if requeue_after is None else requeue_after
 
     def start(self, stop: threading.Event) -> threading.Thread:

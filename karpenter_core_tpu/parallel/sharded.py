@@ -711,6 +711,11 @@ class ShardedSolver:
             )
             while len(self._compiled) > self.MAX_COMPILED:
                 self._compiled.popitem(last=False)
+            # chaos hook: the multi-chip accelerator edge (same point as
+            # TPUSolver._run_kernels — one name covers "the device path")
+            from karpenter_core_tpu import chaos
+
+            chaos.maybe_fail(chaos.SOLVER_DEVICE)
             with mesh:
                 log, ptr, state, _scheduled = fn(*args)
                 jax.block_until_ready(log)

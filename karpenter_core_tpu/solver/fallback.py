@@ -47,6 +47,88 @@ SOLVER_SMALL_BATCH_TOTAL = REGISTRY.counter(
     "Solves routed to the host FFD because the batch was below the "
     "small-batch work product (the device path's fixed cost would dominate)",
 )
+BREAKER_TRANSITIONS = REGISTRY.counter(
+    f"{NAMESPACE}_circuit_breaker_transitions_total",
+    "Circuit-breaker state transitions, by breaker name and target state",
+)
+BREAKER_OPEN = REGISTRY.gauge(
+    f"{NAMESPACE}_circuit_breaker_open",
+    "1 while the named circuit breaker is open (fast-failing), else 0",
+)
+
+
+class CircuitBreaker:
+    """Consecutive-failure circuit breaker with a half-open TTL.
+
+    Protects a remote dependency (the gRPC solver service) the way the
+    ResilientSolver's health TTLs protect the accelerator backend: after
+    `failure_threshold` consecutive transport failures the breaker OPENS
+    and callers fail fast (no RPC, no timeout wait — the local fallback
+    takes over immediately); after `reset_timeout` it HALF-OPENS, letting
+    exactly one trial call through — success closes it, failure re-opens
+    and restarts the TTL. Thread-safe: solves and background health probes
+    share one breaker."""
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half_open"
+
+    def __init__(self, name: str = "solver.rpc", failure_threshold: int = 3,
+                 reset_timeout: float = 30.0, clock=time.time):
+        self.name = name
+        self.failure_threshold = failure_threshold
+        self.reset_timeout = reset_timeout
+        self.clock = clock
+        self._mu = threading.Lock()
+        self._state = self.CLOSED
+        self._failures = 0
+        self._opened_at = 0.0
+
+    def _transition(self, state: str) -> None:
+        if state != self._state:
+            self._state = state
+            BREAKER_TRANSITIONS.inc({"breaker": self.name, "to": state})
+            BREAKER_OPEN.set(
+                1.0 if state == self.OPEN else 0.0, {"breaker": self.name}
+            )
+
+    @property
+    def state(self) -> str:
+        with self._mu:
+            self._maybe_half_open()
+            return self._state
+
+    def _maybe_half_open(self) -> None:
+        if (
+            self._state == self.OPEN
+            and self.clock() - self._opened_at >= self.reset_timeout
+        ):
+            self._transition(self.HALF_OPEN)
+
+    def allow(self) -> bool:
+        """May a call proceed? Half-open admits ONE trial (subsequent
+        callers stay fast-failed until the trial reports)."""
+        with self._mu:
+            self._maybe_half_open()
+            if self._state == self.CLOSED:
+                return True
+            if self._state == self.HALF_OPEN:
+                # admit one probe; treat the slot as taken by re-opening the
+                # TTL window so a hung trial doesn't let callers pile on
+                self._transition(self.OPEN)
+                self._opened_at = self.clock()
+                return True
+            return False
+
+    def record_success(self) -> None:
+        with self._mu:
+            self._failures = 0
+            self._transition(self.CLOSED)
+
+    def record_failure(self) -> None:
+        with self._mu:
+            self._failures += 1
+            if self._failures >= self.failure_threshold:
+                self._transition(self.OPEN)
+                self._opened_at = self.clock()
 
 
 def probe_backend(timeout: float = 60.0) -> Optional[str]:
@@ -304,8 +386,17 @@ class ResilientSolver:
                 **kwargs,
             )
         except Exception as e:  # noqa: BLE001 — degrade, never stall
-            self._mark_dead(f"{type(e).__name__}: {e}")
-            SOLVER_FALLBACK_TOTAL.inc({"reason": "primary_error"})
+            # typed solver-RPC errors classify themselves: a REQUEST defect
+            # (INVALID_ARGUMENT / RESOURCE_EXHAUSTED) means the backend is
+            # fine and must not be marked dead — this solve falls back, the
+            # next one goes to the primary again. Transport/internal
+            # failures (and everything untyped) mark the backend dead as
+            # before.
+            if getattr(e, "marks_unhealthy", True):
+                self._mark_dead(f"{type(e).__name__}: {e}")
+                SOLVER_FALLBACK_TOTAL.inc({"reason": "primary_error"})
+            else:
+                SOLVER_FALLBACK_TOTAL.inc({"reason": "request_rejected"})
             return self._fallback_solve(
                 pods, provisioners, instance_types, daemonset_pods,
                 state_nodes, kube_client, cluster,

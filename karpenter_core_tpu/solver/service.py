@@ -17,11 +17,14 @@ from __future__ import annotations
 import copy
 import json
 import threading
+import time
 from concurrent import futures
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from karpenter_core_tpu import chaos
+from karpenter_core_tpu.metrics.registry import NAMESPACE, REGISTRY
 from karpenter_core_tpu.obs import TRACE_HEADER, TRACER
 from karpenter_core_tpu.solver import service_pb2 as pb
 from karpenter_core_tpu.solver.encode import encode_snapshot
@@ -34,6 +37,88 @@ from karpenter_core_tpu.solver.tpu_solver import (
 )
 
 SERVICE = "karpenter.solver.v1.Solver"
+
+SOLVER_RPC_RETRIES = REGISTRY.counter(
+    f"{NAMESPACE}_solver_rpc_retries_total",
+    "Solver RPCs retried after a transient failure (UNAVAILABLE/"
+    "DEADLINE_EXCEEDED)",
+)
+
+
+# ---------------------------------------------------------------------------
+# typed RPC errors — what the client raises, what the circuit breaker and
+# ResilientSolver classify (ISSUE 2 satellite: no more stringified
+# exceptions in the response the caller has to regex)
+
+
+class SolverRpcError(RuntimeError):
+    """Base typed solver-service failure.
+
+    `transient` drives the client's bounded retry + the circuit breaker
+    (transport-shaped: the SAME request may succeed on a healthy channel);
+    `marks_unhealthy` drives ResilientSolver — a request defect must not
+    condemn a healthy backend to the fallback path."""
+
+    code_name = "UNKNOWN"
+    transient = False
+    marks_unhealthy = True
+
+
+class SolverUnavailableError(SolverRpcError):
+    code_name = "UNAVAILABLE"
+    transient = True
+
+
+class SolverDeadlineExceededError(SolverRpcError):
+    code_name = "DEADLINE_EXCEEDED"
+    transient = True
+
+
+class SolverInvalidArgumentError(SolverRpcError):
+    code_name = "INVALID_ARGUMENT"
+    marks_unhealthy = False
+
+
+class SolverResourceExhaustedError(SolverRpcError):
+    code_name = "RESOURCE_EXHAUSTED"
+    marks_unhealthy = False
+
+
+class SolverInternalError(SolverRpcError):
+    code_name = "INTERNAL"
+
+
+_ERROR_BY_CODE = {
+    cls.code_name: cls
+    for cls in (
+        SolverUnavailableError,
+        SolverDeadlineExceededError,
+        SolverInvalidArgumentError,
+        SolverResourceExhaustedError,
+        SolverInternalError,
+    )
+}
+
+
+def classify_exception(e: Exception) -> Tuple[str, str]:
+    """Server-side: exception -> (gRPC status-code name, detail). Request
+    defects (malformed geometry/tensors) are INVALID_ARGUMENT; memory/slot
+    exhaustion is RESOURCE_EXHAUSTED; everything else INTERNAL."""
+    msg = f"{type(e).__name__}: {e}"
+    if isinstance(e, (ValueError, KeyError, TypeError, IndexError)):
+        return "INVALID_ARGUMENT", msg
+    if isinstance(e, MemoryError) or "RESOURCE_EXHAUSTED" in str(e):
+        return "RESOURCE_EXHAUSTED", msg
+    return "INTERNAL", msg
+
+
+def error_from_string(error: str) -> SolverRpcError:
+    """Client-side: the legacy response.error field (populated when the
+    server handler runs without a gRPC context, i.e. direct in-process
+    calls) -> typed error. The server writes 'CODE: detail'."""
+    code = error.split(":", 1)[0].strip()
+    cls = _ERROR_BY_CODE.get(code, SolverInternalError)
+    return cls(error)
 
 
 # ---------------------------------------------------------------------------
@@ -167,7 +252,21 @@ class SolverService:
             "solver.service.solve", trace_id=trace_id,
             tensors=len(request.tensors),
         ):
-            return self._solve_traced(request)
+            try:
+                return self._solve_traced(request)
+            except Exception as e:  # noqa: BLE001 — mapped to a status code
+                code_name, msg = classify_exception(e)
+                if context is not None:
+                    import grpc
+
+                    # PROPER status codes over the wire (not a stringified
+                    # exception the client must regex): the client maps the
+                    # code back to a typed error the circuit breaker and
+                    # ResilientSolver classify. abort() raises.
+                    context.abort(getattr(grpc.StatusCode, code_name), msg)
+                # no context: direct in-process call (tests, embedding) —
+                # the legacy error field carries the same classification
+                return pb.SolveResponse(error=f"{code_name}: {msg}")
 
     def _solve_traced(self, request: pb.SolveRequest) -> pb.SolveResponse:
         import jax
@@ -175,68 +274,65 @@ class SolverService:
         from karpenter_core_tpu.ops.topology import TopoGroupMeta, TopoMeta
         from karpenter_core_tpu.utils.compilecache import record_lookup
 
-        try:
-            geometry = json.loads(request.geometry)
-            tensors = {t.name: tensor_from_pb(t) for t in request.tensors}
-            args = _unflatten_args(tensors)
-            segments = [tuple(s) for s in geometry["segments"]]
-            zone_seg = tuple(geometry["zone_seg"])
-            ct_seg = tuple(geometry["ct_seg"])
-            topo_meta = None
-            if geometry.get("topo_groups"):
-                topo_meta = TopoMeta(
-                    groups=[
-                        TopoGroupMeta(
-                            gtype=g["gtype"],
-                            seg=tuple(g["seg"]),
-                            key_k=g["key_k"],
-                            max_skew=g["max_skew"],
-                            is_hostname=g["is_hostname"],
-                            is_inverse=g["is_inverse"],
-                            filter_term_rows=list(g["filter_term_rows"]),
-                        )
-                        for g in geometry["topo_groups"]
-                    ]
-                )
-            if self.mesh is not None:
-                log, ptr, state, count_split = self._solve_sharded(
-                    request.geometry, geometry, args, topo_meta,
-                    segments, zone_seg, ct_seg,
-                )
-                out = [
-                    tensor_to_pb("ptr", np.asarray(ptr)),
-                    tensor_to_pb("count_split", np.asarray(count_split)),
-                ]
-            else:
-                key = (request.geometry,)
-                with self._mu:
-                    fn = self._compiled.get(key)
-                    if fn is not None:
-                        self._compiled.move_to_end(key)
-                record_lookup("service", fn is not None)
-                if fn is None:
-                    fn = jax.jit(
-                        make_device_run(
-                            segments, zone_seg, ct_seg, topo_meta, geometry["n_slots"],
-                            log_len=geometry.get("log_len"),
-                            screen_v=geometry.get("screen_v"),
-                        )
+        geometry = json.loads(request.geometry)
+        tensors = {t.name: tensor_from_pb(t) for t in request.tensors}
+        args = _unflatten_args(tensors)
+        segments = [tuple(s) for s in geometry["segments"]]
+        zone_seg = tuple(geometry["zone_seg"])
+        ct_seg = tuple(geometry["ct_seg"])
+        topo_meta = None
+        if geometry.get("topo_groups"):
+            topo_meta = TopoMeta(
+                groups=[
+                    TopoGroupMeta(
+                        gtype=g["gtype"],
+                        seg=tuple(g["seg"]),
+                        key_k=g["key_k"],
+                        max_skew=g["max_skew"],
+                        is_hostname=g["is_hostname"],
+                        is_inverse=g["is_inverse"],
+                        filter_term_rows=list(g["filter_term_rows"]),
                     )
-                    with self._mu:
-                        self._compiled[key] = fn
-                        while len(self._compiled) > self.MAX_COMPILED:
-                            self._compiled.popitem(last=False)
-                log, ptr, state = fn(*args)
-                out = [tensor_to_pb("ptr", np.asarray(ptr))]
-            for name, value in log.items():
-                out.append(tensor_to_pb(f"log/{name}", np.asarray(value)))
-            for field, value in state._asdict().items():
-                out.append(tensor_to_pb(f"state/{field}", np.asarray(value)))
+                    for g in geometry["topo_groups"]
+                ]
+            )
+        if self.mesh is not None:
+            log, ptr, state, count_split = self._solve_sharded(
+                request.geometry, geometry, args, topo_meta,
+                segments, zone_seg, ct_seg,
+            )
+            out = [
+                tensor_to_pb("ptr", np.asarray(ptr)),
+                tensor_to_pb("count_split", np.asarray(count_split)),
+            ]
+        else:
+            key = (request.geometry,)
             with self._mu:
-                self.solves += 1
-            return pb.SolveResponse(tensors=out)
-        except Exception as e:  # surface errors to the client
-            return pb.SolveResponse(error=f"{type(e).__name__}: {e}")
+                fn = self._compiled.get(key)
+                if fn is not None:
+                    self._compiled.move_to_end(key)
+            record_lookup("service", fn is not None)
+            if fn is None:
+                fn = jax.jit(
+                    make_device_run(
+                        segments, zone_seg, ct_seg, topo_meta, geometry["n_slots"],
+                        log_len=geometry.get("log_len"),
+                        screen_v=geometry.get("screen_v"),
+                    )
+                )
+                with self._mu:
+                    self._compiled[key] = fn
+                    while len(self._compiled) > self.MAX_COMPILED:
+                        self._compiled.popitem(last=False)
+            log, ptr, state = fn(*args)
+            out = [tensor_to_pb("ptr", np.asarray(ptr))]
+        for name, value in log.items():
+            out.append(tensor_to_pb(f"log/{name}", np.asarray(value)))
+        for field, value in state._asdict().items():
+            out.append(tensor_to_pb(f"state/{field}", np.asarray(value)))
+        with self._mu:
+            self.solves += 1
+        return pb.SolveResponse(tensors=out)
 
     def _solve_sharded(self, geometry_key: str, geometry: dict, args,
                        topo_meta, segments, zone_seg, ct_seg):
@@ -345,16 +441,32 @@ def serve(address: str = "127.0.0.1:0", max_workers: int = 4, mesh=None):
 
 class RemoteSolver:
     """Solver-interface client: encode locally, solve remotely, decode
-    locally. Falls back to raising on transport errors (the provisioning
-    controller's fallback_solver takes over)."""
+    locally.
+
+    Transport hardening (ISSUE 2): every Solve RPC carries a deadline
+    (`timeout`), transient failures (UNAVAILABLE / DEADLINE_EXCEEDED)
+    retry `rpc_retries` times with exponential backoff + jitter, and a
+    consecutive-failure circuit breaker fails fast while the service is
+    down — so the ResilientSolver wrapping this client degrades to the
+    local fallback in microseconds instead of waiting out a dead
+    channel's timeout on every batch. Health RPCs bypass the breaker and
+    close it on success (the half-open recovery probe)."""
 
     def __init__(self, target: str, max_nodes: int = 1024,
                  max_relax_rounds: int = None,
-                 timeout: float = 120.0):
+                 timeout: float = 120.0,
+                 rpc_retries: int = 2, rpc_retry_base: float = 0.05,
+                 breaker=None):
         import grpc
 
+        from karpenter_core_tpu.solver.fallback import CircuitBreaker
+
+        self.target = target
         self.channel = grpc.insecure_channel(target)
         self.timeout = timeout
+        self.rpc_retries = rpc_retries
+        self.rpc_retry_base = rpc_retry_base
+        self.breaker = breaker or CircuitBreaker(name="solver.rpc")
         self.max_nodes = max_nodes
         if max_relax_rounds is None:
             from karpenter_core_tpu.solver.tpu_solver import DEFAULT_MAX_RELAX_ROUNDS
@@ -377,8 +489,79 @@ class RemoteSolver:
 
     def health(self, timeout: float = 30.0) -> pb.HealthResponse:
         # generous default: the server's first jax.devices() call initializes
-        # the TPU backend, which can take tens of seconds cold
-        return self._health(pb.HealthRequest(), timeout=timeout)
+        # the TPU backend, which can take tens of seconds cold.
+        # Deliberately NOT gated by the breaker: this is the half-open
+        # recovery probe — ResilientSolver re-probes on its TTL, and a
+        # success here closes the breaker so the next solve goes remote.
+        try:
+            response = self._health(pb.HealthRequest(), timeout=timeout)
+        except Exception:
+            self.breaker.record_failure()
+            raise
+        self.breaker.record_success()
+        return response
+
+    def _map_rpc_error(self, e) -> SolverRpcError:
+        """grpc.RpcError -> typed error by status code."""
+        import grpc
+
+        code = e.code() if hasattr(e, "code") else None
+        details = e.details() if hasattr(e, "details") else str(e)
+        name = code.name if isinstance(code, grpc.StatusCode) else "UNKNOWN"
+        cls = _ERROR_BY_CODE.get(name, SolverInternalError)
+        err = cls(f"solver service {name}: {details}")
+        err.__cause__ = e
+        return err
+
+    def _invoke_solve(self, request: pb.SolveRequest, metadata):
+        """One Solve RPC through the breaker + bounded transient retry."""
+        import grpc
+
+        attempt = 0
+        while True:
+            if not self.breaker.allow():
+                raise SolverUnavailableError(
+                    f"solver circuit breaker open (service at {self.target})"
+                )
+            try:
+                # chaos hook INSIDE the try: injected faults (typed solver
+                # errors) exercise the same classification as wire errors
+                chaos.maybe_fail(chaos.SOLVER_RPC)
+                response = self._solve(
+                    request, timeout=self.timeout, metadata=metadata
+                )
+            except grpc.RpcError as e:
+                err = self._map_rpc_error(e)
+            except SolverRpcError as e:
+                err = e
+            else:
+                self.breaker.record_success()
+                return response
+            if not err.transient and not isinstance(err, SolverInternalError):
+                # INVALID_ARGUMENT / RESOURCE_EXHAUSTED are server-PROCESSED
+                # responses: the channel is demonstrably up, so a half-open
+                # trial ending here must CLOSE the breaker (and a closed one
+                # must not drift toward open) even though the request failed
+                self.breaker.record_success()
+            if err.transient:
+                self.breaker.record_failure()
+                if attempt < self.rpc_retries:
+                    SOLVER_RPC_RETRIES.inc()
+                    # exponential backoff with full jitter (utils/backoff):
+                    # N control planes retrying one dead service must not
+                    # re-land in lockstep
+                    from karpenter_core_tpu.utils.backoff import full_jitter
+
+                    time.sleep(
+                        full_jitter(attempt, self.rpc_retry_base, cap=2.0)
+                    )
+                    attempt += 1
+                    continue
+            elif isinstance(err, SolverInternalError):
+                # server-side crashes count toward the breaker too — a
+                # crash-looping service should fail fast, not be hammered
+                self.breaker.record_failure()
+            raise err
 
     def encode(self, pods, provisioners, instance_types, daemonset_pods=None,
                state_nodes=None, kube_client=None, cluster=None):
@@ -444,11 +627,9 @@ class RemoteSolver:
         with TRACER.span("solver.service.request") as sp:
             trace_id = getattr(sp, "trace_id", None) or TRACER.current_trace_id()
             metadata = ((TRACE_HEADER, trace_id),) if trace_id else None
-            response = self._solve(
-                request, timeout=self.timeout, metadata=metadata
-            )
+            response = self._invoke_solve(request, metadata)
         if response.error:
-            raise RuntimeError(f"solver service error: {response.error}")
+            raise error_from_string(response.error)
         tensors = {t.name: tensor_from_pb(t) for t in response.tensors}
         log = {k[len("log/"):]: v for k, v in tensors.items() if k.startswith("log/")}
         state = _StateView(

@@ -23,6 +23,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from karpenter_core_tpu import chaos
 from karpenter_core_tpu.api.provisioner import Provisioner
 from karpenter_core_tpu.cloudprovider.types import InstanceType
 from karpenter_core_tpu.controllers.provisioning.scheduling.machine import MachineTemplate
@@ -652,6 +653,11 @@ class TPUSolver:
 
         import jax
         import jax.numpy as jnp
+
+        # chaos hook: the accelerator edge — an injected fault here is the
+        # wedged-backend failure that cost two bench rounds, and must route
+        # the solve to ResilientSolver's fallback, never stall the loop
+        chaos.maybe_fail(chaos.SOLVER_DEVICE)
 
         phases = self.last_phase_ms = {}
         t_phase = _time.perf_counter_ns()

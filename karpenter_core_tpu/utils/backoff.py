@@ -1,0 +1,38 @@
+"""THE jittered-backoff policies — one implementation for every retry site
+(apiserver transport, solver RPC, singleton reconcile loops, launch
+retriggers), so cap semantics and herd behavior are tuned in one place.
+
+Two shapes, per the AWS architecture-blog taxonomy the reference's
+workqueue rate limiters embody:
+
+  * full_jitter: sleep ~ U(0, min(cap, base * 2^attempt)) — the default
+    for bounded retry loops; spreads N clients retrying one blip across
+    the whole window.
+  * decorrelated_jitter: sleep ~ U(base, prev * 3), capped — for
+    long-lived loops (singleton reconcilers) where each client's NEXT
+    sleep should depend on its own last sleep, not a shared attempt
+    counter, so fleets never re-synchronize.
+"""
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+_MODULE_RNG = random.Random()
+
+
+def full_jitter(attempt: int, base: float, cap: float,
+                rng: Optional[random.Random] = None) -> float:
+    """Exponential backoff with full jitter: U(0, min(cap, base*2^attempt)).
+    attempt is 0-based (the first RETRY passes 0)."""
+    rng = rng or _MODULE_RNG
+    return rng.uniform(0.0, min(cap, base * (2 ** attempt)))
+
+
+def decorrelated_jitter(prev: float, base: float, cap: float,
+                        rng: Optional[random.Random] = None) -> float:
+    """Decorrelated jitter: U(base, prev*3), capped. Feed the returned
+    value back as `prev` on the next failure; reset prev to base on
+    success."""
+    rng = rng or _MODULE_RNG
+    return min(rng.uniform(base, max(prev, base) * 3), cap)

@@ -22,3 +22,11 @@ if len(jax.devices()) < 8:  # honor a pre-set device-count flag if present
     jax.config.update("jax_num_cpu_devices", 8)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running schedule-based chaos cases (tier-1 runs -m 'not slow'; "
+        "`make chaos` includes them)",
+    )

@@ -225,6 +225,28 @@ def test_watch_streams_events(client):
     c.close()
 
 
+def test_unwatch_retires_the_pump(client):
+    """unwatch() must actually cancel the queue's pump thread — the
+    operator's stale-stream relist swaps queues, and a no-op unwatch would
+    leak one live pump (thread + stream + growing orphan queue) per
+    relist."""
+    import time as time_mod
+
+    _, c = client
+    q = c.watch("Pod", backlog=False)
+    assert c._watch_cancels, "watch must register a cancellation handle"
+    c.unwatch("Pod", q)
+    assert id(q) not in c._watch_cancels
+    deadline = time_mod.monotonic() + 5.0
+    while time_mod.monotonic() < deadline:
+        if not any(t.is_alive() for t in c._watch_threads):
+            break
+        time_mod.sleep(0.05)
+    assert not any(t.is_alive() for t in c._watch_threads), (
+        "unwatched pump thread must exit"
+    )
+
+
 def test_operator_runs_over_apiserver_adapter(client):
     """The whole control plane drives through the adapter: provisioner +
     pending pods created over the REST transport, one op.step() launches

@@ -1,0 +1,324 @@
+"""Chaos suite: each fault point armed against a FULL operator loop
+(FakeCloudProvider + InMemoryKubeClient, background watch pumps + singleton
+reconcilers). The acceptance contract per ISSUE 2: pods still get
+scheduled, the chaos/retry/ICE counters tick, and no reconcile loop dies.
+
+"Scheduled" here means what the reference means by a converged
+provisioning pass: a fresh Solve of the pending pods needs NO new machines
+and reports NO failed pods — every pod fits on capacity the loop launched
+(binding is the kubelet/kube-scheduler's job, out of scope for the control
+plane)."""
+import time
+
+import pytest
+
+from karpenter_core_tpu import chaos
+from karpenter_core_tpu.api.settings import Settings
+from karpenter_core_tpu.chaos import CHAOS_INJECTED_TOTAL
+from karpenter_core_tpu.cloudprovider import fake
+from karpenter_core_tpu.cloudprovider.types import InsufficientCapacityError
+from karpenter_core_tpu.operator import new_operator
+from karpenter_core_tpu.testing import FakeClock, make_pod, make_provisioner
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+def make_operator(cp, relist_interval=0.3):
+    op = new_operator(
+        cp,
+        settings=Settings(batch_idle_duration=0.02, batch_max_duration=0.2),
+    )
+    op.watch_relist_interval = relist_interval
+    return op
+
+
+def all_covered(op) -> bool:
+    """A converged control plane: re-solving the pending pods needs no new
+    capacity and strands nobody."""
+    op.sync_state()
+    result = op.provisioning.schedule()
+    return result is None or (
+        not result.new_machines and not result.failed_pods
+    )
+
+
+def wait_for(cond, timeout=20.0, poll=0.05) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        try:
+            if cond():
+                return True
+        except Exception:  # noqa: BLE001 — cond may trip an armed fault
+            pass
+        time.sleep(poll)
+    return False
+
+
+def assert_no_dead_loops(op):
+    """Every pump and singleton thread must still be running — a fault that
+    kills a reconcile loop is exactly the failure this subsystem exists to
+    rule out."""
+    assert op._threads, "operator must have started its loops"
+    dead = [t.name for t in op._threads if not t.is_alive()]
+    assert not dead, f"reconcile loops died: {dead}"
+
+
+# -- cloudprovider.create ----------------------------------------------------
+
+
+def test_create_fails_three_then_recovers_all_pods_schedule():
+    """The acceptance scenario: cloudprovider.create fails 3 times with a
+    transient transport error, then recovers. The launch retry re-solves
+    the residual pods (batcher retrigger) and every pod ends up covered."""
+    cp = fake.FakeCloudProvider(fake.instance_types(8))
+    op = make_operator(cp)
+    fault = chaos.arm(chaos.CLOUDPROVIDER_CREATE, error="conn", times=3)
+    op.kube_client.create(make_provisioner(name="default"))
+    op.start()
+    try:
+        for i in range(10):
+            op.kube_client.create(make_pod(name=f"chaos-p{i}", requests={"cpu": "1"}))
+        assert wait_for(
+            lambda: fault.injected >= 3 and op.kube_client.list("Machine")
+        ), "launches must recover after the injected failures"
+        assert wait_for(lambda: all_covered(op)), "all pods must schedule"
+        assert_no_dead_loops(op)
+    finally:
+        op.stop()
+    assert fault.injected == 3
+    assert CHAOS_INJECTED_TOTAL.get(
+        {"point": chaos.CLOUDPROVIDER_CREATE, "error": "conn"}
+    ) >= 3
+    assert all_covered(op)
+
+
+@pytest.mark.slow
+def test_kube_transport_flaking_at_10pct_still_schedules():
+    """kube.transport at a 10% seeded error rate across EVERY client call:
+    singleton backoff + watch relists keep the loop level-triggered and all
+    pods schedule; nothing dies."""
+    cp = fake.FakeCloudProvider(fake.instance_types(8))
+    op = make_operator(cp)
+    op.kube_client.create(make_provisioner(name="default"))
+    fault = chaos.arm(
+        chaos.KUBE_TRANSPORT, error="conn", probability=0.1, seed=42
+    )
+    op.start()
+    try:
+        created = 0
+        for i in range(20):
+            # the test's own creates ride the flaky client too: retry them
+            # the way an external controller would
+            for _ in range(50):
+                try:
+                    op.kube_client.create(
+                        make_pod(name=f"flaky-p{i}", requests={"cpu": "1"})
+                    )
+                    created += 1
+                    break
+                except ConnectionResetError:
+                    continue
+        assert created == 20
+        # convergence check runs while faults are still armed: wait_for
+        # swallows injected errors and keeps polling — the condition must
+        # eventually pass THROUGH the flaky transport
+        assert wait_for(lambda: all_covered(op), timeout=40.0), (
+            "all pods must schedule through a 10%-flaky apiserver"
+        )
+        assert_no_dead_loops(op)
+    finally:
+        op.stop()
+        chaos.reset()
+    assert fault.injected > 0, "the fault must actually have fired"
+    assert all_covered(op)
+
+
+# -- state.watch -------------------------------------------------------------
+
+
+def test_watch_fault_triggers_relist_and_converges():
+    """Dropped/failed watch deliveries force a backlog relist; the cluster
+    state (and the pods riding the pump's batch triggers) converge."""
+    from karpenter_core_tpu.metrics.registry import REGISTRY
+
+    relists = REGISTRY.counter("karpenter_watch_relists_total")
+    before = sum(relists.values.values())
+    cp = fake.FakeCloudProvider(fake.instance_types(8))
+    op = make_operator(cp)
+    op.kube_client.create(make_provisioner(name="default"))
+    fault = chaos.arm(chaos.STATE_WATCH, error="runtime", times=4)
+    op.start()
+    try:
+        for i in range(6):
+            op.kube_client.create(make_pod(name=f"watch-p{i}", requests={"cpu": "1"}))
+        assert wait_for(lambda: fault.injected >= 4)
+        assert wait_for(lambda: all_covered(op)), (
+            "relist must replay the events the faults ate"
+        )
+        assert sum(relists.values.values()) > before, "a relist must have run"
+        assert_no_dead_loops(op)
+    finally:
+        op.stop()
+
+
+def test_watch_relist_emits_synthetic_deletes():
+    """An object deleted while its watch delivery is failing must not
+    survive as a ghost in the cluster state: the relist diffs known keys
+    and emits synthetic DELETED events."""
+    cp = fake.FakeCloudProvider(fake.instance_types(4))
+    op = make_operator(cp, relist_interval=0.2)
+    op.kube_client.create(make_provisioner(name="default"))
+    node = op.kube_client.new_object("Node")
+    node.metadata.name = "ghost-node"
+    node.metadata.labels = {"node.kubernetes.io/instance-type": "fake-it-1"}
+    op.kube_client.create(node)
+    op.start()
+    try:
+        assert wait_for(
+            lambda: any(n.name() == "ghost-node" for n in op.cluster.nodes())
+        )
+        # every delivery now fails while the node disappears; only the
+        # relist's deletion diffing can remove it from the cluster state
+        chaos.arm(chaos.STATE_WATCH, error="runtime", times=8)
+        op.kube_client.delete("Node", "", "ghost-node")
+        assert wait_for(
+            lambda: not any(n.name() == "ghost-node" for n in op.cluster.nodes())
+        ), "ghost node must be purged by the relist"
+        assert_no_dead_loops(op)
+    finally:
+        op.stop()
+
+
+# -- insufficient capacity (ICE) --------------------------------------------
+
+
+def test_ice_masks_offering_and_resolves_to_next_type():
+    """The cheapest type's capacity is exhausted at the vendor: the first
+    launch ICEs, the offering lands in the ICE cache, and the retriggered
+    re-solve places the pods on the NEXT type instead of spinning."""
+    from karpenter_core_tpu.controllers.provisioning.provisioner import (
+        LAUNCH_FAILURES,
+        LAUNCH_RESOLVE_RETRIGGERS,
+    )
+
+    failures_before = LAUNCH_FAILURES.get({"reason": "insufficient_capacity"})
+    retriggers_before = LAUNCH_RESOLVE_RETRIGGERS.get()
+    cp = fake.FakeCloudProvider(fake.instance_types(6))
+    cp.insufficient_capacity = {("fake-it-4", "", "")}
+    op = make_operator(cp)
+    op.kube_client.create(make_provisioner(name="default"))
+    for i in range(3):
+        op.kube_client.create(make_pod(name=f"ice-p{i}", requests={"cpu": "4.5"}))
+    op.step()  # solve -> fake-it-4 -> ICE -> cache + retrigger
+    assert not op.kube_client.list("Machine")
+    assert ("fake-it-4", "", "") in op.provisioning.ice_cache.keys()
+    assert LAUNCH_FAILURES.get({"reason": "insufficient_capacity"}) > failures_before
+    assert LAUNCH_RESOLVE_RETRIGGERS.get() > retriggers_before
+    op.step()  # re-solve against the masked universe
+    machines = op.kube_client.list("Machine")
+    assert machines, "residual pods must land on the next instance type"
+    placed_types = {
+        m.metadata.labels.get("node.kubernetes.io/instance-type") for m in machines
+    }
+    assert placed_types == {"fake-it-5"}
+    assert all_covered(op)
+
+
+def test_ice_cache_ttl_expiry_lets_capacity_return():
+    """Offerings un-mask when the TTL lapses: pods that could ONLY fit the
+    exhausted type wait, then schedule once capacity returns."""
+    from karpenter_core_tpu.cloudprovider.icecache import ICECache
+
+    clock = FakeClock()
+    cp = fake.FakeCloudProvider(fake.instance_types(5))
+    cp.insufficient_capacity = {("fake-it-4", "", "")}
+    op = make_operator(cp)
+    op.provisioning.ice_cache = ICECache(ttl=60.0, clock=clock)
+    op.kube_client.create(make_provisioner(name="default"))
+    op.kube_client.create(make_pod(name="only-big", requests={"cpu": "4.5"}))
+    op.step()
+    assert len(op.provisioning.ice_cache) == 1
+    op.step()  # masked: nothing else fits, the pod stays pending
+    assert not op.kube_client.list("Machine")
+    # capacity returns and the cache entry expires
+    cp.insufficient_capacity = set()
+    clock.advance(61)
+    assert len(op.provisioning.ice_cache) == 0
+    op.step()
+    assert op.kube_client.list("Machine")
+    assert all_covered(op)
+
+
+def test_chaos_injected_ice_without_offering_key_is_still_retried():
+    """A chaos-injected generic ICE (no offering key) cannot poison the
+    cache, but the launch is still classified retryable and the pods
+    schedule on the next pass."""
+    cp = fake.FakeCloudProvider(fake.instance_types(5))
+    op = make_operator(cp)
+    fault = chaos.arm(chaos.CLOUDPROVIDER_CREATE, error="ice", times=1)
+    op.kube_client.create(make_provisioner(name="default"))
+    op.kube_client.create(make_pod(name="p0", requests={"cpu": "1"}))
+    op.step()
+    assert fault.injected == 1
+    assert len(op.provisioning.ice_cache) == 0, "keyless ICE must not mask"
+    op.step()
+    assert op.kube_client.list("Machine")
+    assert all_covered(op)
+
+
+# -- solver.device -----------------------------------------------------------
+
+
+def test_device_fault_degrades_to_fallback_and_still_schedules():
+    """A wedged accelerator (the failure that motivated ResilientSolver)
+    injected at solver.device: the solve falls back to the host greedy and
+    the pods still schedule."""
+    from karpenter_core_tpu.solver.fallback import ResilientSolver
+    from karpenter_core_tpu.solver.tpu_solver import GreedySolver, TPUSolver
+
+    solver = ResilientSolver(
+        TPUSolver(max_nodes=64),
+        GreedySolver(),
+        prober=lambda: None,  # the backend LOOKS healthy; the solve wedges
+        small_batch_work_max=0,
+    )
+    cp = fake.FakeCloudProvider(fake.instance_types(5))
+    op = new_operator(cp, settings=Settings(), solver=solver)
+    chaos.arm(chaos.SOLVER_DEVICE, error="runtime")
+    op.kube_client.create(make_provisioner(name="default"))
+    op.kube_client.create(make_pod(name="p0", requests={"cpu": "1"}))
+    op.step()
+    assert op.kube_client.list("Machine"), "fallback must keep provisioning"
+    assert solver._healthy is False, "the device fault must mark the primary dead"
+    assert CHAOS_INJECTED_TOTAL.get(
+        {"point": chaos.SOLVER_DEVICE, "error": "runtime"}
+    ) >= 1
+
+
+# -- env-spec end to end -----------------------------------------------------
+
+def test_env_spec_drives_an_operator_loop():
+    """KARPENTER_CHAOS wiring end to end: the spec string arms the same
+    faults the programmatic API does, deterministically under a seed."""
+    armed = chaos.arm_from_env(
+        {
+            "KARPENTER_CHAOS": "cloudprovider.create=error:conn,times:2",
+            "KARPENTER_CHAOS_SEED": "1",
+        }
+    )
+    fault = armed[chaos.CLOUDPROVIDER_CREATE]
+    cp = fake.FakeCloudProvider(fake.instance_types(5))
+    op = make_operator(cp)
+    op.kube_client.create(make_provisioner(name="default"))
+    op.kube_client.create(make_pod(name="p0", requests={"cpu": "1"}))
+    op.step()  # launch fails (injected)
+    op.step()  # still failing
+    op.step()  # recovered
+    assert fault.injected == 2
+    assert op.kube_client.list("Machine")
+    assert all_covered(op)

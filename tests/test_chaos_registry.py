@@ -1,0 +1,219 @@
+"""Chaos fault-injection registry (karpenter_core_tpu/chaos): arming,
+schedules (probability / times / after / latency), seeded determinism, the
+KARPENTER_CHAOS env grammar, and the injected-fault metrics."""
+import time
+
+import pytest
+
+from karpenter_core_tpu import chaos
+from karpenter_core_tpu.chaos import CHAOS_INJECTED_TOTAL
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+def test_unarmed_is_a_noop():
+    for point in chaos.KNOWN_POINTS:
+        chaos.maybe_fail(point)  # must not raise
+
+
+def test_unarmed_path_is_cheap():
+    # the hooks live on every kube CRUD and every solver RPC: the disabled
+    # path must be dict-lookup cheap. Ultra-generous bound (~5us/call) so
+    # CI jitter can't flake it; a regression to real work (locking, RNG,
+    # metric touches) lands orders of magnitude above this.
+    start = time.perf_counter()
+    for _ in range(100_000):
+        chaos.maybe_fail(chaos.KUBE_TRANSPORT)
+    assert time.perf_counter() - start < 0.5
+
+
+def test_arm_raises_and_counts():
+    before = CHAOS_INJECTED_TOTAL.get({"point": "t.point", "error": "runtime"})
+    fault = chaos.arm("t.point")
+    with pytest.raises(RuntimeError, match="chaos: injected fault"):
+        chaos.maybe_fail("t.point")
+    assert fault.calls == 1 and fault.injected == 1
+    assert (
+        CHAOS_INJECTED_TOTAL.get({"point": "t.point", "error": "runtime"})
+        == before + 1
+    )
+
+
+def test_times_schedule_fails_n_then_recovers():
+    fault = chaos.arm("t.point", error="conn", times=3)
+    for _ in range(3):
+        with pytest.raises(ConnectionResetError):
+            chaos.maybe_fail("t.point")
+    for _ in range(5):
+        chaos.maybe_fail("t.point")  # recovered
+    assert fault.injected == 3 and fault.calls == 8
+
+
+def test_after_skips_the_first_calls():
+    fault = chaos.arm("t.point", error="timeout", after=2, times=1)
+    chaos.maybe_fail("t.point")
+    chaos.maybe_fail("t.point")
+    with pytest.raises(TimeoutError):
+        chaos.maybe_fail("t.point")
+    chaos.maybe_fail("t.point")
+    assert fault.injected == 1
+
+
+def test_probability_is_seed_deterministic():
+    def pattern(seed):
+        chaos.arm("t.point", error="conn", probability=0.3, seed=seed)
+        hits = []
+        for _ in range(200):
+            try:
+                chaos.maybe_fail("t.point")
+                hits.append(0)
+            except ConnectionResetError:
+                hits.append(1)
+        return hits
+
+    a, b = pattern(42), pattern(42)
+    assert a == b, "same seed must replay the same fault pattern"
+    assert 20 < sum(a) < 120, "p=0.3 over 200 calls"
+    c = pattern(43)
+    assert a != c, "different seed, different pattern"
+
+
+def test_latency_only_fault_delays_without_raising():
+    chaos.arm("t.point", error=None, latency=0.05)
+    start = time.perf_counter()
+    chaos.maybe_fail("t.point")
+    assert time.perf_counter() - start >= 0.05
+
+
+def test_error_accepts_instance_class_and_factory():
+    class Boom(Exception):
+        pass
+
+    chaos.arm("t.point", error=Boom("x"))
+    with pytest.raises(Boom):
+        chaos.maybe_fail("t.point")
+    chaos.arm("t.point", error=Boom)
+    with pytest.raises(Boom):
+        chaos.maybe_fail("t.point")
+    chaos.arm("t.point", error=lambda: Boom("factory"))
+    with pytest.raises(Boom, match="factory"):
+        chaos.maybe_fail("t.point")
+
+
+def test_error_kinds_build_typed_errors():
+    from karpenter_core_tpu.cloudprovider.types import (
+        IncompatibleRequirementsError,
+        InsufficientCapacityError,
+    )
+    from karpenter_core_tpu.solver.service import (
+        SolverDeadlineExceededError,
+        SolverUnavailableError,
+    )
+
+    for kind, exc in [
+        ("ice", InsufficientCapacityError),
+        ("incompatible", IncompatibleRequirementsError),
+        ("unavailable", SolverUnavailableError),
+        ("deadline", SolverDeadlineExceededError),
+        ("conn", ConnectionResetError),
+        ("timeout", TimeoutError),
+        ("transport", ConnectionError),
+        ("runtime", RuntimeError),
+    ]:
+        chaos.arm("t.point", error=kind)
+        with pytest.raises(exc):
+            chaos.maybe_fail("t.point")
+
+
+def test_armed_context_manager_restores_previous_state():
+    outer = chaos.arm("t.point", error="conn", times=99)
+    with chaos.armed("t.point", error="timeout", times=1) as inner:
+        with pytest.raises(TimeoutError):
+            chaos.maybe_fail("t.point")
+        assert inner.injected == 1
+    with pytest.raises(ConnectionResetError):
+        chaos.maybe_fail("t.point")  # the outer fault is back
+    assert outer.injected == 1
+    with chaos.armed("t.other", error="timeout"):
+        pass
+    chaos.maybe_fail("t.other")  # no previous state: disarmed on exit
+
+
+# -- KARPENTER_CHAOS grammar -------------------------------------------------
+
+
+def test_parse_spec_full_grammar():
+    faults = chaos.parse_spec(
+        "cloudprovider.create=error:ice,times:3;"
+        "kube.transport=error:conn,p:0.1,seed:42;"
+        "solver.rpc=error:unavailable,latency:0.01,after:5"
+    )
+    assert set(faults) == {"cloudprovider.create", "kube.transport", "solver.rpc"}
+    create = faults["cloudprovider.create"]
+    assert create.error == "ice" and create.times == 3
+    transport = faults["kube.transport"]
+    assert transport.probability == 0.1 and transport.seed == 42
+    rpc = faults["solver.rpc"]
+    assert rpc.latency == 0.01 and rpc.after == 5
+
+
+def test_parse_spec_default_seed_and_latency_only():
+    faults = chaos.parse_spec("kube.transport=error:none,latency:0.5", default_seed=7)
+    fault = faults["kube.transport"]
+    assert fault.error is None and fault.seed == 7
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "kube.transport",  # missing =
+        "=error:conn",  # empty point
+        "kube.transport=error",  # param missing :
+        "kube.transport=error:nosuchkind",
+        "kube.transport=frobnicate:1",
+        # a typo'd point would inject nothing and pass vacuously
+        "cloudprovider.craete=error:ice,times:3",
+    ],
+)
+def test_parse_spec_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        chaos.parse_spec(bad)
+
+
+def test_arm_from_env():
+    armed = chaos.arm_from_env(
+        {
+            "KARPENTER_CHAOS": "kube.transport=error:conn,p:0.5",
+            "KARPENTER_CHAOS_SEED": "11",
+        }
+    )
+    assert chaos.armed_points()["kube.transport"] is armed["kube.transport"]
+    assert armed["kube.transport"].seed == 11
+    assert chaos.arm_from_env({}) == {}
+
+
+def test_concurrent_firing_counts_globally():
+    import threading
+
+    fault = chaos.arm("t.point", error="conn", times=10)
+    errors = []
+
+    def hammer():
+        for _ in range(100):
+            try:
+                chaos.maybe_fail("t.point")
+            except ConnectionResetError:
+                errors.append(1)
+
+    threads = [threading.Thread(target=hammer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert fault.injected == 10 == len(errors)
+    assert fault.calls == 400

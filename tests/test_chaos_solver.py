@@ -1,0 +1,252 @@
+"""Solver-RPC hardening: server-side gRPC status codes, client-side typed
+errors + bounded retry, and the circuit breaker that fails fast to the
+local fallback while the service is down."""
+import pytest
+
+from karpenter_core_tpu import chaos
+from karpenter_core_tpu.cloudprovider import fake
+from karpenter_core_tpu.solver import service_pb2 as pb
+from karpenter_core_tpu.solver.fallback import CircuitBreaker, ResilientSolver
+from karpenter_core_tpu.solver.service import (
+    SOLVER_RPC_RETRIES,
+    RemoteSolver,
+    SolverInternalError,
+    SolverInvalidArgumentError,
+    SolverResourceExhaustedError,
+    SolverUnavailableError,
+    classify_exception,
+    error_from_string,
+    serve,
+)
+from karpenter_core_tpu.solver.tpu_solver import GreedySolver
+from karpenter_core_tpu.testing import FakeClock, make_pod, make_provisioner
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+@pytest.fixture(scope="module")
+def server():
+    server, port, service = serve()
+    yield port, service
+    server.stop(0)
+
+
+def _solve_inputs(n=10):
+    return (
+        [make_pod(requests={"cpu": "1"}) for _ in range(n)],
+        [make_provisioner(name="default")],
+        {"default": fake.instance_types(10)},
+    )
+
+
+# -- classification ----------------------------------------------------------
+
+
+def test_classify_exception_maps_codes():
+    assert classify_exception(ValueError("bad"))[0] == "INVALID_ARGUMENT"
+    assert classify_exception(KeyError("segments"))[0] == "INVALID_ARGUMENT"
+    assert classify_exception(MemoryError())[0] == "RESOURCE_EXHAUSTED"
+    assert (
+        classify_exception(RuntimeError("RESOURCE_EXHAUSTED: hbm oom"))[0]
+        == "RESOURCE_EXHAUSTED"
+    )
+    assert classify_exception(RuntimeError("boom"))[0] == "INTERNAL"
+
+
+def test_error_from_string_round_trips_codes():
+    assert isinstance(
+        error_from_string("INVALID_ARGUMENT: ValueError: x"),
+        SolverInvalidArgumentError,
+    )
+    assert isinstance(
+        error_from_string("RESOURCE_EXHAUSTED: oom"), SolverResourceExhaustedError
+    )
+    assert isinstance(error_from_string("INTERNAL: boom"), SolverInternalError)
+    assert isinstance(error_from_string("whatever legacy text"), SolverInternalError)
+
+
+def test_direct_call_surfaces_classified_error_field(server):
+    _, service = server
+    response = service.solve(pb.SolveRequest(geometry="this is not json"))
+    assert response.error.startswith("INVALID_ARGUMENT:")
+
+
+def test_wire_error_raises_typed_invalid_argument(server):
+    port, _ = server
+    client = RemoteSolver(f"127.0.0.1:{port}")
+    with pytest.raises(SolverInvalidArgumentError):
+        client._invoke_solve(pb.SolveRequest(geometry="not json"), None)
+    # a request defect must NOT condemn the backend
+    assert SolverInvalidArgumentError.marks_unhealthy is False
+    # ... and must not have opened the breaker
+    assert client.breaker.state == CircuitBreaker.CLOSED
+
+
+# -- retry -------------------------------------------------------------------
+
+
+def test_injected_unavailable_is_retried_and_succeeds(server):
+    port, _ = server
+    client = RemoteSolver(f"127.0.0.1:{port}", rpc_retry_base=0.001)
+    fault = chaos.arm(chaos.SOLVER_RPC, error="unavailable", times=1)
+    before = SOLVER_RPC_RETRIES.get()
+    result = client.solve(*_solve_inputs())
+    assert not result.failed_pods and result.pod_count_new() == 10
+    assert fault.injected == 1
+    assert SOLVER_RPC_RETRIES.get() > before
+    assert client.breaker.state == CircuitBreaker.CLOSED
+
+
+def test_retries_are_bounded(server):
+    port, _ = server
+    client = RemoteSolver(
+        f"127.0.0.1:{port}", rpc_retries=2, rpc_retry_base=0.001,
+        breaker=CircuitBreaker(failure_threshold=100),
+    )
+    fault = chaos.arm(chaos.SOLVER_RPC, error="deadline")
+    with pytest.raises(Exception) as exc_info:
+        client.solve(*_solve_inputs())
+    assert getattr(exc_info.value, "transient", False) is True
+    # 1 initial + 2 retries per RPC attempt window
+    assert fault.injected == 3
+
+
+# -- circuit breaker ---------------------------------------------------------
+
+
+def test_breaker_unit_transitions():
+    clock = FakeClock()
+    breaker = CircuitBreaker(
+        name="t.breaker", failure_threshold=2, reset_timeout=30.0, clock=clock
+    )
+    assert breaker.state == CircuitBreaker.CLOSED and breaker.allow()
+    breaker.record_failure()
+    assert breaker.state == CircuitBreaker.CLOSED, "below threshold stays closed"
+    breaker.record_failure()
+    assert breaker.state == CircuitBreaker.OPEN
+    assert not breaker.allow(), "open fails fast"
+    clock.advance(31)
+    assert breaker.state == CircuitBreaker.HALF_OPEN
+    assert breaker.allow(), "half-open admits one trial"
+    assert not breaker.allow(), "only one trial until it reports"
+    breaker.record_success()
+    assert breaker.state == CircuitBreaker.CLOSED
+    # failure during half-open re-opens
+    breaker.record_failure()
+    breaker.record_failure()
+    clock.advance(31)
+    assert breaker.allow()
+    breaker.record_failure()
+    assert breaker.state == CircuitBreaker.OPEN
+
+
+def test_breaker_trips_to_fast_failure_and_half_opens(server):
+    port, _ = server
+    clock = FakeClock()
+    client = RemoteSolver(
+        f"127.0.0.1:{port}", rpc_retries=0,
+        breaker=CircuitBreaker(failure_threshold=2, reset_timeout=60.0, clock=clock),
+    )
+    fault = chaos.arm(chaos.SOLVER_RPC, error="unavailable")
+    inputs = _solve_inputs()
+    for _ in range(2):
+        with pytest.raises(SolverUnavailableError):
+            client.solve(*inputs)
+    assert client.breaker.state == CircuitBreaker.OPEN
+    calls_when_open = fault.calls
+    with pytest.raises(SolverUnavailableError, match="circuit breaker open"):
+        client.solve(*inputs)
+    assert fault.calls == calls_when_open, (
+        "an open breaker must fail fast without attempting the RPC"
+    )
+    # TTL lapses and the fault clears: the half-open trial closes the breaker
+    chaos.reset()
+    clock.advance(61)
+    result = client.solve(*inputs)
+    assert not result.failed_pods
+    assert client.breaker.state == CircuitBreaker.CLOSED
+
+
+def test_half_open_trial_with_request_error_closes_breaker(server):
+    """A half-open trial answered by the SERVER with a request-defect code
+    proves the channel is up: the breaker must close, not re-open for
+    another TTL."""
+    port, _ = server
+    clock = FakeClock()
+    client = RemoteSolver(
+        f"127.0.0.1:{port}", rpc_retries=0,
+        breaker=CircuitBreaker(failure_threshold=1, reset_timeout=60.0, clock=clock),
+    )
+    client.breaker.record_failure()
+    assert client.breaker.state == CircuitBreaker.OPEN
+    clock.advance(61)
+    with pytest.raises(SolverInvalidArgumentError):
+        client._invoke_solve(pb.SolveRequest(geometry="not json"), None)
+    assert client.breaker.state == CircuitBreaker.CLOSED
+
+
+def test_health_probe_bypasses_and_closes_breaker(server):
+    port, _ = server
+    client = RemoteSolver(f"127.0.0.1:{port}")
+    for _ in range(5):
+        client.breaker.record_failure()
+    assert client.breaker.state == CircuitBreaker.OPEN
+    health = client.health()
+    assert health.status == "ok"
+    assert client.breaker.state == CircuitBreaker.CLOSED, (
+        "the recovery probe must close the breaker"
+    )
+
+
+def test_health_failure_counts_toward_breaker():
+    client = RemoteSolver(
+        "127.0.0.1:1",  # nothing listens here
+        breaker=CircuitBreaker(failure_threshold=1),
+    )
+    with pytest.raises(Exception):
+        client.health(timeout=0.2)
+    assert client.breaker.state == CircuitBreaker.OPEN
+
+
+# -- ResilientSolver classification ------------------------------------------
+
+
+class _TypedFailingSolver:
+    def __init__(self, err):
+        self.err = err
+        self.calls = 0
+
+    def solve(self, *a, **k):
+        self.calls += 1
+        raise self.err
+
+
+def test_resilient_does_not_mark_dead_on_request_errors():
+    primary = _TypedFailingSolver(SolverInvalidArgumentError("bad encode"))
+    resilient = ResilientSolver(
+        primary, GreedySolver(), prober=lambda: None, small_batch_work_max=0
+    )
+    inputs = _solve_inputs(3)
+    result = resilient.solve(*inputs)
+    assert result.pod_count_new() == 3, "must fall back for THIS solve"
+    assert resilient._healthy is True, "request defect must not mark dead"
+    resilient.solve(*inputs)
+    assert primary.calls == 2, "the next solve goes to the primary again"
+
+
+def test_resilient_marks_dead_on_transport_errors():
+    primary = _TypedFailingSolver(SolverUnavailableError("conn refused"))
+    resilient = ResilientSolver(
+        primary, GreedySolver(), prober=lambda: None, small_batch_work_max=0
+    )
+    inputs = _solve_inputs(3)
+    result = resilient.solve(*inputs)
+    assert result.pod_count_new() == 3
+    assert resilient._healthy is False
+    resilient.solve(*inputs)
+    assert primary.calls == 1, "dead primary must not be retried before TTL"

@@ -1,0 +1,174 @@
+"""ApiServerKubeClient transport retries: exponential backoff + jitter on
+transient failures (5xx / 429 / timeout / connection reset), Retry-After
+honored, conflicts (409) and other 4xx never retried."""
+import random
+
+import pytest
+
+from karpenter_core_tpu import chaos
+from karpenter_core_tpu.kube.apiserver import (
+    KUBE_TRANSPORT_RETRIES,
+    ApiServerKubeClient,
+)
+from karpenter_core_tpu.kube.client import ConflictError
+from karpenter_core_tpu.testing import make_pod
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+class ScriptedTransport:
+    """Yields scripted outcomes per call: an Exception instance (raised), or
+    a (status, body[, headers]) tuple; the last entry repeats."""
+
+    def __init__(self, *script):
+        self.script = list(script)
+        self.calls = []
+
+    def __call__(self, method, path, body=None, params=None, stream=False,
+                 timeout=30.0):
+        self.calls.append((method, path))
+        outcome = self.script[min(len(self.calls) - 1, len(self.script) - 1)]
+        if isinstance(outcome, BaseException):
+            raise outcome
+        return outcome
+
+
+POD_OK = (
+    200,
+    '{"metadata": {"name": "p", "namespace": "default", '
+    '"resourceVersion": "3"}, "spec": {}, "status": {}}',
+)
+
+
+def client_for(transport, **kw):
+    kw.setdefault("retry_base", 0.001)
+    kw.setdefault("retry_max", 0.01)
+    kw.setdefault("rng", random.Random(7))
+    return ApiServerKubeClient(transport, **kw)
+
+
+def test_connection_reset_is_retried():
+    transport = ScriptedTransport(
+        ConnectionResetError("peer reset"), ConnectionResetError("again"), POD_OK
+    )
+    before = KUBE_TRANSPORT_RETRIES.get({"method": "GET"})
+    client = client_for(transport)
+    pod = client.get("Pod", "default", "p")
+    assert pod is not None and pod.metadata.name == "p"
+    assert len(transport.calls) == 3
+    assert KUBE_TRANSPORT_RETRIES.get({"method": "GET"}) == before + 2
+
+
+def test_5xx_is_retried_until_success():
+    transport = ScriptedTransport((503, "unavailable"), (502, "bad gw"), POD_OK)
+    client = client_for(transport)
+    assert client.get("Pod", "default", "p") is not None
+    assert len(transport.calls) == 3
+
+
+def test_retry_after_header_is_honored():
+    waits = []
+
+    class Recording(ApiServerKubeClient):
+        def _backoff(self, attempt, retry_after):
+            waits.append(retry_after)
+            return 0.0
+
+    transport = ScriptedTransport(
+        (429, "slow down", {"Retry-After": "7"}), POD_OK
+    )
+    client = Recording(transport)
+    assert client.get("Pod", "default", "p") is not None
+    assert waits == ["7"]
+    # and the real backoff caps a parseable Retry-After at retry_max
+    real = client_for(ScriptedTransport(POD_OK), retry_max=2.0)
+    assert real._backoff(0, "7") == 2.0
+    assert real._backoff(0, "1.5") == 1.5
+
+
+def test_write_verbs_do_not_retry_ambiguous_statuses():
+    """A 502/504 on a POST can arrive AFTER a gateway-fronted apiserver
+    committed the write: replaying would turn success into AlreadyExists.
+    Writes only retry the not-applied statuses (429/503); GET keeps the
+    full transient set."""
+    transport = ScriptedTransport((502, "bad gateway"))
+    client = client_for(transport)
+    with pytest.raises(RuntimeError, match="apiserver 502"):
+        client.create(make_pod(name="p"))
+    assert len(transport.calls) == 1
+    # 503 is a pre-processing rejection: retried even for writes
+    transport2 = ScriptedTransport(
+        (503, "overloaded"),
+        (201, '{"metadata": {"name": "p", "namespace": "default", '
+              '"resourceVersion": "1"}, "spec": {}, "status": {}}'),
+    )
+    client2 = client_for(transport2)
+    assert client2.create(make_pod(name="p")) is not None
+    assert len(transport2.calls) == 2
+    # ambiguous connection failures: never replayed for writes
+    transport3 = ScriptedTransport(ConnectionResetError("mid-flight"))
+    client3 = client_for(transport3)
+    with pytest.raises(ConnectionResetError):
+        client3.create(make_pod(name="p"))
+    assert len(transport3.calls) == 1
+
+
+def test_conflict_is_never_retried():
+    transport = ScriptedTransport((409, '{"reason": "Conflict"}'))
+    client = client_for(transport)
+    pod = make_pod(name="p")
+    pod.metadata.resource_version = 1
+    with pytest.raises(ConflictError):
+        client.update(pod)
+    assert len(transport.calls) == 1, "409 must return to the caller untouched"
+
+
+def test_plain_4xx_is_not_retried():
+    transport = ScriptedTransport((403, "forbidden"))
+    client = client_for(transport)
+    with pytest.raises(RuntimeError, match="apiserver 403"):
+        client.get("Pod", "default", "p")
+    assert len(transport.calls) == 1
+
+
+def test_retries_exhaust_and_raise():
+    transport = ScriptedTransport(TimeoutError("t"))
+    client = client_for(transport, retry_attempts=3)
+    with pytest.raises(TimeoutError):
+        client.get("Pod", "default", "p")
+    assert len(transport.calls) == 4  # 1 initial + 3 retries
+
+
+def test_backoff_is_jittered_and_bounded():
+    client = client_for(ScriptedTransport(POD_OK), retry_base=0.1, retry_max=2.0,
+                        rng=random.Random(3))
+    samples = [client._backoff(a, None) for a in range(5) for _ in range(20)]
+    assert all(0.0 <= s <= 2.0 for s in samples)
+    assert len(set(samples)) > 10, "backoff must be jittered, not a fixed ladder"
+
+
+def test_eviction_pdb_429_is_not_retried():
+    """Eviction's 429 is a PodDisruptionBudget verdict, not a rate limit:
+    the eviction queue requeues it; the transport layer must not burn
+    seconds replaying it."""
+    from karpenter_core_tpu.kube.client import EvictionBlockedError
+
+    transport = ScriptedTransport((429, "budget exhausted"))
+    client = client_for(transport)
+    with pytest.raises(EvictionBlockedError):
+        client.evict("default", "p")
+    assert len(transport.calls) == 1
+
+
+def test_chaos_transport_fault_rides_the_retry_loop():
+    """An injected kube.transport fault inside _request is classified and
+    retried exactly like a wire failure."""
+    fault = chaos.arm(chaos.KUBE_TRANSPORT, error="conn", times=2)
+    client = client_for(ScriptedTransport(POD_OK))
+    assert client.get("Pod", "default", "p") is not None
+    assert fault.injected == 2

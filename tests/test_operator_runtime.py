@@ -34,12 +34,30 @@ class TestSingleton:
             calls.append(1)
             raise RuntimeError("kaboom")
 
-        s = Singleton("t-err", boom, interval=1.0)
+        import random as random_mod
+
+        from karpenter_core_tpu.operator.controller import (
+            ERROR_BACKOFF_BASE,
+            ERROR_BACKOFF_MAX,
+        )
+
+        s = Singleton("t-err", boom, interval=1.0, rng=random_mod.Random(7))
         before = RECONCILE_ERRORS.get(labels={"controller": "t-err"})
-        w1 = s.reconcile_once()
-        w2 = s.reconcile_once()
-        assert RECONCILE_ERRORS.get(labels={"controller": "t-err"}) == before + 2
-        assert 0 < w1 < w2 <= 10.0  # exponential, capped
+        waits = [s.reconcile_once() for _ in range(6)]
+        assert RECONCILE_ERRORS.get(labels={"controller": "t-err"}) == before + 6
+        # decorrelated jitter: every wait lands in [base, min(3*prev, cap)] —
+        # never lockstep-identical ladders across controllers, still capped
+        prev = ERROR_BACKOFF_BASE
+        for w in waits:
+            assert ERROR_BACKOFF_BASE <= w <= ERROR_BACKOFF_MAX
+            assert w <= max(prev * 3, ERROR_BACKOFF_BASE)
+            prev = w
+        # the expected sleep still grows: later waits dwarf the base
+        assert max(waits) > ERROR_BACKOFF_BASE * 4
+        # two controllers failing in lockstep do NOT share a backoff ladder
+        s2 = Singleton("t-err2", boom, interval=1.0, rng=random_mod.Random(99))
+        waits2 = [s2.reconcile_once() for _ in range(6)]
+        assert waits != waits2
 
     def test_error_then_success_resets_backoff(self):
         state = {"fail": True}

@@ -1,0 +1,128 @@
+"""ResilientSolver RECOVERY coverage (ISSUE 2 satellite): the pre-existing
+suite exercised the degrade direction; these pin the way back — healthy-
+verdict TTL expiry catching a mid-life wedge on the big-batch path, an
+unhealthy backend re-probing and restoring the PRIMARY, and fallback
+events deduping instead of spamming."""
+from karpenter_core_tpu.cloudprovider import fake
+from karpenter_core_tpu.events import Recorder
+from karpenter_core_tpu.solver.fallback import ResilientSolver
+from karpenter_core_tpu.solver.tpu_solver import GreedySolver
+from karpenter_core_tpu.testing import FakeClock, make_pod, make_provisioner
+
+
+class CountingPrimary(GreedySolver):
+    """A working primary that counts its solves."""
+
+    def __init__(self):
+        super().__init__()
+        self.calls = 0
+
+    def solve(self, *a, **k):
+        self.calls += 1
+        return super().solve(*a, **k)
+
+
+def _inputs(n=5):
+    return (
+        [make_pod(requests={"cpu": "1"}) for _ in range(n)],
+        [make_provisioner(name="default")],
+        {"default": fake.instance_types(10)},
+    )
+
+
+def test_healthy_ttl_expiry_detects_midlife_wedge_on_big_batches():
+    """The healthy verdict EXPIRES between big-batch solves: a backend that
+    wedges mid-life is re-probed on the healthy_recheck TTL and the solve
+    routes to the fallback without ever touching the wedged primary."""
+    clock = FakeClock()
+    health = {"reason": None}
+    probes = []
+
+    def prober():
+        probes.append(clock())
+        return health["reason"]
+
+    primary = CountingPrimary()
+    resilient = ResilientSolver(
+        primary, GreedySolver(), clock=clock, prober=prober,
+        healthy_recheck_interval=600.0, small_batch_work_max=0,
+    )
+    inputs = _inputs()
+    resilient.solve(*inputs)
+    assert primary.calls == 1 and len(probes) == 1
+    resilient.solve(*inputs)  # fresh verdict: no re-probe
+    assert len(probes) == 1
+    # the backend wedges mid-life; the verdict is still fresh
+    health["reason"] = "tunnel wedged"
+    clock.advance(601)  # ... until the healthy TTL lapses
+    result = resilient.solve(*inputs)
+    assert result.pod_count_new() == 5
+    assert len(probes) == 2, "stale healthy verdict must re-probe"
+    assert primary.calls == 2, "the wedged primary must not see the solve"
+    assert resilient._healthy is False
+
+
+def test_unhealthy_backend_reprobe_restores_primary():
+    """Recovery direction: after the reprobe interval, a healthy probe
+    routes solves BACK to the primary and publishes SolverRecovered."""
+    clock = FakeClock()
+    health = {"reason": "backend probe timed out after 60s"}
+    primary = CountingPrimary()
+    recorder = Recorder(clock=clock)
+    resilient = ResilientSolver(
+        primary, GreedySolver(), clock=clock, recorder=recorder,
+        prober=lambda: health["reason"], reprobe_interval=300.0,
+        small_batch_work_max=0,
+    )
+    inputs = _inputs()
+    resilient.solve(*inputs)  # unhealthy: fallback
+    assert primary.calls == 0 and resilient._healthy is False
+    resilient.solve(*inputs)  # still inside the reprobe TTL: no probe storm
+    assert primary.calls == 0
+    health["reason"] = None  # the backend comes back
+    resilient.solve(*inputs)  # TTL not lapsed yet: still fallback
+    assert primary.calls == 0
+    clock.advance(301)
+    result = resilient.solve(*inputs)
+    assert result.pod_count_new() == 5
+    assert primary.calls == 1, "recovered backend must serve the primary path"
+    assert resilient._healthy is True
+    reasons = [e.reason for e in recorder.for_object("Solver", "solver")]
+    assert "SolverDegraded" in reasons and "SolverRecovered" in reasons
+
+
+def test_fallback_events_are_deduped():
+    """A dead backend failing every batch must publish ONE SolverDegraded
+    event per dedupe window, not one per solve."""
+    clock = FakeClock()
+
+    class DyingPrimary(CountingPrimary):
+        def solve(self, *a, **k):
+            self.calls += 1
+            raise RuntimeError("UNAVAILABLE: tunnel wedged")
+
+    primary = DyingPrimary()
+    recorder = Recorder(clock=clock)
+    resilient = ResilientSolver(
+        primary, GreedySolver(), clock=clock, recorder=recorder,
+        prober=lambda: None, reprobe_interval=0.0,  # re-try primary each solve
+        small_batch_work_max=0,
+    )
+    inputs = _inputs()
+    for _ in range(6):
+        resilient.solve(*inputs)
+        clock.advance(1.0)
+    degraded = [
+        e for e in recorder.for_object("Solver", "solver")
+        if e.reason == "SolverDegraded"
+    ]
+    assert primary.calls >= 6, "reprobe_interval=0 retries the primary"
+    assert len(degraded) == 1, "degrade events must dedupe inside the window"
+    # after the dedupe TTL the (still dead) backend may publish again
+    clock.advance(Recorder.DEDUPE_TTL + 1)
+    resilient.solve(*inputs)
+    degraded = [
+        e for e in recorder.for_object("Solver", "solver")
+        if e.reason == "SolverDegraded"
+    ]
+    assert len(degraded) == 2
