@@ -2,7 +2,8 @@
 # (test/deflake/verify, reference Makefile:9-33). Tests force the CPU
 # backend with 8 virtual devices via tests/conftest.py.
 
-.PHONY: test deflake perf bench verify trace-demo chaos chaos-smoke
+.PHONY: test deflake perf bench verify trace-demo chaos chaos-smoke \
+	replay-demo no-print
 
 test:  ## tier-1 suite (CPU, 8 virtual devices); slow chaos soaks: make chaos
 	python -m pytest tests -q -m "not slow"
@@ -18,6 +19,12 @@ bench:  ## north-star benchmark on the attached backend (one JSON line)
 
 trace-demo:  ## small traced solve -> /tmp/karpenter_trace.json (validated)
 	python hack/trace_demo.py
+
+replay-demo:  ## flight-recorded solve -> dump -> byte-identical replay
+	python hack/replay.py --demo
+
+no-print:  ## bare print() guard over karpenter_core_tpu/ (AST-based)
+	./hack/check_no_print.sh
 
 chaos:  ## fault-injection suite (incl. slow schedule cases), fixed seed
 	KARPENTER_CHAOS_SEED=42 python -m pytest \
@@ -36,8 +43,14 @@ verify:  ## driver hooks: single-chip compile check + 8-way mesh dryrun
 	import __graft_entry__ as g; fn, a = g.entry(); \
 	jax.block_until_ready(jax.jit(fn)(*a)); print('entry ok')"
 	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+	# no bare print() in the package: everything logs through obs/log
+	./hack/check_no_print.sh
+	# metrics-scraper suite: the scrape-race/startup-guard regressions
+	python -m pytest tests/test_metrics_controllers.py -q
 	# non-fatal smoke: a traced solve must export valid Perfetto JSON
 	-$(MAKE) trace-demo
+	# non-fatal smoke: a flight-recorded solve must replay byte-identically
+	-$(MAKE) replay-demo
 	# non-fatal smoke: an env-spec chaos run must recover and expose the
 	# karpenter_chaos_injected_total / retry / ICE counters
 	-$(MAKE) chaos-smoke

@@ -1,0 +1,64 @@
+"""No-print guard: fail on bare print() calls inside karpenter_core_tpu/.
+
+The package logs through the structured logger (obs/log) — bare prints
+bypass the level gate, the ring (/debug/logs), and the trace-id
+correlation, so they are banned from production code. hack/ and tests/
+stay free-form (CLI tools and assertions print on purpose).
+
+AST-based, not grep: a `print(` inside a string literal (e.g. the
+subprocess probe source in solver/fallback.py) is NOT a violation, and a
+real call can't hide behind formatting. Used by hack/check_no_print.sh
+(make verify) and tests/test_no_print.py (tier-1).
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from typing import List, Tuple
+
+PACKAGE = "karpenter_core_tpu"
+
+
+def find_print_calls(root: str) -> List[Tuple[str, int]]:
+    """(path, lineno) of every print() call under `root`."""
+    violations: List[Tuple[str, int]] = []
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+        for filename in sorted(filenames):
+            if not filename.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, filename)
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            try:
+                tree = ast.parse(source, filename=path)
+            except SyntaxError as exc:
+                violations.append((path, exc.lineno or 0))
+                continue
+            for node in ast.walk(tree):
+                if (
+                    isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "print"
+                ):
+                    violations.append((path, node.lineno))
+    return violations
+
+
+def main() -> int:
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    package_root = os.path.join(repo_root, PACKAGE)
+    violations = find_print_calls(package_root)
+    if violations:
+        for path, lineno in violations:
+            rel = os.path.relpath(path, repo_root)
+            print(f"{rel}:{lineno}: bare print() — use karpenter_core_tpu.obs.log")
+        print(f"check_no_print: {len(violations)} violation(s)")
+        return 1
+    print(f"check_no_print: ok ({PACKAGE}/ is print-free)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

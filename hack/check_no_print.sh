@@ -1,0 +1,4 @@
+#!/bin/sh
+# No-print guard (make verify): fail on bare print() in karpenter_core_tpu/
+# outside hack//tests. AST-based — see hack/check_no_print.py.
+exec python "$(dirname "$0")/check_no_print.py" "$@"

@@ -1,0 +1,172 @@
+"""Flight-record replay: load a solve record (from /debug/solves or an
+auto-dump) and re-run its exact inputs through GreedySolver and TPUSolver,
+diffing placements — a field incident becomes a deterministic differential
+test (`make replay-demo` smoke-checks the whole loop; wired into
+`make verify` as a non-fatal step).
+
+Usage:
+    python hack/replay.py RECORD.json            # replay one dumped record
+    python hack/replay.py SOLVES.json --index -1 # a /debug/solves download
+    python hack/replay.py RECORD.json --solver greedy|tpu|both
+    python hack/replay.py --demo                 # live capture -> replay
+
+Exit status is 0 when the recorded backend's replay reproduces the
+recorded placements byte-identically (the determinism bar); the
+greedy-vs-tpu diff is informational — the two algorithms may legitimately
+produce different, equally valid placements (see
+tests/test_differential_fuzz.py for the equivalence bar).
+
+Hermetic: forces the CPU backend in-process (the image's sitecustomize
+pins the axon TPU tunnel; env vars can't override it — same treatment as
+`make verify`'s compile check).
+"""
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def _load_record(path: str, index: int) -> dict:
+    with open(path) as f:
+        body = json.load(f)
+    if isinstance(body, dict) and "records" in body:  # /debug/solves download
+        records = body["records"]
+        if not records:
+            raise SystemExit(f"{path}: no records in ring export")
+        return records[index]
+    if isinstance(body, list):
+        return body[index]
+    return body
+
+
+def _describe(record: dict) -> str:
+    inputs = record.get("inputs", {})
+    return (
+        f"backend={record.get('backend')} digest={record.get('digest')} "
+        f"pods={len(inputs.get('pods', []))} "
+        f"state_nodes={len(inputs.get('stateNodes', []))} "
+        f"trace={record.get('trace_id', '-')} "
+        f"duration_ms={record.get('duration_ms')}"
+    )
+
+
+def replay_record(record: dict, solver: str = "both") -> int:
+    from karpenter_core_tpu.obs import flightrec
+
+    print(f"record: {_describe(record)}")
+    if record.get("phases_ms"):
+        print(f"phases_ms: {record['phases_ms']}")
+    if record.get("primary_error"):
+        print(f"primary_error: {record['primary_error']}")
+    recorded = record.get("outcome", {}).get("placements")
+
+    results = {}
+    kinds = ["greedy", "tpu"] if solver == "both" else [solver]
+    for kind in kinds:
+        placements, res = flightrec.replay(record, kind)
+        results[kind] = placements
+        print(
+            f"{kind}: {len(placements['machines'])} machines, "
+            f"{sum(len(m['pods']) for m in placements['machines'])} pods on new, "
+            f"{sum(len(e['pods']) for e in placements['existing'])} on existing, "
+            f"{len(placements['failed'])} failed (rounds={res.rounds})"
+        )
+
+    rc = 0
+    if recorded is not None:
+        # determinism bar: the recorded backend's replay must reproduce the
+        # captured placements byte for byte
+        replayer = record.get("replayer", "greedy")
+        replayed = results.get(replayer)
+        if replayed is None:
+            replayed, _ = flightrec.replay(record, replayer)
+        if flightrec.placements_json(replayed) == flightrec.placements_json(recorded):
+            print(f"replay({replayer}) == recorded placements: byte-identical")
+        else:
+            rc = 1
+            print(f"replay({replayer}) DIVERGED from the recorded placements:")
+            for line in flightrec.diff_placements(recorded, replayed):
+                print(f"  {line}")
+    if "greedy" in results and "tpu" in results:
+        diff = flightrec.diff_placements(results["greedy"], results["tpu"])
+        if diff:
+            print("greedy vs tpu differential (informational):")
+            for line in diff:
+                print(f"  {line}")
+        else:
+            print("greedy vs tpu: identical placements")
+    return rc
+
+
+def demo(tmp_dir: str) -> int:
+    """Zero-to-replay smoke: capture a record from a live solve through the
+    production wrapper (ResilientSolver), dump it, reload it, and replay."""
+    from karpenter_core_tpu.cloudprovider import fake
+    from karpenter_core_tpu.obs import FLIGHTREC, TRACER
+    from karpenter_core_tpu.solver.fallback import ResilientSolver
+    from karpenter_core_tpu.solver.tpu_solver import GreedySolver, TPUSolver
+    from karpenter_core_tpu.testing import make_pod, make_provisioner
+
+    TRACER.enable()
+    FLIGHTREC.enable(dump_dir=tmp_dir)
+    FLIGHTREC.clear()
+    n_pods = int(os.environ.get("KCT_REPLAY_DEMO_PODS", "48"))
+    pods = [
+        make_pod(labels={"app": f"demo-{i % 6}"}, requests={"cpu": "1"})
+        for i in range(n_pods)
+    ]
+    provisioners = [make_provisioner(name="default")]
+    instance_types = {"default": fake.instance_types(8)}
+    solver = ResilientSolver(
+        TPUSolver(max_nodes=max(64, n_pods // 4)), GreedySolver(),
+        prober=lambda: None,
+    )
+    result = solver.solve(pods, provisioners, instance_types)
+    placed = result.pod_count_new() + result.pod_count_existing()
+    record = FLIGHTREC.last()
+    if record is None:
+        print("replay-demo FAIL: no flight record captured", file=sys.stderr)
+        return 1
+    if placed != n_pods:
+        print(
+            f"replay-demo FAIL: live solve placed {placed}/{n_pods} pods",
+            file=sys.stderr,
+        )
+        return 1
+    path = FLIGHTREC.dump(record)
+    if not path:
+        print("replay-demo FAIL: record dump failed", file=sys.stderr)
+        return 1
+    print(f"captured {path}")
+    rc = replay_record(_load_record(path, -1), solver="both")
+    print("replay-demo ok" if rc == 0 else "replay-demo FAIL: replay diverged")
+    return rc
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description="solve flight-record replay")
+    parser.add_argument("record", nargs="?", help="record JSON (a dump file or a /debug/solves download)")
+    parser.add_argument("--index", type=int, default=-1,
+                        help="record index when the file holds a ring export")
+    parser.add_argument("--solver", choices=("greedy", "tpu", "both"),
+                        default="both")
+    parser.add_argument("--demo", action="store_true",
+                        help="capture a record from a live solve, then replay it")
+    args = parser.parse_args()
+    if args.demo:
+        import tempfile
+
+        return demo(os.path.join(tempfile.gettempdir(), "karpenter-flightrec"))
+    if not args.record:
+        parser.error("a record file is required (or --demo)")
+    return replay_record(_load_record(args.record, args.index), args.solver)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -48,6 +48,9 @@ import time
 from typing import Callable, Dict, Optional, Union
 
 from karpenter_core_tpu.metrics.registry import NAMESPACE, REGISTRY
+from karpenter_core_tpu.obs.log import get_logger
+
+LOG = get_logger("karpenter.chaos")
 
 CHAOS_INJECTED_TOTAL = REGISTRY.counter(
     f"{NAMESPACE}_chaos_injected_total",
@@ -202,6 +205,12 @@ class Fault:
             self.injected += 1
             kind = self._kind()
         CHAOS_INJECTED_TOTAL.inc({"point": self.point, "error": kind})
+        # a chaos run's log trail shows exactly which call got the fault
+        # (correlated by the bound controller/reconcile fields + trace id)
+        LOG.debug(
+            "chaos fault injected", point=self.point, kind=kind,
+            injected=self.injected,
+        )
         if self.latency > 0.0:
             time.sleep(self.latency)
         err = self._build_error()

@@ -28,6 +28,9 @@ from karpenter_core_tpu.controllers.deprovisioning.deprovisioners import (
     Expiration,
 )
 from karpenter_core_tpu.metrics.registry import NAMESPACE, NODES_CREATED, NODES_TERMINATED, REGISTRY
+from karpenter_core_tpu.obs.log import get_logger
+
+LOG = get_logger("karpenter.deprovisioning")
 
 POLLING_PERIOD = 10.0  # controller.go:58
 MAX_READINESS_WAIT = 9.5 * 60.0  # controller.go:62-70
@@ -85,6 +88,12 @@ class DeprovisioningController:
     def execute_command(self, deprovisioner, cmd: Command) -> None:
         """controller.go:143-194."""
         self.actions.inc({"action": f"{deprovisioner}/{cmd.action}"})
+        LOG.info(
+            "deprovisioning command", deprovisioner=str(deprovisioner),
+            action=cmd.action,
+            nodes=[n.metadata.name for n in cmd.nodes_to_remove],
+            replacements=len(cmd.replacement_machines or ()),
+        )
         if cmd.action == ACTION_REPLACE:
             if not self._launch_replacements(cmd, str(deprovisioner)):
                 return

@@ -301,8 +301,14 @@ def simulate_scheduling(
     with the candidates removed from the snapshot. Returns (new machines,
     all_pods_scheduled)."""
     from karpenter_core_tpu.obs import TRACER
+    from karpenter_core_tpu.obs.flightrec import suppress_recording
 
-    with TRACER.span("deprovisioning.simulate", candidates=len(candidates)):
+    # suppress_recording: simulation re-entries must not churn the flight
+    # recorder's ring (independent of whether tracing is enabled; the span
+    # below only labels the metric series)
+    with TRACER.span(
+        "deprovisioning.simulate", candidates=len(candidates)
+    ), suppress_recording():
         return _simulate_scheduling_traced(
             kube_client, cluster, provisioning, candidates
         )

@@ -53,30 +53,38 @@ class NodeMetricsController:
         self.overhead = REGISTRY.gauge(f"{NAMESPACE}_nodes_system_overhead")
 
     def reconcile(self) -> float:
-        for gauge in (
-            self.allocatable,
-            self.pod_requests,
-            self.pod_limits,
-            self.daemon_requests,
-            self.daemon_limits,
-            self.overhead,
-        ):
-            gauge.clear()
+        # build-then-swap: the old clear()-before-repopulate left a window
+        # where a concurrent REGISTRY.expose() observed an empty/partial
+        # scrape. Each gauge's new series set is built in full here and
+        # swapped atomically under the gauge lock (Gauge.replace_all) —
+        # a scrape sees the previous generation or the new one, never a
+        # blank exposition mid-rebuild.
+        series = {
+            gauge: []
+            for gauge in (
+                self.allocatable, self.pod_requests, self.pod_limits,
+                self.daemon_requests, self.daemon_limits, self.overhead,
+            )
+        }
         for state_node in self.cluster.nodes():
             for name, q in state_node.allocatable().items():
-                self.allocatable.set(q, _node_labels(state_node, name))
+                series[self.allocatable].append((q, _node_labels(state_node, name)))
             for name, q in state_node.total_pod_requests().items():
-                self.pod_requests.set(q, _node_labels(state_node, name))
+                series[self.pod_requests].append((q, _node_labels(state_node, name)))
             for name, q in state_node.total_pod_limits().items():
-                self.pod_limits.set(q, _node_labels(state_node, name))
+                series[self.pod_limits].append((q, _node_labels(state_node, name)))
             for name, q in state_node.total_daemonset_requests().items():
-                self.daemon_requests.set(q, _node_labels(state_node, name))
+                series[self.daemon_requests].append((q, _node_labels(state_node, name)))
             for name, q in state_node.total_daemonset_limits().items():
-                self.daemon_limits.set(q, _node_labels(state_node, name))
+                series[self.daemon_limits].append((q, _node_labels(state_node, name)))
             capacity = state_node.capacity()
             allocatable = state_node.allocatable()
             for name, q in capacity.items():
-                self.overhead.set(q - allocatable.get(name, 0.0), _node_labels(state_node, name))
+                series[self.overhead].append(
+                    (q - allocatable.get(name, 0.0), _node_labels(state_node, name))
+                )
+        for gauge, pairs in series.items():
+            gauge.replace_all(pairs)
         return SCRAPE_PERIOD
 
 
@@ -111,7 +119,16 @@ class PodMetricsController:
         self._labels[key] = labels
         if pod.status.phase == "Running" and pod.metadata.uid not in self._started:
             self._started.add(pod.metadata.uid)
-            self.startup.observe(self.clock() - pod.metadata.creation_timestamp)
+            # observation guard: an unset/zero creationTimestamp would
+            # record a multi-decade startup and negative clock skew a
+            # negative one — both corrupt every percentile of the
+            # histogram, so the observation is skipped (the pod still
+            # counts as started: re-observing later would be worse)
+            created = pod.metadata.creation_timestamp
+            if created:
+                elapsed = self.clock() - created
+                if elapsed >= 0.0:
+                    self.startup.observe(elapsed)
 
 
 class ProvisionerMetricsController:

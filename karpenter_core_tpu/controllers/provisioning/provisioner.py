@@ -28,8 +28,11 @@ from karpenter_core_tpu.controllers.provisioning.volumetopology import VolumeTop
 from karpenter_core_tpu.kube.objects import Node, NodeStatus, Pod
 from karpenter_core_tpu.metrics.registry import NAMESPACE, NODES_CREATED, REGISTRY
 from karpenter_core_tpu.obs import TRACER
+from karpenter_core_tpu.obs.log import get_logger
 from karpenter_core_tpu.solver.tpu_solver import GreedySolver, SolvedMachine, SolveResult
 from karpenter_core_tpu.utils import podutils
+
+LOG = get_logger("karpenter.provisioning")
 
 LAUNCH_FAILURES = REGISTRY.counter(
     f"{NAMESPACE}_launch_failures_total",
@@ -115,6 +118,14 @@ class ProvisioningController:
                 result.new_machines, LaunchOptions(record_pod_nomination=True)
             )
         created = sum(1 for n in names if n)
+        if created or errors or result.failed_pods:
+            LOG.info(
+                "provisioning pass",
+                machines=len(result.new_machines), launched=created,
+                launch_errors=len(errors),
+                existing=len(result.existing_assignments),
+                failed_pods=len(result.failed_pods), rounds=result.rounds,
+            )
         if any(self._launch_retryable(e) for e in errors):
             # level-triggered launch retry: the failed machines' pods are
             # still pending, the exhausted offerings are now ICE-masked —
@@ -337,10 +348,15 @@ class ProvisioningController:
                 kube_client=self.kube_client,
                 cluster=self.cluster,
             )
-        except Exception:
+        except Exception as solve_exc:
             if self.fallback_solver is self.solver:
                 raise
             # solver outage -> host greedy fallback (SURVEY.md section 7.8)
+            LOG.error(
+                "solver raised, using fallback solver",
+                error=type(solve_exc).__name__, error_detail=str(solve_exc),
+                pods=len(pending),
+            )
             return self.fallback_solver.solve(
                 pending,
                 provisioners,
@@ -421,11 +437,16 @@ class ProvisioningController:
                     names.append("")
                     errors.append(e)
                     if isinstance(e, InsufficientCapacityError):
-                        LAUNCH_FAILURES.inc({"reason": "insufficient_capacity"})
+                        reason = "insufficient_capacity"
                     elif self._launch_retryable(e):
-                        LAUNCH_FAILURES.inc({"reason": "transient"})
+                        reason = "transient"
                     else:
-                        LAUNCH_FAILURES.inc({"reason": "error"})
+                        reason = "error"
+                    LAUNCH_FAILURES.inc({"reason": reason})
+                    LOG.warning(
+                        "machine launch failed", reason=reason,
+                        error=type(e).__name__, error_detail=str(e),
+                    )
         return names, errors
 
     def _launch_one(self, machine: SolvedMachine, opts: LaunchOptions) -> str:

@@ -190,6 +190,33 @@ class Recorder:
             return True
         return self._post_idle.wait(timeout)
 
+    def export(self) -> List[dict]:
+        """The ring as JSON-able dicts for the operator's /debug/events —
+        dedupe/rate-limit metadata included, so an exported trail shows WHY
+        an expected event is absent (deduped vs rate-limited vs never
+        published)."""
+        with self._mu:
+            events = list(self.events)
+        return [
+            {
+                "kind": e.involved_kind,
+                "name": e.involved_name,
+                "type": e.type,
+                "reason": e.reason,
+                "message": e.message,
+                "timestamp": e.timestamp,
+                "dedupe_values": list(e.dedupe_values),
+                "dedupe_timeout": (
+                    self.DEDUPE_TTL if e.dedupe_timeout is None
+                    else e.dedupe_timeout
+                ),
+                "rate_limit": (
+                    list(e.rate_limit) if e.rate_limit is not None else None
+                ),
+            }
+            for e in events
+        ]
+
     def for_object(self, kind: str, name: str) -> List[Event]:
         with self._mu:
             return [e for e in self.events if e.involved_kind == kind and e.involved_name == name]

@@ -35,6 +35,9 @@ from karpenter_core_tpu.kube.client import (
 )
 from karpenter_core_tpu.kube.serialization import from_k8s_dict, to_k8s_dict
 from karpenter_core_tpu.metrics.registry import NAMESPACE, REGISTRY
+from karpenter_core_tpu.obs.log import get_logger
+
+LOG = get_logger("karpenter.kube")
 
 KUBE_TRANSPORT_RETRIES = REGISTRY.counter(
     f"{NAMESPACE}_kube_transport_retries_total",
@@ -229,6 +232,13 @@ class ApiServerKubeClient:
             KUBE_TRANSPORT_RETRIES.inc({"method": method})
             delay = self._backoff(attempt, retry_after)
             attempt += 1
+            # correlated retry trail: inside a reconcile the bound
+            # controller/reconcile-id fields (obs/log) ride along, so a
+            # blipping apiserver shows up attributed, not anonymous
+            LOG.warning(
+                "kube transport retry", method=method, path=path,
+                status=status, attempt=attempt, delay_s=round(delay, 3),
+            )
             if delay > 0:
                 time.sleep(delay)
 

@@ -101,6 +101,15 @@ class Gauge:
         with self._mu:
             self.values.clear()
 
+    def replace_all(self, pairs: Iterable[Tuple[float, Optional[Dict[str, str]]]]) -> None:
+        """Atomically swap the whole series set to `pairs` ((value, labels)
+        tuples): scrapers reading under the same lock (expose) see either
+        the previous generation or the new one, never a cleared/partial
+        one — the clear()-then-set scrape race's fix."""
+        new_values = {_labels(labels): value for value, labels in pairs}
+        with self._mu:
+            self.values = new_values
+
 
 class Histogram:
     def __init__(self, name: str, help: str = "", buckets: Iterable[float] = DURATION_BUCKETS):

@@ -1,12 +1,26 @@
-"""Observability: end-to-end solve-path tracing.
+"""Observability: end-to-end solve-path tracing, structured logging, and
+the solve flight recorder.
 
 The production hot path (provisioner reconcile -> batcher window ->
 Scheduler.Solve() -> TPUSolver phases -> gRPC solver service -> bind) is
-instrumented with the process-wide TRACER from obs.tracer. Import the
-singleton from here:
+instrumented with the process-wide TRACER from obs.tracer; log lines join
+spans through obs.log's trace-id correlation, and obs.flightrec captures
+replayable per-Solve input/outcome records. Import the singletons from
+here:
 
-    from karpenter_core_tpu.obs import TRACER, device_profiler
+    from karpenter_core_tpu.obs import TRACER, FLIGHTREC, get_logger
 """
+from karpenter_core_tpu.obs.flightrec import (
+    FLIGHTREC,
+    FlightRecorder,
+    enable_flightrec_from_env,
+)
+from karpenter_core_tpu.obs.log import (
+    SINK as LOG_SINK,
+    bound as log_bound,
+    configure_logging_from_env,
+    get_logger,
+)
 from karpenter_core_tpu.obs.tracer import (
     TRACER,
     TRACE_HEADER,
@@ -20,4 +34,6 @@ from karpenter_core_tpu.obs.tracer import (
 __all__ = [
     "TRACER", "TRACE_HEADER", "Span", "Tracer", "device_profiler",
     "enable_tracing_from_env", "profile_dir",
+    "LOG_SINK", "log_bound", "configure_logging_from_env", "get_logger",
+    "FLIGHTREC", "FlightRecorder", "enable_flightrec_from_env",
 ]

@@ -1,0 +1,8 @@
+"""Shared truthy/falsy env-var spellings for the obs/ arming hooks
+(KARPENTER_TPU_TRACE / KARPENTER_TPU_LOG / KARPENTER_TPU_FLIGHTREC), so the
+three parsers cannot drift. The empty string is deliberately NOT in FALSY:
+each parser decides what "unset" means (tracer/flightrec leave state to the
+entrypoint default; the log parser treats it as off)."""
+
+TRUTHY = ("1", "true", "on", "yes")
+FALSY = ("0", "false", "off", "no")

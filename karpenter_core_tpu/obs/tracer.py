@@ -357,8 +357,7 @@ class Tracer:
 # the process-wide tracer
 TRACER = Tracer()
 
-_TRUTHY = ("1", "true", "on", "yes")
-_FALSY = ("0", "false", "off", "no")
+from karpenter_core_tpu.obs.envflags import FALSY as _FALSY, TRUTHY as _TRUTHY  # noqa: E402
 
 
 def enable_tracing_from_env(default_on: bool = False) -> bool:

@@ -159,11 +159,11 @@ class Operator:
             ("Machine", MachineInformer(self.cluster).handle),
             ("Provisioner", ProvisionerInformer(self.cluster).handle),
         ]
-        import logging
         import queue as queue_mod
 
         from karpenter_core_tpu import chaos
         from karpenter_core_tpu.metrics.registry import NAMESPACE, REGISTRY
+        from karpenter_core_tpu.obs.log import get_logger
         from karpenter_core_tpu.operator.controller import RECONCILE_ERRORS
 
         relists = REGISTRY.counter(
@@ -171,7 +171,7 @@ class Operator:
             "Watch relists after a dropped/stale stream or failed event "
             "delivery, by kind (the informer list-then-watch recovery)",
         )
-        log = logging.getLogger("karpenter.operator")
+        log = get_logger("karpenter.operator")
         for kind, handler in watches:
             q = self.kube_client.watch(kind)
 
@@ -257,7 +257,7 @@ class Operator:
                             known[key] = True
                     except Exception:
                         RECONCILE_ERRORS.inc(labels={"controller": f"watch-{kind}"})
-                        log.exception("watch pump failed (kind=%s)", kind)
+                        log.exception("watch pump failed", kind=kind)
                         # the failed event is lost from the stream's point
                         # of view: recover by relisting so the store state
                         # (including whatever that event carried) lands —
@@ -269,9 +269,7 @@ class Operator:
                                 last_event = time.monotonic()
                                 break
                             except Exception:
-                                log.exception(
-                                    "watch relist failed (kind=%s)", kind
-                                )
+                                log.exception("watch relist failed", kind=kind)
                                 self._stop.wait(0.2)
 
             t = threading.Thread(target=pump, daemon=True)

@@ -35,7 +35,10 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from karpenter_core_tpu.api.settings import Settings
 from karpenter_core_tpu.metrics.registry import REGISTRY
+from karpenter_core_tpu.obs.log import get_logger
 from karpenter_core_tpu.operator import new_operator
+
+LOG = get_logger("karpenter.operator")
 
 
 def solver_from_env():
@@ -83,14 +86,20 @@ class _ControllerContextFilter:
 
 
 def configure_logging() -> None:
-    """KARPENTER_LOGGING_CONFIG (a logging dictConfig JSON, injected from the
+    """Arm the package's structured logger (obs/log) from KARPENTER_TPU_LOG
+    — on at info by default in the control-plane process, like tracing —
+    and keep the legacy stdlib path for vendor libraries:
+    KARPENTER_LOGGING_CONFIG (a logging dictConfig JSON, injected from the
     config-logging ConfigMap — the analog of the reference's zap ConfigMap,
     operator.go:95-100) wins; otherwise basicConfig at KARPENTER_LOG_LEVEL.
-    Either way, records carry the injected controller name."""
+    Either way, stdlib records carry the injected controller name."""
     import json
     import logging
     import logging.config
 
+    from karpenter_core_tpu.obs.log import configure_logging_from_env
+
+    configure_logging_from_env(default_level="info")
     raw = os.environ.get("KARPENTER_LOGGING_CONFIG", "")
     configured = False
     if raw:
@@ -98,7 +107,10 @@ def configure_logging() -> None:
             logging.config.dictConfig(json.loads(raw))
             configured = True
         except (ValueError, TypeError, AttributeError, ImportError) as exc:
-            print(f"invalid KARPENTER_LOGGING_CONFIG, using basicConfig: {exc}")
+            LOG.warning(
+                "invalid KARPENTER_LOGGING_CONFIG, using basicConfig",
+                error_detail=str(exc),
+            )
     if not configured:
         level = os.environ.get("KARPENTER_LOG_LEVEL", "INFO").upper()
         logging.basicConfig(
@@ -161,6 +173,35 @@ class _HealthHandler(BaseHTTPRequestHandler):
 
             body = TRACER.summary().encode() + b"\n"
             ctype = "text/plain"
+        elif self.path == "/debug/logs" and self.profiling_enabled:
+            # the structured-log ring (obs/log): logfmt lines, trace ids
+            # joining /debug/trace spans
+            from karpenter_core_tpu.obs.log import SINK
+
+            body = SINK.lines().encode()
+            ctype = "text/plain"
+        elif self.path == "/debug/logs.json" and self.profiling_enabled:
+            from karpenter_core_tpu.obs.log import SINK, format_json
+
+            body = ("[" + ",".join(
+                format_json(r) for r in SINK.records()
+            ) + "]").encode()
+            ctype = "application/json"
+        elif self.path == "/debug/solves" and self.profiling_enabled:
+            # the solve flight-record ring (obs/flightrec): download, then
+            # `python hack/replay.py` any record offline
+            from karpenter_core_tpu.obs.flightrec import FLIGHTREC
+
+            body = FLIGHTREC.to_json().encode()
+            ctype = "application/json"
+        elif self.path == "/debug/events" and self.profiling_enabled:
+            # the events Recorder ring (events/__init__), dedupe/rate-limit
+            # metadata included
+            recorder = getattr(self.operator, "recorder", None)
+            body = json.dumps(
+                recorder.export() if recorder is not None else []
+            ).encode()
+            ctype = "application/json"
         elif self.path in ("/healthz", "/readyz"):
             body = json.dumps({"status": "ok"}).encode()
             ctype = "application/json"
@@ -234,6 +275,12 @@ def run(cloud_provider, kube_client=None, stop_event=None, options=None):
     from karpenter_core_tpu.obs import enable_tracing_from_env
 
     enable_tracing_from_env(default_on=True)
+    # the solve flight recorder is ON in the production control plane for
+    # the same reason tracing is: a bad placement is only debuggable if
+    # its exact inputs were captured. KARPENTER_TPU_FLIGHTREC=0 opts out.
+    from karpenter_core_tpu.obs import enable_flightrec_from_env
+
+    enable_flightrec_from_env(default_on=True)
     # restart-survivable compiled programs: a rebooted control plane must
     # not blank provisioning for the cold-compile window (utils/compilecache)
     from karpenter_core_tpu.utils.compilecache import enable_persistent_cache
@@ -257,11 +304,7 @@ def run(cloud_provider, kube_client=None, stop_event=None, options=None):
             from karpenter_core_tpu.solver.factory import build_solver, describe
 
             primary = build_solver()
-            import logging
-
-            logging.getLogger(__name__).info(
-                "in-process solver: %s", describe(primary)
-            )
+            LOG.info("in-process solver", solver=describe(primary))
     # production backend-failure defense: subprocess-probe the accelerator,
     # route solves to the host greedy path while it is wedged/unavailable,
     # re-probe for recovery (solver/fallback.py)
@@ -323,12 +366,10 @@ def run(cloud_provider, kube_client=None, stop_event=None, options=None):
         try:
             webhook_server.start()
         except Exception as exc:  # port conflict, apiserver 4xx, cert race
-            print(f"webhook server disabled: {exc}", flush=True)
+            LOG.warning("webhook server disabled", error_detail=str(exc))
             webhook_server = None
     operator.start()
-    print(
-        f"controller running; health/metrics on :{opts.metrics_port}", flush=True
-    )
+    LOG.info("controller running", metrics_port=opts.metrics_port)
     stop.wait()
     operator.stop()
     if elector is not None:

@@ -10,7 +10,7 @@ replacement.
 """
 from __future__ import annotations
 
-import logging
+import itertools
 import queue
 import random
 import threading
@@ -19,9 +19,15 @@ from typing import Callable, Optional
 
 from karpenter_core_tpu.metrics.registry import REGISTRY
 from karpenter_core_tpu.obs import TRACER
+from karpenter_core_tpu.obs.log import bound as log_bound, get_logger
 from karpenter_core_tpu.operator.injection import with_controller_name
 
-LOG = logging.getLogger("karpenter.controller")
+LOG = get_logger("karpenter.controller")
+
+# process-wide reconcile ids: every log line inside one reconcile carries the
+# same reconcile=rNNN field (the request-id analog of the reference's
+# controller-runtime request logging), so a failing pass greps as a unit
+_reconcile_ids = itertools.count(1)
 
 RECONCILE_DURATION = REGISTRY.histogram(
     "karpenter_controller_reconcile_duration_seconds",
@@ -65,14 +71,20 @@ class Singleton:
     def reconcile_once(self) -> Optional[float]:
         """One instrumented reconcile; returns the wait before the next."""
         start = time.perf_counter()
+        # allocated OUTSIDE the bound scope so the failure line below (the
+        # one record that explains a pass) carries the same reconcile id as
+        # the pass's in-scope lines
+        reconcile_id = f"r{next(_reconcile_ids)}"
         try:
             # spans nest: a provisioning reconcile's solve phases land under
             # this root in the exported trace. RECONCILE_DURATION is observed
             # in the finally below (always on), so the tracer's metrics
-            # bridge deliberately skips controller.reconcile spans.
-            with with_controller_name(self.name), TRACER.span(
-                "controller.reconcile", controller=self.name
-            ):
+            # bridge deliberately skips controller.reconcile spans. The log
+            # binding stamps every line emitted below (any depth) with the
+            # controller + reconcile id, correlating logs across the pass.
+            with with_controller_name(self.name), log_bound(
+                controller=self.name, reconcile=reconcile_id
+            ), TRACER.span("controller.reconcile", controller=self.name):
                 requeue_after = self.reconcile()
         except Exception:
             RECONCILE_ERRORS.inc(labels={"controller": self.name})
@@ -91,8 +103,9 @@ class Singleton:
             )
             self._last_backoff = max(backoff, ERROR_BACKOFF_BASE)
             LOG.exception(
-                "reconcile failed (controller=%s, failures=%d, backoff=%.3fs)",
-                self.name, self._failures, backoff,
+                "reconcile failed", controller=self.name,
+                reconcile=reconcile_id, failures=self._failures,
+                backoff_s=round(backoff, 3),
             )
             return backoff
         finally:
@@ -213,7 +226,7 @@ def reconcile_concurrently(name: str, items, reconcile_fn, max_workers: int = 10
         return 0
 
     def one(obj):
-        with with_controller_name(name):
+        with with_controller_name(name), log_bound(controller=name):
             return reconcile_fn(obj)
 
     errors = 0
@@ -223,6 +236,6 @@ def reconcile_concurrently(name: str, items, reconcile_fn, max_workers: int = 10
             result()
         except Exception:
             RECONCILE_ERRORS.inc(labels={"controller": name})
-            LOG.exception("reconcile failed (controller=%s)", name)
+            LOG.exception("reconcile failed", controller=name)
             errors += 1
     return errors

@@ -34,6 +34,10 @@ from typing import Optional
 
 from karpenter_core_tpu.events import Event
 from karpenter_core_tpu.metrics.registry import NAMESPACE, REGISTRY
+from karpenter_core_tpu.obs.flightrec import FLIGHTREC, recording_suppressed
+from karpenter_core_tpu.obs.log import get_logger
+
+LOG = get_logger("karpenter.solver.fallback")
 
 SOLVER_FALLBACK_TOTAL = REGISTRY.counter(
     f"{NAMESPACE}_solver_fallback_total",
@@ -84,10 +88,15 @@ class CircuitBreaker:
 
     def _transition(self, state: str) -> None:
         if state != self._state:
+            was = self._state
             self._state = state
             BREAKER_TRANSITIONS.inc({"breaker": self.name, "to": state})
             BREAKER_OPEN.set(
                 1.0 if state == self.OPEN else 0.0, {"breaker": self.name}
+            )
+            LOG.info(
+                "circuit breaker transition", breaker=self.name,
+                from_state=was, to_state=state, failures=self._failures,
             )
 
     @property
@@ -228,11 +237,16 @@ class ResilientSolver:
                 self._healthy = reason is None
                 self._reason = reason or ""
                 if was is not False and not self._healthy:
+                    LOG.warning(
+                        "solver degraded", reason=self._reason,
+                        probe="backend",
+                    )
                     self._event(
                         "SolverDegraded", "Warning",
                         f"accelerator backend unavailable ({self._reason}); "
                         "falling back to the host solver")
                 elif was is False and self._healthy:
+                    LOG.info("solver recovered", probe="backend")
                     self._event("SolverRecovered", "Normal",
                                 "accelerator backend recovered")
             return bool(self._healthy)
@@ -279,6 +293,7 @@ class ResilientSolver:
             self._healthy = False
             self._last_probe = self.clock()
             self._reason = reason
+        LOG.warning("solver degraded", reason=reason, probe="solve")
         self._event("SolverDegraded", "Warning",
                     f"primary solver failed ({reason}); "
                     "falling back to the host solver")
@@ -357,8 +372,42 @@ class ResilientSolver:
             state_nodes, kube_client=kube_client, cluster=cluster,
         )
 
+    def _recorded_fallback(self, rec, backend, dump, pods, provisioners,
+                           instance_types, daemonset_pods, state_nodes,
+                           kube_client, cluster):
+        """Fallback solve with the flight record closed on EVERY exit: a
+        fallback that itself raises is the worst incident of all — the
+        record is finalized (and dumped) before the exception propagates."""
+        try:
+            result = self._fallback_solve(
+                pods, provisioners, instance_types, daemonset_pods,
+                state_nodes, kube_client, cluster,
+            )
+        except Exception as e:
+            if rec is not None:
+                rec.finish_error(backend, e)
+            raise
+        if rec is not None:
+            rec.finish(backend, result, dump=dump)
+        return result
+
     def solve(self, pods, provisioners, instance_types, daemonset_pods=None,
               state_nodes=None, kube_client=None, cluster=None, encoded=None):
+        # flight recorder (obs/flightrec): snapshot the exact inputs of
+        # this Solve so a bad placement replays offline through
+        # hack/replay.py. Disabled (the default): one flag check, rec=None.
+        # Deprovisioning-simulation re-entries are deliberately NOT
+        # recorded (flightrec.suppress_recording, armed by
+        # deprovisioning/core.simulate_scheduling): consolidation re-enters
+        # this solver every pass and would churn the ring past the
+        # provisioning records an incident actually needs.
+        rec = None
+        if FLIGHTREC.enabled and not recording_suppressed():
+            rec = FLIGHTREC.begin(
+                pods, provisioners, instance_types, daemonset_pods,
+                state_nodes, kube_client=kube_client,
+                max_nodes=self.max_nodes,
+            )
         # tiny batches: the serial FFD beats the device path's fixed
         # encode/transfer cost — route without blocking on primary health,
         # while _maybe_bg_probe keeps the verdict fresh on the normal TTLs
@@ -368,23 +417,29 @@ class ResilientSolver:
         if self._small_batch(pods, instance_types):
             SOLVER_SMALL_BATCH_TOTAL.inc()
             self._maybe_bg_probe()
-            return self._fallback_solve(
-                pods, provisioners, instance_types, daemonset_pods,
-                state_nodes, kube_client, cluster,
+            return self._recorded_fallback(
+                rec, "host.small_batch", False, pods, provisioners,
+                instance_types, daemonset_pods, state_nodes, kube_client,
+                cluster,
             )
         if not self.healthy():
             SOLVER_FALLBACK_TOTAL.inc({"reason": "backend_unavailable"})
-            return self._fallback_solve(
-                pods, provisioners, instance_types, daemonset_pods,
-                state_nodes, kube_client, cluster,
+            # a fallback trip is an incident worth keeping: dump to disk
+            return self._recorded_fallback(
+                rec, "host.backend_unavailable", True, pods, provisioners,
+                instance_types, daemonset_pods, state_nodes, kube_client,
+                cluster,
             )
         try:
             kwargs = {"encoded": encoded} if encoded is not None else {}
-            return self._primary_solve(
+            result = self._primary_solve(
                 pods, provisioners, instance_types, daemonset_pods,
                 state_nodes, kube_client=kube_client, cluster=cluster,
                 **kwargs,
             )
+            if rec is not None:
+                rec.finish("primary", result, replayer="tpu")
+            return result
         except Exception as e:  # noqa: BLE001 — degrade, never stall
             # typed solver-RPC errors classify themselves: a REQUEST defect
             # (INVALID_ARGUMENT / RESOURCE_EXHAUSTED) means the backend is
@@ -392,12 +447,23 @@ class ResilientSolver:
             # next one goes to the primary again. Transport/internal
             # failures (and everything untyped) mark the backend dead as
             # before.
+            if rec is not None:
+                rec.note_primary_error(e)
+            LOG.error(
+                "primary solve failed, routing to fallback",
+                error=type(e).__name__, error_detail=str(e),
+                pods=len(pods),
+            )
             if getattr(e, "marks_unhealthy", True):
                 self._mark_dead(f"{type(e).__name__}: {e}")
                 SOLVER_FALLBACK_TOTAL.inc({"reason": "primary_error"})
             else:
                 SOLVER_FALLBACK_TOTAL.inc({"reason": "request_rejected"})
-            return self._fallback_solve(
-                pods, provisioners, instance_types, daemonset_pods,
-                state_nodes, kube_client, cluster,
+            # note_primary_error makes the record auto-dump on finish; if
+            # the fallback ALSO raises, _recorded_fallback finalizes the
+            # record via finish_error before the exception propagates
+            return self._recorded_fallback(
+                rec, "host.primary_error", False, pods, provisioners,
+                instance_types, daemonset_pods, state_nodes, kube_client,
+                cluster,
             )

@@ -26,6 +26,9 @@ import numpy as np
 from karpenter_core_tpu import chaos
 from karpenter_core_tpu.metrics.registry import NAMESPACE, REGISTRY
 from karpenter_core_tpu.obs import TRACE_HEADER, TRACER
+from karpenter_core_tpu.obs.log import get_logger
+
+LOG = get_logger("karpenter.solver.service")
 from karpenter_core_tpu.solver import service_pb2 as pb
 from karpenter_core_tpu.solver.encode import encode_snapshot
 from karpenter_core_tpu.solver.tpu_solver import (
@@ -547,6 +550,10 @@ class RemoteSolver:
                 self.breaker.record_failure()
                 if attempt < self.rpc_retries:
                     SOLVER_RPC_RETRIES.inc()
+                    LOG.warning(
+                        "solver rpc retrying", target=self.target,
+                        attempt=attempt + 1, error=type(err).__name__,
+                    )
                     # exponential backoff with full jitter (utils/backoff):
                     # N control planes retrying one dead service must not
                     # re-land in lockstep
@@ -705,12 +712,15 @@ def main(argv: Optional[List[str]] = None) -> None:
     enable_persistent_cache()
     import os
 
-    # server-side solve tracing, on by default like the operator's
-    # (KARPENTER_TPU_TRACE=0/false/off opts out); spans adopt the client's
-    # propagated trace id so both processes share one timeline
+    # server-side solve tracing + structured logging, on by default like
+    # the operator's (KARPENTER_TPU_TRACE=0 / KARPENTER_TPU_LOG=off opt
+    # out); spans adopt the client's propagated trace id so both processes
+    # share one timeline
     from karpenter_core_tpu.obs import enable_tracing_from_env
+    from karpenter_core_tpu.obs.log import configure_logging_from_env
 
     enable_tracing_from_env(default_on=True)
+    configure_logging_from_env(default_level="info")
     # multi-chip containers (v5e-4) serve every Solve through the sharded
     # program; KARPENTER_SOLVER_MODE=single pins the one-chip path
 
@@ -745,19 +755,21 @@ def main(argv: Optional[List[str]] = None) -> None:
                 [make_provisioner(name="default")],
                 {"default": _fake.instance_types(4)},
             )
-            print(
-                f"solver warmup done in {_time.perf_counter() - t0:.1f}s",
-                flush=True,
+            LOG.info(
+                "solver warmup done",
+                seconds=round(_time.perf_counter() - t0, 1),
             )
         except Exception as exc:  # noqa: BLE001 — serve anyway
-            print(f"solver warmup failed (serving anyway): {exc}", flush=True)
+            LOG.warning(
+                "solver warmup failed, serving anyway",
+                error=type(exc).__name__, error_detail=str(exc),
+            )
     server, port, _service = serve(
         f"{args.host}:{args.port}", max_workers=args.max_workers, mesh=mesh
     )
     if mesh is not None:
-        print(
-            f"solver service mesh: dp={mesh.shape['dp']} tp={mesh.shape['tp']}",
-            flush=True,
+        LOG.info(
+            "solver service mesh", dp=mesh.shape["dp"], tp=mesh.shape["tp"]
         )
     # decode runs in THIS process in a split deployment: apply the shared
     # long-lived-server GC posture (utils/gctuning.py) so gen-2 pauses
@@ -765,7 +777,7 @@ def main(argv: Optional[List[str]] = None) -> None:
     from karpenter_core_tpu.utils.gctuning import apply_server_gc_tuning
 
     apply_server_gc_tuning()
-    print(f"solver service listening on {args.host}:{port}", flush=True)
+    LOG.info("solver service listening", host=args.host, port=port)
     stop = threading.Event()
     for sig in (signal.SIGTERM, signal.SIGINT):
         signal.signal(sig, lambda *_: stop.set())

@@ -23,6 +23,16 @@ if len(jax.devices()) < 8:  # honor a pre-set device-count flag if present
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# the production persistent XLA compile cache (utils/compilecache — the
+# operator/service/bench all enable it at boot): test files construct fresh
+# solver instances whose in-process executable caches can't share, so
+# without it the suite re-pays the same geometry compiles dozens of times.
+# Must be configured before the first jit dispatch; KARPENTER_COMPILE_CACHE_DIR=off
+# opts out (e.g. when measuring cold-compile behavior).
+from karpenter_core_tpu.utils.compilecache import enable_persistent_cache  # noqa: E402
+
+enable_persistent_cache()
+
 
 def pytest_configure(config):
     config.addinivalue_line(

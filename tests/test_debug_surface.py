@@ -1,0 +1,125 @@
+"""Operator debug surface (ISSUE 3): /debug/logs, /debug/solves, and
+/debug/events served by the health endpoint, gated on profiling like the
+existing /debug/trace — and the events export preserving dedupe/rate-limit
+metadata."""
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from karpenter_core_tpu.cloudprovider import fake
+from karpenter_core_tpu.events import Event, Recorder
+
+
+@pytest.fixture
+def health_server():
+    from karpenter_core_tpu.operator import __main__ as entry, new_operator
+
+    operator = new_operator(
+        fake.FakeCloudProvider(), settings=entry.settings_from_env()
+    )
+    server = entry.serve_health(operator, 0, profiling=True)
+    port = server.server_address[1]
+    yield operator, port
+    server.shutdown()
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+        return r.status, r.read()
+
+
+def test_debug_logs_served(health_server):
+    import karpenter_core_tpu.obs.log as log_mod
+
+    _operator, port = health_server
+    was_level, was_stream = log_mod.SINK.level, log_mod.SINK.stream
+    log_mod.SINK.configure(level=log_mod.INFO, stream=None)
+    try:
+        log_mod.get_logger("karpenter.test").info(
+            "debug surface probe", marker="xyzzy"
+        )
+        status, body = _get(port, "/debug/logs")
+        assert status == 200
+        assert b"debug surface probe" in body
+        assert b"marker=xyzzy" in body
+        status, body = _get(port, "/debug/logs.json")
+        records = json.loads(body)
+        assert any(r.get("marker") == "xyzzy" for r in records)
+    finally:
+        log_mod.SINK.level, log_mod.SINK.stream = was_level, was_stream
+
+
+def test_debug_solves_served(health_server):
+    from karpenter_core_tpu.obs.flightrec import FLIGHTREC
+    from karpenter_core_tpu.solver.tpu_solver import GreedySolver
+    from karpenter_core_tpu.testing import make_pod, make_provisioner
+
+    _operator, port = health_server
+    was_enabled = FLIGHTREC.enabled
+    FLIGHTREC.enable()
+    try:
+        pods = [make_pod(requests={"cpu": "1"}) for _ in range(4)]
+        provisioners = [make_provisioner(name="default")]
+        its = {"default": fake.instance_types(2)}
+        rec = FLIGHTREC.begin(pods, provisioners, its)
+        rec.finish("host.small_batch", GreedySolver().solve(pods, provisioners, its))
+        status, body = _get(port, "/debug/solves")
+        assert status == 200
+        export = json.loads(body)
+        assert export["records"]
+        last = export["records"][-1]
+        assert last["backend"] == "host.small_batch"
+        assert len(last["inputs"]["pods"]) == 4
+        assert last["outcome"]["placements"]["machines"]
+    finally:
+        FLIGHTREC.enabled = was_enabled
+
+
+def test_debug_events_preserves_dedupe_and_rate_limit_metadata(health_server):
+    operator, port = health_server
+    recorder: Recorder = operator.recorder
+    # a rate-limited event (pod nomination carries the shared token bucket)
+    pod = type("P", (), {})()
+    pod.metadata = type("M", (), {})()
+    pod.metadata.namespace, pod.metadata.name = "default", "nominated-pod"
+    recorder.nominate_pod(pod, "node-a")
+    # a deduped event with explicit dedupe values + custom timeout
+    recorder.publish(
+        Event(
+            "Solver", "solver", "Warning", "SolverDegraded",
+            "backend unavailable", dedupe_values=("SolverDegraded",),
+            dedupe_timeout=300.0,
+        )
+    )
+    status, body = _get(port, "/debug/events")
+    assert status == 200
+    events = json.loads(body)
+    nominated = next(e for e in events if e["reason"] == "Nominated")
+    assert nominated["rate_limit"] == list(Recorder.POD_NOMINATION_RATE_LIMIT)
+    assert nominated["dedupe_timeout"] == Recorder.DEDUPE_TTL
+    assert nominated["timestamp"] > 0
+    degraded = next(e for e in events if e["reason"] == "SolverDegraded")
+    assert degraded["dedupe_values"] == ["SolverDegraded"]
+    assert degraded["dedupe_timeout"] == 300.0
+    assert degraded["rate_limit"] is None
+    # the export also round-trips through the recorder's own surface
+    assert recorder.export()[-1]["reason"] == "SolverDegraded"
+
+
+def test_debug_surface_gated_on_profiling():
+    from karpenter_core_tpu.operator import __main__ as entry, new_operator
+
+    operator = new_operator(
+        fake.FakeCloudProvider(), settings=entry.settings_from_env()
+    )
+    server = entry.serve_health(operator, 0, profiling=False)
+    port = server.server_address[1]
+    try:
+        for path in ("/debug/logs", "/debug/solves", "/debug/events"):
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get(port, path)
+            assert err.value.code == 404, path
+    finally:
+        server.shutdown()

@@ -1,0 +1,567 @@
+"""Flight-recorder suite (ISSUE 3 tentpole): input snapshot round trip,
+byte-identical replay, the seeded GreedySolver-vs-TPUSolver differential
+replay, ResilientSolver capture/auto-dump wiring, and the disabled fast
+path."""
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+from karpenter_core_tpu.api.labels import (
+    LABEL_CAPACITY_TYPE,
+    LABEL_NODE_INITIALIZED,
+    PROVISIONER_NAME_LABEL_KEY,
+)
+from karpenter_core_tpu.cloudprovider import fake
+from karpenter_core_tpu.kube.objects import (
+    LABEL_INSTANCE_TYPE_STABLE,
+    LABEL_TOPOLOGY_ZONE,
+    LabelSelector,
+    Taint,
+    Toleration,
+    TopologySpreadConstraint,
+)
+from karpenter_core_tpu.obs import flightrec
+from karpenter_core_tpu.obs.flightrec import (
+    FlightRecorder,
+    canonical_placements,
+    input_digest,
+    placements_json,
+    restore_inputs,
+    snapshot_inputs,
+)
+from karpenter_core_tpu.solver.fallback import ResilientSolver
+from karpenter_core_tpu.solver.tpu_solver import GreedySolver, TPUSolver
+from karpenter_core_tpu.state.node import StateNode
+from karpenter_core_tpu.testing import make_node, make_pod, make_provisioner
+
+ZONES = ["test-zone-1", "test-zone-2", "test-zone-3"]
+
+
+def _workload(seed: int = 7, n_pods: int = 24):
+    """Constraint-rich inputs: selectors, taints/tolerations, zonal spread,
+    host ports, and populated existing nodes — the snapshot must carry all
+    of it for a faithful replay."""
+    rng = np.random.default_rng(seed)
+    universe = fake.instance_types(6)
+    zonal = TopologySpreadConstraint(
+        max_skew=1,
+        topology_key=LABEL_TOPOLOGY_ZONE,
+        when_unsatisfiable="DoNotSchedule",
+        label_selector=LabelSelector(match_labels={"app": "spread"}),
+    )
+    pods = [
+        make_pod(requests={"cpu": "0.1"}, node_selector={LABEL_TOPOLOGY_ZONE: z})
+        for z in ZONES
+    ]
+    pods.append(make_pod(requests={"cpu": "0.1"}, host_ports=[9000]))
+    pods.append(
+        make_pod(
+            requests={"cpu": "0.1"},
+            tolerations=[Toleration(key="dedicated", operator="Exists")],
+        )
+    )
+    while len(pods) < n_pods:
+        kind = int(rng.integers(0, 3))
+        cpu = str(float(rng.choice([0.25, 0.5, 1.0])))
+        if kind == 0:
+            pods.append(
+                make_pod(labels={"app": "spread"}, requests={"cpu": cpu},
+                         topology_spread=[zonal])
+            )
+        elif kind == 1:
+            pods.append(
+                make_pod(requests={"cpu": cpu},
+                         node_selector={LABEL_TOPOLOGY_ZONE: str(rng.choice(ZONES))})
+            )
+        else:
+            pods.append(make_pod(labels={"app": "plain"}, requests={"cpu": cpu}))
+    nodes = []
+    for e in range(3):
+        it = universe[e % len(universe)]
+        sn = StateNode(
+            node=make_node(
+                name=f"rec-node-{e}",
+                labels={
+                    PROVISIONER_NAME_LABEL_KEY: "default",
+                    LABEL_NODE_INITIALIZED: "true",
+                    LABEL_INSTANCE_TYPE_STABLE: it.name,
+                    LABEL_CAPACITY_TYPE: "on-demand",
+                    LABEL_TOPOLOGY_ZONE: ZONES[e % 3],
+                },
+                capacity={k: str(v) for k, v in it.capacity.items()},
+            )
+        )
+        # bound-pod bookkeeping the snapshot must preserve
+        bound_pod = make_pod(requests={"cpu": "0.5"}, host_ports=[9000 + e])
+        bound_pod.spec.node_name = sn.name()
+        sn.update_for_pod(bound_pod)
+        nodes.append(sn)
+    provisioners = [
+        make_provisioner(name="default"),
+        make_provisioner(
+            name="tainted", weight=10,
+            taints=[Taint(key="dedicated", value="x", effect="NoSchedule")],
+        ),
+    ]
+    its = {"default": universe, "tainted": universe}
+    return pods, provisioners, its, nodes
+
+
+@pytest.fixture
+def recorder(tmp_path):
+    rec = FlightRecorder(capacity=8)
+    rec.enable(dump_dir=str(tmp_path))
+    return rec
+
+
+# -- snapshot round trip -----------------------------------------------------
+
+
+def test_snapshot_restore_round_trip():
+    pods, provisioners, its, nodes = _workload()
+    snap = snapshot_inputs(pods, provisioners, its, state_nodes=nodes,
+                           max_nodes=48)
+    json.dumps(snap)  # JSON-able as-is
+    restored = restore_inputs(json.loads(json.dumps(snap)))
+    assert len(restored.pods) == len(pods)
+    assert [p.metadata.name for p in restored.pods] == [
+        p.metadata.name for p in pods
+    ]
+    assert [p.name for p in restored.provisioners] == ["default", "tainted"]
+    assert restored.provisioners[1].spec.taints[0].key == "dedicated"
+    assert restored.max_nodes == 48
+    # instance types: requirements/offerings/capacity survive
+    orig_it = its["default"][0]
+    rest_it = restored.instance_types["default"][0]
+    assert rest_it.name == orig_it.name
+    assert rest_it.capacity == orig_it.capacity
+    assert len(rest_it.offerings) == len(orig_it.offerings)
+    assert rest_it.offerings[0].price == orig_it.offerings[0].price
+    assert set(rest_it.requirements) == set(orig_it.requirements)
+    # state nodes: identity, labels, capacity, per-pod bookkeeping
+    orig_sn, rest_sn = nodes[0], restored.state_nodes[0]
+    assert rest_sn.name() == orig_sn.name()
+    assert rest_sn.labels() == orig_sn.labels()
+    assert rest_sn.allocatable() == orig_sn.allocatable()
+    assert rest_sn.available() == orig_sn.available()
+    assert rest_sn.pod_requests == orig_sn.pod_requests
+    assert rest_sn.hostport_usage.reserved == orig_sn.hostport_usage.reserved
+    # the digest is input-sensitive and round-trip stable
+    assert input_digest(snap) == input_digest(json.loads(json.dumps(snap)))
+    snap2 = snapshot_inputs(pods[:-1], provisioners, its, state_nodes=nodes)
+    assert input_digest(snap2) != input_digest(snap)
+
+
+def test_snapshot_cluster_context_gated_on_constraints():
+    """Constraint-free batches never touch the kube client (the host
+    scheduler's topology counting wouldn't either), so snapshot cost
+    mirrors solve cost."""
+    from karpenter_core_tpu.kube.client import InMemoryKubeClient
+
+    client = InMemoryKubeClient()
+    bound = make_pod(requests={"cpu": "1"})
+    bound.spec.node_name = "n1"
+    client.create(bound)
+    plain = [make_pod(requests={"cpu": "1"}) for _ in range(4)]
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(2)}
+    snap = snapshot_inputs(plain, provisioners, its, kube_client=client)
+    assert "clusterPods" not in snap and "clusterOmitted" not in snap
+
+
+def test_snapshot_cluster_context_capped(monkeypatch):
+    """Above MAX_CLUSTER_SNAPSHOT_PODS bound pods, the cluster context is
+    omitted (marked) so capture cost tracks the batch, not the cluster."""
+    from karpenter_core_tpu.kube.client import InMemoryKubeClient
+
+    monkeypatch.setattr(flightrec, "MAX_CLUSTER_SNAPSHOT_PODS", 3)
+    client = InMemoryKubeClient()
+    for i in range(5):
+        bound = make_pod(requests={"cpu": "0.1"})
+        bound.spec.node_name = "n1"
+        client.create(bound)
+    pods, provisioners, its, _ = _workload(n_pods=6)
+    # guarantee a constraint carrier so the cluster-context gate opens
+    zonal = TopologySpreadConstraint(
+        max_skew=1, topology_key=LABEL_TOPOLOGY_ZONE,
+        when_unsatisfiable="DoNotSchedule",
+        label_selector=LabelSelector(match_labels={"app": "spread"}),
+    )
+    pods.append(make_pod(labels={"app": "spread"}, requests={"cpu": "0.1"},
+                         topology_spread=[zonal]))
+    snap = snapshot_inputs(pods, provisioners, its, kube_client=client)
+    assert snap["clusterOmitted"] == 5
+    assert "clusterPods" not in snap
+    assert restore_inputs(snap).kube_client is None
+    # under the cap the context rides along and restores into a client
+    monkeypatch.setattr(flightrec, "MAX_CLUSTER_SNAPSHOT_PODS", 100)
+    snap = snapshot_inputs(pods, provisioners, its, kube_client=client)
+    assert len(snap["clusterPods"]) == 5
+    restored = restore_inputs(snap)
+    assert restored.kube_client is not None
+    assert len(restored.kube_client.list("Pod")) == 5
+
+
+def test_replay_greedy_byte_identical():
+    pods, provisioners, its, nodes = _workload()
+    live = GreedySolver().solve(
+        pods, provisioners, its,
+        state_nodes=[n.deep_copy() for n in nodes],
+    )
+    record = {
+        "inputs": snapshot_inputs(pods, provisioners, its, state_nodes=nodes),
+        "replayer": "greedy",
+        "outcome": {"placements": canonical_placements(live)},
+    }
+    record = json.loads(json.dumps(record))  # through-disk fidelity
+    replayed, _ = flightrec.replay(record)
+    assert placements_json(replayed) == placements_json(
+        record["outcome"]["placements"]
+    )
+
+
+def test_seeded_greedy_vs_tpu_replay_diff_runs_clean():
+    """The acceptance differential: one seeded record replayed through BOTH
+    solvers; the device result must be no worse than the host oracle (the
+    test_differential_fuzz equivalence bar) and each side deterministic."""
+    pods, provisioners, its, nodes = _workload(seed=23)
+    record = {
+        "inputs": snapshot_inputs(pods, provisioners, its, state_nodes=nodes,
+                                  max_nodes=48),
+        "replayer": "greedy",
+    }
+    record = json.loads(json.dumps(record))
+    greedy, greedy_res = flightrec.replay(record, "greedy")
+    tpu, tpu_res = flightrec.replay(record, "tpu")
+    # determinism: a second replay of each side is byte-identical
+    greedy2, _ = flightrec.replay(record, "greedy")
+    tpu2, _ = flightrec.replay(record, "tpu")
+    assert placements_json(greedy) == placements_json(greedy2)
+    assert placements_json(tpu) == placements_json(tpu2)
+    # equivalence bar (not byte-equality: greedy order-dependence allows
+    # different but equally valid placements)
+    assert len(tpu["failed"]) <= len(greedy["failed"])
+    assert len(tpu["machines"]) <= len(greedy["machines"]) + 1
+    assert greedy_res.pod_count_new() + greedy_res.pod_count_existing() + len(
+        greedy_res.failed_pods
+    ) == len(pods)
+    assert tpu_res.pod_count_new() + tpu_res.pod_count_existing() + len(
+        tpu_res.failed_pods
+    ) == len(pods)
+
+
+# -- canonical placements ----------------------------------------------------
+
+
+def test_canonical_placements_order_independent():
+    pods, provisioners, its, nodes = _workload()
+    res = GreedySolver().solve(pods, provisioners, its,
+                               state_nodes=[n.deep_copy() for n in nodes])
+    a = canonical_placements(res)
+    res.new_machines.reverse()
+    res.existing_assignments.reverse()
+    res.failed_pods.reverse()
+    assert placements_json(a) == placements_json(canonical_placements(res))
+
+
+def test_record_phases_scoped_to_own_trace(recorder):
+    """phases_ms only aggregates THIS solve's phase spans: a concurrent
+    solve's spans (different trace) in the same global ring are excluded."""
+    import time as time_mod
+
+    from karpenter_core_tpu.obs import TRACER
+
+    pods, provisioners, its, _ = _workload(n_pods=6)
+    was = TRACER.enabled
+    TRACER.enable()
+    try:
+        with TRACER.span("provisioner.reconcile"):
+            rec = recorder.begin(pods, provisioners, its)
+            t0 = time_mod.perf_counter_ns()
+            # own-trace phase (inherits the reconcile span's trace id)
+            TRACER.add_span("solver.phase.encode", t0, t0 + 2_000_000)
+            # a foreign trace's phase lands in the same ring window
+            TRACER.add_span("solver.phase.device", t0, t0 + 50_000_000,
+                            trace_id="t-other-solve")
+            rec.finish("host.small_batch",
+                       GreedySolver().solve(pods, provisioners, its))
+    finally:
+        TRACER.enabled = was
+    phases = recorder.last()["phases_ms"]
+    assert phases["encode"] == pytest.approx(2.0, abs=0.5)
+    assert "device" not in phases  # the foreign solve's span is excluded
+
+
+def test_diff_placements_names_concrete_entries_when_summaries_tie():
+    """Same pod sets / counts / instance types but different grouping:
+    the diff must name the differing machines, not just assert divergence."""
+    base = {"provisioner": "default", "instanceType": "t", "options": 4,
+            "requests": {"cpu": 2.0}, "pods": ["default/a", "default/b"]}
+    a = {"machines": [dict(base)], "existing": [], "failed": []}
+    b = {"machines": [dict(base, options=2)], "existing": [], "failed": []}
+    diff = flightrec.diff_placements(a, b)
+    assert any("machine only on left" in line for line in diff)
+    assert any('"options": 4' in line for line in diff)
+
+
+def test_diff_placements_reports_differences():
+    a = {"machines": [{"provisioner": "p", "instanceType": "t", "options": 1,
+                       "requests": {}, "pods": ["default/x"]}],
+         "existing": [], "failed": []}
+    b = {"machines": [], "existing": [], "failed": ["default/x"]}
+    assert flightrec.diff_placements(a, a) == []
+    diff = flightrec.diff_placements(a, b)
+    assert diff and any("default/x" in line for line in diff)
+
+
+# -- ResilientSolver wiring --------------------------------------------------
+
+
+def _swap_flightrec(monkeypatch, recorder):
+    import karpenter_core_tpu.obs.flightrec as fr_mod
+    import karpenter_core_tpu.solver.fallback as fb_mod
+
+    monkeypatch.setattr(fr_mod, "FLIGHTREC", recorder)
+    monkeypatch.setattr(fb_mod, "FLIGHTREC", recorder)
+
+
+def test_resilient_solver_records_small_batch(monkeypatch, recorder):
+    _swap_flightrec(monkeypatch, recorder)
+    pods, provisioners, its, _ = _workload()
+    solver = ResilientSolver(TPUSolver(max_nodes=32), GreedySolver(),
+                             prober=lambda: None)
+    result = solver.solve(pods, provisioners, its)
+    record = recorder.last()
+    assert record["backend"] == "host.small_batch"
+    assert record["replayer"] == "greedy"
+    assert record["schema"] == flightrec.SCHEMA_VERSION
+    assert record["outcome"]["placements"] == canonical_placements(result)
+    assert record["duration_ms"] >= 0
+    # the captured record replays byte-identically (the live->replay bar)
+    replayed, _ = flightrec.replay(json.loads(json.dumps(record)))
+    assert placements_json(replayed) == placements_json(
+        record["outcome"]["placements"]
+    )
+    # a healthy small-batch routing is routine: no auto-dump
+    assert glob.glob(os.path.join(recorder.dump_dir, "*.json")) == []
+
+
+def test_resilient_solver_dumps_on_primary_error(monkeypatch, recorder):
+    _swap_flightrec(monkeypatch, recorder)
+
+    class Boom:
+        max_nodes = 32
+
+        def solve(self, *args, **kwargs):
+            raise RuntimeError("device wedged")
+
+    pods, provisioners, its, _ = _workload()
+    solver = ResilientSolver(Boom(), GreedySolver(), prober=lambda: None,
+                             small_batch_work_max=0)
+    result = solver.solve(pods, provisioners, its)
+    assert result.pod_count_new() + result.pod_count_existing() == len(pods)
+    record = recorder.last()
+    assert record["backend"] == "host.primary_error"
+    assert "RuntimeError: device wedged" in record["primary_error"]
+    # the incident auto-dumped a replayable file
+    (dump,) = glob.glob(os.path.join(recorder.dump_dir, "*.json"))
+    with open(dump) as f:
+        dumped = json.load(f)
+    assert dumped["digest"] == record["digest"]
+    replayed, _ = flightrec.replay(dumped)
+    assert placements_json(replayed) == placements_json(
+        dumped["outcome"]["placements"]
+    )
+
+
+def test_resilient_solver_records_fallback_crash(monkeypatch, recorder):
+    """The worst incident — primary AND fallback both raise — still
+    finalizes and dumps the record before the exception propagates."""
+    _swap_flightrec(monkeypatch, recorder)
+
+    class Boom:
+        max_nodes = 32
+
+        def solve(self, *args, **kwargs):
+            raise RuntimeError("device wedged")
+
+    class FallbackBoom:
+        def solve(self, *args, **kwargs):
+            raise ValueError("bad snapshot")
+
+    pods, provisioners, its, _ = _workload()
+    solver = ResilientSolver(Boom(), FallbackBoom(), prober=lambda: None,
+                             small_batch_work_max=0)
+    with pytest.raises(ValueError, match="bad snapshot"):
+        solver.solve(pods, provisioners, its)
+    record = recorder.last()
+    assert record["backend"] == "host.primary_error"
+    assert "RuntimeError: device wedged" in record["primary_error"]
+    assert "error" in record and "outcome" not in record
+    (dump,) = glob.glob(os.path.join(recorder.dump_dir, "*.json"))
+    # the dumped inputs still replay through a real solver
+    with open(dump) as f:
+        replayed, _ = flightrec.replay(json.load(f), "greedy")
+    assert replayed["machines"] or replayed["failed"]
+
+
+def test_simulation_solves_are_not_recorded(monkeypatch, recorder):
+    """Deprovisioning-simulation re-entries (flightrec.suppress_recording,
+    armed by core.simulate_scheduling) skip the recorder: consolidation
+    re-enters every pass and would churn the ring past the provisioning
+    records. Independent of tracing: works with the tracer disabled."""
+    from karpenter_core_tpu.obs import TRACER
+
+    _swap_flightrec(monkeypatch, recorder)
+    pods, provisioners, its, _ = _workload(n_pods=6)
+    solver = ResilientSolver(TPUSolver(max_nodes=32), GreedySolver(),
+                             prober=lambda: None)
+    assert not TRACER.enabled  # the invariant must not depend on tracing
+    with flightrec.suppress_recording():
+        solver.solve(pods, provisioners, its)
+    assert recorder.records() == []
+    solver.solve(pods, provisioners, its)  # provisioning context records
+    assert recorder.last()["backend"] == "host.small_batch"
+
+
+def test_simulate_scheduling_suppresses_recording(monkeypatch, recorder):
+    """The real deprovisioning simulator wraps its solver re-entry in
+    suppress_recording (end to end through core.simulate_scheduling)."""
+    from karpenter_core_tpu.controllers.deprovisioning import core
+    from karpenter_core_tpu.operator import new_operator
+
+    _swap_flightrec(monkeypatch, recorder)
+    cp = fake.FakeCloudProvider(fake.instance_types(4))
+    solver = ResilientSolver(TPUSolver(max_nodes=32), GreedySolver(),
+                             prober=lambda: None)
+    op = new_operator(cp, solver=solver)
+    op.kube_client.create(make_provisioner(name="default"))
+    for i in range(4):
+        op.kube_client.create(make_pod(requests={"cpu": "1"}))
+    op.sync_state()
+    machines, all_scheduled = core.simulate_scheduling(
+        op.kube_client, op.cluster, op.provisioning, []
+    )
+    assert all_scheduled and machines
+    assert recorder.records() == []  # the simulation left no record
+
+
+def test_recorder_skips_mega_state_node_solves(monkeypatch, recorder):
+    monkeypatch.setattr(flightrec, "MAX_SNAPSHOT_STATE_NODES", 2)
+    pods, provisioners, its, nodes = _workload()  # 3 state nodes > cap
+    assert recorder.begin(pods, provisioners, its, state_nodes=nodes) is None
+    assert json.loads(recorder.to_json())["skipped_large"] == 1
+    # at/under the cap records normally
+    assert recorder.begin(pods, provisioners, its,
+                          state_nodes=nodes[:2]) is not None
+
+
+def test_dump_retention_bounded_on_disk(recorder):
+    pods, provisioners, its, _ = _workload(n_pods=6)
+    result = GreedySolver().solve(pods, provisioners, its)
+    for i in range(recorder.capacity + 5):
+        rec = recorder.begin(pods, provisioners, its)
+        rec._ts = 1700000000.0 + i  # distinct auto-dump filenames
+        rec.finish("host.backend_unavailable", result, dump=True)
+    files = glob.glob(os.path.join(recorder.dump_dir, "solve-*.json"))
+    assert len(files) == recorder.capacity  # oldest pruned, newest kept
+    newest = max(files)
+    with open(newest) as f:
+        replayed, _ = flightrec.replay(json.load(f), "greedy")
+    assert replayed["machines"]
+
+
+def test_resilient_solver_dumps_on_unhealthy_fallback(monkeypatch, recorder):
+    _swap_flightrec(monkeypatch, recorder)
+    pods, provisioners, its, _ = _workload()
+    solver = ResilientSolver(
+        TPUSolver(max_nodes=32), GreedySolver(),
+        prober=lambda: "backend probe timed out", small_batch_work_max=0,
+    )
+    solver.solve(pods, provisioners, its)
+    record = recorder.last()
+    assert record["backend"] == "host.backend_unavailable"
+    assert glob.glob(os.path.join(recorder.dump_dir, "*.json"))
+
+
+def test_recorder_disabled_is_noop(monkeypatch, recorder):
+    recorder.disable()
+    _swap_flightrec(monkeypatch, recorder)
+    pods, provisioners, its, _ = _workload()
+    solver = ResilientSolver(TPUSolver(max_nodes=32), GreedySolver(),
+                             prober=lambda: None)
+    assert recorder.begin(pods, provisioners, its) is None
+    result = solver.solve(pods, provisioners, its)
+    assert result.pod_count_new() + result.pod_count_existing() == len(pods)
+    assert recorder.records() == []
+
+
+def test_recorder_ring_bounded_and_capture_never_raises(recorder):
+    pods, provisioners, its, _ = _workload(n_pods=6)
+    for _ in range(12):
+        rec = recorder.begin(pods, provisioners, its)
+        rec.finish("host.small_batch", GreedySolver().solve(pods, provisioners, its))
+    assert len(recorder.records()) == 8  # capacity
+    assert recorder.dropped == 4
+    # a hostile input can't break the solve path: begin() swallows and counts
+    assert recorder.begin(object(), provisioners, its) is None
+    assert recorder.failures == 1
+    body = json.loads(recorder.to_json())
+    assert body["dropped"] == 4 and body["capture_failures"] == 1
+
+
+def test_enable_flightrec_from_env(monkeypatch, tmp_path):
+    import karpenter_core_tpu.obs.flightrec as fr_mod
+
+    was_enabled, was_dir = fr_mod.FLIGHTREC.enabled, fr_mod.FLIGHTREC.dump_dir
+    try:
+        monkeypatch.setenv("KARPENTER_TPU_FLIGHTREC", "1")
+        monkeypatch.setenv("KARPENTER_TPU_FLIGHTREC_DIR", str(tmp_path))
+        assert fr_mod.enable_flightrec_from_env() is True
+        assert fr_mod.FLIGHTREC.dump_dir == str(tmp_path)
+        monkeypatch.setenv("KARPENTER_TPU_FLIGHTREC", "0")
+        # explicit off wins over the operator default
+        assert fr_mod.enable_flightrec_from_env(default_on=True) is False
+        monkeypatch.setenv("KARPENTER_TPU_FLIGHTREC", "")
+        assert fr_mod.enable_flightrec_from_env(default_on=True) is True
+        # unset + no default: state is left as-is (same contract as
+        # enable_tracing_from_env)
+        fr_mod.FLIGHTREC.disable()
+        assert fr_mod.enable_flightrec_from_env() is False
+    finally:
+        fr_mod.FLIGHTREC.enabled = was_enabled
+        fr_mod.FLIGHTREC.dump_dir = was_dir
+
+
+# -- live operator capture ---------------------------------------------------
+
+
+def test_live_operator_solve_replays_byte_identical(monkeypatch, recorder):
+    """The acceptance loop: a flight record captured from a LIVE operator
+    solve (full reconcile: batcher -> snapshot -> ResilientSolver ->
+    launch) replays through the flightrec machinery byte-identically."""
+    _swap_flightrec(monkeypatch, recorder)
+    from karpenter_core_tpu.operator import new_operator
+    from karpenter_core_tpu.solver.fallback import ResilientSolver as RS
+
+    cp = fake.FakeCloudProvider(fake.instance_types(6))
+    solver = RS(TPUSolver(max_nodes=32), GreedySolver(), prober=lambda: None)
+    op = new_operator(cp, solver=solver)
+    op.kube_client.create(make_provisioner(name="default"))
+    for i in range(16):
+        op.kube_client.create(
+            make_pod(labels={"app": f"live-{i % 4}"}, requests={"cpu": "1"})
+        )
+    op.sync_state()
+    created = op.provisioning.reconcile(wait_timeout=None)
+    assert created > 0
+    record = recorder.last()
+    assert record is not None
+    assert len(record["inputs"]["pods"]) == 16
+    replayed, _ = flightrec.replay(json.loads(json.dumps(record)))
+    assert placements_json(replayed) == placements_json(
+        record["outcome"]["placements"]
+    )

@@ -1,0 +1,250 @@
+"""controllers/metrics scraper suite (ISSUE 3 satellites): node gauge
+build-then-swap repopulation (no empty/partial scrape window), the pod
+startup-observation guard, pod cleanup-then-record across phase
+transitions/deletion, and provisioner prune()."""
+import threading
+
+import pytest
+
+from karpenter_core_tpu.api.labels import (
+    LABEL_NODE_INITIALIZED,
+    PROVISIONER_NAME_LABEL_KEY,
+)
+from karpenter_core_tpu.controllers.metrics.controllers import (
+    NodeMetricsController,
+    PodMetricsController,
+    ProvisionerMetricsController,
+)
+from karpenter_core_tpu.kube.client import InMemoryKubeClient
+from karpenter_core_tpu.metrics.registry import REGISTRY
+from karpenter_core_tpu.state.node import StateNode
+from karpenter_core_tpu.testing import make_node, make_pod, make_provisioner
+
+
+class _FakeCluster:
+    def __init__(self, state_nodes):
+        self._nodes = list(state_nodes)
+
+    def nodes(self):
+        return list(self._nodes)
+
+    def set(self, state_nodes):
+        self._nodes = list(state_nodes)
+
+
+def _state_node(name: str, cpu: str = "4") -> StateNode:
+    return StateNode(
+        node=make_node(
+            name=name,
+            labels={
+                PROVISIONER_NAME_LABEL_KEY: "default",
+                LABEL_NODE_INITIALIZED: "true",
+            },
+            capacity={"cpu": cpu, "memory": "8Gi"},
+        )
+    )
+
+
+# -- node scraper ------------------------------------------------------------
+
+
+def test_node_gauges_populate_and_drop_stale():
+    cluster = _FakeCluster([_state_node("n1"), _state_node("n2", cpu="8")])
+    ctrl = NodeMetricsController(cluster)
+    ctrl.reconcile()
+
+    def alloc(node):
+        labels = {
+            "node_name": node, "resource_type": "cpu", "zone": "",
+            "region": "", "instance_type": "", "arch": "", "os": "",
+            "capacity_type": "", "provisioner": "default",
+        }
+        return ctrl.allocatable.get(labels)
+
+    assert alloc("n1") == 4.0
+    assert alloc("n2") == 8.0
+    # node gone -> its series drop on the next scrape (no stale ghosts)
+    cluster.set([_state_node("n1")])
+    ctrl.reconcile()
+    assert alloc("n1") == 4.0
+    assert alloc("n2") is None
+
+
+def test_node_gauge_repopulation_includes_pod_series():
+    sn = _state_node("n1")
+    bound_pod = make_pod(requests={"cpu": "1", "memory": "1Gi"})
+    bound_pod.spec.node_name = "n1"
+    sn.update_for_pod(bound_pod)
+    ctrl = NodeMetricsController(_FakeCluster([sn]))
+    ctrl.reconcile()
+    labels = {
+        "node_name": "n1", "resource_type": "cpu", "zone": "", "region": "",
+        "instance_type": "", "arch": "", "os": "", "capacity_type": "",
+        "provisioner": "default",
+    }
+    assert ctrl.pod_requests.get(labels) == 1.0
+    assert ctrl.overhead.get(labels) is not None
+
+
+def test_node_scrape_never_observes_empty_window():
+    """The scrape race fix: while reconcile() rebuilds, a concurrent
+    exposition must always see the stable node's allocatable series —
+    the old clear()-then-set left a window where it vanished."""
+    cluster = _FakeCluster([_state_node("stable")])
+    ctrl = NodeMetricsController(cluster)
+    ctrl.reconcile()
+    stop = threading.Event()
+    holes = []
+
+    def scraper():
+        while not stop.is_set():
+            text = REGISTRY.expose()
+            if 'node_name="stable"' not in text:
+                holes.append(text)
+                return
+
+    t = threading.Thread(target=scraper)
+    t.start()
+    try:
+        for _ in range(200):
+            ctrl.reconcile()
+            if holes:
+                break
+    finally:
+        stop.set()
+        t.join()
+    assert not holes, "a concurrent scrape observed the gauges mid-rebuild"
+
+
+# -- pod scraper -------------------------------------------------------------
+
+
+@pytest.fixture
+def pod_ctrl():
+    clock = {"t": 1000.0}
+    ctrl = PodMetricsController(InMemoryKubeClient(), clock=lambda: clock["t"])
+    # the startup histogram is a registry-shared singleton: assert deltas
+    base = (ctrl.startup.counts.get((), 0), ctrl.startup.sums.get((), 0.0))
+    return ctrl, clock, base
+
+
+def _phase_labels(pod, phase, node=""):
+    return {
+        "name": pod.metadata.name, "namespace": pod.metadata.namespace,
+        "phase": phase, "node": node,
+    }
+
+
+def test_pod_cleanup_then_record_across_phases(pod_ctrl):
+    ctrl, clock, (base_n, base_sum) = pod_ctrl
+    pod = make_pod()
+    pod.metadata.creation_timestamp = 990.0
+    pod.status.phase = "Pending"
+    ctrl.reconcile(pod)
+    assert ctrl.state.get(_phase_labels(pod, "Pending")) == 1.0
+    # phase transition: the Pending series is dropped, not orphaned
+    pod.status.phase = "Running"
+    pod.spec.node_name = "n1"
+    ctrl.reconcile(pod)
+    assert ctrl.state.get(_phase_labels(pod, "Pending")) is None
+    assert ctrl.state.get(_phase_labels(pod, "Running", node="n1")) == 1.0
+    # startup observed exactly once, with the real elapsed time
+    assert ctrl.startup.counts[()] == base_n + 1
+    assert ctrl.startup.sums[()] == pytest.approx(base_sum + 10.0)
+    ctrl.reconcile(pod)
+    assert ctrl.startup.counts[()] == base_n + 1  # no re-observation
+    # deletion drops the series and the startup dedupe entry
+    ctrl.reconcile(pod, deleted=True)
+    assert ctrl.state.get(_phase_labels(pod, "Running", node="n1")) is None
+    assert pod.metadata.uid not in ctrl._started
+
+
+def test_pod_startup_guard_missing_creation_timestamp(pod_ctrl):
+    ctrl, _, (base_n, _base_sum) = pod_ctrl
+    pod = make_pod()
+    pod.metadata.creation_timestamp = 0.0  # unset on the wire
+    pod.status.phase = "Running"
+    ctrl.reconcile(pod)
+    # the state gauge records, the startup histogram does NOT get a
+    # multi-decade observation
+    assert ctrl.state.get(_phase_labels(pod, "Running")) == 1.0
+    assert ctrl.startup.counts.get((), 0) == base_n
+    # and the pod is still marked started: a later event can't sneak a
+    # bogus observation in either
+    pod.metadata.creation_timestamp = 999.0
+    ctrl.reconcile(pod)
+    assert ctrl.startup.counts.get((), 0) == base_n
+
+
+def test_pod_startup_guard_negative_clock_skew(pod_ctrl):
+    ctrl, clock, (base_n, _base_sum) = pod_ctrl
+    pod = make_pod()
+    pod.metadata.creation_timestamp = 2000.0  # "created in the future"
+    pod.status.phase = "Running"
+    clock["t"] = 1000.0
+    ctrl.reconcile(pod)
+    assert ctrl.startup.counts.get((), 0) == base_n
+
+
+def test_pod_startup_normal_observation_still_works(pod_ctrl):
+    ctrl, clock, (base_n, base_sum) = pod_ctrl
+    pod = make_pod()
+    pod.metadata.creation_timestamp = 997.5
+    pod.status.phase = "Running"
+    ctrl.reconcile(pod)
+    assert ctrl.startup.counts[()] == base_n + 1
+    assert ctrl.startup.sums[()] == pytest.approx(base_sum + 2.5)
+
+
+# -- provisioner scraper -----------------------------------------------------
+
+
+def test_provisioner_prune_drops_stale_series():
+    ctrl = ProvisionerMetricsController(InMemoryKubeClient())
+    prov = make_provisioner(name="keep", limits={"cpu": "100"})
+    prov.status.resources = {"cpu": 10.0}
+    gone = make_provisioner(name="gone", limits={"cpu": "50"})
+    gone.status.resources = {"cpu": 5.0}
+    ctrl.reconcile(prov)
+    ctrl.reconcile(gone)
+    keep_labels = {"provisioner": "keep", "resource_type": "cpu"}
+    gone_labels = {"provisioner": "gone", "resource_type": "cpu"}
+    assert ctrl.usage.get(keep_labels) == 10.0
+    assert ctrl.usage.get(gone_labels) == 5.0
+    assert ctrl.usage_pct.get(gone_labels) == pytest.approx(10.0)
+    ctrl.prune({"keep"})
+    assert ctrl.usage.get(keep_labels) == 10.0
+    assert ctrl.limit.get(keep_labels) == 100.0
+    assert ctrl.usage.get(gone_labels) is None
+    assert ctrl.limit.get(gone_labels) is None
+    assert ctrl.usage_pct.get(gone_labels) is None
+    assert "gone" not in ctrl._labels
+
+
+def test_provisioner_cleanup_then_record_on_resource_change():
+    ctrl = ProvisionerMetricsController(InMemoryKubeClient())
+    prov = make_provisioner(name="p", limits={"cpu": "10"})
+    prov.status.resources = {"cpu": 2.0, "memory": 4.0}
+    ctrl.reconcile(prov)
+    assert ctrl.usage.get({"provisioner": "p", "resource_type": "memory"}) == 4.0
+    # memory usage disappears -> its series must too
+    prov.status.resources = {"cpu": 3.0}
+    ctrl.reconcile(prov)
+    assert ctrl.usage.get({"provisioner": "p", "resource_type": "cpu"}) == 3.0
+    assert ctrl.usage.get({"provisioner": "p", "resource_type": "memory"}) is None
+    ctrl.reconcile(prov, deleted=True)
+    assert ctrl.usage.get({"provisioner": "p", "resource_type": "cpu"}) is None
+
+
+# -- Gauge.replace_all -------------------------------------------------------
+
+
+def test_gauge_replace_all_swaps_atomically():
+    gauge = REGISTRY.gauge("karpenter_test_replace_all_gauge")
+    gauge.set(1.0, {"a": "x"})
+    gauge.replace_all([(2.0, {"a": "y"}), (3.0, {"a": "z"})])
+    assert gauge.get({"a": "x"}) is None
+    assert gauge.get({"a": "y"}) == 2.0
+    assert gauge.get({"a": "z"}) == 3.0
+    gauge.replace_all([])
+    assert gauge.get({"a": "y"}) is None

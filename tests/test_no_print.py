@@ -1,0 +1,42 @@
+"""No-print guard (ISSUE 3 satellite): the package must log through
+obs/log, never bare print(). AST-based so string literals containing
+"print(" (the subprocess probe source in solver/fallback.py) don't
+false-positive. The same scanner runs in `make verify`
+(hack/check_no_print.sh)."""
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO_ROOT, "hack"))
+
+from check_no_print import PACKAGE, find_print_calls  # noqa: E402
+
+
+def test_package_is_print_free():
+    violations = find_print_calls(os.path.join(REPO_ROOT, PACKAGE))
+    assert not violations, (
+        "bare print() in production code — use karpenter_core_tpu.obs.log: "
+        + ", ".join(f"{os.path.relpath(p, REPO_ROOT)}:{ln}" for p, ln in violations)
+    )
+
+
+def test_scanner_catches_real_prints(tmp_path):
+    (tmp_path / "bad.py").write_text(
+        'x = 1\nprint("leaked")\n\ndef f():\n    print(x)\n'
+    )
+    found = find_print_calls(str(tmp_path))
+    assert [ln for _p, ln in found] == [2, 5]
+
+
+def test_scanner_ignores_prints_in_strings(tmp_path):
+    (tmp_path / "ok.py").write_text(
+        'PROBE = "import jax; print(jax.devices())"\n'
+        "# print(commented out)\n"
+        'doc = """print(in a docstring)"""\n'
+    )
+    assert find_print_calls(str(tmp_path)) == []
+
+
+def test_scanner_flags_unparseable_files(tmp_path):
+    (tmp_path / "broken.py").write_text("def f(:\n")
+    assert find_print_calls(str(tmp_path))

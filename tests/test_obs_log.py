@@ -1,0 +1,314 @@
+"""Structured-logging suite (ISSUE 3 tentpole): level gating, logfmt/JSON
+formatting, per-thread bound context, trace-id correlation with the
+tracer, the bounded ring, env parsing, and the disabled fast path."""
+import io
+import json
+import threading
+
+import pytest
+
+from karpenter_core_tpu.obs.log import (
+    DEBUG,
+    ERROR,
+    INFO,
+    OFF,
+    WARNING,
+    LogSink,
+    bound,
+    bound_context,
+    configure_logging_from_env,
+    format_json,
+    format_logfmt,
+    get_logger,
+    parse_log_spec,
+)
+
+
+@pytest.fixture
+def sink(monkeypatch):
+    """A fresh sink wired in as the module singleton, with a capture
+    stream."""
+    import karpenter_core_tpu.obs.log as log_mod
+
+    fresh = LogSink(capacity=64)
+    fresh.configure(level=INFO, fmt="logfmt", stream=io.StringIO())
+    monkeypatch.setattr(log_mod, "SINK", fresh)
+    return fresh
+
+
+# -- level gating ------------------------------------------------------------
+
+
+def test_level_gating(sink):
+    log = get_logger("karpenter.test")
+    log.debug("dropped")
+    log.info("kept")
+    log.warning("kept too")
+    assert [r["msg"] for r in sink.records()] == ["kept", "kept too"]
+    sink.level = ERROR
+    log.warning("now dropped")
+    log.error("boom")
+    assert [r["msg"] for r in sink.records()][-1] == "boom"
+    assert [r["level"] for r in sink.records()] == ["info", "warning", "error"]
+
+
+def test_disabled_path_no_records(sink):
+    sink.disable()
+    log = get_logger("karpenter.test")
+    log.info("nope", big_field="x" * 1000)
+    log.debug("nope")
+    log.warning("nope")
+    assert sink.records() == []
+    assert sink.stream.getvalue() == ""
+    assert not sink.enabled and sink.level == OFF
+
+
+def test_errors_bypass_disabled_sink(sink, capsys):
+    """Last-resort semantics (stdlib lastResort analog): error records from
+    a process that never configured the sink still ring and reach stderr —
+    a crashing watch pump must never be invisible."""
+    sink.disable()
+    log = get_logger("karpenter.test")
+    log.error("still visible", kind="Pod")
+    assert sink.records()[-1]["msg"] == "still visible"
+    assert "still visible" in sink.stream.getvalue()  # configured stream wins
+    # with NO stream configured at all, stderr is the last resort
+    sink.stream = None
+    try:
+        raise RuntimeError("pump died")
+    except RuntimeError:
+        log.exception("watch pump failed")
+    assert "watch pump failed" in capsys.readouterr().err
+    assert sink.records()[-1]["error"] == "RuntimeError"
+
+
+# -- bound context -----------------------------------------------------------
+
+
+def test_bound_context_nests_and_unwinds(sink):
+    log = get_logger("karpenter.test")
+    with bound(controller="provisioning", reconcile="r7"):
+        log.info("outer")
+        with bound(phase="launch"):
+            log.info("inner")
+            assert bound_context() == {
+                "controller": "provisioning", "reconcile": "r7",
+                "phase": "launch",
+            }
+        log.info("outer again")
+    log.info("unbound")
+    records = sink.records()
+    assert records[0]["controller"] == "provisioning"
+    assert "phase" not in records[0]
+    assert records[1]["phase"] == "launch"
+    assert records[1]["reconcile"] == "r7"  # inherited from the outer scope
+    assert "phase" not in records[2]
+    assert "controller" not in records[3]
+
+
+def test_bound_context_is_per_thread(sink):
+    log = get_logger("karpenter.test")
+    seen = {}
+
+    def worker():
+        seen["ctx"] = bound_context()
+        log.info("from thread")
+
+    with bound(controller="provisioning"):
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+    assert seen["ctx"] == {}  # other threads never see this thread's binding
+    thread_rec = next(r for r in sink.records() if r["msg"] == "from thread")
+    assert "controller" not in thread_rec
+
+
+def test_call_fields_override_bound(sink):
+    log = get_logger("karpenter.test")
+    with bound(controller="a"):
+        log.info("x", controller="b")
+    assert sink.records()[0]["controller"] == "b"
+
+
+# -- trace correlation -------------------------------------------------------
+
+
+def test_trace_id_correlation(sink):
+    from karpenter_core_tpu.obs.tracer import Tracer
+
+    import karpenter_core_tpu.obs.log as log_mod
+
+    tracer = Tracer(capacity=16)
+    tracer.enable()
+    orig = log_mod.TRACER
+    log_mod.TRACER = tracer
+    try:
+        log = get_logger("karpenter.test")
+        log.info("outside any span")
+        with tracer.span("solver.solve") as sp:
+            log.info("inside the solve")
+            trace_id = sp.trace_id
+    finally:
+        log_mod.TRACER = orig
+    records = sink.records()
+    assert "trace_id" not in records[0]
+    assert records[1]["trace_id"] == trace_id  # log line joins the span
+
+
+# -- exception capture -------------------------------------------------------
+
+
+def test_exception_fields(sink):
+    log = get_logger("karpenter.test")
+    try:
+        raise ValueError("bad geometry")
+    except ValueError:
+        log.exception("solve failed", pods=3)
+    (record,) = sink.records()
+    assert record["error"] == "ValueError"
+    assert record["error_detail"] == "bad geometry"
+    assert "ValueError: bad geometry" in record["stack"]
+    assert record["pods"] == 3
+
+
+# -- formatting --------------------------------------------------------------
+
+
+def test_logfmt_escaping():
+    line = format_logfmt(
+        {
+            "ts": 1700000000.5,
+            "level": "info",
+            "logger": "karpenter.x",
+            "msg": 'has spaces and "quotes"',
+            "count": 3,
+            "ratio": 0.25,
+            "ok": True,
+            "plain": "word",
+        }
+    )
+    assert 'msg="has spaces and \\"quotes\\""' in line
+    assert "count=3" in line and "ratio=0.25" in line
+    assert "ok=true" in line and "plain=word" in line
+    assert line.startswith("ts=2023-11-14T")
+
+
+def test_json_format_round_trips():
+    record = {
+        "ts": 1700000000.0, "level": "warning", "logger": "karpenter.x",
+        "msg": "m", "nested": "a=b c", "n": 7,
+    }
+    parsed = json.loads(format_json(record))
+    assert parsed["level"] == "warning"
+    assert parsed["n"] == 7
+    assert parsed["ts"].endswith("Z")
+
+
+# -- ring --------------------------------------------------------------------
+
+
+def test_ring_bounded_with_drop_accounting(sink):
+    log = get_logger("karpenter.test")
+    for i in range(100):
+        log.info(f"m{i}")
+    assert len(sink.records()) == 64
+    assert sink.dropped == 36
+    assert sink.records()[0]["msg"] == "m36"  # newest kept
+    assert "# dropped=36" in sink.lines()
+    sink.clear()
+    assert sink.records() == [] and sink.dropped == 0
+
+
+def test_lines_formats(sink):
+    get_logger("karpenter.test").info("hello", k="v")
+    assert "msg=hello" in sink.lines()
+    assert json.loads(sink.lines(fmt="json").splitlines()[0])["k"] == "v"
+
+
+def test_dead_stream_never_raises(sink):
+    class Dead:
+        def write(self, s):
+            raise OSError("broken pipe")
+
+    sink.stream = Dead()
+    get_logger("karpenter.test").info("still records")
+    assert sink.records()[-1]["msg"] == "still records"
+
+
+# -- env parsing -------------------------------------------------------------
+
+
+def test_parse_log_spec():
+    assert parse_log_spec("") is None
+    assert parse_log_spec("off") is None
+    assert parse_log_spec("0") is None
+    assert parse_log_spec("1") == (INFO, "logfmt")
+    assert parse_log_spec("true") == (INFO, "logfmt")
+    assert parse_log_spec("debug") == (DEBUG, "logfmt")
+    assert parse_log_spec("warn") == (WARNING, "logfmt")
+    assert parse_log_spec("error:json") == (ERROR, "json")
+    assert parse_log_spec("json") == (INFO, "json")
+    assert parse_log_spec("DEBUG:JSON".lower()) == (DEBUG, "json")
+    # a typo'd level still logs (info) instead of silently disabling
+    assert parse_log_spec("verbose") == (INFO, "logfmt")
+
+
+def test_configure_from_env(monkeypatch):
+    import karpenter_core_tpu.obs.log as log_mod
+
+    was_level, was_fmt, was_stream = (
+        log_mod.SINK.level, log_mod.SINK.fmt, log_mod.SINK.stream
+    )
+    try:
+        monkeypatch.setenv("KARPENTER_TPU_LOG", "debug:json")
+        assert configure_logging_from_env() is True
+        assert log_mod.SINK.level == DEBUG and log_mod.SINK.fmt == "json"
+        monkeypatch.setenv("KARPENTER_TPU_LOG", "off")
+        # an explicit off wins over the entrypoint default
+        assert configure_logging_from_env(default_level="info") is False
+        monkeypatch.setenv("KARPENTER_TPU_LOG", "")
+        assert configure_logging_from_env(default_level="info") is True
+        assert log_mod.SINK.level == INFO
+        assert configure_logging_from_env() is False  # unset + no default
+    finally:
+        log_mod.SINK.level, log_mod.SINK.fmt = was_level, was_fmt
+        log_mod.SINK.stream = was_stream
+
+
+# -- integration: the operator loop binds controller/reconcile ---------------
+
+
+def test_singleton_reconcile_binds_context(sink):
+    from karpenter_core_tpu.operator.controller import Singleton
+
+    captured = {}
+
+    def reconcile():
+        captured.update(bound_context())
+        get_logger("karpenter.test").info("inside reconcile")
+        return None
+
+    Singleton("unit-test", reconcile).reconcile_once()
+    assert captured["controller"] == "unit-test"
+    assert captured["reconcile"].startswith("r")
+    record = next(r for r in sink.records() if r["msg"] == "inside reconcile")
+    assert record["controller"] == "unit-test"
+    assert record["reconcile"] == captured["reconcile"]
+
+
+def test_reconcile_failure_logs_structured(sink):
+    from karpenter_core_tpu.operator.controller import Singleton
+
+    def reconcile():
+        raise RuntimeError("injected")
+
+    s = Singleton("failing", reconcile)
+    backoff = s.reconcile_once()
+    assert backoff is not None and backoff > 0
+    record = next(r for r in sink.records() if r["msg"] == "reconcile failed")
+    assert record["controller"] == "failing"
+    assert record["failures"] == 1
+    assert record["error"] == "RuntimeError"
+    # the failure line carries the pass's reconcile id even though the
+    # bound scope has unwound — a failing pass greps as one unit
+    assert record["reconcile"].startswith("r")

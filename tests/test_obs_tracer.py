@@ -276,22 +276,34 @@ def test_service_adopts_propagated_trace_id():
     from karpenter_core_tpu.solver import service_pb2 as pb
     from karpenter_core_tpu.solver.service import SolverService
 
+    class _Abort(Exception):
+        pass
+
     class _Ctx:
+        """grpc.ServicerContext shape: abort() RAISES (never returns)."""
+
         def invocation_metadata(self):
             return ((TRACE_HEADER, "t-from-client"),)
+
+        def abort(self, code, details):
+            raise _Abort(f"{code}: {details}")
 
     TRACER.enable()
     TRACER.clear()
     try:
         service = SolverService()
-        # malformed geometry: the handler reports the error on the wire and
+        # malformed geometry: the handler aborts with INVALID_ARGUMENT and
         # still records its span with the adopted trace id
-        resp = service.solve(
-            pb.SolveRequest(geometry="", tensors=[]), context=_Ctx()
-        )
-        assert resp.error
+        with pytest.raises(_Abort, match="INVALID_ARGUMENT"):
+            service.solve(
+                pb.SolveRequest(geometry="", tensors=[]), context=_Ctx()
+            )
         (span,) = [s for s in TRACER.spans() if s.name == "solver.service.solve"]
         assert span.trace_id == "t-from-client"
+        # without a context (direct in-process call) the classification
+        # rides the legacy error field instead
+        resp = service.solve(pb.SolveRequest(geometry="", tensors=[]))
+        assert resp.error.startswith("INVALID_ARGUMENT")
     finally:
         TRACER.disable()
         TRACER.clear()

@@ -76,6 +76,59 @@ def test_device_solver_throughput_floor(n_pods):
     )
 
 
+def test_disabled_observability_cost_stays_flat():
+    """ISSUE 3 acceptance: with KARPENTER_TPU_LOG off and the flight
+    recorder off, hot-path sites cost one flag check — same bar as the
+    tracer's disabled path. Measured against an empty-function baseline
+    with a generous multiplier (this is a regression tripwire for
+    accidental allocation on the disabled path, not a microbenchmark)."""
+    import timeit
+
+    from karpenter_core_tpu.obs.flightrec import FlightRecorder
+    from karpenter_core_tpu.obs.log import Logger, LogSink
+    from karpenter_core_tpu.obs.tracer import Tracer
+
+    import karpenter_core_tpu.obs.log as log_mod
+
+    n = 200_000
+    baseline = timeit.timeit("f()", globals={"f": lambda: None}, number=n)
+
+    sink = LogSink()  # level=OFF
+    old_sink = log_mod.SINK
+    log_mod.SINK = sink
+    try:
+        log = Logger("karpenter.perf")
+        t_log = timeit.timeit(
+            "log.info('hot path', pods=5)", globals={"log": log}, number=n
+        )
+    finally:
+        log_mod.SINK = old_sink
+    assert sink.records() == []
+    # one comparison + a kwargs dict: within 20x of calling an empty
+    # function (an enabled emit is >100x)
+    assert t_log < baseline * 20 + 0.5, (
+        f"disabled log call {t_log / n * 1e9:.0f}ns/call vs baseline "
+        f"{baseline / n * 1e9:.0f}ns"
+    )
+
+    rec = FlightRecorder()
+    t_rec = timeit.timeit(
+        "r.begin(None, None, None)", globals={"r": rec}, number=n
+    )
+    assert rec.records() == []
+    assert t_rec < baseline * 20 + 0.5, (
+        f"disabled flightrec begin {t_rec / n * 1e9:.0f}ns/call"
+    )
+
+    tracer = Tracer()
+    t_span = timeit.timeit(
+        "t.span('solver.solve')", globals={"t": tracer}, number=n
+    )
+    assert t_span < baseline * 20 + 0.5, (
+        f"disabled tracer span {t_span / n * 1e9:.0f}ns/call"
+    )
+
+
 def test_host_fallback_throughput_floor():
     """The host greedy fallback also holds the reference's floor (it IS the
     reference algorithm; a regression here breaks solver outages)."""
