@@ -3,7 +3,7 @@
 # backend with 8 virtual devices via tests/conftest.py.
 
 .PHONY: test deflake perf bench verify trace-demo chaos chaos-smoke \
-	replay-demo no-print
+	replay-demo lint
 
 test:  ## tier-1 suite (CPU, 8 virtual devices); slow chaos soaks: make chaos
 	python -m pytest tests -q -m "not slow"
@@ -23,8 +23,8 @@ trace-demo:  ## small traced solve -> /tmp/karpenter_trace.json (validated)
 replay-demo:  ## flight-recorded solve -> dump -> byte-identical replay
 	python hack/replay.py --demo
 
-no-print:  ## bare print() guard over karpenter_core_tpu/ (AST-based)
-	./hack/check_no_print.sh
+lint:  ## static analysis (trace-safety/layering/env-flags/monotonic-time/concurrency/no-print)
+	python hack/lint.py
 
 chaos:  ## fault-injection suite (incl. slow schedule cases), fixed seed
 	KARPENTER_CHAOS_SEED=42 python -m pytest \
@@ -43,8 +43,8 @@ verify:  ## driver hooks: single-chip compile check + 8-way mesh dryrun
 	import __graft_entry__ as g; fn, a = g.entry(); \
 	jax.block_until_ready(jax.jit(fn)(*a)); print('entry ok')"
 	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
-	# no bare print() in the package: everything logs through obs/log
-	./hack/check_no_print.sh
+	# static analysis (fatal): all passes, empty baseline, no suppressions
+	$(MAKE) lint
 	# metrics-scraper suite: the scrape-race/startup-guard regressions
 	python -m pytest tests/test_metrics_controllers.py -q
 	# non-fatal smoke: a traced solve must export valid Perfetto JSON

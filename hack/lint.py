@@ -1,0 +1,104 @@
+#!/usr/bin/env python
+"""Static-analysis driver: run every registered pass over karpenter_core_tpu/.
+
+Usage:
+  python hack/lint.py                  # all passes, fatal on any violation
+  python hack/lint.py --list-rules     # rule catalog
+  python hack/lint.py --rule no-print --rule layering
+  python hack/lint.py --update-baseline  # absorb current violations (debt
+                                         # marker — the checked-in baseline
+                                         # must ship empty)
+
+Per-line suppression in source: `# lint: disable=<rule>[,<rule>...]`.
+Exit codes: 0 clean, 1 violations, 2 usage error.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from karpenter_core_tpu.analysis import (  # noqa: E402
+    all_passes,
+    default_config,
+    load_baseline,
+    run_passes,
+)
+from karpenter_core_tpu.analysis.core import collect_sources  # noqa: E402
+
+DEFAULT_BASELINE = os.path.join(REPO_ROOT, "hack", "lint-baseline.txt")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "--rule", action="append", default=None,
+        help="run only the named rule(s); repeatable",
+    )
+    parser.add_argument("--baseline", default=DEFAULT_BASELINE)
+    parser.add_argument(
+        "--update-baseline", action="store_true",
+        help="rewrite the baseline file with the current violation set",
+    )
+    parser.add_argument("--list-rules", action="store_true")
+    parser.add_argument(
+        "-q", "--quiet", action="store_true", help="violations only, no summary"
+    )
+    args = parser.parse_args(argv)
+
+    passes = all_passes()
+    if args.list_rules:
+        for p in passes:
+            for rule in p.rules:
+                print(f"{rule}  (pass: {p.name})")
+        return 0
+
+    rules = set(args.rule) if args.rule else None
+    if rules and args.update_baseline:
+        # a filtered update would silently drop every other rule's entries
+        print("lint: --update-baseline requires a full run (drop --rule)",
+              file=sys.stderr)
+        return 2
+    if rules:
+        known = {r for p in passes for r in p.rules}
+        unknown = rules - known
+        if unknown:
+            print(f"lint: unknown rule(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
+
+    config = default_config(REPO_ROOT)
+    files = collect_sources(REPO_ROOT, config.package_name)
+    baseline = load_baseline(args.baseline) if not args.update_baseline else set()
+    result = run_passes(files, config, passes=passes, rules=rules,
+                        baseline=baseline)
+
+    if args.update_baseline:
+        with open(args.baseline, "w", encoding="utf-8") as f:
+            f.write("# Static-analysis baseline (hack/lint.py --update-baseline).\n")
+            f.write("# Entries are `relpath:line:rule` debt markers; this file\n")
+            f.write("# must ship EMPTY — see docs/static-analysis.md.\n")
+            for v in result.violations:
+                f.write(v.key() + "\n")
+        print(f"lint: baseline updated with {len(result.violations)} entr"
+              f"{'y' if len(result.violations) == 1 else 'ies'}")
+        return 0
+
+    for v in result.violations:
+        print(v.render())
+    if not args.quiet:
+        parts = [f"{len(result.violations)} violation(s)"]
+        if result.suppressed:
+            parts.append(f"{len(result.suppressed)} suppressed")
+        if result.baselined:
+            parts.append(f"{len(result.baselined)} baselined")
+        ran = sorted(rules) if rules else sorted(r for p in passes for r in p.rules)
+        print(f"lint: {', '.join(parts)} — rules: {', '.join(ran)}")
+    return 1 if result.violations else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -11,6 +11,9 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+echo "== presubmit: make lint (static analysis, fatal)"
+make lint
+
 echo "== presubmit: make test"
 make test
 

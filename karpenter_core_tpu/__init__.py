@@ -27,6 +27,8 @@ Layer map (mirrors SURVEY.md section 1):
   parallel/       device mesh, shardings, pjit wrappers
   events/metrics/ observability
   utils/          resource-list algebra and helpers
+  analysis/       AST static-analysis passes (hack/lint.py, `make lint`)
+  testing/        test fixtures + the lockwatch lock-order race detector
 """
 
 __version__ = "0.1.0"

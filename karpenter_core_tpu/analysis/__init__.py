@@ -1,0 +1,50 @@
+"""Pluggable AST-based static analysis for karpenter_core_tpu.
+
+The Go reference leans on `go vet` and the race detector in presubmit; this
+package is the Python/JAX analog, grown out of the one-off no-print guard
+from the observability PR. Each pass is a `Pass` subclass registered in
+`all_passes()`; `hack/lint.py` is the CLI driver (`make lint`, fatal in
+`make verify`). Per-line suppression: `# lint: disable=<rule>[,<rule>...]`.
+
+Passes (rule ids in parentheses):
+  trace_safety  (trace-safety)    — host-side Python control flow/coercions
+                                    inside jit/pjit/shard_map-traced bodies
+  layering      (layering,        — subpackage dependency DAG + module-scope
+                 import-cycle)      import-cycle detection
+  envdiscipline (env-flags)       — all os.environ access funnels through
+                                    obs/envflags.py
+  montime       (monotonic-time)  — time.time() banned outside the audited
+                                    wall-clock allowlist
+  concurrency   (bare-except,     — exception/thread/lock discipline with
+                 thread-discipline,  guarded-by inference for self._lock
+                 guarded-by)
+  noprint       (no-print)        — bare print() in production code
+"""
+from karpenter_core_tpu.analysis.core import (  # noqa: F401
+    Pass,
+    SourceFile,
+    Violation,
+    load_baseline,
+    load_tree,
+    run_passes,
+)
+from karpenter_core_tpu.analysis.config import AnalysisConfig, default_config  # noqa: F401
+
+
+def all_passes():
+    """Instantiate every registered pass, import-cycle-free at module load."""
+    from karpenter_core_tpu.analysis.concurrency import ConcurrencyPass
+    from karpenter_core_tpu.analysis.envdiscipline import EnvDisciplinePass
+    from karpenter_core_tpu.analysis.layering import LayeringPass
+    from karpenter_core_tpu.analysis.montime import MonotonicTimePass
+    from karpenter_core_tpu.analysis.noprint import NoPrintPass
+    from karpenter_core_tpu.analysis.trace_safety import TraceSafetyPass
+
+    return [
+        TraceSafetyPass(),
+        LayeringPass(),
+        EnvDisciplinePass(),
+        MonotonicTimePass(),
+        ConcurrencyPass(),
+        NoPrintPass(),
+    ]

@@ -1,0 +1,129 @@
+"""Analysis configuration: the dependency DAG, the wall-clock allowlist,
+and the structural knobs every pass reads. One default instance describes
+THIS repo; fixture tests build their own to lint synthetic trees.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, Set, Tuple
+
+# Subpackage dependency DAG: subpackage -> subpackages it may import at
+# MODULE scope. Function-scope imports are exempt (they express a runtime
+# collaboration, not a load-order dependency — e.g. solver/encode.py builds
+# host Topology inside encode_snapshot). The map is the architecture:
+# adding an edge here is a design decision, reviewed like one.
+#
+# Layers, roughly bottom-up:
+#   metrics                                      (leaf)
+#   obs, analysis                                (obs -> metrics only)
+#   chaos                                        (registry + env arming)
+#   utils, kube                                  (kube <-> utils is two
+#                                                 module-level acyclic edges:
+#                                                 utils/podutils -> kube/objects,
+#                                                 kube/apiserver -> utils/backoff)
+#   api, events, scheduling                      (domain objects)
+#   cloudprovider, state                         (cluster model)
+#   ops, native, parallel                        (device kernels)
+#   solver                                       (MUST NOT see controllers)
+#   controllers                                  (may orchestrate solver)
+#   operator, webhooks, testing                  (process wiring)
+DEFAULT_LAYERING: Dict[str, FrozenSet[str]] = {
+    "metrics": frozenset(),
+    "analysis": frozenset(),
+    "obs": frozenset({"metrics"}),
+    "chaos": frozenset({"metrics", "obs"}),
+    "utils": frozenset({"kube", "metrics", "obs"}),
+    "kube": frozenset({"chaos", "metrics", "obs", "utils"}),
+    "events": frozenset({"kube", "metrics", "obs", "utils"}),
+    "api": frozenset({"kube", "utils"}),
+    "scheduling": frozenset({"api", "kube", "utils"}),
+    "cloudprovider": frozenset({"api", "kube", "metrics", "obs", "scheduling", "utils"}),
+    "state": frozenset({"api", "kube", "obs", "scheduling", "utils"}),
+    "ops": frozenset({"metrics", "obs", "utils"}),
+    "native": frozenset({"metrics", "obs", "utils"}),
+    "parallel": frozenset({"chaos", "metrics", "obs", "ops", "utils"}),
+    "solver": frozenset({
+        "api", "chaos", "cloudprovider", "events", "kube", "metrics", "native",
+        "obs", "ops", "parallel", "scheduling", "state", "utils",
+    }),
+    "controllers": frozenset({
+        "api", "chaos", "cloudprovider", "events", "kube", "metrics", "native",
+        "obs", "ops", "parallel", "scheduling", "solver", "state", "utils",
+    }),
+    "operator": frozenset({
+        "api", "chaos", "cloudprovider", "controllers", "events", "kube",
+        "metrics", "obs", "scheduling", "solver", "state", "utils", "webhooks",
+    }),
+    "webhooks": frozenset({"api", "kube", "obs", "utils"}),
+    "testing": frozenset({
+        "api", "chaos", "cloudprovider", "controllers", "events", "kube",
+        "metrics", "obs", "operator", "scheduling", "solver", "state", "utils",
+    }),
+}
+
+# monotonic-time allowlist: `relpath::function` sites whose time.time() IS
+# the point — they produce wall-clock timestamps that are serialized,
+# compared against k8s object timestamps, or rendered for humans. Audited
+# in PR 4 (docs/static-analysis.md has the per-site rationale); everything
+# else in the package measures durations and must use time.monotonic()
+# or time.perf_counter().
+DEFAULT_WALLCLOCK_ALLOWLIST: FrozenSet[str] = frozenset({
+    # structured log records carry an epoch ts field (logfmt/JSON output)
+    "karpenter_core_tpu/obs/log.py::_emit",
+    # k8s condition lastTransitionTime is wall-clock API surface
+    "karpenter_core_tpu/api/machine.py::set_condition",
+    # deletionTimestamp mirrors metav1.Time — wall-clock like
+    # creation_timestamp (kube/objects.py ObjectMeta default)
+    "karpenter_core_tpu/kube/client.py::delete",
+    # flight records are stamped with the wall-clock solve time; the dump
+    # filename renders it via time.gmtime
+    "karpenter_core_tpu/obs/flightrec.py::__init__",
+    "karpenter_core_tpu/obs/flightrec.py::dump",
+    # clock=time.time *references* (injectable clock defaults compared
+    # against object wall timestamps) are not calls and are not flagged.
+})
+
+
+@dataclass
+class AnalysisConfig:
+    repo_root: str
+    package_name: str = "karpenter_core_tpu"
+    layering: Dict[str, FrozenSet[str]] = field(
+        default_factory=lambda: dict(DEFAULT_LAYERING)
+    )
+    # subpackages whose absence from `layering` is an error (catches a new
+    # top-level subpackage landing without a declared layer)
+    layering_strict: bool = True
+    wallclock_allowlist: FrozenSet[str] = DEFAULT_WALLCLOCK_ALLOWLIST
+    # the single module allowed to touch os.environ
+    env_funnel: str = "karpenter_core_tpu/obs/envflags.py"
+    # callables that trace the function they wrap (trace-safety pass)
+    trace_wrappers: FrozenSet[str] = frozenset({"jit", "pjit", "shard_map"})
+    # method-name suffix conventionally meaning "caller holds the lock" —
+    # writes there are treated as guarded (guarded-by pass)
+    locked_suffix: str = "_locked"
+
+    def subpackage_of(self, module: str) -> str:
+        """`pkg.solver.encode` -> `solver`; root-level modules -> ''."""
+        prefix = self.package_name + "."
+        if not module.startswith(prefix):
+            return ""
+        rest = module[len(prefix):]
+        return rest.split(".")[0] if "." in rest else (
+            rest if self._is_subpackage(rest) else ""
+        )
+
+    def _is_subpackage(self, name: str) -> bool:
+        return os.path.isdir(
+            os.path.join(self.repo_root, self.package_name, name)
+        )
+
+
+def default_config(repo_root: str | None = None) -> AnalysisConfig:
+    if repo_root is None:
+        # analysis/config.py lives two levels under the repo root
+        repo_root = os.path.dirname(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+    return AnalysisConfig(repo_root=repo_root)

@@ -1,0 +1,268 @@
+"""Framework core: source loading, suppression parsing, pass protocol,
+baseline handling, and the runner.
+
+A pass sees the WHOLE file set at once (layering needs the global import
+graph); single-file passes just loop. Violations carry (relpath, line,
+rule, message); suppressions and the baseline subtract by key. Everything
+here is stdlib-only so the analyzer can run in a bare interpreter and never
+participates in the package's own layering constraints.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+SUPPRESS_RE = re.compile(r"#\s*lint:\s*disable=([A-Za-z0-9_,\- ]+)")
+
+
+@dataclass(frozen=True)
+class Violation:
+    relpath: str  # repo-relative, '/'-separated
+    line: int
+    rule: str
+    message: str
+
+    def key(self) -> str:
+        return f"{self.relpath}:{self.line}:{self.rule}"
+
+    def render(self) -> str:
+        return f"{self.relpath}:{self.line}: [{self.rule}] {self.message}"
+
+
+@dataclass
+class SourceFile:
+    """One parsed module plus its per-line suppressions."""
+
+    path: str  # absolute
+    relpath: str  # relative to the scan root, '/'-separated
+    module: Optional[str]  # dotted module name when under the package root
+    text: str = ""
+    tree: Optional[ast.AST] = None
+    parse_error: Optional[SyntaxError] = None
+    # line -> set of suppressed rule names for that line
+    suppressions: Dict[int, Set[str]] = field(default_factory=dict)
+
+    def suppressed(self, line: int, rule: str) -> bool:
+        rules = self.suppressions.get(line)
+        return rules is not None and (rule in rules or "*" in rules)
+
+
+def parse_suppressions(text: str) -> Dict[int, Set[str]]:
+    out: Dict[int, Set[str]] = {}
+    for i, line in enumerate(text.splitlines(), start=1):
+        if "lint:" not in line:
+            continue
+        m = SUPPRESS_RE.search(line)
+        if m:
+            out[i] = {r.strip() for r in m.group(1).split(",") if r.strip()}
+    return out
+
+
+def load_tree(path: str, relpath: str, module: Optional[str] = None) -> SourceFile:
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    sf = SourceFile(path=path, relpath=relpath, module=module, text=text)
+    sf.suppressions = parse_suppressions(text)
+    try:
+        sf.tree = ast.parse(text, filename=path)
+    except SyntaxError as exc:
+        sf.parse_error = exc
+    return sf
+
+
+def collect_sources(
+    root: str, package_name: str, subdir: Optional[str] = None
+) -> List[SourceFile]:
+    """Walk `<root>/<package_name>` (or a subdir of it) into SourceFiles.
+
+    `relpath` is relative to `root`; `module` is the dotted import name, so
+    `<root>/<pkg>/solver/encode.py` -> `<pkg>.solver.encode`.
+    """
+    base = os.path.join(root, package_name)
+    scan = os.path.join(base, subdir) if subdir else base
+    files: List[SourceFile] = []
+    for dirpath, dirnames, filenames in os.walk(scan):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for filename in sorted(filenames):
+            if not filename.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, filename)
+            rel = os.path.relpath(path, root).replace(os.sep, "/")
+            module = rel[: -len(".py")].replace("/", ".")
+            if module.endswith(".__init__"):
+                module = module[: -len(".__init__")]
+            files.append(load_tree(path, rel, module))
+    return files
+
+
+class Pass:
+    """One analysis pass. Subclasses set `name` (the pass id) and `rules`
+    (every rule id the pass can emit — used by --rule filtering and the
+    docs catalog) and implement run()."""
+
+    name: str = ""
+    rules: Tuple[str, ...] = ()
+
+    def run(self, files: Sequence[SourceFile], config) -> List[Violation]:
+        raise NotImplementedError
+
+    # -- helpers shared by AST passes -------------------------------------
+
+    @staticmethod
+    def syntax_violations(files: Sequence[SourceFile], rule: str) -> List[Violation]:
+        return [
+            Violation(
+                relpath=f.relpath,
+                line=f.parse_error.lineno or 0,
+                rule=rule,
+                message=f"file does not parse: {f.parse_error.msg}",
+            )
+            for f in files
+            if f.parse_error is not None
+        ]
+
+
+def module_scope_imports(tree: ast.AST) -> List[ast.stmt]:
+    """Import statements executed at module import time: top level, plus
+    inside top-level if/try bodies (version shims) — but NOT inside
+    `if TYPE_CHECKING:` blocks, which never run."""
+    out: List[ast.stmt] = []
+
+    def is_type_checking(test: ast.expr) -> bool:
+        return (isinstance(test, ast.Name) and test.id == "TYPE_CHECKING") or (
+            isinstance(test, ast.Attribute) and test.attr == "TYPE_CHECKING"
+        )
+
+    def scan(body: Iterable[ast.stmt]) -> None:
+        for node in body:
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                out.append(node)
+            elif isinstance(node, ast.If):
+                if not is_type_checking(node.test):
+                    scan(node.body)
+                scan(node.orelse)
+            elif isinstance(node, ast.Try):
+                scan(node.body)
+                for handler in node.handlers:
+                    scan(handler.body)
+                scan(node.orelse)
+                scan(node.finalbody)
+            elif isinstance(node, ast.With):
+                scan(node.body)
+
+    scan(getattr(tree, "body", []))
+    return out
+
+
+def resolve_import_targets(
+    node: ast.stmt,
+    current_module: str,
+    known_modules: Set[str],
+    package_name: str,
+    is_package: bool = False,
+) -> List[str]:
+    """Dotted module names a single import statement binds, restricted to
+    modules inside the package (`known_modules`). Handles absolute imports,
+    `from pkg import submodule`, and explicit relative imports
+    (`is_package`: current_module is an __init__, so `from .` is the module
+    itself, not its parent)."""
+    targets: List[str] = []
+    if isinstance(node, ast.Import):
+        for alias in node.names:
+            name = alias.name
+            if name == package_name or name.startswith(package_name + "."):
+                targets.append(name)
+    elif isinstance(node, ast.ImportFrom):
+        if node.level:
+            parts = current_module.split(".")
+            # `from . import x` inside pkg/a/b.py: level 1 strips b; inside
+            # pkg/a/__init__.py (module 'pkg.a') level 1 is pkg.a itself
+            strip = node.level - 1 if is_package else node.level
+            base_parts = parts[: len(parts) - strip] if strip else parts
+            base = ".".join(base_parts)
+            if node.module:
+                base = f"{base}.{node.module}" if base else node.module
+        else:
+            base = node.module or ""
+        if base == package_name or base.startswith(package_name + "."):
+            for alias in node.names:
+                candidate = f"{base}.{alias.name}"
+                # `from pkg.x import y`: y may be a module or an object
+                targets.append(candidate if candidate in known_modules else base)
+    # de-dup while keeping order, and resolve to known modules only
+    seen: Set[str] = set()
+    resolved: List[str] = []
+    for t in targets:
+        mod = t if t in known_modules else _longest_known_prefix(t, known_modules)
+        if mod and mod not in seen:
+            seen.add(mod)
+            resolved.append(mod)
+    return resolved
+
+
+def _longest_known_prefix(dotted: str, known: Set[str]) -> Optional[str]:
+    parts = dotted.split(".")
+    for end in range(len(parts), 0, -1):
+        prefix = ".".join(parts[:end])
+        if prefix in known:
+            return prefix
+    return None
+
+
+def load_baseline(path: str) -> Set[str]:
+    """Baseline entries: `relpath:line:rule` lines; '#' comments and blanks
+    ignored. The checked-in baseline ships empty — this exists so a future
+    emergency can land with a debt marker instead of a suppression spray."""
+    if not os.path.exists(path):
+        return set()
+    entries: Set[str] = set()
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if line and not line.startswith("#"):
+                entries.add(line)
+    return entries
+
+
+@dataclass
+class RunResult:
+    violations: List[Violation]
+    suppressed: List[Violation]
+    baselined: List[Violation]
+
+
+def run_passes(
+    files: Sequence[SourceFile],
+    config,
+    passes: Optional[Sequence[Pass]] = None,
+    rules: Optional[Set[str]] = None,
+    baseline: Optional[Set[str]] = None,
+) -> RunResult:
+    if passes is None:
+        from karpenter_core_tpu.analysis import all_passes
+
+        passes = all_passes()
+    baseline = baseline or set()
+    raw: List[Violation] = []
+    for p in passes:
+        if rules and not (rules & set(p.rules)):
+            continue
+        raw.extend(p.run(files, config))
+    if rules:
+        raw = [v for v in raw if v.rule in rules]
+    by_rel: Dict[str, SourceFile] = {f.relpath: f for f in files}
+    kept: List[Violation] = []
+    suppressed: List[Violation] = []
+    baselined: List[Violation] = []
+    for v in sorted(raw, key=lambda v: (v.relpath, v.line, v.rule, v.message)):
+        sf = by_rel.get(v.relpath)
+        if sf is not None and sf.suppressed(v.line, v.rule):
+            suppressed.append(v)
+        elif v.key() in baseline:
+            baselined.append(v)
+        else:
+            kept.append(v)
+    return RunResult(violations=kept, suppressed=suppressed, baselined=baselined)

@@ -41,13 +41,13 @@ capacity handling), runtime (generic).
 """
 from __future__ import annotations
 
-import os
 import random
 import threading
 import time
 from typing import Callable, Dict, Optional, Union
 
 from karpenter_core_tpu.metrics.registry import NAMESPACE, REGISTRY
+from karpenter_core_tpu.obs import envflags
 from karpenter_core_tpu.obs.log import get_logger
 
 LOG = get_logger("karpenter.chaos")
@@ -370,7 +370,7 @@ def arm_from_env(environ=None) -> Dict[str, Fault]:
     """Arm fault points from KARPENTER_CHAOS (+ KARPENTER_CHAOS_SEED as the
     default per-point seed). Called by entrypoints; a no-op when unset.
     Returns the armed faults."""
-    environ = environ if environ is not None else os.environ
+    environ = environ if environ is not None else envflags.environ()
     spec = environ.get("KARPENTER_CHAOS", "").strip()
     if not spec:
         return {}

@@ -76,7 +76,9 @@ class EvictionQueue:
 
     def start(self) -> None:
         if self._thread is None:
-            self._thread = threading.Thread(target=self._run, daemon=True)
+            self._thread = threading.Thread(
+                target=self._run, daemon=True, name="eviction-queue"
+            )
             self._thread.start()
 
     def stop(self) -> None:

@@ -8,108 +8,27 @@ feasibility expression the TPU kernel (ops/feasibility.py) evaluates densely.
 """
 from __future__ import annotations
 
-import itertools
 from typing import Dict, List, Optional
 
 from karpenter_core_tpu.api import labels as api_labels
-from karpenter_core_tpu.api.machine import (
-    Machine,
-    MachineResourceRequirements,
-    MachineSpec,
-)
-from karpenter_core_tpu.api.provisioner import Provisioner
 from karpenter_core_tpu.cloudprovider.types import InstanceType
 from karpenter_core_tpu.kube.objects import (
     LABEL_HOSTNAME,
-    LABEL_INSTANCE_TYPE_STABLE,
     LABEL_TOPOLOGY_ZONE,
-    Node,
-    ObjectMeta,
     Pod,
     ResourceList,
-    Taint,
 )
 from karpenter_core_tpu.scheduling import taints as taints_mod
 from karpenter_core_tpu.scheduling.hostportusage import HostPortUsage
+# MachineTemplate lives in the neutral scheduling layer (the solver encodes
+# it too); re-exported here for compatibility with existing imports.
+from karpenter_core_tpu.scheduling.machinetemplate import (  # noqa: F401
+    MachineTemplate,
+    next_node_id,
+)
 from karpenter_core_tpu.scheduling.requirement import OP_IN, Requirement
 from karpenter_core_tpu.scheduling.requirements import Requirements
 from karpenter_core_tpu.utils import resources as resources_util
-
-_node_id = itertools.count(1)
-
-
-class MachineTemplate:
-    """Per-Provisioner launch template (machinetemplate.go:32-62)."""
-
-    def __init__(self, provisioner: Provisioner):
-        labels = dict(provisioner.spec.labels)
-        labels[api_labels.PROVISIONER_NAME_LABEL_KEY] = provisioner.name
-        requirements = Requirements()
-        requirements.add(
-            *Requirements.from_node_selector_requirements(*provisioner.spec.requirements).values()
-        )
-        requirements.add(*Requirements.from_labels(labels).values())
-        self.provisioner_name = provisioner.name
-        self.provider = provisioner.spec.provider
-        self.provider_ref = provisioner.spec.provider_ref
-        self.kubelet = provisioner.spec.kubelet_configuration
-        self.annotations = dict(provisioner.spec.annotations)
-        self.labels = labels
-        self.taints: List[Taint] = list(provisioner.spec.taints)
-        self.startup_taints: List[Taint] = list(provisioner.spec.startup_taints)
-        self.requirements = requirements
-        self.requests: ResourceList = {}
-        self.instance_type_options: List[InstanceType] = []
-
-    def to_node(self) -> Node:
-        """machinetemplate.go:64-77."""
-        node = Node(
-            metadata=ObjectMeta(
-                labels={**self.labels, **self.requirements.labels()},
-                annotations=dict(self.annotations),
-                finalizers=[api_labels.TERMINATION_FINALIZER],
-            )
-        )
-        node.spec.taints = list(self.taints) + list(self.startup_taints)
-        return node
-
-    def to_machine(self) -> Machine:
-        """machinetemplate.go:79-100 — narrows instance-type requirement to
-        the final option set; inline provider config rides the compatibility
-        annotation (provisioner.go:104-112)."""
-        self.requirements.add(
-            Requirement(
-                LABEL_INSTANCE_TYPE_STABLE,
-                OP_IN,
-                [it.name for it in self.instance_type_options],
-            )
-        )
-        annotations = dict(self.annotations)
-        if self.provider is not None:
-            import json
-
-            annotations[api_labels.PROVIDER_COMPATIBILITY_ANNOTATION_KEY] = json.dumps(
-                self.provider, sort_keys=True
-            )
-        machine = Machine(
-            metadata=ObjectMeta(
-                name=f"{self.provisioner_name}-{next(_node_id):05d}",
-                annotations=annotations,
-                labels=dict(self.labels),
-            ),
-            spec=MachineSpec(
-                taints=list(self.taints),
-                startup_taints=list(self.startup_taints),
-                requirements=[
-                    r.to_node_selector_requirement() for r in self.requirements.values()
-                ],
-                resources=MachineResourceRequirements(requests=dict(self.requests)),
-                kubelet=self.kubelet,
-                machine_template_ref=self.provider_ref,
-            ),
-        )
-        machine.metadata.namespace = ""
-        return machine
 
 
 class SchedulingMachine:
@@ -122,7 +41,7 @@ class SchedulingMachine:
         daemon_resources: ResourceList,
         instance_types: List[InstanceType],
     ):
-        hostname = f"hostname-placeholder-{next(_node_id):04d}"
+        hostname = f"hostname-placeholder-{next_node_id():04d}"
         topology.register(LABEL_HOSTNAME, hostname)
         self.template = template
         self.provisioner_name = template.provisioner_name

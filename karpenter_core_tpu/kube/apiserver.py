@@ -244,10 +244,10 @@ class ApiServerKubeClient:
 
     @classmethod
     def in_cluster(cls, **kwargs):
-        import os
+        from karpenter_core_tpu.obs import envflags
 
-        host = os.environ.get("KUBERNETES_SERVICE_HOST", "kubernetes.default.svc")
-        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        host = envflags.raw("KUBERNETES_SERVICE_HOST", "kubernetes.default.svc")
+        port = envflags.raw("KUBERNETES_SERVICE_PORT", "443")
         return cls(UrllibTransport(f"https://{host}:{port}"), **kwargs)
 
     # -- path/encoding helpers ---------------------------------------------
@@ -538,7 +538,7 @@ class ApiServerKubeClient:
                 except Exception:
                     cancel.wait(2.0)  # stream dropped; relist on retry
 
-        t = threading.Thread(target=pump, daemon=True)
+        t = threading.Thread(target=pump, daemon=True, name=f"apiserver-watch-{kind}")
         t.start()
         self._watch_threads.append(t)
         return q

@@ -1,8 +1,52 @@
-"""Shared truthy/falsy env-var spellings for the obs/ arming hooks
-(KARPENTER_TPU_TRACE / KARPENTER_TPU_LOG / KARPENTER_TPU_FLIGHTREC), so the
-three parsers cannot drift. The empty string is deliberately NOT in FALSY:
-each parser decides what "unset" means (tracer/flightrec leave state to the
-entrypoint default; the log parser treats it as off)."""
+"""The package's single funnel for environment configuration.
+
+Every env read in karpenter_core_tpu/ routes through these accessors — the
+`env-flags` lint rule (analysis/envdiscipline.py) bans direct os.environ /
+os.getenv use anywhere else. One funnel means the truthy/falsy grammar
+can't drift between parsers, the knob surface is greppable in one place,
+and tests monkeypatching os.environ keep working (reads stay live, nothing
+is cached here).
+
+TRUTHY/FALSY are the shared spellings for the obs/ arming hooks
+(KARPENTER_TPU_TRACE / KARPENTER_TPU_LOG / KARPENTER_TPU_FLIGHTREC). The
+empty string is deliberately NOT in FALSY: each parser decides what
+"unset" means (tracer/flightrec leave state to the entrypoint default; the
+log parser treats it as off).
+"""
+from __future__ import annotations
+
+import os
+from typing import Mapping
 
 TRUTHY = ("1", "true", "on", "yes")
 FALSY = ("0", "false", "off", "no")
+
+
+def raw(name: str, default: str = "") -> str:
+    """os.environ.get with a string default — the universal accessor for
+    callers that do their own parsing."""
+    return os.environ.get(name, default)
+
+
+def require(name: str) -> str:
+    """Read a mandatory variable; KeyError (with the variable name) when
+    unset — for knobs like KARPENTER_DIST_NUM_PROCESSES that have no sane
+    default once their feature is enabled."""
+    return os.environ[name]
+
+
+def get_bool(name: str, default: bool = False) -> bool:
+    """Parse TRUTHY/FALSY spellings; unset or unrecognized -> default."""
+    value = os.environ.get(name, "").strip().lower()
+    if value in TRUTHY:
+        return True
+    if value in FALSY:
+        return False
+    return default
+
+
+def environ() -> Mapping[str, str]:
+    """The live process environment, for callers that take a mapping
+    parameter (chaos.arm_from_env) — still a funnel: the mapping identity
+    is handed out, never copied, so monkeypatched entries are visible."""
+    return os.environ

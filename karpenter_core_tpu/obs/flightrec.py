@@ -37,6 +37,7 @@ import time
 from collections import deque
 from typing import Dict, List, Optional, Tuple
 
+from karpenter_core_tpu.obs import envflags
 from karpenter_core_tpu.obs.envflags import FALSY as _FALSY, TRUTHY as _TRUTHY
 
 SCHEMA_VERSION = 1
@@ -697,8 +698,8 @@ def enable_flightrec_from_env(default_on: bool = False) -> bool:
     directory from KARPENTER_TPU_FLIGHTREC_DIR) — the ONE parser of those
     variables, shared by the import hook (default off) and the operator
     entrypoint (default on). Returns the resulting enabled state."""
-    raw = os.environ.get("KARPENTER_TPU_FLIGHTREC", "").strip().lower()
-    FLIGHTREC.dump_dir = os.environ.get(
+    raw = envflags.raw("KARPENTER_TPU_FLIGHTREC").strip().lower()
+    FLIGHTREC.dump_dir = envflags.raw(
         "KARPENTER_TPU_FLIGHTREC_DIR", FLIGHTREC.dump_dir
     ) or os.path.join(tempfile_dir(), "karpenter-flightrec")
     if raw in _FALSY:

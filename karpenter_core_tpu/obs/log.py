@@ -33,6 +33,7 @@ import traceback
 from collections import deque
 from typing import Dict, List, Optional
 
+from karpenter_core_tpu.obs import envflags
 from karpenter_core_tpu.obs.envflags import FALSY as _FALSY, TRUTHY as _TRUTHY
 from karpenter_core_tpu.obs.tracer import TRACER
 
@@ -306,7 +307,7 @@ def configure_logging_from_env(default_level: str = "") -> bool:
     operator / solver-service entrypoints (default info). Returns the
     resulting enabled state."""
     spec = parse_log_spec(
-        os.environ.get("KARPENTER_TPU_LOG", "") or default_level
+        envflags.raw("KARPENTER_TPU_LOG") or default_level
     )
     if spec is None:
         SINK.disable()

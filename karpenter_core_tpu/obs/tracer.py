@@ -357,6 +357,7 @@ class Tracer:
 # the process-wide tracer
 TRACER = Tracer()
 
+from karpenter_core_tpu.obs import envflags  # noqa: E402
 from karpenter_core_tpu.obs.envflags import FALSY as _FALSY, TRUTHY as _TRUTHY  # noqa: E402
 
 
@@ -366,7 +367,7 @@ def enable_tracing_from_env(default_on: bool = False) -> bool:
     operator / solver-service entrypoints (default on), so truthy
     spellings like 'true'/'on' behave identically everywhere. Returns the
     resulting enabled state."""
-    raw = os.environ.get("KARPENTER_TPU_TRACE", "").strip().lower()
+    raw = envflags.raw("KARPENTER_TPU_TRACE").strip().lower()
     if raw in _FALSY:
         TRACER.disable()
     elif default_on or raw in _TRUTHY:
@@ -386,8 +387,8 @@ def profile_dir() -> str:
     know whether profiling is active (e.g. to barrier the dispatch) must
     use this instead of re-reading the env."""
     return (
-        os.environ.get("KARPENTER_TPU_PROFILE", "")
-        or os.environ.get("KARPENTER_JAX_TRACE_DIR", "")
+        envflags.raw("KARPENTER_TPU_PROFILE")
+        or envflags.raw("KARPENTER_JAX_TRACE_DIR")
     )
 
 

@@ -272,7 +272,9 @@ class Operator:
                                 log.exception("watch relist failed", kind=kind)
                                 self._stop.wait(0.2)
 
-            t = threading.Thread(target=pump, daemon=True)
+            t = threading.Thread(
+                target=pump, daemon=True, name=f"operator-watch-{kind}"
+            )
             t.start()
             self._threads.append(t)
 

@@ -28,13 +28,13 @@ validation.
 from __future__ import annotations
 
 import json
-import os
 import signal
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from karpenter_core_tpu.api.settings import Settings
 from karpenter_core_tpu.metrics.registry import REGISTRY
+from karpenter_core_tpu.obs import envflags
 from karpenter_core_tpu.obs.log import get_logger
 from karpenter_core_tpu.operator import new_operator
 
@@ -43,7 +43,7 @@ LOG = get_logger("karpenter.operator")
 
 def solver_from_env():
     """KARPENTER_SOLVER_ENDPOINT -> RemoteSolver, else None (in-process)."""
-    endpoint = os.environ.get("KARPENTER_SOLVER_ENDPOINT", "")
+    endpoint = envflags.raw("KARPENTER_SOLVER_ENDPOINT")
     if not endpoint:
         return None
     from karpenter_core_tpu.solver.service import RemoteSolver
@@ -53,8 +53,8 @@ def solver_from_env():
 
 def settings_from_env() -> Settings:
     return Settings(
-        batch_idle_duration=float(os.environ.get("KARPENTER_BATCH_IDLE_SECONDS", "1")),
-        batch_max_duration=float(os.environ.get("KARPENTER_BATCH_MAX_SECONDS", "10")),
+        batch_idle_duration=float(envflags.raw("KARPENTER_BATCH_IDLE_SECONDS", "1")),
+        batch_max_duration=float(envflags.raw("KARPENTER_BATCH_MAX_SECONDS", "10")),
     )
 
 
@@ -100,7 +100,7 @@ def configure_logging() -> None:
     from karpenter_core_tpu.obs.log import configure_logging_from_env
 
     configure_logging_from_env(default_level="info")
-    raw = os.environ.get("KARPENTER_LOGGING_CONFIG", "")
+    raw = envflags.raw("KARPENTER_LOGGING_CONFIG")
     configured = False
     if raw:
         try:
@@ -112,7 +112,7 @@ def configure_logging() -> None:
                 error_detail=str(exc),
             )
     if not configured:
-        level = os.environ.get("KARPENTER_LOG_LEVEL", "INFO").upper()
+        level = envflags.raw("KARPENTER_LOG_LEVEL", "INFO").upper()
         logging.basicConfig(
             level=getattr(logging, level, logging.INFO),
             format="%(asctime)s %(levelname)s %(name)s [%(controller)s] %(message)s",
@@ -248,7 +248,9 @@ def serve_health(operator, port: int, profiling: bool = False) -> ThreadingHTTPS
     # registration (operator.go:124-126)
     _HealthHandler.profiling_enabled = profiling
     server = ThreadingHTTPServer(("0.0.0.0", port), _HealthHandler)
-    threading.Thread(target=server.serve_forever, daemon=True).start()
+    threading.Thread(
+        target=server.serve_forever, daemon=True, name="health-metrics-http"
+    ).start()
     return server
 
 

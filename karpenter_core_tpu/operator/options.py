@@ -9,12 +9,11 @@ unchanged.
 from __future__ import annotations
 
 import argparse
-import os
 from dataclasses import dataclass
 
+from karpenter_core_tpu.obs import envflags
 
-def _env(name: str, default: str) -> str:
-    return os.environ.get(name, default)
+_env = envflags.raw
 
 
 @dataclass

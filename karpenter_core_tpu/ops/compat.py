@@ -29,7 +29,7 @@ def resolve_backend(device=None) -> str:
     single-pass screen). Kernel builders take this as an explicit option so
     tracing for a non-default device can't bake the wrong branch
     (jax.default_backend() is only the fallback when no device is given)."""
-    import os
+    from karpenter_core_tpu.obs import envflags
 
     platform = device.platform if device is not None else jax.default_backend()
     if platform == "cpu":
@@ -38,7 +38,7 @@ def resolve_backend(device=None) -> str:
     # geometry (12.5k slots x 2k values, 1k items) it beats the fused
     # Pallas screen (575ms vs 638ms device solve) — the screen's padded
     # staging outweighs its fusion win at this scale. KCT_PALLAS=1 opts in.
-    if os.environ.get("KCT_PALLAS", "auto") in ("1", "true", "on"):
+    if envflags.raw("KCT_PALLAS", "auto") in ("1", "true", "on"):
         return "pallas"
     return "mxu"
 

@@ -28,7 +28,7 @@ import numpy as np
 from karpenter_core_tpu.api import labels as api_labels
 from karpenter_core_tpu.api.provisioner import Provisioner
 from karpenter_core_tpu.cloudprovider.types import InstanceType
-from karpenter_core_tpu.controllers.provisioning.scheduling.machine import MachineTemplate
+from karpenter_core_tpu.scheduling.machinetemplate import MachineTemplate
 from karpenter_core_tpu.kube.objects import (
     LABEL_HOSTNAME,
     LABEL_TOPOLOGY_ZONE,

@@ -31,7 +31,7 @@ environment when KARPENTER_DIST_COORDINATOR=auto).
 """
 from __future__ import annotations
 
-import os
+from karpenter_core_tpu.obs import envflags
 from typing import Optional
 
 _dist_initialized = False
@@ -42,7 +42,7 @@ def ensure_distributed() -> bool:
     Idempotent; returns True when multi-host mode is active. Must run
     before the first jax.devices() call in the process."""
     global _dist_initialized
-    coordinator = os.environ.get("KARPENTER_DIST_COORDINATOR", "")
+    coordinator = envflags.raw("KARPENTER_DIST_COORDINATOR")
     if not coordinator or _dist_initialized:
         return _dist_initialized
     import jax
@@ -52,8 +52,8 @@ def ensure_distributed() -> bool:
     else:
         jax.distributed.initialize(
             coordinator_address=coordinator,
-            num_processes=int(os.environ["KARPENTER_DIST_NUM_PROCESSES"]),
-            process_id=int(os.environ["KARPENTER_DIST_PROCESS_ID"]),
+            num_processes=int(envflags.require("KARPENTER_DIST_NUM_PROCESSES")),
+            process_id=int(envflags.require("KARPENTER_DIST_PROCESS_ID")),
         )
     _dist_initialized = True
     return True
@@ -73,7 +73,7 @@ def detect_mesh(devices=None, tp: Optional[int] = None):
     if n < 2:
         return None
     if tp is None:
-        tp_env = os.environ.get("KARPENTER_MESH_TP", "")
+        tp_env = envflags.raw("KARPENTER_MESH_TP")
         tp = int(tp_env) if tp_env else (2 if n % 2 == 0 and n >= 4 else 1)
     if tp < 1 or n % tp != 0:
         raise ValueError(f"tp={tp} does not divide device count {n}")
@@ -96,7 +96,7 @@ def build_solver(max_nodes: int = 1024, mode: Optional[str] = None,
 
     max_nodes is the GLOBAL new-machine slot budget; the sharded path
     divides it across dp shards unless max_nodes_per_shard pins it."""
-    mode = (mode or os.environ.get("KARPENTER_SOLVER_MODE", "auto")).lower()
+    mode = (mode or envflags.raw("KARPENTER_SOLVER_MODE", "auto")).lower()
     if mode not in ("auto", "single", "sharded"):
         raise ValueError(f"unknown KARPENTER_SOLVER_MODE {mode!r}")
     mesh = None

@@ -25,6 +25,7 @@ import numpy as np
 
 from karpenter_core_tpu import chaos
 from karpenter_core_tpu.metrics.registry import NAMESPACE, REGISTRY
+from karpenter_core_tpu.obs import envflags
 from karpenter_core_tpu.obs import TRACE_HEADER, TRACER
 from karpenter_core_tpu.obs.log import get_logger
 
@@ -710,7 +711,6 @@ def main(argv: Optional[List[str]] = None) -> None:
     from karpenter_core_tpu.utils.compilecache import enable_persistent_cache
 
     enable_persistent_cache()
-    import os
 
     # server-side solve tracing + structured logging, on by default like
     # the operator's (KARPENTER_TPU_TRACE=0 / KARPENTER_TPU_LOG=off opt
@@ -726,7 +726,7 @@ def main(argv: Optional[List[str]] = None) -> None:
 
     from karpenter_core_tpu.solver.factory import detect_mesh
 
-    mode = os.environ.get("KARPENTER_SOLVER_MODE", "auto").lower()
+    mode = envflags.raw("KARPENTER_SOLVER_MODE", "auto").lower()
     mesh = None
     if mode != "single":
         mesh = detect_mesh()
@@ -740,7 +740,7 @@ def main(argv: Optional[List[str]] = None) -> None:
     # jax runtime and compile/load a small solve so the first production
     # Solve doesn't eat the backend-init stall; with the persistent cache
     # populated, real-geometry programs load from disk on first request
-    if os.environ.get("KARPENTER_SOLVER_WARMUP", "1") != "0":
+    if envflags.raw("KARPENTER_SOLVER_WARMUP", "1") != "0":
         import time as _time
 
         t0 = _time.perf_counter()

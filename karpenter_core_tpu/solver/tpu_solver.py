@@ -26,8 +26,8 @@ import numpy as np
 from karpenter_core_tpu import chaos
 from karpenter_core_tpu.api.provisioner import Provisioner
 from karpenter_core_tpu.cloudprovider.types import InstanceType
-from karpenter_core_tpu.controllers.provisioning.scheduling.machine import MachineTemplate
-from karpenter_core_tpu.controllers.provisioning.scheduling.preferences import Preferences
+from karpenter_core_tpu.scheduling.machinetemplate import MachineTemplate
+from karpenter_core_tpu.scheduling.preferences import Preferences
 from karpenter_core_tpu.kube.objects import Pod, ResourceList
 from karpenter_core_tpu.obs import TRACER, device_profiler, profile_dir
 from karpenter_core_tpu.scheduling.requirements import Requirements

@@ -1,0 +1,328 @@
+"""Lock-order-graph recorder: the runtime half of the concurrency lints.
+
+The static `guarded-by` rule catches unguarded writes; what it cannot see
+is ACQUISITION ORDER. With 28 lock sites across operator/solver/obs, two
+code paths taking the same pair of locks in opposite order is a deadlock
+that only fires under the right interleaving — the Go reference gets this
+from the `-race`-instrumented presubmit; this module is the Python analog.
+
+Mechanism: `install()` monkeypatches `threading.Lock` / `threading.RLock`
+with factories that return wrapping proxies for locks ALLOCATED FROM
+PACKAGE CODE (the allocation frame decides — jax/stdlib/test locks pass
+through untouched, so library internals like `queue.Queue` and
+`threading.Condition`'s internal RLock keep their exact native types and
+the suite pays no broad overhead). Each proxy records, per thread, the
+stack of held lock SITES (allocation file:line — instances pool by site so
+per-object locks aggregate); acquiring B while holding A adds the edge
+A->B with a witness. A cycle in the site graph = an acquisition-order
+inversion = a potential deadlock, reported with both witnesses.
+
+Arming: tests/conftest.py installs the global watcher unless
+KARPENTER_LOCKWATCH is falsy, and fails the session on cycles at exit.
+Standalone `LockWatch` instances (tests, tools) can `make_lock()` tracked
+locks without touching the global patch.
+
+Reentrant acquisition of the same lock object never adds an edge, and
+self-edges at one site (two instances from the same allocation line) are
+ignored: per-instance sibling locks (one lock per watch subscription, per
+solver, ...) are routinely held pairwise in either order without a global
+ordering contract, and flagging them would drown the real inversions.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from typing import Dict, List, Optional, Set, Tuple
+
+# the one lock guarding the watcher's own state must never be a proxy:
+# allocate the raw C primitive directly
+_allocate_lock = threading._allocate_lock
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_SELF = os.path.abspath(__file__)
+
+
+def _default_filter(filename: str) -> bool:
+    """Track locks allocated from package source only (not this module)."""
+    f = os.path.abspath(filename)
+    return f.startswith(_PKG_DIR + os.sep) and f != _SELF
+
+
+class _Acquisition:
+    __slots__ = ("site", "count")
+
+    def __init__(self, site: str) -> None:
+        self.site = site
+        self.count = 1
+
+
+class TrackedLock:
+    """Proxy over a real lock primitive, recording ordering edges."""
+
+    def __init__(self, watch: "LockWatch", inner, site: str) -> None:
+        self._watch = watch
+        self._inner = inner
+        self._site = site
+
+    # -- lock protocol -----------------------------------------------------
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        got = self._inner.acquire(blocking, timeout)
+        if got:
+            self._watch._note_acquire(self)
+        return got
+
+    __enter__ = acquire
+
+    def release(self) -> None:
+        self._inner.release()
+        self._watch._note_release(self)
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    # Condition() support when constructed around a tracked RLock
+    def _is_owned(self):
+        return self._inner._is_owned()
+
+    def _release_save(self):
+        state = self._inner._release_save()
+        self._watch._note_release(self, full=True)
+        return state
+
+    def _acquire_restore(self, state) -> None:
+        self._inner._acquire_restore(state)
+        self._watch._note_acquire(self)
+
+    def _at_fork_reinit(self) -> None:
+        self._inner._at_fork_reinit()
+
+    def __repr__(self) -> str:
+        return f"<TrackedLock site={self._site} inner={self._inner!r}>"
+
+
+class LockWatch:
+    """Acquisition-order graph over lock allocation sites."""
+
+    def __init__(self, track_filter=None) -> None:
+        self._mu = _allocate_lock()
+        self._filter = track_filter or _default_filter
+        self._local = threading.local()
+        # site -> site -> witness string
+        self._edges: Dict[str, Dict[str, str]] = {}
+        self._sites: Set[str] = set()
+        self._installed = False
+        self._orig_lock = None
+        self._orig_rlock = None
+
+    # -- allocation --------------------------------------------------------
+
+    def make_lock(self, site: Optional[str] = None, rlock: bool = False):
+        """Explicitly allocate a tracked lock (tests/tools)."""
+        inner = (self._orig_rlock or threading.RLock)() if rlock else (
+            (self._orig_lock or threading.Lock)()
+        )
+        # unwrap accidental double-tracking when the global patch is live
+        if isinstance(inner, TrackedLock):
+            inner = inner._inner
+        site = site or self._caller_site(depth=2)
+        with self._mu:
+            self._sites.add(site)
+        return TrackedLock(self, inner, site)
+
+    @staticmethod
+    def _caller_site(depth: int) -> str:
+        frame = sys._getframe(depth)
+        return f"{frame.f_code.co_filename}:{frame.f_lineno}"
+
+    def _factory(self, orig, kind: str):
+        watch = self
+
+        def allocate():
+            inner = orig()
+            frame = sys._getframe(1)
+            if not watch._filter(frame.f_code.co_filename):
+                return inner
+            rel = os.path.relpath(frame.f_code.co_filename, os.path.dirname(_PKG_DIR))
+            site = f"{rel}:{frame.f_lineno}"
+            with watch._mu:
+                watch._sites.add(site)
+            return TrackedLock(watch, inner, site)
+
+        allocate.__name__ = kind
+        return allocate
+
+    def install(self) -> "LockWatch":
+        """Patch threading.Lock/RLock so package allocations are tracked.
+        Idempotent; returns self."""
+        with self._mu:
+            if self._installed:
+                return self
+            self._orig_lock = threading.Lock
+            self._orig_rlock = threading.RLock
+            self._installed = True
+        threading.Lock = self._factory(self._orig_lock, "Lock")
+        threading.RLock = self._factory(self._orig_rlock, "RLock")
+        return self
+
+    def uninstall(self) -> None:
+        with self._mu:
+            if not self._installed:
+                return
+            self._installed = False
+        threading.Lock = self._orig_lock
+        threading.RLock = self._orig_rlock
+
+    # -- recording ---------------------------------------------------------
+
+    def _held(self) -> List[_Acquisition]:
+        held = getattr(self._local, "held", None)
+        if held is None:
+            held = self._local.held = []
+        return held
+
+    def _note_acquire(self, lock: TrackedLock) -> None:
+        held = self._held()
+        for acq in held:
+            if acq.site == lock._site:
+                # reentrant or same-site sibling: never an ordering edge
+                acq.count += 1
+                return
+        if held:
+            holder = held[-1].site
+            if holder != lock._site:
+                witness = (
+                    f"thread '{threading.current_thread().name}' acquired "
+                    f"{lock._site} while holding {holder}"
+                )
+                with self._mu:
+                    self._edges.setdefault(holder, {}).setdefault(
+                        lock._site, witness
+                    )
+        held.append(_Acquisition(lock._site))
+
+    def _note_release(self, lock: TrackedLock, full: bool = False) -> None:
+        held = getattr(self._local, "held", None)
+        if not held:
+            return
+        for i in range(len(held) - 1, -1, -1):
+            if held[i].site == lock._site:
+                held[i].count -= 1
+                if full or held[i].count <= 0:
+                    del held[i]
+                return
+
+    # -- analysis ----------------------------------------------------------
+
+    def edges(self) -> Dict[str, Dict[str, str]]:
+        with self._mu:
+            return {a: dict(bs) for a, bs in self._edges.items()}
+
+    def cycles(self) -> List[List[str]]:
+        """Site cycles in the acquisition-order graph (each returned list
+        is one cycle, sites in order; the inversion witnesses come from
+        report())."""
+        graph = self.edges()
+        sccs = _sccs({a: list(bs) for a, bs in graph.items()})
+        return [sorted(s) for s in sccs if len(s) > 1]
+
+    def report(self) -> str:
+        cycles = self.cycles()
+        if not cycles:
+            return "lockwatch: no acquisition-order cycles"
+        graph = self.edges()
+        lines = [
+            f"lockwatch: {len(cycles)} potential deadlock(s) — lock "
+            "acquisition-order cycle(s) detected:"
+        ]
+        for cycle in cycles:
+            lines.append("  cycle: " + " <-> ".join(cycle))
+            members = set(cycle)
+            for a in cycle:
+                for b, witness in sorted(graph.get(a, {}).items()):
+                    if b in members:
+                        lines.append(f"    {witness}")
+        return "\n".join(lines)
+
+    def reset(self) -> None:
+        with self._mu:
+            self._edges.clear()
+
+
+def _sccs(graph: Dict[str, List[str]]) -> List[Set[str]]:
+    """Iterative Tarjan (shared shape with analysis/layering, duplicated so
+    the runtime watcher stays importable without the analysis package)."""
+    index: Dict[str, int] = {}
+    lowlink: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    out: List[Set[str]] = []
+    counter = [0]
+    nodes = set(graph)
+    for targets in graph.values():
+        nodes.update(targets)
+    full = {n: [t for t in graph.get(n, [])] for n in nodes}
+
+    for root in full:
+        if root in index:
+            continue
+        work: List[Tuple[str, int]] = [(root, 0)]
+        while work:
+            node, ei = work[-1]
+            if ei == 0:
+                index[node] = lowlink[node] = counter[0]
+                counter[0] += 1
+                stack.append(node)
+                on_stack.add(node)
+            advanced = False
+            targets = full[node]
+            while ei < len(targets):
+                target = targets[ei]
+                ei += 1
+                if target not in index:
+                    work[-1] = (node, ei)
+                    work.append((target, 0))
+                    advanced = True
+                    break
+                if target in on_stack:
+                    lowlink[node] = min(lowlink[node], index[target])
+            if advanced:
+                continue
+            work.pop()
+            if lowlink[node] == index[node]:
+                scc: Set[str] = set()
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.add(w)
+                    if w == node:
+                        break
+                out.append(scc)
+            if work:
+                parent, _ = work[-1]
+                lowlink[parent] = min(lowlink[parent], lowlink[node])
+    return out
+
+
+# -- global instance (conftest arming) ------------------------------------
+
+GLOBAL = LockWatch()
+
+
+def arm(spec: str = "", default_on: bool = True) -> bool:
+    """Install the global watcher per a KARPENTER_LOCKWATCH spec string
+    (truthy/falsy spellings shared with obs/envflags; empty -> default_on).
+    The CALLER reads the environment — conftest.py arms this before the
+    package (and its module-level locks) loads, and this module stays
+    stdlib-only with no env access of its own (env-flags rule)."""
+    spec = (spec or "").strip().lower()
+    if spec in ("0", "false", "off", "no"):
+        return False
+    if spec in ("1", "true", "on", "yes") or default_on:
+        GLOBAL.install()
+        return True
+    return False

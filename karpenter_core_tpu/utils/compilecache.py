@@ -19,6 +19,7 @@ import tempfile
 from typing import Optional
 
 from karpenter_core_tpu.metrics.registry import NAMESPACE, REGISTRY
+from karpenter_core_tpu.obs import envflags
 
 # compiled-program cache observability: every in-process executable-cache
 # lookup (TPUSolver._compiled, SolverService._compiled) records a hit or a
@@ -56,7 +57,7 @@ def enable_persistent_cache(cache_dir: Optional[str] = None) -> Optional[str]:
     KARPENTER_COMPILE_CACHE_DIR overrides the default
     (<tmp>/karpenter-tpu-xla-cache); set it to "0" / "off" to disable.
     Returns the directory in use, or None when disabled/unavailable."""
-    env = os.environ.get("KARPENTER_COMPILE_CACHE_DIR", "")
+    env = envflags.raw("KARPENTER_COMPILE_CACHE_DIR")
     if env.lower() in ("0", "off", "disabled"):
         return None
     cache_dir = cache_dir or env or os.path.join(

@@ -241,7 +241,9 @@ class WebhookServer:
         httpd.socket = ctx.wrap_socket(httpd.socket, server_side=True)
         self._httpd = httpd
         self._cert_pem = cert_pem
-        self._thread = threading.Thread(target=httpd.serve_forever, daemon=True)
+        self._thread = threading.Thread(
+            target=httpd.serve_forever, daemon=True, name="webhook-https"
+        )
         self._thread.start()
         return httpd.server_address[1]
 
@@ -250,7 +252,9 @@ class WebhookServer:
         cert_pem, key_pem = self.cert_manager.reconcile()
         port = self._serve(cert_pem, key_pem)
         self.port = port  # keep the bound port across rotation restarts
-        self._rotator = threading.Thread(target=self._rotate_loop, daemon=True)
+        self._rotator = threading.Thread(
+            target=self._rotate_loop, daemon=True, name="webhook-cert-rotator"
+        )
         self._rotator.start()
         return port
 

@@ -1,0 +1,43 @@
+"""Seeded concurrency-discipline violations: bare except, undisciplined
+threads, and a lock-inconsistent attribute write."""
+import threading
+
+
+def swallow():
+    try:
+        risky()
+    except:  # BAD: bare except
+        pass
+
+
+def risky():
+    raise RuntimeError
+
+
+def spawn():
+    t = threading.Thread(target=risky)  # BAD: no daemon=, no name=
+    t.start()
+    u = threading.Thread(target=risky, daemon=True)  # BAD: no name=
+    u.start()
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0  # construction writes are exempt
+
+    def bump(self):
+        with self._lock:
+            self.value += 1  # guarded write
+
+    def reset(self):
+        self.value = 0  # BAD: unguarded write to a guarded attribute
+
+
+import threading as th
+from threading import Thread as SpawnThread
+
+
+def aliased_spawns():
+    th.Thread(target=risky).start()  # BAD: aliased module, no daemon/name
+    SpawnThread(target=risky).start()  # BAD: from-import alias, no daemon/name

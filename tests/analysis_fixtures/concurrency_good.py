@@ -1,0 +1,62 @@
+"""Disciplined concurrency the pass must NOT flag."""
+import threading
+
+
+def careful():
+    try:
+        risky()
+    except Exception:
+        pass
+
+
+def risky():
+    raise RuntimeError
+
+
+def spawn():
+    t = threading.Thread(target=risky, daemon=True, name="fixture-worker")
+    t.start()
+
+
+class Counter:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0
+
+    def bump(self):
+        with self._lock:
+            self.value += 1
+
+    def reset(self):
+        with self._lock:
+            self.value = 0
+
+    def _drain_locked(self):
+        # `_locked` suffix: caller holds the lock by convention
+        self.value = 0
+
+
+class Plain:
+    """No lock in the class: writes are never guarded-by candidates."""
+
+    def __init__(self):
+        self.count = 0
+
+    def bump(self):
+        self.count += 1
+
+
+class TimeoutGuarded:
+    """`with self._lock.acquire_timeout(...)` counts as holding the lock."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.state = "idle"
+
+    def begin(self):
+        with self._lock:
+            self.state = "busy"
+
+    def finish(self):
+        with self._lock.acquire_timeout(5):
+            self.state = "idle"

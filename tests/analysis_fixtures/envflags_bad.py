@@ -1,0 +1,10 @@
+"""Seeded env-flags violations: every direct-access spelling."""
+import os
+import os as operating_system
+from os import environ, getenv
+
+A = os.environ.get("KARPENTER_FIXTURE_A", "")  # BAD
+B = os.getenv("KARPENTER_FIXTURE_B")  # BAD
+C = operating_system.environ["KARPENTER_FIXTURE_C"]  # BAD: aliased module
+D = environ.get("KARPENTER_FIXTURE_D")  # BAD: from-import
+E = getenv("KARPENTER_FIXTURE_E")  # BAD: from-import

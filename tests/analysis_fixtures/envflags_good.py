@@ -1,0 +1,9 @@
+"""Env reads through the funnel — nothing to flag (os itself stays usable
+for paths etc.)."""
+import os.path
+
+from karpenter_core_tpu.obs import envflags
+
+A = envflags.raw("KARPENTER_FIXTURE_A")
+B = envflags.get_bool("KARPENTER_FIXTURE_B", default=True)
+P = os.path.join("/tmp", "x")

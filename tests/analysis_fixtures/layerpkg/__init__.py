@@ -1,0 +1,1 @@
+"""Synthetic package root for layering-pass fixtures."""

@@ -1,0 +1,5 @@
+from layerpkg.solver import good_import  # allowed: controllers -> solver
+
+
+def helper():
+    return good_import

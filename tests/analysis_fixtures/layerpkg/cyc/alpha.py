@@ -1,0 +1,3 @@
+from layerpkg.cyc import beta  # BAD: alpha <-> beta module cycle
+
+VALUE = 1

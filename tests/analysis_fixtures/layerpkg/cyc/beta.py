@@ -1,0 +1,3 @@
+from layerpkg.cyc import alpha  # BAD: alpha <-> beta module cycle
+
+VALUE = 2

@@ -1,0 +1,1 @@
+from . import good_import  # intra-subpackage relative import: fine

@@ -1,0 +1,6 @@
+"""BAD: solver reaching up into controllers at module scope."""
+from layerpkg.controllers.logic import helper  # layering violation
+
+
+def solve():
+    return helper()

@@ -1,0 +1,6 @@
+"""BAD: same layering violation spelled as an explicit relative import."""
+from ..controllers.logic import helper  # layering violation (relative)
+
+
+def solve():
+    return helper()

@@ -1,0 +1,11 @@
+"""Function-scope and TYPE_CHECKING imports are exempt."""
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:
+    from layerpkg.controllers.logic import helper  # annotation-only: fine
+
+
+def solve():
+    from layerpkg.controllers.logic import helper  # runtime collab: fine
+
+    return helper()

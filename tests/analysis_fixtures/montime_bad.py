@@ -1,0 +1,16 @@
+"""Seeded monotonic-time violations."""
+import time
+import time as clock_mod
+from time import time as now
+
+
+def deadline(timeout):
+    return time.time() + timeout  # BAD: deadline from wall clock
+
+
+def elapsed(start):
+    return clock_mod.time() - start  # BAD: aliased module
+
+
+def stamp():
+    return now()  # BAD: from-import alias

@@ -1,0 +1,16 @@
+"""Monotonic/perf_counter durations and allowlisted wall-clock sites."""
+import time
+
+
+def deadline(timeout):
+    return time.monotonic() + timeout
+
+
+def measure():
+    t0 = time.perf_counter()
+    return time.perf_counter() - t0
+
+
+def wall_stamp():
+    # allowlisted via `<relpath>::wall_stamp` in the test's config
+    return time.time()

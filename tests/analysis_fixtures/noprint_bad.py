@@ -1,0 +1,7 @@
+"""Seeded no-print violations."""
+x = 1
+print("leaked")  # BAD
+
+
+def f():
+    print(x)  # BAD

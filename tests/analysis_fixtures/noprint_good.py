@@ -1,0 +1,4 @@
+"""print() in strings/comments only — nothing to flag."""
+PROBE = "import jax; print(jax.devices())"
+# print(commented out)
+doc = """print(in a docstring)"""

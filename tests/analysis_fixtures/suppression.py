@@ -1,0 +1,5 @@
+"""Suppression-syntax fixture: one suppressed, one live violation."""
+print("tolerated")  # lint: disable=no-print
+print("caught")
+x = 1
+print("multi")  # lint: disable=no-print, monotonic-time

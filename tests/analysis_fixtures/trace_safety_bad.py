@@ -1,0 +1,44 @@
+"""Seeded trace-safety violations: every flavor the pass must catch.
+Never imported — parsed as source by tests/test_analysis_passes.py."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from functools import partial
+
+
+@jax.jit
+def branch_on_traced(x, y):
+    if x > 0:  # BAD: Python branch on traced value
+        return y
+    return -y
+
+
+@partial(jax.jit, static_argnums=(1,))
+def while_on_traced(x, n):
+    total = x * 2
+    while total < 100:  # BAD: Python while on traced-derived value
+        total = total + x
+    return total
+
+
+@jax.jit
+def coerce_traced(x):
+    flag = bool(x)  # BAD: bool() coercion
+    scale = float(x)  # BAD: float() coercion
+    return x * scale + jnp.asarray(flag)
+
+
+@jax.jit
+def item_and_numpy(x):
+    pivot = x.item()  # BAD: .item() host sync
+    return np.maximum(x, pivot)  # BAD: host numpy on traced arg
+
+
+def shard_body(x):
+    if x.sum() > 0:  # BAD: traced via shard_map below
+        return x
+    return -x
+
+
+sharded = jax.shard_map(shard_body, mesh=None, in_specs=None, out_specs=None)
+compiled = jax.jit(sharded)

@@ -1,0 +1,32 @@
+"""Trace-safe idioms the pass must NOT flag."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def good_kernel(x, y):
+    # lax/jnp control flow on traced values is the correct idiom
+    flag = x > 0
+    out = jnp.where(flag, y, -y)
+    # host numpy on NON-traced (closure/static) values is fine
+    table = np.arange(8)
+    return out + jnp.asarray(table)
+
+
+def host_helper(batch):
+    # not traced at all: Python branching on plain values is fine
+    if len(batch) > 4:
+        return batch[:4]
+    return batch
+
+
+def factory(width):
+    @jax.jit
+    def inner(x):
+        # branch on the STATIC closure value, not the traced arg
+        if width > 128:
+            return x * 2.0
+        return x
+
+    return inner

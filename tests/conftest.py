@@ -23,6 +23,20 @@ if len(jax.devices()) < 8:  # honor a pre-set device-count flag if present
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+# Lock-order race detector (testing/lockwatch): wraps threading.Lock/RLock
+# allocations made from package code and records per-thread acquisition
+# order; pytest_sessionfinish fails the run on order cycles (potential
+# deadlocks). Armed BEFORE any lock-owning package module imports so even
+# module-level locks (chaos registry, obs singletons) are tracked; the
+# import below only pulls api/kube.objects/utils.resources, none of which
+# allocate locks. KARPENTER_LOCKWATCH=0 opts out (e.g. when profiling
+# lock-sensitive timings).
+from karpenter_core_tpu.testing import lockwatch  # noqa: E402
+
+LOCKWATCH_ARMED = lockwatch.arm(
+    os.environ.get("KARPENTER_LOCKWATCH", ""), default_on=True
+)
+
 # the production persistent XLA compile cache (utils/compilecache — the
 # operator/service/bench all enable it at boot): test files construct fresh
 # solver instances whose in-process executable caches can't share, so
@@ -40,3 +54,15 @@ def pytest_configure(config):
         "slow: long-running schedule-based chaos cases (tier-1 runs -m 'not slow'; "
         "`make chaos` includes them)",
     )
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Fail the suite when the lock-order graph picked up an acquisition
+    cycle anywhere in the run — a potential deadlock is a test failure even
+    if no test happened to interleave into it this time."""
+    if not LOCKWATCH_ARMED:
+        return
+    cycles = lockwatch.GLOBAL.cycles()
+    if cycles:
+        sys.stderr.write("\n" + lockwatch.GLOBAL.report() + "\n")
+        session.exitstatus = 1

@@ -1,0 +1,127 @@
+"""Framework-level tests: driver CLI, baseline mechanics, rule filtering."""
+import os
+import subprocess
+import sys
+
+from karpenter_core_tpu.analysis import all_passes, default_config
+from karpenter_core_tpu.analysis.core import (
+    collect_sources,
+    load_baseline,
+    load_tree,
+    parse_suppressions,
+    run_passes,
+)
+from karpenter_core_tpu.analysis.noprint import NoPrintPass
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LINT = os.path.join(REPO_ROOT, "hack", "lint.py")
+
+
+def run_lint(*args, cwd=REPO_ROOT):
+    return subprocess.run(
+        [sys.executable, LINT, *args], capture_output=True, text=True, cwd=cwd
+    )
+
+
+def test_registry_covers_the_documented_rule_set():
+    rules = {r for p in all_passes() for r in p.rules}
+    assert rules == {
+        "trace-safety", "layering", "import-cycle", "env-flags",
+        "monotonic-time", "bare-except", "thread-discipline", "guarded-by",
+        "no-print",
+    }
+
+
+def test_driver_exits_zero_and_reports_rules():
+    proc = run_lint()
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 violation(s)" in proc.stdout
+
+
+def test_driver_list_rules():
+    proc = run_lint("--list-rules")
+    assert proc.returncode == 0
+    for rule in ("trace-safety", "guarded-by", "no-print", "import-cycle"):
+        assert rule in proc.stdout
+
+
+def test_driver_single_rule_filter():
+    proc = run_lint("--rule", "no-print")
+    assert proc.returncode == 0
+    assert "rules: no-print" in proc.stdout
+
+
+def test_driver_rejects_unknown_rule():
+    proc = run_lint("--rule", "does-not-exist")
+    assert proc.returncode == 2
+    assert "unknown rule" in proc.stderr
+
+
+def test_driver_rejects_rule_filter_with_update_baseline(tmp_path):
+    """A filtered baseline update would silently drop every other rule's
+    debt entries — refused as a usage error."""
+    proc = run_lint(
+        "--rule", "no-print", "--update-baseline",
+        "--baseline", str(tmp_path / "bl.txt"),
+    )
+    assert proc.returncode == 2
+    assert "full run" in proc.stderr
+
+
+def test_driver_catches_seeded_violation(tmp_path):
+    """End-to-end: a violation written into a scratch copy of the package
+    tree is reported with path:line:rule and a nonzero exit."""
+    pkg = tmp_path / "karpenter_core_tpu" / "solver"
+    pkg.mkdir(parents=True)
+    (tmp_path / "karpenter_core_tpu" / "__init__.py").write_text("")
+    (pkg / "__init__.py").write_text("")
+    (pkg / "oops.py").write_text('print("leak")\n')
+    config = default_config(str(tmp_path))
+    files = collect_sources(str(tmp_path), "karpenter_core_tpu")
+    result = run_passes(files, config)
+    leaks = [v for v in result.violations if v.rule == "no-print"]
+    assert len(leaks) == 1
+    assert leaks[0].relpath == "karpenter_core_tpu/solver/oops.py"
+    assert leaks[0].line == 1
+
+
+def test_baseline_subtracts_known_debt(tmp_path):
+    src = tmp_path / "debt.py"
+    src.write_text("x = 1\nprint(x)\n")
+    sf = load_tree(str(src), "debt.py")
+    config = default_config(str(tmp_path))
+    clean = run_passes([sf], config, passes=[NoPrintPass()])
+    assert [v.key() for v in clean.violations] == ["debt.py:2:no-print"]
+    baselined = run_passes(
+        [sf], config, passes=[NoPrintPass()], baseline={"debt.py:2:no-print"}
+    )
+    assert baselined.violations == []
+    assert [v.key() for v in baselined.baselined] == ["debt.py:2:no-print"]
+
+
+def test_load_baseline_ignores_comments_and_blanks(tmp_path):
+    bl = tmp_path / "baseline.txt"
+    bl.write_text("# header\n\na.py:1:no-print\n")
+    assert load_baseline(str(bl)) == {"a.py:1:no-print"}
+    assert load_baseline(str(tmp_path / "missing.txt")) == set()
+
+
+def test_update_baseline_roundtrip(tmp_path):
+    """--update-baseline writes current violations; a subsequent run with
+    that baseline is clean. Exercised against the real (clean) repo, so the
+    updated file contains only the header."""
+    bl = tmp_path / "bl.txt"
+    proc = run_lint("--update-baseline", "--baseline", str(bl))
+    assert proc.returncode == 0
+    entries = load_baseline(str(bl))
+    assert entries == set()  # repo is clean: baseline stays empty
+
+
+def test_suppression_parser_spellings():
+    text = (
+        "a = 1  # lint: disable=no-print\n"
+        "b = 2  #lint: disable=guarded-by, trace-safety\n"
+        "c = 3  # unrelated comment\n"
+    )
+    sup = parse_suppressions(text)
+    assert sup == {1: {"no-print"}, 2: {"guarded-by", "trace-safety"}}

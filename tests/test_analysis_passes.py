@@ -1,0 +1,212 @@
+"""Per-pass fixture tests: each rule catches its seeded bad snippet and
+stays quiet on the good twin (tests/analysis_fixtures/)."""
+import os
+
+import pytest
+
+from karpenter_core_tpu.analysis import AnalysisConfig
+from karpenter_core_tpu.analysis.concurrency import ConcurrencyPass
+from karpenter_core_tpu.analysis.core import collect_sources, load_tree, run_passes
+from karpenter_core_tpu.analysis.envdiscipline import EnvDisciplinePass
+from karpenter_core_tpu.analysis.layering import LayeringPass
+from karpenter_core_tpu.analysis.montime import MonotonicTimePass
+from karpenter_core_tpu.analysis.noprint import NoPrintPass
+from karpenter_core_tpu.analysis.trace_safety import TraceSafetyPass
+
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "analysis_fixtures")
+
+
+def fixture_config(**overrides):
+    defaults = dict(repo_root=FIXTURES, package_name="layerpkg")
+    defaults.update(overrides)
+    return AnalysisConfig(**defaults)
+
+
+def load_fixture(name):
+    return load_tree(os.path.join(FIXTURES, name), name)
+
+
+def run_one(pass_, name, **config_overrides):
+    sf = load_fixture(name)
+    return pass_.run([sf], fixture_config(**config_overrides)), sf
+
+
+# -- trace safety ---------------------------------------------------------
+
+
+def test_trace_safety_catches_all_seeded_flavors():
+    violations, _ = run_one(TraceSafetyPass(), "trace_safety_bad.py")
+    messages = [v.message for v in violations]
+    assert len(violations) == 7, messages
+    assert sum("`if` on traced" in m for m in messages) == 2  # decorator + shard_map
+    assert sum("`while` on traced" in m for m in messages) == 1
+    assert sum("`bool()` coerces" in m for m in messages) == 1
+    assert sum("`float()` coerces" in m for m in messages) == 1
+    assert sum("`.item()`" in m for m in messages) == 1
+    assert sum("host-side `np." in m for m in messages) == 1
+    assert all(v.rule == "trace-safety" for v in violations)
+
+
+def test_trace_safety_quiet_on_good_idioms():
+    violations, _ = run_one(TraceSafetyPass(), "trace_safety_good.py")
+    assert violations == []
+
+
+# -- layering -------------------------------------------------------------
+
+
+LAYER_DAG = {
+    "solver": frozenset(),
+    "controllers": frozenset({"solver"}),
+    "cyc": frozenset(),
+}
+
+
+def layering_result():
+    files = collect_sources(FIXTURES, "layerpkg")
+    config = fixture_config(layering=dict(LAYER_DAG))
+    return LayeringPass().run(files, config)
+
+
+def test_layering_flags_solver_to_controllers_module_scope():
+    violations = [v for v in layering_result() if v.rule == "layering"]
+    assert {v.relpath for v in violations} == {
+        "layerpkg/solver/bad_import.py",  # absolute import
+        "layerpkg/solver/bad_relative.py",  # explicit relative import
+    }
+    assert all(
+        "'solver' may not depend on 'controllers'" in v.message
+        for v in violations
+    )
+
+
+def test_layering_intra_subpackage_relative_import_is_fine():
+    violations = layering_result()
+    assert not any(v.relpath == "layerpkg/solver/__init__.py" for v in violations)
+
+
+def test_layering_exempts_function_scope_and_type_checking():
+    violations = layering_result()
+    assert not any(v.relpath.endswith("good_import.py") for v in violations)
+
+
+def test_layering_detects_module_cycle():
+    cycles = [v for v in layering_result() if v.rule == "import-cycle"]
+    assert {v.relpath for v in cycles} == {
+        "layerpkg/cyc/alpha.py",
+        "layerpkg/cyc/beta.py",
+    }
+    assert all("layerpkg.cyc.alpha <-> layerpkg.cyc.beta" in v.message for v in cycles)
+
+
+def test_layering_strict_flags_undeclared_subpackage():
+    files = collect_sources(FIXTURES, "layerpkg")
+    config = fixture_config(layering={"cyc": frozenset()})
+    violations = LayeringPass().run(files, config)
+    assert any("no declared layer" in v.message for v in violations)
+
+
+# -- env discipline -------------------------------------------------------
+
+
+def test_envdiscipline_catches_every_spelling():
+    violations, _ = run_one(EnvDisciplinePass(), "envflags_bad.py")
+    assert len(violations) == 5
+    assert {v.line for v in violations} == {6, 7, 8, 9, 10}
+    assert all(v.rule == "env-flags" for v in violations)
+
+
+def test_envdiscipline_quiet_on_funnel_use():
+    violations, _ = run_one(EnvDisciplinePass(), "envflags_good.py")
+    assert violations == []
+
+
+def test_envdiscipline_exempts_the_funnel_module():
+    sf = load_tree(
+        os.path.join(FIXTURES, "envflags_bad.py"), "layerpkg/obs/envflags.py"
+    )
+    config = fixture_config(env_funnel="layerpkg/obs/envflags.py")
+    assert EnvDisciplinePass().run([sf], config) == []
+
+
+# -- monotonic time -------------------------------------------------------
+
+
+def test_montime_catches_wall_clock_durations():
+    violations, _ = run_one(MonotonicTimePass(), "montime_bad.py")
+    assert len(violations) == 3
+    assert {v.line for v in violations} == {8, 12, 16}
+    assert all(v.rule == "monotonic-time" for v in violations)
+
+
+def test_montime_allowlists_audited_wall_clock_site():
+    violations, _ = run_one(
+        MonotonicTimePass(),
+        "montime_good.py",
+        wallclock_allowlist=frozenset({"montime_good.py::wall_stamp"}),
+    )
+    assert violations == []
+
+
+def test_montime_flags_unallowlisted_site_in_good_file():
+    violations, _ = run_one(MonotonicTimePass(), "montime_good.py")
+    assert [v.line for v in violations] == [16]
+
+
+# -- concurrency ----------------------------------------------------------
+
+
+def test_concurrency_catches_seeded_violations():
+    violations, _ = run_one(ConcurrencyPass(), "concurrency_bad.py")
+    by_rule = {}
+    for v in violations:
+        by_rule.setdefault(v.rule, []).append(v)
+    assert len(by_rule.get("bare-except", [])) == 1
+    # two plain + two via `import threading as th` / `from threading import
+    # Thread as SpawnThread` aliases
+    assert len(by_rule.get("thread-discipline", [])) == 4
+    guarded = by_rule.get("guarded-by", [])
+    assert len(guarded) == 1
+    assert "Counter.value" in guarded[0].message
+    assert "reset()" in guarded[0].message
+
+
+def test_concurrency_quiet_on_disciplined_code():
+    violations, _ = run_one(ConcurrencyPass(), "concurrency_good.py")
+    assert violations == []
+
+
+# -- no-print -------------------------------------------------------------
+
+
+def test_noprint_catches_calls_not_strings():
+    bad, _ = run_one(NoPrintPass(), "noprint_bad.py")
+    assert [v.line for v in bad] == [3, 7]
+    good, _ = run_one(NoPrintPass(), "noprint_good.py")
+    assert good == []
+
+
+def test_noprint_flags_unparseable_files(tmp_path):
+    broken = tmp_path / "broken.py"
+    broken.write_text("def f(:\n")
+    sf = load_tree(str(broken), "broken.py")
+    violations = NoPrintPass().run([sf], fixture_config())
+    assert violations and violations[0].rule == "no-print"
+    assert "does not parse" in violations[0].message
+
+
+# -- suppression syntax (framework-level, via run_passes) -----------------
+
+
+def test_suppression_comment_silences_only_its_line_and_rule():
+    sf = load_fixture("suppression.py")
+    result = run_passes([sf], fixture_config(), passes=[NoPrintPass()])
+    assert [v.line for v in result.violations] == [3]
+    assert {v.line for v in result.suppressed} == {2, 5}
+
+
+def test_suppression_does_not_apply_to_other_rules():
+    sf = load_fixture("suppression.py")
+    assert sf.suppressed(2, "no-print")
+    assert not sf.suppressed(2, "monotonic-time")
+    assert sf.suppressed(5, "monotonic-time")

@@ -1,0 +1,176 @@
+"""Lock-order race detector tests: the seeded inversion is flagged, benign
+patterns are not, and the proxy honors the full lock protocol."""
+import threading
+
+from karpenter_core_tpu.testing import lockwatch
+
+
+def make_pair(watch):
+    return watch.make_lock("site-A"), watch.make_lock("site-B")
+
+
+def run_thread(fn, name):
+    t = threading.Thread(target=fn, name=name, daemon=True)
+    t.start()
+    t.join(timeout=10)
+    assert not t.is_alive()
+
+
+def test_seeded_lock_inversion_is_detected():
+    """A->B in one thread, B->A in another: the classic deadlock seed. The
+    threads run sequentially so nothing actually deadlocks — the GRAPH
+    still proves the inversion."""
+    watch = lockwatch.LockWatch()
+    a, b = make_pair(watch)
+
+    def forward():
+        with a:
+            with b:
+                pass
+
+    def backward():
+        with b:
+            with a:
+                pass
+
+    run_thread(forward, "forward")
+    run_thread(backward, "backward")
+    cycles = watch.cycles()
+    assert cycles == [["site-A", "site-B"]]
+    report = watch.report()
+    assert "potential deadlock" in report
+    assert "acquired site-B while holding site-A" in report
+    assert "acquired site-A while holding site-B" in report
+
+
+def test_consistent_order_is_clean():
+    watch = lockwatch.LockWatch()
+    a, b = make_pair(watch)
+
+    def forward():
+        with a:
+            with b:
+                pass
+
+    run_thread(forward, "f1")
+    run_thread(forward, "f2")
+    assert watch.cycles() == []
+    assert "no acquisition-order cycles" in watch.report()
+
+
+def test_three_lock_cycle():
+    watch = lockwatch.LockWatch()
+    a = watch.make_lock("L1")
+    b = watch.make_lock("L2")
+    c = watch.make_lock("L3")
+    for first, second in ((a, b), (b, c), (c, a)):
+        with first:
+            with second:
+                pass
+    assert watch.cycles() == [["L1", "L2", "L3"]]
+
+
+def test_reentrant_rlock_never_edges():
+    watch = lockwatch.LockWatch()
+    r = watch.make_lock("R", rlock=True)
+    other = watch.make_lock("O")
+    with r:
+        with other:
+            with r:  # reacquire while holding `other`: no O->R edge
+                pass
+    assert watch.edges().get("O", {}) == {}
+    assert watch.cycles() == []
+
+
+def test_same_site_siblings_do_not_self_edge():
+    """Per-instance locks allocated at one site and held pairwise (either
+    order) must not report a self-cycle."""
+    watch = lockwatch.LockWatch()
+    l1 = watch.make_lock("shared-site")
+    l2 = watch.make_lock("shared-site")
+    with l1:
+        with l2:
+            pass
+    with l2:
+        with l1:
+            pass
+    assert watch.cycles() == []
+
+
+def test_lock_protocol_passthrough():
+    watch = lockwatch.LockWatch()
+    lk = watch.make_lock("P")
+    assert lk.acquire() is True
+    assert lk.locked()
+    lk.release()
+    assert not lk.locked()
+    assert lk.acquire(False) is True
+    lk.release()
+    # release bookkeeping survives unbalanced threads
+    watch.reset()
+    assert watch.edges() == {}
+
+
+def test_install_wraps_package_allocations_only():
+    watch = lockwatch.LockWatch()
+    watch.install()
+    try:
+        # this test file is NOT package code: plain allocation stays native
+        native = threading.Lock()
+        assert not isinstance(native, lockwatch.TrackedLock)
+        # a package module allocating a lock gets the proxy
+        from karpenter_core_tpu.solver.encode import EncodeReuse
+
+        reuse = EncodeReuse()
+        assert isinstance(reuse._lock, lockwatch.TrackedLock)
+        reuse.get("miss-key")  # exercises acquire/release through the proxy
+    finally:
+        watch.uninstall()
+    assert threading.Lock is watch._orig_lock
+
+
+def test_install_is_idempotent_and_uninstall_restores():
+    watch = lockwatch.LockWatch()
+    orig = threading.Lock
+    watch.install()
+    watch.install()
+    watch.uninstall()
+    watch.uninstall()
+    assert threading.Lock is orig
+
+
+def test_arm_spellings():
+    watch_installed = lockwatch.GLOBAL._installed
+    try:
+        assert lockwatch.arm("0") is False
+        assert lockwatch.arm("off", default_on=True) is False
+        assert lockwatch.arm("", default_on=False) is False
+        assert lockwatch.arm("1", default_on=False) is True
+    finally:
+        if not watch_installed:
+            lockwatch.GLOBAL.uninstall()
+
+
+def test_condition_support_on_tracked_rlock():
+    """threading.Condition over a tracked RLock uses the _release_save /
+    _acquire_restore protocol — the proxy must forward it."""
+    watch = lockwatch.LockWatch()
+    r = watch.make_lock("CV", rlock=True)
+    cond = threading.Condition(r)
+    hits = []
+
+    def waiter():
+        with cond:
+            hits.append("waiting")
+            cond.wait(timeout=5)
+            hits.append("woken")
+
+    t = threading.Thread(target=waiter, name="cv-waiter", daemon=True)
+    t.start()
+    for _ in range(500):
+        with cond:
+            if hits:
+                cond.notify_all()
+                break
+    t.join(timeout=10)
+    assert hits == ["waiting", "woken"]

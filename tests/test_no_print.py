@@ -1,22 +1,40 @@
-"""No-print guard (ISSUE 3 satellite): the package must log through
-obs/log, never bare print(). AST-based so string literals containing
-"print(" (the subprocess probe source in solver/fallback.py) don't
-false-positive. The same scanner runs in `make verify`
-(hack/check_no_print.sh)."""
+"""No-print guard: the package must log through obs/log, never bare
+print(). AST-based so string literals containing "print(" (the subprocess
+probe source in solver/fallback.py) don't false-positive. Originally a
+standalone hack/check_no_print.py scanner (ISSUE 3); now the `no-print`
+pass of the static-analysis framework — these tests pin the behavior the
+old scanner guaranteed against the new driver."""
 import os
-import sys
+
+from karpenter_core_tpu.analysis import default_config
+from karpenter_core_tpu.analysis.core import collect_sources, load_tree
+from karpenter_core_tpu.analysis.noprint import NoPrintPass
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-sys.path.insert(0, os.path.join(REPO_ROOT, "hack"))
+PACKAGE = "karpenter_core_tpu"
 
-from check_no_print import PACKAGE, find_print_calls  # noqa: E402
+
+def scan_tree(root):
+    """(relpath, line) of every no-print violation under `root`/PACKAGE or
+    a bare directory of .py files."""
+    config = default_config(str(root))
+    if os.path.isdir(os.path.join(str(root), PACKAGE)):
+        files = collect_sources(str(root), PACKAGE)
+    else:
+        files = [
+            load_tree(os.path.join(str(root), name), name)
+            for name in sorted(os.listdir(str(root)))
+            if name.endswith(".py")
+        ]
+    violations = NoPrintPass().run(files, config)
+    return [(v.relpath, v.line) for v in violations]
 
 
 def test_package_is_print_free():
-    violations = find_print_calls(os.path.join(REPO_ROOT, PACKAGE))
+    violations = scan_tree(REPO_ROOT)
     assert not violations, (
         "bare print() in production code — use karpenter_core_tpu.obs.log: "
-        + ", ".join(f"{os.path.relpath(p, REPO_ROOT)}:{ln}" for p, ln in violations)
+        + ", ".join(f"{p}:{ln}" for p, ln in violations)
     )
 
 
@@ -24,7 +42,7 @@ def test_scanner_catches_real_prints(tmp_path):
     (tmp_path / "bad.py").write_text(
         'x = 1\nprint("leaked")\n\ndef f():\n    print(x)\n'
     )
-    found = find_print_calls(str(tmp_path))
+    found = scan_tree(tmp_path)
     assert [ln for _p, ln in found] == [2, 5]
 
 
@@ -34,9 +52,9 @@ def test_scanner_ignores_prints_in_strings(tmp_path):
         "# print(commented out)\n"
         'doc = """print(in a docstring)"""\n'
     )
-    assert find_print_calls(str(tmp_path)) == []
+    assert scan_tree(tmp_path) == []
 
 
 def test_scanner_flags_unparseable_files(tmp_path):
     (tmp_path / "broken.py").write_text("def f(:\n")
-    assert find_print_calls(str(tmp_path))
+    assert scan_tree(tmp_path)
