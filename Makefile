@@ -47,6 +47,10 @@ verify:  ## driver hooks: single-chip compile check + 8-way mesh dryrun
 	$(MAKE) lint
 	# metrics-scraper suite: the scrape-race/startup-guard regressions
 	python -m pytest tests/test_metrics_controllers.py -q
+	# pack-kernel structural tripwires (fatal): the prescreen scan body
+	# must not re-grow the full-width slot-screen contraction, and the
+	# precompute must stay inside the 2-programs-per-geometry cache budget
+	python -m pytest tests/test_perf_floor.py tests/test_screen_parity.py -q
 	# non-fatal smoke: a traced solve must export valid Perfetto JSON
 	-$(MAKE) trace-demo
 	# non-fatal smoke: a flight-recorded solve must replay byte-identically

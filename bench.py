@@ -708,10 +708,12 @@ def main():
         tr_phases = TRACER.phase_ms_since(seq, last_only=True)
         phases = {
             k: tr_phases.get(k, 0.0)
-            for k in ("args", "pack", "upload", "device", "fetch")
+            for k in ("args", "pack", "upload", "prescreen", "device",
+                      "fetch", "encode", "bind")
         }
-        # everything solve() spent outside the instrumented kernel phases:
-        # encode + decode + relaxation bookkeeping (host python/numpy)
+        # everything solve() spent outside the instrumented phases —
+        # relaxation bookkeeping and result accounting only, now that
+        # encode/bind (and the prescreen dispatch) carry their own columns
         phases["other_host"] = round(dt * 1e3 - sum(phases.values()), 1)
         run_phases.append(phases)
         sched_counts.append(res.pod_count_new() + res.pod_count_existing())
@@ -958,6 +960,23 @@ def main():
             # must not inherit the shrink the parent's own fallback applied
             env.pop("BENCH_SKIP_PROBE", None)
             env.pop("BENCH_CPU_SHRINK", None)
+            # pin the child to the parent's RESOLVED workload and platform:
+            # the r05 failure mode was a shrunk CPU-fallback parent (5k
+            # pods) spawning a full-config child (BENCH_CPU=1 alone means
+            # "deliberate full run, no shrink"), which cold-compiled a 50k
+            # geometry the parent never populated the disk cache with and
+            # then tripped the pods-mismatch check — the restart claim
+            # needs the SAME geometry against the SAME cache
+            for var, val in (
+                ("BENCH_PODS", N_PODS), ("BENCH_TYPES", N_TYPES),
+                ("BENCH_DISTINCT", N_DISTINCT),
+                ("BENCH_EXISTING", N_EXISTING), ("BENCH_NODES", MAX_NODES),
+            ):
+                env[var] = str(val)
+            if jax.devices()[0].platform == "cpu":
+                env["BENCH_CPU"] = "1"  # deliberate: sizes pinned above
+            else:
+                env.pop("BENCH_CPU", None)
             rc, out, _, timed_out = _run_subprocess(
                 [sys.executable, os.path.abspath(__file__)], env,
                 int(min(_worker_time_left() - 60, 900)),
@@ -1264,11 +1283,22 @@ def orchestrate():
         return
 
     tpu_ok = False
+    probe_dead = False
     for i, t in enumerate(PROBE_SCHEDULE):
         ok, note = _probe_once(_budget(t))
         _log(f"probe {i + 1} ({t}s): {'ok ' if ok else 'FAILED '}({note})")
         if ok:
             tpu_ok = True
+            break
+        if note.startswith("probe timeout"):
+            # a HUNG backend init doesn't heal with a longer timeout — the
+            # r05 run burned 60+240+600+300s of probes on one wedged
+            # tunnel. Record the timeout and go straight to the CPU
+            # fallback; a fast *error* (rc!=0) still gets the escalating
+            # retries, since transient init races do recover.
+            probe_dead = True
+            _log("probe hang: short-circuiting remaining probes to the "
+                 "cpu fallback")
             break
         if i < len(PROBE_SCHEDULE) - 1 and _left() > 60:
             time.sleep(min(30, 5 * (i + 1)))
@@ -1302,10 +1332,14 @@ def orchestrate():
             {"BENCH_CPU": "1", "BENCH_CPU_SHRINK": "1"},
             _budget(CPU_WORKER_TIMEOUT),
         )
-    if not got_tpu and result is not None and _left() > FINAL_PROBE_TIMEOUT + 120:
+    if (not got_tpu and not probe_dead and result is not None
+            and _left() > FINAL_PROBE_TIMEOUT + 120):
         # last chance before settling for the CPU number: the wedge may have
-        # been transient (applies whether the probes failed up front or the
-        # worker wedged mid-run)
+        # been transient (applies whether the probes FAILED fast up front or
+        # the worker wedged mid-run — but not when a probe HUNG: a wedged
+        # tunnel doesn't heal within one run, and r05 burned ~20 min of
+        # probe budget proving it four times; probe_dead caps the whole
+        # orchestration at one probe timeout)
         ok, pnote = _probe_once(FINAL_PROBE_TIMEOUT)
         _log(f"final probe ({FINAL_PROBE_TIMEOUT}s): "
              f"{'ok ' if ok else 'FAILED '}({pnote})")

@@ -43,6 +43,24 @@ def resolve_backend(device=None) -> str:
     return "mxu"
 
 
+def resolve_screen_mode() -> str:
+    """Pick the pack kernel's slot-screen strategy.
+
+    'prescreen' (default): the per-(item-class, slot) requirement screen is
+    hoisted out of the scan — one batched [I, N] verdict tensor computed
+    before the scan, refreshed incrementally for only the slot rows a
+    commit writes (ops/pack.py). 'tiered': the original per-step full
+    screen, kept as the fallback path. KCT_PACK_SCREEN ∈ {auto, prescreen,
+    tiered}; selection happens at trace time, so flipping the flag mints a
+    new compiled program (solver caches key on the resolved mode)."""
+    from karpenter_core_tpu.obs import envflags
+
+    mode = envflags.raw("KCT_PACK_SCREEN", "auto").strip().lower()
+    if mode in ("tiered", "prescreen"):
+        return mode
+    return "prescreen"
+
+
 def seg_matrix(segments: Segments, V: int):
     """Static [V, K] one-hot membership matrix: column k marks the values of
     key k. Turns every per-key any-reduction into ONE bf16 matmul on the MXU

@@ -69,6 +69,166 @@ def _segment_max_alloc(tmask: jnp.ndarray, type_alloc: jnp.ndarray) -> jnp.ndarr
     return masked.max(axis=-2)
 
 
+def make_screen_ops(segments, backend, screen_v):
+    """Lowerings for the batched class×slot requirement screen — the
+    prescreen path's analog of slot_compat_screen, with the item axis as
+    the batch instead of the slot axis.
+
+    Semantics are Requirements.Compatible(slot row = node side, item row =
+    pod side), bit-identical to the per-step screen for the same backend.
+    ALL backends slice the hostname tail off at screen_v here — exact to
+    skip, because when elision engages no item defines (or custom-denies)
+    the elided keys, so every such key term resolves through ~shared
+    regardless of the slot planes; the tiered sliced screen runs full
+    width but computes the same verdicts. All forms evaluate through bf16
+    matmuls with f32 accumulation — exact for 0/1 indicator masks."""
+    seg_mats = {}
+
+    def _w(V):
+        if screen_v is None:
+            return V
+        return min(screen_v, V)
+
+    def _sm(V):
+        w = _w(V)
+        if (V, w) not in seg_mats:
+            seg_mats[(V, w)] = jnp.asarray(compat.seg_matrix(segments, V)[:w])
+        return seg_mats[(V, w)]
+
+    def items_vs_row(items, s_allow, s_out, s_defined):
+        """[I] verdict of every item against ONE slot row — the refresh
+        unit for a candidate commit (one narrowed slot) and for the shared
+        merged row a bulk open writes across its fresh slots."""
+        V = s_allow.shape[0]
+        w = _w(V)
+        sm = _sm(V)
+        s_esc = compat.escape_flags_m(
+            s_allow[None, :w], s_out[None], s_defined[None], sm
+        )[0]
+        inter = compat.segment_any_m(
+            items["allow"][:, :w] & s_allow[None, :w], sm
+        )
+        shared = items["defined"] & s_defined[None, :]
+        both_out = items["out"] & s_out[None, :]
+        escapes = items["escape"] & s_esc[None, :]
+        ok = ((~shared) | both_out | inter | escapes).all(axis=-1)
+        return ok & ~jnp.any(
+            items["custom_deny"] & ~s_defined[None, :], axis=-1
+        )
+
+    def rows_vs_items(items, s_allow, s_out, s_defined):
+        """[B, I] pairwise verdict block: a batch of slot rows against
+        every item — the bulk-region refresh, the pending drain, and the
+        (matmul) precompute. One MXU contraction per key over the
+        dictionary planes, with the slot rows as the LEFT operand so the
+        result is produced NATIVELY in the verdict tensor's slot-major
+        layout (a .T on an item-major form made XLA thread two layouts
+        through the scan and insert a physical transpose copy of the whole
+        tensor per step)."""
+        V = s_allow.shape[1]
+        w = _w(V)
+        sm = _sm(V)
+        s_esc = compat.escape_flags_m(s_allow[:, :w], s_out, s_defined, sm)
+        B = s_allow.shape[0]
+        I = items["allow"].shape[0]
+        ok = jnp.ones((B, I), dtype=bool)
+        for k, (lo, hi) in enumerate(segments):
+            if lo >= w:
+                continue  # elided hostname tail: resolves through ~shared
+            hi_w = min(hi, w)
+            shared = s_defined[:, k : k + 1] & items["defined"][None, :, k]
+            both_out = s_out[:, k : k + 1] & items["out"][None, :, k]
+            if hi_w > lo:
+                inter = (
+                    jnp.matmul(
+                        s_allow[:, lo:hi_w].astype(jnp.bfloat16),
+                        items["allow"][:, lo:hi_w].astype(jnp.bfloat16).T,
+                        preferred_element_type=jnp.float32,
+                    )
+                    > 0.5
+                )
+                nonempty = both_out | inter
+            else:
+                nonempty = both_out
+            escapes = s_esc[:, k : k + 1] & items["escape"][None, :, k]
+            ok &= (~shared) | nonempty | escapes
+        denied = (
+            jnp.matmul(
+                (~s_defined).astype(jnp.bfloat16),
+                items["custom_deny"].astype(jnp.bfloat16).T,
+                preferred_element_type=jnp.float32,
+            )
+            > 0.5
+        )
+        return ok & ~denied
+
+    def initial_screen(items, e_allow, e_out, e_defined, n_slots):
+        """[N, I] slot-major verdict tensor for the scan-entry slot state:
+        the exact pairwise block over the existing prefix plus the virgin
+        verdict row broadcast over the (still closed, hence
+        unread-until-opened) machine region. On the Pallas backend the
+        block reuses the fused screen kernel in its batched (item-major)
+        form, transposed once."""
+        I = items["allow"].shape[0]
+        V = e_allow.shape[1]
+        K = e_out.shape[1]
+        E = e_allow.shape[0]
+        if E and backend == "pallas":
+            from karpenter_core_tpu.ops import pallas_kernels
+
+            w = _w(V)
+            block = pallas_kernels.batched_slot_screen_pallas(
+                e_allow[:, :w], e_out, e_defined,
+                dict(items, allow=items["allow"][:, :w]),
+                _sm(V),
+            ).T
+        elif E:
+            block = rows_vs_items(items, e_allow, e_out, e_defined)
+        else:
+            block = jnp.zeros((0, I), dtype=bool)
+        virgin = items_vs_row(
+            items,
+            jnp.ones(V, dtype=bool),
+            jnp.ones(K, dtype=bool),
+            jnp.zeros(K, dtype=bool),
+        )
+        tail = jnp.broadcast_to(virgin[None, :], (n_slots - E, I))
+        return jnp.concatenate([block, tail], axis=0)
+
+    class _Ops:
+        pass
+
+    ops = _Ops()
+    ops.items_vs_row = items_vs_row
+    ops.rows_vs_items = rows_vs_items
+    ops.initial_screen = initial_screen
+    return ops
+
+
+def make_prescreen_kernel(segments, n_slots, backend=None, screen_v=None):
+    """Build the standalone jittable prescreen: (pod item planes, existing
+    planes) -> [N, C] slot-major verdict tensor over the deduped class
+    columns (pod_arrays["scls_first"], identity when absent). TPUSolver
+    dispatches this as its own (geometry-cached) program so the precompute
+    is host-visible as the solver.phase.prescreen span; pack() computes the
+    identical tensor internally when no screen0 is handed in
+    (rung/sharded/service paths)."""
+    backend = backend or compat.resolve_backend()
+    ops = make_screen_ops(list(segments), backend, screen_v)
+
+    def prescreen(pod_arrays, exist):
+        sf = pod_arrays.get("scls_first")
+        items = {
+            k: (pod_arrays[k] if sf is None else pod_arrays[k][sf])
+            for k in ("allow", "out", "defined", "escape", "custom_deny")
+        }
+        return ops.initial_screen(
+            items, exist["allow"], exist["out"], exist["defined"], n_slots
+        )
+
+    return prescreen
+
+
 def make_pack_kernel(
     segments,
     zone_seg,
@@ -76,6 +236,7 @@ def make_pack_kernel(
     topo_meta: Optional[topo.TopoMeta] = None,
     backend: Optional[str] = None,
     screen_v: Optional[int] = None,
+    screen_mode: Optional[str] = None,
 ):
     """Build the jittable packing fn for a fixed label geometry (+ topology
     group structure when the batch has topology constraints).
@@ -90,10 +251,27 @@ def make_pack_kernel(
     real cluster) hostname segment drops out of the screen matmuls — every
     hostname key term resolves through ~shared regardless of content, so
     the sliced screens are exact. None or >= V means full width; the
-    'sliced' CPU lowering always runs full width (same semantics)."""
+    'sliced' CPU lowering always runs full width (same semantics).
+
+    screen_mode ∈ {'tiered', 'prescreen'} (compat.resolve_screen_mode when
+    None). 'prescreen' hoists the per-step requirement screen out of the
+    scan: a [I items × N slots] verdict tensor is computed ONCE before the
+    scan (make_screen_ops.initial_screen — or handed in via pack's screen0
+    argument by a caller that dispatched it as its own program) and each
+    step GATHERS its row; commits refresh only the slot row(s) they wrote
+    — O(1 slot-row) re-screens instead of the O(N×V×K) per-step full
+    screen, gated off entirely for items that cannot change the
+    requirement planes (no defined keys, no topology involvement).
+    'tiered' keeps the original per-step screen as the fallback."""
     backend = backend or compat.resolve_backend()
     assert backend in ("sliced", "mxu", "pallas"), backend
     mxu = backend in ("mxu", "pallas")
+    screen_mode = screen_mode or compat.resolve_screen_mode()
+    assert screen_mode in ("tiered", "prescreen"), screen_mode
+    prescreen = screen_mode == "prescreen"
+    screen_ops = (
+        make_screen_ops(list(segments), backend, screen_v) if prescreen else None
+    )
 
     zlo, zhi = zone_seg
     clo, chi = ct_seg
@@ -427,6 +605,7 @@ def make_pack_kernel(
         vol_limits: jnp.ndarray = None,  # [E_pad, D]
         vol_driver: jnp.ndarray = None,  # [W, D] claim -> driver onehot
         log_commits: bool = True,
+        screen0: jnp.ndarray = None,  # [N, C] precomputed verdict tensor
     ):
         N = state.used.shape[0]
         J = tmpl_daemon.shape[0]
@@ -488,6 +667,202 @@ def make_pack_kernel(
             "bulk_n": jnp.int32(0),
         }
 
+        # prescreen: the class×slot verdict tensor rides the scan carry in
+        # SLOT-MAJOR [N, C] layout — refreshes write whole slot rows, so
+        # row-major contiguity must be on the slot axis (the item-major
+        # form scattered one cache line per item per written slot,
+        # ~8GB of write traffic at the 1000-class bench geometry). The
+        # column axis C is the UNIQUE requirement class among items
+        # (encode's item_scls/scls_items dedup): anti-affinity expansion
+        # blows I up toward the pod count while C stays put, and every
+        # expanded replica gathers its class's shared column. Each step
+        # gathers its column instead of re-running the slot screen, and
+        # commits refresh only the slot row(s) they wrote. The machine
+        # region starts at the virgin-row value — entries there are never
+        # read before an open (the screen ANDs with state.open) and the
+        # open refresh overwrites them. class planes close over the scan as
+        # constants: the refresh re-screens ALL classes against the written
+        # slot row(s).
+        item_arrays = dict(item_arrays)
+        scls_first = item_arrays.pop("scls_first", None)
+        if prescreen:
+            if scls_first is None:  # identity: one column per item
+                scls_first = jnp.arange(I, dtype=jnp.int32)
+            scls_first = jnp.asarray(scls_first)
+            items_pl = {
+                k: jnp.asarray(item_arrays[k])[scls_first]
+                for k in ("allow", "out", "defined", "escape", "custom_deny")
+            }
+            C = items_pl["allow"].shape[0]
+            screen_init = (
+                screen0
+                if screen0 is not None
+                else screen_ops.initial_screen(
+                    items_pl,
+                    state.allow[:n_exist],
+                    state.out[:n_exist],
+                    state.defined[:n_exist],
+                    N,
+                )
+            )  # [N, C], slot-major
+        else:
+            items_pl = None
+            C = 0
+            screen_init = jnp.zeros((0, 0), dtype=bool)  # dead placeholder
+
+        # per-template verdict columns, computed ONCE per solve: a plane-
+        # neutral item (no defined keys, no topology) merges as the identity,
+        # so the row an open writes for it IS the template's planes — the
+        # dominant generic items gather this constant instead of paying an
+        # items_vs_row contraction on every open
+        if prescreen:
+            if "scls" not in item_arrays:  # identity column per item
+                item_arrays["scls"] = jnp.arange(I, dtype=jnp.int32)
+            tmpl_rows = screen_ops.rows_vs_items(
+                items_pl, tmpl_reqs["allow"], tmpl_reqs["out"],
+                tmpl_reqs["defined"],
+            )  # [J, C]
+        else:
+            tmpl_rows = None
+        # refresh DESCRIPTOR. The verdict tensor must never be written
+        # inside ANY lax.cond whose other branch leaves it unchanged — the
+        # branch-buffer unification copies the whole [N, I] tensor per cond
+        # evaluation. That rules out writes in the while-loop branches
+        # (measured 444ms -> 2139ms at the 1000-class bench geometry) AND
+        # anywhere inside the per-item valid/skip cond around _step_body
+        # (~0.7ms/step in copies). So the step body only ACCUMULATES
+        # refresh ops — (base row, run length, one [C] verdict row) per
+        # commit, in iteration order so later writes of the same slot win —
+        # and `step` applies them OUTSIDE the cond through a while loop of
+        # blended dynamic-update-slice windows, the one update pattern XLA
+        # reliably aliases in place. Whatever cannot fit the fixed budgets
+        # (a bulk commit touching > UWB rows, an open wider than UWO, more
+        # than SU ops in one step) lands in the descriptor's PENDING
+        # interval instead, drained after the op replay by a cond-free
+        # chunked re-screen — exact, because re-screening a slot row from
+        # its current planes always yields the true verdict, and the tensor
+        # is only read again at the next item's step entry.
+        SU = 32  # refresh ops per step
+        UWB = min(32, BR) if BR else 1  # bulk-refresh re-screen chunk
+        UWO = min(64, N)  # max open run per op (also the apply window)
+        DW = min(32, N)  # pending-drain chunk rows
+        # screened value width + the keys whose segments fall inside it: a
+        # commit that only narrows ELIDED keys (the encoder-proven hostname
+        # tail, e.g. hostname-spread/anti narrowing) cannot change any
+        # verdict — no item defines those keys — so its refresh is skipped
+        # entirely via plane_mut
+        WSCR = V if screen_v is None else min(screen_v, V)
+        key_scr = jnp.asarray([lo < WSCR for (lo, _hi) in segments])
+
+        def empty_desc():
+            """No refresh ops, empty pending interval."""
+            return (
+                jnp.zeros((SU,), jnp.int32),  # base row per op
+                jnp.zeros((SU,), jnp.int32),  # run length (0 = unused)
+                jnp.zeros((SU, C), dtype=bool),  # verdict row per op
+                jnp.int32(0),  # op cursor
+                jnp.int32(N),  # pending lo
+                jnp.int32(0),  # pending hi
+            )
+
+        def desc_pend(desc, on, lo, hi):
+            """Queue [lo, hi) for the post-replay re-screen drain."""
+            ub, ul, uv, cu, plo, phi = desc
+            return (
+                ub, ul, uv, cu,
+                jnp.where(on, jnp.minimum(plo, lo), plo),
+                jnp.where(on, jnp.maximum(phi, hi), phi),
+            )
+
+        def desc_append_run(desc, on, base, ln, val):
+            """One op: rows [base, base+ln) all take verdict row `val`
+            (ln <= UWO). Falls back to pending when the op budget is
+            full."""
+            ub, ul, uv, cu, plo, phi = desc
+            w = on & (cu < SU)
+            cuc = jnp.minimum(cu, SU - 1)
+            ub = ub.at[cuc].set(jnp.where(w, base, ub[cuc]))
+            ul = ul.at[cuc].set(jnp.where(w, ln, ul[cuc]))
+            uv = uv.at[cuc].set(jnp.where(w, val, uv[cuc]))
+            desc = (ub, ul, uv, cu + jnp.where(w, 1, 0), plo, phi)
+            return desc_pend(desc, on & ~w, base, base + ln)
+
+        def desc_append_rows(desc, on, rows, vals, k, lo, hi):
+            """k single-row ops (rows [UWB], vals [UWB, C]); [lo, hi) is
+            the covering interval used when the op budget overflows."""
+            ub, ul, uv, cu, plo, phi = desc
+            w = on & ((cu + k) <= SU)
+            idx = cu + jnp.arange(UWB)
+            live = (jnp.arange(UWB) < k) & w
+            iw = jnp.where(live, jnp.minimum(idx, SU - 1), SU)  # OOB drops
+            ub = ub.at[iw].set(rows)
+            ul = ul.at[iw].set(jnp.ones(UWB, jnp.int32))
+            uv = uv.at[iw].set(vals)
+            desc = (ub, ul, uv, cu + jnp.where(w, k, 0), plo, phi)
+            return desc_pend(desc, on & ~w, lo, hi)
+
+        def apply_refresh(screen, desc, state):
+            """Replay the step's refresh ops onto the verdict tensor, then
+            drain the pending interval. Runs at step level, OUTSIDE the
+            valid/skip cond; every write is a blended dynamic-update-slice
+            so the scan-carried tensor keeps aliasing in place."""
+            ub, ul, uv, cu, plo, phi = desc
+
+            def a_cond(c):
+                return c[1] < cu
+
+            def a_body(c):
+                scr, e = c
+                base, ln, val = ub[e], ul[e], uv[e]
+                start = jnp.clip(base, 0, N - UWO)
+                idx = start + jnp.arange(UWO)
+                in_rng = (idx >= base) & (idx < base + ln)
+                win = jax.lax.dynamic_slice(
+                    scr, (start, jnp.int32(0)), (UWO, C)
+                )
+                new = jnp.where(in_rng[:, None], val[None, :], win)
+                return (
+                    jax.lax.dynamic_update_slice(
+                        scr, new, (start, jnp.int32(0))
+                    ),
+                    e + 1,
+                )
+
+            screen, _ = jax.lax.while_loop(
+                a_cond, a_body, (screen, jnp.int32(0))
+            )
+
+            def d_cond(c):
+                return c[1] < c[2]
+
+            def d_body(c):
+                scr, lo, hi = c
+                start = jnp.clip(lo, 0, N - DW)
+                idx = start + jnp.arange(DW)
+                gi = jnp.minimum(idx, N - 1)
+                blk = screen_ops.rows_vs_items(
+                    items_pl, state.allow[gi], state.out[gi],
+                    state.defined[gi],
+                )  # [DW, I]
+                win = jax.lax.dynamic_slice(
+                    scr, (start, jnp.int32(0)), (DW, C)
+                )
+                new = jnp.where(
+                    ((idx >= lo) & (idx < hi))[:, None], blk, win
+                )
+                return (
+                    jax.lax.dynamic_update_slice(
+                        scr, new, (start, jnp.int32(0))
+                    ),
+                    lo + DW,
+                    hi,
+                )
+
+            screen, _, _ = jax.lax.while_loop(
+                d_cond, d_body, (screen, plo, phi)
+            )
+            return screen
+
         def log_ok(ptr):
             """Commit gate: log space when logging, always-true otherwise."""
             return (ptr < L) if log_commits else jnp.bool_(True)
@@ -519,10 +894,33 @@ def make_pack_kernel(
             # 1k items, measured). Padded / empty items skip the whole step
             # body (screens, probes, spread plans) through ONE cond.
             valid_i = x["valid"] & (x["count"] > 0)
+            if prescreen:
+                # the step body READS the verdict tensor (one column
+                # gather) but returns a refresh descriptor in its place;
+                # the tensor is updated here, outside the valid/skip cond,
+                # so the scan carry keeps aliasing it (any write under the
+                # cond copies the whole tensor per step)
+                def _skip(c, _x):
+                    return (c[0], c[1], c[2], empty_desc())
+
+                # the verdict-column gather ALSO stays outside the cond:
+                # with no read of the tensor anywhere under the cond, its
+                # uses form a linear gather -> replay-write chain and XLA
+                # aliases the scan carry instead of copying it every step
+                vrow = carry[3][:, x["scls"]]
+                state2, log2, ptr2, desc = jax.lax.cond(
+                    valid_i, _step_body, _skip,
+                    (carry[0], carry[1], carry[2], vrow), x,
+                )
+                screen2 = apply_refresh(carry[3], desc, state2)
+                return (state2, log2, ptr2, screen2), None
             return jax.lax.cond(valid_i, _step_body, lambda c, _x: c, carry, x), None
 
         def _step_body(carry, x):
-            state, log, ptr = carry
+            # position 3: this item's pre-gathered verdict column [N] in
+            # prescreen mode (the tensor itself never enters the step
+            # cond), the carried screen placeholder in tiered mode
+            state, log, ptr, aux3 = carry
             i = x["i"]
             prow = {
                 k: x[k]
@@ -548,6 +946,30 @@ def make_pack_kernel(
                     any_topo_i |= prow["topo_own"][g] | prow["topo_sel"][g]
             valid = x["valid"]
             count = x["count"]
+            # prescreen: this item's verdict column, in sync with the slot
+            # planes (every commit refreshes what it wrote). plane_mut
+            # gates the refreshes: an item with no defined keys and no
+            # topology involvement merges as the identity on
+            # allow/out/defined (encode gives undefined keys
+            # allow=all/out=True/defined=False), so its commits cannot
+            # change any verdict — the dominant generic items skip the
+            # re-screen matmuls entirely. Both tests are restricted to
+            # SCREENED keys: narrowing an elided hostname key (hostname
+            # spread/anti topology) is equally verdict-neutral, which
+            # spares the biggest per-slot committers the re-screens.
+            if prescreen:
+                vrow = aux3  # verdict column [N], gathered by `step`
+                any_topo_scr = jnp.bool_(False)
+                if has_topo:
+                    for g, gm in enumerate(topo_meta.groups):
+                        if gm.seg[0] < WSCR:
+                            any_topo_scr |= (
+                                prow["topo_own"][g] | prow["topo_sel"][g]
+                            )
+                plane_mut = (prow["defined"] & key_scr).any() | any_topo_scr
+            else:
+                vrow = None
+                plane_mut = None
 
             # -- screen (once per item), TIERED by nopen ------------------
             # slots at or beyond nopen can never be open, so the [N]-wide
@@ -561,10 +983,14 @@ def make_pack_kernel(
                     state.used[:limit] + prow["requests"][None, :],
                     state.cap[:limit],
                 )
-                req_l = slot_compat_screen(
-                    state.allow[:limit], state.out[:limit],
-                    state.defined[:limit], prow,
-                )
+                if prescreen:
+                    # the screen left the loop body: one [N]-row gather
+                    req_l = vrow[:limit]
+                else:
+                    req_l = slot_compat_screen(
+                        state.allow[:limit], state.out[:limit],
+                        state.defined[:limit], prow,
+                    )
                 sc = state.open[:limit] & tol_l & fit_l & req_l
                 if has_topo:
                     sc &= topo.topo_screen(
@@ -811,7 +1237,9 @@ def make_pack_kernel(
             # -- candidate branch: verify best slot, commit k replicas ----
             def do_candidate(args):
                 carry, force, cap, gate, _dmark = args
-                state, log, ptr, remaining, score, _, dead = carry
+                # scrd: refresh descriptor in prescreen mode (see
+                # empty_desc), dead screen placeholder in tiered mode
+                state, log, ptr, remaining, score, _, dead, scrd = carry
                 n = jnp.argmin(jnp.where(gate, score, BIG))
                 ok, compat_tmask, kcap_t, kmax, narrow, applied_keys = verify_slot(
                     state, prow, n, type_reqs, type_alloc, type_offering_ok,
@@ -869,12 +1297,35 @@ def make_pack_kernel(
                 )
                 log, ptr = log_write(log, ptr, do, i, n, 1, k, k)
                 remaining = remaining - jnp.where(do, k, 0)
+                if prescreen:
+                    # incremental refresh: re-screen ONLY slot row n (post-
+                    # commit planes) against the whole item axis, recorded
+                    # as one descriptor op — `step` replays it outside the
+                    # cond tree (see empty_desc). Skipped via the cond when
+                    # the commit cannot have changed the planes (no-op
+                    # merge) or didn't happen — the branches carry one [C]
+                    # row, not the tensor.
+                    col_on = plane_mut & do
+
+                    def _refresh_col(_):
+                        return screen_ops.items_vs_row(
+                            items_pl, state.allow[n], state.out[n],
+                            state.defined[n],
+                        )
+
+                    col = jax.lax.cond(
+                        col_on, _refresh_col,
+                        lambda _: jnp.zeros(C, dtype=bool), None,
+                    )
+                    scrd = desc_append_run(
+                        scrd, col_on, n, jnp.int32(1), col
+                    )
                 # retire the slot on failure or when filled to capacity; a
                 # commit limited only by the water-fill cap leaves the slot
                 # available for a later fill round in the same domain
                 retire = (~do) | (k >= kmax)
                 score = score.at[n].set(jnp.where(retire, BIG, score[n]))
-                return state, log, ptr, remaining, score, jnp.bool_(False), dead
+                return state, log, ptr, remaining, score, jnp.bool_(False), dead, scrd
 
             # -- bulk fill: ALL gated candidates in one iteration (the
             # reference tries existing nodes in index order per pod,
@@ -891,7 +1342,7 @@ def make_pack_kernel(
                 # region bulk items; a machine-slot tail would otherwise
                 # multiply every op's cost ~N/EB-fold for nothing
                 carry, force, cap, gate, _dmark = args
-                state, log, ptr, remaining, score, _, dead = carry
+                state, log, ptr, remaining, score, _, dead, scrd = carry
                 sa = state.allow[:BR]
                 cands = (score[:BR] < BIG) & gate[:BR] & (
                     state.is_existing[:BR]
@@ -1109,11 +1560,51 @@ def make_pack_kernel(
                     }
                 log, ptr = log_write(log, ptr, do, i, 0, -1, bn, placed)
                 remaining = remaining - jnp.where(do, placed, 0)
+                if prescreen:
+                    # only TOUCHED rows changed planes (each merged with
+                    # this item's planes) — a bulk commit touches at most
+                    # the item's replica count of rows, so gather up to UWB
+                    # of them, re-screen that small block, and record the
+                    # rows as descriptor ops (`step` replays them outside
+                    # the cond tree). A commit touching > UWB rows queues
+                    # the covering interval [first touched, last touched+1)
+                    # onto the pending drain instead — re-screening the
+                    # untouched rows in between is exact, just redundant.
+                    # Plane-neutral items skip everything through the cond.
+                    bulk_on = plane_mut & do
+                    ntouched = touched.sum()
+                    over = ntouched > UWB
+                    # stable argsort of ~touched: touched indices first, in
+                    # index order
+                    tidx = jnp.argsort(~touched)[:UWB]
+                    gidx = jnp.where(jnp.arange(UWB) < ntouched, tidx, 0)
+
+                    def _chunk(_):
+                        return screen_ops.rows_vs_items(
+                            items_pl, state.allow[gidx], state.out[gidx],
+                            state.defined[gidx],
+                        )  # [UWB, C]
+
+                    blk = jax.lax.cond(
+                        bulk_on & ~over, _chunk,
+                        lambda _: jnp.zeros((UWB, C), dtype=bool), None,
+                    )
+                    t_lo = jnp.argmax(touched).astype(jnp.int32)
+                    t_hi = (
+                        jnp.int32(BR)
+                        - jnp.argmax(touched[::-1]).astype(jnp.int32)
+                    )
+                    scrd = desc_append_rows(
+                        scrd, bulk_on & ~over, tidx, blk,
+                        ntouched.astype(jnp.int32), t_lo, t_hi,
+                    )
+                    scrd = desc_pend(scrd, bulk_on & over, t_lo, t_hi)
                 # retire filled/unusable slots; on a no-op pass retire every
                 # candidate so the loop is guaranteed to progress
                 retire = cands & jnp.where(do, (k_eff == 0) | (take >= k_eff), True)
                 score = score.at[:BR].set(jnp.where(retire, BIG, score[:BR]))
-                carry2 = (state, log, ptr, remaining, score, jnp.bool_(False), dead)
+                carry2 = (state, log, ptr, remaining, score, jnp.bool_(False), dead,
+                          scrd)
                 # fused open: when the exist fill leaves no candidate at all
                 # and the item owns no vk-spread (whose per-round cap must be
                 # re-planned), open fresh machines in the SAME iteration —
@@ -1139,7 +1630,7 @@ def make_pack_kernel(
                 return open_commit(carry, force, cap, dmark)
 
             def open_commit(carry, force, cap, dmark):
-                state, log, ptr, remaining, score, _, dead = carry
+                state, log, ptr, remaining, score, _, dead, scrd = carry
                 cap_ok = jnp.all(
                     type_capacity[None, :, :] <= state.remaining[:, None, :], axis=-1
                 )  # [J, T]
@@ -1310,17 +1801,43 @@ def make_pack_kernel(
                 # reference simply fails such a pod, machine.go:94-107)
                 dead = dead | (dmark & failed & (n_owned_vk == 1))
                 exhausted = failed & (n_owned_vk != 1)
-                return state, log, ptr, remaining, score, exhausted, dead
+                if prescreen:
+                    # every opened slot carries the SAME merged row, so ONE
+                    # descriptor op — [base, base+s) sharing one [C]
+                    # verdict row — covers the whole open (`step` replays
+                    # it outside the cond tree). A plane-neutral non-topo
+                    # item merges as the identity, so its row IS the
+                    # template's planes and the verdict row is the
+                    # precomputed tmpl_cols gather; only plane-mutating
+                    # items pay the exact re-screen. Opens wider than UWO
+                    # queue [base, base+s) onto the pending drain instead.
+                    base = state.nopen - s  # first freshly-opened slot
+                    over_o = can & (s > UWO)
+
+                    def _exact_col(_):
+                        return screen_ops.items_vs_row(
+                            items_pl, m_allow_o, m_out_o, m_def_o
+                        )
+
+                    col_o = jax.lax.cond(
+                        can & plane_mut, _exact_col,
+                        lambda _: tmpl_rows[jc], None,
+                    )
+                    scrd = desc_append_run(
+                        scrd, can & ~over_o, base, s, col_o
+                    )
+                    scrd = desc_pend(scrd, over_o, base, base + s)
+                return state, log, ptr, remaining, score, exhausted, dead, scrd
 
             def cond_fn(carry):
-                remaining, exhausted, tries = carry[3], carry[5], carry[7]
+                remaining, exhausted, tries = carry[3], carry[5], carry[8]
                 # backstop only: commits consume `count`, failed verifies
                 # retire slots (<= N), open failures retire domains (<= V)
                 return (remaining > 0) & (~exhausted) & (tries < count + N + V + 64)
 
             def body_fn(carry):
-                inner = carry[:7]
-                tries = carry[7]
+                inner = carry[:8]
+                tries = carry[8]
                 state_c, remaining_c, score_c, dead_c = (
                     carry[0], carry[3], carry[4], carry[6],
                 )
@@ -1382,21 +1899,26 @@ def make_pack_kernel(
                     )
                 else:
                     inner = jax.lax.cond(has_cand, do_candidate, do_open, args)
-                state_n, log_n, ptr_n, remaining_n, score_n, exhausted_n, dead_n = inner
+                (state_n, log_n, ptr_n, remaining_n, score_n, exhausted_n,
+                 dead_n, x8) = inner
                 return (
                     state_n, log_n, ptr_n, remaining_n, score_n,
-                    exhausted_n | blocked, dead_n, tries + 1,
+                    exhausted_n | blocked, dead_n, x8, tries + 1,
                 )
 
             remaining0 = jnp.where(valid, count, 0)
+            # in prescreen mode the while carries the refresh descriptor in
+            # the screen's slot; the tensor itself stays outside the step
+            # cond and is updated by `step` via apply_refresh
+            x8_0 = empty_desc() if prescreen else aux3
             carry0 = (
                 state, log, ptr, remaining0, score0, jnp.bool_(False),
-                jnp.zeros(V, dtype=bool), jnp.int32(0),
+                jnp.zeros(V, dtype=bool), x8_0, jnp.int32(0),
             )
-            state, log, ptr, _, _, _, _, _ = jax.lax.while_loop(
+            state, log, ptr, _, _, _, _, x8, _ = jax.lax.while_loop(
                 cond_fn, body_fn, carry0
             )
-            return (state, log, ptr)
+            return (state, log, ptr, x8)
 
         xs = dict(
             item_arrays,
@@ -1404,8 +1926,8 @@ def make_pack_kernel(
             f_static=jnp.moveaxis(f_static, 1, 0),  # [I, J, T]
             openable=openable.T,  # [I, J]
         )
-        (state, log, ptr), _ = jax.lax.scan(
-            step, (state, log0, jnp.int32(0)), xs
+        (state, log, ptr, _screen), _ = jax.lax.scan(
+            step, (state, log0, jnp.int32(0), screen_init), xs
         )
         return state, log, ptr
 

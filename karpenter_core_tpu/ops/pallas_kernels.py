@@ -68,7 +68,8 @@ def _screen_kernel(pod_allow_ref, seg_ref, allow_ref, s_out_ref, s_def_ref,
     # ~shared | nonempty | escapes, then the custom-deny rule
     key_ok = jnp.maximum(jnp.maximum(1.0 - shared, nonempty), escapes)
     key_ok = jnp.minimum(key_ok, 1.0 - deny * (1.0 - s_def))
-    verdict_ref[:] = key_ok
+    # batched grid adds a unit leading block dim on the output ref
+    verdict_ref[...] = key_ok.reshape(verdict_ref.shape)
 
 
 def slot_screen_pallas(slot_allow, slot_out, slot_defined, pod_row, seg_mat,
@@ -120,3 +121,58 @@ def slot_screen_pallas(slot_allow, slot_out, slot_defined, pod_row, seg_mat,
     )(*args)
     # padded keys: verdict 1.0 (shared=0 -> ~shared). AND over real keys.
     return jnp.all(key_ok[:N, :K] > 0.5, axis=-1)
+
+
+def batched_slot_screen_pallas(slot_allow, slot_out, slot_defined, item_rows,
+                               seg_mat, interpret: bool = False):
+    """[B, N] Requirements.Compatible(slot rows, each of B item rows): the
+    BATCHED form of slot_screen_pallas used by the pack kernel's prescreen
+    (class×slot verdict precompute). Same fused kernel, grid extended over
+    the item axis — each (item, slot-tile) cell reads its item row plus one
+    allow tile and runs the three MXU contractions + key algebra in one
+    pass. item_rows: dict with allow [B, V] / out, defined, escape,
+    custom_deny [B, K]."""
+    from jax.experimental import pallas as pl
+
+    N, V = slot_allow.shape
+    K = slot_out.shape[1]
+    B = item_rows["allow"].shape[0]
+    TN = 256
+    Np = _round_up(max(N, TN), TN)
+    Kp = _round_up(max(K, 128), 128)
+    Vp = _round_up(max(V, 128), 128)
+
+    def pad2(a, r, c):
+        a = a.astype(jnp.bfloat16)
+        return jnp.pad(a, ((0, r - a.shape[0]), (0, c - a.shape[1])))
+
+    args = (
+        pad2(item_rows["allow"], B, Vp),
+        pad2(jnp.asarray(seg_mat), Vp, Kp),
+        pad2(slot_allow, Np, Vp),
+        pad2(slot_out, Np, Kp),
+        pad2(slot_defined, Np, Kp),
+        pad2(item_rows["out"], B, Kp),
+        pad2(item_rows["defined"], B, Kp),
+        pad2(item_rows["escape"], B, Kp),
+        pad2(item_rows["custom_deny"], B, Kp),
+    )
+    key_ok = pl.pallas_call(
+        _screen_kernel,
+        grid=(B, Np // TN),
+        in_specs=[
+            pl.BlockSpec((1, Vp), lambda b, n: (b, 0)),
+            pl.BlockSpec((Vp, Kp), lambda b, n: (0, 0)),
+            pl.BlockSpec((TN, Vp), lambda b, n: (n, 0)),
+            pl.BlockSpec((TN, Kp), lambda b, n: (n, 0)),
+            pl.BlockSpec((TN, Kp), lambda b, n: (n, 0)),
+            pl.BlockSpec((1, Kp), lambda b, n: (b, 0)),
+            pl.BlockSpec((1, Kp), lambda b, n: (b, 0)),
+            pl.BlockSpec((1, Kp), lambda b, n: (b, 0)),
+            pl.BlockSpec((1, Kp), lambda b, n: (b, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, TN, Kp), lambda b, n: (b, n, 0)),
+        out_shape=jax.ShapeDtypeStruct((B, Np, Kp), jnp.float32),
+        interpret=interpret,
+    )(*args)
+    return jnp.all(key_ok[:, :N, :K] > 0.5, axis=-1)

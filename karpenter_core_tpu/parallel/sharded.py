@@ -350,6 +350,10 @@ def make_sharded_run(segments, zone_seg, ct_seg, topo_meta, n_slots, mesh,
         "port_conflict": P(None, None),
         "vols": P(None, None),
         "valid": P(None),
+        # prescreen verdict-column maps: the item axis replicates, so the
+        # class-dedup indices stay valid on every shard
+        "scls": P(None),
+        "scls_first": P(None),
     }
     if has_topo:
         pod_spec["topo_own"] = P(None, None)
@@ -514,9 +518,13 @@ def make_sharded_solve(snap, provisioners, mesh, max_nodes_per_shard: int = 256,
     count_split, exist_owner = plan_shards(snap, ndp)
 
     # the shard_map program is pure in everything but the label geometry
-    # (+ topo signature, baked into geom) and the mesh shape: cache it so
-    # steady-state solves and relaxation rounds reuse one compiled program
-    cache_key = (geom, ndp, ntp)
+    # (+ topo signature, baked into geom), the mesh shape, and the screen
+    # mode resolved at trace time: cache on all three so steady-state
+    # solves reuse one compiled program AND a KCT_PACK_SCREEN flip takes
+    # effect instead of returning the other mode's cached program
+    from karpenter_core_tpu.ops import compat as ops_compat
+
+    cache_key = (geom, ndp, ntp, ops_compat.resolve_screen_mode())
     fn = None if program_cache is None else program_cache.get(cache_key)
     if fn is not None and hasattr(program_cache, "move_to_end"):
         program_cache.move_to_end(cache_key)  # LRU recency (ShardedSolver)

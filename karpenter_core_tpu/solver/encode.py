@@ -388,6 +388,16 @@ class EncodedSnapshot:
     item_rep: np.ndarray = None  # [I] int32 representative pod row
     item_members: List[List[int]] = None  # host: pod rows per item, in order
 
+    # prescreen verdict-tensor layout (ops/pack.py): the tensor's column
+    # axis is the UNIQUE requirement class among items, not the item axis —
+    # value-key anti-affinity expansion blows I up toward P (count=1 items)
+    # while the class count stays put, and every expanded replica shares its
+    # class's verdict column. item_scls maps item -> column; scls_items
+    # names one item per column so the kernel can gather the column planes
+    # from the (already item-gathered) pod arrays.
+    item_scls: np.ndarray = None  # [I] int32 verdict column of item i
+    scls_items: np.ndarray = None  # [C] int32 one item index per column
+
     # host-side back-references for decode
     instance_types: List[InstanceType] = field(default_factory=list)
     templates: List[MachineTemplate] = field(default_factory=list)
@@ -992,6 +1002,14 @@ def encode_snapshot(
         ffd_key_of_class=ffd_key_of_class,
     )
 
+    # verdict-column dedup: items of one class (anti-affinity expansion)
+    # share one prescreen column — requirement verdicts depend only on the
+    # class planes, so the dedup is exact (ops/pack.py gathers by item_scls)
+    cls_of_item = uidx[item_rep] if len(item_rep) else item_rep
+    _ucls, scls_items, item_scls = np.unique(
+        cls_of_item, return_index=True, return_inverse=True
+    )
+
     return EncodedSnapshot(
         dictionary=dictionary,
         resource_names=resource_names,
@@ -1031,6 +1049,8 @@ def encode_snapshot(
         item_counts=item_counts,
         item_rep=item_rep,
         item_members=item_members,
+        item_scls=item_scls.astype(np.int32),
+        scls_items=scls_items.astype(np.int32),
         instance_types=all_types,
         templates=templates,
         pods=pods_sorted,

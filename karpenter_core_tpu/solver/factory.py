@@ -91,11 +91,18 @@ def describe(solver) -> str:
 
 def build_solver(max_nodes: int = 1024, mode: Optional[str] = None,
                  backend: Optional[str] = None,
-                 max_nodes_per_shard: Optional[int] = None):
+                 max_nodes_per_shard: Optional[int] = None,
+                 screen_mode: Optional[str] = None):
     """Construct the primary in-process solver for this process's devices.
 
     max_nodes is the GLOBAL new-machine slot budget; the sharded path
-    divides it across dp shards unless max_nodes_per_shard pins it."""
+    divides it across dp shards unless max_nodes_per_shard pins it.
+
+    screen_mode pins the pack kernel's slot-screen strategy ('prescreen' =
+    batched class×slot feasibility precompute + in-scan incremental
+    refresh, 'tiered' = the per-step full screen); None defers to
+    KCT_PACK_SCREEN via ops.compat.resolve_screen_mode (envflags-routed),
+    which defaults to 'prescreen'."""
     mode = (mode or envflags.raw("KARPENTER_SOLVER_MODE", "auto")).lower()
     if mode not in ("auto", "single", "sharded"):
         raise ValueError(f"unknown KARPENTER_SOLVER_MODE {mode!r}")
@@ -114,7 +121,8 @@ def build_solver(max_nodes: int = 1024, mode: Optional[str] = None,
             )
         from karpenter_core_tpu.solver.tpu_solver import TPUSolver
 
-        return TPUSolver(max_nodes=max_nodes, backend=backend)
+        return TPUSolver(max_nodes=max_nodes, backend=backend,
+                         screen_mode=screen_mode)
     from karpenter_core_tpu.parallel.sharded import ShardedSolver
 
     ndp = mesh.shape["dp"]

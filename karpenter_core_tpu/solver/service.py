@@ -310,7 +310,11 @@ class SolverService:
                 tensor_to_pb("count_split", np.asarray(count_split)),
             ]
         else:
-            key = (request.geometry,)
+            from karpenter_core_tpu.ops import compat as ops_compat
+
+            # key on the trace-time screen mode too: a KCT_PACK_SCREEN flip
+            # must mint a new program, not serve the other mode's cache
+            key = (request.geometry, ops_compat.resolve_screen_mode())
             with self._mu:
                 fn = self._compiled.get(key)
                 if fn is not None:
@@ -376,7 +380,11 @@ class SolverService:
         )
         from karpenter_core_tpu.utils.compilecache import record_lookup
 
-        key = (geometry_key, ndp, ntp)
+        from karpenter_core_tpu.ops import compat as ops_compat
+
+        # screen mode in the key for the same reason as the single-device
+        # path: the mode resolves at trace time inside make_pack_kernel
+        key = (geometry_key, ndp, ntp, ops_compat.resolve_screen_mode())
         with self._mu:
             fn = self._compiled.get(key)
             if fn is not None:

@@ -253,7 +253,9 @@ def solve_geometry(snap: EncodedSnapshot, max_nodes: int):
 def make_device_run(segments, zone_seg, ct_seg, topo_meta, n_slots,
                     log_len: Optional[int] = None, rung_mode: bool = False,
                     backend: Optional[str] = None,
-                    screen_v: Optional[int] = None):
+                    screen_v: Optional[int] = None,
+                    screen_mode: Optional[str] = None,
+                    external_prescreen: bool = False):
     """Build the jittable device program — the whole Solve() as ONE program:
     feasibility + openable + packing scan. Pure function of the device arrays
     produced by device_args(); all dims except n_slots derive from shapes.
@@ -261,19 +263,29 @@ def make_device_run(segments, zone_seg, ct_seg, topo_meta, n_slots,
 
     rung_mode=True prepends two args (count_row [I], exist_open [E]) that
     override the per-item replica counts and the open-existing-slot mask —
-    the vmap axis of the batched consolidation ladder (solver/replan.py)."""
+    the vmap axis of the batched consolidation ladder (solver/replan.py).
+
+    screen_mode picks the pack kernel's slot-screen strategy (prescreen vs
+    tiered, compat.resolve_screen_mode default). With external_prescreen
+    (in-process TPUSolver only) the prescreen verdict tensor is NOT
+    computed inside this program: run takes it as a leading `screen0`
+    argument, produced by the companion make_prescreen_kernel program that
+    the solver dispatches (and times as solver.phase.prescreen) first."""
     import jax.numpy as jnp
 
+    from karpenter_core_tpu.ops import compat
     from karpenter_core_tpu.ops.feasibility import feasibility_static, openable_mask
     from karpenter_core_tpu.ops.pack import PackState, make_pack_kernel
 
     segments = list(segments)
+    screen_mode = screen_mode or compat.resolve_screen_mode()
+    external_prescreen = external_prescreen and screen_mode == "prescreen"
     pack = make_pack_kernel(
         segments, zone_seg, ct_seg, topo_meta=topo_meta, backend=backend,
-        screen_v=screen_v,
+        screen_v=screen_v, screen_mode=screen_mode,
     )
 
-    def run_impl(count_row, exist_open, pod_arrays, tmpl, tmpl_daemon,
+    def run_impl(count_row, exist_open, screen0, pod_arrays, tmpl, tmpl_daemon,
                  tmpl_type_mask, types, type_alloc, type_capacity,
                  type_offering_ok, pod_tol_all, exist, exist_used, exist_cap,
                  well_known, remaining0, topo_counts0, topo_hcounts0,
@@ -354,11 +366,39 @@ def make_device_run(segments, zone_seg, ct_seg, topo_meta, n_slots,
             # keeps the vmapped bulk-take matrices at one row AND lets the
             # bulk existing-fill fast path run per rung
             log_commits=not rung_mode,
+            screen0=screen0,
         )
         return log, ptr, state
 
     if rung_mode:
-        return run_impl
+        def rung_run(count_row, exist_open, *rest):
+            # internal prescreen: the vmapped rungs share the (unbatched)
+            # slot planes, so the verdict tensor traces once and broadcasts
+            return run_impl(count_row, exist_open, None, *rest)
+
+        return rung_run
+
+    import inspect
+
+    if external_prescreen:
+        def run(screen0, pod_arrays, tmpl, tmpl_daemon, tmpl_type_mask, types,
+                type_alloc, type_capacity, type_offering_ok, pod_tol_all,
+                exist, exist_used, exist_cap, well_known, remaining0,
+                topo_counts0, topo_hcounts0, topo_doms0, topo_terms,
+                exist_ports, exist_vols, exist_vol_limits, vol_driver):
+            return run_impl(
+                None, None, screen0, pod_arrays, tmpl, tmpl_daemon,
+                tmpl_type_mask, types, type_alloc, type_capacity,
+                type_offering_ok, pod_tol_all, exist, exist_used, exist_cap,
+                well_known, remaining0, topo_counts0, topo_hcounts0,
+                topo_doms0, topo_terms, exist_ports, exist_vols,
+                exist_vol_limits, vol_driver,
+            )
+
+        assert tuple(inspect.signature(run).parameters) == (
+            ("screen0",) + RUN_ARG_NAMES
+        )
+        return run
 
     def run(pod_arrays, tmpl, tmpl_daemon, tmpl_type_mask, types, type_alloc,
             type_capacity, type_offering_ok, pod_tol_all, exist, exist_used,
@@ -366,30 +406,32 @@ def make_device_run(segments, zone_seg, ct_seg, topo_meta, n_slots,
             topo_doms0, topo_terms, exist_ports, exist_vols, exist_vol_limits,
             vol_driver):  # order must match RUN_ARG_NAMES
         return run_impl(
-            None, None, pod_arrays, tmpl, tmpl_daemon, tmpl_type_mask, types,
-            type_alloc, type_capacity, type_offering_ok, pod_tol_all, exist,
-            exist_used, exist_cap, well_known, remaining0, topo_counts0,
+            None, None, None, pod_arrays, tmpl, tmpl_daemon, tmpl_type_mask,
+            types, type_alloc, type_capacity, type_offering_ok, pod_tol_all,
+            exist, exist_used, exist_cap, well_known, remaining0, topo_counts0,
             topo_hcounts0, topo_doms0, topo_terms, exist_ports, exist_vols,
             exist_vol_limits, vol_driver,
         )
-
-    import inspect
 
     assert tuple(inspect.signature(run).parameters) == RUN_ARG_NAMES
     return run
 
 
 def build_device_solve(snap: EncodedSnapshot, max_nodes: int = 1024,
-                       backend: Optional[str] = None):
+                       backend: Optional[str] = None,
+                       screen_mode: Optional[str] = None,
+                       external_prescreen: bool = False):
     """Returns (geometry_key, run_fn) for a snapshot's geometry. backend
     picks the kernel lowering (compat.resolve_backend default); tests force
-    'mxu' on CPU to exercise the exact TPU code path."""
+    'mxu' on CPU to exercise the exact TPU code path. screen_mode picks the
+    slot-screen strategy (prescreen/tiered)."""
     geom = solve_geometry(snap, max_nodes)
     (_P, _J, _T, _E, _R, _K, _V, N, segments_t, zone_seg, ct_seg, _topo_sig,
      log_len, _Q, _W, _D, screen_v) = geom
     run = make_device_run(
         segments_t, zone_seg, ct_seg, snap.topo_meta, N, log_len=log_len,
-        backend=backend, screen_v=screen_v,
+        backend=backend, screen_v=screen_v, screen_mode=screen_mode,
+        external_prescreen=external_prescreen,
     )
     return geom, run
 
@@ -427,6 +469,13 @@ def device_args(snap: EncodedSnapshot, provisioners: Optional[List[Provisioner]]
         "tol_tmpl": snap.pod_tol_u[cls],
         "valid": np.ones(I, dtype=bool),
         "count": counts.astype(np.int32),
+        # prescreen verdict column per item (encode's class dedup; identity
+        # when the snapshot predates it or items were built 1:1)
+        "scls": (
+            snap.item_scls.astype(np.int32)
+            if snap.item_scls is not None
+            else np.arange(I, dtype=np.int32)
+        ),
     }
     if snap.topo_meta is not None:
         pod_arrays["topo_own"] = snap.topo_arrays.owner.T[rep].copy()  # [I, G]
@@ -456,6 +505,20 @@ def device_args(snap: EncodedSnapshot, provisioners: Optional[List[Provisioner]]
 
         pod_arrays = {k: pad_rows(v) for k, v in pod_arrays.items()}
         pod_tol_all = pad_rows(pod_tol_all)
+
+    # verdict-column -> item map, bucketed like the item axis so the
+    # compiled geometry is stable across nearby batches (pad columns alias
+    # item 0 — harmless duplicates of its verdict column). Added AFTER the
+    # item padding: its leading axis is the column count C, not I.
+    scls_items = (
+        snap.scls_items.astype(np.int32)
+        if snap.scls_items is not None
+        else np.arange(I, dtype=np.int32)
+    )
+    C_pad = bucket_pow2(max(len(scls_items), 1), 32)
+    pod_arrays["scls_first"] = np.pad(
+        scls_items, (0, C_pad - len(scls_items))
+    )
 
     # provisioner limits -> remaining resources [J, R] (scheduler.go:70-75)
     remaining0 = np.full((J, len(snap.resource_names)), np.float32(1e30))
@@ -542,11 +605,16 @@ class TPUSolver:
     def __init__(self, max_nodes: int = 1024,
                  max_relax_rounds: int = DEFAULT_MAX_RELAX_ROUNDS,
                  donate: bool = True, backend: Optional[str] = None,
-                 profile_phases: bool = False):
+                 profile_phases: bool = False,
+                 screen_mode: Optional[str] = None):
         self.max_nodes = max_nodes
         self.max_relax_rounds = max_relax_rounds
         self.donate = donate
         self.backend = backend  # kernel lowering override (compat.resolve_backend)
+        # slot-screen strategy override (compat.resolve_screen_mode):
+        # 'prescreen' = batched class×slot verdict precompute + in-scan
+        # incremental refresh, 'tiered' = the per-step full screen fallback
+        self.screen_mode = screen_mode
         # opt-in: barrier after upload so last_phase_ms attributes transfer
         # time separately (costs cold solves the serialized upload)
         self.profile_phases = profile_phases
@@ -671,7 +739,13 @@ class TPUSolver:
             TRACER.add_span(f"solver.phase.{name}", t_phase, now, **attrs)
             t_phase = now
 
-        geom, run = build_device_solve(snap, self.max_nodes, backend=self.backend)
+        from karpenter_core_tpu.ops import compat as ops_compat
+
+        screen_mode = self.screen_mode or ops_compat.resolve_screen_mode()
+        geom, run = build_device_solve(
+            snap, self.max_nodes, backend=self.backend,
+            screen_mode=screen_mode, external_prescreen=True,
+        )
         args = device_args(snap, provisioners)
         _mark("args")
         # upload shrinkage, two layers:
@@ -735,19 +809,18 @@ class TPUSolver:
             record_lookup,
         )
 
-        key = (geom, self.backend, spec, treedef, tuple(layout))
-        fn = self._compiled.get(key)
-        cache_hit = fn is not None
+        key = (geom, self.backend, screen_mode, spec, treedef, tuple(layout))
+        entry = self._compiled.get(key)
+        cache_hit = entry is not None
         record_lookup("tpu_solver", cache_hit)
-        if fn is not None:
+        if entry is not None:
             self._compiled.move_to_end(key)
-        if fn is None:
-            def run_bundled(bundle, *donated):
-                it = iter(donated)
+        if entry is None:
+            def _rebuild(bundle, donated_iter):
                 rebuilt = []
                 for w, lay in zip(spec, layout):
                     if lay is None:
-                        rebuilt.append(next(it))
+                        rebuilt.append(next(donated_iter))
                         continue
                     o, nbytes, dt_s, shape = lay
                     dt = np.dtype(dt_s)
@@ -763,20 +836,70 @@ class TPUSolver:
                     if w is not None:
                         arr = jnp.unpackbits(arr, axis=-1, count=w).astype(bool)
                     rebuilt.append(arr)
-                return run(*jax.tree_util.tree_unflatten(treedef, rebuilt))
+                return jax.tree_util.tree_unflatten(treedef, rebuilt)
 
-            fn = jax.jit(
-                run_bundled,
-                donate_argnums=(
+            if screen_mode == "prescreen":
+                def run_bundled(bundle, screen0, *donated):
+                    return run(screen0, *_rebuild(bundle, iter(donated)))
+
+                # screen0 sits at position 1, shifting the donated planes
+                # one right; it is NOT donated itself — the scan's final
+                # verdict carry is discarded, so no output buffer can ever
+                # alias it and XLA would just warn "donated buffer not
+                # usable" on every compile
+                donate_nums = (
+                    tuple(range(2, 2 + len(donated_leaves)))
+                    if self.donate
+                    else ()
+                )
+            else:
+                def run_bundled(bundle, *donated):
+                    return run(*_rebuild(bundle, iter(donated)))
+
+                donate_nums = (
                     tuple(range(1, 1 + len(donated_leaves)))
                     if self.donate
                     else ()
-                ),
-            )
-            self._compiled[key] = fn
+                )
+            fn = jax.jit(run_bundled, donate_argnums=donate_nums)
+
+            pre_fn = None
+            if screen_mode == "prescreen":
+                # the batched class×slot precompute as its OWN program,
+                # cached under the same LRU entry as the solve program so
+                # the pair ages out together and the bucketed compile cache
+                # stays at 2 programs per geometry (guarded by
+                # tests/test_perf_floor.py's tripwire). It reads only
+                # non-donated bundle leaves; donated slots rebuild as
+                # zero dummies that DCE away.
+                from karpenter_core_tpu.ops.pack import make_prescreen_kernel
+
+                (_P, _J, _T, _E, _R, _K, _V, N_, segments_t, _zs, _cs,
+                 _tsig, _ll, _Q, _W, _D, scr_v) = geom
+                prescreen_run = make_prescreen_kernel(
+                    segments_t, N_, backend=self.backend, screen_v=scr_v
+                )
+                donated_meta = [
+                    (packed[i].shape, packed[i].dtype)
+                    for i in sorted(donate_set)
+                ]
+
+                def prescreen_bundled(bundle):
+                    dummies = iter(
+                        jnp.zeros(s, d) for s, d in donated_meta
+                    )
+                    named = dict(
+                        zip(RUN_ARG_NAMES, _rebuild(bundle, dummies))
+                    )
+                    return prescreen_run(named["pod_arrays"], named["exist"])
+
+                pre_fn = jax.jit(prescreen_bundled)
+            entry = (fn, pre_fn)
+            self._compiled[key] = entry
             while len(self._compiled) > self.MAX_COMPILED:
                 old_key, _ = self._compiled.popitem(last=False)
                 self._fetch_buckets.pop(old_key, None)
+        fn, pre_fn = entry
         # one transfer for the bundle + one per donated plane
         args = jax.device_put((bundle, *donated_leaves))
         if self.profile_phases:
@@ -786,6 +909,21 @@ class TPUSolver:
             jax.block_until_ready(args)
         _mark("upload")
 
+        if pre_fn is not None:
+            # class×slot feasibility precompute: dispatched ahead of the
+            # scan program, which takes the verdict tensor as its (non-
+            # donated — see donate_nums) leading argument. Dispatch is
+            # async, so outside profile_phases this span mostly attributes
+            # the dispatch itself; the execution overlaps into the device
+            # window either way.
+            screen0 = pre_fn(args[0])
+            if self.profile_phases:
+                jax.block_until_ready(screen0)
+            _mark("prescreen", slots=geom[7])
+            run_args = (args[0], screen0, *args[1:])
+        else:
+            run_args = args
+
         t_dispatch = _time.perf_counter()
         # opt-in device profiling around the Solve dispatch (obs.device_
         # profiler, KARPENTER_TPU_PROFILE) — the analog of the reference's
@@ -794,7 +932,7 @@ class TPUSolver:
         # while the env var is set. The barrier keeps the execution inside
         # the captured window.
         with device_profiler():
-            log, ptr, state = fn(*args)
+            log, ptr, state = fn(*run_args)
             if profile_dir():
                 jax.block_until_ready(state)
 
@@ -1038,13 +1176,29 @@ def expand_log(snap: EncodedSnapshot, log, ptr: int,
             assigned[mem_arr] = np.repeat(nz, act)
             cursor[item] = lo + tot
             continue
-        for s in range(ns):
-            take = k_last if s == ns - 1 else k
-            lo = cursor[item]
-            hi = min(lo + take, cap[item], len(mem))
-            for m in mem[lo:hi]:
-                assigned[m] = slots[e] + s
-            cursor[item] = hi
+        # run commit: k replicas on each of ns slots from `slot` (k_last on
+        # the final one), vectorized the same way — the nested per-slot/
+        # per-member python loops were the decode profile's top eager cost
+        # once everything else went lazy (one iteration per PLACED POD)
+        if ns <= 0:
+            continue
+        lo = cursor[item]
+        avail = max(min(cap[item], len(mem)) - lo, 0)
+        if ns == 1:  # dominant case: one slot, take straight from k_last
+            tot = min(k_last, avail)
+            mem_arr = np.asarray(mem[lo : lo + tot], dtype=np.int64)
+            assigned[mem_arr] = slots[e]
+        else:
+            takes = np.full(ns, k, dtype=np.int64)
+            takes[-1] = k_last
+            csum = np.cumsum(takes)
+            tot = int(min(csum[-1], avail))
+            act = np.clip(tot - (csum - takes), 0, takes)
+            mem_arr = np.asarray(mem[lo : lo + tot], dtype=np.int64)
+            assigned[mem_arr] = slots[e] + np.repeat(
+                np.arange(ns, dtype=np.int64), act
+            )
+        cursor[item] = lo + tot
     return assigned
 
 
@@ -1056,7 +1210,11 @@ def decode_solve(snap: EncodedSnapshot, placements, state,
     a per-pod assigned array [P] (native path)."""
     if isinstance(placements, tuple):
         log, ptr = placements
-        assigned = expand_log(snap, log, ptr)
+        # named sub-span: the commit-log replay is the bind phase's largest
+        # host cost at bench geometries (it visits every placed pod), so it
+        # gets its own attribution under solver.phase.bind
+        with TRACER.span("solver.phase.expand", entries=int(ptr)):
+            assigned = expand_log(snap, log, ptr)
     else:
         assigned = placements
     E = len(snap.state_nodes)

@@ -17,7 +17,11 @@ from karpenter_core_tpu.cloudprovider import fake
 from karpenter_core_tpu.solver.tpu_solver import GreedySolver, TPUSolver
 from karpenter_core_tpu.testing import make_pod, make_provisioner
 
-pytestmark = pytest.mark.skipif(
+# throughput floors are opt-in (KCT_PERF=1), like the reference's
+# test_performance build tag; the STRUCTURAL tripwires below (prescreen
+# jaxpr shape, compiled-program count) are cheap and always run — they are
+# wired into `make verify` and guard the perf ARCHITECTURE, not a number
+perf_gate = pytest.mark.skipif(
     os.environ.get("KCT_PERF", "") != "1",
     reason="perf floor is opt-in (KCT_PERF=1), like the reference's "
     "test_performance build tag",
@@ -52,6 +56,7 @@ def _mix(n_pods):
     return pods
 
 
+@perf_gate
 @pytest.mark.parametrize("n_pods", [500, 1000])
 def test_device_solver_throughput_floor(n_pods):
     """Full Solve() (encode + device + decode) >= FLOOR pods/sec, steady
@@ -76,6 +81,7 @@ def test_device_solver_throughput_floor(n_pods):
     )
 
 
+@perf_gate
 def test_disabled_observability_cost_stays_flat():
     """ISSUE 3 acceptance: with KARPENTER_TPU_LOG off and the flight
     recorder off, hot-path sites cost one flag check — same bar as the
@@ -129,6 +135,142 @@ def test_disabled_observability_cost_stays_flat():
     )
 
 
+# -- ISSUE 5 structural tripwires (always run; fatal in make verify) ---------
+
+
+def _tripwire_snapshot():
+    """Small geometry where the slot count N is UNIQUE among array dims, so
+    'a contraction producing an N-sized axis' identifies the full-width
+    slot screen unambiguously: 20 distinct pods (item bucket 32 = C), 3
+    existing nodes (E_pad 8), max_nodes 48 -> N = 8 + 48 = 56, colliding
+    with none of I=32, V=32, K=11, E=8, T=5, R=4, screen_v=24."""
+    from karpenter_core_tpu.solver.encode import encode_snapshot
+    from karpenter_core_tpu.state.node import StateNode
+    from karpenter_core_tpu.testing import make_node
+
+    universe = fake.instance_types(5)
+    pods = [
+        make_pod(labels={"app": f"t{i}"}, requests={"cpu": str(0.1 * (i + 1))})
+        for i in range(20)
+    ]
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": universe}
+    nodes = []
+    for e in range(3):
+        it = universe[e % len(universe)]
+        nodes.append(StateNode(node=make_node(
+            name=f"trip-node-{e}",
+            labels={
+                "karpenter.sh/provisioner-name": "default",
+                "karpenter.sh/initialized": "true",
+                "node.kubernetes.io/instance-type": it.name,
+                "karpenter.sh/capacity-type": "on-demand",
+                "topology.kubernetes.io/zone": "test-zone-1",
+            },
+            capacity={k: str(v) for k, v in it.capacity.items()},
+        )))
+    snap = encode_snapshot(pods, provisioners, its, None, nodes, max_nodes=48)
+    return snap, provisioners
+
+
+def _scan_dot_output_dims(run, args):
+    """Trace run's jaxpr, find the pack scan, and return the set of output
+    dims of every dot_general anywhere inside the scan body (incl. nested
+    while/cond branches)."""
+    import jax
+
+    jaxpr = jax.make_jaxpr(run)(*args).jaxpr
+
+    def subjaxprs(eqn):
+        for v in eqn.params.values():
+            if hasattr(v, "jaxpr"):  # ClosedJaxpr
+                yield v.jaxpr
+            elif isinstance(v, (list, tuple)):
+                for item in v:
+                    if hasattr(item, "jaxpr"):
+                        yield item.jaxpr
+
+    def collect_dots(jx, out):
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "dot_general":
+                for var in eqn.outvars:
+                    out.update(var.aval.shape)
+            for sub in subjaxprs(eqn):
+                collect_dots(sub, out)
+
+    dims = set()
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name == "scan":
+            for sub in subjaxprs(eqn):
+                collect_dots(sub, dims)
+    return dims
+
+
+@pytest.mark.parametrize("mode", ["prescreen", "tiered"])
+def test_scan_body_screen_contraction_tripwire(mode):
+    """The tentpole's whole point, asserted on the jaxpr: with the
+    prescreen selected, the scan STEP must not contain the full-width slot
+    screen contraction (no dot_general producing an N-sized axis — the
+    screen left the loop body); the tiered fallback is the positive
+    control proving the predicate detects it."""
+    from karpenter_core_tpu.solver.tpu_solver import (
+        build_device_solve,
+        device_args,
+    )
+
+    snap, provisioners = _tripwire_snapshot()
+    # force the MXU lowering: the CPU-default 'sliced' screen is a per-key
+    # loop with no dot_general, which would blind the predicate
+    geom, run = build_device_solve(
+        snap, max_nodes=48, backend="mxu", screen_mode=mode
+    )
+    N = geom[7]
+    others = {d for d in geom if isinstance(d, int)} - {N}
+    assert N == 56 and N not in others, (
+        f"geometry drifted: N={N} is no longer unique (see doc; "
+        f"other dims {sorted(others)})"
+    )
+    args = device_args(snap, provisioners)
+    dims = _scan_dot_output_dims(run, args)
+    if mode == "prescreen":
+        assert N not in dims, (
+            f"prescreen scan body still contains an N={N}-wide screen "
+            f"contraction (dot output dims inside the scan: {sorted(dims)})"
+        )
+    else:
+        assert N in dims, (
+            "tripwire predicate lost its positive control: the tiered scan "
+            f"body shows no N={N}-wide contraction"
+        )
+
+
+def test_prescreen_compiled_program_guard():
+    """The precompute must not blow up the bucketed compile cache: repeat
+    solves in one geometry bucket share ONE cache entry holding exactly
+    two programs (prescreen + solve), and the second solve is a cache
+    hit."""
+    universe = fake.instance_types(5)
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": universe}
+    solver = TPUSolver(max_nodes=48, screen_mode="prescreen")
+    for n in (18, 20):  # same item bucket (32)
+        pods = [
+            make_pod(labels={"app": f"t{i}"},
+                     requests={"cpu": str(0.1 * (i + 1))})
+            for i in range(n)
+        ]
+        res = solver.solve(pods, provisioners, its)
+        assert res.pod_count_new() + res.pod_count_existing() == n
+    assert len(solver._compiled) == 1, (
+        f"one geometry bucket minted {len(solver._compiled)} cache entries"
+    )
+    fn, pre_fn = next(iter(solver._compiled.values()))
+    assert fn is not None and pre_fn is not None, (
+        "prescreen entry must pair the solve program with its precompute"
+    )
+
+
+@perf_gate
 def test_host_fallback_throughput_floor():
     """The host greedy fallback also holds the reference's floor (it IS the
     reference algorithm; a regression here breaks solver outages)."""

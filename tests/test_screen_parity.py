@@ -1,0 +1,141 @@
+"""Prescreen-vs-tiered parity (ISSUE 5 acceptance).
+
+The pack kernel's 'prescreen' slot-screen strategy (batched class×slot
+feasibility precompute + in-scan incremental refresh, ops/pack.py) must be
+a pure PERFORMANCE transform: for identical inputs it must produce
+placements byte-identical to the original per-step tiered screen, across
+every constraint family the screen participates in — spread, pod
+(anti-)affinity (which also exercises the item-expansion / class-dedup
+verdict columns), hostPorts, tolerations, relaxation rounds, existing
+nodes, and the bulk replica-group paths.
+
+Byte-identical means flightrec.placements_json equality, the same bar the
+flight-recorder replay uses; a lockstep replay test pins one recorded
+solve through both paths so a future drift shows up as a deterministic
+diff, not a fuzz flake.
+"""
+import copy
+import json
+
+import numpy as np
+import pytest
+
+from karpenter_core_tpu.cloudprovider import fake
+from karpenter_core_tpu.obs import flightrec
+from karpenter_core_tpu.obs.flightrec import (
+    canonical_placements,
+    placements_json,
+    snapshot_inputs,
+)
+from karpenter_core_tpu.solver.tpu_solver import TPUSolver
+from karpenter_core_tpu.testing import make_pod, make_provisioner
+
+from tests.test_differential_fuzz import _workload as _g1_workload
+from tests.test_differential_fuzz_wide import (
+    _g3_workload,
+    _g5_workload,
+)
+
+# one solver per mode, shared across seeds/geometries: the anchored
+# workload generators keep the dictionary geometry constant per family, so
+# each (mode, family) pair compiles once and the seeds reuse the program
+_SOLVERS = {}
+
+
+def _solve(mode, pods, provisioners, its, nodes):
+    solver = _SOLVERS.setdefault(
+        mode, TPUSolver(max_nodes=96, screen_mode=mode)
+    )
+    return solver.solve(
+        copy.deepcopy(pods), provisioners, its,
+        state_nodes=[n.deep_copy() for n in nodes] if nodes else None,
+    )
+
+
+def _assert_parity(pods, provisioners, its, nodes):
+    tiered = _solve("tiered", pods, provisioners, its, nodes)
+    pre = _solve("prescreen", pods, provisioners, its, nodes)
+    a = placements_json(canonical_placements(tiered))
+    b = placements_json(canonical_placements(pre))
+    if a != b:
+        diff = flightrec.diff_placements(
+            canonical_placements(tiered), canonical_placements(pre)
+        )
+        raise AssertionError(
+            "prescreen diverged from tiered:\n" + "\n".join(diff)
+        )
+    assert tiered.rounds == pre.rounds
+    assert len(pre.failed_pods) == len(tiered.failed_pods)
+
+
+@pytest.mark.parametrize("seed", [11, 23, 47])
+def test_parity_generic_mix(seed):
+    """G1: spread + hostPorts + tolerations + selectors over existing
+    nodes — the differential-fuzz baseline geometry."""
+    rng = np.random.default_rng(seed)
+    universe = fake.instance_types(8)
+    pods, provisioners, its, nodes = _g1_workload(rng, universe)
+    _assert_parity(pods, provisioners, its, nodes)
+
+
+@pytest.mark.parametrize("seed", [5, 19])
+def test_parity_hostname_anti_affinity(seed):
+    """G5: hostname anti-affinity owners + selected-only followers — the
+    geometry where encode expands classes into per-pod items and the
+    prescreen's class-dedup verdict columns actually dedup."""
+    rng = np.random.default_rng(seed)
+    pods, provisioners, its, nodes = _g5_workload(rng)
+    _assert_parity(pods, provisioners, its, nodes)
+
+
+def test_parity_relaxation_rounds():
+    """G3: preferred terms that must relax — every relax round re-solves
+    with re-encoded planes, so the refresh path must stay in lockstep
+    across rounds, not just on round 1."""
+    rng = np.random.default_rng(3)
+    pods, provisioners, its, nodes = _g3_workload(rng)
+    _assert_parity(pods, provisioners, its, nodes)
+
+
+def test_parity_bulk_replica_groups():
+    """Deployment-shaped batch (few classes x many replicas): drives the
+    bulk existing-fill and bulk machine-open commits whose region-wide
+    refresh ops (shared merged row / pending-interval drain) the small
+    fuzz mixes rarely reach."""
+    universe = fake.instance_types(6)
+    pods = []
+    for c in range(3):
+        for _ in range(40):
+            pods.append(
+                make_pod(labels={"app": f"dep-{c}"},
+                         requests={"cpu": str(0.25 * (c + 1))})
+            )
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": universe}
+    _assert_parity(pods, provisioners, its, None)
+
+
+def test_replay_lockstep_pinned_record(monkeypatch):
+    """One recorded solve (hack/replay.py's record shape) replayed through
+    BOTH screen modes: each must reproduce the recorded placements byte
+    for byte. Pins the two paths together on a fixed artifact, the way a
+    field incident would be bisected."""
+    from tests.test_flightrec import _workload as _rec_workload
+
+    pods, provisioners, its, nodes = _rec_workload(seed=7)
+    live = _solve("prescreen", pods, provisioners, its, nodes)
+    record = {
+        "inputs": snapshot_inputs(
+            pods, provisioners, its, None, nodes, max_nodes=96
+        ),
+        "replayer": "tpu",
+        "outcome": {"placements": canonical_placements(live)},
+    }
+    record = json.loads(json.dumps(record))  # through-disk fidelity
+    recorded = placements_json(record["outcome"]["placements"])
+    for mode in ("tiered", "prescreen"):
+        monkeypatch.setenv("KCT_PACK_SCREEN", mode)
+        replayed, _res = flightrec.replay(record, "tpu")
+        assert placements_json(replayed) == recorded, (
+            f"replay({mode}) diverged from the recorded placements"
+        )
