@@ -3,7 +3,7 @@
 # backend with 8 virtual devices via tests/conftest.py.
 
 .PHONY: test deflake perf bench verify trace-demo chaos chaos-smoke \
-	replay-demo lint
+	replay-demo lint soak soak-smoke
 
 test:  ## tier-1 suite (CPU, 8 virtual devices); slow chaos soaks: make chaos
 	python -m pytest tests -q -m "not slow"
@@ -35,6 +35,13 @@ chaos:  ## fault-injection suite (incl. slow schedule cases), fixed seed
 chaos-smoke:  ## env-spec chaos run -> loop recovers + counters exposed
 	python hack/chaos_smoke.py
 
+soak:  ## >=60s sustained-churn soak, chaos armed + flightrec on (CPU-hermetic;
+	# override the backend by exporting JAX_PLATFORMS before calling)
+	JAX_PLATFORMS=$${JAX_PLATFORMS:-cpu} python hack/soak.py
+
+soak-smoke:  ## <=30s seeded churn smoke (CI gate: admission SLOs + delta re-solve engage)
+	JAX_PLATFORMS=$${JAX_PLATFORMS:-cpu} python hack/soak.py --smoke
+
 verify:  ## driver hooks: single-chip compile check + 8-way mesh dryrun
 	# force the CPU backend in-process: this image's sitecustomize pins the
 	# axon TPU tunnel (env vars can't override it), and a wedged tunnel
@@ -58,3 +65,6 @@ verify:  ## driver hooks: single-chip compile check + 8-way mesh dryrun
 	# non-fatal smoke: an env-spec chaos run must recover and expose the
 	# karpenter_chaos_injected_total / retry / ICE counters
 	-$(MAKE) chaos-smoke
+	# non-fatal smoke: a short seeded churn soak must bind every pod and
+	# engage the incremental delta re-solve (fatal gate lives in presubmit)
+	-$(MAKE) soak-smoke

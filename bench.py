@@ -1247,6 +1247,29 @@ def _failure_record(note: str, extra: dict) -> dict:
     }
 
 
+def _fold_churn_report(result: dict) -> None:
+    """BENCH_CHURN_REPORT names a soak-report JSON (`hack/soak.py --out`):
+    its churn_* columns (admission->bind SLOs, queue depth, incremental
+    re-solve ratio, refresh-vs-full prescreen medians — docs/PERF.md
+    "churn columns") fold into the bench artifact's extra, so the one-shot
+    Solve() numbers and the steady-state churn numbers travel in the same
+    BENCH_r{N}.json. The soak runs on its own wall clock (make soak), not
+    inside the bench budget."""
+    path = os.environ.get("BENCH_CHURN_REPORT", "")
+    if not path:
+        return
+    try:
+        with open(path) as f:
+            churn = json.load(f)
+        result.setdefault("extra", {}).update(
+            {k: v for k, v in churn.items() if k.startswith("churn_")}
+        )
+    except Exception as exc:  # noqa: BLE001 — a bad report must not kill the bench line
+        result.setdefault("extra", {})["churn_report_error"] = (
+            f"{type(exc).__name__}: {exc}"[:200]
+        )
+
+
 def orchestrate():
     """Top-level driver-facing entry: never imports jax in this process, so
     no wedge can stop the final JSON line from being printed."""
@@ -1279,6 +1302,7 @@ def orchestrate():
         if result is None:
             result = _failure_record(note, {})
         result.setdefault("extra", {})["orchestrator_probe"] = ["forced cpu"]
+        _fold_churn_report(result)
         print(json.dumps(result))
         return
 
@@ -1355,6 +1379,7 @@ def orchestrate():
         result = _failure_record(note, {})
 
     result.setdefault("extra", {})["orchestrator_probe"] = probe_log
+    _fold_churn_report(result)
     print(json.dumps(result))
 
 

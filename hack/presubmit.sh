@@ -20,6 +20,9 @@ make test
 echo "== presubmit: make perf (>=100 pods/sec floor)"
 make perf
 
+echo "== presubmit: make soak-smoke (seeded churn: SLOs + delta re-solve)"
+make soak-smoke
+
 if [[ "${1:-}" != "quick" ]]; then
   echo "== presubmit: short deflake (3 iterations)"
   MAX_ITERS=3 ./hack/deflake.sh

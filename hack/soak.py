@@ -1,0 +1,195 @@
+#!/usr/bin/env python
+"""Soak bench: sustained seeded churn through the FULL operator loop.
+
+Runs minutes of deterministic pod arrival/termination/resize traffic
+(loadgen.ChurnGenerator) against a live operator — background watch pumps,
+batcher windows, TPU solves, machine launches — with chaos armed (the
+`state.diff` feed fault plus transient cloud-create failures) and the
+flight recorder on, then reports the SLO columns the steady-state story is
+judged by (docs/PERF.md "churn columns"):
+
+  churn_admission_p50_s / churn_admission_p99_s
+      pod admission -> bind-decision latency, read from the provisioner's
+      karpenter_admission_to_bind_seconds histogram (REAL exposition,
+      baseline-diffed — not bench-side stopwatching)
+  churn_pending_max / churn_pending_mean
+      batch-queue depth (karpenter_pending_pods gauge samples)
+  churn_resolve_ratio, churn_inc_*
+      incremental delta re-solve hit ratio by outcome
+      (karpenter_incremental_screen_total)
+  churn_prescreen_refresh_med_ms vs churn_prescreen_full_med_ms
+      median device time of the delta refresh vs the full [N, C] verdict
+      precompute at the SAME churn geometry (solver.phase.prescreen spans;
+      the solver runs profile_phases so spans cover device execution)
+
+Usage:
+  python hack/soak.py                 # 75s soak, chaos armed (make soak)
+  python hack/soak.py --smoke         # <=30s seeded smoke (make soak-smoke)
+  python hack/soak.py --duration 300 --seed 7 --rate 12
+
+Exits nonzero when the soak is unhealthy: a dead reconcile loop, nothing
+bound, or pods stranded unbound at the end.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+# flight recorder ON for the whole run (the operator default; hack scripts
+# must opt in before the obs import reads the env)
+os.environ.setdefault("KARPENTER_TPU_FLIGHTREC", "1")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def build_solvers(max_nodes: int):
+    """(primary, resilient): the resilient pair is the operator wiring —
+    health-gated greedy fallback, small-batch routing OFF (churn batches
+    are small by nature; the soak exists to exercise the device path under
+    time), a stub prober (the backend was chosen by JAX_PLATFORMS; a
+    subprocess probe would measure the harness, not the loop). The bare
+    primary is returned too so the warmup pass runs through the SAME
+    solver instance: geometry programs trace/compile once and the measured
+    window starts fully jitted."""
+    from karpenter_core_tpu.solver.fallback import ResilientSolver
+    from karpenter_core_tpu.solver.tpu_solver import GreedySolver, TPUSolver
+
+    primary = TPUSolver(
+        max_nodes=max_nodes, screen_mode="prescreen", profile_phases=True
+    )
+    return primary, ResilientSolver(
+        primary, GreedySolver(), prober=lambda: None, small_batch_work_max=0
+    )
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--duration", type=float, default=75.0,
+                        help="soak length in seconds (default 75)")
+    parser.add_argument("--seed", type=int, default=42)
+    parser.add_argument("--rate", type=float, default=2.5,
+                        help="mean pod-arrival events/s")
+    parser.add_argument("--smoke", action="store_true",
+                        help="<=30s run for CI: 12s, lighter rates")
+    parser.add_argument("--no-chaos", action="store_true")
+    parser.add_argument("--no-warmup", action="store_true",
+                        help="skip the virtual-time compile warmup pass")
+    parser.add_argument("--out", default="",
+                        help="also write the report JSON to this path")
+    args = parser.parse_args(argv)
+
+    from dataclasses import replace
+
+    from karpenter_core_tpu import chaos
+    from karpenter_core_tpu.loadgen import ChurnConfig, SoakDriver
+    from karpenter_core_tpu.testing import FakeClock
+    from karpenter_core_tpu.utils.compilecache import enable_persistent_cache
+
+    # the production persistent XLA cache (ROADMAP item 3): soak geometries
+    # compile once per machine, not once per run
+    enable_persistent_cache()
+
+    duration = 12.0 if args.smoke else args.duration
+    rate = min(args.rate, 3.0) if args.smoke else args.rate
+    # same slot axis for smoke and soak: both draw from one persistent-
+    # compile-cache population, so a smoke run pre-warms the soak and vice
+    # versa (N=64 machine slots is plenty at these churn rates)
+    max_nodes = 64
+    config = ChurnConfig(
+        seed=args.seed,
+        duration_s=duration,
+        arrival_rate=rate,
+        termination_rate=rate * 0.6,
+        resize_rate=rate * 0.08,
+        # the longer run carries more live pods: seed the existing axis
+        # straight into the pow2 bucket it will occupy (24 -> 32, with pad
+        # headroom for launches) so mid-soak machine launches neither cross
+        # a bucket edge nor outgrow the hostname pad pool — either would
+        # re-mint the solve geometry out from under the resident tensor
+        initial_nodes=12 if args.smoke else 24,
+    )
+    primary, resilient = build_solvers(max_nodes)
+    if not args.no_warmup:
+        # virtual-time dress rehearsal of the schedule's opening window,
+        # through the SAME primary solver instance: same seed => same pods
+        # => same solve geometries, so the realtime window below starts
+        # with its device programs traced + compiled instead of spending
+        # its first seconds — or, on a 12s smoke, ALL its seconds — inside
+        # XLA. Chaos is armed after, so the rehearsal stays a pure compile
+        # pass.
+        print("soak: warmup (virtual-time compile pass)", file=sys.stderr)
+        SoakDriver(
+            replace(config, duration_s=min(duration, 12.0)),
+            clock=FakeClock(),
+            solver=primary,
+            max_nodes=max_nodes,
+        ).run_steps()
+
+    if not args.no_chaos:
+        # the feed-fault the incremental path must DEGRADE under (full
+        # re-encode, never drift) + transient cloud-create failures so the
+        # ICE/retry launch path runs too
+        chaos.arm(chaos.STATE_DIFF, error="conn", probability=0.05,
+                  seed=args.seed)
+        chaos.arm(chaos.CLOUDPROVIDER_CREATE, error="conn", probability=0.02,
+                  seed=args.seed + 1)
+
+    driver = SoakDriver(
+        config, max_nodes=max_nodes, solver=resilient,
+        # the tail exits EARLY once everything is bound; the budget only
+        # bounds the unhealthy case — and must outlast a chaos-tripped
+        # launch's exponential-backoff retry window
+        tail_timeout_s=25.0 if args.smoke else 30.0,
+    )
+
+    def progress(now, report):
+        print(
+            f"soak t={now:5.1f}s created={report.pods_created} "
+            f"binds={report.binds} terminated={report.pods_terminated}",
+            file=sys.stderr,
+        )
+
+    report = driver.run(on_progress=progress if sys.stderr.isatty() else None)
+    columns = report.as_columns()
+    columns["churn_seed"] = args.seed
+    columns["churn_chaos_armed"] = not args.no_chaos
+    line = json.dumps(columns, sort_keys=True)
+    print(line)
+    if args.out:
+        with open(args.out, "w") as f:
+            f.write(line + "\n")
+
+    failures = []
+    if not report.loops_alive:
+        failures.append("a reconcile loop died")
+    if report.binds == 0:
+        failures.append("no pod was ever bound")
+    if report.admission_count == 0:
+        failures.append("admission histogram recorded nothing")
+    if report.unbound_at_end > 0:
+        failures.append(f"{report.unbound_at_end} pods stranded unbound")
+    if report.inc_outcomes.get("refresh", 0) == 0:
+        failures.append("incremental delta re-solve never engaged")
+    if failures:
+        print("soak UNHEALTHY: " + "; ".join(failures), file=sys.stderr)
+        return 1
+    print(
+        f"soak ok: {report.binds} binds, admission p99 "
+        f"{report.admission_p99_s}s, resolve ratio "
+        f"{report.resolve_ratio}",
+        file=sys.stderr,
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    rc = main()
+    # skip interpreter teardown: the operator's watch pumps plus the XLA
+    # CPU client's own thread pool race destructors at exit and
+    # intermittently abort AFTER the report and health verdict are out —
+    # the run's result is already decided, so exit without unwinding
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(rc)

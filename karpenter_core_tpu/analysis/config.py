@@ -28,6 +28,8 @@ from typing import Dict, FrozenSet, Set, Tuple
 #   solver                                       (MUST NOT see controllers)
 #   controllers                                  (may orchestrate solver)
 #   operator, webhooks, testing                  (process wiring)
+#   loadgen                                      (churn driver: may see
+#                                                 everything, seen by nobody)
 DEFAULT_LAYERING: Dict[str, FrozenSet[str]] = {
     "metrics": frozenset(),
     "analysis": frozenset(),
@@ -39,7 +41,7 @@ DEFAULT_LAYERING: Dict[str, FrozenSet[str]] = {
     "api": frozenset({"kube", "utils"}),
     "scheduling": frozenset({"api", "kube", "utils"}),
     "cloudprovider": frozenset({"api", "kube", "metrics", "obs", "scheduling", "utils"}),
-    "state": frozenset({"api", "kube", "obs", "scheduling", "utils"}),
+    "state": frozenset({"api", "chaos", "kube", "obs", "scheduling", "utils"}),
     "ops": frozenset({"metrics", "obs", "utils"}),
     "native": frozenset({"metrics", "obs", "utils"}),
     "parallel": frozenset({"chaos", "metrics", "obs", "ops", "utils"}),
@@ -59,6 +61,15 @@ DEFAULT_LAYERING: Dict[str, FrozenSet[str]] = {
     "testing": frozenset({
         "api", "chaos", "cloudprovider", "controllers", "events", "kube",
         "metrics", "obs", "operator", "scheduling", "solver", "state", "utils",
+    }),
+    # churn/soak load generation: drives the REAL operator loop (batcher ->
+    # provisioner -> solver -> bind), so it sits above everything — and is
+    # a leaf the other way: NOTHING may depend on loadgen (no other layer
+    # lists it), so load generation can never leak into the control plane
+    "loadgen": frozenset({
+        "api", "chaos", "cloudprovider", "controllers", "events", "kube",
+        "metrics", "obs", "operator", "scheduling", "solver", "state",
+        "testing", "utils",
     }),
 }
 

@@ -18,6 +18,15 @@ class Settings:
     # an empty ConfigMap value nils the pointer)
     ttl_after_not_registered: Optional[float] = 15 * 60.0
     drift_enabled: bool = False  # feature gate (settings.go:44)
+    # 0 = unbounded (the reference behavior). A positive cap bounds the pods
+    # one provisioning pass solves (oldest first; the rest re-enter the next
+    # window immediately): under sustained churn an unbounded pass re-batches
+    # the WHOLE backlog, so any stall inflates the batch into a new pow2 item
+    # bucket — a fresh solver geometry and (on first sight) an XLA compile —
+    # which stalls the loop further. The cap pins steady-state passes to a
+    # stable geometry, which is also what keeps the incremental delta
+    # re-solve path's resident verdict tensor reusable across solves.
+    batch_max_pods: int = 0
 
     @classmethod
     def from_config_map(cls, data: Dict[str, str]) -> "Settings":
@@ -38,6 +47,10 @@ class Settings:
             if raw not in ("true", "false"):
                 raise ValueError(f"featureGates.driftEnabled: not a boolean: {raw!r}")
             s.drift_enabled = raw == "true"
+        if "batchMaxPods" in data:
+            s.batch_max_pods = int(data["batchMaxPods"])
+        if s.batch_max_pods < 0:
+            raise ValueError("batchMaxPods cannot be negative")
         if s.batch_max_duration <= 0:
             raise ValueError("batchMaxDuration cannot be negative")
         if s.batch_idle_duration <= 0:

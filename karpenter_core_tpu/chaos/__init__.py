@@ -64,6 +64,11 @@ CLOUDPROVIDER_CREATE = "cloudprovider.create"
 SOLVER_RPC = "solver.rpc"
 SOLVER_DEVICE = "solver.device"
 STATE_WATCH = "state.watch"
+# the state-store delta feed the incremental solve path gates on
+# (state.Cluster.changes_since): an injected fault models dropped or
+# duplicated deltas, and the contract is that the consumer DEGRADES to a
+# full re-encode instead of trusting a feed that may have lied
+STATE_DIFF = "state.diff"
 
 KNOWN_POINTS = (
     KUBE_TRANSPORT,
@@ -71,6 +76,7 @@ KNOWN_POINTS = (
     SOLVER_RPC,
     SOLVER_DEVICE,
     STATE_WATCH,
+    STATE_DIFF,
 )
 
 

@@ -55,6 +55,18 @@ class Batcher:
                     closed_by=closed, triggers=folded,
                 )
                 return True
-            if self._trigger.wait(timeout=poll):
+            # the trigger wait is CAPPED at the time remaining to the
+            # nearer of the two close bounds (floored at 0 so a fake
+            # clock that jumped past a deadline still re-checks
+            # immediately): a nonstop trigger stream returns from the
+            # wait instantly over and over, and an uncapped poll quantum
+            # both overshot the max bound by up to `poll` per window and
+            # burned a busy-spin between triggers. The max deadline is a
+            # hard cap — continuous triggers extend `last`, never `start`.
+            remaining = min(
+                settings.batch_max_duration - (now - start),
+                settings.batch_idle_duration - (now - last),
+            )
+            if self._trigger.wait(timeout=max(min(poll, remaining), 0.0)):
                 self._trigger.clear()
                 last = self.clock()

@@ -12,6 +12,8 @@ from __future__ import annotations
 import concurrent.futures
 import copy
 import threading
+import time
+from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -45,6 +47,20 @@ LAUNCH_RESOLVE_RETRIGGERS = REGISTRY.counter(
     " re-solve against an ICE-masked universe instead of spinning on the"
     " offering the cloud just rejected",
 )
+# the soak SLOs (hack/soak.py) read these from real exposition, not
+# bench-side timing: admission -> bind is the pod-visible provisioning
+# latency (pod creation to capacity decision — a machine launched for it
+# or an existing node nominated), pending_pods the batch-queue depth each
+# reconcile observed
+ADMISSION_TO_BIND = REGISTRY.histogram(
+    f"{NAMESPACE}_admission_to_bind_seconds",
+    "Pod admission (creationTimestamp) to bind decision (machine launched /"
+    " existing node nominated) latency, observed by the provisioning loop",
+)
+PENDING_PODS = REGISTRY.gauge(
+    f"{NAMESPACE}_pending_pods",
+    "Provisionable pending pods the last provisioning pass batched",
+)
 
 
 @dataclass
@@ -64,11 +80,15 @@ class ProvisioningController:
         recorder=None,
         solver=None,
         fallback_solver=None,
+        clock=time.time,
     ):
         self.kube_client = kube_client
         self.cloud_provider = cloud_provider
         self.cluster = cluster
         self.recorder = recorder
+        # wall clock, compared against pod creationTimestamps for the
+        # admission->bind histogram (same convention as state.Cluster)
+        self.clock = clock
         self.solver = solver or GreedySolver()
         self.fallback_solver = fallback_solver or GreedySolver()
         self.batcher = Batcher()
@@ -87,6 +107,21 @@ class ProvisioningController:
         # (provisioners, instance_types) the LAST solve saw — the failure-
         # explanation probe reads them so it never races provisioner churn
         self._last_solve_inputs: Tuple[list, dict] = ([], {})
+        # bind feed: callables(pod, node_name) invoked at each capacity
+        # decision (machine launched / existing node nominated). The soak
+        # driver registers here to play kubelet/kube-scheduler — recorder
+        # nomination events are deduped + rate-limited, so they cannot
+        # serve as a faithful binding feed. Best-effort: a listener fault
+        # never breaks the reconcile that fed it.
+        self.bind_listeners: List = []
+        # admission->bind observes each pod ONCE, at its FIRST capacity
+        # decision: a nominated-but-not-yet-bound pod re-enters every batch
+        # window until the external scheduler binds it, and re-observing it
+        # would turn the SLO histogram into a re-nomination-streak counter
+        # (bounded LRU of pod uids; uid, not name — a delete+recreate is a
+        # new admission)
+        self._admission_observed: OrderedDict = OrderedDict()
+        self.MAX_ADMISSION_OBSERVED = 8192
 
     # -- reconcile loop ----------------------------------------------------
 
@@ -118,6 +153,19 @@ class ProvisioningController:
                 result.new_machines, LaunchOptions(record_pod_nomination=True)
             )
         created = sum(1 for n in names if n)
+        # admission->bind SLO: a pod is "bound" when the loop made its
+        # capacity decision — its machine launched, or (below) an existing
+        # node was nominated for it
+        now = self.clock()
+        for machine, name in zip(result.new_machines, names):
+            if name:
+                for pod in machine.pods:
+                    self._observe_bind(pod, now)
+                    self._notify_bind(pod, name)
+        for state_node, pods in result.existing_assignments:
+            for pod in pods:
+                self._observe_bind(pod, now)
+                self._notify_bind(pod, state_node.name())
         if created or errors or result.failed_pods:
             LOG.info(
                 "provisioning pass",
@@ -237,6 +285,27 @@ class ProvisioningController:
                 reasons[pod.metadata.uid] = err_msg
         return reasons
 
+    def _observe_bind(self, pod: Pod, now: float) -> None:
+        uid = pod.metadata.uid or (pod.metadata.namespace, pod.metadata.name)
+        if uid in self._admission_observed:
+            return
+        self._admission_observed[uid] = True
+        while len(self._admission_observed) > self.MAX_ADMISSION_OBSERVED:
+            self._admission_observed.popitem(last=False)
+        ts = getattr(pod.metadata, "creation_timestamp", None)
+        if ts:
+            ADMISSION_TO_BIND.observe(max(now - ts, 0.0))
+
+    def _notify_bind(self, pod: Pod, node_name: str) -> None:
+        for listener in self.bind_listeners:
+            try:
+                listener(pod, node_name)
+            except Exception:  # noqa: BLE001 — listeners are best-effort
+                LOG.warning(
+                    "bind listener failed", pod=pod.metadata.name,
+                    node=node_name,
+                )
+
     def trigger(self) -> None:
         self.batcher.trigger()
 
@@ -313,8 +382,32 @@ class ProvisioningController:
                         reschedule = copy.deepcopy(pod)
                         reschedule.spec.node_name = ""
                         pending.append(reschedule)
+        PENDING_PODS.set(float(len(pending)))
         if not pending:
             return None
+        from karpenter_core_tpu.api.settings import current
+
+        settings = self.batcher.settings or current()
+        if settings.batch_max_pods and len(pending) > settings.batch_max_pods:
+            # bounded pass: solve the OLDEST cap-sized slice and hand the
+            # remainder straight to the next window (re-trigger now, not
+            # after the idle timeout) — see Settings.batch_max_pods for why
+            # an unbounded backlog re-batch compounds its own stall. The
+            # re-trigger fires only when the deferred slice holds pods that
+            # never got a capacity decision: nominated-but-unbound pods
+            # re-enter pending until the external scheduler binds them, and
+            # spinning back-to-back passes on ONLY those would re-solve the
+            # same decided set forever against a slow/down scheduler.
+            pending.sort(key=lambda p: p.metadata.creation_timestamp or 0.0)
+            deferred = pending[settings.batch_max_pods:]
+            pending = pending[: settings.batch_max_pods]
+            LOG.info("batch capped", solving=len(pending), deferred=len(deferred))
+            if any(
+                (p.metadata.uid or (p.metadata.namespace, p.metadata.name))
+                not in self._admission_observed
+                for p in deferred
+            ):
+                self.batcher.trigger()
         from karpenter_core_tpu.api.provisioner import order_by_weight
 
         provisioners = order_by_weight(

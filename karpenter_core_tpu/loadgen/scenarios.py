@@ -1,0 +1,127 @@
+"""Scenario pod builders over a BOUNDED vocabulary.
+
+The solver's compiled-program key embeds the label dictionary and the
+pow2-bucketed axis widths, and the incremental delta re-solve only replays
+when consecutive solves land on the SAME key — so churn pods draw every
+label key/value, request size, and constraint shape from small fixed pools.
+A generator that minted fresh label values per pod would make every batch a
+full re-encode (and a recompile), which is a different benchmark.
+
+Scenario families mirror the fuzz geometries the parity suites cover
+(tests/test_differential_fuzz*.py):
+
+  generic  independent pods: app label + stepped cpu/memory requests
+  bulk     one deployment-shaped replica group (shared class: exercises
+           encode's class dedup + the pack kernel's bulk commits)
+  spread   hostname topology spread over a shared app (skew counters)
+  anti     required hostname anti-affinity on a dedicated app pool (the
+           per-pod item expansion path)
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List
+
+import numpy as np
+
+from karpenter_core_tpu.kube.objects import (
+    LabelSelector,
+    PodAffinityTerm,
+    TopologySpreadConstraint,
+)
+from karpenter_core_tpu.testing import make_pod
+
+HOSTNAME_KEY = "kubernetes.io/hostname"
+
+APPS = tuple(f"churn-app-{i}" for i in range(8))
+# ONE spread pool and ONE anti pool, not several: every distinct multiset
+# of topology/anti-affinity groups in a batch is a STATIC parameter of the
+# compiled pack kernel (the geometry key's topology signature), so pools
+# multiply the program population combinatorially — the bounded-vocabulary
+# rule applies to constraint GROUPS exactly as it does to label values
+SPREAD_APPS = ("churn-spread-0",)
+ANTI_APPS = ("churn-anti-0",)
+CPU_STEPS = (0.25, 0.5, 1.0, 1.5)
+MEM_STEPS = ("256Mi", "512Mi", "1Gi")
+
+
+class ScenarioMixer:
+    """Builds scenario pods deterministically from a seeded rng; pod names
+    are unique per mixer instance (one mixer per soak run)."""
+
+    def __init__(self, rng: np.random.Generator):
+        self.rng = rng
+        self._n = 0
+
+    def _name(self, scenario: str) -> str:
+        self._n += 1
+        return f"{scenario}-{self._n}"
+
+    def _requests(self) -> Dict[str, str]:
+        return {
+            "cpu": str(CPU_STEPS[int(self.rng.integers(len(CPU_STEPS)))]),
+            "memory": MEM_STEPS[int(self.rng.integers(len(MEM_STEPS)))],
+        }
+
+    def generic(self, count: int) -> List:
+        return [
+            make_pod(
+                name=self._name("generic"),
+                labels={"app": APPS[int(self.rng.integers(len(APPS)))]},
+                requests=self._requests(),
+            )
+            for _ in range(count)
+        ]
+
+    def bulk(self, count: int) -> List:
+        app = APPS[int(self.rng.integers(len(APPS)))]
+        requests = self._requests()
+        return [
+            make_pod(name=self._name("bulk"), labels={"app": app}, requests=requests)
+            for _ in range(count)
+        ]
+
+    def spread(self, count: int) -> List:
+        app = SPREAD_APPS[int(self.rng.integers(len(SPREAD_APPS)))]
+        requests = self._requests()
+        constraint = TopologySpreadConstraint(
+            max_skew=2,
+            topology_key=HOSTNAME_KEY,
+            when_unsatisfiable="DoNotSchedule",
+            label_selector=LabelSelector(match_labels={"app": app}),
+        )
+        return [
+            make_pod(
+                name=self._name("spread"),
+                labels={"app": app},
+                requests=requests,
+                topology_spread=[constraint],
+            )
+            for _ in range(count)
+        ]
+
+    def anti(self, count: int) -> List:
+        app = ANTI_APPS[int(self.rng.integers(len(ANTI_APPS)))]
+        term = PodAffinityTerm(
+            topology_key=HOSTNAME_KEY,
+            label_selector=LabelSelector(match_labels={"app": app}),
+        )
+        return [
+            make_pod(
+                name=self._name("anti"),
+                labels={"app": app},
+                requests={"cpu": "0.5"},
+                pod_anti_affinity_required=[term],
+            )
+            for _ in range(count)
+        ]
+
+    def make(self, scenario: str, count: int) -> List:
+        return SCENARIOS[scenario](self, count)
+
+
+SCENARIOS: Dict[str, Callable[[ScenarioMixer, int], List]] = {
+    "generic": ScenarioMixer.generic,
+    "bulk": ScenarioMixer.bulk,
+    "spread": ScenarioMixer.spread,
+    "anti": ScenarioMixer.anti,
+}

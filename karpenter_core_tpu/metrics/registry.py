@@ -132,16 +132,42 @@ class Histogram:
             self.sums[lv] += value
             self.counts[lv] += 1
 
-    def percentile(self, q: float, labels: Optional[Dict[str, str]] = None) -> Optional[float]:
-        """Upper bucket bound at quantile q; values above the largest
-        finite bucket saturate to it (histogram_quantile's convention)."""
+    def snapshot(self, labels: Optional[Dict[str, str]] = None):
+        """(cumulative bucket counts, count, sum) at this instant — pass a
+        snapshot back into percentile()/count_since() as `baseline` to read
+        the distribution of ONLY the observations made since (counters are
+        process-cumulative; SLO windows like the soak bench are not)."""
         lv = _labels(labels)
         with self._mu:
+            return (
+                list(self.bucket_counts.get(lv, ())),
+                self.counts[lv],
+                self.sums[lv],
+            )
+
+    def count_since(self, baseline=None, labels: Optional[Dict[str, str]] = None) -> int:
+        lv = _labels(labels)
+        with self._mu:
+            return self.counts[lv] - (baseline[1] if baseline else 0)
+
+    def percentile(self, q: float, labels: Optional[Dict[str, str]] = None,
+                   baseline=None) -> Optional[float]:
+        """Upper bucket bound at quantile q; values above the largest
+        finite bucket saturate to it (histogram_quantile's convention).
+        With `baseline` (a prior snapshot()), quantiles cover only the
+        observations recorded after the snapshot."""
+        lv = _labels(labels)
+        base_counts, base_total = (
+            (baseline[0], baseline[1]) if baseline else ((), 0)
+        )
+        with self._mu:
             counts = self.bucket_counts.get(lv)
-            if not counts or self.counts[lv] == 0:
+            total = self.counts[lv] - base_total
+            if not counts or total <= 0:
                 return None
-            target = q * self.counts[lv]
-            for bucket, c in zip(self.buckets, counts):
+            target = q * total
+            for i, (bucket, c) in enumerate(zip(self.buckets, counts)):
+                c -= base_counts[i] if i < len(base_counts) else 0
                 if c >= target:
                     return bucket
             return self.buckets[-1]

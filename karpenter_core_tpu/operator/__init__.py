@@ -383,7 +383,8 @@ def new_operator(
     eviction_queue = EvictionQueue(kube_client, recorder)
     terminator = Terminator(kube_client, cp_machine, eviction_queue, clock=clock)
     provisioning = ProvisioningController(
-        kube_client, cp_provisioning, cluster, recorder=recorder, solver=solver
+        kube_client, cp_provisioning, cluster, recorder=recorder, solver=solver,
+        clock=clock,
     )
     from karpenter_core_tpu.controllers.deprovisioning.controller import (
         DeprovisioningController,

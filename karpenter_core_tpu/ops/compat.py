@@ -61,6 +61,26 @@ def resolve_screen_mode() -> str:
     return "prescreen"
 
 
+def resolve_incremental_mode() -> str:
+    """Pick the incremental (delta re-solve) screen policy.
+
+    'on' (the 'auto' default): under the prescreen screen mode, consecutive
+    solves at one geometry keep the verdict tensor resident and replay only
+    the changed existing-slot rows / verdict columns through the delta
+    refresh program (solver/incremental.py); the full precompute stays the
+    fallback for wide deltas, geometry changes, and state-diff-feed faults.
+    'off': always run the full precompute. KCT_INCREMENTAL ∈ {auto, on,
+    off}. Unlike the screen mode this is a DISPATCH policy, not a trace
+    branch — both paths produce bit-identical tensors, so no compiled
+    program keys on it."""
+    from karpenter_core_tpu.obs import envflags
+
+    mode = envflags.raw("KCT_INCREMENTAL", "auto").strip().lower()
+    if mode in ("on", "off"):
+        return mode
+    return "on"
+
+
 def seg_matrix(segments: Segments, V: int):
     """Static [V, K] one-hot membership matrix: column k marks the values of
     key k. Turns every per-key any-reduction into ONE bf16 matmul on the MXU

@@ -229,6 +229,79 @@ def make_prescreen_kernel(segments, n_slots, backend=None, screen_v=None):
     return prescreen
 
 
+def make_screen_refresh_kernel(segments, n_slots, rb: int, cb: int,
+                               backend=None, screen_v=None):
+    """Delta refresh of a RESIDENT [N, C] verdict tensor — the incremental
+    re-solve path's device program (solver/incremental.py).
+
+    verdict[n, c] is a pure function of (slot row n's planes, class column
+    c's planes), so a steady-state solve whose geometry matches the
+    previous one only needs to recompute the rows whose existing-slot
+    planes changed (narrowed / freed / replaced slots) and the columns
+    whose class planes changed (new or relaxed items); everything else
+    carries over bit-for-bit. rb/cb are the padded row/column delta
+    budgets (the compiled signature); indices beyond the live count are
+    dropped via OOB-scatter semantics. Device cost is O(rb*C + E*cb)
+    contractions instead of the full O(N*C) precompute — it scales with
+    the CHURN, not the world.
+
+    Row updates re-screen a changed slot row against ALL columns; column
+    updates recompute the full column (pairwise block over the existing
+    prefix + the virgin-row value broadcast over the machine region, the
+    exact construction initial_screen uses). Overlapping (row, col) cells
+    are written twice with the same value, so update order is immaterial.
+    Semantics are bool-exact vs make_prescreen_kernel: both evaluate the
+    same 0/1 indicator algebra through the same screen ops."""
+    backend = backend or compat.resolve_backend()
+    ops = make_screen_ops(list(segments), backend, screen_v)
+
+    def refresh(prev_screen, pod_arrays, exist, row_idx, row_n, col_idx,
+                col_n):
+        sf = pod_arrays.get("scls_first")
+        items = {
+            k: (pod_arrays[k] if sf is None else pod_arrays[k][sf])
+            for k in ("allow", "out", "defined", "escape", "custom_deny")
+        }
+        C = items["allow"].shape[0]
+        V = items["allow"].shape[1]
+        K = items["out"].shape[1]
+        E = exist["allow"].shape[0]
+        N = n_slots
+        scr = prev_screen
+        if rb and E:
+            # changed existing rows x ALL columns
+            gi = jnp.clip(row_idx, 0, max(E - 1, 0))
+            row_block = ops.rows_vs_items(
+                items, exist["allow"][gi], exist["out"][gi],
+                exist["defined"][gi],
+            )  # [rb, C]
+            on_r = jnp.arange(rb) < row_n
+            target = jnp.where(on_r, row_idx, N)  # OOB rows drop
+            scr = scr.at[target].set(row_block, mode="drop")
+        if cb:
+            # changed columns x ALL rows: existing block + virgin tail
+            gc = jnp.clip(col_idx, 0, max(C - 1, 0))
+            col_items = {k: v[gc] for k, v in items.items()}
+            blk = ops.rows_vs_items(
+                col_items, exist["allow"], exist["out"], exist["defined"]
+            )  # [E, cb]
+            virgin = ops.items_vs_row(
+                col_items,
+                jnp.ones(V, dtype=bool),
+                jnp.ones(K, dtype=bool),
+                jnp.zeros(K, dtype=bool),
+            )  # [cb]
+            full_col = jnp.concatenate(
+                [blk, jnp.broadcast_to(virgin[None, :], (N - E, cb))], axis=0
+            )  # [N, cb]
+            on_c = jnp.arange(cb) < col_n
+            tcol = jnp.where(on_c, col_idx, C)  # OOB columns drop
+            scr = scr.at[:, tcol].set(full_col, mode="drop")
+        return scr
+
+    return refresh
+
+
 def make_pack_kernel(
     segments,
     zone_seg,

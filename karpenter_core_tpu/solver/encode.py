@@ -207,19 +207,6 @@ class LabelDictionary:
         if value not in vals:
             vals[value] = len(vals)
 
-    def move_key_last(self, key: str) -> None:
-        """Reorder so `key`'s segment sits at the END of the flat value
-        axis (call before freeze). The packing screens slice the hostname
-        segment — roughly half of V on a real cluster (one value per
-        existing node + pad) — off their matmuls when no pod constrains
-        hostname; that only works on a contiguous tail."""
-        k = self.key_index.get(key)
-        if k is None or k == len(self.keys) - 1:
-            return
-        self.keys.append(self.keys.pop(k))
-        self._values.append(self._values.pop(k))
-        self.key_index = {name: i for i, name in enumerate(self.keys)}
-
     def freeze(self) -> None:
         """Assign flat offsets."""
         self.offsets = np.zeros(len(self.keys) + 1, dtype=np.int32)
@@ -246,9 +233,95 @@ class LabelDictionary:
             return []
         return [v for v, _ in sorted(self._values[k].items(), key=lambda kv: kv[1])]
 
+    def canonicalize(self, last_key: Optional[str] = None) -> None:
+        """Sort keys and values (with `last_key`'s segment forced last) and
+        freeze. Insertion order is batch-dependent — whichever pod mentioned
+        a value first — and value order is load-bearing: domain tie-breaks
+        resolve by flat index, so two encodes of the SAME vocabulary in
+        different orders pack differently. Canonical order makes the
+        dictionary a pure function of its content: batches with equal
+        vocabularies share a geometry key (and a compiled program), and
+        cross-solve dictionary carryover can never smuggle one batch's
+        insertion history into another's placements."""
+        order = sorted(self.keys)
+        if last_key is not None and last_key in order:
+            order.remove(last_key)
+            order.append(last_key)
+        self._values = [
+            {v: i for i, v in enumerate(sorted(self._values[self.key_index[key]]))}
+            for key in order
+        ]
+        self.keys = order
+        self.key_index = {name: i for i, name in enumerate(order)}
+        self.freeze()
+
     def segment(self, key: str) -> Tuple[int, int]:
         k = self.key_index[key]
         return int(self.offsets[k]), int(self.offsets[k + 1])
+
+
+def dictionary_covers(carrier: LabelDictionary, fresh: LabelDictionary) -> bool:
+    """True when `carrier` (a previous batch's frozen dictionary) can encode
+    everything `fresh` (this batch's closure) mentions: every key and value
+    already mapped, the hostname segment still last (the screens' tail-
+    elision contract), and the carrier not bloated past twice the live
+    vocabulary — extra values behave exactly like pad values, but unbounded
+    staleness (hostnames of long-replaced nodes) would grow V forever."""
+    if carrier.V > max(2 * fresh.V, fresh.V + 32):
+        return False
+    if LABEL_HOSTNAME in carrier.key_index:
+        lo, hi = carrier.segment(LABEL_HOSTNAME)
+        if hi != carrier.V:
+            return False
+    for key in fresh.keys:
+        k = carrier.key_index.get(key)
+        if k is None:
+            return False
+        have = carrier._values[k]
+        for value in fresh._values[fresh.key_index[key]]:
+            if value not in have:
+                return False
+    return True
+
+
+def dictionary_rebind_hostnames(carrier: LabelDictionary,
+                                fresh: LabelDictionary) -> bool:
+    """Second-chance adoption for a growing cluster: when the ONLY values
+    `carrier` is missing are hostnames (a machine launched, a node was
+    replaced), rebind them onto hostname-segment entries `fresh` no longer
+    references — pad sentinels and hostnames of departed nodes. A value
+    index is just a column; renaming an unused one changes plane CONTENT,
+    never V/K/segments, so the compiled program (and the incremental
+    path's resident tensor, guarded by its plane fingerprints) survives
+    node churn instead of being re-minted per launch. Mutates `carrier` in
+    place on success; False leaves it untouched (caller rebuilds fresh)."""
+    k_host = carrier.key_index.get(LABEL_HOSTNAME)
+    if k_host is None:
+        return False
+    lo, hi = carrier.segment(LABEL_HOSTNAME)
+    if hi != carrier.V:
+        return False  # tail-elision contract: hostname segment stays last
+    missing = []
+    for key in fresh.keys:
+        k = carrier.key_index.get(key)
+        if k is None:
+            return False
+        have = carrier._values[k]
+        for value in fresh._values[fresh.key_index[key]]:
+            if value not in have:
+                if key != LABEL_HOSTNAME:
+                    return False
+                missing.append(value)
+    if not missing:
+        return True  # plain coverage (caller usually checked already)
+    host_vals = carrier._values[k_host]
+    fresh_hosts = fresh._values[fresh.key_index[LABEL_HOSTNAME]]
+    rebindable = [v for v in host_vals if v not in fresh_hosts]
+    if len(missing) > len(rebindable):
+        return False
+    for value, stale in zip(missing, rebindable):
+        host_vals[value] = host_vals.pop(stale)
+    return True
 
 
 @dataclass
@@ -547,6 +620,7 @@ def encode_snapshot(
     max_nodes: int = 1024,
     reuse_dictionary: Optional[LabelDictionary] = None,
     reuse: Optional[EncodeReuse] = None,
+    carry_dictionary: Optional[LabelDictionary] = None,
 ) -> EncodedSnapshot:
     """Lower a provisioning snapshot to tensors.
 
@@ -561,6 +635,18 @@ def encode_snapshot(
     reuse: an EncodeReuse carried across solves; stable instance-type
     planes are reused instead of re-encoded when types, dictionary content,
     and resource names all match the previous batch.
+
+    carry_dictionary: the PREVIOUS solve's dictionary, offered across
+    batches (steady-state churn, ISSUE 6). Unlike reuse_dictionary it is
+    not trusted: the fresh closure is built first and the carrier is
+    adopted only when it COVERS it (every fresh key/value already mapped —
+    a superset dictionary is always valid) and hasn't bloated past twice
+    the live vocabulary (stale hostnames from replaced nodes accumulate;
+    past the bound a rebuild re-compacts). Adoption keeps V/K/segments —
+    and with them the compiled-program key and the incremental path's
+    resident verdict tensor — identical across consecutive churn batches
+    whose vocabulary has saturated; any unseen value falls back to the
+    fresh build, which becomes the next carrier.
     """
     from karpenter_core_tpu.api.provisioner import order_by_weight
 
@@ -691,10 +777,22 @@ def encode_snapshot(
         if E_real:
             for i in range(E_real, E_pad):
                 dictionary.add_value(LABEL_HOSTNAME, f"__exist-pad-{i}")
-        # hostname's (large) segment goes LAST so the screens can slice it
-        # off when no pod constrains hostname
-        dictionary.move_key_last(LABEL_HOSTNAME)
-        dictionary.freeze()
+        # canonical order (sorted keys/values — placements must be a pure
+        # function of the vocabulary SET, not of which pod mentioned a
+        # value first), with hostname's (large) segment LAST so the
+        # screens can slice it off when no pod constrains hostname
+        dictionary.canonicalize(last_key=LABEL_HOSTNAME)
+        if carry_dictionary is not None and (
+            dictionary_covers(carry_dictionary, dictionary)
+            or (
+                # same size-bloat bound as plain coverage, then try
+                # rebinding new node hostnames onto unused pad/stale
+                # entries (growing cluster inside one existing bucket)
+                carry_dictionary.V <= max(2 * dictionary.V, dictionary.V + 32)
+                and dictionary_rebind_hostnames(carry_dictionary, dictionary)
+            )
+        ):
+            dictionary = carry_dictionary
 
     # -- resources ---------------------------------------------------------
     extended = sorted(

@@ -1,0 +1,252 @@
+"""Incremental re-solve bookkeeping: the host half of the delta path.
+
+ROADMAP open item 2: production is a churn stream, not one-shot Solve()
+calls, and consecutive steady-state solves see nearly the same world —
+the same instance-type universe (already reused via encode.EncodeReuse),
+nearly the same existing nodes (a few freed / narrowed by bindings and
+terminations since the last batch), and a batch of mostly-new items. The
+prescreen verdict tensor (PR 5) is the expensive device precompute whose
+inputs factor EXACTLY along that delta: verdict[n, c] depends only on
+(slot row n's planes, class column c's planes). This module computes the
+delta between the previous solve's planes and the current ones, and
+decides whether replaying it through ops.pack.make_screen_refresh_kernel
+beats recomputing the tensor from scratch.
+
+Two layers guard correctness:
+
+  * the STATE-DIFF GATE (state.Cluster.changes_since, chaos fault point
+    `state.diff`): a feed fault or history gap forces the full path for
+    one solve and drops the resident tensor — the subsystem degrades to
+    full re-encode instead of trusting a feed that may have dropped or
+    duplicated deltas;
+  * PLANE FINGERPRINTS: the actual delta is computed by comparing the
+    previous and current encoded planes byte-for-byte (bit-packed rows),
+    never inferred from the feed. The feed can only ever make the path
+    MORE conservative; it can never cause a stale verdict to survive.
+    Refreshed entries are recomputed by the same screen ops the full
+    precompute uses, so the refreshed tensor — and every placement decoded
+    downstream of it — is byte-identical to the full path's
+    (tests/test_incremental_parity.py holds the two to flightrec-canonical
+    equality over seeded churn sequences).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from karpenter_core_tpu.metrics.registry import NAMESPACE, REGISTRY
+
+INCREMENTAL_SCREEN_TOTAL = REGISTRY.counter(
+    f"{NAMESPACE}_incremental_screen_total",
+    "Prescreen dispatch decisions on the incremental solve path, by outcome"
+    " (refresh = delta replay, full_* = full precompute with the reason)",
+)
+
+# delta budgets: a refresh only wins while the changed row/column sets are
+# small relative to the tensor; beyond these the full precompute is
+# dispatched (and re-fingerprinted). Budgets are also the compiled-program
+# signature, bucketed pow2 so steady-state churn reuses one refresh program.
+MAX_ROW_DELTA = 128
+MAX_COL_DELTA = 128
+
+_COL_KEYS = ("allow", "out", "defined", "escape", "custom_deny")
+
+
+def _pack_rows(arr: np.ndarray) -> np.ndarray:
+    """Row-wise bit-packed fingerprint of a bool plane ([B, W] -> [B, ~W/8])."""
+    return np.packbits(np.ascontiguousarray(arr), axis=1)
+
+
+def exist_fingerprint(exist: Dict[str, np.ndarray]) -> Tuple[np.ndarray, ...]:
+    """Per-slot-row fingerprint of the existing planes. escape is derived
+    in-kernel from allow/out/defined, so those three determine the row."""
+    return tuple(_pack_rows(exist[k]) for k in ("allow", "out", "defined"))
+
+
+def col_fingerprint(pod_arrays: Dict[str, np.ndarray]) -> Tuple[np.ndarray, ...]:
+    """Per-verdict-column fingerprint: the class planes each column screens
+    with, gathered exactly the way the prescreen kernel gathers them."""
+    sf = pod_arrays["scls_first"]
+    return tuple(_pack_rows(pod_arrays[k][sf]) for k in _COL_KEYS)
+
+
+def _changed_rows(old: Tuple[np.ndarray, ...], new: Tuple[np.ndarray, ...]):
+    """Indices whose fingerprint rows differ, or None on any shape drift
+    (shouldn't happen under a matched geometry key — full path then)."""
+    changed = None
+    for o, n in zip(old, new):
+        if o.shape != n.shape:
+            return None
+        d = (o != n).any(axis=1)
+        changed = d if changed is None else (changed | d)
+    return np.nonzero(changed)[0].astype(np.int32) if changed is not None else None
+
+
+@dataclass
+class ScreenDelta:
+    """A refresh plan: changed existing-row / verdict-column indices plus
+    the padded budgets the compiled refresh program is specialized on."""
+
+    rows: np.ndarray
+    cols: np.ndarray
+    rb: int
+    cb: int
+
+    def padded(self) -> Tuple[np.ndarray, int, np.ndarray, int]:
+        row_idx = np.zeros(self.rb, np.int32)
+        row_idx[: len(self.rows)] = self.rows
+        col_idx = np.zeros(self.cb, np.int32)
+        col_idx[: len(self.cols)] = self.cols
+        return row_idx, len(self.rows), col_idx, len(self.cols)
+
+
+class IncrementalScreen:
+    """Per-solver carrier of the resident verdict tensor + fingerprints,
+    keyed by the solver's compiled-program cache key (which embeds the full
+    geometry: every axis width the tensor's shape and contents depend on).
+
+    Not thread-safe by design: TPUSolver serializes its own solves (the
+    pipelined production loop overlaps ENCODE with the device window, not
+    two device solves), and each solver owns one carrier."""
+
+    def __init__(self):
+        self._key = None
+        self._screen_dev = None  # device [N, C] verdict tensor
+        self._exist_fp = None
+        self._col_fp = None
+        # fingerprints computed by plan() but committed only by adopt():
+        # a solve that dies between the two must leave the carrier's
+        # (tensor, fingerprints) pair consistent, else the NEXT delta
+        # would refresh a tensor older than the planes it diffs against
+        self._pending = None
+
+    # -- planning ----------------------------------------------------------
+
+    def plan(self, key, pod_arrays, exist,
+             gate_ok: bool = True) -> Optional[ScreenDelta]:
+        """Decide refresh vs full for this solve. Returns a ScreenDelta to
+        replay, or None (caller dispatches the full precompute). Either
+        way the caller hands the resulting tensor to adopt(), which is
+        what commits this plan's fingerprints."""
+        from karpenter_core_tpu.solver.encode import bucket_pow2
+
+        new_exist_fp = exist_fingerprint(exist)
+        new_col_fp = col_fingerprint(pod_arrays)
+        outcome = None
+        delta = None
+        resident = self._key == key and self._screen_dev is not None
+        if not gate_ok:
+            # full_gated only when the gate actually DROPPED live residency;
+            # a bad feed verdict with nothing resident is just a miss
+            outcome = "full_gated" if resident else "full_miss"
+            self.invalidate()
+        elif not resident:
+            outcome = "full_miss"
+        else:
+            rows = _changed_rows(self._exist_fp, new_exist_fp)
+            cols = _changed_rows(self._col_fp, new_col_fp)
+            if rows is None or cols is None:
+                outcome = "full_shape"
+            elif len(rows) > MAX_ROW_DELTA or len(cols) > MAX_COL_DELTA:
+                outcome = "full_wide"
+            else:
+                E = exist["allow"].shape[0]
+                C = pod_arrays["scls_first"].shape[0]
+                delta = ScreenDelta(
+                    rows=rows,
+                    cols=cols,
+                    # budgets bucket pow2 (min 8) and never exceed the
+                    # axis; an EMPTY side is budget 0 — the refresh kernel
+                    # statically omits that whole half, which is what keeps
+                    # a row-only (or col-only) delta cheaper than the full
+                    # precompute at small geometries
+                    rb=(0 if len(rows) == 0
+                        else min(bucket_pow2(len(rows), 8), max(E, 1))),
+                    cb=(0 if len(cols) == 0
+                        else min(bucket_pow2(len(cols), 8), max(C, 1))),
+                )
+                outcome = "refresh"
+        if outcome != "refresh":
+            # a planned refresh is NOT yet a refresh: the dispatch can still
+            # fail and degrade to the full precompute, and the soak health
+            # gate / resolve-ratio read this counter — so the caller counts
+            # `refresh` on dispatch SUCCESS (count_refresh) and `full_deg`
+            # on failure (count_degraded), never the plan
+            INCREMENTAL_SCREEN_TOTAL.inc({"outcome": outcome})
+        self._pending = (key, new_exist_fp, new_col_fp)
+        return delta
+
+    @staticmethod
+    def count_refresh() -> None:
+        INCREMENTAL_SCREEN_TOTAL.inc({"outcome": "refresh"})
+
+    @staticmethod
+    def count_degraded() -> None:
+        INCREMENTAL_SCREEN_TOTAL.inc({"outcome": "full_deg"})
+
+    # -- tensor residency --------------------------------------------------
+
+    def adopt(self, key, screen_dev) -> None:
+        """Adopt this solve's verdict tensor (full-precompute output or
+        refresh output) as the resident one, committing the matching
+        fingerprints staged by plan()."""
+        pend = self._pending
+        if pend is None or pend[0] != key:
+            # adopt without a matching plan (incremental re-enabled
+            # mid-run): no fingerprints to pair — drop residency
+            self.invalidate()
+            return
+        self._key, self._exist_fp, self._col_fp = pend
+        self._screen_dev = screen_dev
+        self._pending = None
+
+    def resident(self, key):
+        return self._screen_dev if self._key == key else None
+
+    def drop_resident(self) -> None:
+        """Drop the resident tensor + fingerprints but KEEP the fingerprints
+        staged by this solve's plan(): the refresh-dispatch failure path —
+        the donated previous tensor may be gone, but the fallback full
+        precompute is computed from exactly the planes plan() fingerprinted,
+        so it can still adopt and the NEXT solve refreshes instead of
+        paying a second full_miss."""
+        self._key = None
+        self._screen_dev = None
+        self._exist_fp = None
+        self._col_fp = None
+
+    def invalidate(self) -> None:
+        """Drop the resident tensor AND fingerprints — the degrade path
+        (state-diff fault, refresh dispatch failure, geometry eviction)."""
+        self._key = None
+        self._screen_dev = None
+        self._exist_fp = None
+        self._col_fp = None
+        self._pending = None
+
+
+class DiffGate:
+    """Consumes state.Cluster.changes_since between solves. gate() is True
+    when the feed proves continuous history since the previous consult; a
+    feed fault (chaos `state.diff`) or history gap returns False — and the
+    caller must invalidate its resident state, not just skip one reuse."""
+
+    def __init__(self):
+        self._cursor: Optional[int] = None
+
+    def gate(self, cluster) -> bool:
+        if cluster is None or not hasattr(cluster, "changes_since"):
+            # no feed in scope (direct solver use, gRPC boundary): plane
+            # fingerprints alone are exact — reuse stays allowed
+            return True
+        try:
+            cursor, changed = cluster.changes_since(self._cursor)
+        except Exception:
+            # injected/real feed fault: degrade to the full path and
+            # restart history from scratch
+            self._cursor = None
+            return False
+        self._cursor = cursor
+        return changed is not None

@@ -606,7 +606,8 @@ class TPUSolver:
                  max_relax_rounds: int = DEFAULT_MAX_RELAX_ROUNDS,
                  donate: bool = True, backend: Optional[str] = None,
                  profile_phases: bool = False,
-                 screen_mode: Optional[str] = None):
+                 screen_mode: Optional[str] = None,
+                 incremental: Optional[str] = None):
         self.max_nodes = max_nodes
         self.max_relax_rounds = max_relax_rounds
         self.donate = donate
@@ -615,6 +616,11 @@ class TPUSolver:
         # 'prescreen' = batched class×slot verdict precompute + in-scan
         # incremental refresh, 'tiered' = the per-step full screen fallback
         self.screen_mode = screen_mode
+        # delta re-solve policy override (compat.resolve_incremental_mode):
+        # 'on' keeps the verdict tensor resident across solves and replays
+        # only the state-store delta through the refresh program; 'off'
+        # always runs the full precompute
+        self.incremental = incremental
         # opt-in: barrier after upload so last_phase_ms attributes transfer
         # time separately (costs cold solves the serialized upload)
         self.profile_phases = profile_phases
@@ -633,6 +639,27 @@ class TPUSolver:
         from karpenter_core_tpu.solver.encode import EncodeReuse
 
         self._encode_reuse = EncodeReuse()
+        # incremental re-solve: resident verdict tensor + plane fingerprints
+        # and the state-diff gate (solver/incremental.py); refresh programs
+        # cache per (solve key, row budget, col budget) and are evicted with
+        # their solve entry
+        from karpenter_core_tpu.solver.incremental import DiffGate
+
+        # one residency carrier PER solve key (steady-state churn alternates
+        # among a handful of geometries — topology-signature variants of one
+        # dictionary — and a single carrier would evict on every flip)
+        self._inc_screens = OrderedDict()
+        self.MAX_INC_SCREENS = 8
+        self._diff_gate = DiffGate()
+        self.MAX_REFRESH = 8
+        self._refresh_compiled = OrderedDict()
+        self._gate_ok = True
+        self.last_prescreen_mode = None
+        # cross-solve dictionary carryover (encode.dictionary_covers):
+        # consecutive churn batches whose vocabulary has saturated adopt the
+        # previous solve's dictionary, pinning V/K/segments — and with them
+        # the compiled-program key the resident verdict tensor lives under
+        self._carry_dictionary = None
 
     # -- public API --------------------------------------------------------
 
@@ -681,6 +708,12 @@ class TPUSolver:
                 raise ValueError(
                     "encoded snapshot was built from a different pod batch"
                 )
+        # state-diff gate, consulted ONCE per Solve (relax rounds see no
+        # state churn): a feed fault or history gap forces this solve's
+        # prescreen down the full path and drops the resident tensor —
+        # degrade, never drift (chaos fault point state.diff)
+        if self._inc_enabled():
+            self._gate_ok = self._diff_gate.gate(cluster)
         # relaxation rounds reuse round 1's dictionary: dropping a preferred
         # term would shrink the value universe, change V/K, and force a
         # recompile mid-solve — a superset dictionary is always valid
@@ -708,13 +741,72 @@ class TPUSolver:
                     kube_client=kube_client, cluster=cluster, max_nodes=self.max_nodes,
                     reuse_dictionary=relax_ctx.get("dictionary") if relax_ctx else None,
                     reuse=self._encode_reuse,
+                    # offered, not trusted: adopted only when it covers this
+                    # batch's closure (steady-state churn geometry pinning)
+                    carry_dictionary=(
+                        self._carry_dictionary if self._inc_enabled() else None
+                    ),
                 )
         if relax_ctx is not None:
             relax_ctx["dictionary"] = snap.dictionary
+        self._carry_dictionary = snap.dictionary
         log, ptr, state = self._run_kernels(snap, provisioners)
         # "bind": decode slot assignments back into machines / placements
         with TRACER.span("solver.phase.bind"):
             return decode_solve(snap, (log, ptr), state)
+
+    def _inc_enabled(self, screen_mode: Optional[str] = None) -> bool:
+        """Delta re-solve policy for this solver: prescreen mode only
+        (there is no resident tensor to refresh under tiered), gated by
+        the KCT_INCREMENTAL env / constructor override."""
+        from karpenter_core_tpu.ops import compat as ops_compat
+
+        if screen_mode is None:
+            screen_mode = self.screen_mode or ops_compat.resolve_screen_mode()
+        if screen_mode != "prescreen":
+            return False
+        mode = self.incremental or ops_compat.resolve_incremental_mode()
+        return mode != "off"
+
+    def _refresh_fn(self, key, geom, rb, cb, rebuild, donated_meta):
+        """The jitted delta-refresh program for (solve key, row budget,
+        col budget), lazily compiled and LRU-bounded, plus whether this
+        call MINTED it (the dispatch that follows pays the compile — the
+        prescreen span is tagged cold so steady-state medians exclude it).
+        It reads the same uploaded bundle as the solve program (donated
+        slots rebuild as zero dummies that DCE away) and DONATES the
+        previous verdict tensor so XLA updates the resident buffer in
+        place."""
+        import jax
+        import jax.numpy as jnp
+
+        rkey = (key, rb, cb)
+        fn = self._refresh_compiled.get(rkey)
+        if fn is not None:
+            self._refresh_compiled.move_to_end(rkey)
+            return fn, False
+        from karpenter_core_tpu.ops.pack import make_screen_refresh_kernel
+
+        (_P, _J, _T, _E, _R, _K, _V, N_, segments_t, _zs, _cs, _tsig, _ll,
+         _Q, _W, _D, scr_v) = geom
+        kern = make_screen_refresh_kernel(
+            segments_t, N_, rb, cb, backend=self.backend, screen_v=scr_v
+        )
+
+        def refresh_bundled(bundle, prev_screen, row_idx, row_n, col_idx,
+                            col_n):
+            dummies = iter(jnp.zeros(s, d) for s, d in donated_meta)
+            named = dict(zip(RUN_ARG_NAMES, rebuild(bundle, dummies)))
+            return kern(
+                prev_screen, named["pod_arrays"], named["exist"],
+                row_idx, row_n, col_idx, col_n,
+            )
+
+        fn = jax.jit(refresh_bundled, donate_argnums=(1,))
+        self._refresh_compiled[rkey] = fn
+        while len(self._refresh_compiled) > self.MAX_REFRESH:
+            self._refresh_compiled.popitem(last=False)
+        return fn, True
 
     def _run_kernels(self, snap: EncodedSnapshot, provisioners: List[Provisioner]):
         import time as _time
@@ -747,6 +839,7 @@ class TPUSolver:
             screen_mode=screen_mode, external_prescreen=True,
         )
         args = device_args(snap, provisioners)
+        raw_args = args  # host numpy view (incremental plane fingerprints)
         _mark("args")
         # upload shrinkage, two layers:
         # 1. large bool planes bit-pack on the host and unpack INSIDE the
@@ -815,29 +908,36 @@ class TPUSolver:
         record_lookup("tpu_solver", cache_hit)
         if entry is not None:
             self._compiled.move_to_end(key)
-        if entry is None:
-            def _rebuild(bundle, donated_iter):
-                rebuilt = []
-                for w, lay in zip(spec, layout):
-                    if lay is None:
-                        rebuilt.append(next(donated_iter))
-                        continue
-                    o, nbytes, dt_s, shape = lay
-                    dt = np.dtype(dt_s)
-                    sl = jax.lax.slice(bundle, (o,), (o + nbytes,))
-                    if dt == np.bool_:
-                        arr = sl.astype(bool).reshape(shape)
-                    elif dt.itemsize == 1:
-                        arr = sl.astype(dt).reshape(shape)
-                    else:
-                        arr = jax.lax.bitcast_convert_type(
-                            sl.reshape((-1, dt.itemsize)), jnp.dtype(dt)
-                        ).reshape(shape)
-                    if w is not None:
-                        arr = jnp.unpackbits(arr, axis=-1, count=w).astype(bool)
-                    rebuilt.append(arr)
-                return jax.tree_util.tree_unflatten(treedef, rebuilt)
 
+        # bundle-leaf reconstruction, shared by the solve program, the
+        # prescreen precompute, and the (lazily compiled, possibly on a
+        # solve-cache HIT) delta refresh program — defined unconditionally
+        def _rebuild(bundle, donated_iter):
+            rebuilt = []
+            for w, lay in zip(spec, layout):
+                if lay is None:
+                    rebuilt.append(next(donated_iter))
+                    continue
+                o, nbytes, dt_s, shape = lay
+                dt = np.dtype(dt_s)
+                sl = jax.lax.slice(bundle, (o,), (o + nbytes,))
+                if dt == np.bool_:
+                    arr = sl.astype(bool).reshape(shape)
+                elif dt.itemsize == 1:
+                    arr = sl.astype(dt).reshape(shape)
+                else:
+                    arr = jax.lax.bitcast_convert_type(
+                        sl.reshape((-1, dt.itemsize)), jnp.dtype(dt)
+                    ).reshape(shape)
+                if w is not None:
+                    arr = jnp.unpackbits(arr, axis=-1, count=w).astype(bool)
+                rebuilt.append(arr)
+            return jax.tree_util.tree_unflatten(treedef, rebuilt)
+
+        donated_meta = [
+            (packed[i].shape, packed[i].dtype) for i in sorted(donate_set)
+        ]
+        if entry is None:
             if screen_mode == "prescreen":
                 def run_bundled(bundle, screen0, *donated):
                     return run(screen0, *_rebuild(bundle, iter(donated)))
@@ -879,10 +979,6 @@ class TPUSolver:
                 prescreen_run = make_prescreen_kernel(
                     segments_t, N_, backend=self.backend, screen_v=scr_v
                 )
-                donated_meta = [
-                    (packed[i].shape, packed[i].dtype)
-                    for i in sorted(donate_set)
-                ]
 
                 def prescreen_bundled(bundle):
                     dummies = iter(
@@ -899,6 +995,9 @@ class TPUSolver:
             while len(self._compiled) > self.MAX_COMPILED:
                 old_key, _ = self._compiled.popitem(last=False)
                 self._fetch_buckets.pop(old_key, None)
+                for rk in [k for k in self._refresh_compiled if k[0] == old_key]:
+                    del self._refresh_compiled[rk]
+                self._inc_screens.pop(old_key, None)
         fn, pre_fn = entry
         # one transfer for the bundle + one per donated plane
         args = jax.device_put((bundle, *donated_leaves))
@@ -916,10 +1015,78 @@ class TPUSolver:
             # async, so outside profile_phases this span mostly attributes
             # the dispatch itself; the execution overlaps into the device
             # window either way.
-            screen0 = pre_fn(args[0])
+            #
+            # Incremental path (solver/incremental.py): when the previous
+            # solve's verdict tensor is resident at this key and the plane
+            # delta is narrow, REPLAY the delta through the refresh program
+            # (changed existing rows × all columns, changed columns × all
+            # rows) instead of recomputing the full [N, C] tensor — device
+            # cost scales with the churn, not the world. Bit-identical to
+            # the full precompute by construction; any planning or dispatch
+            # failure degrades to the full path.
+            screen0 = None
+            scr_mode = "full"
+            # cold = this dispatch pays a program compile (first sight of
+            # the solve geometry, or a freshly minted refresh program):
+            # consumers comparing refresh-vs-full device time must bucket
+            # these apart or one-time XLA cost poisons the medians
+            cold = not cache_hit
+            delta = None
+            inc = None
+            if self._inc_enabled(screen_mode):
+                from karpenter_core_tpu.solver.incremental import IncrementalScreen
+
+                gate_ok, self._gate_ok = self._gate_ok, True
+                if not gate_ok:
+                    # a feed fault poisons EVERY key's residency, not just
+                    # the one this solve happens to land on
+                    for other in self._inc_screens.values():
+                        other.invalidate()
+                inc = self._inc_screens.setdefault(key, IncrementalScreen())
+                self._inc_screens.move_to_end(key)
+                while len(self._inc_screens) > self.MAX_INC_SCREENS:
+                    self._inc_screens.popitem(last=False)
+                try:
+                    delta = inc.plan(
+                        key, raw_args[0], raw_args[9], gate_ok=gate_ok
+                    )
+                except Exception:
+                    inc.invalidate()
+                    delta = None
+                if delta is not None:
+                    prev = inc.resident(key)
+                    if prev is not None:
+                        try:
+                            refresh_fn, cold = self._refresh_fn(
+                                key, geom, delta.rb, delta.cb, _rebuild,
+                                donated_meta,
+                            )
+                            row_idx, row_n, col_idx, col_n = delta.padded()
+                            screen0 = refresh_fn(
+                                args[0], prev, row_idx, row_n, col_idx, col_n
+                            )
+                            scr_mode = "refresh"
+                            inc.count_refresh()
+                        except Exception:
+                            # refresh dispatch failed (the donated tensor
+                            # may be gone): drop residency but keep the
+                            # staged fingerprints — the fallback full
+                            # tensor below re-adopts them
+                            inc.drop_resident()
+                            inc.count_degraded()
+                            screen0 = None
+            if screen0 is None:
+                screen0 = pre_fn(args[0])
+            if inc is not None:
+                inc.adopt(key, screen0)
             if self.profile_phases:
                 jax.block_until_ready(screen0)
-            _mark("prescreen", slots=geom[7])
+            _mark(
+                "prescreen", slots=geom[7], mode=scr_mode, cold=cold,
+                delta_rows=len(delta.rows) if delta is not None else -1,
+                delta_cols=len(delta.cols) if delta is not None else -1,
+            )
+            self.last_prescreen_mode = scr_mode
             run_args = (args[0], screen0, *args[1:])
         else:
             run_args = args

@@ -9,8 +9,10 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Callable, Dict, List, Optional
+from collections import deque
+from typing import Callable, Dict, List, Optional, Set, Tuple
 
+from karpenter_core_tpu import chaos
 from karpenter_core_tpu.api.machine import Machine
 from karpenter_core_tpu.api.provisioner import Provisioner
 from karpenter_core_tpu.kube.objects import NamespacedName, Node, Pod, object_key
@@ -22,6 +24,9 @@ class Cluster:
     """cluster.go:44-60."""
 
     CONSOLIDATED_TTL = 5 * 60.0  # forced re-check interval (cluster.go:277-286)
+    # delta-feed history bound: consumers further behind than this many
+    # mutations get a full-resync verdict instead of a partial diff
+    CHANGE_RING = 8192
 
     def __init__(self, kube_client, cloud_provider=None, clock=time.time):
         self.kube_client = kube_client
@@ -35,6 +40,59 @@ class Cluster:
         self.anti_affinity_pods: Dict[NamespacedName, Pod] = {}
         self._consolidated: bool = False
         self._consolidated_at: float = 0.0
+        # diff feed (the incremental solver's gate): every mutation bumps
+        # the revision and appends (revision, token) — token is the touched
+        # node's provider id, or "*" for churn with no single node scope
+        # (provisioner updates, deletes of unknown names)
+        self._revision: int = 0
+        self._changes = deque(maxlen=self.CHANGE_RING)
+
+    # -- diff feed (incremental re-solve) ----------------------------------
+
+    def _record_change(self, token: str) -> None:
+        """Append one delta to the bounded feed (call under self._mu)."""
+        self._revision += 1
+        self._changes.append((self._revision, token))
+
+    def revision(self) -> int:
+        with self._mu:
+            return self._revision
+
+    def changes_since(self, cursor: Optional[int]) -> Tuple[int, Optional[Set[str]]]:
+        """The state-store delta feed: (new_cursor, changed tokens since
+        `cursor`), or (new_cursor, None) when the feed cannot prove it has
+        full history — cursor None/unknown, or older than the bounded ring
+        remembers — and the consumer must treat the world as fully changed.
+
+        Tokens are node provider ids plus the "*" sentinel for unscoped
+        churn. Delivery is at-least-once by construction (tokens are a
+        set, duplicated deltas collapse); DROPPED deltas are impossible
+        within ring history because revisions are dense — a gap between
+        the cursor and the oldest retained revision is detected and
+        reported as a full resync, never silently skipped.
+
+        chaos fault point `state.diff` models a feed that lies (dropped /
+        duplicated / reordered deliveries from a flaky store): the injected
+        error propagates to the caller, whose contract is to degrade to the
+        full re-encode path rather than trust this diff."""
+        chaos.maybe_fail(chaos.STATE_DIFF)
+        with self._mu:
+            rev = self._revision
+            if cursor is None or cursor > rev:
+                return rev, None
+            if cursor == rev:
+                return rev, set()
+            oldest = self._changes[0][0] if self._changes else rev + 1
+            if cursor + 1 < oldest:
+                return rev, None  # history fell off the ring
+            # revisions are dense and the ring is append-ordered: walk the
+            # tail back to the cursor instead of scanning all 8192 entries
+            changed: Set[str] = set()
+            for r, t in reversed(self._changes):
+                if r <= cursor:
+                    break
+                changed.add(t)
+            return rev, changed
 
     # -- queries (cluster.go:116-202) --------------------------------------
 
@@ -85,6 +143,7 @@ class Cluster:
                 node = self.node_for(name)
                 if node is not None:
                     node.marked_for_deletion = False
+                    self._record_change(node.provider_id() or "*")
 
     def mark_for_deletion(self, *node_names: str) -> None:
         """cluster.go:181-202."""
@@ -93,6 +152,7 @@ class Cluster:
                 node = self.node_for(name)
                 if node is not None:
                     node.marked_for_deletion = True
+                    self._record_change(node.provider_id() or "*")
 
     # -- consolidation dirty bit (cluster.go:269-286) ----------------------
 
@@ -127,6 +187,7 @@ class Cluster:
             self.node_name_to_provider_id[node.metadata.name] = provider_id
             self._populate_inflight(existing)
             self._populate_volume_limits(existing)
+            self._record_change(provider_id)
             self.set_consolidated(False)
 
     def delete_node(self, name: str) -> None:
@@ -139,6 +200,7 @@ class Cluster:
                         state_node.node = None  # machine record remains
                     else:
                         del self.nodes_by_provider_id[pid]
+            self._record_change(pid or "*")
             self.set_consolidated(False)
 
     def update_machine(self, machine: Machine) -> None:
@@ -156,6 +218,7 @@ class Cluster:
             else:
                 existing.machine = machine
             self.machine_name_to_provider_id[machine.name] = provider_id
+            self._record_change(provider_id)
             self.set_consolidated(False)
 
     def delete_machine(self, name: str) -> None:
@@ -168,6 +231,7 @@ class Cluster:
                         state_node.machine = None
                     else:
                         del self.nodes_by_provider_id[pid]
+            self._record_change(pid or "*")
             self.set_consolidated(False)
 
     def update_pod(self, pod: Pod) -> None:
@@ -188,6 +252,7 @@ class Cluster:
                 node = self.node_for(pod.spec.node_name)
                 if node is not None:
                     node.update_for_pod(pod)
+                    self._record_change(node.provider_id() or "*")
                 if podutils.has_pod_anti_affinity(pod):
                     self.anti_affinity_pods[key] = pod
             self.set_consolidated(False)
@@ -199,7 +264,11 @@ class Cluster:
             self.set_consolidated(False)
 
     def update_provisioner(self, provisioner: Provisioner) -> None:
-        # cache-invalidate only (informer/provisioner.go:52)
+        # cache-invalidate only (informer/provisioner.go:52); unscoped for
+        # the diff feed — templates, not node rows, but consumers keyed on
+        # node deltas alone must still see that SOMETHING moved
+        with self._mu:
+            self._record_change("*")
         self.set_consolidated(False)
 
     def synced(self) -> bool:
@@ -223,6 +292,9 @@ class Cluster:
             node = self.node_for(node_name)
             if node is not None:
                 node.cleanup_for_pod(key)
+                # a termination FREES a slot — the delta the incremental
+                # re-solve narrows its refresh to
+                self._record_change(node.provider_id() or "*")
 
     def _populate_inflight(self, state_node: StateNode) -> None:
         """Inflight capacity from the instance type until kubelet reports

@@ -543,3 +543,152 @@ def test_failed_scheduling_events_explain_cause(env):
         for m in msgs
     ), msgs
     assert any("no instance type satisfied resources" in m for m in msgs), msgs
+
+
+# -- batcher max-window cap (regression) ------------------------------------
+
+
+def test_batcher_max_window_hard_cap_under_continuous_triggers():
+    """A nonstop trigger stream extends the IDLE deadline but must never
+    extend the max-duration bound: the window closes AT batch_max_duration
+    (within one poll quantum), not when the stream happens to pause.
+    Regression: the wait quantum is capped at the time remaining to the
+    nearer close bound, so continuous triggers can't keep re-arming a
+    full poll-length sleep past the max deadline."""
+    import threading
+    import time
+
+    from karpenter_core_tpu.controllers.provisioning.batcher import Batcher
+
+    b = Batcher(
+        settings=Settings(batch_idle_duration=10.0, batch_max_duration=0.25)
+    )
+    b.trigger()
+    stop = threading.Event()
+
+    def keep_triggering():
+        while not stop.is_set():
+            b.trigger()
+            time.sleep(0.002)
+
+    t = threading.Thread(
+        target=keep_triggering, name="test-batcher-trigger-stream", daemon=True
+    )
+    t.start()
+    try:
+        t0 = time.monotonic()
+        assert b.wait(timeout=1.0)
+        elapsed = time.monotonic() - t0
+    finally:
+        stop.set()
+        t.join()
+    # closed at the max bound: not early, and without extending the window
+    # per-trigger (generous upper slack for a loaded CI box — the failure
+    # mode being pinned is indefinite extension, seconds not milliseconds)
+    assert elapsed >= 0.25
+    assert elapsed < 1.0, f"max window overshot: {elapsed:.3f}s"
+
+
+def test_batcher_wait_quantum_capped_by_deadline():
+    """The inner trigger wait never sleeps past the nearer close bound:
+    with idle=50ms and a 10ms poll quantum the window closes ~idle after
+    the last trigger even though poll < idle (no full-quantum overshoot
+    stacking)."""
+    import time
+
+    from karpenter_core_tpu.controllers.provisioning.batcher import Batcher
+
+    b = Batcher(
+        settings=Settings(batch_idle_duration=0.05, batch_max_duration=5.0)
+    )
+    b.trigger()
+    t0 = time.monotonic()
+    assert b.wait(timeout=1.0)
+    elapsed = time.monotonic() - t0
+    assert 0.05 <= elapsed < 0.5
+
+
+# -- provisioning SLO metrics + bounded batches ------------------------------
+
+
+def test_admission_to_bind_and_pending_pods_metrics():
+    """The soak SLOs come from REAL exposition: every capacity decision
+    (machine launched / existing node nominated) observes pod admission ->
+    bind latency on karpenter_admission_to_bind_seconds, and each pass sets
+    karpenter_pending_pods to the batch depth it saw."""
+    from karpenter_core_tpu.controllers.provisioning.provisioner import (
+        ADMISSION_TO_BIND,
+        PENDING_PODS,
+    )
+
+    clock = FakeClock()
+    op = new_operator(
+        fake.FakeCloudProvider(fake.instance_types(4)),
+        settings=Settings(),
+        clock=clock,
+    )
+    op.kube_client.create(make_provisioner(name="default"))
+    base = ADMISSION_TO_BIND.snapshot()
+    created = clock.t
+    for i in range(4):
+        pod = make_pod(requests={"cpu": "0.5"})
+        pod.metadata.creation_timestamp = created
+        op.kube_client.create(pod)
+    clock.advance(3.0)
+    op.step()
+    assert ADMISSION_TO_BIND.count_since(base) == 4
+    # FakeClock: the decision landed exactly 3s after admission
+    assert ADMISSION_TO_BIND.percentile(0.5, baseline=base) >= 3.0
+    assert PENDING_PODS.get() == 4.0
+
+
+def test_batch_max_pods_caps_one_pass_and_retriggers():
+    """Settings.batch_max_pods bounds the pods one pass solves (oldest
+    first) and hands the remainder straight to the next window — the
+    geometry-stability contract the churn loop leans on."""
+    from karpenter_core_tpu.controllers.provisioning.provisioner import (
+        ADMISSION_TO_BIND,
+    )
+
+    clock = FakeClock()
+    op = new_operator(
+        fake.FakeCloudProvider(fake.instance_types(4)),
+        settings=Settings(batch_max_pods=3),
+        clock=clock,
+    )
+    op.kube_client.create(make_provisioner(name="default"))
+    for i in range(7):
+        pod = make_pod(name=f"cap-{i}", requests={"cpu": "0.5"})
+        pod.metadata.creation_timestamp = clock.t + i  # strict arrival order
+        op.kube_client.create(pod)
+
+    # play kubelet through the bind feed (the soak driver's contract):
+    # nominated pods get spec.node_name before the next pass, so a pod is
+    # decided exactly once
+    nominated = []
+    op.provisioning.bind_listeners.append(
+        lambda p, n: nominated.append((p.metadata.namespace, p.metadata.name, n))
+    )
+
+    def drain_binds():
+        while nominated:
+            ns, name, node = nominated.pop(0)
+            pod = op.kube_client.get("Pod", ns, name)
+            if pod is not None and not pod.spec.node_name:
+                pod.spec.node_name = node
+                op.kube_client.update(pod)
+
+    base = ADMISSION_TO_BIND.snapshot()
+    op.step()
+    # one capped pass decided exactly batch_max_pods pods, via the feed too
+    assert ADMISSION_TO_BIND.count_since(base) == 3
+    assert len(nominated) == 3
+    # the deferred remainder is re-triggered, not parked until the idle
+    # timeout: the batcher already has a pending trigger
+    assert op.provisioning.batcher._trigger.is_set()
+    # the next passes drain the rest, oldest-first slices of the backlog
+    drain_binds()
+    op.step()
+    drain_binds()
+    op.step()
+    assert ADMISSION_TO_BIND.count_since(base) == 7

@@ -1,0 +1,350 @@
+"""Incremental-vs-full prescreen parity over seeded churn SEQUENCES
+(ISSUE 6 acceptance).
+
+The delta re-solve path (solver/incremental.py + ops/pack.py
+make_screen_refresh_kernel) must be a pure DISPATCH optimization: across a
+sequence of consecutive solves whose world drifts the way sustained churn
+drifts it — new items, freed slots, narrowed slots — the incremental
+solver's placements must be byte-identical (flightrec-canonical JSON, the
+test_screen_parity.py bar) to a solver that runs the full [N, C] verdict
+precompute every time. Sequences matter: a one-shot comparison can't catch
+a stale resident tensor, a fingerprint that missed a plane, or an
+adopt/plan pairing bug — those only show up on solve k+1.
+
+Also covers the degrade contract: a chaos `state.diff` feed fault must
+force the full path for that solve (never a drifted refresh) and drop
+residency, with parity still holding.
+"""
+import copy
+
+import numpy as np
+import pytest
+
+from karpenter_core_tpu import chaos
+from karpenter_core_tpu.api.labels import (
+    LABEL_CAPACITY_TYPE,
+    LABEL_NODE_INITIALIZED,
+    PROVISIONER_NAME_LABEL_KEY,
+)
+from karpenter_core_tpu.cloudprovider import fake
+from karpenter_core_tpu.kube.objects import (
+    LABEL_INSTANCE_TYPE_STABLE,
+    LABEL_TOPOLOGY_ZONE,
+    LabelSelector,
+    PodAffinityTerm,
+    TopologySpreadConstraint,
+    object_key,
+)
+from karpenter_core_tpu.obs import flightrec
+from karpenter_core_tpu.obs.flightrec import canonical_placements, placements_json
+from karpenter_core_tpu.solver.incremental import (
+    DiffGate,
+    IncrementalScreen,
+    MAX_ROW_DELTA,
+)
+from karpenter_core_tpu.solver.tpu_solver import TPUSolver
+from karpenter_core_tpu.kube.client import InMemoryKubeClient
+from karpenter_core_tpu.state.cluster import Cluster
+from karpenter_core_tpu.state.node import StateNode
+from karpenter_core_tpu.testing import make_node, make_pod, make_provisioner
+
+ZONES = ["test-zone-1", "test-zone-2", "test-zone-3"]
+APPS = [f"churn-{i}" for i in range(6)]
+HOSTNAME_KEY = "kubernetes.io/hostname"
+
+
+def _anchor_pods():
+    """One pod per vocabulary value: the dictionary (and the compiled
+    geometry) is identical across every step and seed, which is exactly
+    the steady-state regime the incremental path exists for."""
+    spread = TopologySpreadConstraint(
+        max_skew=2,
+        topology_key=HOSTNAME_KEY,
+        when_unsatisfiable="DoNotSchedule",
+        label_selector=LabelSelector(match_labels={"app": APPS[0]}),
+    )
+    anti = PodAffinityTerm(
+        topology_key=HOSTNAME_KEY,
+        label_selector=LabelSelector(match_labels={"app": APPS[1]}),
+    )
+    pods = [make_pod(labels={"app": a}, requests={"cpu": "0.1"}) for a in APPS]
+    pods.append(
+        make_pod(labels={"app": APPS[0]}, requests={"cpu": "0.1"},
+                 topology_spread=[spread])
+    )
+    pods.append(
+        make_pod(labels={"app": APPS[1]}, requests={"cpu": "0.1"},
+                 pod_anti_affinity_required=[anti])
+    )
+    return pods
+
+
+def _filler_pods(rng, n):
+    return [
+        make_pod(
+            labels={"app": APPS[int(rng.integers(len(APPS)))]},
+            requests={"cpu": str(float(rng.choice([0.25, 0.5, 1.0])))},
+        )
+        for _ in range(n)
+    ]
+
+
+def _nodes(universe, count=10):
+    out = []
+    for e in range(count):
+        it = universe[e % len(universe)]
+        out.append(
+            StateNode(
+                node=make_node(
+                    name=f"churn-node-{e}",
+                    labels={
+                        PROVISIONER_NAME_LABEL_KEY: "default",
+                        LABEL_NODE_INITIALIZED: "true",
+                        LABEL_INSTANCE_TYPE_STABLE: it.name,
+                        LABEL_CAPACITY_TYPE: "on-demand",
+                        LABEL_TOPOLOGY_ZONE: ZONES[e % 3],
+                    },
+                    capacity={k: str(v) for k, v in it.capacity.items()},
+                )
+            )
+        )
+    return out
+
+
+class ChurnSequence:
+    """Deterministic sequence of (pods, state_nodes) solve inputs whose
+    node planes drift between steps the way churn drifts them: each step
+    BINDS a few pods onto random nodes (narrowed slots) and UNBINDS a few
+    previously bound ones (freed slots), over a fixed node count and a
+    fixed label vocabulary — so the geometry key is stable and only the
+    plane CONTENT changes."""
+
+    def __init__(self, seed, node_count=10, filler=6, grow_to=13):
+        self.rng = np.random.default_rng(seed)
+        self.universe = fake.instance_types(6)
+        self.nodes = _nodes(self.universe, node_count)
+        self.filler = filler
+        self.grow_to = grow_to
+        self.bound = []  # (node index, pod key) in bind order
+        self._n = 0
+        self._step = 0
+
+    def step(self):
+        self._step += 1
+        # a growing cluster inside one existing-axis bucket: new nodes
+        # exercise the hostname pad-rebinding adoption path (a launch must
+        # not re-mint the geometry out from under the resident tensor)
+        if self._step % 2 == 0 and len(self.nodes) < self.grow_to:
+            self.nodes.append(_nodes(self.universe, len(self.nodes) + 1)[-1])
+        # churn the node planes: unbind up to 2 oldest, bind 2 fresh
+        for _ in range(min(2, len(self.bound))):
+            e, key = self.bound.pop(0)
+            self.nodes[e].cleanup_for_pod(key)
+        for _ in range(2):
+            e = int(self.rng.integers(len(self.nodes)))
+            self._n += 1
+            p = make_pod(
+                name=f"bound-{self._n}",
+                labels={"app": APPS[int(self.rng.integers(len(APPS)))]},
+                requests={"cpu": "0.25"},
+            )
+            self.nodes[e].update_for_pod(p)
+            self.bound.append((e, object_key(p)))
+        pods = _anchor_pods() + _filler_pods(self.rng, self.filler)
+        return pods, [n.deep_copy() for n in self.nodes]
+
+
+def _solve(solver, pods, nodes, its, provisioners, cluster=None):
+    res = solver.solve(
+        copy.deepcopy(pods), provisioners, its, state_nodes=nodes,
+        cluster=cluster,
+    )
+    return placements_json(canonical_placements(res)), res
+
+
+def _parity_run(seed, steps, cluster=None, inc_solver=None):
+    """Drive both solvers through one churn sequence; returns the list of
+    prescreen modes the incremental solver took per step."""
+    seq = ChurnSequence(seed)
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": seq.universe}
+    inc = inc_solver or TPUSolver(
+        max_nodes=64, screen_mode="prescreen", incremental="on"
+    )
+    full = TPUSolver(max_nodes=64, screen_mode="prescreen", incremental="off")
+    modes = []
+    for k in range(steps):
+        pods, nodes = seq.step()
+        a, res_a = _solve(inc, pods, [n.deep_copy() for n in nodes], its,
+                          provisioners, cluster=cluster)
+        b, res_b = _solve(full, pods, nodes, its, provisioners)
+        if a != b:
+            diff = flightrec.diff_placements(
+                canonical_placements(res_a), canonical_placements(res_b)
+            )
+            raise AssertionError(
+                f"incremental diverged from full at churn step {k}:\n"
+                + "\n".join(diff)
+            )
+        assert res_a.rounds == res_b.rounds
+        assert len(res_a.failed_pods) == len(res_b.failed_pods)
+        modes.append(inc.last_prescreen_mode)
+    return modes
+
+
+@pytest.mark.parametrize("seed", [3, 17, 41])
+def test_incremental_parity_churn_sequence(seed):
+    """Seeded churn sequences through both paths: byte-identical placements
+    at EVERY step, and the delta refresh must actually engage (a suite
+    where the incremental path silently always ran full would be testing
+    nothing)."""
+    modes = _parity_run(seed, steps=6)
+    assert modes[0] == "full", "first solve has nothing resident"
+    assert modes.count("refresh") >= 3, (
+        f"delta re-solve never settled in: modes={modes}"
+    )
+
+
+def test_incremental_degrades_under_state_diff_chaos():
+    """chaos `state.diff` feed faults force the FULL path for the faulted
+    solve (degrade, never a drifted refresh) — parity still holds on every
+    step, residency is dropped, and the path re-engages after the fault
+    clears."""
+    cluster = Cluster(InMemoryKubeClient())
+    inc = TPUSolver(max_nodes=64, screen_mode="prescreen", incremental="on")
+    try:
+        # solves 3+ see a dead feed for 2 consults: plan() must dispatch
+        # full for those solves even though the planes barely moved
+        chaos.arm(chaos.STATE_DIFF, error="conn", probability=1.0,
+                  after=2, times=2, seed=7)
+        modes = _parity_run(11, steps=6, cluster=cluster, inc_solver=inc)
+    finally:
+        chaos.disarm(chaos.STATE_DIFF)
+    assert "refresh" in modes, f"never refreshed around the fault: {modes}"
+    # the two faulted consults forced full even under a stable geometry
+    assert modes.count("full") >= 3, f"fault did not degrade: {modes}"
+    assert modes[-1] == "refresh", (
+        f"path did not recover after the fault cleared: {modes}"
+    )
+
+
+def test_incremental_plan_outcomes_unit(monkeypatch):
+    """IncrementalScreen.plan outcome ladder on synthetic planes: miss
+    (nothing resident) -> refresh with exact changed-row/col indices ->
+    full_wide past the delta budget -> full_gated drops residency."""
+    rng = np.random.default_rng(0)
+    E, C, V = 12, 9, 40
+
+    def planes():
+        exist = {
+            k: rng.integers(0, 2, size=(E, V)).astype(bool)
+            for k in ("allow", "out", "defined")
+        }
+        pods = {
+            k: rng.integers(0, 2, size=(C, V)).astype(bool)
+            for k in ("allow", "out", "defined", "escape", "custom_deny")
+        }
+        pods["scls_first"] = np.arange(C, dtype=np.int32)
+        return pods, exist
+
+    pods, exist = planes()
+    inc = IncrementalScreen()
+    key = ("geom", "prescreen")
+
+    assert inc.plan(key, pods, exist) is None  # nothing resident yet
+    inc.adopt(key, screen_dev="tensor-0")
+    assert inc.resident(key) == "tensor-0"
+
+    # identical planes: an EMPTY refresh (carry the tensor over as-is)
+    delta = inc.plan(key, pods, exist)
+    assert delta is not None and len(delta.rows) == 0 and len(delta.cols) == 0
+    inc.adopt(key, "tensor-1")
+
+    # narrow drift: exactly the touched rows/cols, budgets pow2-padded
+    exist["allow"][4] = ~exist["allow"][4]
+    exist["defined"][7] = ~exist["defined"][7]
+    pods["out"][2] = ~pods["out"][2]
+    delta = inc.plan(key, pods, exist)
+    assert delta is not None
+    assert list(delta.rows) == [4, 7]
+    assert list(delta.cols) == [2]
+    assert delta.rb >= 2 and delta.cb >= 1
+    row_idx, row_n, col_idx, col_n = delta.padded()
+    assert len(row_idx) == delta.rb and row_n == 2
+    assert list(row_idx[:2]) == [4, 7]
+    inc.adopt(key, "tensor-2")
+
+    # wide drift: past the (narrowed) row budget -> full, residency kept
+    # (the full precompute that follows re-adopts at the same key)
+    from karpenter_core_tpu.solver import incremental as inc_mod
+
+    monkeypatch.setattr(inc_mod, "MAX_ROW_DELTA", 4)
+    wide_exist = {k: ~v for k, v in exist.items()}
+    assert inc.plan(key, pods, wide_exist) is None
+    monkeypatch.setattr(inc_mod, "MAX_ROW_DELTA", MAX_ROW_DELTA)
+
+    # feed fault with residency: full_gated AND residency dropped
+    assert inc.plan(key, pods, exist, gate_ok=False) is None
+    assert inc.resident(key) is None
+
+    # adopt without a matching plan leaves the carrier empty, not paired
+    # with stale fingerprints
+    inc.adopt(("other", "key"), "tensor-3")
+    assert inc.resident(("other", "key")) is None
+
+
+def test_cluster_changes_since_feed_semantics():
+    """The state-store delta feed: dense revisions, set-collapsed tokens,
+    full-resync verdicts for unknown cursors and ring-gap history."""
+    c = Cluster(InMemoryKubeClient())
+    cur, changed = c.changes_since(None)
+    assert changed is None  # no cursor: cannot prove history
+
+    n = make_node(name="n-1", labels={}, provider_id="fake:///n-1")
+    c.update_node(n)
+    cur2, changed = c.changes_since(cur)
+    assert changed == {"fake:///n-1"}
+    assert cur2 > cur
+
+    # caught-up cursor: provably empty delta, NOT a resync
+    cur3, changed = c.changes_since(cur2)
+    assert cur3 == cur2 and changed == set()
+
+    # duplicated churn collapses (at-least-once delivery is a set)
+    c.update_node(n)
+    c.update_node(n)
+    _, changed = c.changes_since(cur2)
+    assert changed == {"fake:///n-1"}
+
+    # a cursor from the future (restarted store) is a resync
+    _, changed = c.changes_since(cur2 + 10_000)
+    assert changed is None
+
+    # history falling off the bounded ring is DETECTED, never skipped
+    c2 = Cluster(InMemoryKubeClient())
+    base, _ = c2.changes_since(None)
+    for i in range(c2.CHANGE_RING + 5):
+        c2.update_node(make_node(name=f"m-{i}", provider_id=f"fake:///m-{i}"))
+    _, changed = c2.changes_since(base)
+    assert changed is None
+
+
+def test_diff_gate_consumes_feed_and_degrades_on_fault():
+    c = Cluster(InMemoryKubeClient())
+    gate = DiffGate()
+    assert gate.gate(c) is False  # first consult: no cursor yet
+    assert gate.gate(c) is True  # continuous (empty) history
+    c.update_node(make_node(name="g-1", provider_id="fake:///g-1"))
+    assert gate.gate(c) is True  # continuous non-empty history
+    try:
+        chaos.arm(chaos.STATE_DIFF, error="conn", probability=1.0, times=1)
+        assert gate.gate(c) is False  # injected feed fault
+    finally:
+        chaos.disarm(chaos.STATE_DIFF)
+    # the fault reset the cursor: the next consult must re-prove history
+    assert gate.gate(c) is False
+    assert gate.gate(c) is True
+    # objects with no feed at all (gRPC boundary) stay reuse-allowed:
+    # plane fingerprints alone are exact
+    assert gate.gate(object()) is True
+    assert gate.gate(None) is True

@@ -142,3 +142,40 @@ def test_percentile_empty_labels_and_no_observations():
     h.observe(0.05, {"a": "b"})
     assert h.percentile(0.5) is None  # empty-label series still unobserved
     assert h.percentile(0.5, {"a": "b"}) == 0.1
+
+
+# -- baseline-windowed reads (soak SLOs) -------------------------------------
+
+
+def test_histogram_snapshot_baseline_percentile():
+    """snapshot() + percentile(baseline=) reads the distribution of ONLY
+    the observations made after the snapshot — the soak bench's SLO window
+    over a process-cumulative histogram."""
+    h = Histogram("t_h", buckets=[0.1, 1, 10])
+    h.observe(0.05)
+    h.observe(0.05)
+    h.observe(0.05)
+    base = h.snapshot()
+    # everything after the snapshot lands in the 10s bucket
+    h.observe(5)
+    h.observe(5)
+    assert h.percentile(0.5) == 0.1  # cumulative view: old mass dominates
+    assert h.percentile(0.5, baseline=base) == 10  # window view: only new
+    assert h.count_since(base) == 2
+    assert h.count_since() == 5
+
+
+def test_histogram_snapshot_empty_window_is_none():
+    h = Histogram("t_h", buckets=[0.1, 1])
+    h.observe(0.05)
+    base = h.snapshot()
+    assert h.percentile(0.99, baseline=base) is None  # nothing since
+    assert h.count_since(base) == 0
+
+
+def test_histogram_snapshot_before_first_observation():
+    h = Histogram("t_h", buckets=[0.1, 1])
+    base = h.snapshot()  # series not yet materialized
+    h.observe(0.5)
+    assert h.count_since(base) == 1
+    assert h.percentile(0.5, baseline=base) == 1
