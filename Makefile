@@ -3,7 +3,7 @@
 # backend with 8 virtual devices via tests/conftest.py.
 
 .PHONY: test deflake perf bench verify trace-demo chaos chaos-smoke \
-	replay-demo lint soak soak-smoke
+	replay-demo lint soak soak-smoke prewarm-smoke
 
 test:  ## tier-1 suite (CPU, 8 virtual devices); slow chaos soaks: make chaos
 	python -m pytest tests -q -m "not slow"
@@ -42,6 +42,9 @@ soak:  ## >=60s sustained-churn soak, chaos armed + flightrec on (CPU-hermetic;
 soak-smoke:  ## <=30s seeded churn smoke (CI gate: admission SLOs + delta re-solve engage)
 	JAX_PLATFORMS=$${JAX_PLATFORMS:-cpu} python hack/soak.py --smoke
 
+prewarm-smoke:  ## warm-cache restart gate: prewarm a tier, restart fresh, first solve under budget
+	JAX_PLATFORMS=$${JAX_PLATFORMS:-cpu} python hack/prewarm_smoke.py
+
 verify:  ## driver hooks: single-chip compile check + 8-way mesh dryrun
 	# force the CPU backend in-process: this image's sitecustomize pins the
 	# axon TPU tunnel (env vars can't override it), and a wedged tunnel
@@ -68,3 +71,6 @@ verify:  ## driver hooks: single-chip compile check + 8-way mesh dryrun
 	# non-fatal smoke: a short seeded churn soak must bind every pod and
 	# engage the incremental delta re-solve (fatal gate lives in presubmit)
 	-$(MAKE) soak-smoke
+	# non-fatal smoke: a prewarmed persistent cache must make a restarted
+	# process's first solve fast (fatal gate lives in presubmit)
+	-$(MAKE) prewarm-smoke

@@ -23,6 +23,9 @@ make perf
 echo "== presubmit: make soak-smoke (seeded churn: SLOs + delta re-solve)"
 make soak-smoke
 
+echo "== presubmit: make prewarm-smoke (warm-cache restart under budget)"
+make prewarm-smoke
+
 if [[ "${1:-}" != "quick" ]]; then
   echo "== presubmit: short deflake (3 iterations)"
   MAX_ITERS=3 ./hack/deflake.sh

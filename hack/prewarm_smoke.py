@@ -1,0 +1,155 @@
+"""Prewarm smoke (ISSUE 7): prove a restarted process solves fast from the
+warm persistent compile cache.
+
+Two child processes share one fresh cache directory:
+
+  1. populate: AOT-prewarm the ladder's S tier (solver/prewarm.py) plus one
+     live solve — exactly what an operator boot does — writing the
+     persistent XLA cache to disk.
+  2. restart: a FRESH process (cold jit caches, warm disk) solves the same
+     tier-S geometry; its first Solve() must land under the budget —
+     KCT_PREWARM_SMOKE_BUDGET seconds when set, else 60% of the measured
+     populate (cold-compile) time, so the gate is robust to machine speed.
+     This is the CPU-tier analog of the ROADMAP "first Solve() after
+     operator restart < 2s on TPU at the bench geometry" exit criterion,
+     which bench.py's warm-restart probe measures for real.
+
+Exit code 0 on success; non-zero on a slow or cache-missing restart.
+Wired as `make prewarm-smoke`: non-fatal in `make verify`, fatal in
+hack/presubmit.sh.
+"""
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BUDGET_ENV = os.environ.get("KCT_PREWARM_SMOKE_BUDGET", "")
+N_PODS = 40
+
+
+def _workload():
+    """One-tier ladder + matching synthetic workload, installed as the
+    process-wide Settings so BOTH the prewarm and the later live solve's
+    encode snap to the same geometry — the restart child must hit the
+    prewarmed key, not merely the disk cache."""
+    import karpenter_core_tpu.api.settings as api_settings
+    from karpenter_core_tpu.api.settings import GeometryTier, Settings
+    from karpenter_core_tpu.cloudprovider import fake
+    from karpenter_core_tpu.solver.prewarm import synthetic_workload
+    from karpenter_core_tpu.testing import make_provisioner
+
+    tier = GeometryTier("S", pods=128, items=32, instance_types=8,
+                        existing_nodes=8)
+    settings = Settings(bucket_ladder=(tier,))
+    api_settings.set_current(settings)
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(5)}
+    pods, nodes = synthetic_workload(tier, provisioners, its)
+    return tier, settings, provisioners, its, pods, nodes
+
+
+def child_populate() -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from karpenter_core_tpu.solver.prewarm import prewarm
+    from karpenter_core_tpu.solver.tpu_solver import TPUSolver
+    from karpenter_core_tpu.utils.compilecache import enable_persistent_cache
+
+    enable_persistent_cache(os.environ["KCT_PREWARM_SMOKE_CACHE"])
+    tier, settings, provisioners, its, pods, nodes = _workload()
+    solver = TPUSolver(max_nodes=48)
+    t0 = time.perf_counter()
+    outcomes = prewarm(solver, provisioners, its, settings=settings)
+    # one live solve warms the fetch-slice mini-programs into the disk
+    # cache too (they compile lazily per outcome bucket)
+    solver.solve(pods[:N_PODS], provisioners, its, state_nodes=nodes)
+    print(json.dumps({
+        "prewarm_s": round(time.perf_counter() - t0, 1),
+        "outcomes": outcomes,
+    }))
+
+
+def child_restart() -> None:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from karpenter_core_tpu.solver.tpu_solver import TPUSolver
+    from karpenter_core_tpu.utils.compilecache import enable_persistent_cache
+
+    cache_dir = os.environ["KCT_PREWARM_SMOKE_CACHE"]
+    enable_persistent_cache(cache_dir)
+    cache_files = len([f for f in os.listdir(cache_dir) if not f.startswith(".")])
+    _tier, _settings, provisioners, its, pods, nodes = _workload()
+    solver = TPUSolver(max_nodes=48)
+    t0 = time.perf_counter()
+    res = solver.solve(pods[:N_PODS], provisioners, its, state_nodes=nodes)
+    first_solve_s = time.perf_counter() - t0
+    print(json.dumps({
+        "first_solve_s": round(first_solve_s, 2),
+        "cache_files": cache_files,
+        "scheduled": res.pod_count_new() + res.pod_count_existing(),
+    }))
+
+
+def _run_child(stage: str, cache_dir: str) -> dict:
+    env = dict(os.environ)
+    env["KCT_PREWARM_SMOKE_CHILD"] = stage
+    env["KCT_PREWARM_SMOKE_CACHE"] = cache_dir
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__)],
+        env=env, stdout=subprocess.PIPE, text=True, timeout=600,
+    )
+    for line in reversed(out.stdout.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    raise RuntimeError(f"{stage} child produced no JSON (rc={out.returncode})")
+
+
+def main() -> int:
+    cache_dir = tempfile.mkdtemp(prefix="kct-prewarm-smoke-")
+    print(f"[prewarm-smoke] cache dir {cache_dir}", file=sys.stderr)
+    populate = _run_child("populate", cache_dir)
+    print(f"[prewarm-smoke] populate: {populate}", file=sys.stderr)
+    restart = _run_child("restart", cache_dir)
+    print(f"[prewarm-smoke] restart: {restart}", file=sys.stderr)
+    budget_s = (
+        float(BUDGET_ENV)
+        if BUDGET_ENV
+        else 0.6 * float(populate.get("prewarm_s", 0.0) or 20.0)
+    )
+    ok = True
+    if restart.get("cache_files", 0) <= 0:
+        print("[prewarm-smoke] FAIL: persistent cache dir is empty",
+              file=sys.stderr)
+        ok = False
+    if restart.get("scheduled") != N_PODS:
+        print(f"[prewarm-smoke] FAIL: scheduled {restart.get('scheduled')} "
+              f"!= {N_PODS}", file=sys.stderr)
+        ok = False
+    first = restart.get("first_solve_s", 1e9)
+    if first >= budget_s:
+        print(f"[prewarm-smoke] FAIL: first solve after restart {first}s >= "
+              f"budget {budget_s:.1f}s", file=sys.stderr)
+        ok = False
+    if ok:
+        print(f"[prewarm-smoke] OK: first solve after restart {first}s "
+              f"(budget {budget_s:.1f}s, {restart['cache_files']} cache files)",
+              file=sys.stderr)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    stage = os.environ.get("KCT_PREWARM_SMOKE_CHILD", "")
+    if stage == "populate":
+        child_populate()
+    elif stage == "restart":
+        child_restart()
+    else:
+        sys.exit(main())

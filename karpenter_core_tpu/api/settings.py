@@ -7,7 +7,92 @@ object threaded explicitly (or via `current()` for defaults).
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class GeometryTier:
+    """One rung of the solver's geometry bucket ladder.
+
+    Every batch axis the compiled solve program is shaped by pads UP to a
+    tier value (solver/encode.py `ladder_pad`), so the set of programs the
+    operator can ever need is enumerable from this table alone — which is
+    what makes startup AOT prewarm (solver/prewarm.py) and a shipped
+    persistent compile cache product features instead of best-effort
+    caching. Axes:
+
+      pods            total pods a provisioning pass may solve (the
+                      batcher's pass cap clamps to the TOP rung —
+                      effective_batch_max_pods — and the prewarm sizes its
+                      synthetic workloads by it; the pods-derived commit-log
+                      and slot-budget axes stay fine-grained pow2, bounded
+                      because the pass cap bounds the batch)
+      items           pod spec-equivalence classes — the packing scan's
+                      sequential work axis
+      instance_types  padded width of the instance-type axis (pad rows are
+                      unoffered: tmpl_type_mask False, no offerings)
+      existing_nodes  padded width of the existing-node slot axis (pad rows
+                      are the closed sentinels encode always minted)
+    """
+
+    name: str
+    pods: int
+    items: int
+    instance_types: int
+    existing_nodes: int
+
+
+# The default ladder. Values are chosen so (a) the smallest tier matches
+# the historical power-of-two floors (item bucket 32, existing bucket 8)
+# — tiny test geometries keep their exact shapes — and (b) XL covers the
+# north-star 50k pods x 500 types x 1000 nodes in one rung. Sizes above
+# the ladder fall back to power-of-two padding (an "overflow" geometry,
+# counted by karpenter_bucket_overflow_total); the provisioning batcher
+# never produces one because its pass cap is clamped to the top rung
+# (Settings.effective_batch_max_pods).
+DEFAULT_BUCKET_LADDER: Tuple[GeometryTier, ...] = (
+    GeometryTier("S", pods=128, items=32, instance_types=8, existing_nodes=8),
+    GeometryTier("M", pods=1024, items=128, instance_types=32, existing_nodes=32),
+    GeometryTier("L", pods=8192, items=512, instance_types=128, existing_nodes=256),
+    GeometryTier("XL", pods=65536, items=2048, instance_types=512,
+                 existing_nodes=1024),  # north-star: 50k pods x 500 types
+)
+
+
+def parse_bucket_ladder(raw: str) -> Tuple[GeometryTier, ...]:
+    """Parse the ConfigMap grammar
+    `name:pods:items:types:existing[,name:...]` (e.g.
+    "S:128:32:16:8,XL:65536:2048:512:1024"). Tiers must be strictly
+    increasing on every axis; raises ValueError otherwise."""
+    tiers = []
+    for part in raw.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        fields = part.split(":")
+        if len(fields) != 5:
+            raise ValueError(
+                f"bucketLadder tier {part!r}: want name:pods:items:types:existing"
+            )
+        name, *dims = fields
+        try:
+            pods, items, types, existing = (int(d) for d in dims)
+        except ValueError:
+            raise ValueError(f"bucketLadder tier {part!r}: non-integer axis")
+        if min(pods, items, types, existing) <= 0:
+            raise ValueError(f"bucketLadder tier {part!r}: axes must be positive")
+        tiers.append(GeometryTier(name, pods, items, types, existing))
+    if not tiers:
+        raise ValueError("bucketLadder: no tiers")
+    for a, b in zip(tiers, tiers[1:]):
+        if not (a.pods < b.pods and a.items < b.items
+                and a.instance_types < b.instance_types
+                and a.existing_nodes < b.existing_nodes):
+            raise ValueError(
+                f"bucketLadder: tier {b.name!r} does not strictly grow every "
+                f"axis over {a.name!r}"
+            )
+    return tuple(tiers)
 
 
 @dataclass
@@ -27,6 +112,36 @@ class Settings:
     # stable geometry, which is also what keeps the incremental delta
     # re-solve path's resident verdict tensor reusable across solves.
     batch_max_pods: int = 0
+    # the solver's geometry bucket ladder (see GeometryTier): every compiled
+    # program's batch axes land on a tier value, so the program set is
+    # enumerable before the first pod arrives and the startup prewarm can
+    # compile it ahead of traffic
+    bucket_ladder: Tuple[GeometryTier, ...] = DEFAULT_BUCKET_LADDER
+
+    def effective_batch_max_pods(self) -> int:
+        """The provisioning pass cap actually enforced: the configured
+        batch_max_pods when set, clamped to the ladder's top rung either
+        way — a pass larger than the largest tier would mint an unlisted
+        (overflow) geometry and pay a compile the prewarm never covered,
+        so the batcher splits it instead (the remainder re-enters the next
+        window immediately, exactly like the plain batch_max_pods path)."""
+        top = self.bucket_ladder[-1].pods if self.bucket_ladder else 0
+        if self.batch_max_pods and top:
+            return min(self.batch_max_pods, top)
+        return self.batch_max_pods or top
+
+    def steady_state_tier(self) -> Optional[GeometryTier]:
+        """The tier a steady-state provisioning pass lands on — the prewarm
+        thread compiles this bucket FIRST so the common case is warm before
+        the rarer large rungs. With a batch_max_pods cap the steady pass is
+        at most that many pods; uncapped, assume the top rung."""
+        if not self.bucket_ladder:
+            return None
+        if self.batch_max_pods:
+            for tier in self.bucket_ladder:
+                if self.batch_max_pods <= tier.pods:
+                    return tier
+        return self.bucket_ladder[-1]
 
     @classmethod
     def from_config_map(cls, data: Dict[str, str]) -> "Settings":
@@ -49,6 +164,8 @@ class Settings:
             s.drift_enabled = raw == "true"
         if "batchMaxPods" in data:
             s.batch_max_pods = int(data["batchMaxPods"])
+        if "bucketLadder" in data:
+            s.bucket_ladder = parse_bucket_ladder(data["bucketLadder"])
         if s.batch_max_pods < 0:
             raise ValueError("batchMaxPods cannot be negative")
         if s.batch_max_duration <= 0:

@@ -388,7 +388,12 @@ class ProvisioningController:
         from karpenter_core_tpu.api.settings import current
 
         settings = self.batcher.settings or current()
-        if settings.batch_max_pods and len(pending) > settings.batch_max_pods:
+        # the enforced cap is clamped to the bucket ladder's top rung
+        # (Settings.effective_batch_max_pods): a pass larger than the
+        # largest tier would mint an unlisted (overflow) solver geometry —
+        # an un-prewarmed compile — so it splits instead
+        batch_cap = settings.effective_batch_max_pods()
+        if batch_cap and len(pending) > batch_cap:
             # bounded pass: solve the OLDEST cap-sized slice and hand the
             # remainder straight to the next window (re-trigger now, not
             # after the idle timeout) — see Settings.batch_max_pods for why
@@ -399,8 +404,8 @@ class ProvisioningController:
             # spinning back-to-back passes on ONLY those would re-solve the
             # same decided set forever against a slow/down scheduler.
             pending.sort(key=lambda p: p.metadata.creation_timestamp or 0.0)
-            deferred = pending[settings.batch_max_pods:]
-            pending = pending[: settings.batch_max_pods]
+            deferred = pending[batch_cap:]
+            pending = pending[:batch_cap]
             LOG.info("batch capped", solving=len(pending), deferred=len(deferred))
             if any(
                 (p.metadata.uid or (p.metadata.namespace, p.metadata.name))

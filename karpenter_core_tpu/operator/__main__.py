@@ -345,6 +345,31 @@ def run(cloud_provider, kube_client=None, stop_event=None, options=None):
     except ValueError:
         pass  # not the main thread (embedded/test use)
 
+    # startup AOT prewarm (solver/prewarm.py): compile the bucket ladder's
+    # solve/prescreen/refresh programs on a background thread, overlapped
+    # with the watch-cache sync, so the FIRST Solve() after a restart lands
+    # on an already-compiled (or persistent-cache-deserialized) program
+    # instead of paying the cold compile. KARPENTER_PREWARM=0 opts out;
+    # KARPENTER_PREWARM_TIERS=S,M restricts the rungs.
+    if envflags.get_bool("KARPENTER_PREWARM", True):
+        from karpenter_core_tpu.solver.prewarm import start_prewarm_thread
+
+        tier_env = envflags.raw("KARPENTER_PREWARM_TIERS")
+        start_prewarm_thread(
+            primary,
+            provisioners_fn=lambda: kube_client.list("Provisioner"),
+            instance_types_fn=lambda provs: {
+                p.name: cloud_provider.get_instance_types(p) for p in provs
+            },
+            settings=settings,
+            tiers=(
+                [t.strip() for t in tier_env.split(",") if t.strip()]
+                if tier_env
+                else None
+            ),
+            stop=stop,
+        )
+
     elector = None
     if opts.enable_leader_election:
         from karpenter_core_tpu.operator.leaderelection import LeaderElector

@@ -708,7 +708,14 @@ class ShardedSolver:
                     reuse=self._encode_reuse,
                 )
             mesh = self.mesh
-            if len(snap.instance_types) % mesh.shape["tp"] != 0:
+            # the PADDED type-axis width (ladder tiers are even, so padded
+            # geometries stay tp-divisible; raw odd universes fall back)
+            T_axis = (
+                snap.type_alloc.shape[0]
+                if snap.type_alloc is not None
+                else len(snap.instance_types)
+            )
+            if T_axis % mesh.shape["tp"] != 0:
                 # the tp all_gather needs the type axis to divide; rare odd
                 # geometries route through a dp-only view of the same devices
                 mesh = _dp_only_mesh(mesh)

@@ -61,6 +61,67 @@ def bucket_pow2(n: int, lo: int) -> int:
     return b
 
 
+# -- geometry bucket ladder (ISSUE 7) ----------------------------------------
+# The solve-shaping batch axes (pods, items, instance types, existing nodes)
+# pad to values from the FIXED ladder in api/settings.py instead of open-
+# ended power-of-two buckets: compiled_programs is then bounded by the
+# ladder (O(tiers), not O(observed geometries)) and — because the tier
+# table is known before the first pod arrives — the startup prewarm
+# (solver/prewarm.py) can AOT-compile every program the operator will need.
+# Sizes past the top rung continue power-of-two (an "overflow" geometry,
+# counted below); the provisioning batcher's pass cap is clamped to the top
+# rung (Settings.effective_batch_max_pods) so production passes never
+# overflow the pods axis.
+
+BUCKET_OVERFLOW = None  # lazily bound counter (metrics import stays light)
+
+
+def _count_overflow(axis: str) -> None:
+    global BUCKET_OVERFLOW
+    if BUCKET_OVERFLOW is None:
+        from karpenter_core_tpu.metrics.registry import NAMESPACE, REGISTRY
+
+        BUCKET_OVERFLOW = REGISTRY.counter(
+            f"{NAMESPACE}_bucket_overflow_total",
+            "Geometry axes padded past the configured bucket ladder's top "
+            "rung (power-of-two fallback: a compile the prewarm never "
+            "covered), by axis",
+        )
+    BUCKET_OVERFLOW.inc({"axis": axis})
+
+
+def resolve_ladder(ladder=None):
+    """The geometry tier table in effect: an explicit argument wins, else
+    the process-wide Settings. Returns a (possibly empty) tuple; empty
+    disables ladder snapping (pure power-of-two padding, the pre-ladder
+    behavior)."""
+    if ladder is not None:
+        return tuple(ladder)
+    from karpenter_core_tpu.api import settings as api_settings
+
+    return tuple(api_settings.current().bucket_ladder or ())
+
+
+def ladder_pad(n: int, ladder, axis: str, lo: int) -> int:
+    """Round n up to the smallest tier value on `axis`; 0 stays 0. Past the
+    top rung, continue power-of-two from it (overflow — counted, because
+    it mints a geometry the prewarm never compiled). With no ladder,
+    plain bucket_pow2(n, lo)."""
+    if n <= 0:
+        return 0
+    values = sorted(getattr(t, axis) for t in ladder) if ladder else ()
+    if not values:
+        return bucket_pow2(n, lo)
+    for v in values:
+        if n <= v:
+            return v
+    _count_overflow(axis)
+    b = values[-1]
+    while b < n:
+        b *= 2
+    return b
+
+
 def _ids(lst):
     return tuple(map(id, lst))
 
@@ -471,6 +532,14 @@ class EncodedSnapshot:
     item_scls: np.ndarray = None  # [I] int32 verdict column of item i
     scls_items: np.ndarray = None  # [C] int32 one item index per column
 
+    # geometry-ladder bookkeeping (ISSUE 7): padded item / verdict-column
+    # axis widths chosen at encode time from the tier table, read by
+    # solve_geometry / device_args / replan so every consumer pads
+    # identically (0 = pre-ladder snapshot: fall back to pow2)
+    item_pad: int = 0
+    cls_pad: int = 0
+    ladder: object = None  # the tier tuple in effect at encode time
+
     # host-side back-references for decode
     instance_types: List[InstanceType] = field(default_factory=list)
     templates: List[MachineTemplate] = field(default_factory=list)
@@ -621,6 +690,7 @@ def encode_snapshot(
     reuse_dictionary: Optional[LabelDictionary] = None,
     reuse: Optional[EncodeReuse] = None,
     carry_dictionary: Optional[LabelDictionary] = None,
+    ladder=None,
 ) -> EncodedSnapshot:
     """Lower a provisioning snapshot to tensors.
 
@@ -635,6 +705,13 @@ def encode_snapshot(
     reuse: an EncodeReuse carried across solves; stable instance-type
     planes are reused instead of re-encoded when types, dictionary content,
     and resource names all match the previous batch.
+
+    ladder: geometry tier table override (tests); defaults to
+    Settings.bucket_ladder via resolve_ladder(). Every solve-shaping axis
+    (existing nodes, instance types, machine-slot budget, and — stored on
+    the snapshot for solve_geometry/device_args — the item/class axes)
+    pads to a tier value so the compiled-program set stays bounded by the
+    ladder and startup prewarm can enumerate it.
 
     carry_dictionary: the PREVIOUS solve's dictionary, offered across
     batches (steady-state churn, ISSUE 6). Unlike reuse_dictionary it is
@@ -663,6 +740,8 @@ def encode_snapshot(
     ]
     templates = [MachineTemplate(p) for p in provisioners]
 
+    ladder = resolve_ladder(ladder)
+
     # global dedup of instance types by object identity
     all_types: List[InstanceType] = []
     type_ids: Dict[int, int] = {}
@@ -677,6 +756,12 @@ def encode_snapshot(
                 all_types.append(it)
             row.add(tid)
         tmpl_type_mask_rows.append(row)
+    # the instance-type axis pads to its ladder tier: pad columns are
+    # unoffered (no template offers them — tmpl_type_mask gates all of
+    # f_static — no offerings, allocatable -1 so fits() rejects), so a
+    # provider adding a few types stays inside one compiled program
+    T_real = len(all_types)
+    T_pad = ladder_pad(T_real, ladder, "instance_types", 1) if ladder else T_real
 
     # -- pod spec-equivalence classes (the 50k-scale lever) ----------------
     # Real batches are deployment-dominated: thousands of pods share a
@@ -751,7 +836,7 @@ def encode_snapshot(
     # compiled program; hostname values pad in step so the segment width
     # tracks the bucket, not the live count
     E_real = len(state_nodes)
-    E_pad = bucket_pow2(E_real, 8)
+    E_pad = ladder_pad(E_real, ladder, "existing_nodes", 8)
     if reuse_dictionary is not None:
         dictionary = reuse_dictionary
     else:
@@ -813,7 +898,7 @@ def encode_snapshot(
                 out[r_index[name]] = q
         return out
 
-    P, J, T, K, V = len(pods_sorted), len(templates), len(all_types), dictionary.K, dictionary.V
+    P, J, T, K, V = len(pods_sorted), len(templates), T_pad, dictionary.K, dictionary.V
 
     pod_requests_u = (
         np.stack([encode_resources(rl) for rl in req_u])
@@ -848,6 +933,7 @@ def encode_snapshot(
     # planes are the first thing incremental encode skips
     type_key = (
         _ids(all_types),
+        T_pad,
         EncodeReuse.dict_signature(dictionary),
         tuple(resource_names),
         EncodeReuse.offering_signature(all_types),
@@ -858,8 +944,19 @@ def encode_snapshot(
         (type_reqs_arr, type_alloc, type_capacity, type_offering_ok,
          type_offering_price, type_min_price) = cached
     else:
-        type_alloc = np.stack([encode_resources(it.allocatable()) for it in all_types]) if T else np.zeros((0, R), np.float32)
-        type_capacity = np.stack([encode_resources(it.capacity) for it in all_types]) if T else np.zeros((0, R), np.float32)
+        # rows [T_real, T_pad) are closed pad types: allocatable -1 (fits()
+        # rejects negatives), capacity 0, no offerings, offered by no
+        # template — unreachable by the kernel, present only to keep the
+        # type axis on a ladder tier
+        type_alloc = np.full((T, R), -1.0, dtype=np.float32)
+        type_capacity = np.zeros((T, R), dtype=np.float32)
+        if T_real:
+            type_alloc[:T_real] = np.stack(
+                [encode_resources(it.allocatable()) for it in all_types]
+            )
+            type_capacity[:T_real] = np.stack(
+                [encode_resources(it.capacity) for it in all_types]
+            )
 
         # -- offerings -----------------------------------------------------
         Z, C = zhi - zlo, chi - clo
@@ -883,7 +980,10 @@ def encode_snapshot(
             np.min(type_offering_price, axis=(1, 2)),
             np.inf,
         ).astype(np.float32)
-        type_reqs_arr = encode_reqsets(type_reqs_list, dictionary)
+        type_reqs_arr = encode_reqsets(
+            type_reqs_list + [Requirements() for _ in range(T_pad - T_real)],
+            dictionary,
+        )
         if reuse is not None:
             reuse.put(
                 type_key,
@@ -1052,7 +1152,10 @@ def encode_snapshot(
     # -- topology arrays ---------------------------------------------------
     from karpenter_core_tpu.ops.topology import encode_topology
 
-    # machine-slot budget on a bucket too (same compiled-program argument)
+    # machine-slot budget on a pow2 bucket (NOT the pods ladder: the ladder
+    # rungs are coarse, and doubling every small geometry's slot axis costs
+    # real compile+scan time; pow2-of-batch stays bounded because the
+    # batcher's pass cap clamps to the ladder's top rung)
     n_slots = E_pad + min(max_nodes, bucket_pow2(max(P, 1), 64))
     topo_meta, topo_arrays = encode_topology(
         host_topology,
@@ -1108,6 +1211,13 @@ def encode_snapshot(
         cls_of_item, return_index=True, return_inverse=True
     )
 
+    # item / verdict-column axis pads, chosen HERE so every consumer
+    # (solve_geometry, device_args, the replan rung builder) pads to the
+    # same ladder tier; heavy anti-affinity expansion can push the item
+    # axis a rung above the batch's pods tier — still a listed value
+    item_pad = ladder_pad(max(len(item_counts), 1), ladder, "items", 32)
+    cls_pad = ladder_pad(max(len(scls_items), 1), ladder, "items", 32)
+
     return EncodedSnapshot(
         dictionary=dictionary,
         resource_names=resource_names,
@@ -1149,6 +1259,9 @@ def encode_snapshot(
         item_members=item_members,
         item_scls=item_scls.astype(np.int32),
         scls_items=scls_items.astype(np.int32),
+        item_pad=item_pad,
+        cls_pad=cls_pad,
+        ladder=ladder,
         instance_types=all_types,
         templates=templates,
         pods=pods_sorted,

@@ -167,8 +167,11 @@ def _ladder_screen_traced(
     Rn = len(sizes)
     from karpenter_core_tpu.solver.encode import bucket_pow2
 
-    # count axis padded like device_args pads the item axis
-    count_rows = np.zeros((Rn, bucket_pow2(max(I, 1), 32)), dtype=np.int32)
+    # count axis padded like device_args pads the item axis (the snapshot's
+    # ladder tier when present)
+    count_rows = np.zeros(
+        (Rn, snap.item_pad or bucket_pow2(max(I, 1), 32)), dtype=np.int32
+    )
     exist_open = np.ones((Rn, E), dtype=bool)
     for r, size in enumerate(sizes):
         for it in range(I):

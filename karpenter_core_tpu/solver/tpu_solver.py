@@ -215,13 +215,17 @@ def solve_geometry(snap: EncodedSnapshot, max_nodes: int):
 
     dictionary = snap.dictionary
     segments = [dictionary.segment(k) for k in dictionary.keys]
-    # item axis padded to a bucket (device_args pads with valid=False rows)
-    # and existing axis pre-padded at encode: the geometry key — and with it
-    # the compiled program — is stable across nearby batch sizes
+    # item axis padded to the snapshot's ladder tier (device_args pads with
+    # valid=False rows) and existing/type axes pre-padded at encode: the
+    # geometry key — and with it the compiled program — is stable across
+    # every batch inside one tier, and the tier table bounds the program
+    # set (pre-ladder snapshots fall back to open-ended pow2 buckets)
     I_real = len(snap.item_counts) if snap.item_counts is not None else len(snap.pods)
-    P = bucket_pow2(max(I_real, 1), 32)
+    P = snap.item_pad or bucket_pow2(max(I_real, 1), 32)
     J = len(snap.templates)
-    T = len(snap.instance_types)
+    # the PADDED type-axis width (encode pads to the ladder tier); the real
+    # type list is shorter
+    T = snap.type_alloc.shape[0] if snap.type_alloc is not None else len(snap.instance_types)
     E = snap.exist_used.shape[0] if snap.exist_used is not None else 0
     R = len(snap.resource_names)
     K, V = dictionary.K, dictionary.V
@@ -236,7 +240,10 @@ def solve_geometry(snap: EncodedSnapshot, max_nodes: int):
             for g in snap.topo_meta.groups
         )
     # commit-log capacity: total pods rounded to a power-of-two bucket so
-    # repeat solves at nearby batch sizes reuse the compiled program
+    # repeat solves at nearby batch sizes reuse the compiled program (like
+    # the slot budget, this pods-derived axis stays pow2 — bounded by the
+    # batcher's ladder-clamped pass cap, and far finer-grained than the
+    # ladder rungs so small geometries don't inflate)
     log_len = 128
     while log_len < len(snap.pods) + 64:
         log_len *= 2
@@ -489,12 +496,12 @@ def device_args(snap: EncodedSnapshot, provisioners: Optional[List[Provisioner]]
         axis=1,
     )
 
-    # pad the item axis to the bucketed geometry (valid=False, count=0 rows
-    # never commit — the scan pays one cheap step each); must mirror
-    # solve_geometry's bucket
+    # pad the item axis to the snapshot's ladder tier (valid=False, count=0
+    # rows never commit — the scan pays one cheap step each); must mirror
+    # solve_geometry's bucket, which reads the same snapshot field
     from karpenter_core_tpu.solver.encode import bucket_pow2
 
-    I_pad = bucket_pow2(max(I, 1), 32)
+    I_pad = snap.item_pad or bucket_pow2(max(I, 1), 32)
     if I_pad > I:
         pad = I_pad - I
 
@@ -515,7 +522,7 @@ def device_args(snap: EncodedSnapshot, provisioners: Optional[List[Provisioner]]
         if snap.scls_items is not None
         else np.arange(I, dtype=np.int32)
     )
-    C_pad = bucket_pow2(max(len(scls_items), 1), 32)
+    C_pad = snap.cls_pad or bucket_pow2(max(len(scls_items), 1), 32)
     pod_arrays["scls_first"] = np.pad(
         scls_items, (0, C_pad - len(scls_items))
     )
@@ -589,6 +596,160 @@ def device_args(snap: EncodedSnapshot, provisioners: Optional[List[Provisioner]]
     )
 
 
+class _Dispatchable:
+    """A jit-wrapped program that prefers its AOT-compiled executable when
+    the prewarm path produced one: jax.jit(...).lower().compile() does NOT
+    populate the jit object's call cache, so without this a live dispatch
+    after prewarm would re-trace and re-compile (or, with the persistent
+    cache on, re-deserialize). The executable is shape-exact by the
+    geometry key; any mismatch falls back to the jit path for good."""
+
+    __slots__ = ("jit", "aot")
+
+    def __init__(self, jit_fn):
+        self.jit = jit_fn
+        self.aot = None
+
+    def __call__(self, *args):
+        aot = self.aot
+        if aot is not None:
+            try:
+                return aot(*args)
+            except (TypeError, ValueError):
+                # signature/layout drift, rejected at argument processing
+                # BEFORE execution (donated inputs not yet consumed): drop
+                # the executable for good and let the jit path recover.
+                # Execution-time errors (XlaRuntimeError etc.) propagate —
+                # a retry would dereference consumed donated buffers and
+                # bury the real failure under a deleted-array error.
+                self.aot = None
+        return self.jit(*args)
+
+
+@dataclass
+class _StagedCall:
+    """Everything one device call at one geometry needs before dispatch:
+    the bundled host args, the compiled-program cache key derived from
+    them, and the bundle-leaf reconstruction closure the programs share.
+
+    Staging is a pure function of (snapshot arrays, solver config), so the
+    prewarm thread staging a SYNTHETIC snapshot computes byte-for-byte the
+    same key a live solve at that geometry computes — which is what lets
+    AOT-prewarmed cache entries be hit by real traffic (solver/prewarm.py)
+    and lets a live solve arriving mid-prewarm block on exactly its own
+    tier's compile instead of duplicating it."""
+
+    geom: tuple
+    run: object
+    key: tuple
+    spec: tuple
+    treedef: object
+    layout: tuple
+    bundle: np.ndarray
+    donated_leaves: list
+    donated_meta: list
+    rebuild: object  # (bundle, donated_iter) -> run-arg pytree, traceable
+
+
+def _bundle_args(args, geom, run, backend, screen_mode):
+    """Pack device_args output into the upload bundle (see the layout
+    comments inline) and derive the compiled-program cache key. Shared by
+    TPUSolver._run_kernels (live path) and TPUSolver.prewarm_snapshot."""
+    import jax
+    import jax.numpy as jnp
+
+    # upload shrinkage, two layers:
+    # 1. large bool planes bit-pack on the host and unpack INSIDE the
+    #    jitted program — ~8x fewer bytes over a link that runs tens
+    #    of MB/s;
+    # 2. all non-donated leaves CONCATENATE into one uint8 bundle —
+    #    one transfer instead of ~40, on a link that charges
+    #    per-transfer latency. Leaves are sliced + bitcast back inside
+    #    the program (static offsets). Donated leaves (float32 planes
+    #    aliasing into the scan carry) stay separate buffers so
+    #    donation still works.
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    donate_set = set()
+    off = 0
+    for name, arg in zip(RUN_ARG_NAMES, args):
+        n_leaves = len(jax.tree_util.tree_leaves(arg))
+        if name in DONATE_ARG_NAMES:
+            donate_set.update(range(off, off + n_leaves))
+        off += n_leaves
+    # donated leaves must stay unpacked AND unbundled: they alias into
+    # the scan carry verbatim (topo_doms0 is a large bool plane that
+    # would otherwise trip the packing threshold and reach the kernel
+    # as uint8 with the wrong shape)
+    spec = tuple(
+        a.shape[-1]
+        if (
+            i not in donate_set
+            and a.dtype == np.bool_
+            and a.ndim >= 1
+            and a.size > 4096
+        )
+        else None
+        for i, a in enumerate(leaves)
+    )
+    packed = [
+        np.packbits(a, axis=-1) if w is not None else a
+        for a, w in zip(leaves, spec)
+    ]
+    # bundle layout: (byte offset, nbytes, dtype str, stored shape) per
+    # non-donated leaf; None marks a donated (separate) leaf
+    layout = []
+    chunks: List[np.ndarray] = []
+    off_b = 0
+    for i, a in enumerate(packed):
+        if i in donate_set:
+            layout.append(None)
+            continue
+        b = np.ascontiguousarray(a).reshape(-1).view(np.uint8)
+        pad = (-len(b)) % 4  # keep every segment 4-byte aligned
+        layout.append((off_b, len(b), str(a.dtype), a.shape))
+        chunks.append(b)
+        if pad:
+            chunks.append(np.zeros(pad, np.uint8))
+        off_b += len(b) + pad
+    bundle = np.concatenate(chunks) if chunks else np.zeros(0, np.uint8)
+    donated_leaves = [packed[i] for i in sorted(donate_set)]
+    donated_meta = [
+        (packed[i].shape, packed[i].dtype) for i in sorted(donate_set)
+    ]
+    key = (geom, backend, screen_mode, spec, treedef, tuple(layout))
+
+    # bundle-leaf reconstruction, shared by the solve program, the
+    # prescreen precompute, and the (lazily compiled, possibly on a
+    # solve-cache HIT) delta refresh program
+    def _rebuild(bundle, donated_iter):
+        rebuilt = []
+        for w, lay in zip(spec, layout):
+            if lay is None:
+                rebuilt.append(next(donated_iter))
+                continue
+            o, nbytes, dt_s, shape = lay
+            dt = np.dtype(dt_s)
+            sl = jax.lax.slice(bundle, (o,), (o + nbytes,))
+            if dt == np.bool_:
+                arr = sl.astype(bool).reshape(shape)
+            elif dt.itemsize == 1:
+                arr = sl.astype(dt).reshape(shape)
+            else:
+                arr = jax.lax.bitcast_convert_type(
+                    sl.reshape((-1, dt.itemsize)), jnp.dtype(dt)
+                ).reshape(shape)
+            if w is not None:
+                arr = jnp.unpackbits(arr, axis=-1, count=w).astype(bool)
+            rebuilt.append(arr)
+        return jax.tree_util.tree_unflatten(treedef, rebuilt)
+
+    return _StagedCall(
+        geom=geom, run=run, key=key, spec=spec, treedef=treedef,
+        layout=tuple(layout), bundle=bundle, donated_leaves=donated_leaves,
+        donated_meta=donated_meta, rebuild=_rebuild,
+    )
+
+
 class TPUSolver:
     """Stateless dense solver; jit-compiled per label geometry.
 
@@ -628,9 +789,19 @@ class TPUSolver:
         # label dictionary, so live-cluster label churn mints new keys — an
         # unbounded map would pin every old compiled executable (HBM + host)
         from collections import OrderedDict
+        import threading
 
         self.MAX_COMPILED = 32
         self._compiled = OrderedDict()
+        # _cache_lock guards the compiled-program LRU and its satellite
+        # maps (_fetch_buckets/_refresh_compiled/_inc_screens): the live
+        # solve path shares them with the startup prewarm thread.
+        # _key_locks serializes program CREATION per geometry key so a live
+        # solve arriving while prewarm compiles its tier blocks on exactly
+        # that compile instead of duplicating it (and solves at other
+        # geometries don't contend at all).
+        self._cache_lock = threading.Lock()
+        self._key_locks = {}
         # per-geometry (ptr_b, bulk_b, nopen_b, nnz_b) from the previous
         # solve: the speculative single-round-trip fetch slices with these
         self._fetch_buckets = {}
@@ -684,6 +855,60 @@ class TPUSolver:
             kube_client=kube_client, cluster=cluster, max_nodes=self.max_nodes,
             reuse=self._encode_reuse,
         )
+
+    def prewarm_snapshot(self, snap: EncodedSnapshot,
+                         provisioners: List[Provisioner]) -> str:
+        """AOT-compile the solve + prescreen (and, when the incremental
+        path is enabled, the steady-churn delta refresh) programs for a
+        snapshot's geometry WITHOUT dispatching a solve — the startup
+        prewarm path (solver/prewarm.py). The staged call computes the
+        exact cache key a live solve at this geometry computes, so real
+        traffic hits the prewarmed entry; the lower().compile() also
+        writes the persistent disk cache (utils/compilecache) so the NEXT
+        process restart deserializes instead of recompiling. Thread-safe
+        against concurrent live solves ( _entry_for's per-key locks).
+        Returns 'compiled' when this call paid the compile, 'cached' when
+        the entry already existed."""
+        from karpenter_core_tpu.ops import compat as ops_compat
+        from karpenter_core_tpu.utils.compilecache import record_lookup
+
+        screen_mode = self.screen_mode or ops_compat.resolve_screen_mode()
+        geom, run = build_device_solve(
+            snap, self.max_nodes, backend=self.backend,
+            screen_mode=screen_mode, external_prescreen=True,
+        )
+        args = device_args(snap, provisioners)
+        staged = _bundle_args(args, geom, run, self.backend, screen_mode)
+        entry, cache_hit = self._entry_for(staged, screen_mode, aot=True)
+        record_lookup("prewarm", cache_hit)
+        if not cache_hit and self._inc_enabled(screen_mode):
+            self._prewarm_refresh(staged, entry)
+        return "cached" if cache_hit else "compiled"
+
+    def _prewarm_refresh(self, staged: _StagedCall, entry) -> None:
+        """AOT-compile the delta-refresh program at the minimum (8, 8)
+        budget — the steady-churn common case (solver/incremental.py pads
+        narrow deltas to 8); wider budgets compile on demand. Abstract
+        avals only: no tensor is materialized."""
+        import jax
+
+        _fn, pre_fn = entry
+        if pre_fn is None:
+            return
+        refresh_fn, _minted = self._refresh_fn(
+            staged.key, staged.geom, 8, 8, staged.rebuild,
+            staged.donated_meta,
+        )
+        bundle_sds = jax.ShapeDtypeStruct(
+            staged.bundle.shape, staged.bundle.dtype
+        )
+        screen_sds = jax.eval_shape(pre_fn.jit, bundle_sds)
+        idx = np.zeros(8, np.int32)
+        # the count operands lower as weak-typed scalars, matching the
+        # python ints ScreenDelta.padded() passes on the live path
+        refresh_fn.aot = refresh_fn.jit.lower(
+            bundle_sds, screen_sds, idx, 0, idx, 0
+        ).compile()
 
     def solve(
         self,
@@ -781,10 +1006,11 @@ class TPUSolver:
         import jax.numpy as jnp
 
         rkey = (key, rb, cb)
-        fn = self._refresh_compiled.get(rkey)
-        if fn is not None:
-            self._refresh_compiled.move_to_end(rkey)
-            return fn, False
+        with self._cache_lock:
+            fn = self._refresh_compiled.get(rkey)
+            if fn is not None:
+                self._refresh_compiled.move_to_end(rkey)
+                return fn, False
         from karpenter_core_tpu.ops.pack import make_screen_refresh_kernel
 
         (_P, _J, _T, _E, _R, _K, _V, N_, segments_t, _zs, _cs, _tsig, _ll,
@@ -802,11 +1028,135 @@ class TPUSolver:
                 row_idx, row_n, col_idx, col_n,
             )
 
-        fn = jax.jit(refresh_bundled, donate_argnums=(1,))
-        self._refresh_compiled[rkey] = fn
-        while len(self._refresh_compiled) > self.MAX_REFRESH:
-            self._refresh_compiled.popitem(last=False)
+        fn = _Dispatchable(jax.jit(refresh_bundled, donate_argnums=(1,)))
+        with self._cache_lock:
+            self._refresh_compiled[rkey] = fn
+            while len(self._refresh_compiled) > self.MAX_REFRESH:
+                self._refresh_compiled.popitem(last=False)
         return fn, True
+
+    # -- compiled-program cache (shared with the prewarm thread) -----------
+
+    def _entry_for(self, staged: _StagedCall, screen_mode,
+                   aot: bool = False):
+        """(entry, cache_hit) for a staged call's geometry key. Creation is
+        serialized per key: the winner builds the (solve, prescreen) jit
+        pair — and, on the prewarm path (aot=True), pays the XLA compile
+        right here via jax.jit(...).lower().compile(), which also writes
+        the persistent disk cache — while losers block and then hit."""
+        import threading
+
+        key = staged.key
+        with self._cache_lock:
+            entry = self._compiled.get(key)
+            if entry is not None:
+                self._compiled.move_to_end(key)
+                return entry, True
+            lock = self._key_locks.setdefault(key, threading.Lock())
+        with lock:
+            with self._cache_lock:
+                entry = self._compiled.get(key)
+                if entry is not None:  # lost the race: the other thread built it
+                    self._compiled.move_to_end(key)
+                    return entry, True
+            entry = self._build_entry(staged, screen_mode)
+            if aot:
+                self._aot_compile(entry, staged)
+            with self._cache_lock:
+                self._compiled[key] = entry
+                self._key_locks.pop(key, None)
+                while len(self._compiled) > self.MAX_COMPILED:
+                    old_key, _ = self._compiled.popitem(last=False)
+                    self._fetch_buckets.pop(old_key, None)
+                    for rk in [k for k in self._refresh_compiled
+                               if k[0] == old_key]:
+                        del self._refresh_compiled[rk]
+                    self._inc_screens.pop(old_key, None)
+        return entry, False
+
+    def _build_entry(self, staged: _StagedCall, screen_mode):
+        """The (solve, prescreen) jit pair for one geometry — jit objects
+        only; the XLA compile is paid at first dispatch (live path) or by
+        _aot_compile (prewarm path)."""
+        import jax
+        import jax.numpy as jnp
+
+        run = staged.run
+        _rebuild = staged.rebuild
+        donated_meta = staged.donated_meta
+        n_donated = len(staged.donated_leaves)
+        if screen_mode == "prescreen":
+            def run_bundled(bundle, screen0, *donated):
+                return run(screen0, *_rebuild(bundle, iter(donated)))
+
+            # screen0 sits at position 1, shifting the donated planes
+            # one right; it is NOT donated itself — the scan's final
+            # verdict carry is discarded, so no output buffer can ever
+            # alias it and XLA would just warn "donated buffer not
+            # usable" on every compile
+            donate_nums = (
+                tuple(range(2, 2 + n_donated)) if self.donate else ()
+            )
+        else:
+            def run_bundled(bundle, *donated):
+                return run(*_rebuild(bundle, iter(donated)))
+
+            donate_nums = (
+                tuple(range(1, 1 + n_donated)) if self.donate else ()
+            )
+        fn = _Dispatchable(jax.jit(run_bundled, donate_argnums=donate_nums))
+
+        pre_fn = None
+        if screen_mode == "prescreen":
+            # the batched class×slot precompute as its OWN program,
+            # cached under the same LRU entry as the solve program so
+            # the pair ages out together and the bucketed compile cache
+            # stays at 2 programs per geometry (guarded by
+            # tests/test_perf_floor.py's tripwire). It reads only
+            # non-donated bundle leaves; donated slots rebuild as
+            # zero dummies that DCE away.
+            from karpenter_core_tpu.ops.pack import make_prescreen_kernel
+
+            (_P, _J, _T, _E, _R, _K, _V, N_, segments_t, _zs, _cs,
+             _tsig, _ll, _Q, _W, _D, scr_v) = staged.geom
+            prescreen_run = make_prescreen_kernel(
+                segments_t, N_, backend=self.backend, screen_v=scr_v
+            )
+
+            def prescreen_bundled(bundle):
+                dummies = iter(
+                    jnp.zeros(s, d) for s, d in donated_meta
+                )
+                named = dict(
+                    zip(RUN_ARG_NAMES, _rebuild(bundle, dummies))
+                )
+                return prescreen_run(named["pod_arrays"], named["exist"])
+
+            pre_fn = _Dispatchable(jax.jit(prescreen_bundled))
+        return (fn, pre_fn)
+
+    def _aot_compile(self, entry, staged: _StagedCall) -> None:
+        """AOT-compile an entry's programs against the staged (synthetic)
+        args — jax.jit(...).lower(...).compile() pays the full XLA compile
+        NOW and writes the persistent disk cache. The executables attach
+        to the entry's _Dispatchable wrappers so the first live dispatch
+        at this geometry runs them directly (no re-trace, no re-compile,
+        no disk deserialize)."""
+        import jax
+
+        fn, pre_fn = entry
+        bundle = staged.bundle
+        if pre_fn is not None:
+            pre_fn.aot = pre_fn.jit.lower(bundle).compile()
+            # the solve program's screen0 argument has the prescreen
+            # output's shape/dtype; lower with the abstract value so no
+            # tensor is materialized
+            screen_sds = jax.eval_shape(pre_fn.jit, bundle)
+            fn.aot = fn.jit.lower(
+                bundle, screen_sds, *staged.donated_leaves
+            ).compile()
+        else:
+            fn.aot = fn.jit.lower(bundle, *staged.donated_leaves).compile()
 
     def _run_kernels(self, snap: EncodedSnapshot, provisioners: List[Provisioner]):
         import time as _time
@@ -841,166 +1191,24 @@ class TPUSolver:
         args = device_args(snap, provisioners)
         raw_args = args  # host numpy view (incremental plane fingerprints)
         _mark("args")
-        # upload shrinkage, two layers:
-        # 1. large bool planes bit-pack on the host and unpack INSIDE the
-        #    jitted program — ~8x fewer bytes over a link that runs tens
-        #    of MB/s;
-        # 2. all non-donated leaves CONCATENATE into one uint8 bundle —
-        #    one transfer instead of ~40, on a link that charges
-        #    per-transfer latency. Leaves are sliced + bitcast back inside
-        #    the program (static offsets). Donated leaves (float32 planes
-        #    aliasing into the scan carry) stay separate buffers so
-        #    donation still works.
-        leaves, treedef = jax.tree_util.tree_flatten(args)
-        donate_set = set()
-        off = 0
-        for name, arg in zip(RUN_ARG_NAMES, args):
-            n_leaves = len(jax.tree_util.tree_leaves(arg))
-            if name in DONATE_ARG_NAMES:
-                donate_set.update(range(off, off + n_leaves))
-            off += n_leaves
-        # donated leaves must stay unpacked AND unbundled: they alias into
-        # the scan carry verbatim (topo_doms0 is a large bool plane that
-        # would otherwise trip the packing threshold and reach the kernel
-        # as uint8 with the wrong shape)
-        spec = tuple(
-            a.shape[-1]
-            if (
-                i not in donate_set
-                and a.dtype == np.bool_
-                and a.ndim >= 1
-                and a.size > 4096
-            )
-            else None
-            for i, a in enumerate(leaves)
-        )
-        packed = [
-            np.packbits(a, axis=-1) if w is not None else a
-            for a, w in zip(leaves, spec)
-        ]
-        # bundle layout: (byte offset, nbytes, dtype str, stored shape) per
-        # non-donated leaf; None marks a donated (separate) leaf
-        layout = []
-        chunks: List[np.ndarray] = []
-        off_b = 0
-        for i, a in enumerate(packed):
-            if i in donate_set:
-                layout.append(None)
-                continue
-            b = np.ascontiguousarray(a).reshape(-1).view(np.uint8)
-            pad = (-len(b)) % 4  # keep every segment 4-byte aligned
-            layout.append((off_b, len(b), str(a.dtype), a.shape))
-            chunks.append(b)
-            if pad:
-                chunks.append(np.zeros(pad, np.uint8))
-            off_b += len(b) + pad
-        bundle = np.concatenate(chunks) if chunks else np.zeros(0, np.uint8)
-        donated_leaves = [packed[i] for i in sorted(donate_set)]
+        staged = _bundle_args(args, geom, run, self.backend, screen_mode)
         _mark("pack")
         from karpenter_core_tpu.utils.compilecache import (
             record_compile_seconds,
             record_lookup,
         )
 
-        key = (geom, self.backend, screen_mode, spec, treedef, tuple(layout))
-        entry = self._compiled.get(key)
-        cache_hit = entry is not None
+        key = staged.key
+        # thread-safe keyed lookup: the prewarm thread AOT-compiles through
+        # the same path, so a live solve arriving mid-prewarm blocks only
+        # on its own tier's per-key lock and never duplicates a compile
+        entry, cache_hit = self._entry_for(staged, screen_mode)
         record_lookup("tpu_solver", cache_hit)
-        if entry is not None:
-            self._compiled.move_to_end(key)
-
-        # bundle-leaf reconstruction, shared by the solve program, the
-        # prescreen precompute, and the (lazily compiled, possibly on a
-        # solve-cache HIT) delta refresh program — defined unconditionally
-        def _rebuild(bundle, donated_iter):
-            rebuilt = []
-            for w, lay in zip(spec, layout):
-                if lay is None:
-                    rebuilt.append(next(donated_iter))
-                    continue
-                o, nbytes, dt_s, shape = lay
-                dt = np.dtype(dt_s)
-                sl = jax.lax.slice(bundle, (o,), (o + nbytes,))
-                if dt == np.bool_:
-                    arr = sl.astype(bool).reshape(shape)
-                elif dt.itemsize == 1:
-                    arr = sl.astype(dt).reshape(shape)
-                else:
-                    arr = jax.lax.bitcast_convert_type(
-                        sl.reshape((-1, dt.itemsize)), jnp.dtype(dt)
-                    ).reshape(shape)
-                if w is not None:
-                    arr = jnp.unpackbits(arr, axis=-1, count=w).astype(bool)
-                rebuilt.append(arr)
-            return jax.tree_util.tree_unflatten(treedef, rebuilt)
-
-        donated_meta = [
-            (packed[i].shape, packed[i].dtype) for i in sorted(donate_set)
-        ]
-        if entry is None:
-            if screen_mode == "prescreen":
-                def run_bundled(bundle, screen0, *donated):
-                    return run(screen0, *_rebuild(bundle, iter(donated)))
-
-                # screen0 sits at position 1, shifting the donated planes
-                # one right; it is NOT donated itself — the scan's final
-                # verdict carry is discarded, so no output buffer can ever
-                # alias it and XLA would just warn "donated buffer not
-                # usable" on every compile
-                donate_nums = (
-                    tuple(range(2, 2 + len(donated_leaves)))
-                    if self.donate
-                    else ()
-                )
-            else:
-                def run_bundled(bundle, *donated):
-                    return run(*_rebuild(bundle, iter(donated)))
-
-                donate_nums = (
-                    tuple(range(1, 1 + len(donated_leaves)))
-                    if self.donate
-                    else ()
-                )
-            fn = jax.jit(run_bundled, donate_argnums=donate_nums)
-
-            pre_fn = None
-            if screen_mode == "prescreen":
-                # the batched class×slot precompute as its OWN program,
-                # cached under the same LRU entry as the solve program so
-                # the pair ages out together and the bucketed compile cache
-                # stays at 2 programs per geometry (guarded by
-                # tests/test_perf_floor.py's tripwire). It reads only
-                # non-donated bundle leaves; donated slots rebuild as
-                # zero dummies that DCE away.
-                from karpenter_core_tpu.ops.pack import make_prescreen_kernel
-
-                (_P, _J, _T, _E, _R, _K, _V, N_, segments_t, _zs, _cs,
-                 _tsig, _ll, _Q, _W, _D, scr_v) = geom
-                prescreen_run = make_prescreen_kernel(
-                    segments_t, N_, backend=self.backend, screen_v=scr_v
-                )
-
-                def prescreen_bundled(bundle):
-                    dummies = iter(
-                        jnp.zeros(s, d) for s, d in donated_meta
-                    )
-                    named = dict(
-                        zip(RUN_ARG_NAMES, _rebuild(bundle, dummies))
-                    )
-                    return prescreen_run(named["pod_arrays"], named["exist"])
-
-                pre_fn = jax.jit(prescreen_bundled)
-            entry = (fn, pre_fn)
-            self._compiled[key] = entry
-            while len(self._compiled) > self.MAX_COMPILED:
-                old_key, _ = self._compiled.popitem(last=False)
-                self._fetch_buckets.pop(old_key, None)
-                for rk in [k for k in self._refresh_compiled if k[0] == old_key]:
-                    del self._refresh_compiled[rk]
-                self._inc_screens.pop(old_key, None)
+        _rebuild = staged.rebuild
+        donated_meta = staged.donated_meta
         fn, pre_fn = entry
         # one transfer for the bundle + one per donated plane
-        args = jax.device_put((bundle, *donated_leaves))
+        args = jax.device_put((staged.bundle, *staged.donated_leaves))
         if self.profile_phases:
             # barrier ONLY under opt-in phase profiling: it serializes the
             # upload with jit trace/compile, costing cold solves the full
@@ -1042,10 +1250,13 @@ class TPUSolver:
                     # the one this solve happens to land on
                     for other in self._inc_screens.values():
                         other.invalidate()
-                inc = self._inc_screens.setdefault(key, IncrementalScreen())
-                self._inc_screens.move_to_end(key)
-                while len(self._inc_screens) > self.MAX_INC_SCREENS:
-                    self._inc_screens.popitem(last=False)
+                with self._cache_lock:
+                    inc = self._inc_screens.setdefault(
+                        key, IncrementalScreen()
+                    )
+                    self._inc_screens.move_to_end(key)
+                    while len(self._inc_screens) > self.MAX_INC_SCREENS:
+                        self._inc_screens.popitem(last=False)
                 try:
                     delta = inc.plan(
                         key, raw_args[0], raw_args[9], gate_ok=gate_ok
@@ -1191,7 +1402,8 @@ class TPUSolver:
             return dense
 
         lazy_widths = {f: getattr(state, f).shape[1] for f in _SlotState._LAZY}
-        spec_bk = self._fetch_buckets.get(key)
+        with self._cache_lock:
+            spec_bk = self._fetch_buckets.get(key)
         fused = spec_bk is not None
         if fused:
             sliced, lazy_packed = _sliced(*spec_bk)
@@ -1219,11 +1431,12 @@ class TPUSolver:
         # across a pow2 boundary — every step-up solve would pay the wasted
         # fused transfer plus the old second round trip. Over-fetch is
         # bounded by one bucket step per axis.
-        self._fetch_buckets[key] = (
-            tuple(max(n, s) for n, s in zip(need_bk, spec_bk))
-            if spec_bk is not None
-            else need_bk
-        )
+        with self._cache_lock:
+            self._fetch_buckets[key] = (
+                tuple(max(n, s) for n, s in zip(need_bk, spec_bk))
+                if spec_bk is not None
+                else need_bk
+            )
         if not fused or any(n > s for n, s in zip(need_bk, spec_bk)):
             # speculation miss (or first solve at this geometry): fetch the
             # correctly-sized slices in a second round trip
@@ -1420,7 +1633,13 @@ def decode_solve(snap: EncodedSnapshot, placements, state,
 
         def options_thunk(slot=slot):
             tmask = np.asarray(state.tmask[slot])
-            return [snap.instance_types[t] for t in np.nonzero(tmask)[0]]
+            # the mask rides the padded type axis; pad columns can never be
+            # feasible (no template offers them) — guard anyway
+            return [
+                snap.instance_types[t]
+                for t in np.nonzero(tmask)[0]
+                if t < len(snap.instance_types)
+            ]
 
         machines.append(
             SolvedMachine(

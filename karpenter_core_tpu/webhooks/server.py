@@ -32,6 +32,37 @@ from karpenter_core_tpu.webhooks import AdmissionWebhooks
 CERT_SECRET_NAME = "karpenter-core-tpu-cert"
 ROTATE_BEFORE = datetime.timedelta(days=7)
 
+# the TLS cert path needs `cryptography`, which is an optional dependency
+# (the solver image ships without it): probe ONCE at import so every
+# entrypoint can degrade to a clear, structured-log skip instead of an
+# opaque ModuleNotFoundError mid-reconcile
+try:  # pragma: no cover - trivially environment-dependent
+    import cryptography  # noqa: F401
+
+    HAVE_CRYPTOGRAPHY = True
+except ImportError:  # pragma: no cover
+    HAVE_CRYPTOGRAPHY = False
+
+
+def require_cryptography(what: str) -> None:
+    """Raise a self-explanatory error (and leave a structured-log warning)
+    when the TLS cert path is exercised without `cryptography` installed.
+    Callers that can degrade (the operator's webhook startup) catch it and
+    keep serving with in-process admission only."""
+    if HAVE_CRYPTOGRAPHY:
+        return
+    from karpenter_core_tpu.obs.log import get_logger
+
+    get_logger("karpenter.webhooks").warning(
+        "webhook TLS unavailable: `cryptography` is not installed",
+        feature=what,
+    )
+    raise RuntimeError(
+        f"{what} requires the `cryptography` package, which is not "
+        "installed; HTTPS admission serving is disabled (in-process "
+        "admission remains active)"
+    )
+
 
 def generate_self_signed_cert(
     common_name: str = "karpenter-webhook",
@@ -40,6 +71,7 @@ def generate_self_signed_cert(
 ) -> Tuple[bytes, bytes]:
     """(cert_pem, key_pem) for the webhook server (knative cert generation
     analog)."""
+    require_cryptography("webhook serving-cert generation")
     from cryptography import x509
     from cryptography.hazmat.primitives import hashes, serialization
     from cryptography.hazmat.primitives.asymmetric import rsa
@@ -72,6 +104,7 @@ def generate_self_signed_cert(
 
 
 def cert_expiry(cert_pem: bytes) -> datetime.datetime:
+    require_cryptography("webhook cert-expiry inspection")
     from cryptography import x509
 
     return x509.load_pem_x509_certificate(cert_pem).not_valid_after_utc
@@ -248,7 +281,11 @@ class WebhookServer:
         return httpd.server_address[1]
 
     def start(self) -> int:
-        """Serve in a background thread; returns the bound port."""
+        """Serve in a background thread; returns the bound port. Raises a
+        clear RuntimeError (after a structured-log warning) when
+        `cryptography` is missing — the operator catches it and degrades
+        to in-process admission."""
+        require_cryptography("webhook HTTPS serving")
         cert_pem, key_pem = self.cert_manager.reconcile()
         port = self._serve(cert_pem, key_pem)
         self.port = port  # keep the bound port across rotation restarts

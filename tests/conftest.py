@@ -47,6 +47,12 @@ from karpenter_core_tpu.utils.compilecache import enable_persistent_cache  # noq
 
 enable_persistent_cache()
 
+# the operator entrypoint's startup AOT prewarm (solver/prewarm.py) stays
+# OFF in the test process: a background thread compiling ladder tiers would
+# steal the 2-core box from timing-sensitive tests. The prewarm suites
+# (tests/test_bucket_ladder.py) drive it explicitly.
+os.environ.setdefault("KARPENTER_PREWARM", "0")
+
 
 def pytest_configure(config):
     config.addinivalue_line(

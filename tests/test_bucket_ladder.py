@@ -1,0 +1,290 @@
+"""Bucket-ladder edge cases (ISSUE 7): tier boundaries, overflow past the
+top rung, dictionary/node growth bumping tiers, batcher clamping, and the
+prewarm-vs-live-solve race.
+
+The ladder's contract: every solve-shaping axis pads to a value from the
+FIXED tier table (api/settings.py), so the compiled-program set is bounded
+and enumerable — `compiled_programs` stays O(tiers) under mixed-geometry
+churn (the structural tripwire for that lives in test_perf_floor.py) and
+the startup prewarm can compile everything ahead of traffic.
+"""
+import threading
+
+import numpy as np
+import pytest
+
+import karpenter_core_tpu.api.settings as api_settings
+from karpenter_core_tpu.api.settings import (
+    DEFAULT_BUCKET_LADDER,
+    GeometryTier,
+    Settings,
+    parse_bucket_ladder,
+)
+from karpenter_core_tpu.cloudprovider import fake
+from karpenter_core_tpu.solver.encode import encode_snapshot, ladder_pad
+from karpenter_core_tpu.solver.tpu_solver import TPUSolver, solve_geometry
+from karpenter_core_tpu.testing import make_pod, make_provisioner
+
+SMALL_LADDER = (
+    GeometryTier("S", pods=128, items=32, instance_types=16, existing_nodes=8),
+    GeometryTier("M", pods=512, items=64, instance_types=32, existing_nodes=16),
+)
+
+
+@pytest.fixture()
+def small_ladder():
+    """Install a two-rung ladder for the duration of a test."""
+    prev = api_settings.current()
+    api_settings.set_current(Settings(bucket_ladder=SMALL_LADDER))
+    yield SMALL_LADDER
+    api_settings.set_current(prev)
+
+
+def _pods(n, prefix="p"):
+    return [
+        make_pod(labels={"app": f"{prefix}-{i}"},
+                 requests={"cpu": str(0.1 + 0.01 * (i % 7))})
+        for i in range(n)
+    ]
+
+
+def _universe(n=5):
+    return fake.instance_types(n)
+
+
+# -- ladder_pad semantics ----------------------------------------------------
+
+
+def test_ladder_pad_snaps_to_tier_values():
+    assert ladder_pad(0, SMALL_LADDER, "items", 32) == 0
+    assert ladder_pad(1, SMALL_LADDER, "items", 32) == 32
+    assert ladder_pad(32, SMALL_LADDER, "items", 32) == 32  # exact boundary
+    assert ladder_pad(33, SMALL_LADDER, "items", 32) == 64  # one past it
+    assert ladder_pad(64, SMALL_LADDER, "items", 32) == 64
+
+
+def test_ladder_pad_overflow_continues_pow2_and_counts():
+    from karpenter_core_tpu.metrics.registry import NAMESPACE, REGISTRY
+
+    # force the lazy counter to exist, then measure the delta
+    before_pad = ladder_pad(65, SMALL_LADDER, "items", 32)  # overflow: 128
+    counter = REGISTRY.counter(f"{NAMESPACE}_bucket_overflow_total")
+    before = counter.get({"axis": "items"})
+    assert before_pad == 128
+    assert ladder_pad(300, SMALL_LADDER, "items", 32) == 512
+    assert counter.get({"axis": "items"}) == before + 1
+
+
+def test_ladder_pad_without_ladder_is_pow2():
+    assert ladder_pad(20, (), "items", 32) == 32
+    assert ladder_pad(100, (), "items", 32) == 128
+
+
+# -- settings ----------------------------------------------------------------
+
+
+def test_parse_bucket_ladder_grammar():
+    tiers = parse_bucket_ladder("S:128:32:16:8, XL:65536:2048:512:1024")
+    assert [t.name for t in tiers] == ["S", "XL"]
+    assert tiers[1].instance_types == 512
+    with pytest.raises(ValueError):
+        parse_bucket_ladder("S:128:32:16")  # wrong arity
+    with pytest.raises(ValueError):
+        parse_bucket_ladder("S:128:32:16:8,M:64:64:32:16")  # non-monotonic
+    with pytest.raises(ValueError):
+        parse_bucket_ladder("")
+
+
+def test_settings_config_map_parses_ladder():
+    s = Settings.from_config_map({"bucketLadder": "S:16:8:4:2,M:32:16:8:4"})
+    assert len(s.bucket_ladder) == 2
+    assert s.bucket_ladder[0].pods == 16
+
+
+def test_effective_batch_max_pods_clamps_to_top_rung():
+    s = Settings(bucket_ladder=SMALL_LADDER)
+    # unset cap -> the ladder's top rung IS the cap (a bigger pass would
+    # mint an unlisted geometry)
+    assert s.effective_batch_max_pods() == 512
+    s.batch_max_pods = 100
+    assert s.effective_batch_max_pods() == 100
+    s.batch_max_pods = 100000
+    assert s.effective_batch_max_pods() == 512
+    # no ladder: the configured cap passes through untouched
+    s2 = Settings(bucket_ladder=(), batch_max_pods=7)
+    assert s2.effective_batch_max_pods() == 7
+
+
+def test_steady_state_tier_prefers_batch_cap_rung():
+    s = Settings(bucket_ladder=SMALL_LADDER, batch_max_pods=16)
+    assert s.steady_state_tier().name == "S"
+    s.batch_max_pods = 0
+    assert s.steady_state_tier().name == "M"
+
+
+# -- geometry snapping -------------------------------------------------------
+
+
+def test_tier_boundary_batches_share_one_program(small_ladder):
+    """Workloads at 30 and exactly-32 distinct items share one compiled
+    entry; 40 items bumps to the next rung — and both rungs' axes are
+    LISTED tier values, never ad-hoc pow2."""
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": _universe(5)}
+    solver = TPUSolver(max_nodes=48)
+    solver.solve(_pods(30), provisioners, its)
+    solver.solve(_pods(32), provisioners, its)
+    assert len(solver._compiled) == 1
+    solver.solve(_pods(40), provisioners, its)
+    assert len(solver._compiled) == 2
+    item_values = {t.items for t in small_ladder}
+    type_values = {t.instance_types for t in small_ladder}
+    for key in solver._compiled:
+        geom = key[0]
+        P_axis, _J, T_axis = geom[0], geom[1], geom[2]
+        assert P_axis in item_values
+        assert T_axis in type_values
+
+
+def test_node_growth_bumps_existing_tier(small_ladder):
+    """6 existing nodes pad to the S rung (8); 10 nodes cross it and pad
+    to the M rung (16) — a new listed geometry, not pow2's 16... which
+    here coincides, so assert through the tier table."""
+    from karpenter_core_tpu.state.node import StateNode
+    from karpenter_core_tpu.testing import make_node
+
+    universe = _universe(5)
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": universe}
+
+    def nodes(n):
+        out = []
+        for e in range(n):
+            it = universe[e % len(universe)]
+            out.append(StateNode(node=make_node(
+                name=f"gn-{e}",
+                labels={
+                    "karpenter.sh/provisioner-name": "default",
+                    "karpenter.sh/initialized": "true",
+                    "node.kubernetes.io/instance-type": it.name,
+                    "karpenter.sh/capacity-type": "on-demand",
+                    "topology.kubernetes.io/zone": "test-zone-1",
+                },
+                capacity={k: str(v) for k, v in it.capacity.items()},
+            )))
+        return out
+
+    snap6 = encode_snapshot(_pods(10), provisioners, its, None, nodes(6),
+                            max_nodes=48)
+    snap10 = encode_snapshot(_pods(10), provisioners, its, None, nodes(10),
+                             max_nodes=48)
+    e_values = {t.existing_nodes for t in small_ladder}
+    E6 = snap6.exist_used.shape[0]
+    E10 = snap10.exist_used.shape[0]
+    assert E6 == 8 and E10 == 16
+    assert {E6, E10} <= e_values
+    assert solve_geometry(snap6, 48)[3] == 8
+    assert solve_geometry(snap10, 48)[3] == 16
+
+
+def test_overflow_past_top_rung_still_solves(small_ladder):
+    """A direct solver call past the top rung's items axis (the batcher
+    would have split it — Settings.effective_batch_max_pods) falls back to
+    pow2 padding and still answers correctly."""
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": _universe(3)}
+    solver = TPUSolver(max_nodes=256)
+    n = 100  # > M.items (64) distinct specs -> overflow items axis
+    res = solver.solve(_pods(n), provisioners, its)
+    assert res.pod_count_new() + res.pod_count_existing() == n
+    geom = next(iter(solver._compiled))[0]
+    assert geom[0] == 128  # pow2 continuation above the 64 rung
+
+
+# -- prewarm ----------------------------------------------------------------
+
+
+def test_prewarm_then_live_solve_hits_cache(small_ladder):
+    from karpenter_core_tpu.solver.prewarm import prewarm, synthetic_workload
+    from karpenter_core_tpu.utils.compilecache import CACHE_HITS, CACHE_MISSES
+
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": _universe(5)}
+    solver = TPUSolver(max_nodes=48)
+    settings = Settings(bucket_ladder=(SMALL_LADDER[0],))
+    outcomes = prewarm(solver, provisioners, its, settings=settings)
+    assert outcomes == {"S": "compiled"}
+    assert len(solver._compiled) == 1
+    (fn, pre_fn) = next(iter(solver._compiled.values()))
+    assert fn.aot is not None  # the AOT executable is attached
+
+    hits0 = CACHE_HITS.get({"site": "tpu_solver"})
+    misses0 = CACHE_MISSES.get({"site": "tpu_solver"})
+    pods, nodes = synthetic_workload(SMALL_LADDER[0], provisioners, its)
+    res = solver.solve(pods[:40], provisioners, its, state_nodes=nodes)
+    assert res.pod_count_new() + res.pod_count_existing() == 40
+    assert len(solver._compiled) == 1  # no second program minted
+    assert CACHE_HITS.get({"site": "tpu_solver"}) == hits0 + 1
+    assert CACHE_MISSES.get({"site": "tpu_solver"}) == misses0
+
+
+def test_prewarm_vs_live_solve_race(small_ladder):
+    """A solve arriving while the prewarm thread compiles the same tier
+    must produce a correct answer and no duplicate compile: the per-key
+    lock serializes creation, so exactly one entry exists afterward."""
+    from karpenter_core_tpu.solver.prewarm import prewarm, synthetic_workload
+
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": _universe(5)}
+    solver = TPUSolver(max_nodes=48)
+    settings = Settings(bucket_ladder=(SMALL_LADDER[0],))
+    pods, nodes = synthetic_workload(SMALL_LADDER[0], provisioners, its)
+
+    outcomes = {}
+    t = threading.Thread(
+        target=lambda: outcomes.update(
+            prewarm(solver, provisioners, its, settings=settings)
+        ),
+        daemon=True, name="test-prewarm",
+    )
+    t.start()
+    res = solver.solve(pods[:40], provisioners, its, state_nodes=nodes)
+    t.join(timeout=300)
+    assert not t.is_alive()
+    assert res.pod_count_new() + res.pod_count_existing() == 40
+    # whoever won built the single entry; the loser adopted it
+    assert len(solver._compiled) == 1
+    assert outcomes["S"] in ("compiled", "cached")
+    # the answer served mid-prewarm is byte-identical to a post-prewarm
+    # solve of the same batch (placement parity across the race)
+    res2 = solver.solve(pods[:40], provisioners, its, state_nodes=nodes)
+    placed = lambda r: sorted(  # noqa: E731
+        (p.metadata.name, m.template.provisioner_name)
+        for m in r.new_machines for p in m.pods
+    )
+    existing = lambda r: sorted(  # noqa: E731
+        (p.metadata.name, n.name()) for n, ps in r.existing_assignments
+        for p in ps
+    )
+    assert placed(res) == placed(res2)
+    assert existing(res) == existing(res2)
+
+
+def test_synthetic_workload_lands_on_tier(small_ladder):
+    """The prewarm's synthetic snapshot must mint EXACTLY the tier's
+    geometry — that equality is what makes prewarmed entries hittable."""
+    from karpenter_core_tpu.solver.prewarm import synthetic_workload
+
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": _universe(5)}
+    tier = SMALL_LADDER[1]
+    pods, nodes = synthetic_workload(tier, provisioners, its)
+    snap = encode_snapshot(pods, provisioners, its, None, nodes, max_nodes=48)
+    geom = solve_geometry(snap, 48)
+    assert geom[0] == tier.items  # item axis
+    # the type axis rides the REAL universe (5 types -> the S rung), same
+    # snap a live solve against this universe makes — that equality, not
+    # the tier's own value, is what makes the prewarmed entry hittable
+    assert geom[2] == ladder_pad(5, small_ladder, "instance_types", 1)
+    assert geom[3] == tier.existing_nodes  # existing axis
+    assert snap.item_pad == tier.items

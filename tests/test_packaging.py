@@ -237,21 +237,47 @@ def _render_helm(text: str, values: dict, name: str) -> str:
                 raise AssertionError(f"unknown pipe: {pipe}")
         return str(val)
 
-    # strip if/end blocks by evaluating the condition against values
+    # strip if/else-if/else/end blocks by evaluating conditions against
+    # values; conditions are .Values truthiness or (eq|ne .Values.x "lit")
+    def eval_cond(cond):
+        cond = cond.strip()
+        cmp_m = re.match(r'(eq|ne)\s+(\.Values[\w.]*)\s+"([^"]*)"', cond)
+        if cmp_m:
+            op, path, lit = cmp_m.groups()
+            val = lookup(path[1:])
+            return (val == lit) if op == "eq" else (val != lit)
+        if cond.startswith(".Values"):
+            return bool(lookup(cond[1:]))
+        raise AssertionError(f"unknown condition: {cond}")
+
     out_lines = []
-    stack = [True]  # emit-state
+    # each frame: emit (this branch renders), taken (some branch already
+    # rendered), parent (enclosing emit-state)
+    stack = [{"emit": True, "taken": True, "parent": True}]
     for line in text.splitlines():
         s = line.strip()
-        m = re.match(r"\{\{-? if\s*(.*?)\s*-?\}\}", s)
+        m = re.match(r"\{\{-?\s*if\s+(.*?)\s*-?\}\}", s)
         if m:
-            cond = m.group(1).strip()
-            val = lookup(cond[1:]) if cond.startswith(".Values") else None
-            stack.append(stack[-1] and bool(val))
+            parent = stack[-1]["emit"]
+            on = parent and eval_cond(m.group(1))
+            stack.append({"emit": on, "taken": on, "parent": parent})
             continue
-        if re.match(r"\{\{-? end\s*-?\}\}", s):
+        m = re.match(r"\{\{-?\s*else\s+if\s+(.*?)\s*-?\}\}", s)
+        if m:
+            frame = stack[-1]
+            on = frame["parent"] and not frame["taken"] and eval_cond(m.group(1))
+            frame["emit"] = on
+            frame["taken"] = frame["taken"] or on
+            continue
+        if re.match(r"\{\{-?\s*else\s*-?\}\}", s):
+            frame = stack[-1]
+            frame["emit"] = frame["parent"] and not frame["taken"]
+            frame["taken"] = True
+            continue
+        if re.match(r"\{\{-?\s*end\s*-?\}\}", s):
             stack.pop()
             continue
-        if not stack[-1]:
+        if not stack[-1]["emit"]:
             continue
         line = re.sub(
             r"\{\{-?\s*(.*?)\s*-?\}\}", lambda m: render_expr(m.group(1)), line

@@ -143,7 +143,8 @@ def _tripwire_snapshot():
     'a contraction producing an N-sized axis' identifies the full-width
     slot screen unambiguously: 20 distinct pods (item bucket 32 = C), 3
     existing nodes (E_pad 8), max_nodes 48 -> N = 8 + 48 = 56, colliding
-    with none of I=32, V=32, K=11, E=8, T=5, R=4, screen_v=24."""
+    with none of I=32, V=32, K=11, E=8, T=8 (5 types padded to the S
+    tier), R=4, screen_v=24."""
     from karpenter_core_tpu.solver.encode import encode_snapshot
     from karpenter_core_tpu.state.node import StateNode
     from karpenter_core_tpu.testing import make_node
@@ -268,6 +269,67 @@ def test_prescreen_compiled_program_guard():
     assert fn is not None and pre_fn is not None, (
         "prescreen entry must pair the solve program with its precompute"
     )
+
+
+def test_bucket_ladder_program_budget():
+    """ISSUE 7 tripwire: a mixed-geometry churn sequence — batch sizes
+    crossing item-tier boundaries, node counts appearing and vanishing —
+    must keep `compiled_programs` within 3x the configured bucket ladder,
+    and every minted geometry's snapped axes must be LISTED tier values
+    (the ladder, not ad-hoc pow2, bounds the program set)."""
+    from karpenter_core_tpu.solver.encode import resolve_ladder
+    from karpenter_core_tpu.state.node import StateNode
+    from karpenter_core_tpu.testing import make_node
+
+    ladder = resolve_ladder(None)
+    assert ladder, "default Settings must carry a bucket ladder"
+    universe = fake.instance_types(5)
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": universe}
+
+    def nodes(n):
+        out = []
+        for e in range(n):
+            it = universe[e % len(universe)]
+            out.append(StateNode(node=make_node(
+                name=f"churn-node-{e}",
+                labels={
+                    "karpenter.sh/provisioner-name": "default",
+                    "karpenter.sh/initialized": "true",
+                    "node.kubernetes.io/instance-type": it.name,
+                    "karpenter.sh/capacity-type": "on-demand",
+                    "topology.kubernetes.io/zone": "test-zone-1",
+                },
+                capacity={k: str(v) for k, v in it.capacity.items()},
+            )))
+        return out
+
+    solver = TPUSolver(max_nodes=64)
+    # churn: pod counts sweep across the first item-tier boundary (32),
+    # node counts flip between none and a few
+    for n_pods, n_nodes in [(6, 0), (12, 3), (20, 0), (30, 3), (40, 0),
+                            (50, 3), (26, 0), (10, 3), (34, 0), (16, 3)]:
+        pods = [
+            make_pod(labels={"app": f"c{i}"},
+                     requests={"cpu": str(0.1 + 0.01 * (i % 9))})
+            for i in range(n_pods)
+        ]
+        res = solver.solve(pods, provisioners, its, state_nodes=nodes(n_nodes))
+        assert res.pod_count_new() + res.pod_count_existing() == n_pods
+
+    budget = 3 * len(ladder)
+    assert len(solver._compiled) <= budget, (
+        f"mixed-geometry churn minted {len(solver._compiled)} compiled "
+        f"entries > 3 x {len(ladder)} configured buckets"
+    )
+    item_values = {t.items for t in ladder}
+    type_values = {t.instance_types for t in ladder}
+    exist_values = {t.existing_nodes for t in ladder} | {0}
+    for key in solver._compiled:
+        geom = key[0]
+        assert geom[0] in item_values, f"item axis {geom[0]} off-ladder"
+        assert geom[2] in type_values, f"type axis {geom[2]} off-ladder"
+        assert geom[3] in exist_values, f"existing axis {geom[3]} off-ladder"
 
 
 @perf_gate
