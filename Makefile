@@ -3,7 +3,7 @@
 # backend with 8 virtual devices via tests/conftest.py.
 
 .PHONY: test deflake perf bench verify trace-demo chaos chaos-smoke \
-	replay-demo lint soak soak-smoke prewarm-smoke
+	replay-demo lint soak soak-smoke prewarm-smoke multichip-smoke
 
 test:  ## tier-1 suite (CPU, 8 virtual devices); slow chaos soaks: make chaos
 	python -m pytest tests -q -m "not slow"
@@ -45,6 +45,9 @@ soak-smoke:  ## <=30s seeded churn smoke (CI gate: admission SLOs + delta re-sol
 prewarm-smoke:  ## warm-cache restart gate: prewarm a tier, restart fresh, first solve under budget
 	JAX_PLATFORMS=$${JAX_PLATFORMS:-cpu} python hack/prewarm_smoke.py
 
+multichip-smoke:  ## virtual 8-device GSPMD parity (byte-identical) + speedup sanity
+	python hack/multichip_smoke.py
+
 verify:  ## driver hooks: single-chip compile check + 8-way mesh dryrun
 	# force the CPU backend in-process: this image's sitecustomize pins the
 	# axon TPU tunnel (env vars can't override it), and a wedged tunnel
@@ -74,3 +77,6 @@ verify:  ## driver hooks: single-chip compile check + 8-way mesh dryrun
 	# non-fatal smoke: a prewarmed persistent cache must make a restarted
 	# process's first solve fast (fatal gate lives in presubmit)
 	-$(MAKE) prewarm-smoke
+	# non-fatal smoke: GSPMD mesh parity (byte-identical placements) +
+	# multichip speedup sanity on 8 virtual devices (fatal in presubmit)
+	-$(MAKE) multichip-smoke

@@ -1013,6 +1013,74 @@ def main():
                                 **warm_restart}
             print(f"[bench] warm restart: {warm_restart}", file=sys.stderr)
 
+    # -- multichip same-host A/B (ISSUE 8): when the factory served the
+    # GSPMD mesh path, measure `sharded_speedup` = warm single-device wall
+    # over warm mesh wall on the SAME headline batch, assert the
+    # placements are byte-identical, and record the mesh shape + the mesh
+    # path's per-phase timings as first-class columns. The PR 5 probe
+    # short-circuit covers this stage by construction: it only runs inside
+    # a worker whose backend probe SUCCEEDED (a wedged TPU tunnel already
+    # cost exactly one probe timeout at the orchestrator and fell back to
+    # a single-device CPU worker, where mesh is None and the stage is
+    # skipped), and the in-worker budget check sheds it before the
+    # watchdog can eat the round.
+    multichip = None
+    if getattr(solver, "mesh", None) is not None and (
+        os.environ.get("BENCH_SKIP_MULTICHIP", "") != "1"
+    ):
+        if _worker_time_left() < 240:
+            multichip = {"skipped": "worker budget low"}
+            print("[bench] multichip A/B skipped: worker budget low",
+                  file=sys.stderr)
+        else:
+            try:
+                from karpenter_core_tpu.obs.flightrec import (
+                    canonical_placements,
+                    placements_json,
+                )
+
+                mc_single = TPUSolver(max_nodes=MAX_NODES)
+                pods, provisioners, its, nodes = workload(
+                    N_PODS, N_EXISTING, 4242
+                )
+
+                def _mc_run(s):
+                    return s.solve(
+                        pods, provisioners, its,
+                        state_nodes=[n.deep_copy() for n in nodes],
+                    )
+
+                res_m = _mc_run(solver)  # mesh programs are already warm
+                res_s = _mc_run(mc_single)  # pays the single-path compile
+                identical = placements_json(
+                    canonical_placements(res_m)
+                ) == placements_json(canonical_placements(res_s))
+                m_ts, s_ts = [], []
+                for _ in range(3):  # interleaved warm A/B
+                    t0 = time.perf_counter()
+                    _mc_run(solver)
+                    m_ts.append(time.perf_counter() - t0)
+                    t0 = time.perf_counter()
+                    _mc_run(mc_single)
+                    s_ts.append(time.perf_counter() - t0)
+                mesh = solver.mesh
+                multichip = {
+                    "mesh_dp": int(mesh.shape["dp"]),
+                    "mesh_tp": int(mesh.shape["tp"]),
+                    "path": solver.last_path,
+                    "sharded_ms": round(min(m_ts) * 1e3, 1),
+                    "single_ms": round(min(s_ts) * 1e3, 1),
+                    "sharded_speedup": round(min(s_ts) / max(min(m_ts), 1e-9), 3),
+                    "byte_identical": bool(identical),
+                    "sharded_phases_ms": dict(solver.last_phase_ms),
+                }
+                print(f"[bench] multichip A/B: {multichip}", file=sys.stderr)
+            except BaseException as exc:  # noqa: BLE001 — record and move on
+                import traceback
+
+                traceback.print_exc()
+                multichip = {"error": f"{type(exc).__name__}: {exc}"[:200]}
+
     print(
         f"[bench] e2e p50={p50 * 1e3:.0f}ms p99={p99 * 1e3:.0f}ms "
         f"device_med={device_ms:.0f}ms compiled_programs={compiled}",
@@ -1075,6 +1143,19 @@ def main():
                     "warm_restart": warm_restart,
                     "compiled_programs_after_varied_batches": compiled,
                     "solver": solver_desc,
+                    # first-class MULTICHIP columns (ISSUE 8): the same-host
+                    # sharded-vs-single ratio, mesh shape, and the mesh
+                    # path's phase breakdown; null on single-device workers
+                    "sharded_speedup": (
+                        multichip.get("sharded_speedup")
+                        if isinstance(multichip, dict) else None
+                    ),
+                    "mesh": (
+                        f"dp={multichip['mesh_dp']},tp={multichip['mesh_tp']}"
+                        if isinstance(multichip, dict)
+                        and "mesh_dp" in multichip else None
+                    ),
+                    "multichip": multichip,
                     "chips": len(jax.devices()),
                     "backend_probe": PROBE_LOG,
                     "consolidation": cons,

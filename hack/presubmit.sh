@@ -26,6 +26,9 @@ make soak-smoke
 echo "== presubmit: make prewarm-smoke (warm-cache restart under budget)"
 make prewarm-smoke
 
+echo "== presubmit: make multichip-smoke (GSPMD parity + speedup sanity)"
+make multichip-smoke
+
 if [[ "${1:-}" != "quick" ]]; then
   echo "== presubmit: short deflake (3 iterations)"
   MAX_ITERS=3 ./hack/deflake.sh

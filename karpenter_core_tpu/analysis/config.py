@@ -44,10 +44,15 @@ DEFAULT_LAYERING: Dict[str, FrozenSet[str]] = {
     "state": frozenset({"api", "chaos", "kube", "obs", "scheduling", "utils"}),
     "ops": frozenset({"metrics", "obs", "utils"}),
     "native": frozenset({"metrics", "obs", "utils"}),
-    "parallel": frozenset({"chaos", "metrics", "obs", "ops", "utils"}),
+    # ISSUE 8 re-layering: parallel sits ABOVE solver now — ShardedSolver
+    # is a TPUSolver subclass that swaps in the GSPMD mesh program family
+    # (parallel/sharded.py), so parallel may see solver and solver may NOT
+    # see parallel at module scope (factory/service reach it lazily,
+    # function-scope, which the pass exempts)
+    "parallel": frozenset({"chaos", "metrics", "obs", "ops", "solver", "utils"}),
     "solver": frozenset({
         "api", "chaos", "cloudprovider", "events", "kube", "metrics", "native",
-        "obs", "ops", "parallel", "scheduling", "state", "utils",
+        "obs", "ops", "scheduling", "state", "utils",
     }),
     "controllers": frozenset({
         "api", "chaos", "cloudprovider", "events", "kube", "metrics", "native",

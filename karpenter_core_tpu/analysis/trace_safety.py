@@ -14,11 +14,19 @@ Detection is name-taint based and deliberately conservative:
   1. Find traced functions: `@jax.jit`-style decorators, and functions whose
      NAME is passed to jit/pjit/shard_map/vmap in the same module (assignment
      chains like `sharded = shard_map(body, ...); jax.jit(sharded)` are
-     followed one level).
+     followed one level). NamedSharding-jit mesh-program bodies (ISSUE 8:
+     `jax.jit(body, in_shardings=..., donate_argnums=...)` and bodies that
+     apply with_sharding_constraint via a SpecLayout) are the same `jit`
+     spelling, so they are covered by the same name-based detection.
   2. Taint the function's parameters, then propagate through simple
      assignments whose RHS mentions a tainted name.
-  3. Flag `if`/`while` tests, coercion calls, and `np.*` calls that touch a
-     tainted name.
+  3. Flag `if`/`while` tests, coercion calls, `np.*` calls, and explicit
+     host transfers (`jax.device_get` / `device_get`) that touch a
+     tainted name — inside a mesh program a host transfer is a
+     cross-device sync of EVERY shard, not just one chip's stall.
+     (`device_put` inside a jitted body is deliberately NOT flagged: it
+     is on-device placement, not a host round-trip — see the jaxpr
+     tripwire in tests/test_sharded.py.)
 
 Functions produced by factories (`jax.jit(make_device_run(...))`) are out of
 static reach — the kernels those factories close over are covered by their
@@ -33,6 +41,11 @@ from karpenter_core_tpu.analysis.core import Pass, SourceFile, Violation
 
 COERCIONS = {"bool", "float", "int"}
 NUMPY_ALIASES = {"np", "numpy"}
+# explicit host-transfer calls: flagged on tainted values inside any traced
+# body — jit, shard_map, or a NamedSharding-jit mesh-program body, where
+# the sync stalls every device on the mesh. device_put is NOT here: inside
+# a jitted body it lowers to on-device placement, not a host round-trip.
+HOST_TRANSFERS = {"device_get"}
 
 
 def _called_name(func: ast.expr) -> Optional[str]:
@@ -169,6 +182,26 @@ class TraceSafetyPass(Pass):
                     ))
             elif isinstance(node, ast.Call):
                 callee = node.func
+                transfer = None
+                if isinstance(callee, ast.Name) and callee.id in HOST_TRANSFERS:
+                    transfer = callee.id
+                elif (
+                    isinstance(callee, ast.Attribute)
+                    and callee.attr in HOST_TRANSFERS
+                ):
+                    transfer = callee.attr
+                if transfer is not None:
+                    hit = set()
+                    for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                        hit |= _names_in(arg) & tainted
+                    if hit:
+                        flag(node, (
+                            f"`{transfer}` host transfer on traced value(s) "
+                            f"{', '.join(sorted(hit))} — inside a mesh "
+                            "program this syncs every device; fetch after "
+                            "the program returns"
+                        ))
+                    continue
                 if isinstance(callee, ast.Name) and callee.id in COERCIONS:
                     hit = set()
                     for arg in node.args:

@@ -205,14 +205,24 @@ def make_screen_ops(segments, backend, screen_v):
     return ops
 
 
-def make_prescreen_kernel(segments, n_slots, backend=None, screen_v=None):
+def make_prescreen_kernel(segments, n_slots, backend=None, screen_v=None,
+                          spec_layout=None):
     """Build the standalone jittable prescreen: (pod item planes, existing
     planes) -> [N, C] slot-major verdict tensor over the deduped class
     columns (pod_arrays["scls_first"], identity when absent). TPUSolver
     dispatches this as its own (geometry-cached) program so the precompute
     is host-visible as the solver.phase.prescreen span; pack() computes the
     identical tensor internally when no screen0 is handed in
-    (rung/sharded/service paths)."""
+    (rung/service paths).
+
+    spec_layout (parallel/specs.SpecLayout) turns this into a GSPMD mesh
+    program: the existing-slot rows constrain over 'dp' and the class
+    columns over 'tp', so the bf16 screen contractions compute as
+    communication-free (dp x tp) tiles of the [N, C] tensor; the final
+    gather is the one XLA-inserted all_gather that reassembles the rows
+    for the (replicated) pack scan. Sharding only tiles output axes —
+    never a contraction axis — so the tensor is byte-identical to the
+    single-device program's."""
     backend = backend or compat.resolve_backend()
     ops = make_screen_ops(list(segments), backend, screen_v)
 
@@ -222,15 +232,29 @@ def make_prescreen_kernel(segments, n_slots, backend=None, screen_v=None):
             k: (pod_arrays[k] if sf is None else pod_arrays[k][sf])
             for k in ("allow", "out", "defined", "escape", "custom_deny")
         }
-        return ops.initial_screen(
-            items, exist["allow"], exist["out"], exist["defined"], n_slots
-        )
+        e_allow, e_out, e_def = exist["allow"], exist["out"], exist["defined"]
+        if spec_layout is not None:
+            ly = spec_layout
+            cols = ly.type_plane()  # class-column rows ride the tp family
+            items = {k: ly.constrain(v, cols) for k, v in items.items()}
+            rows = ly.slot_plane()
+            e_allow = ly.constrain(e_allow, rows)
+            e_out = ly.constrain(e_out, rows)
+            e_def = ly.constrain(e_def, rows)
+        scr = ops.initial_screen(items, e_allow, e_out, e_def, n_slots)
+        if spec_layout is not None:
+            scr = spec_layout.constrain(scr, spec_layout.verdict())
+            # gather + process-unique persistent-cache key on CPU
+            # (specs.SpecLayout.cache_salt — semantic no-op)
+            scr = spec_layout.cache_salt(spec_layout.gather(scr))
+        return scr
 
     return prescreen
 
 
 def make_screen_refresh_kernel(segments, n_slots, rb: int, cb: int,
-                               backend=None, screen_v=None):
+                               backend=None, screen_v=None,
+                               spec_layout=None):
     """Delta refresh of a RESIDENT [N, C] verdict tensor — the incremental
     re-solve path's device program (solver/incremental.py).
 
@@ -251,12 +275,26 @@ def make_screen_refresh_kernel(segments, n_slots, rb: int, cb: int,
     exact construction initial_screen uses). Overlapping (row, col) cells
     are written twice with the same value, so update order is immaterial.
     Semantics are bool-exact vs make_prescreen_kernel: both evaluate the
-    same 0/1 indicator algebra through the same screen ops."""
+    same 0/1 indicator algebra through the same screen ops.
+
+    spec_layout (the GSPMD mesh path): the refresh pins EVERYTHING
+    replicated — inputs, scatters, output. The compute is delta-sized so
+    sharding it buys nothing, and the pin is the same correctness fence
+    the pack scan needs: with mesh-committed inputs (the resident tensor
+    is a mesh-program output) the auto-partitioned scatter miscomputed on
+    the CPU backend, which surfaced as stale verdict columns on the
+    second-and-later solves of a steady-state mesh churn sequence."""
     backend = backend or compat.resolve_backend()
     ops = make_screen_ops(list(segments), backend, screen_v)
 
     def refresh(prev_screen, pod_arrays, exist, row_idx, row_n, col_idx,
                 col_n):
+        if spec_layout is not None:
+            g = spec_layout.gather
+            prev_screen = g(prev_screen)
+            pod_arrays = {k: g(v) for k, v in pod_arrays.items()}
+            exist = {k: g(v) for k, v in exist.items()}
+            row_idx, col_idx = g(row_idx), g(col_idx)
         sf = pod_arrays.get("scls_first")
         items = {
             k: (pod_arrays[k] if sf is None else pod_arrays[k][sf])
@@ -297,6 +335,10 @@ def make_screen_refresh_kernel(segments, n_slots, rb: int, cb: int,
             on_c = jnp.arange(cb) < col_n
             tcol = jnp.where(on_c, col_idx, C)  # OOB columns drop
             scr = scr.at[:, tcol].set(full_col, mode="drop")
+        if spec_layout is not None:
+            # process-unique persistent-cache key on CPU (semantic no-op;
+            # specs.SpecLayout.cache_salt)
+            scr = spec_layout.cache_salt(scr)
         return scr
 
     return refresh

@@ -1,769 +1,131 @@
-"""Multi-chip sharded solve over a jax.sharding.Mesh.
+"""Multi-chip solve: ONE jit-compiled GSPMD program on a ('dp','tp') mesh.
 
-Scaling design (the "DP/TP" of this framework — SURVEY.md section 2.7):
-  - 'dp'  : the REPLICA COUNT axis is sharded across devices — every
-            device sees the same item (pod-equivalence-class) rows but
-            packs its share of each class's replicas into its own
-            node-slot budget (independent greedy sub-solves; machines are
-            disjoint by construction, so the merge is a concat). Splitting
-            counts instead of item rows keeps per-device work balanced even
-            when one deployment dominates the batch. This is how 50k-pod
-            batches ride ICI.
-  - 'tp'  : the INSTANCE-TYPE axis of the feasibility matmuls is sharded;
-            each device computes F over its type columns, then an
-            all_gather over 'tp' reassembles the [I, T] row an item
-            needs for packing. The gather rides ICI (XLA collective), not
-            host memory.
+Architecture (the ISSUE 8 rebuild — see docs/sharding.md for the
+per-tensor PartitionSpec table and collective inventory):
 
-Topology (round 2): domain counts are global mutable state, so
-topology-entangled work cannot split freely. Items are partitioned into
-COMPONENTS by union-find over the topology groups they own or select into
-(two groups sharing a pod must co-locate); each component is routed whole
-to one 'dp' shard (LPT on replica counts), so every group's counts evolve
-on exactly one device and the per-shard solve follows the reference
-semantics (topologygroup.go:155-243) with no cross-shard races.
-Topology-free items still split evenly. Every shard carries the full
-[G, V] count state; only its own groups' rows ever change. SLOT-LOCAL
-hostname groups are the exception and split freely: hostname spread
-(round 4 of the previous session) and hostname anti-affinity (round 4 —
-separation across disjoint shard slots can only over-satisfy the
-constraint; see plan_shards).
+The previous multi-chip path split the batch's replica counts across dp
+shards and ran an independent pack scan per device under shard_map, with
+host-side plan/split/merge orchestration around it. MULTICHIP_r05 proved
+it correct (0.0% quality delta at 50k pods) and slow (35.3s wall vs the
+sub-second goal): every shard still pays the full sequential scan, the
+per-shard slot budgets force encode at shard-local geometry, and the
+host-side shard orchestration (plan_shards / shard_args / per-shard log
+merge) sat on the critical path of every solve.
 
-Existing nodes (round 2): each existing node is OWNED by one shard
-(round-robin); all shards carry the slots [0, E) at the same indices but
-non-owned slots stay closed, so capacity can never be double-booked. A
-topology component whose pods could have landed on another shard's
-existing node opens a new machine instead — a valid (possibly costlier)
-packing, never a constraint violation.
+The rebuild inverts the design: the multi-chip solve IS the single-device
+program — the PR 5 prescreen + pack scan, the PR 6 incremental refresh,
+the PR 7 bucket-ladder/AOT-prewarm machinery, all of it — jit-compiled
+once with canonical NamedSharding constraints (parallel/specs.SpecLayout)
+at the precompute seams:
 
-Provisioner limits are coordinated pessimistically: the remaining-resource
-budget is pre-split across 'dp' shards proportional to each shard's replica
-load (a conservative under-approximation of the reference's global
-subtract_max accounting, scheduler.go:276-293).
+  * the [N, C] prescreen verdict tensor and its bf16 screen contractions
+    compute as (dp x tp) tiles — slot rows over 'dp', class columns over
+    'tp' — with zero communication (no contraction axis is ever split);
+  * the static-feasibility planes compute item-rows-over-'dp' x
+    type-columns-over-'tp', instance-type planes replicated over 'dp'
+    and sharded over 'tp';
+  * ONE XLA-inserted all_gather per precompute rides ICI to reassemble
+    the tensors for the sequential pack scan, which runs replicated
+    (its carry is a chain of small per-step updates; resharding it would
+    cost a collective per scan step).
+
+Because sharding only tiles output axes, the compiled math is identical
+and placements are BYTE-IDENTICAL (flightrec-canonical) to the
+single-device program — asserted by tests/test_sharded.py across the
+screen-parity geometry families. That identity is what lets ShardedSolver
+be a TPUSolver subclass: the compiled-program LRU, GeometryTier cache
+keys, startup AOT prewarm, and the incremental-refresh residency all
+apply to mesh programs unchanged (keys carry the mesh shape so the two
+program families never collide).
+
+Small batches skip the mesh entirely: below MIN_SPLIT_REPLICAS_PER_SHARD
+replicas per dp row the collective/mesh overhead outweighs any precompute
+parallelism, so _layout_for routes the solve through the plain
+single-device program on device 0 (same cache, different key namespace).
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Tuple
+from typing import Optional
 
 import numpy as np
 
+from karpenter_core_tpu.parallel.specs import SpecLayout, layout_for
+from karpenter_core_tpu.solver.tpu_solver import TPUSolver
 
-def plan_shards(snap, ndp: int) -> Tuple[np.ndarray, np.ndarray]:
-    """Partition the batch across dp shards.
-
-    Returns (count_split [ndp, I], exist_owner [ndp, E] bool).
-
-    Topology-entangled items (owning or selected into any group) are routed
-    whole: union-find joins groups sharing an item, components go to shards
-    by longest-processing-time on replica count, and every item of a
-    component lands on its shard. Free items split evenly with remainders
-    to the low shards.
-    """
-    counts = (
-        snap.item_counts
-        if snap.item_counts is not None
-        else np.ones(len(snap.pods), dtype=np.int32)
-    )
-    # the exist axis is bucket-padded at encode; sentinel rows [E_real, E_pad)
-    # stay unowned, i.e. closed on every shard
-    E_pad = snap.exist_used.shape[0] if snap.exist_used is not None else 0
-    E = len(snap.state_nodes)
-    touch = None
-    if snap.topo_meta is not None and len(snap.topo_meta.groups) > 0:
-        rep = snap.item_rep
-        touch = (snap.topo_arrays.owner | snap.topo_arrays.sel)[:, rep]  # [G, I]
-    return plan_shards_arrays(counts, E, E_pad, ndp, touch, snap.topo_meta)
+__all__ = [
+    "ShardedSolver",
+    "MIN_SPLIT_REPLICAS_PER_SHARD",
+    "route_to_mesh",
+    "SpecLayout",
+    "layout_for",
+]
 
 
-# below this many replicas per dp shard the split costs more packing
-# quality than it buys in parallelism (per-shard leftovers + components
-# that can't share nodes across shards dominate): route the WHOLE batch to
-# shard 0 with single-device semantics. Production small batches route to
-# the host FFD before reaching here (ResilientSolver); this guards direct
-# ShardedSolver use.
+# below this many replicas per dp mesh row the mesh program's collective /
+# multi-device dispatch overhead costs more than the sharded precompute
+# buys: route the WHOLE batch through the plain single-device program.
+# Production small batches route to the host FFD before reaching here
+# (ResilientSolver); this guards direct ShardedSolver use and the gRPC
+# service, whose clients send whatever the batcher accumulated.
 MIN_SPLIT_REPLICAS_PER_SHARD = 32
 
 
-def plan_shards_arrays(counts, E_real: int, E_pad: int, ndp: int,
-                       touch=None, topo_meta=None,
-                       ) -> Tuple[np.ndarray, np.ndarray]:
-    """Array-level core of plan_shards: counts [I] replica counts per item,
-    touch [G, I] bool (item owns/selects into group g) or None. Shared by
-    the snapshot path (plan_shards) and the gRPC service, which rebuilds
-    `touch` from the wire tensors (pod_arrays/topo_own|topo_sel)."""
-    counts = np.asarray(counts).astype(np.int64)
-    I = len(counts)
-    exist_owner = np.zeros((ndp, E_pad), dtype=bool)
-
-    total = int(counts.sum())
-    # single-shard threshold: the per-dp work floor, with an absolute cap
-    # so a huge mesh (dp=64) never serializes thousands of replicas onto
-    # one chip. A single-shard batch that exhausts shard 0's slot budget
-    # retries with a TRANSIENT doubling (ShardedSolver._solve_once keeps
-    # growth non-sticky when the plan didn't split), so no permanent
-    # geometry cliff hides here.
-    threshold = min(ndp * MIN_SPLIT_REPLICAS_PER_SHARD, 256)
-    if total < threshold:
-        # too small to split: shard 0 owns every replica AND every existing
-        # node, making the result exactly the single-device packing
-        count_split = np.zeros((ndp, I), dtype=np.int32)
-        count_split[0] = counts
-        exist_owner[0, :E_real] = True
-        return count_split, exist_owner
-
-    for e in range(E_real):
-        exist_owner[e % ndp, e] = True
-
-    # even base split; remainders ROUND-ROBIN by item index. Sending every
-    # remainder to the low shards (pre-round-5) piled ALL the replicas of a
-    # batch of one-replica items onto shard 0 — a 100-pod no-topology batch
-    # ran entirely serial (the water-fill rebalance below only runs when
-    # topology groups exist).
-    count_split = np.tile(counts // ndp, (ndp, 1)).astype(np.int32)
-    rem = (counts % ndp).astype(np.int64)
-    d_idx = np.arange(ndp, dtype=np.int64)[:, None]
-    i_idx = np.arange(I, dtype=np.int64)[None, :]
-    count_split += (((d_idx - i_idx) % ndp) < rem[None, :]).astype(np.int32)
-
-    if touch is not None and topo_meta is not None and len(topo_meta.groups) > 0:
-        from karpenter_core_tpu.ops import topology as topo_mod
-        # hostname SPREAD groups split freely: their counts live in the
-        # per-SLOT thost lane and slots are disjoint across dp shards (fresh
-        # slots open on one shard; existing slots are owned), so every
-        # domain's count evolves on exactly one device and the global
-        # min-count/skew rule reduces to the local one (fresh empty slots
-        # pin min=0 on every shard, as globally). Routing them whole was
-        # round 3's dominant packing-quality loss: the one shard holding
-        # the hostname component monopolized the colocation headroom that
-        # other shards' hostPort/generic pods needed.
-        #
-        # hostname ANTI groups (direct and inverse, no filter terms) split
-        # freely too: the constraint is pairwise SEPARATION on the slot
-        # axis, so placing its pods on different shards' disjoint slots can
-        # only over-satisfy it — owners repel selector-matching pods, which
-        # therefore could never have co-located with them anyway, and the
-        # within-shard thost lane enforces the rule among same-shard
-        # replicas exactly. Existing slots are owned by one shard, so the
-        # identically-seeded existing columns never race. Value-key
-        # affinity/anti stay routed (their assume/seed semantics span
-        # shards through the shared domain counts).
-        touch = touch.copy()
-        for g, gm in enumerate(topo_meta.groups):
-            if not gm.is_hostname:
-                continue
-            if gm.gtype == topo_mod.TOPO_SPREAD and not gm.is_inverse:
-                # spread groups always carry the pod's node-filter term
-                # row; the filter constrains WHICH nodes count, not the
-                # cross-shard accounting, so it doesn't gate the split
-                touch[g, :] = False
-            elif (
-                gm.gtype == topo_mod.TOPO_ANTI
-                and len(gm.filter_term_rows) == 0
-            ):
-                # anti groups have no node filter in the reference;
-                # guard anyway — a filtered variant would make per-slot
-                # admission row-dependent
-                touch[g, :] = False
-        G = touch.shape[0]
-        parent = list(range(G))
-
-        def find(g):
-            while parent[g] != g:
-                parent[g] = parent[parent[g]]
-                g = parent[g]
-            return g
-
-        for i in range(I):
-            gs = np.nonzero(touch[:, i])[0]
-            for g in gs[1:]:
-                ra, rb = find(int(gs[0])), find(int(g))
-                if ra != rb:
-                    parent[rb] = ra
-        comp_of_item = np.full(I, -1, dtype=np.int64)
-        for i in range(I):
-            gs = np.nonzero(touch[:, i])[0]
-            if len(gs):
-                comp_of_item[i] = find(int(gs[0]))
-        comps = [c for c in np.unique(comp_of_item) if c >= 0]
-        loads = {c: int(counts[comp_of_item == c].sum()) for c in comps}
-        shard_load = np.zeros(ndp, dtype=np.int64)
-        comp_shard: Dict[int, int] = {}
-        for c in sorted(comps, key=lambda c: -loads[c]):
-            d = int(np.argmin(shard_load))
-            comp_shard[c] = d
-            shard_load[d] += loads[c]
-        for i in range(I):
-            c = comp_of_item[i]
-            if c >= 0:
-                count_split[:, i] = 0
-                count_split[comp_shard[int(c)], i] = counts[i]
-        # rebalance FREE items against the component loads (water-fill):
-        # an even free split on top of LPT-routed components leaves the
-        # component shards overloaded; instead free replicas fill toward
-        # the common target load
-        free_items = np.nonzero(comp_of_item < 0)[0]
-        if len(free_items):
-            # largest items first; shard_load ACCUMULATES as items are
-            # assigned, so count-1 classes spread instead of all landing on
-            # the same largest-remainder shard
-            for i in sorted(free_items, key=lambda i: -int(counts[i])):
-                c = int(counts[i])
-                level = (int(shard_load.sum()) + c) / ndp
-                deficit = np.maximum(0.0, level - shard_load.astype(np.float64))
-                if deficit.sum() <= 0:
-                    deficit = np.ones(ndp)
-                frac = deficit / deficit.sum()
-                split = np.floor(frac * c).astype(np.int64)
-                rem = c - int(split.sum())
-                for _ in range(rem):  # leftovers one-by-one to least loaded
-                    d = int(np.argmin(shard_load + split))
-                    split[d] += 1
-                count_split[:, i] = split
-                shard_load += split
-    return count_split, exist_owner
+def route_to_mesh(total_replicas: int, ndp: int) -> bool:
+    """Mesh-vs-single routing for a batch's total replica count: the mesh
+    program engages once the batch clears the per-dp-row work floor, with
+    an absolute cap so a huge mesh (dp=64) doesn't demand thousands of
+    replicas before parallelizing."""
+    return total_replicas >= min(ndp * MIN_SPLIT_REPLICAS_PER_SHARD, 256)
 
 
-def make_sharded_run(segments, zone_seg, ct_seg, topo_meta, n_slots, mesh,
-                     log_len: Optional[int] = None,
-                     screen_v: Optional[int] = None):
-    """Build the jit-compiled shard_map program over `mesh` (axes 'dp' and
-    'tp') from GEOMETRY alone — the sharded analog of
-    tpu_solver.make_device_run, shared by make_sharded_solve (snapshot path)
-    and the gRPC SolverService (which reconstructs geometry from the wire).
-    All other dims derive from argument shapes at trace time."""
-    import jax
-    import jax.numpy as jnp
-    from jax.sharding import PartitionSpec as P
-
-    from karpenter_core_tpu.ops.feasibility import feasibility_static, openable_mask
-    from karpenter_core_tpu.ops.pack import PackState, make_pack_kernel
-
-    segments = list(segments)
-    N = n_slots
-    has_topo = topo_meta is not None and len(topo_meta.groups) > 0
-    pack = make_pack_kernel(segments, zone_seg, ct_seg,
-                            topo_meta=topo_meta,
-                            screen_v=screen_v)
-
-    def body(pod_arrays, count_split, tmpl, tmpl_daemon, tmpl_type_mask_l,
-             types_l, type_offering_ok_l, types_full, type_alloc,
-             type_capacity, type_offering_ok, pod_tol_all, exist, exist_used,
-             exist_cap, exist_owner, well_known, remaining_split,
-             topo_counts0, topo_hcounts0, topo_doms0, topo_terms,
-             exist_ports, exist_vols, exist_vol_limits, vol_driver):
-        E = exist_used.shape[0]
-        R = exist_used.shape[1]
-        J = tmpl_daemon.shape[0]
-        T = type_alloc.shape[0]
-        V = pod_arrays["allow"].shape[1]
-        K = pod_arrays["out"].shape[1]
-        # ---- type-sharded feasibility + all_gather over 'tp' -------------
-        f_local = feasibility_static(
-            {k: pod_arrays[k] for k in ("allow", "out", "defined", "escape")},
-            tmpl,
-            types_l,
-            pod_arrays["tol_tmpl"],
-            tmpl_type_mask_l,
-            type_offering_ok_l,
-            zone_seg,
-            ct_seg,
-            segments,
-            well_known,
-        )  # [J, I, T_local]
-        f_static = jax.lax.all_gather(f_local, "tp", axis=3, tiled=False)
-        f_static = jnp.moveaxis(f_static, 3, 2).reshape(
-            f_local.shape[0], f_local.shape[1], -1
-        )
-
-        openable = openable_mask(
-            f_static, pod_arrays["requests"], tmpl_daemon, type_alloc
-        )
-        mine = exist_owner[0]  # [E] this shard's existing slots
-        slot_exist = jnp.arange(N) < E
-        open0 = jnp.where(slot_exist, jnp.pad(mine, (0, N - E)), False)
-        state = PackState(
-            used=jnp.zeros((N, R), jnp.float32).at[:E].set(exist_used),
-            open=open0,
-            is_existing=open0,
-            tmpl=jnp.zeros(N, jnp.int32),
-            tol_idx=jnp.concatenate(
-                [J + jnp.arange(E, dtype=jnp.int32), jnp.zeros(N - E, jnp.int32)]
-            ),
-            pods=jnp.zeros(N, jnp.int32),
-            allow=jnp.ones((N, V), bool).at[:E].set(exist["allow"]),
-            out=jnp.ones((N, K), bool).at[:E].set(exist["out"]),
-            defined=jnp.zeros((N, K), bool).at[:E].set(exist["defined"]),
-            tmask=jnp.zeros((N, T), bool),
-            cap=jnp.zeros((N, R), jnp.float32).at[:E].set(exist_cap),
-            nopen=jnp.int32(E),
-            remaining=remaining_split[0],
-            tcounts=topo_counts0,
-            thost=topo_hcounts0,
-            tdoms=topo_doms0,
-            ports=jnp.zeros((N, exist_ports.shape[1]), bool).at[:E].set(
-                exist_ports
-            ),
-            vols=exist_vols,
-        )
-        pod_arrays = dict(pod_arrays)
-        pod_arrays["tol"] = pod_tol_all
-        # this shard's share of each class's replicas
-        pod_arrays["count"] = count_split[0]
-        tmpl_type_mask = jax.lax.all_gather(tmpl_type_mask_l, "tp", axis=2, tiled=False)
-        tmpl_type_mask = jnp.moveaxis(tmpl_type_mask, 2, 1).reshape(J, -1)
-        state, log, ptr = pack(
-            state,
-            pod_arrays,
-            f_static,
-            openable,
-            {k: tmpl[k] for k in ("allow", "out", "defined")},
-            tmpl_daemon,
-            tmpl_type_mask,
-            types_full,
-            type_alloc,
-            type_capacity,
-            type_offering_ok,
-            well_known=well_known,
-            topo_terms=topo_terms,
-            log_len=log_len,
-            n_exist=E,
-            vol_limits=exist_vol_limits,
-            vol_driver=vol_driver,
-        )
-        # global stats via psum over dp: pods scheduled (an ICI collective)
-        scheduled = jax.lax.psum(state.pods.sum(), "dp")
-        # rank-0 per-shard values need a singleton axis to concatenate over dp
-        state = state._replace(nopen=state.nopen[None])
-        log = {**log, "bulk_n": log["bulk_n"][None]}
-        return log, ptr[None], state, scheduled
-
-    # item rows replicate; only the per-shard replica counts shard over dp
-    pod_spec = {
-        "allow": P(None, None),
-        "out": P(None, None),
-        "defined": P(None, None),
-        "escape": P(None, None),
-        "custom_deny": P(None, None),
-        "requests": P(None, None),
-        "tol_tmpl": P(None, None),
-        "ports": P(None, None),
-        "port_conflict": P(None, None),
-        "vols": P(None, None),
-        "valid": P(None),
-        # prescreen verdict-column maps: the item axis replicates, so the
-        # class-dedup indices stay valid on every shard
-        "scls": P(None),
-        "scls_first": P(None),
-    }
-    if has_topo:
-        pod_spec["topo_own"] = P(None, None)
-        pod_spec["topo_sel"] = P(None, None)
-    reqset_rep = {k: P(None, None) for k in ("allow", "out", "defined", "escape")}
-    reqset_tp = {k: P("tp", None) for k in ("allow", "out", "defined", "escape")}
-    in_specs = (
-        pod_spec,  # pod_arrays
-        P("dp", None),  # count_split [ndp, I]
-        reqset_rep,  # tmpl
-        P(None, None),  # tmpl_daemon
-        P(None, "tp"),  # tmpl_type_mask_l
-        reqset_tp,  # types_l
-        P("tp", None, None),  # type_offering_ok_l
-        reqset_rep,  # types_full (replicated for packing)
-        P(None, None),  # type_alloc
-        P(None, None),  # type_capacity
-        P(None, None, None),  # type_offering_ok
-        P(None, None),  # pod_tol_all
-        reqset_rep,  # exist
-        P(None, None),  # exist_used
-        P(None, None),  # exist_cap
-        P("dp", None),  # exist_owner [ndp, E]
-        P(None),  # well_known
-        P("dp", None, None),  # remaining_split [ndp, J, R]
-        P(None, None),  # topo_counts0 [G, V]
-        P(None, None),  # topo_hcounts0 [G, N]
-        P(None, None),  # topo_doms0 [G, V]
-        {k: P(None, None) for k in ("allow", "out", "defined", "escape")},  # topo_terms
-        P(None, None),  # exist_ports [E, Q]
-        P(None, None),  # exist_vols [E, W]
-        P(None, None),  # exist_vol_limits [E, D]
-        P(None, None),  # vol_driver [W, D]
-    )
-    out_specs = (
-        {
-            **{k: P("dp") for k in ("item", "slot", "ns", "k", "k_last", "bulk_n")},
-            "bulk_take": P("dp", None),
-        },  # commit log
-        P("dp"),  # log ptr (singleton axis per shard)
-        PackState(
-            used=P("dp", None),
-            open=P("dp"),
-            is_existing=P("dp"),
-            tmpl=P("dp"),
-            tol_idx=P("dp"),
-            pods=P("dp"),
-            allow=P("dp", None),
-            out=P("dp", None),
-            defined=P("dp", None),
-            tmask=P("dp", None),
-            cap=P("dp", None),
-            nopen=P("dp"),
-            remaining=P("dp", None),
-            tcounts=P("dp", None),
-            thost=P("dp", None),
-            tdoms=P("dp", None),
-            ports=P("dp", None),
-            vols=P("dp", None),
-        ),
-        P(),  # scheduled count (replicated)
-    )
-
-    # version compat: jax >= 0.6 exposes jax.shard_map (check_vma);
-    # 0.4.x only has jax.experimental.shard_map (check_rep)
-    if hasattr(jax, "shard_map"):
-        sharded = jax.shard_map(
-            body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-            check_vma=False,
-        )
-    else:
-        from jax.experimental.shard_map import shard_map as _shard_map
-
-        sharded = _shard_map(
-            body, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-            check_rep=False,
-        )
-    fn = jax.jit(sharded)
-    return fn
+def snapshot_replicas(snap) -> int:
+    """Total replica count of an encoded snapshot (the routing signal)."""
+    if snap.item_counts is not None:
+        return int(np.asarray(snap.item_counts).sum())
+    return len(snap.pods)
 
 
-def shard_args(base_args, count_split: np.ndarray, exist_owner: np.ndarray):
-    """Assemble the shard_map argument tuple from a device_args() tuple plus
-    the plan_shards partition. The count axis is padded to the item bucket
-    (device_args pads the item rows); the caller keeps the real-I count_split
-    for decoding."""
-    ndp = count_split.shape[0]
-    (pod_arrays, tmpl, tmpl_daemon, tmpl_type_mask, types, type_alloc,
-     type_capacity, type_offering_ok, pod_tol_all, exist, exist_used,
-     exist_cap, well_known, remaining0, topo_counts0, topo_hcounts0,
-     topo_doms0, topo_terms, exist_ports, exist_vols, exist_vol_limits,
-     vol_driver) = base_args
-    pod_arrays = dict(pod_arrays)
-    pod_arrays.pop("count")
-    E = exist_used.shape[0]
-    I_pad = pod_arrays["valid"].shape[0]
-    count_split_dev = np.zeros((ndp, I_pad), dtype=count_split.dtype)
-    count_split_dev[:, : count_split.shape[1]] = count_split
+class ShardedSolver(TPUSolver):
+    """The multi-chip Solver: TPUSolver whose programs build against a
+    ('dp','tp') mesh SpecLayout. Drop-in for TPUSolver wherever a Mesh is
+    available (solver/factory.py builds one when the process sees >1
+    device); encode()/solve(encoded=)/prewarm_snapshot and the whole
+    relaxation/incremental machinery are inherited — the ONLY difference
+    is which program family _layout_for selects, so a multi-chip
+    deployment gets bucket-ladder cache keys, startup AOT prewarm, and
+    delta-refresh residency for its mesh programs for free."""
 
-    # limits split proportional to each shard's replica load (pessimistic:
-    # the shares always sum to <= the global budget)
-    total = max(int(count_split.sum()), 1)
-    share = count_split.sum(axis=1).astype(np.float64) / total  # [ndp]
-    finite = remaining0 < np.float32(1e29)
-    remaining_split = np.where(
-        finite[None], remaining0[None] * share[:, None, None], remaining0[None]
-    ).astype(np.float32)
-
-    # per-shard hostname-count state: existing columns seed identically on
-    # every shard (only the owner shard's groups ever read/update them);
-    # machine columns start at zero. [G, N] with N = E + max_nodes_per_shard
-    th0 = np.zeros_like(topo_hcounts0)
-    th0[:, :E] = topo_hcounts0[:, :E]
-
-    return (
-        pod_arrays,
-        count_split_dev,
-        tmpl,
-        tmpl_daemon,
-        tmpl_type_mask,
-        types,
-        type_offering_ok,
-        types,
-        type_alloc,
-        type_capacity,
-        type_offering_ok,
-        pod_tol_all,
-        exist,
-        exist_used,
-        exist_cap,
-        exist_owner,
-        well_known,
-        remaining_split,
-        topo_counts0,
-        th0,
-        topo_doms0,
-        topo_terms,
-        exist_ports,
-        exist_vols,
-        exist_vol_limits,
-        vol_driver,
-    )
-
-
-def make_sharded_solve(snap, provisioners, mesh, max_nodes_per_shard: int = 256,
-                       program_cache=None):
-    """Build (fn, args, plan) where fn is a jit-compiled shard_map program
-    over `mesh` (axes 'dp' and 'tp'), args are the host arrays, and plan is
-    (count_split, exist_owner) for decoding.
-
-    Type-axis arrays must divide by mesh.shape['tp'] (ShardedSolver routes
-    non-dividing geometries through a dp-only mesh). Supports topology
-    constraints and existing nodes via component routing / slot ownership
-    (module docstring)."""
-    from karpenter_core_tpu.solver.tpu_solver import device_args, solve_geometry
-
-    geom = solve_geometry(snap, max_nodes_per_shard)
-    (_, _J, _T, _E, _R, _K, _V, N, segments_t, zone_seg, ct_seg, _topo_sig,
-     log_len, _Q, _W, _D, screen_v) = geom
-    ndp = mesh.shape["dp"]
-    ntp = mesh.shape["tp"]
-    count_split, exist_owner = plan_shards(snap, ndp)
-
-    # the shard_map program is pure in everything but the label geometry
-    # (+ topo signature, baked into geom), the mesh shape, and the screen
-    # mode resolved at trace time: cache on all three so steady-state
-    # solves reuse one compiled program AND a KCT_PACK_SCREEN flip takes
-    # effect instead of returning the other mode's cached program
-    from karpenter_core_tpu.ops import compat as ops_compat
-
-    cache_key = (geom, ndp, ntp, ops_compat.resolve_screen_mode())
-    fn = None if program_cache is None else program_cache.get(cache_key)
-    if fn is not None and hasattr(program_cache, "move_to_end"):
-        program_cache.move_to_end(cache_key)  # LRU recency (ShardedSolver)
-    if fn is None:
-        fn = make_sharded_run(
-            segments_t, zone_seg, ct_seg, snap.topo_meta, N, mesh,
-            log_len=log_len, screen_v=screen_v,
-        )
-        if program_cache is not None:
-            program_cache[cache_key] = fn
-
-    args = shard_args(device_args(snap, provisioners), count_split, exist_owner)
-    return fn, args, (count_split, exist_owner)
-
-
-def decode_sharded(snap, log, ptr, state, count_split):
-    """Merge per-shard commit logs into one SolveResult.
-
-    log: dict of [ndp, L] arrays; ptr: [ndp]; state: PackState stacked on a
-    leading dp axis. Shard d consumes members[off_d : off_d + split_d] of
-    each item, where off_d is the cumulative split below d — the same
-    partition plan_shards produced. Each shard's log replays through the
-    single-device expand_log/decode_solve (bounded to the shard's member
-    slice); merging is a concat because machines are shard-local and every
-    existing slot is owned by exactly one shard."""
-    from types import SimpleNamespace
-
-    from karpenter_core_tpu.solver.tpu_solver import (
-        SolveResult,
-        decode_solve,
-        expand_log,
-    )
-
-    ndp = count_split.shape[0]
-    # shard_map concatenates per-shard outputs along the leading axis:
-    # reshape [ndp*L] logs and [ndp*N, ...] state fields back to per-shard
-    # (trailing dims preserved — bulk_take is [ndp*LB, BR]: the
-    # existing prefix, or the full slot axis under mach_bulk geometries)
-    log = {
-        k: (lambda a: a.reshape((ndp, a.shape[0] // ndp) + a.shape[1:]))(
-            np.asarray(v)
-        )
-        for k, v in log.items()
-    }
-    ptr = np.asarray(ptr).reshape(-1)
-    P = len(snap.pods)
-    offs = np.cumsum(count_split, axis=0) - count_split  # [ndp, I]
-
-    N = np.asarray(state.tmpl).shape[0] // ndp
-    fields = {
-        name: np.asarray(getattr(state, name)).reshape((ndp, N) + np.asarray(
-            getattr(state, name)
-        ).shape[1:])
-        for name in ("tmpl", "tmask", "used", "allow", "out", "defined")
-    }
-
-    machines: List = []
-    existing: List[Tuple[object, List]] = []
-    scheduled = np.zeros(P, dtype=bool)
-    for d in range(ndp):
-        assigned_d = expand_log(
-            snap,
-            {k: v[d] for k, v in log.items()},
-            int(ptr[d]),
-            member_lo=offs[d],
-            member_hi=offs[d] + count_split[d],
-        )
-        shard_state = SimpleNamespace(**{k: v[d] for k, v in fields.items()})
-        # failures are recomputed below from the cross-shard bitmask: a
-        # shard's assigned is -1 for every OTHER shard's pods, so per-shard
-        # failed lists would be O(ndp * P) garbage
-        res_d = decode_solve(snap, assigned_d, shard_state, want_failed=False)
-        machines.extend(res_d.new_machines)
-        existing.extend(res_d.existing_assignments)
-        scheduled |= assigned_d >= 0
-
-    failed = [pod for i, pod in enumerate(snap.pods) if not scheduled[i]]
-    return SolveResult(
-        new_machines=machines, existing_assignments=existing, failed_pods=failed
-    )
-
-
-class ShardedSolver:
-    """Solver-interface front end for the multi-chip path: encode once,
-    run the shard_map program over `mesh`, merge shard logs. Drop-in for
-    TPUSolver where a Mesh is available (solver/factory.py builds one when
-    the process sees >1 device); relaxation shares solve_with_relaxation and
-    the pipelined encode()/solve(encoded=) surface matches TPUSolver so the
-    provisioning loop overlaps encode with the previous solve either way."""
-
-    # the consolidation ladder's vmapped screen (solver/replan.py) is
-    # independent of the provisioning solve path: it builds its own device
-    # program and runs on ONE device (a 1k-node ladder fits a single chip),
-    # so a multi-chip deployment keeps the batched-replan fast path —
-    # provisioning fans out over the mesh, the screen rides chip 0
-    supports_batched_replan = True
-    backend = None  # default kernel lowering for the screen program
-
-    def __init__(self, mesh, max_nodes_per_shard: int = 256,
-                 max_relax_rounds: Optional[int] = None):
+    def __init__(self, mesh, max_nodes: int = 1024,
+                 max_relax_rounds: Optional[int] = None,
+                 donate: bool = True, backend: Optional[str] = None,
+                 profile_phases: bool = False,
+                 screen_mode: Optional[str] = None,
+                 incremental: Optional[str] = None):
         from karpenter_core_tpu.solver.tpu_solver import DEFAULT_MAX_RELAX_ROUNDS
 
-        self.mesh = mesh
-        self.max_nodes_per_shard = max_nodes_per_shard
-        self.max_relax_rounds = (
-            DEFAULT_MAX_RELAX_ROUNDS if max_relax_rounds is None else max_relax_rounds
-        )
-        # LRU-bounded (same rationale as TPUSolver/SolverService: label
-        # churn mints geometries; don't pin old executables forever)
-        from collections import OrderedDict
-
-        self.MAX_COMPILED = 32
-        self._compiled = OrderedDict()
-        from karpenter_core_tpu.solver.encode import EncodeReuse
-
-        self._encode_reuse = EncodeReuse()
-
-    @property
-    def max_nodes(self) -> int:
-        # the GLOBAL new-machine budget (consolidation sizes its ladder
-        # screen off this); each shard owns max_nodes_per_shard of it
-        return self.mesh.shape["dp"] * self.max_nodes_per_shard
-
-    def encode(self, pods, provisioners, instance_types, daemonset_pods=None,
-               state_nodes=None, kube_client=None, cluster=None):
-        """Pre-encode a batch off the Solve critical path (same contract as
-        TPUSolver.encode); the snapshot is sized to the PER-SHARD slot
-        budget, which is what every per-device plane keys off."""
-        from karpenter_core_tpu.solver.encode import encode_snapshot
-
-        return encode_snapshot(
-            pods, provisioners, instance_types, daemonset_pods, state_nodes,
-            kube_client=kube_client, cluster=cluster,
-            max_nodes=self.max_nodes_per_shard,
-            reuse=self._encode_reuse,
-        )
-
-    def solve(self, pods, provisioners, instance_types, daemonset_pods=None,
-              state_nodes=None, kube_client=None, cluster=None, encoded=None):
-        from karpenter_core_tpu.solver.tpu_solver import solve_with_relaxation
-
-        if encoded is not None:
-            # must be OF this batch (see TPUSolver.solve for why identity)
-            if len(encoded.pods) != len(pods) or (
-                {id(p) for p in encoded.pods} != {id(p) for p in pods}
-            ):
-                raise ValueError(
-                    "encoded snapshot was built from a different pod batch"
-                )
-        relax_ctx = {"encoded": encoded}
-        return solve_with_relaxation(
-            lambda p: self._solve_once(
-                p, provisioners, instance_types, daemonset_pods, state_nodes,
-                kube_client, cluster, relax_ctx,
+        super().__init__(
+            max_nodes=max_nodes,
+            max_relax_rounds=(
+                DEFAULT_MAX_RELAX_ROUNDS
+                if max_relax_rounds is None
+                else max_relax_rounds
             ),
-            pods,
-            provisioners,
-            instance_types,
-            self.max_relax_rounds,
+            donate=donate, backend=backend, profile_phases=profile_phases,
+            screen_mode=screen_mode, incremental=incremental,
         )
+        self.mesh = mesh
+        self._mesh_layout = SpecLayout(mesh)
+        # which program family served the last dispatch ("mesh"/"single"):
+        # observability + the small-batch routing tests/bench column
+        self.last_path = None
 
-    # a shard that exhausts its per-shard slot budget doubles it and
-    # re-solves (the grown program is compiled once and cached); cap the
-    # growth so a pathological batch can't compile unbounded geometries
-    MAX_NODES_PER_SHARD_CAP = 4096
-
-    def _solve_once(self, pods, provisioners, instance_types, daemonset_pods,
-                    state_nodes, kube_client, cluster, relax_ctx=None):
-        import jax
-
-        from karpenter_core_tpu.solver.encode import encode_snapshot
-
-        snap = relax_ctx.pop("encoded", None) if relax_ctx else None
-        per_shard = self.max_nodes_per_shard
-        while True:
-            if snap is None:
-                snap = encode_snapshot(
-                    pods, provisioners, instance_types, daemonset_pods,
-                    state_nodes, kube_client=kube_client, cluster=cluster,
-                    max_nodes=per_shard,
-                    reuse=self._encode_reuse,
-                )
-            mesh = self.mesh
-            # the PADDED type-axis width (ladder tiers are even, so padded
-            # geometries stay tp-divisible; raw odd universes fall back)
-            T_axis = (
-                snap.type_alloc.shape[0]
-                if snap.type_alloc is not None
-                else len(snap.instance_types)
-            )
-            if T_axis % mesh.shape["tp"] != 0:
-                # the tp all_gather needs the type axis to divide; rare odd
-                # geometries route through a dp-only view of the same devices
-                mesh = _dp_only_mesh(mesh)
-            fn, args, (count_split, _exist_owner) = make_sharded_solve(
-                snap, provisioners, mesh,
-                max_nodes_per_shard=per_shard,
-                program_cache=self._compiled,
-            )
-            while len(self._compiled) > self.MAX_COMPILED:
-                self._compiled.popitem(last=False)
-            # chaos hook: the multi-chip accelerator edge (same point as
-            # TPUSolver._run_kernels — one name covers "the device path")
-            from karpenter_core_tpu import chaos
-
-            chaos.maybe_fail(chaos.SOLVER_DEVICE)
-            with mesh:
-                log, ptr, state, _scheduled = fn(*args)
-                jax.block_until_ready(log)
-            state = jax.tree_util.tree_map(np.asarray, state)
-            result = decode_sharded(snap, log, ptr, state, count_split)
-            if not result.failed_pods:
-                return result
-            # slot-budget exhaustion is NOT a constraint failure: the dp
-            # split can concentrate more machines on one shard than the
-            # per-shard budget admits even when the global budget fits
-            # (scheduler.go has one global node list; shards have disjoint
-            # budgets). Grow and retry. The growth PERSISTS only when the
-            # plan actually split: a small-batch single-shard solve that
-            # overflowed must not permanently double every future solve's
-            # slot geometry (the compiled program for the transient size
-            # stays cached, so repeats pay one extra dispatch, not a
-            # recompile).
-            exhausted = bool(
-                np.any(np.asarray(state.nopen).reshape(-1) >= snap.n_slots)
-            )
-            if not exhausted or per_shard * 2 > self.MAX_NODES_PER_SHARD_CAP:
-                return result
-            per_shard *= 2
-            if int((count_split.sum(axis=1) > 0).sum()) > 1:
-                self.max_nodes_per_shard = per_shard
-            snap = None  # re-encode at the grown slot budget
-
-
-def _dp_only_mesh(mesh):
-    """Reshape a dp×tp mesh's devices into dp×1 (all devices on 'dp')."""
-    from jax.sharding import Mesh
-
-    devices = np.asarray(mesh.devices).reshape(-1, 1)
-    return Mesh(devices, ("dp", "tp"))
-
-
+    def _layout_for(self, snap):
+        """Mesh layout for batches worth parallelizing; None (the plain
+        single-device program, same compiled-program LRU under its own
+        key namespace) for small batches — they stop paying collective
+        and multi-device dispatch overhead entirely."""
+        if route_to_mesh(snapshot_replicas(snap), self._mesh_layout.ndp):
+            self.last_path = "mesh"
+            return self._mesh_layout
+        self.last_path = "single"
+        return None

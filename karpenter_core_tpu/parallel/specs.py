@@ -1,0 +1,246 @@
+"""Canonical PartitionSpecs for every solver tensor family (SpecLayout).
+
+The multi-chip solve is ONE jit-compiled GSPMD program over a named
+``('dp', 'tp')`` mesh (parallel/sharded.py). This module is the single
+source of truth for how each tensor family lays out on that mesh — the
+SNIPPETS.md [2] pattern: a frozen SpecLayout whose methods name the spec
+per family, so every consumer (the in-process solver, the gRPC service,
+the prewarm path, tests) shards the same tensor the same way instead of
+scattering ad-hoc PartitionSpecs through the code.
+
+Axis semantics:
+
+  'dp'  shards the SLOT axis — existing-node rows and the machine-slot
+        region of every per-slot plane, i.e. where replicas land. The
+        [N, C] prescreen verdict tensor and the bf16 screen contractions
+        that produce it compute dp-sharded on their slot/existing rows.
+  'tp'  shards the INSTANCE-TYPE / verdict-COLUMN axis — the type planes
+        of the feasibility contraction and the class-column axis of the
+        verdict tensor. Instance-type planes are replicated over 'dp',
+        sharded over 'tp'.
+
+Item (pod-equivalence-class) planes REPLICATE: the class-dedup gather
+indices (scls/scls_first) must stay valid on every device, and the pack
+scan reads item rows at traced indices every step.
+
+The sequential pack scan itself runs REPLICATED: its carry is a chain of
+small per-step updates whose cross-device reassembly would cost one
+collective per scan step — the precompute phases (feasibility, prescreen)
+are where the FLOPs are, so they shard, and one XLA-inserted all_gather
+riding ICI reassembles the verdict rows/feasibility planes before the
+scan consumes them. Program INPUTS and OUTPUTS are replicated for the
+same reason (and because pjit I/O sharding demands divisible axes, which
+geometry buckets don't guarantee for every (axis, mesh) pair): all
+sharding enters through jax.lax.with_sharding_constraint seams inside
+the program, so the compiled program is a pure function of (geometry,
+mesh shape) with no per-batch sharding decisions.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+
+@dataclass(frozen=True)
+class SpecLayout:
+    """Canonical PartitionSpecs for solver tensors on a ('dp', 'tp') mesh.
+
+    Frozen + hashable: ``layout.key`` rides the compiled-program cache key
+    so a mesh-shape change (or the single-device path, layout=None) mints
+    its own programs.
+    """
+
+    mesh: object  # jax.sharding.Mesh with axes ('dp', 'tp')
+    dp_axis: str = "dp"
+    tp_axis: str = "tp"
+
+    # -- identity ----------------------------------------------------------
+
+    @property
+    def ndp(self) -> int:
+        return self.mesh.shape[self.dp_axis]
+
+    @property
+    def ntp(self) -> int:
+        return self.mesh.shape[self.tp_axis]
+
+    @property
+    def key(self):
+        """Compiled-program cache-key component (mesh shape, not devices:
+        the same executable serves any device assignment of that shape)."""
+        return ("gspmd", self.ndp, self.ntp)
+
+    def __hash__(self):
+        return hash(self.key)
+
+    def __eq__(self, other):
+        return isinstance(other, SpecLayout) and self.key == other.key
+
+    # -- per-family PartitionSpecs ----------------------------------------
+
+    def _ns(self, *spec):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return NamedSharding(self.mesh, P(*spec))
+
+    def replicated(self):
+        """Item planes, template planes, scan carry, commit log, scalars —
+        everything the sequential scan reads at traced indices."""
+        return self._ns()
+
+    def item_plane(self):
+        """[I, ...] pod-equivalence-class rows: replicated (the scls dedup
+        indices and per-step gathers must resolve on every device)."""
+        return self._ns()
+
+    def type_plane(self, rank: int = 2):
+        """[T, ...] instance-type rows: replicated over dp, sharded over
+        tp — the feasibility contraction's column family."""
+        return self._ns(self.tp_axis, *([None] * (rank - 1)))
+
+    def type_cols(self, rank: int = 2):
+        """[..., T] planes whose LAST axis is the type axis
+        (tmpl_type_mask [J, T])."""
+        return self._ns(*([None] * (rank - 1)), self.tp_axis)
+
+    def slot_plane(self, rank: int = 2):
+        """[E, ...] / [N, ...] existing-node and slot rows: sharded over
+        dp — the verdict tensor's row family. Also the dp-row family for
+        the item rows feeding the feasibility contraction (the item axis
+        plays the row role there; the REPLICATED item planes the scan
+        gathers from are item_plane())."""
+        return self._ns(self.dp_axis, *([None] * (rank - 1)))
+
+    def verdict(self):
+        """The [N, C] prescreen verdict tensor: slot rows over dp, class
+        columns over tp — both contraction outputs tile with zero
+        communication; the reassembling all_gather happens where the
+        scan (replicated) consumes it."""
+        return self._ns(self.dp_axis, self.tp_axis)
+
+    def feasibility(self):
+        """[J, I, T] static feasibility: item rows over dp, type columns
+        over tp (templates replicated)."""
+        return self._ns(None, self.dp_axis, self.tp_axis)
+
+    # -- constraint helpers (trace-time, inside jit) ----------------------
+
+    def constrain(self, x, sharding):
+        import jax
+
+        return jax.lax.with_sharding_constraint(x, sharding)
+
+    def shard_reqset(self, reqset: dict, sharding) -> dict:
+        """Apply one spec to each plane of a ReqSet-style dict."""
+        return {k: self.constrain(v, sharding) for k, v in reqset.items()}
+
+    def gather(self, x):
+        """Reassemble to replicated — the explicit all_gather seam between
+        a sharded precompute and the replicated scan."""
+        return self.constrain(x, self.replicated())
+
+    def cache_salt(self, x):
+        """Make a mesh program's persistent-cache key PROCESS-UNIQUE on
+        the CPU backend by or-ing a constant-False term derived from a
+        per-process salt into a bool tensor (semantically a no-op; the
+        optimizer folds it away AFTER the cache key is computed from the
+        unoptimized module).
+
+        Why: XLA:CPU deserializes multi-device executables
+        NONDETERMINISTICALLY (jax 0.4.x) — a GSPMD solve program reloaded
+        from the persistent cache flips placements per dispatch, while
+        the same program freshly compiled is byte-stable (isolated by the
+        ISSUE 8 parity suite; see docs/sharding.md). The config toggles
+        can't gate reads mid-process (jax memoizes is_cache_used), so the
+        key itself must never match across processes. Single-device
+        programs and real-TPU mesh programs keep full cache reuse — the
+        deserialization path there is the battle-tested one."""
+        import jax
+        import jax.numpy as jnp
+
+        if jax.default_backend() != "cpu":
+            return x
+        return x | (jnp.int32(_process_salt()) < jnp.int32(0))
+
+    # -- pre-sharded upload (host -> device, outside jit) ------------------
+
+    def put_replicated(self, tree):
+        """device_put a pytree fully replicated over the mesh — the upload
+        form for the bundled in-process path (the bundle is opaque bytes;
+        per-family sharding happens at the in-program seams)."""
+        import jax
+
+        sharding = self.replicated()
+        return jax.device_put(
+            tree, jax.tree_util.tree_map(lambda _: sharding, tree)
+        )
+
+    def arg_sharding(self, name: str, arr):
+        """The canonical NamedSharding for one device_args tensor (by its
+        RUN_ARG_NAMES entry), used by the unbundled gRPC-service path so
+        the upload lands pre-sharded. Falls back to replicated whenever
+        the sharded axis does not divide the mesh axis (pjit I/O requires
+        divisibility; the in-program constraints still engage)."""
+        family = RUN_ARG_FAMILIES.get(name, "replicated")
+        shape = getattr(arr, "shape", ())
+        if family == "type_rows" and shape and shape[0] % self.ntp == 0:
+            return self.type_plane(rank=max(len(shape), 1))
+        if family == "type_cols" and shape and shape[-1] % self.ntp == 0:
+            return self.type_cols(rank=max(len(shape), 1))
+        if family == "slot_rows" and shape and shape[0] % self.ndp == 0:
+            return self.slot_plane(rank=max(len(shape), 1))
+        return self.replicated()
+
+    def put_args(self, names, args):
+        """device_put a device_args-style tuple with each tensor's
+        canonical sharding (dict-valued args shard per leaf)."""
+        import jax
+
+        def put_one(name, arg):
+            if isinstance(arg, dict):
+                return {
+                    k: jax.device_put(v, self.arg_sharding(name, v))
+                    for k, v in arg.items()
+                }
+            return jax.device_put(arg, self.arg_sharding(name, arg))
+
+        return tuple(put_one(n, a) for n, a in zip(names, args))
+
+
+# device_args tensor name -> sharding family (names match
+# tpu_solver.RUN_ARG_NAMES; anything absent replicates). The reqset dicts
+# under 'types' share the type-row family leaf-wise; 'exist*' planes are
+# slot rows. pod/item planes, templates, topology state, and the donated
+# scan-carry seeds replicate — the scan reads them at traced indices.
+RUN_ARG_FAMILIES = {
+    "types": "type_rows",
+    "type_alloc": "type_rows",
+    "type_capacity": "type_rows",
+    "type_offering_ok": "type_rows",
+    "tmpl_type_mask": "type_cols",
+    "exist": "slot_rows",
+    "exist_used": "slot_rows",
+    "exist_cap": "slot_rows",
+    "exist_ports": "slot_rows",
+    "exist_vols": "slot_rows",
+    "exist_vol_limits": "slot_rows",
+}
+
+
+_PROCESS_SALT = None
+
+
+def _process_salt() -> int:
+    """Per-process 31-bit salt for SpecLayout.cache_salt (stable within
+    the process so in-process program reuse is unaffected)."""
+    global _PROCESS_SALT
+    if _PROCESS_SALT is None:
+        import uuid
+
+        _PROCESS_SALT = int(uuid.uuid4().int & 0x7FFFFFFF) or 1
+    return _PROCESS_SALT
+
+
+def layout_for(mesh) -> Optional[SpecLayout]:
+    """SpecLayout for a mesh (None passes through: single-device path)."""
+    return None if mesh is None else SpecLayout(mesh)

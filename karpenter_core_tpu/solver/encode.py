@@ -447,7 +447,16 @@ def encode_reqsets(
 class EncodedSnapshot:
     """Everything the device kernels need, as numpy arrays (moved to device by
     the solver). Axes: P pods, T instance types, J templates, K keys, V flat
-    values, R resources, Q distinct taints, Z zones, C capacity types."""
+    values, R resources, Q distinct taints, Z zones, C capacity types.
+
+    Multi-chip note (ISSUE 8): these arrays are what the GSPMD mesh
+    programs shard — each device_args tensor has a canonical PartitionSpec
+    family (parallel/specs.RUN_ARG_FAMILIES keyed by
+    tpu_solver.RUN_ARG_NAMES; docs/sharding.md has the table). The ladder
+    padding below is also what keeps the sharded axes mesh-divisible in
+    practice: tier values for instance_types/existing_nodes are even
+    powers of two, so the gRPC service's pre-sharded upload
+    (SpecLayout.put_args) rarely needs its replicated fallback."""
 
     dictionary: LabelDictionary
     resource_names: List[str]

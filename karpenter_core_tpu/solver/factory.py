@@ -91,12 +91,13 @@ def describe(solver) -> str:
 
 def build_solver(max_nodes: int = 1024, mode: Optional[str] = None,
                  backend: Optional[str] = None,
-                 max_nodes_per_shard: Optional[int] = None,
                  screen_mode: Optional[str] = None):
     """Construct the primary in-process solver for this process's devices.
 
-    max_nodes is the GLOBAL new-machine slot budget; the sharded path
-    divides it across dp shards unless max_nodes_per_shard pins it.
+    max_nodes is the GLOBAL new-machine slot budget on both paths: the
+    multi-chip ShardedSolver runs the same (byte-identical) solve as the
+    single-device program, GSPMD-sharded over the mesh, so there is no
+    per-shard budget split anymore (parallel/sharded.py).
 
     screen_mode pins the pack kernel's slot-screen strategy ('prescreen' =
     batched class×slot feasibility precompute + in-scan incremental
@@ -125,6 +126,5 @@ def build_solver(max_nodes: int = 1024, mode: Optional[str] = None,
                          screen_mode=screen_mode)
     from karpenter_core_tpu.parallel.sharded import ShardedSolver
 
-    ndp = mesh.shape["dp"]
-    per_shard = max_nodes_per_shard or max(max_nodes // ndp, 64)
-    return ShardedSolver(mesh, max_nodes_per_shard=per_shard)
+    return ShardedSolver(mesh, max_nodes=max_nodes, backend=backend,
+                         screen_mode=screen_mode)

@@ -28,6 +28,12 @@ Two layers guard correctness:
     downstream of it — is byte-identical to the full path's
     (tests/test_incremental_parity.py holds the two to flightrec-canonical
     equality over seeded churn sequences).
+
+Multi-chip (ISSUE 8): the residency map keys off the compiled-program
+key, which embeds the mesh shape — so a GSPMD mesh solve keeps its own
+resident verdict tensor and refresh programs, and the delta path serves
+multi-chip steady-state churn exactly as it serves single-device
+(docs/sharding.md).
 """
 from __future__ import annotations
 

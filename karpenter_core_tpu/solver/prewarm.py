@@ -28,6 +28,13 @@ Ordering: the steady-state tier (Settings.steady_state_tier — the rung the
 batcher's pass cap lands on) compiles FIRST, then the remaining tiers
 ascending, so the common case is warm earliest. Observability:
 karpenter_prewarm_* metrics and a `solver.prewarm` trace span per tier.
+
+Multi-chip (ISSUE 8): ShardedSolver inherits prewarm_snapshot, and its
+_layout_for routing decides per tier exactly as live traffic would — so
+a multi-chip operator AOT-prewarms its GSPMD MESH programs (cache keys
+carry the mesh shape) for tiers that route to the mesh, and the plain
+single-device programs for tiers below the small-batch floor
+(docs/compile-cache.md#sharded-prewarm-keys).
 """
 from __future__ import annotations
 

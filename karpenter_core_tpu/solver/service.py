@@ -201,9 +201,6 @@ def geometry_json(snap) -> str:
             # index 12 = log_len (see solve_geometry's return tuple)
             "log_len": solve_geometry(snap, 0)[12],
             "topo_groups": topo,
-            # real (pre-padding) existing-node count: the sharded service
-            # path assigns slot ownership over the real rows only
-            "n_exist_real": len(snap.state_nodes),
         }
     )
 
@@ -217,10 +214,11 @@ class SolverService:
 
     `mesh` (a dp×tp jax.sharding.Mesh, or True to autodetect via
     solver/factory.detect_mesh) routes every Solve through the multi-chip
-    shard_map program — the v5e-4 deployment shape. The wire format is
-    unchanged except the response carries per-shard-stacked tensors plus a
-    `count_split` plan tensor, which the client detects and decodes with
-    parallel/sharded.decode_sharded.
+    GSPMD mesh program — the v5e-4 deployment shape. The mesh program is
+    byte-identical to the single-device one (parallel/sharded.py), so the
+    wire format is IDENTICAL either way and the client decodes both with
+    decode_solve; small batches route through the plain single-device
+    program server-side (route_to_mesh).
 
     The cache is LRU-bounded: geometry embeds the label dictionary, so in a
     live cluster label churn mints new keys — an unbounded map would pin every
@@ -312,60 +310,81 @@ class SolverService:
                     for g in geometry["topo_groups"]
                 ]
             )
-        if self.mesh is not None:
-            log, ptr, state, count_split = self._solve_sharded(
-                request.geometry, geometry, args, topo_meta,
-                segments, zone_seg, ct_seg,
-            )
-            out = [
-                tensor_to_pb("ptr", np.asarray(ptr)),
-                tensor_to_pb("count_split", np.asarray(count_split)),
-            ]
-        else:
-            from karpenter_core_tpu.ops import compat as ops_compat
+        from karpenter_core_tpu.ops import compat as ops_compat
 
-            # key on the trace-time screen mode too: a KCT_PACK_SCREEN flip
-            # must mint a new program, not serve the other mode's cache
-            screen_mode = ops_compat.resolve_screen_mode()
-            key = (request.geometry, screen_mode)
-            with self._mu:
-                entry = self._compiled.get(key)
-                if entry is not None:
-                    self._compiled.move_to_end(key)
-            record_lookup("service", entry is not None)
-            if entry is None:
-                run = jax.jit(
-                    make_device_run(
-                        segments, zone_seg, ct_seg, topo_meta, geometry["n_slots"],
-                        log_len=geometry.get("log_len"),
+        # the GSPMD mesh layout (parallel/specs.py) when this container
+        # serves a multi-chip device set AND the batch clears the
+        # small-batch routing floor; None compiles the plain single-device
+        # program. Same response shape either way — the mesh program is
+        # byte-identical to the single-device one, so the client decodes
+        # both with decode_solve.
+        layout = self._layout_for(args)
+        # key on the trace-time screen mode too: a KCT_PACK_SCREEN flip
+        # must mint a new program, not serve the other mode's cache
+        screen_mode = ops_compat.resolve_screen_mode()
+        key = (
+            request.geometry, screen_mode,
+            layout.key if layout is not None else None,
+        )
+        with self._mu:
+            entry = self._compiled.get(key)
+            if entry is not None:
+                self._compiled.move_to_end(key)
+        record_lookup(
+            "service" if layout is None else "service_sharded",
+            entry is not None,
+        )
+        if entry is None:
+            run = jax.jit(
+                make_device_run(
+                    segments, zone_seg, ct_seg, topo_meta, geometry["n_slots"],
+                    log_len=geometry.get("log_len"),
+                    screen_v=geometry.get("screen_v"),
+                    screen_mode=screen_mode,
+                    external_prescreen=screen_mode == "prescreen",
+                    spec_layout=layout,
+                )
+            )
+            pre = None
+            if screen_mode == "prescreen":
+                from karpenter_core_tpu.ops.pack import make_prescreen_kernel
+
+                pre = jax.jit(
+                    make_prescreen_kernel(
+                        segments, geometry["n_slots"],
                         screen_v=geometry.get("screen_v"),
-                        screen_mode=screen_mode,
-                        external_prescreen=screen_mode == "prescreen",
+                        spec_layout=layout,
                     )
                 )
-                pre = None
-                if screen_mode == "prescreen":
-                    from karpenter_core_tpu.ops.pack import make_prescreen_kernel
+            entry = (run, pre)
+            with self._mu:
+                self._compiled[key] = entry
+                while len(self._compiled) > self.MAX_COMPILED:
+                    old_key, _ = self._compiled.popitem(last=False)
+                    self._drop_incremental(old_key)
+        fn, pre_fn = entry
+        host_args = args
+        if layout is not None:
+            # pre-sharded upload: each wire tensor device_puts with its
+            # canonical NamedSharding (type planes over 'tp', existing-slot
+            # planes over 'dp' where the axes divide, everything else
+            # replicated) so the mesh program starts from committed inputs
+            from karpenter_core_tpu.solver.tpu_solver import RUN_ARG_NAMES
 
-                    pre = jax.jit(
-                        make_prescreen_kernel(
-                            segments, geometry["n_slots"],
-                            screen_v=geometry.get("screen_v"),
-                        )
-                    )
-                entry = (run, pre)
-                with self._mu:
-                    self._compiled[key] = entry
-                    while len(self._compiled) > self.MAX_COMPILED:
-                        old_key, _ = self._compiled.popitem(last=False)
-                        self._drop_incremental(old_key)
-            fn, pre_fn = entry
+            args = layout.put_args(RUN_ARG_NAMES, args)
+        from karpenter_core_tpu.obs import device_profiler
+
+        with device_profiler():
             if pre_fn is not None:
-                screen0 = self._prescreen(key, geometry, args, pre_fn)
+                screen0 = self._prescreen(
+                    key, geometry, args, pre_fn, host_args=host_args,
+                    layout=layout,
+                )
                 log, ptr, state = fn(screen0, *args)
             else:
                 log, ptr, state = fn(*args)
-            out = [tensor_to_pb("ptr", np.asarray(ptr))]
+            jax.block_until_ready(ptr)
+        out = [tensor_to_pb("ptr", np.asarray(ptr))]
         for name, value in log.items():
             out.append(tensor_to_pb(f"log/{name}", np.asarray(value)))
         for field, value in state._asdict().items():
@@ -376,18 +395,27 @@ class SolverService:
 
     # -- incremental prescreen (delta re-solve across RPCs) -----------------
 
-    def _prescreen(self, key, geometry: dict, args, pre_fn):
+    def _prescreen(self, key, geometry: dict, args, pre_fn, host_args=None,
+                   layout=None):
         """The verdict tensor for this solve: a delta refresh of the
         resident one when the previous same-geometry RPC left one and the
         plane delta is narrow, the full precompute otherwise. Bit-identical
         either way (the refresh replays the same screen ops over the
         changed rows/columns); any planning or dispatch failure degrades to
         the full path. Serialized under one lock — plan() and adopt() must
-        pair, and the gRPC executor runs several workers."""
+        pair, and the gRPC executor runs several workers.
+
+        host_args carries the numpy view when `args` was already
+        device_put (the mesh path's pre-sharded upload): the plane
+        fingerprints must hash host bytes, not round-trip device arrays."""
         from karpenter_core_tpu.ops import compat as ops_compat
         from karpenter_core_tpu.solver.incremental import IncrementalScreen
 
         pod_arrays, exist = args[0], args[9]
+        if host_args is not None:
+            host_pods, host_exist = host_args[0], host_args[9]
+        else:
+            host_pods, host_exist = pod_arrays, exist
         if ops_compat.resolve_incremental_mode() == "off":
             return pre_fn(pod_arrays, exist)
         # the global lock only guards the residency MAP; planning, the
@@ -403,14 +431,16 @@ class SolverService:
         with lock:
             delta = None
             try:
-                delta = inc.plan(key, pod_arrays, exist)
+                delta = inc.plan(key, host_pods, host_exist)
             except Exception:  # noqa: BLE001 — fingerprints are best-effort
                 inc.invalidate()
             screen0 = None
             prev = inc.resident(key)
             if delta is not None and prev is not None:
                 try:
-                    refresh = self._refresh_fn(key, geometry, delta.rb, delta.cb)
+                    refresh = self._refresh_fn(
+                        key, geometry, delta.rb, delta.cb, layout=layout
+                    )
                     row_idx, row_n, col_idx, col_n = delta.padded()
                     screen0 = refresh(
                         prev, pod_arrays, exist, row_idx, row_n, col_idx, col_n
@@ -427,7 +457,8 @@ class SolverService:
             inc.adopt(key, screen0)
             return screen0
 
-    def _refresh_fn(self, key, geometry: dict, rb: int, cb: int):
+    def _refresh_fn(self, key, geometry: dict, rb: int, cb: int,
+                    layout=None):
         """Jitted delta-refresh program per (solve key, row budget, col
         budget), LRU-bounded; donates the previous verdict tensor so the
         resident buffer updates in place. Takes _inc_mu only around the
@@ -448,6 +479,9 @@ class SolverService:
             make_screen_refresh_kernel(
                 segments, geometry["n_slots"], rb, cb,
                 screen_v=geometry.get("screen_v"),
+                # the mesh path's replicated fence (see the kernel's
+                # docstring): the resident tensor is a mesh-program output
+                spec_layout=layout,
             ),
             donate_argnums=(0,),
         )
@@ -466,71 +500,22 @@ class SolverService:
             for rkey in [k for k in self._refresh_compiled if k[0] == key]:
                 del self._refresh_compiled[rkey]
 
-    def _solve_sharded(self, geometry_key: str, geometry: dict, args,
-                       topo_meta, segments, zone_seg, ct_seg):
-        """Run the request through the multi-chip shard_map program.
+    def _layout_for(self, args):
+        """The parallel/specs.SpecLayout this request's programs build
+        against: the container's mesh layout for batches that clear the
+        small-batch routing floor (parallel/sharded.route_to_mesh — tiny
+        batches stop paying collective/mesh-dispatch overhead), None on a
+        single-chip container."""
+        if self.mesh is None:
+            return None
+        from karpenter_core_tpu.parallel.sharded import route_to_mesh
 
-        The shard plan (plan_shards_arrays) is recomputed server-side from
-        the wire tensors — the item-axis topology incidence rides in
-        pod_arrays/topo_own|topo_sel, so no extra request fields are needed —
-        and returned to the client as `count_split` for log decoding."""
-        import jax
+        total = int(np.asarray(args[0]["count"]).sum())
+        if not route_to_mesh(total, self.mesh.shape["dp"]):
+            return None
+        from karpenter_core_tpu.parallel.specs import layout_for
 
-        from karpenter_core_tpu.parallel.sharded import (
-            _dp_only_mesh,
-            make_sharded_run,
-            plan_shards_arrays,
-            shard_args,
-        )
-
-        pod_arrays = args[0]
-        exist_used = args[10]
-        type_alloc = args[5]
-        counts = np.asarray(pod_arrays["count"])
-        touch = None
-        if topo_meta is not None and "topo_own" in pod_arrays:
-            touch = (
-                np.asarray(pod_arrays["topo_own"])
-                | np.asarray(pod_arrays["topo_sel"])
-            ).T  # [G, I]
-        E_pad = exist_used.shape[0]
-        E_real = int(geometry.get("n_exist_real", E_pad))
-        mesh = self.mesh
-        if type_alloc.shape[0] % mesh.shape["tp"] != 0:
-            mesh = _dp_only_mesh(mesh)  # odd type axis: all devices on dp
-        ndp, ntp = mesh.shape["dp"], mesh.shape["tp"]
-        count_split, exist_owner = plan_shards_arrays(
-            counts, E_real, E_pad, ndp, touch, topo_meta
-        )
-        from karpenter_core_tpu.utils.compilecache import record_lookup
-
-        from karpenter_core_tpu.ops import compat as ops_compat
-
-        # screen mode in the key for the same reason as the single-device
-        # path: the mode resolves at trace time inside make_pack_kernel
-        key = (geometry_key, ndp, ntp, ops_compat.resolve_screen_mode())
-        with self._mu:
-            fn = self._compiled.get(key)
-            if fn is not None:
-                self._compiled.move_to_end(key)
-        record_lookup("service_sharded", fn is not None)
-        if fn is None:
-            fn = make_sharded_run(
-                segments, zone_seg, ct_seg, topo_meta, geometry["n_slots"],
-                mesh, log_len=geometry.get("log_len"),
-                screen_v=geometry.get("screen_v"),
-            )
-            with self._mu:
-                self._compiled[key] = fn
-                while len(self._compiled) > self.MAX_COMPILED:
-                    self._compiled.popitem(last=False)
-        from karpenter_core_tpu.obs import device_profiler
-
-        sh_args = shard_args(args, count_split, exist_owner)
-        with mesh, device_profiler():
-            log, ptr, state, _scheduled = fn(*sh_args)
-            jax.block_until_ready(log)
-        return log, ptr, state, count_split
+        return layout_for(self.mesh)
 
     def health(self, request: pb.HealthRequest, context=None) -> pb.HealthResponse:
         import jax
@@ -775,39 +760,10 @@ class RemoteSolver:
         state = _StateView(
             {k[len("state/"):]: v for k, v in tensors.items() if k.startswith("state/")}
         )
-        if "count_split" in tensors:
-            # the service ran the multi-chip program: per-shard-stacked logs
-            # + the shard plan come back; merge with the sharded decoder
-            from karpenter_core_tpu.parallel.sharded import decode_sharded
-
-            with TRACER.span("solver.phase.bind"):
-                result = decode_sharded(
-                    snap, log, tensors["ptr"], state, tensors["count_split"]
-                )
-            if result.failed_pods:
-                # per-shard slot exhaustion (see ShardedSolver._solve_once):
-                # double the budget — which sizes snap.n_slots per shard on
-                # the sharded service — and re-request once per doubling.
-                # Growth persists only when the plan split; a single-shard
-                # small batch must not permanently double the geometry.
-                from karpenter_core_tpu.parallel.sharded import ShardedSolver
-
-                cap = ShardedSolver.MAX_NODES_PER_SHARD_CAP
-                nopen = np.asarray(tensors["state/nopen"]).reshape(-1)
-                if np.any(nopen >= snap.n_slots) and self.max_nodes * 2 <= cap:
-                    cs = np.asarray(tensors["count_split"])
-                    sticky = int((cs.sum(axis=1) > 0).sum()) > 1
-                    old = self.max_nodes
-                    self.max_nodes = old * 2
-                    try:
-                        return self._solve_once(
-                            pods, provisioners, instance_types,
-                            daemonset_pods, state_nodes, kube_client, cluster,
-                        )
-                    finally:
-                        if not sticky:
-                            self.max_nodes = old
-            return result
+        # the mesh and single-device service programs return the same
+        # response shape (the GSPMD program is byte-identical to the
+        # single-device one — parallel/sharded.py), so one decode serves
+        # both
         ptr = int(np.asarray(tensors["ptr"]).reshape(-1)[0])
         with TRACER.span("solver.phase.bind"):
             return decode_solve(snap, (log, ptr), state)

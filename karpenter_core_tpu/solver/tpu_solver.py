@@ -262,7 +262,8 @@ def make_device_run(segments, zone_seg, ct_seg, topo_meta, n_slots,
                     backend: Optional[str] = None,
                     screen_v: Optional[int] = None,
                     screen_mode: Optional[str] = None,
-                    external_prescreen: bool = False):
+                    external_prescreen: bool = False,
+                    spec_layout=None):
     """Build the jittable device program — the whole Solve() as ONE program:
     feasibility + openable + packing scan. Pure function of the device arrays
     produced by device_args(); all dims except n_slots derive from shapes.
@@ -277,7 +278,14 @@ def make_device_run(segments, zone_seg, ct_seg, topo_meta, n_slots,
     (in-process TPUSolver only) the prescreen verdict tensor is NOT
     computed inside this program: run takes it as a leading `screen0`
     argument, produced by the companion make_prescreen_kernel program that
-    the solver dispatches (and times as solver.phase.prescreen) first."""
+    the solver dispatches (and times as solver.phase.prescreen) first.
+
+    spec_layout (parallel/specs.SpecLayout) makes this the multi-chip GSPMD
+    mesh program: the static-feasibility contraction computes sharded
+    (item rows over 'dp', type columns over 'tp' — docs/sharding.md) and is
+    reassembled by an XLA-inserted all_gather before the sequential pack
+    scan, which runs replicated. Byte-identical to the layout=None program
+    by construction: sharding only ever tiles contraction OUTPUT axes."""
     import jax.numpy as jnp
 
     from karpenter_core_tpu.ops import compat
@@ -312,19 +320,64 @@ def make_device_run(segments, zone_seg, ct_seg, topo_meta, n_slots,
             open0 = jnp.arange(N) < E
         else:
             open0 = (jnp.arange(N) < E) & jnp.pad(exist_open, (0, N - E))
+        pods_f = {k: pod_arrays[k] for k in ("allow", "out", "defined", "escape")}
+        types_f, tmask_f, offer_f = types, tmpl_type_mask, type_offering_ok
+        if spec_layout is not None:
+            # sharded precompute seam: item rows over dp, type columns over
+            # tp — the [J, I, T] contraction tiles with no communication,
+            # then gathers ONCE for the replicated scan (docs/sharding.md)
+            ly = spec_layout
+            pods_f = ly.shard_reqset(pods_f, ly.slot_plane())
+            types_f = ly.shard_reqset(dict(types), ly.type_plane())
+            tmask_f = ly.constrain(tmpl_type_mask, ly.type_cols())
+            offer_f = ly.constrain(type_offering_ok, ly.type_plane(rank=3))
         f_static = feasibility_static(
-            {k: pod_arrays[k] for k in ("allow", "out", "defined", "escape")},
+            pods_f,
             tmpl,
-            types,
+            types_f,
             pod_arrays["tol_tmpl"],
-            tmpl_type_mask,
-            type_offering_ok,
+            tmask_f,
+            offer_f,
             zone_seg,
             ct_seg,
             segments,
             well_known,
         )
+        if spec_layout is not None:
+            f_static = spec_layout.constrain(f_static, spec_layout.feasibility())
         openable = openable_mask(f_static, pod_arrays["requests"], tmpl_daemon, type_alloc)
+        if spec_layout is not None:
+            # the all_gather seam: the scan consumes replicated planes.
+            # EVERY tensor entering the pack scan is pinned replicated —
+            # not just the sharded precompute outputs — so GSPMD's
+            # propagation can never push a sharding into the scan carry
+            # (a per-step collective at best; with committed mesh inputs
+            # the auto-partitioned scan was observed to MISCOMPUTE the
+            # bulk-fill region on the CPU backend — the explicit pins are
+            # a correctness fence, not just a perf choice)
+            g = spec_layout.gather
+            f_static = g(f_static)
+            # process-unique persistent-cache key on CPU (semantic no-op;
+            # XLA:CPU reloads of mesh executables are nondeterministic —
+            # specs.SpecLayout.cache_salt)
+            openable = spec_layout.cache_salt(g(openable))
+            screen0 = g(screen0) if screen0 is not None else None
+            pod_arrays = {k: g(v) for k, v in pod_arrays.items()}
+            tmpl = {k: g(v) for k, v in tmpl.items()}
+            exist = {k: g(v) for k, v in exist.items()}
+            types = {k: g(v) for k, v in types.items()}
+            (tmpl_daemon, tmpl_type_mask, type_alloc, type_capacity,
+             type_offering_ok, pod_tol_all, exist_used, exist_cap,
+             well_known, remaining0, topo_counts0, topo_hcounts0,
+             topo_doms0, exist_ports, exist_vols, exist_vol_limits,
+             vol_driver) = map(g, (
+                 tmpl_daemon, tmpl_type_mask, type_alloc, type_capacity,
+                 type_offering_ok, pod_tol_all, exist_used, exist_cap,
+                 well_known, remaining0, topo_counts0, topo_hcounts0,
+                 topo_doms0, exist_ports, exist_vols, exist_vol_limits,
+                 vol_driver,
+             ))
+            topo_terms = {k: g(v) for k, v in topo_terms.items()}
         # initial state: existing slots [0, E), machine slots open later
         state = PackState(
             used=jnp.zeros((N, R), jnp.float32).at[:E].set(exist_used),
@@ -427,18 +480,20 @@ def make_device_run(segments, zone_seg, ct_seg, topo_meta, n_slots,
 def build_device_solve(snap: EncodedSnapshot, max_nodes: int = 1024,
                        backend: Optional[str] = None,
                        screen_mode: Optional[str] = None,
-                       external_prescreen: bool = False):
+                       external_prescreen: bool = False,
+                       spec_layout=None):
     """Returns (geometry_key, run_fn) for a snapshot's geometry. backend
     picks the kernel lowering (compat.resolve_backend default); tests force
     'mxu' on CPU to exercise the exact TPU code path. screen_mode picks the
-    slot-screen strategy (prescreen/tiered)."""
+    slot-screen strategy (prescreen/tiered). spec_layout builds the GSPMD
+    mesh program instead of the single-device one (parallel/specs.py)."""
     geom = solve_geometry(snap, max_nodes)
     (_P, _J, _T, _E, _R, _K, _V, N, segments_t, zone_seg, ct_seg, _topo_sig,
      log_len, _Q, _W, _D, screen_v) = geom
     run = make_device_run(
         segments_t, zone_seg, ct_seg, snap.topo_meta, N, log_len=log_len,
         backend=backend, screen_v=screen_v, screen_mode=screen_mode,
-        external_prescreen=external_prescreen,
+        external_prescreen=external_prescreen, spec_layout=spec_layout,
     )
     return geom, run
 
@@ -649,9 +704,14 @@ class _StagedCall:
     donated_leaves: list
     donated_meta: list
     rebuild: object  # (bundle, donated_iter) -> run-arg pytree, traceable
+    # parallel/specs.SpecLayout when this call targets the GSPMD mesh
+    # program; None on the single-device path. Its .key rides the cache
+    # key, so mesh programs age in the same LRU without ever colliding
+    # with single-device entries at the same geometry.
+    spec_layout: object = None
 
 
-def _bundle_args(args, geom, run, backend, screen_mode):
+def _bundle_args(args, geom, run, backend, screen_mode, spec_layout=None):
     """Pack device_args output into the upload bundle (see the layout
     comments inline) and derive the compiled-program cache key. Shared by
     TPUSolver._run_kernels (live path) and TPUSolver.prewarm_snapshot."""
@@ -716,7 +776,10 @@ def _bundle_args(args, geom, run, backend, screen_mode):
     donated_meta = [
         (packed[i].shape, packed[i].dtype) for i in sorted(donate_set)
     ]
-    key = (geom, backend, screen_mode, spec, treedef, tuple(layout))
+    key = (
+        geom, backend, screen_mode, spec, treedef, tuple(layout),
+        spec_layout.key if spec_layout is not None else None,
+    )
 
     # bundle-leaf reconstruction, shared by the solve program, the
     # prescreen precompute, and the (lazily compiled, possibly on a
@@ -746,7 +809,7 @@ def _bundle_args(args, geom, run, backend, screen_mode):
     return _StagedCall(
         geom=geom, run=run, key=key, spec=spec, treedef=treedef,
         layout=tuple(layout), bundle=bundle, donated_leaves=donated_leaves,
-        donated_meta=donated_meta, rebuild=_rebuild,
+        donated_meta=donated_meta, rebuild=_rebuild, spec_layout=spec_layout,
     )
 
 
@@ -826,6 +889,10 @@ class TPUSolver:
         self._refresh_compiled = OrderedDict()
         self._gate_ok = True
         self.last_prescreen_mode = None
+        # the SpecLayout the last _run_kernels dispatch built against:
+        # None = single-device program, a layout = the GSPMD mesh program
+        # (observability + the sharded small-batch routing tests)
+        self.last_spec_layout = None
         # cross-solve dictionary carryover (encode.dictionary_covers):
         # consecutive churn batches whose vocabulary has saturated adopt the
         # previous solve's dictionary, pinning V/K/segments — and with them
@@ -873,15 +940,26 @@ class TPUSolver:
         from karpenter_core_tpu.utils.compilecache import record_lookup
 
         screen_mode = self.screen_mode or ops_compat.resolve_screen_mode()
+        layout = self._layout_for(snap)
         geom, run = build_device_solve(
             snap, self.max_nodes, backend=self.backend,
             screen_mode=screen_mode, external_prescreen=True,
+            spec_layout=layout,
         )
         args = device_args(snap, provisioners)
-        staged = _bundle_args(args, geom, run, self.backend, screen_mode)
+        staged = _bundle_args(
+            args, geom, run, self.backend, screen_mode, spec_layout=layout
+        )
         entry, cache_hit = self._entry_for(staged, screen_mode, aot=True)
         record_lookup("prewarm", cache_hit)
-        if not cache_hit and self._inc_enabled(screen_mode):
+        if not cache_hit and self._inc_enabled(screen_mode) and layout is None:
+            # mesh entries skip the refresh AOT: an executable lowered from
+            # host avals would be single-device committed, and the first
+            # mesh dispatch (committed replicated arrays) would just
+            # discard it — let the live path jit the mesh refresh (which
+            # DOES carry the spec_layout replicated fence + cache salt, see
+            # make_screen_refresh_kernel); the solve+prescreen pair above
+            # is where the compile time is anyway
             self._prewarm_refresh(staged, entry)
         return "cached" if cache_hit else "compiled"
 
@@ -897,7 +975,7 @@ class TPUSolver:
             return
         refresh_fn, _minted = self._refresh_fn(
             staged.key, staged.geom, 8, 8, staged.rebuild,
-            staged.donated_meta,
+            staged.donated_meta, spec_layout=staged.spec_layout,
         )
         bundle_sds = jax.ShapeDtypeStruct(
             staged.bundle.shape, staged.bundle.dtype
@@ -980,6 +1058,14 @@ class TPUSolver:
         with TRACER.span("solver.phase.bind"):
             return decode_solve(snap, (log, ptr), state)
 
+    def _layout_for(self, snap) -> object:
+        """The parallel/specs.SpecLayout this snapshot's programs build
+        against — None on the single-device solver. ShardedSolver
+        (parallel/sharded.py) overrides this with its mesh layout plus
+        the small-batch single-device routing, so the whole compile /
+        prewarm / incremental machinery below serves both paths."""
+        return None
+
     def _inc_enabled(self, screen_mode: Optional[str] = None) -> bool:
         """Delta re-solve policy for this solver: prescreen mode only
         (there is no resident tensor to refresh under tiered), gated by
@@ -993,7 +1079,8 @@ class TPUSolver:
         mode = self.incremental or ops_compat.resolve_incremental_mode()
         return mode != "off"
 
-    def _refresh_fn(self, key, geom, rb, cb, rebuild, donated_meta):
+    def _refresh_fn(self, key, geom, rb, cb, rebuild, donated_meta,
+                    spec_layout=None):
         """The jitted delta-refresh program for (solve key, row budget,
         col budget), lazily compiled and LRU-bounded, plus whether this
         call MINTED it (the dispatch that follows pays the compile — the
@@ -1016,7 +1103,8 @@ class TPUSolver:
         (_P, _J, _T, _E, _R, _K, _V, N_, segments_t, _zs, _cs, _tsig, _ll,
          _Q, _W, _D, scr_v) = geom
         kern = make_screen_refresh_kernel(
-            segments_t, N_, rb, cb, backend=self.backend, screen_v=scr_v
+            segments_t, N_, rb, cb, backend=self.backend, screen_v=scr_v,
+            spec_layout=spec_layout,
         )
 
         def refresh_bundled(bundle, prev_screen, row_idx, row_n, col_idx,
@@ -1120,7 +1208,8 @@ class TPUSolver:
             (_P, _J, _T, _E, _R, _K, _V, N_, segments_t, _zs, _cs,
              _tsig, _ll, _Q, _W, _D, scr_v) = staged.geom
             prescreen_run = make_prescreen_kernel(
-                segments_t, N_, backend=self.backend, screen_v=scr_v
+                segments_t, N_, backend=self.backend, screen_v=scr_v,
+                spec_layout=staged.spec_layout,
             )
 
             def prescreen_bundled(bundle):
@@ -1159,6 +1248,12 @@ class TPUSolver:
             fn.aot = fn.jit.lower(bundle, *staged.donated_leaves).compile()
 
     def _run_kernels(self, snap: EncodedSnapshot, provisioners: List[Provisioner]):
+        return self._run_kernels_impl(
+            snap, provisioners, self._layout_for(snap)
+        )
+
+    def _run_kernels_impl(self, snap: EncodedSnapshot,
+                          provisioners: List[Provisioner], layout):
         import time as _time
 
         import jax
@@ -1184,14 +1279,18 @@ class TPUSolver:
         from karpenter_core_tpu.ops import compat as ops_compat
 
         screen_mode = self.screen_mode or ops_compat.resolve_screen_mode()
+        self.last_spec_layout = layout
         geom, run = build_device_solve(
             snap, self.max_nodes, backend=self.backend,
             screen_mode=screen_mode, external_prescreen=True,
+            spec_layout=layout,
         )
         args = device_args(snap, provisioners)
         raw_args = args  # host numpy view (incremental plane fingerprints)
         _mark("args")
-        staged = _bundle_args(args, geom, run, self.backend, screen_mode)
+        staged = _bundle_args(
+            args, geom, run, self.backend, screen_mode, spec_layout=layout
+        )
         _mark("pack")
         from karpenter_core_tpu.utils.compilecache import (
             record_compile_seconds,
@@ -1207,8 +1306,14 @@ class TPUSolver:
         _rebuild = staged.rebuild
         donated_meta = staged.donated_meta
         fn, pre_fn = entry
-        # one transfer for the bundle + one per donated plane
-        args = jax.device_put((staged.bundle, *staged.donated_leaves))
+        # one transfer for the bundle + one per donated plane; on the mesh
+        # path the upload lands committed to the mesh (NamedSharding,
+        # replicated — the bundle is opaque bytes; per-family sharding
+        # engages at the in-program constraint seams)
+        if layout is not None:
+            args = layout.put_replicated((staged.bundle, *staged.donated_leaves))
+        else:
+            args = jax.device_put((staged.bundle, *staged.donated_leaves))
         if self.profile_phases:
             # barrier ONLY under opt-in phase profiling: it serializes the
             # upload with jit trace/compile, costing cold solves the full
@@ -1270,7 +1375,7 @@ class TPUSolver:
                         try:
                             refresh_fn, cold = self._refresh_fn(
                                 key, geom, delta.rb, delta.cb, _rebuild,
-                                donated_meta,
+                                donated_meta, spec_layout=layout,
                             )
                             row_idx, row_n, col_idx, col_n = delta.padded()
                             screen0 = refresh_fn(
@@ -1313,6 +1418,16 @@ class TPUSolver:
             log, ptr, state = fn(*run_args)
             if profile_dir():
                 jax.block_until_ready(state)
+        if layout is not None:
+            # rehome the outputs to ONE device before the fetch path: its
+            # eager ops (slicing, packbits, nonzero compaction) each
+            # compile tiny executables, which must be SINGLE-device —
+            # eager ops can't carry the cache_salt, and XLA:CPU reloads of
+            # multi-device executables are nondeterministic
+            # (specs.SpecLayout.cache_salt has the full story)
+            log, ptr, state = jax.device_put(
+                (log, ptr, state), jax.devices()[0]
+            )
 
         # fetch ONLY what decode reads: log entries [:ptr], bulk rows
         # [:bulk_n], and state slot rows [:nopen] (the slot budget is mostly
@@ -1503,29 +1618,17 @@ class _SlotState:
             self.__dict__.pop("_packed_dev", None)
 
 
-def expand_log(snap: EncodedSnapshot, log, ptr: int,
-               member_lo=None, member_hi=None) -> np.ndarray:
+def expand_log(snap: EncodedSnapshot, log, ptr: int) -> np.ndarray:
     """Replay the kernel's commit log into a per-pod slot assignment [P]
     (-1 = unscheduled). Entry e places ns slots starting at slot, k replicas
     per slot (k_last on the final slot), consuming item e.item's member pods
-    in order.
-
-    member_lo/member_hi (per-item arrays) bound which members this log may
-    consume — the dp-sharded path replays each shard's log against its own
-    slice of every equivalence class (parallel/sharded.py plan_shards)."""
+    in order. (The GSPMD mesh program produces the same single log, so one
+    replay serves both the single-device and multi-chip paths.)"""
     P = len(snap.pods)
     assigned = np.full(P, -1, dtype=np.int64)
     members = snap.item_members or [[i] for i in range(P)]
-    cursor = (
-        [int(x) for x in member_lo]
-        if member_lo is not None
-        else [0] * len(members)
-    )
-    cap = (
-        [int(x) for x in member_hi]
-        if member_hi is not None
-        else [len(m) for m in members]
-    )
+    cursor = [0] * len(members)
+    cap = [len(m) for m in members]
     items = np.asarray(log["item"])
     slots = np.asarray(log["slot"])
     nss = np.asarray(log["ns"])

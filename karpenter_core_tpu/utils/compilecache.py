@@ -56,7 +56,13 @@ def enable_persistent_cache(cache_dir: Optional[str] = None) -> Optional[str]:
 
     KARPENTER_COMPILE_CACHE_DIR overrides the default
     (<tmp>/karpenter-tpu-xla-cache); set it to "0" / "off" to disable.
-    Returns the directory in use, or None when disabled/unavailable."""
+    Returns the directory in use, or None when disabled/unavailable.
+
+    GSPMD mesh programs opt OUT of cross-process reuse on the CPU backend
+    (their cache keys are process-salted — parallel/specs.SpecLayout
+    .cache_salt): XLA:CPU deserialization of multi-device executables is
+    nondeterministic, and a reloaded mesh solve flips placements. TPU
+    mesh programs and all single-device programs cache normally."""
     env = envflags.raw("KARPENTER_COMPILE_CACHE_DIR")
     if env.lower() in ("0", "off", "disabled"):
         return None

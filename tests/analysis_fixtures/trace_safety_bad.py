@@ -42,3 +42,15 @@ def shard_body(x):
 
 sharded = jax.shard_map(shard_body, mesh=None, in_specs=None, out_specs=None)
 compiled = jax.jit(sharded)
+
+
+def mesh_body(x, y):
+    # reached via NamedSharding-jit below: host transfers inside the mesh
+    # program body sync every device on the mesh. jax.device_put is fine
+    # here (on-device placement) and must NOT flag.
+    host = jax.device_get(x)  # BAD: device_get on a traced value
+    placed = jax.device_put(host)  # ok: placement, not a host round-trip
+    return y + jnp.asarray(1.0) + placed
+
+
+mesh_compiled = jax.jit(mesh_body, in_shardings=None, donate_argnums=(0,))

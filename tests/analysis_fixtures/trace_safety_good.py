@@ -21,6 +21,13 @@ def host_helper(batch):
     return batch
 
 
+def dispatch_and_fetch(fn, args):
+    # device_get OUTSIDE a traced body is the correct place to fetch —
+    # this helper is never passed to jit/shard_map, so it must not flag
+    out = fn(*args)
+    return jax.device_get(out)
+
+
 def factory(width):
     @jax.jit
     def inner(x):

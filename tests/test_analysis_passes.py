@@ -37,13 +37,17 @@ def run_one(pass_, name, **config_overrides):
 def test_trace_safety_catches_all_seeded_flavors():
     violations, _ = run_one(TraceSafetyPass(), "trace_safety_bad.py")
     messages = [v.message for v in violations]
-    assert len(violations) == 7, messages
+    assert len(violations) == 8, messages
     assert sum("`if` on traced" in m for m in messages) == 2  # decorator + shard_map
     assert sum("`while` on traced" in m for m in messages) == 1
     assert sum("`bool()` coerces" in m for m in messages) == 1
     assert sum("`float()` coerces" in m for m in messages) == 1
     assert sum("`.item()`" in m for m in messages) == 1
     assert sum("host-side `np." in m for m in messages) == 1
+    # ISSUE 8: host transfers inside a NamedSharding-jit mesh-program body
+    # (device_put deliberately does NOT flag — on-device placement)
+    assert sum("`device_get` host transfer" in m for m in messages) == 1
+    assert not any("`device_put`" in m for m in messages)
     assert all(v.rule == "trace-safety" for v in violations)
 
 

@@ -332,6 +332,48 @@ def test_bucket_ladder_program_budget():
         assert geom[3] in exist_values, f"existing axis {geom[3]} off-ladder"
 
 
+def test_sharded_programs_respect_bucket_and_cache_budget():
+    """ISSUE 8: the GSPMD mesh programs ride the SAME bucket-ladder
+    geometry keys (suffixed with the mesh shape), so repeat solves in one
+    geometry bucket through the mesh path share ONE cache entry holding
+    exactly two programs (prescreen + solve), exactly like the
+    single-device guard above — `compiled_programs` stays bounded on
+    multi-chip deployments too."""
+    import jax
+    from jax.sharding import Mesh
+    import numpy as np
+
+    from karpenter_core_tpu.parallel import sharded as sharded_mod
+    from karpenter_core_tpu.parallel.sharded import ShardedSolver
+
+    old = sharded_mod.MIN_SPLIT_REPLICAS_PER_SHARD
+    sharded_mod.MIN_SPLIT_REPLICAS_PER_SHARD = 0  # small batches, mesh path
+    try:
+        mesh = Mesh(np.array(jax.devices()[:8]).reshape(4, 2), ("dp", "tp"))
+        universe = fake.instance_types(5)
+        provisioners = [make_provisioner(name="default")]
+        its = {"default": universe}
+        solver = ShardedSolver(mesh, max_nodes=48, screen_mode="prescreen")
+        for n in (18, 20):  # same item bucket (32)
+            pods = [
+                make_pod(labels={"app": f"t{i}"},
+                         requests={"cpu": str(0.1 * (i + 1))})
+                for i in range(n)
+            ]
+            res = solver.solve(pods, provisioners, its)
+            assert res.pod_count_new() + res.pod_count_existing() == n
+            assert solver.last_path == "mesh"
+        assert len(solver._compiled) == 1, (
+            f"one geometry bucket minted {len(solver._compiled)} mesh entries"
+        )
+        (key,) = solver._compiled
+        assert key[-1] == ("gspmd", 4, 2), "mesh entry missing its mesh key"
+        fn, pre_fn = solver._compiled[key]
+        assert fn is not None and pre_fn is not None
+    finally:
+        sharded_mod.MIN_SPLIT_REPLICAS_PER_SHARD = old
+
+
 @perf_gate
 def test_host_fallback_throughput_floor():
     """The host greedy fallback also holds the reference's floor (it IS the

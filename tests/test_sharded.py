@@ -1,32 +1,44 @@
-"""Multi-device sharded solve vs single-device solve (differential).
+"""GSPMD mesh solve vs single-device solve (byte-identity + structure).
 
-Runs on the 8 virtual CPU devices from conftest. The equivalence bar
-(SURVEY.md section 7): all constraints satisfied, every pod the single-device
-solve schedules also schedules sharded, and topology outcomes (skew,
-co-location, anti-affinity separation) match the reference semantics —
-placements need not be bit-identical because dp sub-solves pack
-independently.
+Runs on the 8 virtual CPU devices from conftest. The equivalence bar is
+BYTE-IDENTITY (ISSUE 8): the multi-chip path is the single-device program
+jit-compiled with NamedSharding constraints (parallel/specs.SpecLayout),
+and sharding only tiles contraction output axes — so for identical inputs
+the placements must be flightrec-canonical byte-identical across the
+screen-parity geometry families (generic mix, hostname anti-affinity,
+relaxation, bulk replicas), not merely "equivalent".
+
+Structural guards ride along: the mesh program must contain NO host
+round-trips (callbacks) in its jaxpr — the one-program rebuild's whole
+point — and small batches must route through the plain single-device
+program (the collective/mesh overhead fast path).
 """
+import copy
+
 import numpy as np
 import pytest
 
 import jax
 from jax.sharding import Mesh
 
-from karpenter_core_tpu.api.labels import PROVISIONER_NAME_LABEL_KEY
 from karpenter_core_tpu.cloudprovider import fake
-from karpenter_core_tpu.kube.objects import (
-    LABEL_HOSTNAME,
-    LABEL_TOPOLOGY_ZONE,
-    LabelSelector,
-    PodAffinityTerm,
-    TopologySpreadConstraint,
+from karpenter_core_tpu.obs.flightrec import (
+    canonical_placements,
+    placements_json,
 )
-from karpenter_core_tpu.parallel.sharded import ShardedSolver, plan_shards
-from karpenter_core_tpu.solver.encode import encode_snapshot
+from karpenter_core_tpu.parallel import sharded as sharded_mod
+from karpenter_core_tpu.parallel.sharded import (
+    MIN_SPLIT_REPLICAS_PER_SHARD,
+    ShardedSolver,
+    route_to_mesh,
+)
+from karpenter_core_tpu.parallel.specs import SpecLayout
 from karpenter_core_tpu.solver.tpu_solver import TPUSolver
 from karpenter_core_tpu.state.node import StateNode
 from karpenter_core_tpu.testing import make_node, make_pod, make_provisioner
+
+from tests.test_differential_fuzz import _workload as _g1_workload
+from tests.test_differential_fuzz_wide import _g3_workload, _g5_workload
 
 
 @pytest.fixture(scope="module")
@@ -36,305 +48,264 @@ def mesh():
 
 
 @pytest.fixture(autouse=True)
-def force_split(monkeypatch):
-    """This suite exists to pin the SPLIT mechanics (cross-shard ownership,
-    limit shares, component routing): disable the small-batch single-shard
-    routing so the deliberately small differential batches still split.
-    The single-shard routing has its own dedicated test below, which
+def force_mesh(monkeypatch):
+    """The parity families are deliberately small (anchored fuzz
+    vocabularies keep the compiled geometry constant across seeds, which
+    is what keeps this suite inside the tier-1 budget) — zero the
+    small-batch routing floor so they still exercise the MESH program.
+    The routing fast path has its own dedicated test below, which
     restores the production threshold locally."""
-    from karpenter_core_tpu.parallel import sharded as sharded_mod
-
     monkeypatch.setattr(sharded_mod, "MIN_SPLIT_REPLICAS_PER_SHARD", 0)
 
 
-def run_both(mesh, pods, provisioners, its, state_nodes=None):
-    import copy
+# one solver pair per module: the anchored workload generators keep the
+# dictionary geometry constant per family, so each (solver, family) pair
+# compiles once and the seeds reuse the program
+_SOLVERS = {}
 
-    sharded = ShardedSolver(mesh, max_nodes_per_shard=16).solve(
-        pods,
-        provisioners,
-        its,
-        state_nodes=[n.deep_copy() for n in state_nodes] if state_nodes else None,
+
+def _pair(mesh):
+    if "pair" not in _SOLVERS:
+        _SOLVERS["pair"] = (
+            ShardedSolver(mesh, max_nodes=96),
+            TPUSolver(max_nodes=96),
+        )
+    return _SOLVERS["pair"]
+
+
+def assert_byte_identical(mesh, pods, provisioners, its, nodes=None):
+    sh, sg = _pair(mesh)
+    res_sh = sh.solve(
+        copy.deepcopy(pods), provisioners, its,
+        state_nodes=[n.deep_copy() for n in nodes] if nodes else None,
     )
-    single = TPUSolver(max_nodes=64).solve(
-        pods,
-        provisioners,
-        its,
-        state_nodes=[n.deep_copy() for n in state_nodes] if state_nodes else None,
+    res_sg = sg.solve(
+        copy.deepcopy(pods), provisioners, its,
+        state_nodes=[n.deep_copy() for n in nodes] if nodes else None,
     )
-    return sharded, single
-
-
-def zonal_spread(app="spread", max_skew=1):
-    return TopologySpreadConstraint(
-        max_skew=max_skew,
-        topology_key=LABEL_TOPOLOGY_ZONE,
-        when_unsatisfiable="DoNotSchedule",
-        label_selector=LabelSelector(match_labels={"app": app}),
+    assert sh.last_path == "mesh", "parity family must exercise the mesh"
+    a = placements_json(canonical_placements(res_sh))
+    b = placements_json(canonical_placements(res_sg))
+    assert a == b, (
+        f"mesh placements diverged from single-device: "
+        f"{len(res_sh.new_machines)}/{len(res_sh.failed_pods)} vs "
+        f"{len(res_sg.new_machines)}/{len(res_sg.failed_pods)} "
+        f"machines/failed"
     )
+    return res_sh, res_sg
 
 
-def test_plain_pods_all_schedule(mesh):
-    pods = [make_pod(requests={"cpu": "1"}) for _ in range(40)]
-    provs = [make_provisioner(name="default")]
-    its = {"default": fake.instance_types(8)}
-    sh, dv = run_both(mesh, pods, provs, its)
-    assert sh.pod_count_new() == dv.pod_count_new() == 40
-    assert not sh.failed_pods and not dv.failed_pods
+# ---------------------------------------------------------------------------
+# byte-identity across the screen-parity geometry families
 
 
-def test_spread_skew_matches_single_device(mesh):
+@pytest.mark.parametrize("seed", [3, 11])
+def test_generic_mix_byte_identical(mesh, seed):
+    """The anchored generic fuzz family (zones, apps, spread, hostPorts,
+    tolerations) — placements byte-identical mesh vs single."""
+    universe = fake.instance_types(6)
+    rng = np.random.default_rng(seed)
+    pods, provisioners, its, nodes = _g1_workload(rng, universe)
+    assert_byte_identical(mesh, pods, provisioners, its, nodes)
+
+
+@pytest.mark.parametrize("seed", [5])
+def test_hostname_anti_affinity_byte_identical(mesh, seed):
+    """Hostname anti-affinity services (bulk items + machine-region bulk
+    fill) — the family whose bulk-take region caught the GSPMD
+    auto-partitioned scan miscomputing before the replication fence."""
+    rng = np.random.default_rng(seed)
+    pods, provisioners, its, nodes = _g5_workload(rng)
+    assert_byte_identical(mesh, pods, provisioners, its, nodes)
+
+
+@pytest.mark.parametrize("seed", [7])
+def test_relaxation_byte_identical(mesh, seed):
+    """Relaxation families (invalid preferred terms, ScheduleAnyway
+    spreads): the relax rounds re-encode and re-solve through the mesh
+    program; rounds and placements must both match."""
+    rng = np.random.default_rng(seed)
+    pods, provisioners, its, nodes = _g3_workload(rng)
+    res_sh, res_sg = assert_byte_identical(mesh, pods, provisioners, its, nodes)
+    assert res_sh.rounds == res_sg.rounds
+
+
+def test_bulk_replicas_byte_identical(mesh):
+    """Deployment-style bulk replica classes over existing nodes: the
+    bulk existing-fill and run-commit log paths, byte-identical."""
     pods = [
-        make_pod(labels={"app": "spread"}, requests={"cpu": "1"},
-                 topology_spread=[zonal_spread()])
-        for _ in range(9)
-    ] + [make_pod(requests={"cpu": "1"}) for _ in range(12)]
-    provs = [make_provisioner(name="default")]
-    its = {"default": fake.instance_types(8)}
-    sh, dv = run_both(mesh, pods, provs, its)
-    assert not sh.failed_pods and not dv.failed_pods
-
-    def zone_counts(res):
-        counts = {}
-        for m in res.new_machines:
-            n = sum(1 for p in m.pods if p.metadata.labels.get("app") == "spread")
-            if n:
-                zone = m.requirements.get_requirement(LABEL_TOPOLOGY_ZONE).values_list()[0]
-                counts[zone] = counts.get(zone, 0) + n
-        return counts
-
-    shc, dvc = zone_counts(sh), zone_counts(dv)
-    # 9 pods over 3 zones under max_skew=1 -> exactly 3 per zone, both paths
-    assert sorted(shc.values()) == sorted(dvc.values()) == [3, 3, 3]
-
-
-def test_pod_affinity_colocates_one_zone(mesh):
-    aff = PodAffinityTerm(
-        topology_key=LABEL_TOPOLOGY_ZONE,
-        label_selector=LabelSelector(match_labels={"app": "aff"}),
-    )
-    pods = [
-        make_pod(labels={"app": "aff"}, requests={"cpu": "1"},
-                 pod_affinity_required=[aff])
-        for _ in range(8)
+        make_pod(labels={"app": f"dep-{i % 3}"}, requests={"cpu": "0.5"})
+        for i in range(120)
     ]
-    provs = [make_provisioner(name="default")]
-    its = {"default": fake.instance_types(8)}
-    sh, dv = run_both(mesh, pods, provs, its)
-    assert not sh.failed_pods and not dv.failed_pods
-
-    def zones(res):
-        zs = set()
-        for m in res.new_machines:
-            zs.update(m.requirements.get_requirement(LABEL_TOPOLOGY_ZONE).values_list())
-        return zs
-
-    assert len(zones(sh)) == 1  # affinity keeps the group in one zone
-    assert len(zones(dv)) == 1
-
-
-def test_anti_affinity_flexible_machines_block_domains(mesh):
-    """Reference semantics (topology.go:120-143): an anti-affinity pod on a
-    NEW machine records ALL the machine's viable domains, so 3 identical
-    anti pods with 3-zone-flexible machines schedule exactly ONE pod — the
-    first blocks every zone. Sharded must reproduce this, not 'improve' it."""
-    anti = PodAffinityTerm(
-        topology_key=LABEL_TOPOLOGY_ZONE,
-        label_selector=LabelSelector(match_labels={"app": "anti"}),
-    )
-    pods = [
-        make_pod(labels={"app": "anti"}, requests={"cpu": "1"},
-                 pod_anti_affinity_required=[anti])
-        for _ in range(3)
-    ]
-    provs = [make_provisioner(name="default")]
-    its = {"default": fake.instance_types(8)}
-    sh, dv = run_both(mesh, pods, provs, its)
-    assert sh.pod_count_new() == dv.pod_count_new() == 1
-    assert len(sh.failed_pods) == len(dv.failed_pods) == 2
-
-
-def test_anti_affinity_zone_pinned_separates(mesh):
-    """Zone-pinned anti pods (each machine narrowed to one zone) all
-    schedule, in distinct zones, on both paths."""
-    anti = PodAffinityTerm(
-        topology_key=LABEL_TOPOLOGY_ZONE,
-        label_selector=LabelSelector(match_labels={"app": "anti"}),
-    )
-    pods = [
-        make_pod(labels={"app": "anti"}, requests={"cpu": "1"},
-                 pod_anti_affinity_required=[anti],
-                 node_selector={LABEL_TOPOLOGY_ZONE: f"test-zone-{z}"})
-        for z in (1, 2, 3)
-    ]
-    provs = [make_provisioner(name="default")]
-    its = {"default": fake.instance_types(8)}
-    sh, dv = run_both(mesh, pods, provs, its)
-    assert not sh.failed_pods and not dv.failed_pods
-
-    def pod_zones(res):
-        zs = []
-        for m in res.new_machines:
-            for _ in m.pods:
-                zs.extend(
-                    m.requirements.get_requirement(LABEL_TOPOLOGY_ZONE).values_list()
-                )
-        return zs
-
-    assert len(set(pod_zones(sh))) == 3
-    assert len(set(pod_zones(dv))) == 3
-
-
-def test_existing_nodes_fill_before_new(mesh):
     nodes = [
-        StateNode(
-            node=make_node(
-                labels={
-                    PROVISIONER_NAME_LABEL_KEY: "default",
-                    "karpenter.sh/initialized": "true",
-                },
-                capacity={"cpu": "8", "memory": "16Gi", "pods": "50"},
-            )
-        ).deep_copy()
+        StateNode(node=make_node(
+            labels={
+                "karpenter.sh/provisioner-name": "default",
+                "karpenter.sh/initialized": "true",
+            },
+            capacity={"cpu": "8", "memory": "16Gi", "pods": "50"},
+        )).deep_copy()
         for _ in range(4)
     ]
-    pods = [make_pod(requests={"cpu": "1"}) for _ in range(24)]
-    provs = [make_provisioner(name="default")]
-    its = {"default": fake.instance_types(8)}
-    sh, dv = run_both(mesh, pods, provs, its, state_nodes=nodes)
-    assert sh.pod_count_existing() == dv.pod_count_existing() == 24
-    assert not sh.new_machines and not dv.new_machines
-
-
-def test_reference_mix_with_existing(mesh):
-    aff = PodAffinityTerm(
-        topology_key=LABEL_TOPOLOGY_ZONE,
-        label_selector=LabelSelector(match_labels={"app": "aff"}),
-    )
-    pods = []
-    for i in range(28):
-        kind = i % 7
-        if kind == 0:
-            pods.append(
-                make_pod(labels={"app": "spread"}, requests={"cpu": "1"},
-                         topology_spread=[zonal_spread()])
-            )
-        elif kind in (2, 3):
-            pods.append(
-                make_pod(labels={"app": "aff"}, requests={"cpu": "1"},
-                         pod_affinity_required=[aff])
-            )
-        else:
-            pods.append(make_pod(requests={"cpu": "1", "memory": "1Gi"}))
-    nodes = [
-        StateNode(
-            node=make_node(
-                labels={
-                    PROVISIONER_NAME_LABEL_KEY: "default",
-                    "karpenter.sh/initialized": "true",
-                },
-                capacity={"cpu": "4", "memory": "8Gi", "pods": "20"},
-            )
-        ).deep_copy()
-        for _ in range(2)
-    ]
-    provs = [make_provisioner(name="default")]
-    its = {"default": fake.instance_types(8)}
-    sh, dv = run_both(mesh, pods, provs, its, state_nodes=nodes)
-    assert not sh.failed_pods and not dv.failed_pods
-    assert (sh.pod_count_new() + sh.pod_count_existing()) == 28
-    assert (dv.pod_count_new() + dv.pod_count_existing()) == 28
-
-
-def test_provisioner_limits_respected_globally(mesh):
-    # limit allows ~8 cpu total; sharded shares must never over-launch
-    provs = [make_provisioner(name="default", limits={"cpu": "8"})]
-    pods = [make_pod(requests={"cpu": "1"}) for _ in range(32)]
-    its = {"default": fake.instance_types(8)}
-    sh, dv = run_both(mesh, pods, provs, its)
-    for res in (sh, dv):
-        launched = sum(
-            min(it.capacity.get("cpu", 0.0) for it in m.instance_type_options)
-            for m in res.new_machines
-        )
-        assert launched <= 8.0 + 1e-6, f"limit exceeded: {launched}"
-
-
-def test_plan_shards_components_colocate():
-    zonal = zonal_spread()
-    pods = [
-        make_pod(labels={"app": "spread"}, requests={"cpu": "1"},
-                 topology_spread=[zonal])
-        for _ in range(6)
-    ] + [make_pod(requests={"cpu": "1"}) for _ in range(10)]
-    provs = [make_provisioner(name="default")]
-    its = {"default": fake.instance_types(4)}
-    snap = encode_snapshot(pods, provs, its, max_nodes=16)
-    count_split, exist_owner = plan_shards(snap, 4)
-    counts = snap.item_counts
-    # totals preserved
-    assert (count_split.sum(axis=0) == counts).all()
-    # topology-owning items live on exactly one shard
-    touch = (snap.topo_arrays.owner | snap.topo_arrays.sel)[:, snap.item_rep]
-    for i in range(len(counts)):
-        if touch[:, i].any():
-            assert (count_split[:, i] > 0).sum() == 1
-
-
-def hostname_spread(app="hs", max_skew=1):
-    return TopologySpreadConstraint(
-        max_skew=max_skew,
-        topology_key=LABEL_HOSTNAME,
-        when_unsatisfiable="DoNotSchedule",
-        label_selector=LabelSelector(match_labels={"app": app}),
-    )
-
-
-def test_hostname_spread_component_at_scale(mesh):
-    """Round-2 verdict weak #5: a hostname spread (one slot per pod) whose
-    component is routed whole to one dp shard, at a scale that crosses the
-    per-shard machine budget of OTHER shards — the owning shard must place
-    every replica on its own host while free items spread across shards."""
-    pods = [
-        make_pod(labels={"app": "hs"}, requests={"cpu": "0.5"},
-                 topology_spread=[hostname_spread()])
-        for _ in range(40)
-    ] + [make_pod(labels={"app": f"free-{i % 7}"}, requests={"cpu": "0.5"})
-         for i in range(60)]
     provisioners = [make_provisioner(name="default")]
     its = {"default": fake.instance_types(6)}
-    sharded = ShardedSolver(mesh, max_nodes_per_shard=64).solve(
-        pods, provisioners, its
+    res_sh, _ = assert_byte_identical(mesh, pods, provisioners, its, nodes)
+    assert res_sh.pod_count_existing() > 0  # the bulk fill actually ran
+
+
+# ---------------------------------------------------------------------------
+# small-batch fast path + cache-key separation
+
+
+def test_small_batch_routes_to_single_device(mesh, monkeypatch):
+    """Below MIN_SPLIT_REPLICAS_PER_SHARD replicas per dp row the solve
+    dispatches the plain single-device program — no mesh entry minted, no
+    collective overhead — and the result is trivially the single-device
+    packing. Restores the production threshold locally (the module
+    fixture zeroes it for the parity families)."""
+    monkeypatch.setattr(
+        sharded_mod, "MIN_SPLIT_REPLICAS_PER_SHARD",
+        MIN_SPLIT_REPLICAS_PER_SHARD,
     )
-    assert not sharded.failed_pods
-    # skew 1 over hostname: every machine hosting an hs pod has EXACTLY one
-    hs_machines = 0
-    for m in sharded.new_machines:
-        n_hs = sum(1 for p in m.pods if p.metadata.labels.get("app") == "hs")
-        assert n_hs <= 1, "hostname spread violated on a shard"
-        hs_machines += n_hs
-    assert hs_machines == 40
-    # hostname SPREAD splits across shards (its counts are slot-local, so
-    # the shards can share the class without a global-count race) — the
-    # per-machine skew assertion above is the correctness bar; the split is
-    # what buys back cross-shard colocation headroom
-    snap = encode_snapshot(pods, provisioners, its, max_nodes=64)
-    count_split, _ = plan_shards(snap, mesh.shape["dp"])
-    hs_items = [
-        it for it in range(len(snap.item_counts))
-        if snap.pods[snap.item_members[it][0]].metadata.labels.get("app") == "hs"
-    ]
-    for it in hs_items:
-        assert (count_split[:, it] > 0).sum() >= 2, (
-            "hostname-spread replicas must split across shards"
-        )
-    free_shards = (count_split.sum(axis=1) > 0).sum()
-    assert free_shards >= 2, "free items must use multiple shards"
+    solver = ShardedSolver(mesh, max_nodes=32)
+    pods = [make_pod(requests={"cpu": "1"}) for _ in range(6)]
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(4)}
+    res = solver.solve(pods, provisioners, its)
+    assert solver.last_path == "single"
+    assert res.pod_count_new() == 6
+    # the minted program lives in the single-device key namespace
+    assert all(key[-1] is None for key in solver._compiled)
+
+    # routing predicate: the floor scales with dp but caps at 256
+    assert not route_to_mesh(6, 4)
+    assert route_to_mesh(4 * MIN_SPLIT_REPLICAS_PER_SHARD, 4)
+    assert route_to_mesh(256, 64)
+
+
+def test_mesh_and_single_keys_never_collide(mesh):
+    """One geometry solved through both program families mints TWO cache
+    entries whose keys differ exactly in the mesh component."""
+    solver = ShardedSolver(mesh, max_nodes=32)
+    pods = [make_pod(labels={"app": f"k{i % 4}"}, requests={"cpu": "0.5"})
+            for i in range(40)]
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(4)}
+    solver.solve(copy.deepcopy(pods), provisioners, its)
+    assert solver.last_path == "mesh"
+    import karpenter_core_tpu.parallel.sharded as sm
+
+    # same batch, routed single (raise the floor): same geometry, new key
+    old = sm.MIN_SPLIT_REPLICAS_PER_SHARD
+    sm.MIN_SPLIT_REPLICAS_PER_SHARD = 10_000
+    try:
+        solver.solve(copy.deepcopy(pods), provisioners, its)
+    finally:
+        sm.MIN_SPLIT_REPLICAS_PER_SHARD = old
+    assert solver.last_path == "single"
+    keys = list(solver._compiled)
+    assert len(keys) == 2
+    mesh_keys = [k for k in keys if k[-1] is not None]
+    single_keys = [k for k in keys if k[-1] is None]
+    assert len(mesh_keys) == 1 and len(single_keys) == 1
+    assert mesh_keys[0][-1] == ("gspmd", 4, 2)
+    # identical except the mesh component
+    assert mesh_keys[0][:-1] == single_keys[0][:-1]
+
+
+# ---------------------------------------------------------------------------
+# structural tripwires
+
+
+def _collect_primitives(jaxpr, out):
+    for eqn in jaxpr.eqns:
+        out.add(eqn.primitive.name)
+        for v in eqn.params.values():
+            if hasattr(v, "jaxpr"):
+                _collect_primitives(v.jaxpr, out)
+            elif isinstance(v, (list, tuple)):
+                for item in v:
+                    if hasattr(item, "jaxpr"):
+                        _collect_primitives(item.jaxpr, out)
+
+
+def test_mesh_program_has_no_host_roundtrips(mesh):
+    """The rebuild's structural bar, asserted on the jaxpr: the multi-chip
+    solve is ONE program — no callbacks (host round-trips) anywhere in its
+    body, and the SpecLayout sharding constraints are actually present
+    (the program IS a mesh program, not an accidental single-device
+    trace)."""
+    from karpenter_core_tpu.solver.encode import encode_snapshot
+    from karpenter_core_tpu.solver.tpu_solver import (
+        build_device_solve,
+        device_args,
+    )
+
+    pods = [make_pod(labels={"app": f"j{i % 4}"}, requests={"cpu": "0.5"})
+            for i in range(40)]
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(4)}
+    snap = encode_snapshot(pods, provisioners, its, max_nodes=32)
+    layout = SpecLayout(mesh)
+    geom, run = build_device_solve(
+        snap, 32, external_prescreen=True, spec_layout=layout,
+    )
+    args = device_args(snap, provisioners)
+    from karpenter_core_tpu.ops.pack import make_prescreen_kernel
+
+    pre = make_prescreen_kernel(
+        list(geom[8]), geom[7], screen_v=geom[16], spec_layout=layout
+    )
+    screen0 = jax.eval_shape(pre, args[0], args[9])
+
+    prims = set()
+    _collect_primitives(jax.make_jaxpr(run)(screen0, *args).jaxpr, prims)
+    _collect_primitives(jax.make_jaxpr(pre)(args[0], args[9]).jaxpr, prims)
+    # callbacks are the host round-trips jit can express; device_put eqns
+    # are NOT in this set — inside a jitted program they are on-device
+    # constant placement (how jnp.asarray of closure constants lowers),
+    # not a host transfer
+    host_prims = {
+        "pure_callback", "io_callback", "debug_callback", "callback",
+        "host_callback", "outside_call",
+    }
+    hits = prims & host_prims
+    assert not hits, f"mesh program contains host round-trips: {sorted(hits)}"
+    assert "sharding_constraint" in prims, (
+        "mesh program lost its SpecLayout constraints — it would compile "
+        "as a plain single-device program"
+    )
+
+
+def test_single_device_program_unchanged_by_layout_plumbing():
+    """layout=None must trace the exact program it always did: no
+    sharding constraints sneak into the single-device jaxpr."""
+    from karpenter_core_tpu.solver.encode import encode_snapshot
+    from karpenter_core_tpu.solver.tpu_solver import (
+        build_device_solve,
+        device_args,
+    )
+
+    pods = [make_pod(labels={"app": f"j{i % 4}"}, requests={"cpu": "0.5"})
+            for i in range(40)]
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(4)}
+    snap = encode_snapshot(pods, provisioners, its, max_nodes=32)
+    geom, run = build_device_solve(snap, 32, external_prescreen=False)
+    args = device_args(snap, provisioners)
+    prims = set()
+    _collect_primitives(jax.make_jaxpr(run)(*args).jaxpr, prims)
+    assert "sharding_constraint" not in prims
+
+
+# ---------------------------------------------------------------------------
+# solver-surface behaviors on the mesh path
 
 
 def test_relaxation_through_sharded_solver(mesh):
     """A preferred node-affinity term nobody can satisfy must relax (drop)
-    through ShardedSolver's solve_with_relaxation loop and then schedule."""
+    through ShardedSolver's inherited solve_with_relaxation loop and then
+    schedule."""
     from karpenter_core_tpu.kube.objects import (
         NodeSelectorRequirement,
         NodeSelectorTerm,
@@ -353,220 +324,30 @@ def test_relaxation_through_sharded_solver(mesh):
     ]
     provisioners = [make_provisioner(name="default")]
     its = {"default": fake.instance_types(6)}
-    res = ShardedSolver(mesh, max_nodes_per_shard=16).solve(
-        pods, provisioners, its
-    )
+    res = ShardedSolver(mesh, max_nodes=16).solve(pods, provisioners, its)
     assert not res.failed_pods, "relaxation must drop the impossible preference"
     assert res.rounds >= 2, "must have taken at least one relaxation round"
     assert res.pod_count_new() == 8
 
 
-def test_pessimistic_limit_presplit_cost_bounded(mesh):
-    """The dp pre-split of provisioner limits (sharded.py: remaining_split,
-    a conservative under-approximation of the reference's global
-    subtract_max accounting, scheduler.go:276-293) may strand at most the
-    rounding slack: with a budget that exactly fits the batch globally,
-    the sharded solve schedules all but <= ndp boundary pods, and never
-    OVERSHOOTS the limit."""
-    import copy
-
-    ndp = mesh.shape["dp"]
-    universe = fake.instance_types(4)
-    # 32 identical 1-cpu pods; limit covers exactly the node capacity needed
-    pods = [make_pod(requests={"cpu": "1"}) for _ in range(32)]
-    provisioners = [make_provisioner(name="default", limits={"cpu": "48"})]
-    its = {"default": universe}
-
-    single = TPUSolver(max_nodes=64).solve(
-        copy.deepcopy(pods), provisioners, its
-    )
-    sharded = ShardedSolver(mesh, max_nodes_per_shard=16).solve(
-        pods, provisioners, its
-    )
-    # quality bound: the proportional split rounds each shard's budget
-    # DOWN, so at most one node's worth of pods per shard can strand
-    assert len(sharded.failed_pods) <= len(single.failed_pods) + ndp, (
-        f"pre-split stranded {len(sharded.failed_pods)} pods "
-        f"(single-device strands {len(single.failed_pods)})"
-    )
-    # safety bound: the split shares sum to <= the global budget, so the
-    # combined machine capacity can never exceed the limit
-    total_cpu = sum(
-        max(it.capacity.get("cpu", 0.0) for it in m.instance_type_options)
-        for m in sharded.new_machines
-    )
-    assert total_cpu <= 48.0 + 1e-6, f"limit overshot: {total_cpu}"
-
-
-def test_quality_scaling_curve_across_mesh_sizes():
-    """Packing-quality scaling with the dp degree (VERDICT r3 weak #3):
-    the SAME reference-style batch packed at dp in {1, 2, 4} on the
-    virtual mesh must stay within a bounded node-count delta of the
-    single-device solve — the dp pre-split's pessimism (limits shares,
-    component routing, shard-local leftovers) is the only quality cost,
-    and it must not grow superlinearly with the mesh. Mirrors the global
-    accounting the reference keeps in one process (scheduler.go:276-293)."""
-    pods = []
-    for i in range(240):
-        k = i % 6
-        if k == 0:
-            pods.append(make_pod(labels={"app": "spread"}, requests={"cpu": "1"},
-                                 topology_spread=[zonal_spread()]))
-        elif k == 1:
-            # three distinct ports so port packing (3 pods per node max
-            # among these) is a real constraint, not a 1-per-node floor
-            pods.append(
-                make_pod(requests={"cpu": "1"},
-                         host_ports=[7000 + (i // 6) % 3])
-            )
-        elif k == 2:
-            # per-group zonal spreads: five distinct topology components
-            # that plan_shards must route whole, exercising component
-            # routing (not just free-item splitting) at every dp
-            g = f"g-{i % 30 // 6}"
-            pods.append(
-                make_pod(labels={"app": g}, requests={"cpu": "1"},
-                         topology_spread=[zonal_spread(app=g)])
-            )
-        else:
-            pods.append(make_pod(requests={"cpu": "1", "memory": "1Gi"}))
-    provs = [make_provisioner(name="default")]
-    its = {"default": fake.instance_types(8)}
-
-    single = TPUSolver(max_nodes=96).solve(pods, provs, its)
-    assert not single.failed_pods
-    base = len(single.new_machines)
-
-    curve = {}
-    for ndp in (2, 4):
-        devices = np.array(jax.devices()[: ndp * 2]).reshape(ndp, 2)
-        m = Mesh(devices, ("dp", "tp"))
-        res = ShardedSolver(m, max_nodes_per_shard=96 // ndp + 8).solve(
-            pods, provs, its
-        )
-        assert not res.failed_pods, f"dp={ndp} dropped pods"
-        curve[ndp] = len(res.new_machines)
-    # quality parity bound (tightened round 5 from ~10% per doubling): the
-    # dp split's only systematic costs are ONE partially-filled leftover
-    # node per shard (disjoint budgets) plus ~2% split pessimism (limit
-    # pre-shares, component routing). Measured: dp=2 and dp=4 both +3
-    # nodes here (the per-shard remainder, not a percentage), and the 50k
-    # dryrun mixes measure +0.2% (generic) / -0.4% (anti-heavy).
-    for ndp, nodes in curve.items():
-        bound = base + ndp + max(1, int(base * 0.02))
-        assert nodes <= bound, (
-            f"dp={ndp}: {nodes} nodes vs single-device {base}, "
-            f"bound {bound} ({curve})"
-        )
-
-
-def test_hostname_anti_splits_freely_across_shards(mesh):
-    """Hostname anti-affinity components split across dp shards (their
-    constraint is pairwise separation on the slot axis, which disjoint
-    shard slots can only over-satisfy); the result still holds one
-    replica per node per selector group and matches single-device
-    packing quality."""
-    def anti(g):
-        return make_pod(
-            labels={"app": g},
-            requests={"cpu": "1"},
-            pod_anti_affinity_required=[
-                PodAffinityTerm(
-                    topology_key=LABEL_HOSTNAME,
-                    label_selector=LabelSelector(match_labels={"app": g}),
-                )
-            ],
-        )
-
-    pods = [anti(f"svc-{i % 2}") for i in range(48)]
-    pods += [make_pod(requests={"cpu": "0.5"}) for _ in range(32)]
-    provs = [make_provisioner(name="default")]
-    its = {"default": fake.instance_types(8)}
-
-    snap = encode_snapshot(pods, provs, its, max_nodes=64)
-    count_split, _ = plan_shards(snap, 4)
-    # the two anti classes are bulk items whose replicas spread over >1
-    # shard (free split), not routed whole
-    anti_items = [
-        i for i in range(len(snap.item_counts))
-        if (snap.pods[snap.item_members[i][0]].metadata.labels or {})
-        .get("app", "").startswith("svc-")
-        and int(snap.item_counts[i]) == 24
-    ]
-    assert len(anti_items) == 2, "anti classes must stay bulk (one per svc)"
-    for i in anti_items:
-        assert int((count_split[:, i] > 0).sum()) > 1, (
-            f"anti item {i} routed whole: {count_split[:, i]}"
-        )
-
-    sh, dv = run_both(mesh, pods, provs, its)
-    assert not sh.failed_pods and not dv.failed_pods
-    for m in sh.new_machines:
-        per = {}
-        for p in m.pods:
-            app = (p.metadata.labels or {}).get("app", "")
-            if app.startswith("svc-"):
-                per[app] = per.get(app, 0) + 1
-        assert all(v == 1 for v in per.values()), per
-    # quality parity with the single-device solve
-    assert len(sh.new_machines) <= len(dv.new_machines) + 2
-
-
-def test_small_batch_routes_to_one_shard(monkeypatch):
-    """Batches too small to split profitably ride shard 0 whole — replicas
-    AND existing-node ownership — making the result exactly the
-    single-device packing (round-5: small adversarial mixes measured up to
-    +67% nodes under a forced 4-way split). Restores the production
-    threshold locally (the module fixture zeroes it for the split suite)."""
-    from karpenter_core_tpu.parallel import sharded as sharded_mod
-    from karpenter_core_tpu.parallel.sharded import plan_shards_arrays
-
-    monkeypatch.setattr(sharded_mod, "MIN_SPLIT_REPLICAS_PER_SHARD", 32)
-    counts = np.array([10, 5, 3], dtype=np.int64)  # 18 replicas << 4*32
-    count_split, exist_owner = plan_shards_arrays(counts, 5, 8, 4)
-    assert (count_split[0] == counts).all()
-    assert count_split[1:].sum() == 0
-    assert exist_owner[0, :5].all() and not exist_owner[1:].any()
-
-    # above the threshold the replica water-fill still splits
-    big = np.full(16, 16, dtype=np.int64)  # 256 replicas >= 4*32
-    count_split, exist_owner = plan_shards_arrays(big, 5, 8, 4)
-    assert (count_split.sum(axis=0) == big).all()
-    assert (count_split > 0).all(axis=1).sum() == 4  # every shard works
-    assert exist_owner.any(axis=1).sum() > 1  # ownership spread again
-
-    # remainder round-robin: a no-topology batch of one-replica items must
-    # spread over every shard, not pile onto shard 0 (pre-round-5 all
-    # remainders went to the low shards — such batches ran serial)
-    ones = np.full(500, 1, dtype=np.int64)  # above the split threshold
-    count_split, _ = plan_shards_arrays(ones, 0, 0, 4)
-    assert (count_split.sum(axis=1) == 125).all()
-
-
-def test_single_shard_growth_is_not_sticky(mesh, monkeypatch):
-    """A small single-shard-routed batch that exhausts shard 0's slot
-    budget retries with a TRANSIENT doubling: the solver's configured
-    per-shard budget must not grow permanently (that would double every
-    future solve's geometry), while a genuinely split batch's growth does
-    persist (pinned by the 50k generic-mix dryrun)."""
-    from karpenter_core_tpu.parallel import sharded as sharded_mod
-
-    monkeypatch.setattr(sharded_mod, "MIN_SPLIT_REPLICAS_PER_SHARD", 32)
-    anti = PodAffinityTerm(
-        topology_key=LABEL_HOSTNAME,
-        label_selector=LabelSelector(match_labels={"app": "grow1"}),
-    )
-    # 24 one-per-node pods >> the 4-slot budget; 24 replicas < threshold
-    pods = [
-        make_pod(labels={"app": "grow1"}, requests={"cpu": "1"},
-                 pod_anti_affinity_required=[anti])
-        for _ in range(24)
-    ]
-    solver = ShardedSolver(mesh, max_nodes_per_shard=4)
-    res = solver.solve(
-        pods, [make_provisioner(name="default")],
-        {"default": fake.instance_types(8)},
-    )
-    assert not res.failed_pods
-    assert len(res.new_machines) == 24
-    assert solver.max_nodes_per_shard == 4  # growth did not stick
+def test_sharded_prewarm_aot_hits_live_solve(mesh):
+    """Sharded programs participate in the AOT-prewarm story: a
+    prewarm_snapshot on the mesh solver compiles the MESH program pair
+    under the same key a live solve at that geometry computes, attaches
+    the executables, and the live solve is a cache hit."""
+    pods = [make_pod(labels={"app": f"w{i % 4}"}, requests={"cpu": "0.5"})
+            for i in range(40)]
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(4)}
+    solver = ShardedSolver(mesh, max_nodes=32)
+    snap = solver.encode(copy.deepcopy(pods), provisioners, its)
+    outcome = solver.prewarm_snapshot(snap, provisioners)
+    assert outcome == "compiled"
+    keys = list(solver._compiled)
+    assert len(keys) == 1 and keys[0][-1] == ("gspmd", 4, 2)
+    fn, pre_fn = solver._compiled[keys[0]]
+    assert fn.aot is not None and pre_fn.aot is not None
+    res = solver.solve(copy.deepcopy(pods), provisioners, its)
+    assert solver.last_path == "mesh"
+    assert len(solver._compiled) == 1, "live solve must hit the prewarmed key"
+    assert res.pod_count_new() == 40

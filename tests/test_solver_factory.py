@@ -2,9 +2,11 @@
 
 The solver factory must hand a multi-device process the ShardedSolver (the
 v5e-4 deployment shape), the gRPC service must serve Solve() through the
-shard_map program when a mesh is present, and the whole assembly —
-ResilientSolver(primary=sharded) — must match the single-chip TPUSolver's
-packing on the same batch. Runs on the 8 virtual CPU devices from conftest.
+GSPMD mesh program when a mesh is present, and the whole assembly —
+ResilientSolver(primary=sharded) — must produce BYTE-IDENTICAL placements
+to the single-chip TPUSolver on the same batch (the mesh program is the
+single-device program with SpecLayout sharding constraints —
+parallel/sharded.py). Runs on the 8 virtual CPU devices from conftest.
 """
 import numpy as np
 import pytest
@@ -147,7 +149,7 @@ def test_operator_run_boots_sharded_solver():
 
 def test_sharded_encode_solve_pipelined_surface():
     mesh = detect_mesh()
-    solver = ShardedSolver(mesh, max_nodes_per_shard=16)
+    solver = ShardedSolver(mesh, max_nodes=64)
     pods, provisioners, its, state_nodes = mixed_batch()
     snap = solver.encode(pods, provisioners, its, state_nodes=state_nodes)
     res = solver.solve(
@@ -159,7 +161,7 @@ def test_sharded_encode_solve_pipelined_surface():
 
 def test_sharded_encoded_mismatch_raises():
     mesh = detect_mesh()
-    solver = ShardedSolver(mesh, max_nodes_per_shard=16)
+    solver = ShardedSolver(mesh, max_nodes=64)
     pods, provisioners, its, _ = mixed_batch(n_pods=10, n_existing=0)
     snap = solver.encode(pods, provisioners, its)
     other = [make_pod(requests={"cpu": "1"})]
@@ -194,7 +196,7 @@ def test_resilient_over_sharded_assembly():
     from karpenter_core_tpu.solver.tpu_solver import GreedySolver
 
     mesh = detect_mesh()
-    primary = ShardedSolver(mesh, max_nodes_per_shard=16)
+    primary = ShardedSolver(mesh, max_nodes=64)
     solver = ResilientSolver(
         primary, GreedySolver(), prober=lambda: None, small_batch_work_max=1
     )
@@ -219,7 +221,7 @@ def test_sharded_batched_consolidation_ladder():
 
     clock = FakeClock()
     cp = fake.FakeCloudProvider(fake.instance_types(10))
-    solver = ShardedSolver(detect_mesh(), max_nodes_per_shard=16)
+    solver = ShardedSolver(detect_mesh(), max_nodes=64)
     assert solver.supports_batched_replan
     op = new_operator(cp, settings=Settings(), solver=solver, clock=clock)
     op.kube_client.create(
@@ -297,12 +299,21 @@ def test_service_health_reports_mesh(sharded_server):
     assert "dp=4" in health.device and "tp=2" in health.device
 
 
-def test_service_sharded_parity_with_tpu_solver(sharded_server):
-    """Solve() served through the gRPC service on the 8-device mesh matches
-    the single-chip TPUSolver on the same mixed batch: everything schedules,
-    and packing quality stays within the dp-split remainder bound."""
+def test_service_sharded_parity_with_tpu_solver(sharded_server, monkeypatch):
+    """Solve() served through the gRPC service on the 8-device mesh is
+    BYTE-IDENTICAL (flightrec-canonical) to the in-process single-chip
+    TPUSolver on the same mixed batch at the same budget — the GSPMD mesh
+    program IS the single-device program. The routing floor is zeroed so
+    the 96-pod batch exercises the mesh program server-side."""
+    from karpenter_core_tpu.obs.flightrec import (
+        canonical_placements,
+        placements_json,
+    )
+    from karpenter_core_tpu.parallel import sharded as sharded_mod
+
+    monkeypatch.setattr(sharded_mod, "MIN_SPLIT_REPLICAS_PER_SHARD", 0)
     port, service = sharded_server
-    client = RemoteSolver(f"127.0.0.1:{port}", max_nodes=16)
+    client = RemoteSolver(f"127.0.0.1:{port}", max_nodes=64)
     pods, provisioners, its, state_nodes = mixed_batch()
     before = service.solves
     remote = client.solve(
@@ -317,41 +328,46 @@ def test_service_sharded_parity_with_tpu_solver(sharded_server):
     assert not remote.failed_pods and not single.failed_pods
     total = len(pods)
     assert remote.pod_count_new() + remote.pod_count_existing() == total
-    ndp = service.mesh.shape["dp"]
-    assert len(remote.new_machines) <= len(single.new_machines) + ndp
+    assert placements_json(canonical_placements(remote)) == placements_json(
+        canonical_placements(single)
+    ), "service mesh placements diverged from the in-process single path"
     # every machine carries a concrete template + narrowed requirements
-    # (the skew/affinity semantics themselves are pinned differentially in
-    # tests/test_sharded.py against the single-device path)
     for m in remote.new_machines:
         assert m.instance_type_options
         assert m.requirements.get_requirement(LABEL_TOPOLOGY_ZONE) is not None
-
-
-def test_service_sharded_slot_growth_retry(sharded_server):
-    """When a shard exhausts the per-shard slot budget, the CLIENT detects
-    it from the returned nopen and re-requests with a doubled budget (the
-    remote analog of ShardedSolver's self-healing sizing). This 24-replica
-    batch rides the single-shard small-batch routing, so the growth is
-    TRANSIENT: the solve succeeds at the doubled size but the configured
-    budget is restored (a permanently doubled geometry would tax every
-    future solve)."""
-    port, _ = sharded_server
-    client = RemoteSolver(f"127.0.0.1:{port}", max_nodes=2)
-    anti = PodAffinityTerm(
-        topology_key=LABEL_HOSTNAME,
-        label_selector=LabelSelector(match_labels={"app": "grow"}),
+    # second RPC at the same geometry: the service-side incremental
+    # refresh path (resident mesh verdict tensor + delta replay) must stay
+    # byte-identical too — the refresh kernel carries the same replicated
+    # fence as the scan (ops/pack.make_screen_refresh_kernel)
+    remote2 = client.solve(
+        pods, provisioners, its,
+        state_nodes=[n.deep_copy() for n in state_nodes],
     )
-    pods = [
-        make_pod(labels={"app": "grow"}, requests={"cpu": "1"},
-                 pod_anti_affinity_required=[anti])
-        for _ in range(24)
-    ]
+    assert placements_json(canonical_placements(remote2)) == placements_json(
+        canonical_placements(single)
+    ), "service mesh refresh path diverged on the second same-geometry RPC"
+
+
+def test_service_small_batch_routes_single(sharded_server):
+    """Below the routing floor the mesh service solves through the plain
+    single-device program (no mesh key minted for the tiny geometry): the
+    small-batch fast path applies at the RPC boundary too."""
+    port, service = sharded_server
+    client = RemoteSolver(f"127.0.0.1:{port}", max_nodes=16)
+    pods = [make_pod(requests={"cpu": "1"}) for _ in range(5)]
     res = client.solve(
-        pods, [make_provisioner(name="default")], {"default": fake.instance_types(8)}
+        pods, [make_provisioner(name="default")],
+        {"default": fake.instance_types(4)},
     )
     assert not res.failed_pods
-    assert len(res.new_machines) == 24  # one per node (anti)
-    assert client.max_nodes == 2  # single-shard growth did not stick
+    assert res.pod_count_new() == 5
+    tiny_keys = [k for k in service._compiled if k[-1] is not None]
+    # the 5-pod geometry must not appear among the mesh-program keys
+    import json as _json
+
+    for key in tiny_keys:
+        geom = _json.loads(key[0])
+        assert geom["n_slots"] > 16 + 5, "tiny batch minted a mesh program"
 
 
 def test_service_sharded_hostname_anti(sharded_server):
