@@ -3,7 +3,8 @@
 # backend with 8 virtual devices via tests/conftest.py.
 
 .PHONY: test deflake perf bench verify trace-demo chaos chaos-smoke \
-	replay-demo lint soak soak-smoke prewarm-smoke multichip-smoke
+	replay-demo lint soak soak-smoke prewarm-smoke multichip-smoke \
+	consolidation-smoke
 
 test:  ## tier-1 suite (CPU, 8 virtual devices); slow chaos soaks: make chaos
 	python -m pytest tests -q -m "not slow"
@@ -48,6 +49,9 @@ prewarm-smoke:  ## warm-cache restart gate: prewarm a tier, restart fresh, first
 multichip-smoke:  ## virtual 8-device GSPMD parity (byte-identical) + speedup sanity
 	python hack/multichip_smoke.py
 
+consolidation-smoke:  ## batched subset evaluator vs sequential simulator on a live operator
+	python hack/consolidation_smoke.py
+
 verify:  ## driver hooks: single-chip compile check + 8-way mesh dryrun
 	# force the CPU backend in-process: this image's sitecustomize pins the
 	# axon TPU tunnel (env vars can't override it), and a wedged tunnel
@@ -61,9 +65,12 @@ verify:  ## driver hooks: single-chip compile check + 8-way mesh dryrun
 	# metrics-scraper suite: the scrape-race/startup-guard regressions
 	python -m pytest tests/test_metrics_controllers.py -q
 	# pack-kernel structural tripwires (fatal): the prescreen scan body
-	# must not re-grow the full-width slot-screen contraction, and the
-	# precompute must stay inside the 2-programs-per-geometry cache budget
-	python -m pytest tests/test_perf_floor.py tests/test_screen_parity.py -q
+	# must not re-grow the full-width slot-screen contraction, the
+	# precompute must stay inside the 2-programs-per-geometry cache budget,
+	# and the batched consolidation evaluator's Commands must pass
+	# sequential-simulator validation (test_consolidation_parity)
+	python -m pytest tests/test_perf_floor.py tests/test_screen_parity.py \
+		tests/test_consolidation_parity.py -q
 	# non-fatal smoke: a traced solve must export valid Perfetto JSON
 	-$(MAKE) trace-demo
 	# non-fatal smoke: a flight-recorded solve must replay byte-identically
@@ -80,3 +87,7 @@ verify:  ## driver hooks: single-chip compile check + 8-way mesh dryrun
 	# non-fatal smoke: GSPMD mesh parity (byte-identical placements) +
 	# multichip speedup sanity on 8 virtual devices (fatal in presubmit)
 	-$(MAKE) multichip-smoke
+	# non-fatal smoke: the batched consolidation evaluator must pick a
+	# command the sequential simulator validates, live and in offline
+	# replay (fatal gate lives in presubmit)
+	-$(MAKE) consolidation-smoke

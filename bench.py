@@ -61,6 +61,15 @@ N_EXISTING = int(os.environ.get("BENCH_EXISTING", "1000"))
 CONS_NODES = int(os.environ.get("BENCH_CONS_NODES", "1000"))
 CONS_PODS = int(os.environ.get("BENCH_CONS_PODS", "10000"))
 CONS_TYPES = int(os.environ.get("BENCH_CONS_TYPES", "100"))
+# ROADMAP item 4 exit-criterion geometry (ISSUE 10): 10k nodes / 100k pods
+# consolidation pass, target replan_med < 1s. Shed by worker budget like
+# the grid stages — but the column/geometry always appear in the JSON so a
+# TPU round can prove (or disprove) consolidation_under_1s.
+CONS_XL_NODES = int(os.environ.get("BENCH_CONS_XL_NODES", "10000"))
+CONS_XL_PODS = int(os.environ.get("BENCH_CONS_XL_PODS", "100000"))
+# host-side budget the XL stage needs before the watchdog (setup of 100k
+# pod objects + state sync dominates on CPU fallback)
+CONS_XL_MIN_BUDGET = int(os.environ.get("BENCH_CONS_XL_MIN_BUDGET", "900"))
 # node-slot budget: hostname-spread pods (1/7 of the mix) need a slot each,
 # plus headroom for the machine opens of the other kinds — oversizing the
 # budget taxes every [N]-wide op in the scan
@@ -404,13 +413,18 @@ def _config_grid_stage(kind: str):
     return pods, provisioners, its, max(128, n_pods // 3 + 64)
 
 
-def consolidation_bench(emit: bool = True):
-    """Config 4 analog: CONS_NODES under-utilized nodes, CONS_PODS running
-    pods, full multi-node replan (the parallel prefix ladder over
-    simulate_scheduling, replacing multinodeconsolidation.go:87-113's
-    sequential binary search). Timed region: the whole ComputeCommand
-    ladder, steady-state (compiled programs cached). Returns a result dict;
-    emit=True also prints the standalone JSON line."""
+def consolidation_bench(emit: bool = True, n_nodes: int = None,
+                        n_pods: int = None, n_types: int = None):
+    """Config 4 analog: n_nodes under-utilized nodes, n_pods running pods,
+    full multi-node replan — the batched candidate-subset evaluator
+    (solver/replan.py: one union encode + one vmapped device dispatch
+    screening every ladder rung, ranked by the savings objective),
+    replacing multinodeconsolidation.go:87-113's sequential binary search.
+    Timed region: the whole first_n_consolidation_ladder, steady-state
+    (compiled programs cached). Returns a result dict with the ISSUE 10
+    first-class columns (replan_med_ms, candidates_per_sec,
+    consolidation_under_1s, replan per-phase spans); emit=True also prints
+    the standalone JSON line."""
     from karpenter_core_tpu.api.labels import (
         LABEL_CAPACITY_TYPE,
         LABEL_NODE_INITIALIZED,
@@ -424,16 +438,24 @@ def consolidation_bench(emit: bool = True):
     from karpenter_core_tpu.solver.tpu_solver import TPUSolver
     from karpenter_core_tpu.testing import FakeClock, make_node, make_pod, make_provisioner
 
+    n_nodes = n_nodes or CONS_NODES
+    n_pods = n_pods or CONS_PODS
+    n_types = n_types or CONS_TYPES
+
     clock = FakeClock()
-    universe = fake.instance_types(CONS_TYPES)
+    universe = fake.instance_types(n_types)
     cp = fake.FakeCloudProvider(universe)
-    solver = TPUSolver(max_nodes=max(1024, CONS_PODS // 4))
+    # slot budget: existing nodes get their own slots on top; the machine
+    # region only needs headroom for the handful of replacement opens a
+    # replan can produce — oversizing it taxes every [N]-wide op at the
+    # 10k-node geometry
+    solver = TPUSolver(max_nodes=min(max(1024, n_pods // 4), 4096))
     op = new_operator(cp, settings=Settings(), solver=solver, clock=clock)
     op.kube_client.create(make_provisioner(name="default", consolidation_enabled=True))
 
-    pods_per_node = max(1, CONS_PODS // CONS_NODES)
+    pods_per_node = max(1, n_pods // n_nodes)
     t0 = time.perf_counter()
-    for n in range(CONS_NODES):
+    for n in range(n_nodes):
         it = universe[n % len(universe)]
         name = f"node-{n}"
         node = make_node(
@@ -479,24 +501,33 @@ def consolidation_bench(emit: bool = True):
         times.append(time.perf_counter() - t0)
     replan_s = float(np.median(times)) if times else warm_s
 
-    total_pods = CONS_NODES * pods_per_node
+    total_pods = n_nodes * pods_per_node
     pods_per_sec = total_pods / replan_s
+    candidates_per_sec = len(candidates) / replan_s if replan_s else 0.0
+    under_1s = bool(replan_s < 1.0)
+    phases = dict(getattr(solver, "last_replan_phase_ms", {}) or {})
     print(
-        f"[bench] consolidation nodes={CONS_NODES} pods={total_pods} "
-        f"types={CONS_TYPES} candidates={len(candidates)} action={cmd.action} "
+        f"[bench] consolidation nodes={n_nodes} pods={total_pods} "
+        f"types={n_types} candidates={len(candidates)} action={cmd.action} "
         f"removed={len(cmd.nodes_to_remove)} setup={setup_s:.1f}s "
-        f"warm={warm_s:.1f}s replan_med={replan_s * 1e3:.1f}ms",
+        f"warm={warm_s:.1f}s replan_med={replan_s * 1e3:.1f}ms "
+        f"candidates_per_sec={candidates_per_sec:.1f} under_1s={under_1s} "
+        f"phases={phases}",
         file=sys.stderr,
     )
     result = {
-        "nodes": CONS_NODES,
+        "nodes": n_nodes,
         "pods": total_pods,
-        "types": CONS_TYPES,
+        "types": n_types,
+        "candidates": len(candidates),
         "action": str(cmd.action),
         "removed": len(cmd.nodes_to_remove),
         "replan_med_ms": round(replan_s * 1e3, 1),
         "warm_s": round(warm_s, 1),
         "pods_per_sec": round(pods_per_sec, 1),
+        "candidates_per_sec": round(candidates_per_sec, 1),
+        "consolidation_under_1s": under_1s,
+        "replan_phases_ms": phases,
     }
     if emit:
         suffix = "_cpu_fallback" if BACKEND_NOTE.startswith("cpu-fallback") else ""
@@ -504,17 +535,52 @@ def consolidation_bench(emit: bool = True):
             json.dumps(
                 {
                     "metric": (
-                        f"consolidation_replan_pods_per_sec_{CONS_NODES}nodes_"
+                        f"consolidation_replan_pods_per_sec_{n_nodes}nodes_"
                         f"{total_pods}pods{suffix}"
                     ),
                     "value": round(pods_per_sec, 1),
                     "unit": "pods/sec",
                     "vs_baseline": round(pods_per_sec / 100.0, 2),
-                    "extra": {"backend_probe": PROBE_LOG},
+                    "extra": {
+                        "backend_probe": PROBE_LOG,
+                        "replan_med_ms": result["replan_med_ms"],
+                        "candidates_per_sec": result["candidates_per_sec"],
+                        "consolidation_under_1s": under_1s,
+                        "replan_phases_ms": phases,
+                    },
                 }
             )
         )
     return result
+
+
+def consolidation_xl_stage(budget_fn=_worker_time_left):
+    """The exit-criterion geometry (CONS_XL_NODES x CONS_XL_PODS), shed by
+    worker budget like the grid stages — but ALWAYS returns a dict with
+    the geometry + consolidation_under_1s column so the bench artifact
+    records the stage even when the host couldn't afford the run."""
+    stub = {
+        "nodes": CONS_XL_NODES,
+        "pods": CONS_XL_PODS,
+        "consolidation_under_1s": False,
+    }
+    if os.environ.get("BENCH_SKIP_CONS_XL", "") == "1":
+        return dict(stub, skipped="BENCH_SKIP_CONS_XL=1")
+    if budget_fn() < CONS_XL_MIN_BUDGET:
+        print(
+            "[bench] consolidation XL skipped: worker budget low",
+            file=sys.stderr,
+        )
+        return dict(stub, skipped="worker budget low")
+    try:
+        return consolidation_bench(
+            emit=False, n_nodes=CONS_XL_NODES, n_pods=CONS_XL_PODS,
+        )
+    except BaseException as exc:  # noqa: BLE001 — still record the stage
+        import traceback
+
+        traceback.print_exc()
+        return dict(stub, error=f"{type(exc).__name__}: {exc}"[:200])
 
 
 def sweep():
@@ -869,6 +935,7 @@ def main():
     # worker nears its watchdog, so a budget overrun costs the least-
     # chartered numbers first and never the JSON line itself
     cons = None
+    cons_xl = None
     if os.environ.get("BENCH_SKIP_CONSOLIDATION", "") != "1":
         if _worker_time_left() < 180:
             cons = {"skipped": "worker budget low"}
@@ -882,6 +949,9 @@ def main():
 
                 traceback.print_exc()
                 cons = {"error": f"{type(exc).__name__}: {exc}"[:200]}
+        # exit-criterion geometry (10k nodes / 100k pods): shed by budget,
+        # but the column + geometry always land in the artifact
+        cons_xl = consolidation_xl_stage()
 
     # -- BASELINE configs 1-3: the chartered scaling grid's remaining rungs,
     # each its own geometry (own compile, warmed out of the timed region)
@@ -1159,6 +1229,11 @@ def main():
                     "chips": len(jax.devices()),
                     "backend_probe": PROBE_LOG,
                     "consolidation": cons,
+                    "consolidation_xl": cons_xl,
+                    "consolidation_under_1s": (
+                        cons_xl.get("consolidation_under_1s")
+                        if isinstance(cons_xl, dict) else None
+                    ),
                     "config5_multiprov_spot_od": c5,
                     "config_grid_1_2_3": grid,
                 },
@@ -1537,7 +1612,37 @@ if __name__ == "__main__":
     try:
         ensure_backend()
         if CONFIG == "consolidation":
-            consolidation_bench()
+            base = consolidation_bench(emit=False)
+            xl = consolidation_xl_stage()
+            suffix = (
+                "_cpu_fallback"
+                if BACKEND_NOTE.startswith("cpu-fallback") else ""
+            )
+            print(
+                json.dumps(
+                    {
+                        "metric": (
+                            "consolidation_replan_pods_per_sec_"
+                            f"{base.get('nodes')}nodes_"
+                            f"{base.get('pods')}pods{suffix}"
+                        ),
+                        "value": base.get("pods_per_sec", 0.0),
+                        "unit": "pods/sec",
+                        "vs_baseline": round(
+                            (base.get("pods_per_sec") or 0.0) / 100.0, 2
+                        ),
+                        "extra": {
+                            "backend_probe": PROBE_LOG,
+                            "consolidation": base,
+                            "consolidation_xl": xl,
+                            "consolidation_under_1s": (
+                                xl.get("consolidation_under_1s")
+                                if isinstance(xl, dict) else None
+                            ),
+                        },
+                    }
+                )
+            )
         elif CONFIG == "sweep":
             sweep()
         else:

@@ -1,0 +1,177 @@
+"""Consolidation-smoke: the batched candidate-subset evaluator end to end
+against a LIVE operator, validated by the sequential simulator.
+
+Builds a small consolidatable cluster (a keeper node + under-utilized
+candidates, one of them priceless), runs MultiNodeConsolidation's batched
+ladder and SingleNodeConsolidation's ranked sweep, and gates on:
+
+  * the ladder decides DELETE for every candidate (the keeper absorbs);
+  * validate_command — the sequential simulate_scheduling path — accepts
+    the device-ranked command (the parity bar);
+  * the flight recorder captured the decision pass and
+    replay_consolidation's offline sequential re-run validates it too
+    (the `hack/replay.py --consolidation` loop, exercised zero-to-end);
+  * replan per-phase spans were recorded and the replan program cache
+    stays on the candidate-axis bucket ladder.
+
+Non-fatal in `make verify`, FATAL in hack/presubmit.sh — the same
+promotion pattern as prewarm/multichip smoke. Hermetic: forces the CPU
+backend in-process (the image's sitecustomize pins the axon tunnel; env
+vars can't override it).
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def main() -> int:
+    from karpenter_core_tpu.api.labels import (
+        LABEL_CAPACITY_TYPE,
+        LABEL_NODE_INITIALIZED,
+        PROVISIONER_NAME_LABEL_KEY,
+    )
+    from karpenter_core_tpu.api.settings import Settings
+    from karpenter_core_tpu.cloudprovider import fake
+    from karpenter_core_tpu.controllers.deprovisioning.core import candidate_nodes
+    from karpenter_core_tpu.kube.objects import (
+        LABEL_INSTANCE_TYPE_STABLE,
+        LABEL_TOPOLOGY_ZONE,
+    )
+    from karpenter_core_tpu.obs import flightrec
+    from karpenter_core_tpu.operator import new_operator
+    from karpenter_core_tpu.solver.encode import REPLAN_K_BUCKETS
+    from karpenter_core_tpu.solver.tpu_solver import TPUSolver
+    from karpenter_core_tpu.testing import (
+        FakeClock,
+        make_node,
+        make_pod,
+        make_provisioner,
+    )
+
+    clock = FakeClock()
+    universe = fake.instance_types(8)
+    cp = fake.FakeCloudProvider(universe)
+    solver = TPUSolver(max_nodes=64)
+    op = new_operator(cp, settings=Settings(), solver=solver, clock=clock)
+    op.kube_client.create(
+        make_provisioner(name="default", consolidation_enabled=True)
+    )
+    op.kube_client.create(make_provisioner(name="static"))
+    keeper = make_node(
+        name="keeper",
+        labels={PROVISIONER_NAME_LABEL_KEY: "static",
+                LABEL_NODE_INITIALIZED: "true"},
+        capacity={"cpu": "20", "memory": "40Gi", "pods": "200"},
+    )
+    op.kube_client.create(keeper)
+    n_candidates = int(os.environ.get("KCT_CONS_SMOKE_NODES", "8"))
+    for i in range(n_candidates):
+        it = universe[-1]
+        zone = "test-zone-9" if i == n_candidates - 1 else "test-zone-1"
+        node = make_node(
+            name=f"lite-{i}",
+            labels={
+                PROVISIONER_NAME_LABEL_KEY: "default",
+                LABEL_NODE_INITIALIZED: "true",
+                LABEL_INSTANCE_TYPE_STABLE: it.name,
+                LABEL_CAPACITY_TYPE: "on-demand",
+                LABEL_TOPOLOGY_ZONE: zone,  # zone-9 = priceless candidate
+            },
+            capacity={k: str(v) for k, v in it.capacity.items()},
+        )
+        op.kube_client.create(node)
+        pod = make_pod(
+            requests={"cpu": "0.1"}, node_name=node.metadata.name,
+            unschedulable=False,
+        )
+        pod.status.phase = "Running"
+        op.kube_client.create(pod)
+    op.sync_state()
+
+    flightrec.FLIGHTREC.enable()
+    flightrec.FLIGHTREC.clear()
+
+    multi = next(
+        d for d in op.deprovisioning.deprovisioners
+        if type(d).__name__ == "MultiNodeConsolidation"
+    )
+    multi.validation_ttl = 0.0
+    candidates = multi.sort_and_filter_candidates(
+        candidate_nodes(
+            op.cluster, op.kube_client, cp, multi.should_deprovision, clock
+        )
+    )
+    if len(candidates) != n_candidates:
+        print(f"FAIL: expected {n_candidates} candidates, got {len(candidates)}")
+        return 1
+    if not getattr(op.provisioning.solver, "supports_batched_replan", False):
+        print("FAIL: solver does not support batched replan")
+        return 1
+
+    cmd = multi.first_n_consolidation_ladder(candidates)
+    print(
+        f"ladder: action={cmd.action} removed={len(cmd.nodes_to_remove)} "
+        f"from_screen={getattr(cmd, 'from_screen', False)}"
+    )
+    if cmd.action != "delete" or len(cmd.nodes_to_remove) != n_candidates:
+        print("FAIL: batched ladder did not delete every absorbable candidate")
+        return 1
+    if not multi.validate_command(cmd, candidates):
+        print("FAIL: sequential simulator rejected the device-ranked command")
+        return 1
+
+    phases = dict(solver.last_replan_phase_ms or {})
+    print(f"replan phases_ms: {phases}")
+    if "device" not in phases or "prescreen" not in phases:
+        print("FAIL: replan per-phase spans missing")
+        return 1
+    k_values = {k for (_key, k) in solver._replan_compiled}
+    if not k_values or not k_values.issubset(set(REPLAN_K_BUCKETS)):
+        print(f"FAIL: replan programs off the candidate-axis ladder: {k_values}")
+        return 1
+
+    record = flightrec.FLIGHTREC.last_consolidation()
+    if record is None or "inputs" not in record:
+        print("FAIL: no flight-recorded consolidation decision")
+        return 1
+    diff = flightrec.replay_consolidation(record, solver_kind="greedy")
+    agree = sum(1 for s in diff["subsets"] if s["agrees"])
+    print(
+        f"replay: {agree}/{len(diff['subsets'])} subset verdicts agree, "
+        f"chosen_feasible_seq={diff['chosen_feasible_seq']} "
+        f"seq_pick={diff['seq_pick']}"
+    )
+    if not diff["chosen_feasible_seq"]:
+        print("FAIL: offline sequential replay rejects the chosen command")
+        return 1
+
+    # single-node ranked sweep rides the same program family (cache hit)
+    single = next(
+        d for d in op.deprovisioning.deprovisioners
+        if type(d).__name__ == "SingleNodeConsolidation"
+    )
+    single.validation_ttl = 0.0
+    s_candidates = single.sort_and_filter_candidates(
+        candidate_nodes(
+            op.cluster, op.kube_client, cp, single.should_deprovision, clock
+        )
+    )
+    order, screens, _scenario = single._ranked_candidates(s_candidates)
+    if screens is None or len(screens) != len(s_candidates):
+        print("FAIL: single-node ranked sweep did not screen every singleton")
+        return 1
+    print(
+        f"single-node: {len(screens)} singletons screened, "
+        f"{len(order)} ranked feasible"
+    )
+    print("consolidation-smoke ok")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

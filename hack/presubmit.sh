@@ -29,6 +29,9 @@ make prewarm-smoke
 echo "== presubmit: make multichip-smoke (GSPMD parity + speedup sanity)"
 make multichip-smoke
 
+echo "== presubmit: make consolidation-smoke (batched evaluator vs sequential simulator)"
+make consolidation-smoke
+
 if [[ "${1:-}" != "quick" ]]; then
   echo "== presubmit: short deflake (3 iterations)"
   MAX_ITERS=3 ./hack/deflake.sh

@@ -10,6 +10,12 @@ Usage:
     python hack/replay.py RECORD.json --solver greedy|tpu|both
     python hack/replay.py --demo                 # live capture -> replay
 
+Consolidation decision records (kind=consolidation, a /debug/consolidations
+download or obs/flightrec record_consolidation output) are auto-detected:
+the replay re-runs EVERY screened candidate subset through the sequential
+simulator and diffs its verdicts — and the command it would have chosen —
+against the recorded device-ranked decision (docs/consolidation.md).
+
 Exit status is 0 when the recorded backend's replay reproduces the
 recorded placements byte-identically (the determinism bar); the
 greedy-vs-tpu diff is informational — the two algorithms may legitimately
@@ -56,7 +62,46 @@ def _describe(record: dict) -> str:
     )
 
 
+def replay_consolidation_record(record: dict, solver: str = "greedy") -> int:
+    """Diff a recorded consolidation decision (the device-ranked subset
+    evaluator's verdicts + chosen Command, obs/flightrec
+    record_consolidation) against the sequential simulator, offline.
+
+    Exit status is 0 when the sequential simulator validates the executed
+    command (the parity bar); per-subset verdict differences where the
+    relaxing simulator is MORE permissive than the round-0 screen are
+    expected and informational."""
+    from karpenter_core_tpu.obs import flightrec
+
+    solver = "greedy" if solver == "both" else solver
+    chosen = record.get("chosen", {})
+    print(
+        f"consolidation record: deprovisioner={record.get('deprovisioner')} "
+        f"candidates={len(record.get('candidates', []))} "
+        f"subsets={len(record.get('subsets', []))} "
+        f"chosen={chosen.get('action')}:{chosen.get('nodes')}"
+    )
+    diff = flightrec.replay_consolidation(record, solver_kind=solver)
+    for sub in diff["subsets"]:
+        flag = "==" if sub["agrees"] else "!="
+        print(
+            f"  subset {sub['members']}: device "
+            f"(sched={sub['allScheduled']}, new={sub['nNewMachines']}, "
+            f"conclusive={sub['conclusive']}, savings={sub['savings']}) "
+            f"{flag} sequential({solver}) "
+            f"(sched={sub['seqAllScheduled']}, new={sub['seqNewMachines']})"
+        )
+    print(f"sequential pick by the same objective: {diff['seq_pick']}")
+    if diff["chosen_feasible_seq"]:
+        print("sequential simulator validates the chosen command")
+        return 0
+    print("sequential simulator REJECTS the chosen command")
+    return 1
+
+
 def replay_record(record: dict, solver: str = "both") -> int:
+    if record.get("kind") == "consolidation":
+        return replay_consolidation_record(record, solver)
     from karpenter_core_tpu.obs import flightrec
 
     print(f"record: {_describe(record)}")

@@ -96,8 +96,13 @@ DEFAULT_WALLCLOCK_ALLOWLIST: FrozenSet[str] = frozenset({
     # filename renders it via time.gmtime
     "karpenter_core_tpu/obs/flightrec.py::__init__",
     "karpenter_core_tpu/obs/flightrec.py::dump",
-    # clock=time.time *references* (injectable clock defaults compared
-    # against object wall timestamps) are not calls and are not flagged.
+    # consolidation decision records carry the same wall-clock stamp
+    "karpenter_core_tpu/obs/flightrec.py::record_consolidation",
+    # clock=time.time *references* as INSTANCE-clock defaults (methods
+    # store the injectable clock at construction) are not calls and are
+    # not flagged; module-level FUNCTION parameter defaults ARE flagged —
+    # they bind the clock at import, so a later-installed fake/monkeypatch
+    # silently never reaches the call (montime.py, ISSUE 10 satellite).
 })
 
 

@@ -10,9 +10,16 @@ job IS producing a wall-clock timestamp (log record ts, k8s condition
 lastTransitionTime, deletionTimestamp, flight-record stamps); everything
 else must use time.monotonic() / time.perf_counter().
 
-References (`clock=time.time` defaults for injectable test clocks) are not
-calls and are not flagged — those clocks are compared against object
-wall-clock timestamps by design.
+Instance-clock references (`clock=time.time` defaults on METHODS, stored on
+the instance at construction) are not calls and are not flagged — those
+clocks are compared against object wall-clock timestamps by design. But the
+same spelling on a MODULE-LEVEL FUNCTION is flagged (rule
+`monotonic-time-default`): a function default evaluates ONCE at import, so
+the bound clock is a hidden global — a fake clock installed later (tests
+monkeypatching time.time, a steppable clock threaded most of the way down)
+silently never reaches the call site. Spell it `clock=None` and resolve at
+call time instead (deprovisioning/core.lifetime_remaining is the audited
+pattern; tests/analysis_fixtures/montime_default_{good,bad}.py pin it).
 """
 from __future__ import annotations
 
@@ -24,7 +31,7 @@ from karpenter_core_tpu.analysis.core import Pass, SourceFile, Violation
 
 class MonotonicTimePass(Pass):
     name = "montime"
-    rules = ("monotonic-time",)
+    rules = ("monotonic-time", "monotonic-time-default")
 
     def run(self, files: Sequence[SourceFile], config) -> List[Violation]:
         out: List[Violation] = []
@@ -45,6 +52,40 @@ class MonotonicTimePass(Pass):
                                 bare_time.add(alias.asname or "time")
             if not time_aliases and not bare_time:
                 continue
+
+            def is_time_ref(expr) -> bool:
+                return (
+                    isinstance(expr, ast.Attribute)
+                    and expr.attr == "time"
+                    and isinstance(expr.value, ast.Name)
+                    and expr.value.id in time_aliases
+                ) or (isinstance(expr, ast.Name) and expr.id in bare_time)
+
+            # module-level function defaults: `def f(..., clock=time.time)`
+            # at module scope binds the clock AT IMPORT — flag it. Methods
+            # (functions inside a ClassDef) are exempt: they stash the
+            # injectable clock on the instance at construction, the
+            # audited convention.
+            for node in f.tree.body:
+                if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                defaults = list(node.args.defaults) + [
+                    d for d in node.args.kw_defaults if d is not None
+                ]
+                for default in defaults:
+                    if is_time_ref(default):
+                        out.append(Violation(
+                            relpath=f.relpath,
+                            line=default.lineno,
+                            rule="monotonic-time-default",
+                            message=(
+                                "time.time bound as a module-level function "
+                                "parameter default — evaluated once at "
+                                "import, so later-installed clocks (fakes, "
+                                "monkeypatches) never reach the call; use "
+                                "`clock=None` and resolve at call time"
+                            ),
+                        ))
             # map each call to its enclosing function for allowlist checks
             parents = _FuncIndex(f.tree)
             for node in ast.walk(f.tree):

@@ -117,6 +117,15 @@ class Settings:
     # enumerable before the first pod arrives and the startup prewarm can
     # compile it ahead of traffic
     bucket_ladder: Tuple[GeometryTier, ...] = DEFAULT_BUCKET_LADDER
+    # 0 = unbounded (the reference behavior). A positive value caps how
+    # many victim nodes any single consolidation pass may terminate: the
+    # batched subset evaluator (ISSUE 10) ranks candidate subsets by real
+    # savings, and without a cap the best-savings subset on a badly
+    # over-provisioned cluster is "most of it" — this bounds the blast
+    # radius per pass (multi-node prefix sizes, the emptiness sweep, and
+    # empty-node deletion all clip to it; the remainder re-enters the next
+    # 10s reconcile pass).
+    consolidation_disruption_budget: int = 0
 
     def effective_batch_max_pods(self) -> int:
         """The provisioning pass cap actually enforced: the configured
@@ -166,6 +175,12 @@ class Settings:
             s.batch_max_pods = int(data["batchMaxPods"])
         if "bucketLadder" in data:
             s.bucket_ladder = parse_bucket_ladder(data["bucketLadder"])
+        if "consolidationDisruptionBudget" in data:
+            s.consolidation_disruption_budget = int(
+                data["consolidationDisruptionBudget"]
+            )
+        if s.consolidation_disruption_budget < 0:
+            raise ValueError("consolidationDisruptionBudget cannot be negative")
         if s.batch_max_pods < 0:
             raise ValueError("batchMaxPods cannot be negative")
         if s.batch_max_duration <= 0:

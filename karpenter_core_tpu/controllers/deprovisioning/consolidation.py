@@ -33,7 +33,10 @@ from karpenter_core_tpu.controllers.deprovisioning.core import (
     node_prices,
     simulate_scheduling,
 )
+from karpenter_core_tpu.obs.log import get_logger
 from karpenter_core_tpu.scheduling.requirement import OP_IN, Requirement
+
+LOG = get_logger("karpenter.deprovisioning.consolidation")
 
 CONSOLIDATION_TTL = 15.0  # consolidation.go:66
 
@@ -77,6 +80,47 @@ class Consolidation:
             out.append(candidate)
         return sorted(out, key=lambda c: c.disruption_cost)
 
+    def _disruption_budget(self) -> int:
+        """The configured victims-per-pass cap (0 = unbounded):
+        Settings.consolidation_disruption_budget bounds how many nodes any
+        single consolidation command may terminate, so a large savings win
+        can never drain more of the cluster in one pass than the operator
+        signed up for."""
+        from karpenter_core_tpu.api import settings as api_settings
+
+        return api_settings.current().consolidation_disruption_budget
+
+    def _any_relaxable(self, candidates: List[CandidateNode]) -> bool:
+        """True when any involved pod (the candidates' or pending) still
+        carries a relaxable soft constraint — a negative round-0 screen is
+        inconclusive for those (scheduler.go:114-123 relaxes until
+        exhaustion), so the exact (relaxing) path must confirm."""
+        from karpenter_core_tpu.controllers.provisioning.scheduling.preferences import (
+            Preferences,
+        )
+
+        prefs = Preferences()
+        pods = [p for c in candidates for p in c.pods]
+        pods += list(self.provisioning.get_pending_pods())
+        return any(prefs.is_relaxable(p) for p in pods)
+
+    def _record_pass(self, candidates, screens, cmd: Command,
+                     scenario=None) -> None:
+        """Flight-record this consolidation decision (candidate set, every
+        screened subset's verdict + objective, the chosen Command) so
+        hack/replay.py can diff the device-ranked decision against the
+        sequential simulator offline. Best-effort, like every recorder
+        hook: a serialization failure must never break the pass."""
+        from karpenter_core_tpu.obs import flightrec
+
+        try:
+            flightrec.FLIGHTREC.record_consolidation(
+                type(self).__name__, candidates, screens, cmd,
+                scenario=scenario,
+            )
+        except Exception:  # noqa: BLE001 — recording never breaks the pass
+            pass
+
     def compute_consolidation(self, candidates: List[CandidateNode]) -> Command:
         """consolidation.go:180-264: delete if 0 replacements; replace if
         exactly 1 cheaper; spot->spot forbidden; OD->[OD,spot] forces spot."""
@@ -100,7 +144,14 @@ class Consolidation:
             return Command(action=ACTION_DO_NOTHING)
 
         replacement = new_machines[0]
-        current_price = node_prices(candidates)
+        try:
+            current_price = node_prices(candidates)
+        except ValueError:
+            # a candidate's current offering is unknown (priceless node):
+            # the reference's getNodePrices err branch — block the REPLACE
+            # (deletes never price and returned above)
+            self._blocked(candidates, "unable to determine node prices")
+            return Command(action=ACTION_DO_NOTHING)
         replacement.instance_type_options = filter_by_price(
             replacement.instance_type_options, replacement.requirements, current_price
         )
@@ -206,6 +257,11 @@ class EmptyNodeConsolidation(Consolidation):
             return Command(action=ACTION_DO_NOTHING)
         candidates = self.sort_and_filter_candidates(candidates)
         empty = [c for c in candidates if not c.pods]
+        budget = self._disruption_budget()
+        if budget:
+            # victims-per-pass cap (ascending disruption cost — the sort
+            # above): the remainder re-enters the next reconcile pass
+            empty = empty[:budget]
         if not empty:
             return Command(action=ACTION_DO_NOTHING)
         cmd = Command(nodes_to_remove=[c.node for c in empty], action=ACTION_DELETE)
@@ -251,22 +307,32 @@ class MultiNodeConsolidation(Consolidation):
         return cmd
 
     def first_n_consolidation_ladder(self, candidates: List[CandidateNode]) -> Command:
-        """Evaluate a geometric ladder of prefix sizes; keep the largest
-        feasible. Replaces the reference's sequential binary search
+        """Evaluate a geometric ladder of prefix sizes (plus the all-empty
+        subset); keep the best by the savings objective. Replaces the
+        reference's sequential binary search
         (multinodeconsolidation.go:87-113).
 
-        On a solver with batched-replan support (TPUSolver), the whole
-        ladder is screened in ONE vmapped device dispatch over a shared
-        union encode (solver/replan.py). A conclusive 0-new-machine winner
-        becomes the DELETE command directly (validate_after_ttl re-simulates
-        through the exact path before execution; a validation rejection
-        flips the next ladder back to exact per-rung confirmation); REPLACE
-        winners are always confirmed through the exact solve path, stepping
-        down on disagreement. Without batched-replan support each rung is a
-        full solve (host fallback)."""
+        On a solver with batched-replan support (TPUSolver), every subset
+        is screened in ONE batched device dispatch over a shared union
+        encode (solver/replan.py), and feasible subsets rank by REAL
+        savings (current node prices minus the replacement floor) with
+        disruption cost as the tie-break — not first-feasible-prefix. A
+        conclusive 0-new-machine winner becomes the DELETE command
+        directly (validate_after_ttl re-simulates through the exact path
+        before execution; a validation rejection flips the next ladder
+        back to exact per-subset confirmation); REPLACE winners are always
+        confirmed through the exact solve path, stepping down the ranking
+        on disagreement. Without batched-replan support each prefix rung
+        is a full solve (host fallback). The configured disruption budget
+        (api/settings.py) caps victims per pass on both paths."""
         if len(candidates) < 2:
             return Command(action=ACTION_DO_NOTHING)
         n = len(candidates)
+        budget = self._disruption_budget()
+        if budget:
+            n = min(n, budget)
+        if n < 2:
+            return Command(action=ACTION_DO_NOTHING)
         sizes = sorted(
             {
                 max(2, min(n, round(n ** (i / (self.LADDER_POINTS - 1)))))
@@ -276,6 +342,13 @@ class MultiNodeConsolidation(Consolidation):
 
         if getattr(self.provisioning.solver, "supports_batched_replan", False):
             return self._ladder_batched(candidates, sizes)
+        return self._ladder_sequential(candidates, sizes)
+
+    def _ladder_sequential(self, candidates: List[CandidateNode],
+                           sizes: List[int]) -> Command:
+        """The host fallback: one exact solve per prefix rung, keep the
+        largest actionable (the pre-batched behavior, and the degrade path
+        when the batched screen itself fails)."""
         best = Command(action=ACTION_DO_NOTHING)
         for size in sizes:
             cmd = self._evaluate_prefix(candidates, size)
@@ -285,33 +358,51 @@ class MultiNodeConsolidation(Consolidation):
                 break  # larger prefixes are monotonically harder
         return best
 
-    def _evaluate_prefix(self, candidates: List[CandidateNode], size: int) -> Command:
-        """Exact evaluation of one prefix: full solve + price/same-type
-        rules."""
-        prefix = candidates[:size]
-        cmd = self.compute_consolidation(prefix)
+    def _evaluate_subset(self, subset: List[CandidateNode]) -> Command:
+        """Exact evaluation of one candidate subset: full solve +
+        price/same-type rules."""
+        cmd = self.compute_consolidation(subset)
         if cmd.action == ACTION_REPLACE:
             cmd.replacement_machines[0].instance_type_options = self._filter_out_same_type(
-                cmd.replacement_machines[0], prefix
+                cmd.replacement_machines[0], subset
             )
             if not cmd.replacement_machines[0].instance_type_options:
                 cmd = Command(action=ACTION_DO_NOTHING)
         return cmd
 
+    def _evaluate_prefix(self, candidates: List[CandidateNode], size: int) -> Command:
+        return self._evaluate_subset(candidates[:size])
+
     def _ladder_batched(self, candidates: List[CandidateNode],
                         sizes: List[int]) -> Command:
-        """One vmapped screen over all rungs; conclusive 0-new-machine
-        winners short-circuit to DELETE, REPLACE winners get exact
-        confirmation (price and same-type rules live there), stepping down
-        on disagreement. See first_n_consolidation_ladder for the
-        validation backstop on the delete shortcut."""
-        from karpenter_core_tpu.solver.replan import batched_ladder_screen
+        """One batched screen over the prefix rungs + the all-empty-nodes
+        subset; feasible subsets rank by (savings desc, disruption asc,
+        size desc). Conclusive 0-new-machine winners short-circuit to
+        DELETE, REPLACE winners get exact confirmation (price and
+        same-type rules live there), stepping down the ranking on
+        disagreement. See first_n_consolidation_ladder for the validation
+        backstop on the delete shortcut."""
+        from karpenter_core_tpu.solver.replan import batched_subset_screen
 
         confirm_deletes = getattr(self, "_confirm_deletes_once", False)
+        subsets = [tuple(range(s)) for s in sizes]
+        prefix_count = len(subsets)
+        # ride-along emptiness subset: all pod-free candidates in one
+        # DELETE — a non-contiguous subset the prefix ladder would only
+        # find if the empties happened to sort first (they usually do —
+        # zero pods is zero disruption cost — but PDB/price ordering can
+        # interleave); free to screen, and it exercises the evaluator's
+        # arbitrary-subset encoding on every pass
+        budget = self._disruption_budget()
+        empty_idx = tuple(
+            i for i, c in enumerate(candidates) if not c.pods
+        )[: budget or None]
+        if len(empty_idx) >= 2 and empty_idx not in set(subsets):
+            subsets.append(empty_idx)
         try:
-            screens = batched_ladder_screen(
+            screens, scenario = batched_subset_screen(
                 self.kube_client, self.cluster, self.provisioning, candidates,
-                sizes, max_nodes=getattr(
+                subsets, max_nodes=getattr(
                     self.provisioning.solver, "max_nodes", 1024
                 ),
             )
@@ -319,19 +410,28 @@ class MultiNodeConsolidation(Consolidation):
             # transient (a candidate is mid-delete): keep the one-shot flag
             # so the NEXT successful ladder still runs exact confirmation
             return Command(action=ACTION_DO_NOTHING)
+        except Exception as exc:  # noqa: BLE001 — screen is an optimization
+            # a solver/RPC fault (remote replan unreachable, breaker open,
+            # device error) must degrade to the sequential simulate path —
+            # the parity oracle kept for exactly this — never crash the
+            # deprovisioning reconcile loop
+            LOG.warning(
+                "batched consolidation screen failed; sequential fallback",
+                error=type(exc).__name__, error_detail=str(exc)[:200],
+            )
+            return self._ladder_sequential(candidates, sizes)
         self._confirm_deletes_once = False
-        feasible = []
-        blocked = []
-        by_size = {}
-        for screen in screens:
-            if screen.all_scheduled and screen.conclusive and screen.n_new_machines <= 1:
-                feasible.append(screen.size)
-                by_size[screen.size] = screen
-            else:
-                blocked = [s.size for s in screens[len(feasible):]]
-                break  # larger prefixes are monotonically harder
-        for size in reversed(feasible):
-            # A conclusive 0-new-machine rung IS the delete decision: the
+        feasible = [
+            s for s in screens
+            if s.all_scheduled and s.conclusive and s.n_new_machines <= 1
+        ]
+        ranked = sorted(
+            feasible, key=lambda s: (-s.savings, s.disruption, -s.size)
+        )
+        cmd = Command(action=ACTION_DO_NOTHING)
+        for screen in ranked:
+            subset = [candidates[i] for i in screen.subset]
+            # A conclusive 0-new-machine subset IS the delete decision: the
             # screen ran the same round-0 kernel the exact path would (the
             # delete branch of consolidation.go:180-264 checks only "all
             # scheduled, zero replacements" — price/spot/same-type rules
@@ -340,42 +440,39 @@ class MultiNodeConsolidation(Consolidation):
             # exact path before any node is touched. Skipping the
             # confirming solve here halves the replan's critical path.
             # confirm_deletes (set after a validation rejection of a
-            # screen-sourced delete) routes this rung through the exact
+            # screen-sourced delete) routes every subset through the exact
             # path instead, restoring the step-down on disagreement.
-            if by_size[size].n_new_machines == 0 and not confirm_deletes:
-                return Command(
-                    nodes_to_remove=[c.node for c in candidates[:size]],
+            if screen.n_new_machines == 0 and not confirm_deletes:
+                cmd = Command(
+                    nodes_to_remove=[c.node for c in subset],
                     action=ACTION_DELETE,
                     from_screen=True,
                 )
-            cmd = self._evaluate_prefix(candidates, size)
-            if cmd.action in (ACTION_REPLACE, ACTION_DELETE):
-                return cmd
-        # The screen is the round-0 kernel only — no preference relaxation
-        # (scheduler.go:114-123 relaxes until exhaustion). A negative screen
-        # is therefore inconclusive when any involved pod still carries a
-        # relaxable soft constraint; confirm those rungs through the exact
-        # (relaxing) path before concluding nothing consolidates.
-        if blocked and self._any_relaxable(candidates[: blocked[-1]]):
-            best = Command(action=ACTION_DO_NOTHING)
-            for size in blocked:
-                cmd = self._evaluate_prefix(candidates, size)
-                if cmd.action in (ACTION_REPLACE, ACTION_DELETE):
-                    best = cmd
-                else:
-                    break
-            return best
-        return Command(action=ACTION_DO_NOTHING)
-
-    def _any_relaxable(self, candidates: List[CandidateNode]) -> bool:
-        from karpenter_core_tpu.controllers.provisioning.scheduling.preferences import (
-            Preferences,
-        )
-
-        prefs = Preferences()
-        pods = [p for c in candidates for p in c.pods]
-        pods += list(self.provisioning.get_pending_pods())
-        return any(prefs.is_relaxable(p) for p in pods)
+                break
+            exact = self._evaluate_subset(subset)
+            if exact.action in (ACTION_REPLACE, ACTION_DELETE):
+                cmd = exact
+                break
+        else:
+            # The screen is the round-0 kernel only — no preference
+            # relaxation (scheduler.go:114-123 relaxes until exhaustion).
+            # A negative screen is therefore inconclusive when any
+            # involved pod still carries a relaxable soft constraint;
+            # confirm those prefix rungs through the exact (relaxing) path
+            # before concluding nothing consolidates.
+            feasible_ids = {s.subset for s in feasible}
+            blocked = [
+                s for s in sizes if tuple(range(s)) not in feasible_ids
+            ]
+            if blocked and self._any_relaxable(candidates[: blocked[-1]]):
+                for size in blocked:
+                    exact = self._evaluate_prefix(candidates, size)
+                    if exact.action in (ACTION_REPLACE, ACTION_DELETE):
+                        cmd = exact
+                    else:
+                        break
+        self._record_pass(candidates, screens, cmd, scenario=scenario)
+        return cmd
 
     def _filter_out_same_type(self, replacement, consolidated: List[CandidateNode]):
         """multinodeconsolidation.go:133-166: prevent replacing with the same
@@ -400,7 +497,13 @@ class MultiNodeConsolidation(Consolidation):
 
 
 class SingleNodeConsolidation(Consolidation):
-    """singlenodeconsolidation.go:44-86."""
+    """singlenodeconsolidation.go:44-86, with the per-candidate simulation
+    sweep replaced by the batched subset evaluator: every singleton subset
+    screens in a few chunked device dispatches (solver/replan.py), and
+    only the feasible candidates — ranked by savings — pay an exact
+    confirming solve. The sequential sweep is kept verbatim as the
+    fallback (no batched-replan solver) and as the screened-out backstop
+    when relaxable pods make a negative screen inconclusive."""
 
     def __str__(self) -> str:
         return "consolidation"
@@ -409,15 +512,65 @@ class SingleNodeConsolidation(Consolidation):
         if self.cluster.consolidated():
             return Command(action=ACTION_DO_NOTHING)
         candidates = self.sort_and_filter_candidates(candidates)
+        order, screens, scenario = self._ranked_candidates(candidates)
         failed_validation = False
-        for candidate in candidates:
+        final = Command(action=ACTION_DO_NOTHING)
+        for candidate in order:
             cmd = self.compute_consolidation([candidate])
             if cmd.action in (ACTION_DO_NOTHING, ACTION_RETRY):
                 continue
             if not self.validate_after_ttl(cmd):
                 failed_validation = True
                 continue
-            return cmd
-        if failed_validation:
-            return Command(action=ACTION_RETRY)
-        return Command(action=ACTION_DO_NOTHING)
+            final = cmd
+            break
+        if final.action == ACTION_DO_NOTHING and failed_validation:
+            final = Command(action=ACTION_RETRY)
+        if screens is not None:
+            self._record_pass(candidates, screens, final, scenario=scenario)
+        return final
+
+    def _ranked_candidates(self, candidates: List[CandidateNode]):
+        """(exact-confirmation order, screens, scenario): feasible
+        singletons first, ranked by (savings desc, disruption asc);
+        screened-out candidates are dropped UNLESS relaxable pods are in
+        play (the screen is the round-0 kernel — a negative verdict is
+        inconclusive for them), in which case they trail in the
+        reference's original order. Falls back to the untouched candidate
+        order (screens=None) when no batched-replan solver is attached or
+        the screen fails — the screen is an optimization, never a
+        correctness dependency."""
+        if len(candidates) < 2 or not getattr(
+            self.provisioning.solver, "supports_batched_replan", False
+        ):
+            return candidates, None, None
+        from karpenter_core_tpu.solver.replan import batched_subset_screen
+
+        try:
+            screens, scenario = batched_subset_screen(
+                self.kube_client, self.cluster, self.provisioning,
+                candidates, [(i,) for i in range(len(candidates))],
+                max_nodes=getattr(
+                    self.provisioning.solver, "max_nodes", 1024
+                ),
+            )
+        except CandidateNodeDeletingError:
+            # transient: the sequential sweep handles the mid-delete
+            # candidate per-simulation (compute_consolidation catches it)
+            return candidates, None, None
+        except Exception:
+            return candidates, None, None
+        feasible = [
+            s for s in screens
+            if s.all_scheduled and s.conclusive and s.n_new_machines <= 1
+        ]
+        feasible_ids = {id(s) for s in feasible}
+        ranked = sorted(feasible, key=lambda s: (-s.savings, s.disruption))
+        order = [candidates[s.subset[0]] for s in ranked]
+        screened_out = [
+            candidates[s.subset[0]] for s in screens
+            if id(s) not in feasible_ids
+        ]
+        if screened_out and self._any_relaxable(screened_out):
+            order += screened_out
+        return order, screens, scenario

@@ -97,11 +97,20 @@ def clamp(lo: float, v: float, hi: float) -> float:
     return max(lo, min(v, hi))
 
 
-def lifetime_remaining(candidate: CandidateNode, clock=time.time) -> float:
+def lifetime_remaining(candidate: CandidateNode, clock=None) -> float:
     """helpers.go:308-318: fraction of expiry TTL left scales disruption
-    cost toward 0 for nearly-expired nodes."""
+    cost toward 0 for nearly-expired nodes.
+
+    clock resolves at CALL time (None -> time.time): a module-level
+    `clock=time.time` default binds the function object at import, so a
+    clock installed later (tests monkeypatching time.time, a fake clock
+    threaded most-of-the-way) silently never reaches this comparison
+    against the node's wall-clock creation_timestamp — the import-time-
+    bound-clock pattern `make lint`'s monotonic-time pass now rejects."""
     if candidate.provisioner.spec.ttl_seconds_until_expired is None:
         return 1.0
+    if clock is None:
+        clock = time.time
     total = float(candidate.provisioner.spec.ttl_seconds_until_expired)
     age = clock() - candidate.node.metadata.creation_timestamp
     return clamp(0.0, (total - age) / total, 1.0)
@@ -162,6 +171,39 @@ def node_prices(candidates: List[CandidateNode]) -> float:
     return total
 
 
+def candidate_price(candidate: CandidateNode) -> Optional[float]:
+    """One candidate's current offering price, or None when its offering
+    cannot be determined (a 'priceless' node — its zone/capacity-type
+    labels name an offering the cloud provider no longer lists). The
+    ranking objective treats None as a zero-savings contribution; the
+    exact REPLACE path still refuses to price such a subset
+    (node_prices raises -> do-nothing, the reference's err branch)."""
+    offering = candidate.instance_type.offerings.get(
+        candidate.capacity_type, candidate.zone
+    )
+    return None if offering is None else offering.price
+
+
+def replacement_price_floor(
+    instance_types: Dict[str, List[InstanceType]]
+) -> float:
+    """The cheapest price ANY replacement launch could possibly resolve to:
+    min over the live instance-type universe of worst_launch_price under
+    unconstrained requirements. An optimistic lower bound on a REPLACE
+    subset's replacement cost, used only to RANK subsets by savings
+    (deprovisioning.replan objective) — the exact confirming solve still
+    applies filter_by_price's strictly-cheaper rule before anything
+    executes, so an over-optimistic rank costs one extra confirmation,
+    never a wrong command."""
+    floor = math.inf
+    empty = Requirements()
+    for its in instance_types.values():
+        for it in its:
+            price = worst_launch_price(it.offerings.available(), empty)
+            floor = min(floor, price)
+    return 0.0 if floor is math.inf else floor
+
+
 # ---------------------------------------------------------------------------
 # PDB limits (pdblimits.go:34-76)
 
@@ -220,8 +262,12 @@ def candidate_nodes(
     kube_client,
     cloud_provider,
     should_deprovision: Callable[[object, Provisioner, List[Pod]], bool],
-    clock=time.time,
+    clock=None,
 ) -> List[CandidateNode]:
+    # clock resolves late (see lifetime_remaining): a default bound at
+    # import would pin whatever time.time was at import forever
+    if clock is None:
+        clock = time.time
     provisioners: Dict[str, Provisioner] = {
         p.name: p for p in kube_client.list("Provisioner")
     }

@@ -531,6 +531,11 @@ class FlightRecorder:
         self._failures = 0  # snapshot/commit errors (recording is best-effort)
         self._skipped_large = 0  # solves over MAX_SNAPSHOT_STATE_NODES
         self._dumped: List[str] = []
+        # consolidation decisions (ISSUE 10): candidate set + screened
+        # subsets + chosen Command per deprovisioning pass, own ring so
+        # solve records and replan decisions never evict each other
+        self._cons_ring: deque = deque(maxlen=capacity)
+        self._cons_recorded = 0
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -551,6 +556,8 @@ class FlightRecorder:
             self._failures = 0
             self._skipped_large = 0
             self._dumped = []
+            self._cons_ring.clear()
+            self._cons_recorded = 0
 
     # -- recording ---------------------------------------------------------
 
@@ -576,6 +583,96 @@ class FlightRecorder:
         except Exception:  # noqa: BLE001
             self._note_failure()
             return None
+
+    def record_consolidation(self, deprovisioner: str, candidates, screens,
+                             cmd, scenario=None) -> Optional[dict]:
+        """Record one consolidation decision pass: the candidate set (with
+        each candidate's price/disruption), every screened subset's device
+        verdict + objective, and the chosen Command. When the union
+        scenario rides along (and its node count is under the snapshot
+        cap), the pass's full solver inputs are serialized too — which is
+        what lets hack/replay.py re-run every subset through the
+        SEQUENTIAL simulator offline and diff the device-ranked decision
+        against it. Disabled/oversized/failed captures return None;
+        recording never breaks the pass."""
+        if not self.enabled or recording_suppressed():
+            return None
+        try:
+            record = {
+                "schema": SCHEMA_VERSION,
+                "kind": "consolidation",
+                "ts": time.time(),
+                "deprovisioner": deprovisioner,
+                "candidates": [
+                    {
+                        "name": c.name,
+                        "disruption": round(float(c.disruption_cost), 6),
+                        "pods": [p.metadata.uid for p in c.pods],
+                    }
+                    for c in candidates
+                ],
+                "subsets": [
+                    {
+                        "members": [int(i) for i in s.subset],
+                        "allScheduled": bool(s.all_scheduled),
+                        "nNewMachines": int(s.n_new_machines),
+                        "conclusive": bool(s.conclusive),
+                        "price": round(float(s.price), 6),
+                        "disruption": round(float(s.disruption), 6),
+                        "savings": round(float(s.savings), 6),
+                        "priceless": bool(s.priceless),
+                    }
+                    for s in screens
+                ],
+                "chosen": {
+                    "action": cmd.action,
+                    "nodes": [n.metadata.name for n in cmd.nodes_to_remove],
+                    "fromScreen": bool(getattr(cmd, "from_screen", False)),
+                    "replacements": len(cmd.replacement_machines or ()),
+                },
+            }
+            if scenario is not None and scenario.snap is not None:
+                all_nodes = list(scenario.state_nodes) + [
+                    c.state_node for c in candidates
+                ]
+                if len(all_nodes) > MAX_SNAPSHOT_STATE_NODES:
+                    with self._mu:
+                        self._skipped_large += 1
+                    record["inputsOmitted"] = len(all_nodes)
+                else:
+                    record["inputs"] = snapshot_inputs(
+                        scenario.pods, scenario.provisioners,
+                        scenario.instance_types, scenario.daemonset_pods,
+                        all_nodes,
+                    )
+                    record["candOfPod"] = {
+                        uid: ci
+                        for uid, ci in scenario.cand_of_pod.items()
+                        if ci >= 0
+                    }
+            with self._mu:
+                self._cons_ring.append(record)
+                self._cons_recorded += 1
+            return record
+        except Exception:  # noqa: BLE001 — recording is best-effort
+            self._note_failure()
+            return None
+
+    def consolidations(self) -> List[dict]:
+        with self._mu:
+            return list(self._cons_ring)
+
+    def last_consolidation(self) -> Optional[dict]:
+        with self._mu:
+            return self._cons_ring[-1] if self._cons_ring else None
+
+    def consolidations_json(self) -> str:
+        with self._mu:
+            body = {
+                "records": list(self._cons_ring),
+                "dropped": self._cons_recorded - len(self._cons_ring),
+            }
+        return json.dumps(body)
 
     def _commit(self, record: dict, dump: bool) -> None:
         with self._mu:
@@ -741,6 +838,99 @@ def replay(record: dict, solver_kind: Optional[str] = None) -> Tuple[dict, objec
         **inputs.solve_kwargs(),
     )
     return canonical_placements(result), result
+
+
+def replay_consolidation(record: dict, solver_kind: str = "greedy") -> dict:
+    """Re-run every subset of a recorded consolidation decision through the
+    sequential simulator path offline (the same per-subset scenario
+    simulate_scheduling builds: victims out of the snapshot, their pods
+    back on the pending axis) and diff it against the recorded device
+    verdicts and the chosen Command.
+
+    Returns {"subsets": [per-subset dicts with recorded + sequential
+    verdicts and an "agrees" flag], "chosen": ..., "chosen_feasible_seq":
+    bool (the parity bar: the sequential simulator validates the executed
+    command), "seq_pick": the member list the sequential verdicts + the
+    recorded objective would have chosen}."""
+    if record.get("kind") != "consolidation":
+        raise ValueError("not a consolidation record")
+    if "inputs" not in record:
+        raise ValueError(
+            "record carries no inputs snapshot "
+            f"(inputsOmitted={record.get('inputsOmitted')})"
+        )
+    restored = restore_inputs(record["inputs"])
+    cand_of = {
+        uid: int(ci) for uid, ci in record.get("candOfPod", {}).items()
+    }
+    cand_names = [c["name"] for c in record["candidates"]]
+    solver = build_replay_solver(solver_kind, restored.max_nodes)
+    out_subsets = []
+    seq_feasible = []
+    for sub in record["subsets"]:
+        members = set(int(i) for i in sub["members"])
+        names = {cand_names[ci] for ci in members}
+        pods = [
+            p for p in restored.pods
+            if cand_of.get(p.metadata.uid, -1) < 0
+            or cand_of[p.metadata.uid] in members
+        ]
+        state_nodes = [
+            sn for sn in restored.state_nodes if sn.name() not in names
+        ]
+        res = solver.solve(
+            pods, restored.provisioners, restored.instance_types,
+            daemonset_pods=restored.daemonset_pods, state_nodes=state_nodes,
+            kube_client=restored.kube_client,
+        )
+        seq_all = not res.failed_pods
+        seq_new = len(res.new_machines)
+        entry = dict(
+            sub,
+            seqAllScheduled=seq_all,
+            seqNewMachines=seq_new,
+            # the decision-relevant agreement: same feasibility verdict
+            # (all scheduled, <= 1 new machine). The screen is the round-0
+            # kernel while the simulator relaxes, so the simulator may be
+            # MORE permissive — that direction is expected, not a bug.
+            agrees=(
+                (seq_all and seq_new <= 1)
+                == (sub["allScheduled"] and sub["nNewMachines"] <= 1)
+            ),
+        )
+        out_subsets.append(entry)
+        if seq_all and seq_new <= 1:
+            seq_feasible.append(entry)
+    seq_pick = None
+    if seq_feasible:
+        seq_pick = max(
+            seq_feasible,
+            key=lambda s: (s["savings"], -s["disruption"], len(s["members"])),
+        )["members"]
+    chosen = record.get("chosen", {})
+    chosen_feasible = True
+    if chosen.get("action") in ("delete", "replace") and chosen.get("nodes"):
+        chosen_members = {
+            cand_names.index(n) for n in chosen["nodes"] if n in cand_names
+        }
+        match = next(
+            (
+                s for s in out_subsets
+                if set(int(i) for i in s["members"]) == chosen_members
+            ),
+            None,
+        )
+        chosen_feasible = bool(
+            match is not None
+            and match["seqAllScheduled"]
+            and match["seqNewMachines"] <= 1
+        )
+    return {
+        "subsets": out_subsets,
+        "chosen": chosen,
+        "chosen_feasible_seq": chosen_feasible,
+        "seq_pick": seq_pick,
+    }
 
 
 def diff_placements(a: dict, b: dict) -> List[str]:

@@ -353,7 +353,7 @@ def new_operator(
     kube_client: Optional[InMemoryKubeClient] = None,
     settings: Optional[Settings] = None,
     solver=None,
-    clock=time.time,
+    clock=None,
     with_webhooks: bool = False,
 ) -> Operator:
     """Assemble the full control plane (controllers.go:46-73).
@@ -361,6 +361,11 @@ def new_operator(
     with_webhooks installs admission defaulting/validation on the client
     (operator.WithWebhooks, operator.go:149-152); off by default because
     test suites create intentionally-partial objects."""
+    # clock resolves at CALL time (the monotonic-time-default lint rule):
+    # a module-level `clock=time.time` default binds at import and a
+    # later-installed fake clock would silently never reach the controllers
+    if clock is None:
+        clock = time.time
     if settings is not None:
         set_current(settings)
     from karpenter_core_tpu.cloudprovider.metrics import decorate

@@ -194,6 +194,15 @@ class _HealthHandler(BaseHTTPRequestHandler):
 
             body = FLIGHTREC.to_json().encode()
             ctype = "application/json"
+        elif self.path == "/debug/consolidations" and self.profiling_enabled:
+            # consolidation decision ring (obs/flightrec): candidate set +
+            # screened subsets + chosen Command per deprovisioning pass;
+            # `python hack/replay.py --consolidation` diffs any record
+            # against the sequential simulator offline
+            from karpenter_core_tpu.obs.flightrec import FLIGHTREC
+
+            body = FLIGHTREC.consolidations_json().encode()
+            ctype = "application/json"
         elif self.path == "/debug/events" and self.profiling_enabled:
             # the events Recorder ring (events/__init__), dedupe/rate-limit
             # metadata included

@@ -344,6 +344,69 @@ def make_screen_refresh_kernel(segments, n_slots, rb: int, cb: int,
     return refresh
 
 
+def make_replan_verdict_kernel(n_exist: int):
+    """Per-subset verdict reduction for the batched consolidation replan
+    (solver/replan.py): the consolidation search only ever reads FOUR
+    scalars per candidate subset — how many evicted/pending pods re-packed,
+    how many were supposed to, how many NEW machine slots opened, and
+    whether an uninitialized existing node absorbed pods (inconclusive,
+    helpers.go:41-105's in-flight-node rule). Reducing on the device keeps
+    the per-dispatch fetch at [K, 4] int32 instead of the [K, N] slot
+    plane — on the 10k-node geometry that is bytes instead of megabytes
+    over a link that charges per round trip."""
+
+    def verdict(pods_per_slot, count_row, uninit):
+        scheduled = pods_per_slot.sum()
+        expected = count_row.sum()
+        n_new = (pods_per_slot[n_exist:] > 0).sum()
+        incon = (pods_per_slot[:n_exist] * uninit[:n_exist]).sum() > 0
+        return jnp.stack(
+            [scheduled, expected, n_new, incon.astype(jnp.int32)]
+        ).astype(jnp.int32)
+
+    return verdict
+
+
+def make_batched_replan_kernel(rung_run, n_exist: int, external_screen: bool):
+    """The candidate-axis batched replan program: K candidate node-subsets
+    evaluated as ONE device call (ISSUE 10 tentpole).
+
+    rung_run is a rung-mode solve program (tpu_solver.make_device_run with
+    rung_mode=True): per subset, `exist_open` reopens the victims' slots
+    out of the cluster (False = the candidate's existing slot closes) and
+    `count_row` activates the victims' evicted pods on the item axis; the
+    full pack scan then re-packs them against the residual cluster. The
+    candidate axis enters ONLY through those two [K, ...] planes — every
+    slot/type/template plane is shared across subsets, so vmap broadcasts
+    one copy and the feasibility/prescreen precompute traces once.
+
+    external_screen threads a caller-dispatched [N, C] prescreen verdict
+    tensor (screen0) through every subset UNBATCHED: the verdict is
+    candidate-invariant (closing a slot changes its openness, never its
+    requirement planes), which is what lets the solver's RESIDENT tensor —
+    maintained across solves by solver/incremental.py's refresh kernel —
+    serve all K simulated re-packs of a consolidation pass.
+
+    Returns replan(count_rows [K, I], exist_open [K, E], uninit [E],
+    screen0, *run_args) -> (pods_per_slot [K, N] int32, verdicts [K, 4]
+    int32 — see make_replan_verdict_kernel)."""
+    verdict_of = make_replan_verdict_kernel(n_exist)
+
+    def replan(count_rows, exist_open, uninit, screen0, *run_args):
+        def one(count_row, open_row):
+            if external_screen:
+                _log, _ptr, state = rung_run(
+                    count_row, open_row, screen0, *run_args
+                )
+            else:
+                _log, _ptr, state = rung_run(count_row, open_row, *run_args)
+            return state.pods, verdict_of(state.pods, count_row, uninit)
+
+        return jax.vmap(one)(count_rows, exist_open)
+
+    return replan
+
+
 def make_pack_kernel(
     segments,
     zone_seg,

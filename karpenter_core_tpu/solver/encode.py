@@ -122,6 +122,57 @@ def ladder_pad(n: int, ladder, axis: str, lo: int) -> int:
     return b
 
 
+# -- candidate-subset axis (ISSUE 10) ----------------------------------------
+# The batched consolidation replan vmaps K candidate subsets through one
+# rung-mode solve program (solver/replan.py). K is a compiled-program axis
+# like any other batch axis, so it rides its own small fixed ladder: the
+# multi-node prefix ladder is <= 8 rungs (the bottom bucket), single-node
+# sweeps chunk at the top bucket, and the program set per geometry stays
+# bounded by len(REPLAN_K_BUCKETS) instead of O(observed subset counts).
+
+REPLAN_K_BUCKETS = (8, 16, 32, 64)
+
+
+def replan_k_pad(k: int) -> int:
+    """Round a subset count up to the replan candidate-axis ladder. Counts
+    above the top bucket are a caller error — dispatchers chunk at
+    REPLAN_K_BUCKETS[-1] (replan_chunks)."""
+    if k <= 0:
+        return REPLAN_K_BUCKETS[0]
+    for v in REPLAN_K_BUCKETS:
+        if k <= v:
+            return v
+    raise ValueError(
+        f"subset axis {k} exceeds the replan chunk cap "
+        f"{REPLAN_K_BUCKETS[-1]} (callers must chunk)"
+    )
+
+
+def replan_chunks(count_rows, exist_open):
+    """Yield (k_real, k_pad, counts, open) dispatch chunks along the
+    candidate axis: slices of at most REPLAN_K_BUCKETS[-1] subsets, padded
+    up to the bucket ladder. Pad rungs are no-op subsets — zero active
+    pods, nothing closed — so they cost one cheap scan each and never
+    perturb real verdicts. ONE definition of the padding contract, shared
+    by TPUSolver.replan_screen and the gRPC service's Replan handler so
+    the in-process and remote replan paths can never desynchronize."""
+    K = int(count_rows.shape[0])
+    CH = REPLAN_K_BUCKETS[-1]
+    for lo in range(0, K, CH):
+        counts = np.ascontiguousarray(count_rows[lo: lo + CH])
+        opened = np.ascontiguousarray(exist_open[lo: lo + CH])
+        k = counts.shape[0]
+        kp = replan_k_pad(k)
+        if kp > k:
+            counts = np.concatenate(
+                [counts, np.zeros((kp - k,) + counts.shape[1:], counts.dtype)]
+            )
+            opened = np.concatenate(
+                [opened, np.ones((kp - k,) + opened.shape[1:], opened.dtype)]
+            )
+        yield k, kp, counts, opened
+
+
 def _ids(lst):
     return tuple(map(id, lst))
 

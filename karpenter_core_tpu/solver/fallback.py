@@ -334,6 +334,14 @@ class ResilientSolver:
         is advisory for the device path only."""
         return self.primary.encode(*args, **kwargs)
 
+    def replan_screen(self, *args, **kwargs):
+        """Batched consolidation replan passthrough (solver/replan.py):
+        reachable only while supports_batched_replan reads True (cached
+        health + primary capability) — the consolidation driver falls back
+        to the sequential simulate_scheduling path otherwise, so this
+        never routes a replan to a dead backend."""
+        return self.primary.replan_screen(*args, **kwargs)
+
     def _primary_solve(self, *args, **kwargs):
         if self.solve_timeout is None:
             return self.primary.solve(*args, **kwargs)

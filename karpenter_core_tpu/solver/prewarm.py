@@ -2,10 +2,13 @@
 
 The geometry bucket ladder (api/settings.py GeometryTier) makes the set of
 solve programs the operator can ever need ENUMERABLE: every batch axis pads
-to a tier value, so one (solve, prescreen, refresh) program triple per tier
-— against the cluster's real provisioners and instance-type universe —
-covers every generic steady-state batch. This module synthesizes a
-vocabulary-neutral workload per tier and AOT-compiles the triple through
+to a tier value, so one (solve, prescreen, refresh, batched-replan) program
+family per tier — against the cluster's real provisioners and
+instance-type universe — covers every generic steady-state batch AND the
+first consolidation pass (the replan program compiles at the smallest
+candidate-axis bucket, the multi-node ladder's shape —
+docs/consolidation.md). This module synthesizes a vocabulary-neutral
+workload per tier and AOT-compiles the family through
 TPUSolver.prewarm_snapshot (jax.jit(...).lower().compile()), so:
 
   * a live solve that lands on a prewarmed tier is a cache HIT — no

@@ -1,26 +1,45 @@
-"""Batched consolidation replan: the whole prefix ladder as ONE device
-dispatch.
+"""Batched consolidation replan: K candidate node-subsets as ONE device
+dispatch (ISSUE 10 tentpole).
 
 The reference evaluates multi-node consolidation by binary-searching the
 candidate prefix with O(log N) sequential full scheduling simulations
-(multinodeconsolidation.go:87-113). Round 1 replaced that with a host loop
-over ladder rungs — still one encode + one dispatch PER RUNG. Here the union
-scenario is encoded ONCE — every candidate stays in the snapshot as an
-existing slot, every candidate's pods enter the pod axis with a candidate
-tag — and all rungs run as one jit(vmap) over (count_row, exist_open):
+(multinodeconsolidation.go:87-113), and single-node consolidation with one
+simulation PER candidate (singlenodeconsolidation.go:44-86). Earlier rounds
+replaced the multi-node search with a host loop over ladder rungs, then
+with a prefix-only vmapped screen. This module generalizes that screen to
+ARBITRARY candidate subsets and adds a real objective, so the whole
+deprovisioning search — the multi-node prefix ladder, every single-node
+singleton, and the all-empty-nodes subset — evaluates as a handful of
+device dispatches:
 
-  rung r: candidates[:size_r] close their slots (exist_open) and activate
-  their pods' replica counts (count_row); everything else is shared.
+  * the union scenario is encoded ONCE: every candidate stays in the
+    snapshot as an existing slot, every candidate's pods enter the pod
+    axis tagged with their candidate index;
+  * subset k closes its victims' slots (exist_open) and activates their
+    pods' replica counts (count_row); everything else — feasibility
+    planes, the [N, C] prescreen verdict tensor, instance types — is
+    shared across subsets and traces once under the vmap
+    (ops/pack.make_batched_replan_kernel);
+  * the dispatch goes through TPUSolver.replan_screen, which stages the
+    call through the same _bundle_args path as a live solve — so the
+    prescreen program, the RESIDENT verdict tensor, and the delta-refresh
+    machinery (solver/incremental.py) are shared with the provisioning
+    path, and consecutive consolidation passes re-screen only the churned
+    rows/columns;
+  * each subset comes back with (all_scheduled, n_new_machines,
+    conclusive) plus a host-computed objective — the subset's current
+    price (deprovisioning.core.node_prices per candidate), its disruption
+    cost, and the savings bound — so the caller ranks subsets by real
+    savings instead of first-feasible-prefix.
 
-The screen returns per-rung (all_scheduled, n_new_machines, conclusive);
-the caller confirms the winning prefix through the exact solve path (price
-rules, relaxation) — one batched dispatch plus one confirming solve instead
-of up to 8 sequential solves.
+The caller confirms winners through the exact solve path
+(simulate_scheduling — price rules, relaxation), which stays the parity
+oracle and the fallback when no batched-replan solver is attached.
 """
 from __future__ import annotations
 
-from dataclasses import dataclass
-from typing import Dict, List, Tuple
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -28,51 +47,109 @@ from karpenter_core_tpu.utils import podutils
 
 
 @dataclass
-class RungScreen:
-    size: int
+class SubsetScreen:
+    """One candidate subset's device verdict + host objective."""
+
+    subset: Tuple[int, ...]  # candidate indices (into the caller's list)
     all_scheduled: bool
     n_new_machines: int
     conclusive: bool  # False when an uninitialized existing node took pods
+    # objective (host-computed): the subset's current offering price sum,
+    # its eviction-cost disruption, and the savings bound used for ranking
+    # (price minus the cheapest possible replacement when any new machine
+    # is needed; deletes save the full price)
+    price: float = 0.0
+    disruption: float = 0.0
+    savings: float = 0.0
+    # True when any member node's current offering is unknown (the price
+    # contribution is 0 — rank-conservative; the exact path still applies
+    # the reference's price rules to any REPLACE)
+    priceless: bool = False
+    # [N] per-slot re-pack pod counts, fetched only on request
+    # (parity tests / smoke — production reads only the verdict scalars)
+    pods_per_slot: Optional[np.ndarray] = None
+
+    @property
+    def size(self) -> int:
+        return len(self.subset)
 
 
-def batched_ladder_screen(
-    kube_client,
-    cluster,
-    provisioning,
-    candidates,
-    sizes: List[int],
-    max_nodes: int = 1024,
-) -> List[RungScreen]:
-    """One union encode + one vmapped dispatch screening every ladder rung.
+@dataclass
+class UnionScenario:
+    """The union-encoded replan scenario: everything a subset dispatch (or
+    a flight-record of the pass) needs beyond the subsets themselves."""
 
-    Raises CandidateNodeDeletingError under the same conditions as
-    simulate_scheduling (a candidate is already mid-delete)."""
-    from karpenter_core_tpu.obs import TRACER
+    snap: object  # EncodedSnapshot
+    candidates: Sequence
+    pods: List  # union pod axis (pending + deleting-node + candidate pods)
+    cand_of_pod: Dict[str, int]  # pod uid -> candidate index (-1 = always on)
+    provisioners: List
+    instance_types: Dict
+    daemonset_pods: List
+    state_nodes: List  # residual nodes EXCLUDING candidates
+    cand_slot: np.ndarray  # [C] candidate -> existing-slot index (-1 = none)
+    uninitialized: np.ndarray  # [E] uninitialized existing-slot mask
+    counts_base: np.ndarray  # [I] always-active replica count per item
+    counts_per_cand: np.ndarray  # [I, C] per-candidate replica counts
+    item_pad: int
+    prices: List[Optional[float]] = field(default_factory=list)
+    replacement_floor: float = 0.0
 
-    with TRACER.span(
-        "deprovisioning.ladder_screen",
-        candidates=len(candidates), rungs=len(sizes),
-    ):
-        return _ladder_screen_traced(
-            kube_client, cluster, provisioning, candidates, sizes, max_nodes
+    def subset_rows(self, subsets: Sequence[Tuple[int, ...]]):
+        """(count_rows [K, I_pad] int32, exist_open [K, E] bool) for K
+        subsets — the only candidate-axis-batched planes."""
+        E = self.snap.exist_used.shape[0]
+        K = len(subsets)
+        count_rows = np.zeros((K, self.item_pad), dtype=np.int32)
+        exist_open = np.ones((K, E), dtype=bool)
+        I = len(self.counts_base)
+        for r, subset in enumerate(subsets):
+            row = self.counts_base.copy()
+            for ci in subset:
+                row += self.counts_per_cand[:, ci]
+                if self.cand_slot[ci] >= 0:
+                    exist_open[r, self.cand_slot[ci]] = False
+            count_rows[r, :I] = row
+        return count_rows, exist_open
+
+    def objective(self, subset: Tuple[int, ...], n_new: int):
+        """(price, disruption, savings, priceless) for one subset. Savings
+        = what the cluster stops paying (node_prices) minus an optimistic
+        floor for any replacement launch (the cheapest worst_launch_price
+        in the universe) — a sound RANKING bound: the exact confirming
+        path still enforces the reference's strictly-cheaper price filter
+        before any REPLACE executes."""
+        price = 0.0
+        priceless = False
+        for ci in subset:
+            p = self.prices[ci]
+            if p is None:
+                priceless = True
+            else:
+                price += p
+        disruption = sum(
+            self.candidates[ci].disruption_cost for ci in subset
         )
+        savings = price - (self.replacement_floor if n_new > 0 else 0.0)
+        return price, disruption, savings, priceless
 
 
-def _ladder_screen_traced(
+def build_union_scenario(
     kube_client,
     cluster,
     provisioning,
     candidates,
-    sizes: List[int],
-    max_nodes: int,
-) -> List[RungScreen]:
-    import jax
-
+    max_nodes: int = 1024,
+) -> UnionScenario:
+    """Encode the union scenario once. Raises CandidateNodeDeletingError
+    under the same conditions as simulate_scheduling (a candidate is
+    already mid-delete)."""
     from karpenter_core_tpu.controllers.deprovisioning.core import (
         CandidateNodeDeletingError,
+        candidate_price,
+        replacement_price_floor,
     )
-    from karpenter_core_tpu.solver.encode import encode_snapshot
-    from karpenter_core_tpu.solver.tpu_solver import make_device_run, solve_geometry
+    from karpenter_core_tpu.solver.encode import bucket_pow2, encode_snapshot
 
     candidate_names = {c.name for c in candidates}
     state_nodes = []
@@ -86,7 +163,7 @@ def _ladder_screen_traced(
         raise CandidateNodeDeletingError()
 
     # pod axis: pending + deleting-node pods (always active) + candidate
-    # pods (active from the rung that removes their node)
+    # pods (active in the subsets that remove their node)
     pods: List = []
     cand_of: List[int] = []
     for p in provisioning.get_pending_pods():
@@ -123,16 +200,11 @@ def _ladder_screen_traced(
         p for p in kube_client.list("Provisioner")
         if p.metadata.deletion_timestamp is None
     ]
-    if not provisioners:
-        return [
-            RungScreen(size=s, all_scheduled=not pods, n_new_machines=0,
-                       conclusive=True)
-            for s in sizes
-        ]
     instance_types = {
         p.name: provisioning.cloud_provider.get_instance_types(p)
         for p in provisioners
     }
+    daemonset_pods = provisioning.get_daemonset_pods()
 
     # candidate slots appended AFTER the regular nodes so their indices are
     # stable under encode's owned() filter (candidates are always owned)
@@ -141,12 +213,26 @@ def _ladder_screen_traced(
         pods,
         provisioners,
         instance_types,
-        provisioning.get_daemonset_pods(),
+        daemonset_pods,
         all_nodes,
         kube_client=kube_client,
         cluster=cluster,
         max_nodes=max_nodes,
-    )
+    ) if provisioners else None
+
+    if snap is None:
+        return UnionScenario(
+            snap=None, candidates=candidates, pods=pods,
+            cand_of_pod=cand_of_pod, provisioners=[], instance_types={},
+            daemonset_pods=daemonset_pods, state_nodes=state_nodes,
+            cand_slot=np.full(len(candidates), -1, np.int64),
+            uninitialized=np.zeros(0, bool),
+            counts_base=np.zeros(0, np.int32),
+            counts_per_cand=np.zeros((0, len(candidates)), np.int32),
+            item_pad=0,
+            prices=[candidate_price(c) for c in candidates],
+        )
+
     E = snap.exist_used.shape[0]  # bucket-padded existing axis
     name_to_slot = {n.name(): e for e, n in enumerate(snap.state_nodes)}
     cand_slot = np.full(len(candidates), -1, dtype=np.int64)
@@ -157,81 +243,125 @@ def _ladder_screen_traced(
         not n.initialized() for n in snap.state_nodes
     ]
 
-    # per-row candidate tag on the FFD-sorted pod axis
-    cand_of_row = np.array(
-        [cand_of_pod.get(p.metadata.uid, -1) for p in snap.pods], dtype=np.int64
-    )
+    # per-item replica counts, factored by candidate membership so K
+    # subset rows build by vectorized gather instead of a K x P host scan
     members = snap.item_members or [[i] for i in range(len(snap.pods))]
     I = len(snap.item_counts) if snap.item_counts is not None else len(snap.pods)
-
-    Rn = len(sizes)
-    from karpenter_core_tpu.solver.encode import bucket_pow2
-
-    # count axis padded like device_args pads the item axis (the snapshot's
-    # ladder tier when present)
-    count_rows = np.zeros(
-        (Rn, snap.item_pad or bucket_pow2(max(I, 1), 32)), dtype=np.int32
+    item_of = np.zeros(len(snap.pods), dtype=np.int64)
+    for it, mem in enumerate(members):
+        for m in mem:
+            item_of[m] = it
+    cand_of_row = np.array(
+        [cand_of_pod.get(p.metadata.uid, -1) for p in snap.pods],
+        dtype=np.int64,
     )
-    exist_open = np.ones((Rn, E), dtype=bool)
-    for r, size in enumerate(sizes):
-        for it in range(I):
-            count_rows[r, it] = sum(
-                1
-                for m in members[it]
-                if cand_of_row[m] < 0 or cand_of_row[m] < size
+    counts_base = np.zeros(I, dtype=np.int32)
+    counts_per_cand = np.zeros((I, max(len(candidates), 1)), dtype=np.int32)
+    if len(snap.pods):
+        base_sel = cand_of_row < 0
+        np.add.at(counts_base, item_of[base_sel], 1)
+        cand_sel = ~base_sel
+        if cand_sel.any():
+            np.add.at(
+                counts_per_cand,
+                (item_of[cand_sel], cand_of_row[cand_sel]),
+                1,
             )
-        for ci in range(min(size, len(candidates))):
-            if cand_slot[ci] >= 0:
-                exist_open[r, cand_slot[ci]] = False
 
-    geom = solve_geometry(snap, max_nodes)
-    (_P, _J, _T, _E, _R, _K, _V, N, segments_t, zone_seg, ct_seg, _sig,
-     log_len, _Q, _W, _D, screen_v) = geom
-    cache = getattr(provisioning.solver, "_replan_compiled", None)
-    if cache is None:
-        cache = {}
-        try:
-            provisioning.solver._replan_compiled = cache
-        except AttributeError:
-            pass
-    backend = getattr(provisioning.solver, "backend", None)
-    key = (geom, Rn, backend)
-    fn = cache.get(key)
-    from karpenter_core_tpu.utils.compilecache import record_lookup
+    item_pad = snap.item_pad or bucket_pow2(max(I, 1), 32)
+    return UnionScenario(
+        snap=snap, candidates=candidates, pods=list(snap.pods),
+        cand_of_pod=cand_of_pod, provisioners=provisioners,
+        instance_types=instance_types, daemonset_pods=daemonset_pods,
+        state_nodes=state_nodes, cand_slot=cand_slot,
+        uninitialized=uninitialized, counts_base=counts_base,
+        counts_per_cand=counts_per_cand, item_pad=item_pad,
+        prices=[candidate_price(c) for c in candidates],
+        replacement_floor=replacement_price_floor(instance_types),
+    )
 
-    record_lookup("replan", fn is not None)
-    if fn is None:
-        rung_run = make_device_run(
-            segments_t, zone_seg, ct_seg, snap.topo_meta, N, log_len=log_len,
-            rung_mode=True, backend=backend, screen_v=screen_v,
+
+def batched_subset_screen(
+    kube_client,
+    cluster,
+    provisioning,
+    candidates,
+    subsets: Sequence[Sequence[int]],
+    max_nodes: int = 1024,
+    want_slots: bool = False,
+    scenario: Optional[UnionScenario] = None,
+) -> Tuple[List[SubsetScreen], UnionScenario]:
+    """One union encode + batched device dispatches screening every
+    candidate subset, with the per-subset objective attached. Returns
+    (screens in input order, the union scenario — reusable for further
+    dispatches in the same pass and for flight-recording the decision).
+
+    Raises CandidateNodeDeletingError like simulate_scheduling."""
+    from karpenter_core_tpu.obs import TRACER
+
+    with TRACER.span(
+        "deprovisioning.subset_screen",
+        candidates=len(candidates), subsets=len(subsets),
+    ):
+        if scenario is None:
+            scenario = build_union_scenario(
+                kube_client, cluster, provisioning, candidates,
+                max_nodes=max_nodes,
+            )
+        return (
+            _screen_subsets(provisioning, scenario, subsets, want_slots),
+            scenario,
         )
-        from karpenter_core_tpu.solver.tpu_solver import RUN_ARG_NAMES
 
-        fn = jax.jit(
-            jax.vmap(rung_run, in_axes=(0, 0) + (None,) * len(RUN_ARG_NAMES))
-        )
-        cache[key] = fn
 
-    from karpenter_core_tpu.solver.tpu_solver import device_args
+def _screen_subsets(provisioning, scenario: UnionScenario,
+                    subsets: Sequence[Sequence[int]],
+                    want_slots: bool) -> List[SubsetScreen]:
+    subsets = [tuple(s) for s in subsets]
+    if scenario.snap is None:
+        # no live provisioners: nothing can re-pack anywhere — feasible
+        # only when the union scenario strands no pods at all (the same
+        # verdict simulate_scheduling returns, helpers.go:41-105)
+        screens = []
+        for subset in subsets:
+            price, disruption, savings, priceless = scenario.objective(
+                subset, 0
+            )
+            screens.append(
+                SubsetScreen(
+                    subset=subset, all_scheduled=not scenario.pods,
+                    n_new_machines=0, conclusive=True, price=price,
+                    disruption=disruption, savings=savings,
+                    priceless=priceless,
+                )
+            )
+        return screens
 
-    args = device_args(snap, provisioners)
-    log, ptr, state = fn(count_rows, exist_open, *args)
-    pods_per_slot = np.asarray(state.pods)  # [Rn, N]
-
+    count_rows, exist_open = scenario.subset_rows(subsets)
+    verdicts, pods = provisioning.solver.replan_screen(
+        scenario.snap, scenario.provisioners, count_rows, exist_open,
+        uninitialized=scenario.uninitialized, cluster=None,
+        want_slots=want_slots,
+    )
     screens = []
-    for r, size in enumerate(sizes):
-        scheduled = int(pods_per_slot[r].sum())
-        expected = int(count_rows[r].sum())
-        n_new = int((pods_per_slot[r, E:] > 0).sum())
-        inconclusive = bool(
-            (pods_per_slot[r, :E][uninitialized] > 0).any()
+    for r, subset in enumerate(subsets):
+        scheduled, expected, n_new, incon = (int(v) for v in verdicts[r])
+        price, disruption, savings, priceless = scenario.objective(
+            subset, n_new
         )
         screens.append(
-            RungScreen(
-                size=size,
+            SubsetScreen(
+                subset=subset,
                 all_scheduled=scheduled >= expected,
                 n_new_machines=n_new,
-                conclusive=not inconclusive,
+                conclusive=not incon,
+                price=price,
+                disruption=disruption,
+                savings=savings,
+                priceless=priceless,
+                pods_per_slot=pods[r] if pods is not None else None,
             )
         )
     return screens
+
+

@@ -249,6 +249,13 @@ class SolverService:
         self._inc_mu = threading.Lock()
         self._inc_screens: Dict[object, object] = {}
         self._refresh_compiled = OrderedDict()
+        # batched consolidation replan programs (Replan RPC): one vmapped
+        # rung program per (solve key, candidate-axis bucket) — the same
+        # program family the in-process TPUSolver.replan_screen compiles,
+        # sharing this service's solve-entry prescreen + residency
+        self.MAX_REPLAN = 16
+        self._replan_compiled = OrderedDict()
+        self.replans = 0
 
     def solve(self, request: pb.SolveRequest, context=None) -> pb.SolveResponse:
         # adopt the client's propagated trace id (metadata interceptor
@@ -282,15 +289,11 @@ class SolverService:
                 # the legacy error field carries the same classification
                 return pb.SolveResponse(error=f"{code_name}: {msg}")
 
-    def _solve_traced(self, request: pb.SolveRequest) -> pb.SolveResponse:
-        import jax
-
+    @staticmethod
+    def _parse_geometry(geometry: dict):
+        """(segments, zone_seg, ct_seg, topo_meta) from the wire geometry."""
         from karpenter_core_tpu.ops.topology import TopoGroupMeta, TopoMeta
-        from karpenter_core_tpu.utils.compilecache import record_lookup
 
-        geometry = json.loads(request.geometry)
-        tensors = {t.name: tensor_from_pb(t) for t in request.tensors}
-        args = _unflatten_args(tensors)
         segments = [tuple(s) for s in geometry["segments"]]
         zone_seg = tuple(geometry["zone_seg"])
         ct_seg = tuple(geometry["ct_seg"])
@@ -310,31 +313,33 @@ class SolverService:
                     for g in geometry["topo_groups"]
                 ]
             )
-        from karpenter_core_tpu.ops import compat as ops_compat
+        return segments, zone_seg, ct_seg, topo_meta
 
-        # the GSPMD mesh layout (parallel/specs.py) when this container
-        # serves a multi-chip device set AND the batch clears the
-        # small-batch routing floor; None compiles the plain single-device
-        # program. Same response shape either way — the mesh program is
-        # byte-identical to the single-device one, so the client decodes
-        # both with decode_solve.
-        layout = self._layout_for(args)
+    def _entry(self, geom_str: str, geometry: dict, screen_mode, layout,
+               family: str = "service"):
+        """(key, (run, pre)) for one wire geometry — created on first
+        sight, LRU-bounded, shared by the Solve and Replan RPCs (the
+        replan reuses the solve entry's prescreen program and residency —
+        the same program family, exactly like the in-process solver)."""
+        import jax
+
+        from karpenter_core_tpu.utils.compilecache import record_lookup
+
         # key on the trace-time screen mode too: a KCT_PACK_SCREEN flip
         # must mint a new program, not serve the other mode's cache
-        screen_mode = ops_compat.resolve_screen_mode()
         key = (
-            request.geometry, screen_mode,
+            geom_str, screen_mode,
             layout.key if layout is not None else None,
         )
         with self._mu:
             entry = self._compiled.get(key)
             if entry is not None:
                 self._compiled.move_to_end(key)
-        record_lookup(
-            "service" if layout is None else "service_sharded",
-            entry is not None,
-        )
+        record_lookup(family, entry is not None)
         if entry is None:
+            segments, zone_seg, ct_seg, topo_meta = self._parse_geometry(
+                geometry
+            )
             run = jax.jit(
                 make_device_run(
                     segments, zone_seg, ct_seg, topo_meta, geometry["n_slots"],
@@ -362,6 +367,28 @@ class SolverService:
                 while len(self._compiled) > self.MAX_COMPILED:
                     old_key, _ = self._compiled.popitem(last=False)
                     self._drop_incremental(old_key)
+        return key, entry
+
+    def _solve_traced(self, request: pb.SolveRequest) -> pb.SolveResponse:
+        import jax
+
+        geometry = json.loads(request.geometry)
+        tensors = {t.name: tensor_from_pb(t) for t in request.tensors}
+        args = _unflatten_args(tensors)
+        from karpenter_core_tpu.ops import compat as ops_compat
+
+        # the GSPMD mesh layout (parallel/specs.py) when this container
+        # serves a multi-chip device set AND the batch clears the
+        # small-batch routing floor; None compiles the plain single-device
+        # program. Same response shape either way — the mesh program is
+        # byte-identical to the single-device one, so the client decodes
+        # both with decode_solve.
+        layout = self._layout_for(args)
+        screen_mode = ops_compat.resolve_screen_mode()
+        key, entry = self._entry(
+            request.geometry, geometry, screen_mode, layout,
+            family="service" if layout is None else "service_sharded",
+        )
         fn, pre_fn = entry
         host_args = args
         if layout is not None:
@@ -392,6 +419,161 @@ class SolverService:
         with self._mu:
             self.solves += 1
         return pb.SolveResponse(tensors=out)
+
+    # -- batched consolidation replan (ISSUE 10) ----------------------------
+
+    def replan(self, request: pb.SolveRequest, context=None) -> pb.SolveResponse:
+        """Batched candidate-subset evaluation behind the process boundary:
+        the split deployment's control plane ships the union snapshot's
+        tensors plus the [K, ...] subset planes; the service runs the SAME
+        rung-mode program family the in-process TPUSolver.replan_screen
+        compiles — sharing this service's solve-entry prescreen program
+        and resident verdict tensor — and returns [K, 4] verdicts (and the
+        [K, N] slot plane on request)."""
+        trace_id = None
+        if context is not None:
+            try:
+                for k, v in context.invocation_metadata() or ():
+                    if k == TRACE_HEADER:
+                        trace_id = v
+            except Exception:  # noqa: BLE001 — tracing must never fail a replan
+                pass
+        with TRACER.span(
+            "solver.service.replan", trace_id=trace_id,
+            tensors=len(request.tensors),
+        ):
+            try:
+                return self._replan_traced(request)
+            except Exception as e:  # noqa: BLE001 — mapped to a status code
+                code_name, msg = classify_exception(e)
+                if context is not None:
+                    import grpc
+
+                    context.abort(getattr(grpc.StatusCode, code_name), msg)
+                return pb.SolveResponse(error=f"{code_name}: {msg}")
+
+    def _replan_traced(self, request: pb.SolveRequest) -> pb.SolveResponse:
+        import jax
+
+        from karpenter_core_tpu.ops import compat as ops_compat
+        from karpenter_core_tpu.solver.encode import replan_chunks
+        from karpenter_core_tpu.utils.compilecache import record_lookup
+
+        geometry = json.loads(request.geometry)
+        tensors = {t.name: tensor_from_pb(t) for t in request.tensors}
+        count_rows = np.ascontiguousarray(tensors.pop("replan/count_rows"))
+        exist_open = np.ascontiguousarray(
+            tensors.pop("replan/exist_open").astype(bool)
+        )
+        # defensive re-pad: the verdict kernel binds n_exist from
+        # exist_open's width, so a client shipping an unpadded mask must
+        # not crash the dispatch with a broadcast error
+        E = int(exist_open.shape[1]) if exist_open.ndim == 2 else 0
+        raw_uninit = tensors.pop("replan/uninitialized").astype(bool)
+        uninit = np.zeros(E, dtype=bool)
+        uninit[: min(len(raw_uninit), E)] = raw_uninit[:E]
+        want_slots = bool(
+            int(np.asarray(tensors.pop("replan/want_slots")).reshape(-1)[0])
+        )
+        args = _unflatten_args(tensors)
+        # single-device deliberately, like TPUSolver.replan_screen: the
+        # candidate axis is a vmap over the rung program, and vmapping the
+        # GSPMD mesh program is unproven — the K-way batch recovers the
+        # parallelism the mesh would have added
+        screen_mode = ops_compat.resolve_screen_mode()
+        key, entry = self._entry(
+            request.geometry, geometry, screen_mode, None,
+            family="service_replan_entry",
+        )
+        _fn, pre_fn = entry
+        screen0 = None
+        if pre_fn is not None:
+            screen0 = self._prescreen(key, geometry, args, pre_fn)
+
+        verdict_parts, pods_parts = [], []
+        for k, kp, sub_counts, sub_open in replan_chunks(
+            count_rows, exist_open
+        ):
+            replan_fn, hit = self._replan_fn(key, geometry, kp, screen_mode)
+            record_lookup("service_replan", hit)
+            pods_dev, verd_dev = replan_fn(
+                sub_counts, sub_open, uninit, screen0, *args
+            )
+            if want_slots:
+                verd_h, pods_h = jax.device_get((verd_dev, pods_dev))
+                pods_parts.append(np.asarray(pods_h)[:k])
+            else:
+                verd_h = jax.device_get(verd_dev)
+            verdict_parts.append(np.asarray(verd_h)[:k])
+        verdicts = (
+            np.concatenate(verdict_parts)
+            if verdict_parts else np.zeros((0, 4), np.int32)
+        )
+        out = [tensor_to_pb("verdicts", verdicts)]
+        if want_slots and pods_parts:
+            out.append(tensor_to_pb("pods", np.concatenate(pods_parts)))
+        with self._mu:
+            self.replans += 1
+        return pb.SolveResponse(tensors=out)
+
+    def _replan_fn(self, key, geometry: dict, k_pad: int, screen_mode):
+        """(jitted batched replan program for (solve key, candidate-axis
+        bucket), cache_hit) — the service-side analog of
+        TPUSolver._replan_fn, over unbundled wire tensors."""
+        import jax
+
+        rkey = (key, k_pad)
+        with self._mu:
+            fn = self._replan_compiled.get(rkey)
+            if fn is not None:
+                self._replan_compiled.move_to_end(rkey)
+                return fn, True
+        from karpenter_core_tpu.ops.pack import make_batched_replan_kernel
+
+        segments, zone_seg, ct_seg, topo_meta = self._parse_geometry(geometry)
+        rung_run = make_device_run(
+            segments, zone_seg, ct_seg, topo_meta, geometry["n_slots"],
+            log_len=geometry.get("log_len"),
+            screen_v=geometry.get("screen_v"),
+            screen_mode=screen_mode,
+            rung_mode=True,
+            external_prescreen=screen_mode == "prescreen",
+        )
+        # n_exist = the padded existing axis width (exist_used's leading
+        # dim rides the wire); resolved at first dispatch via closure
+        fn = None
+
+        def build(n_exist):
+            kern = make_batched_replan_kernel(
+                rung_run, n_exist, screen_mode == "prescreen"
+            )
+            return jax.jit(
+                lambda count_rows, exist_open, uninit, screen0, *args: kern(
+                    count_rows, exist_open, uninit, screen0, *args
+                )
+            )
+
+        class _LazyReplan:
+            """Binds n_exist from the first call's exist_open width."""
+
+            def __init__(self):
+                self._jit = None
+
+            def __call__(self, count_rows, exist_open, uninit, screen0,
+                         *args):
+                if self._jit is None:
+                    self._jit = build(int(exist_open.shape[1]))
+                return self._jit(
+                    count_rows, exist_open, uninit, screen0, *args
+                )
+
+        fn = _LazyReplan()
+        with self._mu:
+            fn = self._replan_compiled.setdefault(rkey, fn)
+            self._replan_compiled.move_to_end(rkey)
+            while len(self._replan_compiled) > self.MAX_REPLAN:
+                self._replan_compiled.popitem(last=False)
+        return fn, False
 
     # -- incremental prescreen (delta re-solve across RPCs) -----------------
 
@@ -499,6 +681,11 @@ class SolverService:
             self._inc_screens.pop(key, None)
             for rkey in [k for k in self._refresh_compiled if k[0] == key]:
                 del self._refresh_compiled[rkey]
+        # replan programs share the evicted solve entry's geometry too
+        # (caller holds self._mu on the eviction path: _replan_compiled is
+        # guarded by the same lock, so mutate without re-taking it)
+        for rkey in [k for k in self._replan_compiled if k[0] == key]:
+            del self._replan_compiled[rkey]
 
     def _layout_for(self, args):
         """The parallel/specs.SpecLayout this request's programs build
@@ -538,6 +725,11 @@ def serve(address: str = "127.0.0.1:0", max_workers: int = 4, mesh=None):
     handlers = {
         "Solve": grpc.unary_unary_rpc_method_handler(
             service.solve,
+            request_deserializer=pb.SolveRequest.FromString,
+            response_serializer=pb.SolveResponse.SerializeToString,
+        ),
+        "Replan": grpc.unary_unary_rpc_method_handler(
+            service.replan,
             request_deserializer=pb.SolveRequest.FromString,
             response_serializer=pb.SolveResponse.SerializeToString,
         ),
@@ -602,6 +794,11 @@ class RemoteSolver:
             request_serializer=pb.SolveRequest.SerializeToString,
             response_deserializer=pb.SolveResponse.FromString,
         )
+        self._replan = self.channel.unary_unary(
+            f"/{SERVICE}/Replan",
+            request_serializer=pb.SolveRequest.SerializeToString,
+            response_deserializer=pb.SolveResponse.FromString,
+        )
         self._health = self.channel.unary_unary(
             f"/{SERVICE}/Health",
             request_serializer=pb.HealthRequest.SerializeToString,
@@ -634,10 +831,12 @@ class RemoteSolver:
         err.__cause__ = e
         return err
 
-    def _invoke_solve(self, request: pb.SolveRequest, metadata):
-        """One Solve RPC through the breaker + bounded transient retry."""
+    def _invoke_solve(self, request: pb.SolveRequest, metadata, stub=None):
+        """One Solve/Replan RPC through the breaker + bounded transient
+        retry (stub defaults to the Solve method)."""
         import grpc
 
+        stub = stub or self._solve
         attempt = 0
         while True:
             if not self.breaker.allow():
@@ -648,7 +847,7 @@ class RemoteSolver:
                 # chaos hook INSIDE the try: injected faults (typed solver
                 # errors) exercise the same classification as wire errors
                 chaos.maybe_fail(chaos.SOLVER_RPC)
-                response = self._solve(
+                response = stub(
                     request, timeout=self.timeout, metadata=metadata
                 )
             except grpc.RpcError as e:
@@ -688,6 +887,11 @@ class RemoteSolver:
                 self.breaker.record_failure()
             raise err
 
+    # the split deployment runs the same batched-replan program family as
+    # the in-process solver (ISSUE 10): consolidation's subset evaluator
+    # works against a RemoteSolver unchanged, one Replan RPC per pass
+    supports_batched_replan = True
+
     def encode(self, pods, provisioners, instance_types, daemonset_pods=None,
                state_nodes=None, kube_client=None, cluster=None):
         """Pre-encode off the Solve critical path (pipelined surface,
@@ -697,6 +901,62 @@ class RemoteSolver:
             kube_client=kube_client, cluster=cluster, max_nodes=self.max_nodes,
             reuse=self._encode_reuse,
         )
+
+    def replan_screen(self, snap, provisioners, count_rows, exist_open,
+                      uninitialized=None, cluster=None,
+                      want_slots: bool = False):
+        """Batched candidate-subset evaluation over the wire — the same
+        contract as TPUSolver.replan_screen (solver/replan.py is the only
+        caller). Encodes host-side, ships the union snapshot's device_args
+        tensors plus the [K, ...] subset planes, and decodes the [K, 4]
+        verdicts (and the [K, N] slot plane when want_slots)."""
+        with TRACER.span("solver.phase.replan.args"):
+            args = device_args(snap, provisioners)
+            tensors = [tensor_to_pb(n, a) for n, a in _flatten_args(args)]
+            # pad the uninitialized mask to the bucket-padded existing axis
+            # (pad sentinel rows are initialized=False-uninit), the same
+            # contract TPUSolver.replan_screen applies: the service-side
+            # verdict kernel binds n_exist from exist_open's padded width
+            E = snap.exist_used.shape[0]
+            uninit = np.zeros(E, dtype=bool)
+            if uninitialized is not None:
+                src = np.asarray(uninitialized, dtype=bool)
+                uninit[: min(len(src), E)] = src[:E]
+            tensors.append(
+                tensor_to_pb(
+                    "replan/count_rows",
+                    np.asarray(count_rows, dtype=np.int32),
+                )
+            )
+            tensors.append(
+                tensor_to_pb("replan/exist_open", np.asarray(exist_open))
+            )
+            tensors.append(
+                tensor_to_pb("replan/uninitialized", np.asarray(uninit))
+            )
+            tensors.append(
+                tensor_to_pb(
+                    "replan/want_slots",
+                    np.asarray([1 if want_slots else 0], dtype=np.int32),
+                )
+            )
+            request = pb.SolveRequest(
+                geometry=geometry_json(snap), tensors=tensors
+            )
+        with TRACER.span("solver.service.replan_request") as sp:
+            trace_id = getattr(sp, "trace_id", None) or TRACER.current_trace_id()
+            metadata = ((TRACE_HEADER, trace_id),) if trace_id else None
+            response = self._invoke_solve(request, metadata, stub=self._replan)
+        if response.error:
+            raise error_from_string(response.error)
+        tensors = {t.name: tensor_from_pb(t) for t in response.tensors}
+        verdicts = np.asarray(tensors["verdicts"])
+        pods = (
+            np.asarray(tensors["pods"])
+            if want_slots and "pods" in tensors
+            else None
+        )
+        return verdicts, pods
 
     def solve(
         self,

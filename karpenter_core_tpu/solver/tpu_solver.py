@@ -431,10 +431,20 @@ def make_device_run(segments, zone_seg, ct_seg, topo_meta, n_slots,
         return log, ptr, state
 
     if rung_mode:
-        def rung_run(count_row, exist_open, *rest):
-            # internal prescreen: the vmapped rungs share the (unbatched)
-            # slot planes, so the verdict tensor traces once and broadcasts
-            return run_impl(count_row, exist_open, None, *rest)
+        if external_prescreen:
+            # the batched consolidation evaluator's form (solver/replan.py):
+            # the caller dispatches the prescreen as its own program (or
+            # replays a delta into the RESIDENT verdict tensor) and threads
+            # it through every vmapped subset unbatched — the verdict is
+            # candidate-invariant, so one tensor serves all K re-packs
+            def rung_run(count_row, exist_open, screen0, *rest):
+                return run_impl(count_row, exist_open, screen0, *rest)
+        else:
+            def rung_run(count_row, exist_open, *rest):
+                # internal prescreen: the vmapped rungs share the (unbatched)
+                # slot planes, so the verdict tensor traces once and
+                # broadcasts (the tiered-fallback and service legacy form)
+                return run_impl(count_row, exist_open, None, *rest)
 
         return rung_run
 
@@ -887,6 +897,16 @@ class TPUSolver:
         self._diff_gate = DiffGate()
         self.MAX_REFRESH = 8
         self._refresh_compiled = OrderedDict()
+        # batched consolidation replan programs (replan_screen): one
+        # vmapped rung program per (solve key, candidate-axis bucket),
+        # LRU-bounded like the refresh family and evicted with the solve
+        # entry whose prescreen/residency they share
+        self.MAX_REPLAN = 16
+        self._replan_compiled = OrderedDict()
+        # per-phase host timings of the last replan_screen dispatch
+        # (bench.py consolidation columns read these, mirroring
+        # last_phase_ms on the solve path)
+        self.last_replan_phase_ms = {}
         self._gate_ok = True
         self.last_prescreen_mode = None
         # the SpecLayout the last _run_kernels dispatch built against:
@@ -961,6 +981,54 @@ class TPUSolver:
             # make_screen_refresh_kernel); the solve+prescreen pair above
             # is where the compile time is anyway
             self._prewarm_refresh(staged, entry)
+        if not cache_hit:
+            # the consolidation/replan program family rides the same tier:
+            # without this the first deprovisioning pass after a restart
+            # paid a cold compile the solve prewarm never covered (replan
+            # always dispatches the single-device program — see
+            # replan_screen — so mesh solvers prewarm it here too, keyed
+            # spec_layout=None like their live replans). The mesh branch
+            # stages the single-device twin WITHOUT minting a solve cache
+            # entry: _compiled stays "programs live traffic asked for".
+            replan_staged = staged
+            pre_jit = entry[1].jit if entry[1] is not None else None
+            if staged.spec_layout is not None:
+                geom_s, run_s = build_device_solve(
+                    snap, self.max_nodes, backend=self.backend,
+                    screen_mode=screen_mode, external_prescreen=True,
+                    spec_layout=None,
+                )
+                replan_staged = _bundle_args(
+                    args, geom_s, run_s, self.backend, screen_mode,
+                    spec_layout=None,
+                )
+                pre_jit = None
+                if screen_mode == "prescreen":
+                    import jax
+
+                    from karpenter_core_tpu.ops.pack import make_prescreen_kernel
+
+                    (_P, _J, _T, _E, _R, _K, _V, N_s, segs_s, _zs, _cs,
+                     _ts, _ll, _Q, _W, _D, scrv_s) = replan_staged.geom
+                    pre_single = make_prescreen_kernel(
+                        segs_s, N_s, backend=self.backend, screen_v=scrv_s,
+                    )
+                    rebuild_s = replan_staged.rebuild
+                    meta_s = replan_staged.donated_meta
+
+                    def _pre_bundled(bundle):
+                        import jax.numpy as jnp
+
+                        dummies = iter(
+                            jnp.zeros(s, d) for s, d in meta_s
+                        )
+                        named = dict(
+                            zip(RUN_ARG_NAMES, rebuild_s(bundle, dummies))
+                        )
+                        return pre_single(named["pod_arrays"], named["exist"])
+
+                    pre_jit = jax.jit(_pre_bundled)
+            self._prewarm_replan(replan_staged, pre_jit, snap.topo_meta)
         return "cached" if cache_hit else "compiled"
 
     def _prewarm_refresh(self, staged: _StagedCall, entry) -> None:
@@ -1123,6 +1191,304 @@ class TPUSolver:
                 self._refresh_compiled.popitem(last=False)
         return fn, True
 
+    def _dispatch_prescreen(self, staged: _StagedCall, pre_fn,
+                            host_pod_arrays, host_exist, bundle_dev,
+                            cache_hit, layout, screen_mode):
+        """The [N, C] verdict tensor for one dispatch: a delta refresh of
+        the RESIDENT tensor when one is live at this key and the plane
+        delta is narrow (solver/incremental.py), the full precompute
+        otherwise. Returns (screen0, mode, cold, delta) for span
+        attribution.
+
+        Shared by the live solve path (_run_kernels_impl) and the batched
+        consolidation replan (replan_screen): residency keys off the
+        staged call's compiled-program key, so consecutive consolidation
+        passes at a stable union geometry refresh only the churned
+        rows/columns — and, when the union snapshot lands on the same
+        geometry as the steady-state provisioning solves, the replan
+        inherits their resident tensor outright. Bit-identical to the full
+        precompute either way; any planning or dispatch failure degrades
+        to the full path. Consumes the one-shot state-diff gate verdict
+        (self._gate_ok).
+
+        `cold` = this dispatch pays a program compile (first sight of the
+        solve geometry, or a freshly minted refresh program): consumers
+        comparing refresh-vs-full device time must bucket these apart or
+        one-time XLA cost poisons the medians."""
+        key, geom = staged.key, staged.geom
+        screen0 = None
+        scr_mode = "full"
+        cold = not cache_hit
+        delta = None
+        inc = None
+        if self._inc_enabled(screen_mode):
+            from karpenter_core_tpu.solver.incremental import IncrementalScreen
+
+            gate_ok, self._gate_ok = self._gate_ok, True
+            if not gate_ok:
+                # a feed fault poisons EVERY key's residency, not just
+                # the one this dispatch happens to land on
+                for other in self._inc_screens.values():
+                    other.invalidate()
+            with self._cache_lock:
+                inc = self._inc_screens.setdefault(key, IncrementalScreen())
+                self._inc_screens.move_to_end(key)
+                while len(self._inc_screens) > self.MAX_INC_SCREENS:
+                    self._inc_screens.popitem(last=False)
+            try:
+                delta = inc.plan(
+                    key, host_pod_arrays, host_exist, gate_ok=gate_ok
+                )
+            except Exception:
+                inc.invalidate()
+                delta = None
+            if delta is not None:
+                prev = inc.resident(key)
+                if prev is not None:
+                    try:
+                        refresh_fn, cold = self._refresh_fn(
+                            key, geom, delta.rb, delta.cb, staged.rebuild,
+                            staged.donated_meta, spec_layout=layout,
+                        )
+                        row_idx, row_n, col_idx, col_n = delta.padded()
+                        screen0 = refresh_fn(
+                            bundle_dev, prev, row_idx, row_n, col_idx, col_n
+                        )
+                        scr_mode = "refresh"
+                        inc.count_refresh()
+                    except Exception:
+                        # refresh dispatch failed (the donated tensor may
+                        # be gone): drop residency but keep the staged
+                        # fingerprints — the fallback full tensor below
+                        # re-adopts them
+                        inc.drop_resident()
+                        inc.count_degraded()
+                        screen0 = None
+        if screen0 is None:
+            screen0 = pre_fn(bundle_dev)
+        if inc is not None:
+            inc.adopt(key, screen0)
+        return screen0, scr_mode, cold, delta
+
+    # -- batched consolidation replan (ISSUE 10 tentpole) -------------------
+
+    def replan_screen(self, snap: EncodedSnapshot,
+                      provisioners: List[Provisioner],
+                      count_rows: np.ndarray, exist_open: np.ndarray,
+                      uninitialized: Optional[np.ndarray] = None,
+                      cluster=None, want_slots: bool = False):
+        """Evaluate K candidate node-subsets as ONE vmapped device call —
+        the deprovisioning counterpart of _run_kernels.
+
+        Per subset k, exist_open[k] closes the victims' existing slots and
+        count_rows[k] activates their evicted pods on the item axis; the
+        rung-mode solve program re-packs them against the residual cluster
+        (ops/pack.make_batched_replan_kernel). The call shares the whole
+        solve-path machinery: _bundle_args staging (so the compiled-program
+        key — and with it the prescreen program, the resident verdict
+        tensor, and the refresh programs — is the SAME key family a live
+        solve at this geometry uses), the geometry bucket ladder, and the
+        K axis's own bucket ladder (encode.REPLAN_K_BUCKETS) so the replan
+        program set stays bounded and prewarmable.
+
+        Returns (verdicts [K, 4] int32 — (scheduled, expected, n_new,
+        inconclusive) per subset — and pods_per_slot [K, N] int32 when
+        want_slots, else None). The caller (solver/replan.py) turns these
+        into ranked SubsetScreens."""
+        import time as _time
+
+        import jax
+
+        from karpenter_core_tpu.ops import compat as ops_compat
+        from karpenter_core_tpu.solver.encode import replan_chunks
+        from karpenter_core_tpu.utils.compilecache import record_lookup
+
+        chaos.maybe_fail(chaos.SOLVER_DEVICE)
+        phases = self.last_replan_phase_ms = {}
+        t_phase = _time.perf_counter_ns()
+
+        def _mark(name, **attrs):
+            nonlocal t_phase
+            now = _time.perf_counter_ns()
+            phases[name] = round((now - t_phase) / 1e6, 1)
+            TRACER.add_span(f"solver.phase.replan.{name}", t_phase, now,
+                            **attrs)
+            t_phase = now
+
+        screen_mode = self.screen_mode or ops_compat.resolve_screen_mode()
+        # single-device deliberately: the candidate axis is a vmap over the
+        # rung program, and vmapping the GSPMD mesh program is unproven —
+        # a ShardedSolver's replan therefore runs the plain program (the
+        # K-way batch recovers the parallelism the mesh would have added)
+        geom, solve_run = build_device_solve(
+            snap, self.max_nodes, backend=self.backend,
+            screen_mode=screen_mode, external_prescreen=True,
+            spec_layout=None,
+        )
+        args = device_args(snap, provisioners)
+        _mark("args")
+        staged = _bundle_args(
+            args, geom, solve_run, self.backend, screen_mode,
+            spec_layout=None,
+        )
+        _mark("pack")
+        if self._inc_enabled(screen_mode):
+            # same one-shot feed gate as solve(): a diff-feed fault forces
+            # the full prescreen and drops residency — degrade, never drift
+            self._gate_ok = self._diff_gate.gate(cluster)
+        # the solve-path cache entry at this key: its prescreen program and
+        # residency serve this replan; the solve program itself stays an
+        # undispatched jit object until real provisioning traffic needs it
+        entry, cache_hit = self._entry_for(staged, screen_mode)
+        _solve_fn, pre_fn = entry
+
+        K = int(count_rows.shape[0])
+        E = staged.geom[3]
+        uninit = np.zeros(E, dtype=bool)
+        if uninitialized is not None:
+            uninit[: min(len(uninitialized), E)] = uninitialized[:E]
+
+        dev = jax.device_put((staged.bundle, *staged.donated_leaves))
+        _mark("upload")
+        if pre_fn is not None:
+            screen0, scr_mode, cold, delta = self._dispatch_prescreen(
+                staged, pre_fn, args[0], args[9], dev[0], cache_hit,
+                None, screen_mode,
+            )
+            _mark(
+                "prescreen", slots=geom[7], mode=scr_mode, cold=cold,
+                delta_rows=len(delta.rows) if delta is not None else -1,
+                delta_cols=len(delta.cols) if delta is not None else -1,
+            )
+            self.last_prescreen_mode = scr_mode
+        else:
+            screen0 = None
+
+        # chunk over the candidate-axis ladder: one staging + prescreen
+        # serves every chunk, so a 1000-candidate single-node sweep costs
+        # ceil(1000/64) dispatches of ONE compiled program — never 1000
+        # sequential simulate_scheduling solves
+        t_dispatch = _time.perf_counter()
+        any_miss = False
+        verdict_parts, pods_parts = [], []
+        for k, Kp, sub_counts, sub_open in replan_chunks(
+            count_rows, exist_open
+        ):
+            fn, minted = self._replan_fn(
+                staged, Kp, screen_mode, snap.topo_meta
+            )
+            record_lookup("replan", not minted)
+            any_miss |= minted
+            with device_profiler():
+                pods_dev, verd_dev = fn(
+                    sub_counts, sub_open, uninit, screen0, dev[0], *dev[1:]
+                )
+                if profile_dir():
+                    jax.block_until_ready(verd_dev)
+            if want_slots:
+                verd_h, pods_h = jax.device_get((verd_dev, pods_dev))
+                pods_parts.append(np.asarray(pods_h)[:k])
+            else:
+                # the verdict reduction ran on device: fetch [K, 4]
+                # scalars, never the [K, N] slot plane
+                # (make_replan_verdict_kernel)
+                verd_h = jax.device_get(verd_dev)
+            verdict_parts.append(np.asarray(verd_h)[:k])
+        self.last_device_ms = (_time.perf_counter() - t_dispatch) * 1e3
+        _mark(
+            "device", compile_cache="miss" if any_miss else "hit",
+            subsets=K,
+        )
+        verdicts = (
+            np.concatenate(verdict_parts)
+            if verdict_parts else np.zeros((0, 4), np.int32)
+        )
+        pods = np.concatenate(pods_parts) if want_slots and pods_parts else None
+        _mark("fetch")
+        return verdicts, pods
+
+    def _replan_fn(self, staged: _StagedCall, k_pad: int, screen_mode,
+                   topo_meta):
+        """The jitted batched replan program for (solve key, candidate-axis
+        bucket), lazily built and LRU-bounded; returns (fn, minted). The
+        program reads the same uploaded bundle as the solve/prescreen pair
+        and never donates (the batched carry cannot alias the shared
+        planes)."""
+        import jax
+
+        rkey = (staged.key, k_pad)
+        with self._cache_lock:
+            fn = self._replan_compiled.get(rkey)
+            if fn is not None:
+                self._replan_compiled.move_to_end(rkey)
+                return fn, False
+        from karpenter_core_tpu.ops.pack import make_batched_replan_kernel
+
+        (_P, _J, _T, E, _R, _K, _V, N_, segments_t, zone_seg, ct_seg,
+         _tsig, log_len, _Q, _W, _D, scr_v) = staged.geom
+        rung_run = make_device_run(
+            segments_t, zone_seg, ct_seg, topo_meta, N_, log_len=log_len,
+            rung_mode=True, backend=self.backend, screen_v=scr_v,
+            screen_mode=screen_mode,
+            external_prescreen=(screen_mode == "prescreen"),
+        )
+        kern = make_batched_replan_kernel(
+            rung_run, E, screen_mode == "prescreen"
+        )
+        rebuild = staged.rebuild
+
+        def replan_bundled(count_rows, exist_open, uninit, screen0, bundle,
+                           *donated):
+            return kern(
+                count_rows, exist_open, uninit, screen0,
+                *rebuild(bundle, iter(donated)),
+            )
+
+        fn = _Dispatchable(jax.jit(replan_bundled))
+        with self._cache_lock:
+            fn = self._replan_compiled.setdefault(rkey, fn)
+            self._replan_compiled.move_to_end(rkey)
+            while len(self._replan_compiled) > self.MAX_REPLAN:
+                self._replan_compiled.popitem(last=False)
+        return fn, True
+
+    def _prewarm_replan(self, staged: _StagedCall, pre_jit, topo_meta) -> None:
+        """AOT-compile the batched consolidation replan program for this
+        tier at the smallest candidate-axis bucket (the multi-node prefix
+        ladder's shape, encode.REPLAN_K_BUCKETS[0]) so the first
+        deprovisioning pass after a restart dispatches a warm program —
+        the solve/prescreen/refresh triple alone left consolidation paying
+        the cold compile. pre_jit is the bundled prescreen jit whose output
+        shape the replan program's screen0 argument mirrors (None under
+        tiered). Abstract avals except the staged synthetic bundle
+        (concrete, like the solve AOT)."""
+        import jax
+
+        from karpenter_core_tpu.ops import compat as ops_compat
+        from karpenter_core_tpu.solver.encode import REPLAN_K_BUCKETS
+
+        screen_mode = self.screen_mode or ops_compat.resolve_screen_mode()
+        fn, _minted = self._replan_fn(
+            staged, REPLAN_K_BUCKETS[0], screen_mode, topo_meta
+        )
+        if fn.aot is not None:
+            return
+        P, E = staged.geom[0], staged.geom[3]
+        k = REPLAN_K_BUCKETS[0]
+        count_sds = jax.ShapeDtypeStruct((k, P), np.int32)
+        open_sds = jax.ShapeDtypeStruct((k, E), np.bool_)
+        uninit_sds = jax.ShapeDtypeStruct((E,), np.bool_)
+        screen_sds = None
+        if pre_jit is not None:
+            bundle_sds = jax.ShapeDtypeStruct(
+                staged.bundle.shape, staged.bundle.dtype
+            )
+            screen_sds = jax.eval_shape(pre_jit, bundle_sds)
+        fn.aot = fn.jit.lower(
+            count_sds, open_sds, uninit_sds, screen_sds,
+            staged.bundle, *staged.donated_leaves,
+        ).compile()
+
     # -- compiled-program cache (shared with the prewarm thread) -----------
 
     def _entry_for(self, staged: _StagedCall, screen_mode,
@@ -1159,6 +1525,9 @@ class TPUSolver:
                     for rk in [k for k in self._refresh_compiled
                                if k[0] == old_key]:
                         del self._refresh_compiled[rk]
+                    for rk in [k for k in self._replan_compiled
+                               if k[0] == old_key]:
+                        del self._replan_compiled[rk]
                     self._inc_screens.pop(old_key, None)
         return entry, False
 
@@ -1303,8 +1672,6 @@ class TPUSolver:
         # on its own tier's per-key lock and never duplicates a compile
         entry, cache_hit = self._entry_for(staged, screen_mode)
         record_lookup("tpu_solver", cache_hit)
-        _rebuild = staged.rebuild
-        donated_meta = staged.donated_meta
         fn, pre_fn = entry
         # one transfer for the bundle + one per donated plane; on the mesh
         # path the upload lands committed to the mesh (NamedSharding,
@@ -1327,74 +1694,14 @@ class TPUSolver:
             # donated — see donate_nums) leading argument. Dispatch is
             # async, so outside profile_phases this span mostly attributes
             # the dispatch itself; the execution overlaps into the device
-            # window either way.
-            #
-            # Incremental path (solver/incremental.py): when the previous
-            # solve's verdict tensor is resident at this key and the plane
-            # delta is narrow, REPLAY the delta through the refresh program
-            # (changed existing rows × all columns, changed columns × all
-            # rows) instead of recomputing the full [N, C] tensor — device
-            # cost scales with the churn, not the world. Bit-identical to
-            # the full precompute by construction; any planning or dispatch
-            # failure degrades to the full path.
-            screen0 = None
-            scr_mode = "full"
-            # cold = this dispatch pays a program compile (first sight of
-            # the solve geometry, or a freshly minted refresh program):
-            # consumers comparing refresh-vs-full device time must bucket
-            # these apart or one-time XLA cost poisons the medians
-            cold = not cache_hit
-            delta = None
-            inc = None
-            if self._inc_enabled(screen_mode):
-                from karpenter_core_tpu.solver.incremental import IncrementalScreen
-
-                gate_ok, self._gate_ok = self._gate_ok, True
-                if not gate_ok:
-                    # a feed fault poisons EVERY key's residency, not just
-                    # the one this solve happens to land on
-                    for other in self._inc_screens.values():
-                        other.invalidate()
-                with self._cache_lock:
-                    inc = self._inc_screens.setdefault(
-                        key, IncrementalScreen()
-                    )
-                    self._inc_screens.move_to_end(key)
-                    while len(self._inc_screens) > self.MAX_INC_SCREENS:
-                        self._inc_screens.popitem(last=False)
-                try:
-                    delta = inc.plan(
-                        key, raw_args[0], raw_args[9], gate_ok=gate_ok
-                    )
-                except Exception:
-                    inc.invalidate()
-                    delta = None
-                if delta is not None:
-                    prev = inc.resident(key)
-                    if prev is not None:
-                        try:
-                            refresh_fn, cold = self._refresh_fn(
-                                key, geom, delta.rb, delta.cb, _rebuild,
-                                donated_meta, spec_layout=layout,
-                            )
-                            row_idx, row_n, col_idx, col_n = delta.padded()
-                            screen0 = refresh_fn(
-                                args[0], prev, row_idx, row_n, col_idx, col_n
-                            )
-                            scr_mode = "refresh"
-                            inc.count_refresh()
-                        except Exception:
-                            # refresh dispatch failed (the donated tensor
-                            # may be gone): drop residency but keep the
-                            # staged fingerprints — the fallback full
-                            # tensor below re-adopts them
-                            inc.drop_resident()
-                            inc.count_degraded()
-                            screen0 = None
-            if screen0 is None:
-                screen0 = pre_fn(args[0])
-            if inc is not None:
-                inc.adopt(key, screen0)
+            # window either way. The residency/refresh machinery is shared
+            # with the batched consolidation replan (replan_screen), which
+            # reuses the same resident tensor across its K simulated
+            # re-packs — _dispatch_prescreen has the full story.
+            screen0, scr_mode, cold, delta = self._dispatch_prescreen(
+                staged, pre_fn, raw_args[0], raw_args[9], args[0],
+                cache_hit, layout, screen_mode,
+            )
             if self.profile_phases:
                 jax.block_until_ready(screen0)
             _mark(

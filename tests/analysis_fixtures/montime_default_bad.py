@@ -1,0 +1,18 @@
+"""Seeded monotonic-time-default violations: time.time bound as a
+MODULE-LEVEL function parameter default — evaluated once at import, so a
+clock installed later (fakes, monkeypatches) never reaches the call."""
+import time
+import time as clock_mod
+from time import time as now
+
+
+def lifetime(candidate, clock=time.time):  # BAD: import-time binding
+    return clock() - candidate
+
+
+def scan(cluster, *, clock=clock_mod.time):  # BAD: aliased module, kw-only
+    return clock()
+
+
+def stamp(clock=now):  # BAD: from-import alias
+    return clock()

@@ -1,0 +1,17 @@
+"""The audited injectable-clock spellings the default rule must NOT flag:
+call-time resolution for module-level functions, and constructor-stored
+instance clocks on methods (the convention audited in PR 4)."""
+import time
+
+
+def lifetime(candidate, clock=None):
+    if clock is None:
+        clock = time.time  # reference, resolved at CALL time — fine
+    return clock() - candidate
+
+
+class Controller:
+    # METHOD defaults are exempt: the clock is stored on the instance at
+    # construction, the established injectable-clock convention
+    def __init__(self, clock=time.time):
+        self.clock = clock

@@ -27,8 +27,8 @@ def test_registry_covers_the_documented_rule_set():
     rules = {r for p in all_passes() for r in p.rules}
     assert rules == {
         "trace-safety", "layering", "import-cycle", "env-flags",
-        "monotonic-time", "bare-except", "thread-discipline", "guarded-by",
-        "no-print",
+        "monotonic-time", "monotonic-time-default", "bare-except",
+        "thread-discipline", "guarded-by", "no-print",
     }
 
 

@@ -157,6 +157,26 @@ def test_montime_flags_unallowlisted_site_in_good_file():
     assert [v.line for v in violations] == [16]
 
 
+def test_montime_flags_module_level_function_clock_defaults():
+    """ISSUE 10 satellite: `def f(..., clock=time.time)` at module scope
+    binds the clock AT IMPORT (a later-installed fake never reaches the
+    call) — flagged across every import spelling, positional and
+    keyword-only defaults alike."""
+    violations, _ = run_one(MonotonicTimePass(), "montime_default_bad.py")
+    defaults = [v for v in violations if v.rule == "monotonic-time-default"]
+    assert len(defaults) == 3
+    assert {v.line for v in defaults} == {9, 13, 17}
+    assert all("import" in v.message for v in defaults)
+
+
+def test_montime_default_rule_exempts_call_time_resolution_and_methods():
+    """clock=None resolved at call time, and METHOD defaults (instance
+    clocks stored at construction), stay clean — the pattern
+    deprovisioning/core.lifetime_remaining now uses."""
+    violations, _ = run_one(MonotonicTimePass(), "montime_default_good.py")
+    assert [v for v in violations if v.rule == "monotonic-time-default"] == []
+
+
 # -- concurrency ----------------------------------------------------------
 
 

@@ -1,0 +1,378 @@
+"""Consolidation decision parity (ISSUE 10): the batched candidate-subset
+evaluator must pick Commands the SEQUENTIAL simulator validates, across
+delete / replace / empty / PDB-blocked / priceless-node geometries — and
+its re-pack placements must be byte-identical whether a subset is screened
+inside the vmapped batch or dispatched alone (a vmap-miscompilation guard,
+the same class of bug the GSPMD replication fence caught on the mesh path).
+
+Wired FATALLY into `make verify` (with test_perf_floor/test_screen_parity);
+`make consolidation-smoke` runs the same bar against a live operator.
+"""
+import numpy as np
+import pytest
+
+from karpenter_core_tpu.api.labels import (
+    LABEL_CAPACITY_TYPE,
+    LABEL_NODE_INITIALIZED,
+    PROVISIONER_NAME_LABEL_KEY,
+)
+from karpenter_core_tpu.api.settings import Settings, set_current
+from karpenter_core_tpu.cloudprovider import fake
+from karpenter_core_tpu.controllers.deprovisioning.core import candidate_nodes
+from karpenter_core_tpu.kube.objects import (
+    LABEL_INSTANCE_TYPE_STABLE,
+    LABEL_TOPOLOGY_ZONE,
+    LabelSelector,
+    PodDisruptionBudget,
+    PodDisruptionBudgetSpec,
+    PodDisruptionBudgetStatus,
+)
+from karpenter_core_tpu.operator import new_operator
+from karpenter_core_tpu.solver.tpu_solver import TPUSolver
+from karpenter_core_tpu.testing import FakeClock, make_node, make_pod, make_provisioner
+
+
+@pytest.fixture(autouse=True)
+def _reset_settings():
+    yield
+    set_current(Settings())
+
+
+def build_env(max_nodes=64, types=10):
+    clock = FakeClock()
+    cp = fake.FakeCloudProvider(fake.instance_types(types))
+    op = new_operator(
+        cp, settings=Settings(), solver=TPUSolver(max_nodes=max_nodes),
+        clock=clock,
+    )
+    for d in op.deprovisioning.deprovisioners:
+        d.validation_ttl = 0.0
+    return op, cp, clock
+
+
+def add_keeper(op, cpu="40", pods="200"):
+    op.kube_client.create(make_provisioner(name="static"))
+    keeper = make_node(
+        name="keeper",
+        labels={PROVISIONER_NAME_LABEL_KEY: "static",
+                LABEL_NODE_INITIALIZED: "true"},
+        capacity={"cpu": cpu, "memory": "80Gi", "pods": pods},
+    )
+    op.kube_client.create(keeper)
+    return keeper
+
+
+def add_node(op, clock, name, it_name="fake-it-9", cpu="10", ct="on-demand",
+             pods=1, zone="test-zone-1", pod_requests=None, pod_labels=None):
+    node = make_node(
+        name=name,
+        labels={
+            PROVISIONER_NAME_LABEL_KEY: "default",
+            LABEL_NODE_INITIALIZED: "true",
+            LABEL_INSTANCE_TYPE_STABLE: it_name,
+            LABEL_CAPACITY_TYPE: ct,
+            LABEL_TOPOLOGY_ZONE: zone,
+        },
+        capacity={"cpu": cpu, "memory": "20Gi", "pods": "100"},
+    )
+    node.metadata.creation_timestamp = clock()
+    op.kube_client.create(node)
+    for _ in range(pods):
+        pod = make_pod(
+            requests=pod_requests or {"cpu": "0.1"},
+            node_name=name, unschedulable=False, labels=pod_labels,
+        )
+        pod.status.phase = "Running"
+        op.kube_client.create(pod)
+    return node
+
+
+def get_multi(op):
+    return next(
+        d for d in op.deprovisioning.deprovisioners
+        if type(d).__name__ == "MultiNodeConsolidation"
+    )
+
+
+def get_single(op):
+    return next(
+        d for d in op.deprovisioning.deprovisioners
+        if type(d).__name__ == "SingleNodeConsolidation"
+    )
+
+
+def scan(op, cp, clock, dep):
+    return dep.sort_and_filter_candidates(
+        candidate_nodes(op.cluster, op.kube_client, cp,
+                        dep.should_deprovision, clock)
+    )
+
+
+def assert_subset_batch_parity(op, cp, candidates, subsets):
+    """Every subset's re-pack (per-slot pod counts) must be byte-identical
+    whether screened inside one batched dispatch or dispatched alone —
+    vmap rows are independent by construction, and this pins it."""
+    from karpenter_core_tpu.solver.replan import batched_subset_screen
+
+    multi = get_multi(op)
+    batch, scenario = batched_subset_screen(
+        op.kube_client, op.cluster, multi.provisioning, candidates, subsets,
+        max_nodes=multi.provisioning.solver.max_nodes, want_slots=True,
+    )
+    for subset, screen in zip(subsets, batch):
+        alone, _ = batched_subset_screen(
+            op.kube_client, op.cluster, multi.provisioning, candidates,
+            [subset], max_nodes=multi.provisioning.solver.max_nodes,
+            want_slots=True, scenario=scenario,
+        )
+        assert np.array_equal(screen.pods_per_slot, alone[0].pods_per_slot), (
+            f"subset {subset}: batched re-pack != solo re-pack"
+        )
+        assert (
+            screen.all_scheduled, screen.n_new_machines, screen.conclusive
+        ) == (
+            alone[0].all_scheduled, alone[0].n_new_machines,
+            alone[0].conclusive,
+        )
+    return batch, scenario
+
+
+def assert_sequential_validates(multi, cmd, candidates):
+    assert cmd.action in ("delete", "replace"), cmd.action
+    assert multi.validate_command(cmd, candidates), (
+        "sequential simulator rejected the batched evaluator's command"
+    )
+
+
+# -- geometry families -------------------------------------------------------
+
+
+def test_delete_geometry_ranked_and_validated():
+    op, cp, clock = build_env()
+    op.kube_client.create(
+        make_provisioner(name="default", consolidation_enabled=True)
+    )
+    add_keeper(op)
+    for i in range(6):
+        add_node(op, clock, f"lite-{i}")
+    op.sync_state()
+    multi = get_multi(op)
+    candidates = scan(op, cp, clock, multi)
+    assert len(candidates) == 6
+    cmd = multi.first_n_consolidation_ladder(candidates)
+    assert cmd.action == "delete" and len(cmd.nodes_to_remove) == 6
+    assert_sequential_validates(multi, cmd, candidates)
+    # byte-identical re-pack for the chosen subset (and the whole ladder)
+    sizes = [2, 3, 4, 6]
+    assert_subset_batch_parity(
+        op, cp, candidates, [tuple(range(s)) for s in sizes]
+    )
+
+
+def test_replace_geometry_confirms_through_exact_path():
+    op, cp, clock = build_env()
+    op.kube_client.create(
+        make_provisioner(name="default", consolidation_enabled=True)
+    )
+    add_node(op, clock, "big-1", it_name="fake-it-9", cpu="10")
+    add_node(op, clock, "big-2", it_name="fake-it-4", cpu="5")
+    op.sync_state()
+    multi = get_multi(op)
+    candidates = scan(op, cp, clock, multi)
+    assert len(candidates) == 2
+    cmd = multi.first_n_consolidation_ladder(candidates)
+    assert cmd.action == "replace"
+    assert len(cmd.replacement_machines) == 1
+    assert not cmd.from_screen, "REPLACE must come from the exact path"
+    # strictly cheaper: the price filter survived the exact confirmation
+    names = {it.name for it in cmd.replacement_machines[0].instance_type_options}
+    assert "fake-it-9" not in names
+    assert_sequential_validates(multi, cmd, candidates)
+
+
+def test_empty_subset_rides_along_and_wins():
+    """Two empty candidates among loaded ones: the non-contiguous all-empty
+    subset is screened as its own candidate subset (arbitrary-subset
+    encoding, beyond the prefix ladder)."""
+    from karpenter_core_tpu.solver.replan import batched_subset_screen
+
+    op, cp, clock = build_env()
+    op.kube_client.create(
+        make_provisioner(name="default", consolidation_enabled=True)
+    )
+    # loaded candidates whose pods have nowhere to go (no keeper) + empties
+    for i in range(3):
+        add_node(op, clock, f"loaded-{i}", pods=30, pod_requests={"cpu": "0.3"})
+    add_node(op, clock, "empty-a", pods=0)
+    add_node(op, clock, "empty-b", pods=0)
+    op.sync_state()
+    multi = get_multi(op)
+    candidates = scan(op, cp, clock, multi)
+    assert len(candidates) == 5
+    empty_idx = tuple(
+        i for i, c in enumerate(candidates) if not c.pods
+    )
+    assert len(empty_idx) == 2
+    screens, _sc = batched_subset_screen(
+        op.kube_client, op.cluster, multi.provisioning, candidates,
+        [empty_idx], max_nodes=multi.provisioning.solver.max_nodes,
+    )
+    assert screens[0].all_scheduled and screens[0].n_new_machines == 0
+    from karpenter_core_tpu.obs.flightrec import FLIGHTREC
+
+    FLIGHTREC.enable()
+    try:
+        cmd = multi.first_n_consolidation_ladder(candidates)
+        record = FLIGHTREC.last_consolidation()
+    finally:
+        FLIGHTREC.disable()
+        FLIGHTREC.clear()
+    # the ride-along empty subset was screened as part of the pass (the
+    # arbitrary-subset encoding in production, not just the direct call)
+    assert record is not None
+    assert sorted(empty_idx) in [
+        sorted(s["members"]) for s in record["subsets"]
+    ]
+    # whatever ranked best (the empty delete, or a replace that re-packs
+    # loaded nodes more cheaply), the sequential simulator must agree
+    if cmd.action in ("delete", "replace"):
+        assert_sequential_validates(multi, cmd, candidates)
+
+
+def test_pdb_blocked_candidates_never_enter_commands():
+    op, cp, clock = build_env()
+    op.kube_client.create(
+        make_provisioner(name="default", consolidation_enabled=True)
+    )
+    add_keeper(op)
+    for i in range(4):
+        add_node(op, clock, f"lite-{i}", pod_labels={"app": "guarded"}
+                 if i == 0 else None)
+    pdb = PodDisruptionBudget(
+        spec=PodDisruptionBudgetSpec(
+            selector=LabelSelector(match_labels={"app": "guarded"})
+        ),
+        status=PodDisruptionBudgetStatus(disruptions_allowed=0),
+    )
+    pdb.metadata.name = "guard"
+    pdb.metadata.namespace = "default"
+    op.kube_client.create(pdb)
+    op.sync_state()
+    multi = get_multi(op)
+    candidates = scan(op, cp, clock, multi)
+    assert all(
+        "lite-0" != c.name for c in candidates
+    ), "PDB-blocked node must be filtered before screening"
+    cmd = multi.first_n_consolidation_ladder(candidates)
+    if cmd.action in ("delete", "replace"):
+        assert "lite-0" not in {
+            n.metadata.name for n in cmd.nodes_to_remove
+        }
+        assert_sequential_validates(multi, cmd, candidates)
+
+
+def test_priceless_node_still_deletes_never_misprices():
+    """A candidate whose zone names no live offering has no price: the
+    objective treats it as zero savings (rank-conservative) but the delete
+    branch — which never prices — still works, exactly like the
+    reference's getNodePrices err branch blocks only REPLACE."""
+    op, cp, clock = build_env()
+    op.kube_client.create(
+        make_provisioner(name="default", consolidation_enabled=True)
+    )
+    add_keeper(op)
+    for i in range(3):
+        add_node(op, clock, f"lite-{i}")
+    add_node(op, clock, "priceless", zone="test-zone-9")
+    op.sync_state()
+    multi = get_multi(op)
+    candidates = scan(op, cp, clock, multi)
+    assert len(candidates) == 4
+    from karpenter_core_tpu.controllers.deprovisioning.core import candidate_price
+
+    assert any(candidate_price(c) is None for c in candidates)
+    cmd = multi.first_n_consolidation_ladder(candidates)
+    assert cmd.action == "delete"
+    assert_sequential_validates(multi, cmd, candidates)
+
+
+def test_single_node_ranked_sweep_validates():
+    op, cp, clock = build_env()
+    op.kube_client.create(
+        make_provisioner(name="default", consolidation_enabled=True)
+    )
+    add_keeper(op)
+    for i in range(5):
+        add_node(op, clock, f"lite-{i}")
+    op.sync_state()
+    single = get_single(op)
+    candidates = scan(op, cp, clock, single)
+    order, screens, _sc = single._ranked_candidates(candidates)
+    assert screens is not None and len(screens) == len(candidates)
+    assert all(len(s.subset) == 1 for s in screens)
+    cmd = single.compute_command(candidates)
+    assert cmd.action == "delete" and len(cmd.nodes_to_remove) == 1
+    assert single.validate_command(cmd, candidates)
+
+
+def test_disruption_budget_caps_victims_per_pass():
+    op, cp, clock = build_env()  # installs its own Settings() first
+    set_current(Settings(consolidation_disruption_budget=2))
+    op.kube_client.create(
+        make_provisioner(name="default", consolidation_enabled=True)
+    )
+    add_keeper(op)
+    for i in range(6):
+        add_node(op, clock, f"lite-{i}")
+    op.sync_state()
+    multi = get_multi(op)
+    candidates = scan(op, cp, clock, multi)
+    cmd = multi.first_n_consolidation_ladder(candidates)
+    assert cmd.action == "delete"
+    assert len(cmd.nodes_to_remove) == 2, (
+        "disruption budget must cap victims per pass"
+    )
+    assert_sequential_validates(multi, cmd, candidates)
+
+
+# -- seeded fuzz -------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [11, 23, 47])
+def test_seeded_fuzz_batched_commands_validate_sequentially(seed):
+    """Randomized mixed geometries: whatever the batched evaluator decides
+    must pass sequential-simulator validation, and every screened subset's
+    re-pack must be byte-identical batched vs solo."""
+    rng = np.random.RandomState(seed)
+    op, cp, clock = build_env()
+    op.kube_client.create(
+        make_provisioner(name="default", consolidation_enabled=True)
+    )
+    if rng.rand() < 0.7:
+        add_keeper(op)
+    n_nodes = int(rng.randint(4, 8))
+    for i in range(n_nodes):
+        add_node(
+            op, clock, f"fuzz-{i}",
+            it_name=f"fake-it-{int(rng.randint(3, 10))}",
+            pods=int(rng.randint(0, 3)),
+            pod_requests={"cpu": str(round(float(rng.uniform(0.1, 0.4)), 2))},
+        )
+    op.sync_state()
+    multi = get_multi(op)
+    candidates = scan(op, cp, clock, multi)
+    if len(candidates) < 2:
+        pytest.skip("fuzz draw produced <2 candidates")
+    cmd = multi.first_n_consolidation_ladder(candidates)
+    assert cmd.action in ("delete", "replace", "do-nothing")
+    if cmd.action in ("delete", "replace"):
+        assert_sequential_validates(multi, cmd, candidates)
+    # subset parity over the ladder prefixes + one random subset
+    n = len(candidates)
+    subsets = [tuple(range(s)) for s in sorted({2, max(2, n // 2), n})]
+    random_subset = tuple(
+        sorted(rng.choice(n, size=min(2, n), replace=False).tolist())
+    )
+    if random_subset not in subsets and len(random_subset) >= 1:
+        subsets.append(random_subset)
+    assert_subset_batch_parity(op, cp, candidates, subsets)

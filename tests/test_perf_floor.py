@@ -374,6 +374,80 @@ def test_sharded_programs_respect_bucket_and_cache_budget():
         sharded_mod.MIN_SPLIT_REPLICAS_PER_SHARD = old
 
 
+def test_replan_program_family_budget():
+    """ISSUE 10 tripwire: the batched consolidation replan's candidate
+    axis rides its own fixed bucket ladder (encode.REPLAN_K_BUCKETS), so
+    the replan program family is bounded by
+    len(ladder) x len(REPLAN_K_BUCKETS) — subset counts never mint
+    open-ended geometries. Mixed subset-count dispatches at one solve
+    geometry must share entries per K bucket, and a repeat dispatch must
+    be a cache hit (no new entry)."""
+    import numpy as np
+
+    from karpenter_core_tpu.solver.encode import REPLAN_K_BUCKETS, resolve_ladder
+    from karpenter_core_tpu.solver.prewarm import synthetic_workload
+
+    ladder = resolve_ladder(None)
+    universe = fake.instance_types(4)
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": universe}
+    solver = TPUSolver(max_nodes=48, screen_mode="prescreen")
+    tier = ladder[0]
+    pods, nodes = synthetic_workload(tier, provisioners, its, pods_count=12)
+    snap = solver.encode(pods, provisioners, its, state_nodes=nodes)
+    E = snap.exist_used.shape[0]
+    I_pad = snap.item_pad
+
+    def dispatch(k):
+        count_rows = np.zeros((k, I_pad), np.int32)
+        count_rows[:, 0] = 1
+        exist_open = np.ones((k, E), bool)
+        verdicts, pods_ps = solver.replan_screen(
+            snap, provisioners, count_rows, exist_open
+        )
+        assert verdicts.shape == (k, 4)
+        return verdicts
+
+    for k in (3, 5, 12, 12):  # 3,5 share the K=8 bucket; 12 pads to 16
+        dispatch(k)
+    k_values = {k for (_key, k) in solver._replan_compiled}
+    assert k_values == {8, 16}, f"off-ladder candidate-axis buckets: {k_values}"
+    assert all(k in REPLAN_K_BUCKETS for k in k_values)
+    assert len(solver._replan_compiled) <= len(ladder) * len(REPLAN_K_BUCKETS), (
+        f"replan family minted {len(solver._replan_compiled)} programs > "
+        f"{len(ladder)} tiers x {len(REPLAN_K_BUCKETS)} K-buckets"
+    )
+    # the replan rode the solve path's staging: exactly ONE solve cache
+    # entry (prescreen + never-dispatched solve program), same guard as
+    # test_prescreen_compiled_program_guard
+    assert len(solver._compiled) == 1
+
+
+def test_prewarm_covers_replan_family():
+    """ISSUE 10 satellite: prewarm_snapshot AOT-compiles the batched
+    replan program at the tier's geometry and the smallest candidate-axis
+    bucket, so the first consolidation pass after a restart dispatches a
+    warm program instead of paying the cold XLA compile the
+    solve/prescreen/refresh triple never covered."""
+    from karpenter_core_tpu.solver.encode import REPLAN_K_BUCKETS, resolve_ladder
+    from karpenter_core_tpu.solver.prewarm import synthetic_workload
+
+    ladder = resolve_ladder(None)
+    universe = fake.instance_types(4)
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": universe}
+    solver = TPUSolver(max_nodes=48, screen_mode="prescreen")
+    tier = ladder[0]
+    pods, nodes = synthetic_workload(tier, provisioners, its)
+    snap = solver.encode(pods, provisioners, its, state_nodes=nodes)
+    assert solver.prewarm_snapshot(snap, provisioners) == "compiled"
+    assert len(solver._replan_compiled) == 1
+    ((_key, kp),) = solver._replan_compiled.keys()
+    assert kp == REPLAN_K_BUCKETS[0]
+    fn = next(iter(solver._replan_compiled.values()))
+    assert fn.aot is not None, "prewarm left no AOT replan executable"
+
+
 @perf_gate
 def test_host_fallback_throughput_floor():
     """The host greedy fallback also holds the reference's floor (it IS the

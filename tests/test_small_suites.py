@@ -152,6 +152,16 @@ def test_settings_custom_values():
     assert s.ttl_after_not_registered == 30 * 60.0
 
 
+def test_settings_consolidation_disruption_budget():
+    """ISSUE 10: the victims-per-pass cap parses, defaults to unbounded,
+    and rejects negatives."""
+    assert Settings.from_config_map({}).consolidation_disruption_budget == 0
+    s = Settings.from_config_map({"consolidationDisruptionBudget": "3"})
+    assert s.consolidation_disruption_budget == 3
+    with pytest.raises(ValueError):
+        Settings.from_config_map({"consolidationDisruptionBudget": "-1"})
+
+
 def test_settings_empty_ttl_disables_registration_reaper():
     """suite_test.go:68-84 — an empty ttlAfterNotRegistered nils the TTL
     (settings.go:86-91) rather than failing validation."""

@@ -71,3 +71,53 @@ def test_remote_error_surfaces(server):
     # no pods -> local short-circuit, no crash
     result = client.solve([], [make_provisioner(name="d")], {"d": fake.instance_types(2)})
     assert result.pod_count_new() == 0
+
+
+def test_remote_replan_matches_in_process(server):
+    """ISSUE 10: the Replan RPC runs the same batched subset-evaluation
+    program family as the in-process solver — identical verdicts AND
+    identical per-slot re-pack counts for the same union snapshot and
+    subset planes."""
+    import numpy as np
+
+    from karpenter_core_tpu.solver.tpu_solver import TPUSolver
+    from karpenter_core_tpu.state.node import StateNode
+    from karpenter_core_tpu.testing import make_node
+
+    port, service = server
+    client = RemoteSolver(f"127.0.0.1:{port}", max_nodes=32)
+    assert client.supports_batched_replan
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(4)}
+    pods = [
+        make_pod(labels={"app": f"r{i % 3}"}, requests={"cpu": "0.5"})
+        for i in range(9)
+    ]
+    nodes = [
+        StateNode(node=make_node(
+            name=f"rn-{i}",
+            labels={
+                "karpenter.sh/provisioner-name": "default",
+                "karpenter.sh/initialized": "true",
+            },
+            capacity={"cpu": "8", "memory": "16Gi", "pods": "50"},
+        ))
+        for i in range(3)
+    ]
+    snap = client.encode(pods, provisioners, its, state_nodes=nodes)
+    E = snap.exist_used.shape[0]
+    I_pad = snap.item_pad
+    count_rows = np.zeros((3, I_pad), np.int32)
+    count_rows[:, 0] = (1, 2, 3)
+    exist_open = np.ones((3, E), bool)
+    exist_open[1, 0] = False  # subset 1 "removes" the first existing slot
+    remote_v, remote_p = client.replan_screen(
+        snap, provisioners, count_rows, exist_open, want_slots=True
+    )
+    local = TPUSolver(max_nodes=32)
+    local_v, local_p = local.replan_screen(
+        snap, provisioners, count_rows, exist_open, want_slots=True
+    )
+    assert np.array_equal(remote_v, local_v)
+    assert np.array_equal(remote_p, local_p)
+    assert service.replans >= 1
