@@ -4,7 +4,7 @@
 
 .PHONY: test deflake perf bench verify trace-demo chaos chaos-smoke \
 	replay-demo lint soak soak-smoke prewarm-smoke multichip-smoke \
-	consolidation-smoke
+	consolidation-smoke bench-smoke
 
 test:  ## tier-1 suite (CPU, 8 virtual devices); slow chaos soaks: make chaos
 	python -m pytest tests -q -m "not slow"
@@ -52,6 +52,9 @@ multichip-smoke:  ## virtual 8-device GSPMD parity (byte-identical) + speedup sa
 consolidation-smoke:  ## batched subset evaluator vs sequential simulator on a live operator
 	python hack/consolidation_smoke.py
 
+bench-smoke:  ## tiny CPU resumable round: chaos-wedged stage degrades, --resume backfills
+	python hack/bench_smoke.py
+
 verify:  ## driver hooks: single-chip compile check + 8-way mesh dryrun
 	# force the CPU backend in-process: this image's sitecustomize pins the
 	# axon TPU tunnel (env vars can't override it), and a wedged tunnel
@@ -71,6 +74,10 @@ verify:  ## driver hooks: single-chip compile check + 8-way mesh dryrun
 	# sequential-simulator validation (test_consolidation_parity)
 	python -m pytest tests/test_perf_floor.py tests/test_screen_parity.py \
 		tests/test_consolidation_parity.py -q
+	# wedge-proof supervisor + resumable stage-graph bench (fatal): heartbeat
+	# staleness vs slow, atomic artifact resume, process-group kill, and the
+	# plan/merge graph over a fake round dir (ISSUE 11)
+	python -m pytest tests/test_supervise.py tests/test_bench_resume.py -q
 	# non-fatal smoke: a traced solve must export valid Perfetto JSON
 	-$(MAKE) trace-demo
 	# non-fatal smoke: a flight-recorded solve must replay byte-identically
@@ -91,3 +98,6 @@ verify:  ## driver hooks: single-chip compile check + 8-way mesh dryrun
 	# command the sequential simulator validates, live and in offline
 	# replay (fatal gate lives in presubmit)
 	-$(MAKE) consolidation-smoke
+	# non-fatal smoke: a chaos-wedged bench stage must degrade to a marked
+	# column and --resume must backfill it (fatal gate lives in presubmit)
+	-$(MAKE) bench-smoke

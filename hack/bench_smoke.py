@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""bench-smoke: the wedge drill for the resumable stage-graph bench
+(ISSUE 11 acceptance gate — `make bench-smoke`; non-fatal in `make verify`,
+FATAL in hack/presubmit.sh).
+
+A tiny CPU-only round with TWO stages (headline + consolidation), the
+`solver.device.hang` chaos point armed in the consolidation stage's worker
+(sleep-past-watchdog, the observed tunnel-wedge shape). Asserts the whole
+ISSUE-11 story end to end:
+
+  1. the round COMPLETES (rc 0, one merged JSON line) even though one
+     stage's worker wedged and was hard-killed by the supervisor;
+  2. the wedged stage degrades to a MARKED column — `degraded: true` plus
+     a `wedge_log` carrying the killed worker's env-redacted stderr tail —
+     while every other column (and the full BENCH_r{N} schema) still lands;
+  3. `bench.py --resume <round-dir>` re-runs ONLY the degraded stage (the
+     headline artifact is untouched, byte-for-byte) and backfills the
+     column.
+
+Keeps a persistent compile cache under the system temp dir so repeat smoke
+runs skip the geometry compiles.
+"""
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# tiny geometry: the point is the orchestration, not the numbers
+SMOKE_ENV = {
+    "BENCH_CPU": "1",
+    "BENCH_STAGES": "headline,consolidation",
+    "BENCH_PODS": "200",
+    "BENCH_TYPES": "10",
+    "BENCH_RUNS": "2",
+    "BENCH_DISTINCT": "8",
+    "BENCH_EXISTING": "8",
+    "BENCH_NODES": "256",
+    "BENCH_CONS_NODES": "8",
+    "BENCH_CONS_PODS": "40",
+    "BENCH_CONS_TYPES": "4",
+    # wedge detection must fire in seconds, not the production 600s
+    "BENCH_STAGE_STALE": "30",
+    "BENCH_TOTAL_BUDGET": "900",
+    # repeat smokes share compiled programs (same fixed geometry)
+    "BENCH_COMPILE_CACHE_DIR": os.path.join(
+        tempfile.gettempdir(), "kct-bench-smoke-cache"
+    ),
+}
+# the hang: armed ONLY in the consolidation stage's worker; latency far
+# past the staleness threshold so the supervisor must hard-kill the group
+HANG = "consolidation=solver.device.hang=error:none,latency:600,times:1"
+
+# the merged line must stay schema-complete even with a wedged column
+EXPECTED_EXTRA_KEYS = {
+    "e2e_p50_ms", "e2e_p99_ms", "device_solve_med_ms", "pipelined_p50_ms",
+    "pipelined_p99_ms", "single_call_under_target", "pipelined_under_target",
+    "device_under_target", "runs", "tail", "scheduled_min", "compile_cold_s",
+    "first_solve_warm_s", "warm_restart_cache_verified",
+    "warm_restart_under_2s", "bucket_hit_ratio", "warm_restart",
+    "compiled_programs_after_varied_batches", "solver", "sharded_speedup",
+    "mesh", "multichip", "chips", "backend_probe", "consolidation",
+    "consolidation_xl", "consolidation_under_1s", "config5_multiprov_spot_od",
+    "config_grid_1_2_3", "stages", "round_dir", "orchestrator_probe",
+}
+
+
+def run_bench(round_dir, resume=False, chaos=""):
+    env = dict(os.environ)
+    env.update(SMOKE_ENV)
+    env["BENCH_ROUND_DIR"] = round_dir
+    env.pop("BENCH_STAGE_CHAOS", None)
+    if chaos:
+        env["BENCH_STAGE_CHAOS"] = chaos
+    cmd = [sys.executable, os.path.join(REPO, "bench.py")]
+    if resume:
+        cmd += ["--resume", round_dir]
+    proc = subprocess.run(
+        cmd, env=env, cwd=REPO, capture_output=True, text=True, timeout=900,
+    )
+    sys.stderr.write(proc.stderr[-4000:])
+    line = None
+    for ln in proc.stdout.splitlines():
+        ln = ln.strip()
+        if ln.startswith("{"):
+            try:
+                line = json.loads(ln)
+            except ValueError:
+                continue
+    return proc.returncode, line
+
+
+def main() -> int:
+    failures = []
+
+    def check(cond, what):
+        print(("ok   " if cond else "FAIL ") + what, file=sys.stderr)
+        if not cond:
+            failures.append(what)
+
+    round_dir = tempfile.mkdtemp(prefix="kct-bench-smoke-round-")
+    try:
+        # -- round 1: consolidation's worker wedges (hang chaos armed) ----
+        rc, merged = run_bench(round_dir, chaos=HANG)
+        check(rc == 0, "wedged round still exits 0")
+        check(merged is not None, "wedged round still emits the JSON line")
+        if merged is None:
+            return 1
+        extra = merged.get("extra", {})
+        missing = EXPECTED_EXTRA_KEYS - set(extra)
+        check(not missing, f"merged schema complete (missing: {sorted(missing)})")
+        cons = extra.get("consolidation") or {}
+        check(cons.get("degraded") is True, "wedged stage marked degraded")
+        wlog = cons.get("wedge_log") or {}
+        check(wlog.get("wedged") is True,
+              "wedge_log classifies the kill as a wedge (stale heartbeat)")
+        check(bool(wlog.get("stderr_tail")),
+              "wedge_log carries the killed worker's stderr tail")
+        check("latency" not in json.dumps(extra.get("stages", {})),
+              "chaos spec not leaked into other stages' workers")
+        head = extra.get("stages", {}).get("headline", {})
+        check(head.get("status") == "ok", "headline column landed despite the wedge")
+        check(extra.get("e2e_p99_ms") is not None,
+              "headline e2e numbers present")
+
+        head_artifact = os.path.join(round_dir, "stages", "headline.json")
+        with open(head_artifact, "rb") as f:
+            head_bytes_before = f.read()
+
+        # -- round 2: --resume backfills ONLY the degraded stage ----------
+        rc2, merged2 = run_bench(round_dir, resume=True)
+        check(rc2 == 0, "--resume exits 0")
+        check(merged2 is not None, "--resume emits the merged line")
+        if merged2 is None:
+            return 1
+        extra2 = merged2.get("extra", {})
+        planned = [
+            ln for ln in extra2.get("orchestrator_probe", [])
+            if ln.startswith("stages to run:")
+        ]
+        check(planned == ["stages to run: consolidation"],
+              f"resume re-runs ONLY the degraded stage (planned: {planned})")
+        cons2 = extra2.get("consolidation") or {}
+        check(not cons2.get("degraded"),
+              "degraded column backfilled on resume")
+        check(cons2.get("replan_med_ms") is not None,
+              "backfilled column carries real data")
+        with open(head_artifact, "rb") as f:
+            check(f.read() == head_bytes_before,
+                  "headline artifact untouched by the resume (byte-identical)")
+    finally:
+        shutil.rmtree(round_dir, ignore_errors=True)
+
+    if failures:
+        print(f"bench-smoke UNHEALTHY: {len(failures)} failure(s)",
+              file=sys.stderr)
+        return 1
+    print("bench-smoke ok: wedge degraded one column, resume backfilled it",
+          file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -32,6 +32,9 @@ make multichip-smoke
 echo "== presubmit: make consolidation-smoke (batched evaluator vs sequential simulator)"
 make consolidation-smoke
 
+echo "== presubmit: make bench-smoke (wedged stage degrades, --resume backfills)"
+make bench-smoke
+
 if [[ "${1:-}" != "quick" ]]; then
   echo "== presubmit: short deflake (3 iterations)"
   MAX_ITERS=3 ./hack/deflake.sh

@@ -44,7 +44,7 @@ os.environ.setdefault("KARPENTER_TPU_FLIGHTREC", "1")
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def build_solvers(max_nodes: int):
+def build_solvers(max_nodes: int, hang_armed: bool = False):
     """(primary, resilient): the resilient pair is the operator wiring —
     health-gated greedy fallback, small-batch routing OFF (churn batches
     are small by nature; the soak exists to exercise the device path under
@@ -52,15 +52,28 @@ def build_solvers(max_nodes: int):
     subprocess probe would measure the harness, not the loop). The bare
     primary is returned too so the warmup pass runs through the SAME
     solver instance: geometry programs trace/compile once and the measured
-    window starts fully jitted."""
+    window starts fully jitted.
+
+    With `hang_armed` (the soak-smoke wedge drill) the dispatch watchdog
+    runs at drill scale: a solver.device.hang injection goes heartbeat-
+    stale in ~2s, is abandoned as WEDGED, trips the breaker, and the
+    breaker's half-open prober re-admits the backend ~3s later — the full
+    wedge -> open-breaker -> fallback -> re-admit cycle inside one smoke."""
     from karpenter_core_tpu.solver.fallback import ResilientSolver
     from karpenter_core_tpu.solver.tpu_solver import GreedySolver, TPUSolver
 
     primary = TPUSolver(
         max_nodes=max_nodes, screen_mode="prescreen", profile_phases=True
     )
+    watchdog = {}
+    if hang_armed:
+        watchdog = dict(
+            solve_timeout=10.0, wedge_stale_after=2.0, watchdog_poll=0.2,
+            reprobe_interval=3.0,
+        )
     return primary, ResilientSolver(
-        primary, GreedySolver(), prober=lambda: None, small_batch_work_max=0
+        primary, GreedySolver(), prober=lambda: None, small_batch_work_max=0,
+        **watchdog,
     )
 
 
@@ -110,7 +123,11 @@ def main(argv=None) -> int:
         # re-mint the solve geometry out from under the resident tensor
         initial_nodes=12 if args.smoke else 24,
     )
-    primary, resilient = build_solvers(max_nodes)
+    # the wedge drill rides the SMOKE variant (make soak-smoke): one
+    # solver.device.hang injection mid-soak, detected by heartbeat
+    # staleness, recovered through the breaker's prober-gated half-open
+    hang_armed = args.smoke and not args.no_chaos
+    primary, resilient = build_solvers(max_nodes, hang_armed=hang_armed)
     if not args.no_warmup:
         # virtual-time dress rehearsal of the schedule's opening window,
         # through the SAME primary solver instance: same seed => same pods
@@ -135,6 +152,13 @@ def main(argv=None) -> int:
                   seed=args.seed)
         chaos.arm(chaos.CLOUDPROVIDER_CREATE, error="conn", probability=0.02,
                   seed=args.seed + 1)
+    if hang_armed:
+        # ONE sleep-past-watchdog hang after the loop is in steady state:
+        # the dispatch goes silent for 6s against a 2s staleness
+        # threshold — abandoned as wedged, greedy fallback keeps binding,
+        # backend re-admitted by the breaker's prober trial ~3s later
+        chaos.arm(chaos.SOLVER_DEVICE_HANG, error=None, latency=6.0,
+                  times=1, after=2, seed=args.seed + 2)
 
     driver = SoakDriver(
         config, max_nodes=max_nodes, solver=resilient,
@@ -155,6 +179,46 @@ def main(argv=None) -> int:
     columns = report.as_columns()
     columns["churn_seed"] = args.seed
     columns["churn_chaos_armed"] = not args.no_chaos
+    drill_failures = []
+    if hang_armed:
+        # the wedge drill's own gates: the hang must actually have been
+        # detected as a wedge (not silently absorbed), and the backend
+        # must have been RE-ADMITTED before the end of the soak
+        from karpenter_core_tpu.solver.fallback import (
+            SOLVER_WEDGED_TOTAL,
+            CircuitBreaker,
+        )
+
+        wedged = SOLVER_WEDGED_TOTAL.get() or 0.0
+        hang_fault = chaos.armed_points().get(chaos.SOLVER_DEVICE_HANG)
+        injected = hang_fault.injected if hang_fault is not None else 0
+        if injected == 0:
+            drill_failures.append(
+                "solver.device.hang never fired (drill vacuous)"
+            )
+        elif wedged < 1:
+            drill_failures.append(
+                "hang injected but karpenter_solver_wedged_total never ticked"
+            )
+        elif resilient.breaker.state != CircuitBreaker.CLOSED:
+            drill_failures.append(
+                f"backend not re-admitted after the wedge cleared "
+                f"(breaker {resilient.breaker.state})"
+            )
+        elif resilient._healthy is not True:
+            drill_failures.append("solver still unhealthy after wedge recovery")
+        columns["churn_wedge_drill"] = {
+            "injected": injected,
+            "wedged_total": wedged,
+            "abandoned": resilient._abandon_count,
+            "readmitted": not drill_failures,
+        }
+        print(
+            f"soak wedge drill: injected={injected} wedged={wedged:.0f} "
+            f"abandoned={resilient._abandon_count} "
+            f"readmitted={not drill_failures}",
+            file=sys.stderr,
+        )
     line = json.dumps(columns, sort_keys=True)
     print(line)
     if args.out:
@@ -172,6 +236,7 @@ def main(argv=None) -> int:
         failures.append(f"{report.unbound_at_end} pods stranded unbound")
     if report.inc_outcomes.get("refresh", 0) == 0:
         failures.append("incremental delta re-solve never engaged")
+    failures.extend(drill_failures)
     if failures:
         print("soak UNHEALTHY: " + "; ".join(failures), file=sys.stderr)
         return 1

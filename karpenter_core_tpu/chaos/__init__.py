@@ -63,6 +63,12 @@ KUBE_TRANSPORT = "kube.transport"
 CLOUDPROVIDER_CREATE = "cloudprovider.create"
 SOLVER_RPC = "solver.rpc"
 SOLVER_DEVICE = "solver.device"
+# the wedge shape (ISSUE 11): the dispatch HANGS instead of erroring — arm
+# with error:none + latency past the watchdog (sleep-past-watchdog) so the
+# wedge -> open-breaker -> fallback -> re-admit cycle is drivable in-process
+# and in the soak harness; the sleeping thread wakes harmlessly later, which
+# is exactly the abandoned-thread shape the supervisor accounting names
+SOLVER_DEVICE_HANG = "solver.device.hang"
 STATE_WATCH = "state.watch"
 # the state-store delta feed the incremental solve path gates on
 # (state.Cluster.changes_since): an injected fault models dropped or
@@ -75,6 +81,7 @@ KNOWN_POINTS = (
     CLOUDPROVIDER_CREATE,
     SOLVER_RPC,
     SOLVER_DEVICE,
+    SOLVER_DEVICE_HANG,
     STATE_WATCH,
     STATE_DIFF,
 )

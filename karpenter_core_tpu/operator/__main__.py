@@ -155,12 +155,29 @@ def _debug_backend() -> str:
 
 class _HealthHandler(BaseHTTPRequestHandler):
     operator = None  # set by serve_health
+    solver = None  # the ResilientSolver, when the wiring passes it
     profiling_enabled = False  # set from KARPENTER_ENABLE_PROFILING
 
     def do_GET(self):
         if self.path == "/metrics":
             body = REGISTRY.expose().encode() + b"\n"
             ctype = "text/plain; version=0.0.4"
+        elif self.path == "/debug/health":
+            # wedge observability (ISSUE 11): dispatch heartbeat age,
+            # breaker state, wedge history, abandoned-thread inventory.
+            # Deliberately NOT profiling-gated — this is the first thing
+            # an operator curls when provisioning degrades.
+            report = None
+            solver = self.solver
+            if solver is not None and hasattr(solver, "health_report"):
+                report = solver.health_report()
+            status = "ok"
+            if report is not None and report.get("healthy") is False:
+                status = "degraded"
+            body = json.dumps(
+                {"status": status, "solver": report}, sort_keys=True
+            ).encode() + b"\n"
+            ctype = "application/json"
         elif self.path == "/debug/trace" and self.profiling_enabled:
             # Chrome trace-event JSON of the solve-path ring buffer: save
             # and load in Perfetto (ui.perfetto.dev) or chrome://tracing
@@ -251,8 +268,10 @@ class _HealthHandler(BaseHTTPRequestHandler):
         pass
 
 
-def serve_health(operator, port: int, profiling: bool = False) -> ThreadingHTTPServer:
+def serve_health(operator, port: int, profiling: bool = False,
+                 solver=None) -> ThreadingHTTPServer:
     _HealthHandler.operator = operator
+    _HealthHandler.solver = solver
     # opt-in debug handlers, like the reference's --enable-profiling pprof
     # registration (operator.go:124-126)
     _HealthHandler.profiling_enabled = profiling
@@ -322,7 +341,20 @@ def run(cloud_provider, kube_client=None, stop_event=None, options=None):
     from karpenter_core_tpu.solver.fallback import ResilientSolver
     from karpenter_core_tpu.solver.tpu_solver import GreedySolver
 
-    solver = ResilientSolver(primary, GreedySolver(), solve_timeout=900.0)
+    # wedge detection rides the dispatch watchdog: the solver's phase marks
+    # touch a heartbeat; 600s of silence (longer than any prewarmed-path
+    # compile) is a wedge — abandoned early, breaker open, re-admission
+    # gated by the out-of-band probe (solver/fallback.py, ISSUE 11).
+    # IN-PROCESS primaries only: a RemoteSolver's dispatch blocks in one
+    # RPC with no client-side phase marks, so heartbeat staleness would
+    # misread every long remote solve as a wedge — the remote deployment's
+    # wedge detection lives SERVER-side (the service's per-RPC dispatch
+    # heartbeats + the Health RPC's wedged status, which the prober sees).
+    is_remote = callable(getattr(primary, "health", None))
+    solver = ResilientSolver(
+        primary, GreedySolver(), solve_timeout=900.0,
+        wedge_stale_after=None if is_remote else 600.0,
+    )
     settings = resolve_settings(kube_client, opts)
     # context-carried config bootstrap (injection.go:116-127)
     from karpenter_core_tpu.operator.injection import inject_defaults
@@ -346,7 +378,10 @@ def run(cloud_provider, kube_client=None, stop_event=None, options=None):
     from karpenter_core_tpu.utils.gctuning import apply_server_gc_tuning
 
     apply_server_gc_tuning()
-    health = serve_health(operator, opts.metrics_port, profiling=opts.enable_profiling)
+    health = serve_health(
+        operator, opts.metrics_port, profiling=opts.enable_profiling,
+        solver=solver,
+    )
     stop = stop_event or threading.Event()
     try:
         for sig in (signal.SIGTERM, signal.SIGINT):

@@ -13,9 +13,19 @@ defense into the PRODUCTION solve path, per the round-2 verdict:
     backend re-probes for recovery, and a healthy verdict expires so a
     mid-life wedge is detected between solves;
   - optionally, each primary solve runs under a thread watchdog
-    (solve_timeout): a solve that hangs in-process is abandoned (the
-    thread leaks by design — better one leaked thread than a stalled
-    control plane) and the solver degrades;
+    (solve_timeout) with a HEARTBEAT (utils/supervise.ThreadHeartbeat,
+    touched by the solver's phase marks): a dispatch whose heartbeat goes
+    stale is WEDGED and abandoned early — distinct from slow-but-alive,
+    which gets its whole budget. The abandoned thread still leaks by
+    design (better one leaked thread than a stalled control plane), but
+    it is now NAMED (`primary-solve-abandoned-N-<kind>`), counted
+    (karpenter_solver_abandoned_total), and kept for /debug/health;
+  - a wedge opens the device circuit breaker IMMEDIATELY (no waiting for
+    the next reprobe interval) and bumps karpenter_solver_wedged_total;
+    re-admission is gated by the out-of-band prober — the breaker's
+    half-open trial runs the subprocess probe_backend / Health RPC, never
+    a live solve, so a still-wedged backend costs a probe timeout, not a
+    stalled reconcile;
   - while unhealthy, Solve() routes to the fallback solver (GreedySolver),
     publishes a deduped event, and bumps karpenter_solver_fallback_total.
 
@@ -26,16 +36,19 @@ stall, operator.go:154-169).
 """
 from __future__ import annotations
 
+import itertools
 import subprocess
 import sys
 import threading
 import time
+from collections import deque
 from typing import Optional
 
 from karpenter_core_tpu.events import Event
 from karpenter_core_tpu.metrics.registry import NAMESPACE, REGISTRY
 from karpenter_core_tpu.obs.flightrec import FLIGHTREC, recording_suppressed
 from karpenter_core_tpu.obs.log import get_logger
+from karpenter_core_tpu.utils import supervise
 
 LOG = get_logger("karpenter.solver.fallback")
 
@@ -59,6 +72,21 @@ BREAKER_OPEN = REGISTRY.gauge(
     f"{NAMESPACE}_circuit_breaker_open",
     "1 while the named circuit breaker is open (fast-failing), else 0",
 )
+SOLVER_WEDGED_TOTAL = REGISTRY.counter(
+    f"{NAMESPACE}_solver_wedged_total",
+    "Device dispatches abandoned because their heartbeat went stale (the "
+    "backend wedged mid-dispatch, distinct from slow-but-alive timeouts)",
+)
+SOLVER_ABANDONED_TOTAL = REGISTRY.counter(
+    f"{NAMESPACE}_solver_abandoned_total",
+    "Primary-solve worker threads abandoned by the dispatch watchdog, by "
+    "kind (wedged = heartbeat stale, timeout = budget exceeded while alive)",
+)
+
+
+class SolverWedgedError(TimeoutError):
+    """The in-flight device dispatch stopped making progress (heartbeat
+    staleness), as opposed to merely exceeding its budget while alive."""
 
 
 class CircuitBreaker:
@@ -139,6 +167,14 @@ class CircuitBreaker:
                 self._transition(self.OPEN)
                 self._opened_at = self.clock()
 
+    def trip(self) -> None:
+        """Open IMMEDIATELY, regardless of the consecutive-failure count —
+        a wedged dispatch is definitive evidence, not one vote of three."""
+        with self._mu:
+            self._failures = max(self._failures, self.failure_threshold)
+            self._transition(self.OPEN)
+            self._opened_at = self.clock()
+
 
 def probe_backend(timeout: float = 60.0) -> Optional[str]:
     """Probe local accelerator init in a subprocess. Returns None when
@@ -194,7 +230,9 @@ class ResilientSolver:
                  probe_timeout: float = 60.0, reprobe_interval: float = 300.0,
                  healthy_recheck_interval: float = 600.0,
                  solve_timeout: Optional[float] = None, prober=None,
-                 small_batch_work_max: int = 20_000):
+                 small_batch_work_max: int = 20_000,
+                 wedge_stale_after: Optional[float] = None,
+                 watchdog_poll: float = 1.0):
         self.primary = primary
         self.fallback = fallback
         self.recorder = recorder
@@ -203,11 +241,29 @@ class ResilientSolver:
         self.reprobe_interval = reprobe_interval
         self.healthy_recheck_interval = healthy_recheck_interval
         self.solve_timeout = solve_timeout
+        # heartbeat staleness threshold for the dispatch watchdog: the
+        # solver's phase marks touch the heartbeat, so the longest LEGIT
+        # silent stretch is a cold compile — size the threshold above it
+        # (the operator passes 600s; prewarm makes live cold compiles rare)
+        self.wedge_stale_after = wedge_stale_after
+        self.watchdog_poll = watchdog_poll
         self.prober = prober or (lambda: probe_for(primary, probe_timeout))
         self.small_batch_work_max = small_batch_work_max
         self._healthy: Optional[bool] = None
         self._last_probe = 0.0
         self._reason = ""
+        # the device-dispatch circuit breaker: tripped open on wedge or
+        # abandonment, re-admitted ONLY through the out-of-band prober (its
+        # half-open trial is a probe, never a live solve)
+        self.breaker = CircuitBreaker(
+            name="solver.device", reset_timeout=reprobe_interval, clock=clock,
+        )
+        # post-mortem surfaces for /debug/health
+        self.wedge_history: deque = deque(maxlen=32)
+        self._abandoned: deque = deque(maxlen=16)
+        self._abandon_count = 0
+        self._abandon_seq = itertools.count(1)
+        self._last_hb: Optional[supervise.ThreadHeartbeat] = None
         # serializes the probe + verdict write (concurrent controller
         # threads share one probe instead of racing subprocess probes)
         self._verdict_lock = threading.Lock()
@@ -228,6 +284,36 @@ class ResilientSolver:
 
     def healthy(self) -> bool:
         with self._verdict_lock:
+            # wedge gate first: while the device breaker is OPEN every
+            # caller fast-fails to the fallback — no probe, no TTL math.
+            # When the breaker half-opens, the one admitted trial is the
+            # OUT-OF-BAND PROBER (subprocess probe / Health RPC), never a
+            # live solve: re-admission is gated on proof the backend came
+            # back, and a still-wedged backend costs one probe timeout.
+            state = self.breaker.state
+            if state == CircuitBreaker.OPEN:
+                return False
+            if state == CircuitBreaker.HALF_OPEN:
+                if not self.breaker.allow():
+                    return False  # another thread holds the trial slot
+                self._last_probe = self.clock()
+                reason = self.prober()
+                self._healthy = reason is None
+                self._reason = reason or ""
+                if self._healthy:
+                    self.breaker.record_success()
+                    LOG.info("solver recovered from wedge", probe="backend")
+                    self._event("SolverRecovered", "Normal",
+                                "accelerator backend recovered after wedge")
+                else:
+                    # allow() already re-opened the TTL window; count the
+                    # failed trial so the transition log tells the story
+                    self.breaker.record_failure()
+                    LOG.warning(
+                        "wedge re-admission probe failed",
+                        reason=self._reason, probe="backend",
+                    )
+                return bool(self._healthy)
             # re-check under the lock: a concurrent caller may have just
             # refreshed the verdict while this thread waited
             if self._stale():
@@ -281,6 +367,56 @@ class ResilientSolver:
         except BaseException:
             self._probe_gate.release()
             raise
+
+    def _mark_wedged(self, reason: str, kind: str = "wedged") -> None:
+        """Abandonment path (wedge OR slow-timeout): mark the backend dead
+        AND trip the device breaker open immediately — re-admission now
+        runs through the breaker's half-open prober trial, not the plain
+        reprobe TTL, so a wedged backend is never handed a live solve to
+        prove itself with."""
+        with self._verdict_lock:
+            self._healthy = False
+            self._last_probe = self.clock()
+            self._reason = reason
+            if kind == "wedged":
+                SOLVER_WEDGED_TOTAL.inc()
+            self.breaker.trip()
+            hb = self._last_hb
+            self.wedge_history.append({
+                "ts": self.clock(),
+                "kind": kind,
+                "reason": reason[:200],
+                "heartbeat_age_s": (
+                    round(hb.age(), 1)
+                    if hb is not None and hb.age() is not None else None
+                ),
+            })
+        LOG.warning("solver wedged", reason=reason, kind=kind, probe="solve")
+        self._event("SolverWedged", "Warning",
+                    f"device dispatch {kind} ({reason}); breaker open, "
+                    "falling back to the host solver until a probe passes")
+
+    def health_report(self) -> dict:
+        """The /debug/health payload: heartbeat age of the most recent
+        dispatch, breaker state, wedge history, and the abandoned-thread
+        inventory. Reads only — no probe is triggered."""
+        hb = self._last_hb
+        age = hb.age() if hb is not None else None
+        with self._verdict_lock:
+            return {
+                "healthy": self._healthy,
+                "reason": self._reason,
+                "breaker": self.breaker.state,
+                "heartbeat_age_s": round(age, 3) if age is not None else None,
+                "solve_timeout_s": self.solve_timeout,
+                "wedge_stale_after_s": self.wedge_stale_after,
+                "wedge_history": list(self.wedge_history),
+                "abandoned_total": self._abandon_count,
+                "abandoned_threads": [
+                    {"name": t.name, "alive": t.is_alive()}
+                    for t in self._abandoned
+                ],
+            }
 
     def _mark_dead(self, reason: str) -> None:
         # under the verdict lock: a background probe completing after a
@@ -347,8 +483,14 @@ class ResilientSolver:
             return self.primary.solve(*args, **kwargs)
         box = {}
         done = threading.Event()
+        hb = supervise.ThreadHeartbeat()
+        self._last_hb = hb
 
         def run():
+            # bind the heartbeat into this thread: the solver's phase
+            # marks (TPUSolver._mark) touch it as the dispatch progresses
+            supervise.bind_heartbeat(hb)
+            hb.touch()
             try:
                 box["result"] = self.primary.solve(*args, **kwargs)
             except BaseException as e:  # noqa: BLE001 — surfaced below
@@ -358,14 +500,55 @@ class ResilientSolver:
 
         t = threading.Thread(target=run, daemon=True, name="primary-solve")
         t.start()
-        if not done.wait(self.solve_timeout):
-            # the thread leaks with the wedged call — by design
-            raise TimeoutError(
-                f"primary solve exceeded {self.solve_timeout:.0f}s watchdog"
-            )
+        deadline = time.monotonic() + self.solve_timeout
+        while True:
+            remaining = deadline - time.monotonic()
+            if done.wait(min(self.watchdog_poll, max(0.02, remaining))):
+                break
+            age = hb.age()
+            if (
+                self.wedge_stale_after is not None
+                and age is not None
+                and age >= self.wedge_stale_after
+            ):
+                # stale heartbeat = the dispatch stopped making progress:
+                # a WEDGE, abandoned before the budget burns down
+                self._abandon(t, "wedged", age)
+                raise SolverWedgedError(
+                    f"primary solve heartbeat stale for {age:.0f}s "
+                    f"(threshold {self.wedge_stale_after:.0f}s): "
+                    "backend wedged mid-dispatch"
+                )
+            if time.monotonic() >= deadline:
+                # alive (heartbeat fresh) but over budget: slow, not
+                # wedged — the thread still leaks with the running call
+                self._abandon(t, "timeout", age)
+                raise TimeoutError(
+                    f"primary solve exceeded {self.solve_timeout:.0f}s "
+                    "watchdog"
+                )
         if "error" in box:
             raise box["error"]
         return box["result"]
+
+    def _abandon(self, t: threading.Thread, kind: str,
+                 heartbeat_age: Optional[float]) -> None:
+        """Account for the thread the watchdog is about to leak: NAME it
+        (the thread-discipline rule — an anonymous zombie in a thread dump
+        is undiagnosable), keep a bounded reference for /debug/health, and
+        count it. The leak itself stays by design; what was a silent
+        degradation is now an inventory."""
+        n = next(self._abandon_seq)
+        t.name = f"primary-solve-abandoned-{n}-{kind}"
+        self._abandon_count = n
+        self._abandoned.append(t)
+        SOLVER_ABANDONED_TOTAL.inc({"kind": kind})
+        LOG.warning(
+            "primary solve thread abandoned", kind=kind, thread=t.name,
+            heartbeat_age_s=(
+                round(heartbeat_age, 1) if heartbeat_age is not None else None
+            ),
+        )
 
     def _small_batch(self, pods, instance_types) -> bool:
         if self.small_batch_work_max <= 0:
@@ -462,7 +645,18 @@ class ResilientSolver:
                 error=type(e).__name__, error_detail=str(e),
                 pods=len(pods),
             )
-            if getattr(e, "marks_unhealthy", True):
+            if isinstance(e, SolverWedgedError):
+                # heartbeat staleness: wedge — breaker opens now, the
+                # prober gates re-admission (no waiting out a reprobe TTL
+                # with live solves as the trial balloons)
+                self._mark_wedged(f"{type(e).__name__}: {e}", kind="wedged")
+                SOLVER_FALLBACK_TOTAL.inc({"reason": "wedged"})
+            elif isinstance(e, TimeoutError):
+                # watchdog abandonment (slow, not wedged): the leaked
+                # thread is real either way — same immediate breaker trip
+                self._mark_wedged(f"{type(e).__name__}: {e}", kind="timeout")
+                SOLVER_FALLBACK_TOTAL.inc({"reason": "primary_error"})
+            elif getattr(e, "marks_unhealthy", True):
                 self._mark_dead(f"{type(e).__name__}: {e}")
                 SOLVER_FALLBACK_TOTAL.inc({"reason": "primary_error"})
             else:

@@ -14,6 +14,7 @@ messages come from service.proto via protoc.
 """
 from __future__ import annotations
 
+import contextlib
 import copy
 import json
 import threading
@@ -39,6 +40,7 @@ from karpenter_core_tpu.solver.tpu_solver import (
     make_device_run,
     solve_geometry,
 )
+from karpenter_core_tpu.utils import supervise
 
 SERVICE = "karpenter.solver.v1.Solver"
 
@@ -256,6 +258,40 @@ class SolverService:
         self.MAX_REPLAN = 16
         self._replan_compiled = OrderedDict()
         self.replans = 0
+        # in-flight dispatch heartbeats (utils/supervise): each Solve/Replan
+        # RPC binds a ThreadHeartbeat the TPUSolver phase marks touch; the
+        # Health RPC reads the oldest age and reports "wedged" past the
+        # threshold, so a control plane probing a service whose XLA runtime
+        # hung mid-dispatch learns about it WITHOUT issuing a live solve
+        self.wedge_stale_after = 600.0
+        self._inflight_mu = threading.Lock()
+        self._inflight: Dict[int, supervise.ThreadHeartbeat] = {}
+        self._inflight_seq = 0
+
+    @contextlib.contextmanager
+    def _dispatch_heartbeat(self):
+        """Register a heartbeat for the calling RPC thread's dispatch:
+        TPUSolver's phase marks touch it; health() reads the inventory.
+        Unregistered on every exit."""
+        hb = supervise.ThreadHeartbeat()
+        hb.touch()
+        with self._inflight_mu:
+            self._inflight_seq += 1
+            token = self._inflight_seq
+            self._inflight[token] = hb
+        supervise.bind_heartbeat(hb)
+        try:
+            yield hb
+        finally:
+            supervise.bind_heartbeat(None)
+            with self._inflight_mu:
+                self._inflight.pop(token, None)
+
+    def _stalest_dispatch_age(self) -> Optional[float]:
+        with self._inflight_mu:
+            ages = [hb.age() for hb in self._inflight.values()]
+        ages = [a for a in ages if a is not None]
+        return max(ages) if ages else None
 
     def solve(self, request: pb.SolveRequest, context=None) -> pb.SolveResponse:
         # adopt the client's propagated trace id (metadata interceptor
@@ -274,7 +310,8 @@ class SolverService:
             tensors=len(request.tensors),
         ):
             try:
-                return self._solve_traced(request)
+                with self._dispatch_heartbeat():
+                    return self._solve_traced(request)
             except Exception as e:  # noqa: BLE001 — mapped to a status code
                 code_name, msg = classify_exception(e)
                 if context is not None:
@@ -443,7 +480,8 @@ class SolverService:
             tensors=len(request.tensors),
         ):
             try:
-                return self._replan_traced(request)
+                with self._dispatch_heartbeat():
+                    return self._replan_traced(request)
             except Exception as e:  # noqa: BLE001 — mapped to a status code
                 code_name, msg = classify_exception(e)
                 if context is not None:
@@ -705,6 +743,21 @@ class SolverService:
         return layout_for(self.mesh)
 
     def health(self, request: pb.HealthRequest, context=None) -> pb.HealthResponse:
+        # wedge gate FIRST, before anything touches jax: a dispatch whose
+        # heartbeat went stale means the XLA runtime hung mid-call — a
+        # fresh jax query from this thread could hang the Health RPC too.
+        # The status string carries the verdict (the proto stays as-is);
+        # RemoteSolver.health raises on a non-ok status, which is how the
+        # ResilientSolver's out-of-band prober learns the service wedged.
+        age = self._stalest_dispatch_age()
+        if age is not None and age >= self.wedge_stale_after:
+            return pb.HealthResponse(
+                status=(
+                    f"wedged: dispatch heartbeat stale for {age:.0f}s "
+                    f"(threshold {self.wedge_stale_after:.0f}s)"
+                ),
+                device="", solves=self.solves,
+            )
         import jax
 
         device = jax.devices()[0].device_kind
@@ -816,6 +869,14 @@ class RemoteSolver:
         except Exception:
             self.breaker.record_failure()
             raise
+        if response.status != "ok":
+            # the server answered but reported itself wedged (a hung
+            # in-flight dispatch): NOT healthy — the prober must keep the
+            # backend out until the wedge clears
+            self.breaker.record_failure()
+            raise SolverUnavailableError(
+                f"solver service unhealthy: {response.status}"
+            )
         self.breaker.record_success()
         return response
 

@@ -33,6 +33,7 @@ from karpenter_core_tpu.obs import TRACER, device_profiler, profile_dir
 from karpenter_core_tpu.scheduling.requirements import Requirements
 from karpenter_core_tpu.solver.encode import EncodedSnapshot, ReqSetArrays, encode_snapshot
 from karpenter_core_tpu.utils import resources as resources_util
+from karpenter_core_tpu.utils import supervise
 
 
 @dataclass
@@ -1304,6 +1305,9 @@ class TPUSolver:
         from karpenter_core_tpu.utils.compilecache import record_lookup
 
         chaos.maybe_fail(chaos.SOLVER_DEVICE)
+        # hang-shaped chaos (sleep-past-watchdog): models the wedge, where
+        # the dispatch stops progressing instead of erroring
+        chaos.maybe_fail(chaos.SOLVER_DEVICE_HANG)
         phases = self.last_replan_phase_ms = {}
         t_phase = _time.perf_counter_ns()
 
@@ -1314,6 +1318,9 @@ class TPUSolver:
             TRACER.add_span(f"solver.phase.replan.{name}", t_phase, now,
                             **attrs)
             t_phase = now
+            # progress proof for the dispatch watchdog (ResilientSolver /
+            # bench stage supervisor): a wedged dispatch stops marking
+            supervise.touch_heartbeat()
 
         screen_mode = self.screen_mode or ops_compat.resolve_screen_mode()
         # single-device deliberately: the candidate axis is a vmap over the
@@ -1632,6 +1639,10 @@ class TPUSolver:
         # wedged-backend failure that cost two bench rounds, and must route
         # the solve to ResilientSolver's fallback, never stall the loop
         chaos.maybe_fail(chaos.SOLVER_DEVICE)
+        # hang-shaped chaos (sleep-past-watchdog): the wedge failure mode —
+        # the dispatch goes silent, the heartbeat goes stale, and the
+        # ResilientSolver watchdog must abandon + trip the breaker
+        chaos.maybe_fail(chaos.SOLVER_DEVICE_HANG)
 
         phases = self.last_phase_ms = {}
         t_phase = _time.perf_counter_ns()
@@ -1644,6 +1655,9 @@ class TPUSolver:
             phases[name] = round((now - t_phase) / 1e6, 1)
             TRACER.add_span(f"solver.phase.{name}", t_phase, now, **attrs)
             t_phase = now
+            # progress proof for the dispatch watchdog (ResilientSolver /
+            # bench stage supervisor): a wedged dispatch stops marking
+            supervise.touch_heartbeat()
 
         from karpenter_core_tpu.ops import compat as ops_compat
 

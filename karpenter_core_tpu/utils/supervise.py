@@ -1,0 +1,520 @@
+"""Wedge-proof execution supervisor — shared by the bench stage graph and
+the operator's device-dispatch watchdog (ISSUE 11 tentpole).
+
+The accelerator tunnel's observed failure mode is a HANG, not an error:
+every bench round since r03 lost its TPU number to a wedged probe, and the
+operator's `solve_timeout` thread watchdog could only *abandon* a hung
+in-process dispatch. This module is the common machinery both paths now
+stand on:
+
+  * ``Heartbeat`` — a FILE a supervised worker touches as it makes
+    progress. Staleness is the wedge signal, and it is DISTINCT from slow:
+    a worker that is still touching its heartbeat is alive (let it spend
+    its budget); one that stopped touching is wedged (kill it now, don't
+    burn the rest of the budget waiting).
+  * ``ThreadHeartbeat`` — the in-process twin (monotonic clock, no file)
+    the ResilientSolver watchdog reads while a device dispatch runs on a
+    worker thread; the solver's phase marks touch it via the thread-local
+    ``touch_heartbeat()`` hook.
+  * ``run_supervised`` — run a command in its OWN process group under a
+    hard-kill watchdog (SIGKILL the whole group, so a grandchild holding a
+    pipe or a forked helper cannot outlive the kill), with heartbeat-based
+    wedge detection, bounded restart-with-backoff, and 8KB env-redacted
+    output tails for the post-mortem (`extra.wedge_log`).
+  * ``ArtifactStore`` — atomic (write-temp-rename) per-unit-of-work JSON
+    artifacts, content-keyed by a config digest, so an interrupted run
+    RESUMES instead of restarting: a fresh artifact whose digest matches
+    the requested config is done; anything missing, degraded, or produced
+    on a fallback backend is re-runnable.
+  * ``write_verdict``/``read_verdict`` — the TTL'd verdict file an
+    out-of-band health daemon publishes so consumers (bench stages) can
+    skip straight to a fallback without each paying a probe timeout.
+
+Everything here is stdlib-only and jax-free: the supervisor must keep
+working precisely when the accelerator stack is wedged.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import signal
+import subprocess
+import tempfile
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+# ---------------------------------------------------------------------------
+# heartbeats
+
+
+class Heartbeat:
+    """File-based heartbeat: the worker calls touch() at progress points;
+    the supervisor reads age(). The file's mtime is the signal — wall
+    clock, because worker and supervisor are different processes and the
+    filesystem is the only clock they share."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def touch(self) -> None:
+        with open(self.path, "a"):
+            os.utime(self.path, None)
+
+    def age(self) -> Optional[float]:
+        """Seconds since the last touch, or None when never touched."""
+        try:
+            mtime = os.stat(self.path).st_mtime
+        except OSError:
+            return None
+        return max(0.0, time.time() - mtime)
+
+
+class ThreadHeartbeat:
+    """In-process heartbeat for thread watchdogs (ResilientSolver): the
+    dispatch thread touches it at phase boundaries, the watchdog thread
+    reads the age. Monotonic by default; `clock` is injectable for tests."""
+
+    def __init__(self, clock: Optional[Callable[[], float]] = None):
+        self._clock = clock or time.monotonic
+        self._mu = threading.Lock()
+        self._last: Optional[float] = None
+
+    def touch(self) -> None:
+        with self._mu:
+            self._last = self._clock()
+
+    def age(self) -> Optional[float]:
+        with self._mu:
+            if self._last is None:
+                return None
+            return max(0.0, self._clock() - self._last)
+
+
+# thread-local heartbeat binding: the watchdog binds a heartbeat into the
+# worker thread it spawns; deep call sites (TPUSolver phase marks) touch it
+# without plumbing the object through every signature. Unbound threads
+# no-op — the hook is safe on every path.
+_TLS = threading.local()
+
+
+def bind_heartbeat(hb: Optional[ThreadHeartbeat]) -> None:
+    _TLS.heartbeat = hb
+
+
+def touch_heartbeat() -> None:
+    hb = getattr(_TLS, "heartbeat", None)
+    if hb is not None:
+        hb.touch()
+
+
+def bound_heartbeat() -> Optional[ThreadHeartbeat]:
+    return getattr(_TLS, "heartbeat", None)
+
+
+# ---------------------------------------------------------------------------
+# output redaction + tails
+
+_SENSITIVE_MARKERS = ("KEY", "TOKEN", "SECRET", "PASSWORD", "CREDENTIAL",
+                      "AUTH", "COOKIE")
+
+
+def redact_env_text(text: str, environ: Optional[Dict[str, str]] = None) -> str:
+    """Scrub environment-variable VALUES out of captured worker output
+    before it is persisted into an artifact: any env var whose name looks
+    sensitive has its value replaced by ``<redacted:NAME>``. Values under
+    6 chars are skipped (too short to be a secret, too likely to collide
+    with ordinary text)."""
+    if environ is None:
+        from karpenter_core_tpu.obs import envflags
+
+        environ = envflags.environ()
+    for name, value in environ.items():
+        if not value or len(value) < 6:
+            continue
+        upper = name.upper()
+        if any(marker in upper for marker in _SENSITIVE_MARKERS):
+            text = text.replace(value, f"<redacted:{name}>")
+    return text
+
+
+def tail_bytes_of(path: str, n: int = 8192) -> str:
+    """Last n bytes of a file, decoded leniently ('' when unreadable)."""
+    try:
+        size = os.path.getsize(path)
+        with open(path, "rb") as f:
+            if size > n:
+                f.seek(size - n)
+            return f.read(n).decode("utf-8", errors="replace")
+    except OSError:
+        return ""
+
+
+# ---------------------------------------------------------------------------
+# the process-group supervisor
+
+
+@dataclass
+class SuperviseResult:
+    """Outcome of one supervised command (after any restarts).
+
+    ``wedged`` and ``timed_out`` are distinct by contract: wedged means the
+    heartbeat went stale (the worker stopped making progress and was
+    killed early); timed_out means the budget ran out while the worker was
+    still alive (slow, not hung)."""
+
+    ok: bool = False
+    rc: Optional[int] = None
+    wedged: bool = False
+    timed_out: bool = False
+    restarts: int = 0
+    duration_s: float = 0.0
+    stdout: str = ""
+    stdout_tail: str = ""
+    stderr_tail: str = ""
+    note: str = ""
+    attempts: List[str] = field(default_factory=list)
+    # the environment the worker ran with (redaction source): secrets the
+    # SUPERVISOR never had must still not leak through the captured tails
+    environ: Optional[Dict[str, str]] = None
+
+    def wedge_log(self) -> Dict[str, object]:
+        """The post-mortem payload a degraded artifact carries — the last
+        8KB of each stream, env-redacted, plus the kill classification."""
+        return {
+            "note": self.note,
+            "wedged": self.wedged,
+            "timed_out": self.timed_out,
+            "rc": self.rc,
+            "restarts": self.restarts,
+            "stdout_tail": redact_env_text(self.stdout_tail, self.environ),
+            "stderr_tail": redact_env_text(self.stderr_tail, self.environ),
+        }
+
+
+def _kill_group(proc: subprocess.Popen) -> None:
+    """SIGKILL the child's WHOLE process group: a grandchild that survived
+    the child (fork bomb, helper holding a pipe) dies with it."""
+    try:
+        os.killpg(proc.pid, signal.SIGKILL)
+    except (ProcessLookupError, PermissionError):
+        pass
+    try:
+        proc.wait(timeout=30)
+    except (subprocess.TimeoutExpired, OSError):
+        pass
+
+
+def _run_once(
+    cmd: Sequence[str],
+    env: Optional[Dict[str, str]],
+    timeout_s: float,
+    heartbeat: Optional[Heartbeat],
+    stale_after_s: Optional[float],
+    poll_s: float,
+    tail_n: int,
+    workdir: str,
+    on_output: Optional[Callable[[str], None]],
+) -> SuperviseResult:
+    out_path = os.path.join(workdir, "stdout")
+    err_path = os.path.join(workdir, "stderr")
+    res = SuperviseResult(environ=env)
+    start = time.monotonic()
+    with open(out_path, "wb") as out_f, open(err_path, "wb") as err_f:
+        proc = subprocess.Popen(
+            list(cmd), stdout=out_f, stderr=err_f,
+            env=env, start_new_session=True,
+        )
+        deadline = start + timeout_s
+        echoed = 0
+        try:
+            while True:
+                try:
+                    rc = proc.wait(timeout=poll_s)
+                    res.rc = rc
+                    res.ok = rc == 0
+                    res.note = f"rc={rc}"
+                    break
+                except subprocess.TimeoutExpired:
+                    pass
+                if on_output is not None:
+                    echoed = _echo_new(err_path, echoed, on_output)
+                now = time.monotonic()
+                hb_age = heartbeat.age() if heartbeat is not None else None
+                if (
+                    stale_after_s is not None
+                    and heartbeat is not None
+                    and (hb_age if hb_age is not None
+                         else now - start) >= stale_after_s
+                ):
+                    res.wedged = True
+                    res.note = (
+                        f"wedged: heartbeat stale for "
+                        f"{hb_age if hb_age is not None else now - start:.0f}s "
+                        f"(threshold {stale_after_s:.0f}s); process group killed"
+                    )
+                    _kill_group(proc)
+                    res.rc = proc.poll()
+                    break
+                if now >= deadline:
+                    res.timed_out = True
+                    res.note = (
+                        f"timed out: still alive at {timeout_s:.0f}s budget "
+                        "(heartbeat fresh — slow, not wedged); "
+                        "process group killed"
+                    )
+                    _kill_group(proc)
+                    res.rc = proc.poll()
+                    break
+        finally:
+            if proc.poll() is None:
+                _kill_group(proc)
+    if on_output is not None:
+        _echo_new(err_path, echoed, on_output)
+    res.duration_s = time.monotonic() - start
+    res.stdout = _read_text(out_path)
+    res.stdout_tail = res.stdout[-tail_n:]
+    res.stderr_tail = tail_bytes_of(err_path, tail_n)
+    return res
+
+
+def _echo_new(path: str, offset: int, on_output: Callable[[str], None]) -> int:
+    """Forward bytes appended to `path` since `offset` (live worker stderr
+    streaming to the supervisor's own stderr); returns the new offset."""
+    try:
+        with open(path, "rb") as f:
+            f.seek(offset)
+            chunk = f.read()
+    except OSError:
+        return offset
+    if chunk:
+        on_output(chunk.decode("utf-8", errors="replace"))
+    return offset + len(chunk)
+
+
+def _read_text(path: str) -> str:
+    try:
+        with open(path, "rb") as f:
+            return f.read().decode("utf-8", errors="replace")
+    except OSError:
+        return ""
+
+
+def run_supervised(
+    cmd: Sequence[str],
+    *,
+    env: Optional[Dict[str, str]] = None,
+    timeout_s: float,
+    heartbeat_path: Optional[str] = None,
+    stale_after_s: Optional[float] = None,
+    poll_s: float = 0.25,
+    max_restarts: int = 0,
+    backoff_base_s: float = 1.0,
+    backoff_max_s: float = 30.0,
+    tail_n: int = 8192,
+    on_output: Optional[Callable[[str], None]] = None,
+    sleep: Callable[[float], None] = time.sleep,
+) -> SuperviseResult:
+    """Run `cmd` in its own process group under a hard-kill watchdog.
+
+    Liveness has two layers: `timeout_s` is the wall budget (a worker that
+    exceeds it is SLOW and killed with ``timed_out=True``); when a
+    `heartbeat_path` is given, a heartbeat older than `stale_after_s` —
+    or never touched at all within that window — is a WEDGE and kills the
+    group early (``wedged=True``). Restart-with-backoff applies to failed
+    attempts (nonzero rc, wedge, timeout) up to `max_restarts`; backoff
+    doubles from `backoff_base_s`, capped at `backoff_max_s`.
+
+    The returned result is the LAST attempt's, with `restarts` and the
+    per-attempt notes accumulated. A fresh heartbeat file is used per
+    attempt (the previous attempt's touches must not mask a newly wedged
+    restart)."""
+    attempts: List[str] = []
+    total_start = time.monotonic()
+    last: Optional[SuperviseResult] = None
+    for attempt in range(max_restarts + 1):
+        remaining = timeout_s - (time.monotonic() - total_start)
+        if attempt > 0 and remaining <= 0:
+            break
+        hb = None
+        if heartbeat_path is not None:
+            # fresh per attempt: unlink so a restart starts un-touched
+            try:
+                os.unlink(heartbeat_path)
+            except OSError:
+                pass
+            hb = Heartbeat(heartbeat_path)
+        with tempfile.TemporaryDirectory(prefix="kct-supervise-") as workdir:
+            last = _run_once(
+                cmd, env, min(timeout_s, max(1.0, remaining)), hb,
+                stale_after_s, poll_s, tail_n, workdir, on_output,
+            )
+        last.restarts = attempt
+        attempts.append(f"attempt {attempt + 1}: {last.note}")
+        last.attempts = list(attempts)
+        if last.ok:
+            break
+        if attempt < max_restarts:
+            sleep(min(backoff_max_s, backoff_base_s * (2 ** attempt)))
+    assert last is not None  # max_restarts >= 0 guarantees one attempt
+    last.duration_s = time.monotonic() - total_start
+    return last
+
+
+# ---------------------------------------------------------------------------
+# atomic, resumable artifacts
+
+
+def config_digest(config: Dict[str, object]) -> str:
+    """Content key for a unit of work: the sha256 of the canonical JSON of
+    its configuration. An artifact is only `fresh` for the exact config
+    that produced it — change a knob and the stage re-runs on resume."""
+    canon = json.dumps(config, sort_keys=True, separators=(",", ":"))
+    return hashlib.sha256(canon.encode()).hexdigest()[:16]
+
+
+def atomic_write_json(path: str, payload: Dict[str, object]) -> None:
+    """write-temp-fsync-rename in the destination directory: a reader never
+    sees a partial artifact, a crash leaves the previous version intact."""
+    directory = os.path.dirname(path) or "."
+    fd, tmp = tempfile.mkstemp(dir=directory, prefix=".tmp-", suffix=".json")
+    try:
+        with os.fdopen(fd, "w") as f:
+            json.dump(payload, f, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+class ArtifactStore:
+    """One JSON artifact per unit of work (a bench stage), written
+    atomically as the unit finishes, keyed by config digest.
+
+    Record schema::
+
+        {"stage": name, "config_digest": d, "degraded": bool,
+         "fallback": bool, "error": str|None, "wedge_log": {...}|None,
+         "meta": {...}, "data": {...}}
+
+    `degraded` means the unit did NOT produce its data (wedge, crash,
+    budget) — a resume re-runs it. `fallback` means it produced complete
+    data but on a fallback backend (an involuntary CPU column in a TPU
+    round) — a resume re-runs it only when the primary backend is back."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def path(self, stage: str) -> str:
+        return os.path.join(self.root, f"{stage}.json")
+
+    def save(
+        self,
+        stage: str,
+        config: Dict[str, object],
+        data: Optional[Dict[str, object]],
+        *,
+        degraded: bool = False,
+        fallback: bool = False,
+        error: Optional[str] = None,
+        wedge_log: Optional[Dict[str, object]] = None,
+        meta: Optional[Dict[str, object]] = None,
+    ) -> Dict[str, object]:
+        record: Dict[str, object] = {
+            "stage": stage,
+            "config_digest": config_digest(config),
+            "degraded": bool(degraded),
+            "fallback": bool(fallback),
+            "error": error,
+            "wedge_log": wedge_log,
+            "meta": meta or {},
+            "data": data,
+        }
+        atomic_write_json(self.path(stage), record)
+        return record
+
+    def load(self, stage: str) -> Optional[Dict[str, object]]:
+        try:
+            with open(self.path(stage)) as f:
+                record = json.load(f)
+        except (OSError, ValueError):
+            return None
+        if not isinstance(record, dict) or record.get("stage") != stage:
+            return None
+        return record
+
+    def fresh(self, stage: str, config: Dict[str, object]) -> Optional[Dict[str, object]]:
+        """The artifact, iff it matches this config and completed (possibly
+        on a fallback backend — the caller decides whether fallback data
+        is acceptable for this round)."""
+        record = self.load(stage)
+        if record is None:
+            return None
+        if record.get("config_digest") != config_digest(config):
+            return None
+        if record.get("degraded"):
+            return None
+        return record
+
+    def stages(self) -> List[str]:
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return []
+        return sorted(
+            n[:-len(".json")] for n in names
+            if n.endswith(".json") and not n.startswith(".")
+        )
+
+
+# ---------------------------------------------------------------------------
+# TTL'd health verdicts (the out-of-band device-health daemon's output)
+
+
+def write_verdict(
+    path: str,
+    ok: bool,
+    note: str = "",
+    ttl_s: float = 300.0,
+    extra: Optional[Dict[str, object]] = None,
+) -> Dict[str, object]:
+    """Publish a health verdict atomically. `ts` is wall-clock — readers
+    are other processes; the filesystem clock is the shared one."""
+    verdict: Dict[str, object] = {
+        "ok": bool(ok),
+        "note": note,
+        "ts": time.time(),
+        "ttl_s": float(ttl_s),
+    }
+    if extra:
+        verdict.update(extra)
+    atomic_write_json(path, verdict)
+    return verdict
+
+
+def read_verdict(path: str) -> Optional[Dict[str, object]]:
+    """The verdict, or None when missing, unreadable, or past its TTL —
+    a stale verdict is NO verdict (the daemon may itself be wedged)."""
+    try:
+        with open(path) as f:
+            verdict = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(verdict, dict):
+        return None
+    try:
+        age = time.time() - float(verdict["ts"])
+        ttl = float(verdict["ttl_s"])
+    except (KeyError, TypeError, ValueError):
+        return None
+    if age > ttl:
+        return None
+    return verdict

@@ -1,0 +1,258 @@
+"""Resumable stage-graph bench orchestration (ISSUE 11): plan/merge over a
+fake round dir — partial artifacts resume correctly, degraded columns
+re-run, fallback columns re-run only when the TPU verdict is back, and the
+merged JSON is byte-stable and schema-complete. No subprocesses, no jax:
+these drive the pure planning/merging layer the orchestrator is built on."""
+import json
+
+import pytest
+
+import bench
+from karpenter_core_tpu.utils import supervise
+
+
+@pytest.fixture()
+def store(tmp_path):
+    return supervise.ArtifactStore(str(tmp_path / "stages"))
+
+
+def _save_ok(store, name, data=None, **kwargs):
+    store.save(name, bench.stage_config(name), data or {"v": 1}, **kwargs)
+
+
+HEADLINE_DATA = {
+    "pods": bench.N_PODS, "types": bench.N_TYPES,
+    "distinct": bench.N_DISTINCT, "existing": bench.N_EXISTING,
+    "pods_per_sec": 480.4, "e2e_p50_ms": 263.3, "e2e_p99_ms": 416.3,
+    "device_solve_med_ms": 1.4, "device_p50_ms_varied": 5.1,
+    "device_p99_ms_varied": 5.6, "runs": 2,
+    "tail": {"e2e_sorted_ms": [107.3, 419.4]},
+    "scheduled_min": 191, "compile_cold_s": 8.1, "bucket_hit_ratio": 1.0,
+    "compiled_programs_after_varied_batches": 2, "solver": "TPUSolver",
+    "chips": 1, "cpu_fallback": False,
+}
+
+# every column the historical BENCH_r{N}.json schema carries — the merge
+# must emit ALL of them no matter which stages degraded (plus the new
+# stage bookkeeping columns)
+EXPECTED_EXTRA_KEYS = {
+    "e2e_p50_ms", "e2e_p99_ms", "device_solve_med_ms", "device_p50_ms_varied",
+    "device_p99_ms_varied", "pipelined_p50_ms", "pipelined_p99_ms",
+    "pipelined_runs", "north_star_target_ms", "single_call_under_target",
+    "pipelined_under_target", "device_under_target", "runs", "tail",
+    "scheduled_min", "compile_cold_s", "first_solve_warm_s",
+    "warm_restart_cache_verified", "warm_restart_under_2s",
+    "bucket_hit_ratio", "warm_restart",
+    "compiled_programs_after_varied_batches", "solver", "sharded_speedup",
+    "mesh", "multichip", "chips", "backend_probe", "consolidation",
+    "consolidation_xl", "consolidation_under_1s", "config5_multiprov_spot_od",
+    "config_grid_1_2_3", "stages", "round_dir",
+}
+
+
+def _fill_round(store, degraded=(), fallback=()):
+    """A complete fake round with the named stages degraded/fallback."""
+    for name in bench.STAGE_NAMES:
+        cfg = bench.stage_config(name)
+        if name in degraded:
+            store.save(name, cfg, None, degraded=True, error="wedged",
+                       wedge_log={"note": "killed", "wedged": True,
+                                  "stderr_tail": "last lines"})
+        elif name == "headline":
+            store.save(name, cfg, dict(HEADLINE_DATA),
+                       fallback=name in fallback,
+                       meta={"backend": "TPU v5e", "platform": "tpu"})
+        else:
+            store.save(name, cfg, {"v": 1}, fallback=name in fallback,
+                       meta={"backend": "TPU v5e", "platform": "tpu"})
+
+
+# ---------------------------------------------------------------------------
+# planning
+
+
+def test_plan_empty_store_runs_everything_in_graph_order(store):
+    assert bench.plan_stages(store, tpu_available=True) == list(
+        bench.STAGE_NAMES
+    )
+
+
+def test_plan_skips_fresh_artifacts(store):
+    _fill_round(store)
+    assert bench.plan_stages(store, tpu_available=True) == []
+
+
+def test_plan_reruns_missing_and_degraded_only(store):
+    _fill_round(store, degraded=("consolidation",))
+    # remove one artifact entirely: "missing" and "degraded" both re-run
+    import os
+
+    os.unlink(store.path("grid"))
+    assert bench.plan_stages(store, tpu_available=False) == [
+        "grid", "consolidation",
+    ]
+
+
+def test_plan_reruns_fallback_columns_only_when_tpu_is_back(store):
+    """An involuntary-CPU column is complete data — kept while the tunnel
+    is down, re-run the moment the verdict says the TPU returned (the
+    point of --resume after a wedged round)."""
+    _fill_round(store, fallback=("multichip", "headline"))
+    assert bench.plan_stages(store, tpu_available=False) == []
+    assert bench.plan_stages(store, tpu_available=True) == [
+        "headline", "multichip",
+    ]
+
+
+def test_plan_config_digest_change_invalidates(store):
+    _fill_round(store)
+    # a different geometry mints a different digest: the artifact no
+    # longer answers the question being asked
+    rec = store.load("headline")
+    rec["config_digest"] = "0" * 16
+    supervise.atomic_write_json(store.path("headline"), rec)
+    assert bench.plan_stages(store, tpu_available=False) == ["headline"]
+
+
+def test_plan_env_skip_writes_completed_skip_artifact(store, monkeypatch):
+    monkeypatch.setenv("BENCH_STAGES", "headline,consolidation")
+    todo = bench.plan_stages(store, tpu_available=True)
+    assert todo == ["headline", "consolidation"]
+    rec = store.load("grid")
+    assert rec is not None and not rec["degraded"]
+    assert "not in BENCH_STAGES" in rec["data"]["skipped"]
+    # merged schema stays full: the skipped stages carry their marker
+    merged = bench.merge_round(store)
+    assert merged["extra"]["stages"]["grid"]["status"] == "skipped"
+
+
+def test_plan_legacy_skip_envs(store, monkeypatch):
+    monkeypatch.setenv("BENCH_SKIP_CONSOLIDATION", "1")
+    todo = bench.plan_stages(store, tpu_available=True)
+    assert "consolidation" not in todo and "consolidation_xl" not in todo
+    assert "headline" in todo
+
+
+# ---------------------------------------------------------------------------
+# merging
+
+
+def test_merge_complete_round_schema_and_metric(store):
+    _fill_round(store)
+    merged = bench.merge_round(store, round_dir="/r")
+    assert merged["metric"].startswith("pods_per_sec_e2e_p99_")
+    assert merged["value"] == HEADLINE_DATA["pods_per_sec"]
+    assert merged["unit"] == "pods/sec"
+    missing = EXPECTED_EXTRA_KEYS - set(merged["extra"])
+    assert not missing, f"schema incomplete: {sorted(missing)}"
+    assert merged["extra"]["single_call_under_target"] is True
+    assert all(
+        s["status"] == "ok" for s in merged["extra"]["stages"].values()
+    )
+
+
+def test_merge_degraded_stage_yields_marked_column_with_wedge_log(store):
+    _fill_round(store, degraded=("consolidation",))
+    merged = bench.merge_round(store)
+    cons = merged["extra"]["consolidation"]
+    assert cons["degraded"] is True
+    assert cons["wedge_log"]["stderr_tail"] == "last lines"
+    assert merged["extra"]["stages"]["consolidation"]["status"] == "degraded"
+    # a degraded consolidation_xl nulls its derived scalar, nothing else
+    assert merged["extra"]["e2e_p99_ms"] == HEADLINE_DATA["e2e_p99_ms"]
+    missing = EXPECTED_EXTRA_KEYS - set(merged["extra"])
+    assert not missing, "degradation must not drop columns"
+
+
+def test_merge_degraded_headline_still_emits_full_schema(store):
+    _fill_round(store, degraded=("headline",))
+    merged = bench.merge_round(store)
+    assert merged["metric"].startswith("bench_failed_")
+    assert merged["value"] == 0.0
+    missing = EXPECTED_EXTRA_KEYS - set(merged["extra"])
+    assert not missing
+    assert merged["extra"]["e2e_p99_ms"] is None
+    assert merged["extra"]["single_call_under_target"] is False
+
+
+def test_merge_is_byte_stable(store):
+    """Merging the same round dir twice is byte-identical — the merge is
+    pure over the artifacts (resume-then-remerge can't churn the JSON)."""
+    _fill_round(store, degraded=("grid",), fallback=("multichip",))
+    a = json.dumps(bench.merge_round(store, round_dir="/r"), sort_keys=True)
+    b = json.dumps(bench.merge_round(store, round_dir="/r"), sort_keys=True)
+    assert a == b
+
+
+def test_merge_fallback_column_is_marked(store):
+    _fill_round(store, fallback=("consolidation",))
+    merged = bench.merge_round(store)
+    assert merged["extra"]["consolidation"]["cpu_fallback_column"] is True
+    assert merged["extra"]["stages"]["consolidation"]["status"] == "fallback"
+
+
+def test_merge_warm_restart_validity_gates_the_under_2s_claim(store):
+    """A warm-restart worker on a DIFFERENT platform than the headline
+    (the r05 failure mode) must not claim the restart-stall number."""
+    _fill_round(store)
+    wr_cfg = bench.stage_config("warm_restart")
+    good = {"first_solve_s": 1.2, "cache_files": 10, "platform": "tpu",
+            "pods": bench.N_PODS}
+    store.save("warm_restart", wr_cfg, good,
+               meta={"backend": "TPU v5e", "platform": "tpu"})
+    merged = bench.merge_round(store)
+    assert merged["extra"]["warm_restart_under_2s"] is True
+    store.save("warm_restart", wr_cfg, dict(good, platform="cpu"),
+               meta={"backend": "cpu-fallback", "platform": "cpu"})
+    merged = bench.merge_round(store)
+    assert merged["extra"]["warm_restart_under_2s"] is False
+    assert merged["extra"]["warm_restart_cache_verified"] is False
+    assert merged["extra"]["first_solve_warm_s"] == 1.2, (
+        "the raw number still lands; only the claim is gated"
+    )
+
+
+def test_merge_salvaged_wedge_log_rides_a_completed_column(store):
+    """A stage that printed its result then hung at exit completes WITH
+    its wedge log attached (the salvage path)."""
+    _fill_round(store)
+    store.save(
+        "pipelined", bench.stage_config("pipelined"),
+        {"pipelined_p99_ms": 900.0, "pipelined_p50_ms": 800.0,
+         "pipelined_runs": 6},
+        wedge_log={"note": "worker hung at exit, result salvaged",
+                   "wedged": True},
+        meta={"backend": "TPU v5e", "platform": "tpu"},
+    )
+    merged = bench.merge_round(store)
+    col = merged["extra"]["config5_multiprov_spot_od"]
+    assert "degraded" not in col
+    assert merged["extra"]["pipelined_p99_ms"] == 900.0
+    assert merged["extra"]["stages"]["pipelined"]["status"] == "ok"
+
+
+# ---------------------------------------------------------------------------
+# stage-scoped chaos grammar (the smoke's wedge-injection channel)
+
+
+def test_stage_chaos_grammar(monkeypatch):
+    monkeypatch.setenv(
+        "BENCH_STAGE_CHAOS",
+        "consolidation=solver.device.hang=error:none,latency:600,times:1"
+        "|grid=solver.device=error:timeout",
+    )
+    assert bench._stage_chaos("consolidation") == (
+        "solver.device.hang=error:none,latency:600,times:1"
+    )
+    assert bench._stage_chaos("grid") == "solver.device=error:timeout"
+    assert bench._stage_chaos("headline") == ""
+
+
+def test_stage_config_digests_are_stage_distinct():
+    digests = {
+        name: supervise.config_digest(bench.stage_config(name))
+        for name in bench.STAGE_NAMES
+    }
+    assert len(set(digests.values())) == len(digests), (
+        "every stage must key its own artifact"
+    )

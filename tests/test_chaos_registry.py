@@ -217,3 +217,16 @@ def test_concurrent_firing_counts_globally():
         t.join()
     assert fault.injected == 10 == len(errors)
     assert fault.calls == 400
+
+
+def test_hang_point_parses_as_latency_only_fault():
+    """solver.device.hang (ISSUE 11): the sleep-past-watchdog wedge shape
+    is expressible in the env grammar — latency with error:none."""
+    from karpenter_core_tpu import chaos as c
+
+    faults = c.parse_spec(
+        "solver.device.hang=error:none,latency:600,times:1"
+    )
+    fault = faults[c.SOLVER_DEVICE_HANG]
+    assert fault.error is None and fault.latency == 600.0
+    assert fault.times == 1

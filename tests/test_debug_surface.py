@@ -123,3 +123,41 @@ def test_debug_surface_gated_on_profiling():
             assert err.value.code == 404, path
     finally:
         server.shutdown()
+
+
+def test_debug_health_reports_solver_wedge_state():
+    """/debug/health (ISSUE 11): ungated (it's a health surface, not a
+    profiling one), and reporting the ResilientSolver's heartbeat age,
+    breaker state, wedge history, and abandoned-thread inventory."""
+    from karpenter_core_tpu.operator import __main__ as entry, new_operator
+    from karpenter_core_tpu.solver.fallback import ResilientSolver
+    from karpenter_core_tpu.solver.tpu_solver import GreedySolver
+
+    solver = ResilientSolver(
+        GreedySolver(), GreedySolver(), prober=lambda: None,
+        solve_timeout=5.0, wedge_stale_after=1.0,
+    )
+    operator = new_operator(
+        fake.FakeCloudProvider(), settings=entry.settings_from_env()
+    )
+    server = entry.serve_health(operator, 0, profiling=False, solver=solver)
+    port = server.server_address[1]
+    try:
+        status, body = _get(port, "/debug/health")
+        assert status == 200
+        payload = json.loads(body)
+        assert payload["status"] == "ok"
+        report = payload["solver"]
+        assert report["breaker"] == "closed"
+        assert report["wedge_history"] == []
+        assert report["abandoned_threads"] == []
+        assert report["wedge_stale_after_s"] == 1.0
+        # a recorded wedge flips the surface to degraded with history
+        solver._mark_wedged("chaos: injected wedge", kind="wedged")
+        status, body = _get(port, "/debug/health")
+        payload = json.loads(body)
+        assert payload["status"] == "degraded"
+        assert payload["solver"]["breaker"] == "open"
+        assert payload["solver"]["wedge_history"][-1]["kind"] == "wedged"
+    finally:
+        server.shutdown()

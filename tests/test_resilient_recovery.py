@@ -2,12 +2,35 @@
 suite exercised the degrade direction; these pin the way back — healthy-
 verdict TTL expiry catching a mid-life wedge on the big-batch path, an
 unhealthy backend re-probing and restoring the PRIMARY, and fallback
-events deduping instead of spamming."""
+events deduping instead of spamming.
+
+ISSUE 11 additions: the WEDGE cycle — a dispatch whose heartbeat goes
+stale is abandoned early (named + counted, distinct from slow-but-alive),
+the device breaker opens immediately, admission continues on the greedy
+fallback, and re-admission is gated by the out-of-band prober (the
+breaker's half-open trial), never a live solve."""
+import time
+
+import pytest
+
+from karpenter_core_tpu import chaos
 from karpenter_core_tpu.cloudprovider import fake
 from karpenter_core_tpu.events import Recorder
-from karpenter_core_tpu.solver.fallback import ResilientSolver
+from karpenter_core_tpu.solver.fallback import (
+    SOLVER_WEDGED_TOTAL,
+    CircuitBreaker,
+    ResilientSolver,
+)
 from karpenter_core_tpu.solver.tpu_solver import GreedySolver
 from karpenter_core_tpu.testing import FakeClock, make_pod, make_provisioner
+from karpenter_core_tpu.utils import supervise
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    chaos.reset()
+    yield
+    chaos.reset()
 
 
 class CountingPrimary(GreedySolver):
@@ -126,3 +149,205 @@ def test_fallback_events_are_deduped():
         if e.reason == "SolverDegraded"
     ]
     assert len(degraded) == 2
+
+
+class DispatchingPrimary(CountingPrimary):
+    """A primary whose solve behaves like a real device dispatch: it
+    touches the bound heartbeat (the TPUSolver phase-mark hook) and
+    consults the solver.device.hang chaos point — an armed hang goes
+    silent exactly the way a wedged XLA runtime does."""
+
+    def solve(self, *a, **k):
+        supervise.touch_heartbeat()
+        chaos.maybe_fail(chaos.SOLVER_DEVICE_HANG)
+        supervise.touch_heartbeat()
+        return super().solve(*a, **k)
+
+
+def _wedge_pair(prober, **overrides):
+    primary = DispatchingPrimary()
+    kwargs = dict(
+        prober=prober, small_batch_work_max=0,
+        solve_timeout=10.0, wedge_stale_after=0.3, watchdog_poll=0.05,
+        reprobe_interval=0.4,
+    )
+    kwargs.update(overrides)
+    return primary, ResilientSolver(primary, GreedySolver(), **kwargs)
+
+
+def test_wedge_cycle_fallback_breaker_and_prober_gated_readmission():
+    """The full ISSUE 11 operator cycle, end to end: hang -> heartbeat
+    staleness -> abandoned-as-wedged -> breaker OPEN immediately ->
+    fallback keeps admitting -> the out-of-band prober (not a live solve)
+    re-admits after the fault clears."""
+    probes = []
+
+    def prober():
+        probes.append(time.monotonic())
+        return None  # the backend itself is fine once the hang clears
+
+    primary, resilient = _wedge_pair(prober)
+    inputs = _inputs()
+    wedged_before = SOLVER_WEDGED_TOTAL.get() or 0.0
+    # ONE hang, longer than the staleness threshold: the dispatch goes
+    # silent, the watchdog abandons it as wedged
+    chaos.arm(chaos.SOLVER_DEVICE_HANG, error=None, latency=30.0, times=1)
+    resilient.solve(*inputs)  # establishes health (first probe)
+    probes_before = len(probes)
+    result = resilient.solve(*inputs)  # the wedged dispatch
+    assert result.pod_count_new() == 5, "fallback must keep admitting"
+    assert (SOLVER_WEDGED_TOTAL.get() or 0.0) == wedged_before + 1
+    assert resilient.breaker.state == CircuitBreaker.OPEN, (
+        "a wedge must open the breaker IMMEDIATELY"
+    )
+    assert resilient._healthy is False
+    # abandoned-thread accounting: named, counted, inventoried
+    report = resilient.health_report()
+    assert report["abandoned_total"] == 1
+    [t] = report["abandoned_threads"]
+    assert t["name"].startswith("primary-solve-abandoned-1-wedged")
+    assert report["wedge_history"][-1]["kind"] == "wedged"
+    # while OPEN: fast-fail to fallback, NO probe, primary untouched
+    calls_before = primary.calls
+    resilient.solve(*inputs)
+    assert primary.calls == calls_before
+    assert len(probes) == probes_before, "open breaker must not probe"
+    # after the reset TTL the HALF-OPEN trial is the PROBER, never a solve
+    time.sleep(0.5)
+    result = resilient.solve(*inputs)
+    assert result.pod_count_new() == 5
+    assert len(probes) == probes_before + 1, (
+        "re-admission must be gated by exactly one out-of-band probe"
+    )
+    assert resilient.breaker.state == CircuitBreaker.CLOSED
+    assert resilient._healthy is True
+    assert primary.calls > calls_before, "recovered backend serves again"
+
+
+def test_wedge_readmission_blocked_while_probe_still_fails():
+    """A still-wedged backend: the half-open trial probe FAILS, the
+    breaker re-opens, and no live solve ever reaches the primary."""
+    health = {"reason": "still wedged"}
+    primary, resilient = _wedge_pair(lambda: health["reason"])
+    inputs = _inputs()
+    chaos.arm(chaos.SOLVER_DEVICE_HANG, error=None, latency=30.0, times=1)
+    resilient._healthy = True  # established; skip the startup probe
+    resilient._last_probe = time.time()
+    resilient.solve(*inputs)  # wedges
+    calls_after_wedge = primary.calls
+    time.sleep(0.5)  # breaker half-opens; the trial probe fails
+    result = resilient.solve(*inputs)
+    assert result.pod_count_new() == 5
+    assert primary.calls == calls_after_wedge, (
+        "failed re-admission probe must keep live solves off the backend"
+    )
+    assert resilient.breaker.state == CircuitBreaker.OPEN
+    # the backend finally heals: the NEXT trial closes the loop
+    health["reason"] = None
+    time.sleep(0.5)
+    resilient.solve(*inputs)
+    assert resilient.breaker.state == CircuitBreaker.CLOSED
+    assert primary.calls == calls_after_wedge + 1
+
+
+def test_slow_timeout_abandonment_is_named_counted_and_trips_breaker():
+    """The solve_timeout leak accounting (ISSUE 11 satellite): a slow-but-
+    alive dispatch that exceeds the budget is abandoned with kind=timeout
+    — NAMED per the thread-discipline rule, counted, and the breaker trips
+    without waiting for the next reprobe interval."""
+    import threading as _threading
+
+    release = _threading.Event()
+
+    class SlowAlivePrimary(CountingPrimary):
+        def solve(self, *a, **k):
+            # keeps touching its heartbeat: alive, merely slow
+            for _ in range(100):
+                supervise.touch_heartbeat()
+                if release.wait(0.05):
+                    break
+            raise RuntimeError("never reached before the watchdog")
+
+    primary = SlowAlivePrimary()
+    resilient = ResilientSolver(
+        primary, GreedySolver(), prober=lambda: None,
+        small_batch_work_max=0, solve_timeout=0.4, wedge_stale_after=5.0,
+        watchdog_poll=0.05, reprobe_interval=60.0,
+    )
+    inputs = _inputs()
+    result = resilient.solve(*inputs)
+    release.set()
+    assert result.pod_count_new() == 5, "watchdog must fall back"
+    report = resilient.health_report()
+    assert report["abandoned_total"] == 1
+    [t] = report["abandoned_threads"]
+    assert t["name"].startswith("primary-solve-abandoned-1-timeout")
+    assert report["wedge_history"][-1]["kind"] == "timeout"
+    assert resilient.breaker.state == CircuitBreaker.OPEN, (
+        "abandonment must trip the breaker immediately, not wait for the "
+        "reprobe interval"
+    )
+    # immediately after: fast-fail, no probe storm, primary untouched
+    calls = primary.calls
+    resilient.solve(*inputs)
+    assert primary.calls == calls
+
+
+def test_health_report_shape_for_debug_endpoint():
+    """/debug/health contract: the report is JSON-serializable and carries
+    the heartbeat age of the most recent dispatch."""
+    import json as _json
+
+    primary, resilient = _wedge_pair(lambda: None)
+    inputs = _inputs()
+    resilient.solve(*inputs)
+    report = resilient.health_report()
+    _json.dumps(report)  # must not raise
+    assert report["healthy"] is True
+    assert report["breaker"] == CircuitBreaker.CLOSED
+    assert report["heartbeat_age_s"] is not None
+    assert report["wedge_stale_after_s"] == 0.3
+    assert report["abandoned_threads"] == []
+
+
+def test_wedge_cycle_through_operator_admission_continues():
+    """Operator-level acceptance (ISSUE 11): with solver.device.hang armed
+    around the REAL provisioning controller, admission continues on the
+    greedy fallback (no crashed reconcile, every pod covered) and the
+    backend re-admits after the fault clears."""
+    from karpenter_core_tpu.api.settings import Settings
+    from karpenter_core_tpu.operator import new_operator
+
+    cp = fake.FakeCloudProvider(fake.instance_types(10))
+    primary, resilient = _wedge_pair(lambda: None)
+    op = new_operator(
+        cp, settings=Settings(batch_idle_duration=0.02,
+                              batch_max_duration=0.2),
+        solver=resilient,
+    )
+    op.provisioning.fallback_solver = resilient
+    op.kube_client.create(make_provisioner(name="default"))
+    chaos.arm(chaos.SOLVER_DEVICE_HANG, error=None, latency=30.0, times=1)
+    op.start()
+    try:
+        for i in range(4):
+            op.kube_client.create(make_pod(requests={"cpu": "1"}))
+        deadline = time.monotonic() + 20.0
+        covered = False
+        while time.monotonic() < deadline and not covered:
+            time.sleep(0.1)
+            op.sync_state()
+            result = op.provisioning.schedule()
+            covered = result is None or (
+                not result.new_machines and not result.failed_pods
+            )
+        assert covered, "admission must continue through the wedge"
+        assert (SOLVER_WEDGED_TOTAL.get() or 0.0) >= 1 or (
+            resilient._abandon_count == 0
+        ), "if the hang fired mid-loop it must be accounted as a wedge"
+        # recovery: once the breaker TTL lapses, the prober re-admits
+        time.sleep(0.6)
+        assert resilient.healthy() is True
+        assert resilient.breaker.state == CircuitBreaker.CLOSED
+    finally:
+        op.stop()

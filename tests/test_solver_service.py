@@ -22,6 +22,29 @@ def test_health(server):
     assert health.device
 
 
+def test_health_reports_wedged_inflight_dispatch(server):
+    """ISSUE 11: a dispatch whose heartbeat went stale (a hung XLA call on
+    a worker thread) flips the Health RPC to a wedged status, which the
+    client raises as unhealthy — the ResilientSolver's out-of-band prober
+    keeps the service out until the wedge clears."""
+    from karpenter_core_tpu.solver.service import SolverUnavailableError
+    from karpenter_core_tpu.utils import supervise
+
+    port, service = server
+    client = RemoteSolver(f"127.0.0.1:{port}")
+    stale = supervise.ThreadHeartbeat(clock=lambda: 0.0)
+    stale.touch()
+    stale._clock = lambda: service.wedge_stale_after + 1.0  # now: stale
+    service._inflight[10**9] = stale
+    try:
+        with pytest.raises(SolverUnavailableError) as exc:
+            client.health()
+        assert "wedged" in str(exc.value)
+    finally:
+        service._inflight.pop(10**9, None)
+    assert client.health().status == "ok", "cleared wedge = healthy again"
+
+
 def test_remote_solve_matches_local(server):
     port, _ = server
     client = RemoteSolver(f"127.0.0.1:{port}")

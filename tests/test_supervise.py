@@ -1,0 +1,329 @@
+"""Wedge-proof execution supervisor (ISSUE 11): heartbeat staleness vs
+slow-but-alive, atomic resumable artifacts, restart backoff, process-group
+kill semantics (incl. surviving grandchildren), env redaction, and TTL'd
+health verdicts. Everything here is subprocess-real but jax-free — the
+supervisor's whole job is to work when the accelerator stack doesn't."""
+import json
+import os
+import signal
+import sys
+import textwrap
+import time
+
+from karpenter_core_tpu.utils import supervise
+
+
+def _script(body: str) -> list:
+    return [sys.executable, "-c", textwrap.dedent(body)]
+
+
+# ---------------------------------------------------------------------------
+# heartbeats: wedge (stale) is DISTINCT from slow (alive but over budget)
+
+
+def test_slow_but_alive_worker_times_out_not_wedged(tmp_path):
+    """A worker still touching its heartbeat past the budget is SLOW: the
+    supervisor kills it at the budget with timed_out=True, wedged=False."""
+    hb = str(tmp_path / "hb")
+    res = supervise.run_supervised(
+        _script(f"""
+            import os, time
+            for _ in range(200):
+                with open({hb!r}, "a"):
+                    os.utime({hb!r}, None)
+                time.sleep(0.1)
+        """),
+        timeout_s=2.0, heartbeat_path=hb, stale_after_s=1.0, poll_s=0.1,
+    )
+    assert not res.ok
+    assert res.timed_out and not res.wedged
+    assert "slow, not wedged" in res.note
+
+
+def test_stale_heartbeat_is_a_wedge_and_kills_early(tmp_path):
+    """A worker that STOPS touching is wedged: killed at the staleness
+    threshold, long before the wall budget burns down."""
+    hb = str(tmp_path / "hb")
+    start = time.monotonic()
+    res = supervise.run_supervised(
+        _script(f"""
+            import os, time
+            with open({hb!r}, "a"):
+                os.utime({hb!r}, None)
+            time.sleep(60)  # the wedge: silence
+        """),
+        timeout_s=30.0, heartbeat_path=hb, stale_after_s=1.0, poll_s=0.1,
+    )
+    took = time.monotonic() - start
+    assert res.wedged and not res.timed_out
+    assert "wedged" in res.note
+    assert took < 15, f"wedge must be detected early, took {took:.1f}s"
+
+
+def test_never_touched_heartbeat_counts_as_wedge(tmp_path):
+    """A worker that never touches at all is indistinguishable from one
+    that wedged during startup: same early kill."""
+    hb = str(tmp_path / "hb")
+    res = supervise.run_supervised(
+        _script("import time; time.sleep(60)"),
+        timeout_s=30.0, heartbeat_path=hb, stale_after_s=1.0, poll_s=0.1,
+    )
+    assert res.wedged
+
+
+def test_wedge_log_carries_redacted_output_tails(tmp_path):
+    """The post-mortem payload: last bytes of both streams, env-redacted."""
+    hb = str(tmp_path / "hb")
+    env = dict(os.environ)
+    env["KCT_TEST_SECRET_TOKEN"] = "hunter2hunter2"
+    res = supervise.run_supervised(
+        _script("""
+            import sys, time
+            print("progress line on stdout")
+            print("tunnel auth hunter2hunter2 then silence", file=sys.stderr)
+            sys.stdout.flush(); sys.stderr.flush()
+            time.sleep(60)
+        """),
+        env=env, timeout_s=30.0, heartbeat_path=hb, stale_after_s=1.0,
+        poll_s=0.1,
+    )
+    log = res.wedge_log()
+    assert log["wedged"] is True
+    assert "progress line on stdout" in log["stdout_tail"]
+    assert "then silence" in log["stderr_tail"]
+    assert "hunter2hunter2" not in log["stderr_tail"]
+    assert "<redacted:KCT_TEST_SECRET_TOKEN>" in log["stderr_tail"]
+
+
+def test_redact_env_text_only_sensitive_names():
+    env = {"MY_API_KEY": "supersecretvalue", "HOME": "/root", "X": "ab"}
+    out = supervise.redact_env_text(
+        "key=supersecretvalue home=/root x=ab", environ=env
+    )
+    assert "supersecretvalue" not in out
+    assert "<redacted:MY_API_KEY>" in out
+    assert "/root" in out  # non-sensitive name untouched
+
+
+# ---------------------------------------------------------------------------
+# process-group kill: grandchildren die with the worker
+
+
+def test_kill_reaps_the_whole_process_group(tmp_path):
+    """A worker that forked helpers (the fork-bomb shape: grandchildren
+    that would survive a plain child kill) loses its WHOLE group on
+    wedge — no orphan keeps a pipe or a device handle alive."""
+    pid_file = str(tmp_path / "pids")
+    hb = str(tmp_path / "hb")
+    res = supervise.run_supervised(
+        _script(f"""
+            import os, subprocess, sys, time
+            procs = [
+                subprocess.Popen([sys.executable, "-c", "import time; time.sleep(120)"])
+                for _ in range(3)
+            ]
+            with open({pid_file!r}, "w") as f:
+                f.write(" ".join(str(p.pid) for p in procs))
+            time.sleep(120)  # wedge with the grandchildren running
+        """),
+        timeout_s=60.0, heartbeat_path=hb, stale_after_s=1.5, poll_s=0.1,
+    )
+    assert res.wedged
+    with open(pid_file) as f:
+        pids = [int(p) for p in f.read().split()]
+    assert len(pids) == 3
+    # SIGKILL is asynchronous; give the kernel a moment to reap
+    deadline = time.monotonic() + 10
+    alive = pids
+    while alive and time.monotonic() < deadline:
+        alive = [p for p in alive if _alive(p)]
+        time.sleep(0.1)
+    assert not alive, f"grandchildren survived the group kill: {alive}"
+
+
+def _alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+    # zombies are "alive" to kill(0); check the state instead
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            return f.read().split()[2] != "Z"
+    except OSError:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# restart with bounded backoff
+
+
+def test_restart_backoff_until_success(tmp_path):
+    """rc!=0 attempts restart with doubling backoff; the first clean exit
+    stops the loop. The counter file makes attempt 3 succeed."""
+    counter = str(tmp_path / "count")
+    sleeps = []
+    res = supervise.run_supervised(
+        _script(f"""
+            import os, sys
+            n = int(open({counter!r}).read()) if os.path.exists({counter!r}) else 0
+            open({counter!r}, "w").write(str(n + 1))
+            sys.exit(0 if n >= 2 else 1)
+        """),
+        timeout_s=30.0, max_restarts=5, backoff_base_s=0.05,
+        backoff_max_s=0.2, poll_s=0.05, sleep=sleeps.append,
+    )
+    assert res.ok and res.rc == 0
+    assert res.restarts == 2
+    assert sleeps == [0.05, 0.1], "doubling backoff between failed attempts"
+    assert len(res.attempts) == 3 and res.attempts[-1] == "attempt 3: rc=0"
+
+
+def test_restart_budget_is_bounded(tmp_path):
+    res = supervise.run_supervised(
+        _script("import sys; sys.exit(3)"),
+        timeout_s=30.0, max_restarts=2, backoff_base_s=0.01, poll_s=0.05,
+        sleep=lambda s: None,
+    )
+    assert not res.ok
+    assert res.rc == 3
+    assert res.restarts == 2 and len(res.attempts) == 3
+
+
+# ---------------------------------------------------------------------------
+# atomic resumable artifacts
+
+
+def test_artifact_roundtrip_and_digest_gating(tmp_path):
+    store = supervise.ArtifactStore(str(tmp_path / "stages"))
+    cfg = {"stage": "headline", "pods": 200}
+    store.save("headline", cfg, {"e2e_p99_ms": 410.0})
+    rec = store.fresh("headline", cfg)
+    assert rec is not None and rec["data"]["e2e_p99_ms"] == 410.0
+    # a changed config invalidates the artifact (content-keyed resume)
+    assert store.fresh("headline", {"stage": "headline", "pods": 500}) is None
+    # degraded artifacts are never fresh — a resume re-runs them
+    store.save("headline", cfg, None, degraded=True, error="wedged",
+               wedge_log={"note": "killed"})
+    assert store.fresh("headline", cfg) is None
+    loaded = store.load("headline")
+    assert loaded["degraded"] and loaded["wedge_log"]["note"] == "killed"
+
+
+def test_artifact_write_is_atomic(tmp_path):
+    """No partial file is ever visible: the write is temp + rename in the
+    same directory, and a failed dump leaves the previous version."""
+    store = supervise.ArtifactStore(str(tmp_path / "stages"))
+    cfg = {"stage": "s"}
+    store.save("s", cfg, {"v": 1})
+    try:
+        supervise.atomic_write_json(
+            store.path("s"), {"bad": object()}  # not JSON-serializable
+        )
+    except TypeError:
+        pass
+    rec = store.load("s")
+    assert rec is not None and rec["data"]["v"] == 1, "old version preserved"
+    leftovers = [n for n in os.listdir(store.root) if n.startswith(".tmp-")]
+    assert not leftovers, f"temp files leaked: {leftovers}"
+
+
+def test_artifact_corrupt_file_reads_as_missing(tmp_path):
+    store = supervise.ArtifactStore(str(tmp_path / "stages"))
+    with open(store.path("x"), "w") as f:
+        f.write("{not json")
+    assert store.load("x") is None
+    assert store.fresh("x", {"stage": "x"}) is None
+
+
+def test_fallback_artifacts_are_fresh_but_flagged(tmp_path):
+    """An involuntary-CPU column is COMPLETE (fresh) but flagged: the
+    bench planner re-runs it only when the TPU verdict comes back."""
+    store = supervise.ArtifactStore(str(tmp_path / "stages"))
+    cfg = {"stage": "headline"}
+    store.save("headline", cfg, {"pods_per_sec": 1.0}, fallback=True)
+    rec = store.fresh("headline", cfg)
+    assert rec is not None and rec["fallback"] is True
+
+
+# ---------------------------------------------------------------------------
+# TTL'd health verdicts
+
+
+def test_verdict_roundtrip_and_ttl(tmp_path):
+    path = str(tmp_path / "health.json")
+    supervise.write_verdict(path, True, "tpu v5e", ttl_s=60.0)
+    v = supervise.read_verdict(path)
+    assert v is not None and v["ok"] and v["note"] == "tpu v5e"
+    supervise.write_verdict(path, True, "soon stale", ttl_s=0.05)
+    time.sleep(0.1)
+    assert supervise.read_verdict(path) is None, "stale verdict = no verdict"
+
+
+def test_verdict_missing_or_corrupt_is_none(tmp_path):
+    assert supervise.read_verdict(str(tmp_path / "nope.json")) is None
+    bad = str(tmp_path / "bad.json")
+    with open(bad, "w") as f:
+        f.write("[]")
+    assert supervise.read_verdict(bad) is None
+    with open(bad, "w") as f:
+        json.dump({"ok": True}, f)  # no ts/ttl
+    assert supervise.read_verdict(bad) is None
+
+
+# ---------------------------------------------------------------------------
+# in-process thread heartbeats (the ResilientSolver watchdog's view)
+
+
+def test_thread_heartbeat_age_and_thread_local_binding():
+    clock = {"t": 100.0}
+    hb = supervise.ThreadHeartbeat(clock=lambda: clock["t"])
+    assert hb.age() is None, "never touched"
+    hb.touch()
+    clock["t"] += 2.5
+    assert hb.age() == 2.5
+    # the thread-local hook: unbound is a no-op, bound touches
+    supervise.bind_heartbeat(None)
+    supervise.touch_heartbeat()  # must not raise
+    supervise.bind_heartbeat(hb)
+    try:
+        supervise.touch_heartbeat()
+        assert hb.age() == 0.0
+        assert supervise.bound_heartbeat() is hb
+    finally:
+        supervise.bind_heartbeat(None)
+
+
+def test_salvaged_stdout_survives_a_wedge_kill(tmp_path):
+    """A worker that printed its result line and THEN wedged still hands
+    the supervisor the line (the bench salvages such stages)."""
+    hb = str(tmp_path / "hb")
+    res = supervise.run_supervised(
+        _script("""
+            import sys, time
+            print('{"stage": "x", "data": {"v": 7}}')
+            sys.stdout.flush()
+            time.sleep(60)
+        """),
+        timeout_s=30.0, heartbeat_path=hb, stale_after_s=1.0, poll_s=0.1,
+    )
+    assert res.wedged
+    assert json.loads(res.stdout.strip())["data"]["v"] == 7
+
+
+def test_sigkill_is_used_not_sigterm(tmp_path):
+    """The kill must be UNCATCHABLE: a worker shielding itself with a
+    SIGTERM handler dies anyway (the axon wedge does not cooperate)."""
+    hb = str(tmp_path / "hb")
+    res = supervise.run_supervised(
+        _script("""
+            import signal, time
+            signal.signal(signal.SIGTERM, lambda *a: None)
+            time.sleep(60)
+        """),
+        timeout_s=30.0, heartbeat_path=hb, stale_after_s=1.0, poll_s=0.1,
+    )
+    assert res.wedged
+    assert res.rc in (-signal.SIGKILL, None)
