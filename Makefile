@@ -3,8 +3,8 @@
 # backend with 8 virtual devices via tests/conftest.py.
 
 .PHONY: test deflake perf bench verify trace-demo chaos chaos-smoke \
-	replay-demo lint soak soak-smoke prewarm-smoke multichip-smoke \
-	consolidation-smoke bench-smoke
+	replay-demo lint soak soak-smoke soak-smoke-inproc prewarm-smoke \
+	multichip-smoke consolidation-smoke bench-smoke host-smoke
 
 test:  ## tier-1 suite (CPU, 8 virtual devices); slow chaos soaks: make chaos
 	python -m pytest tests -q -m "not slow"
@@ -40,8 +40,17 @@ soak:  ## >=60s sustained-churn soak, chaos armed + flightrec on (CPU-hermetic;
 	# override the backend by exporting JAX_PLATFORMS before calling)
 	JAX_PLATFORMS=$${JAX_PLATFORMS:-cpu} python hack/soak.py
 
-soak-smoke:  ## <=30s seeded churn smoke (CI gate: admission SLOs + delta re-solve engage)
+soak-smoke:  ## <=30s seeded churn smoke through the solver HOST (CI gate: admission
+	# SLOs + delta re-solve in the child + crash-drill respawn + overload shed)
+	JAX_PLATFORMS=$${JAX_PLATFORMS:-cpu} python hack/soak.py --smoke --host
+
+soak-smoke-inproc:  ## the KARPENTER_SOLVER_HOST=off posture's wedge drill: in-process
+	# hang -> heartbeat-stale abandon -> breaker -> prober-gated re-admit
 	JAX_PLATFORMS=$${JAX_PLATFORMS:-cpu} python hack/soak.py --smoke
+
+host-smoke:  ## kill the solver host mid-solve under the live operator: wedge + crash
+	# drills -> respawn, byte-identical parity, zero live zombies (~60s budget)
+	JAX_PLATFORMS=$${JAX_PLATFORMS:-cpu} python hack/host_smoke.py
 
 prewarm-smoke:  ## warm-cache restart gate: prewarm a tier, restart fresh, first solve under budget
 	JAX_PLATFORMS=$${JAX_PLATFORMS:-cpu} python hack/prewarm_smoke.py
@@ -86,8 +95,10 @@ verify:  ## driver hooks: single-chip compile check + 8-way mesh dryrun
 	# karpenter_chaos_injected_total / retry / ICE counters
 	-$(MAKE) chaos-smoke
 	# non-fatal smoke: a short seeded churn soak must bind every pod and
-	# engage the incremental delta re-solve (fatal gate lives in presubmit)
+	# engage the incremental delta re-solve (fatal gate lives in presubmit);
+	# host mode + the in-process wedge-drill posture both stay covered
 	-$(MAKE) soak-smoke
+	-$(MAKE) soak-smoke-inproc
 	# non-fatal smoke: a prewarmed persistent cache must make a restarted
 	# process's first solve fast (fatal gate lives in presubmit)
 	-$(MAKE) prewarm-smoke
@@ -101,3 +112,6 @@ verify:  ## driver hooks: single-chip compile check + 8-way mesh dryrun
 	# non-fatal smoke: a chaos-wedged bench stage must degrade to a marked
 	# column and --resume must backfill it (fatal gate lives in presubmit)
 	-$(MAKE) bench-smoke
+	# non-fatal smoke: the solver host killed mid-solve must respawn with
+	# byte-identical placements and zero live zombies (fatal in presubmit)
+	-$(MAKE) host-smoke

@@ -1,0 +1,196 @@
+"""Host smoke (`make host-smoke`, ISSUE 12): kill the solver host mid-solve
+under the LIVE operator and prove the control plane recovers with parity.
+
+The drill, end to end (~60s budget, typically much faster):
+
+  1. a full in-process control plane runs with the production host-mode
+     wiring: HostSolver (supervised sidecar dispatch) under
+     ResilientSolver (greedy fallback + breaker), exactly what
+     operator/__main__ builds when KARPENTER_SOLVER_HOST is on;
+  2. `solver.device.hang` is armed IN THE CHILD (env grammar) so a real
+     device dispatch goes heartbeat-silent mid-solve — the parent
+     watchdog SIGKILLs the host process group (the zombie dies for
+     real), respawns it, and the greedy fallback keeps admitting;
+  3. a second drill SIGKILLs the respawned host directly (the crash
+     shape — no warning, no staleness);
+  4. acceptance: every pod is covered, the host generation advanced for
+     BOTH kills, the breaker re-closed (re-admission = host respawned +
+     probe passed), /debug/health-shape report shows ZERO live zombies,
+     and a post-recovery solve through the host is byte-identical to an
+     in-process TPUSolver solve of the same workload.
+
+Hermetic: forces the CPU backend in-process (same treatment as `make
+verify`'s compile check). Non-fatal in `make verify`, FATAL in
+hack/presubmit.sh — the bench-smoke/soak-smoke pattern.
+"""
+import os
+import signal
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+N_PODS = int(os.environ.get("KCT_HOST_SMOKE_PODS", "8"))
+STALE_AFTER = float(os.environ.get("KCT_HOST_SMOKE_STALE", "3.0"))
+
+
+def main() -> int:
+    from karpenter_core_tpu.api.settings import Settings
+    from karpenter_core_tpu.cloudprovider import fake
+    from karpenter_core_tpu.obs.flightrec import (
+        canonical_placements,
+        placements_json,
+    )
+    from karpenter_core_tpu.operator import new_operator
+    from karpenter_core_tpu.solver.fallback import (
+        SOLVER_WEDGED_TOTAL,
+        CircuitBreaker,
+        ResilientSolver,
+    )
+    from karpenter_core_tpu.solver.host import HostSolver
+    from karpenter_core_tpu.solver.tpu_solver import GreedySolver, TPUSolver
+    from karpenter_core_tpu.testing import make_pod, make_provisioner
+
+    wedged_before = SOLVER_WEDGED_TOTAL.get() or 0.0
+    host = HostSolver(
+        max_nodes=64, stale_after=STALE_AFTER, solve_timeout=60.0,
+        spawn_timeout=120.0,
+        child_env={
+            "KARPENTER_SOLVER_MODE": "single",
+            # the SECOND device dispatch in the child hangs well past the
+            # watchdog: a hard wedge mid-solve under the live operator
+            "KARPENTER_CHAOS":
+                "solver.device.hang=error:none,latency:60,times:1,after:1",
+        },
+    )
+    resilient = ResilientSolver(
+        host, GreedySolver(), small_batch_work_max=0,
+        solve_timeout=120.0, wedge_stale_after=None,  # the host watches
+        reprobe_interval=2.0, probe_timeout=60.0,
+    )
+    cp = fake.FakeCloudProvider(fake.instance_types(10))
+    op = new_operator(
+        cp,
+        settings=Settings(batch_idle_duration=0.02, batch_max_duration=0.2),
+        solver=resilient,
+    )
+    op.provisioning.fallback_solver = resilient
+    op.kube_client.create(make_provisioner(name="default"))
+
+    problems = []
+    op.start()
+    try:
+        for i in range(N_PODS):
+            op.kube_client.create(
+                make_pod(name=f"hs-{i}", requests={"cpu": "1"})
+            )
+        # drive until every pod is covered — through the wedge, the kill,
+        # the respawn, and the breaker cycle
+        deadline = time.monotonic() + 45.0
+        covered = False
+        while time.monotonic() < deadline and not covered:
+            time.sleep(0.1)
+            op.sync_state()
+            result = op.provisioning.schedule()
+            covered = result is None or (
+                not result.new_machines and not result.failed_pods
+            )
+        if not covered:
+            problems.append("admission did not cover every pod in budget")
+        wedged = (SOLVER_WEDGED_TOTAL.get() or 0.0) - wedged_before
+        if wedged < 1:
+            problems.append(
+                "the armed hang never surfaced as a wedge "
+                f"(wedged_total delta {wedged:.0f})"
+            )
+        gen_after_wedge = host.host.generation
+        if gen_after_wedge < 2:
+            problems.append(
+                f"host generation {gen_after_wedge}: the wedged process "
+                "was never killed+respawned"
+            )
+        # crash drill: SIGKILL the respawned host outright. First disarm
+        # the child-env hang — each respawn re-arms from env, and the
+        # parity check below must run against a CLEAN child
+        host.host.child_env.pop("KARPENTER_CHAOS", None)
+        pid = host.host.pid
+        if pid is not None:
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except ProcessLookupError:
+                # a re-wedge beat us to it and the current child was
+                # spawned BEFORE the disarm — kill it so the next respawn
+                # picks up the clean env
+                pid = host.host.pid
+                if pid is not None:
+                    try:
+                        os.kill(pid, signal.SIGKILL)
+                    except ProcessLookupError:
+                        pass
+        deadline = time.monotonic() + 20.0
+        recovered = False
+        while time.monotonic() < deadline and not recovered:
+            time.sleep(0.2)
+            try:
+                recovered = (
+                    resilient.healthy()
+                    and resilient.breaker.state == CircuitBreaker.CLOSED
+                )
+            except Exception:  # noqa: BLE001 — keep polling
+                recovered = False
+        if not recovered:
+            problems.append(
+                "breaker/health did not recover after the crash kill "
+                f"(breaker {resilient.breaker.state})"
+            )
+        if host.host.generation <= gen_after_wedge:
+            problems.append("host generation did not advance after SIGKILL")
+        report = resilient.health_report()
+        if report["abandoned_live"] != 0:
+            problems.append(
+                f"{report['abandoned_live']} live zombie(s) in the "
+                "inventory — host mode must kill them for real"
+            )
+        if not report["host"] or not report["host"]["alive"]:
+            problems.append("health report is missing a live host section")
+        # parity: the recovered host serves byte-identical placements
+        pods = [make_pod(requests={"cpu": "1"}) for _ in range(10)]
+        provisioners = [make_provisioner(name="default")]
+        its = {"default": fake.instance_types(10)}
+        through_host = resilient.solve(pods, provisioners, its)
+        local = TPUSolver(max_nodes=64).solve(pods, provisioners, its)
+        if placements_json(
+            canonical_placements(through_host)
+        ) != placements_json(canonical_placements(local)):
+            problems.append(
+                "post-recovery host solve is NOT byte-identical to the "
+                "in-process solve"
+            )
+    finally:
+        op.stop()
+        host.close()
+
+    if problems:
+        for p in problems:
+            print(f"host-smoke FAIL: {p}", file=sys.stderr)
+        return 1
+    print(
+        f"host-smoke ok: pods={N_PODS} generations={host.host.generation} "
+        f"respawns={host.host.respawns} "
+        f"(wedge killed mid-solve, crash killed, parity byte-identical, "
+        "zero live zombies)"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    rc = main()
+    sys.stdout.flush()
+    sys.stderr.flush()
+    # skip interpreter teardown: watch pumps + XLA's thread pool race
+    # destructors at exit (same dodge as hack/soak.py)
+    os._exit(rc)

@@ -20,8 +20,11 @@ make test
 echo "== presubmit: make perf (>=100 pods/sec floor)"
 make perf
 
-echo "== presubmit: make soak-smoke (seeded churn: SLOs + delta re-solve)"
+echo "== presubmit: make soak-smoke (host-mode churn: SLOs + crash drill + overload shed)"
 make soak-smoke
+
+echo "== presubmit: make soak-smoke-inproc (in-process wedge drill posture)"
+make soak-smoke-inproc
 
 echo "== presubmit: make prewarm-smoke (warm-cache restart under budget)"
 make prewarm-smoke
@@ -34,6 +37,9 @@ make consolidation-smoke
 
 echo "== presubmit: make bench-smoke (wedged stage degrades, --resume backfills)"
 make bench-smoke
+
+echo "== presubmit: make host-smoke (host killed mid-solve: respawn + parity + no zombies)"
+make host-smoke
 
 if [[ "${1:-}" != "quick" ]]; then
   echo "== presubmit: short deflake (3 iterations)"

@@ -36,6 +36,7 @@ import argparse
 import json
 import os
 import sys
+import time
 
 # flight recorder ON for the whole run (the operator default; hack scripts
 # must opt in before the obs import reads the env)
@@ -44,24 +45,57 @@ os.environ.setdefault("KARPENTER_TPU_FLIGHTREC", "1")
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def build_solvers(max_nodes: int, hang_armed: bool = False):
+def build_solvers(max_nodes: int, hang_armed: bool = False,
+                  host_mode: bool = False):
     """(primary, resilient): the resilient pair is the operator wiring —
     health-gated greedy fallback, small-batch routing OFF (churn batches
     are small by nature; the soak exists to exercise the device path under
-    time), a stub prober (the backend was chosen by JAX_PLATFORMS; a
-    subprocess probe would measure the harness, not the loop). The bare
-    primary is returned too so the warmup pass runs through the SAME
-    solver instance: geometry programs trace/compile once and the measured
-    window starts fully jitted.
+    time). The bare primary is returned too so the warmup pass runs
+    through the SAME solver instance: geometry programs trace/compile once
+    and the measured window starts fully jitted.
 
-    With `hang_armed` (the soak-smoke wedge drill) the dispatch watchdog
-    runs at drill scale: a solver.device.hang injection goes heartbeat-
-    stale in ~2s, is abandoned as WEDGED, trips the breaker, and the
-    breaker's half-open prober re-admits the backend ~3s later — the full
-    wedge -> open-breaker -> fallback -> re-admit cycle inside one smoke."""
+    In-process (`make soak`): a stub prober (the backend was chosen by
+    JAX_PLATFORMS; a subprocess probe would measure the harness, not the
+    loop); with `hang_armed` the dispatch watchdog runs at drill scale —
+    a solver.device.hang injection goes heartbeat-stale in ~2s, is
+    abandoned as WEDGED, trips the breaker, and the breaker's half-open
+    prober re-admits the backend ~3s later.
+
+    Host mode (`make soak-smoke`, ISSUE 12): the primary is the
+    HARD-KILLABLE HostSolver — the same hang now wedges the CHILD, whose
+    process group the parent watchdog SIGKILLs and respawns; the prober is
+    the real host probe (re-admission = host respawned + probe passed),
+    and the admission gate runs at drill scale (queue 4, brownout 4,
+    per-request deadline) so the overload burst exercises the whole
+    brownout ladder."""
     from karpenter_core_tpu.solver.fallback import ResilientSolver
     from karpenter_core_tpu.solver.tpu_solver import GreedySolver, TPUSolver
 
+    if host_mode:
+        from karpenter_core_tpu.solver.host import HostSolver
+
+        # stale_after stays at the PRODUCTION threshold even in the drill:
+        # the soak mints fresh geometries whose multi-second XLA compiles
+        # are legitimately heartbeat-silent, and a drill-scale threshold
+        # would kill the child mid-compile — before the persistent cache
+        # is written — respawning into the same compile forever. The
+        # heartbeat-staleness wedge cycle is drilled where compiles are
+        # warm (make host-smoke, tests/test_solver_host.py); the soak
+        # drills the CRASH shape, which needs no staleness.
+        primary = HostSolver(
+            max_nodes=max_nodes,
+            stale_after=600.0,
+            solve_timeout=60.0,
+            spawn_timeout=120.0,
+            max_queue=4, brownout_at=4, queue_deadline_s=30.0,
+            child_env={"KARPENTER_SOLVER_MODE": "single"},
+        )
+        return primary, ResilientSolver(
+            primary, GreedySolver(), small_batch_work_max=0,
+            solve_timeout=120.0, wedge_stale_after=None,  # the HOST watches
+            reprobe_interval=3.0 if hang_armed else 300.0,
+            probe_timeout=60.0,
+        )
     primary = TPUSolver(
         max_nodes=max_nodes, screen_mode="prescreen", profile_phases=True
     )
@@ -77,6 +111,60 @@ def build_solvers(max_nodes: int, hang_armed: bool = False):
     )
 
 
+def overload_burst(resilient, host_primary, n_threads: int = 10):
+    """The overload drill (ISSUE 12): a concurrent solve burst against the
+    host's drill-scale admission gate. Expected shape: the gate sheds
+    (brownout first), every shed request is SERVED by the greedy fallback
+    (brownout ladder: device -> greedy, never an error), zero accepted
+    requests dispatch past their deadline, and sequential latency
+    re-converges once the burst drains."""
+    import threading
+    import time as _time
+
+    from karpenter_core_tpu.cloudprovider import fake as _fake
+    from karpenter_core_tpu.testing import make_pod, make_provisioner
+
+    pods = [make_pod(requests={"cpu": "1"}) for _ in range(12)]
+    provisioners = [make_provisioner(name="burst")]
+    its = {"burst": _fake.instance_types(8)}
+
+    def timed_solve():
+        t0 = _time.monotonic()
+        resilient.solve(pods, provisioners, its)
+        return _time.monotonic() - t0
+
+    timed_solve()  # compile/warm this geometry out of the measurement
+    pre = sorted(timed_solve() for _ in range(3))
+    gate = host_primary.admission
+    shed_before = sum(gate.stats()["shed"].values())
+    errors = []
+
+    def worker():
+        try:
+            resilient.solve(pods, provisioners, its)
+        except Exception as e:  # noqa: BLE001 — counted, asserted zero
+            errors.append(f"{type(e).__name__}: {e}")
+
+    threads = [
+        threading.Thread(target=worker, daemon=True, name=f"burst-{i}")
+        for i in range(n_threads)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60.0)
+    stats = gate.stats()
+    post = sorted(timed_solve() for _ in range(3))
+    return {
+        "shed": sum(stats["shed"].values()) - shed_before,
+        "shed_reasons": stats["shed"],
+        "deadline_violations": stats["deadline_violations"],
+        "errors": errors,
+        "pre_p50_s": round(pre[1], 3),
+        "post_p99_s": round(post[-1], 3),
+    }
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--duration", type=float, default=75.0,
@@ -86,6 +174,12 @@ def main(argv=None) -> int:
                         help="mean pod-arrival events/s")
     parser.add_argument("--smoke", action="store_true",
                         help="<=30s run for CI: 12s, lighter rates")
+    parser.add_argument("--host", action="store_true",
+                        help="run the primary through the hard-killable "
+                             "solver host (solver/host.py): the smoke "
+                             "drill wedges AND crashes the sidecar, and "
+                             "an overload burst exercises the admission "
+                             "gate's brownout ladder")
     parser.add_argument("--no-chaos", action="store_true")
     parser.add_argument("--no-warmup", action="store_true",
                         help="skip the virtual-time compile warmup pass")
@@ -126,8 +220,11 @@ def main(argv=None) -> int:
     # the wedge drill rides the SMOKE variant (make soak-smoke): one
     # solver.device.hang injection mid-soak, detected by heartbeat
     # staleness, recovered through the breaker's prober-gated half-open
+    # (in host mode: through a hard kill + respawn of the sidecar)
     hang_armed = args.smoke and not args.no_chaos
-    primary, resilient = build_solvers(max_nodes, hang_armed=hang_armed)
+    primary, resilient = build_solvers(
+        max_nodes, hang_armed=hang_armed, host_mode=args.host
+    )
     if not args.no_warmup:
         # virtual-time dress rehearsal of the schedule's opening window,
         # through the SAME primary solver instance: same seed => same pods
@@ -152,13 +249,24 @@ def main(argv=None) -> int:
                   seed=args.seed)
         chaos.arm(chaos.CLOUDPROVIDER_CREATE, error="conn", probability=0.02,
                   seed=args.seed + 1)
-    if hang_armed:
+    if hang_armed and not args.host:
         # ONE sleep-past-watchdog hang after the loop is in steady state:
         # the dispatch goes silent for 6s against a 2s staleness
         # threshold — abandoned as wedged, greedy fallback keeps binding,
         # backend re-admitted by the breaker's prober trial ~3s later
         chaos.arm(chaos.SOLVER_DEVICE_HANG, error=None, latency=6.0,
                   times=1, after=2, seed=args.seed + 2)
+    if hang_armed and args.host:
+        # host-mode drill (ISSUE 12): ONE host crash mid-soak — the
+        # parent-side solver.host.crash hook SIGKILLs the sidecar's
+        # process group mid-dispatch — and the cycle the gates below
+        # assert is crash -> eager respawn -> warm recovery from the
+        # persistent compile cache -> byte-identical placements, all
+        # inside the live loop. (The heartbeat-staleness WEDGE cycle is
+        # drilled in make host-smoke and tests/test_solver_host.py, where
+        # compiles are warm and a drill-scale threshold is safe.)
+        chaos.arm(chaos.SOLVER_HOST_CRASH, error="runtime", times=1,
+                  after=8, seed=args.seed + 3)
 
     driver = SoakDriver(
         config, max_nodes=max_nodes, solver=resilient,
@@ -180,7 +288,108 @@ def main(argv=None) -> int:
     columns["churn_seed"] = args.seed
     columns["churn_chaos_armed"] = not args.no_chaos
     drill_failures = []
-    if hang_armed:
+    if args.host:
+        # the burst must start from a HEALTHY primary (a wedge drill may
+        # have just fired): wait out the reprobe TTL so sheds measure the
+        # GATE, not a breaker fast-fail to greedy
+        wait_deadline = time.monotonic() + 15.0
+        while time.monotonic() < wait_deadline and not resilient.healthy():
+            time.sleep(0.5)
+        # overload burst (runs in every host-mode soak, chaos or not):
+        # shed > 0, zero deadline violations among accepted requests,
+        # every shed request served by the greedy ladder (no errors), and
+        # post-burst latency re-converged
+        burst = overload_burst(resilient, primary)
+        columns["churn_overload"] = burst
+        print(
+            f"soak overload burst: shed={burst['shed']} "
+            f"reasons={burst['shed_reasons']} "
+            f"pre_p50={burst['pre_p50_s']}s post_p99={burst['post_p99_s']}s",
+            file=sys.stderr,
+        )
+        if burst["shed"] == 0:
+            drill_failures.append(
+                "overload burst never shed (gate vacuous)"
+            )
+        if burst["deadline_violations"] != 0:
+            drill_failures.append(
+                f"{burst['deadline_violations']} accepted request(s) "
+                "dispatched past their deadline"
+            )
+        if burst["errors"]:
+            drill_failures.append(
+                "brownout must serve greedy before erroring: "
+                f"{burst['errors'][:3]}"
+            )
+        if burst["post_p99_s"] > max(4.0 * burst["pre_p50_s"], 3.0):
+            drill_failures.append(
+                f"post-burst p99 {burst['post_p99_s']}s never re-converged "
+                f"(pre-burst p50 {burst['pre_p50_s']}s)"
+            )
+    if hang_armed and args.host:
+        # host-mode drill gates: the chaos crash fired, the kill
+        # respawned, the breaker re-admitted, and nothing leaked
+        from karpenter_core_tpu.solver.fallback import CircuitBreaker
+
+        crash_fault = chaos.armed_points().get(chaos.SOLVER_HOST_CRASH)
+        crash_injected = crash_fault.injected if crash_fault else 0
+        if crash_injected < 1:
+            drill_failures.append(
+                "solver.host.crash never fired (crash drill vacuous)"
+            )
+        if primary.host.generation < 2:
+            drill_failures.append(
+                f"host generation {primary.host.generation} < 2: the "
+                "crash kill did not respawn"
+            )
+        if resilient.breaker.state != CircuitBreaker.CLOSED:
+            drill_failures.append(
+                f"backend not re-admitted (breaker {resilient.breaker.state})"
+            )
+        elif resilient._healthy is not True:
+            drill_failures.append("solver still unhealthy after host drills")
+        health = resilient.health_report()
+        if health["abandoned_live"] != 0:
+            drill_failures.append(
+                f"{health['abandoned_live']} live zombie(s): host mode "
+                "must kill the wedged process for real"
+            )
+        # byte-identical recovery: the respawned host answers exactly as
+        # an unwedged in-process solve
+        from karpenter_core_tpu.cloudprovider import fake as _fake
+        from karpenter_core_tpu.obs.flightrec import (
+            canonical_placements,
+            placements_json,
+        )
+        from karpenter_core_tpu.solver.tpu_solver import TPUSolver
+        from karpenter_core_tpu.testing import make_pod, make_provisioner
+
+        pods = [make_pod(requests={"cpu": "1"}) for _ in range(10)]
+        provisioners = [make_provisioner(name="default")]
+        its = {"default": _fake.instance_types(10)}
+        through_host = resilient.solve(pods, provisioners, its)
+        local = TPUSolver(max_nodes=max_nodes).solve(pods, provisioners, its)
+        parity = placements_json(
+            canonical_placements(through_host)
+        ) == placements_json(canonical_placements(local))
+        if not parity:
+            drill_failures.append(
+                "post-drill host solve NOT byte-identical to in-process"
+            )
+        columns["churn_host_drill"] = {
+            "crash_injected": crash_injected,
+            "generations": primary.host.generation,
+            "respawns": primary.host.respawns,
+            "live_zombies": health["abandoned_live"],
+            "parity_byte_identical": parity,
+            "readmitted": not drill_failures,
+        }
+        print(
+            f"soak host drill: crash_injected={crash_injected} "
+            f"generations={primary.host.generation} parity={parity}",
+            file=sys.stderr,
+        )
+    if hang_armed and not args.host:
         # the wedge drill's own gates: the hang must actually have been
         # detected as a wedge (not silently absorbed), and the backend
         # must have been RE-ADMITTED before the end of the soak
@@ -234,7 +443,20 @@ def main(argv=None) -> int:
         failures.append("admission histogram recorded nothing")
     if report.unbound_at_end > 0:
         failures.append(f"{report.unbound_at_end} pods stranded unbound")
-    if report.inc_outcomes.get("refresh", 0) == 0:
+    if args.host:
+        # the verdict-tensor residency lives in the CHILD (service-side
+        # incremental path): read its counters over the stats frame
+        try:
+            child_inc = primary.host.stats().get("incremental", {})
+        except Exception as e:  # noqa: BLE001 — a dead host is its own failure
+            child_inc = {}
+            failures.append(f"host stats unreadable: {type(e).__name__}: {e}")
+        print(f"soak host incremental: {child_inc}", file=sys.stderr)
+        if child_inc.get("refresh", 0) == 0:
+            failures.append(
+                "incremental delta re-solve never engaged in the host child"
+            )
+    elif report.inc_outcomes.get("refresh", 0) == 0:
         failures.append("incremental delta re-solve never engaged")
     failures.extend(drill_failures)
     if failures:

@@ -37,7 +37,8 @@ Grammar (see docs/robustness.md):
 Error kinds map to the typed exceptions each edge's hardening classifies:
 conn/timeout/transport (kube transport retries), unavailable/deadline
 (solver RPC retry + circuit breaker), ice/incompatible (cloud-provider
-capacity handling), runtime (generic).
+capacity handling), exhausted (admission-gate shed — RESOURCE_EXHAUSTED),
+runtime (generic).
 """
 from __future__ import annotations
 
@@ -69,6 +70,18 @@ SOLVER_DEVICE = "solver.device"
 # and in the soak harness; the sleeping thread wakes harmlessly later, which
 # is exactly the abandoned-thread shape the supervisor accounting names
 SOLVER_DEVICE_HANG = "solver.device.hang"
+# the host-process crash shape (ISSUE 12): the sidecar solver host dies
+# mid-dispatch (OOM-kill, segfault in the accelerator runtime). The hook
+# lives in the PARENT (solver/host.SolverHost.call): an injected fault is
+# converted into a SIGKILL of the host's process group, so the drill
+# exercises the real crash -> respawn -> warm-recover cycle, not a
+# simulated exception
+SOLVER_HOST_CRASH = "solver.host.crash"
+# queue-full injection at the admission gate (solver/host.AdmissionGate):
+# models overload shedding without needing a real burst — arm with
+# error:exhausted so callers see the same typed RESOURCE_EXHAUSTED a full
+# queue raises
+SOLVER_RPC_OVERLOAD = "solver.rpc.overload"
 STATE_WATCH = "state.watch"
 # the state-store delta feed the incremental solve path gates on
 # (state.Cluster.changes_since): an injected fault models dropped or
@@ -82,6 +95,8 @@ KNOWN_POINTS = (
     SOLVER_RPC,
     SOLVER_DEVICE,
     SOLVER_DEVICE_HANG,
+    SOLVER_HOST_CRASH,
+    SOLVER_RPC_OVERLOAD,
     STATE_WATCH,
     STATE_DIFF,
 )
@@ -125,6 +140,14 @@ def _err_incompatible() -> Exception:
     return IncompatibleRequirementsError("chaos: injected incompatibility")
 
 
+def _err_exhausted() -> Exception:
+    from karpenter_core_tpu.solver.service import SolverResourceExhaustedError
+
+    return SolverResourceExhaustedError(
+        "chaos: injected RESOURCE_EXHAUSTED (admission queue full)"
+    )
+
+
 def _err_runtime() -> Exception:
     return RuntimeError("chaos: injected fault")
 
@@ -139,6 +162,7 @@ ERROR_KINDS: Dict[str, Callable[[], Exception]] = {
     "deadline": _err_deadline,
     "ice": _err_ice,
     "incompatible": _err_incompatible,
+    "exhausted": _err_exhausted,
     "runtime": _err_runtime,
 }
 

@@ -77,6 +77,13 @@ class Counter:
         with self._mu:
             return self.values.get(_labels(labels), 0.0)
 
+    def series(self) -> List[Tuple[Dict[str, str], float]]:
+        """Snapshot of every labeled series: [(labels, value), ...]. Used
+        by out-of-process reporters (the solver host's stats frame) that
+        need the whole counter, not one label combination."""
+        with self._mu:
+            return [(dict(lv), v) for lv, v in self.values.items()]
+
 
 class Gauge:
     def __init__(self, name: str, help: str = ""):

@@ -327,14 +327,24 @@ def run(cloud_provider, kube_client=None, stop_event=None, options=None):
     else:
         primary = solver_from_env()
         if primary is None:
-            # mesh autodetection: >1 visible device -> the multi-chip
-            # ShardedSolver, else single-chip TPUSolver (solver/factory.py —
-            # the production analog of Solve being THE entry,
-            # provisioner.go:297-301, with the v5e-4 fan-out built in)
-            from karpenter_core_tpu.solver.factory import build_solver, describe
+            # the hard-killable solver host (solver/host.py, ISSUE 12) is
+            # the operator DEFAULT: the device dispatch runs in a
+            # supervised sidecar the watchdog can SIGKILL on a wedge, so
+            # one hung XLA call never poisons this process.
+            # KARPENTER_SOLVER_HOST=off restores the in-process path
+            # (mesh autodetection: >1 visible device -> ShardedSolver,
+            # else TPUSolver — solver/factory.py).
+            from karpenter_core_tpu.solver.factory import (
+                build_primary,
+                describe,
+                host_mode_enabled,
+            )
 
-            primary = build_solver()
-            LOG.info("in-process solver", solver=describe(primary))
+            primary = build_primary(host_default=True)
+            if host_mode_enabled(True):
+                LOG.info("solver host enabled", solver="HostSolver")
+            else:
+                LOG.info("in-process solver", solver=describe(primary))
     # production backend-failure defense: subprocess-probe the accelerator,
     # route solves to the host greedy path while it is wedged/unavailable,
     # re-probe for recovery (solver/fallback.py)

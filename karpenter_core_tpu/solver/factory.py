@@ -128,3 +128,24 @@ def build_solver(max_nodes: int = 1024, mode: Optional[str] = None,
 
     return ShardedSolver(mesh, max_nodes=max_nodes, backend=backend,
                          screen_mode=screen_mode)
+
+
+def host_mode_enabled(default: bool = False) -> bool:
+    """KARPENTER_SOLVER_HOST: run the device dispatch in the supervised
+    sidecar process (solver/host.py) instead of in-process. Default OFF
+    here (unit tests, embedders, the host child itself); the operator
+    entrypoint passes default=True — host mode is the production posture,
+    ISSUE 12."""
+    return envflags.get_bool("KARPENTER_SOLVER_HOST", default)
+
+
+def build_primary(max_nodes: int = 1024, host_default: bool = False,
+                  **host_kwargs):
+    """The production primary: the hard-killable HostSolver when
+    KARPENTER_SOLVER_HOST is on (a wedge means kill-and-respawn, not
+    abandon-and-hope), the in-process build_solver() path otherwise."""
+    if host_mode_enabled(host_default):
+        from karpenter_core_tpu.solver.host import HostSolver
+
+        return HostSolver(max_nodes=max_nodes, **host_kwargs)
+    return build_solver(max_nodes=max_nodes)

@@ -16,10 +16,15 @@ defense into the PRODUCTION solve path, per the round-2 verdict:
     (solve_timeout) with a HEARTBEAT (utils/supervise.ThreadHeartbeat,
     touched by the solver's phase marks): a dispatch whose heartbeat goes
     stale is WEDGED and abandoned early — distinct from slow-but-alive,
-    which gets its whole budget. The abandoned thread still leaks by
-    design (better one leaked thread than a stalled control plane), but
-    it is now NAMED (`primary-solve-abandoned-N-<kind>`), counted
-    (karpenter_solver_abandoned_total), and kept for /debug/health;
+    which gets its whole budget. For an IN-PROCESS primary the abandoned
+    thread still leaks with the hung call (better one leaked thread than
+    a stalled control plane) — NAMED (`primary-solve-abandoned-N-<kind>`),
+    counted (karpenter_solver_abandoned_total), inventoried for
+    /debug/health, and moved to a terminal `reaped` state when it finally
+    exits. In HOST mode (solver/host.py, the operator default) the leak is
+    closed for real: the dispatch runs in a sidecar process the watchdog
+    SIGKILLs on staleness, so the abandoned waiter unblocks within the
+    kill window and the live-zombie count returns to zero;
   - a wedge opens the device circuit breaker IMMEDIATELY (no waiting for
     the next reprobe interval) and bumps karpenter_solver_wedged_total;
     re-admission is gated by the out-of-band prober — the breaker's
@@ -260,8 +265,16 @@ class ResilientSolver:
         )
         # post-mortem surfaces for /debug/health
         self.wedge_history: deque = deque(maxlen=32)
-        self._abandoned: deque = deque(maxlen=16)
+        # the abandoned-thread inventory (ISSUE 12 satellite): a LIST of
+        # records, not a deque — the old deque(maxlen=16) silently dropped
+        # older zombies while abandoned_total kept counting, so
+        # /debug/health under-reported. A record reaches the terminal
+        # `reaped` state when its thread finally exits (checked on every
+        # health_report); only REAPED records are ever trimmed — a live
+        # zombie is never dropped from the inventory, however old.
+        self._abandoned: list = []
         self._abandon_count = 0
+        self._reaped_count = 0
         self._abandon_seq = itertools.count(1)
         self._last_hb: Optional[supervise.ThreadHeartbeat] = None
         # serializes the probe + verdict write (concurrent controller
@@ -396,13 +409,47 @@ class ResilientSolver:
                     f"device dispatch {kind} ({reason}); breaker open, "
                     "falling back to the host solver until a probe passes")
 
+    MAX_REAPED_RECORDS = 48
+
+    def _reap_abandoned_locked(self) -> None:
+        """Move exited abandoned threads to the terminal `reaped` state
+        (dropping the thread reference) and trim old REAPED records; live
+        zombies are never dropped — the inventory stays exact."""
+        for rec in self._abandoned:
+            t = rec.get("thread")
+            if t is not None and not t.is_alive():
+                rec["reaped"] = True
+                rec["thread"] = None
+                self._reaped_count += 1
+        if len(self._abandoned) > self.MAX_REAPED_RECORDS:
+            keep = []
+            excess = len(self._abandoned) - self.MAX_REAPED_RECORDS
+            for rec in self._abandoned:
+                if excess > 0 and rec["reaped"]:
+                    excess -= 1
+                    continue
+                keep.append(rec)
+            self._abandoned = keep
+
     def health_report(self) -> dict:
         """The /debug/health payload: heartbeat age of the most recent
-        dispatch, breaker state, wedge history, and the abandoned-thread
-        inventory. Reads only — no probe is triggered."""
+        dispatch, breaker state, wedge history, the abandoned-thread
+        inventory (with reaped/live accounting — host mode drives the live
+        count to zero because the wedged PROCESS is killed), and the
+        solver host's pid/generation/queue state when the primary runs
+        out-of-process. Reads only — no probe is triggered."""
         hb = self._last_hb
         age = hb.age() if hb is not None else None
+        host_report = None
+        hr = getattr(self.primary, "host_report", None)
+        if callable(hr):
+            try:
+                host_report = hr()
+            except Exception as e:  # noqa: BLE001 — report, don't fail health
+                host_report = {"error": f"{type(e).__name__}: {e}"}
         with self._verdict_lock:
+            self._reap_abandoned_locked()
+            live = sum(1 for r in self._abandoned if not r["reaped"])
             return {
                 "healthy": self._healthy,
                 "reason": self._reason,
@@ -412,10 +459,21 @@ class ResilientSolver:
                 "wedge_stale_after_s": self.wedge_stale_after,
                 "wedge_history": list(self.wedge_history),
                 "abandoned_total": self._abandon_count,
+                "abandoned_live": live,
+                "abandoned_reaped": self._reaped_count,
                 "abandoned_threads": [
-                    {"name": t.name, "alive": t.is_alive()}
-                    for t in self._abandoned
+                    {
+                        "name": r["name"],
+                        "kind": r["kind"],
+                        "alive": (
+                            r["thread"].is_alive()
+                            if r["thread"] is not None else False
+                        ),
+                        "reaped": r["reaped"],
+                    }
+                    for r in self._abandoned
                 ],
+                "host": host_report,
             }
 
     def _mark_dead(self, reason: str) -> None:
@@ -540,8 +598,14 @@ class ResilientSolver:
         degradation is now an inventory."""
         n = next(self._abandon_seq)
         t.name = f"primary-solve-abandoned-{n}-{kind}"
-        self._abandon_count = n
-        self._abandoned.append(t)
+        # inventory mutations under the verdict lock: health_report's reap
+        # pass rebuilds the list under the same lock, and an append racing
+        # that rebuild would silently drop this (live!) record
+        with self._verdict_lock:
+            self._abandon_count = n
+            self._abandoned.append(
+                {"name": t.name, "kind": kind, "thread": t, "reaped": False}
+            )
         SOLVER_ABANDONED_TOTAL.inc({"kind": kind})
         LOG.warning(
             "primary solve thread abandoned", kind=kind, thread=t.name,

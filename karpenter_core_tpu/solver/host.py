@@ -1,0 +1,1137 @@
+"""Hard-killable solver host: the device dispatch in a supervised sidecar
+process, plus deadline-aware admission control (ISSUE 12 tentpole).
+
+PR 10 (ISSUE 11) made wedges *detectable*: heartbeat staleness abandons a
+hung in-process dispatch early, the breaker opens, the greedy fallback
+serves. But the abandoned thread still LEAKED — the zombie keeps the GIL /
+device busy until the hung XLA call returns or the process dies
+(solver/fallback.py documents the gap), so one wedge poisons the
+accelerator every control plane depends on. This module kills the zombie
+for real by moving the dispatch across a process boundary it can SIGKILL:
+
+  * ``host_main`` — the sidecar worker (`python -m
+    karpenter_core_tpu.solver.host`): a ``SolverService`` behind
+    length-prefixed frames on stdin/stdout, using the SAME pb-tensor
+    serialization as the gRPC wire (solver/service.py), with the
+    persistent compile cache enabled and a file ``Heartbeat``
+    (utils/supervise) registered as the PROCESS heartbeat — the
+    ``TPUSolver._mark`` phase marks that already touch the in-process
+    thread heartbeat now also touch the file, so the parent's staleness
+    watchdog reads the same progress signal.
+  * ``SolverHost`` — the parent-side process manager: process-group spawn
+    (start_new_session, exactly like ``run_supervised``), heartbeat-file
+    staleness watchdog while a dispatch is in flight, hard ``killpg``
+    SIGKILL on wedge OR budget overrun, eager respawn, env-redacted
+    stderr tails for the post-mortem, and generation/recovery accounting
+    (`karpenter_solver_host_{respawn_total,recovery_seconds}`).
+  * ``AdmissionGate`` — bounded, deadline-aware admission shared by the
+    host facade and the gRPC service: per-request deadlines propagate
+    into the dispatch, a request whose deadline expires while queued is
+    NEVER dispatched, a full queue sheds with a typed RESOURCE_EXHAUSTED
+    carrying a retry-after hint, and a brownout threshold sheds EARLY so
+    the caller's ResilientSolver serves the greedy path before anything
+    turns into an error (the brownout ladder: device -> greedy -> error).
+  * ``HostSolver`` — the in-process Solver facade (same interface as
+    TPUSolver/RemoteSolver): encodes host-side, ships tensors over the
+    pipe, decodes locally. A wedge now means KILL AND RESPAWN, not
+    abandon-and-hope: the respawned host warm-recovers from the
+    persistent compile cache (PR 7) and rebuilds verdict-tensor
+    residency on its first solve (PR 6), and ``health()`` — the
+    ResilientSolver breaker's half-open trial — ensures the host is
+    respawned and probes it, so re-admission literally means "host
+    respawned and probe passed".
+
+The in-process dispatch path stays available: KARPENTER_SOLVER_HOST=off
+(the default outside the operator entrypoint) keeps TPUSolver in-process,
+so unit tests and embedders pay nothing.
+"""
+from __future__ import annotations
+
+import contextlib
+import itertools
+import json
+import os
+import select
+import signal
+import struct
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from karpenter_core_tpu import chaos
+from karpenter_core_tpu.metrics.registry import NAMESPACE, REGISTRY
+from karpenter_core_tpu.obs import TRACER
+from karpenter_core_tpu.obs import envflags
+from karpenter_core_tpu.obs.log import get_logger
+from karpenter_core_tpu.solver import service_pb2 as pb
+from karpenter_core_tpu.solver.fallback import SolverWedgedError
+from karpenter_core_tpu.solver.service import (
+    SolverDeadlineExceededError,
+    SolverResourceExhaustedError,
+    SolverUnavailableError,
+    _StateView,
+    _flatten_args,
+    error_from_string,
+    geometry_json,
+    tensor_from_pb,
+    tensor_to_pb,
+)
+from karpenter_core_tpu.solver.tpu_solver import (
+    SolveResult,
+    decode_solve,
+    device_args,
+    solve_with_relaxation,
+)
+from karpenter_core_tpu.utils import supervise
+
+LOG = get_logger("karpenter.solver.host")
+
+SOLVER_QUEUE_DEPTH = REGISTRY.gauge(
+    f"{NAMESPACE}_solver_queue_depth",
+    "Solver admission-gate depth (in-flight + queued dispatches), by gate",
+)
+SOLVER_SHED_TOTAL = REGISTRY.counter(
+    f"{NAMESPACE}_solver_shed_total",
+    "Solver requests shed by the admission gate instead of queued "
+    "unboundedly, by gate and reason (queue_full, brownout, "
+    "deadline_expired, injected)",
+)
+HOST_RESPAWN_TOTAL = REGISTRY.counter(
+    f"{NAMESPACE}_solver_host_respawn_total",
+    "Solver host processes killed and respawned, by reason "
+    "(wedged = heartbeat stale, timeout = budget overrun, crashed = the "
+    "host died on its own, chaos = injected crash)",
+)
+HOST_RECOVERY_SECONDS = REGISTRY.gauge(
+    f"{NAMESPACE}_solver_host_recovery_seconds",
+    "Seconds from the most recent solver-host spawn to its ready frame "
+    "(process boot; the first solve additionally pays the persistent-"
+    "compile-cache load for its geometry)",
+)
+
+
+# ---------------------------------------------------------------------------
+# deadline-aware admission control
+
+
+class AdmissionGate:
+    """Bounded admission in front of a serial dispatch resource.
+
+    The device dispatch is one resource; under overload, requests must
+    SHED, not queue forever (the reference's level-triggered loop never
+    blocks a reconcile behind an unbounded queue). Contract:
+
+      * at most ``max_queue`` requests wait; the next one shed with a
+        typed RESOURCE_EXHAUSTED carrying ``retry_after_s`` (estimated
+        from queue depth x a service-time EMA);
+      * ``brownout_at`` (< max_queue) sheds EARLY with the same typed
+        error — the caller's ResilientSolver classifies it as a request
+        defect (marks_unhealthy=False) and serves the greedy fallback,
+        so the ladder degrades device -> greedy BEFORE anything errors;
+      * a request admitted with a deadline that expires while it waits is
+        NEVER dispatched (shed as deadline_expired, a typed
+        DEADLINE_EXCEEDED) — expired work reaching the device would burn
+        exactly the capacity the overload lacks.
+
+    Thread-safe; FIFO. ``clock`` is injectable for tests."""
+
+    def __init__(self, name: str = "solver", max_queue: int = 8,
+                 brownout_at: Optional[int] = None, max_inflight: int = 1,
+                 clock=time.monotonic):
+        self.name = name
+        self.max_queue = int(max_queue)
+        self.brownout_at = brownout_at
+        self.max_inflight = int(max_inflight)
+        self._clock = clock
+        self._cond = threading.Condition()
+        self._waiters: list = []
+        self._inflight = 0
+        self._ema: Optional[float] = None
+        self.accepted_total = 0
+        self.dispatched_total = 0
+        self.deadline_violations = 0  # structurally zero; asserted, not hoped
+        self._shed_counts: Dict[str, int] = {}
+
+    # -- internals (callers hold self._cond) --------------------------------
+
+    def _depth_locked(self) -> int:
+        return self._inflight + len(self._waiters)
+
+    def _publish_depth_locked(self) -> None:
+        SOLVER_QUEUE_DEPTH.set(
+            float(self._depth_locked()), {"gate": self.name}
+        )
+
+    def _retry_after_locked(self) -> float:
+        est = self._ema if self._ema is not None else 0.25
+        return min(5.0, (self._depth_locked() + 1) * est)
+
+    def _shed_locked(self, reason: str, retry_after: Optional[float],
+                     detail: str):
+        self._shed_counts[reason] = self._shed_counts.get(reason, 0) + 1
+        SOLVER_SHED_TOTAL.inc({"gate": self.name, "reason": reason})
+        if reason == "deadline_expired":
+            err: Exception = SolverDeadlineExceededError(detail)
+        else:
+            err = SolverResourceExhaustedError(detail)
+        err.shed_reason = reason
+        err.retry_after_s = retry_after
+        return err
+
+    # -- the gate ------------------------------------------------------------
+
+    @contextlib.contextmanager
+    def admitted(self, deadline_s: Optional[float] = None):
+        """Admit one dispatch. ``deadline_s`` is the request's remaining
+        budget in seconds (None = no deadline). Yields the remaining
+        budget at DISPATCH time (never <= 0 — an expired request raises
+        instead). Raises typed RESOURCE_EXHAUSTED / DEADLINE_EXCEEDED on
+        shed; the dispatch itself runs outside the gate's lock."""
+        try:
+            # queue-full injection (chaos `solver.rpc.overload`): the
+            # injected typed error rides the same shed accounting a real
+            # full queue produces
+            chaos.maybe_fail(chaos.SOLVER_RPC_OVERLOAD)
+        except Exception:
+            with self._cond:
+                self._shed_counts["injected"] = (
+                    self._shed_counts.get("injected", 0) + 1
+                )
+            SOLVER_SHED_TOTAL.inc({"gate": self.name, "reason": "injected"})
+            raise
+        clock = self._clock
+        deadline = clock() + deadline_s if deadline_s is not None else None
+        with self._cond:
+            # max_queue bounds WAITERS: a request the idle gate can
+            # dispatch immediately never sheds (max_queue=0 = "busy means
+            # shed", not "never admit")
+            must_wait = (
+                self._inflight >= self.max_inflight or bool(self._waiters)
+            )
+            if must_wait and len(self._waiters) >= self.max_queue:
+                raise self._shed_locked(
+                    "queue_full", self._retry_after_locked(),
+                    f"solver admission queue full "
+                    f"({len(self._waiters)} queued, max {self.max_queue}); "
+                    f"retry_after_ms="
+                    f"{int(self._retry_after_locked() * 1000)}",
+                )
+            if (
+                self.brownout_at is not None
+                and self._depth_locked() >= self.brownout_at
+            ):
+                raise self._shed_locked(
+                    "brownout", self._retry_after_locked(),
+                    f"solver admission brownout (depth "
+                    f"{self._depth_locked()} >= {self.brownout_at}): "
+                    "serve the local fallback; retry_after_ms="
+                    f"{int(self._retry_after_locked() * 1000)}",
+                )
+            ticket = object()
+            self._waiters.append(ticket)
+            self.accepted_total += 1
+            self._publish_depth_locked()
+            try:
+                while (
+                    self._waiters[0] is not ticket
+                    or self._inflight >= self.max_inflight
+                ):
+                    timeout = 0.5
+                    if deadline is not None:
+                        remaining = deadline - clock()
+                        if remaining <= 0:
+                            raise self._shed_locked(
+                                "deadline_expired", None,
+                                f"deadline expired after "
+                                f"{deadline_s:.2f}s budget while queued; "
+                                "never dispatched",
+                            )
+                        timeout = min(timeout, remaining)
+                    self._cond.wait(timeout)
+                # the final pre-dispatch check: an ACCEPTED request must
+                # never reach the device past its deadline
+                if deadline is not None and deadline - clock() <= 0:
+                    raise self._shed_locked(
+                        "deadline_expired", None,
+                        f"deadline expired after {deadline_s:.2f}s budget "
+                        "at dispatch; never dispatched",
+                    )
+            except BaseException:
+                self._waiters.remove(ticket)
+                self._publish_depth_locked()
+                self._cond.notify_all()
+                raise
+            self._waiters.pop(0)
+            self._inflight += 1
+            self.dispatched_total += 1
+            self._publish_depth_locked()
+        t0 = clock()
+        try:
+            yield (deadline - clock()) if deadline is not None else None
+        finally:
+            dt = clock() - t0
+            with self._cond:
+                self._inflight -= 1
+                self._ema = (
+                    dt if self._ema is None else 0.8 * self._ema + 0.2 * dt
+                )
+                self._publish_depth_locked()
+                self._cond.notify_all()
+
+    def stats(self) -> Dict[str, object]:
+        with self._cond:
+            return {
+                "name": self.name,
+                "inflight": self._inflight,
+                "queued": len(self._waiters),
+                "max_queue": self.max_queue,
+                "brownout_at": self.brownout_at,
+                "accepted_total": self.accepted_total,
+                "dispatched_total": self.dispatched_total,
+                "shed": dict(self._shed_counts),
+                "deadline_violations": self.deadline_violations,
+                "service_ema_s": (
+                    round(self._ema, 4) if self._ema is not None else None
+                ),
+            }
+
+
+# ---------------------------------------------------------------------------
+# frame protocol (length-prefixed header JSON + body bytes)
+
+
+def _write_frame(stream, header: Dict[str, object], body: bytes = b"") -> None:
+    hdr = json.dumps(header, sort_keys=True).encode()
+    stream.write(struct.pack(">II", len(hdr), len(body)))
+    stream.write(hdr)
+    if body:
+        stream.write(body)
+    stream.flush()
+
+
+def _read_exact(stream, n: int) -> bytes:
+    chunks = []
+    while n > 0:
+        chunk = stream.read(n)
+        if not chunk:
+            raise EOFError("solver host stream closed")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+def _read_frame(stream) -> Tuple[Dict[str, object], bytes]:
+    hdr_len, body_len = struct.unpack(">II", _read_exact(stream, 8))
+    header = json.loads(_read_exact(stream, hdr_len).decode())
+    body = _read_exact(stream, body_len) if body_len else b""
+    return header, body
+
+
+class _PipeReader:
+    """Deadline-aware reader over the child's stdout fd: select-slices the
+    wait so the caller's ``on_tick`` hook (heartbeat staleness, budget,
+    child liveness) runs between blocks. Raises EOFError on a closed
+    pipe."""
+
+    def __init__(self, f):
+        self._fd = f.fileno()
+        self._buf = b""
+
+    def read_frame(self, on_tick=None, poll_s: float = 0.25):
+        while True:
+            if len(self._buf) >= 8:
+                hdr_len, body_len = struct.unpack(">II", self._buf[:8])
+                total = 8 + hdr_len + body_len
+                if len(self._buf) >= total:
+                    raw = self._buf[:total]
+                    self._buf = self._buf[total:]
+                    header = json.loads(raw[8:8 + hdr_len].decode())
+                    return header, raw[8 + hdr_len:total]
+            ready, _, _ = select.select([self._fd], [], [], poll_s)
+            if ready:
+                chunk = os.read(self._fd, 1 << 16)
+                if not chunk:
+                    raise EOFError("solver host stdout closed")
+                self._buf += chunk
+            elif on_tick is not None:
+                on_tick()
+
+
+# ---------------------------------------------------------------------------
+# parent: the supervised host process
+
+
+class SolverHost:
+    """Spawn/supervise/kill the sidecar dispatch process.
+
+    One dispatch in flight at a time (the device is serial); while one is,
+    the watchdog reads the heartbeat FILE the child's phase marks touch —
+    staleness past ``stale_after`` is a WEDGE (kill the whole process
+    group NOW), budget overrun past ``solve_timeout`` is SLOW (same kill,
+    different classification: the zombie dies either way, the breaker/
+    metrics story distinguishes them). Every kill respawns eagerly so the
+    ResilientSolver breaker's half-open probe finds a live host."""
+
+    def __init__(self, *, stale_after: Optional[float] = 600.0,
+                 solve_timeout: float = 600.0, spawn_timeout: float = 180.0,
+                 probe_timeout: float = 30.0, poll_s: float = 0.25,
+                 child_env: Optional[Dict[str, str]] = None,
+                 workdir: Optional[str] = None):
+        self.stale_after = stale_after
+        self.solve_timeout = solve_timeout
+        self.spawn_timeout = spawn_timeout
+        self.probe_timeout = probe_timeout
+        self.poll_s = poll_s
+        self.child_env = dict(child_env or {})
+        self.workdir = workdir or tempfile.mkdtemp(prefix="kct-solver-host-")
+        self.generation = 0
+        self.respawns = 0
+        self.last_recovery_s: Optional[float] = None
+        self.last_kill: Optional[Dict[str, object]] = None
+        self._proc: Optional[subprocess.Popen] = None
+        self._reader: Optional[_PipeReader] = None
+        self._ready = False
+        self._hb_path = ""
+        self._stderr_path = ""
+        self._spawned_at = 0.0
+        self._seq = itertools.count(1)
+        # serializes frame exchanges (one in-flight dispatch)
+        self._mu = threading.Lock()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def _spawn_locked(self) -> None:
+        self.generation += 1
+        gen = self.generation
+        self._hb_path = os.path.join(self.workdir, f"hb-{gen}")
+        self._stderr_path = os.path.join(self.workdir, f"stderr-{gen}.log")
+        env = dict(envflags.environ())
+        env.update(self.child_env)
+        # the child must never recurse into building its own host
+        env["KARPENTER_SOLVER_HOST"] = "off"
+        stderr_f = open(self._stderr_path, "wb")
+        try:
+            self._proc = subprocess.Popen(
+                [sys.executable, "-m", "karpenter_core_tpu.solver.host",
+                 "--heartbeat", self._hb_path],
+                stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                stderr=stderr_f, env=env, start_new_session=True,
+            )
+        finally:
+            stderr_f.close()
+        self._reader = _PipeReader(self._proc.stdout)
+        self._ready = False
+        self._spawned_at = time.monotonic()
+        if gen > 1:
+            self.respawns += 1
+        LOG.info(
+            "solver host spawned", pid=self._proc.pid, generation=gen,
+        )
+
+    def _stderr_tail(self) -> str:
+        tail = supervise.tail_bytes_of(self._stderr_path, 4096)
+        return supervise.redact_env_text(tail) if tail else ""
+
+    def _kill_locked(self, kind: str, note: str, respawn: bool = True) -> None:
+        proc = self._proc
+        if proc is not None:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            try:
+                proc.wait(timeout=30)
+            except (subprocess.TimeoutExpired, OSError):
+                pass
+            for stream in (proc.stdin, proc.stdout):
+                try:
+                    if stream is not None:
+                        stream.close()
+                except OSError:
+                    pass
+        self.last_kill = {
+            "generation": self.generation,
+            "kind": kind,
+            "note": note,
+            "stderr_tail": self._stderr_tail(),
+        }
+        self._proc = None
+        self._reader = None
+        self._ready = False
+        if respawn:
+            HOST_RESPAWN_TOTAL.inc({"reason": kind})
+        LOG.warning(
+            "solver host killed", kind=kind, note=note,
+            generation=self.generation,
+        )
+        if respawn:
+            # eager respawn: the breaker's half-open trial must find a
+            # live host to probe — "re-admission = host respawned AND
+            # probe passed"
+            self._spawn_locked()
+
+    def close(self) -> None:
+        """Shut the host down (process-group kill; no respawn)."""
+        with self._mu:
+            proc = self._proc
+            if proc is None:
+                return
+            try:
+                _write_frame(proc.stdin, {"op": "shutdown", "id": 0})
+            except (OSError, ValueError):
+                pass
+            self._kill_locked("shutdown", "close() called", respawn=False)
+
+    @property
+    def pid(self) -> Optional[int]:
+        return self._proc.pid if self._proc is not None else None
+
+    def alive(self) -> bool:
+        return self._proc is not None and self._proc.poll() is None
+
+    def heartbeat_age(self) -> Optional[float]:
+        if not self._hb_path:
+            return None
+        return supervise.Heartbeat(self._hb_path).age()
+
+    # -- readiness -----------------------------------------------------------
+
+    def _ensure_running_locked(self) -> None:
+        if self._proc is not None and self._proc.poll() is not None:
+            rc = self._proc.poll()
+            self._kill_locked("crashed", f"host exited rc={rc} between dispatches")
+        if self._proc is None:
+            self._spawn_locked()
+        if not self._ready:
+            self._wait_ready_locked()
+
+    def _wait_ready_locked(self) -> None:
+        deadline = time.monotonic() + self.spawn_timeout
+
+        def tick():
+            if self._proc is None or self._proc.poll() is not None:
+                raise EOFError("solver host died before ready")
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"solver host not ready within {self.spawn_timeout:.0f}s"
+                )
+
+        try:
+            while True:
+                header, _body = self._reader.read_frame(
+                    on_tick=tick, poll_s=self.poll_s
+                )
+                if header.get("op") == "ready":
+                    break
+        except (EOFError, TimeoutError, OSError) as e:
+            tail = self._stderr_tail()
+            self._kill_locked("crashed", f"never became ready: {e}")
+            raise SolverUnavailableError(
+                f"solver host failed to start: {e}"
+                + (f"; stderr tail: {tail[-500:]}" if tail else "")
+            ) from e
+        self._ready = True
+        self.last_recovery_s = time.monotonic() - self._spawned_at
+        HOST_RECOVERY_SECONDS.set(self.last_recovery_s)
+        LOG.info(
+            "solver host ready", pid=self.pid, generation=self.generation,
+            recovery_s=round(self.last_recovery_s, 2),
+        )
+
+    def ensure_running(self) -> None:
+        with self._mu:
+            self._ensure_running_locked()
+
+    # -- dispatch ------------------------------------------------------------
+
+    def call(self, op: str, body: bytes = b"",
+             expires_in_s: Optional[float] = None,
+             timeout: Optional[float] = None,
+             watch_heartbeat: bool = True) -> Tuple[Dict[str, object], bytes]:
+        """One request/response exchange. Kills + respawns the host on
+        heartbeat staleness (SolverWedgedError), budget overrun
+        (TimeoutError — the process is killed, nothing leaks), or death
+        (SolverUnavailableError)."""
+        with self._mu:
+            return self._call_locked(
+                op, body, expires_in_s, timeout, watch_heartbeat
+            )
+
+    def _call_locked(self, op: str, body: bytes,
+                     expires_in_s: Optional[float],
+                     timeout: Optional[float],
+                     watch_heartbeat: bool) -> Tuple[Dict[str, object], bytes]:
+        self._ensure_running_locked()
+        proc = self._proc
+        rid = next(self._seq)
+        header: Dict[str, object] = {"op": op, "id": rid}
+        if expires_in_s is not None:
+            header["expires_in_s"] = round(float(expires_in_s), 3)
+        try:
+            _write_frame(proc.stdin, header, body)
+        except (OSError, ValueError) as e:
+            rc = proc.poll()
+            self._kill_locked("crashed", f"write failed ({e}), rc={rc}")
+            raise SolverUnavailableError(
+                f"solver host crashed before dispatch (rc={rc})"
+            ) from e
+        # the injected host crash (chaos `solver.host.crash`): SIGKILL
+        # the group mid-dispatch so the drill exercises the REAL death
+        # path (EOF detection, respawn, typed error), not a shortcut
+        try:
+            chaos.maybe_fail(chaos.SOLVER_HOST_CRASH)
+        except Exception:
+            try:
+                os.killpg(proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+        budget = timeout if timeout is not None else self.solve_timeout
+        deadline = time.monotonic() + budget
+        hb = supervise.Heartbeat(self._hb_path)
+        dispatch_start = time.monotonic()
+
+        def tick():
+            if proc.poll() is not None:
+                raise EOFError(f"rc={proc.poll()}")
+            now = time.monotonic()
+            if watch_heartbeat and self.stale_after is not None:
+                age = hb.age()
+                silent = (
+                    age if age is not None else now - dispatch_start
+                )
+                if silent >= self.stale_after:
+                    raise _Wedge(silent)
+            if now >= deadline:
+                raise _Overrun(budget)
+
+        try:
+            while True:
+                rheader, rbody = self._reader.read_frame(
+                    on_tick=tick, poll_s=self.poll_s
+                )
+                if rheader.get("op") == "ready":
+                    continue  # a respawn raced this call; skip
+                if rheader.get("id") == rid:
+                    return rheader, rbody
+                # a stale response from a pre-kill request: drop it
+        except _Wedge as w:
+            self._kill_locked(
+                "wedged",
+                f"dispatch heartbeat stale for {w.age:.1f}s "
+                f"(threshold {self.stale_after:.1f}s)",
+            )
+            raise SolverWedgedError(
+                f"solver host dispatch heartbeat stale for "
+                f"{w.age:.0f}s (threshold {self.stale_after:.0f}s): "
+                "host process group killed and respawned "
+                f"(generation {self.generation})"
+            ) from None
+        except _Overrun as o:
+            self._kill_locked(
+                "timeout",
+                f"dispatch exceeded {o.budget:.1f}s budget "
+                "(heartbeat fresh — slow, not wedged)",
+            )
+            raise TimeoutError(
+                f"solver host dispatch exceeded {o.budget:.0f}s budget: "
+                "host process group killed and respawned "
+                f"(generation {self.generation})"
+            ) from None
+        except (EOFError, OSError) as e:
+            tail = self._stderr_tail()
+            self._kill_locked("crashed", f"died mid-dispatch: {e}")
+            raise SolverUnavailableError(
+                f"solver host crashed mid-dispatch ({e}); respawned as "
+                f"generation {self.generation}"
+                + (f"; stderr tail: {tail[-500:]}" if tail else "")
+            ) from e
+
+    def probe(self, timeout: Optional[float] = None) -> Dict[str, object]:
+        """Health round trip — the breaker's half-open trial: ensure the
+        host is (re)spawned, exchange a health frame, raise on anything
+        unhealthy. While a dispatch is in flight, a FRESH heartbeat is
+        proof of life (the service-side wedge-gate analog): busy-but-
+        progressing reports healthy without waiting for the device."""
+        timeout = timeout if timeout is not None else self.probe_timeout
+        acquired = self._mu.acquire(timeout=min(timeout, 1.0))
+        if not acquired:
+            age = self.heartbeat_age()
+            if (
+                self.stale_after is not None
+                and age is not None
+                and age >= self.stale_after
+            ):
+                raise SolverUnavailableError(
+                    f"solver host busy with a dispatch whose heartbeat is "
+                    f"stale ({age:.0f}s)"
+                )
+            return {"status": "busy", "heartbeat_age_s": age}
+        try:
+            # the whole probe runs under this ONE bounded acquire: going
+            # back through call() would re-take the lock unbounded and a
+            # long in-flight dispatch could pin the prober far past its
+            # budget
+            header, body = self._call_locked(
+                "health", b"", None, timeout, False
+            )
+        finally:
+            self._mu.release()
+        if not header.get("ok"):
+            raise SolverUnavailableError(
+                f"solver host health failed: {header.get('error')}"
+            )
+        info = json.loads(body.decode()) if body else {}
+        status = info.get("status", "")
+        if status != "ok":
+            raise SolverUnavailableError(f"solver host unhealthy: {status}")
+        return info
+
+    def stats(self) -> Dict[str, object]:
+        header, body = self.call(
+            "stats", timeout=self.probe_timeout, watch_heartbeat=False
+        )
+        if not header.get("ok"):
+            raise SolverUnavailableError(
+                f"solver host stats failed: {header.get('error')}"
+            )
+        return json.loads(body.decode()) if body else {}
+
+    def report(self) -> Dict[str, object]:
+        """/debug/health payload: pid/generation/liveness/respawn counts.
+        Reads only — no frame exchange."""
+        # sample once: a concurrent respawn swaps the heartbeat path, and
+        # re-reading between the None-check and round() could hand round()
+        # a None mid-kill — exactly when this report matters most
+        age = self.heartbeat_age()
+        recovery = self.last_recovery_s
+        return {
+            "pid": self.pid,
+            "generation": self.generation,
+            "alive": self.alive(),
+            "ready": self._ready,
+            "respawn_total": self.respawns,
+            "last_recovery_s": (
+                round(recovery, 3) if recovery is not None else None
+            ),
+            "heartbeat_age_s": round(age, 3) if age is not None else None,
+            "stale_after_s": self.stale_after,
+            "solve_timeout_s": self.solve_timeout,
+            "last_kill": self.last_kill,
+        }
+
+
+class _Wedge(Exception):
+    def __init__(self, age: float):
+        self.age = age
+
+
+class _Overrun(Exception):
+    def __init__(self, budget: float):
+        self.budget = budget
+
+
+# ---------------------------------------------------------------------------
+# the in-process Solver facade
+
+
+class HostSolver:
+    """Solver interface over the supervised sidecar: encode locally, solve
+    in the host process, decode locally — RemoteSolver's shape, with the
+    pipe + heartbeat watchdog + admission gate where the gRPC channel +
+    breaker would be. ResilientSolver wraps this exactly as it wraps a
+    RemoteSolver (``health`` is callable, so the operator wiring disables
+    its own in-process wedge watchdog — staleness detection lives HERE,
+    where it can actually kill the zombie)."""
+
+    supports_batched_replan = True
+
+    def __init__(self, max_nodes: int = 1024,
+                 max_relax_rounds: Optional[int] = None,
+                 solve_timeout: float = 600.0,
+                 stale_after: Optional[float] = 600.0,
+                 spawn_timeout: float = 180.0,
+                 max_queue: int = 8, brownout_at: Optional[int] = None,
+                 queue_deadline_s: Optional[float] = None,
+                 child_env: Optional[Dict[str, str]] = None,
+                 admission: Optional[AdmissionGate] = None,
+                 host: Optional[SolverHost] = None):
+        self.max_nodes = max_nodes
+        if max_relax_rounds is None:
+            from karpenter_core_tpu.solver.tpu_solver import (
+                DEFAULT_MAX_RELAX_ROUNDS,
+            )
+
+            max_relax_rounds = DEFAULT_MAX_RELAX_ROUNDS
+        self.max_relax_rounds = max_relax_rounds
+        self.queue_deadline_s = queue_deadline_s
+        self.host = host or SolverHost(
+            stale_after=stale_after, solve_timeout=solve_timeout,
+            spawn_timeout=spawn_timeout, child_env=child_env,
+        )
+        self.admission = admission or AdmissionGate(
+            name="host", max_queue=max_queue, brownout_at=brownout_at,
+        )
+        from karpenter_core_tpu.solver.encode import EncodeReuse
+
+        self._encode_reuse = EncodeReuse()
+
+    # -- health / debug ------------------------------------------------------
+
+    def health(self, timeout: float = 30.0) -> Dict[str, object]:
+        """The ResilientSolver prober's entry (probe_for): respawn the
+        host if it is dead, probe it, raise on failure — the breaker's
+        half-open trial is literally 'host respawned and probe passed'."""
+        return self.host.probe(timeout=timeout)
+
+    def host_report(self) -> Dict[str, object]:
+        report = self.host.report()
+        report["admission"] = self.admission.stats()
+        return report
+
+    def close(self) -> None:
+        self.host.close()
+
+    # -- Solver interface ----------------------------------------------------
+
+    def encode(self, pods, provisioners, instance_types, daemonset_pods=None,
+               state_nodes=None, kube_client=None, cluster=None):
+        from karpenter_core_tpu.solver.encode import encode_snapshot
+
+        return encode_snapshot(
+            pods, provisioners, instance_types, daemonset_pods, state_nodes,
+            kube_client=kube_client, cluster=cluster,
+            max_nodes=self.max_nodes, reuse=self._encode_reuse,
+        )
+
+    def _dispatch(self, op: str, request: pb.SolveRequest) -> pb.SolveResponse:
+        body = request.SerializeToString()
+        with self.admission.admitted(self.queue_deadline_s) as remaining:
+            header, rbody = self.host.call(
+                op, body, expires_in_s=remaining,
+            )
+        if not header.get("ok") and header.get("error"):
+            return pb.SolveResponse(error=str(header["error"]))
+        return pb.SolveResponse.FromString(rbody)
+
+    def solve(self, pods, provisioners, instance_types, daemonset_pods=None,
+              state_nodes=None, kube_client=None, cluster=None,
+              encoded=None) -> SolveResult:
+        if encoded is not None and (
+            len(encoded.pods) != len(pods)
+            or {id(p) for p in encoded.pods} != {id(p) for p in pods}
+        ):
+            raise ValueError(
+                "encoded snapshot was built from a different pod batch"
+            )
+        relax_ctx = {"encoded": encoded}
+        return solve_with_relaxation(
+            lambda p: self._solve_once(
+                p, provisioners, instance_types, daemonset_pods, state_nodes,
+                kube_client, cluster, relax_ctx,
+            ),
+            pods, provisioners, instance_types, self.max_relax_rounds,
+        )
+
+    def _solve_once(self, pods, provisioners, instance_types, daemonset_pods,
+                    state_nodes, kube_client, cluster,
+                    relax_ctx=None) -> SolveResult:
+        snap = relax_ctx.pop("encoded", None) if relax_ctx else None
+        if snap is None:
+            from karpenter_core_tpu.solver.encode import encode_snapshot
+
+            with TRACER.span("solver.phase.encode", pods=len(pods)):
+                snap = encode_snapshot(
+                    pods, provisioners, instance_types, daemonset_pods,
+                    state_nodes, kube_client=kube_client, cluster=cluster,
+                    max_nodes=self.max_nodes, reuse=self._encode_reuse,
+                )
+        with TRACER.span("solver.phase.args"):
+            args = device_args(snap, provisioners)
+            request = pb.SolveRequest(
+                geometry=geometry_json(snap),
+                tensors=[tensor_to_pb(n, a) for n, a in _flatten_args(args)],
+            )
+        with TRACER.span("solver.host.request"):
+            response = self._dispatch("solve", request)
+        if response.error:
+            raise error_from_string(response.error)
+        tensors = {t.name: tensor_from_pb(t) for t in response.tensors}
+        log = {
+            k[len("log/"):]: v for k, v in tensors.items()
+            if k.startswith("log/")
+        }
+        state = _StateView(
+            {
+                k[len("state/"):]: v for k, v in tensors.items()
+                if k.startswith("state/")
+            }
+        )
+        ptr = int(np.asarray(tensors["ptr"]).reshape(-1)[0])
+        with TRACER.span("solver.phase.bind"):
+            return decode_solve(snap, (log, ptr), state)
+
+    def prewarm_snapshot(self, snap, provisioners) -> str:
+        """The startup bucket-ladder prewarm (solver/prewarm.py), host
+        edition: dispatch one synthetic solve at the tier's geometry so
+        the CHILD compiles (or disk-loads) the solve + prescreen programs
+        and writes the persistent cache — the warm-recovery budget every
+        later respawn rides. Returns 'compiled' when the child paid a
+        service-site cache miss, 'cached' otherwise."""
+        args = device_args(snap, provisioners)
+        request = pb.SolveRequest(
+            geometry=geometry_json(snap),
+            tensors=[tensor_to_pb(n, a) for n, a in _flatten_args(args)],
+        )
+        before = self.host.stats().get(
+            "compile_cache_misses", {}
+        ).get("service", 0)
+        response = self._dispatch("solve", request)
+        if response.error:
+            raise error_from_string(response.error)
+        after = self.host.stats().get(
+            "compile_cache_misses", {}
+        ).get("service", 0)
+        return "compiled" if after > before else "cached"
+
+    def replan_screen(self, snap, provisioners, count_rows, exist_open,
+                      uninitialized=None, cluster=None,
+                      want_slots: bool = False):
+        """Batched candidate-subset evaluation through the host — the same
+        wire shape as RemoteSolver.replan_screen (one pb request carrying
+        the union snapshot's tensors + the [K, ...] subset planes)."""
+        with TRACER.span("solver.phase.replan.args"):
+            args = device_args(snap, provisioners)
+            tensors = [tensor_to_pb(n, a) for n, a in _flatten_args(args)]
+            E = snap.exist_used.shape[0]
+            uninit = np.zeros(E, dtype=bool)
+            if uninitialized is not None:
+                src = np.asarray(uninitialized, dtype=bool)
+                uninit[: min(len(src), E)] = src[:E]
+            tensors.append(
+                tensor_to_pb(
+                    "replan/count_rows", np.asarray(count_rows, np.int32)
+                )
+            )
+            tensors.append(
+                tensor_to_pb("replan/exist_open", np.asarray(exist_open))
+            )
+            tensors.append(
+                tensor_to_pb("replan/uninitialized", np.asarray(uninit))
+            )
+            tensors.append(
+                tensor_to_pb(
+                    "replan/want_slots",
+                    np.asarray([1 if want_slots else 0], np.int32),
+                )
+            )
+            request = pb.SolveRequest(
+                geometry=geometry_json(snap), tensors=tensors
+            )
+        with TRACER.span("solver.host.replan_request"):
+            response = self._dispatch("replan", request)
+        if response.error:
+            raise error_from_string(response.error)
+        tensors = {t.name: tensor_from_pb(t) for t in response.tensors}
+        verdicts = np.asarray(tensors["verdicts"])
+        pods = (
+            np.asarray(tensors["pods"])
+            if want_slots and "pods" in tensors
+            else None
+        )
+        return verdicts, pods
+
+
+# ---------------------------------------------------------------------------
+# child: the sidecar worker process
+
+
+def _counter_by_label(counter, label: str) -> Dict[str, float]:
+    out: Dict[str, float] = {}
+    for labels, value in counter.series():
+        key = labels.get(label, "")
+        out[key] = out.get(key, 0.0) + value
+    return out
+
+
+def host_main(argv=None) -> int:
+    """`python -m karpenter_core_tpu.solver.host --heartbeat <path>`: serve
+    solve/replan/health/stats frames on stdin/stdout until EOF/shutdown.
+
+    Warm recovery is this function's whole startup story: the persistent
+    compile cache is enabled BEFORE any jit dispatch, so a respawned host
+    reloads its geometry's compiled executables from disk instead of
+    re-paying the cold compile, and the SolverService's incremental
+    residency rebuilds on the first delta solve — the recovery budget a
+    respawn pays is process boot + cache load, a fraction of cold start
+    (tests/test_solver_host.py tripwires it)."""
+    import argparse
+
+    parser = argparse.ArgumentParser(description="karpenter solver host")
+    parser.add_argument("--heartbeat", required=True)
+    args = parser.parse_args(argv)
+
+    start = time.monotonic()
+    # the frame pipe owns fd 1; redirect EVERYTHING else that might write
+    # to stdout (XLA banners, vendored libs) onto stderr so a stray print
+    # can never corrupt a frame
+    out = os.fdopen(os.dup(1), "wb")
+    os.dup2(2, 1)
+    sys.stdout = sys.stderr
+
+    from karpenter_core_tpu.obs import enable_tracing_from_env
+    from karpenter_core_tpu.obs.log import configure_logging_from_env
+    from karpenter_core_tpu.utils.compilecache import enable_persistent_cache
+
+    configure_logging_from_env(default_level="info")
+    enable_tracing_from_env(default_on=False)
+    enable_persistent_cache()
+
+    # the process heartbeat: TPUSolver phase marks (and the service's
+    # per-dispatch marks) touch this FILE through supervise.touch_heartbeat
+    # — the parent's staleness watchdog reads its mtime
+    hb = supervise.Heartbeat(args.heartbeat)
+    supervise.set_process_heartbeat(hb)
+    hb.touch()
+
+    from karpenter_core_tpu.solver.service import SolverService
+
+    mode = envflags.raw("KARPENTER_SOLVER_MODE", "auto").lower()
+    mesh = None
+    if mode != "single":
+        try:
+            from karpenter_core_tpu.solver.factory import detect_mesh
+
+            mesh = detect_mesh()
+        except Exception:  # noqa: BLE001 — auto degrades to single-device
+            if mode == "sharded":
+                raise
+            mesh = None
+    service = SolverService(mesh=mesh)
+    _write_frame(
+        out,
+        {
+            "op": "ready", "id": 0, "pid": os.getpid(),
+            "startup_s": round(time.monotonic() - start, 3),
+        },
+    )
+    LOG.info(
+        "solver host worker ready", pid=os.getpid(),
+        startup_s=round(time.monotonic() - start, 3),
+    )
+    stdin = sys.stdin.buffer
+    while True:
+        try:
+            header, body = _read_frame(stdin)
+        except EOFError:
+            return 0
+        op = header.get("op")
+        rid = header.get("id", 0)
+        if op == "shutdown":
+            return 0
+        hb.touch()
+        try:
+            if op in ("solve", "replan"):
+                expires = header.get("expires_in_s")
+                if expires is not None and float(expires) <= 0:
+                    # deadline backstop: a request that arrives expired is
+                    # never dispatched (the parent gate already enforces
+                    # this; the child re-checks so a queued frame can't
+                    # slip through)
+                    _write_frame(
+                        out,
+                        {"op": "result", "id": rid, "ok": False,
+                         "error": "DEADLINE_EXCEEDED: deadline expired "
+                                  "before host dispatch"},
+                    )
+                    continue
+                request = pb.SolveRequest.FromString(body)
+                handler = service.solve if op == "solve" else service.replan
+                response = handler(request, context=None)
+                _write_frame(
+                    out,
+                    {"op": "result", "id": rid,
+                     "ok": not bool(response.error),
+                     "error": response.error or ""},
+                    response.SerializeToString(),
+                )
+            elif op == "health":
+                age = service._stalest_dispatch_age()
+                if age is not None and age >= service.wedge_stale_after:
+                    status = (
+                        f"wedged: dispatch heartbeat stale for {age:.0f}s"
+                    )
+                    info = {"status": status, "solves": service.solves}
+                else:
+                    import jax
+
+                    dev = jax.devices()[0]
+                    info = {
+                        "status": "ok",
+                        "platform": dev.platform,
+                        "device": dev.device_kind,
+                        "solves": service.solves,
+                        "replans": service.replans,
+                        "pid": os.getpid(),
+                    }
+                _write_frame(
+                    out, {"op": "result", "id": rid, "ok": True},
+                    json.dumps(info, sort_keys=True).encode(),
+                )
+            elif op == "stats":
+                from karpenter_core_tpu.solver.incremental import (
+                    INCREMENTAL_SCREEN_TOTAL,
+                )
+                from karpenter_core_tpu.utils.compilecache import (
+                    CACHE_HITS,
+                    CACHE_MISSES,
+                )
+
+                info = {
+                    "pid": os.getpid(),
+                    "solves": service.solves,
+                    "replans": service.replans,
+                    "incremental": _counter_by_label(
+                        INCREMENTAL_SCREEN_TOTAL, "outcome"
+                    ),
+                    "compile_cache_hits": _counter_by_label(
+                        CACHE_HITS, "site"
+                    ),
+                    "compile_cache_misses": _counter_by_label(
+                        CACHE_MISSES, "site"
+                    ),
+                }
+                _write_frame(
+                    out, {"op": "result", "id": rid, "ok": True},
+                    json.dumps(info, sort_keys=True).encode(),
+                )
+            else:
+                _write_frame(
+                    out,
+                    {"op": "result", "id": rid, "ok": False,
+                     "error": f"INVALID_ARGUMENT: unknown op {op!r}"},
+                )
+        except Exception as e:  # noqa: BLE001 — classified, never fatal
+            from karpenter_core_tpu.solver.service import classify_exception
+
+            code, msg = classify_exception(e)
+            LOG.error(
+                "solver host request failed", op=op,
+                error=type(e).__name__, error_detail=str(e),
+            )
+            try:
+                _write_frame(
+                    out,
+                    {"op": "result", "id": rid, "ok": False,
+                     "error": f"{code}: {msg}"},
+                )
+            except OSError:
+                return 1
+
+
+if __name__ == "__main__":
+    sys.exit(host_main() or 0)

@@ -63,11 +63,16 @@ class SolverRpcError(RuntimeError):
     `transient` drives the client's bounded retry + the circuit breaker
     (transport-shaped: the SAME request may succeed on a healthy channel);
     `marks_unhealthy` drives ResilientSolver — a request defect must not
-    condemn a healthy backend to the fallback path."""
+    condemn a healthy backend to the fallback path. `retry_after_s` is the
+    server's load-shedding hint (admission gate, ISSUE 12): set on
+    RESOURCE_EXHAUSTED sheds so the client retries after the queue has a
+    chance to drain instead of re-landing immediately."""
 
     code_name = "UNKNOWN"
     transient = False
     marks_unhealthy = True
+    retry_after_s: Optional[float] = None
+    shed_reason: Optional[str] = None
 
 
 class SolverUnavailableError(SolverRpcError):
@@ -118,13 +123,28 @@ def classify_exception(e: Exception) -> Tuple[str, str]:
     return "INTERNAL", msg
 
 
+# metadata key the server sets on admission-gate sheds (lowercase — gRPC
+# metadata keys must be); the detail string carries the same hint as
+# `retry_after_ms=N` for the legacy/in-process error-field path
+RETRY_AFTER_METADATA_KEY = "karpenter-retry-after-ms"
+
+
+def _parse_retry_after(detail: str) -> Optional[float]:
+    import re
+
+    m = re.search(r"retry_after_ms=(\d+)", detail or "")
+    return int(m.group(1)) / 1000.0 if m else None
+
+
 def error_from_string(error: str) -> SolverRpcError:
     """Client-side: the legacy response.error field (populated when the
     server handler runs without a gRPC context, i.e. direct in-process
     calls) -> typed error. The server writes 'CODE: detail'."""
     code = error.split(":", 1)[0].strip()
     cls = _ERROR_BY_CODE.get(code, SolverInternalError)
-    return cls(error)
+    err = cls(error)
+    err.retry_after_s = _parse_retry_after(error)
+    return err
 
 
 # ---------------------------------------------------------------------------
@@ -230,7 +250,7 @@ class SolverService:
 
     MAX_REFRESH = 16
 
-    def __init__(self, mesh=None):
+    def __init__(self, mesh=None, admission=None):
         from collections import OrderedDict
 
         if mesh is True:
@@ -238,6 +258,15 @@ class SolverService:
 
             mesh = detect_mesh()
         self.mesh = mesh
+        # deadline-aware admission control (solver/host.AdmissionGate,
+        # ISSUE 12): when set, every Solve/Replan dispatch passes the
+        # bounded gate — the client's gRPC deadline propagates in, a
+        # request whose deadline expires while queued is never dispatched,
+        # and a full queue sheds with RESOURCE_EXHAUSTED + retry-after
+        # instead of queueing unboundedly in the executor. None (direct
+        # in-process construction, the solver-host child) skips the gate —
+        # the caller gates.
+        self.admission = admission
         self._compiled = OrderedDict()
         self._mu = threading.Lock()
         self.solves = 0
@@ -293,6 +322,81 @@ class SolverService:
         ages = [a for a in ages if a is not None]
         return max(ages) if ages else None
 
+    # -- deadline-aware admission (ISSUE 12) --------------------------------
+
+    @staticmethod
+    def _context_deadline(context) -> Optional[float]:
+        """The caller's remaining gRPC deadline in seconds (None = no
+        deadline / no context) — what the admission gate enforces: a
+        request whose budget expires while queued is never dispatched."""
+        if context is None:
+            return None
+        tr = getattr(context, "time_remaining", None)
+        if not callable(tr):
+            return None
+        try:
+            return tr()
+        except Exception:  # noqa: BLE001 — deadline read must never fail a solve
+            return None
+
+    def _abort_shed(self, e: SolverRpcError, context) -> pb.SolveResponse:
+        """Admission-gate shed -> RESOURCE_EXHAUSTED / DEADLINE_EXCEEDED
+        over the wire, with the retry-after hint in trailing metadata (and
+        already embedded in the detail as retry_after_ms=N for the legacy
+        error-field path)."""
+        retry_ms = int((getattr(e, "retry_after_s", None) or 0) * 1000)
+        msg = str(e)
+        if context is not None:
+            import grpc
+
+            if retry_ms:
+                try:
+                    context.set_trailing_metadata(
+                        ((RETRY_AFTER_METADATA_KEY, str(retry_ms)),)
+                    )
+                except Exception:  # noqa: BLE001 — the abort still sheds
+                    pass
+            context.abort(getattr(grpc.StatusCode, e.code_name), msg)
+        return pb.SolveResponse(error=f"{e.code_name}: {msg}")
+
+    def _gated(self, request: pb.SolveRequest, context,
+               traced) -> pb.SolveResponse:
+        """Dispatch `traced` through the admission gate (when configured)
+        then the heartbeat + status-code mapping shared by Solve/Replan."""
+        if self.admission is None:
+            return self._dispatch_mapped(request, context, traced)
+        deadline_s = self._context_deadline(context)
+        try:
+            gate = self.admission.admitted(deadline_s)
+            gate.__enter__()
+        except (SolverResourceExhaustedError,
+                SolverDeadlineExceededError) as e:
+            return self._abort_shed(e, context)
+        try:
+            return self._dispatch_mapped(request, context, traced)
+        finally:
+            gate.__exit__(None, None, None)
+
+    def _dispatch_mapped(self, request: pb.SolveRequest, context,
+                         traced) -> pb.SolveResponse:
+        try:
+            with self._dispatch_heartbeat():
+                return traced(request)
+        except Exception as e:  # noqa: BLE001 — mapped to a status code
+            code_name, msg = classify_exception(e)
+            if context is not None:
+                import grpc
+
+                # PROPER status codes over the wire (not a stringified
+                # exception the client must regex): the client maps the
+                # code back to a typed error the circuit breaker and
+                # ResilientSolver classify. abort() raises.
+                context.abort(getattr(grpc.StatusCode, code_name), msg)
+            # no context: direct in-process call (tests, embedding, the
+            # solver-host child) — the legacy error field carries the same
+            # classification
+            return pb.SolveResponse(error=f"{code_name}: {msg}")
+
     def solve(self, request: pb.SolveRequest, context=None) -> pb.SolveResponse:
         # adopt the client's propagated trace id (metadata interceptor
         # analog): the server-side span joins the control plane's trace so
@@ -309,22 +413,7 @@ class SolverService:
             "solver.service.solve", trace_id=trace_id,
             tensors=len(request.tensors),
         ):
-            try:
-                with self._dispatch_heartbeat():
-                    return self._solve_traced(request)
-            except Exception as e:  # noqa: BLE001 — mapped to a status code
-                code_name, msg = classify_exception(e)
-                if context is not None:
-                    import grpc
-
-                    # PROPER status codes over the wire (not a stringified
-                    # exception the client must regex): the client maps the
-                    # code back to a typed error the circuit breaker and
-                    # ResilientSolver classify. abort() raises.
-                    context.abort(getattr(grpc.StatusCode, code_name), msg)
-                # no context: direct in-process call (tests, embedding) —
-                # the legacy error field carries the same classification
-                return pb.SolveResponse(error=f"{code_name}: {msg}")
+            return self._gated(request, context, self._solve_traced)
 
     @staticmethod
     def _parse_geometry(geometry: dict):
@@ -409,6 +498,14 @@ class SolverService:
     def _solve_traced(self, request: pb.SolveRequest) -> pb.SolveResponse:
         import jax
 
+        # the accelerator edge's chaos hooks, at the SAME contract as the
+        # in-process TPUSolver dispatch (_run_kernels_impl): an injected
+        # error routes to the caller's fallback; a hang (error:none +
+        # latency past the watchdog) goes heartbeat-silent — which is how
+        # host-mode drills (solver/host.py) wedge the sidecar child
+        chaos.maybe_fail(chaos.SOLVER_DEVICE)
+        chaos.maybe_fail(chaos.SOLVER_DEVICE_HANG)
+        supervise.touch_heartbeat()
         geometry = json.loads(request.geometry)
         tensors = {t.name: tensor_from_pb(t) for t in request.tensors}
         args = _unflatten_args(tensors)
@@ -444,10 +541,17 @@ class SolverService:
                     key, geometry, args, pre_fn, host_args=host_args,
                     layout=layout,
                 )
+                supervise.touch_heartbeat()
                 log, ptr, state = fn(screen0, *args)
             else:
                 log, ptr, state = fn(*args)
             jax.block_until_ready(ptr)
+        # progress proof for the dispatch watchdogs (the per-RPC thread
+        # heartbeat AND — in the solver-host child — the process's file
+        # heartbeat the parent's staleness watchdog reads): the longest
+        # legit silent stretch is ONE XLA compile/execute block, which is
+        # what wedge_stale_after must be sized above
+        supervise.touch_heartbeat()
         out = [tensor_to_pb("ptr", np.asarray(ptr))]
         for name, value in log.items():
             out.append(tensor_to_pb(f"log/{name}", np.asarray(value)))
@@ -479,16 +583,7 @@ class SolverService:
             "solver.service.replan", trace_id=trace_id,
             tensors=len(request.tensors),
         ):
-            try:
-                with self._dispatch_heartbeat():
-                    return self._replan_traced(request)
-            except Exception as e:  # noqa: BLE001 — mapped to a status code
-                code_name, msg = classify_exception(e)
-                if context is not None:
-                    import grpc
-
-                    context.abort(getattr(grpc.StatusCode, code_name), msg)
-                return pb.SolveResponse(error=f"{code_name}: {msg}")
+            return self._gated(request, context, self._replan_traced)
 
     def _replan_traced(self, request: pb.SolveRequest) -> pb.SolveResponse:
         import jax
@@ -497,6 +592,10 @@ class SolverService:
         from karpenter_core_tpu.solver.encode import replan_chunks
         from karpenter_core_tpu.utils.compilecache import record_lookup
 
+        # same accelerator-edge chaos contract as _solve_traced
+        chaos.maybe_fail(chaos.SOLVER_DEVICE)
+        chaos.maybe_fail(chaos.SOLVER_DEVICE_HANG)
+        supervise.touch_heartbeat()
         geometry = json.loads(request.geometry)
         tensors = {t.name: tensor_from_pb(t) for t in request.tensors}
         count_rows = np.ascontiguousarray(tensors.pop("replan/count_rows"))
@@ -543,6 +642,10 @@ class SolverService:
             else:
                 verd_h = jax.device_get(verd_dev)
             verdict_parts.append(np.asarray(verd_h)[:k])
+            # per-chunk progress for the dispatch watchdogs: a K-chunked
+            # sweep is many device calls — each completed chunk is proof
+            # of life
+            supervise.touch_heartbeat()
         verdicts = (
             np.concatenate(verdict_parts)
             if verdict_parts else np.zeros((0, 4), np.int32)
@@ -769,12 +872,42 @@ class SolverService:
         return pb.HealthResponse(status="ok", device=device, solves=self.solves)
 
 
-def serve(address: str = "127.0.0.1:0", max_workers: int = 4, mesh=None):
+def serve(address: str = "127.0.0.1:0", max_workers: int = 4, mesh=None,
+          maximum_concurrent_rpcs: Optional[int] = None,
+          max_queue: Optional[int] = 8, brownout_at: Optional[int] = None):
     """Start the gRPC server; returns (server, bound_port, service).
-    mesh=True autodetects a multi-chip mesh (factory.detect_mesh)."""
+    mesh=True autodetects a multi-chip mesh (factory.detect_mesh).
+
+    Overload control (ISSUE 12) has two bounded layers instead of the old
+    unbounded executor queue:
+
+      * `maximum_concurrent_rpcs` caps what gRPC itself accepts — excess
+        RPCs are rejected with RESOURCE_EXHAUSTED at the transport before
+        they ever hold an executor slot (default: workers + queue + 4,
+        enough to keep the admission gate the binding constraint);
+      * the deadline-aware AdmissionGate (`max_queue`, `brownout_at`;
+        max_queue=None disables) queues at most max_queue dispatches, sheds
+        with RESOURCE_EXHAUSTED + a retry-after hint, and never dispatches
+        a request whose gRPC deadline expired while it waited."""
     import grpc
 
-    service = SolverService(mesh=mesh)
+    admission = None
+    if max_queue is not None:
+        from karpenter_core_tpu.solver.host import AdmissionGate
+
+        admission = AdmissionGate(
+            name="service", max_queue=max_queue, brownout_at=brownout_at,
+        )
+        # the executor must be able to HOLD every gate waiter plus the
+        # dispatching handler plus health-probe headroom, or waiters
+        # exhaust the pool and excess RPCs queue unwatched (no deadline
+        # slicing, no shed) in the executor's own queue — the exact
+        # unbounded-queue failure this gate exists to remove. max_workers
+        # is therefore a floor, raised to the gate's capacity.
+        max_workers = max(max_workers, max_queue + 1 + 2)
+    if maximum_concurrent_rpcs is None:
+        maximum_concurrent_rpcs = max_workers + (max_queue or 0) + 4
+    service = SolverService(mesh=mesh, admission=admission)
     handlers = {
         "Solve": grpc.unary_unary_rpc_method_handler(
             service.solve,
@@ -792,7 +925,10 @@ def serve(address: str = "127.0.0.1:0", max_workers: int = 4, mesh=None):
             response_serializer=pb.HealthResponse.SerializeToString,
         ),
     }
-    server = grpc.server(futures.ThreadPoolExecutor(max_workers=max_workers))
+    server = grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers),
+        maximum_concurrent_rpcs=maximum_concurrent_rpcs,
+    )
     server.add_generic_rpc_handlers(
         (grpc.method_handlers_generic_handler(SERVICE, handlers),)
     )
@@ -881,7 +1017,9 @@ class RemoteSolver:
         return response
 
     def _map_rpc_error(self, e) -> SolverRpcError:
-        """grpc.RpcError -> typed error by status code."""
+        """grpc.RpcError -> typed error by status code; the server's
+        retry-after hint (trailing metadata on an admission-gate shed, or
+        retry_after_ms=N in the detail) rides along as retry_after_s."""
         import grpc
 
         code = e.code() if hasattr(e, "code") else None
@@ -890,6 +1028,18 @@ class RemoteSolver:
         cls = _ERROR_BY_CODE.get(name, SolverInternalError)
         err = cls(f"solver service {name}: {details}")
         err.__cause__ = e
+        retry_after = None
+        tm = getattr(e, "trailing_metadata", None)
+        if callable(tm):
+            try:
+                for k, v in tm() or ():
+                    if k == RETRY_AFTER_METADATA_KEY:
+                        retry_after = int(v) / 1000.0
+            except Exception:  # noqa: BLE001 — hint extraction is best-effort
+                retry_after = None
+        if retry_after is None:
+            retry_after = _parse_retry_after(details or "")
+        err.retry_after_s = retry_after
         return err
 
     def _invoke_solve(self, request: pb.SolveRequest, metadata, stub=None):
@@ -946,6 +1096,31 @@ class RemoteSolver:
                 # server-side crashes count toward the breaker too — a
                 # crash-looping service should fail fast, not be hammered
                 self.breaker.record_failure()
+            if (
+                isinstance(err, SolverResourceExhaustedError)
+                and getattr(err, "retry_after_s", None)
+                and attempt < self.rpc_retries
+            ):
+                # an admission-gate shed with a retry-after hint: the
+                # server is UP but overloaded — wait out the hint (plus
+                # jitter so N shed control planes don't re-land in
+                # lockstep) and retry within the same bounded budget the
+                # transient path uses; a still-full queue then raises and
+                # the ResilientSolver serves the greedy fallback
+                from karpenter_core_tpu.utils.backoff import full_jitter
+
+                SOLVER_RPC_RETRIES.inc()
+                LOG.warning(
+                    "solver rpc shed, honoring retry-after",
+                    target=self.target, attempt=attempt + 1,
+                    retry_after_s=err.retry_after_s,
+                )
+                time.sleep(
+                    min(5.0, err.retry_after_s)
+                    + full_jitter(attempt, self.rpc_retry_base, cap=0.5)
+                )
+                attempt += 1
+                continue
             raise err
 
     # the split deployment runs the same batched-replan program family as

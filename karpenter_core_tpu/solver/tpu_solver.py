@@ -1304,6 +1304,9 @@ class TPUSolver:
         from karpenter_core_tpu.solver.encode import replan_chunks
         from karpenter_core_tpu.utils.compilecache import record_lookup
 
+        # dispatch-start heartbeat (same contract as _run_kernels_impl):
+        # staleness counts from the replan dispatch, not the last solve
+        supervise.touch_heartbeat()
         chaos.maybe_fail(chaos.SOLVER_DEVICE)
         # hang-shaped chaos (sleep-past-watchdog): models the wedge, where
         # the dispatch stops progressing instead of erroring
@@ -1635,6 +1638,11 @@ class TPUSolver:
         import jax
         import jax.numpy as jnp
 
+        # dispatch-start heartbeat: staleness counts from HERE, so a hang
+        # injected (or a backend wedge hit) before the first phase mark is
+        # still measured against the dispatch, not whatever touched the
+        # heartbeat last (the solver-host watchdog reads the same mark)
+        supervise.touch_heartbeat()
         # chaos hook: the accelerator edge — an injected fault here is the
         # wedged-backend failure that cost two bench rounds, and must route
         # the solve to ResilientSolver's fallback, never stall the loop

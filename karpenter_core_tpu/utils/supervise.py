@@ -99,6 +99,26 @@ class ThreadHeartbeat:
 # no-op — the hook is safe on every path.
 _TLS = threading.local()
 
+# process-level heartbeat (solver/host.py): a supervised WORKER PROCESS
+# (the solver-host sidecar) registers its file Heartbeat here once at boot,
+# and every touch_heartbeat() — from ANY thread — also touches it, so the
+# parent's file-staleness watchdog sees the same phase-mark progress the
+# in-process thread watchdog does. None (the default) is a no-op: the
+# thread-local path's cost is unchanged for every existing caller.
+_PROCESS_HB: Optional["Heartbeat"] = None
+
+
+def set_process_heartbeat(hb) -> None:
+    """Register a process-wide heartbeat (file Heartbeat or ThreadHeartbeat
+    — anything with touch()) that every touch_heartbeat() call also touches.
+    Pass None to unregister."""
+    global _PROCESS_HB
+    _PROCESS_HB = hb
+
+
+def process_heartbeat():
+    return _PROCESS_HB
+
 
 def bind_heartbeat(hb: Optional[ThreadHeartbeat]) -> None:
     _TLS.heartbeat = hb
@@ -108,6 +128,8 @@ def touch_heartbeat() -> None:
     hb = getattr(_TLS, "heartbeat", None)
     if hb is not None:
         hb.touch()
+    if _PROCESS_HB is not None:
+        _PROCESS_HB.touch()
 
 
 def bound_heartbeat() -> Optional[ThreadHeartbeat]:

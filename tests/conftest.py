@@ -53,6 +53,12 @@ enable_persistent_cache()
 # (tests/test_bucket_ladder.py) drive it explicitly.
 os.environ.setdefault("KARPENTER_PREWARM", "0")
 
+# the out-of-process solver host (solver/host.py) stays OFF in unit tests
+# for the same reason: operator-runtime suites would each spawn (and
+# cold-boot) a sidecar python process. The host suite
+# (tests/test_solver_host.py) constructs HostSolver explicitly.
+os.environ.setdefault("KARPENTER_SOLVER_HOST", "0")
+
 
 def pytest_configure(config):
     config.addinivalue_line(

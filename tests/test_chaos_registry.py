@@ -115,11 +115,16 @@ def test_error_kinds_build_typed_errors():
         SolverUnavailableError,
     )
 
+    from karpenter_core_tpu.solver.service import (
+        SolverResourceExhaustedError,
+    )
+
     for kind, exc in [
         ("ice", InsufficientCapacityError),
         ("incompatible", IncompatibleRequirementsError),
         ("unavailable", SolverUnavailableError),
         ("deadline", SolverDeadlineExceededError),
+        ("exhausted", SolverResourceExhaustedError),
         ("conn", ConnectionResetError),
         ("timeout", TimeoutError),
         ("transport", ConnectionError),
@@ -230,3 +235,32 @@ def test_hang_point_parses_as_latency_only_fault():
     fault = faults[c.SOLVER_DEVICE_HANG]
     assert fault.error is None and fault.latency == 600.0
     assert fault.times == 1
+
+
+def test_host_crash_point_parses():
+    """solver.host.crash (ISSUE 12): the SIGKILL-the-sidecar shape — any
+    error kind works (the SolverHost hook converts the injection into a
+    process-group kill), and the point is a KNOWN_POINTS member so env
+    specs can arm it."""
+    from karpenter_core_tpu import chaos as c
+
+    assert c.SOLVER_HOST_CRASH in c.KNOWN_POINTS
+    faults = c.parse_spec("solver.host.crash=error:runtime,times:1,after:2")
+    fault = faults[c.SOLVER_HOST_CRASH]
+    assert fault.times == 1 and fault.after == 2
+
+
+def test_rpc_overload_point_parses_with_exhausted_kind():
+    """solver.rpc.overload (ISSUE 12): queue-full injection at the
+    admission gate — error:exhausted builds the same typed
+    RESOURCE_EXHAUSTED a real full queue raises."""
+    from karpenter_core_tpu import chaos as c
+    from karpenter_core_tpu.solver.service import (
+        SolverResourceExhaustedError,
+    )
+
+    assert c.SOLVER_RPC_OVERLOAD in c.KNOWN_POINTS
+    faults = c.parse_spec("solver.rpc.overload=error:exhausted,p:0.5,seed:7")
+    fault = faults[c.SOLVER_RPC_OVERLOAD]
+    assert fault.probability == 0.5
+    assert isinstance(fault._build_error(), SolverResourceExhaustedError)

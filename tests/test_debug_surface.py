@@ -161,3 +161,42 @@ def test_debug_health_reports_solver_wedge_state():
         assert payload["solver"]["wedge_history"][-1]["kind"] == "wedged"
     finally:
         server.shutdown()
+
+
+def test_debug_health_surfaces_solver_host_state():
+    """ISSUE 12: a HostSolver primary's pid/generation/queue state rides
+    the same ungated /debug/health payload — the first thing an operator
+    needs when host-mode provisioning degrades."""
+    from karpenter_core_tpu.operator import __main__ as entry, new_operator
+    from karpenter_core_tpu.solver.fallback import ResilientSolver
+    from karpenter_core_tpu.solver.tpu_solver import GreedySolver
+
+    class Hostish(GreedySolver):
+        """Quacks like solver/host.HostSolver without spawning a child."""
+
+        def health(self, timeout=30.0):
+            return {"status": "ok"}
+
+        def host_report(self):
+            return {
+                "pid": 4242, "generation": 3, "alive": True,
+                "respawn_total": 2,
+                "admission": {"queued": 0, "shed": {"queue_full": 1}},
+            }
+
+    solver = ResilientSolver(Hostish(), GreedySolver(), prober=lambda: None)
+    operator = new_operator(
+        fake.FakeCloudProvider(), settings=entry.settings_from_env()
+    )
+    server = entry.serve_health(operator, 0, profiling=False, solver=solver)
+    port = server.server_address[1]
+    try:
+        status, body = _get(port, "/debug/health")
+        assert status == 200
+        host = json.loads(body)["solver"]["host"]
+        assert host["pid"] == 4242
+        assert host["generation"] == 3
+        assert host["respawn_total"] == 2
+        assert host["admission"]["shed"] == {"queue_full": 1}
+    finally:
+        server.shutdown()

@@ -308,6 +308,68 @@ def test_health_report_shape_for_debug_endpoint():
     assert report["heartbeat_age_s"] is not None
     assert report["wedge_stale_after_s"] == 0.3
     assert report["abandoned_threads"] == []
+    assert report["abandoned_live"] == 0
+    assert report["abandoned_reaped"] == 0
+    assert report["host"] is None, (
+        "an in-process primary has no host section; HostSolver primaries "
+        "fill it with pid/generation/queue state"
+    )
+
+
+def test_abandoned_zombie_reaped_when_thread_finally_exits():
+    """ISSUE 12 satellite: an abandoned thread reaches a TERMINAL reaped
+    state once the hung call returns — the inventory distinguishes a live
+    zombie (still holding the device) from a historical one."""
+    primary, resilient = _wedge_pair(lambda: None)
+    inputs = _inputs()
+    resilient._healthy = True
+    resilient._last_probe = time.time()
+    # a SHORT hang: wedged at 0.3s staleness, but the zombie wakes ~0.7s
+    # later and exits — at which point it must be reaped, not forgotten
+    chaos.arm(chaos.SOLVER_DEVICE_HANG, error=None, latency=1.0, times=1)
+    resilient.solve(*inputs)  # wedges; greedy serves
+    report = resilient.health_report()
+    assert report["abandoned_total"] == 1
+    deadline = time.monotonic() + 10.0
+    while time.monotonic() < deadline:
+        report = resilient.health_report()
+        if report["abandoned_live"] == 0:
+            break
+        time.sleep(0.1)
+    assert report["abandoned_live"] == 0, "the zombie exited: reap it"
+    assert report["abandoned_reaped"] == 1
+    assert report["abandoned_total"] == 1
+    [t] = report["abandoned_threads"]
+    assert t["reaped"] is True and t["alive"] is False
+    assert t["name"].startswith("primary-solve-abandoned-1-wedged")
+
+
+def test_abandoned_inventory_never_drops_live_zombies():
+    """The old deque(maxlen=16) silently dropped older zombies while
+    abandoned_total kept counting — /debug/health under-reported. The
+    inventory now trims only REAPED records; every live zombie stays
+    listed no matter how many abandonments came after it."""
+
+    class FakeThread:
+        def __init__(self, alive):
+            self._alive = alive
+            self.name = ""
+
+        def is_alive(self):
+            return self._alive
+
+    primary, resilient = _wedge_pair(lambda: None)
+    for i in range(60):
+        resilient._abandon(FakeThread(alive=(i % 10 == 0)), "wedged", 1.0)
+    report = resilient.health_report()
+    assert report["abandoned_total"] == 60
+    assert report["abandoned_live"] == 6
+    live = [t for t in report["abandoned_threads"] if not t["reaped"]]
+    assert len(live) == 6, "every live zombie must stay inventoried"
+    assert len(report["abandoned_threads"]) <= (
+        ResilientSolver.MAX_REAPED_RECORDS
+    ), "reaped records are trimmed to the bound"
+    assert report["abandoned_reaped"] == 54
 
 
 def test_wedge_cycle_through_operator_admission_continues():

@@ -1,0 +1,437 @@
+"""Solver-host suite (ISSUE 12): the hard-killable sidecar dispatch.
+
+What the tentpole promises, asserted:
+  * parity — solve/replan through the host are byte-identical to the
+    in-process TPUSolver (flightrec-canonical, the repo's standing bar);
+  * a chaos-induced hard wedge (solver.device.hang armed in the CHILD) is
+    KILLED for real: the wedged process is gone (no live zombie), the
+    host respawns, and the next solve is byte-identical to an unwedged
+    run; the ResilientSolver cycle on top re-admits through "host
+    respawned and probe passed";
+  * warm recovery — a respawned host (persistent compile cache) solves at
+    a fraction of the cold start, and rebuilds verdict-tensor residency
+    on its first delta solve;
+  * deadline-aware admission — a request whose deadline expires while
+    queued is NEVER dispatched; a full queue sheds with a typed
+    RESOURCE_EXHAUSTED carrying retry-after; brownout sheds early.
+"""
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from karpenter_core_tpu import chaos
+from karpenter_core_tpu.cloudprovider import fake
+from karpenter_core_tpu.obs.flightrec import (
+    canonical_placements,
+    placements_json,
+)
+from karpenter_core_tpu.solver.fallback import SolverWedgedError
+from karpenter_core_tpu.solver.host import AdmissionGate, HostSolver
+from karpenter_core_tpu.solver.service import (
+    SolverDeadlineExceededError,
+    SolverResourceExhaustedError,
+    SolverUnavailableError,
+)
+from karpenter_core_tpu.solver.tpu_solver import TPUSolver
+from karpenter_core_tpu.testing import make_pod, make_provisioner
+
+# the child pins the single-device program family: the test process forces
+# 8 virtual CPU devices (conftest XLA_FLAGS, inherited by the child), and
+# parity must compare like against like
+CHILD_ENV = {"KARPENTER_SOLVER_MODE": "single"}
+
+
+def _workload(n=10):
+    pods = [make_pod(requests={"cpu": "1"}) for _ in range(n)]
+    return pods, [make_provisioner(name="default")], {
+        "default": fake.instance_types(10)
+    }
+
+
+def _canon(result) -> bytes:
+    return placements_json(canonical_placements(result))
+
+
+@pytest.fixture(scope="module")
+def host():
+    hs = HostSolver(
+        max_nodes=32, child_env=CHILD_ENV,
+        spawn_timeout=120.0, solve_timeout=120.0,
+    )
+    yield hs
+    hs.close()
+
+
+@pytest.fixture(autouse=True)
+def _no_chaos():
+    yield
+    chaos.reset()
+
+
+# ---------------------------------------------------------------------------
+# parity
+
+
+def test_host_solve_byte_identical_to_in_process(host):
+    pods, provisioners, its = _workload()
+    through_host = host.solve(pods, provisioners, its)
+    local = TPUSolver(max_nodes=32).solve(pods, provisioners, its)
+    assert not through_host.failed_pods
+    assert _canon(through_host) == _canon(local)
+
+
+def test_host_replan_matches_in_process(host):
+    from karpenter_core_tpu.state.node import StateNode
+    from karpenter_core_tpu.testing import make_node
+
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(4)}
+    pods = [
+        make_pod(labels={"app": f"r{i % 3}"}, requests={"cpu": "0.5"})
+        for i in range(9)
+    ]
+    nodes = [
+        StateNode(node=make_node(
+            name=f"hn-{i}",
+            labels={
+                "karpenter.sh/provisioner-name": "default",
+                "karpenter.sh/initialized": "true",
+            },
+            capacity={"cpu": "8", "memory": "16Gi", "pods": "50"},
+        ))
+        for i in range(3)
+    ]
+    snap = host.encode(pods, provisioners, its, state_nodes=nodes)
+    E = snap.exist_used.shape[0]
+    count_rows = np.zeros((3, snap.item_pad), np.int32)
+    count_rows[:, 0] = (1, 2, 3)
+    exist_open = np.ones((3, E), bool)
+    exist_open[1, 0] = False
+    host_v, host_p = host.replan_screen(
+        snap, provisioners, count_rows, exist_open, want_slots=True
+    )
+    local_v, local_p = TPUSolver(max_nodes=32).replan_screen(
+        snap, provisioners, count_rows, exist_open, want_slots=True
+    )
+    assert np.array_equal(host_v, local_v)
+    assert np.array_equal(host_p, local_p)
+
+
+# ---------------------------------------------------------------------------
+# crash -> respawn (chaos solver.host.crash, parent-side hook)
+
+
+def test_crash_injection_kills_and_respawns(host):
+    pods, provisioners, its = _workload()
+    baseline = host.solve(pods, provisioners, its)
+    gen_before = host.host.generation
+    with chaos.armed(chaos.SOLVER_HOST_CRASH, error="runtime", times=1):
+        with pytest.raises(SolverUnavailableError):
+            host.solve(pods, provisioners, its)
+    assert host.host.generation == gen_before + 1
+    assert host.host.last_kill["kind"] == "crashed"
+    # the respawned host answers, byte-identical to the pre-crash run
+    assert _canon(host.solve(pods, provisioners, its)) == _canon(baseline)
+
+
+def test_prewarm_snapshot_through_host(host):
+    """The operator's bucket-ladder prewarm thread works against a
+    HostSolver primary: the first dispatch at a geometry warms the CHILD
+    (jit + persistent cache), and a repeat is a cache hit."""
+    pods, provisioners, its = _workload(6)
+    snap = host.encode(pods, provisioners, its)
+    first = host.prewarm_snapshot(snap, provisioners)
+    assert first in ("compiled", "cached")
+    assert host.prewarm_snapshot(snap, provisioners) == "cached"
+
+
+def test_host_report_shape(host):
+    report = host.host_report()
+    assert report["alive"] is True
+    assert report["pid"] is not None
+    assert report["generation"] >= 1
+    assert report["respawn_total"] >= 0
+    assert report["last_recovery_s"] is not None
+    gate = report["admission"]
+    assert gate["deadline_violations"] == 0
+    assert "shed" in gate and "queued" in gate
+
+
+# ---------------------------------------------------------------------------
+# residency rebuild across a respawn
+
+
+def test_residency_rebuilt_after_respawn(host):
+    pods, provisioners, its = _workload()
+    host.solve(pods, provisioners, its)
+    host.solve(pods, provisioners, its)
+    stats = host.host.stats()
+    assert stats["incremental"].get("refresh", 0) >= 1, (
+        "consecutive same-geometry solves must ride the delta refresh"
+    )
+    # kill the child outright; the next call transparently respawns
+    os.kill(host.host.pid, signal.SIGKILL)
+    time.sleep(0.1)
+    host.solve(pods, provisioners, its)
+    fresh = host.host.stats()
+    assert fresh["incremental"].get("full_miss", 0) >= 1, (
+        "a respawned host has no resident tensor: first solve is a full "
+        "prescreen"
+    )
+    assert fresh["incremental"].get("refresh", 0) == 0
+    host.solve(pods, provisioners, its)
+    fresh = host.host.stats()
+    assert fresh["incremental"].get("refresh", 0) >= 1, (
+        "residency must REBUILD: the second post-respawn solve refreshes"
+    )
+
+
+# ---------------------------------------------------------------------------
+# hard wedge: chaos hang in the CHILD -> kill -> respawn -> parity
+
+
+def test_wedge_kills_host_for_real_and_respawn_is_byte_identical():
+    hs = HostSolver(
+        max_nodes=32, stale_after=6.0, solve_timeout=90.0,
+        spawn_timeout=120.0,
+        child_env={
+            **CHILD_ENV,
+            # the SECOND device dispatch goes silent well past the
+            # watchdog (the sleeping child is killed mid-sleep)
+            "KARPENTER_CHAOS":
+                "solver.device.hang=error:none,latency:30,times:1,after:1",
+        },
+    )
+    try:
+        pods, provisioners, its = _workload()
+        baseline = hs.solve(pods, provisioners, its)
+        wedged_pid = hs.host.pid
+        t0 = time.monotonic()
+        with pytest.raises(SolverWedgedError):
+            hs.solve(pods, provisioners, its)
+        wedge_latency = time.monotonic() - t0
+        assert wedge_latency < 25.0, (
+            "the wedge must be detected in heartbeat-time, not the 30s "
+            f"hang's (took {wedge_latency:.1f}s)"
+        )
+        # the zombie is KILLED, not abandoned: the wedged process is gone
+        time.sleep(0.3)
+        with pytest.raises(ProcessLookupError):
+            os.kill(wedged_pid, 0)
+        assert hs.host.generation == 2
+        assert hs.host.respawns == 1
+        assert hs.host.last_kill["kind"] == "wedged"
+        # warm respawn serves the SAME answer
+        post = hs.solve(pods, provisioners, its)
+        assert _canon(post) == _canon(baseline)
+        assert hs.health(timeout=60.0)["status"] == "ok"
+    finally:
+        hs.close()
+
+
+def test_resilient_cycle_over_host_no_live_zombies():
+    """The operator-shaped cycle: wedge -> greedy fallback -> breaker open
+    -> half-open trial = 'host respawned and probe passed' -> byte-
+    identical primary solve. /debug/health shows ZERO live zombies (the
+    wedged PROCESS died; no thread leaked) and the host's generation."""
+    from karpenter_core_tpu.solver.fallback import (
+        CircuitBreaker,
+        ResilientSolver,
+    )
+    from karpenter_core_tpu.solver.tpu_solver import GreedySolver
+
+    hs = HostSolver(
+        max_nodes=32, stale_after=6.0, solve_timeout=90.0,
+        spawn_timeout=120.0,
+        child_env={
+            **CHILD_ENV,
+            "KARPENTER_CHAOS":
+                "solver.device.hang=error:none,latency:30,times:1,after:1",
+        },
+    )
+    resilient = ResilientSolver(
+        hs, GreedySolver(), small_batch_work_max=0,
+        solve_timeout=120.0, wedge_stale_after=None,  # the HOST watches
+        reprobe_interval=1.0, probe_timeout=60.0,
+    )
+    try:
+        inputs = _workload()
+        r1 = resilient.solve(*inputs)
+        r2 = resilient.solve(*inputs)  # wedges; greedy serves
+        assert r2.pod_count_new() == len(inputs[0]), (
+            "fallback must keep admitting through the wedge"
+        )
+        assert resilient.breaker.state == CircuitBreaker.OPEN
+        report = resilient.health_report()
+        assert report["wedge_history"][-1]["kind"] == "wedged"
+        assert report["abandoned_live"] == 0, (
+            "host mode must leave NO live zombie: the wedged process was "
+            "killed and the waiter unblocked"
+        )
+        assert report["host"]["generation"] == 2, (
+            "the host must have respawned by the time the wedge surfaced"
+        )
+        # half-open trial: the prober (host respawned + probe passed)
+        time.sleep(1.1)
+        r3 = resilient.solve(*inputs)
+        assert resilient.breaker.state == CircuitBreaker.CLOSED
+        assert resilient._healthy is True
+        assert _canon(r3) == _canon(r1), (
+            "the re-admitted host must serve byte-identical placements"
+        )
+    finally:
+        hs.close()
+
+
+# ---------------------------------------------------------------------------
+# warm-recovery budget: respawn <<< cold start
+
+
+def test_warm_respawn_fraction_of_cold_start(tmp_path):
+    """The recovery-budget tripwire: a respawned host (persistent compile
+    cache populated) must complete the same-geometry solve in a fraction
+    of the cold start (fresh cache: jit trace + full XLA compile)."""
+    hs = HostSolver(
+        max_nodes=32, solve_timeout=180.0, spawn_timeout=120.0,
+        child_env={
+            **CHILD_ENV,
+            "KARPENTER_COMPILE_CACHE_DIR": str(tmp_path / "xla-cache"),
+        },
+    )
+    try:
+        pods, provisioners, its = _workload()
+        t0 = time.monotonic()
+        cold_result = hs.solve(pods, provisioners, its)
+        cold_s = time.monotonic() - t0
+        os.kill(hs.host.pid, signal.SIGKILL)
+        time.sleep(0.1)
+        t0 = time.monotonic()
+        warm_result = hs.solve(pods, provisioners, its)  # auto-respawn
+        warm_s = time.monotonic() - t0
+        assert hs.host.generation == 2
+        assert _canon(warm_result) == _canon(cold_result)
+        if cold_s < 2.0:
+            pytest.skip(
+                f"cold start {cold_s:.2f}s too fast to discriminate "
+                "warm-vs-cold on this machine"
+            )
+        assert warm_s < 0.8 * cold_s, (
+            f"warm respawn ({warm_s:.2f}s) must be a fraction of cold "
+            f"start ({cold_s:.2f}s): the persistent compile cache is the "
+            "recovery budget"
+        )
+    finally:
+        hs.close()
+
+
+# ---------------------------------------------------------------------------
+# deadline-aware admission (gate-level; the gRPC layer rides the same gate)
+
+
+def _occupied_gate(**kwargs):
+    gate = AdmissionGate(name="test", **kwargs)
+    release = threading.Event()
+    started = threading.Event()
+
+    def occupy():
+        with gate.admitted():
+            started.set()
+            release.wait(20)
+
+    t = threading.Thread(target=occupy, daemon=True, name="gate-occupier")
+    t.start()
+    assert started.wait(5)
+    return gate, release, t
+
+
+def test_deadline_expired_in_queue_never_dispatched():
+    gate, release, t = _occupied_gate(max_queue=4)
+    dispatched_before = gate.dispatched_total
+    t0 = time.monotonic()
+    with pytest.raises(SolverDeadlineExceededError) as exc:
+        with gate.admitted(deadline_s=0.25):
+            pass
+    assert time.monotonic() - t0 < 2.0
+    assert "never dispatched" in str(exc.value)
+    assert gate.dispatched_total == dispatched_before, (
+        "an expired request must NEVER reach the dispatch"
+    )
+    assert gate.stats()["shed"]["deadline_expired"] == 1
+    release.set()
+    t.join(5)
+    assert gate.stats()["deadline_violations"] == 0
+
+
+def test_queue_full_sheds_with_retry_after():
+    gate, release, t = _occupied_gate(max_queue=0)
+    with pytest.raises(SolverResourceExhaustedError) as exc:
+        with gate.admitted():
+            pass
+    err = exc.value
+    assert err.shed_reason == "queue_full"
+    assert err.retry_after_s and err.retry_after_s > 0
+    assert "retry_after_ms=" in str(err)
+    assert err.marks_unhealthy is False, (
+        "a shed is a request outcome, not a dead backend — ResilientSolver "
+        "must serve greedy without condemning the primary"
+    )
+    release.set()
+    t.join(5)
+
+
+def test_idle_gate_with_zero_queue_still_dispatches():
+    gate = AdmissionGate(name="idle", max_queue=0)
+    with gate.admitted() as remaining:
+        assert remaining is None
+    assert gate.dispatched_total == 1
+
+
+def test_brownout_sheds_before_queue_full():
+    gate, release, t = _occupied_gate(max_queue=8, brownout_at=1)
+    with pytest.raises(SolverResourceExhaustedError) as exc:
+        with gate.admitted():
+            pass
+    assert exc.value.shed_reason == "brownout"
+    release.set()
+    t.join(5)
+
+
+def test_overload_chaos_injection_sheds():
+    gate = AdmissionGate(name="chaos-gate", max_queue=8)
+    with chaos.armed(chaos.SOLVER_RPC_OVERLOAD, error="exhausted", times=1):
+        with pytest.raises(SolverResourceExhaustedError):
+            with gate.admitted():
+                pass
+    assert gate.stats()["shed"]["injected"] == 1
+    with gate.admitted():  # the fault auto-recovered (times=1)
+        pass
+
+
+def test_host_deadline_propagates_to_dispatch(host):
+    """The facade's queue deadline reaches the gate: an occupied host gate
+    sheds a short-deadline solve as DEADLINE_EXCEEDED without dispatching."""
+    release = threading.Event()
+    started = threading.Event()
+
+    def occupy():
+        with host.admission.admitted():
+            started.set()
+            release.wait(20)
+
+    t = threading.Thread(target=occupy, daemon=True, name="host-occupier")
+    t.start()
+    assert started.wait(5)
+    was = host.queue_deadline_s
+    host.queue_deadline_s = 0.2
+    try:
+        pods, provisioners, its = _workload(4)
+        with pytest.raises(SolverDeadlineExceededError):
+            host.solve(pods, provisioners, its)
+    finally:
+        host.queue_deadline_s = was
+        release.set()
+        t.join(5)

@@ -144,3 +144,104 @@ def test_remote_replan_matches_in_process(server):
     assert np.array_equal(remote_v, local_v)
     assert np.array_equal(remote_p, local_p)
     assert service.replans >= 1
+
+
+# ---------------------------------------------------------------------------
+# overload control (ISSUE 12): bounded server + deadline-aware admission
+
+
+def test_serve_defaults_include_admission_gate(server):
+    _port, service = server
+    assert service.admission is not None, (
+        "serve() must bound its queue by default — the old unbounded "
+        "executor queue is the failure ISSUE 12 removes"
+    )
+
+
+def test_overload_sheds_resource_exhausted_with_retry_after():
+    """A full admission queue sheds over the wire: RESOURCE_EXHAUSTED
+    (typed, marks_unhealthy=False) with the server's retry-after hint in
+    trailing metadata — never an unbounded executor queue."""
+    from karpenter_core_tpu.solver.service import (
+        SolverResourceExhaustedError,
+        serve,
+    )
+
+    server, port, service = serve(max_workers=4, max_queue=0)
+    try:
+        gate = service.admission.admitted()
+        gate.__enter__()  # occupy: queue capacity is zero, next RPC sheds
+        client = RemoteSolver(f"127.0.0.1:{port}", rpc_retries=0)
+        pods = [make_pod(requests={"cpu": "1"}) for _ in range(4)]
+        with pytest.raises(SolverResourceExhaustedError) as exc:
+            client.solve(
+                pods, [make_provisioner(name="d")],
+                {"d": fake.instance_types(4)},
+            )
+        err = exc.value
+        assert err.retry_after_s and err.retry_after_s > 0, (
+            "the shed must carry the server's retry-after hint"
+        )
+        assert err.marks_unhealthy is False
+        gate.__exit__(None, None, None)
+    finally:
+        server.stop(0)
+
+
+def test_client_retry_honors_retry_after_hint():
+    """RemoteSolver honors the shed's retry-after with backoff+jitter
+    (the ISSUE 2 transport pattern, now on the solver RPC client): a
+    queue that drains within the hint makes the retried RPC succeed."""
+    import threading
+
+    from karpenter_core_tpu.solver.service import serve
+
+    server, port, service = serve(max_workers=4, max_queue=0)
+    try:
+        gate = service.admission.admitted()
+        gate.__enter__()
+        client = RemoteSolver(f"127.0.0.1:{port}", rpc_retries=2)
+        release = threading.Timer(
+            0.4, lambda: gate.__exit__(None, None, None)
+        )
+        release.start()
+        pods = [make_pod(requests={"cpu": "1"}) for _ in range(4)]
+        result = client.solve(
+            pods, [make_provisioner(name="d")], {"d": fake.instance_types(4)}
+        )
+        assert not result.failed_pods, (
+            "the retried RPC must land once the queue drains"
+        )
+    finally:
+        server.stop(0)
+
+
+def test_expired_deadline_never_dispatched_over_wire():
+    """A gRPC deadline that expires while the request waits in the
+    admission queue surfaces as DEADLINE_EXCEEDED and the dispatch never
+    runs (service.solves unchanged)."""
+    from karpenter_core_tpu.solver.service import (
+        SolverDeadlineExceededError,
+        serve,
+    )
+
+    server, port, service = serve(max_workers=4, max_queue=4)
+    try:
+        gate = service.admission.admitted()
+        gate.__enter__()  # hold the gate past the client deadline
+        client = RemoteSolver(
+            f"127.0.0.1:{port}", timeout=0.5, rpc_retries=0
+        )
+        solves_before = service.solves
+        pods = [make_pod(requests={"cpu": "1"}) for _ in range(4)]
+        with pytest.raises(SolverDeadlineExceededError):
+            client.solve(
+                pods, [make_provisioner(name="d")],
+                {"d": fake.instance_types(4)},
+            )
+        gate.__exit__(None, None, None)
+        assert service.solves == solves_before, (
+            "an expired-in-queue request must never reach the dispatch"
+        )
+    finally:
+        server.stop(0)
