@@ -4,7 +4,7 @@
 
 .PHONY: test deflake perf bench verify trace-demo chaos chaos-smoke \
 	replay-demo lint soak soak-smoke soak-smoke-inproc prewarm-smoke \
-	multichip-smoke consolidation-smoke bench-smoke host-smoke
+	multichip-smoke consolidation-smoke bench-smoke host-smoke race-smoke
 
 test:  ## tier-1 suite (CPU, 8 virtual devices); slow chaos soaks: make chaos
 	python -m pytest tests -q -m "not slow"
@@ -24,8 +24,16 @@ trace-demo:  ## small traced solve -> /tmp/karpenter_trace.json (validated)
 replay-demo:  ## flight-recorded solve -> dump -> byte-identical replay
 	python hack/replay.py --demo
 
-lint:  ## static analysis (trace-safety/layering/env-flags/monotonic-time/concurrency/no-print)
+lint:  ## static analysis, all passes (rule catalog: docs/static-analysis.md)
 	python hack/lint.py
+
+race-smoke:  ## the -race gate at full depth: lock-heavy suites, racewatch exhaustive
+	# sampling off + per-field access cap disabled (tier-1 runs the same
+	# detector with default bounds; this lane trades speed for depth).
+	# Non-fatal in verify, FATAL in hack/presubmit.sh.
+	KARPENTER_RACEWATCH=1 KARPENTER_RACEWATCH_SAMPLE=1 KARPENTER_RACEWATCH_CAP=0 \
+	python -m pytest tests/test_solver_host.py tests/test_resilient_recovery.py \
+		tests/test_supervise.py tests/test_racewatch.py -q
 
 chaos:  ## fault-injection suite (incl. slow schedule cases), fixed seed
 	KARPENTER_CHAOS_SEED=42 python -m pytest \
@@ -74,6 +82,13 @@ verify:  ## driver hooks: single-chip compile check + 8-way mesh dryrun
 	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 	# static analysis (fatal): all passes, empty baseline, no suppressions
 	$(MAKE) lint
+	# the -race gate's own suites (fatal): the three ISSUE 13 passes'
+	# good/bad fixtures, the sarif/changed/parallel driver modes, the
+	# self-lint zero-violation wall, and the lockwatch/racewatch canaries
+	# (seeded deadlock cycle + seeded data race must be DETECTED)
+	python -m pytest tests/test_analysis_framework.py \
+		tests/test_analysis_passes.py tests/test_self_lint.py \
+		tests/test_lockwatch.py tests/test_racewatch.py -q
 	# metrics-scraper suite: the scrape-race/startup-guard regressions
 	python -m pytest tests/test_metrics_controllers.py -q
 	# pack-kernel structural tripwires (fatal): the prescreen scan body
@@ -115,3 +130,6 @@ verify:  ## driver hooks: single-chip compile check + 8-way mesh dryrun
 	# non-fatal smoke: the solver host killed mid-solve must respawn with
 	# byte-identical placements and zero live zombies (fatal in presubmit)
 	-$(MAKE) host-smoke
+	# non-fatal smoke: the lock-heavy suites under the exhaustive racewatch
+	# posture — sampling off, cap off (fatal gate lives in presubmit)
+	-$(MAKE) race-smoke

@@ -5,6 +5,8 @@ Usage:
   python hack/lint.py                  # all passes, fatal on any violation
   python hack/lint.py --list-rules     # rule catalog
   python hack/lint.py --rule no-print --rule layering
+  python hack/lint.py --changed        # report only files differing from main
+  python hack/lint.py --format sarif   # SARIF 2.1.0 for CI PR annotation
   python hack/lint.py --update-baseline  # absorb current violations (debt
                                          # marker — the checked-in baseline
                                          # must ship empty)
@@ -15,7 +17,9 @@ Exit codes: 0 clean, 1 violations, 2 usage error.
 from __future__ import annotations
 
 import argparse
+import json
 import os
+import subprocess
 import sys
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -32,6 +36,72 @@ from karpenter_core_tpu.analysis.core import collect_sources  # noqa: E402
 DEFAULT_BASELINE = os.path.join(REPO_ROOT, "hack", "lint-baseline.txt")
 
 
+def changed_relpaths(base: str = "main") -> set:
+    """Repo-relative paths of files differing from `base` (committed,
+    staged, or unstaged) plus untracked files — what a PR's reviewable
+    surface is. Raises RuntimeError outside a git checkout."""
+    out = set()
+    for args in (
+        ["git", "diff", "--name-only", base, "--"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    ):
+        proc = subprocess.run(
+            args, cwd=REPO_ROOT, capture_output=True, text=True
+        )
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"{' '.join(args)} failed: {proc.stderr.strip()}"
+            )
+        out.update(line.strip() for line in proc.stdout.splitlines() if line.strip())
+    return out
+
+
+def sarif_payload(passes, result) -> dict:
+    """SARIF 2.1.0 over the kept violations: one rule entry per rule id,
+    one result per violation (region startLine), so CI can annotate PRs."""
+    rule_ids = sorted({r for p in passes for r in p.rules})
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [
+            {
+                "tool": {
+                    "driver": {
+                        "name": "karpenter-lint",
+                        "informationUri": (
+                            "docs/static-analysis.md"
+                        ),
+                        "rules": [
+                            {
+                                "id": rule,
+                                "shortDescription": {"text": rule},
+                                "helpUri": "docs/static-analysis.md",
+                            }
+                            for rule in rule_ids
+                        ],
+                    }
+                },
+                "results": [
+                    {
+                        "ruleId": v.rule,
+                        "level": "error",
+                        "message": {"text": v.message},
+                        "locations": [
+                            {
+                                "physicalLocation": {
+                                    "artifactLocation": {"uri": v.relpath},
+                                    "region": {"startLine": max(v.line, 1)},
+                                }
+                            }
+                        ],
+                    }
+                    for v in result.violations
+                ],
+            }
+        ],
+    }
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     parser.add_argument(
@@ -45,6 +115,22 @@ def main(argv=None) -> int:
     )
     parser.add_argument("--list-rules", action="store_true")
     parser.add_argument(
+        "--format", choices=("text", "sarif"), default="text",
+        help="violation output format (sarif: SARIF 2.1.0 on stdout)",
+    )
+    parser.add_argument(
+        "--changed", nargs="?", const="main", default=None, metavar="BASE",
+        help="report only files differing from BASE (default: main). The "
+        "passes still see the whole package (layering needs the global "
+        "import graph); only the REPORT is filtered, so per-file findings "
+        "are identical to a full run",
+    )
+    parser.add_argument(
+        "--workers", type=int, default=min(8, os.cpu_count() or 1),
+        help="thread-pool width for file-scope passes (1 = sequential; "
+        "findings are identical either way)",
+    )
+    parser.add_argument(
         "-q", "--quiet", action="store_true", help="violations only, no summary"
     )
     args = parser.parse_args(argv)
@@ -57,10 +143,10 @@ def main(argv=None) -> int:
         return 0
 
     rules = set(args.rule) if args.rule else None
-    if rules and args.update_baseline:
-        # a filtered update would silently drop every other rule's entries
-        print("lint: --update-baseline requires a full run (drop --rule)",
-              file=sys.stderr)
+    if args.update_baseline and (rules or args.changed):
+        # a filtered update would silently drop every other entry
+        print("lint: --update-baseline requires a full run "
+              "(drop --rule/--changed)", file=sys.stderr)
         return 2
     if rules:
         known = {r for p in passes for r in p.rules}
@@ -70,11 +156,29 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             return 2
 
+    changed = None
+    if args.changed is not None:
+        try:
+            changed = changed_relpaths(args.changed)
+        except (RuntimeError, OSError) as e:
+            print(f"lint: --changed unavailable: {e}", file=sys.stderr)
+            return 2
+
     config = default_config(REPO_ROOT)
     files = collect_sources(REPO_ROOT, config.package_name)
     baseline = load_baseline(args.baseline) if not args.update_baseline else set()
     result = run_passes(files, config, passes=passes, rules=rules,
-                        baseline=baseline)
+                        baseline=baseline, workers=max(1, args.workers))
+    if changed is not None:
+        result.violations = [
+            v for v in result.violations if v.relpath in changed
+        ]
+        result.suppressed = [
+            v for v in result.suppressed if v.relpath in changed
+        ]
+        result.baselined = [
+            v for v in result.baselined if v.relpath in changed
+        ]
 
     if args.update_baseline:
         with open(args.baseline, "w", encoding="utf-8") as f:
@@ -87,6 +191,11 @@ def main(argv=None) -> int:
               f"{'y' if len(result.violations) == 1 else 'ies'}")
         return 0
 
+    if args.format == "sarif":
+        json.dump(sarif_payload(passes, result), sys.stdout, indent=2)
+        sys.stdout.write("\n")
+        return 1 if result.violations else 0
+
     for v in result.violations:
         print(v.render())
     if not args.quiet:
@@ -95,6 +204,8 @@ def main(argv=None) -> int:
             parts.append(f"{len(result.suppressed)} suppressed")
         if result.baselined:
             parts.append(f"{len(result.baselined)} baselined")
+        if changed is not None:
+            parts.append(f"changed-only: {len(changed)} file(s) vs {args.changed}")
         ran = sorted(rules) if rules else sorted(r for p in passes for r in p.rules)
         print(f"lint: {', '.join(parts)} — rules: {', '.join(ran)}")
     return 1 if result.violations else 0

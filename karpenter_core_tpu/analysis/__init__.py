@@ -16,8 +16,15 @@ Passes (rule ids in parentheses):
   montime       (monotonic-time)  — time.time() banned outside the audited
                                     wall-clock allowlist
   concurrency   (bare-except,     — exception/thread/lock discipline with
-                 thread-discipline,  guarded-by inference for self._lock
-                 guarded-by)
+                 thread-discipline,  guarded-by inference for self._lock;
+                 guarded-by,         v2 adds intraprocedural lockset
+                 guarded-by-v2)      summaries incl. acquire()/release()
+  procdiscipline (proc-group,     — process-group spawn discipline,
+                 proc-kill-group,    killpg convention, joined non-daemon
+                 thread-join)        child-waiter threads
+  atomicwrite   (atomic-write)    — artifact writes must be atomic
+                                    (write-temp-fsync-rename) for the
+                                    resume/health/replay readers
   noprint       (no-print)        — bare print() in production code
 """
 from karpenter_core_tpu.analysis.core import (  # noqa: F401
@@ -33,11 +40,13 @@ from karpenter_core_tpu.analysis.config import AnalysisConfig, default_config  #
 
 def all_passes():
     """Instantiate every registered pass, import-cycle-free at module load."""
+    from karpenter_core_tpu.analysis.atomicwrite import AtomicWritePass
     from karpenter_core_tpu.analysis.concurrency import ConcurrencyPass
     from karpenter_core_tpu.analysis.envdiscipline import EnvDisciplinePass
     from karpenter_core_tpu.analysis.layering import LayeringPass
     from karpenter_core_tpu.analysis.montime import MonotonicTimePass
     from karpenter_core_tpu.analysis.noprint import NoPrintPass
+    from karpenter_core_tpu.analysis.procdiscipline import ProcessDisciplinePass
     from karpenter_core_tpu.analysis.trace_safety import TraceSafetyPass
 
     return [
@@ -46,5 +55,7 @@ def all_passes():
         EnvDisciplinePass(),
         MonotonicTimePass(),
         ConcurrencyPass(),
+        ProcessDisciplinePass(),
+        AtomicWritePass(),
         NoPrintPass(),
     ]

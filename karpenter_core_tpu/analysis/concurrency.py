@@ -21,6 +21,19 @@ unnecessary or the unguarded write is a race. Inference is syntactic:
   - `__init__`/`__post_init__`/`__new__` writes are construction, exempt
   - methods named `*_locked` (config.locked_suffix) are callee-guarded by
     convention: the caller holds the lock, so their writes count as guarded
+
+Rule `guarded-by-v2` (ISSUE 13): the lockset upgrade of `guarded-by`.
+Where v1 reduces "guarded" to a boolean (inside ANY `with self.<lock>:`),
+v2 computes an intraprocedural lockset summary per method — which lock
+attributes are held at each write, flowing through `with` nesting AND the
+`self.X.acquire()` / `self.X.release()` statement pattern v1 cannot see.
+Per attribute, the candidate lockset is the intersection of every
+non-exempt write's held set (Eraser's discipline, statically): if some
+write is guarded but the intersection is EMPTY, the writes missing the
+protecting lock are flagged — catching an attribute written under
+`self._lock_a` in one method and `self._lock_b` (or no lock) in another,
+even when the second method never mentions the first lock. Findings v1
+already reports (same line, same attribute) are not re-reported.
 """
 from __future__ import annotations
 
@@ -63,9 +76,17 @@ class _Write:
     guarded: bool
 
 
+@dataclass
+class _LocksetWrite:
+    attr: str
+    line: int
+    method: str
+    lockset: frozenset
+
+
 class ConcurrencyPass(Pass):
     name = "concurrency"
-    rules = ("bare-except", "thread-discipline", "guarded-by")
+    rules = ("bare-except", "thread-discipline", "guarded-by", "guarded-by-v2")
 
     def run(self, files: Sequence[SourceFile], config) -> List[Violation]:
         out: List[Violation] = []
@@ -156,6 +177,7 @@ class ConcurrencyPass(Pass):
             by_attr.setdefault(w.attr, []).append(w)
 
         out: List[Violation] = []
+        v1_flagged: Set[Tuple[int, str]] = set()
         for attr, ws in sorted(by_attr.items()):
             if attr in lock_attrs:
                 continue
@@ -166,6 +188,7 @@ class ConcurrencyPass(Pass):
                     f"{w.method}:{w.line}" for w in guarded[:3]
                 )
                 for w in unguarded:
+                    v1_flagged.add((w.line, attr))
                     out.append(Violation(
                         relpath=f.relpath, line=w.line, rule="guarded-by",
                         message=(
@@ -176,7 +199,185 @@ class ConcurrencyPass(Pass):
                             "if the caller holds it"
                         ),
                     ))
+        out.extend(
+            self._check_guarded_by_v2(f, cls, config, lock_attrs, v1_flagged)
+        )
         return out
+
+    # -- guarded-by-v2: intraprocedural lockset summaries --------------------
+
+    def _check_guarded_by_v2(
+        self, f: SourceFile, cls: ast.ClassDef, config,
+        lock_attrs: Set[str], v1_flagged: Set[Tuple[int, str]],
+    ) -> List[Violation]:
+        writes: List[_LocksetWrite] = []
+        for method in cls.body:
+            if not isinstance(method, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if method.name in INIT_METHODS or method.name.endswith(
+                config.locked_suffix
+            ):
+                continue  # construction / callee-guarded: exempt, and they
+                # must not poison the intersection either
+            self._lockset_flow(
+                method.body, frozenset(), method.name, lock_attrs, writes
+            )
+
+        by_attr: Dict[str, List[_LocksetWrite]] = {}
+        for w in writes:
+            by_attr.setdefault(w.attr, []).append(w)
+
+        out: List[Violation] = []
+        for attr, ws in sorted(by_attr.items()):
+            if attr in lock_attrs:
+                continue
+            if not any(w.lockset for w in ws):
+                continue  # never written under a lock: v2 has no evidence
+            common = frozenset.intersection(*[w.lockset for w in ws])
+            if common:
+                continue  # a consistent protecting lock exists
+            # the protecting candidate: the lock most writes hold
+            counts: Dict[str, int] = {}
+            for w in ws:
+                for lock in w.lockset:
+                    counts[lock] = counts.get(lock, 0) + 1
+            protect = max(sorted(counts), key=lambda k: counts[k])
+            held_lines = ", ".join(
+                f"{w.method}:{w.line}" for w in ws if protect in w.lockset
+            )
+            for w in ws:
+                if protect in w.lockset:
+                    continue
+                if (w.line, attr) in v1_flagged:
+                    continue  # v1 already reports this exact write
+                under = ", ".join(sorted(w.lockset)) or "no lock"
+                out.append(Violation(
+                    relpath=f.relpath, line=w.line, rule="guarded-by-v2",
+                    message=(
+                        f"{cls.name}.{attr} written under [{under}] in "
+                        f"{w.method}() but under {protect} at {held_lines} "
+                        "— the write locksets share no common lock; hold "
+                        f"{protect} at every write (or rename the method "
+                        f"with the '{config.locked_suffix}' suffix if the "
+                        "caller holds it)"
+                    ),
+                ))
+        return out
+
+    def _lockset_flow(
+        self,
+        body: List[ast.stmt],
+        held: frozenset,
+        method: str,
+        lock_attrs: Set[str],
+        writes: List[_LocksetWrite],
+    ) -> frozenset:
+        """Statement-ordered lockset flow through one body: `with self.X:`
+        scopes X over its block; `self.X.acquire(...)` holds X from that
+        statement on (conditional acquires count — the common pattern is
+        `if not self.X.acquire(False): return`); `self.X.release()` drops
+        it. Compound statements recurse per sub-body, so a `with` nested
+        in an `if`/`try` still scopes correctly; acquires/releases found
+        anywhere in a compound statement propagate to its siblings."""
+        current = held
+        for stmt in body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # nested defs are their own analysis context
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                block = set(current)
+                for item in stmt.items:
+                    expr = item.context_expr
+                    if isinstance(expr, ast.Call):
+                        expr = expr.func
+                    attr = _self_attr(expr)
+                    if not attr and isinstance(expr, ast.Attribute):
+                        attr = _self_attr(expr.value)
+                    if attr in lock_attrs:
+                        block.add(attr)
+                self._lockset_flow(
+                    stmt.body, frozenset(block), method, lock_attrs, writes
+                )
+                # acquire()/release() inside the block outlive it
+                current = self._apply_lock_calls(stmt, current, lock_attrs)
+                continue
+            sub_bodies = self._sub_bodies(stmt)
+            if sub_bodies:
+                # header acquires (`if not self.X.acquire(): return`) are
+                # held inside the bodies; each body starts from there
+                entry = self._apply_lock_calls(
+                    stmt, current, lock_attrs, headers_only=True
+                )
+                for sub in sub_bodies:
+                    self._lockset_flow(sub, entry, method, lock_attrs, writes)
+                current = self._apply_lock_calls(stmt, current, lock_attrs)
+                continue
+            # simple statement: record writes at the CURRENT lockset
+            for node in ast.walk(stmt):
+                if isinstance(node, (ast.Assign, ast.AugAssign)):
+                    targets = (
+                        node.targets if isinstance(node, ast.Assign)
+                        else [node.target]
+                    )
+                    for target in targets:
+                        attr = _self_attr(target)
+                        if attr:
+                            writes.append(_LocksetWrite(
+                                attr=attr, line=node.lineno, method=method,
+                                lockset=current,
+                            ))
+            current = self._apply_lock_calls(stmt, current, lock_attrs)
+        return current
+
+    @staticmethod
+    def _sub_bodies(stmt: ast.stmt) -> List[List[ast.stmt]]:
+        if isinstance(stmt, ast.If):
+            return [stmt.body, stmt.orelse]
+        if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            return [stmt.body, stmt.orelse]
+        if isinstance(stmt, ast.Try):
+            return (
+                [stmt.body]
+                + [h.body for h in stmt.handlers]
+                + [stmt.orelse, stmt.finalbody]
+            )
+        return []
+
+    @staticmethod
+    def _apply_lock_calls(
+        stmt: ast.stmt, current: frozenset, lock_attrs: Set[str],
+        headers_only: bool = False,
+    ) -> frozenset:
+        """`current` after the acquire()/release() calls in `stmt` (or in
+        its header expressions only: the If test / For iter / While test),
+        nested defs excluded."""
+        roots: List[ast.AST]
+        if headers_only:
+            roots = [
+                n for n in (
+                    getattr(stmt, "test", None), getattr(stmt, "iter", None)
+                ) if n is not None
+            ]
+        else:
+            roots = [stmt]
+        acquired, released = set(), set()
+        stack: List[ast.AST] = list(roots)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("acquire", "release")
+            ):
+                attr = _self_attr(node.func.value)
+                if attr in lock_attrs:
+                    (acquired if node.func.attr == "acquire"
+                     else released).add(attr)
+            stack.extend(ast.iter_child_nodes(node))
+        if not (acquired or released):
+            return current
+        return frozenset((set(current) | acquired) - released)
 
     def _collect_writes(
         self,

@@ -101,10 +101,16 @@ def collect_sources(
 class Pass:
     """One analysis pass. Subclasses set `name` (the pass id) and `rules`
     (every rule id the pass can emit — used by --rule filtering and the
-    docs catalog) and implement run()."""
+    docs catalog) and implement run().
+
+    `scope` declares what run() needs to see: "file" (the default) means
+    findings for a file depend only on that file, so the runner may invoke
+    run() once per file — in parallel; "fileset" (layering: the global
+    import graph) always gets the whole set in one call."""
 
     name: str = ""
     rules: Tuple[str, ...] = ()
+    scope: str = "file"
 
     def run(self, files: Sequence[SourceFile], config) -> List[Violation]:
         raise NotImplementedError
@@ -240,17 +246,40 @@ def run_passes(
     passes: Optional[Sequence[Pass]] = None,
     rules: Optional[Set[str]] = None,
     baseline: Optional[Set[str]] = None,
+    workers: int = 1,
 ) -> RunResult:
+    """Run the passes; `workers` > 1 fans file-scope passes out over a
+    thread pool, one (pass, file) task each — findings are identical to
+    the sequential run because the result is canonically sorted below
+    (tests/test_analysis_framework.py asserts the equality)."""
     if passes is None:
         from karpenter_core_tpu.analysis import all_passes
 
         passes = all_passes()
     baseline = baseline or set()
+    selected = [
+        p for p in passes if not rules or (rules & set(p.rules))
+    ]
     raw: List[Violation] = []
-    for p in passes:
-        if rules and not (rules & set(p.rules)):
-            continue
-        raw.extend(p.run(files, config))
+    if workers > 1 and len(files) > 1:
+        from concurrent.futures import ThreadPoolExecutor
+
+        per_file = [p for p in selected if p.scope == "file"]
+        whole_set = [p for p in selected if p.scope != "file"]
+        with ThreadPoolExecutor(
+            max_workers=workers, thread_name_prefix="lint"
+        ) as pool:
+            futures = [
+                pool.submit(p.run, [f], config)
+                for p in per_file for f in files
+            ]
+            for p in whole_set:
+                raw.extend(p.run(files, config))
+            for fut in futures:
+                raw.extend(fut.result())
+    else:
+        for p in selected:
+            raw.extend(p.run(files, config))
     if rules:
         raw = [v for v in raw if v.rule in rules]
     by_rel: Dict[str, SourceFile] = {f.relpath: f for f in files}

@@ -27,6 +27,7 @@ from karpenter_core_tpu.analysis.core import (
 class LayeringPass(Pass):
     name = "layering"
     rules = ("layering", "import-cycle")
+    scope = "fileset"  # needs the global import graph: never per-file
 
     def run(self, files: Sequence[SourceFile], config) -> List[Violation]:
         out: List[Violation] = []

@@ -240,13 +240,14 @@ class Fault:
             if self.probability < 1.0 and self._rng.random() >= self.probability:
                 return
             self.injected += 1
+            injected = self.injected
             kind = self._kind()
         CHAOS_INJECTED_TOTAL.inc({"point": self.point, "error": kind})
         # a chaos run's log trail shows exactly which call got the fault
         # (correlated by the bound controller/reconcile fields + trace id)
         LOG.debug(
             "chaos fault injected", point=self.point, kind=kind,
-            injected=self.injected,
+            injected=injected,
         )
         if self.latency > 0.0:
             time.sleep(self.latency)
@@ -255,11 +256,13 @@ class Fault:
             raise err
 
     def __repr__(self) -> str:  # armed-state introspection in tests/debug
+        with self._mu:  # counters mutate under _mu; read them there too
+            calls, injected = self.calls, self.injected
         return (
             f"Fault({self.point!r}, error={self._kind()!r}, "
             f"p={self.probability}, latency={self.latency}, "
             f"times={self.times}, after={self.after}, seed={self.seed}, "
-            f"calls={self.calls}, injected={self.injected})"
+            f"calls={calls}, injected={injected})"
         )
 
 

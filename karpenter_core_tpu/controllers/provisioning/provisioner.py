@@ -103,6 +103,10 @@ class ProvisioningController:
         # of burning a full solve every batch window
         self._launch_retry_failures = 0
         self._launch_retry_at: Optional[float] = None
+        # guards the retry-pacing fields above and _last_solve_inputs:
+        # step()-driven reconciles (tests, soak driver) overlap the
+        # singleton loop's, and the failure-explanation probe reads from
+        # controller threads (racewatch, ISSUE 13)
         self._mu = threading.Lock()
         # (provisioners, instance_types) the LAST solve saw — the failure-
         # explanation probe reads them so it never races provisioner churn
@@ -184,10 +188,13 @@ class ProvisioningController:
             # PERSISTENTLY failing launch must not burn a full solve every
             # batch window.
             LAUNCH_RESOLVE_RETRIGGERS.inc()
-            self._launch_retry_failures += 1
-            self._schedule_launch_retry(self._launch_retry_failures)
+            with self._mu:
+                self._launch_retry_failures += 1
+                failures = self._launch_retry_failures
+            self._schedule_launch_retry(failures)
         else:
-            self._launch_retry_failures = 0
+            with self._mu:
+                self._launch_retry_failures = 0
             if result.failed_pods:
                 # pods left unplaced while offerings are ICE-masked: arm
                 # ONE re-trigger at the earliest cache-entry expiry (masked
@@ -249,7 +256,8 @@ class ProvisioningController:
         from karpenter_core_tpu.utils import resources as resources_util
 
         reasons: Dict[str, str] = {}
-        provisioners, instance_types = self._last_solve_inputs
+        with self._mu:
+            provisioners, instance_types = self._last_solve_inputs
         if not provisioners:
             return reasons
         templates = [
@@ -432,8 +440,10 @@ class ProvisioningController:
             for p in provisioners
         }
         # the exact inputs this solve saw, for the failure-explanation
-        # probe (re-listing would race provisioner churn)
-        self._last_solve_inputs = (provisioners, instance_types)
+        # probe (re-listing would race provisioner churn); under _mu —
+        # step()-driven and loop-driven reconciles can overlap
+        with self._mu:
+            self._last_solve_inputs = (provisioners, instance_types)
         pending = [self.volume_topology.inject(copy.deepcopy(p)) for p in pending]
         daemonset_pods = self.get_daemonset_pods()
         try:
@@ -503,7 +513,8 @@ class ProvisioningController:
     def _schedule_launch_retry_in(self, delay: float) -> None:
         import time as time_mod
 
-        self._launch_retry_at = time_mod.monotonic() + delay
+        with self._mu:
+            self._launch_retry_at = time_mod.monotonic() + delay
 
     def _maybe_fire_launch_retry(self) -> None:
         """Fire a due launch re-trigger (called from the reconcile loop
@@ -511,10 +522,12 @@ class ProvisioningController:
         it never needs the trigger)."""
         import time as time_mod
 
-        due_at = self._launch_retry_at
-        if due_at is not None and time_mod.monotonic() >= due_at:
+        with self._mu:
+            due_at = self._launch_retry_at
+            if due_at is None or time_mod.monotonic() < due_at:
+                return
             self._launch_retry_at = None
-            self.batcher.trigger()
+        self.batcher.trigger()
 
     def _launch_machines_with_errors(
         self, machines: List[SolvedMachine], opts: Optional[LaunchOptions] = None

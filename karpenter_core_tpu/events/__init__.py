@@ -137,19 +137,23 @@ class Recorder:
 
         with self._mu:
             if self._post_q is None:
-                self._post_q = _queue.Queue(maxsize=1024)
+                # the worker takes the queue as an ARGUMENT: re-reading
+                # self._post_q from the loop would be a lock-free read
+                # racing this lazy-init write (racewatch, ISSUE 13)
+                self._post_q = q = _queue.Queue(maxsize=1024)
                 threading.Thread(
-                    target=self._post_loop, daemon=True, name="event-poster"
+                    target=self._post_loop, args=(q,),
+                    daemon=True, name="event-poster",
                 ).start()
-        return self._post_q
+            return self._post_q
 
-    def _post_loop(self) -> None:
+    def _post_loop(self, post_q) -> None:
         import queue as _queue
 
         posted = 0
         while True:
             try:
-                obj = self._post_q.get(timeout=0.2)
+                obj = post_q.get(timeout=0.2)
             except _queue.Empty:
                 self._post_idle.set()
                 continue
@@ -160,7 +164,7 @@ class Recorder:
             posted += 1
             if posted % 256 == 0:
                 self._prune_cluster_events()
-            if self._post_q.empty():
+            if post_q.empty():
                 self._post_idle.set()
 
     def _prune_cluster_events(self) -> None:
@@ -186,7 +190,9 @@ class Recorder:
 
     def flush(self, timeout: float = 5.0) -> bool:
         """Wait for queued cluster posts to drain (tests / shutdown)."""
-        if self._post_q is None:
+        with self._mu:  # _post_q lazy-inits under _mu: read it there too
+            started = self._post_q is not None
+        if not started:
             return True
         return self._post_idle.wait(timeout)
 

@@ -540,13 +540,18 @@ class FlightRecorder:
     # -- lifecycle ---------------------------------------------------------
 
     def enable(self, dump_dir: Optional[str] = None) -> "FlightRecorder":
-        if dump_dir is not None:
-            self.dump_dir = dump_dir
-        self.enabled = True
+        # under _mu so enable/disable can't tear dump_dir vs enabled; the
+        # hot-path `FLIGHTREC.enabled` read per solve stays lock-free by
+        # design — audited in racewatch's suppression table (ISSUE 13)
+        with self._mu:
+            if dump_dir is not None:
+                self.dump_dir = dump_dir
+            self.enabled = True
         return self
 
     def disable(self) -> "FlightRecorder":
-        self.enabled = False
+        with self._mu:
+            self.enabled = False
         return self
 
     def clear(self) -> None:
@@ -733,8 +738,14 @@ class FlightRecorder:
                     f"solve-{stamp}-{record.get('digest', 'na')}.json",
                 )
                 prune_dir = self.dump_dir
-            with open(path, "w") as f:
+            # write-temp + atomic rename: hack/replay.py (and a human mid-
+            # incident) reads these dumps while the recorder is still
+            # dumping — a torn read must see the previous dump or this
+            # one, never a JSON prefix (atomic-write rule, ISSUE 13)
+            tmp = f"{path}.{os.getpid()}.tmp"
+            with open(tmp, "w") as f:
                 json.dump(record, f)
+            os.replace(tmp, path)
             with self._mu:
                 self._dumped.append(path)
                 del self._dumped[:-self.capacity]

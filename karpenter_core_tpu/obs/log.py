@@ -146,14 +146,20 @@ class LogSink:
 
     def configure(self, level: int = INFO, fmt: str = "logfmt",
                   stream=...) -> "LogSink":
-        self.level = level
-        self.fmt = fmt
-        if stream is not ...:
-            self.stream = stream
+        # under _mu so concurrent configures (operator boot vs embedder)
+        # can't tear fmt/stream across generations; the HOT-PATH reads of
+        # these latches stay lock-free by design (one comparison per call
+        # site) — audited in racewatch's suppression table (ISSUE 13)
+        with self._mu:
+            self.level = level
+            self.fmt = fmt
+            if stream is not ...:
+                self.stream = stream
         return self
 
     def disable(self) -> "LogSink":
-        self.level = OFF
+        with self._mu:
+            self.level = OFF
         return self
 
     def emit(self, record: Dict[str, object]) -> None:

@@ -331,8 +331,13 @@ class Tracer:
         }
 
     def export_chrome_trace(self, path: str) -> str:
-        with open(path, "w") as f:
+        # write-temp + atomic rename: a Perfetto/chrome tab polling the
+        # trace file mid-export must never load a JSON prefix
+        # (atomic-write rule, ISSUE 13)
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "w") as f:
             json.dump(self.chrome_trace(), f)
+        os.replace(tmp, path)
         return path
 
     def summary(self) -> str:

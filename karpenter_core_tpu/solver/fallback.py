@@ -278,21 +278,37 @@ class ResilientSolver:
         self._abandon_seq = itertools.count(1)
         self._last_hb: Optional[supervise.ThreadHeartbeat] = None
         # serializes the probe + verdict write (concurrent controller
-        # threads share one probe instead of racing subprocess probes)
+        # threads share one probe instead of racing subprocess probes) and
+        # guards the wedge/abandoned inventories. Can be held for a full
+        # probe budget (60s), so fast paths must never block on it —
+        # verdict FIELD access rides _state_mu below
         self._verdict_lock = threading.Lock()
-        # held while a background probe is scheduled/running
-        self._probe_gate = threading.Lock()
+        # leaf lock for the verdict FIELDS (_healthy/_last_probe/_reason/
+        # _last_hb): held only for reads/writes, never across a probe, so
+        # the small-batch TTL pre-check and supports_batched_replan stay
+        # effectively non-blocking (racewatch, ISSUE 13). Order is always
+        # _verdict_lock -> _state_mu, never the reverse.
+        self._state_mu = threading.Lock()
+        # held while a background probe is scheduled/running. A SEMAPHORE,
+        # not a Lock: it is acquired on the solve path and released by the
+        # probe WORKER thread — cross-thread release is semaphore
+        # semantics, and a Lock here poisons lock-ownership analysis
+        # (lockwatch taints handoff locks; racewatch locksets inherit the
+        # leak — found by the ISSUE 13 gate)
+        self._probe_gate = threading.BoundedSemaphore(1)
 
     # -- health ------------------------------------------------------------
 
     def _stale(self) -> bool:
         now = self.clock()
+        with self._state_mu:
+            healthy, last_probe = self._healthy, self._last_probe
         return (
-            self._healthy is None
-            or (not self._healthy
-                and now - self._last_probe >= self.reprobe_interval)
-            or (self._healthy
-                and now - self._last_probe >= self.healthy_recheck_interval)
+            healthy is None
+            or (not healthy
+                and now - last_probe >= self.reprobe_interval)
+            or (healthy
+                and now - last_probe >= self.healthy_recheck_interval)
         )
 
     def healthy(self) -> bool:
@@ -309,11 +325,14 @@ class ResilientSolver:
             if state == CircuitBreaker.HALF_OPEN:
                 if not self.breaker.allow():
                     return False  # another thread holds the trial slot
-                self._last_probe = self.clock()
+                with self._state_mu:
+                    self._last_probe = self.clock()
                 reason = self.prober()
-                self._healthy = reason is None
-                self._reason = reason or ""
-                if self._healthy:
+                ok = reason is None
+                with self._state_mu:
+                    self._healthy = ok
+                    self._reason = reason or ""
+                if ok:
                     self.breaker.record_success()
                     LOG.info("solver recovered from wedge", probe="backend")
                     self._event("SolverRecovered", "Normal",
@@ -324,31 +343,35 @@ class ResilientSolver:
                     self.breaker.record_failure()
                     LOG.warning(
                         "wedge re-admission probe failed",
-                        reason=self._reason, probe="backend",
+                        reason=reason, probe="backend",
                     )
-                return bool(self._healthy)
+                return ok
             # re-check under the lock: a concurrent caller may have just
             # refreshed the verdict while this thread waited
             if self._stale():
-                self._last_probe = self.clock()
+                with self._state_mu:
+                    self._last_probe = self.clock()
+                    was = self._healthy
                 reason = self.prober()
-                was = self._healthy
-                self._healthy = reason is None
-                self._reason = reason or ""
-                if was is not False and not self._healthy:
+                ok = reason is None
+                with self._state_mu:
+                    self._healthy = ok
+                    self._reason = reason or ""
+                if was is not False and not ok:
                     LOG.warning(
-                        "solver degraded", reason=self._reason,
+                        "solver degraded", reason=reason,
                         probe="backend",
                     )
                     self._event(
                         "SolverDegraded", "Warning",
-                        f"accelerator backend unavailable ({self._reason}); "
+                        f"accelerator backend unavailable ({reason}); "
                         "falling back to the host solver")
-                elif was is False and self._healthy:
+                elif was is False and ok:
                     LOG.info("solver recovered", probe="backend")
                     self._event("SolverRecovered", "Normal",
                                 "accelerator backend recovered")
-            return bool(self._healthy)
+            with self._state_mu:
+                return bool(self._healthy)
 
     def _maybe_bg_probe(self) -> None:
         """Refresh a stale health verdict WITHOUT blocking the caller —
@@ -388,13 +411,14 @@ class ResilientSolver:
         reprobe TTL, so a wedged backend is never handed a live solve to
         prove itself with."""
         with self._verdict_lock:
-            self._healthy = False
-            self._last_probe = self.clock()
-            self._reason = reason
+            with self._state_mu:
+                self._healthy = False
+                self._last_probe = self.clock()
+                self._reason = reason
+                hb = self._last_hb
             if kind == "wedged":
                 SOLVER_WEDGED_TOTAL.inc()
             self.breaker.trip()
-            hb = self._last_hb
             self.wedge_history.append({
                 "ts": self.clock(),
                 "kind": kind,
@@ -438,7 +462,8 @@ class ResilientSolver:
         count to zero because the wedged PROCESS is killed), and the
         solver host's pid/generation/queue state when the primary runs
         out-of-process. Reads only — no probe is triggered."""
-        hb = self._last_hb
+        with self._state_mu:
+            hb = self._last_hb
         age = hb.age() if hb is not None else None
         host_report = None
         hr = getattr(self.primary, "host_report", None)
@@ -447,12 +472,14 @@ class ResilientSolver:
                 host_report = hr()
             except Exception as e:  # noqa: BLE001 — report, don't fail health
                 host_report = {"error": f"{type(e).__name__}: {e}"}
+        with self._state_mu:
+            healthy, reason = self._healthy, self._reason
         with self._verdict_lock:
             self._reap_abandoned_locked()
             live = sum(1 for r in self._abandoned if not r["reaped"])
             return {
-                "healthy": self._healthy,
-                "reason": self._reason,
+                "healthy": healthy,
+                "reason": reason,
                 "breaker": self.breaker.state,
                 "heartbeat_age_s": round(age, 3) if age is not None else None,
                 "solve_timeout_s": self.solve_timeout,
@@ -484,9 +511,10 @@ class ResilientSolver:
         # makes the dead verdict fresh so the next healthy() respects the
         # reprobe TTL instead of instantly re-probing
         with self._verdict_lock:
-            self._healthy = False
-            self._last_probe = self.clock()
-            self._reason = reason
+            with self._state_mu:
+                self._healthy = False
+                self._last_probe = self.clock()
+                self._reason = reason
         LOG.warning("solver degraded", reason=reason, probe="solve")
         self._event("SolverDegraded", "Warning",
                     f"primary solver failed ({reason}); "
@@ -504,9 +532,13 @@ class ResilientSolver:
     @property
     def supports_batched_replan(self) -> bool:
         # cached health only — this property is read every deprovisioning
-        # pass and must never block on a probe; until the first solve has
-        # established health, the sequential replan path is used
-        return self._healthy is True and getattr(
+        # pass and must never block on a probe; _state_mu is a leaf lock
+        # held only for field access, never across a probe, so this stays
+        # effectively non-blocking. Until the first solve has established
+        # health, the sequential replan path is used.
+        with self._state_mu:
+            healthy = self._healthy
+        return healthy is True and getattr(
             self.primary, "supports_batched_replan", False
         )
 
@@ -542,7 +574,11 @@ class ResilientSolver:
         box = {}
         done = threading.Event()
         hb = supervise.ThreadHeartbeat()
-        self._last_hb = hb
+        # under the state lock: health_report/_mark_wedged read _last_hb
+        # from other threads — a bare write here was the racewatch gate's
+        # founding catch (ISSUE 13)
+        with self._state_mu:
+            self._last_hb = hb
 
         def run():
             # bind the heartbeat into this thread: the solver's phase

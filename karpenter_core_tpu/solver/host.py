@@ -402,13 +402,22 @@ class SolverHost:
         self._seq = itertools.count(1)
         # serializes frame exchanges (one in-flight dispatch)
         self._mu = threading.Lock()
+        # leaf lock for the lifecycle METADATA (generation/_proc/_ready/
+        # respawns/last_kill/last_recovery_s/_hb_path): report()/alive()/
+        # pid run on health threads and must never wait on _mu — a
+        # dispatch holds _mu for its whole budget. Every access to those
+        # fields goes through _meta_mu (racewatch, ISSUE 13); order is
+        # always _mu -> _meta_mu, never the reverse.
+        self._meta_mu = threading.Lock()
 
     # -- lifecycle -----------------------------------------------------------
 
     def _spawn_locked(self) -> None:
-        self.generation += 1
-        gen = self.generation
-        self._hb_path = os.path.join(self.workdir, f"hb-{gen}")
+        with self._meta_mu:
+            self.generation += 1
+            gen = self.generation
+            hb_path = os.path.join(self.workdir, f"hb-{gen}")
+            self._hb_path = hb_path
         self._stderr_path = os.path.join(self.workdir, f"stderr-{gen}.log")
         env = dict(envflags.environ())
         env.update(self.child_env)
@@ -416,21 +425,23 @@ class SolverHost:
         env["KARPENTER_SOLVER_HOST"] = "off"
         stderr_f = open(self._stderr_path, "wb")
         try:
-            self._proc = subprocess.Popen(
+            proc = subprocess.Popen(
                 [sys.executable, "-m", "karpenter_core_tpu.solver.host",
-                 "--heartbeat", self._hb_path],
+                 "--heartbeat", hb_path],
                 stdin=subprocess.PIPE, stdout=subprocess.PIPE,
                 stderr=stderr_f, env=env, start_new_session=True,
             )
         finally:
             stderr_f.close()
-        self._reader = _PipeReader(self._proc.stdout)
-        self._ready = False
+        self._reader = _PipeReader(proc.stdout)
         self._spawned_at = time.monotonic()
-        if gen > 1:
-            self.respawns += 1
+        with self._meta_mu:
+            self._proc = proc
+            self._ready = False
+            if gen > 1:
+                self.respawns += 1
         LOG.info(
-            "solver host spawned", pid=self._proc.pid, generation=gen,
+            "solver host spawned", pid=proc.pid, generation=gen,
         )
 
     def _stderr_tail(self) -> str:
@@ -438,7 +449,8 @@ class SolverHost:
         return supervise.redact_env_text(tail) if tail else ""
 
     def _kill_locked(self, kind: str, note: str, respawn: bool = True) -> None:
-        proc = self._proc
+        with self._meta_mu:
+            proc = self._proc
         if proc is not None:
             try:
                 os.killpg(proc.pid, signal.SIGKILL)
@@ -454,20 +466,23 @@ class SolverHost:
                         stream.close()
                 except OSError:
                     pass
-        self.last_kill = {
-            "generation": self.generation,
-            "kind": kind,
-            "note": note,
-            "stderr_tail": self._stderr_tail(),
-        }
-        self._proc = None
+        tail = self._stderr_tail()
+        with self._meta_mu:
+            gen = self.generation
+            self.last_kill = {
+                "generation": gen,
+                "kind": kind,
+                "note": note,
+                "stderr_tail": tail,
+            }
+            self._proc = None
+            self._ready = False
         self._reader = None
-        self._ready = False
         if respawn:
             HOST_RESPAWN_TOTAL.inc({"reason": kind})
         LOG.warning(
             "solver host killed", kind=kind, note=note,
-            generation=self.generation,
+            generation=gen,
         )
         if respawn:
             # eager respawn: the breaker's half-open trial must find a
@@ -478,7 +493,7 @@ class SolverHost:
     def close(self) -> None:
         """Shut the host down (process-group kill; no respawn)."""
         with self._mu:
-            proc = self._proc
+            proc = self._proc_get()
             if proc is None:
                 return
             try:
@@ -487,34 +502,46 @@ class SolverHost:
                 pass
             self._kill_locked("shutdown", "close() called", respawn=False)
 
+    def _proc_get(self) -> Optional[subprocess.Popen]:
+        with self._meta_mu:
+            return self._proc
+
     @property
     def pid(self) -> Optional[int]:
-        return self._proc.pid if self._proc is not None else None
+        proc = self._proc_get()
+        return proc.pid if proc is not None else None
 
     def alive(self) -> bool:
-        return self._proc is not None and self._proc.poll() is None
+        proc = self._proc_get()
+        return proc is not None and proc.poll() is None
 
     def heartbeat_age(self) -> Optional[float]:
-        if not self._hb_path:
+        with self._meta_mu:
+            hb_path = self._hb_path
+        if not hb_path:
             return None
-        return supervise.Heartbeat(self._hb_path).age()
+        return supervise.Heartbeat(hb_path).age()
 
     # -- readiness -----------------------------------------------------------
 
     def _ensure_running_locked(self) -> None:
-        if self._proc is not None and self._proc.poll() is not None:
-            rc = self._proc.poll()
+        proc = self._proc_get()
+        if proc is not None and proc.poll() is not None:
+            rc = proc.poll()
             self._kill_locked("crashed", f"host exited rc={rc} between dispatches")
-        if self._proc is None:
+        if self._proc_get() is None:
             self._spawn_locked()
-        if not self._ready:
+        with self._meta_mu:
+            ready = self._ready
+        if not ready:
             self._wait_ready_locked()
 
     def _wait_ready_locked(self) -> None:
         deadline = time.monotonic() + self.spawn_timeout
 
         def tick():
-            if self._proc is None or self._proc.poll() is not None:
+            proc = self._proc_get()
+            if proc is None or proc.poll() is not None:
                 raise EOFError("solver host died before ready")
             if time.monotonic() >= deadline:
                 raise TimeoutError(
@@ -535,12 +562,15 @@ class SolverHost:
                 f"solver host failed to start: {e}"
                 + (f"; stderr tail: {tail[-500:]}" if tail else "")
             ) from e
-        self._ready = True
-        self.last_recovery_s = time.monotonic() - self._spawned_at
-        HOST_RECOVERY_SECONDS.set(self.last_recovery_s)
+        recovery = time.monotonic() - self._spawned_at
+        with self._meta_mu:
+            self._ready = True
+            self.last_recovery_s = recovery
+            gen = self.generation
+        HOST_RECOVERY_SECONDS.set(recovery)
         LOG.info(
-            "solver host ready", pid=self.pid, generation=self.generation,
-            recovery_s=round(self.last_recovery_s, 2),
+            "solver host ready", pid=self.pid, generation=gen,
+            recovery_s=round(recovery, 2),
         )
 
     def ensure_running(self) -> None:
@@ -567,7 +597,7 @@ class SolverHost:
                      timeout: Optional[float],
                      watch_heartbeat: bool) -> Tuple[Dict[str, object], bytes]:
         self._ensure_running_locked()
-        proc = self._proc
+        proc = self._proc_get()
         rid = next(self._seq)
         header: Dict[str, object] = {"op": op, "id": rid}
         if expires_in_s is not None:
@@ -592,7 +622,9 @@ class SolverHost:
                 pass
         budget = timeout if timeout is not None else self.solve_timeout
         deadline = time.monotonic() + budget
-        hb = supervise.Heartbeat(self._hb_path)
+        with self._meta_mu:
+            hb_path = self._hb_path
+        hb = supervise.Heartbeat(hb_path)
         dispatch_start = time.monotonic()
 
         def tick():
@@ -629,7 +661,7 @@ class SolverHost:
                 f"solver host dispatch heartbeat stale for "
                 f"{w.age:.0f}s (threshold {self.stale_after:.0f}s): "
                 "host process group killed and respawned "
-                f"(generation {self.generation})"
+                f"(generation {self._generation_get()})"
             ) from None
         except _Overrun as o:
             self._kill_locked(
@@ -640,16 +672,20 @@ class SolverHost:
             raise TimeoutError(
                 f"solver host dispatch exceeded {o.budget:.0f}s budget: "
                 "host process group killed and respawned "
-                f"(generation {self.generation})"
+                f"(generation {self._generation_get()})"
             ) from None
         except (EOFError, OSError) as e:
             tail = self._stderr_tail()
             self._kill_locked("crashed", f"died mid-dispatch: {e}")
             raise SolverUnavailableError(
                 f"solver host crashed mid-dispatch ({e}); respawned as "
-                f"generation {self.generation}"
+                f"generation {self._generation_get()}"
                 + (f"; stderr tail: {tail[-500:]}" if tail else "")
             ) from e
+
+    def _generation_get(self) -> int:
+        with self._meta_mu:
+            return self.generation
 
     def probe(self, timeout: Optional[float] = None) -> Dict[str, object]:
         """Health round trip — the breaker's half-open trial: ensure the
@@ -703,25 +739,32 @@ class SolverHost:
 
     def report(self) -> Dict[str, object]:
         """/debug/health payload: pid/generation/liveness/respawn counts.
-        Reads only — no frame exchange."""
-        # sample once: a concurrent respawn swaps the heartbeat path, and
-        # re-reading between the None-check and round() could hand round()
-        # a None mid-kill — exactly when this report matters most
-        age = self.heartbeat_age()
-        recovery = self.last_recovery_s
+        Reads only — no frame exchange, and never a wait on the dispatch
+        lock: the metadata snapshot comes off the leaf _meta_mu in one
+        critical section, so a concurrent respawn can't tear the view
+        (None mid-kill is exactly when this report matters most)."""
+        with self._meta_mu:
+            proc = self._proc
+            generation = self.generation
+            ready = self._ready
+            respawns = self.respawns
+            recovery = self.last_recovery_s
+            last_kill = self.last_kill
+            hb_path = self._hb_path
+        age = supervise.Heartbeat(hb_path).age() if hb_path else None
         return {
-            "pid": self.pid,
-            "generation": self.generation,
-            "alive": self.alive(),
-            "ready": self._ready,
-            "respawn_total": self.respawns,
+            "pid": proc.pid if proc is not None else None,
+            "generation": generation,
+            "alive": proc is not None and proc.poll() is None,
+            "ready": ready,
+            "respawn_total": respawns,
             "last_recovery_s": (
                 round(recovery, 3) if recovery is not None else None
             ),
             "heartbeat_age_s": round(age, 3) if age is not None else None,
             "stale_after_s": self.stale_after,
             "solve_timeout_s": self.solve_timeout,
-            "last_kill": self.last_kill,
+            "last_kill": last_kill,
         }
 
 

@@ -852,6 +852,10 @@ class SolverService:
         # The status string carries the verdict (the proto stays as-is);
         # RemoteSolver.health raises on a non-ok status, which is how the
         # ResilientSolver's out-of-band prober learns the service wedged.
+        # the solve counter mutates under _mu on dispatch threads; health
+        # runs on the RPC pool — read it there too (racewatch, ISSUE 13)
+        with self._mu:
+            solves = self.solves
         age = self._stalest_dispatch_age()
         if age is not None and age >= self.wedge_stale_after:
             return pb.HealthResponse(
@@ -859,7 +863,7 @@ class SolverService:
                     f"wedged: dispatch heartbeat stale for {age:.0f}s "
                     f"(threshold {self.wedge_stale_after:.0f}s)"
                 ),
-                device="", solves=self.solves,
+                device="", solves=solves,
             )
         import jax
 
@@ -869,7 +873,7 @@ class SolverService:
                 f" x{self.mesh.size}"
                 f"(dp={self.mesh.shape['dp']},tp={self.mesh.shape['tp']})"
             )
-        return pb.HealthResponse(status="ok", device=device, solves=self.solves)
+        return pb.HealthResponse(status="ok", device=device, solves=solves)
 
 
 def serve(address: str = "127.0.0.1:0", max_workers: int = 4, mesh=None,

@@ -30,14 +30,19 @@ ordering contract, and flagging them would drown the real inversions.
 """
 from __future__ import annotations
 
+import itertools
 import os
 import sys
 import threading
-from typing import Dict, List, Optional, Set, Tuple
+from typing import Callable, Dict, FrozenSet, List, Optional, Set, Tuple
 
 # the one lock guarding the watcher's own state must never be a proxy:
 # allocate the raw C primitive directly
 _allocate_lock = threading._allocate_lock
+
+# stable per-lock identity: id() recycles after GC, so locksets keyed by
+# id() could alias a dead lock with a fresh one — a monotonic uid cannot
+_uid_counter = itertools.count(1)
 
 _PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _SELF = os.path.abspath(__file__)
@@ -50,11 +55,14 @@ def _default_filter(filename: str) -> bool:
 
 
 class _Acquisition:
-    __slots__ = ("site", "count")
+    __slots__ = ("site", "count", "uids")
 
-    def __init__(self, site: str) -> None:
+    def __init__(self, site: str, uid: int) -> None:
         self.site = site
         self.count = 1
+        # uids of the lock INSTANCES held under this site entry (same-site
+        # siblings pool into one entry; racewatch locksets need identity)
+        self.uids = [uid]
 
 
 class TrackedLock:
@@ -64,6 +72,7 @@ class TrackedLock:
         self._watch = watch
         self._inner = inner
         self._site = site
+        self._uid = next(_uid_counter)
 
     # -- lock protocol -----------------------------------------------------
 
@@ -118,6 +127,20 @@ class LockWatch:
         self._installed = False
         self._orig_lock = None
         self._orig_rlock = None
+        # uid -> allocation site (racewatch reports name locks by site)
+        self._uid_sites: Dict[int, str] = {}
+        # uids released on a thread that never acquired them: cross-thread
+        # HANDOFF (semaphore-style) usage. The acquiring thread's stack
+        # still carries the entry and would leak it forever, poisoning
+        # ordering edges and racewatch locksets — tainted uids are purged
+        # from every thread's held stack lazily and never trusted again
+        # (code that hands a lock between threads should use a Semaphore)
+        self._tainted_uids: Set[int] = set()
+        # allocation observers: fn(lock, frame_or_None) called for every
+        # tracked allocation — racewatch hooks here to discover the OWNING
+        # instance (the `self` in the allocating frame) without lockwatch
+        # knowing anything about attribute instrumentation
+        self._alloc_hooks: List[Callable] = []
 
     # -- allocation --------------------------------------------------------
 
@@ -130,9 +153,12 @@ class LockWatch:
         if isinstance(inner, TrackedLock):
             inner = inner._inner
         site = site or self._caller_site(depth=2)
+        lock = TrackedLock(self, inner, site)
         with self._mu:
             self._sites.add(site)
-        return TrackedLock(self, inner, site)
+            self._uid_sites[lock._uid] = site
+        self._run_alloc_hooks(lock, sys._getframe(1))
+        return lock
 
     @staticmethod
     def _caller_site(depth: int) -> str:
@@ -149,12 +175,29 @@ class LockWatch:
                 return inner
             rel = os.path.relpath(frame.f_code.co_filename, os.path.dirname(_PKG_DIR))
             site = f"{rel}:{frame.f_lineno}"
+            lock = TrackedLock(watch, inner, site)
             with watch._mu:
                 watch._sites.add(site)
-            return TrackedLock(watch, inner, site)
+                watch._uid_sites[lock._uid] = site
+            watch._run_alloc_hooks(lock, frame)
+            return lock
 
         allocate.__name__ = kind
         return allocate
+
+    def add_allocation_hook(self, hook: Callable) -> None:
+        """Register fn(lock, frame) to observe every tracked allocation.
+        `frame` is the allocating package frame (None for explicit
+        make_lock sites with no meaningful caller). Hooks run OUTSIDE the
+        watcher's lock and must not allocate tracked locks themselves."""
+        with self._mu:
+            self._alloc_hooks.append(hook)
+
+    def _run_alloc_hooks(self, lock: "TrackedLock", frame) -> None:
+        with self._mu:
+            hooks = list(self._alloc_hooks)
+        for hook in hooks:
+            hook(lock, frame)
 
     def install(self) -> "LockWatch":
         """Patch threading.Lock/RLock so package allocations are tracked.
@@ -183,7 +226,36 @@ class LockWatch:
         held = getattr(self._local, "held", None)
         if held is None:
             held = self._local.held = []
+        if held and self._tainted_uids:
+            with self._mu:
+                tainted = set(self._tainted_uids)
+            kept = []
+            for acq in held:
+                live = [u for u in acq.uids if u not in tainted]
+                if not live:
+                    continue  # the leaked handoff entry: drop it
+                acq.uids = live
+                kept.append(acq)
+            if len(kept) != len(held):
+                held[:] = kept
         return held
+
+    def held_sites(self) -> List[str]:
+        """Allocation sites of the locks the CURRENT thread holds, outer
+        to inner."""
+        return [acq.site for acq in self._held()]
+
+    def held_lock_uids(self) -> FrozenSet[int]:
+        """Uids of the lock instances the CURRENT thread holds — the
+        lockset racewatch intersects per access."""
+        out: Set[int] = set()
+        for acq in self._held():
+            out.update(acq.uids)
+        return frozenset(out)
+
+    def site_of_uid(self, uid: int) -> str:
+        with self._mu:
+            return self._uid_sites.get(uid, f"uid-{uid}")
 
     def _note_acquire(self, lock: TrackedLock) -> None:
         held = self._held()
@@ -191,6 +263,8 @@ class LockWatch:
             if acq.site == lock._site:
                 # reentrant or same-site sibling: never an ordering edge
                 acq.count += 1
+                if lock._uid not in acq.uids:
+                    acq.uids.append(lock._uid)
                 return
         if held:
             holder = held[-1].site
@@ -203,18 +277,44 @@ class LockWatch:
                     self._edges.setdefault(holder, {}).setdefault(
                         lock._site, witness
                     )
-        held.append(_Acquisition(lock._site))
+        held.append(_Acquisition(lock._site, lock._uid))
 
     def _note_release(self, lock: TrackedLock, full: bool = False) -> None:
         held = getattr(self._local, "held", None)
-        if not held:
-            return
-        for i in range(len(held) - 1, -1, -1):
-            if held[i].site == lock._site:
-                held[i].count -= 1
-                if full or held[i].count <= 0:
-                    del held[i]
-                return
+        if held:
+            # match by lock IDENTITY first: a site-only match could hit a
+            # same-site SIBLING's entry (and a handoff release would then
+            # corrupt this thread's real holding instead of tainting the
+            # handed-off lock)
+            for i in range(len(held) - 1, -1, -1):
+                if lock._uid in held[i].uids:
+                    held[i].count -= 1
+                    if full or held[i].count <= 0:
+                        del held[i]
+                    elif held[i].count < len(held[i].uids):
+                        # a pooled sibling fully released (count dropped
+                        # below the distinct instances tracked): retire its
+                        # uid; reentrant releases of one lock keep the uid
+                        held[i].uids.remove(lock._uid)
+                    return
+            # uid unknown but a same-site entry carries surplus pooled
+            # acquisitions (count > distinct uids): attribute the release
+            # there rather than tainting a legitimately-pooled sibling
+            for i in range(len(held) - 1, -1, -1):
+                if (
+                    held[i].site == lock._site
+                    and held[i].count > len(held[i].uids)
+                ):
+                    held[i].count -= 1
+                    if full or held[i].count <= 0:
+                        del held[i]
+                    return
+        # released on a thread that never acquired it: cross-thread
+        # handoff. Taint the uid so every thread purges the leaked entry
+        # (see _tainted_uids) — ownership analysis cannot model a lock
+        # used as a semaphore.
+        with self._mu:
+            self._tainted_uids.add(lock._uid)
 
     # -- analysis ----------------------------------------------------------
 

@@ -1,0 +1,513 @@
+"""Eraser-style lockset data-race detector — the Python analog of
+`go test -race` (ISSUE 13 tentpole).
+
+Lockwatch catches acquisition-ORDER inversions; what it cannot see is a
+field touched by two threads under no common lock at all — the actual
+data race the Go reference's `-race`-instrumented presubmit exists for.
+This module closes that gap with the classic Eraser algorithm
+(Savage et al., SOSP '97) over the package's own lock machinery:
+
+  * **Discovery** rides lockwatch: every `threading.Lock`/`RLock`
+    allocated from package code already becomes a ``TrackedLock`` proxy
+    (allocation-frame filter); racewatch registers an allocation hook and,
+    when the allocating frame is a method (``self`` in its locals) of a
+    package class, instruments THAT class — a class that owns a lock has
+    concurrent state worth watching, everything else pays nothing.
+  * **Instrumentation** wraps the class's ``__setattr__`` and
+    ``__getattribute__``; only attribute names seen WRITTEN on a tracked
+    instance are recorded on the read path (method lookups early-out on a
+    set-membership test), and only sampled instances are tracked at all.
+  * **State machine** per (object, field), exactly Eraser's:
+
+        virgin -> exclusive (first thread only; no lockset yet — object
+                  construction and single-thread use never report)
+               -> shared (read by a second thread; candidate lockset
+                  initialized from the accessor's held locks, refined on
+                  every later read — an EMPTY set here does NOT report:
+                  read-only sharing after initialization is fine)
+               -> shared-modified (written while shared, or written by a
+                  second thread; the lockset keeps intersecting with the
+                  accessor's held set and the first empty intersection IS
+                  the race — reported once, with both access stacks)
+
+    Held-lock sets come from lockwatch (`held_lock_uids()` — lock
+    *instance* identity, so sibling locks from one allocation site don't
+    alias).
+  * **Overhead bounds**: a sampling knob (track every Nth instance per
+    class) and a per-field access cap (a field stops updating after
+    ``access_cap`` recorded accesses — by then its lockset has long
+    converged). Defaults track everything with cap 128; the race-smoke CI
+    lane forces sampling off and the cap up.
+
+Arming: tests/conftest.py calls ``arm(os.environ.get(...))`` right after
+lockwatch (this module does no env access of its own — env-flags rule) and
+fails the session on unsuppressed races in ``pytest_sessionfinish``.
+``KARPENTER_RACEWATCH=0`` opts out; ``KARPENTER_RACEWATCH_SAMPLE=<n>`` and
+``KARPENTER_RACEWATCH_CAP=<n>`` tune the bounds (cap 0 = unlimited).
+
+False-positive policy (docs/static-analysis.md has the full hierarchy):
+benign races are suppressed by ``suppress("Class.field", reason)`` —
+audited, centrally, never inline; the shipped suppression table must stay
+justified and the real suite must report zero unsuppressed races.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import weakref
+from typing import Dict, FrozenSet, List, Optional, Tuple
+
+from karpenter_core_tpu.testing import lockwatch
+
+_allocate_lock = threading._allocate_lock
+
+_PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_PKG_NAME = os.path.basename(_PKG_DIR)
+
+# Eraser states
+VIRGIN = 0  # implicit: no entry yet
+EXCLUSIVE = 1
+SHARED = 2
+SHARED_MODIFIED = 3
+
+_STATE_NAMES = {
+    EXCLUSIVE: "exclusive",
+    SHARED: "shared",
+    SHARED_MODIFIED: "shared-modified",
+}
+
+
+def _pkg_stack(skip: int, limit: int = 4) -> Tuple[str, ...]:
+    """Up to `limit` package-code frames above `skip`, innermost first —
+    the per-access provenance a race report renders. Single-frame reads
+    via sys._getframe keep this cheap enough for capped recording."""
+    out: List[str] = []
+    depth = skip
+    while len(out) < limit:
+        try:
+            frame = sys._getframe(depth)
+        except ValueError:
+            break
+        depth += 1
+        filename = frame.f_code.co_filename
+        if _PKG_DIR in filename and "racewatch" not in filename:
+            rel = os.path.relpath(filename, os.path.dirname(_PKG_DIR))
+            out.append(f"{rel}:{frame.f_lineno} in {frame.f_code.co_name}")
+        elif out:
+            break  # left the package: the interesting suffix is complete
+        if depth > skip + 14:
+            break
+    return tuple(out)
+
+
+class _Access:
+    """One recorded access: who, where, holding what."""
+
+    __slots__ = ("thread", "op", "stack", "held")
+
+    def __init__(self, op: str, held: FrozenSet[int]) -> None:
+        self.thread = threading.current_thread().name
+        self.op = op
+        self.stack = _pkg_stack(skip=4)
+        self.held = held
+
+    def render(self, watch: "RaceWatch") -> str:
+        locks = (
+            ", ".join(sorted(watch._lockwatch.site_of_uid(u) for u in self.held))
+            or "no locks"
+        )
+        where = " <- ".join(self.stack) or "<non-package frame>"
+        return f"{self.op} by thread '{self.thread}' holding [{locks}] at {where}"
+
+
+class _FieldState:
+    __slots__ = ("state", "owner", "lockset", "accesses", "last_write",
+                 "last_access", "reported")
+
+    def __init__(self, owner_thread_id: int) -> None:
+        self.state = EXCLUSIVE
+        self.owner = owner_thread_id
+        self.lockset: Optional[FrozenSet[int]] = None
+        self.accesses = 0
+        self.last_write: Optional[_Access] = None
+        self.last_access: Optional[_Access] = None
+        self.reported = False
+
+
+class Race:
+    """One candidate race: a field whose candidate lockset emptied while
+    shared-modified, with the two accesses that proved it."""
+
+    def __init__(self, cls_name: str, field: str, prior: Optional[_Access],
+                 current: _Access, state: int) -> None:
+        self.key = f"{cls_name}.{field}"
+        self.cls_name = cls_name
+        self.field = field
+        self.prior = prior
+        self.current = current
+        self.state = state
+
+    def render(self, watch: "RaceWatch") -> str:
+        lines = [
+            f"candidate race on {self.key} "
+            f"(state {_STATE_NAMES.get(self.state, self.state)}, "
+            "candidate lockset empty):"
+        ]
+        if self.prior is not None:
+            lines.append(f"    prior:   {self.prior.render(watch)}")
+        lines.append(f"    current: {self.current.render(watch)}")
+        return "\n".join(lines)
+
+
+class RaceWatch:
+    """Lockset race detector over lock-owning package classes.
+
+    Consumes a LockWatch for lock allocation events and per-thread held
+    sets; instruments owning classes' attribute protocol; maintains the
+    Eraser state machine per (instance, field)."""
+
+    def __init__(self, lock_watch: Optional[lockwatch.LockWatch] = None,
+                 sample: int = 1, access_cap: int = 128,
+                 class_filter=None, access_filter=None) -> None:
+        self._mu = _allocate_lock()
+        self._lockwatch = lock_watch or lockwatch.LockWatch()
+        self.sample = max(1, int(sample))
+        self.access_cap = int(access_cap)  # <=0 means unlimited
+        self._class_filter = class_filter or _default_class_filter
+        # when set, fn(filename) -> bool decides whether an access frame is
+        # recorded at all. The GLOBAL watcher records PACKAGE frames only:
+        # a test reading a counter after join() is synchronized by the join
+        # — an edge Eraser cannot see — and would be a guaranteed false
+        # positive. Standalone instances (None) record everything, so
+        # fixture tests can drive the state machine from test code.
+        self._access_filter = access_filter
+        # type -> (orig_setattr, orig_getattribute); identity-keyed
+        self._instrumented: Dict[type, Tuple[object, object]] = {}
+        # per-class allocation counter driving the sampling knob
+        self._alloc_counts: Dict[type, int] = {}
+        # id(obj) -> {field: _FieldState}; a weakref finalizer retires the
+        # entry so a recycled id can't inherit a dead object's states
+        self._objects: Dict[int, Dict[str, _FieldState]] = {}
+        self._object_refs: Dict[int, weakref.ref] = {}
+        # weakref callbacks fire at arbitrary allocation points — possibly
+        # while THIS thread already holds self._mu — so they only append
+        # (lock-free) here; _note drains the list under the lock
+        self._dead: List[int] = []
+        # per-class set of attribute names ever WRITTEN on a tracked
+        # instance: the read path's early-out (method/descriptor lookups
+        # miss this set and record nothing)
+        self._fields_of: Dict[type, set] = {}
+        self._races: List[Race] = []
+        self._suppressed_hits: Dict[str, int] = {}
+        self.suppressions: Dict[str, str] = {}  # "Class.field" -> reason
+        self._installed = False
+        self.tracked_instances = 0
+        self.recorded_accesses = 0
+
+    # -- wiring ------------------------------------------------------------
+
+    def install(self) -> "RaceWatch":
+        """Hook lock allocations (idempotent). The LockWatch itself must
+        be installed separately (conftest arms lockwatch first)."""
+        with self._mu:
+            if self._installed:
+                return self
+            self._installed = True
+        self._lockwatch.add_allocation_hook(self._on_lock_allocated)
+        return self
+
+    def uninstall(self) -> None:
+        """Restore every instrumented class's attribute protocol and drop
+        tracked-object state (a wrapper a subclass materialized keeps
+        pointing at the closure — an empty object table makes it inert)."""
+        with self._mu:
+            instrumented = dict(self._instrumented)
+            self._instrumented.clear()
+            self._objects.clear()
+            self._object_refs.clear()
+            self._installed = False
+        for cls, (orig_set, orig_get) in instrumented.items():
+            cls.__setattr__ = orig_set
+            cls.__getattribute__ = orig_get
+
+    def suppress(self, key: str, reason: str) -> None:
+        """Mark `Class.field` as an audited benign race. Suppressions are
+        central and reasoned — never sprayed at call sites."""
+        self.suppressions[key] = reason
+
+    # -- discovery ---------------------------------------------------------
+
+    def _on_lock_allocated(self, lock, frame) -> None:
+        if frame is None:
+            return
+        owner = frame.f_locals.get("self")
+        if owner is None:
+            return
+        cls = type(owner)
+        if not self._class_filter(cls):
+            return
+        self._instrument_class(cls)
+        with self._mu:
+            n = self._alloc_counts.get(cls, 0)
+            self._alloc_counts[cls] = n + 1
+            if n % self.sample:
+                return
+        self.track_instance(owner)
+
+    def track_instance(self, obj) -> None:
+        """Explicitly start tracking `obj` (tests seed pre-fix
+        interleavings this way; the allocation hook is the normal path).
+        Instruments the class if the discovery hook hasn't already."""
+        cls = type(obj)
+        self._instrument_class(cls)
+        oid = id(obj)
+        dead = self._dead
+        try:
+            # the callback must NOT take self._mu: GC can fire it while
+            # this very thread holds the lock — append is lock-free and
+            # _note drains
+            ref = weakref.ref(obj, lambda _r, oid=oid: dead.append(oid))
+        except TypeError:
+            return  # no weakref support: tracking would leak the object
+        with self._mu:
+            # drain retirements FIRST: a dead object's id can be recycled
+            # by this very instance, and the stale entry would swallow the
+            # registration (its old-owner states then misread the new
+            # object's single-threaded construction as cross-thread)
+            if self._dead:
+                self._drain_dead_locked()
+            if oid in self._objects:
+                return
+            self._objects[oid] = {}
+            self._object_refs[oid] = ref
+            self.tracked_instances += 1
+
+    def _drain_dead_locked(self) -> None:
+        while self._dead:
+            oid = self._dead.pop()
+            self._objects.pop(oid, None)
+            self._object_refs.pop(oid, None)
+
+    def _instrument_class(self, cls: type) -> None:
+        with self._mu:
+            if cls in self._instrumented:
+                return
+            orig_set = cls.__setattr__
+            orig_get = cls.__getattribute__
+            if getattr(orig_set, "__racewatch__", None) is self or getattr(
+                orig_get, "__racewatch__", None
+            ) is self:
+                # a subclass inheriting an instrumented base's wrappers:
+                # already effectively instrumented — wrapping again would
+                # record every access twice (burning the per-field cap at
+                # 2x) and pin the base's wrapper onto the subclass forever
+                return
+            self._instrumented[cls] = (orig_set, orig_get)
+            fields = self._fields_of.setdefault(cls, set())
+        watch = self
+        objects = self._objects
+
+        def __setattr__(obj, name, value, _orig=orig_set):
+            _orig(obj, name, value)
+            states = objects.get(id(obj))
+            if states is not None:
+                fields.add(name)
+                watch._note(obj, states, name, "write")
+
+        def __getattribute__(obj, name, _orig=orig_get):
+            value = _orig(obj, name)
+            if name in fields:
+                states = objects.get(id(obj))
+                if states is not None:
+                    watch._note(obj, states, name, "read")
+            return value
+
+        __setattr__.__racewatch__ = watch
+        __getattribute__.__racewatch__ = watch
+        cls.__setattr__ = __setattr__
+        cls.__getattribute__ = __getattribute__
+
+    # -- the state machine -------------------------------------------------
+
+    def _note(self, obj, states: Dict[str, _FieldState], field: str,
+              op: str) -> None:
+        if self._access_filter is not None and not self._access_filter(
+            sys._getframe(2).f_code.co_filename
+        ):
+            return
+        tid = threading.get_ident()
+        with self._mu:
+            if self._dead:
+                self._drain_dead_locked()
+            if self._objects.get(id(obj)) is not states:
+                # the wrapper raced a retirement (or a recycled id hit a
+                # stale entry): this states dict is not this object's
+                return
+            st = states.get(field)
+            if st is None:
+                states[field] = st = _FieldState(tid)
+            if st.reported or (
+                self.access_cap > 0 and st.accesses >= self.access_cap
+            ):
+                return
+            st.accesses += 1
+            self.recorded_accesses += 1
+            held = self._lockwatch.held_lock_uids()
+            acc = _Access(op, held)
+            if st.state == EXCLUSIVE:
+                if tid == st.owner:
+                    pass  # still single-threaded: construction/handoff-free
+                elif op == "read":
+                    st.state = SHARED
+                    st.lockset = held
+                else:
+                    st.state = SHARED_MODIFIED
+                    st.lockset = held
+            else:
+                st.lockset = (
+                    held if st.lockset is None else st.lockset & held
+                )
+                if op == "write" and st.state == SHARED:
+                    st.state = SHARED_MODIFIED
+            if (
+                st.state == SHARED_MODIFIED
+                and st.lockset is not None
+                and not st.lockset
+                and not st.reported
+            ):
+                st.reported = True
+                self._report(obj, field, st, acc)
+            if op == "write":
+                st.last_write = acc
+            st.last_access = acc
+
+    def _report(self, obj, field: str, st: _FieldState, acc: _Access) -> None:
+        cls_name = type(obj).__name__
+        key = f"{cls_name}.{field}"
+        if key in self.suppressions:
+            self._suppressed_hits[key] = self._suppressed_hits.get(key, 0) + 1
+            return
+        if any(r.key == key for r in self._races):
+            return  # one report per (class, field): instances would spam
+        prior = st.last_write if acc.op == "read" else (
+            st.last_write or st.last_access
+        )
+        self._races.append(Race(cls_name, field, prior, acc, st.state))
+
+    # -- reporting ---------------------------------------------------------
+
+    def races(self) -> List[Race]:
+        with self._mu:
+            return list(self._races)
+
+    def report(self) -> str:
+        races = self.races()
+        if not races:
+            return "racewatch: no candidate data races"
+        lines = [
+            f"racewatch: {len(races)} candidate data race(s) — field(s) "
+            "accessed by multiple threads under no common lock:"
+        ]
+        for race in races:
+            lines.append("  " + race.render(self).replace("\n", "\n  "))
+        return "\n".join(lines)
+
+    def stats(self) -> Dict[str, object]:
+        with self._mu:
+            return {
+                "tracked_classes": len(self._instrumented),
+                "tracked_instances": self.tracked_instances,
+                "recorded_accesses": self.recorded_accesses,
+                "races": len(self._races),
+                "suppressed_hits": dict(self._suppressed_hits),
+                "sample": self.sample,
+                "access_cap": self.access_cap,
+            }
+
+    def reset(self) -> None:
+        with self._mu:
+            self._races.clear()
+            self._suppressed_hits.clear()
+            for states in self._objects.values():
+                states.clear()
+
+
+def _default_class_filter(cls: type) -> bool:
+    """Instrument package classes only — and never the watchers' own."""
+    module = getattr(cls, "__module__", "") or ""
+    if not module.startswith(_PKG_NAME):
+        return False
+    return "lockwatch" not in module and "racewatch" not in module
+
+
+# -- global instance (conftest arming) --------------------------------------
+
+def _pkg_access_filter(filename: str) -> bool:
+    """Record accesses made from package source only (mirrors lockwatch's
+    allocation-frame filter): accesses from test/harness frames are often
+    synchronized by thread join/start edges Eraser cannot see."""
+    return _PKG_DIR in filename
+
+
+# the global racewatch rides the global lockwatch: one allocation filter,
+# one held-set source, one patch of threading.Lock/RLock
+GLOBAL = RaceWatch(lock_watch=lockwatch.GLOBAL, access_filter=_pkg_access_filter)
+
+# Audited benign-race suppressions for the shipped package (the suppression
+# hierarchy's racewatch tier — docs/static-analysis.md). Every entry must
+# explain WHY the unlocked access is sound. The common shape here is a
+# LATCHING config flag: written under the owner's lock (torn multi-field
+# configuration is impossible), but read lock-free on a hot path where a
+# lock acquire per call would be a real regression — CPython attribute
+# loads are atomic, and the worst case of a stale read is one extra or
+# missing record, never corruption.
+for _key, _reason in {
+    "LogSink.level": (
+        "the one hot-path gate: compared on EVERY log call site before "
+        "anything is built; writes latch under LogSink._mu (configure/"
+        "disable); a stale level costs one mis-gated record"
+    ),
+    "LogSink.fmt": (
+        "render-format latch written under LogSink._mu at configure time, "
+        "read lock-free in emit(); stale read renders one record in the "
+        "previous format"
+    ),
+    "LogSink.stream": (
+        "line-sink latch, same configure-under-lock / lock-free-emit "
+        "shape; emit() snapshots it into a local before use"
+    ),
+    "FlightRecorder.enabled": (
+        "latching bool read once per solve (the 'disabled = one flag "
+        "check' contract); writes latch under FlightRecorder._mu; a stale "
+        "read records or skips one solve at the enable/disable boundary"
+    ),
+    "FlightRecorder.dump_dir": (
+        "written under FlightRecorder._mu at enable time, read at dump "
+        "time; dumps are best-effort by contract"
+    ),
+}.items():
+    GLOBAL.suppress(_key, _reason)
+
+
+def arm(spec: str = "", default_on: bool = True, sample: str = "",
+        cap: str = "") -> bool:
+    """Install the global detector per a KARPENTER_RACEWATCH spec (same
+    truthy/falsy grammar as lockwatch.arm; the CALLER reads the env —
+    this module stays env-free per the env-flags rule). `sample`/`cap`
+    are the raw KARPENTER_RACEWATCH_{SAMPLE,CAP} strings."""
+    spec = (spec or "").strip().lower()
+    if spec in ("0", "false", "off", "no"):
+        return False
+    if not (spec in ("1", "true", "on", "yes") or default_on):
+        return False
+    try:
+        GLOBAL.sample = max(1, int(sample)) if sample.strip() else GLOBAL.sample
+    except ValueError:
+        pass
+    try:
+        GLOBAL.access_cap = int(cap) if cap.strip() else GLOBAL.access_cap
+    except ValueError:
+        pass
+    GLOBAL.install()
+    return True

@@ -1,0 +1,23 @@
+"""Seeded torn-write hazards (every open must be caught)."""
+import json
+
+
+def write_artifact(path, payload):
+    with open(path, "w") as f:  # atomic-write: truncate + write, no rename
+        json.dump(payload, f)
+
+
+def write_binary(path, blob):
+    f = open(path, mode="wb")  # atomic-write: keyword mode spelling
+    try:
+        f.write(blob)
+    finally:
+        f.close()
+
+
+def module_scope_write(blob):
+    pass
+
+
+with open("/tmp/fixture-module-scope.json", "w") as _f:  # atomic-write
+    _f.write("{}")

@@ -1,0 +1,42 @@
+"""Seeded guarded-by-v2 violations: inconsistent write LOCKSETS that the
+boolean v1 rule cannot see (each bad class trips v2 and only v2)."""
+import threading
+
+
+class SplitLocks:
+    """`count` written under _lock_a in one method and _lock_b in another:
+    every write is "guarded" (v1 is satisfied) but the locksets share no
+    common lock — two threads in the two methods still race."""
+
+    def __init__(self):
+        self._lock_a = threading.Lock()
+        self._lock_b = threading.Lock()
+        self.count = 0
+
+    def bump_a(self):
+        with self._lock_a:
+            self.count += 1
+
+    def bump_b(self):
+        with self._lock_b:
+            self.count += 1
+
+
+class AcquireBare:
+    """`total` written under an acquire()/release() guard in one method
+    (v1 cannot see acquire-style guards, so it stays quiet) and bare in
+    another — v2's lockset flow flags the bare write."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.total = 0
+
+    def add(self, n):
+        self._mu.acquire()
+        try:
+            self.total += n
+        finally:
+            self._mu.release()
+
+    def reset(self):
+        self.total = 0  # guarded-by-v2: no lock held

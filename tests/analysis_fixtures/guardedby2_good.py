@@ -1,0 +1,70 @@
+"""Consistent lockset discipline: v2 must stay quiet on all of it."""
+import threading
+
+
+class AcquireConsistent:
+    """acquire()/release() guard in one method, `with` in another — the
+    SAME lock either way: the write lockset intersection is non-empty."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.total = 0
+
+    def add(self, n):
+        self._mu.acquire()
+        try:
+            self.total += n
+        finally:
+            self._mu.release()
+
+    def reset(self):
+        with self._mu:
+            self.total = 0
+
+
+class ConditionalAcquire:
+    """The non-blocking gate pattern: `if not acquire(False): return` —
+    statements after the guard hold the lock."""
+
+    def __init__(self):
+        self._gate = threading.Lock()
+        self.state = "idle"
+
+    def try_start(self):
+        if not self._gate.acquire(blocking=False):
+            return False
+        try:
+            self.state = "running"
+        finally:
+            self._gate.release()
+        return True
+
+    def stop(self):
+        with self._gate:
+            self.state = "idle"
+
+
+class NestedWith:
+    """A `with` nested inside try/if still scopes its lockset (the flow
+    recursion, not a wholesale statement walk)."""
+
+    def __init__(self):
+        self._mu = threading.Lock()
+        self.value = 0
+        self.flag = False
+
+    def update(self, n):
+        try:
+            if n > 0:
+                with self._mu:
+                    self.value = n
+        except ValueError:
+            pass
+
+    def set_value_again(self, n):
+        with self._mu:
+            self.value = n
+
+    def set_flag_locked(self, on):
+        # callee-guarded by the _locked suffix: exempt from v2 entirely
+        self.flag = on

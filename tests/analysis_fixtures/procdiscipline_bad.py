@@ -1,0 +1,28 @@
+"""Seeded process-discipline violations (every one must be caught)."""
+import os
+import signal
+import subprocess
+import threading
+from subprocess import Popen as SpawnProc
+
+
+def spawn_unsupervised(cmd):
+    return subprocess.Popen(cmd)  # no start_new_session: proc-group
+
+
+def spawn_aliased(cmd):
+    return SpawnProc(cmd, stdout=subprocess.PIPE)  # proc-group via alias
+
+
+def kill_child(pid):
+    os.kill(pid, signal.SIGKILL)  # proc-kill-group: killpg is the convention
+
+
+def unjoined_waiter(fn):
+    t = threading.Thread(target=fn, daemon=False, name="waiter")
+    t.start()
+    return t  # never joined in this file: thread-join
+
+
+def anonymous_waiter(fn):
+    threading.Thread(target=fn, daemon=False, name="anon").start()  # thread-join

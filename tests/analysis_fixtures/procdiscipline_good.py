@@ -1,0 +1,32 @@
+"""Disciplined process handling: none of these may be flagged."""
+import os
+import signal
+import subprocess
+import threading
+
+
+def spawn_grouped(cmd):
+    # explicit start_new_session: the supervisor can killpg the group
+    return subprocess.Popen(cmd, start_new_session=True)
+
+
+def run_blocking(cmd):
+    return subprocess.run(cmd, check=True, timeout=30)  # run() waits; not Popen
+
+
+def kill_group(pid):
+    os.killpg(pid, signal.SIGKILL)  # the convention
+
+
+def joined_waiter(fn):
+    t = threading.Thread(target=fn, daemon=False, name="waiter")
+    t.start()
+    t.join(timeout=5.0)  # joined: a bounded child-waiter is fine
+    return t
+
+
+def daemon_background(fn):
+    # daemon threads never wedge shutdown; thread-join does not apply
+    t = threading.Thread(target=fn, daemon=True, name="bg")
+    t.start()
+    return t

@@ -37,6 +37,23 @@ LOCKWATCH_ARMED = lockwatch.arm(
     os.environ.get("KARPENTER_LOCKWATCH", ""), default_on=True
 )
 
+# Eraser-style lockset data-race detector (testing/racewatch): rides the
+# lockwatch proxies — classes that allocate a tracked lock get their
+# attribute protocol instrumented, and per-(object, field) candidate
+# locksets run the virgin -> exclusive -> shared -> shared-modified state
+# machine; pytest_sessionfinish fails the run on unsuppressed candidate
+# races (both access stacks printed). KARPENTER_RACEWATCH=0 opts out;
+# KARPENTER_RACEWATCH_SAMPLE / KARPENTER_RACEWATCH_CAP bound the overhead
+# (the race-smoke lane forces sampling off and a high cap). Requires the
+# lockwatch patch for lock identity — armed only when lockwatch is.
+from karpenter_core_tpu.testing import racewatch  # noqa: E402
+
+RACEWATCH_ARMED = LOCKWATCH_ARMED and racewatch.arm(
+    os.environ.get("KARPENTER_RACEWATCH", ""), default_on=True,
+    sample=os.environ.get("KARPENTER_RACEWATCH_SAMPLE", ""),
+    cap=os.environ.get("KARPENTER_RACEWATCH_CAP", ""),
+)
+
 # the production persistent XLA compile cache (utils/compilecache — the
 # operator/service/bench all enable it at boot): test files construct fresh
 # solver instances whose in-process executable caches can't share, so
@@ -71,10 +88,15 @@ def pytest_configure(config):
 def pytest_sessionfinish(session, exitstatus):
     """Fail the suite when the lock-order graph picked up an acquisition
     cycle anywhere in the run — a potential deadlock is a test failure even
-    if no test happened to interleave into it this time."""
+    if no test happened to interleave into it this time — or when racewatch
+    recorded an unsuppressed candidate data race (two threads, no common
+    lock: the `-race` gate)."""
     if not LOCKWATCH_ARMED:
         return
     cycles = lockwatch.GLOBAL.cycles()
     if cycles:
         sys.stderr.write("\n" + lockwatch.GLOBAL.report() + "\n")
+        session.exitstatus = 1
+    if RACEWATCH_ARMED and racewatch.GLOBAL.races():
+        sys.stderr.write("\n" + racewatch.GLOBAL.report() + "\n")
         session.exitstatus = 1

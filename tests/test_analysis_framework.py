@@ -28,7 +28,8 @@ def test_registry_covers_the_documented_rule_set():
     assert rules == {
         "trace-safety", "layering", "import-cycle", "env-flags",
         "monotonic-time", "monotonic-time-default", "bare-except",
-        "thread-discipline", "guarded-by", "no-print",
+        "thread-discipline", "guarded-by", "guarded-by-v2", "no-print",
+        "proc-group", "proc-kill-group", "thread-join", "atomic-write",
     }
 
 
@@ -115,6 +116,99 @@ def test_update_baseline_roundtrip(tmp_path):
     assert proc.returncode == 0
     entries = load_baseline(str(bl))
     assert entries == set()  # repo is clean: baseline stays empty
+
+
+def test_parallel_run_passes_findings_identical_to_sequential(tmp_path):
+    """ISSUE 13 satellite: the thread-pool fan-out must produce the exact
+    violation list (content AND order) the sequential runner does —
+    exercised on a seeded tree with hits from several passes."""
+    pkg = tmp_path / "karpenter_core_tpu"
+    (pkg / "solver").mkdir(parents=True)
+    (pkg / "__init__.py").write_text("")
+    (pkg / "solver" / "__init__.py").write_text("")
+    (pkg / "solver" / "a.py").write_text(
+        'import subprocess\nprint("leak")\n'
+        "def go(cmd):\n    return subprocess.Popen(cmd)\n"
+    )
+    (pkg / "solver" / "b.py").write_text(
+        'import json\nprint("leak2")\n'
+        "def dump(path, p):\n"
+        '    with open(path, "w") as f:\n        json.dump(p, f)\n'
+    )
+    config = default_config(str(tmp_path))
+    files = collect_sources(str(tmp_path), "karpenter_core_tpu")
+    seq = run_passes(files, config, workers=1)
+    par = run_passes(files, config, workers=8)
+    assert [v.render() for v in seq.violations] == [
+        v.render() for v in par.violations
+    ]
+    assert len(seq.violations) >= 4  # no-print x2, proc-group, atomic-write
+
+
+def test_parallel_real_package_matches_sequential():
+    config = default_config(REPO_ROOT)
+    files = collect_sources(REPO_ROOT, config.package_name)
+    seq = run_passes(files, config, workers=1)
+    par = run_passes(files, config, workers=4)
+    assert [v.key() for v in seq.violations] == [v.key() for v in par.violations]
+    assert [v.key() for v in seq.suppressed] == [v.key() for v in par.suppressed]
+
+
+def test_driver_sarif_output_shape():
+    import json as json_mod
+
+    proc = run_lint("--format", "sarif")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    payload = json_mod.loads(proc.stdout)
+    assert payload["version"] == "2.1.0"
+    run = payload["runs"][0]
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert "atomic-write" in rule_ids and "guarded-by-v2" in rule_ids
+    assert run["results"] == []  # clean repo: no results
+
+
+def test_driver_sarif_carries_locations(tmp_path):
+    """A seeded violation surfaces as a SARIF result with a physical
+    location CI can annotate."""
+    import json as json_mod
+
+    pkg = tmp_path / "karpenter_core_tpu"
+    pkg.mkdir(parents=True)
+    (pkg / "__init__.py").write_text("")
+    (pkg / "oops.py").write_text('print("leak")\n')
+    config = default_config(str(tmp_path))
+    files = collect_sources(str(tmp_path), "karpenter_core_tpu")
+    result = run_passes(files, config)
+    sys.path.insert(0, os.path.join(REPO_ROOT, "hack"))
+    try:
+        import lint as lint_mod
+    finally:
+        sys.path.pop(0)
+    payload = json_mod.loads(
+        json_mod.dumps(lint_mod.sarif_payload(all_passes(), result))
+    )
+    results = payload["runs"][0]["results"]
+    leak = next(r for r in results if r["ruleId"] == "no-print")
+    loc = leak["locations"][0]["physicalLocation"]
+    assert loc["artifactLocation"]["uri"] == "karpenter_core_tpu/oops.py"
+    assert loc["region"]["startLine"] == 1
+
+
+def test_driver_changed_filter():
+    """--changed keeps the run whole-package (layering needs the graph)
+    but reports only files differing from the base; against HEAD the
+    committed tree reports nothing and the summary names the mode."""
+    proc = run_lint("--changed", "HEAD")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "changed-only:" in proc.stdout
+
+
+def test_driver_changed_rejected_with_update_baseline(tmp_path):
+    proc = run_lint(
+        "--changed", "--update-baseline", "--baseline", str(tmp_path / "b.txt")
+    )
+    assert proc.returncode == 2
+    assert "full run" in proc.stderr
 
 
 def test_suppression_parser_spellings():

@@ -5,12 +5,14 @@ import os
 import pytest
 
 from karpenter_core_tpu.analysis import AnalysisConfig
+from karpenter_core_tpu.analysis.atomicwrite import AtomicWritePass
 from karpenter_core_tpu.analysis.concurrency import ConcurrencyPass
 from karpenter_core_tpu.analysis.core import collect_sources, load_tree, run_passes
 from karpenter_core_tpu.analysis.envdiscipline import EnvDisciplinePass
 from karpenter_core_tpu.analysis.layering import LayeringPass
 from karpenter_core_tpu.analysis.montime import MonotonicTimePass
 from karpenter_core_tpu.analysis.noprint import NoPrintPass
+from karpenter_core_tpu.analysis.procdiscipline import ProcessDisciplinePass
 from karpenter_core_tpu.analysis.trace_safety import TraceSafetyPass
 
 FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "analysis_fixtures")
@@ -198,6 +200,120 @@ def test_concurrency_catches_seeded_violations():
 def test_concurrency_quiet_on_disciplined_code():
     violations, _ = run_one(ConcurrencyPass(), "concurrency_good.py")
     assert violations == []
+
+
+# -- guarded-by-v2 (lockset summaries) ------------------------------------
+
+
+def test_guardedby2_flags_split_locksets_v1_cannot_see():
+    """Both bad classes are invisible to v1 (every write is either inside
+    SOME with-block or uses the acquire() pattern v1 doesn't parse); the
+    lockset intersection catches them."""
+    violations, _ = run_one(ConcurrencyPass(), "guardedby2_bad.py")
+    v1 = [v for v in violations if v.rule == "guarded-by"]
+    v2 = [v for v in violations if v.rule == "guarded-by-v2"]
+    assert v1 == []
+    assert len(v2) == 2, [v.render() for v in violations]
+    split = next(v for v in v2 if "SplitLocks.count" in v.message)
+    assert "_lock_b" in split.message and "_lock_a" in split.message
+    bare = next(v for v in v2 if "AcquireBare.total" in v.message)
+    assert "no lock" in bare.message and "reset" in bare.message
+
+
+def test_guardedby2_quiet_on_consistent_locksets():
+    """acquire()/release() guards, the non-blocking gate pattern, a with
+    nested inside try/if, and *_locked callee-guarded methods all stay
+    clean under the lockset flow."""
+    violations, _ = run_one(ConcurrencyPass(), "guardedby2_good.py")
+    assert [v for v in violations if v.rule == "guarded-by-v2"] == [], [
+        v.render() for v in violations
+    ]
+
+
+def test_guardedby2_does_not_duplicate_v1_findings():
+    """The mixed guarded/unguarded write in concurrency_bad.py is v1's
+    finding; v2 must not re-report the same line."""
+    violations, _ = run_one(ConcurrencyPass(), "concurrency_bad.py")
+    v1_lines = {v.line for v in violations if v.rule == "guarded-by"}
+    v2_lines = {v.line for v in violations if v.rule == "guarded-by-v2"}
+    assert not (v1_lines & v2_lines)
+
+
+# -- process discipline ---------------------------------------------------
+
+
+def test_procdiscipline_catches_seeded_violations():
+    violations, _ = run_one(ProcessDisciplinePass(), "procdiscipline_bad.py")
+    by_rule = {}
+    for v in violations:
+        by_rule.setdefault(v.rule, []).append(v)
+    # direct + aliased Popen, both missing start_new_session
+    assert len(by_rule.get("proc-group", [])) == 2
+    assert len(by_rule.get("proc-kill-group", [])) == 1
+    # assigned-but-never-joined + anonymous non-daemon threads
+    assert len(by_rule.get("thread-join", [])) == 2
+
+
+def test_procdiscipline_quiet_on_disciplined_code():
+    violations, _ = run_one(ProcessDisciplinePass(), "procdiscipline_good.py")
+    assert violations == [], [v.render() for v in violations]
+
+
+def test_procdiscipline_funnels_and_allowlist():
+    """The supervisor funnels may Popen on their own terms, and an audited
+    os_kill_allowlist entry silences the killpg rule for that function."""
+    sf = load_tree(
+        os.path.join(FIXTURES, "procdiscipline_bad.py"),
+        "layerpkg/utils/supervise.py",
+    )
+    config = fixture_config(
+        popen_funnels=frozenset({"layerpkg/utils/supervise.py"}),
+        os_kill_allowlist=frozenset(
+            {"layerpkg/utils/supervise.py::kill_child"}
+        ),
+    )
+    violations = ProcessDisciplinePass().run([sf], config)
+    assert [v for v in violations if v.rule == "proc-group"] == []
+    assert [v for v in violations if v.rule == "proc-kill-group"] == []
+
+
+# -- atomic write ---------------------------------------------------------
+
+
+def test_atomicwrite_catches_bare_writes():
+    violations, _ = run_one(AtomicWritePass(), "atomicwrite_bad.py")
+    assert len(violations) == 3, [v.render() for v in violations]
+    assert all(v.rule == "atomic-write" for v in violations)
+    assert {v.line for v in violations} == {6, 11, 22}
+
+
+def test_atomicwrite_quiet_on_idiom_appends_and_reads():
+    violations, _ = run_one(
+        AtomicWritePass(), "atomicwrite_good.py",
+        plain_write_allowlist=frozenset(
+            {"atomicwrite_good.py::allowlisted_stream"}
+        ),
+    )
+    assert violations == [], [v.render() for v in violations]
+
+
+def test_atomicwrite_allowlist_is_per_function():
+    """Without the audited entry, the allowlisted stream write IS flagged
+    — the exemption is site-scoped, not file-scoped."""
+    violations, _ = run_one(AtomicWritePass(), "atomicwrite_good.py")
+    assert len(violations) == 1
+    assert "allowlist" in violations[0].message
+
+
+def test_atomicwrite_funnel_module_is_exempt():
+    sf = load_tree(
+        os.path.join(FIXTURES, "atomicwrite_bad.py"),
+        "layerpkg/utils/supervise.py",
+    )
+    config = fixture_config(
+        atomic_write_funnels=frozenset({"layerpkg/utils/supervise.py"})
+    )
+    assert AtomicWritePass().run([sf], config) == []
 
 
 # -- no-print -------------------------------------------------------------

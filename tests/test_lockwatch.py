@@ -151,6 +151,58 @@ def test_arm_spellings():
             lockwatch.GLOBAL.uninstall()
 
 
+def test_cross_thread_handoff_taints_the_lock():
+    """A lock acquired on thread A and released on thread B (semaphore-
+    style handoff) must not leak a held-stack entry on A forever: the uid
+    is tainted and purged, so A's later held set is clean (ISSUE 13 —
+    leaked entries poisoned racewatch locksets and ordering edges)."""
+    watch = lockwatch.LockWatch()
+    lk = watch.make_lock("handoff")
+    other = watch.make_lock("other")
+    lk.acquire()  # main thread acquires...
+
+    def releaser():
+        lk.release()  # ...worker releases: handoff
+
+    t = threading.Thread(target=releaser, name="handoff-rel", daemon=True)
+    t.start()
+    t.join(timeout=10)
+    # the leaked entry on the main thread is purged once tainted
+    with other:
+        assert watch.held_sites() == ["other"]
+        assert all(
+            watch.site_of_uid(u) == "other" for u in watch.held_lock_uids()
+        )
+
+
+def test_handoff_release_never_corrupts_a_same_site_sibling():
+    """A handoff release arriving on a thread that legitimately holds a
+    SIBLING from the same allocation site must taint the handed-off lock,
+    not decrement the sibling's entry (release matches by uid first)."""
+    watch = lockwatch.LockWatch()
+    handed = watch.make_lock("shared-site")
+    own = watch.make_lock("shared-site")
+    handed.acquire()  # main thread will release on the worker
+
+    def worker():
+        own.acquire()
+        handed.release()  # handoff lands while holding the sibling
+        # the sibling must still read as held...
+        assert any(
+            own._uid in acq.uids for acq in watch._held()
+        ), "sibling entry was corrupted by the handoff release"
+        own.release()
+        assert watch._held() == []
+
+    t = threading.Thread(target=worker, name="handoff-sib", daemon=True)
+    t.start()
+    t.join(timeout=10)
+    assert not t.is_alive()
+    # ...and the handed-off uid (not the sibling's) is the tainted one
+    assert handed._uid in watch._tainted_uids
+    assert own._uid not in watch._tainted_uids
+
+
 def test_condition_support_on_tracked_rlock():
     """threading.Condition over a tracked RLock uses the _release_save /
     _acquire_restore protocol — the proxy must forward it."""

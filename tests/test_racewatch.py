@@ -1,0 +1,495 @@
+"""Racewatch (Eraser lockset detector) tests: the seeded two-thread write
+race is caught with both access stacks, benign lock-protected and
+read-only sharing stay quiet, the overhead bounds (sampling knob, per-
+field access cap) work, the opt-out env spelling works — and one
+regression test per race the gate found in the real package (ISSUE 13
+satellite) seeds the PRE-FIX interleaving on a replica and proves the
+fixed shape is clean.
+
+Standalone RaceWatch instances (their own LockWatch, no access filter)
+are used throughout so the suite never touches the global patch."""
+import threading
+
+import pytest
+
+from karpenter_core_tpu.testing import lockwatch, racewatch
+
+
+def make_watch(**kw):
+    lw = lockwatch.LockWatch()
+    kw.setdefault("class_filter", lambda cls: True)
+    rw = racewatch.RaceWatch(lock_watch=lw, **kw)
+    return lw, rw
+
+
+def run_threads(*fns):
+    ts = [
+        threading.Thread(target=fn, daemon=True, name=f"rw-{i}")
+        for i, fn in enumerate(fns)
+    ]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=10)
+        assert not t.is_alive()
+
+
+def alternate(fn_a, fn_b, rounds=20):
+    """Run fn_a/fn_b strictly alternating from two live threads (ping-
+    pong events): the state machine needs GENUINE interleaving — two
+    tiny loops often run to completion sequentially under the GIL, which
+    is a synchronized handoff, not a race."""
+    ev_a, ev_b = threading.Event(), threading.Event()
+    ev_a.set()
+
+    def loop(fn, my_ev, other_ev):
+        for _ in range(rounds):
+            assert my_ev.wait(10)
+            my_ev.clear()
+            fn()
+            other_ev.set()
+
+    run_threads(
+        lambda: loop(fn_a, ev_a, ev_b), lambda: loop(fn_b, ev_b, ev_a)
+    )
+
+
+class Counter:
+    def __init__(self, lw):
+        self._mu = lw.make_lock("counter-mu")
+        self.guarded = 0
+        self.racy = 0
+        self.read_only = 42
+
+
+# -- detection ------------------------------------------------------------
+
+
+def test_seeded_two_thread_write_race_is_detected():
+    lw, rw = make_watch(access_cap=0)
+    c = Counter(lw)
+    rw.track_instance(c)
+
+    def write_once():
+        c.racy += 1
+
+    alternate(write_once, write_once)
+    races = rw.races()
+    assert [r.key for r in races] == ["Counter.racy"]
+    report = rw.report()
+    assert "candidate data race" in report
+    assert "Counter.racy" in report
+    # both access stacks are rendered (prior + current)
+    assert "prior:" in report and "current:" in report
+    assert "no locks" in report
+
+
+def test_benign_lock_protected_counter_is_clean():
+    lw, rw = make_watch(access_cap=0)
+    c = Counter(lw)
+    rw.track_instance(c)
+
+    def locked_writer():
+        for _ in range(200):
+            with c._mu:
+                c.guarded += 1
+
+    run_threads(locked_writer, locked_writer)
+    assert rw.races() == [], rw.report()
+    assert "no candidate data races" in rw.report()
+
+
+def test_read_only_sharing_never_reports():
+    """Initialized-then-read-everywhere state is the SHARED state: an
+    empty lockset there must not report (Eraser's read-share refinement)."""
+    lw, rw = make_watch(access_cap=0)
+    c = Counter(lw)
+    rw.track_instance(c)
+    sink = []
+
+    def reader():
+        for _ in range(100):
+            sink.append(c.read_only)
+
+    run_threads(reader, reader)
+    assert rw.races() == [], rw.report()
+
+
+def test_single_thread_use_stays_exclusive():
+    lw, rw = make_watch(access_cap=0)
+    c = Counter(lw)
+    rw.track_instance(c)
+    for _ in range(50):
+        c.racy += 1  # construction thread only: EXCLUSIVE, never reported
+    assert rw.races() == []
+
+
+def test_write_under_different_locks_is_a_race():
+    """Lock identity matters: two sibling locks from one site do not
+    protect the same field."""
+    lw, rw = make_watch(access_cap=0)
+
+    class Split:
+        def __init__(self):
+            self.a = lw.make_lock("split-site")
+            self.b = lw.make_lock("split-site")
+            self.field = 0
+
+    s = Split()
+    rw.track_instance(s)
+
+    def via_a():
+        with s.a:
+            s.field += 1
+
+    def via_b():
+        with s.b:
+            s.field += 1
+
+    alternate(via_a, via_b)
+    assert [r.key for r in rw.races()] == ["Split.field"]
+
+
+def test_suppression_is_counted_not_reported():
+    lw, rw = make_watch(access_cap=0)
+    rw.suppress("Counter.racy", "seeded benign race for the test")
+    c = Counter(lw)
+    rw.track_instance(c)
+
+    def write_once():
+        c.racy += 1
+
+    alternate(write_once, write_once)
+    assert rw.races() == []
+    assert rw.stats()["suppressed_hits"].get("Counter.racy", 0) >= 1
+
+
+# -- overhead bounds ------------------------------------------------------
+
+
+def test_access_cap_freezes_a_field():
+    lw, rw = make_watch(access_cap=10)
+    c = Counter(lw)
+    rw.track_instance(c)
+    for _ in range(100):
+        c.guarded += 1  # single-thread: 200 would-be accesses, cap 10
+    assert rw.stats()["recorded_accesses"] <= 10 * 4  # per-FIELD cap
+
+
+def test_race_after_cap_is_not_reported():
+    """The cap is a real bound: once a field freezes, later accesses (even
+    racy ones) record nothing — the race-smoke lane runs cap-off for
+    exhaustiveness."""
+    lw, rw = make_watch(access_cap=5)
+    c = Counter(lw)
+    rw.track_instance(c)
+    for _ in range(10):
+        c.racy += 1  # burn the cap single-threaded
+
+    def writer():
+        for _ in range(50):
+            c.racy += 1
+
+    run_threads(writer, writer)
+    assert rw.races() == []
+
+
+def test_sampling_knob_tracks_every_nth_instance():
+    lw, rw = make_watch(sample=3, access_cap=0)
+    rw.install()
+
+    class Sampled:
+        def __init__(self):
+            self.mu = lw.make_lock("sampled-mu")
+
+    objs = [Sampled() for _ in range(9)]
+    assert len(objs) == 9
+    assert rw.stats()["tracked_instances"] == 3  # every 3rd allocation
+
+
+def test_subclass_of_instrumented_base_is_not_double_wrapped():
+    """A subclass inheriting an instrumented base's wrappers must not be
+    wrapped again: chained wrappers record every access twice, burning
+    the per-field cap at 2x and pinning the base's wrapper permanently."""
+    lw, rw = make_watch(access_cap=0)
+
+    class Base:
+        def __init__(self):
+            self.mu = lw.make_lock("base-mu")
+            self.x = 0
+
+    class Child(Base):
+        def __init__(self):
+            super().__init__()
+            self.extra = lw.make_lock("child-mu")
+
+    rw.install()
+    b = Base()
+    rw.track_instance(b)
+    c = Child()  # allocates a lock -> discovery fires for Child too
+    rw.track_instance(c)
+    assert Base in rw._instrumented
+    assert Child not in rw._instrumented  # inherits Base's wrapper: enough
+    before = rw.stats()["recorded_accesses"]
+    c.x = 1
+    after = rw.stats()["recorded_accesses"]
+    assert after - before == 1, "chained wrappers double-recorded a write"
+    rw.uninstall()
+    assert type(c).__setattr__ is object.__setattr__
+
+
+def test_uninstall_restores_attribute_protocol():
+    lw, rw = make_watch(access_cap=0)
+
+    class Plain:
+        def __init__(self):
+            self.mu = lw.make_lock("plain-mu")
+            self.x = 0
+
+    rw.install()
+    p = Plain()
+    rw.track_instance(p)
+    assert type(p).__setattr__ is not object.__setattr__
+    rw.uninstall()
+    assert type(p).__setattr__ is object.__setattr__
+    p.x = 1  # inert: no recording, no error
+    assert rw.stats()["tracked_instances"] in (0, 1)
+
+
+# -- arming ---------------------------------------------------------------
+
+
+def test_arm_opt_out_spellings():
+    assert racewatch.arm("0") is False
+    assert racewatch.arm("off", default_on=True) is False
+    assert racewatch.arm("", default_on=False) is False
+
+
+def test_arm_parses_sample_and_cap():
+    prev_sample, prev_cap = racewatch.GLOBAL.sample, racewatch.GLOBAL.access_cap
+    try:
+        assert racewatch.arm("1", default_on=False, sample="4", cap="0") is True
+        assert racewatch.GLOBAL.sample == 4
+        assert racewatch.GLOBAL.access_cap == 0
+    finally:
+        racewatch.GLOBAL.sample = prev_sample
+        racewatch.GLOBAL.access_cap = prev_cap
+
+
+# -- regression: the races the gate found in the real package -------------
+#
+# Each replica seeds the PRE-FIX interleaving shape and must be caught;
+# the paired "fixed" replica uses the landed locking discipline and must
+# be clean. The real classes are covered by the armed suite-wide watcher
+# (conftest pytest_sessionfinish), which fails the whole run if any of
+# these regresses in the package itself.
+
+
+def _seed(watch, obj, interleave_a, interleave_b):
+    watch.track_instance(obj)
+    alternate(interleave_a, interleave_b)
+    return [r.key for r in watch.races()]
+
+
+def test_regression_host_metadata_prefix_shape():
+    """solver/host.py pre-fix: _spawn_locked mutated generation under the
+    dispatch lock while report() read it lock-free."""
+    lw, rw = make_watch(access_cap=0)
+
+    class HostReplica:
+        def __init__(self):
+            self._mu = lw.make_lock("host-mu")
+            self._meta_mu = lw.make_lock("host-meta-mu")
+            self.generation = 0
+
+        def spawn_prefix(self):  # pre-fix: metadata under the DISPATCH lock
+            with self._mu:
+                self.generation += 1
+
+        def report_prefix(self):  # pre-fix: lock-free read
+            return self.generation
+
+        def spawn_fixed(self):
+            with self._mu:
+                with self._meta_mu:
+                    self.generation += 1
+
+        def report_fixed(self):
+            with self._meta_mu:
+                return self.generation
+
+    h = HostReplica()
+    keys = _seed(rw, h, h.spawn_prefix, h.report_prefix)
+    assert "HostReplica.generation" in keys
+
+    lw2, rw2 = make_watch(access_cap=0)
+    # rebind the replica's locks to the fresh watch
+    h2 = HostReplica.__new__(HostReplica)
+    h2._mu = lw2.make_lock("host-mu")
+    h2._meta_mu = lw2.make_lock("host-meta-mu")
+    h2.generation = 0
+    rw2.track_instance(h2)
+    alternate(h2.spawn_fixed, h2.report_fixed)
+    assert rw2.races() == [], rw2.report()
+
+
+def test_regression_fallback_last_hb_shape():
+    """solver/fallback.py pre-fix: _primary_solve wrote _last_hb bare
+    while health_report read it under the verdict lock."""
+    lw, rw = make_watch(access_cap=0)
+
+    class FallbackReplica:
+        def __init__(self):
+            self._state_mu = lw.make_lock("state-mu")
+            self._last_hb = None
+
+        def solve_prefix(self, hb):
+            self._last_hb = hb  # pre-fix: bare write
+
+        def solve_fixed(self, hb):
+            with self._state_mu:
+                self._last_hb = hb
+
+        def report(self):
+            with self._state_mu:
+                return self._last_hb
+
+    f = FallbackReplica()
+    keys = _seed(rw, f, lambda: f.solve_prefix(object()), f.report)
+    assert "FallbackReplica._last_hb" in keys
+
+    lw2, rw2 = make_watch(access_cap=0)
+    f2 = FallbackReplica.__new__(FallbackReplica)
+    f2._state_mu = lw2.make_lock("state-mu")
+    f2._last_hb = None
+    rw2.track_instance(f2)
+    alternate(lambda: f2.solve_fixed(object()), f2.report)
+    assert rw2.races() == [], rw2.report()
+
+
+def test_regression_provisioner_retry_counter_shape():
+    """controllers/provisioning pre-fix: _launch_retry_failures mutated
+    with no lock from overlapping reconciles (the class owned _mu but
+    never used it)."""
+    lw, rw = make_watch(access_cap=0)
+
+    class ProvisionerReplica:
+        def __init__(self):
+            self._mu = lw.make_lock("prov-mu")
+            self.failures = 0
+
+        def reconcile_prefix(self):
+            self.failures += 1  # pre-fix: _mu exists but is never held
+
+        def reconcile_fixed(self):
+            with self._mu:
+                self.failures += 1
+
+    p = ProvisionerReplica()
+    keys = _seed(rw, p, p.reconcile_prefix, p.reconcile_prefix)
+    assert "ProvisionerReplica.failures" in keys
+
+    lw2, rw2 = make_watch(access_cap=0)
+    p2 = ProvisionerReplica.__new__(ProvisionerReplica)
+    p2._mu = lw2.make_lock("prov-mu")
+    p2.failures = 0
+    rw2.track_instance(p2)
+    alternate(p2.reconcile_fixed, p2.reconcile_fixed)
+    assert rw2.races() == [], rw2.report()
+
+
+def test_real_resilient_solver_interleaving_is_race_free():
+    """The landed fix on the REAL class: solves binding heartbeats while
+    another thread polls health_report — no candidate race recorded by
+    the armed global watcher (skipped when racewatch is off)."""
+    import tests.conftest as conftest
+
+    if not getattr(conftest, "RACEWATCH_ARMED", False):
+        pytest.skip("global racewatch not armed")
+    from karpenter_core_tpu.solver.fallback import ResilientSolver
+
+    class StubSolver:
+        def solve(self, *a, **k):
+            return "ok"
+
+    rs = ResilientSolver(
+        StubSolver(), StubSolver(), prober=lambda: None,
+        solve_timeout=5.0, small_batch_work_max=0,
+    )
+    before = {r.key for r in racewatch.GLOBAL.races()}
+
+    def solver_loop():
+        for _ in range(20):
+            rs._primary_solve([], {}, {})
+
+    def health_loop():
+        for _ in range(20):
+            rs.health_report()
+            rs.supports_batched_replan
+
+    run_threads(solver_loop, health_loop)
+    after = {r.key for r in racewatch.GLOBAL.races()}
+    assert not {
+        k for k in (after - before) if k.startswith("ResilientSolver.")
+    }, racewatch.GLOBAL.report()
+
+
+def test_real_metrics_registry_interleaving_is_race_free():
+    """metrics/registry.py audit (ISSUE 13 satellite): every mutable
+    series dict — including the Gauge.replace_all whole-dict swap — is
+    read and written under the per-metric lock; interleaving scrapes
+    with writers must record no candidate race on the armed watcher."""
+    import tests.conftest as conftest
+
+    if not getattr(conftest, "RACEWATCH_ARMED", False):
+        pytest.skip("global racewatch not armed")
+    from karpenter_core_tpu.metrics.registry import Registry
+
+    reg = Registry()
+    gauge = reg.gauge("rw_audit_gauge")
+    counter = reg.counter("rw_audit_counter")
+    hist = reg.histogram("rw_audit_hist")
+    before = {r.key for r in racewatch.GLOBAL.races()}
+
+    def writer():
+        gauge.replace_all([(1.0, {"a": "1"}), (2.0, {"a": "2"})])
+        counter.inc({"a": "1"})
+        hist.observe(0.25)
+
+    def scraper():
+        reg.expose()
+        gauge.get({"a": "1"})
+        hist.percentile(0.99)
+
+    alternate(writer, scraper)
+    after = {r.key for r in racewatch.GLOBAL.races()}
+    fresh = {
+        k for k in (after - before)
+        if k.split(".")[0] in ("Registry", "Counter", "Gauge", "Histogram")
+    }
+    assert not fresh, racewatch.GLOBAL.report()
+
+
+def test_real_chaos_fault_interleaving_is_race_free():
+    import tests.conftest as conftest
+
+    if not getattr(conftest, "RACEWATCH_ARMED", False):
+        pytest.skip("global racewatch not armed")
+    from karpenter_core_tpu import chaos
+
+    fault = chaos.Fault("test.point", error=None, probability=0.0)
+    before = {r.key for r in racewatch.GLOBAL.races()}
+
+    def fire_loop():
+        for _ in range(50):
+            fault.fire()
+
+    def repr_loop():
+        for _ in range(50):
+            repr(fault)
+
+    run_threads(fire_loop, repr_loop)
+    after = {r.key for r in racewatch.GLOBAL.races()}
+    assert not {k for k in (after - before) if k.startswith("Fault.")}, (
+        racewatch.GLOBAL.report()
+    )

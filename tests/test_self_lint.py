@@ -57,11 +57,33 @@ def test_every_source_file_parses():
 def test_wallclock_allowlist_sites_still_exist():
     """Allowlist entries name live `relpath::function` sites; a stale entry
     (site renamed/moved) would silently widen the exemption."""
+    _assert_function_sites_live("wallclock_allowlist")
+
+
+def test_plain_write_allowlist_sites_still_exist():
+    """Same staleness guard for the atomic-write audited sites (ISSUE 13)."""
+    _assert_function_sites_live("plain_write_allowlist")
+
+
+def test_os_kill_allowlist_sites_still_exist():
+    _assert_function_sites_live("os_kill_allowlist")
+
+
+def test_funnel_modules_still_exist():
+    """popen/atomic-write funnels name live modules — a renamed supervisor
+    must take its funnel entry with it, not leave a silent wildcard."""
+    config = default_config(REPO_ROOT)
+    files = {f.relpath for f in collect_sources(REPO_ROOT, config.package_name)}
+    for entry in sorted(config.popen_funnels | config.atomic_write_funnels):
+        assert entry in files, f"funnel module gone: {entry}"
+
+
+def _assert_function_sites_live(allowlist_name):
     import ast
 
     config = default_config(REPO_ROOT)
     files = {f.relpath: f for f in collect_sources(REPO_ROOT, config.package_name)}
-    for entry in sorted(config.wallclock_allowlist):
+    for entry in sorted(getattr(config, allowlist_name)):
         relpath, func = entry.split("::")
         assert relpath in files, f"allowlisted file gone: {entry}"
         names = {
