@@ -4,7 +4,8 @@
 
 .PHONY: test deflake perf bench verify trace-demo chaos chaos-smoke \
 	replay-demo lint soak soak-smoke soak-smoke-inproc prewarm-smoke \
-	multichip-smoke consolidation-smoke bench-smoke host-smoke race-smoke
+	multichip-smoke consolidation-smoke bench-smoke host-smoke race-smoke \
+	segment-smoke
 
 test:  ## tier-1 suite (CPU, 8 virtual devices); slow chaos soaks: make chaos
 	python -m pytest tests -q -m "not slow"
@@ -72,6 +73,10 @@ consolidation-smoke:  ## batched subset evaluator vs sequential simulator on a l
 bench-smoke:  ## tiny CPU resumable round: chaos-wedged stage degrades, --resume backfills
 	python hack/bench_smoke.py
 
+segment-smoke:  ## segmented pack scan on a live operator: byte-identical to
+	# sequential, fixup fraction reported, chaos degrades segmented->sequential
+	python hack/segment_smoke.py
+
 verify:  ## driver hooks: single-chip compile check + 8-way mesh dryrun
 	# force the CPU backend in-process: this image's sitecustomize pins the
 	# axon TPU tunnel (env vars can't override it), and a wedged tunnel
@@ -130,6 +135,10 @@ verify:  ## driver hooks: single-chip compile check + 8-way mesh dryrun
 	# non-fatal smoke: the solver host killed mid-solve must respawn with
 	# byte-identical placements and zero live zombies (fatal in presubmit)
 	-$(MAKE) host-smoke
+	# non-fatal smoke: the segmented pack scan on a live operator must stay
+	# byte-identical to sequential and degrade cleanly under chaos (fatal
+	# gate lives in presubmit)
+	-$(MAKE) segment-smoke
 	# non-fatal smoke: the lock-heavy suites under the exhaustive racewatch
 	# posture — sampling off, cap off (fatal gate lives in presubmit)
 	-$(MAKE) race-smoke

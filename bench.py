@@ -374,6 +374,90 @@ def _reference_mix(n_pods: int, n_types: int, distinct: int = 1, seed: int = 0,
     }
 
 
+def _segmented_probe_workload(n_pods: int, distinct: int, pools: int,
+                              seed: int, universe):
+    """The PARTITIONABLE generic mix for the segmented-scan A/B (ISSUE 14):
+    the _reference_mix generic share split across `pools` selector-scoped
+    provisioners (per-team pools — the realistic multi-tenant shape). No
+    topology families: those are structurally ineligible for segmentation
+    and are measured by the headline mix itself."""
+    from karpenter_core_tpu.testing import make_pod, make_pool_provisioners
+
+    provisioners, its = make_pool_provisioners(pools, universe)
+    pods = []
+    for i in range(n_pods):
+        p = i % pools
+        pods.append(make_pod(
+            labels={"app": f"seg-{seed}-{i % max(distinct, 1)}"},
+            requests={"cpu": "1", "memory": "1Gi"},
+            node_selector={"team": f"pool-{p}"},
+        ))
+    return pods, provisioners, its
+
+
+def _segmented_ab(universe, n_pods: int, distinct: int, pairs: int = 3):
+    """Same-host interleaved A/B: sequential vs segmented pack scan on the
+    partitionable generic mix at the current (possibly CPU-shrunk)
+    geometry. Returns the headline columns — segment_count,
+    fixup_fraction, segmented_speedup — plus the per-mode device medians,
+    measured PR 8-style (honest: the segmented window includes the
+    partition + merge cost, and a 1-segment collapse reports speedup ~1.0
+    with fixup 1.0 rather than hiding behind the fallback)."""
+    from karpenter_core_tpu.obs.flightrec import (
+        canonical_placements,
+        placements_json,
+    )
+    from karpenter_core_tpu.solver.tpu_solver import TPUSolver
+
+    pools = int(os.environ.get("BENCH_SEGMENT_POOLS", "8"))
+    solver = TPUSolver(max_nodes=max(512, n_pods // 4 + 256))
+    pods, provisioners, its = _segmented_probe_workload(
+        n_pods, distinct, pools, 0, universe
+    )
+    import copy as _copy
+
+    def run(mode, batch):
+        solver.pack_scan = mode
+        t0 = time.perf_counter()
+        res = solver.solve(_copy.deepcopy(batch), provisioners, its)
+        dt = (time.perf_counter() - t0) * 1e3
+        ph = dict(solver.last_phase_ms)
+        # the per-mode window is partition + lane dispatch + fetch + host
+        # merge for segmented vs dispatch + fetch for sequential — the
+        # merge and the partition are real per-solve costs sequential mode
+        # never pays, so they stay inside the compared window
+        dev = sum(
+            ph.get(k, 0.0) for k in ("segment", "device", "fetch", "merge")
+        )
+        return res, dev, dt
+
+    # warm both modes (compiles excluded from the timed pairs)
+    res_seq, _, _ = run("sequential", pods)
+    res_seg, _, _ = run("segmented", pods)
+    stats = solver.last_segment_stats or {}
+    identical = placements_json(canonical_placements(res_seq)) == (
+        placements_json(canonical_placements(res_seg))
+    )
+    seq_dev, seg_dev = [], []
+    for _r in range(pairs):
+        _, d1, _ = run("sequential", pods)
+        _, d2, _ = run("segmented", pods)
+        seq_dev.append(d1)
+        seg_dev.append(d2)
+    seq_med = float(np.median(seq_dev))
+    seg_med = float(np.median(seg_dev))
+    return {
+        "segment_count": int(stats.get("segments", 0)),
+        "fixup_fraction": float(stats.get("fixup_fraction", 1.0)),
+        "segmented_speedup": round(seq_med / seg_med, 3) if seg_med else None,
+        "segmented_device_med_ms": round(seg_med, 1),
+        "sequential_device_med_ms": round(seq_med, 1),
+        "segmented_mode": stats.get("mode"),
+        "segmented_identical": bool(identical),
+        "segmented_pools": pools,
+    }
+
+
 def _config5_provisioners():
     """BASELINE config 5's control-plane shape: multiple weighted
     provisioners over spot+on-demand priced offerings — a high-weight
@@ -953,6 +1037,27 @@ def stage_headline():
     lookups = (hits1 - hits0) + (misses1 - misses0)
     bucket_hit_ratio = round((hits1 - hits0) / lookups, 3) if lookups else None
     pods_per_sec = N_PODS / p99  # pods/sec at the p99 latency, headline size
+
+    # segmented-scan A/B (ISSUE 14): first-class headline columns, measured
+    # on the partitionable generic mix at this round's geometry so a
+    # resumed TPU round backfills them in the same artifact. Budget-shed
+    # like the optional stages — the columns always appear (null on shed).
+    seg_cols = {
+        "segment_count": None, "fixup_fraction": None,
+        "segmented_speedup": None,
+    }
+    if _worker_time_left() > 180 and os.environ.get(
+        "BENCH_SKIP_SEGMENTED", ""
+    ) != "1":
+        try:
+            _touch()
+            seg_cols = _segmented_ab(universe=ctx.universe,
+                                     n_pods=N_PODS, distinct=N_DISTINCT)
+            print(f"[bench] segmented A/B: {seg_cols}", file=sys.stderr)
+        except Exception as exc:  # noqa: BLE001 — a probe failure costs
+            # only these columns, never the headline numbers
+            seg_cols["segmented_error"] = f"{type(exc).__name__}: {exc}"
+            print(f"[bench] segmented A/B failed: {exc}", file=sys.stderr)
     print(
         f"[bench] e2e p50={p50 * 1e3:.0f}ms p99={p99 * 1e3:.0f}ms "
         f"device_med={device_ms:.0f}ms compiled_programs={compiled}",
@@ -978,6 +1083,7 @@ def stage_headline():
         "solver": solver_desc,
         "chips": len(jax.devices()),
         "cpu_fallback": BACKEND_NOTE.startswith("cpu-fallback"),
+        **seg_cols,
     }
 
 

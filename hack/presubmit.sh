@@ -44,6 +44,9 @@ make bench-smoke
 echo "== presubmit: make host-smoke (host killed mid-solve: respawn + parity + no zombies)"
 make host-smoke
 
+echo "== presubmit: make segment-smoke (segmented scan: byte-identity + chaos degradation)"
+make segment-smoke
+
 if [[ "${1:-}" != "quick" ]]; then
   echo "== presubmit: short deflake (3 iterations)"
   MAX_ITERS=3 ./hack/deflake.sh

@@ -82,6 +82,11 @@ SOLVER_HOST_CRASH = "solver.host.crash"
 # error:exhausted so callers see the same typed RESOURCE_EXHAUSTED a full
 # queue raises
 SOLVER_RPC_OVERLOAD = "solver.rpc.overload"
+# the segmented pack-scan dispatch (ISSUE 14, TPUSolver._try_segmented):
+# an injected fault models a device failure inside the segmented attempt —
+# partition kernel, lane dispatch, or merge — and the contract is that the
+# solve DEGRADES to the sequential scan (fixup_fraction 1.0), never fails
+SOLVER_SEGMENT = "solver.segment"
 STATE_WATCH = "state.watch"
 # the state-store delta feed the incremental solve path gates on
 # (state.Cluster.changes_since): an injected fault models dropped or
@@ -97,6 +102,7 @@ KNOWN_POINTS = (
     SOLVER_DEVICE_HANG,
     SOLVER_HOST_CRASH,
     SOLVER_RPC_OVERLOAD,
+    SOLVER_SEGMENT,
     STATE_WATCH,
     STATE_DIFF,
 )

@@ -61,6 +61,32 @@ def resolve_screen_mode() -> str:
     return "prescreen"
 
 
+def resolve_pack_scan() -> str:
+    """Pick the pack kernel's SCAN strategy (ISSUE 14).
+
+    'sequential': the proven single lax.scan over all FFD-ordered items.
+    'segmented': partition items into conflict-independent segments via the
+    resident [N, C] verdict tensor (ops/pack.make_segment_partition_kernel),
+    scan segments in parallel (vmapped lanes against disjoint slot
+    partitions), and merge on the host — byte-identical to sequential by
+    construction, degrading to the sequential kernel whenever the
+    disjointness proof fails (topology/ports/volumes/finite limits, a
+    single conflict component, or post-hoc slot-budget overflow).
+    'auto' currently resolves to 'sequential': the segmented win is only
+    proven on CPU fallback so far (docs/solver-perf.md "segmented
+    packing"); flip after a real-TPU round (ROADMAP item 1) lands the
+    numbers. KCT_PACK_SCAN ∈ {auto, sequential, segmented}. This is a
+    DISPATCH policy like the incremental mode — the sequential program's
+    compiled key never changes; segmented dispatches extra programs under
+    their own scan-mode-suffixed keys."""
+    from karpenter_core_tpu.obs import envflags
+
+    mode = envflags.raw("KCT_PACK_SCAN", "auto").strip().lower()
+    if mode in ("sequential", "segmented"):
+        return mode
+    return "sequential"
+
+
 def resolve_incremental_mode() -> str:
     """Pick the incremental (delta re-solve) screen policy.
 

@@ -407,6 +407,148 @@ def make_batched_replan_kernel(rung_run, n_exist: int, external_screen: bool):
     return replan
 
 
+def make_segment_partition_kernel(segments, n_exist: int,
+                                  screen_v: Optional[int] = None,
+                                  backend: Optional[str] = None,
+                                  spec_layout=None):
+    """Device-side segment partitioner (ISSUE 14 tentpole): label every
+    verdict-tensor class column with its CONFLICT COMPONENT, so the solver
+    can pack independent components in parallel lanes and still be
+    byte-identical to the sequential scan.
+
+    Two classes conflict iff
+      * their feasible EXISTING-slot sets intersect (read straight off the
+        resident [N, C] verdict tensor's existing prefix — the PR 5/6
+        precompute is exactly the conflict structure), or
+      * their template requirement-verdicts intersect (both could land on —
+        or open — a machine of the same template: a machine row's planes
+        are always a NARROWING of its template's, and the requirement
+        algebra is monotone under narrowing except for the deny channel
+        below, so the template verdict is a superset of reachability), or
+      * one DEFINES a custom key the other custom-DENIES (the one
+        non-monotone channel: a commit that defines a custom key on a slot
+        LIFTS the Compatible() deny for classes that require that key —
+        requirements.go:123-133 — so a verdict can flip False -> True on
+        exactly those (definer, denier) pairs).
+
+    All three tests are conservative SUPERSETS of runtime interaction:
+    capacity, tolerations, scoring and skew only ever REMOVE candidates,
+    and plane merges only ever narrow the remaining terms, so a missing
+    edge proves the sequential scan could never have routed one class's
+    pods through the other's slots or machines. That proof is what makes
+    the per-segment results literally equal the sequential results
+    restricted to the segment (modulo machine-slot renumbering, which the
+    host merge replays in global item order). The predicate deliberately
+    does NOT need a mutates-a-plane catch-all: plane-mutating items stay
+    segmentable because the lanes run the full in-scan refresh machinery,
+    and their mutations land only on slots/machines already inside their
+    own component.
+
+    Returns (labels [C] int32 — component id per class column, neutral
+    [C] bool — no defined keys inside the screen width, slot_label [E]
+    int32 — owning component per existing slot, -1 when no class is
+    feasible there)."""
+    backend = backend or compat.resolve_backend()
+    ops = make_screen_ops(list(segments), backend, screen_v)
+    seg_list = list(segments)
+
+    def partition(screen0, pod_arrays, tmpl, well_known):
+        if spec_layout is not None:
+            g = spec_layout.gather
+            screen0 = g(screen0)
+            pod_arrays = {k: g(jnp.asarray(v)) for k, v in pod_arrays.items()}
+            tmpl = {k: g(jnp.asarray(v)) for k, v in tmpl.items()}
+            well_known = g(well_known)
+        sf = jnp.asarray(pod_arrays["scls_first"])
+        items = {
+            k: jnp.asarray(pod_arrays[k])[sf]
+            for k in ("allow", "out", "defined", "escape", "custom_deny")
+        }
+        C = items["allow"].shape[0]
+        V = items["allow"].shape[1]
+        WSCR = V if screen_v is None else min(screen_v, V)
+        key_scr = jnp.asarray([lo < WSCR for (lo, _hi) in seg_list])
+        neutral = ~jnp.any(items["defined"] & key_scr[None, :], axis=-1)
+        tmpl_rows = ops.rows_vs_items(
+            items, tmpl["allow"], tmpl["out"], tmpl["defined"]
+        )  # [J, C]
+        t = tmpl_rows.astype(jnp.bfloat16)
+        conf = (
+            jnp.matmul(t.T, t, preferred_element_type=jnp.float32) > 0.5
+        )  # [C, C]
+        if n_exist:
+            a = screen0[:n_exist].astype(jnp.bfloat16)
+            conf |= (
+                jnp.matmul(a.T, a, preferred_element_type=jnp.float32) > 0.5
+            )
+        # the deny-lift channel, per key: class c defines a custom key k
+        # (any defined merge makes its slots define k — In, NotIn and DNE
+        # alike), class c' custom-denies k, AND their value sets on k can
+        # actually intersect (the lifted slot's k-plane is always a subset
+        # of c's allow, so an empty c∩c' intersection proves the k-term
+        # still fails after the lift — disjoint selector pools stay
+        # disjoint). Zero-width / complement-only keys fall back to the
+        # both_out term, same shape as the screen algebra itself.
+        lift = jnp.zeros((C, C), dtype=bool)
+        for k, (lo, hi) in enumerate(seg_list):
+            pair = (
+                items["defined"][:, k : k + 1]
+                & ~well_known[k]
+                & items["custom_deny"][None, :, k]
+            )  # [C, C]: definer rows x denier columns
+            both_out = items["out"][:, k : k + 1] & items["out"][None, :, k]
+            if hi > lo:
+                inter = (
+                    jnp.matmul(
+                        items["allow"][:, lo:hi].astype(jnp.bfloat16),
+                        items["allow"][:, lo:hi].astype(jnp.bfloat16).T,
+                        preferred_element_type=jnp.float32,
+                    )
+                    > 0.5
+                )
+                nonempty = both_out | inter
+            else:
+                nonempty = both_out
+            lift |= pair & nonempty
+        conf = conf | lift | lift.T
+        conf |= jnp.eye(C, dtype=bool)
+
+        # connected components by min-label propagation: converges in at
+        # most the component diameter (<= C) rounds; the while_loop stops
+        # at the fixpoint, which real workloads reach in a handful
+        def w_cond(c):
+            return c[1]
+
+        def w_body(c):
+            labels, _ = c
+            new = jnp.min(
+                jnp.where(conf, labels[None, :], jnp.int32(C)), axis=-1
+            ).astype(jnp.int32)
+            new = jnp.minimum(new, labels)
+            return new, jnp.any(new != labels)
+
+        labels, _ = jax.lax.while_loop(
+            w_cond, w_body, (jnp.arange(C, dtype=jnp.int32), jnp.bool_(True))
+        )
+        if n_exist:
+            se = screen0[:n_exist]
+            slot_label = jnp.min(
+                jnp.where(se, labels[None, :], jnp.int32(C)), axis=-1
+            )
+            slot_label = jnp.where(
+                slot_label == C, jnp.int32(-1), slot_label
+            ).astype(jnp.int32)
+        else:
+            slot_label = jnp.zeros((0,), jnp.int32)
+        if spec_layout is not None:
+            # process-unique persistent-cache key on CPU (semantic no-op;
+            # specs.SpecLayout.cache_salt — multi-device executables only)
+            labels = spec_layout.cache_salt(labels)
+        return labels, neutral, slot_label
+
+    return partition
+
+
 def make_pack_kernel(
     segments,
     zone_seg,
@@ -784,6 +926,13 @@ def make_pack_kernel(
         vol_driver: jnp.ndarray = None,  # [W, D] claim -> driver onehot
         log_commits: bool = True,
         screen0: jnp.ndarray = None,  # [N, C] precomputed verdict tensor
+        item_ids: jnp.ndarray = None,  # [I] global item id per scan row
+        screen_frozen: bool = False,  # all-neutral lanes: read-only verdicts
+        bulk_len: int = None,  # override the bulk-take row budget
+        class_planes: dict = None,  # [C, ...] verdict-column planes, when
+        #                             the item axis is a gathered subset
+        #                             (segmented lanes) and scls_first can
+        #                             no longer index it
     ):
         N = state.used.shape[0]
         J = tmpl_daemon.shape[0]
@@ -819,6 +968,11 @@ def make_pack_kernel(
             LB = 1
         elif not log_commits:
             LB = 1
+        elif bulk_len is not None:
+            # segmented lanes size the take matrix to their own item count
+            # (no vk-spread rounds there — topology disables segmentation),
+            # keeping the vmapped [S, LB, BR] plane bounded
+            LB = max(int(bulk_len), 1)
         elif mach_bulk:
             # take rows are per bulk COMMIT: <=~2 per plain bulk item
             # (fill + post-open leftovers) plus one per water-fill domain
@@ -864,25 +1018,43 @@ def make_pack_kernel(
         item_arrays = dict(item_arrays)
         scls_first = item_arrays.pop("scls_first", None)
         if prescreen:
-            if scls_first is None:  # identity: one column per item
-                scls_first = jnp.arange(I, dtype=jnp.int32)
-            scls_first = jnp.asarray(scls_first)
-            items_pl = {
-                k: jnp.asarray(item_arrays[k])[scls_first]
-                for k in ("allow", "out", "defined", "escape", "custom_deny")
-            }
+            if class_planes is not None:
+                items_pl = dict(class_planes)
+            else:
+                if scls_first is None:  # identity: one column per item
+                    scls_first = jnp.arange(I, dtype=jnp.int32)
+                scls_first = jnp.asarray(scls_first)
+                items_pl = {
+                    k: jnp.asarray(item_arrays[k])[scls_first]
+                    for k in ("allow", "out", "defined", "escape",
+                              "custom_deny")
+                }
             C = items_pl["allow"].shape[0]
-            screen_init = (
-                screen0
-                if screen0 is not None
-                else screen_ops.initial_screen(
-                    items_pl,
-                    state.allow[:n_exist],
-                    state.out[:n_exist],
-                    state.defined[:n_exist],
-                    N,
-                )
-            )  # [N, C], slot-major
+            if screen_frozen:
+                # segmented lane (ISSUE 14): every lane item is proven
+                # plane-neutral by the partitioner, so no commit can change
+                # any verdict. The tensor stays a scan CONSTANT (one shared
+                # copy across all vmapped lanes) instead of riding the
+                # carry, and the refresh-descriptor machinery compiles
+                # away; opened MACHINE rows — whose tensor entries are
+                # virgin and, in the sequential path, overwritten by the
+                # open's refresh — read the precomputed tmpl_rows gather in
+                # `step` instead (a neutral open writes exactly the
+                # template's row).
+                assert screen0 is not None, "frozen screen requires screen0"
+                screen_init = screen0
+            else:
+                screen_init = (
+                    screen0
+                    if screen0 is not None
+                    else screen_ops.initial_screen(
+                        items_pl,
+                        state.allow[:n_exist],
+                        state.out[:n_exist],
+                        state.defined[:n_exist],
+                        N,
+                    )
+                )  # [N, C], slot-major
         else:
             items_pl = None
             C = 0
@@ -896,10 +1068,11 @@ def make_pack_kernel(
         if prescreen:
             if "scls" not in item_arrays:  # identity column per item
                 item_arrays["scls"] = jnp.arange(I, dtype=jnp.int32)
+        if prescreen:
             tmpl_rows = screen_ops.rows_vs_items(
                 items_pl, tmpl_reqs["allow"], tmpl_reqs["out"],
                 tmpl_reqs["defined"],
-            )  # [J, C]
+            )  # [J, C] — frozen mode reads these for opened machine rows
         else:
             tmpl_rows = None
         # refresh DESCRIPTOR. The verdict tensor must never be written
@@ -1072,6 +1245,31 @@ def make_pack_kernel(
             # 1k items, measured). Padded / empty items skip the whole step
             # body (screens, probes, spread plans) through ONE cond.
             valid_i = x["valid"] & (x["count"] > 0)
+            if prescreen and screen_frozen:
+                # read-only tensor: gather the column from the scan
+                # CONSTANT; no refresh replay, no tensor in the carry —
+                # position 3 of the carry is a dead scalar. Opened MACHINE
+                # rows are the one place the constant is stale (the
+                # sequential path overwrites them at open time): a neutral
+                # open writes exactly the template's precomputed row, so
+                # read tmpl_rows[state.tmpl] there instead. Unopened
+                # machine rows keep the virgin value, which — as in the
+                # sequential tensor — is never read (screens AND with
+                # state.open).
+                def _skip_f(c, _x):
+                    return c
+
+                st0 = carry[0]
+                vrow0 = jnp.where(
+                    st0.open & ~st0.is_existing,
+                    tmpl_rows[st0.tmpl, x["scls"]],
+                    screen_init[:, x["scls"]],
+                )
+                state2, log2, ptr2, _ = jax.lax.cond(
+                    valid_i, _step_body, _skip_f,
+                    (carry[0], carry[1], carry[2], vrow0), x,
+                )
+                return (state2, log2, ptr2, carry[3]), None
             if prescreen:
                 # the step body READS the verdict tensor (one column
                 # gather) but returns a refresh descriptor in its place;
@@ -1135,7 +1333,12 @@ def make_pack_kernel(
             # SCREENED keys: narrowing an elided hostname key (hostname
             # spread/anti topology) is equally verdict-neutral, which
             # spares the biggest per-slot committers the re-screens.
-            if prescreen:
+            if prescreen and screen_frozen:
+                # every lane item is plane-neutral (partitioner invariant):
+                # no refresh bookkeeping at all
+                vrow = aux3
+                plane_mut = None
+            elif prescreen:
                 vrow = aux3  # verdict column [N], gathered by `step`
                 any_topo_scr = jnp.bool_(False)
                 if has_topo:
@@ -1475,7 +1678,7 @@ def make_pack_kernel(
                 )
                 log, ptr = log_write(log, ptr, do, i, n, 1, k, k)
                 remaining = remaining - jnp.where(do, k, 0)
-                if prescreen:
+                if prescreen and not screen_frozen:
                     # incremental refresh: re-screen ONLY slot row n (post-
                     # commit planes) against the whole item axis, recorded
                     # as one descriptor op — `step` replays it outside the
@@ -1738,7 +1941,7 @@ def make_pack_kernel(
                     }
                 log, ptr = log_write(log, ptr, do, i, 0, -1, bn, placed)
                 remaining = remaining - jnp.where(do, placed, 0)
-                if prescreen:
+                if prescreen and not screen_frozen:
                     # only TOUCHED rows changed planes (each merged with
                     # this item's planes) — a bulk commit touches at most
                     # the item's replica count of rows, so gather up to UWB
@@ -1979,7 +2182,7 @@ def make_pack_kernel(
                 # reference simply fails such a pod, machine.go:94-107)
                 dead = dead | (dmark & failed & (n_owned_vk == 1))
                 exhausted = failed & (n_owned_vk != 1)
-                if prescreen:
+                if prescreen and not screen_frozen:
                     # every opened slot carries the SAME merged row, so ONE
                     # descriptor op — [base, base+s) sharing one [C]
                     # verdict row — covers the whole open (`step` replays
@@ -2088,7 +2291,7 @@ def make_pack_kernel(
             # in prescreen mode the while carries the refresh descriptor in
             # the screen's slot; the tensor itself stays outside the step
             # cond and is updated by `step` via apply_refresh
-            x8_0 = empty_desc() if prescreen else aux3
+            x8_0 = empty_desc() if (prescreen and not screen_frozen) else aux3
             carry0 = (
                 state, log, ptr, remaining0, score0, jnp.bool_(False),
                 jnp.zeros(V, dtype=bool), x8_0, jnp.int32(0),
@@ -2100,12 +2303,24 @@ def make_pack_kernel(
 
         xs = dict(
             item_arrays,
-            i=jnp.arange(I, dtype=jnp.int32),
+            # `i` is the id the commit log records per entry: the global
+            # item index. Segmented lanes scan a GATHERED subset of the
+            # item axis and pass the original indices through item_ids so
+            # the host merge can interleave per-lane logs back into the
+            # sequential order.
+            i=(
+                jnp.asarray(item_ids, dtype=jnp.int32)
+                if item_ids is not None
+                else jnp.arange(I, dtype=jnp.int32)
+            ),
             f_static=jnp.moveaxis(f_static, 1, 0),  # [I, J, T]
             openable=openable.T,  # [I, J]
         )
+        # frozen mode keeps the (read-only) verdict tensor OUT of the scan
+        # carry: one shared constant instead of one copy per vmapped lane
+        aux0 = jnp.int32(0) if (prescreen and screen_frozen) else screen_init
         (state, log, ptr, _screen), _ = jax.lax.scan(
-            step, (state, log0, jnp.int32(0), screen_init), xs
+            step, (state, log0, jnp.int32(0), aux0), xs
         )
         return state, log, ptr
 

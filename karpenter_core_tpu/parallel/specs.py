@@ -111,6 +111,19 @@ class SpecLayout:
         gathers from are item_plane())."""
         return self._ns(self.dp_axis, *([None] * (rank - 1)))
 
+    def segment_axis(self, rank: int = 2):
+        """[S, ...] segmented pack-scan lane planes (ISSUE 14): the LANE
+        axis shards over dp — under segmented mode the pack scan stops
+        being the replicated part of the mesh program; each dp shard runs
+        its own lanes' scans. The replication FENCE is unchanged WITHIN a
+        lane: every shared scan input (item planes, templates, the frozen
+        verdict tensor) stays pinned replicated by run_impl's gather seam,
+        so the per-lane program is byte-identical to the single-device
+        lane (docs/sharding.md "segmented lanes"). Same dp-leading spec as
+        slot_plane — delegated so the lane fence can never drift from the
+        slot-row family it mirrors."""
+        return self.slot_plane(rank)
+
     def verdict(self):
         """The [N, C] prescreen verdict tensor: slot rows over dp, class
         columns over tp — both contraction outputs tile with zero

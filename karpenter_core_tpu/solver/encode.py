@@ -148,6 +148,36 @@ def replan_k_pad(k: int) -> int:
     )
 
 
+# -- segmented pack-scan axes (ISSUE 14) -------------------------------------
+# The segmented dispatch vmaps the pack scan over S conflict-independent
+# lanes of at most M items each (TPUSolver._try_segmented). Both are
+# compiled-program axes, so they ride small fixed ladders: the lane axis a
+# two-value bucket (load-balanced lane counts are capped well below it),
+# the per-lane item axis a pow2 bucket bounded by the snapshot's item tier
+# — so the segmented program family per geometry stays
+# len(SEGMENT_LANE_BUCKETS) x O(log items), not O(observed partitions).
+
+SEGMENT_LANE_BUCKETS = (4, 8, 16)
+
+
+def segment_lane_pad(s: int) -> int:
+    """Round a lane count up to the segment lane-axis ladder."""
+    for v in SEGMENT_LANE_BUCKETS:
+        if s <= v:
+            return v
+    raise ValueError(
+        f"lane axis {s} exceeds the segment lane cap "
+        f"{SEGMENT_LANE_BUCKETS[-1]} (the dispatcher load-balances into "
+        f"fewer lanes)"
+    )
+
+
+def segment_item_pad(m: int, item_pad: int) -> int:
+    """Round a max-lane item count up to its pow2 bucket, capped at the
+    snapshot's item tier (a lane can never hold more than every item)."""
+    return min(bucket_pow2(max(m, 1), 32), max(item_pad, 32))
+
+
 def replan_chunks(count_rows, exist_open):
     """Yield (k_real, k_pad, counts, open) dispatch chunks along the
     candidate axis: slices of at most REPLAN_K_BUCKETS[-1] subsets, padded
@@ -599,6 +629,19 @@ class EncodedSnapshot:
     item_pad: int = 0
     cls_pad: int = 0
     ladder: object = None  # the tier tuple in effect at encode time
+
+    # segmented pack-scan metadata (ISSUE 14): structural eligibility (no
+    # topology groups / host ports / volumes in the batch — the global
+    # couplings the segment partition cannot express) and the per-class
+    # plane-neutrality mask (no defined keys inside the screen width).
+    # Neutrality does NOT gate segmentation — plane-mutating classes stay
+    # segmentable because their mutations land inside their own conflict
+    # component (ops/pack.make_segment_partition_kernel) — it only selects
+    # the frozen read-only-verdict lane variant when EVERY class is
+    # neutral. Dispatch additionally requires infinite provisioner limits
+    # (device_args).
+    seg_eligible: bool = False
+    seg_plane_neutral: np.ndarray = None  # [U] bool
 
     # host-side back-references for decode
     instance_types: List[InstanceType] = field(default_factory=list)
@@ -1278,6 +1321,23 @@ def encode_snapshot(
     item_pad = ladder_pad(max(len(item_counts), 1), ladder, "items", 32)
     cls_pad = ladder_pad(max(len(scls_items), 1), ladder, "items", 32)
 
+    # segmented pack-scan metadata (ISSUE 14): structural eligibility and
+    # the per-class plane-neutrality mask, computed here (pure functions of
+    # the encoded planes) so the dispatch gate is one flag read and the
+    # partitioner's host-side mirror never drifts from the encoder
+    seg_key_scr = np.array(
+        [dictionary.segment(k)[0] < screen_v for k in dictionary.keys],
+        dtype=bool,
+    )
+    seg_plane_neutral = ~(
+        pod_reqs_u_arr.defined & seg_key_scr[None, :]
+    ).any(axis=1)
+    seg_eligible = (
+        (topo_meta is None or len(topo_meta.groups) == 0)
+        and (pod_ports_u is None or pod_ports_u.shape[1] == 0)
+        and (pod_vols_u is None or pod_vols_u.shape[1] == 0)
+    )
+
     return EncodedSnapshot(
         dictionary=dictionary,
         resource_names=resource_names,
@@ -1322,6 +1382,8 @@ def encode_snapshot(
         item_pad=item_pad,
         cls_pad=cls_pad,
         ladder=ladder,
+        seg_eligible=seg_eligible,
+        seg_plane_neutral=seg_plane_neutral,
         instance_types=all_types,
         templates=templates,
         pods=pods_sorted,

@@ -132,6 +132,26 @@ assert all(n in RUN_ARG_NAMES for n in DONATE_ARG_NAMES)
 DEFAULT_MAX_RELAX_ROUNDS = 16
 
 
+def _segment_tmpl_fingerprint(raw_args) -> bytes:
+    """Digest of the template-side partitioner inputs (tmpl planes +
+    well_known mask). The incremental verdict fingerprints only cover the
+    pod/existing planes, so segment-label residency must separately prove
+    these unchanged before reusing cached labels."""
+    import hashlib
+
+    h = hashlib.blake2b(digest_size=16)
+    tmpl = raw_args[RUN_ARG_NAMES.index("tmpl")]
+    for k in sorted(tmpl):
+        h.update(k.encode())
+        h.update(np.ascontiguousarray(tmpl[k]).tobytes())
+    h.update(
+        np.ascontiguousarray(
+            raw_args[RUN_ARG_NAMES.index("well_known")]
+        ).tobytes()
+    )
+    return h.digest()
+
+
 def solve_with_relaxation(solve_once, pods, provisioners, instance_types,
                           max_relax_rounds: int) -> "SolveResult":
     """Shared driver: guard degenerate inputs, run solve_once, relax EVERY
@@ -264,7 +284,9 @@ def make_device_run(segments, zone_seg, ct_seg, topo_meta, n_slots,
                     screen_v: Optional[int] = None,
                     screen_mode: Optional[str] = None,
                     external_prescreen: bool = False,
-                    spec_layout=None):
+                    spec_layout=None,
+                    segment_mode: bool = False,
+                    seg_frozen: bool = False):
     """Build the jittable device program — the whole Solve() as ONE program:
     feasibility + openable + packing scan. Pure function of the device arrays
     produced by device_args(); all dims except n_slots derive from shapes.
@@ -273,6 +295,24 @@ def make_device_run(segments, zone_seg, ct_seg, topo_meta, n_slots,
     rung_mode=True prepends two args (count_row [I], exist_open [E]) that
     override the per-item replica counts and the open-existing-slot mask —
     the vmap axis of the batched consolidation ladder (solver/replan.py).
+
+    segment_mode=True (ISSUE 14) builds the SEGMENTED pack program instead:
+    seg_run(item_sel [S, M], exist_open [S, E], screen0, *run_args) vmaps
+    the pack scan over S conflict-independent lanes. Each lane gathers M
+    items (item_sel row; -1 pads skip), opens only its own existing slots
+    (exist_open row — the partitioner proved the rows disjoint), and packs
+    machine slots into its own private region [E, N). With seg_frozen=True
+    (every class in the snapshot plane-neutral, encode.seg_plane_neutral)
+    the verdict tensor is READ-ONLY: one scan constant shared across lanes
+    with opened machine rows reading the precomputed template rows, and
+    the refresh machinery compiles away; otherwise (e.g. selector-scoped
+    pods, which define their selector keys) each lane carries its own
+    tensor copy and runs the full in-scan refresh machinery. The scan
+    length is M — the segment bucket — not I: that is the whole point (the
+    last O(items) sequential wall becomes O(max-segment)). The host merge
+    (TPUSolver._try_segmented) interleaves the per-lane commit logs back
+    into global item order and renumbers machine slots in first-open
+    order, which reproduces the sequential kernel's numbering exactly.
 
     screen_mode picks the pack kernel's slot-screen strategy (prescreen vs
     tiered, compat.resolve_screen_mode default). With external_prescreen
@@ -306,7 +346,7 @@ def make_device_run(segments, zone_seg, ct_seg, topo_meta, n_slots,
                  type_offering_ok, pod_tol_all, exist, exist_used, exist_cap,
                  well_known, remaining0, topo_counts0, topo_hcounts0,
                  topo_doms0, topo_terms, exist_ports, exist_vols,
-                 exist_vol_limits, vol_driver):
+                 exist_vol_limits, vol_driver, item_sel=None):
         E = exist_used.shape[0]
         N = n_slots
         R = type_alloc.shape[1]
@@ -379,6 +419,40 @@ def make_device_run(segments, zone_seg, ct_seg, topo_meta, n_slots,
                  vol_driver,
              ))
             topo_terms = {k: g(v) for k, v in topo_terms.items()}
+        class_planes = None
+        if item_sel is not None:
+            # segmented lane: the scan consumes only this lane's items —
+            # gather the per-item planes (and the feasibility columns,
+            # which were computed ONCE over the full axis above and stay
+            # unbatched under vmap) down to the [M] segment bucket. Pads
+            # (-1) gather row 0 with valid=False/count=0, so they skip the
+            # whole step body exactly like the item-axis tier padding.
+            # The verdict-COLUMN planes are gathered from the FULL item
+            # axis first: scls_first indexes the original axis, and the
+            # lanes' refresh machinery re-screens written slot rows
+            # against every class (other lanes' columns included — they
+            # are never read here, but the tensor layout is shared).
+            sf = pod_arrays.get("scls_first")
+            if sf is None:
+                sf = jnp.arange(
+                    pod_arrays["allow"].shape[0], dtype=jnp.int32
+                )
+            class_planes = {
+                k: jnp.asarray(pod_arrays[k])[jnp.asarray(sf)]
+                for k in ("allow", "out", "defined", "escape",
+                          "custom_deny")
+            }
+            gi = jnp.maximum(item_sel, 0)
+            onsel = item_sel >= 0
+            pa = dict(pod_arrays)
+            pa.pop("scls_first", None)
+            pa = {k: jnp.asarray(v)[gi] for k, v in pa.items()}
+            pa["valid"] = pa["valid"] & onsel
+            pa["count"] = jnp.where(onsel, pa["count"], 0)
+            pod_arrays = pa
+            pod_tol_all = jnp.asarray(pod_tol_all)[gi]
+            f_static = f_static[:, gi, :]
+            openable = openable[:, gi]
         # initial state: existing slots [0, E), machine slots open later
         state = PackState(
             used=jnp.zeros((N, R), jnp.float32).at[:E].set(exist_used),
@@ -428,8 +502,57 @@ def make_device_run(segments, zone_seg, ct_seg, topo_meta, n_slots,
             # bulk existing-fill fast path run per rung
             log_commits=not rung_mode,
             screen0=screen0,
+            item_ids=item_sel,
+            # frozen lanes (every dispatched class plane-neutral, proven
+            # host-side by encode.seg_plane_neutral): the verdict tensor is
+            # a read-only scan constant shared across lanes and the refresh
+            # machinery compiles away
+            screen_frozen=bool(seg_frozen and item_sel is not None),
+            class_planes=class_planes,
+            bulk_len=(
+                min(2 * item_sel.shape[0] + 64, 4096)
+                if item_sel is not None
+                else None
+            ),
         )
         return log, ptr, state
+
+    if segment_mode:
+        assert screen_mode == "prescreen", (
+            "segmented packing requires the prescreen verdict tensor"
+        )
+        import jax
+
+        def seg_run(item_sel, exist_open, screen0, *rest):
+            if spec_layout is not None:
+                # the mesh-path segment fence (docs/sharding.md): the LANE
+                # axis shards over dp — the scan stops being the replicated
+                # part of the mesh program — while run_impl's existing
+                # gather fence keeps every WITHIN-lane scan input pinned
+                # replicated, exactly as on the sequential mesh path
+                seg2 = spec_layout.segment_axis(rank=2)
+                item_sel = spec_layout.constrain(item_sel, seg2)
+                exist_open = spec_layout.constrain(exist_open, seg2)
+
+            def one(sel, eo):
+                return run_impl(None, eo, screen0, *rest, item_sel=sel)
+
+            out = jax.vmap(one)(item_sel, exist_open)
+            if spec_layout is not None:
+                out = jax.tree_util.tree_map(
+                    lambda t: spec_layout.constrain(
+                        t,
+                        spec_layout.segment_axis(rank=max(t.ndim, 1)),
+                    ),
+                    out,
+                )
+                # process-unique persistent-cache key on CPU (semantic
+                # no-op; specs.SpecLayout.cache_salt)
+                log_o, ptr_o, state_o = out
+                out = (log_o, spec_layout.cache_salt(ptr_o), state_o)
+            return out
+
+        return seg_run
 
     if rung_mode:
         if external_prescreen:
@@ -842,7 +965,8 @@ class TPUSolver:
                  donate: bool = True, backend: Optional[str] = None,
                  profile_phases: bool = False,
                  screen_mode: Optional[str] = None,
-                 incremental: Optional[str] = None):
+                 incremental: Optional[str] = None,
+                 pack_scan: Optional[str] = None):
         self.max_nodes = max_nodes
         self.max_relax_rounds = max_relax_rounds
         self.donate = donate
@@ -856,6 +980,11 @@ class TPUSolver:
         # only the state-store delta through the refresh program; 'off'
         # always runs the full precompute
         self.incremental = incremental
+        # pack-scan strategy override (compat.resolve_pack_scan):
+        # 'segmented' partitions items into conflict-independent segments
+        # and packs them in parallel vmapped lanes, byte-identical to —
+        # and degrading to — the 'sequential' scan (ISSUE 14)
+        self.pack_scan = pack_scan
         # opt-in: barrier after upload so last_phase_ms attributes transfer
         # time separately (costs cold solves the serialized upload)
         self.profile_phases = profile_phases
@@ -904,6 +1033,24 @@ class TPUSolver:
         # entry whose prescreen/residency they share
         self.MAX_REPLAN = 16
         self._replan_compiled = OrderedDict()
+        # segmented-scan program family (ISSUE 14): the partitioner program
+        # (one per solve key) and the vmapped lane programs (one per
+        # (solve key, lane bucket, segment bucket)), LRU-bounded and keyed
+        # with the scan mode so sequential-only runs mint NOTHING here
+        self.MAX_SEGMENT = 16
+        self._segment_compiled = OrderedDict()
+        # partition-label residency: (labels, slot_label, tmpl_fp) per
+        # solve key, reused across steady-churn solves whose incremental
+        # refresh reported an EMPTY verdict delta AND whose template-side
+        # digest matches (segment boundaries recomputed only on conflict-
+        # structure delta — rides PR 6's residency). Accessed under
+        # _cache_lock like every other per-key cache; LRU-bounded on its
+        # own so a store racing the solve-entry eviction can never pin a
+        # dead key's label arrays forever
+        self._segment_labels = OrderedDict()
+        # observability for bench/smoke: mode, segment count, max segment,
+        # fixup fraction of the LAST dispatch through _run_kernels
+        self.last_segment_stats = None
         # per-phase host timings of the last replan_screen dispatch
         # (bench.py consolidation columns read these, mirroring
         # last_phase_ms on the solve path)
@@ -1271,6 +1418,417 @@ class TPUSolver:
             inc.adopt(key, screen0)
         return screen0, scr_mode, cold, delta
 
+    # -- segmented pack scan (ISSUE 14 tentpole) ----------------------------
+
+    def _segment_eligible(self, snap: EncodedSnapshot, geom, raw_args):
+        """Host-side structural gate for the segmented scan: the global
+        couplings the segment partition cannot express (topology counts,
+        host-port planes, volume limits, finite provisioner limits) force
+        the sequential kernel. Returns (ok, reason)."""
+        if not getattr(snap, "seg_eligible", False):
+            return False, "structure"  # topology / ports / volumes
+        remaining0 = raw_args[RUN_ARG_NAMES.index("remaining0")]
+        if not bool((remaining0 >= np.float32(1e29)).all()):
+            return False, "finite-limits"
+        C = raw_args[0]["scls_first"].shape[0]
+        if C > 4096:
+            # the [C, C] conflict matrix is the partitioner's one quadratic
+            # cost; cap it well below where it would rival the scan itself
+            return False, "class-axis"
+        if len(geom[8]) > 128:
+            # the deny-lift channel unrolls one [C, C]-scale term per
+            # dictionary KEY at trace time; a pathological label vocabulary
+            # must not stall the first segmented solve compiling the
+            # partitioner (production dictionaries are a few dozen keys)
+            return False, "key-axis"
+        return True, ""
+
+    def _partition_fn(self, staged: _StagedCall, screen_mode):
+        """The jitted segment-partition program for one solve key (reads
+        the solve bundle + the verdict tensor; ops/pack.
+        make_segment_partition_kernel), LRU-bounded in the scan-mode-keyed
+        segment family; returns (fn, minted)."""
+        import jax
+        import jax.numpy as jnp
+
+        rkey = (staged.key, "segmented", "partition")
+        with self._cache_lock:
+            fn = self._segment_compiled.get(rkey)
+            if fn is not None:
+                self._segment_compiled.move_to_end(rkey)
+                return fn, False
+        from karpenter_core_tpu.ops.pack import make_segment_partition_kernel
+
+        (_P, _J, _T, E, _R, _K, _V, _N, segments_t, _zs, _cs, _ts, _ll,
+         _Q, _W, _D, scr_v) = staged.geom
+        kern = make_segment_partition_kernel(
+            segments_t, E, screen_v=scr_v, backend=self.backend,
+            spec_layout=staged.spec_layout,
+        )
+        rebuild = staged.rebuild
+        meta = staged.donated_meta
+
+        def part_bundled(bundle, screen0):
+            dummies = iter(jnp.zeros(s, d) for s, d in meta)
+            named = dict(zip(RUN_ARG_NAMES, rebuild(bundle, dummies)))
+            return kern(
+                screen0, named["pod_arrays"], named["tmpl"],
+                named["well_known"],
+            )
+
+        fn = _Dispatchable(jax.jit(part_bundled))
+        with self._cache_lock:
+            fn = self._segment_compiled.setdefault(rkey, fn)
+            self._segment_compiled.move_to_end(rkey)
+            while len(self._segment_compiled) > self.MAX_SEGMENT:
+                self._segment_compiled.popitem(last=False)
+        return fn, True
+
+    def _segment_fn(self, staged: _StagedCall, s_pad: int, m_pad: int,
+                    screen_mode, frozen: bool = False):
+        """The jitted vmapped lane program for (solve key, lane bucket,
+        segment bucket, frozen) — make_device_run(segment_mode=True) over
+        the shared bundle; returns (fn, minted). `frozen` (every class in
+        the snapshot plane-neutral, per encode.seg_plane_neutral) compiles
+        the read-only-verdict lane variant: the tensor is a shared scan
+        constant instead of one mutable copy per lane and the refresh
+        machinery compiles away. Never donates: the batched lane carries
+        cannot alias the shared planes (same rule as the replan family)."""
+        import jax
+
+        rkey = (staged.key, "segmented", s_pad, m_pad, bool(frozen))
+        with self._cache_lock:
+            fn = self._segment_compiled.get(rkey)
+            if fn is not None:
+                self._segment_compiled.move_to_end(rkey)
+                return fn, False
+        (_P, _J, _T, _E, _R, _K, _V, N_, segments_t, zone_seg, ct_seg,
+         _ts, log_len, _Q, _W, _D, scr_v) = staged.geom
+        seg_run = make_device_run(
+            segments_t, zone_seg, ct_seg, None, N_, log_len=log_len,
+            backend=self.backend, screen_v=scr_v, screen_mode=screen_mode,
+            external_prescreen=True, spec_layout=staged.spec_layout,
+            segment_mode=True, seg_frozen=bool(frozen),
+        )
+        rebuild = staged.rebuild
+
+        def seg_bundled(item_sel, exist_open, screen0, bundle, *donated):
+            return seg_run(
+                item_sel, exist_open, screen0,
+                *rebuild(bundle, iter(donated)),
+            )
+
+        fn = _Dispatchable(jax.jit(seg_bundled))
+        with self._cache_lock:
+            fn = self._segment_compiled.setdefault(rkey, fn)
+            self._segment_compiled.move_to_end(rkey)
+            while len(self._segment_compiled) > self.MAX_SEGMENT:
+                self._segment_compiled.popitem(last=False)
+        return fn, True
+
+    def _try_segmented(self, snap: EncodedSnapshot, staged: _StagedCall,
+                       geom, args, screen0, raw_args, layout, screen_mode,
+                       scr_mode, delta, _mark):
+        """One segmented pack dispatch: partition -> vmapped lanes ->
+        host merge. Returns decode-ready (log, ptr, state) on success,
+        None to degrade to the sequential dispatch (self.last_segment_stats
+        records which). Byte-identity argument, in three steps:
+
+        1. The partitioner's conflict predicate (ops/pack.
+           make_segment_partition_kernel) is a conservative superset of
+           every cross-item interaction the sequential scan can express at
+           this eligibility level, so items in different components never
+           read or write each other's slots — each lane's trajectory IS
+           the sequential trajectory restricted to its items and slots.
+        2. Machine-slot NUMBERING is the one sequential artifact lanes
+           cannot see: the merge replays per-lane commit logs in global
+           item order and assigns global machine slots in first-open
+           order, which is exactly the order the sequential kernel's
+           nopen counter would have assigned them.
+        3. Anything the lanes cannot prove — total opens exceeding the
+           shared slot budget, a commit-log overflow — aborts the merge
+           and re-packs EVERYTHING through the sequential kernel (the
+           fixup pass is the sequential kernel itself: fixup_fraction 1.0,
+           correctness degrades to the proven path, never past it)."""
+        import time as _time
+
+        import jax
+
+        from karpenter_core_tpu.solver.encode import (
+            SEGMENT_LANE_BUCKETS,
+            bucket_pow2,
+            segment_item_pad,
+            segment_lane_pad,
+        )
+        from karpenter_core_tpu.obs import envflags
+
+        E, N = geom[3], geom[7]
+        L = geom[12]
+
+        def _fallback(reason, segments=0, max_segment=0):
+            self.last_segment_stats = {
+                "mode": "sequential-fallback", "reason": reason,
+                "segments": int(segments), "max_segment": int(max_segment),
+                "fixup_fraction": 1.0,
+            }
+            return None
+
+        ok, reason = self._segment_eligible(snap, geom, raw_args)
+        if not ok:
+            return _fallback(reason)
+
+        t_seg = _time.perf_counter()
+        key = staged.key
+        # partition-label residency: an incremental refresh that reported
+        # an EMPTY verdict delta proves the pod/existing side of the
+        # conflict structure unchanged — but the conflict matrix ALSO reads
+        # the template planes and the well-known mask, which the verdict
+        # fingerprints never cover (a provisioner edit can re-weld pools
+        # with zero pod/node churn), so reuse additionally requires the
+        # template-side fingerprint to match; any mismatch (or a full
+        # precompute) recomputes the labels from the refreshed tensor
+        tmpl_fp = _segment_tmpl_fingerprint(raw_args)
+        with self._cache_lock:
+            cached = self._segment_labels.get(key)
+            if cached is not None:
+                self._segment_labels.move_to_end(key)
+        if (
+            cached is not None
+            and scr_mode == "refresh"
+            and delta is not None
+            and len(delta.rows) == 0
+            and len(delta.cols) == 0
+            and cached[2] == tmpl_fp
+        ):
+            labels, slot_label = cached[:2]
+            part_cold = False
+        else:
+            part_fn, part_cold = self._partition_fn(staged, screen_mode)
+            labels_d, _neutral_d, slot_label_d = part_fn(args[0], screen0)
+            labels, slot_label = jax.device_get((labels_d, slot_label_d))
+            labels = np.asarray(labels)
+            slot_label = np.asarray(slot_label)
+            with self._cache_lock:
+                self._segment_labels[key] = (labels, slot_label, tmpl_fp)
+                self._segment_labels.move_to_end(key)
+                while len(self._segment_labels) > self.MAX_SEGMENT:
+                    self._segment_labels.popitem(last=False)
+
+        # -- host grouping: items -> components -> load-balanced lanes ----
+        pa = raw_args[0]
+        scls = np.asarray(pa["scls"])
+        valid = np.asarray(pa["valid"])
+        real = np.nonzero(valid)[0]
+        if len(real) == 0:
+            return _fallback("empty")
+        labs = labels[scls[real]]
+        sort_i = np.argsort(labs, kind="stable")
+        sorted_labs = labs[sort_i]
+        cuts = np.nonzero(np.diff(sorted_labs))[0] + 1
+        group_items = np.split(real[sort_i], cuts)
+        group_labels = sorted_labs[np.concatenate(([0], cuts))] if len(
+            sorted_labs
+        ) else np.zeros(0, np.int64)
+        s_real = len(group_items)
+        if s_real <= 1:
+            return _fallback("single-segment", segments=s_real,
+                             max_segment=len(real))
+
+        # clamp to the lane-axis ladder top: an oversized (or unparseable)
+        # KCT_SEGMENT_LANES must tune DOWN to the compiled bucket, not raise
+        # into the degrade handler and silently disable segmentation on
+        # every solve
+        try:
+            lanes_req = int(envflags.raw("KCT_SEGMENT_LANES", "8") or 8)
+        except ValueError:
+            lanes_req = 8
+        max_lanes = min(max(lanes_req, 2), SEGMENT_LANE_BUCKETS[-1])
+        lanes_n = min(s_real, max_lanes)
+        # LPT load balance by item count (the scan length is what a lane
+        # pays); merging components into one lane is always sound — the
+        # lane is a sequential scan over the union, and independence
+        # across lanes is what the partition proves
+        order_sz = sorted(
+            range(s_real), key=lambda g: -len(group_items[g])
+        )
+        lane_members = [[] for _ in range(lanes_n)]
+        loads = [0] * lanes_n
+        lane_of_label = {}
+        for g in order_sz:
+            tgt = min(range(lanes_n), key=lambda x: loads[x])
+            lane_members[tgt].append(group_items[g])
+            loads[tgt] += len(group_items[g])
+            lane_of_label[int(group_labels[g])] = tgt
+        m_real = max(loads)
+        s_pad = segment_lane_pad(lanes_n)
+        m_pad = segment_item_pad(m_real, geom[0])
+
+        item_sel = np.full((s_pad, m_pad), -1, np.int32)
+        for s, members in enumerate(lane_members):
+            # global item order WITHIN the lane: the lane's scan must
+            # process its items in the same relative order the sequential
+            # scan would
+            rows = np.sort(np.concatenate(members))
+            item_sel[s, : len(rows)] = rows
+        exist_open = np.zeros((s_pad, E), bool)
+        if E:
+            lane_of = np.full(len(labels) + 1, -1, np.int32)
+            for lab, tgt in lane_of_label.items():
+                lane_of[lab] = tgt
+            sl = np.asarray(slot_label[:E])
+            owner = np.where(sl >= 0, lane_of[np.maximum(sl, 0)], -1)
+            for s in range(lanes_n):
+                exist_open[s] = owner == s
+        _mark(
+            "segment", segments=s_real, lanes=lanes_n,
+            max_segment=m_real, cold=part_cold,
+        )
+
+        # -- vmapped lane dispatch ----------------------------------------
+        # frozen lanes: the encoder proved every class plane-neutral (no
+        # defined keys inside the screen width), so no commit can change
+        # any verdict — the lane program keeps the tensor as a shared
+        # read-only scan constant (opened machine rows read the
+        # precomputed template rows instead)
+        neutral = getattr(snap, "seg_plane_neutral", None)
+        frozen = bool(
+            neutral is not None
+            and np.asarray(neutral).size
+            and bool(np.asarray(neutral).all())
+        )
+        t_dispatch = _time.perf_counter()
+        seg_fn, seg_cold = self._segment_fn(
+            staged, s_pad, m_pad, screen_mode, frozen
+        )
+        log_s, ptr_s, state_s = seg_fn(
+            item_sel, exist_open, screen0, args[0], *args[1:]
+        )
+        ptr_a, nopen_a, bulkn_a = (
+            np.asarray(v)
+            for v in jax.device_get(
+                (ptr_s, state_s.nopen, log_s["bulk_n"])
+            )
+        )
+        self.last_device_ms = (_time.perf_counter() - t_dispatch) * 1e3
+        _mark("device", compile_cache="miss" if seg_cold else "hit",
+              lanes=lanes_n)
+        opens = np.maximum(nopen_a - E, 0)
+        lane_lb = min(2 * m_pad + 64, 4096) if E else 1
+        if int(opens.sum()) > N - E:
+            # the disjointness proof cannot cover the SHARED machine-slot
+            # budget: the sequential scan would have exhausted it mid-run,
+            # and from there its trajectory is order-dependent across
+            # segments — re-pack everything through the proven kernel
+            return _fallback("slot-budget", segments=s_real,
+                             max_segment=m_real)
+        if bool((ptr_a >= L).any()) or bool((bulkn_a >= lane_lb).any()):
+            return _fallback("log-overflow", segments=s_real,
+                             max_segment=m_real)
+
+        # -- slice fetch ---------------------------------------------------
+        pb = min(bucket_pow2(max(int(ptr_a.max()), 1), 256), L)
+        nb = min(bucket_pow2(max(int(nopen_a.max()), 1), 256), N)
+        bb = min(bucket_pow2(max(int(bulkn_a.max()), 1), 64), lane_lb)
+        eager = (
+            {k: log_s[k][:, :pb]
+             for k in ("item", "slot", "ns", "k", "k_last")},
+            log_s["bulk_take"][:, :bb] if E else None,
+            {f: getattr(state_s, f)[:, :nb]
+             for f in ("tmpl", "used", "pods", "tmask", "allow", "out",
+                       "defined")},
+        )
+        log_h, bulk_h, st_h = jax.device_get(eager)
+        log_h = {k: np.asarray(v) for k, v in log_h.items()}
+        st_h = {k: np.asarray(v) for k, v in st_h.items()}
+        _mark("fetch")
+
+        # -- merge: interleave lanes into item order, renumber opens ------
+        lane_ptr = [int(p) for p in ptr_a]
+        items_c = np.concatenate(
+            [log_h["item"][s, : lane_ptr[s]] for s in range(s_pad)]
+        )
+        slots_c = np.concatenate(
+            [log_h["slot"][s, : lane_ptr[s]] for s in range(s_pad)]
+        )
+        ns_c = np.concatenate(
+            [log_h["ns"][s, : lane_ptr[s]] for s in range(s_pad)]
+        )
+        k_c = np.concatenate(
+            [log_h["k"][s, : lane_ptr[s]] for s in range(s_pad)]
+        )
+        kl_c = np.concatenate(
+            [log_h["k_last"][s, : lane_ptr[s]] for s in range(s_pad)]
+        )
+        lane_c = np.concatenate(
+            [np.full(lane_ptr[s], s, np.int32) for s in range(s_pad)]
+        )
+        order = np.argsort(items_c, kind="stable")
+        slot_map = {}
+        next_g = E
+        m_item, m_slot, m_ns, m_k, m_kl = [], [], [], [], []
+        bulk_rows = []
+        for e in order:
+            ln, ns, sl = int(lane_c[e]), int(ns_c[e]), int(slots_c[e])
+            kk, kl = int(k_c[e]), int(kl_c[e])
+            if ns == -1:
+                bulk_rows.append(np.asarray(bulk_h[ln, kk]))
+                kk = len(bulk_rows) - 1
+                sl = 0
+            elif sl >= E:
+                for j in range(ns):
+                    lk = (ln, sl + j)
+                    if lk not in slot_map:
+                        slot_map[lk] = next_g
+                        next_g += 1
+                sl = slot_map[(ln, sl)]
+            m_item.append(int(items_c[e]))
+            m_slot.append(sl)
+            m_ns.append(ns)
+            m_k.append(kk)
+            m_kl.append(kl)
+        merged_log = {
+            "item": np.asarray(m_item, np.int32),
+            "slot": np.asarray(m_slot, np.int32),
+            "ns": np.asarray(m_ns, np.int32),
+            "k": np.asarray(m_k, np.int32),
+            "k_last": np.asarray(m_kl, np.int32),
+            "bulk_take": (
+                np.stack(bulk_rows)
+                if bulk_rows
+                else np.zeros((0, E), np.int32)
+            ),
+            "bulk_n": len(bulk_rows),
+        }
+        ptr_m = len(order)
+
+        # -- merged slot state (decode reads machine rows only) -----------
+        total = next_g
+        fields = {}
+        for f, arr in st_h.items():
+            out = np.zeros((total,) + arr.shape[2:], dtype=arr.dtype)
+            if slot_map:
+                gl = np.asarray(list(slot_map.values()), np.int64)
+                ls = np.asarray([k[0] for k in slot_map], np.int64)
+                lc = np.asarray([k[1] for k in slot_map], np.int64)
+                out[gl] = arr[ls, lc]
+            fields[f] = out
+        state_h = _MergedSlotState(**fields)
+        # the host merge is real per-solve cost sequential mode never pays:
+        # it gets its OWN phase mark so the bench A/B window can include it
+        # (docs/solver-perf.md "honest CPU expectations")
+        _mark("merge", entries=int(ptr_m))
+        self.last_segment_stats = {
+            "mode": "segmented",
+            "segments": int(s_real),
+            "lanes": int(lanes_n),
+            "max_segment": int(m_real),
+            "frozen": bool(frozen),
+            "fixup_fraction": 0.0,
+            "opens": int(opens.sum()),
+            "segment_ms": round((_time.perf_counter() - t_seg) * 1e3, 1),
+        }
+        return merged_log, ptr_m, state_h
+
     # -- batched consolidation replan (ISSUE 10 tentpole) -------------------
 
     def replan_screen(self, snap: EncodedSnapshot,
@@ -1538,6 +2096,10 @@ class TPUSolver:
                     for rk in [k for k in self._replan_compiled
                                if k[0] == old_key]:
                         del self._replan_compiled[rk]
+                    for rk in [k for k in self._segment_compiled
+                               if k[0] == old_key]:
+                        del self._segment_compiled[rk]
+                    self._segment_labels.pop(old_key, None)
                     self._inc_screens.pop(old_key, None)
         return entry, False
 
@@ -1733,6 +2295,33 @@ class TPUSolver:
             )
             self.last_prescreen_mode = scr_mode
             run_args = (args[0], screen0, *args[1:])
+            # segmented scan dispatch (ISSUE 14): partition the item axis
+            # into conflict-independent segments off the verdict tensor and
+            # pack them as parallel vmapped lanes. Any failure — structural
+            # ineligibility, a single conflict component, post-hoc
+            # slot-budget overflow, or a device fault (chaos site
+            # solver.segment) — degrades to the sequential dispatch below,
+            # which is also the proven fixup path: correctness can degrade
+            # TO the sequential kernel, never past it.
+            self.last_segment_stats = None
+            scan_mode = self.pack_scan or ops_compat.resolve_pack_scan()
+            if scan_mode == "segmented":
+                try:
+                    chaos.maybe_fail(chaos.SOLVER_SEGMENT)
+                    seg = self._try_segmented(
+                        snap, staged, geom, args, screen0, raw_args,
+                        layout, screen_mode, scr_mode, delta, _mark,
+                    )
+                except Exception as exc:  # noqa: BLE001 — degrade, never fail
+                    self.last_segment_stats = {
+                        "mode": "sequential-fallback",
+                        "reason": f"error:{type(exc).__name__}",
+                        "segments": 0, "max_segment": 0,
+                        "fixup_fraction": 1.0,
+                    }
+                    seg = None
+                if seg is not None:
+                    return seg
         else:
             run_args = args
 
@@ -1892,6 +2481,21 @@ class TPUSolver:
         state_h = _SlotState(state_d, lazy_packed, lazy_widths)
         _mark("fetch")
         return log_h, ptr_i, state_h
+
+class _MergedSlotState:
+    """Host view of the merged per-slot state a segmented dispatch
+    produces (TPUSolver._try_segmented): machine rows gathered from their
+    owning lane's final state, renumbered into sequential open order.
+    All fields are materialized numpy arrays — the segmented fetch already
+    sliced them to the open-row buckets — so the lazy-plane machinery of
+    _SlotState is unnecessary; release() is a no-op for decode symmetry."""
+
+    def __init__(self, **fields):
+        self.__dict__.update(fields)
+
+    def release(self):
+        pass
+
 
 class _SlotState:
     """Host view of the final per-slot state. tmpl/used/pods are fetched

@@ -230,6 +230,29 @@ def make_provisioner(
     return p
 
 
+def make_pool_provisioners(pools: int, universe) -> tuple:
+    """`pools` selector-scoped provisioners ("pool-<p>" requiring
+    `team In [pool-<p>]`) over one shared instance-type universe — the
+    canonical PARTITIONABLE control-plane shape for the segmented pack
+    scan (ISSUE 14): each pool's pods and nodes are invisible to every
+    other pool's, so the conflict partition splits along pools. Shared by
+    the segmented parity/tripwire suites, bench's segmented A/B, and
+    `hack/segment_smoke.py`; pod construction stays with the caller
+    (pods select a pool with `node_selector={"team": "pool-<p>"}`).
+    Returns (provisioners, instance_types_by_provisioner)."""
+    provisioners, its = [], {}
+    for p in range(pools):
+        pool = f"pool-{p}"
+        provisioners.append(make_provisioner(
+            name=pool,
+            requirements=[NodeSelectorRequirement(
+                key="team", operator="In", values=[pool]
+            )],
+        ))
+        its[pool] = universe
+    return provisioners, its
+
+
 def make_machine(
     name: Optional[str] = None,
     provider_id: str = "",
@@ -410,3 +433,47 @@ def make_node(
         Condition(type="Ready", status="True" if ready else "False")
     )
     return node
+
+
+def solve_scan_parity(solvers, pods, provisioners, instance_types,
+                      nodes=None, kube_client=None, max_nodes=96):
+    """Solve the same workload through the sequential AND segmented pack
+    scans and assert the placements are flightrec-canonical BYTE-IDENTICAL
+    — the ISSUE 14 parity bar, shared by test_segmented,
+    test_screen_parity and both differential-fuzz suites so the bar can
+    only be raised in one place. `solvers` is the caller's cache dict (one
+    TPUSolver per mode, so each suite compiles once per geometry family);
+    segment stats are read off solvers["segmented"].last_segment_stats.
+    Returns (sequential_result, segmented_result)."""
+    import copy
+
+    from karpenter_core_tpu.obs import flightrec
+    from karpenter_core_tpu.obs.flightrec import (
+        canonical_placements,
+        placements_json,
+    )
+    from karpenter_core_tpu.solver.tpu_solver import TPUSolver
+
+    results = {}
+    for mode in ("sequential", "segmented"):
+        solver = solvers.setdefault(
+            mode, TPUSolver(max_nodes=max_nodes, pack_scan=mode)
+        )
+        results[mode] = solver.solve(
+            copy.deepcopy(pods), provisioners, instance_types,
+            state_nodes=[n.deep_copy() for n in nodes] if nodes else None,
+            kube_client=kube_client,
+        )
+    seq, seg = results["sequential"], results["segmented"]
+    a = placements_json(canonical_placements(seq))
+    b = placements_json(canonical_placements(seg))
+    if a != b:
+        diff = flightrec.diff_placements(
+            canonical_placements(seq), canonical_placements(seg)
+        )
+        raise AssertionError(
+            "segmented diverged from sequential:\n" + "\n".join(diff)
+        )
+    assert seg.rounds == seq.rounds
+    assert len(seg.failed_pods) == len(seq.failed_pods)
+    return seq, seg

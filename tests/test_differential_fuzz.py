@@ -273,3 +273,25 @@ def test_fuzz_single_vs_sharded(seed, monkeypatch):
         f"{len(sharded.failed_pods)} failed vs single-device "
         f"{len(single.new_machines)} / {len(single.failed_pods)}"
     )
+
+
+_SEGMENTED = {}
+
+
+@pytest.mark.parametrize("seed", [11, 23, 47])
+def test_fuzz_sequential_vs_segmented(seed):
+    """The SAME random workloads through KCT_PACK_SCAN=segmented vs the
+    sequential scan (ISSUE 14 bar): placements BYTE-IDENTICAL
+    (flightrec-canonical) on every seed. The G1 mix carries topology
+    spread and hostPorts, so most seeds exercise the structural
+    sequential fallback — the contract is identity either way, the fixup
+    pass being the sequential kernel itself."""
+    from karpenter_core_tpu.testing import solve_scan_parity
+
+    rng = np.random.default_rng(seed)
+    universe = fake.instance_types(8)
+    pods, provisioners, its, nodes = _workload(rng, universe)
+    _seq, seg = solve_scan_parity(
+        _SEGMENTED, pods, provisioners, its, nodes=nodes
+    )
+    _check_invariants(seg, pods)

@@ -534,3 +534,36 @@ def test_fuzz_g5_mxu_lowering(seed):
     host, tpu = _solve_both(pods, provisioners, its, nodes, backend="mxu")
     _equivalence(host, tpu, pods)
     _check_hostname_anti(tpu)
+
+
+# -- segmented scan differential (ISSUE 14) ----------------------------------
+
+_SEG_SOLVERS = {}
+
+
+def _solve_scan_pair(pods, provisioners, its, nodes, kube=None):
+    from karpenter_core_tpu.testing import solve_scan_parity
+
+    solve_scan_parity(_SEG_SOLVERS, pods, provisioners, its, nodes=nodes,
+                      kube_client=kube)
+
+
+@pytest.mark.parametrize("seed", list(range(300, 300 + 3)))
+def test_fuzz_g3_sequential_vs_segmented(seed):
+    """Relaxation families through the segmented dispatch: every relax
+    round re-encodes, re-partitions, and must stay byte-identical."""
+    rng = np.random.default_rng(seed)
+    pods, provisioners, its, nodes = _g3_workload(rng)
+    _solve_scan_pair(pods, provisioners, its, nodes)
+
+
+@pytest.mark.parametrize("seed", list(range(400, 400 + 3)))
+def test_fuzz_g4_sequential_vs_segmented(seed):
+    """Multi-attribute requirement mixes (selectors over a wide label
+    universe): the family where the partitioner actually finds >1
+    component on some seeds — identity must hold through the real
+    lanes+merge path, not just the fallback."""
+    rng = np.random.default_rng(seed)
+    universe = _g4_universe()
+    pods, provisioners, its, nodes = _g4_workload(rng, universe)
+    _solve_scan_pair(pods, provisioners, its, nodes)

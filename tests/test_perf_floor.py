@@ -448,6 +448,117 @@ def test_prewarm_covers_replan_family():
     assert fn.aot is not None, "prewarm left no AOT replan executable"
 
 
+# -- ISSUE 14 structural tripwires (always run; fatal in make verify) --------
+
+
+def _pool_pods(n, pools=4):
+    from karpenter_core_tpu.testing import make_pool_provisioners
+
+    universe = fake.instance_types(5)
+    provisioners, its = make_pool_provisioners(pools, universe)
+    pods = [
+        make_pod(labels={"app": f"t{i % 8}"},
+                 requests={"cpu": str(0.1 * (1 + i % 4))},
+                 node_selector={"team": f"pool-{i % pools}"})
+        for i in range(n)
+    ]
+    return pods, provisioners, its
+
+
+def test_scan_mode_compiled_program_budget():
+    """ISSUE 14 cache-key tripwire: the segmented scan's extra programs
+    (partitioner + vmapped lane program) live under their own
+    scan-mode-suffixed keys — sequential-only runs mint NOTHING new (the
+    solve entry budget is exactly the prescreen pair, unchanged), and a
+    segmented run at one geometry bucket mints at most tiers x
+    scan-modes-exercised entries: here 1 solve entry + 2 segment
+    programs, with the repeat solve a cache hit on all of them."""
+    pods, provisioners, its = _pool_pods(24)
+
+    seq = TPUSolver(max_nodes=48, pack_scan="sequential")
+    for _ in range(2):
+        res = seq.solve(pods, provisioners, its)
+        assert res.pod_count_new() + res.pod_count_existing() == len(pods)
+    assert len(seq._compiled) == 1
+    assert len(seq._segment_compiled) == 0, (
+        "sequential-only runs must not mint segmented programs"
+    )
+
+    seg = TPUSolver(max_nodes=48, pack_scan="segmented")
+    for _ in range(2):
+        res = seg.solve(pods, provisioners, its)
+        assert res.pod_count_new() + res.pod_count_existing() == len(pods)
+    assert seg.last_segment_stats["mode"] == "segmented"
+    assert len(seg._compiled) == 1, (
+        "the segmented dispatch must share the sequential solve entry "
+        "(prescreen + fallback programs), not mint its own"
+    )
+    assert len(seg._segment_compiled) == 2, (
+        f"one geometry bucket minted {len(seg._segment_compiled)} segment "
+        f"programs (expected partitioner + one lane program)"
+    )
+    for key in seg._segment_compiled:
+        assert key[1] == "segmented", f"segment key missing scan mode: {key}"
+
+
+def test_segmented_scan_length_is_segment_bucket():
+    """ISSUE 14 structural tripwire: the vmapped lane program's pack scan
+    must run over the SEGMENT bucket M, not the item axis I — the whole
+    point of the partition is that the sequential wall shrinks to the
+    largest segment. Asserted on the jaxpr's scan length."""
+    import jax
+    import numpy as np
+
+    from karpenter_core_tpu.solver.tpu_solver import (
+        build_device_solve,
+        device_args,
+        make_device_run,
+    )
+
+    snap, provisioners = _tripwire_snapshot()
+    geom, _run = build_device_solve(snap, max_nodes=48)
+    (P, _J, _T, E, _R, _K, _V, N, segments_t, zone_seg, ct_seg, _ts,
+     log_len, _Q, _W, _D, scr_v) = geom
+    args = device_args(snap, provisioners)
+    C = args[0]["scls_first"].shape[0]
+    # M deliberately BELOW the production floor (segment_item_pad snaps to
+    # >= 32) so the scan length is unambiguous against the item bucket
+    # P = 32 in this geometry
+    S, M = 8, 16
+    assert M != P
+    seg_run = make_device_run(
+        segments_t, zone_seg, ct_seg, snap.topo_meta, N, log_len=log_len,
+        screen_v=scr_v, screen_mode="prescreen", external_prescreen=True,
+        segment_mode=True,
+    )
+    item_sel = jax.ShapeDtypeStruct((S, M), np.int32)
+    exist_open = jax.ShapeDtypeStruct((S, E), np.bool_)
+    screen0 = jax.ShapeDtypeStruct((N, C), np.bool_)
+    jaxpr = jax.make_jaxpr(seg_run)(item_sel, exist_open, screen0, *args)
+
+    def scan_lengths(jx, out):
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "scan":
+                out.append(eqn.params.get("length"))
+            for v in eqn.params.values():
+                if hasattr(v, "jaxpr"):
+                    scan_lengths(v.jaxpr, out)
+                elif isinstance(v, (list, tuple)):
+                    for item in v:
+                        if hasattr(item, "jaxpr"):
+                            scan_lengths(item.jaxpr, out)
+
+    lengths = []
+    scan_lengths(jaxpr.jaxpr, lengths)
+    assert lengths, "segmented program lost its pack scan"
+    assert M in lengths, (
+        f"pack scan length {lengths} is not the segment bucket {M}"
+    )
+    assert P not in lengths, (
+        f"segmented scan still runs over the full item axis {P}"
+    )
+
+
 @perf_gate
 def test_host_fallback_throughput_floor():
     """The host greedy fallback also holds the reference's floor (it IS the

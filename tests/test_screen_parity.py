@@ -28,7 +28,11 @@ from karpenter_core_tpu.obs.flightrec import (
     snapshot_inputs,
 )
 from karpenter_core_tpu.solver.tpu_solver import TPUSolver
-from karpenter_core_tpu.testing import make_pod, make_provisioner
+from karpenter_core_tpu.testing import (
+    make_pod,
+    make_provisioner,
+    solve_scan_parity,
+)
 
 from tests.test_differential_fuzz import _workload as _g1_workload
 from tests.test_differential_fuzz_wide import (
@@ -115,6 +119,70 @@ def test_parity_bulk_replica_groups():
     _assert_parity(pods, provisioners, its, None)
 
 
+# -- segmented scan parity (ISSUE 14) ----------------------------------------
+# KCT_PACK_SCAN=segmented must be byte-identical to the sequential scan on
+# every family here: partitionable batches through the real lanes+merge
+# path, entangled ones (topology, single shared template) through the
+# structural fallback — identical either way, the fixup pass being the
+# sequential kernel itself.
+
+
+# one cached solver per scan mode, shared across the scan-parity cases
+# (karpenter_core_tpu.testing.solve_scan_parity owns the parity bar)
+_SCAN_SOLVERS = {}
+
+
+def _assert_scan_parity(pods, provisioners, its, nodes):
+    solve_scan_parity(_SCAN_SOLVERS, pods, provisioners, its, nodes=nodes)
+
+
+@pytest.mark.parametrize("seed", [11, 23, 47])
+def test_scan_parity_generic_mix(seed):
+    """G1 through KCT_PACK_SCAN=segmented: spread + hostPorts make the
+    batch structurally ineligible, so this pins the fallback routing —
+    fixup fraction 1.0, output identical."""
+    rng = np.random.default_rng(seed)
+    universe = fake.instance_types(8)
+    pods, provisioners, its, nodes = _g1_workload(rng, universe)
+    _assert_scan_parity(pods, provisioners, its, nodes)
+
+
+@pytest.mark.parametrize("seed", [5, 19])
+def test_scan_parity_hostname_anti_affinity(seed):
+    """G5 (the adversarial all-one-segment family): bulk replicas with pod
+    anti-affinity — topology coupling forces the sequential kernel, and
+    the placements stay byte-identical."""
+    rng = np.random.default_rng(seed)
+    pods, provisioners, its, nodes = _g5_workload(rng)
+    _assert_scan_parity(pods, provisioners, its, nodes)
+    stats = _SCAN_SOLVERS["segmented"].last_segment_stats
+    assert stats["fixup_fraction"] == 1.0
+
+
+def test_scan_parity_relaxation_rounds():
+    """G3 through the segmented dispatch: relax rounds re-encode and
+    re-partition; every round must stay in lockstep."""
+    rng = np.random.default_rng(3)
+    pods, provisioners, its, nodes = _g3_workload(rng)
+    _assert_scan_parity(pods, provisioners, its, nodes)
+
+
+def test_scan_parity_bulk_replica_groups():
+    """Deployment-shaped batch through segmented mode: single shared
+    template collapses to one segment — identical via fallback."""
+    universe = fake.instance_types(6)
+    pods = []
+    for c in range(3):
+        for _ in range(40):
+            pods.append(
+                make_pod(labels={"app": f"dep-{c}"},
+                         requests={"cpu": str(0.25 * (c + 1))})
+            )
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": universe}
+    _assert_scan_parity(pods, provisioners, its, None)
+
+
 def test_replay_lockstep_pinned_record(monkeypatch):
     """One recorded solve (hack/replay.py's record shape) replayed through
     BOTH screen modes: each must reproduce the recorded placements byte
@@ -139,3 +207,11 @@ def test_replay_lockstep_pinned_record(monkeypatch):
         assert placements_json(replayed) == recorded, (
             f"replay({mode}) diverged from the recorded placements"
         )
+    # the scan-mode axis rides the same env contract (ISSUE 14): a
+    # segmented replay of the recorded solve must also be byte-identical
+    monkeypatch.delenv("KCT_PACK_SCREEN", raising=False)
+    monkeypatch.setenv("KCT_PACK_SCAN", "segmented")
+    replayed, _res = flightrec.replay(record, "tpu")
+    assert placements_json(replayed) == recorded, (
+        "replay(segmented) diverged from the recorded placements"
+    )

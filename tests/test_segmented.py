@@ -1,0 +1,484 @@
+"""Segmented pack scan (ISSUE 14 tentpole): conflict-independent segments
+packed in parallel vmapped lanes must be BYTE-IDENTICAL (flightrec
+placements_json, the replay equivalence bar) to the sequential scan, and
+every failure of the disjointness proof must degrade to the sequential
+kernel — never diverge, never fail.
+
+Families covered here:
+  * pool-partitioned generic mix (the partitionable shape: selector-scoped
+    provisioners) — multi-segment, fixup 0.0, identical;
+  * existing nodes owned per pool (exist_open disjointness + bulk
+    existing-fill log entries through the host merge);
+  * the adversarial all-one-segment cases: a single shared template
+    (template-edge clique) and bulk replicas with pod anti-affinity
+    (topology → structurally ineligible) — fixup 1.0, output identical;
+  * mid-churn incremental refresh (segment labels recomputed only on
+    verdict delta, riding PR 6's residency);
+  * chaos-armed solver.segment injection degrading segmented→sequential;
+  * the partitioner kernel's component algebra, unit-level.
+"""
+import copy
+
+import numpy as np
+import pytest
+
+from karpenter_core_tpu import chaos
+from karpenter_core_tpu.cloudprovider import fake
+from karpenter_core_tpu.obs.flightrec import (
+    canonical_placements,
+    placements_json,
+)
+from karpenter_core_tpu.solver.tpu_solver import TPUSolver
+from karpenter_core_tpu.state.node import StateNode
+from karpenter_core_tpu.testing import (
+    make_node,
+    make_pod,
+    make_pool_provisioners,
+    make_provisioner,
+    solve_scan_parity,
+)
+
+# one solver per scan mode, shared across cases at one geometry family so
+# each mode compiles once (the same convention as test_screen_parity)
+_SOLVERS = {}
+
+
+def _solver(mode):
+    return _SOLVERS.setdefault(
+        mode, TPUSolver(max_nodes=96, pack_scan=mode)
+    )
+
+
+def _solve(mode, pods, provisioners, its, nodes=None):
+    return _solver(mode).solve(
+        copy.deepcopy(pods), provisioners, its,
+        state_nodes=[n.deep_copy() for n in nodes] if nodes else None,
+    )
+
+
+def _assert_identical(pods, provisioners, its, nodes=None):
+    seq, seg = solve_scan_parity(_SOLVERS, pods, provisioners, its,
+                                 nodes=nodes)
+    return seq, seg, _solver("segmented").last_segment_stats
+
+
+def _pool_workload(seed, pools=4, n_pods=120, n_nodes=0):
+    """Selector-scoped pools: the partitionable generic-mix shape (each
+    team's pods and nodes are invisible to every other team's)."""
+    rng = np.random.default_rng(seed)
+    universe = fake.instance_types(6)
+    provisioners, its = make_pool_provisioners(pools, universe)
+    nodes = []
+    for e in range(n_nodes):
+        it = universe[e % len(universe)]
+        pool = f"pool-{e % pools}"
+        nodes.append(StateNode(node=make_node(
+            name=f"seg-n-{e}",
+            labels={
+                "karpenter.sh/provisioner-name": pool,
+                "karpenter.sh/initialized": "true",
+                "node.kubernetes.io/instance-type": it.name,
+                "karpenter.sh/capacity-type": "on-demand",
+                "topology.kubernetes.io/zone": "test-zone-1",
+                "team": pool,
+            },
+            capacity={k: str(v) for k, v in it.capacity.items()},
+        )))
+    pods = []
+    for i in range(n_pods):
+        p = int(rng.integers(pools))
+        pods.append(make_pod(
+            labels={"app": f"dep-{p}-{int(rng.integers(8))}"},
+            requests={"cpu": str(0.25 + 0.25 * int(rng.integers(3)))},
+            node_selector={"team": f"pool-{p}"},
+        ))
+    return pods, provisioners, its, nodes
+
+
+@pytest.mark.parametrize("seed", [7, 19, 31])
+def test_pool_partition_byte_identical(seed):
+    pods, provisioners, its, _ = _pool_workload(seed)
+    _res_seq, _res_seg, stats = _assert_identical(pods, provisioners, its)
+    assert stats["mode"] == "segmented"
+    assert stats["segments"] >= 2
+    assert stats["fixup_fraction"] == 0.0
+
+
+@pytest.mark.parametrize("seed", [3, 13])
+def test_pool_partition_with_existing_nodes(seed):
+    """exist_open disjointness + bulk existing-fill entries through the
+    merge: each pool's nodes absorb only that pool's pods."""
+    pods, provisioners, its, nodes = _pool_workload(
+        seed, n_pods=160, n_nodes=8
+    )
+    res_seq, res_seg, stats = _assert_identical(
+        pods, provisioners, its, nodes
+    )
+    assert stats["mode"] == "segmented"
+    assert stats["segments"] >= 2
+    assert res_seg.pod_count_existing() == res_seq.pod_count_existing() > 0
+
+
+def test_single_template_collapses_to_one_segment():
+    """The honest adversarial case the conflict predicate cannot split:
+    undifferentiated pods on one shared provisioner form a template-edge
+    clique — one segment, sequential fallback, fixup fraction 1.0,
+    identical output."""
+    universe = fake.instance_types(5)
+    pods = [
+        make_pod(labels={"app": f"gen-{i % 10}"},
+                 requests={"cpu": str(0.1 * (1 + i % 4))})
+        for i in range(60)
+    ]
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": universe}
+    _seq, _seg, stats = _assert_identical(pods, provisioners, its)
+    assert stats["mode"] == "sequential-fallback"
+    assert stats["reason"] == "single-segment"
+    assert stats["fixup_fraction"] == 1.0
+
+
+def test_anti_affinity_bulk_is_structurally_ineligible():
+    """Bulk replicas with pod anti-affinity: topology groups couple every
+    placement through shared domain counts, so the batch is structurally
+    ineligible — fixup fraction ≈ 1.0 and the output still identical (the
+    fixup pass IS the sequential kernel)."""
+    from karpenter_core_tpu.kube.objects import (
+        LABEL_HOSTNAME,
+        LabelSelector,
+        PodAffinityTerm,
+    )
+
+    universe = fake.instance_types(5)
+    anti = PodAffinityTerm(
+        topology_key=LABEL_HOSTNAME,
+        label_selector=LabelSelector(match_labels={"app": "anti"}),
+    )
+    pods = [
+        make_pod(labels={"app": "anti"}, requests={"cpu": "0.5"},
+                 pod_anti_affinity_required=[anti])
+        for _ in range(24)
+    ]
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": universe}
+    _seq, _seg, stats = _assert_identical(pods, provisioners, its)
+    assert stats["mode"] == "sequential-fallback"
+    assert stats["reason"] == "structure"
+    assert stats["fixup_fraction"] == 1.0
+
+
+def test_mid_churn_incremental_refresh_stays_identical():
+    """Steady-churn sequence through ONE segmented solver (the resident
+    verdict tensor + delta refresh engage between rounds): every round
+    must stay byte-identical to a sequential solve of the same batch, and
+    segment labels must be recomputed on verdict delta (the partition
+    survives churn, it is not a first-solve artifact)."""
+    pods, provisioners, its, nodes = _pool_workload(
+        11, n_pods=100, n_nodes=8
+    )
+    seg = TPUSolver(max_nodes=96, pack_scan="segmented")
+    seq = TPUSolver(max_nodes=96, pack_scan="sequential")
+    rng = np.random.default_rng(5)
+    for round_i in range(3):
+        r_seq = seq.solve(
+            copy.deepcopy(pods), provisioners, its,
+            state_nodes=[n.deep_copy() for n in nodes],
+        )
+        r_seg = seg.solve(
+            copy.deepcopy(pods), provisioners, its,
+            state_nodes=[n.deep_copy() for n in nodes],
+        )
+        assert placements_json(canonical_placements(r_seg)) == (
+            placements_json(canonical_placements(r_seq))
+        ), f"round {round_i} diverged"
+        assert seg.last_segment_stats["mode"] == "segmented"
+        # churn: swap a few pods for fresh specs (same pools, same
+        # geometry bucket)
+        for _ in range(4):
+            i = int(rng.integers(len(pods)))
+            p = int(rng.integers(4))
+            pods[i] = make_pod(
+                labels={"app": f"dep-{p}-{int(rng.integers(8))}"},
+                requests={"cpu": str(0.25 + 0.25 * int(rng.integers(3)))},
+                node_selector={"team": f"pool-{p}"},
+            )
+
+
+def test_provisioner_edit_recomputes_segment_labels():
+    """A provisioner edit with ZERO pod/node churn reports an EMPTY
+    incremental verdict delta (its fingerprints cover only the pod and
+    existing planes), yet it can re-weld pools into one conflict
+    component through the template planes the partitioner also reads —
+    segment-label residency must prove the template side unchanged too,
+    or stale labels would split a welded batch behind the byte-identity
+    contract's back."""
+    from karpenter_core_tpu.kube.objects import NodeSelectorRequirement
+
+    universe = fake.instance_types(5)
+    provisioners, its = make_pool_provisioners(2, universe)
+    pods = [
+        make_pod(
+            labels={"app": f"dep-{p}-{i % 4}"},
+            requests={"cpu": str(0.25 + 0.25 * (i % 3))},
+            node_selector={"team": f"pool-{p}"},
+        )
+        for p in range(2)
+        for i in range(20)
+    ]
+    seg = TPUSolver(max_nodes=96, pack_scan="segmented")
+    computes = []
+    orig = seg._partition_fn
+
+    def spy(*a, **k):
+        computes.append(1)
+        return orig(*a, **k)
+
+    seg._partition_fn = spy
+    seg.solve(copy.deepcopy(pods), provisioners, its)
+    assert seg.last_segment_stats["segments"] == 2
+    n_first = len(computes)
+    assert n_first > 0
+    # steady state: identical batch -> empty delta, labels reused
+    seg.solve(copy.deepcopy(pods), provisioners, its)
+    assert len(computes) == n_first, "empty-delta resolve should reuse labels"
+    # weld: pool-0 now also matches team=pool-1 — same shapes, same
+    # vocabulary, still zero pod churn, still an empty verdict delta
+    welded = [
+        make_provisioner(
+            name="pool-0",
+            requirements=[NodeSelectorRequirement(
+                key="team", operator="In", values=["pool-0", "pool-1"]
+            )],
+        ),
+        provisioners[1],
+    ]
+    r_seg = seg.solve(copy.deepcopy(pods), welded, its)
+    assert len(computes) > n_first, (
+        "template change with an empty verdict delta reused stale labels"
+    )
+    r_seq = TPUSolver(max_nodes=96, pack_scan="sequential").solve(
+        copy.deepcopy(pods), welded, its
+    )
+    assert placements_json(canonical_placements(r_seg)) == (
+        placements_json(canonical_placements(r_seq))
+    )
+
+
+def test_chaos_degrades_segmented_to_sequential():
+    """A chaos-armed solver.segment fault inside the segmented attempt
+    must degrade the solve to the sequential scan — same placements, no
+    error surfaced, fixup fraction 1.0 with the error recorded."""
+    pods, provisioners, its, _ = _pool_workload(23)
+    ref = _solve("sequential", pods, provisioners, its)
+    solver = TPUSolver(max_nodes=96, pack_scan="segmented")
+    chaos.arm(chaos.SOLVER_SEGMENT, error="runtime", times=1)
+    try:
+        res = solver.solve(copy.deepcopy(pods), provisioners, its)
+    finally:
+        chaos.disarm(chaos.SOLVER_SEGMENT)
+    assert placements_json(canonical_placements(res)) == (
+        placements_json(canonical_placements(ref))
+    )
+    stats = solver.last_segment_stats
+    assert stats["mode"] == "sequential-fallback"
+    assert stats["reason"].startswith("error:")
+    assert stats["fixup_fraction"] == 1.0
+
+
+def test_partitioner_components_unit():
+    """The partition kernel's component algebra on a hand-built geometry:
+    two selector pools + one plane-neutral class that is
+    template-compatible with everything must merge all classes sharing a
+    reachable template, while the disjoint pool stays its own island."""
+    import jax.numpy as jnp
+
+    from karpenter_core_tpu.ops.pack import make_segment_partition_kernel
+    from karpenter_core_tpu.solver.tpu_solver import (
+        build_device_solve,
+        device_args,
+    )
+
+    universe = fake.instance_types(4)
+    provisioners, its = make_pool_provisioners(2, universe)
+    pods = [
+        make_pod(labels={"app": "a"}, requests={"cpu": "0.5"},
+                 node_selector={"team": "pool-0"}),
+        make_pod(labels={"app": "b"}, requests={"cpu": "0.25"},
+                 node_selector={"team": "pool-0"}),
+        make_pod(labels={"app": "c"}, requests={"cpu": "0.5"},
+                 node_selector={"team": "pool-1"}),
+        # plane-neutral: no selector — compatible with BOTH templates, so
+        # it must weld the two pools into one component
+        make_pod(labels={"app": "d"}, requests={"cpu": "0.1"}),
+    ]
+    solver = TPUSolver(max_nodes=48)
+    snap = solver.encode(pods, provisioners, its)
+    geom, _run = build_device_solve(snap, max_nodes=48)
+    args = device_args(snap, provisioners)
+    (_P, _J, _T, E, _R, _K, _V, N, segments_t, _zs, _cs, _ts, _ll, _Q,
+     _W, _D, scr_v) = geom
+    kern = make_segment_partition_kernel(segments_t, E, screen_v=scr_v)
+    pa = args[0]
+    C = pa["scls_first"].shape[0]
+    screen0 = jnp.zeros((N, C), dtype=bool)  # E == 0: no slot edges
+    labels, neutral, _slot_label = kern(
+        screen0, pa, args[1], jnp.asarray(args[12])
+    )
+    labels = np.asarray(labels)
+    neutral = np.asarray(neutral)
+    scls = np.asarray(pa["scls"])
+    # map app label -> item row via the snapshot's (FFD-sorted) pod order
+    row_of = {
+        p.metadata.labels["app"]: int(snap.item_of_pod[i])
+        for i, p in enumerate(snap.pods)
+    }
+    lab_of = {app: labels[scls[row_of[app]]] for app in "abcd"}
+    # without the neutral pod, a/b share pool-0 and c is alone; the
+    # neutral pod welds everything (template-compatible with both pools)
+    assert lab_of["a"] == lab_of["b"] == lab_of["c"] == lab_of["d"]
+    # and the neutral mask marks exactly the selector-free class
+    assert int(neutral.sum()) >= 1
+
+    # drop the neutral pod: pools must split into two components
+    pods2 = pods[:3]
+    snap2 = solver.encode(pods2, provisioners, its)
+    geom2, _ = build_device_solve(snap2, max_nodes=48)
+    args2 = device_args(snap2, provisioners)
+    (_P2, _J2, _T2, E2, _R2, _K2, _V2, N2, segments2, _z2, _c2, _t2,
+     _l2, _Q2, _W2, _D2, scr_v2) = geom2
+    kern2 = make_segment_partition_kernel(segments2, E2, screen_v=scr_v2)
+    pa2 = args2[0]
+    C2 = pa2["scls_first"].shape[0]
+    labels2 = np.asarray(kern2(
+        jnp.zeros((N2, C2), dtype=bool), pa2, args2[1],
+        jnp.asarray(args2[12]),
+    )[0])
+    scls2 = np.asarray(pa2["scls"])
+    row_of2 = {
+        p.metadata.labels["app"]: int(snap2.item_of_pod[i])
+        for i, p in enumerate(snap2.pods)
+    }
+    la = labels2[scls2[row_of2["a"]]]
+    lb = labels2[scls2[row_of2["b"]]]
+    lc = labels2[scls2[row_of2["c"]]]
+    assert la == lb, "same-pool classes must share a component"
+    assert la != lc, "disjoint selector pools must split"
+
+
+def test_frozen_lane_kernel_byte_identical():
+    """The frozen-verdict lane variant (seg_frozen=True: the tensor is a
+    read-only scan constant, opened machine rows read tmpl_rows) must be
+    byte-identical to the refresh-machinery lane program on an all-neutral
+    workload. Forced at the KERNEL level: the dispatch gate
+    (encode.seg_plane_neutral.all()) cannot fire on a multi-segment batch
+    — fully neutral pods weld every template into one component — so this
+    is the suite that keeps the frozen branch proven."""
+    import jax
+    import jax.numpy as jnp
+
+    from karpenter_core_tpu.solver.tpu_solver import (
+        build_device_solve,
+        device_args,
+        make_device_run,
+    )
+
+    universe = fake.instance_types(5)
+    # generic pods, NO selectors: every class plane-neutral; several items
+    # per machine so later items commit to slots opened (and, in the
+    # refresh path, re-screened) by earlier ones — the tmpl_rows override
+    # is what's under test
+    pods = [
+        make_pod(labels={"app": f"g{i % 6}"},
+                 requests={"cpu": str(0.2 * (1 + i % 3))})
+        for i in range(40)
+    ]
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": universe}
+    solver = TPUSolver(max_nodes=48)
+    snap = solver.encode(pods, provisioners, its)
+    assert bool(np.asarray(snap.seg_plane_neutral).all()), (
+        "selector-free pods must encode plane-neutral"
+    )
+    geom, _run = build_device_solve(snap, max_nodes=48)
+    args = device_args(snap, provisioners)
+    (_P, _J, _T, E, _R, _K, _V, N, segments_t, zone_seg, ct_seg, _ts,
+     log_len, _Q, _W, _D, scr_v) = geom
+    runs = {}
+    for frozen in (False, True):
+        seg_run = make_device_run(
+            segments_t, zone_seg, ct_seg, snap.topo_meta, N,
+            log_len=log_len, screen_v=scr_v, screen_mode="prescreen",
+            external_prescreen=True, segment_mode=True, seg_frozen=frozen,
+        )
+        pa = args[0]
+        C = pa["scls_first"].shape[0]
+        I = pa["valid"].shape[0]
+        # two lanes: first half / second half of the item axis (kernel
+        # X-vs-X: both variants run the SAME lane structure, so the
+        # comparison isolates the frozen read path)
+        half = I // 2
+        item_sel = np.full((4, max(half + I % 2, I - half)), -1, np.int32)
+        item_sel[0, : half] = np.arange(half)
+        item_sel[1, : I - half] = np.arange(half, I)
+        exist_open = np.zeros((4, E), bool)
+        from karpenter_core_tpu.ops.pack import make_screen_ops
+        from karpenter_core_tpu.ops import compat as ops_compat
+        ops = make_screen_ops(
+            list(segments_t), ops_compat.resolve_backend(), scr_v
+        )
+        items_pl = {
+            k: jnp.asarray(pa[k])[jnp.asarray(pa["scls_first"])]
+            for k in ("allow", "out", "defined", "escape", "custom_deny")
+        }
+        screen0 = ops.initial_screen(
+            items_pl,
+            jnp.zeros((0, _V), bool), jnp.zeros((0, _K), bool),
+            jnp.zeros((0, _K), bool), N,
+        )
+        out = jax.jit(seg_run)(item_sel, exist_open, screen0, *args)
+        runs[frozen] = jax.device_get(out)
+    log_a, ptr_a, st_a = runs[False]
+    log_b, ptr_b, st_b = runs[True]
+    assert np.array_equal(np.asarray(ptr_a), np.asarray(ptr_b))
+    for k in ("item", "slot", "ns", "k", "k_last"):
+        assert np.array_equal(np.asarray(log_a[k]), np.asarray(log_b[k])), (
+            f"frozen lane diverged on log[{k}]"
+        )
+    for f in ("tmpl", "used", "pods"):
+        assert np.array_equal(
+            np.asarray(getattr(st_a, f)), np.asarray(getattr(st_b, f))
+        ), f"frozen lane diverged on state.{f}"
+
+
+def test_relaxation_rounds_through_segmented():
+    """Failed pods relax and re-solve: every relax round re-runs the
+    segmented dispatch against re-encoded planes and must stay identical
+    to the sequential solver's rounds."""
+    from karpenter_core_tpu.kube.objects import (
+        NodeSelectorRequirement as NSR,
+        NodeSelectorTerm,
+        PreferredSchedulingTerm,
+    )
+
+    pods, provisioners, its, _ = _pool_workload(41, n_pods=48)
+    # a preferred term no node can satisfy forces a relax round
+    pref = [PreferredSchedulingTerm(
+        weight=50,
+        preference=NodeSelectorTerm(match_expressions=[
+            NSR("topology.kubernetes.io/zone", "In", ["nowhere"])
+        ]),
+    )]
+    extra = [
+        make_pod(labels={"app": f"pref-{i}"},
+                 requests={"cpu": "0.25"},
+                 node_selector={"team": f"pool-{i % 4}"},
+                 node_affinity_preferred=copy.deepcopy(pref))
+        for i in range(8)
+    ]
+    pods = pods + extra
+    seq = _solve("sequential", pods, provisioners, its)
+    seg = _solve("segmented", pods, provisioners, its)
+    assert placements_json(canonical_placements(seg)) == (
+        placements_json(canonical_placements(seq))
+    )
+    assert seg.rounds == seq.rounds

@@ -277,6 +277,64 @@ def test_mesh_program_has_no_host_roundtrips(mesh):
     )
 
 
+def test_segmented_mesh_program_fence(mesh):
+    """ISSUE 14 fence tripwire: under segmented mode the replication FENCE
+    changes shape — the SEGMENT (lane) axis shards over dp (the scan stops
+    being the replicated part of the mesh program) while the existing
+    gather fence keeps every within-lane scan input pinned replicated.
+    Asserted on the jaxpr: no host callbacks anywhere, and
+    sharding_constraint present (the segment-axis pins plus the inner
+    fence). Byte-identity of the mesh lanes themselves rides the same
+    constraint-only construction the sequential mesh program proved."""
+    from karpenter_core_tpu.solver.encode import encode_snapshot
+    from karpenter_core_tpu.solver.tpu_solver import (
+        build_device_solve,
+        device_args,
+        make_device_run,
+    )
+
+    pods = [make_pod(labels={"app": f"j{i % 4}"}, requests={"cpu": "0.5"})
+            for i in range(40)]
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(4)}
+    snap = encode_snapshot(pods, provisioners, its, max_nodes=32)
+    layout = SpecLayout(mesh)
+    geom, _run = build_device_solve(
+        snap, 32, external_prescreen=True, spec_layout=layout,
+    )
+    args = device_args(snap, provisioners)
+    (_P, _J, _T, E, _R, _K, _V, N, segments_t, zone_seg, ct_seg, _ts,
+     log_len, _Q, _W, _D, scr_v) = geom
+    seg_run = make_device_run(
+        segments_t, zone_seg, ct_seg, snap.topo_meta, N, log_len=log_len,
+        screen_v=scr_v, screen_mode="prescreen", external_prescreen=True,
+        spec_layout=layout, segment_mode=True,
+    )
+    C = args[0]["scls_first"].shape[0]
+    import numpy as np
+
+    item_sel = jax.ShapeDtypeStruct((8, 16), np.int32)
+    exist_open = jax.ShapeDtypeStruct((8, E), np.bool_)
+    screen0 = jax.ShapeDtypeStruct((N, C), np.bool_)
+    prims = set()
+    _collect_primitives(
+        jax.make_jaxpr(seg_run)(item_sel, exist_open, screen0, *args).jaxpr,
+        prims,
+    )
+    host_prims = {
+        "pure_callback", "io_callback", "debug_callback", "callback",
+        "host_callback", "outside_call",
+    }
+    hits = prims & host_prims
+    assert not hits, (
+        f"segmented mesh program contains host round-trips: {sorted(hits)}"
+    )
+    assert "sharding_constraint" in prims, (
+        "segmented mesh program lost its fence — neither the dp-sharded "
+        "segment axis nor the within-lane replication pins are present"
+    )
+
+
 def test_single_device_program_unchanged_by_layout_plumbing():
     """layout=None must trace the exact program it always did: no
     sharding constraints sneak into the single-device jaxpr."""
