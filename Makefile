@@ -5,7 +5,7 @@
 .PHONY: test deflake perf bench verify trace-demo chaos chaos-smoke \
 	replay-demo lint soak soak-smoke soak-smoke-inproc prewarm-smoke \
 	multichip-smoke consolidation-smoke bench-smoke host-smoke race-smoke \
-	segment-smoke
+	segment-smoke obs-smoke
 
 test:  ## tier-1 suite (CPU, 8 virtual devices); slow chaos soaks: make chaos
 	python -m pytest tests -q -m "not slow"
@@ -60,6 +60,11 @@ soak-smoke-inproc:  ## the KARPENTER_SOLVER_HOST=off posture's wedge drill: in-p
 host-smoke:  ## kill the solver host mid-solve under the live operator: wedge + crash
 	# drills -> respawn, byte-identical parity, zero live zombies (~60s budget)
 	JAX_PLATFORMS=$${JAX_PLATFORMS:-cpu} python hack/host_smoke.py
+
+obs-smoke:  ## cross-process observability on a live host-mode operator: child
+	# device phases grafted into /debug/trace (set parity), merged metrics
+	# under the process label + trace-id exemplars, wedge kill names the phase
+	JAX_PLATFORMS=$${JAX_PLATFORMS:-cpu} python hack/obs_smoke.py
 
 prewarm-smoke:  ## warm-cache restart gate: prewarm a tier, restart fresh, first solve under budget
 	JAX_PLATFORMS=$${JAX_PLATFORMS:-cpu} python hack/prewarm_smoke.py
@@ -135,6 +140,10 @@ verify:  ## driver hooks: single-chip compile check + 8-way mesh dryrun
 	# non-fatal smoke: the solver host killed mid-solve must respawn with
 	# byte-identical placements and zero live zombies (fatal in presubmit)
 	-$(MAKE) host-smoke
+	# non-fatal smoke: host-mode /debug/trace must carry the child's grafted
+	# device phases, the exposition the merged child metrics + exemplars,
+	# and a chaos-killed child a phase-named wedge event (fatal in presubmit)
+	-$(MAKE) obs-smoke
 	# non-fatal smoke: the segmented pack scan on a live operator must stay
 	# byte-identical to sequential and degrade cleanly under chaos (fatal
 	# gate lives in presubmit)

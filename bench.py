@@ -192,10 +192,42 @@ def _stage_chaos(name: str) -> str:
 _HB = None
 
 
-def _touch():
+def _touch(label=None):
     if _HB is not None:
-        _HB.touch()
-    supervise.touch_heartbeat()
+        _HB.touch(label)
+    supervise.touch_heartbeat(label)
+    # heartbeat tick on the stage's own trace fragment (ISSUE 15): the
+    # round timeline shows the worker's progress pulse between phase
+    # spans, so a wedge's silent stretch is visible as a gap
+    from karpenter_core_tpu.obs import TRACER
+
+    TRACER.instant("bench.heartbeat", **({"label": label} if label else {}))
+
+
+# cap on the chrome-trace fragment a stage worker ships in its artifact:
+# newest events win (the tail names the work closest to the outcome/kill)
+TIMELINE_STAGE_EVENTS = int(os.environ.get("BENCH_TIMELINE_EVENTS", "1500"))
+
+
+def _trace_fragment():
+    """The stage worker's bounded, WALL-ANCHORED chrome-trace fragment:
+    events ride with a (wall_anchor_s, anchor_ts_us) pair so the round
+    merge can rebase each worker's perf_counter timebase onto the shared
+    wall clock — the only clock the stages and the orchestrator share."""
+    from karpenter_core_tpu.obs import TRACER
+
+    if not TRACER.enabled:
+        return None
+    trace = TRACER.chrome_trace()
+    events = [e for e in trace["traceEvents"] if e.get("ph") != "M"]
+    dropped = max(0, len(events) - TIMELINE_STAGE_EVENTS)
+    return {
+        "wall_anchor_s": time.time(),
+        "anchor_ts_us": (time.perf_counter_ns() - TRACER._t0_ns) / 1e3,
+        "pid": os.getpid(),
+        "events": events[-TIMELINE_STAGE_EVENTS:],
+        "dropped": dropped + int(trace["otherData"].get("dropped_spans", 0)),
+    }
 
 BACKEND_NOTE = ""
 # each probe attempt's outcome, recorded into the final JSON's "extra" so a
@@ -1440,6 +1472,13 @@ def stage_worker(name: str) -> int:
     if hb_path:
         _HB = supervise.Heartbeat(hb_path)
         _HB.touch()
+    # stage workers trace by default (ISSUE 15): the solver's phase spans
+    # + bench heartbeat ticks become this stage's timeline fragment,
+    # shipped in the artifact and stitched round-wide by build_timeline.
+    # KARPENTER_TPU_TRACE=0 opts out (the fragment is then omitted).
+    from karpenter_core_tpu.obs import enable_tracing_from_env
+
+    enable_tracing_from_env(default_on=True)
     try:
         ensure_backend()
         _touch()
@@ -1452,6 +1491,7 @@ def stage_worker(name: str) -> int:
             "backend": BACKEND_NOTE,
             "platform": jax.devices()[0].platform,
             "backend_probe": PROBE_LOG,
+            "trace": _trace_fragment(),
             "data": data,
         }))
         return 0
@@ -1721,6 +1761,108 @@ def merge_round(store: supervise.ArtifactStore, round_dir: str = "") -> dict:
     }
 
 
+def build_timeline(store: supervise.ArtifactStore) -> dict:
+    """Stitch the round-wide Perfetto timeline (BENCH_timeline.json) from
+    the per-stage artifacts — PURE over the store, like merge_round, so
+    re-merging the same round dir is byte-identical (ISSUE 15).
+
+    Rows: pid 0 is the orchestrator (one 'bench.stage.<name>' slice per
+    stage from the meta's wall-clock bounds, wedge/timeout SIGKILLs and
+    resume backfills as instant markers); each stage worker's chrome-trace
+    fragment renders under its own pid, rebased from the worker's
+    perf-counter timebase onto the shared wall clock via the fragment's
+    (wall_anchor_s, anchor_ts_us) pair. Timestamps are µs since the
+    earliest stage start."""
+    recs = {name: store.load(name) for name in STAGE_NAMES}
+    starts = [
+        m["started_ts"]
+        for rec in recs.values() if rec
+        for m in (rec.get("meta") or {},) if m.get("started_ts") is not None
+    ]
+    base = min(starts) if starts else 0.0
+
+    def us(wall_s):
+        return round((float(wall_s) - base) * 1e6, 1)
+
+    events = []
+    dropped = 0
+    statuses = {}
+    for idx, name in enumerate(STAGE_NAMES):
+        rec = recs.get(name)
+        if rec is None:
+            statuses[name] = "missing"
+            continue
+        meta = rec.get("meta") or {}
+        status = (
+            "degraded" if rec.get("degraded")
+            else "fallback" if rec.get("fallback")
+            else "ok"
+        )
+        statuses[name] = status
+        t0, t1 = meta.get("started_ts"), meta.get("ended_ts")
+        if t0 is not None and t1 is not None:
+            events.append({
+                "name": f"bench.stage.{name}", "cat": "bench", "ph": "X",
+                "ts": us(t0),
+                "dur": round(max(float(t1) - float(t0), 0.0) * 1e6, 1),
+                "pid": 0, "tid": idx + 1,
+                "args": {"status": status,
+                         "backend": meta.get("backend", "")},
+            })
+        wl = rec.get("wedge_log") or {}
+        if wl.get("wedged") or wl.get("timed_out"):
+            kind = "wedge" if wl.get("wedged") else "timeout"
+            events.append({
+                "name": f"bench.{kind}.SIGKILL", "cat": "bench",
+                "ph": "i", "s": "g",
+                "ts": us(t1) if t1 is not None else 0.0,
+                "pid": 0, "tid": idx + 1,
+                "args": {"stage": name, "phase": wl.get("phase", ""),
+                         "note": str(wl.get("note", ""))[:200]},
+            })
+        if meta.get("resumed"):
+            events.append({
+                "name": "bench.resume.backfill", "cat": "bench",
+                "ph": "i", "s": "g",
+                "ts": us(t0) if t0 is not None else 0.0,
+                "pid": 0, "tid": idx + 1,
+                "args": {"stage": name, "status": status},
+            })
+        frag = meta.get("trace") or {}
+        if frag.get("events") and frag.get("wall_anchor_s") is not None:
+            offset_us = us(frag["wall_anchor_s"]) - float(
+                frag.get("anchor_ts_us", 0.0)
+            )
+            pid = int(frag.get("pid", idx + 1) or idx + 1)
+            for e in frag["events"]:
+                e2 = dict(e)
+                e2["ts"] = round(float(e.get("ts", 0.0)) + offset_us, 1)
+                e2["pid"] = pid
+                events.append(e2)
+            events.append({
+                "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+                "args": {"name": f"stage {name} worker pid {pid}"},
+            })
+            dropped += int(frag.get("dropped", 0) or 0)
+    events.append({
+        "name": "process_name", "ph": "M", "pid": 0, "tid": 0,
+        "args": {"name": "bench orchestrator"},
+    })
+    events.sort(
+        key=lambda e: (e.get("ts", 0.0), e.get("pid", 0),
+                       e.get("tid", 0), e.get("name", ""))
+    )
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "base_wall_s": base,
+            "stages": statuses,
+            "dropped_events": dropped,
+        },
+    }
+
+
 # ---------------------------------------------------------------------------
 # stage-graph orchestrator (CONFIG=solve): supervised per-stage workers,
 # verdict-file backend gating, resumable round dirs
@@ -1869,8 +2011,17 @@ def orchestrate_stage_graph(resume_dir: str = "") -> None:
             env_extra, on_tpu = _decide_backend()
             _log(f"{name}: starting ({'tpu' if on_tpu else 'cpu'}, "
                  f"budget {budget}s)")
+            started_wall = time.time()
             res, parsed = _launch_stage(name, env_extra, budget, hb_dir,
                                         cache_dir)
+            # wall-clock stage bounds + the worker's trace fragment ride
+            # the artifact meta: build_timeline() stitches the round-wide
+            # BENCH_timeline.json purely from the store (ISSUE 15)
+            span_meta = {
+                "started_ts": round(started_wall, 3),
+                "ended_ts": round(started_wall + res.duration_s, 3),
+                "resumed": bool(resume_dir),
+            }
             if parsed is not None and "data" in parsed:
                 # completed (possibly salvaged from a worker that hung at
                 # exit after printing its line — keep the log either way)
@@ -1879,6 +2030,8 @@ def orchestrate_stage_graph(resume_dir: str = "") -> None:
                     "platform": parsed.get("platform", ""),
                     "attempts": res.attempts,
                     "duration_s": round(res.duration_s, 1),
+                    "trace": parsed.get("trace"),
+                    **span_meta,
                 }
                 # fallback-marked (so --resume reclaims it) only when this
                 # column SHOULD have been an accelerator one: the shrunk
@@ -1929,6 +2082,12 @@ def orchestrate_stage_graph(resume_dir: str = "") -> None:
                                 "duration_s": round(
                                     res.duration_s + res2.duration_s, 1
                                 ),
+                                "trace": parsed2.get("trace"),
+                                **span_meta,
+                                "ended_ts": round(
+                                    started_wall + res.duration_s
+                                    + res2.duration_s, 3
+                                ),
                             },
                         )
                         _log(f"{name}: cpu fallback ok (column marked "
@@ -1941,7 +2100,7 @@ def orchestrate_stage_graph(resume_dir: str = "") -> None:
                 name, cfg, None, degraded=True, error=str(err)[:400],
                 wedge_log=first_log,
                 meta={"backend": (parsed or {}).get("backend", ""),
-                      "attempts": res.attempts},
+                      "attempts": res.attempts, **span_meta},
             )
     finally:
         if daemon is not None:
@@ -1955,6 +2114,13 @@ def orchestrate_stage_graph(resume_dir: str = "") -> None:
     supervise.atomic_write_json(
         os.path.join(round_dir, "BENCH_merged.json"), merged
     )
+    # the round-wide Perfetto timeline (ISSUE 15): stage slices + worker
+    # trace fragments + wedge SIGKILL / resume-backfill markers, stitched
+    # purely from the artifacts (byte-stable across re-merges)
+    supervise.atomic_write_json(
+        os.path.join(round_dir, "BENCH_timeline.json"), build_timeline(store)
+    )
+    _log(f"timeline: {os.path.join(round_dir, 'BENCH_timeline.json')}")
     print(json.dumps(merged, sort_keys=True))
 
 

@@ -126,6 +126,34 @@ def main() -> int:
         check(extra.get("e2e_p99_ms") is not None,
               "headline e2e numbers present")
 
+        # -- round timeline (ISSUE 15): the wedge is VISIBLE on it --------
+        timeline_path = os.path.join(round_dir, "BENCH_timeline.json")
+        check(os.path.exists(timeline_path), "BENCH_timeline.json emitted")
+        timeline = {}
+        if os.path.exists(timeline_path):
+            with open(timeline_path) as f:
+                timeline = json.load(f)
+        names = [e.get("name") for e in timeline.get("traceEvents", [])]
+        check("bench.wedge.SIGKILL" in names,
+              "the chaos-wedged stage's kill is visible on the timeline")
+        check(any(n and n.startswith("bench.stage.") for n in names),
+              "timeline carries orchestrator stage slices")
+        # byte-stability: re-merging the same store reproduces the file
+        sys.path.insert(
+            0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        )
+        import bench as bench_mod
+        from karpenter_core_tpu.utils import supervise as sup
+
+        rebuilt = bench_mod.build_timeline(
+            sup.ArtifactStore(os.path.join(round_dir, "stages"))
+        )
+        check(
+            json.dumps(rebuilt, sort_keys=True)
+            == json.dumps(timeline, sort_keys=True),
+            "timeline is byte-stable across re-merges",
+        )
+
         head_artifact = os.path.join(round_dir, "stages", "headline.json")
         with open(head_artifact, "rb") as f:
             head_bytes_before = f.read()

@@ -1,0 +1,255 @@
+"""Observability smoke (`make obs-smoke`, ISSUE 15): the cross-process
+span graft + merged metrics, proven on a LIVE operator in host mode.
+
+The drill (~45s budget, typically much faster):
+
+  1. a full in-process control plane runs the production host-mode wiring
+     (HostSolver under ResilientSolver) with tracing + flightrec armed and
+     the debug HTTP surface served, exactly like operator/__main__;
+  2. one solve goes through the sidecar; acceptance: `/debug/trace`
+     contains the CHILD's `solver.phase.*` spans grafted under
+     `solver.host.request` (tagged pid/generation), the phase SET equals
+     an in-process solve's of the same workload, `/debug/timeline` links
+     trace ids to flight records, and the parent `/metrics` exposition
+     carries the child's phase histogram under process="solver-host" with
+     a trace-id exemplar on the solve-duration histogram;
+  3. `solver.device.hang` armed in the child wedges a dispatch mid-solve;
+     the parent SIGKILLs the host group; acceptance: the wedge lands as a
+     `solver.host.kill` instant event NAMING the phase the child died in
+     (`solver.phase.device`), and the typed SolverWedgedError carries the
+     same phase.
+
+Hermetic (CPU forced in-process). Non-fatal in `make verify`, FATAL in
+hack/presubmit.sh — the host-smoke/bench-smoke pattern.
+"""
+import json
+import os
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+STALE_AFTER = float(os.environ.get("KCT_OBS_SMOKE_STALE", "3.0"))
+
+
+def _get(port: int, path: str, accept: str = ""):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        headers={"Accept": accept} if accept else {},
+    )
+    with urllib.request.urlopen(req, timeout=10) as resp:
+        return resp.read()
+
+
+def main() -> int:
+    from karpenter_core_tpu.api.settings import Settings
+    from karpenter_core_tpu.cloudprovider import fake
+    from karpenter_core_tpu.metrics.registry import REGISTRY
+    from karpenter_core_tpu.obs import TRACER
+    from karpenter_core_tpu.obs.flightrec import FLIGHTREC
+    from karpenter_core_tpu.operator import new_operator
+    from karpenter_core_tpu.operator.__main__ import serve_health
+    from karpenter_core_tpu.solver.fallback import ResilientSolver
+    from karpenter_core_tpu.solver.host import HostSolver
+    from karpenter_core_tpu.solver.tpu_solver import GreedySolver, TPUSolver
+    from karpenter_core_tpu.testing import make_pod, make_provisioner
+
+    TRACER.enable()
+    FLIGHTREC.enable()
+    # stale_after stays GENEROUS (60s) for the clean-solve half: a
+    # drill-scale threshold kills children mid-cold-compile before the
+    # persistent cache is written and livelocks (measured, PR 11 soak
+    # notes). The wedge drill tightens it AFTER the cache is warm.
+    host = HostSolver(
+        max_nodes=64, stale_after=60.0, solve_timeout=120.0,
+        spawn_timeout=120.0,
+        child_env={"KARPENTER_SOLVER_MODE": "single"},
+    )
+    resilient = ResilientSolver(
+        host, GreedySolver(), small_batch_work_max=0,
+        solve_timeout=120.0, wedge_stale_after=None,  # the host watches
+        reprobe_interval=2.0, probe_timeout=60.0,
+    )
+    cp = fake.FakeCloudProvider(fake.instance_types(10))
+    op = new_operator(
+        cp,
+        settings=Settings(batch_idle_duration=0.02, batch_max_duration=0.2),
+        solver=resilient,
+    )
+    op.provisioning.fallback_solver = resilient
+    op.kube_client.create(make_provisioner(name="default"))
+    health = serve_health(op, 0, profiling=True, solver=resilient)
+    port = health.server_address[1]
+
+    problems = []
+    parent_pid = os.getpid()
+    op.start()
+    try:
+        # -- one clean solve through the sidecar -------------------------
+        for i in range(8):
+            op.kube_client.create(
+                make_pod(name=f"obs-{i}", requests={"cpu": "1"})
+            )
+        deadline = time.monotonic() + 45.0
+        covered = False
+        while time.monotonic() < deadline and not covered:
+            time.sleep(0.1)
+            op.sync_state()
+            result = op.provisioning.schedule()
+            covered = result is None or (
+                not result.new_machines and not result.failed_pods
+            )
+        if not covered:
+            problems.append("admission did not cover every pod in budget")
+
+        trace = json.loads(_get(port, "/debug/trace"))
+        events = [
+            e for e in trace["traceEvents"] if e.get("ph") != "M"
+        ]
+        child_events = [
+            e for e in events
+            if e.get("pid") != parent_pid and "generation" in e["args"]
+        ]
+        child_phases = {
+            e["name"] for e in child_events
+            if e["name"].startswith("solver.phase.")
+        }
+        if "solver.phase.device" not in child_phases:
+            problems.append(
+                "/debug/trace carries no grafted child device phase "
+                f"(child phases: {sorted(child_phases)})"
+            )
+        req = next(
+            (e for e in events if e["name"] == "solver.host.request"), None
+        )
+        disp = next(
+            (e for e in child_events
+             if e["name"] == "solver.host.dispatch"), None
+        )
+        if req is None or disp is None or (
+            disp["args"].get("parent_id") != req["args"]["span_id"]
+        ):
+            problems.append(
+                "child dispatch span is not grafted under solver.host.request"
+            )
+
+        # phase-SET parity vs an in-process solve of the same workload
+        pods = [make_pod(requests={"cpu": "1"}) for _ in range(8)]
+        provisioners = [make_provisioner(name="default")]
+        its = {"default": fake.instance_types(10)}
+        mark = TRACER.mark()
+        resilient.solve(pods, provisioners, its)
+        host_phases = {
+            s.name for s in TRACER.spans_since(mark)
+            if s.name.startswith("solver.phase.")
+        }
+        mark = TRACER.mark()
+        TPUSolver(max_nodes=64).solve(pods, provisioners, its)
+        local_phases = {
+            s.name for s in TRACER.spans_since(mark)
+            if s.name.startswith("solver.phase.")
+        }
+        if host_phases != local_phases:
+            problems.append(
+                f"phase set mismatch: host {sorted(host_phases)} vs "
+                f"in-process {sorted(local_phases)}"
+            )
+
+        timeline = json.loads(_get(port, "/debug/timeline"))
+        if "flight_records" not in timeline.get("otherData", {}):
+            problems.append("/debug/timeline lacks the flight-record index")
+
+        expo = _get(port, "/metrics").decode()
+        if 'process="solver-host"' not in expo or (
+            "karpenter_solver_phase_duration_seconds_bucket" not in expo
+        ):
+            problems.append(
+                "parent exposition lacks child phase histograms under "
+                "the process label"
+            )
+        if "# {trace_id=" in expo:
+            problems.append(
+                "plain 0.0.4 exposition must NOT carry exemplars (a "
+                "stock scraper would fail the whole scrape)"
+            )
+        om = _get(
+            port, "/metrics", accept="application/openmetrics-text"
+        ).decode()
+        if "# {trace_id=" not in om or not om.rstrip().endswith("# EOF"):
+            problems.append(
+                "OpenMetrics-negotiated exposition lacks the trace-id "
+                "exemplar (or the # EOF terminator)"
+            )
+
+        # -- wedge drill: the kill names the phase ------------------------
+        # the programs are compiled and disk-cached now; a tight staleness
+        # threshold is safe and keeps the drill fast
+        host.host.stale_after = STALE_AFTER
+        host.host.child_env["KARPENTER_CHAOS"] = (
+            "solver.device.hang=error:none,latency:60,times:1,after:0"
+        )
+        # respawn so the child picks up the armed env
+        host.host.call("health", timeout=30.0, watch_heartbeat=False)
+        pid = host.host.pid
+        if pid is not None:
+            import signal as _signal
+
+            try:
+                os.kill(pid, _signal.SIGKILL)
+            except ProcessLookupError:
+                pass
+        time.sleep(0.5)
+        mark = TRACER.mark()
+        wedge_msg = ""
+        resilient.solve(pods, provisioners, its)  # wedges, falls back
+        report = resilient.health_report()
+        hist = report.get("wedge_history") or []
+        if hist and hist[-1].get("reason"):
+            wedge_msg = str(hist[-1]["reason"])
+        kills = [
+            s for s in TRACER.spans_since(mark)
+            if s.name == "solver.host.kill"
+            and s.attrs.get("kind") == "wedged"
+        ]
+        if not kills:
+            problems.append("no solver.host.kill wedge instant event landed")
+        elif kills[-1].attrs.get("phase") != "solver.phase.device":
+            problems.append(
+                "wedge instant event does not name the device phase "
+                f"(phase={kills[-1].attrs.get('phase')!r})"
+            )
+        if "solver.phase.device" not in wedge_msg:
+            problems.append(
+                "SolverWedgedError/wedge history does not name the phase "
+                f"(reason={wedge_msg!r})"
+            )
+        host.host.child_env.pop("KARPENTER_CHAOS", None)
+    finally:
+        op.stop()
+        host.close()
+        health.shutdown()
+
+    if problems:
+        for p in problems:
+            print(f"obs-smoke FAIL: {p}", file=sys.stderr)
+        return 1
+    print(
+        "obs-smoke ok: child device phases grafted (set parity), merged "
+        "metrics under process label with trace-id exemplars, wedge kill "
+        "named solver.phase.device on the timeline"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    rc = main()
+    sys.stdout.flush()
+    sys.stderr.flush()
+    # skip interpreter teardown: watch pumps + XLA's thread pool race
+    # destructors at exit (same dodge as hack/host_smoke.py)
+    os._exit(rc)

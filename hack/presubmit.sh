@@ -44,6 +44,9 @@ make bench-smoke
 echo "== presubmit: make host-smoke (host killed mid-solve: respawn + parity + no zombies)"
 make host-smoke
 
+echo "== presubmit: make obs-smoke (cross-process graft + merged metrics + phase-named wedge)"
+make obs-smoke
+
 echo "== presubmit: make segment-smoke (segmented scan: byte-identity + chaos degradation)"
 make segment-smoke
 

@@ -5,7 +5,9 @@ verify` as a non-fatal step).
 Checks the ISSUE-1 contract end to end in-process:
   * the trace round-trips through json.loads,
   * it contains >0 solver-phase events (solver.phase.*),
-  * every event is a complete ('X') event carrying a dur,
+  * every duration event is a complete ('X') event carrying a dur
+    (instant 'i' markers and 'M' process metadata — ISSUE 15 — are the
+    only other phases allowed),
   * the reconcile that triggered the solve is present.
 
 Hermetic: forces the CPU backend in-process (the image's sitecustomize pins
@@ -58,9 +60,16 @@ def main() -> int:
         problems.append(f"demo solve launched no machines (created={created})")
     if not phase_events:
         problems.append("no solver.phase.* events in the trace")
-    bad = [e for e in events if e.get("ph") != "X" or "dur" not in e]
+    bad = [
+        e for e in events
+        if (e.get("ph") == "X" and "dur" not in e)
+        or e.get("ph") not in ("X", "i", "M")
+    ]
     if bad:
-        problems.append(f"{len(bad)} events are not complete ('X') events with dur")
+        problems.append(
+            f"{len(bad)} events are neither complete ('X' with dur) nor "
+            "instant/metadata ('i'/'M')"
+        )
     if not any(e["name"] == "provisioner.reconcile" for e in events):
         problems.append("missing provisioner.reconcile span")
 

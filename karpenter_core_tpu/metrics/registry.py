@@ -62,6 +62,54 @@ def _fmt_value(value: float) -> str:
     return repr(float(value))
 
 
+def _fmt_exemplar(ex: Optional[Tuple[Dict[str, str], float]]) -> str:
+    """OpenMetrics exemplar suffix for a bucket line ('' when absent):
+    ` # {trace_id="t42"} 0.93` — the metric -> trace -> flight-record link
+    (ISSUE 15). Rendered only on lines that carry one, so exemplar-free
+    exposition stays byte-identical to the plain 0.0.4 format."""
+    if not ex:
+        return ""
+    labels, value = ex
+    inner = ",".join(
+        f'{k}="{_escape_label(v)}"' for k, v in sorted(labels.items())
+    )
+    return f" # {{{inner}}} {_fmt_value(value)}"
+
+
+def _render_external(name: str, kind: str, fam: dict) -> List[str]:
+    """Sample lines for one external family (no HELP/TYPE — the caller
+    emitted the one header for this name). Series labels are rendered
+    as-is; the source is responsible for disambiguating its series from
+    the local ones (the solver host adds a `process` label)."""
+    lines: List[str] = []
+    series = sorted(
+        ((_labels(labels), value) for labels, value in fam.get("series", ())),
+    )
+    if kind in ("counter", "gauge"):
+        for lv, value in series:
+            try:
+                lines.append(f"{name}{_fmt_labels(lv)} {_fmt_value(value)}")
+            except (TypeError, ValueError):
+                continue
+        return lines
+    bounds = list(fam.get("buckets", ()))
+    for lv, hist in series:
+        if not isinstance(hist, dict):
+            continue
+        counts = list(hist.get("buckets", ()))
+        count = int(hist.get("count", 0))
+        for bound, c in zip(bounds, counts):
+            le = _fmt_labels(lv, f'le="{bound:g}"')
+            lines.append(f"{name}_bucket{le} {int(c)}")
+        inf = _fmt_labels(lv, 'le="+Inf"')
+        lines.append(f"{name}_bucket{inf} {count}")
+        lines.append(
+            f"{name}_sum{_fmt_labels(lv)} {_fmt_value(float(hist.get('sum', 0.0)))}"
+        )
+        lines.append(f"{name}_count{_fmt_labels(lv)} {count}")
+    return lines
+
+
 class Counter:
     def __init__(self, name: str, help: str = ""):
         self.name = name
@@ -128,8 +176,13 @@ class Histogram:
         self.bucket_counts: Dict[LabelValues, List[int]] = {}
         self.sums: Dict[LabelValues, float] = defaultdict(float)
         self.counts: Dict[LabelValues, int] = defaultdict(int)
+        # last exemplar per (series, bucket index): {trace_id: ...} labels +
+        # the observed value, rendered OpenMetrics-style on bucket lines so
+        # a bad p99 bucket links metric -> trace -> flight record (ISSUE 15)
+        self.exemplars: Dict[LabelValues, Dict[int, Tuple[Dict[str, str], float]]] = {}
 
-    def observe(self, value: float, labels: Optional[Dict[str, str]] = None) -> None:
+    def observe(self, value: float, labels: Optional[Dict[str, str]] = None,
+                exemplar: Optional[Dict[str, str]] = None) -> None:
         lv = _labels(labels)
         with self._mu:
             counts = self.bucket_counts.setdefault(lv, [0] * len(self.buckets))
@@ -138,6 +191,27 @@ class Histogram:
                 counts[b] += 1
             self.sums[lv] += value
             self.counts[lv] += 1
+            if exemplar:
+                # i == len(buckets) attaches to the +Inf bucket
+                self.exemplars.setdefault(lv, {})[i] = (dict(exemplar), value)
+
+    def series(self) -> List[Tuple[Dict[str, str], Dict[str, object]]]:
+        """Snapshot of every labeled series: [(labels, {"buckets":
+        cumulative-counts, "sum": s, "count": n}), ...] — the histogram
+        twin of Counter.series(), ridden by out-of-process reporters (the
+        solver host's stats frame, ISSUE 15)."""
+        with self._mu:
+            return [
+                (
+                    dict(lv),
+                    {
+                        "buckets": list(self.bucket_counts.get(lv, ())),
+                        "sum": self.sums[lv],
+                        "count": count,
+                    },
+                )
+                for lv, count in self.counts.items()
+            ]
 
     def snapshot(self, labels: Optional[Dict[str, str]] = None):
         """(cumulative bucket counts, count, sum) at this instant — pass a
@@ -184,6 +258,23 @@ class Registry:
     def __init__(self):
         self._mu = threading.Lock()
         self.metrics: Dict[str, object] = {}
+        # external sample sources (ISSUE 15): objects with a `families()`
+        # method returning {name: {"kind", "help", "buckets", "series"}} —
+        # the solver host's merged child-process metrics register here so
+        # the ONE exposition carries both processes' series (same metric
+        # family, disjoint label sets: child series carry a `process`
+        # label). Registered sources must never raise from families().
+        self._externals: List[object] = []
+
+    def add_external(self, source) -> None:
+        with self._mu:
+            if source not in self._externals:
+                self._externals.append(source)
+
+    def remove_external(self, source) -> None:
+        with self._mu:
+            if source in self._externals:
+                self._externals.remove(source)
 
     def counter(self, name: str, help: str = "") -> Counter:
         return self._get_or_create(name, Counter, lambda: Counter(name, help))
@@ -208,43 +299,261 @@ class Registry:
                 )
             return existing
 
-    def expose(self) -> str:
-        """Prometheus text exposition (format version 0.0.4)."""
+    def expose(self, exemplars: bool = False) -> str:
+        """Prometheus text exposition (format version 0.0.4 by default).
+
+        ``exemplars=True`` appends OpenMetrics `# {…}` exemplar suffixes
+        on histogram bucket lines that carry one — callers serving that
+        form MUST declare the openmetrics content type (the 0.0.4 parser
+        treats the suffix as a malformed timestamp and fails the whole
+        scrape), which is why the default exposition never renders them:
+        exemplars are only reachable through content negotiation
+        (operator /metrics honors `Accept: application/openmetrics-text`).
+        External sources' series render under the same family header as
+        the local metric of that name (one HELP/TYPE per name — duplicate
+        headers are illegal exposition), after the local series."""
         lines: List[str] = []
         with self._mu:
             metrics = dict(self.metrics)
-        for name, metric in sorted(metrics.items()):
-            if metric.help:
-                lines.append(f"# HELP {name} {_escape_help(metric.help)}")
+            externals = list(self._externals)
+        ext_families: Dict[str, List[dict]] = {}
+        for source in externals:
+            try:
+                fams = source.families()
+            except Exception:  # noqa: BLE001 — a sick source must not kill /metrics
+                continue
+            for name, fam in (fams or {}).items():
+                ext_families.setdefault(name, []).append(fam)
+        for name in sorted(set(metrics) | set(ext_families)):
+            metric = metrics.get(name)
+            fams = ext_families.get(name, [])
+            help_text = metric.help if metric is not None else next(
+                (f.get("help", "") for f in fams if f.get("help")), ""
+            )
+            if metric is not None:
+                kind = (
+                    "counter" if isinstance(metric, Counter)
+                    else "gauge" if isinstance(metric, Gauge)
+                    else "histogram"
+                )
+            else:
+                kind = str(fams[0].get("kind", "counter"))
+            if help_text:
+                lines.append(f"# HELP {name} {_escape_help(help_text)}")
+            lines.append(f"# TYPE {name} {kind}")
             if isinstance(metric, (Counter, Gauge)):
-                kind = "counter" if isinstance(metric, Counter) else "gauge"
-                lines.append(f"# TYPE {name} {kind}")
                 with metric._mu:
                     values = dict(metric.values)
                 for lv, value in sorted(values.items()):
                     lines.append(f"{name}{_fmt_labels(lv)} {_fmt_value(value)}")
             elif isinstance(metric, Histogram):
-                lines.append(f"# TYPE {name} histogram")
                 with metric._mu:
                     series = {
                         lv: (
                             list(metric.bucket_counts.get(lv, [])),
                             metric.sums[lv],
                             count,
+                            dict(metric.exemplars.get(lv, ())),
                         )
                         for lv, count in metric.counts.items()
                     }
-                for lv, (buckets, total_sum, count) in sorted(series.items()):
-                    for bound, c in zip(metric.buckets, buckets):
+                for lv, (buckets, total_sum, count, ex) in sorted(
+                    series.items()
+                ):
+                    for i, (bound, c) in enumerate(zip(metric.buckets, buckets)):
                         le = _fmt_labels(lv, f'le="{bound:g}"')
-                        lines.append(f"{name}_bucket{le} {c}")
+                        lines.append(
+                            f"{name}_bucket{le} {c}"
+                            + (_fmt_exemplar(ex.get(i)) if exemplars else "")
+                        )
                     inf = _fmt_labels(lv, 'le="+Inf"')
-                    lines.append(f"{name}_bucket{inf} {count}")
+                    lines.append(
+                        f"{name}_bucket{inf} {count}"
+                        + (_fmt_exemplar(ex.get(len(metric.buckets)))
+                           if exemplars else "")
+                    )
                     lines.append(
                         f"{name}_sum{_fmt_labels(lv)} {_fmt_value(total_sum)}"
                     )
                     lines.append(f"{name}_count{_fmt_labels(lv)} {count}")
+            for fam in fams:
+                lines.extend(_render_external(name, kind, fam))
         return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# cross-process metric merging (ISSUE 15): the solver-host child snapshots
+# its registry into a JSON-able families dict that rides the stats frame;
+# the parent folds snapshots into a ProcessSeriesMerger registered as an
+# external exposition source, so child counters/histograms appear in the
+# ONE parent /metrics under a `process` label — double-count-proof across
+# kill->respawn cycles (cumulative snapshots are keyed by child generation;
+# a dead generation's last snapshot is committed once, never re-added).
+
+
+def snapshot_families(registry: "Registry", prefix: str = NAMESPACE + "_",
+                      max_series: int = 512) -> Dict[str, dict]:
+    """JSON-able cumulative snapshot of a registry's counters + histograms
+    (gauges deliberately excluded: a dead child's last gauge reading is
+    not a fact about the parent process). Bounded: at most `max_series`
+    series total — oversized registries truncate deterministically (sorted
+    name order) rather than bloat the frame."""
+    out: Dict[str, dict] = {}
+    with registry._mu:
+        metrics = dict(registry.metrics)
+    budget = max_series
+    for name in sorted(metrics):
+        if budget <= 0:
+            break
+        if not name.startswith(prefix):
+            continue
+        metric = metrics[name]
+        if isinstance(metric, Counter):
+            series = [
+                [labels, value] for labels, value in metric.series()
+            ][:budget]
+            if not series:
+                continue
+            out[name] = {"kind": "counter", "help": metric.help,
+                         "series": series}
+        elif isinstance(metric, Histogram):
+            series = [
+                [labels, state] for labels, state in metric.series()
+            ][:budget]
+            if not series:
+                continue
+            out[name] = {
+                "kind": "histogram", "help": metric.help,
+                "buckets": list(metric.buckets), "series": series,
+            }
+        else:
+            continue
+        budget -= len(out[name]["series"])
+    return out
+
+
+def _merge_state(kind: str, a, b):
+    """a + b for one series' cumulative state (scalar or histogram dict)."""
+    if kind != "histogram":
+        return float(a) + float(b)
+    ab, bb = list(a.get("buckets", ())), list(b.get("buckets", ()))
+    if len(ab) < len(bb):
+        ab += [0] * (len(bb) - len(ab))
+    elif len(bb) < len(ab):
+        bb += [0] * (len(ab) - len(bb))
+    return {
+        "buckets": [int(x) + int(y) for x, y in zip(ab, bb)],
+        "sum": float(a.get("sum", 0.0)) + float(b.get("sum", 0.0)),
+        "count": int(a.get("count", 0)) + int(b.get("count", 0)),
+    }
+
+
+class ProcessSeriesMerger:
+    """Merged view over one child process's cumulative metric snapshots.
+
+    Contract (the respawn-idempotency story, asserted in
+    tests/test_solver_host.py):
+
+      * ``ingest(generation, families)`` REPLACES the live view for that
+        generation — re-ingesting the same cumulative snapshot is a no-op
+        on the merged totals (snapshots are states, not deltas);
+      * a generation bump (respawn) folds the previous generation's last
+        snapshot into the committed base exactly once, so a child that
+        died counting 7 solves contributes 7 forever, and its successor
+        counts from 0 on top;
+      * ``retire(generation)`` folds eagerly on a kill, so the exposition
+        never loses the dead child's tail while the respawn boots.
+
+    ``families()`` renders base+live with the ``process`` label added to
+    every series — the disambiguator against the parent's own series."""
+
+    def __init__(self, process: str):
+        self.process = process
+        self._mu = threading.Lock()
+        self._meta: Dict[str, Tuple[str, str, Tuple[float, ...]]] = {}
+        # name -> {label-tuple: state}; states are scalars (counter) or
+        # {"buckets","sum","count"} dicts (histogram)
+        self._base: Dict[str, Dict[LabelValues, object]] = {}
+        self._live: Dict[str, Dict[LabelValues, object]] = {}
+        self._live_gen: Optional[int] = None
+
+    def _parse(self, families: Dict[str, dict]) -> Dict[str, Dict[LabelValues, object]]:
+        parsed: Dict[str, Dict[LabelValues, object]] = {}
+        for name, fam in (families or {}).items():
+            kind = str(fam.get("kind", "counter"))
+            if kind not in ("counter", "histogram"):
+                continue
+            self._meta[name] = (
+                kind, str(fam.get("help", "")),
+                tuple(fam.get("buckets", ())),
+            )
+            parsed[name] = {
+                _labels(dict(labels)): state
+                for labels, state in fam.get("series", ())
+            }
+        return parsed
+
+    def _fold_live_locked(self) -> None:
+        for name, series in self._live.items():
+            kind = self._meta.get(name, ("counter",))[0]
+            base = self._base.setdefault(name, {})
+            for lv, state in series.items():
+                if lv in base:
+                    base[lv] = _merge_state(kind, base[lv], state)
+                else:
+                    base[lv] = state
+        self._live = {}
+        self._live_gen = None
+
+    def ingest(self, generation: int, families: Dict[str, dict]) -> None:
+        with self._mu:
+            if self._live_gen is not None and generation != self._live_gen:
+                self._fold_live_locked()
+            self._live_gen = generation
+            self._live = self._parse(families)
+
+    def retire(self, generation: int) -> None:
+        """The child of `generation` is dead: commit its last snapshot to
+        the base (idempotent — retiring an already-folded or never-seen
+        generation is a no-op)."""
+        with self._mu:
+            if self._live_gen == generation:
+                self._fold_live_locked()
+
+    def clear(self) -> None:
+        with self._mu:
+            self._base = {}
+            self._live = {}
+            self._live_gen = None
+
+    def families(self) -> Dict[str, dict]:
+        with self._mu:
+            names = set(self._base) | set(self._live)
+            out: Dict[str, dict] = {}
+            for name in sorted(names):
+                kind, help_text, buckets = self._meta.get(
+                    name, ("counter", "", ())
+                )
+                merged: Dict[LabelValues, object] = dict(
+                    self._base.get(name, ())
+                )
+                for lv, state in self._live.get(name, {}).items():
+                    if lv in merged:
+                        merged[lv] = _merge_state(kind, merged[lv], state)
+                    else:
+                        merged[lv] = state
+                series = []
+                for lv in sorted(merged):
+                    labels = dict(lv)
+                    labels["process"] = self.process
+                    series.append([labels, merged[lv]])
+                fam: Dict[str, object] = {
+                    "kind": kind, "help": help_text, "series": series,
+                }
+                if kind == "histogram":
+                    fam["buckets"] = list(buckets)
+                out[name] = fam
+            return out
 
 
 REGISTRY = Registry()

@@ -28,12 +28,13 @@ from karpenter_core_tpu.obs.tracer import (
     Tracer,
     device_profiler,
     enable_tracing_from_env,
+    export_spans,
     profile_dir,
 )
 
 __all__ = [
     "TRACER", "TRACE_HEADER", "Span", "Tracer", "device_profiler",
-    "enable_tracing_from_env", "profile_dir",
+    "enable_tracing_from_env", "export_spans", "profile_dir",
     "LOG_SINK", "log_bound", "configure_logging_from_env", "get_logger",
     "FLIGHTREC", "FlightRecorder", "enable_flightrec_from_env",
 ]

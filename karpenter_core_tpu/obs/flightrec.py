@@ -706,6 +706,20 @@ class FlightRecorder:
         with self._mu:
             return list(self._ring)
 
+    def record_for_trace(self, trace_id: str) -> Optional[dict]:
+        """The newest record carrying `trace_id` — the landing point of a
+        histogram exemplar's metric -> trace -> flight-record chain
+        (ISSUE 15): an operator reads the exemplar off a bad p99 bucket,
+        opens /debug/trace at that id, and fetches the replayable inputs
+        here. None when the trace produced no record (or it aged out)."""
+        if not trace_id:
+            return None
+        with self._mu:
+            for record in reversed(self._ring):
+                if record.get("trace_id") == trace_id:
+                    return record
+        return None
+
     def last(self) -> Optional[dict]:
         with self._mu:
             return self._ring[-1] if self._ring else None

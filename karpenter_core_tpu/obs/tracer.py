@@ -30,7 +30,7 @@ import threading
 import time
 from collections import deque
 from contextlib import nullcontext
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from karpenter_core_tpu.metrics.registry import NAMESPACE, REGISTRY
 
@@ -60,7 +60,11 @@ TRACE_HEADER = "x-karpenter-trace-id"
 def _bridge(span: "Span") -> None:
     """Span completion -> metrics registry. Called with the tracer enabled
     only; controller reconcile histograms are observed at their own sites
-    (operator/controller.py) so they are never double-counted here."""
+    (operator/controller.py) so they are never double-counted here.
+    GRAFTED spans (a child process's, folded in over the frame protocol)
+    never pass through: the child already observed its own instruments,
+    which reach the parent exposition via the metrics merge — bridging
+    the grafted copy would double-count every phase (ISSUE 15)."""
     name = span.name
     if name.startswith(_PHASE_PREFIX):
         SOLVER_PHASE_DURATION.observe(
@@ -72,7 +76,12 @@ def _bridge(span: "Span") -> None:
         # batch-size gauge) or consolidation-heavy clusters would report
         # simulation numbers as provisioning SLO data
         ctx = str(span.attrs.get("context", "provisioning"))
-        SOLVER_SOLVE_DURATION.observe(span.duration_s, {"context": ctx})
+        SOLVER_SOLVE_DURATION.observe(
+            span.duration_s, {"context": ctx},
+            # the exemplar links a bad latency bucket to its trace — and,
+            # through the trace id, to the flight record of the same solve
+            exemplar={"trace_id": span.trace_id} if span.trace_id else None,
+        )
         pods = span.attrs.get("pods")
         if pods is not None and ctx == "provisioning":
             SOLVER_BATCH_SIZE.set(float(pods))
@@ -158,6 +167,11 @@ class Tracer:
     truncation is always visible in exports.
     """
 
+    # per-graft span budget (satellite, ISSUE 15): a chatty child can never
+    # push more than this many spans into the parent ring per exchange —
+    # the frame side mirrors the cap at export (MAX_EXPORT_SPANS/BYTES)
+    MAX_GRAFT_SPANS = 256
+
     def __init__(self, capacity: int = 65536):
         self.enabled = False
         self.capacity = capacity
@@ -169,15 +183,33 @@ class Tracer:
         self._tls = threading.local()
         self._t0_ns = time.perf_counter_ns()
         self._pid = os.getpid()
+        # graft accounting (ISSUE 15): spans a child exported but this
+        # tracer refused (per-graft cap) PLUS spans the child itself
+        # dropped at export — truncation is always visible, like `dropped`
+        self._graft_dropped = 0
+        self._grafted = 0
+        # span spill (killed-child salvage): when set, finished spans with
+        # a spilled prefix are mirrored into a small ring + atomically
+        # rewritten to `spill_path` so the PARENT can salvage a killed
+        # child's last phases from disk. None (the default) costs one
+        # attribute check per recorded span, zero when tracing is off.
+        self._spill_path: Optional[str] = None
+        self._spill_prefix: Tuple[str, ...] = ()
+        self._spill_ring: deque = deque(maxlen=64)
 
     # -- lifecycle ---------------------------------------------------------
 
     def enable(self) -> "Tracer":
-        self.enabled = True
+        # the write latches under _mu; the hot-path `enabled` read stays
+        # lock-free by contract (racewatch suppression table, ISSUE 13 —
+        # same posture as FlightRecorder.enabled)
+        with self._mu:
+            self.enabled = True
         return self
 
     def disable(self) -> "Tracer":
-        self.enabled = False
+        with self._mu:
+            self.enabled = False
         return self
 
     def clear(self) -> None:
@@ -203,6 +235,17 @@ class Tracer:
         span.start_ns = start_ns
         span.end_ns = end_ns
         self._record(span)
+
+    def instant(self, name: str, trace_id: Optional[str] = None,
+                **attrs) -> None:
+        """Record a zero-duration INSTANT event (kill, respawn, breaker
+        transition, wedge verdict) — rendered as a Perfetto instant ('i')
+        marker instead of a duration slice. Disabled -> one flag check."""
+        if not self.enabled:
+            return
+        now = time.perf_counter_ns()
+        attrs["instant"] = True
+        self.add_span(name, now, now, trace_id=trace_id, **attrs)
 
     def _make(self, name, trace_id, attrs) -> Span:
         parent = self._current()
@@ -258,6 +301,51 @@ class Tracer:
             _bridge(span)
         except Exception:  # noqa: BLE001 — metrics must never break a solve
             pass
+        if self._spill_path is not None and span.name.startswith(
+            self._spill_prefix
+        ):
+            self._spill(span)
+
+    # -- killed-child salvage spill (ISSUE 15) ------------------------------
+
+    def set_spill(self, path: Optional[str],
+                  prefixes: Tuple[str, ...] = ("solver.",)) -> None:
+        """Arm (path) / disarm (None) the span spill: finished spans whose
+        name starts with one of `prefixes` are mirrored to `path` as an
+        export payload, atomically rewritten per span. The solver-host
+        CHILD arms this beside its heartbeat file so the parent can graft
+        the last phases of a dispatch that never got to answer (the child
+        was SIGKILLed mid-solve)."""
+        with self._mu:
+            self._spill_ring.clear()
+            self._spill_prefix = tuple(prefixes)
+            self._spill_path = path
+
+    def reset_spill(self) -> None:
+        """Clear the spill ring + file. The solver-host child calls this
+        at each dispatch start so a later kill's salvage never re-grafts
+        spans already delivered in an earlier response frame."""
+        with self._mu:
+            self._spill_ring.clear()
+            path = self._spill_path
+        if path is not None:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+    def _spill(self, span: Span) -> None:
+        try:
+            from karpenter_core_tpu.utils import supervise
+
+            with self._mu:
+                self._spill_ring.append(span)
+                payload = export_spans(list(self._spill_ring))
+                path = self._spill_path
+            if path is not None:
+                supervise.atomic_write_json(path, payload)
+        except Exception:  # noqa: BLE001 — salvage is best-effort by design
+            pass
 
     # -- reading -----------------------------------------------------------
 
@@ -266,6 +354,109 @@ class Tracer:
         """Spans evicted from the ring buffer (truncation accounting)."""
         with self._mu:
             return self._finished - len(self._spans)
+
+    @property
+    def graft_dropped(self) -> int:
+        """Child-exported spans NOT grafted (per-graft cap here + export
+        cap on the frame side) — the cap-and-count contract's counter."""
+        with self._mu:
+            return self._graft_dropped
+
+    @property
+    def grafted(self) -> int:
+        with self._mu:
+            return self._grafted
+
+    # -- cross-process graft (ISSUE 15 tentpole) ----------------------------
+
+    def graft(self, payload: Optional[Dict[str, object]], *,
+              pid: Optional[int] = None, generation: Optional[int] = None,
+              trace_id: Optional[str] = None,
+              **extra_attrs) -> int:
+        """Fold a child process's exported span delta (`export_spans`
+        payload, off the solver-host response/stats frame or a salvage
+        spill file) into this tracer's ring, parented under the calling
+        thread's CURRENT span (`solver.host.request` on the dispatch path).
+
+        Contract:
+
+          * timestamps rebase onto this process's perf_counter clock via
+            the payload's `now_ns` anchor (skew = one pipe hop — fine for
+            a timeline; never used for arithmetic beyond display);
+          * child span/parent ids are REMAPPED to fresh parent ids with
+            the child's internal structure preserved; orphans (parent not
+            in the payload) re-home under the current span;
+          * every grafted span is tagged {pid, generation} (+extra_attrs)
+            and re-homed onto the graft trace id, so /debug/trace,
+            flightrec.phases_ms and the bench phase breakdown see the
+            child's solver.phase.* spans as part of the ONE solve;
+          * bounded: at most MAX_GRAFT_SPANS per call land in the ring
+            (which is itself the bounded deque — grafts can never grow it
+            past capacity); refused + child-side-dropped spans count in
+            `graft_dropped`;
+          * grafted spans NEVER re-enter the metrics bridge (the child
+            already observed its instruments; they arrive via the metrics
+            merge instead).
+
+        Returns the number of spans grafted."""
+        if not self.enabled or not payload:
+            return 0
+        entries = list(payload.get("spans") or ())
+        child_dropped = int(payload.get("dropped", 0) or 0)
+        refused = max(0, len(entries) - self.MAX_GRAFT_SPANS)
+        if refused:
+            # keep the NEWEST spans: the tail names the phase closest to
+            # the outcome (or the kill)
+            entries = entries[-self.MAX_GRAFT_SPANS:]
+        parent = self._current()
+        if trace_id is None:
+            trace_id = (
+                parent.trace_id if parent is not None
+                else f"t{next(self._trace_ids):08x}"
+            )
+        now_ns = payload.get("now_ns")
+        offset = (
+            time.perf_counter_ns() - int(now_ns)
+            if isinstance(now_ns, (int, float)) and now_ns else 0
+        )
+        if pid is None:
+            p = payload.get("pid")
+            pid = int(p) if isinstance(p, (int, float)) else None
+        id_map: Dict[int, int] = {}
+        for entry in entries:
+            old = entry.get("i")
+            if isinstance(old, int):
+                id_map[old] = next(self._ids)
+        grafted: List[Span] = []
+        for entry in entries:
+            try:
+                attrs = dict(entry.get("a") or {})
+                if pid is not None:
+                    attrs["pid"] = pid
+                if generation is not None:
+                    attrs["generation"] = generation
+                attrs.update(extra_attrs)
+                old_parent = entry.get("p")
+                span = Span(
+                    self, str(entry["n"]), trace_id,
+                    id_map.get(entry.get("i"), next(self._ids)),
+                    id_map.get(old_parent) if old_parent in id_map
+                    else (parent.span_id if parent is not None else None),
+                    attrs,
+                )
+                span.tid = int(entry.get("d", 0) or 0)
+                span.start_ns = int(entry["s"]) + offset
+                span.end_ns = int(entry["e"]) + offset
+                grafted.append(span)
+            except (KeyError, TypeError, ValueError):
+                refused += 1
+        with self._mu:
+            for span in grafted:
+                self._spans.append(span)
+                self._finished += 1
+            self._grafted += len(grafted)
+            self._graft_dropped += refused + child_dropped
+        return len(grafted)
 
     def mark(self) -> int:
         """Sequence checkpoint; pass to spans_since()/phase_ms_since()."""
@@ -304,30 +495,57 @@ class Tracer:
 
     def chrome_trace(self) -> Dict[str, object]:
         """Chrome trace-event JSON (dict): complete ('X') events with
-        microsecond ts/dur, loadable in Perfetto and chrome://tracing."""
+        microsecond ts/dur, loadable in Perfetto and chrome://tracing.
+        Grafted child-process spans render under THEIR pid (a separate
+        Perfetto process track, named by a metadata event), instant
+        events (kills, respawns, breaker transitions) as 'i' markers —
+        the multi-process solve timeline (ISSUE 15)."""
         events = []
+        proc_names: Dict[int, str] = {self._pid: f"operator pid {self._pid}"}
         for span in self.spans():
             args = {"trace_id": span.trace_id, "span_id": span.span_id}
             if span.parent_id is not None:
                 args["parent_id"] = span.parent_id
             for k, v in span.attrs.items():
                 args[k] = v if isinstance(v, (int, float, bool)) else str(v)
+            pid = span.attrs.get("pid")
+            pid = pid if isinstance(pid, int) else self._pid
+            if pid not in proc_names:
+                gen = span.attrs.get("generation")
+                proc_names[pid] = (
+                    f"solver-host gen {gen} pid {pid}"
+                    if isinstance(gen, int) else f"pid {pid}"
+                )
+            event = {
+                "name": span.name,
+                "cat": "karpenter",
+                "ph": "X",
+                "ts": (span.start_ns - self._t0_ns) / 1e3,
+                "pid": pid,
+                "tid": span.tid % 2**31,  # chrome wants a small int
+                "args": args,
+            }
+            if span.attrs.get("instant") and span.start_ns == span.end_ns:
+                event["ph"] = "i"
+                event["s"] = "p"  # process-scoped marker line
+            else:
+                event["dur"] = max(span.end_ns - span.start_ns, 0) / 1e3
+            events.append(event)
+        for pid, label in sorted(proc_names.items()):
             events.append(
                 {
-                    "name": span.name,
-                    "cat": "karpenter",
-                    "ph": "X",
-                    "ts": (span.start_ns - self._t0_ns) / 1e3,
-                    "dur": max(span.end_ns - span.start_ns, 0) / 1e3,
-                    "pid": self._pid,
-                    "tid": span.tid % 2**31,  # chrome wants a small int
-                    "args": args,
+                    "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+                    "args": {"name": label},
                 }
             )
         return {
             "traceEvents": events,
             "displayTimeUnit": "ms",
-            "otherData": {"dropped_spans": self.dropped},
+            "otherData": {
+                "dropped_spans": self.dropped,
+                "grafted_spans": self.grafted,
+                "graft_dropped": self.graft_dropped,
+            },
         }
 
     def export_chrome_trace(self, path: str) -> str:
@@ -357,6 +575,65 @@ class Tracer:
         if self.dropped:
             lines.append(f"(dropped {self.dropped} spans: ring buffer full)")
         return "\n".join(lines)
+
+
+# frame-side export caps (ISSUE 15): the child's span delta riding a
+# response/stats frame header is bounded in BOTH count and bytes, with the
+# overflow counted in the payload's `dropped` — mirrored by the parent's
+# per-graft cap (Tracer.MAX_GRAFT_SPANS)
+MAX_EXPORT_SPANS = 256
+MAX_EXPORT_BYTES = 131072
+
+
+def _json_safe(value):
+    return value if isinstance(value, (int, float, bool, str)) else str(value)
+
+
+def export_spans(spans: List[Span], max_spans: int = MAX_EXPORT_SPANS,
+                 max_bytes: int = MAX_EXPORT_BYTES) -> Dict[str, object]:
+    """Serialize finished spans into the cross-process graft payload:
+
+        {"pid": …, "now_ns": perf_counter_ns at export (the receiver's
+         clock-rebase anchor), "spans": [{n,i,p,t,s,e,d,a}, …],
+         "dropped": count NOT exported (count/byte cap overflow)}
+
+    Newest spans win under the caps — the tail names the phases closest
+    to the outcome. The payload is pure JSON (rides the solver-host frame
+    header and the salvage spill file)."""
+    window = spans[-max_spans:] if max_spans else []
+    kept_rev: List[Dict[str, object]] = []
+    size = 0
+    dropped = len(spans) - len(window)
+    for span in reversed(window):
+        entry = {
+            "n": span.name,
+            "i": span.span_id,
+            "t": span.trace_id,
+            "s": span.start_ns,
+            "e": span.end_ns,
+            "d": span.tid,
+        }
+        if span.parent_id is not None:
+            entry["p"] = span.parent_id
+        if span.attrs:
+            entry["a"] = {k: _json_safe(v) for k, v in span.attrs.items()}
+        # cheap size proxy: the serialized entry's length; exact-enough to
+        # bound the frame header without serializing the payload twice
+        entry_size = len(json.dumps(entry, separators=(",", ":")))
+        if size + entry_size > max_bytes:
+            # everything older than the first overflow drops too (newest
+            # spans win; counting them keeps truncation visible)
+            dropped += len(window) - len(kept_rev)
+            break
+        size += entry_size
+        kept_rev.append(entry)
+    entries = list(reversed(kept_rev))
+    return {
+        "pid": os.getpid(),
+        "now_ns": time.perf_counter_ns(),
+        "spans": entries,
+        "dropped": dropped,
+    }
 
 
 # the process-wide tracer

@@ -160,8 +160,23 @@ class _HealthHandler(BaseHTTPRequestHandler):
 
     def do_GET(self):
         if self.path == "/metrics":
-            body = REGISTRY.expose().encode() + b"\n"
-            ctype = "text/plain; version=0.0.4"
+            # exemplars (trace-id links on histogram buckets, ISSUE 15)
+            # are only legal under the negotiated OpenMetrics type — the
+            # 0.0.4 parser reads the suffix as a malformed timestamp and
+            # fails the WHOLE scrape, so the plain exposition never
+            # carries them
+            accept = self.headers.get("Accept", "")
+            if "application/openmetrics-text" in accept:
+                body = (
+                    REGISTRY.expose(exemplars=True).encode() + b"\n# EOF\n"
+                )
+                ctype = (
+                    "application/openmetrics-text; version=1.0.0; "
+                    "charset=utf-8"
+                )
+            else:
+                body = REGISTRY.expose().encode() + b"\n"
+                ctype = "text/plain; version=0.0.4"
         elif self.path == "/debug/health":
             # wedge observability (ISSUE 11): dispatch heartbeat age,
             # breaker state, wedge history, abandoned-thread inventory.
@@ -190,6 +205,23 @@ class _HealthHandler(BaseHTTPRequestHandler):
 
             body = TRACER.summary().encode() + b"\n"
             ctype = "text/plain"
+        elif self.path == "/debug/timeline" and self.profiling_enabled:
+            # the cross-process solve timeline (ISSUE 15): the same
+            # Perfetto-loadable trace as /debug/trace — grafted solver-host
+            # child spans on their own pid track, kill/respawn/breaker
+            # instant markers — PLUS the trace-id -> flight-record index,
+            # so a span on the timeline links straight to the replayable
+            # inputs of the solve it belongs to
+            from karpenter_core_tpu.obs import TRACER
+            from karpenter_core_tpu.obs.flightrec import FLIGHTREC
+
+            timeline = TRACER.chrome_trace()
+            timeline["otherData"]["flight_records"] = {
+                r["trace_id"]: r.get("digest", "")
+                for r in FLIGHTREC.records() if r.get("trace_id")
+            }
+            body = json.dumps(timeline).encode()
+            ctype = "application/json"
         elif self.path == "/debug/logs" and self.profiling_enabled:
             # the structured-log ring (obs/log): logfmt lines, trace ids
             # joining /debug/trace spans

@@ -53,6 +53,7 @@ from karpenter_core_tpu.events import Event
 from karpenter_core_tpu.metrics.registry import NAMESPACE, REGISTRY
 from karpenter_core_tpu.obs.flightrec import FLIGHTREC, recording_suppressed
 from karpenter_core_tpu.obs.log import get_logger
+from karpenter_core_tpu.obs.tracer import TRACER
 from karpenter_core_tpu.utils import supervise
 
 LOG = get_logger("karpenter.solver.fallback")
@@ -126,6 +127,13 @@ class CircuitBreaker:
             BREAKER_TRANSITIONS.inc({"breaker": self.name, "to": state})
             BREAKER_OPEN.set(
                 1.0 if state == self.OPEN else 0.0, {"breaker": self.name}
+            )
+            # instant event on the solve timeline (ISSUE 15): the breaker
+            # opening/half-opening/closing shows up in /debug/trace and
+            # /debug/timeline beside the dispatch it interrupted
+            TRACER.instant(
+                f"breaker.{self.name}", to=state, from_state=was,
+                failures=self._failures,
             )
             LOG.info(
                 "circuit breaker transition", breaker=self.name,
@@ -419,15 +427,18 @@ class ResilientSolver:
             if kind == "wedged":
                 SOLVER_WEDGED_TOTAL.inc()
             self.breaker.trip()
+            phase = hb.label() if hb is not None else ""
             self.wedge_history.append({
                 "ts": self.clock(),
                 "kind": kind,
                 "reason": reason[:200],
+                "phase": phase,
                 "heartbeat_age_s": (
                     round(hb.age(), 1)
                     if hb is not None and hb.age() is not None else None
                 ),
             })
+            TRACER.instant("solver.wedge", kind=kind, phase=phase)
         LOG.warning("solver wedged", reason=reason, kind=kind, probe="solve")
         self._event("SolverWedged", "Warning",
                     f"device dispatch {kind} ({reason}); breaker open, "
@@ -482,6 +493,7 @@ class ResilientSolver:
                 "reason": reason,
                 "breaker": self.breaker.state,
                 "heartbeat_age_s": round(age, 3) if age is not None else None,
+                "heartbeat_phase": hb.label() if hb is not None else "",
                 "solve_timeout_s": self.solve_timeout,
                 "wedge_stale_after_s": self.wedge_stale_after,
                 "wedge_history": list(self.wedge_history),
@@ -606,12 +618,15 @@ class ResilientSolver:
                 and age >= self.wedge_stale_after
             ):
                 # stale heartbeat = the dispatch stopped making progress:
-                # a WEDGE, abandoned before the budget burns down
+                # a WEDGE, abandoned before the budget burns down. The
+                # heartbeat's phase label names WHERE it died (ISSUE 15)
+                phase = hb.label()
                 self._abandon(t, "wedged", age)
                 raise SolverWedgedError(
                     f"primary solve heartbeat stale for {age:.0f}s "
-                    f"(threshold {self.wedge_stale_after:.0f}s): "
-                    "backend wedged mid-dispatch"
+                    f"(threshold {self.wedge_stale_after:.0f}s)"
+                    + (f" during {phase}" if phase else "")
+                    + ": backend wedged mid-dispatch"
                 )
             if time.monotonic() >= deadline:
                 # alive (heartbeat fresh) but over budget: slow, not

@@ -64,9 +64,15 @@ from typing import Dict, Optional, Tuple
 import numpy as np
 
 from karpenter_core_tpu import chaos
-from karpenter_core_tpu.metrics.registry import NAMESPACE, REGISTRY
+from karpenter_core_tpu.metrics.registry import (
+    NAMESPACE,
+    REGISTRY,
+    ProcessSeriesMerger,
+    snapshot_families,
+)
 from karpenter_core_tpu.obs import TRACER
 from karpenter_core_tpu.obs import envflags
+from karpenter_core_tpu.obs.tracer import export_spans
 from karpenter_core_tpu.obs.log import get_logger
 from karpenter_core_tpu.solver import service_pb2 as pb
 from karpenter_core_tpu.solver.fallback import SolverWedgedError
@@ -400,6 +406,15 @@ class SolverHost:
         self._stderr_path = ""
         self._spawned_at = 0.0
         self._seq = itertools.count(1)
+        # merged child-process metrics (ISSUE 15): cumulative counter/
+        # histogram snapshots ride every solve/replan/stats response frame;
+        # the merger folds them per generation (a dead generation's last
+        # snapshot commits exactly once — no double counting across
+        # respawns) and registers as an exposition source on first ingest,
+        # so the parent /metrics carries the child's series under
+        # process="solver-host"
+        self.metrics = ProcessSeriesMerger("solver-host")
+        self._metrics_registered = False
         # serializes frame exchanges (one in-flight dispatch)
         self._mu = threading.Lock()
         # leaf lock for the lifecycle METADATA (generation/_proc/_ready/
@@ -423,6 +438,12 @@ class SolverHost:
         env.update(self.child_env)
         # the child must never recurse into building its own host
         env["KARPENTER_SOLVER_HOST"] = "off"
+        # trace enablement follows the PARENT (the operator arms tracing
+        # programmatically, not via env): an unset child env inherits the
+        # parent tracer's current state so span export works out of the
+        # box; an explicit KARPENTER_TPU_TRACE (env or child_env) wins
+        if not env.get("KARPENTER_TPU_TRACE"):
+            env["KARPENTER_TPU_TRACE"] = "1" if TRACER.enabled else "0"
         stderr_f = open(self._stderr_path, "wb")
         try:
             proc = subprocess.Popen(
@@ -440,6 +461,9 @@ class SolverHost:
             self._ready = False
             if gen > 1:
                 self.respawns += 1
+        TRACER.instant(
+            "solver.host.spawn", pid=proc.pid, generation=gen,
+        )
         LOG.info(
             "solver host spawned", pid=proc.pid, generation=gen,
         )
@@ -448,9 +472,12 @@ class SolverHost:
         tail = supervise.tail_bytes_of(self._stderr_path, 4096)
         return supervise.redact_env_text(tail) if tail else ""
 
-    def _kill_locked(self, kind: str, note: str, respawn: bool = True) -> None:
+    def _kill_locked(self, kind: str, note: str, respawn: bool = True,
+                     salvage: bool = False) -> None:
         with self._meta_mu:
             proc = self._proc
+            hb_path = self._hb_path
+        phase = supervise.Heartbeat(hb_path).read_label() if hb_path else ""
         if proc is not None:
             try:
                 os.killpg(proc.pid, signal.SIGKILL)
@@ -473,16 +500,31 @@ class SolverHost:
                 "generation": gen,
                 "kind": kind,
                 "note": note,
+                "phase": phase,
                 "stderr_tail": tail,
             }
             self._proc = None
             self._ready = False
         self._reader = None
+        # commit the dead child's last metrics snapshot exactly once: the
+        # respawned generation counts from zero ON TOP of it
+        self.metrics.retire(gen)
+        if salvage:
+            # mid-dispatch kill: the response frame (and its span delta)
+            # never arrived — graft what the child spilled beside its
+            # heartbeat, so the timeline shows the phases of the dispatch
+            # that died (tagged salvaged; ISSUE 15)
+            self._salvage_spans(gen, proc.pid if proc is not None else None)
+        # the kill is an instant event on the solve timeline, naming the
+        # phase the child died in — the wedge post-mortem's first fact
+        TRACER.instant(
+            "solver.host.kill", kind=kind, generation=gen, phase=phase,
+        )
         if respawn:
             HOST_RESPAWN_TOTAL.inc({"reason": kind})
         LOG.warning(
             "solver host killed", kind=kind, note=note,
-            generation=gen,
+            generation=gen, phase=phase,
         )
         if respawn:
             # eager respawn: the breaker's half-open trial must find a
@@ -490,9 +532,38 @@ class SolverHost:
             # probe passed"
             self._spawn_locked()
 
+    def _spill_path(self) -> str:
+        with self._meta_mu:
+            hb_path = self._hb_path
+        return f"{hb_path}.spans" if hb_path else ""
+
+    def _salvage_spans(self, generation: int, pid: Optional[int]) -> None:
+        """Graft the killed child's span spill (best-effort): the file is
+        the child tracer's bounded ring of finished solver.* spans since
+        its dispatch started, atomically rewritten per span — the last
+        thing it proved before going silent."""
+        path = self._spill_path()
+        if not path:
+            return
+        try:
+            with open(path, "rb") as f:
+                payload = json.loads(f.read().decode())
+        except (OSError, ValueError):
+            return
+        try:
+            os.unlink(path)  # salvage once — never re-graft on a later kill
+        except OSError:
+            pass
+        TRACER.graft(
+            payload, pid=pid, generation=generation, salvaged=True,
+        )
+
     def close(self) -> None:
         """Shut the host down (process-group kill; no respawn)."""
         with self._mu:
+            if self._metrics_registered:
+                REGISTRY.remove_external(self.metrics)
+                self._metrics_registered = False
             proc = self._proc_get()
             if proc is None:
                 return
@@ -602,6 +673,13 @@ class SolverHost:
         header: Dict[str, object] = {"op": op, "id": rid}
         if expires_in_s is not None:
             header["expires_in_s"] = round(float(expires_in_s), 3)
+        # trace propagation over the frame protocol (ISSUE 15): the
+        # parent's trace id rides the request header — the same contract
+        # as the gRPC x-karpenter-trace-id metadata — and its PRESENCE is
+        # the span-export request. Tracing off = no key = zero extra frame
+        # bytes (one enabled check per dispatch, tripwired).
+        if TRACER.enabled:
+            header["trace"] = TRACER.current_trace_id() or ""
         try:
             _write_frame(proc.stdin, header, body)
         except (OSError, ValueError) as e:
@@ -649,18 +727,23 @@ class SolverHost:
                 if rheader.get("op") == "ready":
                     continue  # a respawn raced this call; skip
                 if rheader.get("id") == rid:
+                    self._fold_response_locked(rheader)
                     return rheader, rbody
                 # a stale response from a pre-kill request: drop it
         except _Wedge as w:
+            phase = hb.read_label()
             self._kill_locked(
                 "wedged",
                 f"dispatch heartbeat stale for {w.age:.1f}s "
-                f"(threshold {self.stale_after:.1f}s)",
+                f"(threshold {self.stale_after:.1f}s)"
+                + (f" during {phase}" if phase else ""),
+                salvage=True,
             )
             raise SolverWedgedError(
                 f"solver host dispatch heartbeat stale for "
-                f"{w.age:.0f}s (threshold {self.stale_after:.0f}s): "
-                "host process group killed and respawned "
+                f"{w.age:.0f}s (threshold {self.stale_after:.0f}s)"
+                + (f" during {phase}" if phase else "")
+                + ": host process group killed and respawned "
                 f"(generation {self._generation_get()})"
             ) from None
         except _Overrun as o:
@@ -668,6 +751,7 @@ class SolverHost:
                 "timeout",
                 f"dispatch exceeded {o.budget:.1f}s budget "
                 "(heartbeat fresh — slow, not wedged)",
+                salvage=True,
             )
             raise TimeoutError(
                 f"solver host dispatch exceeded {o.budget:.0f}s budget: "
@@ -676,12 +760,38 @@ class SolverHost:
             ) from None
         except (EOFError, OSError) as e:
             tail = self._stderr_tail()
-            self._kill_locked("crashed", f"died mid-dispatch: {e}")
+            self._kill_locked(
+                "crashed", f"died mid-dispatch: {e}", salvage=True
+            )
             raise SolverUnavailableError(
                 f"solver host crashed mid-dispatch ({e}); respawned as "
                 f"generation {self._generation_get()}"
                 + (f"; stderr tail: {tail[-500:]}" if tail else "")
             ) from e
+
+    def _fold_response_locked(self, rheader: Dict[str, object]) -> None:
+        """Fold a response frame's observability payloads into the parent:
+        the child's span delta grafts under the calling thread's live span
+        (`solver.host.request` on the dispatch path) tagged pid/generation,
+        and the cumulative metrics snapshot feeds the per-generation
+        merger. Both are absent-tolerant — an old child or a tracing-off
+        exchange simply carries neither key."""
+        gen = self._generation_get()
+        spans = rheader.get("spans")
+        if spans:
+            try:
+                TRACER.graft(spans, generation=gen)
+            except Exception:  # noqa: BLE001 — observability must never fail a solve
+                pass
+        families = rheader.get("metrics")
+        if families:
+            try:
+                if not self._metrics_registered:
+                    REGISTRY.add_external(self.metrics)
+                    self._metrics_registered = True
+                self.metrics.ingest(gen, families)
+            except Exception:  # noqa: BLE001
+                pass
 
     def _generation_get(self) -> int:
         with self._meta_mu:
@@ -697,6 +807,11 @@ class SolverHost:
         acquired = self._mu.acquire(timeout=min(timeout, 1.0))
         if not acquired:
             age = self.heartbeat_age()
+            with self._meta_mu:
+                hb_path = self._hb_path
+            phase = (
+                supervise.Heartbeat(hb_path).read_label() if hb_path else ""
+            )
             if (
                 self.stale_after is not None
                 and age is not None
@@ -705,8 +820,12 @@ class SolverHost:
                 raise SolverUnavailableError(
                     f"solver host busy with a dispatch whose heartbeat is "
                     f"stale ({age:.0f}s)"
+                    + (f" during {phase}" if phase else "")
                 )
-            return {"status": "busy", "heartbeat_age_s": age}
+            return {
+                "status": "busy", "heartbeat_age_s": age,
+                "heartbeat_phase": phase,
+            }
         try:
             # the whole probe runs under this ONE bounded acquire: going
             # back through call() would re-take the lock unbounded and a
@@ -751,7 +870,8 @@ class SolverHost:
             recovery = self.last_recovery_s
             last_kill = self.last_kill
             hb_path = self._hb_path
-        age = supervise.Heartbeat(hb_path).age() if hb_path else None
+        hb = supervise.Heartbeat(hb_path) if hb_path else None
+        age = hb.age() if hb is not None else None
         return {
             "pid": proc.pid if proc is not None else None,
             "generation": generation,
@@ -762,6 +882,7 @@ class SolverHost:
                 round(recovery, 3) if recovery is not None else None
             ),
             "heartbeat_age_s": round(age, 3) if age is not None else None,
+            "heartbeat_phase": hb.read_label() if hb is not None else "",
             "stale_after_s": self.stale_after,
             "solve_timeout_s": self.solve_timeout,
             "last_kill": last_kill,
@@ -1036,10 +1157,18 @@ def host_main(argv=None) -> int:
 
     # the process heartbeat: TPUSolver phase marks (and the service's
     # per-dispatch marks) touch this FILE through supervise.touch_heartbeat
-    # — the parent's staleness watchdog reads its mtime
+    # — the parent's staleness watchdog reads its mtime, and the label the
+    # marks write is the phase name a wedge verdict reports (ISSUE 15)
     hb = supervise.Heartbeat(args.heartbeat)
     supervise.set_process_heartbeat(hb)
     hb.touch()
+
+    if TRACER.enabled:
+        # killed-child salvage (ISSUE 15): finished solver.* spans spill
+        # beside the heartbeat, atomically rewritten per span; the parent
+        # grafts the file after a mid-dispatch SIGKILL — the phases this
+        # dispatch completed before going silent
+        TRACER.set_spill(f"{args.heartbeat}.spans")
 
     from karpenter_core_tpu.solver.service import SolverService
 
@@ -1094,14 +1223,42 @@ def host_main(argv=None) -> int:
                     continue
                 request = pb.SolveRequest.FromString(body)
                 handler = service.solve if op == "solve" else service.replan
-                response = handler(request, context=None)
-                _write_frame(
-                    out,
-                    {"op": "result", "id": rid,
-                     "ok": not bool(response.error),
-                     "error": response.error or ""},
-                    response.SerializeToString(),
-                )
+                # trace binding (ISSUE 15): the parent's trace id rides the
+                # request header — bind it exactly like the gRPC
+                # x-karpenter-trace-id path, so the child's phase spans
+                # join the parent's trace; the span-ring DELTA since this
+                # mark rides back in the result header, bounded by
+                # export_spans' count+byte caps
+                trace_id = header.get("trace")
+                want_spans = trace_id is not None and TRACER.enabled
+                if want_spans:
+                    TRACER.reset_spill()
+                    mark = TRACER.mark()
+                    with TRACER.span(
+                        "solver.host.dispatch",
+                        trace_id=str(trace_id) or None, op=op,
+                    ):
+                        response = handler(request, context=None)
+                else:
+                    response = handler(request, context=None)
+                rheader: Dict[str, object] = {
+                    "op": "result", "id": rid,
+                    "ok": not bool(response.error),
+                    "error": response.error or "",
+                }
+                if want_spans:
+                    rheader["spans"] = export_spans(
+                        TRACER.spans_since(mark)
+                    )
+                # cumulative counter/histogram snapshot: the parent's
+                # per-generation merger folds it into the ONE exposition
+                rheader["metrics"] = snapshot_families(REGISTRY)
+                _write_frame(out, rheader, response.SerializeToString())
+                # the spill must only ever hold spans of an UNANSWERED
+                # dispatch: clear it once the response (which carried any
+                # spans) is on the wire, so a kill landing BEFORE the next
+                # dispatch starts can never re-salvage delivered spans
+                TRACER.reset_spill()
             elif op == "health":
                 age = service._stalest_dispatch_age()
                 if age is not None and age >= service.wedge_stale_after:
@@ -1149,7 +1306,13 @@ def host_main(argv=None) -> int:
                     ),
                 }
                 _write_frame(
-                    out, {"op": "result", "id": rid, "ok": True},
+                    out,
+                    {"op": "result", "id": rid, "ok": True,
+                     # the stats frame carries the same snapshot the
+                     # solve/replan responses do (the canonical metrics
+                     # ride, ISSUE 15) — a parent polling stats between
+                     # dispatches keeps the exposition fresh
+                     "metrics": snapshot_families(REGISTRY)},
                     json.dumps(info, sort_keys=True).encode(),
                 )
             else:

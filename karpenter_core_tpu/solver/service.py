@@ -498,6 +498,11 @@ class SolverService:
     def _solve_traced(self, request: pb.SolveRequest) -> pb.SolveResponse:
         import jax
 
+        # dispatch-start heartbeat BEFORE the chaos hooks, labeled with the
+        # device phase: the injected hang below models a device wedge, so
+        # the staleness window starts here and the wedge verdict the
+        # parent/ supervisor produces names the phase it died in (ISSUE 15)
+        supervise.touch_heartbeat("solver.phase.device")
         # the accelerator edge's chaos hooks, at the SAME contract as the
         # in-process TPUSolver dispatch (_run_kernels_impl): an injected
         # error routes to the caller's fallback; a hang (error:none +
@@ -505,7 +510,21 @@ class SolverService:
         # host-mode drills (solver/host.py) wedge the sidecar child
         chaos.maybe_fail(chaos.SOLVER_DEVICE)
         chaos.maybe_fail(chaos.SOLVER_DEVICE_HANG)
-        supervise.touch_heartbeat()
+        # device-side phase marks (ISSUE 15): the SAME solver.phase.* span
+        # names the in-process TPUSolver records, emitted from the service
+        # dispatch — so a host-mode (or split-gRPC) deployment reports the
+        # phases of the process doing the work: pack (program staging),
+        # upload, prescreen, device, fetch. The marks feed the phase
+        # histogram AND label the heartbeat, exactly like TPUSolver._mark.
+        t_phase = time.perf_counter_ns()
+
+        def _mark(name, **attrs):
+            nonlocal t_phase
+            now = time.perf_counter_ns()
+            TRACER.add_span(f"solver.phase.{name}", t_phase, now, **attrs)
+            t_phase = now
+            supervise.touch_heartbeat(f"solver.phase.{name}")
+
         geometry = json.loads(request.geometry)
         tensors = {t.name: tensor_from_pb(t) for t in request.tensors}
         args = _unflatten_args(tensors)
@@ -524,6 +543,7 @@ class SolverService:
             family="service" if layout is None else "service_sharded",
         )
         fn, pre_fn = entry
+        _mark("pack", tensors=len(request.tensors))
         host_args = args
         if layout is not None:
             # pre-sharded upload: each wire tensor device_puts with its
@@ -533,6 +553,7 @@ class SolverService:
             from karpenter_core_tpu.solver.tpu_solver import RUN_ARG_NAMES
 
             args = layout.put_args(RUN_ARG_NAMES, args)
+        _mark("upload")
         from karpenter_core_tpu.obs import device_profiler
 
         with device_profiler():
@@ -541,9 +562,15 @@ class SolverService:
                     key, geometry, args, pre_fn, host_args=host_args,
                     layout=layout,
                 )
-                supervise.touch_heartbeat()
+                _mark("prescreen")
+                # re-label for the long silent stretch: a wedge inside the
+                # XLA compile/execute block names the device phase
+                supervise.touch_heartbeat("solver.phase.device")
                 log, ptr, state = fn(screen0, *args)
             else:
+                # same re-label on the screening-off path — _mark("upload")
+                # just overwrote the dispatch-start device label
+                supervise.touch_heartbeat("solver.phase.device")
                 log, ptr, state = fn(*args)
             jax.block_until_ready(ptr)
         # progress proof for the dispatch watchdogs (the per-RPC thread
@@ -551,12 +578,13 @@ class SolverService:
         # heartbeat the parent's staleness watchdog reads): the longest
         # legit silent stretch is ONE XLA compile/execute block, which is
         # what wedge_stale_after must be sized above
-        supervise.touch_heartbeat()
+        _mark("device")
         out = [tensor_to_pb("ptr", np.asarray(ptr))]
         for name, value in log.items():
             out.append(tensor_to_pb(f"log/{name}", np.asarray(value)))
         for field, value in state._asdict().items():
             out.append(tensor_to_pb(f"state/{field}", np.asarray(value)))
+        _mark("fetch")
         with self._mu:
             self.solves += 1
         return pb.SolveResponse(tensors=out)
@@ -592,10 +620,11 @@ class SolverService:
         from karpenter_core_tpu.solver.encode import replan_chunks
         from karpenter_core_tpu.utils.compilecache import record_lookup
 
-        # same accelerator-edge chaos contract as _solve_traced
+        # same accelerator-edge chaos contract (and labeled dispatch-start
+        # heartbeat ordering) as _solve_traced
+        supervise.touch_heartbeat("solver.phase.replan.device")
         chaos.maybe_fail(chaos.SOLVER_DEVICE)
         chaos.maybe_fail(chaos.SOLVER_DEVICE_HANG)
-        supervise.touch_heartbeat()
         geometry = json.loads(request.geometry)
         tensors = {t.name: tensor_from_pb(t) for t in request.tensors}
         count_rows = np.ascontiguousarray(tensors.pop("replan/count_rows"))

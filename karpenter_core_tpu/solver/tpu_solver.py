@@ -1864,7 +1864,7 @@ class TPUSolver:
 
         # dispatch-start heartbeat (same contract as _run_kernels_impl):
         # staleness counts from the replan dispatch, not the last solve
-        supervise.touch_heartbeat()
+        supervise.touch_heartbeat("solver.phase.replan.device")
         chaos.maybe_fail(chaos.SOLVER_DEVICE)
         # hang-shaped chaos (sleep-past-watchdog): models the wedge, where
         # the dispatch stops progressing instead of erroring
@@ -1881,7 +1881,7 @@ class TPUSolver:
             t_phase = now
             # progress proof for the dispatch watchdog (ResilientSolver /
             # bench stage supervisor): a wedged dispatch stops marking
-            supervise.touch_heartbeat()
+            supervise.touch_heartbeat(f"solver.phase.replan.{name}")
 
         screen_mode = self.screen_mode or ops_compat.resolve_screen_mode()
         # single-device deliberately: the candidate axis is a vmap over the
@@ -2203,8 +2203,13 @@ class TPUSolver:
         # dispatch-start heartbeat: staleness counts from HERE, so a hang
         # injected (or a backend wedge hit) before the first phase mark is
         # still measured against the dispatch, not whatever touched the
-        # heartbeat last (the solver-host watchdog reads the same mark)
-        supervise.touch_heartbeat()
+        # heartbeat last (the solver-host watchdog reads the same mark).
+        # Labeled "solver.phase.device": everything from here to the fetch
+        # IS the device dispatch pipeline, and the hang chaos right below
+        # models a device wedge — so the wedge verdict a drill produces
+        # names the phase it injects (the _marks refine the label as real
+        # phases complete)
+        supervise.touch_heartbeat("solver.phase.device")
         # chaos hook: the accelerator edge — an injected fault here is the
         # wedged-backend failure that cost two bench rounds, and must route
         # the solve to ResilientSolver's fallback, never stall the loop
@@ -2226,8 +2231,10 @@ class TPUSolver:
             TRACER.add_span(f"solver.phase.{name}", t_phase, now, **attrs)
             t_phase = now
             # progress proof for the dispatch watchdog (ResilientSolver /
-            # bench stage supervisor): a wedged dispatch stops marking
-            supervise.touch_heartbeat()
+            # bench stage supervisor): a wedged dispatch stops marking.
+            # The label names the phase just finished, so a later wedge
+            # verdict reports the last phase activity seen (ISSUE 15)
+            supervise.touch_heartbeat(f"solver.phase.{name}")
 
         from karpenter_core_tpu.ops import compat as ops_compat
 
@@ -2326,6 +2333,10 @@ class TPUSolver:
             run_args = args
 
         t_dispatch = _time.perf_counter()
+        # re-label the heartbeat for the long silent stretch ahead: a wedge
+        # inside the XLA compile/execute block must name the device phase,
+        # not the last completed host-side mark (upload/prescreen)
+        supervise.touch_heartbeat("solver.phase.device")
         # opt-in device profiling around the Solve dispatch (obs.device_
         # profiler, KARPENTER_TPU_PROFILE) — the analog of the reference's
         # pprof-profiled benchmark capture (scheduling_benchmark_test.go:

@@ -486,6 +486,13 @@ for _key, _reason in {
         "written under FlightRecorder._mu at enable time, read at dump "
         "time; dumps are best-effort by contract"
     ),
+    "Tracer.enabled": (
+        "the tracer's own 'disabled = one flag check' gate, read on every "
+        "span()/add_span()/instant() call site AND per host dispatch "
+        "(frame trace-key gate, ISSUE 15); writes latch under Tracer._mu "
+        "(enable/disable); a stale read costs one span recorded or "
+        "skipped at the arm/disarm boundary"
+    ),
 }.items():
     GLOBAL.suppress(_key, _reason)
 

@@ -54,14 +54,36 @@ class Heartbeat:
     """File-based heartbeat: the worker calls touch() at progress points;
     the supervisor reads age(). The file's mtime is the signal — wall
     clock, because worker and supervisor are different processes and the
-    filesystem is the only clock they share."""
+    filesystem is the only clock they share.
+
+    touch() optionally carries a LABEL (the current span/phase name, e.g.
+    ``solver.phase.device``) written as the file's content, so a wedge
+    verdict can name the phase the worker died in instead of just an age
+    (ISSUE 15). A label-less touch preserves the previous label — phase
+    marks label, routine progress ticks don't."""
 
     def __init__(self, path: str):
         self.path = path
 
-    def touch(self) -> None:
+    def touch(self, label: Optional[str] = None) -> None:
+        if label is not None:
+            # plain overwrite by design (this module is the audited
+            # atomic-write funnel): the label is one short line, a reader
+            # catching the torn window degrades to "no label", and a
+            # rename-per-touch would churn an inode per phase mark
+            with open(self.path, "w") as f:
+                f.write(label[:256])
+            return
         with open(self.path, "a"):
             os.utime(self.path, None)
+
+    def read_label(self) -> str:
+        """The last labeled touch's phase name ('' when none/unreadable)."""
+        try:
+            with open(self.path, "rb") as f:
+                return f.read(512).decode("utf-8", errors="replace").strip()
+        except OSError:
+            return ""
 
     def age(self) -> Optional[float]:
         """Seconds since the last touch, or None when never touched."""
@@ -75,16 +97,24 @@ class Heartbeat:
 class ThreadHeartbeat:
     """In-process heartbeat for thread watchdogs (ResilientSolver): the
     dispatch thread touches it at phase boundaries, the watchdog thread
-    reads the age. Monotonic by default; `clock` is injectable for tests."""
+    reads the age. Monotonic by default; `clock` is injectable for tests.
+    Carries the same optional phase label as the file Heartbeat."""
 
     def __init__(self, clock: Optional[Callable[[], float]] = None):
         self._clock = clock or time.monotonic
         self._mu = threading.Lock()
         self._last: Optional[float] = None
+        self._label = ""
 
-    def touch(self) -> None:
+    def touch(self, label: Optional[str] = None) -> None:
         with self._mu:
             self._last = self._clock()
+            if label is not None:
+                self._label = label
+
+    def label(self) -> str:
+        with self._mu:
+            return self._label
 
     def age(self) -> Optional[float]:
         with self._mu:
@@ -124,12 +154,12 @@ def bind_heartbeat(hb: Optional[ThreadHeartbeat]) -> None:
     _TLS.heartbeat = hb
 
 
-def touch_heartbeat() -> None:
+def touch_heartbeat(label: Optional[str] = None) -> None:
     hb = getattr(_TLS, "heartbeat", None)
     if hb is not None:
-        hb.touch()
+        hb.touch(label)
     if _PROCESS_HB is not None:
-        _PROCESS_HB.touch()
+        _PROCESS_HB.touch(label)
 
 
 def bound_heartbeat() -> Optional[ThreadHeartbeat]:
@@ -197,6 +227,9 @@ class SuperviseResult:
     stdout_tail: str = ""
     stderr_tail: str = ""
     note: str = ""
+    # the worker heartbeat's last phase label at the kill (ISSUE 15): a
+    # wedge verdict names WHERE the worker died, not just how stale it was
+    phase: str = ""
     attempts: List[str] = field(default_factory=list)
     # the environment the worker ran with (redaction source): secrets the
     # SUPERVISOR never had must still not leak through the captured tails
@@ -211,6 +244,7 @@ class SuperviseResult:
             "timed_out": self.timed_out,
             "rc": self.rc,
             "restarts": self.restarts,
+            "phase": self.phase,
             "stdout_tail": redact_env_text(self.stdout_tail, self.environ),
             "stderr_tail": redact_env_text(self.stderr_tail, self.environ),
         }
@@ -272,16 +306,21 @@ def _run_once(
                          else now - start) >= stale_after_s
                 ):
                     res.wedged = True
+                    res.phase = heartbeat.read_label()
                     res.note = (
                         f"wedged: heartbeat stale for "
                         f"{hb_age if hb_age is not None else now - start:.0f}s "
-                        f"(threshold {stale_after_s:.0f}s); process group killed"
+                        f"(threshold {stale_after_s:.0f}s)"
+                        + (f" during {res.phase}" if res.phase else "")
+                        + "; process group killed"
                     )
                     _kill_group(proc)
                     res.rc = proc.poll()
                     break
                 if now >= deadline:
                     res.timed_out = True
+                    if heartbeat is not None:
+                        res.phase = heartbeat.read_label()
                     res.note = (
                         f"timed out: still alive at {timeout_s:.0f}s budget "
                         "(heartbeat fresh — slow, not wedged); "

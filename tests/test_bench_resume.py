@@ -256,3 +256,100 @@ def test_stage_config_digests_are_stage_distinct():
     assert len(set(digests.values())) == len(digests), (
         "every stage must key its own artifact"
     )
+
+
+# ---------------------------------------------------------------------------
+# round timeline (ISSUE 15): BENCH_timeline.json stitched purely from the
+# artifacts — stage slices, worker trace fragments, wedge/resume markers
+
+
+def _fragment(pid=4242):
+    """A stage worker's wall-anchored chrome-trace fragment: events are in
+    the worker's perf-counter timebase (µs since its tracer t0); the
+    anchor pair lets the merge rebase them onto the wall clock."""
+    return {
+        "wall_anchor_s": 130.0, "anchor_ts_us": 5e6, "pid": pid,
+        "events": [
+            {"name": "solver.phase.device", "ph": "X", "ts": 4e6,
+             "dur": 2e5, "pid": pid, "tid": 1, "args": {}},
+            {"name": "bench.heartbeat", "ph": "i", "s": "p", "ts": 4.1e6,
+             "pid": pid, "tid": 1, "args": {}},
+        ],
+        "dropped": 3,
+    }
+
+
+def _timeline_round(store):
+    for name in bench.STAGE_NAMES:
+        cfg = bench.stage_config(name)
+        if name == "consolidation":
+            store.save(
+                name, cfg, None, degraded=True, error="wedged",
+                wedge_log={"note": "wedged: heartbeat stale for 31s "
+                                   "during solver.phase.device; "
+                                   "process group killed",
+                           "wedged": True, "timed_out": False,
+                           "phase": "solver.phase.device",
+                           "stdout_tail": "", "stderr_tail": ""},
+                meta={"started_ts": 100.0, "ended_ts": 131.0},
+            )
+        elif name == "grid":
+            store.save(name, cfg, {"v": 1},
+                       meta={"started_ts": 140.0, "ended_ts": 150.0,
+                             "resumed": True})
+        else:
+            store.save(name, cfg, {"v": 1},
+                       meta={"started_ts": 90.0, "ended_ts": 130.0,
+                             "trace": _fragment()})
+
+
+def test_timeline_stitches_stages_fragments_and_markers(store):
+    _timeline_round(store)
+    tl = bench.build_timeline(store)
+    events = tl["traceEvents"]
+    names = [e["name"] for e in events]
+    # one orchestrator slice per stage that ran
+    for name in bench.STAGE_NAMES:
+        if name != "consolidation":
+            assert f"bench.stage.{name}" in names
+    # the chaos-wedged stage's kill is VISIBLE, naming the phase
+    kill = next(e for e in events if e["name"] == "bench.wedge.SIGKILL")
+    assert kill["ph"] == "i"
+    assert kill["args"]["stage"] == "consolidation"
+    assert kill["args"]["phase"] == "solver.phase.device"
+    assert kill["ts"] == (131.0 - 90.0) * 1e6
+    # resume backfill marker on the resumed stage
+    backfill = next(
+        e for e in events if e["name"] == "bench.resume.backfill"
+    )
+    assert backfill["args"]["stage"] == "grid"
+    # worker fragments rebase onto the wall clock and keep their pid row:
+    # wall anchor 130 -> 40e6µs after base 90; offset 40e6-5e6 = 35e6
+    dev = next(e for e in events if e["name"] == "solver.phase.device")
+    assert dev["ts"] == 4e6 + 35e6
+    assert dev["pid"] == 4242
+    assert any(e["name"] == "bench.heartbeat" for e in events)
+    # fragment truncation stays visible
+    assert tl["otherData"]["dropped_events"] >= 3
+    assert tl["otherData"]["stages"]["consolidation"] == "degraded"
+
+
+def test_timeline_is_byte_stable_across_remerges(store):
+    _timeline_round(store)
+    a = json.dumps(bench.build_timeline(store), sort_keys=True)
+    b = json.dumps(bench.build_timeline(store), sort_keys=True)
+    assert a == b
+
+
+def test_timeline_tolerates_missing_meta_and_empty_store(store):
+    # empty store: a valid, empty-ish timeline (orchestrator row only)
+    tl = bench.build_timeline(store)
+    assert [e["name"] for e in tl["traceEvents"]] == ["process_name"]
+    # artifacts with no timing meta (old rounds) still merge
+    for name in bench.STAGE_NAMES:
+        store.save(name, bench.stage_config(name), {"v": 1})
+    tl = bench.build_timeline(store)
+    assert tl["otherData"]["stages"]["headline"] == "ok"
+    assert not any(
+        e["name"].startswith("bench.stage.") for e in tl["traceEvents"]
+    )

@@ -200,3 +200,57 @@ def test_debug_health_surfaces_solver_host_state():
         assert host["admission"]["shed"] == {"queue_full": 1}
     finally:
         server.shutdown()
+
+
+def test_debug_timeline_served_with_flight_record_index(health_server):
+    """/debug/timeline (ISSUE 15): the Perfetto trace plus the trace-id ->
+    flight-record digest index, so a timeline span links to the
+    replayable inputs of its solve."""
+    from karpenter_core_tpu.obs import TRACER
+    from karpenter_core_tpu.obs.flightrec import FLIGHTREC
+    from karpenter_core_tpu.solver.fallback import ResilientSolver
+    from karpenter_core_tpu.solver.tpu_solver import GreedySolver
+    from karpenter_core_tpu.testing import make_pod, make_provisioner
+
+    _operator, port = health_server
+    was_enabled = FLIGHTREC.enabled
+    TRACER.enable()
+    FLIGHTREC.enable()
+    try:
+        solver = ResilientSolver(
+            GreedySolver(), GreedySolver(), small_batch_work_max=0
+        )
+        # the record adopts the live trace id, like a real reconcile's
+        with TRACER.span("provisioner.reconcile"):
+            solver.solve(
+                [make_pod(requests={"cpu": "1"})],
+                [make_provisioner(name="default")],
+                {"default": fake.instance_types(2)},
+            )
+        status, body = _get(port, "/debug/timeline")
+        assert status == 200
+        timeline = json.loads(body)
+        index = timeline["otherData"]["flight_records"]
+        record = FLIGHTREC.last()
+        assert record and record["trace_id"] in index
+        assert index[record["trace_id"]] == record["digest"]
+    finally:
+        TRACER.disable()
+        if not was_enabled:
+            FLIGHTREC.disable()
+        FLIGHTREC.clear()
+
+
+def test_debug_timeline_gated_on_profiling():
+    from karpenter_core_tpu.operator import __main__ as entry, new_operator
+
+    operator = new_operator(
+        fake.FakeCloudProvider(), settings=entry.settings_from_env()
+    )
+    server = entry.serve_health(operator, 0, profiling=False)
+    try:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(server.server_address[1], "/debug/timeline")
+        assert err.value.code == 404
+    finally:
+        server.shutdown()

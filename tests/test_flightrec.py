@@ -565,3 +565,57 @@ def test_live_operator_solve_replays_byte_identical(monkeypatch, recorder):
     assert placements_json(replayed) == placements_json(
         record["outcome"]["placements"]
     )
+
+
+def test_exemplar_links_metric_to_trace_to_flight_record():
+    """ISSUE 15: the solve-duration histogram's exemplar carries the trace
+    id; the flight recorder resolves that id back to the replayable
+    record — metric -> trace -> flight record, round-tripped."""
+    from karpenter_core_tpu.cloudprovider import fake
+    from karpenter_core_tpu.metrics.registry import REGISTRY
+    from karpenter_core_tpu.obs import TRACER
+    from karpenter_core_tpu.obs import flightrec as flightrec_mod
+    from karpenter_core_tpu.obs.tracer import SOLVER_SOLVE_DURATION
+    from karpenter_core_tpu.solver.fallback import ResilientSolver
+    from karpenter_core_tpu.solver.tpu_solver import GreedySolver, TPUSolver
+    from karpenter_core_tpu.testing import make_pod, make_provisioner
+
+    recorder = flightrec_mod.FLIGHTREC
+    was_enabled = recorder.enabled
+    TRACER.enable()
+    recorder.enable()
+    try:
+        solver = ResilientSolver(
+            TPUSolver(max_nodes=32), GreedySolver(),
+            prober=lambda: None, small_batch_work_max=0,
+        )
+        with TRACER.span("provisioner.reconcile"):
+            solver.solve(
+                [make_pod(requests={"cpu": "1"}) for _ in range(8)],
+                [make_provisioner(name="default")],
+                {"default": fake.instance_types(4)},
+            )
+        record = recorder.last()
+        assert record is not None and record.get("trace_id")
+        # the histogram's provisioning series carries an exemplar with
+        # the SAME trace id (the bridge attaches it on span completion)
+        lv = (("context", "provisioning"),)
+        exemplars = SOLVER_SOLVE_DURATION.exemplars.get(lv, {})
+        assert exemplars, "solve-duration histogram carries no exemplar"
+        (labels, _value) = list(exemplars.values())[-1]
+        assert labels["trace_id"] == record["trace_id"]
+        # and the OpenMetrics-negotiated exposition renders it on the
+        # bucket line (the default 0.0.4 form never carries exemplars —
+        # they would fail a stock scraper)
+        assert f'trace_id="{record["trace_id"]}"' in REGISTRY.expose(
+            exemplars=True
+        )
+        assert "# {trace_id=" not in REGISTRY.expose()
+        # the chain closes: exemplar trace id -> flight record
+        assert recorder.record_for_trace(labels["trace_id"]) == record
+        assert recorder.record_for_trace("t-nope") is None
+    finally:
+        TRACER.disable()
+        if not was_enabled:
+            recorder.disable()
+        recorder.clear()

@@ -179,3 +179,125 @@ def test_histogram_snapshot_before_first_observation():
     h.observe(0.5)
     assert h.count_since(base) == 1
     assert h.percentile(0.5, baseline=base) == 1
+
+
+# -- cross-process merge (ISSUE 15) ------------------------------------------
+
+
+def test_histogram_series_snapshot():
+    h = Histogram("t_h", buckets=[0.1, 1])
+    h.observe(0.05, {"phase": "device"})
+    h.observe(5, {"phase": "device"})
+    ((labels, state),) = h.series()
+    assert labels == {"phase": "device"}
+    assert state == {"buckets": [1, 1], "sum": 5.05, "count": 2}
+
+
+def test_exemplar_renders_only_under_openmetrics_opt_in():
+    r = Registry()
+    h = r.histogram("t_lat", "Latency", buckets=[0.1, 1])
+    h.observe(0.05)
+    h.observe(0.5, exemplar={"trace_id": "t0000002a"})
+    # the DEFAULT exposition stays pure 0.0.4: a stock Prometheus parser
+    # reads an exemplar suffix as a malformed timestamp and fails the
+    # whole scrape — exemplars are only reachable via content negotiation
+    base = r.expose()
+    assert "# {trace_id=" not in base
+    text = r.expose(exemplars=True)
+    assert 't_lat_bucket{le="1"} 2 # {trace_id="t0000002a"} 0.5' in text
+    # only the bucket the exemplar landed in carries it
+    assert text.count("# {trace_id=") == 1
+
+
+def test_external_source_merges_under_one_family_header():
+    r = Registry()
+    c = r.counter("t_req", "Total requests")
+    c.inc({"code": "200"})
+
+    class Source:
+        def families(self):
+            return {
+                "t_req": {
+                    "kind": "counter", "help": "Total requests",
+                    "series": [[{"code": "200", "process": "child"}, 7.0]],
+                },
+                "t_child_only": {
+                    "kind": "histogram", "help": "child hist",
+                    "buckets": [1, 2],
+                    "series": [[
+                        {"process": "child"},
+                        {"buckets": [1, 2], "sum": 3.0, "count": 2},
+                    ]],
+                },
+            }
+
+    r.add_external(Source())
+    text = r.expose()
+    # ONE header per family name, local series first, external after
+    assert text.count("# TYPE t_req counter") == 1
+    assert text.index('t_req{code="200"} 1') < text.index(
+        't_req{code="200",process="child"} 7'
+    )
+    # external-only family gets its own header + full histogram rendering
+    assert "# TYPE t_child_only histogram" in text
+    assert 't_child_only_bucket{process="child",le="2"} 2' in text
+    assert 't_child_only_count{process="child"} 2' in text
+
+
+def test_external_source_failure_never_breaks_expose():
+    r = Registry()
+    r.counter("t_ok").inc()
+
+    class Sick:
+        def families(self):
+            raise RuntimeError("boom")
+
+    r.add_external(Sick())
+    assert "t_ok 1" in r.expose()
+
+
+def test_process_series_merger_idempotent_and_respawn_safe():
+    from karpenter_core_tpu.metrics.registry import ProcessSeriesMerger
+
+    def snap(n, hist_count):
+        return {
+            "k_solves": {"kind": "counter", "help": "",
+                         "series": [[{}, float(n)]]},
+            "k_hist": {
+                "kind": "histogram", "help": "", "buckets": [1, 2],
+                "series": [[
+                    {"phase": "device"},
+                    {"buckets": [hist_count, hist_count],
+                     "sum": 0.5 * hist_count, "count": hist_count},
+                ]],
+            },
+        }
+
+    m = ProcessSeriesMerger("solver-host")
+
+    def totals():
+        fams = m.families()
+        (c_labels, c_val), = fams["k_solves"]["series"]
+        (h_labels, h_state), = fams["k_hist"]["series"]
+        assert c_labels == {"process": "solver-host"}
+        assert h_labels == {"phase": "device", "process": "solver-host"}
+        return c_val, h_state["count"]
+
+    # cumulative snapshots REPLACE the live view: re-ingest is a no-op
+    m.ingest(1, snap(3, 3))
+    m.ingest(1, snap(3, 3))
+    assert totals() == (3.0, 3)
+    m.ingest(1, snap(5, 5))
+    assert totals() == (5.0, 5)
+    # generation bump folds the dead child's last snapshot exactly once
+    m.retire(1)
+    m.retire(1)  # idempotent
+    assert totals() == (5.0, 5)
+    m.ingest(2, snap(2, 2))
+    assert totals() == (7.0, 7)
+    # an UNSEEN generation's retire is a no-op
+    m.retire(1)
+    assert totals() == (7.0, 7)
+    # implicit fold: a new generation ingested without an explicit retire
+    m.ingest(3, snap(1, 1))
+    assert totals() == (8.0, 8)

@@ -143,7 +143,11 @@ def test_chrome_trace_shape(tracer, tmp_path):
     path = tracer.export_chrome_trace(str(tmp_path / "trace.json"))
     with open(path) as f:
         trace = json.load(f)  # round-trips
-    events = trace["traceEvents"]
+    # one process_name metadata row per pid (ISSUE 15 multi-process
+    # timeline); the span events themselves stay complete-'X' shaped
+    meta = [e for e in trace["traceEvents"] if e["ph"] == "M"]
+    assert [m["name"] for m in meta] == ["process_name"]
+    events = [e for e in trace["traceEvents"] if e["ph"] != "M"]
     assert len(events) == 2
     for e in events:
         # complete events: every one carries ph='X' AND a dur (the
@@ -307,3 +311,152 @@ def test_service_adopts_propagated_trace_id():
     finally:
         TRACER.disable()
         TRACER.clear()
+
+
+# -- cross-process graft (ISSUE 15) ------------------------------------------
+
+
+def _payload_from(src: Tracer):
+    from karpenter_core_tpu.obs.tracer import export_spans
+
+    return export_spans(src.spans())
+
+
+def test_instant_event_renders_as_perfetto_marker(tracer):
+    tracer.instant("solver.host.kill", kind="wedged",
+                   phase="solver.phase.device")
+    trace = tracer.chrome_trace()
+    ev = next(e for e in trace["traceEvents"]
+              if e["name"] == "solver.host.kill")
+    assert ev["ph"] == "i" and ev["s"] == "p"
+    assert "dur" not in ev
+    assert ev["args"]["phase"] == "solver.phase.device"
+
+
+def test_export_spans_shape_and_caps():
+    src = Tracer(capacity=1024).enable()
+    with src.span("solver.host.dispatch"):
+        for i in range(10):
+            with src.span(f"solver.phase.p{i}", idx=i):
+                pass
+    from karpenter_core_tpu.obs.tracer import export_spans
+
+    payload = export_spans(src.spans())
+    assert payload["pid"] and payload["now_ns"] > 0
+    assert len(payload["spans"]) == 11 and payload["dropped"] == 0
+    # count cap keeps the NEWEST spans and counts the overflow
+    capped = export_spans(src.spans(), max_spans=4)
+    assert len(capped["spans"]) == 4
+    assert capped["dropped"] == 7
+    names = [e["n"] for e in capped["spans"]]
+    assert "solver.host.dispatch" in names  # the last-finished span
+    # byte cap drops oldest-first too
+    tiny = export_spans(src.spans(), max_bytes=300)
+    assert tiny["spans"] and len(tiny["spans"]) < 11
+    assert tiny["dropped"] == 11 - len(tiny["spans"])
+
+
+def test_graft_rehomes_under_current_span(tracer):
+    child = Tracer(capacity=256).enable()
+    with child.span("solver.host.dispatch"):
+        with child.span("solver.phase.device", compile_cache="hit"):
+            pass
+    payload = _payload_from(child)
+    with tracer.span("solver.host.request") as req:
+        n = tracer.graft(payload, pid=4242, generation=3)
+    assert n == 2
+    spans = {s.name: s for s in tracer.spans()}
+    disp, dev = spans["solver.host.dispatch"], spans["solver.phase.device"]
+    # the child's internal structure is preserved; its root hangs off the
+    # live parent span; everything joins the parent's trace
+    assert disp.parent_id == req.span_id
+    assert dev.parent_id == disp.span_id
+    assert disp.trace_id == req.trace_id == dev.trace_id
+    for s in (disp, dev):
+        assert s.attrs["pid"] == 4242 and s.attrs["generation"] == 3
+    assert dev.attrs["compile_cache"] == "hit"
+    # timestamps are rebased into this process's perf_counter timebase:
+    # the grafted span must land within the enclosing request span's
+    # neighborhood, not at the child's raw offsets
+    assert abs(dev.end_ns - req.end_ns) < 5_000_000_000
+
+
+def test_graft_respects_cap_and_counts_drops(tracer):
+    entries = [
+        {"n": f"solver.phase.x{i}", "i": i + 1, "t": "tc", "s": 0, "e": 1,
+         "d": 1}
+        for i in range(Tracer.MAX_GRAFT_SPANS + 20)
+    ]
+    payload = {"pid": 1, "now_ns": 0, "spans": entries, "dropped": 5}
+    n = tracer.graft(payload, generation=1)
+    assert n == Tracer.MAX_GRAFT_SPANS
+    assert tracer.graft_dropped == 20 + 5
+    assert tracer.grafted == Tracer.MAX_GRAFT_SPANS
+    # truncation is visible in the chrome export
+    assert tracer.chrome_trace()["otherData"]["graft_dropped"] == 25
+
+
+def test_graft_respects_bounded_ring():
+    t = Tracer(capacity=8).enable()
+    entries = [
+        {"n": f"s{i}", "i": i + 1, "t": "tc", "s": 0, "e": 1, "d": 1}
+        for i in range(20)
+    ]
+    t.graft({"pid": 1, "now_ns": 0, "spans": entries, "dropped": 0})
+    assert len(t.spans()) == 8  # never grows past the ring
+    assert t.dropped == 12  # evictions counted like native spans
+
+
+def test_graft_disabled_and_malformed_are_safe(tracer):
+    disabled = Tracer()
+    assert disabled.graft({"spans": [{"n": "x"}]}) == 0
+    assert tracer.graft(None) == 0
+    # malformed entries are counted, not raised
+    n = tracer.graft(
+        {"pid": 1, "now_ns": 0, "dropped": 0,
+         "spans": [{"n": "ok", "i": 1, "t": "t", "s": 0, "e": 1, "d": 1},
+                   {"broken": True}]}
+    )
+    assert n == 1
+    assert tracer.graft_dropped == 1
+
+
+def test_grafted_spans_skip_the_metrics_bridge(tracer):
+    from karpenter_core_tpu.obs.tracer import SOLVER_PHASE_DURATION
+
+    before = SOLVER_PHASE_DURATION.counts.get(
+        (("phase", "device"),), 0
+    )
+    tracer.graft(
+        {"pid": 1, "now_ns": 0, "dropped": 0,
+         "spans": [{"n": "solver.phase.device", "i": 1, "t": "t",
+                    "s": 0, "e": 1_000_000, "d": 1}]}
+    )
+    after = SOLVER_PHASE_DURATION.counts.get((("phase", "device"),), 0)
+    assert after == before  # the child already observed its instruments
+
+
+def test_spill_writes_salvageable_payload(tmp_path):
+    import json as _json
+
+    t = Tracer(capacity=256).enable()
+    spill = str(tmp_path / "hb.spans")
+    t.set_spill(spill)
+    with t.span("solver.phase.prescreen"):
+        pass
+    with t.span("solver.phase.device"):
+        pass
+    with open(spill) as f:
+        payload = _json.load(f)
+    assert [e["n"] for e in payload["spans"]] == [
+        "solver.phase.prescreen", "solver.phase.device"
+    ]
+    # the payload grafts like a live frame's
+    dst = Tracer(capacity=256).enable()
+    assert dst.graft(payload, generation=2, salvaged=True) == 2
+    assert all(s.attrs["salvaged"] for s in dst.spans())
+    # reset clears ring AND file (dispatch-start contract: a later kill
+    # never re-salvages already-delivered spans)
+    t.reset_spill()
+    assert not (tmp_path / "hb.spans").exists()
+    t.set_spill(None)

@@ -579,3 +579,49 @@ def test_host_fallback_throughput_floor():
     assert pods_per_sec >= FLOOR, (
         f"host fallback {pods_per_sec:.0f} pods/sec < floor {FLOOR:.0f}"
     )
+
+
+def test_span_export_disabled_path_cost(monkeypatch):
+    """ISSUE 15 tripwire: with tracing off, the solver-host dispatch adds
+    ZERO frame bytes (no trace key — asserted end-to-end against a live
+    child in test_solver_host) and the per-dispatch gate is ONE flag
+    check; the frame-side export caps stay wired to the parent's graft
+    cap so a chatty child is bounded at BOTH ends."""
+    import timeit
+
+    from karpenter_core_tpu.obs.tracer import (
+        MAX_EXPORT_BYTES,
+        MAX_EXPORT_SPANS,
+        Tracer,
+        export_spans,
+    )
+
+    # cap-and-count contract: frame-side caps mirror the graft-side cap
+    assert MAX_EXPORT_SPANS <= Tracer.MAX_GRAFT_SPANS
+    assert MAX_EXPORT_BYTES <= 1 << 20
+
+    # the disabled dispatch gate is `if TRACER.enabled:` — a disabled
+    # graft/export round must cost one check, no allocation
+    t = Tracer()
+    n = 200_000
+    baseline = timeit.timeit("f()", globals={"f": lambda: None}, number=n)
+    t_gate = timeit.timeit(
+        "t.enabled and None", globals={"t": t}, number=n
+    )
+    assert t_gate < baseline * 20 + 0.5, (
+        f"disabled span-export gate {t_gate / n * 1e9:.0f}ns/call"
+    )
+    assert t.graft({"spans": [{"n": "x"}]}) == 0  # disabled graft: no-op
+
+    # export itself is bounded: a pathological span flood exports at most
+    # MAX_EXPORT_SPANS entries / MAX_EXPORT_BYTES bytes, counted
+    import json as _json
+
+    src = Tracer(capacity=4096).enable()
+    for i in range(MAX_EXPORT_SPANS + 100):
+        with src.span(f"solver.phase.p{i % 7}"):
+            pass
+    payload = export_spans(src.spans())
+    assert len(payload["spans"]) <= MAX_EXPORT_SPANS
+    assert payload["dropped"] >= 100
+    assert len(_json.dumps(payload)) < MAX_EXPORT_BYTES + 4096

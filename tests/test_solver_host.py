@@ -435,3 +435,228 @@ def test_host_deadline_propagates_to_dispatch(host):
         host.queue_deadline_s = was
         release.set()
         t.join(5)
+
+
+# ---------------------------------------------------------------------------
+# cross-process observability (ISSUE 15): span graft + merged metrics
+
+
+def _phase_set(spans):
+    return {
+        s.name for s in spans if s.name.startswith("solver.phase.")
+    }
+
+
+def test_host_graft_phase_set_parity_and_budget():
+    """One host-mode solve grafts the CHILD's solver.phase.* spans under
+    solver.host.request (tagged pid/generation), and the union phase SET
+    equals an in-process solve's of the same workload — the acceptance
+    bar. The per-solve graft stays inside a small budget (satellite)."""
+    from karpenter_core_tpu.obs import TRACER
+
+    TRACER.enable()
+    TRACER.clear()
+    hs = HostSolver(
+        max_nodes=32, child_env=CHILD_ENV,
+        spawn_timeout=120.0, solve_timeout=120.0,
+    )
+    try:
+        pods, provisioners, its = _workload()
+        hs.solve(pods, provisioners, its)
+        spans = TRACER.spans()
+        host_phases = _phase_set(spans)
+        grafted = [
+            s for s in spans
+            if s.attrs.get("generation") is not None
+            and not s.attrs.get("instant")
+        ]
+        child_phases = _phase_set(grafted)
+        assert "solver.phase.device" in child_phases
+        assert "solver.phase.prescreen" in child_phases
+        req = next(s for s in spans if s.name == "solver.host.request")
+        disp = next(
+            s for s in grafted if s.name == "solver.host.dispatch"
+        )
+        assert disp.parent_id == req.span_id
+        assert disp.trace_id == req.trace_id
+        assert all(
+            isinstance(s.attrs.get("pid"), int) for s in grafted
+        )
+        # grafted-span budget per solve: a solve is ~a dozen phases, not
+        # an unbounded stream — the frame/graft caps are the hard wall,
+        # this is the regression tripwire for chattiness creep
+        assert len(grafted) <= 32
+        # phase-set parity vs in-process
+        TRACER.clear()
+        TPUSolver(max_nodes=32).solve(pods, provisioners, its)
+        assert host_phases == _phase_set(TRACER.spans())
+    finally:
+        hs.close()
+        TRACER.disable()
+        TRACER.clear()
+
+
+def test_host_metrics_merge_idempotent_across_respawn():
+    """Child counter/histogram snapshots merge under process="solver-host"
+    with NO double counting: re-ingesting a cumulative snapshot is a
+    no-op, and a kill->respawn folds the dead generation's last snapshot
+    exactly once (the respawn counts from zero on top)."""
+    from karpenter_core_tpu.metrics.registry import REGISTRY
+    from karpenter_core_tpu.obs import TRACER
+
+    # phase histograms ride the span bridge, so the child populates them
+    # only when tracing is armed (the operator default) — spawn with it on
+    TRACER.enable()
+    hs = HostSolver(
+        max_nodes=32, child_env=CHILD_ENV,
+        spawn_timeout=120.0, solve_timeout=120.0,
+    )
+
+    def device_count():
+        fam = hs.host.metrics.families().get(
+            "karpenter_solver_phase_duration_seconds"
+        )
+        if not fam:
+            return 0
+        for labels, state in fam["series"]:
+            if labels.get("phase") == "device":
+                assert labels["process"] == "solver-host"
+                return state["count"]
+        return 0
+
+    try:
+        pods, provisioners, its = _workload()
+        hs.solve(pods, provisioners, its)
+        hs.solve(pods, provisioners, its)
+        assert device_count() == 2
+        # re-ingesting the same cumulative snapshot must not inflate
+        hs.host.stats()
+        hs.host.stats()
+        assert device_count() == 2
+        # the merged series ride the ONE parent exposition
+        assert 'process="solver-host"' in REGISTRY.expose()
+        # kill -> respawn: dead generation folds once, successor counts
+        # from zero on top
+        os.kill(hs.host.pid, signal.SIGKILL)
+        time.sleep(0.1)
+        hs.solve(pods, provisioners, its)
+        assert device_count() == 3
+        hs.host.stats()
+        assert device_count() == 3
+    finally:
+        hs.close()
+        TRACER.disable()
+        TRACER.clear()
+    # close() unregisters THIS host's exposition source (another live
+    # HostSolver — e.g. the module fixture's — may still be registered)
+    assert hs.host.metrics not in REGISTRY._externals
+
+
+def test_wedge_salvages_child_spans_and_names_phase():
+    """A mid-dispatch kill grafts the child's span spill (the phases it
+    finished before going silent, tagged salvaged) and lands a
+    solver.host.kill instant event naming the phase — the wedge
+    post-mortem's timeline story."""
+    import threading as _threading
+
+    from karpenter_core_tpu.obs import TRACER
+    from karpenter_core_tpu.obs.tracer import Tracer, export_spans
+    from karpenter_core_tpu.utils import supervise as _supervise
+
+    TRACER.enable()
+    TRACER.clear()
+    hs = HostSolver(
+        max_nodes=32, stale_after=6.0, solve_timeout=90.0,
+        spawn_timeout=120.0,
+        child_env={
+            **CHILD_ENV,
+            "KARPENTER_CHAOS":
+                "solver.device.hang=error:none,latency:30,times:1,after:1",
+        },
+    )
+    try:
+        pods, provisioners, its = _workload()
+        hs.solve(pods, provisioners, its)  # warm; arms the second dispatch
+        box = {}
+
+        def run():
+            try:
+                hs.solve(pods, provisioners, its)
+            except BaseException as e:  # noqa: BLE001
+                box["error"] = e
+
+        t = _threading.Thread(target=run, daemon=True, name="wedge-solve")
+        t.start()
+        # while the child hangs mid-dispatch, stand in for the spans it
+        # would have spilled before the wedge (the spill-write half is
+        # proven in test_obs_tracer; the hang chaos fires before the
+        # first phase mark, so the real ring is empty here)
+        time.sleep(2.0)
+        scratch = Tracer(capacity=32).enable()
+        with scratch.span("solver.phase.prescreen"):
+            pass
+        _supervise.atomic_write_json(
+            hs.host._spill_path(), export_spans(scratch.spans())
+        )
+        t.join(timeout=60)
+        assert isinstance(box.get("error"), SolverWedgedError)
+        assert "during solver.phase.device" in str(box["error"])
+        spans = TRACER.spans()
+        kill = next(
+            s for s in spans
+            if s.name == "solver.host.kill"
+            and s.attrs.get("kind") == "wedged"
+        )
+        assert kill.attrs["phase"] == "solver.phase.device"
+        salvaged = [s for s in spans if s.attrs.get("salvaged")]
+        assert [s.name for s in salvaged] == ["solver.phase.prescreen"]
+        assert salvaged[0].attrs["generation"] == 1
+        # salvage is once-only: the spill file is consumed
+        assert not os.path.exists(hs.host._spill_path() or "/nonexistent")
+        # /debug/health names the phase too
+        assert hs.host.report()["last_kill"]["phase"] == "solver.phase.device"
+    finally:
+        hs.close()
+        TRACER.disable()
+        TRACER.clear()
+
+
+def test_span_export_off_means_untouched_frames(monkeypatch):
+    """Tracing off => the request frame header is BYTE-IDENTICAL to the
+    pre-graft protocol (no trace key, no span payload): the disabled path
+    costs one enabled-check per dispatch and zero frame bytes."""
+    from karpenter_core_tpu.obs import TRACER
+    from karpenter_core_tpu.solver import host as host_mod
+
+    captured = []
+    real_write = host_mod._write_frame
+
+    def spy(stream, header, body=b""):
+        captured.append(dict(header))
+        return real_write(stream, header, body)
+
+    monkeypatch.setattr(host_mod, "_write_frame", spy)
+    assert not TRACER.enabled
+    hs = HostSolver(
+        max_nodes=32, child_env=CHILD_ENV,
+        spawn_timeout=120.0, solve_timeout=120.0,
+    )
+    try:
+        pods, provisioners, its = _workload(4)
+        hs.solve(pods, provisioners, its)
+        solve_headers = [h for h in captured if h.get("op") == "solve"]
+        assert solve_headers
+        assert set(solve_headers[0]) == {"op", "id"}, (
+            "tracing-off dispatch must add NO header keys"
+        )
+        # enabled: exactly the trace key appears
+        captured.clear()
+        TRACER.enable()
+        try:
+            hs.solve(pods, provisioners, its)
+        finally:
+            TRACER.disable()
+        solve_headers = [h for h in captured if h.get("op") == "solve"]
+        assert set(solve_headers[0]) == {"op", "id", "trace"}
+    finally:
+        hs.close()

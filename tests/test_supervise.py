@@ -327,3 +327,63 @@ def test_sigkill_is_used_not_sigterm(tmp_path):
     )
     assert res.wedged
     assert res.rc in (-signal.SIGKILL, None)
+
+
+# ---------------------------------------------------------------------------
+# phase-labeled heartbeats (ISSUE 15): a wedge names WHERE the worker died
+
+
+def test_heartbeat_label_roundtrip(tmp_path):
+    hb = supervise.Heartbeat(str(tmp_path / "hb"))
+    assert hb.read_label() == ""
+    hb.touch("solver.phase.device")
+    assert hb.read_label() == "solver.phase.device"
+    # a label-less progress tick preserves the last label
+    hb.touch()
+    assert hb.read_label() == "solver.phase.device"
+    hb.touch("solver.phase.fetch")
+    assert hb.read_label() == "solver.phase.fetch"
+
+
+def test_thread_heartbeat_label(tmp_path):
+    hb = supervise.ThreadHeartbeat()
+    assert hb.label() == ""
+    hb.touch("solver.phase.prescreen")
+    assert hb.label() == "solver.phase.prescreen"
+    hb.touch()  # tick keeps the label
+    assert hb.label() == "solver.phase.prescreen"
+
+
+def test_touch_heartbeat_hook_labels_both_layers(tmp_path):
+    thread_hb = supervise.ThreadHeartbeat()
+    file_hb = supervise.Heartbeat(str(tmp_path / "phb"))
+    supervise.bind_heartbeat(thread_hb)
+    supervise.set_process_heartbeat(file_hb)
+    try:
+        supervise.touch_heartbeat("solver.phase.device")
+    finally:
+        supervise.bind_heartbeat(None)
+        supervise.set_process_heartbeat(None)
+    assert thread_hb.label() == "solver.phase.device"
+    assert file_hb.read_label() == "solver.phase.device"
+
+
+def test_wedge_verdict_names_the_phase(tmp_path):
+    """A worker whose last labeled touch was a phase mark dies with that
+    phase in the SuperviseResult AND the human-readable note."""
+    hb = str(tmp_path / "hb")
+    res = supervise.run_supervised(
+        _script(f"""
+            import time
+            import sys
+            sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
+            from karpenter_core_tpu.utils import supervise
+            supervise.Heartbeat({hb!r}).touch("solver.phase.device")
+            time.sleep(60)  # the wedge: silence mid-device
+        """),
+        timeout_s=30.0, heartbeat_path=hb, stale_after_s=1.0, poll_s=0.1,
+    )
+    assert res.wedged
+    assert res.phase == "solver.phase.device"
+    assert "during solver.phase.device" in res.note
+    assert res.wedge_log()["phase"] == "solver.phase.device"
