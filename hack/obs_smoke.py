@@ -13,7 +13,16 @@ The drill (~45s budget, typically much faster):
      trace ids to flight records, and the parent `/metrics` exposition
      carries the child's phase histogram under process="solver-host" with
      a trace-id exemplar on the solve-duration histogram;
-  3. `solver.device.hang` armed in the child wedges a dispatch mid-solve;
+  3. the attribution drill (ISSUE 16): the tenant-less half above must be
+     byte-clean — no `tenant="` anywhere in the exposition and no `tenant`
+     key in any dispatched frame header (the PR 15 protocol, byte for
+     byte); then two tenants solve through the sidecar and the SAME label
+     must land on the parent-process series, the merged child series
+     (under process="solver-host"), the grafted child span attributes,
+     the flight record, a per-tenant `/debug/slo` burn-rate row, and the
+     exposition exemplar must link each tenant's solve to its flight
+     record through the trace id;
+  4. `solver.device.hang` armed in the child wedges a dispatch mid-solve;
      the parent SIGKILLs the host group; acceptance: the wedge lands as a
      `solver.host.kill` instant event NAMING the phase the child died in
      (`solver.phase.device`), and the typed SolverWedgedError carries the
@@ -24,6 +33,7 @@ hack/presubmit.sh — the host-smoke/bench-smoke pattern.
 """
 import json
 import os
+import re
 import sys
 import time
 import urllib.request
@@ -47,13 +57,18 @@ def _get(port: int, path: str, accept: str = ""):
 
 
 def main() -> int:
+    import karpenter_core_tpu.solver.host as host_mod
+
     from karpenter_core_tpu.api.settings import Settings
     from karpenter_core_tpu.cloudprovider import fake
     from karpenter_core_tpu.metrics.registry import REGISTRY
-    from karpenter_core_tpu.obs import TRACER
+    from karpenter_core_tpu.obs import TRACER, reqctx
     from karpenter_core_tpu.obs.flightrec import FLIGHTREC
     from karpenter_core_tpu.operator import new_operator
-    from karpenter_core_tpu.operator.__main__ import serve_health
+    from karpenter_core_tpu.operator.__main__ import (
+        build_slo_engine,
+        serve_health,
+    )
     from karpenter_core_tpu.solver.fallback import ResilientSolver
     from karpenter_core_tpu.solver.host import HostSolver
     from karpenter_core_tpu.solver.tpu_solver import GreedySolver, TPUSolver
@@ -61,6 +76,17 @@ def main() -> int:
 
     TRACER.enable()
     FLIGHTREC.enable()
+    # frame-header spy (attribution drill): every header the parent writes
+    # to the sidecar, verbatim — proves the tenant key is absent on the
+    # tenant-less half and present on the tenanted half
+    frame_headers = []
+    real_write_frame = host_mod._write_frame
+
+    def spy_write_frame(stream, header, body=b""):
+        frame_headers.append(dict(header))
+        return real_write_frame(stream, header, body)
+
+    host_mod._write_frame = spy_write_frame
     # stale_after stays GENEROUS (60s) for the clean-solve half: a
     # drill-scale threshold kills children mid-cold-compile before the
     # persistent cache is written and livelocks (measured, PR 11 soak
@@ -83,7 +109,13 @@ def main() -> int:
     )
     op.provisioning.fallback_solver = resilient
     op.kube_client.create(make_provisioner(name="default"))
-    health = serve_health(op, 0, profiling=True, solver=resilient)
+    # the production SLO plane, wired exactly like operator/__main__.run():
+    # burn-rate gauges computed fresh on every scrape, digest on /debug/slo
+    slo_engine = build_slo_engine()
+    REGISTRY.add_external(slo_engine)
+    health = serve_health(
+        op, 0, profiling=True, solver=resilient, slo=slo_engine
+    )
     port = health.server_address[1]
 
     problems = []
@@ -186,6 +218,113 @@ def main() -> int:
                 "exemplar (or the # EOF terminator)"
             )
 
+        # -- attribution drill: the tenant-less half is byte-clean --------
+        # everything above ran with NO bound tenant and no tenant pod
+        # labels: the exposition (parent AND merged child series, SLO
+        # gauges included) must carry no tenant label at all, and no
+        # dispatched frame header may carry the key — the zero-bytes-
+        # when-unset contract, same as PR 15's `trace` key
+        if 'tenant="' in expo or 'tenant="' in om:
+            problems.append(
+                "tenant-less run leaked a tenant label into the exposition"
+            )
+        if any("tenant" in h for h in frame_headers):
+            problems.append(
+                "a tenant-less dispatch frame header carried the tenant key"
+            )
+
+        # -- attribution drill: two tenants, end to end -------------------
+        tenants = ("team-blue", "team-green")
+        mark = TRACER.mark()
+        headers_before = len(frame_headers)
+        for tenant in tenants:
+            # bind + span mirror the production call site (the scheduler
+            # wraps its solve in a span, so the flight record begun inside
+            # ResilientSolver.solve joins the same trace the dispatch
+            # thread continues — that trace id is the exemplar's payload)
+            with reqctx.bind(reqctx.RequestContext(
+                tenant=tenant, request_id=f"obs-smoke-{tenant}",
+            )), TRACER.span("scheduler.solve", pods=len(pods)):
+                resilient.solve(pods, provisioners, its)
+        sent = {
+            h["tenant"] for h in frame_headers[headers_before:]
+            if "tenant" in h
+        }
+        if sent != set(tenants):
+            problems.append(
+                f"dispatch frame headers carried tenants {sorted(sent)}, "
+                f"expected {sorted(tenants)}"
+            )
+        grafted_tenants = {
+            s.attrs.get("tenant") for s in TRACER.spans_since(mark)
+            if "generation" in s.attrs and s.attrs.get("tenant")
+        }
+        if not set(tenants) <= grafted_tenants:
+            problems.append(
+                "grafted child spans lack tenant attributes "
+                f"(saw {sorted(grafted_tenants)})"
+            )
+        expo2 = _get(port, "/metrics").decode()
+        for tenant in tenants:
+            tag = f'tenant="{tenant}"'
+            if not any(
+                tag in line and 'process="' not in line
+                for line in expo2.splitlines()
+            ):
+                problems.append(
+                    f"no parent-process series carries tenant={tenant}"
+                )
+            if not any(
+                tag in line and 'process="solver-host"' in line
+                for line in expo2.splitlines()
+            ):
+                problems.append(
+                    f"no merged child series carries tenant={tenant} under "
+                    "the process label"
+                )
+        rec_tenants = {
+            r.get("tenant") for r in FLIGHTREC.records() if r.get("tenant")
+        }
+        if not set(tenants) <= rec_tenants:
+            problems.append(
+                f"flight records attribute tenants {sorted(rec_tenants)}, "
+                f"expected {sorted(tenants)}"
+            )
+        # exemplar -> flight record: every tenant's solve must be reachable
+        # from the exposition through its exemplar trace id
+        om2 = _get(
+            port, "/metrics", accept="application/openmetrics-text"
+        ).decode()
+        linked = set()
+        for tid in set(re.findall(r'trace_id="([^"]+)"', om2)):
+            rec = FLIGHTREC.record_for_trace(tid)
+            if rec is not None and rec.get("tenant"):
+                linked.add(rec["tenant"])
+        if not set(tenants) <= linked:
+            problems.append(
+                "exposition exemplars do not link every tenant's solve to "
+                f"its flight record (linked: {sorted(linked)})"
+            )
+        slo_digest = json.loads(_get(port, "/debug/slo"))
+        burn_tenants = {
+            row["tenant"] for row in slo_digest.get("series", [])
+            if row["slo"] == "solve-duration" and row["tenant"]
+            and any(
+                (w.get("traffic") or 0) > 0 for w in row["windows"].values()
+            )
+        }
+        if not set(tenants) <= burn_tenants:
+            problems.append(
+                "/debug/slo has no per-tenant burn-rate rows with traffic "
+                f"(saw {sorted(burn_tenants)})"
+            )
+        tenants_digest = json.loads(_get(port, "/debug/tenants"))
+        if not set(tenants) <= set(tenants_digest.get("tenants", {})):
+            problems.append(
+                "/debug/tenants lacks the drilled tenants (saw "
+                f"{sorted(tenants_digest.get('tenants', {}))})"
+            )
+
         # -- wedge drill: the kill names the phase ------------------------
         # the programs are compiled and disk-cached now; a tight staleness
         # threshold is safe and keeps the drill fast
@@ -230,6 +369,7 @@ def main() -> int:
             )
         host.host.child_env.pop("KARPENTER_CHAOS", None)
     finally:
+        host_mod._write_frame = real_write_frame
         op.stop()
         host.close()
         health.shutdown()
@@ -240,8 +380,10 @@ def main() -> int:
         return 1
     print(
         "obs-smoke ok: child device phases grafted (set parity), merged "
-        "metrics under process label with trace-id exemplars, wedge kill "
-        "named solver.phase.device on the timeline"
+        "metrics under process label with trace-id exemplars, tenant "
+        "attribution end to end (frames/spans/metrics/flightrec/SLO burn "
+        "rates, tenant-less half byte-clean), wedge kill named "
+        "solver.phase.device on the timeline"
     )
     return 0
 

@@ -26,6 +26,10 @@ Passes (rule ids in parentheses):
                                     (write-temp-fsync-rename) for the
                                     resume/health/replay readers
   noprint       (no-print)        — bare print() in production code
+  metriclabels  (metric-label-keys, — instrument label key sets must be
+                 metric-tenant-guard) static literals/tracked dicts;
+                                    "tenant" values route through the
+                                    cardinality guard (obs/reqctx)
 """
 from karpenter_core_tpu.analysis.core import (  # noqa: F401
     Pass,
@@ -44,6 +48,7 @@ def all_passes():
     from karpenter_core_tpu.analysis.concurrency import ConcurrencyPass
     from karpenter_core_tpu.analysis.envdiscipline import EnvDisciplinePass
     from karpenter_core_tpu.analysis.layering import LayeringPass
+    from karpenter_core_tpu.analysis.metriclabels import MetricLabelsPass
     from karpenter_core_tpu.analysis.montime import MonotonicTimePass
     from karpenter_core_tpu.analysis.noprint import NoPrintPass
     from karpenter_core_tpu.analysis.procdiscipline import ProcessDisciplinePass
@@ -58,4 +63,5 @@ def all_passes():
         ProcessDisciplinePass(),
         AtomicWritePass(),
         NoPrintPass(),
+        MetricLabelsPass(),
     ]

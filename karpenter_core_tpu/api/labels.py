@@ -26,6 +26,10 @@ CAPACITY_TYPE_SPOT = "spot"
 CAPACITY_TYPE_ON_DEMAND = "on-demand"
 
 PROVISIONER_NAME_LABEL_KEY = f"{GROUP}/provisioner-name"
+# pod label naming the tenant a workload bills to (ISSUE 16): the
+# provisioner reads it to attribute admission-to-bind latency and solver
+# cost per tenant. NOT a scheduling constraint — purely attribution.
+TENANT_LABEL_KEY = f"{GROUP}/tenant"
 MACHINE_NAME_LABEL_KEY = f"{GROUP}/machine-name"
 LABEL_NODE_INITIALIZED = f"{GROUP}/initialized"
 LABEL_CAPACITY_TYPE = f"{GROUP}/capacity-type"

@@ -10,6 +10,7 @@ provisioner.go:301).
 from __future__ import annotations
 
 import concurrent.futures
+import contextlib
 import copy
 import threading
 import time
@@ -30,6 +31,7 @@ from karpenter_core_tpu.controllers.provisioning.volumetopology import VolumeTop
 from karpenter_core_tpu.kube.objects import Node, NodeStatus, Pod
 from karpenter_core_tpu.metrics.registry import NAMESPACE, NODES_CREATED, REGISTRY
 from karpenter_core_tpu.obs import TRACER
+from karpenter_core_tpu.obs import reqctx
 from karpenter_core_tpu.obs.log import get_logger
 from karpenter_core_tpu.solver.tpu_solver import GreedySolver, SolvedMachine, SolveResult
 from karpenter_core_tpu.utils import podutils
@@ -293,6 +295,11 @@ class ProvisioningController:
                 reasons[pod.metadata.uid] = err_msg
         return reasons
 
+    @staticmethod
+    def _pod_tenant(pod: Pod) -> Optional[str]:
+        """Tenant a pod bills to (karpenter.sh/tenant label), or None."""
+        return (pod.metadata.labels or {}).get(api_labels.TENANT_LABEL_KEY)
+
     def _observe_bind(self, pod: Pod, now: float) -> None:
         uid = pod.metadata.uid or (pod.metadata.namespace, pod.metadata.name)
         if uid in self._admission_observed:
@@ -302,7 +309,17 @@ class ProvisioningController:
             self._admission_observed.popitem(last=False)
         ts = getattr(pod.metadata, "creation_timestamp", None)
         if ts:
-            ADMISSION_TO_BIND.observe(max(now - ts, 0.0))
+            # per-tenant admission-to-bind: the POD's own tenant label (not
+            # the batch context — bind latency is per-pod), through the
+            # cardinality guard; tenant-less pods keep the unlabeled series
+            tenant = self._pod_tenant(pod)
+            if tenant is not None:
+                ADMISSION_TO_BIND.observe(
+                    max(now - ts, 0.0),
+                    {"tenant": reqctx.TENANTS.admit(tenant)},
+                )
+            else:
+                ADMISSION_TO_BIND.observe(max(now - ts, 0.0))
 
     def _notify_bind(self, pod: Pod, node_name: str) -> None:
         for listener in self.bind_listeners:
@@ -446,16 +463,31 @@ class ProvisioningController:
             self._last_solve_inputs = (provisioners, instance_types)
         pending = [self.volume_topology.inject(copy.deepcopy(p)) for p in pending]
         daemonset_pods = self.get_daemonset_pods()
+        # operator-reconcile attribution entry point (ISSUE 16): the solve
+        # is one batch-level unit of work, billed to the batch's plurality
+        # tenant (pod labels; admission-to-bind stays exactly per-pod in
+        # _observe_bind). The bind rides through the whole ladder — gate,
+        # frame header, child process, flight record, compile cache.
+        tenants = [t for t in (self._pod_tenant(p) for p in pending) if t]
+        batch_tenant = (
+            max(set(tenants), key=tenants.count) if tenants else None
+        )
+        bind_ctx = (
+            reqctx.bind(reqctx.RequestContext(tenant=batch_tenant))
+            if batch_tenant is not None
+            else contextlib.nullcontext()
+        )
         try:
-            return self.solver.solve(
-                pending,
-                provisioners,
-                instance_types,
-                daemonset_pods=daemonset_pods,
-                state_nodes=state_nodes,
-                kube_client=self.kube_client,
-                cluster=self.cluster,
-            )
+            with bind_ctx:
+                return self.solver.solve(
+                    pending,
+                    provisioners,
+                    instance_types,
+                    daemonset_pods=daemonset_pods,
+                    state_nodes=state_nodes,
+                    kube_client=self.kube_client,
+                    cluster=self.cluster,
+                )
         except Exception as solve_exc:
             if self.fallback_solver is self.solver:
                 raise

@@ -302,7 +302,9 @@ class SoakDriver:
 
     def _baselines(self) -> dict:
         return {
-            "admission": ADMISSION_TO_BIND.snapshot(),
+            # merged across the per-tenant series the attribution plane
+            # splits binds into (ISSUE 16): the soak SLO is whole-stream
+            "admission": ADMISSION_TO_BIND.merged_snapshot(),
             "inc": {
                 o: INCREMENTAL_SCREEN_TOTAL.get({"outcome": o})
                 for o in INC_OUTCOMES
@@ -324,9 +326,11 @@ class SoakDriver:
         r.machines_launched = (
             len(self.op.kube_client.list("Machine")) - base["machines"]
         )
-        r.admission_count = ADMISSION_TO_BIND.count_since(base["admission"])
-        r.admission_p50_s = ADMISSION_TO_BIND.percentile(0.5, baseline=base["admission"])
-        r.admission_p99_s = ADMISSION_TO_BIND.percentile(0.99, baseline=base["admission"])
+        r.admission_count = (
+            ADMISSION_TO_BIND.merged_snapshot()[1] - base["admission"][1]
+        )
+        r.admission_p50_s = ADMISSION_TO_BIND.merged_percentile(0.5, baseline=base["admission"])
+        r.admission_p99_s = ADMISSION_TO_BIND.merged_percentile(0.99, baseline=base["admission"])
         if self._pending_samples:
             r.pending_max = max(self._pending_samples)
             r.pending_mean = statistics.fmean(self._pending_samples)

@@ -28,11 +28,18 @@ from karpenter_core_tpu.kube.objects import (
     PodAffinityTerm,
     TopologySpreadConstraint,
 )
+from karpenter_core_tpu.api.labels import TENANT_LABEL_KEY
 from karpenter_core_tpu.testing import make_pod
 
 HOSTNAME_KEY = "kubernetes.io/hostname"
 
 APPS = tuple(f"churn-app-{i}" for i in range(8))
+# tenants the churn bills its load to (ISSUE 16): a SMALL FIXED pool for
+# the same reason as APPS — the tenant label rides in the pod label dict,
+# so fresh tenant values per pod would churn the compiled-program keys.
+# The pool also stays under the cardinality guard's slot cap so loadgen
+# runs never exercise the "other" overflow by accident.
+TENANT_POOL = ("tenant-blue", "tenant-green", "tenant-red")
 # ONE spread pool and ONE anti pool, not several: every distinct multiset
 # of topology/anti-affinity groups in a batch is a STATIC parameter of the
 # compiled pack kernel (the geometry key's topology signature), so pools
@@ -51,10 +58,20 @@ class ScenarioMixer:
     def __init__(self, rng: np.random.Generator):
         self.rng = rng
         self._n = 0
+        self._groups = 0
 
     def _name(self, scenario: str) -> str:
         self._n += 1
         return f"{scenario}-{self._n}"
+
+    def _tenant(self) -> str:
+        """One tenant per scenario GROUP, round-robin off a plain counter:
+        deterministic and rng-stream-neutral (pre-tenant replays draw the
+        identical app/request sequences), and group-level — a bulk
+        deployment stays one encode class instead of splitting per pod."""
+        tenant = TENANT_POOL[self._groups % len(TENANT_POOL)]
+        self._groups += 1
+        return tenant
 
     def _requests(self) -> Dict[str, str]:
         return {
@@ -63,10 +80,14 @@ class ScenarioMixer:
         }
 
     def generic(self, count: int) -> List:
+        tenant = self._tenant()
         return [
             make_pod(
                 name=self._name("generic"),
-                labels={"app": APPS[int(self.rng.integers(len(APPS)))]},
+                labels={
+                    "app": APPS[int(self.rng.integers(len(APPS)))],
+                    TENANT_LABEL_KEY: tenant,
+                },
                 requests=self._requests(),
             )
             for _ in range(count)
@@ -75,13 +96,15 @@ class ScenarioMixer:
     def bulk(self, count: int) -> List:
         app = APPS[int(self.rng.integers(len(APPS)))]
         requests = self._requests()
+        labels = {"app": app, TENANT_LABEL_KEY: self._tenant()}
         return [
-            make_pod(name=self._name("bulk"), labels={"app": app}, requests=requests)
+            make_pod(name=self._name("bulk"), labels=dict(labels), requests=requests)
             for _ in range(count)
         ]
 
     def spread(self, count: int) -> List:
         app = SPREAD_APPS[int(self.rng.integers(len(SPREAD_APPS)))]
+        tenant = self._tenant()
         requests = self._requests()
         constraint = TopologySpreadConstraint(
             max_skew=2,
@@ -92,7 +115,7 @@ class ScenarioMixer:
         return [
             make_pod(
                 name=self._name("spread"),
-                labels={"app": app},
+                labels={"app": app, TENANT_LABEL_KEY: tenant},
                 requests=requests,
                 topology_spread=[constraint],
             )
@@ -101,6 +124,7 @@ class ScenarioMixer:
 
     def anti(self, count: int) -> List:
         app = ANTI_APPS[int(self.rng.integers(len(ANTI_APPS)))]
+        tenant = self._tenant()
         term = PodAffinityTerm(
             topology_key=HOSTNAME_KEY,
             label_selector=LabelSelector(match_labels={"app": app}),
@@ -108,7 +132,7 @@ class ScenarioMixer:
         return [
             make_pod(
                 name=self._name("anti"),
-                labels={"app": app},
+                labels={"app": app, TENANT_LABEL_KEY: tenant},
                 requests={"cpu": "0.5"},
                 pod_anti_affinity_required=[term],
             )

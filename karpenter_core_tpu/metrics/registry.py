@@ -231,6 +231,41 @@ class Histogram:
         with self._mu:
             return self.counts[lv] - (baseline[1] if baseline else 0)
 
+    def merged_snapshot(self):
+        """Cross-series aggregate in snapshot() shape: (cumulative bucket
+        counts, count, sum) summed over EVERY labeled series. The
+        attribution plane splits one logical stream into per-tenant series
+        (ISSUE 16); readers that want the whole stream regardless of who
+        it was billed to — the soak driver's SLO math — baseline-diff
+        against this instead of the unlabeled series."""
+        with self._mu:
+            agg = [0] * len(self.buckets)
+            total = 0
+            s = 0.0
+            for lv, count in list(self.counts.items()):
+                for i, v in enumerate(self.bucket_counts.get(lv, ())):
+                    agg[i] += v
+                total += count
+                s += self.sums[lv]
+            return agg, total, s
+
+    def merged_percentile(self, q: float, baseline=None) -> Optional[float]:
+        """percentile() over the merged_snapshot() aggregate; `baseline`
+        must also be a merged_snapshot()."""
+        counts, total, _ = self.merged_snapshot()
+        base_counts, base_total = (
+            (baseline[0], baseline[1]) if baseline else ((), 0)
+        )
+        total -= base_total
+        if total <= 0:
+            return None
+        target = q * total
+        for i, (bucket, c) in enumerate(zip(self.buckets, counts)):
+            c -= base_counts[i] if i < len(base_counts) else 0
+            if c >= target:
+                return bucket
+        return self.buckets[-1]
+
     def percentile(self, q: float, labels: Optional[Dict[str, str]] = None,
                    baseline=None) -> Optional[float]:
         """Upper bucket bound at quantile q; values above the largest

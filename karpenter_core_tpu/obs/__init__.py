@@ -15,6 +15,17 @@ from karpenter_core_tpu.obs.flightrec import (
     FlightRecorder,
     enable_flightrec_from_env,
 )
+from karpenter_core_tpu.obs.reqctx import (
+    TENANTS,
+    TENANT_HEADER,
+    RequestContext,
+    TenantGuard,
+    bind as bind_request,
+    current as current_request,
+    current_tenant,
+    tenant_labels,
+)
+from karpenter_core_tpu.obs.slo import Objective, SloEngine
 from karpenter_core_tpu.obs.log import (
     SINK as LOG_SINK,
     bound as log_bound,
@@ -37,4 +48,7 @@ __all__ = [
     "enable_tracing_from_env", "export_spans", "profile_dir",
     "LOG_SINK", "log_bound", "configure_logging_from_env", "get_logger",
     "FLIGHTREC", "FlightRecorder", "enable_flightrec_from_env",
+    "TENANTS", "TENANT_HEADER", "RequestContext", "TenantGuard",
+    "bind_request", "current_request", "current_tenant", "tenant_labels",
+    "Objective", "SloEngine",
 ]

@@ -429,9 +429,10 @@ class _LiveRecord:
     """One in-flight capture: begin() -> solve -> finish()/finish_error()."""
 
     __slots__ = ("_recorder", "_snapshot", "_digest", "_trace_id", "_mark",
-                 "_tid", "_t0", "_ts", "_primary_error")
+                 "_tid", "_t0", "_ts", "_primary_error", "_tenant")
 
     def __init__(self, recorder: "FlightRecorder", snapshot: dict):
+        from karpenter_core_tpu.obs import reqctx
         from karpenter_core_tpu.obs.tracer import TRACER
 
         self._recorder = recorder
@@ -439,6 +440,7 @@ class _LiveRecord:
         self._digest = input_digest(snapshot)
         self._trace_id = TRACER.current_trace_id() if TRACER.enabled else None
         self._mark = TRACER.mark() if TRACER.enabled else None
+        self._tenant = reqctx.current_tenant()
         self._tid = threading.get_ident()
         self._t0 = time.perf_counter()
         self._ts = time.time()
@@ -463,6 +465,10 @@ class _LiveRecord:
         }
         if self._trace_id is not None:
             record["trace_id"] = self._trace_id
+        if self._tenant is not None:
+            # raw tenant (records are bounded by the ring, not by label
+            # cardinality); absent key when no request context was bound
+            record["tenant"] = self._tenant
         if self._mark is not None and TRACER.enabled:
             record["phases_ms"] = self._own_phases(TRACER)
         if self._primary_error is not None:
@@ -719,6 +725,25 @@ class FlightRecorder:
                 if record.get("trace_id") == trace_id:
                     return record
         return None
+
+    def tenant_index(self) -> Dict[str, List[dict]]:
+        """Per-tenant index of ring records for /debug/tenants: tenant ->
+        [{ts, digest, backend, trace_id?, duration_ms}, ...] newest last.
+        Tenant-less records are indexed under "" so the digest can show
+        unattributed traffic alongside the named tenants."""
+        index: Dict[str, List[dict]] = {}
+        with self._mu:
+            for record in self._ring:
+                entry = {
+                    "ts": record.get("ts"),
+                    "digest": record.get("digest"),
+                    "backend": record.get("backend"),
+                    "duration_ms": record.get("duration_ms"),
+                }
+                if "trace_id" in record:
+                    entry["trace_id"] = record["trace_id"]
+                index.setdefault(str(record.get("tenant", "")), []).append(entry)
+        return index
 
     def last(self) -> Optional[dict]:
         with self._mu:

@@ -1,0 +1,277 @@
+"""Declarative SLOs evaluated as multi-window burn rates.
+
+An :class:`Objective` names a latency histogram, a good/bad threshold, and a
+target fraction ("admission-to-bind p99 < 30s" becomes: 99% of observations
+land in a bucket ≤ 30s). The :class:`SloEngine` samples the histogram's
+cumulative series (the PR 6 ``Histogram.snapshot()`` shape: cumulative bucket
+counts + count), diffs samples across sliding windows, and reports the
+classic SRE burn rate per window:
+
+    error_rate(window) / (1 - target)
+
+A burn rate of 1.0 spends the error budget exactly at the sustainable pace;
+14.4 over 5 minutes is the canonical page threshold. The budget-remaining
+gauge is computed over the longest window (:attr:`SloEngine.budget_window_s`)
+and exposed as ``karpenter_slo_error_budget_remaining{slo[,tenant]}``.
+
+The engine is an *external exposition source* (PR 15's ``families()``
+protocol): register it with ``REGISTRY.add_external(engine)`` and every
+scrape computes fresh burn rates — no evaluation thread, and the gauge
+family exists only where an engine is wired (the operator). Tenant series
+come from the tenant labels the attribution plane already hangs off the
+underlying histograms; the engine never invents label values, so it inherits
+the ``reqctx.TENANTS`` cardinality cap.
+
+The one control hook: :meth:`SloEngine.budget_exhausted` — the admission
+gate's brownout band can prefer shedding tenants whose budget is spent
+(off by default; see ``AdmissionGate.brownout_prefer``).
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Deque, Dict, List, Optional, Sequence, Tuple
+
+from karpenter_core_tpu.metrics.registry import NAMESPACE, Histogram
+
+__all__ = [
+    "BUDGET_GAUGE_NAME",
+    "DEFAULT_BURN_WINDOWS",
+    "Objective",
+    "SloEngine",
+]
+
+BUDGET_GAUGE_NAME = f"{NAMESPACE}_slo_error_budget_remaining"
+
+# (label, seconds) sliding windows burn rates are reported over. Short by
+# SRE-book standards on purpose: the soak bench and obs-smoke drills live in
+# minutes, not days, and the math is window-length agnostic.
+DEFAULT_BURN_WINDOWS: Tuple[Tuple[str, float], ...] = (
+    ("1m", 60.0),
+    ("5m", 300.0),
+    ("1h", 3600.0),
+)
+
+
+@dataclass(frozen=True)
+class Objective:
+    """One SLO: `target` fraction of `histogram` observations ≤ `threshold_s`.
+
+    ``base_labels`` narrows which series of the histogram belong to the
+    objective (e.g. ``{"context": "provisioning"}`` on the solve-duration
+    histogram); series are then grouped by their ``tenant`` label, with the
+    tenant-less aggregate summed across all matching series.
+    """
+
+    name: str
+    histogram: Histogram
+    threshold_s: float
+    target: float  # e.g. 0.99 — good fraction the SLO promises
+    base_labels: Dict[str, str] = field(default_factory=dict)
+    description: str = ""
+
+
+class _Sample:
+    """One (timestamp, good-count, total-count) point for a series."""
+
+    __slots__ = ("t", "good", "total")
+
+    def __init__(self, t: float, good: int, total: int) -> None:
+        self.t = t
+        self.good = good
+        self.total = total
+
+
+class SloEngine:
+    """Evaluates objectives as multi-window burn rates over histogram diffs."""
+
+    def __init__(
+        self,
+        objectives: Sequence[Objective],
+        windows: Tuple[Tuple[str, float], ...] = DEFAULT_BURN_WINDOWS,
+        clock=time.monotonic,
+        max_samples: int = 1024,
+    ) -> None:
+        self.objectives = tuple(objectives)
+        self.windows = tuple(windows)
+        self.budget_window_s = max(w for _, w in self.windows)
+        self._clock = clock
+        self._max_samples = int(max_samples)
+        self._mu = threading.Lock()
+        # (objective name, tenant-or-None) -> deque of _Sample, oldest first
+        self._samples: Dict[Tuple[str, Optional[str]], Deque[_Sample]] = {}
+
+    # -- sampling ----------------------------------------------------------
+
+    def _good_index(self, obj: Objective) -> int:
+        """Index of the largest bucket bound ≤ threshold (cumulative counts
+        at that index == the good count). -1 when the threshold sits below
+        every bucket (everything counts as bad)."""
+        return bisect.bisect_right(obj.histogram.buckets, obj.threshold_s) - 1
+
+    def _collect(self, obj: Objective) -> Dict[Optional[str], Tuple[int, int]]:
+        """Current (good, total) per tenant for one objective. The None key
+        is the aggregate: the sum over every matching series, so per-tenant
+        observations still count toward the global objective."""
+        gi = self._good_index(obj)
+        out: Dict[Optional[str], List[int]] = {None: [0, 0]}
+        for labels, data in obj.histogram.series():
+            if any(labels.get(k) != v for k, v in obj.base_labels.items()):
+                continue
+            extra = set(labels) - set(obj.base_labels)
+            if extra - {"tenant"}:
+                continue  # differently-shaped series (e.g. another context)
+            counts = list(data.get("buckets", ()))
+            total = int(data.get("count", 0))
+            good = int(counts[gi]) if 0 <= gi < len(counts) else 0
+            tenant = labels.get("tenant")
+            agg = out[None]
+            agg[0] += good
+            agg[1] += total
+            if tenant is not None:
+                cur = out.setdefault(tenant, [0, 0])
+                cur[0] += good
+                cur[1] += total
+        return {k: (v[0], v[1]) for k, v in out.items()}
+
+    def sample(self) -> None:
+        """Record one sample point per (objective, tenant) series."""
+        now = self._clock()
+        with self._mu:
+            for obj in self.objectives:
+                for tenant, (good, total) in self._collect(obj).items():
+                    dq = self._samples.setdefault((obj.name, tenant), deque())
+                    if not dq:
+                        # zero baseline for a first-seen series: a tenant
+                        # that appears mid-run burns from its first window
+                        # instead of hiding behind a missing baseline
+                        dq.append(_Sample(now, 0, 0))
+                    dq.append(_Sample(now, good, total))
+                    while len(dq) > self._max_samples:
+                        dq.popleft()
+                    horizon = now - 2 * self.budget_window_s
+                    while len(dq) > 1 and dq[0].t < horizon:
+                        dq.popleft()
+
+    # -- evaluation --------------------------------------------------------
+
+    @staticmethod
+    def _window_rates(dq: Deque[_Sample], now: float, window_s: float,
+                      target: float) -> Tuple[Optional[float], int]:
+        """(burn rate, window traffic) for one series over one window.
+        Clamps to observed history: the baseline is the newest sample at
+        least `window_s` old, else the oldest we have. None when the window
+        saw no traffic."""
+        if not dq:
+            return None, 0
+        newest = dq[-1]
+        base = dq[0]
+        for s in reversed(dq):
+            if now - s.t >= window_s:
+                base = s
+                break
+        total = newest.total - base.total
+        if total <= 0:
+            return None, 0
+        good = newest.good - base.good
+        error_rate = 1.0 - (good / total)
+        allowed = 1.0 - target
+        burn = error_rate / allowed if allowed > 0 else (0.0 if error_rate == 0 else float("inf"))
+        return burn, total
+
+    def evaluate(self) -> List[dict]:
+        """Sample, then report every (objective, tenant) series: burn rate
+        per window plus budget remaining over the longest window (1.0 =
+        untouched, 0.0 = spent, negative = overdrawn)."""
+        self.sample()
+        now = self._clock()
+        out: List[dict] = []
+        with self._mu:
+            for obj in self.objectives:
+                for (name, tenant), dq in sorted(
+                    self._samples.items(),
+                    key=lambda kv: (kv[0][0], kv[0][1] or ""),
+                ):
+                    if name != obj.name:
+                        continue
+                    burns = {}
+                    for wname, wsec in self.windows:
+                        burn, traffic = self._window_rates(dq, now, wsec, obj.target)
+                        burns[wname] = {"burn_rate": burn, "traffic": traffic}
+                    budget_burn, traffic = self._window_rates(
+                        dq, now, self.budget_window_s, obj.target
+                    )
+                    remaining = 1.0 if budget_burn is None else 1.0 - budget_burn
+                    out.append({
+                        "slo": obj.name,
+                        "tenant": tenant,
+                        "target": obj.target,
+                        "threshold_s": obj.threshold_s,
+                        "description": obj.description,
+                        "windows": burns,
+                        "budget_window_s": self.budget_window_s,
+                        "budget_remaining": remaining,
+                        "traffic": traffic,
+                    })
+        return out
+
+    def budget_exhausted(self, tenant: Optional[str]) -> bool:
+        """True when any objective's budget for *tenant* is spent (≤ 0) over
+        the budget window. Unknown tenants have burned nothing. This is the
+        signal the admission gate's brownout-preference hook consumes."""
+        if tenant is None:
+            return False
+        now = self._clock()
+        with self._mu:
+            for obj in self.objectives:
+                dq = self._samples.get((obj.name, tenant))
+                if not dq:
+                    continue
+                burn, _ = self._window_rates(dq, now, self.budget_window_s, obj.target)
+                if burn is not None and burn >= 1.0:
+                    return True
+        return False
+
+    # -- exposition (external source protocol, PR 15) ----------------------
+
+    def families(self) -> Dict[str, dict]:
+        """Gauge family for the registry's external-source hook. Tenant-less
+        aggregates carry only the `slo` label — a run that never bound a
+        tenant exposes no `tenant` label here either."""
+        series: List[Tuple[Dict[str, str], float]] = []
+        for row in self.evaluate():
+            labels = {"slo": row["slo"]}
+            if row["tenant"] is not None:
+                labels["tenant"] = row["tenant"]
+            series.append((labels, row["budget_remaining"]))
+        return {
+            BUDGET_GAUGE_NAME: {
+                "kind": "gauge",
+                "help": "SLO error budget remaining over the budget window "
+                        "(1 = untouched, <=0 = exhausted)",
+                "series": series,
+            }
+        }
+
+    def digest(self) -> dict:
+        """JSON digest for /debug/slo."""
+        return {
+            "windows": [{"name": n, "seconds": s} for n, s in self.windows],
+            "budget_window_s": self.budget_window_s,
+            "objectives": [
+                {
+                    "name": o.name,
+                    "target": o.target,
+                    "threshold_s": o.threshold_s,
+                    "histogram": o.histogram.name,
+                    "base_labels": dict(o.base_labels),
+                    "description": o.description,
+                }
+                for o in self.objectives
+            ],
+            "series": self.evaluate(),
+        }

@@ -33,6 +33,7 @@ from contextlib import nullcontext
 from typing import Dict, List, Optional, Tuple
 
 from karpenter_core_tpu.metrics.registry import NAMESPACE, REGISTRY
+from karpenter_core_tpu.obs import reqctx
 
 # -- instruments fed by the span bridge (names chartered in ISSUE 1) --------
 
@@ -66,18 +67,26 @@ def _bridge(span: "Span") -> None:
     which reach the parent exposition via the metrics merge — bridging
     the grafted copy would double-count every phase (ISSUE 15)."""
     name = span.name
+    # the span's tenant attr (stamped by span() from the bound request
+    # context) fans the phase/solve histograms out per tenant — through the
+    # cardinality guard, so a label flood collapses into "other"
+    tenant = span.attrs.get("tenant")
     if name.startswith(_PHASE_PREFIX):
-        SOLVER_PHASE_DURATION.observe(
-            span.duration_s, {"phase": name[len(_PHASE_PREFIX):]}
-        )
+        labels = {"phase": name[len(_PHASE_PREFIX):]}
+        if tenant is not None:
+            labels["tenant"] = reqctx.TENANTS.admit(str(tenant))
+        SOLVER_PHASE_DURATION.observe(span.duration_s, labels)
     elif name == "solver.solve":
         # deprovisioning simulations re-enter the same solver: keep their
         # solves out of the provisioning-latency series (context label /
         # batch-size gauge) or consolidation-heavy clusters would report
         # simulation numbers as provisioning SLO data
         ctx = str(span.attrs.get("context", "provisioning"))
+        labels = {"context": ctx}
+        if tenant is not None:
+            labels["tenant"] = reqctx.TENANTS.admit(str(tenant))
         SOLVER_SOLVE_DURATION.observe(
-            span.duration_s, {"context": ctx},
+            span.duration_s, labels,
             # the exemplar links a bad latency bucket to its trace — and,
             # through the trace id, to the flight record of the same solve
             exemplar={"trace_id": span.trace_id} if span.trace_id else None,
@@ -248,6 +257,14 @@ class Tracer:
         self.add_span(name, now, now, trace_id=trace_id, **attrs)
 
     def _make(self, name, trace_id, attrs) -> Span:
+        # a bound request context stamps its tenant onto every locally
+        # created span (the raw tenant, not the guarded label: span attrs
+        # are not metric labels — the _bridge routes through the guard
+        # before labeling). Grafted spans keep whatever the child stamped.
+        if "tenant" not in attrs:
+            tenant = reqctx.current_tenant()
+            if tenant is not None:
+                attrs["tenant"] = tenant
         parent = self._current()
         if trace_id is None:
             trace_id = (

@@ -121,6 +121,188 @@ def configure_logging() -> None:
         handler.addFilter(_ControllerContextFilter())
 
 
+def build_slo_engine():
+    """The operator's declarative SLOs (obs/slo): admission-to-bind and
+    solve-duration latency objectives, evaluated as multi-window burn rates
+    per tenant. Registered as an external exposition source so every
+    /metrics scrape computes fresh
+    karpenter_slo_error_budget_remaining{slo[,tenant]} gauges."""
+    from karpenter_core_tpu.controllers.provisioning.provisioner import (
+        ADMISSION_TO_BIND,
+    )
+    from karpenter_core_tpu.obs.slo import Objective, SloEngine
+    from karpenter_core_tpu.obs.tracer import SOLVER_SOLVE_DURATION
+
+    return SloEngine([
+        Objective(
+            name="admission-to-bind",
+            histogram=ADMISSION_TO_BIND,
+            threshold_s=30.0,
+            target=0.99,
+            description="99% of pods get a capacity decision within 30s "
+                        "of admission",
+        ),
+        Objective(
+            name="solve-duration",
+            histogram=SOLVER_SOLVE_DURATION,
+            threshold_s=30.0,
+            target=0.99,
+            base_labels={"context": "provisioning"},
+            description="99% of provisioning solves finish inside the 30s "
+                        "dispatch deadline",
+        ),
+    ])
+
+
+# every debug endpoint the operator serves: (path, profiling-gated?, what).
+# /debug/ renders this as the discovery index; keep it in sync when adding
+# endpoints (test_debug_surface checks the handler chain against it).
+DEBUG_ENDPOINTS = (
+    ("/metrics", False, "Prometheus exposition (openmetrics negotiable)"),
+    ("/healthz", False, "liveness probe"),
+    ("/readyz", False, "readiness probe"),
+    ("/debug/health", False, "solver health: breaker, wedges, abandoned threads"),
+    ("/debug/slo", True, "SLO burn rates + error budgets, per tenant"),
+    ("/debug/tenants", True, "per-tenant latency/shed/device/compile digest"),
+    ("/debug/trace", True, "Chrome trace-event JSON of the solve-path ring"),
+    ("/debug/trace/summary", True, "human span summary"),
+    ("/debug/timeline", True, "cross-process solve timeline + flight-record index"),
+    ("/debug/logs", True, "structured-log ring (logfmt)"),
+    ("/debug/logs.json", True, "structured-log ring (JSON)"),
+    ("/debug/solves", True, "solve flight-record ring (replayable)"),
+    ("/debug/consolidations", True, "consolidation decision ring"),
+    ("/debug/events", True, "events recorder ring"),
+    ("/debug/threads", True, "all thread stacks (goroutine-dump analog)"),
+    ("/debug/backend", True, "device + compile-cache facts"),
+    ("/debug/config", True, "context-injected options + settings"),
+)
+
+
+def _debug_index(profiling: bool) -> dict:
+    """The /debug/ discovery page: every endpoint, whether it is live in
+    this process (profiling-gated endpoints 404 until
+    KARPENTER_ENABLE_PROFILING), and what it serves."""
+    return {
+        "profiling_enabled": profiling,
+        "endpoints": [
+            {
+                "path": path,
+                "profiling_gated": gated,
+                "enabled": profiling or not gated,
+                "description": desc,
+            }
+            for path, gated, desc in DEBUG_ENDPOINTS
+        ],
+    }
+
+
+def _tenants_digest(slo=None) -> dict:
+    """The /debug/tenants payload: who burned the budget. Per-tenant
+    latency percentiles, shed/fallback breakdowns, device time, compile
+    cost, live gate depth, and the flight-record index — read straight off
+    the live series the attribution plane labeled (parent process only;
+    child series arrive pre-merged in /metrics)."""
+    from karpenter_core_tpu.controllers.provisioning.provisioner import (
+        ADMISSION_TO_BIND,
+    )
+    from karpenter_core_tpu.obs.flightrec import FLIGHTREC
+    from karpenter_core_tpu.obs.reqctx import TENANTS
+    from karpenter_core_tpu.obs.tracer import (
+        SOLVER_PHASE_DURATION,
+        SOLVER_SOLVE_DURATION,
+    )
+    from karpenter_core_tpu.solver.fallback import SOLVER_FALLBACK_TOTAL
+    from karpenter_core_tpu.solver.host import (
+        SOLVER_QUEUE_DEPTH,
+        SOLVER_QUEUE_WAIT,
+        SOLVER_SHED_TOTAL,
+    )
+    from karpenter_core_tpu.utils.compilecache import (
+        CACHE_MISSES,
+        COMPILE_SECONDS,
+    )
+
+    tenants: dict = {}
+
+    def entry(tenant: str) -> dict:
+        return tenants.setdefault(tenant, {
+            "admission_to_bind_s": {},
+            "solve_duration_s": {},
+            "queue_wait_s": {},
+            "shed": {},
+            "fallback": {},
+            "device_ms": 0.0,
+            "compile_misses": 0,
+            "compile_seconds": 0.0,
+            "gate_depth": {},
+            "flight_records": [],
+        })
+
+    def percentiles(hist, labels, data):
+        return {
+            "count": int(data["count"]),
+            "p50": hist.percentile(0.50, labels),
+            "p99": hist.percentile(0.99, labels),
+        }
+
+    for labels, data in ADMISSION_TO_BIND.series():
+        t = labels.get("tenant")
+        if t is not None:
+            entry(t)["admission_to_bind_s"] = percentiles(
+                ADMISSION_TO_BIND, labels, data
+            )
+    for labels, data in SOLVER_SOLVE_DURATION.series():
+        t = labels.get("tenant")
+        if t is not None:
+            entry(t)["solve_duration_s"] = percentiles(
+                SOLVER_SOLVE_DURATION, labels, data
+            )
+    for labels, data in SOLVER_QUEUE_WAIT.series():
+        t = labels.get("tenant")
+        if t is not None:
+            entry(t)["queue_wait_s"] = percentiles(
+                SOLVER_QUEUE_WAIT, labels, data
+            )
+    for labels, value in SOLVER_SHED_TOTAL.series():
+        t = labels.get("tenant")
+        if t is not None:
+            shed = entry(t)["shed"]
+            reason = labels.get("reason", "")
+            shed[reason] = shed.get(reason, 0) + int(value)
+    for labels, value in SOLVER_FALLBACK_TOTAL.series():
+        t = labels.get("tenant")
+        if t is not None:
+            fb = entry(t)["fallback"]
+            reason = labels.get("reason", "")
+            fb[reason] = fb.get(reason, 0) + int(value)
+    for labels, data in SOLVER_PHASE_DURATION.series():
+        t = labels.get("tenant")
+        if t is not None and labels.get("phase") == "device":
+            entry(t)["device_ms"] += round(float(data["sum"]) * 1e3, 1)
+    for labels, value in CACHE_MISSES.series():
+        t = labels.get("tenant")
+        if t is not None:
+            entry(t)["compile_misses"] += int(value)
+    for labels, data in COMPILE_SECONDS.series():
+        t = labels.get("tenant")
+        if t is not None:
+            entry(t)["compile_seconds"] += round(float(data["sum"]), 3)
+    for labels, value in list(SOLVER_QUEUE_DEPTH.values.items()):
+        d = dict(labels)
+        t = d.get("tenant")
+        if t is not None:
+            entry(t)["gate_depth"][d.get("gate", "")] = value
+    for tenant, records in FLIGHTREC.tenant_index().items():
+        if tenant:
+            entry(tenant)["flight_records"] = records
+    digest = {"guard": TENANTS.stats(), "tenants": tenants}
+    if slo is not None:
+        digest["budget_exhausted"] = sorted(
+            t for t in tenants if slo.budget_exhausted(t)
+        )
+    return digest
+
+
 def _debug_threads() -> str:
     """All thread stacks — the goroutine-dump analog of the reference's
     pprof handlers (operator/profiling.go:25), for diagnosing stuck loops."""
@@ -156,6 +338,7 @@ def _debug_backend() -> str:
 class _HealthHandler(BaseHTTPRequestHandler):
     operator = None  # set by serve_health
     solver = None  # the ResilientSolver, when the wiring passes it
+    slo = None  # the SloEngine, when the wiring passes it
     profiling_enabled = False  # set from KARPENTER_ENABLE_PROFILING
 
     def do_GET(self):
@@ -191,6 +374,28 @@ class _HealthHandler(BaseHTTPRequestHandler):
                 status = "degraded"
             body = json.dumps(
                 {"status": status, "solver": report}, sort_keys=True
+            ).encode() + b"\n"
+            ctype = "application/json"
+        elif self.path in ("/debug", "/debug/"):
+            # the discovery index (ISSUE 16): ungated, so an operator can
+            # always enumerate what this process serves — gated endpoints
+            # are listed with enabled=false rather than hidden
+            body = json.dumps(
+                _debug_index(self.profiling_enabled), sort_keys=True
+            ).encode() + b"\n"
+            ctype = "application/json"
+        elif self.path == "/debug/slo" and self.profiling_enabled:
+            # burn rates + error budgets per objective and tenant
+            if self.slo is not None:
+                payload = self.slo.digest()
+            else:
+                payload = {"error": "slo engine not wired"}
+            body = json.dumps(payload, sort_keys=True).encode() + b"\n"
+            ctype = "application/json"
+        elif self.path == "/debug/tenants" and self.profiling_enabled:
+            # who burned the budget: the per-tenant cost/latency digest
+            body = json.dumps(
+                _tenants_digest(self.slo), sort_keys=True
             ).encode() + b"\n"
             ctype = "application/json"
         elif self.path == "/debug/trace" and self.profiling_enabled:
@@ -301,9 +506,10 @@ class _HealthHandler(BaseHTTPRequestHandler):
 
 
 def serve_health(operator, port: int, profiling: bool = False,
-                 solver=None) -> ThreadingHTTPServer:
+                 solver=None, slo=None) -> ThreadingHTTPServer:
     _HealthHandler.operator = operator
     _HealthHandler.solver = solver
+    _HealthHandler.slo = slo
     # opt-in debug handlers, like the reference's --enable-profiling pprof
     # registration (operator.go:124-126)
     _HealthHandler.profiling_enabled = profiling
@@ -420,9 +626,22 @@ def run(cloud_provider, kube_client=None, stop_event=None, options=None):
     from karpenter_core_tpu.utils.gctuning import apply_server_gc_tuning
 
     apply_server_gc_tuning()
+    # the SLO burn-rate plane (ISSUE 16): declarative objectives over the
+    # histograms the attribution plane labels, exposed as fresh-per-scrape
+    # error-budget gauges and the /debug/slo digest
+    slo_engine = build_slo_engine()
+    REGISTRY.add_external(slo_engine)
+    # off-by-default brownout preference: when armed, the admission gate's
+    # brownout sheds ONLY tenants whose error budget is already exhausted
+    # (fast-burning tenants pay first), instead of shedding everyone
+    if envflags.get_bool("KARPENTER_SLO_BROWNOUT", False):
+        gate = getattr(primary, "admission", None)
+        if gate is not None:
+            gate.brownout_prefer = slo_engine.budget_exhausted
+            LOG.info("slo brownout preference armed", gate=gate.name)
     health = serve_health(
         operator, opts.metrics_port, profiling=opts.enable_profiling,
-        solver=solver,
+        solver=solver, slo=slo_engine,
     )
     stop = stop_event or threading.Event()
     try:

@@ -41,6 +41,7 @@ stall, operator.go:154-169).
 """
 from __future__ import annotations
 
+import contextlib
 import itertools
 import subprocess
 import sys
@@ -51,6 +52,7 @@ from typing import Optional
 
 from karpenter_core_tpu.events import Event
 from karpenter_core_tpu.metrics.registry import NAMESPACE, REGISTRY
+from karpenter_core_tpu.obs import reqctx
 from karpenter_core_tpu.obs.flightrec import FLIGHTREC, recording_suppressed
 from karpenter_core_tpu.obs.log import get_logger
 from karpenter_core_tpu.obs.tracer import TRACER
@@ -586,6 +588,14 @@ class ResilientSolver:
         box = {}
         done = threading.Event()
         hb = supervise.ThreadHeartbeat()
+        # request context and trace are thread-local: the watchdog thread
+        # must inherit the caller's binding or attribution dies right here
+        # — the gate, the frame header, and the child would all see an
+        # unbound context, and the solve span (whose trace id the latency
+        # exemplar carries) would start a fresh trace the flight record
+        # (begun on the caller's thread) knows nothing about (ISSUE 16)
+        ctx = reqctx.current()
+        caller_trace = TRACER.current_trace_id() if TRACER.enabled else None
         # under the state lock: health_report/_mark_wedged read _last_hb
         # from other threads — a bare write here was the racewatch gate's
         # founding catch (ISSUE 13)
@@ -598,7 +608,15 @@ class ResilientSolver:
             supervise.bind_heartbeat(hb)
             hb.touch()
             try:
-                box["result"] = self.primary.solve(*args, **kwargs)
+                with contextlib.ExitStack() as stack:
+                    if ctx is not None:
+                        stack.enter_context(reqctx.bind(ctx))
+                    if caller_trace is not None:
+                        stack.enter_context(TRACER.span(
+                            "solver.watchdog.dispatch",
+                            trace_id=caller_trace,
+                        ))
+                    box["result"] = self.primary.solve(*args, **kwargs)
             except BaseException as e:  # noqa: BLE001 — surfaced below
                 box["error"] = e
             finally:
@@ -657,7 +675,7 @@ class ResilientSolver:
             self._abandoned.append(
                 {"name": t.name, "kind": kind, "thread": t, "reaped": False}
             )
-        SOLVER_ABANDONED_TOTAL.inc({"kind": kind})
+        SOLVER_ABANDONED_TOTAL.inc(reqctx.tenant_labels(kind=kind))
         LOG.warning(
             "primary solve thread abandoned", kind=kind, thread=t.name,
             heartbeat_age_s=(
@@ -721,7 +739,7 @@ class ResilientSolver:
         # backend) so batched-replan gating and degradation/recovery
         # events work even when every solve is small.
         if self._small_batch(pods, instance_types):
-            SOLVER_SMALL_BATCH_TOTAL.inc()
+            SOLVER_SMALL_BATCH_TOTAL.inc(reqctx.tenant_labels())
             self._maybe_bg_probe()
             return self._recorded_fallback(
                 rec, "host.small_batch", False, pods, provisioners,
@@ -729,7 +747,7 @@ class ResilientSolver:
                 cluster,
             )
         if not self.healthy():
-            SOLVER_FALLBACK_TOTAL.inc({"reason": "backend_unavailable"})
+            SOLVER_FALLBACK_TOTAL.inc(reqctx.tenant_labels(reason="backend_unavailable"))
             # a fallback trip is an incident worth keeping: dump to disk
             return self._recorded_fallback(
                 rec, "host.backend_unavailable", True, pods, provisioners,
@@ -765,17 +783,17 @@ class ResilientSolver:
                 # prober gates re-admission (no waiting out a reprobe TTL
                 # with live solves as the trial balloons)
                 self._mark_wedged(f"{type(e).__name__}: {e}", kind="wedged")
-                SOLVER_FALLBACK_TOTAL.inc({"reason": "wedged"})
+                SOLVER_FALLBACK_TOTAL.inc(reqctx.tenant_labels(reason="wedged"))
             elif isinstance(e, TimeoutError):
                 # watchdog abandonment (slow, not wedged): the leaked
                 # thread is real either way — same immediate breaker trip
                 self._mark_wedged(f"{type(e).__name__}: {e}", kind="timeout")
-                SOLVER_FALLBACK_TOTAL.inc({"reason": "primary_error"})
+                SOLVER_FALLBACK_TOTAL.inc(reqctx.tenant_labels(reason="primary_error"))
             elif getattr(e, "marks_unhealthy", True):
                 self._mark_dead(f"{type(e).__name__}: {e}")
-                SOLVER_FALLBACK_TOTAL.inc({"reason": "primary_error"})
+                SOLVER_FALLBACK_TOTAL.inc(reqctx.tenant_labels(reason="primary_error"))
             else:
-                SOLVER_FALLBACK_TOTAL.inc({"reason": "request_rejected"})
+                SOLVER_FALLBACK_TOTAL.inc(reqctx.tenant_labels(reason="request_rejected"))
             # note_primary_error makes the record auto-dump on finish; if
             # the fallback ALSO raises, _recorded_fallback finalizes the
             # record via finish_error before the exception propagates

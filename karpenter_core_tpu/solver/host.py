@@ -72,6 +72,7 @@ from karpenter_core_tpu.metrics.registry import (
 )
 from karpenter_core_tpu.obs import TRACER
 from karpenter_core_tpu.obs import envflags
+from karpenter_core_tpu.obs import reqctx
 from karpenter_core_tpu.obs.tracer import export_spans
 from karpenter_core_tpu.obs.log import get_logger
 from karpenter_core_tpu.solver import service_pb2 as pb
@@ -106,6 +107,17 @@ SOLVER_SHED_TOTAL = REGISTRY.counter(
     "Solver requests shed by the admission gate instead of queued "
     "unboundedly, by gate and reason (queue_full, brownout, "
     "deadline_expired, injected)",
+)
+SOLVER_QUEUE_WAIT = REGISTRY.histogram(
+    f"{NAMESPACE}_solver_queue_wait_seconds",
+    "Seconds an admitted request waited in the gate before dispatch, by "
+    "gate (and tenant when a request context is bound)",
+)
+DEADLINE_VIOLATIONS_TOTAL = REGISTRY.counter(
+    f"{NAMESPACE}_solver_deadline_violations_total",
+    "Admitted requests that reached dispatch past their deadline, by gate "
+    "— structurally zero (the gate sheds expired work before dispatch); "
+    "any increment is a gate bug dashboards should page on",
 )
 HOST_RESPAWN_TOTAL = REGISTRY.counter(
     f"{NAMESPACE}_solver_host_respawn_total",
@@ -148,12 +160,17 @@ class AdmissionGate:
 
     def __init__(self, name: str = "solver", max_queue: int = 8,
                  brownout_at: Optional[int] = None, max_inflight: int = 1,
-                 clock=time.monotonic):
+                 clock=time.monotonic, brownout_prefer=None):
         self.name = name
         self.max_queue = int(max_queue)
         self.brownout_at = brownout_at
         self.max_inflight = int(max_inflight)
         self._clock = clock
+        # off-by-default observability->control hook: tenant -> bool.
+        # True = this tenant sheds in the brownout band (its error budget
+        # is spent); False = it rides through to the hard queue bound.
+        # None (the default) keeps legacy behavior: brownout sheds everyone.
+        self.brownout_prefer = brownout_prefer
         self._cond = threading.Condition()
         self._waiters: list = []
         self._inflight = 0
@@ -162,6 +179,9 @@ class AdmissionGate:
         self.dispatched_total = 0
         self.deadline_violations = 0  # structurally zero; asserted, not hoped
         self._shed_counts: Dict[str, int] = {}
+        # guarded tenant label -> depth (in-flight + queued), for the
+        # per-tenant SOLVER_QUEUE_DEPTH series; bounded by the tenant cap
+        self._tenant_depth: Dict[str, int] = {}
 
     # -- internals (callers hold self._cond) --------------------------------
 
@@ -177,10 +197,40 @@ class AdmissionGate:
         est = self._ema if self._ema is not None else 0.25
         return min(5.0, (self._depth_locked() + 1) * est)
 
+    def _tenant_enter_locked(self, tenant: str) -> None:
+        label = reqctx.TENANTS.admit(tenant)
+        depth = self._tenant_depth.get(label, 0) + 1
+        self._tenant_depth[label] = depth
+        SOLVER_QUEUE_DEPTH.set(
+            float(depth),
+            {"gate": self.name, "tenant": reqctx.TENANTS.admit(tenant)},
+        )
+
+    def _tenant_exit_locked(self, tenant: str) -> None:
+        label = reqctx.TENANTS.admit(tenant)
+        depth = self._tenant_depth.get(label, 0) - 1
+        if depth <= 0:
+            self._tenant_depth.pop(label, None)
+            SOLVER_QUEUE_DEPTH.delete(
+                {"gate": self.name, "tenant": reqctx.TENANTS.admit(tenant)}
+            )
+        else:
+            self._tenant_depth[label] = depth
+            SOLVER_QUEUE_DEPTH.set(
+                float(depth),
+                {"gate": self.name, "tenant": reqctx.TENANTS.admit(tenant)},
+            )
+
     def _shed_locked(self, reason: str, retry_after: Optional[float],
-                     detail: str):
+                     detail: str, tenant: Optional[str] = None):
         self._shed_counts[reason] = self._shed_counts.get(reason, 0) + 1
-        SOLVER_SHED_TOTAL.inc({"gate": self.name, "reason": reason})
+        if tenant is not None:
+            SOLVER_SHED_TOTAL.inc({
+                "gate": self.name, "reason": reason,
+                "tenant": reqctx.TENANTS.admit(tenant),
+            })
+        else:
+            SOLVER_SHED_TOTAL.inc({"gate": self.name, "reason": reason})
         if reason == "deadline_expired":
             err: Exception = SolverDeadlineExceededError(detail)
         else:
@@ -188,6 +238,21 @@ class AdmissionGate:
         err.shed_reason = reason
         err.retry_after_s = retry_after
         return err
+
+    def _brownout_sheds(self, tenant: Optional[str]) -> bool:
+        """Whether this request sheds in the brownout band. No preference
+        hook (the default): everyone sheds, the pre-hook behavior. With a
+        hook (e.g. SloEngine.budget_exhausted), only tenants whose error
+        budget is spent shed early — everyone else rides through to the
+        hard queue_full bound. Hook failures fail closed (shed): brownout
+        exists to protect the device, not to be polite."""
+        prefer = self.brownout_prefer
+        if prefer is None:
+            return True
+        try:
+            return bool(prefer(tenant))
+        except Exception:  # noqa: BLE001 — a sick hook must not widen admission
+            return True
 
     # -- the gate ------------------------------------------------------------
 
@@ -198,6 +263,7 @@ class AdmissionGate:
         budget at DISPATCH time (never <= 0 — an expired request raises
         instead). Raises typed RESOURCE_EXHAUSTED / DEADLINE_EXCEEDED on
         shed; the dispatch itself runs outside the gate's lock."""
+        tenant = reqctx.current_tenant()
         try:
             # queue-full injection (chaos `solver.rpc.overload`): the
             # injected typed error rides the same shed accounting a real
@@ -208,10 +274,17 @@ class AdmissionGate:
                 self._shed_counts["injected"] = (
                     self._shed_counts.get("injected", 0) + 1
                 )
-            SOLVER_SHED_TOTAL.inc({"gate": self.name, "reason": "injected"})
+            if tenant is not None:
+                SOLVER_SHED_TOTAL.inc({
+                    "gate": self.name, "reason": "injected",
+                    "tenant": reqctx.TENANTS.admit(tenant),
+                })
+            else:
+                SOLVER_SHED_TOTAL.inc({"gate": self.name, "reason": "injected"})
             raise
         clock = self._clock
-        deadline = clock() + deadline_s if deadline_s is not None else None
+        entered = clock()
+        deadline = entered + deadline_s if deadline_s is not None else None
         with self._cond:
             # max_queue bounds WAITERS: a request the idle gate can
             # dispatch immediately never sheds (max_queue=0 = "busy means
@@ -226,10 +299,12 @@ class AdmissionGate:
                     f"({len(self._waiters)} queued, max {self.max_queue}); "
                     f"retry_after_ms="
                     f"{int(self._retry_after_locked() * 1000)}",
+                    tenant=tenant,
                 )
             if (
                 self.brownout_at is not None
                 and self._depth_locked() >= self.brownout_at
+                and self._brownout_sheds(tenant)
             ):
                 raise self._shed_locked(
                     "brownout", self._retry_after_locked(),
@@ -237,10 +312,13 @@ class AdmissionGate:
                     f"{self._depth_locked()} >= {self.brownout_at}): "
                     "serve the local fallback; retry_after_ms="
                     f"{int(self._retry_after_locked() * 1000)}",
+                    tenant=tenant,
                 )
             ticket = object()
             self._waiters.append(ticket)
             self.accepted_total += 1
+            if tenant is not None:
+                self._tenant_enter_locked(tenant)
             self._publish_depth_locked()
             try:
                 while (
@@ -256,6 +334,7 @@ class AdmissionGate:
                                 f"deadline expired after "
                                 f"{deadline_s:.2f}s budget while queued; "
                                 "never dispatched",
+                                tenant=tenant,
                             )
                         timeout = min(timeout, remaining)
                     self._cond.wait(timeout)
@@ -266,9 +345,12 @@ class AdmissionGate:
                         "deadline_expired", None,
                         f"deadline expired after {deadline_s:.2f}s budget "
                         "at dispatch; never dispatched",
+                        tenant=tenant,
                     )
             except BaseException:
                 self._waiters.remove(ticket)
+                if tenant is not None:
+                    self._tenant_exit_locked(tenant)
                 self._publish_depth_locked()
                 self._cond.notify_all()
                 raise
@@ -278,11 +360,36 @@ class AdmissionGate:
             self._publish_depth_locked()
         t0 = clock()
         try:
-            yield (deadline - clock()) if deadline is not None else None
+            if tenant is not None:
+                SOLVER_QUEUE_WAIT.observe(t0 - entered, {
+                    "gate": self.name,
+                    "tenant": reqctx.TENANTS.admit(tenant),
+                })
+            else:
+                SOLVER_QUEUE_WAIT.observe(t0 - entered, {"gate": self.name})
+            remaining = (deadline - t0) if deadline is not None else None
+            if remaining is not None and remaining <= 0:
+                # the structural invariant ("never dispatched past the
+                # deadline") broke between the final pre-dispatch check
+                # and here — count it where dashboards can page on it,
+                # then shed instead of burning device time on dead work
+                with self._cond:
+                    self.deadline_violations += 1
+                    err = self._shed_locked(
+                        "deadline_expired", None,
+                        f"deadline expired after {deadline_s:.2f}s budget "
+                        "between admission and dispatch",
+                        tenant=tenant,
+                    )
+                DEADLINE_VIOLATIONS_TOTAL.inc({"gate": self.name})
+                raise err
+            yield remaining
         finally:
             dt = clock() - t0
             with self._cond:
                 self._inflight -= 1
+                if tenant is not None:
+                    self._tenant_exit_locked(tenant)
                 self._ema = (
                     dt if self._ema is None else 0.8 * self._ema + 0.2 * dt
                 )
@@ -300,6 +407,7 @@ class AdmissionGate:
                 "accepted_total": self.accepted_total,
                 "dispatched_total": self.dispatched_total,
                 "shed": dict(self._shed_counts),
+                "tenants": dict(self._tenant_depth),
                 "deadline_violations": self.deadline_violations,
                 "service_ema_s": (
                     round(self._ema, 4) if self._ema is not None else None
@@ -680,6 +788,14 @@ class SolverHost:
         # bytes (one enabled check per dispatch, tripwired).
         if TRACER.enabled:
             header["trace"] = TRACER.current_trace_id() or ""
+        # tenant propagation (ISSUE 16): same absent-key contract as the
+        # trace key — no bound tenant = no key = byte-identical header to
+        # the PR 15 protocol (tripwired in test_perf_floor.py). The child
+        # binds a RequestContext from it so its spans, flight records, and
+        # metric series attribute to the same tenant as the parent's.
+        tenant = reqctx.current_tenant()
+        if tenant is not None:
+            header["tenant"] = tenant
         try:
             _write_frame(proc.stdin, header, body)
         except (OSError, ValueError) as e:
@@ -1231,15 +1347,24 @@ def host_main(argv=None) -> int:
                 # export_spans' count+byte caps
                 trace_id = header.get("trace")
                 want_spans = trace_id is not None and TRACER.enabled
-                if want_spans:
-                    TRACER.reset_spill()
-                    mark = TRACER.mark()
-                    with TRACER.span(
-                        "solver.host.dispatch",
-                        trace_id=str(trace_id) or None, op=op,
-                    ):
-                        response = handler(request, context=None)
-                else:
+                # tenant binding (ISSUE 16): the parent's bound tenant rode
+                # the request header; re-bind it here so the child's spans,
+                # flight records, and metric series (which flow back to the
+                # parent exposition via the merger) attribute to the same
+                # tenant. Absent key = nothing bound = zero overhead.
+                tenant = header.get("tenant")
+                with contextlib.ExitStack() as dispatch_ctx:
+                    if tenant is not None:
+                        dispatch_ctx.enter_context(reqctx.bind(
+                            reqctx.RequestContext(tenant=str(tenant))
+                        ))
+                    if want_spans:
+                        TRACER.reset_spill()
+                        mark = TRACER.mark()
+                        dispatch_ctx.enter_context(TRACER.span(
+                            "solver.host.dispatch",
+                            trace_id=str(trace_id) or None, op=op,
+                        ))
                     response = handler(request, context=None)
                 rheader: Dict[str, object] = {
                     "op": "result", "id": rid,

@@ -27,6 +27,7 @@ import numpy as np
 from karpenter_core_tpu import chaos
 from karpenter_core_tpu.metrics.registry import NAMESPACE, REGISTRY
 from karpenter_core_tpu.obs import envflags
+from karpenter_core_tpu.obs import reqctx
 from karpenter_core_tpu.obs import TRACE_HEADER, TRACER
 from karpenter_core_tpu.obs.log import get_logger
 
@@ -227,6 +228,19 @@ def geometry_json(snap) -> str:
     )
 
 
+def _request_metadata(trace_id: Optional[str]):
+    """Outbound gRPC metadata for a solver RPC: the trace id plus the
+    calling thread's bound tenant (x-karpenter-tenant). Neither set ->
+    None, the PR 15 wire shape — attribution off adds zero metadata."""
+    metadata = []
+    if trace_id:
+        metadata.append((TRACE_HEADER, trace_id))
+    tenant = reqctx.current_tenant()
+    if tenant is not None:
+        metadata.append((reqctx.TENANT_HEADER, tenant))
+    return tuple(metadata) if metadata else None
+
+
 # ---------------------------------------------------------------------------
 # server
 
@@ -402,17 +416,30 @@ class SolverService:
         # analog): the server-side span joins the control plane's trace so
         # one Perfetto timeline covers both processes
         trace_id = None
+        tenant = None
         if context is not None:
             try:
                 for k, v in context.invocation_metadata() or ():
                     if k == TRACE_HEADER:
                         trace_id = v
+                    elif k == reqctx.TENANT_HEADER:
+                        tenant = v
             except Exception:  # noqa: BLE001 — tracing must never fail a solve
                 pass
-        with TRACER.span(
-            "solver.service.solve", trace_id=trace_id,
-            tensors=len(request.tensors),
-        ):
+        with contextlib.ExitStack() as stack:
+            # adopt the client's tenant (x-karpenter-tenant metadata, the
+            # gRPC analog of the frame header's tenant key) BEFORE opening
+            # the span, so the span and everything under the gate
+            # attributes to it; an in-process caller (the solver-host
+            # child) arrives already bound and carries no metadata
+            if tenant is not None:
+                stack.enter_context(reqctx.bind(
+                    reqctx.RequestContext(tenant=str(tenant))
+                ))
+            stack.enter_context(TRACER.span(
+                "solver.service.solve", trace_id=trace_id,
+                tensors=len(request.tensors),
+            ))
             return self._gated(request, context, self._solve_traced)
 
     @staticmethod
@@ -600,17 +627,25 @@ class SolverService:
         and resident verdict tensor — and returns [K, 4] verdicts (and the
         [K, N] slot plane on request)."""
         trace_id = None
+        tenant = None
         if context is not None:
             try:
                 for k, v in context.invocation_metadata() or ():
                     if k == TRACE_HEADER:
                         trace_id = v
+                    elif k == reqctx.TENANT_HEADER:
+                        tenant = v
             except Exception:  # noqa: BLE001 — tracing must never fail a replan
                 pass
-        with TRACER.span(
-            "solver.service.replan", trace_id=trace_id,
-            tensors=len(request.tensors),
-        ):
+        with contextlib.ExitStack() as stack:
+            if tenant is not None:
+                stack.enter_context(reqctx.bind(
+                    reqctx.RequestContext(tenant=str(tenant))
+                ))
+            stack.enter_context(TRACER.span(
+                "solver.service.replan", trace_id=trace_id,
+                tensors=len(request.tensors),
+            ))
             return self._gated(request, context, self._replan_traced)
 
     def _replan_traced(self, request: pb.SolveRequest) -> pb.SolveResponse:
@@ -1214,7 +1249,7 @@ class RemoteSolver:
             )
         with TRACER.span("solver.service.replan_request") as sp:
             trace_id = getattr(sp, "trace_id", None) or TRACER.current_trace_id()
-            metadata = ((TRACE_HEADER, trace_id),) if trace_id else None
+            metadata = _request_metadata(trace_id)
             response = self._invoke_solve(request, metadata, stub=self._replan)
         if response.error:
             raise error_from_string(response.error)
@@ -1280,7 +1315,7 @@ class RemoteSolver:
         # handler's span lands in the same trace (stub-interceptor analog)
         with TRACER.span("solver.service.request") as sp:
             trace_id = getattr(sp, "trace_id", None) or TRACER.current_trace_id()
-            metadata = ((TRACE_HEADER, trace_id),) if trace_id else None
+            metadata = _request_metadata(trace_id)
             response = self._invoke_solve(request, metadata)
         if response.error:
             raise error_from_string(response.error)

@@ -20,6 +20,7 @@ from typing import Optional
 
 from karpenter_core_tpu.metrics.registry import NAMESPACE, REGISTRY
 from karpenter_core_tpu.obs import envflags
+from karpenter_core_tpu.obs import reqctx
 
 # compiled-program cache observability: every in-process executable-cache
 # lookup (TPUSolver._compiled, SolverService._compiled) records a hit or a
@@ -43,12 +44,14 @@ COMPILE_SECONDS = REGISTRY.histogram(
 
 
 def record_lookup(site: str, hit: bool) -> None:
-    """One executable-cache lookup outcome (site: 'tpu_solver'/'service')."""
-    (CACHE_HITS if hit else CACHE_MISSES).inc({"site": site})
+    """One executable-cache lookup outcome (site: 'tpu_solver'/'service').
+    A bound request context adds a tenant label — compile-cost attribution:
+    which tenant's request forced the cold compile (ISSUE 16)."""
+    (CACHE_HITS if hit else CACHE_MISSES).inc(reqctx.tenant_labels(site=site))
 
 
 def record_compile_seconds(seconds: float) -> None:
-    COMPILE_SECONDS.observe(seconds)
+    COMPILE_SECONDS.observe(seconds, reqctx.tenant_labels())
 
 
 def enable_persistent_cache(cache_dir: Optional[str] = None) -> Optional[str]:

@@ -1,0 +1,38 @@
+"""Seeded metric-labels violations: every flavor the pass must catch."""
+REQUEST_TOTAL = object()
+QUEUE_DEPTH = object()
+SOLVE_SECONDS = object()
+
+
+def raw_tenant_in_literal(tenant):
+    # tenant value straight off the request -> metric-tenant-guard
+    REQUEST_TOTAL.inc({"tenant": tenant})
+
+
+def dynamic_key(key):
+    # non-constant label key -> metric-label-keys
+    REQUEST_TOTAL.inc({key: "a"})
+
+
+def star_unpack(extra):
+    # ** unpacking hides the key set -> metric-label-keys
+    QUEUE_DEPTH.set(1.0, {"gate": "host", **extra})
+
+
+def untracked_name(labels):
+    # labels arrived as a parameter: nothing ties its keys down
+    SOLVE_SECONDS.observe(0.5, labels)
+
+
+def tracked_dict_goes_bad(tenant):
+    labels = {"gate": "host"}
+    labels["tenant"] = tenant  # raw request string into a tracked dict
+    REQUEST_TOTAL.inc(labels)
+
+
+def comprehension_labels(keys):
+    REQUEST_TOTAL.inc({k: "v" for k in keys})
+
+
+def suppressed_site(tenant):
+    REQUEST_TOTAL.inc({"tenant": tenant})  # lint: disable=metric-tenant-guard

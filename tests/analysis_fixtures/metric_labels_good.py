@@ -1,0 +1,42 @@
+"""Compliant label idioms: literals, guard calls, tracked build-then-observe."""
+from karpenter_core_tpu.obs.reqctx import TENANTS, tenant_labels
+
+REQUEST_TOTAL = object()
+QUEUE_DEPTH = object()
+SOLVE_SECONDS = object()
+CACHE_HITS = object()
+CACHE_MISSES = object()
+
+
+def unlabeled():
+    REQUEST_TOTAL.inc()
+    SOLVE_SECONDS.observe(0.5)
+    SOLVE_SECONDS.observe(0.5, None)
+
+
+def static_literal():
+    REQUEST_TOTAL.inc({"gate": "host", "reason": "brownout"})
+
+
+def guarded_tenant(tenant):
+    REQUEST_TOTAL.inc({"tenant": TENANTS.admit(tenant)})
+
+
+def helper_minted(tenant):
+    REQUEST_TOTAL.inc(tenant_labels(reason="wedged"))
+    SOLVE_SECONDS.observe(1.0, tenant_labels())
+
+
+def conditional_instrument(hit):
+    (CACHE_HITS if hit else CACHE_MISSES).inc({"site": "service"})
+
+
+def build_then_observe(tenant):
+    labels = {"gate": "host"}
+    if tenant is not None:
+        labels["tenant"] = TENANTS.admit(tenant)
+    QUEUE_DEPTH.set(2.0, labels)
+
+
+def lowercase_receiver_is_not_an_instrument(event, labels):
+    event.set(labels)  # threading.Event-style call: out of scope

@@ -30,6 +30,7 @@ def test_registry_covers_the_documented_rule_set():
         "monotonic-time", "monotonic-time-default", "bare-except",
         "thread-discipline", "guarded-by", "guarded-by-v2", "no-print",
         "proc-group", "proc-kill-group", "thread-join", "atomic-write",
+        "metric-tenant-guard", "metric-label-keys",
     }
 
 

@@ -10,6 +10,7 @@ from karpenter_core_tpu.analysis.concurrency import ConcurrencyPass
 from karpenter_core_tpu.analysis.core import collect_sources, load_tree, run_passes
 from karpenter_core_tpu.analysis.envdiscipline import EnvDisciplinePass
 from karpenter_core_tpu.analysis.layering import LayeringPass
+from karpenter_core_tpu.analysis.metriclabels import MetricLabelsPass
 from karpenter_core_tpu.analysis.montime import MonotonicTimePass
 from karpenter_core_tpu.analysis.noprint import NoPrintPass
 from karpenter_core_tpu.analysis.procdiscipline import ProcessDisciplinePass
@@ -333,6 +334,54 @@ def test_noprint_flags_unparseable_files(tmp_path):
     violations = NoPrintPass().run([sf], fixture_config())
     assert violations and violations[0].rule == "no-print"
     assert "does not parse" in violations[0].message
+
+
+# -- metric labels --------------------------------------------------------
+
+
+def test_metric_labels_catches_all_seeded_flavors():
+    violations, _ = run_one(MetricLabelsPass(), "metric_labels_bad.py")
+    by_line = {v.line: v for v in violations}
+    # raw tenant in a literal, tracked dict fed a raw tenant
+    assert by_line[9].rule == "metric-tenant-guard"
+    assert by_line[30].rule == "metric-tenant-guard"
+    # dynamic key, ** unpacking, untracked parameter, comprehension
+    assert by_line[14].rule == "metric-label-keys"
+    assert by_line[19].rule == "metric-label-keys"
+    assert by_line[24].rule == "metric-label-keys"
+    assert by_line[34].rule == "metric-label-keys"
+    # line 38 carries a suppression comment: run_passes subtracts it, and
+    # the raw pass output is the only place it appears
+    assert set(by_line) == {9, 14, 19, 24, 30, 34, 38}
+
+
+def test_metric_labels_suppression_subtracts():
+    sf = load_fixture("metric_labels_bad.py")
+    result = run_passes([sf], fixture_config(), passes=[MetricLabelsPass()])
+    assert {v.line for v in result.suppressed} == {38}
+    assert 38 not in {v.line for v in result.violations}
+
+
+def test_metric_labels_quiet_on_good_idioms():
+    violations, _ = run_one(MetricLabelsPass(), "metric_labels_good.py")
+    assert violations == []
+
+
+def test_metric_labels_whole_package_is_clean():
+    """Every real instrument call site follows the label discipline —
+    the attribution plane's cardinality guarantee, enforced forever."""
+    import karpenter_core_tpu
+
+    root = os.path.dirname(
+        os.path.dirname(os.path.abspath(karpenter_core_tpu.__file__))
+    )
+    files = collect_sources(root, "karpenter_core_tpu")
+    result = run_passes(
+        files, fixture_config(repo_root=root,
+                              package_name="karpenter_core_tpu"),
+        passes=[MetricLabelsPass()],
+    )
+    assert result.violations == [], [v.render() for v in result.violations]
 
 
 # -- suppression syntax (framework-level, via run_passes) -----------------

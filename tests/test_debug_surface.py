@@ -254,3 +254,92 @@ def test_debug_timeline_gated_on_profiling():
         assert err.value.code == 404
     finally:
         server.shutdown()
+
+
+# -- ISSUE 16: /debug/ index, /debug/slo, /debug/tenants ------------------
+
+
+def test_debug_index_lists_every_endpoint_and_is_ungated():
+    """/debug/ (ISSUE 16): the ungated discovery page — every endpoint in
+    the handler chain listed with its gating, so an operator never has to
+    read the source to know what this process serves."""
+    from karpenter_core_tpu.operator import __main__ as entry, new_operator
+
+    operator = new_operator(
+        fake.FakeCloudProvider(), settings=entry.settings_from_env()
+    )
+    server = entry.serve_health(operator, 0, profiling=False)
+    port = server.server_address[1]
+    try:
+        status, body = _get(port, "/debug/")
+        assert status == 200
+        index = json.loads(body)
+        assert index["profiling_enabled"] is False
+        paths = {e["path"]: e for e in index["endpoints"]}
+        # the index covers the whole surface, including itself being served
+        for must in ("/metrics", "/debug/health", "/debug/slo",
+                     "/debug/tenants", "/debug/trace", "/debug/solves"):
+            assert must in paths, must
+        assert paths["/debug/health"]["profiling_gated"] is False
+        assert paths["/debug/slo"]["profiling_gated"] is True
+        # with profiling off, gated endpoints are listed but disabled
+        assert paths["/debug/slo"]["enabled"] is False
+        assert paths["/metrics"]["enabled"] is True
+        # /debug (no trailing slash) serves the same page
+        status, body2 = _get(port, "/debug")
+        assert status == 200 and json.loads(body2) == index
+    finally:
+        server.shutdown()
+
+
+def test_debug_slo_and_tenants_served_and_gated():
+    """/debug/slo serves the engine digest; /debug/tenants serves the
+    per-tenant cost digest; both 404 without profiling."""
+    from karpenter_core_tpu.controllers.provisioning.provisioner import (
+        ADMISSION_TO_BIND,
+    )
+    from karpenter_core_tpu.obs import reqctx
+    from karpenter_core_tpu.operator import __main__ as entry, new_operator
+
+    operator = new_operator(
+        fake.FakeCloudProvider(), settings=entry.settings_from_env()
+    )
+    engine = entry.build_slo_engine()
+    ADMISSION_TO_BIND.observe(
+        0.25, {"tenant": reqctx.TENANTS.admit("debug-tenant-a")}
+    )
+    server = entry.serve_health(operator, 0, profiling=True, slo=engine)
+    port = server.server_address[1]
+    try:
+        status, body = _get(port, "/debug/slo")
+        assert status == 200
+        digest = json.loads(body)
+        names = {o["name"] for o in digest["objectives"]}
+        assert "admission-to-bind" in names
+        assert "solve-duration" in names
+        # the observed tenant has its own burn-rate row
+        assert any(
+            row["slo"] == "admission-to-bind"
+            and row.get("tenant") == "debug-tenant-a"
+            for row in digest["series"]
+        )
+
+        status, body = _get(port, "/debug/tenants")
+        assert status == 200
+        tenants = json.loads(body)
+        assert "debug-tenant-a" in tenants["tenants"]
+        row = tenants["tenants"]["debug-tenant-a"]
+        assert row["admission_to_bind_s"]["count"] >= 1
+        assert tenants["guard"]["cap"] == reqctx.DEFAULT_TENANT_CAP
+    finally:
+        server.shutdown()
+
+    gated = entry.serve_health(operator, 0, profiling=False, slo=engine)
+    port = gated.server_address[1]
+    try:
+        for path in ("/debug/slo", "/debug/tenants"):
+            with pytest.raises(urllib.error.HTTPError) as err:
+                _get(port, path)
+            assert err.value.code == 404, path
+    finally:
+        gated.shutdown()

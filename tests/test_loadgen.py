@@ -16,12 +16,14 @@ from karpenter_core_tpu.loadgen import (
     SCENARIOS,
     SoakDriver,
 )
+from karpenter_core_tpu.api.labels import TENANT_LABEL_KEY
 from karpenter_core_tpu.loadgen.scenarios import (
     ANTI_APPS,
     APPS,
     CPU_STEPS,
     MEM_STEPS,
     SPREAD_APPS,
+    TENANT_POOL,
 )
 from karpenter_core_tpu.testing import FakeClock
 
@@ -91,6 +93,7 @@ def test_scenario_mixer_bounded_vocabulary():
     for scenario in SCENARIOS:
         for pod in mixer.make(scenario, 8):
             assert pod.metadata.labels["app"] in vocab
+            assert pod.metadata.labels[TENANT_LABEL_KEY] in TENANT_POOL
             cpu = pod.spec.containers[0].resources.requests.get("cpu")
             assert cpu is None or float(cpu) in CPU_STEPS
             mem = pod.spec.containers[0].resources.requests.get("memory")

@@ -625,3 +625,70 @@ def test_span_export_disabled_path_cost(monkeypatch):
     assert len(payload["spans"]) <= MAX_EXPORT_SPANS
     assert payload["dropped"] >= 100
     assert len(_json.dumps(payload)) < MAX_EXPORT_BYTES + 4096
+
+
+def test_attribution_off_path_cost():
+    """ISSUE 16 tripwire: with NO request context bound, the attribution
+    plane is free — the frame header gets no tenant key (zero extra frame
+    bytes, same contract as the trace key), tenant_labels() mints zero new
+    dicts, the guard's slot table is untouched, and current_tenant() costs
+    a thread-local read."""
+    import io
+    import timeit
+
+    from karpenter_core_tpu.obs import reqctx
+    from karpenter_core_tpu.solver.host import _write_frame
+
+    assert reqctx.current_tenant() is None
+
+    # zero extra frame bytes: the _call_locked contract adds the key only
+    # when a tenant is bound, and sort_keys JSON makes absent-key == the
+    # byte-exact PR 15 header
+    header = {"op": "solve", "id": 1, "len": 64}
+    tenant = reqctx.current_tenant()
+    if tenant is not None:  # the exact production conditional
+        header["tenant"] = tenant
+    buf_now, buf_legacy = io.BytesIO(), io.BytesIO()
+    _write_frame(buf_now, header)
+    _write_frame(buf_legacy, {"op": "solve", "id": 1, "len": 64})
+    assert buf_now.getvalue() == buf_legacy.getvalue()
+
+    # zero new label allocations: unset-path tenant_labels returns the
+    # base dict unchanged (identity, not a copy) or None
+    base = {"reason": "wedged"}
+    out = reqctx.tenant_labels(**base)
+    assert out == base
+    assert reqctx.tenant_labels() is None
+
+    # the guard's slot table is untouched by unset-path traffic
+    slots_before = reqctx.TENANTS.stats()["slots"]
+    for _ in range(1000):
+        reqctx.tenant_labels()
+        reqctx.current_tenant()
+    assert reqctx.TENANTS.stats()["slots"] == slots_before
+
+    # per-dispatch cost: a thread-local read, same budget as the tracer's
+    # disabled gate (generous multiplier — regression tripwire, not a bench)
+    n = 200_000
+    baseline = timeit.timeit("f()", globals={"f": lambda: None}, number=n)
+    t_read = timeit.timeit(
+        "ct()", globals={"ct": reqctx.current_tenant}, number=n
+    )
+    assert t_read < baseline * 20 + 0.5, (
+        f"unset-path current_tenant() {t_read / n * 1e9:.0f}ns/call"
+    )
+
+
+def test_tenant_guard_flood_stays_bounded():
+    """ISSUE 16 tripwire: a label-value flood (adversarial or buggy tenant
+    strings) can never mint more than cap+1 label values; admit() on a hot
+    slot stays allocation-light."""
+    from karpenter_core_tpu.obs.reqctx import OVERFLOW_TENANT, TenantGuard
+
+    guard = TenantGuard(cap=8)
+    minted = {guard.admit(f"t-{i}") for i in range(10_000)}
+    assert len(minted) == 9  # 8 slots + overflow
+    assert OVERFLOW_TENANT in minted
+    stats = guard.stats()
+    assert stats["slots"] == 8
+    assert stats["overflowed"] == 10_000 - 8

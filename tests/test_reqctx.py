@@ -1,0 +1,246 @@
+"""Request-context attribution plane (ISSUE 16): context binding, the
+tenant cardinality guard, guard-aware label minting, and the SLO engine's
+burn-rate math under a fake clock."""
+import threading
+
+import pytest
+
+from karpenter_core_tpu.metrics.registry import Histogram
+from karpenter_core_tpu.obs import reqctx
+from karpenter_core_tpu.obs.reqctx import (
+    DEFAULT_TENANT_CAP,
+    OVERFLOW_TENANT,
+    RequestContext,
+    TenantGuard,
+    bind,
+    current,
+    current_tenant,
+)
+from karpenter_core_tpu.obs.slo import Objective, SloEngine
+
+
+# -- context binding ------------------------------------------------------
+
+
+def test_bind_nesting_and_unwind():
+    assert current() is None
+    assert current_tenant() is None
+    outer = RequestContext(tenant="team-a", request_id="r1")
+    inner = RequestContext(tenant="team-b", priority=2)
+    with bind(outer):
+        assert current() is outer
+        assert current_tenant() == "team-a"
+        with bind(inner):
+            assert current() is inner
+            assert current_tenant() == "team-b"
+        assert current() is outer
+    assert current() is None
+
+
+def test_bind_unwinds_on_exception():
+    with pytest.raises(RuntimeError):
+        with bind(RequestContext(tenant="boom")):
+            raise RuntimeError("x")
+    assert current() is None
+
+
+def test_bind_is_thread_local():
+    seen = {}
+
+    def worker():
+        seen["tenant_in_thread"] = current_tenant()
+        with bind(RequestContext(tenant="thread-tenant")):
+            seen["bound_in_thread"] = current_tenant()
+
+    with bind(RequestContext(tenant="main-tenant")):
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        assert current_tenant() == "main-tenant"
+    # the spawned thread never saw the main thread's binding
+    assert seen == {
+        "tenant_in_thread": None,
+        "bound_in_thread": "thread-tenant",
+    }
+
+
+def test_bind_pushes_log_context():
+    """Every log line under a bind carries tenant/request_id without the
+    call site knowing about attribution (reqctx.bind -> log.bound)."""
+    import karpenter_core_tpu.obs.log as log_mod
+
+    was_level, was_stream = log_mod.SINK.level, log_mod.SINK.stream
+    log_mod.SINK.configure(level=log_mod.INFO, stream=None)
+    try:
+        with bind(RequestContext(tenant="log-tenant", request_id="req-9")):
+            log_mod.get_logger("karpenter.test").info("attribution probe")
+        records = [
+            r for r in log_mod.SINK.records()
+            if r.get("msg") == "attribution probe"
+        ]
+        assert records, "probe line not captured"
+        assert records[-1]["tenant"] == "log-tenant"
+        assert records[-1]["request_id"] == "req-9"
+    finally:
+        log_mod.SINK.level, log_mod.SINK.stream = was_level, was_stream
+
+
+# -- cardinality guard ----------------------------------------------------
+
+
+def test_guard_caps_and_overflows():
+    guard = TenantGuard(cap=3)
+    assert guard.admit(None) is None
+    assert guard.admit("a") == "a"
+    assert guard.admit("b") == "b"
+    assert guard.admit("c") == "c"
+    # cap hit: new tenants share the overflow label, slots stay fixed
+    assert guard.admit("d") == OVERFLOW_TENANT
+    assert guard.admit("e") == OVERFLOW_TENANT
+    # known tenants keep their slot even after overflow starts
+    assert guard.admit("a") == "a"
+    assert guard.tenants() == ("a", "b", "c")
+    assert guard.stats() == {"slots": 3, "cap": 3, "overflowed": 2}
+
+
+def test_guard_flood_stays_bounded():
+    guard = TenantGuard(cap=4)
+    labels = {guard.admit(f"tenant-{i}") for i in range(1000)}
+    # 4 real slots + the overflow bucket: the label-value universe is fixed
+    assert len(labels) == 5
+    assert OVERFLOW_TENANT in labels
+    assert guard.stats()["slots"] == 4
+
+
+def test_module_guard_default_cap():
+    assert reqctx.TENANTS.cap == DEFAULT_TENANT_CAP
+
+
+def test_tenant_labels_minting():
+    # unset: base passes through untouched (None when empty)
+    assert reqctx.tenant_labels() is None
+    base = reqctx.tenant_labels(reason="wedged")
+    assert base == {"reason": "wedged"}
+    with bind(RequestContext(tenant="mint-a")):
+        assert reqctx.tenant_labels() == {"tenant": "mint-a"}
+        assert reqctx.tenant_labels(reason="wedged") == {
+            "reason": "wedged",
+            "tenant": "mint-a",
+        }
+
+
+# -- SLO engine -----------------------------------------------------------
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+
+def make_engine(hist, threshold=1.0, target=0.9, base_labels=None):
+    clock = FakeClock()
+    engine = SloEngine(
+        [Objective(
+            name="probe", histogram=hist, threshold_s=threshold,
+            target=target, base_labels=base_labels or {},
+        )],
+        windows=(("10s", 10.0), ("60s", 60.0)),
+        clock=clock,
+    )
+    return engine, clock
+
+
+def test_slo_burn_rate_math():
+    hist = Histogram("t_slo_math", buckets=[0.5, 1.0, 5.0])
+    engine, clock = make_engine(hist)  # target 0.9 -> 10% budget
+    engine.sample()  # baseline at t=1000
+    # 10 observations: 8 good (<=1.0), 2 bad -> error rate 0.2, burn 2.0
+    for _ in range(8):
+        hist.observe(0.2)
+    for _ in range(2):
+        hist.observe(3.0)
+    clock.t += 60.0
+    rows = engine.evaluate()
+    agg = next(r for r in rows if r["tenant"] is None)
+    assert agg["traffic"] == 10
+    assert agg["windows"]["60s"]["burn_rate"] == pytest.approx(2.0)
+    # budget window == longest window: remaining = 1 - burn = -1.0
+    assert agg["budget_remaining"] == pytest.approx(-1.0)
+
+
+def test_slo_per_tenant_series_and_aggregate():
+    hist = Histogram("t_slo_tenants", buckets=[0.5, 1.0, 5.0])
+    engine, clock = make_engine(hist)
+    engine.sample()
+    # tenant-a: all good; tenant-b: all bad
+    for _ in range(5):
+        hist.observe(0.1, {"tenant": "a"})
+    for _ in range(5):
+        hist.observe(4.0, {"tenant": "b"})
+    clock.t += 60.0
+    rows = {r["tenant"]: r for r in engine.evaluate()}
+    assert rows["a"]["budget_remaining"] == pytest.approx(1.0)
+    assert rows["b"]["windows"]["60s"]["burn_rate"] == pytest.approx(10.0)
+    # the aggregate sums BOTH tenants: error rate 0.5 -> burn 5.0
+    assert rows[None]["windows"]["60s"]["burn_rate"] == pytest.approx(5.0)
+    assert rows[None]["traffic"] == 10
+
+
+def test_slo_budget_exhausted_hook():
+    hist = Histogram("t_slo_budget", buckets=[0.5, 1.0, 5.0])
+    engine, clock = make_engine(hist)
+    engine.sample()
+    hist.observe(0.1, {"tenant": "calm"})
+    for _ in range(4):
+        hist.observe(4.0, {"tenant": "burny"})
+    clock.t += 60.0
+    engine.sample()
+    assert engine.budget_exhausted("burny") is True
+    assert engine.budget_exhausted("calm") is False
+    # unknown tenants have burned nothing; None can never be shed by budget
+    assert engine.budget_exhausted("never-seen") is False
+    assert engine.budget_exhausted(None) is False
+
+
+def test_slo_base_labels_narrow_series():
+    hist = Histogram("t_slo_base", buckets=[0.5, 1.0, 5.0])
+    engine, clock = make_engine(
+        hist, base_labels={"context": "provisioning"}
+    )
+    engine.sample()
+    hist.observe(4.0, {"context": "consolidation"})  # outside the objective
+    hist.observe(0.1, {"context": "provisioning", "tenant": "a"})
+    clock.t += 60.0
+    rows = {r["tenant"]: r for r in engine.evaluate()}
+    # only the provisioning series counted: all good, nothing burned
+    assert rows[None]["traffic"] == 1
+    assert rows[None]["budget_remaining"] == pytest.approx(1.0)
+    assert "a" in rows
+
+
+def test_slo_families_exposition_shape():
+    hist = Histogram("t_slo_fams", buckets=[0.5, 1.0, 5.0])
+    engine, clock = make_engine(hist)
+    engine.sample()
+    hist.observe(0.1, {"tenant": "fam-a"})
+    clock.t += 60.0
+    fams = engine.families()
+    (name, fam), = fams.items()
+    assert name.endswith("_slo_error_budget_remaining")
+    assert fam["kind"] == "gauge"
+    labels_seen = [dict(labels) for labels, _ in fam["series"]]
+    # aggregate row has NO tenant label; tenant row carries it
+    assert {"slo": "probe"} in labels_seen
+    assert {"slo": "probe", "tenant": "fam-a"} in labels_seen
+
+
+def test_slo_no_traffic_means_untouched_budget():
+    hist = Histogram("t_slo_quiet", buckets=[0.5, 1.0, 5.0])
+    engine, clock = make_engine(hist)
+    rows = engine.evaluate()
+    agg = next(r for r in rows if r["tenant"] is None)
+    assert agg["budget_remaining"] == 1.0
+    assert agg["traffic"] == 0

@@ -1,0 +1,305 @@
+"""End-to-end tenant attribution (ISSUE 16): the tenant survives the
+fallback ladder, gate sheds bill the right tenant, the brownout preference
+hook sheds only budget-exhausted tenants, host kill/respawn never
+double-counts tenant series, and the tenant-less frame header is
+byte-identical to the pre-attribution protocol."""
+import io
+import json
+import struct
+import threading
+
+import pytest
+
+from karpenter_core_tpu.api import labels as api_labels
+from karpenter_core_tpu.api.settings import Settings
+from karpenter_core_tpu.cloudprovider import fake
+from karpenter_core_tpu.metrics.registry import ProcessSeriesMerger
+from karpenter_core_tpu.obs import reqctx
+from karpenter_core_tpu.operator import new_operator
+from karpenter_core_tpu.solver.host import (
+    AdmissionGate,
+    SOLVER_SHED_TOTAL,
+    _read_frame,
+    _write_frame,
+)
+from karpenter_core_tpu.solver.service import SolverResourceExhaustedError
+from karpenter_core_tpu.testing import FakeClock, make_pod, make_provisioner
+
+
+# -- fallback ladder ------------------------------------------------------
+
+
+def test_fallback_ladder_attributes_tenant():
+    """A tenant-labeled pod batch solved through a dead primary bills the
+    fallback AND the admission-to-bind latency to that tenant: the binding
+    the provisioner establishes survives the device -> greedy ladder."""
+    from karpenter_core_tpu.controllers.provisioning.provisioner import (
+        ADMISSION_TO_BIND,
+    )
+    from karpenter_core_tpu.solver.fallback import (
+        SOLVER_FALLBACK_TOTAL,
+        ResilientSolver,
+    )
+    from karpenter_core_tpu.solver.tpu_solver import GreedySolver
+
+    class DeadSolver:
+        supports_batched_replan = True
+
+        def solve(self, *a, **k):
+            raise AssertionError("dead backend must never be invoked")
+
+    clock = FakeClock()
+    resilient = ResilientSolver(
+        DeadSolver(), GreedySolver(), clock=clock,
+        reprobe_interval=300.0, prober=lambda: "backend down",
+        small_batch_work_max=0,
+    )
+    cp = fake.FakeCloudProvider(fake.instance_types(10))
+    op = new_operator(cp, settings=Settings(), solver=resilient, clock=clock)
+    resilient.recorder = op.recorder
+    op.kube_client.create(make_provisioner(name="default"))
+    tenant_labels = {"reason": "backend_unavailable", "tenant": "attr-team"}
+    before = SOLVER_FALLBACK_TOTAL.get(tenant_labels) or 0
+    bind_before = ADMISSION_TO_BIND.snapshot({"tenant": "attr-team"})[1]
+    pod = make_pod(requests={"cpu": "1"})
+    pod.metadata.labels = dict(
+        pod.metadata.labels or {}, **{api_labels.TENANT_LABEL_KEY: "attr-team"}
+    )
+    op.kube_client.create(pod)
+    op.step()
+    assert op.kube_client.list("Machine"), "fallback must still provision"
+    after = SOLVER_FALLBACK_TOTAL.get(tenant_labels) or 0
+    assert after > before, (
+        "the fallback counter must carry the tenant the provisioner bound"
+    )
+    assert ADMISSION_TO_BIND.snapshot({"tenant": "attr-team"})[1] > bind_before
+
+
+def test_batch_tenant_is_plurality():
+    from karpenter_core_tpu.controllers.provisioning.provisioner import (
+        ProvisioningController,
+    )
+
+    def pod_with(tenant):
+        p = make_pod(requests={"cpu": "1"})
+        if tenant:
+            p.metadata.labels = dict(
+                p.metadata.labels or {},
+                **{api_labels.TENANT_LABEL_KEY: tenant},
+            )
+        return p
+
+    pods = [pod_with("a"), pod_with("b"), pod_with("b"), pod_with(None)]
+    tenants = [
+        t for t in (ProvisioningController._pod_tenant(p) for p in pods) if t
+    ]
+    assert max(set(tenants), key=tenants.count) == "b"
+    assert ProvisioningController._pod_tenant(pod_with(None)) is None
+
+
+# -- gate sheds and the brownout preference hook --------------------------
+
+
+def _occupied_gate(**kwargs):
+    gate = AdmissionGate(name="tenant-test", **kwargs)
+    release = threading.Event()
+    started = threading.Event()
+
+    def occupy():
+        with gate.admitted():
+            started.set()
+            release.wait(20)
+
+    t = threading.Thread(target=occupy, daemon=True, name="gate-occupier")
+    t.start()
+    assert started.wait(5)
+    return gate, release, t
+
+
+def test_queue_full_shed_bills_the_tenant():
+    gate, release, t = _occupied_gate(max_queue=0)
+    labels = {"gate": "tenant-test", "reason": "queue_full",
+              "tenant": "shed-team"}
+    before = SOLVER_SHED_TOTAL.get(labels) or 0
+    with reqctx.bind(reqctx.RequestContext(tenant="shed-team")):
+        with pytest.raises(SolverResourceExhaustedError):
+            with gate.admitted():
+                pass
+    assert (SOLVER_SHED_TOTAL.get(labels) or 0) == before + 1
+    release.set()
+    t.join(5)
+
+
+def test_brownout_prefers_budget_exhausted_tenants():
+    """With the preference hook armed, the brownout band sheds ONLY the
+    tenants the hook condemns; everyone else rides through to dispatch."""
+    gate, release, t = _occupied_gate(
+        max_queue=8, brownout_at=1,
+        brownout_prefer=lambda tenant: tenant == "burny",
+    )
+    with reqctx.bind(reqctx.RequestContext(tenant="burny")):
+        with pytest.raises(SolverResourceExhaustedError) as exc:
+            with gate.admitted():
+                pass
+    assert exc.value.shed_reason == "brownout"
+
+    passed = threading.Event()
+
+    def calm_request():
+        with reqctx.bind(reqctx.RequestContext(tenant="calm")):
+            with gate.admitted():
+                passed.set()
+
+    calm = threading.Thread(target=calm_request, daemon=True)
+    calm.start()
+    release.set()
+    t.join(5)
+    calm.join(5)
+    assert passed.is_set(), (
+        "a tenant the hook does not condemn must ride through the brownout "
+        "band and dispatch"
+    )
+    assert gate.stats()["shed"].get("brownout", 0) == 1
+
+
+def test_brownout_hook_failure_fails_closed():
+    def sick_hook(tenant):
+        raise RuntimeError("hook crashed")
+
+    gate, release, t = _occupied_gate(
+        max_queue=8, brownout_at=1, brownout_prefer=sick_hook,
+    )
+    with reqctx.bind(reqctx.RequestContext(tenant="anyone")):
+        with pytest.raises(SolverResourceExhaustedError) as exc:
+            with gate.admitted():
+                pass
+    assert exc.value.shed_reason == "brownout", (
+        "a sick hook must not widen admission: fail closed, shed"
+    )
+    release.set()
+    t.join(5)
+
+
+def test_gate_stats_track_per_tenant_depth():
+    gate, release, t = _occupied_gate(max_queue=4)
+    entered = threading.Event()
+
+    def queued_request():
+        with reqctx.bind(reqctx.RequestContext(tenant="depth-team")):
+            with gate.admitted():
+                pass
+
+    q = threading.Thread(target=queued_request, daemon=True)
+    q.start()
+    deadline = threading.Event()
+    for _ in range(100):
+        if gate.stats()["tenants"].get("depth-team") == 1:
+            entered.set()
+            break
+        deadline.wait(0.05)
+    assert entered.is_set(), gate.stats()
+    release.set()
+    t.join(5)
+    q.join(5)
+    # fully drained: the per-tenant depth series is deleted, not zeroed
+    assert "depth-team" not in gate.stats()["tenants"]
+
+
+# -- kill/respawn fold-once with tenant series ----------------------------
+
+
+def test_merger_folds_tenant_series_exactly_once_across_respawn():
+    """The respawn-idempotency contract holds for tenant-labeled series: a
+    child killed mid-dispatch counting 7 solves for tenant-a contributes 7
+    forever; its successor counts from 0 on top; re-ingesting a snapshot
+    (the per-dispatch stats ride-along) never double-counts."""
+    merger = ProcessSeriesMerger(process="solver-host")
+
+    def fams(n_a, n_b):
+        return {
+            "karpenter_compile_cache_hits": {
+                "kind": "counter", "help": "h",
+                "series": [
+                    ({"site": "service", "tenant": "a"}, n_a),
+                    ({"site": "service", "tenant": "b"}, n_b),
+                ],
+            }
+        }
+
+    def totals():
+        out = {}
+        fam = merger.families()["karpenter_compile_cache_hits"]
+        for labels, value in fam["series"]:
+            assert labels["process"] == "solver-host"
+            out[labels["tenant"]] = out.get(labels["tenant"], 0) + value
+        return out
+
+    merger.ingest(1, fams(7, 2))
+    assert totals() == {"a": 7, "b": 2}
+    # cumulative snapshots are states, not deltas: re-ingest is a no-op
+    merger.ingest(1, fams(7, 2))
+    assert totals() == {"a": 7, "b": 2}
+    # kill: retire folds the dead child's tail exactly once
+    merger.retire(1)
+    merger.retire(1)  # idempotent
+    assert totals() == {"a": 7, "b": 2}
+    # respawn: generation 2 counts from zero on top of the folded base
+    merger.ingest(2, fams(3, 0))
+    assert totals() == {"a": 10, "b": 2}
+    # a respawn that skips the retire (hard kill) folds on the gen bump
+    merger.ingest(3, fams(1, 1))
+    assert totals() == {"a": 11, "b": 3}
+
+
+# -- frame-header contract ------------------------------------------------
+
+
+def _frame_bytes(header):
+    buf = io.BytesIO()
+    _write_frame(buf, header)
+    return buf.getvalue()
+
+
+def test_tenant_unset_frame_header_is_byte_identical():
+    """The zero-bytes-when-unset contract (same as PR 15's `trace` key):
+    a request with no bound tenant produces EXACTLY the pre-attribution
+    frame bytes — the key is absent, not empty."""
+    base = {"op": "solve", "id": 7, "len": 1024}
+
+    def build_header():
+        header = dict(base)
+        # the _call_locked contract: key only when a tenant is bound
+        tenant = reqctx.current_tenant()
+        if tenant is not None:
+            header["tenant"] = tenant
+        return header
+
+    legacy = _frame_bytes(dict(base))  # PR 15 protocol, no tenant logic
+    assert _frame_bytes(build_header()) == legacy
+    with reqctx.bind(reqctx.RequestContext(tenant="frame-team")):
+        tenanted = _frame_bytes(build_header())
+    assert tenanted != legacy
+    # and the read side surfaces it where host_main picks it up
+    hdr, _body = _read_frame(io.BytesIO(tenanted))
+    assert hdr["tenant"] == "frame-team"
+    hdr, _body = _read_frame(io.BytesIO(legacy))
+    assert "tenant" not in hdr
+    # sort_keys JSON: byte layout is deterministic, so absent-key really
+    # means zero extra bytes, not reordered bytes
+    raw = _frame_bytes(build_header())
+    hlen, _blen = struct.unpack(">II", raw[:8])
+    assert json.loads(raw[8:8 + hlen]) == base
+
+
+def test_grpc_metadata_carries_tenant_when_bound():
+    from karpenter_core_tpu.solver.service import _request_metadata
+
+    assert _request_metadata(None) is None
+    md = _request_metadata("abc123")
+    assert md is not None and dict(md).get("x-karpenter-trace") == "abc123" \
+        or any(v == "abc123" for _k, v in md)
+    with reqctx.bind(reqctx.RequestContext(tenant="rpc-team")):
+        md = dict(_request_metadata("abc123"))
+        assert md[reqctx.TENANT_HEADER] == "rpc-team"
+    md = _request_metadata(None)
+    assert md is None, "no trace, no tenant: no metadata at all"
