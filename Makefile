@@ -34,7 +34,8 @@ race-smoke:  ## the -race gate at full depth: lock-heavy suites, racewatch exhau
 	# Non-fatal in verify, FATAL in hack/presubmit.sh.
 	KARPENTER_RACEWATCH=1 KARPENTER_RACEWATCH_SAMPLE=1 KARPENTER_RACEWATCH_CAP=0 \
 	python -m pytest tests/test_solver_host.py tests/test_resilient_recovery.py \
-		tests/test_supervise.py tests/test_racewatch.py -q
+		tests/test_supervise.py tests/test_racewatch.py \
+		tests/test_admission_fairshare.py -q
 
 chaos:  ## fault-injection suite (incl. slow schedule cases), fixed seed
 	KARPENTER_CHAOS_SEED=42 python -m pytest \
@@ -112,6 +113,12 @@ verify:  ## driver hooks: single-chip compile check + 8-way mesh dryrun
 	# staleness vs slow, atomic artifact resume, process-group kill, and the
 	# plan/merge graph over a fake round dir (ISSUE 11)
 	python -m pytest tests/test_supervise.py tests/test_bench_resume.py -q
+	# fair-share admission (fatal, ISSUE 17): WFQ/EDF dispatch order,
+	# per-tenant quota + retry-after isolation, the retry budget, the
+	# burn-driven brownout ladder's hysteresis, and the miniature
+	# two-tenant flood drill
+	python -m pytest tests/test_admission_fairshare.py \
+		tests/test_tenant_attribution.py -q
 	# non-fatal smoke: a traced solve must export valid Perfetto JSON
 	-$(MAKE) trace-demo
 	# non-fatal smoke: a flight-recorded solve must replay byte-identically

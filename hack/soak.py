@@ -165,6 +165,204 @@ def overload_burst(resilient, host_primary, n_threads: int = 10):
     }
 
 
+def tenant_flood_drill(resilient, host_primary, quota: int = 2,
+                       flood_threads: int = 20, flood_s: float = 6.0):
+    """The two-tenant flood drill (ISSUE 17): tenant A floods the gate at
+    10x its per-tenant quota while tenant B keeps a steady one-at-a-time
+    trickle. The fair-share invariants under assault:
+
+      * every shed is billed to A — ``tenant_quota`` isolates the flooder,
+        and B is never quota- or queue-full-shed;
+      * B's admission p99 stays within 1.5x its pre-flood baseline (DRR
+        gives B its dispatch share no matter how deep A's sub-queue is);
+      * ZERO of B's accepted requests expire in queue or dispatch past
+        their deadline;
+      * the closed SLO loop demotes ONLY A (a drill-scale burn engine over
+        the gate's own admission totals drives the brownout ladder), and A
+        re-promotes back to the device rung once the flood drains —
+        hysteresis, not a latch.
+
+    The gate is temporarily re-armed at drill scale — per-tenant quota 2,
+    global queue wide open (so the global bound never sheds B for A's
+    sins), depth-band brownout OFF (the ladder owns the brownout decision
+    here) — and restored afterwards."""
+    import threading
+    import time as _time
+
+    from karpenter_core_tpu.cloudprovider import fake as _fake
+    from karpenter_core_tpu.obs import reqctx
+    from karpenter_core_tpu.obs.slo import Objective, SloEngine
+    from karpenter_core_tpu.solver.host import (
+        GATE_DEMOTIONS_TOTAL,
+        BrownoutLadder,
+    )
+    from karpenter_core_tpu.testing import make_pod, make_provisioner
+
+    pods = [make_pod(requests={"cpu": "1"}) for _ in range(12)]
+    provisioners = [make_provisioner(name="flood")]
+    its = {"flood": _fake.instance_types(8)}
+    gate = host_primary.admission
+    tenant_a, tenant_b = "flood-a", "steady-b"
+    errors = []
+
+    def solve_as(tenant, deadline_s, timings=None):
+        t0 = _time.monotonic()
+        try:
+            with reqctx.bind(reqctx.RequestContext(
+                    tenant=tenant, deadline_s=deadline_s)):
+                resilient.solve(pods, provisioners, its)
+        except Exception as e:  # noqa: BLE001 — counted, asserted zero
+            errors.append(f"{tenant}: {type(e).__name__}: {e}")
+        finally:
+            if timings is not None:
+                timings.append(_time.monotonic() - t0)
+
+    def pump(tenant, stop, deadline_s):
+        while not stop.is_set():
+            solve_as(tenant, deadline_s)
+
+    def demotions_of(tenant):
+        return sum(
+            v for labels, v in GATE_DEMOTIONS_TOTAL.series()
+            if labels.get("tenant") == tenant
+        )
+
+    solve_as(tenant_b, 60.0)  # compile/warm this geometry out of the drill
+    engine = SloEngine(
+        [Objective(
+            name="gate-admission", histogram=None, threshold_s=0.0,
+            target=0.95, collect=gate.admission_totals,
+        )],
+        windows=(("2s", 2.0), ("10s", 10.0)),
+    )
+    ladder = BrownoutLadder(
+        engine.fast_burn, demote_at=1.0, promote_below=0.5,
+        hold_s=2.0, eval_interval_s=0.25,
+    )
+    saved = (gate.tenant_quota, gate.ladder, gate.max_queue, gate.brownout_at)
+    gate.tenant_quota, gate.ladder = quota, ladder
+    gate.max_queue, gate.brownout_at = 64, None
+    failures = []
+    try:
+        # baseline: B's sequential p99 with A running WITHIN its quota
+        stop = threading.Event()
+        base_a = [
+            threading.Thread(target=pump, args=(tenant_a, stop, 30.0),
+                             daemon=True, name=f"flood-base-a-{i}")
+            for i in range(quota)
+        ]
+        for t in base_a:
+            t.start()
+        base_b = []
+        for _ in range(5):
+            solve_as(tenant_b, 60.0, base_b)
+        stop.set()
+        for t in base_a:
+            t.join(timeout=60.0)
+        b_base_p99 = sorted(base_b)[-1]
+
+        shed_before = {
+            k: dict(v) for k, v in gate.stats()["shed_by_tenant"].items()
+        }
+        expired_before = dict(gate.stats()["expired_in_queue"])
+        violations_before = gate.stats()["deadline_violations"]
+        b_demotions_before = demotions_of(tenant_b)
+
+        # flood: A at 10x quota; B keeps its steady trickle throughout
+        stop = threading.Event()
+        flood = [
+            threading.Thread(target=pump, args=(tenant_a, stop, 30.0),
+                             daemon=True, name=f"flood-a-{i}")
+            for i in range(flood_threads)
+        ]
+        for t in flood:
+            t.start()
+        flood_b = []
+        flood_end = _time.monotonic() + flood_s
+        while _time.monotonic() < flood_end:
+            solve_as(tenant_b, 60.0, flood_b)
+        stop.set()
+        for t in flood:
+            t.join(timeout=60.0)
+        b_flood_p99 = sorted(flood_b)[-1]
+
+        stats = gate.stats()
+        shed_delta = {}
+        for key, reasons in stats["shed_by_tenant"].items():
+            before = shed_before.get(key, {})
+            d = {r: n - before.get(r, 0) for r, n in reasons.items()
+                 if n - before.get(r, 0)}
+            if d:
+                shed_delta[key] = d
+        if not shed_delta.get(tenant_a):
+            failures.append("flood never shed tenant A (drill vacuous)")
+        bystanders = sorted(k for k in shed_delta if k != tenant_a)
+        if bystanders:
+            failures.append(
+                f"sheds billed to bystander tenant(s) {bystanders}: "
+                f"{shed_delta}"
+            )
+        b_expired = (
+            stats["expired_in_queue"].get(tenant_b, 0)
+            - expired_before.get(tenant_b, 0)
+        )
+        if b_expired:
+            failures.append(
+                f"{b_expired} of B's accepted requests expired in queue"
+            )
+        if stats["deadline_violations"] != violations_before:
+            failures.append(
+                "accepted request(s) dispatched past their deadline"
+            )
+        if not flood_b:
+            failures.append("tenant B starved: zero solves during the flood")
+        if b_flood_p99 > max(1.5 * b_base_p99, 3.0):
+            failures.append(
+                f"tenant B p99 {b_flood_p99:.3f}s under flood vs "
+                f"{b_base_p99:.3f}s baseline (> 1.5x)"
+            )
+        if errors:
+            failures.append(
+                "every shed must be served by the greedy ladder: "
+                f"{errors[:3]}"
+            )
+        if ladder.demotions_total < 1:
+            failures.append(
+                "brownout ladder never demoted the flooding tenant"
+            )
+        if demotions_of(tenant_b) != b_demotions_before:
+            failures.append("brownout ladder demoted bystander tenant B")
+
+        # recovery: A's own probe traffic drives the ladder's review —
+        # burn decays out of the fast window, and hysteresis promotes A
+        # back to the device rung
+        recover_deadline = _time.monotonic() + 20.0
+        while (_time.monotonic() < recover_deadline
+               and ladder.level(tenant_a) != "device"):
+            solve_as(tenant_a, 30.0)
+            _time.sleep(0.25)
+        if ladder.level(tenant_a) != "device":
+            failures.append(
+                "flooding tenant never re-promoted to the device rung "
+                f"(stuck at {ladder.level(tenant_a)!r})"
+            )
+        return {
+            "b_base_p99_s": round(b_base_p99, 3),
+            "b_flood_p99_s": round(b_flood_p99, 3),
+            "b_served_in_flood": len(flood_b),
+            "b_expired_in_queue": b_expired,
+            "shed_delta": shed_delta,
+            "demotions": ladder.demotions_total,
+            "promotions": ladder.promotions_total,
+            "a_final_rung": ladder.level(tenant_a),
+            "errors": errors,
+            "failures": failures,
+        }
+    finally:
+        (gate.tenant_quota, gate.ladder,
+         gate.max_queue, gate.brownout_at) = saved
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--duration", type=float, default=75.0,
@@ -326,6 +524,22 @@ def main(argv=None) -> int:
                 f"post-burst p99 {burst['post_p99_s']}s never re-converged "
                 f"(pre-burst p50 {burst['pre_p50_s']}s)"
             )
+        # two-tenant flood drill (ISSUE 17): fair-share isolation plus the
+        # closed SLO->brownout loop, asserted end to end — tenant A floods
+        # at 10x quota, only A sheds/demotes, B's p99 and zero-deadline-
+        # violation invariants hold, A re-promotes after the flood drains
+        flood = tenant_flood_drill(resilient, primary)
+        columns["churn_tenant_flood"] = {
+            k: v for k, v in flood.items() if k != "failures"
+        }
+        print(
+            f"soak tenant flood: b_p99 {flood['b_base_p99_s']}s -> "
+            f"{flood['b_flood_p99_s']}s served={flood['b_served_in_flood']} "
+            f"shed={flood['shed_delta']} demotions={flood['demotions']} "
+            f"a_rung={flood['a_final_rung']}",
+            file=sys.stderr,
+        )
+        drill_failures.extend(flood["failures"])
     if hang_armed and args.host:
         # host-mode drill gates: the chaos crash fired, the kill
         # respawned, the breaker re-admitted, and nothing leaked

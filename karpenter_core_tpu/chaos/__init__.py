@@ -82,6 +82,14 @@ SOLVER_HOST_CRASH = "solver.host.crash"
 # error:exhausted so callers see the same typed RESOURCE_EXHAUSTED a full
 # queue raises
 SOLVER_RPC_OVERLOAD = "solver.rpc.overload"
+# tenant-flood injection at the admission gate (solver/host.AdmissionGate):
+# an armed fault does NOT error the request — the gate re-attributes it to
+# one synthetic flooding tenant (CHAOS_FLOOD_TENANT), so arming `p:<frac>`
+# mid-churn converts that fraction of live traffic into a flood that must
+# trip per-tenant quota/brownout isolation while every real tenant's
+# accounting stays clean. Arm with error:exhausted (any kind works; the
+# raised fault is swallowed at the hook)
+SOLVER_GATE_FLOOD = "solver.gate.flood"
 # the segmented pack-scan dispatch (ISSUE 14, TPUSolver._try_segmented):
 # an injected fault models a device failure inside the segmented attempt —
 # partition kernel, lane dispatch, or merge — and the contract is that the
@@ -102,6 +110,7 @@ KNOWN_POINTS = (
     SOLVER_DEVICE_HANG,
     SOLVER_HOST_CRASH,
     SOLVER_RPC_OVERLOAD,
+    SOLVER_GATE_FLOOD,
     SOLVER_SEGMENT,
     STATE_WATCH,
     STATE_DIFF,

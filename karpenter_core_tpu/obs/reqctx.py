@@ -40,6 +40,7 @@ __all__ = [
     "TenantGuard",
     "bind",
     "current",
+    "current_deadline",
     "current_tenant",
     "tenant_labels",
 ]
@@ -112,6 +113,15 @@ def current_tenant() -> Optional[str]:
     """Tenant of the innermost bound context, or None. O(1), no allocation."""
     items = _STACK.items
     return items[-1].tenant if items else None
+
+
+def current_deadline() -> Optional[float]:
+    """``deadline_s`` (remaining budget) of the innermost bound context, or
+    None. O(1), no allocation. The admission gate reads this to tighten a
+    request's queue budget and to order it within its tenant's EDF
+    sub-queue."""
+    items = _STACK.items
+    return items[-1].deadline_s if items else None
 
 
 class TenantGuard:

@@ -34,7 +34,7 @@ import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
-from typing import Deque, Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Deque, Dict, List, Optional, Sequence, Tuple
 
 from karpenter_core_tpu.metrics.registry import NAMESPACE, Histogram
 
@@ -65,14 +65,22 @@ class Objective:
     objective (e.g. ``{"context": "provisioning"}`` on the solve-duration
     histogram); series are then grouped by their ``tenant`` label, with the
     tenant-less aggregate summed across all matching series.
+
+    ``collect`` replaces the histogram read entirely: a callable returning
+    ``{tenant-or-None: (good, total)}`` cumulative counts (the ``None`` key
+    is the aggregate). This is how non-latency ratio objectives plug in —
+    e.g. ``AdmissionGate.admission_totals``, where good = dispatched and
+    bad = capacity sheds. With ``collect`` set, ``histogram`` may be None
+    and ``threshold_s`` is ignored.
     """
 
     name: str
-    histogram: Histogram
+    histogram: Optional[Histogram]
     threshold_s: float
     target: float  # e.g. 0.99 — good fraction the SLO promises
     base_labels: Dict[str, str] = field(default_factory=dict)
     description: str = ""
+    collect: Optional[Callable[[], Dict[Optional[str], Tuple[int, int]]]] = None
 
 
 class _Sample:
@@ -117,6 +125,17 @@ class SloEngine:
         """Current (good, total) per tenant for one objective. The None key
         is the aggregate: the sum over every matching series, so per-tenant
         observations still count toward the global objective."""
+        if obj.collect is not None:
+            try:
+                collected = obj.collect()
+            except Exception:  # noqa: BLE001 — a sick source reports nothing
+                return {None: (0, 0)}
+            out: Dict[Optional[str], Tuple[int, int]] = {}
+            for tenant, pair in collected.items():
+                good, total = pair
+                out[tenant] = (int(good), int(total))
+            out.setdefault(None, (0, 0))
+            return out
         gi = self._good_index(obj)
         out: Dict[Optional[str], List[int]] = {None: [0, 0]}
         for labels, data in obj.histogram.series():
@@ -219,6 +238,29 @@ class SloEngine:
                     })
         return out
 
+    def fast_burn(self, tenant: Optional[str]) -> float:
+        """Max burn rate for *tenant* over the SHORTEST window, across all
+        objectives — the brownout ladder's demotion signal (the fast window
+        reacts in seconds where the budget window takes its full span to
+        drain). Takes a fresh sample, so callers should rate-limit (the
+        ladder's ``eval_interval_s`` does). 0.0 for unknown tenants or
+        windows with no traffic."""
+        self.sample()
+        if tenant is None:
+            return 0.0
+        now = self._clock()
+        fast_s = min(w for _, w in self.windows)
+        worst = 0.0
+        with self._mu:
+            for obj in self.objectives:
+                dq = self._samples.get((obj.name, tenant))
+                if not dq:
+                    continue
+                burn, _ = self._window_rates(dq, now, fast_s, obj.target)
+                if burn is not None and burn > worst:
+                    worst = burn
+        return worst
+
     def budget_exhausted(self, tenant: Optional[str]) -> bool:
         """True when any objective's budget for *tenant* is spent (≤ 0) over
         the budget window. Unknown tenants have burned nothing. This is the
@@ -267,7 +309,13 @@ class SloEngine:
                     "name": o.name,
                     "target": o.target,
                     "threshold_s": o.threshold_s,
-                    "histogram": o.histogram.name,
+                    "histogram": (
+                        o.histogram.name if o.histogram is not None
+                        else None
+                    ),
+                    "source": (
+                        "collect" if o.collect is not None else "histogram"
+                    ),
                     "base_labels": dict(o.base_labels),
                     "description": o.description,
                 }

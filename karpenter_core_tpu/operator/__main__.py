@@ -121,19 +121,25 @@ def configure_logging() -> None:
         handler.addFilter(_ControllerContextFilter())
 
 
-def build_slo_engine():
+def build_slo_engine(admission=None):
     """The operator's declarative SLOs (obs/slo): admission-to-bind and
     solve-duration latency objectives, evaluated as multi-window burn rates
     per tenant. Registered as an external exposition source so every
     /metrics scrape computes fresh
-    karpenter_slo_error_budget_remaining{slo[,tenant]} gauges."""
+    karpenter_slo_error_budget_remaining{slo[,tenant]} gauges.
+
+    With *admission* (the live AdmissionGate), a third ratio objective is
+    evaluated over the gate's own served/shed accounting
+    (``admission_totals``) — the burn signal the brownout ladder consumes,
+    so a tenant flooding the gate burns ITS budget even when its requests
+    never reach a latency histogram."""
     from karpenter_core_tpu.controllers.provisioning.provisioner import (
         ADMISSION_TO_BIND,
     )
     from karpenter_core_tpu.obs.slo import Objective, SloEngine
     from karpenter_core_tpu.obs.tracer import SOLVER_SOLVE_DURATION
 
-    return SloEngine([
+    objectives = [
         Objective(
             name="admission-to-bind",
             histogram=ADMISSION_TO_BIND,
@@ -151,7 +157,20 @@ def build_slo_engine():
             description="99% of provisioning solves finish inside the 30s "
                         "dispatch deadline",
         ),
-    ])
+    ]
+    if admission is not None:
+        objectives.append(Objective(
+            name="gate-admission",
+            histogram=None,
+            threshold_s=0.0,
+            target=0.95,
+            collect=admission.admission_totals,
+            description="95% of admission-gate entries dispatch (capacity "
+                        "sheds and in-queue deadline expiries burn; ladder "
+                        "brownout sheds are excluded so a demoted tenant "
+                        "can drain its burn and re-promote)",
+        ))
+    return SloEngine(objectives)
 
 
 # every debug endpoint the operator serves: (path, profiling-gated?, what).
@@ -213,6 +232,8 @@ def _tenants_digest(slo=None) -> dict:
     )
     from karpenter_core_tpu.solver.fallback import SOLVER_FALLBACK_TOTAL
     from karpenter_core_tpu.solver.host import (
+        DEADLINE_VIOLATIONS_TOTAL,
+        GATE_DEMOTIONS_TOTAL,
         SOLVER_QUEUE_DEPTH,
         SOLVER_QUEUE_WAIT,
         SOLVER_SHED_TOTAL,
@@ -235,6 +256,8 @@ def _tenants_digest(slo=None) -> dict:
             "compile_misses": 0,
             "compile_seconds": 0.0,
             "gate_depth": {},
+            "expired_in_queue": 0,
+            "demotions": {},
             "flight_records": [],
         })
 
@@ -275,6 +298,18 @@ def _tenants_digest(slo=None) -> dict:
             fb = entry(t)["fallback"]
             reason = labels.get("reason", "")
             fb[reason] = fb.get(reason, 0) + int(value)
+    for labels, value in DEADLINE_VIOLATIONS_TOTAL.series():
+        t = labels.get("tenant")
+        # stage=queue: requests that expired while waiting and were shed,
+        # attributed to the tenant that overran its budget (ISSUE 17)
+        if t is not None and labels.get("stage") == "queue":
+            entry(t)["expired_in_queue"] += int(value)
+    for labels, value in GATE_DEMOTIONS_TOTAL.series():
+        t = labels.get("tenant")
+        if t is not None:
+            dem = entry(t)["demotions"]
+            reason = labels.get("reason", "")
+            dem[reason] = dem.get(reason, 0) + int(value)
     for labels, data in SOLVER_PHASE_DURATION.series():
         t = labels.get("tenant")
         if t is not None and labels.get("phase") == "device":
@@ -628,17 +663,25 @@ def run(cloud_provider, kube_client=None, stop_event=None, options=None):
     apply_server_gc_tuning()
     # the SLO burn-rate plane (ISSUE 16): declarative objectives over the
     # histograms the attribution plane labels, exposed as fresh-per-scrape
-    # error-budget gauges and the /debug/slo digest
-    slo_engine = build_slo_engine()
+    # error-budget gauges and the /debug/slo digest — plus (ISSUE 17) a
+    # ratio objective over the admission gate's own served/shed accounting
+    gate = getattr(primary, "admission", None)
+    slo_engine = build_slo_engine(admission=gate)
     REGISTRY.add_external(slo_engine)
-    # off-by-default brownout preference: when armed, the admission gate's
-    # brownout sheds ONLY tenants whose error budget is already exhausted
-    # (fast-burning tenants pay first), instead of shedding everyone
+    # KARPENTER_SLO_BROWNOUT arms the closed SLO->admission loop:
+    #   * the depth-band preference: inside the brownout band the gate
+    #     sheds ONLY tenants whose error budget is exhausted (fast-burning
+    #     tenants pay first), instead of shedding everyone;
+    #   * the per-tenant brownout ladder: a tenant whose fast-window burn
+    #     crosses the threshold is demoted device -> greedy -> shed (with
+    #     hysteresis) while every other tenant keeps the device path.
     if envflags.get_bool("KARPENTER_SLO_BROWNOUT", False):
-        gate = getattr(primary, "admission", None)
         if gate is not None:
+            from karpenter_core_tpu.solver.host import BrownoutLadder
+
             gate.brownout_prefer = slo_engine.budget_exhausted
-            LOG.info("slo brownout preference armed", gate=gate.name)
+            gate.ladder = BrownoutLadder(burn=slo_engine.fast_burn)
+            LOG.info("slo brownout loop armed", gate=gate.name)
     health = serve_health(
         operator, opts.metrics_port, profiling=opts.enable_profiling,
         solver=solver, slo=slo_engine,

@@ -485,6 +485,13 @@ class ResilientSolver:
                 host_report = hr()
             except Exception as e:  # noqa: BLE001 — report, don't fail health
                 host_report = {"error": f"{type(e).__name__}: {e}"}
+        retry_budget = None
+        rb = getattr(self.primary, "retry_budget", None)
+        if rb is not None:
+            try:
+                retry_budget = rb.stats()
+            except Exception as e:  # noqa: BLE001 — report, don't fail health
+                retry_budget = {"error": f"{type(e).__name__}: {e}"}
         with self._state_mu:
             healthy, reason = self._healthy, self._reason
         with self._verdict_lock:
@@ -515,6 +522,7 @@ class ResilientSolver:
                     for r in self._abandoned
                 ],
                 "host": host_report,
+                "retry_budget": retry_budget,
             }
 
     def _mark_dead(self, reason: str) -> None:
@@ -789,6 +797,15 @@ class ResilientSolver:
                 # thread is real either way — same immediate breaker trip
                 self._mark_wedged(f"{type(e).__name__}: {e}", kind="timeout")
                 SOLVER_FALLBACK_TOTAL.inc(reqctx.tenant_labels(reason="primary_error"))
+            elif getattr(e, "shed_reason", None) is not None:
+                # an admission-gate shed (queue_full, tenant_quota,
+                # brownout, deadline_expired, ...): the backend never SAW
+                # the request, so nothing here is evidence against it —
+                # serve the fallback without marking anything dead. This
+                # covers DEADLINE_EXCEEDED sheds too, whose type would
+                # otherwise mark unhealthy: a tenant flooding the gate
+                # must not condemn the device everyone else depends on.
+                SOLVER_FALLBACK_TOTAL.inc(reqctx.tenant_labels(reason="admission_shed"))
             elif getattr(e, "marks_unhealthy", True):
                 self._mark_dead(f"{type(e).__name__}: {e}")
                 SOLVER_FALLBACK_TOTAL.inc(reqctx.tenant_labels(reason="primary_error"))

@@ -105,8 +105,9 @@ SOLVER_QUEUE_DEPTH = REGISTRY.gauge(
 SOLVER_SHED_TOTAL = REGISTRY.counter(
     f"{NAMESPACE}_solver_shed_total",
     "Solver requests shed by the admission gate instead of queued "
-    "unboundedly, by gate and reason (queue_full, brownout, "
-    "deadline_expired, injected)",
+    "unboundedly, by gate and reason (queue_full, tenant_quota, brownout, "
+    "brownout_shed, deadline_expired, injected) and tenant when a request "
+    "context is bound",
 )
 SOLVER_QUEUE_WAIT = REGISTRY.histogram(
     f"{NAMESPACE}_solver_queue_wait_seconds",
@@ -115,9 +116,19 @@ SOLVER_QUEUE_WAIT = REGISTRY.histogram(
 )
 DEADLINE_VIOLATIONS_TOTAL = REGISTRY.counter(
     f"{NAMESPACE}_solver_deadline_violations_total",
-    "Admitted requests that reached dispatch past their deadline, by gate "
-    "— structurally zero (the gate sheds expired work before dispatch); "
-    "any increment is a gate bug dashboards should page on",
+    "Requests whose deadline the gate could not honor, by gate, stage and "
+    "tenant. stage=queue: expired while waiting and shed, NEVER dispatched "
+    "— expected under flood, attributed to the tenant that overran its "
+    "budget. stage=dispatch: reached dispatch past the deadline — "
+    "structurally zero; any increment is a gate bug dashboards should "
+    "page on",
+)
+GATE_DEMOTIONS_TOTAL = REGISTRY.counter(
+    f"{NAMESPACE}_gate_demotions_total",
+    "Brownout-ladder demotions at the admission gate, by tenant and the "
+    "rung demoted to (greedy = shed to the local fallback, shed = hard "
+    "shed with a long retry-after); promotions are tracer instant events "
+    "and ladder stats, not a counter",
 )
 HOST_RESPAWN_TOTAL = REGISTRY.counter(
     f"{NAMESPACE}_solver_host_respawn_total",
@@ -136,31 +147,196 @@ HOST_RECOVERY_SECONDS = REGISTRY.gauge(
 # ---------------------------------------------------------------------------
 # deadline-aware admission control
 
+# sub-queue key for requests with no bound tenant: never a metric label
+_NO_TENANT = ""
+# synthetic tenant the `solver.gate.flood` chaos point attributes traffic
+# to: arming the point converts a fraction of live requests into one
+# flooding tenant, so quota/brownout isolation can be drilled mid-churn
+# without touching real tenants' accounting
+CHAOS_FLOOD_TENANT = "chaos-flood"
+# DRR weights are clamped into [1/16, 16]: the floor bounds the rotation
+# count before some queue accumulates a full dispatch credit, the ceiling
+# keeps one tenant from monopolizing every rotation
+_MIN_WEIGHT = 1.0 / 16.0
+_MAX_WEIGHT = 16.0
+
+_LADDER_RUNGS = ("device", "greedy", "shed")
+
+
+class BrownoutLadder:
+    """Closed SLO->admission loop: a per-tenant brownout ladder.
+
+    PR 16 left ``KARPENTER_SLO_BROWNOUT`` as an off-by-default preference
+    hook (budget-exhausted tenants shed first inside the depth band). This
+    is the live control loop: ``burn`` (typically ``SloEngine.fast_burn``)
+    maps a guarded tenant label to its fast-window burn rate, and the
+    ladder walks that tenant down ``device -> greedy -> shed`` one rung at
+    a time:
+
+      * burn >= ``demote_at``: demote one rung. The first demotion is
+        immediate; escalating further waits out ``hold_s``, so one bad
+        window cannot jump a tenant straight to hard shed.
+      * burn < ``promote_below`` sustained for ``hold_s``: promote one
+        rung back.
+
+    The asymmetric thresholds plus the dwell are the hysteresis: a tenant
+    oscillating around the threshold changes rung at most once per
+    ``hold_s``. Burn probes are rate-limited to one per
+    ``eval_interval_s`` per tenant; between probes the cached rung answers
+    in O(1). A failing probe HOLDS the current rung — unlike the depth-band
+    preference hook (which fails closed to protect the device), the ladder
+    acts on absolute SLO evidence, and a sick probe is not evidence that a
+    tenant started burning.
+
+    Demotions tick ``karpenter_gate_demotions_total{tenant,reason}``; every
+    transition lands as a ``solver.gate.demote`` / ``solver.gate.promote``
+    tracer instant event."""
+
+    def __init__(self, burn, demote_at: float = 1.0,
+                 promote_below: float = 0.5, hold_s: float = 30.0,
+                 eval_interval_s: float = 1.0, clock=time.monotonic):
+        self.burn = burn
+        self.demote_at = float(demote_at)
+        self.promote_below = float(promote_below)
+        self.hold_s = float(hold_s)
+        self.eval_interval_s = float(eval_interval_s)
+        self._clock = clock
+        self._mu = threading.Lock()
+        # guarded label -> [rung index, rung-entered ts, last-probe ts, burn]
+        self._state: Dict[str, list] = {}
+        self.demotions_total = 0
+        self.promotions_total = 0
+
+    def review(self, label: str) -> str:
+        """Current rung for *label* (a guard-admitted tenant label),
+        re-evaluating the burn probe at most once per ``eval_interval_s``."""
+        now = self._clock()
+        with self._mu:
+            st = self._state.get(label)
+            if st is None:
+                st = self._state[label] = [0, now, float("-inf"), 0.0]
+            if now - st[2] < self.eval_interval_s:
+                return _LADDER_RUNGS[st[0]]
+            st[2] = now
+        # the probe samples the SLO engine (histogram walks, its own
+        # locks) — never under self._mu
+        try:
+            burn = float(self.burn(label))
+        except Exception:  # noqa: BLE001 — a sick probe holds the rung
+            burn = None
+        with self._mu:
+            st = self._state[label]
+            if burn is None:
+                return _LADDER_RUNGS[st[0]]
+            st[3] = burn
+            rung = st[0]
+            dwelt = now - st[1]
+            if (burn >= self.demote_at and rung < len(_LADDER_RUNGS) - 1
+                    and (rung == 0 or dwelt >= self.hold_s)):
+                st[0], st[1] = rung + 1, now
+                self._transition_locked(label, rung, rung + 1, burn)
+            elif (burn < self.promote_below and rung > 0
+                    and dwelt >= self.hold_s):
+                st[0], st[1] = rung - 1, now
+                self._transition_locked(label, rung, rung - 1, burn)
+            return _LADDER_RUNGS[st[0]]
+
+    def _transition_locked(self, label: str, frm: int, to: int,
+                           burn: float) -> None:
+        if to > frm:
+            self.demotions_total += 1
+            GATE_DEMOTIONS_TOTAL.inc({
+                "tenant": reqctx.TENANTS.admit(label),
+                "reason": _LADDER_RUNGS[to],
+            })
+            name = "solver.gate.demote"
+        else:
+            self.promotions_total += 1
+            name = "solver.gate.promote"
+        TRACER.instant(
+            name, tenant=label, frm=_LADDER_RUNGS[frm],
+            to=_LADDER_RUNGS[to], burn=round(burn, 3),
+        )
+
+    def level(self, label: str) -> str:
+        with self._mu:
+            st = self._state.get(label)
+            return _LADDER_RUNGS[st[0]] if st is not None else "device"
+
+    def stats(self) -> Dict[str, object]:
+        now = self._clock()
+        with self._mu:
+            return {
+                "demote_at": self.demote_at,
+                "promote_below": self.promote_below,
+                "hold_s": self.hold_s,
+                "demotions_total": self.demotions_total,
+                "promotions_total": self.promotions_total,
+                "tenants": {
+                    label: {
+                        "level": _LADDER_RUNGS[st[0]],
+                        "burn": round(st[3], 3),
+                        "dwell_s": round(now - st[1], 3),
+                    }
+                    for label, st in self._state.items()
+                },
+            }
+
+
+class _Ticket:
+    """One queued admission. ``order`` is the EDF sort key within the
+    tenant's sub-queue: deadline first (None sorts last), arrival breaks
+    ties so equal-deadline work stays FIFO."""
+
+    __slots__ = ("key", "deadline", "seq", "order")
+
+    def __init__(self, key: str, deadline: Optional[float], seq: int):
+        self.key = key
+        self.deadline = deadline
+        self.seq = seq
+        self.order = (deadline if deadline is not None else float("inf"), seq)
+
 
 class AdmissionGate:
-    """Bounded admission in front of a serial dispatch resource.
+    """Bounded fair-share admission in front of a serial dispatch resource.
 
     The device dispatch is one resource; under overload, requests must
     SHED, not queue forever (the reference's level-triggered loop never
     blocks a reconcile behind an unbounded queue). Contract:
 
-      * at most ``max_queue`` requests wait; the next one shed with a
-        typed RESOURCE_EXHAUSTED carrying ``retry_after_s`` (estimated
-        from queue depth x a service-time EMA);
+      * one bounded sub-queue per RequestContext tenant (PR 16's
+        cardinality guard caps the queue count; overflow tenants share
+        the ``other`` queue, unbound requests share an unnamed one);
+      * dispatch order is weighted deficit-round-robin ACROSS tenants
+        (``weights``, default 1.0 per tenant) and earliest-deadline-first
+        WITHIN a tenant — a flooding tenant lengthens only its own queue,
+        not every tenant's wait;
+      * at most ``max_queue`` requests wait in total, and at most
+        ``tenant_quota`` (when set) per tenant — quota-full sheds the
+        OFFENDING tenant with a typed RESOURCE_EXHAUSTED carrying a
+        per-tenant ``retry_after_s`` (its own queue depth x its own
+        service-time EMA, global EMA as the cold-start fallback);
       * ``brownout_at`` (< max_queue) sheds EARLY with the same typed
         error — the caller's ResilientSolver classifies it as a request
         defect (marks_unhealthy=False) and serves the greedy fallback,
         so the ladder degrades device -> greedy BEFORE anything errors;
+        ``ladder`` (a :class:`BrownoutLadder`) does the same per tenant,
+        driven by SLO burn instead of queue depth;
       * a request admitted with a deadline that expires while it waits is
         NEVER dispatched (shed as deadline_expired, a typed
-        DEADLINE_EXCEEDED) — expired work reaching the device would burn
-        exactly the capacity the overload lacks.
+        DEADLINE_EXCEEDED, attributed to the tenant) — expired work
+        reaching the device would burn exactly the capacity the overload
+        lacks. A bound ``RequestContext.deadline_s`` tightens the gate's
+        own budget and orders the request within its sub-queue.
 
-    Thread-safe; FIFO. ``clock`` is injectable for tests."""
+    Thread-safe. ``clock`` is injectable for tests."""
 
     def __init__(self, name: str = "solver", max_queue: int = 8,
                  brownout_at: Optional[int] = None, max_inflight: int = 1,
-                 clock=time.monotonic, brownout_prefer=None):
+                 clock=time.monotonic, brownout_prefer=None,
+                 tenant_quota: Optional[int] = None,
+                 weights: Optional[Dict[str, float]] = None,
+                 ladder: Optional[BrownoutLadder] = None):
         self.name = name
         self.max_queue = int(max_queue)
         self.brownout_at = brownout_at
@@ -171,31 +347,143 @@ class AdmissionGate:
         # is spent); False = it rides through to the hard queue bound.
         # None (the default) keeps legacy behavior: brownout sheds everyone.
         self.brownout_prefer = brownout_prefer
+        # None = no per-tenant bound (the global max_queue still holds)
+        self.tenant_quota = (
+            int(tenant_quota) if tenant_quota is not None else None
+        )
+        # guarded tenant label -> DRR weight (unknown tenants weigh 1.0)
+        self.weights: Dict[str, float] = dict(weights or {})
+        # burn-driven per-tenant brownout (the closed SLO loop); None = off
+        self.ladder = ladder
         self._cond = threading.Condition()
-        self._waiters: list = []
         self._inflight = 0
         self._ema: Optional[float] = None
         self.accepted_total = 0
         self.dispatched_total = 0
         self.deadline_violations = 0  # structurally zero; asserted, not hoped
+        self._seq = itertools.count()
+        # sub-queue key (guarded label, or "" unbound) -> EDF-ordered tickets
+        self._queues: Dict[str, list] = {}
+        # DRR rotation ring: keys with a non-empty sub-queue, visit order
+        self._ring: list = []
+        self._deficit: Dict[str, float] = {}
+        # tickets granted a dispatch slot, waiting for their thread to wake
+        self._granted: set = set()
         self._shed_counts: Dict[str, int] = {}
+        # per-sub-queue accounting (bounded by the tenant cap + unbound)
+        self._tenant_ema: Dict[str, float] = {}
+        self._dispatched_by: Dict[str, int] = {}
+        self._shed_by: Dict[str, Dict[str, int]] = {}
+        self._expired_in_queue: Dict[str, int] = {}
         # guarded tenant label -> depth (in-flight + queued), for the
         # per-tenant SOLVER_QUEUE_DEPTH series; bounded by the tenant cap
         self._tenant_depth: Dict[str, int] = {}
 
     # -- internals (callers hold self._cond) --------------------------------
 
+    def _waiting_locked(self) -> int:
+        return sum(len(q) for q in self._queues.values()) + len(self._granted)
+
     def _depth_locked(self) -> int:
-        return self._inflight + len(self._waiters)
+        return self._inflight + self._waiting_locked()
 
     def _publish_depth_locked(self) -> None:
         SOLVER_QUEUE_DEPTH.set(
             float(self._depth_locked()), {"gate": self.name}
         )
 
-    def _retry_after_locked(self) -> float:
-        est = self._ema if self._ema is not None else 0.25
-        return min(5.0, (self._depth_locked() + 1) * est)
+    def _retry_after_locked(self, key: str = _NO_TENANT) -> float:
+        """Per-tenant retry-after hint: the requesting tenant's OWN queue
+        depth x its OWN service-time EMA (global EMA, then a 0.25 s prior,
+        as cold-start fallbacks) — one tenant's 10x-sized solves no longer
+        poison the hint for everyone."""
+        est = self._tenant_ema.get(key)
+        if est is None:
+            est = self._ema if self._ema is not None else 0.25
+        depth = (
+            len(self._queues.get(key, ()))
+            + self._inflight + len(self._granted)
+        )
+        return min(5.0, (depth + 1) * est)
+
+    def _weight_of(self, key: str) -> float:
+        try:
+            w = float(self.weights.get(key, 1.0))
+        except (TypeError, ValueError):
+            w = 1.0
+        return min(_MAX_WEIGHT, max(_MIN_WEIGHT, w))
+
+    def _enqueue_locked(self, ticket: _Ticket) -> None:
+        q = self._queues.get(ticket.key)
+        if q is None:
+            q = self._queues[ticket.key] = []
+            self._ring.append(ticket.key)
+        for i, other in enumerate(q):
+            if ticket.order < other.order:
+                q.insert(i, ticket)
+                break
+        else:
+            q.append(ticket)
+
+    def _retire_queue_locked(self, key: str) -> None:
+        self._queues.pop(key, None)
+        if key in self._ring:
+            self._ring.remove(key)
+        self._deficit.pop(key, None)
+
+    def _select_locked(self) -> Optional[_Ticket]:
+        """Next ticket to grant: weighted deficit-round-robin across the
+        sub-queues, EDF head within each. Every visit deposits the
+        tenant's weight; a dispatch spends 1.0. Weights are clamped >=
+        _MIN_WEIGHT, so within ceil(1/_MIN_WEIGHT) full rotations some
+        queue accumulates a full credit — the visit bound is a hard
+        guarantee, not a hope."""
+        ring = self._ring
+        max_visits = (int(1.0 / _MIN_WEIGHT) + 1) * max(1, len(ring)) + 1
+        for _ in range(max_visits):
+            if not ring:
+                return None
+            key = ring[0]
+            q = self._queues.get(key)
+            if not q:
+                self._retire_queue_locked(key)
+                continue
+            credit = self._deficit.get(key, 0.0) + self._weight_of(key)
+            if credit >= 1.0:
+                self._deficit[key] = credit - 1.0
+                ticket = q.pop(0)
+                if not q:
+                    self._retire_queue_locked(key)
+                else:
+                    ring.append(ring.pop(0))
+                return ticket
+            self._deficit[key] = credit
+            ring.append(ring.pop(0))
+        return None  # unreachable: the clamp bounds rotations-to-credit
+
+    def _grant_locked(self) -> None:
+        granted = False
+        while self._inflight + len(self._granted) < self.max_inflight:
+            ticket = self._select_locked()
+            if ticket is None:
+                break
+            self._granted.add(ticket)
+            granted = True
+        if granted:
+            self._cond.notify_all()
+
+    def _abandon_locked(self, ticket: _Ticket) -> None:
+        if ticket in self._granted:
+            self._granted.discard(ticket)
+            return
+        q = self._queues.get(ticket.key)
+        if q is not None:
+            try:
+                q.remove(ticket)
+            except ValueError:
+                pass
+            if not q:
+                self._retire_queue_locked(ticket.key)
 
     def _tenant_enter_locked(self, tenant: str) -> None:
         label = reqctx.TENANTS.admit(tenant)
@@ -224,6 +512,11 @@ class AdmissionGate:
     def _shed_locked(self, reason: str, retry_after: Optional[float],
                      detail: str, tenant: Optional[str] = None):
         self._shed_counts[reason] = self._shed_counts.get(reason, 0) + 1
+        key = (
+            reqctx.TENANTS.admit(tenant) if tenant is not None else _NO_TENANT
+        )
+        by = self._shed_by.setdefault(key, {})
+        by[reason] = by.get(reason, 0) + 1
         if tenant is not None:
             SOLVER_SHED_TOTAL.inc({
                 "gate": self.name, "reason": reason,
@@ -238,6 +531,32 @@ class AdmissionGate:
         err.shed_reason = reason
         err.retry_after_s = retry_after
         return err
+
+    def _expired_locked(self, deadline_s: Optional[float], where: str,
+                        tenant: Optional[str]):
+        """Queue-expiry shed, attributed: PR 16's deadline-violations
+        counter gains a ``stage="queue"`` series here, carrying the tenant
+        whose request overran its budget while waiting — distinct from the
+        structurally-zero ``stage="dispatch"`` series dashboards page on."""
+        key = (
+            reqctx.TENANTS.admit(tenant) if tenant is not None else _NO_TENANT
+        )
+        self._expired_in_queue[key] = self._expired_in_queue.get(key, 0) + 1
+        if tenant is not None:
+            DEADLINE_VIOLATIONS_TOTAL.inc({
+                "gate": self.name, "stage": "queue",
+                "tenant": reqctx.TENANTS.admit(tenant),
+            })
+        else:
+            DEADLINE_VIOLATIONS_TOTAL.inc(
+                {"gate": self.name, "stage": "queue"}
+            )
+        budget = f"{deadline_s:.2f}s" if deadline_s is not None else "its"
+        return self._shed_locked(
+            "deadline_expired", None,
+            f"deadline expired after {budget} budget {where}",
+            tenant=tenant,
+        )
 
     def _brownout_sheds(self, tenant: Optional[str]) -> bool:
         """Whether this request sheds in the brownout band. No preference
@@ -259,11 +578,24 @@ class AdmissionGate:
     @contextlib.contextmanager
     def admitted(self, deadline_s: Optional[float] = None):
         """Admit one dispatch. ``deadline_s`` is the request's remaining
-        budget in seconds (None = no deadline). Yields the remaining
+        budget in seconds (None = no deadline; a bound
+        ``RequestContext.deadline_s`` tightens it). Yields the remaining
         budget at DISPATCH time (never <= 0 — an expired request raises
-        instead). Raises typed RESOURCE_EXHAUSTED / DEADLINE_EXCEEDED on
-        shed; the dispatch itself runs outside the gate's lock."""
+        instead). Dispatch order is weighted-fair across tenants and EDF
+        within one, not FIFO. Raises typed RESOURCE_EXHAUSTED /
+        DEADLINE_EXCEEDED on shed; the dispatch itself runs outside the
+        gate's lock."""
         tenant = reqctx.current_tenant()
+        try:
+            # flood injection (chaos `solver.gate.flood`): the armed fault
+            # does NOT error the request — it re-attributes it to one
+            # synthetic flooding tenant, so arming `p:<frac>` mid-churn
+            # turns that fraction of live traffic into a flood that must
+            # trip quota/brownout isolation without touching the real
+            # tenants' accounting
+            chaos.maybe_fail(chaos.SOLVER_GATE_FLOOD)
+        except Exception:
+            tenant = CHAOS_FLOOD_TENANT
         try:
             # queue-full injection (chaos `solver.rpc.overload`): the
             # injected typed error rides the same shed accounting a real
@@ -274,6 +606,12 @@ class AdmissionGate:
                 self._shed_counts["injected"] = (
                     self._shed_counts.get("injected", 0) + 1
                 )
+                key = (
+                    reqctx.TENANTS.admit(tenant)
+                    if tenant is not None else _NO_TENANT
+                )
+                by = self._shed_by.setdefault(key, {})
+                by["injected"] = by.get("injected", 0) + 1
             if tenant is not None:
                 SOLVER_SHED_TOTAL.inc({
                     "gate": self.name, "reason": "injected",
@@ -284,79 +622,118 @@ class AdmissionGate:
             raise
         clock = self._clock
         entered = clock()
+        ctx_deadline = reqctx.current_deadline()
+        if ctx_deadline is not None:
+            deadline_s = (
+                ctx_deadline if deadline_s is None
+                else min(deadline_s, ctx_deadline)
+            )
         deadline = entered + deadline_s if deadline_s is not None else None
+        label = reqctx.TENANTS.admit(tenant) if tenant is not None else None
+        key = label if label is not None else _NO_TENANT
+        ladder = self.ladder
+        if ladder is not None and label is not None:
+            rung = ladder.review(label)
+            if rung != "device":
+                with self._cond:
+                    if rung == "shed":
+                        raise self._shed_locked(
+                            "brownout_shed", ladder.hold_s,
+                            "tenant browned out (ladder rung shed, "
+                            "burn-driven): hard shed; retry_after_ms="
+                            f"{int(ladder.hold_s * 1000)}",
+                            tenant=tenant,
+                        )
+                    ra = self._retry_after_locked(key)
+                    raise self._shed_locked(
+                        "brownout", ra,
+                        "tenant browned out (ladder rung greedy, "
+                        "burn-driven): serve the local fallback; "
+                        f"retry_after_ms={int(ra * 1000)}",
+                        tenant=tenant,
+                    )
         with self._cond:
             # max_queue bounds WAITERS: a request the idle gate can
             # dispatch immediately never sheds (max_queue=0 = "busy means
             # shed", not "never admit")
             must_wait = (
-                self._inflight >= self.max_inflight or bool(self._waiters)
+                self._inflight >= self.max_inflight
+                or bool(self._granted) or bool(self._queues)
             )
-            if must_wait and len(self._waiters) >= self.max_queue:
+            waiting = self._waiting_locked()
+            if must_wait and waiting >= self.max_queue:
+                ra = self._retry_after_locked(key)
                 raise self._shed_locked(
-                    "queue_full", self._retry_after_locked(),
+                    "queue_full", ra,
                     f"solver admission queue full "
-                    f"({len(self._waiters)} queued, max {self.max_queue}); "
-                    f"retry_after_ms="
-                    f"{int(self._retry_after_locked() * 1000)}",
+                    f"({waiting} queued, max {self.max_queue}); "
+                    f"retry_after_ms={int(ra * 1000)}",
                     tenant=tenant,
                 )
+            quota = self.tenant_quota
+            if quota is not None and must_wait:
+                mine = len(self._queues.get(key, ()))
+                if mine >= quota:
+                    ra = self._retry_after_locked(key)
+                    raise self._shed_locked(
+                        "tenant_quota", ra,
+                        f"per-tenant admission quota full "
+                        f"({mine} queued for this tenant, quota {quota}); "
+                        f"retry_after_ms={int(ra * 1000)}",
+                        tenant=tenant,
+                    )
             if (
                 self.brownout_at is not None
                 and self._depth_locked() >= self.brownout_at
                 and self._brownout_sheds(tenant)
             ):
+                ra = self._retry_after_locked(key)
                 raise self._shed_locked(
-                    "brownout", self._retry_after_locked(),
+                    "brownout", ra,
                     f"solver admission brownout (depth "
                     f"{self._depth_locked()} >= {self.brownout_at}): "
                     "serve the local fallback; retry_after_ms="
-                    f"{int(self._retry_after_locked() * 1000)}",
+                    f"{int(ra * 1000)}",
                     tenant=tenant,
                 )
-            ticket = object()
-            self._waiters.append(ticket)
+            ticket = _Ticket(key, deadline, next(self._seq))
+            self._enqueue_locked(ticket)
             self.accepted_total += 1
             if tenant is not None:
                 self._tenant_enter_locked(tenant)
             self._publish_depth_locked()
+            self._grant_locked()
             try:
-                while (
-                    self._waiters[0] is not ticket
-                    or self._inflight >= self.max_inflight
-                ):
+                while ticket not in self._granted:
                     timeout = 0.5
                     if deadline is not None:
                         remaining = deadline - clock()
                         if remaining <= 0:
-                            raise self._shed_locked(
-                                "deadline_expired", None,
-                                f"deadline expired after "
-                                f"{deadline_s:.2f}s budget while queued; "
-                                "never dispatched",
-                                tenant=tenant,
+                            raise self._expired_locked(
+                                deadline_s,
+                                "while queued; never dispatched", tenant,
                             )
                         timeout = min(timeout, remaining)
                     self._cond.wait(timeout)
+                self._granted.discard(ticket)
                 # the final pre-dispatch check: an ACCEPTED request must
                 # never reach the device past its deadline
                 if deadline is not None and deadline - clock() <= 0:
-                    raise self._shed_locked(
-                        "deadline_expired", None,
-                        f"deadline expired after {deadline_s:.2f}s budget "
-                        "at dispatch; never dispatched",
-                        tenant=tenant,
+                    raise self._expired_locked(
+                        deadline_s, "at dispatch; never dispatched", tenant,
                     )
             except BaseException:
-                self._waiters.remove(ticket)
+                self._abandon_locked(ticket)
                 if tenant is not None:
                     self._tenant_exit_locked(tenant)
                 self._publish_depth_locked()
+                # the abandoned slot (or grant) must pass to someone else
+                self._grant_locked()
                 self._cond.notify_all()
                 raise
-            self._waiters.pop(0)
             self._inflight += 1
             self.dispatched_total += 1
+            self._dispatched_by[key] = self._dispatched_by.get(key, 0) + 1
             self._publish_depth_locked()
         t0 = clock()
         try:
@@ -381,7 +758,15 @@ class AdmissionGate:
                         "between admission and dispatch",
                         tenant=tenant,
                     )
-                DEADLINE_VIOLATIONS_TOTAL.inc({"gate": self.name})
+                if tenant is not None:
+                    DEADLINE_VIOLATIONS_TOTAL.inc({
+                        "gate": self.name, "stage": "dispatch",
+                        "tenant": reqctx.TENANTS.admit(tenant),
+                    })
+                else:
+                    DEADLINE_VIOLATIONS_TOTAL.inc(
+                        {"gate": self.name, "stage": "dispatch"}
+                    )
                 raise err
             yield remaining
         finally:
@@ -393,16 +778,51 @@ class AdmissionGate:
                 self._ema = (
                     dt if self._ema is None else 0.8 * self._ema + 0.2 * dt
                 )
+                if label is not None:
+                    prev = self._tenant_ema.get(label)
+                    self._tenant_ema[label] = (
+                        dt if prev is None else 0.8 * prev + 0.2 * dt
+                    )
                 self._publish_depth_locked()
+                self._grant_locked()
                 self._cond.notify_all()
 
+    def admission_totals(self) -> Dict[Optional[str], Tuple[int, int]]:
+        """(good, total) admission outcomes per guarded tenant label, plus
+        a ``None`` aggregate — the SLO engine's ``collect`` source for a
+        ratio objective over the gate itself. good = dispatched; bad =
+        capacity sheds (queue_full, tenant_quota) plus in-queue deadline
+        expiries. Ladder/hook-driven sheds (brownout, brownout_shed) and
+        chaos injections are EXCLUDED on purpose: while a tenant is
+        demoted its residual traffic sheds at the ladder, and counting
+        those sheds as burn would hold the burn rate above the promote
+        threshold forever — the closed loop must be able to see the flood
+        stop."""
+        bad_reasons = ("queue_full", "tenant_quota", "deadline_expired")
+        with self._cond:
+            out: Dict[Optional[str], Tuple[int, int]] = {}
+            agg_good = agg_bad = 0
+            for key in set(self._dispatched_by) | set(self._shed_by):
+                good = self._dispatched_by.get(key, 0)
+                by = self._shed_by.get(key, {})
+                bad = sum(by.get(r, 0) for r in bad_reasons)
+                agg_good += good
+                agg_bad += bad
+                if key != _NO_TENANT and (good or bad):
+                    out[key] = (good, good + bad)
+            out[None] = (agg_good, agg_good + agg_bad)
+            return out
+
     def stats(self) -> Dict[str, object]:
+        ladder = self.ladder
+        ladder_stats = ladder.stats() if ladder is not None else None
         with self._cond:
             return {
                 "name": self.name,
                 "inflight": self._inflight,
-                "queued": len(self._waiters),
+                "queued": self._waiting_locked(),
                 "max_queue": self.max_queue,
+                "tenant_quota": self.tenant_quota,
                 "brownout_at": self.brownout_at,
                 "accepted_total": self.accepted_total,
                 "dispatched_total": self.dispatched_total,
@@ -412,6 +832,26 @@ class AdmissionGate:
                 "service_ema_s": (
                     round(self._ema, 4) if self._ema is not None else None
                 ),
+                # fair-share plane (sub-queue keys: guarded tenant labels;
+                # "" is the unbound-request queue)
+                "queues": {k: len(q) for k, q in self._queues.items()},
+                "weights": dict(self.weights),
+                "service_ema_by_tenant": {
+                    k: round(v, 4) for k, v in self._tenant_ema.items()
+                },
+                "dispatched_by_tenant": {
+                    k: v for k, v in self._dispatched_by.items()
+                    if k != _NO_TENANT
+                },
+                "shed_by_tenant": {
+                    k: dict(v) for k, v in self._shed_by.items()
+                    if k != _NO_TENANT
+                },
+                "expired_in_queue": {
+                    k: v for k, v in self._expired_in_queue.items()
+                    if k != _NO_TENANT
+                },
+                "ladder": ladder_stats,
             }
 
 
@@ -1037,6 +1477,8 @@ class HostSolver:
                  spawn_timeout: float = 180.0,
                  max_queue: int = 8, brownout_at: Optional[int] = None,
                  queue_deadline_s: Optional[float] = None,
+                 tenant_quota: Optional[int] = None,
+                 weights: Optional[Dict[str, float]] = None,
                  child_env: Optional[Dict[str, str]] = None,
                  admission: Optional[AdmissionGate] = None,
                  host: Optional[SolverHost] = None):
@@ -1055,6 +1497,7 @@ class HostSolver:
         )
         self.admission = admission or AdmissionGate(
             name="host", max_queue=max_queue, brownout_at=brownout_at,
+            tenant_quota=tenant_quota, weights=weights,
         )
         from karpenter_core_tpu.solver.encode import EncodeReuse
 

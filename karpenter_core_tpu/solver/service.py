@@ -50,6 +50,13 @@ SOLVER_RPC_RETRIES = REGISTRY.counter(
     "Solver RPCs retried after a transient failure (UNAVAILABLE/"
     "DEADLINE_EXCEEDED)",
 )
+SOLVER_RETRY_BUDGET_EXHAUSTED = REGISTRY.counter(
+    f"{NAMESPACE}_solver_retry_budget_exhausted_total",
+    "Solver RPC retries DENIED by the per-tenant retry budget (token "
+    "bucket): the original error is raised immediately instead of "
+    "retried, so a shed tenant cannot convert rejection into a retry "
+    "storm; by tenant when a request context is bound",
+)
 
 
 # ---------------------------------------------------------------------------
@@ -942,7 +949,9 @@ class SolverService:
 
 def serve(address: str = "127.0.0.1:0", max_workers: int = 4, mesh=None,
           maximum_concurrent_rpcs: Optional[int] = None,
-          max_queue: Optional[int] = 8, brownout_at: Optional[int] = None):
+          max_queue: Optional[int] = 8, brownout_at: Optional[int] = None,
+          tenant_quota: Optional[int] = None,
+          weights: Optional[Dict[str, float]] = None):
     """Start the gRPC server; returns (server, bound_port, service).
     mesh=True autodetects a multi-chip mesh (factory.detect_mesh).
 
@@ -965,6 +974,7 @@ def serve(address: str = "127.0.0.1:0", max_workers: int = 4, mesh=None,
 
         admission = AdmissionGate(
             name="service", max_queue=max_queue, brownout_at=brownout_at,
+            tenant_quota=tenant_quota, weights=weights,
         )
         # the executor must be able to HOLD every gate waiter plus the
         # dispatching handler plus health-probe headroom, or waiters
@@ -1026,10 +1036,11 @@ class RemoteSolver:
                  max_relax_rounds: int = None,
                  timeout: float = 120.0,
                  rpc_retries: int = 2, rpc_retry_base: float = 0.05,
-                 breaker=None):
+                 breaker=None, retry_budget=None):
         import grpc
 
         from karpenter_core_tpu.solver.fallback import CircuitBreaker
+        from karpenter_core_tpu.utils.backoff import RetryBudget
 
         self.target = target
         self.channel = grpc.insecure_channel(target)
@@ -1037,6 +1048,13 @@ class RemoteSolver:
         self.rpc_retries = rpc_retries
         self.rpc_retry_base = rpc_retry_base
         self.breaker = breaker or CircuitBreaker(name="solver.rpc")
+        # per-tenant token bucket consulted before EVERY retry (transient
+        # and retry-after-hint paths): jitter spreads a retry storm out,
+        # the budget stops it — and stops it per tenant, so one shed
+        # tenant's storm never drains everyone else's retries
+        self.retry_budget = (
+            retry_budget if retry_budget is not None else RetryBudget()
+        )
         self.max_nodes = max_nodes
         if max_relax_rounds is None:
             from karpenter_core_tpu.solver.tpu_solver import DEFAULT_MAX_RELAX_ROUNDS
@@ -1110,6 +1128,21 @@ class RemoteSolver:
         err.retry_after_s = retry_after
         return err
 
+    def _retry_allowed(self, err) -> bool:
+        """Consult the per-tenant retry budget for one more attempt.
+        Denial ticks the budget-exhausted counter and means the caller
+        raises *err* as-is — the budget bounds retry VOLUME; jitter and
+        retry-after hints still shape whatever it allows."""
+        key = reqctx.TENANTS.admit(reqctx.current_tenant())
+        if self.retry_budget.try_spend(key):
+            return True
+        SOLVER_RETRY_BUDGET_EXHAUSTED.inc(reqctx.tenant_labels())
+        LOG.warning(
+            "solver rpc retry budget exhausted, not retrying",
+            target=self.target, error=type(err).__name__,
+        )
+        return False
+
     def _invoke_solve(self, request: pb.SolveRequest, metadata, stub=None):
         """One Solve/Replan RPC through the breaker + bounded transient
         retry (stub defaults to the Solve method)."""
@@ -1144,7 +1177,7 @@ class RemoteSolver:
                 self.breaker.record_success()
             if err.transient:
                 self.breaker.record_failure()
-                if attempt < self.rpc_retries:
+                if attempt < self.rpc_retries and self._retry_allowed(err):
                     SOLVER_RPC_RETRIES.inc()
                     LOG.warning(
                         "solver rpc retrying", target=self.target,
@@ -1168,6 +1201,7 @@ class RemoteSolver:
                 isinstance(err, SolverResourceExhaustedError)
                 and getattr(err, "retry_after_s", None)
                 and attempt < self.rpc_retries
+                and self._retry_allowed(err)
             ):
                 # an admission-gate shed with a retry-after hint: the
                 # server is UP but overloaded — wait out the hint (plus

@@ -12,13 +12,81 @@ workqueue rate limiters embody:
     long-lived loops (singleton reconcilers) where each client's NEXT
     sleep should depend on its own last sleep, not a shared attempt
     counter, so fleets never re-synchronize.
+
+Plus the budget that bounds how often the shapes get used at all:
+:class:`RetryBudget`, a per-key token bucket consulted BEFORE a retry is
+attempted — jitter spreads a retry storm out, the budget stops it.
 """
 from __future__ import annotations
 
 import random
-from typing import Optional
+import threading
+import time
+from typing import Dict, Optional
 
 _MODULE_RNG = random.Random()
+
+
+class RetryBudget:
+    """Per-key token-bucket retry budget (key = guarded tenant label).
+
+    Each key starts with ``capacity`` tokens, refilled continuously at
+    ``refill_per_s``; every retry spends one. A key out of tokens gets NO
+    retry — the caller raises the original error immediately, so a shed
+    tenant cannot convert rejection into a retry storm while every other
+    tenant keeps its own full budget. Keys are expected to be
+    guard-admitted tenant labels (bounded set); the unbound-tenant key is
+    ``""``.
+
+    The bucket only gates WHETHER a retry happens; the sleep shape (full
+    jitter, retry-after hints) is untouched.
+    """
+
+    def __init__(self, capacity: float = 10.0, refill_per_s: float = 0.5,
+                 clock=time.monotonic):
+        self.capacity = float(capacity)
+        self.refill_per_s = float(refill_per_s)
+        self._clock = clock
+        self._mu = threading.Lock()
+        # key -> (tokens, last-refill timestamp)
+        self._buckets: Dict[str, tuple] = {}
+        self.spent_total = 0
+        self.denied_total = 0
+
+    def try_spend(self, key: Optional[str], cost: float = 1.0) -> bool:
+        """Spend *cost* tokens from *key*'s bucket; False = budget spent,
+        do not retry."""
+        key = key or ""
+        with self._mu:
+            now = self._clock()
+            tokens, last = self._buckets.get(key, (self.capacity, now))
+            tokens = min(
+                self.capacity, tokens + (now - last) * self.refill_per_s
+            )
+            if tokens >= cost:
+                self._buckets[key] = (tokens - cost, now)
+                self.spent_total += 1
+                return True
+            self._buckets[key] = (tokens, now)
+            self.denied_total += 1
+            return False
+
+    def stats(self) -> Dict[str, object]:
+        with self._mu:
+            now = self._clock()
+            return {
+                "capacity": self.capacity,
+                "refill_per_s": self.refill_per_s,
+                "spent_total": self.spent_total,
+                "denied_total": self.denied_total,
+                "tokens": {
+                    key: round(
+                        min(self.capacity,
+                            tokens + (now - last) * self.refill_per_s), 2
+                    )
+                    for key, (tokens, last) in self._buckets.items()
+                },
+            }
 
 
 def full_jitter(attempt: int, base: float, cap: float,

@@ -1,0 +1,527 @@
+"""Fair-share admission (ISSUE 17): weighted per-tenant queueing, EDF
+dispatch within a tenant, per-tenant quotas/retry-after hints, the retry
+budget that stops a storm, and the closed SLO->brownout loop that demotes
+only the burning tenant."""
+import threading
+import time
+
+import pytest
+
+from karpenter_core_tpu import chaos
+from karpenter_core_tpu.obs import reqctx
+from karpenter_core_tpu.solver.host import (
+    AdmissionGate,
+    BrownoutLadder,
+    DEADLINE_VIOLATIONS_TOTAL,
+    GATE_DEMOTIONS_TOTAL,
+)
+from karpenter_core_tpu.solver.service import (
+    SOLVER_RETRY_BUDGET_EXHAUSTED,
+    SolverDeadlineExceededError,
+    SolverResourceExhaustedError,
+)
+from karpenter_core_tpu.testing import FakeClock
+from karpenter_core_tpu.utils.backoff import RetryBudget
+
+
+@pytest.fixture(autouse=True)
+def _clean_slate():
+    """Chaos off and a fresh tenant-slot table around every test: this
+    suite mints many tenant names, and leaking them into the process-wide
+    guard would overflow OTHER suites' tenants into the `other` label."""
+    chaos.reset()
+    reqctx.TENANTS.reset()
+    yield
+    chaos.reset()
+    reqctx.TENANTS.reset()
+
+
+def _occupied_gate(**kwargs):
+    gate = AdmissionGate(name="fairshare-test", **kwargs)
+    release = threading.Event()
+    started = threading.Event()
+
+    def occupy():
+        with gate.admitted():
+            started.set()
+            release.wait(20)
+
+    t = threading.Thread(target=occupy, daemon=True, name="gate-occupier")
+    t.start()
+    assert started.wait(5)
+    return gate, release, t
+
+
+def _start_waiter(gate, tenant, order, tag, deadline_s=None):
+    def run():
+        with reqctx.bind(reqctx.RequestContext(tenant=tenant)):
+            with gate.admitted(deadline_s=deadline_s):
+                order.append(tag)
+
+    t = threading.Thread(target=run, daemon=True, name=f"waiter-{tag}")
+    t.start()
+    return t
+
+
+def _wait_queued(gate, n):
+    """Block until *n* tickets sit in the sub-queues — the serialization
+    point that makes multi-thread enqueue order deterministic."""
+    for _ in range(400):
+        if sum(gate.stats()["queues"].values()) >= n:
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"never saw {n} queued: {gate.stats()}")
+
+
+# -- dispatch order: WFQ across tenants, EDF within one --------------------
+
+
+def test_wfq_dispatch_alternates_across_tenants():
+    """Three queued requests from tenant A and one from tenant B, equal
+    weights: dispatch order is A,B,A,A — B is served after ONE of A's
+    requests, not after all three. FIFO would starve B behind A's backlog;
+    deficit-round-robin cannot."""
+    gate, release, t = _occupied_gate(max_queue=8)
+    order = []
+    waiters = []
+    for i, (tenant, tag) in enumerate(
+        [("wfq-a", "a1"), ("wfq-a", "a2"), ("wfq-a", "a3"), ("wfq-b", "b1")]
+    ):
+        waiters.append(_start_waiter(gate, tenant, order, tag))
+        _wait_queued(gate, i + 1)
+    release.set()
+    t.join(5)
+    for w in waiters:
+        w.join(5)
+    assert order == ["a1", "b1", "a2", "a3"], order
+
+
+def test_drr_weights_shape_dispatch_share():
+    """A tenant weighted 0.5 accumulates a dispatch credit every OTHER
+    ring rotation: with both backlogged, the weight-1.0 tenant gets two
+    dispatches for each of the light tenant's one."""
+    gate, release, t = _occupied_gate(
+        max_queue=8, weights={"wfq-lite": 0.5}
+    )
+    order = []
+    waiters = []
+    plan = [("wfq-hvy", "h1"), ("wfq-hvy", "h2"), ("wfq-hvy", "h3"),
+            ("wfq-hvy", "h4"), ("wfq-lite", "l1"), ("wfq-lite", "l2")]
+    for i, (tenant, tag) in enumerate(plan):
+        waiters.append(_start_waiter(gate, tenant, order, tag))
+        _wait_queued(gate, i + 1)
+    release.set()
+    t.join(5)
+    for w in waiters:
+        w.join(5)
+    assert order == ["h1", "h2", "l1", "h3", "h4", "l2"], order
+
+
+def test_edf_orders_within_tenant():
+    """Within one tenant's sub-queue the EARLIEST deadline dispatches
+    first, regardless of arrival order."""
+    gate, release, t = _occupied_gate(max_queue=8)
+    order = []
+    waiters = []
+    for i, deadline in enumerate([30.0, 10.0, 20.0]):
+        waiters.append(_start_waiter(
+            gate, "edf-team", order, deadline, deadline_s=deadline
+        ))
+        _wait_queued(gate, i + 1)
+    release.set()
+    t.join(5)
+    for w in waiters:
+        w.join(5)
+    assert order == [10.0, 20.0, 30.0], order
+
+
+# -- per-tenant quota and retry-after -------------------------------------
+
+
+def test_tenant_quota_sheds_only_the_flooder():
+    """With tenant_quota=1 the flooder's SECOND queued request sheds
+    (typed, retry-after hint attached) while another tenant still queues
+    freely — the quota isolates the offender, not the gate."""
+    gate, release, t = _occupied_gate(max_queue=8, tenant_quota=1)
+    order = []
+    w1 = _start_waiter(gate, "quota-flood", order, "a1")
+    _wait_queued(gate, 1)
+    with reqctx.bind(reqctx.RequestContext(tenant="quota-flood")):
+        with pytest.raises(SolverResourceExhaustedError) as exc:
+            with gate.admitted():
+                pass
+    assert exc.value.shed_reason == "tenant_quota"
+    assert exc.value.retry_after_s and exc.value.retry_after_s > 0
+    assert "retry_after_ms=" in str(exc.value)
+    # the calm tenant is NOT shed by the flooder's quota
+    w2 = _start_waiter(gate, "quota-calm", order, "b1")
+    _wait_queued(gate, 2)
+    release.set()
+    t.join(5)
+    w1.join(5)
+    w2.join(5)
+    stats = gate.stats()
+    assert set(order) == {"a1", "b1"}
+    assert list(stats["shed_by_tenant"]) == ["quota-flood"]
+    assert stats["shed_by_tenant"]["quota-flood"]["tenant_quota"] == 1
+    assert stats["dispatched_by_tenant"] == {"quota-flood": 1, "quota-calm": 1}
+
+
+def test_retry_after_hint_is_per_tenant_ema():
+    """The shed's retry-after hint is the REQUESTING tenant's own queue
+    depth x its own service-time EMA — one tenant's slow solves no longer
+    poison the hint for everyone. The global EMA is only the cold-start
+    fallback."""
+    gate, release, t = _occupied_gate(max_queue=0)
+    gate._tenant_ema["ema-slow"] = 2.0
+    gate._ema = 0.05
+    with reqctx.bind(reqctx.RequestContext(tenant="ema-slow")):
+        with pytest.raises(SolverResourceExhaustedError) as exc_slow:
+            with gate.admitted():
+                pass
+    with reqctx.bind(reqctx.RequestContext(tenant="ema-fresh")):
+        with pytest.raises(SolverResourceExhaustedError) as exc_fresh:
+            with gate.admitted():
+                pass
+    # depth is 1 (the occupier) for both; only the EMA differs
+    assert exc_slow.value.retry_after_s == pytest.approx(4.0)
+    assert exc_fresh.value.retry_after_s == pytest.approx(0.1)
+    release.set()
+    t.join(5)
+
+
+def test_retry_after_hint_rides_trailing_metadata_per_tenant():
+    """Satellite 1 wire check: the trailing-metadata retry-after hint the
+    client parses back reflects the REQUESTING tenant's EMA, per tenant,
+    over a real gRPC hop."""
+    from karpenter_core_tpu.cloudprovider import fake
+    from karpenter_core_tpu.solver.service import RemoteSolver, serve
+    from karpenter_core_tpu.testing import make_pod, make_provisioner
+
+    server, port, service = serve(max_workers=4, max_queue=0)
+    try:
+        service.admission._tenant_ema["hint-slow"] = 2.0
+        service.admission._ema = 0.05
+        gate_cm = service.admission.admitted()
+        gate_cm.__enter__()  # occupy: queue capacity is zero, RPCs shed
+        client = RemoteSolver(f"127.0.0.1:{port}", rpc_retries=0)
+        pods = [make_pod(requests={"cpu": "1"}) for _ in range(4)]
+        args = (pods, [make_provisioner(name="d")],
+                {"d": fake.instance_types(4)})
+        with reqctx.bind(reqctx.RequestContext(tenant="hint-slow")):
+            with pytest.raises(SolverResourceExhaustedError) as exc_slow:
+                client.solve(*args)
+        with reqctx.bind(reqctx.RequestContext(tenant="hint-fresh")):
+            with pytest.raises(SolverResourceExhaustedError) as exc_fresh:
+                client.solve(*args)
+        assert exc_slow.value.retry_after_s == pytest.approx(4.0, abs=0.01)
+        assert exc_fresh.value.retry_after_s == pytest.approx(0.1, abs=0.01)
+        gate_cm.__exit__(None, None, None)
+    finally:
+        server.stop(0)
+
+
+# -- retry budget ----------------------------------------------------------
+
+
+def test_retry_budget_token_bucket():
+    clock = FakeClock()
+    rb = RetryBudget(capacity=2.0, refill_per_s=1.0, clock=clock)
+    assert rb.try_spend("a")
+    assert rb.try_spend("a")
+    assert not rb.try_spend("a"), "capacity spent"
+    assert rb.try_spend("b"), "per-key isolation: b has its own bucket"
+    clock.advance(1.0)
+    assert rb.try_spend("a"), "continuous refill"
+    assert not rb.try_spend("a")
+    clock.advance(100.0)
+    assert rb.try_spend("a") and rb.try_spend("a")
+    assert not rb.try_spend("a"), "refill caps at capacity"
+    rb2 = RetryBudget(capacity=1.0, refill_per_s=0.0, clock=clock)
+    assert rb2.try_spend(None), "None folds into the unbound '' bucket"
+    assert not rb2.try_spend(""), "... which is one shared bucket"
+    stats = rb.stats()
+    assert stats["capacity"] == 2.0
+    assert stats["denied_total"] >= 3
+    assert stats["spent_total"] >= 5
+
+
+def test_retry_budget_stops_retry_storm_per_tenant():
+    """An exhausted budget raises the original error instead of retrying —
+    and exhausts PER TENANT: the unbound storm draining '' leaves a bound
+    tenant's bucket full."""
+    from karpenter_core_tpu.solver import service_pb2 as pb
+    from karpenter_core_tpu.solver.fallback import CircuitBreaker
+    from karpenter_core_tpu.solver.service import (
+        RemoteSolver,
+        SolverUnavailableError,
+    )
+
+    fault = chaos.arm(chaos.SOLVER_RPC, error="unavailable")
+    client = RemoteSolver(
+        "127.0.0.1:1", rpc_retries=10, rpc_retry_base=0.001,
+        breaker=CircuitBreaker(failure_threshold=100),
+        retry_budget=RetryBudget(capacity=2.0, refill_per_s=0.0),
+    )
+    with pytest.raises(SolverUnavailableError):
+        client._invoke_solve(pb.SolveRequest(), None)
+    assert fault.injected == 3, "1 initial + the 2 budget-allowed retries"
+    with pytest.raises(SolverUnavailableError):
+        client._invoke_solve(pb.SolveRequest(), None)
+    assert fault.injected == 4, "bucket empty: no retry at all"
+    before = SOLVER_RETRY_BUDGET_EXHAUSTED.get({"tenant": "storm-b"}) or 0
+    with reqctx.bind(reqctx.RequestContext(tenant="storm-b")):
+        with pytest.raises(SolverUnavailableError):
+            client._invoke_solve(pb.SolveRequest(), None)
+    assert fault.injected == 7, (
+        "the bound tenant's bucket is untouched by the unbound storm"
+    )
+    assert (
+        SOLVER_RETRY_BUDGET_EXHAUSTED.get({"tenant": "storm-b"}) or 0
+    ) == before + 1
+
+
+# -- the brownout ladder (closed SLO loop) ---------------------------------
+
+
+def test_ladder_demotes_and_promotes_with_hysteresis():
+    clock = FakeClock()
+    burns = {"lad-osc": 5.0}
+    ladder = BrownoutLadder(
+        lambda t: burns.get(t, 0.0), demote_at=1.0, promote_below=0.5,
+        hold_s=10.0, eval_interval_s=1.0, clock=clock,
+    )
+    # first demotion is immediate (rung 0 needs no dwell)
+    assert ladder.review("lad-osc") == "greedy"
+    # rate limit: re-review inside eval_interval_s answers from cache
+    burns["lad-osc"] = 0.0
+    assert ladder.review("lad-osc") == "greedy"
+    burns["lad-osc"] = 5.0
+    # escalation needs the dwell: 1.5s in is still greedy
+    clock.advance(1.5)
+    assert ladder.review("lad-osc") == "greedy"
+    clock.advance(10.0)
+    assert ladder.review("lad-osc") == "shed"
+    # burn stops: promotion ALSO waits out the dwell (hysteresis)
+    burns["lad-osc"] = 0.0
+    clock.advance(1.5)
+    assert ladder.review("lad-osc") == "shed"
+    clock.advance(10.0)
+    assert ladder.review("lad-osc") == "greedy"
+    clock.advance(10.5)
+    assert ladder.review("lad-osc") == "device"
+    assert ladder.demotions_total == 2
+    assert ladder.promotions_total == 2
+    st = ladder.stats()
+    assert st["tenants"]["lad-osc"]["level"] == "device"
+    # a bystander that never burned never leaves the device rung
+    assert ladder.review("lad-calm") == "device"
+    assert ladder.demotions_total == 2
+
+
+def test_ladder_sick_probe_holds_rung():
+    """A failing burn probe HOLDS the current rung: the ladder acts on
+    absolute SLO evidence, and a sick probe is not evidence (contrast with
+    the depth-band preference hook, which fails closed)."""
+    clock = FakeClock()
+    state = {"burn": 5.0}
+
+    def probe(tenant):
+        if state["burn"] is None:
+            raise RuntimeError("slo engine sick")
+        return state["burn"]
+
+    ladder = BrownoutLadder(
+        probe, demote_at=1.0, promote_below=0.5, hold_s=1.0,
+        eval_interval_s=0.0, clock=clock,
+    )
+    assert ladder.review("lad-sick") == "greedy"
+    state["burn"] = None
+    clock.advance(50.0)
+    assert ladder.review("lad-sick") == "greedy", "sick probe holds"
+    state["burn"] = 0.0
+    clock.advance(50.0)
+    assert ladder.review("lad-sick") == "device"
+
+
+def test_ladder_demotes_only_burning_tenant_at_gate():
+    """Gate integration: the burning tenant walks device -> greedy ->
+    shed (each rung a distinct typed shed) while a calm tenant dispatches
+    throughout; when the burn stops, hysteresis walks the burner back up
+    and it dispatches again. Demotions tick the counter per tenant."""
+    clock = FakeClock()
+    burns = {"lad-hot": 5.0}
+    ladder = BrownoutLadder(
+        lambda t: burns.get(t, 0.0), demote_at=1.0, promote_below=0.5,
+        hold_s=5.0, eval_interval_s=0.0, clock=clock,
+    )
+    gate = AdmissionGate(name="ladder-gate", max_queue=4, ladder=ladder)
+    greedy_before = GATE_DEMOTIONS_TOTAL.get(
+        {"tenant": "lad-hot", "reason": "greedy"}) or 0
+    shed_before = GATE_DEMOTIONS_TOTAL.get(
+        {"tenant": "lad-hot", "reason": "shed"}) or 0
+
+    with reqctx.bind(reqctx.RequestContext(tenant="lad-hot")):
+        with pytest.raises(SolverResourceExhaustedError) as exc:
+            with gate.admitted():
+                pass
+    assert exc.value.shed_reason == "brownout"
+    with reqctx.bind(reqctx.RequestContext(tenant="lad-cold")):
+        with gate.admitted():
+            pass  # the calm tenant rides through
+    clock.advance(6.0)  # past hold_s: the still-burning tenant escalates
+    with reqctx.bind(reqctx.RequestContext(tenant="lad-hot")):
+        with pytest.raises(SolverResourceExhaustedError) as exc:
+            with gate.admitted():
+                pass
+    assert exc.value.shed_reason == "brownout_shed"
+    assert exc.value.retry_after_s == pytest.approx(ladder.hold_s)
+    # the flood stops: two dwells walk shed -> greedy -> device
+    burns["lad-hot"] = 0.0
+    clock.advance(6.0)
+    with reqctx.bind(reqctx.RequestContext(tenant="lad-hot")):
+        with pytest.raises(SolverResourceExhaustedError):
+            with gate.admitted():
+                pass  # promoted to greedy: still shedding to the fallback
+    clock.advance(6.0)
+    with reqctx.bind(reqctx.RequestContext(tenant="lad-hot")):
+        with gate.admitted():
+            pass  # back on the device rung
+    assert ladder.demotions_total == 2 and ladder.promotions_total == 2
+    assert (GATE_DEMOTIONS_TOTAL.get(
+        {"tenant": "lad-hot", "reason": "greedy"}) or 0) == greedy_before + 1
+    assert (GATE_DEMOTIONS_TOTAL.get(
+        {"tenant": "lad-hot", "reason": "shed"}) or 0) == shed_before + 1
+    stats = gate.stats()
+    assert stats["ladder"]["tenants"]["lad-hot"]["level"] == "device"
+    assert "lad-cold" not in stats["ladder"]["tenants"] or (
+        stats["ladder"]["tenants"]["lad-cold"]["level"] == "device"
+    )
+    assert stats["shed_by_tenant"]["lad-hot"]["brownout"] == 2
+    assert stats["shed_by_tenant"]["lad-hot"]["brownout_shed"] == 1
+    assert "lad-cold" not in stats["shed_by_tenant"]
+
+
+# -- deadlines -------------------------------------------------------------
+
+
+def test_deadline_expired_attributed_per_tenant():
+    """A request that expires while queued sheds as deadline_expired,
+    billed to ITS tenant: the stage=queue violations series ticks, the
+    per-tenant expired_in_queue stat ticks, and the structural
+    stage=dispatch counter stays zero."""
+    gate, release, t = _occupied_gate(max_queue=4)
+    labels = {"gate": "fairshare-test", "stage": "queue",
+              "tenant": "exp-team"}
+    before = DEADLINE_VIOLATIONS_TOTAL.get(labels) or 0
+    with reqctx.bind(reqctx.RequestContext(tenant="exp-team")):
+        with pytest.raises(SolverDeadlineExceededError) as exc:
+            with gate.admitted(deadline_s=0.3):
+                pass
+    assert exc.value.shed_reason == "deadline_expired"
+    assert (DEADLINE_VIOLATIONS_TOTAL.get(labels) or 0) == before + 1
+    stats = gate.stats()
+    assert stats["expired_in_queue"] == {"exp-team": 1}
+    assert stats["shed_by_tenant"]["exp-team"]["deadline_expired"] == 1
+    assert stats["deadline_violations"] == 0, (
+        "stage=dispatch is structural: queue expiries never reach it"
+    )
+    release.set()
+    t.join(5)
+
+
+def test_ctx_deadline_tightens_gate_budget():
+    """RequestContext.deadline_s is CONSUMED by the gate: an
+    already-expired context budget is never dispatched, even through an
+    idle gate."""
+    gate = AdmissionGate(name="ctx-deadline", max_queue=4)
+    assert reqctx.current_deadline() is None
+    with reqctx.bind(reqctx.RequestContext(tenant="ctxdl", deadline_s=0.0)):
+        assert reqctx.current_deadline() == 0.0
+        with pytest.raises(SolverDeadlineExceededError):
+            with gate.admitted(deadline_s=30.0):  # ctx tightens 30 -> 0
+                pass
+    assert gate.dispatched_total == 0
+    assert gate.stats()["expired_in_queue"] == {"ctxdl": 1}
+
+
+# -- the SLO feedback source ----------------------------------------------
+
+
+def test_admission_totals_feed_fast_burn():
+    """admission_totals() is the SLO engine's collect source: capacity
+    sheds burn, dispatches don't, and fast_burn() sees the flooder (and
+    ONLY the flooder) burning over the fast window."""
+    from karpenter_core_tpu.obs.slo import Objective, SloEngine
+
+    gate, release, t = _occupied_gate(max_queue=0)
+    for _ in range(3):
+        with reqctx.bind(reqctx.RequestContext(tenant="totals-flood")):
+            with pytest.raises(SolverResourceExhaustedError):
+                with gate.admitted():
+                    pass
+    release.set()
+    t.join(5)
+    with reqctx.bind(reqctx.RequestContext(tenant="totals-calm")):
+        with gate.admitted():
+            pass
+    totals = gate.admission_totals()
+    assert totals["totals-flood"] == (0, 3)
+    assert totals["totals-calm"] == (1, 1)
+    # the aggregate counts the unbound occupier's dispatch too
+    assert totals[None] == (2, 5)
+    engine = SloEngine(
+        [Objective(name="gate-admission", histogram=None, threshold_s=0.0,
+                   target=0.95, collect=gate.admission_totals)],
+        windows=(("2s", 2.0), ("10s", 10.0)),
+    )
+    assert engine.fast_burn("totals-flood") > 1.0
+    assert engine.fast_burn("totals-calm") == 0.0
+    assert engine.fast_burn(None) == 0.0
+
+
+def test_ladder_sheds_excluded_from_burn():
+    """Ladder sheds must NOT count as burn: if they did, a demoted
+    tenant's residual traffic would hold its burn above the promote
+    threshold forever and the closed loop could never recover."""
+    gate, release, t = _occupied_gate(max_queue=0)
+    with reqctx.bind(reqctx.RequestContext(tenant="loop-a")):
+        with pytest.raises(SolverResourceExhaustedError):
+            with gate.admitted():
+                pass
+    release.set()
+    t.join(5)
+    assert gate.admission_totals()["loop-a"] == (0, 1)
+    # now shed the same tenant at the LADDER: totals must not move
+    gate.ladder = BrownoutLadder(
+        lambda t: 5.0, hold_s=60.0, eval_interval_s=0.0, clock=FakeClock(),
+    )
+    for _ in range(4):
+        with reqctx.bind(reqctx.RequestContext(tenant="loop-a")):
+            with pytest.raises(SolverResourceExhaustedError) as exc:
+                with gate.admitted():
+                    pass
+        assert exc.value.shed_reason == "brownout"
+    assert gate.admission_totals()["loop-a"] == (0, 1), (
+        "brownout sheds are excluded: the loop must see the flood stop"
+    )
+
+
+# -- chaos flood point -----------------------------------------------------
+
+
+def test_chaos_flood_reattributes_to_synthetic_tenant():
+    """solver.gate.flood does not ERROR the request — it re-attributes it
+    to the synthetic chaos-flood tenant, so quota/brownout isolation can
+    be drilled mid-churn without touching real tenants' accounting."""
+    from karpenter_core_tpu.solver.host import CHAOS_FLOOD_TENANT
+
+    gate = AdmissionGate(name="chaos-flood-gate", max_queue=4)
+    fault = chaos.arm(chaos.SOLVER_GATE_FLOOD, error="exhausted", times=1)
+    with gate.admitted():
+        pass  # no tenant bound; the injection re-attributes, never raises
+    assert fault.injected == 1
+    assert gate.stats()["dispatched_by_tenant"] == {CHAOS_FLOOD_TENANT: 1}
+    with gate.admitted():
+        pass  # fault exhausted: back to the unbound sub-queue
+    assert gate.stats()["dispatched_by_tenant"] == {CHAOS_FLOOD_TENANT: 1}

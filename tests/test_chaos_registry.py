@@ -264,3 +264,17 @@ def test_rpc_overload_point_parses_with_exhausted_kind():
     fault = faults[c.SOLVER_RPC_OVERLOAD]
     assert fault.probability == 0.5
     assert isinstance(fault._build_error(), SolverResourceExhaustedError)
+
+
+def test_gate_flood_point_parses_with_probability():
+    """solver.gate.flood (ISSUE 17): tenant-flood injection at the
+    admission gate — the armed fault is swallowed at the hook and the
+    request is RE-ATTRIBUTED to one synthetic flooding tenant, so
+    `p:<frac>` turns that fraction of live traffic into a flood that must
+    trip quota/brownout isolation without touching real tenants."""
+    from karpenter_core_tpu import chaos as c
+
+    assert c.SOLVER_GATE_FLOOD in c.KNOWN_POINTS
+    faults = c.parse_spec("solver.gate.flood=error:exhausted,p:0.25,seed:3")
+    fault = faults[c.SOLVER_GATE_FLOOD]
+    assert fault.probability == 0.25 and fault.seed == 3

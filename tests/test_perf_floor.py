@@ -679,6 +679,54 @@ def test_attribution_off_path_cost():
     )
 
 
+def test_gate_hot_path_unset_tenant_cost():
+    """ISSUE 17 tripwire: the fair-share gate's multi-queue machinery is
+    free when no tenant is bound — an unset-context dispatch mints no
+    guard slots, no tenant-labeled series on any gate metric, no
+    sub-queue keyed by a tenant, and the per-dispatch overhead stays
+    bounded."""
+    import timeit
+
+    from karpenter_core_tpu.obs import reqctx
+    from karpenter_core_tpu.solver.host import (
+        SOLVER_QUEUE_WAIT,
+        SOLVER_SHED_TOTAL,
+        AdmissionGate,
+    )
+
+    assert reqctx.current_tenant() is None
+    gate = AdmissionGate(name="perf-floor-gate", max_queue=4)
+
+    def one_pass():
+        with gate.admitted():
+            pass
+
+    slots_before = reqctx.TENANTS.stats()["slots"]
+    n = 2000
+    t_gate = timeit.timeit(one_pass, number=n)
+    assert reqctx.TENANTS.stats()["slots"] == slots_before, (
+        "unset-path dispatches must not mint tenant-guard slots"
+    )
+    stats = gate.stats()
+    assert stats["dispatched_total"] == n
+    # the per-tenant planes stay EMPTY (the unbound sub-queue key is
+    # filtered out of every stat, and no tenant metric series exists)
+    assert stats["dispatched_by_tenant"] == {}
+    assert stats["shed_by_tenant"] == {}
+    assert stats["service_ema_by_tenant"] == {}
+    assert stats["expired_in_queue"] == {}
+    assert stats["tenants"] == {}
+    for metric in (SOLVER_QUEUE_WAIT, SOLVER_SHED_TOTAL):
+        for labels, _ in metric.series():
+            if labels.get("gate") == "perf-floor-gate":
+                assert "tenant" not in labels, (metric.name, labels)
+    # bounded overhead: one uncontended gate pass is lock + ticket +
+    # histogram observe — generous ceiling, regression tripwire not bench
+    assert t_gate / n < 5e-4, (
+        f"unset-path gate dispatch {t_gate / n * 1e6:.0f}us/pass"
+    )
+
+
 def test_tenant_guard_flood_stays_bounded():
     """ISSUE 16 tripwire: a label-value flood (adversarial or buggy tenant
     strings) can never mint more than cap+1 label values; admit() on a hot

@@ -7,6 +7,7 @@ import io
 import json
 import struct
 import threading
+import time
 
 import pytest
 
@@ -203,6 +204,52 @@ def test_gate_stats_track_per_tenant_depth():
     q.join(5)
     # fully drained: the per-tenant depth series is deleted, not zeroed
     assert "depth-team" not in gate.stats()["tenants"]
+
+
+def test_flood_drill_isolates_tenant_b():
+    """The ISSUE 17 flood drill in miniature, deterministic by
+    construction: tenant A hammers the gate from 8 threads against a
+    per-tenant quota of 2 while tenant B runs a steady sequential
+    trickle. Every one of B's requests is served, none expires in queue,
+    no shed is ever billed to B — the quota and the fair-share ring
+    isolate the flooder."""
+    gate = AdmissionGate(name="flood-drill", max_queue=64, tenant_quota=2)
+    stop = threading.Event()
+
+    def flooder():
+        while not stop.is_set():
+            try:
+                with reqctx.bind(reqctx.RequestContext(tenant="drill-a")):
+                    with gate.admitted(deadline_s=10.0):
+                        time.sleep(0.002)
+            except SolverResourceExhaustedError:
+                time.sleep(0.001)  # shed: a well-behaved client backs off
+
+    threads = [
+        threading.Thread(target=flooder, daemon=True, name=f"flood-{i}")
+        for i in range(8)
+    ]
+    for t in threads:
+        t.start()
+    b_served = 0
+    for _ in range(25):
+        with reqctx.bind(
+            reqctx.RequestContext(tenant="drill-b", deadline_s=10.0)
+        ):
+            with gate.admitted():
+                b_served += 1
+    stop.set()
+    for t in threads:
+        t.join(10)
+    stats = gate.stats()
+    assert b_served == 25, "every one of B's requests must dispatch"
+    assert "drill-b" not in stats["shed_by_tenant"], stats["shed_by_tenant"]
+    assert stats["expired_in_queue"].get("drill-b", 0) == 0
+    assert stats["shed_by_tenant"].get("drill-a", {}).get(
+        "tenant_quota", 0
+    ) > 0, "the flooder must actually have been quota-shed (non-vacuous)"
+    assert stats["deadline_violations"] == 0
+    assert gate.admission_totals()["drill-b"] == (25, 25)
 
 
 # -- kill/respawn fold-once with tenant series ----------------------------
