@@ -5,7 +5,7 @@
 .PHONY: test deflake perf bench verify trace-demo chaos chaos-smoke \
 	replay-demo lint soak soak-smoke soak-smoke-inproc prewarm-smoke \
 	multichip-smoke consolidation-smoke bench-smoke host-smoke race-smoke \
-	segment-smoke obs-smoke
+	segment-smoke obs-smoke prof-smoke
 
 test:  ## tier-1 suite (CPU, 8 virtual devices); slow chaos soaks: make chaos
 	python -m pytest tests -q -m "not slow"
@@ -66,6 +66,12 @@ obs-smoke:  ## cross-process observability on a live host-mode operator: child
 	# device phases grafted into /debug/trace (set parity), merged metrics
 	# under the process label + trace-id exemplars, wedge kill names the phase
 	JAX_PLATFORMS=$${JAX_PLATFORMS:-cpu} python hack/obs_smoke.py
+
+prof-smoke:  ## compiled-program cost inventory + perf ledger on a live host-mode
+	# operator: /debug/programs unifies child + local entries with compile
+	# seconds, a chaos-wedged probe's forensics name the init phase, and a
+	# two-round PERF_LEDGER.json tripwires a seeded 2x slowdown
+	JAX_PLATFORMS=$${JAX_PLATFORMS:-cpu} python hack/prof_smoke.py
 
 prewarm-smoke:  ## warm-cache restart gate: prewarm a tier, restart fresh, first solve under budget
 	JAX_PLATFORMS=$${JAX_PLATFORMS:-cpu} python hack/prewarm_smoke.py
@@ -151,6 +157,11 @@ verify:  ## driver hooks: single-chip compile check + 8-way mesh dryrun
 	# device phases, the exposition the merged child metrics + exemplars,
 	# and a chaos-killed child a phase-named wedge event (fatal in presubmit)
 	-$(MAKE) obs-smoke
+	# non-fatal smoke: /debug/programs must unify the sidecar child's
+	# compiled-program inventory with the local one, a wedged probe must
+	# name its init phase, and the perf-ledger tripwire must catch a
+	# seeded slowdown (fatal in presubmit)
+	-$(MAKE) prof-smoke
 	# non-fatal smoke: the segmented pack scan on a live operator must stay
 	# byte-identical to sequential and degrade cleanly under chaos (fatal
 	# gate lives in presubmit)

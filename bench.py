@@ -64,6 +64,7 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 import numpy as np
 
+from karpenter_core_tpu.obs import envflags
 from karpenter_core_tpu.utils import supervise
 
 N_PODS = int(os.environ.get("BENCH_PODS", "50000"))
@@ -133,6 +134,9 @@ STAGE_STALE = int(os.environ.get("BENCH_STAGE_STALE", "600"))
 # the sidecar health daemon's re-probe cadence; verdict TTL covers two
 # cycles plus a probe timeout so a dead daemon reads as "no verdict"
 HEALTH_INTERVAL = int(os.environ.get("BENCH_HEALTH_INTERVAL", "120"))
+# probe-forensics caps (ISSUE 18): karpenter-namespaced knobs route through
+# the audited envflags funnel (the BENCH_* spellings above predate it)
+PROBE_FORENSIC_TAIL = int(envflags.raw("KARPENTER_PROBE_FORENSIC_TAIL", "2048"))
 
 # (name, default worker budget seconds, ordered-after stages). The `needs`
 # edges order the graph (a later stage reuses the round's shared compile
@@ -1461,6 +1465,30 @@ STAGE_FNS = {
 }
 
 
+def _programs_digest() -> str:
+    """Short identity digest of this worker's compiled-program inventory
+    (family + key of every live record) — '' when the ledger is disabled
+    or empty. Stable across re-runs of the same workload on the same
+    build; a changed digest between rounds says the program population
+    itself moved, not just the timings."""
+    try:
+        import hashlib
+
+        from karpenter_core_tpu.obs import proghealth
+
+        snap = proghealth.LEDGER.snapshot()
+        ident = sorted(
+            (str(p.get("family", "")), str(p.get("key", "")))
+            for p in snap.get("programs", [])
+        )
+        if not ident:
+            return ""
+        blob = json.dumps(ident, sort_keys=True).encode()
+        return hashlib.blake2s(blob, digest_size=6).hexdigest()
+    except Exception:  # noqa: BLE001 — forensics must never fail a stage
+        return ""
+
+
 def stage_worker(name: str) -> int:
     """BENCH_STAGE=<name> subprocess entry: resolve the backend the
     orchestrator decided (BENCH_SKIP_PROBE / BENCH_CPU — never an in-line
@@ -1486,6 +1514,11 @@ def stage_worker(name: str) -> int:
         data = fn()
         import jax
 
+        if isinstance(data, dict):
+            # ISSUE 18: tie this stage's numbers to the exact compiled-
+            # program population that produced them (the ledger row's
+            # programs_digest column)
+            data.setdefault("programs_digest", _programs_digest())
         print(json.dumps({
             "stage": name,
             "backend": BACKEND_NOTE,
@@ -1526,9 +1559,12 @@ def health_daemon() -> None:
     while True:
         timeout = PROBE_SCHEDULE[0] if first else PROBE_TIMEOUT
         first = False
-        ok, note = _probe_once(timeout)
+        ok, note, forensics = _probe_forensic(timeout)
         supervise.write_verdict(
             path, ok, note, ttl_s=HEALTH_INTERVAL * 2 + timeout,
+            # ISSUE 18: the forensic record rides the verdict file so a
+            # wedged round's merged artifact names the failing init phase
+            extra={"probe_forensics": forensics},
         )
         print(f"[bench-health] verdict ok={ok} ({note})", file=sys.stderr)
         if os.getppid() != parent:
@@ -1759,6 +1795,153 @@ def merge_round(store: supervise.ArtifactStore, round_dir: str = "") -> dict:
         "vs_baseline": round((value or 0.0) / 100.0, 2),
         "extra": extra,
     }
+
+
+# ---------------------------------------------------------------------------
+# cross-round perf ledger (ISSUE 18): cumulative PERF_LEDGER.json + the
+# regression tripwire — pure over the store/ledger dicts like merge_round,
+# so tests/test_bench_resume.py drives both without subprocesses.
+
+LEDGER_VERSION = 1
+# regression threshold, percent worse than best-known on the same platform
+LEDGER_REGRESSION_PCT = float(envflags.raw("KARPENTER_PERF_REGRESSION_PCT", "25"))
+# column-name direction heuristics: timings regress UP, rates regress DOWN;
+# a column matching neither is ledgered but never tripwired (no direction,
+# no verdict — counts and geometry knobs are identity, not performance)
+_LEDGER_LOWER_BETTER = ("_ms", "_s", "_sec", "_seconds")
+_LEDGER_HIGHER_BETTER = ("per_sec", "speedup", "ratio")
+
+
+def _ledger_direction(column: str) -> str:
+    """'lower' / 'higher' when the column's better-direction is known from
+    its name, '' otherwise. Rate tokens win first: 'pods_per_sec' ends
+    with the '_sec' timing suffix but is a throughput."""
+    if any(tok in column for tok in _LEDGER_HIGHER_BETTER):
+        return "higher"
+    if any(column.endswith(sfx) for sfx in _LEDGER_LOWER_BETTER):
+        return "lower"
+    return ""
+
+
+def append_ledger(store: supervise.ArtifactStore, ledger, round_name: str) -> dict:
+    """Fold one round's COMPLETED stage artifacts into the cumulative
+    ledger — pure (prior ledger dict in, new ledger dict out; the
+    orchestrator owns the PERF_LEDGER.json file I/O). One row per
+    (round, stage, column) where a column is any numeric scalar in the
+    stage's data; re-folding the same round REPLACES its rows, so a
+    --resume backfill updates in place instead of duplicating, and the
+    sorted rows make the same store fold byte-identically."""
+    rows = [
+        r for r in (ledger or {}).get("rows", [])
+        if isinstance(r, dict) and r.get("round") != round_name
+    ]
+    for name in STAGE_NAMES:
+        rec = store.load(name)
+        if rec is None or rec.get("degraded"):
+            continue
+        data = rec.get("data")
+        if not isinstance(data, dict) or "skipped" in data:
+            continue
+        meta = rec.get("meta") or {}
+        platform = str(meta.get("platform") or "")
+        digest = str(data.get("programs_digest") or "")
+        fallback = bool(rec.get("fallback"))
+        for column in sorted(data):
+            value = data[column]
+            if isinstance(value, bool) or not isinstance(value, (int, float)):
+                continue
+            rows.append({
+                "round": round_name,
+                "stage": name,
+                "column": column,
+                "value": float(value),
+                "platform": platform,
+                "degraded": False,
+                "fallback": fallback,
+                "programs_digest": digest,
+            })
+    rows.sort(key=lambda r: (r["round"], r["stage"], r["column"]))
+    return {"version": LEDGER_VERSION, "rows": rows}
+
+
+def ledger_verdict(ledger, round_name: str, pct=None) -> dict:
+    """The regression tripwire: this round's direction-known columns vs
+    the best-known value for the same (stage, column, PLATFORM) from
+    earlier rounds — cross-platform comparison is exactly the r03-r05
+    trap (CPU-fallback numbers vs TPU numbers) this plane exists to end.
+    Worse than best-known by more than `pct` percent ⇒ a named regression
+    entry. WARN-ONLY by contract: the orchestrator folds the verdict into
+    the merged artifact and never fails the round on it. Shrunk fallback
+    rows (different workload) are excluded from both sides."""
+    pct = LEDGER_REGRESSION_PCT if pct is None else float(pct)
+    rows = [
+        r for r in (ledger or {}).get("rows", [])
+        if isinstance(r, dict) and not r.get("fallback")
+    ]
+    best: dict = {}
+    for r in rows:
+        if r.get("round") == round_name:
+            continue
+        direction = _ledger_direction(str(r.get("column", "")))
+        if not direction:
+            continue
+        key = (r.get("stage"), r.get("column"), r.get("platform"))
+        try:
+            value = float(r.get("value"))
+        except (TypeError, ValueError):
+            continue
+        cur = best.get(key)
+        if cur is None or (value < cur if direction == "lower" else value > cur):
+            best[key] = value
+    regressions = []
+    for r in rows:
+        if r.get("round") != round_name:
+            continue
+        column = str(r.get("column", ""))
+        direction = _ledger_direction(column)
+        if not direction:
+            continue
+        ref = best.get((r.get("stage"), column, r.get("platform")))
+        if not ref:  # no same-platform history (or a zero best): no verdict
+            continue
+        value = float(r.get("value", 0.0))
+        worse = (
+            (value - ref) / abs(ref) if direction == "lower"
+            else (ref - value) / abs(ref)
+        )
+        if worse * 100.0 > pct:
+            regressions.append({
+                "stage": r.get("stage"),
+                "column": column,
+                "platform": r.get("platform"),
+                "value": value,
+                "best_known": ref,
+                "worse_pct": round(worse * 100.0, 1),
+            })
+    regressions.sort(key=lambda g: (-g["worse_pct"], g["stage"], g["column"]))
+    return {"ok": not regressions, "threshold_pct": pct,
+            "round": round_name, "regressions": regressions}
+
+
+def _ledger_file_for(round_dir: str) -> str:
+    """PERF_LEDGER.json lives BESIDE the round dirs (one ledger spanning
+    rounds), overridable for smokes/tests via BENCH_LEDGER_FILE."""
+    explicit = os.environ.get("BENCH_LEDGER_FILE", "")
+    if explicit:
+        return explicit
+    rd = os.path.abspath(round_dir)
+    return os.path.join(os.path.dirname(rd) or ".", "PERF_LEDGER.json")
+
+
+def _load_ledger(path: str):
+    """The prior cumulative ledger, or None on a cold start (missing or
+    unreadable file folds as empty — never raises)."""
+    try:
+        with open(path) as f:
+            ledger = json.load(f)
+    except (OSError, ValueError):
+        return None
+    return ledger if isinstance(ledger, dict) else None
 
 
 def build_timeline(store: supervise.ArtifactStore) -> dict:
@@ -2110,6 +2293,36 @@ def orchestrate_stage_graph(resume_dir: str = "") -> None:
                 pass
     merged = merge_round(store, round_dir=round_dir)
     merged["extra"]["orchestrator_probe"] = probe_log
+    # ISSUE 18: surface the daemon's LAST forensic record in the merged
+    # artifact. Read the raw file, not read_verdict — a stale verdict is
+    # no verdict for backend gating, but its forensics are still the best
+    # evidence of where the device init died.
+    forensics = _read_verdict_forensics(verdict_path)
+    if forensics:
+        merged["extra"]["probe_forensics"] = forensics
+    # ISSUE 18: fold this round (fresh run OR --resume backfill — same
+    # path) into the cumulative cross-round ledger, then tripwire it.
+    # Warn-only by contract: a flagged regression names itself in the
+    # merged artifact and stderr but never fails the round.
+    ledger_file = _ledger_file_for(round_dir)
+    round_name = os.path.basename(os.path.abspath(round_dir))
+    ledger = append_ledger(store, _load_ledger(ledger_file), round_name)
+    verdict = ledger_verdict(ledger, round_name)
+    supervise.atomic_write_json(ledger_file, ledger)
+    merged["extra"]["perf_ledger"] = {
+        "file": ledger_file,
+        "rows": len(ledger["rows"]),
+        "verdict": verdict,
+    }
+    if not verdict["ok"]:
+        _log(
+            "PERF REGRESSION (warn-only): "
+            + "; ".join(
+                f"{g['stage']}.{g['column']} {g['worse_pct']}% worse than "
+                f"best-known on {g['platform'] or '?'}"
+                for g in verdict["regressions"][:5]
+            )
+        )
     _fold_churn_report(merged)
     supervise.atomic_write_json(
         os.path.join(round_dir, "BENCH_merged.json"), merged
@@ -2216,22 +2429,119 @@ def _run_subprocess(cmd, env, timeout_s: int, capture_stderr=False) -> tuple:
     return rc, "".join(out_chunks), "".join(err_chunks), timed_out
 
 
+# ISSUE 18: the probe child marks each device-init phase on a labeled
+# heartbeat file — the same one-line contract supervise.Heartbeat reads —
+# so a wedged probe names the phase it died in instead of just "timeout".
+# Deliberately no package import inside the child: the daemon may run from
+# any cwd, and a probe that can't even reach the interpreter should still
+# leave the phases it DID reach behind (no mark at all reads as "spawn").
+_PROBE_SCRIPT = """\
+import os, sys, time
+def mark(label):
+    with open(os.environ["BENCH_PROBE_HEARTBEAT"], "w") as f:
+        f.write(label)
+mark("import")
+t0 = time.perf_counter()
+import jax
+mark("device-init")
+t1 = time.perf_counter()
+devs = jax.devices()
+t2 = time.perf_counter()
+mark("done")
+d = devs[0]
+print(d.platform, d.device_kind)
+print("PROBE_TIMINGS %.1f %.1f %d" % ((t1 - t0) * 1e3, (t2 - t1) * 1e3, len(devs)))
+"""
+
+# the env vars that steer platform resolution — recorded verbatim in the
+# forensic record (they name backends, never secrets; everything else in
+# the stderr tail goes through supervise.redact_env_text)
+_PROBE_PLATFORM_ENVS = (
+    "JAX_PLATFORMS", "JAX_PLATFORM_NAME", "PJRT_DEVICE", "TPU_SKIP_MDS_QUERY",
+)
+
+
+def _probe_forensic(timeout_s: int) -> tuple:
+    """One subprocess backend probe with a device-init forensic record
+    (ISSUE 18). Returns (ok, note, forensics): the note keeps its legacy
+    shape (first token of an ok note is the platform — _decide_backend's
+    contract); the forensic dict is bounded and env-redacted, and names
+    the init phase the probe died in via the labeled-heartbeat file."""
+    import tempfile
+
+    hb_fd, hb_path = tempfile.mkstemp(prefix="bench-probe-hb-")
+    os.close(hb_fd)
+    env = dict(os.environ)
+    env["BENCH_PROBE_HEARTBEAT"] = hb_path
+    t0 = time.monotonic()
+    try:
+        rc, out, err, timed_out = _run_subprocess(
+            [sys.executable, "-c", _PROBE_SCRIPT], env, timeout_s,
+            capture_stderr=True,
+        )
+        phase = supervise.Heartbeat(hb_path).read_label() or "spawn"
+    finally:
+        try:
+            os.unlink(hb_path)
+        except OSError:
+            pass
+    forensics = {
+        "ts": round(time.time(), 3),
+        "timeout_s": timeout_s,
+        "elapsed_s": round(time.monotonic() - t0, 2),
+        "rc": rc,
+        "timed_out": bool(timed_out),
+        "phase": phase,
+        "platform_resolution": {
+            k: os.environ[k] for k in _PROBE_PLATFORM_ENVS if k in os.environ
+        },
+        "stderr_tail": supervise.redact_env_text(
+            err[-PROBE_FORENSIC_TAIL:] if err else ""
+        ),
+    }
+    for line in out.splitlines():
+        if line.startswith("PROBE_TIMINGS "):
+            parts = line.split()
+            try:
+                forensics["import_ms"] = float(parts[1])
+                forensics["device_init_ms"] = float(parts[2])
+                forensics["device_count"] = int(parts[3])
+            except (IndexError, ValueError):
+                pass
+    if timed_out:
+        return False, f"probe timeout after {timeout_s}s (in {phase})", forensics
+    if rc == 0:
+        first = out.strip().splitlines()
+        note = first[0].strip() if first else ""
+        forensics["platform"] = note.split(" ")[0] if note else ""
+        return True, note, forensics
+    lines = [ln for ln in err.strip().splitlines() if ln.strip()]
+    return False, (lines[-1] if lines else f"probe rc={rc}"), forensics
+
+
 def _probe_once(timeout_s: int) -> tuple:
     """One subprocess backend probe. Returns (ok, note); on failure the
     note carries the backend's own last stderr line (e.g. 'Unable to
     initialize backend axon') so BENCH_r{N}.json distinguishes a tunnel
-    wedge from an import error."""
-    rc, out, err, timed_out = _run_subprocess(
-        [sys.executable, "-c",
-         "import jax; d=jax.devices(); print(d[0].platform, d[0].device_kind)"],
-        dict(os.environ), timeout_s, capture_stderr=True,
-    )
-    if timed_out:
-        return False, f"probe timeout after {timeout_s}s"
-    if rc == 0:
-        return True, out.strip()
-    lines = [ln for ln in err.strip().splitlines() if ln.strip()]
-    return False, (lines[-1] if lines else f"probe rc={rc}")
+    wedge from an import error. The forensic record is captured on every
+    attempt; callers that publish it use _probe_forensic directly."""
+    ok, note, _ = _probe_forensic(timeout_s)
+    return ok, note
+
+
+def _read_verdict_forensics(verdict_path: str):
+    """The probe_forensics dict from a verdict file, TTL-ignored (a stale
+    verdict is no verdict for gating, but its forensic record is still the
+    last word on where device init died). None when absent/unreadable."""
+    try:
+        with open(verdict_path) as f:
+            verdict = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(verdict, dict):
+        return None
+    forensics = verdict.get("probe_forensics")
+    return forensics if isinstance(forensics, dict) else None
 
 
 def _parse_json_line(text: str):

@@ -47,6 +47,9 @@ make host-smoke
 echo "== presubmit: make obs-smoke (cross-process graft + merged metrics + phase-named wedge)"
 make obs-smoke
 
+echo "== presubmit: make prof-smoke (program inventory + probe forensics + perf-ledger tripwire)"
+make prof-smoke
+
 echo "== presubmit: make segment-smoke (segmented scan: byte-identity + chaos degradation)"
 make segment-smoke
 

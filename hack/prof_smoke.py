@@ -1,0 +1,291 @@
+"""Program-inventory + perf-ledger smoke (`make prof-smoke`, ISSUE 18):
+the device-cost observability plane, proven live (~45s budget, typically
+much faster).
+
+The drill:
+
+  1. a live host-mode operator (HostSolver under ResilientSolver, debug
+     HTTP surface served, program exposition registered exactly like
+     operator/__main__.run) solves TWO geometries through the sidecar;
+     acceptance: `/debug/programs` serves >= 2 solve-family entries with
+     compile seconds under `process="solver-host"` (child provenance via
+     the PR 15-style inventory merger), plus the local ledger's entries
+     under `process="main"`, and the parent `/metrics` exposition carries
+     the `karpenter_program_*` families with the child process label;
+  2. a CHAOS-WEDGED probe attempt: the real `bench._probe_forensic`
+     subprocess path runs against a stub `jax` whose `devices()` hangs, so
+     the probe times out mid-device-init; acceptance: the forensic record
+     lands in a real TTL'd verdict file NAMING the init phase
+     (`device-init`) via the labeled-heartbeat contract, and survives the
+     verdict's TTL expiry through `_read_verdict_forensics`;
+  3. a tiny two-round bench sequence over a real ArtifactStore: round 1
+     appends ledger rows into `PERF_LEDGER.json`, round 2 carries a seeded
+     2x slowdown on the same platform; acceptance: the cumulative file is
+     byte-stable across a re-append, the backfill REPLACES the round's
+     rows, and `ledger_verdict` trips the named regression (warn-only).
+
+Hermetic (CPU forced in-process; the probe chaos uses a stub module, not
+the network). Non-fatal in `make verify`, FATAL in hack/presubmit.sh —
+the obs-smoke pattern.
+"""
+import json
+import os
+import sys
+import tempfile
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+
+def _get(port: int, path: str) -> bytes:
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=10
+    ) as resp:
+        return resp.read()
+
+
+def _drill_programs(problems) -> None:
+    """Live host-mode operator: two geometries through the sidecar, then
+    the unified inventory + exposition acceptance checks."""
+    from karpenter_core_tpu.api.settings import Settings
+    from karpenter_core_tpu.cloudprovider import fake
+    from karpenter_core_tpu.obs import proghealth
+    from karpenter_core_tpu.operator import new_operator
+    from karpenter_core_tpu.operator.__main__ import serve_health
+    from karpenter_core_tpu.solver.fallback import ResilientSolver
+    from karpenter_core_tpu.solver.host import HostSolver
+    from karpenter_core_tpu.solver.tpu_solver import GreedySolver, TPUSolver
+    from karpenter_core_tpu.testing import make_pod, make_provisioner
+
+    proghealth.ensure_exposition_registered()  # as operator/__main__.run does
+    host = HostSolver(
+        max_nodes=64, stale_after=60.0, solve_timeout=120.0,
+        spawn_timeout=120.0,
+        child_env={"KARPENTER_SOLVER_MODE": "single"},
+    )
+    resilient = ResilientSolver(
+        host, GreedySolver(), small_batch_work_max=0,
+        solve_timeout=120.0, wedge_stale_after=None,
+        reprobe_interval=2.0, probe_timeout=60.0,
+    )
+    op = new_operator(
+        fake.FakeCloudProvider(fake.instance_types(10)),
+        settings=Settings(batch_idle_duration=0.02, batch_max_duration=0.2),
+        solver=resilient,
+    )
+    health = serve_health(op, 0, profiling=True, solver=resilient)
+    port = health.server_address[1]
+    try:
+        provisioners = [make_provisioner(name="default")]
+        its = {"default": fake.instance_types(10)}
+        # two geometries: pod counts straddling an item-tier boundary
+        # (8 and 200 pad into different pod-axis buckets) mint two
+        # distinct solve programs in the CHILD
+        for n_pods in (8, 200):
+            pods = [
+                make_pod(name=f"prof-{n_pods}-{i}", requests={"cpu": "1"})
+                for i in range(n_pods)
+            ]
+            resilient.solve(pods, provisioners, its)
+        # one in-process solve: the local ledger's process="main" entries
+        TPUSolver(max_nodes=64).solve(
+            [make_pod(requests={"cpu": "1"}) for _ in range(8)],
+            provisioners, its,
+        )
+        # the child's inventory rides the RESULT frame, so it is already
+        # folded; the stats frame keeps it fresh between solves
+        snap = json.loads(_get(port, "/debug/programs"))
+        if not snap.get("enabled"):
+            problems.append("/debug/programs reports the ledger disabled")
+        child_solves = [
+            r for r in snap.get("programs", [])
+            if r.get("process") == "solver-host" and r.get("family") == "solve"
+        ]
+        if len(child_solves) < 2:
+            problems.append(
+                "/debug/programs lacks the two child solve programs "
+                f"(saw {len(child_solves)} under process=solver-host)"
+            )
+        with_compile = [
+            r for r in child_solves if (r.get("compile_seconds") or 0) > 0
+        ]
+        if not with_compile:
+            problems.append(
+                "no child solve program carries compile seconds "
+                "(live-path compile attribution lost)"
+            )
+        if not any(
+            r.get("process") == "main" for r in snap.get("programs", [])
+        ):
+            problems.append("/debug/programs lacks the local (main) entries")
+        totals = (snap.get("totals") or {}).get("solver-host") or {}
+        if not (totals.get("solve") or {}).get("exec_total"):
+            problems.append(
+                "merged child totals carry no solve executions"
+            )
+        expo = _get(port, "/metrics").decode()
+        if "karpenter_program_count" not in expo:
+            problems.append("exposition lacks karpenter_program_count")
+        if "karpenter_program_compile_seconds_total" not in expo:
+            problems.append(
+                "exposition lacks karpenter_program_compile_seconds_total"
+            )
+        if not any(
+            "karpenter_program_" in line and 'process="solver-host"' in line
+            for line in expo.splitlines()
+        ):
+            problems.append(
+                "no karpenter_program_* series under process=solver-host"
+            )
+    finally:
+        host.close()
+        health.shutdown()
+
+
+def _drill_probe_forensics(problems, tmp: str) -> None:
+    """A chaos-wedged probe: stub jax hangs in devices(), the REAL probe
+    subprocess path times out, and the forensic record must name the
+    device-init phase in a real TTL'd verdict file."""
+    import bench
+    from karpenter_core_tpu.utils import supervise
+
+    stub = os.path.join(tmp, "stub")
+    os.makedirs(stub, exist_ok=True)
+    with open(os.path.join(stub, "jax.py"), "w") as f:
+        f.write(
+            "import time\n"
+            "def devices():\n"
+            "    time.sleep(60)  # chaos: the tunnel wedge\n"
+        )
+    saved = os.environ.get("PYTHONPATH")
+    os.environ["PYTHONPATH"] = stub + (
+        os.pathsep + saved if saved else ""
+    )
+    try:
+        t0 = time.monotonic()
+        ok, note, forensics = bench._probe_forensic(3)
+        elapsed = time.monotonic() - t0
+    finally:
+        if saved is None:
+            os.environ.pop("PYTHONPATH", None)
+        else:
+            os.environ["PYTHONPATH"] = saved
+    if ok or not forensics.get("timed_out"):
+        problems.append(
+            f"chaos probe did not time out (ok={ok}, note={note!r})"
+        )
+    if forensics.get("phase") != "device-init":
+        problems.append(
+            "wedged probe forensics do not name the device-init phase "
+            f"(phase={forensics.get('phase')!r})"
+        )
+    if "(in device-init)" not in note:
+        problems.append(f"probe note does not name the phase: {note!r}")
+    if elapsed > 30:
+        problems.append(f"probe watchdog too slow ({elapsed:.0f}s for 3s cap)")
+    # the record rides a real verdict file and outlives the TTL
+    verdict_path = os.path.join(tmp, "health.json")
+    supervise.write_verdict(
+        verdict_path, ok, note, ttl_s=0.0,
+        extra={"probe_forensics": forensics},
+    )
+    time.sleep(0.02)
+    if supervise.read_verdict(verdict_path) is not None:
+        problems.append("stale verdict unexpectedly still gates")
+    got = bench._read_verdict_forensics(verdict_path)
+    if not got or got.get("phase") != "device-init":
+        problems.append(
+            "verdict file lost the forensic record across TTL expiry"
+        )
+
+
+def _drill_perf_ledger(problems, tmp: str) -> None:
+    """Two tiny rounds over a real ArtifactStore: rows land in a real
+    PERF_LEDGER.json, the re-append is byte-stable, and the seeded 2x
+    slowdown trips the named regression verdict."""
+    import bench
+    from karpenter_core_tpu.utils import supervise
+
+    store = supervise.ArtifactStore(os.path.join(tmp, "stages"))
+    headline = {
+        "pods": bench.N_PODS, "types": bench.N_TYPES,
+        "distinct": bench.N_DISTINCT, "existing": bench.N_EXISTING,
+        "pods_per_sec": 480.0, "e2e_p50_ms": 260.0, "e2e_p99_ms": 420.0,
+        "device_p99_ms_varied": 5.6, "runs": 2,
+        "programs_digest": "feedc0ffee42",
+    }
+    for name in bench.STAGE_NAMES:
+        cfg = bench.stage_config(name)
+        data = dict(headline) if name == "headline" else {"v": 1}
+        store.save(name, cfg, data,
+                   meta={"backend": "cpu", "platform": "cpu"})
+    ledger_file = os.path.join(tmp, "PERF_LEDGER.json")
+    ledger = bench.append_ledger(store, bench._load_ledger(ledger_file), "r01")
+    supervise.atomic_write_json(ledger_file, ledger)
+    if not ledger["rows"]:
+        problems.append("round 1 appended no ledger rows")
+    if not any(
+        r["programs_digest"] == "feedc0ffee42" for r in ledger["rows"]
+    ):
+        problems.append("ledger rows lost the program-inventory digest")
+    # byte-stable re-append of the unchanged round
+    again = bench.append_ledger(store, bench._load_ledger(ledger_file), "r01")
+    if json.dumps(again, sort_keys=True) != json.dumps(ledger, sort_keys=True):
+        problems.append("re-appending the same round churned the ledger")
+    # round 2: the seeded 2x slowdown on the same platform
+    slow = dict(headline, e2e_p99_ms=headline["e2e_p99_ms"] * 2.0,
+                pods_per_sec=headline["pods_per_sec"] / 2.0)
+    store.save("headline", bench.stage_config("headline"), slow,
+               meta={"backend": "cpu", "platform": "cpu"})
+    ledger = bench.append_ledger(store, bench._load_ledger(ledger_file), "r02")
+    supervise.atomic_write_json(ledger_file, ledger)
+    verdict = bench.ledger_verdict(ledger, "r02")
+    if verdict["ok"]:
+        problems.append("seeded 2x slowdown did not trip the tripwire")
+    named = {(g["stage"], g["column"]) for g in verdict["regressions"]}
+    if ("headline", "e2e_p99_ms") not in named:
+        problems.append(
+            f"regression verdict does not name e2e_p99_ms (got {named})"
+        )
+    if not any(
+        abs(g["worse_pct"] - 100.0) < 1.0 for g in verdict["regressions"]
+    ):
+        problems.append("tripwire mis-measured the seeded 2x slowdown")
+    rounds = {r["round"] for r in ledger["rows"]}
+    if rounds != {"r01", "r02"}:
+        problems.append(f"ledger rounds drifted: {sorted(rounds)}")
+
+
+def main() -> int:
+    problems = []
+    _drill_programs(problems)
+    with tempfile.TemporaryDirectory(prefix="prof-smoke-") as tmp:
+        _drill_probe_forensics(problems, tmp)
+        _drill_perf_ledger(problems, tmp)
+
+    if problems:
+        for p in problems:
+            print(f"prof-smoke FAIL: {p}", file=sys.stderr)
+        return 1
+    print(
+        "prof-smoke ok: /debug/programs serves two child solve programs "
+        "with compile seconds under process=solver-host plus local "
+        "entries, karpenter_program_* families exposed, a chaos-wedged "
+        "probe named device-init in the verdict's forensic record, and "
+        "the two-round PERF_LEDGER.json tripwired the seeded 2x slowdown"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    rc = main()
+    sys.stdout.flush()
+    sys.stderr.flush()
+    # skip interpreter teardown: XLA's thread pool races destructors at
+    # exit (same dodge as hack/obs_smoke.py)
+    os._exit(rc)

@@ -1,0 +1,519 @@
+"""Compiled-program cost inventory — where the device milliseconds live.
+
+The solver's four compiled-program LRUs (solve/refresh/replan/segment,
+solver/tpu_solver.py) were opaque: program COUNT is tripwired
+(test_perf_floor.py) but nothing observed program COST — per-key compile
+seconds, execution counts, device milliseconds, HLO flop/byte estimates,
+peak-HBM footprint. That is exactly the evidence ROADMAP item 5 needs to
+decide which rungs to fold, prewarm, or delete, and what a real-TPU round
+(ROADMAP item 1) must ship home to make the north-star claim measured
+instead of asserted.
+
+Three pieces, all stdlib-only (no jax import — analysis operates on the
+compiled executables the solver hands in, by duck typing, so this module
+keeps working when the accelerator stack is absent or wedged):
+
+  * ``ProgramLedger`` — per-process inventory every mint/dispatch/eviction
+    reports into. Bounded (MAX_RECORDS), lock-protected, and free on the
+    disabled path: each record_* funnel is gated on one flag check before
+    any allocation (tripwired in test_perf_floor.py).
+  * ``normalize_cost_analysis`` / ``analyze_compiled`` — the portability
+    shim over ``compiled.cost_analysis()`` (jax versions differ on
+    list-of-dicts vs dict returns) and ``compiled.memory_analysis()``;
+    the API shape is probed ONCE per ledger and recorded, and every
+    fallback (CPU backend, older jax, missing executable) returns
+    ``"unavailable"`` — never raises.
+  * ``ProgramInventoryMerger`` — the PR 15 generation contract applied to
+    program snapshots riding the solver-host stats frame: ``ingest``
+    replaces the live view for a generation, a generation bump or
+    ``retire`` folds that generation's cumulative totals into the base
+    exactly once (respawn-idempotent), and every surviving entry carries
+    the ``process`` label.
+
+The operator's gated ``/debug/programs`` serves ``full_snapshot()`` (the
+local ledger plus every registered source, e.g. the solver host's child
+merger), and ``EXPOSITION`` renders the summary metric families
+(``karpenter_program_count`` / ``_compile_seconds_total`` /
+``_hbm_peak_bytes``) as a Registry external source.
+"""
+from __future__ import annotations
+
+import hashlib
+import threading
+from typing import Callable, Dict, Optional
+
+from karpenter_core_tpu.obs import envflags
+
+# live records per ledger; eviction-retired records fold into totals, so
+# the bound is on live-program cardinality (itself LRU-bounded upstream)
+MAX_RECORDS = 256
+# entries served per /debug/programs snapshot (deterministic order)
+MAX_SNAPSHOT_PROGRAMS = 128
+# EMA smoothing for per-record device milliseconds
+EMA_ALPHA = 0.2
+
+FAMILIES = ("solve", "refresh", "replan", "segment")
+
+_TOTAL_FIELDS = ("minted", "retired", "exec_total", "compile_seconds_total")
+
+
+def _key_digest(key) -> str:
+    """Stable short digest of a compiled-program cache key (keys carry
+    treedefs and layout objects whose reprs are stable within a process —
+    good enough for a debugging identity, never for equality)."""
+    return hashlib.blake2s(repr(key).encode(), digest_size=6).hexdigest()
+
+
+def normalize_cost_analysis(raw) -> Optional[Dict[str, float]]:
+    """Normalize a ``compiled.cost_analysis()`` return to one schema.
+
+    jax has shipped BOTH a list-of-dicts (one per device/computation) and
+    a bare dict from this API across versions; downstream must never care.
+    Returns ``{"flops": float, "bytes_accessed": float}`` (keys present
+    only when the backend reported them) or None when the shape is
+    unrecognized or empty.
+    """
+    if isinstance(raw, (list, tuple)):
+        raw = raw[0] if raw else None
+    if not isinstance(raw, dict):
+        return None
+    out: Dict[str, float] = {}
+    flops = raw.get("flops")
+    if isinstance(flops, (int, float)):
+        out["flops"] = float(flops)
+    acc = raw.get("bytes accessed", raw.get("bytes_accessed"))
+    if isinstance(acc, (int, float)):
+        out["bytes_accessed"] = float(acc)
+    return out or None
+
+
+def _memory_peak_bytes(mem) -> Optional[int]:
+    """Peak-HBM estimate from a ``memory_analysis()`` return: the explicit
+    peak when the backend reports one, else the sum of the sized sections
+    (arguments + outputs + temps + generated code)."""
+    if mem is None:
+        return None
+    peak = getattr(mem, "peak_memory_in_bytes", None)
+    if isinstance(peak, (int, float)) and peak > 0:
+        return int(peak)
+    total = 0
+    seen = False
+    for attr in ("argument_size_in_bytes", "output_size_in_bytes",
+                 "temp_size_in_bytes", "generated_code_size_in_bytes"):
+        v = getattr(mem, attr, None)
+        if isinstance(v, (int, float)):
+            total += int(v)
+            seen = True
+    return total if seen else None
+
+
+class ProgramLedger:
+    """Per-process compiled-program inventory (mint / dispatch / retire).
+
+    Per-key records carry geometry tier, scan/screen mode, AOT-vs-live
+    origin, compile seconds, exec count, last/EMA device ms, and — where
+    the backend supports it — normalized cost/memory analysis. Family
+    totals (minted/retired/exec/compile-seconds) are cumulative and
+    monotone: eviction retires the record but never the seconds it cost.
+    """
+
+    def __init__(self, enabled: Optional[bool] = None):
+        self.enabled = (
+            envflags.get_bool("KARPENTER_PROGHEALTH", True)
+            if enabled is None else bool(enabled)
+        )
+        self._mu = threading.Lock()
+        self._records: Dict[tuple, dict] = {}
+        self._totals: Dict[str, Dict[str, float]] = {}
+        # cost_analysis API shape, probed once on the first successful
+        # call this ledger sees: "list" | "dict" | "unavailable" | None
+        self._cost_shape: Optional[str] = None
+
+    # -- analysis ----------------------------------------------------------
+
+    def analyze_compiled(self, compiled) -> Dict[str, object]:
+        """Bounded, never-raising cost/memory probe of one executable.
+        The first successful cost_analysis records the API shape this jax
+        ships (the list-vs-dict portability hazard, probed once)."""
+        out: Dict[str, object] = {"cost": "unavailable",
+                                  "memory": "unavailable"}
+        if compiled is None:
+            return out
+        try:
+            raw = compiled.cost_analysis()
+            with self._mu:
+                if self._cost_shape is None:
+                    self._cost_shape = (
+                        "list" if isinstance(raw, (list, tuple)) else
+                        "dict" if isinstance(raw, dict) else "unavailable"
+                    )
+            cost = normalize_cost_analysis(raw)
+            if cost is not None:
+                out["cost"] = cost
+        except Exception:  # noqa: BLE001 — observability never raises
+            with self._mu:
+                if self._cost_shape is None:
+                    self._cost_shape = "unavailable"
+        try:
+            peak = _memory_peak_bytes(compiled.memory_analysis())
+            if peak is not None:
+                out["memory"] = {"hbm_peak_bytes": int(peak)}
+        except Exception:  # noqa: BLE001
+            pass
+        return out
+
+    # -- totals ------------------------------------------------------------
+
+    def _bump_locked(self, family: str, field: str, delta: float) -> None:
+        fam = self._totals.setdefault(
+            family, {f: 0 for f in _TOTAL_FIELDS}
+        )
+        fam[field] = fam.get(field, 0) + delta
+
+    # -- record funnels ----------------------------------------------------
+
+    def record_mint(self, family: str, key, origin: str = "live",
+                    compile_s: float = 0.0, compiled=None,
+                    meta: Optional[dict] = None) -> None:
+        """A program was built at `key` (the compile event). `compiled` is
+        the AOT executable when one exists (live-path jit objects have no
+        inspectable executable until a later AOT attach)."""
+        if not self.enabled:
+            return
+        rec = {
+            "family": family,
+            "key": _key_digest(key),
+            "origin": origin,
+            "compile_seconds": round(float(compile_s), 6),
+            "exec_count": 0,
+            "last_device_ms": None,
+            "ema_device_ms": None,
+        }
+        if meta:
+            rec.update(meta)
+        rec.update(self.analyze_compiled(compiled))
+        with self._mu:
+            fresh = (family, rec["key"]) not in self._records
+            self._records[(family, rec["key"])] = rec
+            if fresh:
+                self._bump_locked(family, "minted", 1)
+            if compile_s:
+                self._bump_locked(
+                    family, "compile_seconds_total", float(compile_s)
+                )
+            while len(self._records) > MAX_RECORDS:
+                old = next(iter(self._records))
+                del self._records[old]
+                self._bump_locked(old[0], "retired", 1)
+
+    def record_compile(self, family: str, key, seconds: float,
+                       compiled=None) -> None:
+        """Attribute compile seconds discovered AFTER the mint — the live
+        path pays jit trace + XLA compile at first dispatch, not at
+        record_mint time."""
+        if not self.enabled:
+            return
+        digest = _key_digest(key)
+        with self._mu:
+            rec = self._records.get((family, digest))
+            if rec is not None:
+                rec["compile_seconds"] = round(
+                    rec.get("compile_seconds", 0.0) + float(seconds), 6
+                )
+            self._bump_locked(
+                family, "compile_seconds_total", float(seconds)
+            )
+        if compiled is not None and rec is not None:
+            analysis = self.analyze_compiled(compiled)
+            with self._mu:
+                rec.update(analysis)
+
+    def record_dispatch(self, family: str, key, device_ms=None) -> None:
+        """One execution of the program at `key`. Hot path: the disabled
+        gate above is the whole cost when the ledger is off."""
+        if not self.enabled:
+            return
+        digest = _key_digest(key)
+        with self._mu:
+            rec = self._records.get((family, digest))
+            if rec is None:
+                # dispatch observed for a program minted before this
+                # ledger existed (or already evicted): count it under a
+                # synthetic record so exec totals stay truthful
+                rec = {
+                    "family": family, "key": digest, "origin": "unknown",
+                    "compile_seconds": 0.0, "exec_count": 0,
+                    "last_device_ms": None, "ema_device_ms": None,
+                    "cost": "unavailable", "memory": "unavailable",
+                }
+                self._records[(family, digest)] = rec
+                self._bump_locked(family, "minted", 1)
+            rec["exec_count"] += 1
+            self._bump_locked(family, "exec_total", 1)
+            if device_ms is not None:
+                ms = float(device_ms)
+                rec["last_device_ms"] = round(ms, 3)
+                prev = rec["ema_device_ms"]
+                rec["ema_device_ms"] = round(
+                    ms if prev is None
+                    else EMA_ALPHA * ms + (1.0 - EMA_ALPHA) * prev, 3
+                )
+
+    def retire(self, family: str, key) -> None:
+        """The LRU evicted `key`: drop the live record, keep its cumulative
+        contribution in the family totals (exactly-once per record)."""
+        if not self.enabled:
+            return
+        digest = _key_digest(key)
+        with self._mu:
+            if self._records.pop((family, digest), None) is not None:
+                self._bump_locked(family, "retired", 1)
+
+    def clear(self) -> None:
+        with self._mu:
+            self._records = {}
+            self._totals = {}
+            self._cost_shape = None
+
+    # -- views -------------------------------------------------------------
+
+    def snapshot(self) -> dict:
+        """JSON-able inventory: bounded program list (deterministic family,
+        key order) + cumulative family totals. Rides the solver-host stats
+        frame, so it must stay small and sort-stable."""
+        with self._mu:
+            records = [dict(r) for r in self._records.values()]
+            totals = {f: dict(t) for f, t in self._totals.items()}
+            shape = self._cost_shape
+        records.sort(key=lambda r: (r["family"], r["key"]))
+        dropped = max(0, len(records) - MAX_SNAPSHOT_PROGRAMS)
+        out = {
+            "programs": records[:MAX_SNAPSHOT_PROGRAMS],
+            "totals": totals,
+            "cost_shape": shape,
+        }
+        if dropped:
+            out["dropped"] = dropped
+        return out
+
+
+class ProgramInventoryMerger:
+    """Merged view over one child process's program-inventory snapshots —
+    the ProcessSeriesMerger contract (metrics/registry.py) applied to the
+    program plane: ingest REPLACES a generation's live view, a generation
+    bump or retire folds that generation's cumulative totals into the
+    committed base exactly once, and a dead child's live program entries
+    drop (its records died with the process; its compile seconds did not).
+    """
+
+    def __init__(self, process: str = "solver-host"):
+        self.process = process
+        self._mu = threading.Lock()
+        self._live: dict = {}
+        self._live_gen: Optional[int] = None
+        self._base_totals: Dict[str, Dict[str, float]] = {}
+
+    def _fold_live_locked(self) -> None:
+        for fam, tot in (self._live.get("totals") or {}).items():
+            base = self._base_totals.setdefault(fam, {})
+            for field, value in tot.items():
+                if isinstance(value, (int, float)):
+                    base[field] = base.get(field, 0) + value
+        self._live = {}
+        self._live_gen = None
+
+    def ingest(self, generation: int, snap: dict) -> None:
+        if not isinstance(snap, dict):
+            return
+        with self._mu:
+            if self._live_gen is not None and generation != self._live_gen:
+                self._fold_live_locked()
+            self._live_gen = generation
+            self._live = snap
+
+    def retire(self, generation: int) -> None:
+        with self._mu:
+            if self._live_gen == generation:
+                self._fold_live_locked()
+
+    def clear(self) -> None:
+        with self._mu:
+            self._live = {}
+            self._live_gen = None
+            self._base_totals = {}
+
+    def snapshot(self) -> dict:
+        with self._mu:
+            gen = self._live_gen
+            programs = [
+                dict(r, process=self.process, generation=gen)
+                for r in (self._live.get("programs") or ())
+            ]
+            totals: Dict[str, Dict[str, float]] = {
+                f: dict(t) for f, t in self._base_totals.items()
+            }
+            for fam, tot in (self._live.get("totals") or {}).items():
+                base = totals.setdefault(fam, {})
+                for field, value in tot.items():
+                    if isinstance(value, (int, float)):
+                        base[field] = base.get(field, 0) + value
+            out = {"programs": programs, "totals": totals,
+                   "process": self.process}
+            shape = self._live.get("cost_shape")
+            if shape is not None:
+                out["cost_shape"] = shape
+            return out
+
+
+# -- module singletons -------------------------------------------------------
+
+LEDGER = ProgramLedger()
+
+# extra inventory sources for the unified /debug/programs view, keyed by
+# process name (e.g. "solver-host" -> the HostSolver merger's snapshot);
+# latest registration per name wins, sources must never raise
+_SOURCES: Dict[str, Callable[[], dict]] = {}
+_sources_mu = threading.Lock()
+
+
+def reset(enabled: Optional[bool] = None) -> ProgramLedger:
+    """Replace the process ledger (tests + entrypoints re-arming after an
+    env change). Returns the new ledger."""
+    global LEDGER
+    LEDGER = ProgramLedger(enabled=enabled)
+    return LEDGER
+
+
+def add_source(name: str, fn: Callable[[], dict]) -> None:
+    with _sources_mu:
+        _SOURCES[name] = fn
+
+
+def remove_source(name: str, fn: Optional[Callable] = None) -> None:
+    with _sources_mu:
+        if fn is None or _SOURCES.get(name) is fn:
+            _SOURCES.pop(name, None)
+
+
+# thin module-level funnels: call sites stay one import away from the
+# live singleton (reset() swaps it atomically), and the disabled path is
+# one attribute load + one flag check before any work
+def record_mint(family, key, origin="live", compile_s=0.0, compiled=None,
+                meta=None):
+    led = LEDGER
+    if led.enabled:
+        led.record_mint(family, key, origin=origin, compile_s=compile_s,
+                        compiled=compiled, meta=meta)
+
+
+def record_compile(family, key, seconds, compiled=None):
+    led = LEDGER
+    if led.enabled:
+        led.record_compile(family, key, seconds, compiled=compiled)
+
+
+def record_dispatch(family, key, device_ms=None):
+    led = LEDGER
+    if led.enabled:
+        led.record_dispatch(family, key, device_ms)
+
+
+def retire(family, key):
+    led = LEDGER
+    if led.enabled:
+        led.retire(family, key)
+
+
+def full_snapshot() -> dict:
+    """The unified inventory: the local ledger's programs (process="main")
+    plus every registered source's (already process-labeled). Served at
+    /debug/programs and summarized by EXPOSITION."""
+    local = LEDGER.snapshot()
+    programs = [dict(r, process="main") for r in local["programs"]]
+    totals: Dict[str, dict] = {"main": local["totals"]}
+    with _sources_mu:
+        sources = dict(_SOURCES)
+    for name, fn in sorted(sources.items()):
+        try:
+            snap = fn()
+        except Exception:  # noqa: BLE001 — a sick source must not kill the view
+            continue
+        if not isinstance(snap, dict):
+            continue
+        programs.extend(snap.get("programs") or ())
+        totals[name] = snap.get("totals") or {}
+    out = {
+        "enabled": LEDGER.enabled,
+        "programs": programs,
+        "totals": totals,
+    }
+    if local.get("cost_shape") is not None:
+        out["cost_shape"] = local["cost_shape"]
+    return out
+
+
+class ProgramExposition:
+    """Registry external source summarizing the unified inventory into the
+    karpenter_program_* families: live program count and max peak-HBM as
+    gauges, cumulative compile seconds as a counter — per (process,
+    family) series, so a compile-collapse regression or a child paying
+    repeated restart compiles is one /metrics scrape away."""
+
+    def families(self) -> Dict[str, dict]:
+        snap = full_snapshot()
+        count: Dict[tuple, int] = {}
+        hbm: Dict[tuple, int] = {}
+        for rec in snap["programs"]:
+            lk = (rec.get("process", "main"), rec.get("family", "?"))
+            count[lk] = count.get(lk, 0) + 1
+            mem = rec.get("memory")
+            if isinstance(mem, dict):
+                peak = mem.get("hbm_peak_bytes")
+                if isinstance(peak, (int, float)):
+                    hbm[lk] = max(hbm.get(lk, 0), int(peak))
+        compile_s: Dict[tuple, float] = {}
+        for process, fams in snap["totals"].items():
+            for fam, tot in (fams or {}).items():
+                sec = tot.get("compile_seconds_total")
+                if isinstance(sec, (int, float)) and sec:
+                    compile_s[(process, fam)] = float(sec)
+
+        def _series(data):
+            return [
+                [{"process": p, "family": f}, v]
+                for (p, f), v in sorted(data.items())
+            ]
+
+        out: Dict[str, dict] = {}
+        if count:
+            out["karpenter_program_count"] = {
+                "kind": "gauge",
+                "help": "Live compiled programs by process and family.",
+                "series": _series(count),
+            }
+        if compile_s:
+            out["karpenter_program_compile_seconds_total"] = {
+                "kind": "counter",
+                "help": "Cumulative XLA compile seconds by process and "
+                        "family (eviction never subtracts).",
+                "series": _series(compile_s),
+            }
+        if hbm:
+            out["karpenter_program_hbm_peak_bytes"] = {
+                "kind": "gauge",
+                "help": "Max peak-HBM estimate among live programs by "
+                        "process and family (memory_analysis).",
+                "series": _series(hbm),
+            }
+        return out
+
+
+EXPOSITION = ProgramExposition()
+
+
+def ensure_exposition_registered() -> None:
+    """Idempotently attach EXPOSITION to the process metrics registry
+    (add_external dedupes by identity)."""
+    from karpenter_core_tpu.metrics.registry import REGISTRY
+
+    REGISTRY.add_external(EXPOSITION)

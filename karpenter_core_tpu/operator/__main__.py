@@ -193,6 +193,7 @@ DEBUG_ENDPOINTS = (
     ("/debug/events", True, "events recorder ring"),
     ("/debug/threads", True, "all thread stacks (goroutine-dump analog)"),
     ("/debug/backend", True, "device + compile-cache facts"),
+    ("/debug/programs", True, "compiled-program cost inventory, all processes"),
     ("/debug/config", True, "context-injected options + settings"),
 )
 
@@ -509,6 +510,17 @@ class _HealthHandler(BaseHTTPRequestHandler):
         elif self.path == "/debug/backend" and self.profiling_enabled:
             body = _debug_backend().encode()
             ctype = "application/json"
+        elif self.path == "/debug/programs" and self.profiling_enabled:
+            # the unified compiled-program cost inventory (ISSUE 18): the
+            # local ledger plus every registered source — in host mode the
+            # sidecar child's programs arrive via the stats/response-frame
+            # snapshots and surface here under process="solver-host"
+            from karpenter_core_tpu.obs import proghealth
+
+            body = json.dumps(
+                proghealth.full_snapshot(), sort_keys=True
+            ).encode()
+            ctype = "application/json"
         elif self.path == "/debug/config" and self.profiling_enabled:
             # context-injected config (operator/injection.py)
             from dataclasses import asdict, is_dataclass
@@ -668,6 +680,12 @@ def run(cloud_provider, kube_client=None, stop_event=None, options=None):
     gate = getattr(primary, "admission", None)
     slo_engine = build_slo_engine(admission=gate)
     REGISTRY.add_external(slo_engine)
+    # compiled-program cost families (ISSUE 18): every scrape summarizes
+    # the unified inventory (local ledger + solver-host merger) into
+    # karpenter_program_{count,compile_seconds_total,hbm_peak_bytes}
+    from karpenter_core_tpu.obs import proghealth
+
+    proghealth.ensure_exposition_registered()
     # KARPENTER_SLO_BROWNOUT arms the closed SLO->admission loop:
     #   * the depth-band preference: inside the brownout band the gate
     #     sheds ONLY tenants whose error budget is exhausted (fast-burning

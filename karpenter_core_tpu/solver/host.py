@@ -72,6 +72,7 @@ from karpenter_core_tpu.metrics.registry import (
 )
 from karpenter_core_tpu.obs import TRACER
 from karpenter_core_tpu.obs import envflags
+from karpenter_core_tpu.obs import proghealth
 from karpenter_core_tpu.obs import reqctx
 from karpenter_core_tpu.obs.tracer import export_spans
 from karpenter_core_tpu.obs.log import get_logger
@@ -963,6 +964,13 @@ class SolverHost:
         # process="solver-host"
         self.metrics = ProcessSeriesMerger("solver-host")
         self._metrics_registered = False
+        # merged child compiled-program inventory (ISSUE 18): snapshots
+        # ride the same response/stats frames as the metrics, fold per
+        # generation under the identical respawn-idempotency contract, and
+        # surface in the unified /debug/programs view under
+        # process="solver-host"
+        self.programs = proghealth.ProgramInventoryMerger("solver-host")
+        self._programs_registered = False
         # serializes frame exchanges (one in-flight dispatch)
         self._mu = threading.Lock()
         # leaf lock for the lifecycle METADATA (generation/_proc/_ready/
@@ -1057,6 +1065,10 @@ class SolverHost:
         # commit the dead child's last metrics snapshot exactly once: the
         # respawned generation counts from zero ON TOP of it
         self.metrics.retire(gen)
+        # same contract for the program inventory: the dead generation's
+        # cumulative compile seconds fold into the base exactly once; its
+        # live program entries died with the process
+        self.programs.retire(gen)
         if salvage:
             # mid-dispatch kill: the response frame (and its span delta)
             # never arrived — graft what the child spilled beside its
@@ -1346,6 +1358,18 @@ class SolverHost:
                     REGISTRY.add_external(self.metrics)
                     self._metrics_registered = True
                 self.metrics.ingest(gen, families)
+            except Exception:  # noqa: BLE001
+                pass
+        programs = rheader.get("programs")
+        if programs:
+            try:
+                if not self._programs_registered:
+                    proghealth.add_source(
+                        "solver-host", self.programs.snapshot
+                    )
+                    proghealth.ensure_exposition_registered()
+                    self._programs_registered = True
+                self.programs.ingest(gen, programs)
             except Exception:  # noqa: BLE001
                 pass
 
@@ -1821,6 +1845,13 @@ def host_main(argv=None) -> int:
                 # cumulative counter/histogram snapshot: the parent's
                 # per-generation merger folds it into the ONE exposition
                 rheader["metrics"] = snapshot_families(REGISTRY)
+                # compiled-program inventory rides beside it (ISSUE 18) —
+                # absent-key when the ledger is disabled or empty, so the
+                # off posture adds zero frame bytes (same contract as the
+                # trace/tenant keys)
+                progs = proghealth.LEDGER.snapshot()
+                if progs["programs"] or progs["totals"]:
+                    rheader["programs"] = progs
                 _write_frame(out, rheader, response.SerializeToString())
                 # the spill must only ever hold spans of an UNANSWERED
                 # dispatch: clear it once the response (which carried any
@@ -1873,14 +1904,19 @@ def host_main(argv=None) -> int:
                         CACHE_MISSES, "site"
                     ),
                 }
+                sheader: Dict[str, object] = {
+                    "op": "result", "id": rid, "ok": True,
+                    # the stats frame carries the same snapshot the
+                    # solve/replan responses do (the canonical metrics
+                    # ride, ISSUE 15) — a parent polling stats between
+                    # dispatches keeps the exposition fresh
+                    "metrics": snapshot_families(REGISTRY),
+                }
+                progs = proghealth.LEDGER.snapshot()
+                if progs["programs"] or progs["totals"]:
+                    sheader["programs"] = progs
                 _write_frame(
-                    out,
-                    {"op": "result", "id": rid, "ok": True,
-                     # the stats frame carries the same snapshot the
-                     # solve/replan responses do (the canonical metrics
-                     # ride, ISSUE 15) — a parent polling stats between
-                     # dispatches keeps the exposition fresh
-                     "metrics": snapshot_families(REGISTRY)},
+                    out, sheader,
                     json.dumps(info, sort_keys=True).encode(),
                 )
             else:

@@ -27,7 +27,7 @@ import numpy as np
 from karpenter_core_tpu import chaos
 from karpenter_core_tpu.metrics.registry import NAMESPACE, REGISTRY
 from karpenter_core_tpu.obs import envflags
-from karpenter_core_tpu.obs import reqctx
+from karpenter_core_tpu.obs import proghealth, reqctx
 from karpenter_core_tpu.obs import TRACE_HEADER, TRACER
 from karpenter_core_tpu.obs.log import get_logger
 
@@ -289,6 +289,10 @@ class SolverService:
         # the caller gates.
         self.admission = admission
         self._compiled = OrderedDict()
+        # solve keys minted but not yet compile-attributed: the live path
+        # pays jit trace + XLA compile at FIRST dispatch, so the first
+        # device block's seconds book against the program (ISSUE 18)
+        self._prog_fresh = set()
         self._mu = threading.Lock()
         self.solves = 0
         # incremental prescreen residency (solver/incremental.py): the
@@ -522,11 +526,27 @@ class SolverService:
                     )
                 )
             entry = (run, pre)
+            retired = []
             with self._mu:
                 self._compiled[key] = entry
+                self._prog_fresh.add(key)
                 while len(self._compiled) > self.MAX_COMPILED:
                     old_key, _ = self._compiled.popitem(last=False)
-                    self._drop_incremental(old_key)
+                    retired.append(("solve", old_key))
+                    retired.extend(self._drop_incremental(old_key))
+                    self._prog_fresh.discard(old_key)
+            # ledger reporting AFTER the cache lock drops, same discipline
+            # as the in-process solver's mint sites
+            proghealth.record_mint(
+                "solve", key, origin="live",
+                meta={
+                    "tier": f"{geometry.get('n_slots', '?')}slots",
+                    "mode": str(screen_mode),
+                    "surface": family,
+                },
+            )
+            for prog_family, prog_key in retired:
+                proghealth.retire(prog_family, prog_key)
         return key, entry
 
     def _solve_traced(self, request: pb.SolveRequest) -> pb.SolveResponse:
@@ -556,8 +576,10 @@ class SolverService:
             nonlocal t_phase
             now = time.perf_counter_ns()
             TRACER.add_span(f"solver.phase.{name}", t_phase, now, **attrs)
+            elapsed_ms = (now - t_phase) / 1e6
             t_phase = now
             supervise.touch_heartbeat(f"solver.phase.{name}")
+            return elapsed_ms
 
         geometry = json.loads(request.geometry)
         tensors = {t.name: tensor_from_pb(t) for t in request.tensors}
@@ -612,7 +634,16 @@ class SolverService:
         # heartbeat the parent's staleness watchdog reads): the longest
         # legit silent stretch is ONE XLA compile/execute block, which is
         # what wedge_stale_after must be sized above
-        _mark("device")
+        device_ms = _mark("device")
+        # program-ledger accounting (ISSUE 18): every dispatch books its
+        # device ms; a first-sight entry also books the block as compile
+        # seconds (jit traces + XLA compiles inside that first dispatch)
+        with self._mu:
+            first_dispatch = key in self._prog_fresh
+            self._prog_fresh.discard(key)
+        proghealth.record_dispatch("solve", key, device_ms=device_ms)
+        if first_dispatch:
+            proghealth.record_compile("solve", key, device_ms / 1e3)
         out = [tensor_to_pb("ptr", np.asarray(ptr))]
         for name, value in log.items():
             out.append(tensor_to_pb(f"log/{name}", np.asarray(value)))
@@ -704,6 +735,7 @@ class SolverService:
         ):
             replan_fn, hit = self._replan_fn(key, geometry, kp, screen_mode)
             record_lookup("service_replan", hit)
+            t_chunk = time.perf_counter()
             pods_dev, verd_dev = replan_fn(
                 sub_counts, sub_open, uninit, screen0, *args
             )
@@ -712,6 +744,14 @@ class SolverService:
                 pods_parts.append(np.asarray(pods_h)[:k])
             else:
                 verd_h = jax.device_get(verd_dev)
+            chunk_ms = (time.perf_counter() - t_chunk) * 1e3
+            proghealth.record_dispatch(
+                "replan", (key, kp), device_ms=chunk_ms
+            )
+            if not hit:
+                # first dispatch of a fresh rung program: the chunk paid
+                # the jit trace + XLA compile
+                proghealth.record_compile("replan", (key, kp), chunk_ms / 1e3)
             verdict_parts.append(np.asarray(verd_h)[:k])
             # per-chunk progress for the dispatch watchdogs: a K-chunked
             # sweep is many device calls — each completed chunk is proof
@@ -780,11 +820,19 @@ class SolverService:
                 )
 
         fn = _LazyReplan()
+        evicted = []
         with self._mu:
             fn = self._replan_compiled.setdefault(rkey, fn)
             self._replan_compiled.move_to_end(rkey)
             while len(self._replan_compiled) > self.MAX_REPLAN:
-                self._replan_compiled.popitem(last=False)
+                evicted.append(self._replan_compiled.popitem(last=False)[0])
+        proghealth.record_mint(
+            "replan", rkey, origin="live",
+            meta={"tier": f"K{k_pad}", "mode": str(screen_mode),
+                  "surface": "service"},
+        )
+        for old in evicted:
+            proghealth.retire("replan", old)
         return fn, False
 
     # -- incremental prescreen (delta re-solve across RPCs) -----------------
@@ -836,8 +884,13 @@ class SolverService:
                         key, geometry, delta.rb, delta.cb, layout=layout
                     )
                     row_idx, row_n, col_idx, col_n = delta.padded()
+                    t_ref = time.perf_counter()
                     screen0 = refresh(
                         prev, pod_arrays, exist, row_idx, row_n, col_idx, col_n
+                    )
+                    proghealth.record_dispatch(
+                        "refresh", (key, delta.rb, delta.cb),
+                        device_ms=(time.perf_counter() - t_ref) * 1e3,
                     )
                     inc.count_refresh()
                 except Exception:  # noqa: BLE001 — degrade, never fail the RPC
@@ -879,25 +932,38 @@ class SolverService:
             ),
             donate_argnums=(0,),
         )
+        evicted = []
         with self._inc_mu:
             fn = self._refresh_compiled.setdefault(rkey, fn)
             self._refresh_compiled.move_to_end(rkey)
             while len(self._refresh_compiled) > self.MAX_REFRESH:
-                self._refresh_compiled.popitem(last=False)
+                evicted.append(self._refresh_compiled.popitem(last=False)[0])
+        proghealth.record_mint(
+            "refresh", rkey, origin="live",
+            meta={"tier": f"rb{rb}xcb{cb}", "surface": "service"},
+        )
+        for old in evicted:
+            proghealth.retire("refresh", old)
         return fn
 
-    def _drop_incremental(self, key) -> None:
+    def _drop_incremental(self, key):
         """Solve-cache eviction also drops the key's resident tensor and
-        refresh programs (they reference the evicted geometry)."""
+        refresh programs (they reference the evicted geometry). Returns
+        the dropped (family, key) pairs so the caller can retire them in
+        the program ledger once the cache locks drop."""
+        dropped = []
         with self._inc_mu:
             self._inc_screens.pop(key, None)
             for rkey in [k for k in self._refresh_compiled if k[0] == key]:
                 del self._refresh_compiled[rkey]
+                dropped.append(("refresh", rkey))
         # replan programs share the evicted solve entry's geometry too
         # (caller holds self._mu on the eviction path: _replan_compiled is
         # guarded by the same lock, so mutate without re-taking it)
         for rkey in [k for k in self._replan_compiled if k[0] == key]:
             del self._replan_compiled[rkey]
+            dropped.append(("replan", rkey))
+        return dropped
 
     def _layout_for(self, args):
         """The parallel/specs.SpecLayout this request's programs build

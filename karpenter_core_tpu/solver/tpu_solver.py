@@ -30,6 +30,7 @@ from karpenter_core_tpu.scheduling.machinetemplate import MachineTemplate
 from karpenter_core_tpu.scheduling.preferences import Preferences
 from karpenter_core_tpu.kube.objects import Pod, ResourceList
 from karpenter_core_tpu.obs import TRACER, device_profiler, profile_dir
+from karpenter_core_tpu.obs import proghealth
 from karpenter_core_tpu.scheduling.requirements import Requirements
 from karpenter_core_tpu.solver.encode import EncodedSnapshot, ReqSetArrays, encode_snapshot
 from karpenter_core_tpu.utils import resources as resources_util
@@ -785,6 +786,15 @@ def device_args(snap: EncodedSnapshot, provisioners: Optional[List[Provisioner]]
     )
 
 
+def _prog_meta(geom, **extra):
+    """Program-ledger record metadata for one geometry: the bucketed tier
+    axes that identify a compiled program's shape class (items x types x
+    existing x slots) without shipping the full cache key."""
+    meta = {"tier": f"P{geom[0]}xT{geom[2]}xE{geom[3]}xN{geom[7]}"}
+    meta.update(extra)
+    return meta
+
+
 class _Dispatchable:
     """A jit-wrapped program that prefers its AOT-compiled executable when
     the prewarm path produced one: jax.jit(...).lower().compile() does NOT
@@ -1333,10 +1343,17 @@ class TPUSolver:
             )
 
         fn = _Dispatchable(jax.jit(refresh_bundled, donate_argnums=(1,)))
+        evicted = []
         with self._cache_lock:
             self._refresh_compiled[rkey] = fn
             while len(self._refresh_compiled) > self.MAX_REFRESH:
-                self._refresh_compiled.popitem(last=False)
+                evicted.append(self._refresh_compiled.popitem(last=False)[0])
+        proghealth.record_mint(
+            "refresh", rkey,
+            meta=_prog_meta(geom, rb=rb, cb=cb),
+        )
+        for old in evicted:
+            proghealth.retire("refresh", old)
         return fn, True
 
     def _dispatch_prescreen(self, staged: _StagedCall, pre_fn,
@@ -1404,6 +1421,9 @@ class TPUSolver:
                         )
                         scr_mode = "refresh"
                         inc.count_refresh()
+                        proghealth.record_dispatch(
+                            "refresh", (key, delta.rb, delta.cb)
+                        )
                     except Exception:
                         # refresh dispatch failed (the donated tensor may
                         # be gone): drop residency but keep the staged
@@ -1477,11 +1497,18 @@ class TPUSolver:
             )
 
         fn = _Dispatchable(jax.jit(part_bundled))
+        evicted = []
         with self._cache_lock:
             fn = self._segment_compiled.setdefault(rkey, fn)
             self._segment_compiled.move_to_end(rkey)
             while len(self._segment_compiled) > self.MAX_SEGMENT:
-                self._segment_compiled.popitem(last=False)
+                evicted.append(self._segment_compiled.popitem(last=False)[0])
+        proghealth.record_mint(
+            "segment", rkey,
+            meta=_prog_meta(staged.geom, scan="segmented", role="partition"),
+        )
+        for old in evicted:
+            proghealth.retire("segment", old)
         return fn, True
 
     def _segment_fn(self, staged: _StagedCall, s_pad: int, m_pad: int,
@@ -1519,11 +1546,21 @@ class TPUSolver:
             )
 
         fn = _Dispatchable(jax.jit(seg_bundled))
+        evicted = []
         with self._cache_lock:
             fn = self._segment_compiled.setdefault(rkey, fn)
             self._segment_compiled.move_to_end(rkey)
             while len(self._segment_compiled) > self.MAX_SEGMENT:
-                self._segment_compiled.popitem(last=False)
+                evicted.append(self._segment_compiled.popitem(last=False)[0])
+        proghealth.record_mint(
+            "segment", rkey,
+            meta=_prog_meta(
+                staged.geom, scan="segmented", lanes=s_pad,
+                segment_bucket=m_pad, frozen=bool(frozen),
+            ),
+        )
+        for old in evicted:
+            proghealth.retire("segment", old)
         return fn, True
 
     def _try_segmented(self, snap: EncodedSnapshot, staged: _StagedCall,
@@ -1605,6 +1642,9 @@ class TPUSolver:
         else:
             part_fn, part_cold = self._partition_fn(staged, screen_mode)
             labels_d, _neutral_d, slot_label_d = part_fn(args[0], screen0)
+            proghealth.record_dispatch(
+                "segment", (key, "segmented", "partition")
+            )
             labels, slot_label = jax.device_get((labels_d, slot_label_d))
             labels = np.asarray(labels)
             slot_label = np.asarray(slot_label)
@@ -1712,6 +1752,11 @@ class TPUSolver:
         self.last_device_ms = (_time.perf_counter() - t_dispatch) * 1e3
         _mark("device", compile_cache="miss" if seg_cold else "hit",
               lanes=lanes_n)
+        proghealth.record_dispatch(
+            "segment",
+            (staged.key, "segmented", s_pad, m_pad, bool(frozen)),
+            self.last_device_ms,
+        )
         opens = np.maximum(nopen_a - E, 0)
         lane_lb = min(2 * m_pad + 64, 4096) if E else 1
         if int(opens.sum()) > N - E:
@@ -1947,6 +1992,7 @@ class TPUSolver:
             )
             record_lookup("replan", not minted)
             any_miss |= minted
+            t_chunk = _time.perf_counter()
             with device_profiler():
                 pods_dev, verd_dev = fn(
                     sub_counts, sub_open, uninit, screen0, dev[0], *dev[1:]
@@ -1962,6 +2008,10 @@ class TPUSolver:
                 # (make_replan_verdict_kernel)
                 verd_h = jax.device_get(verd_dev)
             verdict_parts.append(np.asarray(verd_h)[:k])
+            proghealth.record_dispatch(
+                "replan", (staged.key, Kp),
+                (_time.perf_counter() - t_chunk) * 1e3,
+            )
         self.last_device_ms = (_time.perf_counter() - t_dispatch) * 1e3
         _mark(
             "device", compile_cache="miss" if any_miss else "hit",
@@ -2013,11 +2063,18 @@ class TPUSolver:
             )
 
         fn = _Dispatchable(jax.jit(replan_bundled))
+        evicted = []
         with self._cache_lock:
             fn = self._replan_compiled.setdefault(rkey, fn)
             self._replan_compiled.move_to_end(rkey)
             while len(self._replan_compiled) > self.MAX_REPLAN:
-                self._replan_compiled.popitem(last=False)
+                evicted.append(self._replan_compiled.popitem(last=False)[0])
+        proghealth.record_mint(
+            "replan", rkey,
+            meta=_prog_meta(staged.geom, k_bucket=k_pad),
+        )
+        for old in evicted:
+            proghealth.retire("replan", old)
         return fn, True
 
     def _prewarm_replan(self, staged: _StagedCall, pre_jit, topo_meta) -> None:
@@ -2052,10 +2109,17 @@ class TPUSolver:
                 staged.bundle.shape, staged.bundle.dtype
             )
             screen_sds = jax.eval_shape(pre_jit, bundle_sds)
+        import time as _time
+
+        t_aot = _time.perf_counter()
         fn.aot = fn.jit.lower(
             count_sds, open_sds, uninit_sds, screen_sds,
             staged.bundle, *staged.donated_leaves,
         ).compile()
+        proghealth.record_compile(
+            "replan", (staged.key, k),
+            _time.perf_counter() - t_aot, compiled=fn.aot,
+        )
 
     # -- compiled-program cache (shared with the prewarm thread) -----------
 
@@ -2067,6 +2131,7 @@ class TPUSolver:
         right here via jax.jit(...).lower().compile(), which also writes
         the persistent disk cache — while losers block and then hit."""
         import threading
+        import time as _time
 
         key = staged.key
         with self._cache_lock:
@@ -2082,25 +2147,45 @@ class TPUSolver:
                     self._compiled.move_to_end(key)
                     return entry, True
             entry = self._build_entry(staged, screen_mode)
+            compile_s = 0.0
             if aot:
+                t_aot = _time.perf_counter()
                 self._aot_compile(entry, staged)
+                compile_s = _time.perf_counter() - t_aot
+            retired = []
             with self._cache_lock:
                 self._compiled[key] = entry
                 self._key_locks.pop(key, None)
                 while len(self._compiled) > self.MAX_COMPILED:
                     old_key, _ = self._compiled.popitem(last=False)
+                    retired.append(("solve", old_key))
                     self._fetch_buckets.pop(old_key, None)
                     for rk in [k for k in self._refresh_compiled
                                if k[0] == old_key]:
                         del self._refresh_compiled[rk]
+                        retired.append(("refresh", rk))
                     for rk in [k for k in self._replan_compiled
                                if k[0] == old_key]:
                         del self._replan_compiled[rk]
+                        retired.append(("replan", rk))
                     for rk in [k for k in self._segment_compiled
                                if k[0] == old_key]:
                         del self._segment_compiled[rk]
+                        retired.append(("segment", rk))
                     self._segment_labels.pop(old_key, None)
                     self._inc_screens.pop(old_key, None)
+            proghealth.record_mint(
+                "solve", key,
+                origin="aot" if aot else "live",
+                compile_s=compile_s,
+                compiled=entry[0].aot,
+                meta=_prog_meta(
+                    staged.geom, screen_mode=str(screen_mode),
+                    prescreen=entry[1] is not None,
+                ),
+            )
+            for family, rk in retired:
+                proghealth.retire(family, rk)
         return entry, False
 
     def _build_entry(self, staged: _StagedCall, screen_mode):
@@ -2463,11 +2548,15 @@ class TPUSolver:
         # transfer, which the single-RT design makes inseparable)
         self.last_device_ms = (_time.perf_counter() - t_dispatch) * 1e3
         _mark("device", compile_cache="hit" if cache_hit else "miss")
+        proghealth.record_dispatch("solve", key, self.last_device_ms)
         if not cache_hit:
             # a miss's first dispatch pays jit trace + XLA compile (or the
             # persistent disk-cache load): attribute it to the compile
             # histogram so restart stalls are visible in /metrics
             record_compile_seconds(phases["device"] / 1e3)
+            proghealth.record_compile(
+                "solve", key, phases["device"] / 1e3, compiled=fn.aot
+            )
         ptr_i, nopen, bulk_n, nnz = int(ptr_i), int(nopen), int(bulk_n), int(nnz)
         need_bk = _buckets(ptr_i, nopen, bulk_n, nnz)
         # keep the speculation MONOTONE (max with the previous buckets):

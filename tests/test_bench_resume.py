@@ -341,6 +341,256 @@ def test_timeline_is_byte_stable_across_remerges(store):
     assert a == b
 
 
+# ---------------------------------------------------------------------------
+# cross-round perf ledger (ISSUE 18): append/verdict are pure over the
+# store + prior ledger dict — no subprocesses, no files unless a test
+# wants one
+
+
+def _row_keys(ledger):
+    return [(r["round"], r["stage"], r["column"]) for r in ledger["rows"]]
+
+
+def test_append_ledger_cold_start_and_byte_stable(store, tmp_path):
+    _fill_round(store)
+    # missing-ledger cold start: no file is no prior
+    assert bench._load_ledger(str(tmp_path / "PERF_LEDGER.json")) is None
+    l1 = bench.append_ledger(store, None, "r07")
+    assert l1["version"] == bench.LEDGER_VERSION
+    assert l1["rows"], "a complete round must contribute rows"
+    # re-folding the same unchanged round over its own output is a no-op
+    l2 = bench.append_ledger(store, l1, "r07")
+    assert json.dumps(l1, sort_keys=True) == json.dumps(l2, sort_keys=True)
+    # row identity is unique per (round, stage, column)
+    assert len(set(_row_keys(l2))) == len(l2["rows"])
+
+
+def test_append_ledger_rows_carry_provenance(store):
+    _fill_round(store, fallback=("multichip",))
+    hd = dict(HEADLINE_DATA, programs_digest="abc123def456")
+    store.save("headline", bench.stage_config("headline"), hd,
+               meta={"backend": "TPU v5e", "platform": "tpu"})
+    ledger = bench.append_ledger(store, None, "r07")
+    rows = {(r["stage"], r["column"]): r for r in ledger["rows"]}
+    head = rows[("headline", "e2e_p99_ms")]
+    assert head["value"] == HEADLINE_DATA["e2e_p99_ms"]
+    assert head["platform"] == "tpu"
+    assert head["programs_digest"] == "abc123def456"
+    assert head["fallback"] is False
+    assert rows[("multichip", "v")]["fallback"] is True
+    # the digest itself is provenance, never a perf column
+    assert ("headline", "programs_digest") not in rows
+    # booleans and nested dicts are not perf columns either
+    assert ("headline", "cpu_fallback") not in rows
+    assert ("headline", "tail") not in rows
+
+
+def test_append_ledger_backfill_updates_same_round_rows(store):
+    """--resume re-merges the same round after backfilling a degraded
+    stage: its rows are REPLACED, never duplicated."""
+    _fill_round(store, degraded=("grid",))
+    l1 = bench.append_ledger(store, None, "r07")
+    assert not any(r["stage"] == "grid" for r in l1["rows"])
+    # the resume backfills grid, and the headline got a better number
+    store.save("grid", bench.stage_config("grid"), {"grid_ms": 42.0},
+               meta={"backend": "TPU v5e", "platform": "tpu"})
+    store.save("headline", bench.stage_config("headline"),
+               dict(HEADLINE_DATA, e2e_p99_ms=300.0),
+               meta={"backend": "TPU v5e", "platform": "tpu"})
+    l2 = bench.append_ledger(store, l1, "r07")
+    assert len(set(_row_keys(l2))) == len(l2["rows"]), "duplicated rows"
+    grid = [r for r in l2["rows"] if r["stage"] == "grid"]
+    assert [r["column"] for r in grid] == ["grid_ms"]
+    head = [r for r in l2["rows"]
+            if r["stage"] == "headline" and r["column"] == "e2e_p99_ms"]
+    assert head[0]["value"] == 300.0, "backfill must update, not append"
+
+
+def _two_round_ledger(store, second_round_data):
+    """r01 at the baseline headline numbers, r02 at the given ones."""
+    _fill_round(store)
+    ledger = bench.append_ledger(store, None, "r01")
+    store.save("headline", bench.stage_config("headline"),
+               dict(HEADLINE_DATA, **second_round_data),
+               meta={"backend": "TPU v5e", "platform": "tpu"})
+    return bench.append_ledger(store, ledger, "r02")
+
+
+def test_ledger_verdict_fires_on_seeded_slowdown(store):
+    """A seeded 2x slowdown (and the matching throughput halving) on the
+    same platform trips the named regression verdict — warn-only is the
+    caller's contract, the verdict itself must be loud and specific."""
+    ledger = _two_round_ledger(store, {
+        "e2e_p99_ms": HEADLINE_DATA["e2e_p99_ms"] * 2.0,
+        "pods_per_sec": HEADLINE_DATA["pods_per_sec"] / 2.0,
+    })
+    verdict = bench.ledger_verdict(ledger, "r02")
+    assert verdict["ok"] is False
+    named = {(g["stage"], g["column"]) for g in verdict["regressions"]}
+    assert ("headline", "e2e_p99_ms") in named
+    assert ("headline", "pods_per_sec") in named
+    worst = verdict["regressions"][0]
+    assert worst["worse_pct"] == pytest.approx(100.0, abs=0.2)
+    assert worst["best_known"] > 0
+
+
+def test_ledger_verdict_quiet_on_noise(store):
+    """A 10% wiggle is measurement noise, not a regression (threshold is
+    25%); a directionless column moving a lot is identity, not perf."""
+    ledger = _two_round_ledger(store, {
+        "e2e_p99_ms": HEADLINE_DATA["e2e_p99_ms"] * 1.10,
+        "pods_per_sec": HEADLINE_DATA["pods_per_sec"] * 0.92,
+        "scheduled_min": 9999,  # no direction suffix: never tripwired
+    })
+    verdict = bench.ledger_verdict(ledger, "r02")
+    assert verdict["ok"] is True
+    assert verdict["regressions"] == []
+
+
+def test_ledger_verdict_compares_same_platform_only(store):
+    """A CPU-fallback-grade number on a DIFFERENT platform must not be
+    judged against the TPU best-known — the exact r03-r05 trap."""
+    _fill_round(store)
+    ledger = bench.append_ledger(store, None, "r01")
+    store.save("headline", bench.stage_config("headline"),
+               dict(HEADLINE_DATA, e2e_p99_ms=HEADLINE_DATA["e2e_p99_ms"] * 40),
+               meta={"backend": "cpu-fallback", "platform": "cpu"})
+    ledger = bench.append_ledger(store, ledger, "r02")
+    assert bench.ledger_verdict(ledger, "r02")["ok"] is True
+
+
+def test_ledger_verdict_excludes_fallback_rows(store):
+    """Shrunk involuntary-CPU rows measure a different workload: excluded
+    from both the best-known pool and the judged round."""
+    _fill_round(store)
+    ledger = bench.append_ledger(store, None, "r01")
+    store.save("headline", bench.stage_config("headline"),
+               dict(HEADLINE_DATA, e2e_p99_ms=HEADLINE_DATA["e2e_p99_ms"] * 3),
+               fallback=True,
+               meta={"backend": "TPU v5e", "platform": "tpu"})
+    ledger = bench.append_ledger(store, ledger, "r02")
+    assert bench.ledger_verdict(ledger, "r02")["ok"] is True
+
+
+# ---------------------------------------------------------------------------
+# probe forensics (ISSUE 18): the labeled-heartbeat phase contract and the
+# verdict-file channel — the probe subprocess is faked, everything else real
+
+
+def _fake_probe(label, rc=0, out="", err="", timed_out=False):
+    """A _run_subprocess stand-in that behaves like a probe child reaching
+    `label` before dying/succeeding."""
+
+    def run(cmd, env, timeout_s, capture_stderr=False):
+        if label:
+            with open(env["BENCH_PROBE_HEARTBEAT"], "w") as f:
+                f.write(label)
+        return rc, out, err, timed_out
+
+    return run
+
+
+def test_probe_forensic_success_parses_platform_and_timings(monkeypatch):
+    monkeypatch.setattr(bench, "_run_subprocess", _fake_probe(
+        "done", rc=0,
+        out="cpu TFRT_CPU\nPROBE_TIMINGS 120.5 35.0 2\n",
+    ))
+    ok, note, forensics = bench._probe_forensic(30)
+    assert ok is True
+    assert note == "cpu TFRT_CPU"  # first token = platform: the
+    # _decide_backend contract the legacy note shape must keep
+    assert forensics["phase"] == "done"
+    assert forensics["platform"] == "cpu"
+    assert forensics["import_ms"] == 120.5
+    assert forensics["device_init_ms"] == 35.0
+    assert forensics["device_count"] == 2
+    assert forensics["timed_out"] is False
+
+
+def test_probe_forensic_timeout_names_init_phase(monkeypatch):
+    """The whole point: a wedged TPU probe says WHERE it wedged instead
+    of just 'timeout' — the phase label the child last marked."""
+    monkeypatch.setattr(bench, "_run_subprocess", _fake_probe(
+        "device-init", rc=None, err="libtpu: waiting for TPU system\n",
+        timed_out=True,
+    ))
+    ok, note, forensics = bench._probe_forensic(60)
+    assert ok is False
+    assert "(in device-init)" in note
+    assert forensics["phase"] == "device-init"
+    assert forensics["timed_out"] is True
+    assert "libtpu" in forensics["stderr_tail"]
+
+
+def test_probe_forensic_no_mark_reads_as_spawn(monkeypatch):
+    monkeypatch.setattr(bench, "_run_subprocess", _fake_probe(
+        "", rc=None, timed_out=True,
+    ))
+    _ok, note, forensics = bench._probe_forensic(10)
+    assert forensics["phase"] == "spawn"
+    assert "(in spawn)" in note
+
+
+def test_probe_forensic_stderr_tail_is_bounded_and_redacted(monkeypatch):
+    secret = "hunter2-very-secret-token"
+    monkeypatch.setenv("KCT_TEST_SECRET_TOKEN", secret)
+    monkeypatch.setattr(bench, "_run_subprocess", _fake_probe(
+        "import", rc=1,
+        err=("x" * (bench.PROBE_FORENSIC_TAIL * 2))
+        + f"\nauth failed with {secret}\nfatal: no backend\n",
+    ))
+    _ok, note, forensics = bench._probe_forensic(10)
+    assert note == "fatal: no backend"  # legacy last-stderr-line note
+    tail = forensics["stderr_tail"]
+    assert len(tail) <= bench.PROBE_FORENSIC_TAIL + 64
+    assert secret not in tail, "env values must be redacted from the tail"
+
+
+def test_read_verdict_forensics_survives_ttl_expiry(tmp_path):
+    """read_verdict treats a stale verdict as no verdict (gating); the
+    forensic record must still be readable — it's evidence, not a gate."""
+    path = str(tmp_path / "health.json")
+    record = {"phase": "device-init", "timed_out": True, "rc": None}
+    supervise.write_verdict(path, False, "probe timeout after 60s",
+                            ttl_s=0.0, extra={"probe_forensics": record})
+    import time as _t
+
+    _t.sleep(0.02)
+    assert supervise.read_verdict(path) is None, "stale must not gate"
+    got = bench._read_verdict_forensics(path)
+    assert got == record
+    assert bench._read_verdict_forensics(str(tmp_path / "missing.json")) is None
+
+
+def test_probe_script_marks_real_phases(tmp_path):
+    """The actual probe child script (minus the jax import — replaced by a
+    stub module) drives the real heartbeat-file contract end to end."""
+    import subprocess
+    import sys as _sys
+
+    hb = str(tmp_path / "hb")
+    stub_dir = tmp_path / "stub"
+    stub_dir.mkdir()
+    (stub_dir / "jax.py").write_text(
+        "class _D:\n"
+        "    platform = 'cpu'\n"
+        "    device_kind = 'stub'\n"
+        "def devices():\n"
+        "    return [_D()]\n"
+    )
+    env = {**__import__("os").environ, "BENCH_PROBE_HEARTBEAT": hb,
+           "PYTHONPATH": str(stub_dir)}
+    out = subprocess.run(
+        [_sys.executable, "-c", bench._PROBE_SCRIPT], env=env,
+        capture_output=True, text=True, timeout=60,
+    )
+    assert out.returncode == 0, out.stderr
+    assert supervise.Heartbeat(hb).read_label() == "done"
+    lines = out.stdout.splitlines()
+    assert lines[0] == "cpu stub"
+    assert lines[1].startswith("PROBE_TIMINGS ")
+
+
 def test_timeline_tolerates_missing_meta_and_empty_store(store):
     # empty store: a valid, empty-ish timeline (orchestrator row only)
     tl = bench.build_timeline(store)

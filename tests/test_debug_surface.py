@@ -278,10 +278,13 @@ def test_debug_index_lists_every_endpoint_and_is_ungated():
         paths = {e["path"]: e for e in index["endpoints"]}
         # the index covers the whole surface, including itself being served
         for must in ("/metrics", "/debug/health", "/debug/slo",
-                     "/debug/tenants", "/debug/trace", "/debug/solves"):
+                     "/debug/tenants", "/debug/trace", "/debug/solves",
+                     "/debug/programs"):
             assert must in paths, must
         assert paths["/debug/health"]["profiling_gated"] is False
         assert paths["/debug/slo"]["profiling_gated"] is True
+        # ISSUE 18: the program inventory is a profiling surface
+        assert paths["/debug/programs"]["profiling_gated"] is True
         # with profiling off, gated endpoints are listed but disabled
         assert paths["/debug/slo"]["enabled"] is False
         assert paths["/metrics"]["enabled"] is True

@@ -727,6 +727,41 @@ def test_gate_hot_path_unset_tenant_cost():
     )
 
 
+def test_program_ledger_disabled_path_cost():
+    """ISSUE 18 tripwire: with KARPENTER_PROGHEALTH off, every solver
+    dispatch site pays ONE attribute load + ONE flag check — no key
+    digest, no record dict, no lock. Same budget as the tracer's disabled
+    gate (generous multiplier: regression tripwire, not a bench)."""
+    import timeit
+
+    from karpenter_core_tpu.obs import proghealth
+
+    led = proghealth.reset(enabled=False)
+    try:
+        n = 200_000
+        baseline = timeit.timeit("f()", globals={"f": lambda: None}, number=n)
+        key = (("geom", 64, 8), "mxu", "prescreen")
+        t_disp = timeit.timeit(
+            "rd('solve', key, 1.5)",
+            globals={"rd": proghealth.record_dispatch, "key": key}, number=n,
+        )
+        assert t_disp < baseline * 20 + 0.5, (
+            f"disabled program-ledger dispatch {t_disp / n * 1e9:.0f}ns/call"
+        )
+        t_mint = timeit.timeit(
+            "rm('solve', key)",
+            globals={"rm": proghealth.record_mint, "key": key}, number=n,
+        )
+        assert t_mint < baseline * 20 + 0.5, (
+            f"disabled program-ledger mint {t_mint / n * 1e9:.0f}ns/call"
+        )
+        # nothing was recorded: zero allocations is also zero state
+        snap = led.snapshot()
+        assert snap["programs"] == [] and snap["totals"] == {}
+    finally:
+        proghealth.reset()
+
+
 def test_tenant_guard_flood_stays_bounded():
     """ISSUE 16 tripwire: a label-value flood (adversarial or buggy tenant
     strings) can never mint more than cap+1 label values; admit() on a hot

@@ -1,0 +1,411 @@
+"""Compiled-program cost inventory (ISSUE 18): ledger accounting, the
+cost_analysis portability shim, the solver-host inventory merger's
+respawn-idempotent generation contract, the unified /debug/programs
+surface (served + gated), and the solver wiring that feeds it all."""
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from karpenter_core_tpu.cloudprovider import fake
+from karpenter_core_tpu.obs import proghealth
+from karpenter_core_tpu.obs.proghealth import (
+    ProgramInventoryMerger,
+    ProgramLedger,
+    normalize_cost_analysis,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_ledger():
+    """Fresh singleton + empty source registry per test; restore the
+    env-derived default afterwards so other tests see pristine state."""
+    with proghealth._sources_mu:
+        saved = dict(proghealth._SOURCES)
+        proghealth._SOURCES.clear()
+    proghealth.reset(enabled=True)
+    yield
+    proghealth.reset()
+    with proghealth._sources_mu:
+        proghealth._SOURCES.clear()
+        proghealth._SOURCES.update(saved)
+
+
+class FakeCompiled:
+    """Duck-typed stand-in for a jax compiled executable."""
+
+    def __init__(self, cost=None, mem=None, raise_cost=False, raise_mem=True):
+        self._cost = cost
+        self._mem = mem
+        self._raise_cost = raise_cost
+        self._raise_mem = raise_mem
+
+    def cost_analysis(self):
+        if self._raise_cost:
+            raise NotImplementedError("backend has no cost analysis")
+        return self._cost
+
+    def memory_analysis(self):
+        if self._raise_mem:
+            raise NotImplementedError("backend has no memory analysis")
+        return self._mem
+
+
+class FakeMem:
+    def __init__(self, **attrs):
+        for k, v in attrs.items():
+            setattr(self, k, v)
+
+
+# -- cost_analysis portability (satellite: probe once, normalize) -----------
+
+
+def test_normalize_cost_analysis_list_shape():
+    out = normalize_cost_analysis([{"flops": 1e9, "bytes accessed": 2048.0}])
+    assert out == {"flops": 1e9, "bytes_accessed": 2048.0}
+
+
+def test_normalize_cost_analysis_dict_shape():
+    out = normalize_cost_analysis({"flops": 5.0, "bytes_accessed": 16})
+    assert out == {"flops": 5.0, "bytes_accessed": 16.0}
+
+
+def test_normalize_cost_analysis_unrecognized():
+    assert normalize_cost_analysis(None) is None
+    assert normalize_cost_analysis([]) is None
+    assert normalize_cost_analysis("nope") is None
+    assert normalize_cost_analysis({"unrelated": "x"}) is None
+
+
+def test_cost_shape_probed_once_list():
+    led = ProgramLedger(enabled=True)
+    led.record_mint(
+        "solve", ("k1",),
+        compiled=FakeCompiled(cost=[{"flops": 2.0, "bytes accessed": 4}]),
+    )
+    assert led.snapshot()["cost_shape"] == "list"
+    rec = led.snapshot()["programs"][0]
+    assert rec["cost"] == {"flops": 2.0, "bytes_accessed": 4.0}
+    # a later dict-shaped return does NOT re-probe the recorded shape
+    led.record_mint("solve", ("k2",), compiled=FakeCompiled(cost={"flops": 3.0}))
+    assert led.snapshot()["cost_shape"] == "list"
+
+
+def test_cost_shape_probed_once_dict():
+    led = ProgramLedger(enabled=True)
+    led.record_mint("solve", ("k1",), compiled=FakeCompiled(cost={"flops": 7.0}))
+    assert led.snapshot()["cost_shape"] == "dict"
+
+
+def test_unavailable_analysis_never_raises():
+    """CPU backends and older jax raise from cost/memory analysis — the
+    record degrades to 'unavailable', the mint itself always lands."""
+    led = ProgramLedger(enabled=True)
+    led.record_mint("solve", ("k",), compiled=FakeCompiled(raise_cost=True))
+    rec = led.snapshot()["programs"][0]
+    assert rec["cost"] == "unavailable"
+    assert rec["memory"] == "unavailable"
+    assert led.snapshot()["cost_shape"] == "unavailable"
+    # no executable at all (live-path jit): same fallback
+    led.record_mint("refresh", ("k2",), compiled=None)
+    rec2 = [r for r in led.snapshot()["programs"] if r["family"] == "refresh"][0]
+    assert rec2["cost"] == "unavailable"
+
+
+def test_memory_analysis_peak_and_section_fallback():
+    led = ProgramLedger(enabled=True)
+    led.record_mint(
+        "solve", ("explicit",),
+        compiled=FakeCompiled(
+            cost={"flops": 1.0},
+            mem=FakeMem(peak_memory_in_bytes=4096), raise_mem=False,
+        ),
+    )
+    led.record_mint(
+        "solve", ("sections",),
+        compiled=FakeCompiled(
+            cost={"flops": 1.0},
+            mem=FakeMem(argument_size_in_bytes=100, output_size_in_bytes=20,
+                        temp_size_in_bytes=7, generated_code_size_in_bytes=3),
+            raise_mem=False,
+        ),
+    )
+    mems = {
+        r["key"]: r["memory"] for r in led.snapshot()["programs"]
+    }
+    assert {"hbm_peak_bytes": 4096} in mems.values()
+    assert {"hbm_peak_bytes": 130} in mems.values()
+
+
+# -- ledger accounting -------------------------------------------------------
+
+
+def test_mint_dispatch_accounting():
+    led = ProgramLedger(enabled=True)
+    led.record_mint("solve", ("geo", 1), origin="aot", compile_s=1.5,
+                    meta={"tier": "P64xT8xE4xN128"})
+    led.record_dispatch("solve", ("geo", 1), device_ms=10.0)
+    led.record_dispatch("solve", ("geo", 1), device_ms=20.0)
+    snap = led.snapshot()
+    rec = snap["programs"][0]
+    assert rec["origin"] == "aot"
+    assert rec["tier"] == "P64xT8xE4xN128"
+    assert rec["exec_count"] == 2
+    assert rec["last_device_ms"] == 20.0
+    # EMA: 0.2 * 20 + 0.8 * 10
+    assert rec["ema_device_ms"] == pytest.approx(12.0)
+    totals = snap["totals"]["solve"]
+    assert totals["minted"] == 1
+    assert totals["exec_total"] == 2
+    assert totals["compile_seconds_total"] == pytest.approx(1.5)
+    # a re-mint of the SAME key is not a new program
+    led.record_mint("solve", ("geo", 1), origin="aot")
+    assert led.snapshot()["totals"]["solve"]["minted"] == 1
+
+
+def test_record_compile_attributes_late_seconds():
+    """The live path pays jit trace + XLA compile at FIRST dispatch, not
+    at mint — record_compile folds those seconds into the same record."""
+    led = ProgramLedger(enabled=True)
+    led.record_mint("solve", ("k",), origin="live")
+    led.record_compile("solve", ("k",), 2.25,
+                       compiled=FakeCompiled(cost={"flops": 9.0}))
+    rec = led.snapshot()["programs"][0]
+    assert rec["compile_seconds"] == pytest.approx(2.25)
+    assert rec["cost"] == {"flops": 9.0}
+    assert led.snapshot()["totals"]["solve"][
+        "compile_seconds_total"] == pytest.approx(2.25)
+
+
+def test_eviction_retires_records_totals_monotone():
+    led = ProgramLedger(enabled=True)
+    for i in range(proghealth.MAX_RECORDS + 10):
+        led.record_mint("replan", ("k", i), compile_s=0.001)
+    snap = led.snapshot()
+    totals = snap["totals"]["replan"]
+    assert totals["minted"] == proghealth.MAX_RECORDS + 10
+    assert totals["retired"] == 10
+    # live cardinality is bounded; cumulative seconds were never subtracted
+    assert len(led._records) == proghealth.MAX_RECORDS
+    assert totals["compile_seconds_total"] == pytest.approx(
+        (proghealth.MAX_RECORDS + 10) * 0.001
+    )
+
+
+def test_explicit_retire_is_exactly_once():
+    led = ProgramLedger(enabled=True)
+    led.record_mint("segment", ("s",))
+    led.retire("segment", ("s",))
+    led.retire("segment", ("s",))  # second retire of the same key: no-op
+    totals = led.snapshot()["totals"]["segment"]
+    assert totals["retired"] == 1
+    assert led.snapshot()["programs"] == []
+
+
+def test_dispatch_before_mint_synthesizes_record():
+    led = ProgramLedger(enabled=True)
+    led.record_dispatch("refresh", ("orphan",), device_ms=3.0)
+    rec = led.snapshot()["programs"][0]
+    assert rec["origin"] == "unknown"
+    assert rec["exec_count"] == 1
+    assert led.snapshot()["totals"]["refresh"]["exec_total"] == 1
+
+
+def test_disabled_ledger_records_nothing(monkeypatch):
+    monkeypatch.setenv("KARPENTER_PROGHEALTH", "0")
+    led = proghealth.reset()
+    assert led.enabled is False
+    proghealth.record_mint("solve", ("k",))
+    proghealth.record_dispatch("solve", ("k",))
+    proghealth.record_compile("solve", ("k",), 1.0)
+    snap = led.snapshot()
+    assert snap["programs"] == [] and snap["totals"] == {}
+
+
+# -- solver-host merger: the PR 15 generation contract -----------------------
+
+
+def _child_snap(n=2, family="solve", compile_s=1.0):
+    return {
+        "programs": [
+            {"family": family, "key": f"c{i}", "origin": "live",
+             "compile_seconds": compile_s, "exec_count": i,
+             "last_device_ms": None, "ema_device_ms": None,
+             "cost": "unavailable", "memory": "unavailable"}
+            for i in range(n)
+        ],
+        "totals": {family: {"minted": n, "retired": 0, "exec_total": n,
+                            "compile_seconds_total": compile_s * n}},
+        "cost_shape": "dict",
+    }
+
+
+def test_merger_labels_process_and_generation():
+    m = ProgramInventoryMerger("solver-host")
+    m.ingest(1, _child_snap(2))
+    snap = m.snapshot()
+    assert all(r["process"] == "solver-host" for r in snap["programs"])
+    assert all(r["generation"] == 1 for r in snap["programs"])
+    assert snap["totals"]["solve"]["minted"] == 2
+    assert snap["cost_shape"] == "dict"
+
+
+def test_merger_same_generation_replaces_not_accumulates():
+    m = ProgramInventoryMerger()
+    m.ingest(1, _child_snap(2))
+    m.ingest(1, _child_snap(3))  # a later stats frame from the same child
+    snap = m.snapshot()
+    assert len(snap["programs"]) == 3
+    assert snap["totals"]["solve"]["minted"] == 3  # replaced, not 5
+
+
+def test_merger_respawn_folds_previous_generation_exactly_once():
+    m = ProgramInventoryMerger()
+    m.ingest(1, _child_snap(2, compile_s=1.0))
+    m.ingest(2, _child_snap(1, compile_s=0.5))  # respawn: gen bump
+    snap = m.snapshot()
+    # gen 1's live entries died with the process; its seconds did not
+    assert len(snap["programs"]) == 1
+    assert snap["totals"]["solve"]["compile_seconds_total"] == pytest.approx(
+        2 * 1.0 + 0.5
+    )
+    assert snap["totals"]["solve"]["minted"] == 3
+
+
+def test_merger_retire_is_idempotent():
+    m = ProgramInventoryMerger()
+    m.ingest(1, _child_snap(2))
+    m.retire(1)
+    first = m.snapshot()
+    m.retire(1)  # a second kill signal for the same generation: no-op
+    assert m.snapshot() == first
+    assert first["programs"] == []
+    assert first["totals"]["solve"]["minted"] == 2
+
+
+def test_merger_retire_unknown_generation_noop():
+    m = ProgramInventoryMerger()
+    m.ingest(3, _child_snap(1))
+    m.retire(2)  # stale generation: the live view survives
+    assert len(m.snapshot()["programs"]) == 1
+
+
+# -- unified view + exposition ----------------------------------------------
+
+
+def test_full_snapshot_merges_sources_and_survives_sick_source():
+    proghealth.record_mint("solve", ("local",))
+    merger = ProgramInventoryMerger("solver-host")
+    merger.ingest(1, _child_snap(2))
+    proghealth.add_source("solver-host", merger.snapshot)
+
+    def sick():
+        raise RuntimeError("child pipe broke")
+
+    proghealth.add_source("sick", sick)
+    snap = proghealth.full_snapshot()
+    assert snap["enabled"] is True
+    by_process = {}
+    for rec in snap["programs"]:
+        by_process.setdefault(rec["process"], []).append(rec)
+    assert len(by_process["main"]) == 1
+    assert len(by_process["solver-host"]) == 2
+    assert "solver-host" in snap["totals"]
+    assert "sick" not in snap["totals"]
+
+
+def test_exposition_families():
+    proghealth.record_mint(
+        "solve", ("k",), compile_s=2.0,
+        compiled=FakeCompiled(
+            cost={"flops": 1.0},
+            mem=FakeMem(peak_memory_in_bytes=1 << 20), raise_mem=False,
+        ),
+    )
+    fams = proghealth.EXPOSITION.families()
+    count = fams["karpenter_program_count"]
+    assert count["kind"] == "gauge"
+    assert [{"process": "main", "family": "solve"}, 1] in count["series"]
+    sec = fams["karpenter_program_compile_seconds_total"]
+    assert sec["kind"] == "counter"
+    assert sec["series"][0][1] == pytest.approx(2.0)
+    hbm = fams["karpenter_program_hbm_peak_bytes"]
+    assert hbm["series"][0][1] == 1 << 20
+
+
+def test_exposition_registered_in_registry_exposition():
+    from karpenter_core_tpu.metrics.registry import REGISTRY
+
+    proghealth.record_mint("solve", ("k",), compile_s=1.0)
+    proghealth.ensure_exposition_registered()
+    text = REGISTRY.expose()
+    assert "karpenter_program_count" in text
+    assert 'family="solve"' in text
+
+
+# -- /debug/programs: served + gated ----------------------------------------
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{port}{path}", timeout=5
+    ) as r:
+        return r.status, r.read()
+
+
+def test_debug_programs_served_and_gated():
+    from karpenter_core_tpu.operator import __main__ as entry, new_operator
+
+    proghealth.record_mint("solve", ("served",), origin="aot", compile_s=0.25)
+    merger = ProgramInventoryMerger("solver-host")
+    merger.ingest(4, _child_snap(1))
+    proghealth.add_source("solver-host", merger.snapshot)
+    operator = new_operator(
+        fake.FakeCloudProvider(), settings=entry.settings_from_env()
+    )
+    server = entry.serve_health(operator, 0, profiling=True)
+    port = server.server_address[1]
+    try:
+        status, body = _get(port, "/debug/programs")
+        assert status == 200
+        snap = json.loads(body)
+        assert snap["enabled"] is True
+        processes = {r["process"] for r in snap["programs"]}
+        assert processes == {"main", "solver-host"}
+    finally:
+        server.shutdown()
+
+    gated = entry.serve_health(operator, 0, profiling=False)
+    port = gated.server_address[1]
+    try:
+        with pytest.raises(urllib.error.HTTPError) as err:
+            _get(port, "/debug/programs")
+        assert err.value.code == 404
+    finally:
+        gated.shutdown()
+
+
+# -- solver wiring: real solves feed the inventory ---------------------------
+
+
+def test_solver_solve_mints_and_dispatches_programs():
+    """A real (CPU-backed) TPUSolver solve lands a solve-family record
+    with compile attribution and an execution count — the wiring the
+    whole inventory depends on."""
+    from karpenter_core_tpu.solver.tpu_solver import TPUSolver
+    from karpenter_core_tpu.testing import make_pod, make_provisioner
+
+    pods = [make_pod(requests={"cpu": "1"}) for _ in range(8)]
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(4)}
+    TPUSolver(max_nodes=32).solve(pods, provisioners, its)
+    snap = proghealth.LEDGER.snapshot()
+    solves = [r for r in snap["programs"] if r["family"] == "solve"]
+    assert solves, "solve dispatch never reported to the program ledger"
+    assert any(r["exec_count"] >= 1 for r in solves)
+    assert any(r.get("tier") for r in solves)
+    totals = snap["totals"]["solve"]
+    assert totals["exec_total"] >= 1
+    # the live first-dispatch compile was attributed to the record
+    assert totals["compile_seconds_total"] > 0
