@@ -3,7 +3,7 @@
 # backend with 8 virtual devices via tests/conftest.py.
 
 .PHONY: test deflake perf bench verify trace-demo chaos chaos-smoke \
-	replay-demo lint soak soak-smoke soak-smoke-inproc prewarm-smoke \
+	replay-demo lint irlint soak soak-smoke soak-smoke-inproc prewarm-smoke \
 	multichip-smoke consolidation-smoke bench-smoke host-smoke race-smoke \
 	segment-smoke obs-smoke prof-smoke
 
@@ -27,6 +27,13 @@ replay-demo:  ## flight-recorded solve -> dump -> byte-identical replay
 
 lint:  ## static analysis, all passes (rule catalog: docs/static-analysis.md)
 	python hack/lint.py
+
+irlint:  ## IR contract sweep: stage the compiled-program family on CPU and
+	# check jaxpr/HLO contracts (rule ids ir-*; catalog in
+	# analysis/irlint/contracts.py, docs in docs/static-analysis.md).
+	# Warm (persistent compile cache) this stays under ~2 minutes.
+	# Non-fatal in verify, FATAL in hack/presubmit.sh.
+	python hack/lint.py --ir
 
 race-smoke:  ## the -race gate at full depth: lock-heavy suites, racewatch exhaustive
 	# sampling off + per-field access cap disabled (tier-1 runs the same
@@ -99,6 +106,10 @@ verify:  ## driver hooks: single-chip compile check + 8-way mesh dryrun
 	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 	# static analysis (fatal): all passes, empty baseline, no suppressions
 	$(MAKE) lint
+	# non-fatal: IR contract sweep over the staged compiled-program family
+	# (jaxpr/HLO budgets; fatal gate lives in presubmit — a cold compile
+	# cache can push this past verify's time budget)
+	-$(MAKE) irlint
 	# the -race gate's own suites (fatal): the three ISSUE 13 passes'
 	# good/bad fixtures, the sarif/changed/parallel driver modes, the
 	# self-lint zero-violation wall, and the lockwatch/racewatch canaries

@@ -2,17 +2,24 @@
 """Static-analysis driver: run every registered pass over karpenter_core_tpu/.
 
 Usage:
-  python hack/lint.py                  # all passes, fatal on any violation
+  python hack/lint.py                  # all AST passes, fatal on any violation
   python hack/lint.py --list-rules     # rule catalog
   python hack/lint.py --rule no-print --rule layering
+  python hack/lint.py --jobs 4         # file-scope passes on a process pool
   python hack/lint.py --changed        # report only files differing from main
   python hack/lint.py --format sarif   # SARIF 2.1.0 for CI PR annotation
   python hack/lint.py --update-baseline  # absorb current violations (debt
                                          # marker — the checked-in baseline
                                          # must ship empty)
+  python hack/lint.py --ir             # IR contract sweep (`make irlint`):
+                                       # stage the compiled-program family
+                                       # on CPU and check jaxpr/HLO
+                                       # contracts (rule ids ir-*)
+  python hack/lint.py --ir --families solve,prescreen --tiers S
 
 Per-line suppression in source: `# lint: disable=<rule>[,<rule>...]`.
-Exit codes: 0 clean, 1 violations, 2 usage error.
+Unused suppressions print as warnings (never fatal). Exit codes: 0 clean,
+1 violations, 2 usage error.
 """
 from __future__ import annotations
 
@@ -31,9 +38,41 @@ from karpenter_core_tpu.analysis import (  # noqa: E402
     load_baseline,
     run_passes,
 )
-from karpenter_core_tpu.analysis.core import collect_sources  # noqa: E402
+from karpenter_core_tpu.analysis.core import (  # noqa: E402
+    collect_sources,
+    run_passes_multiprocessing,
+)
 
 DEFAULT_BASELINE = os.path.join(REPO_ROOT, "hack", "lint-baseline.txt")
+
+
+def _ir_pass(args):
+    """Bootstrap the jax CPU environment and build the IR contracts pass.
+    Env vars must land BEFORE jax imports: the mesh family needs 8 host
+    devices (--xla_force_host_platform_device_count) and the sweep must
+    never grab a real accelerator. The persistent compile cache keeps the
+    warm sweep to ~a minute (only the tier-S mesh programs compile)."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8"
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_num_cpu_devices", 8)
+    except Exception:  # noqa: BLE001 — knob absent on older jax
+        pass
+    from karpenter_core_tpu.utils.compilecache import enable_persistent_cache
+
+    enable_persistent_cache()
+    from karpenter_core_tpu.analysis.irlint import IRContractsPass
+
+    families = args.families.split(",") if args.families else None
+    tiers = args.tiers.split(",") if args.tiers else None
+    return IRContractsPass(tiers=tiers, families=families)
 
 
 def changed_relpaths(base: str = "main") -> set:
@@ -131,11 +170,43 @@ def main(argv=None) -> int:
         "findings are identical either way)",
     )
     parser.add_argument(
+        "--jobs", type=int, default=min(4, os.cpu_count() or 1),
+        metavar="N",
+        help="process-pool width for file-scope passes (takes precedence "
+        "over --workers when > 1; findings are byte-identical to the "
+        "sequential run — tests/test_analysis_framework.py asserts it)",
+    )
+    parser.add_argument(
+        "--ir", action="store_true",
+        help="run the IR contract sweep instead of the AST passes: stage "
+        "the whole compiled-program family (solve/prescreen/refresh/"
+        "replan/segment across the bucket ladder, mesh variant included) "
+        "on the CPU backend and evaluate analysis/irlint/contracts.py "
+        "(rule ids ir-*). Needs jax; shares the persistent compile cache",
+    )
+    parser.add_argument(
+        "--families", default=None, metavar="F[,F...]",
+        help="(--ir only) comma-separated program families to stage: "
+        "prescreen,solve,refresh,replan,segment",
+    )
+    parser.add_argument(
+        "--tiers", default=None, metavar="T[,T...]",
+        help="(--ir only) comma-separated bucket-ladder tier names to "
+        "stage (e.g. S,M); the mesh/tripwire variants ride with tier S",
+    )
+    parser.add_argument(
         "-q", "--quiet", action="store_true", help="violations only, no summary"
     )
     args = parser.parse_args(argv)
 
-    passes = all_passes()
+    if (args.families or args.tiers) and not args.ir:
+        print("lint: --families/--tiers require --ir", file=sys.stderr)
+        return 2
+
+    if args.ir:
+        passes = [_ir_pass(args)]
+    else:
+        passes = all_passes()
     if args.list_rules:
         for p in passes:
             for rule in p.rules:
@@ -167,8 +238,18 @@ def main(argv=None) -> int:
     config = default_config(REPO_ROOT)
     files = collect_sources(REPO_ROOT, config.package_name)
     baseline = load_baseline(args.baseline) if not args.update_baseline else set()
-    result = run_passes(files, config, passes=passes, rules=rules,
-                        baseline=baseline, workers=max(1, args.workers))
+    if args.ir and not rules:
+        # scope the suppression/baseline accounting to the ir-* rules:
+        # the AST passes didn't run, so their suppressions must not be
+        # reported as unused off a sweep that could never hit them
+        rules = {r for p in passes for r in p.rules}
+    if not args.ir and args.jobs > 1:
+        result = run_passes_multiprocessing(
+            files, config, rules=rules, baseline=baseline, jobs=args.jobs
+        )
+    else:
+        result = run_passes(files, config, passes=passes, rules=rules,
+                            baseline=baseline, workers=max(1, args.workers))
     if changed is not None:
         result.violations = [
             v for v in result.violations if v.relpath in changed
@@ -178,6 +259,9 @@ def main(argv=None) -> int:
         ]
         result.baselined = [
             v for v in result.baselined if v.relpath in changed
+        ]
+        result.unused_suppressions = [
+            v for v in result.unused_suppressions if v.relpath in changed
         ]
 
     if args.update_baseline:
@@ -198,12 +282,20 @@ def main(argv=None) -> int:
 
     for v in result.violations:
         print(v.render())
+    for v in result.unused_suppressions:
+        # warn-only: dead `# lint: disable=` comments are blind spots but
+        # never fail the run — deleting the comment clears the warning
+        print(f"warning: {v.render()}")
     if not args.quiet:
         parts = [f"{len(result.violations)} violation(s)"]
         if result.suppressed:
             parts.append(f"{len(result.suppressed)} suppressed")
         if result.baselined:
             parts.append(f"{len(result.baselined)} baselined")
+        if result.unused_suppressions:
+            parts.append(
+                f"{len(result.unused_suppressions)} unused suppression(s)"
+            )
         if changed is not None:
             parts.append(f"changed-only: {len(changed)} file(s) vs {args.changed}")
         ran = sorted(rules) if rules else sorted(r for p in passes for r in p.rules)

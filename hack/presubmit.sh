@@ -14,6 +14,9 @@ cd "$(dirname "$0")/.."
 echo "== presubmit: make lint (static analysis, fatal)"
 make lint
 
+echo "== presubmit: make irlint (IR contract sweep over the staged program family, fatal)"
+make irlint
+
 echo "== presubmit: make race-smoke (lock-heavy suites, racewatch exhaustive, fatal)"
 make race-smoke
 

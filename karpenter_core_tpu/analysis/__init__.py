@@ -30,6 +30,16 @@ Passes (rule ids in parentheses):
                  metric-tenant-guard) static literals/tracked dicts;
                                     "tenant" values route through the
                                     cardinality guard (obs/reqctx)
+  recompileguard (recompile-guard) — runtime collection sizes (len of
+                                    pods/nodes/types) must pass through
+                                    the bucket ladder before reaching a
+                                    jit/pjit boundary or kernel-factory
+                                    static argument
+
+A second backend, analysis/irlint (rule ids ir-*), checks the LOWERED
+jaxpr/HLO of every compiled program the solver can mint against per-family
+contracts — it needs jax + staged programs, so it runs via
+`hack/lint.py --ir` (`make irlint`), not in all_passes().
 """
 from karpenter_core_tpu.analysis.core import (  # noqa: F401
     Pass,
@@ -52,6 +62,7 @@ def all_passes():
     from karpenter_core_tpu.analysis.montime import MonotonicTimePass
     from karpenter_core_tpu.analysis.noprint import NoPrintPass
     from karpenter_core_tpu.analysis.procdiscipline import ProcessDisciplinePass
+    from karpenter_core_tpu.analysis.recompileguard import RecompileGuardPass
     from karpenter_core_tpu.analysis.trace_safety import TraceSafetyPass
 
     return [
@@ -64,4 +75,5 @@ def all_passes():
         AtomicWritePass(),
         NoPrintPass(),
         MetricLabelsPass(),
+        RecompileGuardPass(),
     ]

@@ -156,6 +156,36 @@ class AnalysisConfig:
     plain_write_allowlist: FrozenSet[str] = frozenset({
         "karpenter_core_tpu/solver/host.py::_spawn_locked",
     })
+    # bucketing funnels that absorb a runtime-size taint (recompile-guard
+    # pass): a len()-derived value routed through one of these lands on
+    # the geometry bucket ladder, so downstream static shapes are bounded
+    recompile_sanitizers: FrozenSet[str] = frozenset({
+        "ladder_pad",
+        "bucket_pow2",
+        "replan_k_pad",
+        "replan_chunks",
+        "segment_lane_pad",
+        "segment_item_pad",
+        "solve_geometry",
+    })
+    # compile boundaries whose static arguments shape a program
+    # (recompile-guard pass): the ops/pack kernel factories
+    # (pack.kernel_factories), shape-struct constructors, and jit/pjit
+    # themselves — a raw runtime size arriving here mints one program per
+    # distinct value
+    recompile_sinks: FrozenSet[str] = frozenset({
+        "jit",
+        "pjit",
+        "ShapeDtypeStruct",
+        "make_device_run",
+        "make_prescreen_kernel",
+        "make_screen_refresh_kernel",
+        "make_batched_replan_kernel",
+        "make_replan_verdict_kernel",
+        "make_segment_partition_kernel",
+        "make_pack_kernel",
+        "make_screen_ops",
+    })
 
     def subpackage_of(self, module: str) -> str:
         """`pkg.solver.encode` -> `solver`; root-level modules -> ''."""

@@ -238,6 +238,11 @@ class RunResult:
     violations: List[Violation]
     suppressed: List[Violation]
     baselined: List[Violation]
+    # warn-only: `# lint: disable=<rule>` comments whose line no longer
+    # triggers the named rule — dead suppressions accumulate as silent
+    # blind spots, so the driver surfaces them (they never affect the
+    # exit code; deleting the comment clears the warning)
+    unused_suppressions: List[Violation] = field(default_factory=list)
 
 
 def run_passes(
@@ -280,18 +285,121 @@ def run_passes(
     else:
         for p in selected:
             raw.extend(p.run(files, config))
+    return filter_findings(raw, files, rules=rules, baseline=baseline)
+
+
+def _mp_run_file(payload):
+    """Process-pool worker: re-load ONE source file in the child and run
+    every registry file-scope pass over it. Module-level (picklable);
+    takes/returns plain tuples so the only things crossing the pipe are
+    primitives and the (dataclass, frozenset-valued) AnalysisConfig.
+    Re-loading from disk in the child costs one read+parse but keeps
+    SourceFile/ast trees out of pickle entirely."""
+    path, relpath, module, config, rules = payload
+    from karpenter_core_tpu.analysis import all_passes
+
+    sf = load_tree(path, relpath, module)
+    out = []
+    for p in all_passes():
+        if p.scope != "file":
+            continue
+        if rules is not None and not (set(p.rules) & rules):
+            continue
+        for v in p.run([sf], config):
+            out.append((v.relpath, v.line, v.rule, v.message))
+    return out
+
+
+def run_passes_multiprocessing(
+    files: Sequence[SourceFile],
+    config,
+    rules: Optional[Set[str]] = None,
+    baseline: Optional[Set[str]] = None,
+    jobs: int = 2,
+) -> RunResult:
+    """run_passes with the file-scope passes fanned out over a PROCESS
+    pool (`hack/lint.py --jobs`): one (file) task per child call, registry
+    passes only (children re-instantiate all_passes() — a custom `passes`
+    list can't ship by reference, use run_passes for those). Fileset
+    passes run in the parent. Findings are byte-identical to the
+    sequential run: the shared filter_findings tail canonically sorts and
+    splits (tests/test_analysis_framework.py asserts the equality).
+    Workers spawn (not fork): the parent may have jax's thread pools live
+    (pytest, --ir in the same process), and forking a multithreaded
+    process can deadlock; the worker import surface is stdlib-only so a
+    fresh interpreter costs ~30ms."""
+    import multiprocessing
+    from concurrent.futures import ProcessPoolExecutor
+
+    from karpenter_core_tpu.analysis import all_passes
+
+    raw: List[Violation] = []
+    payloads = [
+        (f.path, f.relpath, f.module, config, rules) for f in files
+    ]
+    with ProcessPoolExecutor(
+        max_workers=max(1, jobs),
+        mp_context=multiprocessing.get_context("spawn"),
+    ) as pool:
+        for chunk in pool.map(_mp_run_file, payloads, chunksize=8):
+            raw.extend(Violation(*t) for t in chunk)
+    for p in all_passes():
+        if p.scope == "file":
+            continue
+        if rules is not None and not (set(p.rules) & rules):
+            continue
+        raw.extend(p.run(files, config))
+    return filter_findings(raw, files, rules=rules, baseline=baseline)
+
+
+def filter_findings(
+    raw: Sequence[Violation],
+    files: Sequence[SourceFile],
+    rules: Optional[Set[str]] = None,
+    baseline: Optional[Set[str]] = None,
+) -> RunResult:
+    """Canonical-sort raw findings and subtract suppressions and the
+    baseline — the one spelling of the kept/suppressed/baselined split,
+    shared by the sequential, thread-pool, and multiprocessing drivers
+    (identical findings across all three is what the parallel tests
+    assert). Also flags *unused* suppressions: a `# lint: disable=<rule>`
+    whose line produced no finding for that rule. Skipped under a --rule
+    filter (only some passes ran, so absence proves nothing) — same
+    reason a partial run must not --update-baseline."""
+    baseline = baseline or set()
     if rules:
         raw = [v for v in raw if v.rule in rules]
     by_rel: Dict[str, SourceFile] = {f.relpath: f for f in files}
     kept: List[Violation] = []
     suppressed: List[Violation] = []
     baselined: List[Violation] = []
+    hit: Set[Tuple[str, int, str]] = set()
     for v in sorted(raw, key=lambda v: (v.relpath, v.line, v.rule, v.message)):
         sf = by_rel.get(v.relpath)
         if sf is not None and sf.suppressed(v.line, v.rule):
             suppressed.append(v)
+            hit.add((v.relpath, v.line, v.rule))
+            hit.add((v.relpath, v.line, "*"))
         elif v.key() in baseline:
             baselined.append(v)
         else:
             kept.append(v)
-    return RunResult(violations=kept, suppressed=suppressed, baselined=baselined)
+    unused: List[Violation] = []
+    if not rules:
+        for f in files:
+            for line, names in sorted(f.suppressions.items()):
+                for rule in sorted(names):
+                    if (f.relpath, line, rule) not in hit:
+                        unused.append(Violation(
+                            relpath=f.relpath, line=line,
+                            rule="unused-suppression",
+                            message=(
+                                f"suppression 'lint: disable={rule}' no "
+                                "longer matches a finding on this line — "
+                                "delete the comment"
+                            ),
+                        ))
+    return RunResult(
+        violations=kept, suppressed=suppressed, baselined=baselined,
+        unused_suppressions=unused,
+    )

@@ -1,0 +1,70 @@
+"""irlint — kernel contracts: static analysis over the LOWERED IR.
+
+The AST passes (analysis/*.py, rule ids without a prefix) read source
+text; this package is the second backend. It stages every compiled
+program the solver can mint — solve x {S,M,L,XL} x screen modes,
+prescreen, refresh, replan, segmented partition/lane, the GSPMD mesh
+variant — through the PURE builder seams (tpu_solver.stage_family_programs,
+no cache entries, no proghealth mints), then checks each program's
+jaxpr (and, for the mesh family, post-SPMD compiled HLO) against the
+declarative per-family contracts in contracts.py (rule ids `ir-*`).
+
+Violations are ordinary `Violation`s anchored at the contract's
+declaration line in contracts.py, so the whole kept/suppressed/baselined
+pipeline — per-line disable comments naming an ir-* rule, the baseline
+file, --rule filtering, SARIF output — applies unchanged. Entry points:
+
+  * `hack/lint.py --ir` (`make irlint`) — the CLI sweep; needs jax, runs
+    on CPU with a forced 8-device host platform for the mesh family;
+  * `IRContractsPass` — the Pass-shaped wrapper the driver invokes; NOT
+    registered in analysis.all_passes() (plain `make lint` must not pay
+    a jax startup);
+  * engine walkers (scan_dot_output_dims, collective_counts, ...) —
+    imported directly by tests/test_perf_floor.py and friends, so test
+    tripwires and CI contracts share one spelling of every predicate.
+
+Layering note: this subpackage imports jax and the solver at FUNCTION
+scope only (families.py / engine.ProgramIR), which the layering pass
+exempts — `analysis` stays a module-scope leaf.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from karpenter_core_tpu.analysis.core import Pass, SourceFile, Violation
+
+
+class IRContractsPass(Pass):
+    """Pass-shaped wrapper over the staged-program contract sweep. scope
+    is "fileset": one run stages the whole family and evaluates every
+    contract (per-file parallelism is meaningless here — the unit of
+    work is a staged program, not a source file)."""
+
+    name = "irlint"
+    scope = "fileset"
+
+    def __init__(self, tiers: Optional[Sequence[str]] = None,
+                 families: Optional[Sequence[str]] = None,
+                 compile_level: bool = True):
+        self.tiers = tuple(tiers) if tiers is not None else None
+        self.families = tuple(families) if families is not None else None
+        self.compile_level = compile_level
+
+    @property
+    def rules(self):  # type: ignore[override]
+        from karpenter_core_tpu.analysis.irlint import contracts
+
+        return contracts.rule_ids()
+
+    def run(self, files: Sequence[SourceFile], config) -> List[Violation]:
+        # `files` is the AST corpus — unused: the inputs here are staged
+        # programs. The signature stays Pass-shaped so the driver's
+        # filter_findings tail (suppressions, baseline, sorting) applies.
+        del files, config
+        from karpenter_core_tpu.analysis.irlint import engine, families
+
+        programs, extra_ctx = families.stage_all(
+            tiers=self.tiers, families=self.families,
+            compile_level=self.compile_level,
+        )
+        return engine.evaluate(programs, extra_ctx=extra_ctx)

@@ -1,0 +1,311 @@
+"""The per-family IR contract catalog — contracts are DATA.
+
+Each entry binds a rule id to a predicate over one staged program's
+jaxpr/HLO (engine.ProgramIR) plus the applicability filter (families,
+screen modes, mesh-ness, compile level). Violations anchor at the
+`@contract` declaration line in THIS file, so the standard
+`relpath:line:rule` suppression and baseline grammar covers IR findings
+without any new machinery — a per-line disable comment naming the ir-*
+rule beside a contract mutes it exactly like an AST rule.
+
+Budgets live here, once: the structural tripwires in
+tests/test_perf_floor.py assert through the same predicates
+(engine.check_family_counts / off_ladder_axes / scan_dot_output_dims), so
+a budget can only change by editing this catalog. docs/static-analysis.md
+carries the human-readable table; `how to add a contract` is documented
+there — in short: declare it here with `@contract`, give it a rule id
+starting with `ir-`, and the driver, suppression grammar, docs registry
+test, and `--rule` filtering all pick it up from CONTRACTS.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Tuple
+
+from karpenter_core_tpu.analysis.irlint import engine
+
+RELPATH = "karpenter_core_tpu/analysis/irlint/contracts.py"
+
+# -- budget tables (the one spelling) ---------------------------------------
+
+# mesh solve FLOAT-collective inventory (docs/sharding.md: one all_gather
+# seam per precompute reassembly; the scan runs replicated; no contraction
+# axis is ever split, so no float reduction may cross the mesh — float
+# re-association is exactly what would break the byte-identity guarantee
+# tests/test_sharded.py asserts). The budget counts collectives whose
+# result dtype is floating (engine.FLOAT_DTYPES): the SPMD partitioner
+# also mints small pred/u8 bookkeeping collectives that are
+# backend-dependent noise, bitwise-safe, and NOT budgeted.
+MESH_COLLECTIVE_BUDGET = {
+    "all-gather": 2,
+    "all-reduce": 0,
+    "reduce-scatter": 0,
+}
+
+# compiled programs one staging may mint per (tier, screen-mode) —
+# the same ceilings the live-cache tripwires enforce: a solve entry is
+# the (solve, prescreen) pair, refresh warms one (8,8) budget, replan one
+# K bucket, segment the partitioner + one lane program.
+PER_TIER_PROGRAM_BUDGET = {
+    "solve": 1,
+    "prescreen": 1,
+    "refresh": 1,
+    "replan": 1,
+    "segment": 2,
+}
+
+
+@dataclass(frozen=True)
+class Contract:
+    rule: str
+    doc: str
+    check: Callable
+    line: int
+    families: Optional[frozenset] = None   # None = every family
+    modes: Optional[frozenset] = None      # None = every screen mode
+    mesh: Optional[bool] = None            # None = mesh and single alike
+    compile_level: bool = False            # needs compiled HLO (tier-S only)
+    whole_family: bool = False             # check(all_programs, extra) form
+
+    def applies(self, prog: "engine.ProgramIR") -> bool:
+        if self.families is not None and prog.family not in self.families:
+            return False
+        if self.modes is not None and prog.ctx.screen_mode not in self.modes:
+            return False
+        if self.mesh is not None and prog.ctx.mesh != self.mesh:
+            return False
+        if self.compile_level and not prog.ctx.compile_level:
+            return False
+        return True
+
+
+CONTRACTS: List[Contract] = []
+
+
+def contract(rule: str, doc: str, families=None, modes=None, mesh=None,
+             compile_level: bool = False, whole_family: bool = False):
+    """Register a contract; the decorated predicate's source line is the
+    violation anchor (suppressions/baseline key on it)."""
+
+    def register(fn: Callable) -> Callable:
+        CONTRACTS.append(Contract(
+            rule=rule, doc=doc, check=fn,
+            line=fn.__code__.co_firstlineno,
+            families=frozenset(families) if families else None,
+            modes=frozenset(modes) if modes else None,
+            mesh=mesh, compile_level=compile_level,
+            whole_family=whole_family,
+        ))
+        return fn
+
+    return register
+
+
+def rule_ids() -> Tuple[str, ...]:
+    """Every ir-* rule id, sorted — the docs/registry cross-check and the
+    --rule filter read the catalog through this."""
+    return tuple(sorted({c.rule for c in CONTRACTS}))
+
+
+# -- the catalog ------------------------------------------------------------
+
+
+@contract(
+    "ir-host-callback",
+    "no host round-trips (pure/io/debug callbacks) in any traced body",
+)
+def no_host_callbacks(prog, ctx) -> List[str]:
+    hits = engine.host_callback_prims(prog.jaxpr())
+    if hits:
+        return [f"host round-trip primitives in traced body: {sorted(hits)}"]
+    return []
+
+
+@contract(
+    "ir-scan-dot",
+    "prescreen scan body has no dot_general producing an N-sized axis "
+    "(the slot screen must stay OUT of the sequential loop); tiered is "
+    "the positive control proving the predicate still detects it",
+    families=("solve",),
+)
+def scan_dot_budget(prog, ctx) -> List[str]:
+    if not ctx.n_unique:
+        # N collides with another static dim: 'an N-sized output axis'
+        # would be ambiguous, so the predicate proves nothing — skip
+        # (families.py stages a dedicated N-unique geometry for this)
+        return []
+    if ctx.backend != "mxu":
+        # the CPU-default 'sliced' screen is a per-key loop with no
+        # dot_general — the predicate would be vacuous either way
+        return []
+    N = prog.ctx.geom[7]
+    dims = engine.scan_dot_output_dims(prog.jaxpr())
+    if ctx.screen_mode == "prescreen":
+        if N in dims:
+            return [
+                f"scan body contains dot_general producing an N={N}-sized "
+                f"axis — the full-width slot screen re-grew into the "
+                f"sequential loop (dot output dims inside the scan: "
+                f"{sorted(dims)})"
+            ]
+    else:
+        if N not in dims:
+            return [
+                f"positive control lost: the tiered scan body shows no "
+                f"N={N}-wide contraction, so the prescreen predicate can "
+                f"no longer detect a regression"
+            ]
+    return []
+
+
+@contract(
+    "ir-collectives",
+    "mesh solve float-collective inventory: <=2 float all-gathers (one "
+    "precompute reassembly seam each), 0 float all-reduces / "
+    "reduce-scatters (no contraction axis is split — float "
+    "re-association would break mesh byte-identity)",
+    families=("solve", "prescreen"),
+    mesh=True,
+    compile_level=True,
+)
+def collective_budget(prog, ctx) -> List[str]:
+    text = prog.compiled_text()
+    float_counts = engine.collective_counts(text, dtypes=engine.FLOAT_DTYPES)
+    out = []
+    for op, cap in sorted(MESH_COLLECTIVE_BUDGET.items()):
+        n = float_counts.get(op, 0)
+        if n > cap:
+            out.append(
+                f"compiled HLO contains {n} float-dtype {op} ops > budget "
+                f"{cap} (float inventory: {float_counts}; all dtypes: "
+                f"{engine.collective_counts(text)})"
+            )
+    return out
+
+
+@contract(
+    "ir-mesh-fence",
+    "mesh programs carry their SpecLayout replication fence "
+    "(sharding_constraint present) — without it the program compiles as "
+    "a plain single-device trace and the mesh buys nothing",
+    families=("solve", "prescreen", "segment"),
+    mesh=True,
+)
+def mesh_fence(prog, ctx) -> List[str]:
+    prims = engine.primitive_names(prog.jaxpr())
+    if "sharding_constraint" not in prims:
+        return [
+            "no sharding_constraint in the traced body — the SpecLayout "
+            "fence is gone"
+        ]
+    return []
+
+
+@contract(
+    "ir-single-clean",
+    "single-device programs carry NO sharding constraints — layout "
+    "plumbing must not leak mesh ops into the plain program family",
+    families=("solve",),
+    mesh=False,
+)
+def single_device_clean(prog, ctx) -> List[str]:
+    prims = engine.primitive_names(prog.jaxpr())
+    if "sharding_constraint" in prims:
+        return [
+            "sharding_constraint in a single-device program — layout "
+            "plumbing leaked into the plain family"
+        ]
+    return []
+
+
+@contract(
+    "ir-donation",
+    "every declared donated buffer matches an output aval (shape+dtype) "
+    "— a donation no output can alias is a silent copy",
+)
+def donation_honored(prog, ctx) -> List[str]:
+    nums = tuple(getattr(prog.record, "donate_argnums", ()) or ())
+    if not nums or not ctx.donate:
+        return []
+    return engine.donation_holes(prog.jaxpr(), nums)
+
+
+@contract(
+    "ir-ladder",
+    "every staged geometry's solve-shaping axes are LISTED bucket-ladder "
+    "tier values — an off-ladder axis means unbounded program minting",
+)
+def ladder_axes(prog, ctx) -> List[str]:
+    if not ctx.ladder or ctx.geom is None:
+        return []
+    if ctx.tier == "tripwire":
+        return []  # the N-unique geometry is deliberately off-ladder
+    return engine.off_ladder_axes(ctx.geom, ctx.ladder)
+
+
+@contract(
+    "ir-segment-scan",
+    "the segmented lane program's pack scan runs over the SEGMENT bucket "
+    "M, never the full item axis P — the partition's whole point",
+    families=("segment",),
+)
+def segment_scan_length(prog, ctx) -> List[str]:
+    if "lane" not in prog.name:
+        return []  # the partitioner has no pack scan
+    P = ctx.geom[0]
+    _s, m_pad = ctx.segment_shape
+    if m_pad == P:
+        return []  # ambiguous staging; families.py picks M != P
+    lengths = [n for n in engine.scan_lengths(prog.jaxpr()) if n is not None]
+    if not lengths:
+        return ["segmented lane program lost its pack scan"]
+    out = []
+    if m_pad not in lengths:
+        out.append(
+            f"pack scan lengths {sorted(set(lengths))} do not include the "
+            f"segment bucket {m_pad}"
+        )
+    if P in lengths:
+        out.append(
+            f"a scan still runs over the full item axis {P} — the "
+            f"sequential wall did not shrink to the segment bucket"
+        )
+    return out
+
+
+@contract(
+    "ir-program-count",
+    "per-family compiled-program count ceilings: one staging mints at "
+    "most the budget table's programs per (tier, screen-mode) — more "
+    "means a builder re-minting behind the cache's back",
+    whole_family=True,
+)
+def program_count_ceilings(programs, extra) -> List[str]:
+    stagings = {}
+    for prog in programs:
+        key = (prog.ctx.tier, prog.ctx.screen_mode, prog.ctx.mesh)
+        fam = stagings.setdefault(key, {})
+        fam[prog.family] = fam.get(prog.family, 0) + 1
+    out: List[str] = []
+    for key, counts in sorted(stagings.items()):
+        for msg in engine.check_family_counts(
+            counts, PER_TIER_PROGRAM_BUDGET
+        ):
+            tier, mode, mesh = key
+            where = f"tier={tier},mode={mode}" + (",mesh" if mesh else "")
+            out.append(f"[{where}] {msg}")
+    minted = (extra or {}).get("minted_during_staging")
+    if minted:
+        # cross-check against the PR 18 ProgramLedger: families.stage_all
+        # snapshots the process ledger's family totals before and after
+        # staging and passes the mint DELTA here. Staging goes through the
+        # pure _build_* seams, so ANY mint recorded while staging means an
+        # introspection path leaked into the live cache.
+        for fam, n in sorted(minted.items()):
+            if n > 0:
+                out.append(
+                    f"ProgramLedger recorded {n} '{fam}' mint(s) DURING "
+                    f"staging — the introspection seam created live cache "
+                    f"entries (the _build_* builders must stay pure)"
+                )
+    return out

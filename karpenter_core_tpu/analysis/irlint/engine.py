@@ -1,0 +1,293 @@
+"""jaxpr/HLO walkers + the contract evaluator.
+
+One spelling of every IR predicate: the walkers here serve BOTH the
+`hack/lint.py --ir` contract sweep and the structural tripwires in
+tests/test_perf_floor.py / tests/test_sharded.py — a budget asserted in a
+test and the same budget checked in CI lint can never drift apart, because
+they are the same function.
+
+The walkers take already-traced jaxprs (or HLO text) and use only
+duck-typed attributes (`eqn.primitive.name`, `eqn.params`, `var.aval`), so
+this module imports neither jax nor the solver — `hack/lint.py` can import
+the catalog without paying the jax startup, and only `--ir` (which stages
+real programs via families.py) needs a device runtime.
+"""
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Dict, Iterable, Iterator, List, Optional, Sequence, Set, Tuple
+
+from karpenter_core_tpu.analysis.core import Violation
+
+# host round-trips a jitted program can express. device_put eqns are NOT
+# in this set — inside a jitted body they are on-device constant
+# placement (how jnp.asarray of closure constants lowers), not a host
+# transfer (tests/test_sharded.py documented this first).
+HOST_CALLBACK_PRIMS = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "callback",
+    "host_callback", "outside_call",
+})
+
+# post-SPMD-partitioning collective INSTRUCTION DEFINITIONS in compiled
+# HLO text: `%name = dtype[shape]... op(...)`. Matching the definition
+# (result dtype + op + open paren) rather than any textual mention keeps
+# computation names, metadata strings, and the async -done halves out of
+# the count (-start forms match; their -done partners end in `-done(` so
+# the trailing `\(` rejects them).
+_HLO_COLLECTIVE_RE = re.compile(
+    r"=\s*\(?\s*([a-z][a-z0-9]*)\[[^\]]*\][^=\n]*?"
+    r"\b(all-gather|all-reduce|all-to-all|collective-permute|"
+    r"reduce-scatter)(?:-start)?\("
+)
+
+# dtypes where cross-replica reduction/reassembly re-associates floating
+# point — the byte-identity hazard the mesh collective budget guards
+FLOAT_DTYPES = frozenset({"f8", "f16", "bf16", "f32", "f64", "c64", "c128"})
+
+
+def _as_jaxpr(jx):
+    """Accept a ClosedJaxpr, a Jaxpr, or anything with `.jaxpr`."""
+    return getattr(jx, "jaxpr", jx)
+
+
+def subjaxprs(eqn) -> Iterator:
+    """Sub-jaxprs an equation closes over (scan/while/cond bodies, pjit
+    calls), in params order."""
+    for v in eqn.params.values():
+        if hasattr(v, "jaxpr"):  # ClosedJaxpr
+            yield v.jaxpr
+        elif isinstance(v, (list, tuple)):
+            for item in v:
+                if hasattr(item, "jaxpr"):
+                    yield item.jaxpr
+
+
+def walk_eqns(jx) -> Iterator:
+    """Every equation in the jaxpr, recursively — tracing a jit object
+    yields an outer jaxpr whose single pjit eqn wraps the body, so any
+    non-recursive walk would see nothing."""
+    jx = _as_jaxpr(jx)
+    for eqn in jx.eqns:
+        yield eqn
+        for sub in subjaxprs(eqn):
+            yield from walk_eqns(sub)
+
+
+def primitive_names(jx) -> Set[str]:
+    return {eqn.primitive.name for eqn in walk_eqns(jx)}
+
+
+def host_callback_prims(jx) -> Set[str]:
+    return primitive_names(jx) & HOST_CALLBACK_PRIMS
+
+
+def scan_eqns(jx) -> Iterator:
+    for eqn in walk_eqns(jx):
+        if eqn.primitive.name == "scan":
+            yield eqn
+
+
+def scan_lengths(jx) -> List[Optional[int]]:
+    """`length` param of every scan in the program, outermost first."""
+    return [eqn.params.get("length") for eqn in scan_eqns(jx)]
+
+
+def scan_dot_output_dims(jx) -> Set[int]:
+    """Output dims of every dot_general anywhere INSIDE a scan body
+    (incl. nested while/cond branches) — the predicate behind the
+    prescreen tripwire: an N-sized dim here means the full-width slot
+    screen re-grew into the sequential loop."""
+    dims: Set[int] = set()
+    for eqn in scan_eqns(jx):
+        for sub in subjaxprs(eqn):
+            for inner in walk_eqns(sub):
+                if inner.primitive.name == "dot_general":
+                    for var in inner.outvars:
+                        dims.update(var.aval.shape)
+    return dims
+
+
+def collective_counts(hlo_text: str,
+                      dtypes: Optional[frozenset] = None) -> Dict[str, int]:
+    """Collective-op inventory of compiled (post-SPMD) HLO text: counts
+    instruction definitions (async -start forms count once; -done halves
+    never). `dtypes` restricts to instructions whose result dtype (first
+    tuple element for async pairs) is in the set — pass FLOAT_DTYPES for
+    the re-association-hazard subset the mesh budget caps. The SPMD
+    partitioner freely mints small pred/u8 bookkeeping collectives, so an
+    unrestricted count is backend noise; the float subset is the
+    program's real collective surface."""
+    counts: Dict[str, int] = {}
+    for m in _HLO_COLLECTIVE_RE.finditer(hlo_text):
+        dtype, op = m.group(1), m.group(2)
+        if dtypes is not None and dtype not in dtypes:
+            continue
+        counts[op] = counts.get(op, 0) + 1
+    return counts
+
+
+def donation_holes(jx, donate_argnums: Sequence[int]) -> List[str]:
+    """Donated inputs that no output can possibly reuse — aval (shape,
+    dtype) of each donated invar must match some outvar's, or XLA cannot
+    alias it and the donation silently copies. Necessary-condition check
+    at the jaxpr level (the positive signal, `tf.aliasing_output` in the
+    lowered module, is backend-dependent); assumes each top-level arg is
+    a single leaf, which holds for every program in the solver family
+    (the bundle is one packed array, donated planes are arrays)."""
+    jx = _as_jaxpr(jx)
+    out_avals = [(tuple(v.aval.shape), str(v.aval.dtype)) for v in jx.outvars]
+    holes: List[str] = []
+    for pos in donate_argnums:
+        if pos >= len(jx.invars):
+            holes.append(f"donate_argnums position {pos} out of range")
+            continue
+        aval = jx.invars[pos].aval
+        sig = (tuple(aval.shape), str(aval.dtype))
+        if sig not in out_avals:
+            holes.append(
+                f"donated arg {pos} {sig[1]}{list(sig[0])} matches no "
+                "output buffer — the donation is a silent copy"
+            )
+    return holes
+
+
+def off_ladder_axes(geom, ladder) -> List[str]:
+    """Solve-shaping axes of a geometry that are NOT listed tier values —
+    the same membership test test_perf_floor.py's churn tripwire applies
+    to live cache keys (geom[0]=items, geom[2]=types, geom[3]=existing;
+    a zero existing axis is the no-nodes case, always legal)."""
+    item_values = {t.items for t in ladder}
+    type_values = {t.instance_types for t in ladder}
+    exist_values = {t.existing_nodes for t in ladder} | {0}
+    bad: List[str] = []
+    if geom[0] not in item_values:
+        bad.append(f"item axis {geom[0]} off-ladder (allowed {sorted(item_values)})")
+    if geom[2] not in type_values:
+        bad.append(f"type axis {geom[2]} off-ladder (allowed {sorted(type_values)})")
+    if geom[3] not in exist_values:
+        bad.append(
+            f"existing axis {geom[3]} off-ladder (allowed {sorted(exist_values)})"
+        )
+    return bad
+
+
+def check_family_counts(counts: Dict[str, int],
+                        budgets: Dict[str, int]) -> List[str]:
+    """Per-family program-count ceilings: `counts` (family -> programs
+    minted) against `budgets` (family -> ceiling). One spelling for the
+    live-cache tripwires AND the staged-ledger cross-check."""
+    over: List[str] = []
+    for family, n in sorted(counts.items()):
+        cap = budgets.get(family)
+        if cap is not None and n > cap:
+            over.append(
+                f"family '{family}' minted {n} programs > ceiling {cap}"
+            )
+    return over
+
+
+# -- staged-program handle --------------------------------------------------
+
+
+@dataclass
+class ProgramIR:
+    """One staged program + lazily-computed IR views. Wraps a
+    tpu_solver.FamilyProgram (`record`) with the staging context the
+    contracts key on; jaxpr/lowering/compile happen at most once each."""
+
+    record: object              # tpu_solver.FamilyProgram
+    ctx: "StagingContext"
+    _jaxpr: object = None
+    _lowered: object = None
+    _compiled: object = None
+
+    @property
+    def name(self) -> str:
+        return self.record.name
+
+    @property
+    def family(self) -> str:
+        return self.record.family
+
+    def jaxpr(self):
+        if self._jaxpr is None:
+            import jax
+
+            self._jaxpr = jax.make_jaxpr(self.record.fn)(
+                *self.record.example_args
+            ).jaxpr
+        return self._jaxpr
+
+    def lowered(self):
+        if self._lowered is None:
+            self._lowered = self.record.fn.lower(*self.record.example_args)
+        return self._lowered
+
+    def compiled_text(self) -> str:
+        """Post-SPMD compiled HLO text — pays the XLA compile (persistent
+        cache applies); only the compile-level contracts (collectives)
+        call this, and families.py stages them at tier S only."""
+        if self._compiled is None:
+            self._compiled = self.lowered().compile()
+        return self._compiled.as_text()
+
+
+@dataclass
+class StagingContext:
+    """What one staging pass knew when it built a program — the
+    per-family contract predicates key on these."""
+
+    tier: str                   # "S" | "M" | "L" | "XL" | "tripwire"
+    screen_mode: str            # "prescreen" | "tiered"
+    mesh: bool = False
+    backend: Optional[str] = None
+    geom: Optional[tuple] = None
+    ladder: tuple = ()
+    n_unique: bool = False      # N (geom[7]) unique among int geometry dims
+    segment_shape: Tuple[int, int] = (8, 16)
+    compile_level: bool = False  # compile-level contracts may run here
+    donate: bool = True
+
+    def label(self) -> str:
+        bits = [f"tier={self.tier}", f"mode={self.screen_mode}"]
+        if self.mesh:
+            bits.append("mesh")
+        if self.backend:
+            bits.append(self.backend)
+        return ",".join(bits)
+
+
+def evaluate(programs: Iterable[ProgramIR], contracts=None,
+             extra_ctx: Optional[dict] = None) -> List[Violation]:
+    """Run every applicable contract over every staged program.
+    Violations anchor at the contract's declaration line in contracts.py
+    so the standard `relpath:line:rule` suppression/baseline grammar
+    applies to IR findings unchanged."""
+    from karpenter_core_tpu.analysis.irlint import contracts as contracts_mod
+
+    if contracts is None:
+        contracts = contracts_mod.CONTRACTS
+    out: List[Violation] = []
+    programs = list(programs)
+    for c in contracts:
+        if c.whole_family:
+            msgs = c.check(programs, extra_ctx or {})
+            out.extend(
+                Violation(
+                    relpath=contracts_mod.RELPATH, line=c.line,
+                    rule=c.rule, message=m,
+                )
+                for m in msgs
+            )
+            continue
+        for prog in programs:
+            if not c.applies(prog):
+                continue
+            for m in c.check(prog, prog.ctx):
+                out.append(Violation(
+                    relpath=contracts_mod.RELPATH, line=c.line,
+                    rule=c.rule,
+                    message=f"{prog.name}[{prog.ctx.label()}]: {m}",
+                ))
+    return out

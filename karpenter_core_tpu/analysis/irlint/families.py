@@ -1,0 +1,234 @@
+"""Stage the whole compiled-program family for the contract sweep.
+
+This is the only irlint module that imports jax and the solver (function
+scope — the AST driver imports the catalog without paying for either).
+It mirrors the prewarm path end to end: a vocabulary-neutral synthetic
+workload per bucket-ladder tier (solver/prewarm.synthetic_workload),
+encoded against a fake instance-type universe, bundled through the live
+`_bundle_args` seam, then staged through the PURE builders
+(tpu_solver.stage_family_programs) — no LRU entry, no per-key lock, no
+proghealth mint. The ir-program-count contract cross-checks exactly that:
+stage_all snapshots the process ProgramLedger's family mint totals before
+and after staging and hands the delta to the contracts.
+
+Coverage (bounded so `make irlint` stays ~2 minutes warm):
+
+  * every ladder tier (S/M/L/XL) stages its full single-device family in
+    prescreen mode — jaxpr-level contracts only (tracing is cheap even at
+    XL; nothing compiles);
+  * tier S additionally stages: tiered mode (the prescreen-only
+    satellites drop, matching live dispatch); the GSPMD mesh variant on a
+    4x2 host-device mesh with compile-level contracts armed (the
+    collective budgets need post-SPMD HLO, and only tier S pays an XLA
+    compile — the shared persistent cache absorbs repeat runs);
+  * one off-ladder "tripwire" staging at backend="mxu" whose slot count
+    N is UNIQUE among array dims (the ir-scan-dot contract needs an
+    unambiguous N, and the CPU-default 'sliced' screen has no
+    dot_general) — staged in BOTH screen modes so the tiered program
+    doubles as the positive control.
+
+The mesh variant silently drops when fewer than 8 devices are visible
+(the driver and tests force XLA_FLAGS=--xla_force_host_platform_device_count=8
+before importing jax; a bare interpreter session may not).
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional
+
+from karpenter_core_tpu.analysis.irlint.engine import ProgramIR, StagingContext
+
+MESH_SHAPE = (4, 2)  # (dp, tp) — the test suite's canonical host mesh
+
+# families staged per variant; "segment" yields the partition + one lane
+DEFAULT_FAMILIES = ("prescreen", "solve", "refresh", "replan", "segment")
+
+# the lane/segment buckets the segmented lane program stages at — M=16
+# differs from every small-tier item bucket so the ir-segment-scan
+# membership test is unambiguous
+SEGMENT_SHAPE = (8, 16)
+
+
+def _mint_totals() -> Dict[str, int]:
+    from karpenter_core_tpu.obs import proghealth
+
+    snap = proghealth.LEDGER.snapshot() or {}
+    return {
+        fam: int(t.get("minted", 0))
+        for fam, t in (snap.get("totals") or {}).items()
+    }
+
+
+def _tier_workload(tier, max_nodes: int):
+    """(snap, geom-source) for one ladder tier: the prewarm synthetic
+    workload, sized at tier.items pods so encode time stays bounded while
+    the item/type/existing axes still land on the tier's rungs."""
+    from karpenter_core_tpu.cloudprovider import fake
+    from karpenter_core_tpu.solver.encode import encode_snapshot, resolve_ladder
+    from karpenter_core_tpu.solver.prewarm import synthetic_workload
+    from karpenter_core_tpu.testing import make_provisioner
+
+    ladder = resolve_ladder(None)
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": fake.instance_types(tier.instance_types)}
+    pods, nodes = synthetic_workload(
+        tier, provisioners, its, pods_count=tier.items
+    )
+    snap = encode_snapshot(
+        list(pods), provisioners, its, state_nodes=nodes,
+        max_nodes=max_nodes, ladder=ladder,
+    )
+    return snap, provisioners, ladder
+
+
+def _tripwire_workload(max_nodes: int = 48):
+    """The N-unique geometry (20 distinct pods, 5 types, 3 nodes,
+    max_nodes 48 -> N=56 colliding with no other int dim) — the same
+    geometry tests/test_perf_floor.py asserts the scan-dot tripwire on."""
+    from karpenter_core_tpu.cloudprovider import fake
+    from karpenter_core_tpu.solver.encode import encode_snapshot
+    from karpenter_core_tpu.state.node import StateNode
+    from karpenter_core_tpu.testing import make_node, make_pod, make_provisioner
+
+    universe = fake.instance_types(5)
+    pods = [
+        make_pod(labels={"app": f"t{i}"}, requests={"cpu": str(0.1 * (i + 1))})
+        for i in range(20)
+    ]
+    provisioners = [make_provisioner(name="default")]
+    its = {"default": universe}
+    nodes = []
+    for e in range(3):
+        it = universe[e % len(universe)]
+        nodes.append(StateNode(node=make_node(
+            name=f"irlint-trip-{e}",
+            labels={
+                "karpenter.sh/provisioner-name": "default",
+                "karpenter.sh/initialized": "true",
+                "node.kubernetes.io/instance-type": it.name,
+                "karpenter.sh/capacity-type": "on-demand",
+                "topology.kubernetes.io/zone": "test-zone-1",
+            },
+            capacity={k: str(v) for k, v in it.capacity.items()},
+        )))
+    snap = encode_snapshot(
+        pods, provisioners, its, None, nodes, max_nodes=max_nodes
+    )
+    return snap, provisioners
+
+
+def _stage_variant(snap, provisioners, *, tier: str, screen_mode: str,
+                   ladder=(), backend: Optional[str] = None,
+                   spec_layout=None, n_unique: bool = False,
+                   compile_level: bool = False,
+                   families: Optional[Iterable[str]] = None,
+                   max_nodes: int = 1024) -> List[ProgramIR]:
+    """Stage one (workload, screen-mode, layout, backend) variant through
+    the pure seams and wrap each program with its StagingContext."""
+    from karpenter_core_tpu.solver.tpu_solver import (
+        TPUSolver,
+        _bundle_args,
+        build_device_solve,
+        device_args,
+        stage_family_programs,
+    )
+
+    solver = TPUSolver(max_nodes=max_nodes, backend=backend,
+                       screen_mode=screen_mode)
+    geom, run = build_device_solve(
+        snap, max_nodes, backend=backend, screen_mode=screen_mode,
+        external_prescreen=True, spec_layout=spec_layout,
+    )
+    args = device_args(snap, provisioners)
+    staged = _bundle_args(
+        args, geom, run, backend, screen_mode, spec_layout=spec_layout
+    )
+    records = stage_family_programs(
+        staged, solver, screen_mode, families=families,
+        segment_shape=SEGMENT_SHAPE,
+    )
+    ctx = StagingContext(
+        tier=tier, screen_mode=screen_mode, mesh=spec_layout is not None,
+        backend=backend, geom=geom, ladder=tuple(ladder),
+        n_unique=n_unique, segment_shape=SEGMENT_SHAPE,
+        compile_level=compile_level, donate=solver.donate,
+    )
+    return [ProgramIR(record=r, ctx=ctx) for r in records]
+
+
+def _mesh_layout():
+    """SpecLayout over the canonical 4x2 host mesh, or None when the
+    interpreter wasn't started with 8 visible devices."""
+    import jax
+    import numpy as np
+
+    if len(jax.devices()) < MESH_SHAPE[0] * MESH_SHAPE[1]:
+        return None
+    from jax.sharding import Mesh
+
+    from karpenter_core_tpu.parallel.specs import SpecLayout
+
+    mesh = Mesh(
+        np.array(jax.devices()[: MESH_SHAPE[0] * MESH_SHAPE[1]]).reshape(
+            *MESH_SHAPE
+        ),
+        ("dp", "tp"),
+    )
+    return SpecLayout(mesh)
+
+
+def stage_all(tiers: Optional[Iterable[str]] = None,
+              families: Optional[Iterable[str]] = None,
+              compile_level: bool = True,
+              max_nodes: int = 1024):
+    """Stage the full program family. Returns (programs, extra_ctx) ready
+    for engine.evaluate. `tiers` filters ladder tiers by name (the
+    'tripwire' and mesh variants ride with tier S); `families` filters
+    program families; compile_level=False skips the post-SPMD compile
+    contracts (jaxpr-only sweep, fastest)."""
+    from karpenter_core_tpu.solver.encode import resolve_ladder
+
+    want_tiers = None if tiers is None else frozenset(tiers)
+    mints_before = _mint_totals()
+    ladder = resolve_ladder(None)
+    programs: List[ProgramIR] = []
+    for tier in ladder:
+        if want_tiers is not None and tier.name not in want_tiers:
+            continue
+        snap, provisioners, lad = _tier_workload(tier, max_nodes)
+        programs.extend(_stage_variant(
+            snap, provisioners, tier=tier.name, screen_mode="prescreen",
+            ladder=lad, families=families, max_nodes=max_nodes,
+        ))
+        if tier.name != "S":
+            continue
+        # tier S carries the variant axes: tiered mode (prescreen-only
+        # satellites drop), the GSPMD mesh family (compile-level), and
+        # the N-unique mxu tripwire in both screen modes
+        programs.extend(_stage_variant(
+            snap, provisioners, tier=tier.name, screen_mode="tiered",
+            ladder=lad, families=families, max_nodes=max_nodes,
+        ))
+        layout = _mesh_layout()
+        if layout is not None:
+            programs.extend(_stage_variant(
+                snap, provisioners, tier=tier.name,
+                screen_mode="prescreen", ladder=lad, spec_layout=layout,
+                compile_level=compile_level, families=families,
+                max_nodes=max_nodes,
+            ))
+        trip_snap, trip_prov = _tripwire_workload()
+        for mode in ("prescreen", "tiered"):
+            programs.extend(_stage_variant(
+                trip_snap, trip_prov, tier="tripwire", screen_mode=mode,
+                backend="mxu", n_unique=True,
+                families=("solve",) if families is None else families,
+                max_nodes=48,
+            ))
+    mints_after = _mint_totals()
+    delta = {
+        fam: n - mints_before.get(fam, 0)
+        for fam, n in mints_after.items()
+        if n - mints_before.get(fam, 0) > 0
+    }
+    extra_ctx = {"minted_during_staging": delta}
+    return programs, extra_ctx

@@ -2325,3 +2325,18 @@ def make_pack_kernel(
         return state, log, ptr
 
     return pack
+
+
+def kernel_factories():
+    """The kernel-factory registry, keyed by the compiled-program family
+    each factory's output dispatches under (obs/proghealth FAMILIES plus
+    the prescreen satellite) — the analysis/irlint catalog cross-checks
+    its per-family contracts against this so a new factory without a
+    contract fails loudly instead of shipping unchecked."""
+    return {
+        "prescreen": (make_prescreen_kernel,),
+        "refresh": (make_screen_refresh_kernel,),
+        "replan": (make_batched_replan_kernel, make_replan_verdict_kernel),
+        "segment": (make_segment_partition_kernel,),
+        "solve": (make_pack_kernel,),
+    }

@@ -1315,15 +1315,37 @@ class TPUSolver:
         slots rebuild as zero dummies that DCE away) and DONATES the
         previous verdict tensor so XLA updates the resident buffer in
         place."""
-        import jax
-        import jax.numpy as jnp
-
         rkey = (key, rb, cb)
         with self._cache_lock:
             fn = self._refresh_compiled.get(rkey)
             if fn is not None:
                 self._refresh_compiled.move_to_end(rkey)
                 return fn, False
+        fn = _Dispatchable(self._build_refresh(
+            geom, rb, cb, rebuild, donated_meta, spec_layout=spec_layout,
+        ))
+        evicted = []
+        with self._cache_lock:
+            self._refresh_compiled[rkey] = fn
+            while len(self._refresh_compiled) > self.MAX_REFRESH:
+                evicted.append(self._refresh_compiled.popitem(last=False)[0])
+        proghealth.record_mint(
+            "refresh", rkey,
+            meta=_prog_meta(geom, rb=rb, cb=cb),
+        )
+        for old in evicted:
+            proghealth.retire("refresh", old)
+        return fn, True
+
+    def _build_refresh(self, geom, rb, cb, rebuild, donated_meta,
+                       spec_layout=None):
+        """The raw refresh jit for one (geometry, row budget, col budget)
+        — no cache writes, no proghealth mints: the staging seam irlint
+        uses to lower the family without touching live state. _refresh_fn
+        wraps this with the LRU + mint accounting."""
+        import jax
+        import jax.numpy as jnp
+
         from karpenter_core_tpu.ops.pack import make_screen_refresh_kernel
 
         (_P, _J, _T, _E, _R, _K, _V, N_, segments_t, _zs, _cs, _tsig, _ll,
@@ -1342,19 +1364,7 @@ class TPUSolver:
                 row_idx, row_n, col_idx, col_n,
             )
 
-        fn = _Dispatchable(jax.jit(refresh_bundled, donate_argnums=(1,)))
-        evicted = []
-        with self._cache_lock:
-            self._refresh_compiled[rkey] = fn
-            while len(self._refresh_compiled) > self.MAX_REFRESH:
-                evicted.append(self._refresh_compiled.popitem(last=False)[0])
-        proghealth.record_mint(
-            "refresh", rkey,
-            meta=_prog_meta(geom, rb=rb, cb=cb),
-        )
-        for old in evicted:
-            proghealth.retire("refresh", old)
-        return fn, True
+        return jax.jit(refresh_bundled, donate_argnums=(1,))
 
     def _dispatch_prescreen(self, staged: _StagedCall, pre_fn,
                             host_pod_arrays, host_exist, bundle_dev,
@@ -1468,15 +1478,34 @@ class TPUSolver:
         the solve bundle + the verdict tensor; ops/pack.
         make_segment_partition_kernel), LRU-bounded in the scan-mode-keyed
         segment family; returns (fn, minted)."""
-        import jax
-        import jax.numpy as jnp
-
         rkey = (staged.key, "segmented", "partition")
         with self._cache_lock:
             fn = self._segment_compiled.get(rkey)
             if fn is not None:
                 self._segment_compiled.move_to_end(rkey)
                 return fn, False
+        fn = _Dispatchable(self._build_partition(staged, screen_mode))
+        evicted = []
+        with self._cache_lock:
+            fn = self._segment_compiled.setdefault(rkey, fn)
+            self._segment_compiled.move_to_end(rkey)
+            while len(self._segment_compiled) > self.MAX_SEGMENT:
+                evicted.append(self._segment_compiled.popitem(last=False)[0])
+        proghealth.record_mint(
+            "segment", rkey,
+            meta=_prog_meta(staged.geom, scan="segmented", role="partition"),
+        )
+        for old in evicted:
+            proghealth.retire("segment", old)
+        return fn, True
+
+    def _build_partition(self, staged: _StagedCall, screen_mode):
+        """The raw segment-partition jit for one staged call — no cache
+        writes, no proghealth mints (the irlint staging seam).
+        _partition_fn wraps this with the LRU + mint accounting."""
+        import jax
+        import jax.numpy as jnp
+
         from karpenter_core_tpu.ops.pack import make_segment_partition_kernel
 
         (_P, _J, _T, E, _R, _K, _V, _N, segments_t, _zs, _cs, _ts, _ll,
@@ -1496,20 +1525,7 @@ class TPUSolver:
                 named["well_known"],
             )
 
-        fn = _Dispatchable(jax.jit(part_bundled))
-        evicted = []
-        with self._cache_lock:
-            fn = self._segment_compiled.setdefault(rkey, fn)
-            self._segment_compiled.move_to_end(rkey)
-            while len(self._segment_compiled) > self.MAX_SEGMENT:
-                evicted.append(self._segment_compiled.popitem(last=False)[0])
-        proghealth.record_mint(
-            "segment", rkey,
-            meta=_prog_meta(staged.geom, scan="segmented", role="partition"),
-        )
-        for old in evicted:
-            proghealth.retire("segment", old)
-        return fn, True
+        return jax.jit(part_bundled)
 
     def _segment_fn(self, staged: _StagedCall, s_pad: int, m_pad: int,
                     screen_mode, frozen: bool = False):
@@ -1521,31 +1537,15 @@ class TPUSolver:
         constant instead of one mutable copy per lane and the refresh
         machinery compiles away. Never donates: the batched lane carries
         cannot alias the shared planes (same rule as the replan family)."""
-        import jax
-
         rkey = (staged.key, "segmented", s_pad, m_pad, bool(frozen))
         with self._cache_lock:
             fn = self._segment_compiled.get(rkey)
             if fn is not None:
                 self._segment_compiled.move_to_end(rkey)
                 return fn, False
-        (_P, _J, _T, _E, _R, _K, _V, N_, segments_t, zone_seg, ct_seg,
-         _ts, log_len, _Q, _W, _D, scr_v) = staged.geom
-        seg_run = make_device_run(
-            segments_t, zone_seg, ct_seg, None, N_, log_len=log_len,
-            backend=self.backend, screen_v=scr_v, screen_mode=screen_mode,
-            external_prescreen=True, spec_layout=staged.spec_layout,
-            segment_mode=True, seg_frozen=bool(frozen),
+        fn = _Dispatchable(
+            self._build_segment(staged, s_pad, m_pad, screen_mode, frozen)
         )
-        rebuild = staged.rebuild
-
-        def seg_bundled(item_sel, exist_open, screen0, bundle, *donated):
-            return seg_run(
-                item_sel, exist_open, screen0,
-                *rebuild(bundle, iter(donated)),
-            )
-
-        fn = _Dispatchable(jax.jit(seg_bundled))
         evicted = []
         with self._cache_lock:
             fn = self._segment_compiled.setdefault(rkey, fn)
@@ -1562,6 +1562,34 @@ class TPUSolver:
         for old in evicted:
             proghealth.retire("segment", old)
         return fn, True
+
+    def _build_segment(self, staged: _StagedCall, s_pad: int, m_pad: int,
+                       screen_mode, frozen: bool = False):
+        """The raw vmapped-lane jit for one (staged call, lane bucket,
+        segment bucket, frozen) — no cache writes, no proghealth mints
+        (the irlint staging seam). _segment_fn wraps this with the LRU +
+        mint accounting. s_pad/m_pad only key the cache; the traced
+        shapes come from the dispatch arguments."""
+        import jax
+
+        del s_pad, m_pad  # cache-key only; shapes arrive with the args
+        (_P, _J, _T, _E, _R, _K, _V, N_, segments_t, zone_seg, ct_seg,
+         _ts, log_len, _Q, _W, _D, scr_v) = staged.geom
+        seg_run = make_device_run(
+            segments_t, zone_seg, ct_seg, None, N_, log_len=log_len,
+            backend=self.backend, screen_v=scr_v, screen_mode=screen_mode,
+            external_prescreen=True, spec_layout=staged.spec_layout,
+            segment_mode=True, seg_frozen=bool(frozen),
+        )
+        rebuild = staged.rebuild
+
+        def seg_bundled(item_sel, exist_open, screen0, bundle, *donated):
+            return seg_run(
+                item_sel, exist_open, screen0,
+                *rebuild(bundle, iter(donated)),
+            )
+
+        return jax.jit(seg_bundled)
 
     def _try_segmented(self, snap: EncodedSnapshot, staged: _StagedCall,
                        geom, args, screen0, raw_args, layout, screen_mode,
@@ -2032,16 +2060,40 @@ class TPUSolver:
         program reads the same uploaded bundle as the solve/prescreen pair
         and never donates (the batched carry cannot alias the shared
         planes)."""
-        import jax
-
         rkey = (staged.key, k_pad)
         with self._cache_lock:
             fn = self._replan_compiled.get(rkey)
             if fn is not None:
                 self._replan_compiled.move_to_end(rkey)
                 return fn, False
+        fn = _Dispatchable(
+            self._build_replan(staged, k_pad, screen_mode, topo_meta)
+        )
+        evicted = []
+        with self._cache_lock:
+            fn = self._replan_compiled.setdefault(rkey, fn)
+            self._replan_compiled.move_to_end(rkey)
+            while len(self._replan_compiled) > self.MAX_REPLAN:
+                evicted.append(self._replan_compiled.popitem(last=False)[0])
+        proghealth.record_mint(
+            "replan", rkey,
+            meta=_prog_meta(staged.geom, k_bucket=k_pad),
+        )
+        for old in evicted:
+            proghealth.retire("replan", old)
+        return fn, True
+
+    def _build_replan(self, staged: _StagedCall, k_pad: int, screen_mode,
+                      topo_meta):
+        """The raw batched-replan jit for one (staged call, candidate-axis
+        bucket) — no cache writes, no proghealth mints (the irlint staging
+        seam). _replan_fn wraps this with the LRU + mint accounting. k_pad
+        only keys the cache; the traced K comes from the dispatch args."""
+        import jax
+
         from karpenter_core_tpu.ops.pack import make_batched_replan_kernel
 
+        del k_pad  # cache-key only; shapes arrive with the args
         (_P, _J, _T, E, _R, _K, _V, N_, segments_t, zone_seg, ct_seg,
          _tsig, log_len, _Q, _W, _D, scr_v) = staged.geom
         rung_run = make_device_run(
@@ -2062,20 +2114,7 @@ class TPUSolver:
                 *rebuild(bundle, iter(donated)),
             )
 
-        fn = _Dispatchable(jax.jit(replan_bundled))
-        evicted = []
-        with self._cache_lock:
-            fn = self._replan_compiled.setdefault(rkey, fn)
-            self._replan_compiled.move_to_end(rkey)
-            while len(self._replan_compiled) > self.MAX_REPLAN:
-                evicted.append(self._replan_compiled.popitem(last=False)[0])
-        proghealth.record_mint(
-            "replan", rkey,
-            meta=_prog_meta(staged.geom, k_bucket=k_pad),
-        )
-        for old in evicted:
-            proghealth.retire("replan", old)
-        return fn, True
+        return jax.jit(replan_bundled)
 
     def _prewarm_replan(self, staged: _StagedCall, pre_jit, topo_meta) -> None:
         """AOT-compile the batched consolidation replan program for this
@@ -2871,3 +2910,132 @@ class GreedySolver:
             # limits included) ride along for the FailedScheduling events
             errors=dict(res.errors),
         )
+
+
+# -- staged-program introspection (analysis/irlint) -------------------------
+
+
+@dataclass(frozen=True)
+class FamilyProgram:
+    """One lowerable program from the compiled-program family, staged
+    WITHOUT minting a live cache entry or a proghealth record: the jit
+    object plus the exact abstract example args the live/prewarm paths
+    would lower it with. `fn.lower(*example_args)` yields the jaxpr /
+    StableHLO the irlint contracts walk; `.compile()` on that yields the
+    post-SPMD HLO the collective budgets count."""
+
+    name: str            # unique within one staging, e.g. "refresh[8x8]"
+    family: str          # solve | prescreen | refresh | replan | segment
+    fn: object           # the un-dispatched jax.jit object
+    example_args: tuple  # ShapeDtypeStructs (bundle rides as concrete)
+    donate_argnums: tuple = ()
+
+
+def stage_family_programs(staged, solver, screen_mode, topo_meta=None,
+                          families=None, segment_shape=(8, 16)):
+    """Every program family the solver can mint for one staged call, as
+    pure jit objects + lowering args — the irlint staging seam. Mirrors
+    the live builders exactly (_build_entry / _build_refresh /
+    _build_replan / _build_partition / _build_segment) but touches no
+    LRU cache, no per-key lock, and no proghealth ledger: staging here
+    is free of side effects on a live solver.
+
+    `families` filters by family name ({"solve", "prescreen", "refresh",
+    "replan", "segment"}; "segment" covers both the partition and lane
+    programs). Prescreen-only satellites (prescreen, refresh, segment)
+    are skipped under tiered mode, matching the live dispatch paths.
+    `segment_shape` is the (lane bucket, segment bucket) the lane
+    program stages at."""
+    import jax
+
+    from karpenter_core_tpu.solver.encode import REPLAN_K_BUCKETS
+
+    want = None if families is None else frozenset(families)
+
+    def _want(family):
+        return want is None or family in want
+
+    records = []
+    fn, pre_fn = solver._build_entry(staged, screen_mode)
+    bundle_sds = jax.ShapeDtypeStruct(staged.bundle.shape,
+                                      staged.bundle.dtype)
+    donated_sds = tuple(
+        jax.ShapeDtypeStruct(s, d) for s, d in staged.donated_meta
+    )
+    n_donated = len(donated_sds)
+    screen_sds = None
+    if pre_fn is not None:
+        screen_sds = jax.eval_shape(pre_fn.jit, bundle_sds)
+        if _want("prescreen"):
+            records.append(FamilyProgram(
+                name="prescreen", family="prescreen", fn=pre_fn.jit,
+                example_args=(bundle_sds,),
+            ))
+    if _want("solve"):
+        if screen_sds is not None:
+            solve_args = (bundle_sds, screen_sds, *donated_sds)
+            donate = (
+                tuple(range(2, 2 + n_donated)) if solver.donate else ()
+            )
+        else:
+            solve_args = (bundle_sds, *donated_sds)
+            donate = (
+                tuple(range(1, 1 + n_donated)) if solver.donate else ()
+            )
+        records.append(FamilyProgram(
+            name="solve", family="solve", fn=fn.jit,
+            example_args=solve_args, donate_argnums=donate,
+        ))
+    if screen_sds is not None and _want("refresh"):
+        # the (8, 8) budget the prewarm path AOT-compiles
+        # (_prewarm_refresh): the steady-churn common case
+        refresh_jit = solver._build_refresh(
+            staged.geom, 8, 8, staged.rebuild, staged.donated_meta,
+            spec_layout=staged.spec_layout,
+        )
+        idx = np.zeros(8, np.int32)
+        records.append(FamilyProgram(
+            name="refresh[8x8]", family="refresh", fn=refresh_jit,
+            example_args=(bundle_sds, screen_sds, idx, 0, idx, 0),
+            donate_argnums=(1,),
+        ))
+    if _want("replan"):
+        # the smallest candidate-axis bucket, like _prewarm_replan; the
+        # mesh path stages replan off its own single-device twin so a
+        # spec_layout'd staged call skips it there, matching prewarm
+        if staged.spec_layout is None:
+            k = REPLAN_K_BUCKETS[0]
+            P, E = staged.geom[0], staged.geom[3]
+            replan_jit = solver._build_replan(
+                staged, k, screen_mode, topo_meta
+            )
+            records.append(FamilyProgram(
+                name="replan[k=%d]" % k, family="replan", fn=replan_jit,
+                example_args=(
+                    jax.ShapeDtypeStruct((k, P), np.int32),
+                    jax.ShapeDtypeStruct((k, E), np.bool_),
+                    jax.ShapeDtypeStruct((E,), np.bool_),
+                    screen_sds, bundle_sds, *donated_sds,
+                ),
+            ))
+    if screen_sds is not None and _want("segment"):
+        E = staged.geom[3]
+        part_jit = solver._build_partition(staged, screen_mode)
+        records.append(FamilyProgram(
+            name="segment-partition", family="segment", fn=part_jit,
+            example_args=(bundle_sds, screen_sds),
+        ))
+        s_pad, m_pad = segment_shape
+        seg_jit = solver._build_segment(
+            staged, s_pad, m_pad, screen_mode, frozen=False
+        )
+        records.append(FamilyProgram(
+            name="segment-lane[%dx%d]" % (s_pad, m_pad), family="segment",
+            fn=seg_jit,
+            example_args=(
+                jax.ShapeDtypeStruct((s_pad, m_pad), np.int32),
+                jax.ShapeDtypeStruct((s_pad, E), np.bool_),
+                screen_sds, bundle_sds, *donated_sds,
+            ),
+        ))
+    return records

@@ -1,0 +1,24 @@
+"""Seeded recompile-guard violations: raw len()-derived sizes reaching
+compile boundaries — each mints one program per distinct runtime size."""
+
+
+def direct_len_to_factory(pods):
+    n = len(pods)
+    return make_device_run(n, 8)
+
+
+def arithmetic_propagates(pods):
+    pad = len(pods) + 7
+    return make_prescreen_kernel(pad)
+
+
+def tuple_into_shape_struct(items, dtype):
+    return ShapeDtypeStruct((len(items), 4), dtype)
+
+
+def immediate_jit_dispatch(step, xs):
+    return jit(step)(xs, len(xs))
+
+
+def keyword_into_factory(xs):
+    return make_screen_refresh_kernel(budget=len(xs))

@@ -1,0 +1,32 @@
+"""Bucketed twins: every runtime size is laundered through a configured
+sanitizer (the geometry bucket funnels) before any compile boundary."""
+
+
+def padded_to_factory(pods):
+    n = ladder_pad(len(pods))
+    return make_device_run(n, 8)
+
+
+def pow2_into_shape_struct(items, dtype):
+    k = bucket_pow2(len(items))
+    return ShapeDtypeStruct((k, 4), dtype)
+
+
+def rebinding_clears_taint(pods):
+    n = len(pods)
+    n = 16
+    return make_device_run(n, 8)
+
+
+def jit_keywords_are_argument_positions(fn, bufs):
+    return jit(fn, donate_argnums=tuple(range(len(bufs))))
+
+
+def sanitized_immediate_dispatch(step, xs, pods):
+    k = replan_k_pad(len(pods))
+    return jit(step)(xs, k)
+
+
+def geometry_funnel_absorbs(pods):
+    geom = solve_geometry(len(pods), 8)
+    return make_device_run(geom, 8)

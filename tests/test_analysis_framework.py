@@ -10,6 +10,7 @@ from karpenter_core_tpu.analysis.core import (
     load_tree,
     parse_suppressions,
     run_passes,
+    run_passes_multiprocessing,
 )
 from karpenter_core_tpu.analysis.noprint import NoPrintPass
 
@@ -30,7 +31,7 @@ def test_registry_covers_the_documented_rule_set():
         "monotonic-time", "monotonic-time-default", "bare-except",
         "thread-discipline", "guarded-by", "guarded-by-v2", "no-print",
         "proc-group", "proc-kill-group", "thread-join", "atomic-write",
-        "metric-tenant-guard", "metric-label-keys",
+        "metric-tenant-guard", "metric-label-keys", "recompile-guard",
     }
 
 
@@ -153,6 +154,95 @@ def test_parallel_real_package_matches_sequential():
     par = run_passes(files, config, workers=4)
     assert [v.key() for v in seq.violations] == [v.key() for v in par.violations]
     assert [v.key() for v in seq.suppressed] == [v.key() for v in par.suppressed]
+
+
+def test_multiprocessing_matches_sequential_on_seeded_tree(tmp_path):
+    """ISSUE 19 satellite: the process-pool fan-out (`--jobs`) must be
+    byte-identical to the sequential run — kept, suppressed, AND
+    unused-suppression lists — on a tree seeded with multi-pass hits and
+    one live + one dead suppression."""
+    pkg = tmp_path / "karpenter_core_tpu"
+    (pkg / "solver").mkdir(parents=True)
+    (pkg / "__init__.py").write_text("")
+    (pkg / "solver" / "__init__.py").write_text("")
+    (pkg / "solver" / "a.py").write_text(
+        'import subprocess\nprint("leak")\n'
+        "def go(cmd):\n    return subprocess.Popen(cmd)\n"
+    )
+    (pkg / "solver" / "b.py").write_text(
+        'print("kept quiet")  # lint: disable=no-print\n'
+        "x = 1  # lint: disable=no-print\n"
+    )
+    config = default_config(str(tmp_path))
+    files = collect_sources(str(tmp_path), "karpenter_core_tpu")
+    seq = run_passes(files, config, workers=1)
+    par = run_passes_multiprocessing(files, config, jobs=2)
+    for attr in ("violations", "suppressed", "baselined",
+                 "unused_suppressions"):
+        assert [v.render() for v in getattr(seq, attr)] == [
+            v.render() for v in getattr(par, attr)
+        ], attr
+    assert len(seq.violations) >= 2  # no-print + proc-group in a.py
+    assert [v.line for v in seq.suppressed] == [1]
+    assert [v.line for v in seq.unused_suppressions] == [2]
+
+
+def test_multiprocessing_real_package_matches_sequential():
+    config = default_config(REPO_ROOT)
+    files = collect_sources(REPO_ROOT, config.package_name)
+    seq = run_passes(files, config, workers=1)
+    par = run_passes_multiprocessing(files, config, jobs=4)
+    assert [v.render() for v in seq.violations] == [
+        v.render() for v in par.violations
+    ]
+    assert [v.key() for v in seq.suppressed] == [v.key() for v in par.suppressed]
+    assert [v.key() for v in seq.unused_suppressions] == [
+        v.key() for v in par.unused_suppressions
+    ]
+
+
+def test_driver_jobs_output_identical_to_sequential():
+    """CLI-level twin of the byte-identity guarantee: `--jobs 4` and
+    `--jobs 1` (sequential) print the same report."""
+    seq = run_lint("--jobs", "1")
+    par = run_lint("--jobs", "4")
+    assert seq.returncode == par.returncode == 0, seq.stdout + par.stdout
+    assert seq.stdout == par.stdout
+
+
+def test_unused_suppression_is_warn_only(tmp_path):
+    """A `# lint: disable=` whose line no longer triggers the rule is
+    reported (rule id unused-suppression) but never counted as a
+    violation; a live suppression on the same file is not flagged."""
+    src = tmp_path / "dead.py"
+    src.write_text(
+        'print("hit")  # lint: disable=no-print\n'
+        "x = 1  # lint: disable=no-print\n"
+    )
+    sf = load_tree(str(src), "dead.py")
+    config = default_config(str(tmp_path))
+    result = run_passes([sf], config, passes=[NoPrintPass()])
+    assert result.violations == []
+    assert [v.line for v in result.suppressed] == [1]
+    assert [(v.line, v.rule) for v in result.unused_suppressions] == [
+        (2, "unused-suppression")
+    ]
+    assert "delete the comment" in result.unused_suppressions[0].message
+
+
+def test_unused_suppression_skipped_under_rule_filter(tmp_path):
+    """Under --rule only some passes ran, so a silent line proves nothing
+    — same reason a partial run must not --update-baseline."""
+    src = tmp_path / "dead.py"
+    src.write_text("x = 1  # lint: disable=trace-safety\n")
+    sf = load_tree(str(src), "dead.py")
+    config = default_config(str(tmp_path))
+    full = run_passes([sf], config, passes=[NoPrintPass()])
+    assert [v.rule for v in full.unused_suppressions] == ["unused-suppression"]
+    filtered = run_passes(
+        [sf], config, passes=[NoPrintPass()], rules={"no-print"}
+    )
+    assert filtered.unused_suppressions == []
 
 
 def test_driver_sarif_output_shape():

@@ -14,6 +14,7 @@ from karpenter_core_tpu.analysis.metriclabels import MetricLabelsPass
 from karpenter_core_tpu.analysis.montime import MonotonicTimePass
 from karpenter_core_tpu.analysis.noprint import NoPrintPass
 from karpenter_core_tpu.analysis.procdiscipline import ProcessDisciplinePass
+from karpenter_core_tpu.analysis.recompileguard import RecompileGuardPass
 from karpenter_core_tpu.analysis.trace_safety import TraceSafetyPass
 
 FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)), "analysis_fixtures")
@@ -380,6 +381,44 @@ def test_metric_labels_whole_package_is_clean():
         files, fixture_config(repo_root=root,
                               package_name="karpenter_core_tpu"),
         passes=[MetricLabelsPass()],
+    )
+    assert result.violations == [], [v.render() for v in result.violations]
+
+
+# -- recompile guard ------------------------------------------------------
+
+
+def test_recompileguard_catches_all_seeded_flavors():
+    violations, _ = run_one(RecompileGuardPass(), "recompileguard_bad.py")
+    rendered = [v.render() for v in violations]
+    # direct len, arithmetic propagation, tuple into ShapeDtypeStruct,
+    # immediate jit(f)(...) dispatch, keyword arg into a kernel factory
+    assert {v.line for v in violations} == {7, 12, 16, 20, 24}, rendered
+    assert all(v.rule == "recompile-guard" for v in violations)
+    assert all("bucketing" in v.message for v in violations)
+    assert any("jit(...)" in v.message for v in violations)
+
+
+def test_recompileguard_quiet_on_bucketed_twins():
+    """Sanitizer funnels (ladder_pad/bucket_pow2/...), rebinding a tainted
+    name, and jit's position-valued keywords all stay clean."""
+    violations, _ = run_one(RecompileGuardPass(), "recompileguard_good.py")
+    assert violations == [], [v.render() for v in violations]
+
+
+def test_recompileguard_whole_package_is_clean():
+    """Every real compile boundary in the package takes bucketed sizes —
+    the static twin of karpenter_bucket_overflow_total, enforced forever."""
+    import karpenter_core_tpu
+
+    root = os.path.dirname(
+        os.path.dirname(os.path.abspath(karpenter_core_tpu.__file__))
+    )
+    files = collect_sources(root, "karpenter_core_tpu")
+    result = run_passes(
+        files, fixture_config(repo_root=root,
+                              package_name="karpenter_core_tpu"),
+        passes=[RecompileGuardPass()],
     )
     assert result.violations == [], [v.render() for v in result.violations]
 

@@ -1,0 +1,231 @@
+"""IR contract sweep (analysis/irlint): walker units on hand-built
+jaxprs/HLO, the staged tier-S family evaluating clean, and the
+deliberately-broken-contract detection the sweep exists to provide —
+a tiered program mislabeled as prescreen must be caught with the family
+and the offending op named."""
+import os
+from dataclasses import replace
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from karpenter_core_tpu.analysis.irlint import (
+    IRContractsPass,
+    contracts,
+    engine,
+    families,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# -- engine walkers (no solver, no staging) -------------------------------
+
+
+def test_scan_lengths_and_dot_output_dims():
+    def prog(A, xs):
+        def body(c, x):
+            y = A @ x
+            return c + jnp.sum(y), y
+
+        return jax.lax.scan(body, 0.0, xs)
+
+    jx = jax.make_jaxpr(prog)(
+        jnp.zeros((7, 3), jnp.float32), jnp.zeros((5, 3), jnp.float32)
+    )
+    assert engine.scan_lengths(jx) == [5]
+    dims = engine.scan_dot_output_dims(jx)
+    assert 7 in dims  # the dot output axis INSIDE the scan body
+
+    def no_scan(x):
+        return x @ x.T
+
+    jx2 = jax.make_jaxpr(no_scan)(jnp.zeros((4, 2), jnp.float32))
+    assert engine.scan_lengths(jx2) == []
+    assert engine.scan_dot_output_dims(jx2) == set()  # dot outside any scan
+
+
+def test_host_callback_prims_detected():
+    def dirty(x):
+        y = jax.pure_callback(
+            lambda v: np.asarray(v),
+            jax.ShapeDtypeStruct(x.shape, x.dtype),
+            x,
+        )
+        return y + 1.0
+
+    hits = engine.host_callback_prims(
+        jax.make_jaxpr(dirty)(jnp.zeros((3,), jnp.float32))
+    )
+    assert hits == {"pure_callback"}
+
+    def clean(x):
+        return x + 1.0
+
+    assert engine.host_callback_prims(
+        jax.make_jaxpr(clean)(jnp.zeros((3,), jnp.float32))
+    ) == set()
+
+
+def test_collective_counts_on_synthetic_hlo():
+    """Instruction DEFINITIONS only: -start counts once, its -done half
+    never; computation names and tuples don't; the dtype filter keeps the
+    partitioner's pred/u8 bookkeeping out of the float budget."""
+    text = "\n".join([
+        "%ag.1 = f32[8,16]{1,0} all-gather(f32[1,16]{1,0} %p), dims={0}",
+        "%ags = f32[8,16] all-gather-start(f32[1,16] %p2)",
+        "%agd = f32[8,16] all-gather-done(f32[8,16] %ags)",
+        "%ar = pred[] all-reduce(pred[] %flag), to_apply=%or_reducer",
+        "%rs = bf16[4]{0} reduce-scatter(bf16[8]{0} %x), dimensions={0}",
+        "ROOT %t = (f32[8,16]) tuple(%agd)",
+    ])
+    assert engine.collective_counts(text) == {
+        "all-gather": 2, "all-reduce": 1, "reduce-scatter": 1,
+    }
+    assert engine.collective_counts(text, dtypes=engine.FLOAT_DTYPES) == {
+        "all-gather": 2, "reduce-scatter": 1,
+    }
+
+
+def test_donation_holes_matches_avals():
+    def f(a, b):
+        return a * 2.0, jnp.sum(b)
+
+    jx = jax.make_jaxpr(f)(
+        jnp.zeros((4,), jnp.float32), jnp.zeros((2, 2), jnp.float32)
+    )
+    assert engine.donation_holes(jx, (0,)) == []  # (4,) f32 output exists
+    holes = engine.donation_holes(jx, (1,))
+    assert len(holes) == 1 and "silent copy" in holes[0]
+    assert engine.donation_holes(jx, (5,)) == [
+        "donate_argnums position 5 out of range"
+    ]
+
+
+def test_off_ladder_axes_membership():
+    from karpenter_core_tpu.solver.encode import resolve_ladder
+
+    ladder = resolve_ladder(None)
+    t = ladder[0]
+    on = [t.items, None, t.instance_types, 0]  # 0 existing = no-nodes case
+    assert engine.off_ladder_axes(on, ladder) == []
+    off = [t.items + 1, None, t.instance_types, 7]
+    bad = engine.off_ladder_axes(off, ladder)
+    assert len(bad) == 2
+    assert "item axis" in bad[0] and "existing axis" in bad[1]
+
+
+def test_check_family_counts_ceilings():
+    budgets = {"solve": 1, "segment": 2}
+    assert engine.check_family_counts(
+        {"solve": 1, "segment": 2}, budgets
+    ) == []
+    over = engine.check_family_counts({"solve": 3, "unbudgeted": 9}, budgets)
+    assert over == ["family 'solve' minted 3 programs > ceiling 1"]
+
+
+# -- catalog shape ---------------------------------------------------------
+
+
+def test_rule_catalog_is_the_ir_rule_set():
+    assert contracts.rule_ids() == (
+        "ir-collectives", "ir-donation", "ir-host-callback", "ir-ladder",
+        "ir-mesh-fence", "ir-program-count", "ir-scan-dot",
+        "ir-segment-scan", "ir-single-clean",
+    )
+    assert tuple(IRContractsPass().rules) == contracts.rule_ids()
+
+
+def test_contract_anchor_lines_are_live():
+    """Every violation anchors at its contract's declaration in
+    contracts.py, so the relpath:line:rule suppression/baseline grammar
+    covers IR findings — a stale line would silently widen or miss a
+    suppression."""
+    path = os.path.join(REPO_ROOT, contracts.RELPATH)
+    with open(path, encoding="utf-8") as f:
+        lines = f.read().splitlines()
+    for c in contracts.CONTRACTS:
+        assert 1 <= c.line <= len(lines), c.rule
+        anchor = lines[c.line - 1].lstrip()
+        assert anchor.startswith(("@contract", "def ")), (c.rule, anchor)
+
+
+# -- the staged family -----------------------------------------------------
+
+
+def test_tier_s_family_stages_pure_and_evaluates_clean():
+    """Tier-S sweep at jaxpr level: the full single-device family, the
+    tiered variant, the mesh variant, and the mxu tripwire all stage
+    through the pure builders (empty ProgramLedger mint delta) and every
+    contract holds."""
+    programs, extra = families.stage_all(tiers=("S",), compile_level=False)
+    fams = {p.family for p in programs}
+    assert {"prescreen", "solve", "refresh", "replan", "segment"} <= fams
+    assert any(p.ctx.tier == "tripwire" for p in programs)
+    if len(jax.devices()) >= 8:
+        assert any(p.ctx.mesh for p in programs)
+    assert extra == {"minted_during_staging": {}}
+    violations = engine.evaluate(programs, extra_ctx=extra)
+    assert violations == [], "\n".join(v.render() for v in violations)
+
+
+def test_deliberately_broken_contract_names_family_and_op():
+    """The acceptance check: a tiered solve body (N-wide dot inside the
+    scan) presented as a prescreen program is exactly the regression
+    ir-scan-dot exists to catch — the violation names the family, the op,
+    and the N it re-grew to, and anchors at the contract declaration."""
+    snap, provisioners = families._tripwire_workload()
+    progs = families._stage_variant(
+        snap, provisioners, tier="tripwire", screen_mode="tiered",
+        backend="mxu", n_unique=True, families=("solve",), max_nodes=48,
+    )
+    solves = [p for p in progs if p.family == "solve"]
+    assert solves
+    broken = [
+        engine.ProgramIR(
+            record=p.record, ctx=replace(p.ctx, screen_mode="prescreen")
+        )
+        for p in solves
+    ]
+    hits = [v for v in engine.evaluate(broken) if v.rule == "ir-scan-dot"]
+    assert hits, "mislabeled tiered body must trip ir-scan-dot"
+    v = hits[0]
+    assert v.relpath == contracts.RELPATH
+    decl = next(c for c in contracts.CONTRACTS if c.rule == "ir-scan-dot")
+    assert v.line == decl.line
+    assert "solve" in v.message       # the family
+    assert "dot_general" in v.message  # the op
+    assert "N=56" in v.message        # the tripwire geometry's slot count
+
+
+def test_positive_control_loss_is_detected():
+    """The inverse break: a prescreen body (dot-free scan) relabeled as
+    tiered means the predicate could no longer detect a regression — the
+    contract's positive-control arm flags it."""
+    snap, provisioners = families._tripwire_workload()
+    progs = families._stage_variant(
+        snap, provisioners, tier="tripwire", screen_mode="prescreen",
+        backend="mxu", n_unique=True, families=("solve",), max_nodes=48,
+    )
+    broken = [
+        engine.ProgramIR(
+            record=p.record, ctx=replace(p.ctx, screen_mode="tiered")
+        )
+        for p in progs
+        if p.family == "solve"
+    ]
+    hits = [v for v in engine.evaluate(broken) if v.rule == "ir-scan-dot"]
+    assert hits
+    assert "positive control lost" in hits[0].message
+
+
+@pytest.mark.slow
+def test_compile_level_sweep_is_clean():
+    """The full `make irlint` semantics at tier S: mesh programs compile
+    (persistent cache applies) and the post-SPMD float-collective budget
+    holds."""
+    programs, extra = families.stage_all(tiers=("S",), compile_level=True)
+    violations = engine.evaluate(programs, extra_ctx=extra)
+    assert violations == [], "\n".join(v.render() for v in violations)

@@ -174,46 +174,21 @@ def _tripwire_snapshot():
     return snap, provisioners
 
 
-def _scan_dot_output_dims(run, args):
-    """Trace run's jaxpr, find the pack scan, and return the set of output
-    dims of every dot_general anywhere inside the scan body (incl. nested
-    while/cond branches)."""
-    import jax
-
-    jaxpr = jax.make_jaxpr(run)(*args).jaxpr
-
-    def subjaxprs(eqn):
-        for v in eqn.params.values():
-            if hasattr(v, "jaxpr"):  # ClosedJaxpr
-                yield v.jaxpr
-            elif isinstance(v, (list, tuple)):
-                for item in v:
-                    if hasattr(item, "jaxpr"):
-                        yield item.jaxpr
-
-    def collect_dots(jx, out):
-        for eqn in jx.eqns:
-            if eqn.primitive.name == "dot_general":
-                for var in eqn.outvars:
-                    out.update(var.aval.shape)
-            for sub in subjaxprs(eqn):
-                collect_dots(sub, out)
-
-    dims = set()
-    for eqn in jaxpr.eqns:
-        if eqn.primitive.name == "scan":
-            for sub in subjaxprs(eqn):
-                collect_dots(sub, dims)
-    return dims
-
-
 @pytest.mark.parametrize("mode", ["prescreen", "tiered"])
 def test_scan_body_screen_contraction_tripwire(mode):
     """The tentpole's whole point, asserted on the jaxpr: with the
     prescreen selected, the scan STEP must not contain the full-width slot
     screen contraction (no dot_general producing an N-sized axis — the
     screen left the loop body); the tiered fallback is the positive
-    control proving the predicate detects it."""
+    control proving the predicate detects it.
+
+    The predicate itself lives in analysis/irlint/engine.py
+    (scan_dot_output_dims) — the SAME function the ir-scan-dot contract
+    applies in `make irlint`, so this tripwire and the CI contract can
+    never drift apart."""
+    import jax
+
+    from karpenter_core_tpu.analysis.irlint import engine
     from karpenter_core_tpu.solver.tpu_solver import (
         build_device_solve,
         device_args,
@@ -232,7 +207,7 @@ def test_scan_body_screen_contraction_tripwire(mode):
         f"other dims {sorted(others)})"
     )
     args = device_args(snap, provisioners)
-    dims = _scan_dot_output_dims(run, args)
+    dims = engine.scan_dot_output_dims(jax.make_jaxpr(run)(*args))
     if mode == "prescreen":
         assert N not in dims, (
             f"prescreen scan body still contains an N={N}-wide screen "
@@ -249,7 +224,11 @@ def test_prescreen_compiled_program_guard():
     """The precompute must not blow up the bucketed compile cache: repeat
     solves in one geometry bucket share ONE cache entry holding exactly
     two programs (prescreen + solve), and the second solve is a cache
-    hit."""
+    hit. The ceiling is the irlint budget table (contracts.
+    PER_TIER_PROGRAM_BUDGET) applied through the same predicate the
+    ir-program-count contract uses — one spelling of the invariant."""
+    from karpenter_core_tpu.analysis.irlint import contracts, engine
+
     universe = fake.instance_types(5)
     provisioners = [make_provisioner(name="default")]
     its = {"default": universe}
@@ -262,9 +241,10 @@ def test_prescreen_compiled_program_guard():
         ]
         res = solver.solve(pods, provisioners, its)
         assert res.pod_count_new() + res.pod_count_existing() == n
-    assert len(solver._compiled) == 1, (
-        f"one geometry bucket minted {len(solver._compiled)} cache entries"
+    over = engine.check_family_counts(
+        {"solve": len(solver._compiled)}, contracts.PER_TIER_PROGRAM_BUDGET
     )
+    assert not over, over
     fn, pre_fn = next(iter(solver._compiled.values()))
     assert fn is not None and pre_fn is not None, (
         "prescreen entry must pair the solve program with its precompute"
@@ -276,7 +256,10 @@ def test_bucket_ladder_program_budget():
     crossing item-tier boundaries, node counts appearing and vanishing —
     must keep `compiled_programs` within 3x the configured bucket ladder,
     and every minted geometry's snapped axes must be LISTED tier values
-    (the ladder, not ad-hoc pow2, bounds the program set)."""
+    (the ladder, not ad-hoc pow2, bounds the program set). Ladder
+    membership is asserted through engine.off_ladder_axes — the predicate
+    behind the ir-ladder contract."""
+    from karpenter_core_tpu.analysis.irlint import engine
     from karpenter_core_tpu.solver.encode import resolve_ladder
     from karpenter_core_tpu.state.node import StateNode
     from karpenter_core_tpu.testing import make_node
@@ -317,19 +300,15 @@ def test_bucket_ladder_program_budget():
         res = solver.solve(pods, provisioners, its, state_nodes=nodes(n_nodes))
         assert res.pod_count_new() + res.pod_count_existing() == n_pods
 
-    budget = 3 * len(ladder)
-    assert len(solver._compiled) <= budget, (
-        f"mixed-geometry churn minted {len(solver._compiled)} compiled "
-        f"entries > 3 x {len(ladder)} configured buckets"
+    over = engine.check_family_counts(
+        {"solve": len(solver._compiled)}, {"solve": 3 * len(ladder)}
     )
-    item_values = {t.items for t in ladder}
-    type_values = {t.instance_types for t in ladder}
-    exist_values = {t.existing_nodes for t in ladder} | {0}
+    assert not over, (
+        f"mixed-geometry churn: {over} (3 x {len(ladder)} configured buckets)"
+    )
     for key in solver._compiled:
-        geom = key[0]
-        assert geom[0] in item_values, f"item axis {geom[0]} off-ladder"
-        assert geom[2] in type_values, f"type axis {geom[2]} off-ladder"
-        assert geom[3] in exist_values, f"existing axis {geom[3]} off-ladder"
+        bad = engine.off_ladder_axes(key[0], ladder)
+        assert not bad, bad
 
 
 def test_sharded_programs_respect_bucket_and_cache_budget():
@@ -413,9 +392,14 @@ def test_replan_program_family_budget():
     k_values = {k for (_key, k) in solver._replan_compiled}
     assert k_values == {8, 16}, f"off-ladder candidate-axis buckets: {k_values}"
     assert all(k in REPLAN_K_BUCKETS for k in k_values)
-    assert len(solver._replan_compiled) <= len(ladder) * len(REPLAN_K_BUCKETS), (
-        f"replan family minted {len(solver._replan_compiled)} programs > "
-        f"{len(ladder)} tiers x {len(REPLAN_K_BUCKETS)} K-buckets"
+    from karpenter_core_tpu.analysis.irlint import engine
+
+    over = engine.check_family_counts(
+        {"replan": len(solver._replan_compiled)},
+        {"replan": len(ladder) * len(REPLAN_K_BUCKETS)},
+    )
+    assert not over, (
+        f"{over} ({len(ladder)} tiers x {len(REPLAN_K_BUCKETS)} K-buckets)"
     )
     # the replan rode the solve path's staging: exactly ONE solve cache
     # entry (prescreen + never-dispatched solve program), same guard as
@@ -493,10 +477,16 @@ def test_scan_mode_compiled_program_budget():
         "the segmented dispatch must share the sequential solve entry "
         "(prescreen + fallback programs), not mint its own"
     )
-    assert len(seg._segment_compiled) == 2, (
-        f"one geometry bucket minted {len(seg._segment_compiled)} segment "
-        f"programs (expected partitioner + one lane program)"
+    from karpenter_core_tpu.analysis.irlint import contracts, engine
+
+    over = engine.check_family_counts(
+        {"segment": len(seg._segment_compiled)},
+        contracts.PER_TIER_PROGRAM_BUDGET,
     )
+    assert not over, (
+        f"{over} (expected partitioner + one lane program per bucket)"
+    )
+    assert len(seg._segment_compiled) == 2  # both programs actually minted
     for key in seg._segment_compiled:
         assert key[1] == "segmented", f"segment key missing scan mode: {key}"
 
@@ -505,10 +495,13 @@ def test_segmented_scan_length_is_segment_bucket():
     """ISSUE 14 structural tripwire: the vmapped lane program's pack scan
     must run over the SEGMENT bucket M, not the item axis I — the whole
     point of the partition is that the sequential wall shrinks to the
-    largest segment. Asserted on the jaxpr's scan length."""
+    largest segment. Asserted on the jaxpr's scan lengths via
+    engine.scan_lengths — the predicate behind the ir-segment-scan
+    contract."""
     import jax
     import numpy as np
 
+    from karpenter_core_tpu.analysis.irlint import engine
     from karpenter_core_tpu.solver.tpu_solver import (
         build_device_solve,
         device_args,
@@ -535,21 +528,7 @@ def test_segmented_scan_length_is_segment_bucket():
     exist_open = jax.ShapeDtypeStruct((S, E), np.bool_)
     screen0 = jax.ShapeDtypeStruct((N, C), np.bool_)
     jaxpr = jax.make_jaxpr(seg_run)(item_sel, exist_open, screen0, *args)
-
-    def scan_lengths(jx, out):
-        for eqn in jx.eqns:
-            if eqn.primitive.name == "scan":
-                out.append(eqn.params.get("length"))
-            for v in eqn.params.values():
-                if hasattr(v, "jaxpr"):
-                    scan_lengths(v.jaxpr, out)
-                elif isinstance(v, (list, tuple)):
-                    for item in v:
-                        if hasattr(item, "jaxpr"):
-                            scan_lengths(item.jaxpr, out)
-
-    lengths = []
-    scan_lengths(jaxpr.jaxpr, lengths)
+    lengths = engine.scan_lengths(jaxpr)
     assert lengths, "segmented program lost its pack scan"
     assert M in lengths, (
         f"pack scan length {lengths} is not the segment bucket {M}"

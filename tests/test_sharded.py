@@ -217,24 +217,15 @@ def test_mesh_and_single_keys_never_collide(mesh):
 # structural tripwires
 
 
-def _collect_primitives(jaxpr, out):
-    for eqn in jaxpr.eqns:
-        out.add(eqn.primitive.name)
-        for v in eqn.params.values():
-            if hasattr(v, "jaxpr"):
-                _collect_primitives(v.jaxpr, out)
-            elif isinstance(v, (list, tuple)):
-                for item in v:
-                    if hasattr(item, "jaxpr"):
-                        _collect_primitives(item.jaxpr, out)
-
-
 def test_mesh_program_has_no_host_roundtrips(mesh):
     """The rebuild's structural bar, asserted on the jaxpr: the multi-chip
     solve is ONE program — no callbacks (host round-trips) anywhere in its
     body, and the SpecLayout sharding constraints are actually present
     (the program IS a mesh program, not an accidental single-device
-    trace)."""
+    trace). The walkers live in analysis/irlint/engine.py — the same
+    predicates the ir-host-callback / ir-mesh-fence contracts apply in
+    `make irlint`."""
+    from karpenter_core_tpu.analysis.irlint import engine
     from karpenter_core_tpu.solver.encode import encode_snapshot
     from karpenter_core_tpu.solver.tpu_solver import (
         build_device_solve,
@@ -258,18 +249,13 @@ def test_mesh_program_has_no_host_roundtrips(mesh):
     )
     screen0 = jax.eval_shape(pre, args[0], args[9])
 
+    # engine.HOST_CALLBACK_PRIMS is the one spelling of "host round-trip"
+    # (device_put eqns are NOT in it — inside a jitted program they are
+    # on-device constant placement, not a host transfer)
     prims = set()
-    _collect_primitives(jax.make_jaxpr(run)(screen0, *args).jaxpr, prims)
-    _collect_primitives(jax.make_jaxpr(pre)(args[0], args[9]).jaxpr, prims)
-    # callbacks are the host round-trips jit can express; device_put eqns
-    # are NOT in this set — inside a jitted program they are on-device
-    # constant placement (how jnp.asarray of closure constants lowers),
-    # not a host transfer
-    host_prims = {
-        "pure_callback", "io_callback", "debug_callback", "callback",
-        "host_callback", "outside_call",
-    }
-    hits = prims & host_prims
+    prims |= engine.primitive_names(jax.make_jaxpr(run)(screen0, *args))
+    prims |= engine.primitive_names(jax.make_jaxpr(pre)(args[0], args[9]))
+    hits = prims & engine.HOST_CALLBACK_PRIMS
     assert not hits, f"mesh program contains host round-trips: {sorted(hits)}"
     assert "sharding_constraint" in prims, (
         "mesh program lost its SpecLayout constraints — it would compile "
@@ -316,16 +302,12 @@ def test_segmented_mesh_program_fence(mesh):
     item_sel = jax.ShapeDtypeStruct((8, 16), np.int32)
     exist_open = jax.ShapeDtypeStruct((8, E), np.bool_)
     screen0 = jax.ShapeDtypeStruct((N, C), np.bool_)
-    prims = set()
-    _collect_primitives(
-        jax.make_jaxpr(seg_run)(item_sel, exist_open, screen0, *args).jaxpr,
-        prims,
+    from karpenter_core_tpu.analysis.irlint import engine
+
+    prims = engine.primitive_names(
+        jax.make_jaxpr(seg_run)(item_sel, exist_open, screen0, *args)
     )
-    host_prims = {
-        "pure_callback", "io_callback", "debug_callback", "callback",
-        "host_callback", "outside_call",
-    }
-    hits = prims & host_prims
+    hits = prims & engine.HOST_CALLBACK_PRIMS
     assert not hits, (
         f"segmented mesh program contains host round-trips: {sorted(hits)}"
     )
@@ -351,8 +333,9 @@ def test_single_device_program_unchanged_by_layout_plumbing():
     snap = encode_snapshot(pods, provisioners, its, max_nodes=32)
     geom, run = build_device_solve(snap, 32, external_prescreen=False)
     args = device_args(snap, provisioners)
-    prims = set()
-    _collect_primitives(jax.make_jaxpr(run)(*args).jaxpr, prims)
+    from karpenter_core_tpu.analysis.irlint import engine
+
+    prims = engine.primitive_names(jax.make_jaxpr(run)(*args))
     assert "sharding_constraint" not in prims
 
 
